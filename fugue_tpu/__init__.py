@@ -1,0 +1,14 @@
+"""fugue_tpu: a TPU-native unified interface for distributed dataframe computing.
+
+A ground-up rebuild of the capabilities of Fugue (reference: guilhermedelyra/fugue)
+designed TPU-first: the flagship execution backend stores dataframe partitions as
+sharded ``jax.Array`` blocks on a device mesh and compiles transformers with
+``shard_map``/``vmap``, while the framework core (schema-carrying DataFrames,
+``PartitionSpec``, ExecutionEngine facets, interfaceless transformers, a lazy
+workflow DAG and a SQL front end) is self-contained pure Python.
+"""
+
+__version__ = "0.1.0"
+
+from fugue_tpu.schema import Schema
+from fugue_tpu.constants import register_global_conf

@@ -1,0 +1,52 @@
+"""Global configuration registry and well-known config keys.
+
+Layered config model (parity with reference fugue/constants.py:35-51):
+global conf (this module) <- engine conf at construction <- per-run overrides.
+"""
+
+from typing import Any, Dict
+
+from fugue_tpu.utils.params import ParamDict
+
+KEYWORD_ROWCOUNT = "ROWCOUNT"
+KEYWORD_PARALLELISM = "CONCURRENCY"
+
+FUGUE_CONF_WORKFLOW_CONCURRENCY = "fugue.workflow.concurrency"
+FUGUE_CONF_WORKFLOW_CHECKPOINT_PATH = "fugue.workflow.checkpoint.path"
+FUGUE_CONF_WORKFLOW_EXCEPTION_HIDE = "fugue.workflow.exception.hide"
+FUGUE_CONF_WORKFLOW_EXCEPTION_INJECT = "fugue.workflow.exception.inject"
+FUGUE_CONF_WORKFLOW_EXCEPTION_OPTIMIZE = "fugue.workflow.exception.optimize"
+FUGUE_CONF_SQL_IGNORE_CASE = "fugue.sql.compile.ignore_case"
+FUGUE_CONF_SQL_DIALECT = "fugue.sql.compile.dialect"
+FUGUE_CONF_RPC_SERVER = "fugue.rpc.server"
+FUGUE_CONF_JAX_PARTITIONS = "fugue.jax.default.partitions"
+FUGUE_CONF_JAX_COMPILE = "fugue.jax.compile"
+FUGUE_CONF_JAX_ROW_BUCKET = "fugue.jax.row_bucket"
+
+FUGUE_COMPILE_TIME_CONFIGS = {
+    FUGUE_CONF_WORKFLOW_EXCEPTION_HIDE,
+    FUGUE_CONF_WORKFLOW_EXCEPTION_INJECT,
+    FUGUE_CONF_WORKFLOW_EXCEPTION_OPTIMIZE,
+    FUGUE_CONF_SQL_IGNORE_CASE,
+    FUGUE_CONF_SQL_DIALECT,
+}
+
+_DEFAULT_CONF: Dict[str, Any] = {
+    FUGUE_CONF_WORKFLOW_CONCURRENCY: 1,
+    FUGUE_CONF_WORKFLOW_EXCEPTION_HIDE: "fugue_tpu.",
+    FUGUE_CONF_WORKFLOW_EXCEPTION_INJECT: 3,
+    FUGUE_CONF_WORKFLOW_EXCEPTION_OPTIMIZE: True,
+    FUGUE_CONF_SQL_IGNORE_CASE: False,
+    FUGUE_CONF_SQL_DIALECT: "spark",
+    FUGUE_CONF_JAX_ROW_BUCKET: 0,
+}
+
+_GLOBAL_CONF = ParamDict(_DEFAULT_CONF)
+
+
+def register_global_conf(conf: Dict[str, Any], on_dup: int = ParamDict.OVERWRITE) -> None:
+    """Register global configs readable by every engine/workflow created after."""
+    _GLOBAL_CONF.update(conf, on_dup=on_dup)
+
+
+FUGUE_GLOBAL_CONF = _GLOBAL_CONF

@@ -1,0 +1,98 @@
+"""Conditional-dispatch plugin system.
+
+The extensibility backbone (role of reference ``fugue/_utils/registry.py:9``
+``fugue_plugin`` + the ``"fugue.plugins"`` entry point protocol, rebuilt from
+scratch): a function decorated with :func:`fugue_tpu_plugin` becomes a
+dispatcher; implementations register with ``@f.candidate(matcher)`` where
+``matcher(*args, **kwargs) -> bool`` decides applicability. Candidates are
+tried in priority order (highest first, later registrations win ties); if none
+matches, the decorated body runs as the fallback.
+"""
+
+import inspect
+from importlib.metadata import entry_points
+from typing import Any, Callable, List, NamedTuple, Optional
+
+_ENTRY_POINT_GROUP = "fugue_tpu.plugins"
+_PLUGINS_LOADED = False
+
+
+class _Candidate(NamedTuple):
+    matcher: Callable[..., bool]
+    func: Callable
+    priority: float
+    order: int
+
+
+class ConditionalDispatcher:
+    def __init__(self, default_func: Callable):
+        self._default = default_func
+        self._candidates: List[_Candidate] = []
+        self._counter = 0
+        self.__name__ = default_func.__name__
+        self.__doc__ = default_func.__doc__
+        self.__module__ = default_func.__module__
+        try:
+            self.__signature__ = inspect.signature(default_func)
+        except (TypeError, ValueError):
+            pass
+
+    def candidate(
+        self, matcher: Callable[..., bool], priority: float = 1.0
+    ) -> Callable[[Callable], Callable]:
+        def deco(func: Callable) -> Callable:
+            self.register(matcher, func, priority)
+            return func
+
+        return deco
+
+    def register(
+        self, matcher: Callable[..., bool], func: Callable, priority: float = 1.0
+    ) -> None:
+        self._counter += 1
+        self._candidates.append(_Candidate(matcher, func, priority, self._counter))
+        # stable: higher priority first; among equal priorities, later wins
+        self._candidates.sort(key=lambda c: (-c.priority, -c.order))
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        _load_entry_point_plugins()
+        for c in self._candidates:
+            try:
+                matched = c.matcher(*args, **kwargs)
+            except Exception:
+                matched = False
+            if matched:
+                return c.func(*args, **kwargs)
+        return self._default(*args, **kwargs)
+
+    def run_top(self, *args: Any, **kwargs: Any) -> Any:
+        """Like __call__ but raises NotImplementedError when nothing matches
+        and the default body raises."""
+        return self(*args, **kwargs)
+
+
+def fugue_tpu_plugin(func: Callable) -> ConditionalDispatcher:
+    return ConditionalDispatcher(func)
+
+
+# keep the short alias used across the codebase
+fugue_plugin = fugue_tpu_plugin
+
+
+def _load_entry_point_plugins() -> None:
+    """Load third-party plugin modules registered under the
+    ``fugue_tpu.plugins`` entry point group (parity with the reference's
+    ``fugue.plugins`` group, reference setup.py:96-108)."""
+    global _PLUGINS_LOADED
+    if _PLUGINS_LOADED:
+        return
+    _PLUGINS_LOADED = True
+    try:
+        eps = entry_points(group=_ENTRY_POINT_GROUP)
+    except TypeError:  # older API
+        eps = entry_points().get(_ENTRY_POINT_GROUP, [])  # type: ignore
+    for ep in eps:
+        try:
+            ep.load()
+        except Exception:  # plugin failures never break the host
+            pass

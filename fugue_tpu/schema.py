@@ -1,0 +1,482 @@
+"""Schema: an ordered, expression-parseable column schema backed by pyarrow.
+
+Plays the role the reference delegates to ``triad.Schema`` (see reference
+``fugue/dataframe/dataframe.py:29`` usage) but is built from scratch here:
+a thin ordered mapping ``name -> pyarrow.DataType`` with a compact string
+expression syntax::
+
+    "a:int,b:str,c:[long],d:{x:double,y:str},e:<str,int>,f:datetime"
+
+Supported type tokens (aliases in parens): bool(boolean), int8(byte),
+int16(short), int32, int(=int64 alias long), uint8..uint64, float16,
+float(float32), double(float64), str(string), bytes(binary), date,
+datetime(timestamp, microsecond), null, decimal(p,s), [T] lists,
+{name:T,...} structs, <K,V> maps.
+
+Design note for TPU: the schema intentionally keeps pyarrow as the *host
+boundary* type system; device blocks (fugue_tpu/jax_backend) map a subset of
+these (numeric/bool/temporal + dictionary-encoded strings) onto jax dtypes.
+"""
+
+import re
+from typing import Any, Dict, Iterable, List, Optional, Tuple, Union
+
+import pandas as pd
+import pyarrow as pa
+
+from fugue_tpu.utils.assertion import assert_or_throw
+
+_SIMPLE_TYPES: Dict[str, pa.DataType] = {
+    "null": pa.null(),
+    "bool": pa.bool_(),
+    "boolean": pa.bool_(),
+    "int8": pa.int8(),
+    "byte": pa.int8(),
+    "int16": pa.int16(),
+    "short": pa.int16(),
+    "int32": pa.int32(),
+    "int": pa.int32(),
+    "int64": pa.int64(),
+    "long": pa.int64(),
+    "uint8": pa.uint8(),
+    "ubyte": pa.uint8(),
+    "uint16": pa.uint16(),
+    "ushort": pa.uint16(),
+    "uint32": pa.uint32(),
+    "uint": pa.uint32(),
+    "uint64": pa.uint64(),
+    "ulong": pa.uint64(),
+    "float16": pa.float16(),
+    "float32": pa.float32(),
+    "float": pa.float32(),
+    "float64": pa.float64(),
+    "double": pa.float64(),
+    "string": pa.string(),
+    "str": pa.string(),
+    "binary": pa.binary(),
+    "bytes": pa.binary(),
+    "date": pa.date32(),
+    "datetime": pa.timestamp("us"),
+    "timestamp": pa.timestamp("us"),
+}
+
+# canonical (shortest, unambiguous) names for to-string conversion
+_TYPE_TO_NAME: Dict[pa.DataType, str] = {
+    pa.null(): "null",
+    pa.bool_(): "bool",
+    pa.int8(): "int8",
+    pa.int16(): "int16",
+    pa.int32(): "int",
+    pa.int64(): "long",
+    pa.uint8(): "uint8",
+    pa.uint16(): "uint16",
+    pa.uint32(): "uint32",
+    pa.uint64(): "uint64",
+    pa.float16(): "float16",
+    pa.float32(): "float",
+    pa.float64(): "double",
+    pa.string(): "str",
+    pa.binary(): "bytes",
+    pa.date32(): "date",
+}
+
+_NAME_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+
+
+def is_valid_column_name(name: str) -> bool:
+    return isinstance(name, str) and _NAME_RE.match(name) is not None
+
+
+def parse_type(expr: str) -> pa.DataType:
+    """Parse a single type expression into a pyarrow DataType."""
+    t, pos = _parse_type(expr, 0)
+    assert_or_throw(pos == len(expr.strip()) or expr[pos:].strip() == "",
+                    ValueError(f"invalid type expression {expr.rstrip(chr(0))!r}"))
+    return t
+
+
+def type_to_expr(tp: pa.DataType) -> str:
+    """Canonical string name of a pyarrow type (inverse of :func:`parse_type`)."""
+    if tp in _TYPE_TO_NAME:
+        return _TYPE_TO_NAME[tp]
+    if pa.types.is_timestamp(tp):
+        if tp.tz is None and tp.unit == "us":
+            return "datetime"
+        tz = f",{tp.tz}" if tp.tz is not None else ""
+        return f"timestamp({tp.unit}{tz})"
+    if pa.types.is_decimal(tp):
+        return f"decimal({tp.precision},{tp.scale})"
+    if pa.types.is_list(tp) or pa.types.is_large_list(tp):
+        return f"[{type_to_expr(tp.value_type)}]"
+    if pa.types.is_map(tp):
+        return f"<{type_to_expr(tp.key_type)},{type_to_expr(tp.item_type)}>"
+    if pa.types.is_struct(tp):
+        inner = ",".join(f"{f.name}:{type_to_expr(f.type)}" for f in tp)
+        return "{" + inner + "}"
+    if pa.types.is_large_string(tp):
+        return "str"
+    if pa.types.is_large_binary(tp):
+        return "bytes"
+    raise ValueError(f"unsupported type {tp}")
+
+
+def _skip_ws(s: str, pos: int) -> int:
+    while pos < len(s) and s[pos].isspace():
+        pos += 1
+    return pos
+
+
+def _parse_name(s: str, pos: int) -> Tuple[str, int]:
+    pos = _skip_ws(s, pos)
+    if pos < len(s) and s[pos] == "`":
+        end = s.find("`", pos + 1)
+        assert_or_throw(end > pos, ValueError(f"unclosed backquote in {s.rstrip(chr(0))!r}"))
+        return s[pos + 1 : end], end + 1
+    m = re.match(r"[A-Za-z_][A-Za-z0-9_]*", s[pos:])
+    assert_or_throw(
+        m is not None, ValueError(f"invalid name at {s[pos:].rstrip(chr(0))!r}")
+    )
+    return m.group(0), pos + m.end()
+
+
+def _parse_type(s: str, pos: int) -> Tuple[pa.DataType, int]:
+    pos = _skip_ws(s, pos)
+    assert_or_throw(pos < len(s), ValueError(f"empty type expression in {s.rstrip(chr(0))!r}"))
+    ch = s[pos]
+    if ch == "[":
+        inner, pos = _parse_type(s, pos + 1)
+        pos = _skip_ws(s, pos)
+        assert_or_throw(pos < len(s) and s[pos] == "]", ValueError(f"expect ] in {s.rstrip(chr(0))!r}"))
+        return pa.list_(inner), pos + 1
+    if ch == "<":
+        ktype, pos = _parse_type(s, pos + 1)
+        pos = _skip_ws(s, pos)
+        assert_or_throw(pos < len(s) and s[pos] == ",", ValueError(f"expect , in map {s.rstrip(chr(0))!r}"))
+        vtype, pos = _parse_type(s, pos + 1)
+        pos = _skip_ws(s, pos)
+        assert_or_throw(pos < len(s) and s[pos] == ">", ValueError(f"expect > in {s.rstrip(chr(0))!r}"))
+        return pa.map_(ktype, vtype), pos + 1
+    if ch == "{":
+        fields, pos = _parse_fields(s, pos + 1, "}")
+        return pa.struct(fields), pos
+    m = re.match(r"[A-Za-z_][A-Za-z0-9_]*", s[pos:])
+    assert_or_throw(m is not None, ValueError(f"invalid type at {s[pos:].rstrip(chr(0))!r}"))
+    name = m.group(0).lower()
+    pos += m.end()
+    if name == "decimal":
+        pos = _skip_ws(s, pos)
+        assert_or_throw(pos < len(s) and s[pos] == "(", ValueError("decimal needs (p,s)"))
+        end = s.find(")", pos)
+        assert_or_throw(end > 0, ValueError("decimal needs closing )"))
+        parts = [p.strip() for p in s[pos + 1 : end].split(",")]
+        prec = int(parts[0])
+        scale = int(parts[1]) if len(parts) > 1 else 0
+        return pa.decimal128(prec, scale), end + 1
+    if name == "timestamp":
+        pos2 = _skip_ws(s, pos)
+        if pos2 < len(s) and s[pos2] == "(":
+            end = s.find(")", pos2)
+            assert_or_throw(end > 0, ValueError("timestamp needs closing )"))
+            parts = [p.strip() for p in s[pos2 + 1 : end].split(",")]
+            unit = parts[0]
+            tz = parts[1] if len(parts) > 1 else None
+            return pa.timestamp(unit, tz), end + 1
+        return pa.timestamp("us"), pos
+    assert_or_throw(name in _SIMPLE_TYPES, ValueError(f"unknown type {name!r}"))
+    return _SIMPLE_TYPES[name], pos
+
+
+def _parse_fields(s: str, pos: int, closing: str) -> Tuple[List[pa.Field], int]:
+    fields: List[pa.Field] = []
+    while True:
+        pos = _skip_ws(s, pos)
+        assert_or_throw(pos < len(s), ValueError(f"unclosed struct in {s.rstrip(chr(0))!r}"))
+        if s[pos] == closing:
+            return fields, pos + 1
+        name, pos = _parse_name(s, pos)
+        pos = _skip_ws(s, pos)
+        assert_or_throw(pos < len(s) and s[pos] == ":", ValueError(f"expect : after {name}"))
+        tp, pos = _parse_type(s, pos + 1)
+        fields.append(pa.field(name, tp))
+        pos = _skip_ws(s, pos)
+        if pos < len(s) and s[pos] == ",":
+            pos += 1
+
+
+class Schema:
+    """Ordered column schema. Construct from expression strings, pyarrow
+    schemas/fields, pandas dataframes, dicts, tuples, or other Schemas;
+    mix-and-match via ``Schema("a:int", other_schema, ("b", pa.int64()))``.
+    """
+
+    def __init__(self, *args: Any, **kwargs: Any):
+        self._fields: Dict[str, pa.Field] = {}
+        for a in args:
+            self._append(a)
+        for k, v in kwargs.items():
+            self._append_field(pa.field(k, self._to_type(v)))
+
+    # ---- construction helpers -------------------------------------------
+    def _append(self, obj: Any) -> None:
+        if obj is None:
+            return
+        if isinstance(obj, str):
+            s = obj.strip()
+            if s == "":
+                return
+            fields, pos = _parse_fields(s + "\0", 0, "\0")
+            for f in fields:
+                self._append_field(f)
+        elif isinstance(obj, Schema):
+            for f in obj.fields:
+                self._append_field(f)
+        elif isinstance(obj, pa.Schema):
+            for f in obj:
+                self._append_field(f)
+        elif isinstance(obj, pa.Field):
+            self._append_field(obj)
+        elif isinstance(obj, pd.DataFrame):
+            self._append(pa.Schema.from_pandas(obj, preserve_index=False))
+        elif isinstance(obj, tuple) and len(obj) == 2:
+            self._append_field(pa.field(obj[0], self._to_type(obj[1])))
+        elif isinstance(obj, dict):
+            for k, v in obj.items():
+                self._append_field(pa.field(k, self._to_type(v)))
+        elif isinstance(obj, Iterable):
+            for x in obj:
+                self._append(x)
+        else:
+            raise ValueError(f"can't build schema from {obj!r}")
+
+    def _to_type(self, v: Any) -> pa.DataType:
+        if isinstance(v, pa.DataType):
+            return v
+        if isinstance(v, str):
+            return parse_type(v)
+        raise ValueError(f"can't interpret {v!r} as a type")
+
+    def _append_field(self, f: pa.Field) -> None:
+        assert_or_throw(
+            isinstance(f.name, str) and f.name != "" and not f.name.startswith("_#"),
+            ValueError(f"invalid field name {f.name!r}"),
+        )
+        assert_or_throw(
+            f.name not in self._fields, KeyError(f"duplicated field name {f.name}")
+        )
+        tp = f.type
+        # normalize: large_string -> string, ns timestamps stay as-is
+        if pa.types.is_large_string(tp):
+            tp = pa.string()
+        elif pa.types.is_large_binary(tp):
+            tp = pa.binary()
+        self._fields[f.name] = pa.field(f.name, tp)
+
+    # ---- core accessors --------------------------------------------------
+    @property
+    def names(self) -> List[str]:
+        return list(self._fields.keys())
+
+    @property
+    def fields(self) -> List[pa.Field]:
+        return list(self._fields.values())
+
+    @property
+    def types(self) -> List[pa.DataType]:
+        return [f.type for f in self._fields.values()]
+
+    @property
+    def pa_schema(self) -> pa.Schema:
+        return pa.schema(self.fields)
+
+    @property
+    def pandas_dtype(self) -> Dict[str, Any]:
+        return {f.name: f.type.to_pandas_dtype() for f in self.fields}
+
+    def __len__(self) -> int:
+        return len(self._fields)
+
+    def __iter__(self):
+        return iter(self._fields.keys())
+
+    def __contains__(self, key: Any) -> bool:
+        if isinstance(key, str):
+            if "," in key or ":" in key:
+                try:
+                    other = Schema(key)
+                except Exception:
+                    return False
+                return all(f.name in self._fields and self._fields[f.name].type == f.type
+                           for f in other.fields)
+            return key in self._fields
+        if isinstance(key, pa.Field):
+            return key.name in self._fields and self._fields[key.name].type == key.type
+        if isinstance(key, Schema):
+            return all(f in self for f in key.fields)
+        if isinstance(key, Iterable):
+            return all(k in self for k in key)
+        return False
+
+    def __getitem__(self, key: Union[str, int]) -> pa.Field:
+        if isinstance(key, int):
+            return self.fields[key]
+        return self._fields[key]
+
+    def index_of_key(self, key: str) -> int:
+        for i, n in enumerate(self._fields.keys()):
+            if n == key:
+                return i
+        raise KeyError(key)
+
+    def get_type(self, key: str) -> pa.DataType:
+        return self._fields[key].type
+
+    # ---- comparisons -----------------------------------------------------
+    def __eq__(self, other: Any) -> bool:
+        if other is None:
+            return False
+        if not isinstance(other, Schema):
+            try:
+                other = Schema(other)
+            except Exception:
+                return False
+        return self.names == other.names and all(
+            a.type == b.type for a, b in zip(self.fields, other.fields)
+        )
+
+    def __ne__(self, other: Any) -> bool:
+        return not self.__eq__(other)
+
+    def __hash__(self) -> int:
+        return hash(str(self))
+
+    # ---- algebra ---------------------------------------------------------
+    def __add__(self, other: Any) -> "Schema":
+        return Schema(self, other)
+
+    def __sub__(self, other: Any) -> "Schema":
+        return self.exclude(other)
+
+    def exclude(self, other: Any) -> "Schema":
+        """Remove columns by name(s) (or schema whose names+types must match)."""
+        names = self._to_names(other, require_type_match=True)
+        return Schema([f for f in self.fields if f.name not in names])
+
+    def remove(self, other: Any, ignore_type_mismatch: bool = True) -> "Schema":
+        names = self._to_names(other, require_type_match=not ignore_type_mismatch)
+        return Schema([f for f in self.fields if f.name not in names])
+
+    def extract(self, other: Any, ignore_type_mismatch: bool = False) -> "Schema":
+        """Select a subset (ordered as requested)."""
+        names = self._to_names(other, require_type_match=not ignore_type_mismatch,
+                               keep_order=True)
+        return Schema([self._fields[n] for n in names if n in self._fields])
+
+    def intersect(self, other: Any) -> "Schema":
+        names = set(self._to_names(other, require_type_match=False))
+        return Schema([f for f in self.fields if f.name in names])
+
+    def union(self, other: Any, require_type_match: bool = False) -> "Schema":
+        res = Schema(self)
+        o = other if isinstance(other, Schema) else Schema(other)
+        for f in o.fields:
+            if f.name not in res._fields:
+                res._fields[f.name] = f
+            elif require_type_match:
+                assert_or_throw(
+                    res._fields[f.name].type == f.type,
+                    ValueError(f"type mismatch on {f.name}"),
+                )
+        return res
+
+    def rename(self, columns: Dict[str, str], ignore_missing: bool = False) -> "Schema":
+        if not ignore_missing:
+            for k in columns:
+                assert_or_throw(k in self._fields, KeyError(f"{k} not in schema"))
+        new_names = [columns.get(n, n) for n in self.names]
+        assert_or_throw(
+            len(set(new_names)) == len(new_names),
+            ValueError(f"rename causes duplicated names {new_names}"),
+        )
+        return Schema([pa.field(nn, f.type) for nn, f in zip(new_names, self.fields)])
+
+    def alter(self, subschema: Any) -> "Schema":
+        """Return a new schema with types of the named subset changed."""
+        if subschema is None:
+            return Schema(self)
+        sub = subschema if isinstance(subschema, Schema) else Schema(subschema)
+        for n in sub.names:
+            assert_or_throw(n in self._fields, KeyError(f"{n} not in schema"))
+        return Schema(
+            [sub[f.name] if f.name in sub._fields else f for f in self.fields]
+        )
+
+    def _to_names(
+        self, other: Any, require_type_match: bool, keep_order: bool = False
+    ) -> List[str]:
+        if other is None:
+            return []
+        if isinstance(other, str) and ("," in other or ":" in other):
+            other = Schema(other)
+        if isinstance(other, str):
+            return [other]
+        if isinstance(other, Schema):
+            for f in other.fields:
+                if require_type_match and f.name in self._fields:
+                    assert_or_throw(
+                        self._fields[f.name].type == f.type,
+                        ValueError(
+                            f"type mismatch on {f.name}: "
+                            f"{self._fields[f.name].type} vs {f.type}"
+                        ),
+                    )
+            return other.names
+        if isinstance(other, Iterable):
+            res: List[str] = []
+            for x in other:
+                res.extend(self._to_names(x, require_type_match, keep_order))
+            return res
+        raise ValueError(f"can't interpret {other!r} as column names")
+
+    # ---- representations -------------------------------------------------
+    def __repr__(self) -> str:
+        return str(self)
+
+    def __str__(self) -> str:
+        return ",".join(
+            f"{self._quote(f.name)}:{type_to_expr(f.type)}" for f in self.fields
+        )
+
+    def _quote(self, name: str) -> str:
+        return name if _NAME_RE.match(name) else f"`{name}`"
+
+    def create_empty_pandas(self) -> pd.DataFrame:
+        return self.pa_schema.empty_table().to_pandas()
+
+    def create_empty_arrow(self) -> pa.Table:
+        return self.pa_schema.empty_table()
+
+    def assert_not_empty(self) -> "Schema":
+        assert_or_throw(len(self) > 0, ValueError("schema is empty"))
+        return self
+
+    def transform(self, *args: Any, **kwargs: Any) -> "Schema":
+        """Schema arithmetic used by transformers' schema hints: each arg can be
+        a new schema expression, ``"*"`` (all input columns), ``"-col1,col2"``
+        (exclusion) or ``"+a:int"`` (addition)."""
+        res = Schema()
+        for a in args:
+            if isinstance(a, str):
+                s = a.strip()
+                if s == "*":
+                    res += self
+                    continue
+                if s.startswith("-"):
+                    res = res.remove([x.strip() for x in s[1:].split(",") if x.strip()])
+                    continue
+                if s.startswith("+"):
+                    res += s[1:]
+                    continue
+            res += a
+        if len(kwargs) > 0:
+            res += Schema(**kwargs)
+        return res

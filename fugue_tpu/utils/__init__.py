@@ -1,0 +1,3 @@
+from fugue_tpu.utils.assertion import assert_or_throw
+from fugue_tpu.utils.params import ParamDict
+from fugue_tpu.utils.lock import SerializableRLock

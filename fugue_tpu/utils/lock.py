@@ -1,0 +1,25 @@
+from threading import RLock
+from typing import Any
+
+
+class SerializableRLock:
+    """An ``RLock`` that survives pickling (the lock state itself is not
+    serialized; a fresh lock is created on deserialization). Engines and
+    lazily-evaluated schemas hold one of these so they can be shipped to
+    workers inside closures.
+    """
+
+    def __init__(self) -> None:
+        self._lock = RLock()
+
+    def __enter__(self) -> Any:
+        return self._lock.__enter__()
+
+    def __exit__(self, *args: Any, **kwargs: Any) -> Any:
+        return self._lock.__exit__(*args, **kwargs)
+
+    def __getstate__(self) -> dict:
+        return {}
+
+    def __setstate__(self, state: dict) -> None:
+        self._lock = RLock()

@@ -1,0 +1,8 @@
+import os
+
+# Force JAX onto a virtual 8-device CPU mesh for all tests: multi-chip sharding
+# is validated without TPU hardware (the driver separately dry-runs the
+# multichip path; see __graft_entry__.py).
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")

@@ -1,0 +1,112 @@
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from fugue_tpu.schema import Schema, parse_type, type_to_expr
+
+
+def test_parse_simple_types():
+    assert parse_type("int") == pa.int32()
+    assert parse_type("long") == pa.int64()
+    assert parse_type("str") == pa.string()
+    assert parse_type("double") == pa.float64()
+    assert parse_type("bool") == pa.bool_()
+    assert parse_type("datetime") == pa.timestamp("us")
+    assert parse_type("date") == pa.date32()
+    assert parse_type("bytes") == pa.binary()
+    assert parse_type("decimal(5,2)") == pa.decimal128(5, 2)
+
+
+def test_parse_nested_types():
+    assert parse_type("[int]") == pa.list_(pa.int32())
+    assert parse_type("[[str]]") == pa.list_(pa.list_(pa.string()))
+    assert parse_type("<str,int>") == pa.map_(pa.string(), pa.int32())
+    t = parse_type("{a:int,b:[str]}")
+    assert pa.types.is_struct(t)
+    assert t.field("a").type == pa.int32()
+    assert t.field("b").type == pa.list_(pa.string())
+
+
+def test_type_roundtrip():
+    for expr in ["int", "long", "str", "double", "[int]", "<str,long>",
+                 "{a:int,b:{c:[double]}}", "datetime", "date", "bytes",
+                 "decimal(10,3)", "timestamp(ns,UTC)"]:
+        assert type_to_expr(parse_type(expr)) == expr
+
+
+def test_schema_construct():
+    s = Schema("a:int,b:str")
+    assert s.names == ["a", "b"]
+    assert s.types == [pa.int32(), pa.string()]
+    assert str(s) == "a:int,b:str"
+    s2 = Schema(s, "c:double", ("d", pa.int64()), e="datetime")
+    assert str(s2) == "a:int,b:str,c:double,d:long,e:datetime"
+    assert Schema(dict(a="int", b="str")) == Schema("a:int,b:str")
+    assert Schema() == Schema("")
+    assert len(Schema()) == 0
+
+
+def test_schema_from_pandas():
+    df = pd.DataFrame({"a": [1, 2], "b": ["x", "y"], "c": [1.0, 2.0]})
+    s = Schema(df)
+    assert s["a"].type in (pa.int64(),)
+    assert s["b"].type == pa.string()
+    assert s["c"].type == pa.float64()
+
+
+def test_schema_dup_and_invalid():
+    with pytest.raises(Exception):
+        Schema("a:int,a:str")
+    with pytest.raises(Exception):
+        Schema("a:unknown_type")
+    with pytest.raises(Exception):
+        Schema("_#a:int")
+
+
+def test_schema_contains_eq():
+    s = Schema("a:int,b:str,c:double")
+    assert "a" in s
+    assert "x" not in s
+    assert "a:int" in s
+    assert "a:str" not in s
+    assert ["a", "b"] in s
+    assert Schema("a:int,b:str") in s
+    assert s == "a:int,b:str,c:double"
+    assert s != "b:str,a:int,c:double"  # order matters
+
+
+def test_schema_algebra():
+    s = Schema("a:int,b:str,c:double")
+    assert (s - "b") == "a:int,c:double"
+    assert s.exclude(["a", "c"]) == "b:str"
+    assert s.extract(["c", "a"]) == "c:double,a:int"
+    assert s.intersect(["b", "z"]) == "b:str"
+    assert (s + "d:bool") == "a:int,b:str,c:double,d:bool"
+    assert s.union("c:double,d:bool") == "a:int,b:str,c:double,d:bool"
+    assert s.rename({"a": "aa"}) == "aa:int,b:str,c:double"
+    with pytest.raises(Exception):
+        s.rename({"x": "y"})
+    assert s.alter("a:long") == "a:long,b:str,c:double"
+
+
+def test_schema_transform():
+    s = Schema("a:int,b:str")
+    assert s.transform("*") == s
+    assert s.transform("*", "c:double") == "a:int,b:str,c:double"
+    assert s.transform("*", "-a") == "b:str"
+    assert s.transform("*", "+c:double") == "a:int,b:str,c:double"
+
+
+def test_backquoted_names():
+    s = Schema("`a b`:int,c:str")
+    assert s.names == ["a b", "c"]
+    assert str(s) == "`a b`:int,c:str"
+
+
+def test_empty_creation():
+    s = Schema("a:int,b:str")
+    pdf = s.create_empty_pandas()
+    assert list(pdf.columns) == ["a", "b"]
+    assert len(pdf) == 0
+    t = s.create_empty_arrow()
+    assert t.schema == s.pa_schema

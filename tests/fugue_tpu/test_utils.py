@@ -1,0 +1,88 @@
+import pickle
+
+import pytest
+
+from fugue_tpu.plugins import fugue_plugin
+from fugue_tpu.utils.assertion import assert_or_throw
+from fugue_tpu.utils.hash import to_uuid
+from fugue_tpu.utils.lock import SerializableRLock
+from fugue_tpu.utils.params import ParamDict
+
+
+def test_assert_or_throw():
+    assert_or_throw(True)
+    assert_or_throw(True, "never")
+    with pytest.raises(AssertionError):
+        assert_or_throw(False)
+    with pytest.raises(AssertionError, match="msg"):
+        assert_or_throw(False, "msg")
+    with pytest.raises(ValueError, match="ve"):
+        assert_or_throw(False, ValueError("ve"))
+    with pytest.raises(KeyError):
+        assert_or_throw(False, lambda: KeyError("k"))
+
+
+def test_param_dict():
+    p = ParamDict({"a": 1, "b": "2", "c": "true", "d": 0.5})
+    assert p.get("a", 0) == 1
+    assert p.get("b", 0) == 2
+    assert p.get("b", "x") == "2"
+    assert p.get("c", False) is True
+    assert p.get("missing", 10) == 10
+    assert p.get_or_none("missing", int) is None
+    assert p.get_or_none("a", int) == 1
+    assert p.get_or_throw("a", int) == 1
+    with pytest.raises(KeyError):
+        p.get_or_throw("missing", int)
+    with pytest.raises(ValueError):
+        p.get("d", 1)  # 0.5 not an int
+    with pytest.raises(KeyError):
+        ParamDict({"a": 1}).update({"a": 2}, on_dup=ParamDict.THROW)
+    p2 = ParamDict({"a": 1})
+    p2.update({"a": 2}, on_dup=ParamDict.IGNORE)
+    assert p2["a"] == 1
+    assert ParamDict([("x", 1)]) == {"x": 1}
+
+
+def test_to_uuid_deterministic():
+    assert to_uuid(1, "a", [1, 2]) == to_uuid(1, "a", [1, 2])
+    assert to_uuid({"a": 1, "b": 2}) == to_uuid({"b": 2, "a": 1})
+    assert to_uuid(1) != to_uuid(2)
+    f = lambda x: x + 1  # noqa
+    assert to_uuid(f) == to_uuid(f)
+
+
+def test_serializable_lock():
+    lock = SerializableRLock()
+    with lock:
+        pass
+    lock2 = pickle.loads(pickle.dumps(lock))
+    with lock2:
+        pass
+
+
+def test_plugin_dispatch():
+    @fugue_plugin
+    def handle(obj) -> str:
+        return "default"
+
+    assert handle(123) == "default"
+
+    @handle.candidate(lambda obj: isinstance(obj, str))
+    def _handle_str(obj) -> str:
+        return "str"
+
+    @handle.candidate(lambda obj: isinstance(obj, int), priority=2)
+    def _handle_int(obj) -> str:
+        return "int"
+
+    assert handle("x") == "str"
+    assert handle(1) == "int"
+    assert handle(1.5) == "default"
+
+    # later registration with same priority wins
+    @handle.candidate(lambda obj: isinstance(obj, str))
+    def _handle_str2(obj) -> str:
+        return "str2"
+
+    assert handle("x") == "str2"
