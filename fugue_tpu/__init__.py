@@ -12,3 +12,21 @@ __version__ = "0.1.0"
 
 from fugue_tpu.schema import Schema
 from fugue_tpu.constants import register_global_conf
+from fugue_tpu.collections.partition import PartitionSpec, PartitionCursor
+from fugue_tpu.collections.yielded import PhysicalYielded, Yielded
+from fugue_tpu.dataset import Dataset
+from fugue_tpu.dataframe import (
+    ArrayDataFrame,
+    ArrowDataFrame,
+    DataFrame,
+    DataFrames,
+    IterableArrowDataFrame,
+    IterableDataFrame,
+    IterablePandasDataFrame,
+    LocalBoundedDataFrame,
+    LocalDataFrame,
+    LocalDataFrameIterableDataFrame,
+    PandasDataFrame,
+    as_fugue_df,
+)
+from fugue_tpu.bag import ArrayBag, Bag

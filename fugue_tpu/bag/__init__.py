@@ -1,0 +1,2 @@
+from fugue_tpu.bag.bag import Bag, BagDisplay, LocalBag, LocalBoundedBag
+from fugue_tpu.bag.array_bag import ArrayBag

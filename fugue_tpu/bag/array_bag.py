@@ -1,0 +1,35 @@
+from typing import Any, Iterable, List
+
+from fugue_tpu.bag.bag import Bag, LocalBoundedBag
+from fugue_tpu.utils.assertion import assert_or_throw
+
+
+class ArrayBag(LocalBoundedBag):
+    def __init__(self, data: Any, copy: bool = True):
+        super().__init__()
+        if isinstance(data, ArrayBag):
+            self._native: List[Any] = list(data._native) if copy else data._native
+        elif isinstance(data, list):
+            self._native = list(data) if copy else data
+        elif isinstance(data, Iterable):
+            self._native = list(data)
+        else:
+            raise ValueError(f"can't initialize ArrayBag with {type(data)}")
+
+    @property
+    def native(self) -> List[Any]:
+        return self._native
+
+    @property
+    def empty(self) -> bool:
+        return len(self._native) == 0
+
+    def count(self) -> int:
+        return len(self._native)
+
+    def peek(self) -> Any:
+        assert_or_throw(not self.empty, ValueError("bag is empty"))
+        return self._native[0]
+
+    def as_array(self) -> List[Any]:
+        return list(self._native)

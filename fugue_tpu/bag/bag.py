@@ -1,0 +1,69 @@
+"""Bag: unordered collection of arbitrary python objects — the schemaless
+sibling of DataFrame (reference fugue/bag/bag.py:7)."""
+
+from abc import abstractmethod
+from typing import Any, List, Optional
+
+from fugue_tpu.dataset.dataset import Dataset, DatasetDisplay, get_dataset_display
+from fugue_tpu.utils.assertion import assert_or_throw
+
+
+class Bag(Dataset):
+    @abstractmethod
+    def as_local_bounded(self) -> "LocalBoundedBag":  # pragma: no cover
+        raise NotImplementedError
+
+    def as_local(self) -> "LocalBag":
+        return self.as_local_bounded()
+
+    @abstractmethod
+    def peek(self) -> Any:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    @abstractmethod
+    def as_array(self) -> List[Any]:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def head(self, n: int) -> "LocalBoundedBag":
+        from fugue_tpu.bag.array_bag import ArrayBag
+
+        assert_or_throw(n >= 0, ValueError("n must be >= 0"))
+        return ArrayBag(self.as_array()[:n])
+
+
+class LocalBag(Bag):
+    @property
+    def is_local(self) -> bool:
+        return True
+
+    @property
+    def num_partitions(self) -> int:
+        return 1
+
+
+class LocalBoundedBag(LocalBag):
+    @property
+    def is_bounded(self) -> bool:
+        return True
+
+    def as_local_bounded(self) -> "LocalBoundedBag":
+        return self
+
+
+class BagDisplay(DatasetDisplay):
+    def show(
+        self, n: int = 10, with_count: bool = False, title: Optional[str] = None
+    ) -> None:
+        bg: Bag = self._ds  # type: ignore
+        head = bg.head(n).as_array()
+        if title:
+            print(title)
+        print(type(bg).__name__)
+        print(head)
+        if with_count:
+            print(f"Total count: {bg.count()}")
+
+
+@get_dataset_display.candidate(lambda ds: isinstance(ds, Bag), priority=0.5)
+def _get_bag_display(ds: Bag) -> BagDisplay:
+    return BagDisplay(ds)

@@ -1,0 +1,304 @@
+"""PartitionSpec: the partitioning DSL + partition cursors.
+
+Parity target: reference ``fugue/collections/partition.py:79`` — algorithms
+``default|hash|rand|even|coarse``, a ``num`` expression supporting the
+``ROWCOUNT``/``CONCURRENCY`` keywords, ``by`` keys and ``presort``, plus the
+``"per_row"`` sugar. On the JAX backend these translate to device-placement
+reshards over the mesh rather than shuffles (SURVEY §2.10 TPU mapping).
+"""
+
+import json
+import re
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Tuple
+
+from fugue_tpu.schema import Schema
+from fugue_tpu.utils.assertion import assert_or_throw
+from fugue_tpu.utils.hash import to_uuid
+
+KEYWORD_ROWCOUNT = "ROWCOUNT"
+KEYWORD_CONCURRENCY = "CONCURRENCY"
+
+_ALGOS = {"", "default", "hash", "rand", "even", "coarse"}
+_NUM_EXPR_RE = re.compile(r"^[0-9+\-*/() %]*$")
+
+
+def parse_presort_exp(presort: Any) -> Dict[str, bool]:
+    """Parse ``"a asc, b desc"`` / dict / list-of-tuples into an ordered
+    ``{col: ascending}`` mapping."""
+    if presort is None:
+        return {}
+    if isinstance(presort, dict):
+        for v in presort.values():
+            assert_or_throw(isinstance(v, bool), ValueError("presort value must be bool"))
+        return dict(presort)
+    if isinstance(presort, str):
+        res: Dict[str, bool] = {}
+        for part in presort.split(","):
+            part = part.strip()
+            if part == "":
+                continue
+            m = re.match(r"^([^\s]+|`[^`]+`)(\s+(asc|desc))?$", part, re.IGNORECASE)
+            assert_or_throw(m is not None, SyntaxError(f"invalid presort {part!r}"))
+            name = m.group(1).strip("`")
+            asc = m.group(3) is None or m.group(3).lower() == "asc"
+            assert_or_throw(name not in res, SyntaxError(f"duplicated presort key {name}"))
+            res[name] = asc
+        return res
+    if isinstance(presort, Iterable):
+        res = {}
+        for item in presort:
+            if isinstance(item, str):
+                res[item] = True
+            else:
+                res[item[0]] = bool(item[1])
+        return res
+    raise SyntaxError(f"invalid presort {presort!r}")
+
+
+class PartitionSpec:
+    """Partition specification; immutable once constructed.
+
+    Examples::
+
+        PartitionSpec(num=4)
+        PartitionSpec(by=["a"], presort="b desc")
+        PartitionSpec("per_row")
+        PartitionSpec(algo="even", num="ROWCOUNT/4")
+    """
+
+    def __init__(self, *args: Any, **kwargs: Any):
+        self._algo = ""
+        self._num_partitions = "0"
+        self._partition_by: List[str] = []
+        self._presort: Dict[str, bool] = {}
+        for a in args:
+            self._update(a)
+        if kwargs:
+            self._update(kwargs)
+
+    def _update(self, obj: Any) -> None:
+        if obj is None:
+            return
+        if isinstance(obj, PartitionSpec):
+            self._algo = obj._algo or self._algo
+            if obj._num_partitions != "0":
+                self._num_partitions = obj._num_partitions
+            if obj._partition_by:
+                self._partition_by = list(obj._partition_by)
+            if obj._presort:
+                self._presort = dict(obj._presort)
+            return
+        if isinstance(obj, int):
+            self._num_partitions = str(obj)
+            return
+        if isinstance(obj, str):
+            s = obj.strip()
+            if s == "":
+                return
+            if s == "per_row":
+                self._update(dict(algo="even", num=KEYWORD_ROWCOUNT))
+                return
+            if s.lower() in _ALGOS:
+                self._algo = s.lower()
+                return
+            if s.startswith("{"):
+                self._update(json.loads(s))
+                return
+            # a number or a num expression
+            if _NUM_EXPR_RE.match(s) or KEYWORD_ROWCOUNT in s or KEYWORD_CONCURRENCY in s:
+                self._num_partitions = s
+                return
+            raise SyntaxError(f"can't interpret partition spec {obj!r}")
+        if isinstance(obj, dict):
+            for k, v in obj.items():
+                if k == "algo":
+                    v = str(v).lower()
+                    assert_or_throw(v in _ALGOS, ValueError(f"invalid algo {v}"))
+                    self._algo = "" if v == "default" else v
+                elif k in ("num", "num_partitions"):
+                    self._num_partitions = str(v)
+                elif k in ("by", "partition_by"):
+                    if isinstance(v, str):
+                        v = [v]
+                    v = list(v)
+                    assert_or_throw(
+                        len(set(v)) == len(v), SyntaxError(f"duplicated keys in {v}")
+                    )
+                    self._partition_by = v
+                elif k == "presort":
+                    self._presort = parse_presort_exp(v)
+                else:
+                    raise SyntaxError(f"unknown partition spec key {k}")
+            return
+        if isinstance(obj, (list, tuple)):
+            self._update(dict(by=list(obj)))
+            return
+        raise SyntaxError(f"can't interpret partition spec {obj!r}")
+
+    # ---- properties ------------------------------------------------------
+    @property
+    def empty(self) -> bool:
+        return (
+            self._algo == ""
+            and self._num_partitions == "0"
+            and len(self._partition_by) == 0
+            and len(self._presort) == 0
+        )
+
+    @property
+    def algo(self) -> str:
+        return self._algo
+
+    @property
+    def num_partitions(self) -> str:
+        return self._num_partitions
+
+    @property
+    def partition_by(self) -> List[str]:
+        return list(self._partition_by)
+
+    @property
+    def presort(self) -> Dict[str, bool]:
+        return dict(self._presort)
+
+    @property
+    def presort_expr(self) -> str:
+        return ",".join(
+            f"{k} {'ASC' if v else 'DESC'}" for k, v in self._presort.items()
+        )
+
+    def get_num_partitions(self, **expr_map_funcs: Callable[[], Any]) -> int:
+        """Evaluate the ``num`` expression; keyword callables (ROWCOUNT,
+        CONCURRENCY) are invoked only when referenced."""
+        expr = self._num_partitions
+        env: Dict[str, Any] = {"__builtins__": {}, "min": min, "max": max}
+        for k, f in expr_map_funcs.items():
+            if k in expr:
+                env[k] = int(f())
+        stripped = expr
+        for k in env:
+            stripped = stripped.replace(k, "")
+        assert_or_throw(
+            _NUM_EXPR_RE.match(stripped.replace(",", "")) is not None,
+            ValueError(f"invalid num expression {expr!r}"),
+        )
+        try:
+            return int(eval(expr, env))  # noqa: S307 - validated charset
+        except Exception as e:
+            raise ValueError(f"can't evaluate num expression {expr!r}") from e
+
+    def get_sorts(
+        self, schema: Schema, with_partition_keys: bool = True
+    ) -> Dict[str, bool]:
+        """Full sort spec for a physical partition: partition keys first (asc),
+        then presort keys."""
+        res: Dict[str, bool] = {}
+        if with_partition_keys:
+            for k in self._partition_by:
+                assert_or_throw(k in schema, KeyError(f"{k} not in {schema}"))
+                res[k] = True
+        for k, v in self._presort.items():
+            assert_or_throw(k in schema, KeyError(f"{k} not in {schema}"))
+            res[k] = v
+        return res
+
+    def get_key_schema(self, schema: Schema) -> Schema:
+        return schema.extract(self._partition_by)
+
+    def get_cursor(
+        self, schema: Schema, physical_partition_no: int
+    ) -> "PartitionCursor":
+        return PartitionCursor(schema, self, physical_partition_no)
+
+    # ---- identity --------------------------------------------------------
+    @property
+    def jsondict(self) -> Dict[str, Any]:
+        return dict(
+            algo=self._algo,
+            num_partitions=self._num_partitions,
+            partition_by=list(self._partition_by),
+            presort=self.presort_expr,
+        )
+
+    def __uuid__(self) -> str:
+        return to_uuid(self.jsondict)
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, PartitionSpec) and self.jsondict == other.jsondict
+
+    def __hash__(self) -> int:
+        return hash(self.__uuid__())
+
+    def __repr__(self) -> str:
+        return f"PartitionSpec({json.dumps(self.jsondict)})"
+
+
+class DatasetPartitionCursor:
+    """Tracks position while scanning physical partitions of any dataset
+    (reference partition.py:336)."""
+
+    def __init__(self, physical_no: int):
+        self._physical_no = physical_no
+        self._item: Any = None
+        self._partition_no = 0
+        self._slice_no = 0
+
+    def set(self, item: Any, partition_no: int, slice_no: int) -> None:
+        self._item = item
+        self._partition_no = partition_no
+        self._slice_no = slice_no
+
+    @property
+    def item(self) -> Any:
+        if callable(self._item):
+            self._item = self._item()
+        return self._item
+
+    @property
+    def partition_no(self) -> int:
+        return self._partition_no
+
+    @property
+    def physical_partition_no(self) -> int:
+        return self._physical_no
+
+    @property
+    def slice_no(self) -> int:
+        return self._slice_no
+
+
+class PartitionCursor(DatasetPartitionCursor):
+    """Row-aware cursor: inside a logical partition it exposes the key values
+    of the current partition (reference partition.py:404)."""
+
+    def __init__(self, schema: Schema, spec: PartitionSpec, physical_no: int):
+        super().__init__(physical_no)
+        self._schema = schema
+        self._spec = spec
+        self._key_index = [
+            schema.index_of_key(k) for k in spec.partition_by
+        ]
+
+    def set(self, row: Any, partition_no: int, slice_no: int) -> None:
+        super().set(row, partition_no, slice_no)
+
+    @property
+    def row(self) -> List[Any]:
+        return self.item
+
+    @property
+    def row_schema(self) -> Schema:
+        return self._schema
+
+    @property
+    def key_schema(self) -> Schema:
+        return self._spec.get_key_schema(self._schema)
+
+    @property
+    def key_value_array(self) -> List[Any]:
+        row = self.row
+        return [row[i] for i in self._key_index]
+
+    @property
+    def key_value_dict(self) -> Dict[str, Any]:
+        return dict(zip(self._spec.partition_by, self.key_value_array))

@@ -1,0 +1,93 @@
+"""Dialect-tagged SQL fragments with dataframe-name placeholders
+(reference fugue/collections/sql.py:14,48)."""
+
+import re
+from typing import Any, Callable, Iterable, List, Optional, Tuple
+from uuid import uuid4
+
+from fugue_tpu.plugins import fugue_plugin
+from fugue_tpu.utils.assertion import assert_or_throw
+
+
+class TempTableName:
+    """A unique placeholder name for a dataframe inside a raw SQL string."""
+
+    _PREFIX = "_fugue_tpu_tmp_"
+
+    def __init__(self):
+        self.key = self._PREFIX + str(uuid4())[:8]
+
+    def __repr__(self) -> str:
+        return "<tmpdf:" + self.key + ">"
+
+    @staticmethod
+    def pattern() -> "re.Pattern":
+        return re.compile(r"<tmpdf:(" + TempTableName._PREFIX + r"[0-9a-f]{8})>")
+
+
+@fugue_plugin
+def transpile_sql(raw: str, from_dialect: Optional[str], to_dialect: Optional[str]) -> str:
+    """Transpile a SQL statement between dialects. Default: identity (no
+    sqlglot in this environment); engines may register real transpilers."""
+    return raw
+
+
+class StructuredRawSQL:
+    """A sequence of ``(is_dataframe, text)`` parts; dataframe parts refer to
+    dataframes by name and are re-encoded per engine at construct time."""
+
+    def __init__(
+        self, statements: Iterable[Tuple[bool, str]], dialect: Optional[str] = None
+    ):
+        self._statements = list(statements)
+        self._dialect = dialect
+
+    @property
+    def dialect(self) -> Optional[str]:
+        return self._dialect
+
+    def construct(
+        self,
+        name_map: Any = None,
+        dialect: Optional[str] = None,
+        log: Any = None,
+    ) -> str:
+        """Render the SQL string, mapping dataframe names through ``name_map``
+        (a dict or callable), transpiling when dialects differ."""
+        if name_map is None:
+            _map: Callable[[str], str] = lambda x: x
+        elif isinstance(name_map, dict):
+            _map = lambda x: name_map.get(x, x)  # noqa: E731
+        else:
+            _map = name_map
+        sql = "".join(
+            _map(text) if is_df else text for is_df, text in self._statements
+        )
+        if dialect is not None and self._dialect is not None and dialect != self._dialect:
+            transpiled = transpile_sql(sql, self._dialect, dialect)
+            if log is not None and transpiled != sql:
+                log.debug("transpiled %s to %s", sql, transpiled)
+            return transpiled
+        return sql
+
+    @staticmethod
+    def from_expr(
+        sql: str, prefix: str = "<tmpdf:", suffix: str = ">", dialect: Optional[str] = None
+    ) -> "StructuredRawSQL":
+        """Parse a raw string where dataframe references appear as
+        ``<tmpdf:name>`` markers."""
+        statements: List[Tuple[bool, str]] = []
+        pos = 0
+        while True:
+            start = sql.find(prefix, pos)
+            if start < 0:
+                if pos < len(sql):
+                    statements.append((False, sql[pos:]))
+                break
+            end = sql.find(suffix, start)
+            assert_or_throw(end > 0, ValueError(f"unclosed placeholder in {sql}"))
+            if start > pos:
+                statements.append((False, sql[pos:start]))
+            statements.append((True, sql[start + len(prefix) : end]))
+            pos = end + len(suffix)
+        return StructuredRawSQL(statements, dialect)

@@ -1,0 +1,20 @@
+from fugue_tpu.dataframe.array_dataframe import ArrayDataFrame
+from fugue_tpu.dataframe.arrow_dataframe import ArrowDataFrame
+from fugue_tpu.dataframe.dataframe import (
+    DataFrame,
+    LocalBoundedDataFrame,
+    LocalDataFrame,
+    LocalUnboundedDataFrame,
+    YieldedDataFrame,
+    as_fugue_df,
+)
+from fugue_tpu.dataframe.dataframe_iterable_dataframe import (
+    IterableArrowDataFrame,
+    IterablePandasDataFrame,
+    LocalDataFrameIterableDataFrame,
+)
+from fugue_tpu.dataframe.dataframes import DataFrames
+from fugue_tpu.dataframe.iterable_dataframe import IterableDataFrame
+from fugue_tpu.dataframe.pandas_dataframe import PandasDataFrame
+from fugue_tpu.dataframe.utils import df_eq, deserialize_df, get_join_schemas, serialize_df
+import fugue_tpu.dataframe.api  # noqa: F401  (registers builtin candidates)

@@ -1,0 +1,150 @@
+"""Functional dataframe API: plugin dispatchers that work on ANY supported
+dataframe-ish object (fugue_tpu DataFrames, pandas, arrow, row lists, and —
+once registered — jax block frames). Parity: reference fugue/dataframe/api.py."""
+
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import pandas as pd
+import pyarrow as pa
+
+from fugue_tpu.dataset.api import (  # noqa: F401  (re-exported)
+    as_fugue_dataset,
+    count,
+    is_bounded,
+    is_empty,
+    is_local,
+    show,
+)
+from fugue_tpu.dataframe.array_dataframe import ArrayDataFrame
+from fugue_tpu.dataframe.arrow_dataframe import ArrowDataFrame
+from fugue_tpu.dataframe.dataframe import (
+    DataFrame,
+    LocalBoundedDataFrame,
+    as_fugue_df,
+)
+from fugue_tpu.dataframe.pandas_dataframe import PandasDataFrame
+from fugue_tpu.plugins import fugue_plugin
+from fugue_tpu.schema import Schema
+
+
+@fugue_plugin
+def is_df(df: Any) -> bool:
+    """Whether the object is recognized as a dataframe by any plugin."""
+    return isinstance(df, (DataFrame, pd.DataFrame, pa.Table))
+
+
+@as_fugue_df.candidate(lambda df, **kw: isinstance(df, pd.DataFrame))
+def _pd_as_fugue_df(df: pd.DataFrame, schema: Any = None, **kwargs: Any) -> DataFrame:
+    return PandasDataFrame(df, schema=schema)
+
+
+@as_fugue_df.candidate(lambda df, **kw: isinstance(df, pa.Table))
+def _pa_as_fugue_df(df: pa.Table, schema: Any = None, **kwargs: Any) -> DataFrame:
+    return ArrowDataFrame(df, schema=schema)
+
+
+@as_fugue_df.candidate(
+    lambda df, **kw: isinstance(df, (list, tuple)) and "schema" in kw
+)
+def _rows_as_fugue_df(df: Any, schema: Any = None, **kwargs: Any) -> DataFrame:
+    return ArrayDataFrame(df, schema=schema)
+
+
+@fugue_plugin
+def get_native_as_df(df: Any) -> Any:
+    """Return the backend-native dataframe object."""
+    if isinstance(df, DataFrame):
+        return df.native
+    if isinstance(df, (pd.DataFrame, pa.Table)):
+        return df
+    raise NotImplementedError(f"no native conversion for {type(df)}")
+
+
+def get_schema(df: Any) -> Schema:
+    return as_fugue_df(df).schema
+
+def get_column_names(df: Any) -> List[Any]:
+    return get_schema(df).names
+
+
+def rename(df: Any, columns: Dict[str, Any], as_fugue: bool = False) -> Any:
+    if len(columns) == 0:
+        return df
+    return _adjust(as_fugue_df(df).rename(columns), df, as_fugue)
+
+
+def drop_columns(df: Any, columns: List[str], as_fugue: bool = False) -> Any:
+    return _adjust(as_fugue_df(df).drop(columns), df, as_fugue)
+
+
+def select_columns(df: Any, columns: List[Any], as_fugue: bool = False) -> Any:
+    return _adjust(as_fugue_df(df)[columns], df, as_fugue)
+
+
+def alter_columns(df: Any, columns: Any, as_fugue: bool = False) -> Any:
+    return _adjust(as_fugue_df(df).alter_columns(columns), df, as_fugue)
+
+
+def head(
+    df: Any, n: int, columns: Optional[List[str]] = None, as_fugue: bool = False
+) -> Any:
+    return _adjust(as_fugue_df(df).head(n, columns), df, as_fugue)
+
+
+def peek_array(df: Any) -> List[Any]:
+    return as_fugue_df(df).peek_array()
+
+
+def peek_dict(df: Any) -> Dict[str, Any]:
+    return as_fugue_df(df).peek_dict()
+
+
+def as_array(
+    df: Any, columns: Optional[List[str]] = None, type_safe: bool = False
+) -> List[Any]:
+    return as_fugue_df(df).as_array(columns, type_safe)
+
+
+def as_array_iterable(
+    df: Any, columns: Optional[List[str]] = None, type_safe: bool = False
+) -> Iterable[Any]:
+    return as_fugue_df(df).as_array_iterable(columns, type_safe)
+
+
+def as_dict_iterable(df: Any, columns: Optional[List[str]] = None) -> Iterable[Dict]:
+    return as_fugue_df(df).as_dict_iterable(columns)
+
+
+def as_pandas(df: Any) -> pd.DataFrame:
+    if isinstance(df, pd.DataFrame):
+        return df
+    return as_fugue_df(df).as_pandas()
+
+
+def as_arrow(df: Any) -> pa.Table:
+    if isinstance(df, pa.Table):
+        return df
+    return as_fugue_df(df).as_arrow()
+
+
+def normalize_dataframes(dfs: Any) -> Any:
+    from fugue_tpu.dataframe.dataframes import DataFrames
+
+    if isinstance(dfs, DataFrames):
+        return dfs
+    if isinstance(dfs, dict):
+        return DataFrames({k: as_fugue_df(v) for k, v in dfs.items()})
+    if isinstance(dfs, (list, tuple)):
+        return DataFrames([as_fugue_df(v) for v in dfs])
+    return DataFrames(as_fugue_df(dfs))
+
+
+def _adjust(result: DataFrame, original: Any, as_fugue: bool) -> Any:
+    """Return fugue_tpu DataFrame or downgrade to the original's native type."""
+    if as_fugue or isinstance(original, DataFrame):
+        return result
+    if isinstance(original, pd.DataFrame):
+        return result.as_pandas()
+    if isinstance(original, pa.Table):
+        return result.as_arrow()
+    return result

@@ -1,0 +1,94 @@
+"""Row-major in-memory dataframe (reference array_dataframe.py:14)."""
+
+from typing import Any, Dict, Iterable, List, Optional
+
+from fugue_tpu.dataframe.arrow_utils import cast_table, rows_to_table, table_to_rows
+from fugue_tpu.dataframe.dataframe import DataFrame, LocalBoundedDataFrame
+from fugue_tpu.schema import Schema
+from fugue_tpu.utils.assertion import assert_or_throw
+
+
+class ArrayDataFrame(LocalBoundedDataFrame):
+    """DataFrame on a list of rows (each row a list). The cheapest frame to
+    build; conversions are type-unsafe unless requested."""
+
+    def __init__(self, df: Any = None, schema: Any = None):
+        if df is None:
+            super().__init__(schema)
+            self._native: List[Any] = []
+        elif isinstance(df, DataFrame):
+            super().__init__(schema if schema is not None else df.schema)
+            if schema is None:
+                self._native = df.as_array(type_safe=False)
+            else:
+                self._native = df.as_array(self.schema.names, type_safe=False)
+        elif isinstance(df, Iterable):
+            super().__init__(schema)
+            self._native = [list(r) for r in df]
+        else:
+            raise ValueError(f"can't initialize ArrayDataFrame with {type(df)}")
+
+    @property
+    def native(self) -> List[Any]:
+        return self._native
+
+    @property
+    def empty(self) -> bool:
+        return len(self._native) == 0
+
+    def count(self) -> int:
+        return len(self._native)
+
+    def peek_array(self) -> List[Any]:
+        self.assert_not_empty()
+        return list(self._native[0])
+
+    def _drop_cols(self, cols: List[str]) -> DataFrame:
+        schema = self.schema.exclude(cols)
+        return self._select_by_schema(schema)
+
+    def _select_cols(self, cols: List[Any]) -> DataFrame:
+        schema = self.schema.extract(cols)
+        return self._select_by_schema(schema)
+
+    def _select_by_schema(self, schema: Schema) -> "ArrayDataFrame":
+        idx = [self.schema.index_of_key(n) for n in schema.names]
+        return ArrayDataFrame([[row[i] for i in idx] for row in self._native], schema)
+
+    def rename(self, columns: Dict[str, str]) -> DataFrame:
+        return ArrayDataFrame(self._native, self._rename_schema(columns))
+
+    def alter_columns(self, columns: Any) -> DataFrame:
+        new_schema = self._alter_schema(columns)
+        if new_schema == self.schema:
+            return self
+        table = cast_table(rows_to_table(self._native, self.schema), new_schema)
+        return ArrayDataFrame(list(table_to_rows(table)), new_schema)
+
+    def as_array(
+        self, columns: Optional[List[str]] = None, type_safe: bool = False
+    ) -> List[Any]:
+        return list(self.as_array_iterable(columns, type_safe))
+
+    def as_array_iterable(
+        self, columns: Optional[List[str]] = None, type_safe: bool = False
+    ) -> Iterable[Any]:
+        if not type_safe:
+            if columns is None:
+                yield from self._native
+            else:
+                idx = [self.schema.index_of_key(n) for n in columns]
+                for row in self._native:
+                    yield [row[i] for i in idx]
+        else:
+            table = rows_to_table(self._native, self.schema)
+            yield from table_to_rows(table, columns)
+
+    def head(
+        self, n: int, columns: Optional[List[str]] = None
+    ) -> LocalBoundedDataFrame:
+        assert_or_throw(n >= 0, ValueError("n must be >= 0"))
+        schema = self.schema if columns is None else self.schema.extract(columns)
+        return ArrayDataFrame(
+            list(self.as_array_iterable(columns, type_safe=False))[:n], schema
+        )

@@ -1,0 +1,128 @@
+"""Arrow-table-backed dataframe (reference arrow_dataframe.py:35) — the
+canonical host-boundary format; the JAX backend materializes device blocks
+from these tables."""
+
+from typing import Any, Dict, Iterable, List, Optional
+
+import pandas as pd
+import pyarrow as pa
+
+from fugue_tpu.dataframe.arrow_utils import (
+    cast_table,
+    pandas_to_table,
+    rows_to_table,
+    table_to_pandas,
+    table_to_rows,
+)
+from fugue_tpu.dataframe.dataframe import DataFrame, LocalBoundedDataFrame
+from fugue_tpu.schema import Schema
+from fugue_tpu.utils.assertion import assert_or_throw
+
+
+class ArrowDataFrame(LocalBoundedDataFrame):
+    def __init__(self, df: Any = None, schema: Any = None):
+        if df is None:
+            super().__init__(schema)
+            self._native = self.schema.create_empty_arrow()
+        elif isinstance(df, pa.Table):
+            if schema is None:
+                schema = Schema(df.schema)
+                super().__init__(schema)
+                if df.schema != schema.pa_schema:
+                    df = df.cast(schema.pa_schema)
+                self._native = df
+            else:
+                schema = Schema(schema)
+                assert_or_throw(
+                    set(schema.names) == set(df.schema.names),
+                    ValueError(f"schema {schema} doesn't match table columns"),
+                )
+                df = df.select(schema.names)
+                super().__init__(schema)
+                self._native = (
+                    df if df.schema == schema.pa_schema else cast_table(df, schema)
+                )
+        elif isinstance(df, pd.DataFrame):
+            schema = None if schema is None else Schema(schema)
+            table = pandas_to_table(df, schema)
+            super().__init__(Schema(table.schema) if schema is None else schema)
+            self._native = table
+        elif isinstance(df, DataFrame):
+            if schema is None:
+                super().__init__(df.schema)
+                self._native = df.as_arrow(type_safe=True)
+            else:
+                schema = Schema(schema)
+                assert_or_throw(
+                    set(schema.names) == set(df.schema.names),
+                    ValueError(f"schema {schema} doesn't match {df.schema}"),
+                )
+                super().__init__(schema)
+                table = df[schema.names].as_arrow(type_safe=True)
+                self._native = (
+                    table
+                    if table.schema == schema.pa_schema
+                    else cast_table(table, schema)
+                )
+        elif isinstance(df, Iterable):
+            super().__init__(schema)
+            self._native = rows_to_table(df, self.schema)
+        else:
+            raise ValueError(f"can't initialize ArrowDataFrame with {type(df)}")
+
+    @property
+    def native(self) -> pa.Table:
+        return self._native
+
+    @property
+    def empty(self) -> bool:
+        return self._native.num_rows == 0
+
+    def count(self) -> int:
+        return self._native.num_rows
+
+    def peek_array(self) -> List[Any]:
+        self.assert_not_empty()
+        return next(iter(table_to_rows(self._native.slice(0, 1))))
+
+    def _drop_cols(self, cols: List[str]) -> DataFrame:
+        schema = self.schema.exclude(cols)
+        return ArrowDataFrame(self._native.select(schema.names), schema)
+
+    def _select_cols(self, cols: List[Any]) -> DataFrame:
+        schema = self.schema.extract(cols)
+        return ArrowDataFrame(self._native.select(schema.names), schema)
+
+    def rename(self, columns: Dict[str, str]) -> DataFrame:
+        schema = self._rename_schema(columns)
+        return ArrowDataFrame(self._native.rename_columns(schema.names), schema)
+
+    def alter_columns(self, columns: Any) -> DataFrame:
+        new_schema = self._alter_schema(columns)
+        if new_schema == self.schema:
+            return self
+        return ArrowDataFrame(cast_table(self._native, new_schema), new_schema)
+
+    def as_arrow(self, type_safe: bool = False) -> pa.Table:
+        return self._native
+
+    def as_pandas(self) -> pd.DataFrame:
+        return table_to_pandas(self._native)
+
+    def as_array(
+        self, columns: Optional[List[str]] = None, type_safe: bool = False
+    ) -> List[Any]:
+        return list(table_to_rows(self._native, columns))
+
+    def as_array_iterable(
+        self, columns: Optional[List[str]] = None, type_safe: bool = False
+    ) -> Iterable[Any]:
+        yield from table_to_rows(self._native, columns)
+
+    def head(
+        self, n: int, columns: Optional[List[str]] = None
+    ) -> LocalBoundedDataFrame:
+        assert_or_throw(n >= 0, ValueError("n must be >= 0"))
+        table = self._native if columns is None else self._native.select(columns)
+        schema = self.schema if columns is None else self.schema.extract(columns)
+        return ArrowDataFrame(table.slice(0, n), schema)

@@ -1,0 +1,177 @@
+"""Host-boundary columnar conversions (pyarrow <-> rows <-> pandas).
+
+All dataframe implementations funnel their type-safe conversions through this
+module so null/temporal/nested semantics are identical everywhere (the role
+pyarrow+triad conversions play in the reference data layer, §2.1 of SURVEY).
+Convention: ``as_array`` produces python-native values (datetime, date,
+Decimal, bytes, dict for maps, dict for structs, list for lists); ``None`` is
+the universal null (NaN/NaT normalize to None on the way in).
+"""
+
+from typing import Any, Iterable, Iterator, List, Optional
+
+import pandas as pd
+import pyarrow as pa
+
+from fugue_tpu.schema import Schema
+from fugue_tpu.utils.assertion import assert_or_throw
+
+
+def _normalize_cell(value: Any, tp: pa.DataType) -> Any:
+    if value is None:
+        return None
+    if pa.types.is_map(tp):
+        # pyarrow yields list of (k, v) tuples; we expose dicts
+        if isinstance(value, list):
+            return dict(value)
+        return value
+    if pa.types.is_list(tp) or pa.types.is_large_list(tp):
+        return [_normalize_cell(v, tp.value_type) for v in value]
+    if pa.types.is_struct(tp):
+        return {
+            f.name: _normalize_cell(value.get(f.name), f.type) for f in tp
+        }
+    if pa.types.is_timestamp(tp) and isinstance(value, pd.Timestamp):
+        return value.to_pydatetime()
+    return value
+
+
+def _needs_normalize(tp: pa.DataType) -> bool:
+    return (
+        pa.types.is_map(tp)
+        or pa.types.is_list(tp)
+        or pa.types.is_large_list(tp)
+        or pa.types.is_struct(tp)
+        or pa.types.is_timestamp(tp)
+    )
+
+
+def table_to_rows(
+    table: pa.Table, columns: Optional[List[str]] = None
+) -> Iterator[List[Any]]:
+    """Yield rows (as lists of python-native values) from an arrow table."""
+    if columns is not None:
+        table = table.select(columns)
+    cols = [c.to_pylist() for c in table.columns]
+    norm = [
+        (_normalize_cell if _needs_normalize(f.type) else None, f.type)
+        for f in table.schema
+    ]
+    for row in zip(*cols) if cols else iter([]):
+        yield [
+            fn(v, tp) if fn is not None else v
+            for v, (fn, tp) in zip(row, norm)
+        ]
+
+
+def _prep_map_values(values: Iterable[Any], tp: pa.DataType) -> List[Any]:
+    out = []
+    for v in values:
+        if isinstance(v, dict):
+            v = list(v.items())
+        out.append(v)
+    return out
+
+
+def rows_to_table(rows: Iterable[Any], schema: Schema) -> pa.Table:
+    """Build an arrow table from row-major data (lists/tuples/dicts)."""
+    cols: List[List[Any]] = [[] for _ in range(len(schema))]
+    names = schema.names
+    for row in rows:
+        if isinstance(row, dict):
+            for i, n in enumerate(names):
+                cols[i].append(row.get(n))
+        else:
+            assert_or_throw(
+                len(row) == len(names),
+                ValueError(f"row width {len(row)} != schema width {len(names)}"),
+            )
+            for i, v in enumerate(row):
+                cols[i].append(v)
+    return cols_to_table(cols, schema)
+
+
+def cols_to_table(cols: List[List[Any]], schema: Schema) -> pa.Table:
+    arrays = []
+    for values, field in zip(cols, schema.fields):
+        if pa.types.is_map(field.type):
+            values = _prep_map_values(values, field.type)
+        try:
+            arrays.append(pa.array(values, type=field.type, from_pandas=True))
+        except (pa.ArrowTypeError, pa.ArrowInvalid):
+            # e.g. ISO strings into date/timestamp columns: infer then cast
+            inferred = pa.array(values, from_pandas=True)
+            arrays.append(inferred.cast(field.type, safe=False))
+    return pa.Table.from_arrays(arrays, schema=schema.pa_schema)
+
+
+def pandas_to_table(df: pd.DataFrame, schema: Optional[Schema] = None) -> pa.Table:
+    if schema is None:
+        table = pa.Table.from_pandas(
+            df, preserve_index=False, safe=False
+        )
+        # normalize large_string etc through Schema
+        target = Schema(table.schema)
+        if pa.schema(target.fields) != table.schema:
+            table = table.cast(target.pa_schema)
+        return table
+    return pa.Table.from_pandas(
+        df, schema=schema.pa_schema, preserve_index=False, safe=False
+    )
+
+
+def table_to_pandas(table: pa.Table) -> pd.DataFrame:
+    return table.to_pandas(
+        ignore_metadata=True,
+        types_mapper=None,
+        date_as_object=False,
+    )
+
+
+def normalize_dataframe_schema(df: pd.DataFrame) -> Schema:
+    """Infer a Schema from a pandas dataframe; empty object columns become str."""
+    fields = []
+    for name in df.columns:
+        assert_or_throw(isinstance(name, str), ValueError(f"column name {name!r} must be str"))
+        s = df[name]
+        if s.dtype == object and (len(s) == 0 or s.isna().all()):
+            fields.append(pa.field(name, pa.string()))
+        else:
+            fields.append(pa.field(name, pa.Array.from_pandas(s).type))
+    return Schema(fields)
+
+
+def cast_table(table: pa.Table, schema: Schema) -> pa.Table:
+    """Cast a table to a new schema (same width; names taken from ``schema``)."""
+    assert_or_throw(
+        table.num_columns == len(schema),
+        ValueError("column count mismatch in cast"),
+    )
+    arrays = []
+    for col, field in zip(table.columns, schema.fields):
+        combined = col.combine_chunks() if col.num_chunks != 1 else col.chunk(0)
+        if combined.type == field.type:
+            arrays.append(combined)
+        elif pa.types.is_string(field.type) and pa.types.is_timestamp(combined.type):
+            # arrow's native timestamp->string keeps " " separator; fine
+            arrays.append(combined.cast(field.type))
+        elif pa.types.is_string(field.type) and pa.types.is_boolean(combined.type):
+            # match python str(bool) casing: True/False
+            vals = [None if v is None else str(v) for v in combined.to_pylist()]
+            arrays.append(pa.array(vals, type=pa.string()))
+        elif pa.types.is_boolean(field.type) and pa.types.is_string(combined.type):
+            def _to_b(v: Any) -> Any:
+                if v is None:
+                    return None
+                lv = v.strip().lower()
+                if lv in ("true", "1"):
+                    return True
+                if lv in ("false", "0"):
+                    return False
+                raise ValueError(f"can't cast {v!r} to bool")
+            arrays.append(
+                pa.array([_to_b(v) for v in combined.to_pylist()], type=pa.bool_())
+            )
+        else:
+            arrays.append(combined.cast(field.type, safe=False))
+    return pa.Table.from_arrays(arrays, schema=schema.pa_schema)

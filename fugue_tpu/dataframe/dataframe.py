@@ -1,0 +1,258 @@
+"""DataFrame abstraction: schema-carrying, conversion-rich dataframes.
+
+Parity target: reference ``fugue/dataframe/dataframe.py:29`` (DataFrame,
+LocalDataFrame, LocalBoundedDataFrame, YieldedDataFrame) — rebuilt from
+scratch with lazy schema resolution and arrow-funnelled conversions.
+"""
+
+from abc import abstractmethod
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Union
+
+import pandas as pd
+import pyarrow as pa
+
+from fugue_tpu.collections.yielded import Yielded
+from fugue_tpu.dataset.dataset import Dataset, DatasetDisplay, get_dataset_display
+from fugue_tpu.plugins import fugue_plugin
+from fugue_tpu.schema import Schema
+from fugue_tpu.utils.assertion import assert_or_throw
+from fugue_tpu.utils.display import build_show_text
+from fugue_tpu.utils.lock import SerializableRLock
+
+
+class DataFrame(Dataset):
+    """Abstract schema-carrying dataframe. ``schema`` may be provided lazily
+    as a callable — resolution is locked and happens at most once (mirrors the
+    lazy-schema design at reference dataframe.py:52, needed so expensive
+    backends don't compute schemas for frames that are never inspected)."""
+
+    def __init__(self, schema: Any = None):
+        super().__init__()
+        if callable(schema):
+            self._schema: Union[Schema, Callable[[], Any]] = schema
+            self._schema_discovered = False
+        else:
+            self._schema = Schema(schema)
+            self._schema.assert_not_empty()
+            self._schema_discovered = True
+        self._lazy_schema_lock = SerializableRLock()
+
+    @property
+    def schema(self) -> Schema:
+        if self._schema_discovered:
+            return self._schema  # type: ignore
+        with self._lazy_schema_lock:
+            if not self._schema_discovered:
+                schema = self._schema()  # type: ignore
+                self._schema = schema if isinstance(schema, Schema) else Schema(schema)
+                self._schema.assert_not_empty()
+                self._schema_discovered = True
+        return self._schema  # type: ignore
+
+    @property
+    def schema_discovered(self) -> bool:
+        return self._schema_discovered
+
+    @property
+    def columns(self) -> List[str]:
+        return self.schema.names
+
+    # ---- abstract interface ---------------------------------------------
+    @abstractmethod
+    def peek_array(self) -> List[Any]:  # pragma: no cover - interface
+        """First row as a list; raises when empty."""
+        raise NotImplementedError
+
+    @abstractmethod
+    def as_local_bounded(self) -> "LocalBoundedDataFrame":  # pragma: no cover
+        raise NotImplementedError
+
+    @abstractmethod
+    def _drop_cols(self, cols: List[str]) -> "DataFrame":  # pragma: no cover
+        raise NotImplementedError
+
+    @abstractmethod
+    def rename(self, columns: Dict[str, str]) -> "DataFrame":  # pragma: no cover
+        raise NotImplementedError
+
+    @abstractmethod
+    def alter_columns(self, columns: Any) -> "DataFrame":  # pragma: no cover
+        """Cast a subset of columns to new types (no reorder/drop)."""
+        raise NotImplementedError
+
+    @abstractmethod
+    def as_array(
+        self, columns: Optional[List[str]] = None, type_safe: bool = False
+    ) -> List[Any]:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    @abstractmethod
+    def as_array_iterable(
+        self, columns: Optional[List[str]] = None, type_safe: bool = False
+    ) -> Iterable[Any]:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    @abstractmethod
+    def _select_cols(self, cols: List[Any]) -> "DataFrame":  # pragma: no cover
+        raise NotImplementedError
+
+    @abstractmethod
+    def head(
+        self, n: int, columns: Optional[List[str]] = None
+    ) -> "LocalBoundedDataFrame":  # pragma: no cover - interface
+        raise NotImplementedError
+
+    # ---- derived conversions --------------------------------------------
+    def peek_dict(self) -> Dict[str, Any]:
+        arr = self.peek_array()
+        return dict(zip(self.schema.names, arr))
+
+    def as_local(self) -> "LocalDataFrame":
+        return self.as_local_bounded()
+
+    def as_pandas(self) -> pd.DataFrame:
+        from fugue_tpu.dataframe.arrow_utils import table_to_pandas
+
+        return table_to_pandas(self.as_arrow())
+
+    def as_arrow(self, type_safe: bool = False) -> pa.Table:
+        from fugue_tpu.dataframe.arrow_utils import rows_to_table
+
+        return rows_to_table(self.as_array_iterable(type_safe=True), self.schema)
+
+    def as_dict_iterable(
+        self, columns: Optional[List[str]] = None
+    ) -> Iterable[Dict[str, Any]]:
+        names = self.schema.names if columns is None else columns
+        for row in self.as_array_iterable(columns, type_safe=True):
+            yield dict(zip(names, row))
+
+    def as_dicts(self, columns: Optional[List[str]] = None) -> List[Dict[str, Any]]:
+        return list(self.as_dict_iterable(columns))
+
+    def drop(self, columns: List[str]) -> "DataFrame":
+        schema = self.schema.exclude(columns)  # validates names
+        assert_or_throw(
+            len(schema) > 0, ValueError("can't drop all columns")
+        )
+        assert_or_throw(
+            len(set(columns)) == len(columns) and all(c in self.schema for c in columns),
+            ValueError(f"invalid columns to drop {columns}"),
+        )
+        return self._drop_cols(list(columns))
+
+    def __getitem__(self, columns: List[Any]) -> "DataFrame":
+        assert_or_throw(
+            isinstance(columns, list) and len(columns) > 0,
+            ValueError("columns must be a non-empty list"),
+        )
+        assert_or_throw(
+            all(c in self.schema for c in columns),
+            KeyError(f"{columns} not all in {self.schema}"),
+        )
+        return self._select_cols(columns)
+
+    def get_info_str(self) -> str:
+        return f"{type(self).__name__}({self.schema})"
+
+    def __repr__(self) -> str:
+        return self.get_info_str()
+
+    def _rename_schema(self, columns: Dict[str, str]) -> Schema:
+        return self.schema.rename(columns)
+
+    def _alter_schema(self, subschema: Any) -> Schema:
+        new_schema = self.schema.alter(subschema)
+        return new_schema
+
+
+class LocalDataFrame(DataFrame):
+    """A dataframe fully living in the driver process."""
+
+    @property
+    def is_local(self) -> bool:
+        return True
+
+    @property
+    def num_partitions(self) -> int:
+        return 1
+
+    def as_local_bounded(self) -> "LocalBoundedDataFrame":
+        if isinstance(self, LocalBoundedDataFrame):
+            return self
+        from fugue_tpu.dataframe.array_dataframe import ArrayDataFrame
+
+        res = ArrayDataFrame(list(self.as_array_iterable(type_safe=True)), self.schema)
+        if self.has_metadata:
+            res.reset_metadata(self.metadata)
+        return res
+
+
+class LocalBoundedDataFrame(LocalDataFrame):
+    @property
+    def is_bounded(self) -> bool:
+        return True
+
+
+class LocalUnboundedDataFrame(LocalDataFrame):
+    @property
+    def is_bounded(self) -> bool:
+        return False
+
+    def count(self) -> int:
+        raise ValueError("can't count an unbounded dataframe")
+
+
+class YieldedDataFrame(Yielded):
+    """Handle to a dataframe produced by another workflow run (reference
+    dataframe.py:366)."""
+
+    def __init__(self, yid: str):
+        super().__init__(yid)
+        self._df: Any = None
+
+    @property
+    def is_set(self) -> bool:
+        return self._df is not None
+
+    def set_value(self, df: DataFrame) -> None:
+        self._df = df
+
+    @property
+    def result(self) -> DataFrame:
+        assert_or_throw(self.is_set, ValueError("value is not set"))
+        return self._df
+
+
+class _DataFrameDisplay(DatasetDisplay):
+    def show(
+        self, n: int = 10, with_count: bool = False, title: Optional[str] = None
+    ) -> None:
+        df: DataFrame = self._ds  # type: ignore
+        # fetch one extra row so "exactly n rows" isn't reported as truncated
+        head_rows = df.head(n + 1).as_array(type_safe=True)
+        print(
+            build_show_text(
+                head_rows[:n],
+                df.schema,
+                title=title or df.get_info_str(),
+                count=df.count() if with_count and df.is_bounded else None,
+                truncated=len(head_rows) > n,
+            )
+        )
+
+
+@get_dataset_display.candidate(
+    lambda ds: isinstance(ds, DataFrame), priority=0.5
+)
+def _get_dataframe_display(ds: DataFrame) -> DatasetDisplay:
+    return _DataFrameDisplay(ds)
+
+
+@fugue_plugin
+def as_fugue_df(df: Any, **kwargs: Any) -> DataFrame:
+    """Convert any supported object (pandas/arrow/list/DataFrame/...) into a
+    fugue_tpu DataFrame; backends register candidates for their own types."""
+    if isinstance(df, DataFrame):
+        return df
+    raise NotImplementedError(f"no conversion from {type(df)} to DataFrame")

@@ -1,0 +1,188 @@
+"""Streams of local dataframes — process a partition as a sequence of chunks
+without materializing the whole partition (reference
+dataframe_iterable_dataframe.py:21; this is also the TPU long-partition
+answer: blocks-per-shard streaming when a partition exceeds HBM)."""
+
+from typing import Any, Dict, Iterable, Iterator, List, Optional
+
+import pandas as pd
+import pyarrow as pa
+
+from fugue_tpu.dataframe.arrow_dataframe import ArrowDataFrame
+from fugue_tpu.dataframe.array_dataframe import ArrayDataFrame
+from fugue_tpu.dataframe.dataframe import (
+    DataFrame,
+    LocalBoundedDataFrame,
+    LocalDataFrame,
+    LocalUnboundedDataFrame,
+)
+from fugue_tpu.dataframe.pandas_dataframe import PandasDataFrame
+from fugue_tpu.utils.assertion import assert_or_throw
+
+
+class _FrameStream:
+    """Peekable stream of LocalDataFrame, skipping empty frames."""
+
+    def __init__(self, frames: Iterator[LocalDataFrame]):
+        self._frames = frames
+        self._buffer: List[LocalDataFrame] = []
+
+    def peek(self) -> Optional[LocalDataFrame]:
+        while not self._buffer:
+            try:
+                f = next(self._frames)
+            except StopIteration:
+                return None
+            if not f.empty:
+                self._buffer.append(f)
+        return self._buffer[0]
+
+    def __iter__(self) -> Iterator[LocalDataFrame]:
+        while True:
+            if self._buffer:
+                yield self._buffer.pop(0)
+            else:
+                try:
+                    f = next(self._frames)
+                except StopIteration:
+                    return
+                if not f.empty:
+                    yield f
+
+
+class LocalDataFrameIterableDataFrame(LocalUnboundedDataFrame):
+    """An unbounded local dataframe yielding LocalDataFrame chunks."""
+
+    def __init__(self, df: Any = None, schema: Any = None):
+        if df is None:
+            frames: Iterator[LocalDataFrame] = iter([])
+        elif isinstance(df, LocalDataFrameIterableDataFrame):
+            frames = iter(df.native)
+            if schema is None and df.schema_discovered:
+                schema = df.schema
+        elif isinstance(df, DataFrame):
+            frames = iter([df.as_local_bounded()])
+            if schema is None:
+                schema = df.schema
+        elif isinstance(df, Iterable):
+            frames = iter(df)  # type: ignore
+        else:
+            raise ValueError(
+                f"can't initialize LocalDataFrameIterableDataFrame with {type(df)}"
+            )
+        self._stream = _FrameStream(frames)
+        if schema is None:
+            # schema must come from the first non-empty frame (lazy)
+            super().__init__(lambda: self._first_frame_schema())
+        else:
+            super().__init__(schema)
+
+    def _first_frame_schema(self) -> Any:
+        first = self._stream.peek()
+        assert_or_throw(
+            first is not None,
+            ValueError("schema can't be inferred from an empty stream"),
+        )
+        return first.schema
+
+    @property
+    def native(self) -> Iterable[LocalDataFrame]:
+        return self._stream
+
+    @property
+    def empty(self) -> bool:
+        return self._stream.peek() is None
+
+    def peek_array(self) -> List[Any]:
+        first = self._stream.peek()
+        assert_or_throw(first is not None, ValueError("dataframe is empty"))
+        return first.peek_array()  # type: ignore
+
+    def _drop_cols(self, cols: List[str]) -> DataFrame:
+        schema = self.schema.exclude(cols)
+        return LocalDataFrameIterableDataFrame(
+            (f.drop(cols) for f in self._stream), schema  # type: ignore
+        )
+
+    def _select_cols(self, cols: List[Any]) -> DataFrame:
+        schema = self.schema.extract(cols)
+        return LocalDataFrameIterableDataFrame(
+            (f[cols] for f in self._stream), schema  # type: ignore
+        )
+
+    def rename(self, columns: Dict[str, str]) -> DataFrame:
+        schema = self._rename_schema(columns)
+        return LocalDataFrameIterableDataFrame(
+            (f.rename(columns) for f in self._stream), schema  # type: ignore
+        )
+
+    def alter_columns(self, columns: Any) -> DataFrame:
+        new_schema = self._alter_schema(columns)
+        if new_schema == self.schema:
+            return self
+        return LocalDataFrameIterableDataFrame(
+            (f.alter_columns(columns) for f in self._stream), new_schema  # type: ignore
+        )
+
+    def as_array(
+        self, columns: Optional[List[str]] = None, type_safe: bool = False
+    ) -> List[Any]:
+        return list(self.as_array_iterable(columns, type_safe))
+
+    def as_array_iterable(
+        self, columns: Optional[List[str]] = None, type_safe: bool = False
+    ) -> Iterable[Any]:
+        for f in self._stream:
+            yield from f.as_array_iterable(columns, type_safe)
+
+    def as_pandas(self) -> pd.DataFrame:
+        frames = [f.as_pandas() for f in self._stream]
+        if len(frames) == 0:
+            return self.schema.create_empty_pandas()
+        return pd.concat(frames, ignore_index=True)
+
+    def as_arrow(self, type_safe: bool = False) -> pa.Table:
+        tables = [f.as_arrow(type_safe) for f in self._stream]
+        if len(tables) == 0:
+            return self.schema.create_empty_arrow()
+        return pa.concat_tables(tables)
+
+    def head(
+        self, n: int, columns: Optional[List[str]] = None
+    ) -> LocalBoundedDataFrame:
+        assert_or_throw(n >= 0, ValueError("n must be >= 0"))
+        schema = self.schema if columns is None else self.schema.extract(columns)
+        rows: List[Any] = []
+        for f in self._stream:
+            for row in f.as_array_iterable(columns, type_safe=True):
+                if len(rows) >= n:
+                    return ArrayDataFrame(rows, schema)
+                rows.append(row)
+        return ArrayDataFrame(rows, schema)
+
+
+class IterablePandasDataFrame(LocalDataFrameIterableDataFrame):
+    """Chunk stream where chunks are PandasDataFrames."""
+
+    def __init__(self, df: Any = None, schema: Any = None):
+        if isinstance(df, Iterable) and not isinstance(df, DataFrame):
+            df = (
+                f if isinstance(f, DataFrame) else PandasDataFrame(f, schema)
+                for f in df  # type: ignore
+            )
+        super().__init__(df, schema)
+
+    def as_pandas(self) -> pd.DataFrame:
+        return super().as_pandas()
+
+
+class IterableArrowDataFrame(LocalDataFrameIterableDataFrame):
+    """Chunk stream where chunks are ArrowDataFrames."""
+
+    def __init__(self, df: Any = None, schema: Any = None):
+        if isinstance(df, Iterable) and not isinstance(df, DataFrame):
+            df = (
+                f if isinstance(f, DataFrame) else ArrowDataFrame(f, schema)
+                for f in df  # type: ignore
+            )
+        super().__init__(df, schema)

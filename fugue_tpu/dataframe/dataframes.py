@@ -1,0 +1,105 @@
+"""DataFrames: an ordered collection of named/unnamed DataFrames, the input
+unit for cotransform and SQL (reference fugue/dataframe/dataframes.py)."""
+
+from typing import Any, Dict
+
+from fugue_tpu.dataframe.dataframe import DataFrame
+from fugue_tpu.utils.assertion import assert_or_throw
+
+
+class DataFrames(Dict[str, DataFrame]):
+    """Either all-named (dict-like) or all-unnamed (positional, auto-keyed
+    ``_0, _1, ...``); mixing the two raises."""
+
+    def __init__(self, *args: Any, **kwargs: Any):
+        super().__init__()
+        self._has_dict_name = False
+        for a in args:
+            self._add(a)
+        for k, v in kwargs.items():
+            self._append_named(k, v)
+
+    def _add(self, obj: Any) -> None:
+        if obj is None:
+            return
+        if isinstance(obj, DataFrames):
+            if obj.has_dict:
+                for k, v in obj.items():
+                    self._append_named(k, v)
+            else:
+                for v in obj.values():
+                    self._append_unnamed(v)
+        elif isinstance(obj, dict):
+            for k, v in obj.items():
+                self._append_named(k, v)
+        elif isinstance(obj, DataFrame):
+            self._append_unnamed(obj)
+        elif isinstance(obj, (list, tuple)):
+            for x in obj:
+                self._add(x)
+        else:
+            raise ValueError(f"{type(obj)} is not acceptable in DataFrames")
+
+    def _check_df(self, name: str, df: Any) -> None:
+        assert_or_throw(
+            isinstance(df, DataFrame),
+            ValueError(f"{name}: {type(df)} is not a DataFrame"),
+        )
+        assert_or_throw(name not in self, KeyError(f"duplicated name {name}"))
+
+    def _append_named(self, name: str, df: Any) -> None:
+        assert_or_throw(
+            self._has_dict_name or len(self) == 0,
+            ValueError("can't mix named and unnamed dataframes"),
+        )
+        self._check_df(name, df)
+        self._has_dict_name = True
+        super().__setitem__(name, df)
+
+    def _append_unnamed(self, df: Any) -> None:
+        assert_or_throw(
+            not self._has_dict_name,
+            ValueError("can't mix named and unnamed dataframes"),
+        )
+        name = f"_{len(self)}"
+        self._check_df(name, df)
+        super().__setitem__(name, df)
+
+    @property
+    def has_dict(self) -> bool:
+        return self._has_dict_name
+
+    def __setitem__(self, key: str, value: DataFrame) -> None:
+        self._append_named(key, value)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:  # type: ignore[override]
+        for k, v in dict(*args, **kwargs).items():
+            self._append_named(k, v)
+
+    def setdefault(self, key: str, default: Any = None) -> DataFrame:  # type: ignore[override]
+        if key not in self:
+            self._append_named(key, default)
+        return self[key]
+
+    def pop(self, *args: Any) -> DataFrame:  # type: ignore[override]
+        raise NotImplementedError("DataFrames is append-only")
+
+    def popitem(self) -> Any:
+        raise NotImplementedError("DataFrames is append-only")
+
+    def __delitem__(self, key: str) -> None:
+        raise NotImplementedError("DataFrames is append-only")
+
+    def __getitem__(self, key: Any) -> DataFrame:  # type: ignore[override]
+        if isinstance(key, int):
+            return list(self.values())[key]
+        return super().__getitem__(key)
+
+    def convert(self, func: Any) -> "DataFrames":
+        res = DataFrames()
+        for k, v in self.items():
+            if self._has_dict_name:
+                res._append_named(k, func(v))
+            else:
+                res._append_unnamed(func(v))
+        return res

@@ -1,0 +1,153 @@
+"""One-pass streaming dataframe over an iterable of rows (reference
+iterable_dataframe.py:16). Reading consumes the stream — ``peek_array`` uses
+one-item lookahead."""
+
+from typing import Any, Dict, Iterable, Iterator, List, Optional
+
+from fugue_tpu.dataframe.array_dataframe import ArrayDataFrame
+from fugue_tpu.dataframe.arrow_utils import cast_table, rows_to_table, table_to_rows
+from fugue_tpu.dataframe.dataframe import (
+    DataFrame,
+    LocalBoundedDataFrame,
+    LocalUnboundedDataFrame,
+)
+from fugue_tpu.utils.assertion import assert_or_throw
+
+
+class _Peekable:
+    def __init__(self, it: Iterator[Any]):
+        self._it = it
+        self._buffer: List[Any] = []
+
+    def peek(self) -> Any:
+        if not self._buffer:
+            self._buffer.append(next(self._it))
+        return self._buffer[0]
+
+    def __iter__(self) -> Iterator[Any]:
+        while True:
+            if self._buffer:
+                yield self._buffer.pop(0)
+            else:
+                try:
+                    yield next(self._it)
+                except StopIteration:
+                    return
+
+
+class IterableDataFrame(LocalUnboundedDataFrame):
+    def __init__(self, df: Any = None, schema: Any = None):
+        if df is None:
+            super().__init__(schema)
+            self._native = _Peekable(iter([]))
+        elif isinstance(df, IterableDataFrame):
+            super().__init__(schema if schema is not None else df.schema)
+            if schema is not None and schema != df.schema:
+                idx = [df.schema.index_of_key(n) for n in self.schema.names]
+                self._native = _Peekable(
+                    [r[i] for i in idx] for r in df._native  # type: ignore
+                )
+            else:
+                self._native = df._native
+        elif isinstance(df, DataFrame):
+            super().__init__(schema if schema is not None else df.schema)
+            self._native = _Peekable(
+                iter(df.as_array_iterable(self.schema.names, type_safe=False))
+            )
+        elif isinstance(df, Iterable):
+            super().__init__(schema)
+            self._native = _Peekable(iter(df))
+        else:
+            raise ValueError(f"can't initialize IterableDataFrame with {type(df)}")
+
+    @property
+    def native(self) -> Iterable[Any]:
+        return self._native
+
+    @property
+    def empty(self) -> bool:
+        try:
+            self._native.peek()
+            return False
+        except StopIteration:
+            return True
+
+    def peek_array(self) -> List[Any]:
+        try:
+            return list(self._native.peek())
+        except StopIteration:
+            raise ValueError("dataframe is empty")
+
+    def _drop_cols(self, cols: List[str]) -> DataFrame:
+        return IterableDataFrame(self, self.schema.exclude(cols))
+
+    def _select_cols(self, cols: List[Any]) -> DataFrame:
+        return IterableDataFrame(self, self.schema.extract(cols))
+
+    def rename(self, columns: Dict[str, str]) -> DataFrame:
+        res = IterableDataFrame(self)
+        res._schema = self._rename_schema(columns)
+        return res
+
+    def alter_columns(self, columns: Any) -> DataFrame:
+        new_schema = self._alter_schema(columns)
+        if new_schema == self.schema:
+            return self
+
+        def gen() -> Iterator[List[Any]]:
+            # stream in chunks through arrow casting
+            chunk: List[Any] = []
+            for row in self._native:
+                chunk.append(row)
+                if len(chunk) >= 10000:
+                    yield from table_to_rows(
+                        cast_table(rows_to_table(chunk, self.schema), new_schema)
+                    )
+                    chunk = []
+            if chunk:
+                yield from table_to_rows(
+                    cast_table(rows_to_table(chunk, self.schema), new_schema)
+                )
+
+        return IterableDataFrame(gen(), new_schema)
+
+    def as_array(
+        self, columns: Optional[List[str]] = None, type_safe: bool = False
+    ) -> List[Any]:
+        return list(self.as_array_iterable(columns, type_safe))
+
+    def as_array_iterable(
+        self, columns: Optional[List[str]] = None, type_safe: bool = False
+    ) -> Iterable[Any]:
+        if not type_safe:
+            if columns is None:
+                yield from self._native
+            else:
+                idx = [self.schema.index_of_key(n) for n in columns]
+                for row in self._native:
+                    yield [row[i] for i in idx]
+        else:
+            # chunked type-safe conversion to stay streaming
+            schema = self.schema
+            chunk: List[Any] = []
+            for row in self._native:
+                chunk.append(row)
+                if len(chunk) >= 10000:
+                    yield from table_to_rows(rows_to_table(chunk, schema), columns)
+                    chunk = []
+            if chunk:
+                yield from table_to_rows(rows_to_table(chunk, schema), columns)
+
+    def head(
+        self, n: int, columns: Optional[List[str]] = None
+    ) -> LocalBoundedDataFrame:
+        assert_or_throw(n >= 0, ValueError("n must be >= 0"))
+        schema = self.schema if columns is None else self.schema.extract(columns)
+        rows = []
+        it = iter(self.as_array_iterable(columns, type_safe=True))
+        for _ in range(n):
+            try:
+                rows.append(next(it))
+            except StopIteration:
+                break
+        return ArrayDataFrame(rows, schema)

@@ -1,0 +1,129 @@
+"""Pandas-backed dataframe (reference pandas_dataframe.py:31)."""
+
+from typing import Any, Dict, Iterable, List, Optional
+
+import pandas as pd
+
+from fugue_tpu.dataframe.arrow_utils import (
+    cast_table,
+    normalize_dataframe_schema,
+    pandas_to_table,
+    table_to_pandas,
+    table_to_rows,
+)
+from fugue_tpu.dataframe.dataframe import DataFrame, LocalBoundedDataFrame
+from fugue_tpu.schema import Schema
+from fugue_tpu.utils.assertion import assert_or_throw
+
+
+class PandasDataFrame(LocalBoundedDataFrame):
+    def __init__(self, df: Any = None, schema: Any = None):
+        if df is None:
+            super().__init__(schema)
+            self._native = self.schema.create_empty_pandas()
+        elif isinstance(df, pd.DataFrame):
+            if schema is None:
+                super().__init__(normalize_dataframe_schema(df))
+                self._native = df.reset_index(drop=True)
+            else:
+                schema = Schema(schema)
+                assert_or_throw(
+                    set(schema.names) == set(df.columns),
+                    ValueError(f"schema {schema} doesn't match columns {list(df.columns)}"),
+                )
+                pdf = df[schema.names].reset_index(drop=True)
+                super().__init__(schema)
+                self._native = self._coerce(pdf, schema)
+        elif isinstance(df, pd.Series):
+            raise ValueError("can't initialize PandasDataFrame with a Series")
+        elif isinstance(df, DataFrame):
+            super().__init__(schema if schema is not None else df.schema)
+            self._native = df[self.schema.names].as_pandas() if schema is not None \
+                else df.as_pandas()
+        elif isinstance(df, Iterable):
+            super().__init__(schema)
+            from fugue_tpu.dataframe.arrow_utils import rows_to_table
+
+            self._native = table_to_pandas(rows_to_table(df, self.schema))
+        else:
+            raise ValueError(f"can't initialize PandasDataFrame with {type(df)}")
+
+    def _coerce(self, pdf: pd.DataFrame, schema: Schema) -> pd.DataFrame:
+        """Align pandas dtypes with the target schema (via arrow round trip
+        only when needed)."""
+        try:
+            inferred = normalize_dataframe_schema(pdf)
+        except Exception:
+            inferred = None
+        if inferred is not None and inferred == schema:
+            return pdf
+        return table_to_pandas(pandas_to_table(pdf, schema))
+
+    @property
+    def native(self) -> pd.DataFrame:
+        return self._native
+
+    @property
+    def empty(self) -> bool:
+        return len(self._native) == 0
+
+    def count(self) -> int:
+        return len(self._native)
+
+    def peek_array(self) -> List[Any]:
+        self.assert_not_empty()
+        head = pandas_to_table(self._native.head(1), self.schema)
+        return next(iter(table_to_rows(head)))
+
+    @staticmethod
+    def _wrap(pdf: pd.DataFrame, schema: Schema) -> "PandasDataFrame":
+        """Build without re-coercion when dtypes are known-correct."""
+        res = PandasDataFrame.__new__(PandasDataFrame)
+        LocalBoundedDataFrame.__init__(res, schema)
+        res._native = pdf
+        return res
+
+    def _drop_cols(self, cols: List[str]) -> DataFrame:
+        schema = self.schema.exclude(cols)
+        return self._wrap(self._native[schema.names], schema)
+
+    def _select_cols(self, cols: List[Any]) -> DataFrame:
+        schema = self.schema.extract(cols)
+        return self._wrap(self._native[schema.names], schema)
+
+    def rename(self, columns: Dict[str, str]) -> DataFrame:
+        schema = self._rename_schema(columns)
+        return self._wrap(self._native.rename(columns=columns), schema)
+
+    def alter_columns(self, columns: Any) -> DataFrame:
+        new_schema = self._alter_schema(columns)
+        if new_schema == self.schema:
+            return self
+        table = cast_table(pandas_to_table(self._native, self.schema), new_schema)
+        return PandasDataFrame(table_to_pandas(table), new_schema)
+
+    def as_arrow(self, type_safe: bool = False) -> Any:
+        return pandas_to_table(self._native, self.schema)
+
+    def as_pandas(self) -> pd.DataFrame:
+        return self._native
+
+    def as_array(
+        self, columns: Optional[List[str]] = None, type_safe: bool = False
+    ) -> List[Any]:
+        return list(self.as_array_iterable(columns, type_safe))
+
+    def as_array_iterable(
+        self, columns: Optional[List[str]] = None, type_safe: bool = False
+    ) -> Iterable[Any]:
+        if self.empty:
+            return
+        yield from table_to_rows(self.as_arrow(), columns)
+
+    def head(
+        self, n: int, columns: Optional[List[str]] = None
+    ) -> LocalBoundedDataFrame:
+        assert_or_throw(n >= 0, ValueError("n must be >= 0"))
+        pdf = self._native if columns is None else self._native[columns]
+        schema = self.schema if columns is None else self.schema.extract(columns)
+        return PandasDataFrame(pdf.head(n), schema)

@@ -1,0 +1,11 @@
+from fugue_tpu.dataset.dataset import Dataset, DatasetDisplay, get_dataset_display
+from fugue_tpu.dataset.api import (
+    as_fugue_dataset,
+    as_local,
+    as_local_bounded,
+    count,
+    is_bounded,
+    is_empty,
+    is_local,
+    show,
+)

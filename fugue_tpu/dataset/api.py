@@ -1,0 +1,56 @@
+"""Functional API over any dataset-like object (plugin dispatchers).
+
+Backends register candidates so these work on raw pandas/arrow/jax objects
+as well as fugue_tpu Datasets (parity: reference fugue/dataset/api.py)."""
+
+from typing import Any
+
+from fugue_tpu.dataset.dataset import Dataset
+from fugue_tpu.plugins import fugue_plugin
+
+
+@fugue_plugin
+def as_fugue_dataset(data: Any, **kwargs: Any) -> Dataset:
+    """Convert an arbitrary object to a fugue_tpu Dataset."""
+    if isinstance(data, Dataset):
+        return data
+    raise NotImplementedError(f"can't convert {type(data)} to Dataset")
+
+
+def show(data: Any, n: int = 10, with_count: bool = False, title: Any = None) -> None:
+    as_fugue_dataset(data).show(n, with_count, title)
+
+
+@fugue_plugin
+def as_local(data: Any) -> Any:
+    return as_fugue_dataset(data).native  # pragma: no cover - overridden
+
+
+@fugue_plugin
+def as_local_bounded(data: Any) -> Any:
+    return as_fugue_dataset(data).native  # pragma: no cover - overridden
+
+
+@fugue_plugin
+def is_local(data: Any) -> bool:
+    return as_fugue_dataset(data).is_local
+
+
+@fugue_plugin
+def is_bounded(data: Any) -> bool:
+    return as_fugue_dataset(data).is_bounded
+
+
+@fugue_plugin
+def is_empty(data: Any) -> bool:
+    return as_fugue_dataset(data).empty
+
+
+@fugue_plugin
+def count(data: Any) -> int:
+    return as_fugue_dataset(data).count()
+
+
+@fugue_plugin
+def get_num_partitions(data: Any) -> int:
+    return as_fugue_dataset(data).num_partitions

@@ -1,0 +1,97 @@
+"""Dataset: the root abstraction over anything distributed-or-local with
+metadata (DataFrames and Bags both derive from it). Parity target:
+reference ``fugue/dataset/dataset.py:14``; rebuilt on our own ParamDict and
+plugin registry."""
+
+from abc import ABC, abstractmethod
+from typing import Any, Optional
+
+from fugue_tpu.plugins import fugue_plugin
+from fugue_tpu.utils.assertion import assert_or_throw
+from fugue_tpu.utils.params import ParamDict
+
+
+class Dataset(ABC):
+    """A collection of data that may live locally or across a cluster/mesh."""
+
+    def __init__(self):
+        self._metadata: Optional[ParamDict] = None
+
+    @property
+    def metadata(self) -> ParamDict:
+        if self._metadata is None:
+            self._metadata = ParamDict()
+        return self._metadata
+
+    @property
+    def has_metadata(self) -> bool:
+        return self._metadata is not None and len(self._metadata) > 0
+
+    def reset_metadata(self, metadata: Any) -> None:
+        self._metadata = ParamDict(metadata) if metadata is not None else None
+
+    @property
+    @abstractmethod
+    def is_local(self) -> bool:  # pragma: no cover - interface
+        """Whether the full dataset lives in the driver process."""
+        raise NotImplementedError
+
+    @property
+    @abstractmethod
+    def is_bounded(self) -> bool:  # pragma: no cover - interface
+        """Whether the dataset has finite size."""
+        raise NotImplementedError
+
+    @property
+    @abstractmethod
+    def num_partitions(self) -> int:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    @property
+    @abstractmethod
+    def empty(self) -> bool:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    @abstractmethod
+    def count(self) -> int:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def assert_not_empty(self) -> None:
+        assert_or_throw(not self.empty, ValueError("dataset is empty"))
+
+    @property
+    def native(self) -> Any:
+        """The underlying object of the backend (self for pure-python impls)."""
+        return self
+
+    def show(
+        self, n: int = 10, with_count: bool = False, title: Optional[str] = None
+    ) -> None:
+        get_dataset_display(self).show(n, with_count, title)
+
+
+class DatasetDisplay(ABC):
+    """Pluggable renderer for :meth:`Dataset.show` — notebook integrations
+    override via the :func:`get_dataset_display` plugin."""
+
+    def __init__(self, ds: Dataset):
+        self._ds = ds
+
+    @abstractmethod
+    def show(
+        self, n: int = 10, with_count: bool = False, title: Optional[str] = None
+    ) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def repr(self) -> str:
+        return str(type(self._ds).__name__)
+
+    def repr_html(self) -> str:
+        return self.repr()
+
+
+@fugue_plugin
+def get_dataset_display(ds: "Dataset") -> DatasetDisplay:
+    """Get the display utility for a dataset; backends/notebooks register
+    higher-priority candidates."""
+    raise NotImplementedError(f"no display registered for {type(ds)}")

@@ -1,0 +1,49 @@
+"""Plain-text tabular rendering for Dataset.show() (PrettyTable replacement)."""
+
+from typing import Any, List, Optional
+
+
+def _cell(v: Any, max_width: int = 30) -> str:
+    s = "NULL" if v is None else str(v)
+    if len(s) > max_width:
+        s = s[: max_width - 3] + "..."
+    return s
+
+
+def build_show_text(
+    rows: List[List[Any]],
+    schema: Any,
+    title: Optional[str] = None,
+    count: Optional[int] = None,
+    truncated: bool = False,
+) -> str:
+    headers = [f"{f.name}:{_type_name(f.type)}" for f in schema.fields]
+    str_rows = [[_cell(v) for v in row] for row in rows]
+    widths = [len(h) for h in headers]
+    for r in str_rows:
+        for i, c in enumerate(r):
+            widths[i] = max(widths[i], len(c))
+    lines = []
+    if title:
+        lines.append(title)
+    sep = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+    lines.append(sep)
+    lines.append("|" + "|".join(f" {h.ljust(w)} " for h, w in zip(headers, widths)) + "|")
+    lines.append(sep)
+    for r in str_rows:
+        lines.append("|" + "|".join(f" {c.ljust(w)} " for c, w in zip(r, widths)) + "|")
+    lines.append(sep)
+    if truncated:
+        lines.append("(showing first rows only)")
+    if count is not None:
+        lines.append(f"Total count: {count}")
+    return "\n".join(lines)
+
+
+def _type_name(tp: Any) -> str:
+    from fugue_tpu.schema import type_to_expr
+
+    try:
+        return type_to_expr(tp)
+    except Exception:
+        return str(tp)

@@ -1,0 +1,6 @@
+"""Conformance suites for fugue_tpu implementations.
+
+Mirrors the reference's test strategy (SURVEY §4): abstract test suites that
+every DataFrame implementation / ExecutionEngine must subclass and pass —
+the acceptance gate for new backends (including the JAX/TPU engine, which
+runs them on a virtual multi-device CPU mesh)."""

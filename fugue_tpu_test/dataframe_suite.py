@@ -1,0 +1,206 @@
+"""Abstract conformance suite for DataFrame implementations (parity role:
+reference fugue_test/dataframe_suite.py:17-450). Subclass and implement
+``df(data, schema)`` to run the whole battery against an implementation."""
+
+from datetime import date, datetime
+from typing import Any
+
+import pytest
+
+from fugue_tpu.dataframe import DataFrame
+from fugue_tpu.dataframe.utils import df_eq
+
+
+class DataFrameTests:
+    """Namespace so pytest doesn't collect the abstract base itself."""
+
+    class Tests:
+        @classmethod
+        def setup_class(cls):
+            pass
+
+        def df(self, data: Any = None, schema: Any = None) -> DataFrame:
+            raise NotImplementedError
+
+        # ---- init & basic properties --------------------------------
+        def test_init_basic(self):
+            df = self.df([], "a:int,b:str")
+            assert df.schema == "a:int,b:str"
+            assert df.empty
+            assert df.is_bounded or True  # both allowed
+            with pytest.raises(Exception):
+                self.df([[1]], "")
+
+        def test_peek(self):
+            df = self.df([["x", 1]], "a:str,b:int")
+            assert df.peek_array() == ["x", 1]
+            assert df.peek_dict() == dict(a="x", b=1)
+            df2 = self.df([], "a:str,b:int")
+            with pytest.raises(Exception):
+                df2.peek_array()
+
+        def test_count(self):
+            df = self.df([["a", 1], ["b", 2]], "x:str,y:long")
+            if df.is_bounded:
+                assert df.count() == 2
+            assert not df.empty
+
+        # ---- conversions --------------------------------------------
+        def test_as_array(self):
+            df = self.df([[1, "a"], [2, "b"]], "a:long,b:str")
+            assert df.as_array() == [[1, "a"], [2, "b"]]
+            df = self.df([[1, "a"], [2, "b"]], "a:long,b:str")
+            assert df.as_array(["b", "a"]) == [["a", 1], ["b", 2]]
+            df = self.df([[1, "a"]], "a:long,b:str")
+            assert [[1, "a"]] == [list(r) for r in df.as_array_iterable()]
+
+        def test_as_array_type_safe(self):
+            df = self.df([[1, 1.1], [2, None]], "a:long,b:double")
+            arr = df.as_array(type_safe=True)
+            assert arr[0] == [1, 1.1]
+            assert arr[1][1] is None
+            df = self.df([["2020-01-01", "2020-01-01 01:02:03"]], "a:date,b:datetime")
+            row = df.as_array(type_safe=True)[0] if not df.is_bounded else \
+                df.as_array(type_safe=True)[0]
+            # date/datetime columns produce python date/datetime
+            assert row[0] == date(2020, 1, 1) or str(row[0]) == "2020-01-01"
+            assert row[1] == datetime(2020, 1, 1, 1, 2, 3) or "01:02:03" in str(row[1])
+
+        def test_as_pandas_arrow(self):
+            df = self.df([[1, "a"], [2, None]], "a:long,b:str")
+            pdf = df.as_pandas()
+            assert list(pdf.columns) == ["a", "b"]
+            assert len(pdf) == 2
+            df = self.df([[1, "a"], [2, None]], "a:long,b:str")
+            adf = df.as_arrow()
+            assert adf.num_rows == 2
+            assert [c for c in adf.schema.names] == ["a", "b"]
+
+        def test_as_dict_iterable(self):
+            df = self.df([[1, "a"]], "a:long,b:str")
+            assert list(df.as_dict_iterable()) == [dict(a=1, b="a")]
+
+        def test_nested_types(self):
+            df = self.df([[[30, 40]]], "a:[int]")
+            assert df.as_array(type_safe=True) == [[[30, 40]]]
+            df = self.df([[dict(x=1)]], "a:{x:int}")
+            assert df.as_array(type_safe=True) == [[dict(x=1)]]
+            df = self.df([[{"k": 1}]], "a:<str,int>")
+            assert df.as_array(type_safe=True) == [[{"k": 1}]]
+
+        def test_binary_type(self):
+            df = self.df([[b"\x01\x02"]], "a:bytes")
+            assert df.as_array(type_safe=True) == [[b"\x01\x02"]]
+
+        def test_special_values(self):
+            df = self.df([[float("nan")], [1.1]], "a:double")
+            arr = df.as_array(type_safe=True)
+            assert arr[0][0] is None  # NaN normalizes to null
+            assert arr[1][0] == 1.1
+            df = self.df([[None], [2]], "a:long")
+            assert df.as_array(type_safe=True) == [[None], [2]]
+            df = self.df([[None]], "a:str")
+            assert df.as_array(type_safe=True) == [[None]]
+
+        # ---- transformations ----------------------------------------
+        def test_rename(self):
+            df = self.df([[1, "a"]], "a:long,b:str")
+            df2 = df.rename(dict(a="aa"))
+            assert df2.schema == "aa:long,b:str"
+            assert df2.as_array() == [[1, "a"]]
+            df = self.df([[1, "a"]], "a:long,b:str")
+            with pytest.raises(Exception):
+                df.rename(dict(x="y"))
+            df = self.df([[1, "a"]], "a:long,b:str")
+            with pytest.raises(Exception):
+                df.rename(dict(a="b"))  # collision
+
+        def test_rename_swap(self):
+            df = self.df([[1, "a"]], "a:long,b:str")
+            df2 = df.rename(dict(a="b", b="a"))
+            assert df2.schema == "b:long,a:str"
+            assert df2.as_array() == [[1, "a"]]
+
+        def test_drop_select(self):
+            df = self.df([[1, "a", 2.0]], "a:long,b:str,c:double")
+            df2 = df.drop(["b"])
+            assert df2.schema == "a:long,c:double"
+            assert df2.as_array() == [[1, 2.0]]
+            df = self.df([[1, "a", 2.0]], "a:long,b:str,c:double")
+            with pytest.raises(Exception):
+                df.drop(["a", "b", "c"])  # can't drop all
+            df = self.df([[1, "a", 2.0]], "a:long,b:str,c:double")
+            with pytest.raises(Exception):
+                df.drop(["x"])
+            df = self.df([[1, "a", 2.0]], "a:long,b:str,c:double")
+            df3 = df[["c", "a"]]
+            assert df3.schema == "c:double,a:long"
+            assert df3.as_array() == [[2.0, 1]]
+            df = self.df([[1, "a", 2.0]], "a:long,b:str,c:double")
+            with pytest.raises(Exception):
+                df[["nope"]]
+
+        def test_alter_columns_numeric(self):
+            df = self.df([[1, "a"], [2, "b"]], "a:long,b:str")
+            df2 = df.alter_columns("a:double")
+            assert df2.schema == "a:double,b:str"
+            assert df2.as_array(type_safe=True) == [[1.0, "a"], [2.0, "b"]]
+            df = self.df([[1.0], [2.0]], "a:double")
+            df2 = df.alter_columns("a:long")
+            assert df2.as_array(type_safe=True) == [[1], [2]]
+
+        def test_alter_columns_str_cast(self):
+            df = self.df([[1], [None]], "a:long")
+            df2 = df.alter_columns("a:str")
+            assert df2.schema == "a:str"
+            assert df2.as_array(type_safe=True) == [["1"], [None]]
+            df = self.df([["1"], ["2"]], "a:str")
+            df2 = df.alter_columns("a:int")
+            assert df2.as_array(type_safe=True) == [[1], [2]]
+
+        def test_alter_columns_bool(self):
+            df = self.df([[True], [False], [None]], "a:bool")
+            df2 = df.alter_columns("a:str")
+            assert df2.as_array(type_safe=True) == [["True"], ["False"], [None]]
+            df = self.df([["true"], ["false"]], "a:str")
+            df2 = df.alter_columns("a:bool")
+            assert df2.as_array(type_safe=True) == [[True], [False]]
+
+        def test_alter_columns_noop(self):
+            df = self.df([[1]], "a:long")
+            df2 = df.alter_columns("a:long")
+            assert df2.schema == "a:long"
+            df = self.df([[1]], "a:long")
+            with pytest.raises(Exception):
+                df.alter_columns("x:long")
+
+        # ---- head / local -------------------------------------------
+        def test_head(self):
+            df = self.df([[i, str(i)] for i in range(5)], "a:long,b:str")
+            h = df.head(3)
+            assert h.is_local and h.is_bounded
+            assert h.count() == 3
+            assert h.as_array() == [[0, "0"], [1, "1"], [2, "2"]]
+            df = self.df([[i, str(i)] for i in range(5)], "a:long,b:str")
+            h = df.head(3, ["b"])
+            assert h.schema == "b:str"
+            df = self.df([[1, "a"]], "a:long,b:str")
+            assert df.head(0).count() == 0
+
+        def test_as_local(self):
+            df = self.df([[1, "a"]], "a:long,b:str")
+            local = df.as_local()
+            assert local.is_local
+            assert df_eq(local, [[1, "a"]], "a:long,b:str", throw=True)
+
+        def test_metadata_preserved_on_as_local(self):
+            df = self.df([[1]], "a:long")
+            if not df.is_local:
+                df.reset_metadata({"x": 1})
+                assert df.as_local().metadata == {"x": 1}
+
+        def test_show(self, capsys):
+            df = self.df([[1, "a"]], "a:long,b:str")
+            df.show()
+            out = capsys.readouterr().out
+            assert "a:long" in out

@@ -1,0 +1,100 @@
+import pytest
+
+from fugue_tpu.collections.partition import (
+    PartitionCursor,
+    PartitionSpec,
+    parse_presort_exp,
+)
+from fugue_tpu.schema import Schema
+
+
+def test_empty_spec():
+    assert PartitionSpec().empty
+    assert PartitionSpec(None).empty
+    assert PartitionSpec("").empty
+    assert not PartitionSpec(num=4).empty
+
+
+def test_spec_construct():
+    s = PartitionSpec(num=4)
+    assert s.get_num_partitions() == 4
+    s = PartitionSpec(by=["a", "b"])
+    assert s.partition_by == ["a", "b"]
+    s = PartitionSpec(by="a")
+    assert s.partition_by == ["a"]
+    s = PartitionSpec(algo="hash", num=2, by=["x"], presort="y desc, z")
+    assert s.algo == "hash"
+    assert s.presort == {"y": False, "z": True}
+    assert s.presort_expr == "y DESC,z ASC"
+    # merge: later overrides
+    s2 = PartitionSpec(s, num=8)
+    assert s2.get_num_partitions() == 8
+    assert s2.partition_by == ["x"]
+    # json string
+    s3 = PartitionSpec('{"num":3,"by":["k"]}')
+    assert s3.get_num_partitions() == 3 and s3.partition_by == ["k"]
+    # int arg
+    assert PartitionSpec(5).get_num_partitions() == 5
+    with pytest.raises(SyntaxError):
+        PartitionSpec(by=["a", "a"])
+    with pytest.raises(Exception):
+        PartitionSpec(algo="bogus")
+
+
+def test_per_row():
+    s = PartitionSpec("per_row")
+    assert s.algo == "even"
+    assert s.get_num_partitions(ROWCOUNT=lambda: 42) == 42
+
+
+def test_num_expressions():
+    s = PartitionSpec(num="ROWCOUNT/4+1")
+    assert s.get_num_partitions(ROWCOUNT=lambda: 8) == 3
+    s = PartitionSpec(num="min(ROWCOUNT,CONCURRENCY)")
+    assert s.get_num_partitions(ROWCOUNT=lambda: 8, CONCURRENCY=lambda: 3) == 3
+    # lazy: CONCURRENCY not called when absent from expr
+    s = PartitionSpec(num="2")
+    assert s.get_num_partitions(ROWCOUNT=lambda: 1 / 0) == 2
+    with pytest.raises(Exception):
+        PartitionSpec(num="__import__('os')").get_num_partitions()
+
+
+def test_presort_parse():
+    assert parse_presort_exp(None) == {}
+    assert parse_presort_exp("a") == {"a": True}
+    assert parse_presort_exp("a ASC, b DESC") == {"a": True, "b": False}
+    assert parse_presort_exp({"a": False}) == {"a": False}
+    with pytest.raises(SyntaxError):
+        parse_presort_exp("a asc, a desc")
+    with pytest.raises(SyntaxError):
+        parse_presort_exp("a bogus")
+
+
+def test_get_sorts_and_key_schema():
+    schema = Schema("a:int,b:str,c:double")
+    s = PartitionSpec(by=["b"], presort="c desc")
+    assert s.get_sorts(schema) == {"b": True, "c": False}
+    assert s.get_key_schema(schema) == "b:str"
+    with pytest.raises(Exception):
+        PartitionSpec(by=["nope"]).get_sorts(schema)
+
+
+def test_uuid_eq():
+    assert PartitionSpec(num=2) == PartitionSpec(num=2)
+    assert PartitionSpec(num=2).__uuid__() == PartitionSpec(num="2").__uuid__()
+    assert PartitionSpec(num=2) != PartitionSpec(num=3)
+
+
+def test_cursor():
+    schema = Schema("a:int,b:str,c:double")
+    spec = PartitionSpec(by=["b"])
+    cursor = spec.get_cursor(schema, 7)
+    cursor.set([1, "x", 2.0], 3, 1)
+    assert cursor.row == [1, "x", 2.0]
+    assert cursor.key_value_array == ["x"]
+    assert cursor.key_value_dict == {"b": "x"}
+    assert cursor.partition_no == 3
+    assert cursor.physical_partition_no == 7
+    assert cursor.slice_no == 1
+    assert cursor.key_schema == "b:str"
+    assert cursor.row_schema == schema

@@ -1,0 +1,48 @@
+import pytest
+
+from fugue_tpu.collections.sql import StructuredRawSQL, TempTableName
+from fugue_tpu.collections.yielded import PhysicalYielded, Yielded
+
+
+def test_structured_raw_sql():
+    t1, t2 = TempTableName(), TempTableName()
+    raw = f"SELECT * FROM {t1} JOIN {t2} ON a=b"
+    s = StructuredRawSQL.from_expr(raw, dialect="spark")
+    constructed = s.construct({t1.key: "x", t2.key: "y"})
+    assert constructed == "SELECT * FROM x JOIN y ON a=b"
+    # identity map
+    assert t1.key in s.construct()
+    # callable map
+    assert "QQ" in s.construct(lambda name: "QQ")
+
+
+def test_yielded():
+    y = PhysicalYielded("id1", "file")
+    assert not y.is_set
+    with pytest.raises(Exception):
+        y.name
+    y.set_value("/tmp/x.parquet")
+    assert y.is_set and y.name == "/tmp/x.parquet"
+    assert y.__uuid__() == "id1"
+    with pytest.raises(Exception):
+        PhysicalYielded("id2", "bogus")
+
+
+def test_dataframes():
+    from fugue_tpu.dataframe import ArrayDataFrame, DataFrames
+
+    a = ArrayDataFrame([[1]], "a:int")
+    b = ArrayDataFrame([[2]], "b:int")
+    dfs = DataFrames(a, b)
+    assert not dfs.has_dict
+    assert dfs[0] is a and dfs[1] is b
+    assert list(dfs.keys()) == ["_0", "_1"]
+    dfs2 = DataFrames(x=a, y=b)
+    assert dfs2.has_dict
+    assert dfs2["x"] is a
+    with pytest.raises(Exception):
+        DataFrames(a, x=b)  # mixing
+    with pytest.raises(Exception):
+        DataFrames(dict(x=a), b)  # mixing other order
+    dfs3 = dfs2.convert(lambda df: df)
+    assert list(dfs3.keys()) == ["x", "y"]

@@ -1,0 +1,81 @@
+"""Run the DataFrame conformance suite against every local implementation."""
+
+from typing import Any
+
+import pandas as pd
+
+from fugue_tpu.dataframe import (
+    ArrayDataFrame,
+    ArrowDataFrame,
+    DataFrame,
+    IterableArrowDataFrame,
+    IterableDataFrame,
+    IterablePandasDataFrame,
+    PandasDataFrame,
+)
+from fugue_tpu.dataframe.arrow_utils import rows_to_table
+from fugue_tpu.schema import Schema
+from fugue_tpu_test.dataframe_suite import DataFrameTests
+
+
+class TestArrayDataFrame(DataFrameTests.Tests):
+    def df(self, data: Any = None, schema: Any = None) -> DataFrame:
+        return ArrayDataFrame(data, schema)
+
+
+class TestArrowDataFrame(DataFrameTests.Tests):
+    def df(self, data: Any = None, schema: Any = None) -> DataFrame:
+        return ArrowDataFrame(data, schema)
+
+
+class TestPandasDataFrame(DataFrameTests.Tests):
+    def df(self, data: Any = None, schema: Any = None) -> DataFrame:
+        if isinstance(data, list):
+            # build via arrow to honor the schema's exact types
+            return PandasDataFrame(
+                ArrowDataFrame(data, schema).as_pandas(), schema
+            )
+        return PandasDataFrame(data, schema)
+
+
+class TestIterableDataFrame(DataFrameTests.Tests):
+    def df(self, data: Any = None, schema: Any = None) -> DataFrame:
+        return IterableDataFrame(data, schema)
+
+
+class TestLocalDataFrameIterableDataFrame(DataFrameTests.Tests):
+    def df(self, data: Any = None, schema: Any = None) -> DataFrame:
+        if isinstance(data, list):
+            if len(data) == 0:
+                frames = iter([])
+            else:
+                # split rows into two chunks to exercise multi-frame streams
+                mid = max(1, len(data) // 2)
+                frames = iter(
+                    [
+                        ArrayDataFrame(data[:mid], schema),
+                        ArrayDataFrame(data[mid:], schema),
+                    ]
+                )
+            from fugue_tpu.dataframe import LocalDataFrameIterableDataFrame
+
+            return LocalDataFrameIterableDataFrame(frames, schema)
+        from fugue_tpu.dataframe import LocalDataFrameIterableDataFrame
+
+        return LocalDataFrameIterableDataFrame(data, schema)
+
+
+class TestIterablePandasDataFrame(DataFrameTests.Tests):
+    def df(self, data: Any = None, schema: Any = None) -> DataFrame:
+        if isinstance(data, list):
+            frames = iter([ArrowDataFrame(data, schema).as_pandas()])
+            return IterablePandasDataFrame(frames, schema)
+        return IterablePandasDataFrame(data, schema)
+
+
+class TestIterableArrowDataFrame(DataFrameTests.Tests):
+    def df(self, data: Any = None, schema: Any = None) -> DataFrame:
+        if isinstance(data, list):
+            frames = iter([rows_to_table(data, Schema(schema))])
+            return IterableArrowDataFrame(frames, schema)
+        return IterableArrowDataFrame(data, schema)
