@@ -30,3 +30,19 @@ from fugue_tpu.dataframe import (
     as_fugue_df,
 )
 from fugue_tpu.bag import ArrayBag, Bag
+from fugue_tpu.execution import (
+    AnyDataFrame,
+    ExecutionEngine,
+    MapEngine,
+    NativeExecutionEngine,
+    SQLEngine,
+    clear_global_engine,
+    engine_context,
+    make_execution_engine,
+    register_default_execution_engine,
+    register_execution_engine,
+    register_sql_engine,
+    set_global_engine,
+)
+
+import fugue_tpu.registry  # noqa: F401  (registers builtin engines)

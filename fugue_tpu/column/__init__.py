@@ -1,0 +1,22 @@
+from fugue_tpu.column.expressions import (
+    ColumnExpr,
+    all_cols,
+    col,
+    function,
+    lit,
+    null,
+)
+from fugue_tpu.column.functions import (
+    avg,
+    coalesce,
+    count,
+    count_distinct,
+    first,
+    is_agg,
+    last,
+    max,  # noqa: A004
+    mean,
+    min,  # noqa: A004
+    sum,  # noqa: A004
+)
+from fugue_tpu.column.sql import SelectColumns, SQLExpressionGenerator

@@ -1,0 +1,474 @@
+"""Engine-agnostic column expression tree (reference
+fugue/column/expressions.py:452-860 re-designed): the single algebra consumed
+by the SQL text generator, the pandas evaluator, and the JAX device lowering.
+"""
+
+from typing import Any, Dict, Iterable, List, Optional, Union
+
+import pyarrow as pa
+
+from fugue_tpu.schema import Schema, parse_type
+from fugue_tpu.utils.assertion import assert_or_throw
+from fugue_tpu.utils.hash import to_uuid
+
+
+class ColumnExpr:
+    """Base of all column expressions."""
+
+    def __init__(self):
+        self._as_name = ""
+        self._as_type: Optional[pa.DataType] = None
+
+    # ---- naming / casting ------------------------------------------------
+    @property
+    def name(self) -> str:
+        """The inherent name ('' when the expression has none)."""
+        return ""
+
+    @property
+    def as_name(self) -> str:
+        return self._as_name
+
+    @property
+    def as_type(self) -> Optional[pa.DataType]:
+        return self._as_type
+
+    @property
+    def output_name(self) -> str:
+        return self._as_name if self._as_name != "" else self.name
+
+    def alias(self, as_name: str) -> "ColumnExpr":
+        res = self._copy()
+        res._as_name = as_name
+        res._as_type = self._as_type
+        return res
+
+    def cast(self, data_type: Any) -> "ColumnExpr":
+        res = self._copy()
+        res._as_name = self._as_name
+        if data_type is None:
+            res._as_type = None
+        elif isinstance(data_type, pa.DataType):
+            res._as_type = data_type
+        elif isinstance(data_type, str):
+            res._as_type = parse_type(data_type)
+        else:
+            assert_or_throw(
+                data_type in _PY_TYPES,
+                ValueError(f"can't cast to {data_type!r}"),
+            )
+            res._as_type = _PY_TYPES[data_type]
+        return res
+
+    def _copy(self) -> "ColumnExpr":  # pragma: no cover - overridden
+        raise NotImplementedError
+
+    # ---- type inference --------------------------------------------------
+    def infer_type(self, schema: Schema) -> Optional[pa.DataType]:
+        """Output type against an input schema; None when not inferrable."""
+        return self._as_type
+
+    def infer_schema_field(self, schema: Schema) -> pa.Field:
+        name = self.output_name
+        assert_or_throw(name != "", ValueError(f"{self} has no output name"))
+        tp = self.infer_type(schema)
+        assert_or_throw(tp is not None, ValueError(f"can't infer type of {self}"))
+        return pa.field(name, tp)
+
+    # ---- operators -------------------------------------------------------
+    def __eq__(self, other: Any) -> "ColumnExpr":  # type: ignore[override]
+        return _BinaryOpExpr("==", self, _to_col(other))
+
+    def __ne__(self, other: Any) -> "ColumnExpr":  # type: ignore[override]
+        return _BinaryOpExpr("!=", self, _to_col(other))
+
+    def __lt__(self, other: Any) -> "ColumnExpr":
+        return _BinaryOpExpr("<", self, _to_col(other))
+
+    def __le__(self, other: Any) -> "ColumnExpr":
+        return _BinaryOpExpr("<=", self, _to_col(other))
+
+    def __gt__(self, other: Any) -> "ColumnExpr":
+        return _BinaryOpExpr(">", self, _to_col(other))
+
+    def __ge__(self, other: Any) -> "ColumnExpr":
+        return _BinaryOpExpr(">=", self, _to_col(other))
+
+    def __add__(self, other: Any) -> "ColumnExpr":
+        return _BinaryOpExpr("+", self, _to_col(other))
+
+    def __radd__(self, other: Any) -> "ColumnExpr":
+        return _BinaryOpExpr("+", _to_col(other), self)
+
+    def __sub__(self, other: Any) -> "ColumnExpr":
+        return _BinaryOpExpr("-", self, _to_col(other))
+
+    def __rsub__(self, other: Any) -> "ColumnExpr":
+        return _BinaryOpExpr("-", _to_col(other), self)
+
+    def __mul__(self, other: Any) -> "ColumnExpr":
+        return _BinaryOpExpr("*", self, _to_col(other))
+
+    def __rmul__(self, other: Any) -> "ColumnExpr":
+        return _BinaryOpExpr("*", _to_col(other), self)
+
+    def __truediv__(self, other: Any) -> "ColumnExpr":
+        return _BinaryOpExpr("/", self, _to_col(other))
+
+    def __rtruediv__(self, other: Any) -> "ColumnExpr":
+        return _BinaryOpExpr("/", _to_col(other), self)
+
+    def __and__(self, other: Any) -> "ColumnExpr":
+        return _BinaryOpExpr("&", self, _to_col(other))
+
+    def __rand__(self, other: Any) -> "ColumnExpr":
+        return _BinaryOpExpr("&", _to_col(other), self)
+
+    def __or__(self, other: Any) -> "ColumnExpr":
+        return _BinaryOpExpr("|", self, _to_col(other))
+
+    def __ror__(self, other: Any) -> "ColumnExpr":
+        return _BinaryOpExpr("|", _to_col(other), self)
+
+    def __invert__(self) -> "ColumnExpr":
+        return _UnaryOpExpr("~", self)
+
+    def __neg__(self) -> "ColumnExpr":
+        return _UnaryOpExpr("-", self)
+
+    def is_null(self) -> "ColumnExpr":
+        return _UnaryOpExpr("IS_NULL", self)
+
+    def not_null(self) -> "ColumnExpr":
+        return _UnaryOpExpr("NOT_NULL", self)
+
+    # ---- identity --------------------------------------------------------
+    def __uuid__(self) -> str:
+        return to_uuid(
+            type(self).__name__,
+            self._as_name,
+            str(self._as_type),
+            self._uuid_keys(),
+        )
+
+    def _uuid_keys(self) -> List[Any]:  # pragma: no cover - overridden
+        return []
+
+    def __hash__(self) -> int:
+        return hash(self.__uuid__())
+
+    def __bool__(self) -> bool:
+        raise ValueError("ColumnExpr can't be used as a boolean")
+
+    def __str__(self) -> str:  # pragma: no cover - overridden
+        raise NotImplementedError
+
+    def __repr__(self) -> str:
+        return str(self)
+
+
+_PY_TYPES: Dict[Any, pa.DataType] = {
+    int: pa.int64(),
+    float: pa.float64(),
+    str: pa.string(),
+    bool: pa.bool_(),
+    bytes: pa.binary(),
+}
+
+
+def _to_col(obj: Any) -> ColumnExpr:
+    if isinstance(obj, ColumnExpr):
+        return obj
+    return lit(obj)
+
+
+class _NamedColumnExpr(ColumnExpr):
+    def __init__(self, name: str):
+        super().__init__()
+        self._name = name
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def wildcard(self) -> bool:
+        return self._name == "*"
+
+    def _copy(self) -> ColumnExpr:
+        return _NamedColumnExpr(self._name)
+
+    def infer_type(self, schema: Schema) -> Optional[pa.DataType]:
+        if self._as_type is not None:
+            return self._as_type
+        if self.wildcard:
+            return None
+        return schema[self._name].type if self._name in schema else None
+
+    def _uuid_keys(self) -> List[Any]:
+        return [self._name]
+
+    def __str__(self) -> str:
+        res = self._name
+        if self._as_type is not None:
+            from fugue_tpu.schema import type_to_expr
+
+            res = f"CAST({res} AS {type_to_expr(self._as_type)})"
+        if self._as_name != "":
+            res = f"{res} AS {self._as_name}"
+        return res
+
+
+class _LitColumnExpr(ColumnExpr):
+    def __init__(self, value: Any):
+        super().__init__()
+        assert_or_throw(
+            value is None or isinstance(value, (int, float, str, bool)),
+            NotImplementedError(f"{value} is not a valid literal"),
+        )
+        self._value = value
+
+    @property
+    def value(self) -> Any:
+        return self._value
+
+    def _copy(self) -> ColumnExpr:
+        return _LitColumnExpr(self._value)
+
+    def infer_type(self, schema: Schema) -> Optional[pa.DataType]:
+        if self._as_type is not None:
+            return self._as_type
+        if self._value is None:
+            return pa.null()
+        if isinstance(self._value, bool):
+            return pa.bool_()
+        if isinstance(self._value, int):
+            return pa.int64()
+        if isinstance(self._value, float):
+            return pa.float64()
+        return pa.string()
+
+    def _uuid_keys(self) -> List[Any]:
+        return [self._value]
+
+    def __str__(self) -> str:
+        if self._value is None:
+            body = "NULL"
+        elif isinstance(self._value, bool):
+            body = "TRUE" if self._value else "FALSE"
+        elif isinstance(self._value, str):
+            body = "'" + self._value.replace("'", "''") + "'"
+        else:
+            body = str(self._value)
+        if self._as_name != "":
+            return f"{body} AS {self._as_name}"
+        return body
+
+
+class _UnaryOpExpr(ColumnExpr):
+    def __init__(self, op: str, col: ColumnExpr):
+        super().__init__()
+        self._op = op
+        self._col = col
+
+    @property
+    def op(self) -> str:
+        return self._op
+
+    @property
+    def col(self) -> ColumnExpr:
+        return self._col
+
+    def _copy(self) -> ColumnExpr:
+        return _UnaryOpExpr(self._op, self._col)
+
+    def infer_type(self, schema: Schema) -> Optional[pa.DataType]:
+        if self._as_type is not None:
+            return self._as_type
+        if self._op in ("IS_NULL", "NOT_NULL"):
+            return pa.bool_()
+        return self._col.infer_type(schema)
+
+    def _uuid_keys(self) -> List[Any]:
+        return [self._op, self._col.__uuid__()]
+
+    def __str__(self) -> str:
+        if self._op == "IS_NULL":
+            body = f"{self._col} IS NULL"
+        elif self._op == "NOT_NULL":
+            body = f"{self._col} IS NOT NULL"
+        elif self._op == "~":
+            body = f"(NOT {self._col})"
+        else:
+            body = f"{self._op}({self._col})"
+        if self._as_name != "":
+            return f"{body} AS {self._as_name}"
+        return body
+
+
+_COMPARISON_OPS = {"==", "!=", "<", "<=", ">", ">="}
+_LOGICAL_OPS = {"&", "|"}
+
+
+class _BinaryOpExpr(ColumnExpr):
+    def __init__(self, op: str, left: ColumnExpr, right: ColumnExpr):
+        super().__init__()
+        self._op = op
+        self._left = left
+        self._right = right
+
+    @property
+    def op(self) -> str:
+        return self._op
+
+    @property
+    def left(self) -> ColumnExpr:
+        return self._left
+
+    @property
+    def right(self) -> ColumnExpr:
+        return self._right
+
+    def _copy(self) -> ColumnExpr:
+        return _BinaryOpExpr(self._op, self._left, self._right)
+
+    def infer_type(self, schema: Schema) -> Optional[pa.DataType]:
+        if self._as_type is not None:
+            return self._as_type
+        if self._op in _COMPARISON_OPS or self._op in _LOGICAL_OPS:
+            return pa.bool_()
+        lt = self._left.infer_type(schema)
+        rt = self._right.infer_type(schema)
+        if lt is None or rt is None:
+            return None
+        return _promote(lt, rt, self._op)
+
+    def _uuid_keys(self) -> List[Any]:
+        return [self._op, self._left.__uuid__(), self._right.__uuid__()]
+
+    def __str__(self) -> str:
+        op = {"==": "=", "&": "AND", "|": "OR"}.get(self._op, self._op)
+        body = f"({self._left} {op} {self._right})"
+        if self._as_name != "":
+            return f"{body} AS {self._as_name}"
+        return body
+
+
+class _FuncExpr(ColumnExpr):
+    def __init__(
+        self,
+        func: str,
+        *args: Any,
+        arg_distinct: bool = False,
+        is_aggregation: bool = False,
+    ):
+        super().__init__()
+        self._func = func
+        self._args: List[ColumnExpr] = [_to_col(a) for a in args]
+        self._arg_distinct = arg_distinct
+        self._is_agg = is_aggregation
+
+    @property
+    def func(self) -> str:
+        return self._func
+
+    @property
+    def args(self) -> List[ColumnExpr]:
+        return self._args
+
+    @property
+    def arg_distinct(self) -> bool:
+        return self._arg_distinct
+
+    @property
+    def is_aggregation(self) -> bool:
+        return self._is_agg
+
+    def _copy(self) -> ColumnExpr:
+        return _FuncExpr(
+            self._func,
+            *self._args,
+            arg_distinct=self._arg_distinct,
+            is_aggregation=self._is_agg,
+        )
+
+    def infer_type(self, schema: Schema) -> Optional[pa.DataType]:
+        if self._as_type is not None:
+            return self._as_type
+        f = self._func.lower()
+        if f in ("count", "count_distinct"):
+            return pa.int64()
+        if f in ("avg", "mean"):
+            return pa.float64()
+        if f in ("min", "max", "sum", "first", "last") and len(self._args) == 1:
+            t = self._args[0].infer_type(schema)
+            if f == "sum" and t is not None and pa.types.is_integer(t):
+                return pa.int64()
+            return t
+        if f == "coalesce":
+            types = [a.infer_type(schema) for a in self._args]
+            types = [t for t in types if t is not None and not pa.types.is_null(t)]
+            return types[0] if types else None
+        return None
+
+    def _uuid_keys(self) -> List[Any]:
+        return [
+            self._func,
+            self._arg_distinct,
+            self._is_agg,
+            [a.__uuid__() for a in self._args],
+        ]
+
+    def __str__(self) -> str:
+        distinct = "DISTINCT " if self._arg_distinct else ""
+        body = f"{self._func.upper()}({distinct}{','.join(str(a) for a in self._args)})"
+        if self._as_type is not None:
+            from fugue_tpu.schema import type_to_expr
+
+            body = f"CAST({body} AS {type_to_expr(self._as_type)})"
+        if self._as_name != "":
+            return f"{body} AS {self._as_name}"
+        return body
+
+
+def _promote(lt: pa.DataType, rt: pa.DataType, op: str) -> Optional[pa.DataType]:
+    if op == "/":
+        return pa.float64()
+    if lt == rt:
+        return lt
+    numeric_rank = [pa.bool_(), pa.int8(), pa.int16(), pa.int32(), pa.int64(),
+                    pa.float16(), pa.float32(), pa.float64()]
+    if lt in numeric_rank and rt in numeric_rank:
+        return numeric_rank[max(numeric_rank.index(lt), numeric_rank.index(rt))]
+    if pa.types.is_string(lt) or pa.types.is_string(rt):
+        return pa.string()
+    return None
+
+
+# ---- public constructors --------------------------------------------------
+def col(obj: Union[str, ColumnExpr], alias: str = "") -> ColumnExpr:
+    """Reference a column by name (``col("*")`` is the wildcard)."""
+    if isinstance(obj, ColumnExpr):
+        return obj.alias(alias) if alias != "" else obj
+    if isinstance(obj, str):
+        res: ColumnExpr = _NamedColumnExpr(obj)
+        return res.alias(alias) if alias != "" else res
+    raise ValueError(f"invalid column reference {obj!r}")
+
+
+def lit(obj: Any, alias: str = "") -> ColumnExpr:
+    res: ColumnExpr = _LitColumnExpr(obj)
+    return res.alias(alias) if alias != "" else res
+
+
+def null() -> ColumnExpr:
+    return lit(None)
+
+
+def all_cols() -> ColumnExpr:
+    return col("*")
+
+
+def function(name: str, *args: Any, arg_distinct: bool = False) -> ColumnExpr:
+    """A generic (engine-interpreted) function call expression."""
+    return _FuncExpr(name, *args, arg_distinct=arg_distinct)

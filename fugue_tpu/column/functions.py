@@ -1,0 +1,68 @@
+"""Aggregation and scalar function constructors over the column algebra
+(reference fugue/column/functions.py:40-346)."""
+
+import builtins
+from typing import Any
+
+from fugue_tpu.column.expressions import ColumnExpr, _FuncExpr, _to_col
+from fugue_tpu.utils.assertion import assert_or_throw
+
+
+def _agg(name: str, col: Any, arg_distinct: bool = False) -> ColumnExpr:
+    return _FuncExpr(name, _to_col(col), arg_distinct=arg_distinct, is_aggregation=True)
+
+
+def min(col: Any) -> ColumnExpr:  # noqa: A001
+    return _agg("min", col)
+
+
+def max(col: Any) -> ColumnExpr:  # noqa: A001
+    return _agg("max", col)
+
+
+def sum(col: Any) -> ColumnExpr:  # noqa: A001
+    return _agg("sum", col)
+
+
+def avg(col: Any) -> ColumnExpr:
+    return _agg("avg", col)
+
+
+mean = avg
+
+
+def first(col: Any) -> ColumnExpr:
+    return _agg("first", col)
+
+
+def last(col: Any) -> ColumnExpr:
+    return _agg("last", col)
+
+
+def count(col: Any) -> ColumnExpr:
+    return _agg("count", col)
+
+
+def count_distinct(col: Any) -> ColumnExpr:
+    return _agg("count", col, arg_distinct=True)
+
+
+def coalesce(*args: Any) -> ColumnExpr:
+    assert_or_throw(len(args) > 0, ValueError("coalesce requires at least one arg"))
+    return _FuncExpr("coalesce", *[_to_col(a) for a in args])
+
+
+def is_agg(column: Any) -> bool:
+    """Whether the expression contains an aggregation at any level."""
+    if isinstance(column, _FuncExpr) and column.is_aggregation:
+        return True
+    if isinstance(column, ColumnExpr):
+        from fugue_tpu.column.expressions import _BinaryOpExpr, _UnaryOpExpr
+
+        if isinstance(column, _BinaryOpExpr):
+            return is_agg(column.left) or is_agg(column.right)
+        if isinstance(column, _UnaryOpExpr):
+            return is_agg(column.col)
+        if isinstance(column, _FuncExpr):
+            return builtins.any(is_agg(a) for a in column.args)
+    return False

@@ -1,0 +1,295 @@
+"""Evaluate the column algebra directly on pandas — the native engine's
+compute path for select/filter/assign/aggregate (replaces the reference's
+qpd-SQL-on-pandas dependency with a direct expression interpreter; SQL
+semantics: Kleene logic via pandas nullable booleans, nulls ignored by aggs).
+"""
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+from fugue_tpu.column.expressions import (
+    ColumnExpr,
+    _BinaryOpExpr,
+    _FuncExpr,
+    _LitColumnExpr,
+    _NamedColumnExpr,
+    _UnaryOpExpr,
+)
+from fugue_tpu.column.functions import is_agg
+from fugue_tpu.column.sql import SelectColumns
+from fugue_tpu.schema import Schema
+from fugue_tpu.utils.assertion import assert_or_throw
+
+
+def eval_expr(df: pd.DataFrame, expr: ColumnExpr) -> pd.Series:
+    """Evaluate a non-aggregation expression to a Series aligned with df."""
+    s = _eval(df, expr)
+    if expr.as_type is not None:
+        s = _cast_series(s, expr.as_type)
+    return s
+
+
+def _bool_series(s: pd.Series) -> pd.Series:
+    """To pandas nullable boolean (Kleene logic for &/|)."""
+    if s.dtype == "boolean":
+        return s
+    return s.astype("boolean")
+
+
+def _eval(df: pd.DataFrame, expr: ColumnExpr) -> pd.Series:
+    if isinstance(expr, _NamedColumnExpr):
+        assert_or_throw(not expr.wildcard, ValueError("can't evaluate wildcard"))
+        return df[expr.name]
+    if isinstance(expr, _LitColumnExpr):
+        v = expr.value
+        return pd.Series([v] * len(df), index=df.index)
+    if isinstance(expr, _UnaryOpExpr):
+        inner = _eval(df, expr.col)
+        if expr.op == "IS_NULL":
+            return inner.isna().astype("boolean")
+        if expr.op == "NOT_NULL":
+            return (~inner.isna()).astype("boolean")
+        if expr.op == "-":
+            return -inner
+        if expr.op == "~":
+            return ~_bool_series(inner)
+        raise NotImplementedError(f"unary op {expr.op}")
+    if isinstance(expr, _BinaryOpExpr):
+        left = _eval(df, expr.left)
+        right = _eval(df, expr.right)
+        op = expr.op
+        if op in ("&", "|"):
+            lb, rb = _bool_series(left), _bool_series(right)
+            return lb & rb if op == "&" else lb | rb
+        if op in ("==", "!=", "<", "<=", ">", ">="):
+            # SQL: comparison with NULL yields NULL
+            nulls = left.isna() | right.isna()
+            func = {
+                "==": lambda a, b: a == b,
+                "!=": lambda a, b: a != b,
+                "<": lambda a, b: a < b,
+                "<=": lambda a, b: a <= b,
+                ">": lambda a, b: a > b,
+                ">=": lambda a, b: a >= b,
+            }[op]
+            with np.errstate(invalid="ignore"):
+                res = func(left, right)
+            res = res.astype("boolean")
+            res[nulls] = pd.NA
+            return res
+        if op == "+":
+            return left + right
+        if op == "-":
+            return left - right
+        if op == "*":
+            return left * right
+        if op == "/":
+            return left.astype("float64") / right
+        raise NotImplementedError(f"binary op {op}")
+    if isinstance(expr, _FuncExpr) and not expr.is_aggregation:
+        f = expr.func.lower()
+        if f == "coalesce":
+            args = [_eval(df, a) for a in expr.args]
+            res = args[0]
+            for a in args[1:]:
+                res = res.combine_first(a)
+            return res
+        raise NotImplementedError(f"function {expr.func} not supported on pandas")
+    raise NotImplementedError(f"can't evaluate {expr}")
+
+
+def _cast_series(s: pd.Series, tp: pa.DataType) -> pd.Series:
+    from fugue_tpu.dataframe.arrow_utils import cast_table
+
+    arr = pa.Array.from_pandas(s)
+    table = pa.Table.from_arrays([arr], names=["_c"])
+    out = cast_table(table, Schema([pa.field("_c", tp)]))
+    return out.column(0).to_pandas()
+
+
+def eval_filter(df: pd.DataFrame, condition: ColumnExpr) -> pd.DataFrame:
+    assert_or_throw(not is_agg(condition), ValueError("WHERE can't aggregate"))
+    if len(df) == 0:
+        return df
+    mask = _bool_series(eval_expr(df, condition)).fillna(False).astype(bool)
+    return df[mask.to_numpy()]
+
+
+def eval_assign(df: pd.DataFrame, **columns: ColumnExpr) -> pd.DataFrame:
+    out = df.copy(deep=False)
+    for name, expr in columns.items():
+        assert_or_throw(not is_agg(expr), ValueError("assign can't aggregate"))
+        out[name] = eval_expr(df, expr) if len(df) > 0 else \
+            _empty_typed_series(expr, df)
+    return out
+
+def _empty_typed_series(expr: ColumnExpr, df: pd.DataFrame) -> pd.Series:
+    return pd.Series([], dtype=object)
+
+
+_AGG_FUNCS = {"min", "max", "sum", "avg", "mean", "count", "first", "last"}
+
+
+def _apply_agg(
+    grouped: Any, func: str, col: str, distinct: bool
+) -> pd.Series:
+    f = func.lower()
+    if f == "count":
+        if distinct:
+            return grouped[col].nunique(dropna=True)
+        return grouped[col].count()
+    if f in ("avg", "mean"):
+        return grouped[col].mean()
+    if f == "sum":
+        return grouped[col].sum(min_count=1)  # all-null -> NULL like SQL
+    if f == "min":
+        return grouped[col].min()
+    if f == "max":
+        return grouped[col].max()
+    if f == "first":
+        # .first() would skip nulls; we want the literal first row value
+        return grouped[col].agg(lambda s: s.iloc[0] if len(s) > 0 else None)
+    if f == "last":
+        return grouped[col].agg(lambda s: s.iloc[-1] if len(s) > 0 else None)
+    raise NotImplementedError(f"aggregation {func} not supported")
+
+
+def _global_agg(df: pd.DataFrame, func: str, col: str, distinct: bool) -> Any:
+    f = func.lower()
+    s = df[col]
+    if f == "count":
+        return s.nunique(dropna=True) if distinct else s.count()
+    if f in ("avg", "mean"):
+        return s.mean()
+    if f == "sum":
+        return s.sum(min_count=1)
+    if f == "min":
+        return s.min()
+    if f == "max":
+        return s.max()
+    if f == "first":
+        return s.iloc[0] if len(s) > 0 else None
+    if f == "last":
+        return s.iloc[-1] if len(s) > 0 else None
+    raise NotImplementedError(f"aggregation {func} not supported")
+
+
+def eval_aggregate(
+    df: pd.DataFrame,
+    group_names: List[str],
+    aggs: Dict[str, ColumnExpr],
+) -> pd.DataFrame:
+    """Group by ``group_names`` (empty = global) and compute named
+    aggregations. Each agg expression must be a single aggregation function
+    whose argument is any non-agg expression."""
+    work = df.copy(deep=False)
+    plans: List[Tuple[str, str, str, bool]] = []  # (out_name, func, tmp_col, distinct)
+    for i, (out_name, expr) in enumerate(aggs.items()):
+        assert_or_throw(
+            isinstance(expr, _FuncExpr) and expr.is_aggregation and len(expr.args) == 1,
+            ValueError(f"{expr} is not a simple aggregation"),
+        )
+        arg = expr.args[0]
+        tmp = f"_agg_arg_{i}"
+        if isinstance(arg, _NamedColumnExpr) and arg.wildcard:
+            # count(*): count rows — use a constant column
+            work[tmp] = 1
+        else:
+            work[tmp] = eval_expr(df, arg) if len(df) > 0 else None
+        plans.append((out_name, expr.func, tmp, expr.arg_distinct))
+    if len(group_names) == 0:
+        data = {
+            out: [_global_agg(work, func, tmp, distinct)]
+            for out, func, tmp, distinct in plans
+        }
+        return pd.DataFrame(data)
+    grouped = work.groupby(group_names, dropna=False, sort=False)
+    pieces = {
+        out: _apply_agg(grouped, func, tmp, distinct)
+        for out, func, tmp, distinct in plans
+    }
+    res = pd.DataFrame(pieces)
+    return res.reset_index()
+
+
+def _rewrite_having(
+    expr: ColumnExpr,
+    computed: Dict[str, str],
+    extra: Dict[str, ColumnExpr],
+) -> ColumnExpr:
+    """Replace aggregation subtrees with references to aggregated columns."""
+    from fugue_tpu.column.expressions import col as _col
+
+    if isinstance(expr, _FuncExpr) and expr.is_aggregation:
+        key = expr.alias("").__uuid__()
+        if key in computed:
+            return _col(computed[key])
+        name = f"_having_{len(extra)}"
+        extra[name] = expr.alias(name)
+        computed[key] = name
+        return _col(name)
+    if isinstance(expr, _BinaryOpExpr):
+        return _BinaryOpExpr(
+            expr.op,
+            _rewrite_having(expr.left, computed, extra),
+            _rewrite_having(expr.right, computed, extra),
+        )
+    if isinstance(expr, _UnaryOpExpr):
+        return _UnaryOpExpr(expr.op, _rewrite_having(expr.col, computed, extra))
+    return expr
+
+
+def eval_select(
+    df: pd.DataFrame,
+    columns: SelectColumns,
+    where: Optional[ColumnExpr] = None,
+    having: Optional[ColumnExpr] = None,
+) -> pd.DataFrame:
+    """Full SELECT semantics on pandas: WHERE -> projection/aggregation ->
+    HAVING -> DISTINCT."""
+    # wildcard expansion only needs column NAMES; declare string to avoid an
+    # O(rows*cols) arrow conversion here
+    cols = columns.replace_wildcard(
+        Schema([pa.field(str(c), pa.string()) for c in df.columns])
+    ).assert_all_with_names()
+    if where is not None:
+        df = eval_filter(df, where)
+    if not cols.has_agg:
+        out = pd.DataFrame(
+            {
+                c.output_name: (eval_expr(df, c) if len(df) > 0 else
+                                pd.Series([], dtype=object))
+                for c in cols.all_cols
+            }
+        )
+        if cols.is_distinct:
+            out = out.drop_duplicates()
+        return out.reset_index(drop=True)
+    # aggregation path: group keys are the non-agg output columns
+    key_names: List[str] = []
+    work = df.copy(deep=False)
+    for k in cols.group_keys:
+        name = k.output_name
+        work[name] = eval_expr(df, k) if len(df) > 0 else None
+        key_names.append(name)
+    aggs = {c.output_name: c for c in cols.agg_funcs}
+    having_rewritten: Optional[ColumnExpr] = None
+    if having is not None:
+        # HAVING refers to aggregations: rewrite agg subtrees into column refs
+        # over the aggregated output, computing hidden agg columns as needed
+        # key by alias-stripped uuid so HAVING's bare agg nodes match
+        computed = {c.alias("").__uuid__(): c.output_name for c in cols.agg_funcs}
+        extra: Dict[str, ColumnExpr] = {}
+        having_rewritten = _rewrite_having(having, computed, extra)
+        aggs = dict(aggs, **extra)
+    res = eval_aggregate(work, key_names, aggs)
+    if having_rewritten is not None:
+        res = eval_filter(res, having_rewritten)
+    # order columns as requested
+    res = res[[c.output_name for c in cols.all_cols]]
+    if cols.is_distinct:
+        res = res.drop_duplicates()
+    return res.reset_index(drop=True)

@@ -1,0 +1,248 @@
+"""SelectColumns validation + SQL text generation from the column algebra
+(reference fugue/column/sql.py:38,233)."""
+
+from typing import Any, Callable, Iterable, List, Optional
+
+import pyarrow as pa
+
+from fugue_tpu.column.expressions import (
+    ColumnExpr,
+    _BinaryOpExpr,
+    _FuncExpr,
+    _LitColumnExpr,
+    _NamedColumnExpr,
+    _UnaryOpExpr,
+)
+from fugue_tpu.column.functions import is_agg
+from fugue_tpu.schema import Schema, type_to_expr
+from fugue_tpu.utils.assertion import assert_or_throw
+from fugue_tpu.utils.hash import to_uuid
+
+
+class SelectColumns:
+    """A validated projection list (possibly with aggregations)."""
+
+    def __init__(self, *cols: ColumnExpr, arg_distinct: bool = False):
+        self._cols = list(cols)
+        self._distinct = arg_distinct
+        assert_or_throw(len(self._cols) > 0, ValueError("empty select"))
+        self._agg = [c for c in self._cols if is_agg(c)]
+        self._non_agg = [c for c in self._cols if not is_agg(c)]
+        if self.has_agg:
+            assert_or_throw(
+                not any(
+                    isinstance(c, _NamedColumnExpr) and c.wildcard
+                    for c in self._cols
+                ),
+                ValueError("wildcard can't be used with aggregations"),
+            )
+
+    @property
+    def is_distinct(self) -> bool:
+        return self._distinct
+
+    def distinct(self) -> "SelectColumns":
+        return SelectColumns(*self._cols, arg_distinct=True)
+
+    @property
+    def all_cols(self) -> List[ColumnExpr]:
+        return self._cols
+
+    @property
+    def has_agg(self) -> bool:
+        return len(self._agg) > 0
+
+    @property
+    def agg_funcs(self) -> List[ColumnExpr]:
+        return self._agg
+
+    @property
+    def group_keys(self) -> List[ColumnExpr]:
+        """Non-aggregation expressions = implicit GROUP BY keys."""
+        return self._non_agg
+
+    @property
+    def simple(self) -> bool:
+        """All plain column references (no computation)."""
+        return all(
+            isinstance(c, _NamedColumnExpr) and c.as_type is None for c in self._cols
+        )
+
+    def assert_all_with_names(self) -> "SelectColumns":
+        names: List[str] = []
+        for c in self._cols:
+            if isinstance(c, _NamedColumnExpr) and c.wildcard and c.as_name == "":
+                continue
+            name = c.output_name
+            assert_or_throw(name != "", ValueError(f"{c} has no output name"))
+            names.append(name)
+        assert_or_throw(
+            len(set(names)) == len(names),
+            ValueError(f"duplicated output names in {names}"),
+        )
+        return self
+
+    def assert_no_wildcard(self) -> "SelectColumns":
+        assert_or_throw(
+            not any(
+                isinstance(c, _NamedColumnExpr) and c.wildcard for c in self._cols
+            ),
+            ValueError("wildcard not allowed here"),
+        )
+        return self
+
+    def assert_no_agg(self) -> "SelectColumns":
+        assert_or_throw(not self.has_agg, ValueError("aggregation not allowed here"))
+        return self
+
+    def replace_wildcard(self, schema: Schema) -> "SelectColumns":
+        cols: List[ColumnExpr] = []
+        for c in self._cols:
+            if isinstance(c, _NamedColumnExpr) and c.wildcard and c.as_name == "":
+                explicit = set(
+                    x.output_name for x in self._cols
+                    if not (isinstance(x, _NamedColumnExpr) and x.wildcard)
+                )
+                for n in schema.names:
+                    if n not in explicit:
+                        cols.append(_NamedColumnExpr(n))
+            else:
+                cols.append(c)
+        return SelectColumns(*cols, arg_distinct=self._distinct)
+
+    def infer_schema(self, schema: Schema) -> Schema:
+        resolved = self.replace_wildcard(schema).assert_all_with_names()
+        return Schema([c.infer_schema_field(schema) for c in resolved.all_cols])
+
+    def __uuid__(self) -> str:
+        return to_uuid([c.__uuid__() for c in self._cols], self._distinct)
+
+
+class SQLExpressionGenerator:
+    """Render expressions / SELECT statements as SQL text for engines with a
+    SQL surface. ``enable_cast=False`` lets engines that handle typing
+    themselves skip CAST generation."""
+
+    def __init__(self, enable_cast: bool = True):
+        self._enable_cast = enable_cast
+        self._func_handlers: dict = {}
+
+    def add_func_handler(
+        self, name: str, handler: Callable[["_FuncExpr"], str]
+    ) -> "SQLExpressionGenerator":
+        self._func_handlers[name.lower()] = handler
+        return self
+
+    def generate(self, expr: ColumnExpr) -> str:
+        """Expression (without alias) to SQL text."""
+        return self._gen(expr, with_alias=False)
+
+    def generate_select_expr(self, expr: ColumnExpr) -> str:
+        return self._gen(expr, with_alias=True)
+
+    def select(
+        self,
+        columns: SelectColumns,
+        table: str,
+        where: Optional[ColumnExpr] = None,
+        having: Optional[ColumnExpr] = None,
+    ) -> str:
+        columns.assert_all_with_names()
+        distinct = "DISTINCT " if columns.is_distinct else ""
+        proj = ", ".join(self.generate_select_expr(c) for c in columns.all_cols)
+        sql = f"SELECT {distinct}{proj} FROM {table}"
+        if where is not None:
+            sql += f" WHERE {self.generate(where)}"
+        if columns.has_agg and len(columns.group_keys) > 0:
+            keys = ", ".join(self._gen(k, with_alias=False) for k in columns.group_keys)
+            sql += f" GROUP BY {keys}"
+        if having is not None:
+            assert_or_throw(
+                columns.has_agg, ValueError("HAVING requires aggregation")
+            )
+            sql += f" HAVING {self.generate(having)}"
+        return sql
+
+    def where(self, condition: ColumnExpr, table: str) -> str:
+        assert_or_throw(
+            not is_agg(condition), ValueError("WHERE can't contain aggregation")
+        )
+        return f"SELECT * FROM {table} WHERE {self.generate(condition)}"
+
+    def _gen(self, expr: ColumnExpr, with_alias: bool) -> str:
+        body = self._gen_body(expr)
+        if self._enable_cast and expr.as_type is not None:
+            body = f"CAST({body} AS {self.type_to_sql(expr.as_type)})"
+        if with_alias and expr.as_name != "":
+            body = f"{body} AS {expr.as_name}"
+        elif with_alias and expr.name == "" and expr.output_name == "":
+            pass
+        return body
+
+    def _gen_body(self, expr: ColumnExpr) -> str:
+        if isinstance(expr, _NamedColumnExpr):
+            return expr.name
+        if isinstance(expr, _LitColumnExpr):
+            v = expr.value
+            if v is None:
+                return "NULL"
+            if isinstance(v, bool):
+                return "TRUE" if v else "FALSE"
+            if isinstance(v, str):
+                return "'" + v.replace("'", "''") + "'"
+            return str(v)
+        if isinstance(expr, _UnaryOpExpr):
+            inner = self._gen(expr.col, with_alias=False)
+            if expr.op == "IS_NULL":
+                return f"({inner} IS NULL)"
+            if expr.op == "NOT_NULL":
+                return f"({inner} IS NOT NULL)"
+            if expr.op == "~":
+                return f"(NOT {inner})"
+            return f"({expr.op}{inner})"
+        if isinstance(expr, _BinaryOpExpr):
+            op = {"==": "=", "&": "AND", "|": "OR"}.get(expr.op, expr.op)
+            left = self._gen(expr.left, with_alias=False)
+            right = self._gen(expr.right, with_alias=False)
+            # SQL null-safe: = NULL must become IS NULL
+            if isinstance(expr.right, _LitColumnExpr) and expr.right.value is None:
+                if expr.op == "==":
+                    return f"({left} IS NULL)"
+                if expr.op == "!=":
+                    return f"({left} IS NOT NULL)"
+            return f"({left} {op} {right})"
+        if isinstance(expr, _FuncExpr):
+            handler = self._func_handlers.get(expr.func.lower())
+            if handler is not None:
+                return handler(expr)
+            distinct = "DISTINCT " if expr.arg_distinct else ""
+            args = ", ".join(self._gen(a, with_alias=False) for a in expr.args)
+            return f"{expr.func.upper()}({distinct}{args})"
+        raise NotImplementedError(f"can't generate SQL for {expr}")
+
+    def type_to_sql(self, tp: pa.DataType) -> str:
+        if pa.types.is_int64(tp):
+            return "BIGINT"
+        if pa.types.is_int32(tp):
+            return "INT"
+        if pa.types.is_int16(tp):
+            return "SMALLINT"
+        if pa.types.is_int8(tp):
+            return "TINYINT"
+        if pa.types.is_float64(tp):
+            return "DOUBLE"
+        if pa.types.is_float32(tp):
+            return "FLOAT"
+        if pa.types.is_string(tp):
+            return "VARCHAR"
+        if pa.types.is_boolean(tp):
+            return "BOOLEAN"
+        if pa.types.is_timestamp(tp):
+            return "TIMESTAMP"
+        if pa.types.is_date(tp):
+            return "DATE"
+        if pa.types.is_binary(tp):
+            return "BINARY"
+        if pa.types.is_decimal(tp):
+            return f"DECIMAL({tp.precision},{tp.scale})"
+        return type_to_expr(tp).upper()

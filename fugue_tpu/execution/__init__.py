@@ -1,0 +1,27 @@
+from fugue_tpu.execution.execution_engine import (
+    AnyDataFrame,
+    EngineFacet,
+    ExecutionEngine,
+    MapEngine,
+    SQLEngine,
+)
+from fugue_tpu.execution.native_execution_engine import (
+    NativeExecutionEngine,
+    PandasMapEngine,
+    PandasSQLEngine,
+)
+from fugue_tpu.execution.factory import (
+    make_execution_engine,
+    make_sql_engine,
+    register_default_execution_engine,
+    register_default_sql_engine,
+    register_execution_engine,
+    register_sql_engine,
+)
+from fugue_tpu.execution.api import (
+    clear_global_engine,
+    engine_context,
+    get_context_engine,
+    get_current_parallelism,
+    set_global_engine,
+)

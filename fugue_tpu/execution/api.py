@@ -1,0 +1,363 @@
+"""Eager functional engine API (reference fugue/execution/api.py): context
+managers + one-shot engine ops over any dataframe-like input."""
+
+from contextlib import contextmanager
+from typing import Any, Callable, Iterator, List, Optional, Union
+
+from fugue_tpu.collections.partition import PartitionSpec
+from fugue_tpu.column.expressions import ColumnExpr
+from fugue_tpu.column.sql import SelectColumns
+from fugue_tpu.dataframe import DataFrame
+from fugue_tpu.dataframe.api import as_fugue_df, get_native_as_df
+from fugue_tpu.execution.execution_engine import (
+    _GLOBAL_ENGINE,
+    ExecutionEngine,
+)
+from fugue_tpu.execution.factory import make_execution_engine, try_get_context_engine
+from fugue_tpu.utils.assertion import assert_or_throw
+
+AnyDataFrame = Any
+
+
+@contextmanager
+def engine_context(
+    engine: Any = None, conf: Any = None, infer_by: Optional[List[Any]] = None
+) -> Iterator[ExecutionEngine]:
+    """``with engine_context("jax"):`` — all fugue_tpu calls inside use this
+    engine by default."""
+    e = make_execution_engine(engine, conf, infer_by)
+    e.as_context()
+    try:
+        yield e
+    finally:
+        e.stop_context()
+
+
+def set_global_engine(engine: Any = None, conf: Any = None) -> ExecutionEngine:
+    assert_or_throw(engine is not None, ValueError("engine can't be None"))
+    return make_execution_engine(engine, conf).set_global()
+
+
+def clear_global_engine() -> None:
+    old = _GLOBAL_ENGINE[0]
+    if old is not None:
+        old.unset_global()
+        if not old.in_context:
+            old.stop()
+
+
+def get_context_engine() -> ExecutionEngine:
+    engine = try_get_context_engine()
+    assert_or_throw(engine is not None, ValueError("no contextual/global engine"))
+    return engine  # type: ignore
+
+
+def get_current_parallelism(engine: Any = None, conf: Any = None) -> int:
+    return make_execution_engine(engine, conf).get_current_parallelism()
+
+
+def get_current_conf() -> Any:
+    engine = try_get_context_engine()
+    if engine is not None:
+        return engine.conf
+    from fugue_tpu.constants import FUGUE_GLOBAL_CONF
+
+    return FUGUE_GLOBAL_CONF
+
+
+def run_engine_function(
+    func: Callable[[ExecutionEngine], DataFrame],
+    engine: Any = None,
+    engine_conf: Any = None,
+    as_fugue: bool = False,
+    as_local: bool = False,
+    infer_by: Optional[List[Any]] = None,
+) -> Any:
+    """Resolve engine, run ``func(engine)`` inside its context, adapt result."""
+    e = make_execution_engine(engine, engine_conf, infer_by)
+    e.as_context()
+    try:
+        res = func(e)
+        if as_local:
+            res = res.as_local()
+        if as_fugue:
+            return res
+        return res.native if res.is_local else get_native_as_df(res)
+    finally:
+        e.stop_context()
+
+
+def _to_engine_df(engine: ExecutionEngine, df: AnyDataFrame) -> DataFrame:
+    if isinstance(df, DataFrame):
+        return engine.to_df(df)
+    return engine.to_df(as_fugue_df(df))
+
+
+def repartition(
+    df: AnyDataFrame,
+    partition: Any,
+    engine: Any = None,
+    engine_conf: Any = None,
+    as_fugue: bool = False,
+) -> AnyDataFrame:
+    return run_engine_function(
+        lambda e: e.repartition(_to_engine_df(e, df), PartitionSpec(partition)),
+        engine, engine_conf, as_fugue, infer_by=[df],
+    )
+
+
+def broadcast(
+    df: AnyDataFrame, engine: Any = None, engine_conf: Any = None,
+    as_fugue: bool = False,
+) -> AnyDataFrame:
+    return run_engine_function(
+        lambda e: e.broadcast(_to_engine_df(e, df)),
+        engine, engine_conf, as_fugue, infer_by=[df],
+    )
+
+
+def persist(
+    df: AnyDataFrame, lazy: bool = False, engine: Any = None,
+    engine_conf: Any = None, as_fugue: bool = False, **kwargs: Any,
+) -> AnyDataFrame:
+    return run_engine_function(
+        lambda e: e.persist(_to_engine_df(e, df), lazy=lazy, **kwargs),
+        engine, engine_conf, as_fugue, infer_by=[df],
+    )
+
+
+def join(
+    df1: AnyDataFrame,
+    df2: AnyDataFrame,
+    *dfs: AnyDataFrame,
+    how: str,
+    on: Optional[List[str]] = None,
+    engine: Any = None,
+    engine_conf: Any = None,
+    as_fugue: bool = False,
+) -> AnyDataFrame:
+    def _join(e: ExecutionEngine) -> DataFrame:
+        res = e.join(_to_engine_df(e, df1), _to_engine_df(e, df2), how=how, on=on)
+        for df in dfs:
+            res = e.join(res, _to_engine_df(e, df), how=how, on=on)
+        return res
+
+    return run_engine_function(
+        _join, engine, engine_conf, as_fugue, infer_by=[df1, df2, *dfs]
+    )
+
+
+def _make_join(how: str) -> Callable:
+    def _join(
+        df1: AnyDataFrame, df2: AnyDataFrame, *dfs: AnyDataFrame,
+        on: Optional[List[str]] = None,
+        engine: Any = None, engine_conf: Any = None, as_fugue: bool = False,
+    ) -> AnyDataFrame:
+        return join(df1, df2, *dfs, how=how, on=on, engine=engine,
+                    engine_conf=engine_conf, as_fugue=as_fugue)
+
+    _join.__name__ = how.replace(" ", "_") + "_join"
+    return _join
+
+
+inner_join = _make_join("inner")
+semi_join = _make_join("semi")
+anti_join = _make_join("anti")
+left_outer_join = _make_join("left_outer")
+right_outer_join = _make_join("right_outer")
+full_outer_join = _make_join("full_outer")
+cross_join = _make_join("cross")
+
+
+def union(
+    df1: AnyDataFrame, df2: AnyDataFrame, *dfs: AnyDataFrame,
+    distinct: bool = True,
+    engine: Any = None, engine_conf: Any = None, as_fugue: bool = False,
+) -> AnyDataFrame:
+    def _union(e: ExecutionEngine) -> DataFrame:
+        res = e.union(_to_engine_df(e, df1), _to_engine_df(e, df2), distinct=distinct)
+        for df in dfs:
+            res = e.union(res, _to_engine_df(e, df), distinct=distinct)
+        return res
+
+    return run_engine_function(
+        _union, engine, engine_conf, as_fugue, infer_by=[df1, df2, *dfs]
+    )
+
+
+def subtract(
+    df1: AnyDataFrame, df2: AnyDataFrame, *dfs: AnyDataFrame,
+    distinct: bool = True,
+    engine: Any = None, engine_conf: Any = None, as_fugue: bool = False,
+) -> AnyDataFrame:
+    def _subtract(e: ExecutionEngine) -> DataFrame:
+        res = e.subtract(_to_engine_df(e, df1), _to_engine_df(e, df2), distinct=distinct)
+        for df in dfs:
+            res = e.subtract(res, _to_engine_df(e, df), distinct=distinct)
+        return res
+
+    return run_engine_function(
+        _subtract, engine, engine_conf, as_fugue, infer_by=[df1, df2, *dfs]
+    )
+
+
+def intersect(
+    df1: AnyDataFrame, df2: AnyDataFrame, *dfs: AnyDataFrame,
+    distinct: bool = True,
+    engine: Any = None, engine_conf: Any = None, as_fugue: bool = False,
+) -> AnyDataFrame:
+    def _intersect(e: ExecutionEngine) -> DataFrame:
+        res = e.intersect(_to_engine_df(e, df1), _to_engine_df(e, df2),
+                          distinct=distinct)
+        for df in dfs:
+            res = e.intersect(res, _to_engine_df(e, df), distinct=distinct)
+        return res
+
+    return run_engine_function(
+        _intersect, engine, engine_conf, as_fugue, infer_by=[df1, df2, *dfs]
+    )
+
+
+def distinct(
+    df: AnyDataFrame, engine: Any = None, engine_conf: Any = None,
+    as_fugue: bool = False,
+) -> AnyDataFrame:
+    return run_engine_function(
+        lambda e: e.distinct(_to_engine_df(e, df)),
+        engine, engine_conf, as_fugue, infer_by=[df],
+    )
+
+
+def dropna(
+    df: AnyDataFrame, how: str = "any", thresh: Optional[int] = None,
+    subset: Optional[List[str]] = None,
+    engine: Any = None, engine_conf: Any = None, as_fugue: bool = False,
+) -> AnyDataFrame:
+    return run_engine_function(
+        lambda e: e.dropna(_to_engine_df(e, df), how=how, thresh=thresh, subset=subset),
+        engine, engine_conf, as_fugue, infer_by=[df],
+    )
+
+
+def fillna(
+    df: AnyDataFrame, value: Any, subset: Optional[List[str]] = None,
+    engine: Any = None, engine_conf: Any = None, as_fugue: bool = False,
+) -> AnyDataFrame:
+    return run_engine_function(
+        lambda e: e.fillna(_to_engine_df(e, df), value=value, subset=subset),
+        engine, engine_conf, as_fugue, infer_by=[df],
+    )
+
+
+def sample(
+    df: AnyDataFrame, n: Optional[int] = None, frac: Optional[float] = None,
+    replace: bool = False, seed: Optional[int] = None,
+    engine: Any = None, engine_conf: Any = None, as_fugue: bool = False,
+) -> AnyDataFrame:
+    return run_engine_function(
+        lambda e: e.sample(_to_engine_df(e, df), n=n, frac=frac, replace=replace,
+                           seed=seed),
+        engine, engine_conf, as_fugue, infer_by=[df],
+    )
+
+
+def take(
+    df: AnyDataFrame, n: int, presort: str = "", na_position: str = "last",
+    partition: Any = None,
+    engine: Any = None, engine_conf: Any = None, as_fugue: bool = False,
+) -> AnyDataFrame:
+    return run_engine_function(
+        lambda e: e.take(
+            _to_engine_df(e, df), n=n, presort=presort, na_position=na_position,
+            partition_spec=None if partition is None else PartitionSpec(partition),
+        ),
+        engine, engine_conf, as_fugue, infer_by=[df],
+    )
+
+
+def load(
+    path: Union[str, List[str]], format_hint: Any = None, columns: Any = None,
+    engine: Any = None, engine_conf: Any = None, as_fugue: bool = False,
+    **kwargs: Any,
+) -> AnyDataFrame:
+    return run_engine_function(
+        lambda e: e.load_df(path, format_hint=format_hint, columns=columns, **kwargs),
+        engine, engine_conf, as_fugue,
+    )
+
+
+def save(
+    df: AnyDataFrame, path: str, format_hint: Any = None, mode: str = "overwrite",
+    partition: Any = None, force_single: bool = False,
+    engine: Any = None, engine_conf: Any = None, **kwargs: Any,
+) -> None:
+    e = make_execution_engine(engine, engine_conf, infer_by=[df])
+    e.as_context()
+    try:
+        e.save_df(
+            _to_engine_df(e, df), path, format_hint=format_hint, mode=mode,
+            partition_spec=None if partition is None else PartitionSpec(partition),
+            force_single=force_single, **kwargs,
+        )
+    finally:
+        e.stop_context()
+
+
+def select(
+    df: AnyDataFrame, *columns: Union[str, ColumnExpr],
+    where: Optional[ColumnExpr] = None, having: Optional[ColumnExpr] = None,
+    distinct: bool = False,
+    engine: Any = None, engine_conf: Any = None, as_fugue: bool = False,
+) -> AnyDataFrame:
+    from fugue_tpu.column.expressions import col as _col
+
+    cols = SelectColumns(
+        *[_col(c) if isinstance(c, str) else c for c in columns],
+        arg_distinct=distinct,
+    )
+    return run_engine_function(
+        lambda e: e.select(_to_engine_df(e, df), cols, where=where, having=having),
+        engine, engine_conf, as_fugue, infer_by=[df],
+    )
+
+
+def filter(  # noqa: A001
+    df: AnyDataFrame, condition: ColumnExpr,
+    engine: Any = None, engine_conf: Any = None, as_fugue: bool = False,
+) -> AnyDataFrame:
+    return run_engine_function(
+        lambda e: e.filter(_to_engine_df(e, df), condition),
+        engine, engine_conf, as_fugue, infer_by=[df],
+    )
+
+
+def assign(
+    df: AnyDataFrame,
+    engine: Any = None, engine_conf: Any = None, as_fugue: bool = False,
+    **columns: Any,
+) -> AnyDataFrame:
+    from fugue_tpu.column.expressions import lit
+
+    cols = [
+        (v if isinstance(v, ColumnExpr) else lit(v)).alias(k)
+        for k, v in columns.items()
+    ]
+    return run_engine_function(
+        lambda e: e.assign(_to_engine_df(e, df), cols),
+        engine, engine_conf, as_fugue, infer_by=[df],
+    )
+
+
+def aggregate(
+    df: AnyDataFrame, partition_by: Any = None,
+    engine: Any = None, engine_conf: Any = None, as_fugue: bool = False,
+    **agg_kwcols: ColumnExpr,
+) -> AnyDataFrame:
+    cols = [v.alias(k) for k, v in agg_kwcols.items()]
+    spec = None if partition_by is None else PartitionSpec(by=(
+        [partition_by] if isinstance(partition_by, str) else list(partition_by)
+    ))
+    return run_engine_function(
+        lambda e: e.aggregate(_to_engine_df(e, df), spec, cols),
+        engine, engine_conf, as_fugue, infer_by=[df],
+    )

@@ -1,0 +1,666 @@
+"""ExecutionEngine: THE backend contract, with MapEngine and SQLEngine facets.
+
+Parity target: reference ``fugue/execution/execution_engine.py:339`` (engine
+abstract ops :480-1181, MapEngine :278-316, SQLEngine :184-275, zip/comap
+:969-1118, serialize-by-partition :1221-1360) — re-designed: the co-partition
+(zip/comap) data plane carries arrow-IPC blobs instead of pickled pandas, and
+``select/filter/assign/aggregate`` have engine-overridable defaults instead of
+being hard-wired through a SQL engine.
+"""
+
+import logging
+from abc import ABC, abstractmethod
+from contextlib import contextmanager
+from contextvars import ContextVar
+from threading import RLock
+from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+from uuid import uuid4
+
+from fugue_tpu.collections.partition import PartitionCursor, PartitionSpec
+from fugue_tpu.collections.sql import StructuredRawSQL
+from fugue_tpu.collections.yielded import PhysicalYielded, Yielded
+from fugue_tpu.column.expressions import ColumnExpr
+from fugue_tpu.column.sql import SelectColumns
+from fugue_tpu.constants import FUGUE_GLOBAL_CONF
+from fugue_tpu.dataframe import (
+    ArrayDataFrame,
+    DataFrame,
+    DataFrames,
+    LocalDataFrame,
+)
+from fugue_tpu.dataframe.utils import deserialize_df, serialize_df
+from fugue_tpu.schema import Schema
+from fugue_tpu.utils.assertion import assert_or_throw
+from fugue_tpu.utils.params import ParamDict
+
+AnyDataFrame = Any
+
+_FUGUE_SER_KEY = "_fugue_ser_data"
+_FUGUE_SER_NO = "_fugue_ser_no"
+_ZIP_SCHEMAS_META = "serialized_schemas"
+_ZIP_NAMES_META = "serialized_names"
+_ZIP_HOW_META = "serialized_how"
+
+_CONTEXT_ENGINE: ContextVar[Optional["ExecutionEngine"]] = ContextVar(
+    "fugue_tpu_engine", default=None
+)
+_GLOBAL_LOCK = RLock()
+_GLOBAL_ENGINE: List[Optional["ExecutionEngine"]] = [None]
+
+
+class FugueEngineBase(ABC):
+    @property
+    @abstractmethod
+    def is_distributed(self) -> bool:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    @property
+    def log(self) -> logging.Logger:
+        return logging.getLogger(type(self).__name__)
+
+    @property
+    @abstractmethod
+    def conf(self) -> ParamDict:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    @abstractmethod
+    def to_df(self, df: AnyDataFrame, schema: Any = None) -> DataFrame:
+        """Convert an arbitrary acceptable object to this engine's DataFrame."""
+        raise NotImplementedError  # pragma: no cover
+
+
+class EngineFacet(FugueEngineBase):
+    """A sub-engine sharing its parent's config/log (MapEngine, SQLEngine)."""
+
+    def __init__(self, execution_engine: "ExecutionEngine"):
+        self._execution_engine = execution_engine
+
+    @property
+    def execution_engine(self) -> "ExecutionEngine":
+        return self._execution_engine
+
+    @property
+    def conf(self) -> ParamDict:
+        return self._execution_engine.conf
+
+    @property
+    def log(self) -> logging.Logger:
+        return self._execution_engine.log
+
+    def to_df(self, df: AnyDataFrame, schema: Any = None) -> DataFrame:
+        return self._execution_engine.to_df(df, schema)
+
+
+class MapEngine(EngineFacet):
+    """The single primitive every parallel op lowers to (reference
+    execution_engine.py:278-316): apply ``map_func(cursor, local_df)`` to each
+    logical partition."""
+
+    @abstractmethod
+    def map_dataframe(
+        self,
+        df: DataFrame,
+        map_func: Callable[[PartitionCursor, LocalDataFrame], LocalDataFrame],
+        output_schema: Any,
+        partition_spec: PartitionSpec,
+        on_init: Optional[Callable[[int, DataFrame], Any]] = None,
+        map_func_format_hint: Optional[str] = None,
+    ) -> DataFrame:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def map_bag(
+        self,
+        bag: Any,
+        map_func: Callable,
+        partition_spec: PartitionSpec,
+        on_init: Optional[Callable] = None,
+    ) -> Any:
+        raise NotImplementedError(f"map_bag not supported by {type(self)}")
+
+
+class SQLEngine(EngineFacet):
+    """SQL facet: execute a raw SELECT over named dataframes (reference
+    execution_engine.py:184-275)."""
+
+    @property
+    def dialect(self) -> Optional[str]:
+        return None
+
+    @abstractmethod
+    def select(self, dfs: DataFrames, statement: StructuredRawSQL) -> DataFrame:
+        raise NotImplementedError  # pragma: no cover
+
+    def table_exists(self, table: str) -> bool:
+        raise NotImplementedError(f"{type(self)} doesn't support tables")
+
+    def save_table(
+        self,
+        df: DataFrame,
+        table: str,
+        mode: str = "overwrite",
+        partition_spec: Optional[PartitionSpec] = None,
+        **kwargs: Any,
+    ) -> None:
+        raise NotImplementedError(f"{type(self)} doesn't support tables")
+
+    def load_table(self, table: str, **kwargs: Any) -> DataFrame:
+        raise NotImplementedError(f"{type(self)} doesn't support tables")
+
+    def encode_name(self, name: str) -> str:
+        return name
+
+
+class ExecutionEngine(FugueEngineBase):
+    """The backend contract (reference execution_engine.py:339). Subclasses
+    implement the abstract primitives; relational composites, the co-partition
+    plane (zip/comap) and column-algebra ops have engine-agnostic defaults."""
+
+    def __init__(self, conf: Any = None):
+        self._conf = ParamDict(FUGUE_GLOBAL_CONF)
+        self._conf.update(ParamDict(conf))
+        self._map_engine: Optional[MapEngine] = None
+        self._sql_engine: Optional[SQLEngine] = None
+        self._in_context_count = 0
+        self._is_global = False
+        self._ctx_tokens: List[Any] = []
+        self._stop_lock = RLock()
+        self._stopped = False
+
+    # ---- lifecycle & context (reference :363-447) -----------------------
+    @property
+    def in_context(self) -> bool:
+        return self._in_context_count > 0
+
+    @property
+    def is_global(self) -> bool:
+        return self._is_global
+
+    def as_context(self) -> "ExecutionEngine":
+        """Push self as the contextual engine: ``with engine.as_context():``"""
+        self._in_context_count += 1
+        self._ctx_tokens.append(_CONTEXT_ENGINE.set(self))
+        self.on_enter_context()
+        return self
+
+    def __enter__(self) -> "ExecutionEngine":
+        return self
+
+    def __exit__(self, *args: Any) -> None:
+        self.stop_context()
+
+    def stop_context(self) -> None:
+        if self._in_context_count > 0:
+            self._in_context_count -= 1
+            _CONTEXT_ENGINE.reset(self._ctx_tokens.pop())
+            self.on_exit_context()
+            if self._in_context_count == 0 and not self._is_global:
+                self.stop()
+
+    def set_global(self) -> "ExecutionEngine":
+        with _GLOBAL_LOCK:
+            old = _GLOBAL_ENGINE[0]
+            if old is not None and old is not self:
+                old._is_global = False
+                if not old.in_context:
+                    old.stop()
+            self._is_global = True
+            _GLOBAL_ENGINE[0] = self
+        return self
+
+    def unset_global(self) -> None:
+        with _GLOBAL_LOCK:
+            if _GLOBAL_ENGINE[0] is self:
+                _GLOBAL_ENGINE[0] = None
+            self._is_global = False
+
+    def on_enter_context(self) -> None:  # pragma: no cover - hook
+        pass
+
+    def on_exit_context(self) -> None:  # pragma: no cover - hook
+        pass
+
+    def stop(self) -> None:
+        with self._stop_lock:
+            if not self._stopped:
+                self._stopped = True
+                self.stop_engine()
+
+    def stop_engine(self) -> None:  # pragma: no cover - hook
+        pass
+
+    # ---- facets ----------------------------------------------------------
+    @property
+    def conf(self) -> ParamDict:
+        return self._conf
+
+    @property
+    def map_engine(self) -> MapEngine:
+        if self._map_engine is None:
+            self._map_engine = self.create_default_map_engine()
+        return self._map_engine
+
+    @map_engine.setter
+    def map_engine(self, engine: MapEngine) -> None:
+        self._map_engine = engine
+
+    @property
+    def sql_engine(self) -> SQLEngine:
+        if self._sql_engine is None:
+            self._sql_engine = self.create_default_sql_engine()
+        return self._sql_engine
+
+    @sql_engine.setter
+    def sql_engine(self, engine: SQLEngine) -> None:
+        self._sql_engine = engine
+
+    @abstractmethod
+    def create_default_map_engine(self) -> MapEngine:  # pragma: no cover
+        raise NotImplementedError
+
+    @abstractmethod
+    def create_default_sql_engine(self) -> SQLEngine:  # pragma: no cover
+        raise NotImplementedError
+
+    @abstractmethod
+    def get_current_parallelism(self) -> int:  # pragma: no cover
+        raise NotImplementedError
+
+    # ---- abstract primitives (reference :480-1181) ----------------------
+    @abstractmethod
+    def repartition(self, df: DataFrame, partition_spec: PartitionSpec) -> DataFrame:
+        raise NotImplementedError  # pragma: no cover
+
+    @abstractmethod
+    def broadcast(self, df: DataFrame) -> DataFrame:
+        raise NotImplementedError  # pragma: no cover
+
+    @abstractmethod
+    def persist(
+        self,
+        df: DataFrame,
+        lazy: bool = False,
+        **kwargs: Any,
+    ) -> DataFrame:
+        raise NotImplementedError  # pragma: no cover
+
+    @abstractmethod
+    def join(
+        self,
+        df1: DataFrame,
+        df2: DataFrame,
+        how: str,
+        on: Optional[List[str]] = None,
+    ) -> DataFrame:
+        raise NotImplementedError  # pragma: no cover
+
+    @abstractmethod
+    def union(self, df1: DataFrame, df2: DataFrame, distinct: bool = True) -> DataFrame:
+        raise NotImplementedError  # pragma: no cover
+
+    @abstractmethod
+    def subtract(
+        self, df1: DataFrame, df2: DataFrame, distinct: bool = True
+    ) -> DataFrame:
+        raise NotImplementedError  # pragma: no cover
+
+    @abstractmethod
+    def intersect(
+        self, df1: DataFrame, df2: DataFrame, distinct: bool = True
+    ) -> DataFrame:
+        raise NotImplementedError  # pragma: no cover
+
+    @abstractmethod
+    def distinct(self, df: DataFrame) -> DataFrame:
+        raise NotImplementedError  # pragma: no cover
+
+    @abstractmethod
+    def dropna(
+        self,
+        df: DataFrame,
+        how: str = "any",
+        thresh: Optional[int] = None,
+        subset: Optional[List[str]] = None,
+    ) -> DataFrame:
+        raise NotImplementedError  # pragma: no cover
+
+    @abstractmethod
+    def fillna(self, df: DataFrame, value: Any, subset: Optional[List[str]] = None) -> DataFrame:
+        raise NotImplementedError  # pragma: no cover
+
+    @abstractmethod
+    def sample(
+        self,
+        df: DataFrame,
+        n: Optional[int] = None,
+        frac: Optional[float] = None,
+        replace: bool = False,
+        seed: Optional[int] = None,
+    ) -> DataFrame:
+        raise NotImplementedError  # pragma: no cover
+
+    @abstractmethod
+    def take(
+        self,
+        df: DataFrame,
+        n: int,
+        presort: str,
+        na_position: str = "last",
+        partition_spec: Optional[PartitionSpec] = None,
+    ) -> DataFrame:
+        raise NotImplementedError  # pragma: no cover
+
+    @abstractmethod
+    def load_df(
+        self,
+        path: Union[str, List[str]],
+        format_hint: Any = None,
+        columns: Any = None,
+        **kwargs: Any,
+    ) -> DataFrame:
+        raise NotImplementedError  # pragma: no cover
+
+    @abstractmethod
+    def save_df(
+        self,
+        df: DataFrame,
+        path: str,
+        format_hint: Any = None,
+        mode: str = "overwrite",
+        partition_spec: Optional[PartitionSpec] = None,
+        force_single: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        raise NotImplementedError  # pragma: no cover
+
+    # ---- column-algebra composites (engine-overridable defaults) --------
+    def select(
+        self,
+        df: DataFrame,
+        cols: SelectColumns,
+        where: Optional[ColumnExpr] = None,
+        having: Optional[ColumnExpr] = None,
+    ) -> DataFrame:
+        """SELECT via the column algebra (reference :743). Default: local
+        pandas evaluation; distributed engines should override/push down."""
+        from fugue_tpu.column.pandas_eval import eval_select
+        from fugue_tpu.dataframe import PandasDataFrame
+
+        out_schema = cols.infer_schema(df.schema)
+        pdf = eval_select(df.as_local().as_pandas(), cols, where, having)
+        return self.to_df(PandasDataFrame(pdf, out_schema))
+
+    def filter(self, df: DataFrame, condition: ColumnExpr) -> DataFrame:
+        from fugue_tpu.column.pandas_eval import eval_filter
+        from fugue_tpu.dataframe import PandasDataFrame
+
+        pdf = eval_filter(df.as_local().as_pandas(), condition)
+        return self.to_df(PandasDataFrame(pdf, df.schema))
+
+    def assign(self, df: DataFrame, columns: List[ColumnExpr]) -> DataFrame:
+        from fugue_tpu.column.pandas_eval import eval_assign
+        from fugue_tpu.dataframe import PandasDataFrame
+
+        named = {}
+        for c in columns:
+            assert_or_throw(c.output_name != "", ValueError(f"{c} has no name"))
+            named[c.output_name] = c
+        schema = df.schema
+        new_fields = []
+        for name, expr in named.items():
+            tp = expr.infer_type(schema)
+            if name in schema:
+                if tp is None:
+                    tp = schema[name].type
+            assert_or_throw(tp is not None, ValueError(f"can't infer type of {expr}"))
+            if name in schema:
+                schema = schema.alter(Schema([(name, tp)]))
+            else:
+                new_fields.append((name, tp))
+        out_schema = schema + Schema(new_fields)
+        pdf = eval_assign(df.as_local().as_pandas(), **named)
+        return self.to_df(PandasDataFrame(pdf, out_schema))
+
+    def aggregate(
+        self,
+        df: DataFrame,
+        partition_spec: Optional[PartitionSpec],
+        agg_cols: List[ColumnExpr],
+    ) -> DataFrame:
+        from fugue_tpu.column.pandas_eval import eval_aggregate
+        from fugue_tpu.dataframe import PandasDataFrame
+
+        assert_or_throw(len(agg_cols) > 0, ValueError("no aggregations"))
+        keys = partition_spec.partition_by if partition_spec is not None else []
+        named = {}
+        for c in agg_cols:
+            assert_or_throw(c.output_name != "", ValueError(f"{c} has no name"))
+            named[c.output_name] = c
+        fields = [df.schema[k] for k in keys]
+        for name, expr in named.items():
+            tp = expr.infer_type(df.schema)
+            assert_or_throw(tp is not None, ValueError(f"can't infer type of {expr}"))
+            fields.append((name, tp))  # type: ignore
+        out_schema = Schema(fields)
+        pdf = eval_aggregate(df.as_local().as_pandas(), keys, named)
+        return self.to_df(PandasDataFrame(pdf[out_schema.names], out_schema))
+
+    # ---- co-partition plane: zip / comap (reference :969-1360) ----------
+    def zip(
+        self,
+        dfs: DataFrames,
+        how: str = "inner",
+        partition_spec: Optional[PartitionSpec] = None,
+        temp_path: Optional[str] = None,
+        to_file_threshold: int = -1,
+    ) -> DataFrame:
+        """Co-partition multiple dataframes by key: each input becomes rows of
+        ``(keys..., serialized_blob, df_no)``; union of all inputs grouped by
+        keys is the zipped frame consumed by :meth:`comap`."""
+        assert_or_throw(len(dfs) > 0, ValueError("can't zip 0 dataframes"))
+        how = how.lower().replace(" ", "_")
+        assert_or_throw(
+            how in ("inner", "left_outer", "right_outer", "full_outer", "cross"),
+            ValueError(f"invalid zip type {how}"),
+        )
+        partition_spec = partition_spec or PartitionSpec()
+        keys: List[str] = partition_spec.partition_by
+        if len(keys) == 0 and how != "cross":
+            # infer keys: intersection of all schemas
+            keys = [
+                n
+                for n in dfs[0].schema.names
+                if all(n in df.schema for df in dfs.values())
+            ]
+            assert_or_throw(
+                len(keys) > 0, ValueError("no common keys to zip by")
+            )
+        if how == "cross":
+            assert_or_throw(
+                len(keys) == 0, ValueError("cross zip can't have keys")
+            )
+        serialized: List[DataFrame] = []
+        schemas: List[str] = []
+        names: List[str] = list(dfs.keys()) if dfs.has_dict else [""] * len(dfs)
+        for no, df in enumerate(dfs.values()):
+            schemas.append(str(df.schema))
+            serialized.append(
+                self._serialize_by_partition(
+                    df,
+                    PartitionSpec(partition_spec, by=[k for k in keys if k in df.schema]),
+                    no,
+                    temp_path,
+                    to_file_threshold,
+                )
+            )
+        res = serialized[0]
+        for s in serialized[1:]:
+            res = self.union(res, s, distinct=False)
+        res.reset_metadata(
+            {
+                "serialized": True,
+                _ZIP_SCHEMAS_META: schemas,
+                _ZIP_NAMES_META: names,
+                _ZIP_HOW_META: how,
+            }
+        )
+        return res
+
+    def zip_all(
+        self,
+        dfs: DataFrames,
+        how: str = "inner",
+        partition_spec: Optional[PartitionSpec] = None,
+    ) -> DataFrame:
+        return self.zip(dfs, how=how, partition_spec=partition_spec)
+
+    def _serialize_by_partition(
+        self,
+        df: DataFrame,
+        partition_spec: PartitionSpec,
+        df_no: int,
+        temp_path: Optional[str] = None,
+        to_file_threshold: int = -1,
+    ) -> DataFrame:
+        keys = [k for k in partition_spec.partition_by if k in df.schema]
+        output_schema = Schema(
+            [df.schema[k] for k in keys]
+            + [(_FUGUE_SER_NO, "int"), (_FUGUE_SER_KEY, "bytes")]  # type: ignore
+        )
+
+        def _serialize(cursor: PartitionCursor, data: LocalDataFrame) -> LocalDataFrame:
+            blob = serialize_df(
+                data,
+                threshold=to_file_threshold,
+                file_path=None
+                if temp_path is None
+                else f"{temp_path}/{uuid4()}.parquet",
+            )
+            row = [cursor.key_value_dict[k] for k in keys] + [df_no, blob]
+            return ArrayDataFrame([row], output_schema)
+
+        spec = PartitionSpec(partition_spec, by=keys)
+        return self.map_engine.map_dataframe(df, _serialize, output_schema, spec)
+
+    def comap(
+        self,
+        df: DataFrame,
+        map_func: Callable[[PartitionCursor, DataFrames], LocalDataFrame],
+        output_schema: Any,
+        partition_spec: PartitionSpec,
+        on_init: Optional[Callable[[int, DataFrames], Any]] = None,
+    ) -> DataFrame:
+        """Apply ``map_func(cursor, DataFrames)`` to each co-partitioned key
+        group of a zipped dataframe."""
+        assert_or_throw(
+            df.metadata.get("serialized", False), ValueError("df is not zipped")
+        )
+        schemas = [Schema(s) for s in df.metadata[_ZIP_SCHEMAS_META]]
+        names = df.metadata[_ZIP_NAMES_META]
+        how = df.metadata.get(_ZIP_HOW_META, "inner")
+        key_names = [
+            n for n in df.schema.names if n not in (_FUGUE_SER_NO, _FUGUE_SER_KEY)
+        ]
+        runner = _Comap(schemas, names, how, map_func, on_init)
+        spec = PartitionSpec(partition_spec, by=key_names) if key_names else \
+            PartitionSpec(num=1)
+        return self.map_engine.map_dataframe(
+            df, runner.run, output_schema, spec, on_init=runner.on_init
+        )
+
+    # ---- misc ------------------------------------------------------------
+    def convert_yield_dataframe(self, df: DataFrame, as_local: bool) -> DataFrame:
+        """Prepare a dataframe for yielding across workflows; engines whose
+        frames die with the engine must localize (reference :449-466)."""
+        return df.as_local() if as_local else df
+
+    def load_yielded(self, df: Yielded) -> DataFrame:
+        from fugue_tpu.dataframe.dataframe import YieldedDataFrame
+
+        if isinstance(df, YieldedDataFrame):
+            return self.to_df(df.result)
+        if isinstance(df, PhysicalYielded):
+            if df.storage_type == "file":
+                return self.load_df(df.name)
+            return self.sql_engine.load_table(df.name)
+        raise ValueError(f"can't load {df}")
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}()"
+
+    def __uuid__(self) -> str:
+        from fugue_tpu.utils.hash import to_uuid
+
+        return to_uuid(type(self).__name__, dict(self.conf))
+
+
+class _Comap:
+    def __init__(
+        self,
+        schemas: List[Schema],
+        names: List[str],
+        how: str,
+        func: Callable,
+        on_init: Optional[Callable],
+    ):
+        self.schemas = schemas
+        self.names = names
+        self.how = how
+        self.func = func
+        self._on_init = on_init
+
+    def on_init(self, partition_no: int, df: DataFrame) -> None:
+        if self._on_init is not None:
+            self._on_init(partition_no, self._empty_dfs())
+
+    def _empty_dfs(self) -> DataFrames:
+        if any(n != "" for n in self.names):
+            return DataFrames(
+                {
+                    n: ArrayDataFrame([], s)
+                    for n, s in zip(self.names, self.schemas)
+                }
+            )
+        return DataFrames([ArrayDataFrame([], s) for s in self.schemas])
+
+    def run(self, cursor: PartitionCursor, data: LocalDataFrame) -> LocalDataFrame:
+        by_no: Dict[int, List[Any]] = {}
+        no_idx = data.schema.index_of_key(_FUGUE_SER_NO)
+        blob_idx = data.schema.index_of_key(_FUGUE_SER_KEY)
+        for row in data.as_array_iterable(type_safe=False):
+            by_no.setdefault(row[no_idx], []).append(row[blob_idx])
+        # presence rules by zip type
+        n = len(self.schemas)
+        present = set(by_no.keys())
+        if self.how == "inner" and len(present) < n:
+            return ArrayDataFrame([], self.func_output_schema(cursor))
+        if self.how == "left_outer" and 0 not in present:
+            return ArrayDataFrame([], self.func_output_schema(cursor))
+        if self.how == "right_outer" and (n - 1) not in present:
+            return ArrayDataFrame([], self.func_output_schema(cursor))
+        frames: List[DataFrame] = []
+        for no in range(n):
+            blobs = by_no.get(no, [])
+            if len(blobs) == 0:
+                frames.append(ArrayDataFrame([], self.schemas[no]))
+            elif len(blobs) == 1:
+                frames.append(deserialize_df(blobs[0]))  # type: ignore
+            else:
+                sub = [deserialize_df(b) for b in blobs]
+                merged = sub[0].as_arrow()  # type: ignore
+                import pyarrow as pa
+
+                merged = pa.concat_tables(
+                    [merged] + [s.as_arrow() for s in sub[1:]]  # type: ignore
+                )
+                from fugue_tpu.dataframe import ArrowDataFrame
+
+                frames.append(ArrowDataFrame(merged))
+        if any(x != "" for x in self.names):
+            dfs = DataFrames(dict(zip(self.names, frames)))
+        else:
+            dfs = DataFrames(frames)
+        return self.func(cursor, dfs)
+
+    def func_output_schema(self, cursor: PartitionCursor) -> Any:
+        # used only for empty results; the map engine replaces with real schema
+        return self.schemas[0]

@@ -1,0 +1,156 @@
+"""Engine registry + resolution (reference fugue/execution/factory.py:18-508).
+
+Resolution order for ``make_execution_engine(None)``: contextual engine ->
+global engine -> inferred from input objects -> registered default -> native.
+"""
+
+from typing import Any, Callable, Dict, List, Optional, Tuple, Type, Union
+
+from fugue_tpu.execution.execution_engine import (
+    _CONTEXT_ENGINE,
+    _GLOBAL_ENGINE,
+    ExecutionEngine,
+    SQLEngine,
+)
+from fugue_tpu.plugins import fugue_plugin
+from fugue_tpu.utils.assertion import assert_or_throw
+from fugue_tpu.utils.params import ParamDict
+
+_ENGINE_FACTORY: Dict[str, Callable[..., ExecutionEngine]] = {}
+_SQL_ENGINE_FACTORY: Dict[str, Callable[..., SQLEngine]] = {}
+_DEFAULT_FACTORY: List[Optional[Callable[..., ExecutionEngine]]] = [None]
+
+
+def register_execution_engine(
+    name_or_type: Union[str, Type], func: Callable[..., ExecutionEngine],
+    on_dup: str = "overwrite",
+) -> None:
+    """Register an engine factory under a name (``func(conf, **kwargs)``)."""
+    if isinstance(name_or_type, str):
+        key = name_or_type.lower()
+        assert_or_throw(
+            on_dup in ("overwrite", "throw", "ignore"),
+            ValueError(f"invalid on_dup {on_dup}"),
+        )
+        if key in _ENGINE_FACTORY:
+            if on_dup == "throw":
+                raise KeyError(f"engine {key} already registered")
+            if on_dup == "ignore":
+                return
+        _ENGINE_FACTORY[key] = func
+    else:
+        # register by type: handled through the parse plugin
+        t = name_or_type
+
+        @parse_execution_engine.candidate(
+            lambda engine, conf, **kwargs: isinstance(engine, t)
+        )
+        def _parse(engine: Any, conf: Any, **kwargs: Any) -> ExecutionEngine:
+            return func(engine, conf, **kwargs)
+
+
+def register_default_execution_engine(
+    func: Callable[..., ExecutionEngine], on_dup: str = "overwrite"
+) -> None:
+    _DEFAULT_FACTORY[0] = func
+
+
+def register_sql_engine(name: str, func: Callable[..., SQLEngine],
+                        on_dup: str = "overwrite") -> None:
+    key = name.lower()
+    if key in _SQL_ENGINE_FACTORY:
+        if on_dup == "throw":
+            raise KeyError(f"sql engine {key} already registered")
+        if on_dup == "ignore":
+            return
+    _SQL_ENGINE_FACTORY[key] = func
+
+
+def register_default_sql_engine(func: Callable[..., SQLEngine]) -> None:
+    _SQL_ENGINE_FACTORY[""] = func
+
+
+@fugue_plugin
+def parse_execution_engine(engine: Any, conf: Any, **kwargs: Any) -> ExecutionEngine:
+    """Plugin: convert an arbitrary object (session, url, ...) to an engine."""
+    raise NotImplementedError(f"can't parse execution engine from {engine!r}")
+
+
+@fugue_plugin
+def infer_execution_engine(objs: List[Any]) -> Any:
+    """Plugin: infer the engine identifier from input dataframes (e.g. a jax
+    block frame infers the jax engine)."""
+    return None
+
+
+@fugue_plugin
+def parse_sql_engine(engine: Any, execution_engine: ExecutionEngine,
+                     **kwargs: Any) -> SQLEngine:
+    raise NotImplementedError(f"can't parse sql engine from {engine!r}")
+
+
+def try_get_context_engine() -> Optional[ExecutionEngine]:
+    eng = _CONTEXT_ENGINE.get()
+    if eng is not None:
+        return eng
+    return _GLOBAL_ENGINE[0]
+
+
+def make_sql_engine(
+    engine: Any = None,
+    execution_engine: Optional[ExecutionEngine] = None,
+    **kwargs: Any,
+) -> SQLEngine:
+    if engine is None:
+        assert_or_throw(execution_engine is not None, ValueError("no engine"))
+        return execution_engine.sql_engine  # type: ignore
+    if isinstance(engine, SQLEngine):
+        return engine
+    if isinstance(engine, type) and issubclass(engine, SQLEngine):
+        return engine(execution_engine, **kwargs)
+    if isinstance(engine, str) and engine.lower() in _SQL_ENGINE_FACTORY:
+        return _SQL_ENGINE_FACTORY[engine.lower()](execution_engine, **kwargs)
+    return parse_sql_engine(engine, execution_engine, **kwargs)
+
+
+def make_execution_engine(
+    engine: Any = None,
+    conf: Any = None,
+    infer_by: Optional[List[Any]] = None,
+    **kwargs: Any,
+) -> ExecutionEngine:
+    """Resolve anything engine-like into a live ExecutionEngine (reference
+    factory.py:237-339)."""
+    conf = ParamDict(conf)
+    if isinstance(engine, tuple):
+        execution_engine = make_execution_engine(engine[0], conf, infer_by, **kwargs)
+        execution_engine.sql_engine = make_sql_engine(engine[1], execution_engine)
+        return execution_engine
+    if isinstance(engine, ExecutionEngine):
+        if len(conf) > 0:
+            engine.conf.update(conf)
+        return engine
+    if engine is None:
+        ctx = try_get_context_engine()
+        if ctx is not None:
+            if len(conf) > 0:
+                ctx.conf.update(conf)
+            return ctx
+        if infer_by is not None:
+            inferred = infer_execution_engine(infer_by)
+            if inferred is not None:
+                return make_execution_engine(inferred, conf, None, **kwargs)
+        if _DEFAULT_FACTORY[0] is not None:
+            return _DEFAULT_FACTORY[0](conf, **kwargs)
+        engine = "native"
+    if isinstance(engine, str):
+        key = engine.lower()
+        if ":" in key:  # "engine:sql_engine" shorthand
+            parts = key.split(":", 1)
+            return make_execution_engine((parts[0], parts[1]), conf, infer_by, **kwargs)
+        if key in _ENGINE_FACTORY:
+            return _ENGINE_FACTORY[key](conf, **kwargs)
+        return parse_execution_engine(engine, conf, **kwargs)
+    if isinstance(engine, type) and issubclass(engine, ExecutionEngine):
+        return engine(conf, **kwargs)
+    return parse_execution_engine(engine, conf, **kwargs)

@@ -1,0 +1,419 @@
+"""NativeExecutionEngine: the single-process pandas engine — reference
+semantics for every conformance suite (parity target: reference
+fugue/execution/native_execution_engine.py; SQL-on-pandas comes from our own
+column-algebra/SQL interpreter instead of qpd)."""
+
+import os
+from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+import pandas as pd
+
+from fugue_tpu.collections.partition import PartitionCursor, PartitionSpec
+from fugue_tpu.collections.sql import StructuredRawSQL
+from fugue_tpu.constants import KEYWORD_PARALLELISM, KEYWORD_ROWCOUNT
+from fugue_tpu.dataframe import (
+    ArrayDataFrame,
+    DataFrame,
+    DataFrames,
+    LocalBoundedDataFrame,
+    LocalDataFrame,
+    PandasDataFrame,
+    as_fugue_df,
+)
+from fugue_tpu.dataframe.pandas_dataframe import PandasDataFrame as _PDF
+from fugue_tpu.dataframe.utils import get_join_schemas
+from fugue_tpu.execution.execution_engine import (
+    ExecutionEngine,
+    MapEngine,
+    SQLEngine,
+)
+from fugue_tpu.schema import Schema
+from fugue_tpu.utils.assertion import assert_or_throw
+from fugue_tpu.utils import io as _io
+
+
+def _sort_pandas(
+    pdf: pd.DataFrame, sorts: Dict[str, bool], na_position: str = "first"
+) -> pd.DataFrame:
+    if len(sorts) == 0 or len(pdf) == 0:
+        return pdf
+    return pdf.sort_values(
+        list(sorts.keys()),
+        ascending=list(sorts.values()),
+        na_position=na_position,
+        kind="stable",
+    )
+
+
+class PandasMapEngine(MapEngine):
+    """Per-partition map on pandas: presort + even split or stable groupby
+    (reference native_execution_engine.py:68-168)."""
+
+    @property
+    def is_distributed(self) -> bool:
+        return False
+
+    def map_dataframe(
+        self,
+        df: DataFrame,
+        map_func: Callable[[PartitionCursor, LocalDataFrame], LocalDataFrame],
+        output_schema: Any,
+        partition_spec: PartitionSpec,
+        on_init: Optional[Callable[[int, DataFrame], Any]] = None,
+        map_func_format_hint: Optional[str] = None,
+    ) -> DataFrame:
+        output_schema = Schema(output_schema)
+        input_schema = df.schema
+        pdf = self.to_df(df).as_pandas()
+        cursor = partition_spec.get_cursor(input_schema, 0)
+        if on_init is not None:
+            on_init(0, self.to_df(df))
+        results: List[pd.DataFrame] = []
+        partition_no = 0
+        for chunk in self._split(pdf, partition_spec, input_schema):
+            if len(chunk) == 0:
+                continue
+            chunk = chunk.reset_index(drop=True)
+            first_row = chunk.iloc[0].tolist()
+            cursor.set(first_row, partition_no, 0)
+            local = _PDF._wrap(chunk, input_schema)
+            out = map_func(cursor, local)
+            partition_no += 1
+            if out is not None and not out.empty:
+                results.append(out.as_pandas())
+        if len(results) == 0:
+            return PandasDataFrame(None, output_schema)
+        res = pd.concat(results, ignore_index=True)
+        return PandasDataFrame(res, output_schema)
+
+    def _split(
+        self, pdf: pd.DataFrame, spec: PartitionSpec, schema: Schema
+    ) -> Iterator[pd.DataFrame]:
+        sorts = spec.get_sorts(schema)
+        if len(spec.partition_by) == 0:
+            num = spec.get_num_partitions(
+                **{
+                    KEYWORD_ROWCOUNT: lambda: len(pdf),
+                    KEYWORD_PARALLELISM: lambda: 1,
+                }
+            )
+            pdf = _sort_pandas(pdf, sorts)
+            if num <= 1 or spec.algo == "coarse" or len(pdf) == 0:
+                yield pdf
+            else:
+                # even split into contiguous chunks (np.array_split boundaries)
+                parts = min(num, len(pdf))
+                base, extra = divmod(len(pdf), parts)
+                start = 0
+                for i in range(parts):
+                    end = start + base + (1 if i < extra else 0)
+                    yield pdf.iloc[start:end]
+                    start = end
+        else:
+            pdf = _sort_pandas(pdf, spec.get_sorts(schema))
+            if len(pdf) == 0:
+                yield pdf
+                return
+            grouped = pdf.groupby(
+                spec.partition_by, dropna=False, sort=False, group_keys=False
+            )
+            for _, sub in grouped:
+                yield sub
+
+    def map_bag(
+        self,
+        bag: Any,
+        map_func: Callable,
+        partition_spec: PartitionSpec,
+        on_init: Optional[Callable] = None,
+    ) -> Any:
+        from fugue_tpu.bag import ArrayBag
+
+        if on_init is not None:
+            on_init(0, bag)
+        return map_func(0, ArrayBag(bag.as_array()))
+
+
+class PandasSQLEngine(SQLEngine):
+    """SQL over pandas via the built-in SQL front end (wired by
+    fugue_tpu.sql_frontend; raises until that module provides the executor)."""
+
+    @property
+    def is_distributed(self) -> bool:
+        return False
+
+    @property
+    def dialect(self) -> Optional[str]:
+        return "spark"
+
+    def select(self, dfs: DataFrames, statement: StructuredRawSQL) -> DataFrame:
+        from fugue_tpu.sql_frontend.executor import run_sql_on_dataframes
+
+        return run_sql_on_dataframes(
+            statement.construct(dialect=self.dialect), dfs
+        )
+
+
+class NativeExecutionEngine(ExecutionEngine):
+    """Single-process engine on pandas (reference
+    native_execution_engine.py:171-419)."""
+
+    def __init__(self, conf: Any = None):
+        super().__init__(conf)
+
+    @property
+    def is_distributed(self) -> bool:
+        return False
+
+    def create_default_map_engine(self) -> MapEngine:
+        return PandasMapEngine(self)
+
+    def create_default_sql_engine(self) -> SQLEngine:
+        return PandasSQLEngine(self)
+
+    def get_current_parallelism(self) -> int:
+        return 1
+
+    def to_df(self, df: Any, schema: Any = None) -> LocalBoundedDataFrame:
+        if isinstance(df, DataFrame):
+            assert_or_throw(
+                schema is None,
+                ValueError("schema must be None when df is a DataFrame"),
+            )
+            res = df.as_local_bounded()
+            if df.has_metadata:
+                res.reset_metadata(df.metadata)
+            return res  # type: ignore
+        if isinstance(df, pd.DataFrame):
+            return PandasDataFrame(df, schema)
+        if isinstance(df, (list, tuple)) or (
+            hasattr(df, "__iter__") and not isinstance(df, str)
+        ):
+            return ArrayDataFrame(df, schema)
+        from fugue_tpu.collections.yielded import Yielded
+
+        if isinstance(df, Yielded):
+            return self.load_yielded(df)  # type: ignore
+        raise ValueError(f"can't convert {type(df)} to DataFrame")
+
+    def repartition(self, df: DataFrame, partition_spec: PartitionSpec) -> DataFrame:
+        return df
+
+    def broadcast(self, df: DataFrame) -> DataFrame:
+        return df
+
+    def persist(self, df: DataFrame, lazy: bool = False, **kwargs: Any) -> DataFrame:
+        return self.to_df(df)
+
+    def join(
+        self,
+        df1: DataFrame,
+        df2: DataFrame,
+        how: str,
+        on: Optional[List[str]] = None,
+    ) -> DataFrame:
+        how = how.lower().replace("_", "").replace(" ", "")
+        key_schema, output_schema = get_join_schemas(df1, df2, how, on)
+        keys = key_schema.names
+        a = self.to_df(df1).as_pandas()
+        b = self.to_df(df2).as_pandas()
+        res = _pandas_join(a, b, how, keys)
+        return PandasDataFrame(res[output_schema.names], output_schema)
+
+    def union(self, df1: DataFrame, df2: DataFrame, distinct: bool = True) -> DataFrame:
+        assert_or_throw(
+            df1.schema == df2.schema,
+            ValueError(f"union schema mismatch {df1.schema} vs {df2.schema}"),
+        )
+        a = self.to_df(df1).as_pandas()
+        b = self.to_df(df2).as_pandas()
+        res = pd.concat([a, b], ignore_index=True)
+        if distinct:
+            res = _pandas_distinct(res)
+        return PandasDataFrame(res, df1.schema)
+
+    def subtract(
+        self, df1: DataFrame, df2: DataFrame, distinct: bool = True
+    ) -> DataFrame:
+        assert_or_throw(
+            df1.schema == df2.schema,
+            ValueError(f"subtract schema mismatch {df1.schema} vs {df2.schema}"),
+        )
+        assert_or_throw(distinct, NotImplementedError("EXCEPT ALL not supported"))
+        a = _pandas_distinct(self.to_df(df1).as_pandas())
+        b = self.to_df(df2).as_pandas()
+        cols = list(a.columns)
+        merged = a.merge(b.drop_duplicates(), on=cols, how="left", indicator=True)
+        res = merged[merged["_merge"] == "left_only"][cols]
+        return PandasDataFrame(res.reset_index(drop=True), df1.schema)
+
+    def intersect(
+        self, df1: DataFrame, df2: DataFrame, distinct: bool = True
+    ) -> DataFrame:
+        assert_or_throw(
+            df1.schema == df2.schema,
+            ValueError(f"intersect schema mismatch {df1.schema} vs {df2.schema}"),
+        )
+        assert_or_throw(distinct, NotImplementedError("INTERSECT ALL not supported"))
+        a = _pandas_distinct(self.to_df(df1).as_pandas())
+        b = self.to_df(df2).as_pandas()
+        cols = list(a.columns)
+        merged = a.merge(b.drop_duplicates(), on=cols, how="inner")
+        return PandasDataFrame(merged.reset_index(drop=True), df1.schema)
+
+    def distinct(self, df: DataFrame) -> DataFrame:
+        res = _pandas_distinct(self.to_df(df).as_pandas())
+        return PandasDataFrame(res, df.schema)
+
+    def dropna(
+        self,
+        df: DataFrame,
+        how: str = "any",
+        thresh: Optional[int] = None,
+        subset: Optional[List[str]] = None,
+    ) -> DataFrame:
+        kw: Dict[str, Any] = dict(subset=subset)
+        if thresh is not None:
+            kw["thresh"] = thresh
+        else:
+            kw["how"] = how
+        res = self.to_df(df).as_pandas().dropna(**kw)
+        return PandasDataFrame(res.reset_index(drop=True), df.schema)
+
+    def fillna(
+        self, df: DataFrame, value: Any, subset: Optional[List[str]] = None
+    ) -> DataFrame:
+        assert_or_throw(
+            (not isinstance(value, dict)) or all(v is not None for v in value.values()),
+            ValueError("fillna dict can't contain None"),
+        )
+        assert_or_throw(value is not None, ValueError("fillna value can't be None"))
+        pdf = self.to_df(df).as_pandas()
+        if isinstance(value, dict):
+            res = pdf.fillna(value)
+        elif subset is not None:
+            res = pdf.fillna({c: value for c in subset})
+        else:
+            res = pdf.fillna(value)
+        return PandasDataFrame(res, df.schema)
+
+    def sample(
+        self,
+        df: DataFrame,
+        n: Optional[int] = None,
+        frac: Optional[float] = None,
+        replace: bool = False,
+        seed: Optional[int] = None,
+    ) -> DataFrame:
+        assert_or_throw(
+            (n is None) != (frac is None),
+            ValueError("one and only one of n and frac must be set"),
+        )
+        res = (
+            self.to_df(df)
+            .as_pandas()
+            .sample(n=n, frac=frac, replace=replace, random_state=seed)
+        )
+        return PandasDataFrame(res.reset_index(drop=True), df.schema)
+
+    def take(
+        self,
+        df: DataFrame,
+        n: int,
+        presort: str,
+        na_position: str = "last",
+        partition_spec: Optional[PartitionSpec] = None,
+    ) -> DataFrame:
+        assert_or_throw(
+            isinstance(n, int) and n >= 0, ValueError("n must be a non-negative int")
+        )
+        assert_or_throw(
+            na_position in ("first", "last"), ValueError("invalid na_position")
+        )
+        partition_spec = partition_spec or PartitionSpec()
+        from fugue_tpu.collections.partition import parse_presort_exp
+
+        sorts = parse_presort_exp(presort) if presort else partition_spec.presort
+        pdf = self.to_df(df).as_pandas()
+        if len(partition_spec.partition_by) == 0:
+            res = _sort_pandas(pdf, sorts, na_position).head(n)
+        else:
+            pdf = _sort_pandas(pdf, sorts, na_position)
+            res = (
+                pdf.groupby(
+                    partition_spec.partition_by, dropna=False, sort=False,
+                    group_keys=False,
+                )
+                .head(n)
+            )
+        return PandasDataFrame(res.reset_index(drop=True), df.schema)
+
+    def load_df(
+        self,
+        path: Union[str, List[str]],
+        format_hint: Any = None,
+        columns: Any = None,
+        **kwargs: Any,
+    ) -> LocalBoundedDataFrame:
+        return _io.load_df(path, format_hint, columns, **kwargs)
+
+    def save_df(
+        self,
+        df: DataFrame,
+        path: str,
+        format_hint: Any = None,
+        mode: str = "overwrite",
+        partition_spec: Optional[PartitionSpec] = None,
+        force_single: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        _io.save_df(df, path, format_hint, mode, **kwargs)
+
+
+def _pandas_distinct(pdf: pd.DataFrame) -> pd.DataFrame:
+    try:
+        return pdf.drop_duplicates(ignore_index=True)
+    except TypeError:
+        # unhashable cells (lists/dicts): fall back to a string projection
+        key = pdf.astype(str).apply(lambda r: "\0".join(r), axis=1)
+        return pdf[~key.duplicated()].reset_index(drop=True)
+
+
+def _pandas_join(
+    a: pd.DataFrame, b: pd.DataFrame, how: str, keys: List[str]
+) -> pd.DataFrame:
+    """SQL-semantics join on pandas: null keys never match (pd.merge would
+    match NaN == NaN, so null-keyed rows are handled explicitly)."""
+    if how == "cross":
+        return a.merge(b, how="cross")
+    a_null = a[keys].isna().any(axis=1) if len(a) else pd.Series([], dtype=bool)
+    b_null = b[keys].isna().any(axis=1) if len(b) else pd.Series([], dtype=bool)
+    a_ok, a_bad = (a[~a_null], a[a_null]) if len(a) else (a, a)
+    b_ok, b_bad = (b[~b_null], b[b_null]) if len(b) else (b, b)
+    if how == "inner":
+        return a_ok.merge(b_ok, on=keys, how="inner")
+    if how in ("semi", "leftsemi"):
+        right = b_ok[keys].drop_duplicates()
+        return a_ok.merge(right, on=keys, how="inner")
+    if how in ("anti", "leftanti"):
+        right = b_ok[keys].drop_duplicates()
+        merged = a.merge(right, on=keys, how="left", indicator=True)
+        return merged[merged["_merge"] == "left_only"].drop(columns=["_merge"])
+    if how == "leftouter":
+        res = a.merge(b_ok, on=keys, how="left")
+        return res
+    if how == "rightouter":
+        res = a_ok.merge(b, on=keys, how="right")
+        return res
+    if how == "fullouter":
+        core = a_ok.merge(b_ok, on=keys, how="outer")
+        extras = []
+        if len(a_bad) > 0:
+            extras.append(a_bad.merge(b_ok.head(0), on=keys, how="left"))
+        if len(b_bad) > 0:
+            extras.append(a_ok.head(0).merge(b_bad, on=keys, how="right"))
+        if extras:
+            core = pd.concat([core] + extras, ignore_index=True)
+        return core
+    raise NotImplementedError(f"join type {how}")

@@ -1,0 +1,31 @@
+"""Builtin registrations: the native engine under names ``native``/``pandas``
+and dataset display fallbacks (backend registration pattern parity:
+reference fugue_spark/registry.py etc; the jax backend registers itself in
+fugue_tpu/jax_backend/registry.py)."""
+
+from typing import Any
+
+from fugue_tpu.execution.factory import (
+    register_default_execution_engine,
+    register_execution_engine,
+)
+from fugue_tpu.execution.native_execution_engine import NativeExecutionEngine
+
+
+def _register() -> None:
+    register_execution_engine(
+        "native", lambda conf, **kwargs: NativeExecutionEngine(conf)
+    )
+    register_execution_engine(
+        "pandas", lambda conf, **kwargs: NativeExecutionEngine(conf)
+    )
+    register_default_execution_engine(
+        lambda conf, **kwargs: NativeExecutionEngine(conf)
+    )
+    try:
+        import fugue_tpu.jax_backend.registry  # noqa: F401
+    except ImportError:  # pragma: no cover - jax backend is part of the pkg
+        pass
+
+
+_register()

@@ -1,0 +1,188 @@
+"""Host IO: parquet/csv/json load & save on local paths (reference
+fugue/_utils/io.py rebuilt on pyarrow only — no fs/duckdb deps).
+
+Files may be single files or directories of part files (the distributed
+convention); saving with ``force_single`` writes one file, otherwise engines
+may write a directory."""
+
+import os
+import shutil
+from typing import Any, Dict, Iterable, List, Optional, Tuple, Union
+
+import pyarrow as pa
+import pyarrow.csv as pacsv
+import pyarrow.json as pajson
+import pyarrow.parquet as pq
+
+from fugue_tpu.dataframe import ArrowDataFrame, DataFrame, LocalBoundedDataFrame
+from fugue_tpu.schema import Schema
+from fugue_tpu.utils.assertion import assert_or_throw
+
+_FORMATS = {".parquet": "parquet", ".csv": "csv", ".json": "json"}
+
+
+def infer_format(path: str, format_hint: Optional[str] = None) -> str:
+    if format_hint is not None:
+        assert_or_throw(
+            format_hint in ("parquet", "csv", "json"),
+            NotImplementedError(f"invalid format {format_hint}"),
+        )
+        return format_hint
+    for suffix, fmt in _FORMATS.items():
+        if path.endswith(suffix):
+            return fmt
+    raise NotImplementedError(f"can't infer format of {path}")
+
+
+def _part_files(path: str, fmt: str) -> List[str]:
+    if os.path.isdir(path):
+        files = sorted(
+            os.path.join(path, f)
+            for f in os.listdir(path)
+            if not f.startswith(".") and not f.startswith("_")
+        )
+        assert_or_throw(len(files) > 0, FileNotFoundError(f"no part files in {path}"))
+        return files
+    assert_or_throw(os.path.exists(path), FileNotFoundError(path))
+    return [path]
+
+
+def load_df(
+    path: Union[str, List[str]],
+    format_hint: Optional[str] = None,
+    columns: Any = None,
+    **kwargs: Any,
+) -> LocalBoundedDataFrame:
+    paths = [path] if isinstance(path, str) else list(path)
+    fmt = infer_format(paths[0], format_hint)
+    tables = []
+    for p in paths:
+        for f in _part_files(p, fmt):
+            # copy kwargs: the csv branch pops options, every file must see them
+            tables.append(_load_single(f, fmt, columns, dict(kwargs)))
+    table = tables[0] if len(tables) == 1 else pa.concat_tables(tables)
+    if isinstance(columns, str):  # schema expression: select + cast
+        schema = Schema(columns)
+        from fugue_tpu.dataframe.arrow_utils import cast_table
+
+        table = cast_table(table.select(schema.names), schema)
+        return ArrowDataFrame(table, schema)
+    return ArrowDataFrame(table)
+
+
+def _load_single(
+    path: str, fmt: str, columns: Any, kwargs: Dict[str, Any]
+) -> pa.Table:
+    cols = columns if isinstance(columns, list) else None
+    if fmt == "parquet":
+        return pq.read_table(path, columns=cols, **kwargs)
+    if fmt == "csv":
+        header = bool(kwargs.pop("header", True))
+        infer = bool(kwargs.pop("infer_schema", False))
+        schema: Optional[Schema] = None
+        read_opts = pacsv.ReadOptions()
+        convert_opts = pacsv.ConvertOptions()
+        if isinstance(columns, str):
+            schema = Schema(columns)
+        names: Optional[List[str]] = None
+        if not header:
+            assert_or_throw(
+                columns is not None,
+                ValueError("columns must be set when csv has no header"),
+            )
+            names = schema.names if schema is not None else list(columns)
+            read_opts.column_names = names
+        if schema is not None:
+            # parse straight into the requested types
+            convert_opts.column_types = {
+                f.name: f.type for f in schema.fields
+                if not pa.types.is_nested(f.type)
+            }
+        elif not infer:
+            # inference disabled: keep raw text (declare every column string)
+            if names is None:
+                import csv as _csv
+
+                with open(path, "r", newline="") as fp:
+                    names = next(_csv.reader(fp))
+            convert_opts.column_types = {n: pa.string() for n in names}
+        table = pacsv.read_csv(path, read_options=read_opts,
+                               convert_options=convert_opts)
+        if cols is not None:
+            table = table.select(cols)
+        return table
+    if fmt == "json":
+        table = pajson.read_json(path)
+        if cols is not None:
+            table = table.select(cols)
+        return table
+    raise NotImplementedError(fmt)
+
+
+def save_df(
+    df: DataFrame,
+    path: str,
+    format_hint: Optional[str] = None,
+    mode: str = "overwrite",
+    force_single: bool = False,
+    **kwargs: Any,
+) -> None:
+    fmt = infer_format(path, format_hint)
+    assert_or_throw(
+        mode in ("overwrite", "append", "error"),
+        NotImplementedError(f"invalid mode {mode}"),
+    )
+    if os.path.exists(path):
+        if mode == "error":
+            raise FileExistsError(path)
+        if mode == "overwrite":
+            if os.path.isdir(path):
+                shutil.rmtree(path)
+            else:
+                os.remove(path)
+    table = df.as_local_bounded().as_arrow(type_safe=True)
+    if mode == "append" and os.path.exists(path):
+        if os.path.isdir(path):
+            target = os.path.join(path, f"part-{len(os.listdir(path))}.{fmt}")
+            _save_single(table, target, fmt, kwargs)
+            return
+        # read the existing file with the SAME header convention we write
+        # (csv is saved headerless by default), then align types to the new data
+        load_kw: Dict[str, Any] = {}
+        load_cols: Any = None
+        if fmt == "csv":
+            load_kw["header"] = bool(kwargs.get("header", False))
+            if not load_kw["header"]:
+                load_cols = list(table.schema.names)
+        old = _load_single(path, fmt, load_cols, load_kw)
+        if old.schema != table.schema:
+            from fugue_tpu.dataframe.arrow_utils import cast_table
+            from fugue_tpu.schema import Schema as _Schema
+
+            old = cast_table(old.select(table.schema.names), _Schema(table.schema))
+        table = pa.concat_tables([old, table])
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    _save_single(table, path, fmt, kwargs)
+
+
+def _save_single(table: pa.Table, path: str, fmt: str, kwargs: Dict[str, Any]) -> None:
+    if fmt == "parquet":
+        pq.write_table(table, path, **kwargs)
+        return
+    if fmt == "csv":
+        header = bool(kwargs.pop("header", False))
+        opts = pacsv.WriteOptions(include_header=header)
+        pacsv.write_csv(table, path, opts)
+        return
+    if fmt == "json":
+        # line-delimited json (the cross-engine convention)
+        import json as _json
+
+        from fugue_tpu.dataframe.arrow_utils import table_to_rows
+
+        names = table.schema.names
+        with open(path, "w") as fp:
+            for row in table_to_rows(table):
+                fp.write(_json.dumps(dict(zip(names, row)), default=str) + "\n")
+        return
+    raise NotImplementedError(fmt)

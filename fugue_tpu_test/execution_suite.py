@@ -1,0 +1,480 @@
+"""Abstract conformance suite every ExecutionEngine must pass (parity role:
+reference fugue_test/execution_suite.py:36-1248). Subclass, implement
+``make_engine``, run. The JAX engine runs this under a virtual multi-device
+CPU mesh — exactly how the reference validates each new backend."""
+
+import os
+import pickle
+from typing import Any
+
+import pandas as pd
+import pytest
+
+from fugue_tpu.collections.partition import PartitionSpec
+from fugue_tpu.column import SelectColumns, all_cols, col, lit
+from fugue_tpu.column import functions as ff
+from fugue_tpu.dataframe import ArrayDataFrame, DataFrame, DataFrames
+from fugue_tpu.dataframe.utils import df_eq
+from fugue_tpu.execution import ExecutionEngine
+from fugue_tpu.execution.api import engine_context
+
+
+class ExecutionEngineTests:
+    class Tests:
+        @classmethod
+        def setup_class(cls):
+            cls._engine = cls.make_engine(cls)
+            cls._engine.as_context()
+
+        @classmethod
+        def teardown_class(cls):
+            cls._engine.stop_context()
+
+        def make_engine(self) -> ExecutionEngine:  # pragma: no cover
+            raise NotImplementedError
+
+        @property
+        def engine(self) -> ExecutionEngine:
+            return self._engine  # type: ignore
+
+        # ---- basics -----------------------------------------------------
+        def test_init(self):
+            print(self.engine)
+            assert self.engine.log is not None
+            assert self.engine.conf is not None
+            assert self.engine.get_current_parallelism() >= 1
+
+        def test_to_df(self):
+            e = self.engine
+            a = e.to_df([[1, "a"], [2, "b"]], "x:long,y:str")
+            assert a.schema == "x:long,y:str"
+            assert df_eq(a, [[1, "a"], [2, "b"]], "x:long,y:str", throw=True)
+            b = e.to_df(pd.DataFrame({"x": [1], "y": ["a"]}))
+            assert "x" in b.schema and "y" in b.schema
+            c = e.to_df(a)
+            assert df_eq(c, a, throw=True)
+            empty = e.to_df([], "x:long,y:str")
+            assert empty.count() == 0 if empty.is_bounded else True
+
+        def test_to_df_special_values(self):
+            e = self.engine
+            a = e.to_df([[1, None], [None, "b"]], "x:long,y:str")
+            assert df_eq(a, [[1, None], [None, "b"]], "x:long,y:str", throw=True)
+            b = e.to_df([[1.0, float("nan")]], "x:double,y:double")
+            assert df_eq(b, [[1.0, None]], "x:double,y:double", throw=True)
+            c = e.to_df([["2020-01-01 01:02:03"]], "t:datetime")
+            assert c.as_local().as_array(type_safe=True)[0][0].year == 2020
+
+        def test_map(self):
+            e = self.engine
+
+            def mapper(cursor, data):
+                pdf = data.as_pandas()
+                pdf = pdf.assign(z=pdf["x"] * 2)
+                from fugue_tpu.dataframe import PandasDataFrame
+
+                return PandasDataFrame(pdf, "x:long,y:str,z:long")
+
+            a = e.to_df([[1, "a"], [2, "b"], [3, "c"]], "x:long,y:str")
+            res = e.map_engine.map_dataframe(
+                a, mapper, "x:long,y:str,z:long", PartitionSpec()
+            )
+            assert df_eq(
+                res,
+                [[1, "a", 2], [2, "b", 4], [3, "c", 6]],
+                "x:long,y:str,z:long",
+                throw=True,
+            )
+
+        def test_map_with_partition_keys(self):
+            e = self.engine
+
+            def mapper(cursor, data):
+                k = cursor.key_value_dict["k"]
+                n = data.count()
+                return ArrayDataFrame([[k, n]], "k:str,n:long")
+
+            a = e.to_df(
+                [[1, "a"], [2, "a"], [3, "b"]], "x:long,k:str"
+            )
+            res = e.map_engine.map_dataframe(
+                a, mapper, "k:str,n:long", PartitionSpec(by=["k"])
+            )
+            assert df_eq(res, [["a", 2], ["b", 1]], "k:str,n:long", throw=True)
+
+        def test_map_with_presort(self):
+            e = self.engine
+
+            def mapper(cursor, data):
+                rows = data.as_array()
+                return ArrayDataFrame(
+                    [[cursor.key_value_dict["k"], rows[0][0]]], "k:str,first:long"
+                )
+
+            a = e.to_df(
+                [[3, "a"], [1, "a"], [2, "b"], [5, "b"]], "x:long,k:str"
+            )
+            res = e.map_engine.map_dataframe(
+                a,
+                mapper,
+                "k:str,first:long",
+                PartitionSpec(by=["k"], presort="x desc"),
+            )
+            assert df_eq(res, [["a", 3], ["b", 5]], "k:str,first:long", throw=True)
+
+        def test_map_with_on_init(self):
+            e = self.engine
+            inits = []
+
+            def on_init(no, data):
+                inits.append(no)
+
+            def mapper(cursor, data):
+                return data
+
+            a = e.to_df([[1], [2]], "x:long")
+            res = e.map_engine.map_dataframe(
+                a, mapper, "x:long", PartitionSpec(num=2), on_init=on_init
+            )
+            assert df_eq(res, [[1], [2]], "x:long", throw=True)
+            assert len(inits) >= 1
+
+        def test_map_with_special_cols(self):
+            e = self.engine
+
+            def mapper(cursor, data):
+                return data
+
+            a = e.to_df([[b"\x01", [1, 2], {"a": 1}]], "x:bytes,y:[long],z:{a:long}")
+            res = e.map_engine.map_dataframe(
+                a, mapper, "x:bytes,y:[long],z:{a:long}", PartitionSpec()
+            )
+            rows = res.as_local().as_array(type_safe=True)
+            assert rows == [[b"\x01", [1, 2], {"a": 1}]]
+
+        def test_map_empty_input(self):
+            e = self.engine
+
+            def mapper(cursor, data):
+                return data
+
+            a = e.to_df([], "x:long,y:str")
+            res = e.map_engine.map_dataframe(a, mapper, "x:long,y:str", PartitionSpec())
+            assert df_eq(res, [], "x:long,y:str", throw=True)
+
+        # ---- relational ops ---------------------------------------------
+        def test_join_inner(self):
+            e = self.engine
+            a = e.to_df([[1, "a"], [2, "b"], [3, "c"]], "x:long,y:str")
+            b = e.to_df([[1, 10.0], [2, 20.0], [4, 40.0]], "x:long,z:double")
+            res = e.join(a, b, how="inner", on=["x"])
+            assert df_eq(
+                res, [[1, "a", 10.0], [2, "b", 20.0]], "x:long,y:str,z:double",
+                throw=True,
+            )
+
+        def test_join_outer(self):
+            e = self.engine
+            a = e.to_df([[1, "a"], [2, "b"]], "x:long,y:str")
+            b = e.to_df([[2, 20.0], [3, 30.0]], "x:long,z:double")
+            res = e.join(a, b, how="left_outer", on=["x"])
+            assert df_eq(
+                res, [[1, "a", None], [2, "b", 20.0]], "x:long,y:str,z:double",
+                throw=True,
+            )
+            res = e.join(a, b, how="right_outer", on=["x"])
+            assert df_eq(
+                res, [[2, "b", 20.0], [3, None, 30.0]], "x:long,y:str,z:double",
+                throw=True,
+            )
+            res = e.join(a, b, how="full_outer", on=["x"])
+            assert df_eq(
+                res,
+                [[1, "a", None], [2, "b", 20.0], [3, None, 30.0]],
+                "x:long,y:str,z:double",
+                throw=True,
+            )
+
+        def test_join_semi_anti_cross(self):
+            e = self.engine
+            a = e.to_df([[1, "a"], [2, "b"]], "x:long,y:str")
+            b = e.to_df([[2, 9.0]], "x:long,z:double")
+            assert df_eq(
+                e.join(a, b, how="semi", on=["x"]), [[2, "b"]], "x:long,y:str",
+                throw=True,
+            )
+            assert df_eq(
+                e.join(a, b, how="anti", on=["x"]), [[1, "a"]], "x:long,y:str",
+                throw=True,
+            )
+            c = e.to_df([[10], [20]], "w:long")
+            assert df_eq(
+                e.join(a, c, how="cross"),
+                [[1, "a", 10], [1, "a", 20], [2, "b", 10], [2, "b", 20]],
+                "x:long,y:str,w:long",
+                throw=True,
+            )
+
+        def test_join_null_keys(self):
+            # SQL semantics: null keys never match
+            e = self.engine
+            a = e.to_df([[1, "a"], [None, "b"]], "x:long,y:str")
+            b = e.to_df([[1, 10.0], [None, 99.0]], "x:long,z:double")
+            assert df_eq(
+                e.join(a, b, how="inner", on=["x"]),
+                [[1, "a", 10.0]], "x:long,y:str,z:double", throw=True,
+            )
+            assert df_eq(
+                e.join(a, b, how="full_outer", on=["x"]),
+                [[1, "a", 10.0], [None, "b", None], [None, None, 99.0]],
+                "x:long,y:str,z:double", throw=True,
+            )
+
+        def test_union(self):
+            e = self.engine
+            a = e.to_df([[1, "a"], [1, "a"], [2, "b"]], "x:long,y:str")
+            b = e.to_df([[2, "b"], [3, "c"]], "x:long,y:str")
+            assert df_eq(
+                e.union(a, b), [[1, "a"], [2, "b"], [3, "c"]], "x:long,y:str",
+                throw=True,
+            )
+            assert df_eq(
+                e.union(a, b, distinct=False),
+                [[1, "a"], [1, "a"], [2, "b"], [2, "b"], [3, "c"]],
+                "x:long,y:str", throw=True,
+            )
+            with pytest.raises(Exception):
+                e.union(a, e.to_df([[1]], "x:long"))
+
+        def test_subtract_intersect(self):
+            e = self.engine
+            a = e.to_df([[1, "a"], [1, "a"], [2, "b"]], "x:long,y:str")
+            b = e.to_df([[2, "b"], [3, "c"]], "x:long,y:str")
+            assert df_eq(e.subtract(a, b), [[1, "a"]], "x:long,y:str", throw=True)
+            assert df_eq(e.intersect(a, b), [[2, "b"]], "x:long,y:str", throw=True)
+
+        def test_distinct(self):
+            e = self.engine
+            a = e.to_df([[1, "a"], [1, "a"], [None, None]], "x:long,y:str")
+            assert df_eq(
+                e.distinct(a), [[1, "a"], [None, None]], "x:long,y:str", throw=True
+            )
+
+        def test_dropna(self):
+            e = self.engine
+            a = e.to_df([[1, "a"], [None, "b"], [None, None]], "x:long,y:str")
+            assert df_eq(e.dropna(a), [[1, "a"]], "x:long,y:str", throw=True)
+            assert df_eq(
+                e.dropna(a, how="all"),
+                [[1, "a"], [None, "b"]], "x:long,y:str", throw=True,
+            )
+            assert df_eq(
+                e.dropna(a, thresh=1),
+                [[1, "a"], [None, "b"]], "x:long,y:str", throw=True,
+            )
+            assert df_eq(
+                e.dropna(a, subset=["y"]),
+                [[1, "a"], [None, "b"]], "x:long,y:str", throw=True,
+            )
+
+        def test_fillna(self):
+            e = self.engine
+            a = e.to_df([[1, "a"], [None, None]], "x:long,y:str")
+            assert df_eq(
+                e.fillna(a, 0, subset=["x"]),
+                [[1, "a"], [0, None]], "x:long,y:str", throw=True,
+            )
+            assert df_eq(
+                e.fillna(a, {"x": -1, "y": "z"}),
+                [[1, "a"], [-1, "z"]], "x:long,y:str", throw=True,
+            )
+            with pytest.raises(Exception):
+                e.fillna(a, None)
+            with pytest.raises(Exception):
+                e.fillna(a, {"x": None})
+
+        def test_sample(self):
+            e = self.engine
+            a = e.to_df([[i] for i in range(100)], "x:long")
+            res = e.sample(a, frac=0.3, seed=0)
+            n = res.as_local().count()
+            assert 10 <= n <= 60
+            res = e.sample(a, n=10, seed=0)
+            assert res.as_local().count() == 10
+            with pytest.raises(Exception):
+                e.sample(a, n=1, frac=0.1)
+            with pytest.raises(Exception):
+                e.sample(a)
+
+        def test_take(self):
+            e = self.engine
+            a = e.to_df(
+                [[1, "a"], [5, "a"], [2, "b"], [None, "b"]], "x:long,k:str"
+            )
+            assert df_eq(
+                e.take(a, 1, presort="x desc"), [[5, "a"]], "x:long,k:str", throw=True
+            )
+            assert df_eq(
+                e.take(a, 1, presort="x", na_position="first"),
+                [[None, "b"]], "x:long,k:str", throw=True,
+            )
+            res = e.take(a, 1, presort="x", na_position="last",
+                         partition_spec=PartitionSpec(by=["k"]))
+            assert df_eq(res, [[1, "a"], [2, "b"]], "x:long,k:str", throw=True)
+
+        # ---- column algebra ---------------------------------------------
+        def test_select(self):
+            e = self.engine
+            a = e.to_df([[1, "a", 10.0], [2, "a", 20.0], [3, "b", 1.0]],
+                        "x:long,k:str,v:double")
+            res = e.select(a, SelectColumns(col("k"), col("v")))
+            assert df_eq(res, [["a", 10.0], ["a", 20.0], ["b", 1.0]],
+                         "k:str,v:double", throw=True)
+            res = e.select(
+                a,
+                SelectColumns(col("k"), ff.sum(col("v")).alias("s")),
+                where=col("v") > 5,
+            )
+            assert df_eq(res, [["a", 30.0]], "k:str,s:double", throw=True)
+            res = e.select(
+                a, SelectColumns(col("k"), ff.count(all_cols()).alias("c")),
+                having=ff.count(all_cols()) > 1,
+            )
+            assert df_eq(res, [["a", 2]], "k:str,c:long", throw=True)
+
+        def test_filter_assign_aggregate(self):
+            e = self.engine
+            a = e.to_df([[1, "a"], [2, "b"], [None, "c"]], "x:long,k:str")
+            assert df_eq(
+                e.filter(a, col("x").not_null() & (col("x") > 1)),
+                [[2, "b"]], "x:long,k:str", throw=True,
+            )
+            res = e.assign(a, [(col("x") * 2).cast("double").alias("y")])
+            assert df_eq(
+                res, [[1, "a", 2.0], [2, "b", 4.0], [None, "c", None]],
+                "x:long,k:str,y:double", throw=True,
+            )
+            res = e.aggregate(
+                a, None, [ff.max(col("x")).alias("mx"), ff.count(all_cols()).alias("n")]
+            )
+            assert df_eq(res, [[2, 3]], "mx:long,n:long", throw=True)
+            res = e.aggregate(
+                e.to_df([[1, "a"], [2, "a"], [3, "b"]], "x:long,k:str"),
+                PartitionSpec(by=["k"]),
+                [ff.sum(col("x")).alias("s")],
+            )
+            assert df_eq(res, [["a", 3], ["b", 3]], "k:str,s:long", throw=True)
+
+        # ---- zip / comap ------------------------------------------------
+        def test_zip_comap(self):
+            e = self.engine
+            a = e.to_df([[1, "a"], [2, "a"], [3, "b"]], "x:long,k:str")
+            b = e.to_df([["a", 10.0], ["b", 20.0], ["c", 30.0]], "k:str,w:double")
+            z = e.zip(DataFrames(a, b), partition_spec=PartitionSpec(by=["k"]))
+
+            def cm(cursor, dfs):
+                na = dfs[0].count()
+                nb = dfs[1].count()
+                return ArrayDataFrame(
+                    [[cursor.key_value_dict["k"], na, nb]], "k:str,na:long,nb:long"
+                )
+
+            res = e.comap(z, cm, "k:str,na:long,nb:long", PartitionSpec(by=["k"]))
+            # inner zip: key c dropped
+            assert df_eq(
+                res, [["a", 2, 1], ["b", 1, 1]], "k:str,na:long,nb:long", throw=True
+            )
+
+        def test_zip_comap_left_outer(self):
+            e = self.engine
+            a = e.to_df([[1, "a"], [3, "b"]], "x:long,k:str")
+            b = e.to_df([["b", 20.0], ["c", 30.0]], "k:str,w:double")
+            z = e.zip(
+                DataFrames(a, b), how="left_outer",
+                partition_spec=PartitionSpec(by=["k"]),
+            )
+
+            def cm(cursor, dfs):
+                return ArrayDataFrame(
+                    [[cursor.key_value_dict["k"], dfs[0].count(), dfs[1].count()]],
+                    "k:str,na:long,nb:long",
+                )
+
+            res = e.comap(z, cm, "k:str,na:long,nb:long", PartitionSpec(by=["k"]))
+            assert df_eq(
+                res, [["a", 1, 0], ["b", 1, 1]], "k:str,na:long,nb:long", throw=True
+            )
+
+        def test_comap_with_named_dfs(self):
+            e = self.engine
+            a = e.to_df([[1, "a"]], "x:long,k:str")
+            b = e.to_df([["a", 10.0]], "k:str,w:double")
+            z = e.zip(
+                DataFrames(dict(left=a, right=b)),
+                partition_spec=PartitionSpec(by=["k"]),
+            )
+
+            def cm(cursor, dfs):
+                assert "left" in dfs and "right" in dfs
+                return ArrayDataFrame([[cursor.key_value_dict["k"]]], "k:str")
+
+            res = e.comap(z, cm, "k:str", PartitionSpec(by=["k"]))
+            assert df_eq(res, [["a"]], "k:str", throw=True)
+
+        # ---- persist / broadcast / repartition --------------------------
+        def test_persist_broadcast_repartition(self):
+            e = self.engine
+            a = e.to_df([[1], [2]], "x:long")
+            assert df_eq(e.persist(a), [[1], [2]], "x:long", throw=True)
+            assert df_eq(e.broadcast(a), [[1], [2]], "x:long", throw=True)
+            assert df_eq(
+                e.repartition(a, PartitionSpec(num=2)), [[1], [2]], "x:long",
+                throw=True,
+            )
+
+        # ---- io ---------------------------------------------------------
+        def test_save_load_parquet(self, tmp_path):
+            e = self.engine
+            a = e.to_df([[1, "a"], [2, None]], "x:long,y:str")
+            path = os.path.join(str(tmp_path), "a.parquet")
+            e.save_df(a, path)
+            res = e.load_df(path)
+            assert df_eq(res, [[1, "a"], [2, None]], "x:long,y:str", throw=True)
+            res = e.load_df(path, columns=["y"])
+            assert df_eq(res, [["a"], [None]], "y:str", throw=True)
+
+        def test_save_load_csv(self, tmp_path):
+            e = self.engine
+            a = e.to_df([[1, "a"]], "x:long,y:str")
+            path = os.path.join(str(tmp_path), "a.csv")
+            e.save_df(a, path, header=True)
+            res = e.load_df(path, header=True, infer_schema=False)
+            assert df_eq(res, [["1", "a"]], "x:str,y:str", throw=True)
+            res = e.load_df(path, header=True, columns="x:long,y:str")
+            assert df_eq(res, [[1, "a"]], "x:long,y:str", throw=True)
+
+        def test_save_load_json(self, tmp_path):
+            e = self.engine
+            a = e.to_df([[1, "a"], [2, None]], "x:long,y:str")
+            path = os.path.join(str(tmp_path), "a.json")
+            e.save_df(a, path)
+            res = e.load_df(path)
+            assert df_eq(res, [[1, "a"], [2, None]], "x:long,y:str", throw=True)
+
+        def test_save_modes(self, tmp_path):
+            e = self.engine
+            a = e.to_df([[1]], "x:long")
+            path = os.path.join(str(tmp_path), "m.parquet")
+            e.save_df(a, path)
+            with pytest.raises(Exception):
+                e.save_df(a, path, mode="error")
+            e.save_df(a, path, mode="append")
+            assert df_eq(e.load_df(path), [[1], [1]], "x:long", throw=True)
+            e.save_df(a, path, mode="overwrite")
+            assert df_eq(e.load_df(path), [[1]], "x:long", throw=True)
+
+        # ---- engine context ---------------------------------------------
+        def test_engine_context(self):
+            e = self.engine
+            with engine_context(e) as ee:
+                assert ee is e

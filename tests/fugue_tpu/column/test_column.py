@@ -1,0 +1,88 @@
+import pyarrow as pa
+import pytest
+
+from fugue_tpu.column import (
+    SelectColumns,
+    SQLExpressionGenerator,
+    all_cols,
+    col,
+    lit,
+    null,
+)
+from fugue_tpu.column import functions as ff
+from fugue_tpu.column.functions import is_agg
+from fugue_tpu.schema import Schema
+
+
+def test_expr_str():
+    assert str(col("a")) == "a"
+    assert str(col("a").alias("b")) == "a AS b"
+    assert str(lit(1)) == "1"
+    assert str(lit("x'y")) == "'x''y'"
+    assert str(lit(None)) == "NULL"
+    assert str(lit(True)) == "TRUE"
+    assert str((col("a") + 1) * 2) == "((a + 1) * 2)"
+    assert str(col("a") == 1) == "(a = 1)"
+    assert str((col("a") < 1) & (col("b") > 2)) == "((a < 1) AND (b > 2))"
+    assert str(~(col("a").is_null())) == "(NOT a IS NULL)"
+    assert str(ff.sum(col("a")).alias("s")) == "SUM(a) AS s"
+    assert str(ff.count_distinct(col("a"))) == "COUNT(DISTINCT a)"
+
+
+def test_infer_type():
+    s = Schema("a:int,b:str,c:double")
+    assert col("a").infer_type(s) == pa.int32()
+    assert (col("a") + col("c")).infer_type(s) == pa.float64()
+    assert (col("a") / 2).infer_type(s) == pa.float64()
+    assert (col("a") > 1).infer_type(s) == pa.bool_()
+    assert col("a").cast("str").infer_type(s) == pa.string()
+    assert lit(5).infer_type(s) == pa.int64()
+    assert ff.count(all_cols()).infer_type(s) == pa.int64()
+    assert ff.sum(col("a")).infer_type(s) == pa.int64()
+    assert ff.avg(col("a")).infer_type(s) == pa.float64()
+    assert ff.first(col("b")).infer_type(s) == pa.string()
+    assert ff.coalesce(col("b"), "z").infer_type(s) == pa.string()
+
+
+def test_is_agg():
+    assert is_agg(ff.sum(col("a")))
+    assert is_agg(ff.sum(col("a")) + 1)
+    assert is_agg(ff.max(col("a")) > ff.min(col("a")))
+    assert not is_agg(col("a"))
+    assert not is_agg(col("a") + 1)
+    assert not is_agg(lit(1))
+
+
+def test_select_columns():
+    sc = SelectColumns(col("a"), ff.sum(col("b")).alias("s"))
+    assert sc.has_agg
+    assert [str(c) for c in sc.group_keys] == ["a"]
+    with pytest.raises(Exception):
+        SelectColumns(all_cols(), ff.sum(col("b")).alias("s"))
+    with pytest.raises(Exception):
+        SelectColumns(col("a"), col("b") + 1).assert_all_with_names()
+    sc2 = SelectColumns(all_cols()).replace_wildcard(Schema("x:int,y:str"))
+    assert [str(c) for c in sc2.all_cols] == ["x", "y"]
+    schema = SelectColumns(
+        col("a"), ff.sum(col("b")).alias("s")
+    ).infer_schema(Schema("a:str,b:int"))
+    assert schema == "a:str,s:long"
+
+
+def test_sql_generator():
+    gen = SQLExpressionGenerator()
+    sc = SelectColumns(col("k"), ff.sum(col("v")).alias("s"))
+    sql = gen.select(sc, "t", where=col("v") > 0)
+    assert sql == "SELECT k, SUM(v) AS s FROM t WHERE (v > 0) GROUP BY k"
+    assert gen.generate(col("a") == None) == "(a IS NULL)"  # noqa: E711
+    assert gen.generate(col("a") != None) == "(a IS NOT NULL)"  # noqa: E711
+    assert gen.generate(col("a").cast("int")) == "CAST(a AS INT)"
+    sql = gen.select(SelectColumns(col("a")).distinct(), "t")
+    assert sql == "SELECT DISTINCT a FROM t"
+
+
+def test_no_bool():
+    with pytest.raises(ValueError):
+        bool(col("a") == 1)
+    with pytest.raises(ValueError):
+        assert col("a")
