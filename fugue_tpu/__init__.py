@@ -45,4 +45,42 @@ from fugue_tpu.execution import (
     set_global_engine,
 )
 
+from fugue_tpu.extensions import (
+    CoTransformer,
+    Creator,
+    OutputCoTransformer,
+    Outputter,
+    OutputTransformer,
+    Processor,
+    Transformer,
+    cotransformer,
+    creator,
+    output_cotransformer,
+    output_transformer,
+    outputter,
+    processor,
+    register_creator,
+    register_output_transformer,
+    register_outputter,
+    register_processor,
+    register_transformer,
+    transformer,
+)
+from fugue_tpu.rpc import (
+    EmptyRPCHandler,
+    RPCClient,
+    RPCFunc,
+    RPCHandler,
+    RPCServer,
+    make_rpc_server,
+    to_rpc_handler,
+)
+from fugue_tpu.workflow import (
+    FugueWorkflow,
+    FugueWorkflowResult,
+    WorkflowDataFrame,
+    module,
+)
+from fugue_tpu.workflow.api import out_transform, raw_sql, transform
+
 import fugue_tpu.registry  # noqa: F401  (registers builtin engines)

@@ -1,0 +1,76 @@
+"""The functional API: ``import fugue_tpu.api as fa`` (reference
+fugue/api.py:1-71 — one flat namespace over the whole framework)."""
+
+# dataframe/dataset functional ops
+from fugue_tpu.dataset.api import (
+    as_fugue_dataset,
+    count,
+    is_bounded,
+    is_empty,
+    is_local,
+    show,
+)
+from fugue_tpu.dataframe.api import (
+    alter_columns,
+    as_array,
+    as_array_iterable,
+    as_arrow,
+    as_dict_iterable,
+    as_pandas,
+    drop_columns,
+    get_column_names,
+    get_native_as_df,
+    get_schema,
+    head,
+    is_df,
+    normalize_dataframes,
+    peek_array,
+    peek_dict,
+    rename,
+    select_columns,
+)
+from fugue_tpu.dataframe.dataframe import as_fugue_df
+
+# engine management + eager ops
+from fugue_tpu.execution.api import (
+    aggregate,
+    anti_join,
+    assign,
+    broadcast,
+    clear_global_engine,
+    cross_join,
+    distinct,
+    dropna,
+    engine_context,
+    fillna,
+    filter,  # noqa: A004
+    full_outer_join,
+    get_context_engine,
+    get_current_conf,
+    get_current_parallelism,
+    inner_join,
+    intersect,
+    join,
+    left_outer_join,
+    load,
+    persist,
+    repartition,
+    right_outer_join,
+    sample,
+    save,
+    select,
+    semi_join,
+    set_global_engine,
+    subtract,
+    take,
+    union,
+)
+
+# workflow-level entry points
+from fugue_tpu.workflow.api import out_transform, raw_sql, transform
+
+# sql entry points
+from fugue_tpu.sql_frontend.api import fugue_sql, fugue_sql_flow
+
+# column algebra re-exports (fa.col, fa.lit usable in select/filter)
+from fugue_tpu.column import all_cols, col, lit, null

@@ -1,0 +1,459 @@
+"""The "interfaceless" core: map annotated python function signatures onto
+dataframe conversions so plain functions become transformers/processors.
+
+Parity target: reference ``fugue/dataframe/function_wrapper.py:41-463`` —
+each parameter/return annotation resolves to a one-letter code; converters
+validate the full code string with a regex (e.g. a transformer body must
+match ``^[lpqrRmMdPQ][fF]?x*$``).
+
+Codes:
+  input/output dataframes --
+    d DataFrame            l LocalDataFrame        p pd.DataFrame
+    q pa.Table             r List[List[Any]]       R Iterable[List[Any]]
+    m List[Dict[str,Any]]  M Iterable[Dict[str,Any]]
+    P Iterable[pd.DataFrame]   Q Iterable[pa.Table]
+    c DataFrames (multi-df)
+  specials --
+    f callable (required callback)   F Optional[callable]
+    e ExecutionEngine                x other keyword params
+    s PartitionCursor? (not used: cursor comes via context)
+  output only --
+    n None (output extensions)
+"""
+
+import inspect
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    Iterable,
+    Iterator,
+    List,
+    Optional,
+    Union,
+    get_args,
+    get_origin,
+    get_type_hints,
+)
+
+import pandas as pd
+import pyarrow as pa
+
+from fugue_tpu.dataframe import (
+    ArrayDataFrame,
+    ArrowDataFrame,
+    DataFrame,
+    DataFrames,
+    IterableArrowDataFrame,
+    IterableDataFrame,
+    IterablePandasDataFrame,
+    LocalDataFrame,
+    LocalDataFrameIterableDataFrame,
+    PandasDataFrame,
+)
+from fugue_tpu.plugins import fugue_plugin
+from fugue_tpu.schema import Schema
+from fugue_tpu.utils.assertion import assert_or_throw
+
+
+class AnnotatedParam:
+    """Handler for one annotation kind."""
+
+    code = "x"
+    format_hint: Optional[str] = None
+
+    def to_input(self, df: LocalDataFrame, ctx: Dict[str, Any]) -> Any:
+        raise NotImplementedError  # pragma: no cover
+
+    def to_output_df(self, output: Any, schema: Schema, ctx: Dict[str, Any]) -> LocalDataFrame:
+        raise NotImplementedError  # pragma: no cover
+
+    def count(self, obj: Any) -> int:
+        """Row count of a produced output (for outputters' bookkeeping)."""
+        return -1
+
+
+_PARAM_REGISTRY: List[Any] = []  # (matcher, param_factory)
+
+
+def fugue_annotated_param(
+    annotation: Any, matcher: Optional[Callable[[Any], bool]] = None
+) -> Callable:
+    """Register an AnnotatedParam class for an annotation (the extension
+    point backends use to accept their native frame types in transformers —
+    the fugue_polars integration pattern, SURVEY §2.7)."""
+
+    def deco(cls: type) -> type:
+        if matcher is not None:
+            _PARAM_REGISTRY.append((matcher, cls))
+        else:
+            _PARAM_REGISTRY.append((lambda a: a == annotation, cls))
+        return cls
+
+    return deco
+
+
+def _resolve_param(annotation: Any) -> Optional[AnnotatedParam]:
+    for matcher, cls in reversed(_PARAM_REGISTRY):
+        try:
+            if matcher(annotation):
+                return cls()
+        except Exception:
+            continue
+    return None
+
+
+# ---- dataframe params ------------------------------------------------------
+@fugue_annotated_param(DataFrame)
+class _DataFrameParam(AnnotatedParam):
+    code = "d"
+
+    def to_input(self, df: LocalDataFrame, ctx: Dict[str, Any]) -> Any:
+        return df
+
+    def to_output_df(self, output: Any, schema: Schema, ctx: Dict[str, Any]) -> LocalDataFrame:
+        assert_or_throw(
+            isinstance(output, DataFrame), ValueError(f"{output} is not a DataFrame")
+        )
+        assert_or_throw(
+            output.schema == schema,
+            ValueError(f"schema mismatch {output.schema} vs {schema}"),
+        )
+        return output.as_local()
+
+
+@fugue_annotated_param(LocalDataFrame)
+class _LocalDataFrameParam(_DataFrameParam):
+    code = "l"
+
+
+@fugue_annotated_param(pd.DataFrame)
+class _PandasParam(AnnotatedParam):
+    code = "p"
+    format_hint = "pandas"
+
+    def to_input(self, df: LocalDataFrame, ctx: Dict[str, Any]) -> Any:
+        return df.as_pandas()
+
+    def to_output_df(self, output: Any, schema: Schema, ctx: Dict[str, Any]) -> LocalDataFrame:
+        assert_or_throw(
+            isinstance(output, pd.DataFrame), ValueError("output is not pd.DataFrame")
+        )
+        return PandasDataFrame(output, schema)
+
+    def count(self, obj: Any) -> int:
+        return len(obj)
+
+
+@fugue_annotated_param(pa.Table)
+class _ArrowParam(AnnotatedParam):
+    code = "q"
+    format_hint = "pyarrow"
+
+    def to_input(self, df: LocalDataFrame, ctx: Dict[str, Any]) -> Any:
+        return df.as_arrow()
+
+    def to_output_df(self, output: Any, schema: Schema, ctx: Dict[str, Any]) -> LocalDataFrame:
+        assert_or_throw(
+            isinstance(output, pa.Table), ValueError("output is not pa.Table")
+        )
+        return ArrowDataFrame(output, schema)
+
+    def count(self, obj: Any) -> int:
+        return obj.num_rows
+
+
+@fugue_annotated_param(List[List[Any]])
+class _RowsParam(AnnotatedParam):
+    code = "r"
+
+    def to_input(self, df: LocalDataFrame, ctx: Dict[str, Any]) -> Any:
+        return df.as_array(type_safe=True)
+
+    def to_output_df(self, output: Any, schema: Schema, ctx: Dict[str, Any]) -> LocalDataFrame:
+        return ArrayDataFrame(output, schema)
+
+    def count(self, obj: Any) -> int:
+        return len(obj)
+
+
+@fugue_annotated_param(Iterable[List[Any]])
+class _IterRowsParam(AnnotatedParam):
+    code = "R"
+
+    def to_input(self, df: LocalDataFrame, ctx: Dict[str, Any]) -> Any:
+        return df.as_array_iterable(type_safe=True)
+
+    def to_output_df(self, output: Any, schema: Schema, ctx: Dict[str, Any]) -> LocalDataFrame:
+        return IterableDataFrame(output, schema)
+
+
+@fugue_annotated_param(List[Dict[str, Any]])
+class _DictsParam(AnnotatedParam):
+    code = "m"
+
+    def to_input(self, df: LocalDataFrame, ctx: Dict[str, Any]) -> Any:
+        return list(df.as_dict_iterable())
+
+    def to_output_df(self, output: Any, schema: Schema, ctx: Dict[str, Any]) -> LocalDataFrame:
+        return ArrayDataFrame(
+            ([row.get(n) for n in schema.names] for row in output), schema
+        )
+
+    def count(self, obj: Any) -> int:
+        return len(obj)
+
+
+@fugue_annotated_param(Iterable[Dict[str, Any]])
+class _IterDictsParam(AnnotatedParam):
+    code = "M"
+
+    def to_input(self, df: LocalDataFrame, ctx: Dict[str, Any]) -> Any:
+        return df.as_dict_iterable()
+
+    def to_output_df(self, output: Any, schema: Schema, ctx: Dict[str, Any]) -> LocalDataFrame:
+        return IterableDataFrame(
+            ([row.get(n) for n in schema.names] for row in output), schema
+        )
+
+
+@fugue_annotated_param(Iterable[pd.DataFrame])
+class _IterPandasParam(AnnotatedParam):
+    code = "P"
+    format_hint = "pandas"
+
+    def to_input(self, df: LocalDataFrame, ctx: Dict[str, Any]) -> Any:
+        if isinstance(df, LocalDataFrameIterableDataFrame):
+            return (chunk.as_pandas() for chunk in df.native)
+        return iter([df.as_pandas()])
+
+    def to_output_df(self, output: Any, schema: Schema, ctx: Dict[str, Any]) -> LocalDataFrame:
+        return IterablePandasDataFrame(
+            (PandasDataFrame(o, schema) for o in output), schema
+        )
+
+
+@fugue_annotated_param(Iterable[pa.Table])
+class _IterArrowParam(AnnotatedParam):
+    code = "Q"
+    format_hint = "pyarrow"
+
+    def to_input(self, df: LocalDataFrame, ctx: Dict[str, Any]) -> Any:
+        if isinstance(df, LocalDataFrameIterableDataFrame):
+            return (chunk.as_arrow() for chunk in df.native)
+        return iter([df.as_arrow()])
+
+    def to_output_df(self, output: Any, schema: Schema, ctx: Dict[str, Any]) -> LocalDataFrame:
+        return IterableArrowDataFrame(
+            (ArrowDataFrame(o, schema) for o in output), schema
+        )
+
+
+@fugue_annotated_param(DataFrames)
+class _DataFramesParam(AnnotatedParam):
+    code = "c"
+
+
+# Iterator[...] behaves like Iterable[...]
+fugue_annotated_param(Iterator[List[Any]])(_IterRowsParam)
+fugue_annotated_param(Iterator[Dict[str, Any]])(_IterDictsParam)
+fugue_annotated_param(Iterator[pd.DataFrame])(_IterPandasParam)
+fugue_annotated_param(Iterator[pa.Table])(_IterArrowParam)
+
+
+# ---- special params --------------------------------------------------------
+class _CallbackParam(AnnotatedParam):
+    code = "f"
+
+
+class _OptionalCallbackParam(AnnotatedParam):
+    code = "F"
+
+
+class _EngineParam(AnnotatedParam):
+    code = "e"
+
+
+class _OtherParam(AnnotatedParam):
+    code = "x"
+
+
+class _NoneParam(AnnotatedParam):
+    code = "n"
+
+
+_DF_INPUT_CODES = "dlpqrRmMPQ"
+_DF_OUTPUT_CODES = "dlpqrRmMPQ"
+
+
+def annotation_code(annotation: Any) -> str:
+    p = _annotation_param(annotation)
+    return p.code
+
+
+def _annotation_param(anno: Any) -> AnnotatedParam:
+    from fugue_tpu.execution.execution_engine import ExecutionEngine
+
+    if anno is None or anno is type(None) or anno is inspect.Parameter.empty:
+        return _OtherParam()
+    if anno == "None":
+        return _NoneParam()
+    # Callable / Optional[Callable]
+    import collections.abc as _abc
+
+    origin = get_origin(anno)
+    if anno is Callable or anno is callable or origin is _abc.Callable:
+        return _CallbackParam()
+    if origin is Union:
+        args = [a for a in get_args(anno) if a is not type(None)]
+        if len(args) == 1:
+            inner = _annotation_param(args[0])
+            if inner.code == "f":
+                return _OptionalCallbackParam()
+            return inner
+    if isinstance(anno, type) and issubclass(anno, ExecutionEngine):
+        return _EngineParam()
+    resolved = _resolve_param(anno)
+    if resolved is not None:
+        return resolved
+    # typing generics equality (List[List[Any]] etc.) handled by registry via ==
+    return _OtherParam()
+
+
+class _Param:
+    def __init__(self, name: str, param: AnnotatedParam, required: bool):
+        self.name = name
+        self.param = param
+        self.required = required
+
+    @property
+    def code(self) -> str:
+        return self.param.code
+
+
+class DataFrameFunctionWrapper:
+    """Wrap a plain function: classify each param/return, validate the code
+    string, and at call time convert dataframes to the annotated formats."""
+
+    def __init__(self, func: Callable, params_re: str = ".*", return_re: str = ".*"):
+        import re
+
+        self._func = func
+        sig = inspect.signature(func)
+        try:
+            hints = get_type_hints(func)
+        except Exception:
+            hints = {}
+        self._params: List[_Param] = []
+        for name, p in sig.parameters.items():
+            assert_or_throw(
+                p.kind
+                not in (inspect.Parameter.VAR_POSITIONAL, inspect.Parameter.VAR_KEYWORD),
+                TypeError("*args/**kwargs not supported in fugue functions"),
+            )
+            anno = hints.get(name, p.annotation)
+            self._params.append(
+                _Param(name, _annotation_param(anno), p.default is inspect.Parameter.empty)
+            )
+        ret_anno = hints.get("return", sig.return_annotation)
+        if ret_anno is None or ret_anno is type(None) or (
+            ret_anno is inspect.Signature.empty
+        ):
+            self._rt: AnnotatedParam = _NoneParam()
+        else:
+            self._rt = _annotation_param(ret_anno)
+            if isinstance(self._rt, _OtherParam):
+                self._rt = _NoneParam()
+        self._input_code = "".join(p.code for p in self._params)
+        assert_or_throw(
+            re.match(params_re, self._input_code) is not None,
+            TypeError(
+                f"signature code {self._input_code!r} of {func} doesn't match "
+                f"{params_re!r}"
+            ),
+        )
+        assert_or_throw(
+            re.match(return_re, self._rt.code) is not None,
+            TypeError(f"return code {self._rt.code!r} of {func} doesn't match {return_re!r}"),
+        )
+
+    @property
+    def func(self) -> Callable:
+        return self._func
+
+    @property
+    def input_code(self) -> str:
+        return self._input_code
+
+    @property
+    def output_code(self) -> str:
+        return self._rt.code
+
+    @property
+    def params(self) -> List[_Param]:
+        return self._params
+
+    @property
+    def need_engine(self) -> bool:
+        return "e" in self._input_code
+
+    @property
+    def need_callback(self) -> bool:
+        return "f" in self._input_code or "F" in self._input_code
+
+    def get_format_hint(self) -> Optional[str]:
+        for p in self._params:
+            if p.param.format_hint is not None:
+                return p.param.format_hint
+        return self._rt.format_hint
+
+    def run(
+        self,
+        args: List[Any],
+        kwargs: Dict[str, Any],
+        output_schema: Any = None,
+        output: bool = True,
+        ctx: Optional[Dict[str, Any]] = None,
+        ignore_unknown: bool = True,
+    ) -> Any:
+        """Call the wrapped function: ``args`` are LocalDataFrames (or
+        DataFrames collection) mapped in order onto dataframe-coded params;
+        ``kwargs`` fill the ``x`` params; callback/engine come from ``ctx``."""
+        ctx = ctx or {}
+        call_args: Dict[str, Any] = {}
+        dfs = list(args)
+        for p in self._params:
+            if p.code in _DF_INPUT_CODES and len(dfs) > 0:
+                call_args[p.name] = p.param.to_input(dfs.pop(0), ctx)
+            elif p.code == "c":
+                call_args[p.name] = dfs.pop(0)
+            elif p.code in ("f", "F"):
+                cb = ctx.get("callback")
+                assert_or_throw(
+                    cb is not None or p.code == "F",
+                    ValueError(f"callback required by {p.name} but not provided"),
+                )
+                call_args[p.name] = cb
+            elif p.code == "e":
+                call_args[p.name] = ctx.get("engine")
+            else:  # x
+                if p.name in kwargs:
+                    call_args[p.name] = kwargs[p.name]
+                elif p.required:
+                    raise ValueError(f"param {p.name} is required but not provided")
+        if not ignore_unknown:
+            known = {p.name for p in self._params}
+            unknown = [k for k in kwargs if k not in known]
+            assert_or_throw(
+                len(unknown) == 0, ValueError(f"unknown params {unknown}")
+            )
+        res = self._func(**call_args)
+        if not output:
+            if isinstance(res, Iterator):
+                for _ in res:  # drain generators so they execute
+                    pass
+            return None
+        if output_schema is None:
+            return res
+        schema = Schema(output_schema)
+        return self._rt.to_output_df(res, schema, ctx)

@@ -1,0 +1,465 @@
+"""Builtin extensions: the operations workflow tasks lower to (reference
+fugue/extensions/_builtins/{creators,processors,outputters}.py)."""
+
+from typing import Any, Callable, Dict, List, Optional, Type
+
+from fugue_tpu.collections.partition import PartitionCursor, PartitionSpec
+from fugue_tpu.collections.sql import StructuredRawSQL
+from fugue_tpu.column.expressions import ColumnExpr
+from fugue_tpu.column.sql import SelectColumns
+from fugue_tpu.dataframe import (
+    ArrayDataFrame,
+    DataFrame,
+    DataFrames,
+    LocalDataFrame,
+)
+from fugue_tpu.dataframe.utils import df_eq
+from fugue_tpu.extensions.convert import (
+    _to_output_transformer,
+    _to_transformer,
+)
+from fugue_tpu.extensions.interfaces import (
+    CoTransformer,
+    Creator,
+    OUTPUT_TRANSFORMER_DUMMY_SCHEMA,
+    Outputter,
+    Processor,
+    Transformer,
+)
+from fugue_tpu.extensions.validation import (
+    validate_input_schema,
+    validate_partition_spec,
+)
+from fugue_tpu.schema import Schema
+from fugue_tpu.utils.assertion import assert_or_throw
+
+
+# ---- creators --------------------------------------------------------------
+class Load(Creator):
+    def create(self) -> DataFrame:
+        kwargs = self.params.get("params", dict())
+        path = self.params.get_or_throw("path", object)
+        format_hint = self.params.get("fmt", "")
+        columns = self.params.get("columns", None)
+        return self.execution_engine.load_df(
+            path=path,
+            format_hint=format_hint if format_hint != "" else None,
+            columns=columns,
+            **kwargs,
+        )
+
+
+class CreateData(Creator):
+    def create(self) -> DataFrame:
+        data = self.params.get_or_throw("data", object)
+        schema = self.params.get("schema", None)
+        return self.execution_engine.to_df(
+            data, schema=None if schema is None else Schema(schema)
+        )
+
+
+# ---- transform lowering ----------------------------------------------------
+class _TransformerRunner:
+    """Worker-side runner: fills cursor/context, converts, applies the user
+    transformer, optionally swallowing per-partition failures (reference
+    _builtins/processors.py:322)."""
+
+    def __init__(
+        self,
+        df: DataFrame,
+        transformer: Transformer,
+        ignore_errors: List[type],
+    ):
+        self.schema = df.schema
+        self.metadata = df.metadata if df.has_metadata else None
+        self.transformer = transformer
+        self.ignore_errors = tuple(ignore_errors)
+
+    def run(self, cursor: PartitionCursor, df: LocalDataFrame) -> LocalDataFrame:
+        self.transformer._cursor = cursor  # type: ignore
+        df._metadata = self.metadata
+        if len(self.ignore_errors) == 0:
+            return self.transformer.transform(df)
+        try:
+            return self.transformer.transform(df).as_local_bounded()
+        except self.ignore_errors:
+            return ArrayDataFrame([], self.transformer.output_schema)
+
+    def on_init(self, partition_no: int, df: DataFrame) -> None:
+        s = self.transformer.partition_spec
+        self.transformer._cursor = s.get_cursor(self.schema, partition_no)  # type: ignore
+        self.transformer.on_init(df)
+
+
+class _CoTransformerRunner:
+    def __init__(
+        self,
+        df: DataFrame,
+        transformer: CoTransformer,
+        ignore_errors: List[type],
+    ):
+        self.schema = df.schema
+        self.transformer = transformer
+        self.ignore_errors = tuple(ignore_errors)
+
+    def run(self, cursor: PartitionCursor, dfs: DataFrames) -> LocalDataFrame:
+        self.transformer._cursor = cursor  # type: ignore
+        if len(self.ignore_errors) == 0:
+            return self.transformer.transform(dfs)
+        try:
+            return self.transformer.transform(dfs).as_local_bounded()
+        except self.ignore_errors:
+            return ArrayDataFrame([], self.transformer.output_schema)
+
+    def on_init(self, partition_no: int, dfs: DataFrames) -> None:
+        s = self.transformer.partition_spec
+        self.transformer._cursor = s.get_cursor(self.schema, partition_no)  # type: ignore
+        self.transformer.on_init(dfs)
+
+
+class RunTransformer(Processor):
+    """Lower transform() to map_dataframe / comap (reference processors.py:23)."""
+
+    def process(self, dfs: DataFrames) -> DataFrame:
+        df = dfs[0]
+        tf = _to_transformer(
+            self.params.get_or_throw("transformer", object),
+            self.params.get("schema", None),
+        )
+        return _lower_transform(self, df, tf)
+
+    def _run_cotransform(
+        self, df: DataFrame, tf: CoTransformer, ignore_errors: List[type]
+    ) -> DataFrame:
+        return _lower_cotransform(self, df, tf, ignore_errors)
+
+
+def _lower_transform(host: Any, df: DataFrame, tf: Any) -> DataFrame:
+    """Shared lowering used by RunTransformer and RunOutputTransformer:
+    configure the transformer and dispatch to map_dataframe or comap."""
+    tf._workflow_conf = host.execution_engine.conf
+    tf._params = host.params.get("params", dict())
+    tf._partition_spec = host.partition_spec
+    rpc_handler = host.params.get("rpc_handler", None)
+    if rpc_handler is not None:
+        tf._callback = host.rpc_server.make_client(rpc_handler)
+    ignore_errors = host.params.get("ignore_errors", [])
+    validate_partition_spec(tf.validation_rules, host.partition_spec)
+    if bool(df.metadata.get("serialized", False)):
+        assert_or_throw(
+            isinstance(tf, CoTransformer),
+            TypeError(f"{tf} is not a CoTransformer but the input is zipped"),
+        )
+        return _lower_cotransform(host, df, tf, ignore_errors)
+    assert_or_throw(
+        isinstance(tf, Transformer), TypeError(f"{tf} is not a Transformer")
+    )
+    validate_input_schema(tf.validation_rules, df.schema)
+    tf._key_schema = host.partition_spec.get_key_schema(df.schema)
+    output_schema = Schema(tf.get_output_schema(df))
+    tf._output_schema = output_schema
+    runner = _TransformerRunner(df, tf, ignore_errors)
+    fmt = getattr(tf, "get_format_hint", lambda: None)()
+    return host.execution_engine.map_engine.map_dataframe(
+        df,
+        map_func=runner.run,
+        output_schema=output_schema,
+        partition_spec=host.partition_spec,
+        on_init=runner.on_init,
+        map_func_format_hint=fmt,
+    )
+
+
+def _lower_cotransform(
+    host: Any, df: DataFrame, tf: CoTransformer, ignore_errors: List[type]
+) -> DataFrame:
+    from fugue_tpu.execution.execution_engine import (
+        _ZIP_NAMES_META,
+        _ZIP_SCHEMAS_META,
+    )
+
+    schemas = [Schema(s) for s in df.metadata[_ZIP_SCHEMAS_META]]
+    names = df.metadata[_ZIP_NAMES_META]
+    if any(n != "" for n in names):
+        empty_dfs = DataFrames(
+            {n: ArrayDataFrame([], s) for n, s in zip(names, schemas)}
+        )
+    else:
+        empty_dfs = DataFrames([ArrayDataFrame([], s) for s in schemas])
+    tf._key_schema = Schema(
+        [df.schema[n] for n in df.schema.names
+         if not n.startswith("_fugue_ser_")]
+    )
+    output_schema = Schema(tf.get_output_schema(empty_dfs))
+    tf._output_schema = output_schema
+    runner = _CoTransformerRunner(df, tf, ignore_errors)
+    return host.execution_engine.comap(
+        df,
+        map_func=runner.run,
+        output_schema=output_schema,
+        partition_spec=host.partition_spec,
+        on_init=runner.on_init,
+    )
+
+
+class RunJoin(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        if len(dfs) == 1:
+            return dfs[0]
+        how = self.params.get_or_throw("how", str)
+        on = self.params.get("on", [])
+        df = dfs[0]
+        for i in range(1, len(dfs)):
+            df = self.execution_engine.join(
+                df, dfs[i], how=how, on=on if len(on) > 0 else None
+            )
+        return df
+
+
+class RunSetOperation(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        if len(dfs) == 1:
+            return dfs[0]
+        how = self.params.get_or_throw("how", str)
+        func: Callable = {
+            "union": self.execution_engine.union,
+            "subtract": self.execution_engine.subtract,
+            "intersect": self.execution_engine.intersect,
+        }[how]
+        distinct = self.params.get("distinct", True)
+        df = dfs[0]
+        for i in range(1, len(dfs)):
+            df = func(df, dfs[i], distinct=distinct)
+        return df
+
+
+class Distinct(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        assert_or_throw(len(dfs) == 1, ValueError("distinct takes 1 df"))
+        return self.execution_engine.distinct(dfs[0])
+
+
+class Dropna(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        assert_or_throw(len(dfs) == 1, ValueError("dropna takes 1 df"))
+        return self.execution_engine.dropna(
+            dfs[0],
+            how=self.params.get("how", "any"),
+            thresh=self.params.get_or_none("thresh", int),
+            subset=self.params.get("subset", None),
+        )
+
+
+class Fillna(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        assert_or_throw(len(dfs) == 1, ValueError("fillna takes 1 df"))
+        return self.execution_engine.fillna(
+            dfs[0],
+            value=self.params.get_or_throw("value", object),
+            subset=self.params.get("subset", None),
+        )
+
+
+class RunSQLSelect(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        statement = self.params.get_or_throw("statement", object)
+        if isinstance(statement, str):
+            statement = StructuredRawSQL([(False, statement)])
+        engine = self.execution_engine.sql_engine
+        return engine.select(dfs, statement)
+
+
+class Zip(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        how = self.params.get("how", "inner")
+        return self.execution_engine.zip(
+            dfs,
+            how=how,
+            partition_spec=self.partition_spec,
+            temp_path=self.params.get("temp_path", None),
+            to_file_threshold=self.params.get("to_file_threshold", -1),
+        )
+
+
+class Select(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        assert_or_throw(len(dfs) == 1, ValueError("select takes 1 df"))
+        return self.execution_engine.select(
+            dfs[0],
+            cols=self.params.get_or_throw("columns", SelectColumns),
+            where=self.params.get("where", None),
+            having=self.params.get("having", None),
+        )
+
+
+class Filter(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        assert_or_throw(len(dfs) == 1, ValueError("filter takes 1 df"))
+        return self.execution_engine.filter(
+            dfs[0], condition=self.params.get_or_throw("condition", ColumnExpr)
+        )
+
+
+class Assign(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        assert_or_throw(len(dfs) == 1, ValueError("assign takes 1 df"))
+        return self.execution_engine.assign(
+            dfs[0], columns=self.params.get_or_throw("columns", list)
+        )
+
+
+class Aggregate(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        assert_or_throw(len(dfs) == 1, ValueError("aggregate takes 1 df"))
+        return self.execution_engine.aggregate(
+            dfs[0],
+            partition_spec=self.partition_spec,
+            agg_cols=self.params.get_or_throw("columns", list),
+        )
+
+
+class Rename(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        assert_or_throw(len(dfs) == 1, ValueError("rename takes 1 df"))
+        return dfs[0].rename(self.params.get_or_throw("columns", dict))
+
+
+class AlterColumns(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        assert_or_throw(len(dfs) == 1, ValueError("alter_columns takes 1 df"))
+        return dfs[0].alter_columns(self.params.get_or_throw("columns", object))
+
+
+class DropColumns(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        assert_or_throw(len(dfs) == 1, ValueError("drop takes 1 df"))
+        if self.params.get("if_exists", False):
+            columns = [
+                c for c in self.params.get_or_throw("columns", list)
+                if c in dfs[0].schema
+            ]
+            if len(columns) == 0:
+                return dfs[0]
+        else:
+            columns = self.params.get_or_throw("columns", list)
+        return dfs[0].drop(columns)
+
+
+class SelectColumnsP(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        assert_or_throw(len(dfs) == 1, ValueError("select_columns takes 1 df"))
+        return dfs[0][self.params.get_or_throw("columns", list)]
+
+
+class Sample(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        assert_or_throw(len(dfs) == 1, ValueError("sample takes 1 df"))
+        return self.execution_engine.sample(
+            dfs[0],
+            n=self.params.get_or_none("n", int),
+            frac=self.params.get_or_none("frac", float),
+            replace=self.params.get("replace", False),
+            seed=self.params.get_or_none("seed", int),
+        )
+
+
+class Take(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        assert_or_throw(len(dfs) == 1, ValueError("take takes 1 df"))
+        return self.execution_engine.take(
+            dfs[0],
+            n=self.params.get_or_throw("n", int),
+            presort=self.params.get("presort", ""),
+            na_position=self.params.get("na_position", "last"),
+            partition_spec=self.partition_spec,
+        )
+
+
+class SaveAndUse(Processor):
+    def process(self, dfs: DataFrames) -> DataFrame:
+        assert_or_throw(len(dfs) == 1, ValueError("save_and_use takes 1 df"))
+        kwargs = self.params.get("params", dict())
+        path = self.params.get_or_throw("path", str)
+        format_hint = self.params.get("fmt", "")
+        mode = self.params.get("mode", "overwrite")
+        self.execution_engine.save_df(
+            dfs[0], path=path,
+            format_hint=format_hint if format_hint != "" else None,
+            mode=mode, partition_spec=self.partition_spec, **kwargs,
+        )
+        return self.execution_engine.load_df(
+            path, format_hint=format_hint if format_hint != "" else None
+        )
+
+
+# ---- outputters ------------------------------------------------------------
+class Show(Outputter):
+    def process(self, dfs: DataFrames) -> None:
+        n = self.params.get("n", 10)
+        with_count = self.params.get("with_count", False)
+        title = self.params.get("title", "")
+        for df in dfs.values():
+            df.show(n, with_count, title if title != "" else None)
+
+
+class AssertEqFunc(Outputter):
+    def process(self, dfs: DataFrames) -> None:
+        assert_or_throw(len(dfs) >= 2, ValueError("assert_eq requires >= 2 dfs"))
+        expected = dfs[0]
+        for i in range(1, len(dfs)):
+            df_eq(
+                expected,
+                dfs[i],
+                throw=True,
+                check_order=self.params.get("check_order", False),
+                check_schema=self.params.get("check_schema", True),
+                digits=self.params.get("digits", 8),
+            )
+
+
+class AssertNotEqFunc(Outputter):
+    def process(self, dfs: DataFrames) -> None:
+        assert_or_throw(len(dfs) >= 2, ValueError("assert_not_eq requires >= 2 dfs"))
+        expected = dfs[0]
+        for i in range(1, len(dfs)):
+            assert_or_throw(
+                not df_eq(
+                    expected,
+                    dfs[i],
+                    check_order=self.params.get("check_order", False),
+                    check_schema=self.params.get("check_schema", True),
+                ),
+                AssertionError("dataframes are equal"),
+            )
+
+
+class Save(Outputter):
+    def process(self, dfs: DataFrames) -> None:
+        assert_or_throw(len(dfs) == 1, ValueError("save takes 1 df"))
+        kwargs = self.params.get("params", dict())
+        path = self.params.get_or_throw("path", str)
+        format_hint = self.params.get("fmt", "")
+        mode = self.params.get("mode", "overwrite")
+        force_single = self.params.get("single", False)
+        self.execution_engine.save_df(
+            dfs[0],
+            path=path,
+            format_hint=format_hint if format_hint != "" else None,
+            mode=mode,
+            partition_spec=self.partition_spec,
+            force_single=force_single,
+            **kwargs,
+        )
+
+
+class RunOutputTransformer(Outputter):
+    """Lower out_transform() to map (discarding output)."""
+
+    def process(self, dfs: DataFrames) -> None:
+        df = dfs[0]
+        tf = _to_output_transformer(
+            self.params.get_or_throw("transformer", object),
+        )
+        out = _lower_transform(self, df, tf)
+        # materialize to force execution on lazy engines
+        out.as_local()

@@ -1,0 +1,77 @@
+"""ExtensionContext: what every extension sees at runtime (reference
+fugue/extensions/context.py:13-121)."""
+
+from typing import Any, Dict, Optional
+
+from fugue_tpu.collections.partition import PartitionCursor, PartitionSpec
+from fugue_tpu.rpc import RPCClient, RPCServer
+from fugue_tpu.schema import Schema
+from fugue_tpu.utils.params import ParamDict
+
+
+class ExtensionContext:
+    """Mixin giving extensions access to params, engine, partition info,
+    callback channel and validation rules. The framework fills the underlying
+    attributes before invoking the extension."""
+
+    @property
+    def params(self) -> ParamDict:
+        return getattr(self, "_params", ParamDict())
+
+    @property
+    def workflow_conf(self) -> ParamDict:
+        return getattr(self, "_workflow_conf", ParamDict())
+
+    @property
+    def execution_engine(self) -> Any:
+        e = getattr(self, "_execution_engine", None)
+        assert e is not None, "execution_engine not set"
+        return e
+
+    @property
+    def output_schema(self) -> Schema:
+        s = getattr(self, "_output_schema", None)
+        assert s is not None, "output_schema not set"
+        return s
+
+    @property
+    def key_schema(self) -> Schema:
+        return getattr(self, "_key_schema", Schema())
+
+    @property
+    def partition_spec(self) -> PartitionSpec:
+        return getattr(self, "_partition_spec", PartitionSpec())
+
+    @property
+    def cursor(self) -> PartitionCursor:
+        c = getattr(self, "_cursor", None)
+        assert c is not None, "cursor not set"
+        return c
+
+    @property
+    def has_callback(self) -> bool:
+        return getattr(self, "_callback", None) is not None
+
+    @property
+    def callback(self) -> RPCClient:
+        c = getattr(self, "_callback", None)
+        assert c is not None, "callback not set"
+        return c
+
+    @property
+    def rpc_server(self) -> RPCServer:
+        s = getattr(self, "_rpc_server", None)
+        assert s is not None, "rpc_server not set"
+        return s
+
+    @property
+    def validation_rules(self) -> Dict[str, Any]:
+        return {}
+
+    def validate_on_compile(self) -> None:
+        """Hook: raise on invalid config at DAG build time."""
+        pass
+
+    def validate_on_runtime(self, data: Any) -> None:
+        """Hook: raise on invalid input at execution time."""
+        pass

@@ -1,0 +1,498 @@
+"""Interfaceless converters: accept a class, an instance, a plain function
+(schema via argument or ``# schema:`` comment) or a registered alias, and
+produce extension objects (reference fugue/extensions/*/convert.py).
+
+Signature acceptance is validated by regex over the one-letter param codes of
+DataFrameFunctionWrapper (reference convert.py:328-560 pattern)."""
+
+import copy
+from typing import Any, Callable, Dict, List, Optional
+
+from fugue_tpu.dataframe import DataFrame, DataFrames, LocalDataFrame
+from fugue_tpu.dataframe.function_wrapper import DataFrameFunctionWrapper
+from fugue_tpu.extensions.interfaces import (
+    CoTransformer,
+    Creator,
+    OutputCoTransformer,
+    Outputter,
+    OutputTransformer,
+    Processor,
+    Transformer,
+)
+from fugue_tpu.extensions.schema_hint import apply_schema_hint, parse_comment_annotation
+from fugue_tpu.extensions.validation import (
+    parse_validation_rules_from_comment,
+    validate_rules,
+)
+from fugue_tpu.plugins import fugue_plugin
+from fugue_tpu.schema import Schema
+from fugue_tpu.utils.assertion import assert_or_throw
+from fugue_tpu.utils.hash import to_uuid
+
+_DF = "[dlpqrRmMPQ]"
+
+_REGISTRIES: Dict[str, Dict[str, Any]] = {
+    "creator": {},
+    "processor": {},
+    "outputter": {},
+    "transformer": {},
+    "output_transformer": {},
+    "cotransformer": {},
+    "output_cotransformer": {},
+}
+
+
+def _register(kind: str, name: str, extension: Any, on_dup: str = "overwrite") -> None:
+    reg = _REGISTRIES[kind]
+    if name in reg:
+        if on_dup == "throw":
+            raise KeyError(f"{kind} {name} already registered")
+        if on_dup == "ignore":
+            return
+    reg[name] = extension
+
+
+def register_creator(alias: str, obj: Any, on_dup: str = "overwrite") -> None:
+    _register("creator", alias, obj, on_dup)
+
+
+def register_processor(alias: str, obj: Any, on_dup: str = "overwrite") -> None:
+    _register("processor", alias, obj, on_dup)
+
+
+def register_outputter(alias: str, obj: Any, on_dup: str = "overwrite") -> None:
+    _register("outputter", alias, obj, on_dup)
+
+
+def register_transformer(alias: str, obj: Any, on_dup: str = "overwrite") -> None:
+    _register("transformer", alias, obj, on_dup)
+
+
+def register_output_transformer(alias: str, obj: Any, on_dup: str = "overwrite") -> None:
+    _register("output_transformer", alias, obj, on_dup)
+
+
+# ---- parse plugins (backends add namespaced creators like "myio:...") ------
+@fugue_plugin
+def parse_creator(obj: Any) -> Any:
+    return obj
+
+
+@fugue_plugin
+def parse_processor(obj: Any) -> Any:
+    return obj
+
+
+@fugue_plugin
+def parse_outputter(obj: Any) -> Any:
+    return obj
+
+
+@fugue_plugin
+def parse_transformer(obj: Any) -> Any:
+    return obj
+
+
+@fugue_plugin
+def parse_output_transformer(obj: Any) -> Any:
+    return obj
+
+
+# ---- function-backed extensions -------------------------------------------
+class _FuncExtension:
+    """Shared machinery for _FuncAs* wrappers."""
+
+    def __init__(self, wrapper: DataFrameFunctionWrapper, validation: Dict[str, Any]):
+        self._wrapper = wrapper
+        self._validation = validation
+
+    @property
+    def validation_rules(self) -> Dict[str, Any]:
+        return self._validation
+
+    @property
+    def wrapper(self) -> DataFrameFunctionWrapper:
+        return self._wrapper
+
+    def __uuid__(self) -> str:
+        return to_uuid(type(self).__name__, self._wrapper.func, self._validation)
+
+    def _ctx(self) -> Dict[str, Any]:
+        return dict(
+            callback=getattr(self, "_callback", None),
+            engine=getattr(self, "_execution_engine", None),
+        )
+
+
+class _FuncAsTransformer(_FuncExtension, Transformer):
+    """Plain function -> Transformer (reference convert.py:328)."""
+
+    def __init__(
+        self, wrapper: DataFrameFunctionWrapper, schema: Any, validation: Dict[str, Any]
+    ):
+        super().__init__(wrapper, validation)
+        self._schema_hint = schema
+
+    def get_output_schema(self, df: DataFrame) -> Any:
+        return apply_schema_hint(df.schema, self._schema_hint)
+
+    def get_format_hint(self) -> Optional[str]:
+        return self._wrapper.get_format_hint()
+
+    def transform(self, df: LocalDataFrame) -> LocalDataFrame:
+        return self._wrapper.run(
+            [df], dict(self.params), output_schema=self.output_schema, ctx=self._ctx()
+        )
+
+    def __uuid__(self) -> str:
+        return to_uuid(super().__uuid__(), str(self._schema_hint))
+
+    @staticmethod
+    def from_func(
+        func: Callable, schema: Any, validation: Dict[str, Any]
+    ) -> "_FuncAsTransformer":
+        if schema is None:
+            schema = parse_comment_annotation(func, "schema")
+        assert_or_throw(
+            schema is not None,
+            ValueError(f"schema hint is required for transformer {func}"),
+        )
+        validation = dict(parse_validation_rules_from_comment(func), **validation)
+        wrapper = DataFrameFunctionWrapper(
+            func, f"^{_DF}[fF]?x*$", f"^{_DF}$"
+        )
+        return _FuncAsTransformer(wrapper, schema, validate_rules(validation))
+
+
+class _FuncAsOutputTransformer(_FuncExtension, OutputTransformer):
+    def __init__(self, wrapper: DataFrameFunctionWrapper, validation: Dict[str, Any]):
+        super().__init__(wrapper, validation)
+
+    def get_format_hint(self) -> Optional[str]:
+        return self._wrapper.get_format_hint()
+
+    def process(self, df: LocalDataFrame) -> None:
+        self._wrapper.run(
+            [df], dict(self.params), output=False, ctx=self._ctx()
+        )
+
+    @staticmethod
+    def from_func(
+        func: Callable, validation: Dict[str, Any]
+    ) -> "_FuncAsOutputTransformer":
+        validation = dict(parse_validation_rules_from_comment(func), **validation)
+        wrapper = DataFrameFunctionWrapper(func, f"^{_DF}[fF]?x*$", "^[dlpqrRmMPQn]$")
+        return _FuncAsOutputTransformer(wrapper, validate_rules(validation))
+
+
+class _FuncAsCoTransformer(_FuncExtension, CoTransformer):
+    def __init__(
+        self, wrapper: DataFrameFunctionWrapper, schema: Any, validation: Dict[str, Any]
+    ):
+        super().__init__(wrapper, validation)
+        self._schema_hint = schema
+
+    def get_output_schema(self, dfs: DataFrames) -> Any:
+        if isinstance(self._schema_hint, str) and "*" in self._schema_hint:
+            raise ValueError("cotransformer schema hint can't use *")
+        return Schema(self._schema_hint) if isinstance(self._schema_hint, str) \
+            else self._schema_hint
+
+    def get_format_hint(self) -> Optional[str]:
+        return self._wrapper.get_format_hint()
+
+    def transform(self, dfs: DataFrames) -> LocalDataFrame:
+        if self._wrapper.input_code.startswith("c"):
+            args: List[Any] = [dfs]
+        else:
+            args = list(dfs.values())
+        return self._wrapper.run(
+            args, dict(self.params), output_schema=self.output_schema, ctx=self._ctx()
+        )
+
+    def __uuid__(self) -> str:
+        return to_uuid(super().__uuid__(), str(self._schema_hint))
+
+    @staticmethod
+    def from_func(
+        func: Callable, schema: Any, validation: Dict[str, Any]
+    ) -> "_FuncAsCoTransformer":
+        if schema is None:
+            schema = parse_comment_annotation(func, "schema")
+        assert_or_throw(
+            schema is not None,
+            ValueError(f"schema hint is required for cotransformer {func}"),
+        )
+        validation = dict(parse_validation_rules_from_comment(func), **validation)
+        wrapper = DataFrameFunctionWrapper(
+            func, f"^(c|{_DF}+)[fF]?x*$", f"^{_DF}$"
+        )
+        return _FuncAsCoTransformer(wrapper, schema, validate_rules(validation))
+
+
+class _FuncAsOutputCoTransformer(_FuncExtension, OutputCoTransformer):
+    def __init__(self, wrapper: DataFrameFunctionWrapper, validation: Dict[str, Any]):
+        super().__init__(wrapper, validation)
+
+    def get_format_hint(self) -> Optional[str]:
+        return self._wrapper.get_format_hint()
+
+    def process(self, dfs: DataFrames) -> None:
+        if self._wrapper.input_code.startswith("c"):
+            args: List[Any] = [dfs]
+        else:
+            args = list(dfs.values())
+        self._wrapper.run(args, dict(self.params), output=False, ctx=self._ctx())
+
+    @staticmethod
+    def from_func(
+        func: Callable, validation: Dict[str, Any]
+    ) -> "_FuncAsOutputCoTransformer":
+        validation = dict(parse_validation_rules_from_comment(func), **validation)
+        wrapper = DataFrameFunctionWrapper(
+            func, f"^(c|{_DF}+)[fF]?x*$", "^[dlpqrRmMPQn]$"
+        )
+        return _FuncAsOutputCoTransformer(wrapper, validate_rules(validation))
+
+
+class _FuncAsCreator(_FuncExtension, Creator):
+    def __init__(self, wrapper: DataFrameFunctionWrapper, schema: Any):
+        super().__init__(wrapper, {})
+        self._schema_hint = schema
+
+    def create(self) -> DataFrame:
+        schema = None if self._schema_hint is None else Schema(self._schema_hint)
+        res = self._wrapper.run(
+            [], dict(self.params),
+            output_schema=schema,
+            ctx=dict(engine=getattr(self, "_execution_engine", None)),
+        )
+        if isinstance(res, DataFrame):
+            return res
+        return self.execution_engine.to_df(
+            res, schema
+        )
+
+    def __uuid__(self) -> str:
+        return to_uuid(super().__uuid__(), str(self._schema_hint))
+
+    @staticmethod
+    def from_func(func: Callable, schema: Any) -> "_FuncAsCreator":
+        if schema is None:
+            schema = parse_comment_annotation(func, "schema")
+        wrapper = DataFrameFunctionWrapper(func, "^e?x*$", f"^{_DF}$")
+        return _FuncAsCreator(wrapper, schema)
+
+
+class _FuncAsProcessor(_FuncExtension, Processor):
+    def __init__(self, wrapper: DataFrameFunctionWrapper, schema: Any):
+        super().__init__(wrapper, {})
+        self._schema_hint = schema
+
+    def process(self, dfs: DataFrames) -> DataFrame:
+        if self._wrapper.input_code.replace("e", "").startswith("c"):
+            args: List[Any] = [dfs]
+        else:
+            args = [df.as_local() for df in dfs.values()]
+        schema = None if self._schema_hint is None else Schema(self._schema_hint)
+        res = self._wrapper.run(
+            args,
+            dict(self.params),
+            output_schema=schema,
+            ctx=dict(engine=getattr(self, "_execution_engine", None)),
+        )
+        if isinstance(res, DataFrame):
+            return res
+        return self.execution_engine.to_df(res, schema)
+
+    def __uuid__(self) -> str:
+        return to_uuid(super().__uuid__(), str(self._schema_hint))
+
+    @staticmethod
+    def from_func(func: Callable, schema: Any) -> "_FuncAsProcessor":
+        if schema is None:
+            schema = parse_comment_annotation(func, "schema")
+        wrapper = DataFrameFunctionWrapper(
+            func, f"^e?(c|{_DF}+)x*$", f"^{_DF}$"
+        )
+        return _FuncAsProcessor(wrapper, schema)
+
+
+class _FuncAsOutputter(_FuncExtension, Outputter):
+    def process(self, dfs: DataFrames) -> None:
+        if self._wrapper.input_code.replace("e", "").startswith("c"):
+            args: List[Any] = [dfs]
+        else:
+            args = [df.as_local() for df in dfs.values()]
+        self._wrapper.run(
+            args, dict(self.params), output=False,
+            ctx=dict(engine=getattr(self, "_execution_engine", None)),
+        )
+
+    @staticmethod
+    def from_func(func: Callable) -> "_FuncAsOutputter":
+        wrapper = DataFrameFunctionWrapper(func, f"^e?(c|{_DF}+)x*$", "^.*$")
+        return _FuncAsOutputter(wrapper, {})
+
+
+# ---- converters ------------------------------------------------------------
+def _lookup(kind: str, name: str) -> Optional[Any]:
+    return _REGISTRIES[kind].get(name)
+
+
+def _to_extension(
+    obj: Any,
+    kind: str,
+    base: type,
+    from_func: Callable,
+    parse: Callable,
+    copy_instance: bool = True,
+) -> Any:
+    obj = parse(obj)
+    if isinstance(obj, str):
+        registered = _lookup(kind, obj)
+        assert_or_throw(
+            registered is not None, ValueError(f"{obj!r} is not a registered {kind}")
+        )
+        return _to_extension(registered, kind, base, from_func, parse, copy_instance)
+    if isinstance(obj, base):
+        return copy.copy(obj) if copy_instance else obj
+    if isinstance(obj, type) and issubclass(obj, base):
+        return obj()
+    if callable(obj):
+        return from_func(obj)
+    raise ValueError(f"can't convert {obj!r} to {kind}")
+
+
+def _to_creator(obj: Any, schema: Any = None) -> Creator:
+    return _to_extension(
+        obj, "creator", Creator, lambda f: _FuncAsCreator.from_func(f, schema),
+        parse_creator,
+    )
+
+
+def _to_processor(obj: Any, schema: Any = None) -> Processor:
+    return _to_extension(
+        obj, "processor", Processor, lambda f: _FuncAsProcessor.from_func(f, schema),
+        parse_processor,
+    )
+
+
+def _to_outputter(obj: Any) -> Outputter:
+    return _to_extension(
+        obj, "outputter", Outputter, _FuncAsOutputter.from_func, parse_outputter
+    )
+
+
+def _to_transformer(
+    obj: Any, schema: Any = None, validation: Optional[Dict[str, Any]] = None
+) -> Transformer:
+    """Convert to Transformer OR CoTransformer (dispatch on signature: a
+    DataFrames/multi-df first param means cotransform)."""
+    validation = validation or {}
+    obj = parse_transformer(obj)
+    if isinstance(obj, str):
+        registered = _lookup("transformer", obj) or _lookup("cotransformer", obj)
+        assert_or_throw(
+            registered is not None,
+            ValueError(f"{obj!r} is not a registered transformer"),
+        )
+        return _to_transformer(registered, schema, validation)
+    if isinstance(obj, (Transformer, CoTransformer)):
+        return copy.copy(obj)  # type: ignore
+    if isinstance(obj, type) and issubclass(obj, (Transformer, CoTransformer)):
+        return obj()  # type: ignore
+    if callable(obj):
+        if _is_cotransform_func(obj):
+            return _FuncAsCoTransformer.from_func(obj, schema, validation)  # type: ignore
+        return _FuncAsTransformer.from_func(obj, schema, validation)
+    raise ValueError(f"can't convert {obj!r} to transformer")
+
+
+def _to_output_transformer(
+    obj: Any, validation: Optional[Dict[str, Any]] = None
+) -> Transformer:
+    validation = validation or {}
+    obj = parse_output_transformer(obj)
+    if isinstance(obj, str):
+        registered = (
+            _lookup("output_transformer", obj)
+            or _lookup("output_cotransformer", obj)
+            or _lookup("transformer", obj)
+        )
+        assert_or_throw(
+            registered is not None,
+            ValueError(f"{obj!r} is not a registered output transformer"),
+        )
+        return _to_output_transformer(registered, validation)
+    if isinstance(obj, (OutputTransformer, OutputCoTransformer)):
+        return copy.copy(obj)  # type: ignore
+    if isinstance(obj, type) and issubclass(
+        obj, (OutputTransformer, OutputCoTransformer)
+    ):
+        return obj()  # type: ignore
+    if callable(obj):
+        if _is_cotransform_func(obj):
+            return _FuncAsOutputCoTransformer.from_func(obj, validation)  # type: ignore
+        return _FuncAsOutputTransformer.from_func(obj, validation)
+    raise ValueError(f"can't convert {obj!r} to output transformer")
+
+
+def _is_cotransform_func(func: Callable) -> bool:
+    try:
+        wrapper = DataFrameFunctionWrapper(func)
+    except TypeError:
+        return False
+    code = wrapper.input_code
+    dfs = "".join(c for c in code if c in "dlpqrRmMPQc")
+    return code.startswith("c") or len(dfs) > 1
+
+
+# ---- decorators ------------------------------------------------------------
+def creator(schema: Any = None) -> Callable:
+    def deco(func: Callable) -> "_FuncAsCreator":
+        return _FuncAsCreator.from_func(func, schema)
+
+    return deco
+
+
+def processor(schema: Any = None) -> Callable:
+    def deco(func: Callable) -> "_FuncAsProcessor":
+        return _FuncAsProcessor.from_func(func, schema)
+
+    return deco
+
+
+def outputter() -> Callable:
+    def deco(func: Callable) -> "_FuncAsOutputter":
+        return _FuncAsOutputter.from_func(func)
+
+    return deco
+
+
+def transformer(schema: Any, **validation: Any) -> Callable:
+    def deco(func: Callable) -> "_FuncAsTransformer":
+        return _FuncAsTransformer.from_func(func, schema, validation)
+
+    return deco
+
+
+def output_transformer(**validation: Any) -> Callable:
+    def deco(func: Callable) -> "_FuncAsOutputTransformer":
+        return _FuncAsOutputTransformer.from_func(func, validation)
+
+    return deco
+
+
+def cotransformer(schema: Any, **validation: Any) -> Callable:
+    def deco(func: Callable) -> "_FuncAsCoTransformer":
+        return _FuncAsCoTransformer.from_func(func, schema, validation)
+
+    return deco
+
+
+def output_cotransformer(**validation: Any) -> Callable:
+    def deco(func: Callable) -> "_FuncAsOutputCoTransformer":
+        return _FuncAsOutputCoTransformer.from_func(func, validation)
+
+    return deco

@@ -1,0 +1,103 @@
+"""Extension interfaces (reference fugue/extensions/{creator,processor,
+outputter,transformer}/*.py): the five extension kinds of the framework.
+
+Driver side: Creator/Processor/Outputter run on the driver and can use the
+full ExecutionEngine. Worker side: Transformer/CoTransformer run per logical
+partition inside the map primitive (no engine access)."""
+
+from abc import ABC, abstractmethod
+from typing import Any
+
+from fugue_tpu.dataframe import DataFrame, DataFrames, LocalDataFrame
+from fugue_tpu.extensions.context import ExtensionContext
+
+
+class Creator(ExtensionContext, ABC):
+    """Generate a dataframe from nothing (load, create from config...)."""
+
+    @abstractmethod
+    def create(self) -> DataFrame:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class Processor(ExtensionContext, ABC):
+    """Driver-side dataframes -> dataframe (joins, repartition, ...)."""
+
+    @abstractmethod
+    def process(self, dfs: DataFrames) -> DataFrame:  # pragma: no cover
+        raise NotImplementedError
+
+
+class Outputter(ExtensionContext, ABC):
+    """Driver-side dataframes -> side effect (save, show, assert...)."""
+
+    @abstractmethod
+    def process(self, dfs: DataFrames) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+class Transformer(ExtensionContext, ABC):
+    """Worker-side per-logical-partition map. ``get_output_schema`` runs on
+    the driver; ``on_init`` once per physical partition; ``transform`` per
+    logical partition (reference transformer.py:8)."""
+
+    @abstractmethod
+    def get_output_schema(self, df: DataFrame) -> Any:  # pragma: no cover
+        raise NotImplementedError
+
+    def on_init(self, df: DataFrame) -> None:  # pragma: no cover - hook
+        pass
+
+    @abstractmethod
+    def transform(self, df: LocalDataFrame) -> LocalDataFrame:  # pragma: no cover
+        raise NotImplementedError
+
+
+class OutputTransformer(Transformer):
+    """Transformer with no output (side effects only)."""
+
+    def get_output_schema(self, df: DataFrame) -> Any:
+        return OUTPUT_TRANSFORMER_DUMMY_SCHEMA
+
+    @abstractmethod
+    def process(self, df: LocalDataFrame) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def transform(self, df: LocalDataFrame) -> LocalDataFrame:
+        from fugue_tpu.dataframe import ArrayDataFrame
+
+        self.process(df)
+        return ArrayDataFrame([], OUTPUT_TRANSFORMER_DUMMY_SCHEMA)
+
+
+class CoTransformer(ExtensionContext, ABC):
+    """Worker-side map over co-partitioned (zipped) dataframes."""
+
+    @abstractmethod
+    def get_output_schema(self, dfs: DataFrames) -> Any:  # pragma: no cover
+        raise NotImplementedError
+
+    def on_init(self, dfs: DataFrames) -> None:  # pragma: no cover - hook
+        pass
+
+    @abstractmethod
+    def transform(self, dfs: DataFrames) -> LocalDataFrame:  # pragma: no cover
+        raise NotImplementedError
+
+
+class OutputCoTransformer(CoTransformer):
+    def get_output_schema(self, dfs: DataFrames) -> Any:
+        return OUTPUT_TRANSFORMER_DUMMY_SCHEMA
+
+    @abstractmethod
+    def process(self, dfs: DataFrames) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def transform(self, dfs: DataFrames) -> LocalDataFrame:
+        from fugue_tpu.dataframe import ArrayDataFrame
+
+        self.process(dfs)
+        return ArrayDataFrame([], OUTPUT_TRANSFORMER_DUMMY_SCHEMA)
+
+
+OUTPUT_TRANSFORMER_DUMMY_SCHEMA = "_fugue_output_dummy:int"

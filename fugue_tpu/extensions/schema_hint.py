@@ -1,0 +1,81 @@
+"""Schema hints + comment annotations for interfaceless extensions
+(reference fugue/_utils/interfaceless.py:9-40)."""
+
+import inspect
+import re
+from typing import Any, Dict, List, Optional
+
+from fugue_tpu.schema import Schema
+from fugue_tpu.utils.assertion import assert_or_throw
+
+_COMMENT_ANNO_RE = re.compile(r"^\s*#\s*(\w+)\s*:\s*(.*)$")
+
+
+def parse_comment_annotation(func: Any, key: str) -> Optional[str]:
+    """Find ``# key: value`` comment lines right above a function def."""
+    annos = parse_comment_annotations(func)
+    return annos.get(key)
+
+
+def parse_comment_annotations(func: Any) -> Dict[str, str]:
+    """Scan upward from the function's ``def`` line: consecutive comment
+    lines (and decorators) directly above it carry the annotations."""
+    try:
+        file = inspect.getsourcefile(func)
+        _, lineno = inspect.getsourcelines(func)  # 1-based first line of def
+        assert file is not None
+        with open(file, "r") as fp:
+            all_lines = fp.readlines()
+    except (OSError, TypeError, AssertionError):
+        return {}
+    res: Dict[str, str] = {}
+    i = lineno - 2  # the line above `def`
+    while i >= 0:
+        stripped = all_lines[i].strip()
+        if stripped.startswith("@"):  # decorators between comments and def
+            i -= 1
+            continue
+        m = _COMMENT_ANNO_RE.match(all_lines[i])
+        if m is None:
+            break
+        # nearest annotation wins on duplicates
+        res.setdefault(m.group(1), m.group(2).strip())
+        i -= 1
+    return res
+
+
+def split_top_level(expr: str) -> List[str]:
+    """Split on commas not nested inside []{}<>()."""
+    parts: List[str] = []
+    depth = 0
+    buf = ""
+    for ch in expr:
+        if ch in "[{(<":
+            depth += 1
+        elif ch in "]})>":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append(buf)
+            buf = ""
+        else:
+            buf += ch
+    if buf.strip() != "":
+        parts.append(buf)
+    return [p.strip() for p in parts]
+
+
+def apply_schema_hint(input_schema: Schema, hint: Any) -> Schema:
+    """Resolve a transformer's schema hint against the input schema.
+
+    Supported: plain expressions (``a:int,b:str``), ``*`` (all inputs),
+    ``-col`` (exclusion), ``+a:int`` (addition), mixed with commas:
+    ``"*,c:double"``, ``"*,-b"``.
+    """
+    if isinstance(hint, Schema):
+        return hint
+    if callable(hint):
+        return Schema(hint(input_schema))
+    assert_or_throw(isinstance(hint, str), ValueError(f"invalid schema hint {hint!r}"))
+    if "*" not in hint and not hint.startswith(("+", "-")):
+        return Schema(hint)
+    return input_schema.transform(*split_top_level(hint))

@@ -1,0 +1,12 @@
+from fugue_tpu.rpc.base import (
+    EmptyRPCHandler,
+    RPCClient,
+    RPCFunc,
+    RPCHandler,
+    RPCServer,
+    NativeRPCClient,
+    NativeRPCServer,
+    make_rpc_server,
+    register_rpc_server,
+    to_rpc_handler,
+)

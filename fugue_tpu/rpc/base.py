@@ -1,0 +1,184 @@
+"""RPC: the worker->driver callback channel (reference fugue/rpc/base.py).
+
+Handlers live on the driver; the server hands out picklable clients that are
+shipped to workers inside map closures; ``client(*args)`` invokes the handler
+on the driver. ``NativeRPCServer`` is in-process (local engines and the jax
+single-controller model); ``fugue_tpu.rpc.http`` provides a stdlib-HTTP server
+for true multi-host setups (flask replacement)."""
+
+import pickle
+from abc import ABC, abstractmethod
+from threading import RLock
+from typing import Any, Callable, Dict, Optional
+from uuid import uuid4
+
+from fugue_tpu.utils.assertion import assert_or_throw
+from fugue_tpu.utils.params import ParamDict
+
+
+class RPCClient:
+    """Callable handle a worker invokes to reach a driver-side handler."""
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:  # pragma: no cover
+        raise NotImplementedError
+
+
+class RPCHandler(RPCClient):
+    """Driver-side handler. Subclasses implement ``__call__``."""
+
+    def __init__(self):
+        self._rpchandler_lock = RLock()
+        self._running = 0
+
+    @property
+    def running(self) -> bool:
+        return self._running > 0
+
+    def start_handler(self) -> None:  # pragma: no cover - hook
+        pass
+
+    def stop_handler(self) -> None:  # pragma: no cover - hook
+        pass
+
+    def start(self) -> "RPCHandler":
+        with self._rpchandler_lock:
+            if self._running == 0:
+                self.start_handler()
+            self._running += 1
+        return self
+
+    def stop(self) -> None:
+        with self._rpchandler_lock:
+            if self._running == 1:
+                self.stop_handler()
+            self._running = max(0, self._running - 1)
+
+    def __enter__(self) -> "RPCHandler":
+        assert_or_throw(self._running > 0, ValueError("handler not started"))
+        return self
+
+    def __exit__(self, *args: Any) -> None:
+        self.stop()
+
+    def __getstate__(self) -> Any:
+        raise pickle.PicklingError(f"{self} is not serializable")
+
+
+class EmptyRPCHandler(RPCHandler):
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        raise NotImplementedError("empty rpc handler")
+
+
+class RPCFunc(RPCHandler):
+    """Wrap a plain callable as a handler."""
+
+    def __init__(self, func: Callable):
+        super().__init__()
+        assert_or_throw(callable(func), ValueError(f"{func} is not callable"))
+        self._func = func
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self._func(*args, **kwargs)
+
+
+def to_rpc_handler(obj: Any) -> RPCHandler:
+    if obj is None:
+        return EmptyRPCHandler()
+    if isinstance(obj, RPCHandler):
+        return obj
+    if callable(obj):
+        return RPCFunc(obj)
+    raise ValueError(f"{obj} can't be converted to RPCHandler")
+
+
+class RPCServer(RPCHandler, ABC):
+    """Registers handlers by key and makes shippable clients (reference
+    rpc/base.py:105-175)."""
+
+    def __init__(self, conf: Any = None):
+        super().__init__()
+        self._conf = ParamDict(conf)
+        self._handlers: Dict[str, RPCHandler] = {}
+
+    @property
+    def conf(self) -> ParamDict:
+        return self._conf
+
+    @abstractmethod
+    def make_client(self, handler: Any) -> RPCClient:  # pragma: no cover
+        raise NotImplementedError
+
+    def start_server(self) -> None:  # pragma: no cover - hook
+        pass
+
+    def stop_server(self) -> None:  # pragma: no cover - hook
+        pass
+
+    def start_handler(self) -> None:
+        self.start_server()
+
+    def stop_handler(self) -> None:
+        self.stop_server()
+        for h in list(self._handlers.values()):
+            h.stop()
+        self._handlers.clear()
+
+    def invoke(self, key: str, *args: Any, **kwargs: Any) -> Any:
+        with self._rpchandler_lock:
+            handler = self._handlers[key]
+        return handler(*args, **kwargs)
+
+    def register(self, handler: Any) -> str:
+        key = "_" + str(uuid4())[:8]
+        with self._rpchandler_lock:
+            assert_or_throw(key not in self._handlers, ValueError(f"dup key {key}"))
+            self._handlers[key] = to_rpc_handler(handler).start()
+        return key
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        raise NotImplementedError("RPCServer is not directly callable")
+
+
+class NativeRPCClient(RPCClient):
+    """In-process client: holds the server by reference (picklable within a
+    single process; shipped across processes only by http server clients)."""
+
+    def __init__(self, server: "NativeRPCServer", key: str):
+        self._key = key
+        self._server = server
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self._server.invoke(self._key, *args, **kwargs)
+
+    def __getstate__(self) -> Any:
+        raise pickle.PicklingError("NativeRPCClient can't be serialized")
+
+
+class NativeRPCServer(RPCServer):
+    def make_client(self, handler: Any) -> RPCClient:
+        key = self.register(handler)
+        return NativeRPCClient(self, key)
+
+
+_SERVER_TYPES: Dict[str, Callable[..., RPCServer]] = {}
+
+
+def register_rpc_server(name: str, factory: Callable[..., RPCServer]) -> None:
+    _SERVER_TYPES[name.lower()] = factory
+
+
+def make_rpc_server(conf: Any = None) -> RPCServer:
+    """Build the configured server (conf key ``fugue.rpc.server``; default
+    in-process native server)."""
+    conf = ParamDict(conf)
+    tp = conf.get("fugue.rpc.server", "native")
+    if tp.lower() in _SERVER_TYPES:
+        return _SERVER_TYPES[tp.lower()](conf)
+    # a fully qualified class path
+    import importlib
+
+    module, cls = tp.rsplit(".", 1)
+    return getattr(importlib.import_module(module), cls)(conf)
+
+
+register_rpc_server("native", lambda conf: NativeRPCServer(conf))

@@ -1,0 +1,3 @@
+"""FugueSQL-equivalent front end: tokenizer, parser, DAG compiler and the
+SQL-on-dataframes executor (reference fugue/sql + fugue-sql-antlr + qpd,
+rebuilt from scratch — see fugue_tpu/sql_frontend/parser.py)."""

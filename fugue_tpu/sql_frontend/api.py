@@ -1,0 +1,16 @@
+"""fugue_sql / fugue_sql_flow entry points (reference fugue/sql/api.py:18,111).
+Full implementation arrives with the parser module."""
+
+from typing import Any
+
+
+def fugue_sql(query: str, *args: Any, **kwargs: Any) -> Any:
+    from fugue_tpu.sql_frontend.workflow_sql import run_fugue_sql
+
+    return run_fugue_sql(query, *args, **kwargs)
+
+
+def fugue_sql_flow(query: str, *args: Any, **kwargs: Any) -> Any:
+    from fugue_tpu.sql_frontend.workflow_sql import build_fugue_sql_flow
+
+    return build_fugue_sql_flow(query, *args, **kwargs)

@@ -1,0 +1,72 @@
+"""Error-trace surgery: prune framework frames from tracebacks and point the
+user at their own call site (reference fugue/_utils/exception.py:7-42 +
+workflow.py:1586-1604 behavior). jax/XLA tracebacks are notoriously deep —
+this keeps workflow failures readable."""
+
+import traceback
+from types import TracebackType
+from typing import List, Optional
+
+
+def prune_traceback(
+    tb: Optional[TracebackType], hide_prefixes: List[str]
+) -> Optional[TracebackType]:
+    """Drop frames whose module file matches any hide prefix (by module name
+    or path fragment). Always keeps at least the deepest frame."""
+    frames: List[TracebackType] = []
+    cur = tb
+    while cur is not None:
+        frames.append(cur)
+        cur = cur.tb_next
+    kept = [
+        f
+        for f in frames
+        if not _is_hidden(f, hide_prefixes)
+    ]
+    if len(kept) == 0:
+        kept = frames[-1:]
+    # rebuild the chain from the end
+    next_tb: Optional[TracebackType] = None
+    for f in reversed(kept):
+        next_tb = TracebackType(
+            next_tb, f.tb_frame, f.tb_lasti, f.tb_lineno
+        )
+    return next_tb
+
+
+def _match_module(module: str, prefix: str) -> bool:
+    """True when ``module`` IS the package named by ``prefix`` or a submodule
+    of it — 'fugue_tpu.' must not hide 'fugue_tpu_userlib.x'."""
+    p = prefix.rstrip(".")
+    return module == p or module.startswith(p + ".")
+
+
+def _is_hidden(tb: TracebackType, prefixes: List[str]) -> bool:
+    g = tb.tb_frame.f_globals
+    module = g.get("__name__", "")
+    return any(_match_module(module, p) for p in prefixes if p != "")
+
+
+def extract_user_callsite(inject: int, hide_prefixes: List[str]) -> List[str]:
+    """Capture the current stack's last ``inject`` user (non-framework)
+    frames as display strings, for splicing into runtime errors."""
+    if inject <= 0:
+        return []
+    pkg_dirs = [
+        "/" + p.rstrip(".").replace(".", "/") + "/" for p in hide_prefixes if p
+    ]
+    frames: List[List[str]] = []  # each entry: [header, code?] of one frame
+    for frame in reversed(traceback.extract_stack()[:-1]):
+        fname = frame.filename.replace("\\", "/")
+        if any(d in fname for d in pkg_dirs) or "/fugue_tpu/" in fname:
+            continue
+        entry = [f'  File "{frame.filename}", line {frame.lineno}, in {frame.name}']
+        if frame.line:
+            entry.append(f"    {frame.line}")
+        frames.append(entry)
+        if len(frames) >= inject:
+            break
+    res: List[str] = []
+    for entry in reversed(frames):  # reverse frame ORDER, keep header/code pairs
+        res.extend(entry)
+    return res

@@ -40,6 +40,10 @@ def _convert(value: Any, ttype: Type[T]) -> T:
         raise ValueError(f"can't convert {value!r} to float")
     if ttype is str:
         return str(value)  # type: ignore
+    if issubclass(ttype, dict) and isinstance(value, dict):
+        return ttype(value)  # type: ignore
+    if issubclass(ttype, list) and isinstance(value, (list, tuple)):
+        return ttype(value)  # type: ignore
     raise ValueError(f"can't convert {value!r} to {ttype}")
 
 

@@ -1,0 +1,12 @@
+from fugue_tpu.workflow.workflow import (
+    FugueWorkflow,
+    FugueWorkflowResult,
+    WorkflowDataFrame,
+)
+from fugue_tpu.workflow.module import module
+from fugue_tpu.workflow.checkpoint import (
+    Checkpoint,
+    CheckpointPath,
+    StrongCheckpoint,
+    WeakCheckpoint,
+)

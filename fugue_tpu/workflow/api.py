@@ -1,0 +1,108 @@
+"""The #1 entry points: transform / out_transform / raw_sql (reference
+fugue/workflow/api.py:34,187,253)."""
+
+from typing import Any, Callable, List, Optional
+
+from fugue_tpu.collections.sql import StructuredRawSQL, TempTableName
+from fugue_tpu.collections.yielded import Yielded
+from fugue_tpu.dataframe import DataFrame
+from fugue_tpu.dataframe.api import as_fugue_df, get_native_as_df
+from fugue_tpu.execution.factory import make_execution_engine
+from fugue_tpu.utils.assertion import assert_or_throw
+from fugue_tpu.workflow.workflow import FugueWorkflow, WorkflowDataFrame
+
+
+def transform(
+    df: Any,
+    using: Any,
+    schema: Any = None,
+    params: Any = None,
+    partition: Any = None,
+    callback: Any = None,
+    ignore_errors: Optional[List[type]] = None,
+    persist: bool = False,
+    as_local: bool = False,
+    as_fugue: bool = False,
+    engine: Any = None,
+    engine_conf: Any = None,
+) -> Any:
+    """Transform ``df`` by ``using`` (an interfaceless function, Transformer,
+    or registered alias) on any engine — the one-line entry point (call stack
+    parity: SURVEY §3.1)."""
+    dag = FugueWorkflow()
+    src = dag.create_data(df)
+    if partition is not None:
+        src = src.partition(partition)
+    tdf = src.transform(
+        using,
+        schema=schema,
+        params=params,
+        ignore_errors=ignore_errors,
+        callback=callback,
+    )
+    if persist:
+        tdf = tdf.persist()
+    tdf.yield_dataframe_as("result", as_local=as_local)
+    e = make_execution_engine(engine, engine_conf, infer_by=[df])
+    dag.run(e)
+    result = dag.yields["result"].result  # type: ignore
+    if as_fugue or isinstance(df, (DataFrame, Yielded)):
+        return result
+    return result.native if result.is_local else get_native_as_df(result)
+
+
+def out_transform(
+    df: Any,
+    using: Any,
+    params: Any = None,
+    partition: Any = None,
+    callback: Any = None,
+    ignore_errors: Optional[List[type]] = None,
+    engine: Any = None,
+    engine_conf: Any = None,
+) -> None:
+    """Transform with no output — side effects only (reference api.py:187)."""
+    dag = FugueWorkflow()
+    src = dag.create_data(df)
+    if partition is not None:
+        src = src.partition(partition)
+    src.out_transform(
+        using, params=params, ignore_errors=ignore_errors, callback=callback
+    )
+    e = make_execution_engine(engine, engine_conf, infer_by=[df])
+    dag.run(e)
+
+
+def raw_sql(
+    *statements: Any,
+    engine: Any = None,
+    engine_conf: Any = None,
+    as_fugue: bool = False,
+    as_local: bool = False,
+) -> Any:
+    """Run a SQL SELECT mixing string fragments and dataframes::
+
+        raw_sql("SELECT a FROM", df, "WHERE a > 0")
+    """
+    dag = FugueWorkflow()
+    parts = []
+    dfs = {}
+    for s in statements:
+        if isinstance(s, str):
+            parts.append((False, s))
+        else:
+            t = TempTableName()
+            dfs[t.key] = s
+            parts.append((True, t.key))
+        parts.append((False, " "))
+    named = {k: dag.create_data(v) for k, v in dfs.items()}
+    tdf = dag.select(
+        StructuredRawSQL(parts), dfs=named if len(named) > 0 else None
+    )
+    tdf.yield_dataframe_as("result", as_local=as_local)
+    e = make_execution_engine(engine, engine_conf, infer_by=list(dfs.values()))
+    dag.run(e)
+    result = dag.yields["result"].result  # type: ignore
+    if as_fugue or any(isinstance(x, DataFrame) for x in dfs.values()):
+        return result
+    return result.native if result.is_local else get_native_as_df(result)

@@ -1,0 +1,164 @@
+"""Checkpoints: caching and cross-run recovery (reference
+fugue/workflow/_checkpoint.py:37-175).
+
+- WeakCheckpoint  = engine persist (in-memory cache)
+- StrongCheckpoint = save+reload a parquet file; ``deterministic=True`` keys
+  the file by the task uuid so re-running an identical DAG SKIPS recompute
+  when the artifact already exists.
+"""
+
+import os
+import shutil
+from typing import Any, Optional
+from uuid import uuid4
+
+from fugue_tpu.collections.yielded import PhysicalYielded
+from fugue_tpu.dataframe import DataFrame
+from fugue_tpu.utils.assertion import assert_or_throw
+
+
+class Checkpoint:
+    """Null checkpoint."""
+
+    @property
+    def is_null(self) -> bool:
+        return True
+
+    def run(self, df: DataFrame, path: "CheckpointPath") -> DataFrame:
+        return df
+
+    def try_load(self, path: "CheckpointPath") -> Optional[DataFrame]:
+        """Pre-execution check: a deterministic checkpoint whose artifact
+        already exists returns the cached dataframe so the task can SKIP
+        recompute entirely (reference _checkpoint.py:67)."""
+        return None
+
+
+class WeakCheckpoint(Checkpoint):
+    def __init__(self, lazy: bool = False, **kwargs: Any):
+        self._lazy = lazy
+        self._kwargs = dict(kwargs)
+
+    @property
+    def is_null(self) -> bool:
+        return False
+
+    def run(self, df: DataFrame, path: "CheckpointPath") -> DataFrame:
+        return path.execution_engine.persist(df, lazy=self._lazy, **self._kwargs)
+
+
+class StrongCheckpoint(Checkpoint):
+    def __init__(
+        self,
+        obj_id: str,
+        deterministic: bool = False,
+        permanent: bool = False,
+        lazy: bool = False,
+        fmt: str = "parquet",
+        partition: Any = None,
+        single: bool = False,
+        namespace: Any = None,
+        **save_kwargs: Any,
+    ):
+        assert_or_throw(
+            not deterministic or permanent,
+            ValueError("deterministic checkpoint must be permanent"),
+        )
+        assert_or_throw(not lazy, NotImplementedError("lazy strong checkpoint"))
+        self._obj_id = obj_id
+        self._deterministic = deterministic
+        self._permanent = permanent
+        self._fmt = fmt
+        self._partition = partition
+        self._single = single
+        self._namespace = namespace
+        self._save_kwargs = dict(save_kwargs)
+        self.yielded: Optional[PhysicalYielded] = None
+
+    @property
+    def is_null(self) -> bool:
+        return False
+
+    def _file_path(self, path: "CheckpointPath") -> str:
+        from fugue_tpu.utils.hash import to_uuid
+
+        fid = self._obj_id if self._namespace is None else to_uuid(
+            self._obj_id, self._namespace
+        )
+        return path.get_file_path(fid, self._fmt, permanent=self._permanent)
+
+    def try_load(self, path: "CheckpointPath") -> Optional[DataFrame]:
+        if not self._deterministic:
+            return None
+        fpath = self._file_path(path)
+        if not path.file_exists(fpath):
+            return None
+        result = path.execution_engine.load_df(fpath, format_hint=self._fmt)
+        if self.yielded is not None:
+            self.yielded.set_value(fpath)
+        return result
+
+    def run(self, df: DataFrame, path: "CheckpointPath") -> DataFrame:
+        fpath = self._file_path(path)
+        if not (self._deterministic and path.file_exists(fpath)):
+            path.execution_engine.save_df(
+                df,
+                fpath,
+                format_hint=self._fmt,
+                mode="overwrite",
+                force_single=self._single,
+                **self._save_kwargs,
+            )
+        result = path.execution_engine.load_df(fpath, format_hint=self._fmt)
+        if self.yielded is not None:
+            self.yielded.set_value(fpath)
+        return result
+
+
+class CheckpointPath:
+    """Temp/permanent checkpoint dirs per workflow execution (reference
+    _checkpoint.py:130-175)."""
+
+    def __init__(self, engine: Any):
+        self._engine = engine
+        self._path = engine.conf.get("fugue.workflow.checkpoint.path", "").strip()
+        self._temp_path = ""
+
+    @property
+    def execution_engine(self) -> Any:
+        return self._engine
+
+    def init_temp_path(self, execution_id: str) -> str:
+        if self._path == "":
+            self._temp_path = ""
+            return ""
+        self._temp_path = os.path.join(self._path, execution_id)
+        os.makedirs(self._temp_path, exist_ok=True)
+        return self._temp_path
+
+    def remove_temp_path(self) -> None:
+        if self._temp_path != "":
+            try:
+                shutil.rmtree(self._temp_path)
+            except Exception:  # pragma: no cover - best effort
+                pass
+
+    def get_file_path(self, obj_id: str, fmt: str, permanent: bool) -> str:
+        path = self._path if permanent else self._temp_path
+        assert_or_throw(
+            path != "",
+            ValueError(
+                "fugue.workflow.checkpoint.path is not set for checkpoints"
+            ),
+        )
+        return os.path.join(path, f"{obj_id}.{fmt}")
+
+    def file_exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def temp_file(self, fmt: str = "parquet") -> str:
+        assert_or_throw(
+            self._temp_path != "",
+            ValueError("fugue.workflow.checkpoint.path is not set"),
+        )
+        return os.path.join(self._temp_path, f"{uuid4()}.{fmt}")

@@ -1,0 +1,45 @@
+"""Reusable sub-DAG functions (reference fugue/workflow/module.py:19): a
+``@module`` function takes/returns WorkflowDataFrames and can be applied in
+any workflow."""
+
+import inspect
+from typing import Any, Callable, Optional
+
+from fugue_tpu.utils.assertion import assert_or_throw
+from fugue_tpu.workflow.workflow import FugueWorkflow, WorkflowDataFrame
+
+
+def module(
+    func: Optional[Callable] = None, as_method: bool = False,
+    name: Optional[str] = None, on_dup: str = "overwrite",
+) -> Any:
+    """Mark a function as a workflow module. With ``as_method=True`` it is
+    also injected as a WorkflowDataFrame method."""
+
+    def deco(fn: Callable) -> Callable:
+        sig = inspect.signature(fn)
+
+        def wrapper(*args: Any, **kwargs: Any) -> Any:
+            return fn(*args, **kwargs)
+
+        wrapper.__name__ = fn.__name__
+        wrapper.__doc__ = fn.__doc__
+        if as_method:
+            method_name = name or fn.__name__
+            params = list(sig.parameters.values())
+            assert_or_throw(
+                len(params) > 0,
+                ValueError("as_method module needs a WorkflowDataFrame param"),
+            )
+
+            def method(self: WorkflowDataFrame, *args: Any, **kwargs: Any) -> Any:
+                return fn(self, *args, **kwargs)
+
+            if hasattr(WorkflowDataFrame, method_name) and on_dup == "throw":
+                raise KeyError(f"{method_name} already exists")
+            setattr(WorkflowDataFrame, method_name, method)
+        return wrapper
+
+    if func is not None:
+        return deco(func)
+    return deco

@@ -1,0 +1,91 @@
+"""Lightweight parallel DAG runner — replaces the reference's external
+`adagio` dependency (SURVEY §7 step 6: "own lightweight parallel DAG
+runner"). Topological execution with bounded concurrency; independent tasks
+run concurrently when ``fugue.workflow.concurrency > 1``."""
+
+from concurrent.futures import FIRST_COMPLETED, Future, ThreadPoolExecutor, wait
+from typing import Any, Callable, Dict, List, Optional, Set
+
+from fugue_tpu.utils.assertion import assert_or_throw
+
+
+class TaskNode:
+    def __init__(self, task_id: str, func: Callable[[List[Any]], Any],
+                 dependencies: List[str]):
+        self.task_id = task_id
+        self.func = func
+        self.dependencies = dependencies
+
+
+class DAGRunner:
+    """Run tasks respecting dependencies; results keyed by task id."""
+
+    def __init__(self, concurrency: int = 1):
+        self._concurrency = max(1, concurrency)
+
+    def run(self, nodes: List[TaskNode]) -> Dict[str, Any]:
+        by_id = {n.task_id: n for n in nodes}
+        for n in nodes:
+            for d in n.dependencies:
+                assert_or_throw(d in by_id, ValueError(f"unknown dependency {d}"))
+        results: Dict[str, Any] = {}
+        if self._concurrency <= 1:
+            for n in self._topological(nodes):
+                results[n.task_id] = n.func([results[d] for d in n.dependencies])
+            return results
+        return self._run_parallel(nodes, results)
+
+    def _topological(self, nodes: List[TaskNode]) -> List[TaskNode]:
+        done: Set[str] = set()
+        ordered: List[TaskNode] = []
+        remaining = list(nodes)
+        while remaining:
+            progress = False
+            still: List[TaskNode] = []
+            for n in remaining:
+                if all(d in done for d in n.dependencies):
+                    ordered.append(n)
+                    done.add(n.task_id)
+                    progress = True
+                else:
+                    still.append(n)
+            assert_or_throw(progress, ValueError("cycle detected in workflow DAG"))
+            remaining = still
+        return ordered
+
+    def _run_parallel(
+        self, nodes: List[TaskNode], results: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        pending = {n.task_id: n for n in nodes}
+        running: Dict[Future, str] = {}
+        first_error: List[BaseException] = []
+        with ThreadPoolExecutor(max_workers=self._concurrency) as pool:
+            while (pending or running) and not first_error:
+                # launch all ready tasks
+                ready = [
+                    n for n in pending.values()
+                    if all(d in results for d in n.dependencies)
+                ]
+                for n in ready:
+                    del pending[n.task_id]
+                    deps = [results[d] for d in n.dependencies]
+                    running[pool.submit(n.func, deps)] = n.task_id
+                if not running:
+                    assert_or_throw(
+                        not pending, ValueError("cycle detected in workflow DAG")
+                    )
+                    break
+                finished, _ = wait(list(running.keys()), return_when=FIRST_COMPLETED)
+                for f in finished:
+                    tid = running.pop(f)
+                    err = f.exception()
+                    if err is not None:
+                        first_error.append(err)
+                    else:
+                        results[tid] = f.result()
+            # drain remaining futures on error
+            for f in list(running.keys()):
+                f.cancel()
+        if first_error:
+            raise first_error[0]
+        return results

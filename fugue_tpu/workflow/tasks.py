@@ -1,0 +1,173 @@
+"""Workflow tasks: uuid-deterministic units executed by the DAG runner
+(reference fugue/workflow/_tasks.py:85-347 behavior on our own runner)."""
+
+from typing import Any, Callable, Dict, List, Optional
+
+from fugue_tpu.collections.partition import PartitionSpec
+from fugue_tpu.collections.yielded import PhysicalYielded, Yielded
+from fugue_tpu.dataframe import DataFrame, DataFrames
+from fugue_tpu.dataframe.dataframe import YieldedDataFrame
+from fugue_tpu.extensions.convert import (
+    _to_creator,
+    _to_outputter,
+    _to_processor,
+)
+from fugue_tpu.extensions.interfaces import Creator, Outputter, Processor
+from fugue_tpu.schema import Schema
+from fugue_tpu.utils.assertion import assert_or_throw
+from fugue_tpu.utils.hash import to_uuid
+from fugue_tpu.utils.params import ParamDict
+from fugue_tpu.workflow.checkpoint import Checkpoint
+
+
+def _ext_uuid(ext: Any) -> str:
+    if hasattr(ext, "__uuid__"):
+        return ext.__uuid__()
+    if isinstance(ext, type):
+        return to_uuid(f"{ext.__module__}.{ext.__qualname__}")
+    return to_uuid(ext)
+
+
+class FugueTask:
+    """A node in the workflow DAG; identity is deterministic from the spec so
+    identical DAGs produce identical task uuids across runs/processes (the
+    determinism backbone used by deterministic checkpoints)."""
+
+    def __init__(
+        self,
+        extension: Any,
+        params: Any = None,
+        schema: Any = None,
+        partition_spec: Optional[PartitionSpec] = None,
+        input_tasks: Optional[List["FugueTask"]] = None,
+        input_names: Optional[List[str]] = None,
+    ):
+        self.extension = extension
+        self.params = ParamDict(params)  # passed to the extension verbatim
+        self.schema = schema  # for interfaceless conversion only
+        self.partition_spec = partition_spec or PartitionSpec()
+        self.inputs = input_tasks or []
+        self.input_names = input_names
+        self.checkpoint: Checkpoint = Checkpoint()
+        self.broadcast_result = False
+        self.yields: List[Yielded] = []
+        self.yield_as_local = False
+        self.callsite: List[str] = []
+        self._uuid: Optional[str] = None
+
+    def __uuid__(self) -> str:
+        if self._uuid is None:
+            self._uuid = to_uuid(
+                type(self).__name__,
+                _ext_uuid(self.extension),
+                self._params_uuid(),
+                str(self.schema),
+                self.partition_spec.__uuid__(),
+                [t.__uuid__() for t in self.inputs],
+                self.input_names,
+            )
+        return self._uuid
+
+    def _params_uuid(self) -> Any:
+        res: Dict[str, Any] = {}
+        for k, v in self.params.items():
+            if hasattr(v, "__uuid__"):
+                res[k] = v.__uuid__()
+            elif isinstance(v, (list, dict, str, int, float, bool, type(None))):
+                res[k] = v
+            else:
+                res[k] = to_uuid(v)
+        return res
+
+    @property
+    def name(self) -> str:
+        return f"{type(self.extension).__name__}_{self.__uuid__()[:8]}"
+
+    def execute(self, ctx: "TaskContext", inputs: List[DataFrame]) -> Any:
+        raise NotImplementedError  # pragma: no cover
+
+    # ---- shared result handling -----------------------------------------
+    def _try_skip(self, ctx: "TaskContext") -> Optional[DataFrame]:
+        """Deterministic-checkpoint short circuit: reuse the artifact and
+        skip compute when an identical DAG already produced it."""
+        cached = self.checkpoint.try_load(ctx.checkpoint_path)
+        if cached is None:
+            return None
+        return self._finalize(ctx, cached, run_checkpoint=False)
+
+    def _finalize(
+        self, ctx: "TaskContext", df: DataFrame, run_checkpoint: bool = True
+    ) -> DataFrame:
+        if run_checkpoint:
+            df = self.checkpoint.run(df, ctx.checkpoint_path)
+        if self.broadcast_result:
+            df = ctx.engine.broadcast(df)
+        for y in self.yields:
+            if isinstance(y, YieldedDataFrame):
+                y.set_value(
+                    ctx.engine.convert_yield_dataframe(df, self.yield_as_local)
+                )
+        return df
+
+    def _setup_extension(self, ext: Any, ctx: "TaskContext") -> None:
+        ext._params = self.params
+        ext._workflow_conf = ctx.engine.conf
+        ext._execution_engine = ctx.engine
+        ext._partition_spec = self.partition_spec
+        ext._rpc_server = ctx.rpc_server
+
+
+class TaskContext:
+    def __init__(self, engine: Any, rpc_server: Any, checkpoint_path: Any):
+        self.engine = engine
+        self.rpc_server = rpc_server
+        self.checkpoint_path = checkpoint_path
+
+
+class CreateTask(FugueTask):
+    """Wrap a Creator (reference _tasks.py:214)."""
+
+    def execute(self, ctx: TaskContext, inputs: List[DataFrame]) -> DataFrame:
+        cached = self._try_skip(ctx)
+        if cached is not None:
+            return cached
+        creator = _to_creator(self.extension, self.schema)
+        self._setup_extension(creator, ctx)
+        df = creator.create()
+        return self._finalize(ctx, ctx.engine.to_df(df))
+
+
+class ProcessTask(FugueTask):
+    """Wrap a Processor (reference _tasks.py:243)."""
+
+    def execute(self, ctx: TaskContext, inputs: List[DataFrame]) -> DataFrame:
+        cached = self._try_skip(ctx)
+        if cached is not None:
+            return cached
+        processor = _to_processor(self.extension, self.schema)
+        self._setup_extension(processor, ctx)
+        dfs = self._make_dfs(ctx, inputs)
+        df = processor.process(dfs)
+        return self._finalize(ctx, ctx.engine.to_df(df))
+
+    def _make_dfs(self, ctx: TaskContext, inputs: List[DataFrame]) -> DataFrames:
+        engine_inputs = [ctx.engine.to_df(i) if not isinstance(i, DataFrame) else i
+                         for i in inputs]
+        if self.input_names is not None:
+            return DataFrames(dict(zip(self.input_names, engine_inputs)))
+        return DataFrames(engine_inputs)
+
+
+class OutputTask(FugueTask):
+    """Wrap an Outputter (reference _tasks.py:297)."""
+
+    def execute(self, ctx: TaskContext, inputs: List[DataFrame]) -> Optional[DataFrame]:
+        outputter = _to_outputter(self.extension)
+        self._setup_extension(outputter, ctx)
+        if self.input_names is not None:
+            dfs = DataFrames(dict(zip(self.input_names, inputs)))
+        else:
+            dfs = DataFrames(inputs)
+        outputter.process(dfs)
+        # pass through the first input so dependents can still reference it
+        return inputs[0] if len(inputs) > 0 else None

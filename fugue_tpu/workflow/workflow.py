@@ -1,0 +1,746 @@
+"""FugueWorkflow: the lazy DAG programming interface (reference
+fugue/workflow/workflow.py:88-2302 re-built on our own runner/tasks).
+
+``FugueWorkflow()`` collects operations as deterministic tasks;
+``run(engine)`` executes them (nothing is compiled before that)."""
+
+from typing import Any, Callable, Dict, Iterable, List, Optional, Union
+from uuid import uuid4
+
+from fugue_tpu.collections.partition import PartitionSpec
+from fugue_tpu.collections.sql import StructuredRawSQL
+from fugue_tpu.collections.yielded import PhysicalYielded, Yielded
+from fugue_tpu.column.expressions import ColumnExpr
+from fugue_tpu.column.sql import SelectColumns
+from fugue_tpu.constants import (
+    FUGUE_CONF_WORKFLOW_CONCURRENCY,
+    FUGUE_CONF_WORKFLOW_EXCEPTION_HIDE,
+    FUGUE_CONF_WORKFLOW_EXCEPTION_INJECT,
+    FUGUE_GLOBAL_CONF,
+)
+from fugue_tpu.dataframe import DataFrame
+from fugue_tpu.dataframe.dataframe import YieldedDataFrame
+from fugue_tpu.execution.factory import make_execution_engine
+from fugue_tpu.extensions.builtins import (
+    Aggregate,
+    AlterColumns,
+    Assign,
+    AssertEqFunc,
+    AssertNotEqFunc,
+    CreateData,
+    Distinct,
+    DropColumns,
+    Dropna,
+    Fillna,
+    Filter,
+    Load,
+    Rename,
+    RunJoin,
+    RunOutputTransformer,
+    RunSetOperation,
+    RunSQLSelect,
+    RunTransformer,
+    Sample,
+    Save,
+    SaveAndUse,
+    Select,
+    SelectColumnsP,
+    Show,
+    Take,
+    Zip,
+)
+from fugue_tpu.rpc import make_rpc_server, to_rpc_handler
+from fugue_tpu.schema import Schema
+from fugue_tpu.utils.assertion import assert_or_throw
+from fugue_tpu.utils.exception import extract_user_callsite, prune_traceback
+from fugue_tpu.utils.hash import to_uuid
+from fugue_tpu.utils.params import ParamDict
+from fugue_tpu.workflow.checkpoint import (
+    Checkpoint,
+    CheckpointPath,
+    StrongCheckpoint,
+    WeakCheckpoint,
+)
+from fugue_tpu.workflow.runner import DAGRunner, TaskNode
+from fugue_tpu.workflow.tasks import (
+    CreateTask,
+    FugueTask,
+    OutputTask,
+    ProcessTask,
+    TaskContext,
+)
+
+
+class WorkflowDataFrame:
+    """Lazy handle to a dataframe inside a workflow DAG (reference
+    workflow.py:88). All methods add tasks; nothing executes until
+    ``workflow.run``."""
+
+    def __init__(self, workflow: "FugueWorkflow", task: FugueTask):
+        self._workflow = workflow
+        self._task = task
+        self._pending_partition: Optional[PartitionSpec] = None
+
+    @property
+    def workflow(self) -> "FugueWorkflow":
+        return self._workflow
+
+    @property
+    def task(self) -> FugueTask:
+        return self._task
+
+    @property
+    def partition_spec(self) -> PartitionSpec:
+        return self._pending_partition or PartitionSpec()
+
+    def __uuid__(self) -> str:
+        return self._task.__uuid__()
+
+    # ---- partition hints -------------------------------------------------
+    def partition(self, *args: Any, **kwargs: Any) -> "WorkflowDataFrame":
+        res = WorkflowDataFrame(self._workflow, self._task)
+        res._pending_partition = PartitionSpec(*args, **kwargs)
+        return res
+
+    def partition_by(self, *keys: str, **kwargs: Any) -> "WorkflowDataFrame":
+        return self.partition(by=list(keys), **kwargs)
+
+    def per_partition_by(self, *keys: str) -> "WorkflowDataFrame":
+        return self.partition(by=list(keys), algo="coarse")
+
+    def per_row(self) -> "WorkflowDataFrame":
+        return self.partition("per_row")
+
+    # ---- transform -------------------------------------------------------
+    def transform(
+        self,
+        using: Any,
+        schema: Any = None,
+        params: Any = None,
+        pre_partition: Any = None,
+        ignore_errors: Optional[List[type]] = None,
+        callback: Any = None,
+    ) -> "WorkflowDataFrame":
+        if pre_partition is None and self._pending_partition is not None:
+            pre_partition = self._pending_partition
+        task = ProcessTask(
+            RunTransformer,
+            params=dict(
+                transformer=using,
+                schema=schema,
+                params=ParamDict(params),
+                ignore_errors=ignore_errors or [],
+                rpc_handler=None if callback is None else to_rpc_handler(callback),
+            ),
+            partition_spec=PartitionSpec(pre_partition),
+            input_tasks=[self._task],
+        )
+        return self._workflow.add(task)
+
+    def out_transform(
+        self,
+        using: Any,
+        params: Any = None,
+        pre_partition: Any = None,
+        ignore_errors: Optional[List[type]] = None,
+        callback: Any = None,
+    ) -> None:
+        if pre_partition is None and self._pending_partition is not None:
+            pre_partition = self._pending_partition
+        task = OutputTask(
+            RunOutputTransformer,
+            params=dict(
+                transformer=using,
+                params=ParamDict(params),
+                ignore_errors=ignore_errors or [],
+                rpc_handler=None if callback is None else to_rpc_handler(callback),
+            ),
+            partition_spec=PartitionSpec(pre_partition),
+            input_tasks=[self._task],
+        )
+        self._workflow.add(task)
+
+    def process(
+        self,
+        using: Any,
+        schema: Any = None,
+        params: Any = None,
+        pre_partition: Any = None,
+    ) -> "WorkflowDataFrame":
+        return self._workflow.process(
+            self, using=using, schema=schema, params=params,
+            pre_partition=pre_partition or self._pending_partition,
+        )
+
+    def output(self, using: Any, params: Any = None, pre_partition: Any = None) -> None:
+        self._workflow.output(
+            self, using=using, params=params,
+            pre_partition=pre_partition or self._pending_partition,
+        )
+
+    # ---- relational ------------------------------------------------------
+    def join(
+        self, *dfs: "WorkflowDataFrame", how: str, on: Optional[List[str]] = None
+    ) -> "WorkflowDataFrame":
+        return self._workflow.join(self, *dfs, how=how, on=on)
+
+    def inner_join(self, *dfs: Any, on: Optional[List[str]] = None) -> "WorkflowDataFrame":
+        return self.join(*dfs, how="inner", on=on)
+
+    def semi_join(self, *dfs: Any, on: Optional[List[str]] = None) -> "WorkflowDataFrame":
+        return self.join(*dfs, how="semi", on=on)
+
+    def anti_join(self, *dfs: Any, on: Optional[List[str]] = None) -> "WorkflowDataFrame":
+        return self.join(*dfs, how="anti", on=on)
+
+    def left_outer_join(self, *dfs: Any, on: Optional[List[str]] = None) -> "WorkflowDataFrame":
+        return self.join(*dfs, how="left_outer", on=on)
+
+    def right_outer_join(self, *dfs: Any, on: Optional[List[str]] = None) -> "WorkflowDataFrame":
+        return self.join(*dfs, how="right_outer", on=on)
+
+    def full_outer_join(self, *dfs: Any, on: Optional[List[str]] = None) -> "WorkflowDataFrame":
+        return self.join(*dfs, how="full_outer", on=on)
+
+    def cross_join(self, *dfs: Any) -> "WorkflowDataFrame":
+        return self.join(*dfs, how="cross")
+
+    def union(self, *dfs: Any, distinct: bool = True) -> "WorkflowDataFrame":
+        return self._workflow.set_op("union", self, *dfs, distinct=distinct)
+
+    def subtract(self, *dfs: Any, distinct: bool = True) -> "WorkflowDataFrame":
+        return self._workflow.set_op("subtract", self, *dfs, distinct=distinct)
+
+    def intersect(self, *dfs: Any, distinct: bool = True) -> "WorkflowDataFrame":
+        return self._workflow.set_op("intersect", self, *dfs, distinct=distinct)
+
+    def distinct(self) -> "WorkflowDataFrame":
+        return self._add_process(Distinct)
+
+    def dropna(
+        self, how: str = "any", thresh: Optional[int] = None,
+        subset: Optional[List[str]] = None,
+    ) -> "WorkflowDataFrame":
+        params: Dict[str, Any] = dict(how=how, subset=subset)
+        if thresh is not None:
+            params["thresh"] = thresh
+        return self._add_process(Dropna, params=params)
+
+    def fillna(self, value: Any, subset: Optional[List[str]] = None) -> "WorkflowDataFrame":
+        return self._add_process(Fillna, params=dict(value=value, subset=subset))
+
+    def sample(
+        self, n: Optional[int] = None, frac: Optional[float] = None,
+        replace: bool = False, seed: Optional[int] = None,
+    ) -> "WorkflowDataFrame":
+        params: Dict[str, Any] = dict(replace=replace)
+        if n is not None:
+            params["n"] = n
+        if frac is not None:
+            params["frac"] = frac
+        if seed is not None:
+            params["seed"] = seed
+        return self._add_process(Sample, params=params)
+
+    def take(
+        self, n: int, presort: str = "", na_position: str = "last"
+    ) -> "WorkflowDataFrame":
+        return self._add_process(
+            Take,
+            params=dict(n=n, presort=presort, na_position=na_position),
+            partition_spec=self._pending_partition,
+        )
+
+    def select(
+        self,
+        *columns: Union[str, ColumnExpr],
+        where: Optional[ColumnExpr] = None,
+        having: Optional[ColumnExpr] = None,
+        distinct: bool = False,
+    ) -> "WorkflowDataFrame":
+        from fugue_tpu.column.expressions import col as _col
+
+        cols = SelectColumns(
+            *[_col(c) if isinstance(c, str) else c for c in columns],
+            arg_distinct=distinct,
+        )
+        return self._add_process(
+            Select, params=dict(columns=cols, where=where, having=having)
+        )
+
+    def filter(self, condition: ColumnExpr) -> "WorkflowDataFrame":
+        return self._add_process(Filter, params=dict(condition=condition))
+
+    def assign(self, **columns: Any) -> "WorkflowDataFrame":
+        from fugue_tpu.column.expressions import lit
+
+        cols = [
+            (v if isinstance(v, ColumnExpr) else lit(v)).alias(k)
+            for k, v in columns.items()
+        ]
+        return self._add_process(Assign, params=dict(columns=cols))
+
+    def aggregate(self, **agg_kwcols: ColumnExpr) -> "WorkflowDataFrame":
+        cols = [v.alias(k) for k, v in agg_kwcols.items()]
+        return self._add_process(
+            Aggregate,
+            params=dict(columns=cols),
+            partition_spec=self._pending_partition,
+        )
+
+    # ---- schema ops ------------------------------------------------------
+    def rename(self, *args: Dict[str, str], **kwargs: str) -> "WorkflowDataFrame":
+        columns: Dict[str, str] = {}
+        for a in args:
+            columns.update(a)
+        columns.update(kwargs)
+        return self._add_process(Rename, params=dict(columns=columns))
+
+    def alter_columns(self, columns: Any) -> "WorkflowDataFrame":
+        return self._add_process(AlterColumns, params=dict(columns=str(columns)))
+
+    def drop(self, columns: List[str], if_exists: bool = False) -> "WorkflowDataFrame":
+        return self._add_process(
+            DropColumns, params=dict(columns=columns, if_exists=if_exists)
+        )
+
+    def __getitem__(self, columns: List[Any]) -> "WorkflowDataFrame":
+        return self._add_process(SelectColumnsP, params=dict(columns=columns))
+
+    # ---- zip -------------------------------------------------------------
+    def zip(
+        self,
+        *dfs: "WorkflowDataFrame",
+        how: str = "inner",
+        partition: Any = None,
+        temp_path: Optional[str] = None,
+        to_file_threshold: int = -1,
+    ) -> "WorkflowDataFrame":
+        return self._workflow.zip(
+            self, *dfs, how=how,
+            partition=partition or self._pending_partition,
+            temp_path=temp_path, to_file_threshold=to_file_threshold,
+        )
+
+    # ---- checkpoints / persist / broadcast ------------------------------
+    def persist(self) -> "WorkflowDataFrame":
+        self._task.checkpoint = WeakCheckpoint(lazy=False)
+        return self
+
+    def weak_checkpoint(self, lazy: bool = False, **kwargs: Any) -> "WorkflowDataFrame":
+        self._task.checkpoint = WeakCheckpoint(lazy=lazy, **kwargs)
+        return self
+
+    def checkpoint(self, **kwargs: Any) -> "WorkflowDataFrame":
+        # non-deterministic strong checkpoint lives in the per-run TEMP dir
+        # (cleaned up after run); only deterministic ones are permanent
+        self._task.checkpoint = StrongCheckpoint(
+            obj_id=str(uuid4()), deterministic=False, permanent=False, **kwargs
+        )
+        return self
+
+    def strong_checkpoint(self, **kwargs: Any) -> "WorkflowDataFrame":
+        return self.checkpoint(**kwargs)
+
+    def deterministic_checkpoint(
+        self, namespace: Any = None, **kwargs: Any
+    ) -> "WorkflowDataFrame":
+        self._task.checkpoint = StrongCheckpoint(
+            obj_id=self._task.__uuid__(),
+            deterministic=True,
+            permanent=True,
+            namespace=namespace,
+            **kwargs,
+        )
+        return self
+
+    def broadcast(self) -> "WorkflowDataFrame":
+        self._task.broadcast_result = True
+        return self
+
+    # ---- yields ----------------------------------------------------------
+    def yield_dataframe_as(self, name: str, as_local: bool = False) -> None:
+        y = YieldedDataFrame(self._task.__uuid__())
+        self._task.yields.append(y)
+        self._task.yield_as_local = as_local
+        self._workflow.register_yield(name, y)
+
+    def yield_file_as(self, name: str, **kwargs: Any) -> None:
+        if not isinstance(self._task.checkpoint, StrongCheckpoint):
+            self._task.checkpoint = StrongCheckpoint(
+                obj_id=self._task.__uuid__(), deterministic=True, permanent=True,
+                **kwargs,
+            )
+        y = PhysicalYielded(self._task.__uuid__(), "file")
+        self._task.checkpoint.yielded = y  # type: ignore
+        self._workflow.register_yield(name, y)
+
+    def yield_table_as(self, name: str, **kwargs: Any) -> None:
+        raise NotImplementedError(
+            "table yields require a table-supporting SQL engine"
+        )
+
+    # ---- io / output sugar ----------------------------------------------
+    def save(
+        self,
+        path: str,
+        fmt: str = "",
+        mode: str = "overwrite",
+        partition: Any = None,
+        single: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        task = OutputTask(
+            Save,
+            params=dict(path=path, fmt=fmt, mode=mode, single=single, params=kwargs),
+            partition_spec=PartitionSpec(partition or self._pending_partition),
+            input_tasks=[self._task],
+        )
+        self._workflow.add(task)
+
+    def save_and_use(
+        self,
+        path: str,
+        fmt: str = "",
+        mode: str = "overwrite",
+        partition: Any = None,
+        **kwargs: Any,
+    ) -> "WorkflowDataFrame":
+        return self._add_process(
+            SaveAndUse,
+            params=dict(path=path, fmt=fmt, mode=mode, params=kwargs),
+            partition_spec=PartitionSpec(partition or self._pending_partition),
+        )
+
+    def show(
+        self, n: int = 10, with_count: bool = False, title: Optional[str] = None
+    ) -> None:
+        task = OutputTask(
+            Show,
+            params=dict(n=n, with_count=with_count, title=title or ""),
+            input_tasks=[self._task],
+        )
+        self._workflow.add(task)
+
+    def assert_eq(self, *dfs: "WorkflowDataFrame", **params: Any) -> None:
+        self._workflow.assert_eq(self, *dfs, **params)
+
+    def assert_not_eq(self, *dfs: "WorkflowDataFrame", **params: Any) -> None:
+        self._workflow.assert_not_eq(self, *dfs, **params)
+
+    # ---- internals -------------------------------------------------------
+    def _add_process(
+        self,
+        ext: Any,
+        params: Any = None,
+        partition_spec: Optional[PartitionSpec] = None,
+    ) -> "WorkflowDataFrame":
+        task = ProcessTask(
+            ext,
+            params=params,
+            partition_spec=partition_spec or PartitionSpec(),
+            input_tasks=[self._task],
+        )
+        return self._workflow.add(task)
+
+
+class FugueWorkflow:
+    """Build and run a workflow DAG (reference workflow.py:1499)."""
+
+    def __init__(self, compile_conf: Any = None):
+        self._tasks: List[FugueTask] = []
+        self._yields: Dict[str, Yielded] = {}
+        self._conf = ParamDict(FUGUE_GLOBAL_CONF)
+        self._conf.update(ParamDict(compile_conf))
+        self._computed = False
+        self._last_df: Optional[WorkflowDataFrame] = None
+
+    @property
+    def yields(self) -> Dict[str, Yielded]:
+        return self._yields
+
+    @property
+    def last_df(self) -> Optional[WorkflowDataFrame]:
+        return self._last_df
+
+    def register_yield(self, name: str, y: Yielded) -> None:
+        assert_or_throw(
+            name not in self._yields, ValueError(f"duplicated yield {name}")
+        )
+        self._yields[name] = y
+
+    def add(self, task: FugueTask) -> WorkflowDataFrame:
+        task.callsite = extract_user_callsite(
+            self._conf.get(FUGUE_CONF_WORKFLOW_EXCEPTION_INJECT, 3),
+            [self._conf.get(FUGUE_CONF_WORKFLOW_EXCEPTION_HIDE, "fugue_tpu.")],
+        )
+        self._tasks.append(task)
+        res = WorkflowDataFrame(self, task)
+        if not isinstance(task, OutputTask):
+            self._last_df = res
+        return res
+
+    # ---- creation --------------------------------------------------------
+    def create(
+        self, using: Any, schema: Any = None, params: Any = None
+    ) -> WorkflowDataFrame:
+        task = CreateTask(using, params=ParamDict(params), schema=schema)
+        return self.add(task)
+
+    def df(self, data: Any, schema: Any = None) -> WorkflowDataFrame:
+        return self.create_data(data, schema)
+
+    def create_data(self, data: Any, schema: Any = None) -> WorkflowDataFrame:
+        if isinstance(data, WorkflowDataFrame):
+            assert_or_throw(
+                data.workflow is self, ValueError("dataframe from another workflow")
+            )
+            return data
+        task = CreateTask(
+            CreateData,
+            params=dict(
+                data=data, schema=None if schema is None else str(Schema(schema))
+            ),
+        )
+        return self.add(task)
+
+    def load(
+        self, path: str, fmt: str = "", columns: Any = None, **kwargs: Any
+    ) -> WorkflowDataFrame:
+        task = CreateTask(
+            Load,
+            params=dict(path=path, fmt=fmt, columns=columns, params=kwargs),
+        )
+        return self.add(task)
+
+    # ---- generic ---------------------------------------------------------
+    def process(
+        self,
+        *dfs: Any,
+        using: Any,
+        schema: Any = None,
+        params: Any = None,
+        pre_partition: Any = None,
+    ) -> WorkflowDataFrame:
+        inputs, names = self._resolve_dfs(*dfs)
+        task = ProcessTask(
+            using,
+            params=ParamDict(params),
+            schema=schema,
+            partition_spec=PartitionSpec(pre_partition),
+            input_tasks=inputs,
+            input_names=names,
+        )
+        return self.add(task)
+
+    def output(
+        self, *dfs: Any, using: Any, params: Any = None, pre_partition: Any = None
+    ) -> None:
+        inputs, names = self._resolve_dfs(*dfs)
+        task = OutputTask(
+            using,
+            params=ParamDict(params),
+            partition_spec=PartitionSpec(pre_partition),
+            input_tasks=inputs,
+            input_names=names,
+        )
+        self.add(task)
+
+    def transform(self, *dfs: Any, using: Any, **kwargs: Any) -> WorkflowDataFrame:
+        assert_or_throw(len(dfs) == 1, ValueError("transform takes 1 df"))
+        return self.create_data(dfs[0]).transform(using, **kwargs)
+
+    def out_transform(self, *dfs: Any, using: Any, **kwargs: Any) -> None:
+        assert_or_throw(len(dfs) == 1, ValueError("out_transform takes 1 df"))
+        self.create_data(dfs[0]).out_transform(using, **kwargs)
+
+    # ---- multi-df ops ----------------------------------------------------
+    def join(
+        self, *dfs: Any, how: str, on: Optional[List[str]] = None
+    ) -> WorkflowDataFrame:
+        inputs, names = self._resolve_dfs(*dfs)
+        task = ProcessTask(
+            RunJoin,
+            params=dict(how=how, on=on or []),
+            input_tasks=inputs,
+            input_names=names,
+        )
+        return self.add(task)
+
+    def set_op(self, how: str, *dfs: Any, distinct: bool = True) -> WorkflowDataFrame:
+        inputs, names = self._resolve_dfs(*dfs)
+        task = ProcessTask(
+            RunSetOperation,
+            params=dict(how=how, distinct=distinct),
+            input_tasks=inputs,
+            input_names=names,
+        )
+        return self.add(task)
+
+    def union(self, *dfs: Any, distinct: bool = True) -> WorkflowDataFrame:
+        return self.set_op("union", *dfs, distinct=distinct)
+
+    def subtract(self, *dfs: Any, distinct: bool = True) -> WorkflowDataFrame:
+        return self.set_op("subtract", *dfs, distinct=distinct)
+
+    def intersect(self, *dfs: Any, distinct: bool = True) -> WorkflowDataFrame:
+        return self.set_op("intersect", *dfs, distinct=distinct)
+
+    def zip(
+        self,
+        *dfs: Any,
+        how: str = "inner",
+        partition: Any = None,
+        temp_path: Optional[str] = None,
+        to_file_threshold: int = -1,
+    ) -> WorkflowDataFrame:
+        inputs, names = self._resolve_dfs(*dfs)
+        task = ProcessTask(
+            Zip,
+            params=dict(
+                how=how, temp_path=temp_path, to_file_threshold=to_file_threshold
+            ),
+            partition_spec=PartitionSpec(partition),
+            input_tasks=inputs,
+            input_names=names,
+        )
+        return self.add(task)
+
+    def select(
+        self,
+        statement: Union[str, StructuredRawSQL],
+        dfs: Optional[Dict[str, Any]] = None,
+        dialect: Optional[str] = None,
+    ) -> WorkflowDataFrame:
+        """Raw SQL SELECT against named dataframes via the engine's SQLEngine."""
+        named = {k: self.create_data(v) for k, v in (dfs or {}).items()}
+        inputs = [v.task for v in named.values()]
+        names = list(named.keys())
+        if isinstance(statement, str):
+            statement = StructuredRawSQL([(False, statement)], dialect=dialect)
+        task = ProcessTask(
+            RunSQLSelect,
+            params=dict(statement=statement),
+            input_tasks=inputs,
+            input_names=names if len(names) > 0 else None,
+        )
+        return self.add(task)
+
+    def assert_eq(self, *dfs: Any, **params: Any) -> None:
+        self.output(*dfs, using=AssertEqFunc, params=params)
+
+    def assert_not_eq(self, *dfs: Any, **params: Any) -> None:
+        self.output(*dfs, using=AssertNotEqFunc, params=params)
+
+    def show(
+        self, *dfs: Any, n: int = 10, with_count: bool = False,
+        title: Optional[str] = None,
+    ) -> None:
+        self.output(
+            *dfs, using=Show, params=dict(n=n, with_count=with_count,
+                                          title=title or ""),
+        )
+
+    # ---- run -------------------------------------------------------------
+    def run(self, engine: Any = None, conf: Any = None) -> "FugueWorkflowResult":
+        e = make_execution_engine(engine, conf)
+        execution_id = str(uuid4())
+        rpc_server = make_rpc_server(e.conf)
+        checkpoint_path = CheckpointPath(e)
+        ctx = TaskContext(e, rpc_server, checkpoint_path)
+        started_rpc = in_ctx = False
+        try:
+            rpc_server.start()
+            started_rpc = True
+            e.as_context()
+            in_ctx = True
+            checkpoint_path.init_temp_path(execution_id)
+            index_of = {id(t): i for i, t in enumerate(self._tasks)}
+            nodes = [
+                TaskNode(
+                    t.__uuid__() + f"_{i}",
+                    self._make_task_func(t, ctx),
+                    [
+                        inp.__uuid__() + f"_{index_of[id(inp)]}"
+                        for inp in t.inputs
+                    ],
+                )
+                for i, t in enumerate(self._tasks)
+            ]
+            concurrency = e.conf.get(FUGUE_CONF_WORKFLOW_CONCURRENCY, 1)
+            try:
+                DAGRunner(concurrency).run(nodes)
+            except Exception as ex:
+                # prune at the outermost point: frames added during
+                # propagation through the runner are framework noise too
+                if self._conf.get("fugue.workflow.exception.optimize", True):
+                    hide = [
+                        self._conf.get(
+                            FUGUE_CONF_WORKFLOW_EXCEPTION_HIDE, "fugue_tpu."
+                        ),
+                        "concurrent.futures.",
+                        "threading",
+                    ]
+                    raise ex.with_traceback(
+                        prune_traceback(ex.__traceback__, hide)
+                    ) from None
+                raise
+            self._computed = True
+        finally:
+            if in_ctx:
+                e.stop_context()
+            checkpoint_path.remove_temp_path()
+            if started_rpc:
+                rpc_server.stop()
+        return FugueWorkflowResult(self._yields)
+
+    def _make_task_func(self, task: FugueTask, ctx: TaskContext) -> Callable:
+        def run_task(inputs: List[Any]) -> Any:
+            try:
+                return task.execute(ctx, inputs)
+            except Exception as ex:
+                self._reraise_with_callsite(task, ex)
+
+        return run_task
+
+    def _reraise_with_callsite(self, task: FugueTask, ex: Exception) -> None:
+        if task.callsite:
+            try:
+                ex.add_note("defined at:\n" + "\n".join(task.callsite))
+            except Exception:  # pragma: no cover
+                pass
+        raise ex
+
+    def __enter__(self) -> "FugueWorkflow":
+        return self
+
+    def __exit__(self, exc_type: Any, *args: Any) -> None:
+        if exc_type is None:
+            self.run()
+
+    def __uuid__(self) -> str:
+        return to_uuid([t.__uuid__() for t in self._tasks])
+
+    def _resolve_dfs(self, *dfs: Any) -> Any:
+        if len(dfs) == 1 and isinstance(dfs[0], dict):
+            named = {k: self.create_data(v) for k, v in dfs[0].items()}
+            return [v.task for v in named.values()], list(named.keys())
+        inputs = [self.create_data(d).task for d in dfs]
+        return inputs, None
+
+
+class FugueWorkflowResult:
+    """Run result: access yielded dataframes (reference workflow.py:1609)."""
+
+    def __init__(self, yields: Dict[str, Yielded]):
+        self._yields = yields
+
+    @property
+    def yields(self) -> Dict[str, Yielded]:
+        return self._yields
+
+    def __getitem__(self, name: str) -> Any:
+        y = self._yields[name]
+        if isinstance(y, YieldedDataFrame):
+            return y.result
+        return y

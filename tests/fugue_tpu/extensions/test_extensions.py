@@ -1,0 +1,271 @@
+from typing import Any, Callable, Iterable, List, Optional
+
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from fugue_tpu.collections.partition import PartitionSpec
+from fugue_tpu.dataframe import ArrayDataFrame, DataFrames
+from fugue_tpu.dataframe.utils import df_eq
+from fugue_tpu.execution import NativeExecutionEngine
+from fugue_tpu.extensions import (
+    CoTransformer,
+    Transformer,
+    _to_creator,
+    _to_outputter,
+    _to_processor,
+    _to_transformer,
+    register_transformer,
+    transformer,
+)
+from fugue_tpu.extensions.builtins import RunTransformer
+from fugue_tpu.utils.params import ParamDict
+
+
+def _run_transform(engine, df, func, schema=None, partition=None, params=None):
+    r = RunTransformer()
+    r._execution_engine = engine
+    r._partition_spec = PartitionSpec(partition) if partition else PartitionSpec()
+    r._params = ParamDict(
+        {"transformer": func, "schema": schema, "params": params or {}}
+    )
+    return r.process(DataFrames(df))
+
+
+def test_pandas_transformer():
+    e = NativeExecutionEngine()
+
+    def f(df: pd.DataFrame) -> pd.DataFrame:
+        return df.assign(b=df["a"] * 2)
+
+    t = _to_transformer(f, "a:long,b:long")
+    assert t.wrapper.input_code == "p"
+    assert t.get_format_hint() == "pandas"
+    res = _run_transform(e, e.to_df([[1], [2]], "a:long"), f, "*,b:long")
+    assert df_eq(res, [[1, 2], [2, 4]], "a:long,b:long", throw=True)
+
+
+def test_schema_from_comment():
+    # schema: a:long,c:long
+    def f(rows: Iterable[List[Any]]) -> Iterable[List[Any]]:
+        for r in rows:
+            yield [r[0], r[0] + 1]
+
+    t = _to_transformer(f)
+    out = t.get_output_schema(ArrayDataFrame([[1]], "a:long"))
+    assert out == "a:long,c:long"
+
+
+def test_schema_hints_star():
+    e = NativeExecutionEngine()
+
+    def f(df: pd.DataFrame) -> pd.DataFrame:
+        return df.assign(z=1).drop(columns=["b"])
+
+    res = _run_transform(
+        e, e.to_df([[1, "x"]], "a:long,b:str"), f, "*,-b,+z:long"
+    )
+    assert df_eq(res, [[1, 1]], "a:long,z:long", throw=True)
+
+
+def test_iterable_transformer():
+    e = NativeExecutionEngine()
+
+    def f(dfs: Iterable[pd.DataFrame]) -> Iterable[pd.DataFrame]:
+        for df in dfs:
+            yield df.head(1)
+
+    res = _run_transform(
+        e, e.to_df([[1, "a"], [2, "a"], [3, "b"]], "x:long,k:str"), f, "*",
+        partition={"by": ["k"]},
+    )
+    assert df_eq(res, [[1, "a"], [3, "b"]], "x:long,k:str", throw=True)
+
+
+def test_transformer_with_params_and_cursor():
+    e = NativeExecutionEngine()
+
+    class MyT(Transformer):
+        def get_output_schema(self, df):
+            return "k:str,n:long"
+
+        def transform(self, df):
+            assert self.params.get("m", 0) == 7
+            k = self.cursor.key_value_dict["k"]
+            return ArrayDataFrame([[k, df.count()]], "k:str,n:long")
+
+    res = _run_transform(
+        e, e.to_df([[1, "a"], [2, "a"], [3, "b"]], "x:long,k:str"),
+        MyT, partition={"by": ["k"]}, params={"m": 7},
+    )
+    assert df_eq(res, [["a", 2], ["b", 1]], "k:str,n:long", throw=True)
+
+
+def test_transformer_on_init():
+    e = NativeExecutionEngine()
+    state = []
+
+    class MyT(Transformer):
+        def get_output_schema(self, df):
+            return df.schema
+
+        def on_init(self, df):
+            state.append("init")
+
+        def transform(self, df):
+            assert len(state) > 0
+            return df
+
+    res = _run_transform(e, e.to_df([[1]], "a:long"), MyT)
+    assert df_eq(res, [[1]], "a:long", throw=True)
+    assert state == ["init"]
+
+
+def test_ignore_errors():
+    e = NativeExecutionEngine()
+
+    def f(df: pd.DataFrame) -> pd.DataFrame:
+        if df["k"].iloc[0] == "b":
+            raise NotImplementedError("boom")
+        return df
+
+    r = RunTransformer()
+    r._execution_engine = e
+    r._partition_spec = PartitionSpec(by=["k"])
+    r._params = ParamDict(
+        {
+            "transformer": f,
+            "schema": "*",
+            "params": {},
+            "ignore_errors": [NotImplementedError],
+        }
+    )
+    res = r.process(DataFrames(e.to_df([[1, "a"], [3, "b"]], "x:long,k:str")))
+    assert df_eq(res, [[1, "a"]], "x:long,k:str", throw=True)
+    # without ignore_errors it raises
+    with pytest.raises(NotImplementedError):
+        _run_transform(
+            e, e.to_df([[3, "b"]], "x:long,k:str"), f, "*", partition={"by": ["k"]}
+        )
+
+
+def test_cotransformer_detection_and_decorator():
+    def cf(df1: pd.DataFrame, df2: pd.DataFrame) -> pd.DataFrame:
+        return df1
+
+    assert isinstance(_to_transformer(cf, "a:int"), CoTransformer)
+
+    @transformer("a:long,b:long")
+    def decorated(df: pd.DataFrame) -> pd.DataFrame:
+        return df.assign(b=1)
+
+    t = _to_transformer(decorated)
+    assert isinstance(t, Transformer)
+
+
+def test_register_transformer_alias():
+    def f(df: pd.DataFrame) -> pd.DataFrame:
+        return df
+
+    register_transformer("my_f_alias", f)
+    e = NativeExecutionEngine()
+    res = _run_transform(e, e.to_df([[1]], "a:long"), "my_f_alias", "*")
+    assert df_eq(res, [[1]], "a:long", throw=True)
+    with pytest.raises(ValueError):
+        _to_transformer("not_registered_xyz")
+
+
+def test_validation_rules():
+    e = NativeExecutionEngine()
+
+    # partitionby_has: k
+    def f(df: pd.DataFrame) -> pd.DataFrame:
+        return df
+
+    with pytest.raises(ValueError):
+        _run_transform(e, e.to_df([[1, "a"]], "x:long,k:str"), f, "*")
+    res = _run_transform(
+        e, e.to_df([[1, "a"]], "x:long,k:str"), f, "*", partition={"by": ["k"]}
+    )
+    assert res.count() == 1
+
+
+def test_creator_processor_outputter():
+    e = NativeExecutionEngine()
+
+    def make(n: int) -> pd.DataFrame:
+        return pd.DataFrame({"a": list(range(n))})
+
+    c = _to_creator(make, "a:long")
+    c._execution_engine = e
+    c._params = ParamDict({"n": 3})
+    assert c.create().as_local().count() == 3
+
+    def proc(df: List[List[Any]]) -> List[List[Any]]:
+        return [[r[0] * 10] for r in df]
+
+    p = _to_processor(proc, "a:long")
+    p._execution_engine = e
+    p._params = ParamDict()
+    assert df_eq(
+        p.process(DataFrames(e.to_df([[1]], "a:long"))).as_local(),
+        [[10]], "a:long", throw=True,
+    )
+
+    collected = []
+
+    def out(df: List[List[Any]]) -> None:
+        collected.extend(df)
+
+    o = _to_outputter(out)
+    o._execution_engine = e
+    o._params = ParamDict()
+    o.process(DataFrames(e.to_df([[9]], "a:long")))
+    assert collected == [[9]]
+
+
+def test_engine_param_in_processor():
+    from fugue_tpu.execution import ExecutionEngine
+
+    e = NativeExecutionEngine()
+
+    def proc(engine: ExecutionEngine, df: pd.DataFrame) -> pd.DataFrame:
+        assert engine is e
+        return df
+
+    p = _to_processor(proc, "a:long")
+    p._execution_engine = e
+    p._params = ParamDict()
+    assert p.process(DataFrames(e.to_df([[1]], "a:long"))).as_local().count() == 1
+
+
+def test_callback_param():
+    e = NativeExecutionEngine()
+    from fugue_tpu.rpc import NativeRPCServer
+
+    server = NativeRPCServer()
+    server.start()
+    try:
+        hits = []
+
+        def f(df: pd.DataFrame, cb: Callable) -> pd.DataFrame:
+            cb("hello")
+            return df
+
+        r = RunTransformer()
+        r._execution_engine = e
+        r._partition_spec = PartitionSpec()
+        r._rpc_server = server
+        r._params = ParamDict(
+            {
+                "transformer": f,
+                "schema": "*",
+                "params": {},
+                "rpc_handler": lambda x: hits.append(x),
+            }
+        )
+        res = r.process(DataFrames(e.to_df([[1]], "a:long")))
+        res.as_local()
+        assert hits == ["hello"]
+    finally:
+        server.stop()

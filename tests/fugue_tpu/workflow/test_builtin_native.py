@@ -1,0 +1,7 @@
+from fugue_tpu.execution import ExecutionEngine, NativeExecutionEngine
+from fugue_tpu_test.builtin_suite import BuiltInTests
+
+
+class TestBuiltInNative(BuiltInTests.Tests):
+    def make_engine(self) -> ExecutionEngine:
+        return NativeExecutionEngine(dict(test=True))
