@@ -282,8 +282,8 @@ class _NoneParam(AnnotatedParam):
     code = "n"
 
 
-_DF_INPUT_CODES = "dlpqrRmMPQ"
-_DF_OUTPUT_CODES = "dlpqrRmMPQ"
+_DF_INPUT_CODES = "dlpqrRmMPQj"
+_DF_OUTPUT_CODES = "dlpqrRmMPQj"
 
 
 def annotation_code(annotation: Any) -> str:

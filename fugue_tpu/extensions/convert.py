@@ -29,7 +29,7 @@ from fugue_tpu.schema import Schema
 from fugue_tpu.utils.assertion import assert_or_throw
 from fugue_tpu.utils.hash import to_uuid
 
-_DF = "[dlpqrRmMPQ]"
+_DF = "[dlpqrRmMPQj]"
 
 _REGISTRIES: Dict[str, Dict[str, Any]] = {
     "creator": {},
@@ -181,7 +181,7 @@ class _FuncAsOutputTransformer(_FuncExtension, OutputTransformer):
         func: Callable, validation: Dict[str, Any]
     ) -> "_FuncAsOutputTransformer":
         validation = dict(parse_validation_rules_from_comment(func), **validation)
-        wrapper = DataFrameFunctionWrapper(func, f"^{_DF}[fF]?x*$", "^[dlpqrRmMPQn]$")
+        wrapper = DataFrameFunctionWrapper(func, f"^{_DF}[fF]?x*$", "^[dlpqrRmMPQjn]$")
         return _FuncAsOutputTransformer(wrapper, validate_rules(validation))
 
 
@@ -250,7 +250,7 @@ class _FuncAsOutputCoTransformer(_FuncExtension, OutputCoTransformer):
     ) -> "_FuncAsOutputCoTransformer":
         validation = dict(parse_validation_rules_from_comment(func), **validation)
         wrapper = DataFrameFunctionWrapper(
-            func, f"^(c|{_DF}+)[fF]?x*$", "^[dlpqrRmMPQn]$"
+            func, f"^(c|{_DF}+)[fF]?x*$", "^[dlpqrRmMPQjn]$"
         )
         return _FuncAsOutputCoTransformer(wrapper, validate_rules(validation))
 

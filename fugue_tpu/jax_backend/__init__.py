@@ -1,0 +1,16 @@
+import os
+
+import jax as _jax
+
+# Data fidelity requires 64-bit dtypes (long columns, timestamp microseconds):
+# without x64, device_put silently truncates int64 -> int32. Opt out only if
+# you know every column fits 32 bits (e.g. pure-float32 TPU pipelines).
+if os.environ.get("FUGUE_TPU_DISABLE_X64", "").lower() not in ("1", "true"):
+    _jax.config.update("jax_enable_x64", True)
+
+from fugue_tpu.jax_backend.dataframe import JaxDataFrame
+from fugue_tpu.jax_backend.execution_engine import (
+    JaxExecutionEngine,
+    JaxMapEngine,
+    JaxSQLEngine,
+)

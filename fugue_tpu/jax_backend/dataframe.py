@@ -1,0 +1,137 @@
+"""JaxDataFrame: a DataFrame whose columns live as sharded jax.Arrays
+(the ``fugue_jax`` sibling-backend dataframe of the BASELINE north star;
+structural parity role: fugue_spark/dataframe.py:38 etc.)."""
+
+from typing import Any, Dict, Iterable, List, Optional
+
+import pandas as pd
+import pyarrow as pa
+
+from fugue_tpu.dataframe import ArrowDataFrame, DataFrame, LocalBoundedDataFrame
+from fugue_tpu.dataframe.arrow_utils import cast_table
+from fugue_tpu.jax_backend.blocks import (
+    JaxBlocks,
+    JaxColumn,
+    from_arrow,
+    to_arrow,
+)
+from fugue_tpu.schema import Schema
+from fugue_tpu.utils.assertion import assert_or_throw
+
+
+class JaxDataFrame(DataFrame):
+    """Columnar, device-resident, mesh-sharded dataframe."""
+
+    def __init__(self, blocks: JaxBlocks, schema: Schema):
+        super().__init__(schema)
+        self._blocks = blocks
+
+    @staticmethod
+    def from_table(table: pa.Table, mesh: Any, schema: Optional[Schema] = None) -> "JaxDataFrame":
+        schema = Schema(table.schema) if schema is None else schema
+        return JaxDataFrame(from_arrow(table, schema, mesh), schema)
+
+    @property
+    def native(self) -> JaxBlocks:
+        return self._blocks
+
+    @property
+    def blocks(self) -> JaxBlocks:
+        return self._blocks
+
+    @property
+    def mesh(self) -> Any:
+        return self._blocks.mesh
+
+    @property
+    def is_local(self) -> bool:
+        return False
+
+    @property
+    def is_bounded(self) -> bool:
+        return True
+
+    @property
+    def num_partitions(self) -> int:
+        return int(self._blocks.mesh.devices.size)
+
+    @property
+    def empty(self) -> bool:
+        return self._blocks.nrows == 0
+
+    def count(self) -> int:
+        return self._blocks.nrows
+
+    def peek_array(self) -> List[Any]:
+        self.assert_not_empty()
+        return self.head(1).as_array(type_safe=True)[0]
+
+    def as_arrow(self, type_safe: bool = False) -> pa.Table:
+        return to_arrow(self._blocks, self.schema)
+
+    def as_pandas(self) -> pd.DataFrame:
+        from fugue_tpu.dataframe.arrow_utils import table_to_pandas
+
+        return table_to_pandas(self.as_arrow())
+
+    def as_local_bounded(self) -> LocalBoundedDataFrame:
+        res = ArrowDataFrame(self.as_arrow(), self.schema)
+        if self.has_metadata:
+            res.reset_metadata(self.metadata)
+        return res
+
+    def as_array(
+        self, columns: Optional[List[str]] = None, type_safe: bool = False
+    ) -> List[Any]:
+        return self.as_local_bounded().as_array(columns, type_safe)
+
+    def as_array_iterable(
+        self, columns: Optional[List[str]] = None, type_safe: bool = False
+    ) -> Iterable[Any]:
+        return self.as_local_bounded().as_array_iterable(columns, type_safe)
+
+    def _drop_cols(self, cols: List[str]) -> DataFrame:
+        schema = self.schema.exclude(cols)
+        return self._select_schema(schema)
+
+    def _select_cols(self, cols: List[Any]) -> DataFrame:
+        schema = self.schema.extract(cols)
+        return self._select_schema(schema)
+
+    def _select_schema(self, schema: Schema) -> "JaxDataFrame":
+        blocks = JaxBlocks(
+            self._blocks.nrows,
+            {n: self._blocks.columns[n] for n in schema.names},
+            self._blocks.mesh,
+        )
+        return JaxDataFrame(blocks, schema)
+
+    def rename(self, columns: Dict[str, str]) -> DataFrame:
+        schema = self._rename_schema(columns)
+        cols = {
+            columns.get(n, n): c for n, c in self._blocks.columns.items()
+        }
+        return JaxDataFrame(
+            JaxBlocks(self._blocks.nrows, cols, self._blocks.mesh), schema
+        )
+
+    def alter_columns(self, columns: Any) -> DataFrame:
+        new_schema = self._alter_schema(columns)
+        if new_schema == self.schema:
+            return self
+        # general correctness path: cast at the host boundary, re-device
+        table = cast_table(self.as_arrow(), new_schema)
+        return JaxDataFrame.from_table(table, self._blocks.mesh, new_schema)
+
+    def head(
+        self, n: int, columns: Optional[List[str]] = None
+    ) -> LocalBoundedDataFrame:
+        assert_or_throw(n >= 0, ValueError("n must be >= 0"))
+        schema = self.schema if columns is None else self.schema.extract(columns)
+        src = self if columns is None else self[columns]
+        take_n = min(n, self._blocks.nrows)
+        table = to_arrow(
+            JaxBlocks(take_n, src._blocks.columns, src._blocks.mesh),  # type: ignore
+            schema,
+        )
+        return ArrowDataFrame(table, schema)

@@ -1,0 +1,739 @@
+"""JaxExecutionEngine: the flagship TPU-native backend (BASELINE north star).
+
+Structure parity: a sibling of fugue_spark/fugue_dask engines (reference
+fugue_spark/execution_engine.py:336) — but TPU-first in design:
+
+- dataframes are mesh-sharded device blocks (see blocks.py)
+- select/filter/assign/aggregate lower to jit-compiled masked jnp programs
+  and sort+segment reductions (no shuffle: XLA inserts ICI collectives)
+- the map primitive has a compiled path for jax-annotated transformers
+  (``Dict[str, jax.Array] -> Dict[str, jax.Array]``, whole-shard vectorized —
+  the TPU-idiomatic transformer contract) and a host fallback with exact
+  reference semantics for everything else
+- relational ops that don't vectorize well yet (joins, set ops) run on the
+  host arrow path, then re-device: correctness everywhere, speed where it
+  counts; deeper device lowerings land in later rounds
+"""
+
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+from fugue_tpu.collections.partition import PartitionCursor, PartitionSpec
+from fugue_tpu.column.expressions import ColumnExpr, _NamedColumnExpr
+from fugue_tpu.column.sql import SelectColumns
+from fugue_tpu.constants import FUGUE_CONF_JAX_PARTITIONS
+from fugue_tpu.dataframe import (
+    ArrowDataFrame,
+    DataFrame,
+    LocalDataFrame,
+)
+from fugue_tpu.execution.execution_engine import (
+    ExecutionEngine,
+    MapEngine,
+    SQLEngine,
+)
+from fugue_tpu.execution.native_execution_engine import (
+    NativeExecutionEngine,
+    PandasMapEngine,
+    PandasSQLEngine,
+)
+from fugue_tpu.jax_backend import expr_eval, groupby
+from fugue_tpu.jax_backend.blocks import (
+    JaxBlocks,
+    JaxColumn,
+    from_arrow,
+    gather_indices,
+    make_mesh,
+    padded_len,
+    row_sharding,
+)
+from fugue_tpu.jax_backend.dataframe import JaxDataFrame
+from fugue_tpu.schema import Schema
+from fugue_tpu.utils.assertion import assert_or_throw
+
+
+class JaxMapEngine(MapEngine):
+    """Map primitive: compiled whole-shard path for jax transformers, host
+    loop fallback otherwise (role parity: SparkMapEngine's pandas-udf vs RDD
+    path selection, reference fugue_spark/execution_engine.py:112-133)."""
+
+    @property
+    def is_distributed(self) -> bool:
+        return True
+
+    def map_dataframe(
+        self,
+        df: DataFrame,
+        map_func: Callable[[PartitionCursor, LocalDataFrame], LocalDataFrame],
+        output_schema: Any,
+        partition_spec: PartitionSpec,
+        on_init: Optional[Callable[[int, DataFrame], Any]] = None,
+        map_func_format_hint: Optional[str] = None,
+    ) -> DataFrame:
+        engine: "JaxExecutionEngine" = self.execution_engine  # type: ignore
+        output_schema = Schema(output_schema)
+        if map_func_format_hint == "jax":
+            raw = self._extract_jax_func(map_func)
+            jdf = engine.to_df(df)
+            if raw is not None and self._device_mappable(
+                jdf, output_schema, partition_spec
+            ):
+                return self._compiled_map(
+                    jdf, raw, output_schema, partition_spec, on_init
+                )
+        # host fallback: exact reference semantics via the pandas map engine;
+        # fugue.jax.default.partitions sets the split count when the spec
+        # doesn't name one
+        default_parts = engine.conf.get(FUGUE_CONF_JAX_PARTITIONS, 0)
+        if (
+            default_parts > 0
+            and partition_spec.num_partitions == "0"
+            and len(partition_spec.partition_by) == 0
+        ):
+            partition_spec = PartitionSpec(partition_spec, num=default_parts)
+        host = PandasMapEngine(engine)
+        res = host.map_dataframe(
+            df, map_func, output_schema, partition_spec, on_init,
+            map_func_format_hint,
+        )
+        return engine.to_df(res)
+
+    def _extract_jax_func(self, map_func: Callable) -> Optional[Callable]:
+        """Reach the raw user function through the transformer runner."""
+        runner = getattr(map_func, "__self__", None)
+        tf = getattr(runner, "transformer", None)
+        wrapper = getattr(tf, "wrapper", None)
+        if wrapper is not None and wrapper.input_code.startswith("j"):
+            return wrapper.func
+        return None
+
+    def _device_mappable(
+        self, df: JaxDataFrame, output_schema: Schema, spec: PartitionSpec
+    ) -> bool:
+        ok_in = all(
+            c.on_device and not c.is_string for c in df.blocks.columns.values()
+        )
+        from fugue_tpu.jax_backend.blocks import is_device_type
+
+        ok_out = all(
+            is_device_type(f.type) and not pa.types.is_string(f.type)
+            for f in output_schema.fields
+        )
+        return ok_in and ok_out
+
+    def _compiled_map(
+        self,
+        df: JaxDataFrame,
+        fn: Callable,
+        output_schema: Schema,
+        spec: PartitionSpec,
+        on_init: Optional[Callable],
+    ) -> DataFrame:
+        """Whole-shard vectorized execution: the function sees the full
+        (padded, mesh-sharded) columns as a dict of jax arrays; XLA fuses and
+        auto-partitions; groups never leave the device.
+
+        Rows are padded to the mesh size: ``_row_valid`` marks real rows and
+        ``_nrows`` gives the true count. Groups are NOT contiguous; with
+        partition keys, ``_segment_ids``/``_num_segments`` are provided for
+        ``jax.ops.segment_*`` reductions (the TPU answer to per-group python
+        loops) — padding rows carry segment id ``_num_segments`` so segment
+        ops with ``num_segments=_num_segments`` drop them automatically."""
+        engine: "JaxExecutionEngine" = self.execution_engine  # type: ignore
+        blocks = df.blocks
+        if on_init is not None:
+            on_init(0, df)
+        arrs: Dict[str, Any] = {}
+        row_valid = groupby.row_validity(blocks)
+        keys = [k for k in spec.partition_by]
+        if len(keys) > 0:
+            seg, _, num = groupby.factorize_keys(blocks, keys)
+            # padding rows -> out-of-range segment: dropped by segment ops
+            arrs["_segment_ids"] = jnp.where(row_valid, seg, num)
+            arrs["_num_segments"] = num
+        for name, col in blocks.columns.items():
+            arrs[name] = col.data
+            if col.mask is not None:
+                arrs[f"_{name}_mask"] = col.mask
+        arrs["_nrows"] = blocks.nrows
+        arrs["_row_valid"] = row_valid
+        out = fn(dict(arrs))
+        assert_or_throw(
+            isinstance(out, dict),
+            ValueError("jax transformer must return a dict of arrays"),
+        )
+        ndev = int(blocks.mesh.devices.size)
+        sharding = row_sharding(blocks.mesh)
+        raw: Dict[str, Any] = {}
+        first = -1
+        for f in output_schema.fields:
+            assert_or_throw(
+                f.name in out,
+                ValueError(f"jax transformer output missing column {f.name}"),
+            )
+            data = jnp.asarray(out[f.name])
+            if first < 0:
+                first = int(data.shape[0])
+            assert_or_throw(
+                int(data.shape[0]) == first,
+                ValueError("jax transformer output columns differ in length"),
+            )
+            raw[f.name] = data
+        if "_nrows" in out:
+            out_rows = int(out["_nrows"])
+        elif first == blocks.padded_nrows:
+            out_rows = blocks.nrows  # same shape -> row-aligned output
+        else:
+            raise ValueError(
+                "jax transformer changed the row count "
+                f"({blocks.padded_nrows} -> {first}) without returning "
+                "'_nrows'; include '_nrows' in the output dict"
+            )
+        target = padded_len(first, ndev)
+        cols: Dict[str, JaxColumn] = {}
+        for f in output_schema.fields:
+            data = _pad_to(raw[f.name], target)
+            mask = out.get(f"_{f.name}_mask")
+            cols[f.name] = JaxColumn(
+                f.type,
+                jax.device_put(data, sharding),
+                None
+                if mask is None
+                else jax.device_put(_pad_to(jnp.asarray(mask), target), sharding),
+            )
+        return JaxDataFrame(
+            JaxBlocks(out_rows, cols, blocks.mesh), output_schema
+        )
+
+
+class JaxSQLEngine(PandasSQLEngine):
+    """SQL facet: parse with the built-in front end; GROUP BY plans route
+    back through JaxExecutionEngine.select -> device segment reductions."""
+
+    @property
+    def is_distributed(self) -> bool:
+        return True
+
+
+class JaxExecutionEngine(ExecutionEngine):
+    """ExecutionEngine over a jax device mesh (single controller).
+
+    Config keys: ``fugue.jax.default.partitions`` (logical split count for
+    host-fallback maps; default = mesh size)."""
+
+    def __init__(self, conf: Any = None, mesh: Any = None):
+        super().__init__(conf)
+        self._mesh = mesh if mesh is not None else make_mesh()
+        # host sibling used for fallback relational ops
+        self._native = NativeExecutionEngine(conf)
+
+    @property
+    def mesh(self) -> Any:
+        return self._mesh
+
+    @property
+    def is_distributed(self) -> bool:
+        return True
+
+    def create_default_map_engine(self) -> MapEngine:
+        return JaxMapEngine(self)
+
+    def create_default_sql_engine(self) -> SQLEngine:
+        return JaxSQLEngine(self)
+
+    def get_current_parallelism(self) -> int:
+        return int(self._mesh.devices.size)
+
+    def to_df(self, df: Any, schema: Any = None) -> DataFrame:
+        if isinstance(df, JaxDataFrame):
+            assert_or_throw(
+                schema is None, ValueError("schema must be None for JaxDataFrame")
+            )
+            return df
+        if isinstance(df, DataFrame):
+            assert_or_throw(
+                schema is None, ValueError("schema must be None for DataFrame")
+            )
+            res = JaxDataFrame.from_table(
+                df.as_local_bounded().as_arrow(type_safe=True),
+                self._mesh,
+                df.schema,
+            )
+            if df.has_metadata:
+                res.reset_metadata(df.metadata)
+            return res
+        from fugue_tpu.collections.yielded import Yielded
+
+        if isinstance(df, Yielded):
+            return self.load_yielded(df)  # type: ignore
+        local = self._native.to_df(df, schema)
+        return JaxDataFrame.from_table(
+            local.as_arrow(type_safe=True), self._mesh, local.schema
+        )
+
+    # ---- device-lowered column algebra ----------------------------------
+    def select(
+        self,
+        df: DataFrame,
+        cols: SelectColumns,
+        where: Optional[ColumnExpr] = None,
+        having: Optional[ColumnExpr] = None,
+    ) -> DataFrame:
+        jdf = self.to_df(df)
+        resolved = cols.replace_wildcard(jdf.schema).assert_all_with_names()
+        if self._can_select_on_device(jdf, resolved, where, having):
+            out_schema = resolved.infer_schema(jdf.schema)
+            filtered = jdf if where is None else self.filter(jdf, where)
+            if not resolved.has_agg:
+                return self._device_project(filtered, resolved, out_schema)  # type: ignore
+            res = self._device_groupby_select(
+                filtered, resolved, out_schema, having  # type: ignore
+            )
+            if res is not None:
+                return res
+        # fallback gets the ORIGINAL frame + where (avoid double filtering)
+        return self.to_df(
+            self._native.select(jdf.as_local_bounded(), cols, where, having)
+        )
+
+    def filter(self, df: DataFrame, condition: ColumnExpr) -> DataFrame:
+        jdf: JaxDataFrame = self.to_df(df)  # type: ignore
+        if expr_eval.can_eval_on_device(condition, jdf.blocks):
+            masked_cols = expr_eval.blocks_to_masked(jdf.blocks)
+            pad_n = jdf.blocks.padded_nrows
+            value, mask = expr_eval.eval_expr(
+                masked_cols, condition, pad_n
+            )
+            keep = value.astype(jnp.bool_)
+            if mask is not None:
+                keep = keep & mask
+            keep = keep & groupby.row_validity(jdf.blocks)
+            idx = jnp.nonzero(keep)[0]
+            return JaxDataFrame(
+                gather_indices(jdf.blocks, idx, jdf.schema), jdf.schema
+            )
+        return self.to_df(self._native.filter(jdf.as_local_bounded(), condition))
+
+    def assign(self, df: DataFrame, columns: List[ColumnExpr]) -> DataFrame:
+        jdf: JaxDataFrame = self.to_df(df)  # type: ignore
+        if all(
+            expr_eval.can_eval_on_device(c, jdf.blocks) for c in columns
+        ):
+            masked_cols = expr_eval.blocks_to_masked(jdf.blocks)
+            pad_n = jdf.blocks.padded_nrows
+            schema = jdf.schema
+            new_cols = dict(jdf.blocks.columns)
+            sharding = row_sharding(jdf.blocks.mesh)
+            for c in columns:
+                name = c.output_name
+                tp = c.infer_type(schema) or (
+                    schema[name].type if name in schema else None
+                )
+                assert_or_throw(tp is not None, ValueError(f"can't infer {c}"))
+                v, m = expr_eval.eval_expr(masked_cols, c, pad_n)
+                new_cols[name] = JaxColumn(
+                    tp,
+                    jax.device_put(v, sharding),
+                    None if m is None else jax.device_put(m, sharding),
+                )
+                if name in schema:
+                    schema = schema.alter(Schema([(name, tp)]))
+                else:
+                    schema = schema + Schema([(name, tp)])
+            return JaxDataFrame(
+                JaxBlocks(jdf.blocks.nrows, new_cols, jdf.blocks.mesh), schema
+            )
+        return self.to_df(self._native.assign(jdf.as_local_bounded(), columns))
+
+    def aggregate(
+        self,
+        df: DataFrame,
+        partition_spec: Optional[PartitionSpec],
+        agg_cols: List[ColumnExpr],
+    ) -> DataFrame:
+        jdf: JaxDataFrame = self.to_df(df)  # type: ignore
+        keys = partition_spec.partition_by if partition_spec is not None else []
+        res = self._try_device_aggregate(jdf, keys, agg_cols)
+        if res is not None:
+            return res
+        return self.to_df(
+            self._native.aggregate(
+                jdf.as_local_bounded(), partition_spec, agg_cols
+            )
+        )
+
+    # ---- device implementations of engine primitives --------------------
+    def repartition(self, df: DataFrame, partition_spec: PartitionSpec) -> DataFrame:
+        return self.to_df(df)  # sharding is fixed by the mesh
+
+    def broadcast(self, df: DataFrame) -> DataFrame:
+        return self.to_df(df)
+
+    def persist(self, df: DataFrame, lazy: bool = False, **kwargs: Any) -> DataFrame:
+        jdf: JaxDataFrame = self.to_df(df)  # type: ignore
+        if not lazy:
+            for col in jdf.blocks.columns.values():
+                if col.on_device:
+                    col.data.block_until_ready()
+        return jdf
+
+    def join(
+        self,
+        df1: DataFrame,
+        df2: DataFrame,
+        how: str,
+        on: Optional[List[str]] = None,
+    ) -> DataFrame:
+        return self._host_op(
+            lambda a, b: self._native.join(a, b, how=how, on=on), df1, df2
+        )
+
+    def union(self, df1: DataFrame, df2: DataFrame, distinct: bool = True) -> DataFrame:
+        return self._host_op(
+            lambda a, b: self._native.union(a, b, distinct=distinct), df1, df2
+        )
+
+    def subtract(
+        self, df1: DataFrame, df2: DataFrame, distinct: bool = True
+    ) -> DataFrame:
+        return self._host_op(
+            lambda a, b: self._native.subtract(a, b, distinct=distinct), df1, df2
+        )
+
+    def intersect(
+        self, df1: DataFrame, df2: DataFrame, distinct: bool = True
+    ) -> DataFrame:
+        return self._host_op(
+            lambda a, b: self._native.intersect(a, b, distinct=distinct), df1, df2
+        )
+
+    def distinct(self, df: DataFrame) -> DataFrame:
+        jdf: JaxDataFrame = self.to_df(df)  # type: ignore
+        blocks = jdf.blocks
+        if blocks.all_on_device and blocks.nrows > 0:
+            seg, first_idx, num = groupby.factorize_keys(
+                blocks, jdf.schema.names
+            )
+            return JaxDataFrame(
+                gather_indices(blocks, first_idx, jdf.schema), jdf.schema
+            )
+        return self.to_df(self._native.distinct(jdf.as_local_bounded()))
+
+    def dropna(
+        self,
+        df: DataFrame,
+        how: str = "any",
+        thresh: Optional[int] = None,
+        subset: Optional[List[str]] = None,
+    ) -> DataFrame:
+        jdf: JaxDataFrame = self.to_df(df)  # type: ignore
+        blocks = jdf.blocks
+        names = subset if subset is not None else jdf.schema.names
+        if all(blocks.columns[n].on_device for n in names):
+            pad_n = blocks.padded_nrows
+            valid_count = jnp.zeros((pad_n,), dtype=jnp.int32)
+            for n in names:
+                col = blocks.columns[n]
+                v = (
+                    jnp.ones((pad_n,), dtype=jnp.int32)
+                    if col.mask is None
+                    else col.mask.astype(jnp.int32)
+                )
+                valid_count = valid_count + v
+            if thresh is not None:
+                keep = valid_count >= thresh
+            elif how == "any":
+                keep = valid_count == len(names)
+            else:  # all
+                keep = valid_count > 0
+            keep = keep & groupby.row_validity(blocks)
+            idx = jnp.nonzero(keep)[0]
+            return JaxDataFrame(
+                gather_indices(blocks, idx, jdf.schema), jdf.schema
+            )
+        return self.to_df(
+            self._native.dropna(
+                jdf.as_local_bounded(), how=how, thresh=thresh, subset=subset
+            )
+        )
+
+    def fillna(
+        self, df: DataFrame, value: Any, subset: Optional[List[str]] = None
+    ) -> DataFrame:
+        jdf: JaxDataFrame = self.to_df(df)  # type: ignore
+        return self.to_df(
+            self._native.fillna(jdf.as_local_bounded(), value=value, subset=subset)
+        )
+
+    def sample(
+        self,
+        df: DataFrame,
+        n: Optional[int] = None,
+        frac: Optional[float] = None,
+        replace: bool = False,
+        seed: Optional[int] = None,
+    ) -> DataFrame:
+        assert_or_throw(
+            (n is None) != (frac is None),
+            ValueError("one and only one of n and frac must be set"),
+        )
+        jdf: JaxDataFrame = self.to_df(df)  # type: ignore
+        total = jdf.blocks.nrows
+        rng = np.random.default_rng(seed)
+        count = n if n is not None else int(round(total * frac))  # type: ignore
+        count = min(count, total) if not replace else count
+        idx = rng.choice(total, size=count, replace=replace)
+        return JaxDataFrame(
+            gather_indices(jdf.blocks, jnp.asarray(np.sort(idx)), jdf.schema),
+            jdf.schema,
+        )
+
+    def take(
+        self,
+        df: DataFrame,
+        n: int,
+        presort: str,
+        na_position: str = "last",
+        partition_spec: Optional[PartitionSpec] = None,
+    ) -> DataFrame:
+        jdf: JaxDataFrame = self.to_df(df)  # type: ignore
+        return self.to_df(
+            self._native.take(
+                jdf.as_local_bounded(), n, presort, na_position, partition_spec
+            )
+        )
+
+    def load_df(
+        self,
+        path: Union[str, List[str]],
+        format_hint: Any = None,
+        columns: Any = None,
+        **kwargs: Any,
+    ) -> DataFrame:
+        local = self._native.load_df(path, format_hint, columns, **kwargs)
+        return self.to_df(local)
+
+    def save_df(
+        self,
+        df: DataFrame,
+        path: str,
+        format_hint: Any = None,
+        mode: str = "overwrite",
+        partition_spec: Optional[PartitionSpec] = None,
+        force_single: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        jdf: JaxDataFrame = self.to_df(df)  # type: ignore
+        self._native.save_df(
+            jdf.as_local_bounded(), path, format_hint, mode, partition_spec,
+            force_single, **kwargs,
+        )
+
+    def convert_yield_dataframe(self, df: DataFrame, as_local: bool) -> DataFrame:
+        return df.as_local() if as_local else df
+
+    # ---- helpers ---------------------------------------------------------
+    def _host_op(self, func: Callable, *dfs: DataFrame) -> DataFrame:
+        locals_ = [self.to_df(d).as_local_bounded() for d in dfs]
+        return self.to_df(func(*locals_))
+
+    def _can_select_on_device(
+        self,
+        jdf: JaxDataFrame,
+        cols: SelectColumns,
+        where: Optional[ColumnExpr],
+        having: Optional[ColumnExpr],
+    ) -> bool:
+        if having is not None:
+            return False  # having rewrite handled on host for now
+        if cols.is_distinct:
+            return False
+        blocks = jdf.blocks
+        if where is not None and not expr_eval.can_eval_on_device(where, blocks):
+            return False
+        if not cols.has_agg:
+            return all(
+                expr_eval.can_eval_on_device(c, blocks) for c in cols.all_cols
+            )
+        # aggregation: group keys must be simple device columns (string keys
+        # allowed: they group by dictionary code)
+        for k in cols.group_keys:
+            if not isinstance(k, _NamedColumnExpr) or k.as_type is not None:
+                return False
+            col = blocks.columns.get(k.name)
+            if col is None or not col.on_device:
+                return False
+        from fugue_tpu.column.expressions import _FuncExpr
+
+        for a in cols.agg_funcs:
+            if not isinstance(a, _FuncExpr) or len(a.args) != 1:
+                return False
+            if a.arg_distinct:
+                return False
+            if a.func.lower() not in (
+                "min", "max", "sum", "avg", "mean", "count", "first", "last"
+            ):
+                return False
+            arg = a.args[0]
+            if isinstance(arg, _NamedColumnExpr) and arg.wildcard:
+                continue
+            if not expr_eval.can_eval_on_device(arg, blocks):
+                return False
+        return True
+
+    def _device_project(
+        self, jdf: JaxDataFrame, cols: SelectColumns, out_schema: Schema
+    ) -> DataFrame:
+        masked_cols = expr_eval.blocks_to_masked(jdf.blocks)
+        pad_n = jdf.blocks.padded_nrows
+        sharding = row_sharding(jdf.blocks.mesh)
+        new_cols: Dict[str, JaxColumn] = {}
+        for c, f in zip(cols.all_cols, out_schema.fields):
+            v, m = expr_eval.eval_expr(masked_cols, c, pad_n)
+            new_cols[f.name] = JaxColumn(
+                f.type,
+                jax.device_put(v, sharding),
+                None if m is None else jax.device_put(m, sharding),
+            )
+        return JaxDataFrame(
+            JaxBlocks(jdf.blocks.nrows, new_cols, jdf.blocks.mesh), out_schema
+        )
+
+    def _device_groupby_select(
+        self,
+        jdf: JaxDataFrame,
+        cols: SelectColumns,
+        out_schema: Schema,
+        having: Optional[ColumnExpr],
+    ) -> Optional[DataFrame]:
+        keys = [k.name for k in cols.group_keys]  # type: ignore
+        aggs = [(c.output_name, c) for c in cols.agg_funcs]
+        res = self._try_device_aggregate(
+            jdf, keys, [c for _, c in aggs], out_schema=out_schema,
+            col_order=[c.output_name for c in cols.all_cols],
+        )
+        return res
+
+    def _try_device_aggregate(
+        self,
+        jdf: JaxDataFrame,
+        keys: List[str],
+        agg_cols: List[ColumnExpr],
+        out_schema: Optional[Schema] = None,
+        col_order: Optional[List[str]] = None,
+    ) -> Optional[DataFrame]:
+        from fugue_tpu.column.expressions import _FuncExpr
+
+        blocks = jdf.blocks
+        for k in keys:
+            col = blocks.columns.get(k)
+            if col is None or not col.on_device:
+                return None
+        plans = []
+        for c in agg_cols:
+            if not isinstance(c, _FuncExpr) or len(c.args) != 1 or c.arg_distinct:
+                return None
+            if c.func.lower() not in (
+                "min", "max", "sum", "avg", "mean", "count", "first", "last"
+            ):
+                return None
+            arg = c.args[0]
+            if isinstance(arg, _NamedColumnExpr) and arg.wildcard:
+                plans.append((c.output_name, "count", None, c))
+                continue
+            if not expr_eval.can_eval_on_device(arg, blocks):
+                return None
+            plans.append((c.output_name, c.func.lower(), arg, c))
+        if blocks.nrows == 0:
+            # empty input: host path handles schema/empty conventions
+            return None
+        pad_n = blocks.padded_nrows
+        valid_rows = groupby.row_validity(blocks)
+        masked_cols = expr_eval.blocks_to_masked(blocks)
+        if len(keys) > 0:
+            seg, first_idx, num = groupby.factorize_keys(blocks, keys)
+        else:
+            seg = jnp.zeros((pad_n,), dtype=jnp.int64)
+            first_idx = jnp.zeros((1,), dtype=jnp.int64)
+            num = 1
+        sharding = row_sharding(blocks.mesh)
+        out_cols: Dict[str, JaxColumn] = {}
+        # key columns from representative rows
+        key_blocks = gather_indices(blocks, first_idx, jdf.schema.extract(keys))
+        for k in keys:
+            out_cols[k] = key_blocks.columns[k]
+        schema_fields = [jdf.schema[k] for k in keys]
+        for name, func, arg, expr in plans:
+            if func == "count" and arg is None:
+                values: Any = jnp.ones((pad_n,), dtype=jnp.int64)
+                mask: Any = None
+            else:
+                values, mask = expr_eval.eval_expr(masked_cols, arg, pad_n)
+            v, m = groupby.segment_agg(
+                func, values, mask, seg, num, valid_rows
+            )
+            tp = expr.infer_type(jdf.schema)
+            if tp is None:
+                return None
+            # sum of ints stays int; avg float; cast result accordingly
+            v = _cast_agg_result(v, tp)
+            out_pad = padded_len(num, blocks.mesh.devices.size)
+            v = jnp.concatenate(
+                [v, jnp.zeros((out_pad - num,), dtype=v.dtype)]
+            ) if out_pad != num else v
+            if m is not None:
+                m = jnp.concatenate(
+                    [m, jnp.zeros((out_pad - num,), dtype=jnp.bool_)]
+                ) if out_pad != num else m
+            out_cols[name] = JaxColumn(
+                tp,
+                jax.device_put(v, sharding),
+                None if m is None else jax.device_put(m, sharding),
+            )
+            schema_fields.append(pa.field(name, tp))
+        # key columns also need re-padding to out_pad
+        out_pad = padded_len(num, blocks.mesh.devices.size)
+        for k in keys:
+            col = out_cols[k]
+            if col.data.shape[0] != out_pad:
+                data = jnp.concatenate(
+                    [col.data, jnp.zeros((out_pad - num,), dtype=col.data.dtype)]
+                )
+                mask2 = col.mask
+                if mask2 is not None:
+                    mask2 = jnp.concatenate(
+                        [mask2, jnp.zeros((out_pad - num,), dtype=jnp.bool_)]
+                    )
+                out_cols[k] = JaxColumn(
+                    col.pa_type,
+                    jax.device_put(data, sharding),
+                    None if mask2 is None else jax.device_put(mask2, sharding),
+                    col.dictionary,
+                )
+        schema = Schema(schema_fields)
+        if col_order is not None:
+            schema = schema.extract(col_order)
+            out_cols = {n: out_cols[n] for n in col_order}
+        return JaxDataFrame(
+            JaxBlocks(num, out_cols, blocks.mesh), schema
+        )
+
+
+def _pad_to(v: jnp.ndarray, target: int) -> jnp.ndarray:
+    n = int(v.shape[0])
+    if n == target:
+        return v
+    return jnp.concatenate([v, jnp.zeros((target - n,), dtype=v.dtype)])
+
+
+def _cast_agg_result(v: jnp.ndarray, tp: pa.DataType) -> jnp.ndarray:
+    target = tp.to_pandas_dtype()
+    try:
+        return v.astype(target)
+    except Exception:  # pragma: no cover
+        return v

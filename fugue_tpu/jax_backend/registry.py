@@ -1,0 +1,87 @@
+"""Register the jax backend (pattern parity: fugue_spark/registry.py:26-131):
+engine names, inference from JaxDataFrame inputs, and the jax-annotated
+transformer param that unlocks the compiled whole-shard map path."""
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fugue_tpu.dataframe.function_wrapper import (
+    AnnotatedParam,
+    fugue_annotated_param,
+)
+from fugue_tpu.dataframe.dataframe import as_fugue_df
+from fugue_tpu.execution.factory import (
+    infer_execution_engine,
+    register_execution_engine,
+)
+from fugue_tpu.jax_backend.dataframe import JaxDataFrame
+from fugue_tpu.jax_backend.execution_engine import JaxExecutionEngine
+from fugue_tpu.schema import Schema
+
+
+@fugue_annotated_param(Dict[str, jax.Array])
+class JaxArraysParam(AnnotatedParam):
+    """Transformer param ``Dict[str, jax.Array]``: on JaxExecutionEngine the
+    function runs compiled over whole mesh-sharded columns (with
+    ``_segment_ids``/``_num_segments`` when partitioned); on host engines it
+    receives the partition's columns as jax arrays."""
+
+    code = "j"
+    format_hint = "jax"
+
+    def to_input(self, df: Any, ctx: Dict[str, Any]) -> Any:
+        # contract: jax transformers see NUMERIC/bool columns (strings and
+        # nested types don't exist on device; use a pandas transformer there)
+        pdf = df.as_pandas()
+        res: Dict[str, Any] = {}
+        for c in pdf.columns:
+            np_col = pdf[c].to_numpy()
+            if np_col.dtype.kind in "biuf":
+                res[str(c)] = jnp.asarray(np_col)
+        res["_nrows"] = len(pdf)
+        return res
+
+    def to_output_df(self, output: Any, schema: Schema, ctx: Dict[str, Any]) -> Any:
+        import pandas as pd
+
+        from fugue_tpu.dataframe import PandasDataFrame
+
+        n = int(output.get("_nrows", -1))
+        data = {}
+        for f in schema.fields:
+            arr = np.asarray(output[f.name])
+            data[f.name] = arr if n < 0 else arr[:n]
+        return PandasDataFrame(pd.DataFrame(data), schema)
+
+
+def _register() -> None:
+    register_execution_engine(
+        "jax", lambda conf, **kwargs: JaxExecutionEngine(conf, **kwargs)
+    )
+    register_execution_engine(
+        "tpu", lambda conf, **kwargs: JaxExecutionEngine(conf, **kwargs)
+    )
+
+    @infer_execution_engine.candidate(
+        lambda objs: any(isinstance(o, JaxDataFrame) for o in objs)
+    )
+    def _infer_jax(objs: List[Any]) -> Any:
+        return "jax"
+
+    @as_fugue_df.candidate(lambda df, **kw: isinstance(df, JaxDataFrame))
+    def _jax_as_fugue(df: JaxDataFrame, **kwargs: Any) -> JaxDataFrame:
+        return df
+
+    from fugue_tpu.dataframe.api import get_native_as_df
+
+    @get_native_as_df.candidate(lambda df: isinstance(df, JaxDataFrame))
+    def _jax_native(df: JaxDataFrame) -> JaxDataFrame:
+        # the backend IS jax: JaxDataFrame is its native frame (unlike spark
+        # where .native unwraps to a third-party object)
+        return df
+
+
+_register()

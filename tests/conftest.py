@@ -1,8 +1,17 @@
 import os
 
-# Force JAX onto a virtual 8-device CPU mesh for all tests: multi-chip sharding
-# is validated without TPU hardware (the driver separately dry-runs the
-# multichip path; see __graft_entry__.py).
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+# Force JAX onto a virtual 8-device CPU mesh for all tests: multi-chip
+# sharding is validated without TPU hardware (the driver separately dry-runs
+# the multichip path; see __graft_entry__.py).
+#
+# NOTE: in this environment jax is PRELOADED at interpreter startup (axon
+# site hook), so env vars alone are too late — use config.update before the
+# first backend initialization.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,32 @@
+"""The acceptance gate (SURVEY §4 implication): the full engine + workflow
+conformance suites against JaxExecutionEngine on a virtual 8-device CPU mesh
+— exactly how the reference validates every new backend."""
+
+from typing import Any
+
+from fugue_tpu.execution import ExecutionEngine
+from fugue_tpu.jax_backend import JaxDataFrame, JaxExecutionEngine
+from fugue_tpu_test.builtin_suite import BuiltInTests
+from fugue_tpu_test.dataframe_suite import DataFrameTests
+from fugue_tpu_test.execution_suite import ExecutionEngineTests
+
+
+class TestJaxExecutionEngine(ExecutionEngineTests.Tests):
+    def make_engine(self) -> ExecutionEngine:
+        return JaxExecutionEngine(dict(test=True))
+
+
+class TestJaxBuiltIn(BuiltInTests.Tests):
+    def make_engine(self) -> ExecutionEngine:
+        return JaxExecutionEngine(dict(test=True))
+
+
+class TestJaxDataFrame(DataFrameTests.Tests):
+    @classmethod
+    def setup_class(cls):
+        cls._engine = JaxExecutionEngine()
+
+    def df(self, data: Any = None, schema: Any = None) -> JaxDataFrame:
+        from fugue_tpu.dataframe import ArrayDataFrame
+
+        return self._engine.to_df(ArrayDataFrame(data, schema))
