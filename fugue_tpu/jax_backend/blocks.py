@@ -100,6 +100,8 @@ class JaxBlocks:
         self.nrows = nrows
         self.columns = columns
         self.mesh = mesh
+        # per-frame cache of key factorizations: (keys...) -> (seg, first, num)
+        self.factorize_cache: Dict[Any, Any] = {}
 
     @property
     def all_on_device(self) -> bool:

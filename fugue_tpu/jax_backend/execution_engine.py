@@ -149,20 +149,39 @@ class JaxMapEngine(MapEngine):
         if on_init is not None:
             on_init(0, df)
         arrs: Dict[str, Any] = {}
-        row_valid = groupby.row_validity(blocks)
         keys = [k for k in spec.partition_by]
+        num = -1
         if len(keys) > 0:
             seg, _, num = groupby.factorize_keys(blocks, keys)
-            # padding rows -> out-of-range segment: dropped by segment ops
-            arrs["_segment_ids"] = jnp.where(row_valid, seg, num)
-            arrs["_num_segments"] = num
+            arrs["_raw_seg"] = seg
         for name, col in blocks.columns.items():
             arrs[name] = col.data
             if col.mask is not None:
                 arrs[f"_{name}_mask"] = col.mask
-        arrs["_nrows"] = blocks.nrows
-        arrs["_row_valid"] = row_valid
-        out = fn(dict(arrs))
+        # ONE jitted dispatch: scalars are closed over (static under trace);
+        # eager per-op dispatch would round-trip a tunneled TPU per op
+        nrows = blocks.nrows
+        pad_n = blocks.padded_nrows
+        array_args = {k: v for k, v in arrs.items() if hasattr(v, "shape")}
+        scalar_args = {k: v for k, v in arrs.items() if not hasattr(v, "shape")}
+
+        def _wrapped(aa: Dict[str, Any]) -> Any:
+            full = {**aa, **scalar_args}
+            row_valid = jnp.arange(pad_n) < nrows
+            full["_row_valid"] = row_valid
+            full["_nrows"] = nrows
+            if num >= 0:
+                # padding rows -> out-of-range segment: dropped by segment ops
+                full["_segment_ids"] = jnp.where(
+                    row_valid, full.pop("_raw_seg"), num
+                )
+                full["_num_segments"] = num
+            return fn(full)
+
+        out = engine._jit_cached(
+            ("map", id(fn), nrows, pad_n, num,
+             tuple(sorted(scalar_args.items()))), _wrapped
+        )(array_args)
         assert_or_throw(
             isinstance(out, dict),
             ValueError("jax transformer must return a dict of arrays"),
@@ -619,6 +638,18 @@ class JaxExecutionEngine(ExecutionEngine):
         )
         return res
 
+    def _jit_cached(self, key: Any, fn: Callable) -> Callable:
+        """Per-engine jit cache: logical programs (aggregate plans, map fns,
+        filters) are keyed by structure so repeated queries reuse the
+        compiled executable."""
+        cache = getattr(self, "_jit_cache", None)
+        if cache is None:
+            cache = {}
+            self._jit_cache = cache
+        if key not in cache:
+            cache[key] = jax.jit(fn)
+        return cache[key]
+
     def _try_device_aggregate(
         self,
         jdf: JaxDataFrame,
@@ -653,7 +684,7 @@ class JaxExecutionEngine(ExecutionEngine):
             # empty input: host path handles schema/empty conventions
             return None
         pad_n = blocks.padded_nrows
-        valid_rows = groupby.row_validity(blocks)
+        nrows = blocks.nrows
         masked_cols = expr_eval.blocks_to_masked(blocks)
         if len(keys) > 0:
             seg, first_idx, num = groupby.factorize_keys(blocks, keys)
@@ -661,60 +692,82 @@ class JaxExecutionEngine(ExecutionEngine):
             seg = jnp.zeros((pad_n,), dtype=jnp.int64)
             first_idx = jnp.zeros((1,), dtype=jnp.int64)
             num = 1
-        sharding = row_sharding(blocks.mesh)
-        out_cols: Dict[str, JaxColumn] = {}
-        # key columns from representative rows
-        key_blocks = gather_indices(blocks, first_idx, jdf.schema.extract(keys))
-        for k in keys:
-            out_cols[k] = key_blocks.columns[k]
-        schema_fields = [jdf.schema[k] for k in keys]
+        # resolve output types up front (needed inside the traced program)
+        typed_plans = []
         for name, func, arg, expr in plans:
-            if func == "count" and arg is None:
-                values: Any = jnp.ones((pad_n,), dtype=jnp.int64)
-                mask: Any = None
-            else:
-                values, mask = expr_eval.eval_expr(masked_cols, arg, pad_n)
-            v, m = groupby.segment_agg(
-                func, values, mask, seg, num, valid_rows
-            )
             tp = expr.infer_type(jdf.schema)
             if tp is None:
                 return None
-            # sum of ints stays int; avg float; cast result accordingly
-            v = _cast_agg_result(v, tp)
-            out_pad = padded_len(num, blocks.mesh.devices.size)
-            v = jnp.concatenate(
-                [v, jnp.zeros((out_pad - num,), dtype=v.dtype)]
-            ) if out_pad != num else v
-            if m is not None:
-                m = jnp.concatenate(
-                    [m, jnp.zeros((out_pad - num,), dtype=jnp.bool_)]
-                ) if out_pad != num else m
+            typed_plans.append((name, func, arg, tp))
+        out_pad = padded_len(num, int(blocks.mesh.devices.size))
+        sharding = row_sharding(blocks.mesh)
+
+        # ONE fused program: every agg + key gather + padding, single dispatch
+        def _agg_program(
+            mcols: Dict[str, Any],
+            key_data: Dict[str, Any],
+            key_masks: Dict[str, Any],
+            seg_: Any,
+            first_idx_: Any,
+        ) -> Dict[str, Any]:
+            valid_ = jnp.arange(pad_n, dtype=jnp.int32) < nrows
+            outs: Dict[str, Any] = {}
+            for k in keys:
+                kd = key_data[k][first_idx_]
+                km = key_masks.get(k)
+                outs[f"k:{k}"] = _pad_to(kd, out_pad)
+                if km is not None:
+                    outs[f"km:{k}"] = _pad_to(km[first_idx_], out_pad)
+            for name, func, arg, tp in typed_plans:
+                if func == "count" and arg is None:
+                    values: Any = jnp.ones((pad_n,), dtype=jnp.int32)
+                    mask: Any = None
+                else:
+                    values, mask = expr_eval.eval_expr(mcols, arg, pad_n)
+                v, m = groupby._segment_agg_impl(
+                    func, values, mask, seg_, num, valid_
+                )
+                outs[f"a:{name}"] = _pad_to(_cast_agg_result(v, tp), out_pad)
+                if m is not None:
+                    outs[f"am:{name}"] = _pad_to(m, out_pad)
+            return outs
+
+        prog_key = (
+            "agg",
+            tuple((n, f, None if a is None else a.__uuid__(), str(t))
+                  for n, f, a, t in typed_plans),
+            tuple(keys), num, out_pad, pad_n, nrows,
+        )
+        key_data = {k: blocks.columns[k].data for k in keys}
+        key_masks = {
+            k: blocks.columns[k].mask
+            for k in keys
+            if blocks.columns[k].mask is not None
+        }
+        outs = self._jit_cached(prog_key, _agg_program)(
+            masked_cols, key_data, key_masks, seg, first_idx
+        )
+        out_cols: Dict[str, JaxColumn] = {}
+        schema_fields = [jdf.schema[k] for k in keys]
+        for k in keys:
+            src_col = blocks.columns[k]
+            out_cols[k] = JaxColumn(
+                src_col.pa_type,
+                jax.device_put(outs[f"k:{k}"], sharding),
+                None if f"km:{k}" not in outs else jax.device_put(
+                    outs[f"km:{k}"], sharding
+                ),
+                src_col.dictionary,
+            )
+        for name, func, arg, tp in typed_plans:
             out_cols[name] = JaxColumn(
                 tp,
-                jax.device_put(v, sharding),
-                None if m is None else jax.device_put(m, sharding),
+                jax.device_put(outs[f"a:{name}"], sharding),
+                None if f"am:{name}" not in outs else jax.device_put(
+                    outs[f"am:{name}"], sharding
+                ),
             )
             schema_fields.append(pa.field(name, tp))
-        # key columns also need re-padding to out_pad
-        out_pad = padded_len(num, blocks.mesh.devices.size)
-        for k in keys:
-            col = out_cols[k]
-            if col.data.shape[0] != out_pad:
-                data = jnp.concatenate(
-                    [col.data, jnp.zeros((out_pad - num,), dtype=col.data.dtype)]
-                )
-                mask2 = col.mask
-                if mask2 is not None:
-                    mask2 = jnp.concatenate(
-                        [mask2, jnp.zeros((out_pad - num,), dtype=jnp.bool_)]
-                    )
-                out_cols[k] = JaxColumn(
-                    col.pa_type,
-                    jax.device_put(data, sharding),
-                    None if mask2 is None else jax.device_put(mask2, sharding),
-                    col.dictionary,
-                )
         schema = Schema(schema_fields)
         if col_order is not None:
             schema = schema.extract(col_order)
