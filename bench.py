@@ -5,8 +5,8 @@ jax (device) vs NativeExecutionEngine (pandas). Prints ONE json line:
 ``{"metric":..., "value":..., "unit":..., "vs_baseline":...}`` where value is
 the jax engine's rows/sec and vs_baseline its speedup over native.
 
-Env knobs: BENCH_ROWS (default 20_000_000 device / capped 4_000_000 native),
-BENCH_GROUPS (default 1024).
+Env knobs: BENCH_ROWS (default 100_000_000 per BASELINE.md north star /
+capped 4_000_000 native, scaled to rows/sec), BENCH_GROUPS (default 1024).
 """
 
 import json
@@ -17,10 +17,6 @@ from typing import Any, Dict
 
 def _bench() -> Dict[str, Any]:
     import jax
-
-    if all(d.platform == "cpu" for d in jax.devices()):
-        # virtual multi-device CPU for local runs
-        pass
     import jax.numpy as jnp
     import numpy as np
     import pandas as pd
@@ -31,7 +27,7 @@ def _bench() -> Dict[str, Any]:
     from fugue_tpu.execution import make_execution_engine
     from fugue_tpu.execution.api import aggregate
 
-    n_rows = int(os.environ.get("BENCH_ROWS", 20_000_000))
+    n_rows = int(os.environ.get("BENCH_ROWS", 100_000_000))
     n_groups = int(os.environ.get("BENCH_GROUPS", 1024))
     n_native = min(n_rows, int(os.environ.get("BENCH_NATIVE_ROWS", 4_000_000)))
 
@@ -78,13 +74,18 @@ def _bench() -> Dict[str, Any]:
             s=ff.sum(col("v2")), m=ff.avg(col("v2")), c=ff.count(col("v2")),
             engine=engine, as_fugue=True,
         )
-        for c in agg.native.columns.values():  # type: ignore
-            if c.on_device:
-                c.data.block_until_ready()
+        # materialize the (small) result to host — the honest endpoint,
+        # same as the native path's as_local(); block_until_ready alone is
+        # not trustworthy on relayed TPU backends. One async wave.
+        arrs = [c.data for c in agg.native.columns.values() if c.on_device]
+        if agg.native.row_valid is not None:  # type: ignore
+            arrs.append(agg.native.row_valid)  # type: ignore
+        jax.device_get(arrs)
         return time.perf_counter() - t0
 
     cold_secs = run_once()  # includes jit compilation at full shapes
-    jax_secs = run_once()  # steady state (compiled programs cached)
+    warm = sorted(run_once() for _ in range(5))
+    jax_secs = warm[len(warm) // 2]  # median steady state
     jax_rps = n_rows / jax_secs
 
     return {

@@ -1,12 +1,8 @@
-import os
-
-import jax as _jax
-
-# Data fidelity requires 64-bit dtypes (long columns, timestamp microseconds):
-# without x64, device_put silently truncates int64 -> int32. Opt out only if
-# you know every column fits 32 bits (e.g. pure-float32 TPU pipelines).
-if os.environ.get("FUGUE_TPU_DISABLE_X64", "").lower() not in ("1", "true"):
-    _jax.config.update("jax_enable_x64", True)
+"""TPU-native backend package. 64-bit dtype support (required for long/
+timestamp column fidelity) is enabled by :func:`blocks.ensure_x64` when an
+engine, mesh, or ingest path is first used — NOT as an import side effect,
+so importing this package never mutates global jax config for unrelated
+code."""
 
 from fugue_tpu.jax_backend.dataframe import JaxDataFrame
 from fugue_tpu.jax_backend.execution_engine import (

@@ -100,9 +100,11 @@ class JaxDataFrame(DataFrame):
 
     def _select_schema(self, schema: Schema) -> "JaxDataFrame":
         blocks = JaxBlocks(
-            self._blocks.nrows,
+            self._blocks._nrows,
             {n: self._blocks.columns[n] for n in schema.names},
             self._blocks.mesh,
+            row_valid=self._blocks.row_valid,
+            nrows_dev=self._blocks._nrows_dev,
         )
         return JaxDataFrame(blocks, schema)
 
@@ -112,7 +114,14 @@ class JaxDataFrame(DataFrame):
             columns.get(n, n): c for n, c in self._blocks.columns.items()
         }
         return JaxDataFrame(
-            JaxBlocks(self._blocks.nrows, cols, self._blocks.mesh), schema
+            JaxBlocks(
+                self._blocks._nrows,
+                cols,
+                self._blocks.mesh,
+                row_valid=self._blocks.row_valid,
+                nrows_dev=self._blocks._nrows_dev,
+            ),
+            schema,
         )
 
     def alter_columns(self, columns: Any) -> DataFrame:
@@ -129,9 +138,19 @@ class JaxDataFrame(DataFrame):
         assert_or_throw(n >= 0, ValueError("n must be >= 0"))
         schema = self.schema if columns is None else self.schema.extract(columns)
         src = self if columns is None else self[columns]
-        take_n = min(n, self._blocks.nrows)
+        blocks = src._blocks  # type: ignore
+        if blocks.row_valid is not None:
+            # masked layout: locate the first n valid rows (one mask
+            # readback), gather them on device, export the small frame
+            import numpy as np
+
+            from fugue_tpu.jax_backend.blocks import gather_indices
+
+            idx = np.nonzero(np.asarray(blocks.row_valid))[0][:n]
+            small = gather_indices(blocks, idx, schema)
+            return ArrowDataFrame(to_arrow(small, schema), schema)
+        take_n = min(n, blocks.nrows)
         table = to_arrow(
-            JaxBlocks(take_n, src._blocks.columns, src._blocks.mesh),  # type: ignore
-            schema,
+            JaxBlocks(take_n, blocks.columns, blocks.mesh), schema
         )
         return ArrowDataFrame(table, schema)

@@ -5,17 +5,24 @@ fugue_spark/execution_engine.py:336) — but TPU-first in design:
 
 - dataframes are mesh-sharded device blocks (see blocks.py)
 - select/filter/assign/aggregate lower to jit-compiled masked jnp programs
-  and sort+segment reductions (no shuffle: XLA inserts ICI collectives)
+  and segment reductions (no shuffle: XLA inserts ICI collectives)
 - the map primitive has a compiled path for jax-annotated transformers
   (``Dict[str, jax.Array] -> Dict[str, jax.Array]``, whole-shard vectorized —
   the TPU-idiomatic transformer contract) and a host fallback with exact
   reference semantics for everything else
+- **latency design**: on a network-tunneled TPU every host synchronization
+  costs ~70ms and every eager (non-jit) op ~85ms, so the steady-state
+  pipeline is a chain of cached jitted dispatches with ZERO intermediate
+  readbacks — filter/dropna/distinct flip validity masks instead of
+  gathering, group-by uses host-known key stats for static bin counts, row
+  counts stay lazy device scalars, and the single sync happens at the host
+  boundary (arrow export)
 - relational ops that don't vectorize well yet (joins, set ops) run on the
   host arrow path, then re-device: correctness everywhere, speed where it
   counts; deeper device lowerings land in later rounds
 """
 
-from typing import Any, Callable, Dict, List, Optional, Union
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -46,6 +53,7 @@ from fugue_tpu.jax_backend import expr_eval, groupby
 from fugue_tpu.jax_backend.blocks import (
     JaxBlocks,
     JaxColumn,
+    ensure_x64,
     from_arrow,
     gather_indices,
     make_mesh,
@@ -138,95 +146,131 @@ class JaxMapEngine(MapEngine):
         (padded, mesh-sharded) columns as a dict of jax arrays; XLA fuses and
         auto-partitions; groups never leave the device.
 
-        Rows are padded to the mesh size: ``_row_valid`` marks real rows and
-        ``_nrows`` gives the true count. Groups are NOT contiguous; with
-        partition keys, ``_segment_ids``/``_num_segments`` are provided for
-        ``jax.ops.segment_*`` reductions (the TPU answer to per-group python
-        loops) — padding rows carry segment id ``_num_segments`` so segment
-        ops with ``num_segments=_num_segments`` drop them automatically."""
+        Contract (the TPU transformer ABI):
+
+        - ``_row_valid`` bool[padded]: True = real row (padding AND
+          filtered-out rows are False).
+        - ``_nrows``: the true row count as a TRACED int32 scalar (it is
+          data-dependent under the lazy-count design; use it in arithmetic
+          / ``jnp.where``, not as a static shape).
+        - with partition keys: ``_segment_ids`` int32[padded] (invalid rows
+          carry the out-of-range sentinel ``_num_segments``, so segment ops
+          with ``num_segments=_num_segments`` drop them automatically) and
+          ``_num_segments`` — a STATIC python int segment-id space size
+          (some segments may be empty; fine for segment_* reductions).
+        - output columns the same padded length as the input are row-aligned
+          with it; to change the row count, include ``_nrows`` in the output
+          dict (forces one host sync).
+        """
         engine: "JaxExecutionEngine" = self.execution_engine  # type: ignore
         blocks = df.blocks
         if on_init is not None:
             on_init(0, df)
-        arrs: Dict[str, Any] = {}
-        keys = [k for k in spec.partition_by]
-        num = -1
+        keys = list(spec.partition_by)
+        num_segments = -1
+        seg: Optional[Any] = None
         if len(keys) > 0:
-            seg, _, num = groupby.factorize_keys(blocks, keys)
-            arrs["_raw_seg"] = seg
+            fr = groupby.factorize_keys(blocks, keys)
+            seg = fr.seg
+            num_segments = fr.num_segments
+        array_args: Dict[str, Any] = {}
         for name, col in blocks.columns.items():
-            arrs[name] = col.data
+            array_args[name] = col.data
             if col.mask is not None:
-                arrs[f"_{name}_mask"] = col.mask
-        # ONE jitted dispatch: scalars are closed over (static under trace);
-        # eager per-op dispatch would round-trip a tunneled TPU per op
-        nrows = blocks.nrows
+                array_args[f"_{name}_mask"] = col.mask
+        if seg is not None:
+            array_args["_segment_ids"] = seg
         pad_n = blocks.padded_nrows
-        array_args = {k: v for k, v in arrs.items() if hasattr(v, "shape")}
-        scalar_args = {k: v for k, v in arrs.items() if not hasattr(v, "shape")}
 
-        def _wrapped(aa: Dict[str, Any]) -> Any:
-            full = {**aa, **scalar_args}
-            row_valid = jnp.arange(pad_n) < nrows
+        def _wrapped(
+            aa: Dict[str, Any], row_valid: Optional[Any], nrows_s: Any
+        ) -> Any:
+            full = dict(aa)
+            row_valid = groupby.materialize_validity(row_valid, pad_n, nrows_s)
             full["_row_valid"] = row_valid
-            full["_nrows"] = nrows
-            if num >= 0:
-                # padding rows -> out-of-range segment: dropped by segment ops
-                full["_segment_ids"] = jnp.where(
-                    row_valid, full.pop("_raw_seg"), num
-                )
-                full["_num_segments"] = num
+            full["_nrows"] = nrows_s
+            if num_segments >= 0:
+                full["_num_segments"] = num_segments
             return fn(full)
 
-        out = engine._jit_cached(
-            ("map", id(fn), nrows, pad_n, num,
-             tuple(sorted(scalar_args.items()))), _wrapped
-        )(array_args)
+        jitted, passthrough = engine._map_program(
+            ("map", id(fn), pad_n, num_segments, tuple(sorted(array_args))),
+            _wrapped,
+            array_args,
+            blocks,
+            list(blocks.columns),
+        )
+        out = jitted(
+            array_args, blocks.row_valid, _nrows_arg(blocks)
+        )
         assert_or_throw(
             isinstance(out, dict),
             ValueError("jax transformer must return a dict of arrays"),
         )
         ndev = int(blocks.mesh.devices.size)
         sharding = row_sharding(blocks.mesh)
-        raw: Dict[str, Any] = {}
         first = -1
         for f in output_schema.fields:
             assert_or_throw(
                 f.name in out,
                 ValueError(f"jax transformer output missing column {f.name}"),
             )
-            data = jnp.asarray(out[f.name])
+            data = out[f.name]
             if first < 0:
                 first = int(data.shape[0])
             assert_or_throw(
                 int(data.shape[0]) == first,
                 ValueError("jax transformer output columns differ in length"),
             )
-            raw[f.name] = data
+        row_valid_out: Optional[Any] = None
+        nrows_out: Optional[int] = None
+        nrows_dev_out: Optional[Any] = None
         if "_nrows" in out:
-            out_rows = int(out["_nrows"])
-        elif first == blocks.padded_nrows:
-            out_rows = blocks.nrows  # same shape -> row-aligned output
+            # explicit count -> prefix layout over [0, _nrows). One sync;
+            # only row-count-changing transformers pay it.
+            nrows_out = int(out["_nrows"])
+            target = max(padded_len(nrows_out, ndev), padded_len(first, ndev))
+        elif first == pad_n:
+            # same shape -> row-aligned: inherit the input's membership
+            # (including a pending lazy count) with zero syncs
+            row_valid_out = blocks.row_valid
+            nrows_out = blocks._nrows
+            nrows_dev_out = blocks._nrows_dev
+            target = pad_n
         else:
             raise ValueError(
                 "jax transformer changed the row count "
-                f"({blocks.padded_nrows} -> {first}) without returning "
+                f"({pad_n} -> {first}) without returning "
                 "'_nrows'; include '_nrows' in the output dict"
             )
-        target = padded_len(first, ndev)
         cols: Dict[str, JaxColumn] = {}
         for f in output_schema.fields:
-            data = _pad_to(raw[f.name], target)
+            data = _pad_to(out[f.name], target)
             mask = out.get(f"_{f.name}_mask")
+            src_name = passthrough.get(f.name)
+            stats = dictionary = None
+            if src_name is not None and src_name in blocks.columns:
+                src = blocks.columns[src_name]
+                stats = src.stats
+                dictionary = src.dictionary
             cols[f.name] = JaxColumn(
                 f.type,
                 jax.device_put(data, sharding),
                 None
                 if mask is None
-                else jax.device_put(_pad_to(jnp.asarray(mask), target), sharding),
+                else jax.device_put(_pad_to(mask, target), sharding),
+                dictionary,
+                stats,
             )
         return JaxDataFrame(
-            JaxBlocks(out_rows, cols, blocks.mesh), output_schema
+            JaxBlocks(
+                nrows_out,
+                cols,
+                blocks.mesh,
+                row_valid=row_valid_out,
+                nrows_dev=nrows_dev_out,
+            ),
+            output_schema,
         )
 
 
@@ -247,6 +291,7 @@ class JaxExecutionEngine(ExecutionEngine):
 
     def __init__(self, conf: Any = None, mesh: Any = None):
         super().__init__(conf)
+        ensure_x64()
         self._mesh = mesh if mesh is not None else make_mesh()
         # host sibling used for fallback relational ops
         self._native = NativeExecutionEngine(conf)
@@ -321,52 +366,89 @@ class JaxExecutionEngine(ExecutionEngine):
         )
 
     def filter(self, df: DataFrame, condition: ColumnExpr) -> DataFrame:
+        """Mask-only filter: ONE cached jitted dispatch flips row validity;
+        columns (and their stats) are untouched, the row count becomes a
+        lazy device scalar. No gather, no host sync."""
         jdf: JaxDataFrame = self.to_df(df)  # type: ignore
         if expr_eval.can_eval_on_device(condition, jdf.blocks):
-            masked_cols = expr_eval.blocks_to_masked(jdf.blocks)
-            pad_n = jdf.blocks.padded_nrows
-            value, mask = expr_eval.eval_expr(
-                masked_cols, condition, pad_n
+            blocks = jdf.blocks
+            pad_n = blocks.padded_nrows
+
+            def _filter_prog(
+                mcols: Dict[str, Any], row_valid: Optional[Any], nrows_s: Any
+            ) -> Tuple[Any, Any]:
+                row_valid = groupby.materialize_validity(
+                    row_valid, pad_n, nrows_s
+                )
+                value, mask = expr_eval.eval_expr(mcols, condition, pad_n)
+                keep = value.astype(jnp.bool_)
+                if mask is not None:
+                    keep = keep & mask
+                keep = keep & row_valid
+                return keep, jnp.sum(keep).astype(jnp.int32)
+
+            keep, cnt = self._jit_cached(
+                ("filter", condition.__uuid__(), pad_n), _filter_prog
+            )(
+                expr_eval.blocks_to_masked(blocks),
+                blocks.row_valid,
+                _nrows_arg(blocks),
             )
-            keep = value.astype(jnp.bool_)
-            if mask is not None:
-                keep = keep & mask
-            keep = keep & groupby.row_validity(jdf.blocks)
-            idx = jnp.nonzero(keep)[0]
             return JaxDataFrame(
-                gather_indices(jdf.blocks, idx, jdf.schema), jdf.schema
+                JaxBlocks(
+                    None,
+                    dict(blocks.columns),
+                    blocks.mesh,
+                    row_valid=keep,
+                    nrows_dev=cnt,
+                ),
+                jdf.schema,
             )
         return self.to_df(self._native.filter(jdf.as_local_bounded(), condition))
 
     def assign(self, df: DataFrame, columns: List[ColumnExpr]) -> DataFrame:
         jdf: JaxDataFrame = self.to_df(df)  # type: ignore
-        if all(
-            expr_eval.can_eval_on_device(c, jdf.blocks) for c in columns
-        ):
-            masked_cols = expr_eval.blocks_to_masked(jdf.blocks)
-            pad_n = jdf.blocks.padded_nrows
+        blocks = jdf.blocks
+        if all(expr_eval.can_eval_on_device(c, blocks) for c in columns):
+            pad_n = blocks.padded_nrows
             schema = jdf.schema
-            new_cols = dict(jdf.blocks.columns)
-            sharding = row_sharding(jdf.blocks.mesh)
+            plans: List[Tuple[str, Any, ColumnExpr]] = []
             for c in columns:
                 name = c.output_name
                 tp = c.infer_type(schema) or (
                     schema[name].type if name in schema else None
                 )
                 assert_or_throw(tp is not None, ValueError(f"can't infer {c}"))
-                v, m = expr_eval.eval_expr(masked_cols, c, pad_n)
-                new_cols[name] = JaxColumn(
-                    tp,
-                    jax.device_put(v, sharding),
-                    None if m is None else jax.device_put(m, sharding),
-                )
+                plans.append((name, tp, c))
                 if name in schema:
                     schema = schema.alter(Schema([(name, tp)]))
                 else:
                     schema = schema + Schema([(name, tp)])
-            return JaxDataFrame(
-                JaxBlocks(jdf.blocks.nrows, new_cols, jdf.blocks.mesh), schema
-            )
+
+            def _assign_prog(mcols: Dict[str, Any]) -> Dict[str, Any]:
+                outs: Dict[str, Any] = {}
+                for name, _tp, c in plans:
+                    v, m = expr_eval.eval_expr(mcols, c, pad_n)
+                    outs[f"v:{name}"] = v
+                    if m is not None:
+                        outs[f"m:{name}"] = m
+                return outs
+
+            outs = self._jit_cached(
+                ("assign", tuple(c.__uuid__() for c in columns), pad_n),
+                _assign_prog,
+            )(expr_eval.blocks_to_masked(blocks))
+            sharding = row_sharding(blocks.mesh)
+            new_cols = dict(blocks.columns)
+            for name, tp, _c in plans:
+                new_cols[name] = JaxColumn(
+                    tp,
+                    jax.device_put(outs[f"v:{name}"], sharding),
+                    None
+                    if f"m:{name}" not in outs
+                    else jax.device_put(outs[f"m:{name}"], sharding),
+                )
+            return JaxDataFrame(blocks_with_columns(blocks, new_cols), schema)
         return self.to_df(self._native.assign(jdf.as_local_bounded(), columns))
 
     def aggregate(
@@ -396,9 +478,12 @@ class JaxExecutionEngine(ExecutionEngine):
     def persist(self, df: DataFrame, lazy: bool = False, **kwargs: Any) -> DataFrame:
         jdf: JaxDataFrame = self.to_df(df)  # type: ignore
         if not lazy:
-            for col in jdf.blocks.columns.values():
-                if col.on_device:
-                    col.data.block_until_ready()
+            arrs = [
+                c.data
+                for c in jdf.blocks.columns.values()
+                if c.on_device
+            ]
+            jax.block_until_ready(arrs)
         return jdf
 
     def join(
@@ -432,14 +517,44 @@ class JaxExecutionEngine(ExecutionEngine):
         )
 
     def distinct(self, df: DataFrame) -> DataFrame:
+        """Mask-only distinct: factorize all columns, keep each segment's
+        representative row by flipping validity — no gather, and zero host
+        syncs on the binned path."""
         jdf: JaxDataFrame = self.to_df(df)  # type: ignore
         blocks = jdf.blocks
-        if blocks.all_on_device and blocks.nrows > 0:
-            seg, first_idx, num = groupby.factorize_keys(
-                blocks, jdf.schema.names
-            )
+        if blocks.all_on_device and not (
+            blocks.nrows_known and blocks.nrows == 0
+        ):
+            fr = groupby.factorize_keys(blocks, jdf.schema.names)
+
+            def _distinct_prog(
+                seg: Any,
+                first_idx: Any,
+                row_valid: Optional[Any],
+                nrows_s: Any,
+            ) -> Any:
+                pad_n = seg.shape[0]
+                row_valid = groupby.materialize_validity(
+                    row_valid, pad_n, nrows_s
+                )
+                pos = jnp.arange(pad_n, dtype=jnp.int32)
+                # invalid rows' sentinel seg clamps OOB on gather; they
+                # stay invalid regardless
+                return row_valid & (first_idx[seg] == pos)
+
+            keep = self._jit_cached(
+                ("distinct", blocks.padded_nrows, fr.num_segments),
+                _distinct_prog,
+            )(fr.seg, fr.first_idx, blocks.row_valid, _nrows_arg(blocks))
             return JaxDataFrame(
-                gather_indices(blocks, first_idx, jdf.schema), jdf.schema
+                JaxBlocks(
+                    None,
+                    dict(blocks.columns),
+                    blocks.mesh,
+                    row_valid=keep,
+                    nrows_dev=fr.num_groups_dev,
+                ),
+                jdf.schema,
             )
         return self.to_df(self._native.distinct(jdf.as_local_bounded()))
 
@@ -453,27 +568,50 @@ class JaxExecutionEngine(ExecutionEngine):
         jdf: JaxDataFrame = self.to_df(df)  # type: ignore
         blocks = jdf.blocks
         names = subset if subset is not None else jdf.schema.names
-        if all(blocks.columns[n].on_device for n in names):
+        if all(
+            n in blocks.columns and blocks.columns[n].on_device for n in names
+        ):
             pad_n = blocks.padded_nrows
-            valid_count = jnp.zeros((pad_n,), dtype=jnp.int32)
-            for n in names:
-                col = blocks.columns[n]
-                v = (
-                    jnp.ones((pad_n,), dtype=jnp.int32)
-                    if col.mask is None
-                    else col.mask.astype(jnp.int32)
+            masks = {
+                n: blocks.columns[n].mask
+                for n in names
+                if blocks.columns[n].mask is not None
+            }
+
+            def _dropna_prog(
+                masks_: Dict[str, Any],
+                row_valid: Optional[Any],
+                nrows_s: Any,
+            ) -> Tuple[Any, Any]:
+                row_valid = groupby.materialize_validity(
+                    row_valid, pad_n, nrows_s
                 )
-                valid_count = valid_count + v
-            if thresh is not None:
-                keep = valid_count >= thresh
-            elif how == "any":
-                keep = valid_count == len(names)
-            else:  # all
-                keep = valid_count > 0
-            keep = keep & groupby.row_validity(blocks)
-            idx = jnp.nonzero(keep)[0]
+                valid_count = jnp.full((pad_n,), len(names) - len(masks_),
+                                       dtype=jnp.int32)
+                for m in masks_.values():
+                    valid_count = valid_count + m.astype(jnp.int32)
+                if thresh is not None:
+                    keep = valid_count >= thresh
+                elif how == "any":
+                    keep = valid_count == len(names)
+                else:  # all
+                    keep = valid_count > 0
+                keep = keep & row_valid
+                return keep, jnp.sum(keep).astype(jnp.int32)
+
+            keep, cnt = self._jit_cached(
+                ("dropna", pad_n, how, thresh, tuple(sorted(names))),
+                _dropna_prog,
+            )(masks, blocks.row_valid, _nrows_arg(blocks))
             return JaxDataFrame(
-                gather_indices(blocks, idx, jdf.schema), jdf.schema
+                JaxBlocks(
+                    None,
+                    dict(blocks.columns),
+                    blocks.mesh,
+                    row_valid=keep,
+                    nrows_dev=cnt,
+                ),
+                jdf.schema,
             )
         return self.to_df(
             self._native.dropna(
@@ -502,11 +640,16 @@ class JaxExecutionEngine(ExecutionEngine):
             ValueError("one and only one of n and frac must be set"),
         )
         jdf: JaxDataFrame = self.to_df(df)  # type: ignore
-        total = jdf.blocks.nrows
+        blocks = jdf.blocks
+        if blocks.row_valid is not None:
+            valid_idx = np.nonzero(np.asarray(blocks.row_valid))[0]
+        else:
+            valid_idx = np.arange(blocks.nrows)
+        total = len(valid_idx)
         rng = np.random.default_rng(seed)
         count = n if n is not None else int(round(total * frac))  # type: ignore
         count = min(count, total) if not replace else count
-        idx = rng.choice(total, size=count, replace=replace)
+        idx = valid_idx[rng.choice(total, size=count, replace=replace)]
         return JaxDataFrame(
             gather_indices(jdf.blocks, jnp.asarray(np.sort(idx)), jdf.schema),
             jdf.schema,
@@ -608,19 +751,43 @@ class JaxExecutionEngine(ExecutionEngine):
     def _device_project(
         self, jdf: JaxDataFrame, cols: SelectColumns, out_schema: Schema
     ) -> DataFrame:
-        masked_cols = expr_eval.blocks_to_masked(jdf.blocks)
-        pad_n = jdf.blocks.padded_nrows
-        sharding = row_sharding(jdf.blocks.mesh)
+        blocks = jdf.blocks
+        pad_n = blocks.padded_nrows
+        exprs = list(cols.all_cols)
+
+        def _project_prog(mcols: Dict[str, Any]) -> Dict[str, Any]:
+            outs: Dict[str, Any] = {}
+            for c, f in zip(exprs, out_schema.fields):
+                v, m = expr_eval.eval_expr(mcols, c, pad_n)
+                outs[f"v:{f.name}"] = v
+                if m is not None:
+                    outs[f"m:{f.name}"] = m
+            return outs
+
+        outs = self._jit_cached(
+            ("project", tuple(c.__uuid__() for c in exprs), pad_n),
+            _project_prog,
+        )(expr_eval.blocks_to_masked(blocks))
+        sharding = row_sharding(blocks.mesh)
         new_cols: Dict[str, JaxColumn] = {}
-        for c, f in zip(cols.all_cols, out_schema.fields):
-            v, m = expr_eval.eval_expr(masked_cols, c, pad_n)
+        for c, f in zip(exprs, out_schema.fields):
+            # plain column references keep their stats/dictionary
+            src = (
+                blocks.columns.get(c.name)
+                if isinstance(c, _NamedColumnExpr) and c.as_type is None
+                else None
+            )
             new_cols[f.name] = JaxColumn(
                 f.type,
-                jax.device_put(v, sharding),
-                None if m is None else jax.device_put(m, sharding),
+                jax.device_put(outs[f"v:{f.name}"], sharding),
+                None
+                if f"m:{f.name}" not in outs
+                else jax.device_put(outs[f"m:{f.name}"], sharding),
+                src.dictionary if src is not None else None,
+                src.stats if src is not None else None,
             )
         return JaxDataFrame(
-            JaxBlocks(jdf.blocks.nrows, new_cols, jdf.blocks.mesh), out_schema
+            blocks_with_columns(blocks, new_cols), out_schema
         )
 
     def _device_groupby_select(
@@ -641,13 +808,86 @@ class JaxExecutionEngine(ExecutionEngine):
     def _jit_cached(self, key: Any, fn: Callable) -> Callable:
         """Per-engine jit cache: logical programs (aggregate plans, map fns,
         filters) are keyed by structure so repeated queries reuse the
-        compiled executable."""
+        compiled executable. Keys never include row counts — those enter
+        programs as traced scalars/masks."""
         cache = getattr(self, "_jit_cache", None)
         if cache is None:
             cache = {}
             self._jit_cache = cache
         if key not in cache:
             cache[key] = jax.jit(fn)
+        return cache[key]
+
+    def _map_program(
+        self,
+        key: Any,
+        fn: Callable,
+        array_args: Dict[str, Any],
+        blocks: JaxBlocks,
+        col_names: List[str],
+    ) -> Tuple[Callable, Dict[str, str]]:
+        """Jit a compiled-map program and (once, at cache miss) analyze its
+        jaxpr for column passthroughs: an output leaf that IS an input var
+        carries the input column's value bounds, so stats (and dictionaries)
+        propagate soundly through user transforms — the key enabler of
+        sync-free group-by after a transform."""
+        cache = getattr(self, "_map_cache", None)
+        if cache is None:
+            cache = {}
+            self._map_cache = cache
+        if key not in cache:
+            jitted = jax.jit(fn)
+            passthrough: Dict[str, str] = {}
+            try:
+                shaped = {
+                    k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                    for k, v in array_args.items()
+                }
+                rv = blocks.row_valid
+                rv_s = (
+                    None
+                    if rv is None
+                    else jax.ShapeDtypeStruct(rv.shape, rv.dtype)
+                )
+                closed = jax.make_jaxpr(fn)(
+                    shaped, rv_s, jax.ShapeDtypeStruct((), jnp.int32)
+                )
+                in_leaves, in_tree = jax.tree_util.tree_flatten(
+                    (shaped, rv_s, jax.ShapeDtypeStruct((), jnp.int32))
+                )
+                in_paths = [
+                    p
+                    for p, _ in jax.tree_util.tree_flatten_with_path(
+                        (shaped, rv_s, jax.ShapeDtypeStruct((), jnp.int32))
+                    )[0]
+                ]
+                # rebuild the output structure to get leaf names
+                out_aval_tree = jax.eval_shape(
+                    fn, shaped, rv_s, jax.ShapeDtypeStruct((), jnp.int32)
+                )
+                out_paths = [
+                    p
+                    for p, _ in jax.tree_util.tree_flatten_with_path(
+                        out_aval_tree
+                    )[0]
+                ]
+                invars = closed.jaxpr.invars
+                outvars = closed.jaxpr.outvars
+                var_to_in: Dict[Any, str] = {}
+                for var, path in zip(invars, in_paths):
+                    name = _path_leaf_key(path)
+                    if name is not None:
+                        var_to_in[var] = name
+                for var, path in zip(outvars, out_paths):
+                    name = _path_leaf_key(path)
+                    if name is None or name.startswith("_"):
+                        continue
+                    src = var_to_in.get(var)
+                    if src is not None and src in col_names:
+                        passthrough[name] = src
+            except Exception:  # pragma: no cover - analysis is best-effort
+                passthrough = {}
+            cache[key] = (jitted, passthrough)
         return cache[key]
 
     def _try_device_aggregate(
@@ -680,18 +920,10 @@ class JaxExecutionEngine(ExecutionEngine):
             if not expr_eval.can_eval_on_device(arg, blocks):
                 return None
             plans.append((c.output_name, c.func.lower(), arg, c))
-        if blocks.nrows == 0:
-            # empty input: host path handles schema/empty conventions
+        if blocks.nrows_known and blocks.nrows == 0:
+            # known-empty input: host path handles schema/empty conventions
             return None
         pad_n = blocks.padded_nrows
-        nrows = blocks.nrows
-        masked_cols = expr_eval.blocks_to_masked(blocks)
-        if len(keys) > 0:
-            seg, first_idx, num = groupby.factorize_keys(blocks, keys)
-        else:
-            seg = jnp.zeros((pad_n,), dtype=jnp.int64)
-            first_idx = jnp.zeros((1,), dtype=jnp.int64)
-            num = 1
         # resolve output types up front (needed inside the traced program)
         typed_plans = []
         for name, func, arg, expr in plans:
@@ -699,8 +931,27 @@ class JaxExecutionEngine(ExecutionEngine):
             if tp is None:
                 return None
             typed_plans.append((name, func, arg, tp))
-        out_pad = padded_len(num, int(blocks.mesh.devices.size))
+        ndev = int(blocks.mesh.devices.size)
         sharding = row_sharding(blocks.mesh)
+        if len(keys) == 0:
+            return self._global_aggregate(
+                jdf, typed_plans, col_order, sharding
+            )
+        bspec = groupby.bin_spec(blocks, keys)
+        if (
+            bspec is not None
+            and bspec.total <= groupby._MATMUL_MAX_SEGMENTS
+            and all(
+                self._matmul_agg_ok(jdf, func, arg)
+                for _, func, arg, _ in typed_plans
+            )
+        ):
+            return self._binned_matmul_aggregate(
+                jdf, keys, typed_plans, bspec, col_order, sharding
+            )
+        fr = groupby.factorize_keys(blocks, keys)
+        num_segments = fr.num_segments
+        out_pad = padded_len(num_segments, ndev)
 
         # ONE fused program: every agg + key gather + padding, single dispatch
         def _agg_program(
@@ -709,8 +960,11 @@ class JaxExecutionEngine(ExecutionEngine):
             key_masks: Dict[str, Any],
             seg_: Any,
             first_idx_: Any,
+            occupied_: Optional[Any],
+            row_valid: Optional[Any],
+            nrows_s: Any,
         ) -> Dict[str, Any]:
-            valid_ = jnp.arange(pad_n, dtype=jnp.int32) < nrows
+            valid_ = groupby.materialize_validity(row_valid, pad_n, nrows_s)
             outs: Dict[str, Any] = {}
             for k in keys:
                 kd = key_data[k][first_idx_]
@@ -725,18 +979,20 @@ class JaxExecutionEngine(ExecutionEngine):
                 else:
                     values, mask = expr_eval.eval_expr(mcols, arg, pad_n)
                 v, m = groupby._segment_agg_impl(
-                    func, values, mask, seg_, num, valid_
+                    func, values, mask, seg_, num_segments, valid_
                 )
                 outs[f"a:{name}"] = _pad_to(_cast_agg_result(v, tp), out_pad)
                 if m is not None:
                     outs[f"am:{name}"] = _pad_to(m, out_pad)
+            if occupied_ is not None:
+                outs["_occupied"] = _pad_to(occupied_, out_pad)
             return outs
 
         prog_key = (
             "agg",
             tuple((n, f, None if a is None else a.__uuid__(), str(t))
                   for n, f, a, t in typed_plans),
-            tuple(keys), num, out_pad, pad_n, nrows,
+            tuple(keys), num_segments, out_pad, pad_n,
         )
         key_data = {k: blocks.columns[k].data for k in keys}
         key_masks = {
@@ -745,7 +1001,14 @@ class JaxExecutionEngine(ExecutionEngine):
             if blocks.columns[k].mask is not None
         }
         outs = self._jit_cached(prog_key, _agg_program)(
-            masked_cols, key_data, key_masks, seg, first_idx
+            expr_eval.blocks_to_masked(blocks),
+            key_data,
+            key_masks,
+            fr.seg,
+            fr.first_idx,
+            fr.occupied,
+            blocks.row_valid,
+            _nrows_arg(blocks),
         )
         out_cols: Dict[str, JaxColumn] = {}
         schema_fields = [jdf.schema[k] for k in keys]
@@ -758,6 +1021,7 @@ class JaxExecutionEngine(ExecutionEngine):
                     outs[f"km:{k}"], sharding
                 ),
                 src_col.dictionary,
+                src_col.stats,
             )
         for name, func, arg, tp in typed_plans:
             out_cols[name] = JaxColumn(
@@ -772,9 +1036,321 @@ class JaxExecutionEngine(ExecutionEngine):
         if col_order is not None:
             schema = schema.extract(col_order)
             out_cols = {n: out_cols[n] for n in col_order}
+        if "_occupied" in outs:
+            # binned path: empty bins masked out lazily; count stays a
+            # device scalar until the host asks
+            row_valid_out = jax.device_put(outs["_occupied"], sharding)
+            return JaxDataFrame(
+                JaxBlocks(
+                    None,
+                    out_cols,
+                    blocks.mesh,
+                    row_valid=row_valid_out,
+                    nrows_dev=fr.num_groups_dev,
+                ),
+                schema,
+            )
         return JaxDataFrame(
-            JaxBlocks(num, out_cols, blocks.mesh), schema
+            JaxBlocks(num_segments, out_cols, blocks.mesh), schema
         )
+
+    def _matmul_agg_ok(
+        self, jdf: JaxDataFrame, func: str, arg: Any
+    ) -> bool:
+        """Whether an aggregation can ride the one-hot-matmul path: counts
+        always; sum/avg only over FLOAT payloads (integer sums would lose
+        low bits in the float accumulator — they take the exact
+        scatter-based path instead)."""
+        if func == "count":
+            return True
+        if func not in ("sum", "avg", "mean"):
+            return False
+        tp = arg.infer_type(jdf.schema) if arg is not None else None
+        if tp is None and isinstance(arg, _NamedColumnExpr):
+            col = jdf.schema[arg.name] if arg.name in jdf.schema else None
+            tp = col.type if col is not None else None
+        return tp is not None and pa.types.is_floating(tp)
+
+    def _global_aggregate(
+        self,
+        jdf: JaxDataFrame,
+        typed_plans: List[Tuple[str, str, Any, pa.DataType]],
+        col_order: Optional[List[str]],
+        sharding: Any,
+    ) -> DataFrame:
+        """Keyless aggregation: plain masked jnp reductions — one program,
+        no segments, no scatter."""
+        blocks = jdf.blocks
+        pad_n = blocks.padded_nrows
+
+        def _prog(
+            mcols: Dict[str, Any], row_valid: Optional[Any], nrows_s: Any
+        ) -> Dict[str, Any]:
+            valid = groupby.materialize_validity(row_valid, pad_n, nrows_s)
+            outs: Dict[str, Any] = {}
+            for name, func, arg, tp in typed_plans:
+                if func == "count" and arg is None:
+                    values: Any = jnp.ones((pad_n,), dtype=jnp.int32)
+                    mask: Any = None
+                else:
+                    values, mask = expr_eval.eval_expr(mcols, arg, pad_n)
+                eff = valid if mask is None else (mask & valid)
+                cnt = jnp.sum(eff.astype(jnp.int32))
+                if func == "count":
+                    v: Any = cnt
+                    m: Any = None
+                elif func in ("sum", "avg", "mean"):
+                    tot = jnp.sum(jnp.where(eff, values, 0))
+                    v = (
+                        tot
+                        if func == "sum"
+                        else tot / jnp.maximum(cnt, 1)
+                    )
+                    m = cnt > 0
+                elif func == "min":
+                    v = jnp.min(
+                        jnp.where(eff, values, groupby._type_max(values.dtype))
+                    )
+                    m = cnt > 0
+                elif func == "max":
+                    v = jnp.max(
+                        jnp.where(eff, values, groupby._type_min(values.dtype))
+                    )
+                    m = cnt > 0
+                else:  # first/last
+                    idx = jnp.arange(pad_n, dtype=jnp.int32)
+                    pick = (
+                        jnp.argmin(jnp.where(valid, idx, pad_n))
+                        if func == "first"
+                        else jnp.argmax(jnp.where(valid, idx, -1))
+                    )
+                    v = values[pick]
+                    # no valid row at all (e.g. filter removed everything
+                    # from a lazy-count frame) -> NULL, not row-0 garbage
+                    any_valid = jnp.any(valid)
+                    m = (
+                        any_valid
+                        if mask is None
+                        else (mask[pick] & any_valid)
+                    )
+                outs[f"a:{name}"] = _cast_agg_result(
+                    jnp.asarray(v)[None], tp
+                )
+                if m is not None:
+                    outs[f"am:{name}"] = jnp.asarray(m)[None]
+            return outs
+
+        prog_key = (
+            "gagg",
+            tuple(
+                (n, f, None if a is None else a.__uuid__(), str(t))
+                for n, f, a, t in typed_plans
+            ),
+            pad_n,
+        )
+        outs = self._jit_cached(prog_key, _prog)(
+            expr_eval.blocks_to_masked(blocks),
+            blocks.row_valid,
+            _nrows_arg(blocks),
+        )
+        ndev = int(blocks.mesh.devices.size)
+        out_pad = padded_len(1, ndev)
+        out_cols: Dict[str, JaxColumn] = {}
+        schema_fields = []
+        for name, func, arg, tp in typed_plans:
+            out_cols[name] = JaxColumn(
+                tp,
+                jax.device_put(
+                    _pad_to(outs[f"a:{name}"], out_pad), sharding
+                ),
+                None
+                if f"am:{name}" not in outs
+                else jax.device_put(
+                    _pad_to(outs[f"am:{name}"], out_pad), sharding
+                ),
+            )
+            schema_fields.append(pa.field(name, tp))
+        schema = Schema(schema_fields)
+        if col_order is not None:
+            schema = schema.extract(col_order)
+            out_cols = {n: out_cols[n] for n in col_order}
+        return JaxDataFrame(
+            JaxBlocks(1, out_cols, blocks.mesh), schema
+        )
+
+    def _binned_matmul_aggregate(
+        self,
+        jdf: JaxDataFrame,
+        keys: List[str],
+        typed_plans: List[Tuple[str, str, Any, pa.DataType]],
+        bspec: "groupby.BinSpec",
+        col_order: Optional[List[str]],
+        sharding: Any,
+    ) -> DataFrame:
+        """The group-by hot path: ONE jitted program computing mixed-radix
+        segment ids inline, ALL sum/avg/count reductions via a single
+        chunked one-hot matmul on the MXU (scatter-free), and key values
+        decoded arithmetically from bin indices (gather-free). Zero host
+        syncs; the group count stays a lazy device scalar."""
+        blocks = jdf.blocks
+        pad_n = blocks.padded_nrows
+        ndev = int(blocks.mesh.devices.size)
+        total = bspec.total
+        out_pad = padded_len(total, ndev)
+        key_dtypes = {k: blocks.columns[k].data.dtype for k in keys}
+
+        def _prog(
+            mcols: Dict[str, Any],
+            key_data: Dict[str, Any],
+            key_masks: Dict[str, Any],
+            row_valid: Optional[Any],
+            nrows_s: Any,
+        ) -> Dict[str, Any]:
+            valid = groupby.materialize_validity(row_valid, pad_n, nrows_s)
+            seg = groupby.inline_seg(
+                bspec, key_data, key_masks, valid
+            )
+            float_payloads: List[Any] = []
+            count_payloads: List[Any] = [valid]  # occupancy rides along
+            slots: List[Tuple[str, str]] = []  # (kind, index-key) per plan
+            for name, func, arg, tp in typed_plans:
+                if func == "count" and arg is None:
+                    count_payloads.append(valid)
+                    slots.append(("c", len(count_payloads) - 1))
+                    continue
+                values, mask = expr_eval.eval_expr(mcols, arg, pad_n)
+                eff = valid if mask is None else (mask & valid)
+                if func == "count":
+                    count_payloads.append(eff)
+                    slots.append(("c", len(count_payloads) - 1))
+                else:
+                    float_payloads.append(jnp.where(eff, values, 0))
+                    count_payloads.append(eff)
+                    slots.append(
+                        ("f", (len(float_payloads) - 1,
+                               len(count_payloads) - 1))
+                    )
+            f_sums, c_sums = groupby.matmul_segment_sums(
+                float_payloads, count_payloads, seg, total
+            )
+            occupied = c_sums[0] > 0
+            outs: Dict[str, Any] = {
+                "_occupied": _pad_to(occupied, out_pad),
+                "_num": jnp.sum(occupied.astype(jnp.int32)),
+            }
+            decoded = groupby.decode_bin_keys(bspec, key_dtypes)
+            for k in keys:
+                kv, km = decoded[k]
+                outs[f"k:{k}"] = _pad_to(kv, out_pad)
+                if km is not None:
+                    outs[f"km:{k}"] = _pad_to(km, out_pad)
+            for (name, func, arg, tp), slot in zip(typed_plans, slots):
+                kind, idx = slot
+                if kind == "c":
+                    outs[f"a:{name}"] = _pad_to(
+                        _cast_agg_result(c_sums[idx], tp), out_pad
+                    )
+                    continue
+                fi, ci = idx
+                tot, cnt = f_sums[fi], c_sums[ci]
+                if func == "sum":
+                    v = tot
+                else:  # avg/mean
+                    v = tot / jnp.maximum(cnt, 1)
+                outs[f"a:{name}"] = _pad_to(_cast_agg_result(v, tp), out_pad)
+                outs[f"am:{name}"] = _pad_to(cnt > 0, out_pad)
+            return outs
+
+        prog_key = (
+            "bagg",
+            tuple(
+                (n, f, None if a is None else a.__uuid__(), str(t))
+                for n, f, a, t in typed_plans
+            ),
+            bspec,
+            pad_n,
+        )
+        key_data = {k: blocks.columns[k].data for k in keys}
+        key_masks = {
+            k: blocks.columns[k].mask
+            for k in keys
+            if blocks.columns[k].mask is not None
+        }
+        outs = self._jit_cached(prog_key, _prog)(
+            expr_eval.blocks_to_masked(blocks),
+            key_data,
+            key_masks,
+            blocks.row_valid,
+            _nrows_arg(blocks),
+        )
+        out_cols: Dict[str, JaxColumn] = {}
+        schema_fields = [jdf.schema[k] for k in keys]
+        for k in keys:
+            src_col = blocks.columns[k]
+            out_cols[k] = JaxColumn(
+                src_col.pa_type,
+                jax.device_put(outs[f"k:{k}"], sharding),
+                None
+                if f"km:{k}" not in outs
+                else jax.device_put(outs[f"km:{k}"], sharding),
+                src_col.dictionary,
+                src_col.stats,
+            )
+        for name, func, arg, tp in typed_plans:
+            out_cols[name] = JaxColumn(
+                tp,
+                jax.device_put(outs[f"a:{name}"], sharding),
+                None
+                if f"am:{name}" not in outs
+                else jax.device_put(outs[f"am:{name}"], sharding),
+            )
+            schema_fields.append(pa.field(name, tp))
+        schema = Schema(schema_fields)
+        if col_order is not None:
+            schema = schema.extract(col_order)
+            out_cols = {n: out_cols[n] for n in col_order}
+        return JaxDataFrame(
+            JaxBlocks(
+                None,
+                out_cols,
+                blocks.mesh,
+                row_valid=jax.device_put(outs["_occupied"], sharding),
+                nrows_dev=outs["_num"],
+            ),
+            schema,
+        )
+
+
+def blocks_with_columns(
+    blocks: JaxBlocks, new_cols: Dict[str, JaxColumn]
+) -> JaxBlocks:
+    """New column set, same row membership (lazy state passes through)."""
+    return JaxBlocks(
+        blocks._nrows,
+        new_cols,
+        blocks.mesh,
+        row_valid=blocks.row_valid,
+        nrows_dev=blocks._nrows_dev,
+    )
+
+
+def _nrows_arg(blocks: JaxBlocks) -> Any:
+    """Row count as a program argument with no host sync: a known int (jax
+    converts per call, no retrace) or the pending device scalar."""
+    if blocks._nrows is not None:
+        return np.int32(blocks._nrows)
+    if blocks._nrows_dev is not None:
+        return blocks._nrows_dev
+    return np.int32(-1)  # row_valid is set; programs use the mask directly
+
+
+def _path_leaf_key(path: Any) -> Optional[str]:
+    """Dict key of a pytree leaf path like (DictKey('k'),) -> 'k'."""
+    if len(path) == 0:
+        return None
+    last = path[-1]
+    key = getattr(last, "key", None)
+    return key if isinstance(key, str) else None
 
 
 def _pad_to(v: jnp.ndarray, target: int) -> jnp.ndarray:

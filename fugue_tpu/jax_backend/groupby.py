@@ -1,14 +1,26 @@
-"""Device group-by aggregation: factorize keys, then segment reductions.
+"""Device group-by: key factorization + segment reductions.
 
 The TPU lowering of SQL GROUP BY (BASELINE: "group-by aggregates lower to
-segment_sum/segment_max scans on device"): key columns (ints, dict-encoded
-string codes, bools, dates) are packed into a single code, factorized with a
-sort, and every aggregation becomes one ``jax.ops.segment_*`` scan — O(n log n)
-once for the sort, O(n) per agg, all on the MXU-adjacent vector units with
-XLA-inserted psums over ICI when sharded."""
+segment_sum/segment_max scans on device"). Two factorization strategies:
+
+- **Static binning (the hot path, zero host syncs):** when every key is
+  integer-like with host-known bounds (column ``stats`` captured at ingest
+  and propagated through the pipeline), segment ids are a mixed-radix
+  combination of ``key - min`` — one fused O(n) pass, no sort, and the
+  segment COUNT is the static bin count, so downstream segment ops and
+  output shapes need no device readback. Empty bins are dropped lazily via
+  an occupancy mask (the frame's ``row_valid``).
+
+- **Sort-based (general fallback):** lexicographic factorization via
+  stable sorts for float/wide/unbounded keys. Costs two host syncs (group
+  count) — acceptable off the hot path.
+
+Everything computes in int32: int64 is EMULATED on TPU (~10x slower), and
+row positions/bin codes fit int32 by construction.
+"""
 
 from functools import partial
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -20,40 +32,295 @@ from fugue_tpu.utils.assertion import assert_or_throw
 
 
 def row_validity(blocks: JaxBlocks) -> jnp.ndarray:
-    """True for real rows, False for mesh padding."""
-    pad_n = blocks.padded_nrows
-    return jnp.arange(pad_n) < blocks.nrows
+    """True for real rows, False for mesh padding / filtered-out rows."""
+    return blocks.validity()
 
 
-def factorize_keys(
-    blocks: JaxBlocks, keys: List[str]
-) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
-    """Return (segment_ids [padded_n], representative row index per group [G],
-    num_groups). Null keys form their own groups (SQL GROUP BY semantics).
-    Padding rows are routed to a trash segment dropped by the caller.
+def materialize_validity(
+    row_valid: Optional[jnp.ndarray], pad_n: int, nrows_s: Any
+) -> jnp.ndarray:
+    """Traced helper: the one validity convention, shared by every device
+    program — a masked frame passes its mask; a prefix frame materializes
+    ``arange < nrows`` in-program (int32: int64 is emulated on TPU)."""
+    if row_valid is not None:
+        return row_valid
+    return jnp.arange(pad_n, dtype=jnp.int32) < nrows_s
 
-    Fast path — direct binning: when the combined key range is small (dict
-    codes, int categories, bools, dates) segment ids are computed WITHOUT a
-    global sort (seg = mixed-radix(k - kmin)); a distributed sort across the
-    mesh costs ~10x one binning pass. Wide/float keys fall back to the
-    sort-based path. Results are cached per frame (repeated ops on the same
-    keys — transform then aggregate — pay once)."""
+
+class BinSpec(NamedTuple):
+    """Static description of a mixed-radix key binning: everything needed
+    to compute segment ids INSIDE a traced program (no separate factorize
+    dispatch) and to DECODE key values arithmetically from bin indices
+    (no representative-row gather, no segment_min scatter)."""
+
+    names: Tuple[str, ...]
+    mins: Tuple[int, ...]
+    spans: Tuple[int, ...]  # includes the +1 null bucket where masked
+    masked: Tuple[bool, ...]
+    total: int
+
+
+def bin_spec(blocks: JaxBlocks, keys: List[str]) -> Optional[BinSpec]:
+    """BinSpec for `keys` when all are integer-like with host-known bounds
+    (stats from ingest / propagation, else ONE device min/max readback,
+    cached on the column); None for float/unbounded keys."""
+    missing: List[str] = []
+    for k in keys:
+        col = blocks.columns.get(k)
+        if col is None or not col.on_device:
+            return None
+        if jnp.issubdtype(col.data.dtype, jnp.floating):
+            return None
+        if col.stats is None:
+            missing.append(k)
+    if missing:
+        _fill_stats_from_device(blocks, missing)
+    spans: List[int] = []
+    mins: List[int] = []
+    masked: List[bool] = []
+    for k in keys:
+        col = blocks.columns[k]
+        lo, hi = col.stats  # type: ignore[misc]
+        span = int(hi) - int(lo) + 1
+        if span <= 0 or span > _MAX_BINS:
+            return None
+        has_mask = col.mask is not None
+        if has_mask:
+            span += 1  # null bucket
+        spans.append(span)
+        mins.append(int(lo))
+        masked.append(has_mask)
+    total = 1
+    for r in spans:
+        total *= r
+        if total > _MAX_BINS:
+            return None
+    return BinSpec(tuple(keys), tuple(mins), tuple(spans), tuple(masked), total)
+
+
+@jax.jit
+def _minmax_prog(datas: Tuple[jnp.ndarray, ...]) -> Tuple[jnp.ndarray, ...]:
+    return tuple(
+        jnp.stack([jnp.min(d), jnp.max(d)]).astype(jnp.int64) for d in datas
+    )
+
+
+def _fill_stats_from_device(blocks: JaxBlocks, names: List[str]) -> None:
+    """Backfill missing int-key stats with one jitted min/max program and a
+    single batched readback, cached on the columns (a one-sync fallback so
+    computed keys — e.g. from assign() — still reach the binned fast path
+    instead of the ~10x sort factorization)."""
+    datas = tuple(blocks.columns[k].data for k in names)
+    bounds = jax.device_get(_minmax_prog(datas))
+    for k, b in zip(names, bounds):
+        blocks.columns[k].stats = (int(b[0]), int(b[1]))
+
+
+def inline_seg(
+    spec: BinSpec,
+    key_data: Dict[str, jnp.ndarray],
+    key_masks: Dict[str, Optional[jnp.ndarray]],
+    valid_rows: jnp.ndarray,
+) -> jnp.ndarray:
+    """Traced helper: mixed-radix segment ids per row; invalid rows get the
+    out-of-range sentinel ``spec.total`` (dropped by one-hot and segment
+    ops alike)."""
+    n = valid_rows.shape[0]
+    combined = jnp.zeros((n,), dtype=jnp.int32)
+    for name, kmin, span, has_mask in zip(
+        spec.names, spec.mins, spec.spans, spec.masked
+    ):
+        code = (key_data[name] - kmin).astype(jnp.int32)
+        if has_mask:
+            code = jnp.where(key_masks[name], code, span - 1)
+        combined = combined * jnp.int32(span) + code
+    return jnp.where(valid_rows, combined, jnp.int32(spec.total))
+
+
+def decode_bin_keys(
+    spec: BinSpec, dtypes: Dict[str, Any]
+) -> Dict[str, Tuple[jnp.ndarray, Optional[jnp.ndarray]]]:
+    """Traced helper: key (values, mask) per bin index — pure arithmetic
+    over ``arange(total)``, replacing the representative-row gather."""
+    b = jnp.arange(spec.total, dtype=jnp.int32)
+    out: Dict[str, Tuple[jnp.ndarray, Optional[jnp.ndarray]]] = {}
+    stride = spec.total
+    for name, kmin, span, has_mask in zip(
+        spec.names, spec.mins, spec.spans, spec.masked
+    ):
+        stride //= span
+        code = (b // stride) % span
+        if has_mask:
+            mask = code != span - 1
+            value = jnp.where(mask, code, 0) + kmin
+        else:
+            mask = None
+            value = code + kmin
+        out[name] = (value.astype(dtypes[name]), mask)
+    return out
+
+
+# one-hot matmul aggregation: beats XLA's scatter-based segment_sum ~5x on
+# TPU for small segment counts (scatter serializes; the MXU does not)
+_MATMUL_MAX_SEGMENTS = 8192
+_MATMUL_CHUNK = 1 << 17
+
+
+def matmul_segment_sums(
+    float_payloads: List[jnp.ndarray],
+    count_payloads: List[jnp.ndarray],
+    seg: jnp.ndarray,
+    num_segments: int,
+) -> Tuple[List[jnp.ndarray], List[jnp.ndarray]]:
+    """Traced helper: all sum-type reductions in ONE chunked one-hot matmul
+    over the MXU. ``float_payloads`` accumulate in f32/f64; ``count_payloads``
+    (bool/0-1 valued) accumulate exactly in int32 (f32 partials per chunk
+    are exact below the chunk size). ``seg`` values >= num_segments
+    contribute nothing (their one-hot row is all zeros)."""
+    n = seg.shape[0]
+    ch = min(_MATMUL_CHUNK, n)
+    pad = (-n) % ch
+    # accumulate in the widest float dtype present (f64 stays f64 for CPU
+    # fidelity; pure-f32 TPU pipelines ride the fast path); count partials
+    # are exact below the chunk size in any float dtype
+    acc_dtype = (
+        jnp.result_type(*[p.dtype for p in float_payloads])
+        if len(float_payloads) > 0
+        else jnp.float32
+    )
+    if not jnp.issubdtype(acc_dtype, jnp.floating):
+        acc_dtype = jnp.float32
+    payloads = [p.astype(acc_dtype) for p in float_payloads] + [
+        p.astype(acc_dtype) for p in count_payloads
+    ]
+    if pad:
+        seg = jnp.concatenate(
+            [seg, jnp.full((pad,), num_segments, dtype=seg.dtype)]
+        )
+        payloads = [
+            jnp.concatenate([p, jnp.zeros((pad,), dtype=p.dtype)])
+            for p in payloads
+        ]
+    a = len(payloads)
+    nf = len(float_payloads)
+    kc = seg.reshape(-1, ch)
+    pc = jnp.stack(payloads, axis=0).reshape(a, -1, ch)
+    iota = jnp.arange(num_segments, dtype=seg.dtype)
+
+    def body(carry: Tuple[Any, Any], kv: Any) -> Tuple[Tuple[Any, Any], None]:
+        f_acc, c_acc = carry
+        kk, vv = kv
+        oh = (kk[:, None] == iota[None, :]).astype(acc_dtype)
+        part = vv @ oh  # (a, num_segments)
+        f_acc = f_acc + part[:nf]
+        c_acc = c_acc + part[nf:].astype(jnp.int32)
+        return (f_acc, c_acc), None
+
+    init = (
+        jnp.zeros((nf, num_segments), acc_dtype),
+        jnp.zeros((a - nf, num_segments), jnp.int32),
+    )
+    (f_acc, c_acc), _ = jax.lax.scan(
+        body, init, (kc, jnp.moveaxis(pc, 0, 1))
+    )
+    return list(f_acc), list(c_acc)
+
+
+class Factorized(NamedTuple):
+    """Result of key factorization over a frame's padded rows.
+
+    - ``seg``: int32 segment id per padded row; invalid rows carry the
+      out-of-range sentinel ``num_segments`` (dropped by segment ops).
+    - ``num_segments``: STATIC segment-id space size (bin count on the
+      binned path; exact group count on the sort path). Some segments may
+      be empty on the binned path.
+    - ``first_idx``: representative (first valid) row index per segment,
+      shape (num_segments,); garbage where a segment is empty.
+    - ``occupied``: bool (num_segments,) marking non-empty segments, or
+      None when every segment is occupied (sort path).
+    - ``num_groups_dev``: device int32 scalar = true group count (lazy).
+    """
+
+    seg: jnp.ndarray
+    num_segments: int
+    first_idx: jnp.ndarray
+    occupied: Optional[jnp.ndarray]
+    num_groups_dev: Any
+
+
+def factorize_keys(blocks: JaxBlocks, keys: List[str]) -> Factorized:
+    """Factorize `keys` into segment ids. Null keys form their own groups
+    (SQL GROUP BY semantics). Results are cached per frame (repeated ops
+    on the same keys — transform then aggregate — pay once)."""
     cache_key = tuple(keys)
     if cache_key in blocks.factorize_cache:
         return blocks.factorize_cache[cache_key]
-    res = _factorize_keys_impl(blocks, keys)
+    res = _try_bin_factorize(blocks, keys)
+    if res is None:
+        res = _sort_factorize(blocks, keys)
     blocks.factorize_cache[cache_key] = res
     return res
 
 
-def _factorize_keys_impl(
+_MAX_BINS = 1 << 22  # static-binning cap (16MB of int32 per scratch array)
+
+
+def _try_bin_factorize(
     blocks: JaxBlocks, keys: List[str]
-) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
-    binned = _try_bin_factorize(blocks, keys)
-    if binned is not None:
-        return binned
-    valid_rows = row_validity(blocks)
-    # pack each key into an int64 code with null flag
+) -> Optional[Factorized]:
+    """Sort-free, sync-free factorization for integer-like keys with
+    host-known bounds."""
+    spec = bin_spec(blocks, keys)
+    if spec is None:
+        return None
+    seg, first_idx, occupied, num_dev = _bin_core(
+        tuple(blocks.columns[k].data for k in keys),
+        tuple(blocks.columns[k].mask for k in keys),
+        blocks.row_valid,
+        _nrows_scalar_arg(blocks),
+        spec,
+    )
+    return Factorized(seg, spec.total, first_idx, occupied, num_dev)
+
+
+def _nrows_scalar_arg(blocks: JaxBlocks) -> Any:
+    """Known row count as a traced-arg scalar (np, so no eager dispatch);
+    -1 when the frame is mask-layout (programs then use row_valid)."""
+    if blocks._nrows is not None:
+        return np.int32(blocks._nrows)
+    return np.int32(-1)
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def _bin_core(
+    datas: Tuple[jnp.ndarray, ...],
+    masks: Tuple[Optional[jnp.ndarray], ...],
+    valid_rows: Optional[jnp.ndarray],
+    nrows_s: Any,
+    spec: "BinSpec",
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    n = datas[0].shape[0]
+    total = spec.total
+    valid_rows = materialize_validity(valid_rows, n, nrows_s)
+    seg = inline_seg(
+        spec,
+        dict(zip(spec.names, datas)),
+        dict(zip(spec.names, masks)),
+        valid_rows,
+    )
+    pos = jnp.arange(n, dtype=jnp.int32)
+    # first valid row index per bin (n = "empty bin" sentinel)
+    first_pos = jax.ops.segment_min(
+        jnp.where(valid_rows, pos, n), seg, num_segments=total
+    )
+    occupied = first_pos < n
+    first_idx = jnp.clip(first_pos, 0, n - 1)
+    return seg, first_idx, occupied, occupied.sum().astype(jnp.int32)
+
+
+def _sort_factorize(blocks: JaxBlocks, keys: List[str]) -> Factorized:
+    """Lexicographic factorization via repeated stable sorts (general keys:
+    floats, wide ints). One host sync for the group count."""
     codes: List[jnp.ndarray] = []
     for k in keys:
         col = blocks.columns[k]
@@ -63,186 +330,90 @@ def _factorize_keys_impl(
             v = v.astype(jnp.int32)
         if jnp.issubdtype(v.dtype, jnp.floating):
             # normalize -0.0 to +0.0 so both group together (host parity),
-            # then use the bit pattern as a stable grouping identity
+            # then use the bit pattern as a stable grouping identity.
+            # NOTE: 64-bit bitcast-convert is NOT implemented by XLA's TPU
+            # x64 rewriter, so doubles are viewed as (n, 2) uint32 words
+            # and contribute two composite sort keys (advisor r1, high).
             v = jnp.where(v == 0, jnp.zeros_like(v), v)
             if v.dtype == jnp.float64:
-                v = jax.lax.bitcast_convert_type(v, jnp.int64)
+                words = jax.lax.bitcast_convert_type(v, jnp.uint32)
+                pair = [words[:, 0].astype(jnp.int32),
+                        words[:, 1].astype(jnp.int32)]
             else:
-                v = jax.lax.bitcast_convert_type(
-                    v.astype(jnp.float32), jnp.int32
-                ).astype(jnp.int64)
+                pair = [
+                    jax.lax.bitcast_convert_type(
+                        v.astype(jnp.float32), jnp.int32
+                    )
+                ]
+        elif v.dtype in (jnp.int64, jnp.uint64):
+            words = jax.lax.bitcast_convert_type(v, jnp.uint32)
+            pair = [words[:, 0].astype(jnp.int32),
+                    words[:, 1].astype(jnp.int32)]
         else:
-            v = v.astype(jnp.int64)
+            pair = [v.astype(jnp.int32)]
         if col.mask is not None:
             # a separate null-flag key avoids any sentinel collision with
-            # legitimate values: (is_null, value) is the composite key
-            codes.append((~col.mask).astype(jnp.int64))
-            v = jnp.where(col.mask, v, 0)
-        codes.append(v)
-    # lexicographic factorization via repeated stable sorts
-    n = codes[0].shape[0]
-    order = jnp.arange(n)
-    for c in reversed(codes):
-        order = order[jnp.argsort(c[order], stable=True)]
-    # after composite sort, detect boundaries
-    sorted_cols = [c[order] for c in codes]
-    boundary = jnp.zeros((n,), dtype=jnp.bool_)
-    for c in sorted_cols:
-        boundary = boundary | jnp.concatenate(
-            [jnp.ones((1,), dtype=jnp.bool_), c[1:] != c[:-1]]
-        )
-    # padding rows: force to the end by sorting validity first is not done;
-    # instead mark them as their own trailing group and drop later
-    sorted_valid = valid_rows[order]
-    seg_sorted = jnp.cumsum(boundary) - 1
-    # segment ids in original row order
-    seg = jnp.zeros((n,), dtype=jnp.int64).at[order].set(seg_sorted)
-    num_segments = int(seg_sorted[-1]) + 1 if n > 0 else 0
-    # representative row per group: first VALID occurrence in sorted order
-    # (deterministic segment_min; padding rows must never represent a group)
-    pos = jnp.arange(n)
-    first_valid_pos = jax.ops.segment_min(
-        jnp.where(sorted_valid, pos, n), seg_sorted, num_segments=num_segments
+            # legitimate values: (is_null, value...) is the composite key
+            codes.append((~col.mask).astype(jnp.int32))
+            pair = [jnp.where(col.mask, p, 0) for p in pair]
+        codes.extend(pair)
+    seg_sorted, order, valid_rows, num_arr = _sort_factorize_core(
+        tuple(codes), blocks.row_valid, _nrows_scalar_arg(blocks)
     )
-    group_has_valid = first_valid_pos < n
-    first_idx = order[jnp.clip(first_valid_pos, 0, n - 1)]
-    keep = group_has_valid
-    # remap segment ids to the kept groups
-    new_ids = jnp.cumsum(keep.astype(jnp.int64)) - 1
-    seg = new_ids[seg]
-    kept_first = first_idx[keep]
-    return seg, kept_first, int(keep.sum())
-
-
-_MAX_BINS = 1 << 22  # direct-binning cap (16MB of int32 per scratch array)
-
-
-def _try_bin_factorize(
-    blocks: JaxBlocks, keys: List[str]
-) -> Optional[Tuple[jnp.ndarray, jnp.ndarray, int]]:
-    """Sort-free factorization for small-range integer-like keys.
-
-    Dispatch-frugal (the TPU may be network-tunneled, so every eager op is a
-    round trip): ONE jitted min/max pass + ONE host sync for spans, ONE
-    jitted binning program + ONE sync for the group count, ONE jitted gather.
-    """
-    datas: List[jnp.ndarray] = []
-    masks: List[Optional[jnp.ndarray]] = []
-    for k in keys:
-        col = blocks.columns[k]
-        if not col.on_device:
-            return None
-        if jnp.issubdtype(col.data.dtype, jnp.floating):
-            return None
-        datas.append(col.data)
-        masks.append(col.mask)
-    # one fused min/max for all keys -> single host transfer
-    bounds = np.asarray(_minmax_jit(tuple(datas)))
-    spans: List[int] = []
-    for i in range(len(datas)):
-        span = int(bounds[i, 1]) - int(bounds[i, 0]) + 1
-        if span <= 0 or span > _MAX_BINS:
-            return None
-        if masks[i] is not None:
-            span += 1  # null bucket
-        spans.append(span)
-    total = 1
-    for r in spans:
-        total *= r
-        if total > _MAX_BINS:
-            return None
-    mins = tuple(int(bounds[i, 0]) for i in range(len(datas)))
-    seg, first_pos, occupied, num_arr = _bin_core(
-        tuple(datas),
-        tuple(masks),
-        mins,
-        tuple(spans),
-        blocks.nrows,
-        total,
+    num = int(num_arr)  # host sync (general path only)
+    seg, first_idx = _sort_factorize_finish(
+        seg_sorted, order, valid_rows, num
     )
-    num = int(num_arr)
-    first_idx = _gather_occupied(first_pos, occupied, num)
-    return seg, first_idx, num
+    return Factorized(seg, num, first_idx, None, jnp.int32(num))
 
 
 @jax.jit
-def _minmax_jit(datas: Tuple[jnp.ndarray, ...]) -> jnp.ndarray:
-    return jnp.stack(
-        [
-            jnp.stack([jnp.min(d).astype(jnp.int64), jnp.max(d).astype(jnp.int64)])
-            for d in datas
-        ]
-    )
-
-
-@partial(jax.jit, static_argnames=("mins", "spans", "nrows", "total"))
-def _bin_core(
-    datas: Tuple[jnp.ndarray, ...],
-    masks: Tuple[Optional[jnp.ndarray], ...],
-    mins: Tuple[int, ...],
-    spans: Tuple[int, ...],
-    nrows: int,
-    total: int,
+def _sort_factorize_core(
+    codes: Tuple[jnp.ndarray, ...],
+    valid_in: Optional[jnp.ndarray],
+    nrows_s: Any,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    # int32 throughout: int64 is EMULATED on TPU (~10x slower); bin codes
-    # fit int32 by construction (total <= _MAX_BINS) and row positions fit
-    # int32 up to 2B rows per frame
-    n = datas[0].shape[0]
-    valid_rows = jnp.arange(n, dtype=jnp.int32) < nrows
-    # mixed-radix combine (single fused program; XLA auto-partitions)
-    combined = jnp.zeros((n,), dtype=jnp.int32)
-    for d, mask, kmin, span in zip(datas, masks, mins, spans):
-        code = (d - kmin).astype(jnp.int32)
-        if mask is not None:
-            code = jnp.where(mask, code, span - 1)  # null -> top bucket
-        combined = combined * jnp.int32(span) + code
-    pos = jnp.arange(n, dtype=jnp.int32)
-    # first valid row index per bin (n = "no valid row" sentinel)
-    first_pos = jax.ops.segment_min(
-        jnp.where(valid_rows, pos, n), combined, num_segments=total
-    )
-    occupied = first_pos < n
-    # dense remap of occupied bins; group output order is unspecified,
-    # like any SQL engine
-    dense_ids = jnp.cumsum(occupied.astype(jnp.int32)) - 1
-    seg = dense_ids[combined]
-    return seg, first_pos, occupied, occupied.sum()
+    n = codes[0].shape[0]
+    valid_rows = materialize_validity(valid_in, n, nrows_s)
+    order = jnp.arange(n, dtype=jnp.int32)
+    for c in reversed(codes):
+        order = order[jnp.argsort(c[order], stable=True)]
+    # validity as the final primary key (stable: preserves code order);
+    # invalid rows sort last
+    order = order[jnp.argsort(~valid_rows[order], stable=True)]
+    sorted_valid = valid_rows[order]
+    boundary = jnp.zeros((n,), dtype=jnp.bool_)
+    for c in codes:
+        sc = c[order]
+        boundary = boundary | jnp.concatenate(
+            [jnp.ones((1,), dtype=jnp.bool_), sc[1:] != sc[:-1]]
+        )
+    # only valid rows open groups; invalid rows (all trailing) get sentinel
+    boundary = boundary & sorted_valid
+    seg_sorted = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    num = jnp.max(jnp.where(sorted_valid, seg_sorted, -1)) + 1
+    return seg_sorted, order, valid_rows, num
 
 
 @partial(jax.jit, static_argnames=("num",))
-def _gather_occupied(
-    first_pos: jnp.ndarray, occupied: jnp.ndarray, num: int
-) -> jnp.ndarray:
-    idx = jnp.nonzero(occupied, size=num, fill_value=0)[0]
-    return first_pos[idx]
-
-
-@partial(jax.jit, static_argnames=("func", "num_segments", "has_mask"))
-def _segment_agg_jit(
-    func: str,
-    values: jnp.ndarray,
-    mask: Optional[jnp.ndarray],
-    seg: jnp.ndarray,
-    num_segments: int,
+def _sort_factorize_finish(
+    seg_sorted: jnp.ndarray,
+    order: jnp.ndarray,
     valid_rows: jnp.ndarray,
-    has_mask: bool,
-) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
-    return _segment_agg_impl(func, values, mask, seg, num_segments, valid_rows)
-
-
-def segment_agg(
-    func: str,
-    values: jnp.ndarray,
-    mask: Optional[jnp.ndarray],
-    seg: jnp.ndarray,
-    num_segments: int,
-    valid_rows: jnp.ndarray,
-) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
-    """One aggregation as a jit-compiled segment reduction; returns
-    (values[G], mask[G])."""
-    return _segment_agg_jit(
-        func, values, mask, seg, num_segments, valid_rows, mask is not None
+    num: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    n = order.shape[0]
+    sorted_valid = valid_rows[order]
+    seg_sorted = jnp.where(sorted_valid, seg_sorted, num)
+    seg = (
+        jnp.zeros((n,), dtype=jnp.int32).at[order].set(seg_sorted)
     )
+    pos = jnp.arange(n, dtype=jnp.int32)
+    first_pos = jax.ops.segment_min(
+        jnp.where(sorted_valid, pos, n), seg_sorted, num_segments=num
+    )
+    first_idx = order[jnp.clip(first_pos, 0, n - 1)]
+    return seg, first_idx
 
 
 def _segment_agg_impl(
@@ -253,6 +424,8 @@ def _segment_agg_impl(
     num_segments: int,
     valid_rows: jnp.ndarray,
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """One aggregation as a segment reduction (trace-time building block);
+    returns (values[num_segments], mask[num_segments])."""
     effective = valid_rows if mask is None else (mask & valid_rows)
     # int32 accumulation: int64 is emulated on TPU; counts fit int32 (<2B
     # rows); callers cast the output to the schema type
