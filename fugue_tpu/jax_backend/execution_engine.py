@@ -251,8 +251,25 @@ class JaxMapEngine(MapEngine):
             stats = dictionary = None
             if src_name is not None and src_name in blocks.columns:
                 src = blocks.columns[src_name]
-                stats = src.stats
-                dictionary = src.dictionary
+                # jaxpr identity alone is not enough: a dict-encoded string
+                # column's codes passed through to a non-string output field
+                # must NOT carry the dictionary (to_arrow would decode codes
+                # into the wrong type); stats only describe integer-like
+                # value bounds (advisor r2, low)
+                if src.dictionary is not None and (
+                    pa.types.is_string(f.type)
+                    or pa.types.is_large_string(f.type)
+                ):
+                    dictionary = src.dictionary
+                if (
+                    pa.types.is_integer(f.type)
+                    or pa.types.is_boolean(f.type)
+                    or pa.types.is_timestamp(f.type)
+                    or pa.types.is_date32(f.type)
+                ):
+                    # any type whose device representation is integer-like
+                    # keeps its (min,max) bounds — matches ingest's stats
+                    stats = src.stats
             cols[f.name] = JaxColumn(
                 f.type,
                 jax.device_put(data, sharding),
@@ -920,9 +937,11 @@ class JaxExecutionEngine(ExecutionEngine):
             if not expr_eval.can_eval_on_device(arg, blocks):
                 return None
             plans.append((c.output_name, c.func.lower(), arg, c))
-        if blocks.nrows_known and blocks.nrows == 0:
-            # known-empty input: host path handles schema/empty conventions
-            return None
+        # known-empty inputs stay on the device path too: padded_len(0)=ndev
+        # keeps arrays non-empty, all rows invalid, so keyed aggregates give
+        # 0 groups and global ones count=0/NULL — the SAME conventions a
+        # lazily-empty masked frame gets (advisor r2, low: the two paths
+        # must not diverge based on whether the count happens to be known)
         pad_n = blocks.padded_nrows
         # resolve output types up front (needed inside the traced program)
         typed_plans = []

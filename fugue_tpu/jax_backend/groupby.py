@@ -164,6 +164,10 @@ def decode_bin_keys(
 # TPU for small segment counts (scatter serializes; the MXU does not)
 _MATMUL_MAX_SEGMENTS = 8192
 _MATMUL_CHUNK = 1 << 17
+# cap on chunk*num_segments: the (chunk, num_segments) one-hot is the
+# scan-step transient; 2^26 elements = 256MB f32 (1/2 that in bf16), safe
+# on 16GB parts even if XLA fails to fuse it into the matmul (advisor r2)
+_MATMUL_ONEHOT_BUDGET = 1 << 26
 
 
 def matmul_segment_sums(
@@ -178,7 +182,11 @@ def matmul_segment_sums(
     are exact below the chunk size). ``seg`` values >= num_segments
     contribute nothing (their one-hot row is all zeros)."""
     n = seg.shape[0]
-    ch = min(_MATMUL_CHUNK, n)
+    ch = min(
+        _MATMUL_CHUNK,
+        max(256, _MATMUL_ONEHOT_BUDGET // max(1, num_segments)),
+        n,
+    )
     pad = (-n) % ch
     # accumulate in the widest float dtype present (f64 stays f64 for CPU
     # fidelity; pure-f32 TPU pipelines ride the fast path); count partials
@@ -329,22 +337,19 @@ def _sort_factorize(blocks: JaxBlocks, keys: List[str]) -> Factorized:
         if v.dtype == jnp.bool_:
             v = v.astype(jnp.int32)
         if jnp.issubdtype(v.dtype, jnp.floating):
-            # normalize -0.0 to +0.0 so both group together (host parity),
-            # then use the bit pattern as a stable grouping identity.
-            # NOTE: 64-bit bitcast-convert is NOT implemented by XLA's TPU
-            # x64 rewriter, so doubles are viewed as (n, 2) uint32 words
-            # and contribute two composite sort keys (advisor r1, high).
+            # Floats are their OWN sort codes: argsort orders them and the
+            # equality-based boundary detection below works once the two
+            # identity-hostile values are canonicalized — -0.0 -> +0.0
+            # (groups with +0.0, host parity) and NaN -> 0.0 with a
+            # separate isnan flag code (NaN != NaN would otherwise split
+            # every NaN row into its own group). No bitcast anywhere: any
+            # 64-bit bitcast-convert operand trips XLA's TPU x64 rewriter
+            # (INTERNAL: bitcast-convert not implemented) regardless of
+            # the target word shape (advisor r2, high).
+            isnan = jnp.isnan(v)
             v = jnp.where(v == 0, jnp.zeros_like(v), v)
-            if v.dtype == jnp.float64:
-                words = jax.lax.bitcast_convert_type(v, jnp.uint32)
-                pair = [words[:, 0].astype(jnp.int32),
-                        words[:, 1].astype(jnp.int32)]
-            else:
-                pair = [
-                    jax.lax.bitcast_convert_type(
-                        v.astype(jnp.float32), jnp.int32
-                    )
-                ]
+            v = jnp.where(isnan, jnp.zeros_like(v), v)
+            pair = [isnan.astype(jnp.int32), v]
         elif v.dtype in (jnp.int64, jnp.uint64):
             words = jax.lax.bitcast_convert_type(v, jnp.uint32)
             pair = [words[:, 0].astype(jnp.int32),

@@ -48,7 +48,8 @@ def transform(
     result = dag.yields["result"].result  # type: ignore
     if as_fugue or isinstance(df, (DataFrame, Yielded)):
         return result
-    return result.native if result.is_local else get_native_as_df(result)
+    # local results surface as pandas — reference fugue/workflow/api.py:184
+    return result.as_pandas() if result.is_local else get_native_as_df(result)
 
 
 def out_transform(
