@@ -1,14 +1,9 @@
-"""SQL SELECT executor over DataFrames (qpd/duckdb replacement).
-
-Wired up by fugue_tpu.sql_frontend.parser; this placeholder raises until the
-parser module lands (SURVEY §7 step 9)."""
-
-from typing import Any
+"""SQL SELECT executor over DataFrames — the qpd/duckdb role for the native
+engine (reference fugue/execution/native_execution_engine.py:41-65)."""
 
 from fugue_tpu.dataframe import DataFrame, DataFrames
+from fugue_tpu.sql_frontend.select_runner import run_select
 
 
 def run_sql_on_dataframes(sql: str, dfs: DataFrames) -> DataFrame:
-    from fugue_tpu.sql_frontend.select_runner import run_select
-
     return run_select(sql, dfs)

@@ -1,0 +1,1239 @@
+"""SELECT executor over DataFrames on pandas — the role qpd plays for the
+reference's native engine (reference fugue/execution/native_execution_engine.py:41-65)
+and duckdb plays for its SQL backends.
+
+Executes the AST from :mod:`fugue_tpu.sql_frontend.parser` with SQL
+semantics: three-valued logic, null-ignoring aggregates, null keys never
+joining, null-safe set operations.
+"""
+
+import re
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+from fugue_tpu.dataframe import DataFrame, DataFrames
+from fugue_tpu.dataframe.arrow_dataframe import ArrowDataFrame
+from fugue_tpu.dataframe.dataframe import LocalBoundedDataFrame
+from fugue_tpu.schema import Schema
+from fugue_tpu.sql_frontend import ast
+from fugue_tpu.sql_frontend.parser import parse_select
+
+__all__ = ["run_select", "run_query", "SQLExecutionError"]
+
+
+class SQLExecutionError(ValueError):
+    pass
+
+
+def run_select(sql: str, dfs: DataFrames) -> LocalBoundedDataFrame:
+    """Parse and execute ``sql`` against the named dataframes in ``dfs``."""
+    return run_query(parse_select(sql), dfs)
+
+
+def run_query(query: ast.Query, dfs: DataFrames) -> LocalBoundedDataFrame:
+    env: Dict[str, "_Table"] = {}
+    for name, df in dfs.items():
+        env[name.lower()] = _Table.from_fugue(df)
+    res = _run(query, env)
+    return res.to_fugue()
+
+
+# ---- typed columnar intermediates ---------------------------------------
+
+
+class _TS(NamedTuple):
+    """A typed series: values aligned to the current scope index + the
+    arrow output type (None = not yet determined)."""
+
+    series: pd.Series
+    dtype: Optional[pa.DataType]
+
+
+class _Table:
+    """An executed relation: pandas frame with output names + arrow types."""
+
+    def __init__(self, frame: pd.DataFrame, names: List[str],
+                 types: List[Optional[pa.DataType]]):
+        self.frame = frame
+        self.names = names
+        self.types = types
+
+    @staticmethod
+    def from_fugue(df: DataFrame) -> "_Table":
+        pdf = df.as_pandas().reset_index(drop=True)
+        schema = df.schema
+        pdf.columns = list(range(len(schema)))
+        return _Table(pdf, list(schema.names), list(schema.types))
+
+    def to_fugue(self) -> LocalBoundedDataFrame:
+        arrays: List[pa.Array] = []
+        fields: List[pa.Field] = []
+        for i, (name, tp) in enumerate(zip(self.names, self.types)):
+            s = self.frame.iloc[:, i] if self.frame.shape[1] > i else \
+                pd.Series([], dtype=object)
+            arr = _series_to_arrow(s, tp)
+            arrays.append(arr)
+            fields.append(pa.field(name, arr.type))
+        table = pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+        return ArrowDataFrame(table)
+
+
+def _series_to_arrow(s: pd.Series, tp: Optional[pa.DataType]) -> pa.Array:
+    target = tp if tp is not None and not pa.types.is_null(tp) else None
+    try:
+        if target is not None:
+            return pa.Array.from_pandas(s, type=target)
+        arr = pa.Array.from_pandas(s)
+        if pa.types.is_null(arr.type):
+            return arr.cast(pa.string())
+        return arr
+    except (pa.ArrowInvalid, pa.ArrowTypeError, pa.ArrowNotImplementedError):
+        arr = pa.Array.from_pandas(s.astype(object).where(s.notna(), None))
+        if target is not None:
+            return arr.cast(target)
+        return arr
+
+
+# ---- scopes -------------------------------------------------------------
+
+
+class _Entry(NamedTuple):
+    qual: Optional[str]  # lower-cased table alias/name
+    name: str
+    label: Any  # column label in the scope frame
+    dtype: Optional[pa.DataType]
+
+
+class _Scope:
+    def __init__(self, frame: pd.DataFrame, entries: List[_Entry]):
+        self.frame = frame
+        self.entries = entries
+
+    @staticmethod
+    def from_table(t: _Table, qual: Optional[str]) -> "_Scope":
+        frame = t.frame.copy(deep=False)
+        labels = [f"c{i}" for i in range(len(t.names))]
+        frame.columns = labels
+        q = qual.lower() if qual is not None else None
+        entries = [
+            _Entry(q, n, lb, tp)
+            for n, lb, tp in zip(t.names, labels, t.types)
+        ]
+        return _Scope(frame, entries)
+
+    def resolve(self, name: str, qual: Optional[str]) -> _Entry:
+        q = qual.lower() if qual is not None else None
+        cands = [
+            e for e in self.entries
+            if e.name == name and (q is None or e.qual == q)
+        ]
+        if len(cands) == 0:  # case-insensitive fallback
+            low = name.lower()
+            cands = [
+                e for e in self.entries
+                if e.name.lower() == low and (q is None or e.qual == q)
+            ]
+        if len(cands) == 0:
+            raise SQLExecutionError(f"column not found: {_qname(name, qual)}")
+        if len(cands) > 1:
+            raise SQLExecutionError(f"ambiguous column: {_qname(name, qual)}")
+        return cands[0]
+
+    def star_entries(self, qual: Optional[str]) -> List[_Entry]:
+        if qual is None:
+            return list(self.entries)
+        q = qual.lower()
+        out = [e for e in self.entries if e.qual == q]
+        if len(out) == 0:
+            raise SQLExecutionError(f"unknown table {qual!r} in wildcard")
+        return out
+
+
+def _qname(name: str, qual: Optional[str]) -> str:
+    return name if qual is None else f"{qual}.{name}"
+
+
+# ---- query execution ----------------------------------------------------
+
+
+def _run(query: ast.Query, env: Dict[str, _Table]) -> _Table:
+    if isinstance(query, ast.With):
+        scoped = dict(env)
+        for name, sub in query.ctes:
+            scoped[name.lower()] = _run(sub, scoped)
+        return _run(query.body, scoped)
+    if isinstance(query, ast.SetOp):
+        return _run_setop(query, env)
+    if isinstance(query, ast.Select):
+        return _run_select(query, env)
+    raise SQLExecutionError(f"unsupported query node {type(query).__name__}")
+
+
+def _lookup_table(name: str, env: Dict[str, _Table]) -> _Table:
+    t = env.get(name.lower())
+    if t is None:
+        raise SQLExecutionError(f"table not found: {name}")
+    return t
+
+
+def _build_scope(rel: ast.Relation, env: Dict[str, _Table]) -> _Scope:
+    if isinstance(rel, ast.TableRef):
+        t = _lookup_table(rel.name, env)
+        return _Scope.from_table(t, rel.alias or rel.name)
+    if isinstance(rel, ast.SubqueryRef):
+        return _Scope.from_table(_run(rel.query, env), rel.alias)
+    if isinstance(rel, ast.JoinRel):
+        left = _build_scope(rel.left, env)
+        right = _build_scope(rel.right, env)
+        return _join_scopes(left, right, rel)
+    raise SQLExecutionError(f"unsupported relation {type(rel).__name__}")
+
+
+def _relabel(scope: _Scope, prefix: str) -> _Scope:
+    mapping = {e.label: f"{prefix}{e.label}" for e in scope.entries}
+    frame = scope.frame.rename(columns=mapping)
+    entries = [e._replace(label=mapping[e.label]) for e in scope.entries]
+    return _Scope(frame, entries)
+
+
+def _join_scopes(left: _Scope, right: _Scope, rel: ast.JoinRel) -> _Scope:
+    left = _relabel(left, "l_")
+    right = _relabel(right, "r_")
+    how = rel.how
+    if how == "cross":
+        frame = left.frame.merge(right.frame, how="cross")
+        return _Scope(frame, left.entries + right.entries)
+    # extract equi-join key expressions
+    pairs: List[Tuple[_TS, _TS]] = []
+    residual: Optional[ast.Expr] = None
+    coalesce_pairs: List[Tuple[Any, Any]] = []  # (left label, right label)
+    hidden_right: List[Any] = []
+    if rel.using is not None:
+        for name in rel.using:
+            le = left.resolve(name, None)
+            re_ = right.resolve(name, None)
+            pairs.append((
+                _TS(left.frame[le.label], le.dtype),
+                _TS(right.frame[re_.label], re_.dtype),
+            ))
+            coalesce_pairs.append((le.label, re_.label))
+            hidden_right.append(re_.label)
+    elif rel.on is not None:
+        conj = _split_conjunction(rel.on)
+        ev_l, ev_r = _Evaluator(left), _Evaluator(right)
+        for c in conj:
+            sides = _equi_sides(c, ev_l, ev_r)
+            if sides is None:
+                residual = c if residual is None else \
+                    ast.Binary("AND", residual, c)
+            else:
+                pairs.append(sides)
+        if len(pairs) == 0:
+            if how != "inner":
+                raise SQLExecutionError(
+                    f"{how} join requires at least one equi-join condition"
+                )
+            frame = left.frame.merge(right.frame, how="cross")
+            scope = _Scope(frame, left.entries + right.entries)
+            if rel.on is not None:
+                mask = _to_bool_mask(_Evaluator(scope).eval(rel.on).series)
+                scope = _Scope(scope.frame[mask], scope.entries)
+            return scope
+    else:
+        raise SQLExecutionError("join requires ON or USING")
+    lf = left.frame.copy(deep=False)
+    rf = right.frame.copy(deep=False)
+    keys = []
+    for i, (lts, rts) in enumerate(pairs):
+        k = f"_jk{i}"
+        lf[k] = lts.series
+        rf[k] = rts.series
+        keys.append(k)
+    from fugue_tpu.execution.native_execution_engine import _pandas_join
+
+    how_map = {
+        "inner": "inner", "left_outer": "leftouter",
+        "right_outer": "rightouter", "full_outer": "fullouter",
+        "semi": "semi", "anti": "anti",
+    }
+    joined = _pandas_join(lf, rf, how_map[how], keys)
+    entries = list(left.entries)
+    if how in ("semi", "anti"):
+        joined = joined[[e.label for e in left.entries]]
+    else:
+        for ll, rl in coalesce_pairs:
+            # USING: expose one coalesced key column under the left label
+            if how in ("right_outer", "full_outer"):
+                joined[ll] = joined[ll].combine_first(joined[rl])
+        entries = entries + [
+            e for e in right.entries if e.label not in hidden_right
+        ]
+        joined = joined[[e.label for e in entries]]
+    scope = _Scope(joined.reset_index(drop=True), entries)
+    if residual is not None:
+        mask = _to_bool_mask(_Evaluator(scope).eval(residual).series)
+        scope = _Scope(scope.frame[mask].reset_index(drop=True), scope.entries)
+    return scope
+
+
+def _split_conjunction(e: ast.Expr) -> List[ast.Expr]:
+    if isinstance(e, ast.Binary) and e.op == "AND":
+        return _split_conjunction(e.left) + _split_conjunction(e.right)
+    return [e]
+
+
+def _equi_sides(
+    e: ast.Expr, ev_l: "_Evaluator", ev_r: "_Evaluator"
+) -> Optional[Tuple[_TS, _TS]]:
+    """If ``e`` is ``left_expr = right_expr`` (each side evaluable on one
+    scope), evaluate both; else None."""
+    if not (isinstance(e, ast.Binary) and e.op == "="):
+        return None
+    for a, b in ((e.left, e.right), (e.right, e.left)):
+        try:
+            lts = ev_l.eval(a)
+        except SQLExecutionError:
+            continue
+        try:
+            rts = ev_r.eval(b)
+        except SQLExecutionError:
+            continue
+        return lts, rts
+    return None
+
+
+def _to_bool_mask(s: pd.Series) -> np.ndarray:
+    return s.astype("boolean").fillna(False).to_numpy(dtype=bool)
+
+
+# ---- expression evaluation ----------------------------------------------
+
+_NUMERIC = (pa.int64(), pa.float64())
+
+
+def _is_float(tp: Optional[pa.DataType]) -> bool:
+    return tp is not None and pa.types.is_floating(tp)
+
+
+def _arith_type(
+    op: str, lt: Optional[pa.DataType], rt: Optional[pa.DataType]
+) -> pa.DataType:
+    if op == "/":
+        return pa.float64()
+    if _is_float(lt) or _is_float(rt):
+        return pa.float64()
+    if lt is not None and rt is not None and \
+            pa.types.is_integer(lt) and pa.types.is_integer(rt):
+        return pa.int64()
+    return pa.float64()
+
+
+class _Evaluator:
+    """Evaluates expressions over a scope with SQL null semantics."""
+
+    def __init__(self, scope: _Scope, allow_agg: bool = False):
+        self.scope = scope
+        self.allow_agg = allow_agg
+
+    @property
+    def index(self) -> pd.Index:
+        return self.scope.frame.index
+
+    def const(self, value: Any, dtype: Optional[pa.DataType]) -> _TS:
+        return _TS(pd.Series([value] * len(self.index), index=self.index,
+                             dtype=object if value is None else None),
+                   dtype)
+
+    def eval(self, e: ast.Expr) -> _TS:
+        if isinstance(e, ast.Lit):
+            v = e.value
+            if v is None:
+                return self.const(None, None)
+            if isinstance(v, bool):
+                return self.const(v, pa.bool_())
+            if isinstance(v, int):
+                return self.const(v, pa.int64())
+            if isinstance(v, float):
+                return self.const(v, pa.float64())
+            return self.const(v, pa.string())
+        if isinstance(e, ast.Col):
+            entry = self.scope.resolve(e.name, e.table)
+            return _TS(self.scope.frame[entry.label], entry.dtype)
+        if isinstance(e, ast.Unary):
+            return self._unary(e)
+        if isinstance(e, ast.Binary):
+            return self._binary(e)
+        if isinstance(e, ast.IsNull):
+            ts = self.eval(e.operand)
+            res = ts.series.isna()
+            if e.negated:
+                res = ~res
+            return _TS(res.astype("boolean"), pa.bool_())
+        if isinstance(e, ast.InList):
+            return self._in_list(e)
+        if isinstance(e, ast.Between):
+            low = ast.Binary("<=", e.operand, e.high)
+            high = ast.Binary(">=", e.operand, e.low)
+            combined: ast.Expr = ast.Binary("AND", high, low)
+            if e.negated:
+                combined = ast.Unary("NOT", combined)
+            return self.eval(combined)
+        if isinstance(e, ast.Like):
+            return self._like(e)
+        if isinstance(e, ast.Case):
+            return self._case(e)
+        if isinstance(e, ast.Cast):
+            return self._cast(e)
+        if isinstance(e, ast.Func):
+            return self._func(e)
+        if isinstance(e, ast.Star):
+            raise SQLExecutionError("wildcard not allowed in this context")
+        raise SQLExecutionError(f"unsupported expression {type(e).__name__}")
+
+    def _unary(self, e: ast.Unary) -> _TS:
+        ts = self.eval(e.operand)
+        if e.op == "NOT":
+            return _TS(~ts.series.astype("boolean"), pa.bool_())
+        if e.op == "-":
+            return _TS(-pd.to_numeric(ts.series), ts.dtype or pa.float64())
+        return ts  # unary +
+
+    def _binary(self, e: ast.Binary) -> _TS:
+        op = e.op
+        if op in ("AND", "OR"):
+            lb = self.eval(e.left).series.astype("boolean")
+            rb = self.eval(e.right).series.astype("boolean")
+            return _TS(lb & rb if op == "AND" else lb | rb, pa.bool_())
+        lts = self.eval(e.left)
+        rts = self.eval(e.right)
+        if op in ("=", "<>", "<", "<=", ">", ">="):
+            return self._compare(op, lts, rts)
+        if op == "||":
+            ls = lts.series.astype(object)
+            rs = rts.series.astype(object)
+            nulls = ls.isna() | rs.isna()
+            res = ls.where(nulls, ls.astype(str) + rs.astype(str))
+            res[nulls] = None
+            return _TS(res, pa.string())
+        left, right = lts.series, rts.series
+        if op == "+":
+            res = left + right
+        elif op == "-":
+            res = left - right
+        elif op == "*":
+            res = left * right
+        elif op == "/":
+            res = pd.to_numeric(left, errors="coerce").astype("float64") / \
+                pd.to_numeric(right, errors="coerce")
+        elif op == "%":
+            res = pd.to_numeric(left) % pd.to_numeric(right)
+        else:
+            raise SQLExecutionError(f"unsupported operator {op}")
+        return _TS(res, _arith_type(op, lts.dtype, rts.dtype))
+
+    def _compare(self, op: str, lts: _TS, rts: _TS) -> _TS:
+        left, right = lts.series, rts.series
+        nulls = left.isna() | right.isna()
+        func: Dict[str, Callable[[Any, Any], Any]] = {
+            "=": lambda a, b: a == b,
+            "<>": lambda a, b: a != b,
+            "<": lambda a, b: a < b,
+            "<=": lambda a, b: a <= b,
+            ">": lambda a, b: a > b,
+            ">=": lambda a, b: a >= b,
+        }
+        with np.errstate(invalid="ignore"):
+            res = func[op](left, right)
+        res = pd.Series(res, index=left.index).astype("boolean")
+        res[nulls.to_numpy(dtype=bool)] = pd.NA
+        return _TS(res, pa.bool_())
+
+    def _in_list(self, e: ast.InList) -> _TS:
+        ts = self.eval(e.operand)
+        values = []
+        for item in e.items:
+            if not isinstance(item, ast.Lit):
+                raise SQLExecutionError("IN list items must be literals")
+            values.append(item.value)
+        res = ts.series.isin([v for v in values if v is not None])
+        res = res.astype("boolean")
+        if e.negated:
+            res = ~res
+        res[ts.series.isna().to_numpy(dtype=bool)] = pd.NA
+        return _TS(res, pa.bool_())
+
+    def _like(self, e: ast.Like) -> _TS:
+        ts = self.eval(e.operand)
+        pat = self.eval(e.pattern)
+        if not isinstance(e.pattern, ast.Lit):
+            raise SQLExecutionError("LIKE pattern must be a literal")
+        regex = _like_to_regex(str(e.pattern.value))
+        s = ts.series.astype(object)
+        nulls = s.isna()
+        matched = s.where(nulls, s.astype(str).str.match(regex, na=False))
+        res = matched.astype("boolean")
+        if e.negated:
+            res = ~res
+        res[nulls.to_numpy(dtype=bool)] = pd.NA
+        del pat
+        return _TS(res, pa.bool_())
+
+    def _case(self, e: ast.Case) -> _TS:
+        whens = e.whens
+        if e.operand is not None:
+            whens = [
+                (ast.Binary("=", e.operand, cond), val) for cond, val in whens
+            ]
+        default_ts = self.eval(e.default) if e.default is not None else \
+            self.const(None, None)
+        res = default_ts.series.astype(object)
+        dtype = default_ts.dtype
+        decided = pd.Series(False, index=self.index)
+        for cond, val in whens:
+            mask = _to_bool_mask(self.eval(cond).series) & ~decided.to_numpy()
+            vts = self.eval(val)
+            res = res.where(~mask, vts.series.astype(object))
+            decided = decided | mask
+            if dtype is None:
+                dtype = vts.dtype
+            elif vts.dtype is not None and not dtype.equals(vts.dtype):
+                dtype = _arith_type("+", dtype, vts.dtype) \
+                    if pa.types.is_integer(dtype) or pa.types.is_floating(dtype) \
+                    else dtype
+        return _TS(res, dtype)
+
+    def _cast(self, e: ast.Cast) -> _TS:
+        ts = self.eval(e.operand)
+        tp = _SQL_TYPES.get(e.type_name)
+        if tp is None:
+            raise SQLExecutionError(f"unknown type {e.type_name}")
+        s = ts.series
+        try:
+            if pa.types.is_integer(tp):
+                num = pd.to_numeric(s, errors="raise")
+                s = pd.Series(num, index=s.index).astype("Int64")
+            elif pa.types.is_floating(tp):
+                s = pd.to_numeric(s, errors="raise").astype("float64")
+            elif pa.types.is_boolean(tp):
+                s = s.map(_to_bool_scalar).astype("boolean")
+            elif pa.types.is_string(tp):
+                nulls = s.isna()
+                s = s.astype(object)
+                s = s.where(nulls, s.map(_to_str_scalar))
+                s[nulls] = None
+        except (ValueError, TypeError) as ex:
+            raise SQLExecutionError(f"cast failed: {ex}") from ex
+        return _TS(s, tp)
+
+    def _func(self, e: ast.Func) -> _TS:
+        name = e.name
+        if name in _AGG_FUNCS:
+            raise SQLExecutionError(
+                f"aggregation {name} not allowed in this context"
+            )
+        impl = _SCALAR_FUNCS.get(name)
+        if impl is None:
+            raise SQLExecutionError(f"unsupported function {name}")
+        args = [self.eval(a) for a in e.args]
+        return impl(self, args)
+
+
+def _to_bool_scalar(v: Any) -> Any:
+    if v is None or (isinstance(v, float) and np.isnan(v)):
+        return None
+    if isinstance(v, str):
+        return v.strip().lower() in ("true", "1", "t", "yes")
+    return bool(v)
+
+
+def _to_str_scalar(v: Any) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float) and v.is_integer():
+        return str(v)
+    return str(v)
+
+
+def _like_to_regex(pattern: str) -> str:
+    out = []
+    for ch in pattern:
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+    return "^" + "".join(out) + "$"
+
+
+_SQL_TYPES: Dict[str, pa.DataType] = {
+    "int": pa.int32(), "integer": pa.int32(), "tinyint": pa.int8(),
+    "smallint": pa.int16(), "bigint": pa.int64(), "long": pa.int64(),
+    "float": pa.float32(), "real": pa.float32(),
+    "double": pa.float64(), "decimal": pa.float64(), "numeric": pa.float64(),
+    "string": pa.string(), "varchar": pa.string(), "char": pa.string(),
+    "text": pa.string(),
+    "boolean": pa.bool_(), "bool": pa.bool_(),
+    "date": pa.date32(), "timestamp": pa.timestamp("us"),
+    "datetime": pa.timestamp("us"),
+    "binary": pa.binary(), "bytes": pa.binary(),
+}
+
+
+# ---- scalar function registry -------------------------------------------
+
+
+def _fn_coalesce(ev: _Evaluator, args: List[_TS]) -> _TS:
+    res = args[0].series
+    dtype = args[0].dtype
+    for a in args[1:]:
+        res = res.combine_first(a.series)
+        dtype = dtype or a.dtype
+    return _TS(res, dtype)
+
+
+def _fn_nullif(ev: _Evaluator, args: List[_TS]) -> _TS:
+    a, b = args
+    eq = _to_bool_mask(ev._compare("=", a, b).series)
+    res = a.series.astype(object).where(~eq, None)
+    return _TS(res, a.dtype)
+
+
+def _fn_if(ev: _Evaluator, args: List[_TS]) -> _TS:
+    cond, yes, no = args
+    mask = _to_bool_mask(cond.series)
+    res = yes.series.astype(object).where(mask, no.series.astype(object))
+    return _TS(res, yes.dtype or no.dtype)
+
+
+def _num_fn(f: Callable[[pd.Series], pd.Series],
+            out: Optional[pa.DataType] = pa.float64()) -> Callable:
+    def impl(ev: _Evaluator, args: List[_TS]) -> _TS:
+        s = pd.to_numeric(args[0].series, errors="coerce")
+        return _TS(f(s), out if out is not None else args[0].dtype)
+    return impl
+
+
+def _fn_round(ev: _Evaluator, args: List[_TS]) -> _TS:
+    s = pd.to_numeric(args[0].series, errors="coerce")
+    digits = 0
+    if len(args) > 1:
+        digits = int(args[1].series.iloc[0]) if len(args[1].series) else 0
+    return _TS(s.round(digits), pa.float64())
+
+
+def _fn_power(ev: _Evaluator, args: List[_TS]) -> _TS:
+    a = pd.to_numeric(args[0].series, errors="coerce")
+    b = pd.to_numeric(args[1].series, errors="coerce")
+    return _TS(a ** b, pa.float64())
+
+
+def _fn_mod(ev: _Evaluator, args: List[_TS]) -> _TS:
+    a = pd.to_numeric(args[0].series, errors="coerce")
+    b = pd.to_numeric(args[1].series, errors="coerce")
+    return _TS(a % b, args[0].dtype or pa.int64())
+
+
+def _str_fn(f: Callable[[pd.Series], pd.Series],
+            out: pa.DataType = pa.string()) -> Callable:
+    def impl(ev: _Evaluator, args: List[_TS]) -> _TS:
+        s = args[0].series
+        nulls = s.isna()
+        res = f(s.astype(object).astype(str))
+        res = pd.Series(res, index=s.index).astype(object)
+        res[nulls.to_numpy(dtype=bool)] = None
+        return _TS(res, out)
+    return impl
+
+
+def _fn_substring(ev: _Evaluator, args: List[_TS]) -> _TS:
+    s = args[0].series
+    nulls = s.isna()
+    start = int(args[1].series.iloc[0]) if len(args[1].series) else 1
+    start0 = max(start - 1, 0)
+    if len(args) > 2:
+        length = int(args[2].series.iloc[0]) if len(args[2].series) else 0
+        res = s.astype(object).astype(str).str.slice(start0, start0 + length)
+    else:
+        res = s.astype(object).astype(str).str.slice(start0)
+    res = res.astype(object)
+    res[nulls.to_numpy(dtype=bool)] = None
+    return _TS(res, pa.string())
+
+
+def _fn_concat(ev: _Evaluator, args: List[_TS]) -> _TS:
+    res = None
+    nulls = None
+    for a in args:
+        s = a.series
+        nulls = s.isna() if nulls is None else (nulls | s.isna())
+        part = s.astype(object).astype(str)
+        res = part if res is None else res + part
+    res = res.astype(object)
+    res[nulls.to_numpy(dtype=bool)] = None
+    return _TS(res, pa.string())
+
+
+def _fn_replace(ev: _Evaluator, args: List[_TS]) -> _TS:
+    s = args[0].series
+    nulls = s.isna()
+    old = str(args[1].series.iloc[0]) if len(args[1].series) else ""
+    new = str(args[2].series.iloc[0]) if len(args[2].series) else ""
+    res = s.astype(object).astype(str).str.replace(old, new, regex=False)
+    res = res.astype(object)
+    res[nulls.to_numpy(dtype=bool)] = None
+    return _TS(res, pa.string())
+
+
+_SCALAR_FUNCS: Dict[str, Callable[[ _Evaluator, List[_TS]], _TS]] = {
+    "coalesce": _fn_coalesce,
+    "nullif": _fn_nullif,
+    "if": _fn_if,
+    "iif": _fn_if,
+    "abs": _num_fn(lambda s: s.abs(), None),
+    "round": _fn_round,
+    "floor": _num_fn(np.floor, pa.int64()),
+    "ceil": _num_fn(np.ceil, pa.int64()),
+    "ceiling": _num_fn(np.ceil, pa.int64()),
+    "sqrt": _num_fn(np.sqrt),
+    "exp": _num_fn(np.exp),
+    "ln": _num_fn(np.log),
+    "log": _num_fn(np.log),
+    "log2": _num_fn(np.log2),
+    "log10": _num_fn(np.log10),
+    "sin": _num_fn(np.sin),
+    "cos": _num_fn(np.cos),
+    "tan": _num_fn(np.tan),
+    "sign": _num_fn(np.sign, pa.int64()),
+    "power": _fn_power,
+    "pow": _fn_power,
+    "mod": _fn_mod,
+    "upper": _str_fn(lambda s: s.str.upper()),
+    "ucase": _str_fn(lambda s: s.str.upper()),
+    "lower": _str_fn(lambda s: s.str.lower()),
+    "lcase": _str_fn(lambda s: s.str.lower()),
+    "length": _str_fn(lambda s: s.str.len(), pa.int64()),
+    "len": _str_fn(lambda s: s.str.len(), pa.int64()),
+    "trim": _str_fn(lambda s: s.str.strip()),
+    "ltrim": _str_fn(lambda s: s.str.lstrip()),
+    "rtrim": _str_fn(lambda s: s.str.rstrip()),
+    "reverse": _str_fn(lambda s: s.str[::-1]),
+    "substring": _fn_substring,
+    "substr": _fn_substring,
+    "concat": _fn_concat,
+    "replace": _fn_replace,
+}
+
+
+# ---- aggregation --------------------------------------------------------
+
+_AGG_FUNCS = {
+    "count", "sum", "avg", "mean", "min", "max", "first", "last",
+    "first_value", "last_value", "stddev", "stddev_samp", "stddev_pop",
+    "variance", "var_samp", "var_pop", "median",
+}
+
+
+def _contains_agg(e: ast.Expr) -> bool:
+    if isinstance(e, ast.Func) and e.name in _AGG_FUNCS:
+        return True
+    return any(_contains_agg(c) for c in _children(e))
+
+
+def _children(e: ast.Expr) -> List[ast.Expr]:
+    out: List[ast.Expr] = []
+    if isinstance(e, ast.Unary):
+        out = [e.operand]
+    elif isinstance(e, ast.Binary):
+        out = [e.left, e.right]
+    elif isinstance(e, ast.Func):
+        out = [a for a in e.args if not isinstance(a, ast.Star)]
+    elif isinstance(e, ast.Case):
+        out = [x for pair in e.whens for x in pair]
+        if e.operand is not None:
+            out.append(e.operand)
+        if e.default is not None:
+            out.append(e.default)
+    elif isinstance(e, ast.Cast):
+        out = [e.operand]
+    elif isinstance(e, (ast.IsNull, ast.Like, ast.InList)):
+        out = [e.operand]
+        if isinstance(e, ast.Like):
+            out.append(e.pattern)
+    elif isinstance(e, ast.Between):
+        out = [e.operand, e.low, e.high]
+    return out
+
+
+def _collect_aggs(e: ast.Expr, out: List[ast.Func]) -> None:
+    if isinstance(e, ast.Func) and e.name in _AGG_FUNCS:
+        if e not in out:
+            out.append(e)
+        return
+    for c in _children(e):
+        _collect_aggs(c, out)
+
+
+def _agg_result(
+    grouped: Any, func: ast.Func, label: str, arg_type: Optional[pa.DataType]
+) -> Tuple[pd.Series, Optional[pa.DataType]]:
+    name = func.name
+    if name == "count":
+        if func.distinct:
+            return grouped[label].nunique(dropna=True), pa.int64()
+        if len(func.args) == 1 and isinstance(func.args[0], ast.Star):
+            return grouped[label].size(), pa.int64()
+        return grouped[label].count(), pa.int64()
+    if name in ("avg", "mean"):
+        return grouped[label].mean(), pa.float64()
+    if name == "sum":
+        col = grouped[label]
+        if func.distinct:
+            res = col.agg(lambda s: s.dropna().drop_duplicates().sum()
+                          if s.notna().any() else None)
+        else:
+            res = col.sum(min_count=1)
+        tp = pa.int64() if arg_type is not None and \
+            pa.types.is_integer(arg_type) else pa.float64()
+        return res, tp
+    if name == "min":
+        return grouped[label].min(), arg_type
+    if name == "max":
+        return grouped[label].max(), arg_type
+    if name in ("first", "first_value"):
+        return grouped[label].agg(
+            lambda s: s.iloc[0] if len(s) > 0 else None
+        ), arg_type
+    if name in ("last", "last_value"):
+        return grouped[label].agg(
+            lambda s: s.iloc[-1] if len(s) > 0 else None
+        ), arg_type
+    if name in ("stddev", "stddev_samp"):
+        return grouped[label].std(ddof=1), pa.float64()
+    if name == "stddev_pop":
+        return grouped[label].std(ddof=0), pa.float64()
+    if name in ("variance", "var_samp"):
+        return grouped[label].var(ddof=1), pa.float64()
+    if name == "var_pop":
+        return grouped[label].var(ddof=0), pa.float64()
+    if name == "median":
+        return grouped[label].median(), pa.float64()
+    raise SQLExecutionError(f"unsupported aggregation {name}")
+
+
+def _global_agg_result(
+    frame: pd.DataFrame, func: ast.Func, label: str,
+    arg_type: Optional[pa.DataType],
+) -> Tuple[Any, Optional[pa.DataType]]:
+    s = frame[label]
+    name = func.name
+    if name == "count":
+        if func.distinct:
+            return s.nunique(dropna=True), pa.int64()
+        if len(func.args) == 1 and isinstance(func.args[0], ast.Star):
+            return len(s), pa.int64()
+        return s.count(), pa.int64()
+    if name in ("avg", "mean"):
+        return (s.mean() if len(s) else None), pa.float64()
+    if name == "sum":
+        vals = s.dropna().drop_duplicates() if func.distinct else s
+        res = vals.sum(min_count=1) if len(vals) else None
+        tp = pa.int64() if arg_type is not None and \
+            pa.types.is_integer(arg_type) else pa.float64()
+        return (None if res is None or pd.isna(res) else res), tp
+    if name == "min":
+        return (s.min() if s.notna().any() else None), arg_type
+    if name == "max":
+        return (s.max() if s.notna().any() else None), arg_type
+    if name in ("first", "first_value"):
+        return (s.iloc[0] if len(s) > 0 else None), arg_type
+    if name in ("last", "last_value"):
+        return (s.iloc[-1] if len(s) > 0 else None), arg_type
+    if name in ("stddev", "stddev_samp"):
+        return (s.std(ddof=1) if len(s) else None), pa.float64()
+    if name == "stddev_pop":
+        return (s.std(ddof=0) if len(s) else None), pa.float64()
+    if name in ("variance", "var_samp"):
+        return (s.var(ddof=1) if len(s) else None), pa.float64()
+    if name == "var_pop":
+        return (s.var(ddof=0) if len(s) else None), pa.float64()
+    if name == "median":
+        return (s.median() if len(s) else None), pa.float64()
+    raise SQLExecutionError(f"unsupported aggregation {name}")
+
+
+# ---- SELECT execution ---------------------------------------------------
+
+
+def _run_select(q: ast.Select, env: Dict[str, _Table]) -> _Table:
+    if q.from_ is None:
+        scope = _Scope(pd.DataFrame({"_": [0]})[[]], [])
+        scope.frame.index = pd.RangeIndex(1)
+    else:
+        scope = _build_scope(q.from_, env)
+    if q.where is not None:
+        if _contains_agg(q.where):
+            raise SQLExecutionError("WHERE cannot contain aggregations")
+        mask = _to_bool_mask(_Evaluator(scope).eval(q.where).series)
+        scope = _Scope(scope.frame[mask], scope.entries)
+
+    has_agg = (
+        len(q.group_by) > 0
+        or any(
+            not isinstance(it.expr, ast.Star) and _contains_agg(it.expr)
+            for it in q.items
+        )
+        or (q.having is not None)
+    )
+    resolver: Optional[Callable[[ast.Expr], _TS]]
+    if has_agg:
+        out, resolver = _run_agg_select(q, scope)
+    else:
+        out = _run_plain_select(q, scope)
+        ev = _Evaluator(scope)
+        resolver = ev.eval
+    if q.distinct:
+        # keep the original index so order keys can still be reindexed
+        out = _Table(out.frame.drop_duplicates(), out.names, out.types)
+    out = _apply_order_limit(out, q.order_by, q.limit, q.offset, resolver)
+    return out
+
+
+def _output_name(item: ast.SelectItem, i: int) -> str:
+    if item.alias is not None:
+        return item.alias
+    if isinstance(item.expr, ast.Col):
+        return item.expr.name
+    return f"col_{i}"
+
+
+def _run_plain_select(q: ast.Select, scope: _Scope) -> _Table:
+    ev = _Evaluator(scope)
+    cols: List[Tuple[str, _TS]] = []
+    for i, item in enumerate(q.items):
+        if isinstance(item.expr, ast.Star):
+            for e in scope.star_entries(item.expr.table):
+                cols.append((e.name, _TS(scope.frame[e.label], e.dtype)))
+        else:
+            cols.append((_output_name(item, i), ev.eval(item.expr)))
+    names = [c[0] for c in cols]
+    _check_dup(names)
+    frame = pd.DataFrame(
+        {f"o{i}": ts.series for i, (_, ts) in enumerate(cols)},
+        index=scope.frame.index,
+    )
+    if len(cols) > 0 and len(scope.frame.index) == 0:
+        frame = frame.iloc[0:0]
+    return _Table(frame, names, [ts.dtype for _, ts in cols])
+
+
+def _check_dup(names: List[str]) -> None:
+    seen = set()
+    for n in names:
+        if n in seen:
+            raise SQLExecutionError(f"duplicated output column {n}")
+        seen.add(n)
+
+
+class _AggContext:
+    """Post-aggregation scope: group keys + aggregated values by node."""
+
+    def __init__(self) -> None:
+        self.key_exprs: List[ast.Expr] = []
+        self.key_labels: List[str] = []
+        self.key_types: List[Optional[pa.DataType]] = []
+        self.agg_nodes: List[ast.Func] = []
+        self.agg_labels: List[str] = []
+        self.agg_types: List[Optional[pa.DataType]] = []
+        self.frame = pd.DataFrame()
+
+    def eval_post(self, e: ast.Expr, scope: _Scope) -> _TS:
+        """Evaluate over the aggregated frame, mapping group-by exprs and
+        agg funcs to their computed columns."""
+        for k, lbl, tp in zip(self.key_exprs, self.key_labels, self.key_types):
+            if e == k:
+                return _TS(self.frame[lbl], tp)
+            if isinstance(e, ast.Col) and isinstance(k, ast.Col) \
+                    and e.name == k.name and e.table is None:
+                return _TS(self.frame[lbl], tp)
+        if isinstance(e, ast.Func) and e.name in _AGG_FUNCS:
+            for node, lbl, tp in zip(
+                self.agg_nodes, self.agg_labels, self.agg_types
+            ):
+                if e == node:
+                    return _TS(self.frame[lbl], tp)
+            raise SQLExecutionError(f"aggregation {e} was not computed")
+        if isinstance(e, ast.Col):
+            raise SQLExecutionError(
+                f"column {_qname(e.name, e.table)} is not in GROUP BY"
+            )
+        # structural recursion via a shadow evaluator over the agg frame
+        sub = _Evaluator(_Scope(self.frame, []))
+        return _eval_with_hook(sub, e, lambda x: self._hook(x, scope))
+
+    def _hook(self, e: ast.Expr, scope: _Scope) -> Optional[_TS]:
+        for k, lbl, tp in zip(self.key_exprs, self.key_labels, self.key_types):
+            if e == k or (
+                isinstance(e, ast.Col) and isinstance(k, ast.Col)
+                and e.name == k.name and e.table is None
+            ):
+                return _TS(self.frame[lbl], tp)
+        if isinstance(e, ast.Func) and e.name in _AGG_FUNCS:
+            for node, lbl, tp in zip(
+                self.agg_nodes, self.agg_labels, self.agg_types
+            ):
+                if e == node:
+                    return _TS(self.frame[lbl], tp)
+        return None
+
+
+def _eval_with_hook(
+    ev: _Evaluator, e: ast.Expr, hook: Callable[[ast.Expr], Optional[_TS]]
+) -> _TS:
+    hooked = hook(e)
+    if hooked is not None:
+        return hooked
+    orig = ev.eval
+
+    def patched(x: ast.Expr) -> _TS:
+        h = hook(x)
+        if h is not None:
+            return h
+        return orig(x)
+
+    ev.eval = patched  # type: ignore[method-assign]
+    try:
+        return orig(e)
+    finally:
+        ev.eval = orig  # type: ignore[method-assign]
+
+
+def _resolve_groupby_expr(
+    g: ast.Expr, q: ast.Select
+) -> ast.Expr:
+    """GROUP BY ordinal or select alias resolves to the item's expression."""
+    if isinstance(g, ast.Lit) and isinstance(g.value, int) \
+            and not isinstance(g.value, bool):
+        idx = g.value - 1
+        if idx < 0 or idx >= len(q.items):
+            raise SQLExecutionError(f"GROUP BY ordinal {g.value} out of range")
+        return q.items[idx].expr
+    if isinstance(g, ast.Col) and g.table is None:
+        for it in q.items:
+            if it.alias == g.name:
+                return it.expr
+    return g
+
+
+def _run_agg_select(
+    q: ast.Select, scope: _Scope
+) -> Tuple[_Table, Callable[[ast.Expr], _TS]]:
+    ctx = _AggContext()
+    ctx.key_exprs = [_resolve_groupby_expr(g, q) for g in q.group_by]
+    for k in ctx.key_exprs:
+        if _contains_agg(k):
+            raise SQLExecutionError("GROUP BY cannot contain aggregations")
+    aggs: List[ast.Func] = []
+    for it in q.items:
+        if isinstance(it.expr, ast.Star):
+            raise SQLExecutionError("SELECT * cannot be used with GROUP BY")
+        _collect_aggs(it.expr, aggs)
+    if q.having is not None:
+        _collect_aggs(q.having, aggs)
+    for o in q.order_by:
+        _collect_aggs(o.expr, aggs)
+    ctx.agg_nodes = aggs
+
+    ev = _Evaluator(scope)
+    work = pd.DataFrame(index=scope.frame.index)
+    key_labels = []
+    for i, k in enumerate(ctx.key_exprs):
+        ts = ev.eval(k)
+        lbl = f"k{i}"
+        work[lbl] = ts.series
+        key_labels.append(lbl)
+        ctx.key_labels.append(lbl)
+        ctx.key_types.append(ts.dtype)
+    arg_types: List[Optional[pa.DataType]] = []
+    for i, node in enumerate(aggs):
+        lbl = f"a{i}"
+        if len(node.args) == 1 and isinstance(node.args[0], ast.Star):
+            work[lbl] = 1
+            arg_types.append(pa.int64())
+        else:
+            if len(node.args) != 1:
+                raise SQLExecutionError(
+                    f"aggregation {node.name} takes one argument"
+                )
+            ts = ev.eval(node.args[0])
+            work[lbl] = ts.series
+            arg_types.append(ts.dtype)
+        ctx.agg_labels.append(lbl)
+
+    if len(key_labels) == 0:
+        data: Dict[str, Any] = {}
+        for node, lbl, atp in zip(aggs, ctx.agg_labels, arg_types):
+            val, tp = _global_agg_result(work, node, lbl, atp)
+            data[lbl] = [val]
+            ctx.agg_types.append(tp)
+        ctx.frame = pd.DataFrame(data) if data else pd.DataFrame(index=[0])
+    else:
+        grouped = work.groupby(key_labels, dropna=False, sort=False)
+        pieces: Dict[str, pd.Series] = {}
+        for node, lbl, atp in zip(aggs, ctx.agg_labels, arg_types):
+            res, tp = _agg_result(grouped, node, lbl, atp)
+            pieces[lbl] = res
+            ctx.agg_types.append(tp)
+        if pieces:
+            agg_frame = pd.DataFrame(pieces).reset_index()
+        else:
+            agg_frame = grouped.size().reset_index(name="_sz") \
+                .drop(columns=["_sz"])
+        ctx.frame = agg_frame
+
+    if q.having is not None:
+        mask = _to_bool_mask(ctx.eval_post(q.having, scope).series)
+        ctx.frame = ctx.frame[mask]
+
+    cols: List[Tuple[str, _TS]] = []
+    for i, it in enumerate(q.items):
+        cols.append((_output_name(it, i), ctx.eval_post(it.expr, scope)))
+    names = [c[0] for c in cols]
+    _check_dup(names)
+    frame = pd.DataFrame(
+        {f"o{i}": ts.series for i, (_, ts) in enumerate(cols)},
+        index=ctx.frame.index,
+    )
+    out = _Table(frame, names, [ts.dtype for _, ts in cols])
+    return out, (lambda e: ctx.eval_post(e, scope))
+
+
+def _apply_order_limit(
+    t: _Table,
+    order_by: List[ast.OrderItem],
+    limit: Optional[int],
+    offset: Optional[int],
+    resolver: Optional[Callable[[ast.Expr], _TS]],
+) -> _Table:
+    if order_by:
+        keys = []
+        for j, o in enumerate(order_by):
+            ts = _order_key(t, o, resolver)
+            keys.append((f"s{j}", ts.series, o))
+        t = _sort_table(t, keys, t.frame.index)
+    t = _Table(t.frame.reset_index(drop=True), t.names, t.types)
+    return _apply_limit(t, limit, offset)
+
+
+def _order_key(
+    t: _Table, o: ast.OrderItem,
+    resolver: Optional[Callable[[ast.Expr], _TS]],
+) -> _TS:
+    e = o.expr
+    if isinstance(e, ast.Lit) and isinstance(e.value, int) \
+            and not isinstance(e.value, bool):
+        idx = e.value - 1
+        if 0 <= idx < len(t.names):
+            return _TS(t.frame.iloc[:, idx], t.types[idx])
+    if isinstance(e, ast.Col) and e.table is None and e.name in t.names:
+        idx = t.names.index(e.name)
+        return _TS(t.frame.iloc[:, idx], t.types[idx])
+    if resolver is not None:
+        ts = resolver(e)
+        return _TS(ts.series.reindex(t.frame.index), ts.dtype)
+    raise SQLExecutionError(f"cannot resolve ORDER BY expression {e}")
+
+
+def _sort_table(
+    t: _Table, keys: List[Tuple[str, pd.Series, ast.OrderItem]],
+    index: pd.Index,
+) -> _Table:
+    sorter = pd.DataFrame(
+        {lbl: s.reindex(index) for lbl, s, _ in keys}, index=index
+    )
+    by = [lbl for lbl, _, _ in keys]
+    ascending = [o.asc for _, _, o in keys]
+    # pandas supports one na_position for all keys; emulate per-key NULLS
+    # FIRST/LAST via a null-rank column per key
+    frames = []
+    for lbl, _, o in keys:
+        nulls_first = (o.nulls == "FIRST") if o.nulls is not None else False
+        nf = sorter[lbl].isna()
+        frames.append((f"n_{lbl}", (~nf) if nulls_first else nf))
+    for lbl, s in frames:
+        sorter[lbl] = s
+    interleaved = []
+    asc2 = []
+    for (lbl, _, o), (nlbl, _s) in zip(keys, frames):
+        interleaved.extend([nlbl, lbl])
+        asc2.extend([True, o.asc])
+    del by, ascending
+    order = sorter.sort_values(interleaved, ascending=asc2, kind="stable").index
+    return _Table(t.frame.loc[order], t.names, t.types)
+
+
+def _apply_limit(
+    t: _Table, limit: Optional[int], offset: Optional[int]
+) -> _Table:
+    if offset is not None:
+        t = _Table(t.frame.iloc[offset:], t.names, t.types)
+    if limit is not None:
+        t = _Table(t.frame.iloc[:limit], t.names, t.types)
+    return _Table(t.frame.reset_index(drop=True), t.names, t.types)
+
+
+# ---- set operations -----------------------------------------------------
+
+
+def _unify_types(
+    a: Optional[pa.DataType], b: Optional[pa.DataType]
+) -> Optional[pa.DataType]:
+    if a is None:
+        return b
+    if b is None or a.equals(b):
+        return a
+    numeric = (pa.types.is_integer, pa.types.is_floating)
+    if any(f(a) for f in numeric) and any(f(b) for f in numeric):
+        if pa.types.is_floating(a) or pa.types.is_floating(b):
+            return pa.float64()
+        return pa.int64()
+    return pa.string()
+
+
+def _run_setop(q: ast.SetOp, env: Dict[str, _Table]) -> _Table:
+    left = _run(q.left, env)
+    right = _run(q.right, env)
+    if len(left.names) != len(right.names):
+        raise SQLExecutionError(
+            f"{q.op} requires equal column counts "
+            f"({len(left.names)} vs {len(right.names)})"
+        )
+    lf = left.frame.copy(deep=False)
+    rf = right.frame.copy(deep=False)
+    labels = [f"u{i}" for i in range(len(left.names))]
+    lf.columns = labels
+    rf.columns = labels
+    types = [
+        _unify_types(a, b) for a, b in zip(left.types, right.types)
+    ]
+    if q.op == "UNION":
+        res = pd.concat([lf, rf], ignore_index=True)
+        if not q.all:
+            res = res.drop_duplicates().reset_index(drop=True)
+    elif q.op == "EXCEPT":
+        ld = lf.drop_duplicates()
+        rd = rf.drop_duplicates()
+        merged = ld.merge(rd, on=labels, how="left", indicator=True)
+        res = merged[merged["_merge"] == "left_only"] \
+            .drop(columns=["_merge"]).reset_index(drop=True)
+    elif q.op == "INTERSECT":
+        ld = lf.drop_duplicates()
+        rd = rf.drop_duplicates()
+        res = ld.merge(rd, on=labels, how="inner").reset_index(drop=True)
+    else:
+        raise SQLExecutionError(f"unsupported set op {q.op}")
+    out = _Table(res, list(left.names), types)
+    return _apply_order_limit(out, q.order_by, q.limit, q.offset, None)

@@ -1,0 +1,356 @@
+import pandas as pd
+import pytest
+
+from fugue_tpu.dataframe import DataFrames
+from fugue_tpu.dataframe.pandas_dataframe import PandasDataFrame
+from fugue_tpu.sql_frontend.select_runner import (
+    SQLExecutionError,
+    run_select,
+)
+
+
+def _dfs(**tables):
+    out = {}
+    for name, (data, schema) in tables.items():
+        out[name] = PandasDataFrame(pd.DataFrame(data), schema)
+    return DataFrames(out)
+
+
+def _run(sql, **tables):
+    res = run_select(sql, _dfs(**tables))
+    return res.schema, res.as_array(type_safe=True)
+
+
+T1 = dict(a=dict(
+    data={"k": ["x", "y", "x", None], "v": [1, 2, 3, 4]},
+    schema="k:str,v:long",
+))
+T1 = {"a": (T1["a"]["data"], T1["a"]["schema"])}
+
+
+def test_basic_projection():
+    schema, rows = _run("SELECT k, v FROM a", **T1)
+    assert str(schema) == "k:str,v:long"
+    assert rows == [["x", 1], ["y", 2], ["x", 3], [None, 4]]
+
+
+def test_star_and_alias():
+    schema, rows = _run("SELECT *, v + 1 AS w FROM a", **T1)
+    assert str(schema) == "k:str,v:long,w:long"
+    assert rows[0] == ["x", 1, 2]
+
+
+def test_where_null_semantics():
+    # k = 'x' is NULL for the null row -> excluded
+    _, rows = _run("SELECT v FROM a WHERE k = 'x'", **T1)
+    assert rows == [[1], [3]]
+    _, rows = _run("SELECT v FROM a WHERE k IS NULL", **T1)
+    assert rows == [[4]]
+    _, rows = _run("SELECT v FROM a WHERE k IS NOT NULL AND v > 1", **T1)
+    assert rows == [[2], [3]]
+
+
+def test_expressions():
+    _, rows = _run(
+        "SELECT v * 2 AS d, v / 2 AS h, v % 2 AS m FROM a WHERE v = 3", **T1
+    )
+    assert rows == [[6, 1.5, 1]]
+    _, rows = _run("SELECT -v AS n FROM a WHERE v = 1", **T1)
+    assert rows == [[-1]]
+
+
+def test_case_when():
+    _, rows = _run(
+        "SELECT v, CASE WHEN v >= 3 THEN 'big' WHEN v = 2 THEN 'mid' "
+        "ELSE 'small' END AS c FROM a",
+        **T1,
+    )
+    assert [r[1] for r in rows] == ["small", "mid", "big", "big"]
+
+
+def test_case_operand_form():
+    _, rows = _run(
+        "SELECT CASE k WHEN 'x' THEN 1 ELSE 0 END AS c FROM a", **T1
+    )
+    assert [r[0] for r in rows] == [1, 0, 1, 0]
+
+
+def test_in_between_like():
+    _, rows = _run("SELECT v FROM a WHERE v IN (1, 3)", **T1)
+    assert rows == [[1], [3]]
+    _, rows = _run("SELECT v FROM a WHERE v BETWEEN 2 AND 3", **T1)
+    assert rows == [[2], [3]]
+    _, rows = _run("SELECT v FROM a WHERE k LIKE 'x%'", **T1)
+    assert rows == [[1], [3]]
+    _, rows = _run("SELECT v FROM a WHERE v NOT IN (1, 3)", **T1)
+    assert rows == [[2], [4]]
+
+
+def test_cast():
+    schema, rows = _run("SELECT CAST(v AS double) AS d FROM a LIMIT 1", **T1)
+    assert str(schema) == "d:double"
+    assert rows == [[1.0]]
+    with pytest.raises(SQLExecutionError):
+        # 'str' is not a SQL type name; use string
+        _run("SELECT CAST(v AS str) AS s FROM a", **T1)
+
+
+def test_cast_string():
+    schema, rows = _run(
+        "SELECT CAST(v AS string) AS s FROM a LIMIT 1", **T1
+    )
+    assert str(schema) == "s:str"
+    assert rows == [["1"]]
+
+
+def test_group_by():
+    schema, rows = _run(
+        "SELECT k, SUM(v) AS s, COUNT(*) AS c, AVG(v) AS m "
+        "FROM a GROUP BY k ORDER BY s",
+        **T1,
+    )
+    assert str(schema) == "k:str,s:long,c:long,m:double"
+    # stable sort: ties (s=4) stay in encounter order (x before None)
+    assert rows == [["y", 2, 1, 2.0], ["x", 4, 2, 2.0], [None, 4, 1, 4.0]]
+
+
+def test_global_agg():
+    _, rows = _run("SELECT SUM(v) AS s, COUNT(*) AS c FROM a", **T1)
+    assert rows == [[10, 4]]
+
+
+def test_global_agg_empty():
+    _, rows = _run(
+        "SELECT SUM(v) AS s, COUNT(*) AS c FROM a",
+        a=({"v": []}, "v:long"),
+    )
+    assert rows == [[None, 0]]
+
+
+def test_having():
+    _, rows = _run(
+        "SELECT k, SUM(v) AS s FROM a GROUP BY k HAVING SUM(v) > 2 "
+        "ORDER BY s DESC",
+        **T1,
+    )
+    assert rows == [["x", 4], [None, 4]] or rows == [[None, 4], ["x", 4]]
+
+
+def test_agg_expression():
+    _, rows = _run(
+        "SELECT k, SUM(v) + COUNT(*) AS t FROM a GROUP BY k ORDER BY k",
+        a=({"k": ["x", "x", "y"], "v": [1, 2, 3]}, "k:str,v:long"),
+    )
+    assert rows == [["x", 5], ["y", 4]]
+
+
+def test_count_distinct():
+    _, rows = _run(
+        "SELECT COUNT(DISTINCT k) AS c FROM a", **T1
+    )
+    assert rows == [[2]]
+
+
+def test_order_by_nulls():
+    _, rows = _run("SELECT k FROM a ORDER BY k NULLS FIRST, v", **T1)
+    assert rows[0] == [None]
+    _, rows = _run("SELECT k FROM a ORDER BY k DESC NULLS LAST", **T1)
+    assert rows[-1] == [None]
+
+
+def test_limit_offset():
+    _, rows = _run("SELECT v FROM a ORDER BY v LIMIT 2", **T1)
+    assert rows == [[1], [2]]
+    _, rows = _run("SELECT v FROM a ORDER BY v LIMIT 2 OFFSET 1", **T1)
+    assert rows == [[2], [3]]
+
+
+def test_distinct():
+    # default null ordering is NULLS LAST for ASC
+    _, rows = _run("SELECT DISTINCT k FROM a ORDER BY k", **T1)
+    assert rows == [["x"], ["y"], [None]]
+
+
+def test_join_inner():
+    _, rows = _run(
+        "SELECT a.k, a.v, b.w FROM a INNER JOIN b ON a.k = b.k ORDER BY v",
+        a=({"k": ["x", "y", None], "v": [1, 2, 3]}, "k:str,v:long"),
+        b=({"k": ["x", "z", None], "w": [10, 20, 30]}, "k:str,w:long"),
+    )
+    # null keys never match
+    assert rows == [["x", 1, 10]]
+
+
+def test_join_left():
+    _, rows = _run(
+        "SELECT a.k AS k, v, w FROM a LEFT JOIN b ON a.k = b.k ORDER BY v",
+        a=({"k": ["x", "y"], "v": [1, 2]}, "k:str,v:long"),
+        b=({"k": ["x"], "w": [10]}, "k:str,w:long"),
+    )
+    assert rows == [["x", 1, 10], ["y", 2, None]]
+
+
+def test_join_full():
+    _, rows = _run(
+        "SELECT a.k AS ak, b.k AS bk, v, w FROM a FULL OUTER JOIN b "
+        "ON a.k = b.k ORDER BY v NULLS LAST",
+        a=({"k": ["x", "y"], "v": [1, 2]}, "k:str,v:long"),
+        b=({"k": ["x", "z"], "w": [10, 20]}, "k:str,w:long"),
+    )
+    assert rows == [
+        ["x", "x", 1, 10], ["y", None, 2, None], [None, "z", None, 20],
+    ]
+
+
+def test_join_semi_anti():
+    a = ({"k": ["x", "y", "z"], "v": [1, 2, 3]}, "k:str,v:long")
+    b = ({"k": ["x", "z"], "w": [1, 2]}, "k:str,w:long")
+    _, rows = _run(
+        "SELECT v FROM a LEFT SEMI JOIN b ON a.k = b.k ORDER BY v", a=a, b=b
+    )
+    assert rows == [[1], [3]]
+    _, rows = _run(
+        "SELECT v FROM a LEFT ANTI JOIN b ON a.k = b.k ORDER BY v", a=a, b=b
+    )
+    assert rows == [[2]]
+
+
+def test_join_cross():
+    _, rows = _run(
+        "SELECT v, w FROM a CROSS JOIN b ORDER BY v, w",
+        a=({"v": [1, 2]}, "v:long"),
+        b=({"w": [10, 20]}, "w:long"),
+    )
+    assert rows == [[1, 10], [1, 20], [2, 10], [2, 20]]
+
+
+def test_join_using():
+    _, rows = _run(
+        "SELECT k, v, w FROM a JOIN b USING (k) ORDER BY v",
+        a=({"k": ["x", "y"], "v": [1, 2]}, "k:str,v:long"),
+        b=({"k": ["x", "y"], "w": [10, 20]}, "k:str,w:long"),
+    )
+    assert rows == [["x", 1, 10], ["y", 2, 20]]
+
+
+def test_join_non_equi_residual():
+    _, rows = _run(
+        "SELECT v, w FROM a JOIN b ON a.k = b.k AND b.w > 10 ORDER BY v",
+        a=({"k": ["x", "y"], "v": [1, 2]}, "k:str,v:long"),
+        b=({"k": ["x", "y"], "w": [10, 20]}, "k:str,w:long"),
+    )
+    assert rows == [[2, 20]]
+
+
+def test_subquery():
+    _, rows = _run(
+        "SELECT t.k, t.s FROM (SELECT k, SUM(v) AS s FROM a GROUP BY k) t "
+        "WHERE t.s > 2 ORDER BY t.s",
+        a=({"k": ["x", "x", "y"], "v": [1, 2, 3]}, "k:str,v:long"),
+    )
+    assert rows == [["x", 3], ["y", 3]]
+
+
+def test_cte():
+    _, rows = _run(
+        "WITH t AS (SELECT k, SUM(v) AS s FROM a GROUP BY k), "
+        "u AS (SELECT * FROM t WHERE s > 2) "
+        "SELECT k FROM u ORDER BY k",
+        a=({"k": ["x", "x", "y"], "v": [1, 2, 3]}, "k:str,v:long"),
+    )
+    assert rows == [["x"], ["y"]]
+
+
+def test_union():
+    a = ({"v": [1, 2]}, "v:long")
+    b = ({"v": [2, 3]}, "v:long")
+    _, rows = _run("SELECT v FROM a UNION ALL SELECT v FROM b ORDER BY v",
+                   a=a, b=b)
+    assert rows == [[1], [2], [2], [3]]
+    _, rows = _run("SELECT v FROM a UNION SELECT v FROM b ORDER BY v",
+                   a=a, b=b)
+    assert rows == [[1], [2], [3]]
+
+
+def test_except_intersect():
+    a = ({"v": [1, 2, 2, 3]}, "v:long")
+    b = ({"v": [2]}, "v:long")
+    _, rows = _run("SELECT v FROM a EXCEPT SELECT v FROM b ORDER BY v",
+                   a=a, b=b)
+    assert rows == [[1], [3]]
+    _, rows = _run("SELECT v FROM a INTERSECT SELECT v FROM b", a=a, b=b)
+    assert rows == [[2]]
+
+
+def test_scalar_functions():
+    _, rows = _run(
+        "SELECT COALESCE(k, 'na') AS c, UPPER(COALESCE(k, 'na')) AS u, "
+        "ABS(v - 3) AS d FROM a ORDER BY v",
+        **T1,
+    )
+    assert rows[0] == ["x", "X", 2]
+    assert rows[3] == ["na", "NA", 1]
+
+
+def test_string_functions():
+    _, rows = _run(
+        "SELECT LENGTH(s) AS l, SUBSTRING(s, 2, 2) AS m, "
+        "CONCAT(s, '!') AS c, TRIM(p) AS t FROM a",
+        a=({"s": ["hello"], "p": ["  x "]}, "s:str,p:str"),
+    )
+    assert rows == [[5, "el", "hello!", "x"]]
+
+
+def test_concat_operator():
+    _, rows = _run(
+        "SELECT k || '_' || CAST(v AS string) AS c FROM a WHERE v = 1", **T1
+    )
+    assert rows == [["x_1"]]
+
+
+def test_group_by_ordinal_and_alias():
+    a = ({"k": ["x", "x", "y"], "v": [1, 2, 3]}, "k:str,v:long")
+    _, rows = _run(
+        "SELECT k AS kk, SUM(v) AS s FROM a GROUP BY 1 ORDER BY kk", a=a
+    )
+    assert rows == [["x", 3], ["y", 3]]
+    _, rows = _run(
+        "SELECT UPPER(k) AS kk, SUM(v) AS s FROM a GROUP BY kk ORDER BY kk",
+        a=a,
+    )
+    assert rows == [["X", 3], ["Y", 3]]
+
+
+def test_group_by_expression():
+    _, rows = _run(
+        "SELECT v % 2 AS parity, COUNT(*) AS c FROM a GROUP BY v % 2 "
+        "ORDER BY parity",
+        a=({"v": [1, 2, 3, 4, 5]}, "v:long"),
+    )
+    assert rows == [[0, 2], [1, 3]]
+
+
+def test_errors():
+    with pytest.raises(SQLExecutionError):
+        _run("SELECT nope FROM a", **T1)
+    with pytest.raises(SQLExecutionError):
+        _run("SELECT v FROM missing", **T1)
+    with pytest.raises(SQLExecutionError):
+        _run("SELECT k, SUM(v) AS s FROM a GROUP BY k HAVING nope > 1", **T1)
+    with pytest.raises(SQLExecutionError):
+        _run("SELECT v FROM a WHERE SUM(v) > 1", **T1)
+
+
+def test_select_no_from():
+    schema, rows = _run("SELECT 1 AS a, 'x' AS b, 1.5 AS c", **T1)
+    assert str(schema) == "a:long,b:str,c:double"
+    assert rows == [[1, "x", 1.5]]
+
+
+def test_empty_input_group_by():
+    schema, rows = _run(
+        "SELECT k, SUM(v) AS s FROM a GROUP BY k",
+        a=({"k": [], "v": []}, "k:str,v:long"),
+    )
+    assert str(schema) == "k:str,s:long"
+    assert rows == []
