@@ -1,0 +1,841 @@
+"""FugueSQL dialect compiler: parses a FugueSQL script and emits workflow
+DAG operations — the role of the ANTLR grammar + ``_Extensions`` visitor in
+the reference (fugue/sql/_visitors.py:305-743).
+
+Statement forms (subset of the reference grammar, same semantics):
+
+- ``[var =] SELECT ...`` / ``WITH ... SELECT ...`` — standard SQL routed to
+  the engine's SQLEngine; a missing FROM uses the previous statement's result
+- ``CREATE [[...], ...] SCHEMA s`` / ``CREATE USING ext [(params)]``
+- ``TRANSFORM [dfs] [prepartition] USING ext [(params)] [SCHEMA s]
+  [CALLBACK cb]`` (multiple dfs are zipped → cotransform)
+- ``OUTTRANSFORM [dfs] [prepartition] USING ext [(params)] [CALLBACK cb]``
+- ``PROCESS [dfs] [prepartition] USING ext [(params)] [SCHEMA s]``
+- ``OUTPUT [dfs] [prepartition] USING ext [(params)]``
+- ``PRINT [n ROWS] [FROM dfs] [ROWCOUNT] [TITLE "t"]``
+- ``SAVE [df] [prepartition] OVERWRITE|APPEND|TO [SINGLE] [fmt] "path"
+  [(params)]`` / ``SAVE AND USE ...``
+- ``LOAD [fmt] "path" [(params)] [COLUMNS cols|schema]``
+- ``ZIP dfs [INNER|LEFT OUTER|...] [BY cols] [PRESORT ...]``
+- ``RENAME COLUMNS a:b[,...] [FROM df]`` / ``ALTER COLUMNS a:t[,...]
+  [FROM df]`` / ``DROP COLUMNS a[,...] [IF EXISTS] [FROM df]``
+- ``DROP ROWS IF ANY|ALL NULL[S] [ON cols] [FROM df]``
+- ``FILL NULLS [PARAMS] k:v[,...] [FROM df]``
+- ``SAMPLE [REPLACE] n ROWS | p PERCENT [SEED n] [FROM df]``
+- ``TAKE n ROW[S] [FROM df] [prepartition] [PRESORT ...] [NULLS
+  FIRST|LAST]``
+- postfix modifiers on any assignable statement: ``PERSIST``, ``BROADCAST``,
+  ``[LAZY] WEAK CHECKPOINT``, ``[LAZY] [STRONG] CHECKPOINT``, ``[LAZY]
+  DETERMINISTIC CHECKPOINT [(params)]``, ``YIELD [LOCAL] DATAFRAME|FILE|
+  TABLE AS name``
+"""
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from fugue_tpu.collections.partition import PartitionSpec
+from fugue_tpu.collections.sql import StructuredRawSQL
+from fugue_tpu.sql_frontend import ast
+from fugue_tpu.sql_frontend.parser import Cursor, ExprParser, SQLParseError
+from fugue_tpu.sql_frontend.sqlgen import generate_parts
+from fugue_tpu.sql_frontend.tokenizer import tokenize
+
+__all__ = ["FugueSQLSyntaxError", "FugueSQLCompiler"]
+
+
+class FugueSQLSyntaxError(ValueError):
+    pass
+
+
+_STATEMENT_KEYWORDS = {
+    "SELECT", "WITH", "CREATE", "TRANSFORM", "OUTTRANSFORM", "PROCESS",
+    "OUTPUT", "PRINT", "SAVE", "LOAD", "ZIP", "RENAME", "ALTER", "DROP",
+    "FILL", "SAMPLE", "TAKE",
+}
+_MODIFIER_KEYWORDS = {
+    "PERSIST", "BROADCAST", "CHECKPOINT", "WEAK", "STRONG", "DETERMINISTIC",
+    "LAZY", "YIELD",
+}
+_SCHEMA_OPS = {":", ",", "*", "+", "-", "~", "[", "]", "{", "}", "<", ">", "."}
+
+
+class FugueSQLCompiler:
+    """Compiles one FugueSQL script onto a FugueWorkflow."""
+
+    def __init__(
+        self,
+        workflow: Any,
+        variables: Optional[Dict[str, Any]] = None,
+        sources: Optional[Dict[str, Any]] = None,
+        local_vars: Optional[Dict[str, Any]] = None,
+        dialect: Optional[str] = None,
+        last: Any = None,
+    ):
+        self.workflow = workflow
+        self.variables: Dict[str, Any] = dict(variables or {})
+        self.sources = dict(sources or {})  # raw dataframes from the caller
+        self.local_vars = dict(local_vars or {})
+        self.dialect = dialect
+        self.last = last
+
+    def compile(self, code: str) -> Dict[str, Any]:
+        cur = Cursor(tokenize(code))
+        while not cur.at_end():
+            if cur.accept_op(";"):
+                continue
+            self._statement(cur)
+        return self.variables
+
+    # ---- statement dispatch ---------------------------------------------
+
+    def _statement(self, cur: Cursor) -> None:
+        varname = None
+        if (
+            cur.tok.kind == "IDENT"
+            and cur.peek(1).kind == "OP"
+            and cur.peek(1).value == "="
+        ):
+            varname = cur.advance().value
+            cur.advance()
+        tdf = self._task(cur)
+        tdf = self._modifiers(cur, tdf, varname)
+        if varname is not None:
+            if tdf is None:
+                raise FugueSQLSyntaxError(
+                    f"cannot assign an output statement to {varname}"
+                )
+            self.variables[varname] = tdf
+        if tdf is not None:
+            self.last = tdf
+
+    def _task(self, cur: Cursor) -> Any:
+        t = cur.tok
+        if t.kind != "IDENT":
+            raise FugueSQLSyntaxError(f"unexpected token {t.value!r}")
+        u = t.upper
+        if u in ("SELECT", "WITH"):
+            return self._select_stmt(cur)
+        if u == "CREATE":
+            return self._create_stmt(cur)
+        if u in ("TRANSFORM", "OUTTRANSFORM"):
+            return self._transform_stmt(cur, out=(u == "OUTTRANSFORM"))
+        if u == "PROCESS":
+            return self._process_stmt(cur)
+        if u == "OUTPUT":
+            return self._output_stmt(cur)
+        if u == "PRINT":
+            return self._print_stmt(cur)
+        if u == "SAVE":
+            return self._save_stmt(cur)
+        if u == "LOAD":
+            return self._load_stmt(cur)
+        if u == "ZIP":
+            return self._zip_stmt(cur)
+        if u == "RENAME":
+            return self._rename_stmt(cur)
+        if u == "ALTER":
+            return self._alter_stmt(cur)
+        if u == "DROP":
+            return self._drop_stmt(cur)
+        if u == "FILL":
+            return self._fillna_stmt(cur)
+        if u == "SAMPLE":
+            return self._sample_stmt(cur)
+        if u == "TAKE":
+            return self._take_stmt(cur)
+        raise FugueSQLSyntaxError(f"unknown statement {t.value!r}")
+
+    # ---- SELECT ---------------------------------------------------------
+
+    def _select_stmt(self, cur: Cursor) -> Any:
+        q = ExprParser(cur).query()
+        if isinstance(q, ast.Select) and q.from_ is None and \
+                self.last is not None:
+            q.from_ = ast.TableRef("__fugue_last__")
+        dfs: Dict[str, Any] = {}
+
+        def resolve(name: str) -> str:
+            if name == "__fugue_last__":
+                dfs[name] = self.last
+                return name
+            df = self._find_df(name)
+            if df is None:
+                raise FugueSQLSyntaxError(f"{name} is not defined")
+            dfs[name] = df
+            return name
+
+        parts = generate_parts(q, resolve)
+        return self.workflow.select(
+            StructuredRawSQL(parts, dialect=self.dialect),
+            dfs=dfs if len(dfs) > 0 else None,
+        )
+
+    # ---- CREATE / LOAD --------------------------------------------------
+
+    def _create_stmt(self, cur: Cursor) -> Any:
+        cur.expect_kw("CREATE")
+        if cur.is_kw("USING"):
+            cur.advance()
+            using = self._using_ref(cur)
+            params = self._opt_params(cur)
+            schema = self._opt_schema(cur)
+            return self.workflow.create(
+                using=using, schema=schema, params=params
+            )
+        data = self._json_value(cur)
+        cur.expect_kw("SCHEMA")
+        schema = self._schema_expr(cur)
+        return self.workflow.df(data, schema=schema)
+
+    def _load_stmt(self, cur: Cursor) -> Any:
+        cur.expect_kw("LOAD")
+        fmt = ""
+        if cur.is_kw("PARQUET", "CSV", "JSON"):
+            fmt = cur.advance().value.lower()
+        path = self._path(cur)
+        params = self._opt_paren_params(cur) or {}
+        columns: Any = None
+        if cur.accept_kw("COLUMNS"):
+            columns = self._schema_or_cols(cur)
+        return self.workflow.load(path, fmt=fmt, columns=columns, **params)
+
+    # ---- extension statements -------------------------------------------
+
+    def _transform_stmt(self, cur: Cursor, out: bool) -> Any:
+        cur.advance()  # TRANSFORM / OUTTRANSFORM
+        dfs = self._opt_dfs(cur)
+        partition = self._opt_prepartition(cur)
+        cur.expect_kw("USING")
+        using = self._using_ref(cur)
+        params = self._opt_params(cur)
+        schema = self._opt_schema(cur)
+        callback = None
+        if cur.accept_kw("CALLBACK"):
+            callback = self._using_ref(cur)
+        src = self._dfs_to_single(dfs, partition)
+        pre = None if self._was_zipped(dfs) else partition
+        if out:
+            if schema is not None:
+                raise FugueSQLSyntaxError("OUTTRANSFORM cannot have SCHEMA")
+            src.out_transform(
+                using, params=params, pre_partition=pre, callback=callback
+            )
+            return None
+        return src.transform(
+            using, schema=schema, params=params, pre_partition=pre,
+            callback=callback,
+        )
+
+    def _was_zipped(self, dfs: Any) -> bool:
+        return isinstance(dfs, (list, dict)) and len(dfs) > 1
+
+    def _dfs_to_single(self, dfs: Any, partition: Any) -> Any:
+        """One df passes through; many dfs are zipped by the prepartition
+        keys (cotransform input)."""
+        if isinstance(dfs, list) and len(dfs) > 1:
+            return self.workflow.zip(*dfs, partition=partition)
+        if isinstance(dfs, dict) and len(dfs) > 1:
+            return self.workflow.zip(
+                *dfs.values(), partition=partition
+            )
+        if isinstance(dfs, list):
+            return dfs[0]
+        if isinstance(dfs, dict):
+            return next(iter(dfs.values()))
+        return self._last_df()
+
+    def _last_df(self) -> Any:
+        if self.last is None:
+            raise FugueSQLSyntaxError("no previous dataframe in this script")
+        return self.last
+
+    def _process_stmt(self, cur: Cursor) -> Any:
+        cur.expect_kw("PROCESS")
+        dfs = self._opt_dfs(cur)
+        partition = self._opt_prepartition(cur)
+        cur.expect_kw("USING")
+        using = self._using_ref(cur)
+        params = self._opt_params(cur)
+        schema = self._opt_schema(cur)
+        args = self._dfs_to_args(dfs)
+        return self.workflow.process(
+            *args, using=using, schema=schema, params=params,
+            pre_partition=partition,
+        )
+
+    def _output_stmt(self, cur: Cursor) -> None:
+        cur.expect_kw("OUTPUT")
+        dfs = self._opt_dfs(cur)
+        partition = self._opt_prepartition(cur)
+        cur.expect_kw("USING")
+        using = self._using_ref(cur)
+        params = self._opt_params(cur)
+        args = self._dfs_to_args(dfs)
+        self.workflow.output(
+            *args, using=using, params=params, pre_partition=partition
+        )
+        return None
+
+    def _dfs_to_args(self, dfs: Any) -> List[Any]:
+        if dfs is None:
+            return [self._last_df()]
+        if isinstance(dfs, dict):
+            return [dfs]
+        return list(dfs)
+
+    # ---- simple df statements -------------------------------------------
+
+    def _print_stmt(self, cur: Cursor) -> None:
+        cur.expect_kw("PRINT")
+        n = 10
+        if cur.tok.kind == "NUMBER":
+            n = int(cur.advance().value)
+            cur.accept_kw("ROWS") or cur.accept_kw("ROW")
+        dfs = None
+        if cur.accept_kw("FROM"):
+            dfs = self._dfs_clause(cur)
+        elif (
+            cur.tok.kind == "IDENT"
+            and cur.tok.upper not in ("ROWCOUNT", "TITLE")
+            and not (cur.peek(1).kind == "OP" and cur.peek(1).value == "=")
+            and self._find_df(cur.tok.value) is not None
+        ):
+            dfs = self._dfs_clause(cur)
+        with_count = False
+        if cur.accept_kw("ROWCOUNT"):
+            with_count = True
+        title = None
+        if cur.accept_kw("TITLE"):
+            if cur.tok.kind not in ("STRING", "QIDENT"):
+                raise FugueSQLSyntaxError("TITLE expects a string")
+            title = cur.advance().value
+        args = self._dfs_to_args(dfs)
+        self.workflow.show(*args, n=n, with_count=with_count, title=title)
+        return None
+
+    def _save_stmt(self, cur: Cursor) -> Any:
+        cur.expect_kw("SAVE")
+        and_use = False
+        if cur.accept_kw("AND"):
+            cur.expect_kw("USE")
+            and_use = True
+        df = None
+        if (
+            cur.tok.kind == "IDENT"
+            and not (cur.peek(1).kind == "OP" and cur.peek(1).value == "=")
+            and self._find_df(cur.tok.value) is not None
+        ):
+            df = self._df_ref(cur)
+        partition = self._opt_prepartition(cur)
+        if cur.accept_kw("OVERWRITE"):
+            mode = "overwrite"
+        elif cur.accept_kw("APPEND"):
+            mode = "append"
+        elif cur.accept_kw("TO"):
+            mode = "error"
+        else:
+            raise FugueSQLSyntaxError("SAVE requires OVERWRITE|APPEND|TO")
+        single = cur.accept_kw("SINGLE")
+        fmt = ""
+        if cur.is_kw("PARQUET", "CSV", "JSON"):
+            fmt = cur.advance().value.lower()
+        path = self._path(cur)
+        params = self._opt_paren_params(cur) or {}
+        src = df if df is not None else self._last_df()
+        if and_use:
+            return src.save_and_use(
+                path, fmt=fmt, mode=mode, partition=partition, **params
+            )
+        src.save(
+            path, fmt=fmt, mode=mode, partition=partition, single=single,
+            **params,
+        )
+        return None
+
+    def _zip_stmt(self, cur: Cursor) -> Any:
+        cur.expect_kw("ZIP")
+        dfs = self._dfs_clause(cur)
+        how = "inner"
+        if cur.is_kw("INNER", "CROSS"):
+            how = cur.advance().value.lower()
+        elif cur.is_kw("LEFT", "RIGHT", "FULL"):
+            side = cur.advance().value.lower()
+            cur.expect_kw("OUTER")
+            how = f"{side}_outer"
+        by: List[str] = []
+        if cur.accept_kw("BY"):
+            by = self._name_list(cur)
+        presort = ""
+        if cur.accept_kw("PRESORT"):
+            presort = self._presort_expr(cur)
+        partition = PartitionSpec(by=by, presort=presort)
+        args = list(dfs.values()) if isinstance(dfs, dict) else list(dfs)
+        return self.workflow.zip(*args, how=how, partition=partition)
+
+    def _rename_stmt(self, cur: Cursor) -> Any:
+        cur.expect_kw("RENAME")
+        cur.expect_kw("COLUMNS")
+        pairs = {}
+        while True:
+            old = self._ident(cur, "column name")
+            cur.expect_op(":")
+            new = self._ident(cur, "column name")
+            pairs[old] = new
+            if not cur.accept_op(","):
+                break
+        df = self._opt_from_df(cur)
+        return df.rename(pairs)
+
+    def _alter_stmt(self, cur: Cursor) -> Any:
+        cur.expect_kw("ALTER")
+        cur.expect_kw("COLUMNS")
+        schema = self._schema_expr(cur)
+        df = self._opt_from_df(cur)
+        return df.alter_columns(schema)
+
+    def _drop_stmt(self, cur: Cursor) -> Any:
+        cur.expect_kw("DROP")
+        if cur.accept_kw("COLUMNS"):
+            cols = self._name_list(cur)
+            if_exists = False
+            if cur.accept_kw("IF"):
+                cur.expect_kw("EXISTS")
+                if_exists = True
+            df = self._opt_from_df(cur)
+            return df.drop(cols, if_exists=if_exists)
+        cur.expect_kw("ROWS")
+        cur.expect_kw("IF")
+        if cur.accept_kw("ANY"):
+            how = "any"
+        else:
+            cur.expect_kw("ALL")
+            how = "all"
+        if not cur.accept_kw("NULLS"):
+            cur.expect_kw("NULL")
+        subset = None
+        if cur.accept_kw("ON"):
+            subset = self._name_list(cur)
+        df = self._opt_from_df(cur)
+        return df.dropna(how=how, subset=subset)
+
+    def _fillna_stmt(self, cur: Cursor) -> Any:
+        cur.expect_kw("FILL")
+        cur.expect_kw("NULLS")
+        value = self._params(cur)
+        df = self._opt_from_df(cur)
+        return df.fillna(value)
+
+    def _sample_stmt(self, cur: Cursor) -> Any:
+        cur.expect_kw("SAMPLE")
+        replace = cur.accept_kw("REPLACE")
+        n = frac = None
+        if cur.tok.kind != "NUMBER":
+            raise FugueSQLSyntaxError("SAMPLE expects n ROWS or p PERCENT")
+        num = cur.advance().value
+        if cur.accept_kw("ROWS") or cur.accept_kw("ROW"):
+            n = int(num)
+        elif cur.accept_kw("PERCENT"):
+            frac = float(num) / 100.0
+        else:
+            raise FugueSQLSyntaxError("SAMPLE expects ROWS or PERCENT")
+        seed = None
+        if cur.accept_kw("SEED"):
+            if cur.tok.kind != "NUMBER":
+                raise FugueSQLSyntaxError("SEED expects an integer")
+            seed = int(cur.advance().value)
+        df = self._opt_from_df(cur)
+        return df.sample(n=n, frac=frac, replace=replace, seed=seed)
+
+    def _take_stmt(self, cur: Cursor) -> Any:
+        cur.expect_kw("TAKE")
+        if cur.tok.kind != "NUMBER":
+            raise FugueSQLSyntaxError("TAKE expects a row count")
+        n = int(cur.advance().value)
+        cur.accept_kw("ROWS") or cur.accept_kw("ROW")
+        df = self._opt_from_df(cur)
+        partition = self._opt_prepartition(cur)
+        presort = ""
+        if cur.accept_kw("PRESORT"):
+            presort = self._presort_expr(cur)
+        na_position = "last"
+        if cur.accept_kw("NULLS") or cur.accept_kw("NULL"):
+            if cur.accept_kw("FIRST"):
+                na_position = "first"
+            else:
+                cur.expect_kw("LAST")
+        if partition is not None:
+            df = df.partition(partition)
+        if presort:
+            return df.take(n, presort=presort, na_position=na_position)
+        return df.take(n, na_position=na_position)
+
+    # ---- modifiers ------------------------------------------------------
+
+    def _modifiers(self, cur: Cursor, tdf: Any, varname: Optional[str]) -> Any:
+        while True:
+            lazy = False
+            if cur.is_kw("LAZY"):
+                lazy = True
+                cur.advance()
+            if cur.accept_kw("PERSIST"):
+                tdf = self._req(tdf, "PERSIST").persist()
+            elif cur.accept_kw("BROADCAST"):
+                tdf = self._req(tdf, "BROADCAST").broadcast()
+            elif cur.accept_kw("WEAK"):
+                cur.expect_kw("CHECKPOINT")
+                params = self._opt_paren_params(cur) or {}
+                tdf = self._req(tdf, "WEAK CHECKPOINT").weak_checkpoint(
+                    lazy=lazy, **params
+                )
+            elif cur.accept_kw("DETERMINISTIC"):
+                cur.expect_kw("CHECKPOINT")
+                ns = None
+                if cur.tok.kind == "STRING":
+                    ns = cur.advance().value
+                partition = self._opt_prepartition(cur)
+                single = cur.accept_kw("SINGLE")
+                params = self._opt_paren_params(cur) or {}
+                if partition is not None:
+                    params["partition"] = partition
+                if single:
+                    params["single"] = True
+                tdf = self._req(tdf, "DETERMINISTIC CHECKPOINT") \
+                    .deterministic_checkpoint(namespace=ns, **params)
+            elif cur.is_kw("STRONG", "CHECKPOINT"):
+                cur.accept_kw("STRONG")
+                cur.expect_kw("CHECKPOINT")
+                params = self._opt_paren_params(cur) or {}
+                tdf = self._req(tdf, "CHECKPOINT").strong_checkpoint(**params)
+            elif cur.accept_kw("YIELD"):
+                local = cur.accept_kw("LOCAL")
+                target = "dataframe"
+                if cur.accept_kw("DATAFRAME"):
+                    target = "dataframe"
+                elif cur.accept_kw("FILE"):
+                    target = "file"
+                elif cur.accept_kw("TABLE"):
+                    target = "table"
+                name = varname
+                if cur.accept_kw("AS"):
+                    name = self._ident(cur, "yield name")
+                if name is None:
+                    raise FugueSQLSyntaxError("yield name is not specified")
+                t = self._req(tdf, "YIELD")
+                if target == "dataframe":
+                    t.yield_dataframe_as(name, as_local=local)
+                elif target == "file":
+                    t.yield_file_as(name)
+                else:
+                    t.yield_table_as(name)
+            else:
+                if lazy:
+                    raise FugueSQLSyntaxError("LAZY must prefix a checkpoint")
+                return tdf
+
+    def _req(self, tdf: Any, what: str) -> Any:
+        if tdf is None:
+            raise FugueSQLSyntaxError(f"{what} requires a dataframe result")
+        return tdf
+
+    # ---- shared clause parsers ------------------------------------------
+
+    def _find_df(self, name: str) -> Any:
+        if name in self.variables:
+            return self.variables[name]
+        if name in self.sources:
+            df = self.workflow.create_data(self.sources.pop(name))
+            self.variables[name] = df
+            return df
+        if name in self.local_vars and self._is_dataframe_like(
+            self.local_vars[name]
+        ):
+            df = self.workflow.create_data(self.local_vars[name])
+            self.variables[name] = df
+            return df
+        return None
+
+    @staticmethod
+    def _is_dataframe_like(obj: Any) -> bool:
+        from fugue_tpu.dataframe import DataFrame
+
+        if isinstance(obj, DataFrame):
+            return True
+        mod = type(obj).__module__ or ""
+        return mod.startswith("pandas") or mod.startswith("pyarrow")
+
+    def _df_ref(self, cur: Cursor) -> Any:
+        name = self._ident(cur, "dataframe name")
+        df = self._find_df(name)
+        if df is None:
+            raise FugueSQLSyntaxError(f"{name} is not defined")
+        return df
+
+    def _opt_dfs(self, cur: Cursor) -> Any:
+        """Optional dataframe list before PREPARTITION/USING."""
+        if cur.tok.kind == "IDENT" and not cur.is_kw(
+            "USING", "PREPARTITION", "HASH", "RAND", "EVEN", "COARSE",
+        ):
+            return self._dfs_clause(cur)
+        return None
+
+    def _dfs_clause(self, cur: Cursor) -> Any:
+        """``a, b`` (list) or ``x: a, y: b`` (dict) of dataframe refs."""
+        named: Dict[str, Any] = {}
+        unnamed: List[Any] = []
+        while True:
+            if (
+                cur.tok.kind == "IDENT"
+                and cur.peek(1).kind == "OP"
+                and cur.peek(1).value == ":"
+            ):
+                key = cur.advance().value
+                cur.advance()
+                named[key] = self._df_ref(cur)
+            else:
+                unnamed.append(self._df_ref(cur))
+            if not cur.accept_op(","):
+                break
+        if named and unnamed:
+            raise FugueSQLSyntaxError("cannot mix named and unnamed dfs")
+        return named if named else unnamed
+
+    def _opt_from_df(self, cur: Cursor) -> Any:
+        if cur.accept_kw("FROM"):
+            return self._df_ref(cur)
+        if (
+            cur.tok.kind == "IDENT"
+            and not (cur.peek(1).kind == "OP" and cur.peek(1).value == "=")
+            and self._find_df(cur.tok.value) is not None
+        ):
+            return self._df_ref(cur)
+        return self._last_df()
+
+    def _opt_prepartition(self, cur: Cursor) -> Optional[PartitionSpec]:
+        algo = ""
+        if cur.is_kw("HASH", "RAND", "EVEN", "COARSE") and \
+                cur.peek(1).upper == "PREPARTITION":
+            algo = cur.advance().value.lower()
+        if not cur.accept_kw("PREPARTITION"):
+            return None
+        num = "0"
+        if cur.tok.kind == "NUMBER":
+            num = cur.advance().value
+        elif cur.is_kw("ROWCOUNT", "CONCURRENCY"):
+            # expression like ROWCOUNT/4
+            parts = [cur.advance().value]
+            while cur.is_op("/", "*", "+", "-") or cur.tok.kind == "NUMBER":
+                parts.append(cur.advance().value)
+            num = "".join(parts)
+        by: List[str] = []
+        if cur.accept_kw("BY"):
+            by = self._name_list(cur)
+        presort = ""
+        if cur.accept_kw("PRESORT"):
+            presort = self._presort_expr(cur)
+        return PartitionSpec(algo=algo, num=num, by=by, presort=presort)
+
+    def _presort_expr(self, cur: Cursor) -> str:
+        parts = []
+        while True:
+            name = self._ident(cur, "presort column")
+            direction = ""
+            if cur.accept_kw("ASC"):
+                direction = " asc"
+            elif cur.accept_kw("DESC"):
+                direction = " desc"
+            parts.append(name + direction)
+            if not cur.accept_op(","):
+                break
+        return ",".join(parts)
+
+    def _name_list(self, cur: Cursor) -> List[str]:
+        out = [self._ident(cur, "column name")]
+        while cur.accept_op(","):
+            out.append(self._ident(cur, "column name"))
+        return out
+
+    def _ident(self, cur: Cursor, what: str) -> str:
+        t = cur.tok
+        if t.kind not in ("IDENT", "QIDENT"):
+            raise FugueSQLSyntaxError(f"expected {what}, got {t.value!r}")
+        cur.advance()
+        return t.value
+
+    def _using_ref(self, cur: Cursor) -> Any:
+        parts = [self._ident(cur, "extension name")]
+        while cur.is_op(".") and cur.peek(1).kind == "IDENT":
+            cur.advance()
+            parts.append(cur.advance().value)
+        name = ".".join(parts)
+        if len(parts) == 1 and name in self.local_vars:
+            return self.local_vars[name]
+        if len(parts) > 1:
+            head = parts[0]
+            if head in self.local_vars:
+                obj = self.local_vars[head]
+                try:
+                    for p in parts[1:]:
+                        obj = getattr(obj, p)
+                    return obj
+                except AttributeError:
+                    pass
+            try:
+                import importlib
+
+                mod = importlib.import_module(".".join(parts[:-1]))
+                return getattr(mod, parts[-1])
+            except (ImportError, AttributeError):
+                pass
+        return name  # registered alias
+
+    def _opt_params(self, cur: Cursor) -> Optional[Dict[str, Any]]:
+        if cur.accept_kw("PARAMS"):
+            return self._json_pairs(cur)
+        return self._opt_paren_params(cur)
+
+    def _opt_paren_params(self, cur: Cursor) -> Optional[Dict[str, Any]]:
+        if cur.is_op("(") or cur.is_op("{"):
+            return self._params(cur)
+        return None
+
+    def _params(self, cur: Cursor) -> Dict[str, Any]:
+        if cur.accept_op("("):
+            out = self._json_pairs(cur)
+            cur.expect_op(")")
+            return out
+        if cur.is_op("{"):
+            v = self._json_value(cur)
+            if not isinstance(v, dict):
+                raise FugueSQLSyntaxError("expected a params object")
+            return v
+        if cur.accept_kw("PARAMS"):
+            return self._json_pairs(cur)
+        return self._json_pairs(cur)
+
+    def _opt_schema(self, cur: Cursor) -> Optional[str]:
+        if cur.accept_kw("SCHEMA"):
+            return self._schema_expr(cur)
+        return None
+
+    def _schema_expr(self, cur: Cursor) -> str:
+        """Consume schema tokens (``a:int,b:[str]`` or ``*,c:int``) until a
+        statement/modifier boundary."""
+        parts: List[str] = []
+        while True:
+            t = cur.tok
+            if t.kind == "END":
+                break
+            if t.kind == "OP" and t.value in _SCHEMA_OPS:
+                # a comma only continues the schema if a pair follows
+                if t.value == ",":
+                    nxt = cur.peek(1)
+                    if nxt.kind != "IDENT" and nxt.kind != "QIDENT" and \
+                            not (nxt.kind == "OP" and nxt.value in
+                                 ("*", "-", "+", "~")):
+                        break
+                parts.append(t.value)
+                cur.advance()
+                continue
+            if t.kind in ("IDENT", "QIDENT"):
+                if t.upper in _STATEMENT_KEYWORDS or \
+                        t.upper in _MODIFIER_KEYWORDS or \
+                        t.upper in ("USING", "FROM", "CALLBACK", "PARAMS"):
+                    break
+                # assignment lookahead: `name = ...`
+                if cur.peek(1).kind == "OP" and cur.peek(1).value == "=":
+                    break
+                parts.append(t.value)
+                cur.advance()
+                continue
+            if t.kind == "NUMBER":
+                parts.append(t.value)
+                cur.advance()
+                continue
+            break
+        if len(parts) == 0:
+            raise FugueSQLSyntaxError("expected a schema expression")
+        return "".join(parts)
+
+    def _schema_or_cols(self, cur: Cursor) -> Any:
+        """COLUMNS a,b (names) or a:int,b:str (schema string)."""
+        start = cur.i
+        names = []
+        is_schema = False
+        while True:
+            t = cur.tok
+            if t.kind not in ("IDENT", "QIDENT"):
+                break
+            names.append(t.value)
+            cur.advance()
+            if cur.is_op(":"):
+                is_schema = True
+                break
+            if not cur.accept_op(","):
+                break
+        if is_schema:
+            cur.i = start
+            return self._schema_expr(cur)
+        return names
+
+    def _path(self, cur: Cursor) -> str:
+        # single- or double-quoted paths are both accepted
+        if cur.tok.kind not in ("STRING", "QIDENT"):
+            raise FugueSQLSyntaxError("expected a quoted path")
+        return cur.advance().value
+
+    # ---- fugue-json -----------------------------------------------------
+
+    def _json_pairs(self, cur: Cursor) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        while True:
+            t = cur.tok
+            if t.kind not in ("IDENT", "QIDENT", "STRING"):
+                break
+            key = cur.advance().value
+            if not cur.accept_op(":"):
+                cur.expect_op("=")
+            out[key] = self._json_value(cur)
+            if not cur.accept_op(","):
+                break
+        return out
+
+    def _json_value(self, cur: Cursor) -> Any:
+        t = cur.tok
+        if t.kind == "NUMBER":
+            cur.advance()
+            return float(t.value) if "." in t.value or \
+                "e" in t.value.lower() else int(t.value)
+        if t.kind in ("STRING", "QIDENT"):  # double quotes = string here
+            cur.advance()
+            return t.value
+        if t.kind == "IDENT":
+            u = t.upper
+            if u == "TRUE":
+                cur.advance()
+                return True
+            if u == "FALSE":
+                cur.advance()
+                return False
+            if u in ("NULL", "NONE"):
+                cur.advance()
+                return None
+            cur.advance()
+            return t.value  # bare word = string
+        if cur.accept_op("-") :
+            v = self._json_value(cur)
+            return -v
+        if cur.accept_op("["):
+            items = []
+            if not cur.accept_op("]"):
+                while True:
+                    items.append(self._json_value(cur))
+                    if not cur.accept_op(","):
+                        break
+                cur.expect_op("]")
+            return items
+        if cur.accept_op("{"):
+            obj: Dict[str, Any] = {}
+            if not cur.accept_op("}"):
+                obj = self._json_pairs(cur)
+                cur.expect_op("}")
+            return obj
+        raise FugueSQLSyntaxError(f"expected a value, got {t.value!r}")

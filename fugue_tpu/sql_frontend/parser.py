@@ -34,6 +34,10 @@ _RESERVED_AFTER_TABLE = {
     "PERSIST", "BROADCAST", "CHECKPOINT", "YIELD", "PREPARTITION",
     "TRANSFORM", "PROCESS", "OUTPUT", "PRINT", "SAVE", "LOAD", "TAKE",
     "SELECT", "WITH", "END", "DISTRIBUTE", "PRESORT", "SINGLE", "FROM",
+    "OUTTRANSFORM", "CREATE", "ZIP", "RENAME", "ALTER", "FILL", "SAMPLE",
+    "REPLACE", "SEED", "DETERMINISTIC", "LAZY", "WEAK", "STRONG",
+    "CALLBACK", "ROWCOUNT", "ROWS", "TITLE", "HASH", "RAND", "EVEN",
+    "COARSE", "DROP", "SCHEMA", "PARAMS", "COLUMNS", "OVERWRITE", "APPEND",
 }
 
 

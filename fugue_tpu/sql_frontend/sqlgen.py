@@ -1,0 +1,248 @@
+"""Serialize the SQL AST back to text with dataframe-reference parts —
+produces the ``StructuredRawSQL`` fragments that :class:`FugueSQLWorkflow`
+feeds to ``dag.select`` (the role of ``_beautify_sql`` + placeholder
+re-encoding in reference fugue/sql/_visitors.py:640-686)."""
+
+from typing import Callable, List, Optional, Set, Tuple
+
+from fugue_tpu.sql_frontend import ast
+
+__all__ = ["generate_parts"]
+
+
+def generate_parts(
+    q: ast.Query,
+    resolve_df: Callable[[str], Optional[str]],
+) -> List[Tuple[bool, str]]:
+    """Render ``q`` as ``(is_dataframe, text)`` parts. Table names are passed
+    through ``resolve_df``: a non-None return marks the name as a dataframe
+    reference part; None keeps it as plain SQL text (e.g. a CTE name)."""
+    gen = _Gen(resolve_df)
+    gen.query(q, set())
+    return gen.parts
+
+
+class _Gen:
+    def __init__(self, resolve_df: Callable[[str], Optional[str]]):
+        self.parts: List[Tuple[bool, str]] = []
+        self.resolve_df = resolve_df
+
+    def emit(self, text: str) -> None:
+        if self.parts and not self.parts[-1][0]:
+            self.parts[-1] = (False, self.parts[-1][1] + text)
+        else:
+            self.parts.append((False, text))
+
+    def emit_df(self, key: str) -> None:
+        self.parts.append((True, key))
+
+    # ---- queries --------------------------------------------------------
+
+    def query(self, q: ast.Query, ctes: Set[str]) -> None:
+        if isinstance(q, ast.With):
+            scoped = set(ctes)
+            self.emit("WITH ")
+            for i, (name, sub) in enumerate(q.ctes):
+                if i > 0:
+                    self.emit(", ")
+                self.emit(f"{name} AS (")
+                self.query(sub, scoped)
+                self.emit(")")
+                scoped.add(name.lower())
+            self.emit(" ")
+            self.query(q.body, scoped)
+            return
+        if isinstance(q, ast.SetOp):
+            self.query(q.left, ctes)
+            self.emit(f" {q.op}{' ALL' if q.all else ''} ")
+            self.query(q.right, ctes)
+            self._order_limit(q.order_by, q.limit, q.offset, ctes)
+            return
+        assert isinstance(q, ast.Select)
+        self.emit("SELECT ")
+        if q.distinct:
+            self.emit("DISTINCT ")
+        for i, item in enumerate(q.items):
+            if i > 0:
+                self.emit(", ")
+            if isinstance(item.expr, ast.Star):
+                self.emit(
+                    "*" if item.expr.table is None else f"{item.expr.table}.*"
+                )
+            else:
+                self.expr(item.expr, ctes)
+                if item.alias is not None:
+                    self.emit(f' AS "{item.alias}"')
+        if q.from_ is not None:
+            self.emit(" FROM ")
+            self.relation(q.from_, ctes)
+        if q.where is not None:
+            self.emit(" WHERE ")
+            self.expr(q.where, ctes)
+        if q.group_by:
+            self.emit(" GROUP BY ")
+            for i, g in enumerate(q.group_by):
+                if i > 0:
+                    self.emit(", ")
+                self.expr(g, ctes)
+        if q.having is not None:
+            self.emit(" HAVING ")
+            self.expr(q.having, ctes)
+        self._order_limit(q.order_by, q.limit, q.offset, ctes)
+
+    def _order_limit(
+        self,
+        order_by: List[ast.OrderItem],
+        limit: Optional[int],
+        offset: Optional[int],
+        ctes: Set[str],
+    ) -> None:
+        if order_by:
+            self.emit(" ORDER BY ")
+            for i, o in enumerate(order_by):
+                if i > 0:
+                    self.emit(", ")
+                self.expr(o.expr, ctes)
+                if not o.asc:
+                    self.emit(" DESC")
+                if o.nulls is not None:
+                    self.emit(f" NULLS {o.nulls}")
+        if limit is not None:
+            self.emit(f" LIMIT {limit}")
+        if offset is not None:
+            self.emit(f" OFFSET {offset}")
+
+    # ---- relations ------------------------------------------------------
+
+    def relation(self, rel: ast.Relation, ctes: Set[str]) -> None:
+        if isinstance(rel, ast.TableRef):
+            key = None if rel.name.lower() in ctes else \
+                self.resolve_df(rel.name)
+            if key is None:
+                self.emit(rel.name)
+            else:
+                self.emit_df(key)
+            alias = rel.alias or rel.name
+            self.emit(f' AS "{alias}"')
+            return
+        if isinstance(rel, ast.SubqueryRef):
+            self.emit("(")
+            self.query(rel.query, ctes)
+            self.emit(f') AS "{rel.alias}"')
+            return
+        assert isinstance(rel, ast.JoinRel)
+        self.relation(rel.left, ctes)
+        kw = {
+            "inner": "INNER JOIN", "cross": "CROSS JOIN",
+            "left_outer": "LEFT OUTER JOIN", "right_outer": "RIGHT OUTER JOIN",
+            "full_outer": "FULL OUTER JOIN", "semi": "LEFT SEMI JOIN",
+            "anti": "LEFT ANTI JOIN",
+        }[rel.how]
+        self.emit(f" {kw} ")
+        self.relation(rel.right, ctes)
+        if rel.on is not None:
+            self.emit(" ON ")
+            self.expr(rel.on, ctes)
+        elif rel.using is not None:
+            self.emit(" USING (" + ", ".join(rel.using) + ")")
+
+    # ---- expressions ----------------------------------------------------
+
+    def expr(self, e: ast.Expr, ctes: Set[str]) -> None:
+        if isinstance(e, ast.Lit):
+            v = e.value
+            if v is None:
+                self.emit("NULL")
+            elif isinstance(v, bool):
+                self.emit("TRUE" if v else "FALSE")
+            elif isinstance(v, str):
+                self.emit("'" + v.replace("'", "''") + "'")
+            else:
+                self.emit(repr(v))
+            return
+        if isinstance(e, ast.Col):
+            name = f'"{e.name}"' if not e.name.isidentifier() else e.name
+            self.emit(name if e.table is None else f"{e.table}.{name}")
+            return
+        if isinstance(e, ast.Star):
+            self.emit("*" if e.table is None else f"{e.table}.*")
+            return
+        if isinstance(e, ast.Unary):
+            if e.op == "NOT":
+                self.emit("NOT (")
+                self.expr(e.operand, ctes)
+                self.emit(")")
+            else:
+                self.emit(f"{e.op}(")
+                self.expr(e.operand, ctes)
+                self.emit(")")
+            return
+        if isinstance(e, ast.Binary):
+            self.emit("(")
+            self.expr(e.left, ctes)
+            self.emit(f" {e.op} ")
+            self.expr(e.right, ctes)
+            self.emit(")")
+            return
+        if isinstance(e, ast.Func):
+            self.emit(e.name.upper() + "(")
+            if e.distinct:
+                self.emit("DISTINCT ")
+            for i, a in enumerate(e.args):
+                if i > 0:
+                    self.emit(", ")
+                self.expr(a, ctes)
+            self.emit(")")
+            return
+        if isinstance(e, ast.Case):
+            self.emit("CASE")
+            if e.operand is not None:
+                self.emit(" ")
+                self.expr(e.operand, ctes)
+            for cond, val in e.whens:
+                self.emit(" WHEN ")
+                self.expr(cond, ctes)
+                self.emit(" THEN ")
+                self.expr(val, ctes)
+            if e.default is not None:
+                self.emit(" ELSE ")
+                self.expr(e.default, ctes)
+            self.emit(" END")
+            return
+        if isinstance(e, ast.Cast):
+            self.emit("CAST(")
+            self.expr(e.operand, ctes)
+            self.emit(f" AS {e.type_name})")
+            return
+        if isinstance(e, ast.InList):
+            self.emit("(")
+            self.expr(e.operand, ctes)
+            self.emit(" NOT IN (" if e.negated else " IN (")
+            for i, item in enumerate(e.items):
+                if i > 0:
+                    self.emit(", ")
+                self.expr(item, ctes)
+            self.emit("))")
+            return
+        if isinstance(e, ast.Between):
+            self.emit("(")
+            self.expr(e.operand, ctes)
+            self.emit(" NOT BETWEEN " if e.negated else " BETWEEN ")
+            self.expr(e.low, ctes)
+            self.emit(" AND ")
+            self.expr(e.high, ctes)
+            self.emit(")")
+            return
+        if isinstance(e, ast.Like):
+            self.emit("(")
+            self.expr(e.operand, ctes)
+            self.emit(" NOT LIKE " if e.negated else " LIKE ")
+            self.expr(e.pattern, ctes)
+            self.emit(")")
+            return
+        if isinstance(e, ast.IsNull):
+            self.emit("(")
+            self.expr(e.operand, ctes)
+            self.emit(" IS NOT NULL)" if e.negated else " IS NULL)")
+            return
+        raise ValueError(f"cannot serialize {type(e).__name__}")

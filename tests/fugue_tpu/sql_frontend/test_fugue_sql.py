@@ -1,0 +1,260 @@
+import os
+
+import pandas as pd
+import pytest
+
+from fugue_tpu.sql_frontend.api import fugue_sql, fugue_sql_flow
+from fugue_tpu.sql_frontend.fugue_parser import FugueSQLSyntaxError
+from fugue_tpu.sql_frontend.workflow_sql import FugueSQLWorkflow
+
+
+def _pd(res):
+    if isinstance(res, pd.DataFrame):
+        return res
+    return res.to_pandas()
+
+
+def tr_add(df: pd.DataFrame, delta: int = 1) -> pd.DataFrame:
+    return df.assign(b=df["a"] + delta)
+
+
+def test_create_and_select():
+    res = fugue_sql(
+        """
+        a = CREATE [[0, "x"], [1, "y"]] SCHEMA n:long,s:str
+        SELECT s, n + 1 AS m FROM a WHERE n > 0
+        """
+    )
+    assert _pd(res).values.tolist() == [["y", 2]]
+
+
+def test_select_from_last():
+    res = fugue_sql(
+        """
+        CREATE [[1], [2], [3]] SCHEMA a:long
+        SELECT a * 10 AS a
+        SELECT SUM(a) AS s
+        """
+    )
+    assert _pd(res)["s"].tolist() == [60]
+
+
+def test_transform_using_local_func():
+    res = fugue_sql(
+        """
+        CREATE [[1], [2]] SCHEMA a:long
+        TRANSFORM USING tr_add(delta:10) SCHEMA a:long,b:long
+        """,
+        tr_add=tr_add,
+    )
+    assert _pd(res)["b"].tolist() == [11, 12]
+
+
+def test_transform_prepartition():
+    def largest(df: pd.DataFrame) -> pd.DataFrame:
+        return df.head(1)
+
+    res = fugue_sql(
+        """
+        CREATE [["x", 1], ["x", 5], ["y", 2]] SCHEMA k:str,v:long
+        TRANSFORM PREPARTITION BY k PRESORT v DESC USING largest
+        SCHEMA k:str,v:long
+        SELECT * FROM __fugue_auto__ ORDER BY k
+        """.replace("FROM __fugue_auto__ ", ""),
+        largest=largest,
+    )
+    vals = sorted(_pd(res).values.tolist())
+    assert vals == [["x", 5], ["y", 2]]
+
+
+def test_outtransform_and_callback():
+    hits = []
+
+    def sink(df: pd.DataFrame) -> None:
+        hits.append(len(df))
+
+    fugue_sql_flow(
+        """
+        CREATE [[1], [2]] SCHEMA a:long
+        OUTTRANSFORM USING sink
+        """,
+        sink=sink,
+    ).run()
+    assert hits == [2]
+
+
+def test_process_and_output():
+    seen = []
+
+    def double(df: pd.DataFrame) -> pd.DataFrame:
+        return pd.concat([df, df])
+
+    def count(df: pd.DataFrame) -> None:
+        seen.append(len(df))
+
+    fugue_sql_flow(
+        """
+        CREATE [[1]] SCHEMA a:long
+        PROCESS USING double SCHEMA a:long
+        OUTPUT USING count
+        """,
+        double=double,
+        count=count,
+    ).run()
+    assert seen == [2]
+
+
+def test_print(capsys):
+    fugue_sql_flow(
+        """
+        CREATE [[1], [2]] SCHEMA a:long
+        PRINT 1 ROWS TITLE "mytitle"
+        """
+    ).run()
+    out = capsys.readouterr().out
+    assert "mytitle" in out
+
+
+def test_save_load(tmp_path):
+    path = os.path.join(str(tmp_path), "t.parquet")
+    fugue_sql_flow(
+        f"""
+        CREATE [[1], [2]] SCHEMA a:long
+        SAVE OVERWRITE "{path}"
+        """
+    ).run()
+    res = fugue_sql(f'LOAD "{path}"\nSELECT SUM(a) AS s')
+    assert _pd(res)["s"].tolist() == [3]
+
+
+def test_yield_flow():
+    dag = fugue_sql_flow(
+        """
+        a = CREATE [[1], [2]] SCHEMA x:long
+        b = SELECT x * 2 AS x FROM a
+        YIELD DATAFRAME AS doubled
+        """
+    )
+    res = dag.run()
+    assert res["doubled"].as_array() == [[2], [4]]
+
+
+def test_assignment_and_reuse():
+    res = fugue_sql(
+        """
+        a = CREATE [[1], [2]] SCHEMA x:long
+        b = SELECT x + 1 AS x FROM a
+        SELECT a.x AS ax, b.x AS bx FROM a INNER JOIN b ON a.x = b.x
+        """
+    )
+    assert _pd(res).values.tolist() == [[2, 2]]
+
+
+def test_take_sample_fill_drop_rename_alter():
+    res = fugue_sql(
+        """
+        CREATE [[1, "x"], [2, NULL], [3, "z"]] SCHEMA a:long,s:str
+        FILL NULLS PARAMS s:"?"
+        TAKE 2 ROWS PRESORT a DESC
+        RENAME COLUMNS s:t
+        SELECT a, t FROM __l__
+        """.replace(" FROM __l__", ""),
+    )
+    vals = sorted(_pd(res).values.tolist())
+    assert vals == [[2, "?"], [3, "z"]]
+
+
+def test_drop_columns_and_rows():
+    res = fugue_sql(
+        """
+        CREATE [[1, "x"], [2, NULL]] SCHEMA a:long,s:str
+        DROP ROWS IF ANY NULL
+        """,
+        as_fugue=True,
+    )
+    assert res.as_array() == [[1, "x"]]
+    res = fugue_sql(
+        """
+        CREATE [[1, "x"]] SCHEMA a:long,s:str
+        DROP COLUMNS s
+        """,
+        as_fugue=True,
+    )
+    assert res.schema.names == ["a"]
+
+
+def test_distinct_via_sql():
+    res = fugue_sql(
+        """
+        CREATE [[1], [1], [2]] SCHEMA a:long
+        SELECT DISTINCT a ORDER BY a
+        """
+    )
+    assert _pd(res)["a"].tolist() == [1, 2]
+
+
+def test_persist_broadcast_checkpoint():
+    dag = fugue_sql_flow(
+        """
+        a = CREATE [[1]] SCHEMA x:long
+        PERSIST
+        b = SELECT x FROM a
+        BROADCAST
+        YIELD DATAFRAME AS out
+        """
+    )
+    res = dag.run()
+    assert res["out"].as_array() == [[1]]
+
+
+def test_cotransform_via_multiple_dfs():
+    from fugue_tpu.dataframe import DataFrames
+
+    def merge_count(dfs: DataFrames) -> pd.DataFrame:
+        return pd.DataFrame({"n": [sum(x.count() for x in dfs.values())]})
+
+    res = fugue_sql(
+        """
+        a = CREATE [["x", 1], ["x", 2], ["y", 3]] SCHEMA k:str,v:long
+        b = CREATE [["x", 9]] SCHEMA k:str,w:long
+        TRANSFORM a, b PREPARTITION BY k USING merge_count SCHEMA n:long
+        """,
+        merge_count=merge_count,
+    )
+    assert sorted(_pd(res)["n"].tolist()) == [3]
+
+
+def test_incremental_workflow():
+    dag = FugueSQLWorkflow()
+    dag("a = CREATE [[5]] SCHEMA x:long")
+    dag("b = SELECT x + 1 AS x FROM a \n YIELD DATAFRAME AS out")
+    res = dag.run()
+    assert res["out"].as_array() == [[6]]
+
+
+def test_jinja_template():
+    res = fugue_sql(
+        """
+        CREATE [[1], [2], [3]] SCHEMA a:long
+        SELECT * WHERE a >= {{low}}
+        """,
+        low=2,
+    )
+    assert _pd(res)["a"].tolist() == [2, 3]
+
+
+def test_undefined_df_raises():
+    with pytest.raises(FugueSQLSyntaxError):
+        fugue_sql_flow("SELECT * FROM nosuchdf")
+
+
+def test_jax_engine_fugue_sql():
+    res = fugue_sql(
+        """
+        CREATE [["x", 1], ["x", 2], ["y", 3]] SCHEMA k:str,v:long
+        SELECT k, SUM(v) AS s GROUP BY k
+        """,
+        engine="jax",
+        as_local=True,
+    )
+    assert sorted(_pd(res).values.tolist()) == [["x", 3], ["y", 3]]
