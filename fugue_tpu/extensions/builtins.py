@@ -382,10 +382,12 @@ class SaveAndUse(Processor):
         path = self.params.get_or_throw("path", str)
         format_hint = self.params.get("fmt", "")
         mode = self.params.get("mode", "overwrite")
+        force_single = self.params.get("single", False)
         self.execution_engine.save_df(
             dfs[0], path=path,
             format_hint=format_hint if format_hint != "" else None,
-            mode=mode, partition_spec=self.partition_spec, **kwargs,
+            mode=mode, partition_spec=self.partition_spec,
+            force_single=force_single, **kwargs,
         )
         return self.execution_engine.load_df(
             path, format_hint=format_hint if format_hint != "" else None

@@ -49,7 +49,7 @@ from fugue_tpu.execution.native_execution_engine import (
     PandasMapEngine,
     PandasSQLEngine,
 )
-from fugue_tpu.jax_backend import expr_eval, groupby
+from fugue_tpu.jax_backend import expr_eval, groupby, relational
 from fugue_tpu.jax_backend.blocks import (
     JaxBlocks,
     JaxColumn,
@@ -94,6 +94,9 @@ class JaxMapEngine(MapEngine):
                 return self._compiled_map(
                     jdf, raw, output_schema, partition_spec, on_init
                 )
+            engine._count_fallback(
+                "map", "jax-hinted transformer not device-mappable"
+            )
         # host fallback: exact reference semantics via the pandas map engine;
         # fugue.jax.default.partitions sets the split count when the spec
         # doesn't name one
@@ -312,6 +315,27 @@ class JaxExecutionEngine(ExecutionEngine):
         self._mesh = mesh if mesh is not None else make_mesh()
         # host sibling used for fallback relational ops
         self._native = NativeExecutionEngine(conf)
+        # host-fallback observability: op name -> count. Silent fallbacks
+        # are silent 100x slowdowns (verdict r2); every host round-trip on
+        # an op with a device path increments this and logs at info, so
+        # tests/benches can assert a pipeline stayed on device.
+        self._fallbacks: Dict[str, int] = {}
+
+    @property
+    def fallbacks(self) -> Dict[str, int]:
+        """Host-fallback counters since construction (or `reset_fallbacks`)."""
+        return dict(self._fallbacks)
+
+    def reset_fallbacks(self) -> None:
+        self._fallbacks.clear()
+
+    def _count_fallback(self, op: str, why: str = "") -> None:
+        self._fallbacks[op] = self._fallbacks.get(op, 0) + 1
+        self.log.info(
+            "fugue_tpu.jax host fallback: %s%s",
+            op,
+            f" ({why})" if why else "",
+        )
 
     @property
     def mesh(self) -> Any:
@@ -378,6 +402,7 @@ class JaxExecutionEngine(ExecutionEngine):
             if res is not None:
                 return res
         # fallback gets the ORIGINAL frame + where (avoid double filtering)
+        self._count_fallback("select")
         return self.to_df(
             self._native.select(jdf.as_local_bounded(), cols, where, having)
         )
@@ -421,6 +446,7 @@ class JaxExecutionEngine(ExecutionEngine):
                 ),
                 jdf.schema,
             )
+        self._count_fallback("filter")
         return self.to_df(self._native.filter(jdf.as_local_bounded(), condition))
 
     def assign(self, df: DataFrame, columns: List[ColumnExpr]) -> DataFrame:
@@ -466,6 +492,7 @@ class JaxExecutionEngine(ExecutionEngine):
                     else jax.device_put(outs[f"m:{name}"], sharding),
                 )
             return JaxDataFrame(blocks_with_columns(blocks, new_cols), schema)
+        self._count_fallback("assign")
         return self.to_df(self._native.assign(jdf.as_local_bounded(), columns))
 
     def aggregate(
@@ -479,6 +506,7 @@ class JaxExecutionEngine(ExecutionEngine):
         res = self._try_device_aggregate(jdf, keys, agg_cols)
         if res is not None:
             return res
+        self._count_fallback("aggregate")
         return self.to_df(
             self._native.aggregate(
                 jdf.as_local_bounded(), partition_spec, agg_cols
@@ -510,11 +538,70 @@ class JaxExecutionEngine(ExecutionEngine):
         how: str,
         on: Optional[List[str]] = None,
     ) -> DataFrame:
+        """Device join via shared key factorization (see relational.py):
+        semi/anti flip validity masks (zero syncs); inner/left/right/full/
+        cross enumerate matches on device with ONE host sync for the output
+        row count. Null keys never match (SQL). Falls back to the host
+        pandas path only for host-resident (nested/binary) columns."""
+        from fugue_tpu.dataframe.utils import get_join_schemas
+
+        j1: JaxDataFrame = self.to_df(df1)  # type: ignore
+        j2: JaxDataFrame = self.to_df(df2)  # type: ignore
+        hownorm = how.lower().replace("_", "").replace(" ", "")
+        key_schema, output_schema = get_join_schemas(j1, j2, hownorm, on)
+        keys = list(key_schema.names)
+        b1, b2 = j1.blocks, j2.blocks
+        if relational.device_joinable(
+            b1, b2, j1.schema.names, j2.schema.names
+        ):
+            if hownorm in ("semi", "leftsemi", "anti", "leftanti"):
+                out = relational.semi_anti_join(
+                    self, b1, b2, keys, anti=hownorm in ("anti", "leftanti")
+                )
+                return JaxDataFrame(out, output_schema)
+            if hownorm in ("inner", "cross", "leftouter", "fullouter"):
+                out = relational.expand_join(
+                    self, b1, b2, keys, hownorm, j1.schema, j2.schema,
+                    output_schema,
+                )
+                return JaxDataFrame(out, output_schema)
+            if hownorm == "rightouter":
+                # left join with sides swapped, columns reordered
+                _, swapped_schema = get_join_schemas(
+                    j2, j1, "leftouter", keys
+                )
+                out = relational.expand_join(
+                    self, b2, b1, keys, "leftouter", j2.schema, j1.schema,
+                    swapped_schema,
+                )
+                cols = {
+                    n: out.columns[n] for n in output_schema.names
+                }
+                return JaxDataFrame(
+                    JaxBlocks(
+                        out._nrows, cols, out.mesh,
+                        row_valid=out.row_valid, nrows_dev=out._nrows_dev,
+                    ),
+                    output_schema,
+                )
+        self._count_fallback("join", "host-resident columns")
         return self._host_op(
             lambda a, b: self._native.join(a, b, how=how, on=on), df1, df2
         )
 
     def union(self, df1: DataFrame, df2: DataFrame, distinct: bool = True) -> DataFrame:
+        j1: JaxDataFrame = self.to_df(df1)  # type: ignore
+        j2: JaxDataFrame = self.to_df(df2)  # type: ignore
+        assert_or_throw(
+            j1.schema == j2.schema,
+            ValueError(f"union schema mismatch {j1.schema} vs {j2.schema}"),
+        )
+        if j1.blocks.all_on_device and j2.blocks.all_on_device:
+            out = JaxDataFrame(
+                relational.union_all_blocks(j1.blocks, j2.blocks), j1.schema
+            )
+            return self.distinct(out) if distinct else out
+        self._count_fallback("union", "host-resident columns")
         return self._host_op(
             lambda a, b: self._native.union(a, b, distinct=distinct), df1, df2
         )
@@ -522,15 +609,37 @@ class JaxExecutionEngine(ExecutionEngine):
     def subtract(
         self, df1: DataFrame, df2: DataFrame, distinct: bool = True
     ) -> DataFrame:
-        return self._host_op(
-            lambda a, b: self._native.subtract(a, b, distinct=distinct), df1, df2
-        )
+        return self._set_op(df1, df2, distinct, subtract=True)
 
     def intersect(
         self, df1: DataFrame, df2: DataFrame, distinct: bool = True
     ) -> DataFrame:
+        return self._set_op(df1, df2, distinct, subtract=False)
+
+    def _set_op(
+        self, df1: DataFrame, df2: DataFrame, distinct: bool, subtract: bool
+    ) -> DataFrame:
+        name = "subtract" if subtract else "intersect"
+        j1: JaxDataFrame = self.to_df(df1)  # type: ignore
+        j2: JaxDataFrame = self.to_df(df2)  # type: ignore
+        assert_or_throw(
+            j1.schema == j2.schema,
+            ValueError(f"{name} schema mismatch {j1.schema} vs {j2.schema}"),
+        )
+        assert_or_throw(
+            distinct, NotImplementedError(f"{name.upper()} ALL not supported")
+        )
+        if j1.blocks.all_on_device and j2.blocks.all_on_device:
+            out = relational.intersect_subtract(
+                self, j1.blocks, j2.blocks, j1.schema.names, subtract
+            )
+            return JaxDataFrame(out, j1.schema)
+        self._count_fallback(name, "host-resident columns")
+        host = (
+            self._native.subtract if subtract else self._native.intersect
+        )
         return self._host_op(
-            lambda a, b: self._native.intersect(a, b, distinct=distinct), df1, df2
+            lambda a, b: host(a, b, distinct=distinct), df1, df2
         )
 
     def distinct(self, df: DataFrame) -> DataFrame:
@@ -573,6 +682,7 @@ class JaxExecutionEngine(ExecutionEngine):
                 ),
                 jdf.schema,
             )
+        self._count_fallback("distinct")
         return self.to_df(self._native.distinct(jdf.as_local_bounded()))
 
     def dropna(
@@ -630,6 +740,7 @@ class JaxExecutionEngine(ExecutionEngine):
                 ),
                 jdf.schema,
             )
+        self._count_fallback("dropna")
         return self.to_df(
             self._native.dropna(
                 jdf.as_local_bounded(), how=how, thresh=thresh, subset=subset
@@ -640,6 +751,7 @@ class JaxExecutionEngine(ExecutionEngine):
         self, df: DataFrame, value: Any, subset: Optional[List[str]] = None
     ) -> DataFrame:
         jdf: JaxDataFrame = self.to_df(df)  # type: ignore
+        self._count_fallback("fillna")
         return self.to_df(
             self._native.fillna(jdf.as_local_bounded(), value=value, subset=subset)
         )
@@ -681,6 +793,7 @@ class JaxExecutionEngine(ExecutionEngine):
         partition_spec: Optional[PartitionSpec] = None,
     ) -> DataFrame:
         jdf: JaxDataFrame = self.to_df(df)  # type: ignore
+        self._count_fallback("take")
         return self.to_df(
             self._native.take(
                 jdf.as_local_bounded(), n, presort, na_position, partition_spec

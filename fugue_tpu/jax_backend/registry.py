@@ -34,14 +34,23 @@ class JaxArraysParam(AnnotatedParam):
 
     def to_input(self, df: Any, ctx: Dict[str, Any]) -> Any:
         # contract: jax transformers see NUMERIC/bool columns (strings and
-        # nested types don't exist on device; use a pandas transformer there)
+        # nested types don't exist on device; use a pandas transformer there).
+        # The ABI matches the compiled whole-shard path (JaxMapEngine.
+        # _compiled_map): ``_row_valid`` / ``_nrows`` / ``_segment_ids`` /
+        # ``_num_segments`` are always present so a transformer written to
+        # the documented contract runs unmodified on host engines — here each
+        # call is exactly one logical partition, i.e. one segment.
         pdf = df.as_pandas()
         res: Dict[str, Any] = {}
         for c in pdf.columns:
             np_col = pdf[c].to_numpy()
             if np_col.dtype.kind in "biuf":
                 res[str(c)] = jnp.asarray(np_col)
-        res["_nrows"] = len(pdf)
+        n = len(pdf)
+        res["_nrows"] = jnp.int32(n)
+        res["_row_valid"] = jnp.ones((n,), dtype=bool)
+        res["_segment_ids"] = jnp.zeros((n,), dtype=jnp.int32)
+        res["_num_segments"] = 1
         return res
 
     def to_output_df(self, output: Any, schema: Schema, ctx: Dict[str, Any]) -> Any:

@@ -1,0 +1,644 @@
+"""Device relational ops: joins and set operations on mesh-sharded blocks.
+
+TPU-first design (replaces the reference's engine-delegated joins,
+fugue/execution/execution_engine.py:547-741, which lower to Spark/Dask
+shuffles): both sides' key columns are factorized into ONE shared segment
+space using the group-by machinery (groupby.py), then
+
+- **semi / anti** are mask-only: flip the left frame's row validity by a
+  per-segment occupancy test — no gather, no shuffle, zero host syncs.
+- **inner / left / right / full / cross** expand matches with a
+  counts -> exclusive-cumsum -> searchsorted enumeration entirely on
+  device; ONE host sync reads the output row count (joins change
+  cardinality, so a static output shape needs exactly one readback).
+- **union** concatenates padded blocks (validity masks make the seam
+  invisible); **intersect / subtract** are mask-only occupancy tests over
+  a full-row factorization (SQL set-op semantics: NULLs compare equal,
+  which the factorizer's null buckets give for free).
+
+String keys join by dictionary code after re-encoding both sides into a
+shared dictionary (host work proportional to the dictionaries, not the
+data). Null JOIN keys never match (SQL): rows with any null key get the
+out-of-range sentinel segment, so every occupancy/count test skips them.
+"""
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from fugue_tpu.jax_backend import groupby
+from fugue_tpu.jax_backend.blocks import (
+    JaxBlocks,
+    JaxColumn,
+    padded_len,
+    row_sharding,
+)
+from fugue_tpu.schema import Schema
+from fugue_tpu.utils.assertion import assert_or_throw
+
+
+def _common_dtype(d1: Any, d2: Any) -> Any:
+    return jnp.result_type(d1, d2)
+
+
+def harmonize_string_keys(
+    c1: JaxColumn, c2: JaxColumn
+) -> Tuple[JaxColumn, JaxColumn, np.ndarray]:
+    """Re-encode two dictionary columns into one shared dictionary.
+    Side 1 keeps its codes (the union dictionary extends side 1's);
+    side 2's codes are remapped with one device table-gather."""
+    d1, d2 = c1.dictionary, c2.dictionary
+    if d1 is d2 or (len(d1) == len(d2) and (d1 == d2).all()):
+        return c1, c2, d1
+    index1 = {v: i for i, v in enumerate(d1)}
+    map2 = np.empty(max(len(d2), 1), dtype=np.int32)
+    extra: List[Any] = []
+    for i, v in enumerate(d2):
+        j = index1.get(v)
+        if j is None:
+            j = len(d1) + len(extra)
+            extra.append(v)
+        map2[i] = j
+    union = (
+        np.concatenate([d1, np.asarray(extra, dtype=object)])
+        if extra
+        else d1
+    )
+    new_codes2 = jnp.asarray(map2)[jnp.clip(c2.data, 0, max(len(d2) - 1, 0))]
+    hi = max(len(union) - 1, 0)
+    out1 = JaxColumn(c1.pa_type, c1.data, c1.mask, union, (0, hi))
+    out2 = JaxColumn(c2.pa_type, new_codes2, c2.mask, union, (0, hi))
+    return out1, out2, union
+
+
+def _merged_stats(
+    c1: JaxColumn, c2: JaxColumn
+) -> Optional[Tuple[int, int]]:
+    if c1.stats is None or c2.stats is None:
+        return None
+    return (min(c1.stats[0], c2.stats[0]), max(c1.stats[1], c2.stats[1]))
+
+
+def concat_key_blocks(
+    b1: JaxBlocks, b2: JaxBlocks, keys: List[str]
+) -> Tuple[JaxBlocks, int, int]:
+    """A combined frame holding both sides' key columns stacked along the
+    row axis (side 1 rows first). Padding rows of each side stay invalid,
+    so no compaction is needed — factorization sees them as non-rows.
+    Returns (combined, p1, p2) where p1/p2 are each side's padded length."""
+    p1, p2 = b1.padded_nrows, b2.padded_nrows
+    sharding = row_sharding(b1.mesh)
+    cols: Dict[str, JaxColumn] = {}
+    for k in keys:
+        c1, c2 = b1.columns[k], b2.columns[k]
+        if c1.is_string:
+            c1, c2, _ = harmonize_string_keys(c1, c2)
+        dt = _common_dtype(c1.data.dtype, c2.data.dtype)
+        data = jnp.concatenate([c1.data.astype(dt), c2.data.astype(dt)])
+        if c1.mask is not None or c2.mask is not None:
+            m1 = (
+                c1.mask
+                if c1.mask is not None
+                else jnp.ones((p1,), dtype=bool)
+            )
+            m2 = (
+                c2.mask
+                if c2.mask is not None
+                else jnp.ones((p2,), dtype=bool)
+            )
+            mask: Optional[Any] = jax.device_put(
+                jnp.concatenate([m1, m2]), sharding
+            )
+        else:
+            mask = None
+        cols[k] = JaxColumn(
+            c1.pa_type,
+            jax.device_put(data, sharding),
+            mask,
+            c1.dictionary,
+            _merged_stats(c1, c2),
+        )
+    row_valid = jax.device_put(
+        jnp.concatenate([b1.validity(), b2.validity()]), sharding
+    )
+    combined = JaxBlocks(None, cols, b1.mesh, row_valid=row_valid)
+    return combined, p1, p2
+
+
+class SharedFactorization:
+    """Both sides' keys in one segment space."""
+
+    def __init__(
+        self,
+        seg1: Any,
+        seg2: Any,
+        num_segments: int,
+        b1: JaxBlocks,
+        b2: JaxBlocks,
+        keys: List[str],
+    ):
+        self.seg1 = seg1  # int32[p1], sentinel num_segments for non-rows
+        self.seg2 = seg2
+        self.num_segments = num_segments
+        self.b1 = b1
+        self.b2 = b2
+        self.keys = keys
+
+
+def shared_factorize(
+    b1: JaxBlocks, b2: JaxBlocks, keys: List[str]
+) -> SharedFactorization:
+    combined, p1, p2 = concat_key_blocks(b1, b2, keys)
+    fr = groupby.factorize_keys(combined, keys)
+    return SharedFactorization(
+        fr.seg[:p1], fr.seg[p1:], fr.num_segments, b1, b2, keys
+    )
+
+
+def _null_any_mask(b: JaxBlocks, keys: List[str]) -> Optional[Any]:
+    """True where ANY key is null (such rows never match in a JOIN)."""
+    masks = [
+        b.columns[k].mask for k in keys if b.columns[k].mask is not None
+    ]
+    if not masks:
+        return None
+    nn = masks[0]
+    for m in masks[1:]:
+        nn = nn & m
+    return ~nn
+
+
+def device_joinable(
+    b1: JaxBlocks, b2: JaxBlocks, names1: List[str], names2: List[str]
+) -> bool:
+    return all(
+        n in b1.columns and b1.columns[n].on_device for n in names1
+    ) and all(n in b2.columns and b2.columns[n].on_device for n in names2)
+
+
+# ---------------------------------------------------------------------------
+# semi / anti: mask-only
+# ---------------------------------------------------------------------------
+
+
+def semi_anti_join(
+    engine: Any, b1: JaxBlocks, b2: JaxBlocks, keys: List[str], anti: bool
+) -> JaxBlocks:
+    sf = shared_factorize(b1, b2, keys)
+    S = sf.num_segments
+    null1 = _null_any_mask(b1, keys)
+    null2 = _null_any_mask(b2, keys)
+    p1 = b1.padded_nrows
+
+    def _prog(
+        seg1: Any,
+        seg2: Any,
+        v2: Any,
+        n2m: Optional[Any],
+        rv1: Optional[Any],
+        n1m: Optional[Any],
+        nrows1: Any,
+    ) -> Tuple[Any, Any]:
+        valid1 = groupby.materialize_validity(rv1, p1, nrows1)
+        match2 = v2 if n2m is None else (v2 & ~n2m)
+        # out-of-range seg ids contribute nothing to segment_sum
+        c2 = jax.ops.segment_sum(
+            match2.astype(jnp.int32),
+            jnp.where(match2, seg2, S),
+            num_segments=S,
+        )
+        hit = c2[jnp.clip(seg1, 0, max(S - 1, 0))] > 0
+        matchable1 = valid1 if n1m is None else (valid1 & ~n1m)
+        if S == 0:
+            hit = jnp.zeros_like(valid1)
+        if anti:
+            keep = valid1 & (~matchable1 | ~hit)
+        else:
+            keep = matchable1 & hit
+        return keep, jnp.sum(keep).astype(jnp.int32)
+
+    keep, cnt = engine._jit_cached(
+        ("semi_anti", anti, S, p1, b2.padded_nrows, tuple(keys)), _prog
+    )(
+        sf.seg1,
+        sf.seg2,
+        b2.validity(),
+        null2,
+        b1.row_valid,
+        null1,
+        _nrows_arg(b1),
+    )
+    return JaxBlocks(
+        None, dict(b1.columns), b1.mesh, row_valid=keep, nrows_dev=cnt
+    )
+
+
+# ---------------------------------------------------------------------------
+# inner / left_outer (right/full build on these)
+# ---------------------------------------------------------------------------
+
+
+def expand_join(
+    engine: Any,
+    b1: JaxBlocks,
+    b2: JaxBlocks,
+    keys: List[str],
+    how: str,  # "inner" | "leftouter" | "fullouter" | "cross"
+    schema1: Schema,
+    schema2: Schema,
+    out_schema: Schema,
+) -> JaxBlocks:
+    """Match-enumerating join. Phase 1 (device): per-left-row match counts
+    and the sorted-by-segment ordering of the right side. One host sync
+    reads the output size(s). Phase 2 (device): enumerate output rows by
+    searchsorted over the exclusive cumsum, gather both sides."""
+    mesh = b1.mesh
+    p1, p2 = b1.padded_nrows, b2.padded_nrows
+    is_cross = how == "cross"
+    if is_cross:
+        S = 1
+        seg1 = jnp.zeros((p1,), dtype=jnp.int32)
+        seg2 = jnp.zeros((p2,), dtype=jnp.int32)
+        null1 = null2 = None
+    else:
+        sf = shared_factorize(b1, b2, keys)
+        S, seg1, seg2 = sf.num_segments, sf.seg1, sf.seg2
+        null1 = _null_any_mask(b1, keys)
+        null2 = _null_any_mask(b2, keys)
+    S = max(S, 1)
+    outer_left = how in ("leftouter", "fullouter")
+
+    def _count_prog(
+        seg1_: Any,
+        seg2_: Any,
+        rv1: Optional[Any],
+        n1: Any,
+        v2: Any,
+        n1m: Optional[Any],
+        n2m: Optional[Any],
+    ) -> Tuple[Any, Any, Any, Any, Any, Any, Any]:
+        valid1 = groupby.materialize_validity(rv1, p1, n1)
+        match2 = v2 if n2m is None else (v2 & ~n2m)
+        seg2s = jnp.where(match2, seg2_, S)
+        c2 = jax.ops.segment_sum(
+            match2.astype(jnp.int32), seg2s, num_segments=S
+        )
+        matchable1 = valid1 if n1m is None else (valid1 & ~n1m)
+        m = jnp.where(matchable1, c2[jnp.clip(seg1_, 0, S - 1)], 0)
+        reps = jnp.where(
+            valid1, jnp.maximum(m, 1) if outer_left else m, 0
+        )
+        total = jnp.sum(reps)
+        start = jnp.cumsum(reps) - reps
+        # right side grouped by segment: stable order, non-rows last
+        order2 = jnp.argsort(seg2s, stable=True).astype(jnp.int32)
+        cstart2 = jnp.cumsum(c2) - c2
+        # right-unmatched count (full outer only; cheap either way)
+        c1 = jax.ops.segment_sum(
+            matchable1.astype(jnp.int32),
+            jnp.where(matchable1, seg1_, S),
+            num_segments=S,
+        )
+        un2 = v2 & (
+            ~match2 | (c1[jnp.clip(seg2_, 0, S - 1)] == 0)
+        )
+        r_total = jnp.sum(un2.astype(jnp.int32))
+        order_un2 = jnp.argsort(~un2, stable=True).astype(jnp.int32)
+        return m, start, order2, cstart2, total, r_total, order_un2
+
+    m, start, order2, cstart2, total, r_total, order_un2 = engine._jit_cached(
+        ("join_count", how, S, p1, p2, tuple(keys)), _count_prog
+    )(
+        seg1,
+        seg2,
+        b1.row_valid,
+        _nrows_arg(b1),
+        b2.validity(),
+        null1,
+        null2,
+    )
+    # THE one host sync of the join: output cardinality
+    M = int(total)
+    R = int(r_total) if how == "fullouter" else 0
+    ndev = int(mesh.devices.size)
+    out_pad = padded_len(M, ndev)
+    sharding = row_sharding(mesh)
+
+    d1 = {n: b1.columns[n] for n in schema1.names}
+    other2 = [n for n in schema2.names if n not in schema1.names]
+    d2 = {n: b2.columns[n] for n in other2}
+    # harmonize output string columns BEFORE gathering so full-outer's
+    # appended right rows share dictionaries (keys only; non-key columns
+    # come from exactly one side)
+    key_cols2: Dict[str, JaxColumn] = {}
+    if how == "fullouter":
+        for k in keys:
+            c1h, c2h, _ = (
+                harmonize_string_keys(d1[k], b2.columns[k])
+                if d1[k].is_string
+                else (d1[k], b2.columns[k], None)
+            )
+            d1[k] = c1h
+            key_cols2[k] = c2h
+
+    def _gather_prog(
+        datas1: Dict[str, Any],
+        masks1: Dict[str, Any],
+        datas2: Dict[str, Any],
+        masks2: Dict[str, Any],
+        m_: Any,
+        start_: Any,
+        order2_: Any,
+        cstart2_: Any,
+        seg1_: Any,
+    ) -> Tuple[Dict[str, Any], Dict[str, Any], Dict[str, Any], Dict[str, Any], Any]:
+        t = jnp.arange(out_pad, dtype=jnp.int32)
+        i = (
+            jnp.searchsorted(start_, t, side="right").astype(jnp.int32) - 1
+        )
+        i = jnp.clip(i, 0, p1 - 1)
+        j_local = t - start_[i]
+        matched = j_local < m_[i]
+        s = jnp.clip(seg1_[i], 0, S - 1)
+        rpos = jnp.clip(cstart2_[s] + j_local, 0, p2 - 1)
+        ridx = order2_[rpos]
+        out1 = {k: v[i] for k, v in datas1.items()}
+        om1 = {k: v[i] for k, v in masks1.items()}
+        out2 = {k: v[ridx] for k, v in datas2.items()}
+        om2 = {k: v[ridx] & matched for k, v in masks2.items()}
+        for k in datas2:
+            if k not in om2:
+                om2[k] = matched
+        return out1, om1, out2, om2, matched
+
+    g1, gm1, g2, gm2, _matched = engine._jit_cached(
+        (
+            "join_gather",
+            how,
+            S,
+            p1,
+            p2,
+            out_pad,
+            tuple(sorted(d1)),
+            tuple(sorted(d2)),
+            tuple(sorted(n for n, c in d1.items() if c.mask is not None)),
+            tuple(sorted(n for n, c in d2.items() if c.mask is not None)),
+        ),
+        _gather_prog,
+    )(
+        {n: c.data for n, c in d1.items()},
+        {n: c.mask for n, c in d1.items() if c.mask is not None},
+        {n: c.data for n, c in d2.items()},
+        {n: c.mask for n, c in d2.items() if c.mask is not None},
+        m,
+        start,
+        order2,
+        cstart2,
+        seg1,
+    )
+    cols: Dict[str, JaxColumn] = {}
+    for f in out_schema.fields:
+        n = f.name
+        if n in g1:
+            src, data, mask = d1[n], g1[n], gm1.get(n)
+        else:
+            src, data, mask = d2[n], g2[n], gm2.get(n)
+        cols[n] = JaxColumn(
+            f.type,
+            jax.device_put(data, sharding),
+            None if mask is None else jax.device_put(mask, sharding),
+            src.dictionary,
+            src.stats,
+        )
+    out = JaxBlocks(M, cols, mesh)
+    if how == "fullouter" and R > 0:
+        right_part = _gather_right_unmatched(
+            engine, b1, b2, keys, key_cols2, order_un2, R, out_schema
+        )
+        out = union_all_blocks(out, right_part)
+    return out
+
+
+def _gather_right_unmatched(
+    engine: Any,
+    b1: JaxBlocks,
+    b2: JaxBlocks,
+    keys: List[str],
+    key_cols2: Dict[str, JaxColumn],
+    order_un2: Any,
+    R: int,
+    out_schema: Schema,
+) -> JaxBlocks:
+    """Full-outer tail: df2 rows with no df1 match; df1-only columns NULL.
+    Key columns take df2's values (already dictionary-harmonized)."""
+    mesh = b2.mesh
+    ndev = int(mesh.devices.size)
+    out_pad = padded_len(R, ndev)
+    sharding = row_sharding(mesh)
+    src_cols: Dict[str, JaxColumn] = {}
+    left_only: List[str] = []
+    for f in out_schema.fields:
+        n = f.name
+        if n in keys:
+            src_cols[n] = key_cols2.get(n, b2.columns[n])
+        elif n in b2.columns and n not in b1.columns:
+            src_cols[n] = b2.columns[n]
+        else:
+            left_only.append(n)
+
+    def _prog(
+        datas: Dict[str, Any], masks: Dict[str, Any], order_: Any
+    ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        idx = order_[
+            jnp.clip(
+                jnp.arange(out_pad, dtype=jnp.int32),
+                0,
+                order_.shape[0] - 1,
+            )
+        ]
+        return (
+            {k: v[idx] for k, v in datas.items()},
+            {k: v[idx] for k, v in masks.items()},
+        )
+
+    g, gm = engine._jit_cached(
+        (
+            "join_right_tail",
+            out_pad,
+            b2.padded_nrows,
+            tuple(sorted(src_cols)),
+            tuple(
+                sorted(
+                    n for n, c in src_cols.items() if c.mask is not None
+                )
+            ),
+        ),
+        _prog,
+    )(
+        {n: c.data for n, c in src_cols.items()},
+        {n: c.mask for n, c in src_cols.items() if c.mask is not None},
+        order_un2,
+    )
+    cols: Dict[str, JaxColumn] = {}
+    for f in out_schema.fields:
+        n = f.name
+        if n in src_cols:
+            src = src_cols[n]
+            cols[n] = JaxColumn(
+                f.type,
+                jax.device_put(g[n], sharding),
+                None if n not in gm else jax.device_put(gm[n], sharding),
+                src.dictionary,
+                src.stats,
+            )
+        else:
+            # left-only column: all NULL
+            dt = _null_device_dtype(f.type)
+            cols[n] = JaxColumn(
+                f.type,
+                jax.device_put(jnp.zeros((out_pad,), dtype=dt), sharding),
+                jax.device_put(
+                    jnp.zeros((out_pad,), dtype=bool), sharding
+                ),
+                np.asarray([], dtype=object) if _is_str(f.type) else None,
+                None,
+            )
+    return JaxBlocks(R, cols, mesh)
+
+
+def _is_str(tp: pa.DataType) -> bool:
+    return pa.types.is_string(tp) or pa.types.is_large_string(tp)
+
+
+def _null_device_dtype(tp: pa.DataType) -> Any:
+    if _is_str(tp):
+        return jnp.int32
+    if pa.types.is_timestamp(tp):
+        return jnp.int64
+    if pa.types.is_date32(tp):
+        return jnp.int32
+    if pa.types.is_boolean(tp):
+        return jnp.bool_
+    return tp.to_pandas_dtype()
+
+
+# ---------------------------------------------------------------------------
+# set operations
+# ---------------------------------------------------------------------------
+
+
+def union_all_blocks(b1: JaxBlocks, b2: JaxBlocks) -> JaxBlocks:
+    """Concatenate two frames along the row axis. Padding rows of each side
+    remain invalid under the combined mask — no compaction, no sync."""
+    sharding = row_sharding(b1.mesh)
+    cols: Dict[str, JaxColumn] = {}
+    p1, p2 = b1.padded_nrows, b2.padded_nrows
+    need_mask_names = set()
+    for n, c1 in b1.columns.items():
+        c2 = b2.columns[n]
+        if c1.mask is not None or c2.mask is not None:
+            need_mask_names.add(n)
+    for n, c1 in b1.columns.items():
+        c2 = b2.columns[n]
+        if c1.is_string:
+            c1, c2, _ = harmonize_string_keys(c1, c2)
+        dt = _common_dtype(c1.data.dtype, c2.data.dtype)
+        data = jnp.concatenate([c1.data.astype(dt), c2.data.astype(dt)])
+        mask: Optional[Any] = None
+        if n in need_mask_names:
+            m1 = (
+                c1.mask
+                if c1.mask is not None
+                else jnp.ones((p1,), dtype=bool)
+            )
+            m2 = (
+                c2.mask
+                if c2.mask is not None
+                else jnp.ones((p2,), dtype=bool)
+            )
+            mask = jax.device_put(jnp.concatenate([m1, m2]), sharding)
+        cols[n] = JaxColumn(
+            c1.pa_type,
+            jax.device_put(data, sharding),
+            mask,
+            c1.dictionary,
+            _merged_stats(c1, c2),
+        )
+    row_valid = jax.device_put(
+        jnp.concatenate([b1.validity(), b2.validity()]), sharding
+    )
+    nrows = (
+        b1._nrows + b2._nrows
+        if b1.nrows_known and b2.nrows_known
+        else None
+    )
+    nrows_dev = None
+    if nrows is None:
+        nrows_dev = b1.nrows_scalar + b2.nrows_scalar
+    return JaxBlocks(
+        nrows, cols, b1.mesh, row_valid=row_valid, nrows_dev=nrows_dev
+    )
+
+
+def intersect_subtract(
+    engine: Any,
+    b1: JaxBlocks,
+    b2: JaxBlocks,
+    names: List[str],
+    subtract: bool,
+) -> JaxBlocks:
+    """INTERSECT / EXCEPT (distinct): keep df1 rows whose full-row key
+    {is, is not} present in df2, first occurrence only. Mask-only; NULLs
+    compare equal (null buckets)."""
+    sf = shared_factorize(b1, b2, names)
+    S = max(sf.num_segments, 1)
+    p1 = b1.padded_nrows
+
+    def _prog(
+        seg1: Any,
+        seg2: Any,
+        rv1: Optional[Any],
+        n1: Any,
+        v2: Any,
+    ) -> Tuple[Any, Any]:
+        valid1 = groupby.materialize_validity(rv1, p1, n1)
+        c2 = jax.ops.segment_sum(
+            v2.astype(jnp.int32), jnp.where(v2, seg2, S), num_segments=S
+        )
+        hit = c2[jnp.clip(seg1, 0, S - 1)] > 0
+        present = valid1 & (~hit if subtract else hit)
+        # first occurrence among the kept df1 rows
+        pos = jnp.arange(p1, dtype=jnp.int32)
+        firsts = jax.ops.segment_min(
+            jnp.where(present, pos, p1),
+            jnp.where(present, seg1, S),
+            num_segments=S,
+        )
+        keep = present & (firsts[jnp.clip(seg1, 0, S - 1)] == pos)
+        return keep, jnp.sum(keep).astype(jnp.int32)
+
+    keep, cnt = engine._jit_cached(
+        (
+            "intersect_subtract",
+            subtract,
+            S,
+            p1,
+            b2.padded_nrows,
+            tuple(names),
+        ),
+        _prog,
+    )(sf.seg1, sf.seg2, b1.row_valid, _nrows_arg(b1), b2.validity())
+    return JaxBlocks(
+        None, dict(b1.columns), b1.mesh, row_valid=keep, nrows_dev=cnt
+    )
+
+
+def _nrows_arg(blocks: JaxBlocks) -> Any:
+    if blocks._nrows is not None:
+        return np.int32(blocks._nrows)
+    if blocks._nrows_dev is not None:
+        return blocks._nrows_dev
+    return np.int32(-1)

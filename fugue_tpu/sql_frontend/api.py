@@ -1,37 +1,15 @@
-"""fugue_sql / fugue_sql_flow entry points (reference fugue/sql/api.py:18,111)."""
+"""fugue_sql / fugue_sql_flow entry points (reference fugue/sql/api.py:18,111).
 
-from typing import Any
+The implementations live in :mod:`fugue_tpu.sql_frontend.workflow_sql`
+(their ``_caller_vars`` frame depth is relative to the functions
+themselves, so a plain re-export preserves caller-local dataframe
+resolution)."""
 
-from fugue_tpu.sql_frontend.workflow_sql import (
+from fugue_tpu.sql_frontend.workflow_sql import (  # noqa: F401
     FugueSQLWorkflow,
-    _caller_vars,
     fill_sql_template,
+    fugue_sql,
+    fugue_sql_flow,
 )
 
 __all__ = ["fugue_sql", "fugue_sql_flow", "FugueSQLWorkflow", "fill_sql_template"]
-
-
-def fugue_sql(
-    query: str,
-    *args: Any,
-    engine: Any = None,
-    engine_conf: Any = None,
-    as_fugue: bool = False,
-    as_local: bool = False,
-    **kwargs: Any,
-) -> Any:
-    """Run a FugueSQL script and return its last dataframe."""
-    from fugue_tpu.sql_frontend.workflow_sql import _fugue_sql_impl
-
-    return _fugue_sql_impl(
-        query, _caller_vars(2), args, kwargs,
-        engine=engine, engine_conf=engine_conf,
-        as_fugue=as_fugue, as_local=as_local,
-    )
-
-
-def fugue_sql_flow(query: str, *args: Any, **kwargs: Any) -> FugueSQLWorkflow:
-    """Build (not run) a FugueSQLWorkflow; use YIELD for outputs."""
-    dag = FugueSQLWorkflow()
-    dag._sql(query, _caller_vars(2), *args, **kwargs)
-    return dag

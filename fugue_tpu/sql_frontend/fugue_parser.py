@@ -234,9 +234,9 @@ class FugueSQLCompiler:
         if isinstance(dfs, list) and len(dfs) > 1:
             return self.workflow.zip(*dfs, partition=partition)
         if isinstance(dfs, dict) and len(dfs) > 1:
-            return self.workflow.zip(
-                *dfs.values(), partition=partition
-            )
+            # pass the dict itself: zip keeps the names so cotransformers
+            # can address inputs as dfs["name"]
+            return self.workflow.zip(dfs, partition=partition)
         if isinstance(dfs, list):
             return dfs[0]
         if isinstance(dfs, dict):
@@ -343,7 +343,8 @@ class FugueSQLCompiler:
         src = df if df is not None else self._last_df()
         if and_use:
             return src.save_and_use(
-                path, fmt=fmt, mode=mode, partition=partition, **params
+                path, fmt=fmt, mode=mode, partition=partition, single=single,
+                **params,
             )
         src.save(
             path, fmt=fmt, mode=mode, partition=partition, single=single,
@@ -368,8 +369,9 @@ class FugueSQLCompiler:
         if cur.accept_kw("PRESORT"):
             presort = self._presort_expr(cur)
         partition = PartitionSpec(by=by, presort=presort)
-        args = list(dfs.values()) if isinstance(dfs, dict) else list(dfs)
-        return self.workflow.zip(*args, how=how, partition=partition)
+        if isinstance(dfs, dict):
+            return self.workflow.zip(dfs, how=how, partition=partition)
+        return self.workflow.zip(*dfs, how=how, partition=partition)
 
     def _rename_stmt(self, cur: Cursor) -> Any:
         cur.expect_kw("RENAME")
@@ -477,8 +479,12 @@ class FugueSQLCompiler:
                 lazy = True
                 cur.advance()
             if cur.accept_kw("PERSIST"):
-                tdf = self._req(tdf, "PERSIST").persist()
+                # LAZY PERSIST = lazy weak checkpoint
+                t = self._req(tdf, "PERSIST")
+                tdf = t.weak_checkpoint(lazy=True) if lazy else t.persist()
             elif cur.accept_kw("BROADCAST"):
+                if lazy:
+                    raise FugueSQLSyntaxError("LAZY cannot prefix BROADCAST")
                 tdf = self._req(tdf, "BROADCAST").broadcast()
             elif cur.accept_kw("WEAK"):
                 cur.expect_kw("CHECKPOINT")
@@ -498,13 +504,17 @@ class FugueSQLCompiler:
                     params["partition"] = partition
                 if single:
                     params["single"] = True
+                # lazy strong checkpoints surface NotImplementedError from
+                # StrongCheckpoint rather than silently running eagerly
                 tdf = self._req(tdf, "DETERMINISTIC CHECKPOINT") \
-                    .deterministic_checkpoint(namespace=ns, **params)
+                    .deterministic_checkpoint(namespace=ns, lazy=lazy, **params)
             elif cur.is_kw("STRONG", "CHECKPOINT"):
                 cur.accept_kw("STRONG")
                 cur.expect_kw("CHECKPOINT")
                 params = self._opt_paren_params(cur) or {}
-                tdf = self._req(tdf, "CHECKPOINT").strong_checkpoint(**params)
+                tdf = self._req(tdf, "CHECKPOINT").strong_checkpoint(
+                    lazy=lazy, **params
+                )
             elif cur.accept_kw("YIELD"):
                 local = cur.accept_kw("LOCAL")
                 target = "dataframe"

@@ -4,6 +4,7 @@
 import sys
 from typing import Any, Dict, Optional, Tuple
 
+from fugue_tpu.constants import FUGUE_CONF_SQL_DIALECT
 from fugue_tpu.dataframe import DataFrame
 from fugue_tpu.execution.factory import make_execution_engine
 from fugue_tpu.sql_frontend.fugue_parser import FugueSQLCompiler
@@ -89,7 +90,7 @@ class FugueSQLWorkflow(FugueWorkflow):
             variables=self._sql_vars,
             sources=sources,
             local_vars=local_vars,
-            dialect=self._conf.get("fugue.sql.compile.dialect", "spark"),
+            dialect=self._conf.get(FUGUE_CONF_SQL_DIALECT, "spark"),
             last=self.last_df,
         )
         variables = compiler.compile(code)

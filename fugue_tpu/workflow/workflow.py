@@ -404,11 +404,14 @@ class WorkflowDataFrame:
         fmt: str = "",
         mode: str = "overwrite",
         partition: Any = None,
+        single: bool = False,
         **kwargs: Any,
     ) -> "WorkflowDataFrame":
         return self._add_process(
             SaveAndUse,
-            params=dict(path=path, fmt=fmt, mode=mode, params=kwargs),
+            params=dict(
+                path=path, fmt=fmt, mode=mode, single=single, params=kwargs
+            ),
             partition_spec=PartitionSpec(partition or self._pending_partition),
         )
 

