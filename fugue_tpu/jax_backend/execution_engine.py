@@ -295,12 +295,42 @@ class JaxMapEngine(MapEngine):
 
 
 class JaxSQLEngine(PandasSQLEngine):
-    """SQL facet: parse with the built-in front end; GROUP BY plans route
-    back through JaxExecutionEngine.select -> device segment reductions."""
+    """SQL facet: parse with the built-in front end; simple single-table
+    SELECT [WHERE] [GROUP BY] plans route through JaxExecutionEngine.select
+    -> device projections / segment-reduction aggregates (the role Spark
+    SQL / DuckDB play for the reference's engines). Everything else —
+    joins, subqueries, CTEs, set ops, ORDER BY — runs on the host SELECT
+    runner with exact SQL semantics."""
 
     @property
     def is_distributed(self) -> bool:
         return True
+
+    def select(self, dfs: Any, statement: Any) -> DataFrame:
+        from fugue_tpu.sql_frontend.algebra_bridge import (
+            translate_simple_select,
+        )
+        from fugue_tpu.sql_frontend.parser import parse_select
+
+        engine: "JaxExecutionEngine" = self.execution_engine  # type: ignore
+        sql = statement.construct(dialect=self.dialect)
+        plan = None
+        try:
+            plan = translate_simple_select(parse_select(sql), list(dfs.keys()))
+        except Exception:
+            plan = None
+        if plan is not None:
+            try:
+                return engine.select(
+                    dfs[plan.table], plan.cols, where=plan.where,
+                    having=plan.having,
+                )
+            except Exception:
+                # semantics disagreement -> host runner is the oracle
+                engine._count_fallback("sql_select", "device select raised")
+                return super().select(dfs, statement)
+        engine._count_fallback("sql_select", "non-simple query shape")
+        return super().select(dfs, statement)
 
 
 class JaxExecutionEngine(ExecutionEngine):
@@ -750,8 +780,33 @@ class JaxExecutionEngine(ExecutionEngine):
     def fillna(
         self, df: DataFrame, value: Any, subset: Optional[List[str]] = None
     ) -> DataFrame:
+        """Device fillna: one jitted mask-flip + ``jnp.where`` per frame —
+        the block layout makes this trivial (masked slots take the fill
+        value, the mask drops). Float columns also fill literal NaNs in the
+        data, matching pandas semantics."""
+        assert_or_throw(
+            (not isinstance(value, dict))
+            or all(v is not None for v in value.values()),
+            ValueError("fillna dict can't contain None"),
+        )
+        assert_or_throw(value is not None, ValueError("fillna value can't be None"))
         jdf: JaxDataFrame = self.to_df(df)  # type: ignore
-        self._count_fallback("fillna")
+        blocks = jdf.blocks
+        if isinstance(value, dict):
+            fills: Dict[str, Any] = dict(value)
+        elif subset is not None:
+            fills = {c: value for c in subset}
+        else:
+            fills = {c: value for c in jdf.schema.names}
+        targets = {
+            n: v
+            for n, v in fills.items()
+            if n in blocks.columns
+        }
+        res = relational.device_fillna(self, blocks, jdf.schema, targets)
+        if res is not None:
+            return JaxDataFrame(res, jdf.schema)
+        self._count_fallback("fillna", "host-resident or untypable fill")
         return self.to_df(
             self._native.fillna(jdf.as_local_bounded(), value=value, subset=subset)
         )
@@ -770,6 +825,14 @@ class JaxExecutionEngine(ExecutionEngine):
         )
         jdf: JaxDataFrame = self.to_df(df)  # type: ignore
         blocks = jdf.blocks
+        if not replace:
+            # mask-only device sampling, zero host syncs: frac keeps rows
+            # under a uniform threshold; exact-n keeps the n smallest
+            # uniforms (the n-th order statistic is computed in-program)
+            res = relational.device_sample(self, blocks, n, frac, seed)
+            return JaxDataFrame(res, jdf.schema)
+        # replace=True duplicates rows (changes the row multiset) — host RNG
+        # gather; not a "fallback" per se (no device path exists for it)
         if blocks.row_valid is not None:
             valid_idx = np.nonzero(np.asarray(blocks.row_valid))[0]
         else:
@@ -777,8 +840,7 @@ class JaxExecutionEngine(ExecutionEngine):
         total = len(valid_idx)
         rng = np.random.default_rng(seed)
         count = n if n is not None else int(round(total * frac))  # type: ignore
-        count = min(count, total) if not replace else count
-        idx = valid_idx[rng.choice(total, size=count, replace=replace)]
+        idx = valid_idx[rng.choice(total, size=count, replace=True)]
         return JaxDataFrame(
             gather_indices(jdf.blocks, jnp.asarray(np.sort(idx)), jdf.schema),
             jdf.schema,
@@ -792,8 +854,27 @@ class JaxExecutionEngine(ExecutionEngine):
         na_position: str = "last",
         partition_spec: Optional[PartitionSpec] = None,
     ) -> DataFrame:
+        assert_or_throw(
+            isinstance(n, int) and n >= 0,
+            ValueError("n must be a non-negative int"),
+        )
+        assert_or_throw(
+            na_position in ("first", "last"), ValueError("invalid na_position")
+        )
         jdf: JaxDataFrame = self.to_df(df)  # type: ignore
-        self._count_fallback("take")
+        partition_spec = partition_spec or PartitionSpec()
+        from fugue_tpu.collections.partition import parse_presort_exp
+
+        sorts = (
+            parse_presort_exp(presort) if presort else partition_spec.presort
+        )
+        res = relational.device_take(
+            self, jdf.blocks, jdf.schema, n, sorts, na_position,
+            list(partition_spec.partition_by),
+        )
+        if res is not None:
+            return JaxDataFrame(res, jdf.schema)
+        self._count_fallback("take", "host-resident sort/partition column")
         return self.to_df(
             self._native.take(
                 jdf.as_local_bounded(), n, presort, na_position, partition_spec

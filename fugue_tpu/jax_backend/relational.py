@@ -188,7 +188,7 @@ def semi_anti_join(
     engine: Any, b1: JaxBlocks, b2: JaxBlocks, keys: List[str], anti: bool
 ) -> JaxBlocks:
     sf = shared_factorize(b1, b2, keys)
-    S = sf.num_segments
+    S = max(sf.num_segments, 1)
     null1 = _null_any_mask(b1, keys)
     null2 = _null_any_mask(b2, keys)
     p1 = b1.padded_nrows
@@ -210,10 +210,8 @@ def semi_anti_join(
             jnp.where(match2, seg2, S),
             num_segments=S,
         )
-        hit = c2[jnp.clip(seg1, 0, max(S - 1, 0))] > 0
+        hit = c2[jnp.clip(seg1, 0, S - 1)] > 0
         matchable1 = valid1 if n1m is None else (valid1 & ~n1m)
-        if S == 0:
-            hit = jnp.zeros_like(valid1)
         if anti:
             keep = valid1 & (~matchable1 | ~hit)
         else:
@@ -536,19 +534,15 @@ def union_all_blocks(b1: JaxBlocks, b2: JaxBlocks) -> JaxBlocks:
     sharding = row_sharding(b1.mesh)
     cols: Dict[str, JaxColumn] = {}
     p1, p2 = b1.padded_nrows, b2.padded_nrows
-    need_mask_names = set()
     for n, c1 in b1.columns.items():
         c2 = b2.columns[n]
-        if c1.mask is not None or c2.mask is not None:
-            need_mask_names.add(n)
-    for n, c1 in b1.columns.items():
-        c2 = b2.columns[n]
+        need_mask = c1.mask is not None or c2.mask is not None
         if c1.is_string:
             c1, c2, _ = harmonize_string_keys(c1, c2)
         dt = _common_dtype(c1.data.dtype, c2.data.dtype)
         data = jnp.concatenate([c1.data.astype(dt), c2.data.astype(dt)])
         mask: Optional[Any] = None
-        if n in need_mask_names:
+        if need_mask:
             m1 = (
                 c1.mask
                 if c1.mask is not None
@@ -642,3 +636,286 @@ def _nrows_arg(blocks: JaxBlocks) -> Any:
     if blocks._nrows_dev is not None:
         return blocks._nrows_dev
     return np.int32(-1)
+
+
+# ---------------------------------------------------------------------------
+# fillna / take / sample (mask-only where possible)
+# ---------------------------------------------------------------------------
+
+
+def _encode_fill_value(col: JaxColumn, value: Any) -> Optional[Any]:
+    """The fill value in the column's device representation, or None if it
+    cannot be represented (caller falls back)."""
+    tp = col.pa_type
+    try:
+        if col.is_string:
+            if not isinstance(value, str):
+                return None
+            hits = np.nonzero(col.dictionary == value)[0]
+            if len(hits) > 0:
+                return np.int32(hits[0])
+            # append to the dictionary (host-side, small)
+            col.dictionary = np.concatenate(
+                [col.dictionary, np.asarray([value], dtype=object)]
+            )
+            if col.stats is not None:
+                col.stats = (col.stats[0], len(col.dictionary) - 1)
+            return np.int32(len(col.dictionary) - 1)
+        if pa.types.is_timestamp(tp):
+            ts = np.datetime64(value, "us")
+            return np.int64((ts - np.datetime64(0, "us")).astype(np.int64))
+        if pa.types.is_date32(tp):
+            d = np.datetime64(value, "D")
+            return np.int32(
+                (d - np.datetime64(0, "D")).astype(np.int64)
+            )
+        v = np.asarray(value, dtype=col.data.dtype)[()]
+        # the host oracle REJECTS inexact fills (e.g. 2.5 into int64);
+        # a silently truncating device path would diverge from it
+        if not np.issubdtype(col.data.dtype, np.floating) and v != value:
+            return None
+        return v
+    except (ValueError, TypeError):
+        return None
+
+
+def device_fillna(
+    engine: Any,
+    blocks: JaxBlocks,
+    schema: Schema,
+    targets: Dict[str, Any],
+) -> Optional[JaxBlocks]:
+    """Fill nulls in `targets` columns in ONE jitted dispatch; the filled
+    columns drop their masks. Returns None when any target column is
+    host-resident or the value can't be encoded."""
+    enc: Dict[str, Any] = {}
+    float_cols: List[str] = []
+    for name, value in targets.items():
+        col = blocks.columns[name]
+        if not col.on_device:
+            return None
+        is_float = jnp.issubdtype(col.data.dtype, jnp.floating)
+        if col.mask is None and not is_float:
+            continue  # nothing to fill
+        v = _encode_fill_value(col, value)
+        if v is None:
+            return None
+        enc[name] = v
+        if is_float:
+            float_cols.append(name)
+    if not enc:
+        return blocks
+    names = sorted(enc)
+
+    def _prog(
+        datas: Dict[str, Any], masks: Dict[str, Any], fills: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        outs: Dict[str, Any] = {}
+        for nm in names:
+            d = datas[nm]
+            m = masks.get(nm)
+            eff_null = jnp.zeros(d.shape, dtype=bool) if m is None else ~m
+            if nm in float_cols:
+                eff_null = eff_null | jnp.isnan(d)
+            outs[nm] = jnp.where(eff_null, fills[nm].astype(d.dtype), d)
+        return outs
+
+    outs = engine._jit_cached(
+        (
+            "fillna",
+            blocks.padded_nrows,
+            tuple(names),
+            tuple(sorted(float_cols)),
+            tuple(nm for nm in names if blocks.columns[nm].mask is not None),
+        ),
+        _prog,
+    )(
+        {nm: blocks.columns[nm].data for nm in names},
+        {
+            nm: blocks.columns[nm].mask
+            for nm in names
+            if blocks.columns[nm].mask is not None
+        },
+        {nm: jnp.asarray(enc[nm]) for nm in names},
+    )
+    sharding = row_sharding(blocks.mesh)
+    new_cols = dict(blocks.columns)
+    for nm in names:
+        src = blocks.columns[nm]
+        new_cols[nm] = JaxColumn(
+            src.pa_type,
+            jax.device_put(outs[nm], sharding),
+            None,
+            src.dictionary,
+            src.stats,
+        )
+    return JaxBlocks(
+        blocks._nrows,
+        new_cols,
+        blocks.mesh,
+        row_valid=blocks.row_valid,
+        nrows_dev=blocks._nrows_dev,
+    )
+
+
+def _sort_code_columns(
+    blocks: JaxBlocks, sorts: Dict[str, bool], na_position: str
+) -> Optional[List[Tuple[Any, Optional[Any], bool]]]:
+    """Per sort column: (device code array, effective-null mask or None,
+    ascending). String columns sort by LEXICOGRAPHIC rank (a host argsort
+    of the small dictionary builds the rank table), not by code order."""
+    out: List[Tuple[Any, Optional[Any], bool]] = []
+    for name, asc in sorts.items():
+        col = blocks.columns.get(name)
+        if col is None or not col.on_device:
+            return None
+        data = col.data
+        if col.is_string:
+            order = np.argsort(col.dictionary.astype(str), kind="stable")
+            rank = np.empty(max(len(order), 1), dtype=np.int32)
+            rank[order] = np.arange(len(order), dtype=np.int32)
+            data = jnp.asarray(rank)[
+                jnp.clip(col.data, 0, max(len(order) - 1, 0))
+            ]
+        elif data.dtype == jnp.bool_:
+            data = data.astype(jnp.int32)
+        null = None if col.mask is None else ~col.mask
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            nan = jnp.isnan(data)
+            null = nan if null is None else (null | nan)
+            data = jnp.where(nan, jnp.zeros_like(data), data)
+        out.append((data, null, bool(asc)))
+    return out
+
+
+def device_take(
+    engine: Any,
+    blocks: JaxBlocks,
+    schema: Schema,
+    n: int,
+    sorts: Dict[str, bool],
+    na_position: str,
+    partition_by: List[str],
+) -> Optional[JaxBlocks]:
+    """Mask-only take: rows keep their storage order; validity flips to
+    the first `n` rows per partition (or globally) under the presort
+    order. Zero host syncs; the row count becomes a lazy device scalar."""
+    codes = _sort_code_columns(blocks, sorts, na_position)
+    if codes is None:
+        return None
+    for k in partition_by:
+        col = blocks.columns.get(k)
+        if col is None or not col.on_device:
+            return None
+    p = blocks.padded_nrows
+    if partition_by:
+        fr = groupby.factorize_keys(blocks, partition_by)
+        seg, S = fr.seg, max(fr.num_segments, 1)
+    else:
+        seg, S = None, 1
+    na_first = na_position == "first"
+
+    def _prog(
+        code_arrs: Tuple[Any, ...],
+        null_arrs: Dict[int, Any],
+        seg_: Optional[Any],
+        row_valid: Optional[Any],
+        nrows_s: Any,
+    ) -> Tuple[Any, Any]:
+        valid = groupby.materialize_validity(row_valid, p, nrows_s)
+        order = jnp.arange(p, dtype=jnp.int32)
+        # stable sorts applied from the least-significant key outward
+        for i in reversed(range(len(code_arrs))):
+            c = code_arrs[i]
+            _, nullm, asc = codes[i]
+            sc = c[order]
+            # descending=True (not negation): negating unsigned or INT_MIN
+            # values wraps and silently misorders (review finding)
+            order = order[jnp.argsort(sc, stable=True, descending=not asc)]
+            if i in null_arrs:
+                nf = null_arrs[i][order]
+                # nulls first -> sort by NOT-null; nulls last -> by null
+                flag = ~nf if na_first else nf
+                order = order[jnp.argsort(flag, stable=True)]
+        if seg_ is not None:
+            order = order[jnp.argsort(seg_[order], stable=True)]
+        # invalid rows last (primary key)
+        order = order[jnp.argsort(~valid[order], stable=True)]
+        invrank = jnp.zeros((p,), dtype=jnp.int32).at[order].set(
+            jnp.arange(p, dtype=jnp.int32)
+        )
+        if seg_ is not None:
+            cnt = jax.ops.segment_sum(
+                valid.astype(jnp.int32),
+                jnp.where(valid, seg_, S),
+                num_segments=S,
+            )
+            starts = jnp.cumsum(cnt) - cnt
+            local = invrank - starts[jnp.clip(seg_, 0, S - 1)]
+            keep = valid & (local < n)
+        else:
+            keep = valid & (invrank < n)
+        return keep, jnp.sum(keep).astype(jnp.int32)
+
+    keep, cnt = engine._jit_cached(
+        (
+            "take",
+            n,
+            p,
+            S,
+            tuple(partition_by),
+            tuple((nm, asc) for nm, asc in sorts.items()),
+            tuple(i for i in range(len(codes)) if codes[i][1] is not None),
+            na_position,
+        ),
+        _prog,
+    )(
+        tuple(c for c, _, _ in codes),
+        {i: nl for i, (_, nl, _) in enumerate(codes) if nl is not None},
+        seg,
+        blocks.row_valid,
+        _nrows_arg(blocks),
+    )
+    return JaxBlocks(
+        None, dict(blocks.columns), blocks.mesh, row_valid=keep, nrows_dev=cnt
+    )
+
+
+def device_sample(
+    engine: Any,
+    blocks: JaxBlocks,
+    n: Optional[int],
+    frac: Optional[float],
+    seed: Optional[int],
+) -> JaxBlocks:
+    """Sampling without replacement as a validity flip: every row draws a
+    distinct priority (a random permutation, so no float-tie inflation);
+    the k smallest priorities among valid rows are kept. k is `n` or
+    ``round(nrows * frac)`` computed IN-program, so lazy counts stay lazy."""
+    p = blocks.padded_nrows
+    if seed is None:
+        seed = int(np.random.default_rng().integers(0, 2**31 - 1))
+
+    def _prog(key: Any, row_valid: Optional[Any], nrows_s: Any) -> Tuple[Any, Any]:
+        valid = groupby.materialize_validity(row_valid, p, nrows_s)
+        pri = jax.random.permutation(key, p).astype(
+            jnp.int32
+        )
+        masked = jnp.where(valid, pri, p)
+        srt = jnp.sort(masked)
+        nvalid = jnp.sum(valid.astype(jnp.int32))
+        if n is not None:
+            k = jnp.int32(n)
+        else:
+            k = jnp.round(nvalid.astype(jnp.float64) * frac).astype(jnp.int32)
+        k = jnp.minimum(k, nvalid)
+        kth = srt[jnp.clip(k - 1, 0, p - 1)]
+        keep = valid & (masked <= kth) & (k > 0)
+        return keep, jnp.sum(keep).astype(jnp.int32)
+
+    keep, cnt = engine._jit_cached(
+        ("sample", p, n, frac), _prog
+    )(jax.random.PRNGKey(seed), blocks.row_valid, _nrows_arg(blocks))
+    return JaxBlocks(
+        None, dict(blocks.columns), blocks.mesh, row_valid=keep, nrows_dev=cnt
+    )

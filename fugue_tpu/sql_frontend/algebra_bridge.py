@@ -1,0 +1,183 @@
+"""SQL AST -> column algebra bridge.
+
+Lets SQL engines lower simple single-table SELECT [WHERE] [GROUP BY]
+queries into :meth:`ExecutionEngine.select` (the column-algebra path) —
+on the jax engine that means device projections and segment-reduction
+aggregates instead of the host SELECT runner. The reference gets this for
+free from its SQL backends (Spark SQL, DuckDB); here the bridge plays
+that role for expressions the device evaluator understands, and returns
+``None`` for anything else (joins, subqueries, CTEs, set ops, ORDER BY,
+window functions) so callers fall back to the host runner.
+"""
+
+from typing import Dict, List, Optional, Tuple
+
+from fugue_tpu.column import functions as ff
+from fugue_tpu.column.expressions import ColumnExpr, col, lit, null
+from fugue_tpu.column.sql import SelectColumns
+from fugue_tpu.sql_frontend import ast
+
+__all__ = ["translate_simple_select", "SimplePlan"]
+
+_AGG_FUNCS = {"sum", "min", "max", "avg", "mean", "count", "first", "last"}
+
+
+class SimplePlan:
+    """A single-table plan: run ``engine.select(dfs[table], cols, where,
+    having)``."""
+
+    def __init__(
+        self,
+        table: str,
+        cols: SelectColumns,
+        where: Optional[ColumnExpr],
+        having: Optional[ColumnExpr],
+    ):
+        self.table = table
+        self.cols = cols
+        self.where = where
+        self.having = having
+
+
+class _GiveUp(Exception):
+    pass
+
+
+def translate_simple_select(
+    query: ast.Query, df_names: List[str]
+) -> Optional[SimplePlan]:
+    """Translate, or None when the query doesn't fit the simple shape."""
+    try:
+        return _translate(query, df_names)
+    except _GiveUp:
+        return None
+
+
+def _translate(query: ast.Query, df_names: List[str]) -> SimplePlan:
+    if not isinstance(query, ast.Select):
+        raise _GiveUp()
+    if query.order_by or query.limit is not None or query.offset is not None:
+        raise _GiveUp()
+    if query.distinct:
+        raise _GiveUp()
+    if not isinstance(query.from_, ast.TableRef):
+        raise _GiveUp()
+    lowered = {n.lower(): n for n in df_names}
+    tname = query.from_.name.lower()
+    if tname not in lowered:
+        raise _GiveUp()
+    alias = (query.from_.alias or query.from_.name).lower()
+
+    exprs: List[ColumnExpr] = []
+    implicit_star = False
+    for item in query.items:
+        if isinstance(item.expr, ast.Star):
+            if item.expr.table is not None and item.expr.table.lower() != alias:
+                raise _GiveUp()
+            exprs.append(col("*"))
+            implicit_star = True
+            continue
+        e = _expr(item.expr, alias)
+        if item.alias:
+            e = e.alias(item.alias)
+        elif e.output_name == "":
+            raise _GiveUp()  # unnamed computed column
+        exprs.append(e)
+
+    cols = SelectColumns(*exprs)
+    if cols.has_agg and implicit_star:
+        raise _GiveUp()
+    # GROUP BY keys must coincide with the non-agg select items
+    if query.group_by:
+        keys = set()
+        for g in query.group_by:
+            if not isinstance(g, ast.Col):
+                raise _GiveUp()
+            keys.add(g.name.lower())
+        non_agg = {c.output_name.lower() for c in cols.group_keys}
+        if keys != non_agg or not cols.has_agg:
+            raise _GiveUp()
+    elif cols.has_agg and len(cols.group_keys) > 0:
+        raise _GiveUp()  # non-agg cols without GROUP BY is invalid SQL
+
+    where = _expr(query.where, alias) if query.where is not None else None
+    having = _expr(query.having, alias) if query.having is not None else None
+    return SimplePlan(lowered[tname], cols, where, having)
+
+
+_BIN_OPS = {"=", "<>", "!=", "<", "<=", ">", ">=", "+", "-", "*", "/",
+            "AND", "OR"}
+
+
+def _expr(e: ast.Expr, alias: str) -> ColumnExpr:
+    if isinstance(e, ast.Lit):
+        return null() if e.value is None else lit(e.value)
+    if isinstance(e, ast.Col):
+        if e.table is not None and e.table.lower() != alias:
+            raise _GiveUp()
+        return col(e.name)
+    if isinstance(e, ast.Unary):
+        op = e.op.upper()
+        v = _expr(e.operand, alias)
+        if op == "-":
+            return -v
+        if op == "+":
+            return v
+        if op == "NOT":
+            return ~v
+        raise _GiveUp()
+    if isinstance(e, ast.Binary):
+        op = e.op.upper()
+        if op not in _BIN_OPS:
+            raise _GiveUp()
+        lv, rv = _expr(e.left, alias), _expr(e.right, alias)
+        return {
+            "=": lambda: lv == rv,
+            "<>": lambda: lv != rv,
+            "!=": lambda: lv != rv,
+            "<": lambda: lv < rv,
+            "<=": lambda: lv <= rv,
+            ">": lambda: lv > rv,
+            ">=": lambda: lv >= rv,
+            "+": lambda: lv + rv,
+            "-": lambda: lv - rv,
+            "*": lambda: lv * rv,
+            "/": lambda: lv / rv,
+            "AND": lambda: lv & rv,
+            "OR": lambda: lv | rv,
+        }[op]()
+    if isinstance(e, ast.Func):
+        name = e.name.lower()
+        if e.distinct:
+            raise _GiveUp()
+        if name in _AGG_FUNCS:
+            if len(e.args) != 1:
+                raise _GiveUp()
+            a = e.args[0]
+            arg = col("*") if isinstance(a, ast.Star) else _expr(a, alias)
+            if name == "mean":
+                name = "avg"
+            # the ff constructors mark is_aggregation (function() does not)
+            return getattr(ff, name)(arg)
+        if name == "coalesce":
+            return ff.coalesce(*[_expr(a, alias) for a in e.args])
+        raise _GiveUp()
+    if isinstance(e, ast.Cast):
+        return _expr(e.operand, alias).cast(e.type_name)
+    if isinstance(e, ast.IsNull):
+        v = _expr(e.operand, alias)
+        return v.not_null() if e.negated else v.is_null()
+    if isinstance(e, ast.Between):
+        v = _expr(e.operand, alias)
+        res = (v >= _expr(e.low, alias)) & (v <= _expr(e.high, alias))
+        return ~res if e.negated else res
+    if isinstance(e, ast.InList):
+        v = _expr(e.operand, alias)
+        res: Optional[ColumnExpr] = None
+        for item in e.items:
+            term = v == _expr(item, alias)
+            res = term if res is None else (res | term)
+        if res is None:
+            raise _GiveUp()
+        return ~res if e.negated else res
+    raise _GiveUp()  # Case / Like / subqueries / windows
