@@ -22,6 +22,7 @@ FUGUE_CONF_RPC_SERVER = "fugue.rpc.server"
 FUGUE_CONF_JAX_PARTITIONS = "fugue.jax.default.partitions"
 FUGUE_CONF_JAX_COMPILE = "fugue.jax.compile"
 FUGUE_CONF_JAX_ROW_BUCKET = "fugue.jax.row_bucket"
+FUGUE_CONF_JAX_DEVICE_ZIP = "fugue.jax.device_zip"
 
 FUGUE_COMPILE_TIME_CONFIGS = {
     FUGUE_CONF_WORKFLOW_EXCEPTION_HIDE,
@@ -39,6 +40,7 @@ _DEFAULT_CONF: Dict[str, Any] = {
     FUGUE_CONF_SQL_IGNORE_CASE: False,
     FUGUE_CONF_SQL_DIALECT: "spark",
     FUGUE_CONF_JAX_ROW_BUCKET: 0,
+    FUGUE_CONF_JAX_DEVICE_ZIP: True,
 }
 
 _GLOBAL_CONF = ParamDict(_DEFAULT_CONF)

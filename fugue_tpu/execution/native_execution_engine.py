@@ -101,15 +101,23 @@ class PandasMapEngine(MapEngine):
             pdf = _sort_pandas(pdf, sorts)
             if num <= 1 or spec.algo == "coarse" or len(pdf) == 0:
                 yield pdf
+            elif spec.algo == "hash":
+                # stable row-hash partitioning (reference
+                # fugue_spark/_utils/partition.py:14 hash_repartition)
+                ids = (
+                    pd.util.hash_pandas_object(pdf, index=False).to_numpy()
+                    % num
+                )
+                for i in range(num):
+                    yield pdf[ids == i]
+            elif spec.algo == "rand":
+                # seeded shuffle then even chunks (reference :26
+                # rand_repartition); deterministic per run for testability
+                rng = np.random.default_rng(42)
+                pdf = pdf.iloc[rng.permutation(len(pdf))]
+                yield from self._even_chunks(pdf, num)
             else:
-                # even split into contiguous chunks (np.array_split boundaries)
-                parts = min(num, len(pdf))
-                base, extra = divmod(len(pdf), parts)
-                start = 0
-                for i in range(parts):
-                    end = start + base + (1 if i < extra else 0)
-                    yield pdf.iloc[start:end]
-                    start = end
+                yield from self._even_chunks(pdf, num)
         else:
             pdf = _sort_pandas(pdf, spec.get_sorts(schema))
             if len(pdf) == 0:
@@ -120,6 +128,19 @@ class PandasMapEngine(MapEngine):
             )
             for _, sub in grouped:
                 yield sub
+
+    def _even_chunks(
+        self, pdf: pd.DataFrame, num: int
+    ) -> Iterator[pd.DataFrame]:
+        """Exact balanced contiguous chunks (reference :38 even_repartition:
+        sizes differ by at most one row)."""
+        parts = min(num, len(pdf))
+        base, extra = divmod(len(pdf), parts)
+        start = 0
+        for i in range(parts):
+            end = start + base + (1 if i < extra else 0)
+            yield pdf.iloc[start:end]
+            start = end
 
     def map_bag(
         self,
@@ -135,9 +156,16 @@ class PandasMapEngine(MapEngine):
         return map_func(0, ArrayBag(bag.as_array()))
 
 
+# process-wide table catalog: the role of the duckdb connection / spark
+# session catalog in the reference backends. Single-controller engines all
+# share it, so table yields cross workflows and engine instances.
+_TABLE_CATALOG: Dict[str, Any] = {}
+
+
 class PandasSQLEngine(SQLEngine):
-    """SQL over pandas via the built-in SQL front end (wired by
-    fugue_tpu.sql_frontend; raises until that module provides the executor)."""
+    """SQL over pandas via the built-in SQL front end (the qpd role,
+    reference native_execution_engine.py:41-65) + an in-memory table
+    catalog for save_table/load_table/table yields."""
 
     @property
     def is_distributed(self) -> bool:
@@ -153,6 +181,41 @@ class PandasSQLEngine(SQLEngine):
         return run_sql_on_dataframes(
             statement.construct(dialect=self.dialect), dfs
         )
+
+    def table_exists(self, table: str) -> bool:
+        return table in _TABLE_CATALOG
+
+    def save_table(
+        self,
+        df: DataFrame,
+        table: str,
+        mode: str = "overwrite",
+        partition_spec: Optional[PartitionSpec] = None,
+        **kwargs: Any,
+    ) -> None:
+        assert_or_throw(
+            mode in ("overwrite", "error"),
+            NotImplementedError(f"save mode {mode}"),
+        )
+        if mode == "error":
+            assert_or_throw(
+                table not in _TABLE_CATALOG,
+                ValueError(f"table {table} exists"),
+            )
+        local = self.execution_engine.to_df(df).as_local_bounded()
+        _TABLE_CATALOG[table] = (
+            local.as_arrow(type_safe=True),
+            local.schema,
+        )
+
+    def load_table(self, table: str, **kwargs: Any) -> DataFrame:
+        assert_or_throw(
+            table in _TABLE_CATALOG, ValueError(f"table {table} not found")
+        )
+        data, schema = _TABLE_CATALOG[table]
+        from fugue_tpu.dataframe import ArrowDataFrame
+
+        return self.execution_engine.to_df(ArrowDataFrame(data, schema))
 
 
 class NativeExecutionEngine(ExecutionEngine):

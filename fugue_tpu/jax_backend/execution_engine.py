@@ -33,7 +33,11 @@ import pyarrow as pa
 from fugue_tpu.collections.partition import PartitionCursor, PartitionSpec
 from fugue_tpu.column.expressions import ColumnExpr, _NamedColumnExpr
 from fugue_tpu.column.sql import SelectColumns
-from fugue_tpu.constants import FUGUE_CONF_JAX_PARTITIONS
+from fugue_tpu.constants import (
+    FUGUE_CONF_JAX_PARTITIONS,
+    KEYWORD_PARALLELISM,
+    KEYWORD_ROWCOUNT,
+)
 from fugue_tpu.dataframe import (
     ArrowDataFrame,
     DataFrame,
@@ -385,6 +389,10 @@ class JaxExecutionEngine(ExecutionEngine):
         return int(self._mesh.devices.size)
 
     def to_df(self, df: Any, schema: Any = None) -> DataFrame:
+        from fugue_tpu.jax_backend.zipped import JaxZippedDataFrame
+
+        if isinstance(df, JaxZippedDataFrame):
+            return df  # co-partition handle: consumed by comap only
         if isinstance(df, JaxDataFrame):
             assert_or_throw(
                 schema is None, ValueError("schema must be None for JaxDataFrame")
@@ -545,12 +553,57 @@ class JaxExecutionEngine(ExecutionEngine):
 
     # ---- device implementations of engine primitives --------------------
     def repartition(self, df: DataFrame, partition_spec: PartitionSpec) -> DataFrame:
-        return self.to_df(df)  # sharding is fixed by the mesh
+        """Mesh sharding is fixed (rows are row-sharded over devices), so
+        repartition is a device ROW REORDER: after it, contiguous even
+        chunks of the frame equal the requested partitioning — hash groups
+        equal-key rows together, rand applies a seeded permutation. The
+        host map fallback's contiguous splitter then yields exactly the
+        intended membership (reference fugue_spark/_utils/partition.py)."""
+        jdf: JaxDataFrame = self.to_df(df)  # type: ignore
+        algo = partition_spec.algo
+        if algo not in ("hash", "rand"):
+            return jdf  # default/even/coarse: sharding already uniform
+        blocks = jdf.blocks
+        by = [
+            k
+            for k in (partition_spec.partition_by or jdf.schema.names)
+            if k in blocks.columns
+        ]
+        if not all(blocks.columns[k].on_device for k in by):
+            return jdf
+        num = partition_spec.get_num_partitions(
+            **{
+                KEYWORD_ROWCOUNT: lambda: blocks.nrows,
+                KEYWORD_PARALLELISM: lambda: self.get_current_parallelism(),
+            }
+        )
+        if algo == "hash":
+            fr = groupby.factorize_keys(blocks, by)
+            part = np.asarray(fr.seg) % max(num, 1)
+            valid = np.asarray(blocks.validity())
+            # sentinel = num (sorts after every real partition id; an int64
+            # max literal would WRAP in the int32 seg dtype under NEP50)
+            idx = np.argsort(np.where(valid, part, num), kind="stable")[
+                : int(valid.sum())
+            ]
+        else:  # rand
+            valid = np.asarray(blocks.validity())
+            vidx = np.nonzero(valid)[0]
+            idx = vidx[np.random.default_rng(42).permutation(len(vidx))]
+        from fugue_tpu.jax_backend.blocks import gather_indices
+
+        return JaxDataFrame(
+            gather_indices(blocks, jnp.asarray(idx), jdf.schema), jdf.schema
+        )
 
     def broadcast(self, df: DataFrame) -> DataFrame:
         return self.to_df(df)
 
     def persist(self, df: DataFrame, lazy: bool = False, **kwargs: Any) -> DataFrame:
+        from fugue_tpu.jax_backend.zipped import JaxZippedDataFrame
+
+        if isinstance(df, JaxZippedDataFrame):
+            return df
         jdf: JaxDataFrame = self.to_df(df)  # type: ignore
         if not lazy:
             arrs = [
@@ -560,6 +613,80 @@ class JaxExecutionEngine(ExecutionEngine):
             ]
             jax.block_until_ready(arrs)
         return jdf
+
+    def zip(
+        self,
+        dfs: Any,
+        how: str = "inner",
+        partition_spec: Optional[PartitionSpec] = None,
+        temp_path: Optional[str] = None,
+        to_file_threshold: int = -1,
+    ) -> DataFrame:
+        """Device zip: RECORDS the co-partition (member frames + keys) in a
+        JaxZippedDataFrame instead of pickling partitions into blob rows
+        and unioning them (the reference design this replaces:
+        execution_engine.py:969-1360; SURVEY §3.5 'the piece to
+        re-architect on TPU'). comap then assembles key groups from one
+        columnar export per member — serialize_df is never called.
+        Disable with ``fugue.jax.device_zip=false``."""
+        from fugue_tpu.constants import FUGUE_CONF_JAX_DEVICE_ZIP
+        from fugue_tpu.jax_backend.zipped import JaxZippedDataFrame
+
+        hownorm = how.lower().replace(" ", "_")
+        if self.conf.get(FUGUE_CONF_JAX_DEVICE_ZIP, True) and hownorm in (
+            "inner", "left_outer", "right_outer", "full_outer", "cross",
+        ):
+            assert_or_throw(len(dfs) > 0, ValueError("can't zip 0 dataframes"))
+            spec = partition_spec or PartitionSpec()
+            keys: List[str] = list(spec.partition_by)
+            # members stay AS THEY ARE (device or local): comap exports to
+            # pandas anyway, so converting local frames to device here would
+            # be an upload immediately followed by a download
+            members: List[DataFrame] = list(dfs.values())
+            if len(keys) == 0 and hownorm != "cross":
+                keys = [
+                    n
+                    for n in members[0].schema.names
+                    if all(n in m.schema for m in members)
+                ]
+                assert_or_throw(
+                    len(keys) > 0, ValueError("no common keys to zip by")
+                )
+            if hownorm == "cross":
+                assert_or_throw(
+                    len(keys) == 0, ValueError("cross zip can't have keys")
+                )
+            names = list(dfs.keys()) if dfs.has_dict else [""] * len(dfs)
+            key_schema = Schema([members[0].schema[k] for k in keys])
+            return JaxZippedDataFrame(
+                members, names, hownorm, keys, key_schema, spec
+            )
+        self._count_fallback("zip", "device zip disabled or exotic zip type")
+        return super().zip(
+            dfs, how=how, partition_spec=partition_spec,
+            temp_path=temp_path, to_file_threshold=to_file_threshold,
+        )
+
+    def comap(
+        self,
+        df: DataFrame,
+        map_func: Callable,
+        output_schema: Any,
+        partition_spec: PartitionSpec,
+        on_init: Optional[Callable] = None,
+    ) -> DataFrame:
+        from fugue_tpu.jax_backend.zipped import (
+            JaxZippedDataFrame,
+            device_comap,
+        )
+
+        if isinstance(df, JaxZippedDataFrame):
+            return device_comap(
+                self, df, map_func, output_schema, partition_spec, on_init
+            )
+        return super().comap(
+            df, map_func, output_schema, partition_spec, on_init
+        )
 
     def join(
         self,

@@ -1,0 +1,232 @@
+"""Device co-partitioning: zip/comap without serialization.
+
+The reference's zip path (fugue/execution/execution_engine.py:969-1360)
+pickles every logical partition into a blob column, unions the blobs, and
+re-groups — two shuffles plus (de)serialization per group; SURVEY §3.5
+calls it "the main perf cliff of the design, and the piece to re-architect
+on TPU". Here, zipping device frames just RECORDS the co-partition intent:
+``JaxZippedDataFrame`` holds the member frames as-is. ``comap`` then makes
+ONE columnar host export per member (the same boundary any host
+cotransformer needs anyway) and assembles each key group by dataframe
+slicing — no pickle, no blob union, no second shuffle.
+"""
+
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+import pandas as pd
+import pyarrow as pa
+
+from fugue_tpu.collections.partition import PartitionSpec
+from fugue_tpu.dataframe import (
+    ArrayDataFrame,
+    ArrowDataFrame,
+    DataFrame,
+    DataFrames,
+    LocalBoundedDataFrame,
+    PandasDataFrame,
+)
+from fugue_tpu.execution.execution_engine import (
+    _ZIP_HOW_META,
+    _ZIP_NAMES_META,
+    _ZIP_SCHEMAS_META,
+)
+from fugue_tpu.schema import Schema
+from fugue_tpu.utils.assertion import assert_or_throw
+
+
+class JaxZippedDataFrame(DataFrame):
+    """A co-partition handle over device frames (not a materializable
+    dataframe: its only consumer is :meth:`JaxExecutionEngine.comap`)."""
+
+    def __init__(
+        self,
+        frames: List[DataFrame],
+        names: List[str],
+        how: str,
+        keys: List[str],
+        key_schema: Schema,
+        zip_spec: PartitionSpec,
+    ):
+        # cross zip has no keys; DataFrame refuses an empty schema, so use
+        # the serialized path's marker column as a placeholder (the schema
+        # of a zipped frame is only ever read for its key columns)
+        super().__init__(
+            key_schema
+            if len(key_schema) > 0
+            else Schema([("_fugue_ser_no", "int")])
+        )
+        self.key_schema = key_schema
+        self.frames = frames
+        self.names = names
+        self.how = how
+        self.keys = keys
+        self.zip_spec = zip_spec
+        self.reset_metadata(
+            {
+                "serialized": True,
+                "device_zipped": True,
+                _ZIP_SCHEMAS_META: [str(f.schema) for f in frames],
+                _ZIP_NAMES_META: names,
+                _ZIP_HOW_META: how,
+            }
+        )
+
+    @property
+    def is_local(self) -> bool:
+        return False
+
+    @property
+    def is_bounded(self) -> bool:
+        return True
+
+    @property
+    def num_partitions(self) -> int:
+        return self.frames[0].num_partitions
+
+    @property
+    def empty(self) -> bool:
+        return all(f.empty for f in self.frames)
+
+    def count(self) -> int:
+        raise NotImplementedError(_ONLY_COMAP)
+
+    def peek_array(self) -> List[Any]:
+        raise NotImplementedError(_ONLY_COMAP)
+
+    def as_local_bounded(self) -> LocalBoundedDataFrame:
+        raise NotImplementedError(_ONLY_COMAP)
+
+    def as_array(
+        self, columns: Optional[List[str]] = None, type_safe: bool = False
+    ) -> List[Any]:
+        raise NotImplementedError(_ONLY_COMAP)
+
+    def as_array_iterable(
+        self, columns: Optional[List[str]] = None, type_safe: bool = False
+    ) -> Iterable[Any]:
+        raise NotImplementedError(_ONLY_COMAP)
+
+    def _drop_cols(self, cols: List[str]) -> DataFrame:
+        raise NotImplementedError(_ONLY_COMAP)
+
+    def _select_cols(self, cols: List[Any]) -> DataFrame:
+        raise NotImplementedError(_ONLY_COMAP)
+
+    def rename(self, columns: Dict[str, str]) -> DataFrame:
+        raise NotImplementedError(_ONLY_COMAP)
+
+    def alter_columns(self, columns: Any) -> DataFrame:
+        raise NotImplementedError(_ONLY_COMAP)
+
+    def head(
+        self, n: int, columns: Optional[List[str]] = None
+    ) -> LocalBoundedDataFrame:
+        raise NotImplementedError(_ONLY_COMAP)
+
+
+_ONLY_COMAP = (
+    "a device-zipped dataframe only supports comap/cotransform; "
+    "set fugue.jax.device_zip=false for the serialized zip path"
+)
+
+
+def _canon_key(vals: Tuple[Any, ...]) -> Tuple[Any, ...]:
+    return tuple(None if pd.isna(v) else v for v in vals)
+
+
+def device_comap(
+    engine: Any,
+    zdf: JaxZippedDataFrame,
+    map_func: Callable,
+    output_schema: Any,
+    partition_spec: PartitionSpec,
+    on_init: Optional[Callable] = None,
+) -> DataFrame:
+    """Assemble key groups from one columnar export per member and apply
+    the cotransformer. Presence rules per zip type mirror the serialized
+    runner (execution_engine.py _Comap)."""
+    out_schema = Schema(output_schema)
+    keys = zdf.keys
+    how = zdf.how
+    n_members = len(zdf.frames)
+    schemas = [f.schema for f in zdf.frames]
+    sorts = zdf.zip_spec.presort
+    pdfs: List[pd.DataFrame] = []
+    for f in zdf.frames:
+        pdf = f.as_pandas()
+        if sorts:
+            cols = [c for c in sorts if c in pdf.columns]
+            if cols:
+                pdf = pdf.sort_values(
+                    cols,
+                    ascending=[sorts[c] for c in cols],
+                    kind="stable",
+                    na_position="first",
+                ).reset_index(drop=True)
+        pdfs.append(pdf)
+
+    if on_init is not None:
+        empty = [ArrayDataFrame([], s) for s in schemas]
+        on_init(0, _make_dfs(zdf.names, empty))
+
+    if len(keys) == 0:  # cross zip: one group, whole frames
+        frames: List[DataFrame] = [
+            PandasDataFrame(pdf, s) for pdf, s in zip(pdfs, schemas)
+        ]
+        cursor = PartitionSpec().get_cursor(Schema(), 0)
+        res = map_func(cursor, _make_dfs(zdf.names, frames))
+        return engine.to_df(res)
+
+    groups: List[Dict[Tuple[Any, ...], pd.DataFrame]] = []
+    key_order: List[Tuple[Any, ...]] = []
+    seen = set()
+    for pdf in pdfs:
+        g: Dict[Tuple[Any, ...], pd.DataFrame] = {}
+        if len(pdf) > 0:
+            for kv, sub in pdf.groupby(keys, dropna=False, sort=False):
+                ck = _canon_key(kv if isinstance(kv, tuple) else (kv,))
+                g[ck] = sub.reset_index(drop=True)
+        groups.append(g)
+        for ck in g:
+            if ck not in seen:
+                seen.add(ck)
+                key_order.append(ck)
+
+    key_schema = zdf.key_schema
+    spec = PartitionSpec(partition_spec, by=keys)
+    cursor = spec.get_cursor(key_schema, 0)
+    outputs: List[pa.Table] = []
+    part_no = 0
+    for ck in key_order:
+        present = [i for i in range(n_members) if ck in groups[i]]
+        if how == "inner" and len(present) < n_members:
+            continue
+        if how == "left_outer" and 0 not in present:
+            continue
+        if how == "right_outer" and (n_members - 1) not in present:
+            continue
+        frames = [
+            PandasDataFrame(groups[i][ck], schemas[i])
+            if ck in groups[i]
+            else ArrayDataFrame([], schemas[i])
+            for i in range(n_members)
+        ]
+        cursor.set(list(ck), part_no, 0)
+        part_no += 1
+        res = map_func(cursor, _make_dfs(zdf.names, frames))
+        table = res.as_arrow() if res.schema == out_schema else None
+        if table is None:
+            from fugue_tpu.dataframe.arrow_utils import cast_table
+
+            table = cast_table(res.as_arrow(), out_schema)
+        outputs.append(table)
+    if not outputs:
+        return engine.to_df(ArrayDataFrame([], out_schema))
+    merged = pa.concat_tables(outputs)
+    return engine.to_df(ArrowDataFrame(merged, out_schema))
+
+
+def _make_dfs(names: List[str], frames: List[DataFrame]) -> DataFrames:
+    if any(n != "" for n in names):
+        return DataFrames(dict(zip(names, frames)))
+    return DataFrames(frames)

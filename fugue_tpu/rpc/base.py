@@ -172,6 +172,8 @@ def make_rpc_server(conf: Any = None) -> RPCServer:
     in-process native server)."""
     conf = ParamDict(conf)
     tp = conf.get("fugue.rpc.server", "native")
+    if tp.lower() == "http" and "http" not in _SERVER_TYPES:
+        import fugue_tpu.rpc.http  # noqa: F401 (registers "http")
     if tp.lower() in _SERVER_TYPES:
         return _SERVER_TYPES[tp.lower()](conf)
     # a fully qualified class path

@@ -1,0 +1,121 @@
+"""Distributed worker->driver RPC over HTTP (stdlib; the role of
+FlaskRPCServer in the reference, fugue/rpc/flask.py:19-120).
+
+The server runs on the driver; ``make_client`` returns a PICKLABLE client
+carrying only (host, port, key, timeout), so it ships inside map closures
+to remote workers. The wire format is pickle over POST bodies — the same
+trust model as the reference's cloudpickle-over-flask channel: this is a
+private driver<->worker control plane, not a public endpoint.
+
+Conf keys (parity with ``fugue.rpc.flask_server.*``):
+
+- ``fugue.rpc.server = "http"``
+- ``fugue.rpc.http_server.host`` (default ``127.0.0.1``)
+- ``fugue.rpc.http_server.port`` (default ``0`` = ephemeral)
+- ``fugue.rpc.http_server.timeout`` seconds (default ``30``)
+"""
+
+import pickle
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+from fugue_tpu.rpc.base import (
+    RPCClient,
+    RPCServer,
+    register_rpc_server,
+)
+
+__all__ = ["HTTPRPCServer", "HTTPRPCClient"]
+
+_CONF_HOST = "fugue.rpc.http_server.host"
+_CONF_PORT = "fugue.rpc.http_server.port"
+_CONF_TIMEOUT = "fugue.rpc.http_server.timeout"
+
+
+class _RPCRequestHandler(BaseHTTPRequestHandler):
+    # set by the server factory
+    rpc_server: "HTTPRPCServer"
+
+    def do_POST(self) -> None:  # noqa: N802 (stdlib naming)
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            key, args, kwargs = pickle.loads(self.rfile.read(length))
+            result = self.rpc_server.invoke(key, *args, **kwargs)
+            payload = pickle.dumps((True, result))
+        except Exception as ex:  # error crosses the wire as data
+            payload = pickle.dumps((False, f"{type(ex).__name__}: {ex}"))
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def log_message(self, fmt: str, *args: Any) -> None:  # silence stderr
+        pass
+
+
+class HTTPRPCClient(RPCClient):
+    """Picklable: carries only the address and handler key."""
+
+    def __init__(self, host: str, port: int, key: str, timeout: float):
+        self._host = host
+        self._port = port
+        self._key = key
+        self._timeout = timeout
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        body = pickle.dumps((self._key, args, kwargs))
+        req = urllib.request.Request(
+            f"http://{self._host}:{self._port}/", data=body, method="POST"
+        )
+        with urllib.request.urlopen(req, timeout=self._timeout) as resp:
+            ok, payload = pickle.loads(resp.read())
+        if not ok:
+            raise RuntimeError(f"rpc call failed on driver: {payload}")
+        return payload
+
+
+class HTTPRPCServer(RPCServer):
+    """Threaded stdlib HTTP server hosting the registered handlers."""
+
+    def __init__(self, conf: Any = None):
+        super().__init__(conf)
+        self._host: str = self.conf.get(_CONF_HOST, "127.0.0.1")
+        self._port: int = int(self.conf.get(_CONF_PORT, 0))
+        self._timeout: float = float(self.conf.get(_CONF_TIMEOUT, 30))
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> Any:
+        """(host, actual_port) once started."""
+        assert self._httpd is not None, "server not started"
+        return (self._host, self._httpd.server_address[1])
+
+    def start_server(self) -> None:
+        handler = type(
+            "_BoundHandler", (_RPCRequestHandler,), {"rpc_server": self}
+        )
+        self._httpd = ThreadingHTTPServer((self._host, self._port), handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop_server(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def make_client(self, handler: Any) -> RPCClient:
+        key = self.register(handler)
+        host, port = self.address
+        return HTTPRPCClient(host, port, key, self._timeout)
+
+
+register_rpc_server("http", lambda conf: HTTPRPCServer(conf))

@@ -115,6 +115,61 @@ class StrongCheckpoint(Checkpoint):
         return result
 
 
+class TableCheckpoint(Checkpoint):
+    """Save+reload through the SQL engine's table catalog (the reference's
+    StrongCheckpoint storage_type='table'); backs ``yield_table_as``. No
+    checkpoint path needed — tables live in the engine's catalog."""
+
+    def __init__(
+        self,
+        obj_id: str,
+        deterministic: bool = False,
+        namespace: Any = None,
+        **save_kwargs: Any,
+    ):
+        self._obj_id = obj_id
+        self._deterministic = deterministic
+        self._namespace = namespace
+        self._save_kwargs = dict(save_kwargs)
+        self.yielded: Optional[PhysicalYielded] = None
+
+    @property
+    def is_null(self) -> bool:
+        return False
+
+    def _table_name(self, path: "CheckpointPath") -> str:
+        from fugue_tpu.utils.hash import to_uuid
+
+        fid = self._obj_id if self._namespace is None else to_uuid(
+            self._obj_id, self._namespace
+        )
+        return path.execution_engine.sql_engine.encode_name(
+            "tbl_" + fid.replace("-", "")[:24]
+        )
+
+    def try_load(self, path: "CheckpointPath") -> Optional[DataFrame]:
+        if not self._deterministic:
+            return None
+        sql = path.execution_engine.sql_engine
+        name = self._table_name(path)
+        if not sql.table_exists(name):
+            return None
+        result = sql.load_table(name)
+        if self.yielded is not None:
+            self.yielded.set_value(name)
+        return result
+
+    def run(self, df: DataFrame, path: "CheckpointPath") -> DataFrame:
+        sql = path.execution_engine.sql_engine
+        name = self._table_name(path)
+        if not (self._deterministic and sql.table_exists(name)):
+            sql.save_table(df, name, mode="overwrite", **self._save_kwargs)
+        result = sql.load_table(name)
+        if self.yielded is not None:
+            self.yielded.set_value(name)
+        return result
+
+
 class CheckpointPath:
     """Temp/permanent checkpoint dirs per workflow execution (reference
     _checkpoint.py:130-175)."""

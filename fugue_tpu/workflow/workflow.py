@@ -59,6 +59,7 @@ from fugue_tpu.workflow.checkpoint import (
     Checkpoint,
     CheckpointPath,
     StrongCheckpoint,
+    TableCheckpoint,
     WeakCheckpoint,
 )
 from fugue_tpu.workflow.runner import DAGRunner, TaskNode
@@ -376,9 +377,13 @@ class WorkflowDataFrame:
         self._workflow.register_yield(name, y)
 
     def yield_table_as(self, name: str, **kwargs: Any) -> None:
-        raise NotImplementedError(
-            "table yields require a table-supporting SQL engine"
-        )
+        if not isinstance(self._task.checkpoint, TableCheckpoint):
+            self._task.checkpoint = TableCheckpoint(
+                obj_id=self._task.__uuid__(), deterministic=True, **kwargs
+            )
+        y = PhysicalYielded(self._task.__uuid__(), "table")
+        self._task.checkpoint.yielded = y  # type: ignore
+        self._workflow.register_yield(name, y)
 
     # ---- io / output sugar ----------------------------------------------
     def save(

@@ -1,0 +1,179 @@
+"""Device zip/comap: the co-partition path must never serialize (SURVEY
+§3.5 perf cliff) and must match the serialized path's reference semantics."""
+
+from typing import Any, List
+from unittest import mock
+
+import numpy as np
+import pandas as pd
+
+from fugue_tpu import transform
+from fugue_tpu.collections.partition import PartitionSpec
+from fugue_tpu.dataframe import ArrayDataFrame, DataFrames, PandasDataFrame
+from fugue_tpu.execution.native_execution_engine import NativeExecutionEngine
+from fugue_tpu.jax_backend import JaxExecutionEngine
+from fugue_tpu.jax_backend.zipped import JaxZippedDataFrame
+
+
+def make_engine(**conf: Any) -> JaxExecutionEngine:
+    return JaxExecutionEngine(dict(test=True, **conf))
+
+
+def test_zip_never_serializes():
+    e = make_engine()
+    a = e.to_df([[1, "a"], [2, "a"], [3, "b"]], "x:long,k:str")
+    b = e.to_df([["a", 10.0], ["b", 20.0]], "k:str,w:double")
+    with mock.patch(
+        "fugue_tpu.dataframe.utils.serialize_df",
+        side_effect=AssertionError("serialize_df called on device zip"),
+    ):
+        z = e.zip(DataFrames(a, b), partition_spec=PartitionSpec(by=["k"]))
+        assert isinstance(z, JaxZippedDataFrame)
+
+        def cm(cursor, dfs):
+            return ArrayDataFrame(
+                [[cursor.key_value_dict["k"], dfs[0].count(), dfs[1].count()]],
+                "k:str,na:long,nb:long",
+            )
+
+        res = e.comap(z, cm, "k:str,na:long,nb:long", PartitionSpec(by=["k"]))
+        rows = sorted(map(tuple, res.as_array()))
+    assert rows == [("a", 2, 1), ("b", 1, 1)], rows
+
+
+def test_zip_comap_matches_native_all_hows():
+    a_pd = pd.DataFrame({"k": [1, 1, 2, None], "v": [1.0, 2.0, 3.0, 4.0]})
+    b_pd = pd.DataFrame({"k": [2, 3, None], "w": [10.0, 20.0, 30.0]})
+
+    def cm(cursor, dfs):
+        return ArrayDataFrame(
+            [[cursor.key_value_dict["k"], dfs[0].count(), dfs[1].count()]],
+            "k:double,na:long,nb:long",
+        )
+
+    for how in ["inner", "left_outer", "right_outer", "full_outer"]:
+        e, n = make_engine(), NativeExecutionEngine()
+        outs: List[Any] = []
+        for eng in (e, n):
+            da = eng.to_df(PandasDataFrame(a_pd, "k:double,v:double"))
+            db = eng.to_df(PandasDataFrame(b_pd, "k:double,w:double"))
+            z = eng.zip(
+                DataFrames(da, db), how=how,
+                partition_spec=PartitionSpec(by=["k"]),
+            )
+            res = eng.comap(
+                z, cm, "k:double,na:long,nb:long", PartitionSpec(by=["k"])
+            )
+            canon = [
+                (
+                    "<null>"
+                    if r[0] is None
+                    or (isinstance(r[0], float) and np.isnan(r[0]))
+                    else r[0],
+                    r[1],
+                    r[2],
+                )
+                for r in res.as_array()
+            ]
+            outs.append(sorted(canon, key=str))
+        assert outs[0] == outs[1], (how, outs)
+
+
+def test_cotransform_through_workflow():
+    # the user-level path: dag zip + transform with a cotransformer
+    from fugue_tpu.workflow import FugueWorkflow
+
+    a = pd.DataFrame({"k": ["x", "x", "y"], "v": [1, 2, 3]})
+    b = pd.DataFrame({"k": ["x", "z"], "w": [10, 30]})
+
+    def cm(dfs: DataFrames) -> pd.DataFrame:
+        va = dfs[0].as_pandas()
+        vb = dfs[1].as_pandas()
+        return pd.DataFrame(
+            {"k": [va.k.iloc[0]], "s": [int(va.v.sum() + vb.w.sum())]}
+        )
+
+    e = make_engine()
+    dag = FugueWorkflow()
+    za = dag.df(a, "k:str,v:long")
+    zb = dag.df(b, "k:str,w:long")
+    z = za.partition_by("k").zip(zb)
+    res = z.transform(cm, schema="k:str,s:long")
+    res.yield_dataframe_as("out", as_local=True)
+    dag.run(e)
+    rows = sorted(map(tuple, dag.yields["out"].result.as_array()))
+    assert rows == [("x", 13)], rows
+
+
+def test_zip_presort_applies():
+    e = make_engine()
+    a = e.to_df([[1, 3.0], [1, 1.0], [1, 2.0]], "k:long,v:double")
+    b = e.to_df([[1, 9.0]], "k:long,w:double")
+
+    def cm(cursor, dfs):
+        vals = [r[1] for r in dfs[0].as_array()]
+        assert vals == sorted(vals), vals
+        return ArrayDataFrame([[cursor.key_value_dict["k"]]], "k:long")
+
+    z = e.zip(
+        DataFrames(a, b),
+        partition_spec=PartitionSpec(by=["k"], presort="v asc"),
+    )
+    res = e.comap(z, cm, "k:long", PartitionSpec(by=["k"]))
+    assert res.as_array() == [[1]]
+
+
+def test_cross_zip_device():
+    # review r3: cross zip must not crash on the empty key schema
+    e = make_engine()
+    a = e.to_df([[1], [2]], "x:long")
+    b = e.to_df([[10.0]], "w:double")
+    z = e.zip(DataFrames(a, b), how="cross")
+    assert isinstance(z, JaxZippedDataFrame)
+
+    def cm(cursor, dfs):
+        return ArrayDataFrame(
+            [[dfs[0].count(), dfs[1].count()]], "na:long,nb:long"
+        )
+
+    res = e.comap(z, cm, "na:long,nb:long", PartitionSpec())
+    assert res.as_array() == [[2, 1]]
+
+
+def test_zip_local_members_no_device_upload():
+    # review r3: local members stay local inside the wrapper (comap exports
+    # to pandas anyway; uploading first would be waste)
+    from fugue_tpu.dataframe import PandasDataFrame
+
+    e = make_engine()
+    a = PandasDataFrame(pd.DataFrame({"k": [1], "v": [1.0]}), "k:long,v:double")
+    b = PandasDataFrame(pd.DataFrame({"k": [1], "w": [2.0]}), "k:long,w:double")
+    z = e.zip(DataFrames(a, b), partition_spec=PartitionSpec(by=["k"]))
+    assert isinstance(z, JaxZippedDataFrame)
+    assert all(isinstance(f, PandasDataFrame) for f in z.frames)
+
+    def cm(cursor, dfs):
+        return ArrayDataFrame(
+            [[cursor.key_value_dict["k"], dfs[0].count(), dfs[1].count()]],
+            "k:long,na:long,nb:long",
+        )
+
+    res = e.comap(z, cm, "k:long,na:long,nb:long", PartitionSpec(by=["k"]))
+    assert res.as_array() == [[1, 1, 1]]
+
+
+def test_device_zip_opt_out():
+    e = make_engine(**{"fugue.jax.device_zip": False})
+    a = e.to_df([[1, 1.0]], "k:long,v:double")
+    b = e.to_df([[1, 2.0]], "k:long,w:double")
+    z = e.zip(DataFrames(a, b), partition_spec=PartitionSpec(by=["k"]))
+    assert not isinstance(z, JaxZippedDataFrame)
+
+    def cm(cursor, dfs):
+        return ArrayDataFrame(
+            [[cursor.key_value_dict["k"], dfs[0].count(), dfs[1].count()]],
+            "k:long,na:long,nb:long",
+        )
+
+    res = e.comap(z, cm, "k:long,na:long,nb:long", PartitionSpec(by=["k"]))
+    assert sorted(map(tuple, res.as_array())) == [(1, 1, 1)]
