@@ -1,21 +1,56 @@
-"""Benchmark: transform() + groupby-agg rows/sec — jax engine vs native.
+"""Benchmark: the BASELINE.md headline plus all five BASELINE configs.
 
-BASELINE.md headline: rows/sec/chip on a numeric transform()+groupby,
-jax (device) vs NativeExecutionEngine (pandas). Prints ONE json line:
-``{"metric":..., "value":..., "unit":..., "vs_baseline":...}`` where value is
-the jax engine's rows/sec and vs_baseline its speedup over native.
+Prints ONE json line (driver contract):
+``{"metric":..., "value":..., "unit":..., "vs_baseline":..., "detail":...}``
+where value is the jax engine's rows/sec on the 100M-row numeric
+transform()+groupby and ``vs_baseline`` its speedup over native. The
+``detail.configs`` dict carries every BASELINE.md config (1-5), each with
+native/jax secs + rows/sec + speedup. Set ``BENCH_CONFIGS=lines`` to also
+print one json line per config (for humans; the driver reads line 1).
 
-Env knobs: BENCH_ROWS (default 100_000_000 per BASELINE.md north star /
-capped 4_000_000 native, scaled to rows/sec), BENCH_GROUPS (default 1024).
+Env knobs: BENCH_ROWS (default 100_000_000), BENCH_GROUPS (1024),
+BENCH_NATIVE_ROWS (10_000_000), BENCH_SMALL=1 (scale everything down ~100x
+for a fast smoke run).
 """
 
 import json
 import os
+import tempfile
 import time
-from typing import Any, Dict
+from typing import Any, Callable, Dict, Tuple
+
+_SMALL = os.environ.get("BENCH_SMALL", "") in ("1", "true")
 
 
-def _bench() -> Dict[str, Any]:
+def _scale(n: int) -> int:
+    return max(10_000, n // 100) if _SMALL else n
+
+
+def _timed(fn: Callable[[], Any], warm: int = 3) -> float:
+    fn()  # cold
+    samples = []
+    for _ in range(warm):
+        t0 = time.perf_counter()
+        fn()
+        samples.append(time.perf_counter() - t0)
+    samples.sort()
+    return samples[len(samples) // 2]
+
+
+def _pair(rows: int, native_fn: Callable, jax_fn: Callable) -> Dict[str, Any]:
+    native_secs = _timed(native_fn)
+    jax_secs = _timed(jax_fn)
+    return {
+        "rows": rows,
+        "native_secs": round(native_secs, 4),
+        "jax_secs": round(jax_secs, 4),
+        "native_rows_per_sec": round(rows / native_secs, 1),
+        "jax_rows_per_sec": round(rows / jax_secs, 1),
+        "speedup": round(native_secs / jax_secs, 2),
+    }
+
+
+def _bench_headline() -> Dict[str, Any]:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -27,9 +62,11 @@ def _bench() -> Dict[str, Any]:
     from fugue_tpu.execution import make_execution_engine
     from fugue_tpu.execution.api import aggregate
 
-    n_rows = int(os.environ.get("BENCH_ROWS", 100_000_000))
+    n_rows = _scale(int(os.environ.get("BENCH_ROWS", 100_000_000)))
     n_groups = int(os.environ.get("BENCH_GROUPS", 1024))
-    n_native = min(n_rows, int(os.environ.get("BENCH_NATIVE_ROWS", 4_000_000)))
+    n_native = min(
+        n_rows, _scale(int(os.environ.get("BENCH_NATIVE_ROWS", 10_000_000)))
+    )
 
     rng = np.random.default_rng(42)
     # float32 + int32: TPU-friendly dtypes (f64 has no TPU hardware path)
@@ -43,15 +80,19 @@ def _bench() -> Dict[str, Any]:
         return df.assign(v2=df["v"] * 2.0 + 1.0)
 
     native = make_execution_engine("native")
+
+    def run_native() -> None:
+        out = transform(pdf_small, pandas_udf, schema="*,v2:float",
+                        engine=native, as_fugue=True)
+        agg = aggregate(
+            out, partition_by="k",
+            s=ff.sum(col("v2")), m=ff.avg(col("v2")), c=ff.count(col("v2")),
+            engine=native, as_fugue=True,
+        )
+        agg.as_local()
+
     t0 = time.perf_counter()
-    out = transform(pdf_small, pandas_udf, schema="*,v2:float", engine=native,
-                    as_fugue=True)
-    agg = aggregate(
-        out, partition_by="k",
-        s=ff.sum(col("v2")), m=ff.avg(col("v2")), c=ff.count(col("v2")),
-        engine=native, as_fugue=True,
-    )
-    agg.as_local()
+    run_native()
     native_secs = time.perf_counter() - t0
     native_rps = n_native / native_secs
 
@@ -101,11 +142,263 @@ def _bench() -> Dict[str, Any]:
             "jax_cold_secs": round(cold_secs, 4),
             "native_secs": round(native_secs, 4),
             "native_rows_per_sec": round(native_rps, 1),
-            "devices": len(__import__("jax").devices()),
-            "platform": __import__("jax").devices()[0].platform,
+            "devices": len(jax.devices()),
+            "platform": jax.devices()[0].platform,
         },
     }
 
 
+def _config1_map_letter_to_food() -> Dict[str, Any]:
+    """BASELINE config 1: the README map_letter_to_food transform (string
+    mapping UDF). String columns have no device kernel; the jax engine runs
+    it through its host map path — measured as-is (honest)."""
+    import numpy as np
+    import pandas as pd
+
+    from fugue_tpu import transform
+    from fugue_tpu.execution import make_execution_engine
+
+    n = _scale(2_000_000)
+    mapping = {"A": "Apple", "B": "Banana", "C": "Carrot"}
+    pdf = pd.DataFrame(
+        {"id": np.arange(n), "value": np.random.default_rng(0).choice(
+            ["A", "B", "C"], n)}
+    )
+
+    def map_letter_to_food(df: pd.DataFrame, mp: dict) -> pd.DataFrame:
+        df["value"] = df["value"].map(mp)
+        return df
+
+    def run(engine: Any) -> None:
+        transform(
+            pdf, map_letter_to_food, schema="*",
+            params=dict(mp=mapping), engine=engine, as_fugue=True,
+        ).as_local()
+
+    native = make_execution_engine("native")
+    jax_e = make_execution_engine("jax")
+    return _pair(n, lambda: run(native), lambda: run(jax_e))
+
+
+def _config2_partition_udf() -> Dict[str, Any]:
+    """BASELINE config 2: 10M-row vectorized UDF with partition_by."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import pandas as pd
+
+    from fugue_tpu import transform
+    from fugue_tpu.execution import make_execution_engine
+
+    n = _scale(10_000_000)
+    rng = np.random.default_rng(1)
+    pdf = pd.DataFrame(
+        {
+            "k": rng.integers(0, 512, n).astype(np.int32),
+            "v": rng.random(n).astype(np.float32),
+        }
+    )
+
+    def pandas_udf(df: pd.DataFrame) -> pd.DataFrame:
+        return df.assign(z=(df["v"] - df["v"].mean()))
+
+    def jax_udf(arrs: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        seg, num, valid = (
+            arrs["_segment_ids"], arrs["_num_segments"], arrs["_row_valid"]
+        )
+        v = jnp.where(valid, arrs["v"], 0.0)
+        cnt = jax.ops.segment_sum(
+            jnp.where(valid, 1.0, 0.0), seg, num_segments=num
+        )
+        mean = jax.ops.segment_sum(v, seg, num_segments=num) / jnp.maximum(
+            cnt, 1.0
+        )
+        return {
+            "k": arrs["k"], "v": arrs["v"],
+            "z": arrs["v"] - mean[jnp.clip(seg, 0, num - 1)],
+        }
+
+    native = make_execution_engine("native")
+    jax_e = make_execution_engine("jax")
+    jsrc = jax_e.to_df(pdf)
+
+    def run_native() -> None:
+        transform(
+            pdf, pandas_udf, schema="*,z:float",
+            partition={"by": ["k"]}, engine=native, as_fugue=True,
+        ).as_local()
+
+    def run_jax() -> None:
+        out = transform(
+            jsrc, jax_udf, schema="k:int,v:float,z:float",
+            partition={"by": ["k"]}, engine=jax_e, as_fugue=True,
+        )
+        import jax as _j
+
+        _j.device_get(
+            [c.data for c in out.native.columns.values() if c.on_device][:1]
+        )
+
+    return _pair(n, run_native, run_jax)
+
+
+def _config3_fuguesql_groupby() -> Dict[str, Any]:
+    """BASELINE config 3: FugueSQL SELECT + GROUP BY sum/mean/count."""
+    import numpy as np
+    import pandas as pd
+
+    from fugue_tpu.execution import make_execution_engine
+    from fugue_tpu.workflow.api import raw_sql
+
+    n = _scale(10_000_000)
+    rng = np.random.default_rng(2)
+    pdf = pd.DataFrame(
+        {
+            "k": rng.integers(0, 256, n).astype(np.int32),
+            "v": rng.random(n).astype(np.float32),
+        }
+    )
+    native = make_execution_engine("native")
+    jax_e = make_execution_engine("jax")
+    jsrc = jax_e.to_df(pdf)
+
+    def run(engine: Any, src: Any) -> None:
+        raw_sql(
+            "SELECT k, SUM(v) AS s, AVG(v) AS m, COUNT(*) AS c FROM", src,
+            "GROUP BY k", engine=engine, as_fugue=True,
+        ).as_local()
+
+    return _pair(
+        n, lambda: run(native, pdf), lambda: run(jax_e, jsrc)
+    )
+
+
+def _config4_cotransform() -> Dict[str, Any]:
+    """BASELINE config 4: cotransform inner zip+comap of two partitioned
+    dataframes (the path rebuilt without serialization)."""
+    import numpy as np
+    import pandas as pd
+
+    from fugue_tpu.collections.partition import PartitionSpec
+    from fugue_tpu.dataframe import DataFrames
+    from fugue_tpu.execution import make_execution_engine
+
+    groups = 2_000 if not _SMALL else 100
+    per = 50
+    n = groups * per
+    rng = np.random.default_rng(3)
+    a = pd.DataFrame(
+        {
+            "k": np.repeat(np.arange(groups, dtype=np.int64), per),
+            "v": rng.random(n),
+        }
+    )
+    b = pd.DataFrame(
+        {
+            "k": np.arange(groups, dtype=np.int64),
+            "w": rng.random(groups),
+        }
+    )
+
+    def cm(cursor: Any, dfs: Any) -> Any:
+        from fugue_tpu.dataframe import ArrayDataFrame
+
+        va = dfs[0].as_pandas()
+        vb = dfs[1].as_pandas()
+        return ArrayDataFrame(
+            [[cursor.key_value_dict["k"],
+              float(va.v.sum() + (vb.w.sum() if len(vb) else 0.0))]],
+            "k:long,s:double",
+        )
+
+    def run(engine: Any) -> None:
+        da = engine.to_df(a)
+        db = engine.to_df(b)
+        z = engine.zip(
+            DataFrames(da, db), partition_spec=PartitionSpec(by=["k"])
+        )
+        engine.comap(
+            z, cm, "k:long,s:double", PartitionSpec(by=["k"])
+        ).as_local_bounded()
+
+    native = make_execution_engine("native")
+    jax_e = make_execution_engine("jax")
+    return _pair(n, lambda: run(native), lambda: run(jax_e))
+
+
+def _config5_e2e_parquet() -> Dict[str, Any]:
+    """BASELINE config 5: load parquet -> transform -> groupby -> save."""
+    import numpy as np
+    import pandas as pd
+
+    from fugue_tpu.column import col
+    from fugue_tpu.column import functions as ff
+    from fugue_tpu.execution import make_execution_engine
+    from fugue_tpu.execution.api import aggregate
+    from fugue_tpu import transform
+
+    n = _scale(5_000_000)
+    rng = np.random.default_rng(4)
+    pdf = pd.DataFrame(
+        {
+            "k": rng.integers(0, 128, n).astype(np.int32),
+            "v": rng.random(n).astype(np.float32),
+        }
+    )
+    tmp = tempfile.mkdtemp(prefix="fugue_bench_")
+    src_path = os.path.join(tmp, "src.parquet")
+    pdf.to_parquet(src_path)
+
+    def pandas_udf(df: pd.DataFrame) -> pd.DataFrame:
+        return df.assign(v2=df["v"] * 0.5)
+
+    import jax as _jax
+    import jax.numpy as jnp
+
+    def jax_udf(arrs: Dict[str, _jax.Array]) -> Dict[str, _jax.Array]:
+        return {"k": arrs["k"], "v2": arrs["v"] * jnp.float32(0.5)}
+
+    engines = {
+        "native": make_execution_engine("native"),
+        "jax": make_execution_engine("jax"),
+    }
+
+    def run(engine: Any, udf: Any, schema: str, out_name: str) -> None:
+        e = engines[engine]  # reuse: jit caches live on the engine
+        df = e.load_df(src_path, format_hint="parquet")
+        out = transform(df, udf, schema=schema, engine=e, as_fugue=True)
+        agg = aggregate(
+            out, partition_by="k",
+            s=ff.sum(col("v2")), c=ff.count(col("v2")),
+            engine=e, as_fugue=True,
+        )
+        e.save_df(agg, os.path.join(tmp, out_name), format_hint="parquet")
+
+    return _pair(
+        n,
+        lambda: run("native", pandas_udf, "*,v2:float", "out_native.parquet"),
+        lambda: run(
+            "jax", jax_udf, "k:int,v2:float", "out_jax.parquet"
+        ),
+    )
+
+
+def _bench() -> Dict[str, Any]:
+    headline = _bench_headline()
+    configs = {
+        "1_map_letter_to_food": _config1_map_letter_to_food(),
+        "2_partition_udf": _config2_partition_udf(),
+        "3_fuguesql_groupby": _config3_fuguesql_groupby(),
+        "4_cotransform": _config4_cotransform(),
+        "5_e2e_parquet": _config5_e2e_parquet(),
+    }
+    headline["detail"]["configs"] = configs
+    return headline
+
+
 if __name__ == "__main__":
-    print(json.dumps(_bench()))
+    res = _bench()
+    if os.environ.get("BENCH_CONFIGS", "") == "lines":
+        for name, cfg in res["detail"]["configs"].items():
+            print(json.dumps({"metric": name, **cfg}))
+    print(json.dumps(res))

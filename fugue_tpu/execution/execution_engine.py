@@ -522,6 +522,16 @@ class ExecutionEngine(FugueEngineBase):
         to_file_threshold: int = -1,
     ) -> DataFrame:
         keys = [k for k in partition_spec.partition_by if k in df.schema]
+        # presort columns are filtered PER FRAME (reference :1232: a zip
+        # presort may reference columns that exist in only some members)
+        presort = [
+            (c, asc)
+            for c, asc in partition_spec.presort.items()
+            if c in df.schema
+        ]
+        partition_spec = PartitionSpec(
+            partition_spec, by=keys, presort=presort
+        )
         output_schema = Schema(
             [df.schema[k] for k in keys]
             + [(_FUGUE_SER_NO, "int"), (_FUGUE_SER_KEY, "bytes")]  # type: ignore
@@ -538,8 +548,9 @@ class ExecutionEngine(FugueEngineBase):
             row = [cursor.key_value_dict[k] for k in keys] + [df_no, blob]
             return ArrayDataFrame([row], output_schema)
 
-        spec = PartitionSpec(partition_spec, by=keys)
-        return self.map_engine.map_dataframe(df, _serialize, output_schema, spec)
+        return self.map_engine.map_dataframe(
+            df, _serialize, output_schema, partition_spec
+        )
 
     def comap(
         self,

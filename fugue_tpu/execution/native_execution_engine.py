@@ -108,8 +108,9 @@ class PandasMapEngine(MapEngine):
                     pd.util.hash_pandas_object(pdf, index=False).to_numpy()
                     % num
                 )
-                for i in range(num):
-                    yield pdf[ids == i]
+                # one O(n) groupby pass, not num full-length mask scans
+                for _, sub in pdf.groupby(ids, sort=True):
+                    yield sub
             elif spec.algo == "rand":
                 # seeded shuffle then even chunks (reference :26
                 # rand_repartition); deterministic per run for testability
@@ -158,8 +159,19 @@ class PandasMapEngine(MapEngine):
 
 # process-wide table catalog: the role of the duckdb connection / spark
 # session catalog in the reference backends. Single-controller engines all
-# share it, so table yields cross workflows and engine instances.
+# share it, so table yields cross workflows and engine instances. Long-lived
+# processes reclaim memory with drop_table / clear_table_catalog.
 _TABLE_CATALOG: Dict[str, Any] = {}
+
+
+def drop_table(name: str) -> None:
+    "Remove one table from the in-memory catalog (no-op if absent)."
+    _TABLE_CATALOG.pop(name, None)
+
+
+def clear_table_catalog() -> None:
+    "Drop every table in the in-memory catalog."
+    _TABLE_CATALOG.clear()
 
 
 class PandasSQLEngine(SQLEngine):
@@ -431,7 +443,13 @@ class NativeExecutionEngine(ExecutionEngine):
         force_single: bool = False,
         **kwargs: Any,
     ) -> None:
-        _io.save_df(df, path, format_hint, mode, **kwargs)
+        partition_spec = partition_spec or PartitionSpec()
+        cols = (
+            list(partition_spec.partition_by)
+            if not force_single and len(partition_spec.partition_by) > 0
+            else None
+        )
+        _io.save_df(df, path, format_hint, mode, partition_cols=cols, **kwargs)
 
 
 def _pandas_distinct(pdf: pd.DataFrame) -> pd.DataFrame:

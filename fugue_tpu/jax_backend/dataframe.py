@@ -20,27 +20,52 @@ from fugue_tpu.utils.assertion import assert_or_throw
 
 
 class JaxDataFrame(DataFrame):
-    """Columnar, device-resident, mesh-sharded dataframe."""
+    """Columnar, device-resident, mesh-sharded dataframe.
+
+    Ingestion is LAZY: a frame built :meth:`from_table` keeps the arrow
+    table and uploads to the mesh only when a device op first touches
+    :attr:`blocks`. Host-path chains (host-fallback maps, string
+    transforms, immediate ``as_local``) therefore never pay a device
+    round trip — on a network-tunneled TPU that round trip costs seconds
+    per GB each way. Once blocks materialize, the host copy is dropped
+    (no double-residency); columns are immutable so the pending table is
+    always an exact image of the frame."""
 
     def __init__(self, blocks: JaxBlocks, schema: Schema):
         super().__init__(schema)
-        self._blocks = blocks
+        self._blocks: Optional[JaxBlocks] = blocks
+        self._pending: Optional[Any] = None  # (pa.Table, mesh) before upload
 
     @staticmethod
     def from_table(table: pa.Table, mesh: Any, schema: Optional[Schema] = None) -> "JaxDataFrame":
         schema = Schema(table.schema) if schema is None else schema
-        return JaxDataFrame(from_arrow(table, schema, mesh), schema)
+        res = JaxDataFrame.__new__(JaxDataFrame)
+        DataFrame.__init__(res, schema)
+        res._blocks = None
+        res._pending = (table, mesh)
+        return res
+
+    @property
+    def is_pending(self) -> bool:
+        """True while the data only lives on host (no device copy yet)."""
+        return self._blocks is None
 
     @property
     def native(self) -> JaxBlocks:
-        return self._blocks
+        return self.blocks
 
     @property
     def blocks(self) -> JaxBlocks:
+        if self._blocks is None:
+            table, mesh = self._pending  # type: ignore[misc]
+            self._blocks = from_arrow(table, self.schema, mesh)
+            self._pending = None  # device copy is authoritative now
         return self._blocks
 
     @property
     def mesh(self) -> Any:
+        if self._blocks is None:
+            return self._pending[1]  # type: ignore[index]
         return self._blocks.mesh
 
     @property
@@ -53,13 +78,17 @@ class JaxDataFrame(DataFrame):
 
     @property
     def num_partitions(self) -> int:
-        return int(self._blocks.mesh.devices.size)
+        return int(self.mesh.devices.size)
 
     @property
     def empty(self) -> bool:
+        if self._blocks is None:
+            return self._pending[0].num_rows == 0  # type: ignore[index]
         return self._blocks.nrows == 0
 
     def count(self) -> int:
+        if self._blocks is None:
+            return self._pending[0].num_rows  # type: ignore[index]
         return self._blocks.nrows
 
     def peek_array(self) -> List[Any]:
@@ -67,6 +96,8 @@ class JaxDataFrame(DataFrame):
         return self.head(1).as_array(type_safe=True)[0]
 
     def as_arrow(self, type_safe: bool = False) -> pa.Table:
+        if self._blocks is None:
+            return self._pending[0]  # type: ignore[index]
         return to_arrow(self._blocks, self.schema)
 
     def as_pandas(self) -> pd.DataFrame:
@@ -99,6 +130,11 @@ class JaxDataFrame(DataFrame):
         return self._select_schema(schema)
 
     def _select_schema(self, schema: Schema) -> "JaxDataFrame":
+        if self._blocks is None:
+            table, mesh = self._pending  # type: ignore[misc]
+            return JaxDataFrame.from_table(
+                table.select(schema.names), mesh, schema
+            )
         blocks = JaxBlocks(
             self._blocks._nrows,
             {n: self._blocks.columns[n] for n in schema.names},
@@ -110,6 +146,11 @@ class JaxDataFrame(DataFrame):
 
     def rename(self, columns: Dict[str, str]) -> DataFrame:
         schema = self._rename_schema(columns)
+        if self._blocks is None:
+            table, mesh = self._pending  # type: ignore[misc]
+            return JaxDataFrame.from_table(
+                table.rename_columns(schema.names), mesh, schema
+            )
         cols = {
             columns.get(n, n): c for n, c in self._blocks.columns.items()
         }
@@ -130,7 +171,7 @@ class JaxDataFrame(DataFrame):
             return self
         # general correctness path: cast at the host boundary, re-device
         table = cast_table(self.as_arrow(), new_schema)
-        return JaxDataFrame.from_table(table, self._blocks.mesh, new_schema)
+        return JaxDataFrame.from_table(table, self.mesh, new_schema)
 
     def head(
         self, n: int, columns: Optional[List[str]] = None
@@ -138,6 +179,9 @@ class JaxDataFrame(DataFrame):
         assert_or_throw(n >= 0, ValueError("n must be >= 0"))
         schema = self.schema if columns is None else self.schema.extract(columns)
         src = self if columns is None else self[columns]
+        if src._blocks is None:  # type: ignore[union-attr]
+            table = src._pending[0]  # type: ignore[index]
+            return ArrowDataFrame(table.slice(0, n), schema)
         blocks = src._blocks  # type: ignore
         if blocks.row_valid is not None:
             # masked layout: locate the first n valid rows (one mask

@@ -130,15 +130,23 @@ class JaxMapEngine(MapEngine):
     def _device_mappable(
         self, df: JaxDataFrame, output_schema: Schema, spec: PartitionSpec
     ) -> bool:
-        ok_in = all(
-            c.on_device and not c.is_string for c in df.blocks.columns.values()
-        )
         from fugue_tpu.jax_backend.blocks import is_device_type
 
-        ok_out = all(
-            is_device_type(f.type) and not pa.types.is_string(f.type)
-            for f in output_schema.fields
-        )
+        def _numeric(tp: pa.DataType) -> bool:
+            return is_device_type(tp) and not (
+                pa.types.is_string(tp) or pa.types.is_large_string(tp)
+            )
+
+        if df.is_pending:
+            # decide from the schema — don't materialize the device copy
+            # just to discover the frame belongs on the host path
+            ok_in = all(_numeric(f.type) for f in df.schema.fields)
+        else:
+            ok_in = all(
+                c.on_device and not c.is_string
+                for c in df.blocks.columns.values()
+            )
+        ok_out = all(_numeric(f.type) for f in output_schema.fields)
         return ok_in and ok_out
 
     def _compiled_map(
@@ -578,14 +586,20 @@ class JaxExecutionEngine(ExecutionEngine):
             }
         )
         if algo == "hash":
+            if num <= 1:
+                return jdf
             fr = groupby.factorize_keys(blocks, by)
-            part = np.asarray(fr.seg) % max(num, 1)
+            seg = np.asarray(fr.seg)
+            part = seg % num
             valid = np.asarray(blocks.validity())
-            # sentinel = num (sorts after every real partition id; an int64
-            # max literal would WRAP in the int32 seg dtype under NEP50)
-            idx = np.argsort(np.where(valid, part, num), kind="stable")[
-                : int(valid.sum())
-            ]
+            # order by (partition id, key id) so equal keys stay contiguous
+            # even when distinct keys collide into one partition; invalid
+            # rows sort last via the out-of-range sentinels (int64 literals
+            # would WRAP in the int32 seg dtype under NEP50)
+            idx = np.lexsort(
+                (np.where(valid, seg, seg.max() + 1),
+                 np.where(valid, part, num))
+            )[: int(valid.sum())]
         else:  # rand
             valid = np.asarray(blocks.validity())
             vidx = np.nonzero(valid)[0]

@@ -26,6 +26,7 @@ from fugue_tpu.dataframe import (
     PandasDataFrame,
 )
 from fugue_tpu.execution.execution_engine import (
+    _FUGUE_SER_NO,
     _ZIP_HOW_META,
     _ZIP_NAMES_META,
     _ZIP_SCHEMAS_META,
@@ -53,7 +54,7 @@ class JaxZippedDataFrame(DataFrame):
         super().__init__(
             key_schema
             if len(key_schema) > 0
-            else Schema([("_fugue_ser_no", "int")])
+            else Schema([(_FUGUE_SER_NO, "int")])
         )
         self.key_schema = key_schema
         self.frames = frames

@@ -57,6 +57,20 @@ def load_df(
     fmt = infer_format(paths[0], format_hint)
     tables = []
     for p in paths:
+        if fmt == "parquet" and os.path.isdir(p):
+            # dataset read: flat part dirs AND hive-partitioned layouts
+            # (partition columns are restored from the directory names)
+            cols = columns if isinstance(columns, list) else None
+            t = pq.read_table(p, columns=cols, **kwargs)
+            # hive partition keys arrive dictionary-encoded; decode to
+            # plain types (our schema language has no dictionary type)
+            for i, f in enumerate(t.schema):
+                if pa.types.is_dictionary(f.type):
+                    t = t.set_column(
+                        i, f.name, t.column(i).cast(f.type.value_type)
+                    )
+            tables.append(t)
+            continue
         for f in _part_files(p, fmt):
             # copy kwargs: the csv branch pops options, every file must see them
             tables.append(_load_single(f, fmt, columns, dict(kwargs)))
@@ -125,6 +139,7 @@ def save_df(
     format_hint: Optional[str] = None,
     mode: str = "overwrite",
     force_single: bool = False,
+    partition_cols: Optional[List[str]] = None,
     **kwargs: Any,
 ) -> None:
     fmt = infer_format(path, format_hint)
@@ -140,6 +155,19 @@ def save_df(
                 shutil.rmtree(path)
             else:
                 os.remove(path)
+    if partition_cols:
+        # hive-style partitioned dataset (reference native engine:
+        # partition_spec.partition_by -> pandas to_parquet partition_cols)
+        assert_or_throw(
+            fmt == "parquet",
+            NotImplementedError(f"partitioned save not supported for {fmt}"),
+        )
+        table_p = df.as_local_bounded().as_arrow(type_safe=True)
+        pq.write_to_dataset(
+            table_p, root_path=path, partition_cols=list(partition_cols),
+            **kwargs,
+        )
+        return
     table = df.as_local_bounded().as_arrow(type_safe=True)
     if mode == "append" and os.path.exists(path):
         if os.path.isdir(path):

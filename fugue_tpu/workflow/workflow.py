@@ -368,6 +368,12 @@ class WorkflowDataFrame:
 
     def yield_file_as(self, name: str, **kwargs: Any) -> None:
         if not isinstance(self._task.checkpoint, StrongCheckpoint):
+            # reference workflow.py:1006: a RANDOM namespace per DAG build =
+            # permanent but effectively non-deterministic checkpoint, so a
+            # rebuilt workflow with different data never serves stale yields
+            # (task uuids hash dataframes weakly); an EXPLICIT deterministic
+            # checkpoint before the yield opts back into skip-on-rerun
+            kwargs.setdefault("namespace", str(uuid4()))
             self._task.checkpoint = StrongCheckpoint(
                 obj_id=self._task.__uuid__(), deterministic=True, permanent=True,
                 **kwargs,
@@ -378,6 +384,8 @@ class WorkflowDataFrame:
 
     def yield_table_as(self, name: str, **kwargs: Any) -> None:
         if not isinstance(self._task.checkpoint, TableCheckpoint):
+            # same random-namespace guard as yield_file_as
+            kwargs.setdefault("namespace", str(uuid4()))
             self._task.checkpoint = TableCheckpoint(
                 obj_id=self._task.__uuid__(), deterministic=True, **kwargs
             )

@@ -1,0 +1,63 @@
+"""Abstract conformance suite for Bag implementations (parity role:
+reference fugue_test/bag_suite.py). Subclass and implement ``bag``."""
+
+from typing import Any
+
+import pytest
+
+
+class BagTests:
+    class Tests:
+        def bag(self, data: Any = None) -> Any:  # pragma: no cover
+            raise NotImplementedError
+
+        def test_init_and_count(self):
+            b = self.bag([1, "a", None, 2.5])
+            assert b.count() == 4
+            assert not b.empty
+            assert self.bag([]).empty
+            assert b.is_bounded
+            assert b.is_local == b.as_local().is_local
+
+        def test_peek(self):
+            b = self.bag([3, 1])
+            assert b.peek() in (3, 1)
+            with pytest.raises(Exception):
+                self.bag([]).peek()
+
+        def test_as_array(self):
+            data = [1, {"a": 1}, [2, 3], "x"]
+            b = self.bag(data)
+            got = b.as_array()
+            assert len(got) == 4
+            for item in data:
+                assert item in got
+
+        def test_head(self):
+            b = self.bag(list(range(10)))
+            h = b.head(3)
+            assert h.count() == 3
+            assert all(x in range(10) for x in h.as_array())
+            assert b.head(0).count() == 0
+            with pytest.raises(Exception):
+                b.head(-1)
+
+        def test_show(self, capsys):
+            b = self.bag([1, 2])
+            b.show(with_count=True)
+            out = capsys.readouterr().out
+            assert "2" in out
+
+        def test_map_bag_through_engine(self):
+            from fugue_tpu.bag.array_bag import ArrayBag
+            from fugue_tpu.collections.partition import PartitionSpec
+            from fugue_tpu.execution import make_execution_engine
+
+            e = make_execution_engine("native")
+            b = self.bag([1, 2, 3])
+
+            def mapper(no: int, bag: Any) -> Any:
+                return ArrayBag([x * 2 for x in bag.as_array()])
+
+            res = e.map_engine.map_bag(b, mapper, PartitionSpec())
+            assert sorted(res.as_array()) == [2, 4, 6]

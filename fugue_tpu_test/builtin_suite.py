@@ -140,10 +140,6 @@ class BuiltInTests:
         def test_out_transform(self):
             collected: List[int] = []
 
-            class Counter:
-                def __init__(self):
-                    self.n = 0
-
             def f(df: pd.DataFrame) -> None:
                 collected.append(len(df))
 
@@ -244,6 +240,23 @@ class BuiltInTests:
             a.take(3, presort="x desc").assert_eq(
                 dag.df([[19], [18], [17]], "x:long")
             )
+            s = a.sample(n=5, seed=3)
+
+            def check_n(df: pd.DataFrame) -> pd.DataFrame:
+                assert len(df) == 5
+                assert df.x.isin(range(20)).all()
+                return df.head(0)
+
+            s.transform(check_n, schema="x:long")
+            # same seed -> same rows (determinism through the DAG)
+            a.sample(n=5, seed=3).assert_eq(s)
+            f = a.sample(frac=0.5, seed=9)
+
+            def check_f(df: pd.DataFrame) -> pd.DataFrame:
+                assert len(df) == 10
+                return df.head(0)
+
+            f.transform(check_f, schema="x:long")
             self.run(dag)
 
         def test_select_filter_assign_aggregate(self):

@@ -166,6 +166,31 @@ class DataFrameTests:
             df2 = df.alter_columns("a:bool")
             assert df2.as_array(type_safe=True) == [[True], [False]]
 
+        def test_alter_columns_datetime(self):
+            import datetime
+
+            df = self.df(
+                [["2020-01-01 01:02:03"], [None]], "a:str"
+            )
+            df2 = df.alter_columns("a:datetime")
+            rows = df2.as_array(type_safe=True)
+            assert rows[0][0] == datetime.datetime(2020, 1, 1, 1, 2, 3)
+            assert rows[1][0] is None
+            df = self.df([["2020-01-01"], [None]], "a:str")
+            df2 = df.alter_columns("a:date")
+            rows = df2.as_array(type_safe=True)
+            assert str(rows[0][0]) == "2020-01-01"
+            assert rows[1][0] is None
+
+        def test_alter_columns_multi(self):
+            # several columns at once; untouched columns keep their types
+            df = self.df(
+                [[1, "2", 3.0, "x"]], "a:long,b:str,c:double,d:str"
+            )
+            df2 = df.alter_columns("a:double,b:int")
+            assert df2.schema == "a:double,b:int,c:double,d:str"
+            assert df2.as_array(type_safe=True) == [[1.0, 2, 3.0, "x"]]
+
         def test_alter_columns_noop(self):
             df = self.df([[1]], "a:long")
             df2 = df.alter_columns("a:long")

@@ -473,6 +473,147 @@ class ExecutionEngineTests:
             e.save_df(a, path, mode="overwrite")
             assert df_eq(e.load_df(path), [[1]], "x:long", throw=True)
 
+        def test_save_load_folder(self, tmp_path):
+            # folder of part files (the distributed convention)
+            e = self.engine
+            folder = os.path.join(str(tmp_path), "parts.parquet")
+            os.makedirs(folder)
+            e.save_df(e.to_df([[1]], "x:long"),
+                      os.path.join(folder, "part-0.parquet"))
+            e.save_df(e.to_df([[2]], "x:long"),
+                      os.path.join(folder, "part-1.parquet"))
+            res = e.load_df(folder, format_hint="parquet")
+            assert df_eq(res, [[1], [2]], "x:long", throw=True)
+
+        def test_save_partitioned(self, tmp_path):
+            # partition_spec on save_df -> hive-style layout, loads back
+            e = self.engine
+            a = e.to_df(
+                [[1, "a", 1.0], [1, "b", 2.0], [2, "c", 3.0]],
+                "k:long,y:str,v:double",
+            )
+            path = os.path.join(str(tmp_path), "p.parquet")
+            e.save_df(a, path, partition_spec=PartitionSpec(by=["k"]))
+            assert sorted(os.listdir(path)) == ["k=1", "k=2"]
+            res = e.load_df(path, columns="k:long,y:str,v:double")
+            assert df_eq(
+                res, [[1, "a", 1.0], [1, "b", 2.0], [2, "c", 3.0]],
+                "k:long,y:str,v:double", throw=True,
+            )
+
+        def test_sample_replace_and_seed(self):
+            e = self.engine
+            a = e.to_df([[i] for i in range(50)], "x:long")
+            r = e.sample(a, n=80, replace=True, seed=1)
+            assert r.as_local().count() == 80
+            s1 = e.sample(a, n=20, seed=42)
+            s2 = e.sample(a, n=20, seed=42)
+            assert df_eq(s1.as_local(), s2.as_local(), throw=True)
+            f1 = e.sample(a, frac=0.5, seed=7)
+            f2 = e.sample(a, frac=0.5, seed=7)
+            assert df_eq(f1.as_local(), f2.as_local(), throw=True)
+
+        def test_take_multi_presort(self):
+            e = self.engine
+            a = e.to_df(
+                [[1, "a", 9.0], [1, "a", 1.0], [2, "b", 5.0], [1, "b", 5.0]],
+                "x:long,k:str,v:double",
+            )
+            res = e.take(a, 1, presort="x desc, v asc")
+            assert df_eq(res, [[2, "b", 5.0]], "x:long,k:str,v:double",
+                         throw=True)
+            res = e.take(
+                a, 1, presort="v desc",
+                partition_spec=PartitionSpec(by=["k"]),
+            )
+            assert df_eq(
+                res, [[1, "a", 9.0], [2, "b", 5.0]], "x:long,k:str,v:double",
+                throw=True,
+            )
+
+        def test_map_rowcount_expression(self):
+            # num="ROWCOUNT/2" through the engine (reference partition.py:191)
+            e = self.engine
+            counts = []
+
+            def mapper(cursor, data):
+                counts.append(data.count())
+                return data
+
+            a = e.to_df([[i] for i in range(8)], "x:long")
+            res = e.map_engine.map_dataframe(
+                a, mapper, "x:long", PartitionSpec(algo="even", num="ROWCOUNT/2")
+            )
+            assert df_eq(res, [[i] for i in range(8)], "x:long", throw=True)
+            assert max(counts) <= 2  # 4 partitions of 2
+
+        def test_comap_three_frames_and_empty_sides(self):
+            e = self.engine
+            a = e.to_df([[1, 1.0], [2, 2.0]], "k:long,v:double")
+            b = e.to_df([[1, 10.0]], "k:long,w:double")
+            c = e.to_df([[2, 100.0], [3, 300.0]], "k:long,u:double")
+            z = e.zip(
+                DataFrames(a, b, c), how="full_outer",
+                partition_spec=PartitionSpec(by=["k"]),
+            )
+
+            def cm(cursor, dfs):
+                assert len(dfs) == 3
+                return ArrayDataFrame(
+                    [[cursor.key_value_dict["k"],
+                      dfs[0].count(), dfs[1].count(), dfs[2].count()]],
+                    "k:long,na:long,nb:long,nc:long",
+                )
+
+            res = e.comap(
+                z, cm, "k:long,na:long,nb:long,nc:long",
+                PartitionSpec(by=["k"]),
+            )
+            assert df_eq(
+                res,
+                [[1, 1, 1, 0], [2, 1, 0, 1], [3, 0, 0, 1]],
+                "k:long,na:long,nb:long,nc:long", throw=True,
+            )
+
+        def test_comap_with_presort(self):
+            e = self.engine
+            a = e.to_df([[1, 3.0], [1, 1.0], [1, 2.0]], "k:long,v:double")
+            b = e.to_df([[1, 0.0]], "k:long,w:double")
+            z = e.zip(
+                DataFrames(a, b),
+                partition_spec=PartitionSpec(by=["k"], presort="v desc"),
+            )
+
+            def cm(cursor, dfs):
+                first = dfs[0].as_array()[0][1]
+                return ArrayDataFrame(
+                    [[cursor.key_value_dict["k"], first]], "k:long,top:double"
+                )
+
+            res = e.comap(z, cm, "k:long,top:double", PartitionSpec(by=["k"]))
+            assert df_eq(res, [[1, 3.0]], "k:long,top:double", throw=True)
+
+        def test_eager_engine_api(self):
+            # the fa.* eager functions against this engine
+            import fugue_tpu.api as fa
+
+            e = self.engine
+            with engine_context(e):
+                a = fa.as_fugue_df([[1, "a"], [2, "b"]], schema="x:long,y:str")
+                b = fa.as_fugue_df([[2, 9.0]], schema="x:long,z:double")
+                j = fa.inner_join(a, b, as_fugue=True)
+                assert df_eq(
+                    j, [[2, "b", 9.0]], "x:long,y:str,z:double", throw=True
+                )
+                u = fa.union(a, a, distinct=False, as_fugue=True)
+                assert u.count() == 4
+                d = fa.distinct(u, as_fugue=True)
+                assert d.count() == 2
+                f = fa.filter(a, col("x") > 1, as_fugue=True)
+                assert df_eq(f, [[2, "b"]], "x:long,y:str", throw=True)
+                agg = fa.aggregate(a, n=ff.count(all_cols()), as_fugue=True)
+                assert df_eq(agg, [[2]], "n:long", throw=True)
+
         # ---- engine context ---------------------------------------------
         def test_engine_context(self):
             e = self.engine

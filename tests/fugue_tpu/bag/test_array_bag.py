@@ -1,0 +1,9 @@
+from typing import Any
+
+from fugue_tpu.bag.array_bag import ArrayBag
+from fugue_tpu_test.bag_suite import BagTests
+
+
+class TestArrayBag(BagTests.Tests):
+    def bag(self, data: Any = None) -> ArrayBag:
+        return ArrayBag(data if data is not None else [])

@@ -83,6 +83,29 @@ def test_jax_repartition_hash_groups_rows():
             prev = k
 
 
+def test_jax_repartition_hash_colliding_keys_stay_contiguous():
+    # review r3: distinct keys colliding into one partition (0%3 == 3%3)
+    # must STILL be grouped contiguously after the reorder
+    e = JaxExecutionEngine(dict(test=True))
+    pdf = pd.DataFrame(
+        {"k": np.array([0, 3, 0, 3, 1, 4, 1, 4], dtype=np.int64),
+         "v": np.arange(8)}
+    )
+    rep = e.repartition(
+        e.to_df(pdf), PartitionSpec(algo="hash", by=["k"], num=3)
+    )
+    rows = rep.as_array()
+    assert sorted(r[1] for r in rows) == list(range(8))
+    ks = [r[0] for r in rows]
+    seen = set()
+    prev = None
+    for k in ks:
+        if k != prev:
+            assert k not in seen, f"key {k} split: {ks}"
+            seen.add(k)
+            prev = k
+
+
 def test_jax_repartition_rand_preserves_rows():
     e = JaxExecutionEngine(dict(test=True))
     pdf = pd.DataFrame({"v": np.arange(32, dtype=np.int64)})

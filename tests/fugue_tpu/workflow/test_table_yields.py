@@ -47,8 +47,9 @@ def test_yield_table_jax_engine():
     assert rows == [1, 2, 3]
 
 
-def test_yield_table_deterministic_skip():
-    # second run of an identical DAG loads the stored table without recompute
+def test_yield_table_explicit_namespace_skips():
+    # reference semantics: default yields get a RANDOM namespace (recompute
+    # per DAG build); an explicit namespace opts into deterministic skip
     calls = []
 
     def creator() -> pd.DataFrame:
@@ -58,9 +59,27 @@ def test_yield_table_deterministic_skip():
     for _ in range(2):
         dag = FugueWorkflow()
         df = dag.create(creator, schema="a:long")
-        df.yield_table_as("t")
+        df.yield_table_as("t", namespace="fixed-ns")
         dag.run("native")
     assert len(calls) == 1, calls
+
+
+def test_yield_table_no_stale_data_across_builds():
+    # review r3: two workflows whose dataframes share a repr-hash must NOT
+    # serve each other's cached tables
+    n = 100
+    base = list(range(n))
+    for marker in (111111, 999999):
+        data = list(base)
+        data[50] = marker  # middle row: truncated repr is identical
+        dag = FugueWorkflow()
+        dag.df(pd.DataFrame({"a": data}), "a:long").yield_table_as("t")
+        dag.run("native")
+        dag2 = FugueWorkflow()
+        dag2.df(dag.yields["t"]).yield_dataframe_as("r", as_local=True)
+        dag2.run("native")
+        vals = [r[0] for r in dag2.yields["r"].result.as_array()]
+        assert marker in vals, f"stale table served (missing {marker})"
 
 
 def test_fugue_sql_yield_table():
