@@ -78,6 +78,9 @@ class FugueSQLCompiler:
         self.last = last
 
     def compile(self, code: str) -> Dict[str, Any]:
+        from fugue_tpu.sql_frontend.native_build import enable_native_scanner
+
+        enable_native_scanner()  # idempotent; falls back to python silently
         cur = Cursor(tokenize(code))
         while not cur.at_end():
             if cur.accept_op(";"):

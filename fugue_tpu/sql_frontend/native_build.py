@@ -1,0 +1,102 @@
+"""Build & install the C++ SQL scanner (the role of the reference's
+``fugue-sql-antlr[cpp]`` accelerated parser, reference README.md:162).
+
+``enable_native_scanner()`` compiles ``native/ctokenizer.cpp`` with g++ at
+first use (cached as a .so next to a source-hash marker, so rebuilds only
+happen when the source changes), loads it, and installs it via
+:func:`fugue_tpu.sql_frontend.tokenizer.set_accelerated_scanner`. Every
+failure path (no compiler, load error) leaves the pure-Python scanner in
+place — acceleration is strictly opt-out-able and never changes behavior
+(the C scanner defers to Python on anything it can't lex identically).
+
+Set ``FUGUE_TPU_NO_NATIVE=1`` to skip entirely.
+"""
+
+import hashlib
+import importlib.util
+import os
+import subprocess
+import sysconfig
+from typing import Optional
+
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_SRC = os.path.join(_REPO, "native", "ctokenizer.cpp")
+_BUILD_DIR = os.path.join(_REPO, "native", "_build")
+_STATE = {"tried": False, "ok": False}
+
+
+def _so_path(src_hash: str) -> str:
+    return os.path.join(_BUILD_DIR, f"_fugue_tpu_ctokenizer_{src_hash}.so")
+
+
+def _build() -> Optional[str]:
+    # EVERY failure (no source, read-only fs, no compiler) returns None so
+    # the pure-Python scanner silently takes over — never crash a SQL call
+    try:
+        with open(_SRC, "rb") as fp:
+            src_hash = hashlib.sha256(fp.read()).hexdigest()[:16]
+        so = _so_path(src_hash)
+        if os.path.exists(so):
+            return so
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        include = sysconfig.get_path("include")
+        cmd = [
+            "g++", "-O2", "-shared", "-fPIC", f"-I{include}", _SRC, "-o",
+            so + ".tmp",
+        ]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(so + ".tmp", so)
+        return so
+    except Exception:
+        return None
+
+
+def _load(so: str) -> Optional[object]:
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "_fugue_tpu_ctokenizer", so
+        )
+        mod = importlib.util.module_from_spec(spec)  # type: ignore[arg-type]
+        spec.loader.exec_module(mod)  # type: ignore[union-attr]
+        return mod
+    except Exception:
+        return None
+
+
+def enable_native_scanner() -> bool:
+    """Idempotent; returns True when the C++ scanner is active."""
+    if _STATE["tried"]:
+        return _STATE["ok"]
+    _STATE["tried"] = True
+    if os.environ.get("FUGUE_TPU_NO_NATIVE", "").lower() in ("1", "true"):
+        return False
+    so = _build()
+    if so is None:
+        return False
+    mod = _load(so)
+    if mod is None:
+        return False
+    from itertools import starmap
+
+    from fugue_tpu.sql_frontend.tokenizer import (
+        Token,
+        set_accelerated_scanner,
+    )
+
+    scan = mod.scan  # type: ignore[attr-defined]
+
+    def _native_scan(sql: str):
+        raw = scan(sql)
+        if raw is None:  # non-ASCII or lexical error: python path decides
+            return None
+        return list(starmap(Token, raw))
+
+    set_accelerated_scanner(_native_scan)
+    _STATE["ok"] = True
+    return True
+
+
+def native_scanner_active() -> bool:
+    return _STATE["ok"]

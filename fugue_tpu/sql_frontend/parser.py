@@ -99,6 +99,9 @@ class Cursor:
 
 
 def parse_select(sql: str) -> Query:
+    from fugue_tpu.sql_frontend.native_build import enable_native_scanner
+
+    enable_native_scanner()  # idempotent; falls back to python silently
     cur = Cursor(tokenize(sql))
     q = ExprParser(cur).query()
     cur.accept_op(";")

@@ -115,6 +115,11 @@ class StrongCheckpoint(Checkpoint):
         return result
 
 
+# last catalog table name per checkpoint obj_id: lets a rebuilt workflow
+# replace (not accumulate) its previous yield table
+_LAST_TABLE_BY_OBJ: dict = {}
+
+
 class TableCheckpoint(Checkpoint):
     """Save+reload through the SQL engine's table catalog (the reference's
     StrongCheckpoint storage_type='table'); backs ``yield_table_as``. No
@@ -163,6 +168,17 @@ class TableCheckpoint(Checkpoint):
         sql = path.execution_engine.sql_engine
         name = self._table_name(path)
         if not (self._deterministic and sql.table_exists(name)):
+            # evict the previous build's table for the same logical yield:
+            # random per-build namespaces must not accumulate copies in the
+            # process-wide catalog (review r3)
+            prev = _LAST_TABLE_BY_OBJ.get(self._obj_id)
+            if prev is not None and prev != name:
+                from fugue_tpu.execution.native_execution_engine import (
+                    drop_table,
+                )
+
+                drop_table(prev)
+            _LAST_TABLE_BY_OBJ[self._obj_id] = name
             sql.save_table(df, name, mode="overwrite", **self._save_kwargs)
         result = sql.load_table(name)
         if self.yielded is not None:

@@ -1,0 +1,2 @@
+"""Contrib extensions (parity role: reference fugue_contrib): importing
+submodules registers their extensions by alias."""

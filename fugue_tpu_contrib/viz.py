@@ -1,0 +1,51 @@
+"""Visualization outputter: ``out_transform``/``output`` with ``using="viz"``
+plots each (optionally partitioned, presorted) group via ``DataFrame.plot``
+(parity role: reference fugue_contrib/viz/_ext.py; matplotlib is imported
+lazily so the module is importable without it)."""
+
+from typing import Any
+
+import pandas as pd
+
+from fugue_tpu.dataframe import DataFrames
+from fugue_tpu.extensions.convert import register_outputter
+from fugue_tpu.extensions.interfaces import Outputter
+from fugue_tpu.utils.assertion import assert_or_throw
+
+
+class Visualize(Outputter):
+    """Plot the single input dataframe; with partition keys, one plot per
+    key group (presort applied first). Params pass through to
+    ``pandas.DataFrame.plot`` plus ``func`` to pick a plot kind method."""
+
+    def process(self, dfs: DataFrames) -> None:
+        assert_or_throw(len(dfs) == 1, ValueError("viz takes one dataframe"))
+        params = dict(self.params)
+        func = params.pop("func", "plot")
+        pdf = dfs[0].as_pandas()
+        presort = self.partition_spec.presort
+        if presort:
+            pdf = pdf.sort_values(
+                list(presort.keys()), ascending=list(presort.values())
+            ).reset_index(drop=True)
+        keys = self.partition_spec.partition_by
+        if len(keys) == 0:
+            self._plot(pdf, func, params)
+            return
+        for _, gp in pdf.groupby(
+            keys if len(keys) > 1 else keys[0], dropna=False
+        ):
+            self._plot(gp.reset_index(drop=True), func, params)
+
+    def _plot(self, df: pd.DataFrame, func: str, params: Any) -> None:
+        plotter = df.plot if func == "plot" else getattr(df.plot, func)
+        plotter(**params)
+        try:  # render eagerly in scripts/notebooks
+            import matplotlib.pyplot as plt
+
+            plt.show()
+        except ImportError:  # pragma: no cover - matplotlib optional
+            pass
+
+
+register_outputter("viz", Visualize)

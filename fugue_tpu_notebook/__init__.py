@@ -1,0 +1,29 @@
+"""Jupyter integration: the ``%%fsql`` cell magic and HTML dataframe
+display (parity role: reference fugue_notebook/env.py:36-138).
+
+Use ``%load_ext fugue_tpu_notebook`` in a notebook, or call
+:func:`setup` directly."""
+
+from typing import Any, Optional
+
+from fugue_tpu_notebook.env import NotebookSetup, _setup_fugue_notebook
+
+__all__ = ["NotebookSetup", "setup", "load_ipython_extension"]
+
+
+def load_ipython_extension(ipython: Any) -> None:
+    """Entry point for ``%load_ext fugue_tpu_notebook``."""
+    _setup_fugue_notebook(ipython, None)
+
+
+def setup(notebook_setup: Optional[Any] = None) -> None:
+    """Register the magic + display on the current IPython shell.
+
+    (No ``fsql_ignore_case`` flag: this dialect's keywords are always
+    case-insensitive, unlike the reference's ANTLR grammar.)"""
+    from IPython import get_ipython
+
+    ip = get_ipython()
+    if ip is None:  # pragma: no cover - notebook only
+        raise RuntimeError("setup() must run inside IPython/Jupyter")
+    _setup_fugue_notebook(ip, notebook_setup)

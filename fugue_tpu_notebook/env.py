@@ -1,0 +1,113 @@
+"""The ``%%fsql`` cell magic, Jupyter HTML display, and NotebookSetup
+(parity role: reference fugue_notebook/env.py:36-138; rewritten for the
+built-in SQL front end and display plugin)."""
+
+import html
+import json
+from typing import Any, Dict, List, Optional
+
+from fugue_tpu.dataframe import DataFrame
+from fugue_tpu.dataset.dataset import DatasetDisplay, get_dataset_display
+from fugue_tpu.execution.factory import make_execution_engine
+from fugue_tpu.sql_frontend.workflow_sql import FugueSQLWorkflow
+from fugue_tpu.utils.params import ParamDict
+
+
+class NotebookSetup:
+    """Subclass to inject default/forced engine conf into every ``%%fsql``
+    cell (reference env.py NotebookSetup)."""
+
+    def get_pre_conf(self) -> Dict[str, Any]:
+        """Defaults the cell conf can override."""
+        return {}
+
+    def get_post_conf(self) -> Dict[str, Any]:
+        """Forced values; a cell conf conflicting with these raises."""
+        return {}
+
+
+class JupyterDataFrameDisplay(DatasetDisplay):
+    """HTML rendering via IPython.display for dataframes shown in cells."""
+
+    def show(
+        self, n: int = 10, with_count: bool = False, title: Optional[str] = None
+    ) -> None:
+        from IPython.display import HTML, display
+
+        df: DataFrame = self._ds  # type: ignore
+        components: List[Any] = []
+        if title:
+            components.append(HTML(f"<h3>{html.escape(title)}</h3>"))
+        components.append(HTML(self._df_html(df, n)))
+        if with_count:
+            components.append(
+                HTML(f"<strong>total count: {df.count()}</strong>")
+            )
+        display(*components)
+
+    @staticmethod
+    def _df_html(df: DataFrame, n: int) -> str:
+        pdf = df.head(n).as_pandas()
+        schema_line = (
+            '<font size="-1">'
+            + html.escape(f"{type(df).__name__}: {df.schema}")
+            + "</font>"
+        )
+        return pdf._repr_html_() + "\n" + schema_line
+
+
+def _parse_engine_line(line: str, lc: Dict[str, Any]) -> Any:
+    """``%%fsql [engine] [{json conf} | conf_var]`` -> (engine, conf)."""
+    line = line.strip()
+    p = line.find("{")
+    if p >= 0:
+        return line[:p].strip() or None, json.loads(line[p:])
+    parts = line.split(" ", 1)
+    engine = parts[0] or None
+    conf = ParamDict(None if len(parts) == 1 else lc.get(parts[1]))
+    return engine, conf
+
+
+def _setup_fugue_notebook(ipython: Any, setup_obj: Any) -> None:
+    from IPython.core.magic import (
+        Magics,
+        cell_magic,
+        magics_class,
+        needs_local_scope,
+    )
+
+    pre = dict((setup_obj or NotebookSetup()).get_pre_conf())
+    post = dict((setup_obj or NotebookSetup()).get_post_conf())
+
+    @magics_class
+    class _FugueSQLMagics(Magics):  # type: ignore[misc]
+        @needs_local_scope
+        @cell_magic("fsql")
+        def fsql(self, line: str, cell: str, local_ns: Any = None) -> None:
+            local_ns = local_ns or {}
+            engine, conf = _parse_engine_line(line, local_ns)
+            cf = dict(pre)
+            cf.update(conf)
+            for k, v in post.items():
+                if k in cf and cf[k] != v:
+                    raise ValueError(
+                        f"{k} must be {v}, but you set {cf[k]}; unset it"
+                    )
+                cf[k] = v
+            dag = FugueSQLWorkflow()
+            dag._sql(cell, local_ns)
+            dag.run(make_execution_engine(engine, cf))
+            from fugue_tpu.dataframe.dataframe import YieldedDataFrame
+
+            for k, v in dag.yields.items():
+                local_ns[k] = (
+                    v.result if isinstance(v, YieldedDataFrame) else v
+                )
+
+    ipython.register_magics(_FugueSQLMagics)
+
+    @get_dataset_display.candidate(
+        lambda ds: isinstance(ds, DataFrame), priority=3.0
+    )
+    def _jupyter_display(ds: DataFrame) -> DatasetDisplay:
+        return JupyterDataFrameDisplay(ds)

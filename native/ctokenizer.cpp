@@ -1,0 +1,190 @@
+/* C++ SQL scanner for fugue_tpu.sql_frontend.tokenizer.
+ *
+ * The role of the reference's C++ ANTLR parser (fugue-sql-antlr[cpp],
+ * reference README.md:162 "can be 50+ times faster"): the lexing hot loop
+ * in native code, exposed as a CPython extension. Semantics mirror
+ * tokenizer._scan_py exactly; on any input it cannot handle identically
+ * (non-ASCII source, lexical errors) it returns None and the Python
+ * scanner takes over, so behavior never diverges.
+ *
+ * Built by fugue_tpu/sql_frontend/native_build.py with g++ at first use.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+
+static PyObject *K_IDENT, *K_QIDENT, *K_NUMBER, *K_STRING, *K_OP, *K_END;
+
+static inline int is_ident_start(char c) {
+    return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') || c == '_';
+}
+static inline int is_digit(char c) { return c >= '0' && c <= '9'; }
+static inline int is_ident_cont(char c) {
+    return is_ident_start(c) || is_digit(c);
+}
+
+/* append (kind, value, pos) to the list */
+static int emit(PyObject *out, PyObject *kind, const char *v, Py_ssize_t len,
+                Py_ssize_t pos) {
+    PyObject *val = PyUnicode_FromStringAndSize(v, len);
+    if (!val) return -1;
+    PyObject *p = PyLong_FromSsize_t(pos);
+    if (!p) {
+        Py_DECREF(val);
+        return -1;
+    }
+    PyObject *tup = PyTuple_Pack(3, kind, val, p);
+    Py_DECREF(val);
+    Py_DECREF(p);
+    if (!tup) return -1;
+    int rc = PyList_Append(out, tup);
+    Py_DECREF(tup);
+    return rc;
+}
+
+static PyObject *scan(PyObject *Py_UNUSED(self), PyObject *arg) {
+    if (!PyUnicode_Check(arg)) {
+        PyErr_SetString(PyExc_TypeError, "scan expects str");
+        return NULL;
+    }
+    if (!PyUnicode_IS_ASCII(arg)) Py_RETURN_NONE; /* byte!=char offsets */
+    Py_ssize_t n;
+    const char *s = PyUnicode_AsUTF8AndSize(arg, &n);
+    if (!s) return NULL;
+    PyObject *out = PyList_New(0);
+    if (!out) return NULL;
+    std::string buf;
+    Py_ssize_t i = 0;
+    while (i < n) {
+        char c = s[i];
+        if (c == ' ' || c == '\t' || c == '\r' || c == '\n') { i++; continue; }
+        if (c == '-' && i + 1 < n && s[i + 1] == '-') {
+            while (i < n && s[i] != '\n') i++;
+            if (i < n) i++;
+            continue;
+        }
+        if (c == '/' && i + 1 < n && s[i + 1] == '*') {
+            Py_ssize_t j = i + 2;
+            while (j + 1 < n && !(s[j] == '*' && s[j + 1] == '/')) j++;
+            if (j + 1 >= n) goto fallback; /* unterminated: python raises */
+            i = j + 2;
+            continue;
+        }
+        if (c == '\'') {
+            buf.clear();
+            Py_ssize_t j = i + 1;
+            for (;;) {
+                if (j >= n) goto fallback; /* unterminated */
+                if (s[j] == '\'') {
+                    if (j + 1 < n && s[j + 1] == '\'') { buf += '\''; j += 2; continue; }
+                    break;
+                }
+                if (s[j] == '\\' && j + 1 < n &&
+                    (s[j + 1] == '\'' || s[j + 1] == '\\')) {
+                    buf += s[j + 1]; j += 2; continue;
+                }
+                buf += s[j]; j++;
+            }
+            if (emit(out, K_STRING, buf.data(), (Py_ssize_t)buf.size(), i) < 0)
+                goto error;
+            i = j + 1;
+            continue;
+        }
+        if (c == '"' || c == '`') {
+            char close = c;
+            buf.clear();
+            Py_ssize_t j = i + 1;
+            for (;;) {
+                if (j >= n) goto fallback;
+                if (s[j] == close) {
+                    if (j + 1 < n && s[j + 1] == close) { buf += close; j += 2; continue; }
+                    break;
+                }
+                buf += s[j]; j++;
+            }
+            if (emit(out, K_QIDENT, buf.data(), (Py_ssize_t)buf.size(), i) < 0)
+                goto error;
+            i = j + 1;
+            continue;
+        }
+        if (is_digit(c) || (c == '.' && i + 1 < n && is_digit(s[i + 1]))) {
+            Py_ssize_t j = i;
+            int seen_dot = 0, seen_exp = 0;
+            while (j < n) {
+                char ch = s[j];
+                if (is_digit(ch)) { j++; }
+                else if (ch == '.' && !seen_dot && !seen_exp) { seen_dot = 1; j++; }
+                else if ((ch == 'e' || ch == 'E') && !seen_exp && j > i) {
+                    if (j + 1 < n && (is_digit(s[j + 1]) ||
+                        ((s[j + 1] == '+' || s[j + 1] == '-') && j + 2 < n &&
+                         is_digit(s[j + 2])))) {
+                        seen_exp = 1;
+                        j += (s[j + 1] == '+' || s[j + 1] == '-') ? 2 : 1;
+                    } else break;
+                } else break;
+            }
+            if (emit(out, K_NUMBER, s + i, j - i, i) < 0) goto error;
+            i = j;
+            continue;
+        }
+        if (is_ident_start(c)) {
+            Py_ssize_t j = i + 1;
+            while (j < n && is_ident_cont(s[j])) j++;
+            if (emit(out, K_IDENT, s + i, j - i, i) < 0) goto error;
+            i = j;
+            continue;
+        }
+        /* two-char operators first (same order as the python table) */
+        if (i + 1 < n) {
+            char d = s[i + 1];
+            const char *two = NULL;
+            if (c == '<' && d == '>') two = "<>";
+            else if (c == '!' && d == '=') two = "!=";
+            else if (c == '<' && d == '=') two = "<=";
+            else if (c == '>' && d == '=') two = ">=";
+            else if (c == '|' && d == '|') two = "||";
+            else if (c == '=' && d == '=') two = "==";
+            else if (c == '=' && d == '>') two = "=>";
+            if (two) {
+                if (emit(out, K_OP, two, 2, i) < 0) goto error;
+                i += 2;
+                continue;
+            }
+        }
+        if (c != '\0' && strchr("=<>+-*/%(),.;:{}[]?", c) != NULL) {
+            if (emit(out, K_OP, &c, 1, i) < 0) goto error;
+            i += 1;
+            continue;
+        }
+        goto fallback; /* unexpected char: python raises the exact error */
+    }
+    if (emit(out, K_END, "", 0, n) < 0) goto error;
+    return out;
+fallback:
+    Py_DECREF(out);
+    Py_RETURN_NONE;
+error:
+    Py_DECREF(out);
+    return NULL;
+}
+
+static PyMethodDef methods[] = {
+    {"scan", scan, METH_O,
+     "scan(sql) -> list[(kind, value, pos)] or None (fallback)"},
+    {NULL, NULL, 0, NULL}};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_fugue_tpu_ctokenizer",
+    "native SQL scanner", -1, methods, NULL, NULL, NULL, NULL};
+
+PyMODINIT_FUNC PyInit__fugue_tpu_ctokenizer(void) {
+    K_IDENT = PyUnicode_InternFromString("IDENT");
+    K_QIDENT = PyUnicode_InternFromString("QIDENT");
+    K_NUMBER = PyUnicode_InternFromString("NUMBER");
+    K_STRING = PyUnicode_InternFromString("STRING");
+    K_OP = PyUnicode_InternFromString("OP");
+    K_END = PyUnicode_InternFromString("END");
+    return PyModule_Create(&moduledef);
+}

@@ -1,0 +1,75 @@
+"""Notebook %%fsql magic + contrib viz (reference fugue_notebook/env.py,
+fugue_contrib) — exercised through a real in-process IPython shell."""
+
+import matplotlib
+
+matplotlib.use("Agg")  # headless
+
+import pandas as pd
+import pytest
+
+
+@pytest.fixture(scope="module")
+def ip():
+    from IPython.testing.globalipapp import start_ipython
+
+    shell = start_ipython()
+    shell.run_line_magic("load_ext", "fugue_tpu_notebook")
+    return shell
+
+
+def test_fsql_magic_runs_and_yields(ip):
+    ip.user_ns["src"] = pd.DataFrame({"k": [1, 1, 2], "v": [1.0, 2.0, 3.0]})
+    ip.run_cell_magic(
+        "fsql",
+        "native",
+        "SELECT k, SUM(v) AS s FROM src GROUP BY k\n"
+        "YIELD LOCAL DATAFRAME AS result",
+    )
+    res = ip.user_ns["result"]
+    assert sorted(map(tuple, res.as_array())) == [(1, 3.0), (2, 3.0)]
+
+
+def test_fsql_magic_engine_conf(ip):
+    ip.user_ns["src2"] = pd.DataFrame({"a": [1, 2]})
+    ip.run_cell_magic(
+        "fsql",
+        'native {"fugue.workflow.concurrency": 1}',
+        "SELECT a FROM src2 WHERE a > 1\nYIELD LOCAL DATAFRAME AS r2",
+    )
+    assert [r[0] for r in ip.user_ns["r2"].as_array()] == [2]
+
+
+def test_jupyter_display_html():
+    from fugue_tpu.dataframe import PandasDataFrame
+    from fugue_tpu_notebook.env import JupyterDataFrameDisplay
+
+    df = PandasDataFrame(pd.DataFrame({"a": [1]}), "a:long")
+    html = JupyterDataFrameDisplay._df_html(df, 10)
+    assert "a:long" in html and "<" in html
+
+
+def test_viz_outputter():
+    import fugue_tpu_contrib.viz  # noqa: F401  (registers "viz")
+    from fugue_tpu.workflow import FugueWorkflow
+
+    dag = FugueWorkflow()
+    df = dag.df(pd.DataFrame({"x": [1, 2, 3], "y": [2.0, 4.0, 6.0]}),
+                "x:long,y:double")
+    df.output("viz", params={"x": "x", "y": "y"})
+    dag.run("native")  # no exception = plotted headlessly
+
+
+def test_viz_partitioned():
+    import fugue_tpu_contrib.viz  # noqa: F401
+    from fugue_tpu.workflow import FugueWorkflow
+
+    dag = FugueWorkflow()
+    df = dag.df(
+        pd.DataFrame({"k": [1, 1, 2], "x": [1, 2, 1], "y": [1.0, 2.0, 3.0]}),
+        "k:long,x:long,y:double",
+    )
+    df.partition(by=["k"], presort="x").output(
+        "viz", params={"func": "line", "x": "x", "y": "y"}
+    )
+    dag.run("native")

@@ -82,6 +82,24 @@ def test_yield_table_no_stale_data_across_builds():
         assert marker in vals, f"stale table served (missing {marker})"
 
 
+def test_yield_table_rebuilds_do_not_accumulate():
+    # review r3: repeated builds of the same workflow replace (not leak)
+    # their catalog entry
+    from fugue_tpu.execution.native_execution_engine import _TABLE_CATALOG
+
+    for i in range(5):
+        dag = FugueWorkflow()
+        dag.df(pd.DataFrame({"a": [i]}), "a:long").yield_table_as("t")
+        dag.run("native")
+    names = [n for n in _TABLE_CATALOG if n.startswith("tbl_")]
+    # one live table for this logical yield (other tests may add their own)
+    dag2 = FugueWorkflow()
+    dag2.df(pd.DataFrame({"a": [99]}), "a:long").yield_table_as("u")
+    dag2.run("native")
+    after = [n for n in _TABLE_CATALOG if n.startswith("tbl_")]
+    assert len(after) <= len(names) + 1
+
+
 def test_fugue_sql_yield_table():
     from fugue_tpu.api import fugue_sql_flow
 
