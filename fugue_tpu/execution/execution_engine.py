@@ -146,6 +146,10 @@ class SQLEngine(EngineFacet):
     def load_table(self, table: str, **kwargs: Any) -> DataFrame:
         raise NotImplementedError(f"{type(self)} doesn't support tables")
 
+    def drop_table(self, table: str) -> None:
+        """Remove a table from the engine's catalog (no-op if absent)."""
+        raise NotImplementedError(f"{type(self)} doesn't support tables")
+
     def encode_name(self, name: str) -> str:
         return name
 

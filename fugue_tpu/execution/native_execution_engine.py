@@ -220,6 +220,9 @@ class PandasSQLEngine(SQLEngine):
             local.schema,
         )
 
+    def drop_table(self, table: str) -> None:
+        drop_table(table)
+
     def load_table(self, table: str, **kwargs: Any) -> DataFrame:
         assert_or_throw(
             table in _TABLE_CATALOG, ValueError(f"table {table} not found")

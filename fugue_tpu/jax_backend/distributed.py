@@ -1,0 +1,116 @@
+"""Multi-host support: DCN-coordinated meshes + in-program callbacks.
+
+The reference's distributed story is its backends' (Spark/Dask/Ray)
+cluster runtimes plus a Flask RPC channel (SURVEY §2.11). The TPU-native
+equivalents here:
+
+- :func:`init_distributed` — ``jax.distributed.initialize`` from conf
+  keys (``fugue.jax.dist.*``); after it, ``jax.devices()`` spans every
+  host and ``make_mesh()`` builds a global mesh whose collectives ride
+  ICI within a slice and DCN across slices. The driver program is SPMD:
+  every host runs the same engine code (single-controller per host,
+  XLA owns the transport — no NCCL analog needed).
+- :func:`make_device_callback` — the ``io_callback`` bridge: wraps an
+  RPC client (in-process or HTTP) so a COMPILED jax transformer can
+  invoke driver-side handlers from inside traced code — the TPU analog
+  of calling the callback from a Spark UDF (reference
+  fugue_test/builtin_suite.py:1552). Pinned to one device (SPMD rejects
+  replicated side-effecting calls; one invocation per logical call is
+  also the semantic an RPC notification wants).
+
+Conf keys:
+
+- ``fugue.jax.dist.coordinator`` — ``host:port`` of process 0
+- ``fugue.jax.dist.num_processes`` / ``fugue.jax.dist.process_id``
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from fugue_tpu.utils.params import ParamDict
+
+CONF_COORDINATOR = "fugue.jax.dist.coordinator"
+CONF_NUM_PROCESSES = "fugue.jax.dist.num_processes"
+CONF_PROCESS_ID = "fugue.jax.dist.process_id"
+
+_STATE = {"initialized": False}
+
+
+def init_distributed(conf: Any = None) -> bool:
+    """Initialize multi-host jax from conf; returns True when a
+    multi-process setup was configured (False = single-host, no-op).
+    Idempotent."""
+    if _STATE["initialized"]:
+        return True
+    conf = ParamDict(conf)
+    coordinator = conf.get(CONF_COORDINATOR, "")
+    if coordinator == "":
+        return False
+    num = int(conf.get(CONF_NUM_PROCESSES, 1))
+    pid = int(conf.get(CONF_PROCESS_ID, 0))
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num,
+        process_id=pid,
+    )
+    _STATE["initialized"] = True
+    return True
+
+
+def make_device_callback(
+    client: Callable[..., Any], result_shape: Optional[Any] = None
+) -> Callable[..., Any]:
+    """Wrap an RPC client (or any host callable) for use INSIDE jitted
+    code via ``jax.experimental.io_callback``.
+
+    The wrapped function takes jax arrays, ships them to the host, calls
+    ``client`` with numpy values, and returns arrays matching
+    ``result_shape`` (a ``jax.ShapeDtypeStruct`` pytree; None = no
+    result — pure notification). Example, inside a jax transformer::
+
+        notify = make_device_callback(arrs_cb)  # from ctx callback
+        def step(arrs):
+            ...
+            notify(jnp.sum(arrs["_row_valid"]))
+            return {...}
+    """
+    from jax.experimental import io_callback
+
+    def _host(*args: Any) -> Any:
+        import numpy as np
+
+        res = client(*[np.asarray(a) for a in args])
+        if result_shape is None:
+            return None
+        return res
+
+    # under SPMD the callback is pinned to one device: the partitioner
+    # rejects replicated side-effecting custom-calls, and a single
+    # invocation per logical call is the semantic the RPC channel wants
+    pin = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+
+    if result_shape is None:
+        # io_callback requires a result; use a dummy int32 scalar
+        shape = jax.ShapeDtypeStruct((), jnp.int32)
+
+        def _host_dummy(*args: Any) -> Any:
+            _host(*args)
+            import numpy as np
+
+            return np.int32(0)
+
+        def _call(*args: Any) -> Any:
+            return io_callback(
+                _host_dummy, shape, *args, ordered=False, sharding=pin
+            )
+
+        return _call
+
+    def _call_res(*args: Any) -> Any:
+        return io_callback(
+            _host, result_shape, *args, ordered=False, sharding=pin
+        )
+
+    return _call_res

@@ -17,9 +17,11 @@ fugue_spark/execution_engine.py:336) — but TPU-first in design:
   gathering, group-by uses host-known key stats for static bin counts, row
   counts stay lazy device scalars, and the single sync happens at the host
   boundary (arrow export)
-- relational ops that don't vectorize well yet (joins, set ops) run on the
-  host arrow path, then re-device: correctness everywhere, speed where it
-  counts; deeper device lowerings land in later rounds
+- relational ops run on device: joins/set-ops via shared key factorization
+  (relational.py), zip/comap without serialization (zipped.py), fillna/
+  take/sample as validity flips; long-context streams fold through donated
+  accumulators (streaming.py); host fallbacks are COUNTED (``fallbacks``)
+  so a silent 100x slowdown cannot hide
 """
 
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
@@ -547,8 +549,14 @@ class JaxExecutionEngine(ExecutionEngine):
         partition_spec: Optional[PartitionSpec],
         agg_cols: List[ColumnExpr],
     ) -> DataFrame:
-        jdf: JaxDataFrame = self.to_df(df)  # type: ignore
         keys = partition_spec.partition_by if partition_spec is not None else []
+        # long-context path: an ITERABLE input streams through donated
+        # device accumulators chunk by chunk — the dataset never needs to
+        # fit in device (or host) memory at once (see streaming.py)
+        res = self._try_stream_aggregate(df, keys, agg_cols)
+        if res is not None:
+            return res
+        jdf: JaxDataFrame = self.to_df(df)  # type: ignore
         res = self._try_device_aggregate(jdf, keys, agg_cols)
         if res is not None:
             return res
@@ -1407,6 +1415,75 @@ class JaxExecutionEngine(ExecutionEngine):
         return JaxDataFrame(
             JaxBlocks(num_segments, out_cols, blocks.mesh), schema
         )
+
+    def _try_stream_aggregate(
+        self, df: DataFrame, keys: List[str], agg_cols: List[ColumnExpr]
+    ) -> Optional[DataFrame]:
+        """Streaming aggregation for iterable-of-frames inputs (keys must
+        be integer-like, aggs in the streaming whitelist); None when the
+        input is an ordinary bounded frame."""
+        from fugue_tpu.dataframe.dataframe_iterable_dataframe import (
+            LocalDataFrameIterableDataFrame,
+        )
+
+        if not isinstance(df, LocalDataFrameIterableDataFrame):
+            return None
+        if len(keys) == 0:
+            return None
+        schema = df.schema
+        for k in keys:
+            if k not in schema or not (
+                pa.types.is_integer(schema[k].type)
+                or pa.types.is_boolean(schema[k].type)
+            ):
+                return None
+        from fugue_tpu.column.expressions import _FuncExpr
+        from fugue_tpu.jax_backend import streaming
+
+        plans: List[Tuple[str, str, Optional[str]]] = []
+        for c in agg_cols:
+            if (
+                not isinstance(c, _FuncExpr)
+                or len(c.args) != 1
+                or c.arg_distinct
+                or c.func.lower() not in streaming._SUPPORTED
+            ):
+                return None
+            arg = c.args[0]
+            if isinstance(arg, _NamedColumnExpr) and arg.wildcard:
+                src = keys[0]  # count(*): count key occurrences
+            elif isinstance(arg, _NamedColumnExpr) and arg.as_type is None:
+                src = arg.name
+            else:
+                return None
+            plans.append((c.output_name, c.func.lower(), src))
+
+        def _chunks() -> Any:
+            for local in df.native:
+                yield local.as_pandas()
+
+        try:
+            return streaming.stream_aggregate(
+                self, _chunks(), schema, list(keys), plans
+            )
+        except streaming.StreamFallback as fb:
+            # bounded-path semantics can't stream (NULL keys, unbounded key
+            # space, empty stream): materialize and go through the normal
+            # path so results never depend on the container type
+            self._count_fallback("aggregate", f"stream fallback: {fb}")
+            from fugue_tpu.dataframe import PandasDataFrame
+
+            pdf = streaming.materialize_fallback(fb, schema)
+            bounded = PandasDataFrame(pdf, schema)
+            jdf = self.to_df(bounded)
+            res = self._try_device_aggregate(jdf, list(keys), agg_cols)
+            if res is not None:
+                return res
+            return self.to_df(
+                self._native.aggregate(
+                    bounded, PartitionSpec(by=list(keys)), agg_cols
+                )
+            )
 
     def _matmul_agg_ok(
         self, jdf: JaxDataFrame, func: str, arg: Any

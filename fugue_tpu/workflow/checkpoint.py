@@ -173,11 +173,10 @@ class TableCheckpoint(Checkpoint):
             # process-wide catalog (review r3)
             prev = _LAST_TABLE_BY_OBJ.get(self._obj_id)
             if prev is not None and prev != name:
-                from fugue_tpu.execution.native_execution_engine import (
-                    drop_table,
-                )
-
-                drop_table(prev)
+                try:
+                    sql.drop_table(prev)  # engine-polymorphic eviction
+                except NotImplementedError:  # pragma: no cover
+                    pass
             _LAST_TABLE_BY_OBJ[self._obj_id] = name
             sql.save_table(df, name, mode="overwrite", **self._save_kwargs)
         result = sql.load_table(name)
