@@ -2,11 +2,27 @@
 (reference fugue/collections/sql.py:14,48)."""
 
 import re
-from typing import Any, Callable, Iterable, List, Optional, Tuple
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 from uuid import uuid4
 
 from fugue_tpu.plugins import fugue_plugin
 from fugue_tpu.utils.assertion import assert_or_throw
+
+
+def interleave_sql(statements: Any) -> "Tuple[List[Any], Dict[str, Any]]":
+    """Mix string fragments and dataframes into StructuredRawSQL parts +
+    a {temp_name: df} map (the ``raw_sql("SELECT ... FROM", df)`` form)."""
+    parts: List[Any] = []
+    dfs: Dict[str, Any] = {}
+    for s in statements:
+        if isinstance(s, str):
+            parts.append((False, s))
+        else:
+            t = TempTableName()
+            dfs[t.key] = s
+            parts.append((True, t.key))
+        parts.append((False, " "))
+    return parts, dfs
 
 
 class TempTableName:

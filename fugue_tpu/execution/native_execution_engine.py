@@ -150,11 +150,35 @@ class PandasMapEngine(MapEngine):
         partition_spec: PartitionSpec,
         on_init: Optional[Callable] = None,
     ) -> Any:
+        """Partitioned bag map: split per the spec's ``num`` (even chunks;
+        rand = seeded shuffle first), apply ``map_func(no, bag)`` per
+        physical partition, concatenate."""
         from fugue_tpu.bag import ArrayBag
 
         if on_init is not None:
             on_init(0, bag)
-        return map_func(0, ArrayBag(bag.as_array()))
+        data = list(bag.as_array())
+        num = partition_spec.get_num_partitions(
+            **{
+                KEYWORD_ROWCOUNT: lambda: len(data),
+                KEYWORD_PARALLELISM: lambda: 1,
+            }
+        )
+        if num <= 1 or len(data) == 0 or partition_spec.algo == "coarse":
+            return map_func(0, ArrayBag(data))
+        if partition_spec.algo == "rand":
+            rng = np.random.default_rng(42)
+            data = [data[i] for i in rng.permutation(len(data))]
+        parts = min(num, len(data))
+        base, extra = divmod(len(data), parts)
+        out: List[Any] = []
+        start = 0
+        for i in range(parts):
+            end = start + base + (1 if i < extra else 0)
+            res = map_func(i, ArrayBag(data[start:end]))
+            out.extend(res.as_array())
+            start = end
+        return ArrayBag(out)
 
 
 # process-wide table catalog: the role of the duckdb connection / spark

@@ -85,17 +85,10 @@ def raw_sql(
 
         raw_sql("SELECT a FROM", df, "WHERE a > 0")
     """
+    from fugue_tpu.collections.sql import interleave_sql
+
     dag = FugueWorkflow()
-    parts = []
-    dfs = {}
-    for s in statements:
-        if isinstance(s, str):
-            parts.append((False, s))
-        else:
-            t = TempTableName()
-            dfs[t.key] = s
-            parts.append((True, t.key))
-        parts.append((False, " "))
+    parts, dfs = interleave_sql(statements)
     named = {k: dag.create_data(v) for k, v in dfs.items()}
     tdf = dag.select(
         StructuredRawSQL(parts), dfs=named if len(named) > 0 else None

@@ -624,11 +624,34 @@ class FugueWorkflow:
 
     def select(
         self,
-        statement: Union[str, StructuredRawSQL],
+        *statements: Any,
+        statement: Any = None,
         dfs: Optional[Dict[str, Any]] = None,
         dialect: Optional[str] = None,
     ) -> WorkflowDataFrame:
-        """Raw SQL SELECT against named dataframes via the engine's SQLEngine."""
+        """Raw SQL SELECT via the engine's SQLEngine. Accepts either one
+        statement (positional or ``statement=``) plus ``dfs={name: df}``,
+        or the reference's interleaved form mixing fragments and
+        dataframes::
+
+            dag.select("SELECT k, SUM(x) AS s FROM", df, "GROUP BY k")
+        """
+        if statement is not None:
+            assert_or_throw(
+                len(statements) == 0,
+                ValueError("pass the statement positionally OR by keyword"),
+            )
+            statements = (statement,)
+        if len(statements) == 1 and isinstance(
+            statements[0], (str, StructuredRawSQL)
+        ):
+            statement = statements[0]
+        else:
+            from fugue_tpu.collections.sql import interleave_sql
+
+            parts, inline = interleave_sql(statements)
+            statement = StructuredRawSQL(parts, dialect=dialect)
+            dfs = {**(dfs or {}), **inline}
         named = {k: self.create_data(v) for k, v in (dfs or {}).items()}
         inputs = [v.task for v in named.values()]
         names = list(named.keys())

@@ -61,3 +61,20 @@ class BagTests:
 
             res = e.map_engine.map_bag(b, mapper, PartitionSpec())
             assert sorted(res.as_array()) == [2, 4, 6]
+
+        def test_map_bag_partitioned(self):
+            from fugue_tpu.bag.array_bag import ArrayBag
+            from fugue_tpu.collections.partition import PartitionSpec
+            from fugue_tpu.execution import make_execution_engine
+
+            e = make_execution_engine("native")
+            b = self.bag(list(range(20)))
+            seen = []
+
+            def mapper(no: int, bag: Any) -> Any:
+                seen.append((no, bag.count()))
+                return ArrayBag([x + 100 for x in bag.as_array()])
+
+            res = e.map_engine.map_bag(b, mapper, PartitionSpec(num=4))
+            assert sorted(res.as_array()) == [x + 100 for x in range(20)]
+            assert len(seen) == 4 and all(n == 5 for _, n in seen)

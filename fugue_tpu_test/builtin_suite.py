@@ -379,6 +379,120 @@ class BuiltInTests:
             with pytest.raises(Exception):
                 self.run(dag)
 
+        def test_module_decorator(self):
+            from fugue_tpu.workflow.module import module
+
+            @module
+            def double(df: Any) -> Any:
+                def _d(pdf: pd.DataFrame) -> pd.DataFrame:
+                    return pdf.assign(x=pdf.x * 2)
+
+                return df.transform(_d, schema="*")
+
+            dag = self.dag()
+            a = dag.df([[1], [2]], "x:long")
+            double(double(a)).assert_eq(dag.df([[4], [8]], "x:long"))
+            self.run(dag)
+
+        def test_workflow_select_sql(self):
+            dag = self.dag()
+            a = dag.df([[1, "a"], [2, "a"], [3, "b"]], "x:long,k:str")
+            res = dag.select("SELECT k, SUM(x) AS s FROM", a, "GROUP BY k")
+            res.assert_eq(dag.df([["a", 3], ["b", 3]], "k:str,s:long"))
+            self.run(dag)
+
+        def test_yield_table_through_suite(self):
+            dag = self.dag()
+            a = dag.df([[7]], "x:long")
+            a.yield_table_as("suite_tbl")
+            self.run(dag)
+            y = dag.yields["suite_tbl"]
+            assert y.storage_type == "table"
+            dag2 = self.dag()
+            dag2.df(y).assert_eq(dag2.df([[7]], "x:long"))
+            self.run(dag2)
+
+        def test_out_cotransform(self):
+            collected: List[Any] = []
+
+            def ocm(dfs: DataFrames) -> None:
+                collected.append((dfs[0].count(), dfs[1].count()))
+
+            dag = self.dag()
+            a = dag.df([[1, "a"], [2, "a"]], "x:long,k:str")
+            b = dag.df([["a", 1.0]], "k:str,v:double")
+            z = a.partition_by("k").zip(b)
+            z.out_transform(ocm)
+            self.run(dag)
+            assert collected == [(2, 1)]
+
+        def test_callback_with_partitions(self):
+            seen: List[Any] = []
+
+            def cb(k: str, n: int) -> None:
+                seen.append((k, n))
+
+            def t(df: pd.DataFrame, announce: Callable) -> pd.DataFrame:
+                announce(str(df.k.iloc[0]), len(df))
+                return df
+
+            dag = self.dag()
+            a = dag.df([[1, "a"], [2, "a"], [3, "b"]], "x:long,k:str")
+            a.partition_by("k").transform(
+                t, schema="*", callback=cb
+            ).assert_eq(a)
+            self.run(dag)
+            assert sorted(seen) == [("a", 2), ("b", 1)]
+
+        def test_load_save_csv_json(self, tmp_path):
+            dag = self.dag()
+            a = dag.df([[1, "a"], [2, "b"]], "x:long,y:str")
+            csvp = os.path.join(str(tmp_path), "t.csv")
+            jsonp = os.path.join(str(tmp_path), "t.json")
+            a.save(csvp, header=True)
+            a.save(jsonp)
+            self.run(dag)
+            dag2 = self.dag()
+            c = dag2.load(csvp, header=True, columns="x:long,y:str")
+            c.assert_eq(dag2.df([[1, "a"], [2, "b"]], "x:long,y:str"))
+            j = dag2.load(jsonp)
+            j.assert_eq(dag2.df([[1, "a"], [2, "b"]], "x:long,y:str"))
+            self.run(dag2)
+
+        def test_cotransform_presort_and_empty_side(self):
+            def cm(dfs: DataFrames) -> LocalDataFrame:
+                rows = dfs[0].as_array()
+                first = rows[0][0] if rows else -1
+                k = rows[0][1] if rows else -1
+                return ArrayDataFrame(
+                    [[k, first, dfs[1].count()]], "k:long,top:long,nb:long"
+                )
+
+            dag = self.dag()
+            a = dag.df([[1, 1], [3, 1], [2, 1]], "x:long,k:long")
+            b = dag.df([[2, 9.0]], "k:long,w:double")
+            z = a.partition(by=["k"], presort="x desc").zip(
+                b, how="left_outer"
+            )
+            res = z.transform(cm, schema="k:long,top:long,nb:long")
+            res.assert_eq(dag.df([[1, 3, 0]], "k:long,top:long,nb:long"))
+            self.run(dag)
+
+        def test_engine_inference_from_engine_frame(self):
+            # fa.transform on an engine-native frame infers this engine
+            import fugue_tpu.api as fa
+
+            src = self.engine.to_df([[1], [2]], "x:long")
+
+            def t(df: pd.DataFrame) -> pd.DataFrame:
+                return df.assign(y=df.x + 1)
+
+            out = fa.transform(src, t, schema="*,y:long", as_fugue=True)
+            assert df_eq(
+                fa.as_fugue_df(out), [[1, 2], [2, 3]], "x:long,y:long",
+                throw=True,
+            )
+
         # ---- registry ----------------------------------------------------
         def test_registered_alias(self):
             def rt(df: pd.DataFrame) -> pd.DataFrame:
