@@ -18,6 +18,14 @@ def interleave_sql(statements: Any) -> "Tuple[List[Any], Dict[str, Any]]":
         if isinstance(s, str):
             parts.append((False, s))
         else:
+            # only dataframe-like objects may interleave; a dict/None here
+            # is almost certainly a misplaced dfs= argument — fail loudly
+            # at call time, not deep inside task execution
+            if s is None or isinstance(s, (dict, list, tuple, set)):
+                raise ValueError(
+                    f"cannot interleave {type(s).__name__} into SQL; "
+                    "pass named dataframes via dfs={name: df}"
+                )
             t = TempTableName()
             dfs[t.key] = s
             parts.append((True, t.key))

@@ -4,7 +4,7 @@ fugue/execution/native_execution_engine.py; SQL-on-pandas comes from our own
 column-algebra/SQL interpreter instead of qpd)."""
 
 import os
-from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union
 
 import numpy as np
 import pandas as pd
@@ -130,18 +130,23 @@ class PandasMapEngine(MapEngine):
             for _, sub in grouped:
                 yield sub
 
-    def _even_chunks(
-        self, pdf: pd.DataFrame, num: int
-    ) -> Iterator[pd.DataFrame]:
-        """Exact balanced contiguous chunks (reference :38 even_repartition:
-        sizes differ by at most one row)."""
-        parts = min(num, len(pdf))
-        base, extra = divmod(len(pdf), parts)
+    @staticmethod
+    def _even_ranges(n: int, num: int) -> Iterator[Tuple[int, int]]:
+        """Exact balanced contiguous (start, end) ranges (reference :38
+        even_repartition: sizes differ by at most one row)."""
+        parts = min(num, n)
+        base, extra = divmod(n, parts)
         start = 0
         for i in range(parts):
             end = start + base + (1 if i < extra else 0)
-            yield pdf.iloc[start:end]
+            yield start, end
             start = end
+
+    def _even_chunks(
+        self, pdf: pd.DataFrame, num: int
+    ) -> Iterator[pd.DataFrame]:
+        for start, end in self._even_ranges(len(pdf), num):
+            yield pdf.iloc[start:end]
 
     def map_bag(
         self,
@@ -169,15 +174,12 @@ class PandasMapEngine(MapEngine):
         if partition_spec.algo == "rand":
             rng = np.random.default_rng(42)
             data = [data[i] for i in rng.permutation(len(data))]
-        parts = min(num, len(data))
-        base, extra = divmod(len(data), parts)
         out: List[Any] = []
-        start = 0
-        for i in range(parts):
-            end = start + base + (1 if i < extra else 0)
+        for i, (start, end) in enumerate(
+            self._even_ranges(len(data), num)
+        ):
             res = map_func(i, ArrayBag(data[start:end]))
             out.extend(res.as_array())
-            start = end
         return ArrayBag(out)
 
 

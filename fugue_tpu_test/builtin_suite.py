@@ -493,6 +493,94 @@ class BuiltInTests:
                 throw=True,
             )
 
+        def test_any_column_name(self):
+            # special characters in column names flow through the workflow
+            dag = self.dag()
+            a = dag.df(
+                pd.DataFrame({"a b": [1, 2], "c-d": ["x", "y"]}),
+                "`a b`:long,`c-d`:str",
+            )
+
+            def f(df: pd.DataFrame) -> pd.DataFrame:
+                return df
+
+            a.transform(f, schema="*").assert_eq(a)
+            a.rename({"a b": "ab"}).assert_eq(
+                dag.df(
+                    pd.DataFrame({"ab": [1, 2], "c-d": ["x", "y"]}),
+                    "ab:long,`c-d`:str",
+                )
+            )
+            self.run(dag)
+
+        def test_datetime_in_workflow(self):
+            import datetime
+
+            dag = self.dag()
+            a = dag.df(
+                [["2020-01-01 10:00:00", "2020-01-02"]], "t:datetime,d:date"
+            )
+
+            def f(df: pd.DataFrame) -> pd.DataFrame:
+                assert df["t"].iloc[0].hour == 10
+                return df
+
+            a.transform(f, schema="*").assert_eq(a)
+            self.run(dag)
+
+        def test_local_instance_as_extension(self):
+            from fugue_tpu.extensions import Transformer
+
+            class AddK(Transformer):
+                def __init__(self, k: int):
+                    self._k = k
+
+                def get_output_schema(self, df: Any) -> Any:
+                    return df.schema
+
+                def transform(self, df: Any) -> Any:
+                    pdf = df.as_pandas()
+                    from fugue_tpu.dataframe import PandasDataFrame
+
+                    return PandasDataFrame(
+                        pdf.assign(x=pdf.x + self._k), df.schema
+                    )
+
+            dag = self.dag()
+            a = dag.df([[1], [2]], "x:long")
+            a.transform(AddK(10)).assert_eq(dag.df([[11], [12]], "x:long"))
+            self.run(dag)
+
+        def test_deterministic_checkpoint_complex_dag(self, tmp_path):
+            # the checkpoint skip must key on the FULL upstream lineage
+            calls: List[int] = []
+
+            def expensive(df: pd.DataFrame) -> pd.DataFrame:
+                calls.append(1)
+                return df.assign(y=df.x * 2)
+
+            conf = {"fugue.workflow.checkpoint.path": str(tmp_path)}
+
+            def build(val: int) -> FugueWorkflow:
+                dag = FugueWorkflow()
+                a = dag.df([[val]], "x:long")
+                b = dag.df([[val + 1]], "x:long")
+                u = a.union(b, distinct=False)
+                t = u.transform(
+                    expensive, schema="*,y:long"
+                ).deterministic_checkpoint()
+                t.yield_dataframe_as("out", as_local=True)
+                return dag
+
+            import fugue_tpu.execution.factory as factory
+
+            e1 = factory.make_execution_engine(self.engine, conf)
+            build(1).run(e1)
+            build(1).run(e1)  # identical lineage: skipped
+            assert len(calls) == 1, calls
+            build(2).run(e1)  # different upstream: recomputed
+            assert len(calls) == 2, calls
+
         # ---- registry ----------------------------------------------------
         def test_registered_alias(self):
             def rt(df: pd.DataFrame) -> pd.DataFrame:
