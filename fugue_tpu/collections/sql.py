@@ -9,6 +9,19 @@ from fugue_tpu.plugins import fugue_plugin
 from fugue_tpu.utils.assertion import assert_or_throw
 
 
+def _is_dataframe_like(obj: Any) -> bool:
+    """DataFrame / WorkflowDataFrame / Yielded / pandas / pyarrow inputs."""
+    from fugue_tpu.collections.yielded import Yielded
+    from fugue_tpu.dataframe import DataFrame
+
+    if isinstance(obj, (DataFrame, Yielded)):
+        return True
+    if hasattr(obj, "workflow") and hasattr(obj, "task"):
+        return True  # WorkflowDataFrame (no import: avoids a cycle)
+    mod = type(obj).__module__ or ""
+    return mod.startswith("pandas") or mod.startswith("pyarrow")
+
+
 def interleave_sql(statements: Any) -> "Tuple[List[Any], Dict[str, Any]]":
     """Mix string fragments and dataframes into StructuredRawSQL parts +
     a {temp_name: df} map (the ``raw_sql("SELECT ... FROM", df)`` form)."""
@@ -18,13 +31,13 @@ def interleave_sql(statements: Any) -> "Tuple[List[Any], Dict[str, Any]]":
         if isinstance(s, str):
             parts.append((False, s))
         else:
-            # only dataframe-like objects may interleave; a dict/None here
-            # is almost certainly a misplaced dfs= argument — fail loudly
-            # at call time, not deep inside task execution
-            if s is None or isinstance(s, (dict, list, tuple, set)):
+            # only dataframe-like objects may interleave — anything else
+            # (a misplaced dfs= dict, a scalar) fails loudly at call time,
+            # not deep inside task execution
+            if not _is_dataframe_like(s):
                 raise ValueError(
                     f"cannot interleave {type(s).__name__} into SQL; "
-                    "pass named dataframes via dfs={name: df}"
+                    "only SQL fragments (str) and dataframes are accepted"
                 )
             t = TempTableName()
             dfs[t.key] = s

@@ -572,14 +572,17 @@ class BuiltInTests:
                 t.yield_dataframe_as("out", as_local=True)
                 return dag
 
-            import fugue_tpu.execution.factory as factory
-
-            e1 = factory.make_execution_engine(self.engine, conf)
-            build(1).run(e1)
-            build(1).run(e1)  # identical lineage: skipped
-            assert len(calls) == 1, calls
-            build(2).run(e1)  # different upstream: recomputed
-            assert len(calls) == 2, calls
+            key = "fugue.workflow.checkpoint.path"
+            old_path = self.engine.conf.get(key, "")
+            self.engine.conf[key] = conf[key]
+            try:
+                build(1).run(self.engine)
+                build(1).run(self.engine)  # identical lineage: skipped
+                assert len(calls) == 1, calls
+                build(2).run(self.engine)  # different upstream: recomputed
+                assert len(calls) == 2, calls
+            finally:
+                self.engine.conf[key] = old_path
 
         # ---- registry ----------------------------------------------------
         def test_registered_alias(self):

@@ -614,6 +614,45 @@ class ExecutionEngineTests:
                 agg = fa.aggregate(a, n=ff.count(all_cols()), as_fugue=True)
                 assert df_eq(agg, [[2]], "n:long", throw=True)
 
+        def test_join_multiple(self):
+            # chained multi-way joins (reference execution_suite
+            # test_join_multiple)
+            e = self.engine
+            a = e.to_df([[1, "a"], [2, "b"]], "x:long,y:str")
+            b = e.to_df([[1, 10.0], [2, 20.0]], "x:long,z:double")
+            c = e.to_df([[1, True]], "x:long,f:bool")
+            res = e.join(e.join(a, b, how="inner", on=["x"]), c,
+                         how="inner", on=["x"])
+            assert df_eq(
+                res, [[1, "a", 10.0, True]], "x:long,y:str,z:double,f:bool",
+                throw=True,
+            )
+
+        def test_load_multiple_paths(self, tmp_path):
+            e = self.engine
+            p1 = os.path.join(str(tmp_path), "a.parquet")
+            p2 = os.path.join(str(tmp_path), "b.parquet")
+            e.save_df(e.to_df([[1]], "x:long"), p1)
+            e.save_df(e.to_df([[2]], "x:long"), p2)
+            res = e.load_df([p1, p2])
+            assert df_eq(res, [[1], [2]], "x:long", throw=True)
+
+        def test_map_with_dict_col(self):
+            e = self.engine
+
+            def mapper(cursor, data):
+                rows = data.as_array(type_safe=True)
+                rows[0][1]["extra"] = 1
+                return ArrayDataFrame(rows, data.schema)
+
+            a = e.to_df([[1, {"k": 9}]], "x:long,m:{k:long,extra:long}")
+            res = e.map_engine.map_dataframe(
+                a, mapper, "x:long,m:{k:long,extra:long}", PartitionSpec()
+            )
+            rows = res.as_local().as_array(type_safe=True)
+            assert rows[0][0] == 1 and rows[0][1]["k"] == 9
+            assert rows[0][1]["extra"] == 1  # the mutation must round-trip
+
         # ---- engine context ---------------------------------------------
         def test_engine_context(self):
             e = self.engine
