@@ -143,14 +143,14 @@ def test_random_chain_matches_native(seed):
         ndf = ne.to_df(_apply(ne, ndf, op, aux))
     assert jdf.schema == ndf.schema, (pruned, jdf.schema, ndf.schema)
     assert _canon(jdf) == _canon(ndf), pruned
-    # and a final aggregate over whatever survived
-    if "v" in jdf.schema:
-        spec = PartitionSpec(by=["k"]) if "k" in jdf.schema else None
-        aggs = [
-            ff.sum(col("v")).alias("sv"),
-            ff.count(all_cols()).alias("c"),
-            ff.min(col("v")).alias("lo"),
-        ]
+    # and final aggregates (grouped AND global) over whatever survived —
+    # no generated op drops columns, so both paths always apply
+    aggs = [
+        ff.sum(col("v")).alias("sv"),
+        ff.count(all_cols()).alias("c"),
+        ff.min(col("v")).alias("lo"),
+    ]
+    for spec in (PartitionSpec(by=["k"]), None):
         ja = je.aggregate(jdf, spec, aggs)
         na = ne.aggregate(ndf, spec, aggs)
-        assert _canon(ja) == _canon(na), pruned
+        assert _canon(ja) == _canon(na), (pruned, spec)
