@@ -23,6 +23,9 @@ FUGUE_CONF_JAX_PARTITIONS = "fugue.jax.default.partitions"
 FUGUE_CONF_JAX_COMPILE = "fugue.jax.compile"
 FUGUE_CONF_JAX_ROW_BUCKET = "fugue.jax.row_bucket"
 FUGUE_CONF_JAX_DEVICE_ZIP = "fugue.jax.device_zip"
+FUGUE_CONF_JAX_PLACEMENT = "fugue.jax.placement"
+FUGUE_CONF_JAX_MIN_DEVICE_BYTES = "fugue.jax.placement.min_device_bytes"
+FUGUE_CONF_JAX_COMPILE_CACHE = "fugue.jax.compile.cache"
 
 FUGUE_COMPILE_TIME_CONFIGS = {
     FUGUE_CONF_WORKFLOW_EXCEPTION_HIDE,
@@ -41,6 +44,13 @@ _DEFAULT_CONF: Dict[str, Any] = {
     FUGUE_CONF_SQL_DIALECT: "spark",
     FUGUE_CONF_JAX_ROW_BUCKET: 0,
     FUGUE_CONF_JAX_DEVICE_ZIP: True,
+    # Two-tier placement (see JaxExecutionEngine): frames below the byte
+    # threshold ingest onto the host (CPU-XLA) mesh; at/above it they go to
+    # the accelerator mesh. The default is tuned for network-attached
+    # accelerators where per-query host<->device transfer costs seconds per
+    # GB; on PCIe-local TPU hosts set a lower threshold or placement=device.
+    FUGUE_CONF_JAX_PLACEMENT: "auto",
+    FUGUE_CONF_JAX_MIN_DEVICE_BYTES: 256 * 1024 * 1024,
 }
 
 _GLOBAL_CONF = ParamDict(_DEFAULT_CONF)

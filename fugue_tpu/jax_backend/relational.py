@@ -759,13 +759,14 @@ def device_fillna(
 
 
 def _sort_code_columns(
-    blocks: JaxBlocks, sorts: Dict[str, bool], na_position: str
+    blocks: JaxBlocks, sorts: List[Tuple[str, bool]]
 ) -> Optional[List[Tuple[Any, Optional[Any], bool]]]:
-    """Per sort column: (device code array, effective-null mask or None,
-    ascending). String columns sort by LEXICOGRAPHIC rank (a host argsort
-    of the small dictionary builds the rank table), not by code order."""
+    """Per sort item IN ORDER (duplicates kept): (device code array,
+    effective-null mask or None, ascending). String columns sort by
+    LEXICOGRAPHIC rank (a host argsort of the small dictionary builds the
+    rank table), not by code order."""
     out: List[Tuple[Any, Optional[Any], bool]] = []
-    for name, asc in sorts.items():
+    for name, asc in sorts:
         col = blocks.columns.get(name)
         if col is None or not col.on_device:
             return None
@@ -788,6 +789,41 @@ def _sort_code_columns(
     return out
 
 
+def _stable_sort_order(
+    code_arrs: Tuple[Any, ...],
+    null_arrs: Dict[int, Any],
+    ascs: List[bool],
+    na_first: List[bool],
+    valid: Any,
+    invalid_last: bool = True,
+) -> Any:
+    """Traced helper shared by device_take/device_sort: row order under a
+    stable multi-key sort (keys applied least-significant outward), per-key
+    NULLS FIRST/LAST, then (unless the caller re-sorts, e.g. by segment)
+    invalid rows last. ``descending=True`` (not value negation) because
+    negating unsigned or INT_MIN values wraps and silently misorders
+    (review finding)."""
+    p = valid.shape[0]
+    order = jnp.arange(p, dtype=jnp.int32)
+    for i in reversed(range(len(code_arrs))):
+        sc = code_arrs[i]
+        if i in null_arrs:
+            # null slots hold fill garbage (join gathers especially):
+            # neutralize them so null rows TIE on the value key and keep
+            # the less-significant key order (review finding)
+            sc = jnp.where(null_arrs[i], jnp.zeros_like(sc), sc)
+        sc = sc[order]
+        order = order[jnp.argsort(sc, stable=True, descending=not ascs[i])]
+        if i in null_arrs:
+            nf = null_arrs[i][order]
+            # nulls first -> sort by NOT-null; nulls last -> by null
+            flag = ~nf if na_first[i] else nf
+            order = order[jnp.argsort(flag, stable=True)]
+    if invalid_last:
+        order = order[jnp.argsort(~valid[order], stable=True)]
+    return order
+
+
 def device_take(
     engine: Any,
     blocks: JaxBlocks,
@@ -800,7 +836,7 @@ def device_take(
     """Mask-only take: rows keep their storage order; validity flips to
     the first `n` rows per partition (or globally) under the presort
     order. Zero host syncs; the row count becomes a lazy device scalar."""
-    codes = _sort_code_columns(blocks, sorts, na_position)
+    codes = _sort_code_columns(blocks, list(sorts.items()))
     if codes is None:
         return None
     for k in partition_by:
@@ -823,24 +859,18 @@ def device_take(
         nrows_s: Any,
     ) -> Tuple[Any, Any]:
         valid = groupby.materialize_validity(row_valid, p, nrows_s)
-        order = jnp.arange(p, dtype=jnp.int32)
-        # stable sorts applied from the least-significant key outward
-        for i in reversed(range(len(code_arrs))):
-            c = code_arrs[i]
-            _, nullm, asc = codes[i]
-            sc = c[order]
-            # descending=True (not negation): negating unsigned or INT_MIN
-            # values wraps and silently misorders (review finding)
-            order = order[jnp.argsort(sc, stable=True, descending=not asc)]
-            if i in null_arrs:
-                nf = null_arrs[i][order]
-                # nulls first -> sort by NOT-null; nulls last -> by null
-                flag = ~nf if na_first else nf
-                order = order[jnp.argsort(flag, stable=True)]
+        order = _stable_sort_order(
+            code_arrs, null_arrs,
+            [asc for _, _, asc in codes],
+            [na_first] * len(codes),
+            valid,
+            invalid_last=seg_ is None,
+        )
         if seg_ is not None:
             order = order[jnp.argsort(seg_[order], stable=True)]
-        # invalid rows last (primary key)
-        order = order[jnp.argsort(~valid[order], stable=True)]
+            # invalid rows last (their sentinel seg already sorts high,
+            # but keep the explicit guarantee)
+            order = order[jnp.argsort(~valid[order], stable=True)]
         invrank = jnp.zeros((p,), dtype=jnp.int32).at[order].set(
             jnp.arange(p, dtype=jnp.int32)
         )
@@ -879,6 +909,73 @@ def device_take(
     return JaxBlocks(
         None, dict(blocks.columns), blocks.mesh, row_valid=keep, nrows_dev=cnt
     )
+
+
+def device_sort(
+    engine: Any,
+    blocks: JaxBlocks,
+    schema: Schema,
+    sorts: List[Tuple[str, bool, Optional[bool]]],
+    limit: Optional[int] = None,
+    offset: Optional[int] = None,
+) -> Optional[JaxBlocks]:
+    """ORDER BY [LIMIT/OFFSET] as a device ROW REORDER: stable multi-key
+    argsort on device (per-key NULLS FIRST/LAST; default LAST to match the
+    host SELECT runner), then one gather of the surviving window. Pays one
+    host sync for the row count — ORDER BY sits at a query's export
+    boundary, where that sync happens anyway. With ``sorts == []`` this is
+    plain LIMIT/OFFSET in storage order."""
+    code_cols = _sort_code_columns(
+        blocks, [(name, asc) for name, asc, _ in sorts]
+    )
+    if code_cols is None:
+        return None
+    if not all(c.on_device for c in blocks.columns.values()):
+        return None
+    p = blocks.padded_nrows
+    na_first = [
+        (nulls if nulls is not None else False) for _, _, nulls in sorts
+    ]
+
+    def _prog(
+        code_arrs: Tuple[Any, ...],
+        null_arrs: Dict[int, Any],
+        row_valid: Optional[Any],
+        nrows_s: Any,
+    ) -> Any:
+        valid = groupby.materialize_validity(row_valid, p, nrows_s)
+        return _stable_sort_order(
+            code_arrs, null_arrs,
+            [asc for _, _, asc in code_cols],
+            na_first,
+            valid,
+        )
+
+    order = engine._jit_cached(
+        (
+            "sort",
+            p,
+            tuple(
+                (nm, asc, nf) for (nm, asc, _), nf in zip(sorts, na_first)
+            ),
+            tuple(
+                i for i in range(len(code_cols))
+                if code_cols[i][1] is not None
+            ),
+        ),
+        _prog,
+    )(
+        tuple(c for c, _, _ in code_cols),
+        {i: nl for i, (_, nl, _) in enumerate(code_cols) if nl is not None},
+        blocks.row_valid,
+        _nrows_arg(blocks),
+    )
+    n = blocks.nrows  # the one host sync
+    start = min(offset or 0, n)
+    stop = n if limit is None else min(n, start + limit)
+    from fugue_tpu.jax_backend.blocks import gather_indices
+
+    return gather_indices(blocks, order[start:stop], schema)
 
 
 def device_sample(

@@ -1,124 +1,429 @@
-"""SQL AST -> column algebra bridge.
+"""SQL AST -> device plan bridge.
 
-Lets SQL engines lower simple single-table SELECT [WHERE] [GROUP BY]
-queries into :meth:`ExecutionEngine.select` (the column-algebra path) —
-on the jax engine that means device projections and segment-reduction
-aggregates instead of the host SELECT runner. The reference gets this for
-free from its SQL backends (Spark SQL, DuckDB); here the bridge plays
-that role for expressions the device evaluator understands, and returns
-``None`` for anything else (joins, subqueries, CTEs, set ops, ORDER BY,
-window functions) so callers fall back to the host runner.
+Lowers SELECT queries into a small tree of engine primitives —
+``engine.join`` / ``engine.union`` / ``engine.select`` / device sort —
+so that on the jax engine, joins, set ops, GROUP BY and ORDER BY all run
+on device (the role the reference's SQL backends play natively:
+``/root/reference/fugue_duckdb/execution_engine.py:238-483`` builds its
+relational ops as DuckDB SQL; here the bridge builds them as device
+relational ops). Returns ``None`` for anything outside the supported
+shape (non-equi joins, correlated subqueries, window functions, LIKE,
+EXCEPT/INTERSECT ALL) so callers fall back to the host SELECT runner.
+
+Name scoping is tracked per relation (each plan node knows its output
+column names), so a qualified reference to a column the relation does
+not own is a translation failure — the host runner then raises the
+proper SQL error instead of the bridge silently mis-binding it.
 """
 
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from fugue_tpu.column import functions as ff
 from fugue_tpu.column.expressions import ColumnExpr, col, lit, null
 from fugue_tpu.column.sql import SelectColumns
 from fugue_tpu.sql_frontend import ast
 
-__all__ = ["translate_simple_select", "SimplePlan"]
+__all__ = [
+    "translate_query",
+    "Plan",
+    "ScanPlan",
+    "JoinPlan",
+    "SetPlan",
+    "SelectPlan",
+]
 
 _AGG_FUNCS = {"sum", "min", "max", "avg", "mean", "count", "first", "last"}
 
-
-class SimplePlan:
-    """A single-table plan: run ``engine.select(dfs[table], cols, where,
-    having)``."""
-
-    def __init__(
-        self,
-        table: str,
-        cols: SelectColumns,
-        where: Optional[ColumnExpr],
-        having: Optional[ColumnExpr],
-    ):
-        self.table = table
-        self.cols = cols
-        self.where = where
-        self.having = having
+_JOIN_HOW = {
+    "inner": "inner",
+    "cross": "cross",
+    "left_outer": "left_outer",
+    "right_outer": "right_outer",
+    "full_outer": "full_outer",
+    "semi": "semi",
+    "anti": "anti",
+}
 
 
 class _GiveUp(Exception):
     pass
 
 
-def translate_simple_select(
-    query: ast.Query, df_names: List[str]
-) -> Optional[SimplePlan]:
-    """Translate, or None when the query doesn't fit the simple shape."""
+class Plan:
+    """A device-executable relational plan node.
+
+    ``out_names`` is the node's PHYSICAL output column list (what the
+    engine frame will hold); executors walk the tree with engine
+    primitives. ``sql_row_names`` is the SQL-visible namespace, which can
+    differ: an ON equi-join keeps BOTH key columns visible (referencing
+    the bare key is ambiguous, per the host oracle) even though the
+    engine output collapses them, while USING merges them in SQL too."""
+
+    out_names: List[str]
+
+    @property
+    def sql_row_names(self) -> List[str]:
+        return self.out_names
+
+
+class ScanPlan(Plan):
+    def __init__(self, table: str, out_names: List[str]):
+        self.table = table
+        self.out_names = out_names
+
+
+class JoinPlan(Plan):
+    def __init__(
+        self,
+        left: Plan,
+        right: Plan,
+        how: str,
+        on: List[str],
+        using: bool = False,
+    ):
+        self.left = left
+        self.right = right
+        self.how = how
+        self.on = on
+        self.using = using
+        if how in ("semi", "anti"):
+            self.out_names = list(left.out_names)
+            self._sql_names = list(left.sql_row_names)
+        else:
+            keyset = {k.lower() for k in on}
+            self.out_names = list(left.out_names) + [
+                n for n in right.out_names if n.lower() not in keyset
+            ]
+            if using:
+                self._sql_names = list(self.out_names)
+            else:
+                # ON join: both key columns stay SQL-visible, so a bare
+                # reference to the key is ambiguous — exactly what the
+                # host oracle enforces
+                self._sql_names = list(left.sql_row_names) + list(
+                    right.sql_row_names
+                )
+
+    @property
+    def sql_row_names(self) -> List[str]:
+        return self._sql_names
+
+
+class SetPlan(Plan):
+    def __init__(self, op: str, distinct: bool, left: Plan, right: Plan):
+        self.op = op  # union | except | intersect
+        self.distinct = distinct
+        self.left = left
+        self.right = right
+        self.out_names = list(left.out_names)
+
+
+class SelectPlan(Plan):
+    """Project/filter/aggregate over ``source`` plus post-ops.
+
+    ``cols is None`` means pass the source through unchanged (used to
+    hang ORDER BY / LIMIT off a set-op result)."""
+
+    def __init__(
+        self,
+        source: Plan,
+        cols: Optional[SelectColumns],
+        where: Optional[ColumnExpr],
+        having: Optional[ColumnExpr],
+        order_by: List[Tuple[str, bool, Optional[str]]],
+        limit: Optional[int],
+        offset: Optional[int],
+        distinct: bool,
+        out_names: List[str],
+    ):
+        self.source = source
+        self.cols = cols
+        self.where = where
+        self.having = having
+        self.order_by = order_by  # (output column, asc, nulls)
+        self.limit = limit
+        self.offset = offset
+        self.distinct = distinct
+        self.out_names = out_names
+
+
+class _Scope:
+    """Visible relations: alias -> that relation's output column names.
+    ``row_names`` is the FROM clause's final (join-deduped) column list —
+    unqualified references resolve against it, so a join key appearing on
+    both sides is unambiguous exactly when the join collapsed it."""
+
+    def __init__(self) -> None:
+        self.relations: Dict[str, List[str]] = {}
+        self.row_names: List[str] = []
+        # (alias, column) pairs whose SQL value diverges from the surviving
+        # joined column — e.g. ``b.k`` after ``a LEFT JOIN b`` is NULL on
+        # unmatched rows while the surviving ``k`` is a's value
+        self.tainted: Set[Tuple[str, str]] = set()
+
+    def add(self, alias: str, names: List[str]) -> None:
+        if alias.lower() in self.relations:
+            raise _GiveUp()  # duplicate alias: let the host runner error
+        self.relations[alias.lower()] = names
+
+    def taint(self, alias: str, name: str) -> None:
+        self.tainted.add((alias.lower(), name.lower()))
+
+    def resolve(self, name: str, table: Optional[str]) -> str:
+        """Return the bound column name, or give up on a bad/ambiguous
+        reference (the host runner owns the error message)."""
+        if table is not None:
+            if (table.lower(), name.lower()) in self.tainted:
+                raise _GiveUp()
+            names = self.relations.get(table.lower())
+            if names is None:
+                raise _GiveUp()
+            for n in names:
+                if n.lower() == name.lower():
+                    return n
+            raise _GiveUp()
+        hits = [n for n in self.row_names if n.lower() == name.lower()]
+        if len(hits) != 1:
+            raise _GiveUp()
+        return hits[0]
+
+
+def translate_query(
+    query: ast.Query, df_schemas: Dict[str, Sequence[str]]
+) -> Optional[Plan]:
+    """Translate a full query (CTEs, set ops, joins, nested SELECTs) into
+    a device plan, or ``None`` when any part falls outside the supported
+    shape."""
     try:
-        return _translate(query, df_names)
+        return _query(
+            {n.lower(): list(v) for n, v in df_schemas.items()}, query
+        )
     except _GiveUp:
         return None
 
 
-def _translate(query: ast.Query, df_names: List[str]) -> SimplePlan:
-    if not isinstance(query, ast.Select):
+def _query(env: Dict[str, object], q: ast.Query) -> Plan:
+    if isinstance(q, ast.With):
+        inner = dict(env)
+        for name, sub in q.ctes:
+            inner[name.lower()] = _query(inner, sub)
+        return _query(inner, q.body)
+    if isinstance(q, ast.SetOp):
+        op = q.op.lower()
+        if op not in ("union", "except", "intersect"):
+            raise _GiveUp()
+        if q.all and op != "union":
+            raise _GiveUp()  # EXCEPT/INTERSECT ALL: host only
+        left = _query(env, q.left)
+        right = _query(env, q.right)
+        plan: Plan = SetPlan(op, not q.all, left, right)
+        if q.order_by or q.limit is not None or q.offset is not None:
+            order = _order_items(q.order_by, plan.out_names)
+            plan = SelectPlan(
+                plan, None, None, None, order, q.limit, q.offset,
+                False, list(plan.out_names),
+            )
+        return plan
+    if isinstance(q, ast.Select):
+        return _select(env, q)
+    raise _GiveUp()
+
+
+def _relation(env: Dict[str, object], rel: ast.Relation, scope: _Scope) -> Plan:
+    if isinstance(rel, ast.TableRef):
+        target = env.get(rel.name.lower())
+        if target is None:
+            raise _GiveUp()
+        alias = rel.alias or rel.name
+        if isinstance(target, Plan):  # CTE body
+            plan: Plan = target
+            names = list(target.out_names)
+        else:
+            names = list(target)  # type: ignore[arg-type]
+            plan = ScanPlan(rel.name.lower(), names)
+        scope.add(alias, names)
+        return plan
+    if isinstance(rel, ast.SubqueryRef):
+        sub = _query(env, rel.query)
+        scope.add(rel.alias, list(sub.out_names))
+        return sub
+    if isinstance(rel, ast.JoinRel):
+        left = _relation(env, rel.left, scope)
+        left_aliases = set(scope.relations)
+        right_scope = _Scope()
+        right = _relation(env, rel.right, right_scope)
+        for alias, names in right_scope.relations.items():
+            scope.add(alias, names)
+        scope.tainted |= right_scope.tainted
+        how = _JOIN_HOW.get(rel.how.lower().replace(" ", "_"))
+        if how is None:
+            raise _GiveUp()
+        keys = _join_keys(rel, left, right)
+        if how != "cross" and len(keys) == 0:
+            raise _GiveUp()
+        # a qualified key reference on an outer join's null-filled side is
+        # NOT the surviving joined key — decline those bindings
+        if how in ("left_outer", "full_outer"):
+            for alias in set(scope.relations) - left_aliases:
+                for k in keys:
+                    scope.taint(alias, k)
+        if how in ("right_outer", "full_outer"):
+            for alias in left_aliases:
+                for k in keys:
+                    scope.taint(alias, k)
+        plan = JoinPlan(left, right, how, keys, using=bool(rel.using))
+        lowered_names = [n.lower() for n in plan.out_names]
+        if len(set(lowered_names)) != len(lowered_names):
+            raise _GiveUp()  # shared non-key columns: engine.join can't
+        return plan
+    raise _GiveUp()
+
+
+def _join_keys(rel: ast.JoinRel, left: Plan, right: Plan) -> List[str]:
+    """Equi-join keys: USING(...) or an ON conjunction of same-name
+    column equalities across the two sides. Keys resolve
+    case-insensitively against BOTH sides' actual column names."""
+    lnames = {n.lower(): n for n in left.out_names}
+    rnames = {n.lower(): n for n in right.out_names}
+    if rel.using:
+        out = []
+        for u in rel.using:
+            nl = u.lower()
+            if nl not in lnames or nl not in rnames:
+                raise _GiveUp()
+            out.append(lnames[nl])
+        return out
+    if rel.on is None:
+        return []
+
+    def _conj(e: ast.Expr) -> List[str]:
+        if isinstance(e, ast.Binary) and e.op.upper() == "AND":
+            return _conj(e.left) + _conj(e.right)
+        if (
+            isinstance(e, ast.Binary)
+            and e.op == "="
+            and isinstance(e.left, ast.Col)
+            and isinstance(e.right, ast.Col)
+        ):
+            a, b = e.left, e.right
+            if a.name.lower() != b.name.lower():
+                raise _GiveUp()  # differently-named equi keys: host only
+            nl = a.name.lower()
+            if nl not in lnames or nl not in rnames:
+                raise _GiveUp()
+            return [lnames[nl]]
         raise _GiveUp()
-    if query.order_by or query.limit is not None or query.offset is not None:
-        raise _GiveUp()
-    if query.distinct:
-        raise _GiveUp()
-    if not isinstance(query.from_, ast.TableRef):
-        raise _GiveUp()
-    lowered = {n.lower(): n for n in df_names}
-    tname = query.from_.name.lower()
-    if tname not in lowered:
-        raise _GiveUp()
-    alias = (query.from_.alias or query.from_.name).lower()
+
+    return _conj(rel.on)
+
+
+def _select(env: Dict[str, object], q: ast.Select) -> Plan:
+    if q.from_ is None:
+        raise _GiveUp()  # FROM-less SELECT: host evaluates it fine
+    scope = _Scope()
+    source = _relation(env, q.from_, scope)
+    scope.row_names = list(source.sql_row_names)
 
     exprs: List[ColumnExpr] = []
+    out_names: List[str] = []
     implicit_star = False
-    for item in query.items:
+    for item in q.items:
         if isinstance(item.expr, ast.Star):
-            if item.expr.table is not None and item.expr.table.lower() != alias:
+            if (
+                item.expr.table is not None
+                and item.expr.table.lower() not in scope.relations
+            ):
+                raise _GiveUp()
+            if item.expr.table is not None and len(scope.relations) > 1:
+                raise _GiveUp()  # per-table star over a join: host only
+            visible = [n.lower() for n in source.sql_row_names]
+            if len(set(visible)) != len(visible):
+                # SELECT * over an ON join duplicates the key column —
+                # the host oracle rejects that; don't silently dedup
                 raise _GiveUp()
             exprs.append(col("*"))
+            out_names.extend(source.out_names)
             implicit_star = True
             continue
-        e = _expr(item.expr, alias)
+        e = _expr(item.expr, scope)
         if item.alias:
             e = e.alias(item.alias)
         elif e.output_name == "":
             raise _GiveUp()  # unnamed computed column
         exprs.append(e)
+        out_names.append(e.output_name)
 
     cols = SelectColumns(*exprs)
     if cols.has_agg and implicit_star:
         raise _GiveUp()
-    # GROUP BY keys must coincide with the non-agg select items
-    if query.group_by:
+    if q.group_by:
         keys = set()
-        for g in query.group_by:
+        for g in q.group_by:
             if not isinstance(g, ast.Col):
                 raise _GiveUp()
-            keys.add(g.name.lower())
+            keys.add(scope.resolve(g.name, g.table).lower())
         non_agg = {c.output_name.lower() for c in cols.group_keys}
         if keys != non_agg or not cols.has_agg:
             raise _GiveUp()
     elif cols.has_agg and len(cols.group_keys) > 0:
         raise _GiveUp()  # non-agg cols without GROUP BY is invalid SQL
 
-    where = _expr(query.where, alias) if query.where is not None else None
-    having = _expr(query.having, alias) if query.having is not None else None
-    return SimplePlan(lowered[tname], cols, where, having)
+    where = _expr(q.where, scope) if q.where is not None else None
+    having = _expr(q.having, scope) if q.having is not None else None
+    order = _order_items(q.order_by, out_names)
+    return SelectPlan(
+        source, cols, where, having, order, q.limit, q.offset,
+        q.distinct, out_names,
+    )
+
+
+def _order_items(
+    items: List[ast.OrderItem],
+    out_names: List[str],
+) -> List[Tuple[str, bool, Optional[str]]]:
+    """ORDER BY entries resolved against the SELECT's OUTPUT columns
+    (unqualified references and 1-based positions only — expression and
+    qualified sort keys stay on the host runner)."""
+    lowered = {n.lower(): n for n in out_names}
+    out: List[Tuple[str, bool, Optional[str]]] = []
+    for o in items:
+        e = o.expr
+        if (
+            isinstance(e, ast.Lit)
+            and isinstance(e.value, int)
+            and not isinstance(e.value, bool)
+            and 1 <= e.value <= len(out_names)
+        ):
+            name = out_names[e.value - 1]
+        elif isinstance(e, ast.Col):
+            if e.table is not None:
+                # a QUALIFIED ref names the source column, which an output
+                # alias of the same name may shadow with different values —
+                # sorting by the output here would silently diverge from
+                # SQL semantics (review finding), so the host runner keeps
+                # this shape
+                raise _GiveUp()
+            name = lowered.get(e.name.lower())
+            if name is None:
+                raise _GiveUp()
+        else:
+            raise _GiveUp()
+        out.append((name, o.asc, o.nulls))
+    return out
 
 
 _BIN_OPS = {"=", "<>", "!=", "<", "<=", ">", ">=", "+", "-", "*", "/",
             "AND", "OR"}
 
 
-def _expr(e: ast.Expr, alias: str) -> ColumnExpr:
+def _expr(e: ast.Expr, scope: _Scope) -> ColumnExpr:
     if isinstance(e, ast.Lit):
         return null() if e.value is None else lit(e.value)
     if isinstance(e, ast.Col):
-        if e.table is not None and e.table.lower() != alias:
-            raise _GiveUp()
-        return col(e.name)
+        return col(scope.resolve(e.name, e.table))
     if isinstance(e, ast.Unary):
         op = e.op.upper()
-        v = _expr(e.operand, alias)
+        v = _expr(e.operand, scope)
         if op == "-":
             return -v
         if op == "+":
@@ -130,7 +435,7 @@ def _expr(e: ast.Expr, alias: str) -> ColumnExpr:
         op = e.op.upper()
         if op not in _BIN_OPS:
             raise _GiveUp()
-        lv, rv = _expr(e.left, alias), _expr(e.right, alias)
+        lv, rv = _expr(e.left, scope), _expr(e.right, scope)
         return {
             "=": lambda: lv == rv,
             "<>": lambda: lv != rv,
@@ -154,28 +459,28 @@ def _expr(e: ast.Expr, alias: str) -> ColumnExpr:
             if len(e.args) != 1:
                 raise _GiveUp()
             a = e.args[0]
-            arg = col("*") if isinstance(a, ast.Star) else _expr(a, alias)
+            arg = col("*") if isinstance(a, ast.Star) else _expr(a, scope)
             if name == "mean":
                 name = "avg"
             # the ff constructors mark is_aggregation (function() does not)
             return getattr(ff, name)(arg)
         if name == "coalesce":
-            return ff.coalesce(*[_expr(a, alias) for a in e.args])
+            return ff.coalesce(*[_expr(a, scope) for a in e.args])
         raise _GiveUp()
     if isinstance(e, ast.Cast):
-        return _expr(e.operand, alias).cast(e.type_name)
+        return _expr(e.operand, scope).cast(e.type_name)
     if isinstance(e, ast.IsNull):
-        v = _expr(e.operand, alias)
+        v = _expr(e.operand, scope)
         return v.not_null() if e.negated else v.is_null()
     if isinstance(e, ast.Between):
-        v = _expr(e.operand, alias)
-        res = (v >= _expr(e.low, alias)) & (v <= _expr(e.high, alias))
+        v = _expr(e.operand, scope)
+        res = (v >= _expr(e.low, scope)) & (v <= _expr(e.high, scope))
         return ~res if e.negated else res
     if isinstance(e, ast.InList):
-        v = _expr(e.operand, alias)
+        v = _expr(e.operand, scope)
         res: Optional[ColumnExpr] = None
         for item in e.items:
-            term = v == _expr(item, alias)
+            term = v == _expr(item, scope)
             res = term if res is None else (res | term)
         if res is None:
             raise _GiveUp()

@@ -64,10 +64,22 @@ def test_global_agg_on_device():
     assert e.fallbacks == {}, e.fallbacks
 
 
-def test_complex_query_falls_back_correctly():
+def test_orderby_limit_routes_to_device():
+    # round-3 verdict item 3: this shape used to fall back; now the whole
+    # groupby+sort+limit pipeline stays on device
     df = _df()
     e, jx, nt = _both(
         ("SELECT k, SUM(v) AS s FROM", df, "GROUP BY k ORDER BY s DESC LIMIT 3")
+    )
+    assert jx == nt
+    assert e.fallbacks == {}, e.fallbacks
+
+
+def test_complex_query_falls_back_correctly():
+    # CASE WHEN is outside the bridge: host runner with a counted fallback
+    df = _df()
+    e, jx, nt = _both(
+        ("SELECT k, CASE WHEN v > 0.5 THEN 1 ELSE 0 END AS b FROM", df)
     )
     assert jx == nt
     assert e.fallbacks.get("sql_select", 0) >= 1  # counted, not silent
