@@ -431,6 +431,21 @@ class JaxSQLEngine(PandasSQLEngine):
             if plan.op == "except":
                 return engine.subtract(left, right, distinct=True)
             return engine.intersect(left, right, distinct=True)
+        if isinstance(plan, ab.WindowPlan):
+            src: JaxDataFrame = engine.to_df(
+                self._exec_plan(plan.source, dfs, done)
+            )  # type: ignore[assignment]
+            if plan.where is not None:
+                src = engine.to_df(engine.filter(src, plan.where))  # type: ignore
+            res = relational.device_window(
+                engine, src.blocks, src.schema, plan.items
+            )
+            assert_or_throw(
+                res is not None,
+                ValueError("window columns not device-resident"),
+            )
+            wblocks, wschema = res  # type: ignore[misc]
+            return JaxDataFrame(wblocks, wschema)
         assert_or_throw(
             isinstance(plan, ab.SelectPlan), ValueError(f"bad plan {plan}")
         )

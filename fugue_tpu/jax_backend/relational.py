@@ -978,6 +978,200 @@ def device_sort(
     return gather_indices(blocks, order[start:stop], schema)
 
 
+def device_window(
+    engine: Any,
+    blocks: JaxBlocks,
+    schema: Schema,
+    items: List[Any],
+) -> Optional[Tuple[JaxBlocks, Schema]]:
+    """Window functions as device programs (verdict r3 item 4's device
+    lowering): whole-partition aggregates gather segment reductions back
+    per row; ``row_number`` reuses the device_take local-rank machinery
+    (stable sort + per-segment start offsets). ``items`` mixes
+    ``("col", (out_name, src_name))`` passthroughs with ``("win", spec)``
+    entries (see ``algebra_bridge.WindowSpec``). Returns None when any
+    referenced column is host-resident."""
+    if not all(c.on_device for c in blocks.columns.values()):
+        return None
+    p = blocks.padded_nrows
+    out_cols: Dict[str, JaxColumn] = {}
+    fields: List[Any] = []
+    for kind, payload in items:
+        if kind == "col":
+            out_name, src_name = payload
+            src = blocks.columns.get(src_name)
+            if src is None:
+                return None
+            out_cols[out_name] = src
+            fields.append(
+                pa.field(out_name, schema[src_name].type)
+            )
+            continue
+        spec = payload
+        if spec.partition_by:
+            fr = groupby.factorize_keys(blocks, list(spec.partition_by))
+            seg, S = fr.seg, max(fr.num_segments, 1)
+        else:
+            seg, S = jnp.zeros((p,), dtype=jnp.int32), 1
+        if spec.func == "row_number":
+            col, tp = _window_row_number(engine, blocks, spec, seg, S, p)
+        else:
+            res = _window_segment_agg(engine, blocks, spec, seg, S, p)
+            if res is None:
+                return None
+            col, tp = res
+        out_cols[spec.name] = col
+        fields.append(pa.field(spec.name, tp))
+    out_schema = Schema(fields)
+    return (
+        JaxBlocks(
+            blocks._nrows,
+            out_cols,
+            blocks.mesh,
+            row_valid=blocks.row_valid,
+            nrows_dev=blocks._nrows_dev,
+        ),
+        out_schema,
+    )
+
+
+def _window_row_number(
+    engine: Any, blocks: JaxBlocks, spec: Any, seg: Any, S: int, p: int
+) -> Tuple[JaxColumn, pa.DataType]:
+    codes = _sort_code_columns(
+        blocks, [(name, asc) for name, asc, _ in spec.order_by]
+    )
+    assert_or_throw(codes is not None, ValueError("sort key not on device"))
+    na_first = [
+        (nf if nf is not None else False) for _, _, nf in spec.order_by
+    ]
+
+    def _prog(
+        code_arrs: Tuple[Any, ...],
+        null_arrs: Dict[int, Any],
+        seg_: Any,
+        row_valid: Optional[Any],
+        nrows_s: Any,
+    ) -> Any:
+        valid = groupby.materialize_validity(row_valid, p, nrows_s)
+        order = _stable_sort_order(
+            code_arrs, null_arrs,
+            [asc for _, _, asc in codes],  # type: ignore[misc]
+            na_first, valid, invalid_last=False,
+        )
+        segv = jnp.where(valid, seg_, S)
+        order = order[jnp.argsort(segv[order], stable=True)]
+        invrank = jnp.zeros((p,), dtype=jnp.int32).at[order].set(
+            jnp.arange(p, dtype=jnp.int32)
+        )
+        cnt = jax.ops.segment_sum(
+            valid.astype(jnp.int32), segv, num_segments=S + 1
+        )[:S]
+        starts = jnp.cumsum(cnt) - cnt
+        local = invrank - starts[jnp.clip(seg_, 0, S - 1)]
+        return (local + 1).astype(jnp.int64)
+
+    rn = engine._jit_cached(
+        (
+            "win_rn", p, S, tuple(spec.partition_by),
+            tuple(
+                (nm, asc, nf)
+                for (nm, asc, _), nf in zip(spec.order_by, na_first)
+            ),
+            tuple(i for i in range(len(codes)) if codes[i][1] is not None),
+        ),
+        _prog,
+    )(
+        tuple(c for c, _, _ in codes),
+        {i: nl for i, (_, nl, _) in enumerate(codes) if nl is not None},
+        seg,
+        blocks.row_valid,
+        _nrows_arg(blocks),
+    )
+    sharding = row_sharding(blocks.mesh)
+    return (
+        JaxColumn(pa.int64(), jax.device_put(rn, sharding)),
+        pa.int64(),
+    )
+
+
+def _window_segment_agg(
+    engine: Any, blocks: JaxBlocks, spec: Any, seg: Any, S: int, p: int
+) -> Optional[Tuple[JaxColumn, pa.DataType]]:
+    if spec.arg is None:  # count(*)
+        values = jnp.ones((p,), dtype=jnp.int32)
+        vmask = None
+        arg_tp: Optional[pa.DataType] = None
+    else:
+        col = blocks.columns.get(spec.arg)
+        if col is None or not col.on_device or col.is_string:
+            return None
+        values, vmask = col.data, col.mask
+        arg_tp = col.pa_type
+    func = "avg" if spec.func == "mean" else spec.func
+    cast_result = True
+    if func == "count":
+        tp: pa.DataType = pa.int64()
+    elif func in ("avg", "sum"):
+        # numeric payloads only — the host oracle owns the error for
+        # SUM(timestamp) etc.
+        if arg_tp is None or not (
+            pa.types.is_integer(arg_tp)
+            or pa.types.is_floating(arg_tp)
+            or pa.types.is_boolean(arg_tp)
+        ):
+            return None
+        tp = (
+            pa.float64()
+            if func == "avg"
+            else (pa.int64() if pa.types.is_integer(arg_tp) else pa.float64())
+        )
+    else:  # min/max
+        if arg_tp is None:
+            return None
+        tp = arg_tp
+        if pa.types.is_timestamp(arg_tp) or pa.types.is_date32(arg_tp):
+            # device representation is already the right integer encoding;
+            # datetime64 is not a jax dtype (review finding)
+            cast_result = False
+
+    def _prog(
+        values_: Any,
+        vmask_: Optional[Any],
+        seg_: Any,
+        row_valid: Optional[Any],
+        nrows_s: Any,
+    ) -> Tuple[Any, Optional[Any]]:
+        valid = groupby.materialize_validity(row_valid, p, nrows_s)
+        segv = jnp.where(valid, seg_, S)
+        v, m = groupby._segment_agg_impl(
+            func, values_, vmask_, segv, S + 1, valid
+        )
+        segc = jnp.clip(seg_, 0, S - 1)
+        out = v[:S][segc]
+        if cast_result:
+            out = out.astype(tp.to_pandas_dtype())
+        outm = None if m is None else m[:S][segc]
+        return out, outm
+
+    out, outm = engine._jit_cached(
+        (
+            "win_agg", func, spec.arg, p, S, tuple(spec.partition_by),
+            str(tp), vmask is not None,
+        ),
+        _prog,
+    )(values, vmask, seg, blocks.row_valid, _nrows_arg(blocks))
+    sharding = row_sharding(blocks.mesh)
+    return (
+        JaxColumn(
+            tp,
+            jax.device_put(out, sharding),
+            None if outm is None else jax.device_put(outm, sharding),
+        ),
+        tp,
+    )
+
+
 def device_sample(
     engine: Any,
     blocks: JaxBlocks,

@@ -30,6 +30,8 @@ __all__ = [
     "JoinPlan",
     "SetPlan",
     "SelectPlan",
+    "WindowPlan",
+    "WindowSpec",
 ]
 
 _AGG_FUNCS = {"sum", "min", "max", "avg", "mean", "count", "first", "last"}
@@ -144,6 +146,43 @@ class SelectPlan(Plan):
         self.limit = limit
         self.offset = offset
         self.distinct = distinct
+        self.out_names = out_names
+
+
+class WindowSpec:
+    """One device-lowerable window item: ``row_number`` (needs ORDER BY)
+    or a whole-partition aggregate (sum/count/avg/min/max, no ORDER BY —
+    running frames stay on the host runner)."""
+
+    def __init__(
+        self,
+        name: str,
+        func: str,
+        arg: Optional[str],
+        partition_by: List[str],
+        order_by: List[Tuple[str, bool, Optional[bool]]],
+    ):
+        self.name = name
+        self.func = func
+        self.arg = arg
+        self.partition_by = partition_by
+        self.order_by = order_by  # (column, asc, nulls_first)
+
+
+class WindowPlan(Plan):
+    """Window items + passthrough columns over ``source``; executed by
+    ``relational.device_window``."""
+
+    def __init__(
+        self,
+        source: Plan,
+        items: List[Tuple[str, object]],
+        where: Optional[ColumnExpr],
+        out_names: List[str],
+    ):
+        self.source = source
+        self.items = items  # ("col", (out, src)) | ("win", WindowSpec)
+        self.where = where
         self.out_names = out_names
 
 
@@ -323,6 +362,8 @@ def _select(env: Dict[str, object], q: ast.Select) -> Plan:
     scope = _Scope()
     source = _relation(env, q.from_, scope)
     scope.row_names = list(source.sql_row_names)
+    if any(isinstance(it.expr, ast.Window) for it in q.items):
+        return _window_select(q, scope, source)
 
     exprs: List[ColumnExpr] = []
     out_names: List[str] = []
@@ -375,6 +416,81 @@ def _select(env: Dict[str, object], q: ast.Select) -> Plan:
         source, cols, where, having, order, q.limit, q.offset,
         q.distinct, out_names,
     )
+
+
+_DEVICE_WINDOW_AGGS = {"sum", "count", "avg", "mean", "min", "max"}
+
+
+def _window_select(q: ast.Select, scope: _Scope, source: Plan) -> Plan:
+    """SELECT with window items -> WindowPlan (verdict r3 item 4's device
+    lowering). Shapes beyond the device set — running frames, rank/lag/
+    lead, expression args — give up to the host runner."""
+    if q.group_by or q.having is not None or q.distinct:
+        raise _GiveUp()
+    items: List[Tuple[str, object]] = []
+    out_names: List[str] = []
+    for item in q.items:
+        e = item.expr
+        if isinstance(e, ast.Col):
+            name = scope.resolve(e.name, e.table)
+            out = item.alias or name
+            items.append(("col", (out, name)))
+            out_names.append(out)
+            continue
+        if not isinstance(e, ast.Window) or item.alias is None:
+            raise _GiveUp()
+        if e.func.distinct:
+            raise _GiveUp()
+        part: List[str] = []
+        for pexpr in e.partition_by:
+            if not isinstance(pexpr, ast.Col):
+                raise _GiveUp()
+            part.append(scope.resolve(pexpr.name, pexpr.table))
+        order: List[Tuple[str, bool, Optional[bool]]] = []
+        for o in e.order_by:
+            if not isinstance(o.expr, ast.Col):
+                raise _GiveUp()
+            order.append(
+                (
+                    scope.resolve(o.expr.name, o.expr.table),
+                    o.asc,
+                    None if o.nulls is None else o.nulls == "FIRST",
+                )
+            )
+        fn = e.func.name
+        arg: Optional[str] = None
+        if fn == "row_number":
+            if not order or e.func.args:
+                raise _GiveUp()
+        elif fn in _DEVICE_WINDOW_AGGS:
+            if order:
+                raise _GiveUp()  # running frame: host runner
+            if len(e.func.args) != 1:
+                raise _GiveUp()
+            a = e.func.args[0]
+            if isinstance(a, ast.Star):
+                if fn != "count":
+                    raise _GiveUp()
+            elif isinstance(a, ast.Col):
+                arg = scope.resolve(a.name, a.table)
+            else:
+                raise _GiveUp()
+        else:
+            raise _GiveUp()  # rank/lag/lead etc.: host runner
+        items.append(("win", WindowSpec(item.alias, fn, arg, part, order)))
+        out_names.append(item.alias)
+    lowered = [n.lower() for n in out_names]
+    if len(set(lowered)) != len(lowered):
+        raise _GiveUp()
+    where = _expr(q.where, scope) if q.where is not None else None
+    plan: Plan = WindowPlan(source, items, where, out_names)
+    if q.order_by or q.limit is not None or q.offset is not None:
+        order2 = _order_items(q.order_by, out_names)
+        plan = SelectPlan(
+            plan, None, None, None, order2, q.limit, q.offset, False,
+            list(out_names),
+        )
+    return plan
 
 
 def _order_items(

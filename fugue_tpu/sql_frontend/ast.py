@@ -6,7 +6,7 @@ from typing import Any, List, Optional, Tuple
 
 __all__ = [
     "Expr", "Lit", "Col", "Star", "Unary", "Binary", "Func", "Case", "Cast",
-    "InList", "Between", "Like", "IsNull",
+    "InList", "Between", "Like", "IsNull", "Window",
     "Relation", "TableRef", "SubqueryRef", "JoinRel",
     "SelectItem", "OrderItem", "Select", "SetOp", "With", "Query",
 ]
@@ -142,6 +142,25 @@ class IsNull(Expr):
     def __init__(self, operand: Expr, negated: bool):
         self.operand = operand
         self.negated = negated
+
+
+class Window(Expr):
+    """``func(...) OVER (PARTITION BY ... ORDER BY ...)``. No explicit
+    frame clause: with ORDER BY, aggregates use the SQL default frame
+    (RANGE UNBOUNDED PRECEDING .. CURRENT ROW — running totals where
+    peers share a value); without it, the whole partition."""
+
+    _fields = ("func", "partition_by", "order_by")
+
+    def __init__(
+        self,
+        func: "Func",
+        partition_by: List["Expr"],
+        order_by: List["OrderItem"],
+    ):
+        self.func = func
+        self.partition_by = partition_by
+        self.order_by = order_by
 
 
 # ---- relations ----------------------------------------------------------

@@ -15,7 +15,7 @@ from typing import List, Optional, Tuple
 from fugue_tpu.sql_frontend.ast import (
     Between, Binary, Case, Cast, Col, Expr, Func, InList, IsNull, JoinRel,
     Like, Lit, OrderItem, Query, Relation, Select, SelectItem, SetOp, Star,
-    SubqueryRef, TableRef, Unary, With,
+    SubqueryRef, TableRef, Unary, Window, With,
 )
 from fugue_tpu.sql_frontend.tokenizer import Token, tokenize
 
@@ -478,19 +478,41 @@ class ExprParser:
             name = cur.advance().value
             cur.advance()  # (
             if cur.accept_op(")"):
-                return Func(name, [])
+                return self._maybe_over(Func(name, []))
             if cur.is_op("*"):
                 cur.advance()
                 cur.expect_op(")")
-                return Func(name, [Star()])
+                return self._maybe_over(Func(name, [Star()]))
             distinct = cur.accept_kw("DISTINCT")
             args = [self.expr()]
             while cur.accept_op(","):
                 args.append(self.expr())
             cur.expect_op(")")
-            return Func(name, args, distinct)
+            return self._maybe_over(Func(name, args, distinct))
         cur.advance()
         return self._maybe_qualified(t.value)
+
+    def _maybe_over(self, func: Func) -> Expr:
+        """``OVER (PARTITION BY ... ORDER BY ...)`` after a function call."""
+        cur = self.cur
+        if not cur.accept_kw("OVER"):
+            return func
+        cur.expect_op("(")
+        partition: List[Expr] = []
+        if cur.accept_kw("PARTITION"):
+            cur.expect_kw("BY")
+            partition.append(self.expr())
+            while cur.accept_op(","):
+                partition.append(self.expr())
+        order: List[OrderItem] = []
+        if cur.is_kw("ORDER"):
+            order = self._order_by_clause()
+        if cur.is_kw("ROWS", "RANGE", "GROUPS"):
+            raise cur.error(
+                "explicit window frame specifications are not supported"
+            )
+        cur.expect_op(")")
+        return Window(func, partition, order)
 
     def _maybe_qualified(self, first: str) -> Expr:
         cur = self.cur

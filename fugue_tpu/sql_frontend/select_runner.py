@@ -389,6 +389,8 @@ class _Evaluator:
             return self._cast(e)
         if isinstance(e, ast.Func):
             return self._func(e)
+        if isinstance(e, ast.Window):
+            return _eval_window(self, e)
         if isinstance(e, ast.Star):
             raise SQLExecutionError("wildcard not allowed in this context")
         raise SQLExecutionError(f"unsupported expression {type(e).__name__}")
@@ -738,13 +740,31 @@ _AGG_FUNCS = {
 
 
 def _contains_agg(e: ast.Expr) -> bool:
+    if isinstance(e, ast.Window):
+        # a window expression is row-level: its inner aggregate runs over
+        # the window frame, not the GROUP BY
+        return False
     if isinstance(e, ast.Func) and e.name in _AGG_FUNCS:
         return True
     return any(_contains_agg(c) for c in _children(e))
 
 
+def _contains_window(e: Optional[ast.Expr]) -> bool:
+    if e is None:
+        return False
+    if isinstance(e, ast.Window):
+        return True
+    return any(_contains_window(c) for c in _children(e))
+
+
 def _children(e: ast.Expr) -> List[ast.Expr]:
     out: List[ast.Expr] = []
+    if isinstance(e, ast.Window):
+        return (
+            list(e.partition_by)
+            + [o.expr for o in e.order_by]
+            + [a for a in e.func.args if not isinstance(a, ast.Star)]
+        )
     if isinstance(e, ast.Unary):
         out = [e.operand]
     elif isinstance(e, ast.Binary):
@@ -766,6 +786,269 @@ def _children(e: ast.Expr) -> List[ast.Expr]:
     elif isinstance(e, ast.Between):
         out = [e.operand, e.low, e.high]
     return out
+
+
+_WINDOW_ONLY_FUNCS = {"row_number", "rank", "dense_rank", "lag", "lead"}
+
+_NOT_LITERAL = object()
+
+
+def _literal_value(e: ast.Expr) -> Any:
+    """The python value of a (possibly sign-negated) literal expression."""
+    if isinstance(e, ast.Lit):
+        return e.value
+    if (
+        isinstance(e, ast.Unary)
+        and e.op == "-"
+        and isinstance(e.operand, ast.Lit)
+        and isinstance(e.operand.value, (int, float))
+        and not isinstance(e.operand.value, bool)
+    ):
+        return -e.operand.value
+    return _NOT_LITERAL
+
+
+def _eval_window(ev: "_Evaluator", e: ast.Window) -> _TS:
+    """Window functions over the evaluator's scope rows.
+
+    Semantics match the reference's DuckDB/SparkSQL backends
+    (``/root/reference/fugue_duckdb/execution_engine.py:37``): ranking
+    functions need ORDER BY; aggregates-without-ORDER BY see the whole
+    partition; aggregates-with-ORDER BY use the SQL default frame (RANGE
+    UNBOUNDED PRECEDING .. CURRENT ROW), so peers — rows tying on every
+    ORDER BY key — share one value."""
+    name = e.func.name
+    if name not in _WINDOW_ONLY_FUNCS and name not in _AGG_FUNCS:
+        raise SQLExecutionError(f"unsupported window function {name}")
+    if e.func.distinct:
+        raise SQLExecutionError("DISTINCT is not supported in windows")
+    if name in ("row_number", "rank", "dense_rank") and e.func.args:
+        raise SQLExecutionError(f"{name}() takes no arguments")
+    idx = ev.index
+    if not idx.is_unique:  # pragma: no cover - scopes use fresh indexes
+        raise SQLExecutionError("window over non-unique row index")
+    # several items commonly share one OVER clause: memoize the sorted
+    # order / partition / peer machinery per (partition_by, order_by)
+    # on the evaluator (review finding)
+    wcache = getattr(ev, "_window_clause_cache", None)
+    if wcache is None:
+        wcache = ev._window_clause_cache = {}  # type: ignore[attr-defined]
+    ckey = (tuple(e.partition_by), tuple(e.order_by))
+    if ckey in wcache:
+        order, same_part, part_id, is_peer, peer_id = wcache[ckey]
+    else:
+        work = pd.DataFrame(index=idx)
+        pcols: List[str] = []
+        for j, p in enumerate(e.partition_by):
+            work[f"p{j}"] = ev.eval(p).series
+            pcols.append(f"p{j}")
+        # partition keys lead the sort: the shift-based partition/peer
+        # detection below requires each partition to be CONTIGUOUS
+        ocols: List[str] = []
+        sort_cols: List[str] = list(pcols)
+        sort_asc: List[bool] = [True] * len(pcols)
+        for j, o in enumerate(e.order_by):
+            c = f"s{j}"
+            work[c] = ev.eval(o.expr).series
+            ocols.append(c)
+            nulls_first = (
+                (o.nulls == "FIRST") if o.nulls is not None else False
+            )
+            work[f"n_{c}"] = (
+                (~work[c].isna()) if nulls_first else work[c].isna()
+            )
+            sort_cols.extend([f"n_{c}", c])
+            sort_asc.extend([True, o.asc])
+        if sort_cols:
+            order = work.sort_values(
+                sort_cols, ascending=sort_asc, kind="stable"
+            ).index
+        else:
+            order = idx
+        sw0 = work.loc[order]
+
+        def _same_as_prev(col: str) -> pd.Series:
+            s = sw0[col]
+            prev = s.shift()
+            return (s == prev).fillna(False) | (s.isna() & prev.isna())
+
+        if len(sw0) > 0:
+            same_part = pd.Series(True, index=sw0.index)
+            for c in pcols:
+                same_part &= _same_as_prev(c)
+            same_part.iloc[0] = False
+            part_id = (~same_part).cumsum()
+            same_order = pd.Series(True, index=sw0.index)
+            for c in ocols:
+                same_order &= _same_as_prev(c)
+            is_peer = same_part & same_order
+            peer_id = (~is_peer).cumsum()
+        else:
+            same_part = part_id = is_peer = peer_id = pd.Series(
+                [], dtype="int64"
+            )
+        wcache[ckey] = (order, same_part, part_id, is_peer, peer_id)
+
+    n = len(order)
+    if n == 0:
+        # empty input: keep the same output TYPE a non-empty input gives
+        if name in ("row_number", "rank", "dense_rank", "count"):
+            tp0: Optional[pa.DataType] = pa.int64()
+        elif name in ("avg", "mean"):
+            tp0 = pa.float64()
+        else:
+            args0 = e.func.args
+            if len(args0) >= 1 and not isinstance(args0[0], ast.Star):
+                atp = ev.eval(args0[0]).dtype
+            else:
+                atp = pa.int64()
+            if name == "sum":
+                tp0 = (
+                    pa.int64()
+                    if atp is not None and pa.types.is_integer(atp)
+                    else pa.float64()
+                )
+            else:  # min/max/lag/lead/first/last: the argument's type
+                tp0 = atp
+        return _TS(pd.Series([], index=idx, dtype=object), tp0)
+    grp = part_id.groupby(part_id)
+    rn = grp.cumcount() + 1
+
+    def _back(s: pd.Series, tp: Optional[pa.DataType]) -> _TS:
+        return _TS(s.reindex(idx), tp)
+
+    if name == "row_number":
+        if not e.order_by:
+            raise SQLExecutionError("row_number() requires ORDER BY")
+        return _back(rn.astype("int64"), pa.int64())
+    if name in ("rank", "dense_rank"):
+        if not e.order_by:
+            raise SQLExecutionError(f"{name}() requires ORDER BY")
+        if name == "rank":
+            r = rn.where(~is_peer).groupby(part_id).ffill()
+        else:
+            r = (~is_peer).astype("int64").groupby(part_id).cumsum()
+        return _back(r.astype("int64"), pa.int64())
+    if name in ("lag", "lead"):
+        if len(e.func.args) < 1 or len(e.func.args) > 3 or isinstance(
+            e.func.args[0], ast.Star
+        ):
+            raise SQLExecutionError(f"{name} takes (expr[, offset[, default]])")
+        offset = 1
+        default: Any = None
+        if len(e.func.args) >= 2:
+            ov = _literal_value(e.func.args[1])
+            if not isinstance(ov, int) or isinstance(ov, bool):
+                raise SQLExecutionError(f"{name} offset must be an int literal")
+            offset = ov
+        if len(e.func.args) == 3:
+            default = _literal_value(e.func.args[2])
+            if default is _NOT_LITERAL:
+                raise SQLExecutionError(f"{name} default must be a literal")
+        if offset < 0:
+            raise SQLExecutionError(f"{name} offset must be >= 0")
+        vts = ev.eval(e.func.args[0])
+        vs = vts.series.loc[order]
+        shifted = vs.groupby(part_id).shift(offset if name == "lag" else -offset)
+        if default is not None:
+            # the default fills only OUT-OF-PARTITION positions; a shifted-in
+            # NULL source value stays NULL (review finding)
+            if name == "lag":
+                oob = rn <= offset
+            else:
+                psize = grp.transform("size")
+                oob = rn > psize - offset
+            shifted = shifted.where(~oob, default)
+        return _back(shifted, vts.dtype)
+
+    # aggregates over the window
+    star = len(e.func.args) == 1 and isinstance(e.func.args[0], ast.Star)
+    if star:
+        if name != "count":
+            raise SQLExecutionError(f"{name}(*) is not valid")
+        vs = pd.Series(1, index=order)
+        vts_tp: Optional[pa.DataType] = pa.int64()
+    else:
+        if len(e.func.args) != 1:
+            raise SQLExecutionError(f"window {name} takes one argument")
+        vts = ev.eval(e.func.args[0])
+        vs = vts.series.loc[order]
+        vts_tp = vts.dtype
+    sum_tp = (
+        pa.int64()
+        if vts_tp is not None and pa.types.is_integer(vts_tp)
+        else pa.float64()
+    )
+
+    def _positional_pick(group_id: pd.Series, first: bool) -> pd.Series:
+        """POSITIONAL first/last value per group — unlike pandas
+        transform('first'/'last'), a NULL boundary row yields NULL
+        (review finding; matches _agg_result's iloc semantics)."""
+        new_group = group_id != group_id.shift()
+        marker = new_group if first else new_group.shift(-1, fill_value=True)
+        mapping = pd.Series(
+            vs[marker].values, index=group_id[marker].values
+        )
+        return group_id.map(mapping)
+
+    if not e.order_by:
+        g = vs.groupby(part_id)
+        if name == "count":
+            r = (
+                g.transform("size")
+                if star
+                else vs.notna().groupby(part_id).transform("sum")
+            )
+            return _back(r.astype("int64"), pa.int64())
+        if name in ("sum", "avg", "mean"):
+            cnt = vs.notna().groupby(part_id).transform("sum")
+            tot = vs.fillna(0).groupby(part_id).transform("sum")
+            if name == "sum":
+                return _back(tot.where(cnt > 0), sum_tp)
+            return _back(
+                (tot / cnt).where(cnt > 0), pa.float64()
+            )
+        if name in ("min", "max"):
+            r = g.transform(name)
+            return _back(r, vts_tp)
+        if name in ("first", "first_value", "last", "last_value"):
+            r = _positional_pick(part_id, first=name.startswith("first"))
+            return _back(r, vts_tp)
+        raise SQLExecutionError(f"unsupported window aggregate {name}")
+    # running (default-frame) aggregates; peers share the group's last value
+    cnt = (
+        grp.cumcount() + 1
+        if star
+        else vs.notna().astype("int64").groupby(part_id).cumsum()
+    )
+    if name == "count":
+        r = cnt
+    elif name in ("sum", "avg", "mean"):
+        tot = vs.fillna(0).groupby(part_id).cumsum()
+        r = tot.where(cnt > 0) if name == "sum" else (tot / cnt).where(cnt > 0)
+    elif name in ("min", "max"):
+        r = getattr(vs.groupby(part_id), f"cum{name}")()
+        # cummin/cummax leave NaN AT null positions; SQL's null-ignoring
+        # frame carries the prior extremum forward (review finding)
+        r = r.groupby(part_id).ffill()
+    elif name in ("first", "first_value"):
+        r = _positional_pick(part_id, first=True)
+    elif name in ("last", "last_value"):
+        # frame ends at the current row's peer group: its last row's value
+        r = _positional_pick(peer_id, first=False)
+    else:
+        raise SQLExecutionError(f"unsupported running window {name}")
+    r = r.groupby(peer_id).transform("last")
+    tp = (
+        pa.int64()
+        if name == "count"
+        else (
+            sum_tp
+            if name == "sum"
+            else (pa.float64() if name in ("avg", "mean") else vts_tp)
+        )
+    )
+    return _back(r, tp)
 
 
 def _collect_aggs(e: ast.Expr, out: List[ast.Func]) -> None:
@@ -877,6 +1160,8 @@ def _run_select(q: ast.Select, env: Dict[str, _Table]) -> _Table:
     if q.where is not None:
         if _contains_agg(q.where):
             raise SQLExecutionError("WHERE cannot contain aggregations")
+        if _contains_window(q.where):
+            raise SQLExecutionError("WHERE cannot contain window functions")
         mask = _to_bool_mask(_Evaluator(scope).eval(q.where).series)
         scope = _Scope(scope.frame[mask], scope.entries)
 
@@ -888,6 +1173,17 @@ def _run_select(q: ast.Select, env: Dict[str, _Table]) -> _Table:
         )
         or (q.having is not None)
     )
+    if has_agg and (
+        _contains_window(q.having)
+        or any(_contains_window(g) for g in q.group_by)
+        or any(
+            not isinstance(it.expr, ast.Star) and _contains_window(it.expr)
+            for it in q.items
+        )
+    ):
+        raise SQLExecutionError(
+            "window functions over aggregated output are not supported"
+        )
     resolver: Optional[Callable[[ast.Expr], _TS]]
     if has_agg:
         out, resolver = _run_agg_select(q, scope)

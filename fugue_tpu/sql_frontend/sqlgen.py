@@ -245,4 +245,27 @@ class _Gen:
             self.expr(e.operand, ctes)
             self.emit(" IS NOT NULL)" if e.negated else " IS NULL)")
             return
+        if isinstance(e, ast.Window):
+            self.expr(e.func, ctes)
+            self.emit(" OVER (")
+            if e.partition_by:
+                self.emit("PARTITION BY ")
+                for i, p in enumerate(e.partition_by):
+                    if i > 0:
+                        self.emit(", ")
+                    self.expr(p, ctes)
+            if e.order_by:
+                if e.partition_by:
+                    self.emit(" ")
+                self.emit("ORDER BY ")
+                for i, o in enumerate(e.order_by):
+                    if i > 0:
+                        self.emit(", ")
+                    self.expr(o.expr, ctes)
+                    if not o.asc:
+                        self.emit(" DESC")
+                    if o.nulls is not None:
+                        self.emit(f" NULLS {o.nulls}")
+            self.emit(")")
+            return
         raise ValueError(f"cannot serialize {type(e).__name__}")

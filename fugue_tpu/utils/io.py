@@ -97,6 +97,12 @@ def _load_single(
         read_opts = pacsv.ReadOptions()
         convert_opts = pacsv.ConvertOptions()
         if isinstance(columns, str):
+            assert_or_throw(
+                not infer,
+                ValueError(
+                    "can't set typed columns together with infer_schema=True"
+                ),
+            )
             schema = Schema(columns)
         names: Optional[List[str]] = None
         if not header:

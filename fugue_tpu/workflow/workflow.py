@@ -500,6 +500,16 @@ class FugueWorkflow:
     def create(
         self, using: Any, schema: Any = None, params: Any = None
     ) -> WorkflowDataFrame:
+        import pandas as pd
+
+        if isinstance(using, (DataFrame, pd.DataFrame)):
+            # a dataframe input IS the data: identical spec (and uuid) to
+            # dag.df(data) — reference builtin_suite.py:106 equivalence
+            assert_or_throw(
+                params is None or len(ParamDict(params)) == 0,
+                ValueError("params not allowed when creating from a dataframe"),
+            )
+            return self.create_data(using, schema)
         task = CreateTask(using, params=ParamDict(params), schema=schema)
         return self.add(task)
 

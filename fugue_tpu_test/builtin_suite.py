@@ -622,3 +622,134 @@ class BuiltInTests:
             a.transform(bad, schema="*")
             with pytest.raises(RuntimeError, match="user error"):
                 self.run(dag)
+
+        # ---- df-level column ops (reference builtin_suite test_col_ops) --
+        def test_col_ops(self):
+            dag = self.dag()
+            a = dag.df([[1, 10], [2, 20]], "x:long,y:long")
+            aa = dag.df([[1, 10], [2, 20]], "xx:long,y:long")
+            a.rename({"x": "xx"}).assert_eq(aa)
+            a[["x"]].assert_eq(ArrayDataFrame([[1], [2]], "x:long"))
+            a.drop(["y", "yy"], if_exists=True).assert_eq(
+                ArrayDataFrame([[1], [2]], "x:long")
+            )
+            a[["x"]].rename({"x": "xx"}).assert_eq(
+                ArrayDataFrame([[1], [2]], "xx:long")
+            )
+            a.alter_columns("x:str").assert_eq(
+                ArrayDataFrame([["1", 10], ["2", 20]], "x:str,y:long")
+            )
+            self.run(dag)
+
+        def test_create_df_equivalence(self):
+            # dag.df and dag.create of the same engine frame build the SAME
+            # deterministic spec (reference builtin_suite.py:106)
+            src = self.engine.to_df(pd.DataFrame([[0]], columns=["a"]))
+            dag1 = FugueWorkflow()
+            dag1.df(src).show()
+            dag2 = FugueWorkflow()
+            dag2.create(src).show()
+            assert dag1.__uuid__() == dag2.__uuid__()
+
+        def test_transform_binary(self):
+            # bytes columns round-trip through transformers (reference
+            # builtin_suite.py:504)
+            def tf(rows: Iterable[List[Any]]) -> Iterable[List[Any]]:
+                for r in rows:
+                    obj = pickle.loads(r[1])
+                    obj[0] += r[0]
+                    obj[1] += "x"
+                    yield [r[0], pickle.dumps(obj)]
+
+            dag = self.dag()
+            a = dag.df([[1, pickle.dumps([0, "a"])]], "a:int,b:bytes")
+            c = a.transform(tf, schema="*").persist()
+            dag.df([[1, pickle.dumps([1, "ax"])]], "a:int,b:bytes").assert_eq(c)
+            self.run(dag)
+
+        def test_transform_iterable_dfs(self):
+            # Iterable[pd.DataFrame] -> Iterator[pd.DataFrame], including
+            # empty generators with and without partitioning (reference
+            # builtin_suite.py:441 — the mapInPandas-critical shape)
+            from typing import Iterator
+
+            import pyarrow as pa
+
+            # schema: *,c:int
+            def mt_pandas(
+                dfs: Iterable[pd.DataFrame], empty: bool = False
+            ) -> Iterator[pd.DataFrame]:
+                for df in dfs:
+                    if not empty:
+                        yield df.assign(c=2)
+
+            dag = self.dag()
+            a = dag.df([[1, 2], [3, 4]], "a:int,b:int")
+            a.transform(mt_pandas).assert_eq(
+                ArrayDataFrame([[1, 2, 2], [3, 4, 2]], "a:int,b:int,c:int")
+            )
+            a.transform(mt_pandas, params=dict(empty=True)).assert_eq(
+                ArrayDataFrame([], "a:int,b:int,c:int")
+            )
+            a.partition(by=["a"]).transform(
+                mt_pandas, params=dict(empty=True)
+            ).assert_eq(ArrayDataFrame([], "a:int,b:int,c:int"))
+            self.run(dag)
+
+            # schema: a:long
+            def mt_arrow(dfs: Iterable[pa.Table]) -> Iterator[pa.Table]:
+                for df in dfs:
+                    yield df.drop_columns(["b"])
+
+            dag = self.dag()
+            a = dag.df([[1, 2], [3, 4]], "a:long,b:int")
+            a.transform(mt_arrow).assert_eq(
+                ArrayDataFrame([[1], [3]], "a:long")
+            )
+            self.run(dag)
+
+        def test_out_transform_annotations(self):
+            # the out_transform annotation matrix (reference
+            # builtin_suite.py:400-792): pandas, iterable-of-lists,
+            # iterable-of-pandas, arrow, and Transformer-class variants
+            from typing import Iterator
+
+            import pyarrow as pa
+
+            hits: List[str] = []
+
+            def t_pandas(df: pd.DataFrame) -> None:
+                hits.append("pandas")
+
+            def t_rows(rows: Iterable[List[Any]]) -> None:
+                for _ in rows:
+                    pass
+                hits.append("rows")
+
+            def t_iter_pd(dfs: Iterable[pd.DataFrame]) -> None:
+                for _ in dfs:
+                    pass
+                hits.append("iter_pd")
+
+            def t_arrow(df: pa.Table) -> None:
+                hits.append("arrow")
+
+            def t_iter_arrow(dfs: Iterable[pa.Table]) -> None:
+                for _ in dfs:
+                    pass
+                hits.append("iter_arrow")
+
+            # yields are consumed and discarded by out_transform
+            def t_gen(df: pd.DataFrame) -> Iterator[pd.DataFrame]:
+                hits.append("gen")
+                yield df
+
+            dag = self.dag()
+            a = dag.df([[1, 2], [3, 4]], "a:int,b:int")
+            for f in (t_pandas, t_rows, t_iter_pd, t_arrow, t_iter_arrow):
+                a.out_transform(f)
+            a.out_transform(t_gen)
+            self.run(dag)
+            assert set(hits) >= {
+                "pandas", "rows", "iter_pd", "arrow", "iter_arrow", "gen"
+            }, hits

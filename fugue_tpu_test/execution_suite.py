@@ -501,6 +501,111 @@ class ExecutionEngineTests:
                 "k:long,y:str,v:double", throw=True,
             )
 
+        def test_save_single_and_load_parquet(self, tmp_path):
+            # the reference save_single matrix (execution_suite.py:991):
+            # overwrite a folder with a single file, then a single file
+            # with a new save
+            e = self.engine
+            b = e.to_df([[6, 1], [2, 7]], "c:int,a:long")
+            path = os.path.join(str(tmp_path), "a", "b")
+            os.makedirs(path)
+            e.save_df(b, path, format_hint="parquet", force_single=True)
+            assert os.path.isfile(path)
+            c = e.load_df(path, format_hint="parquet", columns=["a", "c"])
+            assert df_eq(c, [[1, 6], [7, 2]], "a:long,c:int", throw=True)
+            b2 = e.to_df([[60, 1], [20, 7]], "c:int,a:long")
+            e.save_df(b2, path, format_hint="parquet", mode="overwrite")
+            c = e.load_df(path, format_hint="parquet", columns=["a", "c"])
+            assert df_eq(c, [[1, 60], [7, 20]], "a:long,c:int", throw=True)
+
+        def test_save_single_and_load_csv(self, tmp_path):
+            # reference execution_suite.py:1040 — the header matrix
+            e = self.engine
+            b = e.to_df([[6.1, 1.1], [2.1, 7.1]], "c:double,a:double")
+            path = os.path.join(str(tmp_path), "a", "b")
+            os.makedirs(path)
+            e.save_df(b, path, format_hint="csv", header=True,
+                      force_single=True)
+            assert os.path.isfile(path)
+            c = e.load_df(path, format_hint="csv", header=True,
+                          infer_schema=False)
+            assert df_eq(
+                c, [["6.1", "1.1"], ["2.1", "7.1"]], "c:str,a:str",
+                throw=True,
+            )
+            c = e.load_df(path, format_hint="csv", header=True,
+                          infer_schema=True)
+            assert df_eq(
+                c, [[6.1, 1.1], [2.1, 7.1]], "c:double,a:double", throw=True
+            )
+            with pytest.raises(ValueError):
+                # typed columns conflict with infer_schema=True
+                e.load_df(path, format_hint="csv", header=True,
+                          infer_schema=True, columns="c:str,a:str")
+            c = e.load_df(path, format_hint="csv", header=True,
+                          infer_schema=False, columns=["a", "c"])
+            assert df_eq(
+                c, [["1.1", "6.1"], ["7.1", "2.1"]], "a:str,c:str",
+                throw=True,
+            )
+            c = e.load_df(path, format_hint="csv", header=True,
+                          infer_schema=False, columns="a:double,c:double")
+            assert df_eq(
+                c, [[1.1, 6.1], [7.1, 2.1]], "a:double,c:double", throw=True
+            )
+
+        def test_save_single_and_load_csv_no_header(self, tmp_path):
+            # reference execution_suite.py:1101
+            e = self.engine
+            b = e.to_df([[6.1, 1.1], [2.1, 7.1]], "c:double,a:double")
+            path = os.path.join(str(tmp_path), "a", "b")
+            os.makedirs(path)
+            e.save_df(b, path, format_hint="csv", header=False,
+                      force_single=True)
+            assert os.path.isfile(path)
+            with pytest.raises(ValueError):
+                # headerless csv requires columns
+                e.load_df(path, format_hint="csv", header=False,
+                          infer_schema=False)
+            c = e.load_df(path, format_hint="csv", header=False,
+                          infer_schema=False, columns=["c", "a"])
+            assert df_eq(
+                c, [["6.1", "1.1"], ["2.1", "7.1"]], "c:str,a:str",
+                throw=True,
+            )
+            c = e.load_df(path, format_hint="csv", header=False,
+                          infer_schema=True, columns=["c", "a"])
+            assert df_eq(
+                c, [[6.1, 1.1], [2.1, 7.1]], "c:double,a:double", throw=True
+            )
+            with pytest.raises(ValueError):
+                e.load_df(path, format_hint="csv", header=False,
+                          infer_schema=True, columns="c:double,a:double")
+
+        def test_save_single_and_load_json(self, tmp_path):
+            # reference execution_suite.py:1206
+            e = self.engine
+            b = e.to_df([[6, 1], [2, 7]], "c:int,a:long")
+            path = os.path.join(str(tmp_path), "a", "b")
+            os.makedirs(path)
+            e.save_df(b, path, format_hint="json", force_single=True)
+            assert os.path.isfile(path)
+            c = e.load_df(path, format_hint="json", columns=["a", "c"])
+            assert df_eq(c, [[1, 6], [7, 2]], "a:long,c:long", throw=True)
+
+        def test_load_parquet_files_list(self, tmp_path):
+            # reference execution_suite.py:1026 — explicit file lists
+            e = self.engine
+            f1 = os.path.join(str(tmp_path), "a.parquet")
+            f2 = os.path.join(str(tmp_path), "b.parquet")
+            e.save_df(e.to_df([[6, 1]], "c:int,a:long"), f1)
+            e.save_df(e.to_df([[2, 7], [4, 8]], "c:int,a:long"), f2)
+            c = e.load_df([f1, f2], format_hint="parquet",
+                          columns=["a", "c"])
+            assert df_eq(
+                c, [[1, 6], [7, 2], [8, 4]], "a:long,c:int", throw=True
+            )
+
         def test_sample_replace_and_seed(self):
             e = self.engine
             a = e.to_df([[i] for i in range(50)], "x:long")
