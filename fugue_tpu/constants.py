@@ -26,6 +26,7 @@ FUGUE_CONF_JAX_DEVICE_ZIP = "fugue.jax.device_zip"
 FUGUE_CONF_JAX_PLACEMENT = "fugue.jax.placement"
 FUGUE_CONF_JAX_MIN_DEVICE_BYTES = "fugue.jax.placement.min_device_bytes"
 FUGUE_CONF_JAX_COMPILE_CACHE = "fugue.jax.compile.cache"
+FUGUE_CONF_JAX_GROUPBY_MATMUL = "fugue.jax.groupby.matmul"
 
 FUGUE_COMPILE_TIME_CONFIGS = {
     FUGUE_CONF_WORKFLOW_EXCEPTION_HIDE,
@@ -51,6 +52,11 @@ _DEFAULT_CONF: Dict[str, Any] = {
     # GB; on PCIe-local TPU hosts set a lower threshold or placement=device.
     FUGUE_CONF_JAX_PLACEMENT: "auto",
     FUGUE_CONF_JAX_MIN_DEVICE_BYTES: 256 * 1024 * 1024,
+    # group-by reduction algorithm: "auto" rides the one-hot matmul on
+    # accelerators (MXU: scatter serializes, matmul does not — measured
+    # 50x) and the scatter segment-sum on CPU meshes (the one-hot
+    # transient thrashes CPU memory bandwidth); "always"/"never" pin it.
+    FUGUE_CONF_JAX_GROUPBY_MATMUL: "auto",
 }
 
 _GLOBAL_CONF = ParamDict(_DEFAULT_CONF)

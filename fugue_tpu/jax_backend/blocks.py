@@ -143,6 +143,17 @@ def row_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("p"))
 
 
+def on_mesh(mesh: Mesh) -> Any:
+    """Context manager pinning EAGER jnp array creation to the mesh's
+    backend. Without it, eager ``jnp.arange``/``ones``/``concatenate``
+    land on the process default device — on a TPU process operating a
+    HOST-tier frame that silently bounces arrays through the accelerator
+    link (measured: a 5M-row eager validity() cost 123ms over the tunnel
+    vs <5ms local). Jitted programs don't need this: they follow their
+    inputs' placement."""
+    return jax.default_device(mesh.devices.flat[0])
+
+
 def padded_len(n: int, ndev: int) -> int:
     if n == 0:
         return ndev
@@ -229,7 +240,10 @@ class JaxBlocks:
         if self.row_valid is not None:
             return self.row_valid
         pad_n = self.padded_nrows
-        return jnp.arange(pad_n, dtype=jnp.int32) < jnp.int32(self._nrows)
+        with on_mesh(self.mesh):
+            return jnp.arange(pad_n, dtype=jnp.int32) < jnp.int32(
+                self._nrows
+            )
 
     @property
     def is_prefix_layout(self) -> bool:
@@ -427,7 +441,9 @@ def gather_indices(blocks: JaxBlocks, idx: Any, schema: Schema) -> JaxBlocks:
     device_cols = {n: c for n, c in blocks.columns.items() if c.on_device}
     datas = {n: c.data for n, c in device_cols.items()}
     masks = {n: c.mask for n, c in device_cols.items() if c.mask is not None}
-    out_d, out_m = _gather_program(pad_n)(datas, masks, jnp.asarray(idx))
+    with on_mesh(mesh):
+        idx_dev = jnp.asarray(idx)
+    out_d, out_m = _gather_program(pad_n)(datas, masks, idx_dev)
     cols: Dict[str, JaxColumn] = {}
     for name, col in blocks.columns.items():
         if not col.on_device:

@@ -1519,6 +1519,7 @@ class JaxExecutionEngine(ExecutionEngine):
         if (
             bspec is not None
             and bspec.total <= groupby._MATMUL_MAX_SEGMENTS
+            and self._prefer_matmul(blocks)
             and all(
                 self._matmul_agg_ok(jdf, func, arg)
                 for _, func, arg, _ in typed_plans
@@ -1700,6 +1701,23 @@ class JaxExecutionEngine(ExecutionEngine):
                     bounded, PartitionSpec(by=list(keys)), agg_cols
                 )
             )
+
+    def _prefer_matmul(self, blocks: JaxBlocks) -> bool:
+        """Whether this frame's mesh should take the one-hot matmul
+        group-by. ``auto``: accelerators yes (MXU — scatter serializes
+        there, measured 50x worse), CPU meshes no (the (chunk, segments)
+        one-hot transient is pure memory-bandwidth waste on CPU; scatter
+        segment-sum wins ~10x at bench scale)."""
+        from fugue_tpu.constants import FUGUE_CONF_JAX_GROUPBY_MATMUL
+
+        mode = str(
+            self.conf.get(FUGUE_CONF_JAX_GROUPBY_MATMUL, "auto")
+        ).lower()
+        if mode == "always":
+            return True
+        if mode == "never":
+            return False
+        return blocks.mesh.devices.flat[0].platform != "cpu"
 
     def _matmul_agg_ok(
         self, jdf: JaxDataFrame, func: str, arg: Any

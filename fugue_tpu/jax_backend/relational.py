@@ -33,6 +33,7 @@ from fugue_tpu.jax_backend import groupby
 from fugue_tpu.jax_backend.blocks import (
     JaxBlocks,
     JaxColumn,
+    on_mesh,
     padded_len,
     row_sharding,
 )
@@ -42,6 +43,25 @@ from fugue_tpu.utils.assertion import assert_or_throw
 
 def _common_dtype(d1: Any, d2: Any) -> Any:
     return jnp.result_type(d1, d2)
+
+
+def _mesh_scoped(pos: int) -> Any:
+    """Run the decorated function under ``on_mesh(args[pos].mesh)`` so its
+    EAGER jnp creations (zeros/arange/asarray fed into jitted programs)
+    stay on the frame's backend instead of the process default device —
+    on a TPU process with host-tier frames the default device is across
+    a network link (see blocks.on_mesh)."""
+    import functools
+
+    def deco(fn: Any) -> Any:
+        @functools.wraps(fn)
+        def wrapper(*args: Any, **kwargs: Any) -> Any:
+            with on_mesh(args[pos].mesh):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
 
 
 def harmonize_string_keys(
@@ -82,6 +102,7 @@ def _merged_stats(
     return (min(c1.stats[0], c2.stats[0]), max(c1.stats[1], c2.stats[1]))
 
 
+@_mesh_scoped(0)
 def concat_key_blocks(
     b1: JaxBlocks, b2: JaxBlocks, keys: List[str]
 ) -> Tuple[JaxBlocks, int, int]:
@@ -184,6 +205,7 @@ def device_joinable(
 # ---------------------------------------------------------------------------
 
 
+@_mesh_scoped(1)
 def semi_anti_join(
     engine: Any, b1: JaxBlocks, b2: JaxBlocks, keys: List[str], anti: bool
 ) -> JaxBlocks:
@@ -239,6 +261,7 @@ def semi_anti_join(
 # ---------------------------------------------------------------------------
 
 
+@_mesh_scoped(1)
 def expand_join(
     engine: Any,
     b1: JaxBlocks,
@@ -342,6 +365,11 @@ def expand_join(
             d1[k] = c1h
             key_cols2[k] = c2h
 
+    # expansion index algorithm: searchsorted vectorizes on accelerators;
+    # on CPU meshes the equivalent scatter+cumsum is ~7x faster (binary
+    # search over 5M boundaries is cache-hostile; measured 417ms vs 57ms)
+    on_cpu = mesh.devices.flat[0].platform == "cpu"
+
     def _gather_prog(
         datas1: Dict[str, Any],
         masks1: Dict[str, Any],
@@ -354,9 +382,16 @@ def expand_join(
         seg1_: Any,
     ) -> Tuple[Dict[str, Any], Dict[str, Any], Dict[str, Any], Dict[str, Any], Any]:
         t = jnp.arange(out_pad, dtype=jnp.int32)
-        i = (
-            jnp.searchsorted(start_, t, side="right").astype(jnp.int32) - 1
-        )
+        if on_cpu:
+            marks = jnp.zeros((out_pad,), jnp.int32).at[start_].add(
+                1, mode="drop"
+            )
+            i = jnp.cumsum(marks) - 1
+        else:
+            i = (
+                jnp.searchsorted(start_, t, side="right").astype(jnp.int32)
+                - 1
+            )
         i = jnp.clip(i, 0, p1 - 1)
         j_local = t - start_[i]
         matched = j_local < m_[i]
@@ -380,6 +415,7 @@ def expand_join(
             p1,
             p2,
             out_pad,
+            on_cpu,
             tuple(sorted(d1)),
             tuple(sorted(d2)),
             tuple(sorted(n for n, c in d1.items() if c.mask is not None)),
@@ -420,6 +456,7 @@ def expand_join(
     return out
 
 
+@_mesh_scoped(1)
 def _gather_right_unmatched(
     engine: Any,
     b1: JaxBlocks,
@@ -528,6 +565,7 @@ def _null_device_dtype(tp: pa.DataType) -> Any:
 # ---------------------------------------------------------------------------
 
 
+@_mesh_scoped(0)
 def union_all_blocks(b1: JaxBlocks, b2: JaxBlocks) -> JaxBlocks:
     """Concatenate two frames along the row axis. Padding rows of each side
     remain invalid under the combined mask — no compaction, no sync."""
@@ -577,6 +615,7 @@ def union_all_blocks(b1: JaxBlocks, b2: JaxBlocks) -> JaxBlocks:
     )
 
 
+@_mesh_scoped(1)
 def intersect_subtract(
     engine: Any,
     b1: JaxBlocks,
@@ -679,6 +718,7 @@ def _encode_fill_value(col: JaxColumn, value: Any) -> Optional[Any]:
         return None
 
 
+@_mesh_scoped(1)
 def device_fillna(
     engine: Any,
     blocks: JaxBlocks,
@@ -758,6 +798,7 @@ def device_fillna(
     )
 
 
+@_mesh_scoped(0)
 def _sort_code_columns(
     blocks: JaxBlocks, sorts: List[Tuple[str, bool]]
 ) -> Optional[List[Tuple[Any, Optional[Any], bool]]]:
@@ -824,6 +865,7 @@ def _stable_sort_order(
     return order
 
 
+@_mesh_scoped(1)
 def device_take(
     engine: Any,
     blocks: JaxBlocks,
@@ -911,6 +953,7 @@ def device_take(
     )
 
 
+@_mesh_scoped(1)
 def device_sort(
     engine: Any,
     blocks: JaxBlocks,
@@ -978,6 +1021,7 @@ def device_sort(
     return gather_indices(blocks, order[start:stop], schema)
 
 
+@_mesh_scoped(1)
 def device_window(
     engine: Any,
     blocks: JaxBlocks,
@@ -1172,6 +1216,7 @@ def _window_segment_agg(
     )
 
 
+@_mesh_scoped(1)
 def device_sample(
     engine: Any,
     blocks: JaxBlocks,

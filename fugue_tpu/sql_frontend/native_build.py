@@ -42,12 +42,14 @@ def _build() -> Optional[str]:
             return so
         os.makedirs(_BUILD_DIR, exist_ok=True)
         include = sysconfig.get_path("include")
+        # pid-unique temp + atomic rename (see native_parse._build)
+        tmp = f"{so}.{os.getpid()}.tmp"
         cmd = [
             "g++", "-O2", "-shared", "-fPIC", f"-I{include}", _SRC, "-o",
-            so + ".tmp",
+            tmp,
         ]
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(so + ".tmp", so)
+        os.replace(tmp, so)
         return so
     except Exception:
         return None

@@ -1,0 +1,194 @@
+"""C++ parser integration (the FULL-parse half of the reference's
+``fugue-sql-antlr[cpp]`` role — reference README.md:162 "can be 50+
+times faster"; the scanner half lives in native_build.py).
+
+``native/cparser.cpp`` lexes AND parses in native code and returns a
+generic tree of tuples; :func:`try_native_parse` rebuilds ast.* nodes
+from it. Any construct the C++ side cannot handle identically makes it
+return None and the pure-Python parser takes over, so behavior —
+including error messages on bad SQL — never diverges. AST equality over
+the corpus is enforced by tests/.../test_native_parser.py.
+
+Set ``FUGUE_TPU_NO_NATIVE=1`` to skip entirely.
+"""
+
+import hashlib
+import importlib.util
+import os
+import subprocess
+import sysconfig
+from typing import Any, List, Optional, Tuple
+
+from fugue_tpu.sql_frontend import ast
+
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_SRC = os.path.join(_REPO, "native", "cparser.cpp")
+_BUILD_DIR = os.path.join(_REPO, "native", "_build")
+_STATE: dict = {"tried": False, "parse": None}
+
+
+def _build() -> Optional[str]:
+    try:
+        with open(_SRC, "rb") as fp:
+            src_hash = hashlib.sha256(fp.read()).hexdigest()[:16]
+        so = os.path.join(
+            _BUILD_DIR, f"_fugue_tpu_cparser_{src_hash}.so"
+        )
+        if os.path.exists(so):
+            return so
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        include = sysconfig.get_path("include")
+        # pid-unique temp + atomic rename: concurrent first-use builds
+        # (e.g. parallel test workers) must not install a half-written
+        # .so that the hash-existence check would then trust forever
+        tmp = f"{so}.{os.getpid()}.tmp"
+        cmd = [
+            "g++", "-O2", "-shared", "-fPIC", f"-I{include}", _SRC,
+            "-o", tmp,
+        ]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=180)
+        os.replace(tmp, so)
+        return so
+    except Exception:
+        return None
+
+
+def enable_native_parser() -> bool:
+    """Idempotent; returns True when the C++ parser is loaded."""
+    if _STATE["tried"]:
+        return _STATE["parse"] is not None
+    _STATE["tried"] = True
+    if os.environ.get("FUGUE_TPU_NO_NATIVE", "").lower() in ("1", "true"):
+        return False
+    so = _build()
+    if so is None:
+        return False
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "_fugue_tpu_cparser", so
+        )
+        mod = importlib.util.module_from_spec(spec)  # type: ignore[arg-type]
+        spec.loader.exec_module(mod)  # type: ignore[union-attr]
+        _STATE["parse"] = mod.parse  # type: ignore[attr-defined]
+        return True
+    except Exception:
+        return False
+
+
+def native_parser_active() -> bool:
+    return _STATE["parse"] is not None
+
+
+def try_native_parse(sql: str) -> Optional[ast.Query]:
+    """Parse with the C++ parser; None = use the Python parser."""
+    fn = _STATE["parse"]
+    if fn is None:
+        return None
+    try:
+        tree = fn(sql)
+        if tree is None:
+            return None
+        return _query(tree)
+    except Exception:
+        return None  # defensive: python path owns errors
+
+
+# ---- generic tree -> ast -------------------------------------------------
+
+
+def _query(t: Any) -> ast.Query:
+    tag = t[0]
+    if tag == "with":
+        return ast.With(
+            [(name, _query(sub)) for name, sub in t[1]], _query(t[2])
+        )
+    if tag == "setop_tail":
+        inner = _query(t[1])
+        assert isinstance(inner, ast.SetOp)
+        inner.order_by = [_order(o) for o in t[2]]
+        inner.limit = t[3]
+        inner.offset = t[4]
+        return inner
+    if tag == "setop":
+        return ast.SetOp(t[1], t[2], _query(t[3]), _query(t[4]))
+    if tag == "select":
+        (_, items, from_, where, group, having, order, limit, offset,
+         distinct) = t
+        return ast.Select(
+            [_item(i) for i in items],
+            None if from_ is None else _relation(from_),
+            None if where is None else _expr(where),
+            [_expr(g) for g in group],
+            None if having is None else _expr(having),
+            [_order(o) for o in order],
+            limit,
+            offset,
+            distinct,
+        )
+    raise ValueError(f"bad query tag {tag}")
+
+
+def _item(t: Any) -> ast.SelectItem:
+    return ast.SelectItem(_expr(t[1]), t[2])
+
+
+def _order(t: Any) -> ast.OrderItem:
+    return ast.OrderItem(_expr(t[1]), t[2], t[3])
+
+
+def _relation(t: Any) -> ast.Relation:
+    tag = t[0]
+    if tag == "table":
+        return ast.TableRef(t[1], t[2])
+    if tag == "subq":
+        return ast.SubqueryRef(_query(t[1]), t[2])
+    if tag == "join":
+        return ast.JoinRel(
+            _relation(t[1]),
+            _relation(t[2]),
+            t[3],
+            None if t[4] is None else _expr(t[4]),
+            None if t[5] is None else list(t[5]),
+        )
+    raise ValueError(f"bad relation tag {tag}")
+
+
+def _expr(t: Any) -> ast.Expr:
+    tag = t[0]
+    if tag == "lit":
+        return ast.Lit(t[1])
+    if tag == "col":
+        return ast.Col(t[1], t[2])
+    if tag == "star":
+        return ast.Star(t[1])
+    if tag == "unary":
+        return ast.Unary(t[1], _expr(t[2]))
+    if tag == "bin":
+        return ast.Binary(t[1], _expr(t[2]), _expr(t[3]))
+    if tag == "func":
+        return ast.Func(t[1], [_expr(a) for a in t[2]], t[3])
+    if tag == "case":
+        return ast.Case(
+            None if t[1] is None else _expr(t[1]),
+            [(_expr(c), _expr(v)) for c, v in t[2]],
+            None if t[3] is None else _expr(t[3]),
+        )
+    if tag == "cast":
+        return ast.Cast(_expr(t[1]), t[2])
+    if tag == "inlist":
+        return ast.InList(_expr(t[1]), [_expr(i) for i in t[2]], t[3])
+    if tag == "between":
+        return ast.Between(_expr(t[1]), _expr(t[2]), _expr(t[3]), t[4])
+    if tag == "like":
+        return ast.Like(_expr(t[1]), _expr(t[2]), t[3])
+    if tag == "isnull":
+        return ast.IsNull(_expr(t[1]), t[2])
+    if tag == "window":
+        return ast.Window(
+            _expr(t[1]),  # type: ignore[arg-type]
+            [_expr(p) for p in t[2]],
+            [_order(o) for o in t[3]],
+        )
+    raise ValueError(f"bad expr tag {tag}")

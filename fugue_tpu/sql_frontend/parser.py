@@ -100,7 +100,18 @@ class Cursor:
 
 def parse_select(sql: str) -> Query:
     from fugue_tpu.sql_frontend.native_build import enable_native_scanner
+    from fugue_tpu.sql_frontend.native_parse import (
+        enable_native_parser,
+        try_native_parse,
+    )
 
+    # the C++ parser covers the FULL parse; on None (unsupported shape,
+    # syntax error, no compiler) the pure-Python path below owns the
+    # parse AND the error message
+    enable_native_parser()
+    q = try_native_parse(sql)
+    if q is not None:
+        return q
     enable_native_scanner()  # idempotent; falls back to python silently
     cur = Cursor(tokenize(sql))
     q = ExprParser(cur).query()
@@ -493,10 +504,17 @@ class ExprParser:
         return self._maybe_qualified(t.value)
 
     def _maybe_over(self, func: Func) -> Expr:
-        """``OVER (PARTITION BY ... ORDER BY ...)`` after a function call."""
+        """``OVER (PARTITION BY ... ORDER BY ...)`` after a function call.
+        OVER introduces a window only when followed by ``(`` — a bare
+        ``over`` stays available as a select-item alias (review finding)."""
         cur = self.cur
-        if not cur.accept_kw("OVER"):
+        if not (
+            cur.is_kw("OVER")
+            and cur.peek(1).kind == "OP"
+            and cur.peek(1).value == "("
+        ):
             return func
+        cur.advance()
         cur.expect_op("(")
         partition: List[Expr] = []
         if cur.accept_kw("PARTITION"):

@@ -1027,10 +1027,27 @@ def _eval_window(ev: "_Evaluator", e: ast.Window) -> _TS:
         tot = vs.fillna(0).groupby(part_id).cumsum()
         r = tot.where(cnt > 0) if name == "sum" else (tot / cnt).where(cnt > 0)
     elif name in ("min", "max"):
-        r = getattr(vs.groupby(part_id), f"cum{name}")()
-        # cummin/cummax leave NaN AT null positions; SQL's null-ignoring
-        # frame carries the prior extremum forward (review finding)
-        r = r.groupby(part_id).ffill()
+        if vs.dtype.kind in "biufcmM":
+            r = getattr(vs.groupby(part_id), f"cum{name}")()
+            # cummin/cummax leave NaN AT null positions; SQL's
+            # null-ignoring frame carries the prior extremum forward
+            # (review finding)
+            r = r.groupby(part_id).ffill()
+        else:
+            # strings/objects: pandas cummin rejects them — accumulate
+            # per group (review finding)
+            pick = min if name == "min" else max
+
+            def _acc(s: pd.Series) -> pd.Series:
+                best: Any = None
+                out: List[Any] = []
+                for v in s:
+                    if not pd.isna(v):
+                        best = v if best is None else pick(best, v)
+                    out.append(best)
+                return pd.Series(out, index=s.index, dtype=object)
+
+            r = vs.groupby(part_id, group_keys=False).apply(_acc)
     elif name in ("first", "first_value"):
         r = _positional_pick(part_id, first=True)
     elif name in ("last", "last_value"):

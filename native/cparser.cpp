@@ -1,0 +1,1055 @@
+/* C++ SQL parser for fugue_tpu.sql_frontend.
+ *
+ * Completes the role of the reference's C++-accelerated ANTLR parser
+ * (fugue-sql-antlr[cpp], reference README.md:162 "can be 50+ times
+ * faster"): the FULL parse — lexing AND recursive descent to an AST —
+ * runs in native code. The module exposes parse(sql) returning a nested
+ * generic tree of Python tuples which
+ * fugue_tpu/sql_frontend/native_parse.py rebuilds into ast.* nodes.
+ *
+ * Grammar and precedence mirror fugue_tpu/sql_frontend/parser.py
+ * exactly. On ANY input it cannot handle identically — non-ASCII
+ * source, lexical error, unsupported construct, syntax error — parse()
+ * returns None and the pure-Python parser takes over, so behavior
+ * (including error messages) never diverges. A differential test
+ * (tests/.../test_native_parser.py) asserts AST equality over the whole
+ * SQL corpus.
+ *
+ * Built by fugue_tpu/sql_frontend/native_build.py with g++ at first use.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cctype>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+/* ---------------- lexer (mirrors tokenizer._scan_py) ------------------- */
+
+enum Kind { T_IDENT, T_QIDENT, T_NUMBER, T_STRING, T_OP, T_END };
+
+struct Tok {
+    Kind kind;
+    std::string value;
+    std::string upper;  // cached for IDENT
+};
+
+struct Lexer {
+    const char* s;
+    Py_ssize_t n;
+    std::vector<Tok> toks;
+
+    bool push(Kind k, std::string v) {
+        Tok t;
+        t.kind = k;
+        t.value = std::move(v);
+        if (k == T_IDENT) {
+            t.upper = t.value;
+            for (auto& c : t.upper) c = (char)toupper((unsigned char)c);
+        }
+        toks.push_back(std::move(t));
+        return true;
+    }
+
+    /* returns false on anything the python lexer would RAISE on (or that
+       we choose not to handle) -> caller falls back */
+    bool scan() {
+        Py_ssize_t i = 0;
+        while (i < n) {
+            char c = s[i];
+            if (c == ' ' || c == '\t' || c == '\r' || c == '\n') { i++; continue; }
+            if (c == '-' && i + 1 < n && s[i + 1] == '-') {
+                while (i < n && s[i] != '\n') i++;
+                continue;
+            }
+            if (c == '/' && i + 1 < n && s[i + 1] == '*') {
+                Py_ssize_t j = i + 2;
+                while (j + 1 < n && !(s[j] == '*' && s[j + 1] == '/')) j++;
+                if (j + 1 >= n) return false;
+                i = j + 2;
+                continue;
+            }
+            if (c == '\'') {
+                std::string buf;
+                Py_ssize_t j = i + 1;
+                for (;;) {
+                    if (j >= n) return false;
+                    if (s[j] == '\'') {
+                        if (j + 1 < n && s[j + 1] == '\'') { buf += '\''; j += 2; continue; }
+                        break;
+                    }
+                    if (s[j] == '\\' && j + 1 < n && (s[j + 1] == '\'' || s[j + 1] == '\\')) {
+                        buf += s[j + 1]; j += 2; continue;
+                    }
+                    buf += s[j]; j++;
+                }
+                push(T_STRING, buf);
+                i = j + 1;
+                continue;
+            }
+            if (c == '"' || c == '`') {
+                char close = c;
+                std::string buf;
+                Py_ssize_t j = i + 1;
+                for (;;) {
+                    if (j >= n) return false;
+                    if (s[j] == close) {
+                        if (j + 1 < n && s[j + 1] == close) { buf += close; j += 2; continue; }
+                        break;
+                    }
+                    buf += s[j]; j++;
+                }
+                push(T_QIDENT, buf);
+                i = j + 1;
+                continue;
+            }
+            bool digit = (c >= '0' && c <= '9');
+            if (digit || (c == '.' && i + 1 < n && s[i + 1] >= '0' && s[i + 1] <= '9')) {
+                Py_ssize_t j = i;
+                bool dot = false, exp = false;
+                while (j < n) {
+                    char ch = s[j];
+                    if (ch >= '0' && ch <= '9') { j++; }
+                    else if (ch == '.' && !dot && !exp) { dot = true; j++; }
+                    else if ((ch == 'e' || ch == 'E') && !exp && j > i) {
+                        if (j + 1 < n && ((s[j + 1] >= '0' && s[j + 1] <= '9') ||
+                            ((s[j + 1] == '+' || s[j + 1] == '-') && j + 2 < n &&
+                             s[j + 2] >= '0' && s[j + 2] <= '9'))) {
+                            exp = true;
+                            j += (s[j + 1] == '+' || s[j + 1] == '-') ? 2 : 1;
+                        } else break;
+                    } else break;
+                }
+                push(T_NUMBER, std::string(s + i, (size_t)(j - i)));
+                i = j;
+                continue;
+            }
+            if (isalpha((unsigned char)c) || c == '_') {
+                Py_ssize_t j = i + 1;
+                while (j < n && (isalnum((unsigned char)s[j]) || s[j] == '_')) j++;
+                push(T_IDENT, std::string(s + i, (size_t)(j - i)));
+                i = j;
+                continue;
+            }
+            /* operators: two-char first (same table as the tokenizer) */
+            if (i + 1 < n) {
+                char d = s[i + 1];
+                if ((c == '<' && (d == '>' || d == '=')) ||
+                    (c == '!' && d == '=') || (c == '>' && d == '=') ||
+                    (c == '|' && d == '|') || (c == '=' && (d == '=' || d == '>'))) {
+                    push(T_OP, std::string(s + i, 2));
+                    i += 2;
+                    continue;
+                }
+            }
+            if (strchr("=<>+-*/%(),.;:{}[]?", c) != nullptr) {
+                push(T_OP, std::string(1, c));
+                i++;
+                continue;
+            }
+            return false; /* unknown char: python raises its error */
+        }
+        Tok end;
+        end.kind = T_END;
+        toks.push_back(end);
+        return true;
+    }
+};
+
+/* ---------------- parser ------------------------------------------------ */
+
+static const char* RESERVED_AFTER_TABLE[] = {
+    "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "OFFSET", "UNION",
+    "EXCEPT", "INTERSECT", "JOIN", "INNER", "LEFT", "RIGHT", "FULL",
+    "CROSS", "SEMI", "ANTI", "ON", "USING", "NATURAL", "BY", "AND", "OR",
+    "PERSIST", "BROADCAST", "CHECKPOINT", "YIELD", "PREPARTITION",
+    "TRANSFORM", "PROCESS", "OUTPUT", "PRINT", "SAVE", "LOAD", "TAKE",
+    "SELECT", "WITH", "END", "DISTRIBUTE", "PRESORT", "SINGLE", "FROM",
+    "OUTTRANSFORM", "CREATE", "ZIP", "RENAME", "ALTER", "FILL", "SAMPLE",
+    "REPLACE", "SEED", "DETERMINISTIC", "LAZY", "WEAK", "STRONG",
+    "CALLBACK", "ROWCOUNT", "ROWS", "TITLE", "HASH", "RAND", "EVEN",
+    "COARSE", "DROP", "SCHEMA", "PARAMS", "COLUMNS", "OVERWRITE", "APPEND",
+    nullptr,
+};
+
+static bool reserved_after_table(const std::string& u) {
+    for (int i = 0; RESERVED_AFTER_TABLE[i]; i++)
+        if (u == RESERVED_AFTER_TABLE[i]) return true;
+    return false;
+}
+
+struct Parser {
+    const std::vector<Tok>& t;
+    size_t pos = 0;
+    bool failed = false;  // unsupported/syntax problem -> whole parse None
+
+    explicit Parser(const std::vector<Tok>& toks) : t(toks) {}
+
+    const Tok& tok() const { return t[pos]; }
+    const Tok& peek(size_t k = 1) const {
+        size_t i = pos + k;
+        return i < t.size() ? t[i] : t.back();
+    }
+    bool at_end() const { return tok().kind == T_END; }
+    void advance() { if (pos + 1 < t.size()) pos++; }
+
+    bool is_kw(const char* w) const {
+        return tok().kind == T_IDENT && tok().upper == w;
+    }
+    bool accept_kw(const char* w) {
+        if (is_kw(w)) { advance(); return true; }
+        return false;
+    }
+    bool expect_kw(const char* w) {
+        if (accept_kw(w)) return true;
+        failed = true;
+        return false;
+    }
+    bool is_op(const char* o) const {
+        return tok().kind == T_OP && tok().value == o;
+    }
+    bool accept_op(const char* o) {
+        if (is_op(o)) { advance(); return true; }
+        return false;
+    }
+    bool expect_op(const char* o) {
+        if (accept_op(o)) return true;
+        failed = true;
+        return false;
+    }
+
+    PyObject* fail() { failed = true; return nullptr; }
+
+    /* tag helpers: every node is ("tag", children...) with N stealing */
+    PyObject* node(const char* fmt, const char* tag, ...) {
+        va_list va;
+        va_start(va, tag);
+        PyObject* res = Py_VaBuildValue(fmt, va);
+        va_end(va);
+        (void)tag;
+        if (!res) failed = true;
+        return res;
+    }
+
+    PyObject* str_or_none(const std::string* s) {
+        if (!s) Py_RETURN_NONE;
+        return PyUnicode_FromStringAndSize(s->c_str(), (Py_ssize_t)s->size());
+    }
+
+    /* ---- names / aliases ---- */
+    bool name(std::string& out) {
+        if (tok().kind != T_IDENT && tok().kind != T_QIDENT) {
+            failed = true;
+            return false;
+        }
+        out = tok().value;
+        advance();
+        return true;
+    }
+
+    bool table_alias(std::string& out, bool& has) {
+        has = false;
+        if (accept_kw("AS")) {
+            if (!name(out)) return false;
+            has = true;
+            return true;
+        }
+        if (tok().kind == T_QIDENT ||
+            (tok().kind == T_IDENT && !reserved_after_table(tok().upper))) {
+            out = tok().value;
+            advance();
+            has = true;
+        }
+        return true;
+    }
+
+    /* ---- queries ---- */
+    PyObject* query() {
+        if (is_kw("WITH")) {
+            advance();
+            PyObject* ctes = PyList_New(0);
+            if (!ctes) return fail();
+            for (;;) {
+                std::string nm;
+                if (!name(nm)) { Py_DECREF(ctes); return nullptr; }
+                if (!expect_kw("AS") || !expect_op("(")) { Py_DECREF(ctes); return nullptr; }
+                PyObject* sub = query();
+                if (!sub) { Py_DECREF(ctes); return nullptr; }
+                if (!expect_op(")")) { Py_DECREF(sub); Py_DECREF(ctes); return nullptr; }
+                PyObject* pair = Py_BuildValue("(s#N)", nm.c_str(),
+                                               (Py_ssize_t)nm.size(), sub);
+                if (!pair || PyList_Append(ctes, pair) < 0) {
+                    Py_XDECREF(pair); Py_DECREF(ctes); return fail();
+                }
+                Py_DECREF(pair);
+                if (!accept_op(",")) break;
+            }
+            PyObject* body = query();
+            if (!body) { Py_DECREF(ctes); return nullptr; }
+            return node("(sNN)", "with", "with", ctes, body);
+        }
+        return set_expr();
+    }
+
+    PyObject* set_expr() {
+        PyObject* left = select_core();
+        if (!left) return nullptr;
+        while (is_kw("UNION") || is_kw("EXCEPT") || is_kw("INTERSECT")) {
+            std::string op = tok().upper;
+            advance();
+            bool all = accept_kw("ALL");
+            if (!all) accept_kw("DISTINCT");
+            PyObject* right = select_core();
+            if (!right) { Py_DECREF(left); return nullptr; }
+            PyObject* so = Py_BuildValue("(ss#ONN)", "setop", op.c_str(),
+                                         (Py_ssize_t)op.size(),
+                                         all ? Py_True : Py_False, left, right);
+            if (!so) { failed = true; return nullptr; }
+            left = so;
+        }
+        /* trailing ORDER BY / LIMIT bind to the whole set expression */
+        int is_setop = 0;
+        if (PyTuple_Check(left) && PyTuple_GET_SIZE(left) > 0) {
+            PyObject* tag = PyTuple_GET_ITEM(left, 0);
+            is_setop = PyUnicode_CompareWithASCIIString(tag, "setop") == 0;
+        }
+        if (is_setop) {
+            PyObject* order = order_by_clause();
+            if (!order) { Py_DECREF(left); return nullptr; }
+            PyObject *limit = nullptr, *offset = nullptr;
+            if (!limit_clause(&limit, &offset)) {
+                Py_DECREF(order); Py_DECREF(left); return nullptr;
+            }
+            PyObject* wrapped = Py_BuildValue("(sNNNN)", "setop_tail", left,
+                                              order, limit, offset);
+            if (!wrapped) { failed = true; return nullptr; }
+            left = wrapped;
+        }
+        return left;
+    }
+
+    PyObject* select_core() {
+        if (accept_op("(")) {
+            PyObject* q = query();
+            if (!q) return nullptr;
+            if (!expect_op(")")) { Py_DECREF(q); return nullptr; }
+            return q;
+        }
+        if (!expect_kw("SELECT")) return nullptr;
+        bool distinct = false;
+        if (accept_kw("DISTINCT")) distinct = true;
+        else accept_kw("ALL");
+        PyObject* items = PyList_New(0);
+        if (!items) return fail();
+        for (;;) {
+            PyObject* it = select_item();
+            if (!it || PyList_Append(items, it) < 0) {
+                Py_XDECREF(it); Py_DECREF(items); return fail();
+            }
+            Py_DECREF(it);
+            if (!accept_op(",")) break;
+        }
+        PyObject* from = nullptr;
+        if (accept_kw("FROM")) {
+            from = from_expr();
+            if (!from) { Py_DECREF(items); return nullptr; }
+        } else {
+            from = Py_None;
+            Py_INCREF(from);
+        }
+        PyObject* where = nullptr;
+        if (accept_kw("WHERE")) {
+            where = expr();
+            if (!where) { Py_DECREF(items); Py_DECREF(from); return nullptr; }
+        } else { where = Py_None; Py_INCREF(where); }
+        PyObject* group = PyList_New(0);
+        if (!group) { Py_DECREF(items); Py_DECREF(from); Py_DECREF(where); return fail(); }
+        if (accept_kw("GROUP")) {
+            if (!expect_kw("BY")) {
+                Py_DECREF(items); Py_DECREF(from); Py_DECREF(where);
+                Py_DECREF(group); return nullptr;
+            }
+            for (;;) {
+                PyObject* g = expr();
+                if (!g || PyList_Append(group, g) < 0) {
+                    Py_XDECREF(g); Py_DECREF(items); Py_DECREF(from);
+                    Py_DECREF(where); Py_DECREF(group); return fail();
+                }
+                Py_DECREF(g);
+                if (!accept_op(",")) break;
+            }
+        }
+        PyObject* having = nullptr;
+        if (accept_kw("HAVING")) {
+            having = expr();
+            if (!having) {
+                Py_DECREF(items); Py_DECREF(from); Py_DECREF(where);
+                Py_DECREF(group); return nullptr;
+            }
+        } else { having = Py_None; Py_INCREF(having); }
+        PyObject* order = order_by_clause();
+        if (!order) {
+            Py_DECREF(items); Py_DECREF(from); Py_DECREF(where);
+            Py_DECREF(group); Py_DECREF(having); return nullptr;
+        }
+        PyObject *limit = nullptr, *offset = nullptr;
+        if (!limit_clause(&limit, &offset)) {
+            Py_DECREF(items); Py_DECREF(from); Py_DECREF(where);
+            Py_DECREF(group); Py_DECREF(having); Py_DECREF(order);
+            return nullptr;
+        }
+        return node("(sNNNNNNNNO)", "select", "select", items, from, where,
+                    group, having, order, limit, offset,
+                    distinct ? Py_True : Py_False);
+    }
+
+    PyObject* order_by_clause() {
+        PyObject* out = PyList_New(0);
+        if (!out) return fail();
+        if (!is_kw("ORDER")) return out;
+        advance();
+        if (!expect_kw("BY")) { Py_DECREF(out); return nullptr; }
+        for (;;) {
+            PyObject* e = expr();
+            if (!e) { Py_DECREF(out); return nullptr; }
+            bool asc = true;
+            if (accept_kw("DESC")) asc = false;
+            else accept_kw("ASC");
+            const char* nulls = nullptr;
+            if (accept_kw("NULLS")) {
+                if (accept_kw("FIRST")) nulls = "FIRST";
+                else if (expect_kw("LAST")) nulls = "LAST";
+                else { Py_DECREF(e); Py_DECREF(out); return nullptr; }
+            }
+            PyObject* item =
+                nulls ? Py_BuildValue("(sNOs)", "order", e,
+                                      asc ? Py_True : Py_False, nulls)
+                      : Py_BuildValue("(sNOO)", "order", e,
+                                      asc ? Py_True : Py_False, Py_None);
+            if (!item || PyList_Append(out, item) < 0) {
+                Py_XDECREF(item); Py_DECREF(out); return fail();
+            }
+            Py_DECREF(item);
+            if (!accept_op(",")) break;
+        }
+        return out;
+    }
+
+    bool limit_clause(PyObject** limit, PyObject** offset) {
+        *limit = *offset = nullptr;
+        if (accept_kw("LIMIT")) {
+            if (tok().kind != T_NUMBER) { failed = true; return false; }
+            *limit = PyLong_FromString(tok().value.c_str(), nullptr, 10);
+            if (!*limit) { PyErr_Clear(); failed = true; return false; }
+            advance();
+        } else { *limit = Py_None; Py_INCREF(Py_None); }
+        if (accept_kw("OFFSET")) {
+            if (tok().kind != T_NUMBER) {
+                Py_DECREF(*limit); failed = true; return false;
+            }
+            *offset = PyLong_FromString(tok().value.c_str(), nullptr, 10);
+            if (!*offset) { PyErr_Clear(); Py_DECREF(*limit); failed = true; return false; }
+            advance();
+        } else { *offset = Py_None; Py_INCREF(Py_None); }
+        return true;
+    }
+
+    PyObject* select_item() {
+        if (is_op("*")) {
+            advance();
+            PyObject* star = Py_BuildValue("(sO)", "star", Py_None);
+            if (!star) return fail();
+            return node("(sNO)", "item", "item", star, Py_None);
+        }
+        if ((tok().kind == T_IDENT || tok().kind == T_QIDENT) &&
+            peek(1).kind == T_OP && peek(1).value == "." &&
+            peek(2).kind == T_OP && peek(2).value == "*") {
+            std::string tbl = tok().value;
+            advance(); advance(); advance();
+            PyObject* star = Py_BuildValue(
+                "(ss#)", "star", tbl.c_str(), (Py_ssize_t)tbl.size());
+            if (!star) return fail();
+            return node("(sNO)", "item", "item", star, Py_None);
+        }
+        PyObject* e = expr();
+        if (!e) return nullptr;
+        std::string alias;
+        bool has = false;
+        if (accept_kw("AS")) {
+            if (!name(alias)) { Py_DECREF(e); return nullptr; }
+            has = true;
+        } else if (tok().kind == T_QIDENT ||
+                   (tok().kind == T_IDENT &&
+                    !reserved_after_table(tok().upper))) {
+            alias = tok().value;
+            advance();
+            has = true;
+        }
+        if (has)
+            return node("(sNs#)", "item", "item", e, alias.c_str(),
+                        (Py_ssize_t)alias.size());
+        return node("(sNO)", "item", "item", e, Py_None);
+    }
+
+    /* ---- FROM ---- */
+    PyObject* from_expr() {
+        PyObject* rel = table_primary();
+        if (!rel) return nullptr;
+        for (;;) {
+            const char* how = nullptr;
+            if (is_kw("CROSS")) {
+                advance();
+                if (!expect_kw("JOIN")) { Py_DECREF(rel); return nullptr; }
+                how = "cross";
+            } else if (is_kw("INNER")) {
+                advance();
+                if (!expect_kw("JOIN")) { Py_DECREF(rel); return nullptr; }
+                how = "inner";
+            } else if (is_kw("JOIN")) {
+                advance();
+                how = "inner";
+            } else if (is_kw("LEFT")) {
+                if (peek(1).kind == T_IDENT &&
+                    (peek(1).upper == "SEMI" || peek(1).upper == "ANTI")) {
+                    advance();
+                    how = tok().upper == "SEMI" ? "semi" : "anti";
+                    advance();
+                    if (!expect_kw("JOIN")) { Py_DECREF(rel); return nullptr; }
+                } else {
+                    advance();
+                    accept_kw("OUTER");
+                    if (!expect_kw("JOIN")) { Py_DECREF(rel); return nullptr; }
+                    how = "left_outer";
+                }
+            } else if (is_kw("RIGHT")) {
+                advance();
+                accept_kw("OUTER");
+                if (!expect_kw("JOIN")) { Py_DECREF(rel); return nullptr; }
+                how = "right_outer";
+            } else if (is_kw("FULL")) {
+                advance();
+                accept_kw("OUTER");
+                if (!expect_kw("JOIN")) { Py_DECREF(rel); return nullptr; }
+                how = "full_outer";
+            } else if (is_kw("SEMI") || is_kw("ANTI")) {
+                how = tok().upper == "SEMI" ? "semi" : "anti";
+                advance();
+                if (!expect_kw("JOIN")) { Py_DECREF(rel); return nullptr; }
+            } else if (is_op(",")) {
+                advance();
+                PyObject* right = table_primary();
+                if (!right) { Py_DECREF(rel); return nullptr; }
+                PyObject* j = Py_BuildValue("(sNNsOO)", "join", rel, right,
+                                            "cross", Py_None, Py_None);
+                if (!j) { failed = true; return nullptr; }
+                rel = j;
+                continue;
+            } else {
+                break;
+            }
+            PyObject* right = table_primary();
+            if (!right) { Py_DECREF(rel); return nullptr; }
+            PyObject* on = Py_None;
+            Py_INCREF(on);
+            PyObject* using_ = Py_None;
+            Py_INCREF(using_);
+            if (strcmp(how, "cross") != 0) {
+                if (accept_kw("ON")) {
+                    Py_DECREF(on);
+                    on = expr();
+                    if (!on) { Py_DECREF(rel); Py_DECREF(right); Py_DECREF(using_); return nullptr; }
+                } else if (accept_kw("USING")) {
+                    if (!expect_op("(")) {
+                        Py_DECREF(rel); Py_DECREF(right);
+                        Py_DECREF(on); Py_DECREF(using_); return nullptr;
+                    }
+                    Py_DECREF(using_);
+                    using_ = PyList_New(0);
+                    if (!using_) { Py_DECREF(rel); Py_DECREF(right); Py_DECREF(on); return fail(); }
+                    for (;;) {
+                        std::string u;
+                        if (!name(u)) {
+                            Py_DECREF(rel); Py_DECREF(right);
+                            Py_DECREF(on); Py_DECREF(using_); return nullptr;
+                        }
+                        PyObject* us = PyUnicode_FromStringAndSize(
+                            u.c_str(), (Py_ssize_t)u.size());
+                        if (!us || PyList_Append(using_, us) < 0) {
+                            Py_XDECREF(us); Py_DECREF(rel); Py_DECREF(right);
+                            Py_DECREF(on); Py_DECREF(using_); return fail();
+                        }
+                        Py_DECREF(us);
+                        if (!accept_op(",")) break;
+                    }
+                    if (!expect_op(")")) {
+                        Py_DECREF(rel); Py_DECREF(right);
+                        Py_DECREF(on); Py_DECREF(using_); return nullptr;
+                    }
+                }
+            }
+            PyObject* j = Py_BuildValue("(sNNsNN)", "join", rel, right, how,
+                                        on, using_);
+            if (!j) { failed = true; return nullptr; }
+            rel = j;
+        }
+        return rel;
+    }
+
+    PyObject* table_primary() {
+        if (accept_op("(")) {
+            PyObject* q = query();
+            if (!q) return nullptr;
+            if (!expect_op(")")) { Py_DECREF(q); return nullptr; }
+            std::string alias;
+            bool has = false;
+            if (!table_alias(alias, has)) { Py_DECREF(q); return nullptr; }
+            if (!has) { Py_DECREF(q); return fail(); }
+            return node("(sNs#)", "subq", "subq", q, alias.c_str(),
+                        (Py_ssize_t)alias.size());
+        }
+        std::string nm;
+        if (!name(nm)) return nullptr;
+        std::string alias;
+        bool has = false;
+        if (!table_alias(alias, has)) return nullptr;
+        if (has)
+            return node("(ss#s#)", "table", "table", nm.c_str(),
+                        (Py_ssize_t)nm.size(), alias.c_str(),
+                        (Py_ssize_t)alias.size());
+        return node("(ss#O)", "table", "table", nm.c_str(),
+                    (Py_ssize_t)nm.size(), Py_None);
+    }
+
+    /* ---- expressions ---- */
+    PyObject* expr() { return or_expr(); }
+
+    PyObject* binop(const char* tag, const std::string& op, PyObject* l,
+                    PyObject* r) {
+        return node("(ss#NN)", tag, "bin", op.c_str(), (Py_ssize_t)op.size(),
+                    l, r);
+    }
+
+    PyObject* or_expr() {
+        PyObject* left = and_expr();
+        if (!left) return nullptr;
+        while (accept_kw("OR")) {
+            PyObject* right = and_expr();
+            if (!right) { Py_DECREF(left); return nullptr; }
+            left = binop("bin", "OR", left, right);
+            if (!left) return nullptr;
+        }
+        return left;
+    }
+
+    PyObject* and_expr() {
+        PyObject* left = not_expr();
+        if (!left) return nullptr;
+        while (accept_kw("AND")) {
+            PyObject* right = not_expr();
+            if (!right) { Py_DECREF(left); return nullptr; }
+            left = binop("bin", "AND", left, right);
+            if (!left) return nullptr;
+        }
+        return left;
+    }
+
+    PyObject* not_expr() {
+        if (accept_kw("NOT")) {
+            PyObject* v = not_expr();
+            if (!v) return nullptr;
+            return node("(ssN)", "unary", "unary", "NOT", v);
+        }
+        return predicate();
+    }
+
+    PyObject* predicate() {
+        PyObject* left = additive();
+        if (!left) return nullptr;
+        for (;;) {
+            if (tok().kind == T_OP) {
+                const std::string& v = tok().value;
+                if (v == "=" || v == "==" || v == "<>" || v == "!=" ||
+                    v == "<" || v == "<=" || v == ">" || v == ">=") {
+                    std::string op = v == "==" ? "=" : (v == "!=" ? "<>" : v);
+                    advance();
+                    PyObject* right = additive();
+                    if (!right) { Py_DECREF(left); return nullptr; }
+                    left = binop("bin", op, left, right);
+                    if (!left) return nullptr;
+                    continue;
+                }
+            }
+            if (is_kw("IS")) {
+                advance();
+                bool neg = accept_kw("NOT");
+                if (!expect_kw("NULL")) { Py_DECREF(left); return nullptr; }
+                left = node("(sNO)", "isnull", "isnull", left,
+                            neg ? Py_True : Py_False);
+                if (!left) return nullptr;
+                continue;
+            }
+            bool neg = false;
+            if (is_kw("NOT") && peek(1).kind == T_IDENT &&
+                (peek(1).upper == "IN" || peek(1).upper == "BETWEEN" ||
+                 peek(1).upper == "LIKE")) {
+                advance();
+                neg = true;
+            }
+            if (accept_kw("IN")) {
+                if (!expect_op("(")) { Py_DECREF(left); return nullptr; }
+                PyObject* items = PyList_New(0);
+                if (!items) { Py_DECREF(left); return fail(); }
+                for (;;) {
+                    PyObject* e = expr();
+                    if (!e || PyList_Append(items, e) < 0) {
+                        Py_XDECREF(e); Py_DECREF(items); Py_DECREF(left);
+                        return fail();
+                    }
+                    Py_DECREF(e);
+                    if (!accept_op(",")) break;
+                }
+                if (!expect_op(")")) {
+                    Py_DECREF(items); Py_DECREF(left); return nullptr;
+                }
+                left = node("(sNNO)", "inlist", "inlist", left, items,
+                            neg ? Py_True : Py_False);
+                if (!left) return nullptr;
+                continue;
+            }
+            if (accept_kw("BETWEEN")) {
+                PyObject* low = additive();
+                if (!low) { Py_DECREF(left); return nullptr; }
+                if (!expect_kw("AND")) {
+                    Py_DECREF(low); Py_DECREF(left); return nullptr;
+                }
+                PyObject* high = additive();
+                if (!high) { Py_DECREF(low); Py_DECREF(left); return nullptr; }
+                left = node("(sNNNO)", "between", "between", left, low, high,
+                            neg ? Py_True : Py_False);
+                if (!left) return nullptr;
+                continue;
+            }
+            if (accept_kw("LIKE")) {
+                PyObject* pat = additive();
+                if (!pat) { Py_DECREF(left); return nullptr; }
+                left = node("(sNNO)", "like", "like", left, pat,
+                            neg ? Py_True : Py_False);
+                if (!left) return nullptr;
+                continue;
+            }
+            if (neg) { Py_DECREF(left); return fail(); }
+            return left;
+        }
+    }
+
+    PyObject* additive() {
+        PyObject* left = multiplicative();
+        if (!left) return nullptr;
+        for (;;) {
+            if (tok().kind == T_OP && (tok().value == "+" ||
+                tok().value == "-" || tok().value == "||")) {
+                std::string op = tok().value;
+                advance();
+                PyObject* right = multiplicative();
+                if (!right) { Py_DECREF(left); return nullptr; }
+                left = binop("bin", op, left, right);
+                if (!left) return nullptr;
+            } else return left;
+        }
+    }
+
+    PyObject* multiplicative() {
+        PyObject* left = unary();
+        if (!left) return nullptr;
+        for (;;) {
+            if (tok().kind == T_OP && (tok().value == "*" ||
+                tok().value == "/" || tok().value == "%")) {
+                std::string op = tok().value;
+                advance();
+                PyObject* right = unary();
+                if (!right) { Py_DECREF(left); return nullptr; }
+                left = binop("bin", op, left, right);
+                if (!left) return nullptr;
+            } else return left;
+        }
+    }
+
+    PyObject* unary() {
+        if (tok().kind == T_OP && (tok().value == "-" || tok().value == "+")) {
+            std::string op = tok().value;
+            advance();
+            PyObject* v = unary();
+            if (!v) return nullptr;
+            return node("(ss#N)", "unary", "unary", op.c_str(),
+                        (Py_ssize_t)op.size(), v);
+        }
+        return primary();
+    }
+
+    PyObject* maybe_qualified(const std::string& first) {
+        if (is_op(".") &&
+            (peek(1).kind == T_IDENT || peek(1).kind == T_QIDENT)) {
+            advance();
+            std::string nm = tok().value;
+            advance();
+            return node("(ss#s#)", "col", "col", nm.c_str(),
+                        (Py_ssize_t)nm.size(), first.c_str(),
+                        (Py_ssize_t)first.size());
+        }
+        return node("(ss#O)", "col", "col", first.c_str(),
+                    (Py_ssize_t)first.size(), Py_None);
+    }
+
+    PyObject* maybe_over(PyObject* func) {
+        /* OVER introduces a window only when followed by "(" — a bare
+           "over" stays usable as a select-item alias (parity with the
+           python parser) */
+        if (!(is_kw("OVER") && peek(1).kind == T_OP && peek(1).value == "("))
+            return func;
+        advance();
+        if (!expect_op("(")) { Py_DECREF(func); return nullptr; }
+        PyObject* part = PyList_New(0);
+        if (!part) { Py_DECREF(func); return fail(); }
+        if (accept_kw("PARTITION")) {
+            if (!expect_kw("BY")) {
+                Py_DECREF(part); Py_DECREF(func); return nullptr;
+            }
+            for (;;) {
+                PyObject* p = expr();
+                if (!p || PyList_Append(part, p) < 0) {
+                    Py_XDECREF(p); Py_DECREF(part); Py_DECREF(func);
+                    return fail();
+                }
+                Py_DECREF(p);
+                if (!accept_op(",")) break;
+            }
+        }
+        PyObject* order = order_by_clause();
+        if (!order) { Py_DECREF(part); Py_DECREF(func); return nullptr; }
+        if (is_kw("ROWS") || is_kw("RANGE") || is_kw("GROUPS")) {
+            /* explicit frames are a python-side error; fall back */
+            Py_DECREF(order); Py_DECREF(part); Py_DECREF(func);
+            return fail();
+        }
+        if (!expect_op(")")) {
+            Py_DECREF(order); Py_DECREF(part); Py_DECREF(func);
+            return nullptr;
+        }
+        return node("(sNNN)", "window", "window", func, part, order);
+    }
+
+    PyObject* case_expr() {
+        advance(); /* CASE */
+        PyObject* operand = nullptr;
+        if (!is_kw("WHEN")) {
+            operand = expr();
+            if (!operand) return nullptr;
+        } else { operand = Py_None; Py_INCREF(operand); }
+        PyObject* whens = PyList_New(0);
+        if (!whens) { Py_DECREF(operand); return fail(); }
+        int count = 0;
+        while (accept_kw("WHEN")) {
+            PyObject* c = expr();
+            if (!c) { Py_DECREF(operand); Py_DECREF(whens); return nullptr; }
+            if (!expect_kw("THEN")) {
+                Py_DECREF(c); Py_DECREF(operand); Py_DECREF(whens);
+                return nullptr;
+            }
+            PyObject* v = expr();
+            if (!v) {
+                Py_DECREF(c); Py_DECREF(operand); Py_DECREF(whens);
+                return nullptr;
+            }
+            PyObject* pair = Py_BuildValue("(NN)", c, v);
+            if (!pair || PyList_Append(whens, pair) < 0) {
+                Py_XDECREF(pair); Py_DECREF(operand); Py_DECREF(whens);
+                return fail();
+            }
+            Py_DECREF(pair);
+            count++;
+        }
+        PyObject* dflt = nullptr;
+        if (accept_kw("ELSE")) {
+            dflt = expr();
+            if (!dflt) { Py_DECREF(operand); Py_DECREF(whens); return nullptr; }
+        } else { dflt = Py_None; Py_INCREF(dflt); }
+        if (!expect_kw("END") || count == 0) {
+            Py_DECREF(operand); Py_DECREF(whens); Py_DECREF(dflt);
+            return fail();
+        }
+        return node("(sNNN)", "case", "case", operand, whens, dflt);
+    }
+
+    bool type_name(std::string& out) {
+        if (tok().kind != T_IDENT && tok().kind != T_QIDENT) {
+            failed = true;
+            return false;
+        }
+        out = tok().value;
+        for (auto& c : out) c = (char)tolower((unsigned char)c);
+        advance();
+        if (accept_op("(")) {
+            if (tok().kind != T_NUMBER) { failed = true; return false; }
+            advance();
+            if (accept_op(",")) {
+                if (tok().kind != T_NUMBER) { failed = true; return false; }
+                advance();
+            }
+            if (!expect_op(")")) return false;
+        }
+        return true;
+    }
+
+    PyObject* primary() {
+        const Tok& tk = tok();
+        if (tk.kind == T_NUMBER) {
+            std::string v = tk.value;
+            advance();
+            bool isf = v.find('.') != std::string::npos ||
+                       v.find('e') != std::string::npos ||
+                       v.find('E') != std::string::npos;
+            PyObject* lit;
+            if (isf) {
+                lit = PyFloat_FromDouble(PyOS_string_to_double(
+                    v.c_str(), nullptr, nullptr));
+                if (PyErr_Occurred()) { PyErr_Clear(); return fail(); }
+            } else {
+                lit = PyLong_FromString(v.c_str(), nullptr, 10);
+                if (!lit) { PyErr_Clear(); return fail(); }
+            }
+            if (!lit) return fail();
+            return node("(sN)", "lit", "lit", lit);
+        }
+        if (tk.kind == T_STRING) {
+            std::string v = tk.value;
+            advance();
+            return node("(ss#)", "lit", "lit", v.c_str(),
+                        (Py_ssize_t)v.size());
+        }
+        if (accept_op("(")) {
+            if (is_kw("SELECT") || is_kw("WITH")) return fail();
+            PyObject* e = expr();
+            if (!e) return nullptr;
+            if (!expect_op(")")) { Py_DECREF(e); return nullptr; }
+            return e;
+        }
+        if (tk.kind == T_QIDENT) {
+            std::string v = tk.value;
+            advance();
+            return maybe_qualified(v);
+        }
+        if (tk.kind != T_IDENT) return fail();
+        const std::string& u = tk.upper;
+        if (u == "NULL") { advance(); return node("(sO)", "lit", "lit", Py_None); }
+        if (u == "TRUE") { advance(); return node("(sO)", "lit", "lit", Py_True); }
+        if (u == "FALSE") { advance(); return node("(sO)", "lit", "lit", Py_False); }
+        if (u == "CASE") return case_expr();
+        if (u == "CAST") {
+            advance();
+            if (!expect_op("(")) return nullptr;
+            PyObject* e = expr();
+            if (!e) return nullptr;
+            if (!expect_kw("AS")) { Py_DECREF(e); return nullptr; }
+            std::string tp;
+            if (!type_name(tp)) { Py_DECREF(e); return nullptr; }
+            if (!expect_op(")")) { Py_DECREF(e); return nullptr; }
+            return node("(sNs#)", "cast", "cast", e, tp.c_str(),
+                        (Py_ssize_t)tp.size());
+        }
+        /* function call? */
+        if (peek(1).kind == T_OP && peek(1).value == "(") {
+            std::string nm = tk.value;
+            advance();
+            advance(); /* ( */
+            PyObject* args = PyList_New(0);
+            if (!args) return fail();
+            bool distinct = false;
+            if (accept_op(")")) {
+                /* empty args */
+            } else if (is_op("*")) {
+                advance();
+                if (!expect_op(")")) { Py_DECREF(args); return nullptr; }
+                PyObject* star = Py_BuildValue("(sO)", "star", Py_None);
+                if (!star || PyList_Append(args, star) < 0) {
+                    Py_XDECREF(star); Py_DECREF(args); return fail();
+                }
+                Py_DECREF(star);
+            } else {
+                distinct = accept_kw("DISTINCT");
+                for (;;) {
+                    PyObject* a = expr();
+                    if (!a || PyList_Append(args, a) < 0) {
+                        Py_XDECREF(a); Py_DECREF(args); return fail();
+                    }
+                    Py_DECREF(a);
+                    if (!accept_op(",")) break;
+                }
+                if (!expect_op(")")) { Py_DECREF(args); return nullptr; }
+            }
+            PyObject* f = node("(ss#NO)", "func", "func", nm.c_str(),
+                               (Py_ssize_t)nm.size(), args,
+                               distinct ? Py_True : Py_False);
+            if (!f) return nullptr;
+            return maybe_over(f);
+        }
+        std::string v = tk.value;
+        advance();
+        return maybe_qualified(v);
+    }
+};
+
+}  // namespace
+
+static PyObject* parse(PyObject* Py_UNUSED(self), PyObject* arg) {
+    if (!PyUnicode_Check(arg)) {
+        PyErr_SetString(PyExc_TypeError, "parse expects str");
+        return nullptr;
+    }
+    if (!PyUnicode_IS_ASCII(arg)) Py_RETURN_NONE;
+    Py_ssize_t n;
+    const char* s = PyUnicode_AsUTF8AndSize(arg, &n);
+    if (!s) return nullptr;
+    Lexer lx;
+    lx.s = s;
+    lx.n = n;
+    if (!lx.scan()) Py_RETURN_NONE;
+    Parser p(lx.toks);
+    PyObject* q = p.query();
+    if (q != nullptr) {
+        p.accept_op(";");
+        if (!p.at_end()) {
+            Py_DECREF(q);
+            q = nullptr;
+            p.failed = true;
+        }
+    }
+    if (q == nullptr) {
+        /* unsupported/syntax problem: python path owns it (and its
+           error message) */
+        if (PyErr_Occurred()) {
+            if (PyErr_ExceptionMatches(PyExc_MemoryError)) return nullptr;
+            PyErr_Clear();
+        }
+        Py_RETURN_NONE;
+    }
+    return q;
+}
+
+static PyMethodDef Methods[] = {
+    {"parse", parse, METH_O,
+     "parse(sql) -> generic AST tree, or None to fall back to python"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+static struct PyModuleDef Module = {
+    PyModuleDef_HEAD_INIT, "_fugue_tpu_cparser",
+    "native SQL parser for fugue_tpu", -1, Methods,
+    nullptr, nullptr, nullptr, nullptr,
+};
+
+PyMODINIT_FUNC PyInit__fugue_tpu_cparser(void) {
+    return PyModule_Create(&Module);
+}

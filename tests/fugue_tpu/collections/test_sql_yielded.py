@@ -46,3 +46,28 @@ def test_dataframes():
         DataFrames(dict(x=a), b)  # mixing other order
     dfs3 = dfs2.convert(lambda df: df)
     assert list(dfs3.keys()) == ["x", "y"]
+
+
+def test_dialect_transpile_seam():
+    """The cross-dialect hook (reference fugue/collections/sql.py:25 role,
+    sqlglot-free): StructuredRawSQL.construct transpiles through the
+    ``transpile_sql`` plugin when source and target dialects differ."""
+    from fugue_tpu.collections.sql import StructuredRawSQL, transpile_sql
+
+    s = StructuredRawSQL([(False, "SELECT IFF(a, 1, 2) FROM t")],
+                         dialect="spark")
+    # same dialect (or unset): identity, no transpiler consulted
+    assert s.construct(dialect="spark") == "SELECT IFF(a, 1, 2) FROM t"
+    assert s.construct() == "SELECT IFF(a, 1, 2) FROM t"
+
+    hits = []
+
+    @transpile_sql.candidate(
+        lambda raw, from_dialect, to_dialect: to_dialect == "duckdb"
+    )
+    def spark_to_duckdb(raw, from_dialect, to_dialect):
+        hits.append((from_dialect, to_dialect))
+        return raw.replace("IFF(", "IF(")
+
+    assert s.construct(dialect="duckdb") == "SELECT IF(a, 1, 2) FROM t"
+    assert hits == [("spark", "duckdb")]

@@ -211,6 +211,42 @@ def test_cross_mesh_join_aligns():
     assert out["w"].sum() == expect["w"].sum()
 
 
+def test_groupby_matmul_conf_paths_agree():
+    """fugue.jax.groupby.matmul: 'always' (the accelerator path) and
+    'never' (the CPU scatter path) must agree bit-for-bit on counts and
+    to rounding on sums; 'auto' picks scatter on CPU meshes."""
+    import pandas as pd
+
+    from fugue_tpu.collections.partition import PartitionSpec
+    from fugue_tpu.column import col as fcol
+    from fugue_tpu.column import functions as ff
+    from fugue_tpu.constants import FUGUE_CONF_JAX_GROUPBY_MATMUL
+
+    rng = np.random.default_rng(5)
+    pdf = pd.DataFrame(
+        {
+            "k": rng.integers(0, 16, 5000).astype(np.int32),
+            "v": rng.random(5000).astype(np.float32),
+        }
+    )
+    results = {}
+    for mode in ("always", "never"):
+        e = JaxExecutionEngine({FUGUE_CONF_JAX_GROUPBY_MATMUL: mode})
+        out = e.aggregate(
+            e.to_df(pdf), PartitionSpec(by=["k"]),
+            [ff.sum(fcol("v")).alias("s"), ff.count(fcol("k")).alias("c")],
+        ).as_pandas().sort_values("k").reset_index(drop=True)
+        assert e.fallbacks == {}, (mode, e.fallbacks)
+        results[mode] = out
+    a, b = results["always"], results["never"]
+    assert a["k"].tolist() == b["k"].tolist()
+    assert a["c"].tolist() == b["c"].tolist()
+    assert np.allclose(a["s"], b["s"], rtol=1e-5)
+    # auto on a CPU mesh = the scatter path
+    e = JaxExecutionEngine()
+    assert not e._prefer_matmul(e.to_df(pdf).blocks)
+
+
 def test_compile_cache_conf():
     from fugue_tpu.constants import FUGUE_CONF_JAX_COMPILE_CACHE
     from fugue_tpu.jax_backend import execution_engine as ee

@@ -179,6 +179,24 @@ def test_timestamp_window_matches_native():
     assert rj["m"].tolist() == rn["m"].tolist()
 
 
+def test_running_min_max_over_strings():
+    """Review r4 finding: running MIN/MAX over string columns must work
+    (pandas cummin rejects str dtype)."""
+    t = pd.DataFrame({"o": [1, 2, 3, 4], "s": ["c", None, "a", "b"]})
+    r = _run(("SELECT o, MIN(s) OVER (ORDER BY o) AS m,"
+              " MAX(s) OVER (ORDER BY o) AS x FROM", t, "ORDER BY o"))
+    assert r["m"].tolist() == ["c", "c", "a", "a"]
+    assert r["x"].tolist() == ["c", "c", "c", "c"]
+
+
+def test_over_as_alias_still_parses():
+    """Review r4 finding: a bare 'over' remains usable as a select-item
+    alias; OVER only introduces a window when followed by '('."""
+    t = pd.DataFrame({"a": [1, 2]})
+    r = _run(("SELECT COUNT(*) over FROM", t))
+    assert r["over"].tolist() == [2]
+
+
 def test_windows_through_fugue_sql():
     """Windows survive the FugueSQL reserialization path (sqlgen) on both
     engines."""
