@@ -27,44 +27,52 @@ _BUILD_DIR = os.path.join(_REPO, "native", "_build")
 _STATE = {"tried": False, "ok": False}
 
 
-def _so_path(src_hash: str) -> str:
-    return os.path.join(_BUILD_DIR, f"_fugue_tpu_ctokenizer_{src_hash}.so")
-
-
-def _build() -> Optional[str]:
-    # EVERY failure (no source, read-only fs, no compiler) returns None so
-    # the pure-Python scanner silently takes over — never crash a SQL call
+def build_extension(
+    src: str, stem: str, timeout: int = 120
+) -> Optional[str]:
+    """Compile ``src`` into a content-hashed .so under the shared build
+    dir and return its path (shared by the C++ scanner and parser).
+    EVERY failure (no source, read-only fs, no compiler) returns None so
+    the pure-Python path silently takes over — never crash a SQL call.
+    pid-unique temp + atomic rename: concurrent first-use builds (e.g.
+    parallel test workers) must not install a half-written .so that the
+    hash-existence check would then trust forever."""
     try:
-        with open(_SRC, "rb") as fp:
+        with open(src, "rb") as fp:
             src_hash = hashlib.sha256(fp.read()).hexdigest()[:16]
-        so = _so_path(src_hash)
+        so = os.path.join(_BUILD_DIR, f"{stem}_{src_hash}.so")
         if os.path.exists(so):
             return so
         os.makedirs(_BUILD_DIR, exist_ok=True)
         include = sysconfig.get_path("include")
-        # pid-unique temp + atomic rename (see native_parse._build)
         tmp = f"{so}.{os.getpid()}.tmp"
         cmd = [
-            "g++", "-O2", "-shared", "-fPIC", f"-I{include}", _SRC, "-o",
+            "g++", "-O2", "-shared", "-fPIC", f"-I{include}", src, "-o",
             tmp,
         ]
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        subprocess.run(cmd, check=True, capture_output=True, timeout=timeout)
         os.replace(tmp, so)
         return so
     except Exception:
         return None
 
 
-def _load(so: str) -> Optional[object]:
+def load_extension(so: str, module_name: str) -> Optional[object]:
     try:
-        spec = importlib.util.spec_from_file_location(
-            "_fugue_tpu_ctokenizer", so
-        )
+        spec = importlib.util.spec_from_file_location(module_name, so)
         mod = importlib.util.module_from_spec(spec)  # type: ignore[arg-type]
         spec.loader.exec_module(mod)  # type: ignore[union-attr]
         return mod
     except Exception:
         return None
+
+
+def _build() -> Optional[str]:
+    return build_extension(_SRC, "_fugue_tpu_ctokenizer", timeout=120)
+
+
+def _load(so: str) -> Optional[object]:
+    return load_extension(so, "_fugue_tpu_ctokenizer")
 
 
 def enable_native_scanner() -> bool:

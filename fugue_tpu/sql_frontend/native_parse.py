@@ -12,47 +12,20 @@ the corpus is enforced by tests/.../test_native_parser.py.
 Set ``FUGUE_TPU_NO_NATIVE=1`` to skip entirely.
 """
 
-import hashlib
-import importlib.util
 import os
-import subprocess
-import sysconfig
-from typing import Any, List, Optional, Tuple
+from typing import Any, Optional
 
 from fugue_tpu.sql_frontend import ast
+from fugue_tpu.sql_frontend.native_build import (
+    build_extension,
+    load_extension,
+)
 
 _REPO = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
 _SRC = os.path.join(_REPO, "native", "cparser.cpp")
-_BUILD_DIR = os.path.join(_REPO, "native", "_build")
 _STATE: dict = {"tried": False, "parse": None}
-
-
-def _build() -> Optional[str]:
-    try:
-        with open(_SRC, "rb") as fp:
-            src_hash = hashlib.sha256(fp.read()).hexdigest()[:16]
-        so = os.path.join(
-            _BUILD_DIR, f"_fugue_tpu_cparser_{src_hash}.so"
-        )
-        if os.path.exists(so):
-            return so
-        os.makedirs(_BUILD_DIR, exist_ok=True)
-        include = sysconfig.get_path("include")
-        # pid-unique temp + atomic rename: concurrent first-use builds
-        # (e.g. parallel test workers) must not install a half-written
-        # .so that the hash-existence check would then trust forever
-        tmp = f"{so}.{os.getpid()}.tmp"
-        cmd = [
-            "g++", "-O2", "-shared", "-fPIC", f"-I{include}", _SRC,
-            "-o", tmp,
-        ]
-        subprocess.run(cmd, check=True, capture_output=True, timeout=180)
-        os.replace(tmp, so)
-        return so
-    except Exception:
-        return None
 
 
 def enable_native_parser() -> bool:
@@ -62,19 +35,14 @@ def enable_native_parser() -> bool:
     _STATE["tried"] = True
     if os.environ.get("FUGUE_TPU_NO_NATIVE", "").lower() in ("1", "true"):
         return False
-    so = _build()
+    so = build_extension(_SRC, "_fugue_tpu_cparser", timeout=180)
     if so is None:
         return False
-    try:
-        spec = importlib.util.spec_from_file_location(
-            "_fugue_tpu_cparser", so
-        )
-        mod = importlib.util.module_from_spec(spec)  # type: ignore[arg-type]
-        spec.loader.exec_module(mod)  # type: ignore[union-attr]
-        _STATE["parse"] = mod.parse  # type: ignore[attr-defined]
-        return True
-    except Exception:
+    mod = load_extension(so, "_fugue_tpu_cparser")
+    if mod is None:
         return False
+    _STATE["parse"] = mod.parse  # type: ignore[attr-defined]
+    return True
 
 
 def native_parser_active() -> bool:

@@ -1532,10 +1532,54 @@ def _run_setop(q: ast.SetOp, env: Dict[str, _Table]) -> _Table:
     types = [
         _unify_types(a, b) for a, b in zip(left.types, right.types)
     ]
+    # coerce BOTH sides to the unified column types up front: dedup and
+    # the multiset merges below compare values, and pandas refuses to
+    # merge int64 against str outright (review finding)
+    for lbl, tp in zip(labels, types):
+        if str(lf[lbl].dtype) == str(rf[lbl].dtype):
+            continue
+        if tp is not None and pa.types.is_string(tp):
+            for f in (lf, rf):
+                s = f[lbl]
+                nulls = s.isna()
+                o = s.astype(object)
+                o[~nulls] = s[~nulls].map(_to_str_scalar)
+                o[nulls.to_numpy(dtype=bool)] = None
+                f[lbl] = o
+        else:
+            try:
+                dt = tp.to_pandas_dtype() if tp is not None else float
+                lf[lbl] = lf[lbl].astype(dt)
+                rf[lbl] = rf[lbl].astype(dt)
+            except Exception:
+                raise SQLExecutionError(
+                    f"incompatible column types in {q.op}"
+                )
     if q.op == "UNION":
         res = pd.concat([lf, rf], ignore_index=True)
         if not q.all:
             res = res.drop_duplicates().reset_index(drop=True)
+    elif q.op in ("EXCEPT", "INTERSECT") and q.all:
+        # multiset semantics (standard SQL ... ALL): pair off occurrences
+        # — EXCEPT ALL keeps each left row whose occurrence index exceeds
+        # the right-side count; INTERSECT ALL keeps those within it
+        lo = lf.assign(
+            _occ=lf.groupby(labels, dropna=False).cumcount()
+        )
+        rcnt = (
+            rf.groupby(labels, dropna=False)
+            .size()
+            .rename("_rc")
+            .reset_index()
+        )
+        merged = lo.merge(rcnt, on=labels, how="left")
+        rc = merged["_rc"].fillna(0)
+        keep = merged["_occ"] >= rc if q.op == "EXCEPT" else (
+            merged["_occ"] < rc
+        )
+        res = merged[keep].drop(columns=["_occ", "_rc"]).reset_index(
+            drop=True
+        )
     elif q.op == "EXCEPT":
         ld = lf.drop_duplicates()
         rd = rf.drop_duplicates()

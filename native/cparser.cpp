@@ -224,12 +224,12 @@ struct Parser {
     PyObject* fail() { failed = true; return nullptr; }
 
     /* tag helpers: every node is ("tag", children...) with N stealing */
-    PyObject* node(const char* fmt, const char* tag, ...) {
+    PyObject* node(const char* fmt, ...) {
+        /* fmt's leading 's' consumes the node's tag string */
         va_list va;
-        va_start(va, tag);
+        va_start(va, fmt);
         PyObject* res = Py_VaBuildValue(fmt, va);
         va_end(va);
-        (void)tag;
         if (!res) failed = true;
         return res;
     }
@@ -289,7 +289,7 @@ struct Parser {
             }
             PyObject* body = query();
             if (!body) { Py_DECREF(ctes); return nullptr; }
-            return node("(sNN)", "with", "with", ctes, body);
+            return node("(sNN)", "with", ctes, body);
         }
         return set_expr();
     }
@@ -401,7 +401,7 @@ struct Parser {
             Py_DECREF(group); Py_DECREF(having); Py_DECREF(order);
             return nullptr;
         }
-        return node("(sNNNNNNNNO)", "select", "select", items, from, where,
+        return node("(sNNNNNNNNO)", "select", items, from, where,
                     group, having, order, limit, offset,
                     distinct ? Py_True : Py_False);
     }
@@ -462,7 +462,7 @@ struct Parser {
             advance();
             PyObject* star = Py_BuildValue("(sO)", "star", Py_None);
             if (!star) return fail();
-            return node("(sNO)", "item", "item", star, Py_None);
+            return node("(sNO)", "item", star, Py_None);
         }
         if ((tok().kind == T_IDENT || tok().kind == T_QIDENT) &&
             peek(1).kind == T_OP && peek(1).value == "." &&
@@ -472,7 +472,7 @@ struct Parser {
             PyObject* star = Py_BuildValue(
                 "(ss#)", "star", tbl.c_str(), (Py_ssize_t)tbl.size());
             if (!star) return fail();
-            return node("(sNO)", "item", "item", star, Py_None);
+            return node("(sNO)", "item", star, Py_None);
         }
         PyObject* e = expr();
         if (!e) return nullptr;
@@ -489,9 +489,9 @@ struct Parser {
             has = true;
         }
         if (has)
-            return node("(sNs#)", "item", "item", e, alias.c_str(),
+            return node("(sNs#)", "item", e, alias.c_str(),
                         (Py_ssize_t)alias.size());
-        return node("(sNO)", "item", "item", e, Py_None);
+        return node("(sNO)", "item", e, Py_None);
     }
 
     /* ---- FROM ---- */
@@ -607,7 +607,7 @@ struct Parser {
             bool has = false;
             if (!table_alias(alias, has)) { Py_DECREF(q); return nullptr; }
             if (!has) { Py_DECREF(q); return fail(); }
-            return node("(sNs#)", "subq", "subq", q, alias.c_str(),
+            return node("(sNs#)", "subq", q, alias.c_str(),
                         (Py_ssize_t)alias.size());
         }
         std::string nm;
@@ -616,19 +616,18 @@ struct Parser {
         bool has = false;
         if (!table_alias(alias, has)) return nullptr;
         if (has)
-            return node("(ss#s#)", "table", "table", nm.c_str(),
+            return node("(ss#s#)", "table", nm.c_str(),
                         (Py_ssize_t)nm.size(), alias.c_str(),
                         (Py_ssize_t)alias.size());
-        return node("(ss#O)", "table", "table", nm.c_str(),
+        return node("(ss#O)", "table", nm.c_str(),
                     (Py_ssize_t)nm.size(), Py_None);
     }
 
     /* ---- expressions ---- */
     PyObject* expr() { return or_expr(); }
 
-    PyObject* binop(const char* tag, const std::string& op, PyObject* l,
-                    PyObject* r) {
-        return node("(ss#NN)", tag, "bin", op.c_str(), (Py_ssize_t)op.size(),
+    PyObject* binop(const std::string& op, PyObject* l, PyObject* r) {
+        return node("(ss#NN)", "bin", op.c_str(), (Py_ssize_t)op.size(),
                     l, r);
     }
 
@@ -638,7 +637,7 @@ struct Parser {
         while (accept_kw("OR")) {
             PyObject* right = and_expr();
             if (!right) { Py_DECREF(left); return nullptr; }
-            left = binop("bin", "OR", left, right);
+            left = binop("OR", left, right);
             if (!left) return nullptr;
         }
         return left;
@@ -650,7 +649,7 @@ struct Parser {
         while (accept_kw("AND")) {
             PyObject* right = not_expr();
             if (!right) { Py_DECREF(left); return nullptr; }
-            left = binop("bin", "AND", left, right);
+            left = binop("AND", left, right);
             if (!left) return nullptr;
         }
         return left;
@@ -660,7 +659,7 @@ struct Parser {
         if (accept_kw("NOT")) {
             PyObject* v = not_expr();
             if (!v) return nullptr;
-            return node("(ssN)", "unary", "unary", "NOT", v);
+            return node("(ssN)", "unary", "NOT", v);
         }
         return predicate();
     }
@@ -677,7 +676,7 @@ struct Parser {
                     advance();
                     PyObject* right = additive();
                     if (!right) { Py_DECREF(left); return nullptr; }
-                    left = binop("bin", op, left, right);
+                    left = binop(op, left, right);
                     if (!left) return nullptr;
                     continue;
                 }
@@ -686,7 +685,7 @@ struct Parser {
                 advance();
                 bool neg = accept_kw("NOT");
                 if (!expect_kw("NULL")) { Py_DECREF(left); return nullptr; }
-                left = node("(sNO)", "isnull", "isnull", left,
+                left = node("(sNO)", "isnull", left,
                             neg ? Py_True : Py_False);
                 if (!left) return nullptr;
                 continue;
@@ -714,7 +713,7 @@ struct Parser {
                 if (!expect_op(")")) {
                     Py_DECREF(items); Py_DECREF(left); return nullptr;
                 }
-                left = node("(sNNO)", "inlist", "inlist", left, items,
+                left = node("(sNNO)", "inlist", left, items,
                             neg ? Py_True : Py_False);
                 if (!left) return nullptr;
                 continue;
@@ -727,7 +726,7 @@ struct Parser {
                 }
                 PyObject* high = additive();
                 if (!high) { Py_DECREF(low); Py_DECREF(left); return nullptr; }
-                left = node("(sNNNO)", "between", "between", left, low, high,
+                left = node("(sNNNO)", "between", left, low, high,
                             neg ? Py_True : Py_False);
                 if (!left) return nullptr;
                 continue;
@@ -735,7 +734,7 @@ struct Parser {
             if (accept_kw("LIKE")) {
                 PyObject* pat = additive();
                 if (!pat) { Py_DECREF(left); return nullptr; }
-                left = node("(sNNO)", "like", "like", left, pat,
+                left = node("(sNNO)", "like", left, pat,
                             neg ? Py_True : Py_False);
                 if (!left) return nullptr;
                 continue;
@@ -755,7 +754,7 @@ struct Parser {
                 advance();
                 PyObject* right = multiplicative();
                 if (!right) { Py_DECREF(left); return nullptr; }
-                left = binop("bin", op, left, right);
+                left = binop(op, left, right);
                 if (!left) return nullptr;
             } else return left;
         }
@@ -771,7 +770,7 @@ struct Parser {
                 advance();
                 PyObject* right = unary();
                 if (!right) { Py_DECREF(left); return nullptr; }
-                left = binop("bin", op, left, right);
+                left = binop(op, left, right);
                 if (!left) return nullptr;
             } else return left;
         }
@@ -783,7 +782,7 @@ struct Parser {
             advance();
             PyObject* v = unary();
             if (!v) return nullptr;
-            return node("(ss#N)", "unary", "unary", op.c_str(),
+            return node("(ss#N)", "unary", op.c_str(),
                         (Py_ssize_t)op.size(), v);
         }
         return primary();
@@ -795,11 +794,11 @@ struct Parser {
             advance();
             std::string nm = tok().value;
             advance();
-            return node("(ss#s#)", "col", "col", nm.c_str(),
+            return node("(ss#s#)", "col", nm.c_str(),
                         (Py_ssize_t)nm.size(), first.c_str(),
                         (Py_ssize_t)first.size());
         }
-        return node("(ss#O)", "col", "col", first.c_str(),
+        return node("(ss#O)", "col", first.c_str(),
                     (Py_ssize_t)first.size(), Py_None);
     }
 
@@ -838,7 +837,7 @@ struct Parser {
             Py_DECREF(order); Py_DECREF(part); Py_DECREF(func);
             return nullptr;
         }
-        return node("(sNNN)", "window", "window", func, part, order);
+        return node("(sNNN)", "window", func, part, order);
     }
 
     PyObject* case_expr() {
@@ -880,7 +879,18 @@ struct Parser {
             Py_DECREF(operand); Py_DECREF(whens); Py_DECREF(dflt);
             return fail();
         }
-        return node("(sNNN)", "case", "case", operand, whens, dflt);
+        return node("(sNNN)", "case", operand, whens, dflt);
+    }
+
+    bool int_number() {
+        /* python's _int_lit only accepts int(...)-parsable text: all
+           digits. Declining "1.5" here keeps both paths agreeing that
+           CAST(a AS decimal(1.5)) is an error (review finding). */
+        if (tok().kind != T_NUMBER) { failed = true; return false; }
+        for (char c : tok().value)
+            if (c < '0' || c > '9') { failed = true; return false; }
+        advance();
+        return true;
     }
 
     bool type_name(std::string& out) {
@@ -892,12 +902,8 @@ struct Parser {
         for (auto& c : out) c = (char)tolower((unsigned char)c);
         advance();
         if (accept_op("(")) {
-            if (tok().kind != T_NUMBER) { failed = true; return false; }
-            advance();
-            if (accept_op(",")) {
-                if (tok().kind != T_NUMBER) { failed = true; return false; }
-                advance();
-            }
+            if (!int_number()) return false;
+            if (accept_op(",") && !int_number()) return false;
             if (!expect_op(")")) return false;
         }
         return true;
@@ -921,12 +927,12 @@ struct Parser {
                 if (!lit) { PyErr_Clear(); return fail(); }
             }
             if (!lit) return fail();
-            return node("(sN)", "lit", "lit", lit);
+            return node("(sN)", "lit", lit);
         }
         if (tk.kind == T_STRING) {
             std::string v = tk.value;
             advance();
-            return node("(ss#)", "lit", "lit", v.c_str(),
+            return node("(ss#)", "lit", v.c_str(),
                         (Py_ssize_t)v.size());
         }
         if (accept_op("(")) {
@@ -943,9 +949,9 @@ struct Parser {
         }
         if (tk.kind != T_IDENT) return fail();
         const std::string& u = tk.upper;
-        if (u == "NULL") { advance(); return node("(sO)", "lit", "lit", Py_None); }
-        if (u == "TRUE") { advance(); return node("(sO)", "lit", "lit", Py_True); }
-        if (u == "FALSE") { advance(); return node("(sO)", "lit", "lit", Py_False); }
+        if (u == "NULL") { advance(); return node("(sO)", "lit", Py_None); }
+        if (u == "TRUE") { advance(); return node("(sO)", "lit", Py_True); }
+        if (u == "FALSE") { advance(); return node("(sO)", "lit", Py_False); }
         if (u == "CASE") return case_expr();
         if (u == "CAST") {
             advance();
@@ -956,7 +962,7 @@ struct Parser {
             std::string tp;
             if (!type_name(tp)) { Py_DECREF(e); return nullptr; }
             if (!expect_op(")")) { Py_DECREF(e); return nullptr; }
-            return node("(sNs#)", "cast", "cast", e, tp.c_str(),
+            return node("(sNs#)", "cast", e, tp.c_str(),
                         (Py_ssize_t)tp.size());
         }
         /* function call? */
@@ -989,7 +995,7 @@ struct Parser {
                 }
                 if (!expect_op(")")) { Py_DECREF(args); return nullptr; }
             }
-            PyObject* f = node("(ss#NO)", "func", "func", nm.c_str(),
+            PyObject* f = node("(ss#NO)", "func", nm.c_str(),
                                (Py_ssize_t)nm.size(), args,
                                distinct ? Py_True : Py_False);
             if (!f) return nullptr;

@@ -232,3 +232,18 @@ def test_qualified_misbinding_gives_up():
             "SELECT a.w FROM", a, "AS a JOIN", b,
             "AS b ON a.k = b.k", engine=e, as_fugue=True,
         ).as_array()
+
+
+def test_except_intersect_all_multiset_semantics():
+    """Review r4: EXCEPT ALL / INTERSECT ALL pair occurrences off
+    (standard multiset semantics), they do not dedup first."""
+    a = pd.DataFrame({"x": [1, 1, 1, 2, 3]})
+    b = pd.DataFrame({"x": [1, 1, 2]})
+    for eng in ("native", "jax"):
+        e = make_execution_engine(eng)
+        r1 = raw_sql("SELECT x FROM", a, "EXCEPT ALL SELECT x FROM", b,
+                     engine=e, as_fugue=True).as_pandas()
+        assert sorted(r1["x"].tolist()) == [1, 3], eng
+        r2 = raw_sql("SELECT x FROM", a, "INTERSECT ALL SELECT x FROM", b,
+                     engine=e, as_fugue=True).as_pandas()
+        assert sorted(r2["x"].tolist()) == [1, 1, 2], eng
