@@ -788,7 +788,10 @@ def _children(e: ast.Expr) -> List[ast.Expr]:
     return out
 
 
-_WINDOW_ONLY_FUNCS = {"row_number", "rank", "dense_rank", "lag", "lead"}
+_WINDOW_ONLY_FUNCS = {
+    "row_number", "rank", "dense_rank", "lag", "lead",
+    "ntile", "percent_rank", "cume_dist",
+}
 
 _NOT_LITERAL = object()
 
@@ -822,7 +825,9 @@ def _eval_window(ev: "_Evaluator", e: ast.Window) -> _TS:
         raise SQLExecutionError(f"unsupported window function {name}")
     if e.func.distinct:
         raise SQLExecutionError("DISTINCT is not supported in windows")
-    if name in ("row_number", "rank", "dense_rank") and e.func.args:
+    if name in (
+        "row_number", "rank", "dense_rank", "percent_rank", "cume_dist"
+    ) and e.func.args:
         raise SQLExecutionError(f"{name}() takes no arguments")
     idx = ev.index
     if not idx.is_unique:  # pragma: no cover - scopes use fresh indexes
@@ -892,9 +897,9 @@ def _eval_window(ev: "_Evaluator", e: ast.Window) -> _TS:
     n = len(order)
     if n == 0:
         # empty input: keep the same output TYPE a non-empty input gives
-        if name in ("row_number", "rank", "dense_rank", "count"):
+        if name in ("row_number", "rank", "dense_rank", "count", "ntile"):
             tp0: Optional[pa.DataType] = pa.int64()
-        elif name in ("avg", "mean"):
+        elif name in ("avg", "mean", "percent_rank", "cume_dist"):
             tp0 = pa.float64()
         else:
             args0 = e.func.args
@@ -929,6 +934,36 @@ def _eval_window(ev: "_Evaluator", e: ast.Window) -> _TS:
         else:
             r = (~is_peer).astype("int64").groupby(part_id).cumsum()
         return _back(r.astype("int64"), pa.int64())
+    if name in ("ntile", "percent_rank", "cume_dist"):
+        if not e.order_by:
+            raise SQLExecutionError(f"{name}() requires ORDER BY")
+        psize = grp.transform("size")
+        if name == "ntile":
+            if len(e.func.args) != 1:
+                raise SQLExecutionError("ntile takes one int argument")
+            buckets = _literal_value(e.func.args[0])
+            if not isinstance(buckets, int) or isinstance(buckets, bool) \
+                    or buckets < 1:
+                raise SQLExecutionError(
+                    "ntile argument must be a positive int literal"
+                )
+            # first (psize % n) buckets get one extra row (standard SQL)
+            q_, rem = psize // buckets, psize % buckets
+            cutoff = rem * (q_ + 1)
+            in_head = rn <= cutoff
+            head = (rn - 1) // (q_ + 1).clip(lower=1) + 1
+            tail = rem + (rn - 1 - cutoff) // q_.clip(lower=1) + 1
+            r = head.where(in_head, tail)
+            return _back(r.astype("int64"), pa.int64())
+        if name == "percent_rank":
+            srank = rn.where(~is_peer).groupby(part_id).ffill()
+            denom = (psize - 1).clip(lower=1)
+            r = (srank - 1) / denom
+            r = r.where(psize > 1, 0.0)
+        else:  # cume_dist: rows <= current row's peer group, over psize
+            last_rn = rn.groupby(peer_id).transform("max")
+            r = last_rn / psize
+        return _back(r.astype("float64"), pa.float64())
     if name in ("lag", "lead"):
         if len(e.func.args) < 1 or len(e.func.args) > 3 or isinstance(
             e.func.args[0], ast.Star
@@ -1535,7 +1570,9 @@ def _run_setop(q: ast.SetOp, env: Dict[str, _Table]) -> _Table:
     # coerce BOTH sides to the unified column types up front: dedup and
     # the multiset merges below compare values, and pandas refuses to
     # merge int64 against str outright (review finding)
-    for lbl, tp in zip(labels, types):
+    for lbl, tp, ltp, rtp in zip(labels, types, left.types, right.types):
+        if ltp is None or rtp is None:
+            continue  # NULL-literal side: concat/object semantics as-is
         if str(lf[lbl].dtype) == str(rf[lbl].dtype):
             continue
         if tp is not None and pa.types.is_string(tp):

@@ -197,6 +197,59 @@ def test_over_as_alias_still_parses():
     assert r["over"].tolist() == [2]
 
 
+def test_ntile_percent_rank_cume_dist():
+    """Standard distribution functions: NTILE's first (size % n) buckets
+    get the extra rows; PERCENT_RANK = (rank-1)/(size-1) with 0 for
+    single-row partitions; CUME_DIST counts peers inclusively."""
+    t = pd.DataFrame({"o": list(range(1, 8))})
+    r = _run(("SELECT o, NTILE(3) OVER (ORDER BY o) AS b FROM", t,
+              "ORDER BY o"))
+    assert r["b"].tolist() == [1, 1, 1, 2, 2, 3, 3]
+
+    t2 = pd.DataFrame({"x": [10, 20, 20, 30]})
+    r2 = _run(("SELECT x, PERCENT_RANK() OVER (ORDER BY x) AS p,"
+               " CUME_DIST() OVER (ORDER BY x) AS c FROM", t2,
+               "ORDER BY x"))
+    assert [round(v, 4) for v in r2["p"]] == [0.0, 0.3333, 0.3333, 1.0]
+    assert [round(v, 4) for v in r2["c"]] == [0.25, 0.75, 0.75, 1.0]
+
+    t3 = pd.DataFrame({"g": [1, 2], "x": [5, 6]})
+    r3 = _run(("SELECT g, PERCENT_RANK() OVER"
+               " (PARTITION BY g ORDER BY x) AS p FROM", t3, "ORDER BY g"))
+    assert r3["p"].tolist() == [0.0, 0.0]
+
+    t4 = pd.DataFrame({"g": [1] * 5 + [2] * 2, "o": list(range(7))})
+    r4 = _run(("SELECT g, o, NTILE(2) OVER"
+               " (PARTITION BY g ORDER BY o) AS b FROM", t4,
+               "ORDER BY g, o"))
+    assert r4["b"].tolist() == [1, 1, 1, 2, 2, 1, 2]
+
+    with pytest.raises(Exception):
+        _run(("SELECT NTILE(0) OVER (ORDER BY o) AS b FROM", t))
+    with pytest.raises(Exception):
+        _run(("SELECT CUME_DIST() OVER () AS c FROM", t))
+    with pytest.raises(Exception):
+        # review r4: distribution functions take no argument
+        _run(("SELECT CUME_DIST(o) OVER (ORDER BY o) AS c FROM", t))
+    # review r4: empty inputs keep the non-empty output types
+    te = pd.DataFrame({"o": pd.Series([], dtype="int64")})
+    from fugue_tpu.workflow.api import raw_sql as _rs
+
+    out = _rs("SELECT PERCENT_RANK() OVER (ORDER BY o) AS p,"
+              " NTILE(2) OVER (ORDER BY o) AS b FROM", te,
+              engine="native", as_fugue=True)
+    assert "p:double" in str(out.schema) and "b:long" in str(out.schema)
+
+
+def test_union_with_null_literal_column():
+    """Review r4 regression guard: the set-op type coercion must skip
+    NULL-literal sides (their declared type is None)."""
+    t = pd.DataFrame({"a": [1, 2]})
+    r = _run(("SELECT a FROM", t, "UNION ALL SELECT NULL AS a FROM", t))
+    assert len(r) == 4
+    assert r["a"].isna().sum() == 2
+
+
 def test_windows_through_fugue_sql():
     """Windows survive the FugueSQL reserialization path (sqlgen) on both
     engines."""
