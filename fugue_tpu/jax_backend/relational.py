@@ -1057,8 +1057,8 @@ def device_window(
             seg, S = fr.seg, max(fr.num_segments, 1)
         else:
             seg, S = jnp.zeros((p,), dtype=jnp.int32), 1
-        if spec.func == "row_number":
-            col, tp = _window_row_number(engine, blocks, spec, seg, S, p)
+        if spec.func in ("row_number", "rank", "dense_rank"):
+            col, tp = _window_rank_family(engine, blocks, spec, seg, S, p)
         else:
             res = _window_segment_agg(engine, blocks, spec, seg, S, p)
             if res is None:
@@ -1079,9 +1079,14 @@ def device_window(
     )
 
 
-def _window_row_number(
+def _window_rank_family(
     engine: Any, blocks: JaxBlocks, spec: Any, seg: Any, S: int, p: int
 ) -> Tuple[JaxColumn, pa.DataType]:
+    """row_number / rank / dense_rank as one device program: stable sort
+    by (order keys, partition), local position per partition, and — for
+    the ranked variants — peer-group detection by comparing ADJACENT
+    sorted rows' key codes (null-neutralized exactly like the sort)."""
+    kind = spec.func
     codes = _sort_code_columns(
         blocks, [(name, asc) for name, asc, _ in spec.order_by]
     )
@@ -1105,19 +1110,49 @@ def _window_row_number(
         )
         segv = jnp.where(valid, seg_, S)
         order = order[jnp.argsort(segv[order], stable=True)]
-        invrank = jnp.zeros((p,), dtype=jnp.int32).at[order].set(
-            jnp.arange(p, dtype=jnp.int32)
-        )
+        pos = jnp.arange(p, dtype=jnp.int32)
         cnt = jax.ops.segment_sum(
             valid.astype(jnp.int32), segv, num_segments=S + 1
         )[:S]
         starts = jnp.cumsum(cnt) - cnt
-        local = invrank - starts[jnp.clip(seg_, 0, S - 1)]
-        return (local + 1).astype(jnp.int64)
+        sseg = segv[order]
+        start_pos = starts[jnp.clip(sseg, 0, S - 1)]
+        local_sorted = pos - start_pos  # 0-based row number per partition
+        if kind == "row_number":
+            out_sorted = local_sorted + 1
+        else:
+            false0 = jnp.zeros((1,), dtype=bool)
+            same_part = jnp.concatenate([false0, sseg[1:] == sseg[:-1]])
+            is_peer = same_part
+            for i, c in enumerate(code_arrs):
+                sc = c
+                if i in null_arrs:
+                    sc = jnp.where(null_arrs[i], jnp.zeros_like(sc), sc)
+                scs = sc[order]
+                eq = jnp.concatenate([false0, scs[1:] == scs[:-1]])
+                if i in null_arrs:
+                    nn = null_arrs[i][order]
+                    eq = eq & jnp.concatenate([false0, nn[1:] == nn[:-1]])
+                is_peer = is_peer & eq
+            if kind == "rank":
+                # the peer-group head's GLOBAL position carries forward
+                # (cummax is safe: positions are globally increasing and
+                # every partition head starts a new peer group)
+                head_pos = jax.lax.cummax(jnp.where(~is_peer, pos, -1))
+                out_sorted = head_pos - start_pos + 1
+            else:  # dense_rank
+                cs = jnp.cumsum((~is_peer).astype(jnp.int32))
+                cs_at_start = cs[jnp.clip(start_pos, 0, p - 1)]
+                out_sorted = cs - cs_at_start + 1
+        return (
+            jnp.zeros((p,), dtype=jnp.int64).at[order].set(
+                out_sorted.astype(jnp.int64)
+            )
+        )
 
     rn = engine._jit_cached(
         (
-            "win_rn", p, S, tuple(spec.partition_by),
+            "win_rank", kind, p, S, tuple(spec.partition_by),
             tuple(
                 (nm, asc, nf)
                 for (nm, asc, _), nf in zip(spec.order_by, na_first)

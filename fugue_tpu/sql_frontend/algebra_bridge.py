@@ -459,7 +459,7 @@ def _window_select(q: ast.Select, scope: _Scope, source: Plan) -> Plan:
             )
         fn = e.func.name
         arg: Optional[str] = None
-        if fn == "row_number":
+        if fn in ("row_number", "rank", "dense_rank"):
             if not order or e.func.args:
                 raise _GiveUp()
         elif fn in _DEVICE_WINDOW_AGGS:

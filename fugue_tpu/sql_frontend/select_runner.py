@@ -1572,7 +1572,14 @@ def _run_setop(q: ast.SetOp, env: Dict[str, _Table]) -> _Table:
     # merge int64 against str outright (review finding)
     for lbl, tp, ltp, rtp in zip(labels, types, left.types, right.types):
         if ltp is None or rtp is None:
-            continue  # NULL-literal side: concat/object semantics as-is
+            # NULL-literal side: compare in object space — concat handles
+            # it natively, but the merge-based ops need matching dtypes
+            # (review finding); set-op NULLs compare equal, which pandas'
+            # merge factorization gives for None keys
+            if str(lf[lbl].dtype) != str(rf[lbl].dtype):
+                lf[lbl] = lf[lbl].astype(object)
+                rf[lbl] = rf[lbl].astype(object)
+            continue
         if str(lf[lbl].dtype) == str(rf[lbl].dtype):
             continue
         if tp is not None and pa.types.is_string(tp):

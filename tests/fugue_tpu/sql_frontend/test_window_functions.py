@@ -242,12 +242,20 @@ def test_ntile_percent_rank_cume_dist():
 
 
 def test_union_with_null_literal_column():
-    """Review r4 regression guard: the set-op type coercion must skip
-    NULL-literal sides (their declared type is None)."""
+    """Review r4 regression guard: NULL-literal sides (declared type
+    None) work across ALL set ops — object-space comparison for the
+    merge-based ones, and set-op NULLs compare equal."""
     t = pd.DataFrame({"a": [1, 2]})
     r = _run(("SELECT a FROM", t, "UNION ALL SELECT NULL AS a FROM", t))
     assert len(r) == 4
     assert r["a"].isna().sum() == 2
+    r2 = _run(("SELECT a FROM", t, "EXCEPT SELECT NULL AS a FROM", t))
+    assert sorted(r2["a"].tolist()) == [1, 2]
+    r3 = _run(("SELECT a FROM", t, "INTERSECT SELECT NULL AS a FROM", t))
+    assert len(r3) == 0
+    tn = pd.DataFrame({"a": [1.0, None]})
+    r4 = _run(("SELECT a FROM", tn, "INTERSECT SELECT NULL AS a FROM", t))
+    assert len(r4) == 1 and r4["a"].isna().all()
 
 
 def test_windows_through_fugue_sql():
@@ -327,6 +335,36 @@ def test_windows_route_to_device():
                      ).as_pandas()
         assert _match(rj, rn), (head, tail)
         assert e.fallbacks == {}, (head, e.fallbacks)
+
+
+def test_rank_windows_route_to_device():
+    """RANK/DENSE_RANK lower to the device rank-family program (peer
+    detection on adjacent sorted rows), including NULLS FIRST, ties and
+    string order keys."""
+    df = _df()
+    for head in (
+        "SELECT k, v, RANK() OVER (PARTITION BY k ORDER BY v) AS r FROM",
+        "SELECT k, v, DENSE_RANK() OVER (PARTITION BY k ORDER BY v DESC)"
+        " AS d FROM",
+        "SELECT k, v, RANK() OVER (ORDER BY v NULLS FIRST) AS r FROM",
+    ):
+        e = make_execution_engine("jax")
+        rj = raw_sql(head, df, "ORDER BY k, v, 3", engine=e,
+                     as_fugue=True).as_pandas()
+        rn = raw_sql(head, df, "ORDER BY k, v, 3", engine="native",
+                     as_fugue=True).as_pandas()
+        assert _match(rj, rn), head
+        assert e.fallbacks == {}, (head, e.fallbacks)
+    sdf = pd.DataFrame({"g": [1, 1, 1, 2, 2], "s": ["b", "a", "a", "c", "c"]})
+    e = make_execution_engine("jax")
+    h = ("SELECT g, s, RANK() OVER (PARTITION BY g ORDER BY s) AS r,"
+         " DENSE_RANK() OVER (PARTITION BY g ORDER BY s) AS d FROM")
+    rj = raw_sql(h, sdf, "ORDER BY g, s, r", engine=e,
+                 as_fugue=True).as_pandas()
+    rn = raw_sql(h, sdf, "ORDER BY g, s, r", engine="native",
+                 as_fugue=True).as_pandas()
+    assert _match(rj, rn)
+    assert e.fallbacks == {}, e.fallbacks
 
 
 def test_running_windows_fall_back_counted():
