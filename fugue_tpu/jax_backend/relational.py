@@ -1030,8 +1030,10 @@ def device_window(
 ) -> Optional[Tuple[JaxBlocks, Schema]]:
     """Window functions as device programs (verdict r3 item 4's device
     lowering): whole-partition aggregates gather segment reductions back
-    per row; ``row_number`` reuses the device_take local-rank machinery
-    (stable sort + per-segment start offsets). ``items`` mixes
+    per row; the ranking family (row_number/rank/dense_rank/ntile/
+    percent_rank/cume_dist) runs through _window_rank_family's sorted-
+    space program (stable sort + per-segment start offsets + adjacent-
+    row peer detection). ``items`` mixes
     ``("col", (out_name, src_name))`` passthroughs with ``("win", spec)``
     entries (see ``algebra_bridge.WindowSpec``). Returns None when any
     referenced column is host-resident."""
@@ -1057,7 +1059,10 @@ def device_window(
             seg, S = fr.seg, max(fr.num_segments, 1)
         else:
             seg, S = jnp.zeros((p,), dtype=jnp.int32), 1
-        if spec.func in ("row_number", "rank", "dense_rank"):
+        if spec.func in (
+            "row_number", "rank", "dense_rank", "ntile", "percent_rank",
+            "cume_dist",
+        ):
             col, tp = _window_rank_family(engine, blocks, spec, seg, S, p)
         else:
             res = _window_segment_agg(engine, blocks, spec, seg, S, p)
@@ -1082,11 +1087,13 @@ def device_window(
 def _window_rank_family(
     engine: Any, blocks: JaxBlocks, spec: Any, seg: Any, S: int, p: int
 ) -> Tuple[JaxColumn, pa.DataType]:
-    """row_number / rank / dense_rank as one device program: stable sort
-    by (order keys, partition), local position per partition, and — for
-    the ranked variants — peer-group detection by comparing ADJACENT
+    """The ranking family (row_number / rank / dense_rank / ntile /
+    percent_rank / cume_dist) as one device program: stable sort by
+    (order keys, partition), local position per partition, and — for the
+    peer-aware variants — peer-group detection by comparing ADJACENT
     sorted rows' key codes (null-neutralized exactly like the sort)."""
     kind = spec.func
+    buckets = int(getattr(spec, "param", 0) or 0)  # ntile's N
     codes = _sort_code_columns(
         blocks, [(name, asc) for name, asc, _ in spec.order_by]
     )
@@ -1117,9 +1124,18 @@ def _window_rank_family(
         starts = jnp.cumsum(cnt) - cnt
         sseg = segv[order]
         start_pos = starts[jnp.clip(sseg, 0, S - 1)]
+        psize = cnt[jnp.clip(sseg, 0, S - 1)]
         local_sorted = pos - start_pos  # 0-based row number per partition
         if kind == "row_number":
-            out_sorted = local_sorted + 1
+            out_sorted: Any = local_sorted + 1
+        elif kind == "ntile":
+            # first (psize % n) buckets take the extra rows (standard)
+            q_ = psize // buckets
+            rem = psize % buckets
+            cutoff = rem * (q_ + 1)
+            head = local_sorted // jnp.maximum(q_ + 1, 1) + 1
+            tail = rem + (local_sorted - cutoff) // jnp.maximum(q_, 1) + 1
+            out_sorted = jnp.where(local_sorted < cutoff, head, tail)
         else:
             false0 = jnp.zeros((1,), dtype=bool)
             same_part = jnp.concatenate([false0, sseg[1:] == sseg[:-1]])
@@ -1134,16 +1150,43 @@ def _window_rank_family(
                     nn = null_arrs[i][order]
                     eq = eq & jnp.concatenate([false0, nn[1:] == nn[:-1]])
                 is_peer = is_peer & eq
-            if kind == "rank":
+            if kind in ("rank", "percent_rank"):
                 # the peer-group head's GLOBAL position carries forward
                 # (cummax is safe: positions are globally increasing and
                 # every partition head starts a new peer group)
                 head_pos = jax.lax.cummax(jnp.where(~is_peer, pos, -1))
-                out_sorted = head_pos - start_pos + 1
-            else:  # dense_rank
+                rank_sorted = head_pos - start_pos + 1
+                if kind == "rank":
+                    out_sorted = rank_sorted
+                else:
+                    out_sorted = jnp.where(
+                        psize > 1,
+                        (rank_sorted - 1)
+                        / jnp.maximum(psize - 1, 1).astype(jnp.float64),
+                        0.0,
+                    )
+            elif kind == "dense_rank":
                 cs = jnp.cumsum((~is_peer).astype(jnp.int32))
                 cs_at_start = cs[jnp.clip(start_pos, 0, p - 1)]
                 out_sorted = cs - cs_at_start + 1
+            else:  # cume_dist: peers share the group's LAST position
+                big = jnp.int32(p)
+                heads = jnp.where(~is_peer, pos, big)
+                # next peer-head strictly after each position, via a
+                # reversed cummin of head positions shifted left
+                nh = jnp.flip(jax.lax.cummin(jnp.flip(
+                    jnp.concatenate([heads[1:], big[None]])
+                )))
+                part_end = start_pos + psize - 1
+                last_pos = jnp.minimum(nh - 1, part_end)
+                out_sorted = (
+                    (last_pos - start_pos + 1)
+                    / jnp.maximum(psize, 1).astype(jnp.float64)
+                )
+        if kind in ("percent_rank", "cume_dist"):
+            return jnp.zeros((p,), dtype=jnp.float64).at[order].set(
+                out_sorted.astype(jnp.float64)
+            )
         return (
             jnp.zeros((p,), dtype=jnp.int64).at[order].set(
                 out_sorted.astype(jnp.int64)
@@ -1152,7 +1195,7 @@ def _window_rank_family(
 
     rn = engine._jit_cached(
         (
-            "win_rank", kind, p, S, tuple(spec.partition_by),
+            "win_rank", kind, buckets, p, S, tuple(spec.partition_by),
             tuple(
                 (nm, asc, nf)
                 for (nm, asc, _), nf in zip(spec.order_by, na_first)
@@ -1167,11 +1210,13 @@ def _window_rank_family(
         blocks.row_valid,
         _nrows_arg(blocks),
     )
-    sharding = row_sharding(blocks.mesh)
-    return (
-        JaxColumn(pa.int64(), jax.device_put(rn, sharding)),
-        pa.int64(),
+    tp = (
+        pa.float64()
+        if kind in ("percent_rank", "cume_dist")
+        else pa.int64()
     )
+    sharding = row_sharding(blocks.mesh)
+    return (JaxColumn(tp, jax.device_put(rn, sharding)), tp)
 
 
 def _window_segment_agg(

@@ -6,9 +6,11 @@ so that on the jax engine, joins, set ops, GROUP BY and ORDER BY all run
 on device (the role the reference's SQL backends play natively:
 ``/root/reference/fugue_duckdb/execution_engine.py:238-483`` builds its
 relational ops as DuckDB SQL; here the bridge builds them as device
-relational ops). Returns ``None`` for anything outside the supported
-shape (non-equi joins, correlated subqueries, window functions, LIKE,
-EXCEPT/INTERSECT ALL) so callers fall back to the host SELECT runner.
+relational ops), including the window ranking family and
+whole-partition aggregates-over (``WindowPlan``). Returns ``None`` for
+anything outside the supported shape (non-equi joins, correlated
+subqueries, running window frames, LAG/LEAD, LIKE, EXCEPT/INTERSECT
+ALL) so callers fall back to the host SELECT runner.
 
 Name scoping is tracked per relation (each plan node knows its output
 column names), so a qualified reference to a column the relation does
@@ -150,9 +152,11 @@ class SelectPlan(Plan):
 
 
 class WindowSpec:
-    """One device-lowerable window item: ``row_number`` (needs ORDER BY)
-    or a whole-partition aggregate (sum/count/avg/min/max, no ORDER BY —
-    running frames stay on the host runner)."""
+    """One device-lowerable window item: the ranking family
+    (row_number/rank/dense_rank/ntile/percent_rank/cume_dist, needing
+    ORDER BY) or a whole-partition aggregate (sum/count/avg/min/max, no
+    ORDER BY — running frames stay on the host runner). ``param`` holds
+    ntile's bucket count."""
 
     def __init__(
         self,
@@ -161,12 +165,14 @@ class WindowSpec:
         arg: Optional[str],
         partition_by: List[str],
         order_by: List[Tuple[str, bool, Optional[bool]]],
+        param: Optional[int] = None,
     ):
         self.name = name
         self.func = func
         self.arg = arg
         self.partition_by = partition_by
         self.order_by = order_by  # (column, asc, nulls_first)
+        self.param = param
 
 
 class WindowPlan(Plan):
@@ -459,9 +465,23 @@ def _window_select(q: ast.Select, scope: _Scope, source: Plan) -> Plan:
             )
         fn = e.func.name
         arg: Optional[str] = None
-        if fn in ("row_number", "rank", "dense_rank"):
+        param: Optional[int] = None
+        if fn in ("row_number", "rank", "dense_rank", "percent_rank",
+                  "cume_dist"):
             if not order or e.func.args:
                 raise _GiveUp()
+        elif fn == "ntile":
+            if not order or len(e.func.args) != 1:
+                raise _GiveUp()
+            a0 = e.func.args[0]
+            if (
+                not isinstance(a0, ast.Lit)
+                or not isinstance(a0.value, int)
+                or isinstance(a0.value, bool)
+                or a0.value < 1
+            ):
+                raise _GiveUp()  # host runner owns the error message
+            param = a0.value
         elif fn in _DEVICE_WINDOW_AGGS:
             if order:
                 raise _GiveUp()  # running frame: host runner
@@ -476,8 +496,10 @@ def _window_select(q: ast.Select, scope: _Scope, source: Plan) -> Plan:
             else:
                 raise _GiveUp()
         else:
-            raise _GiveUp()  # rank/lag/lead etc.: host runner
-        items.append(("win", WindowSpec(item.alias, fn, arg, part, order)))
+            raise _GiveUp()  # lag/lead & expression args: host runner
+        items.append(
+            ("win", WindowSpec(item.alias, fn, arg, part, order, param))
+        )
         out_names.append(item.alias)
     lowered = [n.lower() for n in out_names]
     if len(set(lowered)) != len(lowered):

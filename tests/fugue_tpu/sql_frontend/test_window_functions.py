@@ -367,6 +367,30 @@ def test_rank_windows_route_to_device():
     assert e.fallbacks == {}, e.fallbacks
 
 
+def test_distribution_windows_route_to_device():
+    """NTILE/PERCENT_RANK/CUME_DIST lower to the device rank-family
+    program with exact oracle parity."""
+    df = _df()
+    for head in (
+        "SELECT k, v, NTILE(3) OVER (PARTITION BY k ORDER BY v) AS b"
+        " FROM",
+        "SELECT k, v, PERCENT_RANK() OVER (PARTITION BY k ORDER BY v)"
+        " AS p FROM",
+        "SELECT k, v, CUME_DIST() OVER (PARTITION BY k ORDER BY v) AS c"
+        " FROM",
+        "SELECT k, v, CUME_DIST() OVER (ORDER BY v DESC NULLS FIRST)"
+        " AS c FROM",
+        "SELECT k, v, NTILE(7) OVER (ORDER BY v) AS b FROM",
+    ):
+        e = make_execution_engine("jax")
+        rj = raw_sql(head, df, "ORDER BY k, v, 3", engine=e,
+                     as_fugue=True).as_pandas()
+        rn = raw_sql(head, df, "ORDER BY k, v, 3", engine="native",
+                     as_fugue=True).as_pandas()
+        assert _match(rj, rn), head
+        assert e.fallbacks == {}, (head, e.fallbacks)
+
+
 def test_running_windows_fall_back_counted():
     """Running (ordered) aggregate frames stay on the host runner with a
     counted fallback and identical results."""
