@@ -447,6 +447,8 @@ def _window_select(q: ast.Select, scope: _Scope, source: Plan) -> Plan:
             raise _GiveUp()
         if e.func.distinct:
             raise _GiveUp()
+        if e.frame is not None:
+            raise _GiveUp()  # explicit frame clauses: host runner
         part: List[str] = []
         for pexpr in e.partition_by:
             if not isinstance(pexpr, ast.Col):

@@ -6,7 +6,7 @@ from typing import Any, List, Optional, Tuple
 
 __all__ = [
     "Expr", "Lit", "Col", "Star", "Unary", "Binary", "Func", "Case", "Cast",
-    "InList", "Between", "Like", "IsNull", "Window",
+    "InList", "Between", "Like", "IsNull", "Window", "Frame",
     "Relation", "TableRef", "SubqueryRef", "JoinRel",
     "SelectItem", "OrderItem", "Select", "SetOp", "With", "Query",
 ]
@@ -144,23 +144,46 @@ class IsNull(Expr):
         self.negated = negated
 
 
-class Window(Expr):
-    """``func(...) OVER (PARTITION BY ... ORDER BY ...)``. No explicit
-    frame clause: with ORDER BY, aggregates use the SQL default frame
-    (RANGE UNBOUNDED PRECEDING .. CURRENT ROW — running totals where
-    peers share a value); without it, the whole partition."""
+class Frame(Node):
+    """Explicit window frame clause: ``ROWS|RANGE|GROUPS BETWEEN <bound>
+    AND <bound>``. Bounds are ``(kind, n)`` pairs with kind one of
+    ``"up"`` (UNBOUNDED PRECEDING), ``"p"`` (n PRECEDING), ``"c"``
+    (CURRENT ROW), ``"f"`` (n FOLLOWING), ``"uf"`` (UNBOUNDED
+    FOLLOWING); ``n`` is None except for "p"/"f"."""
 
-    _fields = ("func", "partition_by", "order_by")
+    _fields = ("unit", "start", "end")
+
+    def __init__(
+        self,
+        unit: str,  # "rows" | "range" | "groups"
+        start: Tuple[str, Optional[Any]],
+        end: Tuple[str, Optional[Any]],
+    ):
+        self.unit = unit
+        self.start = start
+        self.end = end
+
+
+class Window(Expr):
+    """``func(...) OVER (PARTITION BY ... ORDER BY ... [frame])``. With
+    no explicit frame clause and an ORDER BY, aggregates use the SQL
+    default frame (RANGE UNBOUNDED PRECEDING .. CURRENT ROW — running
+    totals where peers share a value); without ORDER BY, the whole
+    partition."""
+
+    _fields = ("func", "partition_by", "order_by", "frame")
 
     def __init__(
         self,
         func: "Func",
         partition_by: List["Expr"],
         order_by: List["OrderItem"],
+        frame: Optional["Frame"] = None,
     ):
         self.func = func
         self.partition_by = partition_by
         self.order_by = order_by
+        self.frame = frame
 
 
 # ---- relations ----------------------------------------------------------

@@ -13,9 +13,9 @@ comparison, boolean logic and function calls (incl. DISTINCT aggregates).
 from typing import List, Optional, Tuple
 
 from fugue_tpu.sql_frontend.ast import (
-    Between, Binary, Case, Cast, Col, Expr, Func, InList, IsNull, JoinRel,
-    Like, Lit, OrderItem, Query, Relation, Select, SelectItem, SetOp, Star,
-    SubqueryRef, TableRef, Unary, Window, With,
+    Between, Binary, Case, Cast, Col, Expr, Frame, Func, InList, IsNull,
+    JoinRel, Like, Lit, OrderItem, Query, Relation, Select, SelectItem,
+    SetOp, Star, SubqueryRef, TableRef, Unary, Window, With,
 )
 from fugue_tpu.sql_frontend.tokenizer import Token, tokenize
 
@@ -525,12 +525,53 @@ class ExprParser:
         order: List[OrderItem] = []
         if cur.is_kw("ORDER"):
             order = self._order_by_clause()
+        frame = None
         if cur.is_kw("ROWS", "RANGE", "GROUPS"):
-            raise cur.error(
-                "explicit window frame specifications are not supported"
-            )
+            frame = self._frame_clause()
         cur.expect_op(")")
-        return Window(func, partition, order)
+        return Window(func, partition, order, frame)
+
+    def _frame_clause(self) -> Frame:
+        """``ROWS|RANGE|GROUPS BETWEEN <bound> AND <bound>`` (or the
+        single-bound shorthand, whose end is CURRENT ROW)."""
+        cur = self.cur
+        unit = cur.advance().value.lower()
+        if cur.accept_kw("BETWEEN"):
+            start = self._frame_bound()
+            cur.expect_kw("AND")
+            end = self._frame_bound()
+        else:
+            start = self._frame_bound()
+            end = ("c", None)
+        if cur.is_kw("EXCLUDE"):
+            raise cur.error("EXCLUDE in window frames is not supported")
+        if start[0] == "uf" or end[0] == "up":
+            raise cur.error("window frame start cannot follow its end")
+        _rank = {"up": 0, "p": 1, "c": 2, "f": 3, "uf": 4}
+        if _rank[start[0]] > _rank[end[0]]:
+            raise cur.error("window frame start cannot follow its end")
+        return Frame(unit, start, end)
+
+    def _frame_bound(self) -> Tuple[str, Optional[object]]:
+        cur = self.cur
+        if cur.accept_kw("UNBOUNDED"):
+            if cur.accept_kw("PRECEDING"):
+                return ("up", None)
+            cur.expect_kw("FOLLOWING")
+            return ("uf", None)
+        if cur.accept_kw("CURRENT"):
+            cur.expect_kw("ROW")
+            return ("c", None)
+        t = cur.tok
+        if t.kind != "NUMBER":
+            raise cur.error("expected a numeric window frame offset")
+        cur.advance()
+        v = t.value
+        n: object = float(v) if ("." in v or "e" in v.lower()) else int(v)
+        if cur.accept_kw("PRECEDING"):
+            return ("p", n)
+        cur.expect_kw("FOLLOWING")
+        return ("f", n)
 
     def _maybe_qualified(self, first: str) -> Expr:
         cur = self.cur

@@ -790,7 +790,14 @@ def _children(e: ast.Expr) -> List[ast.Expr]:
 
 _WINDOW_ONLY_FUNCS = {
     "row_number", "rank", "dense_rank", "lag", "lead",
-    "ntile", "percent_rank", "cume_dist",
+    "ntile", "percent_rank", "cume_dist", "nth_value",
+}
+
+# aggregates that honor an explicit frame clause (ranking and lag/lead
+# are frame-independent by the standard; the frame is ignored for them)
+_FRAME_AGGS = {
+    "count", "sum", "avg", "mean", "min", "max",
+    "first", "first_value", "last", "last_value", "nth_value",
 }
 
 _NOT_LITERAL = object()
@@ -996,6 +1003,9 @@ def _eval_window(ev: "_Evaluator", e: ast.Window) -> _TS:
             shifted = shifted.where(~oob, default)
         return _back(shifted, vts.dtype)
 
+    if (name == "nth_value" or e.frame is not None) and name in _FRAME_AGGS:
+        return _eval_frame_window(ev, e, name, order, part_id, peer_id, _back)
+
     # aggregates over the window
     star = len(e.func.args) == 1 and isinstance(e.func.args[0], ast.Star)
     if star:
@@ -1101,6 +1111,297 @@ def _eval_window(ev: "_Evaluator", e: ast.Window) -> _TS:
         )
     )
     return _back(r, tp)
+
+
+def _frame_bound_check(b: Tuple[str, Any], unit: str) -> Tuple[str, Any]:
+    kind, nv = b
+    if kind in ("p", "f"):
+        if unit in ("rows", "groups"):
+            if not isinstance(nv, int) or isinstance(nv, bool) or nv < 0:
+                raise SQLExecutionError(
+                    f"{unit.upper()} frame offsets must be "
+                    "non-negative integers"
+                )
+        else:
+            if isinstance(nv, bool) or not isinstance(nv, (int, float)) \
+                    or nv < 0:
+                raise SQLExecutionError(
+                    "RANGE frame offsets must be non-negative numbers"
+                )
+    return kind, nv
+
+
+def _range_minmax(
+    codes: np.ndarray, lo: np.ndarray, hi: np.ndarray, is_min: bool
+) -> np.ndarray:
+    """Vectorized range-min/max queries over ``codes`` via a sparse
+    table: O(n log n) build, O(1) per query. ``lo``/``hi`` are inclusive
+    and must satisfy ``0 <= lo <= hi < n`` (callers mask empty frames
+    afterwards)."""
+    n = len(codes)
+    op = np.minimum if is_min else np.maximum
+    st = [codes]
+    w = 1
+    while 2 * w <= n:
+        prev = st[-1]
+        m = n - 2 * w + 1
+        st.append(op(prev[:m], prev[w:w + m]))
+        w *= 2
+    length = hi - lo + 1
+    k = np.floor(np.log2(np.maximum(length, 1))).astype(np.int64)
+    out = np.empty(len(lo), dtype=codes.dtype)
+    for kk in range(len(st)):
+        m = k == kk
+        if not m.any():
+            continue
+        w = 1 << kk
+        out[m] = op(st[kk][lo[m]], st[kk][hi[m] - w + 1])
+    return out
+
+
+def _eval_frame_window(
+    ev: "_Evaluator",
+    e: ast.Window,
+    name: str,
+    order: pd.Index,
+    part_id: pd.Series,
+    peer_id: pd.Series,
+    _back: Callable[[pd.Series, Optional[pa.DataType]], _TS],
+) -> _TS:
+    """Aggregates (and first/last/nth_value) over an EXPLICIT frame
+    clause — ROWS / RANGE / GROUPS, BETWEEN any pair of bounds — plus
+    ``nth_value`` under the default frame. Semantics follow the
+    standard as the reference's DuckDB backend executes it
+    (``/root/reference/fugue_duckdb/execution_engine.py:37``):
+    positional bounds clip to the partition, empty frames yield NULL
+    (COUNT 0), RANGE offsets need exactly one numeric ORDER BY key and
+    resolve to the null peer group on null keys."""
+    frame = e.frame
+    if frame is None:  # nth_value under the default frame
+        if e.order_by:
+            frame = ast.Frame("range", ("up", None), ("c", None))
+        else:
+            frame = ast.Frame("rows", ("up", None), ("uf", None))
+    unit = frame.unit
+    if unit == "groups" and not e.order_by:
+        raise SQLExecutionError("GROUPS frames require ORDER BY")
+    skind, sn = _frame_bound_check(frame.start, unit)
+    ekind, en = _frame_bound_check(frame.end, unit)
+
+    n = len(order)
+    pos = np.arange(n, dtype=np.int64)
+    pid = part_id.to_numpy()
+    new_part = np.empty(n, dtype=bool)
+    new_part[0] = True
+    new_part[1:] = pid[1:] != pid[:-1]
+    p_starts = np.flatnonzero(new_part)
+    p_ends = np.append(p_starts[1:], n) - 1
+    pidx = np.cumsum(new_part) - 1
+    part_start = p_starts[pidx]
+    part_end = p_ends[pidx]
+    gid = peer_id.to_numpy()
+    new_peer = np.empty(n, dtype=bool)
+    new_peer[0] = True
+    new_peer[1:] = gid[1:] != gid[:-1]
+    g_starts = np.flatnonzero(new_peer)
+    g_ends = np.append(g_starts[1:], n) - 1
+    g_glob = np.cumsum(new_peer) - 1
+    peer_start = g_starts[g_glob]
+    peer_end = g_ends[g_glob]
+
+    # ---- the argument ----------------------------------------------------
+    star = len(e.func.args) >= 1 and isinstance(e.func.args[0], ast.Star)
+    nth = 0
+    if name == "nth_value":
+        if len(e.func.args) != 2 or star:
+            raise SQLExecutionError("nth_value takes (expr, n)")
+        nv = _literal_value(e.func.args[1])
+        if not isinstance(nv, int) or isinstance(nv, bool) or nv < 1:
+            raise SQLExecutionError(
+                "nth_value position must be a positive int literal"
+            )
+        nth = nv
+    elif star:
+        if name != "count" or len(e.func.args) != 1:
+            raise SQLExecutionError(f"{name}(*) is not valid")
+    elif len(e.func.args) != 1:
+        raise SQLExecutionError(f"window {name} takes one argument")
+    if star:
+        vs = pd.Series(1, index=order)
+        vts_tp: Optional[pa.DataType] = pa.int64()
+    else:
+        vts = ev.eval(e.func.args[0])
+        vs = vts.series.loc[order]
+        vts_tp = vts.dtype
+
+    # ---- frame bounds as positions ---------------------------------------
+    def _rows_bound(kind: str, nv: Any, is_start: bool) -> np.ndarray:
+        if kind == "up":
+            return part_start.copy()
+        if kind == "uf":
+            return part_end.copy()
+        if kind == "c":
+            return pos.copy()
+        off = nv if kind == "f" else -nv
+        return pos + off
+
+    def _groups_bound(kind: str, nv: Any, is_start: bool) -> np.ndarray:
+        if kind == "up":
+            return part_start.copy()
+        if kind == "uf":
+            return part_end.copy()
+        if kind == "c":
+            return peer_start.copy() if is_start else peer_end.copy()
+        g_first = g_glob[part_start]
+        g_last = g_glob[part_end]
+        tg = g_glob + (nv if kind == "f" else -nv)
+        if is_start:
+            # before the partition's first group -> clamp to it; past the
+            # last group -> empty (one past partition end)
+            out = g_starts[np.clip(tg, g_first, g_last)]
+            return np.where(tg > g_last, part_end + 1, out)
+        out = g_ends[np.clip(tg, g_first, g_last)]
+        return np.where(tg < g_first, part_start - 1, out)
+
+    _rk: Dict[str, Any] = {}
+
+    def _range_key_state() -> Dict[str, Any]:
+        """Order-key machinery for RANGE offsets — computed once and
+        shared by the lo and hi bounds (the key expression can be
+        arbitrarily expensive)."""
+        if _rk:
+            return _rk
+        if len(e.order_by) != 1:
+            raise SQLExecutionError(
+                "RANGE frames with offsets require exactly one "
+                "ORDER BY expression"
+            )
+        o = e.order_by[0]
+        ks = ev.eval(o.expr).series.loc[order]
+        if not pd.api.types.is_numeric_dtype(
+            ks.dtype
+        ) and not ks.map(
+            lambda v: v is None or isinstance(v, (int, float))
+        ).all():
+            raise SQLExecutionError(
+                "RANGE frame offsets require a numeric ORDER BY key"
+            )
+        kv = pd.to_numeric(ks).astype("float64").to_numpy()
+        isna = np.isnan(kv)
+        if not o.asc:
+            kv = -kv
+        nulls_first = (o.nulls == "FIRST") if o.nulls is not None else False
+        spans = []  # (part first, part last, non-null first, non-null last)
+        for t in range(len(p_starts)):
+            s_, e_ = p_starts[t], p_ends[t]
+            nn = int(isna[s_:e_ + 1].sum())
+            a, b = (s_ + nn, e_) if nulls_first else (s_, e_ - nn)
+            spans.append((s_, e_, a, b))
+        _rk.update(kv=kv, isna=isna, spans=spans)
+        return _rk
+
+    def _range_bound(kind: str, nv: Any, is_start: bool) -> np.ndarray:
+        if kind == "up":
+            return part_start.copy()
+        if kind == "uf":
+            return part_end.copy()
+        if kind == "c":
+            return peer_start.copy() if is_start else peer_end.copy()
+        st = _range_key_state()
+        kv, isna = st["kv"], st["isna"]
+        delta = float(nv) if kind == "f" else -float(nv)
+        out = np.empty(n, dtype=np.int64)
+        for s_, e_, a, b in st["spans"]:
+            if a > b:  # all-null partition
+                continue
+            seg = kv[a:b + 1]
+            tgt = kv[s_:e_ + 1] + delta
+            if is_start:
+                out[s_:e_ + 1] = a + np.searchsorted(seg, tgt, side="left")
+            else:
+                out[s_:e_ + 1] = (
+                    a + np.searchsorted(seg, tgt, side="right") - 1
+                )
+        # null keys: the frame bound resolves to the null peer group
+        out[isna] = peer_start[isna] if is_start else peer_end[isna]
+        return out
+
+    bound = {"rows": _rows_bound, "groups": _groups_bound,
+             "range": _range_bound}[unit]
+    lo = bound(skind, sn, True)
+    hi = bound(ekind, en, False)
+    lo = np.maximum(lo, part_start)
+    hi = np.minimum(hi, part_end)
+    empty = lo > hi
+    lo_s = np.clip(lo, 0, n - 1)
+    hi_s = np.clip(hi, 0, n - 1)
+
+    # ---- aggregate over [lo, hi] -----------------------------------------
+    def _ser(arr: np.ndarray) -> pd.Series:
+        return pd.Series(arr, index=order)
+
+    if name == "count":
+        if star:
+            r = _ser(np.where(empty, 0, hi - lo + 1))
+        else:
+            c = np.concatenate(
+                [[0], np.cumsum(vs.notna().to_numpy(dtype="int64"))]
+            )
+            r = _ser(np.where(empty, 0, c[hi_s + 1] - c[lo_s]))
+        return _back(r.astype("int64"), pa.int64())
+    if name in ("sum", "avg", "mean"):
+        fv = vs.fillna(0).to_numpy(dtype="float64")
+        cs = np.concatenate([[0.0], np.cumsum(fv)])
+        cn = np.concatenate(
+            [[0], np.cumsum(vs.notna().to_numpy(dtype="int64"))]
+        )
+        cnt = np.where(empty, 0, cn[hi_s + 1] - cn[lo_s])
+        tot = np.where(empty, 0.0, cs[hi_s + 1] - cs[lo_s])
+        sum_tp = (
+            pa.int64()
+            if vts_tp is not None and pa.types.is_integer(vts_tp)
+            else pa.float64()
+        )
+        if name == "sum":
+            r = _ser(tot).where(cnt > 0)
+            if sum_tp == pa.int64():
+                # exact for the int64 range a float64 cumsum preserves
+                r = r.round()
+            return _back(r, sum_tp)
+        return _back(
+            _ser(np.where(cnt > 0, tot / np.maximum(cnt, 1), np.nan)).where(
+                cnt > 0
+            ),
+            pa.float64(),
+        )
+    if name in ("min", "max"):
+        codes, uniques = pd.factorize(vs, sort=True)
+        cf = codes.astype(np.float64)
+        cf[codes < 0] = np.inf if name == "min" else -np.inf
+        res = _range_minmax(cf, lo_s, hi_s, name == "min")
+        ok = np.isfinite(res) & ~empty
+        vals = np.empty(n, dtype=object)
+        vals[~ok] = None
+        if ok.any():
+            taken = np.asarray(uniques, dtype=object)[
+                res[ok].astype(np.int64)
+            ]
+            vals[ok] = taken
+        return _back(_ser(vals), vts_tp)
+    if name in ("first", "first_value", "last", "last_value", "nth_value"):
+        if name == "nth_value":
+            at = lo + nth - 1
+            bad = empty | (at > hi)
+        elif name.startswith("first"):
+            at = lo
+            bad = empty
+        else:
+            at = hi
+            bad = empty
+        arr = vs.to_numpy()
+        r = _ser(arr[np.clip(at, 0, n - 1)]).where(~_ser(bad))
+        return _back(r, vts_tp)
+    raise AssertionError(name)  # the _FRAME_AGGS gate owns the contract
 
 
 def _collect_aggs(e: ast.Expr, out: List[ast.Func]) -> None:

@@ -3,7 +3,7 @@ produces the ``StructuredRawSQL`` fragments that :class:`FugueSQLWorkflow`
 feeds to ``dag.select`` (the role of ``_beautify_sql`` + placeholder
 re-encoding in reference fugue/sql/_visitors.py:640-686)."""
 
-from typing import Callable, List, Optional, Set, Tuple
+from typing import Any, Callable, List, Optional, Set, Tuple
 
 from fugue_tpu.sql_frontend import ast
 
@@ -266,6 +266,26 @@ class _Gen:
                         self.emit(" DESC")
                     if o.nulls is not None:
                         self.emit(f" NULLS {o.nulls}")
+            if e.frame is not None:
+                if e.partition_by or e.order_by:
+                    self.emit(" ")
+                fb = {
+                    "up": "UNBOUNDED PRECEDING",
+                    "uf": "UNBOUNDED FOLLOWING",
+                    "c": "CURRENT ROW",
+                }
+
+                def _bound(b: Any) -> str:
+                    kind, nv = b
+                    if kind in fb:
+                        return fb[kind]
+                    word = "PRECEDING" if kind == "p" else "FOLLOWING"
+                    return f"{nv} {word}"
+
+                self.emit(
+                    f"{e.frame.unit.upper()} BETWEEN "
+                    f"{_bound(e.frame.start)} AND {_bound(e.frame.end)}"
+                )
             self.emit(")")
             return
         raise ValueError(f"cannot serialize {type(e).__name__}")

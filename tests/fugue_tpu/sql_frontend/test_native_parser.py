@@ -52,7 +52,16 @@ BAD = [
     "SELECT a FROM t LIMIT x",
     "SELECT CASE END FROM t",
     "SELECT a FROM (SELECT a FROM t)",  # subquery needs alias
+    "SELECT SUM(v) OVER (ORDER BY v ROWS BETWEEN 1 PRECEDING AND"
+    " CURRENT ROW EXCLUDE TIES) FROM t",
+]
+
+# valid only on the Python path (the native parser defers and the
+# fallback handles it) — explicit window frames
+PY_ONLY = [
     "SELECT SUM(v) OVER (ORDER BY v ROWS 1 PRECEDING) FROM t",
+    "SELECT SUM(v) OVER (ORDER BY v RANGE BETWEEN 1 PRECEDING AND"
+    " 1 FOLLOWING) FROM t",
 ]
 
 
@@ -87,6 +96,14 @@ def test_native_parser_defers_on_bad_sql():
         assert try_native_parse(sql) is None, sql
         with pytest.raises((SQLParseError, TokenError, ValueError)):
             _py_parse(sql)
+
+
+def test_native_parser_defers_on_python_only_syntax():
+    """Frame clauses parse on the Python path; native declines them so
+    the fallback (not a native error) owns the statement."""
+    for sql in PY_ONLY:
+        assert try_native_parse(sql) is None, sql
+        assert _py_parse(sql) is not None, sql
 
 
 def test_native_parser_matches_python_quirks():

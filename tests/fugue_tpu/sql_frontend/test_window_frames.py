@@ -1,0 +1,281 @@
+"""Explicit window frame clauses — ROWS / RANGE / GROUPS BETWEEN any
+pair of bounds — plus nth_value. Semantics to match: standard SQL as
+the reference executes it through DuckDB
+(``/root/reference/fugue_duckdb/execution_engine.py:37``): bounds clip
+to the partition, empty frames give NULL (COUNT 0), RANGE offsets need
+one numeric ORDER BY key."""
+
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fugue_tpu.sql_frontend.parser import SQLParseError
+from fugue_tpu.sql_frontend.select_runner import SQLExecutionError
+from fugue_tpu.workflow.api import raw_sql
+
+
+def _run(parts, engine="native"):
+    return raw_sql(*parts, engine=engine, as_fugue=True).as_pandas()
+
+
+def _df() -> pd.DataFrame:
+    rng = np.random.default_rng(7)
+    df = pd.DataFrame(
+        {
+            "k": rng.integers(0, 3, 25).astype(np.int64),
+            "o": np.arange(25, dtype=np.int64),
+            "v": np.round(rng.random(25) * 10, 2),
+        }
+    )
+    df.loc[::7, "v"] = np.nan
+    return df
+
+
+def _oracle(
+    df: pd.DataFrame,
+    agg: Callable[[List[Any]], Any],
+    lo_of: Callable[[int, int], int],
+    hi_of: Callable[[int, int], int],
+) -> pd.Series:
+    """Brute-force frame oracle: for each row (per partition, ordered by
+    ``o``), apply ``agg`` to values at sorted positions
+    [lo_of(i, n), hi_of(i, n)] clipped to the partition."""
+    out = pd.Series(index=df.index, dtype=object)
+    for _, g in df.groupby("k"):
+        g = g.sort_values("o")
+        vals = list(g["v"])
+        n = len(vals)
+        for i, idx in enumerate(g.index):
+            lo = max(0, lo_of(i, n))
+            hi = min(n - 1, hi_of(i, n))
+            out[idx] = None if lo > hi else agg(vals[lo:hi + 1])
+    return out
+
+
+def _sum(vals: List[Any]) -> Any:
+    xs = [v for v in vals if not pd.isna(v)]
+    return None if not xs else sum(xs)
+
+
+def _cnt(vals: List[Any]) -> Any:
+    return sum(0 if pd.isna(v) else 1 for v in vals)
+
+
+def _minv(vals: List[Any]) -> Any:
+    xs = [v for v in vals if not pd.isna(v)]
+    return None if not xs else min(xs)
+
+
+def _eq(r: pd.Series, exp: pd.Series) -> None:
+    a = pd.to_numeric(r, errors="coerce")
+    b = pd.to_numeric(exp.astype(object).where(exp.notna()), errors="coerce")
+    assert np.allclose(
+        a.to_numpy(dtype=float), b.to_numpy(dtype=float), equal_nan=True
+    ), f"\ngot:\n{a}\nexpected:\n{b}"
+
+
+@pytest.mark.parametrize("engine", ["native", "jax"])
+def test_rows_moving_sum(engine):
+    df = _df()
+    r = _run(
+        ("SELECT k, o, SUM(v) OVER (PARTITION BY k ORDER BY o"
+         " ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) AS s FROM", df,
+         "ORDER BY k, o"),
+        engine=engine,
+    )
+    exp = _oracle(df, _sum, lambda i, n: i - 1, lambda i, n: i)
+    merged = df.assign(exp=exp).sort_values(["k", "o"])
+    _eq(r["s"].reset_index(drop=True),
+        merged["exp"].reset_index(drop=True))
+
+
+def test_rows_shorthand_preceding():
+    # "ROWS 2 PRECEDING" == BETWEEN 2 PRECEDING AND CURRENT ROW
+    df = _df()
+    r = _run(
+        ("SELECT k, o, COUNT(v) OVER (PARTITION BY k ORDER BY o"
+         " ROWS 2 PRECEDING) AS c FROM", df, "ORDER BY k, o")
+    )
+    exp = _oracle(df, _cnt, lambda i, n: i - 2, lambda i, n: i)
+    merged = df.assign(exp=exp).sort_values(["k", "o"])
+    assert list(r["c"]) == [int(x) for x in merged["exp"]]
+
+
+def test_rows_following_empty_frames():
+    df = _df()
+    r = _run(
+        ("SELECT k, o,"
+         " SUM(v) OVER (PARTITION BY k ORDER BY o"
+         "   ROWS BETWEEN 1 FOLLOWING AND 2 FOLLOWING) AS s,"
+         " COUNT(*) OVER (PARTITION BY k ORDER BY o"
+         "   ROWS BETWEEN 1 FOLLOWING AND 2 FOLLOWING) AS c"
+         " FROM", df, "ORDER BY k, o")
+    )
+    exp_s = _oracle(df, _sum, lambda i, n: i + 1, lambda i, n: i + 2)
+    exp_c = _oracle(
+        df, lambda vs: len(vs), lambda i, n: i + 1, lambda i, n: i + 2
+    )
+    merged = df.assign(es=exp_s, ec=exp_c).sort_values(["k", "o"])
+    _eq(r["s"].reset_index(drop=True),
+        merged["es"].reset_index(drop=True))
+    # empty frame -> COUNT(*) 0, and the last row of each partition is empty
+    assert list(r["c"]) == [
+        0 if x is None else int(x) for x in merged["ec"]
+    ]
+    assert (r.groupby("k")["c"].last() == 0).all()
+
+
+def test_rows_minmax_window():
+    df = _df()
+    r = _run(
+        ("SELECT k, o, MIN(v) OVER (PARTITION BY k ORDER BY o"
+         " ROWS BETWEEN 2 PRECEDING AND 1 FOLLOWING) AS m FROM", df,
+         "ORDER BY k, o")
+    )
+    exp = _oracle(df, _minv, lambda i, n: i - 2, lambda i, n: i + 1)
+    merged = df.assign(exp=exp).sort_values(["k", "o"])
+    _eq(r["m"].reset_index(drop=True),
+        merged["exp"].reset_index(drop=True))
+
+
+def test_rows_unbounded_following_reverse_running():
+    df = _df()
+    r = _run(
+        ("SELECT k, o, SUM(v) OVER (PARTITION BY k ORDER BY o"
+         " ROWS BETWEEN CURRENT ROW AND UNBOUNDED FOLLOWING) AS s FROM",
+         df, "ORDER BY k, o")
+    )
+    exp = _oracle(df, _sum, lambda i, n: i, lambda i, n: n - 1)
+    merged = df.assign(exp=exp).sort_values(["k", "o"])
+    _eq(r["s"].reset_index(drop=True),
+        merged["exp"].reset_index(drop=True))
+
+
+def test_range_numeric_offsets():
+    dd = pd.DataFrame(
+        {"x": [1.0, 2.0, 2.0, 4.0, 7.0, 8.0],
+         "v": [1, 2, 3, 4, 5, 6]}
+    )
+    r = _run(
+        ("SELECT x, SUM(v) OVER (ORDER BY x"
+         " RANGE BETWEEN 1 PRECEDING AND 1 FOLLOWING) AS s FROM", dd,
+         "ORDER BY x, v")
+    )
+    # per row: sum of v where |x_j - x_i| <= 1
+    exp = [
+        sum(vv for xx, vv in zip(dd["x"], dd["v"]) if abs(xx - x) <= 1)
+        for x in sorted(dd["x"])
+    ]
+    assert [int(s) for s in r["s"]] == exp
+
+
+def test_range_desc_and_null_keys():
+    dd = pd.DataFrame(
+        {"x": [10.0, 9.0, 9.0, 5.0, None, None],
+         "v": [1, 2, 3, 4, 100, 200]}
+    )
+    r = _run(
+        ("SELECT x, v, SUM(v) OVER (ORDER BY x DESC"
+         " RANGE BETWEEN 1 PRECEDING AND CURRENT ROW) AS s FROM", dd,
+         "ORDER BY v")
+    )
+    by_v = r.set_index("v")["s"]
+    # DESC: "1 preceding" = keys in [x, x+1]
+    assert by_v[1] == 1          # x=10: only itself
+    assert by_v[2] == 6 and by_v[3] == 6   # x=9: 10,9,9
+    assert by_v[4] == 4          # x=5: nothing within [5,6] but itself
+    # null keys: frame = the null peer group
+    assert by_v[100] == 300 and by_v[200] == 300
+
+
+def test_groups_frame():
+    dd = pd.DataFrame(
+        {"x": [1, 1, 2, 2, 2, 5], "v": [1, 2, 3, 4, 5, 6]}
+    )
+    r = _run(
+        ("SELECT x, v, SUM(v) OVER (ORDER BY x"
+         " GROUPS BETWEEN 1 PRECEDING AND CURRENT ROW) AS s FROM", dd,
+         "ORDER BY v")
+    )
+    by_v = r.set_index("v")["s"]
+    # group 1: {1,2}; group 2: {3,4,5}; group 3: {6}
+    assert by_v[1] == 3 and by_v[2] == 3
+    assert by_v[3] == 15 and by_v[4] == 15 and by_v[5] == 15
+    assert by_v[6] == 18  # groups {2} + {5}: 3+4+5+6
+
+
+@pytest.mark.parametrize("engine", ["native", "jax"])
+def test_first_last_nth_value_frames(engine):
+    dd = pd.DataFrame({"x": [1, 2, 3, 4, 5], "v": [10, 20, 30, 40, 50]})
+    r = _run(
+        ("SELECT x,"
+         " FIRST_VALUE(v) OVER (ORDER BY x"
+         "   ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING) AS f,"
+         " LAST_VALUE(v) OVER (ORDER BY x"
+         "   ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING) AS l,"
+         " NTH_VALUE(v, 2) OVER (ORDER BY x"
+         "   ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING) AS n2"
+         " FROM", dd, "ORDER BY x"),
+        engine=engine,
+    )
+    assert list(r["f"]) == [10, 10, 20, 30, 40]
+    assert list(r["l"]) == [20, 30, 40, 50, 50]
+    assert list(r["n2"]) == [20, 20, 30, 40, 50]
+
+
+def test_nth_value_default_frame():
+    # default frame = RANGE UNBOUNDED PRECEDING .. CURRENT ROW: nth_value
+    # is NULL until the frame reaches n rows
+    dd = pd.DataFrame({"x": [1, 2, 3], "v": [7, 8, 9]})
+    r = _run(
+        ("SELECT x, NTH_VALUE(v, 2) OVER (ORDER BY x) AS n2 FROM", dd,
+         "ORDER BY x")
+    )
+    assert pd.isna(r["n2"].iloc[0])
+    assert list(r["n2"].iloc[1:]) == [8, 8]
+
+
+def test_frame_ignored_for_ranking():
+    dd = pd.DataFrame({"x": [3, 1, 2]})
+    r = _run(
+        ("SELECT x, ROW_NUMBER() OVER (ORDER BY x"
+         " ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) AS rn FROM", dd,
+         "ORDER BY x")
+    )
+    assert list(r["rn"]) == [1, 2, 3]
+
+
+def test_avg_over_rows_frame():
+    dd = pd.DataFrame({"x": [1, 2, 3, 4], "v": [2.0, 4.0, None, 8.0]})
+    r = _run(
+        ("SELECT x, AVG(v) OVER (ORDER BY x"
+         " ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) AS a FROM", dd,
+         "ORDER BY x")
+    )
+    assert list(r["a"].round(4)) == [2.0, 3.0, 4.0, 8.0]
+
+
+def test_frame_errors():
+    dd = pd.DataFrame({"x": [1, 2], "v": [1, 2]})
+    with pytest.raises(SQLParseError):
+        _run(("SELECT SUM(v) OVER (ORDER BY x ROWS BETWEEN CURRENT ROW"
+              " AND 1 PRECEDING) AS s FROM", dd))
+    with pytest.raises(SQLParseError):
+        _run(("SELECT SUM(v) OVER (ORDER BY x ROWS BETWEEN 1 PRECEDING"
+              " AND CURRENT ROW EXCLUDE CURRENT ROW) AS s FROM", dd))
+    with pytest.raises(SQLExecutionError):
+        _run(("SELECT SUM(v) OVER (ORDER BY x"
+              " ROWS BETWEEN 1.5 PRECEDING AND CURRENT ROW) AS s FROM",
+              dd))
+    with pytest.raises(SQLExecutionError):
+        _run(("SELECT SUM(v) OVER (ORDER BY x, v"
+              " RANGE BETWEEN 1 PRECEDING AND CURRENT ROW) AS s FROM",
+              dd))
+    with pytest.raises(SQLExecutionError):
+        _run(("SELECT SUM(v) OVER (PARTITION BY x"
+              " GROUPS BETWEEN 1 PRECEDING AND CURRENT ROW) AS s FROM",
+              dd))
+    with pytest.raises(SQLExecutionError):
+        _run(("SELECT NTH_VALUE(v, 0) OVER (ORDER BY x) AS s FROM", dd))

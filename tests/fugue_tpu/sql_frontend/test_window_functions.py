@@ -290,11 +290,12 @@ def test_window_over_aggregate_rejected():
               "GROUP BY k"))
 
 
-def test_frame_clause_rejected():
+def test_frame_exclude_rejected():
+    # frames are supported (test_window_frames.py); EXCLUDE is not
     df = _df()
     with pytest.raises(Exception):
         _run(("SELECT SUM(v) OVER (ORDER BY v ROWS BETWEEN 1 PRECEDING"
-              " AND CURRENT ROW) AS s FROM", df))
+              " AND CURRENT ROW EXCLUDE NO OTHERS) AS s FROM", df))
 
 
 def _match(rj: pd.DataFrame, rn: pd.DataFrame) -> bool:
