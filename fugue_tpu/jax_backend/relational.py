@@ -1064,6 +1064,11 @@ def device_window(
             "cume_dist",
         ):
             col, tp = _window_rank_family(engine, blocks, spec, seg, S, p)
+        elif spec.order_by:
+            res = _window_frame_agg(engine, blocks, spec, seg, S, p)
+            if res is None:
+                return None
+            col, tp = res
         else:
             res = _window_segment_agg(engine, blocks, spec, seg, S, p)
             if res is None:
@@ -1217,6 +1222,315 @@ def _window_rank_family(
     )
     sharding = row_sharding(blocks.mesh)
     return (JaxColumn(tp, jax.device_put(rn, sharding)), tp)
+
+
+def _window_frame_agg(
+    engine: Any, blocks: JaxBlocks, spec: Any, seg: Any, S: int, p: int
+) -> Optional[Tuple[JaxColumn, pa.DataType]]:
+    """Ordered window programs in sorted space (the role the reference's
+    DuckDB backend plays natively for framed/running windows,
+    ``/root/reference/fugue_duckdb/execution_engine.py:37``): stable
+    sort by (order keys, partition), then
+
+    - running (default RANGE) aggregates: segment-offset prefix sums
+      with peers sharing their group's LAST value,
+    - ROWS-framed aggregates: prefix-sum differences over positional
+      [lo, hi] bounds; min/max via a log2(p)-level sparse table,
+    - lag/lead: a shifted gather with partition-boundary masking,
+    - first/last/nth_value: gathers at frame boundary positions,
+
+    and one scatter back to row space. Returns None when the argument or
+    a sort key is host-resident or the dtype is outside the device set.
+    """
+    func = "avg" if spec.func == "mean" else spec.func
+    gather_like = func in (
+        "lag", "lead", "first_value", "last_value", "nth_value"
+    )
+    if spec.arg is None:  # count(*)
+        vcol = None
+        arg_tp: Optional[pa.DataType] = None
+    else:
+        vcol = blocks.columns.get(spec.arg)
+        if vcol is None or not vcol.on_device:
+            return None
+        if vcol.is_string and not gather_like:
+            return None
+        if vcol.is_string and spec.default is not None:
+            return None  # a fill literal has no dictionary code
+        if (
+            spec.default is not None
+            and isinstance(spec.default, float)
+            and pa.types.is_integer(vcol.pa_type)
+        ):
+            return None  # the host upcasts int columns to float here
+        arg_tp = vcol.pa_type
+    cast_result = True
+    if func == "count":
+        tp: pa.DataType = pa.int64()
+    elif func in ("sum", "avg"):
+        if arg_tp is None or not (
+            pa.types.is_integer(arg_tp)
+            or pa.types.is_floating(arg_tp)
+            or pa.types.is_boolean(arg_tp)
+        ):
+            return None
+        tp = (
+            pa.float64()
+            if func == "avg"
+            else (pa.int64() if pa.types.is_integer(arg_tp) else pa.float64())
+        )
+    elif func in ("min", "max"):
+        if arg_tp is None or pa.types.is_boolean(arg_tp):
+            return None
+        tp = arg_tp
+        if pa.types.is_timestamp(arg_tp) or pa.types.is_date32(arg_tp):
+            cast_result = False
+    else:  # gathers keep the argument's device representation
+        assert arg_tp is not None
+        tp = arg_tp
+        cast_result = False
+    codes = _sort_code_columns(
+        blocks, [(name, asc) for name, asc, _ in spec.order_by]
+    )
+    if codes is None:
+        return None
+    na_first = [
+        (nf if nf is not None else False) for _, _, nf in spec.order_by
+    ]
+    frame = spec.frame  # None = running default frame (peers share)
+    off = int(spec.param or 0)  # lag/lead offset or nth_value position
+    default = spec.default
+    values = None if vcol is None else vcol.data
+    vmask = None if vcol is None else vcol.mask
+
+    def _prog(
+        code_arrs: Tuple[Any, ...],
+        null_arrs: Dict[int, Any],
+        values_: Optional[Any],
+        vmask_: Optional[Any],
+        seg_: Any,
+        row_valid: Optional[Any],
+        nrows_s: Any,
+    ) -> Tuple[Any, Optional[Any]]:
+        valid = groupby.materialize_validity(row_valid, p, nrows_s)
+        order = _stable_sort_order(
+            code_arrs, null_arrs,
+            [asc for _, _, asc in codes],  # type: ignore[misc]
+            na_first, valid, invalid_last=False,
+        )
+        segv = jnp.where(valid, seg_, S)
+        order = order[jnp.argsort(segv[order], stable=True)]
+        pos = jnp.arange(p, dtype=jnp.int32)
+        cnt = jax.ops.segment_sum(
+            valid.astype(jnp.int32), segv, num_segments=S + 1
+        )[:S]
+        starts = jnp.cumsum(cnt) - cnt
+        sseg = segv[order]
+        part_start = starts[jnp.clip(sseg, 0, S - 1)]
+        psize = cnt[jnp.clip(sseg, 0, S - 1)]
+        part_end = part_start + psize - 1
+        svalid = valid[order]
+        sv = None if values_ is None else values_[order]
+        if values_ is None:
+            sm = svalid
+        elif vmask_ is None:
+            sm = svalid
+        else:
+            sm = svalid & vmask_[order]
+        if sv is not None and jnp.issubdtype(sv.dtype, jnp.floating):
+            sm = sm & ~jnp.isnan(sv)
+
+        def _scatter(out_sorted: Any, m_sorted: Optional[Any]) -> Tuple[
+            Any, Optional[Any]
+        ]:
+            out = jnp.zeros((p,), dtype=out_sorted.dtype).at[order].set(
+                out_sorted
+            )
+            m = (
+                None
+                if m_sorted is None
+                else jnp.zeros((p,), dtype=bool).at[order].set(m_sorted)
+            )
+            return out, m
+
+        if func in ("lag", "lead"):
+            src = pos - off if func == "lag" else pos + off
+            inb = (src >= part_start) & (src <= part_end)
+            srcc = jnp.clip(src, 0, p - 1)
+            val = sv[srcc]
+            vm = sm[srcc] & inb
+            if default is not None:
+                dv = jnp.asarray(default).astype(val.dtype)
+                val = jnp.where(inb, val, dv)
+                vm = vm | ~inb
+            return _scatter(val, vm)
+
+        # frame bounds [lo, hi] in sorted space
+        if frame is None:
+            # running: lo = partition start, hi = peer group's LAST row
+            false0 = jnp.zeros((1,), dtype=bool)
+            same_part = jnp.concatenate([false0, sseg[1:] == sseg[:-1]])
+            is_peer = same_part
+            for i, c in enumerate(code_arrs):
+                sc = c
+                if i in null_arrs:
+                    sc = jnp.where(null_arrs[i], jnp.zeros_like(sc), sc)
+                scs = sc[order]
+                eq = jnp.concatenate([false0, scs[1:] == scs[:-1]])
+                if i in null_arrs:
+                    nn = null_arrs[i][order]
+                    eq = eq & jnp.concatenate([false0, nn[1:] == nn[:-1]])
+                is_peer = is_peer & eq
+            big = jnp.int32(p)
+            heads = jnp.where(~is_peer, pos, big)
+            nh = jnp.flip(jax.lax.cummin(jnp.flip(
+                jnp.concatenate([heads[1:], big[None]])
+            )))
+            lo = part_start
+            hi = jnp.minimum(nh - 1, part_end)
+        else:
+            sk, sn, ek, en = frame
+
+            def _bound(kd: str, nv: Optional[int]) -> Any:
+                if kd == "up":
+                    return part_start
+                if kd == "uf":
+                    return part_end
+                if kd == "c":
+                    return pos
+                return pos + nv if kd == "f" else pos - nv
+
+            lo = jnp.maximum(_bound(sk, sn), part_start)
+            hi = jnp.minimum(_bound(ek, en), part_end)
+        empty = lo > hi
+        lo_s = jnp.clip(lo, 0, p - 1)
+        hi_s = jnp.clip(hi, 0, p - 1)
+
+        if func == "count":
+            if sv is None:
+                out = jnp.where(empty, 0, hi - lo + 1).astype(jnp.int64)
+            else:
+                c = jnp.concatenate(
+                    [jnp.zeros((1,), jnp.int64), jnp.cumsum(
+                        sm.astype(jnp.int64)
+                    )]
+                )
+                out = jnp.where(empty, 0, c[hi_s + 1] - c[lo_s])
+            return _scatter(out.astype(jnp.int64), None)
+        if func in ("sum", "avg"):
+            acc = (
+                jnp.int64
+                if arg_tp is not None and pa.types.is_integer(arg_tp)
+                else jnp.float64
+            )
+            fv = jnp.where(sm, sv.astype(acc), jnp.zeros((), acc))
+            cs = jnp.concatenate(
+                [jnp.zeros((1,), acc), jnp.cumsum(fv)]
+            )
+            cn = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int64), jnp.cumsum(
+                    sm.astype(jnp.int64)
+                )]
+            )
+            fcnt = jnp.where(empty, 0, cn[hi_s + 1] - cn[lo_s])
+            tot = jnp.where(
+                empty, jnp.zeros((), acc), cs[hi_s + 1] - cs[lo_s]
+            )
+            if func == "sum":
+                return _scatter(tot, fcnt > 0)
+            return _scatter(
+                tot.astype(jnp.float64)
+                / jnp.maximum(fcnt, 1).astype(jnp.float64),
+                fcnt > 0,
+            )
+        if func in ("min", "max"):
+            is_min = func == "min"
+            if jnp.issubdtype(sv.dtype, jnp.floating):
+                sentinel = jnp.array(
+                    jnp.inf if is_min else -jnp.inf, dtype=sv.dtype
+                )
+            else:
+                info = jnp.iinfo(sv.dtype)
+                sentinel = jnp.array(
+                    info.max if is_min else info.min, dtype=sv.dtype
+                )
+            op = jnp.minimum if is_min else jnp.maximum
+            level = jnp.where(sm, sv, sentinel)
+            levels = [level]
+            w = 1
+            while w < p:
+                shifted = jnp.concatenate(
+                    [level[w:], jnp.full((w,), sentinel, dtype=sv.dtype)]
+                )
+                level = op(level, shifted)
+                levels.append(level)
+                w *= 2
+            stack = jnp.stack(levels)  # (K, p): min/max over [i, i+2^k-1]
+            length = (hi_s - lo_s + 1).astype(jnp.float64)
+            kq = jnp.floor(
+                jnp.log2(jnp.maximum(length, 1.0))
+            ).astype(jnp.int32)
+            flat = stack.reshape(-1)
+            a = flat[kq * p + lo_s]
+            b = flat[kq * p + jnp.maximum(hi_s - (1 << kq) + 1, 0)]
+            out = op(a, b)
+            cn = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int64), jnp.cumsum(
+                    sm.astype(jnp.int64)
+                )]
+            )
+            fcnt = jnp.where(empty, 0, cn[hi_s + 1] - cn[lo_s])
+            if cast_result:
+                out = out.astype(tp.to_pandas_dtype())
+            return _scatter(out, fcnt > 0)
+        # first/last/nth_value: boundary gathers
+        if func == "nth_value":
+            at = lo + off - 1
+            bad = empty | (at > hi)
+        elif func == "first_value":
+            at = lo
+            bad = empty
+        else:
+            at = hi
+            bad = empty
+        atc = jnp.clip(at, 0, p - 1)
+        return _scatter(sv[atc], sm[atc] & ~bad)
+
+    out, outm = engine._jit_cached(
+        (
+            "win_frame", func, spec.arg, frame, off,
+            None if default is None else float(default), p, S,
+            tuple(spec.partition_by),
+            tuple(
+                (nm, asc, nf)
+                for (nm, asc, _), nf in zip(spec.order_by, na_first)
+            ),
+            str(tp), vmask is not None,
+            tuple(i for i in range(len(codes)) if codes[i][1] is not None),
+        ),
+        _prog,
+    )(
+        tuple(c for c, _, _ in codes),
+        {i: nl for i, (_, nl, _) in enumerate(codes) if nl is not None},
+        values,
+        vmask,
+        seg,
+        blocks.row_valid,
+        _nrows_arg(blocks),
+    )
+    sharding = row_sharding(blocks.mesh)
+    dictionary = None if vcol is None else (
+        vcol.dictionary if gather_like else None
+    )
+    return (
+        JaxColumn(
+            tp,
+            jax.device_put(out, sharding),
+            None if outm is None else jax.device_put(outm, sharding),
+            dictionary=dictionary,
+        ),
+        tp,
+    )
 
 
 def _window_segment_agg(

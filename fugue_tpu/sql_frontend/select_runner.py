@@ -1001,7 +1001,15 @@ def _eval_window(ev: "_Evaluator", e: ast.Window) -> _TS:
                 psize = grp.transform("size")
                 oob = rn > psize - offset
             shifted = shifted.where(~oob, default)
-        return _back(shifted, vts.dtype)
+        tp = vts.dtype
+        if (
+            default is not None
+            and isinstance(default, float)
+            and tp is not None
+            and pa.types.is_integer(tp)
+        ):
+            tp = pa.float64()  # a float fill widens an int column
+        return _back(shifted, tp)
 
     if (name == "nth_value" or e.frame is not None) and name in _FRAME_AGGS:
         return _eval_frame_window(ev, e, name, order, part_id, peer_id, _back)

@@ -392,14 +392,39 @@ def test_distribution_windows_route_to_device():
         assert e.fallbacks == {}, (head, e.fallbacks)
 
 
-def test_running_windows_fall_back_counted():
-    """Running (ordered) aggregate frames stay on the host runner with a
+def test_running_windows_route_to_device():
+    """Running (ordered, default-frame) aggregates lower to the device
+    sorted-space prefix-sum program — peers share their group's last
+    value, fallbacks == {}."""
+    df = _df()
+    for head in (
+        "SELECT k, v, SUM(v) OVER (PARTITION BY k ORDER BY v) AS s FROM",
+        "SELECT k, v, COUNT(v) OVER (PARTITION BY k ORDER BY v) AS c,"
+        " AVG(v) OVER (PARTITION BY k ORDER BY v) AS a FROM",
+        "SELECT k, v, MIN(v) OVER (PARTITION BY k ORDER BY v DESC) AS m,"
+        " MAX(v) OVER (ORDER BY v NULLS FIRST) AS x FROM",
+    ):
+        parts = (head, df, "ORDER BY k, v, 3")
+        e = make_execution_engine("jax")
+        rj = raw_sql(*parts, engine=e, as_fugue=True).as_pandas()
+        rn = _run(parts)
+        assert _match(rj, rn), head
+        assert e.fallbacks == {}, (head, e.fallbacks)
+
+
+def test_groups_and_range_offset_windows_fall_back_counted():
+    """GROUPS frames and RANGE offsets stay on the host runner with a
     counted fallback and identical results."""
     df = _df()
-    parts = ("SELECT k, v, SUM(v) OVER (PARTITION BY k ORDER BY v) AS s"
-             " FROM", df, "ORDER BY k, v")
-    e = make_execution_engine("jax")
-    rj = raw_sql(*parts, engine=e, as_fugue=True).as_pandas()
-    rn = _run(parts)
-    assert _match(rj, rn)
-    assert e.fallbacks.get("sql_select", 0) >= 1
+    for head in (
+        "SELECT k, v, SUM(v) OVER (PARTITION BY k ORDER BY v"
+        " GROUPS BETWEEN 1 PRECEDING AND CURRENT ROW) AS s FROM",
+        "SELECT k, v, SUM(v) OVER (PARTITION BY k ORDER BY v"
+        " RANGE BETWEEN 0.5 PRECEDING AND 0.5 FOLLOWING) AS s FROM",
+    ):
+        parts = (head, df, "ORDER BY k, v, 3")
+        e = make_execution_engine("jax")
+        rj = raw_sql(*parts, engine=e, as_fugue=True).as_pandas()
+        rn = _run(parts)
+        assert _match(rj, rn), head
+        assert e.fallbacks.get("sql_select", 0) >= 1, head
