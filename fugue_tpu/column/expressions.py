@@ -409,6 +409,28 @@ class _FuncExpr(ColumnExpr):
             types = [a.infer_type(schema) for a in self._args]
             types = [t for t in types if t is not None and not pa.types.is_null(t)]
             return types[0] if types else None
+        if f == "like":
+            return pa.bool_()
+        if f == "case_when":
+            # value branches: args 1, 3, ... and the trailing default
+            vals = [
+                a
+                for i, a in enumerate(self._args)
+                if i % 2 == 1 or i == len(self._args) - 1
+            ]
+            types = [a.infer_type(schema) for a in vals]
+            types = [t for t in types if t is not None and not pa.types.is_null(t)]
+            if not types:
+                return None
+            out = types[0]
+            for t in types[1:]:
+                if t == out:
+                    continue
+                p = _promote(out, t, "+")
+                if p is None:
+                    return None
+                out = p
+            return out
         return None
 
     def _uuid_keys(self) -> List[Any]:

@@ -47,6 +47,25 @@ def count_distinct(col: Any) -> ColumnExpr:
     return _agg("count", col, arg_distinct=True)
 
 
+def like(col: Any, pattern: str, negated: bool = False) -> ColumnExpr:
+    """SQL ``LIKE`` with a literal pattern (``%``/``_`` wildcards)."""
+    assert_or_throw(
+        isinstance(pattern, str), ValueError("LIKE pattern must be a string")
+    )
+    return _FuncExpr("like", _to_col(col), pattern, bool(negated))
+
+
+def case_when(*args: Any) -> ColumnExpr:
+    """``CASE WHEN c1 THEN v1 [WHEN c2 THEN v2 ...] ELSE d END`` —
+    arguments are condition/value pairs followed by the default (odd
+    argument count required)."""
+    assert_or_throw(
+        len(args) >= 3 and len(args) % 2 == 1,
+        ValueError("case_when takes cond/value pairs plus a default"),
+    )
+    return _FuncExpr("case_when", *[_to_col(a) for a in args])
+
+
 def coalesce(*args: Any) -> ColumnExpr:
     assert_or_throw(len(args) > 0, ValueError("coalesce requires at least one arg"))
     return _FuncExpr("coalesce", *[_to_col(a) for a in args])

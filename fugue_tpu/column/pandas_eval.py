@@ -4,6 +4,7 @@ qpd-SQL-on-pandas dependency with a direct expression interpreter; SQL
 semantics: Kleene logic via pandas nullable booleans, nulls ignored by aggs).
 """
 
+import re
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -97,8 +98,57 @@ def _eval(df: pd.DataFrame, expr: ColumnExpr) -> pd.Series:
             for a in args[1:]:
                 res = res.combine_first(a)
             return res
+        if f == "like":
+            operand = _eval(df, expr.args[0])
+            pattern = expr.args[1]
+            negated = expr.args[2]
+            assert_or_throw(
+                isinstance(pattern, _LitColumnExpr)
+                and isinstance(pattern.value, str)
+                and isinstance(negated, _LitColumnExpr),
+                ValueError("LIKE needs a literal pattern"),
+            )
+            rx = like_pattern_to_regex(pattern.value)
+            res = operand.astype("string").str.fullmatch(rx).astype("boolean")
+            if negated.value:
+                res = ~res
+            res[operand.isna()] = pd.NA  # NULL LIKE anything -> NULL
+            return res
+        if f == "case_when":
+            # cond/value pairs + default; NULL conditions don't match —
+            # fill NA up front so one NULL condition can't poison the
+            # matched accumulator for later branches (review finding)
+            default = _eval(df, expr.args[-1])
+            res = default.copy()
+            matched = pd.Series(False, index=df.index)
+            for i in range(0, len(expr.args) - 1, 2):
+                cond = (
+                    _bool_series(_eval(df, expr.args[i]))
+                    .fillna(False)
+                    .astype(bool)
+                )
+                val = _eval(df, expr.args[i + 1])
+                take = cond & ~matched
+                if take.any():
+                    res = val.where(take, res)
+                matched = matched | cond
+            return res
         raise NotImplementedError(f"function {expr.func} not supported on pandas")
     raise NotImplementedError(f"can't evaluate {expr}")
+
+
+def like_pattern_to_regex(pattern: str) -> str:
+    """SQL LIKE pattern -> an equivalent regex (``%`` -> ``.*``,
+    ``_`` -> ``.``, everything else literal)."""
+    out = []
+    for ch in pattern:
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+    return "".join(out)
 
 
 def _cast_series(s: pd.Series, tp: pa.DataType) -> pd.Series:

@@ -670,6 +670,7 @@ class JaxExecutionEngine(ExecutionEngine):
         if expr_eval.can_eval_on_device(condition, jdf.blocks):
             blocks = jdf.blocks
             pad_n = blocks.padded_nrows
+            dicts = expr_eval.dicts_of(blocks)
 
             def _filter_prog(
                 mcols: Dict[str, Any], row_valid: Optional[Any], nrows_s: Any
@@ -677,7 +678,9 @@ class JaxExecutionEngine(ExecutionEngine):
                 row_valid = groupby.materialize_validity(
                     row_valid, pad_n, nrows_s
                 )
-                value, mask = expr_eval.eval_expr(mcols, condition, pad_n)
+                value, mask = expr_eval.eval_expr(
+                    mcols, condition, pad_n, dicts
+                )
                 keep = value.astype(jnp.bool_)
                 if mask is not None:
                     keep = keep & mask
@@ -685,7 +688,8 @@ class JaxExecutionEngine(ExecutionEngine):
                 return keep, jnp.sum(keep).astype(jnp.int32)
 
             keep, cnt = self._jit_cached(
-                ("filter", condition.__uuid__(), pad_n), _filter_prog
+                ("filter", condition.__uuid__(), pad_n,
+                 expr_eval.dict_fingerprint(blocks)), _filter_prog
             )(
                 expr_eval.blocks_to_masked(blocks),
                 blocks.row_valid,
@@ -709,6 +713,7 @@ class JaxExecutionEngine(ExecutionEngine):
         blocks = jdf.blocks
         if all(expr_eval.can_eval_on_device(c, blocks) for c in columns):
             pad_n = blocks.padded_nrows
+            dicts = expr_eval.dicts_of(blocks)
             schema = jdf.schema
             plans: List[Tuple[str, Any, ColumnExpr]] = []
             for c in columns:
@@ -726,25 +731,35 @@ class JaxExecutionEngine(ExecutionEngine):
             def _assign_prog(mcols: Dict[str, Any]) -> Dict[str, Any]:
                 outs: Dict[str, Any] = {}
                 for name, _tp, c in plans:
-                    v, m = expr_eval.eval_expr(mcols, c, pad_n)
+                    v, m = expr_eval.eval_expr(mcols, c, pad_n, dicts)
                     outs[f"v:{name}"] = v
                     if m is not None:
                         outs[f"m:{name}"] = m
                 return outs
 
             outs = self._jit_cached(
-                ("assign", tuple(c.__uuid__() for c in columns), pad_n),
+                ("assign", tuple(c.__uuid__() for c in columns), pad_n,
+                 expr_eval.dict_fingerprint(blocks)),
                 _assign_prog,
             )(expr_eval.blocks_to_masked(blocks))
             sharding = row_sharding(blocks.mesh)
             new_cols = dict(blocks.columns)
-            for name, tp, _c in plans:
+            for name, tp, c in plans:
+                # bare column references keep their dictionary/stats
+                # (same rule as _device_project)
+                src = (
+                    blocks.columns.get(c.name)
+                    if isinstance(c, _NamedColumnExpr) and c.as_type is None
+                    else None
+                )
                 new_cols[name] = JaxColumn(
                     tp,
                     jax.device_put(outs[f"v:{name}"], sharding),
                     None
                     if f"m:{name}" not in outs
                     else jax.device_put(outs[f"m:{name}"], sharding),
+                    src.dictionary if src is not None else None,
+                    src.stats if src is not None else None,
                 )
             return JaxDataFrame(blocks_with_columns(blocks, new_cols), schema)
         self._count_fallback("assign")
@@ -1314,7 +1329,9 @@ class JaxExecutionEngine(ExecutionEngine):
             arg = a.args[0]
             if isinstance(arg, _NamedColumnExpr) and arg.wildcard:
                 continue
-            if not expr_eval.can_eval_on_device(arg, blocks):
+            if not expr_eval.can_eval_on_device(
+                arg, blocks
+            ) or expr_eval.is_string_result(arg, blocks):
                 return False
         return True
 
@@ -1323,19 +1340,21 @@ class JaxExecutionEngine(ExecutionEngine):
     ) -> DataFrame:
         blocks = jdf.blocks
         pad_n = blocks.padded_nrows
+        dicts = expr_eval.dicts_of(blocks)
         exprs = list(cols.all_cols)
 
         def _project_prog(mcols: Dict[str, Any]) -> Dict[str, Any]:
             outs: Dict[str, Any] = {}
             for c, f in zip(exprs, out_schema.fields):
-                v, m = expr_eval.eval_expr(mcols, c, pad_n)
+                v, m = expr_eval.eval_expr(mcols, c, pad_n, dicts)
                 outs[f"v:{f.name}"] = v
                 if m is not None:
                     outs[f"m:{f.name}"] = m
             return outs
 
         outs = self._jit_cached(
-            ("project", tuple(c.__uuid__() for c in exprs), pad_n),
+            ("project", tuple(c.__uuid__() for c in exprs), pad_n,
+             expr_eval.dict_fingerprint(blocks)),
             _project_prog,
         )(expr_eval.blocks_to_masked(blocks))
         sharding = row_sharding(blocks.mesh)
@@ -1493,7 +1512,9 @@ class JaxExecutionEngine(ExecutionEngine):
             if isinstance(arg, _NamedColumnExpr) and arg.wildcard:
                 plans.append((c.output_name, "count", None, c))
                 continue
-            if not expr_eval.can_eval_on_device(arg, blocks):
+            if not expr_eval.can_eval_on_device(
+                arg, blocks
+            ) or expr_eval.is_string_result(arg, blocks):
                 return None
             plans.append((c.output_name, c.func.lower(), arg, c))
         # known-empty inputs stay on the device path too: padded_len(0)=ndev
@@ -1502,6 +1523,7 @@ class JaxExecutionEngine(ExecutionEngine):
         # lazily-empty masked frame gets (advisor r2, low: the two paths
         # must not diverge based on whether the count happens to be known)
         pad_n = blocks.padded_nrows
+        dicts = expr_eval.dicts_of(blocks)
         # resolve output types up front (needed inside the traced program)
         typed_plans = []
         for name, func, arg, expr in plans:
@@ -1556,7 +1578,9 @@ class JaxExecutionEngine(ExecutionEngine):
                     values: Any = jnp.ones((pad_n,), dtype=jnp.int32)
                     mask: Any = None
                 else:
-                    values, mask = expr_eval.eval_expr(mcols, arg, pad_n)
+                    values, mask = expr_eval.eval_expr(
+                        mcols, arg, pad_n, dicts
+                    )
                 v, m = groupby._segment_agg_impl(
                     func, values, mask, seg_, num_segments, valid_
                 )
@@ -1572,6 +1596,7 @@ class JaxExecutionEngine(ExecutionEngine):
             tuple((n, f, None if a is None else a.__uuid__(), str(t))
                   for n, f, a, t in typed_plans),
             tuple(keys), num_segments, out_pad, pad_n,
+            expr_eval.dict_fingerprint(blocks),
         )
         key_data = {k: blocks.columns[k].data for k in keys}
         key_masks = {
@@ -1747,6 +1772,7 @@ class JaxExecutionEngine(ExecutionEngine):
         no segments, no scatter."""
         blocks = jdf.blocks
         pad_n = blocks.padded_nrows
+        dicts = expr_eval.dicts_of(blocks)
 
         def _prog(
             mcols: Dict[str, Any], row_valid: Optional[Any], nrows_s: Any
@@ -1758,7 +1784,9 @@ class JaxExecutionEngine(ExecutionEngine):
                     values: Any = jnp.ones((pad_n,), dtype=jnp.int32)
                     mask: Any = None
                 else:
-                    values, mask = expr_eval.eval_expr(mcols, arg, pad_n)
+                    values, mask = expr_eval.eval_expr(
+                        mcols, arg, pad_n, dicts
+                    )
                 eff = valid if mask is None else (mask & valid)
                 cnt = jnp.sum(eff.astype(jnp.int32))
                 if func == "count":
@@ -1859,6 +1887,7 @@ class JaxExecutionEngine(ExecutionEngine):
         syncs; the group count stays a lazy device scalar."""
         blocks = jdf.blocks
         pad_n = blocks.padded_nrows
+        dicts = expr_eval.dicts_of(blocks)
         ndev = int(blocks.mesh.devices.size)
         total = bspec.total
         out_pad = padded_len(total, ndev)
@@ -1903,7 +1932,7 @@ class JaxExecutionEngine(ExecutionEngine):
                     slots.append(("c", 0))  # COUNT(*) == occupancy
                     continue
                 akey = arg.__uuid__()
-                values, mask = expr_eval.eval_expr(mcols, arg, pad_n)
+                values, mask = expr_eval.eval_expr(mcols, arg, pad_n, dicts)
                 eff_key = "__valid__" if mask is None else f"m:{akey}"
                 eff = valid if mask is None else (mask & valid)
                 if func == "count":
@@ -1951,6 +1980,7 @@ class JaxExecutionEngine(ExecutionEngine):
             ),
             bspec,
             pad_n,
+            expr_eval.dict_fingerprint(blocks),
         )
         key_data = {k: blocks.columns[k].data for k in keys}
         key_masks = {

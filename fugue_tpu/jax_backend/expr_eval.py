@@ -4,9 +4,19 @@ The JAX lowering of the same expression tree the pandas evaluator interprets
 (BASELINE: "FugueSQL group-by aggregates lower to segment_sum/segment_max
 scans on device") — select/filter/assign run as jit-compiled elementwise
 programs over mesh-sharded columns; XLA fuses the chain into the surrounding
-ops (HBM-bandwidth-friendly: one pass)."""
+ops (HBM-bandwidth-friendly: one pass).
 
-from typing import Any, Dict, Optional, Tuple
+String columns participate through their dictionary encoding: predicates
+(=, <>, <, <=, >, >=, LIKE, IN-as-OR) are resolved against a shared
+lexicographic vocabulary built on the host from the SMALL dictionaries,
+then executed as int32 lookup-table gathers + numeric compares on device
+(the dictionaries never leave the host; only code arrays ride the mesh).
+Because the lookup tables are baked into traced programs as constants,
+jit cache keys at the call sites must include ``dict_fingerprint``.
+"""
+
+import re
+from typing import Any, Dict, NamedTuple, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -21,11 +31,29 @@ from fugue_tpu.column.expressions import (
     _NamedColumnExpr,
     _UnaryOpExpr,
 )
+from fugue_tpu.column.pandas_eval import like_pattern_to_regex
 from fugue_tpu.jax_backend.blocks import JaxBlocks, JaxColumn
 from fugue_tpu.utils.assertion import assert_or_throw
 
 # a masked value: (values, mask) — mask None means all-valid
 Masked = Tuple[jnp.ndarray, Optional[jnp.ndarray]]
+
+
+class _Str(NamedTuple):
+    """A dictionary-encoded string value during device evaluation."""
+
+    codes: jnp.ndarray
+    mask: Optional[jnp.ndarray]
+    dictionary: np.ndarray  # host-resident decode table
+
+
+class _StrLit(NamedTuple):
+    value: str
+
+
+_Value = Union[Masked, _Str, _StrLit]
+
+_CMP_OPS = ("==", "!=", "<", "<=", ">", ">=")
 
 
 def _valid(m: Masked) -> jnp.ndarray:
@@ -35,56 +63,181 @@ def _valid(m: Masked) -> jnp.ndarray:
     return mask
 
 
-def eval_expr(cols: Dict[str, Masked], expr: ColumnExpr, nrows: int) -> Masked:
-    res = _eval(cols, expr, nrows)
+def eval_expr(
+    cols: Dict[str, Masked],
+    expr: ColumnExpr,
+    nrows: int,
+    dicts: Optional[Dict[str, np.ndarray]] = None,
+) -> Masked:
+    res = _eval(cols, expr, nrows, dicts or {})
+    if isinstance(res, (_Str, _StrLit)):
+        assert_or_throw(
+            isinstance(res, _Str) and expr.as_type is None,
+            NotImplementedError("string-valued expression on device"),
+        )
+        return (res.codes, res.mask)  # type: ignore[union-attr]
     if expr.as_type is not None:
         res = _cast(res, expr.as_type)
     return res
 
 
-def _eval(cols: Dict[str, Masked], expr: ColumnExpr, nrows: int) -> Masked:
+def _eval(
+    cols: Dict[str, Masked],
+    expr: ColumnExpr,
+    nrows: int,
+    dicts: Dict[str, np.ndarray],
+) -> _Value:
     if isinstance(expr, _NamedColumnExpr):
         assert_or_throw(
             expr.name in cols, ValueError(f"{expr.name} not available on device")
         )
-        return cols[expr.name]
+        v, m = cols[expr.name]
+        if expr.name in dicts:
+            return _Str(v, m, dicts[expr.name])
+        return (v, m)
     if isinstance(expr, _LitColumnExpr):
         v = expr.value
         if v is None:
             return jnp.zeros((nrows,)), jnp.zeros((nrows,), dtype=jnp.bool_)
+        if isinstance(v, str):
+            return _StrLit(v)
         assert_or_throw(
             isinstance(v, (int, float, bool)),
             ValueError(f"literal {v!r} not supported on device"),
         )
         return jnp.full((nrows,), v), None
     if isinstance(expr, _UnaryOpExpr):
-        inner = _eval(cols, expr.col, nrows)
-        iv, im = inner
-        if expr.op == "IS_NULL":
-            return (~_valid(inner)), None
-        if expr.op == "NOT_NULL":
+        inner = _eval(cols, expr.col, nrows, dicts)
+        if expr.op in ("IS_NULL", "NOT_NULL"):
+            if isinstance(inner, _StrLit):
+                raise NotImplementedError("IS NULL on a string literal")
+            if isinstance(inner, _Str):
+                inner = (inner.codes, inner.mask)
+            if expr.op == "IS_NULL":
+                return (~_valid(inner)), None
             return _valid(inner), None
+        if isinstance(inner, (_Str, _StrLit)):
+            raise NotImplementedError(f"unary {expr.op} on strings")
+        iv, im = inner
         if expr.op == "-":
             return -iv, im
         if expr.op == "~":
             return ~iv.astype(jnp.bool_), im
         raise NotImplementedError(f"unary {expr.op} on device")
     if isinstance(expr, _BinaryOpExpr):
-        left = _eval(cols, expr.left, nrows)
-        right = _eval(cols, expr.right, nrows)
+        left = _eval(cols, expr.left, nrows, dicts)
+        right = _eval(cols, expr.right, nrows, dicts)
+        if isinstance(left, (_Str, _StrLit)) or isinstance(
+            right, (_Str, _StrLit)
+        ):
+            return _str_compare(expr.op, left, right, nrows)
         return _binary(expr.op, left, right)
     if isinstance(expr, _FuncExpr) and not expr.is_aggregation:
-        if expr.func.lower() == "coalesce":
-            args = [_eval(cols, a, nrows) for a in expr.args]
-            out_v, out_m = args[0]
+        f = expr.func.lower()
+        if f == "coalesce":
+            raws = [_eval(cols, a, nrows, dicts) for a in expr.args]
+            if any(isinstance(a, (_Str, _StrLit)) for a in raws):
+                raise NotImplementedError("COALESCE over strings on device")
+            args = [a for a in raws if isinstance(a, tuple)]
+            out_v, _ = args[0]
             out_m = _valid(args[0])
             for a in args[1:]:
-                av, am = a
+                av, _am = a
                 out_v = jnp.where(out_m, out_v, av)
                 out_m = out_m | _valid(a)
             return out_v, out_m
+        if f == "like":
+            operand = _eval(cols, expr.args[0], nrows, dicts)
+            pat = expr.args[1]
+            neg = expr.args[2]
+            assert_or_throw(
+                isinstance(operand, _Str)
+                and isinstance(pat, _LitColumnExpr)
+                and isinstance(pat.value, str)
+                and isinstance(neg, _LitColumnExpr),
+                NotImplementedError("LIKE needs a string column + literal"),
+            )
+            rx = re.compile(like_pattern_to_regex(pat.value))
+            d = operand.dictionary
+            lut = np.fromiter(
+                (rx.fullmatch(str(x)) is not None for x in d),
+                dtype=bool,
+                count=len(d),
+            )
+            if len(lut) == 0:
+                lut = np.zeros(1, dtype=bool)
+            hit = jnp.asarray(lut)[
+                jnp.clip(operand.codes, 0, len(lut) - 1)
+            ]
+            if neg.value:
+                hit = ~hit
+            return hit, operand.mask
+        if f == "case_when":
+            raws = [_eval(cols, a, nrows, dicts) for a in expr.args]
+            if any(isinstance(a, (_Str, _StrLit)) for a in raws):
+                raise NotImplementedError("string CASE branches on device")
+            default = raws[-1]
+            out_v, _ = default
+            out_valid = _valid(default)
+            # first-match-wins: apply branches in REVERSE so earlier
+            # conditions overwrite later ones
+            for i in range(len(raws) - 2, 0, -2):
+                cond, val = raws[i - 1], raws[i]
+                cv, _cm = cond
+                match = cv.astype(jnp.bool_) & _valid(cond)
+                vv, _vm = val
+                out_v = jnp.where(match, vv, out_v)
+                out_valid = jnp.where(match, _valid(val), out_valid)
+            # a NULL-literal default is float64 zeros but contributes no
+            # VALUES — don't let it promote int branches to float
+            vtypes = [
+                raws[i][0].dtype for i in range(1, len(raws) - 1, 2)
+            ]
+            last = expr.args[-1]
+            if not (
+                isinstance(last, _LitColumnExpr) and last.value is None
+            ):
+                vtypes.append(default[0].dtype)
+            if vtypes:
+                out_v = out_v.astype(jnp.result_type(*vtypes))
+            return out_v, out_valid
         raise NotImplementedError(f"function {expr.func} on device")
     raise NotImplementedError(f"can't evaluate {expr} on device")
+
+
+def _str_compare(op: str, left: _Value, right: _Value, nrows: int) -> Masked:
+    """String comparison via a shared lexicographic vocabulary: each
+    side's dictionary (or literal) maps to its rank in the union, then
+    the compare runs numerically on device."""
+    if op not in _CMP_OPS:
+        raise NotImplementedError(f"binary {op} on strings")
+    sides = (left, right)
+    if not any(isinstance(s, _Str) for s in sides):
+        raise NotImplementedError("literal-vs-literal string compare")
+    parts = []
+    for s in sides:
+        if isinstance(s, _Str):
+            parts.append(s.dictionary.astype(str))
+        elif isinstance(s, _StrLit):
+            parts.append(np.array([s.value], dtype=str))
+        else:
+            raise NotImplementedError("string vs non-string comparison")
+    vocab = np.unique(np.concatenate([p.astype(str) for p in parts]))
+
+    def _rank(s: _Value) -> Masked:
+        if isinstance(s, _StrLit):
+            r = int(np.searchsorted(vocab, s.value))
+            return jnp.full((nrows,), r, dtype=jnp.int32), None
+        assert isinstance(s, _Str)
+        lut = np.searchsorted(vocab, s.dictionary.astype(str)).astype(
+            np.int32
+        )
+        if len(lut) == 0:
+            lut = np.zeros(1, dtype=np.int32)
+        v = jnp.asarray(lut)[jnp.clip(s.codes, 0, len(lut) - 1)]
+        return v, s.mask
+
+    return _binary(op, _rank(left), _rank(right))
 
 
 def _binary(op: str, left: Masked, right: Masked) -> Masked:
@@ -142,44 +295,119 @@ def _cast(m: Masked, tp: pa.DataType) -> Masked:
 def blocks_to_masked(blocks: JaxBlocks) -> Dict[str, Masked]:
     res: Dict[str, Masked] = {}
     for name, col in blocks.columns.items():
-        if col.on_device and not col.is_string:
+        if col.on_device:
             res[name] = (col.data, col.mask)
     return res
 
 
+def dicts_of(blocks: JaxBlocks) -> Dict[str, np.ndarray]:
+    """Decode tables of the device-resident string columns (host side)."""
+    return {
+        name: col.dictionary
+        for name, col in blocks.columns.items()
+        if col.on_device and col.is_string
+    }
+
+
+def dict_fingerprint(blocks: JaxBlocks) -> Tuple[Any, ...]:
+    """A stable key component for jit caches of programs that bake
+    string-dictionary lookup tables in as constants: same expression +
+    same fingerprint => identical program."""
+    out = []
+    for name in sorted(blocks.columns):
+        col = blocks.columns[name]
+        if col.on_device and col.is_string:
+            fp = getattr(col, "_dict_fp", None)
+            if fp is None:
+                fp = hash("\x00".join(str(x) for x in col.dictionary))
+                col._dict_fp = fp  # type: ignore[attr-defined]
+            out.append((name, len(col.dictionary), fp))
+    return tuple(out)
+
+
 def can_eval_on_device(expr: ColumnExpr, blocks: JaxBlocks) -> bool:
-    """Whether the whole expression tree references only device numeric
-    columns and supported ops."""
+    """Whether the whole expression tree references only device columns
+    and supported ops. String-KINDED results are only allowed for bare
+    column references (the caller re-attaches the dictionary); string
+    subtrees under comparisons/LIKE always lower."""
     try:
-        _check(expr, blocks)
+        kind = _check(expr, blocks)
+    except NotImplementedError:
+        return False
+    if kind == "num":
         return True
+    return (
+        kind == "str"
+        and isinstance(expr, _NamedColumnExpr)
+        and expr.as_type is None
+    )
+
+
+def is_string_result(expr: ColumnExpr, blocks: JaxBlocks) -> bool:
+    try:
+        return _check(expr, blocks) != "num"
     except NotImplementedError:
         return False
 
 
-def _check(expr: ColumnExpr, blocks: JaxBlocks) -> None:
+def _check(expr: ColumnExpr, blocks: JaxBlocks) -> str:
+    """Kind inference mirroring ``_eval`` exactly: returns "num", "str"
+    (dictionary column) or "strlit"; raises NotImplementedError for
+    anything ``_eval`` would reject."""
     if isinstance(expr, _NamedColumnExpr):
         col = blocks.columns.get(expr.name)
-        if col is None or not col.on_device or col.is_string:
+        if col is None or not col.on_device:
             raise NotImplementedError(expr.name)
-        return
+        return "str" if col.is_string else "num"
     if isinstance(expr, _LitColumnExpr):
-        if expr.value is not None and not isinstance(expr.value, (int, float, bool)):
+        if isinstance(expr.value, str):
+            return "strlit"
+        if expr.value is not None and not isinstance(
+            expr.value, (int, float, bool)
+        ):
             raise NotImplementedError(str(expr.value))
-        return
+        return "num"
     if isinstance(expr, _UnaryOpExpr):
-        if expr.op not in ("IS_NULL", "NOT_NULL", "-", "~"):
-            raise NotImplementedError(expr.op)
-        _check(expr.col, blocks)
-        return
+        k = _check(expr.col, blocks)
+        if expr.op in ("IS_NULL", "NOT_NULL"):
+            if k == "strlit":
+                raise NotImplementedError("IS NULL on a string literal")
+            return "num"
+        if expr.op in ("-", "~"):
+            if k != "num":
+                raise NotImplementedError(f"unary {expr.op} on strings")
+            return "num"
+        raise NotImplementedError(expr.op)
     if isinstance(expr, _BinaryOpExpr):
-        _check(expr.left, blocks)
-        _check(expr.right, blocks)
-        return
+        lk = _check(expr.left, blocks)
+        rk = _check(expr.right, blocks)
+        if lk == "num" and rk == "num":
+            return "num"
+        if expr.op in _CMP_OPS and "num" not in (lk, rk) and "str" in (
+            lk, rk
+        ):
+            return "num"
+        raise NotImplementedError(f"binary {expr.op} on {lk}/{rk}")
     if isinstance(expr, _FuncExpr) and not expr.is_aggregation:
-        if expr.func.lower() != "coalesce":
-            raise NotImplementedError(expr.func)
-        for a in expr.args:
-            _check(a, blocks)
-        return
+        f = expr.func.lower()
+        if f == "coalesce":
+            for a in expr.args:
+                if _check(a, blocks) != "num":
+                    raise NotImplementedError("COALESCE over strings")
+            return "num"
+        if f == "like":
+            if _check(expr.args[0], blocks) != "str":
+                raise NotImplementedError("LIKE needs a string column")
+            if not (
+                isinstance(expr.args[1], _LitColumnExpr)
+                and isinstance(expr.args[1].value, str)
+            ):
+                raise NotImplementedError("LIKE needs a literal pattern")
+            return "num"
+        if f == "case_when":
+            for a in expr.args:
+                if _check(a, blocks) != "num":
+                    raise NotImplementedError("string CASE branches")
+            return "num"
+        raise NotImplementedError(expr.func)
     raise NotImplementedError(str(expr))

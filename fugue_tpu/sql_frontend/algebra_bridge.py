@@ -727,4 +727,27 @@ def _expr(e: ast.Expr, scope: _Scope) -> ColumnExpr:
         if res is None:
             raise _GiveUp()
         return ~res if e.negated else res
-    raise _GiveUp()  # Case / Like / subqueries / windows
+    if isinstance(e, ast.Like):
+        if not isinstance(e.pattern, ast.Lit) or not isinstance(
+            e.pattern.value, str
+        ):
+            raise _GiveUp()  # dynamic patterns: host runner
+        return ff.like(
+            _expr(e.operand, scope), e.pattern.value, negated=e.negated
+        )
+    if isinstance(e, ast.Case):
+        args: List[ColumnExpr] = []
+        operand = (
+            None if e.operand is None else _expr(e.operand, scope)
+        )
+        for cond, val in e.whens:
+            c = _expr(cond, scope)
+            if operand is not None:
+                c = operand == c
+            args.append(c)
+            args.append(_expr(val, scope))
+        args.append(
+            null() if e.default is None else _expr(e.default, scope)
+        )
+        return ff.case_when(*args)
+    raise _GiveUp()  # subqueries / windows

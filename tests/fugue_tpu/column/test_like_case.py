@@ -1,0 +1,76 @@
+"""LIKE and CASE WHEN in the column algebra — pandas evaluation and the
+device (dictionary-code) lowering must agree with SQL semantics
+(reference column algebra: fugue/column/functions.py)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from fugue_tpu.column import col, lit, null
+from fugue_tpu.column import functions as ff
+from fugue_tpu.column.pandas_eval import eval_expr, like_pattern_to_regex
+from fugue_tpu.schema import Schema
+
+
+def _df() -> pd.DataFrame:
+    return pd.DataFrame(
+        {
+            "s": ["apple", "apricot", "banana", None, "fig"],
+            "x": [1, 2, 3, 4, 5],
+        }
+    )
+
+
+def test_like_pattern_translation():
+    assert like_pattern_to_regex("a%") == "a.*"
+    assert like_pattern_to_regex("a_c") == "a.c"
+    assert like_pattern_to_regex("10.5%") == "10\\.5.*"
+
+
+def test_like_eval():
+    r = eval_expr(_df(), ff.like(col("s"), "ap%"))
+    assert list(r[:3]) == [True, True, False]
+    assert pd.isna(r[3])  # NULL LIKE -> NULL
+    r = eval_expr(_df(), ff.like(col("s"), "%an%", negated=True))
+    assert list(r[:3]) == [True, True, False]
+    assert pd.isna(r[3])
+
+
+def test_like_requires_string_pattern():
+    with pytest.raises(Exception):
+        ff.like(col("s"), 5)  # type: ignore
+
+
+def test_case_when_eval():
+    e = ff.case_when(col("x") <= 2, lit(10), col("x") <= 4, lit(20), lit(0))
+    r = eval_expr(_df(), e)
+    assert list(r) == [10, 10, 20, 20, 0]
+
+
+def test_case_when_first_match_wins():
+    e = ff.case_when(col("x") > 0, lit(1), col("x") > 2, lit(2), lit(9))
+    assert list(eval_expr(_df(), e)) == [1] * 5
+
+
+def test_case_when_null_default():
+    e = ff.case_when(col("x") < 2, lit(7), null())
+    r = eval_expr(_df(), e)
+    assert r.iloc[0] == 7
+    assert r[1:].isna().all()
+
+
+def test_case_when_infer_type():
+    sch = Schema("s:str,x:long")
+    assert ff.case_when(col("x") < 2, lit(7), null()).infer_type(
+        sch
+    ) == pa.int64()
+    assert ff.case_when(
+        col("x") < 2, lit(7), lit(1.5)
+    ).infer_type(sch) == pa.float64()
+    assert ff.like(col("s"), "a%").infer_type(sch) == pa.bool_()
+
+
+def test_case_when_arity_validation():
+    with pytest.raises(Exception):
+        ff.case_when(col("x") > 1, lit(1))  # no default

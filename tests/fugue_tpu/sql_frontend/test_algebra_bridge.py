@@ -75,11 +75,23 @@ def test_orderby_limit_routes_to_device():
     assert e.fallbacks == {}, e.fallbacks
 
 
-def test_complex_query_falls_back_correctly():
-    # CASE WHEN is outside the bridge: host runner with a counted fallback
+def test_case_when_routes_to_device():
+    # CASE WHEN now lowers through the bridge (was a host fallback
+    # before round 4)
     df = _df()
     e, jx, nt = _both(
         ("SELECT k, CASE WHEN v > 0.5 THEN 1 ELSE 0 END AS b FROM", df)
+    )
+    assert jx == nt
+    assert e.fallbacks == {}, e.fallbacks
+
+
+def test_complex_query_falls_back_correctly():
+    # scalar functions outside the device set: host runner with a
+    # counted fallback
+    df = _df()
+    e, jx, nt = _both(
+        ("SELECT k, ABS(v) AS b FROM", df)
     )
     assert jx == nt
     assert e.fallbacks.get("sql_select", 0) >= 1  # counted, not silent
