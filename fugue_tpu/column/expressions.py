@@ -411,6 +411,27 @@ class _FuncExpr(ColumnExpr):
             return types[0] if types else None
         if f == "like":
             return pa.bool_()
+        if f in ("abs", "nullif"):
+            return self._args[0].infer_type(schema)
+        if f in (
+            "round", "sqrt", "exp", "ln", "log", "log2", "log10",
+            "sin", "cos", "tan", "power", "pow",
+        ):
+            return pa.float64()
+        if f in ("floor", "ceil", "ceiling", "sign", "length", "len"):
+            return pa.int64()
+        if f == "mod":
+            t = self._args[0].infer_type(schema)
+            return t if t is not None else pa.int64()
+        if f in ("if", "iif") and len(self._args) == 3:
+            return self._args[1].infer_type(schema) or self._args[
+                2
+            ].infer_type(schema)
+        if f in (
+            "upper", "ucase", "lower", "lcase", "trim", "ltrim", "rtrim",
+            "reverse", "substring", "substr", "concat", "replace",
+        ):
+            return pa.string()
         if f == "case_when":
             # value branches: args 1, 3, ... and the trailing default
             vals = [

@@ -133,8 +133,161 @@ def _eval(df: pd.DataFrame, expr: ColumnExpr) -> pd.Series:
                     res = val.where(take, res)
                 matched = matched | cond
             return res
+        if f in _NUM_UNARY:
+            s = pd.to_numeric(_eval(df, expr.args[0]), errors="coerce")
+            return pd.Series(_NUM_UNARY[f](s), index=df.index)
+        if f == "round":
+            s = pd.to_numeric(_eval(df, expr.args[0]), errors="coerce")
+            digits = _scalar_arg(df, expr.args, 1, 0)
+            return s.round(int(digits))
+        if f in ("power", "pow"):
+            a = pd.to_numeric(_eval(df, expr.args[0]), errors="coerce")
+            b = pd.to_numeric(_eval(df, expr.args[1]), errors="coerce")
+            return a**b
+        if f == "mod":
+            a = pd.to_numeric(_eval(df, expr.args[0]), errors="coerce")
+            b = pd.to_numeric(_eval(df, expr.args[1]), errors="coerce")
+            return a % b
+        if f == "nullif":
+            a = _eval(df, expr.args[0])
+            b = _eval(df, expr.args[1])
+            eq = pd.Series(False, index=df.index)
+            with np.errstate(invalid="ignore"):
+                eq = (a == b) & a.notna() & b.notna()
+            return a.astype(object).where(~eq, None)
+        if f in ("if", "iif"):
+            cond = _bool_series(_eval(df, expr.args[0])).fillna(False)
+            yes = _eval(df, expr.args[1])
+            no = _eval(df, expr.args[2])
+            return yes.astype(object).where(
+                cond.astype(bool), no.astype(object)
+            )
+        if f in _STR_UNARY:
+            s = _eval(df, expr.args[0])
+            nulls = s.isna()
+            res = _STR_UNARY[f](s.astype(object).astype(str)).astype(object)
+            res[nulls.to_numpy(dtype=bool)] = None
+            return res
+        if f in ("length", "len"):
+            s = _eval(df, expr.args[0])
+            res = s.astype(object).astype(str).str.len().astype(object)
+            res[s.isna().to_numpy(dtype=bool)] = None
+            return res
+        if f in ("substring", "substr"):
+            s = _eval(df, expr.args[0])
+            starts = pd.to_numeric(_eval(df, expr.args[1]), errors="coerce")
+            lens = (
+                pd.to_numeric(_eval(df, expr.args[2]), errors="coerce")
+                if len(expr.args) > 2
+                else None
+            )
+            return sql_substring(s, starts, lens)
+        if f == "concat":
+            res: Optional[pd.Series] = None
+            nulls: Optional[pd.Series] = None
+            for a in expr.args:
+                s = _eval(df, a)
+                nulls = s.isna() if nulls is None else (nulls | s.isna())
+                part = s.astype(object).astype(str)
+                res = part if res is None else res + part
+            assert res is not None and nulls is not None
+            res = res.astype(object)
+            res[nulls.to_numpy(dtype=bool)] = None
+            return res
+        if f == "replace":
+            s = _eval(df, expr.args[0])
+            nulls = s.isna()
+            old = str(_scalar_arg(df, expr.args, 1, ""))
+            new = str(_scalar_arg(df, expr.args, 2, ""))
+            res = s.astype(object).astype(str).str.replace(
+                old, new, regex=False
+            ).astype(object)
+            res[nulls.to_numpy(dtype=bool)] = None
+            return res
         raise NotImplementedError(f"function {expr.func} not supported on pandas")
     raise NotImplementedError(f"can't evaluate {expr}")
+
+
+_NUM_UNARY: Dict[str, Any] = {
+    "abs": lambda s: s.abs(),
+    "floor": np.floor,
+    "ceil": np.ceil,
+    "ceiling": np.ceil,
+    "sqrt": np.sqrt,
+    "exp": np.exp,
+    "ln": np.log,
+    "log": np.log,
+    "log2": np.log2,
+    "log10": np.log10,
+    "sin": np.sin,
+    "cos": np.cos,
+    "tan": np.tan,
+    "sign": np.sign,
+}
+
+_STR_UNARY: Dict[str, Any] = {
+    "upper": lambda s: s.str.upper(),
+    "ucase": lambda s: s.str.upper(),
+    "lower": lambda s: s.str.lower(),
+    "lcase": lambda s: s.str.lower(),
+    "trim": lambda s: s.str.strip(),
+    "ltrim": lambda s: s.str.lstrip(),
+    "rtrim": lambda s: s.str.rstrip(),
+    "reverse": lambda s: s.str[::-1],
+}
+
+
+def _scalar_arg(df: pd.DataFrame, args: List[Any], i: int, default: Any) -> Any:
+    """A scalar parameter (round digits, substring bounds, ...): the
+    first value of the evaluated argument — same convention as the SQL
+    runner's scalar functions."""
+    if i >= len(args):
+        return default
+    s = _eval(df, args[i])
+    return s.iloc[0] if len(s) else default
+
+
+def sql_substring(
+    s: pd.Series,
+    starts: pd.Series,
+    lens: Optional[pd.Series],
+) -> pd.Series:
+    """SQL SUBSTRING over object-typed strings: per-row 1-based start and
+    optional length, NULL operand/start/length -> NULL. Shared by the SQL
+    runner and the column-algebra evaluator so the two host paths cannot
+    diverge. Constant parameters (the common, literal case) take the
+    vectorized ``str.slice`` path."""
+    nulls = s.isna() | starts.isna()
+    if lens is not None:
+        nulls = nulls | lens.isna()
+    nl = nulls.to_numpy(dtype=bool)
+    sv = s.astype(object).astype(str)
+    su = starts[~nulls].unique()
+    lu = None if lens is None else lens[~nulls].unique()
+    if len(su) <= 1 and (lu is None or len(lu) <= 1):
+        st0 = max(int(su[0]) - 1, 0) if len(su) else 0
+        if lens is not None:
+            n = int(lu[0]) if lu is not None and len(lu) else 0
+            res = sv.str.slice(st0, st0 + n)
+        else:
+            res = sv.str.slice(st0)
+        res = res.astype(object)
+        res[nl] = None
+        return res
+    out: List[Any] = []
+    for i in range(len(sv)):
+        if nl[i]:
+            out.append(None)
+            continue
+        x = sv.iloc[i]
+        st0 = max(int(starts.iloc[i]) - 1, 0)
+        if lens is not None:
+            out.append(x[st0:st0 + int(lens.iloc[i])])
+        else:
+            out.append(x[st0:])
+    res = pd.Series(out, index=s.index, dtype=object)
+    res[nl] = None
+    return res
 
 
 def like_pattern_to_regex(pattern: str) -> str:

@@ -667,7 +667,9 @@ class JaxExecutionEngine(ExecutionEngine):
         columns (and their stats) are untouched, the row count becomes a
         lazy device scalar. No gather, no host sync."""
         jdf: JaxDataFrame = self.to_df(df)  # type: ignore
-        if expr_eval.can_eval_on_device(condition, jdf.blocks):
+        if expr_eval.can_eval_on_device(
+            condition, jdf.blocks
+        ) and not expr_eval.is_string_result(condition, jdf.blocks):
             blocks = jdf.blocks
             pad_n = blocks.padded_nrows
             dicts = expr_eval.dicts_of(blocks)
@@ -752,13 +754,22 @@ class JaxExecutionEngine(ExecutionEngine):
                     if isinstance(c, _NamedColumnExpr) and c.as_type is None
                     else None
                 )
+                dict_r = (
+                    src.dictionary
+                    if src is not None
+                    else (
+                        expr_eval.result_dictionary(c, blocks)
+                        if pa.types.is_string(tp)
+                        else None
+                    )
+                )
                 new_cols[name] = JaxColumn(
                     tp,
                     jax.device_put(outs[f"v:{name}"], sharding),
                     None
                     if f"m:{name}" not in outs
                     else jax.device_put(outs[f"m:{name}"], sharding),
-                    src.dictionary if src is not None else None,
+                    dict_r,
                     src.stats if src is not None else None,
                 )
             return JaxDataFrame(blocks_with_columns(blocks, new_cols), schema)
@@ -1301,7 +1312,10 @@ class JaxExecutionEngine(ExecutionEngine):
         if cols.is_distinct:
             return False
         blocks = jdf.blocks
-        if where is not None and not expr_eval.can_eval_on_device(where, blocks):
+        if where is not None and (
+            not expr_eval.can_eval_on_device(where, blocks)
+            or expr_eval.is_string_result(where, blocks)
+        ):
             return False
         if not cols.has_agg:
             return all(
@@ -1366,13 +1380,22 @@ class JaxExecutionEngine(ExecutionEngine):
                 if isinstance(c, _NamedColumnExpr) and c.as_type is None
                 else None
             )
+            dict_r = (
+                src.dictionary
+                if src is not None
+                else (
+                    expr_eval.result_dictionary(c, blocks)
+                    if pa.types.is_string(f.type)
+                    else None
+                )
+            )
             new_cols[f.name] = JaxColumn(
                 f.type,
                 jax.device_put(outs[f"v:{f.name}"], sharding),
                 None
                 if f"m:{f.name}" not in outs
                 else jax.device_put(outs[f"m:{f.name}"], sharding),
-                src.dictionary if src is not None else None,
+                dict_r,
                 src.stats if src is not None else None,
             )
         return JaxDataFrame(
@@ -1840,6 +1863,7 @@ class JaxExecutionEngine(ExecutionEngine):
                 for n, f, a, t in typed_plans
             ),
             pad_n,
+            expr_eval.dict_fingerprint(blocks),
         )
         outs = self._jit_cached(prog_key, _prog)(
             expr_eval.blocks_to_masked(blocks),

@@ -201,8 +201,206 @@ def _eval(
             if vtypes:
                 out_v = out_v.astype(jnp.result_type(*vtypes))
             return out_v, out_valid
+        if f in _DEV_NUM_UNARY:
+            v, m = _num_arg(_eval(cols, expr.args[0], nrows, dicts))
+            out = _DEV_NUM_UNARY[f](v)
+            if f in ("floor", "ceil", "ceiling", "sign"):
+                # int64 result; NaN inputs must become NULL, not garbage
+                valid = (
+                    jnp.ones(out.shape, dtype=jnp.bool_) if m is None else m
+                )
+                if jnp.issubdtype(out.dtype, jnp.floating):
+                    valid = valid & ~jnp.isnan(out)
+                    out = jnp.where(valid, out, jnp.zeros_like(out))
+                return out.astype(jnp.int64), valid
+            return out, m
+        if f == "round":
+            v, m = _num_arg(_eval(cols, expr.args[0], nrows, dicts))
+            digits = _dev_scalar(expr.args, 1, 0)
+            return jnp.round(v.astype(jnp.float64), int(digits)), m
+        if f in ("power", "pow"):
+            lv, lm = _num_arg(_eval(cols, expr.args[0], nrows, dicts))
+            rv, rm = _num_arg(_eval(cols, expr.args[1], nrows, dicts))
+            m = _and_masks(lm, rm)
+            return lv.astype(jnp.float64) ** rv.astype(jnp.float64), m
+        if f == "mod":
+            lv, lm = _num_arg(_eval(cols, expr.args[0], nrows, dicts))
+            rv, rm = _num_arg(_eval(cols, expr.args[1], nrows, dicts))
+            return jnp.mod(lv, rv), _and_masks(lm, rm)
+        if f == "nullif":
+            a = _eval(cols, expr.args[0], nrows, dicts)
+            b = _eval(cols, expr.args[1], nrows, dicts)
+            if isinstance(a, (_Str, _StrLit)) or isinstance(
+                b, (_Str, _StrLit)
+            ):
+                eqv, eqm = _str_compare("==", a, b, nrows)
+                assert_or_throw(
+                    isinstance(a, _Str),
+                    NotImplementedError("NULLIF on a string literal"),
+                )
+                eq = eqv & (
+                    jnp.ones((nrows,), jnp.bool_) if eqm is None else eqm
+                )
+                am = (
+                    jnp.ones((nrows,), jnp.bool_)
+                    if a.mask is None
+                    else a.mask
+                )
+                return _Str(a.codes, am & ~eq, a.dictionary)
+            av, am = a
+            bv, bm = b
+            eq = (av == bv) & _valid(a) & _valid(b)
+            return av, _valid(a) & ~eq
+        if f in ("if", "iif"):
+            cond = _eval(cols, expr.args[0], nrows, dicts)
+            yes = _eval(cols, expr.args[1], nrows, dicts)
+            no = _eval(cols, expr.args[2], nrows, dicts)
+            if any(isinstance(x, (_Str, _StrLit)) for x in (cond, yes, no)):
+                raise NotImplementedError("string IF branches on device")
+            cv, _cm = cond
+            match = cv.astype(jnp.bool_) & _valid(cond)
+            return (
+                jnp.where(match, yes[0], no[0]),
+                jnp.where(match, _valid(yes), _valid(no)),
+            )
+        if f in ("length", "len"):
+            operand = _eval(cols, expr.args[0], nrows, dicts)
+            assert_or_throw(
+                isinstance(operand, _Str),
+                NotImplementedError("LENGTH needs a string column"),
+            )
+            d = operand.dictionary
+            lut = np.fromiter(
+                (len(str(x)) for x in d), dtype=np.int64, count=len(d)
+            )
+            if len(lut) == 0:
+                lut = np.zeros(1, dtype=np.int64)
+            return (
+                jnp.asarray(lut)[jnp.clip(operand.codes, 0, len(lut) - 1)],
+                operand.mask,
+            )
+        if f in _DICT_TRANSFORMS or f in (
+            "substring", "substr", "replace", "concat"
+        ):
+            return _dict_transform_eval(cols, expr, f, nrows, dicts)
         raise NotImplementedError(f"function {expr.func} on device")
     raise NotImplementedError(f"can't evaluate {expr} on device")
+
+
+_DEV_NUM_UNARY: Dict[str, Any] = {
+    "abs": jnp.abs,
+    "floor": jnp.floor,
+    "ceil": jnp.ceil,
+    "ceiling": jnp.ceil,
+    "sqrt": jnp.sqrt,
+    "exp": jnp.exp,
+    "ln": jnp.log,
+    "log": jnp.log,
+    "log2": jnp.log2,
+    "log10": jnp.log10,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "sign": jnp.sign,
+}
+
+_DICT_TRANSFORMS: Dict[str, Any] = {
+    "upper": lambda x: x.upper(),
+    "ucase": lambda x: x.upper(),
+    "lower": lambda x: x.lower(),
+    "lcase": lambda x: x.lower(),
+    "trim": lambda x: x.strip(),
+    "ltrim": lambda x: x.lstrip(),
+    "rtrim": lambda x: x.rstrip(),
+    "reverse": lambda x: x[::-1],
+}
+
+
+def _num_arg(v: _Value) -> Masked:
+    if isinstance(v, (_Str, _StrLit)):
+        raise NotImplementedError("numeric function over strings")
+    return v
+
+
+def _and_masks(
+    a: Optional[jnp.ndarray], b: Optional[jnp.ndarray]
+) -> Optional[jnp.ndarray]:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
+def _dev_scalar(args: Any, i: int, default: Any) -> Any:
+    if i >= len(args):
+        return default
+    a = args[i]
+    assert_or_throw(
+        isinstance(a, _LitColumnExpr)
+        and isinstance(a.value, (int, float, str)),
+        NotImplementedError("scalar parameter must be a literal on device"),
+    )
+    return a.value
+
+
+def _transformed_dictionary(f: str, args: Any, d: np.ndarray) -> np.ndarray:
+    """The host-side dictionary transform for a string scalar function —
+    codes are untouched, only the decode table changes."""
+    sd = [str(x) for x in d]
+    if f in _DICT_TRANSFORMS:
+        fn = _DICT_TRANSFORMS[f]
+        return np.array([fn(x) for x in sd], dtype=object)
+    if f in ("substring", "substr"):
+        start0 = max(int(_dev_scalar(args, 1, 1)) - 1, 0)
+        if len(args) > 2:
+            n = int(_dev_scalar(args, 2, 0))
+            return np.array(
+                [x[start0:start0 + n] for x in sd], dtype=object
+            )
+        return np.array([x[start0:] for x in sd], dtype=object)
+    if f == "replace":
+        old = str(_dev_scalar(args, 1, ""))
+        new = str(_dev_scalar(args, 2, ""))
+        return np.array([x.replace(old, new) for x in sd], dtype=object)
+    raise NotImplementedError(f)  # pragma: no cover - callers gate
+
+
+def _dict_transform_eval(
+    cols: Dict[str, Masked],
+    expr: "_FuncExpr",
+    f: str,
+    nrows: int,
+    dicts: Dict[str, np.ndarray],
+) -> _Value:
+    """String scalar functions as pure dictionary rewrites: the codes and
+    mask pass through, the decode table is transformed on the host."""
+    if f == "concat":
+        # exactly one string COLUMN, any number of string literals —
+        # the result dictionary is prefix + entry + suffix
+        parts = [_eval(cols, a, nrows, dicts) for a in expr.args]
+        strs = [p for p in parts if isinstance(p, _Str)]
+        if len(strs) == 0 and all(isinstance(p, _StrLit) for p in parts):
+            return _StrLit("".join(p.value for p in parts))
+        if len(strs) != 1 or not all(
+            isinstance(p, (_Str, _StrLit)) for p in parts
+        ):
+            raise NotImplementedError("CONCAT over multiple string columns")
+        src = strs[0]
+        idx = parts.index(src)
+        pre = "".join(p.value for p in parts[:idx])  # type: ignore[union-attr]
+        post = "".join(p.value for p in parts[idx + 1:])  # type: ignore[union-attr]
+        nd = np.array(
+            [pre + str(x) + post for x in src.dictionary], dtype=object
+        )
+        return _Str(src.codes, src.mask, nd)
+    operand = _eval(cols, expr.args[0], nrows, dicts)
+    assert_or_throw(
+        isinstance(operand, _Str),
+        NotImplementedError(f"{f} needs a string column"),
+    )
+    nd = _transformed_dictionary(f, expr.args, operand.dictionary)
+    return _Str(operand.codes, operand.mask, nd)
 
 
 def _str_compare(op: str, left: _Value, right: _Value, nrows: int) -> Masked:
@@ -327,20 +525,85 @@ def dict_fingerprint(blocks: JaxBlocks) -> Tuple[Any, ...]:
 
 def can_eval_on_device(expr: ColumnExpr, blocks: JaxBlocks) -> bool:
     """Whether the whole expression tree references only device columns
-    and supported ops. String-KINDED results are only allowed for bare
-    column references (the caller re-attaches the dictionary); string
-    subtrees under comparisons/LIKE always lower."""
+    and supported ops. String-KINDED results are only allowed when the
+    output decode table is statically known (bare refs and
+    dictionary-transform chains — the caller re-attaches it via
+    ``result_dictionary``); string subtrees under comparisons/LIKE
+    always lower."""
     try:
         kind = _check(expr, blocks)
     except NotImplementedError:
         return False
     if kind == "num":
         return True
-    return (
-        kind == "str"
-        and isinstance(expr, _NamedColumnExpr)
-        and expr.as_type is None
-    )
+    return kind == "str" and expr.as_type is None and _dict_chain_ok(expr)
+
+
+def _dict_chain_ok(expr: ColumnExpr) -> bool:
+    """Structural mirror of ``_walk_dict`` with no dictionary work —
+    ``can_eval_on_device`` uses it so the decode table is only built by
+    the callers that actually need it."""
+    if isinstance(expr, _NamedColumnExpr):
+        return True
+    if isinstance(expr, _FuncExpr):
+        f = expr.func.lower()
+        if f == "nullif":
+            return _dict_chain_ok(expr.args[0])
+        if f == "concat":
+            subs = [
+                a for a in expr.args if not isinstance(a, _LitColumnExpr)
+            ]
+            return len(subs) == 1 and _dict_chain_ok(subs[0])
+        if f in _DICT_TRANSFORMS or f in ("substring", "substr", "replace"):
+            return _dict_chain_ok(expr.args[0])
+    return False
+
+
+def result_dictionary(
+    expr: ColumnExpr, blocks: JaxBlocks
+) -> Optional[np.ndarray]:
+    """The output decode table of a codes-preserving string expression
+    (bare column refs and dictionary-transform chains: UPPER, TRIM,
+    SUBSTRING, REPLACE, one-column CONCAT, string NULLIF); None when the
+    expression is not such a chain."""
+    try:
+        if _check(expr, blocks) != "str":
+            return None
+        return _walk_dict(expr, blocks)
+    except NotImplementedError:
+        return None
+
+
+def _walk_dict(expr: ColumnExpr, blocks: JaxBlocks) -> np.ndarray:
+    if isinstance(expr, _NamedColumnExpr):
+        col = blocks.columns[expr.name]
+        assert col.dictionary is not None
+        return col.dictionary
+    if isinstance(expr, _FuncExpr):
+        f = expr.func.lower()
+        if f == "concat":
+            src_i = -1
+            for i, a in enumerate(expr.args):
+                if _check(a, blocks) == "str":
+                    src_i = i
+            pre = "".join(
+                a.value  # type: ignore[union-attr]
+                for a in expr.args[:src_i]
+            )
+            post = "".join(
+                a.value  # type: ignore[union-attr]
+                for a in expr.args[src_i + 1:]
+            )
+            inner = _walk_dict(expr.args[src_i], blocks)
+            return np.array(
+                [pre + str(x) + post for x in inner], dtype=object
+            )
+        if f == "nullif":
+            return _walk_dict(expr.args[0], blocks)
+        return _transformed_dictionary(
+            f, expr.args, _walk_dict(expr.args[0], blocks)
+        )
+    raise NotImplementedError(str(expr))
 
 
 def is_string_result(expr: ColumnExpr, blocks: JaxBlocks) -> bool:
@@ -409,5 +672,66 @@ def _check(expr: ColumnExpr, blocks: JaxBlocks) -> str:
                 if _check(a, blocks) != "num":
                     raise NotImplementedError("string CASE branches")
             return "num"
+        if f in _DEV_NUM_UNARY:
+            if _check(expr.args[0], blocks) != "num":
+                raise NotImplementedError(f"{f} over strings")
+            return "num"
+        if f == "round":
+            if _check(expr.args[0], blocks) != "num":
+                raise NotImplementedError("ROUND over strings")
+            _check_scalar_lit(expr.args, 1)
+            return "num"
+        if f in ("power", "pow", "mod"):
+            if (
+                _check(expr.args[0], blocks) != "num"
+                or _check(expr.args[1], blocks) != "num"
+            ):
+                raise NotImplementedError(f"{f} over strings")
+            return "num"
+        if f == "nullif":
+            lk = _check(expr.args[0], blocks)
+            rk = _check(expr.args[1], blocks)
+            if lk == "num" and rk == "num":
+                return "num"
+            if lk == "str" and rk in ("str", "strlit"):
+                return "str"
+            raise NotImplementedError(f"NULLIF on {lk}/{rk}")
+        if f in ("if", "iif"):
+            for a in expr.args:
+                if _check(a, blocks) != "num":
+                    raise NotImplementedError("string IF branches")
+            return "num"
+        if f in ("length", "len"):
+            if _check(expr.args[0], blocks) != "str":
+                raise NotImplementedError("LENGTH needs a string column")
+            return "num"
+        if f in _DICT_TRANSFORMS:
+            if _check(expr.args[0], blocks) != "str":
+                raise NotImplementedError(f"{f} needs a string column")
+            return "str"
+        if f in ("substring", "substr", "replace"):
+            if _check(expr.args[0], blocks) != "str":
+                raise NotImplementedError(f"{f} needs a string column")
+            _check_scalar_lit(expr.args, 1)
+            _check_scalar_lit(expr.args, 2)
+            return "str"
+        if f == "concat":
+            kinds = [_check(a, blocks) for a in expr.args]
+            if any(k == "num" for k in kinds):
+                raise NotImplementedError("CONCAT of non-strings")
+            n_str = sum(1 for k in kinds if k == "str")
+            if n_str == 0:
+                return "strlit"
+            if n_str == 1:
+                return "str"
+            raise NotImplementedError("CONCAT over multiple string columns")
         raise NotImplementedError(expr.func)
     raise NotImplementedError(str(expr))
+
+
+def _check_scalar_lit(args: Any, i: int) -> None:
+    if i < len(args) and not (
+        isinstance(args[i], _LitColumnExpr)
+        and isinstance(args[i].value, (int, float, str))
+    ):
+        raise NotImplementedError("scalar parameter must be a literal")

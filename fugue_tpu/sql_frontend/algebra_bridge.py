@@ -436,6 +436,16 @@ def _select(env: Dict[str, object], q: ast.Select) -> Plan:
 
 _DEVICE_WINDOW_AGGS = {"sum", "count", "avg", "mean", "min", "max"}
 
+# scalar functions the bridge forwards into the column algebra (device
+# evaluation or the pandas evaluator; anything else is a host fallback)
+_SCALAR_FN_NAMES = {
+    "abs", "round", "floor", "ceil", "ceiling", "sqrt", "exp", "ln",
+    "log", "log2", "log10", "sin", "cos", "tan", "sign", "power", "pow",
+    "mod", "nullif", "if", "iif", "upper", "ucase", "lower", "lcase",
+    "length", "len", "trim", "ltrim", "rtrim", "reverse", "substring",
+    "substr", "concat", "replace",
+}
+
 # device frame/offset arithmetic runs in int32 sorted-space positions;
 # anything larger stays on the host runner (which handles it exactly)
 _DEVICE_OFFSET_MAX = 1 << 30
@@ -708,6 +718,10 @@ def _expr(e: ast.Expr, scope: _Scope) -> ColumnExpr:
             return getattr(ff, name)(arg)
         if name == "coalesce":
             return ff.coalesce(*[_expr(a, scope) for a in e.args])
+        if name in _SCALAR_FN_NAMES:
+            from fugue_tpu.column.expressions import function
+
+            return function(name, *[_expr(a, scope) for a in e.args])
         raise _GiveUp()
     if isinstance(e, ast.Cast):
         return _expr(e.operand, scope).cast(e.type_name)

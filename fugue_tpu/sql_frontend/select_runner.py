@@ -652,18 +652,18 @@ def _str_fn(f: Callable[[pd.Series], pd.Series],
 
 
 def _fn_substring(ev: _Evaluator, args: List[_TS]) -> _TS:
-    s = args[0].series
-    nulls = s.isna()
-    start = int(args[1].series.iloc[0]) if len(args[1].series) else 1
-    start0 = max(start - 1, 0)
-    if len(args) > 2:
-        length = int(args[2].series.iloc[0]) if len(args[2].series) else 0
-        res = s.astype(object).astype(str).str.slice(start0, start0 + length)
-    else:
-        res = s.astype(object).astype(str).str.slice(start0)
-    res = res.astype(object)
-    res[nulls.to_numpy(dtype=bool)] = None
-    return _TS(res, pa.string())
+    """Per-row 1-based start and optional length (standard SQL); NULL
+    operand/start/length -> NULL. Shared helper with the column-algebra
+    evaluator (``pandas_eval.sql_substring``)."""
+    from fugue_tpu.column.pandas_eval import sql_substring
+
+    starts = pd.to_numeric(args[1].series, errors="coerce")
+    lens = (
+        pd.to_numeric(args[2].series, errors="coerce")
+        if len(args) > 2
+        else None
+    )
+    return _TS(sql_substring(args[0].series, starts, lens), pa.string())
 
 
 def _fn_concat(ev: _Evaluator, args: List[_TS]) -> _TS:

@@ -157,6 +157,21 @@ def test_assign_keeps_string_dictionary():
     assert e.fallbacks == {}, e.fallbacks
 
 
+def test_keyless_aggregate_fingerprint_prevents_stale_programs():
+    # the GLOBAL (keyless) aggregate program also bakes dictionary
+    # lookup tables; its cache key must include the fingerprint
+    # (review finding: reproduced returning 16.0 instead of 40.0)
+    e = make_execution_engine("jax")
+    d1 = pd.DataFrame({"s": ["a", "b", "a"], "v": [1.0, 16.0, 2.0]})
+    d2 = pd.DataFrame({"s": ["b", "c", "b"], "v": [15.0, 7.0, 25.0]})
+    q = "SELECT SUM(CASE WHEN s = 'b' THEN v ELSE 0 END) AS t FROM"
+    r1 = raw_sql(q, d1, engine=e, as_fugue=True).as_pandas()
+    r2 = raw_sql(q, d2, engine=e, as_fugue=True).as_pandas()
+    assert float(r1["t"].iloc[0]) == 16.0
+    assert float(r2["t"].iloc[0]) == 40.0
+    assert e.fallbacks == {}, e.fallbacks
+
+
 def test_dictionary_fingerprint_prevents_stale_programs():
     # same expression uuid over frames with different dictionaries must
     # not reuse a baked lookup table
