@@ -7,6 +7,7 @@ from typing import Any, List, Optional, Tuple
 __all__ = [
     "Expr", "Lit", "Col", "Star", "Unary", "Binary", "Func", "Case", "Cast",
     "InList", "Between", "Like", "IsNull", "Window", "Frame",
+    "ScalarSubquery", "InSubquery", "Exists",
     "Relation", "TableRef", "SubqueryRef", "JoinRel",
     "SelectItem", "OrderItem", "Select", "SetOp", "With", "Query",
 ]
@@ -142,6 +143,37 @@ class IsNull(Expr):
     def __init__(self, operand: Expr, negated: bool):
         self.operand = operand
         self.negated = negated
+
+
+class ScalarSubquery(Expr):
+    """``(SELECT ...)`` as a value: exactly one output column; one row
+    gives its value, zero rows NULL, more is an error. Columns that do
+    not bind inside the subquery correlate to the enclosing scope."""
+
+    _fields = ("query",)
+
+    def __init__(self, query: "Query"):
+        self.query = query
+
+
+class InSubquery(Expr):
+    """``operand [NOT] IN (SELECT ...)`` with SQL three-valued logic."""
+
+    _fields = ("operand", "query", "negated")
+
+    def __init__(self, operand: "Expr", query: "Query", negated: bool):
+        self.operand = operand
+        self.query = query
+        self.negated = negated
+
+
+class Exists(Expr):
+    """``EXISTS (SELECT ...)`` — true iff the subquery returns rows."""
+
+    _fields = ("query",)
+
+    def __init__(self, query: "Query"):
+        self.query = query
 
 
 class Frame(Node):

@@ -13,9 +13,10 @@ comparison, boolean logic and function calls (incl. DISTINCT aggregates).
 from typing import List, Optional, Tuple
 
 from fugue_tpu.sql_frontend.ast import (
-    Between, Binary, Case, Cast, Col, Expr, Frame, Func, InList, IsNull,
-    JoinRel, Like, Lit, OrderItem, Query, Relation, Select, SelectItem,
-    SetOp, Star, SubqueryRef, TableRef, Unary, Window, With,
+    Between, Binary, Case, Cast, Col, Exists, Expr, Frame, Func, InList,
+    InSubquery, IsNull, JoinRel, Like, Lit, OrderItem, Query, Relation,
+    ScalarSubquery, Select, SelectItem, SetOp, Star, SubqueryRef,
+    TableRef, Unary, Window, With,
 )
 from fugue_tpu.sql_frontend.tokenizer import Token, tokenize
 
@@ -399,6 +400,11 @@ class ExprParser:
                 negated = True
             if cur.accept_kw("IN"):
                 cur.expect_op("(")
+                if cur.is_kw("SELECT", "WITH"):
+                    q = self.query()
+                    cur.expect_op(")")
+                    left = InSubquery(left, q, negated)
+                    continue
                 items = [self.expr()]
                 while cur.accept_op(","):
                     items.append(self.expr())
@@ -455,7 +461,9 @@ class ExprParser:
             return Lit(t.value)
         if cur.accept_op("("):
             if cur.is_kw("SELECT", "WITH"):
-                raise cur.error("scalar subqueries are not supported")
+                q = self.query()
+                cur.expect_op(")")
+                return ScalarSubquery(q)
             e = self.expr()
             cur.expect_op(")")
             return e
@@ -476,6 +484,18 @@ class ExprParser:
             return Lit(False)
         if u == "CASE":
             return self._case()
+        if (
+            u == "EXISTS"
+            and cur.peek(1).kind == "OP"
+            and cur.peek(1).value == "("
+            and cur.peek(2).kind == "IDENT"
+            and cur.peek(2).upper in ("SELECT", "WITH")
+        ):
+            cur.advance()
+            cur.advance()  # (
+            q = self.query()
+            cur.expect_op(")")
+            return Exists(q)
         if u == "CAST":
             cur.advance()
             cur.expect_op("(")

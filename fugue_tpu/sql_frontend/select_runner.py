@@ -188,7 +188,7 @@ def _build_scope(rel: ast.Relation, env: Dict[str, _Table]) -> _Scope:
     if isinstance(rel, ast.JoinRel):
         left = _build_scope(rel.left, env)
         right = _build_scope(rel.right, env)
-        return _join_scopes(left, right, rel)
+        return _join_scopes(left, right, rel, env)
     raise SQLExecutionError(f"unsupported relation {type(rel).__name__}")
 
 
@@ -199,7 +199,10 @@ def _relabel(scope: _Scope, prefix: str) -> _Scope:
     return _Scope(frame, entries)
 
 
-def _join_scopes(left: _Scope, right: _Scope, rel: ast.JoinRel) -> _Scope:
+def _join_scopes(
+    left: _Scope, right: _Scope, rel: ast.JoinRel,
+    env: Optional[Dict[str, _Table]] = None,
+) -> _Scope:
     left = _relabel(left, "l_")
     right = _relabel(right, "r_")
     how = rel.how
@@ -223,7 +226,7 @@ def _join_scopes(left: _Scope, right: _Scope, rel: ast.JoinRel) -> _Scope:
             hidden_right.append(re_.label)
     elif rel.on is not None:
         conj = _split_conjunction(rel.on)
-        ev_l, ev_r = _Evaluator(left), _Evaluator(right)
+        ev_l, ev_r = _Evaluator(left, env=env), _Evaluator(right, env=env)
         for c in conj:
             sides = _equi_sides(c, ev_l, ev_r)
             if sides is None:
@@ -239,7 +242,9 @@ def _join_scopes(left: _Scope, right: _Scope, rel: ast.JoinRel) -> _Scope:
             frame = left.frame.merge(right.frame, how="cross")
             scope = _Scope(frame, left.entries + right.entries)
             if rel.on is not None:
-                mask = _to_bool_mask(_Evaluator(scope).eval(rel.on).series)
+                mask = _to_bool_mask(
+                    _Evaluator(scope, env=env).eval(rel.on).series
+                )
                 scope = _Scope(scope.frame[mask], scope.entries)
             return scope
     else:
@@ -274,7 +279,9 @@ def _join_scopes(left: _Scope, right: _Scope, rel: ast.JoinRel) -> _Scope:
         joined = joined[[e.label for e in entries]]
     scope = _Scope(joined.reset_index(drop=True), entries)
     if residual is not None:
-        mask = _to_bool_mask(_Evaluator(scope).eval(residual).series)
+        mask = _to_bool_mask(
+            _Evaluator(scope, env=env).eval(residual).series
+        )
         scope = _Scope(scope.frame[mask].reset_index(drop=True), scope.entries)
     return scope
 
@@ -331,12 +338,184 @@ def _arith_type(
     return pa.float64()
 
 
-class _Evaluator:
-    """Evaluates expressions over a scope with SQL null semantics."""
+def _walk_nodes(n: ast.Node, fn: Callable[[ast.Node], None]) -> None:
+    fn(n)
+    for f in n._fields:
+        _walk_val(getattr(n, f), fn)
 
-    def __init__(self, scope: _Scope, allow_agg: bool = False):
+
+def _walk_val(v: Any, fn: Callable[[ast.Node], None]) -> None:
+    if isinstance(v, ast.Node):
+        _walk_nodes(v, fn)
+    elif isinstance(v, (list, tuple)):
+        for x in v:
+            _walk_val(x, fn)
+
+
+def _transform(n: ast.Node, tr: Callable[[ast.Node], Optional[ast.Node]]) -> Any:
+    r = tr(n)
+    if r is not None:
+        return r
+    return type(n)(*[_transform_val(getattr(n, f), tr) for f in n._fields])
+
+
+def _transform_val(v: Any, tr: Callable[[ast.Node], Optional[ast.Node]]) -> Any:
+    if isinstance(v, ast.Node):
+        return _transform(v, tr)
+    if isinstance(v, list):
+        return [_transform_val(x, tr) for x in v]
+    if isinstance(v, tuple):
+        return tuple(_transform_val(x, tr) for x in v)
+    return v
+
+
+def _static_output_names(
+    q: ast.Query, env_names: Dict[str, List[str]], ctes: Dict[str, List[str]]
+) -> List[str]:
+    """Best-effort output column names of a query WITHOUT executing it
+    (for correlation analysis; unknown pieces expand to nothing)."""
+    if isinstance(q, ast.With):
+        scoped = dict(ctes)
+        for name, sub in q.ctes:
+            scoped[name.lower()] = _static_output_names(sub, env_names, scoped)
+        return _static_output_names(q.body, env_names, scoped)
+    if isinstance(q, ast.SetOp):
+        return _static_output_names(q.left, env_names, ctes)
+    if not isinstance(q, ast.Select):
+        return []
+    out: List[str] = []
+    for i, item in enumerate(q.items):
+        if isinstance(item.expr, ast.Star):
+            rel = q.from_
+            if rel is not None:
+                names: List[str] = []
+
+                def visit(n: ast.Node) -> None:
+                    if isinstance(n, ast.TableRef):
+                        src = ctes.get(n.name.lower()) or env_names.get(
+                            n.name.lower()
+                        )
+                        if src:
+                            names.extend(src)
+                    elif isinstance(n, ast.SubqueryRef):
+                        names.extend(
+                            _static_output_names(n.query, env_names, ctes)
+                        )
+
+                _walk_nodes(rel, visit)
+                out.extend(names)
+        else:
+            out.append(_output_name(item, i))
+    return out
+
+
+def _outer_refs(
+    q: ast.Query, env: Dict[str, "_Table"], outer_scope: "_Scope"
+) -> List[ast.Col]:
+    """Column references inside ``q`` that do not bind to ANY name
+    visible inside the subquery subtree (union-of-subtree name sets;
+    unqualified names prefer inner binding, matching SQL's
+    innermost-first rule) but DO resolve in the enclosing scope."""
+    env_names = {k: list(t.names) for k, t in env.items()}
+    quals: Set[str] = set()
+    cols: Set[str] = set()
+    ctes: Dict[str, List[str]] = {}
+
+    def gather(n: ast.Node) -> None:
+        if isinstance(n, ast.With):
+            for name, sub in n.ctes:
+                ctes[name.lower()] = _static_output_names(
+                    sub, env_names, ctes
+                )
+                quals.add(name.lower())
+        elif isinstance(n, ast.TableRef):
+            alias = (n.alias or n.name).lower()
+            quals.add(alias)
+            src = ctes.get(n.name.lower()) or env_names.get(
+                n.name.lower()
+            ) or []
+            cols.update(x.lower() for x in src)
+        elif isinstance(n, ast.SubqueryRef):
+            quals.add(n.alias.lower())
+            cols.update(
+                x.lower()
+                for x in _static_output_names(n.query, env_names, ctes)
+            )
+        elif isinstance(n, ast.SelectItem) and n.alias is not None:
+            # select aliases count as inner names so ORDER BY/GROUP BY
+            # alias refs inside the subquery are never substituted.
+            # Known limit: an unqualified OUTER ref colliding with an
+            # inner select alias binds nowhere and errors (this engine
+            # never resolves aliases in WHERE, subquery or not)
+            cols.add(n.alias.lower())
+
+    _walk_nodes(q, gather)
+    found: List[ast.Col] = []
+    seen: Set[Tuple[Optional[str], str]] = set()
+
+    def classify(n: ast.Node) -> None:
+        if not isinstance(n, ast.Col):
+            return
+        tl = n.table.lower() if n.table is not None else None
+        key = (tl, n.name.lower())
+        if key in seen:
+            return
+        if tl is not None:
+            if tl in quals:
+                return
+        elif n.name.lower() in cols:
+            return
+        try:
+            outer_scope.resolve(n.name, n.table)
+        except Exception:
+            return
+        seen.add(key)
+        found.append(ast.Col(n.name, n.table))
+
+    _walk_nodes(q, classify)
+    return found
+
+
+def _subst_outer(
+    q: ast.Query, refs: List[ast.Col], values: Tuple[Any, ...]
+) -> ast.Query:
+    """Rebuild the subquery with every outer reference replaced by the
+    current outer row's value as a literal."""
+    mapping = {
+        (
+            r.table.lower() if r.table is not None else None,
+            r.name.lower(),
+        ): v
+        for r, v in zip(refs, values)
+    }
+
+    def tr(n: ast.Node) -> Optional[ast.Node]:
+        if isinstance(n, ast.Col):
+            key = (
+                n.table.lower() if n.table is not None else None,
+                n.name.lower(),
+            )
+            if key in mapping:
+                return ast.Lit(mapping[key])
+        return None
+
+    return _transform(q, tr)
+
+
+class _Evaluator:
+    """Evaluates expressions over a scope with SQL null semantics.
+    ``env`` (the visible tables) enables subquery expressions; outer
+    references inside them correlate to this evaluator's scope."""
+
+    def __init__(
+        self,
+        scope: _Scope,
+        allow_agg: bool = False,
+        env: Optional[Dict[str, _Table]] = None,
+    ):
         self.scope = scope
         self.allow_agg = allow_agg
+        self.env = env
 
     @property
     def index(self) -> pd.Index:
@@ -391,9 +570,135 @@ class _Evaluator:
             return self._func(e)
         if isinstance(e, ast.Window):
             return _eval_window(self, e)
+        if isinstance(e, ast.ScalarSubquery):
+            return self._scalar_subquery(e)
+        if isinstance(e, ast.InSubquery):
+            return self._in_subquery(e)
+        if isinstance(e, ast.Exists):
+            return self._exists(e)
         if isinstance(e, ast.Star):
             raise SQLExecutionError("wildcard not allowed in this context")
         raise SQLExecutionError(f"unsupported expression {type(e).__name__}")
+
+    def _subquery_tables(
+        self, q: ast.Query
+    ) -> Tuple[Optional[_Table], Optional[List[_Table]]]:
+        """Execute a subquery: uncorrelated -> (table, None), executed
+        once; correlated -> (None, per-row tables), executed once per
+        DISTINCT outer-reference tuple."""
+        env = self.env if self.env is not None else {}
+        refs = _outer_refs(q, env, self.scope)
+        if not refs:
+            return _run(q, env), None
+        series = [self.eval(c).series for c in refs]
+        cache: Dict[Tuple[Any, ...], _Table] = {}
+        per_row: List[_Table] = []
+        for i in range(len(self.index)):
+            vals = []
+            for s in series:
+                v = s.iloc[i]
+                if pd.isna(v):
+                    v = None
+                elif hasattr(v, "item"):
+                    v = v.item()
+                vals.append(v)
+            key = tuple(vals)
+            if key not in cache:
+                q2 = _subst_outer(q, refs, key)
+                cache[key] = _run(q2, env)
+            per_row.append(cache[key])
+        return None, per_row
+
+    def _scalar_subquery(self, e: ast.ScalarSubquery) -> _TS:
+        once, per_row = self._subquery_tables(e.query)
+
+        def _value(t: _Table) -> Any:
+            if len(t.names) != 1:
+                raise SQLExecutionError(
+                    "scalar subquery must return exactly one column"
+                )
+            if len(t.frame) > 1:
+                raise SQLExecutionError(
+                    "scalar subquery returned more than one row"
+                )
+            if len(t.frame) == 0:
+                return None
+            v = t.frame.iloc[0, 0]
+            return None if pd.isna(v) else v
+
+        if once is not None:
+            return self.const(_value(once), once.types[0])
+        assert per_row is not None
+        tp = per_row[0].types[0] if per_row else None
+        vals = [_value(t) for t in per_row]
+        ser = pd.Series(vals, index=self.index)  # infers; None -> NaN
+        return _TS(ser, tp)
+
+    def _in_subquery(self, e: ast.InSubquery) -> _TS:
+        ots = self.eval(e.operand)
+        once, per_row = self._subquery_tables(e.query)
+
+        def _membership(v: Any, t: _Table) -> Any:
+            """SQL 3VL: match -> True; no match but NULLs present ->
+            NULL; empty set -> False; NULL operand -> NULL unless the
+            set is empty."""
+            if len(t.names) != 1:
+                raise SQLExecutionError(
+                    "IN subquery must return exactly one column"
+                )
+            col = t.frame.iloc[:, 0]
+            if len(col) == 0:
+                return False
+            if pd.isna(v):
+                return None
+            nn = col.dropna()
+            hit = bool((nn == v).any()) if len(nn) else False
+            if hit:
+                return True
+            return None if len(nn) < len(col) else False
+
+        if once is not None:
+            # vectorized path: one isin over the precomputed value set
+            if len(once.names) != 1:
+                raise SQLExecutionError(
+                    "IN subquery must return exactly one column"
+                )
+            col = once.frame.iloc[:, 0]
+            nn = col.dropna()
+            has_null = len(nn) < len(col)
+            if len(col) == 0:
+                res = pd.Series(False, index=self.index).astype("boolean")
+            else:
+                hit = ots.series.isin(nn).astype("boolean")
+                if has_null:
+                    hit[~hit.fillna(False).to_numpy(dtype=bool)] = pd.NA
+                hit[ots.series.isna().to_numpy(dtype=bool)] = pd.NA
+                res = hit
+            if e.negated:
+                res = ~res
+            return _TS(res, pa.bool_())
+        vals = []
+        for i in range(len(self.index)):
+            m = _membership(ots.series.iloc[i], per_row[i])  # type: ignore
+            if e.negated and m is not None:
+                m = not m
+            vals.append(m)
+        return _TS(
+            pd.Series(vals, index=self.index, dtype=object).astype(
+                "boolean"
+            ),
+            pa.bool_(),
+        )
+
+    def _exists(self, e: ast.Exists) -> _TS:
+        once, per_row = self._subquery_tables(e.query)
+        if once is not None:
+            return self.const(len(once.frame) > 0, pa.bool_())
+        assert per_row is not None
+        vals = [len(t.frame) > 0 for t in per_row]
+        return _TS(
+            pd.Series(vals, index=self.index, dtype="boolean"), pa.bool_()
+        )
 
     def _unary(self, e: ast.Unary) -> _TS:
         ts = self.eval(e.operand)
@@ -447,10 +752,14 @@ class _Evaluator:
             ">": lambda a, b: a > b,
             ">=": lambda a, b: a >= b,
         }
-        with np.errstate(invalid="ignore"):
-            res = func[op](left, right)
-        res = pd.Series(res, index=left.index).astype("boolean")
-        res[nulls.to_numpy(dtype=bool)] = pd.NA
+        # compare only non-null positions: object-dtype series (e.g.
+        # subquery results) would raise on None-vs-value otherwise
+        res = pd.Series(pd.NA, index=left.index, dtype="boolean")
+        m = (~nulls).to_numpy(dtype=bool)
+        if m.any():
+            with np.errstate(invalid="ignore"):
+                r = func[op](left[m], right[m])
+            res[m] = np.asarray(r, dtype=bool)
         return _TS(res, pa.bool_())
 
     def _in_list(self, e: ast.InList) -> _TS:
@@ -785,6 +1094,10 @@ def _children(e: ast.Expr) -> List[ast.Expr]:
             out.append(e.pattern)
     elif isinstance(e, ast.Between):
         out = [e.operand, e.low, e.high]
+    elif isinstance(e, ast.InSubquery):
+        # the subquery body is its OWN scope — only the operand belongs
+        # to this one (ScalarSubquery/Exists contribute nothing)
+        out = [e.operand]
     return out
 
 
@@ -1523,7 +1836,9 @@ def _run_select(q: ast.Select, env: Dict[str, _Table]) -> _Table:
             raise SQLExecutionError("WHERE cannot contain aggregations")
         if _contains_window(q.where):
             raise SQLExecutionError("WHERE cannot contain window functions")
-        mask = _to_bool_mask(_Evaluator(scope).eval(q.where).series)
+        mask = _to_bool_mask(
+            _Evaluator(scope, env=env).eval(q.where).series
+        )
         scope = _Scope(scope.frame[mask], scope.entries)
 
     has_agg = (
@@ -1547,10 +1862,10 @@ def _run_select(q: ast.Select, env: Dict[str, _Table]) -> _Table:
         )
     resolver: Optional[Callable[[ast.Expr], _TS]]
     if has_agg:
-        out, resolver = _run_agg_select(q, scope)
+        out, resolver = _run_agg_select(q, scope, env)
     else:
-        out = _run_plain_select(q, scope)
-        ev = _Evaluator(scope)
+        out = _run_plain_select(q, scope, env)
+        ev = _Evaluator(scope, env=env)
         resolver = ev.eval
     if q.distinct:
         # keep the original index so order keys can still be reindexed
@@ -1567,8 +1882,10 @@ def _output_name(item: ast.SelectItem, i: int) -> str:
     return f"col_{i}"
 
 
-def _run_plain_select(q: ast.Select, scope: _Scope) -> _Table:
-    ev = _Evaluator(scope)
+def _run_plain_select(
+    q: ast.Select, scope: _Scope, env: Optional[Dict[str, _Table]] = None
+) -> _Table:
+    ev = _Evaluator(scope, env=env)
     cols: List[Tuple[str, _TS]] = []
     for i, item in enumerate(q.items):
         if isinstance(item.expr, ast.Star):
@@ -1598,7 +1915,7 @@ def _check_dup(names: List[str]) -> None:
 class _AggContext:
     """Post-aggregation scope: group keys + aggregated values by node."""
 
-    def __init__(self) -> None:
+    def __init__(self, env: Optional[Dict[str, _Table]] = None) -> None:
         self.key_exprs: List[ast.Expr] = []
         self.key_labels: List[str] = []
         self.key_types: List[Optional[pa.DataType]] = []
@@ -1606,6 +1923,7 @@ class _AggContext:
         self.agg_labels: List[str] = []
         self.agg_types: List[Optional[pa.DataType]] = []
         self.frame = pd.DataFrame()
+        self.env = env
 
     def eval_post(self, e: ast.Expr, scope: _Scope) -> _TS:
         """Evaluate over the aggregated frame, mapping group-by exprs and
@@ -1628,7 +1946,7 @@ class _AggContext:
                 f"column {_qname(e.name, e.table)} is not in GROUP BY"
             )
         # structural recursion via a shadow evaluator over the agg frame
-        sub = _Evaluator(_Scope(self.frame, []))
+        sub = _Evaluator(_Scope(self.frame, []), env=self.env)
         return _eval_with_hook(sub, e, lambda x: self._hook(x, scope))
 
     def _hook(self, e: ast.Expr, scope: _Scope) -> Optional[_TS]:
@@ -1686,9 +2004,9 @@ def _resolve_groupby_expr(
 
 
 def _run_agg_select(
-    q: ast.Select, scope: _Scope
+    q: ast.Select, scope: _Scope, env: Optional[Dict[str, _Table]] = None
 ) -> Tuple[_Table, Callable[[ast.Expr], _TS]]:
-    ctx = _AggContext()
+    ctx = _AggContext(env)
     ctx.key_exprs = [_resolve_groupby_expr(g, q) for g in q.group_by]
     for k in ctx.key_exprs:
         if _contains_agg(k):
@@ -1704,7 +2022,7 @@ def _run_agg_select(
         _collect_aggs(o.expr, aggs)
     ctx.agg_nodes = aggs
 
-    ev = _Evaluator(scope)
+    ev = _Evaluator(scope, env=env)
     work = pd.DataFrame(index=scope.frame.index)
     key_labels = []
     for i, k in enumerate(ctx.key_exprs):

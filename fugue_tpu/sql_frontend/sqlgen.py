@@ -288,4 +288,21 @@ class _Gen:
                 )
             self.emit(")")
             return
+        if isinstance(e, ast.ScalarSubquery):
+            self.emit("(")
+            self.query(e.query, ctes)
+            self.emit(")")
+            return
+        if isinstance(e, ast.InSubquery):
+            self.emit("(")
+            self.expr(e.operand, ctes)
+            self.emit(" NOT IN (" if e.negated else " IN (")
+            self.query(e.query, ctes)
+            self.emit("))")
+            return
+        if isinstance(e, ast.Exists):
+            self.emit("EXISTS (")
+            self.query(e.query, ctes)
+            self.emit(")")
+            return
         raise ValueError(f"cannot serialize {type(e).__name__}")

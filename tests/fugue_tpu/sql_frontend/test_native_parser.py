@@ -62,6 +62,10 @@ PY_ONLY = [
     "SELECT SUM(v) OVER (ORDER BY v ROWS 1 PRECEDING) FROM t",
     "SELECT SUM(v) OVER (ORDER BY v RANGE BETWEEN 1 PRECEDING AND"
     " 1 FOLLOWING) FROM t",
+    # subquery expressions
+    "SELECT a FROM t WHERE v > (SELECT AVG(w) FROM u)",
+    "SELECT a FROM t WHERE k IN (SELECT k FROM u)",
+    "SELECT a FROM t WHERE EXISTS (SELECT 1 FROM u WHERE u.k = t.k)",
 ]
 
 

@@ -1,0 +1,195 @@
+"""Subquery expressions — scalar subqueries, IN (SELECT ...), EXISTS —
+correlated and uncorrelated. Semantics to match: standard SQL as the
+reference executes through DuckDB/SparkSQL
+(``/root/reference/fugue_duckdb/execution_engine.py:37``): scalar
+subqueries yield NULL on zero rows and error on >1, IN uses
+three-valued logic, correlation binds to the nearest enclosing scope."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fugue_tpu.sql_frontend.select_runner import SQLExecutionError
+from fugue_tpu.workflow.api import raw_sql
+
+
+def _a() -> pd.DataFrame:
+    return pd.DataFrame({"k": [1, 2, 3], "v": [10, 20, 30]})
+
+
+def _b() -> pd.DataFrame:
+    return pd.DataFrame({"k": [1, 2, 4], "w": [5, 25, 45]})
+
+
+def _run(*parts, engine="native"):
+    return raw_sql(*parts, engine=engine, as_fugue=True).as_pandas()
+
+
+@pytest.mark.parametrize("engine", ["native", "jax"])
+def test_correlated_scalar_subquery(engine):
+    r = _run(
+        "SELECT k, v FROM", _a(),
+        "AS a WHERE v > (SELECT AVG(w) FROM", _b(),
+        "AS b WHERE b.k = a.k)", engine=engine,
+    )
+    # k=1: 10 > 5; k=2: 20 > 25 false; k=3: empty -> NULL -> filtered
+    assert sorted(r["k"]) == [1]
+
+
+def test_uncorrelated_scalar_subquery():
+    r = _run("SELECT k FROM", _a(),
+             "WHERE v > (SELECT AVG(w) FROM", _b(), ")")
+    assert sorted(r["k"]) == [3]
+
+
+def test_scalar_subquery_in_select_items():
+    r = _run("SELECT k, (SELECT MAX(w) FROM", _b(), ") AS mw FROM", _a())
+    assert list(r["mw"]) == [45, 45, 45]
+
+
+def test_correlated_scalar_in_select_items():
+    r = _run(
+        "SELECT k, (SELECT SUM(w) FROM", _b(),
+        "AS b WHERE b.k = a.k) AS sw FROM", _a(), "AS a ORDER BY k",
+    )
+    assert list(r["sw"].fillna(-1)) == [5, 25, -1]
+
+
+def test_scalar_subquery_multiple_rows_errors():
+    with pytest.raises(Exception, match="more than one row"):
+        _run("SELECT k FROM", _a(),
+             "WHERE v > (SELECT w FROM", _b(), ")")
+
+
+def test_scalar_subquery_multiple_columns_errors():
+    with pytest.raises(Exception, match="one column"):
+        _run("SELECT k FROM", _a(),
+             "WHERE v > (SELECT k, w FROM", _b(), ")")
+
+
+@pytest.mark.parametrize("engine", ["native", "jax"])
+def test_in_subquery(engine):
+    r = _run("SELECT k FROM", _a(),
+             "WHERE k IN (SELECT k FROM", _b(), ")", engine=engine)
+    assert sorted(r["k"]) == [1, 2]
+
+
+def test_not_in_subquery_with_nulls_matches_nothing():
+    # SQL 3VL: NOT IN over a set containing NULL is never TRUE
+    b2 = pd.DataFrame({"k": [1.0, None]})
+    r = _run("SELECT k FROM", _a(),
+             "WHERE k NOT IN (SELECT k FROM", b2, ")")
+    assert len(r) == 0
+
+
+def test_in_empty_subquery_is_false_not_null():
+    b2 = pd.DataFrame({"k": [9.0]})
+    r = _run("SELECT k FROM", _a(),
+             "WHERE k NOT IN (SELECT k FROM", b2,
+             "WHERE k < 0)")
+    assert sorted(r["k"]) == [1, 2, 3]
+
+
+def test_correlated_in_subquery():
+    r = _run(
+        "SELECT k FROM", _a(),
+        "AS a WHERE v IN (SELECT w + 5 FROM", _b(),
+        "AS b WHERE b.k = a.k)",
+    )
+    assert sorted(r["k"]) == [1]  # k=1: 10 in {10}
+
+
+@pytest.mark.parametrize("engine", ["native", "jax"])
+def test_exists_and_not_exists(engine):
+    r = _run(
+        "SELECT k FROM", _a(),
+        "AS a WHERE EXISTS (SELECT 1 FROM", _b(),
+        "AS b WHERE b.k = a.k AND b.w > 20)", engine=engine,
+    )
+    assert sorted(r["k"]) == [2]
+    r = _run(
+        "SELECT k FROM", _a(),
+        "AS a WHERE NOT EXISTS (SELECT 1 FROM", _b(),
+        "AS b WHERE b.k = a.k)", engine=engine,
+    )
+    assert sorted(r["k"]) == [3]
+
+
+def test_exists_uncorrelated():
+    r = _run("SELECT k FROM", _a(),
+             "WHERE EXISTS (SELECT 1 FROM", _b(), "WHERE w > 100)")
+    assert len(r) == 0
+
+
+def test_correlated_subquery_caches_by_distinct_tuple():
+    # many outer rows, few distinct keys: results stay correct
+    rng = np.random.default_rng(5)
+    big = pd.DataFrame(
+        {"k": rng.integers(1, 4, 200), "v": rng.integers(0, 50, 200)}
+    )
+    r = _run(
+        "SELECT k, v FROM", big,
+        "AS a WHERE v > (SELECT AVG(w) FROM", _b(),
+        "AS b WHERE b.k = a.k)",
+    )
+    exp = []
+    avg = {1: 5.0, 2: 25.0}
+    for _, row in big.iterrows():
+        if row["k"] in avg and row["v"] > avg[row["k"]]:
+            exp.append((row["k"], row["v"]))
+    assert sorted(map(tuple, r.to_numpy().tolist())) == sorted(exp)
+
+
+def test_subquery_in_cte_and_nested():
+    r = _run(
+        "WITH big AS (SELECT k, v FROM", _a(),
+        "WHERE v >= (SELECT AVG(v) FROM", _a(),
+        ")) SELECT k FROM big ORDER BY k",
+    )
+    assert list(r["k"]) == [2, 3]
+
+
+def test_subquery_in_having_and_agg_items():
+    # the post-aggregation shadow evaluator must see the table env
+    # (review finding: 'table not found' in HAVING subqueries)
+    orders = pd.DataFrame({"k": [1, 1, 2, 3], "v": [10, 30, 5, 99]})
+    r = _run(
+        "SELECT k, SUM(v) AS s FROM", orders,
+        "GROUP BY k HAVING SUM(v) > (SELECT AVG(v) FROM", orders,
+        ") ORDER BY k",
+    )
+    assert list(r["k"]) == [1, 3]  # avg=36; sums 40, 5, 99
+    r = _run(
+        "SELECT k, SUM(v) + (SELECT MIN(v) FROM", orders,
+        ") AS t FROM", orders, "GROUP BY k ORDER BY k",
+    )
+    assert list(r["t"]) == [45, 10, 104]
+
+
+def test_uncorrelated_in_is_vectorized_and_correct():
+    rng = np.random.default_rng(9)
+    big = pd.DataFrame({"k": rng.integers(0, 1000, 5000)})
+    sub = pd.DataFrame({"k": rng.integers(0, 1000, 500)})
+    r = _run("SELECT k FROM", big,
+             "WHERE k IN (SELECT k FROM", sub, ")")
+    exp = big[big["k"].isin(set(sub["k"]))]
+    assert sorted(r["k"]) == sorted(exp["k"])
+
+
+def test_exists_as_function_name_still_works():
+    # EXISTS not followed by (SELECT stays an ordinary identifier
+    df = pd.DataFrame({"exists": [1, 2]})
+    r = _run("SELECT exists FROM", df, "ORDER BY 1")
+    assert list(r.iloc[:, 0]) == [1, 2]
+
+
+def test_inner_name_shadows_outer():
+    # unqualified names bind innermost-first: v inside the subquery is
+    # b's v, not a's
+    b3 = pd.DataFrame({"k": [1, 2], "v": [100, 200]})
+    r = _run(
+        "SELECT k FROM", _a(),
+        "AS a WHERE EXISTS (SELECT 1 FROM", b3,
+        "AS b WHERE v > 150 AND b.k = a.k)",
+    )
+    assert sorted(r["k"]) == [2]
