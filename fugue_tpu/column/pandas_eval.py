@@ -471,13 +471,27 @@ def eval_select(
         if cols.is_distinct:
             out = out.drop_duplicates()
         return out.reset_index(drop=True)
-    # aggregation path: group keys are the non-agg output columns
+    # aggregation path: group keys are the non-agg output columns.
+    # Computed keys materialize under TEMP names so an alias shadowing a
+    # source column (SELECT x % 10 AS x, SUM(x) ...) cannot corrupt the
+    # aggregate arguments (review-adjacent finding)
     key_names: List[str] = []
+    key_rename: Dict[str, str] = {}
     work = df.copy(deep=False)
-    for k in cols.group_keys:
+    for i, k in enumerate(cols.group_keys):
         name = k.output_name
-        work[name] = eval_expr(df, k) if len(df) > 0 else None
-        key_names.append(name)
+        if (
+            isinstance(k, _NamedColumnExpr)
+            and k.as_type is None
+            and k.name == name
+            and name in work.columns
+        ):
+            key_names.append(name)  # plain passthrough key
+            continue
+        tmp = f"_gk_{i}"
+        work[tmp] = eval_expr(df, k) if len(df) > 0 else None
+        key_rename[tmp] = name
+        key_names.append(tmp)
     aggs = {c.output_name: c for c in cols.agg_funcs}
     having_rewritten: Optional[ColumnExpr] = None
     if having is not None:
@@ -489,6 +503,8 @@ def eval_select(
         having_rewritten = _rewrite_having(having, computed, extra)
         aggs = dict(aggs, **extra)
     res = eval_aggregate(work, key_names, aggs)
+    if key_rename:
+        res = res.rename(columns=key_rename)
     if having_rewritten is not None:
         res = eval_filter(res, having_rewritten)
     # order columns as requested

@@ -763,14 +763,20 @@ class JaxExecutionEngine(ExecutionEngine):
                         else None
                     )
                 )
+                data = outs[f"v:{name}"]
+                stats = src.stats if src is not None else None
+                if dict_r is not None and src is None:
+                    data, dict_r, stats = expr_eval.finalize_string_result(
+                        data, dict_r
+                    )
                 new_cols[name] = JaxColumn(
                     tp,
-                    jax.device_put(outs[f"v:{name}"], sharding),
+                    jax.device_put(data, sharding),
                     None
                     if f"m:{name}" not in outs
                     else jax.device_put(outs[f"m:{name}"], sharding),
                     dict_r,
-                    src.stats if src is not None else None,
+                    stats,
                 )
             return JaxDataFrame(blocks_with_columns(blocks, new_cols), schema)
         self._count_fallback("assign")
@@ -1321,13 +1327,21 @@ class JaxExecutionEngine(ExecutionEngine):
             return all(
                 expr_eval.can_eval_on_device(c, blocks) for c in cols.all_cols
             )
-        # aggregation: group keys must be simple device columns (string keys
-        # allowed: they group by dictionary code)
+        # aggregation: group keys are device columns (string keys group by
+        # dictionary code) or device-evaluable expressions, which get
+        # materialized as key columns before the aggregate
         for k in cols.group_keys:
-            if not isinstance(k, _NamedColumnExpr) or k.as_type is not None:
+            if isinstance(k, _NamedColumnExpr) and k.as_type is None:
+                col = blocks.columns.get(k.name)
+                if col is None or not col.on_device:
+                    return False
+                continue
+            name = k.output_name
+            if name == "" or name in blocks.columns:
+                # unnamed, or shadowing an existing column an agg arg
+                # might still reference: host handles it
                 return False
-            col = blocks.columns.get(k.name)
-            if col is None or not col.on_device:
+            if not expr_eval.can_eval_on_device(k, blocks):
                 return False
         from fugue_tpu.column.expressions import _FuncExpr
 
@@ -1389,14 +1403,20 @@ class JaxExecutionEngine(ExecutionEngine):
                     else None
                 )
             )
+            data = outs[f"v:{f.name}"]
+            stats = src.stats if src is not None else None
+            if dict_r is not None and src is None:
+                data, dict_r, stats = expr_eval.finalize_string_result(
+                    data, dict_r
+                )
             new_cols[f.name] = JaxColumn(
                 f.type,
-                jax.device_put(outs[f"v:{f.name}"], sharding),
+                jax.device_put(data, sharding),
                 None
                 if f"m:{f.name}" not in outs
                 else jax.device_put(outs[f"m:{f.name}"], sharding),
                 dict_r,
-                src.stats if src is not None else None,
+                stats,
             )
         return JaxDataFrame(
             blocks_with_columns(blocks, new_cols), out_schema
@@ -1409,7 +1429,18 @@ class JaxExecutionEngine(ExecutionEngine):
         out_schema: Schema,
         having: Optional[ColumnExpr],
     ) -> Optional[DataFrame]:
-        keys = [k.name for k in cols.group_keys]  # type: ignore
+        keys: List[str] = []
+        computed: List[ColumnExpr] = []
+        for k in cols.group_keys:
+            if isinstance(k, _NamedColumnExpr) and k.as_type is None:
+                keys.append(k.name)
+            else:
+                # expression key: materialize it as a key column first
+                # (_can_select_on_device guarantees a fresh output name)
+                computed.append(k)
+                keys.append(k.output_name)
+        if computed:
+            jdf = self.to_df(self.assign(jdf, computed))  # type: ignore
         aggs = [(c.output_name, c) for c in cols.agg_funcs]
         res = self._try_device_aggregate(
             jdf, keys, [c for _, c in aggs], out_schema=out_schema,

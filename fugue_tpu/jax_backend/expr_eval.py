@@ -498,6 +498,32 @@ def blocks_to_masked(blocks: JaxBlocks) -> Dict[str, Masked]:
     return res
 
 
+def canonicalize_string_column(
+    data: jnp.ndarray, dictionary: np.ndarray
+) -> Tuple[jnp.ndarray, np.ndarray]:
+    """Re-encode codes when a TRANSFORMED decode table contains
+    duplicate values (e.g. TRIM collapsing ``"a "`` and ``"a"``):
+    code-identity operations — group-by, distinct, joins, sort ranks —
+    require one code per distinct string."""
+    if len(dictionary) == 0:
+        return data, dictionary
+    uniq, inverse = np.unique(dictionary.astype(str), return_inverse=True)
+    if len(uniq) == len(dictionary):
+        return data, dictionary
+    lut = jnp.asarray(inverse.astype(np.int32))
+    new = jnp.take(lut, jnp.clip(data, 0, len(dictionary) - 1))
+    return new, uniq.astype(object)
+
+
+def finalize_string_result(
+    data: jnp.ndarray, dictionary: np.ndarray
+) -> Tuple[jnp.ndarray, np.ndarray, Tuple[int, int]]:
+    """Canonicalize a transformed string column and derive its code
+    stats — the one shared attach path for computed string outputs."""
+    data, dictionary = canonicalize_string_column(data, dictionary)
+    return data, dictionary, (0, max(len(dictionary) - 1, 0))
+
+
 def dicts_of(blocks: JaxBlocks) -> Dict[str, np.ndarray]:
     """Decode tables of the device-resident string columns (host side)."""
     return {

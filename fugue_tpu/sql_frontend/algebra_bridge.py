@@ -414,13 +414,66 @@ def _select(env: Dict[str, object], q: ast.Select) -> Plan:
     if cols.has_agg and implicit_star:
         raise _GiveUp()
     if q.group_by:
-        keys = set()
+        # each GROUP BY entry — ordinal, select alias, plain column or
+        # expression — must cover a non-agg select item, and every
+        # non-agg item must be covered (extra keys: host runner)
+        na_pairs = [
+            (item, e)
+            for item, e in zip(q.items, exprs)
+            if any(e is k for k in cols.group_keys)
+        ]
+        covered = [False] * len(na_pairs)
+
+        def _cover(pred) -> bool:
+            hit = False
+            for j, (item, e2) in enumerate(na_pairs):
+                if pred(item, e2):
+                    covered[j] = True
+                    hit = True
+            return hit
+
         for g in q.group_by:
-            if not isinstance(g, ast.Col):
+            if (
+                isinstance(g, ast.Lit)
+                and isinstance(g.value, int)
+                and not isinstance(g.value, bool)
+            ):
+                idx = g.value - 1
+                if not (0 <= idx < len(q.items)) or not _cover(
+                    lambda item, _e, t=q.items[idx]: item is t
+                ):
+                    raise _GiveUp()
+                continue
+            if isinstance(g, ast.Col):
+                if g.table is None and _cover(
+                    lambda item, _e: item.alias is not None
+                    and item.alias.lower() == g.name.lower()
+                ):
+                    continue
+                try:
+                    resolved = scope.resolve(g.name, g.table).lower()
+                except Exception:
+                    raise _GiveUp()
+
+                def _same_col(item: ast.SelectItem, _e: ColumnExpr) -> bool:
+                    if not isinstance(item.expr, ast.Col):
+                        return False
+                    try:
+                        return (
+                            scope.resolve(
+                                item.expr.name, item.expr.table
+                            ).lower()
+                            == resolved
+                        )
+                    except Exception:
+                        return False
+
+                if _cover(_same_col):
+                    continue
                 raise _GiveUp()
-            keys.add(scope.resolve(g.name, g.table).lower())
-        non_agg = {c.output_name.lower() for c in cols.group_keys}
-        if keys != non_agg or not cols.has_agg:
+            if not _cover(lambda item, _e: item.expr == g):
+                raise _GiveUp()
+        if not all(covered) or not cols.has_agg:
             raise _GiveUp()
     elif cols.has_agg and len(cols.group_keys) > 0:
         raise _GiveUp()  # non-agg cols without GROUP BY is invalid SQL
@@ -685,6 +738,12 @@ def _expr(e: ast.Expr, scope: _Scope) -> ColumnExpr:
         raise _GiveUp()
     if isinstance(e, ast.Binary):
         op = e.op.upper()
+        if op == "%":
+            from fugue_tpu.column.expressions import function
+
+            return function(
+                "mod", _expr(e.left, scope), _expr(e.right, scope)
+            )
         if op not in _BIN_OPS:
             raise _GiveUp()
         lv, rv = _expr(e.left, scope), _expr(e.right, scope)

@@ -1945,8 +1945,22 @@ class _AggContext:
             raise SQLExecutionError(
                 f"column {_qname(e.name, e.table)} is not in GROUP BY"
             )
-        # structural recursion via a shadow evaluator over the agg frame
-        sub = _Evaluator(_Scope(self.frame, []), env=self.env)
+        # structural recursion via a shadow evaluator over the agg frame.
+        # Plain-column group keys become scope entries (qualified with
+        # their PRE-aggregation qualifier) so qualified refs — notably
+        # correlated subqueries' outer references like ``a.k`` in HAVING
+        # — resolve to the grouped key columns (review finding)
+        entries: List[_Entry] = []
+        for k, lbl, tp in zip(
+            self.key_exprs, self.key_labels, self.key_types
+        ):
+            if isinstance(k, ast.Col):
+                try:
+                    src = scope.resolve(k.name, k.table)
+                except SQLExecutionError:
+                    continue
+                entries.append(_Entry(src.qual, src.name, lbl, tp))
+        sub = _Evaluator(_Scope(self.frame, entries), env=self.env)
         return _eval_with_hook(sub, e, lambda x: self._hook(x, scope))
 
     def _hook(self, e: ast.Expr, scope: _Scope) -> Optional[_TS]:

@@ -166,6 +166,26 @@ def test_subquery_in_having_and_agg_items():
     assert list(r["t"]) == [45, 10, 104]
 
 
+def test_correlated_subquery_in_having_and_agg_items():
+    # the post-aggregation scope exposes plain-column group keys under
+    # their pre-aggregation qualifiers, so a.k correlates from HAVING
+    # (review finding: raised 'column not found: a.k')
+    orders = pd.DataFrame({"k": [1, 1, 2, 3], "v": [10, 30, 5, 99]})
+    limits = pd.DataFrame({"k": [1, 2, 3], "w": [35.0, 10.0, 100.0]})
+    r = _run(
+        "SELECT k, SUM(v) AS s FROM", orders,
+        "AS a GROUP BY k HAVING SUM(v) > (SELECT w FROM", limits,
+        "AS b WHERE b.k = a.k) ORDER BY k",
+    )
+    assert list(r["k"]) == [1]  # 40>35 T; 5>10 F; 99>100 F
+    r = _run(
+        "SELECT k, (SELECT w FROM", limits,
+        "AS b WHERE b.k = a.k) AS lim, SUM(v) AS s FROM", orders,
+        "AS a GROUP BY k ORDER BY k",
+    )
+    assert list(r["lim"]) == [35.0, 10.0, 100.0]
+
+
 def test_uncorrelated_in_is_vectorized_and_correct():
     rng = np.random.default_rng(9)
     big = pd.DataFrame({"k": rng.integers(0, 1000, 5000)})
