@@ -345,8 +345,14 @@ def _apply_agg(
             return grouped[col].nunique(dropna=True)
         return grouped[col].count()
     if f in ("avg", "mean"):
+        if distinct:
+            return grouped[col].agg(lambda s: s.drop_duplicates().mean())
         return grouped[col].mean()
     if f == "sum":
+        if distinct:
+            return grouped[col].agg(
+                lambda s: s.drop_duplicates().sum(min_count=1)
+            )
         return grouped[col].sum(min_count=1)  # all-null -> NULL like SQL
     if f == "min":
         return grouped[col].min()
@@ -366,8 +372,10 @@ def _global_agg(df: pd.DataFrame, func: str, col: str, distinct: bool) -> Any:
     if f == "count":
         return s.nunique(dropna=True) if distinct else s.count()
     if f in ("avg", "mean"):
-        return s.mean()
+        return s.drop_duplicates().mean() if distinct else s.mean()
     if f == "sum":
+        if distinct:
+            return s.drop_duplicates().sum(min_count=1)
         return s.sum(min_count=1)
     if f == "min":
         return s.min()

@@ -1313,8 +1313,8 @@ class JaxExecutionEngine(ExecutionEngine):
         where: Optional[ColumnExpr],
         having: Optional[ColumnExpr],
     ) -> bool:
-        if having is not None:
-            return False  # having rewrite handled on host for now
+        if having is not None and not cols.has_agg:
+            return False  # invalid SQL: host owns the error
         if cols.is_distinct:
             return False
         blocks = jdf.blocks
@@ -1348,18 +1348,21 @@ class JaxExecutionEngine(ExecutionEngine):
         for a in cols.agg_funcs:
             if not isinstance(a, _FuncExpr) or len(a.args) != 1:
                 return False
-            if a.arg_distinct:
-                return False
-            if a.func.lower() not in (
+            fn = a.func.lower()
+            if fn not in (
                 "min", "max", "sum", "avg", "mean", "count", "first", "last"
+            ):
+                return False
+            if a.arg_distinct and fn not in (
+                "min", "max", "sum", "avg", "mean", "count"
             ):
                 return False
             arg = a.args[0]
             if isinstance(arg, _NamedColumnExpr) and arg.wildcard:
                 continue
-            if not expr_eval.can_eval_on_device(
-                arg, blocks
-            ) or expr_eval.is_string_result(arg, blocks):
+            if not expr_eval.can_eval_on_device(arg, blocks) or (
+                expr_eval.is_string_result(arg, blocks) and fn != "count"
+            ):
                 return False
         return True
 
@@ -1441,12 +1444,38 @@ class JaxExecutionEngine(ExecutionEngine):
                 keys.append(k.output_name)
         if computed:
             jdf = self.to_df(self.assign(jdf, computed))  # type: ignore
-        aggs = [(c.output_name, c) for c in cols.agg_funcs]
+        agg_exprs = list(cols.agg_funcs)
+        visible = [c.output_name for c in cols.all_cols]
+        having2: Optional[ColumnExpr] = None
+        extra: Dict[str, ColumnExpr] = {}
+        if having is not None:
+            # HAVING refers to aggregations: rewrite agg subtrees into
+            # refs over the aggregated output, computing HIDDEN agg
+            # columns as needed, filter, then drop the hidden columns
+            from fugue_tpu.column.pandas_eval import _rewrite_having
+
+            computed_map = {
+                c.alias("").__uuid__(): c.output_name
+                for c in cols.agg_funcs
+            }
+            having2 = _rewrite_having(having, computed_map, extra)
+            agg_exprs = agg_exprs + list(extra.values())
         res = self._try_device_aggregate(
-            jdf, keys, [c for _, c in aggs], out_schema=out_schema,
-            col_order=[c.output_name for c in cols.all_cols],
+            jdf, keys, agg_exprs, out_schema=out_schema,
+            col_order=visible + list(extra.keys()),
         )
-        return res
+        if res is None or having2 is None:
+            return res
+        jres: JaxDataFrame = self.to_df(self.filter(res, having2))  # type: ignore
+        if extra:
+            jres = JaxDataFrame(
+                blocks_with_columns(
+                    jres.blocks,
+                    {n: jres.blocks.columns[n] for n in visible},
+                ),
+                jres.schema.extract(visible),
+            )
+        return jres
 
     def _jit_cached(self, key: Any, fn: Callable) -> Callable:
         """Per-engine jit cache: logical programs (aggregate plans, map fns,
@@ -1555,22 +1584,45 @@ class JaxExecutionEngine(ExecutionEngine):
             if col is None or not col.on_device:
                 return None
         plans = []
+        distinct_args: Dict[str, str] = {}
         for c in agg_cols:
-            if not isinstance(c, _FuncExpr) or len(c.args) != 1 or c.arg_distinct:
+            if not isinstance(c, _FuncExpr) or len(c.args) != 1:
                 return None
-            if c.func.lower() not in (
+            fn = c.func.lower()
+            if fn not in (
                 "min", "max", "sum", "avg", "mean", "count", "first", "last"
             ):
                 return None
             arg = c.args[0]
+            if c.arg_distinct:
+                # DISTINCT: min/max are dedup-invariant; count/sum/avg
+                # dedup via a per-(keys, value) first-occurrence mask.
+                # first/last DISTINCT are order-sensitive: host runner.
+                if fn in ("first", "last"):
+                    return None
+                if fn not in ("min", "max"):
+                    if (
+                        not isinstance(arg, _NamedColumnExpr)
+                        or arg.wildcard
+                        or arg.as_type is not None
+                    ):
+                        return None
+                    acol = blocks.columns.get(arg.name)
+                    if acol is None or not acol.on_device:
+                        return None
+                    if fn != "count" and acol.is_string:
+                        return None
+                    distinct_args[c.output_name] = arg.name
             if isinstance(arg, _NamedColumnExpr) and arg.wildcard:
                 plans.append((c.output_name, "count", None, c))
                 continue
             if not expr_eval.can_eval_on_device(
                 arg, blocks
-            ) or expr_eval.is_string_result(arg, blocks):
+            ) or (
+                expr_eval.is_string_result(arg, blocks) and fn != "count"
+            ):
                 return None
-            plans.append((c.output_name, c.func.lower(), arg, c))
+            plans.append((c.output_name, fn, arg, c))
         # known-empty inputs stay on the device path too: padded_len(0)=ndev
         # keeps arrays non-empty, all rows invalid, so keyed aggregates give
         # 0 groups and global ones count=0/NULL — the SAME conventions a
@@ -1589,11 +1641,12 @@ class JaxExecutionEngine(ExecutionEngine):
         sharding = row_sharding(blocks.mesh)
         if len(keys) == 0:
             return self._global_aggregate(
-                jdf, typed_plans, col_order, sharding
+                jdf, typed_plans, col_order, sharding, distinct_args
             )
         bspec = groupby.bin_spec(blocks, keys)
         if (
             bspec is not None
+            and not distinct_args
             and bspec.total <= groupby._MATMUL_MAX_SEGMENTS
             and self._prefer_matmul(blocks)
             and all(
@@ -1616,6 +1669,8 @@ class JaxExecutionEngine(ExecutionEngine):
             seg_: Any,
             first_idx_: Any,
             occupied_: Optional[Any],
+            dsegs_: Dict[str, Any],
+            dfirsts_: Dict[str, Any],
             row_valid: Optional[Any],
             nrows_s: Any,
         ) -> Dict[str, Any]:
@@ -1635,6 +1690,9 @@ class JaxExecutionEngine(ExecutionEngine):
                     values, mask = expr_eval.eval_expr(
                         mcols, arg, pad_n, dicts
                     )
+                mask = _apply_distinct_mask(
+                    dsegs_, dfirsts_, name, pad_n, mask
+                )
                 v, m = groupby._segment_agg_impl(
                     func, values, mask, seg_, num_segments, valid_
                 )
@@ -1645,11 +1703,13 @@ class JaxExecutionEngine(ExecutionEngine):
                 outs["_occupied"] = _pad_to(occupied_, out_pad)
             return outs
 
+        dsegs, dfirsts = _distinct_factorize(blocks, keys, distinct_args)
         prog_key = (
             "agg",
             tuple((n, f, None if a is None else a.__uuid__(), str(t))
                   for n, f, a, t in typed_plans),
             tuple(keys), num_segments, out_pad, pad_n,
+            tuple(sorted(distinct_args.items())),
             expr_eval.dict_fingerprint(blocks),
         )
         key_data = {k: blocks.columns[k].data for k in keys}
@@ -1665,6 +1725,8 @@ class JaxExecutionEngine(ExecutionEngine):
             fr.seg,
             fr.first_idx,
             fr.occupied,
+            dsegs,
+            dfirsts,
             blocks.row_valid,
             _nrows_arg(blocks),
         )
@@ -1821,15 +1883,22 @@ class JaxExecutionEngine(ExecutionEngine):
         typed_plans: List[Tuple[str, str, Any, pa.DataType]],
         col_order: Optional[List[str]],
         sharding: Any,
+        distinct_args: Optional[Dict[str, str]] = None,
     ) -> DataFrame:
         """Keyless aggregation: plain masked jnp reductions — one program,
-        no segments, no scatter."""
+        no segments, no scatter. DISTINCT aggregates contribute only the
+        first row of each value (a per-value factorize mask)."""
         blocks = jdf.blocks
         pad_n = blocks.padded_nrows
         dicts = expr_eval.dicts_of(blocks)
+        dsegs, dfirsts = _distinct_factorize(blocks, [], distinct_args)
 
         def _prog(
-            mcols: Dict[str, Any], row_valid: Optional[Any], nrows_s: Any
+            mcols: Dict[str, Any],
+            dsegs_: Dict[str, Any],
+            dfirsts_: Dict[str, Any],
+            row_valid: Optional[Any],
+            nrows_s: Any,
         ) -> Dict[str, Any]:
             valid = groupby.materialize_validity(row_valid, pad_n, nrows_s)
             outs: Dict[str, Any] = {}
@@ -1841,6 +1910,9 @@ class JaxExecutionEngine(ExecutionEngine):
                     values, mask = expr_eval.eval_expr(
                         mcols, arg, pad_n, dicts
                     )
+                mask = _apply_distinct_mask(
+                    dsegs_, dfirsts_, name, pad_n, mask
+                )
                 eff = valid if mask is None else (mask & valid)
                 cnt = jnp.sum(eff.astype(jnp.int32))
                 if func == "count":
@@ -1894,10 +1966,13 @@ class JaxExecutionEngine(ExecutionEngine):
                 for n, f, a, t in typed_plans
             ),
             pad_n,
+            tuple(sorted((distinct_args or {}).items())),
             expr_eval.dict_fingerprint(blocks),
         )
         outs = self._jit_cached(prog_key, _prog)(
             expr_eval.blocks_to_masked(blocks),
+            dsegs,
+            dfirsts,
             blocks.row_valid,
             _nrows_arg(blocks),
         )
@@ -2147,6 +2222,36 @@ def blocks_with_columns(
         row_valid=blocks.row_valid,
         nrows_dev=blocks._nrows_dev,
     )
+
+
+def _distinct_factorize(
+    blocks: JaxBlocks, keys: List[str], distinct_args: Optional[Dict[str, str]]
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Per-(keys, value) factorizations backing DISTINCT aggregates —
+    shared by the keyed and global aggregate paths."""
+    dsegs: Dict[str, Any] = {}
+    dfirsts: Dict[str, Any] = {}
+    for name, argname in (distinct_args or {}).items():
+        fr2 = groupby.factorize_keys(blocks, keys + [argname])
+        dsegs[name] = fr2.seg
+        dfirsts[name] = fr2.first_idx
+    return dsegs, dfirsts
+
+
+def _apply_distinct_mask(
+    dsegs: Dict[str, Any],
+    dfirsts: Dict[str, Any],
+    name: str,
+    pad_n: int,
+    mask: Optional[Any],
+) -> Optional[Any]:
+    """Fold the first-occurrence-of-(keys, value) mask into an agg's
+    validity mask (inside a traced program)."""
+    if name not in dsegs:
+        return mask
+    pos_ = jnp.arange(pad_n, dtype=jnp.int32)
+    dmask = dfirsts[name][dsegs[name]] == pos_
+    return dmask if mask is None else (mask & dmask)
 
 
 def _nrows_arg(blocks: JaxBlocks) -> Any:

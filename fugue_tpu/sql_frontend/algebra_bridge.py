@@ -764,7 +764,7 @@ def _expr(e: ast.Expr, scope: _Scope) -> ColumnExpr:
         }[op]()
     if isinstance(e, ast.Func):
         name = e.name.lower()
-        if e.distinct:
+        if e.distinct and name not in _AGG_FUNCS:
             raise _GiveUp()
         if name in _AGG_FUNCS:
             if len(e.args) != 1:
@@ -773,6 +773,12 @@ def _expr(e: ast.Expr, scope: _Scope) -> ColumnExpr:
             arg = col("*") if isinstance(a, ast.Star) else _expr(a, scope)
             if name == "mean":
                 name = "avg"
+            if e.distinct:
+                if isinstance(a, ast.Star):
+                    raise _GiveUp()  # COUNT(DISTINCT *): host owns error
+                from fugue_tpu.column.functions import _agg
+
+                return _agg(name, arg, arg_distinct=True)
             # the ff constructors mark is_aggregation (function() does not)
             return getattr(ff, name)(arg)
         if name == "coalesce":

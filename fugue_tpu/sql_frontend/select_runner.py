@@ -1745,6 +1745,11 @@ def _agg_result(
             return grouped[label].size(), pa.int64()
         return grouped[label].count(), pa.int64()
     if name in ("avg", "mean"):
+        if func.distinct:
+            return (
+                grouped[label].agg(lambda s: s.drop_duplicates().mean()),
+                pa.float64(),
+            )
         return grouped[label].mean(), pa.float64()
     if name == "sum":
         col = grouped[label]
@@ -1794,7 +1799,8 @@ def _global_agg_result(
             return len(s), pa.int64()
         return s.count(), pa.int64()
     if name in ("avg", "mean"):
-        return (s.mean() if len(s) else None), pa.float64()
+        vals = s.drop_duplicates() if func.distinct else s
+        return (vals.mean() if len(vals) else None), pa.float64()
     if name == "sum":
         vals = s.dropna().drop_duplicates() if func.distinct else s
         res = vals.sum(min_count=1) if len(vals) else None

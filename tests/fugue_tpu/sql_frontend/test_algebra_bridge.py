@@ -87,11 +87,12 @@ def test_case_when_routes_to_device():
 
 
 def test_complex_query_falls_back_correctly():
-    # DISTINCT aggregates are outside the device set: host runner with
+    # subquery expressions are outside the device set: host runner with
     # a counted fallback
     df = _df()
     e, jx, nt = _both(
-        ("SELECT k, COUNT(DISTINCT v) AS b FROM", df, "GROUP BY k")
+        ("SELECT k, v FROM", df,
+         "WHERE v > (SELECT AVG(v) FROM", df, ")")
     )
     assert jx == nt
     assert sum(e.fallbacks.values()) >= 1  # counted, not silent
