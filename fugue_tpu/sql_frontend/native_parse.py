@@ -154,9 +154,19 @@ def _expr(t: Any) -> ast.Expr:
     if tag == "isnull":
         return ast.IsNull(_expr(t[1]), t[2])
     if tag == "window":
+        frame = None
+        if len(t) > 4 and t[4] is not None:
+            frame = ast.Frame(t[4][1], tuple(t[4][2]), tuple(t[4][3]))
         return ast.Window(
             _expr(t[1]),  # type: ignore[arg-type]
             [_expr(p) for p in t[2]],
             [_order(o) for o in t[3]],
+            frame,
         )
+    if tag == "subquery":
+        return ast.ScalarSubquery(_query(t[1]))
+    if tag == "insub":
+        return ast.InSubquery(_expr(t[1]), _query(t[2]), t[3])
+    if tag == "exists":
+        return ast.Exists(_query(t[1]))
     raise ValueError(f"bad expr tag {tag}")

@@ -699,6 +699,17 @@ struct Parser {
             }
             if (accept_kw("IN")) {
                 if (!expect_op("(")) { Py_DECREF(left); return nullptr; }
+                if (is_kw("SELECT") || is_kw("WITH")) {
+                    PyObject* q = query();
+                    if (!q) { Py_DECREF(left); return nullptr; }
+                    if (!expect_op(")")) {
+                        Py_DECREF(q); Py_DECREF(left); return nullptr;
+                    }
+                    left = node("(sNNO)", "insub", left, q,
+                                neg ? Py_True : Py_False);
+                    if (!left) return nullptr;
+                    continue;
+                }
                 PyObject* items = PyList_New(0);
                 if (!items) { Py_DECREF(left); return fail(); }
                 for (;;) {
@@ -828,16 +839,105 @@ struct Parser {
         }
         PyObject* order = order_by_clause();
         if (!order) { Py_DECREF(part); Py_DECREF(func); return nullptr; }
+        PyObject* frame = Py_None;
+        Py_INCREF(frame);
         if (is_kw("ROWS") || is_kw("RANGE") || is_kw("GROUPS")) {
-            /* explicit frames are a python-side error; fall back */
-            Py_DECREF(order); Py_DECREF(part); Py_DECREF(func);
-            return fail();
+            Py_DECREF(frame);
+            frame = frame_clause();
+            if (!frame) {
+                Py_DECREF(order); Py_DECREF(part); Py_DECREF(func);
+                return nullptr;
+            }
         }
         if (!expect_op(")")) {
-            Py_DECREF(order); Py_DECREF(part); Py_DECREF(func);
+            Py_DECREF(frame); Py_DECREF(order); Py_DECREF(part);
+            Py_DECREF(func);
             return nullptr;
         }
-        return node("(sNNN)", "window", func, part, order);
+        return node("(sNNNN)", "window", func, part, order, frame);
+    }
+
+    /* materialize a T_NUMBER token as a Python int or float; advances.
+       Returns nullptr + soft-fail on malformed text. */
+    PyObject* number_literal() {
+        std::string v = tok().value;
+        advance();
+        bool isf = v.find('.') != std::string::npos ||
+                   v.find('e') != std::string::npos ||
+                   v.find('E') != std::string::npos;
+        if (isf) {
+            double d = PyOS_string_to_double(v.c_str(), nullptr, nullptr);
+            if (PyErr_Occurred()) { PyErr_Clear(); return fail(); }
+            return PyFloat_FromDouble(d);
+        }
+        PyObject* num = PyLong_FromString(v.c_str(), nullptr, 10);
+        if (!num) { PyErr_Clear(); return fail(); }
+        return num;
+    }
+
+    /* one frame bound as ("up"/"p"/"c"/"f"/"uf", value-or-None); sets
+       rank for the start<=end validation */
+    PyObject* frame_bound(int& rank) {
+        if (accept_kw("UNBOUNDED")) {
+            if (accept_kw("PRECEDING")) {
+                rank = 0;
+                return Py_BuildValue("(sO)", "up", Py_None);
+            }
+            if (!is_kw("FOLLOWING")) return fail();
+            advance();
+            rank = 4;
+            return Py_BuildValue("(sO)", "uf", Py_None);
+        }
+        if (accept_kw("CURRENT")) {
+            if (!is_kw("ROW")) return fail();
+            advance();
+            rank = 2;
+            return Py_BuildValue("(sO)", "c", Py_None);
+        }
+        if (tok().kind != T_NUMBER) return fail();
+        PyObject* num = number_literal();
+        if (!num) return nullptr;
+        if (accept_kw("PRECEDING")) {
+            rank = 1;
+            return node("(sN)", "p", num);
+        }
+        if (!is_kw("FOLLOWING")) { Py_DECREF(num); return fail(); }
+        advance();
+        rank = 3;
+        return node("(sN)", "f", num);
+    }
+
+    /* ROWS|RANGE|GROUPS [BETWEEN a AND b]; EXCLUDE and reversed bounds
+       are python-side errors -> defer */
+    PyObject* frame_clause() {
+        std::string unit = tok().value;
+        for (auto& c : unit) c = (char)tolower((unsigned char)c);
+        advance();
+        PyObject* start = nullptr;
+        PyObject* end = nullptr;
+        int sr = 0, er = 2;
+        if (accept_kw("BETWEEN")) {
+            start = frame_bound(sr);
+            if (!start) return nullptr;
+            if (!is_kw("AND")) { Py_DECREF(start); return fail(); }
+            advance();
+            end = frame_bound(er);
+            if (!end) { Py_DECREF(start); return nullptr; }
+        } else {
+            start = frame_bound(sr);
+            if (!start) return nullptr;
+            end = Py_BuildValue("(sO)", "c", Py_None);
+            er = 2;
+            if (!end) { Py_DECREF(start); return fail(); }
+        }
+        /* python raises for reversed bounds and for UNBOUNDED
+           FOLLOWING starts / UNBOUNDED PRECEDING ends: defer those */
+        if (is_kw("EXCLUDE") || sr > er || sr == 4 || er == 0) {
+            Py_DECREF(start); Py_DECREF(end);
+            return fail();
+        }
+        return node("(ss#NN)", "frame", unit.c_str(),
+                    (Py_ssize_t)unit.size(), start, end);
     }
 
     PyObject* case_expr() {
@@ -912,21 +1012,8 @@ struct Parser {
     PyObject* primary() {
         const Tok& tk = tok();
         if (tk.kind == T_NUMBER) {
-            std::string v = tk.value;
-            advance();
-            bool isf = v.find('.') != std::string::npos ||
-                       v.find('e') != std::string::npos ||
-                       v.find('E') != std::string::npos;
-            PyObject* lit;
-            if (isf) {
-                lit = PyFloat_FromDouble(PyOS_string_to_double(
-                    v.c_str(), nullptr, nullptr));
-                if (PyErr_Occurred()) { PyErr_Clear(); return fail(); }
-            } else {
-                lit = PyLong_FromString(v.c_str(), nullptr, 10);
-                if (!lit) { PyErr_Clear(); return fail(); }
-            }
-            if (!lit) return fail();
+            PyObject* lit = number_literal();
+            if (!lit) return nullptr;
             return node("(sN)", "lit", lit);
         }
         if (tk.kind == T_STRING) {
@@ -936,7 +1023,12 @@ struct Parser {
                         (Py_ssize_t)v.size());
         }
         if (accept_op("(")) {
-            if (is_kw("SELECT") || is_kw("WITH")) return fail();
+            if (is_kw("SELECT") || is_kw("WITH")) {
+                PyObject* q = query();
+                if (!q) return nullptr;
+                if (!expect_op(")")) { Py_DECREF(q); return nullptr; }
+                return node("(sN)", "subquery", q);
+            }
             PyObject* e = expr();
             if (!e) return nullptr;
             if (!expect_op(")")) { Py_DECREF(e); return nullptr; }
@@ -953,6 +1045,16 @@ struct Parser {
         if (u == "TRUE") { advance(); return node("(sO)", "lit", Py_True); }
         if (u == "FALSE") { advance(); return node("(sO)", "lit", Py_False); }
         if (u == "CASE") return case_expr();
+        if (u == "EXISTS" && peek(1).kind == T_OP && peek(1).value == "(" &&
+            peek(2).kind == T_IDENT &&
+            (peek(2).upper == "SELECT" || peek(2).upper == "WITH")) {
+            advance();
+            advance(); /* ( */
+            PyObject* q = query();
+            if (!q) return nullptr;
+            if (!expect_op(")")) { Py_DECREF(q); return nullptr; }
+            return node("(sN)", "exists", q);
+        }
         if (u == "CAST") {
             advance();
             if (!expect_op("(")) return nullptr;

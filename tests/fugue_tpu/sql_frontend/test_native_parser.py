@@ -42,6 +42,22 @@ CORPUS = [
     "SELECT 1.5e3, .5, 1e-2, 'it''s', 'a\\'b', NULL, TRUE, FALSE;",
     "SELECT a -- comment\n FROM t /* block */ WHERE a == 1 AND b != 2",
     "select lower(a) from t where a is null order by 1 asc nulls last",
+    # window frames
+    "SELECT SUM(v) OVER (ORDER BY v ROWS 1 PRECEDING) FROM t",
+    "SELECT SUM(v) OVER (PARTITION BY k ORDER BY v ROWS BETWEEN 2 "
+    "PRECEDING AND 1 FOLLOWING) FROM t",
+    "SELECT SUM(v) OVER (ORDER BY v RANGE BETWEEN 1.5 PRECEDING AND "
+    "1 FOLLOWING), AVG(v) OVER (ORDER BY v GROUPS BETWEEN UNBOUNDED "
+    "PRECEDING AND CURRENT ROW) FROM t",
+    "SELECT FIRST_VALUE(v) OVER (ORDER BY v ROWS BETWEEN CURRENT ROW "
+    "AND UNBOUNDED FOLLOWING) FROM t",
+    # subquery expressions
+    "SELECT a FROM t WHERE v > (SELECT AVG(w) FROM u)",
+    "SELECT a, (SELECT MAX(w) FROM u WHERE u.k = t.k) m FROM t",
+    "SELECT a FROM t WHERE k IN (SELECT k FROM u) AND j NOT IN "
+    "(SELECT j FROM v WHERE x = 1)",
+    "SELECT a FROM t WHERE EXISTS (SELECT 1 FROM u WHERE u.k = t.k) "
+    "AND NOT EXISTS (WITH c AS (SELECT k FROM v) SELECT k FROM c)",
 ]
 
 BAD = [
@@ -54,19 +70,15 @@ BAD = [
     "SELECT a FROM (SELECT a FROM t)",  # subquery needs alias
     "SELECT SUM(v) OVER (ORDER BY v ROWS BETWEEN 1 PRECEDING AND"
     " CURRENT ROW EXCLUDE TIES) FROM t",
+    "SELECT SUM(v) OVER (ORDER BY v ROWS BETWEEN CURRENT ROW AND"
+    " 1 PRECEDING) FROM t",
+    "SELECT SUM(v) OVER (ORDER BY v ROWS BETWEEN UNBOUNDED FOLLOWING"
+    " AND UNBOUNDED FOLLOWING) FROM t",
 ]
 
-# valid only on the Python path (the native parser defers and the
-# fallback handles it) — explicit window frames
-PY_ONLY = [
-    "SELECT SUM(v) OVER (ORDER BY v ROWS 1 PRECEDING) FROM t",
-    "SELECT SUM(v) OVER (ORDER BY v RANGE BETWEEN 1 PRECEDING AND"
-    " 1 FOLLOWING) FROM t",
-    # subquery expressions
-    "SELECT a FROM t WHERE v > (SELECT AVG(w) FROM u)",
-    "SELECT a FROM t WHERE k IN (SELECT k FROM u)",
-    "SELECT a FROM t WHERE EXISTS (SELECT 1 FROM u WHERE u.k = t.k)",
-]
+# valid only on the Python path (native defers; the fallback handles
+# it) — currently none: the C++ grammar covers the full Python grammar
+PY_ONLY: list = []
 
 
 def _py_parse(sql: str):
@@ -103,8 +115,10 @@ def test_native_parser_defers_on_bad_sql():
 
 
 def test_native_parser_defers_on_python_only_syntax():
-    """Frame clauses parse on the Python path; native declines them so
-    the fallback (not a native error) owns the statement."""
+    """Guard for future Python-only grammar additions: native must
+    decline them (deferring to the fallback), never mis-parse. The list
+    is currently empty — the C++ grammar covers the full Python
+    grammar."""
     for sql in PY_ONLY:
         assert try_native_parse(sql) is None, sql
         assert _py_parse(sql) is not None, sql
