@@ -185,8 +185,24 @@ struct Parser {
     const std::vector<Tok>& t;
     size_t pos = 0;
     bool failed = false;  // unsupported/syntax problem -> whole parse None
+    int depth = 0;        // recursion depth across query()/expr()
 
     explicit Parser(const std::vector<Tok>& toks) : t(toks) {}
+
+    /* Deep nesting (subqueries, parenthesized expressions) must DEFER
+       to the Python parser — which raises a catchable RecursionError —
+       instead of blowing the native stack (review finding). */
+    struct DepthGuard {
+        Parser* p;
+        bool bad;
+        explicit DepthGuard(Parser* p_) : p(p_), bad(false) {
+            if (++p->depth > 200) {
+                p->failed = true;
+                bad = true;
+            }
+        }
+        ~DepthGuard() { --p->depth; }
+    };
 
     const Tok& tok() const { return t[pos]; }
     const Tok& peek(size_t k = 1) const {
@@ -268,6 +284,8 @@ struct Parser {
 
     /* ---- queries ---- */
     PyObject* query() {
+        DepthGuard g(this);
+        if (g.bad) return nullptr;
         if (is_kw("WITH")) {
             advance();
             PyObject* ctes = PyList_New(0);
@@ -624,7 +642,11 @@ struct Parser {
     }
 
     /* ---- expressions ---- */
-    PyObject* expr() { return or_expr(); }
+    PyObject* expr() {
+        DepthGuard g(this);
+        if (g.bad) return nullptr;
+        return or_expr();
+    }
 
     PyObject* binop(const std::string& op, PyObject* l, PyObject* r) {
         return node("(ss#NN)", "bin", op.c_str(), (Py_ssize_t)op.size(),

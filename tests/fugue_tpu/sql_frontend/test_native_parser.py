@@ -174,6 +174,19 @@ def test_native_parser_fuzz_generated_queries():
         assert nat is not None and nat == py, sql
 
 
+def test_native_parser_defers_on_pathological_nesting():
+    """Deep subquery/paren nesting must defer to Python (which raises a
+    catchable RecursionError), never blow the native stack (review
+    finding: 20k-deep nesting segfaulted)."""
+    deep_sub = "SELECT " + "(SELECT " * 20000 + "1" + ")" * 20000
+    assert try_native_parse(deep_sub) is None
+    deep_paren = "SELECT " + "(" * 5000 + "1" + ")" * 5000
+    assert try_native_parse(deep_paren) is None
+    # moderate nesting still parses natively
+    ok = "SELECT (SELECT (SELECT MAX(v) FROM u) FROM w) FROM t"
+    assert try_native_parse(ok) is not None
+
+
 def test_native_parser_through_public_api():
     from fugue_tpu.sql_frontend.parser import parse_select
 
