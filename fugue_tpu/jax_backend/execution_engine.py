@@ -1331,7 +1331,11 @@ class JaxExecutionEngine(ExecutionEngine):
         # dictionary code) or device-evaluable expressions, which get
         # materialized as key columns before the aggregate
         for k in cols.group_keys:
-            if isinstance(k, _NamedColumnExpr) and k.as_type is None:
+            if (
+                isinstance(k, _NamedColumnExpr)
+                and k.as_type is None
+                and k.output_name == k.name
+            ):
                 col = blocks.columns.get(k.name)
                 if col is None or not col.on_device:
                     return False
@@ -1435,11 +1439,16 @@ class JaxExecutionEngine(ExecutionEngine):
         keys: List[str] = []
         computed: List[ColumnExpr] = []
         for k in cols.group_keys:
-            if isinstance(k, _NamedColumnExpr) and k.as_type is None:
+            if (
+                isinstance(k, _NamedColumnExpr)
+                and k.as_type is None
+                and k.output_name == k.name
+            ):
                 keys.append(k.name)
             else:
-                # expression key: materialize it as a key column first
-                # (_can_select_on_device guarantees a fresh output name)
+                # expression OR aliased key: materialize it as a key
+                # column first (a bare-ref rename keeps dictionary and
+                # stats; _can_select_on_device guarantees a fresh name)
                 computed.append(k)
                 keys.append(k.output_name)
         if computed:

@@ -678,6 +678,8 @@ struct Parser {
     }
 
     PyObject* not_expr() {
+        DepthGuard g(this);
+        if (g.bad) return nullptr;
         if (accept_kw("NOT")) {
             PyObject* v = not_expr();
             if (!v) return nullptr;
@@ -810,6 +812,8 @@ struct Parser {
     }
 
     PyObject* unary() {
+        DepthGuard g(this);
+        if (g.bad) return nullptr;
         if (tok().kind == T_OP && (tok().value == "-" || tok().value == "+")) {
             std::string op = tok().value;
             advance();

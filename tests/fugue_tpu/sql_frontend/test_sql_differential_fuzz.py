@@ -125,32 +125,45 @@ def _rows_close(a: tuple, b: tuple) -> bool:
     return True
 
 
-def _both(parts) -> None:
-    e = make_execution_engine("jax")
+_ORACLE = make_execution_engine("native")
+
+
+def _both(e, parts) -> bool:
+    """Run on both engines, compare; returns True when the jax run was
+    fallback-free (device-resident) so callers can assert coverage."""
+    before = sum(e.fallbacks.values())
     rj = raw_sql(*parts, engine=e, as_fugue=True).as_pandas()
-    rn = raw_sql(*parts, engine="native", as_fugue=True).as_pandas()
+    on_device = sum(e.fallbacks.values()) == before
+    rn = raw_sql(*parts, engine=_ORACLE, as_fugue=True).as_pandas()
     ca, cb = _canon(rj), _canon(rn)
     assert len(ca) == len(cb) and all(
         _rows_close(x, y) for x, y in zip(ca, cb)
     ), f"\nSQL: {parts[0]} ... {parts[-1]}\n{rj}\n{rn}"
+    return on_device
 
 
 def test_fuzz_plain_selects():
     rng = np.random.default_rng(101)
     df = _frame(rng)
+    e = make_execution_engine("jax")
+    on_device = 0
     for _ in range(40):
         items = ["o AS rid", f"{_num(rng)} AS a0", f"{_str(rng)} AS a1"]
         if rng.random() < 0.5:
             items.append(f"{_bool(rng)} AS a2")
         head = "SELECT " + ", ".join(items) + " FROM"
         tail = f"WHERE {_bool(rng)}" if rng.random() < 0.6 else ""
-        _both((head, df, tail))
+        on_device += _both(e, (head, df, tail))
+    # the comparison must not silently degrade to host-vs-host
+    assert on_device >= 30, (on_device, e.fallbacks)
 
 
 def test_fuzz_groupby_aggregates():
     rng = np.random.default_rng(202)
     df = _frame(rng)
     aggs = ["SUM", "AVG", "MIN", "MAX", "COUNT"]
+    e = make_execution_engine("jax")
+    on_device = 0
     for _ in range(40):
         key = rng.choice(["k", "s", "TRIM(s)", "k %% 2", "i %% 3"]).replace(
             "%%", "%"
@@ -159,7 +172,8 @@ def test_fuzz_groupby_aggregates():
         for j in range(rng.integers(1, 4)):
             fn = rng.choice(aggs)
             d = "DISTINCT " if rng.random() < 0.3 else ""
-            arg = "*" if fn == "COUNT" and rng.random() < 0.3 else (
+            star = fn == "COUNT" and not d and rng.random() < 0.3
+            arg = "*" if star else (
                 rng.choice(["v", "i"]) if d else _num(rng)
             )
             parts_sel.append(f"{fn}({d}{arg}) AS a{j}")
@@ -167,7 +181,8 @@ def test_fuzz_groupby_aggregates():
         tail = f"GROUP BY {key}"
         if rng.random() < 0.4:
             tail += f" HAVING COUNT(*) > {rng.integers(1, 20)}"
-        _both((head, df, tail))
+        on_device += _both(e, (head, df, tail))
+    assert on_device >= 30, (on_device, e.fallbacks)
 
 
 def test_fuzz_window_functions():
@@ -182,6 +197,8 @@ def test_fuzz_window_functions():
         " ROWS BETWEEN CURRENT ROW AND UNBOUNDED FOLLOWING",
         " ROWS BETWEEN UNBOUNDED PRECEDING AND UNBOUNDED FOLLOWING",
     ]
+    e = make_execution_engine("jax")
+    on_device = 0
     for _ in range(30):
         over = "PARTITION BY k ORDER BY o" if rng.random() < 0.7 else \
             "ORDER BY o"
@@ -200,16 +217,18 @@ def test_fuzz_window_functions():
         if rng.random() < 0.3:
             items.append(f"FIRST_VALUE(v) OVER ({over}{fr}) AS fv")
         head = "SELECT " + ", ".join(items) + " FROM"
-        _both((head, df, ""))
+        on_device += _both(e, (head, df, ""))
+    assert on_device >= 22, (on_device, e.fallbacks)
 
 
 def test_fuzz_subquery_predicates():
     rng = np.random.default_rng(404)
     df = _frame(rng)
+    e = make_execution_engine("jax")
     for _ in range(15):
         pred = _bool(rng)
         neg = "NOT " if rng.random() < 0.4 else ""
         parts = ("SELECT k, o, v FROM", df,
                  f"AS t2 WHERE k {neg}IN (SELECT k FROM", df,
                  f"AS q WHERE {pred})")
-        _both(parts)
+        _both(e, parts)  # subquery predicates run on the host runner
