@@ -416,6 +416,8 @@ class _FuncExpr(ColumnExpr):
         if f in (
             "round", "sqrt", "exp", "ln", "log", "log2", "log10",
             "sin", "cos", "tan", "power", "pow",
+            "stddev", "stddev_samp", "stddev_pop",
+            "variance", "var_samp", "var_pop",
         ):
             return pa.float64()
         if f in ("floor", "ceil", "ceiling", "sign", "length", "len"):

@@ -8,6 +8,15 @@ from fugue_tpu.column.expressions import ColumnExpr, _FuncExpr, _to_col
 from fugue_tpu.utils.assertion import assert_or_throw
 
 
+# the variance family — shared by the device segment programs, the
+# engine gates, the SQL bridge and both host evaluators (one constant
+# so a new member can't be added to some layers and not others)
+VARIANCE_FUNCS = (
+    "stddev", "stddev_samp", "stddev_pop",
+    "variance", "var_samp", "var_pop",
+)
+
+
 def _agg(name: str, col: Any, arg_distinct: bool = False) -> ColumnExpr:
     return _FuncExpr(name, _to_col(col), arg_distinct=arg_distinct, is_aggregation=True)
 

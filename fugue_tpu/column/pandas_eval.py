@@ -19,7 +19,7 @@ from fugue_tpu.column.expressions import (
     _NamedColumnExpr,
     _UnaryOpExpr,
 )
-from fugue_tpu.column.functions import is_agg
+from fugue_tpu.column.functions import VARIANCE_FUNCS, is_agg
 from fugue_tpu.column.sql import SelectColumns
 from fugue_tpu.schema import Schema
 from fugue_tpu.utils.assertion import assert_or_throw
@@ -333,8 +333,6 @@ def _empty_typed_series(expr: ColumnExpr, df: pd.DataFrame) -> pd.Series:
     return pd.Series([], dtype=object)
 
 
-_AGG_FUNCS = {"min", "max", "sum", "avg", "mean", "count", "first", "last"}
-
 
 def _apply_agg(
     grouped: Any, func: str, col: str, distinct: bool
@@ -358,6 +356,14 @@ def _apply_agg(
         return grouped[col].min()
     if f == "max":
         return grouped[col].max()
+    if f in VARIANCE_FUNCS:
+        ddof = 0 if f.endswith("_pop") else 1
+        fn2 = "std" if f.startswith("stddev") else "var"
+        if distinct:
+            return grouped[col].agg(
+                lambda s: getattr(s.drop_duplicates(), fn2)(ddof=ddof)
+            )
+        return getattr(grouped[col], fn2)(ddof=ddof)
     if f == "first":
         # .first() would skip nulls; we want the literal first row value
         return grouped[col].agg(lambda s: s.iloc[0] if len(s) > 0 else None)
@@ -381,6 +387,12 @@ def _global_agg(df: pd.DataFrame, func: str, col: str, distinct: bool) -> Any:
         return s.min()
     if f == "max":
         return s.max()
+    if f in VARIANCE_FUNCS:
+        ddof = 0 if f.endswith("_pop") else 1
+        vals = s.drop_duplicates() if distinct else s
+        return getattr(vals, "std" if f.startswith("stddev") else "var")(
+            ddof=ddof
+        )
     if f == "first":
         return s.iloc[0] if len(s) > 0 else None
     if f == "last":

@@ -34,6 +34,7 @@ import pyarrow as pa
 
 from fugue_tpu.collections.partition import PartitionCursor, PartitionSpec
 from fugue_tpu.column.expressions import ColumnExpr, _NamedColumnExpr
+from fugue_tpu.column.functions import VARIANCE_FUNCS
 from fugue_tpu.column.sql import SelectColumns
 from fugue_tpu.constants import (
     FUGUE_CONF_JAX_PARTITIONS,
@@ -1354,7 +1355,8 @@ class JaxExecutionEngine(ExecutionEngine):
                 return False
             fn = a.func.lower()
             if fn not in (
-                "min", "max", "sum", "avg", "mean", "count", "first", "last"
+                "min", "max", "sum", "avg", "mean", "count", "first", "last",
+                *VARIANCE_FUNCS,
             ):
                 return False
             if a.arg_distinct and fn not in (
@@ -1599,10 +1601,21 @@ class JaxExecutionEngine(ExecutionEngine):
                 return None
             fn = c.func.lower()
             if fn not in (
-                "min", "max", "sum", "avg", "mean", "count", "first", "last"
+                "min", "max", "sum", "avg", "mean", "count", "first", "last",
+                *VARIANCE_FUNCS,
             ):
                 return None
             arg = c.args[0]
+            if fn in VARIANCE_FUNCS:
+                if c.arg_distinct:
+                    return None  # DISTINCT variance: host runner
+                tp0 = arg.infer_type(jdf.schema)
+                if tp0 is None or not (
+                    pa.types.is_integer(tp0)
+                    or pa.types.is_floating(tp0)
+                    or pa.types.is_boolean(tp0)
+                ):
+                    return None  # the host oracle owns the type error
             if c.arg_distinct:
                 # DISTINCT: min/max are dedup-invariant; count/sum/avg
                 # dedup via a per-(keys, value) first-occurrence mask.
@@ -1935,6 +1948,18 @@ class JaxExecutionEngine(ExecutionEngine):
                         else tot / jnp.maximum(cnt, 1)
                     )
                     m = cnt > 0
+                elif func in VARIANCE_FUNCS:
+                    fv = jnp.where(eff, values.astype(jnp.float64), 0.0)
+                    cf = cnt.astype(jnp.float64)
+                    mean = jnp.sum(fv) / jnp.maximum(cf, 1.0)
+                    dev = jnp.where(
+                        eff, values.astype(jnp.float64) - mean, 0.0
+                    )
+                    ss = jnp.sum(dev * dev)
+                    pop = func in ("stddev_pop", "var_pop")
+                    var = ss / jnp.maximum(cf if pop else cf - 1.0, 1.0)
+                    v = jnp.sqrt(var) if func.startswith("stddev") else var
+                    m = cnt > (0 if pop else 1)
                 elif func == "min":
                     v = jnp.min(
                         jnp.where(eff, values, groupby._type_max(values.dtype))

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 import pyarrow as pa
 
+from fugue_tpu.column.functions import VARIANCE_FUNCS
 from fugue_tpu.jax_backend.blocks import JaxBlocks, JaxColumn
 from fugue_tpu.utils.assertion import assert_or_throw
 
@@ -475,6 +476,26 @@ def _segment_agg_impl(
         filled = jnp.where(effective, values, small)
         res = jax.ops.segment_max(filled, seg, num_segments=num_segments)
         return res, count > 0
+    if f in VARIANCE_FUNCS:
+        if num_segments == 0:  # empty factorization: no groups at all
+            z = jnp.zeros((0,), dtype=jnp.float64)
+            return z, jnp.zeros((0,), dtype=jnp.bool_)
+        # stable two-pass: mean per segment, then squared deviations
+        fv = jnp.where(effective, values.astype(jnp.float64), 0.0)
+        tot = jax.ops.segment_sum(fv, seg, num_segments=num_segments)
+        cnt = count.astype(jnp.float64)
+        mean = tot / jnp.maximum(cnt, 1.0)
+        segc = jnp.clip(seg, 0, num_segments - 1)
+        dev = jnp.where(
+            effective, values.astype(jnp.float64) - mean[segc], 0.0
+        )
+        ss = jax.ops.segment_sum(dev * dev, seg, num_segments=num_segments)
+        pop = f in ("stddev_pop", "var_pop")
+        denom = jnp.maximum(cnt if pop else cnt - 1.0, 1.0)
+        var = ss / denom
+        res = jnp.sqrt(var) if f.startswith("stddev") else var
+        # sample forms need >= 2 rows (pandas ddof=1 gives NaN on one)
+        return res, count > (0 if pop else 1)
     if f in ("first", "last"):
         n = values.shape[0]
         idx = jnp.arange(n, dtype=jnp.int32)

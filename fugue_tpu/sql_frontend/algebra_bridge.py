@@ -37,7 +37,12 @@ __all__ = [
     "WindowSpec",
 ]
 
-_AGG_FUNCS = {"sum", "min", "max", "avg", "mean", "count", "first", "last"}
+from fugue_tpu.column.functions import VARIANCE_FUNCS
+
+_AGG_FUNCS = {
+    "sum", "min", "max", "avg", "mean", "count", "first", "last",
+    *VARIANCE_FUNCS,
+}
 
 _JOIN_HOW = {
     "inner": "inner",
@@ -779,6 +784,10 @@ def _expr(e: ast.Expr, scope: _Scope) -> ColumnExpr:
                 from fugue_tpu.column.functions import _agg
 
                 return _agg(name, arg, arg_distinct=True)
+            if not hasattr(ff, name):  # variance family etc.
+                from fugue_tpu.column.functions import _agg
+
+                return _agg(name, arg)
             # the ff constructors mark is_aggregation (function() does not)
             return getattr(ff, name)(arg)
         if name == "coalesce":

@@ -1773,14 +1773,19 @@ def _agg_result(
         return grouped[label].agg(
             lambda s: s.iloc[-1] if len(s) > 0 else None
         ), arg_type
-    if name in ("stddev", "stddev_samp"):
-        return grouped[label].std(ddof=1), pa.float64()
-    if name == "stddev_pop":
-        return grouped[label].std(ddof=0), pa.float64()
-    if name in ("variance", "var_samp"):
-        return grouped[label].var(ddof=1), pa.float64()
-    if name == "var_pop":
-        return grouped[label].var(ddof=0), pa.float64()
+    if name in (
+        "stddev", "stddev_samp", "stddev_pop",
+        "variance", "var_samp", "var_pop",
+    ):
+        ddof = 0 if name.endswith("_pop") else 1
+        f2 = "std" if name.startswith("stddev") else "var"
+        if func.distinct:
+            res = grouped[label].agg(
+                lambda s: getattr(s.drop_duplicates(), f2)(ddof=ddof)
+            )
+        else:
+            res = getattr(grouped[label], f2)(ddof=ddof)
+        return res, pa.float64()
     if name == "median":
         return grouped[label].median(), pa.float64()
     raise SQLExecutionError(f"unsupported aggregation {name}")
@@ -1815,14 +1820,16 @@ def _global_agg_result(
         return (s.iloc[0] if len(s) > 0 else None), arg_type
     if name in ("last", "last_value"):
         return (s.iloc[-1] if len(s) > 0 else None), arg_type
-    if name in ("stddev", "stddev_samp"):
-        return (s.std(ddof=1) if len(s) else None), pa.float64()
-    if name == "stddev_pop":
-        return (s.std(ddof=0) if len(s) else None), pa.float64()
-    if name in ("variance", "var_samp"):
-        return (s.var(ddof=1) if len(s) else None), pa.float64()
-    if name == "var_pop":
-        return (s.var(ddof=0) if len(s) else None), pa.float64()
+    if name in (
+        "stddev", "stddev_samp", "stddev_pop",
+        "variance", "var_samp", "var_pop",
+    ):
+        ddof = 0 if name.endswith("_pop") else 1
+        f2 = "std" if name.startswith("stddev") else "var"
+        vals = s.drop_duplicates() if func.distinct else s
+        return (
+            getattr(vals, f2)(ddof=ddof) if len(vals) else None
+        ), pa.float64()
     if name == "median":
         return (s.median() if len(s) else None), pa.float64()
     raise SQLExecutionError(f"unsupported aggregation {name}")

@@ -1,0 +1,117 @@
+"""Variance-family aggregates on device (STDDEV/VARIANCE, _SAMP and
+_POP forms): stable two-pass segment programs — mean per group, then
+squared deviations — matching pandas ddof semantics (sample forms NULL
+on single-row groups). Role: the reference's SQL backends compute these
+natively (``/root/reference/fugue_duckdb/execution_engine.py:238``)."""
+
+import numpy as np
+import pandas as pd
+
+from fugue_tpu.execution import make_execution_engine
+from fugue_tpu.workflow.api import raw_sql
+
+
+def _df() -> pd.DataFrame:
+    rng = np.random.default_rng(47)
+    df = pd.DataFrame(
+        {
+            "k": rng.integers(0, 5, 70).astype(np.int64),
+            "v": np.round(rng.random(70) * 1000, 3),
+            "i": rng.integers(-30, 30, 70).astype(np.int64),
+        }
+    )
+    df.loc[::6, "v"] = np.nan
+    return df
+
+
+def _check(head: str, tail: str = "") -> None:
+    df = _df()
+    e = make_execution_engine("jax")
+    rj = raw_sql(head, df, tail, engine=e, as_fugue=True).as_pandas()
+    rn = raw_sql(head, df, tail, engine="native", as_fugue=True).as_pandas()
+    for c in rj.columns:
+        a = rj[c].to_numpy(dtype=float)
+        b = rn[c].to_numpy(dtype=float)
+        assert np.allclose(a, b, equal_nan=True, rtol=1e-9), (c, a, b)
+    assert e.fallbacks == {}, (head, e.fallbacks)
+
+
+def test_grouped_variance_family():
+    _check(
+        "SELECT k, STDDEV(v) AS s1, STDDEV_SAMP(v) AS s2,"
+        " STDDEV_POP(v) AS s3, VARIANCE(v) AS v1, VAR_SAMP(v) AS v2,"
+        " VAR_POP(v) AS v3 FROM",
+        "GROUP BY k ORDER BY k",
+    )
+
+
+def test_global_variance_family():
+    _check(
+        "SELECT STDDEV(v) AS s, VAR_POP(i) AS vp, VARIANCE(i) AS vr FROM"
+    )
+
+
+def test_variance_in_having():
+    _check(
+        "SELECT k, COUNT(*) AS c FROM",
+        "GROUP BY k HAVING STDDEV(v) > 200 ORDER BY k",
+    )
+
+
+def test_variance_over_expression_args():
+    _check(
+        "SELECT k, STDDEV(ABS(v) + i) AS s FROM", "GROUP BY k ORDER BY k"
+    )
+
+
+def test_single_row_sample_is_null_population_zero():
+    dd = pd.DataFrame({"k": [1, 2, 2], "v": [5.0, 1.0, 3.0]})
+    e = make_execution_engine("jax")
+    r = raw_sql(
+        "SELECT k, STDDEV(v) AS s, STDDEV_POP(v) AS p FROM", dd,
+        "GROUP BY k ORDER BY k", engine=e, as_fugue=True,
+    ).as_pandas()
+    assert pd.isna(r["s"].iloc[0]) and float(r["p"].iloc[0]) == 0.0
+    assert abs(float(r["s"].iloc[1]) - np.sqrt(2.0)) < 1e-12
+    assert e.fallbacks == {}, e.fallbacks
+
+
+def test_numerical_stability_large_mean():
+    # huge mean, tiny spread: the naive E[x^2]-mean^2 form would
+    # catastrophically cancel; the two-pass program must not
+    dd = pd.DataFrame(
+        {"k": [1] * 4, "v": [1e9 + 1.0, 1e9 + 2.0, 1e9 + 3.0, 1e9 + 4.0]}
+    )
+    e = make_execution_engine("jax")
+    r = raw_sql(
+        "SELECT k, VAR_SAMP(v) AS s FROM", dd, "GROUP BY k",
+        engine=e, as_fugue=True,
+    ).as_pandas()
+    assert abs(float(r["s"].iloc[0]) - 5.0 / 3.0) < 1e-9, r
+    assert e.fallbacks == {}, e.fallbacks
+
+
+def test_variance_on_filtered_to_empty_frame():
+    # float group keys + everything filtered out: num_segments == 0 must
+    # not crash the device program (review finding: gather from empty)
+    dd = pd.DataFrame({"k": [1.5, 2.5], "v": [1.0, 2.0]})
+    e = make_execution_engine("jax")
+    r = raw_sql(
+        "SELECT k, STDDEV(v) AS s FROM", dd,
+        "WHERE v > 100 GROUP BY k", engine=e, as_fugue=True,
+    ).as_pandas()
+    assert len(r) == 0, r
+    assert e.fallbacks == {}, e.fallbacks
+
+
+def test_distinct_variance_dedups_on_both_engines():
+    # STDDEV(DISTINCT x) must dedup (review finding: host dropped it)
+    dd = pd.DataFrame({"k": [1] * 4, "v": [1.0, 1.0, 1.0, 5.0]})
+    for eng in ("native", "jax"):
+        e = make_execution_engine(eng)
+        r = raw_sql(
+            "SELECT k, STDDEV(DISTINCT v) AS s, VAR_POP(DISTINCT v) AS p"
+            " FROM", dd, "GROUP BY k", engine=e, as_fugue=True,
+        ).as_pandas()
+        assert abs(float(r["s"].iloc[0]) - np.sqrt(8.0)) < 1e-12, (eng, r)
+        assert abs(float(r["p"].iloc[0]) - 4.0) < 1e-12, (eng, r)
