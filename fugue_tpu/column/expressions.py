@@ -417,7 +417,7 @@ class _FuncExpr(ColumnExpr):
             "round", "sqrt", "exp", "ln", "log", "log2", "log10",
             "sin", "cos", "tan", "power", "pow",
             "stddev", "stddev_samp", "stddev_pop",
-            "variance", "var_samp", "var_pop",
+            "variance", "var_samp", "var_pop", "median",
         ):
             return pa.float64()
         if f in ("floor", "ceil", "ceiling", "sign", "length", "len"):

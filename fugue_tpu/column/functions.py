@@ -17,6 +17,14 @@ VARIANCE_FUNCS = (
 )
 
 
+def variance_ddof(name: str) -> int:
+    return 0 if name.endswith("_pop") else 1
+
+
+def variance_stat(name: str) -> str:
+    return "std" if name.startswith("stddev") else "var"
+
+
 def _agg(name: str, col: Any, arg_distinct: bool = False) -> ColumnExpr:
     return _FuncExpr(name, _to_col(col), arg_distinct=arg_distinct, is_aggregation=True)
 

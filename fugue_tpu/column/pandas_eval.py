@@ -19,7 +19,12 @@ from fugue_tpu.column.expressions import (
     _NamedColumnExpr,
     _UnaryOpExpr,
 )
-from fugue_tpu.column.functions import VARIANCE_FUNCS, is_agg
+from fugue_tpu.column.functions import (
+    VARIANCE_FUNCS,
+    is_agg,
+    variance_ddof,
+    variance_stat,
+)
 from fugue_tpu.column.sql import SelectColumns
 from fugue_tpu.schema import Schema
 from fugue_tpu.utils.assertion import assert_or_throw
@@ -357,13 +362,16 @@ def _apply_agg(
     if f == "max":
         return grouped[col].max()
     if f in VARIANCE_FUNCS:
-        ddof = 0 if f.endswith("_pop") else 1
-        fn2 = "std" if f.startswith("stddev") else "var"
+        ddof, fn2 = variance_ddof(f), variance_stat(f)
         if distinct:
             return grouped[col].agg(
                 lambda s: getattr(s.drop_duplicates(), fn2)(ddof=ddof)
             )
         return getattr(grouped[col], fn2)(ddof=ddof)
+    if f == "median":
+        if distinct:
+            return grouped[col].agg(lambda s: s.drop_duplicates().median())
+        return grouped[col].median()
     if f == "first":
         # .first() would skip nulls; we want the literal first row value
         return grouped[col].agg(lambda s: s.iloc[0] if len(s) > 0 else None)
@@ -388,11 +396,11 @@ def _global_agg(df: pd.DataFrame, func: str, col: str, distinct: bool) -> Any:
     if f == "max":
         return s.max()
     if f in VARIANCE_FUNCS:
-        ddof = 0 if f.endswith("_pop") else 1
         vals = s.drop_duplicates() if distinct else s
-        return getattr(vals, "std" if f.startswith("stddev") else "var")(
-            ddof=ddof
-        )
+        return getattr(vals, variance_stat(f))(ddof=variance_ddof(f))
+    if f == "median":
+        vals = s.drop_duplicates() if distinct else s
+        return vals.median()
     if f == "first":
         return s.iloc[0] if len(s) > 0 else None
     if f == "last":

@@ -1356,7 +1356,7 @@ class JaxExecutionEngine(ExecutionEngine):
             fn = a.func.lower()
             if fn not in (
                 "min", "max", "sum", "avg", "mean", "count", "first", "last",
-                *VARIANCE_FUNCS,
+                "median", *VARIANCE_FUNCS,
             ):
                 return False
             if a.arg_distinct and fn not in (
@@ -1602,11 +1602,11 @@ class JaxExecutionEngine(ExecutionEngine):
             fn = c.func.lower()
             if fn not in (
                 "min", "max", "sum", "avg", "mean", "count", "first", "last",
-                *VARIANCE_FUNCS,
+                "median", *VARIANCE_FUNCS,
             ):
                 return None
             arg = c.args[0]
-            if fn in VARIANCE_FUNCS:
+            if fn == "median" or fn in VARIANCE_FUNCS:
                 if c.arg_distinct:
                     return None  # DISTINCT variance: host runner
                 tp0 = arg.infer_type(jdf.schema)
@@ -1948,18 +1948,34 @@ class JaxExecutionEngine(ExecutionEngine):
                         else tot / jnp.maximum(cnt, 1)
                     )
                     m = cnt > 0
+                elif func == "median":
+                    eff2 = eff
+                    if jnp.issubdtype(values.dtype, jnp.floating):
+                        eff2 = eff2 & ~jnp.isnan(values)
+                    c2 = jnp.sum(eff2.astype(jnp.int32))
+                    fv2 = values.astype(jnp.float64)
+                    sv = jnp.sort(jnp.where(eff2, fv2, jnp.inf))
+                    npad = sv.shape[0]
+                    lo = jnp.clip((c2 - 1) // 2, 0, npad - 1)
+                    hi = jnp.clip(c2 // 2, 0, npad - 1)
+                    v = (sv[lo] + sv[hi]) * 0.5
+                    m = c2 > 0
                 elif func in VARIANCE_FUNCS:
-                    fv = jnp.where(eff, values.astype(jnp.float64), 0.0)
-                    cf = cnt.astype(jnp.float64)
+                    eff2 = eff
+                    if jnp.issubdtype(values.dtype, jnp.floating):
+                        eff2 = eff2 & ~jnp.isnan(values)  # pandas skips NaN
+                    c2 = jnp.sum(eff2.astype(jnp.int32))
+                    fv = jnp.where(eff2, values.astype(jnp.float64), 0.0)
+                    cf = c2.astype(jnp.float64)
                     mean = jnp.sum(fv) / jnp.maximum(cf, 1.0)
                     dev = jnp.where(
-                        eff, values.astype(jnp.float64) - mean, 0.0
+                        eff2, values.astype(jnp.float64) - mean, 0.0
                     )
                     ss = jnp.sum(dev * dev)
                     pop = func in ("stddev_pop", "var_pop")
                     var = ss / jnp.maximum(cf if pop else cf - 1.0, 1.0)
                     v = jnp.sqrt(var) if func.startswith("stddev") else var
-                    m = cnt > (0 if pop else 1)
+                    m = c2 > (0 if pop else 1)
                 elif func == "min":
                     v = jnp.min(
                         jnp.where(eff, values, groupby._type_max(values.dtype))

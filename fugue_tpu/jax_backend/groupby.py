@@ -480,14 +480,22 @@ def _segment_agg_impl(
         if num_segments == 0:  # empty factorization: no groups at all
             z = jnp.zeros((0,), dtype=jnp.float64)
             return z, jnp.zeros((0,), dtype=jnp.bool_)
-        # stable two-pass: mean per segment, then squared deviations
-        fv = jnp.where(effective, values.astype(jnp.float64), 0.0)
+        # stable two-pass: mean per segment, then squared deviations.
+        # NaN payloads (non-null computed NaNs, e.g. SQRT of a negative)
+        # are skipped like pandas std/var skips them (review finding)
+        eff = effective
+        if jnp.issubdtype(values.dtype, jnp.floating):
+            eff = eff & ~jnp.isnan(values)
+        vcnt = jax.ops.segment_sum(
+            eff.astype(jnp.int32), seg, num_segments=num_segments
+        )
+        fv = jnp.where(eff, values.astype(jnp.float64), 0.0)
         tot = jax.ops.segment_sum(fv, seg, num_segments=num_segments)
-        cnt = count.astype(jnp.float64)
+        cnt = vcnt.astype(jnp.float64)
         mean = tot / jnp.maximum(cnt, 1.0)
         segc = jnp.clip(seg, 0, num_segments - 1)
         dev = jnp.where(
-            effective, values.astype(jnp.float64) - mean[segc], 0.0
+            eff, values.astype(jnp.float64) - mean[segc], 0.0
         )
         ss = jax.ops.segment_sum(dev * dev, seg, num_segments=num_segments)
         pop = f in ("stddev_pop", "var_pop")
@@ -495,7 +503,35 @@ def _segment_agg_impl(
         var = ss / denom
         res = jnp.sqrt(var) if f.startswith("stddev") else var
         # sample forms need >= 2 rows (pandas ddof=1 gives NaN on one)
-        return res, count > (0 if pop else 1)
+        return res, vcnt > (0 if pop else 1)
+    if f == "median":
+        if num_segments == 0:  # empty factorization: no groups at all
+            z = jnp.zeros((0,), dtype=jnp.float64)
+            return z, jnp.zeros((0,), dtype=jnp.bool_)
+        # sorted-space selection: stable sort by value, re-sort by
+        # segment (stability keeps the value order inside each segment),
+        # then pick the middle position(s) per segment
+        n = values.shape[0]
+        fv = values.astype(jnp.float64)
+        eff = effective
+        if jnp.issubdtype(values.dtype, jnp.floating):
+            eff = eff & ~jnp.isnan(values)
+        mcount = jax.ops.segment_sum(
+            eff.astype(jnp.int32), seg, num_segments=num_segments
+        )
+        keyv = jnp.where(eff, fv, jnp.inf)
+        order = jnp.argsort(keyv, stable=True)
+        segv = jnp.where(eff, seg, num_segments)
+        order = order[jnp.argsort(segv[order], stable=True)]
+        starts = jnp.cumsum(mcount) - mcount
+        sortedv = fv[order]
+        lo = starts + (mcount - 1) // 2
+        hi = starts + mcount // 2
+        med = (
+            sortedv[jnp.clip(lo, 0, n - 1)]
+            + sortedv[jnp.clip(hi, 0, n - 1)]
+        ) * 0.5
+        return med, mcount > 0
     if f in ("first", "last"):
         n = values.shape[0]
         idx = jnp.arange(n, dtype=jnp.int32)

@@ -41,7 +41,7 @@ from fugue_tpu.column.functions import VARIANCE_FUNCS
 
 _AGG_FUNCS = {
     "sum", "min", "max", "avg", "mean", "count", "first", "last",
-    *VARIANCE_FUNCS,
+    "median", *VARIANCE_FUNCS,
 }
 
 _JOIN_HOW = {

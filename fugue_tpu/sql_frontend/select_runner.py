@@ -17,6 +17,11 @@ import pyarrow as pa
 from fugue_tpu.dataframe import DataFrame, DataFrames
 from fugue_tpu.dataframe.arrow_dataframe import ArrowDataFrame
 from fugue_tpu.dataframe.dataframe import LocalBoundedDataFrame
+from fugue_tpu.column.functions import (
+    VARIANCE_FUNCS,
+    variance_ddof,
+    variance_stat,
+)
 from fugue_tpu.schema import Schema
 from fugue_tpu.sql_frontend import ast
 from fugue_tpu.sql_frontend.parser import parse_select
@@ -1773,12 +1778,8 @@ def _agg_result(
         return grouped[label].agg(
             lambda s: s.iloc[-1] if len(s) > 0 else None
         ), arg_type
-    if name in (
-        "stddev", "stddev_samp", "stddev_pop",
-        "variance", "var_samp", "var_pop",
-    ):
-        ddof = 0 if name.endswith("_pop") else 1
-        f2 = "std" if name.startswith("stddev") else "var"
+    if name in VARIANCE_FUNCS:
+        ddof, f2 = variance_ddof(name), variance_stat(name)
         if func.distinct:
             res = grouped[label].agg(
                 lambda s: getattr(s.drop_duplicates(), f2)(ddof=ddof)
@@ -1787,6 +1788,10 @@ def _agg_result(
             res = getattr(grouped[label], f2)(ddof=ddof)
         return res, pa.float64()
     if name == "median":
+        if func.distinct:
+            return grouped[label].agg(
+                lambda s: s.drop_duplicates().median()
+            ), pa.float64()
         return grouped[label].median(), pa.float64()
     raise SQLExecutionError(f"unsupported aggregation {name}")
 
@@ -1820,18 +1825,16 @@ def _global_agg_result(
         return (s.iloc[0] if len(s) > 0 else None), arg_type
     if name in ("last", "last_value"):
         return (s.iloc[-1] if len(s) > 0 else None), arg_type
-    if name in (
-        "stddev", "stddev_samp", "stddev_pop",
-        "variance", "var_samp", "var_pop",
-    ):
-        ddof = 0 if name.endswith("_pop") else 1
-        f2 = "std" if name.startswith("stddev") else "var"
+    if name in VARIANCE_FUNCS:
         vals = s.drop_duplicates() if func.distinct else s
         return (
-            getattr(vals, f2)(ddof=ddof) if len(vals) else None
+            getattr(vals, variance_stat(name))(ddof=variance_ddof(name))
+            if len(vals)
+            else None
         ), pa.float64()
     if name == "median":
-        return (s.median() if len(s) else None), pa.float64()
+        vals = s.drop_duplicates() if func.distinct else s
+        return (vals.median() if len(vals) else None), pa.float64()
     raise SQLExecutionError(f"unsupported aggregation {name}")
 
 

@@ -1,4 +1,4 @@
-"""Variance-family aggregates on device (STDDEV/VARIANCE, _SAMP and
+"""Variance-family and MEDIAN aggregates on device (STDDEV/VARIANCE, _SAMP and
 _POP forms): stable two-pass segment programs — mean per group, then
 squared deviations — matching pandas ddof semantics (sample forms NULL
 on single-row groups). Role: the reference's SQL backends compute these
@@ -115,3 +115,60 @@ def test_distinct_variance_dedups_on_both_engines():
         ).as_pandas()
         assert abs(float(r["s"].iloc[0]) - np.sqrt(8.0)) < 1e-12, (eng, r)
         assert abs(float(r["p"].iloc[0]) - 4.0) < 1e-12, (eng, r)
+
+
+def test_median_grouped_and_global():
+    _check("SELECT k, MEDIAN(v) AS m FROM", "GROUP BY k ORDER BY k")
+    _check("SELECT MEDIAN(v) AS m, MEDIAN(i) AS mi FROM")
+
+
+def test_median_even_odd_groups():
+    dd = pd.DataFrame(
+        {"k": [1, 1, 1, 2, 2, 2, 2], "v": [3.0, 1.0, 2.0, 10.0, 40.0, 20.0, 30.0]}
+    )
+    e = make_execution_engine("jax")
+    r = raw_sql(
+        "SELECT k, MEDIAN(v) AS m FROM", dd, "GROUP BY k ORDER BY k",
+        engine=e, as_fugue=True,
+    ).as_pandas()
+    assert list(r["m"]) == [2.0, 25.0], r  # odd: middle; even: mean of two
+    assert e.fallbacks == {}, e.fallbacks
+
+
+def test_median_in_having_and_empty():
+    _check(
+        "SELECT k, COUNT(*) AS c FROM",
+        "GROUP BY k HAVING MEDIAN(v) > 400 ORDER BY k",
+    )
+    dd = pd.DataFrame({"k": [1.5], "v": [1.0]})
+    e = make_execution_engine("jax")
+    r = raw_sql(
+        "SELECT k, MEDIAN(v) AS m FROM", dd, "WHERE v > 99 GROUP BY k",
+        engine=e, as_fugue=True,
+    ).as_pandas()
+    assert len(r) == 0
+    assert e.fallbacks == {}, e.fallbacks
+
+
+def test_median_distinct_dedups_on_both_engines():
+    dd = pd.DataFrame({"k": [1] * 4, "v": [1.0, 1.0, 1.0, 5.0]})
+    for eng in ("native", "jax"):
+        r = raw_sql(
+            "SELECT k, MEDIAN(DISTINCT v) AS m FROM", dd, "GROUP BY k",
+            engine=eng, as_fugue=True,
+        ).as_pandas()
+        assert float(r["m"].iloc[0]) == 3.0, (eng, r)  # median of {1, 5}
+
+
+def test_variance_skips_nan_payloads_like_pandas():
+    # SQRT of a negative yields NaN with mask still valid; pandas std
+    # skips NaN, so the device kernel must too (review finding)
+    dd = pd.DataFrame({"k": [1] * 4, "i": [-4, 1, 4, 9]})
+    for eng in ("native", "jax"):
+        e = make_execution_engine(eng)
+        r = raw_sql(
+            "SELECT k, STDDEV(SQRT(i)) AS s, MEDIAN(SQRT(i)) AS m FROM",
+            dd, "GROUP BY k", engine=e, as_fugue=True,
+        ).as_pandas()
+        assert abs(float(r["s"].iloc[0]) - 1.0) < 1e-12, (eng, r)
+        assert abs(float(r["m"].iloc[0]) - 2.0) < 1e-12, (eng, r)

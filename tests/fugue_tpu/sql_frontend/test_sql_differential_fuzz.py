@@ -161,7 +161,8 @@ def test_fuzz_plain_selects():
 def test_fuzz_groupby_aggregates():
     rng = np.random.default_rng(202)
     df = _frame(rng)
-    aggs = ["SUM", "AVG", "MIN", "MAX", "COUNT", "STDDEV", "VAR_POP"]
+    aggs = ["SUM", "AVG", "MIN", "MAX", "COUNT", "STDDEV", "VAR_POP",
+            "MEDIAN"]
     e = make_execution_engine("jax")
     on_device = 0
     for _ in range(40):
