@@ -1236,6 +1236,9 @@ def _window_frame_agg(
       with peers sharing their group's LAST value,
     - ROWS-framed aggregates: prefix-sum differences over positional
       [lo, hi] bounds; min/max via a log2(p)-level sparse table,
+    - GROUPS frames: peer-group ids with per-group start/end tables,
+    - RANGE frames: peer bounds, with numeric offsets resolved by a
+      vectorized per-partition bisect over the raw order key,
     - lag/lead: a shifted gather with partition-boundary masking,
     - first/last/nth_value: gathers at frame boundary positions,
 
@@ -1302,12 +1305,35 @@ def _window_frame_agg(
     default = spec.default
     values = None if vcol is None else vcol.data
     vmask = None if vcol is None else vcol.mask
+    okey = None
+    okey_mask = None
+    if frame is not None and frame[0] == "range" and any(
+        kd in ("p", "f") for kd in (frame[1], frame[3])
+    ):
+        # numeric RANGE offsets: the raw single ORDER BY key drives the
+        # per-partition value search (bridge guarantees one key)
+        kcol = blocks.columns.get(spec.order_by[0][0])
+        if (
+            kcol is None
+            or not kcol.on_device
+            or kcol.is_string
+            or not (
+                pa.types.is_integer(kcol.pa_type)
+                or pa.types.is_floating(kcol.pa_type)
+                or pa.types.is_boolean(kcol.pa_type)
+            )
+        ):
+            return None  # non-numeric key: host runner owns the error
+        okey = kcol.data
+        okey_mask = kcol.mask
 
     def _prog(
         code_arrs: Tuple[Any, ...],
         null_arrs: Dict[int, Any],
         values_: Optional[Any],
         vmask_: Optional[Any],
+        okey_: Optional[Any],
+        okey_mask_: Optional[Any],
         seg_: Any,
         row_valid: Optional[Any],
         nrows_s: Any,
@@ -1366,8 +1392,9 @@ def _window_frame_agg(
             return _scatter(val, vm)
 
         # frame bounds [lo, hi] in sorted space
-        if frame is None:
-            # running: lo = partition start, hi = peer group's LAST row
+        unit = None if frame is None else frame[0]
+        if unit is None or unit in ("groups", "range"):
+            # peer detection (adjacent sorted rows tying on every key)
             false0 = jnp.zeros((1,), dtype=bool)
             same_part = jnp.concatenate([false0, sseg[1:] == sseg[:-1]])
             is_peer = same_part
@@ -1381,6 +1408,17 @@ def _window_frame_agg(
                     nn = null_arrs[i][order]
                     eq = eq & jnp.concatenate([false0, nn[1:] == nn[:-1]])
                 is_peer = is_peer & eq
+        if unit in ("groups", "range"):
+            gnew = ~is_peer
+            g_glob = (jnp.cumsum(gnew.astype(jnp.int32)) - 1).astype(
+                jnp.int32
+            )
+            g_start_by = jax.ops.segment_min(pos, g_glob, num_segments=p)
+            g_end_by = jax.ops.segment_max(pos, g_glob, num_segments=p)
+            peer_start = g_start_by[g_glob]
+            peer_end = g_end_by[g_glob]
+        if unit is None:
+            # running: lo = partition start, hi = peer group's LAST row
             big = jnp.int32(p)
             heads = jnp.where(~is_peer, pos, big)
             nh = jnp.flip(jax.lax.cummin(jnp.flip(
@@ -1388,8 +1426,8 @@ def _window_frame_agg(
             )))
             lo = part_start
             hi = jnp.minimum(nh - 1, part_end)
-        else:
-            sk, sn, ek, en = frame
+        elif unit == "rows":
+            _, sk, sn, ek, en = frame
 
             def _bound(kd: str, nv: Optional[int]) -> Any:
                 if kd == "up":
@@ -1398,10 +1436,96 @@ def _window_frame_agg(
                     return part_end
                 if kd == "c":
                     return pos
-                return pos + nv if kd == "f" else pos - nv
+                return pos + int(nv) if kd == "f" else pos - int(nv)
 
             lo = jnp.maximum(_bound(sk, sn), part_start)
             hi = jnp.minimum(_bound(ek, en), part_end)
+        elif unit == "groups":
+            _, sk, sn, ek, en = frame
+            g_first = g_glob[part_start]
+            g_last = g_glob[part_end]
+
+            def _gbound(kd: str, nv: Optional[int], is_start: bool) -> Any:
+                if kd == "up":
+                    return part_start
+                if kd == "uf":
+                    return part_end
+                if kd == "c":
+                    return peer_start if is_start else peer_end
+                tg = g_glob + (int(nv) if kd == "f" else -int(nv))
+                tgc = jnp.clip(tg, 0, p - 1)
+                if is_start:
+                    out = jnp.where(
+                        tg < g_first, part_start, g_start_by[tgc]
+                    )
+                    return jnp.where(tg > g_last, part_end + 1, out)
+                out = jnp.where(tg > g_last, part_end, g_end_by[tgc])
+                return jnp.where(tg < g_first, part_start - 1, out)
+
+            lo = jnp.maximum(_gbound(sk, sn, True), part_start)
+            hi = jnp.minimum(_gbound(ek, en, False), part_end)
+        else:  # range (peer bounds; numeric offsets via bisect)
+            _, sk, sn, ek, en = frame
+            need_key = sk in ("p", "f") or ek in ("p", "f")
+            if need_key:  # okey_ is loaded only for offset bounds
+                kv = okey_.astype(jnp.float64)
+                knull = (
+                    jnp.zeros((p,), dtype=bool)
+                    if okey_mask_ is None
+                    else ~okey_mask_
+                )
+                knull = knull | jnp.isnan(okey_.astype(jnp.float64))
+                asc = bool(spec.order_by[0][1])
+                if not asc:
+                    kv = -kv
+                skv = kv[order]
+                snull = (knull | ~valid)[order]
+                # non-null span [a, b] per row: nulls sort to one end
+                ncnt = jax.ops.segment_sum(
+                    (knull & valid).astype(jnp.int32), segv,
+                    num_segments=S + 1,
+                )[:S][jnp.clip(sseg, 0, S - 1)]
+                nf = spec.order_by[0][2]
+                nulls_first = bool(nf) if nf is not None else False
+                if nulls_first:
+                    a_, b_ = part_start + ncnt, part_end
+                else:
+                    a_, b_ = part_start, part_end - ncnt
+            steps = max(1, int(np.ceil(np.log2(max(p, 2)))) + 1)
+
+            def _bisect(target: Any, right: bool) -> Any:
+                lo_b, hi_b = a_, b_ + 1
+                for _ in range(steps):
+                    mid = (lo_b + hi_b) // 2
+                    mv = skv[jnp.clip(mid, 0, p - 1)]
+                    go = (mv <= target) if right else (mv < target)
+                    go = go & (lo_b < hi_b)
+                    stay = (lo_b < hi_b) & ~go
+                    lo_b = jnp.where(go, mid + 1, lo_b)
+                    hi_b = jnp.where(stay, mid, hi_b)
+                return lo_b
+
+            def _rbound(kd: str, nv: Any, is_start: bool) -> Any:
+                if kd == "up":
+                    return part_start
+                if kd == "uf":
+                    return part_end
+                if kd == "c":
+                    return peer_start if is_start else peer_end
+                delta = float(nv) if kd == "f" else -float(nv)
+                tgt = skv + delta
+                res = (
+                    _bisect(tgt, right=False)
+                    if is_start
+                    else _bisect(tgt, right=True) - 1
+                )
+                # null keys: the bound resolves to the null peer group
+                return jnp.where(
+                    snull, peer_start if is_start else peer_end, res
+                )
+
+            lo = jnp.maximum(_rbound(sk, sn, True), part_start)
+            hi = jnp.minimum(_rbound(ek, en, False), part_end)
         empty = lo > hi
         lo_s = jnp.clip(lo, 0, p - 1)
         hi_s = jnp.clip(hi, 0, p - 1)
@@ -1514,6 +1638,8 @@ def _window_frame_agg(
         {i: nl for i, (_, nl, _) in enumerate(codes) if nl is not None},
         values,
         vmask,
+        okey,
+        okey_mask,
         seg,
         blocks.row_valid,
         _nrows_arg(blocks),

@@ -165,9 +165,10 @@ class WindowSpec:
     (sum/count/avg/min/max/first_value/last_value/nth_value with ORDER
     BY), or lag/lead. ``param`` holds ntile's bucket count, nth_value's
     position or lag/lead's offset; ``default`` lag/lead's fill literal.
-    ``frame`` is a normalized ROWS frame ``(lo_kind, lo_n, hi_kind,
-    hi_n)`` with kinds 'up'/'p'/'c'/'f'/'uf', or None for the default
-    frame (running when ``order_by`` is non-empty)."""
+    ``frame`` is a normalized frame ``(unit, lo_kind, lo_n, hi_kind,
+    hi_n)`` — unit 'rows'/'groups'/'range', kinds 'up'/'p'/'c'/'f'/'uf'
+    — or None for the default frame (running when ``order_by`` is
+    non-empty; RANGE offsets require exactly one ORDER BY key)."""
 
     def __init__(
         self,
@@ -177,7 +178,9 @@ class WindowSpec:
         partition_by: List[str],
         order_by: List[Tuple[str, bool, Optional[bool]]],
         param: Optional[int] = None,
-        frame: Optional[Tuple[str, Optional[int], str, Optional[int]]] = None,
+        frame: Optional[
+            Tuple[str, str, Optional[float], str, Optional[float]]
+        ] = None,
         default: Optional[object] = None,
     ):
         self.name = name
@@ -558,10 +561,12 @@ def _window_select(q: ast.Select, scope: _Scope, source: Plan) -> Plan:
         param: Optional[int] = None
         default: Optional[object] = None
         # normalize the frame clause: None = the SQL default frame.
-        # Only ROWS frames (plus the RANGE spellings of the default and
-        # whole-partition frames) lower to device; GROUPS and RANGE
-        # offsets stay on the host runner.
-        frame: Optional[Tuple[str, Optional[int], str, Optional[int]]]
+        # ROWS, GROUPS and single-key RANGE frames (incl. numeric
+        # offsets) all lower to device; only oversized offsets and
+        # multi-key RANGE stay on the host runner.
+        frame: Optional[
+            Tuple[str, str, Optional[float], str, Optional[float]]
+        ]
         frame = None
         whole_partition = False
         fr = e.frame
@@ -577,15 +582,30 @@ def _window_select(q: ast.Select, scope: _Scope, source: Plan) -> Plan:
             if (sk, ek) == ("up", "uf"):
                 whole_partition = True
             elif fr.unit == "range":
-                if (sk, ek) != ("up", "c"):
-                    raise _GiveUp()  # RANGE offsets: host runner
+                if (sk, ek) == ("up", "c"):
+                    pass  # the default running frame
+                elif len(order) == 1:
+                    # numeric RANGE offsets: one ORDER BY key required
+                    for kd, nv in ((sk, sn), (ek, en)):
+                        if kd in ("p", "f") and (
+                            isinstance(nv, bool)
+                            or not isinstance(nv, (int, float))
+                            or not (0 <= nv <= _DEVICE_OFFSET_MAX)
+                        ):
+                            raise _GiveUp()  # host runner owns the error
+                    frame = ("range", sk, sn, ek, en)
+                else:
+                    raise _GiveUp()
             elif fr.unit == "rows":
                 for kd, nv in ((sk, sn), (ek, en)):
                     if kd in ("p", "f") and not _device_int(nv):
                         raise _GiveUp()  # host runner owns the error
-                frame = (sk, sn, ek, en)
-            else:
-                raise _GiveUp()  # GROUPS: host runner
+                frame = ("rows", sk, sn, ek, en)
+            else:  # groups
+                for kd, nv in ((sk, sn), (ek, en)):
+                    if kd in ("p", "f") and not _device_int(nv):
+                        raise _GiveUp()  # host runner owns the error
+                frame = ("groups", sk, sn, ek, en)
         if fn in ("row_number", "rank", "dense_rank", "percent_rank",
                   "cume_dist"):
             if not order or e.func.args:
@@ -631,7 +651,7 @@ def _window_select(q: ast.Select, scope: _Scope, source: Plan) -> Plan:
                     raise _GiveUp()
                 param = a1.value
             if whole_partition:
-                frame = ("up", None, "uf", None)
+                frame = ("rows", "up", None, "uf", None)
         elif fn in ("lag", "lead"):
             if not order or not (1 <= len(e.func.args) <= 3):
                 raise _GiveUp()

@@ -204,3 +204,92 @@ def test_float_default_lag_falls_back():
     ).as_pandas()
     assert list(r["p"]) == [0.5, 10.0]
     assert e.fallbacks.get("sql_select", 0) >= 1
+
+
+def test_groups_frames_on_device():
+    _check(
+        "SELECT k, o, SUM(v) OVER (PARTITION BY k ORDER BY o"
+        " GROUPS BETWEEN 1 PRECEDING AND CURRENT ROW) AS s,"
+        " COUNT(v) OVER (PARTITION BY k ORDER BY v"
+        " GROUPS BETWEEN 2 PRECEDING AND 1 FOLLOWING) AS c FROM"
+    )
+
+
+def test_groups_frame_ties_share_groups():
+    # duplicate order keys form ONE group; 1 PRECEDING spans the whole
+    # previous peer group
+    dd = pd.DataFrame(
+        {"k": [1] * 6, "o": [1, 1, 2, 2, 2, 5],
+         "v": [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]}
+    )
+    e = make_execution_engine("jax")
+    r = raw_sql(
+        "SELECT o, v, SUM(v) OVER (PARTITION BY k ORDER BY o"
+        " GROUPS BETWEEN 1 PRECEDING AND CURRENT ROW) AS s FROM",
+        dd, "ORDER BY o, v", engine=e, as_fugue=True,
+    ).as_pandas()
+    assert list(r["s"]) == [3.0, 3.0, 15.0, 15.0, 15.0, 18.0]
+    assert e.fallbacks == {}, e.fallbacks
+
+
+def test_range_offsets_on_device():
+    _check(
+        "SELECT k, o, SUM(v) OVER (PARTITION BY k ORDER BY o"
+        " RANGE BETWEEN 5 PRECEDING AND 5 FOLLOWING) AS s,"
+        " AVG(v) OVER (PARTITION BY k ORDER BY o"
+        " RANGE BETWEEN 10 PRECEDING AND CURRENT ROW) AS a FROM"
+    )
+
+
+def test_range_desc_and_float_offsets_on_device():
+    _check(
+        "SELECT k, o, MIN(v) OVER (PARTITION BY k ORDER BY v DESC"
+        " RANGE BETWEEN 2.5 PRECEDING AND 0 FOLLOWING) AS m FROM"
+    )
+
+
+def test_range_null_keys_resolve_to_peer_group():
+    dd = pd.DataFrame(
+        {"k": [1] * 5, "x": [1.0, 2.0, None, None, 9.0],
+         "v": [10.0, 20.0, 1.0, 2.0, 40.0]}
+    )
+    e = make_execution_engine("jax")
+    r = raw_sql(
+        "SELECT v, SUM(v) OVER (PARTITION BY k ORDER BY x"
+        " RANGE BETWEEN 1 PRECEDING AND 1 FOLLOWING) AS s FROM",
+        dd, "ORDER BY v", engine=e, as_fugue=True,
+    ).as_pandas()
+    by_v = r.set_index("v")["s"]
+    assert by_v[10.0] == 30.0 and by_v[20.0] == 30.0  # x in [0,3]
+    assert by_v[40.0] == 40.0
+    assert by_v[1.0] == 3.0 and by_v[2.0] == 3.0  # null peers only
+    assert e.fallbacks == {}, e.fallbacks
+
+
+def test_range_groups_first_value_on_device():
+    _check(
+        "SELECT k, o, FIRST_VALUE(v) OVER (PARTITION BY k ORDER BY o"
+        " GROUPS BETWEEN 1 PRECEDING AND 1 FOLLOWING) AS f,"
+        " LAST_VALUE(v) OVER (PARTITION BY k ORDER BY o"
+        " RANGE BETWEEN 3 PRECEDING AND 3 FOLLOWING) AS l FROM"
+    )
+
+
+def test_range_offsetless_spellings_on_device():
+    # RANGE CURRENT ROW .. UNBOUNDED FOLLOWING (and c..c) need no order
+    # key machinery — peer/partition bounds only (review finding: the
+    # device program crashed loading a key it never fetched)
+    dd = pd.DataFrame({"k": [1, 1, 1], "o": [1, 2, 2],
+                       "v": [1.0, 2.0, 3.0]})
+    e = make_execution_engine("jax")
+    r = raw_sql(
+        "SELECT o, SUM(v) OVER (PARTITION BY k ORDER BY o"
+        " RANGE BETWEEN CURRENT ROW AND UNBOUNDED FOLLOWING) AS s,"
+        " SUM(v) OVER (PARTITION BY k ORDER BY o"
+        " RANGE BETWEEN CURRENT ROW AND CURRENT ROW) AS c FROM",
+        dd, "ORDER BY o, s", engine=e, as_fugue=True,
+    ).as_pandas()
+    assert [tuple(x) for x in r.to_numpy()] == [
+        (1, 6.0, 1.0), (2, 5.0, 5.0), (2, 5.0, 5.0)
+    ], r
+    assert e.fallbacks == {}, e.fallbacks

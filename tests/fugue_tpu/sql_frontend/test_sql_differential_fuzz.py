@@ -197,6 +197,8 @@ def test_fuzz_window_functions():
         " ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING",
         " ROWS BETWEEN CURRENT ROW AND UNBOUNDED FOLLOWING",
         " ROWS BETWEEN UNBOUNDED PRECEDING AND UNBOUNDED FOLLOWING",
+        " GROUPS BETWEEN 1 PRECEDING AND CURRENT ROW",
+        " RANGE BETWEEN 20 PRECEDING AND 20 FOLLOWING",
     ]
     e = make_execution_engine("jax")
     on_device = 0

@@ -412,9 +412,10 @@ def test_running_windows_route_to_device():
         assert e.fallbacks == {}, (head, e.fallbacks)
 
 
-def test_groups_and_range_offset_windows_fall_back_counted():
-    """GROUPS frames and RANGE offsets stay on the host runner with a
-    counted fallback and identical results."""
+def test_groups_and_range_offset_windows_route_to_device():
+    """GROUPS frames and RANGE offsets lower to the device sorted-space
+    program (peer-group bounds; per-partition bisect for value
+    offsets) — round-4 closed this former host fallback."""
     df = _df()
     for head in (
         "SELECT k, v, SUM(v) OVER (PARTITION BY k ORDER BY v"
@@ -427,4 +428,4 @@ def test_groups_and_range_offset_windows_fall_back_counted():
         rj = raw_sql(*parts, engine=e, as_fugue=True).as_pandas()
         rn = _run(parts)
         assert _match(rj, rn), head
-        assert e.fallbacks.get("sql_select", 0) >= 1, head
+        assert e.fallbacks == {}, (head, e.fallbacks)
