@@ -344,7 +344,15 @@ class NativeExecutionEngine(ExecutionEngine):
             df1.schema == df2.schema,
             ValueError(f"subtract schema mismatch {df1.schema} vs {df2.schema}"),
         )
-        assert_or_throw(distinct, NotImplementedError("EXCEPT ALL not supported"))
+        if not distinct:  # multiset: pair off occurrences
+            return PandasDataFrame(
+                _pandas_multiset_op(
+                    self.to_df(df1).as_pandas(),
+                    self.to_df(df2).as_pandas(),
+                    subtract=True,
+                ),
+                df1.schema,
+            )
         a = _pandas_distinct(self.to_df(df1).as_pandas())
         b = self.to_df(df2).as_pandas()
         cols = list(a.columns)
@@ -359,7 +367,15 @@ class NativeExecutionEngine(ExecutionEngine):
             df1.schema == df2.schema,
             ValueError(f"intersect schema mismatch {df1.schema} vs {df2.schema}"),
         )
-        assert_or_throw(distinct, NotImplementedError("INTERSECT ALL not supported"))
+        if not distinct:  # multiset: pair off occurrences
+            return PandasDataFrame(
+                _pandas_multiset_op(
+                    self.to_df(df1).as_pandas(),
+                    self.to_df(df2).as_pandas(),
+                    subtract=False,
+                ),
+                df1.schema,
+            )
         a = _pandas_distinct(self.to_df(df1).as_pandas())
         b = self.to_df(df2).as_pandas()
         cols = list(a.columns)
@@ -488,6 +504,30 @@ def _pandas_distinct(pdf: pd.DataFrame) -> pd.DataFrame:
         # unhashable cells (lists/dicts): fall back to a string projection
         key = pdf.astype(str).apply(lambda r: "\0".join(r), axis=1)
         return pdf[~key.duplicated()].reset_index(drop=True)
+
+
+def _pandas_multiset_op(
+    a: pd.DataFrame, b: pd.DataFrame, subtract: bool
+) -> pd.DataFrame:
+    """EXCEPT/INTERSECT ALL (standard SQL multiset semantics): each left
+    row pairs off against right-side occurrences of the same full-row
+    key — EXCEPT ALL keeps occurrences past the right count, INTERSECT
+    ALL those within it. NULL keys compare equal (merge factorization)."""
+    cols = list(a.columns)
+    occ_l = "_occ"
+    while occ_l in cols:  # user columns can shadow the temp names
+        occ_l += "_"
+    rc_l = "_rc"
+    while rc_l in cols:
+        rc_l += "_"
+    lo = a.assign(**{occ_l: a.groupby(cols, dropna=False).cumcount()})
+    rcnt = (
+        b.groupby(cols, dropna=False).size().rename(rc_l).reset_index()
+    )
+    merged = lo.merge(rcnt, on=cols, how="left")
+    rc = merged[rc_l].fillna(0)
+    keep = merged[occ_l] >= rc if subtract else merged[occ_l] < rc
+    return merged[keep][cols].reset_index(drop=True)
 
 
 def _pandas_join(

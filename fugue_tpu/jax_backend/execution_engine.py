@@ -430,8 +430,8 @@ class JaxSQLEngine(PandasSQLEngine):
             if plan.op == "union":
                 return engine.union(left, right, distinct=plan.distinct)
             if plan.op == "except":
-                return engine.subtract(left, right, distinct=True)
-            return engine.intersect(left, right, distinct=True)
+                return engine.subtract(left, right, distinct=plan.distinct)
+            return engine.intersect(left, right, distinct=plan.distinct)
         if isinstance(plan, ab.WindowPlan):
             src: JaxDataFrame = engine.to_df(
                 self._exec_plan(plan.source, dfs, done)
@@ -1048,12 +1048,10 @@ class JaxExecutionEngine(ExecutionEngine):
             j1.schema == j2.schema,
             ValueError(f"{name} schema mismatch {j1.schema} vs {j2.schema}"),
         )
-        assert_or_throw(
-            distinct, NotImplementedError(f"{name.upper()} ALL not supported")
-        )
         if j1.blocks.all_on_device and j2.blocks.all_on_device:
             out = relational.intersect_subtract(
-                self, j1.blocks, j2.blocks, j1.schema.names, subtract
+                self, j1.blocks, j2.blocks, j1.schema.names, subtract,
+                distinct=distinct,
             )
             return JaxDataFrame(out, j1.schema)
         self._count_fallback(name, "host-resident columns")

@@ -622,10 +622,14 @@ def intersect_subtract(
     b2: JaxBlocks,
     names: List[str],
     subtract: bool,
+    distinct: bool = True,
 ) -> JaxBlocks:
-    """INTERSECT / EXCEPT (distinct): keep df1 rows whose full-row key
-    {is, is not} present in df2, first occurrence only. Mask-only; NULLs
-    compare equal (null buckets)."""
+    """INTERSECT / EXCEPT: keep df1 rows whose full-row key {is, is not}
+    present in df2 — first occurrence only when ``distinct``; multiset
+    (... ALL) semantics otherwise: EXCEPT ALL keeps each row whose
+    occurrence ordinal within its key is >= df2's count of that key,
+    INTERSECT ALL those below it. Mask-only; NULLs compare equal (null
+    buckets)."""
     sf = shared_factorize(b1, b2, names)
     S = max(sf.num_segments, 1)
     p1 = b1.padded_nrows
@@ -641,22 +645,39 @@ def intersect_subtract(
         c2 = jax.ops.segment_sum(
             v2.astype(jnp.int32), jnp.where(v2, seg2, S), num_segments=S
         )
-        hit = c2[jnp.clip(seg1, 0, S - 1)] > 0
-        present = valid1 & (~hit if subtract else hit)
-        # first occurrence among the kept df1 rows
         pos = jnp.arange(p1, dtype=jnp.int32)
-        firsts = jax.ops.segment_min(
-            jnp.where(present, pos, p1),
-            jnp.where(present, seg1, S),
-            num_segments=S,
+        if distinct:
+            hit = c2[jnp.clip(seg1, 0, S - 1)] > 0
+            present = valid1 & (~hit if subtract else hit)
+            # first occurrence among the kept df1 rows
+            firsts = jax.ops.segment_min(
+                jnp.where(present, pos, p1),
+                jnp.where(present, seg1, S),
+                num_segments=S,
+            )
+            keep = present & (firsts[jnp.clip(seg1, 0, S - 1)] == pos)
+            return keep, jnp.sum(keep).astype(jnp.int32)
+        # multiset: occurrence ordinal per key via a segment-sorted scan
+        segv1 = jnp.where(valid1, seg1, S)
+        order = jnp.argsort(segv1, stable=True)
+        c1 = jax.ops.segment_sum(
+            valid1.astype(jnp.int32), segv1, num_segments=S + 1
+        )[:S]
+        starts = jnp.cumsum(c1) - c1
+        sseg = segv1[order]
+        ordinal_sorted = pos - starts[jnp.clip(sseg, 0, S - 1)]
+        ordinal = jnp.zeros((p1,), dtype=jnp.int32).at[order].set(
+            ordinal_sorted
         )
-        keep = present & (firsts[jnp.clip(seg1, 0, S - 1)] == pos)
+        rc = c2[jnp.clip(seg1, 0, S - 1)]
+        keep = valid1 & (ordinal >= rc if subtract else ordinal < rc)
         return keep, jnp.sum(keep).astype(jnp.int32)
 
     keep, cnt = engine._jit_cached(
         (
             "intersect_subtract",
             subtract,
+            distinct,
             S,
             p1,
             b2.padded_nrows,

@@ -275,8 +275,6 @@ def _query(env: Dict[str, object], q: ast.Query) -> Plan:
         op = q.op.lower()
         if op not in ("union", "except", "intersect"):
             raise _GiveUp()
-        if q.all and op != "union":
-            raise _GiveUp()  # EXCEPT/INTERSECT ALL: host only
         left = _query(env, q.left)
         right = _query(env, q.right)
         plan: Plan = SetPlan(op, not q.all, left, right)

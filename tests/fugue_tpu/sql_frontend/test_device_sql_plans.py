@@ -235,8 +235,9 @@ def test_qualified_misbinding_gives_up():
 
 
 def test_except_intersect_all_multiset_semantics():
-    """Review r4: EXCEPT ALL / INTERSECT ALL pair occurrences off
-    (standard multiset semantics), they do not dedup first."""
+    """EXCEPT ALL / INTERSECT ALL pair occurrences off (standard
+    multiset semantics), they do not dedup first — and on the jax
+    engine they run as device occurrence-ordinal programs."""
     a = pd.DataFrame({"x": [1, 1, 1, 2, 3]})
     b = pd.DataFrame({"x": [1, 1, 2]})
     for eng in ("native", "jax"):
@@ -247,3 +248,52 @@ def test_except_intersect_all_multiset_semantics():
         r2 = raw_sql("SELECT x FROM", a, "INTERSECT ALL SELECT x FROM", b,
                      engine=e, as_fugue=True).as_pandas()
         assert sorted(r2["x"].tolist()) == [1, 1, 2], eng
+        if eng == "jax":
+            assert e.fallbacks == {}, e.fallbacks
+
+
+def test_multiset_set_ops_with_strings_and_nulls_on_device():
+    # full-row keys incl. string dictionaries and NULL buckets align
+    # across frames via the shared factorization
+    a = pd.DataFrame({"x": [1.0, 1.0, 2.0, None, None],
+                      "s": ["a", "a", "b", None, None]})
+    b = pd.DataFrame({"x": [1.0, None], "s": ["a", None]})
+    e = make_execution_engine("jax")
+    r = raw_sql("SELECT x, s FROM", a, "EXCEPT ALL SELECT x, s FROM", b,
+                engine=e, as_fugue=True).as_pandas()
+    rn = raw_sql("SELECT x, s FROM", a, "EXCEPT ALL SELECT x, s FROM", b,
+                 engine="native", as_fugue=True).as_pandas()
+    cj = sorted(map(str, r.fillna("~").to_dict("records")))
+    cn = sorted(map(str, rn.fillna("~").to_dict("records")))
+    assert cj == cn and len(r) == 3, (r, rn)
+    assert e.fallbacks == {}, e.fallbacks
+
+
+def test_engine_level_multiset_set_ops():
+    # the engine API surface (not just SQL) supports distinct=False on
+    # both engines
+    from fugue_tpu.execution import make_execution_engine as mee
+
+    a = pd.DataFrame({"x": [1, 1, 2, 3]})
+    b = pd.DataFrame({"x": [1, 2, 2]})
+    for eng in ("native", "jax"):
+        e = mee(eng)
+        r = e.subtract(e.to_df(a), e.to_df(b), distinct=False).as_pandas()
+        assert sorted(r["x"].tolist()) == [1, 3], eng
+        r = e.intersect(e.to_df(a), e.to_df(b), distinct=False).as_pandas()
+        assert sorted(r["x"].tolist()) == [1, 2], eng
+
+
+def test_multiset_set_ops_with_colliding_temp_names():
+    # columns literally named _rc/_occ must not break the pairing
+    # machinery (review finding)
+    a = pd.DataFrame({"_rc": [1, 1, 2], "_occ": [5, 5, 6]})
+    b = pd.DataFrame({"_rc": [1], "_occ": [5]})
+    for eng in ("native", "jax"):
+        e = make_execution_engine(eng)
+        r = raw_sql("SELECT _rc, _occ FROM", a,
+                    "EXCEPT ALL SELECT _rc, _occ FROM", b,
+                    engine=e, as_fugue=True).as_pandas()
+        assert sorted(map(tuple, r.to_numpy().tolist())) == [
+            (1, 5), (2, 6)
+        ], (eng, r)
