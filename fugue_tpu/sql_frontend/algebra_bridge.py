@@ -7,11 +7,14 @@ on device (the role the reference's SQL backends play natively:
 ``/root/reference/fugue_duckdb/execution_engine.py:238-483`` builds its
 relational ops as DuckDB SQL; here the bridge builds them as device
 relational ops), including windows (``WindowPlan``): the ranking
-family, whole-partition / running / ROWS-framed aggregates, LAG/LEAD
-and FIRST/LAST/NTH_VALUE. Returns ``None`` for anything outside the
-supported shape (non-equi joins, correlated subqueries, GROUPS frames,
-RANGE offsets, LIKE, EXCEPT/INTERSECT ALL) so callers fall back to the
-host SELECT runner.
+family, whole-partition / running / framed aggregates over the FULL
+frame matrix (ROWS, GROUPS, RANGE incl. numeric offsets), LAG/LEAD and
+FIRST/LAST/NTH_VALUE; multiset set ops; DISTINCT and variance/median
+aggregates; HAVING; string predicates, LIKE, CASE and the scalar
+function library. Returns ``None`` for anything outside the supported
+shape (non-equi joins, correlated subqueries, oversized frame offsets,
+dynamic LIKE patterns) so callers fall back to the host SELECT
+runner.
 
 Name scoping is tracked per relation (each plan node knows its output
 column names), so a qualified reference to a column the relation does
