@@ -11,10 +11,11 @@ family, whole-partition / running / framed aggregates over the FULL
 frame matrix (ROWS, GROUPS, RANGE incl. numeric offsets), LAG/LEAD and
 FIRST/LAST/NTH_VALUE; multiset set ops; DISTINCT and variance/median
 aggregates; HAVING; string predicates, LIKE, CASE and the scalar
-function library. Returns ``None`` for anything outside the supported
-shape (non-equi joins, correlated subqueries, oversized frame offsets,
-dynamic LIKE patterns) so callers fall back to the host SELECT
-runner.
+function library; uncorrelated ``col IN (SELECT ...)`` WHERE conjuncts
+as device SEMI joins. Returns ``None`` for anything outside the
+supported shape (non-equi joins, correlated subqueries, NOT IN
+subqueries, oversized frame offsets, dynamic LIKE patterns) so callers
+fall back to the host SELECT runner.
 
 Name scoping is tracked per relation (each plan node knows its output
 column names), so a qualified reference to a column the relation does
@@ -487,13 +488,63 @@ def _select(env: Dict[str, object], q: ast.Select) -> Plan:
     elif cols.has_agg and len(cols.group_keys) > 0:
         raise _GiveUp()  # non-agg cols without GROUP BY is invalid SQL
 
-    where = _expr(q.where, scope) if q.where is not None else None
+    where_ast = q.where
+    if where_ast is not None:
+        source, where_ast = _lower_in_subqueries(
+            env, source, scope, where_ast
+        )
+    where = _expr(where_ast, scope) if where_ast is not None else None
     having = _expr(q.having, scope) if q.having is not None else None
     order = _order_items(q.order_by, out_names)
     return SelectPlan(
         source, cols, where, having, order, q.limit, q.offset,
         q.distinct, out_names,
     )
+
+
+def _lower_in_subqueries(
+    env: Dict[str, object],
+    source: Plan,
+    scope: _Scope,
+    where: ast.Expr,
+) -> Tuple[Plan, Optional[ast.Expr]]:
+    """Uncorrelated ``col IN (SELECT ...)`` WHERE conjuncts become
+    device SEMI joins against the translated subquery. NULL semantics
+    match exactly: in a WHERE context a no-match NULL filters the row
+    just like FALSE, and null keys never join. ``NOT IN`` stays on the
+    host — with any NULL on the right it is never TRUE, which an ANTI
+    join cannot express."""
+
+    def split(e: ast.Expr) -> List[ast.Expr]:
+        if isinstance(e, ast.Binary) and e.op.upper() == "AND":
+            return split(e.left) + split(e.right)
+        return [e]
+
+    remaining: List[ast.Expr] = []
+    for c in split(where):
+        if (
+            isinstance(c, ast.InSubquery)
+            and not c.negated
+            and isinstance(c.operand, ast.Col)
+        ):
+            sub = _query(env, c.query)  # correlated refs -> _GiveUp
+            if len(sub.out_names) != 1:
+                raise _GiveUp()  # the host owns the arity error
+            keyname = scope.resolve(c.operand.name, c.operand.table)
+            inner = sub.out_names[0]
+            if inner.lower() != keyname.lower():
+                sub = SelectPlan(
+                    sub,
+                    SelectColumns(col(inner).alias(keyname)),
+                    None, None, [], None, None, False, [keyname],
+                )
+            source = JoinPlan(source, sub, "semi", [keyname])
+            continue
+        remaining.append(c)
+    out: Optional[ast.Expr] = None
+    for c in remaining:
+        out = c if out is None else ast.Binary("AND", out, c)
+    return source, out
 
 
 _DEVICE_WINDOW_AGGS = {"sum", "count", "avg", "mean", "min", "max"}

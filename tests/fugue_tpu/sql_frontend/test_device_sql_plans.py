@@ -297,3 +297,49 @@ def test_multiset_set_ops_with_colliding_temp_names():
         assert sorted(map(tuple, r.to_numpy().tolist())) == [
             (1, 5), (2, 6)
         ], (eng, r)
+
+
+def test_in_subquery_lowers_to_device_semi_join():
+    # uncorrelated IN (SELECT ...) in WHERE = a device semi join; NULL
+    # semantics agree because no-match NULL filters like FALSE
+    a = pd.DataFrame({"k": [1, 2, 3, 4, None], "v": [1.0, 2, 3, 4, 5]})
+    b = pd.DataFrame({"k": [1.0, 3.0, 3.0, None]})
+    e = make_execution_engine("jax")
+    r = raw_sql(
+        "SELECT k, v FROM", a, "WHERE k IN (SELECT k FROM", b,
+        ") ORDER BY k", engine=e, as_fugue=True,
+    ).as_pandas()
+    rn = raw_sql(
+        "SELECT k, v FROM", a, "WHERE k IN (SELECT k FROM", b,
+        ") ORDER BY k", engine="native", as_fugue=True,
+    ).as_pandas()
+    assert r.to_dict("records") == rn.to_dict("records")
+    assert sorted(r["k"]) == [1.0, 3.0]  # dup matches keep rows ONCE
+    assert e.fallbacks == {}, e.fallbacks
+
+
+def test_in_subquery_with_rename_and_residual_where():
+    # subquery output under a different name + extra conjuncts
+    a = pd.DataFrame({"k": [1, 2, 3], "v": [1.0, 2.0, 3.0]})
+    b = pd.DataFrame({"j": [2, 3]})
+    e = make_execution_engine("jax")
+    r = raw_sql(
+        "SELECT k FROM", a, "WHERE k IN (SELECT j FROM", b,
+        ") AND v < 2.5 ORDER BY k", engine=e, as_fugue=True,
+    ).as_pandas()
+    assert list(r["k"]) == [2]
+    assert e.fallbacks == {}, e.fallbacks
+
+
+def test_not_in_subquery_stays_on_host():
+    # NOT IN with right-side NULLs is never TRUE; an ANTI join cannot
+    # express that, so the host runner owns it
+    a = pd.DataFrame({"k": [1, 2, 3]})
+    b = pd.DataFrame({"k": [1.0, None]})
+    e = make_execution_engine("jax")
+    r = raw_sql(
+        "SELECT k FROM", a, "WHERE k NOT IN (SELECT k FROM", b, ")",
+        engine=e, as_fugue=True,
+    ).as_pandas()
+    assert len(r) == 0
+    assert sum(e.fallbacks.values()) >= 1
