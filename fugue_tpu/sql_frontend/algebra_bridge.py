@@ -12,8 +12,9 @@ frame matrix (ROWS, GROUPS, RANGE incl. numeric offsets), LAG/LEAD and
 FIRST/LAST/NTH_VALUE; multiset set ops; DISTINCT and variance/median
 aggregates; HAVING; string predicates, LIKE, CASE and the scalar
 function library; uncorrelated ``col IN (SELECT ...)`` WHERE conjuncts
-as device SEMI joins. Returns ``None`` for anything outside the
-supported shape (non-equi joins, correlated subqueries, NOT IN
+and equi-correlated ``[NOT] EXISTS`` predicates as device SEMI/ANTI
+joins. Returns ``None`` for anything outside the supported shape
+(non-equi joins and correlations, scalar subqueries, NOT IN
 subqueries, oversized frame offsets, dynamic LIKE patterns) so callers
 fall back to the host SELECT runner.
 
@@ -23,7 +24,7 @@ not own is a translation failure — the host runner then raises the
 proper SQL error instead of the bridge silently mis-binding it.
 """
 
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 from fugue_tpu.column import functions as ff
 from fugue_tpu.column.expressions import ColumnExpr, col, lit, null
@@ -515,13 +516,8 @@ def _lower_in_subqueries(
     host — with any NULL on the right it is never TRUE, which an ANTI
     join cannot express."""
 
-    def split(e: ast.Expr) -> List[ast.Expr]:
-        if isinstance(e, ast.Binary) and e.op.upper() == "AND":
-            return split(e.left) + split(e.right)
-        return [e]
-
     remaining: List[ast.Expr] = []
-    for c in split(where):
+    for c in _split_conjuncts(where):
         if (
             isinstance(c, ast.InSubquery)
             and not c.negated
@@ -540,11 +536,138 @@ def _lower_in_subqueries(
                 )
             source = JoinPlan(source, sub, "semi", [keyname])
             continue
+        ex = _exists_form(c)
+        if ex is not None:
+            source = _decorrelate_exists(env, source, scope, *ex)
+            continue
         remaining.append(c)
     out: Optional[ast.Expr] = None
     for c in remaining:
         out = c if out is None else ast.Binary("AND", out, c)
     return source, out
+
+
+def _split_conjuncts(e: ast.Expr) -> List[ast.Expr]:
+    if isinstance(e, ast.Binary) and e.op.upper() == "AND":
+        return _split_conjuncts(e.left) + _split_conjuncts(e.right)
+    return [e]
+
+
+def _has_aggregate(e: Any) -> bool:
+    """Any aggregate call anywhere in the expression subtree (nested
+    queries included — conservative: callers give up to the host)."""
+    if isinstance(e, ast.Func) and e.name.lower() in _AGG_FUNCS:
+        return True
+    if isinstance(e, ast.Node):
+        return any(
+            _has_aggregate(getattr(e, f)) for f in e._fields
+        )
+    if isinstance(e, (list, tuple)):
+        return any(_has_aggregate(x) for x in e)
+    return False
+
+
+def _exists_form(c: ast.Expr) -> Optional[Tuple[ast.Query, bool]]:
+    if isinstance(c, ast.Exists):
+        return (c.query, False)
+    if (
+        isinstance(c, ast.Unary)
+        and c.op.upper() == "NOT"
+        and isinstance(c.operand, ast.Exists)
+    ):
+        return (c.operand.query, True)
+    return None
+
+
+def _decorrelate_exists(
+    env: Dict[str, object],
+    source: Plan,
+    scope: _Scope,
+    q: ast.Query,
+    negated: bool,
+) -> Plan:
+    """The classic decorrelation: ``[NOT] EXISTS (SELECT ... WHERE
+    inner.k = outer.k AND <inner-only residuals>)`` is exactly a device
+    SEMI (resp. ANTI) join on the equality pairs — NULL outer keys never
+    join, which matches EXISTS evaluating the correlation to NULL.
+    Anything beyond equi-correlation + inner residuals gives up (the
+    host runner owns the general case)."""
+    if not isinstance(q, ast.Select) or q.from_ is None:
+        raise _GiveUp()
+    if (
+        q.group_by
+        or q.having is not None
+        or q.distinct
+        or q.order_by
+        or q.limit is not None
+        or q.offset is not None
+    ):
+        raise _GiveUp()
+    if _has_aggregate(list(q.items)) or _has_aggregate(q.where):
+        # a scalar-aggregate subquery ALWAYS returns one row, so EXISTS
+        # is unconditionally true — not a semi join (review finding)
+        raise _GiveUp()
+    inner_scope = _Scope()
+    inner_src = _relation(env, q.from_, inner_scope)
+    inner_scope.row_names = list(inner_src.sql_row_names)
+    for item in q.items:  # EXISTS ignores items, but bad refs must fall
+        if isinstance(item.expr, ast.Star):
+            continue
+        _expr(item.expr, inner_scope)
+
+    def _bind(ref: ast.Col) -> Tuple[str, str]:
+        # standard scoping: unqualified names prefer the INNER scope.
+        # Only a name genuinely ABSENT from the inner scope may bind
+        # outer — taint/ambiguity failures must not silently rebind
+        # (review finding)
+        if ref.table is not None:
+            if ref.table.lower() in inner_scope.relations:
+                return (
+                    "inner", inner_scope.resolve(ref.name, ref.table)
+                )
+            return ("outer", scope.resolve(ref.name, ref.table))
+        hits = [
+            n
+            for n in inner_scope.row_names
+            if n.lower() == ref.name.lower()
+        ]
+        if hits:
+            return ("inner", inner_scope.resolve(ref.name, None))
+        return ("outer", scope.resolve(ref.name, None))
+
+    pairs: List[Tuple[str, str]] = []  # (outer name, inner name)
+    residual: Optional[ColumnExpr] = None
+    for cj in _split_conjuncts(q.where) if q.where is not None else []:
+        if (
+            isinstance(cj, ast.Binary)
+            and cj.op == "="
+            and isinstance(cj.left, ast.Col)
+            and isinstance(cj.right, ast.Col)
+        ):
+            (ka, na), (kb, nb) = _bind(cj.left), _bind(cj.right)
+            if {ka, kb} == {"inner", "outer"}:
+                outer_n = na if ka == "outer" else nb
+                inner_n = na if ka == "inner" else nb
+                pairs.append((outer_n, inner_n))
+                continue
+            if ka == "outer":  # outer = outer: host handles
+                raise _GiveUp()
+        # anything else must be INNER-only (resolve raises otherwise)
+        term = _expr(cj, inner_scope)
+        residual = term if residual is None else (residual & term)
+    if not pairs:
+        raise _GiveUp()  # uncorrelated EXISTS: host owns it
+    outer_names = [o for o, _ in pairs]
+    if len({o.lower() for o in outer_names}) != len(outer_names):
+        raise _GiveUp()
+    sub = SelectPlan(
+        inner_src,
+        SelectColumns(*[col(i).alias(o) for o, i in pairs]),
+        residual, None, [], None, None, False, list(outer_names),
+    )
+    return JoinPlan(
+        source, sub, "anti" if negated else "semi", list(outer_names)
+    )
 
 
 _DEVICE_WINDOW_AGGS = {"sum", "count", "avg", "mean", "min", "max"}

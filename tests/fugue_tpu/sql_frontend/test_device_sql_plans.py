@@ -343,3 +343,72 @@ def test_not_in_subquery_stays_on_host():
     ).as_pandas()
     assert len(r) == 0
     assert sum(e.fallbacks.values()) >= 1
+
+
+def test_exists_decorrelates_to_device_semi_join():
+    # EXISTS (SELECT ... WHERE b.k = a.k [AND inner residuals]) = a
+    # device semi join; NULL outer keys never join = EXISTS-NULL filters
+    a = pd.DataFrame({"k": [1, 2, 3, None], "v": [1.0, 2, 3, 4]})
+    b = pd.DataFrame({"k": [1.0, 3.0, 3.0], "w": [0.1, 0.9, 0.2]})
+    e = make_execution_engine("jax")
+    r = raw_sql(
+        "SELECT k FROM", a, "AS a WHERE EXISTS (SELECT 1 FROM", b,
+        "AS b WHERE b.k = a.k AND w > 0.5) ORDER BY k",
+        engine=e, as_fugue=True,
+    ).as_pandas()
+    assert list(r["k"]) == [3.0], r
+    assert e.fallbacks == {}, e.fallbacks
+
+
+def test_not_exists_decorrelates_to_device_anti_join():
+    # NOT EXISTS = anti join; a NULL outer key has no match, so the row
+    # is KEPT — exactly the anti-join convention
+    a = pd.DataFrame({"k": [1, 2, None], "v": [1.0, 2.0, 3.0]})
+    b = pd.DataFrame({"k": [1.0]})
+    e = make_execution_engine("jax")
+    r = raw_sql(
+        "SELECT v FROM", a, "AS a WHERE NOT EXISTS (SELECT 1 FROM", b,
+        "AS b WHERE b.k = a.k) ORDER BY v", engine=e, as_fugue=True,
+    ).as_pandas()
+    assert list(r["v"]) == [2.0, 3.0], r
+    assert e.fallbacks == {}, e.fallbacks
+    rn = raw_sql(
+        "SELECT v FROM", a, "AS a WHERE NOT EXISTS (SELECT 1 FROM", b,
+        "AS b WHERE b.k = a.k) ORDER BY v", engine="native",
+        as_fugue=True,
+    ).as_pandas()
+    assert r.to_dict() == rn.to_dict()
+
+
+def test_exists_beyond_equi_correlation_falls_back():
+    # non-equi correlation: host runner owns the general case
+    a = pd.DataFrame({"k": [1, 2, 3], "v": [1.0, 2.0, 3.0]})
+    b = pd.DataFrame({"k": [2.0], "w": [9.0]})
+    e = make_execution_engine("jax")
+    r = raw_sql(
+        "SELECT k FROM", a, "AS a WHERE EXISTS (SELECT 1 FROM", b,
+        "AS b WHERE b.w > a.v) ORDER BY k", engine=e, as_fugue=True,
+    ).as_pandas()
+    rn = raw_sql(
+        "SELECT k FROM", a, "AS a WHERE EXISTS (SELECT 1 FROM", b,
+        "AS b WHERE b.w > a.v) ORDER BY k", engine="native",
+        as_fugue=True,
+    ).as_pandas()
+    assert r.to_dict() == rn.to_dict()
+    assert sum(e.fallbacks.values()) >= 1
+
+
+def test_exists_with_aggregate_subquery_is_always_true():
+    # a scalar-aggregate subquery returns exactly one row: EXISTS is
+    # unconditionally TRUE — must NOT lower to a semi join
+    # (review finding: device returned only matching rows)
+    a = pd.DataFrame({"k": [1.0, 2.0, 3.0]})
+    b = pd.DataFrame({"k": [1.0], "w": [9.0]})
+    for eng in ("native", "jax"):
+        e = make_execution_engine(eng)
+        r = raw_sql(
+            "SELECT k FROM", a, "AS a WHERE EXISTS (SELECT MAX(w) FROM",
+            b, "AS b WHERE b.k = a.k) ORDER BY k",
+            engine=e, as_fugue=True,
+        ).as_pandas()
+        assert list(r["k"]) == [1.0, 2.0, 3.0], (eng, r)
