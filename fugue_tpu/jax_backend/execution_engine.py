@@ -1352,14 +1352,9 @@ class JaxExecutionEngine(ExecutionEngine):
             if not isinstance(a, _FuncExpr) or len(a.args) != 1:
                 return False
             fn = a.func.lower()
-            if fn not in (
-                "min", "max", "sum", "avg", "mean", "count", "first", "last",
-                "median", *VARIANCE_FUNCS,
-            ):
+            if fn not in _DEVICE_AGGS:
                 return False
-            if a.arg_distinct and fn not in (
-                "min", "max", "sum", "avg", "mean", "count"
-            ):
+            if a.arg_distinct and fn not in _DEVICE_DISTINCT_AGGS:
                 return False
             arg = a.args[0]
             if isinstance(arg, _NamedColumnExpr) and arg.wildcard:
@@ -1598,15 +1593,11 @@ class JaxExecutionEngine(ExecutionEngine):
             if not isinstance(c, _FuncExpr) or len(c.args) != 1:
                 return None
             fn = c.func.lower()
-            if fn not in (
-                "min", "max", "sum", "avg", "mean", "count", "first", "last",
-                "median", *VARIANCE_FUNCS,
-            ):
+            if fn not in _DEVICE_AGGS:
                 return None
             arg = c.args[0]
             if fn == "median" or fn in VARIANCE_FUNCS:
-                if c.arg_distinct:
-                    return None  # DISTINCT variance: host runner
+                # DISTINCT composes via the first-occurrence mask below
                 tp0 = arg.infer_type(jdf.schema)
                 if tp0 is None or not (
                     pa.types.is_integer(tp0)
@@ -2270,6 +2261,18 @@ def blocks_with_columns(
         row_valid=blocks.row_valid,
         nrows_dev=blocks._nrows_dev,
     )
+
+
+# the aggregate families the device paths accept (one definition so the
+# can-select gate and the plan builder cannot drift apart)
+_DEVICE_AGGS = (
+    "min", "max", "sum", "avg", "mean", "count", "first", "last",
+    "median", *VARIANCE_FUNCS,
+)
+_DEVICE_DISTINCT_AGGS = (
+    "min", "max", "sum", "avg", "mean", "count", "median",
+    *VARIANCE_FUNCS,
+)
 
 
 def _distinct_factorize(

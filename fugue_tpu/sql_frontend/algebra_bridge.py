@@ -612,6 +612,9 @@ def _decorrelate_exists(
     inner_scope.row_names = list(inner_src.sql_row_names)
     for item in q.items:  # EXISTS ignores items, but bad refs must fall
         if isinstance(item.expr, ast.Star):
+            tbl = item.expr.table
+            if tbl is not None and tbl.lower() not in inner_scope.relations:
+                raise _GiveUp()  # unknown alias: the host raises it
             continue
         _expr(item.expr, inner_scope)
 

@@ -172,3 +172,25 @@ def test_variance_skips_nan_payloads_like_pandas():
         ).as_pandas()
         assert abs(float(r["s"].iloc[0]) - 1.0) < 1e-12, (eng, r)
         assert abs(float(r["m"].iloc[0]) - 2.0) < 1e-12, (eng, r)
+
+
+def test_distinct_variance_and_median_on_device():
+    # DISTINCT composes with the variance/median kernels through the
+    # per-(keys, value) first-occurrence mask — no host fallback
+    rng = np.random.default_rng(4)
+    dd = pd.DataFrame({"k": rng.integers(0, 4, 50),
+                       "v": rng.integers(0, 6, 50).astype(float)})
+    dd.loc[::7, "v"] = np.nan
+    q = ("SELECT k, STDDEV(DISTINCT v) AS sd, VAR_POP(DISTINCT v) AS vp,"
+         " MEDIAN(DISTINCT v) AS md FROM")
+    e = make_execution_engine("jax")
+    rj = raw_sql(q, dd, "GROUP BY k ORDER BY k", engine=e,
+                 as_fugue=True).as_pandas()
+    rn = raw_sql(q, dd, "GROUP BY k ORDER BY k", engine="native",
+                 as_fugue=True).as_pandas()
+    for c in rj.columns:
+        assert np.allclose(
+            rj[c].to_numpy(dtype=float), rn[c].to_numpy(dtype=float),
+            equal_nan=True,
+        ), (c, rj, rn)
+    assert e.fallbacks == {}, e.fallbacks
