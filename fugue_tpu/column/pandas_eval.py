@@ -30,6 +30,14 @@ from fugue_tpu.schema import Schema
 from fugue_tpu.utils.assertion import assert_or_throw
 
 
+def sql_fmod(a: pd.Series, b: pd.Series) -> pd.Series:
+    """SQL modulo: truncated (sign of dividend, MOD(-7, 3) = -1), NULL on
+    a zero divisor, with numpy's out-of-domain chatter suppressed. Shared
+    by every host evaluator so the semantics cannot drift apart."""
+    with np.errstate(invalid="ignore", divide="ignore"):
+        return np.fmod(a, b).where(b != 0)
+
+
 def eval_expr(df: pd.DataFrame, expr: ColumnExpr) -> pd.Series:
     """Evaluate a non-aggregation expression to a Series aligned with df."""
     s = _eval(df, expr)
@@ -140,7 +148,11 @@ def _eval(df: pd.DataFrame, expr: ColumnExpr) -> pd.Series:
             return res
         if f in _NUM_UNARY:
             s = pd.to_numeric(_eval(df, expr.args[0]), errors="coerce")
-            return pd.Series(_NUM_UNARY[f](s), index=df.index)
+            # out-of-domain inputs (SQRT(-4), LN(0)) yield NaN by SQL
+            # intent, not as a numpy anomaly — keep -W error runs clean
+            with np.errstate(invalid="ignore", divide="ignore"):
+                res = _NUM_UNARY[f](s)
+            return pd.Series(res, index=df.index)
         if f == "round":
             s = pd.to_numeric(_eval(df, expr.args[0]), errors="coerce")
             digits = _scalar_arg(df, expr.args, 1, 0)
@@ -152,7 +164,7 @@ def _eval(df: pd.DataFrame, expr: ColumnExpr) -> pd.Series:
         if f == "mod":
             a = pd.to_numeric(_eval(df, expr.args[0]), errors="coerce")
             b = pd.to_numeric(_eval(df, expr.args[1]), errors="coerce")
-            return a % b
+            return sql_fmod(a, b)
         if f == "nullif":
             a = _eval(df, expr.args[0])
             b = _eval(df, expr.args[1])
@@ -517,6 +529,8 @@ def eval_select(
             key_names.append(name)  # plain passthrough key
             continue
         tmp = f"_gk_{i}"
+        while tmp in work.columns:  # never clobber a real input column
+            tmp += "_"
         work[tmp] = eval_expr(df, k) if len(df) > 0 else None
         key_rename[tmp] = name
         key_names.append(tmp)

@@ -226,7 +226,12 @@ def _eval(
         if f == "mod":
             lv, lm = _num_arg(_eval(cols, expr.args[0], nrows, dicts))
             rv, rm = _num_arg(_eval(cols, expr.args[1], nrows, dicts))
-            return jnp.mod(lv, rv), _and_masks(lm, rm)
+            # truncated modulo (sign of dividend), matching the host
+            # runners; x % 0 is NULL
+            m = _and_masks(lm, rm)
+            nz = rv != 0
+            m = nz if m is None else (m & nz)
+            return jnp.fmod(lv, jnp.where(nz, rv, 1)), m
         if f == "nullif":
             a = _eval(cols, expr.args[0], nrows, dicts)
             b = _eval(cols, expr.args[1], nrows, dicts)

@@ -456,15 +456,20 @@ def _select(env: Dict[str, object], q: ast.Select) -> Plan:
                     raise _GiveUp()
                 continue
             if isinstance(g, ast.Col):
-                if g.table is None and _cover(
-                    lambda item, _e: item.alias is not None
-                    and item.alias.lower() == g.name.lower()
+                # a real input column takes precedence over a select
+                # alias of the same folded name (host runner agrees);
+                # an ambiguous reference gives up so the host owns the
+                # error message
+                if g.table is None and not any(
+                    n.lower() == g.name.lower() for n in scope.row_names
                 ):
-                    continue
-                try:
-                    resolved = scope.resolve(g.name, g.table).lower()
-                except Exception:
+                    if _cover(
+                        lambda item, _e: item.alias is not None
+                        and item.alias.lower() == g.name.lower()
+                    ):
+                        continue
                     raise _GiveUp()
+                resolved = scope.resolve(g.name, g.table).lower()
 
                 def _same_col(item: ast.SelectItem, _e: ColumnExpr) -> bool:
                     if not isinstance(item.expr, ast.Col):
@@ -892,7 +897,6 @@ def _order_items(
     """ORDER BY entries resolved against the SELECT's OUTPUT columns
     (unqualified references and 1-based positions only — expression and
     qualified sort keys stay on the host runner)."""
-    lowered = {n.lower(): n for n in out_names}
     out: List[Tuple[str, bool, Optional[str]]] = []
     for o in items:
         e = o.expr
@@ -911,9 +915,13 @@ def _order_items(
                 # SQL semantics (review finding), so the host runner keeps
                 # this shape
                 raise _GiveUp()
-            name = lowered.get(e.name.lower())
-            if name is None:
-                raise _GiveUp()
+            if e.name in out_names:  # exact name wins, like the host
+                name = e.name
+            else:
+                folded = [n for n in out_names if n.lower() == e.name.lower()]
+                if len(folded) != 1:  # missing or case-ambiguous: host
+                    raise _GiveUp()
+                name = folded[0]
         else:
             raise _GiveUp()
         out.append((name, o.asc, o.nulls))

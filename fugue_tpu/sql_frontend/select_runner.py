@@ -22,6 +22,7 @@ from fugue_tpu.column.functions import (
     variance_ddof,
     variance_stat,
 )
+from fugue_tpu.column.pandas_eval import sql_fmod
 from fugue_tpu.schema import Schema
 from fugue_tpu.sql_frontend import ast
 from fugue_tpu.sql_frontend.parser import parse_select
@@ -129,7 +130,9 @@ class _Scope:
         ]
         return _Scope(frame, entries)
 
-    def resolve(self, name: str, qual: Optional[str]) -> _Entry:
+    def candidates(self, name: str, qual: Optional[str]) -> List[_Entry]:
+        """Exact-name matches, else case-insensitive matches (SQL
+        identifier folding). 0 = not found, >1 = ambiguous."""
         q = qual.lower() if qual is not None else None
         cands = [
             e for e in self.entries
@@ -141,6 +144,10 @@ class _Scope:
                 e for e in self.entries
                 if e.name.lower() == low and (q is None or e.qual == q)
             ]
+        return cands
+
+    def resolve(self, name: str, qual: Optional[str]) -> _Entry:
+        cands = self.candidates(name, qual)
         if len(cands) == 0:
             raise SQLExecutionError(f"column not found: {_qname(name, qual)}")
         if len(cands) > 1:
@@ -741,7 +748,7 @@ class _Evaluator:
             res = pd.to_numeric(left, errors="coerce").astype("float64") / \
                 pd.to_numeric(right, errors="coerce")
         elif op == "%":
-            res = pd.to_numeric(left) % pd.to_numeric(right)
+            res = sql_fmod(pd.to_numeric(left), pd.to_numeric(right))
         else:
             raise SQLExecutionError(f"unsupported operator {op}")
         return _TS(res, _arith_type(op, lts.dtype, rts.dtype))
@@ -929,7 +936,11 @@ def _num_fn(f: Callable[[pd.Series], pd.Series],
             out: Optional[pa.DataType] = pa.float64()) -> Callable:
     def impl(ev: _Evaluator, args: List[_TS]) -> _TS:
         s = pd.to_numeric(args[0].series, errors="coerce")
-        return _TS(f(s), out if out is not None else args[0].dtype)
+        # out-of-domain inputs (SQRT(-4), LN(0)) yield NaN by SQL intent,
+        # not as a numpy anomaly — keep -W error runs clean
+        with np.errstate(invalid="ignore", divide="ignore"):
+            res = f(s)
+        return _TS(res, out if out is not None else args[0].dtype)
     return impl
 
 
@@ -950,7 +961,7 @@ def _fn_power(ev: _Evaluator, args: List[_TS]) -> _TS:
 def _fn_mod(ev: _Evaluator, args: List[_TS]) -> _TS:
     a = pd.to_numeric(args[0].series, errors="coerce")
     b = pd.to_numeric(args[1].series, errors="coerce")
-    return _TS(a % b, args[0].dtype or pa.int64())
+    return _TS(sql_fmod(a, b), args[0].dtype or pa.int64())
 
 
 def _str_fn(f: Callable[[pd.Series], pd.Series],
@@ -2017,9 +2028,12 @@ def _eval_with_hook(
 
 
 def _resolve_groupby_expr(
-    g: ast.Expr, q: ast.Select
+    g: ast.Expr, q: ast.Select, scope: _Scope
 ) -> ast.Expr:
-    """GROUP BY ordinal or select alias resolves to the item's expression."""
+    """GROUP BY ordinal or select alias resolves to the item's expression.
+
+    A real input column takes precedence over a select alias of the same
+    (case-folded) name — Postgres/DuckDB resolution order."""
     if isinstance(g, ast.Lit) and isinstance(g.value, int) \
             and not isinstance(g.value, bool):
         idx = g.value - 1
@@ -2027,8 +2041,13 @@ def _resolve_groupby_expr(
             raise SQLExecutionError(f"GROUP BY ordinal {g.value} out of range")
         return q.items[idx].expr
     if isinstance(g, ast.Col) and g.table is None:
+        cands = scope.candidates(g.name, None)
+        if len(cands) > 1:
+            raise SQLExecutionError(f"ambiguous column: {_qname(g.name, None)}")
+        if len(cands) == 1:
+            return g  # input column wins over any same-named alias
         for it in q.items:
-            if it.alias == g.name:
+            if it.alias is not None and it.alias.lower() == g.name.lower():
                 return it.expr
     return g
 
@@ -2037,7 +2056,7 @@ def _run_agg_select(
     q: ast.Select, scope: _Scope, env: Optional[Dict[str, _Table]] = None
 ) -> Tuple[_Table, Callable[[ast.Expr], _TS]]:
     ctx = _AggContext(env)
-    ctx.key_exprs = [_resolve_groupby_expr(g, q) for g in q.group_by]
+    ctx.key_exprs = [_resolve_groupby_expr(g, q, scope) for g in q.group_by]
     for k in ctx.key_exprs:
         if _contains_agg(k):
             raise SQLExecutionError("GROUP BY cannot contain aggregations")
@@ -2143,9 +2162,15 @@ def _order_key(
         idx = e.value - 1
         if 0 <= idx < len(t.names):
             return _TS(t.frame.iloc[:, idx], t.types[idx])
-    if isinstance(e, ast.Col) and e.table is None and e.name in t.names:
-        idx = t.names.index(e.name)
-        return _TS(t.frame.iloc[:, idx], t.types[idx])
+    if isinstance(e, ast.Col) and e.table is None:
+        if e.name in t.names:
+            idx = t.names.index(e.name)
+            return _TS(t.frame.iloc[:, idx], t.types[idx])
+        # SQL identifiers fold case: ORDER BY k matches output column K
+        folded = [n.lower() for n in t.names]
+        if folded.count(e.name.lower()) == 1:
+            idx = folded.index(e.name.lower())
+            return _TS(t.frame.iloc[:, idx], t.types[idx])
     if resolver is not None:
         ts = resolver(e)
         return _TS(ts.series.reindex(t.frame.index), ts.dtype)

@@ -74,3 +74,31 @@ def test_case_when_infer_type():
 def test_case_when_arity_validation():
     with pytest.raises(Exception):
         ff.case_when(col("x") > 1, lit(1))  # no default
+
+
+def test_mod_truncated_semantics_column_algebra():
+    # SQL MOD follows the dividend's sign: MOD(-7, 3) = -1 (not 2)
+    from fugue_tpu.column import function
+
+    df = pd.DataFrame({"x": [-7, 7, -8]})
+    r = eval_expr(df, function("mod", col("x"), lit(3)))
+    assert list(r) == [-1, 1, -2]
+    r = eval_expr(df, function("mod", col("x"), lit(0)))
+    assert r.isna().all()  # MOD(x, 0) is NULL, silently
+
+
+def test_group_key_temp_name_no_clobber():
+    # a real input column literally named _gk_0 must survive key
+    # materialization for computed GROUP BY keys
+    import fugue_tpu.column.functions as fff
+    from fugue_tpu.column.pandas_eval import eval_select
+    from fugue_tpu.column.sql import SelectColumns
+
+    df = pd.DataFrame({"_gk_0": [10, 20, 30, 40], "x": [1, 1, 2, 2]})
+    cols = SelectColumns(
+        (col("x") + lit(0)).alias("g"),
+        fff.sum(col("_gk_0")).alias("s"),
+    )
+    out = eval_select(df, cols).sort_values("g").reset_index(drop=True)
+    assert list(out["g"]) == [1, 2]
+    assert list(out["s"]) == [30, 70]

@@ -55,7 +55,8 @@ def test_numeric_unary_on_device():
 
 def test_round_power_mod_on_device():
     _check(
-        "SELECT ROUND(v, 2) AS r, POWER(v, 2) AS p, MOD(n, 7) AS m FROM"
+        "SELECT ROUND(v, 2) AS r, POWER(v, 2) AS p, MOD(n, 7) AS m,"
+        " MOD(n - 50, 7) AS mn, MOD(n, 0) AS mz FROM"
     )
 
 

@@ -6,9 +6,14 @@ natively (``/root/reference/fugue_duckdb/execution_engine.py:238``)."""
 
 import numpy as np
 import pandas as pd
+import pytest
 
 from fugue_tpu.execution import make_execution_engine
 from fugue_tpu.workflow.api import raw_sql
+
+# the host oracle must reach NaN the same guarded way the device does —
+# any numpy warning here means the two paths disagree on how
+pytestmark = pytest.mark.filterwarnings("error::RuntimeWarning")
 
 
 def _df() -> pd.DataFrame:

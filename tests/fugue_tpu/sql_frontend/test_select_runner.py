@@ -354,3 +354,48 @@ def test_empty_input_group_by():
     )
     assert str(schema) == "k:str,s:long"
     assert rows == []
+
+
+def test_group_by_alias_case_insensitive():
+    # SQL identifiers fold case: GROUP BY k must match SELECT ... AS K
+    # when no real input column k exists
+    schema, rows = _run(
+        "SELECT v % 2 AS K, COUNT(*) AS c FROM a GROUP BY k ORDER BY k",
+        a=({"v": [1, 2, 3, 4]}, "v:long"),
+    )
+    assert str(schema) == "K:long,c:long"
+    assert rows == [[0, 2], [1, 2]]
+
+
+def test_group_by_real_column_beats_alias():
+    # Postgres/DuckDB resolution order: a real input column named k wins
+    # over the select alias K of a different expression
+    schema, rows = _run(
+        "SELECT k AS w, COUNT(*) AS c FROM a GROUP BY k ORDER BY k",
+        a=({"k": ["x", "y", "x", "z"], "v": [1, 2, 3, 4]}, "k:str,v:long"),
+    )
+    assert rows == [["x", 2], ["y", 1], ["z", 1]]
+
+
+def test_mod_truncated_semantics():
+    # SQL MOD follows the dividend's sign: MOD(-7, 3) = -1 (not 2);
+    # MOD(x, 0) is NULL, silently
+    schema, rows = _run(
+        "SELECT MOD(v, 3) AS m, v % 3 AS p, MOD(v, 0) AS z FROM a",
+        a=({"v": [-7, 7, -8]}, "v:long"),
+    )
+    assert [r[0] for r in rows] == [-1, 1, -2]
+    assert [r[1] for r in rows] == [-1, 1, -2]
+    assert [r[2] for r in rows] == [None, None, None]
+
+
+def test_group_by_ambiguous_column_raises():
+    # both join sides have a real k: GROUP BY k is ambiguous (Postgres/
+    # DuckDB raise), and must NOT silently bind a same-named select alias
+    with pytest.raises(SQLExecutionError, match="ambiguous"):
+        _run(
+            "SELECT a.v % 2 AS k, COUNT(*) AS c FROM a CROSS JOIN b"
+            " GROUP BY k",
+            a=({"k": ["x"], "v": [1]}, "k:str,v:long"),
+            b=({"k": ["y"], "w": [2]}, "k:str,w:long"),
+        )
