@@ -380,8 +380,6 @@ def _config4_cotransform() -> Dict[str, Any]:
     import numpy as np
     import pandas as pd
 
-    from fugue_tpu.collections.partition import PartitionSpec
-    from fugue_tpu.dataframe import DataFrames
     from fugue_tpu.execution import make_execution_engine
 
     groups = 2_000 if not _SMALL else 100
@@ -401,30 +399,57 @@ def _config4_cotransform() -> Dict[str, Any]:
         }
     )
 
-    def cm(cursor: Any, dfs: Any) -> Any:
-        from fugue_tpu.dataframe import ArrayDataFrame
-
-        va = dfs[0].as_pandas()
-        vb = dfs[1].as_pandas()
-        return ArrayDataFrame(
-            [[cursor.key_value_dict["k"],
-              float(va.v.sum() + (vb.w.sum() if len(vb) else 0.0))]],
-            "k:long,s:double",
+    def cm_pandas(dfa: pd.DataFrame, dfb: pd.DataFrame) -> pd.DataFrame:
+        va, vb = dfa, dfb
+        return pd.DataFrame(
+            {
+                "k": [int(va.k.iloc[0])],
+                "s": [float(va.v.sum() + (vb.w.sum() if len(vb) else 0.0))],
+            }
         )
 
-    def run(engine: Any) -> None:
-        da = engine.to_df(a)
-        db = engine.to_df(b)
-        z = engine.zip(
-            DataFrames(da, db), partition_spec=PartitionSpec(by=["k"])
+    import jax as _jax
+    import jax.numpy as jnp
+
+    def cm_jax(
+        da: Dict[str, _jax.Array], db: Dict[str, _jax.Array]
+    ) -> Dict[str, _jax.Array]:
+        # the compiled-comap ABI: per-key work as segment reductions over
+        # the shared segment space (comap_compiled.py)
+        S = da["_num_segments"]
+        sa = _jax.ops.segment_sum(
+            jnp.where(da["_row_valid"], da["v"], 0.0),
+            da["_segment_ids"], num_segments=S,
         )
-        engine.comap(
-            z, cm, "k:long,s:double", PartitionSpec(by=["k"])
-        ).as_local_bounded()
+        sb = _jax.ops.segment_sum(
+            jnp.where(db["_row_valid"], db["w"], 0.0),
+            db["_segment_ids"], num_segments=S,
+        )
+        k = _jax.ops.segment_max(
+            jnp.where(da["_row_valid"], da["k"].astype(jnp.int32), -(2**31)),
+            da["_segment_ids"], num_segments=S,
+        )
+        return {"k": k, "s": sa + sb}
+
+    def run(engine: Any, cm: Any) -> None:
+        from fugue_tpu.workflow import FugueWorkflow
+
+        dag = FugueWorkflow()
+        za = dag.df(a, "k:long,v:double")
+        zb = dag.df(b, "k:long,w:double")
+        z = za.partition_by("k").zip(zb)
+        z.transform(cm, schema="k:long,s:double").yield_dataframe_as(
+            "out", as_local=True
+        )
+        dag.run(engine)
 
     native = make_execution_engine("native")
     jax_e = make_execution_engine("jax")
-    return _pair(n, lambda: run(native), lambda: run(jax_e))
+    res = _pair(
+        n, lambda: run(native, cm_pandas), lambda: run(jax_e, cm_jax)
+    )
+    res["jax_fallbacks"] = dict(jax_e.fallbacks)
+    return res
 
 
 def _config5_e2e_parquet() -> Dict[str, Any]:

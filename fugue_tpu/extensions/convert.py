@@ -444,7 +444,7 @@ def _is_cotransform_func(func: Callable) -> bool:
     except TypeError:
         return False
     code = wrapper.input_code
-    dfs = "".join(c for c in code if c in "dlpqrRmMPQc")
+    dfs = "".join(c for c in code if c in "dlpqrRmMPQjc")  # mirrors _DF
     return code.startswith("c") or len(dfs) > 1
 
 

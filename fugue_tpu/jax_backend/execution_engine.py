@@ -937,18 +937,59 @@ class JaxExecutionEngine(ExecutionEngine):
         partition_spec: PartitionSpec,
         on_init: Optional[Callable] = None,
     ) -> DataFrame:
+        from fugue_tpu.jax_backend.comap_compiled import (
+            HostPathRequired,
+            compiled_comap,
+        )
         from fugue_tpu.jax_backend.zipped import (
             JaxZippedDataFrame,
             device_comap,
         )
 
         if isinstance(df, JaxZippedDataFrame):
+            raw = self._extract_cotransform_jax_func(map_func, len(df.frames))
+            if raw is not None:
+                runner = getattr(map_func, "__self__", None)
+                if getattr(runner, "ignore_errors", ()):
+                    # per-group error swallowing needs the host group loop
+                    self._count_fallback(
+                        "comap", "ignore_errors needs the host group loop"
+                    )
+                else:
+                    try:
+                        return compiled_comap(
+                            self, df, raw, output_schema, partition_spec,
+                            on_init,
+                        )
+                    except HostPathRequired as e:
+                        self._count_fallback("comap", str(e))
+                    except _StringDictUnavailable as e:
+                        self._count_fallback(
+                            "comap",
+                            f"string output '{e}' has no decode table",
+                        )
             return device_comap(
                 self, df, map_func, output_schema, partition_spec, on_init
             )
         return super().comap(
             df, map_func, output_schema, partition_spec, on_init
         )
+
+    def _extract_cotransform_jax_func(
+        self, map_func: Callable, n_members: int
+    ) -> Optional[Callable]:
+        """The raw user function behind a jax-annotated cotransformer: one
+        ``Dict[str, jax.Array]`` parameter per zipped member, dict output."""
+        runner = getattr(map_func, "__self__", None)
+        tf = getattr(runner, "transformer", None)
+        wrapper = getattr(tf, "wrapper", None)
+        if (
+            wrapper is not None
+            and wrapper.input_code == "j" * n_members
+            and wrapper.output_code == "j"
+        ):
+            return wrapper.func
+        return None
 
     def join(
         self,
