@@ -116,17 +116,38 @@ def _eval(df: pd.DataFrame, expr: ColumnExpr) -> pd.Series:
             pattern = expr.args[1]
             negated = expr.args[2]
             assert_or_throw(
-                isinstance(pattern, _LitColumnExpr)
-                and isinstance(pattern.value, str)
-                and isinstance(negated, _LitColumnExpr),
-                ValueError("LIKE needs a literal pattern"),
+                isinstance(negated, _LitColumnExpr),
+                ValueError("LIKE negation must be a literal"),
             )
-            rx = like_pattern_to_regex(pattern.value)
-            res = operand.astype("string").str.fullmatch(rx).astype("boolean")
-            if negated.value:
-                res = ~res
-            res[operand.isna()] = pd.NA  # NULL LIKE anything -> NULL
-            return res
+            if isinstance(pattern, _LitColumnExpr) and isinstance(
+                pattern.value, str
+            ):
+                rx = like_pattern_to_regex(pattern.value)
+                res = operand.astype("string").str.fullmatch(rx).astype(
+                    "boolean"
+                )
+                if negated.value:
+                    res = ~res
+                res[operand.isna()] = pd.NA  # NULL LIKE anything -> NULL
+                return res
+            # dynamic pattern: compile per DISTINCT pattern value;
+            # NULL on either side -> NULL
+            p = _eval(df, pattern)
+            cache: Dict[Any, Any] = {}
+            vals: List[Any] = []
+            for v, pv in zip(operand, p):
+                if pd.isna(v) or pd.isna(pv):
+                    vals.append(None)
+                    continue
+                crx = cache.get(pv)
+                if crx is None:
+                    crx = re.compile(like_pattern_to_regex(str(pv)))
+                    cache[pv] = crx
+                vals.append(crx.fullmatch(str(v)) is not None)
+            res = pd.Series(vals, index=df.index, dtype=object).astype(
+                "boolean"
+            )
+            return ~res if negated.value else res
         if f == "case_when":
             # cond/value pairs + default; NULL conditions don't match —
             # fill NA up front so one NULL condition can't poison the

@@ -202,9 +202,6 @@ def compiled_comap(
     if not all(b.all_on_device for b in blocks_list):
         raise HostPathRequired("comap member has host-resident columns")
 
-    if on_init is not None:
-        on_init(0, _empty_dfs(zdf))
-
     n_members = len(blocks_list)
     ps = [b.padded_nrows for b in blocks_list]
     if how == "cross":
@@ -332,7 +329,17 @@ def compiled_comap(
         nr_s = tuple(
             jax.ShapeDtypeStruct((), jnp.int32) for _ in nrows_args
         )
-        jax.eval_shape(_wrapped, shaped, rv_s, nr_s)
+        try:
+            jax.eval_shape(_wrapped, shaped, rv_s, nr_s)
+        except HostPathRequired:
+            raise
+        except Exception as ex:
+            # a function valid in the host's one-segment mode but not
+            # jit-traceable (float()/item()/data-dependent branching)
+            # belongs on the host group loop, not a trace crash
+            raise HostPathRequired(
+                f"cotransformer not jit-traceable ({type(ex).__name__})"
+            )
         cache[cache_key] = (jax.jit(_wrapped), stash)
     jitted, dict_stash = cache[cache_key]
     # every string output needs an fn-returned decode table: co-reduced
@@ -341,6 +348,10 @@ def compiled_comap(
         if pa.types.is_string(f.type) or pa.types.is_large_string(f.type):
             if f"_{f.name}_dict" not in dict_stash:
                 raise _StringDictUnavailable(f.name)
+    # past the last bail-out point: on_init runs exactly once per comap
+    # (the host-loop fallback has its own call — review finding)
+    if on_init is not None:
+        on_init(0, _empty_dfs(zdf))
     out, alive, cnt_alive, rv0, cnt0 = jitted(array_args, rvs, nrows_args)
 
     first = -1

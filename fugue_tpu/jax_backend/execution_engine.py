@@ -376,7 +376,10 @@ class JaxSQLEngine(PandasSQLEngine):
         return True
 
     def select(self, dfs: Any, statement: Any) -> DataFrame:
-        from fugue_tpu.sql_frontend.algebra_bridge import translate_query
+        from fugue_tpu.sql_frontend.algebra_bridge import (
+            inline_scalar_subqueries,
+            translate_query,
+        )
         from fugue_tpu.sql_frontend.parser import parse_select
 
         engine: "JaxExecutionEngine" = self.execution_engine  # type: ignore
@@ -384,7 +387,14 @@ class JaxSQLEngine(PandasSQLEngine):
         plan = None
         try:
             schemas = {name: list(df.schema.names) for name, df in dfs.items()}
-            plan = translate_query(parse_select(sql), schemas)
+            q = parse_select(sql)
+            # uncorrelated scalar subqueries run as device plans NOW and
+            # inline as literals (one scalar readback each); whatever
+            # stays un-inlined makes the outer translate give up below
+            inline_scalar_subqueries(
+                q, schemas, lambda p: self._exec_plan(p, dfs, {})
+            )
+            plan = translate_query(q, schemas)
         except Exception:
             plan = None
         if plan is not None:
@@ -424,6 +434,24 @@ class JaxSQLEngine(PandasSQLEngine):
                 how=plan.how,
                 on=list(plan.on),
             )
+        if isinstance(plan, ab.NotInJoinPlan):
+            l_df: JaxDataFrame = engine.to_df(
+                self._exec_plan(plan.left, dfs, done)
+            )  # type: ignore[assignment]
+            r_df: JaxDataFrame = engine.to_df(
+                self._exec_plan(plan.right, dfs, done)
+            )  # type: ignore[assignment]
+            l_df, r_df = engine._align_meshes(l_df, r_df)
+            assert_or_throw(
+                relational.device_joinable(
+                    l_df.blocks, r_df.blocks, [plan.key], [plan.key]
+                ),
+                ValueError("NOT IN key not device-resident"),
+            )
+            out = relational.not_in_join(
+                engine, l_df.blocks, r_df.blocks, [plan.key]
+            )
+            return JaxDataFrame(out, l_df.schema)
         if isinstance(plan, ab.SetPlan):
             left = self._exec_plan(plan.left, dfs, done)
             right = self._exec_plan(plan.right, dfs, done)
@@ -648,15 +676,20 @@ class JaxExecutionEngine(ExecutionEngine):
         jdf = self.to_df(df)
         resolved = cols.replace_wildcard(jdf.schema).assert_all_with_names()
         if self._can_select_on_device(jdf, resolved, where, having):
-            out_schema = resolved.infer_schema(jdf.schema)
-            filtered = jdf if where is None else self.filter(jdf, where)
-            if not resolved.has_agg:
-                return self._device_project(filtered, resolved, out_schema)  # type: ignore
-            res = self._device_groupby_select(
-                filtered, resolved, out_schema, having  # type: ignore
-            )
-            if res is not None:
-                return res
+            try:
+                out_schema = resolved.infer_schema(jdf.schema)
+                filtered = jdf if where is None else self.filter(jdf, where)
+                if not resolved.has_agg:
+                    return self._device_project(filtered, resolved, out_schema)  # type: ignore
+                res = self._device_groupby_select(
+                    filtered, resolved, out_schema, having  # type: ignore
+                )
+                if res is not None:
+                    return res
+            except NotImplementedError:
+                # size-capped lowerings (dynamic-LIKE LUTs, composed
+                # CONCAT dictionaries) surface at build time: host owns
+                pass
         # fallback gets the ORIGINAL frame + where (avoid double filtering)
         self._count_fallback("select")
         return self.to_df(
@@ -690,24 +723,27 @@ class JaxExecutionEngine(ExecutionEngine):
                 keep = keep & row_valid
                 return keep, jnp.sum(keep).astype(jnp.int32)
 
-            keep, cnt = self._jit_cached(
-                ("filter", condition.__uuid__(), pad_n,
-                 expr_eval.dict_fingerprint(blocks)), _filter_prog
-            )(
-                expr_eval.blocks_to_masked(blocks),
-                blocks.row_valid,
-                _nrows_arg(blocks),
-            )
-            return JaxDataFrame(
-                JaxBlocks(
-                    None,
-                    dict(blocks.columns),
-                    blocks.mesh,
-                    row_valid=keep,
-                    nrows_dev=cnt,
-                ),
-                jdf.schema,
-            )
+            try:
+                keep, cnt = self._jit_cached(
+                    ("filter", condition.__uuid__(), pad_n,
+                     expr_eval.dict_fingerprint(blocks)), _filter_prog
+                )(
+                    expr_eval.blocks_to_masked(blocks),
+                    blocks.row_valid,
+                    _nrows_arg(blocks),
+                )
+                return JaxDataFrame(
+                    JaxBlocks(
+                        None,
+                        dict(blocks.columns),
+                        blocks.mesh,
+                        row_valid=keep,
+                        nrows_dev=cnt,
+                    ),
+                    jdf.schema,
+                )
+            except NotImplementedError:
+                pass  # size-capped lowering surfaced at build time
         self._count_fallback("filter")
         return self.to_df(self._native.filter(jdf.as_local_bounded(), condition))
 

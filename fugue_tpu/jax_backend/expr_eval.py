@@ -16,7 +16,7 @@ jit cache keys at the call sites must include ``dict_fingerprint``.
 """
 
 import re
-from typing import Any, Dict, NamedTuple, Optional, Tuple, Union
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -54,6 +54,29 @@ class _StrLit(NamedTuple):
 _Value = Union[Masked, _Str, _StrLit]
 
 _CMP_OPS = ("==", "!=", "<", "<=", ">", ">=")
+
+# caps for host-built pairwise-dictionary tables (dynamic LIKE LUTs,
+# composed CONCAT dictionaries): beyond this the host work/memory stops
+# being "proportional to the dictionaries" and the host runner wins
+_MAX_PAIR_LUT = 1 << 20
+_MAX_COMPOSED_DICT = 1 << 18
+
+
+def _like_literal(operand: "_Str", pattern: str, negated: bool) -> Masked:
+    """LIKE against one literal pattern: a 1D dictionary LUT + gather."""
+    rx = re.compile(like_pattern_to_regex(pattern))
+    d = operand.dictionary
+    lut = np.fromiter(
+        (rx.fullmatch(str(x)) is not None for x in d),
+        dtype=bool,
+        count=len(d),
+    )
+    if len(lut) == 0:
+        lut = np.zeros(1, dtype=bool)
+    hit = jnp.asarray(lut)[jnp.clip(operand.codes, 0, len(lut) - 1)]
+    if negated:
+        hit = ~hit
+    return hit, operand.mask
 
 
 def _valid(m: Masked) -> jnp.ndarray:
@@ -152,26 +175,44 @@ def _eval(
             neg = expr.args[2]
             assert_or_throw(
                 isinstance(operand, _Str)
-                and isinstance(pat, _LitColumnExpr)
-                and isinstance(pat.value, str)
                 and isinstance(neg, _LitColumnExpr),
-                NotImplementedError("LIKE needs a string column + literal"),
+                NotImplementedError("LIKE needs a string column"),
             )
-            rx = re.compile(like_pattern_to_regex(pat.value))
-            d = operand.dictionary
-            lut = np.fromiter(
-                (rx.fullmatch(str(x)) is not None for x in d),
-                dtype=bool,
-                count=len(d),
+            if isinstance(pat, _LitColumnExpr) and isinstance(
+                pat.value, str
+            ):
+                return _like_literal(operand, pat.value, bool(neg.value))
+            # dynamic pattern COLUMN: the result depends only on the
+            # (value code, pattern code) pair — one host-built 2D LUT
+            # over the two dictionaries, one device gather
+            pv = _eval(cols, pat, nrows, dicts)
+            if isinstance(pv, _StrLit):
+                return _like_literal(operand, pv.value, bool(neg.value))
+            assert_or_throw(
+                isinstance(pv, _Str),
+                NotImplementedError("LIKE pattern must be a string"),
             )
-            if len(lut) == 0:
-                lut = np.zeros(1, dtype=bool)
-            hit = jnp.asarray(lut)[
-                jnp.clip(operand.codes, 0, len(lut) - 1)
-            ]
+            do, dp = operand.dictionary, pv.dictionary
+            no, np_ = max(len(do), 1), max(len(dp), 1)
+            assert_or_throw(
+                no * np_ <= _MAX_PAIR_LUT,
+                NotImplementedError("dynamic LIKE dictionaries too large"),
+            )
+            lut2 = np.zeros((no, np_), dtype=bool)
+            for j, p in enumerate(dp):
+                rxp = re.compile(like_pattern_to_regex(str(p)))
+                lut2[: len(do), j] = np.fromiter(
+                    (rxp.fullmatch(str(x)) is not None for x in do),
+                    dtype=bool,
+                    count=len(do),
+                )
+            flat = jnp.asarray(lut2.reshape(-1))
+            oi = jnp.clip(operand.codes, 0, no - 1)
+            pj = jnp.clip(pv.codes, 0, np_ - 1)
+            hit = flat[oi * np_ + pj]
             if neg.value:
                 hit = ~hit
-            return hit, operand.mask
+            return hit, _and_masks(operand.mask, pv.mask)
         if f == "case_when":
             raws = [_eval(cols, a, nrows, dicts) for a in expr.args]
             if any(isinstance(a, (_Str, _StrLit)) for a in raws):
@@ -381,24 +422,47 @@ def _dict_transform_eval(
     """String scalar functions as pure dictionary rewrites: the codes and
     mask pass through, the decode table is transformed on the host."""
     if f == "concat":
-        # exactly one string COLUMN, any number of string literals —
-        # the result dictionary is prefix + entry + suffix
+        # any mix of string COLUMNS and literals. One column: the result
+        # dictionary is prefix + entry + suffix. Multiple columns: the
+        # result dictionary is the (capped) cross product of the column
+        # dictionaries and the codes compose in mixed radix — still pure
+        # dictionary rewriting, host work proportional to the product of
+        # the dictionaries, zero extra device passes.
         parts = [_eval(cols, a, nrows, dicts) for a in expr.args]
         strs = [p for p in parts if isinstance(p, _Str)]
         if len(strs) == 0 and all(isinstance(p, _StrLit) for p in parts):
             return _StrLit("".join(p.value for p in parts))
-        if len(strs) != 1 or not all(
-            isinstance(p, (_Str, _StrLit)) for p in parts
-        ):
-            raise NotImplementedError("CONCAT over multiple string columns")
-        src = strs[0]
-        idx = parts.index(src)
-        pre = "".join(p.value for p in parts[:idx])  # type: ignore[union-attr]
-        post = "".join(p.value for p in parts[idx + 1:])  # type: ignore[union-attr]
-        nd = np.array(
-            [pre + str(x) + post for x in src.dictionary], dtype=object
+        if not all(isinstance(p, (_Str, _StrLit)) for p in parts):
+            raise NotImplementedError("CONCAT over non-string values")
+        if len(strs) == 1:
+            src = strs[0]
+            idx = parts.index(src)
+            pre = "".join(
+                p.value for p in parts[:idx]  # type: ignore[union-attr]
+            )
+            post = "".join(
+                p.value for p in parts[idx + 1:]  # type: ignore[union-attr]
+            )
+            nd = np.array(
+                [pre + str(x) + post for x in src.dictionary], dtype=object
+            )
+            return _Str(src.codes, src.mask, nd)
+        # codes in mixed radix, row-major over the columns in order —
+        # matching _compose_concat_dictionary's enumeration exactly
+        code: Any = None
+        mask: Optional[jnp.ndarray] = None
+        for p in strs:
+            sz = max(len(p.dictionary), 1)
+            c = jnp.clip(p.codes, 0, sz - 1)
+            code = c if code is None else code * sz + c
+            mask = _and_masks(mask, p.mask)
+        tmpl = [
+            p.value if isinstance(p, _StrLit) else None for p in parts
+        ]
+        nd = _compose_concat_dictionary(
+            tmpl, [p.dictionary for p in strs]
         )
-        return _Str(src.codes, src.mask, nd)
+        return _Str(code, mask, nd)
     operand = _eval(cols, expr.args[0], nrows, dicts)
     assert_or_throw(
         isinstance(operand, _Str),
@@ -570,6 +634,32 @@ def can_eval_on_device(expr: ColumnExpr, blocks: JaxBlocks) -> bool:
     return kind == "str" and expr.as_type is None and _dict_chain_ok(expr)
 
 
+def _compose_concat_dictionary(
+    tmpl: List[Optional[str]], dicts_: List[np.ndarray]
+) -> np.ndarray:
+    """The decode table of a multi-column CONCAT: the cross product of
+    the column dictionaries (row-major over the columns in order —
+    matching the mixed-radix code composition), with literal fragments
+    interleaved per the template (None marks a column slot)."""
+    import itertools
+
+    total = 1
+    for d in dicts_:
+        total *= max(len(d), 1)
+    assert_or_throw(
+        total <= _MAX_COMPOSED_DICT,
+        NotImplementedError("CONCAT dictionaries too large to compose"),
+    )
+    parts = list(tmpl)
+    col_idx = [i for i, t in enumerate(parts) if t is None]
+    nd = np.full(total, "", dtype=object)  # empty dicts: all-masked
+    for flat, combo in enumerate(itertools.product(*dicts_)):
+        for i, v in zip(col_idx, combo):
+            parts[i] = str(v)
+        nd[flat] = "".join(parts)  # type: ignore[arg-type]
+    return nd
+
+
 def _dict_chain_ok(expr: ColumnExpr) -> bool:
     """Structural mirror of ``_walk_dict`` with no dictionary work —
     ``can_eval_on_device`` uses it so the decode table is only built by
@@ -584,7 +674,7 @@ def _dict_chain_ok(expr: ColumnExpr) -> bool:
             subs = [
                 a for a in expr.args if not isinstance(a, _LitColumnExpr)
             ]
-            return len(subs) == 1 and _dict_chain_ok(subs[0])
+            return len(subs) >= 1 and all(_dict_chain_ok(s) for s in subs)
         if f in _DICT_TRANSFORMS or f in ("substring", "substr", "replace"):
             return _dict_chain_ok(expr.args[0])
     return False
@@ -613,21 +703,39 @@ def _walk_dict(expr: ColumnExpr, blocks: JaxBlocks) -> np.ndarray:
     if isinstance(expr, _FuncExpr):
         f = expr.func.lower()
         if f == "concat":
-            src_i = -1
+            str_idx = [
+                i
+                for i, a in enumerate(expr.args)
+                if _check(a, blocks) == "str"
+            ]
+            if len(str_idx) == 1:
+                src_i = str_idx[0]
+                pre = "".join(
+                    a.value  # type: ignore[union-attr]
+                    for a in expr.args[:src_i]
+                )
+                post = "".join(
+                    a.value  # type: ignore[union-attr]
+                    for a in expr.args[src_i + 1:]
+                )
+                inner = _walk_dict(expr.args[src_i], blocks)
+                return np.array(
+                    [pre + str(x) + post for x in inner], dtype=object
+                )
+            # multi-column: composed cross-product dictionary, SAME
+            # enumeration as _eval's mixed-radix code composition
             for i, a in enumerate(expr.args):
-                if _check(a, blocks) == "str":
-                    src_i = i
-            pre = "".join(
-                a.value  # type: ignore[union-attr]
-                for a in expr.args[:src_i]
-            )
-            post = "".join(
-                a.value  # type: ignore[union-attr]
-                for a in expr.args[src_i + 1:]
-            )
-            inner = _walk_dict(expr.args[src_i], blocks)
-            return np.array(
-                [pre + str(x) + post for x in inner], dtype=object
+                if i not in str_idx and not (
+                    isinstance(a, _LitColumnExpr)
+                    and isinstance(a.value, str)
+                ):
+                    raise NotImplementedError("non-literal CONCAT filler")
+            tmpl = [
+                None if i in str_idx else a.value  # type: ignore[union-attr]
+                for i, a in enumerate(expr.args)
+            ]
+            return _compose_concat_dictionary(
+                tmpl, [_walk_dict(expr.args[i], blocks) for i in str_idx]
             )
         if f == "nullif":
             return _walk_dict(expr.args[0], blocks)
@@ -696,7 +804,12 @@ def _check(expr: ColumnExpr, blocks: JaxBlocks) -> str:
                 isinstance(expr.args[1], _LitColumnExpr)
                 and isinstance(expr.args[1].value, str)
             ):
-                raise NotImplementedError("LIKE needs a literal pattern")
+                # dynamic pattern: any string expression works (the
+                # evaluator builds a pairwise-dictionary LUT, capped)
+                if _check(expr.args[1], blocks) not in ("str", "strlit"):
+                    raise NotImplementedError(
+                        "LIKE pattern must be a string"
+                    )
             return "num"
         if f == "case_when":
             for a in expr.args:
@@ -750,12 +863,11 @@ def _check(expr: ColumnExpr, blocks: JaxBlocks) -> str:
             kinds = [_check(a, blocks) for a in expr.args]
             if any(k == "num" for k in kinds):
                 raise NotImplementedError("CONCAT of non-strings")
-            n_str = sum(1 for k in kinds if k == "str")
-            if n_str == 0:
+            if all(k == "strlit" for k in kinds):
                 return "strlit"
-            if n_str == 1:
-                return "str"
-            raise NotImplementedError("CONCAT over multiple string columns")
+            # one or more string columns: dictionary rewrite (multiple
+            # columns compose a capped cross-product dictionary)
+            return "str"
         raise NotImplementedError(expr.func)
     raise NotImplementedError(str(expr))
 

@@ -256,6 +256,65 @@ def semi_anti_join(
     )
 
 
+@_mesh_scoped(1)
+def not_in_join(
+    engine: Any, b1: JaxBlocks, b2: JaxBlocks, keys: List[str]
+) -> JaxBlocks:
+    """``WHERE x NOT IN (SELECT y ...)`` as a mask-only device op with
+    SQL's three-valued semantics (the host oracle:
+    select_runner._in_subquery): an EMPTY right side keeps every row
+    (even a NULL x); ANY null right value keeps none (the comparison is
+    never TRUE); otherwise keep non-null, non-matching rows. Zero host
+    syncs — the count stays lazy like semi/anti."""
+    sf = shared_factorize(b1, b2, keys)
+    S = max(sf.num_segments, 1)
+    null1 = _null_any_mask(b1, keys)
+    null2 = _null_any_mask(b2, keys)
+    p1 = b1.padded_nrows
+
+    def _prog(
+        seg1: Any,
+        seg2: Any,
+        v2: Any,
+        n2m: Optional[Any],
+        rv1: Optional[Any],
+        n1m: Optional[Any],
+        nrows1: Any,
+    ) -> Tuple[Any, Any]:
+        valid1 = groupby.materialize_validity(rv1, p1, nrows1)
+        empty2 = jnp.sum(v2.astype(jnp.int32)) == 0
+        if n2m is None:
+            any_null2 = jnp.asarray(False)
+            match2 = v2
+        else:
+            any_null2 = jnp.sum((v2 & n2m).astype(jnp.int32)) > 0
+            match2 = v2 & ~n2m
+        c2 = jax.ops.segment_sum(
+            match2.astype(jnp.int32),
+            jnp.where(match2, seg2, S),
+            num_segments=S,
+        )
+        hit = c2[jnp.clip(seg1, 0, S - 1)] > 0
+        notnull1 = valid1 if n1m is None else (valid1 & ~n1m)
+        keep = valid1 & (empty2 | (notnull1 & ~any_null2 & ~hit))
+        return keep, jnp.sum(keep).astype(jnp.int32)
+
+    keep, cnt = engine._jit_cached(
+        ("not_in", S, p1, b2.padded_nrows, tuple(keys)), _prog
+    )(
+        sf.seg1,
+        sf.seg2,
+        b2.validity(),
+        null2,
+        b1.row_valid,
+        null1,
+        _nrows_arg(b1),
+    )
+    return JaxBlocks(
+        None, dict(b1.columns), b1.mesh, row_valid=keep, nrows_dev=cnt
+    )
+
+
 # ---------------------------------------------------------------------------
 # inner / left_outer (right/full build on these)
 # ---------------------------------------------------------------------------

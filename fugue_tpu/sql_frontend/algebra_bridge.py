@@ -10,12 +10,16 @@ relational ops), including windows (``WindowPlan``): the ranking
 family, whole-partition / running / framed aggregates over the FULL
 frame matrix (ROWS, GROUPS, RANGE incl. numeric offsets), LAG/LEAD and
 FIRST/LAST/NTH_VALUE; multiset set ops; DISTINCT and variance/median
-aggregates; HAVING; string predicates, LIKE, CASE and the scalar
-function library; uncorrelated ``col IN (SELECT ...)`` WHERE conjuncts
-and equi-correlated ``[NOT] EXISTS`` predicates as device SEMI/ANTI
-joins. Returns ``None`` for anything outside the supported shape
-(non-equi joins and correlations, scalar subqueries, NOT IN
-subqueries, oversized frame offsets, dynamic LIKE patterns) so callers
+aggregates; HAVING; string predicates, LIKE (literal AND dynamic
+column-valued patterns via pairwise-dictionary LUTs), CASE and the
+scalar function library incl. multi-column CONCAT (composed
+cross-product dictionaries); uncorrelated ``col [NOT] IN (SELECT ...)``
+WHERE conjuncts as device SEMI / 3VL-anti joins, equi-correlated
+``[NOT] EXISTS`` as device SEMI/ANTI joins, and uncorrelated scalar
+subqueries inlined as device-computed literals
+(:func:`inline_scalar_subqueries`). Returns ``None`` for anything
+outside the supported shape (non-equi joins and correlations,
+oversized frame offsets, over-cap dictionary compositions) so callers
 fall back to the host SELECT runner.
 
 Name scoping is tracked per relation (each plan node knows its output
@@ -33,9 +37,11 @@ from fugue_tpu.sql_frontend import ast
 
 __all__ = [
     "translate_query",
+    "inline_scalar_subqueries",
     "Plan",
     "ScanPlan",
     "JoinPlan",
+    "NotInJoinPlan",
     "SetPlan",
     "SelectPlan",
     "WindowPlan",
@@ -118,6 +124,23 @@ class JoinPlan(Plan):
                 self._sql_names = list(left.sql_row_names) + list(
                     right.sql_row_names
                 )
+
+    @property
+    def sql_row_names(self) -> List[str]:
+        return self._sql_names
+
+
+class NotInJoinPlan(Plan):
+    """``WHERE x NOT IN (SELECT ...)`` — an anti-join variant with SQL's
+    three-valued NOT IN semantics (relational.not_in_join). Keeps the
+    left frame's columns/visibility like semi/anti."""
+
+    def __init__(self, left: Plan, right: Plan, key: str):
+        self.left = left
+        self.right = right
+        self.key = key
+        self.out_names = list(left.out_names)
+        self._sql_names = list(left.sql_row_names)
 
     @property
     def sql_row_names(self) -> List[str]:
@@ -254,6 +277,187 @@ class _Scope:
         if len(hits) != 1:
             raise _GiveUp()
         return hits[0]
+
+
+def inline_scalar_subqueries(
+    q: ast.Node,
+    df_schemas: Dict[str, Sequence[str]],
+    run_plan: Any,  # Callable[[Plan], DataFrame-like]
+) -> None:
+    """Pre-pass: replace each UNCORRELATED scalar subquery whose body
+    lowers to a device plan with the literal value computed on device
+    (one scalar readback — the data never leaves the device). The
+    rewritten outer query then lowers as usual, so e.g.
+    ``WHERE v > (SELECT AVG(v) FROM t)`` runs entirely in-engine (the
+    reference executes all SQL in-engine,
+    /root/reference/fugue_duckdb/execution_engine.py:37-135).
+
+    Non-lowerable, correlated, multi-row or exotic-typed subqueries stay
+    in the tree — the host runner owns those (including the proper
+    "more than one row" error). Mutates ``q`` in place (the ast is
+    parsed fresh per statement).
+
+    Guards (review findings): a subquery referencing a name any CTE
+    shadows is never inlined (the base-table value would silently
+    diverge from the host's CTE-scoped one), and nothing executes until
+    a cheap placeholder probe shows the OUTER query would lower — a
+    host-destined statement must not pay device subquery runs it will
+    redo on the host."""
+    import copy
+
+    cte_names: Set[str] = set()
+    subq_count = 0
+
+    def _scan(node: Any) -> None:
+        nonlocal subq_count
+        if isinstance(node, ast.With):
+            cte_names.update(name.lower() for name, _ in node.ctes)
+        if isinstance(node, ast.ScalarSubquery):
+            subq_count += 1
+        if isinstance(node, ast.Node):
+            for f in node._fields:
+                _scan_val(getattr(node, f))
+
+    def _scan_val(v: Any) -> None:
+        if isinstance(v, ast.Node):
+            _scan(v)
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                _scan_val(x)
+
+    _scan(q)
+    if subq_count == 0:
+        return
+    # probe: would the outer query lower with the subqueries replaced by
+    # placeholder literals? (numeric and string shapes both tried — the
+    # value's kind can decide lowerability)
+    probe_ok = False
+    for ph in (ast.Lit(0), ast.Lit("")):
+        qc = copy.deepcopy(q)
+
+        def _stub(node: Any) -> Any:
+            if isinstance(node, ast.ScalarSubquery):
+                return copy.deepcopy(ph)
+            if isinstance(node, ast.Node):
+                for f in node._fields:
+                    setattr(node, f, _stub_val(getattr(node, f)))
+            return node
+
+        def _stub_val(v: Any) -> Any:
+            if isinstance(v, ast.Node):
+                return _stub(v)
+            if isinstance(v, list):
+                return [_stub_val(x) for x in v]
+            if isinstance(v, tuple):
+                return tuple(_stub_val(x) for x in v)
+            return v
+
+        if translate_query(_stub(qc), df_schemas) is not None:
+            probe_ok = True
+            break
+    if not probe_ok:
+        return
+
+    def _references_cte(sub: ast.Node) -> bool:
+        found = False
+
+        def _walk_refs(node: Any) -> None:
+            nonlocal found
+            if isinstance(node, ast.TableRef):
+                if node.name.lower() in cte_names:
+                    found = True
+            if isinstance(node, ast.Node):
+                for f in node._fields:
+                    _walk_refs_val(getattr(node, f))
+
+        def _walk_refs_val(v: Any) -> None:
+            if isinstance(v, ast.Node):
+                _walk_refs(v)
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    _walk_refs_val(x)
+
+        _walk_refs(sub)
+        return found
+
+    def _rewrite(node: Any) -> Any:
+        if isinstance(node, ast.ScalarSubquery):
+            if cte_names and _references_cte(node.query):
+                return node  # a CTE shadows the name: host scoping wins
+            if (
+                isinstance(node.query, ast.Select)
+                and len(node.query.items) == 1
+                and node.query.items[0].alias is None
+                and not isinstance(node.query.items[0].expr, ast.Star)
+            ):
+                # the bridge needs named computed columns; the name is
+                # never visible to the outer query (harmless on host too)
+                node.query.items[0].alias = "__scalar__"
+            plan = translate_query(node.query, df_schemas)
+            if plan is None or len(plan.out_names) != 1:
+                return node
+            try:
+                res = run_plan(plan)
+                n = res.count()
+                if n > 1:
+                    return node  # host raises the >1-row error
+                v = None if n == 0 else res.as_array()[0][0]
+                tp = res.schema.fields[0].type
+            except Exception:
+                return node
+            if v is not None and hasattr(v, "item"):
+                v = v.item()
+            if isinstance(v, float) and v != v:
+                v = None  # NaN payload -> SQL NULL
+            if v is None:
+                # a bare NULL literal is typeless; the host's scalar
+                # subquery carries the subquery's dtype — cast to match
+                tn = _sql_type_name(tp)
+                return (
+                    ast.Cast(ast.Lit(None), tn) if tn is not None else node
+                )
+            if isinstance(v, (bool, int, float, str)):
+                return ast.Lit(v)
+            return node  # exotic value type: host owns it
+        if isinstance(node, ast.Node):
+            for f in node._fields:
+                setattr(node, f, _walk(getattr(node, f)))
+        return node
+
+    def _walk(v: Any) -> Any:
+        if isinstance(v, ast.Node):
+            return _rewrite(v)
+        if isinstance(v, list):
+            return [_walk(x) for x in v]
+        if isinstance(v, tuple):
+            return tuple(_walk(x) for x in v)
+        return v
+
+    _rewrite(q)
+
+
+def _sql_type_name(tp: Any) -> Optional[str]:
+    """SQL type name for a pyarrow type (inverse of the parsers'
+    _SQL_TYPES for the types a scalar subquery can produce)."""
+    import pyarrow as pa
+
+    if pa.types.is_float64(tp):
+        return "double"
+    if pa.types.is_float32(tp):
+        return "float"
+    if pa.types.is_int64(tp):
+        return "long"
+    if pa.types.is_int32(tp):
+        return "int"
+    if pa.types.is_int16(tp):
+        return "smallint"
+    if pa.types.is_int8(tp):
+        return "tinyint"
+    if pa.types.is_boolean(tp):
+        return "boolean"
+    if pa.types.is_string(tp) or pa.types.is_large_string(tp):
+        return "string"
+    return None
 
 
 def translate_query(
@@ -515,19 +719,17 @@ def _lower_in_subqueries(
     where: ast.Expr,
 ) -> Tuple[Plan, Optional[ast.Expr]]:
     """Uncorrelated ``col IN (SELECT ...)`` WHERE conjuncts become
-    device SEMI joins against the translated subquery. NULL semantics
-    match exactly: in a WHERE context a no-match NULL filters the row
-    just like FALSE, and null keys never join. ``NOT IN`` stays on the
-    host — with any NULL on the right it is never TRUE, which an ANTI
-    join cannot express."""
+    device SEMI joins against the translated subquery; ``col NOT IN
+    (SELECT ...)`` becomes a :class:`NotInJoinPlan` — an anti-join
+    variant carrying SQL's three-valued NOT IN semantics (any NULL on
+    the right keeps nothing; an empty right keeps everything). NULL
+    semantics of the IN form match exactly: in a WHERE context a
+    no-match NULL filters the row just like FALSE, and null keys never
+    join."""
 
     remaining: List[ast.Expr] = []
     for c in _split_conjuncts(where):
-        if (
-            isinstance(c, ast.InSubquery)
-            and not c.negated
-            and isinstance(c.operand, ast.Col)
-        ):
+        if isinstance(c, ast.InSubquery) and isinstance(c.operand, ast.Col):
             sub = _query(env, c.query)  # correlated refs -> _GiveUp
             if len(sub.out_names) != 1:
                 raise _GiveUp()  # the host owns the arity error
@@ -539,7 +741,10 @@ def _lower_in_subqueries(
                     SelectColumns(col(inner).alias(keyname)),
                     None, None, [], None, None, False, [keyname],
                 )
-            source = JoinPlan(source, sub, "semi", [keyname])
+            if c.negated:
+                source = NotInJoinPlan(source, sub, keyname)
+            else:
+                source = JoinPlan(source, sub, "semi", [keyname])
             continue
         ex = _exists_form(c)
         if ex is not None:
@@ -1022,12 +1227,21 @@ def _expr(e: ast.Expr, scope: _Scope) -> ColumnExpr:
             raise _GiveUp()
         return ~res if e.negated else res
     if isinstance(e, ast.Like):
-        if not isinstance(e.pattern, ast.Lit) or not isinstance(
+        if isinstance(e.pattern, ast.Lit) and isinstance(
             e.pattern.value, str
         ):
-            raise _GiveUp()  # dynamic patterns: host runner
-        return ff.like(
-            _expr(e.operand, scope), e.pattern.value, negated=e.negated
+            return ff.like(
+                _expr(e.operand, scope), e.pattern.value, negated=e.negated
+            )
+        # dynamic (column-valued) pattern: engine-interpreted LIKE over
+        # two expressions — on device a (value-dict x pattern-dict) LUT
+        from fugue_tpu.column.expressions import function
+
+        return function(
+            "like",
+            _expr(e.operand, scope),
+            _expr(e.pattern, scope),
+            lit(bool(e.negated)),
         )
     if isinstance(e, ast.Case):
         args: List[ColumnExpr] = []

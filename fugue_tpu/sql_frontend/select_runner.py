@@ -790,18 +790,34 @@ class _Evaluator:
 
     def _like(self, e: ast.Like) -> _TS:
         ts = self.eval(e.operand)
-        pat = self.eval(e.pattern)
-        if not isinstance(e.pattern, ast.Lit):
-            raise SQLExecutionError("LIKE pattern must be a literal")
-        regex = _like_to_regex(str(e.pattern.value))
         s = ts.series.astype(object)
         nulls = s.isna()
-        matched = s.where(nulls, s.astype(str).str.match(regex, na=False))
-        res = matched.astype("boolean")
+        if isinstance(e.pattern, ast.Lit):
+            regex = _like_to_regex(str(e.pattern.value))
+            matched = s.where(nulls, s.astype(str).str.match(regex, na=False))
+            res = matched.astype("boolean")
+        else:
+            # dynamic (column-valued) pattern: compile per DISTINCT
+            # pattern value; NULL pattern -> NULL like any comparison
+            p = self.eval(e.pattern).series
+            nulls = nulls | p.isna()
+            cache: Dict[Any, Any] = {}
+            vals: List[Any] = []
+            for v, pv in zip(s, p):
+                if pd.isna(v) or pd.isna(pv):
+                    vals.append(None)
+                    continue
+                rx = cache.get(pv)
+                if rx is None:
+                    rx = re.compile(_like_to_regex(str(pv)))
+                    cache[pv] = rx
+                vals.append(rx.match(str(v)) is not None)
+            res = pd.Series(vals, index=s.index, dtype=object).astype(
+                "boolean"
+            )
         if e.negated:
             res = ~res
         res[nulls.to_numpy(dtype=bool)] = pd.NA
-        del pat
         return _TS(res, pa.bool_())
 
     def _case(self, e: ast.Case) -> _TS:

@@ -252,3 +252,30 @@ def test_ignore_errors_counts_fallback():
     res = e.comap(z, runner.run, "k:long,s:double", PartitionSpec(by=["k"]))
     assert sorted(map(tuple, res.as_array())) == [(1, 11.0), (2, 25.0)]
     assert e.fallbacks.get("comap", 0) == 1, e.fallbacks
+
+
+def test_untraceable_cotransformer_falls_back_to_host_loop():
+    # valid in the host's one-segment mode but not jit-traceable
+    # (data-dependent float()): host group loop, counted fallback
+    from fugue_tpu.extensions.builtins import _CoTransformerRunner
+    from fugue_tpu.extensions.convert import _to_transformer
+
+    def cm_concrete(
+        a: Dict[str, jax.Array], b: Dict[str, jax.Array]
+    ) -> Dict[str, jax.Array]:
+        total = float(jnp.sum(jnp.where(a["_row_valid"], a["v"], 0.0)))
+        total += float(jnp.sum(jnp.where(b["_row_valid"], b["w"], 0.0)))
+        k = int(jnp.max(jnp.where(a["_row_valid"], a["k"], 0)))
+        return {"k": jnp.array([k]), "s": jnp.array([total])}
+
+    e = make_engine()
+    a = e.to_df([[1, 1.0], [1, 2.0]], "k:long,v:double")
+    b = e.to_df([[1, 10.0]], "k:long,w:double")
+    z = e.zip(DataFrames(a, b), partition_spec=PartitionSpec(by=["k"]))
+    tf = _to_transformer(cm_concrete, schema="k:long,s:double")
+    tf._output_schema = "k:long,s:double"
+    tf._partition_spec = PartitionSpec(by=["k"])
+    runner = _CoTransformerRunner(z, tf, [])
+    res = e.comap(z, runner.run, "k:long,s:double", PartitionSpec(by=["k"]))
+    assert sorted(map(tuple, res.as_array())) == [(1, 13.0)]
+    assert e.fallbacks.get("comap", 0) == 1, e.fallbacks

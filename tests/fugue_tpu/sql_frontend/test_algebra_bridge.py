@@ -87,12 +87,20 @@ def test_case_when_routes_to_device():
 
 
 def test_complex_query_falls_back_correctly():
-    # subquery expressions are outside the device set: host runner with
-    # a counted fallback
+    # round 5: uncorrelated scalar subqueries inline as device-computed
+    # literals, so this shape now stays entirely on device; a CORRELATED
+    # non-equi subquery remains the host runner's (counted)
     df = _df()
     e, jx, nt = _both(
         ("SELECT k, v FROM", df,
          "WHERE v > (SELECT AVG(v) FROM", df, ")")
     )
     assert jx == nt
-    assert sum(e.fallbacks.values()) >= 1  # counted, not silent
+    assert e.fallbacks == {}, e.fallbacks
+    e2, jx2, nt2 = _both(
+        ("SELECT k, v FROM", df,
+         "AS t WHERE v > (SELECT AVG(v) FROM", df,
+         "AS q WHERE q.k > t.k)")
+    )
+    assert jx2 == nt2
+    assert sum(e2.fallbacks.values()) >= 1  # counted, not silent

@@ -20,6 +20,8 @@ def _frame(rng: np.random.Generator, n: int = 160) -> pd.DataFrame:
     v[rng.random(n) < 0.12] = np.nan
     s = rng.choice(["red", "green", "blue", "teal "], n).astype(object)
     s[rng.random(n) < 0.1] = None
+    p = rng.choice(["r%", "%e%", "b___", "%l", "te%"], n).astype(object)
+    p[rng.random(n) < 0.1] = None
     return pd.DataFrame(
         {
             "k": rng.integers(0, 5, n).astype(np.int64),
@@ -27,6 +29,7 @@ def _frame(rng: np.random.Generator, n: int = 160) -> pd.DataFrame:
             "v": v,
             "i": rng.integers(-40, 40, n).astype(np.int64),
             "s": s,
+            "p": p,  # dynamic LIKE patterns
         }
     )
 
@@ -62,6 +65,7 @@ def _str(rng: np.random.Generator, depth: int = 0) -> str:
             f"TRIM({_str(rng, depth + 1)})",
             f"SUBSTRING({_str(rng, depth + 1)}, 2, 3)",
             f"CONCAT('x_', {_str(rng, depth + 1)})",
+            f"CONCAT({_str(rng, depth + 1)}, '-', p)",  # multi-column
             f"REPLACE({_str(rng, depth + 1)}, 'e', 'E')",
         ]
     )
@@ -79,6 +83,8 @@ def _bool(rng: np.random.Generator, depth: int = 0) -> str:
                 "s <> 'blue'",
                 "s LIKE '%e%'",
                 "s NOT LIKE 'r%'",
+                "s LIKE p",  # dynamic (column-valued) pattern
+                "s NOT LIKE p",
                 "s IN ('red', 'teal ')",
                 "s < 'green'",
             ]
@@ -127,6 +133,10 @@ def _rows_close(a: tuple, b: tuple) -> bool:
 
 _ORACLE = make_execution_engine("native")
 
+# corpus-wide device-routing ledger, reported and asserted by
+# test_zz_device_routed_fraction (file-order: keep that test LAST)
+_COVERAGE = {"total": 0, "device": 0}
+
 
 def _both(e, parts) -> bool:
     """Run on both engines, compare; returns True when the jax run was
@@ -139,6 +149,8 @@ def _both(e, parts) -> bool:
     assert len(ca) == len(cb) and all(
         _rows_close(x, y) for x, y in zip(ca, cb)
     ), f"\nSQL: {parts[0]} ... {parts[-1]}\n{rj}\n{rn}"
+    _COVERAGE["total"] += 1
+    _COVERAGE["device"] += int(on_device)
     return on_device
 
 
@@ -236,5 +248,38 @@ def test_fuzz_subquery_predicates():
                  f"AS t2 WHERE k {neg}IN (SELECT k FROM", df,
                  f"AS q WHERE {pred})")
         on_device += _both(e, parts)
-    # positive IN lowers to a device semi join; NOT IN stays host
-    assert on_device >= 5, (on_device, e.fallbacks)
+    # IN lowers to a device semi join, NOT IN to the 3VL anti variant
+    assert on_device >= 14, (on_device, e.fallbacks)
+
+
+def test_fuzz_scalar_subqueries():
+    rng = np.random.default_rng(505)
+    df = _frame(rng)
+    e = make_execution_engine("jax")
+    on_device = 0
+    for _ in range(15):
+        agg = rng.choice(["AVG", "MIN", "MAX", "SUM", "COUNT"])
+        col_ = rng.choice(["v", "i"])
+        inner = f"(SELECT {agg}({col_}) FROM"
+        if rng.random() < 0.5:
+            parts = ("SELECT k, o, v FROM", df,
+                     f"AS t2 WHERE v > {inner}", df, "AS q) / 2")
+        else:
+            parts = (f"SELECT k, o, {inner}", df,
+                     "AS q) AS m FROM", df, "AS t2")
+        on_device += _both(e, parts)
+    # uncorrelated scalar subqueries inline as device-computed literals
+    assert on_device >= 14, (on_device, e.fallbacks)
+
+
+def test_zz_device_routed_fraction():
+    """The corpus-wide report VERDICT r4 asked for: the differential
+    fuzzer must KNOW how much of its corpus ran device-resident, not
+    just per-test thresholds. Skips when the corpus didn't run in this
+    process (-k selection, xdist sharding)."""
+    total, dev = _COVERAGE["total"], _COVERAGE["device"]
+    if total < 100:
+        pytest.skip(f"fuzz corpus not (fully) run in this process: {total}")
+    frac = dev / total
+    print(f"\ndevice-routed fraction: {dev}/{total} = {frac:.1%}")
+    assert frac >= 0.9, (_COVERAGE, frac)
