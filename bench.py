@@ -31,6 +31,23 @@ os.environ.setdefault(
 )
 
 
+# pinned native denominators (rows/sec), measured 2026-07-30 on this
+# round's container (BENCH_r04 values; config 4 re-pinned the same day
+# when its workload moved to the user-level zip+transform path). The
+# LIVE native run keeps feeding vs_baseline — vs_baseline_pinned divides
+# by these so round-over-round numbers stop tracking the ambient
+# variance of the native rerun (VERDICT r4 item 6).
+_PINNED_NATIVE_RPS = {
+    "headline": 24_973_678.0,
+    "1_map_letter_to_food": 26_600_151.0,
+    "2_partition_udf": 3_118_399.0,
+    "3_fuguesql_groupby": 33_436_836.0,
+    "3b_sql_join": 12_610_482.0,
+    "4_cotransform": 9_335.0,
+    "5_e2e_parquet": 23_835_434.0,
+}
+
+
 def _scale(n: int) -> int:
     return max(10_000, n // 100) if _SMALL else n
 
@@ -49,10 +66,81 @@ def _timed(fn: Callable[[], Any], warm: int = 5) -> float:
     return min(samples)
 
 
-def _pair(rows: int, native_fn: Callable, jax_fn: Callable) -> Dict[str, Any]:
+def _roofline(
+    build_result_frame: Callable[[], Any], bytes_touched: int
+) -> Dict[str, Any]:
+    """Decompose a device pipeline's cost on a (possibly network-attached)
+    TPU: measure the relay's irreducible sync+fetch latency with a tiny
+    op, then the full pipeline ending in ONE derived-scalar fetch (which
+    forces all queued compute through the same single sync). The
+    difference is the device-resident time; bytes_touched / that time is
+    a LOWER bound on achieved HBM bandwidth (bytes_touched counts each
+    logical pass over the data once; XLA fusion can only reduce real
+    traffic below it)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    # the sync baseline must live on the SAME backend as the pipeline
+    # (frames may sit on the host CPU-XLA tier, where a sync is ~free)
+    probe = build_result_frame()
+    blocks0 = getattr(probe, "native", None)
+    if blocks0 is None or not hasattr(blocks0, "mesh") or not any(
+        c.on_device for c in blocks0.columns.values()
+    ):
+        return {"skipped": "result frame not device-resident (fallback?)"}
+    dev = blocks0.mesh.devices.flat[0]
+    tiny = jax.device_put(jnp.ones((8,), jnp.float32), dev)
+    jax.block_until_ready(tiny)
+
+    def rtt_once() -> float:
+        t0 = time.perf_counter()
+        float(jnp.sum(tiny * np.float32(np.random.rand())))
+        return time.perf_counter() - t0
+
+    rtt_once()
+    rtt = min(rtt_once() for _ in range(5))
+
+    def dev_once() -> float:
+        t0 = time.perf_counter()
+        fr = build_result_frame()
+        blocks = fr.native
+        parts = [
+            jnp.sum(c.data.astype(jnp.float32))
+            for c in blocks.columns.values()
+            if c.on_device
+        ]
+        if blocks.row_valid is not None:
+            parts.append(jnp.sum(blocks.row_valid.astype(jnp.float32)))
+        float(jnp.sum(jnp.stack(parts)))  # one sync drains the pipeline
+        return time.perf_counter() - t0
+
+    dev_once()  # warm (possible jit of the reduction)
+    dev_plus = min(dev_once() for _ in range(5))
+    device_secs = max(dev_plus - rtt, 0.0)
+    return {
+        "backend": dev.platform,
+        "relay_rtt_secs": round(rtt, 4),
+        "device_plus_rtt_secs": round(dev_plus, 4),
+        "device_resident_secs": round(device_secs, 4),
+        "approx_bytes_touched": bytes_touched,
+        "achieved_gbps_lower_bound": (
+            None
+            if device_secs <= 0
+            else round(bytes_touched / device_secs / 1e9, 1)
+        ),
+    }
+
+
+def _pair(
+    rows: int,
+    native_fn: Callable,
+    jax_fn: Callable,
+    pinned_key: str = "",
+) -> Dict[str, Any]:
     native_secs = _timed(native_fn)
     jax_secs = _timed(jax_fn)
-    return {
+    out = {
         "rows": rows,
         "native_secs": round(native_secs, 4),
         "jax_secs": round(jax_secs, 4),
@@ -60,6 +148,10 @@ def _pair(rows: int, native_fn: Callable, jax_fn: Callable) -> Dict[str, Any]:
         "jax_rows_per_sec": round(rows / jax_secs, 1),
         "speedup": round(native_secs / jax_secs, 2),
     }
+    pinned = _PINNED_NATIVE_RPS.get(pinned_key)
+    if pinned and not _SMALL:
+        out["speedup_pinned"] = round((rows / jax_secs) / pinned, 2)
+    return out
 
 
 def _bench_headline() -> Dict[str, Any]:
@@ -149,11 +241,28 @@ def _bench_headline() -> Dict[str, Any]:
     # statistic on a tunneled TPU; medians measure ambient relay load
     jax_rps = n_rows / jax_secs
 
+    def build_frame() -> Any:
+        out = transform(src, jax_udf, schema="k:int,v2:float",
+                        engine=engine, as_fugue=True)
+        return aggregate(
+            out, partition_by="k",
+            s=ff.sum(col("v2")), m=ff.avg(col("v2")), c=ff.count(col("v2")),
+            engine=engine, as_fugue=True,
+        )
+
+    # transform reads k+v, writes v2; groupby reads k+v2 (5 x 4B streams)
+    roofline = _roofline(build_frame, n_rows * 20)
+
     return {
         "metric": "transform_groupby_rows_per_sec",
         "value": round(jax_rps, 1),
         "unit": "rows/sec",
         "vs_baseline": round(jax_rps / native_rps, 2),
+        "vs_baseline_pinned": (
+            None  # pinned denominators are full-scale measurements
+            if _SMALL
+            else round(jax_rps / _PINNED_NATIVE_RPS["headline"], 2)
+        ),
         "detail": {
             "rows_jax": n_rows,
             "rows_native": n_native,
@@ -162,23 +271,33 @@ def _bench_headline() -> Dict[str, Any]:
             "jax_cold_secs": round(cold_secs, 4),
             "native_secs": round(native_secs, 4),
             "native_rows_per_sec": round(native_rps, 1),
+            "roofline": roofline,
             "devices": len(jax.devices()),
             "platform": jax.devices()[0].platform,
             "notes": (
                 "vs_baseline uses the same min-of-warm statistic on both "
-                "sides; round-over-round headline drift tracks the native "
-                "denominator's ambient variance (r2 32x vs r3 18x was a "
-                "faster native run, not a jax regression). jax_cold_secs "
-                "is THIS process's first full-shape run; the persistent "
-                "compile cache (fugue.jax.compile.cache, on by default "
-                "here) verifiably serves second-process compiles from disk "
-                "(jax logs PERSISTENT COMPILATION CACHE HIT), so the "
-                "remaining cold cost on THIS hardware is the network "
-                "relay's first-dispatch warmup, not XLA. Small/IO-bound "
-                "configs run on the engine's "
-                "host CPU-XLA placement tier (fugue.jax.placement=auto): "
+                "sides; vs_baseline_pinned divides by the dated pinned "
+                "denominator (_PINNED_NATIVE_RPS) so rounds compare "
+                "without the native rerun's ambient variance. "
+                "jax_cold_secs is THIS process's first full-shape run "
+                "AFTER a forcing persist: rounds 1-4 reported 24-93s "
+                "here, which profiling showed was the 800MB host->device "
+                "staging completing lazily over the ~10MB/s network "
+                "relay inside the first timed run (the relay acks "
+                "block_until_ready optimistically; persist now forces "
+                "residency with a derived-value fetch, so staging lands "
+                "in setup where the reference's in-memory input also "
+                "lives). The residual cold ~2-9s is trace + persistent-"
+                "compile-cache load + first dispatch. detail.roofline "
+                "splits warm time into the relay's sync round trip "
+                "(~0.11s on this tunnel, microseconds on locally-"
+                "attached TPUs) vs device-resident compute, with a "
+                "bytes-touched lower bound on achieved bandwidth. "
+                "Small/IO-bound configs run on the engine's host "
+                "CPU-XLA placement tier (fugue.jax.placement=auto): "
                 "per-query transfer over the network-attached TPU link "
-                "dominates any accelerator win at those sizes."
+                "dominates any accelerator win at those sizes — 3b's "
+                "roofline shows exactly that tradeoff."
             ),
         },
     }
@@ -235,7 +354,7 @@ def _config1_map_letter_to_food() -> Dict[str, Any]:
             jsrc, jax_map_letter, schema="*", engine=jax_e, as_fugue=True
         ).as_local()
 
-    return _pair(n, run_native, run_jax)
+    return _pair(n, run_native, run_jax, "1_map_letter_to_food")
 
 
 def _config2_partition_udf() -> Dict[str, Any]:
@@ -300,7 +419,7 @@ def _config2_partition_udf() -> Dict[str, Any]:
             arrs.append(out.native.row_valid)
         _j.device_get(arrs)
 
-    return _pair(n, run_native, run_jax)
+    return _pair(n, run_native, run_jax, "2_partition_udf")
 
 
 def _config3_fuguesql_groupby() -> Dict[str, Any]:
@@ -330,7 +449,8 @@ def _config3_fuguesql_groupby() -> Dict[str, Any]:
         ).as_local()
 
     return _pair(
-        n, lambda: run(native, pdf), lambda: run(jax_e, jsrc)
+        n, lambda: run(native, pdf), lambda: run(jax_e, jsrc),
+        "3_fuguesql_groupby",
     )
 
 
@@ -362,16 +482,24 @@ def _config3b_sql_join() -> Dict[str, Any]:
     jax_e = make_execution_engine("jax")
     jf, jd = jax_e.to_df(facts), jax_e.to_df(dims)
 
-    def run(engine: Any, f: Any, d: Any) -> None:
-        raw_sql(
+    def run(engine: Any, f: Any, d: Any) -> Any:
+        return raw_sql(
             "SELECT f.k, SUM(v) AS s, AVG(w) AS m, COUNT(*) AS c FROM", f,
             "AS f JOIN", d, "AS d ON f.k = d.k GROUP BY f.k",
             engine=engine, as_fugue=True,
-        ).as_local()
+        )
 
-    return _pair(
-        n, lambda: run(native, facts, dims), lambda: run(jax_e, jf, jd)
+    res = _pair(
+        n,
+        lambda: run(native, facts, dims).as_local(),
+        lambda: run(jax_e, jf, jd).as_local(),
+        "3b_sql_join",
     )
+    # snapshot BEFORE the roofline probe re-runs the query
+    res["jax_fallbacks"] = dict(jax_e.fallbacks)
+    # join reads k+v, gathers w + validity; groupby reads k+v+w
+    res["roofline"] = _roofline(lambda: run(jax_e, jf, jd), n * 20)
+    return res
 
 
 def _config4_cotransform() -> Dict[str, Any]:
@@ -446,7 +574,8 @@ def _config4_cotransform() -> Dict[str, Any]:
     native = make_execution_engine("native")
     jax_e = make_execution_engine("jax")
     res = _pair(
-        n, lambda: run(native, cm_pandas), lambda: run(jax_e, cm_jax)
+        n, lambda: run(native, cm_pandas), lambda: run(jax_e, cm_jax),
+        "4_cotransform",
     )
     res["jax_fallbacks"] = dict(jax_e.fallbacks)
     return res
@@ -506,6 +635,7 @@ def _config5_e2e_parquet() -> Dict[str, Any]:
         lambda: run(
             "jax", jax_udf, "k:int,v2:float", "out_jax.parquet"
         ),
+        pinned_key="5_e2e_parquet",
     )
 
 

@@ -73,7 +73,12 @@ class JaxColumn:
 
     ``stats`` is an optional host-known (min, max) int pair bounding the
     VALID values of an integer-like column (a superset bound is fine);
-    ``dictionary`` holds the decode table for string columns."""
+    ``dictionary`` holds the decode table for string columns. ``unique``
+    is a host-known guarantee that the column's VALID values are
+    pairwise distinct (captured at ingest for strictly monotonic integer
+    keys — the dimension-table surrogate-key pattern); it stays sound
+    under row filtering (a subset of distinct values is distinct) and is
+    dropped by every transformation that could duplicate values."""
 
     def __init__(
         self,
@@ -82,12 +87,14 @@ class JaxColumn:
         mask: Optional[Any] = None,  # jax bool array, True = valid
         dictionary: Optional[np.ndarray] = None,  # for string kind
         stats: Optional[Tuple[int, int]] = None,  # host-known (min, max)
+        unique: bool = False,
     ):
         self.pa_type = pa_type
         self.data = data
         self.mask = mask
         self.dictionary = dictionary
         self.stats = stats
+        self.unique = unique
 
     @property
     def on_device(self) -> bool:
@@ -333,10 +340,26 @@ def from_arrow(table: pa.Table, schema: Schema, mesh: Mesh) -> JaxBlocks:
             mask_arr = None
             data = _pad(np.ascontiguousarray(values, dtype=np_dtype), pad_n, 0)
             stats = _int_like_stats(data[:n] if n > 0 else data[:0], tp)
+        unique = False
+        if (
+            mask_arr is None
+            and pa.types.is_integer(tp)
+            and 0 < n <= _UNIQUE_CHECK_MAX
+        ):
+            # strictly monotonic integer keys (the dim-table surrogate-key
+            # pattern) are provably unique — unlocks the sync-free
+            # unique-right join fast path (relational.expand_join).
+            # element-wise comparison, NOT np.diff: subtraction wraps for
+            # unsigned/extreme values and would falsely prove uniqueness
+            unique = bool((data[1:n] > data[: n - 1]).all())
         cols[field.name] = JaxColumn(
-            tp, put_sharded(data, sharding), mask_arr, stats=stats
+            tp, put_sharded(data, sharding), mask_arr, stats=stats,
+            unique=unique,
         )
     return JaxBlocks(n, cols, mesh)
+
+
+_UNIQUE_CHECK_MAX = 4_000_000  # O(n) host check only for dim-table sizes
 
 
 def _pad(arr: np.ndarray, target: int, fill: Any) -> np.ndarray:

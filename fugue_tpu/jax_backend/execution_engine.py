@@ -910,6 +910,24 @@ class JaxExecutionEngine(ExecutionEngine):
                 if c.on_device
             ]
             jax.block_until_ready(arrs)
+            if arrs:
+                # relayed TPU backends ack block_until_ready before the
+                # bytes are resident; only a derived-value fetch proves
+                # the staging finished (one full-pass reduction + one
+                # scalar readback — persist means "materialize NOW")
+                from fugue_tpu.jax_backend.blocks import on_mesh
+
+                with on_mesh(jdf.blocks.mesh):
+                    # sum in native dtype, cast the SCALAR: a full-array
+                    # float32 cast would transiently copy the frame
+                    float(
+                        jnp.stack(
+                            [
+                                jnp.sum(a).astype(jnp.float32)
+                                for a in arrs
+                            ]
+                        ).sum()
+                    )
         return jdf
 
     def zip(

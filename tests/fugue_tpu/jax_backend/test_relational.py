@@ -209,3 +209,41 @@ def test_device_pipeline_zero_fallbacks():
     for k, (s, c) in got.items():
         assert abs(s - exp.loc[k, "s"]) < 1e-6
         assert c == exp.loc[k, "c"]
+
+
+def test_unique_key_detection_no_wraparound():
+    # element-wise monotonicity, not np.diff: subtraction wraps for
+    # extreme values and would falsely prove uniqueness (review finding)
+    e = make_engine()
+    dup_extreme = pd.DataFrame(
+        {"k": np.array([0, 2_000_000_000, -2_000_000_000, 0], np.int32),
+         "w": [1.0, 2.0, 3.0, 4.0]}
+    )
+    jd = e.to_df(dup_extreme)
+    assert jd.native.columns["k"].unique is False
+    mono = pd.DataFrame({"k": np.arange(16, dtype=np.int64),
+                         "w": np.arange(16, dtype=np.float64)})
+    assert e.to_df(mono).native.columns["k"].unique is True
+    shuffled = mono.sample(frac=1.0, random_state=3).reset_index(drop=True)
+    assert e.to_df(shuffled).native.columns["k"].unique is False
+
+
+def test_unique_right_join_matches_expansion_path():
+    # the sync-free unique-right fast path must agree with the general
+    # expansion join (forced via a shuffled — non-monotonic — right side)
+    rng = np.random.default_rng(33)
+    left = pd.DataFrame({"k": rng.integers(0, 50, 500).astype(np.int64),
+                         "v": rng.random(500)})
+    right = pd.DataFrame({"k": np.arange(0, 80, 2, dtype=np.int64),
+                          "w": rng.random(40)})
+    shuffled = right.sample(frac=1.0, random_state=5).reset_index(drop=True)
+    for how in ("inner", "left_outer"):
+        e = make_engine()
+        jfast = e.join(e.to_df(left), e.to_df(right), how=how, on=["k"])
+        jslow = e.join(e.to_df(left), e.to_df(shuffled), how=how, on=["k"])
+        assert e.to_df(right).native.columns["k"].unique
+        assert not e.to_df(shuffled).native.columns["k"].unique
+        a = sorted(map(tuple, jfast.as_array()), key=str)
+        b = sorted(map(tuple, jslow.as_array()), key=str)
+        assert a == b, (how, a[:3], b[:3])
+        assert e.fallbacks == {}, e.fallbacks
