@@ -51,9 +51,15 @@ from fugue_tpu.dataframe import (
     LocalDataFrameIterableDataFrame,
     PandasDataFrame,
 )
+from fugue_tpu.exceptions import FugueInterfacelessError
 from fugue_tpu.plugins import fugue_plugin
 from fugue_tpu.schema import Schema
 from fugue_tpu.utils.assertion import assert_or_throw
+
+
+class FunctionSignatureError(FugueInterfacelessError, TypeError):
+    """A function's signature can't map onto the required extension shape
+    (TypeError kept for pre-hierarchy callers)."""
 
 
 class AnnotatedParam:
@@ -367,14 +373,17 @@ class DataFrameFunctionWrapper:
         self._input_code = "".join(p.code for p in self._params)
         assert_or_throw(
             re.match(params_re, self._input_code) is not None,
-            TypeError(
+            FunctionSignatureError(
                 f"signature code {self._input_code!r} of {func} doesn't match "
                 f"{params_re!r}"
             ),
         )
         assert_or_throw(
             re.match(return_re, self._rt.code) is not None,
-            TypeError(f"return code {self._rt.code!r} of {func} doesn't match {return_re!r}"),
+            FunctionSignatureError(
+                f"return code {self._rt.code!r} of {func} doesn't match "
+                f"{return_re!r}"
+            ),
         )
 
     @property

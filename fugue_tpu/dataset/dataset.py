@@ -7,8 +7,14 @@ from abc import ABC, abstractmethod
 from typing import Any, Optional
 
 from fugue_tpu.plugins import fugue_plugin
+from fugue_tpu.exceptions import FugueDatasetEmptyError
 from fugue_tpu.utils.assertion import assert_or_throw
 from fugue_tpu.utils.params import ParamDict
+
+
+class DatasetEmptyError(FugueDatasetEmptyError, ValueError):
+    """Peek on an empty dataset (ValueError kept for pre-hierarchy
+    callers)."""
 
 
 class Dataset(ABC):
@@ -57,7 +63,9 @@ class Dataset(ABC):
         raise NotImplementedError
 
     def assert_not_empty(self) -> None:
-        assert_or_throw(not self.empty, ValueError("dataset is empty"))
+        assert_or_throw(
+            not self.empty, DatasetEmptyError("dataset is empty")
+        )
 
     @property
     def native(self) -> Any:

@@ -1,0 +1,66 @@
+"""The backend-author surface: everything needed to write a new
+execution-engine backend, custom extension parameter types, or RPC
+handlers, re-exported from one place so backend code never imports
+internal module paths (role parity: ``/root/reference/fugue/dev.py:1-47``).
+
+A minimal backend implements :class:`ExecutionEngine` (with its
+:class:`MapEngine` and :class:`SQLEngine` facets), registers it via
+:func:`register_execution_engine`, and optionally adds annotated
+transformer parameter types with :func:`fugue_annotated_param` — see
+``fugue_tpu/jax_backend/registry.py`` for the in-tree example.
+"""
+
+# flake8: noqa
+
+from fugue_tpu.bag.bag import BagDisplay
+from fugue_tpu.collections.partition import PartitionCursor, PartitionSpec
+from fugue_tpu.collections.sql import (
+    StructuredRawSQL,
+    TempTableName,
+    transpile_sql,
+)
+from fugue_tpu.collections.yielded import PhysicalYielded, Yielded
+from fugue_tpu.dataframe.function_wrapper import (
+    AnnotatedParam,
+    DataFrameFunctionWrapper,
+    FunctionSignatureError,
+    fugue_annotated_param,
+)
+from fugue_tpu.dataset.dataset import DatasetDisplay
+from fugue_tpu.exceptions import (
+    FugueBug,
+    FugueError,
+    FugueInterfacelessError,
+    FugueWorkflowCompileError,
+    FugueWorkflowRuntimeError,
+)
+from fugue_tpu.execution.execution_engine import (
+    EngineFacet,
+    ExecutionEngine,
+    MapEngine,
+    SQLEngine,
+)
+from fugue_tpu.execution.factory import (
+    make_execution_engine,
+    make_sql_engine,
+    register_default_execution_engine,
+    register_default_sql_engine,
+    register_execution_engine,
+    register_sql_engine,
+)
+from fugue_tpu.execution.native_execution_engine import (
+    NativeExecutionEngine,
+    PandasMapEngine,
+)
+from fugue_tpu.plugins import fugue_plugin, fugue_tpu_plugin
+from fugue_tpu.rpc.base import (
+    EmptyRPCHandler,
+    RPCClient,
+    RPCFunc,
+    RPCHandler,
+    RPCServer,
+    make_rpc_server,
+    to_rpc_handler,
+)
+from fugue_tpu.workflow.module import module
+from fugue_tpu.workflow.workflow import FugueWorkflow, WorkflowDataFrame

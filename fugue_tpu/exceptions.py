@@ -1,0 +1,79 @@
+"""Typed exception hierarchy (parity contract:
+``/root/reference/fugue/exceptions.py:1-66``): one root users can catch
+(:class:`FugueError`), split into compile-time vs runtime vs validation
+vs SQL branches so programs can distinguish "my workflow is malformed"
+from "execution failed" without string-matching.
+
+The framework's concrete errors subclass BOTH a branch here and their
+historical base (``ValueError`` for the SQL front end's errors), so
+pre-hierarchy code catching ``ValueError`` keeps working.
+"""
+
+
+class FugueError(Exception):
+    """Base of every framework-raised error."""
+
+
+class FugueBug(FugueError):
+    """An internal invariant broke — not a user error."""
+
+
+class FugueInvalidOperation(FugueError):
+    """The requested operation is not valid on this object/state."""
+
+
+class FuguePluginsRegistrationError(FugueError):
+    """Loading or registering a plugin failed."""
+
+
+class FugueDataFrameError(FugueError):
+    """DataFrame-related errors."""
+
+
+class FugueDataFrameInitError(FugueDataFrameError):
+    """Constructing a DataFrame from the given object failed."""
+
+
+class FugueDatasetEmptyError(FugueDataFrameError):
+    """The dataframe is empty where a value was required (peek)."""
+
+
+class FugueDataFrameOperationError(FugueDataFrameError):
+    """An invalid DataFrame operation (bad rename/alter/select)."""
+
+
+class FugueWorkflowError(FugueError):
+    """Workflow-related errors."""
+
+
+class FugueWorkflowCompileError(FugueWorkflowError):
+    """Raised while BUILDING a workflow DAG (before execution)."""
+
+
+class FugueWorkflowCompileValidationError(FugueWorkflowCompileError):
+    """A validation rule failed at compile time."""
+
+
+class FugueInterfacelessError(FugueWorkflowCompileError):
+    """A function couldn't be adapted into an extension (bad signature
+    or missing schema hint)."""
+
+
+class FugueWorkflowRuntimeError(FugueWorkflowError):
+    """Raised while EXECUTING a workflow."""
+
+
+class FugueWorkflowRuntimeValidationError(FugueWorkflowRuntimeError):
+    """A validation rule failed at runtime (partition/input checks)."""
+
+
+class FugueSQLError(FugueWorkflowCompileError):
+    """FugueSQL-related compile error."""
+
+
+class FugueSQLSyntaxError(FugueSQLError):
+    """FugueSQL/SELECT text failed to parse."""
+
+
+class FugueSQLRuntimeError(FugueWorkflowRuntimeError):
+    """A SQL statement failed during execution."""

@@ -8,6 +8,7 @@ DataFrameFunctionWrapper (reference convert.py:328-560 pattern)."""
 import copy
 from typing import Any, Callable, Dict, List, Optional
 
+from fugue_tpu.exceptions import FugueInterfacelessError
 from fugue_tpu.dataframe import DataFrame, DataFrames, LocalDataFrame
 from fugue_tpu.dataframe.function_wrapper import DataFrameFunctionWrapper
 from fugue_tpu.extensions.interfaces import (
@@ -28,6 +29,11 @@ from fugue_tpu.plugins import fugue_plugin
 from fugue_tpu.schema import Schema
 from fugue_tpu.utils.assertion import assert_or_throw
 from fugue_tpu.utils.hash import to_uuid
+
+class ExtensionConvertError(FugueInterfacelessError, ValueError):
+    """An object can't be adapted into the requested extension
+    (ValueError kept for pre-hierarchy callers)."""
+
 
 _DF = "[dlpqrRmMPQj]"
 
@@ -155,7 +161,7 @@ class _FuncAsTransformer(_FuncExtension, Transformer):
             schema = parse_comment_annotation(func, "schema")
         assert_or_throw(
             schema is not None,
-            ValueError(f"schema hint is required for transformer {func}"),
+            ExtensionConvertError(f"schema hint is required for transformer {func}"),
         )
         validation = dict(parse_validation_rules_from_comment(func), **validation)
         wrapper = DataFrameFunctionWrapper(
@@ -221,7 +227,9 @@ class _FuncAsCoTransformer(_FuncExtension, CoTransformer):
             schema = parse_comment_annotation(func, "schema")
         assert_or_throw(
             schema is not None,
-            ValueError(f"schema hint is required for cotransformer {func}"),
+            ExtensionConvertError(
+                f"schema hint is required for cotransformer {func}"
+            ),
         )
         validation = dict(parse_validation_rules_from_comment(func), **validation)
         wrapper = DataFrameFunctionWrapper(
@@ -361,7 +369,7 @@ def _to_extension(
         return obj()
     if callable(obj):
         return from_func(obj)
-    raise ValueError(f"can't convert {obj!r} to {kind}")
+    raise ExtensionConvertError(f"can't convert {obj!r} to {kind}")
 
 
 def _to_creator(obj: Any, schema: Any = None) -> Creator:
@@ -406,7 +414,7 @@ def _to_transformer(
         if _is_cotransform_func(obj):
             return _FuncAsCoTransformer.from_func(obj, schema, validation)  # type: ignore
         return _FuncAsTransformer.from_func(obj, schema, validation)
-    raise ValueError(f"can't convert {obj!r} to transformer")
+    raise ExtensionConvertError(f"can't convert {obj!r} to transformer")
 
 
 def _to_output_transformer(
@@ -435,7 +443,7 @@ def _to_output_transformer(
         if _is_cotransform_func(obj):
             return _FuncAsOutputCoTransformer.from_func(obj, validation)  # type: ignore
         return _FuncAsOutputTransformer.from_func(obj, validation)
-    raise ValueError(f"can't convert {obj!r} to output transformer")
+    raise ExtensionConvertError(f"can't convert {obj!r} to output transformer")
 
 
 def _is_cotransform_func(func: Callable) -> bool:

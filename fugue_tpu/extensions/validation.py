@@ -9,7 +9,27 @@ from typing import Any, Dict, List
 
 from fugue_tpu.collections.partition import PartitionSpec, parse_presort_exp
 from fugue_tpu.schema import Schema
+from fugue_tpu.exceptions import (
+    FugueWorkflowCompileError,
+    FugueWorkflowCompileValidationError,
+    FugueWorkflowRuntimeValidationError,
+)
 from fugue_tpu.utils.assertion import assert_or_throw
+
+class InvalidValidationRuleError(FugueWorkflowCompileError, ValueError):
+    """Unknown validation rule key (ValueError kept for pre-hierarchy
+    callers)."""
+
+
+class CompileValidationError(FugueWorkflowCompileValidationError, ValueError):
+    """Compile-time validation failure (ValueError kept for
+    pre-hierarchy callers)."""
+
+
+class RuntimeValidationError(FugueWorkflowRuntimeValidationError, ValueError):
+    """Runtime validation failure (ValueError kept for pre-hierarchy
+    callers)."""
+
 
 _VALID_KEYS = {
     "input_has",
@@ -36,7 +56,10 @@ def _to_list(v: Any) -> List[str]:
 
 def validate_rules(rules: Dict[str, Any]) -> Dict[str, Any]:
     for k in rules:
-        assert_or_throw(k in _VALID_KEYS, ValueError(f"invalid validation rule {k}"))
+        assert_or_throw(
+            k in _VALID_KEYS,
+            InvalidValidationRuleError(f"invalid validation rule {k}"),
+        )
     return rules
 
 
@@ -46,7 +69,7 @@ def validate_partition_spec(rules: Dict[str, Any], spec: PartitionSpec) -> None:
         req = _to_list(rules["partitionby_has"])
         assert_or_throw(
             all(k in spec.partition_by for k in req),
-            ValueError(
+            CompileValidationError(
                 f"partitionby_has: {req} required but got {spec.partition_by}"
             ),
         )
@@ -54,19 +77,19 @@ def validate_partition_spec(rules: Dict[str, Any], spec: PartitionSpec) -> None:
         req = _to_list(rules["partitionby_is"])
         assert_or_throw(
             req == spec.partition_by,
-            ValueError(f"partitionby_is: expected {req} got {spec.partition_by}"),
+            CompileValidationError(f"partitionby_is: expected {req} got {spec.partition_by}"),
         )
     if "presort_has" in rules:
         req = parse_presort_exp(rules["presort_has"])
         assert_or_throw(
             all(k in spec.presort and spec.presort[k] == v for k, v in req.items()),
-            ValueError(f"presort_has: {req} required but got {spec.presort}"),
+            CompileValidationError(f"presort_has: {req} required but got {spec.presort}"),
         )
     if "presort_is" in rules:
         req = parse_presort_exp(rules["presort_is"])
         assert_or_throw(
             req == spec.presort,
-            ValueError(f"presort_is: expected {req} got {spec.presort}"),
+            CompileValidationError(f"presort_is: expected {req} got {spec.presort}"),
         )
 
 
@@ -77,10 +100,10 @@ def validate_input_schema(rules: Dict[str, Any], schema: Schema) -> None:
         missing = [c for c in req if c not in schema]
         assert_or_throw(
             len(missing) == 0,
-            ValueError(f"input_has: missing columns {missing} in {schema}"),
+            RuntimeValidationError(f"input_has: missing columns {missing} in {schema}"),
         )
     if "input_is" in rules:
         assert_or_throw(
             schema == Schema(rules["input_is"]),
-            ValueError(f"input_is: expected {rules['input_is']} got {schema}"),
+            RuntimeValidationError(f"input_is: expected {rules['input_is']} got {schema}"),
         )

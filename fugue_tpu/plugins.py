@@ -54,6 +54,11 @@ class ConditionalDispatcher:
         # stable: higher priority first; among equal priorities, later wins
         self._candidates.sort(key=lambda c: (-c.priority, -c.order))
 
+    def unregister(self, func: Callable) -> None:
+        """Remove every candidate backed by ``func`` (tests and
+        temporary registrations)."""
+        self._candidates = [c for c in self._candidates if c.func is not func]
+
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
         _load_entry_point_plugins()
         for c in self._candidates:

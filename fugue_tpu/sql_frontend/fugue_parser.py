@@ -32,6 +32,9 @@ Statement forms (subset of the reference grammar, same semantics):
 
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from fugue_tpu.exceptions import (
+    FugueSQLSyntaxError as _BaseFugueSQLSyntaxError,
+)
 from fugue_tpu.collections.partition import PartitionSpec
 from fugue_tpu.collections.sql import StructuredRawSQL
 from fugue_tpu.sql_frontend import ast
@@ -39,11 +42,22 @@ from fugue_tpu.sql_frontend.parser import Cursor, ExprParser, SQLParseError
 from fugue_tpu.sql_frontend.sqlgen import generate_parts
 from fugue_tpu.sql_frontend.tokenizer import tokenize
 
-__all__ = ["FugueSQLSyntaxError", "FugueSQLCompiler"]
+__all__ = [
+    "FugueSQLDialectSyntaxError",
+    "FugueSQLSyntaxError",
+    "FugueSQLCompiler",
+]
 
 
-class FugueSQLSyntaxError(ValueError):
-    pass
+class FugueSQLDialectSyntaxError(_BaseFugueSQLSyntaxError, ValueError):
+    """FugueSQL DIALECT syntax error (catchable as the canonical
+    fugue_tpu.exceptions.FugueSQLSyntaxError; ValueError kept for
+    pre-hierarchy callers). The historical module-local name
+    ``FugueSQLSyntaxError`` stays as an alias — import the canonical
+    class from fugue_tpu.exceptions to catch EVERY SQL syntax error."""
+
+
+FugueSQLSyntaxError = FugueSQLDialectSyntaxError
 
 
 _STATEMENT_KEYWORDS = {

@@ -12,6 +12,7 @@ comparison, boolean logic and function calls (incl. DISTINCT aggregates).
 
 from typing import List, Optional, Tuple
 
+from fugue_tpu.exceptions import FugueSQLSyntaxError
 from fugue_tpu.sql_frontend.ast import (
     Between, Binary, Case, Cast, Col, Exists, Expr, Frame, Func, InList,
     InSubquery, IsNull, JoinRel, Like, Lit, OrderItem, Query, Relation,
@@ -23,8 +24,8 @@ from fugue_tpu.sql_frontend.tokenizer import Token, tokenize
 __all__ = ["SQLParseError", "parse_select", "Cursor", "ExprParser"]
 
 
-class SQLParseError(ValueError):
-    pass
+class SQLParseError(FugueSQLSyntaxError, ValueError):
+    """Parse failure (ValueError kept for pre-hierarchy callers)."""
 
 
 _RESERVED_AFTER_TABLE = {

@@ -14,6 +14,7 @@ import numpy as np
 import pandas as pd
 import pyarrow as pa
 
+from fugue_tpu.exceptions import FugueSQLRuntimeError
 from fugue_tpu.dataframe import DataFrame, DataFrames
 from fugue_tpu.dataframe.arrow_dataframe import ArrowDataFrame
 from fugue_tpu.dataframe.dataframe import LocalBoundedDataFrame
@@ -30,8 +31,9 @@ from fugue_tpu.sql_frontend.parser import parse_select
 __all__ = ["run_select", "run_query", "SQLExecutionError"]
 
 
-class SQLExecutionError(ValueError):
-    pass
+class SQLExecutionError(FugueSQLRuntimeError, ValueError):
+    """SQL execution failure (ValueError kept for pre-hierarchy
+    callers)."""
 
 
 def run_select(sql: str, dfs: DataFrames) -> LocalBoundedDataFrame:

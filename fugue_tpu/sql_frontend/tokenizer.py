@@ -8,11 +8,13 @@ A C++ accelerated scanner (the ``[cpp]`` role) can replace ``_scan_py`` via
 
 from typing import Callable, List, NamedTuple, Optional
 
+from fugue_tpu.exceptions import FugueSQLSyntaxError
+
 __all__ = ["Token", "TokenError", "tokenize", "set_accelerated_scanner"]
 
 
-class TokenError(ValueError):
-    pass
+class TokenError(FugueSQLSyntaxError, ValueError):
+    """Lexing failure (ValueError kept for pre-hierarchy callers)."""
 
 
 class Token(NamedTuple):

@@ -10,6 +10,7 @@ from typing import Any, Callable, Iterable, List
 import pandas as pd
 import pytest
 
+from fugue_tpu.exceptions import FugueWorkflowCompileValidationError
 from fugue_tpu.collections.partition import PartitionSpec
 from fugue_tpu.dataframe import ArrayDataFrame, DataFrame, DataFrames, LocalDataFrame
 from fugue_tpu.dataframe.utils import df_eq
@@ -376,7 +377,7 @@ class BuiltInTests:
             dag = self.dag()
             a = dag.df([[1, "a"]], "x:long,k:str")
             a.transform(f, schema="*")
-            with pytest.raises(Exception):
+            with pytest.raises(FugueWorkflowCompileValidationError):
                 self.run(dag)
 
         def test_module_decorator(self):

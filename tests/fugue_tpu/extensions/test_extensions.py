@@ -176,13 +176,22 @@ def test_register_transformer_alias():
 
 
 def test_validation_rules():
+    from fugue_tpu.exceptions import (
+        FugueWorkflowCompileError,
+        FugueWorkflowCompileValidationError,
+    )
+
     e = NativeExecutionEngine()
 
     # partitionby_has: k
     def f(df: pd.DataFrame) -> pd.DataFrame:
         return df
 
-    with pytest.raises(ValueError):
+    # the typed hierarchy: a compile-time validation failure is
+    # programmatically distinguishable (reference exceptions.py:41)
+    with pytest.raises(FugueWorkflowCompileValidationError):
+        _run_transform(e, e.to_df([[1, "a"]], "x:long,k:str"), f, "*")
+    with pytest.raises(FugueWorkflowCompileError):  # parent catches too
         _run_transform(e, e.to_df([[1, "a"]], "x:long,k:str"), f, "*")
     res = _run_transform(
         e, e.to_df([[1, "a"]], "x:long,k:str"), f, "*", partition={"by": ["k"]}

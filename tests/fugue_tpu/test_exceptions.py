@@ -1,0 +1,115 @@
+"""Typed exception hierarchy (reference parity:
+``/root/reference/fugue/exceptions.py:1-66``): users can catch "any
+fugue error", "any compile error", "any SQL error" programmatically,
+and the framework's concrete errors keep their historical ValueError/
+TypeError bases so pre-hierarchy callers don't break."""
+
+from typing import Any, Dict
+
+import pandas as pd
+import pytest
+
+import fugue_tpu.exceptions as ex
+from fugue_tpu.execution import make_execution_engine
+from fugue_tpu.workflow.api import raw_sql, transform
+
+
+def test_hierarchy_shape():
+    assert issubclass(ex.FugueWorkflowCompileError, ex.FugueWorkflowError)
+    assert issubclass(
+        ex.FugueWorkflowCompileValidationError, ex.FugueWorkflowCompileError
+    )
+    assert issubclass(
+        ex.FugueInterfacelessError, ex.FugueWorkflowCompileError
+    )
+    assert issubclass(ex.FugueWorkflowRuntimeError, ex.FugueWorkflowError)
+    assert issubclass(
+        ex.FugueWorkflowRuntimeValidationError, ex.FugueWorkflowRuntimeError
+    )
+    assert issubclass(ex.FugueSQLError, ex.FugueWorkflowCompileError)
+    assert issubclass(ex.FugueSQLSyntaxError, ex.FugueSQLError)
+    assert issubclass(ex.FugueSQLRuntimeError, ex.FugueWorkflowRuntimeError)
+    assert issubclass(ex.FugueDataFrameInitError, ex.FugueDataFrameError)
+    assert issubclass(ex.FugueDatasetEmptyError, ex.FugueDataFrameError)
+    assert issubclass(
+        ex.FugueDataFrameOperationError, ex.FugueDataFrameError
+    )
+    for name in (
+        "FugueBug", "FugueInvalidOperation", "FuguePluginsRegistrationError",
+        "FugueDataFrameError", "FugueWorkflowError",
+    ):
+        assert issubclass(getattr(ex, name), ex.FugueError)
+
+
+def test_sql_syntax_error_is_typed():
+    e = make_execution_engine("native")
+    df = pd.DataFrame({"a": [1]})
+    with pytest.raises(ex.FugueSQLSyntaxError):
+        raw_sql("SELEC a FROM", df, engine=e)
+    with pytest.raises(ValueError):  # pre-hierarchy compatibility
+        raw_sql("SELECT a FRO", df, engine=e)
+
+
+def test_sql_runtime_error_is_typed():
+    from fugue_tpu.sql_frontend.select_runner import SQLExecutionError
+
+    assert issubclass(SQLExecutionError, ex.FugueSQLRuntimeError)
+    assert issubclass(SQLExecutionError, ValueError)
+    e = make_execution_engine("native")
+    df = pd.DataFrame({"a": [1]})
+    with pytest.raises(ex.FugueSQLRuntimeError):
+        raw_sql("SELECT nope FROM", df, engine=e)
+
+
+def test_interfaceless_error_is_typed():
+    # no schema hint -> compile-time interfaceless error
+    def f(df: pd.DataFrame) -> pd.DataFrame:
+        return df
+
+    with pytest.raises(ex.FugueInterfacelessError):
+        transform(pd.DataFrame({"a": [1]}), f, engine="native")
+    # a signature outside every extension shape
+    from fugue_tpu.dataframe.function_wrapper import (
+        DataFrameFunctionWrapper,
+        FunctionSignatureError,
+    )
+
+    def g(x: Dict[str, Any], y: int, z: int) -> None:
+        pass
+
+    with pytest.raises(FunctionSignatureError):
+        DataFrameFunctionWrapper(g, "^[dlpqrRmMPQj]$", "^[dlpqrRmMPQjn]$")
+    assert issubclass(FunctionSignatureError, ex.FugueInterfacelessError)
+    assert issubclass(FunctionSignatureError, TypeError)
+
+
+def test_dataset_empty_error_is_typed():
+    e = make_execution_engine("native")
+    with pytest.raises(ex.FugueDatasetEmptyError):
+        e.to_df([], "a:long").peek_array()
+
+
+def test_validation_errors_are_typed():
+    from fugue_tpu.collections.partition import PartitionSpec
+    from fugue_tpu.extensions.validation import (
+        validate_input_schema,
+        validate_partition_spec,
+    )
+    from fugue_tpu.schema import Schema
+
+    with pytest.raises(ex.FugueWorkflowCompileValidationError):
+        validate_partition_spec({"partitionby_has": "k"}, PartitionSpec())
+    with pytest.raises(ex.FugueWorkflowRuntimeValidationError):
+        validate_input_schema({"input_has": "zz"}, Schema("a:long"))
+    # both are catchable at the workflow-error root
+    with pytest.raises(ex.FugueWorkflowError):
+        validate_partition_spec({"partitionby_has": "k"}, PartitionSpec())
+
+
+def test_catch_any_fugue_error():
+    e = make_execution_engine("native")
+    df = pd.DataFrame({"a": [1]})
+    with pytest.raises(ex.FugueError):
+        raw_sql("SELECT * FRM", df, engine=e)
+    with pytest.raises(ex.FugueError):
+        e.to_df([], "a:long").peek_array()
