@@ -150,6 +150,25 @@ def row_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("p"))
 
 
+_JIT_ROW_SHARDED_CACHE: Dict[Any, Any] = {}
+
+
+def jit_row_sharded(mesh: Mesh, key: Any, fn: Any) -> Any:
+    """Jit ``fn`` with every output constrained to the mesh's row
+    sharding, cached per (mesh, key). This is the multihost-safe way to
+    CREATE row-axis arrays outside engine programs: eager jnp creations
+    commit to one process-local device, and ``device_put`` onto a
+    process-spanning sharding is a cross-host reshard jax refuses on CPU
+    meshes. Callers must pass HOST scalars (np.int32, not jnp) so inputs
+    never carry a single-device commitment either."""
+    k = (mesh, key)
+    prog = _JIT_ROW_SHARDED_CACHE.get(k)
+    if prog is None:
+        prog = jax.jit(fn, out_shardings=row_sharding(mesh))
+        _JIT_ROW_SHARDED_CACHE[k] = prog
+    return prog
+
+
 def on_mesh(mesh: Mesh) -> Any:
     """Context manager pinning EAGER jnp array creation to the mesh's
     backend. Without it, eager ``jnp.arange``/``ones``/``concatenate``
@@ -243,14 +262,19 @@ class JaxBlocks:
         return self.nrows
 
     def validity(self) -> jnp.ndarray:
-        """Device bool array over padded rows: True = real row."""
+        """Device bool array over padded rows: True = real row. Built by
+        a row-sharded jitted program so the mask is a GLOBAL array on
+        multi-process meshes (an eager arange commits to one local
+        device, and device_put cannot reshard across hosts)."""
         if self.row_valid is not None:
             return self.row_valid
         pad_n = self.padded_nrows
-        with on_mesh(self.mesh):
-            return jnp.arange(pad_n, dtype=jnp.int32) < jnp.int32(
-                self._nrows
-            )
+        prog = jit_row_sharded(
+            self.mesh,
+            ("validity", pad_n),
+            lambda n: jnp.arange(pad_n, dtype=jnp.int32) < n,
+        )
+        return prog(np.int32(self._nrows))
 
     @property
     def is_prefix_layout(self) -> bool:

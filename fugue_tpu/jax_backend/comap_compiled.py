@@ -56,9 +56,8 @@ from fugue_tpu.jax_backend.blocks import (
     JaxBlocks,
     JaxColumn,
     is_device_type,
-    on_mesh,
+    jit_row_sharded,
     padded_len,
-    row_sharding,
 )
 from fugue_tpu.jax_backend.relational import (
     _common_dtype,
@@ -75,14 +74,14 @@ class HostPathRequired(Exception):
     reason recorded by the engine's counter."""
 
 
-def _harmonize_n(cs: List[JaxColumn]) -> List[JaxColumn]:
+def _harmonize_n(cs: List[JaxColumn], mesh: Any) -> List[JaxColumn]:
     """Re-encode N dictionary columns into one shared dictionary by
     left-folding the pairwise harmonizer: each step only APPENDS to the
     union dictionary, so earlier members' codes stay valid and just adopt
     the final table."""
     out = [cs[0]]
     for c in cs[1:]:
-        base, remapped, _ = harmonize_string_keys(out[0], c)
+        base, remapped, _ = harmonize_string_keys(out[0], c, mesh)
         out[0] = base
         out.append(remapped)
     union = out[0].dictionary
@@ -97,49 +96,99 @@ def _concat_key_blocks_n(
 ) -> Tuple[JaxBlocks, List[int]]:
     """All members' key columns stacked along the row axis (member 0 rows
     first) — the N-way form of relational.concat_key_blocks. Padding rows
-    stay invalid, so factorization sees them as non-rows."""
+    stay invalid, so factorization sees them as non-rows. Arrays are
+    built inside one row-sharded jitted program (multihost-safe — see
+    relational.concat_key_blocks)."""
     mesh = blocks_list[0].mesh
     ps = [b.padded_nrows for b in blocks_list]
-    sharding = row_sharding(mesh)
-    cols: Dict[str, JaxColumn] = {}
-    with on_mesh(mesh):
-        for k in keys:
-            cs = [b.columns[k] for b in blocks_list]
-            if cs[0].is_string:
-                cs = _harmonize_n(cs)
-            dt = cs[0].data.dtype
-            for c in cs[1:]:
-                dt = _common_dtype(dt, c.data.dtype)
-            data = jnp.concatenate([c.data.astype(dt) for c in cs])
-            if any(c.mask is not None for c in cs):
-                mask: Optional[Any] = jax.device_put(
-                    jnp.concatenate(
-                        [
-                            c.mask
-                            if c.mask is not None
-                            else jnp.ones((p,), dtype=bool)
-                            for c, p in zip(cs, ps)
-                        ]
-                    ),
-                    sharding,
-                )
-            else:
-                mask = None
-            stats = cs[0]
-            for c in cs[1:]:
-                stats = JaxColumn(
-                    stats.pa_type, stats.data, None, None,
-                    _merged_stats(stats, c),
-                )
-            cols[k] = JaxColumn(
-                cs[0].pa_type,
-                jax.device_put(data, sharding),
-                mask,
-                cs[0].dictionary,
-                stats.stats,
+    n = len(blocks_list)
+    per_key: Dict[str, List[JaxColumn]] = {}
+    for k in keys:
+        cs = [b.columns[k] for b in blocks_list]
+        if cs[0].is_string:
+            cs = _harmonize_n(cs, mesh)
+        per_key[k] = cs
+    dts = {}
+    for k, cs in per_key.items():
+        dt = cs[0].data.dtype
+        for c in cs[1:]:
+            dt = _common_dtype(dt, c.data.dtype)
+        dts[k] = dt
+    masked = tuple(
+        sorted(
+            k
+            for k, cs in per_key.items()
+            if any(c.mask is not None for c in cs)
+        )
+    )
+
+    key_names = tuple(sorted(per_key))
+
+    def _prog(
+        datas: List[Dict[str, Any]],
+        masks: List[Dict[str, Any]],
+        rvs: Tuple[Optional[Any], ...],
+        nrs: Tuple[Any, ...],
+    ) -> Tuple[Dict[str, Any], Dict[str, Any], Any]:
+        # iterate NAMES only: closing over per_key would pin the first
+        # call's device arrays inside the process-wide jit cache
+        data = {
+            k: jnp.concatenate(
+                [datas[m][k].astype(dts[k]) for m in range(n)]
             )
-        row_valid = jax.device_put(
-            jnp.concatenate([b.validity() for b in blocks_list]), sharding
+            for k in key_names
+        }
+        mask = {
+            k: jnp.concatenate(
+                [
+                    masks[m].get(k, jnp.ones((ps[m],), dtype=bool))
+                    for m in range(n)
+                ]
+            )
+            for k in masked
+        }
+        valid = jnp.concatenate(
+            [
+                groupby.materialize_validity(rvs[m], ps[m], nrs[m])
+                for m in range(n)
+            ]
+        )
+        return data, mask, valid
+
+    prog = jit_row_sharded(
+        mesh,
+        (
+            "concat_keys_n", tuple(ps), tuple(sorted(per_key)), masked,
+            tuple(str(dts[k]) for k in sorted(dts)),
+        ),
+        _prog,
+    )
+    from fugue_tpu.jax_backend.execution_engine import _nrows_arg
+
+    data, mask, row_valid = prog(
+        [{k: cs[m].data for k, cs in per_key.items()} for m in range(n)],
+        [
+            {
+                k: cs[m].mask
+                for k, cs in per_key.items()
+                if cs[m].mask is not None
+            }
+            for m in range(n)
+        ],
+        tuple(b.row_valid for b in blocks_list),
+        tuple(_nrows_arg(b) for b in blocks_list),
+    )
+    cols: Dict[str, JaxColumn] = {}
+    for k, cs in per_key.items():
+        stats = cs[0]
+        for c in cs[1:]:
+            stats = JaxColumn(
+                stats.pa_type, stats.data, None, None,
+                _merged_stats(stats, c),
+            )
+        cols[k] = JaxColumn(
+            cs[0].pa_type, data[k], mask.get(k), cs[0].dictionary,
+            stats.stats,
         )
     combined = JaxBlocks(None, cols, mesh, row_valid=row_valid)
     return combined, ps
@@ -178,7 +227,6 @@ def compiled_comap(
         _StringDictUnavailable,
         _is_dict_key,
         _nrows_arg,
-        _pad_to,
     )
     from fugue_tpu.jax_backend.dataframe import JaxDataFrame
 
@@ -206,19 +254,32 @@ def compiled_comap(
     ps = [b.padded_nrows for b in blocks_list]
     if how == "cross":
         S = 1
-        segs: List[Any] = []
-        with on_mesh(mesh):
-            for b in blocks_list:
-                segs.append(jnp.zeros((b.padded_nrows,), dtype=jnp.int32))
+        zero_prog = jit_row_sharded(
+            mesh,
+            ("comap_zero_segs", tuple(ps)),
+            lambda: tuple(
+                jnp.zeros((p,), dtype=jnp.int32) for p in ps
+            ),
+        )
+        segs: List[Any] = list(zero_prog())
     else:
         combined, _ = _concat_key_blocks_n(blocks_list, keys)
         fr = groupby.factorize_keys(combined, keys)
         S = max(fr.num_segments, 1)
-        segs = []
+        bounds = []
         off = 0
         for p in ps:
-            segs.append(fr.seg[off:off + p])
+            bounds.append((off, off + p))
             off += p
+        # row-sharded split (eager slices are not multihost-safe)
+        split = jit_row_sharded(
+            mesh,
+            ("comap_seg_split", tuple(ps)),
+            lambda s: tuple(
+                jax.lax.slice(s, (a,), (b,)) for a, b in bounds
+            ),
+        )
+        segs = list(split(fr.seg))
 
     if S == ps[0]:
         # output length is the ONLY signal separating per-segment from
@@ -369,51 +430,74 @@ def compiled_comap(
         )
 
     ndev = int(mesh.devices.size)
-    sharding = row_sharding(mesh)
     row_valid_out: Optional[Any] = None
     nrows_out: Optional[int] = None
     nrows_dev_out: Optional[Any] = None
     cols: Dict[str, JaxColumn] = {}
-    with on_mesh(mesh):
-        if "_nrows" in out:
-            nrows_out = int(out["_nrows"])  # explicit count: one sync
-            target = max(
-                padded_len(nrows_out, ndev), padded_len(first, ndev)
+    to_pad: Dict[str, Any] = {}
+    alive_key = "__alive"
+    while alive_key in out or any(
+        f.name == alive_key for f in out_schema.fields
+    ):
+        alive_key += "_"  # never collide with a user output column
+    if "_nrows" in out:
+        nrows_out = int(out["_nrows"])  # explicit count: one sync
+        target = max(padded_len(nrows_out, ndev), padded_len(first, ndev))
+    elif first == S:
+        # per-segment output: live segments are the rows, count lazy
+        target = padded_len(S, ndev)
+        to_pad[alive_key] = alive
+        nrows_dev_out = cnt_alive
+    elif first == ps[0]:
+        # row-aligned with member 0 (validity has dead-segment drops)
+        target = ps[0]
+        row_valid_out = rv0
+        nrows_dev_out = cnt0
+    else:
+        raise ValueError(
+            "jax cotransformer output length must be _num_segments "
+            f"({S}), member 0's padded length ({ps[0]}), or come with "
+            f"an explicit '_nrows' (got {first})"
+        )
+    for f in out_schema.fields:
+        to_pad[f.name] = out[f.name]
+        mk = out.get(f"_{f.name}_mask")
+        if mk is not None:
+            to_pad[f"_{f.name}_mask"] = mk
+    # pad through ONE row-sharded program (eager concatenate/device_put
+    # of process-spanning arrays is not multihost-safe)
+    sig = tuple(
+        (k, str(v.dtype), int(v.shape[0])) for k, v in sorted(to_pad.items())
+    )
+
+    def _pad_prog(arrs: Dict[str, Any]) -> Dict[str, Any]:
+        return {
+            k: (
+                v
+                if int(v.shape[0]) == target
+                else jnp.concatenate(
+                    [v, jnp.zeros((target - int(v.shape[0]),), v.dtype)]
+                )
             )
-        elif first == S:
-            # per-segment output: live segments are the rows, count lazy
-            target = padded_len(S, ndev)
-            row_valid_out = jax.device_put(_pad_to(alive, target), sharding)
-            nrows_dev_out = cnt_alive
-        elif first == ps[0]:
-            # row-aligned with member 0 (validity has dead-segment drops)
-            target = ps[0]
-            row_valid_out = rv0
-            nrows_dev_out = cnt0
-        else:
-            raise ValueError(
-                "jax cotransformer output length must be _num_segments "
-                f"({S}), member 0's padded length ({ps[0]}), or come with "
-                f"an explicit '_nrows' (got {first})"
-            )
-        for f in out_schema.fields:
-            data = _pad_to(out[f.name], target)
-            mask = out.get(f"_{f.name}_mask")
-            dictionary = None
-            if f"_{f.name}_dict" in dict_stash and (
-                pa.types.is_string(f.type)
-                or pa.types.is_large_string(f.type)
-            ):
-                dictionary = dict_stash[f"_{f.name}_dict"]
-            cols[f.name] = JaxColumn(
-                f.type,
-                jax.device_put(data, sharding),
-                None
-                if mask is None
-                else jax.device_put(_pad_to(mask, target), sharding),
-                dictionary,
-                None,
-            )
+            for k, v in arrs.items()
+        }
+
+    padded = jit_row_sharded(
+        mesh, ("comap_pad", target, sig), _pad_prog
+    )(to_pad)
+    if alive_key in padded:
+        row_valid_out = padded[alive_key]
+    for f in out_schema.fields:
+        mask = padded.get(f"_{f.name}_mask")
+        dictionary = None
+        if f"_{f.name}_dict" in dict_stash and (
+            pa.types.is_string(f.type)
+            or pa.types.is_large_string(f.type)
+        ):
+            dictionary = dict_stash[f"_{f.name}_dict"]
+        cols[f.name] = JaxColumn(
+            f.type, padded[f.name], mask, dictionary, None
+        )
     return JaxDataFrame(
         JaxBlocks(
             nrows_out,

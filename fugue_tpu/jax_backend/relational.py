@@ -33,6 +33,7 @@ from fugue_tpu.jax_backend import groupby
 from fugue_tpu.jax_backend.blocks import (
     JaxBlocks,
     JaxColumn,
+    jit_row_sharded,
     on_mesh,
     padded_len,
     row_sharding,
@@ -65,11 +66,12 @@ def _mesh_scoped(pos: int) -> Any:
 
 
 def harmonize_string_keys(
-    c1: JaxColumn, c2: JaxColumn
+    c1: JaxColumn, c2: JaxColumn, mesh: Any
 ) -> Tuple[JaxColumn, JaxColumn, np.ndarray]:
     """Re-encode two dictionary columns into one shared dictionary.
     Side 1 keeps its codes (the union dictionary extends side 1's);
-    side 2's codes are remapped with one device table-gather."""
+    side 2's codes are remapped with one device table-gather (a
+    row-sharded jitted program: multihost-safe)."""
     d1, d2 = c1.dictionary, c2.dictionary
     if d1 is d2 or (len(d1) == len(d2) and (d1 == d2).all()):
         return c1, c2, d1
@@ -87,7 +89,14 @@ def harmonize_string_keys(
         if extra
         else d1
     )
-    new_codes2 = jnp.asarray(map2)[jnp.clip(c2.data, 0, max(len(d2) - 1, 0))]
+    p2 = int(c2.data.shape[0])
+    hi2 = max(len(d2) - 1, 0)
+    remap = jit_row_sharded(
+        mesh,
+        ("dict_remap", p2, len(map2), hi2),
+        lambda m, c: m[jnp.clip(c, 0, hi2)],
+    )
+    new_codes2 = remap(map2, c2.data)
     hi = max(len(union) - 1, 0)
     out1 = JaxColumn(c1.pa_type, c1.data, c1.mask, union, (0, hi))
     out2 = JaxColumn(c2.pa_type, new_codes2, c2.mask, union, (0, hi))
@@ -109,43 +118,88 @@ def concat_key_blocks(
     """A combined frame holding both sides' key columns stacked along the
     row axis (side 1 rows first). Padding rows of each side stay invalid,
     so no compaction is needed — factorization sees them as non-rows.
-    Returns (combined, p1, p2) where p1/p2 are each side's padded length."""
+    Returns (combined, p1, p2) where p1/p2 are each side's padded length.
+
+    All arrays are built inside ONE row-sharded jitted program
+    (multihost-safe: eager concatenates would commit to a process-local
+    device and device_put can't reshard across hosts)."""
+    mesh = b1.mesh
     p1, p2 = b1.padded_nrows, b2.padded_nrows
-    sharding = row_sharding(b1.mesh)
-    cols: Dict[str, JaxColumn] = {}
+    pairs: Dict[str, Tuple[JaxColumn, JaxColumn]] = {}
     for k in keys:
         c1, c2 = b1.columns[k], b2.columns[k]
         if c1.is_string:
-            c1, c2, _ = harmonize_string_keys(c1, c2)
-        dt = _common_dtype(c1.data.dtype, c2.data.dtype)
-        data = jnp.concatenate([c1.data.astype(dt), c2.data.astype(dt)])
-        if c1.mask is not None or c2.mask is not None:
-            m1 = (
-                c1.mask
-                if c1.mask is not None
-                else jnp.ones((p1,), dtype=bool)
+            c1, c2, _ = harmonize_string_keys(c1, c2, mesh)
+        pairs[k] = (c1, c2)
+    dts = {
+        k: _common_dtype(c1.data.dtype, c2.data.dtype)
+        for k, (c1, c2) in pairs.items()
+    }
+    masked = tuple(
+        sorted(
+            k
+            for k, (c1, c2) in pairs.items()
+            if c1.mask is not None or c2.mask is not None
+        )
+    )
+
+    def _prog(
+        d1: Dict[str, Any],
+        d2: Dict[str, Any],
+        m1: Dict[str, Any],
+        m2: Dict[str, Any],
+        rv1: Optional[Any],
+        n1: Any,
+        rv2: Optional[Any],
+        n2: Any,
+    ) -> Tuple[Dict[str, Any], Dict[str, Any], Any]:
+        data = {
+            k: jnp.concatenate(
+                [d1[k].astype(dts[k]), d2[k].astype(dts[k])]
             )
-            m2 = (
-                c2.mask
-                if c2.mask is not None
-                else jnp.ones((p2,), dtype=bool)
+            for k in d1
+        }
+        mask = {
+            k: jnp.concatenate(
+                [
+                    m1.get(k, jnp.ones((p1,), dtype=bool)),
+                    m2.get(k, jnp.ones((p2,), dtype=bool)),
+                ]
             )
-            mask: Optional[Any] = jax.device_put(
-                jnp.concatenate([m1, m2]), sharding
-            )
-        else:
-            mask = None
+            for k in masked
+        }
+        v1 = groupby.materialize_validity(rv1, p1, n1)
+        v2 = groupby.materialize_validity(rv2, p2, n2)
+        return data, mask, jnp.concatenate([v1, v2])
+
+    prog = jit_row_sharded(
+        mesh,
+        (
+            "concat_keys", p1, p2, tuple(sorted(pairs)), masked,
+            tuple(str(dts[k]) for k in sorted(dts)),
+        ),
+        _prog,
+    )
+    data, mask, row_valid = prog(
+        {k: c1.data for k, (c1, _) in pairs.items()},
+        {k: c2.data for k, (_, c2) in pairs.items()},
+        {k: c1.mask for k, (c1, _) in pairs.items() if c1.mask is not None},
+        {k: c2.mask for k, (_, c2) in pairs.items() if c2.mask is not None},
+        b1.row_valid,
+        _nrows_arg(b1),
+        b2.row_valid,
+        _nrows_arg(b2),
+    )
+    cols: Dict[str, JaxColumn] = {}
+    for k, (c1, c2) in pairs.items():
         cols[k] = JaxColumn(
             c1.pa_type,
-            jax.device_put(data, sharding),
-            mask,
+            data[k],
+            mask.get(k),
             c1.dictionary,
             _merged_stats(c1, c2),
         )
-    row_valid = jax.device_put(
-        jnp.concatenate([b1.validity(), b2.validity()]), sharding
-    )
-    combined = JaxBlocks(None, cols, b1.mesh, row_valid=row_valid)
+    combined = JaxBlocks(None, cols, mesh, row_valid=row_valid)
     return combined, p1, p2
 
 
@@ -174,8 +228,19 @@ def shared_factorize(
 ) -> SharedFactorization:
     combined, p1, p2 = concat_key_blocks(b1, b2, keys)
     fr = groupby.factorize_keys(combined, keys)
+    # split through a row-sharded program: an eager slice of a
+    # process-spanning array is not multihost-safe
+    split = jit_row_sharded(
+        b1.mesh,
+        ("seg_split", p1, p2),
+        lambda s: (
+            jax.lax.slice(s, (0,), (p1,)),
+            jax.lax.slice(s, (p1,), (p1 + p2,)),
+        ),
+    )
+    seg1, seg2 = split(fr.seg)
     return SharedFactorization(
-        fr.seg[:p1], fr.seg[p1:], fr.num_segments, b1, b2, keys
+        seg1, seg2, fr.num_segments, b1, b2, keys
     )
 
 
@@ -435,7 +500,7 @@ def expand_join(
     if how == "fullouter":
         for k in keys:
             c1h, c2h, _ = (
-                harmonize_string_keys(d1[k], b2.columns[k])
+                harmonize_string_keys(d1[k], b2.columns[k], mesh)
                 if d1[k].is_string
                 else (d1[k], b2.columns[k], None)
             )
@@ -744,40 +809,85 @@ def _null_device_dtype(tp: pa.DataType) -> Any:
 @_mesh_scoped(0)
 def union_all_blocks(b1: JaxBlocks, b2: JaxBlocks) -> JaxBlocks:
     """Concatenate two frames along the row axis. Padding rows of each side
-    remain invalid under the combined mask — no compaction, no sync."""
-    sharding = row_sharding(b1.mesh)
-    cols: Dict[str, JaxColumn] = {}
+    remain invalid under the combined mask — no compaction, no sync. All
+    arrays come from one row-sharded jitted program (multihost-safe —
+    see concat_key_blocks)."""
+    mesh = b1.mesh
     p1, p2 = b1.padded_nrows, b2.padded_nrows
+    pairs: Dict[str, Tuple[JaxColumn, JaxColumn]] = {}
     for n, c1 in b1.columns.items():
         c2 = b2.columns[n]
-        need_mask = c1.mask is not None or c2.mask is not None
         if c1.is_string:
-            c1, c2, _ = harmonize_string_keys(c1, c2)
-        dt = _common_dtype(c1.data.dtype, c2.data.dtype)
-        data = jnp.concatenate([c1.data.astype(dt), c2.data.astype(dt)])
-        mask: Optional[Any] = None
-        if need_mask:
-            m1 = (
-                c1.mask
-                if c1.mask is not None
-                else jnp.ones((p1,), dtype=bool)
+            c1, c2, _ = harmonize_string_keys(c1, c2, mesh)
+        pairs[n] = (c1, c2)
+    dts = {
+        n: _common_dtype(c1.data.dtype, c2.data.dtype)
+        for n, (c1, c2) in pairs.items()
+    }
+    masked = tuple(
+        sorted(
+            n
+            for n, (c1, c2) in pairs.items()
+            if c1.mask is not None or c2.mask is not None
+        )
+    )
+
+    def _prog(
+        d1: Dict[str, Any],
+        d2: Dict[str, Any],
+        m1: Dict[str, Any],
+        m2: Dict[str, Any],
+        rv1: Optional[Any],
+        n1: Any,
+        rv2: Optional[Any],
+        n2: Any,
+    ) -> Tuple[Dict[str, Any], Dict[str, Any], Any]:
+        data = {
+            n: jnp.concatenate(
+                [d1[n].astype(dts[n]), d2[n].astype(dts[n])]
             )
-            m2 = (
-                c2.mask
-                if c2.mask is not None
-                else jnp.ones((p2,), dtype=bool)
+            for n in d1
+        }
+        mask = {
+            n: jnp.concatenate(
+                [
+                    m1.get(n, jnp.ones((p1,), dtype=bool)),
+                    m2.get(n, jnp.ones((p2,), dtype=bool)),
+                ]
             )
-            mask = jax.device_put(jnp.concatenate([m1, m2]), sharding)
+            for n in masked
+        }
+        v1 = groupby.materialize_validity(rv1, p1, n1)
+        v2 = groupby.materialize_validity(rv2, p2, n2)
+        return data, mask, jnp.concatenate([v1, v2])
+
+    prog = jit_row_sharded(
+        mesh,
+        (
+            "union_all", p1, p2, tuple(sorted(pairs)), masked,
+            tuple(str(dts[n]) for n in sorted(dts)),
+        ),
+        _prog,
+    )
+    data, mask, row_valid = prog(
+        {n: c1.data for n, (c1, _) in pairs.items()},
+        {n: c2.data for n, (_, c2) in pairs.items()},
+        {n: c1.mask for n, (c1, _) in pairs.items() if c1.mask is not None},
+        {n: c2.mask for n, (_, c2) in pairs.items() if c2.mask is not None},
+        b1.row_valid,
+        _nrows_arg(b1),
+        b2.row_valid,
+        _nrows_arg(b2),
+    )
+    cols: Dict[str, JaxColumn] = {}
+    for n, (c1, c2) in pairs.items():
         cols[n] = JaxColumn(
             c1.pa_type,
-            jax.device_put(data, sharding),
-            mask,
+            data[n],
+            mask.get(n),
             c1.dictionary,
             _merged_stats(c1, c2),
         )
-    row_valid = jax.device_put(
-        jnp.concatenate([b1.validity(), b2.validity()]), sharding
-    )
     nrows = (
         b1._nrows + b2._nrows
         if b1.nrows_known and b2.nrows_known
@@ -787,7 +897,7 @@ def union_all_blocks(b1: JaxBlocks, b2: JaxBlocks) -> JaxBlocks:
     if nrows is None:
         nrows_dev = b1.nrows_scalar + b2.nrows_scalar
     return JaxBlocks(
-        nrows, cols, b1.mesh, row_valid=row_valid, nrows_dev=nrows_dev
+        nrows, cols, mesh, row_valid=row_valid, nrows_dev=nrows_dev
     )
 
 
