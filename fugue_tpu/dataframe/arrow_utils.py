@@ -153,8 +153,14 @@ def cast_table(table: pa.Table, schema: Schema) -> pa.Table:
         if combined.type == field.type:
             arrays.append(combined)
         elif pa.types.is_string(field.type) and pa.types.is_timestamp(combined.type):
-            # arrow's native timestamp->string keeps " " separator; fine
-            arrays.append(combined.cast(field.type))
+            # seconds precision like python str(datetime) — arrow's
+            # native cast appends ".000000" (reference renders
+            # "2020-01-01 03:04:05", fugue_test/dataframe_suite.py:372)
+            vals = [
+                None if v is None else str(v)
+                for v in combined.to_pylist()
+            ]
+            arrays.append(pa.array(vals, type=pa.string()))
         elif pa.types.is_string(field.type) and pa.types.is_boolean(combined.type):
             # match python str(bool) casing: True/False
             vals = [None if v is None else str(v) for v in combined.to_pylist()]

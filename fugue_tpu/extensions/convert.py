@@ -293,8 +293,13 @@ class _FuncAsCreator(_FuncExtension, Creator):
 
 
 class _FuncAsProcessor(_FuncExtension, Processor):
-    def __init__(self, wrapper: DataFrameFunctionWrapper, schema: Any):
-        super().__init__(wrapper, {})
+    def __init__(
+        self,
+        wrapper: DataFrameFunctionWrapper,
+        schema: Any,
+        validation: Optional[Dict[str, Any]] = None,
+    ):
+        super().__init__(wrapper, validation or {})
         self._schema_hint = schema
 
     def process(self, dfs: DataFrames) -> DataFrame:
@@ -320,10 +325,11 @@ class _FuncAsProcessor(_FuncExtension, Processor):
     def from_func(func: Callable, schema: Any) -> "_FuncAsProcessor":
         if schema is None:
             schema = parse_comment_annotation(func, "schema")
+        validation = validate_rules(parse_validation_rules_from_comment(func))
         wrapper = DataFrameFunctionWrapper(
             func, f"^e?(c|{_DF}+)x*$", f"^{_DF}$"
         )
-        return _FuncAsProcessor(wrapper, schema)
+        return _FuncAsProcessor(wrapper, schema, validation)
 
 
 class _FuncAsOutputter(_FuncExtension, Outputter):
@@ -339,8 +345,9 @@ class _FuncAsOutputter(_FuncExtension, Outputter):
 
     @staticmethod
     def from_func(func: Callable) -> "_FuncAsOutputter":
+        validation = validate_rules(parse_validation_rules_from_comment(func))
         wrapper = DataFrameFunctionWrapper(func, f"^e?(c|{_DF}+)x*$", "^.*$")
-        return _FuncAsOutputter(wrapper, {})
+        return _FuncAsOutputter(wrapper, validation)
 
 
 # ---- converters ------------------------------------------------------------

@@ -227,6 +227,7 @@ def compiled_comap(
         _StringDictUnavailable,
         _is_dict_key,
         _nrows_arg,
+        _pad_to,
     )
     from fugue_tpu.jax_backend.dataframe import JaxDataFrame
 
@@ -471,16 +472,7 @@ def compiled_comap(
     )
 
     def _pad_prog(arrs: Dict[str, Any]) -> Dict[str, Any]:
-        return {
-            k: (
-                v
-                if int(v.shape[0]) == target
-                else jnp.concatenate(
-                    [v, jnp.zeros((target - int(v.shape[0]),), v.dtype)]
-                )
-            )
-            for k, v in arrs.items()
-        }
+        return {k: _pad_to(v, target) for k, v in arrs.items()}
 
     padded = jit_row_sharded(
         mesh, ("comap_pad", target, sig), _pad_prog

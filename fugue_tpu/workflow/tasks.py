@@ -3,6 +3,10 @@
 
 from typing import Any, Callable, Dict, List, Optional
 
+from fugue_tpu.extensions.validation import (
+    validate_input_schema,
+    validate_partition_spec,
+)
 from fugue_tpu.collections.partition import PartitionSpec
 from fugue_tpu.collections.yielded import PhysicalYielded, Yielded
 from fugue_tpu.dataframe import DataFrame, DataFrames
@@ -141,12 +145,17 @@ class ProcessTask(FugueTask):
     """Wrap a Processor (reference _tasks.py:243)."""
 
     def execute(self, ctx: TaskContext, inputs: List[DataFrame]) -> DataFrame:
+        # validations are declarations about the WORKFLOW, not the data:
+        # they must fire even when the task result is checkpoint-cached
+        processor = _to_processor(self.extension, self.schema)
+        self._setup_extension(processor, ctx)
+        validate_partition_spec(processor.validation_rules, self.partition_spec)
+        dfs = self._make_dfs(ctx, inputs)
+        for in_df in dfs.values():
+            validate_input_schema(processor.validation_rules, in_df.schema)
         cached = self._try_skip(ctx)
         if cached is not None:
             return cached
-        processor = _to_processor(self.extension, self.schema)
-        self._setup_extension(processor, ctx)
-        dfs = self._make_dfs(ctx, inputs)
         df = processor.process(dfs)
         return self._finalize(ctx, ctx.engine.to_df(df))
 
@@ -164,10 +173,13 @@ class OutputTask(FugueTask):
     def execute(self, ctx: TaskContext, inputs: List[DataFrame]) -> Optional[DataFrame]:
         outputter = _to_outputter(self.extension)
         self._setup_extension(outputter, ctx)
+        validate_partition_spec(outputter.validation_rules, self.partition_spec)
         if self.input_names is not None:
             dfs = DataFrames(dict(zip(self.input_names, inputs)))
         else:
             dfs = DataFrames(inputs)
+        for in_df in dfs.values():
+            validate_input_schema(outputter.validation_rules, in_df.schema)
         outputter.process(dfs)
         # pass through the first input so dependents can still reference it
         return inputs[0] if len(inputs) > 0 else None

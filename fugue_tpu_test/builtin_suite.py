@@ -754,3 +754,148 @@ class BuiltInTests:
             assert set(hits) >= {
                 "pandas", "rows", "iter_pd", "arrow", "iter_arrow", "gen"
             }, hits
+
+        def test_transform_annotation_matrix(self):
+            # the INPUT x OUTPUT annotation matrix of transform()
+            # (reference builtin_suite.py:400-511): arrow in/out,
+            # dict-rows in/out, rows->pandas, pandas->rows — every
+            # combination round-trips values and nulls
+            from typing import Dict as _Dict, Iterator
+
+            import pyarrow as pa
+
+            dag = self.dag()
+            a = dag.df([[1, "a"], [2, None]], "x:long,y:str")
+
+            def arrow_in_out(df: pa.Table) -> pa.Table:
+                return df.set_column(
+                    0, "x", pa.compute.add(df.column("x"), 10)
+                )
+
+            dag.df([[1, "a"], [2, None]], "x:long,y:str").transform(
+                arrow_in_out, schema="*"
+            ).assert_eq(dag.df([[11, "a"], [12, None]], "x:long,y:str"))
+
+            def dicts_in_rows_out(
+                rows: Iterable[_Dict[str, Any]],
+            ) -> List[List[Any]]:
+                return [[r["x"] * 2, r["y"]] for r in rows]
+
+            a.transform(dicts_in_rows_out, schema="x:long,y:str").assert_eq(
+                dag.df([[2, "a"], [4, None]], "x:long,y:str")
+            )
+
+            def rows_in_pandas_out(
+                rows: List[List[Any]],
+            ) -> pd.DataFrame:
+                return pd.DataFrame(
+                    {"x": [r[0] for r in rows], "y": [r[1] for r in rows]}
+                )
+
+            a.transform(rows_in_pandas_out, schema="x:long,y:str").assert_eq(
+                dag.df([[1, "a"], [2, None]], "x:long,y:str")
+            )
+
+            def pandas_in_dicts_out(
+                df: pd.DataFrame,
+            ) -> Iterator[_Dict[str, Any]]:
+                for _, r in df.iterrows():
+                    yield dict(x=int(r["x"]) + 100, y=r["y"])
+
+            a.transform(pandas_in_dicts_out, schema="x:long,y:str").assert_eq(
+                dag.df([[101, "a"], [102, None]], "x:long,y:str")
+            )
+            self.run(dag)
+
+        def test_processor_validation(self):
+            # processors carry the same validation-comment machinery as
+            # transformers (reference builtin_suite.py:1429)
+            # partitionby_has: k
+            def p(df: pd.DataFrame) -> pd.DataFrame:
+                return df
+
+            dag = self.dag()
+            a = dag.df([[1, "a"]], "x:long,k:str")
+            a.process(p, schema="x:long,k:str")
+            with pytest.raises(FugueWorkflowCompileValidationError):
+                self.run(dag)
+            # satisfying the rule runs clean
+            dag = self.dag()
+            a = dag.df([[1, "a"]], "x:long,k:str")
+            a.partition(by=["k"]).process(p, schema="x:long,k:str")
+            self.run(dag)
+
+        def test_outputter_validation(self):
+            # input_has is a RUNTIME validation on outputters
+            # (reference builtin_suite.py:1476)
+            from fugue_tpu.exceptions import (
+                FugueWorkflowRuntimeValidationError,
+            )
+
+            # input_has: zz
+            def out(df: pd.DataFrame) -> None:
+                pass
+
+            dag = self.dag()
+            a = dag.df([[1]], "x:long")
+            a.output(out)
+            with pytest.raises(FugueWorkflowRuntimeValidationError):
+                self.run(dag)
+
+            # input_has: x
+            def out2(df: pd.DataFrame) -> None:
+                assert list(df.columns) == ["x"]
+
+            dag = self.dag()
+            a = dag.df([[1]], "x:long")
+            a.output(out2)
+            self.run(dag)
+
+        def test_cotransform_key_access(self):
+            # per-group key values through the cursor in a class-based
+            # cotransformer (reference builtin_suite.py:595-632)
+            from fugue_tpu.extensions import CoTransformer
+
+            class KeyAware(CoTransformer):
+                def get_output_schema(self, dfs: DataFrames) -> Any:
+                    return "k:str,na:long,nb:long"
+
+                def transform(self, dfs: DataFrames) -> LocalDataFrame:
+                    k = self.cursor.key_value_dict["k"]
+                    return ArrayDataFrame(
+                        [[k, dfs[0].count(), dfs[1].count()]],
+                        "k:str,na:long,nb:long",
+                    )
+
+            dag = self.dag()
+            a = dag.df([["x", 1], ["x", 2], ["y", 3]], "k:str,v:long")
+            b = dag.df([["x", 10]], "k:str,w:long")
+            z = a.partition_by("k").zip(b, how="left_outer")
+            res = z.transform(KeyAware)
+            res.assert_eq(
+                dag.df([["x", 2, 1], ["y", 1, 0]], "k:str,na:long,nb:long")
+            )
+            self.run(dag)
+
+        def test_transform_schema_expressions(self):
+            # schema hint arithmetic: *, +col, -col and replacements
+            # (reference builtin_suite.py transform schema handling)
+            dag = self.dag()
+            a = dag.df([[1, "a", 2.0]], "x:long,y:str,z:double")
+
+            def add(df: pd.DataFrame) -> pd.DataFrame:
+                return df.assign(w=1)
+
+            dag.df([[1, "a", 2.0]], "x:long,y:str,z:double").transform(
+                add, schema="*,w:long"
+            ).assert_eq(
+                dag.df([[1, "a", 2.0, 1]], "x:long,y:str,z:double,w:long")
+            )
+
+            def drop_y(df: pd.DataFrame) -> pd.DataFrame:
+                return df.drop(columns=["y"])
+
+            a.transform(drop_y, schema="*,-y").assert_eq(
+                dag.df([[1, 2.0]], "x:long,z:double")
+            )
+            self.run(dag)

@@ -199,6 +199,186 @@ class DataFrameTests:
             with pytest.raises(Exception):
                 df.alter_columns("x:long")
 
+        def test_alter_columns_full_matrix(self):
+            # the full conversion matrix the reference suite pins
+            # (fugue_test/dataframe_suite.py:298-430), with nulls riding
+            # through every cast
+            # bool -> str (capitalization may vary by backend)
+            df = self.df(
+                [["a", True], ["b", False], ["c", None]], "a:str,b:bool"
+            )
+            got = df.alter_columns("b:str").as_array(type_safe=True)
+            assert got in (
+                [["a", "True"], ["b", "False"], ["c", None]],
+                [["a", "true"], ["b", "false"], ["c", None]],
+            ), got
+            # int -> str with a null (pandas may surface "1.0")
+            df = self.df([["a", 1], ["c", None]], "a:str,b:int")
+            got = df.alter_columns("b:str").as_array(type_safe=True)
+            assert got in (
+                [["a", "1"], ["c", None]],
+                [["a", "1.0"], ["c", None]],
+            ), got
+            # int -> double keeps values and nulls
+            df = self.df([["a", 1], ["c", None]], "a:str,b:int")
+            df2 = df.alter_columns("b:double")
+            assert df2.schema == "a:str,b:double"
+            assert df2.as_array(type_safe=True) == [["a", 1.0], ["c", None]]
+            # double -> str
+            df = self.df([["a", 1.1], ["b", None]], "a:str,b:double")
+            assert df.alter_columns("b:str").as_array(type_safe=True) == [
+                ["a", "1.1"], ["b", None],
+            ]
+            # double -> int (whole values only)
+            df = self.df([["a", 1.0], ["b", None]], "a:str,b:double")
+            assert df.alter_columns("b:int").as_array(type_safe=True) == [
+                ["a", 1], ["b", None],
+            ]
+            # date -> str
+            df = self.df(
+                [["a", date(2020, 1, 1)], ["b", date(2020, 1, 2)],
+                 ["c", None]],
+                "a:str,b:date",
+            )
+            assert df.alter_columns("b:str").as_array(type_safe=True) == [
+                ["a", "2020-01-01"], ["b", "2020-01-02"], ["c", None],
+            ]
+            # datetime -> str
+            df = self.df(
+                [["a", datetime(2020, 1, 1, 3, 4, 5)],
+                 ["b", datetime(2020, 1, 2, 16, 7, 8)], ["c", None]],
+                "a:str,b:datetime",
+            )
+            assert df.alter_columns("b:str").as_array(type_safe=True) == [
+                ["a", "2020-01-01 03:04:05"],
+                ["b", "2020-01-02 16:07:08"],
+                ["c", None],
+            ]
+            # str -> bool folds case, keeps nulls
+            df = self.df(
+                [["a", "trUe"], ["b", "False"], ["c", None]], "a:str,b:str"
+            )
+            df2 = df.alter_columns("b:bool,a:str")
+            assert df2.schema == "a:str,b:bool"
+            assert df2.as_array(type_safe=True) == [
+                ["a", True], ["b", False], ["c", None],
+            ]
+            # str -> double incl. integral text
+            df = self.df(
+                [["a", "1.1"], ["b", "2"], ["c", None]], "a:str,b:str"
+            )
+            assert df.alter_columns("b:double").as_array(type_safe=True) == [
+                ["a", 1.1], ["b", 2.0], ["c", None],
+            ]
+            # str -> date and MULTI-column alter in one spec
+            df = self.df(
+                [["1", "2020-01-01"], ["2", "2020-01-02"], ["3", None]],
+                "a:str,b:str",
+            )
+            df2 = df.alter_columns("b:date,a:int")
+            assert df2.schema == "a:int,b:date"
+            assert df2.as_array(type_safe=True) == [
+                [1, date(2020, 1, 1)],
+                [2, date(2020, 1, 2)],
+                [3, None],
+            ]
+            # str -> datetime
+            df = self.df(
+                [["1", "2020-01-01 01:02:03"], ["2", None]], "a:str,b:str"
+            )
+            df2 = df.alter_columns("b:datetime,a:int")
+            assert df2.as_array(type_safe=True) == [
+                [1, datetime(2020, 1, 1, 1, 2, 3)], [2, None],
+            ]
+
+        def test_alter_columns_empty_and_order(self):
+            # empty frames cast schema-only
+            df = self.df([], "a:str,b:int")
+            df2 = df.alter_columns("a:str,b:str")
+            assert df2.schema == "a:str,b:str"
+            assert df2.as_array(type_safe=True) == []
+            # a no-change spec listed in a different order keeps the
+            # frame's column order AND values
+            df = self.df([["a", 1], ["c", None]], "a:str,b:int")
+            df2 = df.alter_columns("b:int,a:str")
+            assert df2.schema == "a:str,b:int"
+            assert df2.as_array(type_safe=True) == [["a", 1], ["c", None]]
+
+        def test_alter_columns_invalid_conversion(self):
+            # non-numeric text -> int must raise (lazily materialized
+            # frames may defer the error to materialization)
+            with pytest.raises(Exception):
+                df = self.df(
+                    [["1", "x"], ["2", "y"], ["3", None]], "a:str,b:str"
+                )
+                df.alter_columns("b:int").as_array(type_safe=True)
+
+        def test_rename_battery(self):
+            # empty rename map: schema and values unchanged
+            df = self.df([[0, 1, 2]], "a:long,b:long,c:long")
+            df2 = df.rename({})
+            assert df2.schema == "a:long,b:long,c:long"
+            assert df2.as_array() == [[0, 1, 2]]
+            # underscore-prefixed names rename cleanly
+            df = self.df([[0, 1, 2]], "_0:long,_1:long,_2:long")
+            df2 = df.rename({"_0": "x0", "_1": "x1", "_2": "x2"})
+            assert df2.schema.names == ["x0", "x1", "x2"]
+            assert df2.as_array() == [[0, 1, 2]]
+            # chained renames compose
+            df = self.df([[1, "a"]], "a:long,b:str")
+            df2 = df.rename(dict(a="x")).rename(dict(x="y"))
+            assert df2.schema == "y:long,b:str"
+            assert df2.as_array() == [[1, "a"]]
+            # a three-way rotation is a valid simultaneous rename
+            df = self.df([[1, 2, 3]], "a:long,b:long,c:long")
+            df2 = df.rename(dict(a="b", b="c", c="a"))
+            assert df2.schema == "b:long,c:long,a:long"
+            assert df2.as_array() == [[1, 2, 3]]
+            # renaming a subset keeps the other columns in place
+            df = self.df([[1, 2, 3]], "a:long,b:long,c:long")
+            df2 = df.rename(dict(b="bb"))
+            assert df2.schema == "a:long,bb:long,c:long"
+
+        def test_drop_keeps_types_and_nulls(self):
+            df = self.df(
+                [[1, None, 2.0], [None, "x", None]],
+                "a:long,b:str,c:double",
+            )
+            df2 = df.drop(["a"])
+            assert df2.schema == "b:str,c:double"
+            assert df2.as_array(type_safe=True) == [
+                [None, 2.0], ["x", None],
+            ]
+            df3 = self.df(
+                [[1, None, 2.0], [None, "x", None]],
+                "a:long,b:str,c:double",
+            )[["c", "b"]]
+            assert df3.schema == "c:double,b:str"
+            assert df3.as_array(type_safe=True) == [
+                [2.0, None], [None, "x"],
+            ]
+
+        def test_as_arrow_roundtrip_all_types(self):
+            import pyarrow as pa
+
+            df = self.df(
+                [
+                    [1, 1.5, "x", True, date(2020, 1, 2),
+                     datetime(2021, 2, 3, 4, 5, 6)],
+                    [None, None, None, None, None, None],
+                ],
+                "a:long,b:double,c:str,d:bool,e:date,f:datetime",
+            )
+            t = df.as_arrow()
+            assert t.num_rows == 2
+            assert pa.types.is_int64(t.schema.field("a").type)
+            assert pa.types.is_float64(t.schema.field("b").type)
+            assert pa.types.is_boolean(t.schema.field("d").type)
+            assert pa.types.is_date32(t.schema.field("e").type)
+            assert pa.types.is_timestamp(t.schema.field("f").type)
+            # every null survived the round trip
+            assert [c.null_count for c in t.columns] == [1] * 6
+
         # ---- head / local -------------------------------------------
         def test_head(self):
             df = self.df([[i, str(i)] for i in range(5)], "a:long,b:str")
