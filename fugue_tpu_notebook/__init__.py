@@ -27,3 +27,18 @@ def setup(notebook_setup: Optional[Any] = None) -> None:
     if ip is None:  # pragma: no cover - notebook only
         raise RuntimeError("setup() must run inside IPython/Jupyter")
     _setup_fugue_notebook(ip, notebook_setup)
+
+
+def _jupyter_nbextension_paths():
+    """Classic-notebook extension metadata so ``jupyter nbextension
+    install --py fugue_tpu_notebook`` finds the FugueSQL cell
+    highlighter (component parity: the reference's fugue_notebook
+    nbextension)."""
+    return [
+        dict(
+            section="notebook",
+            src="nbextension",
+            dest="fugue_tpu_notebook",
+            require="fugue_tpu_notebook/main",
+        )
+    ]

@@ -1,0 +1,80 @@
+// FugueSQL syntax highlighting for classic Jupyter Notebook cells
+// (component parity: the reference ships an nbextension that teaches
+// CodeMirror to highlight %%fsql cells as SQL instead of Python).
+//
+// Loading: `jupyter nbextension install --py fugue_tpu_notebook` then
+// `jupyter nbextension enable fugue_tpu_notebook/main`. The `%load_ext
+// fugue_tpu_notebook` magic works without this file (it only registers
+// the %%fsql magic and HTML display); the highlighter is an optional
+// front-end add-on, like the reference's.
+define([
+  "base/js/namespace",
+  "codemirror/lib/codemirror",
+], function (Jupyter, CodeMirror) {
+  "use strict";
+
+  var MAGIC = /^%%fsql\b/;
+
+  // FugueSQL extends SQL with workflow keywords; register a thin mode
+  // that layers them over CodeMirror's text/x-sql.
+  var EXTRA = (
+    "transform outtransform process output create load save zip take " +
+    "sample print persist broadcast checkpoint yield dataframe file " +
+    "using presort prepartition single fillna dropna connect"
+  ).split(" ");
+
+  CodeMirror.defineMode("fuguesql", function (config) {
+    var sql = CodeMirror.getMode(config, "text/x-sql");
+    return {
+      startState: function () {
+        return { sub: CodeMirror.startState(sql) };
+      },
+      copyState: function (s) {
+        return { sub: CodeMirror.copyState(sql, s.sub) };
+      },
+      token: function (stream, state) {
+        var style = sql.token(stream, state.sub);
+        if (style === null || style === "variable") {
+          var word = stream.current().toLowerCase();
+          if (EXTRA.indexOf(word) >= 0) return "keyword";
+        }
+        return style;
+      },
+    };
+  });
+  CodeMirror.defineMIME("text/x-fuguesql", "fuguesql");
+
+  function highlightCell(cell) {
+    if (!cell || cell.cell_type !== "code" || !cell.code_mirror) return;
+    var text = cell.get_text();
+    var want = MAGIC.test(text) ? "fuguesql" : null;
+    var cur = cell.code_mirror.getOption("mode");
+    if (want && cur !== "fuguesql") {
+      cell.code_mirror.setOption("mode", "fuguesql");
+    } else if (!want && cur === "fuguesql") {
+      cell.code_mirror.setOption(
+        "mode", cell.notebook.codemirror_mode || "ipython"
+      );
+    }
+  }
+
+  function refreshAll() {
+    Jupyter.notebook.get_cells().forEach(highlightCell);
+  }
+
+  function load_ipython_extension() {
+    // highlight existing cells and re-check a cell whenever it changes
+    refreshAll();
+    Jupyter.notebook.events.on("create.Cell", function (_e, data) {
+      highlightCell(data.cell);
+    });
+    Jupyter.notebook.events.on("edit_mode.Cell", function (_e, data) {
+      highlightCell(data.cell);
+    });
+    Jupyter.notebook.events.on(
+      "notebook_loaded.Notebook", refreshAll
+    );
+  }
+
+  return { load_ipython_extension: load_ipython_extension };
+});

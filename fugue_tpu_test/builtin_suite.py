@@ -763,6 +763,7 @@ class BuiltInTests:
             from typing import Dict as _Dict, Iterator
 
             import pyarrow as pa
+            import pyarrow.compute  # noqa: F401  (pa.compute below)
 
             dag = self.dag()
             a = dag.df([[1, "a"], [2, None]], "x:long,y:str")

@@ -73,3 +73,25 @@ def test_viz_partitioned():
         "viz", params={"func": "line", "x": "x", "y": "y"}
     )
     dag.run("native")
+
+
+def test_nbextension_metadata_and_asset():
+    # the classic-notebook highlighter ships with install metadata
+    # (component parity: reference fugue_notebook/nbextension/main.js)
+    import os
+
+    import fugue_tpu_notebook
+
+    paths = fugue_tpu_notebook._jupyter_nbextension_paths()
+    assert paths[0]["require"] == "fugue_tpu_notebook/main"
+    asset = os.path.join(
+        os.path.dirname(fugue_tpu_notebook.__file__),
+        paths[0]["src"], "main.js",
+    )
+    with open(asset) as f:
+        js = f.read()
+    # the three load-bearing pieces: the magic detector, the CodeMirror
+    # mode registration, and the loader entry point
+    assert "%%fsql" in js
+    assert "defineMode" in js and "fuguesql" in js
+    assert "load_ipython_extension" in js
