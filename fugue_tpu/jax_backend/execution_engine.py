@@ -94,22 +94,33 @@ class JaxMapEngine(MapEngine):
         output_schema = Schema(output_schema)
         if map_func_format_hint == "jax":
             raw = self._extract_jax_func(map_func)
-            jdf = engine.to_df(df)
-            if raw is not None and self._device_mappable(
-                jdf, output_schema, partition_spec
+            if raw is not None and getattr(
+                getattr(map_func, "__self__", None), "ignore_errors", ()
             ):
-                try:
-                    return self._compiled_map(
-                        jdf, raw, output_schema, partition_spec, on_init
-                    )
-                except _StringDictUnavailable as e:
-                    engine._count_fallback(
-                        "map", f"string output '{e}' has no dictionary source"
-                    )
-            else:
+                # per-partition error swallowing can't run whole-shard:
+                # the host loop owns that semantics (same rule as comap);
+                # counted ONCE here, so skip the not-mappable counter
                 engine._count_fallback(
-                    "map", "jax-hinted transformer not device-mappable"
+                    "map", "ignore_errors needs the host partition loop"
                 )
+            else:
+                jdf = engine.to_df(df)
+                if raw is not None and self._device_mappable(
+                    jdf, output_schema, partition_spec
+                ):
+                    try:
+                        return self._compiled_map(
+                            jdf, raw, output_schema, partition_spec, on_init
+                        )
+                    except _StringDictUnavailable as e:
+                        engine._count_fallback(
+                            "map",
+                            f"string output '{e}' has no dictionary source",
+                        )
+                else:
+                    engine._count_fallback(
+                        "map", "jax-hinted transformer not device-mappable"
+                    )
         # host fallback: exact reference semantics via the pandas map engine;
         # fugue.jax.default.partitions sets the split count when the spec
         # doesn't name one

@@ -98,3 +98,32 @@ def test_graft_entry_step_on_native():
         partition={"by": ["k"]}, engine="native", as_fugue=True,
     )
     assert len(out.as_array()) == 24
+
+
+def test_jax_transformer_ignore_errors_uses_host_loop():
+    # per-partition error swallowing can't run whole-shard: the host
+    # partition loop must run (counted), skipping the failing partition
+    from typing import Dict
+
+    import jax
+    import jax.numpy as jnp
+    import pandas as pd
+
+    from fugue_tpu import transform
+    from fugue_tpu.execution import make_execution_engine
+
+    def boom(arrs: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        if float(jnp.max(arrs["k"])) == 1:  # concrete per-partition check
+            raise NotImplementedError("bad partition")
+        return {"k": arrs["k"], "v": arrs["v"] * 2}
+
+    e = make_execution_engine("jax")
+    df = pd.DataFrame({"k": [0, 0, 1, 1], "v": [1.0, 2.0, 3.0, 4.0]})
+    out = transform(
+        df, boom, schema="k:long,v:double",
+        partition={"by": ["k"]}, ignore_errors=[NotImplementedError],
+        engine=e, as_fugue=True,
+    ).as_pandas()
+    assert sorted(out["v"].tolist()) == [2.0, 4.0], out
+    # exactly ONE counted fallback event for one logical map
+    assert e.fallbacks.get("map", 0) == 1, e.fallbacks
