@@ -94,9 +94,8 @@ class JaxMapEngine(MapEngine):
         output_schema = Schema(output_schema)
         if map_func_format_hint == "jax":
             raw = self._extract_jax_func(map_func)
-            if raw is not None and getattr(
-                getattr(map_func, "__self__", None), "ignore_errors", ()
-            ):
+            runner = getattr(map_func, "__self__", None)
+            if raw is not None and getattr(runner, "ignore_errors", ()):
                 # per-partition error swallowing can't run whole-shard:
                 # the host loop owns that semantics (same rule as comap);
                 # counted ONCE here, so skip the not-mappable counter
