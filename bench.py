@@ -7,6 +7,8 @@ transform()+groupby and ``vs_baseline`` its speedup over native. The
 ``detail.configs`` dict carries every BASELINE.md config (1-5), each with
 native/jax secs + rows/sec + speedup. Set ``BENCH_CONFIGS=lines`` to also
 print one json line per config (for humans; the driver reads line 1).
+The SAME headline line is printed again LAST: the driver stores only the
+output tail, so the artifact stays self-contained (VERDICT r5 #8).
 
 Env knobs: BENCH_ROWS (default 100_000_000), BENCH_GROUPS (1024),
 BENCH_NATIVE_ROWS (10_000_000), BENCH_SMALL=1 (scale everything down ~100x
@@ -66,8 +68,36 @@ def _timed(fn: Callable[[], Any], warm: int = 5) -> float:
     return min(samples)
 
 
+# HBM peak bandwidth by TPU generation (GB/s) — the roofline denominator.
+# Sources: published TPU system specs (v5e 819, v5p 2765, v4 1228,
+# v6e/Trillium 1640). Matched against device_kind fragments; "v5 lite"
+# comes before "v5" so v5e doesn't read as v5p.
+_HBM_PEAK_GBPS = (
+    ("v5 lite", 819.0),
+    ("v5e", 819.0),
+    ("v5p", 2765.0),
+    ("v5", 2765.0),
+    ("v6", 1640.0),
+    ("v4", 1228.0),
+    ("v3", 900.0),
+    ("v2", 700.0),
+)
+
+
+def _platform_peak_gbps(dev: Any) -> Any:
+    if dev.platform == "cpu":
+        return None
+    kind = str(getattr(dev, "device_kind", "")).lower()
+    for frag, peak in _HBM_PEAK_GBPS:
+        if frag in kind:
+            return peak
+    return None
+
+
 def _roofline(
-    build_result_frame: Callable[[], Any], bytes_touched: int
+    build_result_frame: Callable[[], Any],
+    bytes_touched: int,
+    engine: Any = None,
 ) -> Dict[str, Any]:
     """Decompose a device pipeline's cost on a (possibly network-attached)
     TPU: measure the relay's irreducible sync+fetch latency with a tiny
@@ -76,10 +106,16 @@ def _roofline(
     difference is the device-resident time; bytes_touched / that time is
     a LOWER bound on achieved HBM bandwidth (bytes_touched counts each
     logical pass over the data once; XLA fusion can only reduce real
-    traffic below it)."""
+    traffic below it). Achieved GB/s is also reported as a % of the
+    platform's HBM peak, and — when ``engine`` is passed — against XLA's
+    OWN traffic accounting (``jit(...).lower().compile().cost_analysis()``
+    of the engine programs that ran), which proves or disproves whether
+    the compiler's real traffic is near the logical bound."""
     import jax
     import jax.numpy as jnp
     import numpy as np
+
+    from fugue_tpu.jax_backend.blocks import residency_arrays
 
     # the sync baseline must live on the SAME backend as the pipeline
     # (frames may sit on the host CPU-XLA tier, where a sync is ~free)
@@ -101,35 +137,82 @@ def _roofline(
     rtt_once()
     rtt = min(rtt_once() for _ in range(5))
 
+    if engine is not None:
+        # scope cost_analysis to exactly the programs this pipeline runs
+        engine.reset_program_log()
+
     def dev_once() -> float:
         t0 = time.perf_counter()
         fr = build_result_frame()
-        blocks = fr.native
         parts = [
-            jnp.sum(c.data.astype(jnp.float32))
-            for c in blocks.columns.values()
-            if c.on_device
+            jnp.sum(a.astype(jnp.float32))
+            for a in residency_arrays(fr.native)
         ]
-        if blocks.row_valid is not None:
-            parts.append(jnp.sum(blocks.row_valid.astype(jnp.float32)))
         float(jnp.sum(jnp.stack(parts)))  # one sync drains the pipeline
         return time.perf_counter() - t0
 
     dev_once()  # warm (possible jit of the reduction)
     dev_plus = min(dev_once() for _ in range(5))
     device_secs = max(dev_plus - rtt, 0.0)
-    return {
+    peak = _platform_peak_gbps(dev)
+    gbps = (
+        None
+        if device_secs <= 0
+        else round(bytes_touched / device_secs / 1e9, 1)
+    )
+    out: Dict[str, Any] = {
         "backend": dev.platform,
         "relay_rtt_secs": round(rtt, 4),
         "device_plus_rtt_secs": round(dev_plus, 4),
         "device_resident_secs": round(device_secs, 4),
         "approx_bytes_touched": bytes_touched,
-        "achieved_gbps_lower_bound": (
+        "achieved_gbps_lower_bound": gbps,
+        "platform_peak_gbps": peak,
+        "pct_of_peak_lower_bound": (
             None
-            if device_secs <= 0
-            else round(bytes_touched / device_secs / 1e9, 1)
+            if gbps is None or not peak
+            else round(100.0 * gbps / peak, 2)
         ),
     }
+    if engine is not None:
+        try:
+            ca = engine.program_cost_analysis()
+        except Exception:  # pragma: no cover - analysis unsupported
+            ca = {"flops": 0.0, "bytes_accessed": 0.0, "programs": {}}
+        if ca.get("bytes_accessed"):
+            xla_gbps = (
+                None
+                if device_secs <= 0
+                else round(ca["bytes_accessed"] / device_secs / 1e9, 1)
+            )
+            out["xla_cost_analysis"] = {
+                "flops": ca["flops"],
+                "bytes_accessed": ca["bytes_accessed"],
+                "programs": {
+                    k: {
+                        "flops": v["flops"],
+                        "bytes_accessed": v["bytes_accessed"],
+                    }
+                    for k, v in ca["programs"].items()
+                },
+                "achieved_gbps_xla": xla_gbps,
+                "pct_of_peak_xla": (
+                    None
+                    if xla_gbps is None or not peak
+                    else round(100.0 * xla_gbps / peak, 2)
+                ),
+                # >1 means XLA's real traffic exceeds the logical
+                # bytes-touched bound (e.g. a materialized one-hot): the
+                # "bandwidth gap" is then compiler traffic, not an idle
+                # memory system — the cost_analysis()-based proof ISSUE
+                # r6 asks for when the lower bound can't be raised
+                "traffic_ratio_xla_vs_logical": (
+                    None
+                    if not bytes_touched
+                    else round(ca["bytes_accessed"] / bytes_touched, 2)
+                ),
+            }
+    return out
 
 
 def _pair(
@@ -251,7 +334,7 @@ def _bench_headline() -> Dict[str, Any]:
         )
 
     # transform reads k+v, writes v2; groupby reads k+v2 (5 x 4B streams)
-    roofline = _roofline(build_frame, n_rows * 20)
+    roofline = _roofline(build_frame, n_rows * 20, engine=engine)
 
     return {
         "metric": "transform_groupby_rows_per_sec",
@@ -272,6 +355,7 @@ def _bench_headline() -> Dict[str, Any]:
             "native_secs": round(native_secs, 4),
             "native_rows_per_sec": round(native_rps, 1),
             "roofline": roofline,
+            "strategy_counts": dict(engine.strategy_counts),
             "devices": len(jax.devices()),
             "platform": jax.devices()[0].platform,
             "notes": (
@@ -354,7 +438,33 @@ def _config1_map_letter_to_food() -> Dict[str, Any]:
             jsrc, jax_map_letter, schema="*", engine=jax_e, as_fugue=True
         ).as_local()
 
-    return _pair(n, run_native, run_jax, "1_map_letter_to_food")
+    res = _pair(n, run_native, run_jax, "1_map_letter_to_food")
+    # VERDICT r5 #7: quantify the auto-placement tradeoff per round. The
+    # row above runs placement=auto (this config lands on the host
+    # CPU-XLA tier); rerun with the accelerator tier FORCED so both sides
+    # of the policy are measured, not asserted. On CPU-only boxes the
+    # "device" tier IS the host mesh, so the two rows converge.
+    forced = make_execution_engine("jax", {"fugue.jax.placement": "device"})
+    fsrc = forced.persist(forced.to_df(pdf))  # stage outside the timing
+
+    def run_forced() -> None:
+        transform(
+            fsrc, jax_map_letter, schema="*", engine=forced, as_fugue=True
+        ).as_local()
+
+    forced_secs = _timed(run_forced)
+    res["placement"] = {
+        "auto": {
+            "jax_secs": res["jax_secs"],
+            "backend": jsrc.native.mesh.devices.flat[0].platform,
+        },
+        "tpu": {
+            "jax_secs": round(forced_secs, 4),
+            "jax_rows_per_sec": round(n / forced_secs, 1),
+            "backend": fsrc.native.mesh.devices.flat[0].platform,
+        },
+    }
+    return res
 
 
 def _config2_partition_udf() -> Dict[str, Any]:
@@ -659,3 +769,7 @@ if __name__ == "__main__":
     if os.environ.get("BENCH_CONFIGS", "") == "lines":
         for name, cfg in res["detail"]["configs"].items():
             print(json.dumps({"metric": name, **cfg}))
+    # ... and AGAIN as the last line: the driver stores only the output
+    # tail, so the artifact must be self-contained (VERDICT r5 #8 — the
+    # r5 artifact lost its headline)
+    print(json.dumps(res))

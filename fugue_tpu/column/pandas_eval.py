@@ -122,7 +122,7 @@ def _eval(df: pd.DataFrame, expr: ColumnExpr) -> pd.Series:
             if isinstance(pattern, _LitColumnExpr) and isinstance(
                 pattern.value, str
             ):
-                rx = like_pattern_to_regex(pattern.value)
+                rx = compile_like_regex(pattern.value)
                 res = operand.astype("string").str.fullmatch(rx).astype(
                     "boolean"
                 )
@@ -141,7 +141,7 @@ def _eval(df: pd.DataFrame, expr: ColumnExpr) -> pd.Series:
                     continue
                 crx = cache.get(pv)
                 if crx is None:
-                    crx = re.compile(like_pattern_to_regex(str(pv)))
+                    crx = compile_like_regex(str(pv))
                     cache[pv] = crx
                 vals.append(crx.fullmatch(str(v)) is not None)
             res = pd.Series(vals, index=df.index, dtype=object).astype(
@@ -330,7 +330,8 @@ def sql_substring(
 
 def like_pattern_to_regex(pattern: str) -> str:
     """SQL LIKE pattern -> an equivalent regex (``%`` -> ``.*``,
-    ``_`` -> ``.``, everything else literal)."""
+    ``_`` -> ``.``, everything else literal). Unanchored — use
+    :func:`compile_like_regex` for matching."""
     out = []
     for ch in pattern:
         if ch == "%":
@@ -340,6 +341,18 @@ def like_pattern_to_regex(pattern: str) -> str:
         else:
             out.append(re.escape(ch))
     return "".join(out)
+
+
+def compile_like_regex(pattern: str) -> "re.Pattern":
+    r"""THE compiled regex every LIKE evaluator (host select runner,
+    device dictionary LUTs, pandas column algebra) matches with. Anchored
+    with ``\A...\Z`` — ``$`` would also match just before a trailing
+    newline, so the three evaluators could diverge on values like
+    ``"red\n"`` (ADVICE r5 #3). DOTALL because SQL's ``%``/``_`` match
+    any character INCLUDING newlines (``'a\nb' LIKE 'a%'`` is TRUE)."""
+    return re.compile(
+        r"\A" + like_pattern_to_regex(pattern) + r"\Z", re.DOTALL
+    )
 
 
 def _cast_series(s: pd.Series, tp: pa.DataType) -> pd.Series:

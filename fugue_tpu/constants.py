@@ -27,6 +27,8 @@ FUGUE_CONF_JAX_PLACEMENT = "fugue.jax.placement"
 FUGUE_CONF_JAX_MIN_DEVICE_BYTES = "fugue.jax.placement.min_device_bytes"
 FUGUE_CONF_JAX_COMPILE_CACHE = "fugue.jax.compile.cache"
 FUGUE_CONF_JAX_GROUPBY_MATMUL = "fugue.jax.groupby.matmul"
+FUGUE_CONF_JAX_GROUPBY_STRATEGY = "fugue.jax.groupby.strategy"
+FUGUE_CONF_JAX_GROUPBY_AUTOTUNE = "fugue.jax.groupby.autotune"
 
 FUGUE_COMPILE_TIME_CONFIGS = {
     FUGUE_CONF_WORKFLOW_EXCEPTION_HIDE,
@@ -52,11 +54,20 @@ _DEFAULT_CONF: Dict[str, Any] = {
     # GB; on PCIe-local TPU hosts set a lower threshold or placement=device.
     FUGUE_CONF_JAX_PLACEMENT: "auto",
     FUGUE_CONF_JAX_MIN_DEVICE_BYTES: 256 * 1024 * 1024,
-    # group-by reduction algorithm: "auto" rides the one-hot matmul on
-    # accelerators (MXU: scatter serializes, matmul does not — measured
-    # 50x) and the scatter segment-sum on CPU meshes (the one-hot
-    # transient thrashes CPU memory bandwidth); "always"/"never" pin it.
+    # group-by reduction algorithm (legacy knob, kept for back-compat):
+    # "always"/"never" pin the strategy below to matmul/scatter; "auto"
+    # defers to fugue.jax.groupby.strategy.
     FUGUE_CONF_JAX_GROUPBY_MATMUL: "auto",
+    # segment-reduction strategy: "auto" consults the measured crossover
+    # table in jax_backend/segtune.py (scatter on CPU meshes, one-hot
+    # matmul on accelerators below the segment cap, sorted scatter above
+    # it), sharpened by a one-shot on-device autotune; or pin one of
+    # "matmul" | "matmul_bf16" | "scatter" | "sort". matmul_bf16 trades
+    # ~8 mantissa bits for speed and is PIN-ONLY — auto never picks it.
+    FUGUE_CONF_JAX_GROUPBY_STRATEGY: "auto",
+    # autotune policy: "auto" probes on accelerator meshes for large
+    # frames only; True/False force it on/off.
+    FUGUE_CONF_JAX_GROUPBY_AUTOTUNE: "auto",
 }
 
 _GLOBAL_CONF = ParamDict(_DEFAULT_CONF)

@@ -281,6 +281,23 @@ class JaxBlocks:
         return self.row_valid is None
 
 
+def residency_arrays(blocks: JaxBlocks) -> List[Any]:
+    """EVERY device array a frame owns: column data, column validity
+    masks, and the row_valid mask. This is the set a residency-forcing
+    fetch (persist) or an honest bench endpoint must drain — on relayed
+    TPU backends any array left out can lazily stage over the link later
+    (ADVICE r5 #1: masks staged inside the first timed run)."""
+    arrs: List[Any] = []
+    for c in blocks.columns.values():
+        if c.on_device:
+            arrs.append(c.data)
+            if c.mask is not None:
+                arrs.append(c.mask)
+    if blocks.row_valid is not None:
+        arrs.append(blocks.row_valid)
+    return arrs
+
+
 def _int_like_stats(
     values: np.ndarray, tp: pa.DataType
 ) -> Optional[Tuple[int, int]]:

@@ -443,6 +443,16 @@ def compiled_comap(
         alive_key += "_"  # never collide with a user output column
     if "_nrows" in out:
         nrows_out = int(out["_nrows"])  # explicit count: one sync
+        # an over-reporting cotransformer would make garbage padding rows
+        # real; match the host group loop's validation instead of
+        # exporting them (ADVICE r5 #2)
+        assert_or_throw(
+            0 <= nrows_out <= first,
+            ValueError(
+                f"jax cotransformer reported _nrows={nrows_out} outside "
+                f"[0, {first}] (its output column length)"
+            ),
+        )
         target = max(padded_len(nrows_out, ndev), padded_len(first, ndev))
     elif first == S:
         # per-segment output: live segments are the rows, count lazy

@@ -557,6 +557,17 @@ class JaxExecutionEngine(ExecutionEngine):
         # an op with a device path increments this and logs at info, so
         # tests/benches can assert a pipeline stayed on device.
         self._fallbacks: Dict[str, int] = {}
+        # segment-reduction strategy observability, mirroring fallbacks:
+        # strategy name -> times an aggregate program ran on it ("generic"
+        # = the unpacked per-agg path). Benches report this per config so
+        # the crossover selector's choices are visible, not guessed.
+        self._strategy_counts: Dict[str, int] = {}
+        # (fn, arg avals) of jitted programs as they run, for AOT
+        # cost_analysis (see program_cost_analysis). Recording is DISARMED
+        # until reset_program_log() so the per-dispatch aval capture never
+        # taxes workloads that don't profile (review finding)
+        self._program_log: Dict[Any, Tuple[Callable, Any]] = {}
+        self._program_log_armed = False
 
     @property
     def fallbacks(self) -> Dict[str, int]:
@@ -573,6 +584,55 @@ class JaxExecutionEngine(ExecutionEngine):
             op,
             f" ({why})" if why else "",
         )
+
+    @property
+    def strategy_counts(self) -> Dict[str, int]:
+        """Segment-reduction strategy counters since construction (or
+        ``reset_strategy_counts``) — which kernel each aggregate ran on."""
+        return dict(self._strategy_counts)
+
+    def reset_strategy_counts(self) -> None:
+        self._strategy_counts.clear()
+
+    def _count_strategy(self, name: str) -> None:
+        self._strategy_counts[name] = self._strategy_counts.get(name, 0) + 1
+
+    def reset_program_log(self) -> None:
+        """Arm program recording and forget prior signatures (scopes
+        program_cost_analysis to the ops run after this call)."""
+        self._program_log.clear()
+        self._program_log_armed = True
+
+    def program_cost_analysis(self) -> Dict[str, Any]:
+        """XLA ``cost_analysis()`` of the engine programs that ran since
+        ``reset_program_log``: per-program flops and bytes accessed plus
+        totals. This is the compiler's own traffic accounting — the number
+        the roofline block divides by device time to report achieved GB/s
+        against platform peak (ISSUE r6: a bytes-touched guess can only
+        lower-bound it; XLA's real traffic proves or disproves fusion).
+        Reading the analysis DISARMS recording again, so one profiling
+        pass never taxes the rest of the engine's lifetime."""
+        self._program_log_armed = False
+        out: Dict[str, Any] = {"programs": {}, "flops": 0.0, "bytes_accessed": 0.0}
+        for key, (fn, avals) in list(self._program_log.items()):
+            try:
+                ca = jax.jit(fn).lower(*avals).compile().cost_analysis()
+                if isinstance(ca, (list, tuple)):
+                    ca = ca[0] if len(ca) > 0 else {}
+                flops = float(ca.get("flops", 0.0))
+                nbytes = float(ca.get("bytes accessed", 0.0))
+            except Exception:  # pragma: no cover - backend w/o analysis
+                continue
+            name = str(key[0]) if isinstance(key, tuple) and key else str(key)
+            slot = out["programs"].setdefault(
+                name, {"flops": 0.0, "bytes_accessed": 0.0, "count": 0}
+            )
+            slot["flops"] += flops
+            slot["bytes_accessed"] += nbytes
+            slot["count"] += 1
+            out["flops"] += flops
+            out["bytes_accessed"] += nbytes
+        return out
 
     @property
     def mesh(self) -> Any:
@@ -914,11 +974,12 @@ class JaxExecutionEngine(ExecutionEngine):
             return df
         jdf: JaxDataFrame = self.to_df(df)  # type: ignore
         if not lazy:
-            arrs = [
-                c.data
-                for c in jdf.blocks.columns.values()
-                if c.on_device
-            ]
+            from fugue_tpu.jax_backend.blocks import residency_arrays
+
+            # EVERY device array: column data, column masks AND row_valid
+            # — a mask left out of the fetch can lazily stage over the
+            # relay after persist returns (ADVICE r5 #1)
+            arrs = residency_arrays(jdf.blocks)
             jax.block_until_ready(arrs)
             if arrs:
                 # relayed TPU backends ack block_until_ready before the
@@ -928,8 +989,9 @@ class JaxExecutionEngine(ExecutionEngine):
                 from fugue_tpu.jax_backend.blocks import on_mesh
 
                 with on_mesh(jdf.blocks.mesh):
-                    # sum in native dtype, cast the SCALAR: a full-array
-                    # float32 cast would transiently copy the frame
+                    # sum in native dtype (bool masks sum to int32), cast
+                    # the SCALAR: a full-array float32 cast would
+                    # transiently copy the frame
                     float(
                         jnp.stack(
                             [
@@ -1590,13 +1652,28 @@ class JaxExecutionEngine(ExecutionEngine):
         """Per-engine jit cache: logical programs (aggregate plans, map fns,
         filters) are keyed by structure so repeated queries reuse the
         compiled executable. Keys never include row counts — those enter
-        programs as traced scalars/masks."""
+        programs as traced scalars/masks.
+
+        Each call records (fn, arg avals) in the program log so
+        ``program_cost_analysis`` can AOT-lower the exact program later and
+        read XLA's own flops/bytes accounting."""
         cache = getattr(self, "_jit_cache", None)
         if cache is None:
             cache = {}
             self._jit_cache = cache
         if key not in cache:
-            cache[key] = jax.jit(fn)
+            jitted = jax.jit(fn)
+
+            def _wrapped(
+                *args: Any, _j: Any = jitted, _f: Callable = fn, _k: Any = key
+            ) -> Any:
+                if self._program_log_armed:
+                    self._program_log[_k] = (
+                        _f, jax.tree_util.tree_map(_as_aval, args)
+                    )
+                return _j(*args)
+
+            cache[key] = _wrapped
         return cache[key]
 
     def _map_program(
@@ -1623,7 +1700,19 @@ class JaxExecutionEngine(ExecutionEngine):
             cache = {}
             self._map_cache = cache
         if key not in cache:
-            jitted = jax.jit(fn)
+            inner = jax.jit(fn)
+
+            def jitted(
+                *args: Any, _j: Any = inner, _f: Callable = fn, _k: Any = key
+            ) -> Any:
+                # recorded like _jit_cached programs so the compiled map
+                # shows up in program_cost_analysis (the headline's
+                # transform traffic)
+                if self._program_log_armed:
+                    self._program_log[
+                        ("map",) + (_k if isinstance(_k, tuple) else (_k,))
+                    ] = (_f, jax.tree_util.tree_map(_as_aval, args))
+                return _j(*args)
             passthrough: Dict[str, str] = {}
             try:
                 shaped = {
@@ -1760,22 +1849,42 @@ class JaxExecutionEngine(ExecutionEngine):
                 jdf, typed_plans, col_order, sharding, distinct_args
             )
         bspec = groupby.bin_spec(blocks, keys)
-        if (
-            bspec is not None
-            and not distinct_args
-            and bspec.total <= groupby._MATMUL_MAX_SEGMENTS
-            and self._prefer_matmul(blocks)
-            and all(
-                self._matmul_agg_ok(jdf, func, arg)
-                for _, func, arg, _ in typed_plans
-            )
-        ):
-            return self._binned_matmul_aggregate(
-                jdf, keys, typed_plans, bspec, col_order, sharding
-            )
+        if bspec is not None:
+            kinds = []
+            need_int, all_f32 = False, True
+            for _, func, arg, _ in typed_plans:
+                kind = self._packed_agg_kind(jdf, func, arg)
+                kinds.append(kind)
+                if kind == "int":
+                    need_int = True
+                elif kind == "float":
+                    atp = arg.infer_type(jdf.schema)
+                    if atp is None or not pa.types.is_float32(atp):
+                        all_f32 = False
+            if all(k is not None for k in kinds):
+                # payload estimate for the crossover table: every plan
+                # contributes at most one payload row + the occupancy slot
+                # (dedup inside the program can only shrink it)
+                strategy = self._groupby_strategy(
+                    blocks,
+                    pad_n,
+                    bspec.total,
+                    1 + len(typed_plans),
+                    need_int=need_int,
+                    all_f32=all_f32,
+                )
+                if strategy is not None:
+                    return self._binned_packed_aggregate(
+                        jdf, keys, typed_plans, bspec, col_order,
+                        sharding, strategy, distinct_args,
+                    )
         fr = groupby.factorize_keys(blocks, keys)
         num_segments = fr.num_segments
         out_pad = padded_len(num_segments, ndev)
+        # the generic (unpacked) path still routes its sum-type reductions
+        # through the strategy layer per tier — min/max/median etc. stay
+        # scatter-native inside _segment_agg_impl
+        seg_strategy = self._count_reduce_strategy(blocks, num_segments)
 
         # ONE fused program: every agg + key gather + padding, single dispatch
         def _agg_program(
@@ -1810,7 +1919,8 @@ class JaxExecutionEngine(ExecutionEngine):
                     dsegs_, dfirsts_, name, pad_n, mask
                 )
                 v, m = groupby._segment_agg_impl(
-                    func, values, mask, seg_, num_segments, valid_
+                    func, values, mask, seg_, num_segments, valid_,
+                    strategy=seg_strategy,
                 )
                 outs[f"a:{name}"] = _pad_to(_cast_agg_result(v, tp), out_pad)
                 if m is not None:
@@ -1824,10 +1934,11 @@ class JaxExecutionEngine(ExecutionEngine):
             "agg",
             tuple((n, f, None if a is None else a.__uuid__(), str(t))
                   for n, f, a, t in typed_plans),
-            tuple(keys), num_segments, out_pad, pad_n,
+            tuple(keys), num_segments, out_pad, pad_n, seg_strategy,
             tuple(sorted(distinct_args.items())),
             expr_eval.dict_fingerprint(blocks),
         )
+        self._count_strategy("generic")
         key_data = {k: blocks.columns[k].data for k in keys}
         key_masks = {
             k: blocks.columns[k].mask
@@ -1959,39 +2070,127 @@ class JaxExecutionEngine(ExecutionEngine):
                 )
             )
 
-    def _prefer_matmul(self, blocks: JaxBlocks) -> bool:
-        """Whether this frame's mesh should take the one-hot matmul
-        group-by. ``auto``: accelerators yes (MXU — scatter serializes
-        there, measured 50x worse), CPU meshes no (the (chunk, segments)
-        one-hot transient is pure memory-bandwidth waste on CPU; scatter
-        segment-sum wins ~10x at bench scale)."""
-        from fugue_tpu.constants import FUGUE_CONF_JAX_GROUPBY_MATMUL
+    def _strategy_mode(self) -> str:
+        """The configured strategy: ``fugue.jax.groupby.strategy``, with
+        the legacy ``fugue.jax.groupby.matmul`` knob mapped onto it
+        (always -> matmul, never -> scatter) for back-compat."""
+        from fugue_tpu.constants import (
+            FUGUE_CONF_JAX_GROUPBY_MATMUL,
+            FUGUE_CONF_JAX_GROUPBY_STRATEGY,
+        )
 
         mode = str(
+            self.conf.get(FUGUE_CONF_JAX_GROUPBY_STRATEGY, "auto")
+        ).lower()
+        assert_or_throw(
+            mode == "auto" or mode in groupby.STRATEGIES,
+            ValueError(
+                f"{FUGUE_CONF_JAX_GROUPBY_STRATEGY}={mode!r} is not one of "
+                f"{('auto',) + groupby.STRATEGIES}"
+            ),
+        )
+        legacy = str(
             self.conf.get(FUGUE_CONF_JAX_GROUPBY_MATMUL, "auto")
         ).lower()
-        if mode == "always":
-            return True
-        if mode == "never":
-            return False
-        return blocks.mesh.devices.flat[0].platform != "cpu"
+        if mode == "auto" and legacy != "auto":
+            mode = "matmul" if legacy == "always" else "scatter"
+        return mode
 
-    def _matmul_agg_ok(
+    def _groupby_strategy(
+        self,
+        blocks: JaxBlocks,
+        rows: int,
+        num_segments: int,
+        n_payload: int,
+        need_int: bool = False,
+        all_f32: bool = True,
+    ) -> Optional[str]:
+        """Select the packed segment-reduction strategy for one aggregate
+        shape, or None when no strategy is eligible (the caller then takes
+        the generic per-agg path). Eligibility: the matmul family cannot
+        sum integers exactly and is capped at _MATMUL_MAX_SEGMENTS (the
+        one-hot transient), matmul_bf16 additionally needs all-f32 float
+        payloads; scatter/sort run up to _PACKED_MAX_SEGMENTS. ``auto``
+        consults segtune's measured table + one-shot on-device autotune;
+        an explicit conf pin is honored when eligible."""
+        from fugue_tpu.constants import FUGUE_CONF_JAX_GROUPBY_AUTOTUNE
+        from fugue_tpu.jax_backend import segtune
+
+        candidates: List[str] = []
+        if not need_int and num_segments <= groupby._MATMUL_MAX_SEGMENTS:
+            candidates.append("matmul")
+            if all_f32:
+                candidates.append("matmul_bf16")
+        if num_segments <= groupby._PACKED_MAX_SEGMENTS:
+            candidates.extend(["scatter", "sort"])
+        if not candidates:
+            return None
+        mode = self._strategy_mode()
+        if mode != "auto":
+            return mode if mode in candidates else None
+        # bf16's hi/lo split trades ~8 mantissa bits for speed — an
+        # accuracy change users must PIN into, never an autotune pick
+        # (review finding)
+        candidates = [c for c in candidates if c != "matmul_bf16"]
+        return segtune.choose_strategy(
+            blocks.mesh,
+            rows,
+            num_segments,
+            n_payload,
+            candidates,
+            self.conf.get(FUGUE_CONF_JAX_GROUPBY_AUTOTUNE, "auto"),
+            self.log,
+        )
+
+    def _count_reduce_strategy(
+        self, blocks: JaxBlocks, num_segments: int
+    ) -> str:
+        """Strategy for single-payload 0/1 count reductions (join sides,
+        window/generic aggregates): the shapes relational.py shares with
+        the group-by machinery. Sorting inside a join program is never
+        worth it for one payload, so the choice is matmul-vs-scatter by
+        tier and segment cap; explicit strategy pins map onto that pair."""
+        from fugue_tpu.jax_backend import segtune
+
+        mode = self._strategy_mode()
+        if mode in ("matmul", "matmul_bf16"):
+            return (
+                mode
+                if num_segments <= groupby._MATMUL_MAX_SEGMENTS
+                else "scatter"
+            )
+        if mode in ("scatter", "sort"):
+            return "scatter"
+        platform = blocks.mesh.devices.flat[0].platform
+        if (
+            platform != "cpu"
+            and num_segments <= groupby._MATMUL_MAX_SEGMENTS
+        ):
+            return "matmul"
+        return "scatter"
+
+    def _packed_agg_kind(
         self, jdf: JaxDataFrame, func: str, arg: Any
-    ) -> bool:
-        """Whether an aggregation can ride the one-hot-matmul path: counts
-        always; sum/avg only over FLOAT payloads (integer sums would lose
-        low bits in the float accumulator — they take the exact
-        scatter-based path instead)."""
+    ) -> Optional[str]:
+        """How an aggregation rides the packed strategy kernels: "count",
+        "float" (f32/f64 sum/avg payload), "int" (exact integer sum/avg
+        payload — scatter/sort strategies only), or None (not packable:
+        min/max/median and friends stay on the generic path)."""
         if func == "count":
-            return True
+            return "count"
         if func not in ("sum", "avg", "mean"):
-            return False
+            return None
         tp = arg.infer_type(jdf.schema) if arg is not None else None
         if tp is None and isinstance(arg, _NamedColumnExpr):
             col = jdf.schema[arg.name] if arg.name in jdf.schema else None
             tp = col.type if col is not None else None
-        return tp is not None and pa.types.is_floating(tp)
+        if tp is None:
+            return None
+        if pa.types.is_floating(tp):
+            return "float"
+        if pa.types.is_integer(tp):
+            return "int"
+        return None
 
     def _global_aggregate(
         self,
@@ -2145,7 +2344,7 @@ class JaxExecutionEngine(ExecutionEngine):
             JaxBlocks(1, out_cols, blocks.mesh), schema
         )
 
-    def _binned_matmul_aggregate(
+    def _binned_packed_aggregate(
         self,
         jdf: JaxDataFrame,
         keys: List[str],
@@ -2153,12 +2352,18 @@ class JaxExecutionEngine(ExecutionEngine):
         bspec: "groupby.BinSpec",
         col_order: Optional[List[str]],
         sharding: Any,
+        strategy: str,
+        distinct_args: Optional[Dict[str, str]] = None,
     ) -> DataFrame:
         """The group-by hot path: ONE jitted program computing mixed-radix
-        segment ids inline, ALL sum/avg/count reductions via a single
-        chunked one-hot matmul on the MXU (scatter-free), and key values
-        decoded arithmetically from bin indices (gather-free). Zero host
-        syncs; the group count stays a lazy device scalar."""
+        segment ids inline, ALL sum/avg/count reductions (float, exact-int
+        and DISTINCT variants) packed into a single strategy kernel —
+        one-hot matmul / bf16 matmul / packed scatter / sorted scatter,
+        per the crossover selector — and key values decoded arithmetically
+        from bin indices (gather-free). Zero host syncs on the matmul and
+        scatter strategies; the group count stays a lazy device scalar.
+        DISTINCT aggregates fold their first-occurrence-of-(keys, value)
+        masks into the payloads, so they ride the same packed kernel."""
         blocks = jdf.blocks
         pad_n = blocks.padded_nrows
         dicts = expr_eval.dicts_of(blocks)
@@ -2166,11 +2371,22 @@ class JaxExecutionEngine(ExecutionEngine):
         total = bspec.total
         out_pad = padded_len(total, ndev)
         key_dtypes = {k: blocks.columns[k].data.dtype for k in keys}
+        distinct_args = distinct_args or {}
+        plan_kinds = [
+            "c" if (func == "count") else (
+                "i"
+                if self._packed_agg_kind(jdf, func, arg) == "int"
+                else "f"
+            )
+            for _, func, arg, _ in typed_plans
+        ]
 
         def _prog(
             mcols: Dict[str, Any],
             key_data: Dict[str, Any],
             key_masks: Dict[str, Any],
+            dsegs_: Dict[str, Any],
+            dfirsts_: Dict[str, Any],
             row_valid: Optional[Any],
             nrows_s: Any,
         ) -> Dict[str, Any]:
@@ -2180,13 +2396,17 @@ class JaxExecutionEngine(ExecutionEngine):
             )
             float_payloads: List[Any] = []
             count_payloads: List[Any] = [valid]  # occupancy rides along
-            # payload DEDUP: matmul FLOPs scale with the payload count, and
+            int_payloads: List[Any] = []
+            # payload DEDUP: kernel work scales with the payload count, and
             # real queries repeat payloads constantly — SUM(v)+AVG(v) share
             # one float payload; COUNT(*) / any unmasked count IS the
             # occupancy vector (slot 0). A sum+avg+count query drops from
-            # 6 payload rows to 2 — a ~3x FLOP cut on the hot path.
+            # 6 payload rows to 2 — a ~3x work cut on the hot path.
+            # DISTINCT variants key separately (their effective mask also
+            # carries the first-occurrence dedup mask).
             fkeys: Dict[str, int] = {}
             ckeys: Dict[str, int] = {"__valid__": 0}
+            ikeys: Dict[str, int] = {}
             slots: List[Tuple[str, Any]] = []  # (kind, index-key) per plan
 
             def _count_slot(key: str, vec: Any) -> int:
@@ -2201,22 +2421,41 @@ class JaxExecutionEngine(ExecutionEngine):
                     fkeys[key] = len(float_payloads) - 1
                 return fkeys[key]
 
-            for name, func, arg, tp in typed_plans:
+            def _int_slot(key: str, vec: Any) -> int:
+                if key not in ikeys:
+                    int_payloads.append(vec)
+                    ikeys[key] = len(int_payloads) - 1
+                return ikeys[key]
+
+            for (name, func, arg, tp), kind in zip(typed_plans, plan_kinds):
                 if func == "count" and arg is None:
                     slots.append(("c", 0))  # COUNT(*) == occupancy
                     continue
                 akey = arg.__uuid__()
+                dname = distinct_args.get(name)
                 values, mask = expr_eval.eval_expr(mcols, arg, pad_n, dicts)
-                eff_key = "__valid__" if mask is None else f"m:{akey}"
+                mask = _apply_distinct_mask(
+                    dsegs_, dfirsts_, name, pad_n, mask
+                )
+                parts = ([f"m:{akey}"] if mask is not None else [])
+                if dname is not None:
+                    parts.append(f"d:{dname}")
+                eff_key = "|".join(parts) or "__valid__"
                 eff = valid if mask is None else (mask & valid)
                 if func == "count":
                     slots.append(("c", _count_slot(eff_key, eff)))
+                    continue
+                ci = _count_slot(eff_key, eff)
+                pkey = f"{akey}|{eff_key}"
+                if kind == "i":
+                    ii = _int_slot(pkey, jnp.where(eff, values, 0))
+                    slots.append(("i", (ii, ci)))
                 else:
-                    fi = _float_slot(akey, jnp.where(eff, values, 0))
-                    ci = _count_slot(eff_key, eff)
+                    fi = _float_slot(pkey, jnp.where(eff, values, 0))
                     slots.append(("f", (fi, ci)))
-            f_sums, c_sums = groupby.matmul_segment_sums(
-                float_payloads, count_payloads, seg, total
+            f_sums, c_sums, i_sums = groupby.segment_sums(
+                float_payloads, count_payloads, seg, total,
+                strategy=strategy, int_payloads=int_payloads,
             )
             occupied = c_sums[0] > 0
             outs: Dict[str, Any] = {
@@ -2236,8 +2475,9 @@ class JaxExecutionEngine(ExecutionEngine):
                         _cast_agg_result(c_sums[idx], tp), out_pad
                     )
                     continue
-                fi, ci = idx
-                tot, cnt = f_sums[fi], c_sums[ci]
+                si, ci = idx
+                tot = i_sums[si] if kind == "i" else f_sums[si]
+                cnt = c_sums[ci]
                 if func == "sum":
                     v = tot
                 else:  # avg/mean
@@ -2254,8 +2494,12 @@ class JaxExecutionEngine(ExecutionEngine):
             ),
             bspec,
             pad_n,
+            strategy,
+            tuple(sorted(distinct_args.items())),
             expr_eval.dict_fingerprint(blocks),
         )
+        self._count_strategy(strategy)
+        dsegs, dfirsts = _distinct_factorize(blocks, keys, distinct_args)
         key_data = {k: blocks.columns[k].data for k in keys}
         key_masks = {
             k: blocks.columns[k].mask
@@ -2266,6 +2510,8 @@ class JaxExecutionEngine(ExecutionEngine):
             expr_eval.blocks_to_masked(blocks),
             key_data,
             key_masks,
+            dsegs,
+            dfirsts,
             blocks.row_valid,
             _nrows_arg(blocks),
         )
@@ -2437,6 +2683,12 @@ def _path_leaf_key(path: Any) -> Optional[str]:
     last = path[-1]
     key = getattr(last, "key", None)
     return key if isinstance(key, str) else None
+
+
+def _as_aval(x: Any) -> Any:
+    """Shape/dtype signature of a program argument (for AOT re-lowering in
+    program_cost_analysis; keeps no reference to the data)."""
+    return jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x))
 
 
 def _pad_to(v: jnp.ndarray, target: int) -> jnp.ndarray:

@@ -15,7 +15,6 @@ Because the lookup tables are baked into traced programs as constants,
 jit cache keys at the call sites must include ``dict_fingerprint``.
 """
 
-import re
 from typing import Any, Dict, List, NamedTuple, Optional, Tuple, Union
 
 import jax
@@ -31,7 +30,7 @@ from fugue_tpu.column.expressions import (
     _NamedColumnExpr,
     _UnaryOpExpr,
 )
-from fugue_tpu.column.pandas_eval import like_pattern_to_regex
+from fugue_tpu.column.pandas_eval import compile_like_regex
 from fugue_tpu.jax_backend.blocks import JaxBlocks, JaxColumn
 from fugue_tpu.utils.assertion import assert_or_throw
 
@@ -63,8 +62,11 @@ _MAX_COMPOSED_DICT = 1 << 18
 
 
 def _like_literal(operand: "_Str", pattern: str, negated: bool) -> Masked:
-    """LIKE against one literal pattern: a 1D dictionary LUT + gather."""
-    rx = re.compile(like_pattern_to_regex(pattern))
+    """LIKE against one literal pattern: a 1D dictionary LUT + gather.
+    The LUT rows come from the SAME anchored regex helper the host
+    evaluators use, so device and host can never diverge on values like
+    a trailing newline (ADVICE r5 #3)."""
+    rx = compile_like_regex(pattern)
     d = operand.dictionary
     lut = np.fromiter(
         (rx.fullmatch(str(x)) is not None for x in d),
@@ -200,7 +202,7 @@ def _eval(
             )
             lut2 = np.zeros((no, np_), dtype=bool)
             for j, p in enumerate(dp):
-                rxp = re.compile(like_pattern_to_regex(str(p)))
+                rxp = compile_like_regex(str(p))
                 lut2[: len(do), j] = np.fromiter(
                     (rxp.fullmatch(str(x)) is not None for x in do),
                     dtype=bool,

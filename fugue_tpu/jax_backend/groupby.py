@@ -161,26 +161,54 @@ def decode_bin_keys(
     return out
 
 
-# one-hot matmul aggregation: the fastest segment reduction measured on
-# this TPU for small segment counts. Alternatives benchmarked at
-# 100M rows x 1024 segments, f32, honest device_get endpoint (r3):
-#   one-hot matmul (this design)            ~204ms  (~490M rows/s)
-#   hierarchical (OH_hi*v)^T @ OH_lo split  ~490ms  (2.4x worse: two
-#       one-hots materialize; XLA fuses the flat pattern better)
-#   sort + segment_sum                      ~3.7s   (18x worse)
-#   jax.ops.segment_sum (scatter)           ~10.0s  (50x worse: scatter
-#       serializes on TPU; the MXU does not)
-# Chunk-size sweeps (2^16..2^20) move the time <15%, so the cost is the
-# inherent n*num_segments one-hot work, not scan-step overhead — a pallas
-# kernel was evaluated and offers no algorithmic advantage here (VPU
-# compare-accumulate is the same n*S work at lower throughput).
-# Platform note: these numbers are TPU measurements. On CPU meshes (the
-# host placement tier) the trade INVERTS — the (chunk, segments) one-hot
-# transient is pure memory-bandwidth waste while scatter-adds are cheap —
-# so the engine routes CPU-mesh aggregates to the scatter path
-# (fugue.jax.groupby.matmul=auto, see JaxExecutionEngine._prefer_matmul;
-# measured: 10M rows x 256 segments = 1.28s matmul vs 0.048s scatter).
+# ---------------------------------------------------------------------------
+# segment-reduction STRATEGY KERNELS
+#
+# All sum-type reductions (sum/avg/count payloads for every aggregated
+# column) are packed into one multi-row operand so the per-row segment
+# work — one-hot materialization, scatter index handling, or the sort —
+# is amortized across every output. Four interchangeable strategies
+# compute the identical contract; the engine picks one per (rows,
+# num_segments, n_payload, placement tier) via a measured table + a
+# one-shot on-device autotune (see segtune.py):
+#
+# - "matmul": chunked one-hot matmul over the MXU. The fastest measured
+#   on TPU for small segment counts. Benchmarked at 100M rows x 1024
+#   segments, f32, honest device_get endpoint (r3):
+#     one-hot matmul (this design)            ~204ms  (~490M rows/s)
+#     hierarchical (OH_hi*v)^T @ OH_lo split  ~490ms  (2.4x worse: two
+#         one-hots materialize; XLA fuses the flat pattern better)
+#     sort + segment_sum                      ~3.7s   (18x worse)
+#     jax.ops.segment_sum (scatter)           ~10.0s  (50x worse: scatter
+#         serializes on TPU; the MXU does not)
+#   Chunk-size sweeps (2^16..2^20) move the time <15%, so the cost is
+#   the inherent n*num_segments one-hot work, not scan-step overhead — a
+#   pallas kernel was evaluated and offers no algorithmic advantage here
+#   (VPU compare-accumulate is the same n*S work at lower throughput).
+# - "matmul_bf16": the same one-hot matmul with the one-hot in bf16 and
+#   each f32 payload split into hi+lo bf16 halves (two exact-0/1-weighted
+#   products, f32 MXU accumulation) — halves the one-hot transient
+#   traffic and rides the MXU's native bf16 rate at ~16 effective
+#   mantissa bits. Only eligible when every float payload is f32.
+# - "scatter": ONE packed (rows, n_payload) jax.ops.segment_sum. On CPU
+#   meshes (the host placement tier) the one-hot transient is pure
+#   memory-bandwidth waste while scatter-adds are cheap — measured
+#   10M rows x 256 segments = 1.28s matmul vs 0.048s scatter — so the
+#   table routes CPU meshes here. Exact integer accumulation (the matmul
+#   family would lose low bits in its float accumulator).
+# - "sort": argsort by segment id, then the packed scatter with
+#   ``indices_are_sorted=True`` — XLA lowers sorted scatters to a far
+#   cheaper kernel, trading the n*S one-hot work for an n*log(n) sort.
+#   The crossover candidate for LARGE segment counts where the one-hot
+#   work dominates.
+# ---------------------------------------------------------------------------
+
+STRATEGIES = ("matmul", "matmul_bf16", "scatter", "sort")
+
 _MATMUL_MAX_SEGMENTS = 8192
+# scatter/sort have no one-hot transient: the packed path stays viable up
+# to the bin cap itself (output is (num_segments, n_payload))
+_PACKED_MAX_SEGMENTS = 1 << 20
 _MATMUL_CHUNK = 1 << 17
 # cap on chunk*num_segments: the (chunk, num_segments) one-hot is the
 # scan-step transient; 2^26 elements = 256MB f32 (1/2 that in bf16), safe
@@ -188,27 +216,68 @@ _MATMUL_CHUNK = 1 << 17
 _MATMUL_ONEHOT_BUDGET = 1 << 26
 
 
-def matmul_segment_sums(
+def segment_sums(
     float_payloads: List[jnp.ndarray],
     count_payloads: List[jnp.ndarray],
     seg: jnp.ndarray,
     num_segments: int,
-) -> Tuple[List[jnp.ndarray], List[jnp.ndarray]]:
-    """Traced helper: all sum-type reductions in ONE chunked one-hot matmul
-    over the MXU. ``float_payloads`` accumulate in f32/f64; ``count_payloads``
-    (bool/0-1 valued) accumulate exactly in int32 (f32 partials per chunk
-    are exact below the chunk size). ``seg`` values >= num_segments
-    contribute nothing (their one-hot row is all zeros)."""
-    n = seg.shape[0]
-    ch = min(
-        _MATMUL_CHUNK,
-        max(256, _MATMUL_ONEHOT_BUDGET // max(1, num_segments)),
-        n,
+    strategy: str = "matmul",
+    int_payloads: Optional[List[jnp.ndarray]] = None,
+) -> Tuple[List[jnp.ndarray], List[jnp.ndarray], List[jnp.ndarray]]:
+    """Traced helper: every sum-type reduction through ONE strategy kernel.
+
+    ``float_payloads`` accumulate in the widest float dtype present;
+    ``count_payloads`` (bool/0-1 valued) accumulate exactly in int32;
+    ``int_payloads`` accumulate exactly in int64 (scatter/sort only — the
+    matmul family's float accumulator would drop low bits, callers gate).
+    ``seg`` values >= num_segments contribute nothing on every strategy.
+    Returns (float_sums, count_sums, int_sums) as per-payload lists."""
+    ints = int_payloads or []
+    assert_or_throw(
+        strategy in STRATEGIES,
+        ValueError(f"unknown segment-reduction strategy {strategy!r}"),
     )
-    pad = (-n) % ch
-    # accumulate in the widest float dtype present (f64 stays f64 for CPU
-    # fidelity; pure-f32 TPU pipelines ride the fast path); count partials
-    # are exact below the chunk size in any float dtype
+    if strategy in ("matmul", "matmul_bf16"):
+        assert_or_throw(
+            len(ints) == 0,
+            ValueError("matmul strategies cannot sum integer payloads"),
+        )
+        f, c = matmul_segment_sums(
+            float_payloads,
+            count_payloads,
+            seg,
+            num_segments,
+            bf16=strategy == "matmul_bf16",
+        )
+        return f, c, []
+    return _packed_scatter_sums(
+        float_payloads, count_payloads, ints, seg, num_segments,
+        presort=strategy == "sort",
+    )
+
+
+def segment_count(
+    vec: jnp.ndarray,
+    seg: jnp.ndarray,
+    num_segments: int,
+    strategy: str = "scatter",
+) -> jnp.ndarray:
+    """Traced helper: ONE 0/1-valued int32 count reduction routed through
+    the strategy layer — the join-side/window count shape. ``vec`` must be
+    bool or 0/1 (matmul accumulates chunk partials in f32; 0/1 sums below
+    the chunk size are exact)."""
+    if strategy != "scatter" and num_segments > 0:
+        _, c, _ = segment_sums([], [vec], seg, num_segments, strategy)
+        return c[0]
+    return jax.ops.segment_sum(
+        vec.astype(jnp.int32), seg, num_segments=num_segments
+    )
+
+
+def _float_acc_dtype(float_payloads: List[jnp.ndarray]) -> Any:
+    """The accumulation dtype the strategy kernels share: the widest float
+    dtype present (f64 stays f64 for CPU fidelity; pure-f32 TPU pipelines
+    ride the fast path), f32 when there are no float payloads."""
     acc_dtype = (
         jnp.result_type(*[p.dtype for p in float_payloads])
         if len(float_payloads) > 0
@@ -216,9 +285,94 @@ def matmul_segment_sums(
     )
     if not jnp.issubdtype(acc_dtype, jnp.floating):
         acc_dtype = jnp.float32
-    payloads = [p.astype(acc_dtype) for p in float_payloads] + [
-        p.astype(acc_dtype) for p in count_payloads
-    ]
+    return acc_dtype
+
+
+def _packed_scatter_sums(
+    float_payloads: List[jnp.ndarray],
+    count_payloads: List[jnp.ndarray],
+    int_payloads: List[jnp.ndarray],
+    seg: jnp.ndarray,
+    num_segments: int,
+    presort: bool,
+) -> Tuple[List[jnp.ndarray], List[jnp.ndarray], List[jnp.ndarray]]:
+    """The scatter/sort strategies: same-kind payloads packed into one
+    (rows, n_payload) operand per accumulator dtype, ONE segment_sum per
+    pack (index handling amortized across every output). ``presort``
+    reorders rows by segment id first so XLA lowers the scatter with
+    ``indices_are_sorted=True``."""
+    acc_dtype = _float_acc_dtype(float_payloads)
+    if presort:
+        order = jnp.argsort(seg).astype(jnp.int32)
+        seg = seg[order]
+
+        def _g(p: jnp.ndarray) -> jnp.ndarray:
+            return p[order]
+    else:
+
+        def _g(p: jnp.ndarray) -> jnp.ndarray:
+            return p
+
+    def _reduce(payloads: List[jnp.ndarray], dtype: Any) -> List[jnp.ndarray]:
+        if not payloads:
+            return []
+        pack = jnp.stack([_g(p.astype(dtype)) for p in payloads], axis=1)
+        sums = jax.ops.segment_sum(
+            pack, seg, num_segments=num_segments, indices_are_sorted=presort
+        )
+        return [sums[:, i] for i in range(len(payloads))]
+
+    return (
+        _reduce(float_payloads, acc_dtype),
+        _reduce(count_payloads, jnp.int32),
+        _reduce(int_payloads, jnp.int64),
+    )
+
+
+def matmul_segment_sums(
+    float_payloads: List[jnp.ndarray],
+    count_payloads: List[jnp.ndarray],
+    seg: jnp.ndarray,
+    num_segments: int,
+    bf16: bool = False,
+) -> Tuple[List[jnp.ndarray], List[jnp.ndarray]]:
+    """Traced helper: all sum-type reductions in ONE chunked one-hot matmul
+    over the MXU. ``float_payloads`` accumulate in f32/f64; ``count_payloads``
+    (bool/0-1 valued) accumulate exactly in int32 (f32 partials per chunk
+    are exact below the chunk size). ``seg`` values >= num_segments
+    contribute nothing (their one-hot row is all zeros).
+
+    ``bf16``: one-hot and payloads in bf16 with f32 MXU accumulation; each
+    f32 payload is split hi+lo so ~16 mantissa bits survive. Callers must
+    guarantee every float payload is f32 (gated by the strategy selector)."""
+    n = seg.shape[0]
+    ch = min(
+        _MATMUL_CHUNK,
+        max(256, _MATMUL_ONEHOT_BUDGET // max(1, num_segments)),
+        n,
+    )
+    pad = (-n) % ch
+    acc_dtype = _float_acc_dtype(float_payloads)
+    nf = len(float_payloads)
+    if bf16:
+        # split each f32 payload into exact-sum bf16 halves: hi = bf16(v),
+        # lo = bf16(v - hi); one-hot weights (0/1) are bf16-exact, so the
+        # two f32-accumulated products recover ~16 mantissa bits
+        op_dtype: Any = jnp.bfloat16
+        acc_dtype = jnp.float32
+        his = [p.astype(jnp.bfloat16) for p in float_payloads]
+        los = [
+            (p.astype(jnp.float32) - h.astype(jnp.float32)).astype(
+                jnp.bfloat16
+            )
+            for p, h in zip(float_payloads, his)
+        ]
+        payloads = his + los + [p.astype(jnp.bfloat16) for p in count_payloads]
+    else:
+        op_dtype = acc_dtype
+        payloads = [p.astype(acc_dtype) for p in float_payloads] + [
+            p.astype(acc_dtype) for p in count_payloads
+        ]
     if pad:
         seg = jnp.concatenate(
             [seg, jnp.full((pad,), num_segments, dtype=seg.dtype)]
@@ -228,7 +382,7 @@ def matmul_segment_sums(
             for p in payloads
         ]
     a = len(payloads)
-    nf = len(float_payloads)
+    nsplit = 2 * nf if bf16 else nf
     kc = seg.reshape(-1, ch)
     pc = jnp.stack(payloads, axis=0).reshape(a, -1, ch)
     iota = jnp.arange(num_segments, dtype=seg.dtype)
@@ -236,15 +390,21 @@ def matmul_segment_sums(
     def body(carry: Tuple[Any, Any], kv: Any) -> Tuple[Tuple[Any, Any], None]:
         f_acc, c_acc = carry
         kk, vv = kv
-        oh = (kk[:, None] == iota[None, :]).astype(acc_dtype)
-        part = vv @ oh  # (a, num_segments)
-        f_acc = f_acc + part[:nf]
-        c_acc = c_acc + part[nf:].astype(jnp.int32)
+        oh = (kk[:, None] == iota[None, :]).astype(op_dtype)
+        part = jax.lax.dot_general(
+            vv, oh, (((1,), (0,)), ((), ())),
+            preferred_element_type=acc_dtype,
+        )  # (a, num_segments), accumulated in acc_dtype
+        if bf16:
+            f_acc = f_acc + part[:nf] + part[nf:nsplit]
+        else:
+            f_acc = f_acc + part[:nf]
+        c_acc = c_acc + part[nsplit:].astype(jnp.int32)
         return (f_acc, c_acc), None
 
     init = (
         jnp.zeros((nf, num_segments), acc_dtype),
-        jnp.zeros((a - nf, num_segments), jnp.int32),
+        jnp.zeros((a - nsplit, num_segments), jnp.int32),
     )
     (f_acc, c_acc), _ = jax.lax.scan(
         body, init, (kc, jnp.moveaxis(pc, 0, 1))
@@ -446,26 +606,44 @@ def _segment_agg_impl(
     seg: jnp.ndarray,
     num_segments: int,
     valid_rows: jnp.ndarray,
+    strategy: str = "scatter",
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
     """One aggregation as a segment reduction (trace-time building block);
-    returns (values[num_segments], mask[num_segments])."""
+    returns (values[num_segments], mask[num_segments]). Sum-type reductions
+    (count/sum/avg) route through the strategy layer; order-based ones
+    (min/max/median/...) are scatter-native on every platform."""
     effective = valid_rows if mask is None else (mask & valid_rows)
-    # int32 accumulation: int64 is emulated on TPU; counts fit int32 (<2B
-    # rows); callers cast the output to the schema type
-    count = jax.ops.segment_sum(
-        effective.astype(jnp.int32), seg, num_segments=num_segments
-    )
     f = func.lower()
     if f == "count":
-        return count, None
+        return segment_count(effective, seg, num_segments, strategy), None
     if f == "sum" or f in ("avg", "mean"):
         filled = jnp.where(effective, values, 0)
-        total = jax.ops.segment_sum(filled, seg, num_segments=num_segments)
+        use = strategy
+        if use == "matmul_bf16" and filled.dtype != jnp.float32:
+            use = "matmul"  # the hi/lo split assumes f32 payloads
+        if not jnp.issubdtype(filled.dtype, jnp.floating):
+            if use in ("matmul", "matmul_bf16"):
+                use = "scatter"  # exact int sums can't ride a float acc
+            _, cs, is_ = segment_sums(
+                [], [effective], seg, num_segments, use,
+                int_payloads=[filled],
+            )
+            total, count = is_[0], cs[0]
+        else:
+            fs, cs, _ = segment_sums(
+                [filled], [effective], seg, num_segments, use
+            )
+            total, count = fs[0], cs[0]
         if f == "sum":
             return total, count > 0  # all-null group -> NULL (SQL)
         avg = total / jnp.maximum(count, 1)
         return avg.astype(jnp.float64 if values.dtype == jnp.float64 else
                           jnp.float32), count > 0
+    # int32 accumulation: int64 is emulated on TPU; counts fit int32 (<2B
+    # rows); callers cast the output to the schema type
+    count = jax.ops.segment_sum(
+        effective.astype(jnp.int32), seg, num_segments=num_segments
+    )
     if f == "min":
         big = _type_max(values.dtype)
         filled = jnp.where(effective, values, big)

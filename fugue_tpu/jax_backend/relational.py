@@ -279,6 +279,8 @@ def semi_anti_join(
     null1 = _null_any_mask(b1, keys)
     null2 = _null_any_mask(b2, keys)
     p1 = b1.padded_nrows
+    # join-side count reductions share the group-by strategy layer
+    strat = engine._count_reduce_strategy(b1, S)
 
     def _prog(
         seg1: Any,
@@ -291,11 +293,9 @@ def semi_anti_join(
     ) -> Tuple[Any, Any]:
         valid1 = groupby.materialize_validity(rv1, p1, nrows1)
         match2 = v2 if n2m is None else (v2 & ~n2m)
-        # out-of-range seg ids contribute nothing to segment_sum
-        c2 = jax.ops.segment_sum(
-            match2.astype(jnp.int32),
-            jnp.where(match2, seg2, S),
-            num_segments=S,
+        # out-of-range seg ids contribute nothing on any strategy
+        c2 = groupby.segment_count(
+            match2, jnp.where(match2, seg2, S), S, strat
         )
         hit = c2[jnp.clip(seg1, 0, S - 1)] > 0
         matchable1 = valid1 if n1m is None else (valid1 & ~n1m)
@@ -306,7 +306,8 @@ def semi_anti_join(
         return keep, jnp.sum(keep).astype(jnp.int32)
 
     keep, cnt = engine._jit_cached(
-        ("semi_anti", anti, S, p1, b2.padded_nrows, tuple(keys)), _prog
+        ("semi_anti", anti, S, p1, b2.padded_nrows, tuple(keys), strat),
+        _prog,
     )(
         sf.seg1,
         sf.seg2,
@@ -336,6 +337,7 @@ def not_in_join(
     null1 = _null_any_mask(b1, keys)
     null2 = _null_any_mask(b2, keys)
     p1 = b1.padded_nrows
+    strat = engine._count_reduce_strategy(b1, S)
 
     def _prog(
         seg1: Any,
@@ -354,10 +356,8 @@ def not_in_join(
         else:
             any_null2 = jnp.sum((v2 & n2m).astype(jnp.int32)) > 0
             match2 = v2 & ~n2m
-        c2 = jax.ops.segment_sum(
-            match2.astype(jnp.int32),
-            jnp.where(match2, seg2, S),
-            num_segments=S,
+        c2 = groupby.segment_count(
+            match2, jnp.where(match2, seg2, S), S, strat
         )
         hit = c2[jnp.clip(seg1, 0, S - 1)] > 0
         notnull1 = valid1 if n1m is None else (valid1 & ~n1m)
@@ -365,7 +365,7 @@ def not_in_join(
         return keep, jnp.sum(keep).astype(jnp.int32)
 
     keep, cnt = engine._jit_cached(
-        ("not_in", S, p1, b2.padded_nrows, tuple(keys)), _prog
+        ("not_in", S, p1, b2.padded_nrows, tuple(keys), strat), _prog
     )(
         sf.seg1,
         sf.seg2,
@@ -430,6 +430,10 @@ def expand_join(
             schema1, schema2, out_schema,
         )
 
+    # per-side match counts share the group-by strategy layer (matmul on
+    # accelerator tiers below the segment cap, scatter otherwise)
+    strat = engine._count_reduce_strategy(b1, S)
+
     def _count_prog(
         seg1_: Any,
         seg2_: Any,
@@ -442,9 +446,7 @@ def expand_join(
         valid1 = groupby.materialize_validity(rv1, p1, n1)
         match2 = v2 if n2m is None else (v2 & ~n2m)
         seg2s = jnp.where(match2, seg2_, S)
-        c2 = jax.ops.segment_sum(
-            match2.astype(jnp.int32), seg2s, num_segments=S
-        )
+        c2 = groupby.segment_count(match2, seg2s, S, strat)
         matchable1 = valid1 if n1m is None else (valid1 & ~n1m)
         m = jnp.where(matchable1, c2[jnp.clip(seg1_, 0, S - 1)], 0)
         reps = jnp.where(
@@ -460,10 +462,8 @@ def expand_join(
             # O(p1) segment_sum the other join types shouldn't pay
             zero = jnp.zeros((), jnp.int32)
             return m, start, order2, cstart2, total, zero, order2
-        c1 = jax.ops.segment_sum(
-            matchable1.astype(jnp.int32),
-            jnp.where(matchable1, seg1_, S),
-            num_segments=S,
+        c1 = groupby.segment_count(
+            matchable1, jnp.where(matchable1, seg1_, S), S, strat
         )
         un2 = v2 & (
             ~match2 | (c1[jnp.clip(seg2_, 0, S - 1)] == 0)
@@ -473,7 +473,7 @@ def expand_join(
         return m, start, order2, cstart2, total, r_total, order_un2
 
     m, start, order2, cstart2, total, r_total, order_un2 = engine._jit_cached(
-        ("join_count", how, S, p1, p2, tuple(keys)), _count_prog
+        ("join_count", how, S, p1, p2, tuple(keys), strat), _count_prog
     )(
         seg1,
         seg2,
@@ -919,6 +919,9 @@ def intersect_subtract(
     sf = shared_factorize(b1, b2, names)
     S = max(sf.num_segments, 1)
     p1 = b1.padded_nrows
+    # S + 1: the multiset branch reduces over the sentinel bucket too —
+    # the selector must see the LARGEST segment count the program uses
+    strat = engine._count_reduce_strategy(b1, S + 1)
 
     def _prog(
         seg1: Any,
@@ -928,9 +931,7 @@ def intersect_subtract(
         v2: Any,
     ) -> Tuple[Any, Any]:
         valid1 = groupby.materialize_validity(rv1, p1, n1)
-        c2 = jax.ops.segment_sum(
-            v2.astype(jnp.int32), jnp.where(v2, seg2, S), num_segments=S
-        )
+        c2 = groupby.segment_count(v2, jnp.where(v2, seg2, S), S, strat)
         pos = jnp.arange(p1, dtype=jnp.int32)
         if distinct:
             hit = c2[jnp.clip(seg1, 0, S - 1)] > 0
@@ -946,9 +947,7 @@ def intersect_subtract(
         # multiset: occurrence ordinal per key via a segment-sorted scan
         segv1 = jnp.where(valid1, seg1, S)
         order = jnp.argsort(segv1, stable=True)
-        c1 = jax.ops.segment_sum(
-            valid1.astype(jnp.int32), segv1, num_segments=S + 1
-        )[:S]
+        c1 = groupby.segment_count(valid1, segv1, S + 1, strat)[:S]
         starts = jnp.cumsum(c1) - c1
         sseg = segv1[order]
         ordinal_sorted = pos - starts[jnp.clip(sseg, 0, S - 1)]
@@ -968,6 +967,7 @@ def intersect_subtract(
             p1,
             b2.padded_nrows,
             tuple(names),
+            strat,
         ),
         _prog,
     )(sf.seg1, sf.seg2, b1.row_valid, _nrows_arg(b1), b2.validity())
@@ -2006,6 +2006,10 @@ def _window_segment_agg(
             # datetime64 is not a jax dtype (review finding)
             cast_result = False
 
+    # windowed sum/avg/count are segment reductions too: same strategy
+    # layer as the group-by (min/max stay scatter-native inside the impl)
+    strat = engine._count_reduce_strategy(blocks, S + 1)
+
     def _prog(
         values_: Any,
         vmask_: Optional[Any],
@@ -2016,7 +2020,7 @@ def _window_segment_agg(
         valid = groupby.materialize_validity(row_valid, p, nrows_s)
         segv = jnp.where(valid, seg_, S)
         v, m = groupby._segment_agg_impl(
-            func, values_, vmask_, segv, S + 1, valid
+            func, values_, vmask_, segv, S + 1, valid, strategy=strat
         )
         segc = jnp.clip(seg_, 0, S - 1)
         out = v[:S][segc]
@@ -2028,7 +2032,7 @@ def _window_segment_agg(
     out, outm = engine._jit_cached(
         (
             "win_agg", func, spec.arg, p, S, tuple(spec.partition_by),
-            str(tp), vmask is not None,
+            str(tp), vmask is not None, strat,
         ),
         _prog,
     )(values, vmask, seg, blocks.row_valid, _nrows_arg(blocks))

@@ -384,6 +384,12 @@ def inline_scalar_subqueries(
         if isinstance(node, ast.ScalarSubquery):
             if cte_names and _references_cte(node.query):
                 return node  # a CTE shadows the name: host scoping wins
+            # translate a COPY: when this pass declines to inline (plan
+            # not lowerable, >1 row, exotic value), the original tree must
+            # come out untouched — the host runner reuses it, and a
+            # synthetic __scalar__ alias left behind would leak into its
+            # scoping (ADVICE r5 #4)
+            query = node.query
             if (
                 isinstance(node.query, ast.Select)
                 and len(node.query.items) == 1
@@ -391,9 +397,10 @@ def inline_scalar_subqueries(
                 and not isinstance(node.query.items[0].expr, ast.Star)
             ):
                 # the bridge needs named computed columns; the name is
-                # never visible to the outer query (harmless on host too)
-                node.query.items[0].alias = "__scalar__"
-            plan = translate_query(node.query, df_schemas)
+                # never visible to the outer query
+                query = copy.deepcopy(node.query)
+                query.items[0].alias = "__scalar__"
+            plan = translate_query(query, df_schemas)
             if plan is None or len(plan.out_names) != 1:
                 return node
             try:

@@ -7,7 +7,6 @@ semantics: three-valued logic, null-ignoring aggregates, null keys never
 joining, null-safe set operations.
 """
 
-import re
 from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
@@ -23,7 +22,7 @@ from fugue_tpu.column.functions import (
     variance_ddof,
     variance_stat,
 )
-from fugue_tpu.column.pandas_eval import sql_fmod
+from fugue_tpu.column.pandas_eval import compile_like_regex, sql_fmod
 from fugue_tpu.schema import Schema
 from fugue_tpu.sql_frontend import ast
 from fugue_tpu.sql_frontend.parser import parse_select
@@ -795,8 +794,14 @@ class _Evaluator:
         s = ts.series.astype(object)
         nulls = s.isna()
         if isinstance(e.pattern, ast.Lit):
-            regex = _like_to_regex(str(e.pattern.value))
-            matched = s.where(nulls, s.astype(str).str.match(regex, na=False))
+            # the ONE anchored like->regex helper all three evaluators
+            # share (device LUTs, pandas_eval, this runner): fullmatch
+            # with \A...\Z — str.match + ^...$ would also accept a
+            # trailing newline and silently diverge (ADVICE r5 #3)
+            regex = compile_like_regex(str(e.pattern.value))
+            matched = s.where(
+                nulls, s.astype(str).str.fullmatch(regex, na=False)
+            )
             res = matched.astype("boolean")
         else:
             # dynamic (column-valued) pattern: compile per DISTINCT
@@ -811,9 +816,9 @@ class _Evaluator:
                     continue
                 rx = cache.get(pv)
                 if rx is None:
-                    rx = re.compile(_like_to_regex(str(pv)))
+                    rx = compile_like_regex(str(pv))
                     cache[pv] = rx
-                vals.append(rx.match(str(v)) is not None)
+                vals.append(rx.fullmatch(str(v)) is not None)
             res = pd.Series(vals, index=s.index, dtype=object).astype(
                 "boolean"
             )
@@ -896,18 +901,6 @@ def _to_str_scalar(v: Any) -> str:
     if isinstance(v, float) and v.is_integer():
         return str(v)
     return str(v)
-
-
-def _like_to_regex(pattern: str) -> str:
-    out = []
-    for ch in pattern:
-        if ch == "%":
-            out.append(".*")
-        elif ch == "_":
-            out.append(".")
-        else:
-            out.append(re.escape(ch))
-    return "^" + "".join(out) + "$"
 
 
 _SQL_TYPES: Dict[str, pa.DataType] = {

@@ -146,17 +146,27 @@ class ProcessTask(FugueTask):
 
     def execute(self, ctx: TaskContext, inputs: List[DataFrame]) -> DataFrame:
         # validations are declarations about the WORKFLOW, not the data:
-        # they must fire even when the task result is checkpoint-cached
+        # they must fire even when the task result is checkpoint-cached.
+        # Schemas validate DIRECTLY on the inputs (no conversion) and
+        # _make_dfs runs only past the checkpoint check, so a
+        # deterministic-cache hit never pays input conversion — EXCEPT a
+        # raw (non-DataFrame) input under declared input-schema rules,
+        # which has no schema to validate until converted (ADVICE r5 #5)
         processor = _to_processor(self.extension, self.schema)
         self._setup_extension(processor, ctx)
-        validate_partition_spec(processor.validation_rules, self.partition_spec)
-        dfs = self._make_dfs(ctx, inputs)
-        for in_df in dfs.values():
-            validate_input_schema(processor.validation_rules, in_df.schema)
+        rules = processor.validation_rules
+        validate_partition_spec(rules, self.partition_spec)
+        if "input_has" in rules or "input_is" in rules:
+            inputs = [
+                i if isinstance(i, DataFrame) else ctx.engine.to_df(i)
+                for i in inputs
+            ]
+            for i in inputs:
+                validate_input_schema(rules, i.schema)
         cached = self._try_skip(ctx)
         if cached is not None:
             return cached
-        df = processor.process(dfs)
+        df = processor.process(self._make_dfs(ctx, inputs))
         return self._finalize(ctx, ctx.engine.to_df(df))
 
     def _make_dfs(self, ctx: TaskContext, inputs: List[DataFrame]) -> DataFrames:

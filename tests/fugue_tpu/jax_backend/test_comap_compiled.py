@@ -279,3 +279,54 @@ def test_untraceable_cotransformer_falls_back_to_host_loop():
     res = e.comap(z, runner.run, "k:long,s:double", PartitionSpec(by=["k"]))
     assert sorted(map(tuple, res.as_array())) == [(1, 13.0)]
     assert e.fallbacks.get("comap", 0) == 1, e.fallbacks
+
+
+def test_over_reporting_nrows_is_rejected():
+    # ADVICE r5 #2: a cotransformer claiming more rows than its output
+    # columns hold would turn garbage padding rows into real rows — the
+    # compiled path must validate like the host group loop does
+    def cm_over(
+        a: Dict[str, jax.Array], b: Dict[str, jax.Array]
+    ) -> Dict[str, jax.Array]:
+        k = seg_key(a, "k")
+        s = seg_sum(a, "v") + seg_sum(b, "w")
+        return {"k": k, "s": s, "_nrows": jnp.int32(k.shape[0] + 3)}
+
+    from fugue_tpu.extensions.builtins import _CoTransformerRunner
+    from fugue_tpu.extensions.convert import _to_transformer
+
+    e = make_engine()
+    a = e.to_df([[1, 1.0], [1, 2.0], [2, 5.0]], "k:long,v:double")
+    b = e.to_df([[1, 10.0], [2, 20.0]], "k:long,w:double")
+    z = e.zip(DataFrames(a, b), partition_spec=PartitionSpec(by=["k"]))
+    tf = _to_transformer(cm_over, schema="k:long,s:double")
+    tf._output_schema = "k:long,s:double"
+    tf._partition_spec = PartitionSpec(by=["k"])
+    runner = _CoTransformerRunner(z, tf, [])
+    with pytest.raises(Exception, match="_nrows"):
+        e.comap(z, runner.run, "k:long,s:double", PartitionSpec(by=["k"]))
+
+
+def test_explicit_nrows_at_bound_is_accepted():
+    # _nrows == output length is the valid boundary (all rows real)
+    def cm_exact(
+        a: Dict[str, jax.Array], b: Dict[str, jax.Array]
+    ) -> Dict[str, jax.Array]:
+        k = seg_key(a, "k")
+        s = seg_sum(a, "v") + seg_sum(b, "w")
+        return {"k": k, "s": s, "_nrows": jnp.int32(k.shape[0])}
+
+    from fugue_tpu.extensions.builtins import _CoTransformerRunner
+    from fugue_tpu.extensions.convert import _to_transformer
+
+    e = make_engine()
+    a = e.to_df([[1, 1.0], [1, 2.0], [2, 5.0]], "k:long,v:double")
+    b = e.to_df([[1, 10.0], [2, 20.0]], "k:long,w:double")
+    z = e.zip(DataFrames(a, b), partition_spec=PartitionSpec(by=["k"]))
+    tf = _to_transformer(cm_exact, schema="k:long,s:double")
+    tf._output_schema = "k:long,s:double"
+    tf._partition_spec = PartitionSpec(by=["k"])
+    runner = _CoTransformerRunner(z, tf, [])
+    res = e.comap(z, runner.run, "k:long,s:double", PartitionSpec(by=["k"]))
+    assert len(res.as_array()) == 2
+    assert e.fallbacks == {}, e.fallbacks

@@ -242,9 +242,11 @@ def test_groupby_matmul_conf_paths_agree():
     assert a["k"].tolist() == b["k"].tolist()
     assert a["c"].tolist() == b["c"].tolist()
     assert np.allclose(a["s"], b["s"], rtol=1e-5)
-    # auto on a CPU mesh = the scatter path
+    # auto on a CPU mesh = the scatter strategy
     e = JaxExecutionEngine()
-    assert not e._prefer_matmul(e.to_df(pdf).blocks)
+    blocks = e.to_df(pdf).blocks
+    assert e._groupby_strategy(blocks, 5000, 16, 3) == "scatter"
+    assert e._count_reduce_strategy(blocks, 16) == "scatter"
 
 
 def test_compile_cache_conf():

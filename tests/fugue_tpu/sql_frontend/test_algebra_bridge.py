@@ -104,3 +104,24 @@ def test_complex_query_falls_back_correctly():
     )
     assert jx2 == nt2
     assert sum(e2.fallbacks.values()) >= 1  # counted, not silent
+
+
+def test_inline_scalar_subquery_decline_leaves_ast_untouched():
+    # ADVICE r5 #4: when the inline pass declines (here: run_plan raises),
+    # the parsed tree must come out EXACTLY as parsed — no synthetic
+    # __scalar__ alias left behind for the host runner to trip on
+    import copy
+
+    from fugue_tpu.sql_frontend.algebra_bridge import (
+        inline_scalar_subqueries,
+    )
+    from fugue_tpu.sql_frontend.parser import parse_select
+
+    q = parse_select("SELECT k FROM t WHERE v > (SELECT AVG(v) FROM t)")
+    snapshot = copy.deepcopy(q)
+
+    def boom(plan):
+        raise RuntimeError("device refused")
+
+    inline_scalar_subqueries(q, {"t": ["k", "v"]}, boom)
+    assert q == snapshot, q

@@ -18,9 +18,15 @@ from fugue_tpu.workflow.api import raw_sql
 def _frame(rng: np.random.Generator, n: int = 160) -> pd.DataFrame:
     v = np.round(rng.random(n) * 10, 3)
     v[rng.random(n) < 0.12] = np.nan
-    s = rng.choice(["red", "green", "blue", "teal "], n).astype(object)
+    # trailing-newline values exercise the LIKE anchor unification
+    # (ADVICE r5 #3: ^...$ + str.match would accept "red\n" LIKE 'red')
+    s = rng.choice(
+        ["red", "green", "blue", "teal ", "red\n"], n
+    ).astype(object)
     s[rng.random(n) < 0.1] = None
-    p = rng.choice(["r%", "%e%", "b___", "%l", "te%"], n).astype(object)
+    p = rng.choice(["r%", "%e%", "b___", "%l", "te%", "red"], n).astype(
+        object
+    )
     p[rng.random(n) < 0.1] = None
     return pd.DataFrame(
         {
@@ -82,6 +88,7 @@ def _bool(rng: np.random.Generator, depth: int = 0) -> str:
                 "s = 'red'",
                 "s <> 'blue'",
                 "s LIKE '%e%'",
+                "s LIKE 'red'",  # exact literal: the trailing-\n anchor case
                 "s NOT LIKE 'r%'",
                 "s LIKE p",  # dynamic (column-valued) pattern
                 "s NOT LIKE p",
