@@ -726,6 +726,11 @@ def _config5_e2e_parquet() -> Dict[str, Any]:
     engines = {
         "native": make_execution_engine("native"),
         "jax": make_execution_engine("jax"),
+        # streamed ingest/save: record-batch decode overlaps per-shard
+        # device staging (fugue.jax.io.batch_rows; ISSUE 2 tentpole)
+        "jax_streamed": make_execution_engine(
+            "jax", {"fugue.jax.io.batch_rows": max(n // 16, 65_536)}
+        ),
     }
 
     def run(engine: Any, udf: Any, schema: str, out_name: str) -> None:
@@ -739,7 +744,45 @@ def _config5_e2e_parquet() -> Dict[str, Any]:
         )
         e.save_df(agg, os.path.join(tmp, out_name), format_hint="parquet")
 
-    return _pair(
+    def _drain(df: Any) -> Any:
+        """Force device residency so a phase boundary is honest (lazy
+        ingest + async dispatch otherwise push work into later phases)."""
+        import jax as __jax
+
+        blocks = getattr(df, "blocks", None)
+        if blocks is not None and not callable(blocks):
+            from fugue_tpu.jax_backend.blocks import residency_arrays
+
+            for arr in residency_arrays(blocks):
+                __jax.block_until_ready(arr)
+        return df
+
+    def run_phases(engine: Any, udf: Any, schema: str, out_name: str) -> Dict[str, float]:
+        """One decomposed pass: per-phase seconds with forced phase
+        boundaries. Comparing `sum(phases)` with the pipelined e2e time
+        (which never forces boundaries) makes the load/stage/save
+        overlap win visible in the artifact."""
+        e = engines[engine]
+        t0 = time.perf_counter()
+        df = _drain(e.load_df(src_path, format_hint="parquet"))
+        t1 = time.perf_counter()
+        out = transform(df, udf, schema=schema, engine=e, as_fugue=True)
+        agg = _drain(aggregate(
+            out, partition_by="k",
+            s=ff.sum(col("v2")), c=ff.count(col("v2")),
+            engine=e, as_fugue=True,
+        ))
+        t2 = time.perf_counter()
+        e.save_df(agg, os.path.join(tmp, out_name), format_hint="parquet")
+        t3 = time.perf_counter()
+        return {
+            "load_secs": round(t1 - t0, 4),
+            "compute_secs": round(t2 - t1, 4),
+            "save_secs": round(t3 - t2, 4),
+            "sum_secs": round(t3 - t0, 4),
+        }
+
+    res = _pair(
         n,
         lambda: run("native", pandas_udf, "*,v2:float", "out_native.parquet"),
         lambda: run(
@@ -747,6 +790,22 @@ def _config5_e2e_parquet() -> Dict[str, Any]:
         ),
         pinned_key="5_e2e_parquet",
     )
+    streamed_secs = _timed(
+        lambda: run("jax_streamed", jax_udf, "k:int,v2:float",
+                    "out_jax_s.parquet")
+    )
+    res["jax_streamed_secs"] = round(streamed_secs, 4)
+    res["jax_streamed_rows_per_sec"] = round(n / streamed_secs, 1)
+    res["streamed_vs_eager"] = round(res["jax_secs"] / streamed_secs, 2)
+    res["phases"] = {
+        name: run_phases(name, udf, schema, out)
+        for name, udf, schema, out in [
+            ("native", pandas_udf, "*,v2:float", "out_native.parquet"),
+            ("jax", jax_udf, "k:int,v2:float", "out_jax.parquet"),
+            ("jax_streamed", jax_udf, "k:int,v2:float", "out_jax_s.parquet"),
+        ]
+    }
+    return res
 
 
 def _bench() -> Dict[str, Any]:

@@ -26,6 +26,7 @@ FUGUE_CONF_JAX_DEVICE_ZIP = "fugue.jax.device_zip"
 FUGUE_CONF_JAX_PLACEMENT = "fugue.jax.placement"
 FUGUE_CONF_JAX_MIN_DEVICE_BYTES = "fugue.jax.placement.min_device_bytes"
 FUGUE_CONF_JAX_COMPILE_CACHE = "fugue.jax.compile.cache"
+FUGUE_CONF_JAX_IO_BATCH_ROWS = "fugue.jax.io.batch_rows"
 FUGUE_CONF_JAX_GROUPBY_MATMUL = "fugue.jax.groupby.matmul"
 FUGUE_CONF_JAX_GROUPBY_STRATEGY = "fugue.jax.groupby.strategy"
 FUGUE_CONF_JAX_GROUPBY_AUTOTUNE = "fugue.jax.groupby.autotune"
@@ -54,6 +55,12 @@ _DEFAULT_CONF: Dict[str, Any] = {
     # GB; on PCIe-local TPU hosts set a lower threshold or placement=device.
     FUGUE_CONF_JAX_PLACEMENT: "auto",
     FUGUE_CONF_JAX_MIN_DEVICE_BYTES: 256 * 1024 * 1024,
+    # streamed parquet ingest/save: 0 = eager (whole-table). > 0 pipelines
+    # arrow record-batch decode with per-shard device_put staging on load
+    # (each mesh shard ships as soon as its rows are decoded, while the
+    # next batches decode) and bounds parquet row groups on save. The
+    # ingest stays LAZY: host-only chains never pay a device round trip.
+    FUGUE_CONF_JAX_IO_BATCH_ROWS: 0,
     # group-by reduction algorithm (legacy knob, kept for back-compat):
     # "always"/"never" pin the strategy below to matmul/scatter; "auto"
     # defers to fugue.jax.groupby.strategy.

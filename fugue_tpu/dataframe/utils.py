@@ -126,6 +126,7 @@ def serialize_df(
     df: Optional[DataFrame],
     threshold: int = -1,
     file_path: Optional[str] = None,
+    fs: Any = None,
 ) -> Optional[bytes]:
     """Serialize a local-izable dataframe into a blob (arrow IPC inside
     pickle), or spill to a parquet file past ``threshold`` returning the
@@ -143,11 +144,17 @@ def serialize_df(
     assert_or_throw(
         file_path is not None, ValueError("file_path required beyond threshold")
     )
-    pq.write_table(table, file_path)
+    if fs is None:
+        from fugue_tpu.utils.io import default_fs
+
+        fs = default_fs()
+    fs.write_file_atomic(file_path, lambda fp: pq.write_table(table, fp))
     return pickle.dumps(("file", file_path))
 
 
-def deserialize_df(blob: Optional[bytes]) -> Optional[LocalBoundedDataFrame]:
+def deserialize_df(
+    blob: Optional[bytes], fs: Any = None
+) -> Optional[LocalBoundedDataFrame]:
     if blob is None:
         return None
     kind, payload = pickle.loads(blob)
@@ -156,7 +163,12 @@ def deserialize_df(blob: Optional[bytes]) -> Optional[LocalBoundedDataFrame]:
             table = reader.read_all()
         return ArrowDataFrame(table)
     if kind == "file":
-        return ArrowDataFrame(pq.read_table(payload))
+        if fs is None:
+            from fugue_tpu.utils.io import default_fs
+
+            fs = default_fs()
+        with fs.open_input_stream(payload) as fp:
+            return ArrowDataFrame(pq.read_table(fp))
     raise ValueError(f"invalid serialized dataframe {kind}")
 
 

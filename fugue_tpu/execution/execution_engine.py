@@ -13,8 +13,11 @@ from abc import ABC, abstractmethod
 from contextlib import contextmanager
 from contextvars import ContextVar
 from threading import RLock
-from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+from typing import TYPE_CHECKING, Any, Callable, Dict, Iterator, List, Optional, Union
 from uuid import uuid4
+
+if TYPE_CHECKING:  # pragma: no cover
+    from fugue_tpu.fs import FileSystemRegistry
 
 from fugue_tpu.collections.partition import PartitionCursor, PartitionSpec
 from fugue_tpu.collections.sql import StructuredRawSQL
@@ -164,6 +167,7 @@ class ExecutionEngine(FugueEngineBase):
         self._conf.update(ParamDict(conf))
         self._map_engine: Optional[MapEngine] = None
         self._sql_engine: Optional[SQLEngine] = None
+        self._fs: Optional[Any] = None
         self._in_context_count = 0
         self._is_global = False
         self._ctx_tokens: List[Any] = []
@@ -256,6 +260,26 @@ class ExecutionEngine(FugueEngineBase):
     @sql_engine.setter
     def sql_engine(self, engine: SQLEngine) -> None:
         self._sql_engine = engine
+
+    @property
+    def fs(self) -> "FileSystemRegistry":
+        """The engine's URI-routing filesystem (part of the contract,
+        reference execution_engine.py:476): every persistence path —
+        save/load targets, checkpoint dirs, yield files — resolves
+        through it, so ``memory://`` / ``gs://`` URIs work anywhere a
+        local path does."""
+        if self._fs is None:
+            self._fs = self.create_default_fs()
+        return self._fs
+
+    @fs.setter
+    def fs(self, fs: "FileSystemRegistry") -> None:
+        self._fs = fs
+
+    def create_default_fs(self) -> "FileSystemRegistry":
+        from fugue_tpu.fs import make_default_registry
+
+        return make_default_registry()
 
     @abstractmethod
     def create_default_map_engine(self) -> MapEngine:  # pragma: no cover
@@ -541,6 +565,8 @@ class ExecutionEngine(FugueEngineBase):
             + [(_FUGUE_SER_NO, "int"), (_FUGUE_SER_KEY, "bytes")]  # type: ignore
         )
 
+        engine_fs = self.fs if temp_path is not None else None
+
         def _serialize(cursor: PartitionCursor, data: LocalDataFrame) -> LocalDataFrame:
             blob = serialize_df(
                 data,
@@ -548,6 +574,7 @@ class ExecutionEngine(FugueEngineBase):
                 file_path=None
                 if temp_path is None
                 else f"{temp_path}/{uuid4()}.parquet",
+                fs=engine_fs,
             )
             row = [cursor.key_value_dict[k] for k in keys] + [df_no, blob]
             return ArrayDataFrame([row], output_schema)
@@ -575,7 +602,7 @@ class ExecutionEngine(FugueEngineBase):
         key_names = [
             n for n in df.schema.names if n not in (_FUGUE_SER_NO, _FUGUE_SER_KEY)
         ]
-        runner = _Comap(schemas, names, how, map_func, on_init)
+        runner = _Comap(schemas, names, how, map_func, on_init, fs=self.fs)
         spec = PartitionSpec(partition_spec, by=key_names) if key_names else \
             PartitionSpec(num=1)
         return self.map_engine.map_dataframe(
@@ -616,12 +643,16 @@ class _Comap:
         how: str,
         func: Callable,
         on_init: Optional[Callable],
+        fs: Any = None,
     ):
         self.schemas = schemas
         self.names = names
         self.how = how
         self.func = func
         self._on_init = on_init
+        # spill blobs were written through the engine's fs: read back
+        # through the SAME registry, not the process default
+        self._fs = fs
 
     def on_init(self, partition_no: int, df: DataFrame) -> None:
         if self._on_init is not None:
@@ -658,9 +689,9 @@ class _Comap:
             if len(blobs) == 0:
                 frames.append(ArrayDataFrame([], self.schemas[no]))
             elif len(blobs) == 1:
-                frames.append(deserialize_df(blobs[0]))  # type: ignore
+                frames.append(deserialize_df(blobs[0], fs=self._fs))  # type: ignore
             else:
-                sub = [deserialize_df(b) for b in blobs]
+                sub = [deserialize_df(b, fs=self._fs) for b in blobs]
                 merged = sub[0].as_arrow()  # type: ignore
                 import pyarrow as pa
 

@@ -476,7 +476,7 @@ class NativeExecutionEngine(ExecutionEngine):
         columns: Any = None,
         **kwargs: Any,
     ) -> LocalBoundedDataFrame:
-        return _io.load_df(path, format_hint, columns, **kwargs)
+        return _io.load_df(path, format_hint, columns, fs=self.fs, **kwargs)
 
     def save_df(
         self,
@@ -488,13 +488,11 @@ class NativeExecutionEngine(ExecutionEngine):
         force_single: bool = False,
         **kwargs: Any,
     ) -> None:
-        partition_spec = partition_spec or PartitionSpec()
-        cols = (
-            list(partition_spec.partition_by)
-            if not force_single and len(partition_spec.partition_by) > 0
-            else None
+        _io.save_df(
+            df, path, format_hint, mode,
+            partition_cols=_io.spec_partition_cols(partition_spec, force_single),
+            fs=self.fs, **kwargs,
         )
-        _io.save_df(df, path, format_hint, mode, partition_cols=cols, **kwargs)
 
 
 def _pandas_distinct(pdf: pd.DataFrame) -> pd.DataFrame:

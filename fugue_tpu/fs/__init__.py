@@ -1,0 +1,27 @@
+"""URI-addressed virtual filesystem layer (see base.py for the design)."""
+
+from fugue_tpu.fs.base import (
+    FileSystemRegistry,
+    VirtualFileSystem,
+    is_uri,
+    join_uri,
+    make_default_registry,
+    register_filesystem,
+    split_uri,
+    uri_basename,
+    uri_dirname,
+)
+from fugue_tpu.fs.memory import reset_memory_fs
+
+__all__ = [
+    "FileSystemRegistry",
+    "VirtualFileSystem",
+    "is_uri",
+    "join_uri",
+    "make_default_registry",
+    "register_filesystem",
+    "reset_memory_fs",
+    "split_uri",
+    "uri_basename",
+    "uri_dirname",
+]

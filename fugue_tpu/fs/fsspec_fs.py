@@ -1,0 +1,86 @@
+"""fsspec adapter: any scheme fsspec knows (``gs://``, ``s3://``,
+``az://``, ``http://``...) becomes a VirtualFileSystem.
+
+Registered as the ``"*"`` fallback so explicit builtin schemes
+(``file``, ``memory``) keep their native backends. fsspec is OPTIONAL:
+when absent the fallback registration is skipped and unknown schemes
+raise the registry's NotImplementedError instead of an import error."""
+
+from typing import Any, BinaryIO, List
+
+from fugue_tpu.fs.base import VirtualFileSystem, register_filesystem
+
+
+class FsspecFileSystem(VirtualFileSystem):
+    """Thin mapping onto ``fsspec.AbstractFileSystem`` (one instance per
+    scheme; connection conf comes from the environment the way fsspec
+    backends already standardize)."""
+
+    def __init__(self, scheme: str):
+        import fsspec
+
+        self.scheme = scheme
+        self._fs = fsspec.filesystem(scheme)
+
+    def _q(self, path: str) -> str:
+        # fsspec backends accept scheme-less paths for their own protocol
+        return path
+
+    def open_input_stream(self, path: str) -> BinaryIO:
+        return self._fs.open(self._q(path), "rb")
+
+    def open_output_stream(self, path: str) -> BinaryIO:
+        p = self._q(path)
+        parent = p.rsplit("/", 1)[0] if "/" in p else ""
+        if parent:
+            try:  # contract: parents exist after this call; object
+                # stores have no real dirs and may no-op or refuse
+                self._fs.makedirs(parent, exist_ok=True)
+            except Exception:
+                pass
+        return self._fs.open(p, "wb")
+
+    def exists(self, path: str) -> bool:
+        return bool(self._fs.exists(self._q(path)))
+
+    def isdir(self, path: str) -> bool:
+        return bool(self._fs.isdir(self._q(path)))
+
+    def listdir(self, path: str) -> List[str]:
+        out = []
+        for p in self._fs.ls(self._q(path), detail=False):
+            out.append(str(p).rstrip("/").rsplit("/", 1)[-1])
+        return sorted(out)
+
+    def file_size(self, path: str) -> int:
+        return int(self._fs.size(self._q(path)))
+
+    def makedirs(self, path: str, exist_ok: bool = True) -> None:
+        self._fs.makedirs(self._q(path), exist_ok=exist_ok)
+
+    def rm(self, path: str, recursive: bool = False) -> None:
+        p = self._q(path)
+        if not self._fs.exists(p):
+            return
+        self._fs.rm(p, recursive=recursive)
+
+    def rename(self, src: str, dst: str) -> None:
+        self._fs.mv(self._q(src), self._q(dst), recursive=False)
+
+    def glob(self, pattern: str) -> List[str]:
+        return sorted(str(p) for p in self._fs.glob(self._q(pattern)))
+
+    def pyarrow_native(self) -> Any:
+        """Object stores skip the python FileSystemHandler shim: pyarrow
+        wraps fsspec directly (C++-thread-safe handler)."""
+        from pyarrow.fs import FSSpecHandler, PyFileSystem
+
+        return PyFileSystem(FSSpecHandler(self._fs))
+
+
+try:  # pragma: no cover - environment dependent
+    import fsspec  # noqa: F401
+
+    register_filesystem("*", lambda scheme: FsspecFileSystem(scheme))
+except ImportError:  # pragma: no cover
+    pass

@@ -1,0 +1,55 @@
+"""Local-disk backend: ``file://`` URIs and bare paths."""
+
+import glob as _glob
+import os
+import shutil
+from typing import BinaryIO, List
+
+from fugue_tpu.fs.base import VirtualFileSystem, register_filesystem
+
+
+class LocalFileSystem(VirtualFileSystem):
+    scheme = "file"
+
+    def open_input_stream(self, path: str) -> BinaryIO:
+        return open(path, "rb")
+
+    def open_output_stream(self, path: str) -> BinaryIO:
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        return open(path, "wb")
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def isdir(self, path: str) -> bool:
+        return os.path.isdir(path)
+
+    def listdir(self, path: str) -> List[str]:
+        return sorted(os.listdir(path))
+
+    def file_size(self, path: str) -> int:
+        return os.path.getsize(path)
+
+    def makedirs(self, path: str, exist_ok: bool = True) -> None:
+        os.makedirs(path, exist_ok=exist_ok)
+
+    def rm(self, path: str, recursive: bool = False) -> None:
+        if not os.path.exists(path):
+            return
+        if os.path.isdir(path):
+            if recursive:
+                shutil.rmtree(path, ignore_errors=True)
+            else:
+                os.rmdir(path)
+        else:
+            os.remove(path)
+
+    def rename(self, src: str, dst: str) -> None:
+        os.replace(src, dst)
+
+    def glob(self, pattern: str) -> List[str]:
+        return sorted(_glob.glob(pattern))
+
+
+register_filesystem("file", lambda scheme: LocalFileSystem())

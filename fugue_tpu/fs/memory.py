@@ -1,0 +1,173 @@
+"""In-process ``memory://`` backend.
+
+The non-local filesystem every test environment has: a process-wide
+blob store (the role the in-memory table catalog plays for table
+yields), used to exercise URI plumbing — checkpoints, yield files,
+multi-part folder writes — without object storage. Process-wide on
+purpose: yields/checkpoints must cross engine instances within one
+driver process, exactly like a real remote store would."""
+
+import io
+import posixpath
+from threading import RLock
+from typing import BinaryIO, Callable, Dict, List
+
+from fugue_tpu.fs.base import VirtualFileSystem, register_filesystem
+
+_LOCK = RLock()
+_FILES: Dict[str, bytes] = {}
+_DIRS: set = set()
+
+
+def reset_memory_fs() -> None:
+    """Drop every memory:// object (test isolation)."""
+    with _LOCK:
+        _FILES.clear()
+        _DIRS.clear()
+
+
+def _norm(path: str) -> str:
+    p = posixpath.normpath(path.strip("/"))
+    return "" if p == "." else p
+
+
+def _parents(path: str) -> List[str]:
+    out = []
+    while True:
+        path = posixpath.dirname(path)
+        if path == "":
+            return out
+        out.append(path)
+
+
+class _WriteBuffer(io.BytesIO):
+    """Commits the blob on close — a reader never sees a partial file,
+    which is also what makes single-file overwrite atomic here."""
+
+    def __init__(self, commit: Callable[[bytes], None]):
+        super().__init__()
+        self._commit = commit
+        self._committed = False
+
+    def abort(self) -> None:
+        """Discard the buffer without publishing (failed atomic write)."""
+        self._committed = True
+        super().close()
+
+    def close(self) -> None:
+        if not self._committed:
+            self._committed = True
+            self._commit(self.getvalue())
+        super().close()
+
+
+class MemoryFileSystem(VirtualFileSystem):
+    scheme = "memory"
+
+    def open_input_stream(self, path: str) -> BinaryIO:
+        p = _norm(path)
+        with _LOCK:
+            if p not in _FILES:
+                raise FileNotFoundError(f"memory://{p}")
+            return io.BytesIO(_FILES[p])
+
+    def open_output_stream(self, path: str) -> BinaryIO:
+        p = _norm(path)
+
+        def commit(data: bytes) -> None:
+            with _LOCK:
+                _FILES[p] = data
+                _DIRS.update(_parents(p))
+
+        return _WriteBuffer(commit)
+
+    def exists(self, path: str) -> bool:
+        p = _norm(path)
+        with _LOCK:
+            return p == "" or p in _FILES or p in _DIRS
+
+    def isdir(self, path: str) -> bool:
+        p = _norm(path)
+        with _LOCK:
+            return p == "" or p in _DIRS
+
+    def listdir(self, path: str) -> List[str]:
+        p = _norm(path)
+        with _LOCK:
+            if p != "" and p not in _DIRS:
+                raise FileNotFoundError(f"memory://{p} is not a directory")
+            prefix = p + "/" if p != "" else ""
+            names = set()
+            for k in list(_FILES) + list(_DIRS):
+                if k != p and k.startswith(prefix):
+                    names.add(k[len(prefix):].split("/", 1)[0])
+            return sorted(names)
+
+    def file_size(self, path: str) -> int:
+        p = _norm(path)
+        with _LOCK:
+            if p not in _FILES:
+                raise FileNotFoundError(f"memory://{p}")
+            return len(_FILES[p])
+
+    def makedirs(self, path: str, exist_ok: bool = True) -> None:
+        p = _norm(path)
+        with _LOCK:
+            if not exist_ok and p in _DIRS:
+                raise FileExistsError(f"memory://{p}")
+            if p != "":
+                _DIRS.add(p)
+                _DIRS.update(_parents(p))
+
+    def rm(self, path: str, recursive: bool = False) -> None:
+        p = _norm(path)
+        with _LOCK:
+            if p in _FILES:
+                del _FILES[p]
+                return
+            if p in _DIRS:
+                prefix = p + "/"
+                children = [k for k in _FILES if k.startswith(prefix)]
+                subdirs = [k for k in _DIRS if k.startswith(prefix)]
+                if not recursive and (children or subdirs):
+                    raise OSError(f"memory://{p} is not empty")
+                for k in children:
+                    del _FILES[k]
+                for k in subdirs:
+                    _DIRS.discard(k)
+                _DIRS.discard(p)
+
+    def rename(self, src: str, dst: str) -> None:
+        s, d = _norm(src), _norm(dst)
+        with _LOCK:
+            if s in _FILES:
+                _FILES[d] = _FILES.pop(s)
+                _DIRS.update(_parents(d))
+                return
+            if s in _DIRS:
+                prefix = s + "/"
+                for k in [k for k in _FILES if k.startswith(prefix)]:
+                    _FILES[d + "/" + k[len(prefix):]] = _FILES.pop(k)
+                for k in [k for k in _DIRS if k.startswith(prefix)]:
+                    _DIRS.discard(k)
+                    _DIRS.add(d + "/" + k[len(prefix):])
+                _DIRS.discard(s)
+                _DIRS.add(d)
+                _DIRS.update(_parents(d))
+                return
+            raise FileNotFoundError(f"memory://{s}")
+
+    def write_file_atomic(self, path: str, writer: Callable[[BinaryIO], None]) -> None:
+        # the commit-on-close buffer IS the atomic swap; no temp object.
+        # A failing writer ABORTS the buffer — partial bytes must never
+        # publish, or a deterministic checkpoint would reuse the torn file
+        fp = self.open_output_stream(path)
+        try:
+            writer(fp)
+        except BaseException:
+            fp.abort()  # type: ignore[attr-defined]
+            raise
+        fp.close()
+
+
+register_filesystem("memory", lambda scheme: MemoryFileSystem())

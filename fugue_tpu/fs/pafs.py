@@ -1,0 +1,120 @@
+"""VirtualFileSystem -> pyarrow.fs bridge.
+
+Parquet dataset machinery (hive partition discovery, directory reads,
+``write_to_dataset``) is pyarrow C++ code that talks to a
+``pyarrow.fs.FileSystem``. This module makes any VFS backend usable
+there: local disk maps to pyarrow's native LocalFileSystem (zero
+overhead), fsspec backends wrap through pyarrow's FSSpecHandler, and
+everything else (memory://, custom backends) goes through a python
+``FileSystemHandler`` shim."""
+
+from typing import Any, List
+
+import pyarrow as pa
+from pyarrow import fs as pafs
+
+from fugue_tpu.fs.base import VirtualFileSystem
+
+
+def to_pyarrow_fs(vfs: VirtualFileSystem) -> pafs.FileSystem:
+    from fugue_tpu.fs.local import LocalFileSystem
+
+    if isinstance(vfs, LocalFileSystem):
+        return pafs.LocalFileSystem()
+    native = getattr(vfs, "pyarrow_native", None)
+    if native is not None:
+        return native()
+    return pafs.PyFileSystem(_VFSHandler(vfs))
+
+
+class _VFSHandler(pafs.FileSystemHandler):
+    def __init__(self, vfs: VirtualFileSystem):
+        self._vfs = vfs
+
+    def get_type_name(self) -> str:
+        return f"fugue-vfs-{self._vfs.scheme}"
+
+    def __eq__(self, other: Any) -> bool:
+        return (
+            isinstance(other, _VFSHandler) and other._vfs is self._vfs
+        )
+
+    def __ne__(self, other: Any) -> bool:
+        return not self.__eq__(other)
+
+    # ---- info ------------------------------------------------------------
+    def _info(self, path: str) -> pafs.FileInfo:
+        v = self._vfs
+        if v.isdir(path):
+            return pafs.FileInfo(path, pafs.FileType.Directory)
+        if v.exists(path):
+            return pafs.FileInfo(
+                path, pafs.FileType.File, size=v.file_size(path)
+            )
+        return pafs.FileInfo(path, pafs.FileType.NotFound)
+
+    def get_file_info(self, paths: List[str]) -> List[pafs.FileInfo]:
+        return [self._info(p) for p in paths]
+
+    def get_file_info_selector(self, selector: Any) -> List[pafs.FileInfo]:
+        base = selector.base_dir
+        if not self._vfs.isdir(base):
+            if selector.allow_not_found:
+                return []
+            raise FileNotFoundError(base)
+        out: List[pafs.FileInfo] = []
+        stack = [base]
+        while stack:
+            d = stack.pop()
+            for name in self._vfs.listdir(d):
+                child = f"{d.rstrip('/')}/{name}" if d not in ("", "/") else name
+                info = self._info(child)
+                out.append(info)
+                if selector.recursive and info.type == pafs.FileType.Directory:
+                    stack.append(child)
+        return out
+
+    def normalize_path(self, path: str) -> str:
+        return path
+
+    # ---- mutation ---------------------------------------------------------
+    def create_dir(self, path: str, recursive: bool) -> None:
+        self._vfs.makedirs(path, exist_ok=True)
+
+    def delete_dir(self, path: str) -> None:
+        self._vfs.rm(path, recursive=True)
+
+    def delete_dir_contents(self, path: str, missing_dir_ok: bool = False) -> None:
+        if not self._vfs.isdir(path):
+            if missing_dir_ok:
+                return
+            raise FileNotFoundError(path)
+        for name in self._vfs.listdir(path):
+            self._vfs.rm(f"{path.rstrip('/')}/{name}", recursive=True)
+
+    def delete_root_dir_contents(self) -> None:  # pragma: no cover
+        self.delete_dir_contents("")
+
+    def delete_file(self, path: str) -> None:
+        self._vfs.rm(path)
+
+    def move(self, src: str, dest: str) -> None:
+        self._vfs.rename(src, dest)
+
+    def copy_file(self, src: str, dest: str) -> None:
+        data = self._vfs.read_bytes(src)
+        with self._vfs.open_output_stream(dest) as fp:
+            fp.write(data)
+
+    # ---- streams -----------------------------------------------------------
+    def open_input_stream(self, path: str) -> Any:
+        return pa.PythonFile(self._vfs.open_input_stream(path), mode="r")
+
+    def open_input_file(self, path: str) -> Any:
+        return pa.PythonFile(self._vfs.open_input_stream(path), mode="r")
+
+    def open_output_stream(self, path: str, metadata: Any = None) -> Any:
+        return pa.PythonFile(self._vfs.open_output_stream(path), mode="w")
+
+    def open_append_stream(self, path: str, metadata: Any = None) -> Any:
+        raise NotImplementedError("append streams are not supported")

@@ -313,6 +313,25 @@ def _int_like_stats(
     return None
 
 
+def decode_device_values(arr: Any, tp: pa.DataType) -> np.ndarray:
+    """Arrow array/chunked-array -> raw numpy values for a device-kind
+    non-string column (timestamps to int64 us-since-epoch, date32 to
+    int32 days; null positions arrive as NaN/NaT and are filled by the
+    caller). THE canonical decode — the eager ingest (:func:`from_arrow`)
+    and the streamed per-batch ingest (ingest._decode_into) must stay
+    value-identical, so both call this."""
+    if pa.types.is_timestamp(tp):
+        values = arr.cast(pa.timestamp("us")).to_numpy(zero_copy_only=False)
+        return (values.astype("datetime64[us]") - _EPOCH).astype(np.int64)
+    if pa.types.is_date32(tp):
+        values = arr.to_numpy(zero_copy_only=False)
+        values = (
+            values.astype("datetime64[D]").astype("datetime64[us]") - _EPOCH
+        ).astype(np.int64) // 86_400_000_000
+        return values.astype(np.int32)
+    return arr.to_numpy(zero_copy_only=False)
+
+
 def from_arrow(table: pa.Table, schema: Schema, mesh: Mesh) -> JaxBlocks:
     """Arrow -> device blocks (pads rows, encodes strings, builds masks,
     captures host-side key stats)."""
@@ -349,19 +368,7 @@ def from_arrow(table: pa.Table, schema: Schema, mesh: Mesh) -> JaxBlocks:
         np_dtype = _np_dtype_for(tp)
         combined = arr.combine_chunks()
         null_count = combined.null_count
-        if pa.types.is_timestamp(tp):
-            values = combined.cast(pa.timestamp("us")).to_numpy(
-                zero_copy_only=False
-            )
-            values = (values.astype("datetime64[us]") - _EPOCH).astype(np.int64)
-        elif pa.types.is_date32(tp):
-            values = combined.to_numpy(zero_copy_only=False)
-            values = (
-                values.astype("datetime64[D]").astype("datetime64[us]") - _EPOCH
-            ).astype(np.int64) // 86_400_000_000
-            values = values.astype(np.int32)
-        else:
-            values = combined.to_numpy(zero_copy_only=False)
+        values = decode_device_values(combined, tp)
         if null_count > 0:
             import pyarrow.compute as pc
 

@@ -2,7 +2,7 @@
 (the ``fugue_jax`` sibling-backend dataframe of the BASELINE north star;
 structural parity role: fugue_spark/dataframe.py:38 etc.)."""
 
-from typing import Any, Dict, Iterable, List, Optional
+from typing import Any, Dict, Iterable, List, NamedTuple, Optional
 
 import pandas as pd
 import pyarrow as pa
@@ -17,6 +17,17 @@ from fugue_tpu.jax_backend.blocks import (
 )
 from fugue_tpu.schema import Schema
 from fugue_tpu.utils.assertion import assert_or_throw
+
+
+class _LazyState(NamedTuple):
+    """Loaders for a frame still sitting in storage (streamed ingest)."""
+
+    load_blocks: Any  # () -> JaxBlocks: stream batches straight to mesh
+    load_table: Any  # () -> pa.Table: host-only full decode
+    mesh: Any
+    nrows: int  # from file metadata: count is free
+    load_head: Any  # (n) -> pa.Table reading only leading batches, or None
+    narrow: Any  # (cols) -> JaxDataFrame re-planned column subset, or None
 
 
 class JaxDataFrame(DataFrame):
@@ -35,6 +46,8 @@ class JaxDataFrame(DataFrame):
         super().__init__(schema)
         self._blocks: Optional[JaxBlocks] = blocks
         self._pending: Optional[Any] = None  # (pa.Table, mesh) before upload
+        # (load_blocks, load_table, mesh, nrows) for storage-lazy frames
+        self._lazy: Optional[Any] = None
 
     @staticmethod
     def from_table(table: pa.Table, mesh: Any, schema: Optional[Schema] = None) -> "JaxDataFrame":
@@ -43,11 +56,42 @@ class JaxDataFrame(DataFrame):
         DataFrame.__init__(res, schema)
         res._blocks = None
         res._pending = (table, mesh)
+        res._lazy = None
+        return res
+
+    @staticmethod
+    def from_lazy(
+        load_blocks: Any,
+        load_table: Any,
+        mesh: Any,
+        schema: Schema,
+        nrows: int,
+        load_head: Any = None,
+        narrow: Any = None,
+    ) -> "JaxDataFrame":
+        """A frame still sitting IN STORAGE (streamed parquet ingest):
+        ``load_blocks()`` streams record batches straight to the mesh
+        when a device op first touches :attr:`blocks`; ``load_table()``
+        is the host-only decode used by ``as_arrow`` chains that never
+        need the device copy; ``load_head(n)`` (optional) reads only the
+        leading batches so ``head``/``peek`` never decode the whole
+        file; ``narrow(cols)`` (optional) re-plans the load over a
+        column subset so selects prune decode/staging at the source.
+        ``nrows`` comes from file metadata, so ``count`` is free in
+        every state."""
+        res = JaxDataFrame.__new__(JaxDataFrame)
+        DataFrame.__init__(res, schema)
+        res._blocks = None
+        res._pending = None
+        res._lazy = _LazyState(
+            load_blocks, load_table, mesh, nrows, load_head, narrow
+        )
         return res
 
     @property
     def is_pending(self) -> bool:
-        """True while the data only lives on host (no device copy yet)."""
+        """True while the data only lives on host/storage (no device
+        copy yet)."""
         return self._blocks is None
 
     @property
@@ -57,16 +101,22 @@ class JaxDataFrame(DataFrame):
     @property
     def blocks(self) -> JaxBlocks:
         if self._blocks is None:
-            table, mesh = self._pending  # type: ignore[misc]
-            self._blocks = from_arrow(table, self.schema, mesh)
-            self._pending = None  # device copy is authoritative now
+            if self._lazy is not None:
+                self._blocks = self._lazy.load_blocks()
+                self._lazy = None  # device copy is authoritative now
+            else:
+                table, mesh = self._pending  # type: ignore[misc]
+                self._blocks = from_arrow(table, self.schema, mesh)
+                self._pending = None  # device copy is authoritative now
         return self._blocks
 
     @property
     def mesh(self) -> Any:
-        if self._blocks is None:
-            return self._pending[1]  # type: ignore[index]
-        return self._blocks.mesh
+        if self._blocks is not None:
+            return self._blocks.mesh
+        if self._lazy is not None:
+            return self._lazy.mesh
+        return self._pending[1]  # type: ignore[index]
 
     @property
     def is_local(self) -> bool:
@@ -82,23 +132,31 @@ class JaxDataFrame(DataFrame):
 
     @property
     def empty(self) -> bool:
-        if self._blocks is None:
-            return self._pending[0].num_rows == 0  # type: ignore[index]
-        return self._blocks.nrows == 0
+        return self.count() == 0
 
     def count(self) -> int:
-        if self._blocks is None:
-            return self._pending[0].num_rows  # type: ignore[index]
-        return self._blocks.nrows
+        if self._blocks is not None:
+            return self._blocks.nrows
+        if self._lazy is not None:
+            return self._lazy.nrows
+        return self._pending[0].num_rows  # type: ignore[index]
 
     def peek_array(self) -> List[Any]:
         self.assert_not_empty()
         return self.head(1).as_array(type_safe=True)[0]
 
     def as_arrow(self, type_safe: bool = False) -> pa.Table:
-        if self._blocks is None:
-            return self._pending[0]  # type: ignore[index]
-        return to_arrow(self._blocks, self.schema)
+        if self._blocks is not None:
+            return to_arrow(self._blocks, self.schema)
+        if self._lazy is not None:
+            # host-only decode, no device trip; memoize as an in-memory
+            # pending frame so a second host touch (or a later device op)
+            # never re-reads the file
+            table = self._lazy.load_table()
+            self._pending = (table, self._lazy.mesh)
+            self._lazy = None
+            return table
+        return self._pending[0]  # type: ignore[index]
 
     def as_pandas(self) -> pd.DataFrame:
         from fugue_tpu.dataframe.arrow_utils import table_to_pandas
@@ -130,6 +188,20 @@ class JaxDataFrame(DataFrame):
         return self._select_schema(schema)
 
     def _select_schema(self, schema: Schema) -> "JaxDataFrame":
+        if self._blocks is None and self._lazy is not None:
+            load_blocks, load_table, mesh, nrows, load_head, narrow = self._lazy
+            names = list(schema.names)
+            if narrow is not None:
+                res = narrow(names)
+                if res is not None:
+                    return res  # re-planned: unselected columns never decode
+            return JaxDataFrame.from_lazy(
+                lambda: _subset_blocks(load_blocks(), names),
+                lambda: load_table().select(names),
+                mesh, schema, nrows,
+                None if load_head is None
+                else lambda n: load_head(n).select(names),
+            )
         if self._blocks is None:
             table, mesh = self._pending  # type: ignore[misc]
             return JaxDataFrame.from_table(
@@ -146,6 +218,17 @@ class JaxDataFrame(DataFrame):
 
     def rename(self, columns: Dict[str, str]) -> DataFrame:
         schema = self._rename_schema(columns)
+        if self._blocks is None and self._lazy is not None:
+            load_blocks, load_table, mesh, nrows, load_head, _ = self._lazy
+            mapping = dict(columns)
+            names = list(schema.names)
+            return JaxDataFrame.from_lazy(
+                lambda: _rename_blocks(load_blocks(), mapping),
+                lambda: load_table().rename_columns(names),
+                mesh, schema, nrows,
+                None if load_head is None
+                else lambda n: load_head(n).rename_columns(names),
+            )
         if self._blocks is None:
             table, mesh = self._pending  # type: ignore[misc]
             return JaxDataFrame.from_table(
@@ -180,7 +263,12 @@ class JaxDataFrame(DataFrame):
         schema = self.schema if columns is None else self.schema.extract(columns)
         src = self if columns is None else self[columns]
         if src._blocks is None:  # type: ignore[union-attr]
-            table = src._pending[0]  # type: ignore[index]
+            lazy = src._lazy  # type: ignore[union-attr]
+            if lazy is not None and lazy.load_head is not None:
+                # bounded read: only the leading batches, not the file
+                table = lazy.load_head(n)
+            else:
+                table = src.as_arrow()  # pending/lazy host path, no device
             return ArrowDataFrame(table.slice(0, n), schema)
         blocks = src._blocks  # type: ignore
         if blocks.row_valid is not None:
@@ -198,3 +286,23 @@ class JaxDataFrame(DataFrame):
             JaxBlocks(take_n, blocks.columns, blocks.mesh), schema
         )
         return ArrowDataFrame(table, schema)
+
+
+def _subset_blocks(blocks: JaxBlocks, names: List[str]) -> JaxBlocks:
+    return JaxBlocks(
+        blocks._nrows,
+        {n: blocks.columns[n] for n in names},
+        blocks.mesh,
+        row_valid=blocks.row_valid,
+        nrows_dev=blocks._nrows_dev,
+    )
+
+
+def _rename_blocks(blocks: JaxBlocks, mapping: Dict[str, str]) -> JaxBlocks:
+    return JaxBlocks(
+        blocks._nrows,
+        {mapping.get(n, n): c for n, c in blocks.columns.items()},
+        blocks.mesh,
+        row_valid=blocks.row_valid,
+        nrows_dev=blocks._nrows_dev,
+    )

@@ -1445,7 +1445,20 @@ class JaxExecutionEngine(ExecutionEngine):
         columns: Any = None,
         **kwargs: Any,
     ) -> DataFrame:
-        local = self._native.load_df(path, format_hint, columns, **kwargs)
+        from fugue_tpu.constants import FUGUE_CONF_JAX_IO_BATCH_ROWS
+
+        batch_rows = int(self.conf.get(FUGUE_CONF_JAX_IO_BATCH_ROWS, 0))
+        if batch_rows > 0:
+            from fugue_tpu.jax_backend import ingest
+
+            res = ingest.try_stream_load(
+                self, path, format_hint, columns, batch_rows, **kwargs
+            )
+            if res is not None:
+                return res
+        from fugue_tpu.utils import io as _io
+
+        local = _io.load_df(path, format_hint, columns, fs=self.fs, **kwargs)
         return self.to_df(local)
 
     def save_df(
@@ -1458,10 +1471,17 @@ class JaxExecutionEngine(ExecutionEngine):
         force_single: bool = False,
         **kwargs: Any,
     ) -> None:
+        from fugue_tpu.constants import FUGUE_CONF_JAX_IO_BATCH_ROWS
+        from fugue_tpu.utils import io as _io
+
         jdf: JaxDataFrame = self.to_df(df)  # type: ignore
-        self._native.save_df(
-            jdf.as_local_bounded(), path, format_hint, mode, partition_spec,
-            force_single, **kwargs,
+        batch_rows = int(self.conf.get(FUGUE_CONF_JAX_IO_BATCH_ROWS, 0))
+        if batch_rows > 0:
+            kwargs.setdefault("batch_rows", batch_rows)
+        _io.save_df(
+            jdf.as_local_bounded(), path, format_hint, mode,
+            partition_cols=_io.spec_partition_cols(partition_spec, force_single),
+            fs=self.fs, **kwargs,
         )
 
     def convert_yield_dataframe(self, df: DataFrame, as_local: bool) -> DataFrame:

@@ -29,6 +29,21 @@ class RPCHandler(RPCClient):
     def __init__(self):
         self._rpchandler_lock = RLock()
         self._running = 0
+        self._uuid_once: Optional[str] = None
+
+    def __uuid__(self) -> str:
+        """Identity folded into workflow task uuids: a task whose
+        callback handler hashes identically across runs can reuse a
+        deterministic checkpoint; a CHANGED callback must invalidate it.
+        Default: FAIL CLOSED — a per-instance random uuid, because the
+        base class cannot see subclass constructor state and a stale
+        checkpoint reused for changed state is silent corruption.
+        Subclasses whose identity IS deterministic override: see
+        :class:`RPCFunc` (hashes the wrapped function's source) and
+        :class:`EmptyRPCHandler` (stateless by definition)."""
+        if self._uuid_once is None:
+            self._uuid_once = str(uuid4())
+        return self._uuid_once
 
     @property
     def running(self) -> bool:
@@ -65,6 +80,11 @@ class RPCHandler(RPCClient):
 
 
 class EmptyRPCHandler(RPCHandler):
+    def __uuid__(self) -> str:
+        from fugue_tpu.utils.hash import to_uuid
+
+        return to_uuid("EmptyRPCHandler")  # stateless: always identical
+
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
         raise NotImplementedError("empty rpc handler")
 
@@ -77,8 +97,127 @@ class RPCFunc(RPCHandler):
         assert_or_throw(callable(func), ValueError(f"{func} is not callable"))
         self._func = func
 
+    def __uuid__(self) -> str:
+        # hash the wrapped callable by SOURCE **plus captured state** so
+        # any behavioral change to the callback changes the task uuid:
+        # partial args fold in, closure cells fold in, a bound method
+        # folds its instance's __dict__. State that can't be hashed
+        # deterministically (opaque objects — hash._normalize falls back
+        # to repr with a memory address) or source that can't be read
+        # (exec'd/REPL code) FAILS CLOSED into a per-run uuid:
+        # recomputing is safe, reusing a stale checkpoint is not.
+        import functools
+        import inspect
+        from fugue_tpu.utils.hash import to_uuid
+
+        f: Any = self._func
+        state: list = []
+        while isinstance(f, functools.partial):
+            state.append(
+                (
+                    _state_view(list(f.args)),
+                    _state_view(sorted((f.keywords or {}).items())),
+                )
+            )
+            f = f.func
+        bound = getattr(f, "__self__", None)
+        if bound is not None:
+            if hasattr(bound, "__uuid__"):
+                state.append(bound.__uuid__())
+            else:
+                try:
+                    state.append(_state_view(sorted(vars(bound).items())))
+                except TypeError:  # no __dict__ (slots/builtins)
+                    return str(uuid4())
+        f = getattr(f, "__func__", f)  # bound method -> function
+        if hasattr(f, "__uuid__"):
+            base: Any = f.__uuid__()
+        elif inspect.isbuiltin(f):  # builtins are stable across runs
+            base = to_uuid(f)
+        elif inspect.isfunction(f):
+            try:
+                inspect.getsource(f)
+            except (OSError, TypeError):
+                return str(uuid4())  # source unknown: never reuse
+            # the TRANSITIVE state view: closure cells, default args, and
+            # the same for every captured function, recursively
+            state.append(_state_view(f))
+            base = "fn"
+        else:
+            return str(uuid4())  # opaque callable: never reuse
+        if not _state_hash_is_sound(state):
+            # captured state contains an opaque object whose repr may
+            # hide behavior-relevant changes: never reuse
+            return str(uuid4())
+        return to_uuid(type(self).__name__, base, state)
+
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
         return self._func(*args, **kwargs)
+
+
+def _state_view(v: Any, _seen: Optional[set] = None) -> Any:
+    """Expand a captured-state structure so EVERY behavior-carrying leaf
+    is visible to the hash: functions become (fn, [defaults, kwdefaults,
+    closure-cells]) with their own captured functions expanded
+    recursively — nested closures and default-argument bindings cannot
+    silently escape checkpoint invalidation."""
+    import inspect
+
+    seen = _seen if _seen is not None else set()
+    if inspect.isfunction(v):
+        if id(v) in seen:
+            return "<cycle>"
+        seen.add(id(v))
+        inner: list = []
+        if v.__defaults__:
+            inner.append(("defaults", _state_view(list(v.__defaults__), seen)))
+        if v.__kwdefaults__:
+            inner.append(
+                ("kwdefaults", _state_view(sorted(v.__kwdefaults__.items()), seen))
+            )
+        if v.__closure__:
+            cells = []
+            for c in v.__closure__:
+                try:
+                    cells.append(_state_view(c.cell_contents, seen))
+                except ValueError:  # still-empty cell
+                    cells.append("<empty>")
+            inner.append(("closure", cells))
+        return (v, inner)
+    if isinstance(v, (set, frozenset)):
+        return [_state_view(x, seen) for x in sorted(v, key=repr)]
+    if isinstance(v, (list, tuple)):
+        return [_state_view(x, seen) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _state_view(x, seen) for k, x in v.items()}
+    return v
+
+
+def _state_hash_is_sound(v: Any) -> bool:
+    """True when every leaf of a captured-state structure hashes by
+    VALUE (plain data, source-hashed functions, __uuid__ carriers) —
+    anything else would hash by repr, which a custom __repr__ can make
+    state-independent, silently defeating checkpoint invalidation."""
+    import inspect
+
+    if v is None or isinstance(v, (bool, int, float, str, bytes)):
+        return True
+    if isinstance(v, (list, tuple, set, frozenset)):
+        return all(_state_hash_is_sound(x) for x in v)
+    if isinstance(v, dict):
+        return all(
+            _state_hash_is_sound(k) and _state_hash_is_sound(x)
+            for k, x in v.items()
+        )
+    if hasattr(v, "__uuid__"):
+        return True
+    if inspect.isfunction(v):
+        try:
+            inspect.getsource(v)
+            return True
+        except (OSError, TypeError):
+            return False
+    return False
 
 
 def to_rpc_handler(obj: Any) -> RPCHandler:

@@ -5,10 +5,13 @@ fugue/workflow/_checkpoint.py:37-175).
 - StrongCheckpoint = save+reload a parquet file; ``deterministic=True`` keys
   the file by the task uuid so re-running an identical DAG SKIPS recompute
   when the artifact already exists.
+
+All paths resolve through the engine's virtual filesystem, so
+``fugue.workflow.checkpoint.path`` may be a URI (``memory://...``,
+``gs://...``) and checkpoint artifacts live wherever the cluster's
+data does.
 """
 
-import os
-import shutil
 from typing import Any, Optional
 from uuid import uuid4
 
@@ -202,14 +205,15 @@ class CheckpointPath:
         if self._path == "":
             self._temp_path = ""
             return ""
-        self._temp_path = os.path.join(self._path, execution_id)
-        os.makedirs(self._temp_path, exist_ok=True)
+        fs = self._engine.fs
+        self._temp_path = fs.join(self._path, execution_id)
+        fs.makedirs(self._temp_path, exist_ok=True)
         return self._temp_path
 
     def remove_temp_path(self) -> None:
         if self._temp_path != "":
             try:
-                shutil.rmtree(self._temp_path)
+                self._engine.fs.rm(self._temp_path, recursive=True)
             except Exception:  # pragma: no cover - best effort
                 pass
 
@@ -221,14 +225,14 @@ class CheckpointPath:
                 "fugue.workflow.checkpoint.path is not set for checkpoints"
             ),
         )
-        return os.path.join(path, f"{obj_id}.{fmt}")
+        return self._engine.fs.join(path, f"{obj_id}.{fmt}")
 
     def file_exists(self, path: str) -> bool:
-        return os.path.exists(path)
+        return self._engine.fs.exists(path)
 
     def temp_file(self, fmt: str = "parquet") -> str:
         assert_or_throw(
             self._temp_path != "",
             ValueError("fugue.workflow.checkpoint.path is not set"),
         )
-        return os.path.join(self._temp_path, f"{uuid4()}.{fmt}")
+        return self._engine.fs.join(self._temp_path, f"{uuid4()}.{fmt}")

@@ -1,0 +1,75 @@
+"""Seaborn visualization sub-plugin (parity role: reference
+fugue_contrib/seaborn/__init__.py:16-44): a NAMESPACED outputter —
+``using="sns:lineplot"`` routes to ``seaborn.lineplot`` — proving the
+``parse_outputter`` plugin protocol composes beyond exact aliases: the
+candidate matcher claims a whole ``sns:*`` namespace, the second
+in-repo plugin instance next to the exact-alias ``viz`` outputter.
+
+Seaborn/matplotlib import lazily at process() time, so registering the
+namespace never drags plotting deps into headless runs."""
+
+from typing import Any
+
+from fugue_tpu.dataframe import DataFrames
+from fugue_tpu.extensions.convert import parse_outputter
+from fugue_tpu.extensions.interfaces import Outputter
+from fugue_tpu.utils.assertion import assert_or_throw
+
+_NAMESPACE = "sns"
+
+
+class SeabornVisualize(Outputter):
+    """Plot the single input via a named seaborn function; with partition
+    keys, one plot per key group (presort applied first). Params pass
+    through to the seaborn function."""
+
+    def __init__(self, func: str):
+        super().__init__()
+        ns, has_func, name = func.partition(":")
+        assert_or_throw(
+            ns == _NAMESPACE, ValueError(f"{func} is not in the sns namespace")
+        )
+        self._func = name if has_func else "lineplot"
+
+    def __uuid__(self) -> str:
+        from fugue_tpu.utils.hash import to_uuid
+
+        return to_uuid(type(self).__name__, self._func)
+
+    def process(self, dfs: DataFrames) -> None:
+        assert_or_throw(len(dfs) == 1, ValueError("sns takes one dataframe"))
+        import seaborn as sns
+
+        fn = getattr(sns, self._func)
+        params = dict(self.params)
+        pdf = dfs[0].as_pandas()
+        presort = self.partition_spec.presort
+        if presort:
+            pdf = pdf.sort_values(
+                list(presort.keys()), ascending=list(presort.values())
+            ).reset_index(drop=True)
+        keys = self.partition_spec.partition_by
+        if len(keys) == 0:
+            self._plot(fn, pdf, params)
+            return
+        for _, gp in pdf.groupby(
+            keys if len(keys) > 1 else keys[0], dropna=False
+        ):
+            self._plot(fn, gp.reset_index(drop=True), params)
+
+    def _plot(self, fn: Any, pdf: Any, params: Any) -> None:
+        fn(data=pdf, **params)
+        try:  # render eagerly in scripts/notebooks
+            import matplotlib.pyplot as plt
+
+            plt.show()
+        except ImportError:  # pragma: no cover - matplotlib optional
+            pass
+
+
+@parse_outputter.candidate(
+    lambda obj, *a, **kw: isinstance(obj, str)
+    and (obj == _NAMESPACE or obj.startswith(_NAMESPACE + ":"))
+)
+def _parse_seaborn(obj: str, *args: Any, **kwargs: Any) -> Outputter:
+    return SeabornVisualize(obj)

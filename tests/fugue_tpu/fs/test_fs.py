@@ -1,0 +1,248 @@
+"""Unit tests for the virtual filesystem layer: URI helpers, memory
+backend semantics, glob, save modes, atomic overwrite."""
+
+import os
+
+import pytest
+
+from fugue_tpu.fs import (
+    FileSystemRegistry,
+    join_uri,
+    make_default_registry,
+    split_uri,
+    uri_basename,
+    uri_dirname,
+)
+from fugue_tpu.fs.local import LocalFileSystem
+
+
+def test_split_uri():
+    assert split_uri("gs://bucket/a/b") == ("gs", "bucket/a/b")
+    assert split_uri("memory://x") == ("memory", "x")
+    assert split_uri("/local/path") == ("file", "/local/path")
+    assert split_uri("rel/path") == ("file", "rel/path")
+    # windows drive letters are not schemes
+    assert split_uri("C://tmp")[0] == "file" or split_uri("C://tmp") == (
+        "file", "C://tmp",
+    )
+
+
+def test_join_and_names():
+    assert join_uri("memory://b/a", "x", "y.parquet") == "memory://b/a/x/y.parquet"
+    assert join_uri("/tmp/a", "b") == os.path.join("/tmp/a", "b")
+    assert uri_dirname("memory://b/a/x.parquet") == "memory://b/a"
+    assert uri_basename("memory://b/a/x.parquet") == "x.parquet"
+    assert uri_basename("/tmp/a/x.parquet") == "x.parquet"
+
+
+def test_memory_basic_and_listdir():
+    fs = make_default_registry()
+    base = "memory://unit/basic"
+    with fs.open_output_stream(f"{base}/d1/f1.bin") as fp:
+        fp.write(b"one")
+    with fs.open_output_stream(f"{base}/d1/f2.bin") as fp:
+        fp.write(b"two")
+    assert fs.exists(f"{base}/d1/f1.bin")
+    assert fs.isdir(f"{base}/d1")
+    assert not fs.isdir(f"{base}/d1/f1.bin")
+    assert fs.listdir(f"{base}/d1") == ["f1.bin", "f2.bin"]
+    assert fs.read_bytes(f"{base}/d1/f2.bin") == b"two"
+    assert fs.file_size(f"{base}/d1/f1.bin") == 3
+    with pytest.raises(FileNotFoundError):
+        fs.open_input_stream(f"{base}/nope.bin")
+
+
+def test_memory_rm_semantics():
+    fs = make_default_registry()
+    base = "memory://unit/rm"
+    with fs.open_output_stream(f"{base}/d/a.bin") as fp:
+        fp.write(b"x")
+    # non-recursive rm of a non-empty dir refuses
+    with pytest.raises(OSError):
+        fs.rm(f"{base}/d")
+    fs.rm(f"{base}/d", recursive=True)
+    assert not fs.exists(f"{base}/d")
+    # idempotent: removing a missing path is a no-op
+    fs.rm(f"{base}/d", recursive=True)
+
+
+def test_memory_glob():
+    fs = make_default_registry()
+    base = "memory://unit/glob"
+    for name in ["a/x.parquet", "a/y.csv", "a/b/z.parquet"]:
+        with fs.open_output_stream(f"{base}/{name}") as fp:
+            fp.write(b".")
+    got = fs.glob(f"{base}/a/*.parquet")
+    # standard glob semantics: * never crosses /, matching the native
+    # local/fsspec backends
+    assert got == [f"{base}/a/x.parquet"]
+    assert fs.glob(f"{base}/a/*/*.parquet") == [f"{base}/a/b/z.parquet"]
+    assert fs.glob(f"{base}/a/x.parquet") == [f"{base}/a/x.parquet"]
+    assert fs.glob(f"{base}/a/missing-*") == []
+    assert fs.glob(f"{base}/*/y.csv") == [f"{base}/a/y.csv"]
+
+
+def test_memory_atomic_abort_on_writer_failure():
+    # a failing writer must publish NOTHING (new file) and keep the OLD
+    # contents (overwrite) — a torn partial blob would be reused by
+    # deterministic checkpoints
+    fs = make_default_registry()
+    path = "memory://unit/abort/f.bin"
+    with pytest.raises(RuntimeError):
+        fs.write_file_atomic(
+            path, lambda fp: (_ for _ in ()).throw(RuntimeError("boom"))
+        )
+    assert not fs.exists(path)
+    with fs.open_output_stream(path) as fp:
+        fp.write(b"old")
+
+    def partial_then_fail(fp):
+        fp.write(b"partial")
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError):
+        fs.write_file_atomic(path, partial_then_fail)
+    assert fs.read_bytes(path) == b"old"
+
+
+def test_memory_atomic_overwrite():
+    # a reader holding the old object keeps reading OLD bytes; the swap
+    # happens only at writer close (no torn reads)
+    fs = make_default_registry()
+    path = "memory://unit/atomic/f.bin"
+    with fs.open_output_stream(path) as fp:
+        fp.write(b"old-contents")
+    reader = fs.open_input_stream(path)
+    out = fs.open_output_stream(path)
+    out.write(b"new")
+    assert fs.read_bytes(path) == b"old-contents"  # not yet committed
+    out.close()
+    assert fs.read_bytes(path) == b"new"
+    assert reader.read() == b"old-contents"  # old handle unaffected
+
+
+def test_overwrite_failure_keeps_old_artifact():
+    # mode='overwrite' must not delete the old single-file artifact
+    # before the new one commits: a failed write keeps the old contents
+    import pytest as _pytest
+
+    from fugue_tpu.execution.native_execution_engine import (
+        NativeExecutionEngine,
+    )
+
+    e = NativeExecutionEngine()
+    path = "memory://unit/ow/a.parquet"
+    e.save_df(e.to_df([[1]], "x:long"), path)
+    with _pytest.raises(Exception):
+        e.save_df(
+            e.to_df([[2]], "x:long"), path, compression="no-such-codec"
+        )
+    assert e.fs.exists(path)
+    assert e.load_df(path).as_array() == [[1]]  # old artifact intact
+
+
+def test_local_atomic_write(tmp_path):
+    fs = LocalFileSystem()
+    target = str(tmp_path / "out.bin")
+    fs.write_file_atomic(target, lambda fp: fp.write(b"data"))
+    assert fs.read_bytes(target) == b"data"
+    # failure inside the writer leaves no temp droppings and no target
+    with pytest.raises(RuntimeError):
+        fs.write_file_atomic(
+            str(tmp_path / "bad.bin"),
+            lambda fp: (_ for _ in ()).throw(RuntimeError("boom")),
+        )
+    assert sorted(os.listdir(tmp_path)) == ["out.bin"]
+
+
+def test_local_rename_and_glob(tmp_path):
+    fs = LocalFileSystem()
+    a = str(tmp_path / "a.txt")
+    b = str(tmp_path / "b.txt")
+    with fs.open_output_stream(a) as fp:
+        fp.write(b"z")
+    fs.rename(a, b)
+    assert not fs.exists(a) and fs.read_bytes(b) == b"z"
+    assert fs.glob(str(tmp_path / "*.txt")) == [b]
+
+
+def test_late_registration_reaches_default_registries():
+    # register_filesystem AFTER a default registry exists must still work
+    # (default registries track the live global factory table)
+    from fugue_tpu.fs import register_filesystem
+    from fugue_tpu.fs.base import _FACTORIES
+    from fugue_tpu.fs.memory import MemoryFileSystem
+
+    reg = make_default_registry()
+    try:
+        register_filesystem("lateproto", lambda s: MemoryFileSystem())
+        fs, path = reg.resolve("lateproto://bucket/k")
+        assert isinstance(fs, MemoryFileSystem)
+        assert path == "bucket/k"
+    finally:
+        _FACTORIES.pop("lateproto", None)
+
+    # RE-registering an already-resolved scheme invalidates the cached
+    # instance (the cache is keyed by producing factory, not just scheme)
+    class M2(MemoryFileSystem):
+        pass
+
+    fs1, _ = reg.resolve("memory://x")
+    try:
+        register_filesystem("memory", lambda s: M2())
+        fs2, _ = reg.resolve("memory://x")
+        assert type(fs2) is M2
+    finally:
+        register_filesystem("memory", lambda s: MemoryFileSystem())
+    fs3, _ = reg.resolve("memory://x")
+    assert type(fs3) is MemoryFileSystem
+
+
+def test_atomic_temp_files_are_hidden(tmp_path):
+    # crash-mid-write leftovers must be invisible to part-file readers:
+    # the temp name is '.'-prefixed next to the target
+    fs = LocalFileSystem()
+    seen = []
+    orig = fs.open_output_stream
+
+    def spy(path):
+        seen.append(path)
+        return orig(path)
+
+    fs.open_output_stream = spy  # type: ignore[method-assign]
+    fs.write_file_atomic(
+        str(tmp_path / "part-1.parquet"), lambda fp: fp.write(b"x")
+    )
+    assert os.path.basename(seen[0]).startswith(".")
+    assert os.listdir(tmp_path) == ["part-1.parquet"]
+
+
+def test_registry_unknown_scheme():
+    reg = FileSystemRegistry({"file": lambda s: LocalFileSystem()})
+    with pytest.raises(NotImplementedError):
+        reg.exists("nosuchscheme://x/y")
+
+
+def test_registry_scheme_routing(tmp_path):
+    fs = make_default_registry()
+    # same registry serves both backends; instances are cached per scheme
+    p_local = str(tmp_path / "f.bin")
+    with fs.open_output_stream(p_local) as fp:
+        fp.write(b"L")
+    with fs.open_output_stream("memory://unit/route/f.bin") as fp:
+        fp.write(b"M")
+    assert fs.read_bytes(p_local) == b"L"
+    assert fs.read_bytes("memory://unit/route/f.bin") == b"M"
+    assert fs.resolve("memory://a")[0] is fs.resolve("memory://b")[0]
+
+
+def test_engine_fs_contract():
+    from fugue_tpu.execution.native_execution_engine import (
+        NativeExecutionEngine,
+    )
+
+    e = NativeExecutionEngine()
+    assert e.fs is e.fs  # lazily created once
+    assert e.fs.exists("memory://") is True or isinstance(
+        e.fs, FileSystemRegistry
+    )

@@ -1,0 +1,227 @@
+"""Streamed parquet->device ingest (fugue.jax.io.batch_rows): batch-wise
+per-shard staging must produce frames IDENTICAL to the eager path, stay
+lazy for host-only chains, and fall back safely where it can't stream."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fugue_tpu.constants import FUGUE_CONF_JAX_IO_BATCH_ROWS
+from fugue_tpu.dataframe.utils import df_eq
+from fugue_tpu.jax_backend.execution_engine import JaxExecutionEngine
+
+
+@pytest.fixture(scope="module")
+def eager_engine():
+    e = JaxExecutionEngine({FUGUE_CONF_JAX_IO_BATCH_ROWS: 0})
+    yield e
+    e.stop()
+
+
+@pytest.fixture(scope="module")
+def stream_engine():
+    e = JaxExecutionEngine({FUGUE_CONF_JAX_IO_BATCH_ROWS: 64})
+    yield e
+    e.stop()
+
+
+def _mixed_pdf(n: int) -> pd.DataFrame:
+    rng = np.random.default_rng(7)
+    return pd.DataFrame(
+        {
+            "i": np.arange(n, dtype=np.int64),
+            "f": np.where(np.arange(n) % 7 == 0, np.nan, rng.random(n)),
+            "s": pd.array(
+                [None if i % 11 == 0 else f"s{i % 13}" for i in range(n)],
+                dtype="string",
+            ),
+            "b": np.arange(n) % 2 == 0,
+            "t": pd.to_datetime("2020-01-01")
+            + pd.to_timedelta(np.arange(n), unit="h"),
+            # nulls only past the first shards: the mask appears
+            # mid-stream and must backfill shipped shards as valid
+            "li": pd.array(
+                [i if i < n - 40 else None for i in range(n)], dtype="Int64"
+            ),
+        }
+    )
+
+
+def test_stream_parity_mixed_types(eager_engine, stream_engine, base_path="memory://ingest/mixed"):
+    pdf = _mixed_pdf(500)
+    path = f"{base_path}.parquet"
+    eager_engine.save_df(eager_engine.to_df(pdf), path)
+    eager = eager_engine.load_df(path)
+    streamed = stream_engine.load_df(path)
+    assert streamed._lazy is not None  # lazy until a device op
+    _ = streamed.blocks  # force the streamed upload
+    assert df_eq(streamed, eager, throw=True)
+
+
+def test_stream_metadata_parity(eager_engine, stream_engine):
+    pdf = _mixed_pdf(300)
+    path = "memory://ingest/meta.parquet"
+    eager_engine.save_df(eager_engine.to_df(pdf), path)
+    be = eager_engine.load_df(path).blocks
+    bs = stream_engine.load_df(path).blocks
+    # int stats and the monotonic-uniqueness proof match the eager ingest
+    assert be.columns["i"].stats == bs.columns["i"].stats
+    assert be.columns["i"].unique and bs.columns["i"].unique
+    assert bs.columns["li"].mask is not None
+    assert not bs.columns["li"].unique
+    # string dictionary decodes to the same values
+    assert be.columns["s"].dictionary is not None
+    assert bs.columns["s"].dictionary is not None
+
+
+def test_stream_multi_part_folder(eager_engine, stream_engine):
+    pdf = _mixed_pdf(200)
+    folder = "memory://ingest/folder"
+    eager_engine.save_df(
+        eager_engine.to_df(pdf.iloc[:77]), f"{folder}/part-0.parquet"
+    )
+    eager_engine.save_df(
+        eager_engine.to_df(pdf.iloc[77:].reset_index(drop=True)),
+        f"{folder}/part-1.parquet",
+    )
+    eager = eager_engine.load_df(folder, format_hint="parquet")
+    streamed = stream_engine.load_df(folder, format_hint="parquet")
+    assert streamed.count() == 200  # row count free from metadata
+    _ = streamed.blocks
+    assert df_eq(streamed, eager, throw=True)
+
+
+def test_stream_select_prunes_at_source(eager_engine, stream_engine):
+    # selecting columns on a lazy streamed frame re-plans the load: the
+    # dropped columns are never decoded or staged to device
+    pdf = _mixed_pdf(100)
+    path = "memory://ingest/prune.parquet"
+    eager_engine.save_df(eager_engine.to_df(pdf), path)
+    sub = stream_engine.load_df(path)[["i", "f"]]
+    assert sub._lazy is not None
+    blocks = sub.blocks
+    assert set(blocks.columns) == {"i", "f"}
+    assert df_eq(
+        sub, eager_engine.load_df(path, columns=["i", "f"]), throw=True
+    )
+
+
+def test_stream_column_select_stays_lazy(eager_engine, stream_engine):
+    pdf = _mixed_pdf(150)
+    path = "memory://ingest/sel.parquet"
+    eager_engine.save_df(eager_engine.to_df(pdf), path)
+    sub = stream_engine.load_df(path, columns=["i", "s"])
+    assert sub._lazy is not None
+    renamed = sub.rename({"i": "j"})
+    assert renamed._lazy is not None  # schema ops keep the frame lazy
+    _ = renamed.blocks
+    assert df_eq(
+        renamed,
+        eager_engine.load_df(path, columns=["i", "s"]).rename({"i": "j"}),
+        throw=True,
+    )
+
+
+def test_stream_host_chain_never_touches_device(eager_engine, stream_engine):
+    pdf = _mixed_pdf(120)
+    path = "memory://ingest/host.parquet"
+    eager_engine.save_df(eager_engine.to_df(pdf), path)
+    streamed = stream_engine.load_df(path)
+    tbl = streamed.as_arrow()  # host decode
+    assert streamed._blocks is None  # no device copy was built
+    assert tbl.num_rows == 120
+    head = stream_engine.load_df(path).head(3)
+    assert head.count() == 3
+
+
+def test_stream_fallbacks(eager_engine, stream_engine):
+    # schema-expression columns and hive dirs take the eager path
+    pdf = pd.DataFrame({"k": [1, 2, 1], "v": [1.0, 2.0, 3.0]})
+    path = "memory://ingest/fb.parquet"
+    eager_engine.save_df(eager_engine.to_df(pdf), path)
+    df = stream_engine.load_df(path, columns="k:long,v:double")
+    assert df_eq(df, eager_engine.load_df(path, columns="k:long,v:double"), throw=True)
+    from fugue_tpu.collections.partition import PartitionSpec
+
+    hive = "memory://ingest/hive.parquet"
+    eager_engine.save_df(
+        eager_engine.to_df(pdf), hive, partition_spec=PartitionSpec(by=["k"])
+    )
+    got = stream_engine.load_df(hive, columns="k:long,v:double")
+    assert df_eq(
+        got, eager_engine.load_df(hive, columns="k:long,v:double"), throw=True
+    )
+
+
+def test_stream_save_row_groups(eager_engine, stream_engine):
+    # buffered save bounds parquet row groups at batch_rows
+    import pyarrow.parquet as pq
+
+    pdf = _mixed_pdf(300)
+    path = "memory://ingest/rg.parquet"
+    stream_engine.save_df(stream_engine.to_df(pdf), path)
+    with stream_engine.fs.open_input_stream(path) as fp:
+        md = pq.ParquetFile(fp).metadata
+    assert md.num_row_groups >= 300 // 64
+    assert max(
+        md.row_group(i).num_rows for i in range(md.num_row_groups)
+    ) <= 64
+    assert df_eq(
+        stream_engine.load_df(path), eager_engine.load_df(path), throw=True
+    )
+
+
+def test_stream_heterogeneous_parts_fall_back(eager_engine, stream_engine):
+    # a part file missing a column must defer to the eager dataset read
+    # (null promotion), never silently substitute another column
+    eager_engine.save_df(
+        eager_engine.to_df(pd.DataFrame({"a": [1.0, 2.0], "b": [10.0, 20.0]})),
+        "memory://ingest/het/part-0.parquet",
+    )
+    eager_engine.save_df(
+        eager_engine.to_df(pd.DataFrame({"a": [3.0, 4.0]})),
+        "memory://ingest/het/part-1.parquet",
+    )
+    eager = eager_engine.load_df("memory://ingest/het", format_hint="parquet")
+    streamed = stream_engine.load_df("memory://ingest/het", format_hint="parquet")
+    assert df_eq(streamed, eager, throw=True)
+    # the missing column null-promotes for the short part file
+    assert sum(1 for r in eager.as_array() if r[1] is None) == 2
+
+
+def test_stream_unique_key_ending_at_zero(eager_engine, stream_engine):
+    # the monotonic-uniqueness proof must survive a last value of 0
+    # (membership check, not truthiness of the stored last element)
+    pdf = pd.DataFrame({"k": np.array([-2, -1, 0], dtype=np.int64)})
+    eager_engine.save_df(eager_engine.to_df(pdf), "memory://ingest/uz.parquet")
+    assert eager_engine.load_df(
+        "memory://ingest/uz.parquet"
+    ).blocks.columns["k"].unique
+    assert stream_engine.load_df(
+        "memory://ingest/uz.parquet"
+    ).blocks.columns["k"].unique
+
+
+def test_stream_head_is_bounded_and_lazy(eager_engine, stream_engine):
+    pdf = _mixed_pdf(200)
+    path = "memory://ingest/head.parquet"
+    eager_engine.save_df(eager_engine.to_df(pdf), path)
+    h = stream_engine.load_df(path)
+    hd = h.head(3)
+    assert hd.count() == 3
+    assert h._blocks is None  # head never built the device copy
+    # column-select (incl. out-of-order) threads the bounded head loader
+    sel = stream_engine.load_df(path, columns=["s", "i"])
+    hd2 = sel.head(2)
+    assert hd2.schema.names == ["s", "i"]
+    assert sel.peek_array() == [None, 0]  # row 0: s is null (i % 11 == 0)
+    assert sel._blocks is None
+
+
+def test_stream_empty_frame(eager_engine, stream_engine):
+    path = "memory://ingest/empty.parquet"
+    eager_engine.save_df(eager_engine.to_df([], "x:long,y:str"), path)
+    streamed = stream_engine.load_df(path)
+    assert streamed.count() == 0
+    _ = streamed.blocks
+    assert df_eq(streamed, eager_engine.load_df(path), throw=True)

@@ -1,0 +1,73 @@
+"""Seaborn contrib sub-plugin: proves the parse_outputter NAMESPACE
+protocol (``sns:*`` claims a whole prefix) with a second in-repo plugin
+instance next to the exact-alias ``viz`` outputter."""
+
+import sys
+from types import SimpleNamespace
+from typing import Any, List
+
+import pytest
+
+import fugue_tpu_contrib.seaborn as sns_contrib
+from fugue_tpu.exceptions import FugueInterfacelessError
+from fugue_tpu.extensions.convert import _to_outputter
+from fugue_tpu.workflow import FugueWorkflow
+from fugue_tpu.execution.native_execution_engine import NativeExecutionEngine
+
+
+def test_namespace_parsing():
+    o = _to_outputter("sns:barplot")
+    assert isinstance(o, sns_contrib.SeabornVisualize)
+    assert o._func == "barplot"
+    assert _to_outputter("sns")._func == "lineplot"  # namespace default
+    # identity is deterministic per plot function (checkpoint-safe)
+    assert o.__uuid__() == _to_outputter("sns:barplot").__uuid__()
+    assert o.__uuid__() != _to_outputter("sns:lineplot").__uuid__()
+    # non-namespaced unknown aliases still fail through the registry
+    with pytest.raises((ValueError, FugueInterfacelessError)):
+        _to_outputter("sns_not_a_namespace")
+
+
+def test_coexists_with_exact_alias_plugin():
+    import fugue_tpu_contrib.viz as viz
+
+    assert type(_to_outputter("viz")) is viz.Visualize
+    assert isinstance(_to_outputter("sns:histplot"), sns_contrib.SeabornVisualize)
+
+
+class _FakeSns(SimpleNamespace):
+    def __init__(self, calls: List[Any]):
+        super().__init__()
+        self._calls = calls
+
+    def lineplot(self, data=None, **kwargs):
+        self._calls.append(("lineplot", len(data), dict(kwargs)))
+
+
+def test_outputter_runs_in_workflow(monkeypatch):
+    calls: List[Any] = []
+    monkeypatch.setitem(sys.modules, "seaborn", _FakeSns(calls))
+    engine = NativeExecutionEngine()
+    dag = FugueWorkflow()
+    dag.df([[1, 2], [3, 4]], "x:long,y:long").output(
+        "sns:lineplot", params=dict(x="x", y="y")
+    )
+    dag.run(engine)
+    assert calls == [("lineplot", 2, {"x": "x", "y": "y"})]
+
+
+def test_outputter_partitioned(monkeypatch):
+    calls: List[Any] = []
+    monkeypatch.setitem(sys.modules, "seaborn", _FakeSns(calls))
+    o = sns_contrib.SeabornVisualize("sns:lineplot")
+    from fugue_tpu.collections.partition import PartitionSpec
+    from fugue_tpu.dataframe import ArrayDataFrame, DataFrames
+    from fugue_tpu.utils.params import ParamDict
+
+    o._params = ParamDict({"x": "x", "y": "y"})
+    o._partition_spec = PartitionSpec(by=["k"])
+    df = ArrayDataFrame(
+        [[1, 1, 10], [1, 2, 20], [2, 3, 30]], "k:long,x:long,y:long"
+    )
+    o.process(DataFrames([df]))
+    assert len(calls) == 2  # one plot per key group
