@@ -13,6 +13,11 @@ KEYWORD_PARALLELISM = "CONCURRENCY"
 
 FUGUE_CONF_WORKFLOW_CONCURRENCY = "fugue.workflow.concurrency"
 FUGUE_CONF_WORKFLOW_CHECKPOINT_PATH = "fugue.workflow.checkpoint.path"
+FUGUE_CONF_WORKFLOW_RETRY_MAX_ATTEMPTS = "fugue.workflow.retry.max_attempts"
+FUGUE_CONF_WORKFLOW_RETRY_BACKOFF = "fugue.workflow.retry.backoff"
+FUGUE_CONF_WORKFLOW_RETRY_JITTER = "fugue.workflow.retry.jitter"
+FUGUE_CONF_WORKFLOW_TIMEOUT = "fugue.workflow.timeout"
+FUGUE_CONF_WORKFLOW_RESUME = "fugue.workflow.resume"
 FUGUE_CONF_WORKFLOW_EXCEPTION_HIDE = "fugue.workflow.exception.hide"
 FUGUE_CONF_WORKFLOW_EXCEPTION_INJECT = "fugue.workflow.exception.inject"
 FUGUE_CONF_WORKFLOW_EXCEPTION_OPTIMIZE = "fugue.workflow.exception.optimize"
@@ -41,6 +46,19 @@ FUGUE_COMPILE_TIME_CONFIGS = {
 
 _DEFAULT_CONF: Dict[str, Any] = {
     FUGUE_CONF_WORKFLOW_CONCURRENCY: 1,
+    # fault tolerance: attempts = 1 means no retry; backoff is the base
+    # exponential delay in seconds (delay = backoff * 2**(attempt-1)),
+    # jitter a multiplicative fraction added on top. Only TRANSIENT error
+    # classes retry (fs/IO, RPC transport, jax RESOURCE_EXHAUSTED) — see
+    # fugue_tpu/workflow/fault.py:classify_error. timeout is the per-task
+    # wall clock in seconds (0 = unlimited), enforced by the parallel
+    # runner. resume=True keeps a run manifest of completed task uuids so
+    # re-running an identical DAG after a crash restarts at the frontier.
+    FUGUE_CONF_WORKFLOW_RETRY_MAX_ATTEMPTS: 1,
+    FUGUE_CONF_WORKFLOW_RETRY_BACKOFF: 0.1,
+    FUGUE_CONF_WORKFLOW_RETRY_JITTER: 0.1,
+    FUGUE_CONF_WORKFLOW_TIMEOUT: 0.0,
+    FUGUE_CONF_WORKFLOW_RESUME: False,
     FUGUE_CONF_WORKFLOW_EXCEPTION_HIDE: "fugue_tpu.",
     FUGUE_CONF_WORKFLOW_EXCEPTION_INJECT: 3,
     FUGUE_CONF_WORKFLOW_EXCEPTION_OPTIMIZE: True,

@@ -33,6 +33,10 @@ from fugue_tpu.exceptions import (
     FugueInterfacelessError,
     FugueWorkflowCompileError,
     FugueWorkflowRuntimeError,
+    TaskCancelledError,
+    TaskFailure,
+    TaskTimeoutError,
+    WorkflowRuntimeError,
 )
 from fugue_tpu.execution.execution_engine import (
     EngineFacet,
@@ -62,5 +66,18 @@ from fugue_tpu.rpc.base import (
     make_rpc_server,
     to_rpc_handler,
 )
+from fugue_tpu.testing.faults import (
+    FaultPlan,
+    FaultSpec,
+    fault_point,
+    inject_faults,
+)
+from fugue_tpu.workflow.fault import (
+    CancelToken,
+    RetryPolicy,
+    classify_error,
+    execute_with_policy,
+)
+from fugue_tpu.workflow.manifest import RunManifest
 from fugue_tpu.workflow.module import module
 from fugue_tpu.workflow.workflow import FugueWorkflow, WorkflowDataFrame

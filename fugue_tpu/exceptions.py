@@ -67,6 +67,75 @@ class FugueWorkflowRuntimeValidationError(FugueWorkflowRuntimeError):
     """A validation rule failed at runtime (partition/input checks)."""
 
 
+class TaskFailure:
+    """One task's failure inside a workflow run: the task's display name,
+    the user callsite where it was defined, and the error itself."""
+
+    def __init__(
+        self,
+        task_id: str,
+        task_name: str,
+        error: BaseException,
+        callsite=None,
+    ):
+        self.task_id = task_id
+        self.task_name = task_name
+        self.error = error
+        self.callsite = list(callsite or [])
+
+    def describe(self) -> str:
+        lines = [
+            f"[task {self.task_name}] "
+            f"{type(self.error).__name__}: {self.error}"
+        ]
+        if self.callsite:
+            lines.append("  defined at:")
+            lines.extend("  " + c for c in self.callsite)
+        return "\n".join(lines)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"TaskFailure({self.task_name}, {type(self.error).__name__})"
+
+
+class WorkflowRuntimeError(FugueWorkflowRuntimeError):
+    """The parallel runner's aggregated failure: EVERY task that failed
+    during the run (not just the first), each carrying its task name and
+    the user callsite that defined it. ``failures`` holds the structured
+    :class:`TaskFailure` list; the first failure is chained as
+    ``__cause__`` so ``raise ... from`` semantics and traceback tools
+    keep working."""
+
+    def __init__(self, failures):
+        self.failures = list(failures)
+        msg = f"{len(self.failures)} task(s) failed:\n" + "\n".join(
+            f.describe() for f in self.failures
+        )
+        super().__init__(msg)
+        if self.failures:
+            self.__cause__ = self.failures[0].error
+
+    @property
+    def errors(self):
+        return [f.error for f in self.failures]
+
+
+class TaskTimeoutError(FugueWorkflowRuntimeError):
+    """A task exceeded its wall-clock timeout (``fugue.workflow.timeout``
+    or a per-task override) and was abandoned by the runner."""
+
+    def __init__(self, task_name: str, timeout: float):
+        super().__init__(
+            f"task {task_name} timed out after {timeout:g}s"
+        )
+        self.task_name = task_name
+        self.timeout = timeout
+
+
+class TaskCancelledError(FugueWorkflowRuntimeError):
+    """A task was cooperatively cancelled because a sibling failed or
+    timed out; it never ran (or aborted at a cancellation point)."""
+
+
 class FugueSQLError(FugueWorkflowCompileError):
     """FugueSQL-related compile error."""
 

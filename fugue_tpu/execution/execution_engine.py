@@ -281,6 +281,21 @@ class ExecutionEngine(FugueEngineBase):
 
         return make_default_registry()
 
+    # ---- fault tolerance -------------------------------------------------
+    @property
+    def supports_host_degrade(self) -> bool:
+        """True when the engine has a cheaper capacity tier a device-OOM
+        task can re-run on (the jax engine's host mesh). The workflow's
+        retry executor consults this before counting an OOM as a retry."""
+        return False
+
+    def degraded_to_host(self) -> Any:
+        """Context manager forcing this THREAD's work onto the host tier.
+        Default engines have one tier: a no-op context."""
+        from contextlib import nullcontext
+
+        return nullcontext()
+
     @abstractmethod
     def create_default_map_engine(self) -> MapEngine:  # pragma: no cover
         raise NotImplementedError

@@ -28,6 +28,7 @@ import re
 from abc import ABC, abstractmethod
 from typing import Any, BinaryIO, Callable, Dict, List, Optional, Tuple
 
+from fugue_tpu.testing.faults import fault_point
 from fugue_tpu.utils.assertion import assert_or_throw
 
 _URI_RE = re.compile(r"^([A-Za-z][A-Za-z0-9+.-]*)://(.*)$")
@@ -228,19 +229,27 @@ class FileSystemRegistry:
         return fs, path
 
     # ---- URI-level operations -------------------------------------------
+    # fault_point calls are the fault-injection harness's fs sites
+    # ("fs.open" / "fs.write" keyed by full URI): free when no plan is
+    # active, and they sit at the REGISTRY level so every consumer —
+    # utils/io, streamed ingest, checkpoints, spill files — is covered.
     def open_input_stream(self, uri: str) -> BinaryIO:
+        fault_point("fs.open", uri)
         fs, path = self.resolve(uri)
         return fs.open_input_stream(path)
 
     def open_output_stream(self, uri: str) -> BinaryIO:
+        fault_point("fs.write", uri)
         fs, path = self.resolve(uri)
         return fs.open_output_stream(path)
 
     def read_bytes(self, uri: str) -> bytes:
+        fault_point("fs.open", uri)
         fs, path = self.resolve(uri)
         return fs.read_bytes(path)
 
     def write_file_atomic(self, uri: str, writer: Callable[[BinaryIO], None]) -> None:
+        fault_point("fs.write", uri)
         fs, path = self.resolve(uri)
         fs.write_file_atomic(path, writer)
 

@@ -24,6 +24,7 @@ fugue_spark/execution_engine.py:336) — but TPU-first in design:
   so a silent 100x slowdown cannot hide
 """
 
+import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import jax
@@ -568,6 +569,10 @@ class JaxExecutionEngine(ExecutionEngine):
         # taxes workloads that don't profile (review finding)
         self._program_log: Dict[Any, Tuple[Callable, Any]] = {}
         self._program_log_armed = False
+        # per-THREAD placement override: the fault-tolerance layer re-runs
+        # a device-OOM task under degraded_to_host() — thread-local so one
+        # degraded task in a parallel runner doesn't demote its siblings
+        self._tier_override = threading.local()
 
     @property
     def fallbacks(self) -> Dict[str, int]:
@@ -644,10 +649,36 @@ class JaxExecutionEngine(ExecutionEngine):
         engine is pinned or the default platform already is CPU."""
         return self._host_mesh
 
+    @property
+    def supports_host_degrade(self) -> bool:
+        """A device-OOM task can re-run on the host tier when the engine
+        actually has two tiers (not pinned to one mesh)."""
+        return not self._mesh_pinned and self._host_mesh is not self._mesh
+
+    def degraded_to_host(self) -> Any:
+        """Force THIS thread's ingest placement onto the host (CPU) mesh —
+        the graceful-degradation venue for a task whose device allocation
+        failed (RESOURCE_EXHAUSTED). Thread-local: concurrent sibling
+        tasks keep their accelerator placement."""
+        from contextlib import contextmanager
+
+        @contextmanager
+        def _ctx():
+            prev = getattr(self._tier_override, "mode", None)
+            self._tier_override.mode = "host"
+            try:
+                yield self
+            finally:
+                self._tier_override.mode = prev
+
+        return _ctx()
+
     def _ingest_mesh(self, nbytes: int) -> Any:
         """Placement policy: which mesh a newly ingested frame lands on."""
         if self._mesh_pinned or self._host_mesh is self._mesh:
             return self._mesh
+        if getattr(self._tier_override, "mode", None) == "host":
+            return self._host_mesh
         from fugue_tpu.constants import (
             FUGUE_CONF_JAX_MIN_DEVICE_BYTES,
             FUGUE_CONF_JAX_PLACEMENT,

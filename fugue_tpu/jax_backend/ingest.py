@@ -120,8 +120,20 @@ def try_stream_load(
         schema = Schema(a_schema)
 
         def load_blocks() -> B.JaxBlocks:
+            # re-consult placement at MATERIALIZATION time: under the
+            # fault layer's host-tier degrade override (thread-local,
+            # see JaxExecutionEngine.degraded_to_host) the streamed
+            # upload must re-place onto the host mesh even though the
+            # plan captured the device tier; the frame's mesh property
+            # follows the blocks once loaded
             return _stream_to_blocks(
-                fs, files, schema, mesh, nrows, batch_rows, sel
+                fs,
+                files,
+                schema,
+                engine._ingest_mesh(est_bytes),
+                nrows,
+                batch_rows,
+                sel,
             )
 
         def load_table() -> pa.Table:

@@ -263,6 +263,11 @@ class RPCServer(RPCHandler, ABC):
         self._handlers.clear()
 
     def invoke(self, key: str, *args: Any, **kwargs: Any) -> Any:
+        # fault-injection site: a worker->driver callback transport blip
+        # ("rpc" keyed by handler key; match "*" to fault any callback)
+        from fugue_tpu.testing.faults import fault_point
+
+        fault_point("rpc", key)
         with self._rpchandler_lock:
             handler = self._handlers[key]
         return handler(*args, **kwargs)

@@ -1,0 +1,9 @@
+"""Deterministic testing utilities: the fault-injection harness that
+exercises the workflow fault-tolerance layer (retry, degrade, resume)."""
+
+from fugue_tpu.testing.faults import (
+    FaultPlan,
+    FaultSpec,
+    fault_point,
+    inject_faults,
+)

@@ -1,0 +1,190 @@
+"""Deterministic fault-injection harness.
+
+Production code is sprinkled with zero-cost *fault points* — named sites
+(`"fs.open"`, `"fs.write"`, `"task"`, `"rpc"`) that consult the active
+:class:`FaultPlan` and raise the planned error when a site/key/invocation
+matches. No plan active (the normal case) is a single ``None`` check.
+
+A plan is a list of :class:`FaultSpec` rules. Each rule matches a site
+and a key glob (the URI for fs sites, the task display name for task
+sites, the handler key for rpc sites), and fires on specific invocations:
+``skip`` matching calls pass through first, then ``times`` calls raise
+the spec's error, then the site is clean again — so "fail the first two
+reads, then succeed" (the retry-recovery shape) is one rule. A seeded
+``probability`` mode exists for randomized soak tests; with the same
+seed the plan replays identically.
+
+Every matching invocation is counted per site:key (``attempts``,
+``injected``), and the retry executor reports back ``retries``,
+``recoveries`` and ``degradations`` — the same counter idiom as the jax
+engine's strategy/fallback counters, so tests assert recovery paths
+actually ran instead of trusting them on faith.
+
+Usage::
+
+    plan = FaultPlan(
+        FaultSpec("fs.open", "memory://data/*", times=2,
+                  error=lambda: OSError("injected read hiccup")),
+        seed=7,
+    )
+    with inject_faults(plan):
+        dag.run(engine)          # first two matching reads fail
+    # counters key by the CONCRETE invocation key, not the spec glob:
+    assert plan.counters["fs.open:memory://data/a.parquet"]["injected"] == 2
+    assert plan.total("injected") == 2
+"""
+
+import fnmatch
+import random
+import threading
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+
+_ErrorLike = Union[BaseException, Callable[[], BaseException], type]
+
+
+class FaultSpec:
+    """One injection rule: where (``site`` + ``match`` glob), when
+    (``skip``/``times`` invocation window, or seeded ``probability``),
+    and what (``error`` — an exception instance, class, or factory)."""
+
+    def __init__(
+        self,
+        site: str,
+        match: str = "*",
+        times: int = 1,
+        skip: int = 0,
+        probability: Optional[float] = None,
+        error: _ErrorLike = OSError,
+    ):
+        self.site = site
+        self.match = match
+        self.times = times
+        self.skip = skip
+        self.probability = probability
+        self._error = error
+        self._seen = 0
+        self._fired = 0
+
+    def make_error(self) -> BaseException:
+        if isinstance(self._error, BaseException):
+            return self._error
+        err = self._error()
+        if isinstance(err, BaseException):
+            return err
+        raise TypeError(  # pragma: no cover - plan authoring bug
+            f"fault error factory returned {err!r}"
+        )
+
+    def should_fire(self, rng: random.Random) -> bool:
+        """Advance this spec's invocation counter and decide. Caller holds
+        the plan lock."""
+        self._seen += 1
+        if self.probability is not None:
+            return rng.random() < self.probability
+        if self._seen <= self.skip:
+            return False
+        if self._fired >= self.times:
+            return False
+        self._fired += 1
+        return True
+
+
+class FaultPlan:
+    """A seeded, replayable set of :class:`FaultSpec` rules plus the
+    per-site counters that make recovery paths observable."""
+
+    def __init__(self, *specs: FaultSpec, seed: int = 0):
+        self.specs: List[FaultSpec] = list(specs)
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.counters: Dict[str, Dict[str, int]] = {}
+
+    def add(self, spec: FaultSpec) -> "FaultPlan":
+        with self._lock:
+            self.specs.append(spec)
+        return self
+
+    def _bump(self, key: str, counter: str, n: int = 1) -> None:
+        slot = self.counters.setdefault(
+            key,
+            {
+                "attempts": 0,
+                "injected": 0,
+                "retries": 0,
+                "recoveries": 0,
+                "degradations": 0,
+            },
+        )
+        slot[counter] += n
+
+    def check(self, site: str, key: str) -> None:
+        """Raise the planned error if any rule matches this invocation."""
+        with self._lock:
+            fired: Optional[FaultSpec] = None
+            matched = False
+            for spec in self.specs:
+                if spec.site != site or not fnmatch.fnmatchcase(
+                    key, spec.match
+                ):
+                    continue
+                matched = True
+                if fired is None and spec.should_fire(self._rng):
+                    fired = spec
+            if matched:
+                self._bump(f"{site}:{key}", "attempts")
+            if fired is not None:
+                self._bump(f"{site}:{key}", "injected")
+                err = fired.make_error()
+        if fired is not None:
+            raise err
+
+    # ---- recovery observability (reported by the retry executor) --------
+    def note_retry(self, site: str, key: str) -> None:
+        with self._lock:
+            self._bump(f"{site}:{key}", "retries")
+
+    def note_recovery(self, site: str, key: str) -> None:
+        with self._lock:
+            self._bump(f"{site}:{key}", "recoveries")
+
+    def note_degradation(self, site: str, key: str) -> None:
+        with self._lock:
+            self._bump(f"{site}:{key}", "degradations")
+
+    def total(self, counter: str) -> int:
+        with self._lock:
+            return sum(c.get(counter, 0) for c in self.counters.values())
+
+
+_ACTIVE: Optional[FaultPlan] = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _ACTIVE
+
+
+def fault_point(site: str, key: str) -> None:
+    """The hook embedded at injection sites. Free when no plan is active."""
+    plan = _ACTIVE
+    if plan is None:
+        return
+    plan.check(site, key)
+
+
+@contextmanager
+def inject_faults(plan: FaultPlan) -> Iterator[FaultPlan]:
+    """Arm ``plan`` process-wide for the duration of the block. Nesting is
+    rejected: overlapping plans would make the replay nondeterministic."""
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        if _ACTIVE is not None:
+            raise RuntimeError("a FaultPlan is already active")
+        _ACTIVE = plan
+    try:
+        yield plan
+    finally:
+        with _ACTIVE_LOCK:
+            _ACTIVE = None

@@ -47,18 +47,55 @@ def _is_hidden(tb: TracebackType, prefixes: List[str]) -> bool:
     return any(_match_module(module, p) for p in prefixes if p != "")
 
 
+def _package_dir(prefix: str) -> Optional[str]:
+    """The on-disk directory of the package named by a hide prefix
+    (``'fugue_tpu.'`` -> ``'/…/fugue_tpu/'``), or None if unimportable."""
+    import importlib
+    import os
+
+    try:
+        mod = importlib.import_module(prefix.rstrip("."))
+        f = getattr(mod, "__file__", None)
+        if f is None:
+            return None
+        return os.path.dirname(os.path.abspath(f)).replace("\\", "/") + "/"
+    except Exception:
+        return None
+
+
+def add_error_note(ex: BaseException, note: str) -> None:
+    """Attach a PEP-678 note to an exception, portably: ``add_note`` on
+    3.11+, a hand-rolled ``__notes__`` list on 3.10 (programmatically
+    identical — 3.10 tracebacks just don't render it, which is why the
+    aggregated WorkflowRuntimeError also embeds callsites in its
+    message)."""
+    try:
+        add = getattr(ex, "add_note", None)
+        if add is not None:
+            add(note)
+            return
+        notes = getattr(ex, "__notes__", None)
+        if not isinstance(notes, list):
+            notes = []
+            ex.__notes__ = notes  # type: ignore[attr-defined]
+        notes.append(note)
+    except Exception:  # pragma: no cover - never mask the original error
+        pass
+
+
 def extract_user_callsite(inject: int, hide_prefixes: List[str]) -> List[str]:
     """Capture the current stack's last ``inject`` user (non-framework)
     frames as display strings, for splicing into runtime errors."""
     if inject <= 0:
         return []
-    pkg_dirs = [
-        "/" + p.rstrip(".").replace(".", "/") + "/" for p in hide_prefixes if p
-    ]
+    # resolve each hidden package to its REAL directory — fragment
+    # matching ("/fugue_tpu/" in path) would also hide user code that
+    # merely lives under a same-named folder (tests/fugue_tpu/...)
+    pkg_dirs = [d for d in (_package_dir(p) for p in hide_prefixes if p) if d]
     frames: List[List[str]] = []  # each entry: [header, code?] of one frame
     for frame in reversed(traceback.extract_stack()[:-1]):
         fname = frame.filename.replace("\\", "/")
-        if any(d in fname for d in pkg_dirs) or "/fugue_tpu/" in fname:
+        if any(fname.startswith(d) for d in pkg_dirs):
             continue
         entry = [f'  File "{frame.filename}", line {frame.lineno}, in {frame.name}']
         if frame.line:

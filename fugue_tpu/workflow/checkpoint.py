@@ -36,6 +36,17 @@ class Checkpoint:
         recompute entirely (reference _checkpoint.py:67)."""
         return None
 
+    def artifact_uri(self, path: "CheckpointPath") -> Optional[str]:
+        """The PERMANENT artifact URI this checkpoint writes, or None when
+        it leaves nothing durable behind (null/weak/temp checkpoints).
+        The run manifest records it so a killed run can resume by loading
+        the artifact instead of recomputing."""
+        return None
+
+    @property
+    def fmt(self) -> str:
+        return "parquet"
+
 
 class WeakCheckpoint(Checkpoint):
     def __init__(self, lazy: bool = False, **kwargs: Any):
@@ -89,6 +100,15 @@ class StrongCheckpoint(Checkpoint):
             self._obj_id, self._namespace
         )
         return path.get_file_path(fid, self._fmt, permanent=self._permanent)
+
+    def artifact_uri(self, path: "CheckpointPath") -> Optional[str]:
+        if not (self._deterministic and self._permanent):
+            return None
+        return self._file_path(path)
+
+    @property
+    def fmt(self) -> str:
+        return self._fmt
 
     def try_load(self, path: "CheckpointPath") -> Optional[DataFrame]:
         if not self._deterministic:

@@ -1,0 +1,384 @@
+"""Fault-tolerance primitives for workflow execution.
+
+Three pieces:
+
+- :func:`classify_error` — the transient/oom/fatal triage that decides
+  whether a task failure is worth retrying. Spark retries every task
+  failure and relies on lineage; we are single-controller, so the
+  classifier is the line between "the storage/transport hiccuped, run it
+  again" and "the workflow is wrong, fail NOW with the original error".
+- :class:`RetryPolicy` — per-task retry/backoff/timeout knobs, built
+  from conf (``fugue.workflow.retry.*`` / ``fugue.workflow.timeout``)
+  and overridable per task via ``WorkflowDataFrame.fault_tolerant``.
+- :func:`execute_with_policy` — the attempt loop the workflow wraps
+  around every task: classify, degrade device-OOM onto the host tier
+  (jax engine) without consuming a retry, back off with jitter, honor
+  cooperative cancellation, and report retries/recoveries/degradations
+  to the active fault plan's counters.
+"""
+
+import random
+import threading
+import time
+from typing import Any, Callable, Optional, Tuple
+
+from fugue_tpu.constants import (
+    FUGUE_CONF_WORKFLOW_RETRY_BACKOFF,
+    FUGUE_CONF_WORKFLOW_RETRY_JITTER,
+    FUGUE_CONF_WORKFLOW_RETRY_MAX_ATTEMPTS,
+    FUGUE_CONF_WORKFLOW_TIMEOUT,
+)
+from fugue_tpu.exceptions import (
+    FugueError,
+    FugueWorkflowError,
+    TaskCancelledError,
+)
+from fugue_tpu.testing.faults import active_plan
+
+TRANSIENT = "transient"
+OOM = "oom"
+FATAL = "fatal"
+
+# exception class NAMES treated as transient: transport/storage errors
+# raised by backends we don't import (fsspec, gcsfs, requests, grpc) —
+# matching by name keeps the classifier dependency-free.
+_TRANSIENT_NAMES = (
+    "TimeoutError",
+    "ConnectTimeoutError",
+    "ReadTimeoutError",
+    "ServiceUnavailableError",
+    "TemporaryError",
+    "RemoteDisconnected",
+    "IncompleteRead",
+    "RetriableError",
+    "TransientError",
+)
+# status tokens in error text that mark a transient RPC/XLA transport
+# failure (grpc/absl status vocabulary)
+_TRANSIENT_TOKENS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED")
+_OOM_TOKENS = ("RESOURCE_EXHAUSTED", "out of memory", "Out of memory")
+
+
+def classify_error(ex: BaseException, retry_on: Tuple[type, ...] = ()) -> str:
+    """Triage an execution error.
+
+    - ``oom``: a device allocation failure (jax ``RESOURCE_EXHAUSTED``) —
+      eligible for host-tier degradation, then retry.
+    - ``transient``: fs/IO errors and RPC transport errors — retry with
+      backoff.
+    - ``fatal``: everything else — deterministic failures (schema &
+      validation errors, user code bugs) re-raise immediately; retrying
+      them only hides the first, best traceback.
+    """
+    if isinstance(ex, retry_on):
+        return TRANSIENT
+    name = type(ex).__name__
+    text = str(ex)
+    if isinstance(ex, MemoryError):
+        return OOM
+    if name == "XlaRuntimeError" or "jaxlib" in type(ex).__module__:
+        if any(t in text for t in _OOM_TOKENS):
+            return OOM
+    # framework errors are deliberate: never retry (validation, schema,
+    # compile problems are deterministic by construction)
+    if isinstance(ex, (FugueError, FugueWorkflowError)):
+        return FATAL
+    if isinstance(ex, (ConnectionError, BrokenPipeError, TimeoutError)):
+        return TRANSIENT
+    if isinstance(ex, OSError):
+        # a missing/denied path is deterministic; other OS errors (EIO,
+        # network filesystems, stale handles) are the storage hiccups
+        # this layer exists for
+        if isinstance(
+            ex,
+            (
+                FileNotFoundError,
+                FileExistsError,
+                IsADirectoryError,
+                NotADirectoryError,
+                PermissionError,
+            ),
+        ):
+            return FATAL
+        return TRANSIENT
+    if name in _TRANSIENT_NAMES:
+        return TRANSIENT
+    if any(t in text for t in _TRANSIENT_TOKENS):
+        # only trust status tokens on actual transport/status error types
+        # (grpc, jaxlib) — a plain RuntimeError("... ABORTED ...") from
+        # user code is deterministic and must NOT replay side effects
+        mod = type(ex).__module__
+        if (
+            name.endswith(("RpcError", "StatusError"))
+            or name == "XlaRuntimeError"
+            or "grpc" in mod
+            or "jaxlib" in mod
+        ):
+            return TRANSIENT
+    return FATAL
+
+
+class RetryPolicy:
+    """Immutable per-task fault policy. ``max_attempts`` counts the first
+    run (1 = no retry); ``backoff`` is the base exponential delay in
+    seconds, ``jitter`` a multiplicative random fraction on top;
+    ``timeout`` the per-task wall clock (0 = unlimited) enforced by the
+    parallel runner; ``retry_on`` extra exception types to treat as
+    transient for this task."""
+
+    __slots__ = ("max_attempts", "backoff", "jitter", "timeout", "retry_on")
+
+    def __init__(
+        self,
+        max_attempts: int = 1,
+        backoff: float = 0.1,
+        jitter: float = 0.1,
+        timeout: float = 0.0,
+        retry_on: Any = (),
+    ):
+        self.max_attempts = max(1, int(max_attempts))
+        self.backoff = max(0.0, float(backoff))
+        self.jitter = max(0.0, float(jitter))
+        self.timeout = max(0.0, float(timeout))
+        # accept a bare exception class as well as an iterable of them
+        self.retry_on = (
+            (retry_on,) if isinstance(retry_on, type) else tuple(retry_on)
+        )
+
+    @staticmethod
+    def from_conf(conf: Any) -> "RetryPolicy":
+        return RetryPolicy(
+            max_attempts=conf.get(FUGUE_CONF_WORKFLOW_RETRY_MAX_ATTEMPTS, 1),
+            backoff=conf.get(FUGUE_CONF_WORKFLOW_RETRY_BACKOFF, 0.1),
+            jitter=conf.get(FUGUE_CONF_WORKFLOW_RETRY_JITTER, 0.1),
+            timeout=conf.get(FUGUE_CONF_WORKFLOW_TIMEOUT, 0.0),
+        )
+
+    def override(
+        self,
+        max_attempts: Optional[int] = None,
+        backoff: Optional[float] = None,
+        jitter: Optional[float] = None,
+        timeout: Optional[float] = None,
+        retry_on: Any = None,
+    ) -> "RetryPolicy":
+        return RetryPolicy(
+            max_attempts=(
+                self.max_attempts if max_attempts is None else max_attempts
+            ),
+            backoff=self.backoff if backoff is None else backoff,
+            jitter=self.jitter if jitter is None else jitter,
+            timeout=self.timeout if timeout is None else timeout,
+            retry_on=self.retry_on if retry_on is None else retry_on,
+        )
+
+    def delay(self, attempt: int, rng: random.Random) -> float:
+        """Backoff before retry number ``attempt`` (1-based)."""
+        base = self.backoff * (2 ** (attempt - 1))
+        if self.jitter > 0:
+            base *= 1.0 + rng.random() * self.jitter
+        return base
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return (
+            f"RetryPolicy(max_attempts={self.max_attempts}, "
+            f"backoff={self.backoff}, jitter={self.jitter}, "
+            f"timeout={self.timeout})"
+        )
+
+
+class CancelToken:
+    """Cooperative cancellation: the runner sets it when a sibling fails
+    or times out; cancellation points (task launch, backoff sleeps, user
+    extensions via ``TaskContext``) observe it and abort early."""
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+
+    def cancel(self) -> None:
+        self._event.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._event.is_set()
+
+    def raise_if_cancelled(self) -> None:
+        if self._event.is_set():
+            raise TaskCancelledError("cancelled by a failing sibling task")
+
+    def wait(self, seconds: float) -> bool:
+        """Sleep up to ``seconds``; True if cancelled meanwhile."""
+        return self._event.wait(seconds)
+
+
+class RunStats:
+    """Per-run fault-tolerance observability, exposed on the workflow
+    result: retries/recoveries/degradations per task plus the tasks the
+    run manifest marked resumable (completed by a prior run with a
+    durable artifact still present at check time — the actual load is
+    served by the task's checkpoint short-circuit)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.retries: dict = {}
+        self.recoveries: dict = {}
+        self.degradations: dict = {}
+        self.resumed: list = []
+
+    def _bump(self, d: dict, key: str) -> None:
+        with self._lock:
+            d[key] = d.get(key, 0) + 1
+
+    def note_retry(self, name: str) -> None:
+        self._bump(self.retries, name)
+
+    def note_recovery(self, name: str) -> None:
+        self._bump(self.recoveries, name)
+
+    def note_degradation(self, name: str) -> None:
+        self._bump(self.degradations, name)
+
+    def note_resumed(self, name: str) -> None:
+        with self._lock:
+            self.resumed.append(name)
+
+    def as_dict(self) -> dict:
+        with self._lock:
+            return {
+                "retries": dict(self.retries),
+                "recoveries": dict(self.recoveries),
+                "degradations": dict(self.degradations),
+                "resumed": list(self.resumed),
+            }
+
+
+def _degrade_ctx(engine: Any) -> Optional[Any]:
+    """The engine's host-tier degradation context, or None when the
+    engine has no cheaper tier to fall back to."""
+    if engine is None or not getattr(engine, "supports_host_degrade", False):
+        return None
+    return engine.degraded_to_host()
+
+
+def execute_with_policy(
+    fn: Callable[[], Any],
+    policy: RetryPolicy,
+    *,
+    engine: Any = None,
+    token: Optional[CancelToken] = None,
+    task_name: str = "",
+    stats: Optional[RunStats] = None,
+    log: Any = None,
+) -> Any:
+    """Run ``fn`` under ``policy``: transient errors retry with
+    exponential backoff + jitter; a device-OOM first re-runs on the
+    engine's host tier WITHOUT consuming a retry (capacity degradation is
+    not a transient fault — the same attempt deserves a cheaper venue);
+    fatal errors and exhausted budgets re-raise the original error."""
+    rng = random.Random()
+    attempt = 0
+    while True:
+        attempt += 1
+        if token is not None:
+            token.raise_if_cancelled()
+        try:
+            result = fn()
+            if attempt > 1:
+                plan = active_plan()
+                if plan is not None:
+                    plan.note_recovery("task", task_name)
+                if stats is not None:
+                    stats.note_recovery(task_name)
+            return result
+        except TaskCancelledError:
+            raise
+        except Exception as ex:
+            cls = classify_error(ex, policy.retry_on)
+            if cls == OOM:
+                degraded = _try_degrade(
+                    fn, engine, token, task_name, stats, log, ex
+                )
+                if degraded is not None:
+                    return degraded[0]
+                # degradation unsupported or failed: treat as transient
+                cls = TRANSIENT
+            if cls == FATAL or attempt >= policy.max_attempts:
+                raise
+            plan = active_plan()
+            if plan is not None:
+                plan.note_retry("task", task_name)
+            if stats is not None:
+                stats.note_retry(task_name)
+            if log is not None:
+                log.info(
+                    "fugue_tpu retry %d/%d of task %s after %s: %s",
+                    attempt,
+                    policy.max_attempts,
+                    task_name,
+                    type(ex).__name__,
+                    ex,
+                )
+            delay = policy.delay(attempt, rng)
+            if token is not None:
+                if token.wait(delay):
+                    token.raise_if_cancelled()
+            elif delay > 0:
+                time.sleep(delay)
+
+
+def _try_degrade(
+    fn: Callable[[], Any],
+    engine: Any,
+    token: Optional[CancelToken],
+    task_name: str,
+    stats: Optional[RunStats],
+    log: Any,
+    cause: BaseException,
+) -> Optional[Tuple[Any]]:
+    """One host-tier re-run after a device OOM. Returns a 1-tuple with
+    the result on success (so a None result is distinguishable), or None
+    when the engine can't degrade or the degraded run failed too."""
+    ctx = _degrade_ctx(engine)
+    if ctx is None:
+        return None
+    if token is not None:
+        token.raise_if_cancelled()
+    if log is not None:
+        log.warning(
+            "fugue_tpu task %s hit device OOM (%s); degrading to host tier",
+            task_name,
+            cause,
+        )
+    try:
+        with ctx:
+            result = fn()
+    except TaskCancelledError:
+        raise
+    except Exception as degraded_ex:
+        # the host-tier run failed DIFFERENTLY: surface it — the caller
+        # re-raises the original OOM and this may be the real bug
+        if log is not None:
+            log.warning(
+                "fugue_tpu host-tier degraded run of task %s failed with "
+                "%s: %s (original device error: %s)",
+                task_name,
+                type(degraded_ex).__name__,
+                degraded_ex,
+                cause,
+            )
+        from fugue_tpu.utils.exception import add_error_note
+
+        add_error_note(
+            cause,
+            "host-tier degraded re-run also failed: "
+            f"{type(degraded_ex).__name__}: {degraded_ex}",
+        )
+        return None
+    plan = active_plan()
+    if plan is not None:
+        plan.note_degradation("task", task_name)
+    if stats is not None:
+        stats.note_degradation(task_name)
+    if hasattr(engine, "_count_fallback"):
+        engine._count_fallback("oom_degrade", task_name)
+    return (result,)

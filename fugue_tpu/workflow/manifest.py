@@ -1,0 +1,142 @@
+"""Run manifest: checkpoint-backed resume for killed/failed workflows.
+
+With ``fugue.workflow.resume`` enabled (and a checkpoint path set), every
+task completion atomically rewrites a small JSON manifest under the
+checkpoint dir, keyed by the workflow's deterministic uuid::
+
+    <checkpoint.path>/manifest_<workflow_uuid>.json
+    {"workflow": "...", "completed": {task_uuid: {name, artifact, fmt}}}
+
+The manifest is crash-durable — a run killed mid-flight leaves it behind.
+Re-running the IDENTICAL DAG (same workflow uuid — the task-uuid
+determinism backbone guarantees identical specs hash identically)
+consults it before executing each task: a completed task whose artifact
+URI still exists short-circuits (the artifact is served by the task's
+own deterministic-checkpoint ``try_load`` through ``engine.fs``), so
+execution restarts at the frontier. Artifacts exist for
+deterministically-checkpointed tasks (their files are permanent);
+completed tasks without a durable artifact are recorded for
+observability but re-execute. A fully successful run deletes its
+manifest — resume state never outlives the failure it serves.
+"""
+
+import json
+import threading
+from typing import Any, Dict, Optional
+
+from fugue_tpu.constants import (
+    FUGUE_CONF_WORKFLOW_CHECKPOINT_PATH,
+    FUGUE_CONF_WORKFLOW_RESUME,
+)
+
+
+class RunManifest:
+    """Tracks completed task uuids + artifact URIs for one workflow run."""
+
+    def __init__(self, engine: Any, checkpoint_path: Any, workflow_uuid: str):
+        self._engine = engine
+        self._ckpt = checkpoint_path
+        self._wf_uuid = workflow_uuid
+        self._lock = threading.Lock()
+        self._completed: Dict[str, Dict[str, Any]] = {}
+        self._resumable: Dict[str, Dict[str, Any]] = {}
+
+    @staticmethod
+    def from_conf(
+        engine: Any, checkpoint_path: Any, workflow_uuid: str
+    ) -> Optional["RunManifest"]:
+        """Build the manifest manager when resume is on and a durable
+        checkpoint dir exists to hold it; None otherwise."""
+        if not engine.conf.get(FUGUE_CONF_WORKFLOW_RESUME, False):
+            return None
+        base = str(
+            engine.conf.get(FUGUE_CONF_WORKFLOW_CHECKPOINT_PATH, "")
+        ).strip()
+        if base == "":
+            return None
+        m = RunManifest(engine, checkpoint_path, workflow_uuid)
+        m.load()
+        return m
+
+    @property
+    def uri(self) -> str:
+        base = str(
+            self._engine.conf.get(FUGUE_CONF_WORKFLOW_CHECKPOINT_PATH, "")
+        ).strip()
+        return self._engine.fs.join(base, f"manifest_{self._wf_uuid}.json")
+
+    @property
+    def completed(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return dict(self._completed)
+
+    def load(self) -> None:
+        """Read a prior (killed/failed) run's manifest; its completed set
+        becomes this run's resume candidates."""
+        fs = self._engine.fs
+        uri = self.uri
+        try:
+            if not fs.exists(uri):
+                return
+            data = json.loads(fs.read_bytes(uri).decode("utf-8"))
+        except Exception:  # unreadable manifest: resume is best-effort
+            self._engine.log.warning(
+                "fugue_tpu resume: manifest %s unreadable; ignoring", uri
+            )
+            return
+        if data.get("workflow") != self._wf_uuid:  # pragma: no cover
+            return
+        self._resumable = dict(data.get("completed", {}))
+
+    def can_resume(self, task: Any, ctx: Any) -> bool:
+        """True when the prior run completed this task AND its durable
+        artifact still exists. The caller then runs the task's NORMAL
+        execute path — validation rules still fire (they are workflow
+        declarations, not data checks — see ProcessTask.execute) and the
+        deterministic checkpoint's ``try_load`` serves the artifact, so
+        resume adds no second load path to keep consistent."""
+        rec = self._resumable.get(task.__uuid__())
+        if rec is None:
+            return False
+        uri = rec.get("artifact")
+        if not uri:
+            return False
+        try:
+            return bool(ctx.engine.fs.exists(uri))
+        except Exception:  # pragma: no cover - fs probe failure
+            return False
+
+    def mark_complete(self, task: Any) -> None:
+        """Record a finished task and atomically rewrite the manifest —
+        the incremental write is what makes resume survive a hard kill,
+        not just a graceful failure."""
+        ckpt = task.checkpoint
+        rec = {
+            "name": task.name,
+            "artifact": ckpt.artifact_uri(self._ckpt),
+            "fmt": ckpt.fmt,
+        }
+        with self._lock:
+            # write under the lock: concurrent completions must not land
+            # an older snapshot LAST and drop a finished task from the
+            # manifest a resume will trust
+            self._completed[task.__uuid__()] = rec
+            payload = json.dumps(
+                {"workflow": self._wf_uuid, "completed": self._completed},
+                indent=1,
+            ).encode("utf-8")
+            try:
+                self._engine.fs.write_file_atomic(
+                    self.uri, lambda fp: fp.write(payload)
+                )
+            except Exception:  # pragma: no cover - manifest is best-effort
+                self._engine.log.warning(
+                    "fugue_tpu resume: failed writing manifest %s", self.uri
+                )
+
+    def finish(self) -> None:
+        """Successful run: the manifest has served its purpose."""
+        try:
+            self._engine.fs.rm(self.uri)
+        except Exception:  # pragma: no cover - best effort
+            pass

@@ -1,20 +1,69 @@
 """Lightweight parallel DAG runner — replaces the reference's external
 `adagio` dependency (SURVEY §7 step 6: "own lightweight parallel DAG
 runner"). Topological execution with bounded concurrency; independent tasks
-run concurrently when ``fugue.workflow.concurrency > 1``."""
+run concurrently when ``fugue.workflow.concurrency > 1``.
 
-from concurrent.futures import FIRST_COMPLETED, Future, ThreadPoolExecutor, wait
+Fault semantics (the production contract):
+
+- A task failure stops LAUNCHING but the runner drains: every in-flight
+  sibling is awaited (their results/side effects stay consistent) and
+  every failure is collected — a single failure re-raises the original
+  exception unchanged (compat with ``raises(UserError)`` call sites),
+  two or more raise one structured
+  :class:`~fugue_tpu.exceptions.WorkflowRuntimeError` listing every
+  failed task with its name and user callsite.
+- A per-task wall-clock ``timeout`` (node field, fed from
+  ``fugue.workflow.timeout``/per-task policy) is enforced by the
+  parallel runner and covers EXECUTION time (queue wait is free): an
+  expired task is abandoned (recorded as
+  :class:`~fugue_tpu.exceptions.TaskTimeoutError`), never awaited in
+  the drain. Workers are bounded DAEMON threads (not a
+  ThreadPoolExecutor, whose non-daemon workers would be joined at
+  interpreter shutdown) so a wedged call in a TIMED task can't hang
+  the workflow or process exit; a wedged task WITHOUT a timeout is
+  awaited indefinitely by the drain (no budget means no abandonment),
+  and the serial runner cannot preempt at all (it warns when timeouts
+  are configured with concurrency <= 1).
+- On any failure/timeout the shared :class:`CancelToken` is set;
+  launched-but-unstarted siblings abort at their first cancellation
+  point and are NOT recorded as failures (they didn't fail — they were
+  cancelled).
+"""
+
+import threading
+import time
+from concurrent.futures import FIRST_COMPLETED, Future, wait
 from typing import Any, Callable, Dict, List, Optional, Set
 
+from fugue_tpu.exceptions import (
+    TaskCancelledError,
+    TaskFailure,
+    TaskTimeoutError,
+    WorkflowRuntimeError,
+)
 from fugue_tpu.utils.assertion import assert_or_throw
+from fugue_tpu.workflow.fault import CancelToken
 
 
 class TaskNode:
-    def __init__(self, task_id: str, func: Callable[[List[Any]], Any],
-                 dependencies: List[str]):
+    def __init__(
+        self,
+        task_id: str,
+        func: Callable[[List[Any]], Any],
+        dependencies: List[str],
+        name: Optional[str] = None,
+        callsite: Optional[List[str]] = None,
+        timeout: float = 0.0,
+    ):
         self.task_id = task_id
         self.func = func
         self.dependencies = dependencies
+        self.name = name or task_id
+        self.callsite = list(callsite or [])
+        self.timeout = max(0.0, float(timeout))
+        # stamped by the worker thread when execution actually BEGINS:
+        # the wall-clock budget covers run time, not launch-queue wait
+        self.started_at: Optional[float] = None
 
 
 class DAGRunner:
@@ -23,17 +72,49 @@ class DAGRunner:
     def __init__(self, concurrency: int = 1):
         self._concurrency = max(1, concurrency)
 
-    def run(self, nodes: List[TaskNode]) -> Dict[str, Any]:
+    def run(
+        self,
+        nodes: List[TaskNode],
+        on_complete: Optional[Callable[[TaskNode], None]] = None,
+        cancel_token: Optional[CancelToken] = None,
+    ) -> Dict[str, Any]:
         by_id = {n.task_id: n for n in nodes}
         for n in nodes:
             for d in n.dependencies:
                 assert_or_throw(d in by_id, ValueError(f"unknown dependency {d}"))
+            n.started_at = None  # nodes may be reused across runs
         results: Dict[str, Any] = {}
+        token = cancel_token if cancel_token is not None else CancelToken()
         if self._concurrency <= 1:
+            if any(n.timeout > 0 for n in nodes):
+                import logging
+
+                logging.getLogger("fugue_tpu").warning(
+                    "task timeouts are configured but "
+                    "fugue.workflow.concurrency <= 1: the serial runner "
+                    "cannot preempt a task — timeouts will NOT be enforced"
+                )
             for n in self._topological(nodes):
-                results[n.task_id] = n.func([results[d] for d in n.dependencies])
+                token.raise_if_cancelled()
+                try:
+                    results[n.task_id] = n.func(
+                        [results[d] for d in n.dependencies]
+                    )
+                except BaseException:
+                    token.cancel()
+                    raise
+                self._notify(on_complete, n)
             return results
-        return self._run_parallel(nodes, results)
+        return self._run_parallel(nodes, results, on_complete, token)
+
+    def _notify(
+        self, on_complete: Optional[Callable[[TaskNode], None]], node: TaskNode
+    ) -> None:
+        if on_complete is not None:
+            try:
+                on_complete(node)
+            except Exception:  # manifest write is best-effort observability
+                pass
 
     def _topological(self, nodes: List[TaskNode]) -> List[TaskNode]:
         done: Set[str] = set()
@@ -54,38 +135,139 @@ class DAGRunner:
         return ordered
 
     def _run_parallel(
-        self, nodes: List[TaskNode], results: Dict[str, Any]
+        self,
+        nodes: List[TaskNode],
+        results: Dict[str, Any],
+        on_complete: Optional[Callable[[TaskNode], None]],
+        token: CancelToken,
     ) -> Dict[str, Any]:
         pending = {n.task_id: n for n in nodes}
-        running: Dict[Future, str] = {}
-        first_error: List[BaseException] = []
-        with ThreadPoolExecutor(max_workers=self._concurrency) as pool:
-            while (pending or running) and not first_error:
-                # launch all ready tasks
-                ready = [
-                    n for n in pending.values()
-                    if all(d in results for d in n.dependencies)
-                ]
-                for n in ready:
-                    del pending[n.task_id]
-                    deps = [results[d] for d in n.dependencies]
-                    running[pool.submit(n.func, deps)] = n.task_id
+        running: Dict[Future, TaskNode] = {}
+        failures: List[TaskFailure] = []
+        while running or (pending and not failures):
+            if not failures:
+                # bounded concurrency: launch ready tasks into free slots
+                # only (each task gets its own daemon worker thread)
+                free = self._concurrency - len(running)
+                if free > 0:
+                    ready = [
+                        n for n in pending.values()
+                        if all(d in results for d in n.dependencies)
+                    ][:free]
+                    for n in ready:
+                        del pending[n.task_id]
+                        deps = [results[d] for d in n.dependencies]
+                        running[self._spawn(n, deps, token, on_complete)] = n
                 if not running:
                     assert_or_throw(
-                        not pending, ValueError("cycle detected in workflow DAG")
+                        not pending,
+                        ValueError("cycle detected in workflow DAG"),
                     )
                     break
-                finished, _ = wait(list(running.keys()), return_when=FIRST_COMPLETED)
-                for f in finished:
-                    tid = running.pop(f)
-                    err = f.exception()
-                    if err is not None:
-                        first_error.append(err)
-                    else:
-                        results[tid] = f.result()
-            # drain remaining futures on error
-            for f in list(running.keys()):
-                f.cancel()
-        if first_error:
-            raise first_error[0]
+            if not running:
+                break
+            finished, _ = wait(
+                list(running.keys()),
+                timeout=self._next_wait(running.values()),
+                return_when=FIRST_COMPLETED,
+            )
+            for f in finished:
+                n = running.pop(f)
+                err = f.exception()
+                if err is None:
+                    results[n.task_id] = f.result()
+                elif isinstance(err, TaskCancelledError):
+                    pass  # cancelled, not failed
+                else:
+                    failures.append(
+                        TaskFailure(n.task_id, n.name, err, n.callsite)
+                    )
+                    token.cancel()
+            # expire tasks whose EXECUTION exceeded their budget: record
+            # the timeout, abandon the future (its daemon thread can't be
+            # killed, but it can't wedge the drain or interpreter exit
+            # either), cancel siblings. A future that completed while the
+            # supervisor was busy is NOT expired — it's harvested on the
+            # next wait round.
+            now = time.monotonic()
+            for f, n in [
+                (f, n)
+                for f, n in running.items()
+                if n.timeout > 0
+                and not f.done()
+                and n.started_at is not None
+                and now - n.started_at >= n.timeout
+            ]:
+                del running[f]
+                failures.append(
+                    TaskFailure(
+                        n.task_id,
+                        n.name,
+                        TaskTimeoutError(n.name, n.timeout),
+                        n.callsite,
+                    )
+                )
+                token.cancel()
+        if failures:
+            if len(failures) == 1:
+                raise failures[0].error
+            raise WorkflowRuntimeError(failures)
         return results
+
+    def _spawn(
+        self,
+        node: TaskNode,
+        deps: List[Any],
+        token: CancelToken,
+        on_complete: Optional[Callable[[TaskNode], None]],
+    ) -> Future:
+        """One bounded worker: a DAEMON thread resolving a Future. The
+        completion callback (manifest write — possibly remote fs I/O)
+        runs HERE, not on the supervisor thread, so it can't stall task
+        launch or timeout enforcement; it finishes before the future
+        resolves, so downstream tasks only launch after the manifest
+        already records their dependency."""
+        f: Future = Future()
+
+        def work() -> None:
+            if not f.set_running_or_notify_cancel():  # pragma: no cover
+                return
+            try:
+                # first cancellation point: a task launched just before a
+                # sibling failed aborts here instead of doing work the
+                # run will discard
+                token.raise_if_cancelled()
+                node.started_at = time.monotonic()
+                result = node.func(deps)
+            except BaseException as ex:
+                f.set_exception(ex)
+                return
+            # stop the wall clock BEFORE the completion callback: a slow
+            # manifest write (remote fs) must not expire a task whose
+            # work already succeeded
+            node.started_at = None
+            self._notify(on_complete, node)
+            f.set_result(result)
+
+        threading.Thread(
+            target=work, daemon=True, name=f"fugue-task-{node.task_id}"
+        ).start()
+        return f
+
+    @staticmethod
+    def _next_wait(running: Any) -> Optional[float]:
+        """How long the supervisor may block: until the nearest deadline
+        of a STARTED timed task, or a short poll while a timed task has
+        not stamped its start yet (its clock begins at execution)."""
+        now = time.monotonic()
+        wait_for: Optional[float] = None
+        for n in running:
+            if n.timeout <= 0:
+                continue
+            remaining = (
+                0.05 if n.started_at is None
+                else max(0.0, n.started_at + n.timeout - now)
+            )
+            if wait_for is None or remaining < wait_for:
+                wait_for = remaining
+        return wait_for

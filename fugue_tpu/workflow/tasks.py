@@ -57,6 +57,12 @@ class FugueTask:
         self.yields: List[Yielded] = []
         self.yield_as_local = False
         self.callsite: List[str] = []
+        # per-task fault-policy override kwargs (max_attempts/backoff/
+        # jitter/timeout/retry_on), resolved against the conf-level
+        # RetryPolicy at run time. Execution-only: NOT part of the task
+        # uuid (retry settings must not invalidate deterministic
+        # checkpoints, same as checkpoint config itself).
+        self.fault_override: Optional[Dict[str, Any]] = None
         self._uuid: Optional[str] = None
 
     def __uuid__(self) -> str:
@@ -85,7 +91,18 @@ class FugueTask:
 
     @property
     def name(self) -> str:
-        return f"{type(self.extension).__name__}_{self.__uuid__()[:8]}"
+        # the extension is usually a CLASS (builtins) — use its own name,
+        # not "type"; instances/functions fall back to their type/name.
+        # This display name keys error reports and fault-injection task
+        # sites ("task", "RunTransformer*"), so it must be meaningful.
+        ext = self.extension
+        if isinstance(ext, type):
+            base = ext.__name__
+        elif callable(ext) and hasattr(ext, "__name__"):
+            base = ext.__name__
+        else:
+            base = type(ext).__name__
+        return f"{base}_{self.__uuid__()[:8]}"
 
     def execute(self, ctx: "TaskContext", inputs: List[DataFrame]) -> Any:
         raise NotImplementedError  # pragma: no cover
@@ -122,10 +139,20 @@ class FugueTask:
 
 
 class TaskContext:
-    def __init__(self, engine: Any, rpc_server: Any, checkpoint_path: Any):
+    def __init__(
+        self,
+        engine: Any,
+        rpc_server: Any,
+        checkpoint_path: Any,
+        cancel_token: Any = None,
+    ):
         self.engine = engine
         self.rpc_server = rpc_server
         self.checkpoint_path = checkpoint_path
+        # cooperative cancellation: long-running extensions may poll
+        # ctx.cancel_token.cancelled / raise_if_cancelled() to stop early
+        # when a sibling task failed or timed out
+        self.cancel_token = cancel_token
 
 
 class CreateTask(FugueTask):

@@ -52,7 +52,11 @@ from fugue_tpu.extensions.builtins import (
 from fugue_tpu.rpc import make_rpc_server, to_rpc_handler
 from fugue_tpu.schema import Schema
 from fugue_tpu.utils.assertion import assert_or_throw
-from fugue_tpu.utils.exception import extract_user_callsite, prune_traceback
+from fugue_tpu.utils.exception import (
+    add_error_note,
+    extract_user_callsite,
+    prune_traceback,
+)
 from fugue_tpu.utils.hash import to_uuid
 from fugue_tpu.utils.params import ParamDict
 from fugue_tpu.workflow.checkpoint import (
@@ -62,6 +66,13 @@ from fugue_tpu.workflow.checkpoint import (
     TableCheckpoint,
     WeakCheckpoint,
 )
+from fugue_tpu.workflow.fault import (
+    CancelToken,
+    RetryPolicy,
+    RunStats,
+    execute_with_policy,
+)
+from fugue_tpu.workflow.manifest import RunManifest
 from fugue_tpu.workflow.runner import DAGRunner, TaskNode
 from fugue_tpu.workflow.tasks import (
     CreateTask,
@@ -357,6 +368,37 @@ class WorkflowDataFrame:
 
     def broadcast(self) -> "WorkflowDataFrame":
         self._task.broadcast_result = True
+        return self
+
+    # ---- fault tolerance -------------------------------------------------
+    def fault_tolerant(
+        self,
+        max_attempts: Optional[int] = None,
+        backoff: Optional[float] = None,
+        jitter: Optional[float] = None,
+        timeout: Optional[float] = None,
+        retry_on: Any = None,
+    ) -> "WorkflowDataFrame":
+        """Per-task override of the workflow fault policy
+        (``fugue.workflow.retry.*`` / ``fugue.workflow.timeout``):
+        retry the task producing THIS dataframe up to ``max_attempts``
+        times on transient errors with exponential ``backoff`` (+
+        ``jitter``), abandon it after ``timeout`` seconds of wall clock
+        (parallel runner), and additionally treat the ``retry_on``
+        exception types as transient."""
+        if isinstance(retry_on, type):  # a bare exception class is fine
+            retry_on = (retry_on,)
+        ov = dict(self._task.fault_override or {})
+        for k, v in (
+            ("max_attempts", max_attempts),
+            ("backoff", backoff),
+            ("jitter", jitter),
+            ("timeout", timeout),
+            ("retry_on", None if retry_on is None else tuple(retry_on)),
+        ):
+            if v is not None:
+                ov[k] = v
+        self._task.fault_override = ov
         return self
 
     # ---- yields ----------------------------------------------------------
@@ -696,7 +738,13 @@ class FugueWorkflow:
         execution_id = str(uuid4())
         rpc_server = make_rpc_server(e.conf)
         checkpoint_path = CheckpointPath(e)
-        ctx = TaskContext(e, rpc_server, checkpoint_path)
+        token = CancelToken()
+        stats = RunStats()
+        ctx = TaskContext(e, rpc_server, checkpoint_path, cancel_token=token)
+        base_policy = RetryPolicy.from_conf(e.conf)
+        # checkpoint-backed resume: None unless fugue.workflow.resume is on
+        # AND a durable checkpoint dir exists to hold the run manifest
+        manifest = RunManifest.from_conf(e, checkpoint_path, self.__uuid__())
         started_rpc = in_ctx = False
         try:
             rpc_server.start()
@@ -708,17 +756,33 @@ class FugueWorkflow:
             nodes = [
                 TaskNode(
                     t.__uuid__() + f"_{i}",
-                    self._make_task_func(t, ctx),
+                    self._make_task_func(
+                        t, ctx, base_policy, token, manifest, stats
+                    ),
                     [
                         inp.__uuid__() + f"_{index_of[id(inp)]}"
                         for inp in t.inputs
                     ],
+                    name=t.name,
+                    callsite=t.callsite,
+                    timeout=self._task_policy(t, base_policy).timeout,
                 )
                 for i, t in enumerate(self._tasks)
             ]
+            on_complete = None
+            if manifest is not None:
+                by_node_id = {
+                    t.__uuid__() + f"_{i}": t
+                    for i, t in enumerate(self._tasks)
+                }
+                on_complete = lambda node: manifest.mark_complete(  # noqa: E731
+                    by_node_id[node.task_id]
+                )
             concurrency = e.conf.get(FUGUE_CONF_WORKFLOW_CONCURRENCY, 1)
             try:
-                DAGRunner(concurrency).run(nodes)
+                DAGRunner(concurrency).run(
+                    nodes, on_complete=on_complete, cancel_token=token
+                )
             except Exception as ex:
                 # prune at the outermost point: frames added during
                 # propagation through the runner are framework noise too
@@ -730,34 +794,79 @@ class FugueWorkflow:
                         "concurrent.futures.",
                         "threading",
                     ]
+                    # ``from ex.__cause__`` (not ``from None``): both
+                    # suppress the re-raise context, but this one keeps
+                    # the cause an aggregated WorkflowRuntimeError chains
+                    # to its first failure
                     raise ex.with_traceback(
                         prune_traceback(ex.__traceback__, hide)
-                    ) from None
+                    ) from ex.__cause__
                 raise
             self._computed = True
+            if manifest is not None:
+                manifest.finish()
         finally:
             if in_ctx:
                 e.stop_context()
             checkpoint_path.remove_temp_path()
             if started_rpc:
                 rpc_server.stop()
-        return FugueWorkflowResult(self._yields)
+        return FugueWorkflowResult(self._yields, stats=stats)
 
-    def _make_task_func(self, task: FugueTask, ctx: TaskContext) -> Callable:
+    def _task_policy(self, task: FugueTask, base: RetryPolicy) -> RetryPolicy:
+        if not task.fault_override:
+            return base
+        return base.override(**task.fault_override)
+
+    def _make_task_func(
+        self,
+        task: FugueTask,
+        ctx: TaskContext,
+        base_policy: RetryPolicy,
+        token: CancelToken,
+        manifest: Optional[RunManifest],
+        stats: RunStats,
+    ) -> Callable:
+        policy = self._task_policy(task, base_policy)
+
+        def attempt(inputs: List[Any]) -> Any:
+            # fault-injection site INSIDE the attempt loop: "task" faults
+            # fire per attempt, so nth-invocation plans exercise retries
+            from fugue_tpu.testing.faults import fault_point
+
+            fault_point("task", task.name)
+            return task.execute(ctx, inputs)
+
         def run_task(inputs: List[Any]) -> Any:
             try:
-                return task.execute(ctx, inputs)
+                # manifest resume is OBSERVED here but served by the
+                # task's own checkpoint short-circuit inside execute():
+                # validations still fire and there is only one load path
+                if manifest is not None and manifest.can_resume(task, ctx):
+                    stats.note_resumed(task.name)
+                return execute_with_policy(
+                    lambda: attempt(inputs),
+                    policy,
+                    engine=ctx.engine,
+                    token=token,
+                    task_name=task.name,
+                    stats=stats,
+                    log=ctx.engine.log,
+                )
             except Exception as ex:
                 self._reraise_with_callsite(task, ex)
 
         return run_task
 
     def _reraise_with_callsite(self, task: FugueTask, ex: Exception) -> None:
+        """Attach the failing task's name and the USER's workflow callsite
+        to the error, so a failing transform points at the line that
+        defined it rather than runner internals (notes survive retry
+        wrapping, pruning and aggregation)."""
+        note = f"in task {task.name}"
         if task.callsite:
-            try:
-                ex.add_note("defined at:\n" + "\n".join(task.callsite))
-            except Exception:  # pragma: no cover
-                pass
+            note += ", defined at:\n" + "\n".join(task.callsite)
+        add_error_note(ex, note)
         raise ex
 
     def __enter__(self) -> "FugueWorkflow":
@@ -779,14 +888,21 @@ class FugueWorkflow:
 
 
 class FugueWorkflowResult:
-    """Run result: access yielded dataframes (reference workflow.py:1609)."""
+    """Run result: access yielded dataframes (reference workflow.py:1609)
+    plus the run's fault-tolerance stats (retries/recoveries/degradations
+    per task and manifest-resumed tasks)."""
 
-    def __init__(self, yields: Dict[str, Yielded]):
+    def __init__(self, yields: Dict[str, Yielded], stats: Any = None):
         self._yields = yields
+        self._stats = stats
 
     @property
     def yields(self) -> Dict[str, Yielded]:
         return self._yields
+
+    @property
+    def fault_stats(self) -> Dict[str, Any]:
+        return self._stats.as_dict() if self._stats is not None else {}
 
     def __getitem__(self, name: str) -> Any:
         y = self._yields[name]

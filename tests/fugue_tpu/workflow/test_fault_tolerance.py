@@ -1,0 +1,618 @@
+"""Fault-tolerant workflow execution under the deterministic
+fault-injection harness: retry/backoff on transient faults, host-tier
+degradation on device OOM, checkpoint-backed resume from the run
+manifest, and aggregated structured failures. Tier-1 compatible (runs
+under ``-m 'not slow'``); also selectable via ``-m faults``."""
+
+import threading
+import time
+from typing import Callable, List
+
+import pandas as pd
+import pytest
+
+from fugue_tpu.constants import (
+    FUGUE_CONF_WORKFLOW_CHECKPOINT_PATH,
+    FUGUE_CONF_WORKFLOW_CONCURRENCY,
+    FUGUE_CONF_WORKFLOW_RESUME,
+    FUGUE_CONF_WORKFLOW_RETRY_BACKOFF,
+    FUGUE_CONF_WORKFLOW_RETRY_JITTER,
+    FUGUE_CONF_WORKFLOW_RETRY_MAX_ATTEMPTS,
+    FUGUE_CONF_WORKFLOW_TIMEOUT,
+)
+from fugue_tpu.exceptions import (
+    TaskCancelledError,
+    TaskTimeoutError,
+    WorkflowRuntimeError,
+)
+from fugue_tpu.execution import make_execution_engine
+from fugue_tpu.testing.faults import FaultPlan, FaultSpec, inject_faults
+from fugue_tpu.workflow import FugueWorkflow
+from fugue_tpu.workflow.fault import (
+    FATAL,
+    OOM,
+    TRANSIENT,
+    CancelToken,
+    RetryPolicy,
+    classify_error,
+    execute_with_policy,
+)
+
+pytestmark = pytest.mark.faults
+
+_FAST_RETRY = {
+    FUGUE_CONF_WORKFLOW_RETRY_MAX_ATTEMPTS: 3,
+    FUGUE_CONF_WORKFLOW_RETRY_BACKOFF: 0.01,
+    FUGUE_CONF_WORKFLOW_RETRY_JITTER: 0.0,
+}
+
+
+class FakeXlaRuntimeError(Exception):
+    pass
+
+
+FakeXlaRuntimeError.__name__ = "XlaRuntimeError"
+
+
+# ---------------------------------------------------------------------------
+# error classifier
+# ---------------------------------------------------------------------------
+def test_classifier_triage():
+    assert classify_error(OSError("EIO: device hiccup")) == TRANSIENT
+    assert classify_error(ConnectionError("reset by peer")) == TRANSIENT
+    assert classify_error(TimeoutError("rpc deadline")) == TRANSIENT
+    # deterministic failures fail fast
+    assert classify_error(FileNotFoundError("gone")) == FATAL
+    assert classify_error(PermissionError("denied")) == FATAL
+    assert classify_error(ValueError("bad schema")) == FATAL
+    assert classify_error(TypeError("bad arg")) == FATAL
+    from fugue_tpu.exceptions import FugueWorkflowRuntimeValidationError
+
+    assert classify_error(FugueWorkflowRuntimeValidationError("v")) == FATAL
+    # jax device allocation failure
+    assert (
+        classify_error(FakeXlaRuntimeError("RESOURCE_EXHAUSTED: 1.2G"))
+        == OOM
+    )
+    # a bare host MemoryError is an OOM even with an empty message
+    assert classify_error(MemoryError()) == OOM
+    # status tokens only count on transport/status error TYPES — a user
+    # RuntimeError mentioning ABORTED is deterministic
+    assert classify_error(RuntimeError("job ABORTED: bad config")) == FATAL
+    assert (
+        classify_error(FakeXlaRuntimeError("UNAVAILABLE: socket closed"))
+        == TRANSIENT
+    )
+    # per-task opt-in classes (tuple or bare class via RetryPolicy)
+    assert classify_error(RuntimeError("x")) == FATAL
+    assert classify_error(RuntimeError("x"), (RuntimeError,)) == TRANSIENT
+    assert RetryPolicy(retry_on=RuntimeError).retry_on == (RuntimeError,)
+
+
+def test_retry_policy_from_conf_and_override():
+    e = make_execution_engine("native", dict(_FAST_RETRY))
+    p = RetryPolicy.from_conf(e.conf)
+    assert p.max_attempts == 3 and p.backoff == 0.01 and p.jitter == 0.0
+    q = p.override(max_attempts=5, timeout=1.5)
+    assert q.max_attempts == 5 and q.timeout == 1.5 and q.backoff == 0.01
+
+
+def test_execute_with_policy_retries_transient_and_fails_fast():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    p = RetryPolicy(max_attempts=3, backoff=0.001, jitter=0.0)
+    assert execute_with_policy(flaky, p) == "ok"
+    assert len(calls) == 3
+
+    def fatal():
+        calls.append(1)
+        raise ValueError("deterministic")
+
+    calls.clear()
+    with pytest.raises(ValueError):
+        execute_with_policy(fatal, p)
+    assert len(calls) == 1  # no retry on fatal
+
+    def always():
+        calls.append(1)
+        raise OSError("transient")
+
+    calls.clear()
+    with pytest.raises(OSError):
+        execute_with_policy(always, p)
+    assert len(calls) == 3  # budget exhausted, original error
+
+
+def test_execute_with_policy_honors_cancellation():
+    token = CancelToken()
+    token.cancel()
+    with pytest.raises(TaskCancelledError):
+        execute_with_policy(lambda: 1, RetryPolicy(), token=token)
+
+
+# ---------------------------------------------------------------------------
+# harness mechanics
+# ---------------------------------------------------------------------------
+def test_fault_plan_nth_invocation_and_counters():
+    plan = FaultPlan(
+        FaultSpec("fs.open", "memory://h/*", times=2, skip=1,
+                  error=lambda: OSError("injected"))
+    )
+    from fugue_tpu.testing.faults import fault_point
+
+    with inject_faults(plan):
+        fault_point("fs.open", "memory://h/a")  # skipped
+        with pytest.raises(OSError):
+            fault_point("fs.open", "memory://h/a")
+        with pytest.raises(OSError):
+            fault_point("fs.open", "memory://h/b")
+        fault_point("fs.open", "memory://h/a")  # times exhausted
+        fault_point("fs.open", "memory://other")  # no match, no counter
+    assert plan.counters["fs.open:memory://h/a"]["attempts"] == 3
+    assert plan.counters["fs.open:memory://h/a"]["injected"] == 1
+    assert plan.counters["fs.open:memory://h/b"]["injected"] == 1
+    assert "fs.open:memory://other" not in plan.counters
+    assert plan.total("injected") == 2
+
+
+def test_fault_plan_seeded_replay_and_nesting_guard():
+    def run(seed):
+        plan = FaultPlan(
+            FaultSpec("task", "*", probability=0.5, times=10**9,
+                      error=lambda: OSError("p")),
+            seed=seed,
+        )
+        fired = []
+        from fugue_tpu.testing.faults import fault_point
+
+        with inject_faults(plan):
+            for i in range(20):
+                try:
+                    fault_point("task", f"t{i}")
+                    fired.append(False)
+                except OSError:
+                    fired.append(True)
+        return fired
+
+    assert run(7) == run(7)  # same seed -> identical replay
+    assert run(7) != run(8)
+    with inject_faults(FaultPlan()):
+        with pytest.raises(RuntimeError):
+            inject_faults(FaultPlan()).__enter__()
+
+
+# ---------------------------------------------------------------------------
+# acceptance (a): transient fs fault during streamed ingest recovers
+# ---------------------------------------------------------------------------
+def test_transient_fs_fault_during_streamed_ingest_recovers():
+    from fugue_tpu.constants import FUGUE_CONF_JAX_IO_BATCH_ROWS
+    from fugue_tpu.jax_backend.execution_engine import JaxExecutionEngine
+
+    e = JaxExecutionEngine(
+        {FUGUE_CONF_JAX_IO_BATCH_ROWS: 64, **_FAST_RETRY}
+    )
+    try:
+        pdf = pd.DataFrame({"x": range(300), "y": [f"s{i % 7}" for i in range(300)]})
+        path = "memory://faults/ingest_src.parquet"
+        e.save_df(e.to_df(pdf), path)
+        plan = FaultPlan(
+            FaultSpec(
+                "fs.open",
+                "memory://faults/ingest_src.parquet",
+                times=1,
+                error=lambda: OSError("injected storage hiccup"),
+            )
+        )
+        dag = FugueWorkflow()
+        dag.load(path).yield_dataframe_as("out", as_local=True)
+        with inject_faults(plan):
+            res = dag.run(e)
+        got = res["out"].as_pandas().sort_values("x").reset_index(drop=True)
+        pd.testing.assert_frame_equal(got, pdf)
+        assert plan.counters[
+            "fs.open:memory://faults/ingest_src.parquet"
+        ]["injected"] == 1
+        # the retry executor reported the recovery against the task site
+        assert plan.total("retries") == 1
+        assert plan.total("recoveries") == 1
+        assert sum(res.fault_stats["retries"].values()) == 1
+    finally:
+        e.stop()
+
+
+def test_transient_fs_write_fault_on_checkpoint_recovers():
+    e = make_execution_engine(
+        "native",
+        {
+            FUGUE_CONF_WORKFLOW_CHECKPOINT_PATH: "memory://faults/ckpt_w",
+            **_FAST_RETRY,
+        },
+    )
+    plan = FaultPlan(
+        FaultSpec(
+            "fs.write",
+            "memory://faults/ckpt_w/*",
+            times=1,
+            error=lambda: OSError("injected write hiccup"),
+        )
+    )
+    dag = FugueWorkflow()
+    dag.df(pd.DataFrame({"x": [1, 2]})).deterministic_checkpoint(
+        namespace="wfault"
+    ).yield_dataframe_as("out", as_local=True)
+    with inject_faults(plan):
+        res = dag.run(e)
+    assert res["out"].as_pandas()["x"].tolist() == [1, 2]
+    assert plan.total("injected") == 1
+    assert plan.total("recoveries") == 1
+
+
+def test_transient_rpc_fault_during_callback_recovers():
+    hits: List[str] = []
+
+    def cb(value: str) -> None:
+        hits.append(value)
+
+    def f(df: pd.DataFrame, announce: Callable) -> pd.DataFrame:
+        announce(f"rows={len(df)}")
+        return df
+
+    e = make_execution_engine("native", dict(_FAST_RETRY))
+    plan = FaultPlan(
+        FaultSpec(
+            "rpc", "*", times=1,
+            error=lambda: ConnectionError("injected transport blip"),
+        )
+    )
+    dag = FugueWorkflow()
+    dag.df([[1], [2]], "x:long").transform(
+        f, schema="*", callback=cb
+    ).yield_dataframe_as("out", as_local=True)
+    with inject_faults(plan):
+        res = dag.run(e)
+    assert res["out"].as_pandas()["x"].tolist() == [1, 2]
+    assert plan.total("injected") == 1
+    assert plan.total("recoveries") == 1
+    assert len(hits) >= 1  # the retried attempt's callback landed
+
+
+# ---------------------------------------------------------------------------
+# acceptance (b): injected device-OOM degrades to the host tier
+# ---------------------------------------------------------------------------
+def test_injected_oom_degrades_to_host_tier():
+    import jax
+
+    from fugue_tpu.jax_backend.blocks import make_mesh
+    from fugue_tpu.jax_backend.execution_engine import JaxExecutionEngine
+
+    e = JaxExecutionEngine(dict(_FAST_RETRY))
+    try:
+        # on a CPU-only box the host mesh IS the device mesh (and jax
+        # interns Mesh objects): give the engine a DISTINCT host-tier
+        # mesh (a device subset) so degradation is observable
+        e._host_mesh = make_mesh(jax.devices("cpu")[:4])
+        assert e.supports_host_degrade
+        # the thread-local override redirects ingest placement
+        with e.degraded_to_host():
+            assert e._ingest_mesh(10**12) is e.host_mesh
+        assert e._ingest_mesh(1) is not None  # restored
+
+        plan = FaultPlan(
+            FaultSpec(
+                "task", "CreateData*", times=1,
+                error=lambda: FakeXlaRuntimeError(
+                    "RESOURCE_EXHAUSTED: failed to allocate 9.99G"
+                ),
+            )
+        )
+        pdf = pd.DataFrame({"x": [1, 2, 3], "y": [9, 8, 7]})
+        dag = FugueWorkflow()
+        dag.df(pdf).yield_dataframe_as("out", as_local=True)
+        with inject_faults(plan):
+            res = dag.run(e)
+        got = res["out"].as_pandas().reset_index(drop=True)
+        pd.testing.assert_frame_equal(got, pdf)
+        # degraded exactly once, without consuming a retry
+        assert plan.total("degradations") == 1
+        assert plan.total("retries") == 0
+        assert sum(res.fault_stats["degradations"].values()) == 1
+        assert e.fallbacks.get("oom_degrade", 0) == 1
+    finally:
+        e.stop()
+
+
+def test_streamed_lazy_load_replaces_tier_at_materialization():
+    """A lazy streamed frame planned on the device tier must re-place
+    onto the host mesh when MATERIALIZED under the degrade override —
+    the tier decision happens at load_blocks time, not plan time."""
+    import jax
+
+    from fugue_tpu.constants import (
+        FUGUE_CONF_JAX_IO_BATCH_ROWS,
+        FUGUE_CONF_JAX_PLACEMENT,
+    )
+    from fugue_tpu.jax_backend.blocks import make_mesh
+    from fugue_tpu.jax_backend.execution_engine import JaxExecutionEngine
+
+    e = JaxExecutionEngine(
+        {
+            FUGUE_CONF_JAX_IO_BATCH_ROWS: 64,
+            FUGUE_CONF_JAX_PLACEMENT: "device",
+        }
+    )
+    try:
+        e._host_mesh = make_mesh(jax.devices("cpu")[:4])
+        assert e.supports_host_degrade
+        path = "memory://faults/lazy_degrade.parquet"
+        e.save_df(e.to_df(pd.DataFrame({"x": range(200)})), path)
+        df = e.load_df(path)
+        assert df._lazy is not None  # planned, not materialized
+        with e.degraded_to_host():
+            blocks = df.blocks  # streamed upload under the override
+        assert blocks.mesh is e.host_mesh
+        assert df.as_pandas()["x"].tolist() == list(range(200))
+    finally:
+        e.stop()
+
+
+def test_oom_without_degradable_engine_retries_as_transient():
+    calls = []
+
+    def oom_once():
+        calls.append(1)
+        if len(calls) == 1:
+            raise FakeXlaRuntimeError("RESOURCE_EXHAUSTED: oom")
+        return "ok"
+
+    p = RetryPolicy(max_attempts=2, backoff=0.001, jitter=0.0)
+    assert execute_with_policy(oom_once, p) == "ok"
+    assert len(calls) == 2
+
+
+# ---------------------------------------------------------------------------
+# acceptance (c): killed run resumes from the manifest
+# ---------------------------------------------------------------------------
+_RESUME_CALLS: List[str] = []
+
+
+def _counted_creator() -> pd.DataFrame:
+    _RESUME_CALLS.append("create")
+    return pd.DataFrame({"x": [1, 2, 3, 4]})
+
+
+def _double(df: pd.DataFrame) -> pd.DataFrame:
+    return df.assign(x=df["x"] * 2)
+
+
+def test_resume_from_manifest_reexecutes_only_uncompleted():
+    _RESUME_CALLS.clear()
+    conf = {
+        FUGUE_CONF_WORKFLOW_CHECKPOINT_PATH: "memory://faults/resume_ck",
+        FUGUE_CONF_WORKFLOW_RESUME: True,
+    }
+
+    def build() -> FugueWorkflow:
+        dag = FugueWorkflow()
+        src = dag.create(
+            _counted_creator, schema="x:long"
+        ).deterministic_checkpoint(namespace="resume_t")
+        src.transform(_double, schema="*").yield_dataframe_as(
+            "out", as_local=True
+        )
+        return dag
+
+    # run 1: the downstream transform is "killed" by an injected fatal
+    # fault — the creator completed and its artifact + manifest survive
+    plan = FaultPlan(
+        FaultSpec(
+            "task", "RunTransformer*", times=1,
+            error=lambda: ValueError("injected kill"),
+        )
+    )
+    e1 = make_execution_engine("native", conf)
+    with inject_faults(plan):
+        with pytest.raises(ValueError):
+            build().run(e1)
+    assert _RESUME_CALLS == ["create"]
+    # the manifest survived the failed run and lists the completed task
+    from fugue_tpu.workflow.manifest import RunManifest
+
+    wf_uuid = build().__uuid__()
+    mf_uri = e1.fs.join(
+        "memory://faults/resume_ck", f"manifest_{wf_uuid}.json"
+    )
+    assert e1.fs.exists(mf_uri)
+
+    # run 2: identical DAG resumes — the creator does NOT re-execute,
+    # only the frontier (the failed transform and downstream) runs
+    e2 = make_execution_engine("native", conf)
+    res = build().run(e2)
+    assert res["out"].as_pandas()["x"].tolist() == [2, 4, 6, 8]
+    assert _RESUME_CALLS == ["create"]  # no recompute
+    assert any(
+        n.startswith("_counted_creator") for n in res.fault_stats["resumed"]
+    )
+    # a fully successful run removes its manifest
+    assert not e2.fs.exists(mf_uri)
+
+
+def test_resume_disabled_writes_no_manifest():
+    _RESUME_CALLS.clear()
+    conf = {FUGUE_CONF_WORKFLOW_CHECKPOINT_PATH: "memory://faults/nores"}
+
+    dag = FugueWorkflow()
+    dag.create(_counted_creator, schema="x:long").yield_dataframe_as(
+        "out", as_local=True
+    )
+    e = make_execution_engine("native", conf)
+    dag.run(e)
+    assert not any(
+        n.startswith("manifest_")
+        for n in e.fs.listdir("memory://faults/nores")
+    )
+
+
+# ---------------------------------------------------------------------------
+# acceptance (d): concurrent failures aggregate into WorkflowRuntimeError
+# ---------------------------------------------------------------------------
+def test_two_concurrent_failures_both_in_aggregated_error():
+    barrier = threading.Barrier(2, timeout=10)
+
+    def fail_a() -> pd.DataFrame:
+        barrier.wait()
+        raise ValueError("boom-a")
+
+    def fail_b() -> pd.DataFrame:
+        barrier.wait()
+        raise ValueError("boom-b")
+
+    e = make_execution_engine(
+        "native", {FUGUE_CONF_WORKFLOW_CONCURRENCY: 2}
+    )
+    dag = FugueWorkflow()
+    dag.create(fail_a, schema="x:long").yield_dataframe_as("a")
+    dag.create(fail_b, schema="x:long").yield_dataframe_as("b")
+    with pytest.raises(WorkflowRuntimeError) as ei:
+        dag.run(e)
+    err = ei.value
+    assert len(err.failures) == 2
+    msgs = sorted(str(f.error) for f in err.failures)
+    assert msgs == ["boom-a", "boom-b"]
+    names = " ".join(f.task_name for f in err.failures)
+    assert "fail_a" in names and "fail_b" in names
+    # the aggregated message carries names + callsites for each failure
+    assert "fail_a" in str(err) and "boom-b" in str(err)
+    assert "defined at:" in str(err)
+
+
+def test_single_failure_keeps_original_exception_type():
+    def fail() -> pd.DataFrame:
+        raise KeyError("only-me")
+
+    e = make_execution_engine(
+        "native", {FUGUE_CONF_WORKFLOW_CONCURRENCY: 2}
+    )
+    dag = FugueWorkflow()
+    dag.create(fail, schema="x:long").yield_dataframe_as("a")
+    with pytest.raises(KeyError):
+        dag.run(e)
+
+
+# ---------------------------------------------------------------------------
+# timeout + cooperative cancellation
+# ---------------------------------------------------------------------------
+def test_task_timeout_abandons_hung_task():
+    def hang() -> pd.DataFrame:
+        time.sleep(3.0)
+        return pd.DataFrame({"x": [1]})
+
+    e = make_execution_engine(
+        "native",
+        {FUGUE_CONF_WORKFLOW_CONCURRENCY: 2, FUGUE_CONF_WORKFLOW_TIMEOUT: 0.3},
+    )
+    dag = FugueWorkflow()
+    dag.create(hang, schema="x:long").yield_dataframe_as("a")
+    t0 = time.perf_counter()
+    with pytest.raises(TaskTimeoutError) as ei:
+        dag.run(e)
+    assert time.perf_counter() - t0 < 2.5  # abandoned, not awaited
+    assert "timed out after 0.3s" in str(ei.value)
+
+
+def test_per_task_timeout_override_via_workflow_api():
+    def hang() -> pd.DataFrame:
+        time.sleep(3.0)
+        return pd.DataFrame({"x": [1]})
+
+    e = make_execution_engine(
+        "native", {FUGUE_CONF_WORKFLOW_CONCURRENCY: 2}
+    )
+    dag = FugueWorkflow()
+    dag.create(hang, schema="x:long").fault_tolerant(
+        timeout=0.3
+    ).yield_dataframe_as("a")
+    t0 = time.perf_counter()
+    with pytest.raises(TaskTimeoutError):
+        dag.run(e)
+    assert time.perf_counter() - t0 < 2.5
+
+
+def test_failure_cancels_pending_siblings_and_drains_running():
+    events: List[str] = []
+    lock = threading.Lock()
+    started = threading.Event()
+
+    def fail_fast() -> pd.DataFrame:
+        started.wait(5)  # let the slow sibling actually start
+        raise ValueError("boom")
+
+    def slow_ok() -> pd.DataFrame:
+        started.set()
+        time.sleep(0.4)
+        with lock:
+            events.append("slow-done")
+        return pd.DataFrame({"x": [1]})
+
+    def never(df: pd.DataFrame) -> pd.DataFrame:
+        with lock:
+            events.append("dependent-ran")
+        return df
+
+    e = make_execution_engine(
+        "native", {FUGUE_CONF_WORKFLOW_CONCURRENCY: 2}
+    )
+    dag = FugueWorkflow()
+    bad = dag.create(fail_fast, schema="x:long")
+    bad.transform(never, schema="*").yield_dataframe_as("dep")
+    dag.create(slow_ok, schema="x:long").yield_dataframe_as("ok")
+    with pytest.raises(ValueError):
+        dag.run(e)
+    # in-flight sibling was drained to completion; the dependent of the
+    # failed task never launched
+    assert events == ["slow-done"]
+
+
+# ---------------------------------------------------------------------------
+# per-task retry override + callsite attribution
+# ---------------------------------------------------------------------------
+def test_per_task_retry_override_recovers_custom_class():
+    class Flaky(RuntimeError):
+        pass
+
+    plan = FaultPlan(
+        FaultSpec("task", "CreateData*", times=2,
+                  error=lambda: Flaky("custom transient"))
+    )
+    e = make_execution_engine("native")  # global conf: NO retry
+    dag = FugueWorkflow()
+    dag.df(pd.DataFrame({"x": [5]})).fault_tolerant(
+        # a BARE class (not a tuple) must be accepted too
+        max_attempts=3, backoff=0.01, jitter=0.0, retry_on=Flaky
+    ).yield_dataframe_as("out", as_local=True)
+    with inject_faults(plan):
+        res = dag.run(e)
+    assert res["out"].as_pandas()["x"].tolist() == [5]
+    assert plan.total("injected") == 2
+    assert plan.total("recoveries") == 1
+
+
+def test_task_error_carries_name_and_user_callsite():
+    def explode(df: pd.DataFrame) -> pd.DataFrame:
+        raise RuntimeError("user bug")
+
+    e = make_execution_engine("native")
+    dag = FugueWorkflow()
+    dag.df(pd.DataFrame({"x": [1]})).transform(
+        explode, schema="*"
+    ).yield_dataframe_as("out")
+    with pytest.raises(RuntimeError) as ei:
+        dag.run(e)
+    notes = "\n".join(getattr(ei.value, "__notes__", []))
+    assert "in task RunTransformer" in notes
+    assert __file__.split("/")[-1] in notes  # the user's workflow line

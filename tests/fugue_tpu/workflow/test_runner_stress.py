@@ -1,0 +1,218 @@
+"""Parallel DAG runner under stress: deep/wide seeded DAGs must produce
+results identical to the serial path, cycle detection must fire under
+concurrency > 1, mid-run failures must drain in-flight siblings, and the
+completion callback must see every finished task exactly once."""
+
+import random
+import threading
+import time
+from typing import Any, Dict, List
+
+import pytest
+
+from fugue_tpu.exceptions import WorkflowRuntimeError
+from fugue_tpu.workflow.runner import DAGRunner, TaskNode
+
+
+def _random_dag(seed: int, layers: int, width: int) -> List[TaskNode]:
+    """A layered DAG whose node values are deterministic functions of
+    their dependencies, so serial and parallel runs are comparable."""
+    rng = random.Random(seed)
+    nodes: List[TaskNode] = []
+    prev_layer: List[str] = []
+    for layer in range(layers):
+        cur: List[str] = []
+        for i in range(rng.randint(1, width)):
+            tid = f"n{layer}_{i}"
+            deps = (
+                rng.sample(prev_layer, rng.randint(1, len(prev_layer)))
+                if prev_layer
+                else []
+            )
+
+            def func(inputs: List[Any], tid=tid) -> Any:
+                # tiny stagger so completion order varies across runs
+                time.sleep(random.random() * 0.002)
+                return hash((tid, tuple(sorted(inputs))))
+
+            nodes.append(TaskNode(tid, func, deps))
+            cur.append(tid)
+        prev_layer = cur
+    rng.shuffle(nodes)  # submission order must not matter
+    return nodes
+
+
+@pytest.mark.parametrize("seed", [11, 23, 47])
+def test_parallel_matches_serial_on_random_dags(seed):
+    nodes = _random_dag(seed, layers=8, width=8)
+    serial = DAGRunner(1).run(list(nodes))
+    parallel = DAGRunner(8).run(list(nodes))
+    assert parallel == serial
+    assert len(parallel) == len(nodes)
+
+
+def test_deep_chain_and_wide_fanout():
+    # depth: a 150-long dependency chain
+    chain = [
+        TaskNode(
+            f"c{i}",
+            lambda inputs, i=i: (inputs[0] if inputs else 0) + 1,
+            [f"c{i-1}"] if i > 0 else [],
+        )
+        for i in range(150)
+    ]
+    assert DAGRunner(4).run(chain)["c149"] == 150
+    # width: 100 independent tasks fanned into one reducer
+    wide = [
+        TaskNode(f"w{i}", lambda inputs, i=i: i, []) for i in range(100)
+    ]
+    wide.append(
+        TaskNode("sum", lambda inputs: sum(inputs), [f"w{i}" for i in range(100)])
+    )
+    assert DAGRunner(8).run(wide)["sum"] == sum(range(100))
+
+
+@pytest.mark.parametrize("concurrency", [1, 2, 8])
+def test_cycle_detection_under_concurrency(concurrency):
+    nodes = [
+        TaskNode("a", lambda i: 1, ["c"]),
+        TaskNode("b", lambda i: 1, ["a"]),
+        TaskNode("c", lambda i: 1, ["b"]),
+        TaskNode("root", lambda i: 0, []),
+    ]
+    with pytest.raises(ValueError, match="cycle"):
+        DAGRunner(concurrency).run(nodes)
+
+
+def test_mid_run_failures_drain_and_aggregate():
+    """Two tasks fail while in flight together; a slow healthy sibling
+    must be drained to completion and BOTH failures must surface."""
+    barrier = threading.Barrier(3, timeout=10)
+    done: List[str] = []
+
+    def fail(inputs, tag=""):
+        barrier.wait()
+        raise RuntimeError(f"boom-{tag}")
+
+    def slow_ok(inputs):
+        barrier.wait()
+        time.sleep(0.3)
+        done.append("survivor")
+        return 42
+
+    nodes = [
+        TaskNode("f1", lambda i: fail(i, "1"), [], name="f1"),
+        TaskNode("f2", lambda i: fail(i, "2"), [], name="f2"),
+        TaskNode("ok", slow_ok, [], name="ok"),
+        # dependent of a failed task: must never launch
+        TaskNode("dep", lambda i: done.append("dep"), ["f1"], name="dep"),
+    ]
+    completed: List[str] = []
+    with pytest.raises(WorkflowRuntimeError) as ei:
+        DAGRunner(3).run(nodes, on_complete=lambda n: completed.append(n.task_id))
+    err = ei.value
+    assert sorted(f.task_name for f in err.failures) == ["f1", "f2"]
+    assert sorted(str(f.error) for f in err.failures) == ["boom-1", "boom-2"]
+    assert done == ["survivor"]  # drained, and "dep" never ran
+    assert completed == ["ok"]
+
+
+def test_timeout_excludes_pool_queue_wait():
+    """Three 0.3s tasks on two workers with a 0.45s per-task budget: the
+    third sits queued ~0.3s before starting. Its clock starts at
+    EXECUTION, so the run succeeds (a submit-time clock would expire it
+    while queued)."""
+    nodes = [
+        TaskNode(
+            f"q{i}",
+            lambda d, i=i: (time.sleep(0.3), i)[1],
+            [],
+            timeout=0.45,
+        )
+        for i in range(3)
+    ]
+    res = DAGRunner(2).run(nodes)
+    assert res == {"q0": 0, "q1": 1, "q2": 2}
+
+
+def test_reused_nodes_do_not_inherit_stale_timeout_clock():
+    """run() resets started_at: re-running the same TaskNode objects
+    must not expire tasks against the PREVIOUS run's start stamps."""
+    nodes = [
+        TaskNode(f"r{i}", lambda d, i=i: (time.sleep(0.15), i)[1], [],
+                 timeout=0.5)
+        for i in range(2)
+    ]
+    runner = DAGRunner(2)
+    assert runner.run(nodes) == {"r0": 0, "r1": 1}
+    time.sleep(0.6)  # long enough that stale stamps would look expired
+    assert runner.run(nodes) == {"r0": 0, "r1": 1}
+
+
+def test_worker_threads_are_daemon():
+    """Abandoned (timed-out) workers must not block interpreter exit —
+    every task worker is a daemon thread."""
+    flags: List[bool] = []
+
+    def probe(inputs):
+        flags.append(threading.current_thread().daemon)
+        return 1
+
+    DAGRunner(2).run([TaskNode("p", probe, [])])
+    assert flags == [True]
+
+
+def test_on_complete_fires_exactly_once_per_task():
+    nodes = _random_dag(5, layers=6, width=6)
+    seen: Dict[str, int] = {}
+    lock = threading.Lock()
+
+    def on_complete(node):
+        with lock:
+            seen[node.task_id] = seen.get(node.task_id, 0) + 1
+
+    DAGRunner(8).run(list(nodes), on_complete=on_complete)
+    assert seen == {n.task_id: 1 for n in nodes}
+
+
+def test_failure_callback_errors_do_not_mask_results():
+    """A crashing on_complete (manifest write failure) must not break
+    the run."""
+    nodes = [TaskNode("a", lambda i: 7, [])]
+
+    def bad_callback(node):
+        raise OSError("manifest write failed")
+
+    assert DAGRunner(2).run(nodes, on_complete=bad_callback)["a"] == 7
+
+
+def test_concurrency_stress_interleaved_failures():
+    """A bigger soak: every run a seeded subset of tasks fails; results
+    of all SUCCESSFUL serial tasks match, and the runner neither hangs
+    nor loses failures."""
+    for seed in (3, 9):
+        rng = random.Random(seed)
+        nodes = []
+        failing = set()
+        for i in range(40):
+            tid = f"t{i}"
+            deps = [f"t{j}" for j in rng.sample(range(i), min(i, 2))] if i else []
+            if rng.random() < 0.15:
+                failing.add(tid)
+
+                def func(inputs, tid=tid):
+                    raise RuntimeError(tid)
+
+            else:
+
+                def func(inputs, tid=tid):
+                    return tid
+
+            nodes.append(TaskNode(tid, func, deps, name=tid))
+        try:
+            DAGRunner(6).run(nodes)
+            assert not failing
+        except WorkflowRuntimeError as ex:
+            assert {f.task_name for f in ex.failures} <= failing
+        except RuntimeError as ex:
+            assert str(ex) in failing
