@@ -237,6 +237,81 @@ def _pair(
     return out
 
 
+def _governance_overhead(
+    pdf: Any, jax_udf: Callable, n_rows: int
+) -> Dict[str, Any]:
+    """Memory-governance overhead block (ISSUE r9): the SAME
+    transform+groupby pipeline on a governed engine (generous
+    budget_fraction — ledger + admission active, zero spills expected)
+    vs a fresh ungoverned engine, plus the governed run's peak ledger
+    bytes per tier and spill count. The governed headline must stay
+    within noise of the ungoverned one — a regression here means the
+    ledger/admission layer leaked onto the hot path."""
+    import jax
+
+    from fugue_tpu import transform
+    from fugue_tpu.column import col
+    from fugue_tpu.column import functions as ff
+    from fugue_tpu.execution import make_execution_engine
+    from fugue_tpu.execution.api import aggregate
+
+    def run_on(eng: Any) -> float:
+        src = eng.persist(eng.to_df(pdf))
+
+        def once() -> None:
+            out = transform(
+                src, jax_udf, schema="k:int,v2:float", engine=eng,
+                as_fugue=True,
+            )
+            agg = aggregate(
+                out, partition_by="k",
+                s=ff.sum(col("v2")), m=ff.avg(col("v2")),
+                c=ff.count(col("v2")),
+                engine=eng, as_fugue=True,
+            )
+            arrs = [
+                c_.data for c_ in agg.native.columns.values() if c_.on_device
+            ]
+            if agg.native.row_valid is not None:  # type: ignore
+                arrs.append(agg.native.row_valid)  # type: ignore
+            jax.device_get(arrs)
+
+        return _timed(once, warm=3)
+
+    ungoverned = make_execution_engine("jax")
+    governed = make_execution_engine(
+        "jax", {"fugue.jax.memory.budget_fraction": 0.8}
+    )
+    ungoverned_secs = run_on(ungoverned)
+    governed_secs = run_on(governed)
+    stats = governed.memory_stats
+    ratio = governed_secs / max(ungoverned_secs, 1e-9)
+    within_noise = ratio < 1.15
+    if not within_noise:
+        import sys
+
+        print(
+            f"WARNING: governed run {ratio:.2f}x the ungoverned run "
+            "(> 1.15 noise band) — memory governance overhead regressed",
+            file=sys.stderr,
+        )
+    return {
+        "rows": n_rows,
+        "governed_secs": round(governed_secs, 4),
+        "ungoverned_secs": round(ungoverned_secs, 4),
+        "overhead_ratio": round(ratio, 3),
+        "within_noise": within_noise,
+        "budget_bytes": stats["budget_bytes"],
+        "peak_bytes": dict(stats["peak"]),
+        "spills": stats["counters"]["spills"],
+        "pressure_events": stats["counters"]["pressure_events"],
+        "admissions": {
+            "device": stats["counters"]["admissions_device"],
+            "host": stats["counters"]["admissions_host"],
+        },
+    }
+
+
 def _bench_headline() -> Dict[str, Any]:
     import jax
     import jax.numpy as jnp
@@ -336,6 +411,12 @@ def _bench_headline() -> Dict[str, Any]:
     # transform reads k+v, writes v2; groupby reads k+v2 (5 x 4B streams)
     roofline = _roofline(build_frame, n_rows * 20, engine=engine)
 
+    memory_block = _governance_overhead(
+        pd.DataFrame({"k": keys[:n_native], "v": values[:n_native]}),
+        jax_udf,
+        n_native,
+    )
+
     return {
         "metric": "transform_groupby_rows_per_sec",
         "value": round(jax_rps, 1),
@@ -356,6 +437,7 @@ def _bench_headline() -> Dict[str, Any]:
             "native_rows_per_sec": round(native_rps, 1),
             "roofline": roofline,
             "strategy_counts": dict(engine.strategy_counts),
+            "memory": memory_block,
             "devices": len(jax.devices()),
             "platform": jax.devices()[0].platform,
             "notes": (

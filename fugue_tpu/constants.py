@@ -35,6 +35,11 @@ FUGUE_CONF_JAX_IO_BATCH_ROWS = "fugue.jax.io.batch_rows"
 FUGUE_CONF_JAX_GROUPBY_MATMUL = "fugue.jax.groupby.matmul"
 FUGUE_CONF_JAX_GROUPBY_STRATEGY = "fugue.jax.groupby.strategy"
 FUGUE_CONF_JAX_GROUPBY_AUTOTUNE = "fugue.jax.groupby.autotune"
+FUGUE_CONF_JAX_MEMORY_BUDGET_BYTES = "fugue.jax.memory.budget_bytes"
+FUGUE_CONF_JAX_MEMORY_BUDGET_FRACTION = "fugue.jax.memory.budget_fraction"
+FUGUE_CONF_JAX_MEMORY_HIGH_WATERMARK = "fugue.jax.memory.high_watermark"
+FUGUE_CONF_JAX_MEMORY_LOW_WATERMARK = "fugue.jax.memory.low_watermark"
+FUGUE_CONF_RPC_HTTP_RETRIES = "fugue.rpc.http_server.retries"
 
 FUGUE_COMPILE_TIME_CONFIGS = {
     FUGUE_CONF_WORKFLOW_EXCEPTION_HIDE,
@@ -93,6 +98,21 @@ _DEFAULT_CONF: Dict[str, Any] = {
     # autotune policy: "auto" probes on accelerator meshes for large
     # frames only; True/False force it on/off.
     FUGUE_CONF_JAX_GROUPBY_AUTOTUNE: "auto",
+    # device-memory governance (jax_backend/memory.py): budget_bytes > 0
+    # (or budget_fraction > 0 of the detected per-device memory) turns on
+    # the HBM byte ledger + admission controller. An ingest/persist that
+    # would push the device tier past high_watermark * budget first
+    # spills LRU persisted frames to the host tier down to low_watermark;
+    # a frame whose estimated footprint alone exceeds the budget is
+    # placed on the host tier directly. 0/0.0 = ungoverned (default).
+    FUGUE_CONF_JAX_MEMORY_BUDGET_BYTES: 0,
+    FUGUE_CONF_JAX_MEMORY_BUDGET_FRACTION: 0.0,
+    FUGUE_CONF_JAX_MEMORY_HIGH_WATERMARK: 0.9,
+    FUGUE_CONF_JAX_MEMORY_LOW_WATERMARK: 0.75,
+    # bounded exponential-backoff retries for the HTTP RPC client on
+    # transient transport failures (connection refused/reset, HTTP 503);
+    # non-transient HTTP errors always fail fast
+    FUGUE_CONF_RPC_HTTP_RETRIES: 2,
 }
 
 _GLOBAL_CONF = ParamDict(_DEFAULT_CONF)

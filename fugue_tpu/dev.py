@@ -71,6 +71,7 @@ from fugue_tpu.testing.faults import (
     FaultSpec,
     fault_point,
     inject_faults,
+    resource_exhausted,
 )
 from fugue_tpu.workflow.fault import (
     CancelToken,

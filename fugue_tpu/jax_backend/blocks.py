@@ -298,6 +298,15 @@ def residency_arrays(blocks: JaxBlocks) -> List[Any]:
     return arrs
 
 
+def device_nbytes(blocks: JaxBlocks) -> int:
+    """A frame's REAL device-tier footprint: the byte sum over every
+    device array it owns (column data, validity masks, row_valid). This
+    is the number the memory governor's ledger registers — tests assert
+    ledger parity against it, so it must stay in lockstep with
+    :func:`residency_arrays`."""
+    return sum(int(a.nbytes) for a in residency_arrays(blocks))
+
+
 def _int_like_stats(
     values: np.ndarray, tp: pa.DataType
 ) -> Optional[Tuple[int, int]]:

@@ -48,6 +48,10 @@ class JaxDataFrame(DataFrame):
         self._pending: Optional[Any] = None  # (pa.Table, mesh) before upload
         # (load_blocks, load_table, mesh, nrows) for storage-lazy frames
         self._lazy: Optional[Any] = None
+        # memory-governance admission ticket (memory.AllocationGate) set
+        # by the engine on governed pending frames; consumed at blocks
+        # materialization
+        self._mem_gate: Optional[Any] = None
 
     @staticmethod
     def from_table(table: pa.Table, mesh: Any, schema: Optional[Schema] = None) -> "JaxDataFrame":
@@ -57,6 +61,7 @@ class JaxDataFrame(DataFrame):
         res._blocks = None
         res._pending = (table, mesh)
         res._lazy = None
+        res._mem_gate = None
         return res
 
     @staticmethod
@@ -86,6 +91,7 @@ class JaxDataFrame(DataFrame):
         res._lazy = _LazyState(
             load_blocks, load_table, mesh, nrows, load_head, narrow
         )
+        res._mem_gate = None
         return res
 
     @property
@@ -101,6 +107,14 @@ class JaxDataFrame(DataFrame):
     @property
     def blocks(self) -> JaxBlocks:
         if self._blocks is None:
+            # governance runs at MATERIALIZATION time: before() may spill
+            # LRU persisted frames to make room (and hosts the
+            # device.alloc fault site); after() registers the real
+            # footprint. A raised alloc failure leaves the gate armed so
+            # a later touch is still governed.
+            gate = getattr(self, "_mem_gate", None)
+            if gate is not None:
+                gate.before()
             if self._lazy is not None:
                 self._blocks = self._lazy.load_blocks()
                 self._lazy = None  # device copy is authoritative now
@@ -108,6 +122,9 @@ class JaxDataFrame(DataFrame):
                 table, mesh = self._pending  # type: ignore[misc]
                 self._blocks = from_arrow(table, self.schema, mesh)
                 self._pending = None  # device copy is authoritative now
+            if gate is not None:
+                gate.after(self._blocks)
+                self._mem_gate = None
         return self._blocks
 
     @property
@@ -204,9 +221,14 @@ class JaxDataFrame(DataFrame):
             )
         if self._blocks is None:
             table, mesh = self._pending  # type: ignore[misc]
-            return JaxDataFrame.from_table(
+            res = JaxDataFrame.from_table(
                 table.select(schema.names), mesh, schema
             )
+            # the derived pending frame materializes under the same
+            # admission ticket (sharing it is safe: the gate is
+            # stateless and registers whatever blocks it is handed)
+            res._mem_gate = self._mem_gate
+            return res
         blocks = JaxBlocks(
             self._blocks._nrows,
             {n: self._blocks.columns[n] for n in schema.names},
@@ -231,9 +253,11 @@ class JaxDataFrame(DataFrame):
             )
         if self._blocks is None:
             table, mesh = self._pending  # type: ignore[misc]
-            return JaxDataFrame.from_table(
+            res = JaxDataFrame.from_table(
                 table.rename_columns(schema.names), mesh, schema
             )
+            res._mem_gate = self._mem_gate  # same admission ticket
+            return res
         cols = {
             columns.get(n, n): c for n, c in self._blocks.columns.items()
         }

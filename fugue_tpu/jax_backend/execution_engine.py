@@ -573,6 +573,12 @@ class JaxExecutionEngine(ExecutionEngine):
         # a device-OOM task under degraded_to_host() — thread-local so one
         # degraded task in a parallel runner doesn't demote its siblings
         self._tier_override = threading.local()
+        # proactive device-memory governance: byte ledger + admission
+        # control + LRU spill-to-host (memory.py). Disabled unless
+        # fugue.jax.memory.budget_bytes/.budget_fraction is set.
+        from fugue_tpu.jax_backend.memory import MemoryGovernor
+
+        self._memory = MemoryGovernor(self)
 
     @property
     def fallbacks(self) -> Dict[str, int]:
@@ -589,6 +595,33 @@ class JaxExecutionEngine(ExecutionEngine):
             op,
             f" ({why})" if why else "",
         )
+
+    def _count_memory_event(self, name: str, detail: str = "") -> None:
+        """Memory-governance events ride the fallback counter surface
+        (``mem_admit_host``/``mem_pressure``/``mem_spill``/
+        ``mem_oom_feedback``) so tests and benches assert governance ran
+        the same way they assert a pipeline stayed on device."""
+        self._fallbacks[name] = self._fallbacks.get(name, 0) + 1
+        self.log.info(
+            "fugue_tpu.jax memory governance: %s%s",
+            name,
+            f" ({detail})" if detail else "",
+        )
+
+    @property
+    def memory_stats(self) -> Dict[str, Any]:
+        """Snapshot of the device-memory governor: budget, per-tier live
+        and peak ledger bytes, and event counters. ``enabled`` is False
+        (and everything zero) unless ``fugue.jax.memory.budget_bytes`` or
+        ``.budget_fraction`` is configured."""
+        return self._memory.snapshot()
+
+    def note_device_oom(self, ex: BaseException) -> None:
+        """Called by the fault layer when a RESOURCE_EXHAUSTED slipped
+        past admission: feed the measured allocation size back into the
+        ledger (budget clamps to observed capacity, pressure is
+        relieved) before the reactive host-tier degrade runs."""
+        self._memory.note_oom(ex)
 
     @property
     def strategy_counts(self) -> Dict[str, int]:
@@ -675,10 +708,28 @@ class JaxExecutionEngine(ExecutionEngine):
 
     def _ingest_mesh(self, nbytes: int) -> Any:
         """Placement policy: which mesh a newly ingested frame lands on."""
-        if self._mesh_pinned or self._host_mesh is self._mesh:
-            return self._mesh
+        return self._place(nbytes)[0]
+
+    def _place(self, nbytes: int, admit: bool = True) -> Tuple[Any, str]:
+        """Placement + admission: the bandwidth policy picks the default
+        tier; the memory governor may redirect a device-tier newcomer
+        whose footprint alone exceeds the budget onto the host tier. The
+        returned tier label is LOGICAL — on single-mesh engines (CPU
+        tests, pinned meshes) both tiers share one mesh but the ledger
+        still governs them separately. ``admit=False`` is the
+        provisional, side-effect-free form for plan-time placement
+        (streamed loads re-place — and admit for real — at
+        materialization)."""
+        tier = self._default_tier(nbytes)
+        if admit:
+            tier = self._memory.admit(int(nbytes), tier)
+        return (self._host_mesh if tier == "host" else self._mesh), tier
+
+    def _default_tier(self, nbytes: int) -> str:
+        if self._mesh_pinned:
+            return "device"
         if getattr(self._tier_override, "mode", None) == "host":
-            return self._host_mesh
+            return "host"
         from fugue_tpu.constants import (
             FUGUE_CONF_JAX_MIN_DEVICE_BYTES,
             FUGUE_CONF_JAX_PLACEMENT,
@@ -686,13 +737,16 @@ class JaxExecutionEngine(ExecutionEngine):
 
         mode = str(self.conf.get(FUGUE_CONF_JAX_PLACEMENT, "auto")).lower()
         if mode == "device":
-            return self._mesh
+            return "device"
         if mode == "host":
-            return self._host_mesh
+            return "host"
+        if self._host_mesh is self._mesh:
+            # single physical tier: the transfer-cost threshold is moot
+            return "device"
         threshold = int(
             self.conf.get(FUGUE_CONF_JAX_MIN_DEVICE_BYTES, 256 * 1024 * 1024)
         )
-        return self._mesh if nbytes >= threshold else self._host_mesh
+        return "device" if nbytes >= threshold else "host"
 
     def _align_meshes(
         self, j1: JaxDataFrame, j2: JaxDataFrame
@@ -744,15 +798,16 @@ class JaxExecutionEngine(ExecutionEngine):
             assert_or_throw(
                 schema is None, ValueError("schema must be None for JaxDataFrame")
             )
+            # LRU recency for the governor's spill ordering: a frame
+            # flowing through an engine op is in active use
+            self._memory.touch(df._blocks)
             return df
         if isinstance(df, DataFrame):
             assert_or_throw(
                 schema is None, ValueError("schema must be None for DataFrame")
             )
             table = df.as_local_bounded().as_arrow(type_safe=True)
-            res = JaxDataFrame.from_table(
-                table, self._ingest_mesh(table.nbytes), df.schema
-            )
+            res = self._governed_frame(table, df.schema)
             if df.has_metadata:
                 res.reset_metadata(df.metadata)
             return res
@@ -762,9 +817,20 @@ class JaxExecutionEngine(ExecutionEngine):
             return self.load_yielded(df)  # type: ignore
         local = self._native.to_df(df, schema)
         table = local.as_arrow(type_safe=True)
-        return JaxDataFrame.from_table(
-            table, self._ingest_mesh(table.nbytes), local.schema
-        )
+        return self._governed_frame(table, local.schema)
+
+    def _governed_frame(self, table: pa.Table, schema: Schema) -> JaxDataFrame:
+        """Ingest entry point for host tables: placement + admission on
+        the dtype-widened device-footprint estimate, with the governor's
+        admission ticket attached so the lazy upload is gated (and its
+        real byte count registered) at materialization time."""
+        from fugue_tpu.jax_backend.memory import estimate_table_device_bytes
+
+        est = estimate_table_device_bytes(table)
+        mesh, tier = self._place(est)
+        res = JaxDataFrame.from_table(table, mesh, schema)
+        res._mem_gate = self._memory.gate(tier, est)
+        return res
 
     # ---- device-lowered column algebra ----------------------------------
     def select(
@@ -1031,6 +1097,10 @@ class JaxExecutionEngine(ExecutionEngine):
                             ]
                         ).sum()
                     )
+        if not jdf.is_pending:
+            # persisted frames are the spillable population of the memory
+            # governor's LRU (registered here if ingest didn't)
+            self._memory.mark_persisted(jdf.blocks)
         return jdf
 
     def zip(

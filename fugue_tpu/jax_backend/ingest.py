@@ -102,7 +102,9 @@ def try_stream_load(
                 return None
     assert arrow_schema is not None
     base_schema = arrow_schema
-    mesh = engine._ingest_mesh(est_bytes)
+    # provisional placement only (admit=False): the binding admission
+    # decision happens in load_blocks at materialization time
+    mesh = engine._place(est_bytes, admit=False)[0]
     nrows = total_rows
     from fugue_tpu.jax_backend.dataframe import JaxDataFrame
 
@@ -120,21 +122,27 @@ def try_stream_load(
         schema = Schema(a_schema)
 
         def load_blocks() -> B.JaxBlocks:
-            # re-consult placement at MATERIALIZATION time: under the
-            # fault layer's host-tier degrade override (thread-local,
-            # see JaxExecutionEngine.degraded_to_host) the streamed
-            # upload must re-place onto the host mesh even though the
-            # plan captured the device tier; the frame's mesh property
-            # follows the blocks once loaded
-            return _stream_to_blocks(
+            # re-consult placement AND admission at MATERIALIZATION time:
+            # under the fault layer's host-tier degrade override
+            # (thread-local, see JaxExecutionEngine.degraded_to_host) the
+            # streamed upload must re-place onto the host mesh even
+            # though the plan captured the device tier, and the memory
+            # governor's watermark/spill decision must see the ledger as
+            # it is NOW, not as it was at plan time
+            mesh_now, tier = engine._place(est_bytes)
+            gate = engine._memory.gate(tier, est_bytes)
+            gate.before()
+            loaded = _stream_to_blocks(
                 fs,
                 files,
                 schema,
-                engine._ingest_mesh(est_bytes),
+                mesh_now,
                 nrows,
                 batch_rows,
                 sel,
             )
+            gate.after(loaded)
+            return loaded
 
         def load_table() -> pa.Table:
             tables = []

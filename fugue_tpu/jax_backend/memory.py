@@ -1,0 +1,516 @@
+"""Proactive device-memory governance: HBM budget ledger, admission
+control, and LRU spill-to-host.
+
+PR 3 made the workflow layer survive a device OOM *after* it happens
+(classify ``RESOURCE_EXHAUSTED``, degrade the whole task to the host
+tier, retry). This module makes the jax engine avoid the crash in the
+first place, the way production dataframe/array runtimes govern memory
+(Spark's unified memory manager with storage eviction, Ray's
+object-store spilling):
+
+- **Byte ledger** (:class:`MemoryGovernor`): every ingested, persisted,
+  or checkpoint-loaded frame's device blocks are registered with their
+  REAL footprint (``sum(arr.nbytes)`` over :func:`blocks.residency_arrays`)
+  against a per-tier budget. Registration is weakref-based: a dropped
+  frame returns its budget the moment its blocks are collected — no
+  explicit free calls, no leak on exception paths.
+- **Admission control**: placement (``JaxExecutionEngine._place``) asks
+  the governor before a frame lands on the device tier. A newcomer whose
+  estimated footprint alone exceeds the budget is placed on the host
+  tier directly — XLA never gets the chance to throw.
+- **Watermark spill**: when an admission would push the device tier past
+  ``high_watermark * budget``, the governor first spills LRU *persisted*
+  frames to the host tier (their blocks are re-placed on the host mesh
+  IN PLACE, so every live reference follows) until usage falls to the
+  low watermark, then admits. Only persisted frames spill: transient
+  intermediates die with their task and return budget via weakref.
+- **OOM feedback**: a real ``RESOURCE_EXHAUSTED`` that still slips
+  through (engine under-estimate, foreign allocations in the same
+  process) feeds the measured allocation size back into the ledger —
+  the budget clamps to the observed capacity and pressure is relieved —
+  before PR 3's reactive degrade path runs.
+
+Conf keys (see ``constants.py``):
+
+- ``fugue.jax.memory.budget_bytes``: absolute device-tier budget
+  (0 = governance off, the default).
+- ``fugue.jax.memory.budget_fraction``: fraction of the detected
+  per-device memory (``device.memory_stats()['bytes_limit']``) summed
+  over the mesh; on backends without memory stats (CPU test meshes) a
+  2 GiB/device default applies so fraction-configured tests behave
+  deterministically.
+- ``fugue.jax.memory.high_watermark`` / ``.low_watermark``: admission
+  trigger and spill target as fractions of the budget.
+
+Every governance event is observable: ``engine.fallbacks`` counts
+``mem_admit_host`` / ``mem_pressure`` / ``mem_spill`` /
+``mem_oom_feedback`` (the strategy/fallback counter idiom), and
+``engine.memory_stats`` snapshots the full ledger; workflow runs copy
+the snapshot into ``FugueWorkflowResult.fault_stats["memory"]``.
+
+The ``device.alloc`` fault-injection site (:mod:`fugue_tpu.testing.faults`)
+fires in :meth:`MemoryGovernor.pre_alloc` with the placement tier as its
+key, so tests simulate a device allocation failure deterministically on
+CPU: a spec matching ``"device"`` raises on accelerator-tier staging and
+stays silent after the degrade override re-places onto the host tier.
+"""
+
+import re
+import threading
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import pyarrow as pa
+
+from fugue_tpu.constants import (
+    FUGUE_CONF_JAX_MEMORY_BUDGET_BYTES,
+    FUGUE_CONF_JAX_MEMORY_BUDGET_FRACTION,
+    FUGUE_CONF_JAX_MEMORY_HIGH_WATERMARK,
+    FUGUE_CONF_JAX_MEMORY_LOW_WATERMARK,
+)
+from fugue_tpu.jax_backend.blocks import (
+    JaxBlocks,
+    device_nbytes,
+    row_sharding,
+)
+from fugue_tpu.testing.faults import fault_point
+
+# CPU-backend default when the platform reports no memory stats: tests
+# configure budget_fraction against a deterministic synthetic capacity
+_DEFAULT_TIER_CAPACITY_PER_DEVICE = 2 * 1024 * 1024 * 1024
+
+_OOM_BYTES_RE = re.compile(r"(?:allocat\w*|of)\s+(\d+)\s*(?:bytes|B)\b", re.I)
+
+
+def detect_tier_capacity(mesh: Any) -> int:
+    """Total device-tier memory over the mesh: the sum of each device's
+    ``memory_stats()['bytes_limit']`` where the backend reports it
+    (TPU/GPU), else the synthetic CPU default per device."""
+    total = 0
+    ndev = 0
+    for d in mesh.devices.flat:
+        ndev += 1
+        limit = 0
+        try:
+            stats = d.memory_stats()
+            if stats:
+                limit = int(stats.get("bytes_limit", 0))
+        except Exception:  # pragma: no cover - backend w/o memory stats
+            limit = 0
+        total += (
+            limit if limit > 0 else _DEFAULT_TIER_CAPACITY_PER_DEVICE
+        )
+    return total if ndev > 0 else _DEFAULT_TIER_CAPACITY_PER_DEVICE
+
+
+def _field_device_width(tp: pa.DataType) -> int:
+    """Per-row device bytes of one column after ingest widening: strings
+    dictionary-encode to int32 codes, timestamps widen to int64
+    microseconds, date32 to int32 days, bool to one byte (arrow packs
+    bools 8/byte — an 8x widening), numerics keep their width."""
+    if pa.types.is_string(tp) or pa.types.is_large_string(tp):
+        return 4
+    if pa.types.is_timestamp(tp):
+        return 8
+    if pa.types.is_date32(tp):
+        return 4
+    if pa.types.is_boolean(tp):
+        return 1
+    if pa.types.is_integer(tp) or pa.types.is_floating(tp):
+        return tp.bit_width // 8
+    return 0  # nested/binary/decimal stay host arrow columns
+
+
+def estimate_table_device_bytes(table: pa.Table) -> int:
+    """Estimated device footprint of ingesting ``table``: per-column
+    dtype-widened row widths plus a one-byte validity mask for columns
+    that actually carry nulls. A superset-ish bound over the real
+    ``device_nbytes`` (exact up to mesh padding), cheap enough to run on
+    every admission decision."""
+    n = table.num_rows
+    total = 0
+    for i, field in enumerate(table.schema):
+        w = _field_device_width(field.type)
+        if w == 0:
+            continue
+        total += n * w
+        if table.column(i).null_count > 0:
+            total += n  # bool validity mask
+    return total
+
+
+def move_blocks_to_mesh(blocks: JaxBlocks, mesh: Any) -> bool:
+    """Re-place a frame's device arrays onto ``mesh`` IN PLACE (columns
+    are shared across derived frames, so every live reference follows
+    the move). Returns False when the move is not representable (row
+    padding not divisible by the target mesh); when source and target
+    mesh are the same object the move is ledger-only.
+
+    The spiller also moves every REGISTERED sibling sharing a column so
+    ledger tiers and mesh labels stay consistent; an unregistered
+    transient frame derived from a spilled one keeps a stale mesh label
+    on a real two-tier engine and may pay one implicit transfer on its
+    next op — registered (ingested/persisted) frames never do."""
+    if blocks.mesh is mesh:
+        return True
+    ndev = int(mesh.devices.size)
+    for col in blocks.columns.values():
+        if col.on_device and int(col.data.shape[0]) % ndev != 0:
+            return False
+    sharding = row_sharding(mesh)
+    for col in blocks.columns.values():
+        if not col.on_device:
+            continue
+        col.data = jax.device_put(col.data, sharding)
+        if col.mask is not None:
+            col.mask = jax.device_put(col.mask, sharding)
+    if blocks.row_valid is not None:
+        blocks.row_valid = jax.device_put(blocks.row_valid, sharding)
+    if blocks._nrows_dev is not None:
+        blocks._nrows_dev = jax.device_put(
+            blocks._nrows_dev, mesh.devices.flat[0]
+        )
+    blocks.mesh = mesh
+    # cached factorizations hold old-mesh arrays
+    blocks.factorize_cache.clear()
+    return True
+
+
+def parse_oom_bytes(text: str) -> int:
+    """Requested allocation size out of an XLA RESOURCE_EXHAUSTED message
+    (``... while trying to allocate 123456 bytes ...``), 0 if absent."""
+    m = _OOM_BYTES_RE.search(text)
+    return int(m.group(1)) if m else 0
+
+
+class _LedgerEntry:
+    __slots__ = ("ref", "tier", "nbytes", "seq", "spillable")
+
+    def __init__(
+        self, ref: Any, tier: str, nbytes: int, seq: int, spillable: bool
+    ):
+        self.ref = ref
+        self.tier = tier
+        self.nbytes = nbytes
+        self.seq = seq
+        self.spillable = spillable
+
+
+class AllocationGate:
+    """One admission ticket for one frame materialization: ``before()``
+    runs right before the device arrays are allocated (watermark spill +
+    the ``device.alloc`` fault site), ``after(blocks)`` registers the
+    REAL footprint in the ledger. Attached by the engine to pending
+    frames (``JaxDataFrame._mem_gate``) so lazy ingest pays admission at
+    materialization time, when the ledger state is current."""
+
+    __slots__ = ("_gov", "tier", "est")
+
+    def __init__(self, gov: "MemoryGovernor", tier: str, est: int):
+        self._gov = gov
+        self.tier = tier
+        self.est = est
+
+    def before(self) -> None:
+        self._gov.pre_alloc(self.tier, self.est)
+
+    def after(self, blocks: JaxBlocks) -> None:
+        self._gov.register(blocks, self.tier)
+
+
+class MemoryGovernor:
+    """Per-engine byte ledger + admission controller + LRU spiller.
+
+    Owned by :class:`JaxExecutionEngine`; reads conf lazily at first use
+    so engines constructed before conf settles still govern correctly.
+    Disabled (the default: no budget configured) every method is a cheap
+    no-op except :meth:`pre_alloc`, which always runs the
+    ``device.alloc`` fault site so OOM-injection tests work ungoverned.
+    """
+
+    def __init__(self, engine: Any):
+        self._engine = engine
+        self._lock = threading.RLock()
+        self._entries: Dict[int, _LedgerEntry] = {}
+        self._seq = 0
+        self._resolved = False
+        self._budget = 0
+        self._high = 0.9
+        self._low = 0.75
+        self._tier_bytes: Dict[str, int] = {"device": 0, "host": 0}
+        self._tier_peak: Dict[str, int] = {"device": 0, "host": 0}
+        self.counters: Dict[str, int] = {
+            "admissions_device": 0,
+            "admissions_host": 0,
+            "pressure_events": 0,
+            "spills": 0,
+            "spilled_bytes": 0,
+            "oom_feedback": 0,
+            "overcommit": 0,
+        }
+
+    # ---- configuration ---------------------------------------------------
+    def _resolve(self) -> None:
+        if self._resolved:
+            return
+        conf = self._engine.conf
+        budget = int(conf.get(FUGUE_CONF_JAX_MEMORY_BUDGET_BYTES, 0))
+        if budget <= 0:
+            frac = float(
+                conf.get(FUGUE_CONF_JAX_MEMORY_BUDGET_FRACTION, 0.0)
+            )
+            if frac > 0:
+                budget = int(frac * detect_tier_capacity(self._engine.mesh))
+        self._budget = max(0, budget)
+        high = float(conf.get(FUGUE_CONF_JAX_MEMORY_HIGH_WATERMARK, 0.9))
+        low = float(conf.get(FUGUE_CONF_JAX_MEMORY_LOW_WATERMARK, 0.75))
+        self._high = min(max(high, 0.0), 1.0)
+        self._low = min(max(low, 0.0), self._high)
+        self._resolved = True
+
+    @property
+    def enabled(self) -> bool:
+        self._resolve()
+        return self._budget > 0
+
+    @property
+    def budget_bytes(self) -> int:
+        self._resolve()
+        return self._budget
+
+    def _count(self, name: str, detail: str = "") -> None:
+        counter = getattr(self._engine, "_count_memory_event", None)
+        if counter is not None:
+            counter(name, detail)
+
+    def _next_seq(self) -> int:
+        self._seq += 1
+        return self._seq
+
+    # ---- admission -------------------------------------------------------
+    def gate(self, tier: str, est: int) -> AllocationGate:
+        return AllocationGate(self, tier, max(0, int(est)))
+
+    def admit(self, est: int, default_tier: str) -> str:
+        """The admission decision for a new frame of estimated footprint
+        ``est`` whose placement policy chose ``default_tier``: a
+        newcomer that alone exceeds the whole budget goes to the host
+        tier directly instead of ever letting XLA throw."""
+        if default_tier != "device" or not self.enabled:
+            return default_tier
+        with self._lock:
+            if est > self._budget:
+                self.counters["admissions_host"] += 1
+                self._count(
+                    "mem_admit_host",
+                    f"{est}B exceeds budget {self._budget}B",
+                )
+                return "host"
+            self.counters["admissions_device"] += 1
+        return "device"
+
+    def pre_alloc(self, tier: str, est: int) -> None:
+        """Right before device arrays are allocated for an admitted
+        frame: run the ``device.alloc`` fault site (keyed by tier), then
+        spill LRU persisted frames down to the low watermark if this
+        allocation would cross the high watermark."""
+        fault_point("device.alloc", tier)
+        if tier != "device" or not self.enabled:
+            return
+        with self._lock:
+            high = self._high * self._budget
+            if self._tier_bytes["device"] + est <= high:
+                return
+            self.counters["pressure_events"] += 1
+            self._count(
+                "mem_pressure",
+                f"{self._tier_bytes['device'] + est}B > "
+                f"high watermark {int(high)}B",
+            )
+            target = max(self._low * self._budget - est, 0.0)
+            self._spill_down_to_locked(target)
+            if self._tier_bytes["device"] + est > self._budget:
+                # nothing left to spill: the allocation proceeds anyway
+                # (the reactive OOM path still backstops it) but the
+                # overcommit is on the record
+                self.counters["overcommit"] += 1
+
+    # ---- ledger ----------------------------------------------------------
+    def register(
+        self, blocks: JaxBlocks, tier: str, persisted: bool = False
+    ) -> None:
+        """Enter a frame's blocks into the ledger with their REAL device
+        footprint. Idempotent: re-registering refreshes recency, the
+        persisted flag, and the byte count."""
+        if not self.enabled:
+            return
+        nbytes = device_nbytes(blocks)
+        key = id(blocks)
+        with self._lock:
+            existing = self._entries.get(key)
+            if existing is not None and existing.ref() is blocks:
+                existing.seq = self._next_seq()
+                existing.spillable = existing.spillable or persisted
+                if existing.nbytes != nbytes:
+                    self._tier_bytes[existing.tier] += (
+                        nbytes - existing.nbytes
+                    )
+                    existing.nbytes = nbytes
+                    self._bump_peak(existing.tier)
+                return
+            entry = _LedgerEntry(
+                weakref.ref(blocks), tier, nbytes, self._next_seq(),
+                persisted,
+            )
+            self._entries[key] = entry
+            self._tier_bytes[tier] += nbytes
+            self._bump_peak(tier)
+        weakref.finalize(blocks, self._release, key, entry)
+
+    def _bump_peak(self, tier: str) -> None:
+        if self._tier_bytes[tier] > self._tier_peak[tier]:
+            self._tier_peak[tier] = self._tier_bytes[tier]
+
+    def _release(self, key: int, entry: _LedgerEntry) -> None:
+        """Weakref finalizer: a collected frame returns its budget."""
+        with self._lock:
+            if self._entries.get(key) is entry:
+                del self._entries[key]
+                self._tier_bytes[entry.tier] -= entry.nbytes
+
+    def touch(self, blocks: Optional[JaxBlocks]) -> None:
+        """LRU recency update for a frame flowing through an engine op."""
+        if blocks is None or not self.enabled:
+            return
+        with self._lock:
+            e = self._entries.get(id(blocks))
+            if e is not None and e.ref() is blocks:
+                e.seq = self._next_seq()
+
+    def mark_persisted(self, blocks: JaxBlocks) -> None:
+        """A persisted frame is pinned in memory by the user on purpose —
+        exactly the population the LRU spiller may move to the host tier
+        under pressure. Registers the blocks if ingest didn't."""
+        if not self.enabled:
+            return
+        with self._lock:
+            e = self._entries.get(id(blocks))
+            if e is not None and e.ref() is blocks:
+                e.spillable = True
+                e.seq = self._next_seq()
+                return
+        self.register(blocks, self._infer_tier(blocks), persisted=True)
+
+    def _infer_tier(self, blocks: JaxBlocks) -> str:
+        host = getattr(self._engine, "host_mesh", None)
+        dev = getattr(self._engine, "mesh", None)
+        if host is not None and host is not dev and blocks.mesh is host:
+            return "host"
+        return "device"
+
+    def tier_of(self, blocks: JaxBlocks) -> Optional[str]:
+        """The ledger tier of a registered frame's blocks, or None."""
+        with self._lock:
+            e = self._entries.get(id(blocks))
+            return e.tier if e is not None and e.ref() is blocks else None
+
+    def ledger_entries(self) -> List[Tuple[str, int, bool]]:
+        """Debug/testing view: (tier, nbytes, spillable) per live entry."""
+        with self._lock:
+            return [
+                (e.tier, e.nbytes, e.spillable)
+                for e in self._entries.values()
+                if e.ref() is not None
+            ]
+
+    # ---- spill -----------------------------------------------------------
+    def _spill_down_to_locked(self, target_bytes: float) -> None:
+        """Spill LRU persisted device-tier frames until device usage is
+        at or below ``target_bytes`` (or nothing spillable remains).
+        Caller holds the lock."""
+        victims = sorted(
+            (
+                e
+                for e in self._entries.values()
+                if e.tier == "device" and e.spillable
+            ),
+            key=lambda e: e.seq,
+        )
+        host_mesh = getattr(self._engine, "host_mesh", None)
+        for v in victims:
+            if self._tier_bytes["device"] <= target_bytes:
+                break
+            blocks = v.ref()
+            if blocks is None:
+                continue  # finalizer will reclaim; skip
+            if host_mesh is None or not move_blocks_to_mesh(
+                blocks, host_mesh
+            ):
+                continue
+            self._move_entry_locked(v, "host")
+            self.counters["spills"] += 1
+            self.counters["spilled_bytes"] += v.nbytes
+            self._count("mem_spill", f"{v.nbytes}B to host tier")
+            # derived frames SHARE JaxColumn objects with their source
+            # (select/rename/filter build new JaxBlocks over the same
+            # columns): their arrays just moved with the spill, so move
+            # their remaining arrays (row_valid), mesh label and ledger
+            # bytes too — otherwise a sibling keeps a stale device-mesh
+            # label over host-resident data and the device tier
+            # over-reports forever
+            vcols = {id(c) for c in blocks.columns.values()}
+            for e in self._entries.values():
+                if e is v or e.tier != "device":
+                    continue
+                sib = e.ref()
+                if sib is None or not any(
+                    id(c) in vcols for c in sib.columns.values()
+                ):
+                    continue
+                if move_blocks_to_mesh(sib, host_mesh):
+                    self._move_entry_locked(e, "host")
+
+    def _move_entry_locked(self, entry: _LedgerEntry, tier: str) -> None:
+        if entry.tier == tier:
+            return
+        self._tier_bytes[entry.tier] -= entry.nbytes
+        self._tier_bytes[tier] += entry.nbytes
+        entry.tier = tier
+        self._bump_peak(tier)
+
+    # ---- OOM feedback ----------------------------------------------------
+    def note_oom(self, ex: BaseException) -> None:
+        """A real RESOURCE_EXHAUSTED reached the fault layer: clamp the
+        budget to the observed capacity (ledger bytes + the failed
+        request) and relieve pressure, so the ledger learns what the
+        estimate missed before the reactive degrade/retry re-runs."""
+        measured = parse_oom_bytes(str(ex))
+        with self._lock:
+            self.counters["oom_feedback"] += 1
+            self._count(
+                "mem_oom_feedback", f"measured {measured}B" if measured else ""
+            )
+            if not self.enabled:
+                return
+            observed = self._tier_bytes["device"] + measured
+            if 0 < observed < self._budget:
+                self._budget = observed
+            self._spill_down_to_locked(self._low * self._budget)
+
+    # ---- observability ---------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        self._resolve()
+        with self._lock:
+            return {
+                "enabled": self._budget > 0,
+                "budget_bytes": self._budget,
+                "high_watermark": self._high,
+                "low_watermark": self._low,
+                "tiers": dict(self._tier_bytes),
+                "peak": dict(self._tier_peak),
+                "counters": dict(self.counters),
+                "live_frames": sum(
+                    1 for e in self._entries.values() if e.ref() is not None
+                ),
+            }

@@ -163,6 +163,20 @@ def stream_aggregate(
     update_cache: Dict[int, Any] = {}
 
     def _make_init(total: int) -> Dict[str, jnp.ndarray]:
+        gov = getattr(engine, "_memory", None)
+        if gov is not None:
+            # accumulator (re)allocation goes through the governor's
+            # pre-alloc gate: watermark spill may run first, and the
+            # device.alloc fault site makes streaming accumulator OOM
+            # deterministically testable. Upper bound: 8B per slot per
+            # accumulator vector (count + up to 2 per plan). The tier
+            # key honors the fault layer's host-degrade override so a
+            # degraded re-run no longer matches a "device" fault spec.
+            override = getattr(
+                getattr(engine, "_tier_override", None), "mode", None
+            )
+            tier = "host" if override == "host" else "device"
+            gov.pre_alloc(tier, total * 8 * (1 + 2 * len(plans)))
         accs: Dict[str, jnp.ndarray] = {
             "_count": jnp.zeros((total,), jnp.int64)
         }

@@ -13,10 +13,19 @@ Conf keys (parity with ``fugue.rpc.flask_server.*``):
 - ``fugue.rpc.http_server.host`` (default ``127.0.0.1``)
 - ``fugue.rpc.http_server.port`` (default ``0`` = ephemeral)
 - ``fugue.rpc.http_server.timeout`` seconds (default ``30``)
+- ``fugue.rpc.http_server.retries`` (default ``2``): bounded
+  exponential-backoff retries on TRANSIENT transport failures only —
+  connection refused/reset and HTTP 503 (the classifier in
+  ``workflow/fault.py`` decides); any other HTTP error and every
+  server-side handler error fail fast.
 """
 
+import logging
 import pickle
+import random
 import threading
+import time
+import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
@@ -29,9 +38,31 @@ from fugue_tpu.rpc.base import (
 
 __all__ = ["HTTPRPCServer", "HTTPRPCClient"]
 
+_LOG = logging.getLogger("fugue_tpu.rpc")
+
 _CONF_HOST = "fugue.rpc.http_server.host"
 _CONF_PORT = "fugue.rpc.http_server.port"
 _CONF_TIMEOUT = "fugue.rpc.http_server.timeout"
+_CONF_RETRIES = "fugue.rpc.http_server.retries"
+
+# HTTP statuses that mark a transient server condition worth retrying;
+# everything else (404, 500 handler bugs, ...) is deterministic
+_RETRYABLE_HTTP = (503,)
+
+
+def _is_transient_transport_error(ex: BaseException) -> bool:
+    """Transient-vs-deterministic triage for one RPC transport failure,
+    reusing the workflow fault classifier for the OS/socket layer."""
+    from fugue_tpu.workflow.fault import TRANSIENT, classify_error
+
+    if isinstance(ex, urllib.error.HTTPError):
+        return ex.code in _RETRYABLE_HTTP
+    if isinstance(ex, urllib.error.URLError):
+        reason = ex.reason
+        if isinstance(reason, BaseException):
+            return classify_error(reason) == TRANSIENT
+        return True  # bare-string reason: treat as a transport hiccup
+    return classify_error(ex) == TRANSIENT
 
 
 class _RPCRequestHandler(BaseHTTPRequestHandler):
@@ -56,16 +87,50 @@ class _RPCRequestHandler(BaseHTTPRequestHandler):
 
 
 class HTTPRPCClient(RPCClient):
-    """Picklable: carries only the address and handler key."""
+    """Picklable: carries only the address, handler key and retry
+    budget. Transport failures (connection refused/reset, HTTP 503)
+    retry with bounded exponential backoff + jitter; deterministic
+    failures — other HTTP statuses and handler errors relayed by the
+    driver — fail fast on the first attempt.
 
-    def __init__(self, host: str, port: int, key: str, timeout: float):
+    Retries give AT-LEAST-ONCE delivery: a connection that resets after
+    the request was sent may replay a handler that already ran.
+    Handlers should be idempotent — the same contract the task-level
+    retry layer (``fugue.workflow.retry.*``) already imposes on
+    callbacks; set ``fugue.rpc.http_server.retries=0`` for handlers
+    where a duplicate side effect is worse than a failed call."""
+
+    def __init__(
+        self, host: str, port: int, key: str, timeout: float,
+        retries: int = 2,
+    ):
         self._host = host
         self._port = port
         self._key = key
         self._timeout = timeout
+        self._retries = max(0, int(retries))
 
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
         body = pickle.dumps((self._key, args, kwargs))
+        rng = random.Random()
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                return self._call_once(body)
+            except Exception as ex:
+                if attempt > self._retries or not _is_transient_transport_error(
+                    ex
+                ):
+                    raise
+                delay = 0.05 * (2 ** (attempt - 1)) * (1.0 + rng.random() * 0.1)
+                _LOG.info(
+                    "fugue_tpu rpc retry %d/%d after %s: %s",
+                    attempt, self._retries, type(ex).__name__, ex,
+                )
+                time.sleep(min(delay, 2.0))
+
+    def _call_once(self, body: bytes) -> Any:
         req = urllib.request.Request(
             f"http://{self._host}:{self._port}/", data=body, method="POST"
         )
@@ -104,18 +169,32 @@ class HTTPRPCServer(RPCServer):
         self._thread.start()
 
     def stop_server(self) -> None:
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        """Idempotent shutdown: safe to call repeatedly; a serve thread
+        that outlives its join timeout is reported (and retried by a
+        later call) instead of silently leaked."""
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5)
+            if thread.is_alive():
+                _LOG.warning(
+                    "fugue_tpu rpc: HTTP server thread did not stop "
+                    "within 5s; shutdown is wedged (daemon thread will "
+                    "not block interpreter exit)"
+                )
+            else:
+                self._thread = None
 
     def make_client(self, handler: Any) -> RPCClient:
         key = self.register(handler)
         host, port = self.address
-        return HTTPRPCClient(host, port, key, self._timeout)
+        return HTTPRPCClient(
+            host, port, key, self._timeout,
+            retries=int(self.conf.get(_CONF_RETRIES, 2)),
+        )
 
 
 register_rpc_server("http", lambda conf: HTTPRPCServer(conf))

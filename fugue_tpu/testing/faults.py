@@ -1,9 +1,19 @@
 """Deterministic fault-injection harness.
 
 Production code is sprinkled with zero-cost *fault points* — named sites
-(`"fs.open"`, `"fs.write"`, `"task"`, `"rpc"`) that consult the active
-:class:`FaultPlan` and raise the planned error when a site/key/invocation
-matches. No plan active (the normal case) is a single ``None`` check.
+(`"fs.open"`, `"fs.write"`, `"task"`, `"rpc"`, `"device.alloc"`) that
+consult the active :class:`FaultPlan` and raise the planned error when a
+site/key/invocation matches. No plan active (the normal case) is a
+single ``None`` check.
+
+The ``device.alloc`` site fires in the memory governor's pre-allocation
+gate (jax_backend/memory.py) with the placement TIER as its key, right
+before a frame's device arrays are staged. A spec matching ``"device"``
+with a :func:`resource_exhausted` error simulates an accelerator
+allocation failure deterministically on CPU — and stays silent once the
+degrade override re-places the retry onto the host tier — so every
+governance path (admission, spill, OOM feedback, host degrade) is
+testable without real HBM pressure.
 
 A plan is a list of :class:`FaultSpec` rules. Each rule matches a site
 and a key glob (the URI for fs sites, the task display name for task
@@ -41,6 +51,27 @@ from contextlib import contextmanager
 from typing import Any, Callable, Dict, Iterator, List, Optional, Union
 
 _ErrorLike = Union[BaseException, Callable[[], BaseException], type]
+
+
+class _InjectedXlaRuntimeError(Exception):
+    """Stand-in for jaxlib's XlaRuntimeError in injected device faults.
+    The classifier (workflow/fault.py) keys on the class NAME plus the
+    RESOURCE_EXHAUSTED token, so renaming the class makes an injected
+    instance triage exactly like the real thing."""
+
+
+_InjectedXlaRuntimeError.__name__ = "XlaRuntimeError"
+_InjectedXlaRuntimeError.__qualname__ = "XlaRuntimeError"
+
+
+def resource_exhausted(nbytes: int = 0) -> BaseException:
+    """An injectable device-OOM error for ``device.alloc`` fault specs:
+    classifies as OOM and carries a parseable allocation size so the
+    memory governor's OOM feedback path sees a measured request."""
+    return _InjectedXlaRuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+        f"{int(nbytes)} bytes."
+    )
 
 
 class FaultSpec:

@@ -224,6 +224,12 @@ class RunStats:
         self.recoveries: dict = {}
         self.degradations: dict = {}
         self.resumed: list = []
+        # manifest artifacts that failed size/sha256 verification on
+        # resume and were recomputed instead of loaded
+        self.integrity_rejected: dict = {}
+        # snapshot of the jax engine's memory-governance ledger at run
+        # end (empty for ungoverned engines)
+        self.memory: dict = {}
 
     def _bump(self, d: dict, key: str) -> None:
         with self._lock:
@@ -238,9 +244,16 @@ class RunStats:
     def note_degradation(self, name: str) -> None:
         self._bump(self.degradations, name)
 
+    def note_integrity_rejected(self, name: str) -> None:
+        self._bump(self.integrity_rejected, name)
+
     def note_resumed(self, name: str) -> None:
         with self._lock:
             self.resumed.append(name)
+
+    def set_memory(self, snapshot: dict) -> None:
+        with self._lock:
+            self.memory = dict(snapshot)
 
     def as_dict(self) -> dict:
         with self._lock:
@@ -249,6 +262,8 @@ class RunStats:
                 "recoveries": dict(self.recoveries),
                 "degradations": dict(self.degradations),
                 "resumed": list(self.resumed),
+                "integrity_rejected": dict(self.integrity_rejected),
+                "memory": dict(self.memory),
             }
 
 
@@ -295,6 +310,16 @@ def execute_with_policy(
         except Exception as ex:
             cls = classify_error(ex, policy.retry_on)
             if cls == OOM:
+                # feed the measured allocation size back into the memory
+                # governor's ledger FIRST: the budget clamps to observed
+                # capacity and pressure is relieved, so the degraded
+                # re-run (and later admissions) see the truth
+                noter = getattr(engine, "note_device_oom", None)
+                if noter is not None:
+                    try:
+                        noter(ex)
+                    except Exception:  # pragma: no cover - best effort
+                        pass
                 degraded = _try_degrade(
                     fn, engine, token, task_name, stats, log, ex
                 )

@@ -5,7 +5,8 @@ task completion atomically rewrites a small JSON manifest under the
 checkpoint dir, keyed by the workflow's deterministic uuid::
 
     <checkpoint.path>/manifest_<workflow_uuid>.json
-    {"workflow": "...", "completed": {task_uuid: {name, artifact, fmt}}}
+    {"workflow": "...", "completed":
+        {task_uuid: {name, artifact, fmt, size, sha256}}}
 
 The manifest is crash-durable — a run killed mid-flight leaves it behind.
 Re-running the IDENTICAL DAG (same workflow uuid — the task-uuid
@@ -18,16 +19,62 @@ deterministically-checkpointed tasks (their files are permanent);
 completed tasks without a durable artifact are recorded for
 observability but re-execute. A fully successful run deletes its
 manifest — resume state never outlives the failure it serves.
+
+**Artifact integrity**: each completion records the artifact's byte size
+and sha256. ``can_resume`` recomputes the fingerprint before serving a
+checkpoint hit — a truncated or corrupted artifact (a crash mid-write
+outside the atomic path, bit rot on remote storage) is treated as
+INCOMPLETE: the stale file is removed so the deterministic checkpoint
+recomputes instead of loading garbage, and the rejection is counted in
+``fault_stats["integrity_rejected"]``.
 """
 
+import hashlib
 import json
 import threading
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 from fugue_tpu.constants import (
     FUGUE_CONF_WORKFLOW_CHECKPOINT_PATH,
     FUGUE_CONF_WORKFLOW_RESUME,
 )
+
+
+_FINGERPRINT_CHUNK = 4 * 1024 * 1024
+
+
+def artifact_fingerprint(fs: Any, uri: str) -> Tuple[int, str]:
+    """(total bytes, sha256 hexdigest) of a checkpoint artifact — a
+    single file, or a part-file directory hashed as sorted
+    (relative name, size, bytes) records so the digest is layout-stable.
+    Dot/underscore-prefixed entries (atomic temps, markers) are skipped,
+    matching what the readers consume. Files hash in streamed chunks:
+    constant memory regardless of artifact size (this runs on the
+    SUCCESS path of every completed task, not just on resume)."""
+    h = hashlib.sha256()
+    total = 0
+
+    def _walk(path: str, rel: str) -> None:
+        nonlocal total
+        if fs.isdir(path):
+            for name in sorted(fs.listdir(path)):
+                if name.startswith(".") or name.startswith("_"):
+                    continue
+                _walk(fs.join(path, name), f"{rel}/{name}" if rel else name)
+            return
+        h.update(rel.encode("utf-8"))
+        size = fs.file_size(path)
+        h.update(int(size).to_bytes(8, "little"))
+        total += size
+        with fs.open_input_stream(path) as fp:
+            while True:
+                chunk = fp.read(_FINGERPRINT_CHUNK)
+                if not chunk:
+                    break
+                h.update(chunk)
+
+    _walk(uri, "")
+    return total, h.hexdigest()
 
 
 class RunManifest:
@@ -88,33 +135,74 @@ class RunManifest:
             return
         self._resumable = dict(data.get("completed", {}))
 
-    def can_resume(self, task: Any, ctx: Any) -> bool:
+    def can_resume(self, task: Any, ctx: Any, stats: Any = None) -> bool:
         """True when the prior run completed this task AND its durable
-        artifact still exists. The caller then runs the task's NORMAL
-        execute path — validation rules still fire (they are workflow
-        declarations, not data checks — see ProcessTask.execute) and the
-        deterministic checkpoint's ``try_load`` serves the artifact, so
-        resume adds no second load path to keep consistent."""
+        artifact still exists and verifies against the recorded
+        size/sha256. The caller then runs the task's NORMAL execute path
+        — validation rules still fire (they are workflow declarations,
+        not data checks — see ProcessTask.execute) and the deterministic
+        checkpoint's ``try_load`` serves the artifact, so resume adds no
+        second load path to keep consistent. A corrupted artifact is
+        REMOVED so the checkpoint recomputes instead of loading it."""
         rec = self._resumable.get(task.__uuid__())
         if rec is None:
             return False
         uri = rec.get("artifact")
         if not uri:
             return False
+        fs = ctx.engine.fs
         try:
-            return bool(ctx.engine.fs.exists(uri))
+            if not fs.exists(uri):
+                return False
+            want_sha = rec.get("sha256")
+            if want_sha:
+                size, digest = artifact_fingerprint(fs, uri)
+                want_size = rec.get("size")
+                if digest != want_sha or (
+                    want_size is not None and size != want_size
+                ):
+                    self._engine.log.warning(
+                        "fugue_tpu resume: artifact %s failed integrity "
+                        "check (size %s vs %s); recomputing task %s",
+                        uri, size, want_size, rec.get("name", "?"),
+                    )
+                    if stats is not None:
+                        stats.note_integrity_rejected(task.name)
+                    try:
+                        fs.rm(uri, recursive=True)
+                    except Exception:  # pragma: no cover - best effort
+                        pass
+                    return False
         except Exception:  # pragma: no cover - fs probe failure
             return False
+        return True
 
     def mark_complete(self, task: Any) -> None:
         """Record a finished task and atomically rewrite the manifest —
         the incremental write is what makes resume survive a hard kill,
         not just a graceful failure."""
         ckpt = task.checkpoint
+        artifact = ckpt.artifact_uri(self._ckpt)
+        size: Optional[int] = None
+        sha256: Optional[str] = None
+        if artifact:
+            # fingerprint OUTSIDE the lock (reads the whole artifact);
+            # best-effort — a missing fingerprint just skips verification
+            try:
+                size, sha256 = artifact_fingerprint(
+                    self._engine.fs, artifact
+                )
+            except Exception:  # pragma: no cover - storage hiccup
+                self._engine.log.warning(
+                    "fugue_tpu resume: could not fingerprint artifact %s",
+                    artifact,
+                )
         rec = {
             "name": task.name,
-            "artifact": ckpt.artifact_uri(self._ckpt),
+            "artifact": artifact,
             "fmt": ckpt.fmt,
+            "size": size,
+            "sha256": sha256,
         }
         with self._lock:
             # write under the lock: concurrent completions must not land

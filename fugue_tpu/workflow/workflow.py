@@ -805,6 +805,11 @@ class FugueWorkflow:
             self._computed = True
             if manifest is not None:
                 manifest.finish()
+            # governed jax engines: the memory ledger snapshot rides the
+            # run's fault stats (same surface as retries/degradations)
+            mem = getattr(e, "memory_stats", None)
+            if isinstance(mem, dict) and mem.get("enabled"):
+                stats.set_memory(mem)
         finally:
             if in_ctx:
                 e.stop_context()
@@ -842,7 +847,9 @@ class FugueWorkflow:
                 # manifest resume is OBSERVED here but served by the
                 # task's own checkpoint short-circuit inside execute():
                 # validations still fire and there is only one load path
-                if manifest is not None and manifest.can_resume(task, ctx):
+                if manifest is not None and manifest.can_resume(
+                    task, ctx, stats=stats
+                ):
                     stats.note_resumed(task.name)
                 return execute_with_policy(
                     lambda: attempt(inputs),

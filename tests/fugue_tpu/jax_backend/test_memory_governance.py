@@ -1,0 +1,369 @@
+"""Device-memory governance: the HBM budget ledger, admission control
+and LRU spill-to-host (jax_backend/memory.py). Tier-1 compatible; select
+with ``-m memory``."""
+
+import gc
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fugue_tpu.constants import (
+    FUGUE_CONF_JAX_MEMORY_BUDGET_BYTES,
+    FUGUE_CONF_JAX_MEMORY_BUDGET_FRACTION,
+    FUGUE_CONF_JAX_MEMORY_HIGH_WATERMARK,
+    FUGUE_CONF_JAX_MEMORY_LOW_WATERMARK,
+)
+from fugue_tpu.jax_backend.blocks import device_nbytes, residency_arrays
+from fugue_tpu.jax_backend.execution_engine import JaxExecutionEngine
+from fugue_tpu.jax_backend.memory import (
+    estimate_table_device_bytes,
+    parse_oom_bytes,
+)
+
+pytestmark = pytest.mark.memory
+
+
+def _frame(n=2000, seed=0):
+    """Two 8-byte columns, n divisible by the 8-device test mesh: exactly
+    16n device bytes, no masks — deterministic ledger arithmetic."""
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame(
+        {
+            "x": rng.integers(0, 100, n).astype(np.int64),
+            "y": rng.random(n),
+        }
+    )
+
+
+def _engine(budget, **extra):
+    return JaxExecutionEngine(
+        {FUGUE_CONF_JAX_MEMORY_BUDGET_BYTES: budget, **extra}
+    )
+
+
+# ---------------------------------------------------------------------------
+# ledger: registration parity + weakref release
+# ---------------------------------------------------------------------------
+def test_ledger_parity_with_actual_array_nbytes():
+    e = _engine(10_000_000)
+    try:
+        jdf = e.to_df(_frame())
+        blocks = jdf.blocks  # materialize under the gate
+        actual = sum(int(a.nbytes) for a in residency_arrays(blocks))
+        assert actual == device_nbytes(blocks) == 2000 * 16
+        assert e.memory_stats["tiers"]["device"] == actual
+        # a frame with nulls registers its masks too
+        pdf = _frame(seed=1)
+        pdf.loc[::3, "y"] = None
+        j2 = e.to_df(pdf)
+        with_mask = device_nbytes(j2.blocks)  # materializes under the gate
+        assert with_mask == 2000 * 16 + 2000  # + bool mask
+        assert e.memory_stats["tiers"]["device"] == actual + with_mask
+    finally:
+        e.stop()
+
+
+def test_weakref_release_returns_budget_on_frame_drop():
+    e = _engine(10_000_000)
+    try:
+        jdf = e.to_df(_frame())
+        jdf.blocks  # materialize; no extra reference kept
+        assert e.memory_stats["tiers"]["device"] == 32000
+        assert e.memory_stats["live_frames"] == 1
+        del jdf
+        gc.collect()
+        stats = e.memory_stats
+        assert stats["tiers"]["device"] == 0
+        assert stats["live_frames"] == 0
+        # peak survives the release (bench reports it)
+        assert stats["peak"]["device"] == 32000
+    finally:
+        e.stop()
+
+
+def test_disabled_by_default_and_zero_ledger():
+    e = JaxExecutionEngine()
+    try:
+        jdf = e.to_df(_frame())
+        _ = jdf.blocks
+        stats = e.memory_stats
+        assert stats["enabled"] is False
+        assert stats["tiers"] == {"device": 0, "host": 0}
+        assert "mem_pressure" not in e.fallbacks
+    finally:
+        e.stop()
+
+
+def test_budget_fraction_resolves_on_cpu_default_capacity():
+    e = JaxExecutionEngine({FUGUE_CONF_JAX_MEMORY_BUDGET_FRACTION: 0.5})
+    try:
+        stats = e.memory_stats
+        assert stats["enabled"] is True
+        # 8 virtual CPU devices x 2GiB synthetic capacity, halved
+        assert stats["budget_bytes"] == 8 * 2 * 1024**3 // 2
+    finally:
+        e.stop()
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+def test_oversized_newcomer_placed_on_host_tier_directly():
+    e = _engine(1000)  # smaller than any test frame
+    try:
+        jdf = e.to_df(_frame())
+        _ = jdf.blocks
+        stats = e.memory_stats
+        assert stats["tiers"] == {"device": 0, "host": 32000}
+        assert stats["counters"]["admissions_host"] == 1
+        assert e.fallbacks["mem_admit_host"] == 1
+        # governance never changes results
+        pd.testing.assert_frame_equal(jdf.as_pandas(), _frame())
+    finally:
+        e.stop()
+
+
+def test_estimator_accounts_for_dtype_widening():
+    import pyarrow as pa
+
+    pdf = pd.DataFrame(
+        {
+            "b": [True, False, None],
+            "s": ["a", "bb", None],
+            "t": pd.to_datetime(["2021-01-01", "2021-01-02", "2021-01-03"]),
+            "i": pd.array([1, 2, 3], dtype="int32"),
+        }
+    )
+    table = pa.Table.from_pandas(pdf, preserve_index=False)
+    est = estimate_table_device_bytes(table)
+    # bool: 1B + 1B mask; string: 4B codes + 1B mask; timestamp: 8B
+    # (arrow packs bools 8/byte — the device copy is 8x wider); int32: 4B
+    assert est == 3 * (1 + 1) + 3 * (4 + 1) + 3 * 8 + 3 * 4
+
+
+def test_parse_oom_bytes():
+    assert (
+        parse_oom_bytes(
+            "RESOURCE_EXHAUSTED: Out of memory while trying to "
+            "allocate 123456 bytes."
+        )
+        == 123456
+    )
+    assert parse_oom_bytes("RESOURCE_EXHAUSTED: 1.2G") == 0
+
+
+# ---------------------------------------------------------------------------
+# LRU spill
+# ---------------------------------------------------------------------------
+def test_lru_spill_order_respects_recency():
+    # budget 110K, high 0.9 (99K), low 0.6 (66K); frames are 32K each
+    e = _engine(
+        110_000,
+        **{
+            FUGUE_CONF_JAX_MEMORY_HIGH_WATERMARK: 0.9,
+            FUGUE_CONF_JAX_MEMORY_LOW_WATERMARK: 0.6,
+        },
+    )
+    try:
+        f1 = e.persist(e.to_df(_frame(seed=1)))
+        f2 = e.persist(e.to_df(_frame(seed=2)))
+        f3 = e.persist(e.to_df(_frame(seed=3)))
+        # f1 is now the most recently USED despite being oldest
+        _ = e.to_df(f1)
+        f4 = e.persist(e.to_df(_frame(seed=4)))  # crosses the watermark
+        gov = e._memory
+        tiers = [gov.tier_of(f.blocks) for f in (f1, f2, f3, f4)]
+        # LRU order spills f2 then f3; touched f1 and the newcomer stay
+        assert tiers == ["device", "host", "host", "device"]
+        assert e.fallbacks["mem_pressure"] == 1
+        assert e.fallbacks["mem_spill"] == 2
+        stats = e.memory_stats
+        assert stats["tiers"] == {"device": 64000, "host": 64000}
+        assert stats["counters"]["spilled_bytes"] == 64000
+        # spilled frames stay fully readable
+        pd.testing.assert_frame_equal(f2.as_pandas(), _frame(seed=2))
+        pd.testing.assert_frame_equal(f3.as_pandas(), _frame(seed=3))
+    finally:
+        e.stop()
+
+
+def test_spill_only_targets_persisted_frames():
+    e = _engine(
+        110_000,
+        **{
+            FUGUE_CONF_JAX_MEMORY_HIGH_WATERMARK: 0.9,
+            FUGUE_CONF_JAX_MEMORY_LOW_WATERMARK: 0.6,
+        },
+    )
+    try:
+        # transient (non-persisted) frames are not spill candidates:
+        # they die with their task and return budget via weakref
+        t1 = e.to_df(_frame(seed=1))
+        _ = t1.blocks
+        t2 = e.to_df(_frame(seed=2))
+        _ = t2.blocks
+        t3 = e.to_df(_frame(seed=3))
+        _ = t3.blocks
+        f4 = e.to_df(_frame(seed=4))
+        _ = f4.blocks  # pressure fires but there is nothing to spill
+        assert e.fallbacks["mem_pressure"] == 1
+        assert "mem_spill" not in e.fallbacks
+        assert e.memory_stats["counters"]["overcommit"] == 1
+        gov = e._memory
+        assert gov.tier_of(t1.blocks) == "device"
+    finally:
+        e.stop()
+
+
+def test_spilled_frame_release_credits_host_tier():
+    e = _engine(
+        60_000,
+        **{
+            FUGUE_CONF_JAX_MEMORY_HIGH_WATERMARK: 0.9,
+            FUGUE_CONF_JAX_MEMORY_LOW_WATERMARK: 0.5,
+        },
+    )
+    try:
+        f1 = e.persist(e.to_df(_frame(seed=1)))
+        f2 = e.persist(e.to_df(_frame(seed=2)))  # spills f1
+        assert e._memory.tier_of(f1.blocks) == "host"
+        assert e.memory_stats["tiers"] == {"device": 32000, "host": 32000}
+        del f1
+        gc.collect()
+        assert e.memory_stats["tiers"] == {"device": 32000, "host": 0}
+        pd.testing.assert_frame_equal(f2.as_pandas(), _frame(seed=2))
+    finally:
+        e.stop()
+
+
+def test_spill_moves_arrays_onto_distinct_host_mesh():
+    """With a real two-tier engine the spill physically re-places the
+    frame's arrays on the host mesh (in place, so live references
+    follow) — not just the ledger label."""
+    import jax
+
+    from fugue_tpu.constants import FUGUE_CONF_JAX_PLACEMENT
+    from fugue_tpu.jax_backend.blocks import make_mesh
+
+    e = _engine(
+        60_000,
+        **{
+            FUGUE_CONF_JAX_PLACEMENT: "device",
+            FUGUE_CONF_JAX_MEMORY_LOW_WATERMARK: 0.5,
+        },
+    )
+    try:
+        e._host_mesh = make_mesh(jax.devices("cpu")[:4])
+        f1 = e.persist(e.to_df(_frame(seed=1)))
+        assert f1.blocks.mesh is e.mesh
+        f2 = e.persist(e.to_df(_frame(seed=2)))  # spills f1
+        assert e._memory.tier_of(f1.blocks) == "host"
+        assert f1.blocks.mesh is e.host_mesh
+        for col in f1.blocks.columns.values():
+            assert col.data.sharding.mesh == e.host_mesh
+        assert f2.blocks.mesh is e.mesh
+        pd.testing.assert_frame_equal(f1.as_pandas(), _frame(seed=1))
+        # cross-tier ops still compose (mesh alignment moves one side)
+        j = e.union(f1, f2, distinct=False)
+        assert j.as_pandas()["x"].sum() == (
+            _frame(seed=1)["x"].sum() + _frame(seed=2)["x"].sum()
+        )
+    finally:
+        e.stop()
+
+
+def test_spill_moves_registered_column_sharing_siblings():
+    """A derived frame shares JaxColumn objects with its source; when
+    the source spills, every REGISTERED sibling's mesh label and ledger
+    tier must move with it — a stale device label over host-resident
+    data would mis-place ops and over-report the device tier forever."""
+    import jax
+
+    from fugue_tpu.constants import FUGUE_CONF_JAX_PLACEMENT
+    from fugue_tpu.jax_backend.blocks import make_mesh
+
+    e = _engine(
+        70_000,
+        **{
+            FUGUE_CONF_JAX_PLACEMENT: "device",
+            FUGUE_CONF_JAX_MEMORY_LOW_WATERMARK: 0.5,
+        },
+    )
+    try:
+        e._host_mesh = make_mesh(jax.devices("cpu")[:4])
+        a = e.persist(e.to_df(_frame(seed=1)))
+        b = e.persist(a[["x"]])  # shares the 'x' JaxColumn with a
+        assert a.blocks.columns["x"] is b.blocks.columns["x"]  # type: ignore
+        c = e.persist(e.to_df(_frame(seed=2)))  # pressure -> spills a
+        gov = e._memory
+        assert gov.tier_of(a.blocks) == "host"
+        # the sibling moved WITH it: mesh label, tier and bytes agree
+        assert gov.tier_of(b.blocks) == "host"  # type: ignore
+        assert b.blocks.mesh is e.host_mesh  # type: ignore
+        assert gov.tier_of(c.blocks) == "device"
+        stats = e.memory_stats
+        entries = gov.ledger_entries()
+        assert stats["tiers"]["device"] == sum(
+            n for t, n, _ in entries if t == "device"
+        )
+        pd.testing.assert_frame_equal(a.as_pandas(), _frame(seed=1))
+        assert b.as_pandas()["x"].tolist() == _frame(seed=1)["x"].tolist()
+    finally:
+        e.stop()
+
+
+def test_note_oom_clamps_budget_and_spills():
+    from fugue_tpu.testing.faults import resource_exhausted
+
+    e = _engine(1_000_000)
+    try:
+        f1 = e.persist(e.to_df(_frame(seed=1)))
+        assert e._memory.tier_of(f1.blocks) == "device"
+        # a real RESOURCE_EXHAUSTED of 10KB while 32KB is resident:
+        # observed capacity = 42KB < budget -> clamp + pressure relief
+        e.note_device_oom(resource_exhausted(10_000))
+        stats = e.memory_stats
+        assert stats["counters"]["oom_feedback"] == 1
+        assert e.fallbacks["mem_oom_feedback"] == 1
+        assert stats["budget_bytes"] == 42_000
+        # the resident 32K exceeds low watermark (31.5K): f1 spilled
+        assert e._memory.tier_of(f1.blocks) == "host"
+    finally:
+        e.stop()
+
+
+# ---------------------------------------------------------------------------
+# governed vs ungoverned result parity on a full op mix
+# ---------------------------------------------------------------------------
+def test_governed_pipeline_results_identical_to_ungoverned():
+    def run(e):
+        from fugue_tpu.collections.partition import PartitionSpec
+        from fugue_tpu.column import col
+        from fugue_tpu.column import functions as ff
+
+        a = e.persist(e.to_df(_frame(seed=1)))
+        b = e.persist(e.to_df(_frame(seed=2)))
+        c = e.persist(e.to_df(_frame(seed=3)))
+        u = e.union(e.union(a, b, distinct=False), c, distinct=False)
+        agg = e.aggregate(
+            u,
+            PartitionSpec(by=["x"]),
+            [ff.sum(col("y")).alias("s"), ff.count(col("x")).alias("c")],
+        )
+        return agg.as_pandas().sort_values("x").reset_index(drop=True)
+
+    gov = _engine(
+        70_000, **{FUGUE_CONF_JAX_MEMORY_LOW_WATERMARK: 0.5}
+    )
+    ungov = JaxExecutionEngine()
+    try:
+        got = run(gov)
+        want = run(ungov)
+        pd.testing.assert_frame_equal(got, want)
+        # the small budget actually exercised the spill path
+        assert gov.fallbacks.get("mem_spill", 0) >= 1
+        assert ungov.memory_stats["enabled"] is False
+    finally:
+        gov.stop()
+        ungov.stop()

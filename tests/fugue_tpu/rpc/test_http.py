@@ -73,3 +73,153 @@ def test_callback_through_transform_with_http_server():
         engine_conf={"fugue.rpc.server": "http"},
     )
     assert received == [3]
+
+
+# ---------------------------------------------------------------------------
+# transient-transport retry (bounded exponential backoff)
+# ---------------------------------------------------------------------------
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+
+class _FlakyRPCHandler(BaseHTTPRequestHandler):
+    """Serves the HTTPRPC pickle protocol, but answers the first
+    ``fail_first`` requests with the configured HTTP status."""
+
+    fail_first = 0
+    fail_status = 503
+    state: dict = {}
+
+    def do_POST(self):  # noqa: N802 (stdlib naming)
+        n = self.state["requests"] = self.state.get("requests", 0) + 1
+        if n <= self.fail_first:
+            self.send_response(self.fail_status)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        length = int(self.headers.get("Content-Length", "0"))
+        key, args, kwargs = pickle.loads(self.rfile.read(length))
+        payload = pickle.dumps((True, sum(args)))
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def log_message(self, fmt, *args):  # silence stderr
+        pass
+
+
+def _flaky_server(fail_first, fail_status=503):
+    handler = type(
+        "_Bound",
+        (_FlakyRPCHandler,),
+        {"fail_first": fail_first, "fail_status": fail_status, "state": {}},
+    )
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    return httpd, handler.state
+
+
+def test_client_retries_503_then_succeeds():
+    httpd, state = _flaky_server(fail_first=2)
+    try:
+        client = HTTPRPCClient(
+            "127.0.0.1", httpd.server_address[1], "k", 5.0, retries=3
+        )
+        assert client(3, 4) == 7
+        assert state["requests"] == 3  # two 503s + the success
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_client_fails_fast_on_non_transient_http_error():
+    import urllib.error
+
+    httpd, state = _flaky_server(fail_first=10**9, fail_status=404)
+    try:
+        client = HTTPRPCClient(
+            "127.0.0.1", httpd.server_address[1], "k", 5.0, retries=3
+        )
+        with pytest.raises(urllib.error.HTTPError):
+            client(1)
+        assert state["requests"] == 1  # no retry on a deterministic 404
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_client_retry_budget_exhausted_reraises():
+    import urllib.error
+
+    httpd, state = _flaky_server(fail_first=10**9, fail_status=503)
+    try:
+        client = HTTPRPCClient(
+            "127.0.0.1", httpd.server_address[1], "k", 5.0, retries=2
+        )
+        with pytest.raises(urllib.error.HTTPError):
+            client(1)
+        assert state["requests"] == 3  # initial + 2 retries... then raise
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_transient_classifier_for_transport_errors():
+    from urllib.error import HTTPError, URLError
+
+    from fugue_tpu.rpc.http import _is_transient_transport_error as t
+
+    assert t(URLError(ConnectionRefusedError("refused")))
+    assert t(URLError(ConnectionResetError("reset")))
+    assert t(ConnectionError("reset by peer"))
+    assert t(HTTPError("http://x", 503, "unavailable", {}, None))
+    assert not t(HTTPError("http://x", 500, "handler bug", {}, None))
+    assert not t(RuntimeError("rpc call failed on driver: ValueError"))
+
+
+def test_make_client_reads_retry_conf():
+    server = make_rpc_server(
+        {"fugue.rpc.server": "http", "fugue.rpc.http_server.retries": 5}
+    )
+    server.start()
+    try:
+        client = server.make_client(lambda: 1)
+        assert client._retries == 5
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# idempotent stop + wedged-shutdown warning
+# ---------------------------------------------------------------------------
+def test_stop_server_is_idempotent():
+    server = make_rpc_server({"fugue.rpc.server": "http"})
+    server.start()
+    server.stop()
+    server.stop()  # second stop is a no-op, not an error
+    assert server._httpd is None and server._thread is None
+
+
+def test_stop_server_warns_on_wedged_thread(caplog):
+    server = make_rpc_server({"fugue.rpc.server": "http"})
+    server.start_server()
+
+    class _Wedged:
+        def join(self, timeout=None):
+            pass  # never actually joins
+
+        def is_alive(self):
+            return True
+
+    server._thread = _Wedged()
+    with caplog.at_level(logging.WARNING, logger="fugue_tpu.rpc"):
+        server.stop_server()
+    assert any("did not stop" in r.message for r in caplog.records)
+    # the wedged handle is kept so a later stop can observe/retry it,
+    # and calling again stays safe
+    server.stop_server()

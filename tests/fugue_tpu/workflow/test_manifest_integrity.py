@@ -1,0 +1,173 @@
+"""Manifest artifact integrity: each completed task's checkpoint
+artifact is fingerprinted (size + sha256) into the run manifest; on
+resume, a corrupted or truncated artifact is treated as INCOMPLETE —
+removed and recomputed, counted in ``fault_stats["integrity_rejected"]``
+— instead of being loaded as garbage."""
+
+import json
+from typing import List
+
+import pandas as pd
+import pytest
+
+from fugue_tpu.constants import (
+    FUGUE_CONF_WORKFLOW_CHECKPOINT_PATH,
+    FUGUE_CONF_WORKFLOW_RESUME,
+)
+from fugue_tpu.execution import make_execution_engine
+from fugue_tpu.testing.faults import FaultPlan, FaultSpec, inject_faults
+from fugue_tpu.workflow import FugueWorkflow
+from fugue_tpu.workflow.manifest import artifact_fingerprint
+
+pytestmark = pytest.mark.faults
+
+_CALLS: List[str] = []
+
+
+def _creator() -> pd.DataFrame:
+    _CALLS.append("create")
+    return pd.DataFrame({"x": [1, 2, 3, 4]})
+
+
+def _double(df: pd.DataFrame) -> pd.DataFrame:
+    return df.assign(x=df["x"] * 2)
+
+
+def _build(namespace: str) -> FugueWorkflow:
+    dag = FugueWorkflow()
+    src = dag.create(_creator, schema="x:long").deterministic_checkpoint(
+        namespace=namespace
+    )
+    src.transform(_double, schema="*").yield_dataframe_as(
+        "out", as_local=True
+    )
+    return dag
+
+
+def _killed_first_run(conf: dict, namespace: str):
+    """Run 1: the downstream transform dies; the creator's checkpoint +
+    manifest survive. Returns (engine, manifest record)."""
+    plan = FaultPlan(
+        FaultSpec(
+            "task", "RunTransformer*", times=1,
+            error=lambda: ValueError("injected kill"),
+        )
+    )
+    e = make_execution_engine("native", conf)
+    with inject_faults(plan):
+        with pytest.raises(ValueError):
+            _build(namespace).run(e)
+    wf_uuid = _build(namespace).__uuid__()
+    mf_uri = e.fs.join(
+        conf[FUGUE_CONF_WORKFLOW_CHECKPOINT_PATH],
+        f"manifest_{wf_uuid}.json",
+    )
+    data = json.loads(e.fs.read_bytes(mf_uri).decode("utf-8"))
+    recs = list(data["completed"].values())
+    assert len(recs) == 1
+    return e, recs[0]
+
+
+def test_manifest_records_artifact_size_and_sha256():
+    _CALLS.clear()
+    conf = {
+        FUGUE_CONF_WORKFLOW_CHECKPOINT_PATH: "memory://integ/record",
+        FUGUE_CONF_WORKFLOW_RESUME: True,
+    }
+    e, rec = _killed_first_run(conf, "integ_rec")
+    assert rec["size"] and rec["size"] > 0
+    assert rec["sha256"] and len(rec["sha256"]) == 64
+    # the fingerprint matches a fresh recomputation over the artifact
+    size, digest = artifact_fingerprint(e.fs, rec["artifact"])
+    assert (size, digest) == (rec["size"], rec["sha256"])
+
+
+def test_corrupted_artifact_recomputes_instead_of_loading():
+    _CALLS.clear()
+    conf = {
+        FUGUE_CONF_WORKFLOW_CHECKPOINT_PATH: "memory://integ/corrupt",
+        FUGUE_CONF_WORKFLOW_RESUME: True,
+    }
+    e1, rec = _killed_first_run(conf, "integ_corrupt")
+    assert _CALLS == ["create"]
+    # corrupt the checkpoint artifact in place (a crash mid-write on
+    # non-atomic storage, bit rot, a truncated upload ...)
+    e1.fs.write_file_atomic(
+        rec["artifact"], lambda fp: fp.write(b"garbage, not parquet")
+    )
+
+    e2 = make_execution_engine("native", conf)
+    res = _build("integ_corrupt").run(e2)
+    # correct results — recomputed, never loaded from the garbage
+    assert res["out"].as_pandas()["x"].tolist() == [2, 4, 6, 8]
+    assert _CALLS == ["create", "create"]
+    assert sum(res.fault_stats["integrity_rejected"].values()) == 1
+    assert res.fault_stats["resumed"] == []  # nothing was resumable
+
+
+def test_intact_artifact_resumes_without_recompute():
+    _CALLS.clear()
+    conf = {
+        FUGUE_CONF_WORKFLOW_CHECKPOINT_PATH: "memory://integ/intact",
+        FUGUE_CONF_WORKFLOW_RESUME: True,
+    }
+    _killed_first_run(conf, "integ_intact")
+    assert _CALLS == ["create"]
+    e2 = make_execution_engine("native", conf)
+    res = _build("integ_intact").run(e2)
+    assert res["out"].as_pandas()["x"].tolist() == [2, 4, 6, 8]
+    # verification passed: served from the checkpoint, no recompute
+    assert _CALLS == ["create"]
+    assert res.fault_stats["integrity_rejected"] == {}
+    assert len(res.fault_stats["resumed"]) == 1
+
+
+def test_legacy_manifest_without_fingerprint_still_resumes():
+    """Manifests written before this change (no size/sha256) skip
+    verification instead of rejecting everything."""
+    _CALLS.clear()
+    conf = {
+        FUGUE_CONF_WORKFLOW_CHECKPOINT_PATH: "memory://integ/legacy",
+        FUGUE_CONF_WORKFLOW_RESUME: True,
+    }
+    e1, _rec = _killed_first_run(conf, "integ_legacy")
+    wf_uuid = _build("integ_legacy").__uuid__()
+    mf_uri = e1.fs.join("memory://integ/legacy", f"manifest_{wf_uuid}.json")
+    data = json.loads(e1.fs.read_bytes(mf_uri).decode("utf-8"))
+    for rec in data["completed"].values():
+        rec.pop("size", None)
+        rec.pop("sha256", None)
+    payload = json.dumps(data).encode("utf-8")
+    e1.fs.write_file_atomic(mf_uri, lambda fp: fp.write(payload))
+
+    e2 = make_execution_engine("native", conf)
+    res = _build("integ_legacy").run(e2)
+    assert res["out"].as_pandas()["x"].tolist() == [2, 4, 6, 8]
+    assert _CALLS == ["create"]  # resumed, no recompute
+    assert len(res.fault_stats["resumed"]) == 1
+
+
+def test_artifact_fingerprint_directory_stability():
+    """Directory artifacts hash as sorted (name, bytes) pairs; hidden
+    temp files are ignored, content changes are detected."""
+    e = make_execution_engine("native")
+    base = "memory://integ/fp"
+    e.fs.makedirs(base, exist_ok=True)
+    e.fs.write_file_atomic(
+        e.fs.join(base, "b.bin"), lambda fp: fp.write(b"bb")
+    )
+    e.fs.write_file_atomic(
+        e.fs.join(base, "a.bin"), lambda fp: fp.write(b"aa")
+    )
+    size1, sha1 = artifact_fingerprint(e.fs, base)
+    assert size1 == 4
+    # a dot-hidden temp file does not change the fingerprint
+    e.fs.write_file_atomic(
+        e.fs.join(base, ".tmp123"), lambda fp: fp.write(b"zzz")
+    )
+    assert artifact_fingerprint(e.fs, base) == (size1, sha1)
+    # flipping one byte does
+    e.fs.write_file_atomic(
+        e.fs.join(base, "a.bin"), lambda fp: fp.write(b"ax")
+    )
+    assert artifact_fingerprint(e.fs, base) != (size1, sha1)
