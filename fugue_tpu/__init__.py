@@ -82,6 +82,6 @@ from fugue_tpu.workflow import (
     module,
 )
 from fugue_tpu.workflow.api import out_transform, raw_sql, transform
-from fugue_tpu.sql_frontend.api import fugue_sql, fugue_sql_flow  # noqa: E402
+from fugue_tpu.sql_frontend.api import fugue_sql, fugue_sql_flow, lint_sql  # noqa: E402
 
 import fugue_tpu.registry  # noqa: F401  (registers builtin engines)

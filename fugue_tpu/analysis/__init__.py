@@ -1,0 +1,44 @@
+"""Pre-execution static analysis of workflow DAGs.
+
+A pluggable linter over the built-but-unexecuted :class:`FugueWorkflow`
+task graph: stable-coded rules (``FWF###``) check schemas, partition
+specs, conf keys and predicted jax-engine behavior in milliseconds,
+before a single byte hits a device. Wired into ``FugueWorkflow.run()``
+behind the ``fugue.analysis`` conf (``off`` / ``warn`` / ``error``,
+default ``warn``), exposed directly as ``workflow.analyze()``, and
+runnable standalone over FugueSQL files or workflow modules via
+``python -m fugue_tpu.analysis``.
+"""
+
+from fugue_tpu.analysis.diagnostics import (
+    GENERIC,
+    JAX,
+    Diagnostic,
+    Rule,
+    Severity,
+    all_rules,
+    register_rule,
+)
+from fugue_tpu.analysis.schema_pass import SchemaInfo, propagate
+from fugue_tpu.analysis.analyzer import (
+    AnalysisContext,
+    Analyzer,
+    analyze_workflow,
+    max_severity,
+)
+
+__all__ = [
+    "AnalysisContext",
+    "Analyzer",
+    "Diagnostic",
+    "GENERIC",
+    "JAX",
+    "Rule",
+    "SchemaInfo",
+    "Severity",
+    "all_rules",
+    "analyze_workflow",
+    "max_severity",
+    "propagate",
+    "register_rule",
+]

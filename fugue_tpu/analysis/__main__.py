@@ -1,0 +1,184 @@
+"""``python -m fugue_tpu.analysis`` — lint a FugueSQL file or a workflow
+module WITHOUT executing it.
+
+Targets:
+
+- a FugueSQL script (``.fsql`` / ``.sql`` / any readable file): the DAG is
+  compiled exactly as ``fugue_sql_flow`` would, then analyzed instead of
+  run;
+- a workflow module: ``pkg.mod`` or ``pkg.mod:attr`` where the attribute
+  (or, unqualified, the first match in the module) is a FugueWorkflow
+  instance or a zero-arg callable returning one;
+- ``--self-test``: analyze the built-in representative workflow corpus
+  (pre-merge gate: exits nonzero on any error-level diagnostic).
+
+Exit codes: 0 clean (or only sub-error findings), 1 error-level
+diagnostics, 2 the target could not be built.
+"""
+
+import argparse
+import importlib
+import os
+import sys
+from typing import Any, List, Optional
+
+from fugue_tpu.analysis.analyzer import Analyzer
+from fugue_tpu.analysis.diagnostics import Diagnostic, Severity
+
+
+def _build_from_sql_file(path: str, conf: Any) -> Any:
+    from fugue_tpu.sql_frontend.workflow_sql import FugueSQLWorkflow
+
+    with open(path, "r") as fp:
+        code = fp.read()
+    dag = FugueSQLWorkflow(conf)
+    dag._sql(code, {})
+    return dag
+
+
+def _build_from_module(spec: str) -> Any:
+    from fugue_tpu.workflow.workflow import FugueWorkflow
+
+    mod_name, _, attr = spec.partition(":")
+    mod = importlib.import_module(mod_name)
+    candidates = (
+        [getattr(mod, attr)]
+        if attr
+        else [getattr(mod, n) for n in dir(mod) if not n.startswith("_")]
+    )
+    for obj in candidates:
+        if isinstance(obj, FugueWorkflow):
+            return obj
+        if attr and callable(obj):
+            wf = obj()
+            if isinstance(wf, FugueWorkflow):
+                return wf
+            raise TypeError(f"{spec} returned {type(wf).__name__}, not a FugueWorkflow")
+    if not attr:
+        # second sweep: zero-arg builder functions by convention
+        for name in ("build_workflow", "get_workflow", "workflow"):
+            obj = getattr(mod, name, None)
+            if callable(obj):
+                wf = obj()
+                if isinstance(wf, FugueWorkflow):
+                    return wf
+    raise LookupError(f"no FugueWorkflow found in {spec!r}")
+
+
+def _parse_conf(pairs: List[str]) -> dict:
+    conf = {}
+    for p in pairs:
+        k, eq, v = p.partition("=")
+        if eq == "":
+            raise ValueError(f"--conf expects key=value, got {p!r}")
+        conf[k.strip()] = v.strip()
+    return conf
+
+
+def _strip_bootstrap_frames(callsite: List[str]) -> List[str]:
+    """Drop interpreter-bootstrap frames (runpy; ``<frozen runpy>`` on
+    py3.11+) from a callsite, each with its trailing source line(s), and
+    keep any genuine user frames — a module target's build function IS a
+    meaningful callsite even though runpy frames lead the stack."""
+    kept: List[str] = []
+    skipping = False
+    for line in callsite:
+        if line.lstrip().startswith("File "):
+            skipping = "/runpy.py" in line or "<frozen runpy>" in line
+        if not skipping:
+            kept.append(line)
+    return kept
+
+
+def _print_diags(title: str, diags: List[Diagnostic], out: Any) -> None:
+    if title:
+        print(f"== {title}", file=out)
+    if not diags:
+        print("  clean: no diagnostics", file=out)
+        return
+    for d in diags:
+        frames = _strip_bootstrap_frames(d.callsite or [])
+        print(d.describe(with_callsite=False), file=out)
+        if frames:
+            print("  defined at:", file=out)
+            for line in frames:
+                print("  " + line, file=out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m fugue_tpu.analysis",
+        description="statically lint a FugueSQL file or workflow module "
+        "without executing it",
+    )
+    p.add_argument(
+        "target",
+        nargs="?",
+        help="FugueSQL file path, or module[:attr] providing a FugueWorkflow",
+    )
+    p.add_argument(
+        "--self-test",
+        action="store_true",
+        help="analyze the built-in representative workflows; exit nonzero "
+        "on any error-level diagnostic (pre-merge gate)",
+    )
+    p.add_argument(
+        "--conf",
+        action="append",
+        default=[],
+        metavar="KEY=VALUE",
+        help="conf overrides for the analysis (repeatable)",
+    )
+    p.add_argument(
+        "--min-severity",
+        default="info",
+        choices=["info", "warn", "error"],
+        help="hide diagnostics below this severity (default: info)",
+    )
+    args = p.parse_args(argv)
+    floor = Severity.parse(args.min_severity)
+    try:
+        conf = _parse_conf(args.conf)
+    except ValueError as ex:
+        print(str(ex), file=sys.stderr)
+        return 2
+
+    if args.self_test:
+        from fugue_tpu.analysis.selftest import run_self_test, self_test_failed
+
+        results = run_self_test()
+        for name, diags in results:
+            _print_diags(name, [d for d in diags if d.severity >= floor], sys.stdout)
+        failed = self_test_failed(results)
+        print(
+            f"self-test {'FAILED' if failed else 'passed'}: "
+            f"{len(results)} workflows analyzed",
+            file=sys.stdout,
+        )
+        return 1 if failed else 0
+
+    if not args.target:
+        p.print_usage(sys.stderr)
+        print("error: a target or --self-test is required", file=sys.stderr)
+        return 2
+    try:
+        if os.path.isfile(args.target):
+            dag = _build_from_sql_file(args.target, conf)
+        else:
+            dag = _build_from_module(args.target)
+    except Exception as ex:
+        print(
+            f"can't build a workflow from {args.target!r}: "
+            f"{type(ex).__name__}: {ex}",
+            file=sys.stderr,
+        )
+        return 2
+    merged = dict(dag._conf)
+    merged.update(conf)
+    diags = Analyzer().analyze(dag, conf=merged)
+    _print_diags(args.target, [d for d in diags if d.severity >= floor], sys.stdout)
+    return 1 if any(d.severity is Severity.ERROR for d in diags) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

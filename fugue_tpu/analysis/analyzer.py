@@ -1,0 +1,130 @@
+"""The Analyzer: walks a built-but-unexecuted FugueWorkflow task graph and
+runs every registered rule over it.
+
+One schema-propagation sweep is shared by all rules via the
+:class:`AnalysisContext`; rules are side-effect free and independently
+sandboxed — a crashing rule degrades to a skipped check (logged at
+warning so a weakened ``fugue.analysis=error`` gate stays visible),
+never a broken run. Typical cost is well under a millisecond per
+task: a 50-task DAG analyzes in single-digit milliseconds.
+"""
+
+import logging
+from typing import Any, Dict, List, Optional, Sequence, Set, Type
+
+import fugue_tpu.analysis.conf_pass  # noqa: F401  (register rules)
+import fugue_tpu.analysis.cost_pass  # noqa: F401  (register rules)
+from fugue_tpu.analysis.diagnostics import (
+    GENERIC,
+    JAX,
+    Diagnostic,
+    Rule,
+    Severity,
+    all_rules,
+)
+from fugue_tpu.analysis.schema_pass import (
+    UNKNOWN,
+    PropagationIssue,
+    SchemaInfo,
+    propagate,
+)
+from fugue_tpu.utils.params import ParamDict
+from fugue_tpu.workflow.tasks import FugueTask
+
+_LOG = logging.getLogger("fugue_tpu.analysis")
+
+
+def _is_jax_engine(engine: Any) -> bool:
+    return engine is not None and any(
+        c.__name__ == "JaxExecutionEngine" for c in type(engine).__mro__
+    )
+
+
+class AnalysisContext:
+    """Everything a rule may consult: the task list (build = dependency
+    order), the effective conf, the (optional) target engine, and the
+    propagated static schema knowledge."""
+
+    def __init__(
+        self,
+        tasks: Sequence[FugueTask],
+        conf: Any = None,
+        engine: Any = None,
+    ):
+        self.tasks: List[FugueTask] = list(tasks)
+        self.conf: ParamDict = conf if isinstance(conf, ParamDict) else ParamDict(conf)
+        self.engine = engine
+        self.schema_infos: Dict[int, SchemaInfo]
+        self.issues: List[PropagationIssue]
+        self.schema_infos, self.issues = propagate(self.tasks)
+
+    def info(self, task: FugueTask) -> SchemaInfo:
+        """The task's statically-known OUTPUT schema."""
+        return self.schema_infos.get(id(task), UNKNOWN)
+
+    def input_info(self, task: FugueTask, index: int = 0) -> SchemaInfo:
+        """The statically-known schema of one of the task's inputs."""
+        if index >= len(task.inputs):
+            return UNKNOWN
+        return self.info(task.inputs[index])
+
+
+class Analyzer:
+    """Run rules over a workflow. ``rules=None`` uses the full registry;
+    pass explicit rule classes to narrow (e.g. per-rule tests)."""
+
+    def __init__(self, rules: Optional[Sequence[Type[Rule]]] = None):
+        self._rules = list(rules) if rules is not None else None
+
+    def analyze(
+        self,
+        workflow: Any,
+        conf: Any = None,
+        engine: Any = None,
+        scopes: Optional[Set[str]] = None,
+    ) -> List[Diagnostic]:
+        """Analyze a built (unexecuted) workflow. ``scopes`` defaults to
+        engine-appropriate: with a non-jax engine only generic rules run;
+        with no engine at all (lint mode) every scope runs."""
+        if scopes is None:
+            if engine is None:
+                scopes = {GENERIC, JAX}
+            else:
+                scopes = {GENERIC} | ({JAX} if _is_jax_engine(engine) else set())
+        tasks = getattr(workflow, "tasks", None)
+        if tasks is None:
+            tasks = getattr(workflow, "_tasks", [])
+        ctx = AnalysisContext(tasks, conf=conf, engine=engine)
+        out: List[Diagnostic] = []
+        for rule_cls in self._rules if self._rules is not None else all_rules():
+            if rule_cls.scope not in scopes:
+                continue
+            try:
+                out.extend(rule_cls().check(ctx))
+            except Exception as ex:  # defensive: a broken rule is a skipped
+                # check, never a broken run — but a skipped check under a
+                # fugue.analysis=error gate silently weakens the gate, so
+                # the skip itself must be VISIBLE at default log levels
+                _LOG.warning(
+                    "analysis rule %s crashed and was skipped (its checks "
+                    "did not run): %s: %s",
+                    rule_cls.__name__,
+                    type(ex).__name__,
+                    ex,
+                )
+        out.sort(key=lambda d: -int(d.severity))
+        return out
+
+
+def analyze_workflow(
+    workflow: Any,
+    conf: Any = None,
+    engine: Any = None,
+    scopes: Optional[Set[str]] = None,
+) -> List[Diagnostic]:
+    """Convenience wrapper: full-registry analysis of a workflow."""
+    return Analyzer().analyze(workflow, conf=conf, engine=engine, scopes=scopes)
+
+
+def max_severity(diagnostics: Sequence[Diagnostic]) -> Optional[Severity]:
+    return max((d.severity for d in diagnostics), default=None)

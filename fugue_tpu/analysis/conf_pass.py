@@ -1,0 +1,59 @@
+"""Conf-key rules: every ``fugue.*`` key in effect is checked against the
+declared registry in :mod:`fugue_tpu.constants` — unknown keys get a
+did-you-mean suggestion (a typo'd conf key is otherwise SILENTLY ignored
+by every engine getter), and values that the typed getters could not
+coerce to the declared type are rejected before an engine trips on them
+mid-run."""
+
+import difflib
+from typing import Any, Iterable
+
+from fugue_tpu.analysis.diagnostics import (
+    Diagnostic,
+    Rule,
+    Severity,
+    register_rule,
+)
+from fugue_tpu.constants import declared_conf_keys
+from fugue_tpu.utils.params import _convert
+
+
+@register_rule
+class UnknownConfKeyRule(Rule):
+    code = "FWF201"
+    severity = Severity.ERROR
+    description = "unknown fugue.* conf key (typo'd keys are silently ignored)"
+
+    def check(self, ctx: Any) -> Iterable[Diagnostic]:
+        declared = declared_conf_keys()
+        for key in sorted(ctx.conf.keys()):
+            if not key.startswith("fugue.") or key in declared:
+                continue
+            close = difflib.get_close_matches(key, declared.keys(), n=1, cutoff=0.6)
+            hint = f" — did you mean '{close[0]}'?" if close else ""
+            yield self.diag(
+                f"unknown conf key '{key}'{hint} (unknown fugue.* keys are "
+                "ignored by every engine)",
+            )
+
+
+@register_rule
+class ConfValueTypeRule(Rule):
+    code = "FWF202"
+    severity = Severity.ERROR
+    description = "conf value is not convertible to the key's declared type"
+
+    def check(self, ctx: Any) -> Iterable[Diagnostic]:
+        declared = declared_conf_keys()
+        for key in sorted(ctx.conf.keys()):
+            info = declared.get(key)
+            if info is None or info.type is object:
+                continue
+            value = ctx.conf[key]
+            try:
+                _convert(value, info.type)
+            except Exception:
+                yield self.diag(
+                    f"conf '{key}' = {value!r} is not convertible to the "
+                    f"declared type {info.type.__name__} ({info.description})",
+                )

@@ -1,0 +1,317 @@
+"""Engine cost/compatibility prediction + workflow-safety rules.
+
+The jax-scoped rules statically predict expensive engine behavior from
+schemas and conf alone, BEFORE ingest or compile:
+
+- FWF301: columns whose dtype has no device representation (decimal,
+  binary, nested, null) stay host arrow columns — every op touching them
+  pays a host fallback (the engine counts these at runtime in
+  ``engine.fallbacks``; this rule predicts them from the schema).
+- FWF302: with ``fugue.jax.row_bucket`` at 0, every distinct row count
+  compiles its own XLA program; data-dependent row counts (filter,
+  dropna, sample, take, distinct, joins) make shapes unbounded, so the
+  compile cache can never converge — a recompile hazard.
+- FWF303: estimated ingest working set (dtype-widened bytes, same
+  estimator the admission controller uses) exceeds the configured
+  device-memory budget — spills/host admissions are predicted, not a
+  surprise mid-run.
+
+The generic rules catch resume/retry patterns that are unsafe regardless
+of engine: non-deterministic checkpoints under ``fugue.workflow.resume``
+(FWF401) and retries wrapping non-idempotent outputters (FWF402).
+"""
+
+from typing import Any, Iterable, List, Optional, Tuple
+
+from fugue_tpu.analysis.diagnostics import (
+    JAX,
+    Diagnostic,
+    Rule,
+    Severity,
+    register_rule,
+)
+from fugue_tpu.constants import (
+    FUGUE_CONF_JAX_MEMORY_BUDGET_BYTES,
+    FUGUE_CONF_JAX_MEMORY_BUDGET_FRACTION,
+    FUGUE_CONF_JAX_ROW_BUCKET,
+    FUGUE_CONF_WORKFLOW_RESUME,
+    FUGUE_CONF_WORKFLOW_RETRY_MAX_ATTEMPTS,
+)
+from fugue_tpu.extensions import builtins as _b
+from fugue_tpu.workflow.checkpoint import StrongCheckpoint, TableCheckpoint
+
+def _row_varying_exts() -> Tuple[Any, ...]:
+    return (
+        _b.Filter,
+        _b.Dropna,
+        _b.Sample,
+        _b.Take,
+        _b.Distinct,
+        _b.RunJoin,
+        _b.RunSetOperation,
+    )
+
+
+def _host_only_columns(schema: Any) -> List[str]:
+    # the jax backend's own ingest-widening estimator is the single source
+    # of truth for what has a device representation (width 0 = host-only);
+    # importing it is free — fugue_tpu's package import already loads jax
+    from fugue_tpu.jax_backend.memory import _field_device_width
+
+    return [f.name for f in schema.fields if _field_device_width(f.type) == 0]
+
+
+@register_rule
+class HostFallbackDtypeRule(Rule):
+    code = "FWF301"
+    severity = Severity.WARN
+    scope = JAX
+    description = (
+        "dtypes with no device representation force host fallbacks on the "
+        "jax engine"
+    )
+
+    def check(self, ctx: Any) -> Iterable[Diagnostic]:
+        for t in ctx.tasks:
+            info = ctx.info(t)
+            if info.schema is None:
+                continue
+            offending = _host_only_columns(info.schema)
+            if not offending:
+                continue
+            # only the task that INTRODUCES the columns is flagged — a
+            # passthrough chain would repeat the same finding per task
+            inherited = set()
+            for i in range(len(t.inputs)):
+                src = ctx.input_info(t, i)
+                if src.schema is not None:
+                    inherited.update(_host_only_columns(src.schema))
+            fresh = [c for c in offending if c not in inherited]
+            if not fresh:
+                continue
+            extra = ""
+            fb = getattr(ctx.engine, "fallbacks", None)
+            if fb:
+                # the counter dict also carries mem_* memory-governance
+                # events (PR 4); only genuine host fallbacks belong here
+                host_fb = {
+                    k: v for k, v in fb.items() if not k.startswith("mem_")
+                }
+                if host_fb:
+                    extra = (
+                        " (engine has already recorded host fallbacks: "
+                        f"{host_fb})"
+                    )
+            yield self.diag(
+                f"column(s) {fresh} have no jax device representation "
+                "(decimal/binary/nested stay host arrow columns): every op "
+                f"touching them runs on the host tier{extra}",
+                task=t,
+            )
+
+
+@register_rule
+class RecompileHazardRule(Rule):
+    code = "FWF302"
+    severity = Severity.INFO
+    scope = JAX
+    description = (
+        "data-dependent row counts with row bucketing off: each distinct "
+        "shape compiles its own XLA program"
+    )
+
+    def check(self, ctx: Any) -> Iterable[Diagnostic]:
+        try:
+            bucket = int(ctx.conf.get(FUGUE_CONF_JAX_ROW_BUCKET, 0))
+        except Exception:
+            return
+        if bucket > 0:
+            return
+        varying = [t for t in ctx.tasks if t.extension in _row_varying_exts()]
+        if not varying:
+            return
+        names = [t.name for t in varying[:3]]
+        yield self.diag(
+            f"{len(varying)} task(s) produce data-dependent row counts "
+            f"(e.g. {', '.join(names)}) while fugue.jax.row_bucket is 0: "
+            "every distinct intermediate shape compiles its own XLA "
+            "program; set a row bucket to make nearby shapes share "
+            "compiled programs",
+            task=varying[0],
+        )
+
+
+def _estimate_create_bytes(task: Any) -> Optional[int]:
+    """Dtype-widened device estimate of a CreateData task's data, or None
+    when rows/schema aren't statically known. Never materializes arrow."""
+    import pandas as pd
+
+    from fugue_tpu.dataframe import DataFrame
+    from fugue_tpu.schema import Schema
+
+    data = task.params.get("data", None)
+    schema = task.params.get("schema", None)
+    rows: Optional[int] = None
+    sch: Optional[Schema] = None
+    if isinstance(data, pd.DataFrame):
+        rows = len(data)
+        sch = Schema(schema) if schema is not None else Schema(data)
+    elif isinstance(data, DataFrame):
+        try:
+            if data.is_bounded and data.is_local:
+                rows = data.count()
+        except Exception:
+            rows = None
+        sch = data.schema
+    elif isinstance(data, (list, tuple)) and schema is not None:
+        rows = len(data)
+        sch = Schema(schema)
+    if rows is None or sch is None:
+        return None
+    from fugue_tpu.jax_backend.memory import estimate_schema_device_bytes
+
+    return estimate_schema_device_bytes(sch, rows)
+
+
+@register_rule
+class MemoryBudgetRule(Rule):
+    code = "FWF303"
+    severity = Severity.WARN
+    scope = JAX
+    description = (
+        "estimated device working set exceeds the memory budget: spills / "
+        "host admissions predicted"
+    )
+
+    def check(self, ctx: Any) -> Iterable[Diagnostic]:
+        try:
+            budget = int(ctx.conf.get(FUGUE_CONF_JAX_MEMORY_BUDGET_BYTES, 0))
+        except Exception:
+            return
+        if budget <= 0:
+            mem = getattr(ctx.engine, "memory_stats", None)
+            if isinstance(mem, dict) and mem.get("enabled"):
+                budget = int(mem.get("budget_bytes", 0) or 0)
+        if budget <= 0:
+            # governance enabled via budget_fraction alone: resolve it
+            # against the default (all-devices) capacity, the same
+            # detection a lint-mode run has no engine/mesh to ask
+            try:
+                frac = float(
+                    ctx.conf.get(FUGUE_CONF_JAX_MEMORY_BUDGET_FRACTION, 0.0)
+                )
+            except Exception:
+                frac = 0.0
+            if frac > 0:
+                import jax
+
+                from fugue_tpu.jax_backend.memory import detect_devices_capacity
+
+                budget = int(detect_devices_capacity(jax.devices()) * frac)
+        if budget <= 0:
+            return
+        total = 0
+        biggest: Tuple[int, Any] = (0, None)
+        for t in ctx.tasks:
+            if not (t.task_type == "create" and t.extension is _b.CreateData):
+                continue
+            est = _estimate_create_bytes(t)
+            if est is None:
+                continue
+            if est > budget:
+                # the admission controller never places this frame on the
+                # device tier, so it contributes nothing to the DEVICE
+                # working set — flag it and keep it out of the spill math
+                yield self.diag(
+                    f"a single ingested frame is estimated at ~{est} device "
+                    f"bytes, above the {budget}-byte budget: the admission "
+                    "controller will place it on the host tier directly",
+                    task=t,
+                )
+                continue
+            total += est
+            if est > biggest[0]:
+                biggest = (est, t)
+        if total > budget and biggest[1] is not None:
+            yield self.diag(
+                f"estimated ingest working set ~{total} device bytes "
+                f"exceeds the {budget}-byte budget "
+                f"(fugue.jax.memory.budget_bytes): LRU spills to the host "
+                "tier are predicted under admission pressure",
+                task=biggest[1],
+            )
+
+
+def _max_attempts(ctx: Any, task: Any) -> int:
+    try:
+        attempts = int(ctx.conf.get(FUGUE_CONF_WORKFLOW_RETRY_MAX_ATTEMPTS, 1))
+    except Exception:
+        attempts = 1
+    ov = getattr(task, "fault_override", None) or {}
+    return int(ov.get("max_attempts", attempts))
+
+
+@register_rule
+class ResumeNonDeterministicCheckpointRule(Rule):
+    code = "FWF401"
+    severity = Severity.ERROR
+    description = (
+        "non-deterministic checkpoint under fugue.workflow.resume: the "
+        "manifest can never serve it, so a resumed run silently recomputes"
+    )
+
+    def check(self, ctx: Any) -> Iterable[Diagnostic]:
+        try:
+            resume = bool(ctx.conf.get(FUGUE_CONF_WORKFLOW_RESUME, False))
+        except Exception:
+            resume = False
+        if not resume:
+            return
+        for t in ctx.tasks:
+            cp = t.checkpoint
+            if isinstance(cp, (StrongCheckpoint, TableCheckpoint)) and not getattr(
+                cp, "_deterministic", True
+            ):
+                yield self.diag(
+                    "fugue.workflow.resume is on but this task's checkpoint "
+                    "is non-deterministic (random id, temp storage): a "
+                    "crashed run can never resume from it — use "
+                    "deterministic_checkpoint() for resume-safe artifacts",
+                    task=t,
+                )
+
+
+@register_rule
+class RetryNonIdempotentOutputterRule(Rule):
+    code = "FWF402"
+    severity = Severity.WARN
+    description = (
+        "retries wrap a non-idempotent outputter: a partial side effect "
+        "may be applied more than once"
+    )
+
+    def check(self, ctx: Any) -> Iterable[Diagnostic]:
+        for t in ctx.tasks:
+            if _max_attempts(ctx, t) <= 1:
+                continue
+            # SaveAndUse is a PROCESS task but shares Save's append hazard:
+            # the retry loop wraps its side-effecting write all the same
+            if t.extension in (_b.Save, _b.SaveAndUse):
+                if str(t.params.get("mode", "overwrite")).lower() == "append":
+                    yield self.diag(
+                        "retries are enabled and this append-mode save is "
+                        "not idempotent: a retried attempt can append the "
+                        "same rows twice — use overwrite mode or "
+                        "max_attempts=1 for this task",
+                        task=t,
+                    )
+            elif t.task_type == "output" and t.extension not in (
+                _b.Show, _b.AssertEqFunc, _b.AssertNotEqFunc
+            ):
+                yield self.diag(
+                    "retries are enabled around a user outputter whose side "
+                    "effects the framework cannot prove idempotent; a "
+                    "transient failure after a partial write replays them",
+                    task=t,
+                    severity=Severity.INFO,
+                )

@@ -1,0 +1,131 @@
+"""Diagnostic framework for the pre-execution workflow analyzer.
+
+- :class:`Severity` — info / warn / error ordering.
+- :class:`Diagnostic` — one finding: a stable rule code, severity, message,
+  and the offending task's display name + USER callsite (captured at DAG
+  build time by ``FugueWorkflow.add``, same attribution the fault layer
+  splices into runtime errors).
+- :class:`Rule` — a pluggable check with a stable code (``FWF###``);
+  subclasses registered via :func:`register_rule` run in every analysis.
+  ``scope`` partitions rules: ``"generic"`` rules run for every engine,
+  ``"jax"`` rules only when the target engine is the jax backend (or in
+  engine-agnostic lint mode, e.g. the CLI).
+"""
+
+from enum import IntEnum
+from typing import Any, Dict, Iterable, List, Optional, Type
+
+GENERIC = "generic"
+JAX = "jax"
+
+
+class Severity(IntEnum):
+    INFO = 0
+    WARN = 1
+    ERROR = 2
+
+    def __str__(self) -> str:
+        return self.name.lower()
+
+    @staticmethod
+    def parse(obj: Any) -> "Severity":
+        if isinstance(obj, Severity):
+            return obj
+        s = str(obj).strip().lower()
+        for sev in Severity:
+            if s == sev.name.lower():
+                return sev
+        raise ValueError(f"invalid severity {obj!r}")
+
+
+class Diagnostic:
+    """One analyzer finding, printable as a single lint line."""
+
+    __slots__ = ("code", "severity", "message", "task_name", "callsite", "rule")
+
+    def __init__(
+        self,
+        code: str,
+        severity: Severity,
+        message: str,
+        task_name: str = "",
+        callsite: Optional[List[str]] = None,
+        rule: str = "",
+    ):
+        self.code = code
+        self.severity = Severity.parse(severity)
+        self.message = message
+        self.task_name = task_name
+        self.callsite = list(callsite or [])
+        self.rule = rule
+
+    def describe(self, with_callsite: bool = True) -> str:
+        head = f"{self.code} {self.severity}"
+        if self.task_name:
+            head += f" [task {self.task_name}]"
+        lines = [f"{head}: {self.message}"]
+        if with_callsite and self.callsite:
+            lines.append("  defined at:")
+            lines.extend("  " + c for c in self.callsite)
+        return "\n".join(lines)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dict(
+            code=self.code,
+            severity=str(self.severity),
+            message=self.message,
+            task_name=self.task_name,
+            callsite=list(self.callsite),
+            rule=self.rule,
+        )
+
+    def __str__(self) -> str:
+        return self.describe()
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"Diagnostic({self.code}, {self.severity}, {self.task_name})"
+
+
+class Rule:
+    """Base class of one analyzer check. Subclasses set the class attrs and
+    implement :meth:`check`; ``self.diag(...)`` builds consistently-tagged
+    diagnostics. Rules must be side-effect free and never execute tasks."""
+
+    code: str = "FWF000"
+    severity: Severity = Severity.WARN
+    scope: str = GENERIC
+    description: str = ""
+
+    def check(self, ctx: Any) -> Iterable[Diagnostic]:  # pragma: no cover
+        raise NotImplementedError
+
+    def diag(
+        self,
+        message: str,
+        task: Any = None,
+        severity: Optional[Severity] = None,
+    ) -> Diagnostic:
+        return Diagnostic(
+            code=self.code,
+            severity=self.severity if severity is None else severity,
+            message=message,
+            task_name=getattr(task, "name", "") if task is not None else "",
+            callsite=getattr(task, "callsite", None) if task is not None else None,
+            rule=type(self).__name__,
+        )
+
+
+_RULES: Dict[str, Type[Rule]] = {}
+
+
+def register_rule(cls: Type[Rule]) -> Type[Rule]:
+    """Class decorator: add a Rule to the global registry (keyed by its
+    stable code; re-registering a code replaces the rule — plugins may
+    override a builtin check)."""
+    _RULES[cls.code] = cls
+    return cls
+
+
+def all_rules() -> List[Type[Rule]]:
+    """Registered rule classes, ordered by code."""
+    return [_RULES[k] for k in sorted(_RULES)]

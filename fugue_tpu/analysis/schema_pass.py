@@ -1,0 +1,668 @@
+"""Static schema propagation + schema rules.
+
+Threads declared/inferred schemas through the built-but-unexecuted DAG:
+create -> transform -> select/rename/drop -> zip/join edges, without
+executing anything. Knowledge is three-valued per task:
+
+- full (:class:`SchemaInfo` with a ``Schema``),
+- names-only (``columns``: order known, types not — e.g. an ``Assign``
+  whose expression types can't be inferred),
+- unknown (raw SQL output, schema-less loads, opaque processors).
+
+Rules then check column references (partition specs, presorts, selects,
+renames, join keys, subsets) against the propagated knowledge and flag
+only DEFINITE misses — a reference into an unknown schema is reported
+separately at info level (FWF104) as unverifiable, never as an error.
+"""
+
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Tuple
+
+from fugue_tpu.analysis.diagnostics import (
+    Diagnostic,
+    Rule,
+    Severity,
+    register_rule,
+)
+from fugue_tpu.collections.partition import parse_presort_exp
+from fugue_tpu.column.expressions import ColumnExpr, _NamedColumnExpr
+from fugue_tpu.extensions import builtins as _b
+from fugue_tpu.schema import Schema
+from fugue_tpu.workflow.tasks import FugueTask
+
+
+class SchemaInfo:
+    """What the analyzer statically knows about one task's OUTPUT."""
+
+    __slots__ = ("schema", "columns", "zipped", "reason")
+
+    def __init__(
+        self,
+        schema: Optional[Schema] = None,
+        columns: Optional[List[str]] = None,
+        zipped: bool = False,
+        reason: str = "",
+    ):
+        self.schema = schema
+        self.columns = columns if schema is None else schema.names
+        self.zipped = zipped
+        self.reason = reason  # why unknown, for FWF104 messages
+
+    @property
+    def known(self) -> bool:
+        return self.schema is not None or self.columns is not None
+
+    def has_column(self, name: str) -> Optional[bool]:
+        """True/False when knowable, None when the schema is opaque."""
+        if self.columns is None:
+            return None
+        return name in self.columns
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        if self.schema is not None:
+            return f"SchemaInfo({self.schema})"
+        if self.columns is not None:
+            return f"SchemaInfo(columns={self.columns})"
+        return f"SchemaInfo(unknown: {self.reason})"
+
+
+UNKNOWN = SchemaInfo(reason="unknown")
+
+
+class PropagationIssue:
+    """A problem discovered WHILE propagating (not a column reference):
+    kind is ``"duplicate"`` (conflicting output columns) or ``"convert"``
+    (the extension can't be statically adapted — which is exactly the
+    runtime conversion path, so it will fail at execution too)."""
+
+    __slots__ = ("kind", "task", "message")
+
+    def __init__(self, kind: str, task: FugueTask, message: str):
+        self.kind = kind
+        self.task = task
+        self.message = message
+
+
+# ---- column-expression walking ---------------------------------------------
+def expr_columns(expr: Any) -> Iterator[str]:
+    """Named (non-wildcard) input columns referenced by a column expression
+    tree, in depth-first order."""
+    if isinstance(expr, _NamedColumnExpr):
+        if not expr.wildcard:
+            yield expr.name
+        return
+    if not isinstance(expr, ColumnExpr):
+        return
+    for attr in ("col", "left", "right"):
+        sub = getattr(expr, attr, None)
+        if isinstance(sub, ColumnExpr):
+            yield from expr_columns(sub)
+    for sub in getattr(expr, "args", None) or []:
+        yield from expr_columns(sub)
+
+
+def _dedup(names: Iterable[str]) -> List[str]:
+    seen: Dict[str, None] = {}
+    for n in names:
+        seen.setdefault(n)
+    return list(seen)
+
+
+# ---- per-extension column references ---------------------------------------
+class ColumnRef:
+    """One static column reference: the name, where it appears, and which
+    inputs it must resolve against (indices into ``task.inputs``)."""
+
+    __slots__ = ("column", "where", "input_indices")
+
+    def __init__(self, column: str, where: str, input_indices: List[int]):
+        self.column = column
+        self.where = where
+        self.input_indices = input_indices
+
+
+def column_refs(task: FugueTask) -> List[ColumnRef]:
+    """Every column the task's spec references, beyond partition/presort
+    (those have their own rules). Defensive: an unparseable spec yields no
+    refs — the runtime will surface its own error."""
+    refs: List[ColumnRef] = []
+    ext = task.extension
+    p = task.params
+    first = [0]
+
+    def add(names: Iterable[str], where: str, idx: Optional[List[int]] = None) -> None:
+        for n in names:
+            if isinstance(n, str):
+                refs.append(ColumnRef(n, where, idx or first))
+
+    try:
+        if ext is _b.Rename:
+            add((p.get("columns", None) or {}).keys(), "rename")
+        elif ext is _b.AlterColumns:
+            add(Schema(p.get("columns", "")).names, "alter_columns")
+        elif ext is _b.DropColumns:
+            if not p.get("if_exists", False):
+                add(p.get("columns", None) or [], "drop")
+        elif ext is _b.SelectColumnsP:
+            add([c for c in p.get("columns", None) or [] if isinstance(c, str)],
+                "select columns")
+        elif ext is _b.Dropna:
+            add(p.get("subset", None) or [], "dropna subset")
+        elif ext is _b.Fillna:
+            add(p.get("subset", None) or [], "fillna subset")
+            value = p.get("value", None)
+            if isinstance(value, dict):
+                add(value.keys(), "fillna value")
+        elif ext is _b.Select:
+            cols = p.get("columns", None)
+            for c in getattr(cols, "all_cols", None) or []:
+                add(_dedup(expr_columns(c)), "select")
+            # NOT `having`: it filters the aggregated OUTPUT (aliases), so
+            # its references don't resolve against the input schema
+            add(_dedup(expr_columns(p.get("where", None))), "where")
+        elif ext is _b.Filter:
+            add(_dedup(expr_columns(p.get("condition", None))), "filter")
+        elif ext is _b.Assign:
+            for c in p.get("columns", None) or []:
+                add(_dedup(expr_columns(c)), "assign")
+        elif ext is _b.Aggregate:
+            for c in p.get("columns", None) or []:
+                add(_dedup(expr_columns(c)), "aggregate")
+        elif ext is _b.RunJoin:
+            how = str(p.get("how", "")).lower()
+            on = p.get("on", None) or []
+            if how not in ("cross",):
+                # join keys must exist on EVERY side
+                add(on, "join on", list(range(len(task.inputs))))
+    except Exception:  # pragma: no cover - malformed spec, runtime will raise
+        return refs
+    return refs
+
+
+def partition_check_inputs(task: FugueTask) -> List[int]:
+    """Which inputs a task's partition_by/presort must resolve against:
+    zip keys must exist on every side, everything else partitions its
+    first input."""
+    if task.extension is _b.Zip:
+        return list(range(len(task.inputs)))
+    return [0]
+
+
+# ---- schema transfer functions ---------------------------------------------
+def _schema_of_data(data: Any, schema: Any) -> SchemaInfo:
+    import pandas as pd
+
+    from fugue_tpu.dataframe import DataFrame
+
+    if schema is not None:
+        return SchemaInfo(schema=Schema(schema))
+    if isinstance(data, DataFrame):
+        return SchemaInfo(schema=Schema(data.schema))
+    if isinstance(data, pd.DataFrame):
+        return SchemaInfo(schema=Schema(data))
+    return SchemaInfo(reason="raw data without a declared schema")
+
+
+def _transformer_output(
+    task: FugueTask, inp: SchemaInfo, issues: List[PropagationIssue]
+) -> SchemaInfo:
+    from fugue_tpu.extensions.convert import (
+        _FuncAsCoTransformer,
+        _FuncAsTransformer,
+        _to_output_transformer,
+        _to_transformer,
+    )
+    from fugue_tpu.extensions.schema_hint import apply_schema_hint
+
+    is_output = task.task_type == "output"
+    to_conv = _to_output_transformer if is_output else _to_transformer
+    try:
+        tf = to_conv(
+            task.params.get("transformer", None),
+            *(() if is_output else (task.params.get("schema", None),)),
+        )
+    except Exception as ex:
+        # the SAME conversion runs at execution: a failure here is a real
+        # pre-execution catch, not an analyzer artifact
+        issues.append(
+            PropagationIssue(
+                "convert", task, f"{type(ex).__name__}: {ex}"
+            )
+        )
+        return SchemaInfo(reason="unconvertible transformer")
+    if is_output:
+        return SchemaInfo(reason="output transformer")
+    if isinstance(tf, _FuncAsCoTransformer):
+        try:
+            return SchemaInfo(schema=Schema(tf._schema_hint))
+        except Exception:
+            return SchemaInfo(reason="cotransformer schema hint not static")
+    if isinstance(tf, _FuncAsTransformer):
+        hint = tf._schema_hint
+        try:
+            if inp.schema is not None:
+                return SchemaInfo(schema=apply_schema_hint(inp.schema, hint))
+            if isinstance(hint, str) and "*" not in hint and not hint.startswith(
+                ("+", "-")
+            ):
+                # hint independent of the input schema
+                return SchemaInfo(schema=Schema(hint))
+        except Exception as ex:
+            issues.append(
+                PropagationIssue("duplicate", task, f"schema hint {hint!r}: {ex}")
+            )
+            return SchemaInfo(reason="inapplicable schema hint")
+        return SchemaInfo(reason="schema hint needs the (unknown) input schema")
+    # an interface Transformer: ask it, feeding a schema-only stub — user
+    # implementations overwhelmingly only touch df.schema
+    if inp.schema is not None and not inp.zipped:
+        class _Stub:
+            schema = inp.schema
+
+        try:
+            return SchemaInfo(schema=Schema(tf.get_output_schema(_Stub())))
+        except Exception:
+            return SchemaInfo(reason="get_output_schema is not static")
+    return SchemaInfo(reason="transformer over an unknown input schema")
+
+
+def _select_output(
+    task: FugueTask, inp: SchemaInfo, issues: List[PropagationIssue]
+) -> SchemaInfo:
+    cols = task.params.get("columns", None)
+    all_cols = getattr(cols, "all_cols", None) or []
+    if inp.schema is None:
+        names = [
+            c.output_name
+            for c in all_cols
+            if getattr(c, "output_name", "") not in ("", "*")
+        ]
+        if len(names) == len(all_cols) and len(set(names)) == len(names):
+            return SchemaInfo(columns=names)
+        return SchemaInfo(reason="select over an unknown input schema")
+    out = Schema()
+    try:
+        for c in all_cols:
+            if isinstance(c, _NamedColumnExpr) and c.wildcard:
+                out += inp.schema
+            else:
+                out += c.infer_schema_field(inp.schema)
+        return SchemaInfo(schema=out)
+    except KeyError as ex:
+        issues.append(PropagationIssue("duplicate", task, f"select list: {ex}"))
+        return SchemaInfo(reason="conflicting select output")
+    except Exception:
+        return SchemaInfo(reason="select output not inferable")
+
+
+def _join_output(
+    task: FugueTask, inputs: List[SchemaInfo], issues: List[PropagationIssue]
+) -> SchemaInfo:
+    how = str(task.params.get("how", "")).lower()
+    on = [c for c in task.params.get("on", None) or [] if isinstance(c, str)]
+    if any(not i.known for i in inputs):
+        return SchemaInfo(reason="join side with unknown schema")
+    if how in ("semi", "anti", "left_semi", "left_anti"):
+        first = inputs[0]
+        return (
+            SchemaInfo(schema=first.schema)
+            if first.schema is not None
+            else SchemaInfo(columns=list(first.columns or []))
+        )
+    names: List[str] = []
+    dup: List[str] = []
+    for i, info in enumerate(inputs):
+        for n in info.columns or []:
+            if n in names:
+                if i > 0 and n in on:
+                    continue  # shared join key appears once
+                dup.append(n)
+            else:
+                names.append(n)
+    if dup:
+        issues.append(
+            PropagationIssue(
+                "duplicate",
+                task,
+                f"{how} join would duplicate non-key column(s) {sorted(set(dup))}",
+            )
+        )
+        return SchemaInfo(reason="conflicting join output")
+    if all(i.schema is not None for i in inputs):
+        fields: List[Any] = []
+        by_name: Dict[str, Any] = {}
+        for info in inputs:
+            for f in info.schema.fields:  # type: ignore[union-attr]
+                if f.name not in by_name:
+                    by_name[f.name] = f
+                    fields.append(f)
+        return SchemaInfo(schema=Schema(fields))
+    return SchemaInfo(columns=names)
+
+
+def _passthrough(inp: SchemaInfo) -> SchemaInfo:
+    if inp.schema is not None:
+        return SchemaInfo(schema=inp.schema)
+    if inp.columns is not None:
+        return SchemaInfo(columns=list(inp.columns), zipped=inp.zipped)
+    return SchemaInfo(zipped=inp.zipped, reason=inp.reason or "unknown input")
+
+
+def _output_of(
+    task: FugueTask,
+    inputs: List[SchemaInfo],
+    issues: List[PropagationIssue],
+) -> SchemaInfo:
+    ext = task.extension
+    p = task.params
+    inp = inputs[0] if inputs else UNKNOWN
+    if task.task_type == "output":
+        if ext is _b.RunOutputTransformer:
+            return _transformer_output(task, inp, issues)
+        return SchemaInfo(reason="output task")
+    if task.task_type == "create":
+        if ext is _b.CreateData:
+            return _schema_of_data(p.get("data", None), p.get("schema", None))
+        if ext is _b.Load:
+            columns = p.get("columns", None)
+            if isinstance(columns, str):
+                return SchemaInfo(schema=Schema(columns))
+            if isinstance(columns, (list, tuple)) and all(
+                isinstance(c, str) for c in columns
+            ) and len(columns) > 0:
+                return SchemaInfo(columns=list(columns))
+            return SchemaInfo(reason="load without declared columns")
+        # custom creator: a static schema hint is the only knowledge source
+        from fugue_tpu.extensions.convert import _to_creator
+
+        try:
+            creator = _to_creator(ext, task.schema)
+            hint = getattr(creator, "_schema_hint", None)
+            if hint is not None:
+                return SchemaInfo(schema=Schema(hint))
+        except Exception as ex:
+            issues.append(PropagationIssue("convert", task, f"{type(ex).__name__}: {ex}"))
+            return SchemaInfo(reason="unconvertible creator")
+        return SchemaInfo(reason="creator without a schema hint")
+    # ---- processors --------------------------------------------------------
+    if ext is _b.RunTransformer:
+        return _transformer_output(task, inp, issues)
+    if ext in (
+        _b.Distinct,
+        _b.Dropna,
+        _b.Fillna,
+        _b.Sample,
+        _b.Take,
+        _b.Filter,
+        _b.SaveAndUse,
+        _b.RunSetOperation,
+    ):
+        return _passthrough(inp)
+    if ext is _b.RunJoin:
+        return _join_output(task, inputs, issues)
+    if ext is _b.Zip:
+        return SchemaInfo(zipped=True, reason="zipped (serialized) frame")
+    if ext is _b.RunSQLSelect:
+        return SchemaInfo(reason="raw SQL output")
+    if ext is _b.Select:
+        return _select_output(task, inp, issues)
+    if ext is _b.Assign:
+        if inp.columns is None:
+            return SchemaInfo(reason="assign over an unknown input schema")
+        cols = p.get("columns", None) or []
+        if inp.schema is not None:
+            try:
+                fields = list(inp.schema.fields)
+                by_name = {f.name: i for i, f in enumerate(fields)}
+                for c in cols:
+                    f = c.infer_schema_field(inp.schema)
+                    if f.name in by_name:
+                        fields[by_name[f.name]] = f
+                    else:
+                        by_name[f.name] = len(fields)
+                        fields.append(f)
+                return SchemaInfo(schema=Schema(fields))
+            except Exception:
+                pass
+        names = list(inp.columns)
+        for c in cols:
+            n = getattr(c, "output_name", "")
+            if n and n not in names:
+                names.append(n)
+        return SchemaInfo(columns=names)
+    if ext is _b.Aggregate:
+        keys = task.partition_spec.partition_by
+        aliases = [
+            getattr(c, "output_name", "") for c in p.get("columns", None) or []
+        ]
+        if inp.schema is not None and all(k in inp.schema for k in keys):
+            try:
+                out = Schema(inp.schema.extract(keys))
+                for c in p.get("columns", None) or []:
+                    out += c.infer_schema_field(inp.schema)
+                return SchemaInfo(schema=out)
+            except Exception:
+                pass
+        names = [k for k in keys] + [a for a in aliases if a]
+        return SchemaInfo(columns=names) if names else SchemaInfo(
+            reason="aggregate output not inferable"
+        )
+    if ext is _b.Rename:
+        columns = p.get("columns", None) or {}
+        if inp.schema is not None:
+            # missing keys are FWF103's finding; propagate what resolves
+            present = {k: v for k, v in columns.items() if k in inp.schema}
+            try:
+                return SchemaInfo(schema=inp.schema.rename(present))
+            except Exception as ex:
+                issues.append(PropagationIssue("duplicate", task, f"rename: {ex}"))
+                return SchemaInfo(reason="conflicting rename output")
+        if inp.columns is not None:
+            names = [columns.get(n, n) for n in inp.columns]
+            if len(set(names)) != len(names):
+                issues.append(
+                    PropagationIssue(
+                        "duplicate", task, f"rename causes duplicated names {names}"
+                    )
+                )
+                return SchemaInfo(reason="conflicting rename output")
+            return SchemaInfo(columns=names)
+        return _passthrough(inp)
+    if ext is _b.AlterColumns:
+        if inp.schema is not None:
+            try:
+                sub = Schema(p.get("columns", ""))
+                present = Schema([f for f in sub.fields if f.name in inp.schema])
+                return SchemaInfo(schema=inp.schema.alter(present))
+            except Exception:
+                return SchemaInfo(reason="alter_columns output not inferable")
+        return _passthrough(inp)
+    if ext is _b.DropColumns:
+        names = [c for c in p.get("columns", None) or [] if isinstance(c, str)]
+        if inp.schema is not None:
+            return SchemaInfo(
+                schema=Schema([f for f in inp.schema.fields if f.name not in names])
+            )
+        if inp.columns is not None:
+            return SchemaInfo(columns=[n for n in inp.columns if n not in names])
+        return _passthrough(inp)
+    if ext is _b.SelectColumnsP:
+        names = [c for c in p.get("columns", None) or [] if isinstance(c, str)]
+        if inp.schema is not None:
+            return SchemaInfo(
+                schema=Schema([inp.schema[n] for n in names if n in inp.schema])
+            )
+        if inp.columns is not None:
+            return SchemaInfo(columns=[n for n in names if n in inp.columns])
+        return SchemaInfo(reason="column select over an unknown schema")
+    # custom processor: only a declared schema hint is static knowledge
+    from fugue_tpu.extensions.convert import _to_processor
+
+    try:
+        proc = _to_processor(ext, task.schema)
+        hint = getattr(proc, "_schema_hint", None)
+        if hint is not None:
+            return SchemaInfo(schema=Schema(hint))
+    except Exception as ex:
+        issues.append(PropagationIssue("convert", task, f"{type(ex).__name__}: {ex}"))
+        return SchemaInfo(reason="unconvertible processor")
+    return SchemaInfo(reason="opaque processor")
+
+
+def propagate(
+    tasks: List[FugueTask],
+) -> Tuple[Dict[int, SchemaInfo], List[PropagationIssue]]:
+    """One topological sweep (workflow task lists are already in build =
+    dependency order): id(task) -> output SchemaInfo, plus the issues
+    discovered on the way. Never raises: an unhandled transfer failure
+    degrades that task (and its consumers) to unknown."""
+    infos: Dict[int, SchemaInfo] = {}
+    issues: List[PropagationIssue] = []
+    for t in tasks:
+        inputs = [infos.get(id(i), UNKNOWN) for i in t.inputs]
+        try:
+            infos[id(t)] = _output_of(t, inputs, issues)
+        except Exception as ex:  # pragma: no cover - defensive
+            infos[id(t)] = SchemaInfo(reason=f"propagation failed: {ex}")
+    return infos, issues
+
+
+# ---- rules ------------------------------------------------------------------
+def _check_names_against(
+    ctx: Any,
+    task: FugueTask,
+    names: Iterable[str],
+    input_indices: List[int],
+    where: str,
+    rule: Rule,
+) -> Iterator[Diagnostic]:
+    for name in names:
+        for idx in input_indices:
+            if idx >= len(task.inputs):
+                continue
+            info = ctx.input_info(task, idx)
+            if info.has_column(name) is False:
+                known = ", ".join(info.columns or [])
+                yield rule.diag(
+                    f"{where} references unknown column '{name}' "
+                    f"(input columns: [{known}])",
+                    task=task,
+                )
+                break  # one diagnostic per name
+
+
+@register_rule
+class PartitionColumnRule(Rule):
+    code = "FWF101"
+    severity = Severity.ERROR
+    description = "partition_by references a column missing from the input schema"
+
+    def check(self, ctx: Any) -> Iterable[Diagnostic]:
+        for t in ctx.tasks:
+            by = t.partition_spec.partition_by
+            if not by or not t.inputs:
+                continue
+            yield from _check_names_against(
+                ctx, t, by, partition_check_inputs(t), "partition_by", self
+            )
+
+
+@register_rule
+class PresortColumnRule(Rule):
+    code = "FWF102"
+    severity = Severity.ERROR
+    description = "presort references a column missing from the input schema"
+
+    def check(self, ctx: Any) -> Iterable[Diagnostic]:
+        for t in ctx.tasks:
+            if not t.inputs:
+                continue
+            keys = list(t.partition_spec.presort.keys())
+            if t.extension is _b.Take:
+                try:
+                    keys += list(parse_presort_exp(t.params.get("presort", "")).keys())
+                except Exception:
+                    pass
+            if not keys:
+                continue
+            yield from _check_names_against(
+                ctx, t, _dedup(keys), partition_check_inputs(t), "presort", self
+            )
+
+
+@register_rule
+class ColumnReferenceRule(Rule):
+    code = "FWF103"
+    severity = Severity.ERROR
+    description = (
+        "select/rename/drop/subset/join-on references an unknown column"
+    )
+
+    def check(self, ctx: Any) -> Iterable[Diagnostic]:
+        for t in ctx.tasks:
+            if not t.inputs:
+                continue
+            for ref in column_refs(t):
+                yield from _check_names_against(
+                    ctx, t, [ref.column], ref.input_indices, ref.where, self
+                )
+
+
+@register_rule
+class UnverifiableConsumerRule(Rule):
+    code = "FWF104"
+    severity = Severity.INFO
+    description = (
+        "a schema-less producer feeds a consumer that references specific "
+        "columns (statically unverifiable)"
+    )
+
+    def check(self, ctx: Any) -> Iterable[Diagnostic]:
+        for t in ctx.tasks:
+            if not t.inputs:
+                continue
+            names = _dedup(
+                list(t.partition_spec.partition_by)
+                + list(t.partition_spec.presort.keys())
+                + [r.column for r in column_refs(t)]
+            )
+            if not names:
+                continue
+            unknown_inputs = [
+                i
+                for i, inp in enumerate(t.inputs)
+                if not ctx.input_info(t, i).known and not ctx.input_info(t, i).zipped
+            ]
+            if unknown_inputs:
+                info = ctx.input_info(t, unknown_inputs[0])
+                yield self.diag(
+                    f"cannot statically verify column(s) {names}: input "
+                    f"schema is unknown ({info.reason or 'opaque upstream'})",
+                    task=t,
+                )
+
+
+@register_rule
+class DuplicateOutputRule(Rule):
+    code = "FWF105"
+    severity = Severity.ERROR
+    description = "duplicate/conflicting output columns (hint, rename, join)"
+
+    def check(self, ctx: Any) -> Iterable[Diagnostic]:
+        for issue in ctx.issues:
+            if issue.kind == "duplicate":
+                yield self.diag(issue.message, task=issue.task)
+
+
+@register_rule
+class ExtensionConvertRule(Rule):
+    code = "FWF106"
+    severity = Severity.ERROR
+    description = (
+        "an extension cannot be statically adapted (missing schema hint or "
+        "bad signature) — the identical conversion runs at execution time"
+    )
+
+    def check(self, ctx: Any) -> Iterable[Diagnostic]:
+        for issue in ctx.issues:
+            if issue.kind == "convert":
+                yield self.diag(issue.message, task=issue.task)

@@ -1,0 +1,119 @@
+"""Representative-workflow self-test for the analyzer.
+
+Builds (never runs) a set of DAGs exercising the patterns the
+``fugue_tpu_test`` acceptance suites use — create/transform/select/
+aggregate/join/zip-cotransform/checkpoint/save — and analyzes each at
+full scope. A clean framework must produce ZERO error-level diagnostics
+over them: any error here is an analyzer false positive (or a genuinely
+broken exemplar), which is exactly what a pre-merge gate should catch.
+Used by ``python -m fugue_tpu.analysis --self-test`` and the test suite.
+"""
+
+from typing import Any, Callable, Dict, List, Tuple
+
+import pandas as pd
+
+from fugue_tpu.analysis.analyzer import Analyzer
+from fugue_tpu.analysis.diagnostics import Diagnostic, Severity
+
+
+# schema: *,s:double
+def _add_s(df: pd.DataFrame) -> pd.DataFrame:
+    return df.assign(s=df["b"] * 2.0)
+
+
+def _wf_transform() -> Any:
+    from fugue_tpu.workflow.workflow import FugueWorkflow
+
+    dag = FugueWorkflow()
+    df = dag.df([[0, 1.0], [1, 2.0]], "a:int,b:double")
+    df.partition_by("a").transform(_add_s).select("a", "s")
+    return dag
+
+
+def _wf_relational() -> Any:
+    from fugue_tpu.column import functions as f
+    from fugue_tpu.column.expressions import col
+    from fugue_tpu.workflow.workflow import FugueWorkflow
+
+    dag = FugueWorkflow()
+    left = dag.df([[0, "x"], [1, "y"]], "a:int,c:str")
+    right = dag.df([[0, 10], [2, 20]], "a:int,d:int")
+    joined = left.inner_join(right, on=["a"])
+    joined.filter(col("d") > 5).partition_by("a").aggregate(
+        total=f.sum(col("d"))
+    )
+    left.rename({"c": "name"}).drop(["name"])
+    left.union(left, distinct=True).distinct()
+    return dag
+
+
+def _wf_sql_and_schema_ops() -> Any:
+    from fugue_tpu.workflow.workflow import FugueWorkflow
+
+    dag = FugueWorkflow()
+    df = dag.df([[1, "a", 2.5]], "x:int,y:str,z:double")
+    dag.select("SELECT x, z FROM", df)
+    df.alter_columns("x:long").assign(w=1)[["x", "w"]]
+    df.dropna(subset=["z"]).fillna(0.0, subset=["z"]).sample(frac=0.5)
+    df.take(1, presort="z desc")
+    return dag
+
+
+def _wf_checkpoint_yield() -> Any:
+    from fugue_tpu.workflow.workflow import FugueWorkflow
+
+    dag = FugueWorkflow()
+    df = dag.df([[0]], "a:int")
+    df.persist().yield_dataframe_as("res")
+    return dag
+
+
+# schema: a:int,n:long
+def _count_group(df: pd.DataFrame) -> pd.DataFrame:
+    return pd.DataFrame({"a": [int(df["a"].iloc[0])], "n": [len(df)]})
+
+
+def _wf_deep_chain(n: int = 50) -> Any:
+    """A 50-task DAG for the timing bound in the acceptance criteria."""
+    from fugue_tpu.column.expressions import col
+    from fugue_tpu.workflow.workflow import FugueWorkflow
+
+    dag = FugueWorkflow()
+    df = dag.df([[i, float(i)] for i in range(8)], "a:int,b:double")
+    for i in range(n - 1):
+        if i % 5 == 4:
+            df = df.partition_by("a").transform(_count_group).rename({"n": "b"})
+            df = df.alter_columns("b:double")
+        elif i % 2 == 0:
+            df = df.filter(col("a") >= 0)
+        else:
+            df = df.assign(b=col("b") + 1.0)
+    return dag
+
+
+WORKFLOW_BUILDERS: Dict[str, Callable[[], Any]] = {
+    "transform": _wf_transform,
+    "relational": _wf_relational,
+    "sql_and_schema_ops": _wf_sql_and_schema_ops,
+    "checkpoint_yield": _wf_checkpoint_yield,
+    "deep_chain_50": _wf_deep_chain,
+}
+
+
+def run_self_test() -> List[Tuple[str, List[Diagnostic]]]:
+    """Analyze every representative workflow at full scope; returns
+    (name, diagnostics) pairs. Error-level diagnostics mean the self-test
+    FAILS (the CLI exits nonzero)."""
+    out: List[Tuple[str, List[Diagnostic]]] = []
+    analyzer = Analyzer()
+    for name, build in WORKFLOW_BUILDERS.items():
+        dag = build()
+        out.append((name, analyzer.analyze(dag, conf=dag._conf)))
+    return out
+
+
+def self_test_failed(results: List[Tuple[str, List[Diagnostic]]]) -> bool:
+    return any(
+        d.severity is Severity.ERROR for _, diags in results for d in diags
+    )

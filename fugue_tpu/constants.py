@@ -2,15 +2,24 @@
 
 Layered config model (parity with reference fugue/constants.py:35-51):
 global conf (this module) <- engine conf at construction <- per-run overrides.
+
+Every ``FUGUE_CONF_*`` key is DECLARED in :data:`_CONF_REGISTRY` below with
+its value type, default, and a one-line description; ``_DEFAULT_CONF`` (the
+seed of the global conf every engine/workflow inherits) is derived from that
+table, so the registry is the single source of truth shared by the engine
+conf getters and the static analyzer's conf pass
+(:mod:`fugue_tpu.analysis`), which flags unknown ``fugue.*`` keys with a
+did-you-mean suggestion and values not convertible to the declared type.
 """
 
-from typing import Any, Dict
+from typing import Any, Dict, NamedTuple
 
-from fugue_tpu.utils.params import ParamDict
+from fugue_tpu.utils.params import ParamDict, _convert
 
 KEYWORD_ROWCOUNT = "ROWCOUNT"
 KEYWORD_PARALLELISM = "CONCURRENCY"
 
+FUGUE_CONF_ANALYSIS = "fugue.analysis"
 FUGUE_CONF_WORKFLOW_CONCURRENCY = "fugue.workflow.concurrency"
 FUGUE_CONF_WORKFLOW_CHECKPOINT_PATH = "fugue.workflow.checkpoint.path"
 FUGUE_CONF_WORKFLOW_RETRY_MAX_ATTEMPTS = "fugue.workflow.retry.max_attempts"
@@ -49,8 +58,73 @@ FUGUE_COMPILE_TIME_CONFIGS = {
     FUGUE_CONF_SQL_DIALECT,
 }
 
-_DEFAULT_CONF: Dict[str, Any] = {
-    FUGUE_CONF_WORKFLOW_CONCURRENCY: 1,
+class ConfKeyInfo(NamedTuple):
+    """One declared conf key: its value type (``object`` = unchecked),
+    default, and description. ``in_defaults=False`` keys are declared (the
+    analyzer knows them) but deliberately NOT seeded into the global conf
+    (e.g. legacy/no-op knobs)."""
+
+    key: str
+    type: type
+    default: Any
+    description: str
+    in_defaults: bool = True
+
+
+_CONF_REGISTRY: Dict[str, ConfKeyInfo] = {}
+
+
+def register_conf_key(
+    key: str,
+    type_: type,
+    default: Any,
+    description: str,
+    in_defaults: bool = True,
+) -> None:
+    """Declare a conf key (type + default + description). Backends and
+    plugins may call this for their own ``fugue.*`` keys so the static
+    analyzer recognizes them; keys registered after import time extend the
+    live registry but not the already-built global defaults."""
+    _CONF_REGISTRY[key] = ConfKeyInfo(key, type_, default, description, in_defaults)
+
+
+def declared_conf_keys() -> Dict[str, ConfKeyInfo]:
+    """Snapshot of every declared conf key (key -> ConfKeyInfo). Shared by
+    the engine conf getters (via :func:`conf_default`) and the analyzer's
+    conf pass."""
+    return dict(_CONF_REGISTRY)
+
+
+def conf_default(key: str) -> Any:
+    """The registered default of a declared conf key."""
+    return _CONF_REGISTRY[key].default
+
+
+def typed_conf_get(conf: Any, key: str) -> Any:
+    """Read a declared key from a conf mapping: missing keys return the
+    registered default, present values coerce to the key's DECLARED type
+    (the same ``_convert`` semantics the analyzer's FWF202 rule checks;
+    ``object``-typed keys pass through untouched)."""
+    info = _CONF_REGISTRY[key]
+    if key not in conf:
+        return info.default
+    value = conf[key]
+    if info.type is object:
+        return value
+    return _convert(value, info.type)
+
+
+def _declare_defaults() -> None:
+    r = register_conf_key
+    r(
+        FUGUE_CONF_ANALYSIS,
+        str,
+        "warn",
+        "pre-execution static analysis of the workflow DAG: 'off' skips it, "
+        "'warn' (default) logs diagnostics and proceeds, 'error' raises "
+        "before any task executes when error-level diagnostics exist",
+    )
+    r(FUGUE_CONF_WORKFLOW_CONCURRENCY, int, 1, "parallel task slots of the DAG runner")
     # fault tolerance: attempts = 1 means no retry; backoff is the base
     # exponential delay in seconds (delay = backoff * 2**(attempt-1)),
     # jitter a multiplicative fraction added on top. Only TRANSIENT error
@@ -59,45 +133,87 @@ _DEFAULT_CONF: Dict[str, Any] = {
     # wall clock in seconds (0 = unlimited), enforced by the parallel
     # runner. resume=True keeps a run manifest of completed task uuids so
     # re-running an identical DAG after a crash restarts at the frontier.
-    FUGUE_CONF_WORKFLOW_RETRY_MAX_ATTEMPTS: 1,
-    FUGUE_CONF_WORKFLOW_RETRY_BACKOFF: 0.1,
-    FUGUE_CONF_WORKFLOW_RETRY_JITTER: 0.1,
-    FUGUE_CONF_WORKFLOW_TIMEOUT: 0.0,
-    FUGUE_CONF_WORKFLOW_RESUME: False,
-    FUGUE_CONF_WORKFLOW_EXCEPTION_HIDE: "fugue_tpu.",
-    FUGUE_CONF_WORKFLOW_EXCEPTION_INJECT: 3,
-    FUGUE_CONF_WORKFLOW_EXCEPTION_OPTIMIZE: True,
-    FUGUE_CONF_SQL_IGNORE_CASE: False,
-    FUGUE_CONF_SQL_DIALECT: "spark",
-    FUGUE_CONF_JAX_ROW_BUCKET: 0,
-    FUGUE_CONF_JAX_DEVICE_ZIP: True,
+    r(FUGUE_CONF_WORKFLOW_RETRY_MAX_ATTEMPTS, int, 1, "task attempts (1 = no retry)")
+    r(FUGUE_CONF_WORKFLOW_RETRY_BACKOFF, float, 0.1, "base exponential retry delay (s)")
+    r(FUGUE_CONF_WORKFLOW_RETRY_JITTER, float, 0.1, "multiplicative retry jitter fraction")
+    r(FUGUE_CONF_WORKFLOW_TIMEOUT, float, 0.0, "per-task wall clock (s, 0 = unlimited)")
+    r(FUGUE_CONF_WORKFLOW_RESUME, bool, False, "manifest-backed resume of crashed runs")
+    r(
+        FUGUE_CONF_WORKFLOW_CHECKPOINT_PATH,
+        str,
+        "",
+        "durable dir/URI for strong checkpoints, yields and run manifests",
+    )
+    r(FUGUE_CONF_WORKFLOW_EXCEPTION_HIDE, str, "fugue_tpu.", "module prefix hidden from tracebacks")
+    r(FUGUE_CONF_WORKFLOW_EXCEPTION_INJECT, int, 3, "user stack frames attached to task errors")
+    r(FUGUE_CONF_WORKFLOW_EXCEPTION_OPTIMIZE, bool, True, "prune framework frames from tracebacks")
+    r(FUGUE_CONF_SQL_IGNORE_CASE, bool, False, "case-insensitive FugueSQL keywords")
+    r(FUGUE_CONF_SQL_DIALECT, str, "spark", "SQL dialect of raw SELECT statements")
+    r(FUGUE_CONF_RPC_SERVER, str, "native", "driver<->worker RPC server ('native' or 'http')")
+    r(
+        FUGUE_CONF_RPC_HTTP_RETRIES,
+        int,
+        2,
+        # bounded exponential-backoff retries for the HTTP RPC client on
+        # transient transport failures (connection refused/reset, HTTP 503);
+        # non-transient HTTP errors always fail fast
+        "HTTP RPC client retries on transient transport failures",
+    )
+    r(
+        FUGUE_CONF_JAX_PARTITIONS,
+        int,
+        0,
+        "logical split count for host-fallback maps (0 = mesh size)",
+    )
+    # legacy/no-op: compilation is always on; declared so old confs lint clean
+    r(FUGUE_CONF_JAX_COMPILE, bool, True, "legacy no-op (compilation is always on)", in_defaults=False)
+    r(
+        FUGUE_CONF_JAX_ROW_BUCKET,
+        int,
+        0,
+        "round row counts up to multiples of this before compile so nearby "
+        "shapes share programs (0 = exact shapes; every distinct row count "
+        "compiles its own program)",
+    )
+    r(FUGUE_CONF_JAX_DEVICE_ZIP, bool, True, "device-side zip of co-partitioned frames")
     # Two-tier placement (see JaxExecutionEngine): frames below the byte
     # threshold ingest onto the host (CPU-XLA) mesh; at/above it they go to
     # the accelerator mesh. The default is tuned for network-attached
     # accelerators where per-query host<->device transfer costs seconds per
     # GB; on PCIe-local TPU hosts set a lower threshold or placement=device.
-    FUGUE_CONF_JAX_PLACEMENT: "auto",
-    FUGUE_CONF_JAX_MIN_DEVICE_BYTES: 256 * 1024 * 1024,
+    r(FUGUE_CONF_JAX_PLACEMENT, str, "auto", "ingest tier: auto | device | host")
+    r(
+        FUGUE_CONF_JAX_MIN_DEVICE_BYTES,
+        int,
+        256 * 1024 * 1024,
+        "auto-placement threshold: smaller frames stay on the host tier",
+    )
+    r(FUGUE_CONF_JAX_COMPILE_CACHE, str, "", "persistent XLA compilation cache dir")
     # streamed parquet ingest/save: 0 = eager (whole-table). > 0 pipelines
     # arrow record-batch decode with per-shard device_put staging on load
     # (each mesh shard ships as soon as its rows are decoded, while the
     # next batches decode) and bounds parquet row groups on save. The
     # ingest stays LAZY: host-only chains never pay a device round trip.
-    FUGUE_CONF_JAX_IO_BATCH_ROWS: 0,
+    r(FUGUE_CONF_JAX_IO_BATCH_ROWS, int, 0, "streamed parquet ingest batch rows (0 = eager)")
     # group-by reduction algorithm (legacy knob, kept for back-compat):
     # "always"/"never" pin the strategy below to matmul/scatter; "auto"
     # defers to fugue.jax.groupby.strategy.
-    FUGUE_CONF_JAX_GROUPBY_MATMUL: "auto",
+    r(FUGUE_CONF_JAX_GROUPBY_MATMUL, str, "auto", "legacy matmul pin: auto | always | never")
     # segment-reduction strategy: "auto" consults the measured crossover
     # table in jax_backend/segtune.py (scatter on CPU meshes, one-hot
     # matmul on accelerators below the segment cap, sorted scatter above
     # it), sharpened by a one-shot on-device autotune; or pin one of
     # "matmul" | "matmul_bf16" | "scatter" | "sort". matmul_bf16 trades
     # ~8 mantissa bits for speed and is PIN-ONLY — auto never picks it.
-    FUGUE_CONF_JAX_GROUPBY_STRATEGY: "auto",
+    r(
+        FUGUE_CONF_JAX_GROUPBY_STRATEGY,
+        str,
+        "auto",
+        "segment-reduction kernel: auto | matmul | matmul_bf16 | scatter | sort",
+    )
     # autotune policy: "auto" probes on accelerator meshes for large
-    # frames only; True/False force it on/off.
-    FUGUE_CONF_JAX_GROUPBY_AUTOTUNE: "auto",
+    # frames only; True/False force it on/off. Mixed-type by design.
+    r(FUGUE_CONF_JAX_GROUPBY_AUTOTUNE, object, "auto", "one-shot strategy autotune: auto | bool")
     # device-memory governance (jax_backend/memory.py): budget_bytes > 0
     # (or budget_fraction > 0 of the detected per-device memory) turns on
     # the HBM byte ledger + admission controller. An ingest/persist that
@@ -105,14 +221,69 @@ _DEFAULT_CONF: Dict[str, Any] = {
     # spills LRU persisted frames to the host tier down to low_watermark;
     # a frame whose estimated footprint alone exceeds the budget is
     # placed on the host tier directly. 0/0.0 = ungoverned (default).
-    FUGUE_CONF_JAX_MEMORY_BUDGET_BYTES: 0,
-    FUGUE_CONF_JAX_MEMORY_BUDGET_FRACTION: 0.0,
-    FUGUE_CONF_JAX_MEMORY_HIGH_WATERMARK: 0.9,
-    FUGUE_CONF_JAX_MEMORY_LOW_WATERMARK: 0.75,
-    # bounded exponential-backoff retries for the HTTP RPC client on
-    # transient transport failures (connection refused/reset, HTTP 503);
-    # non-transient HTTP errors always fail fast
-    FUGUE_CONF_RPC_HTTP_RETRIES: 2,
+    r(FUGUE_CONF_JAX_MEMORY_BUDGET_BYTES, int, 0, "device-memory budget bytes (0 = ungoverned)")
+    r(
+        FUGUE_CONF_JAX_MEMORY_BUDGET_FRACTION,
+        float,
+        0.0,
+        "budget as a fraction of detected per-device memory",
+    )
+    r(FUGUE_CONF_JAX_MEMORY_HIGH_WATERMARK, float, 0.9, "admission spill trigger fraction")
+    r(FUGUE_CONF_JAX_MEMORY_LOW_WATERMARK, float, 0.75, "spill-down target fraction")
+    # consumed with local fallbacks by their owning modules (multi-process
+    # init in jax_backend/distributed.py, HTTP RPC in rpc/http.py) rather
+    # than through the global defaults table — declared here so the
+    # analyzer's conf pass recognizes them, NOT seeded (in_defaults=False)
+    r(
+        "fugue.jax.dist.coordinator",
+        str,
+        "",
+        "host:port of process 0 for multi-process jax init",
+        in_defaults=False,
+    )
+    r(
+        "fugue.jax.dist.num_processes",
+        int,
+        1,
+        "total process count of the multi-process mesh",
+        in_defaults=False,
+    )
+    r(
+        "fugue.jax.dist.process_id",
+        int,
+        0,
+        "this process's index in the multi-process mesh",
+        in_defaults=False,
+    )
+    r(
+        "fugue.rpc.http_server.host",
+        str,
+        "127.0.0.1",
+        "bind/connect host of the HTTP RPC server",
+        in_defaults=False,
+    )
+    r(
+        "fugue.rpc.http_server.port",
+        int,
+        0,
+        "HTTP RPC server port (0 = ephemeral)",
+        in_defaults=False,
+    )
+    r(
+        "fugue.rpc.http_server.timeout",
+        float,
+        30.0,
+        "HTTP RPC request timeout (s)",
+        in_defaults=False,
+    )
+
+
+_declare_defaults()
+
+_DEFAULT_CONF: Dict[str, Any] = {
+    info.key: info.default
+    for info in _CONF_REGISTRY.values()
+    if info.in_defaults
 }
 
 _GLOBAL_CONF = ParamDict(_DEFAULT_CONF)

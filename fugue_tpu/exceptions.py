@@ -54,6 +54,25 @@ class FugueWorkflowCompileValidationError(FugueWorkflowCompileError):
     """A validation rule failed at compile time."""
 
 
+class WorkflowAnalysisError(FugueWorkflowCompileError):
+    """The pre-execution static analyzer found error-level diagnostics and
+    ``fugue.analysis`` is set to ``error``: the run is rejected BEFORE any
+    task executes. ``diagnostics`` holds every finding of the analysis
+    (not only the error-level ones), most severe first."""
+
+    def __init__(self, diagnostics):
+        self.diagnostics = list(diagnostics)
+        # compared by NAME to stay import-free of the analysis package
+        # without hardcoding the severity enum's integer layout
+        errors = [d for d in self.diagnostics if str(d.severity) == "error"]
+        msg = (
+            f"static analysis rejected the workflow with {len(errors)} "
+            "error-level diagnostic(s):\n"
+            + "\n".join(d.describe() for d in errors)
+        )
+        super().__init__(msg)
+
+
 class FugueInterfacelessError(FugueWorkflowCompileError):
     """A function couldn't be adapted into an extension (bad signature
     or missing schema hint)."""

@@ -41,6 +41,7 @@ from fugue_tpu.constants import (
     FUGUE_CONF_JAX_PARTITIONS,
     KEYWORD_PARALLELISM,
     KEYWORD_ROWCOUNT,
+    typed_conf_get,
 )
 from fugue_tpu.dataframe import (
     ArrowDataFrame,
@@ -124,7 +125,7 @@ class JaxMapEngine(MapEngine):
         # host fallback: exact reference semantics via the pandas map engine;
         # fugue.jax.default.partitions sets the split count when the spec
         # doesn't name one
-        default_parts = engine.conf.get(FUGUE_CONF_JAX_PARTITIONS, 0)
+        default_parts = typed_conf_get(engine.conf, FUGUE_CONF_JAX_PARTITIONS)
         if (
             default_parts > 0
             and partition_spec.num_partitions == "0"
@@ -582,31 +583,36 @@ class JaxExecutionEngine(ExecutionEngine):
 
     @property
     def fallbacks(self) -> Dict[str, int]:
-        """Host-fallback counters since construction (or `reset_fallbacks`)."""
+        """Read-only snapshot of the host-fallback/governance counters
+        since construction (or `reset_fallbacks`). Cited by the static
+        analyzer's cost pass when predicting host behavior."""
         return dict(self._fallbacks)
 
     def reset_fallbacks(self) -> None:
         self._fallbacks.clear()
 
-    def _count_fallback(self, op: str, why: str = "") -> None:
-        self._fallbacks[op] = self._fallbacks.get(op, 0) + 1
+    def _bump_fallback_counter(self, name: str, kind: str, detail: str) -> None:
+        """The ONE increment path behind every fallback-surface counter:
+        host fallbacks and memory-governance events share the same dict,
+        the same info log shape, and therefore the same assertions in
+        tests/benches."""
+        self._fallbacks[name] = self._fallbacks.get(name, 0) + 1
         self.log.info(
-            "fugue_tpu.jax host fallback: %s%s",
-            op,
-            f" ({why})" if why else "",
+            "fugue_tpu.jax %s: %s%s",
+            kind,
+            name,
+            f" ({detail})" if detail else "",
         )
+
+    def _count_fallback(self, op: str, why: str = "") -> None:
+        self._bump_fallback_counter(op, "host fallback", why)
 
     def _count_memory_event(self, name: str, detail: str = "") -> None:
         """Memory-governance events ride the fallback counter surface
         (``mem_admit_host``/``mem_pressure``/``mem_spill``/
         ``mem_oom_feedback``) so tests and benches assert governance ran
         the same way they assert a pipeline stayed on device."""
-        self._fallbacks[name] = self._fallbacks.get(name, 0) + 1
-        self.log.info(
-            "fugue_tpu.jax memory governance: %s%s",
-            name,
-            f" ({detail})" if detail else "",
-        )
+        self._bump_fallback_counter(name, "memory governance", detail)
 
     @property
     def memory_stats(self) -> Dict[str, Any]:
@@ -2259,7 +2265,7 @@ class JaxExecutionEngine(ExecutionEngine):
             num_segments,
             n_payload,
             candidates,
-            self.conf.get(FUGUE_CONF_JAX_GROUPBY_AUTOTUNE, "auto"),
+            typed_conf_get(self.conf, FUGUE_CONF_JAX_GROUPBY_AUTOTUNE),
             self.log,
         )
 

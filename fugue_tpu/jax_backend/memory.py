@@ -83,13 +83,15 @@ _DEFAULT_TIER_CAPACITY_PER_DEVICE = 2 * 1024 * 1024 * 1024
 _OOM_BYTES_RE = re.compile(r"(?:allocat\w*|of)\s+(\d+)\s*(?:bytes|B)\b", re.I)
 
 
-def detect_tier_capacity(mesh: Any) -> int:
-    """Total device-tier memory over the mesh: the sum of each device's
+def detect_devices_capacity(devices: Any) -> int:
+    """Total memory over an iterable of jax devices: each device's
     ``memory_stats()['bytes_limit']`` where the backend reports it
-    (TPU/GPU), else the synthetic CPU default per device."""
+    (TPU/GPU), else the synthetic CPU default per device. Shared by the
+    mesh-level detection below and the static analyzer's lint-mode
+    ``budget_fraction`` resolution (no engine/mesh exists there)."""
     total = 0
     ndev = 0
-    for d in mesh.devices.flat:
+    for d in devices:
         ndev += 1
         limit = 0
         try:
@@ -102,6 +104,11 @@ def detect_tier_capacity(mesh: Any) -> int:
             limit if limit > 0 else _DEFAULT_TIER_CAPACITY_PER_DEVICE
         )
     return total if ndev > 0 else _DEFAULT_TIER_CAPACITY_PER_DEVICE
+
+
+def detect_tier_capacity(mesh: Any) -> int:
+    """Total device-tier memory over the mesh."""
+    return detect_devices_capacity(mesh.devices.flat)
 
 
 def _field_device_width(tp: pa.DataType) -> int:
@@ -138,6 +145,15 @@ def estimate_table_device_bytes(table: pa.Table) -> int:
         if table.column(i).null_count > 0:
             total += n  # bool validity mask
     return total
+
+
+def estimate_schema_device_bytes(schema: Any, rows: int) -> int:
+    """Schema-only variant of :func:`estimate_table_device_bytes` for the
+    static analyzer's cost pass: the same dtype-widened per-row widths,
+    but from a schema + row count alone (no data, so no per-column null
+    masks — a slight under-bound relative to the table estimator)."""
+    fields = schema if isinstance(schema, pa.Schema) else getattr(schema, "fields", schema)
+    return sum(_field_device_width(f.type) for f in fields) * int(rows)
 
 
 def move_blocks_to_mesh(blocks: JaxBlocks, mesh: Any) -> bool:

@@ -10,6 +10,13 @@ from fugue_tpu.sql_frontend.workflow_sql import (  # noqa: F401
     fill_sql_template,
     fugue_sql,
     fugue_sql_flow,
+    lint_sql,
 )
 
-__all__ = ["fugue_sql", "fugue_sql_flow", "FugueSQLWorkflow", "fill_sql_template"]
+__all__ = [
+    "fugue_sql",
+    "fugue_sql_flow",
+    "FugueSQLWorkflow",
+    "fill_sql_template",
+    "lint_sql",
+]

@@ -12,6 +12,7 @@ from fugue_tpu.workflow.workflow import FugueWorkflow, WorkflowDataFrame
 
 __all__ = [
     "FugueSQLWorkflow", "fugue_sql", "fugue_sql_flow", "fill_sql_template",
+    "lint_sql",
 ]
 
 
@@ -133,6 +134,19 @@ def _fugue_sql_impl(
     from fugue_tpu.dataframe.api import get_native_as_df
 
     return result.native if result.is_local else get_native_as_df(result)
+
+
+def lint_sql(query: str, *args: Any, conf: Any = None, **kwargs: Any) -> Any:
+    """Compile a FugueSQL script into a DAG and statically analyze it
+    WITHOUT executing anything: returns the list of
+    :class:`~fugue_tpu.analysis.Diagnostic` findings (most severe first).
+    The same compilation path as :func:`fugue_sql_flow`, so FugueSQL
+    syntax errors surface as usual; column/partition/conf problems come
+    back as stable-coded diagnostics instead of mid-run failures. Also
+    available from the shell: ``python -m fugue_tpu.analysis script.fsql``."""
+    dag = FugueSQLWorkflow(conf)
+    dag._sql(query, _caller_vars(2), *args, **kwargs)
+    return dag.analyze(conf=conf)
 
 
 def fugue_sql(

@@ -1,0 +1,57 @@
+"""User-callsite attribution, shared by fault notes and analyzer
+diagnostics.
+
+A workflow DAG is built at one place (user code) and fails at another
+(runner/engine internals, possibly minutes later). Both the fault layer
+(error notes spliced into runtime failures) and the static analyzer
+(diagnostics pointing at the line that DEFINED a bad task) need the same
+primitive: "the last N user (non-framework) frames of the current stack".
+Extracted from the exception-surgery module so neither consumer drags in
+traceback-pruning machinery.
+"""
+
+import traceback
+from typing import List, Optional
+
+
+def package_dir(prefix: str) -> Optional[str]:
+    """The on-disk directory of the package named by a hide prefix
+    (``'fugue_tpu.'`` -> ``'/…/fugue_tpu/'``), or None if unimportable."""
+    import importlib
+    import os
+
+    try:
+        mod = importlib.import_module(prefix.rstrip("."))
+        f = getattr(mod, "__file__", None)
+        if f is None:
+            return None
+        return os.path.dirname(os.path.abspath(f)).replace("\\", "/") + "/"
+    except Exception:
+        return None
+
+
+def extract_user_callsite(inject: int, hide_prefixes: List[str]) -> List[str]:
+    """Capture the current stack's last ``inject`` user (non-framework)
+    frames as display strings, for splicing into runtime errors and
+    analyzer diagnostics."""
+    if inject <= 0:
+        return []
+    # resolve each hidden package to its REAL directory — fragment
+    # matching ("/fugue_tpu/" in path) would also hide user code that
+    # merely lives under a same-named folder (tests/fugue_tpu/...)
+    pkg_dirs = [d for d in (package_dir(p) for p in hide_prefixes if p) if d]
+    frames: List[List[str]] = []  # each entry: [header, code?] of one frame
+    for frame in reversed(traceback.extract_stack()[:-1]):
+        fname = frame.filename.replace("\\", "/")
+        if any(fname.startswith(d) for d in pkg_dirs):
+            continue
+        entry = [f'  File "{frame.filename}", line {frame.lineno}, in {frame.name}']
+        if frame.line:
+            entry.append(f"    {frame.line}")
+        frames.append(entry)
+        if len(frames) >= inject:
+            break
+    res: List[str] = []
+    for entry in reversed(frames):  # reverse frame ORDER, keep header/code pairs
+        res.extend(entry)
+    return res

@@ -1,11 +1,19 @@
 """Error-trace surgery: prune framework frames from tracebacks and point the
 user at their own call site (reference fugue/_utils/exception.py:7-42 +
 workflow.py:1586-1604 behavior). jax/XLA tracebacks are notoriously deep —
-this keeps workflow failures readable."""
+this keeps workflow failures readable.
 
-import traceback
+Callsite attribution itself lives in :mod:`fugue_tpu.utils.callsite` (it is
+shared with the static analyzer); ``extract_user_callsite`` is re-exported
+here for pre-refactor importers."""
+
 from types import TracebackType
 from typing import List, Optional
+
+from fugue_tpu.utils.callsite import (  # noqa: F401  (re-export)
+    extract_user_callsite,
+    package_dir as _package_dir,
+)
 
 
 def prune_traceback(
@@ -47,22 +55,6 @@ def _is_hidden(tb: TracebackType, prefixes: List[str]) -> bool:
     return any(_match_module(module, p) for p in prefixes if p != "")
 
 
-def _package_dir(prefix: str) -> Optional[str]:
-    """The on-disk directory of the package named by a hide prefix
-    (``'fugue_tpu.'`` -> ``'/…/fugue_tpu/'``), or None if unimportable."""
-    import importlib
-    import os
-
-    try:
-        mod = importlib.import_module(prefix.rstrip("."))
-        f = getattr(mod, "__file__", None)
-        if f is None:
-            return None
-        return os.path.dirname(os.path.abspath(f)).replace("\\", "/") + "/"
-    except Exception:
-        return None
-
-
 def add_error_note(ex: BaseException, note: str) -> None:
     """Attach a PEP-678 note to an exception, portably: ``add_note`` on
     3.11+, a hand-rolled ``__notes__`` list on 3.10 (programmatically
@@ -81,29 +73,3 @@ def add_error_note(ex: BaseException, note: str) -> None:
         notes.append(note)
     except Exception:  # pragma: no cover - never mask the original error
         pass
-
-
-def extract_user_callsite(inject: int, hide_prefixes: List[str]) -> List[str]:
-    """Capture the current stack's last ``inject`` user (non-framework)
-    frames as display strings, for splicing into runtime errors."""
-    if inject <= 0:
-        return []
-    # resolve each hidden package to its REAL directory — fragment
-    # matching ("/fugue_tpu/" in path) would also hide user code that
-    # merely lives under a same-named folder (tests/fugue_tpu/...)
-    pkg_dirs = [d for d in (_package_dir(p) for p in hide_prefixes if p) if d]
-    frames: List[List[str]] = []  # each entry: [header, code?] of one frame
-    for frame in reversed(traceback.extract_stack()[:-1]):
-        fname = frame.filename.replace("\\", "/")
-        if any(fname.startswith(d) for d in pkg_dirs):
-            continue
-        entry = [f'  File "{frame.filename}", line {frame.lineno}, in {frame.name}']
-        if frame.line:
-            entry.append(f"    {frame.line}")
-        frames.append(entry)
-        if len(frames) >= inject:
-            break
-    res: List[str] = []
-    for entry in reversed(frames):  # reverse frame ORDER, keep header/code pairs
-        res.extend(entry)
-    return res

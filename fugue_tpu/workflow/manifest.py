@@ -37,6 +37,7 @@ from typing import Any, Dict, Optional, Tuple
 from fugue_tpu.constants import (
     FUGUE_CONF_WORKFLOW_CHECKPOINT_PATH,
     FUGUE_CONF_WORKFLOW_RESUME,
+    typed_conf_get,
 )
 
 
@@ -94,10 +95,10 @@ class RunManifest:
     ) -> Optional["RunManifest"]:
         """Build the manifest manager when resume is on and a durable
         checkpoint dir exists to hold it; None otherwise."""
-        if not engine.conf.get(FUGUE_CONF_WORKFLOW_RESUME, False):
+        if not typed_conf_get(engine.conf, FUGUE_CONF_WORKFLOW_RESUME):
             return None
         base = str(
-            engine.conf.get(FUGUE_CONF_WORKFLOW_CHECKPOINT_PATH, "")
+            typed_conf_get(engine.conf, FUGUE_CONF_WORKFLOW_CHECKPOINT_PATH)
         ).strip()
         if base == "":
             return None
@@ -108,7 +109,7 @@ class RunManifest:
     @property
     def uri(self) -> str:
         base = str(
-            self._engine.conf.get(FUGUE_CONF_WORKFLOW_CHECKPOINT_PATH, "")
+            typed_conf_get(self._engine.conf, FUGUE_CONF_WORKFLOW_CHECKPOINT_PATH)
         ).strip()
         return self._engine.fs.join(base, f"manifest_{self._wf_uuid}.json")
 

@@ -90,6 +90,17 @@ class FugueTask:
         return res
 
     @property
+    def task_type(self) -> str:
+        """``"create"`` / ``"process"`` / ``"output"`` — the task's role in
+        the DAG, used by static analysis and display tooling without
+        isinstance-ing against concrete task classes."""
+        if isinstance(self, CreateTask):
+            return "create"
+        if isinstance(self, OutputTask):
+            return "output"
+        return "process"
+
+    @property
     def name(self) -> str:
         # the extension is usually a CLASS (builtins) — use its own name,
         # not "type"; instances/functions fall back to their type/name.

@@ -13,6 +13,7 @@ from fugue_tpu.collections.yielded import PhysicalYielded, Yielded
 from fugue_tpu.column.expressions import ColumnExpr
 from fugue_tpu.column.sql import SelectColumns
 from fugue_tpu.constants import (
+    FUGUE_CONF_ANALYSIS,
     FUGUE_CONF_WORKFLOW_CONCURRENCY,
     FUGUE_CONF_WORKFLOW_EXCEPTION_HIDE,
     FUGUE_CONF_WORKFLOW_EXCEPTION_INJECT,
@@ -518,6 +519,11 @@ class FugueWorkflow:
         return self._yields
 
     @property
+    def tasks(self) -> List[FugueTask]:
+        """The DAG's tasks in build (= dependency) order."""
+        return list(self._tasks)
+
+    @property
     def last_df(self) -> Optional[WorkflowDataFrame]:
         return self._last_df
 
@@ -732,9 +738,98 @@ class FugueWorkflow:
                                           title=title or ""),
         )
 
+    # ---- static analysis -------------------------------------------------
+    def analyze(self, conf: Any = None, engine: Any = None) -> List[Any]:
+        """Statically analyze the built (unexecuted) DAG and return the
+        list of :class:`~fugue_tpu.analysis.Diagnostic` findings, most
+        severe first — stable-coded rules over schemas, partition specs,
+        conf keys and predicted engine behavior. Nothing executes.
+
+        With no ``engine``, every rule scope runs (lint mode); pass the
+        target engine — a live instance or the same name/spec ``run()``
+        accepts (e.g. ``"jax"``) — to narrow engine-specific rules to the
+        actual backend."""
+        from fugue_tpu.analysis import Analyzer
+
+        if engine is not None and not hasattr(engine, "conf"):
+            # an engine NAME/spec, as run() accepts: resolve it the same
+            # way — analyze(engine="jax") must not silently degrade to a
+            # generic-only (false-clean) report
+            engine = make_execution_engine(engine, conf)
+        merged = ParamDict(self._conf)
+        # a live engine brings its own conf (row_bucket, memory budget, …);
+        # engine-dependent rules must read it, not the global defaults
+        engine_conf = getattr(engine, "conf", None)
+        if engine_conf is not None:
+            merged.update(ParamDict(engine_conf))
+        merged.update(ParamDict(conf))
+        return Analyzer().analyze(self, conf=merged, engine=engine)
+
+    def _pre_run_analysis(self, e: Any, run_conf: Any = None) -> None:
+        """The ``fugue.analysis`` gate at the top of ``run()``: ``off``
+        skips, ``warn`` (default) logs findings and proceeds, ``error``
+        raises :class:`WorkflowAnalysisError` before any task executes
+        when error-level diagnostics exist. The analyzer itself is
+        sandboxed — an internal analyzer failure never blocks a run."""
+        # precedence: run/engine conf > workflow compile conf > default.
+        # run() hands us its RAW conf argument, so an explicitly passed
+        # run-level value always wins — even one equal to the default
+        # (e.g. run-level "warn" relaxing a compile-level "error"). Only
+        # the merged engine conf inherits the global default, so there an
+        # inherited-default value is "not set" and yields to an explicit
+        # compile-conf override.
+        from fugue_tpu.constants import conf_default
+
+        default = str(conf_default(FUGUE_CONF_ANALYSIS))
+        raw_run = ParamDict(run_conf)
+        e_val = str(e.conf.get(FUGUE_CONF_ANALYSIS, default))
+        c_val = str(self._conf.get(FUGUE_CONF_ANALYSIS, default))
+        if FUGUE_CONF_ANALYSIS in raw_run:
+            mode = str(raw_run[FUGUE_CONF_ANALYSIS]).strip().lower()
+        else:
+            mode = (
+                c_val if e_val == default and c_val != default else e_val
+            ).strip().lower()
+        if mode in ("off", "false", "0", "none", ""):
+            return
+        if mode not in ("warn", "error", "true", "on", "1"):
+            # an unrecognized mode must NOT silently degrade to warn: the
+            # user asked for a gate that doesn't exist
+            raise ValueError(
+                f"invalid {FUGUE_CONF_ANALYSIS} mode {mode!r}: "
+                "expected off | warn | error"
+            )
+        from fugue_tpu.analysis import Severity
+        from fugue_tpu.exceptions import WorkflowAnalysisError
+
+        try:
+            diags = self.analyze(conf=e.conf, engine=e)
+        except WorkflowAnalysisError:  # pragma: no cover - defensive
+            raise
+        except Exception as ex:  # analyzer bug: log VISIBLY (the user asked
+            # for a gate that silently didn't run), never block the run
+            e.log.warning(
+                "fugue_tpu workflow analysis crashed and was skipped "
+                "(the %s gate did not run): %s: %s",
+                FUGUE_CONF_ANALYSIS,
+                type(ex).__name__,
+                ex,
+            )
+            return
+        if mode == "error" and any(
+            d.severity is Severity.ERROR for d in diags
+        ):
+            raise WorkflowAnalysisError(diags)
+        for d in diags:
+            if d.severity is Severity.ERROR or d.severity is Severity.WARN:
+                e.log.warning("fugue_tpu analysis: %s", d.describe())
+            else:
+                e.log.info("fugue_tpu analysis: %s", d.describe(False))
+
     # ---- run -------------------------------------------------------------
     def run(self, engine: Any = None, conf: Any = None) -> "FugueWorkflowResult":
         e = make_execution_engine(engine, conf)
+        self._pre_run_analysis(e, run_conf=conf)
         execution_id = str(uuid4())
         rpc_server = make_rpc_server(e.conf)
         checkpoint_path = CheckpointPath(e)

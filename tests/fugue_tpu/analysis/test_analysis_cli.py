@@ -1,0 +1,135 @@
+"""The ``python -m fugue_tpu.analysis`` entry point: lints FugueSQL files
+and workflow modules without executing them; ``--self-test`` is the
+pre-merge gate (nonzero exit on any error-level diagnostic)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from fugue_tpu.analysis.__main__ import main
+
+pytestmark = pytest.mark.analysis
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+)
+
+GOOD_SQL = """
+a = CREATE [[0, "x"], [1, "y"]] SCHEMA k:int, v:str
+b = SELECT k, v FROM a WHERE k > 0
+PRINT b
+"""
+
+BAD_SQL = """
+a = CREATE [[0, "x"]] SCHEMA k:int, v:str
+TAKE 1 ROW FROM a PREPARTITION BY ghost
+PRINT
+"""
+
+MODULE_SRC = '''
+from fugue_tpu.workflow.workflow import FugueWorkflow
+
+def build_workflow():
+    dag = FugueWorkflow()
+    dag.df([[0]], "a:int").partition_by("missing").take(1)
+    return dag
+'''
+
+
+def test_cli_inprocess_good_sql(tmp_path, capsys):
+    p = tmp_path / "good.fsql"
+    p.write_text(GOOD_SQL)
+    assert main([str(p)]) == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_cli_inprocess_bad_sql(tmp_path, capsys):
+    p = tmp_path / "bad.fsql"
+    p.write_text(BAD_SQL)
+    assert main([str(p)]) == 1
+    out = capsys.readouterr().out
+    assert "FWF101" in out and "ghost" in out
+
+
+def test_cli_inprocess_conf_override(tmp_path, capsys):
+    p = tmp_path / "good.fsql"
+    p.write_text(GOOD_SQL)
+    assert main([str(p), "--conf", "fugue.jax.memory.budgt_bytes=1"]) == 1
+    assert "FWF201" in capsys.readouterr().out
+
+
+def test_cli_inprocess_module_target(tmp_path, capsys, monkeypatch):
+    mod = tmp_path / "wfmod_cli_test.py"
+    mod.write_text(MODULE_SRC)
+    monkeypatch.syspath_prepend(str(tmp_path))
+    assert main(["wfmod_cli_test:build_workflow"]) == 1
+    out = capsys.readouterr().out
+    assert "FWF101" in out
+    # the module's own build line is a GENUINE user callsite and must
+    # survive the bootstrap-frame filter
+    assert "wfmod_cli_test.py" in out and "defined at" in out
+
+
+def test_cli_subprocess_module_target_shows_user_frame(tmp_path):
+    # under a real `python -m` the callsite leads with runpy bootstrap
+    # frames (frozen on py3.11+); only those are stripped — the module
+    # frame stays visible
+    mod = tmp_path / "wfmod_subproc_test.py"
+    mod.write_text(MODULE_SRC)
+    res = subprocess.run(
+        [sys.executable, "-m", "fugue_tpu.analysis", "wfmod_subproc_test"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=240,
+        env={
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": f"{tmp_path}{os.pathsep}{os.environ.get('PYTHONPATH', '')}",
+        },
+    )
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "FWF101" in res.stdout
+    assert "wfmod_subproc_test.py" in res.stdout
+    assert "runpy" not in res.stdout
+
+
+def test_cli_inprocess_bad_target(capsys):
+    assert main(["no.such.module"]) == 2
+    assert main([]) == 2
+
+
+def test_cli_directory_does_not_shadow_module_target(tmp_path, monkeypatch):
+    # a directory named like the module spec must not hijack dispatch
+    # into the sql-file path: only FILES are lintable sql targets
+    pkg = tmp_path / "wfmod_dir_test"
+    pkg.mkdir()
+    mod = tmp_path / "wfmod_dir_test.py"
+    mod.write_text(MODULE_SRC)
+    monkeypatch.syspath_prepend(str(tmp_path))
+    monkeypatch.chdir(tmp_path)
+    assert main(["wfmod_dir_test"]) == 1  # module linted, not IsADirectoryError
+
+
+def test_cli_min_severity_filter(tmp_path, capsys):
+    p = tmp_path / "good.fsql"
+    p.write_text(GOOD_SQL)
+    assert main([str(p), "--min-severity", "error"]) == 0
+    out = capsys.readouterr().out
+    assert "FWF302" not in out  # info finding hidden by the floor
+
+
+def test_cli_subprocess_self_test_gate():
+    """The pre-merge gate form: a real interpreter, exit code contract."""
+    res = subprocess.run(
+        [sys.executable, "-m", "fugue_tpu.analysis", "--self-test"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "self-test passed" in res.stdout

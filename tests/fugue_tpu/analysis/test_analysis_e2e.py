@@ -1,0 +1,165 @@
+"""End-to-end ``fugue.analysis`` gate semantics on real runs:
+
+- ``error``: a bad DAG raises :class:`WorkflowAnalysisError` BEFORE any
+  task executes (proved by a counting creator);
+- ``warn`` (default): diagnostics are logged, execution proceeds;
+- ``off``: the analyzer never runs.
+
+Plus the acceptance-criteria scenario: unknown partition column + typo'd
+conf key + non-deterministic checkpoint under resume -> three distinct
+stable-coded diagnostics from ``workflow.analyze()`` without executing
+any task."""
+
+import pandas as pd
+import pytest
+
+from fugue_tpu.analysis import Severity
+from fugue_tpu.exceptions import WorkflowAnalysisError
+from fugue_tpu.workflow.workflow import FugueWorkflow
+
+pytestmark = pytest.mark.analysis
+
+EXECUTED = []
+
+
+# schema: a:int
+def _tracked_create() -> pd.DataFrame:
+    EXECUTED.append("create")
+    return pd.DataFrame({"a": [0]})
+
+
+def _bad_dag() -> FugueWorkflow:
+    dag = FugueWorkflow()
+    df = dag.create(_tracked_create)
+    df.checkpoint()  # non-deterministic, bad under resume
+    df.partition_by("ghost").take(1)
+    return dag
+
+
+BAD_CONF = {
+    "fugue.jax.memory.budgt_bytes": 4096,  # typo'd key
+    "fugue.workflow.resume": True,
+}
+
+
+@pytest.fixture(autouse=True)
+def _reset_tracker():
+    EXECUTED.clear()
+    yield
+    EXECUTED.clear()
+
+
+def test_acceptance_three_distinct_diagnostics_without_execution():
+    dag = _bad_dag()
+    diags = dag.analyze(conf=BAD_CONF)
+    assert EXECUTED == []  # analysis never executes a task
+    errors = {d.code: d for d in diags if d.severity is Severity.ERROR}
+    assert {"FWF101", "FWF201", "FWF401"} <= set(errors)
+    # each carries the offending task name + user callsite (conf findings
+    # are workflow-level: no task to point at)
+    for code in ("FWF101", "FWF401"):
+        d = errors[code]
+        assert d.task_name != ""
+        assert any(__file__ in line for line in d.callsite)
+
+
+def test_error_mode_raises_before_any_task_executes(tmp_path):
+    dag = _bad_dag()
+    with pytest.raises(WorkflowAnalysisError) as info:
+        dag.run(
+            conf={
+                "fugue.analysis": "error",
+                "fugue.workflow.checkpoint.path": str(tmp_path),
+                **BAD_CONF,
+            }
+        )
+    assert EXECUTED == []  # rejected BEFORE execution
+    codes = {d.code for d in info.value.diagnostics}
+    assert {"FWF101", "FWF201", "FWF401"} <= codes
+    assert "FWF101" in str(info.value)
+
+
+def test_warn_mode_logs_and_proceeds(tmp_path, caplog):
+    import logging
+
+    dag = FugueWorkflow()
+    dag.create(_tracked_create).persist()
+    with caplog.at_level(logging.WARNING):
+        dag.run(conf={"fugue.analysis": "warn", "fugue.jax.memory.budgt_bytes": 1})
+    assert EXECUTED == ["create"]  # ran despite the error-level finding
+    assert any("FWF201" in r.message for r in caplog.records)
+
+
+def test_error_mode_passes_clean_dag():
+    dag = FugueWorkflow()
+    dag.create(_tracked_create).persist()
+    dag.run(conf={"fugue.analysis": "error"})
+    assert EXECUTED == ["create"]
+
+
+def test_off_mode_skips_analysis(tmp_path, caplog):
+    import logging
+
+    dag = _bad_dag()
+    dag.tasks[-1].checkpoint = type(dag.tasks[-1].checkpoint)()  # noop
+    # the DAG still fails at RUNTIME on the ghost column; off-mode must
+    # reach that runtime error rather than an analysis error
+    with caplog.at_level(logging.WARNING):
+        with pytest.raises(Exception) as info:
+            dag.run(conf={"fugue.analysis": "off", **BAD_CONF})
+    assert not isinstance(info.value, WorkflowAnalysisError)
+    assert not any("FWF" in r.message for r in caplog.records)
+    assert EXECUTED == ["create"]  # execution was attempted
+
+
+def test_compile_conf_mode_precedence():
+    # a workflow built with fugue.analysis=error rejects its own bad DAG
+    # even when run() brings no conf of its own...
+    dag = FugueWorkflow({"fugue.analysis": "error"})
+    dag.create(_tracked_create).partition_by("ghost").take(1)
+    with pytest.raises(WorkflowAnalysisError):
+        dag.run()
+    assert EXECUTED == []
+    # ...but an explicit run-level override still wins: with analysis off
+    # nothing is rejected pre-run and execution is attempted
+    dag2 = FugueWorkflow({"fugue.analysis": "error"})
+    dag2.create(_tracked_create).partition_by("ghost").take(1)
+    try:
+        dag2.run(conf={"fugue.analysis": "off"})
+    except WorkflowAnalysisError:  # pragma: no cover
+        pytest.fail("run-level off must override compile-level error")
+    except Exception:
+        pass  # any RUNTIME failure of the bad DAG is fine here
+    assert EXECUTED == ["create"]
+
+
+def test_run_level_default_value_still_overrides_compile_conf():
+    # an EXPLICIT run-level "warn" — even though it equals the global
+    # default — must relax a compile-level "error": run conf > compile
+    # conf is about explicit presence, not about differing from default
+    dag = FugueWorkflow({"fugue.analysis": "error"})
+    dag.create(_tracked_create).partition_by("ghost").take(1)
+    try:
+        dag.run(conf={"fugue.analysis": "warn"})
+    except WorkflowAnalysisError:  # pragma: no cover
+        pytest.fail("explicit run-level warn must override compile-level error")
+    except Exception:
+        pass  # the bad DAG may still fail at RUNTIME; that's the point
+    assert EXECUTED == ["create"]  # execution was attempted, not gated
+
+
+def test_invalid_analysis_mode_rejected():
+    dag = FugueWorkflow()
+    dag.create(_tracked_create)
+    with pytest.raises(ValueError, match="fugue.analysis"):
+        dag.run(conf={"fugue.analysis": "strict"})  # no such mode
+    assert EXECUTED == []
+
+
+def test_default_mode_is_warn():
+    dag = FugueWorkflow()
+    dag.create(_tracked_create)
+    # an error-level diagnostic present but the run proceeds (default warn)
+    dag._tasks[-1].partition_spec = dag._tasks[-1].partition_spec  # no-op
+    res = dag.run(conf={"fugue.jax.memory.budgt_bytes": 1})
+    assert EXECUTED == ["create"]

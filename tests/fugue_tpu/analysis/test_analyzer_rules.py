@@ -1,0 +1,372 @@
+"""Rule corpus: every analyzer rule gets a minimal bad-workflow fixture
+asserting its stable code, severity, offending task name and user
+callsite — the contract diagnostics tooling (CI annotations, editors)
+keys on."""
+
+import pandas as pd
+import pytest
+
+from fugue_tpu.analysis import Analyzer, Severity, all_rules
+from fugue_tpu.column import functions as f
+from fugue_tpu.column.expressions import col
+from fugue_tpu.workflow.workflow import FugueWorkflow
+
+pytestmark = pytest.mark.analysis
+
+THIS_FILE = __file__
+
+
+# schema: *,s:double
+def _add_s(df: pd.DataFrame) -> pd.DataFrame:
+    return df.assign(s=df["b"] * 2.0)
+
+
+def _analyze(dag, conf=None, codes=None):
+    merged = dict(dag._conf)
+    merged.update(conf or {})
+    diags = Analyzer().analyze(dag, conf=merged)
+    if codes is None:
+        return diags
+    return [d for d in diags if d.code in codes]
+
+
+def _assert_diag(diags, code, severity, task_prefix=None, needs_callsite=True):
+    found = [d for d in diags if d.code == code]
+    assert len(found) >= 1, f"no {code} in {[d.code for d in diags]}"
+    d = found[0]
+    assert d.severity is severity
+    if task_prefix is not None:
+        assert d.task_name.startswith(task_prefix), d.task_name
+    if needs_callsite:
+        assert any(THIS_FILE in line for line in d.callsite), d.callsite
+    return d
+
+
+def test_fwf101_unknown_partition_column():
+    dag = FugueWorkflow()
+    df = dag.df([[0, 1.0]], "a:int,b:double")
+    df.partition_by("nope").transform(_add_s)
+    d = _assert_diag(
+        _analyze(dag), "FWF101", Severity.ERROR, task_prefix="RunTransformer"
+    )
+    assert "nope" in d.message and "a, b" in d.message
+
+
+def test_fwf102_unknown_presort_column():
+    dag = FugueWorkflow()
+    df = dag.df([[0, 1.0]], "a:int,b:double")
+    df.partition(by=["a"], presort="zzz desc").take(1)
+    d = _assert_diag(_analyze(dag), "FWF102", Severity.ERROR, task_prefix="Take")
+    assert "zzz" in d.message
+
+
+def test_fwf102_take_presort_param():
+    dag = FugueWorkflow()
+    dag.df([[0]], "a:int").take(1, presort="ghost desc")
+    _assert_diag(_analyze(dag), "FWF102", Severity.ERROR, task_prefix="Take")
+
+
+def test_fwf103_unknown_column_references():
+    dag = FugueWorkflow()
+    df = dag.df([[0, 1.0]], "a:int,b:double")
+    df.rename({"ghost": "g"})
+    df.drop(["phantom"])
+    df.select(col("a"), col("missing"))
+    diags = _analyze(dag, codes={"FWF103"})
+    assert len(diags) == 3
+    wheres = " | ".join(d.message for d in diags)
+    for name in ("ghost", "phantom", "missing"):
+        assert name in wheres
+    _assert_diag(diags, "FWF103", Severity.ERROR)
+
+
+def test_fwf103_join_on_checks_every_side():
+    dag = FugueWorkflow()
+    left = dag.df([[0, 1]], "a:int,b:int")
+    right = dag.df([[0, 2]], "a:int,c:int")
+    left.inner_join(right, on=["b"])  # b exists left, not right
+    d = _assert_diag(_analyze(dag), "FWF103", Severity.ERROR, task_prefix="RunJoin")
+    assert "'b'" in d.message
+
+
+def test_fwf104_unverifiable_consumer_is_info():
+    dag = FugueWorkflow()
+    df = dag.load("/nonexistent/data.parquet")  # schema unknown statically
+    df.partition_by("k").transform(_add_s)
+    d = _assert_diag(
+        _analyze(dag), "FWF104", Severity.INFO, task_prefix="RunTransformer"
+    )
+    assert "'k'" in d.message
+    # and crucially NO error-level diagnostic: unknown is not wrong
+    assert not any(
+        d.severity is Severity.ERROR for d in _analyze(dag, codes={"FWF101"})
+    )
+
+
+def test_fwf105_duplicate_output_columns():
+    dag = FugueWorkflow()
+    df = dag.df([[0, 1.0]], "a:int,b:double")
+    df.rename({"a": "b2", "b": "b2"})
+    d = _assert_diag(_analyze(dag), "FWF105", Severity.ERROR, task_prefix="Rename")
+    assert "duplicat" in d.message.lower()
+
+
+def test_fwf105_join_duplicate_non_key_column():
+    dag = FugueWorkflow()
+    left = dag.df([[0, 1]], "a:int,v:int")
+    right = dag.df([[0, 2]], "a:int,v:int")
+    left.inner_join(right, on=["a"])  # v collides on both sides
+    d = _assert_diag(_analyze(dag), "FWF105", Severity.ERROR, task_prefix="RunJoin")
+    assert "'v'" in d.message
+
+
+def test_fwf106_unconvertible_transformer():
+    dag = FugueWorkflow()
+    df = dag.df([[0]], "a:int")
+    df.transform(lambda d: d)  # no schema hint, no annotations
+    _assert_diag(
+        _analyze(dag), "FWF106", Severity.ERROR, task_prefix="RunTransformer"
+    )
+
+
+def test_fwf201_unknown_conf_key_did_you_mean():
+    dag = FugueWorkflow()
+    dag.df([[0]], "a:int").persist()
+    diags = _analyze(dag, conf={"fugue.jax.memory.budgt_bytes": 64})
+    d = _assert_diag(diags, "FWF201", Severity.ERROR, needs_callsite=False)
+    assert "fugue.jax.memory.budget_bytes" in d.message  # the suggestion
+
+
+def test_fwf201_ignores_non_fugue_keys():
+    dag = FugueWorkflow()
+    dag.df([[0]], "a:int").persist()
+    diags = _analyze(dag, conf={"myapp.custom.key": 1})
+    assert not any(d.code == "FWF201" for d in diags)
+
+
+def test_fwf202_unconvertible_conf_value():
+    dag = FugueWorkflow()
+    dag.df([[0]], "a:int").persist()
+    diags = _analyze(
+        dag, conf={"fugue.jax.memory.high_watermark": "almost full"}
+    )
+    d = _assert_diag(diags, "FWF202", Severity.ERROR, needs_callsite=False)
+    assert "high_watermark" in d.message and "float" in d.message
+
+
+def test_fwf202_convertible_strings_pass():
+    dag = FugueWorkflow()
+    dag.df([[0]], "a:int").persist()
+    diags = _analyze(
+        dag,
+        conf={
+            "fugue.jax.memory.high_watermark": "0.8",  # str -> float ok
+            "fugue.workflow.concurrency": "4",  # str -> int ok
+        },
+    )
+    assert not any(d.code == "FWF202" for d in diags)
+
+
+def test_fwf301_host_only_dtypes_flagged_once():
+    dag = FugueWorkflow()
+    df = dag.df([[0, b"raw"]], "a:int,blob:bytes")
+    df.filter(col("a") >= 0).persist()  # passthrough must NOT re-flag
+    diags = _analyze(dag, codes={"FWF301"})
+    assert len(diags) == 1
+    d = _assert_diag(diags, "FWF301", Severity.WARN, task_prefix="CreateData")
+    assert "blob" in d.message
+
+
+def test_fwf301_cites_only_genuine_host_fallbacks():
+    # engine.fallbacks also carries mem_* governance counters; citing a
+    # spill as a "host fallback" would be a factually wrong diagnostic
+    class _Eng:
+        fallbacks = {"mem_spill": 3}
+
+    class _EngMixed:
+        fallbacks = {"mem_spill": 3, "map": 1}
+
+    def _with_engine(engine):
+        dag = FugueWorkflow()
+        dag.df([[0, b"raw"]], "a:int,blob:bytes")
+        return [
+            d
+            for d in Analyzer().analyze(
+                dag, engine=engine, scopes={"generic", "jax"}
+            )
+            if d.code == "FWF301"
+        ]
+
+    d = _assert_diag(_with_engine(_Eng()), "FWF301", Severity.WARN)
+    assert "mem_spill" not in d.message and "fallback" not in d.message
+    d = _assert_diag(_with_engine(_EngMixed()), "FWF301", Severity.WARN)
+    assert "map" in d.message and "mem_spill" not in d.message
+
+
+def test_fwf302_recompile_hazard_info():
+    dag = FugueWorkflow()
+    df = dag.df([[0]], "a:int")
+    df.filter(col("a") > 0).distinct()
+    d = _assert_diag(_analyze(dag), "FWF302", Severity.INFO)
+    assert "row_bucket" in d.message
+    # bucketing on silences it
+    diags = _analyze(dag, conf={"fugue.jax.row_bucket": 1024})
+    assert not any(x.code == "FWF302" for x in diags)
+
+
+def test_fwf303_memory_budget_prediction():
+    rows = 1000
+    dag = FugueWorkflow()
+    dag.df([[i, float(i)] for i in range(rows)], "a:int,b:double")
+    # a:int=4B + b:double=8B -> 12KB working set vs a 1KB budget
+    diags = _analyze(dag, conf={"fugue.jax.memory.budget_bytes": 1024})
+    d = _assert_diag(diags, "FWF303", Severity.WARN, task_prefix="CreateData")
+    assert "host" in d.message
+    # an adequate budget stays silent
+    diags = _analyze(dag, conf={"fugue.jax.memory.budget_bytes": 1 << 30})
+    assert not any(x.code == "FWF303" for x in diags)
+
+
+def test_fwf303_budget_fraction_resolves_in_lint_mode():
+    # governance enabled via budget_fraction ALONE must not lint clean:
+    # with no engine/mesh the rule resolves the fraction against the
+    # default all-devices capacity (synthetic 2GiB/device on CPU)
+    import jax
+
+    from fugue_tpu.jax_backend.memory import detect_devices_capacity
+
+    cap = detect_devices_capacity(jax.devices())
+    frac = 1024.0 / cap  # -> ~1KB effective budget
+    dag = FugueWorkflow()
+    dag.df([[i, float(i)] for i in range(1000)], "a:int,b:double")  # ~12KB
+    diags = _analyze(dag, conf={"fugue.jax.memory.budget_fraction": frac})
+    _assert_diag(diags, "FWF303", Severity.WARN, task_prefix="CreateData")
+
+
+def test_fwf303_oversize_frame_does_not_mask_device_spill_prediction():
+    # one frame above budget (host-admitted, off the device tier) must
+    # not suppress the spill prediction for the frames that DO land on
+    # device and together exceed the budget
+    dag = FugueWorkflow()
+    dag.df([[i, float(i)] for i in range(200)], "a:int,b:double")  # ~2.4KB > 1KB
+    dag.df([[i] for i in range(180)], "a:int")  # ~720B
+    dag.df([[i] for i in range(180)], "a:int")  # ~720B: device total > 1KB
+    diags = _analyze(dag, conf={"fugue.jax.memory.budget_bytes": 1024})
+    msgs = [d.message for d in diags if d.code == "FWF303"]
+    assert any("host tier directly" in m for m in msgs), msgs
+    assert any("LRU spills" in m for m in msgs), msgs
+
+
+def test_fwf401_nondeterministic_checkpoint_under_resume():
+    dag = FugueWorkflow()
+    dag.df([[0]], "a:int").checkpoint()  # random-id strong checkpoint
+    diags = _analyze(dag, conf={"fugue.workflow.resume": True})
+    d = _assert_diag(diags, "FWF401", Severity.ERROR, task_prefix="CreateData")
+    assert "deterministic_checkpoint" in d.message
+    # without resume the pattern is fine
+    assert not any(
+        x.code == "FWF401" for x in _analyze(dag, conf={"fugue.workflow.resume": False})
+    )
+    # deterministic checkpoints are resume-safe
+    dag2 = FugueWorkflow()
+    dag2.df([[0]], "a:int").deterministic_checkpoint()
+    assert not any(
+        x.code == "FWF401"
+        for x in _analyze(dag2, conf={"fugue.workflow.resume": True})
+    )
+
+
+def test_fwf402_retry_wraps_append_save():
+    dag = FugueWorkflow()
+    df = dag.df([[0]], "a:int")
+    df.save("/tmp/out.parquet", mode="append")
+    diags = _analyze(dag, conf={"fugue.workflow.retry.max_attempts": 3})
+    d = _assert_diag(diags, "FWF402", Severity.WARN, task_prefix="Save")
+    assert "append" in d.message
+    # overwrite saves are idempotent: silent
+    dag2 = FugueWorkflow()
+    dag2.df([[0]], "a:int").save("/tmp/out.parquet", mode="overwrite")
+    diags2 = _analyze(dag2, conf={"fugue.workflow.retry.max_attempts": 3})
+    assert not any(x.code == "FWF402" and x.severity is Severity.WARN for x in diags2)
+
+
+def test_fwf402_retry_wraps_append_save_and_use():
+    # SaveAndUse is a PROCESS task but shares Save's append hazard
+    dag = FugueWorkflow()
+    dag.df([[0]], "a:int").save_and_use("/tmp/out.parquet", mode="append")
+    diags = _analyze(dag, conf={"fugue.workflow.retry.max_attempts": 3})
+    d = _assert_diag(diags, "FWF402", Severity.WARN, task_prefix="SaveAndUse")
+    assert "append" in d.message
+    # overwrite save_and_use is idempotent: silent
+    dag2 = FugueWorkflow()
+    dag2.df([[0]], "a:int").save_and_use("/tmp/out.parquet", mode="overwrite")
+    assert not any(
+        x.code == "FWF402"
+        for x in _analyze(dag2, conf={"fugue.workflow.retry.max_attempts": 3})
+    )
+
+
+def test_analyze_with_live_engine_reads_engine_conf():
+    # engine-dependent rules must read the LIVE engine's conf, not the
+    # global defaults: an engine built with a row bucket has already
+    # mitigated the FWF302 recompile hazard (jax engine, so the jax
+    # scope stays active and the silence comes from the CONF)
+    from fugue_tpu.jax_backend.execution_engine import JaxExecutionEngine
+
+    dag = FugueWorkflow()
+    dag.df([[0]], "a:int").take(1)  # data-dependent row count
+    assert any(x.code == "FWF302" for x in dag.analyze())
+    e = JaxExecutionEngine({"fugue.jax.row_bucket": 64})
+    assert not any(x.code == "FWF302" for x in dag.analyze(engine=e))
+
+
+def test_analyze_with_engine_name_string_resolves_like_run():
+    # run() accepts engine names, so analyze(engine="jax") must resolve
+    # the name — not silently narrow to generic-only and report clean
+    dag = FugueWorkflow()
+    dag.df([[0]], "a:int").take(1)  # data-dependent row count (jax scope)
+    assert any(x.code == "FWF302" for x in dag.analyze(engine="jax"))
+    # a non-jax name still narrows correctly
+    assert not any(x.code == "FWF302" for x in dag.analyze(engine="native"))
+
+
+def test_crashing_rule_is_skipped_with_a_visible_warning(caplog):
+    import logging
+
+    from fugue_tpu.analysis.analyzer import Analyzer
+    from fugue_tpu.analysis.diagnostics import Rule
+
+    class _Broken(Rule):
+        code = "FWF999"
+        severity = Severity.ERROR
+        description = "always crashes"
+
+        def check(self, ctx):
+            raise RuntimeError("boom")
+
+    dag = FugueWorkflow()
+    dag.df([[0]], "a:int")
+    with caplog.at_level(logging.WARNING, logger="fugue_tpu.analysis"):
+        diags = Analyzer(rules=[_Broken]).analyze(dag)
+    assert diags == []  # skipped check, not a broken run
+    assert any(
+        "_Broken" in r.message and "skipped" in r.message for r in caplog.records
+    )
+
+
+def test_every_rule_has_corpus_coverage():
+    """The corpus above must track the registry: a newly registered rule
+    without a fixture here fails this meta-check."""
+    covered = {
+        "FWF101", "FWF102", "FWF103", "FWF104", "FWF105", "FWF106",
+        "FWF201", "FWF202", "FWF301", "FWF302", "FWF303", "FWF401",
+        "FWF402",
+    }
+    assert {r.code for r in all_rules()} == covered
+
+
+def test_rule_metadata_complete():
+    for r in all_rules():
+        assert r.code.startswith("FWF") and len(r.code) == 6
+        assert r.description != ""
+        assert r.scope in ("generic", "jax")

@@ -1,0 +1,113 @@
+"""Analyzer self-check: representative GOOD workflows (patterns mirroring
+the fugue_tpu_test acceptance suites) must produce ZERO error-level
+diagnostics — every error on clean code is an analyzer false positive.
+Plus the acceptance-criteria performance bound: a 50-task DAG analyzes
+well under a second."""
+
+import time
+
+import pandas as pd
+import pytest
+
+from fugue_tpu.analysis import Analyzer, Severity
+from fugue_tpu.analysis.selftest import (
+    WORKFLOW_BUILDERS,
+    run_self_test,
+    self_test_failed,
+)
+from fugue_tpu.column import functions as f
+from fugue_tpu.column.expressions import col
+from fugue_tpu.workflow.workflow import FugueWorkflow
+
+pytestmark = pytest.mark.analysis
+
+
+def _errors(dag, conf=None):
+    merged = dict(dag._conf)
+    merged.update(conf or {})
+    return [
+        d
+        for d in Analyzer().analyze(dag, conf=merged)
+        if d.severity is Severity.ERROR
+    ]
+
+
+def test_builtin_selftest_corpus_clean():
+    results = run_self_test()
+    assert len(results) == len(WORKFLOW_BUILDERS) >= 5
+    assert not self_test_failed(results), [
+        (n, [str(d) for d in ds if d.severity is Severity.ERROR])
+        for n, ds in results
+    ]
+
+
+# schema: *,s:double
+def _with_s(df: pd.DataFrame) -> pd.DataFrame:
+    return df.assign(s=df["b"] * 2.0)
+
+
+# schema: a:int,n:long
+def _group_size(df: pd.DataFrame) -> pd.DataFrame:
+    return pd.DataFrame({"a": [int(df["a"].iloc[0])], "n": [len(df)]})
+
+
+def test_suite_style_transform_workflows_clean():
+    dag = FugueWorkflow()
+    df = dag.df([[0, 1.0], [1, 2.0]], "a:int,b:double")
+    out = df.partition(by=["a"], presort="b desc").transform(_with_s)
+    out.select(col("a"), col("s")).filter(col("s") > 0)
+    df.partition_by("a").transform(_group_size)
+    assert _errors(dag) == []
+
+
+def test_suite_style_relational_workflows_clean():
+    dag = FugueWorkflow()
+    left = dag.df([[0, "x"]], "a:int,name:str")
+    right = dag.df([[0, 3]], "a:int,score:int")
+    j = left.inner_join(right, on=["a"])
+    j.partition_by("a").aggregate(total=f.sum(col("score")))
+    j.rename({"name": "label"})[["a", "label"]]
+    left.semi_join(right, on=["a"])  # semi keeps ONLY the left columns
+    left.cross_join(right.drop(["a"]))
+    assert _errors(dag) == []
+
+
+def test_zip_cotransform_workflow_clean():
+    def co(d1: pd.DataFrame, d2: pd.DataFrame) -> pd.DataFrame:
+        return d1
+
+    dag = FugueWorkflow()
+    a = dag.df([[0, 1.0]], "k:int,x:double")
+    b = dag.df([[0, 2.0]], "k:int,y:double")
+    a.zip(b, partition={"by": ["k"]}).transform(co, schema="k:int,x:double")
+    assert _errors(dag) == []
+
+
+def test_checkpoint_and_yield_workflows_clean():
+    dag = FugueWorkflow()
+    df = dag.df([[0]], "a:int")
+    df.persist().broadcast()
+    df.deterministic_checkpoint()
+    df.yield_dataframe_as("out")
+    assert _errors(dag) == []
+    assert _errors(dag, conf={"fugue.workflow.resume": True}) == []
+
+
+def test_sql_select_workflow_clean():
+    dag = FugueWorkflow()
+    df = dag.df([[1, "a"]], "x:int,y:str")
+    dag.select("SELECT y, COUNT(*) AS n FROM", df, "GROUP BY y")
+    assert _errors(dag) == []
+
+
+def test_50_task_dag_analyzes_fast():
+    dag = WORKFLOW_BUILDERS["deep_chain_50"]()
+    assert len(dag.tasks) >= 50
+    analyzer = Analyzer()
+    analyzer.analyze(dag, conf=dag._conf)  # warm imports
+    t0 = time.perf_counter()
+    diags = analyzer.analyze(dag, conf=dag._conf)
+    elapsed = time.perf_counter() - t0
+    assert not any(d.severity is Severity.ERROR for d in diags)
+    # acceptance bound is "well under a second"; generous CI margin
+    assert elapsed < 1.0, f"50-task analysis took {elapsed:.3f}s"
