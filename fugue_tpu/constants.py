@@ -49,6 +49,14 @@ FUGUE_CONF_JAX_MEMORY_BUDGET_FRACTION = "fugue.jax.memory.budget_fraction"
 FUGUE_CONF_JAX_MEMORY_HIGH_WATERMARK = "fugue.jax.memory.high_watermark"
 FUGUE_CONF_JAX_MEMORY_LOW_WATERMARK = "fugue.jax.memory.low_watermark"
 FUGUE_CONF_RPC_HTTP_RETRIES = "fugue.rpc.http_server.retries"
+FUGUE_CONF_RPC_HTTP_MAX_BODY = "fugue.rpc.http_server.max_body_bytes"
+FUGUE_CONF_RPC_HTTP_READ_TIMEOUT = "fugue.rpc.http_server.read_timeout"
+FUGUE_CONF_SERVE_HOST = "fugue.serve.host"
+FUGUE_CONF_SERVE_PORT = "fugue.serve.port"
+FUGUE_CONF_SERVE_MAX_CONCURRENT = "fugue.serve.max_concurrent"
+FUGUE_CONF_SERVE_SESSION_TTL = "fugue.serve.session_ttl"
+FUGUE_CONF_SERVE_SYNC_WAIT = "fugue.serve.sync_wait"
+FUGUE_CONF_SERVE_TENANT_BUDGET_FRACTION = "fugue.serve.tenant_budget_fraction"
 
 FUGUE_COMPILE_TIME_CONFIGS = {
     FUGUE_CONF_WORKFLOW_EXCEPTION_HIDE,
@@ -274,6 +282,79 @@ def _declare_defaults() -> None:
         float,
         30.0,
         "HTTP RPC request timeout (s)",
+        in_defaults=False,
+    )
+    # daemon-hardening knobs of the HTTP server (rpc/http.py): a request
+    # body over the cap is rejected with 413 before it is read into
+    # memory; read_timeout bounds how long one request may keep a handler
+    # thread blocked on a slow/stalled client socket
+    r(
+        FUGUE_CONF_RPC_HTTP_MAX_BODY,
+        int,
+        64 * 1024 * 1024,
+        "max HTTP request body bytes (413 above; 0 = unlimited)",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_RPC_HTTP_READ_TIMEOUT,
+        float,
+        30.0,
+        "per-request socket read timeout of the HTTP server (s)",
+        in_defaults=False,
+    )
+    # multi-tenant serving daemon (fugue_tpu/serve/): consumed by the
+    # daemon via typed_conf_get with these registered defaults — declared
+    # module-owned (not seeded) like the other fugue.rpc.http_server keys
+    r(
+        FUGUE_CONF_SERVE_HOST,
+        str,
+        "127.0.0.1",
+        "bind host of the serving daemon's HTTP API",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_SERVE_PORT,
+        int,
+        0,
+        "serving daemon HTTP port (0 = ephemeral)",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_SERVE_MAX_CONCURRENT,
+        int,
+        4,
+        "workflow submissions the daemon runs concurrently",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_SERVE_SESSION_TTL,
+        float,
+        3600.0,
+        "idle seconds before a serve session expires (0 = never)",
+        in_defaults=False,
+    )
+    # sync submissions park an HTTP handler thread while they wait: the
+    # cap bounds how long a wedged job can pin it — on expiry the call
+    # returns the live job snapshot (still queued/running) and the
+    # client polls /v1/jobs/<id> like an async submission
+    r(
+        FUGUE_CONF_SERVE_SYNC_WAIT,
+        float,
+        600.0,
+        "max seconds a sync submit blocks before returning the job "
+        "snapshot for polling (0 = unbounded)",
+        in_defaults=False,
+    )
+    # per-tenant fair share of the device-memory budget: > 0 makes the
+    # governor's spill ordering FAIR (the tenant most over
+    # fraction * budget spills first, LRU within it) so one heavy serve
+    # session cannot evict everyone else's persisted tables; 0 keeps the
+    # original global LRU order
+    r(
+        FUGUE_CONF_SERVE_TENANT_BUDGET_FRACTION,
+        float,
+        0.0,
+        "per-tenant fair share of the memory budget (0 = global LRU)",
         in_defaults=False,
     )
 

@@ -12,7 +12,7 @@ import logging
 from abc import ABC, abstractmethod
 from contextlib import contextmanager
 from contextvars import ContextVar
-from threading import RLock
+from threading import RLock, local
 from typing import TYPE_CHECKING, Any, Callable, Dict, Iterator, List, Optional, Union
 from uuid import uuid4
 
@@ -170,7 +170,12 @@ class ExecutionEngine(FugueEngineBase):
         self._fs: Optional[Any] = None
         self._in_context_count = 0
         self._is_global = False
-        self._ctx_tokens: List[Any] = []
+        # ContextVar tokens must be reset by the thread that created them,
+        # so each thread keeps its own token stack — a long-lived engine
+        # (the serving daemon) runs many workflows concurrently, each
+        # entering/leaving the context on its own worker thread
+        self._ctx_local = local()
+        self._ctx_lock = RLock()
         self._stop_lock = RLock()
         self._stopped = False
 
@@ -185,8 +190,12 @@ class ExecutionEngine(FugueEngineBase):
 
     def as_context(self) -> "ExecutionEngine":
         """Push self as the contextual engine: ``with engine.as_context():``"""
-        self._in_context_count += 1
-        self._ctx_tokens.append(_CONTEXT_ENGINE.set(self))
+        with self._ctx_lock:
+            self._in_context_count += 1
+        stack = getattr(self._ctx_local, "tokens", None)
+        if stack is None:
+            stack = self._ctx_local.tokens = []
+        stack.append(_CONTEXT_ENGINE.set(self))
         self.on_enter_context()
         return self
 
@@ -197,12 +206,17 @@ class ExecutionEngine(FugueEngineBase):
         self.stop_context()
 
     def stop_context(self) -> None:
-        if self._in_context_count > 0:
+        stack = getattr(self._ctx_local, "tokens", None)
+        if stack:
+            _CONTEXT_ENGINE.reset(stack.pop())
+        with self._ctx_lock:
+            if self._in_context_count == 0:
+                return
             self._in_context_count -= 1
-            _CONTEXT_ENGINE.reset(self._ctx_tokens.pop())
-            self.on_exit_context()
-            if self._in_context_count == 0 and not self._is_global:
-                self.stop()
+            should_stop = self._in_context_count == 0 and not self._is_global
+        self.on_exit_context()
+        if should_stop:
+            self.stop()
 
     def set_global(self) -> "ExecutionEngine":
         with _GLOBAL_LOCK:
@@ -232,6 +246,19 @@ class ExecutionEngine(FugueEngineBase):
             if not self._stopped:
                 self._stopped = True
                 self.stop_engine()
+
+    @property
+    def task_execution_lock(self) -> Optional[Any]:
+        """An engine-wide reentrant lock the workflow layer holds around
+        each task's EXECUTION when concurrent workflows share this
+        engine, or None when concurrent dispatch is safe (the default).
+        Engines whose device runtime cannot take concurrent multi-device
+        program dispatch (XLA CPU collectives rendezvous across
+        executions and can deadlock when two programs interleave) return
+        a real lock: host-side work — SQL compile, planning, queueing,
+        result serialization — still overlaps; device programs
+        serialize at task granularity."""
+        return None
 
     def stop_engine(self) -> None:  # pragma: no cover - hook
         pass

@@ -520,6 +520,48 @@ class JaxSQLEngine(PandasSQLEngine):
         )
         return JaxDataFrame(out, jdf.schema)
 
+    # ---- table catalog: DEVICE-resident hot tables ----------------------
+    # The shared process-wide catalog keeps the PERSISTED JaxDataFrame
+    # itself instead of a host arrow copy: a table saved once stays on
+    # its device tier across load_table calls (the serving daemon's hot
+    # sessions never re-ingest), is the memory governor's spillable
+    # population (persist marks it), and under pressure moves tiers IN
+    # PLACE — the catalog reference follows automatically. Entries from
+    # other engines (host arrow tuples) still load through the parent.
+    def save_table(
+        self,
+        df: DataFrame,
+        table: str,
+        mode: str = "overwrite",
+        partition_spec: Any = None,
+        **kwargs: Any,
+    ) -> None:
+        from fugue_tpu.execution.native_execution_engine import (
+            _TABLE_CATALOG,
+        )
+
+        assert_or_throw(
+            mode in ("overwrite", "error"),
+            NotImplementedError(f"save mode {mode}"),
+        )
+        if mode == "error":
+            assert_or_throw(
+                table not in _TABLE_CATALOG,
+                ValueError(f"table {table} exists"),
+            )
+        engine: "JaxExecutionEngine" = self.execution_engine  # type: ignore
+        _TABLE_CATALOG[table] = engine.persist(engine.to_df(df))
+
+    def load_table(self, table: str, **kwargs: Any) -> DataFrame:
+        from fugue_tpu.execution.native_execution_engine import (
+            _TABLE_CATALOG,
+        )
+
+        entry = _TABLE_CATALOG.get(table)
+        if isinstance(entry, DataFrame):
+            return self.execution_engine.to_df(entry)
+        return super().load_table(table, **kwargs)
+
 
 class JaxExecutionEngine(ExecutionEngine):
     """ExecutionEngine over a jax device mesh (single controller).
@@ -580,6 +622,13 @@ class JaxExecutionEngine(ExecutionEngine):
         from fugue_tpu.jax_backend.memory import MemoryGovernor
 
         self._memory = MemoryGovernor(self)
+        # task-granular dispatch serialization for SHARED-engine use (the
+        # serving daemon): XLA's CPU backend runs cross-device collectives
+        # through a per-execution rendezvous on a shared thread pool — two
+        # concurrently dispatched programs with collectives can starve
+        # each other's participants and deadlock. Reentrant, so a serial
+        # in-thread workflow nests freely.
+        self._dispatch_lock = threading.RLock()
 
     @property
     def fallbacks(self) -> Dict[str, int]:
@@ -613,6 +662,20 @@ class JaxExecutionEngine(ExecutionEngine):
         ``mem_oom_feedback``) so tests and benches assert governance ran
         the same way they assert a pipeline stayed on device."""
         self._bump_fallback_counter(name, "memory governance", detail)
+
+    @property
+    def task_execution_lock(self) -> Any:
+        """Engine-wide reentrant dispatch lock (see the base property):
+        concurrent workflows sharing this engine serialize their DEVICE
+        work at task granularity while their host-side phases overlap."""
+        return self._dispatch_lock
+
+    @property
+    def memory_governor(self) -> Any:
+        """The engine's :class:`~fugue_tpu.jax_backend.memory.MemoryGovernor`
+        — the serving daemon claims session tables for their tenant and
+        scopes job registrations through it."""
+        return self._memory
 
     @property
     def memory_stats(self) -> Dict[str, Any]:

@@ -42,11 +42,26 @@ Conf keys (see ``constants.py``):
 - ``fugue.jax.memory.high_watermark`` / ``.low_watermark``: admission
   trigger and spill target as fractions of the budget.
 
+- **Per-tenant accounting** (the serving daemon's fairness plane):
+  ledger entries carry an optional *tenant* tag — set for a whole scope
+  with :meth:`MemoryGovernor.tenant_scope` (thread-local, so concurrent
+  jobs against one shared engine tag independently) or explicitly with
+  :meth:`MemoryGovernor.assign_tenant` (how a serve session claims its
+  saved tables). When ``fugue.serve.tenant_budget_fraction`` > 0 each
+  tenant's fair share is that fraction of the budget and the spiller
+  becomes *fair*: victims come first from the tenant currently MOST
+  over its share (proportional), LRU within that tenant (recency-aware)
+  — so one heavy tenant's persisted tables spill before a light
+  tenant's ever do, instead of global LRU letting the heavy newcomer
+  evict everyone else. With no tenants recorded (or fraction 0) the
+  spiller reduces exactly to the original global LRU order.
+
 Every governance event is observable: ``engine.fallbacks`` counts
 ``mem_admit_host`` / ``mem_pressure`` / ``mem_spill`` /
 ``mem_oom_feedback`` (the strategy/fallback counter idiom), and
-``engine.memory_stats`` snapshots the full ledger; workflow runs copy
-the snapshot into ``FugueWorkflowResult.fault_stats["memory"]``.
+``engine.memory_stats`` snapshots the full ledger (including the
+per-tenant tier breakdown); workflow runs copy the snapshot into
+``FugueWorkflowResult.fault_stats["memory"]``.
 
 The ``device.alloc`` fault-injection site (:mod:`fugue_tpu.testing.faults`)
 fires in :meth:`MemoryGovernor.pre_alloc` with the placement tier as its
@@ -68,6 +83,7 @@ from fugue_tpu.constants import (
     FUGUE_CONF_JAX_MEMORY_BUDGET_FRACTION,
     FUGUE_CONF_JAX_MEMORY_HIGH_WATERMARK,
     FUGUE_CONF_JAX_MEMORY_LOW_WATERMARK,
+    FUGUE_CONF_SERVE_TENANT_BUDGET_FRACTION,
 )
 from fugue_tpu.jax_backend.blocks import (
     JaxBlocks,
@@ -201,16 +217,23 @@ def parse_oom_bytes(text: str) -> int:
 
 
 class _LedgerEntry:
-    __slots__ = ("ref", "tier", "nbytes", "seq", "spillable")
+    __slots__ = ("ref", "tier", "nbytes", "seq", "spillable", "tenant")
 
     def __init__(
-        self, ref: Any, tier: str, nbytes: int, seq: int, spillable: bool
+        self,
+        ref: Any,
+        tier: str,
+        nbytes: int,
+        seq: int,
+        spillable: bool,
+        tenant: Optional[str] = None,
     ):
         self.ref = ref
         self.tier = tier
         self.nbytes = nbytes
         self.seq = seq
         self.spillable = spillable
+        self.tenant = tenant
 
 
 class AllocationGate:
@@ -254,6 +277,10 @@ class MemoryGovernor:
         self._budget = 0
         self._high = 0.9
         self._low = 0.75
+        self._tenant_fraction = 0.0
+        # thread-local so concurrent jobs on one shared engine each tag
+        # their own registrations (serving daemon: one thread per job)
+        self._tenant_local = threading.local()
         self._tier_bytes: Dict[str, int] = {"device": 0, "host": 0}
         self._tier_peak: Dict[str, int] = {"device": 0, "host": 0}
         self.counters: Dict[str, int] = {
@@ -283,6 +310,8 @@ class MemoryGovernor:
         low = float(conf.get(FUGUE_CONF_JAX_MEMORY_LOW_WATERMARK, 0.75))
         self._high = min(max(high, 0.0), 1.0)
         self._low = min(max(low, 0.0), self._high)
+        frac = float(conf.get(FUGUE_CONF_SERVE_TENANT_BUDGET_FRACTION, 0.0))
+        self._tenant_fraction = min(max(frac, 0.0), 1.0)
         self._resolved = True
 
     @property
@@ -303,6 +332,55 @@ class MemoryGovernor:
     def _next_seq(self) -> int:
         self._seq += 1
         return self._seq
+
+    # ---- tenants ---------------------------------------------------------
+    def tenant_scope(self, tenant: Optional[str]) -> Any:
+        """Context manager: registrations on THIS thread inside the scope
+        are tagged with ``tenant`` (the serving daemon wraps each job's
+        execution so a session's ingests charge its own account).
+        Thread-local by design: a parallel inner runner's worker threads
+        are NOT covered — durable ownership of anything that outlives a
+        job comes from :meth:`assign_tenant` at save time, and untagged
+        transients die with the job and return budget via weakref."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _scope() -> Any:
+            prev = getattr(self._tenant_local, "tenant", None)
+            self._tenant_local.tenant = tenant
+            try:
+                yield self
+            finally:
+                self._tenant_local.tenant = prev
+
+        return _scope()
+
+    def current_tenant(self) -> Optional[str]:
+        return getattr(self._tenant_local, "tenant", None)
+
+    def assign_tenant(self, blocks: JaxBlocks, tenant: Optional[str]) -> None:
+        """Claim a REGISTERED frame's bytes for ``tenant`` — how a serve
+        session takes ownership of a table it saved. No-op when the frame
+        is unregistered (governance off or transient)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            e = self._entries.get(id(blocks))
+            if e is not None and e.ref() is blocks:
+                e.tenant = tenant
+
+    def tenant_usage(self, tenant: Optional[str]) -> Dict[str, int]:
+        """Live ledger bytes of one tenant per tier (zeros when absent)."""
+        out = {"device": 0, "host": 0}
+        with self._lock:
+            for e in self._entries.values():
+                if e.tenant == tenant and e.ref() is not None:
+                    out[e.tier] += e.nbytes
+        return out
+
+    def _tenant_share_locked(self) -> int:
+        """Each tenant's fair-share bytes (0 = per-tenant fairness off)."""
+        return int(self._tenant_fraction * self._budget)
 
     # ---- admission -------------------------------------------------------
     def gate(self, tier: str, est: int) -> AllocationGate:
@@ -377,7 +455,7 @@ class MemoryGovernor:
                 return
             entry = _LedgerEntry(
                 weakref.ref(blocks), tier, nbytes, self._next_seq(),
-                persisted,
+                persisted, tenant=self.current_tenant(),
             )
             self._entries[key] = entry
             self._tier_bytes[tier] += nbytes
@@ -440,34 +518,47 @@ class MemoryGovernor:
                 if e.ref() is not None
             ]
 
+    def ledger_entries_by_tenant(
+        self,
+    ) -> List[Tuple[Optional[str], str, int, bool]]:
+        """Debug/testing view including the tenant tag:
+        (tenant, tier, nbytes, spillable) per live entry."""
+        with self._lock:
+            return [
+                (e.tenant, e.tier, e.nbytes, e.spillable)
+                for e in self._entries.values()
+                if e.ref() is not None
+            ]
+
     # ---- spill -----------------------------------------------------------
     def _spill_down_to_locked(self, target_bytes: float) -> None:
-        """Spill LRU persisted device-tier frames until device usage is
-        at or below ``target_bytes`` (or nothing spillable remains).
-        Caller holds the lock."""
-        victims = sorted(
-            (
-                e
-                for e in self._entries.values()
-                if e.tier == "device" and e.spillable
-            ),
-            key=lambda e: e.seq,
-        )
+        """Spill persisted device-tier frames until device usage is at or
+        below ``target_bytes`` (or nothing spillable remains). Victim
+        order is FAIR when per-tenant shares are configured — the tenant
+        currently most over its share pays first, LRU within it — and
+        plain global LRU otherwise. Caller holds the lock."""
         host_mesh = getattr(self._engine, "host_mesh", None)
-        for v in victims:
-            if self._tier_bytes["device"] <= target_bytes:
+        skipped: set = set()
+        while self._tier_bytes["device"] > target_bytes:
+            v = self._pick_victim_locked(skipped)
+            if v is None:
                 break
             blocks = v.ref()
-            if blocks is None:
-                continue  # finalizer will reclaim; skip
-            if host_mesh is None or not move_blocks_to_mesh(
-                blocks, host_mesh
+            if (
+                blocks is None  # finalizer will reclaim
+                or host_mesh is None
+                or not move_blocks_to_mesh(blocks, host_mesh)
             ):
+                skipped.add(id(v))
                 continue
             self._move_entry_locked(v, "host")
             self.counters["spills"] += 1
             self.counters["spilled_bytes"] += v.nbytes
-            self._count("mem_spill", f"{v.nbytes}B to host tier")
+            self._count(
+                "mem_spill",
+                f"{v.nbytes}B to host tier"
+                + (f" (tenant {v.tenant})" if v.tenant else ""),
+            )
             # derived frames SHARE JaxColumn objects with their source
             # (select/rename/filter build new JaxBlocks over the same
             # columns): their arrays just moved with the spill, so move
@@ -486,6 +577,36 @@ class MemoryGovernor:
                     continue
                 if move_blocks_to_mesh(sib, host_mesh):
                     self._move_entry_locked(e, "host")
+
+    def _pick_victim_locked(self, skipped: set) -> Optional[_LedgerEntry]:
+        """Next spill victim. Proportional fairness: while any tenant's
+        device usage exceeds its fair share, the MOST-over tenant's LRU
+        frame goes first; once every tenant is within its share (or no
+        shares are configured) the order is global LRU — identical to the
+        pre-tenant behavior."""
+        cands = [
+            e
+            for e in self._entries.values()
+            if e.tier == "device" and e.spillable and id(e) not in skipped
+        ]
+        if not cands:
+            return None
+        share = self._tenant_share_locked()
+        if share > 0:
+            usage: Dict[Optional[str], int] = {}
+            for e in self._entries.values():
+                if e.tier == "device" and e.ref() is not None:
+                    usage[e.tenant] = usage.get(e.tenant, 0) + e.nbytes
+            over = {
+                t: usage[t] / share
+                for t in {e.tenant for e in cands}
+                if t is not None and usage.get(t, 0) > share
+            }
+            if over:
+                worst = max(over, key=lambda t: over[t])  # type: ignore[arg-type]
+                pool = [e for e in cands if e.tenant == worst]
+                return min(pool, key=lambda e: e.seq)
+        return min(cands, key=lambda e: e.seq)
 
     def _move_entry_locked(self, entry: _LedgerEntry, tier: str) -> None:
         if entry.tier == tier:
@@ -518,6 +639,12 @@ class MemoryGovernor:
     def snapshot(self) -> Dict[str, Any]:
         self._resolve()
         with self._lock:
+            tenants: Dict[str, Dict[str, int]] = {}
+            for e in self._entries.values():
+                if e.tenant is None or e.ref() is None:
+                    continue
+                slot = tenants.setdefault(e.tenant, {"device": 0, "host": 0})
+                slot[e.tier] += e.nbytes
             return {
                 "enabled": self._budget > 0,
                 "budget_bytes": self._budget,
@@ -529,4 +656,6 @@ class MemoryGovernor:
                 "live_frames": sum(
                     1 for e in self._entries.values() if e.ref() is not None
                 ),
+                "tenant_share_bytes": self._tenant_share_locked(),
+                "tenants": tenants,
             }

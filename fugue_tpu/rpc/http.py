@@ -18,6 +18,19 @@ Conf keys (parity with ``fugue.rpc.flask_server.*``):
   connection refused/reset and HTTP 503 (the classifier in
   ``workflow/fault.py`` decides); any other HTTP error and every
   server-side handler error fail fast.
+
+Daemon hardening (the serving daemon in :mod:`fugue_tpu.serve` runs this
+server long-lived on a semi-trusted edge, so the handler defends itself):
+
+- ``fugue.rpc.http_server.max_body_bytes`` (default 64 MiB): a request
+  whose declared body exceeds the cap is rejected with HTTP 413 BEFORE
+  the body is read into memory (0 = unlimited).
+- ``fugue.rpc.http_server.read_timeout`` (default 30 s): per-request
+  socket read timeout — a stalled client cannot pin a handler thread
+  forever (0 = unlimited).
+- handler exceptions cross the wire as a STRUCTURED payload
+  (``{"error": <type name>, "message": <str(ex)>}``) — never a raw
+  traceback.
 """
 
 import logging
@@ -44,6 +57,11 @@ _CONF_HOST = "fugue.rpc.http_server.host"
 _CONF_PORT = "fugue.rpc.http_server.port"
 _CONF_TIMEOUT = "fugue.rpc.http_server.timeout"
 _CONF_RETRIES = "fugue.rpc.http_server.retries"
+_CONF_MAX_BODY = "fugue.rpc.http_server.max_body_bytes"
+_CONF_READ_TIMEOUT = "fugue.rpc.http_server.read_timeout"
+
+_DEFAULT_MAX_BODY = 64 * 1024 * 1024
+_DEFAULT_READ_TIMEOUT = 30.0
 
 # HTTP statuses that mark a transient server condition worth retrying;
 # everything else (404, 500 handler bugs, ...) is deterministic
@@ -65,25 +83,95 @@ def _is_transient_transport_error(ex: BaseException) -> bool:
     return classify_error(ex) == TRANSIENT
 
 
-class _RPCRequestHandler(BaseHTTPRequestHandler):
+def structured_error(ex: BaseException) -> dict:
+    """The one shape a server-side failure takes on the wire: exception
+    type name + message, NEVER a traceback (frames leak file paths and
+    internals to whoever is on the other end of a long-lived daemon
+    socket)."""
+    return {"error": type(ex).__name__, "message": str(ex)}
+
+
+class HardenedRequestHandler(BaseHTTPRequestHandler):
+    """Request handler base with the daemon-hardening behaviors shared by
+    the RPC protocol handler below and the serving daemon's JSON API
+    (:mod:`fugue_tpu.serve.http`):
+
+    - ``timeout`` (class attr, set by the server factory from
+      ``fugue.rpc.http_server.read_timeout``) is the stdlib
+      StreamRequestHandler per-request socket timeout: a stalled client
+      raises ``socket.timeout``, which ``handle_one_request`` turns into
+      a closed connection instead of a pinned thread.
+    - :meth:`read_body` enforces ``max_body`` from the declared
+      Content-Length BEFORE reading, answering HTTP 413 (and closing the
+      connection, since the unread body poisons keep-alive) over the cap.
+    """
+
+    # set by the server factory; None/0 = unlimited
+    timeout: Any = _DEFAULT_READ_TIMEOUT
+    max_body: int = _DEFAULT_MAX_BODY
+
+    def read_body(self) -> Optional[bytes]:
+        """The request body, or None when the request was rejected (the
+        error response has already been written): a malformed or
+        negative Content-Length answers a structured 400, a length over
+        the cap answers 413 — both close the connection, since the
+        unread body poisons keep-alive."""
+        raw = self.headers.get("Content-Length", "0") or "0"
+        try:
+            length = int(raw)
+            if length < 0:
+                raise ValueError("negative length")
+        except ValueError:
+            self.close_connection = True
+            self.send_error_payload(
+                400, ValueError(f"bad Content-Length {raw!r}")
+            )
+            return None
+        if self.max_body and length > self.max_body:
+            self.close_connection = True
+            self.send_error_payload(
+                413,
+                ValueError(
+                    f"request body {length}B exceeds the "
+                    f"{self.max_body}B cap"
+                ),
+            )
+            return None
+        return self.rfile.read(length)
+
+    def send_error_payload(self, status: int, ex: BaseException) -> None:
+        """Protocol-specific structured error writer (no tracebacks)."""
+        raise NotImplementedError  # pragma: no cover - subclass contract
+
+    def log_message(self, fmt: str, *args: Any) -> None:  # silence stderr
+        pass
+
+
+class _RPCRequestHandler(HardenedRequestHandler):
     # set by the server factory
     rpc_server: "HTTPRPCServer"
 
     def do_POST(self) -> None:  # noqa: N802 (stdlib naming)
+        body = self.read_body()  # socket.timeout propagates: stdlib
+        if body is None:  # handle_one_request closes the connection
+            return
         try:
-            length = int(self.headers.get("Content-Length", "0"))
-            key, args, kwargs = pickle.loads(self.rfile.read(length))
+            key, args, kwargs = pickle.loads(body)
             result = self.rpc_server.invoke(key, *args, **kwargs)
             payload = pickle.dumps((True, result))
         except Exception as ex:  # error crosses the wire as data
-            payload = pickle.dumps((False, f"{type(ex).__name__}: {ex}"))
+            payload = pickle.dumps((False, structured_error(ex)))
         self.send_response(200)
         self.send_header("Content-Length", str(len(payload)))
         self.end_headers()
         self.wfile.write(payload)
 
-    def log_message(self, fmt: str, *args: Any) -> None:  # silence stderr
-        pass
+    def send_error_payload(self, status: int, ex: BaseException) -> None:
+        payload = pickle.dumps((False, structured_error(ex)))
+        self.send_response(status)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
 
 
 class HTTPRPCClient(RPCClient):
@@ -137,18 +225,32 @@ class HTTPRPCClient(RPCClient):
         with urllib.request.urlopen(req, timeout=self._timeout) as resp:
             ok, payload = pickle.loads(resp.read())
         if not ok:
+            if isinstance(payload, dict):  # structured handler error
+                payload = f"{payload.get('error')}: {payload.get('message')}"
             raise RuntimeError(f"rpc call failed on driver: {payload}")
         return payload
 
 
 class HTTPRPCServer(RPCServer):
-    """Threaded stdlib HTTP server hosting the registered handlers."""
+    """Threaded stdlib HTTP server hosting the registered handlers, with
+    the daemon-hardening conf applied to every request handler (body
+    size cap, per-request read timeout, structured error payloads)."""
+
+    # the protocol handler the factory binds; the serving daemon's HTTP
+    # layer subclasses this server and swaps in its JSON API handler
+    handler_class = _RPCRequestHandler
 
     def __init__(self, conf: Any = None):
         super().__init__(conf)
         self._host: str = self.conf.get(_CONF_HOST, "127.0.0.1")
         self._port: int = int(self.conf.get(_CONF_PORT, 0))
         self._timeout: float = float(self.conf.get(_CONF_TIMEOUT, 30))
+        self._max_body: int = int(
+            self.conf.get(_CONF_MAX_BODY, _DEFAULT_MAX_BODY)
+        )
+        self._read_timeout: float = float(
+            self.conf.get(_CONF_READ_TIMEOUT, _DEFAULT_READ_TIMEOUT)
+        )
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -160,7 +262,15 @@ class HTTPRPCServer(RPCServer):
 
     def start_server(self) -> None:
         handler = type(
-            "_BoundHandler", (_RPCRequestHandler,), {"rpc_server": self}
+            "_BoundHandler",
+            (self.handler_class,),
+            {
+                "rpc_server": self,
+                # stdlib StreamRequestHandler: None = no socket timeout
+                "timeout": self._read_timeout if self._read_timeout > 0
+                else None,
+                "max_body": max(0, self._max_body),
+            },
         )
         self._httpd = ThreadingHTTPServer((self._host, self._port), handler)
         self._thread = threading.Thread(

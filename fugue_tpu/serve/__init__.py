@@ -1,0 +1,35 @@
+"""Multi-tenant serving: a long-lived daemon owning ONE persistent
+execution engine that serves concurrent FugueSQL / workflow submissions
+over HTTP — the role Spark's Thrift Server and Ray Serve play for the
+reference backends (PAPER.md §2.7/§2.10), composed out of parts this
+repo already has: the hardened HTTP layer (:mod:`fugue_tpu.rpc.http`),
+the SQLEngine table catalog (device-resident for the jax engine), the
+workflow runner's timeout/cancellation machinery, and the memory
+governor's per-tenant fair-spill accounting.
+
+Quick start::
+
+    from fugue_tpu.serve import ServeClient, ServeDaemon
+
+    with ServeDaemon({"fugue.serve.max_concurrent": 8}) as daemon:
+        client = ServeClient(*daemon.address)
+        sid = client.create_session()
+        client.sql(sid, "CREATE [[0],[1]] SCHEMA a:long", save_as="t")
+        print(client.sql(sid, "SELECT COUNT(*) AS n FROM t")["result"])
+        client.close_session(sid)
+"""
+
+from fugue_tpu.serve.client import ServeAPIError, ServeClient
+from fugue_tpu.serve.daemon import ServeDaemon
+from fugue_tpu.serve.scheduler import JobScheduler, ServeJob
+from fugue_tpu.serve.session import ServeSession, SessionManager
+
+__all__ = [
+    "ServeAPIError",
+    "ServeClient",
+    "ServeDaemon",
+    "JobScheduler",
+    "ServeJob",
+    "ServeSession",
+    "SessionManager",
+]

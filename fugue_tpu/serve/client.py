@@ -1,0 +1,133 @@
+"""Minimal stdlib JSON client for the serving daemon's HTTP API — what
+the integration tests and the sustained-throughput bench drive; the same
+flow works from ``curl`` (see README "Serving")."""
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional
+
+
+class ServeAPIError(RuntimeError):
+    """A structured error answer from the daemon."""
+
+    def __init__(self, status: int, error: Dict[str, Any]):
+        self.status = status
+        self.error = dict(error or {})
+        super().__init__(
+            f"HTTP {status}: {self.error.get('error')}: "
+            f"{self.error.get('message')}"
+        )
+
+
+class ServeClient:
+    def __init__(self, host: str, port: int, timeout: float = 120.0):
+        self._base = f"http://{host}:{port}"
+        self._timeout = timeout
+
+    def _call(
+        self,
+        method: str,
+        path: str,
+        payload: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        data = (
+            json.dumps(payload).encode("utf-8")
+            if payload is not None
+            else None
+        )
+        req = urllib.request.Request(
+            self._base + path, data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self._timeout) as resp:
+                return json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as ex:
+            try:
+                body = json.loads(ex.read().decode("utf-8"))
+            except Exception:
+                body = {}
+            raise ServeAPIError(
+                ex.code, body.get("error") or {"error": str(ex)}
+            ) from None
+
+    # ---- sessions --------------------------------------------------------
+    def create_session(self, ttl: Optional[float] = None) -> str:
+        payload: Dict[str, Any] = {} if ttl is None else {"ttl": ttl}
+        return self._call("POST", "/v1/sessions", payload)["session_id"]
+
+    def close_session(self, session_id: str) -> Dict[str, Any]:
+        return self._call("POST", f"/v1/sessions/{session_id}/close", {})
+
+    def session(self, session_id: str) -> Dict[str, Any]:
+        return self._call("GET", f"/v1/sessions/{session_id}")
+
+    # ---- submissions -----------------------------------------------------
+    def sql(
+        self,
+        session_id: str,
+        sql: str,
+        save_as: Optional[str] = None,
+        timeout: float = 0.0,
+        collect: bool = True,
+        limit: int = 10_000,
+    ) -> Dict[str, Any]:
+        """Synchronous submit: returns the finished job snapshot (its
+        ``result`` carries columns/rows when the script ends in a
+        dataframe and ``collect`` is on)."""
+        payload: Dict[str, Any] = {
+            "sql": sql,
+            "mode": "sync",
+            "timeout": timeout,
+            "collect": collect,
+            "limit": limit,
+        }
+        if save_as is not None:
+            payload["save_as"] = save_as
+        return self._call("POST", f"/v1/sessions/{session_id}/sql", payload)
+
+    def submit_async(
+        self,
+        session_id: str,
+        sql: str,
+        save_as: Optional[str] = None,
+        timeout: float = 0.0,
+        collect: bool = True,
+        limit: int = 10_000,
+    ) -> str:
+        payload: Dict[str, Any] = {
+            "sql": sql,
+            "mode": "async",
+            "timeout": timeout,
+            "collect": collect,
+            "limit": limit,
+        }
+        if save_as is not None:
+            payload["save_as"] = save_as
+        return self._call(
+            "POST", f"/v1/sessions/{session_id}/sql", payload
+        )["job_id"]
+
+    def job(self, job_id: str) -> Dict[str, Any]:
+        return self._call("GET", f"/v1/jobs/{job_id}")
+
+    def cancel(self, job_id: str) -> Dict[str, Any]:
+        return self._call("POST", f"/v1/jobs/{job_id}/cancel", {})
+
+    def wait(self, job_id: str, poll: float = 0.05) -> Dict[str, Any]:
+        """Poll an async job until it finishes; returns the snapshot."""
+        import time
+
+        while True:
+            snap = self.job(job_id)
+            if snap["status"] in ("done", "error", "cancelled"):
+                return snap
+            time.sleep(poll)
+
+    # ---- daemon ----------------------------------------------------------
+    def status(self) -> Dict[str, Any]:
+        return self._call("GET", "/v1/status")
+
+    def health(self) -> bool:
+        return bool(self._call("GET", "/v1/health").get("ok"))

@@ -1,0 +1,364 @@
+"""The serving daemon: ONE persistent engine, many concurrent tenants.
+
+``ServeDaemon`` composes the repo's existing parts into a resident
+server (ROADMAP open item #2):
+
+- a single long-lived execution engine (default ``"jax"``) entered as a
+  context for the daemon's whole lifetime, so per-run context push/pop
+  from concurrent job threads never tears it down between requests;
+- :class:`~fugue_tpu.serve.session.SessionManager` sessions whose saved
+  tables live device-resident in the SQL engine's catalog under a
+  per-session namespace (hot across requests, no re-ingest) and are
+  claimed as the memory governor's *tenants* for fair-spill accounting;
+- :class:`~fugue_tpu.serve.scheduler.JobScheduler` running up to
+  ``fugue.serve.max_concurrent`` FugueSQL workflows concurrently against
+  the shared engine with the workflow runner's timeout + cancellation
+  machinery;
+- :class:`~fugue_tpu.serve.http.ServeHTTPServer` exposing the JSON API
+  below on the hardened HTTP layer.
+
+HTTP API (all JSON; errors are structured payloads, never tracebacks)::
+
+    POST   /v1/sessions                     {"ttl": seconds?}
+    GET    /v1/sessions
+    GET    /v1/sessions/<sid>
+    POST   /v1/sessions/<sid>/close         (alias: DELETE /v1/sessions/<sid>)
+    POST   /v1/sessions/<sid>/sql           {"sql": ..., "save_as"?: name,
+                                             "mode"?: "sync"|"async",
+                                             "timeout"?: s, "collect"?: bool,
+                                             "limit"?: rows}
+    GET    /v1/jobs/<jid>                   poll an async submission
+    POST   /v1/jobs/<jid>/cancel
+    GET    /v1/status                       memory_stats, fault totals,
+                                            fallback counters, sessions, jobs
+    GET    /v1/health
+"""
+
+import threading
+import time
+from contextlib import nullcontext
+from typing import Any, Dict, Optional, Tuple
+
+from fugue_tpu.constants import (
+    FUGUE_CONF_SERVE_HOST,
+    FUGUE_CONF_SERVE_MAX_CONCURRENT,
+    FUGUE_CONF_SERVE_PORT,
+    FUGUE_CONF_SERVE_SESSION_TTL,
+    FUGUE_CONF_SERVE_SYNC_WAIT,
+    typed_conf_get,
+)
+from fugue_tpu.execution.factory import make_execution_engine
+from fugue_tpu.rpc.http import structured_error
+from fugue_tpu.serve.http import ServeHTTPServer
+from fugue_tpu.serve.scheduler import JobScheduler, ServeJob
+from fugue_tpu.serve.session import ServeSession, SessionManager
+from fugue_tpu.sql_frontend.workflow_sql import FugueSQLWorkflow
+from fugue_tpu.utils.params import ParamDict
+
+_RESULT_YIELD = "serve_result"
+
+
+class ServeDaemon:
+    """A long-lived in-process serving daemon. Usable as a context
+    manager; ``start()`` binds the HTTP API and returns the daemon."""
+
+    def __init__(self, conf: Any = None, engine: Any = "jax"):
+        self._engine = make_execution_engine(engine, ParamDict(conf))
+        econf = self._engine.conf
+        self._sessions = SessionManager(
+            self._engine,
+            default_ttl=typed_conf_get(econf, FUGUE_CONF_SERVE_SESSION_TTL),
+        )
+        self._scheduler = JobScheduler(
+            self._execute_job,
+            typed_conf_get(econf, FUGUE_CONF_SERVE_MAX_CONCURRENT),
+        )
+        http_conf = ParamDict(econf)
+        http_conf["fugue.rpc.http_server.host"] = typed_conf_get(
+            econf, FUGUE_CONF_SERVE_HOST
+        )
+        http_conf["fugue.rpc.http_server.port"] = typed_conf_get(
+            econf, FUGUE_CONF_SERVE_PORT
+        )
+        self._http = ServeHTTPServer(self, http_conf)
+        self._sync_wait = typed_conf_get(econf, FUGUE_CONF_SERVE_SYNC_WAIT)
+        self._started = False
+        self._started_at: Optional[float] = None
+        self._stats_lock = threading.Lock()
+        self._fault_totals: Dict[str, int] = {
+            "runs": 0,
+            "retries": 0,
+            "recoveries": 0,
+            "degradations": 0,
+            "integrity_rejected": 0,
+            "resumed": 0,
+        }
+
+    # ---- lifecycle -------------------------------------------------------
+    @property
+    def engine(self) -> Any:
+        return self._engine
+
+    @property
+    def sessions(self) -> SessionManager:
+        return self._sessions
+
+    @property
+    def scheduler(self) -> JobScheduler:
+        return self._scheduler
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        """(host, port) of the bound HTTP API (after ``start``)."""
+        return self._http.address
+
+    def start(self) -> "ServeDaemon":
+        if self._started:
+            return self
+        # hold ONE engine context for the daemon's lifetime: concurrent
+        # job runs push/pop their own per-thread contexts on top and the
+        # count never reaches zero, so the engine stays hot between
+        # requests instead of stopping after each run
+        self._engine.as_context()
+        self._scheduler.start()
+        self._http.start()
+        self._started = True
+        self._started_at = time.time()
+        return self
+
+    def stop(self) -> None:
+        """Stop serving: HTTP down first (no new requests), then the
+        scheduler (cancels queued/running jobs), then the sessions (drops
+        their tables), then the daemon's engine context — which stops the
+        engine, including one the caller passed in."""
+        if not self._started:
+            return
+        self._started = False
+        self._http.stop()
+        self._scheduler.stop()
+        self._sessions.close_all()
+        self._engine.stop_context()
+
+    def __enter__(self) -> "ServeDaemon":
+        return self.start()
+
+    def __exit__(self, *args: Any) -> None:
+        self.stop()
+
+    # ---- operations (HTTP routes call these; tests/benches may too) ------
+    def create_session(self, ttl: Optional[float] = None) -> ServeSession:
+        return self._sessions.create(ttl=ttl)
+
+    def close_session(self, session_id: str) -> Dict[str, Any]:
+        dropped = self._sessions.close(session_id)
+        return {"closed": session_id, "dropped_tables": dropped}
+
+    def submit(
+        self,
+        session_id: str,
+        sql: str,
+        save_as: Optional[str] = None,
+        wait: bool = True,
+        timeout: float = 0.0,
+        collect: bool = True,
+        limit: int = 10_000,
+    ) -> ServeJob:
+        self._sessions.get(session_id)  # 404 early + touches the session
+        job = ServeJob(
+            session_id,
+            sql,
+            save_as=save_as,
+            timeout=timeout,
+            collect=collect,
+            limit=limit,
+        )
+        self._scheduler.submit(job)
+        if wait:
+            # bounded: a wedged job must not pin the caller (an HTTP
+            # handler thread) forever — on expiry the live snapshot goes
+            # back (status still queued/running) and the client polls
+            # /v1/jobs/<id> exactly like an async submission
+            job.done_event.wait(
+                timeout=self._sync_wait if self._sync_wait > 0 else None
+            )
+        return job
+
+    def status(self) -> Dict[str, Any]:
+        self._sessions.sweep()
+        engine_stats: Dict[str, Any] = {
+            "type": type(self._engine).__name__,
+            "parallelism": self._engine.get_current_parallelism(),
+        }
+        mem = getattr(self._engine, "memory_stats", None)
+        if isinstance(mem, dict):
+            engine_stats["memory"] = mem
+        fallbacks = getattr(self._engine, "fallbacks", None)
+        if isinstance(fallbacks, dict):
+            engine_stats["fallbacks"] = fallbacks
+        with self._stats_lock:
+            fault_totals = dict(self._fault_totals)
+        return {
+            "uptime_seconds": (
+                round(time.time() - self._started_at, 3)
+                if self._started_at is not None
+                else 0.0
+            ),
+            "engine": engine_stats,
+            "sessions": {
+                "count": self._sessions.count(),
+                "active": self._sessions.describe(),
+            },
+            "jobs": self._scheduler.counts(),
+            "fault_stats": fault_totals,
+        }
+
+    # ---- job execution (scheduler worker threads) ------------------------
+    def _execute_job(self, job: ServeJob) -> Dict[str, Any]:
+        session = self._sessions.get(job.session_id)
+        dag = FugueSQLWorkflow()
+        sources = session.table_frames()
+        dag._sql(job.sql, {}, **sources)
+        has_result = dag.last_df is not None
+        if has_result:
+            dag.last_df.yield_dataframe_as(_RESULT_YIELD)
+        gov = getattr(self._engine, "memory_governor", None)
+        # tenant_scope is THREAD-local: it covers the run's serial task
+        # execution (the inner runner defaults to concurrency 1, in
+        # thread) and this thread's save/collect materializations; a
+        # parallel inner runner's worker threads are outside it, which
+        # is fine — durable ownership comes from assign_tenant at
+        # save_table time, and unsaved frames die with the job anyway
+        scope = (
+            gov.tenant_scope(job.session_id)
+            if gov is not None
+            else nullcontext()
+        )
+        with scope:
+            wres = dag.run(self._engine, cancel_token=job.token)
+            self._note_fault_stats(wres.fault_stats)
+            payload: Dict[str, Any] = {
+                "yields": sorted(
+                    k for k in dag.yields if k != _RESULT_YIELD
+                ),
+            }
+            if not has_result:
+                return payload
+            df = wres[_RESULT_YIELD]
+            if job.save_as is not None:
+                session.save_table(job.save_as, df)
+                payload["saved_as"] = job.save_as
+            if job.collect:
+                from fugue_tpu.workflow.fault import engine_dispatch_guard
+
+                # head() on a device frame reads back through device
+                # programs: serialize with concurrent jobs; the job's
+                # token makes the wait cancellable
+                with engine_dispatch_guard(self._engine, job.token):
+                    local = df.head(job.limit + 1)
+                rows = local.as_array(type_safe=True)
+                truncated = len(rows) > job.limit
+                payload["result"] = {
+                    "columns": list(df.schema.names),
+                    "types": str(df.schema),
+                    "rows": rows[: job.limit],
+                    "row_count": min(len(rows), job.limit),
+                    "truncated": truncated,
+                }
+        session.touch()
+        return payload
+
+    def _note_fault_stats(self, stats: Dict[str, Any]) -> None:
+        with self._stats_lock:
+            self._fault_totals["runs"] += 1
+            for key in (
+                "retries", "recoveries", "degradations",
+                "integrity_rejected",
+            ):
+                self._fault_totals[key] += sum(
+                    (stats.get(key) or {}).values()
+                )
+            self._fault_totals["resumed"] += len(stats.get("resumed") or [])
+
+    # ---- HTTP routing ----------------------------------------------------
+    def handle_api(
+        self, method: str, path: str, payload: Dict[str, Any]
+    ) -> Tuple[int, Dict[str, Any]]:
+        """Route one API request; returns (status, JSON-safe response).
+        Never raises: handler failures become structured error payloads
+        (KeyError -> 404, bad input -> 400, the rest -> 500)."""
+        try:
+            return self._route(method, path, payload)
+        except KeyError as ex:
+            return 404, {"error": structured_error(ex)}
+        except (ValueError, TypeError) as ex:
+            return 400, {"error": structured_error(ex)}
+        except Exception as ex:  # pragma: no cover - defensive
+            return 500, {"error": structured_error(ex)}
+
+    def _route(
+        self, method: str, path: str, payload: Dict[str, Any]
+    ) -> Tuple[int, Dict[str, Any]]:
+        parts = [p for p in path.split("?", 1)[0].split("/") if p]
+        if not parts or parts[0] != "v1":
+            raise KeyError(f"unknown path {path}")
+        route = parts[1:]
+        if route == ["health"] and method == "GET":
+            return 200, {"ok": True}
+        if route == ["status"] and method == "GET":
+            return 200, self.status()
+        if route == ["sessions"]:
+            if method == "POST":
+                ttl = payload.get("ttl")
+                session = self.create_session(
+                    ttl=None if ttl is None else float(ttl)
+                )
+                return 200, {
+                    "session_id": session.session_id,
+                    "ttl": session.ttl,
+                }
+            if method == "GET":
+                self._sessions.sweep()
+                return 200, {"sessions": self._sessions.describe()}
+        if len(route) >= 2 and route[0] == "sessions":
+            sid = route[1]
+            rest = route[2:]
+            if not rest and method == "GET":
+                return 200, self._sessions.get(sid).describe()
+            if (not rest and method == "DELETE") or (
+                rest == ["close"] and method == "POST"
+            ):
+                return 200, self.close_session(sid)
+            if rest == ["sql"] and method == "POST":
+                return self._route_sql(sid, payload)
+        if len(route) >= 2 and route[0] == "jobs":
+            jid = route[1]
+            rest = route[2:]
+            if not rest and method == "GET":
+                return 200, self._scheduler.get(jid).snapshot()
+            if rest == ["cancel"] and method == "POST":
+                return 200, self._scheduler.cancel(jid).snapshot(
+                    include_result=False
+                )
+        raise KeyError(f"unknown route {method} {path}")
+
+    def _route_sql(
+        self, sid: str, payload: Dict[str, Any]
+    ) -> Tuple[int, Dict[str, Any]]:
+        sql = payload.get("sql")
+        if not isinstance(sql, str) or not sql.strip():
+            raise ValueError("payload must carry a non-empty 'sql' string")
+        mode = str(payload.get("mode", "sync")).lower()
+        if mode not in ("sync", "async"):
+            raise ValueError(f"mode must be sync|async, got {mode!r}")
+        job = self.submit(
+            sid,
+            sql,
+            save_as=payload.get("save_as"),
+            wait=mode == "sync",
+            timeout=float(payload.get("timeout", 0.0)),
+            collect=bool(payload.get("collect", True)),
+            limit=int(payload.get("limit", 10_000)),
+        )
+        if mode == "async":
+            return 202, job.snapshot(include_result=False)
+        return 200, job.snapshot()

@@ -1,0 +1,97 @@
+"""The daemon's HTTP layer: a JSON API on the hardened server machinery
+from :mod:`fugue_tpu.rpc.http`.
+
+:class:`ServeHTTPServer` subclasses :class:`HTTPRPCServer`, inheriting
+its threaded lifecycle (start/stop idempotence, wedged-shutdown
+reporting) and the daemon-hardening conf — request body cap
+(``fugue.rpc.http_server.max_body_bytes``), per-request read timeout
+(``.read_timeout``) — while swapping the pickle RPC protocol handler for
+a JSON router. Every response is JSON; failures are the structured
+``{"error": {"error": <type>, "message": <str>}}`` payload, never a
+traceback.
+"""
+
+import json
+from typing import TYPE_CHECKING, Any
+
+from fugue_tpu.rpc.http import (
+    HardenedRequestHandler,
+    HTTPRPCServer,
+    structured_error,
+)
+
+if TYPE_CHECKING:  # pragma: no cover
+    from fugue_tpu.serve.daemon import ServeDaemon
+
+
+def json_default(obj: Any) -> Any:
+    """JSON fallback for engine result cells: numpy/jax scalars unwrap
+    via ``.item()``, dates/timestamps via ``.isoformat()``, anything
+    else stringifies."""
+    item = getattr(obj, "item", None)
+    if callable(item):
+        try:
+            return item()
+        except Exception:
+            pass
+    iso = getattr(obj, "isoformat", None)
+    if callable(iso):
+        return iso()
+    return str(obj)
+
+
+def dumps(payload: Any) -> bytes:
+    return json.dumps(payload, default=json_default).encode("utf-8")
+
+
+class _ServeAPIHandler(HardenedRequestHandler):
+    # bound by the server factory (HTTPRPCServer.start_server)
+    rpc_server: "ServeHTTPServer"
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib naming)
+        self._route("GET", b"")
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        self._route("DELETE", b"")
+
+    def do_POST(self) -> None:  # noqa: N802
+        body = self.read_body()  # 413 already sent when over the cap
+        if body is None:
+            return
+        self._route("POST", body)
+
+    def _route(self, method: str, body: bytes) -> None:
+        try:
+            payload = json.loads(body.decode("utf-8")) if body else {}
+            if not isinstance(payload, dict):
+                raise ValueError("request body must be a JSON object")
+        except ValueError as ex:
+            self.send_error_payload(400, ex)
+            return
+        status, resp = self.rpc_server.daemon.handle_api(
+            method, self.path, payload
+        )
+        self._send_json(status, resp)
+
+    def _send_json(self, status: int, resp: Any) -> None:
+        data = dumps(resp)
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def send_error_payload(self, status: int, ex: BaseException) -> None:
+        self._send_json(status, {"error": structured_error(ex)})
+
+
+class ServeHTTPServer(HTTPRPCServer):
+    """The daemon's JSON API server. ``conf`` uses the same
+    ``fugue.rpc.http_server.*`` keys as the RPC server (the daemon maps
+    ``fugue.serve.host``/``.port`` onto them before construction)."""
+
+    handler_class = _ServeAPIHandler
+
+    def __init__(self, daemon: "ServeDaemon", conf: Any = None):
+        super().__init__(conf)
+        self.daemon = daemon

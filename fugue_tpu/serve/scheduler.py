@@ -1,0 +1,241 @@
+"""The daemon's job scheduler: N workflow submissions run concurrently
+against the ONE shared engine, each wrapped in the workflow runner's
+existing timeout/cancellation machinery.
+
+Every job executes as a single :class:`~fugue_tpu.workflow.runner.TaskNode`
+driven by a :class:`~fugue_tpu.workflow.runner.DAGRunner` in parallel
+mode, which is what provides the guarantees the daemon needs without new
+mechanism:
+
+- the node ``timeout`` gives per-job wall-clock abandonment (a wedged
+  query is abandoned on its daemon worker thread, never pinning a
+  scheduler slot past its budget);
+- the job's :class:`~fugue_tpu.workflow.fault.CancelToken` is shared
+  between the outer node AND the inner ``FugueWorkflow.run`` (via its
+  ``cancel_token`` parameter), so a cancel request aborts a queued job
+  before it starts and stops a running workflow at its next task
+  boundary.
+
+Concurrency is bounded by ``fugue.serve.max_concurrent`` worker threads
+pulling from one FIFO queue; completed jobs stay queryable until the
+retention cap evicts the oldest finished ones.
+"""
+
+import queue
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from fugue_tpu.exceptions import TaskCancelledError
+from fugue_tpu.workflow.fault import CancelToken
+from fugue_tpu.workflow.runner import DAGRunner, TaskNode
+
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+ERROR = "error"
+CANCELLED = "cancelled"
+
+# finished jobs kept for polling before the oldest are evicted
+_RETAIN_FINISHED = 1000
+# ... of which only the newest keep their FULL result payload (collected
+# rows can run to limit x row_width bytes per job — a long-lived daemon
+# must not pin hundreds of MB of host memory for jobs nobody will poll
+# again); older finished jobs keep status/error/timings only
+_RETAIN_RESULTS = 64
+
+
+class ServeJob:
+    """One submission: its request, lifecycle state, and outcome."""
+
+    def __init__(
+        self,
+        session_id: str,
+        sql: str,
+        save_as: Optional[str] = None,
+        timeout: float = 0.0,
+        collect: bool = True,
+        limit: int = 10_000,
+    ):
+        self.job_id = "job-" + uuid.uuid4().hex[:12]
+        self.session_id = session_id
+        self.sql = sql
+        self.save_as = save_as
+        self.timeout = max(0.0, float(timeout))
+        self.collect = bool(collect)
+        self.limit = int(limit)
+        self.token = CancelToken()
+        self.status = QUEUED
+        self.result: Optional[Dict[str, Any]] = None
+        self.error: Optional[Dict[str, str]] = None
+        self.submitted_at = time.time()
+        self.started_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.done_event = threading.Event()
+
+    @property
+    def finished(self) -> bool:
+        return self.status in (DONE, ERROR, CANCELLED)
+
+    def finish(self, status: str) -> None:
+        self.status = status
+        self.finished_at = time.time()
+        self.done_event.set()
+
+    def snapshot(self, include_result: bool = True) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "job_id": self.job_id,
+            "session_id": self.session_id,
+            "status": self.status,
+            "submitted_at": self.submitted_at,
+        }
+        if self.started_at is not None and self.finished_at is not None:
+            out["seconds"] = round(self.finished_at - self.started_at, 6)
+        if self.error is not None:
+            out["error"] = dict(self.error)
+        if include_result and isinstance(self.result, dict):
+            # the execution payload ("yields"/"saved_as"/"result") merges
+            # into the snapshot top level; job fields win on collision
+            for k, v in self.result.items():
+                out.setdefault(k, v)
+        return out
+
+
+class JobScheduler:
+    """Bounded-concurrency executor: ``execute(job)`` produces the job's
+    result payload; failures become structured errors on the job."""
+
+    def __init__(self, execute: Callable[[ServeJob], Any], max_concurrent: int):
+        self._execute = execute
+        self._max_concurrent = max(1, int(max_concurrent))
+        self._queue: "queue.Queue[Optional[ServeJob]]" = queue.Queue()
+        self._jobs: Dict[str, ServeJob] = {}
+        self._order: List[str] = []  # submission order, for retention
+        self._lock = threading.RLock()
+        self._workers: List[threading.Thread] = []
+        self._started = False
+
+    @property
+    def max_concurrent(self) -> int:
+        return self._max_concurrent
+
+    def start(self) -> None:
+        with self._lock:
+            if self._started:
+                return
+            self._started = True
+            self._workers = [
+                threading.Thread(
+                    target=self._work, daemon=True,
+                    name=f"fugue-serve-worker-{i}",
+                )
+                for i in range(self._max_concurrent)
+            ]
+        for w in self._workers:
+            w.start()
+
+    def stop(self) -> None:
+        """Cancel queued jobs and stop the workers. Running jobs get
+        their token set; their worker threads are daemons, so a wedged
+        query cannot block shutdown."""
+        with self._lock:
+            if not self._started:
+                return
+            self._started = False
+            jobs = list(self._jobs.values())
+        for job in jobs:
+            if not job.finished:
+                job.token.cancel()
+        for _ in self._workers:
+            self._queue.put(None)
+        for w in self._workers:
+            w.join(timeout=5)
+        self._workers = []
+
+    def submit(self, job: ServeJob) -> ServeJob:
+        with self._lock:
+            if not self._started:
+                raise ValueError("scheduler is not running")
+            self._jobs[job.job_id] = job
+            self._order.append(job.job_id)
+            self._evict_locked()
+            # enqueue UNDER the lock: stop() flips _started and snapshots
+            # the job table under the same lock, so a job can never land
+            # in the queue behind the shutdown sentinels un-cancelled
+            # (which would leave a sync waiter blocked forever)
+            self._queue.put(job)
+        return job
+
+    def get(self, job_id: str) -> ServeJob:
+        with self._lock:
+            job = self._jobs.get(job_id)
+        if job is None:
+            raise KeyError(f"unknown job {job_id}")
+        return job
+
+    def cancel(self, job_id: str) -> ServeJob:
+        """Set the job's cancel token: a queued job is skipped by its
+        worker, a running one aborts at its next cancellation point (or
+        its timeout). Finished jobs are left untouched."""
+        job = self.get(job_id)
+        if not job.finished:
+            job.token.cancel()
+        return job
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            jobs = list(self._jobs.values())
+        out = {QUEUED: 0, RUNNING: 0, DONE: 0, ERROR: 0, CANCELLED: 0}
+        for j in jobs:
+            out[j.status] = out.get(j.status, 0) + 1
+        return out
+
+    def _evict_locked(self) -> None:
+        while len(self._order) > _RETAIN_FINISHED:
+            for i, jid in enumerate(self._order):
+                if self._jobs[jid].finished:
+                    del self._jobs[jid]
+                    del self._order[i]
+                    break
+            else:
+                return  # everything retained is still live
+        # payload stripping beyond the fresh window (see _RETAIN_RESULTS)
+        finished = [j for j in self._order if self._jobs[j].finished]
+        for jid in finished[:-_RETAIN_RESULTS]:
+            self._jobs[jid].result = None
+
+    # ---- worker loop -----------------------------------------------------
+    def _work(self) -> None:
+        while True:
+            job = self._queue.get()
+            if job is None:
+                return
+            if job.token.cancelled:
+                job.finish(CANCELLED)
+                continue
+            job.status = RUNNING
+            job.started_at = time.time()
+            node = TaskNode(
+                job.job_id,
+                lambda deps, j=job: self._execute(j),
+                [],
+                name=f"serve:{job.job_id}",
+                timeout=job.timeout,
+            )
+            try:
+                # parallel mode (even for one node) is what enforces the
+                # wall-clock timeout; the shared token lets cancel() stop
+                # the inner workflow too
+                res = DAGRunner(concurrency=2).run(
+                    [node], cancel_token=job.token
+                )
+                job.result = res.get(job.job_id)
+                job.finish(DONE)
+            except TaskCancelledError:
+                job.finish(CANCELLED)
+            except Exception as ex:
+                from fugue_tpu.rpc.http import structured_error
+
+                job.error = structured_error(ex)
+                job.finish(ERROR)

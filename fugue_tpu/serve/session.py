@@ -1,0 +1,199 @@
+"""Serve sessions: the per-client unit of state in the daemon.
+
+A session owns a namespaced slice of the engine's table catalog — every
+table it saves lands under ``__serve__.<session_id>.<name>`` via the
+engine's ``SQLEngine.save_table`` (the jax SQL engine keeps the
+PERSISTED device-resident frame, so a hot table survives across requests
+without re-ingest) — and doubles as the memory governor's *tenant*: its
+saved tables are claimed with :meth:`MemoryGovernor.assign_tenant`, so
+per-tenant budget accounting and fair spill ordering see exactly the
+bytes this session pins. Closing the session drops every table from the
+catalog; the ledger reconciles to zero through the frames' weakref
+finalizers the moment the last reference dies.
+"""
+
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from fugue_tpu.dataframe import DataFrame
+from fugue_tpu.utils.assertion import assert_or_throw
+from fugue_tpu.workflow.fault import engine_dispatch_guard
+
+_NAMESPACE = "__serve__"
+
+
+class ServeSession:
+    """One client's hot state against the shared persistent engine."""
+
+    def __init__(self, engine: Any, ttl: float = 0.0):
+        self.session_id = "s-" + uuid.uuid4().hex[:12]
+        self._engine = engine
+        self.ttl = max(0.0, float(ttl))
+        self.created_at = time.time()
+        self._last_used = time.monotonic()
+        self._tables: Dict[str, str] = {}  # name -> qualified catalog name
+        self._lock = threading.RLock()
+        self._closed = False
+
+    # ---- lifecycle -------------------------------------------------------
+    def touch(self) -> None:
+        self._last_used = time.monotonic()
+
+    @property
+    def idle_seconds(self) -> float:
+        return time.monotonic() - self._last_used
+
+    @property
+    def expired(self) -> bool:
+        return self.ttl > 0 and self.idle_seconds > self.ttl
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self) -> List[str]:
+        """Drop every session table from the catalog; returns the dropped
+        names. Idempotent."""
+        with self._lock:
+            if self._closed:
+                return []
+            self._closed = True
+            dropped = list(self._tables)
+            sql = self._engine.sql_engine
+            for name, qualified in self._tables.items():
+                try:
+                    sql.drop_table(qualified)
+                except Exception:  # pragma: no cover - best-effort cleanup
+                    pass
+            self._tables.clear()
+            return dropped
+
+    # ---- table catalog (namespaced) --------------------------------------
+    def qualified(self, name: str) -> str:
+        return f"{_NAMESPACE}.{self.session_id}.{name}"
+
+    def save_table(self, name: str, df: DataFrame) -> str:
+        """Persist ``df`` as a hot session table and claim its bytes for
+        this session's tenant account in the memory governor."""
+        assert_or_throw(
+            name.isidentifier(),
+            ValueError(f"invalid table name {name!r}"),
+        )
+        q = self.qualified(name)
+        with self._lock:
+            assert_or_throw(
+                not self._closed, ValueError("session is closed")
+            )
+            sql = self._engine.sql_engine
+            # persist runs device programs: serialize with concurrent
+            # jobs sharing the engine (see task_execution_lock)
+            with engine_dispatch_guard(self._engine, None):
+                sql.save_table(df, q, mode="overwrite")
+            self._claim_tenant(sql.load_table(q))
+            self._tables[name] = q
+        self.touch()
+        return q
+
+    def _claim_tenant(self, loaded: DataFrame) -> None:
+        gov = getattr(self._engine, "memory_governor", None)
+        blocks = getattr(loaded, "native", None)
+        if gov is not None and blocks is not None:
+            gov.assign_tenant(blocks, self.session_id)
+
+    def drop_table(self, name: str) -> None:
+        with self._lock:
+            q = self._tables.pop(name, None)
+        if q is not None:
+            self._engine.sql_engine.drop_table(q)
+
+    def table_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._tables)
+
+    def table_frames(self) -> Dict[str, DataFrame]:
+        """The live session tables as engine dataframes — fed into
+        FugueSQL compilation as named sources, so a query just says
+        ``SELECT ... FROM mytable``."""
+        with self._lock:
+            items = list(self._tables.items())
+        sql = self._engine.sql_engine
+        return {name: sql.load_table(q) for name, q in items}
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "session_id": self.session_id,
+            "created_at": self.created_at,
+            "idle_seconds": round(self.idle_seconds, 3),
+            "ttl": self.ttl,
+            "tables": self.table_names(),
+        }
+
+
+class SessionManager:
+    """Session registry with lazy TTL expiry: every lookup sweeps the
+    expired (closing them drops their tables, so an abandoned session
+    cannot pin device memory forever)."""
+
+    def __init__(self, engine: Any, default_ttl: float = 0.0):
+        self._engine = engine
+        self._default_ttl = max(0.0, float(default_ttl))
+        self._sessions: Dict[str, ServeSession] = {}
+        self._lock = threading.RLock()
+
+    def create(self, ttl: Optional[float] = None) -> ServeSession:
+        session = ServeSession(
+            self._engine,
+            ttl=self._default_ttl if ttl is None else float(ttl),
+        )
+        with self._lock:
+            self._sessions[session.session_id] = session
+        self.sweep()
+        return session
+
+    def get(self, session_id: str) -> ServeSession:
+        """Raises ``KeyError`` for unknown AND expired ids (an expired
+        session is closed on discovery)."""
+        self.sweep()
+        with self._lock:
+            session = self._sessions.get(session_id)
+        if session is None:
+            raise KeyError(f"unknown or expired session {session_id}")
+        session.touch()
+        return session
+
+    def close(self, session_id: str) -> List[str]:
+        with self._lock:
+            session = self._sessions.pop(session_id, None)
+        if session is None:
+            raise KeyError(f"unknown or expired session {session_id}")
+        return session.close()
+
+    def close_all(self) -> None:
+        with self._lock:
+            sessions = list(self._sessions.values())
+            self._sessions.clear()
+        for s in sessions:
+            s.close()
+
+    def sweep(self) -> int:
+        """Close every expired session; returns how many were closed."""
+        with self._lock:
+            expired = [
+                (sid, s) for sid, s in self._sessions.items() if s.expired
+            ]
+            for sid, _ in expired:
+                del self._sessions[sid]
+        for _, s in expired:
+            s.close()
+        return len(expired)
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self._sessions)
+
+    def describe(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            sessions = list(self._sessions.values())
+        return [s.describe() for s in sessions]

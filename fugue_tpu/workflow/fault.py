@@ -20,6 +20,7 @@ Three pieces:
 import random
 import threading
 import time
+from contextlib import contextmanager
 from typing import Any, Callable, Optional, Tuple
 
 from fugue_tpu.constants import (
@@ -275,6 +276,31 @@ def _degrade_ctx(engine: Any) -> Optional[Any]:
     return engine.degraded_to_host()
 
 
+@contextmanager
+def engine_dispatch_guard(
+    engine: Any, token: Optional[CancelToken]
+) -> Any:
+    """Hold the engine's ``task_execution_lock`` (device-dispatch
+    serialization for engines shared by concurrent workflows — the
+    serving daemon) around ONE task attempt; no-op for engines that
+    allow concurrent dispatch (lock is None). Scoped to the attempt so
+    backoff sleeps and queue time never serialize other tenants, and
+    acquisition is CANCELLATION-AWARE: a task cancelled (or expired at
+    the job layer) while queued behind a wedged sibling aborts with
+    ``TaskCancelledError`` instead of blocking on the lock forever."""
+    lock = getattr(engine, "task_execution_lock", None)
+    if lock is None:
+        yield
+        return
+    while not lock.acquire(timeout=0.1):
+        if token is not None:
+            token.raise_if_cancelled()
+    try:
+        yield
+    finally:
+        lock.release()
+
+
 def execute_with_policy(
     fn: Callable[[], Any],
     policy: RetryPolicy,
@@ -289,7 +315,8 @@ def execute_with_policy(
     exponential backoff + jitter; a device-OOM first re-runs on the
     engine's host tier WITHOUT consuming a retry (capacity degradation is
     not a transient fault — the same attempt deserves a cheaper venue);
-    fatal errors and exhausted budgets re-raise the original error."""
+    fatal errors and exhausted budgets re-raise the original error.
+    Each attempt runs under :func:`engine_dispatch_guard`."""
     rng = random.Random()
     attempt = 0
     while True:
@@ -297,7 +324,8 @@ def execute_with_policy(
         if token is not None:
             token.raise_if_cancelled()
         try:
-            result = fn()
+            with engine_dispatch_guard(engine, token):
+                result = fn()
             if attempt > 1:
                 plan = active_plan()
                 if plan is not None:
@@ -375,7 +403,7 @@ def _try_degrade(
             cause,
         )
     try:
-        with ctx:
+        with ctx, engine_dispatch_guard(engine, token):
             result = fn()
     except TaskCancelledError:
         raise

@@ -28,6 +28,12 @@ Fault semantics (the production contract):
   launched-but-unstarted siblings abort at their first cancellation
   point and are NOT recorded as failures (they didn't fail — they were
   cancelled).
+- An EXTERNAL cancellation (a caller-owned ``cancel_token`` set from
+  another thread — the serving daemon's job-cancel path) stops task
+  launch at the next supervisor round, drains in-flight work, and
+  raises :class:`~fugue_tpu.exceptions.TaskCancelledError` when the run
+  did not complete; a token set after every task already finished is a
+  completed run, not a cancelled one.
 """
 
 import threading
@@ -144,8 +150,10 @@ class DAGRunner:
         pending = {n.task_id: n for n in nodes}
         running: Dict[Future, TaskNode] = {}
         failures: List[TaskFailure] = []
-        while running or (pending and not failures):
-            if not failures:
+        while running or (
+            pending and not failures and not token.cancelled
+        ):
+            if not failures and not token.cancelled:
                 # bounded concurrency: launch ready tasks into free slots
                 # only (each task gets its own daemon worker thread)
                 free = self._concurrency - len(running)
@@ -212,6 +220,11 @@ class DAGRunner:
             if len(failures) == 1:
                 raise failures[0].error
             raise WorkflowRuntimeError(failures)
+        if len(results) < len(nodes):
+            # nothing failed but not every task completed: an externally
+            # cancelled run surfaces as cancellation, not as a silent
+            # partial result dict
+            token.raise_if_cancelled()
         return results
 
     def _spawn(

@@ -827,13 +827,26 @@ class FugueWorkflow:
                 e.log.info("fugue_tpu analysis: %s", d.describe(False))
 
     # ---- run -------------------------------------------------------------
-    def run(self, engine: Any = None, conf: Any = None) -> "FugueWorkflowResult":
+    def run(
+        self,
+        engine: Any = None,
+        conf: Any = None,
+        cancel_token: Any = None,
+    ) -> "FugueWorkflowResult":
+        """Execute the DAG. ``cancel_token`` (optional): a caller-owned
+        :class:`~fugue_tpu.workflow.fault.CancelToken` shared with the
+        runner — setting it from another thread cancels the run at the
+        next task boundary (how the serving daemon cancels a job
+        mid-workflow). The token is a ONE-RUN object: the runner also
+        sets it internally when any task fails (that is the sibling
+        abort signal), so never reuse a token across runs — a re-run
+        with a fired token cancels immediately."""
         e = make_execution_engine(engine, conf)
         self._pre_run_analysis(e, run_conf=conf)
         execution_id = str(uuid4())
         rpc_server = make_rpc_server(e.conf)
         checkpoint_path = CheckpointPath(e)
-        token = CancelToken()
+        token = cancel_token if cancel_token is not None else CancelToken()
         stats = RunStats()
         ctx = TaskContext(e, rpc_server, checkpoint_path, cancel_token=token)
         base_policy = RetryPolicy.from_conf(e.conf)
@@ -946,6 +959,9 @@ class FugueWorkflow:
                     task, ctx, stats=stats
                 ):
                     stats.note_resumed(task.name)
+                # each attempt inside holds the engine's dispatch guard
+                # (task_execution_lock): shared-engine device programs
+                # serialize per attempt, host phases overlap
                 return execute_with_policy(
                     lambda: attempt(inputs),
                     policy,

@@ -1,0 +1,340 @@
+"""Multi-tenant serving daemon integration: concurrent sessions over
+real HTTP against ONE persistent engine — result parity with serial
+execution, hot tables surviving across requests without re-ingest,
+async submit/poll/cancel, TTL expiry, and the hardened error surface.
+Tier-1 compatible; select with ``-m serve``."""
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fugue_tpu.constants import (
+    FUGUE_CONF_SERVE_MAX_CONCURRENT,
+    FUGUE_CONF_SERVE_SESSION_TTL,
+)
+from fugue_tpu.serve import ServeAPIError, ServeClient, ServeDaemon
+
+pytestmark = pytest.mark.serve
+
+
+def _pdf(n=256, seed=0):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame(
+        {
+            "k": rng.integers(0, 7, n).astype(np.int64),
+            "v": rng.integers(0, 1000, n).astype(np.int64),
+        }
+    )
+
+
+def _rows_sql(pdf):
+    """An inline FugueSQL CREATE for a small pandas frame."""
+    rows = ",".join(f"[{k},{v}]" for k, v in zip(pdf.k, pdf.v))
+    return f"CREATE [{rows}] SCHEMA k:long,v:long"
+
+
+def _expected_agg(pdf):
+    g = pdf.groupby("k", as_index=False).agg(n=("v", "count"), s=("v", "sum"))
+    return sorted([int(a), int(b), int(c)] for a, b, c in g.itertuples(index=False))
+
+
+_AGG_SQL = "SELECT k, COUNT(*) AS n, SUM(v) AS s FROM t GROUP BY k"
+
+
+# ---------------------------------------------------------------------------
+# basics: health, round trip, structured errors
+# ---------------------------------------------------------------------------
+def test_health_round_trip_and_hot_table_no_reingest():
+    with ServeDaemon() as daemon:
+        client = ServeClient(*daemon.address)
+        assert client.health()
+        sid = client.create_session()
+        pdf = _pdf(seed=1)
+        client.sql(sid, _rows_sql(pdf), save_as="t", collect=False)
+        # the hot table lives in the catalog as ONE persisted frame: the
+        # identical object serves every subsequent request (no re-ingest)
+        session = daemon.sessions.get(sid)
+        frame1 = session.table_frames()["t"]
+        r = client.sql(sid, _AGG_SQL)
+        assert r["status"] == "done"
+        assert sorted(r["result"]["rows"]) == _expected_agg(pdf)
+        r2 = client.sql(sid, "SELECT COUNT(*) AS c FROM t")
+        assert r2["result"]["rows"] == [[len(pdf)]]
+        frame2 = session.table_frames()["t"]
+        assert frame1 is frame2  # same catalog object across requests
+        assert session.describe()["tables"] == ["t"]
+        closed = client.close_session(sid)
+        assert closed["dropped_tables"] == ["t"]
+        with pytest.raises(ServeAPIError) as ex:
+            client.sql(sid, "SELECT 1 AS x FROM t")
+        assert ex.value.status == 404
+
+
+def test_structured_errors_no_tracebacks():
+    with ServeDaemon() as daemon:
+        client = ServeClient(*daemon.address)
+        # unknown route -> 404 structured
+        with pytest.raises(ServeAPIError) as ex:
+            client._call("GET", "/v1/nope")
+        assert ex.value.status == 404
+        assert "error" in ex.value.error and "message" in ex.value.error
+        # bad payload -> 400 structured
+        sid = client.create_session()
+        with pytest.raises(ServeAPIError) as ex:
+            client._call("POST", f"/v1/sessions/{sid}/sql", {"sql": ""})
+        assert ex.value.status == 400
+        # a failing query surfaces as a structured job error, not a 500
+        snap = client.sql(sid, "SELECT nope FROM missing_table")
+        assert snap["status"] == "error"
+        assert set(snap["error"]) == {"error", "message"}
+        assert "Traceback" not in json.dumps(snap)
+
+
+def test_request_body_cap_returns_413():
+    with ServeDaemon(
+        {"fugue.rpc.http_server.max_body_bytes": 1024}
+    ) as daemon:
+        client = ServeClient(*daemon.address)
+        sid = client.create_session()
+        with pytest.raises(ServeAPIError) as ex:
+            client.sql(sid, "SELECT 1 AS x -- " + "z" * 4096)
+        assert ex.value.status == 413
+        assert "cap" in ex.value.error["message"]
+        # the daemon keeps serving normal requests afterwards
+        assert client.health()
+
+
+def test_malformed_content_length_returns_400():
+    with ServeDaemon() as daemon:
+        host, port = daemon.address
+        for bad in (b"abc", b"-5"):
+            s = socket.create_connection((host, port), timeout=5)
+            try:
+                s.sendall(
+                    b"POST /v1/sessions HTTP/1.1\r\nHost: x\r\n"
+                    b"Content-Length: " + bad + b"\r\n\r\n"
+                )
+                s.settimeout(5)
+                head = s.recv(4096)
+                # structured 400, not a dropped connection / traceback
+                assert b"400" in head.split(b"\r\n", 1)[0], head
+                assert b"Content-Length" in head and b"Traceback" not in head
+            finally:
+                s.close()
+        client = ServeClient(host, port)
+        assert client.health()  # handler survived both
+
+
+def test_read_timeout_closes_stalled_connection():
+    with ServeDaemon(
+        {"fugue.rpc.http_server.read_timeout": 0.3}
+    ) as daemon:
+        host, port = daemon.address
+        s = socket.create_connection((host, port), timeout=5)
+        try:
+            # declare a body, then stall: the per-request read timeout
+            # must close the connection instead of pinning the handler
+            s.sendall(
+                b"POST /v1/sessions HTTP/1.1\r\nHost: x\r\n"
+                b"Content-Length: 100\r\n\r\n"
+            )
+            s.settimeout(5)
+            assert s.recv(1024) == b""  # server closed on us
+        finally:
+            s.close()
+        client = ServeClient(host, port)
+        assert client.health()  # handler thread survived
+
+
+# ---------------------------------------------------------------------------
+# the acceptance bar: >= 4 concurrent sessions, one engine, serial parity
+# ---------------------------------------------------------------------------
+def test_concurrent_sessions_parity_with_serial():
+    n_sessions, n_queries = 4, 3
+    frames = {i: _pdf(seed=10 + i) for i in range(n_sessions)}
+    with ServeDaemon(
+        {FUGUE_CONF_SERVE_MAX_CONCURRENT: n_sessions}
+    ) as daemon:
+        host, port = daemon.address
+        results: dict = {}
+        errors: list = []
+
+        def tenant(i: int) -> None:
+            try:
+                client = ServeClient(host, port)
+                sid = client.create_session()
+                client.sql(
+                    sid, _rows_sql(frames[i]), save_as="t", collect=False
+                )
+                out = []
+                for _ in range(n_queries):
+                    r = client.sql(sid, _AGG_SQL)
+                    assert r["status"] == "done", r
+                    out.append(sorted(r["result"]["rows"]))
+                results[i] = out
+                client.close_session(sid)
+            except Exception as ex:  # pragma: no cover - surfaced below
+                errors.append((i, repr(ex)))
+
+        threads = [
+            threading.Thread(target=tenant, args=(i,))
+            for i in range(n_sessions)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not errors, errors
+        # parity: every concurrent answer matches the serial (pandas)
+        # computation of the same session's data
+        for i in range(n_sessions):
+            expected = _expected_agg(frames[i])
+            assert results[i] == [expected] * n_queries
+        status = daemon.status()
+        assert status["jobs"]["done"] == n_sessions * (n_queries + 1)
+        assert status["jobs"]["error"] == 0
+        assert status["sessions"]["count"] == 0  # all closed
+        assert status["fault_stats"]["runs"] == n_sessions * (n_queries + 1)
+
+
+# ---------------------------------------------------------------------------
+# async submit / poll / cancel
+# ---------------------------------------------------------------------------
+def test_async_submit_and_poll():
+    with ServeDaemon() as daemon:
+        client = ServeClient(*daemon.address)
+        sid = client.create_session()
+        pdf = _pdf(seed=3)
+        jid = client.submit_async(sid, _rows_sql(pdf), save_as="t")
+        snap = client.wait(jid)
+        assert snap["status"] == "done"
+        assert snap["saved_as"] == "t"
+        snap2 = client.wait(client.submit_async(sid, _AGG_SQL))
+        assert sorted(snap2["result"]["rows"]) == _expected_agg(pdf)
+
+
+def test_cancel_queued_job_with_single_slot():
+    # one scheduler slot; the first job blocks on an event, the second
+    # queues behind it and is cancelled BEFORE it ever runs
+    with ServeDaemon({FUGUE_CONF_SERVE_MAX_CONCURRENT: 1}) as daemon:
+        client = ServeClient(*daemon.address)
+        sid = client.create_session()
+        started = threading.Event()
+        release = threading.Event()
+        real_execute = daemon.scheduler._execute
+
+        def blocking_execute(job):
+            started.set()
+            release.wait(timeout=60)
+            return real_execute(job)
+
+        daemon.scheduler._execute = blocking_execute
+        try:
+            j1 = client.submit_async(sid, "CREATE [[1]] SCHEMA a:long")
+            assert started.wait(timeout=30)
+            j2 = client.submit_async(sid, "CREATE [[2]] SCHEMA a:long")
+            cancelled = client.cancel(j2)
+            assert cancelled["status"] in ("queued", "cancelled")
+            release.set()
+            assert client.wait(j1)["status"] == "done"
+            assert client.wait(j2)["status"] == "cancelled"
+            # cancelling a finished job is a no-op, not an error
+            assert client.cancel(j1)["status"] == "done"
+        finally:
+            daemon.scheduler._execute = real_execute
+            release.set()
+
+
+def test_job_timeout_surfaces_as_structured_error():
+    with ServeDaemon({FUGUE_CONF_SERVE_MAX_CONCURRENT: 2}) as daemon:
+        client = ServeClient(*daemon.address)
+        sid = client.create_session()
+        real_execute = daemon.scheduler._execute
+        daemon.scheduler._execute = lambda job: time.sleep(30)
+        try:
+            snap = client.sql(sid, "CREATE [[1]] SCHEMA a:long", timeout=0.4)
+            assert snap["status"] == "error"
+            assert snap["error"]["error"] == "TaskTimeoutError"
+        finally:
+            daemon.scheduler._execute = real_execute
+
+
+# ---------------------------------------------------------------------------
+# session TTL
+# ---------------------------------------------------------------------------
+def test_session_ttl_expires_and_drops_tables():
+    with ServeDaemon({FUGUE_CONF_SERVE_SESSION_TTL: 0.3}) as daemon:
+        client = ServeClient(*daemon.address)
+        sid = client.create_session()
+        client.sql(sid, "CREATE [[5]] SCHEMA a:long", save_as="t",
+                   collect=False)
+        q = daemon.sessions.get(sid).qualified("t")
+        assert daemon.engine.sql_engine.table_exists(q)
+        time.sleep(0.5)
+        with pytest.raises(ServeAPIError) as ex:
+            client.session(sid)
+        assert ex.value.status == 404
+        # expiry CLOSED the session: its catalog tables are gone
+        assert not daemon.engine.sql_engine.table_exists(q)
+        assert daemon.sessions.count() == 0
+
+
+def test_per_session_ttl_override_keeps_session_alive():
+    with ServeDaemon({FUGUE_CONF_SERVE_SESSION_TTL: 0.2}) as daemon:
+        client = ServeClient(*daemon.address)
+        sid = client.create_session(ttl=0)  # never expires
+        time.sleep(0.4)
+        assert client.session(sid)["session_id"] == sid
+
+
+# ---------------------------------------------------------------------------
+# status surface
+# ---------------------------------------------------------------------------
+def test_status_surfaces_memory_fallbacks_and_fault_stats():
+    with ServeDaemon() as daemon:
+        client = ServeClient(*daemon.address)
+        sid = client.create_session()
+        client.sql(sid, "CREATE [[1],[2]] SCHEMA a:long", save_as="t",
+                   collect=False)
+        client.sql(sid, "SELECT SUM(a) AS s FROM t")
+        st = client.status()
+        assert st["uptime_seconds"] >= 0
+        engine = st["engine"]
+        assert engine["type"] == "JaxExecutionEngine"
+        assert "memory" in engine and "enabled" in engine["memory"]
+        assert "tenants" in engine["memory"]
+        assert isinstance(engine.get("fallbacks"), dict)
+        assert st["fault_stats"]["runs"] == 2
+        assert st["sessions"]["count"] == 1
+        assert st["jobs"]["done"] == 2
+
+
+def test_urllib_curl_style_flow():
+    # the README curl flow, verbatim over raw urllib: JSON in, JSON out
+    with ServeDaemon() as daemon:
+        host, port = daemon.address
+        base = f"http://{host}:{port}"
+
+        def post(path, payload):
+            req = urllib.request.Request(
+                base + path,
+                data=json.dumps(payload).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return json.loads(resp.read().decode())
+
+        sid = post("/v1/sessions", {})["session_id"]
+        post(f"/v1/sessions/{sid}/sql",
+             {"sql": "CREATE [[1],[2],[3]] SCHEMA a:long", "save_as": "t"})
+        out = post(f"/v1/sessions/{sid}/sql",
+                   {"sql": "SELECT SUM(a) AS s FROM t"})
+        assert out["result"]["rows"] == [[6]]
+        assert post(f"/v1/sessions/{sid}/close", {})["closed"] == sid

@@ -1,0 +1,163 @@
+"""Per-tenant memory governance through the serving daemon: session
+tables charge their session's tenant account, the ledger reconciles to
+zero on session close, and fair spill ordering protects light tenants
+from heavy ones under a constrained budget. Tier-1 compatible; select
+with ``-m serve`` (or ``-m memory``)."""
+
+import gc
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fugue_tpu.constants import (
+    FUGUE_CONF_JAX_MEMORY_BUDGET_BYTES,
+    FUGUE_CONF_JAX_MEMORY_HIGH_WATERMARK,
+    FUGUE_CONF_JAX_MEMORY_LOW_WATERMARK,
+    FUGUE_CONF_SERVE_TENANT_BUDGET_FRACTION,
+)
+from fugue_tpu.serve import ServeDaemon
+
+pytestmark = [pytest.mark.serve, pytest.mark.memory]
+
+
+def _frame(n, seed=0):
+    """Two 8-byte columns, n divisible by the 8-device test mesh: exactly
+    16n device bytes, no masks — deterministic ledger arithmetic."""
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame(
+        {
+            "x": rng.integers(0, 100, n).astype(np.int64),
+            "y": rng.random(n),
+        }
+    )
+
+
+def _governed_daemon(budget, fraction, high=0.9, low=0.6):
+    return ServeDaemon(
+        {
+            FUGUE_CONF_JAX_MEMORY_BUDGET_BYTES: budget,
+            FUGUE_CONF_JAX_MEMORY_HIGH_WATERMARK: high,
+            FUGUE_CONF_JAX_MEMORY_LOW_WATERMARK: low,
+            FUGUE_CONF_SERVE_TENANT_BUDGET_FRACTION: fraction,
+        }
+    )
+
+
+def _save(daemon, session, name, pdf):
+    session.save_table(name, daemon.engine.to_df(pdf))
+
+
+# ---------------------------------------------------------------------------
+# tenant accounting + reconciliation to zero on close
+# ---------------------------------------------------------------------------
+def test_session_tables_charge_their_tenant_account():
+    with _governed_daemon(10_000_000, 0.25) as daemon:
+        s1 = daemon.create_session()
+        s2 = daemon.create_session()
+        _save(daemon, s1, "a", _frame(2000, seed=1))  # 32K
+        _save(daemon, s2, "b", _frame(4000, seed=2))  # 64K
+        tenants = daemon.engine.memory_stats["tenants"]
+        assert tenants[s1.session_id] == {"device": 32_000, "host": 0}
+        assert tenants[s2.session_id] == {"device": 64_000, "host": 0}
+        gov = daemon.engine.memory_governor
+        assert gov.tenant_usage(s1.session_id)["device"] == 32_000
+        assert (
+            daemon.engine.memory_stats["tenant_share_bytes"]
+            == 2_500_000
+        )
+
+
+def test_tenant_ledger_reconciles_to_zero_on_session_close():
+    with _governed_daemon(10_000_000, 0.25) as daemon:
+        sessions = [daemon.create_session() for _ in range(3)]
+        for i, s in enumerate(sessions):
+            _save(daemon, s, "t", _frame(2000, seed=i))
+            _save(daemon, s, "u", _frame(2000, seed=10 + i))
+        stats = daemon.engine.memory_stats
+        assert len(stats["tenants"]) == 3
+        assert stats["tiers"]["device"] == 6 * 32_000
+        closing = sessions[0].session_id
+        daemon.close_session(closing)
+        gc.collect()  # catalog refs dropped -> weakref finalizers fire
+        stats = daemon.engine.memory_stats
+        # the closed tenant's account is GONE (reconciled to zero);
+        # everyone else's is untouched
+        assert closing not in stats["tenants"]
+        assert stats["tiers"]["device"] == 4 * 32_000
+        for s in sessions[1:]:
+            assert stats["tenants"][s.session_id]["device"] == 64_000
+        for s in sessions[1:]:
+            daemon.close_session(s.session_id)
+        gc.collect()
+        stats = daemon.engine.memory_stats
+        assert stats["tenants"] == {}
+        assert stats["tiers"]["device"] == 0
+        assert stats["live_frames"] == 0
+
+
+# ---------------------------------------------------------------------------
+# fair spill: the heavy tenant pays first, light survives on device
+# ---------------------------------------------------------------------------
+def test_fair_spill_evicts_heavy_tenant_before_light():
+    # budget 200K, share 30% = 60K/tenant, high 0.8 (160K), low 0.5.
+    # Light saves its 16K table FIRST (globally the LRU victim); heavy
+    # then piles on 3 x 64K. The admission crossing the watermark must
+    # spill the HEAVY tenant's oldest frames and leave light's alone —
+    # under plain global LRU, light's would have gone first.
+    with _governed_daemon(200_000, 0.3, high=0.8, low=0.5) as daemon:
+        light = daemon.create_session()
+        heavy = daemon.create_session()
+        _save(daemon, light, "small", _frame(1000, seed=1))   # 16K, oldest
+        _save(daemon, heavy, "big1", _frame(4000, seed=2))    # 64K
+        _save(daemon, heavy, "big2", _frame(4000, seed=3))    # 64K
+        # usage 144K; admitting another 64K crosses 160K -> pressure
+        _save(daemon, heavy, "big3", _frame(4000, seed=4))
+        stats = daemon.engine.memory_stats
+        tenants = stats["tenants"]
+        # light's table never spilled despite being LRU-oldest
+        assert tenants[light.session_id] == {"device": 16_000, "host": 0}
+        # heavy paid for its own pressure: big1/big2 went to host
+        assert tenants[heavy.session_id]["host"] == 128_000
+        assert tenants[heavy.session_id]["device"] == 64_000
+        assert stats["counters"]["spills"] == 2
+        assert daemon.engine.fallbacks["mem_spill"] == 2
+        # spilled tables stay fully readable through the catalog
+        spilled = heavy.table_frames()["big1"]
+        pd.testing.assert_frame_equal(
+            spilled.as_pandas(), _frame(4000, seed=2)
+        )
+
+
+def test_global_lru_when_no_tenant_fraction_configured():
+    # fraction 0 = per-tenant fairness off: the original global LRU
+    # order applies even with tenants present — light's OLDEST table is
+    # the first victim
+    with _governed_daemon(200_000, 0.0, high=0.8, low=0.5) as daemon:
+        light = daemon.create_session()
+        heavy = daemon.create_session()
+        _save(daemon, light, "small", _frame(1000, seed=1))  # oldest
+        _save(daemon, heavy, "big1", _frame(4000, seed=2))
+        _save(daemon, heavy, "big2", _frame(4000, seed=3))
+        _save(daemon, heavy, "big3", _frame(4000, seed=4))
+        tenants = daemon.engine.memory_stats["tenants"]
+        assert tenants[light.session_id]["host"] == 16_000  # spilled
+        assert tenants[light.session_id]["device"] == 0
+
+
+def test_job_run_registrations_tagged_with_tenant_scope():
+    # a query's ingest inside the job thread is tagged via tenant_scope:
+    # the saved RESULT of a submitted workflow lands on the session's
+    # account too (submit -> save_as path, end to end in process)
+    with _governed_daemon(10_000_000, 0.25) as daemon:
+        session = daemon.create_session()
+        job = daemon.submit(
+            session.session_id,
+            "CREATE [[1,10],[2,20],[3,30]] SCHEMA k:long,v:long",
+            save_as="t",
+            collect=False,
+        )
+        assert job.status == "done", (job.status, job.error)
+        tenants = daemon.engine.memory_stats["tenants"]
+        assert session.session_id in tenants
+        assert tenants[session.session_id]["device"] > 0

@@ -55,3 +55,85 @@ def test_serial_when_concurrency_one():
         assert events[i].startswith("start:") and events[i + 1].startswith(
             "end:"
         ), events
+
+
+# ---------------------------------------------------------------------------
+# EXTERNAL cancellation: a caller-owned token (the serving daemon's
+# job-cancel path) stops the run at the next task boundary
+# ---------------------------------------------------------------------------
+def test_external_cancel_token_aborts_parallel_run():
+    import pytest
+
+    from fugue_tpu.exceptions import TaskCancelledError
+    from fugue_tpu.workflow.fault import CancelToken
+    from fugue_tpu.workflow.runner import DAGRunner, TaskNode
+
+    token = CancelToken()
+    first_started = threading.Event()
+    ran: List[str] = []
+
+    def first(deps):
+        first_started.set()
+        time.sleep(0.2)
+        ran.append("first")
+        return 1
+
+    def second(deps):
+        ran.append("second")
+        return 2
+
+    nodes = [
+        TaskNode("t1", first, []),
+        TaskNode("t2", second, ["t1"]),
+    ]
+    canceller = threading.Thread(
+        target=lambda: (first_started.wait(5), token.cancel())
+    )
+    canceller.start()
+    with pytest.raises(TaskCancelledError):
+        DAGRunner(concurrency=2).run(nodes, cancel_token=token)
+    canceller.join()
+    # the in-flight task drained; the dependent never launched
+    assert ran == ["first"]
+
+
+def test_external_token_set_after_completion_is_a_completed_run():
+    from fugue_tpu.workflow.fault import CancelToken
+    from fugue_tpu.workflow.runner import DAGRunner, TaskNode
+
+    token = CancelToken()
+    res = DAGRunner(concurrency=2).run(
+        [TaskNode("t1", lambda deps: 7, [])], cancel_token=token
+    )
+    token.cancel()  # too late: every task already completed
+    assert res == {"t1": 7}
+
+
+def test_external_cancel_token_through_workflow_run():
+    import pytest
+
+    from fugue_tpu.exceptions import TaskCancelledError
+    from fugue_tpu.workflow.fault import CancelToken
+
+    token = CancelToken()
+    started = threading.Event()
+
+    def slow_creator() -> pd.DataFrame:
+        started.set()
+        time.sleep(0.2)
+        return pd.DataFrame({"x": [1]})
+
+    def never_runs(df: pd.DataFrame) -> pd.DataFrame:
+        raise AssertionError("downstream task ran after cancel")
+
+    dag = FugueWorkflow()
+    src = dag.create(slow_creator, schema="x:long")
+    src.transform(never_runs, schema="*").yield_dataframe_as("out")
+    e = make_execution_engine("native", {"fugue.workflow.concurrency": 2})
+    canceller = threading.Thread(
+        target=lambda: (started.wait(5), token.cancel())
+    )
+    canceller.start()
+    with pytest.raises(TaskCancelledError):
+        dag.run(e, cancel_token=token)
+    canceller.join()
