@@ -975,6 +975,74 @@ def _config6_serving_daemon() -> Dict[str, Any]:
             out["mean_ms"] = round(float(np.mean(latencies)), 2)
         out["jobs"] = status["jobs"]
         out["fault_stats"] = status["fault_stats"]
+    out["restart_recovery"] = _serving_restart_recovery(
+        clients, _scale(200_000), agg_sql
+    )
+    return out
+
+
+def _serving_restart_recovery(
+    tenants: int, rows: int, agg_sql: str
+) -> Dict[str, Any]:
+    """Restart-recovery scenario (ISSUE 7): a DURABLE daemon holding one
+    hot table per tenant is hard-killed mid-serving, then restarted on
+    the same state path. Reports time-to-healthy (journal load + session
+    rehydration, i.e. restart ``start()`` wall), the recovered session /
+    hot-table counts, and the lazy integrity-verified reload time of the
+    first post-restart query per tenant."""
+    import tempfile
+
+    import numpy as np
+    import pandas as pd
+
+    from fugue_tpu.serve import ServeClient, ServeDaemon
+
+    out: Dict[str, Any] = {"tenants": tenants, "rows_per_table": rows}
+    with tempfile.TemporaryDirectory() as state_dir:
+        conf = {
+            "fugue.serve.max_concurrent": tenants,
+            "fugue.serve.state_path": state_dir,
+        }
+        d1 = ServeDaemon(conf).start()
+        host, port = d1.address
+        rng = np.random.default_rng(7)
+        sids = []
+        for _ in range(tenants):
+            c = ServeClient(host, port, timeout=600)
+            sid = c.create_session()
+            pdf = pd.DataFrame(
+                {
+                    "k": rng.integers(0, 64, rows).astype(np.int64),
+                    "v": rng.random(rows),
+                }
+            )
+            d1.sessions.get(sid).save_table("t", d1.engine.to_df(pdf))
+            sids.append(sid)
+        d1._hard_kill()  # no drain, no final journal write
+
+        t0 = time.perf_counter()
+        d2 = ServeDaemon(conf).start()
+        out["time_to_healthy_secs"] = round(time.perf_counter() - t0, 4)
+        try:
+            c2 = ServeClient(host, d2.address[1], timeout=600)
+            st = c2.status()
+            out["recovered_sessions"] = st["recovery"]["sessions"]
+            # first query per tenant lazily reloads the fingerprint-
+            # verified artifact into the device catalog
+            t1 = time.perf_counter()
+            ok = 0
+            for sid in sids:
+                snap = c2.sql(sid, agg_sql)
+                if snap["status"] == "done" and "t" in c2.session(sid)[
+                    "tables"
+                ]:
+                    ok += 1
+            out["reload_all_tables_secs"] = round(
+                time.perf_counter() - t1, 4
+            )
+            out["recovered_hot_tables"] = ok
+        finally:
+            d2.stop()
     return out
 
 

@@ -14,7 +14,11 @@ from fugue_tpu.analysis.diagnostics import (
     Severity,
     register_rule,
 )
-from fugue_tpu.constants import declared_conf_keys
+from fugue_tpu.constants import (
+    FUGUE_CONF_SERVE_STATE_PATH,
+    FUGUE_CONF_WORKFLOW_RESUME,
+    declared_conf_keys,
+)
 from fugue_tpu.utils.params import _convert
 
 
@@ -57,3 +61,39 @@ class ConfValueTypeRule(Rule):
                     f"conf '{key}' = {value!r} is not convertible to the "
                     f"declared type {info.type.__name__} ({info.description})",
                 )
+
+
+@register_rule
+class DaemonResumeOffRule(Rule):
+    code = "FWF403"
+    severity = Severity.WARN
+    description = (
+        "daemon-targeted workflow runs with fugue.workflow.resume off: "
+        "an interrupted async job re-executes from scratch on failover"
+    )
+
+    def check(self, ctx: Any) -> Iterable[Diagnostic]:
+        # a durable serve state path in the effective conf marks this as
+        # a daemon-targeted run (the daemon's engine conf carries the
+        # fugue.serve.* keys it was configured with)
+        state_path = str(
+            ctx.conf.get(FUGUE_CONF_SERVE_STATE_PATH, "") or ""
+        ).strip()
+        if state_path == "":
+            return
+        try:
+            # _convert, not bool(): conf values legitimately arrive as
+            # strings, and bool("false") is True
+            resume = _convert(
+                ctx.conf.get(FUGUE_CONF_WORKFLOW_RESUME, False), bool
+            )
+        except Exception:
+            resume = False
+        if not resume:
+            yield self.diag(
+                "the daemon journals interrupted async jobs for restart "
+                "recovery, but fugue.workflow.resume is off: a resubmitted "
+                "job re-executes every task instead of resuming at its "
+                "checkpoint frontier — set fugue.workflow.resume=true (and "
+                "a fugue.workflow.checkpoint.path) for cheap failover",
+            )

@@ -57,6 +57,17 @@ FUGUE_CONF_SERVE_MAX_CONCURRENT = "fugue.serve.max_concurrent"
 FUGUE_CONF_SERVE_SESSION_TTL = "fugue.serve.session_ttl"
 FUGUE_CONF_SERVE_SYNC_WAIT = "fugue.serve.sync_wait"
 FUGUE_CONF_SERVE_TENANT_BUDGET_FRACTION = "fugue.serve.tenant_budget_fraction"
+FUGUE_CONF_SERVE_STATE_PATH = "fugue.serve.state_path"
+FUGUE_CONF_SERVE_DRAIN_TIMEOUT = "fugue.serve.drain_timeout"
+FUGUE_CONF_SERVE_MAX_QUEUE = "fugue.serve.max_queue"
+FUGUE_CONF_SERVE_SESSION_MAX_JOBS = "fugue.serve.session_max_jobs"
+FUGUE_CONF_SERVE_MEMORY_REJECT = "fugue.serve.memory_reject_fraction"
+FUGUE_CONF_SERVE_SYNC_DEGRADE_DEPTH = "fugue.serve.sync_degrade_depth"
+FUGUE_CONF_SERVE_BREAKER_THRESHOLD = "fugue.serve.breaker.threshold"
+FUGUE_CONF_SERVE_BREAKER_COOLDOWN = "fugue.serve.breaker.cooldown"
+FUGUE_CONF_SERVE_HEARTBEAT_TIMEOUT = "fugue.serve.heartbeat_timeout"
+FUGUE_CONF_SERVE_JOB_TTL = "fugue.serve.job_ttl"
+FUGUE_CONF_SERVE_CLIENT_RETRIES = "fugue.serve.client.retries"
 
 FUGUE_COMPILE_TIME_CONFIGS = {
     FUGUE_CONF_WORKFLOW_EXCEPTION_HIDE,
@@ -355,6 +366,108 @@ def _declare_defaults() -> None:
         float,
         0.0,
         "per-tenant fair share of the memory budget (0 = global LRU)",
+        in_defaults=False,
+    )
+    # serving resilience (serve/state.py, serve/supervisor.py): a durable
+    # state_path turns on the daemon's crash journal — the session
+    # registry, per-session saved-table catalog (parquet artifacts with
+    # sha256 fingerprints) and the async job journal are atomically
+    # rewritten through engine.fs on every mutation, so a restarted
+    # daemon rehydrates sessions, lazily reloads integrity-verified hot
+    # tables, and resumes interrupted async jobs
+    r(
+        FUGUE_CONF_SERVE_STATE_PATH,
+        str,
+        "",
+        "durable dir/URI for the daemon's state journal + hot-table "
+        "artifacts ('' = ephemeral daemon, no crash recovery)",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_SERVE_DRAIN_TIMEOUT,
+        float,
+        30.0,
+        "seconds in-flight jobs get to finish on stop(drain=True) before "
+        "their tokens are cancelled and they are abandoned",
+        in_defaults=False,
+    )
+    # backpressure & admission: overload answers 503/429 WITH Retry-After
+    # instead of queueing unboundedly or blocking HTTP handler threads
+    r(
+        FUGUE_CONF_SERVE_MAX_QUEUE,
+        int,
+        256,
+        "queued-job backlog over which new submissions get 503 + "
+        "Retry-After (0 = unbounded queue)",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_SERVE_SESSION_MAX_JOBS,
+        int,
+        0,
+        "per-session queued+running job cap; over it submissions get "
+        "429 + Retry-After (0 = uncapped)",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_SERVE_MEMORY_REJECT,
+        float,
+        0.0,
+        "device-tier fill fraction of the memory budget over which new "
+        "submissions get 503 (0 = no memory-pressure rejection)",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_SERVE_SYNC_DEGRADE_DEPTH,
+        int,
+        0,
+        "queued-job backlog at which sync submits degrade to async "
+        "202 + job-id instead of parking an HTTP worker (0 = never)",
+        in_defaults=False,
+    )
+    # engine supervisor: consecutive-failure circuit breakers per session
+    # and per query fingerprint (deterministic workflow uuid) quarantine
+    # poison queries with a structured error instead of burning retries;
+    # a tripped breaker half-opens after the cooldown to probe recovery
+    r(
+        FUGUE_CONF_SERVE_BREAKER_THRESHOLD,
+        int,
+        5,
+        "consecutive job failures that trip a session/query circuit "
+        "breaker (0 = breakers off)",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_SERVE_BREAKER_COOLDOWN,
+        float,
+        30.0,
+        "seconds a tripped breaker stays open before half-opening for "
+        "one probe submission",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_SERVE_HEARTBEAT_TIMEOUT,
+        float,
+        0.0,
+        "seconds without a heartbeat before the supervisor cancels a "
+        "running job as wedged (0 = runner timeouts only)",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_SERVE_JOB_TTL,
+        float,
+        600.0,
+        "seconds a finished job keeps its result payload before TTL "
+        "eviction drops it (status survives; 0 = keep until the record "
+        "cap evicts the job)",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_SERVE_CLIENT_RETRIES,
+        int,
+        2,
+        "ServeClient retries on transient transport failures and "
+        "503/429 backpressure answers (honors server Retry-After)",
         in_defaults=False,
     )
 

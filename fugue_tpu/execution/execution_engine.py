@@ -218,6 +218,33 @@ class ExecutionEngine(FugueEngineBase):
         if should_stop:
             self.stop()
 
+    def retain(self) -> "ExecutionEngine":
+        """Hold the engine alive across context exits WITHOUT becoming
+        the ambient context engine. Unlike ``as_context`` this is
+        thread-agnostic: ``as_context``'s ContextVar token stack is
+        per-thread, so a ``stop_context`` from a different thread (a
+        drain thread, a signal handler) would decrement the count but
+        leave the starting thread's ambient engine pointing at a stopped
+        engine. Long-lived owners that never want ambient resolution —
+        the serving daemon — pair ``retain()`` with ``release()``."""
+        with self._ctx_lock:
+            self._in_context_count += 1
+        self.on_enter_context()
+        return self
+
+    def release(self) -> None:
+        """Drop a ``retain`` hold; stops the engine when the last
+        context/hold is gone (and it is not the global engine). Safe
+        from any thread."""
+        with self._ctx_lock:
+            if self._in_context_count == 0:
+                return
+            self._in_context_count -= 1
+            should_stop = self._in_context_count == 0 and not self._is_global
+        self.on_exit_context()
+        if should_stop:
+            self.stop()
+
     def set_global(self) -> "ExecutionEngine":
         with _GLOBAL_LOCK:
             old = _GLOBAL_ENGINE[0]

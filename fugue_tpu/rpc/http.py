@@ -63,9 +63,44 @@ _CONF_READ_TIMEOUT = "fugue.rpc.http_server.read_timeout"
 _DEFAULT_MAX_BODY = 64 * 1024 * 1024
 _DEFAULT_READ_TIMEOUT = 30.0
 
-# HTTP statuses that mark a transient server condition worth retrying;
-# everything else (404, 500 handler bugs, ...) is deterministic
-_RETRYABLE_HTTP = (503,)
+# HTTP statuses that mark a transient server condition worth retrying
+# (503 overload/drain, 429 per-tenant caps — both are the serving
+# daemon's backpressure vocabulary); everything else (404, 500 handler
+# bugs, ...) is deterministic
+_RETRYABLE_HTTP = (503, 429)
+
+# an absurd Retry-After from a confused server must not park a client
+# thread for minutes — cap what we are willing to honor
+_MAX_RETRY_AFTER = 10.0
+
+
+def parse_retry_after(headers: Any) -> Optional[float]:
+    """Seconds from a ``Retry-After`` header (delta-seconds form only —
+    the HTTP-date form is overkill for this control plane), capped at
+    ``_MAX_RETRY_AFTER``; None when absent/unparseable. Shared by the
+    RPC client below and :class:`fugue_tpu.serve.client.ServeClient`."""
+    try:
+        raw = headers.get("Retry-After") if headers is not None else None
+        if raw is None:
+            return None
+        return min(max(0.0, float(raw)), _MAX_RETRY_AFTER)
+    except (TypeError, ValueError):
+        return None
+
+
+def backoff_delay(
+    attempt: int, rng: Any, server_hint: Optional[float] = None
+) -> float:
+    """Bounded-exponential retry delay shared by the RPC client and
+    :class:`fugue_tpu.serve.client.ServeClient`: 50ms doubling with 10%
+    jitter, capped at 2s, then floored at the server's (already capped)
+    ``Retry-After`` hint — one backoff policy, not two drifting copies."""
+    delay = min(
+        0.05 * (2 ** (attempt - 1)) * (1.0 + rng.random() * 0.1), 2.0
+    )
+    if server_hint is not None:
+        delay = max(delay, server_hint)
+    return delay
 
 
 def _is_transient_transport_error(ex: BaseException) -> bool:
@@ -211,12 +246,20 @@ class HTTPRPCClient(RPCClient):
                     ex
                 ):
                     raise
-                delay = 0.05 * (2 ** (attempt - 1)) * (1.0 + rng.random() * 0.1)
+                # a backpressure answer names its own backoff: honor the
+                # server's Retry-After over our schedule
+                delay = backoff_delay(
+                    attempt,
+                    rng,
+                    parse_retry_after(ex.headers)
+                    if isinstance(ex, urllib.error.HTTPError)
+                    else None,
+                )
                 _LOG.info(
                     "fugue_tpu rpc retry %d/%d after %s: %s",
                     attempt, self._retries, type(ex).__name__, ex,
                 )
-                time.sleep(min(delay, 2.0))
+                time.sleep(delay)
 
     def _call_once(self, body: bytes) -> Any:
         req = urllib.request.Request(

@@ -7,6 +7,14 @@ the SQLEngine table catalog (device-resident for the jax engine), the
 workflow runner's timeout/cancellation machinery, and the memory
 governor's per-tenant fair-spill accounting.
 
+The resilience plane (ISSUE 7) makes the daemon production-shaped:
+durable crash-journaled state (:mod:`~fugue_tpu.serve.state`) with
+restart rehydration of sessions/hot tables/async jobs, graceful drain
+with 503 + ``Retry-After``, queue-depth/memory-pressure/per-session
+admission control, circuit breakers + heartbeat supervision
+(:mod:`~fugue_tpu.serve.supervisor`), client transient retry, and a
+serve-plane chaos harness (see README "Serving resilience").
+
 Quick start::
 
     from fugue_tpu.serve import ServeClient, ServeDaemon
@@ -23,11 +31,29 @@ from fugue_tpu.serve.client import ServeAPIError, ServeClient
 from fugue_tpu.serve.daemon import ServeDaemon
 from fugue_tpu.serve.scheduler import JobScheduler, ServeJob
 from fugue_tpu.serve.session import ServeSession, SessionManager
+from fugue_tpu.serve.state import ServeStateJournal
+from fugue_tpu.serve.supervisor import (
+    AdmissionError,
+    BackpressureError,
+    CircuitBreaker,
+    CircuitOpenError,
+    EngineSupervisor,
+    PoisonQueryError,
+    SessionBusyError,
+)
 
 __all__ = [
+    "AdmissionError",
+    "BackpressureError",
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "EngineSupervisor",
+    "PoisonQueryError",
     "ServeAPIError",
     "ServeClient",
     "ServeDaemon",
+    "ServeStateJournal",
+    "SessionBusyError",
     "JobScheduler",
     "ServeJob",
     "ServeSession",

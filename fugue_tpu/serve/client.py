@@ -1,19 +1,52 @@
 """Minimal stdlib JSON client for the serving daemon's HTTP API — what
 the integration tests and the sustained-throughput bench drive; the same
-flow works from ``curl`` (see README "Serving")."""
+flow works from ``curl`` (see README "Serving").
+
+**Transient retry** (ISSUE 7): every call retries bounded-exponential on
+transient transport failures — connection refused/reset while a daemon
+restarts, and the daemon's own 503/429 backpressure answers — reusing
+the workflow fault classifier's triage through
+:func:`fugue_tpu.rpc.http._is_transient_transport_error` and honoring
+the server's ``Retry-After`` header over the local backoff schedule.
+Deterministic failures (404s, structured job errors, 400s) fail fast.
+The budget comes from ``fugue.serve.client.retries`` (the registered
+default; per-client override via the ``retries`` argument). Retries are
+at-least-once: a connection that dies after the request was sent may
+replay a submission — the daemon's saves are overwrite-mode idempotent,
+but set ``retries=0`` for flows where a duplicate submit is worse than
+a failed call.
+"""
 
 import json
+import random
+import time
 import urllib.error
 import urllib.request
 from typing import Any, Dict, Optional
 
+from fugue_tpu.constants import FUGUE_CONF_SERVE_CLIENT_RETRIES, conf_default
+from fugue_tpu.rpc.http import (
+    _is_transient_transport_error,
+    backoff_delay,
+    parse_retry_after,
+)
+
 
 class ServeAPIError(RuntimeError):
-    """A structured error answer from the daemon."""
+    """A structured error answer from the daemon. ``retry_after`` is the
+    server's backoff hint on 503/429 backpressure rejections (None on
+    deterministic errors) — the fault classifier treats an exception
+    carrying ``retry_after`` as TRANSIENT."""
 
-    def __init__(self, status: int, error: Dict[str, Any]):
+    def __init__(
+        self,
+        status: int,
+        error: Dict[str, Any],
+        retry_after: Optional[float] = None,
+    ):
         self.status = status
         self.error = dict(error or {})
+        self.retry_after = retry_after
         super().__init__(
             f"HTTP {status}: {self.error.get('error')}: "
             f"{self.error.get('message')}"
@@ -21,11 +54,52 @@ class ServeAPIError(RuntimeError):
 
 
 class ServeClient:
-    def __init__(self, host: str, port: int, timeout: float = 120.0):
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        timeout: float = 120.0,
+        retries: Optional[int] = None,
+    ):
         self._base = f"http://{host}:{port}"
         self._timeout = timeout
+        self._retries = max(
+            0,
+            int(
+                conf_default(FUGUE_CONF_SERVE_CLIENT_RETRIES)
+                if retries is None
+                else retries
+            ),
+        )
 
     def _call(
+        self,
+        method: str,
+        path: str,
+        payload: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        rng = random.Random()
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                return self._call_once(method, path, payload)
+            except Exception as ex:
+                transient = (
+                    ex.status in (503, 429)
+                    if isinstance(ex, ServeAPIError)
+                    else _is_transient_transport_error(ex)
+                )
+                if attempt > self._retries or not transient:
+                    raise
+                # retry_after is already parse_retry_after-capped
+                time.sleep(
+                    backoff_delay(
+                        attempt, rng, getattr(ex, "retry_after", None)
+                    )
+                )
+
+    def _call_once(
         self,
         method: str,
         path: str,
@@ -49,7 +123,9 @@ class ServeClient:
             except Exception:
                 body = {}
             raise ServeAPIError(
-                ex.code, body.get("error") or {"error": str(ex)}
+                ex.code,
+                body.get("error") or {"error": str(ex)},
+                retry_after=parse_retry_after(ex.headers),
             ) from None
 
     # ---- sessions --------------------------------------------------------
@@ -75,7 +151,10 @@ class ServeClient:
     ) -> Dict[str, Any]:
         """Synchronous submit: returns the finished job snapshot (its
         ``result`` carries columns/rows when the script ends in a
-        dataframe and ``collect`` is on)."""
+        dataframe and ``collect`` is on). Under deep queues the daemon
+        may degrade the submit to async (202 + ``degraded_to_async``):
+        this helper then polls the job to completion, so callers keep
+        sync semantics either way."""
         payload: Dict[str, Any] = {
             "sql": sql,
             "mode": "sync",
@@ -85,7 +164,12 @@ class ServeClient:
         }
         if save_as is not None:
             payload["save_as"] = save_as
-        return self._call("POST", f"/v1/sessions/{session_id}/sql", payload)
+        snap = self._call(
+            "POST", f"/v1/sessions/{session_id}/sql", payload
+        )
+        if snap.get("degraded_to_async"):
+            return self.wait(snap["job_id"])
+        return snap
 
     def submit_async(
         self,
@@ -117,8 +201,6 @@ class ServeClient:
 
     def wait(self, job_id: str, poll: float = 0.05) -> Dict[str, Any]:
         """Poll an async job until it finishes; returns the snapshot."""
-        import time
-
         while True:
             snap = self.job(job_id)
             if snap["status"] in ("done", "error", "cancelled"):

@@ -17,6 +17,28 @@ server (ROADMAP open item #2):
 - :class:`~fugue_tpu.serve.http.ServeHTTPServer` exposing the JSON API
   below on the hardened HTTP layer.
 
+Resilience plane (ISSUE 7):
+
+- **durable state** — with ``fugue.serve.state_path`` set, sessions,
+  hot-table fingerprints and async jobs journal through
+  :class:`~fugue_tpu.serve.state.ServeStateJournal`; a restarted daemon
+  rehydrates sessions, lazily reloads integrity-verified hot tables and
+  resubmits interrupted async jobs under their original ids;
+- **graceful drain** — ``stop(drain=True)`` (or SIGTERM via
+  :meth:`install_signal_handlers`) flips healthy→draining: new
+  submissions answer 503 + ``Retry-After`` while in-flight jobs run to
+  the ``fugue.serve.drain_timeout`` deadline, then state is journaled
+  and the engine context closes;
+- **backpressure** — queue-depth (``fugue.serve.max_queue``),
+  memory-pressure (``fugue.serve.memory_reject_fraction`` over the HBM
+  ledger) and per-session caps (``fugue.serve.session_max_jobs``)
+  answer 503/429 + ``Retry-After``; deep-queue sync submits degrade to
+  async 202 + job-id (``fugue.serve.sync_degrade_depth``);
+- **supervision** — per-job heartbeats with a wedged-job watchdog, and
+  consecutive-failure circuit breakers per session and per query
+  fingerprint (deterministic workflow uuid) that quarantine poison
+  queries with a structured error.
+
 HTTP API (all JSON; errors are structured payloads, never tracebacks)::
 
     POST   /v1/sessions                     {"ttl": seconds?}
@@ -29,33 +51,63 @@ HTTP API (all JSON; errors are structured payloads, never tracebacks)::
                                              "limit"?: rows}
     GET    /v1/jobs/<jid>                   poll an async submission
     POST   /v1/jobs/<jid>/cancel
-    GET    /v1/status                       memory_stats, fault totals,
-                                            fallback counters, sessions, jobs
-    GET    /v1/health
+    GET    /v1/status                       health, memory_stats, breakers,
+                                            backpressure, recovery, jobs
+    GET    /v1/health                       200 healthy / 503 draining
 """
 
+import signal
 import threading
 import time
 from contextlib import nullcontext
 from typing import Any, Dict, Optional, Tuple
 
 from fugue_tpu.constants import (
+    FUGUE_CONF_SERVE_BREAKER_COOLDOWN,
+    FUGUE_CONF_SERVE_BREAKER_THRESHOLD,
+    FUGUE_CONF_SERVE_DRAIN_TIMEOUT,
+    FUGUE_CONF_SERVE_HEARTBEAT_TIMEOUT,
     FUGUE_CONF_SERVE_HOST,
+    FUGUE_CONF_SERVE_JOB_TTL,
     FUGUE_CONF_SERVE_MAX_CONCURRENT,
+    FUGUE_CONF_SERVE_MAX_QUEUE,
+    FUGUE_CONF_SERVE_MEMORY_REJECT,
     FUGUE_CONF_SERVE_PORT,
+    FUGUE_CONF_SERVE_SESSION_MAX_JOBS,
     FUGUE_CONF_SERVE_SESSION_TTL,
+    FUGUE_CONF_SERVE_STATE_PATH,
+    FUGUE_CONF_SERVE_SYNC_DEGRADE_DEPTH,
     FUGUE_CONF_SERVE_SYNC_WAIT,
     typed_conf_get,
 )
 from fugue_tpu.execution.factory import make_execution_engine
 from fugue_tpu.rpc.http import structured_error
 from fugue_tpu.serve.http import ServeHTTPServer
-from fugue_tpu.serve.scheduler import JobScheduler, ServeJob
+from fugue_tpu.serve.scheduler import (
+    CANCELLED,
+    ERROR,
+    JobScheduler,
+    ServeJob,
+)
 from fugue_tpu.serve.session import ServeSession, SessionManager
+from fugue_tpu.serve.state import make_journal
+from fugue_tpu.serve.supervisor import (
+    AdmissionError,
+    BackpressureError,
+    EngineSupervisor,
+    HealthState,
+    SessionBusyError,
+    STOPPED,
+)
 from fugue_tpu.sql_frontend.workflow_sql import FugueSQLWorkflow
+from fugue_tpu.testing.faults import fault_point
 from fugue_tpu.utils.params import ParamDict
 
 _RESULT_YIELD = "serve_result"
+
+# breaker accounting must not count a breaker's own rejections as fresh
+# failures (that would extend a quarantine every time someone probes it)
+_BREAKER_ERRORS = ("PoisonQueryError", "CircuitOpenError")
 
 
 class ServeDaemon:
@@ -65,13 +117,28 @@ class ServeDaemon:
     def __init__(self, conf: Any = None, engine: Any = "jax"):
         self._engine = make_execution_engine(engine, ParamDict(conf))
         econf = self._engine.conf
+        self._journal = make_journal(
+            self._engine, typed_conf_get(econf, FUGUE_CONF_SERVE_STATE_PATH)
+        )
+        self._health = HealthState()
+        self._supervisor = EngineSupervisor(
+            typed_conf_get(econf, FUGUE_CONF_SERVE_BREAKER_THRESHOLD),
+            typed_conf_get(econf, FUGUE_CONF_SERVE_BREAKER_COOLDOWN),
+            heartbeat_timeout=typed_conf_get(
+                econf, FUGUE_CONF_SERVE_HEARTBEAT_TIMEOUT
+            ),
+            log=self._engine.log,
+        )
         self._sessions = SessionManager(
             self._engine,
             default_ttl=typed_conf_get(econf, FUGUE_CONF_SERVE_SESSION_TTL),
+            journal=self._journal,
         )
         self._scheduler = JobScheduler(
             self._execute_job,
             typed_conf_get(econf, FUGUE_CONF_SERVE_MAX_CONCURRENT),
+            job_ttl=typed_conf_get(econf, FUGUE_CONF_SERVE_JOB_TTL),
+            on_finish=self._job_finished,
         )
         http_conf = ParamDict(econf)
         http_conf["fugue.rpc.http_server.host"] = typed_conf_get(
@@ -82,6 +149,19 @@ class ServeDaemon:
         )
         self._http = ServeHTTPServer(self, http_conf)
         self._sync_wait = typed_conf_get(econf, FUGUE_CONF_SERVE_SYNC_WAIT)
+        self._drain_timeout = typed_conf_get(
+            econf, FUGUE_CONF_SERVE_DRAIN_TIMEOUT
+        )
+        self._max_queue = typed_conf_get(econf, FUGUE_CONF_SERVE_MAX_QUEUE)
+        self._session_max_jobs = typed_conf_get(
+            econf, FUGUE_CONF_SERVE_SESSION_MAX_JOBS
+        )
+        self._memory_reject = typed_conf_get(
+            econf, FUGUE_CONF_SERVE_MEMORY_REJECT
+        )
+        self._sync_degrade_depth = typed_conf_get(
+            econf, FUGUE_CONF_SERVE_SYNC_DEGRADE_DEPTH
+        )
         self._started = False
         self._started_at: Optional[float] = None
         self._stats_lock = threading.Lock()
@@ -93,6 +173,20 @@ class ServeDaemon:
             "integrity_rejected": 0,
             "resumed": 0,
         }
+        self._reject_totals: Dict[str, int] = {
+            "draining": 0,
+            "queue_full": 0,
+            "memory_pressure": 0,
+            "session_cap": 0,
+            "breaker_open": 0,
+            "sync_degraded": 0,
+        }
+        self._recovery: Dict[str, int] = {
+            "sessions": 0,
+            "jobs_resubmitted": 0,
+            "jobs_failed_over": 0,
+        }
+        self._drain_result: Optional[Dict[str, int]] = None
 
     # ---- lifecycle -------------------------------------------------------
     @property
@@ -108,6 +202,18 @@ class ServeDaemon:
         return self._scheduler
 
     @property
+    def supervisor(self) -> EngineSupervisor:
+        return self._supervisor
+
+    @property
+    def journal(self) -> Any:
+        return self._journal
+
+    @property
+    def health_state(self) -> str:
+        return self._health.state
+
+    @property
     def address(self) -> Tuple[str, int]:
         """(host, port) of the bound HTTP API (after ``start``)."""
         return self._http.address
@@ -115,29 +221,130 @@ class ServeDaemon:
     def start(self) -> "ServeDaemon":
         if self._started:
             return self
-        # hold ONE engine context for the daemon's lifetime: concurrent
-        # job runs push/pop their own per-thread contexts on top and the
-        # count never reaches zero, so the engine stays hot between
-        # requests instead of stopping after each run
-        self._engine.as_context()
+        # hold the engine for the daemon's lifetime: concurrent job runs
+        # push/pop their own per-thread contexts on top and the count
+        # never reaches zero, so the engine stays hot between requests.
+        # retain (not as_context): the hold must release cleanly from a
+        # drain thread or signal handler, and the daemon's engine must
+        # never become the caller thread's ambient context engine
+        self._engine.retain()
         self._scheduler.start()
+        if self._journal is not None:
+            self._recover()
+        self._supervisor.tick_hooks = [
+            self._sessions.sweep,
+            self._scheduler.gc_payloads,
+        ]
+        if self._journal is not None:
+            self._supervisor.tick_hooks.append(self._journal.maybe_flush)
+        self._supervisor.start(
+            self._scheduler.running_jobs, abandon=self._scheduler.abandon
+        )
         self._http.start()
         self._started = True
         self._started_at = time.time()
         return self
 
-    def stop(self) -> None:
-        """Stop serving: HTTP down first (no new requests), then the
-        scheduler (cancels queued/running jobs), then the sessions (drops
-        their tables), then the daemon's engine context — which stops the
-        engine, including one the caller passed in."""
+    def _recover(self) -> None:
+        """Rehydrate the prior daemon's journaled state: sessions come
+        back (tables reload lazily on first access), interrupted async
+        jobs resubmit under their original ids, and jobs whose session
+        did not survive fail over with a structured error a poller can
+        read."""
+        data = self._journal.load()
+        self._recovery["sessions"] = self._sessions.restore(
+            data.get("sessions") or {}
+        )
+        for jid, rec in sorted((data.get("jobs") or {}).items()):
+            job = ServeJob(
+                rec.get("session_id", ""),
+                rec.get("sql", ""),
+                save_as=rec.get("save_as"),
+                timeout=float(rec.get("timeout", 0.0) or 0.0),
+                collect=bool(rec.get("collect", True)),
+                limit=int(rec.get("limit", 10_000)),
+                job_id=jid,
+            )
+            job.recovered = True
+            try:
+                self._sessions.get(job.session_id)
+                self._scheduler.submit(job)
+                self._recovery["jobs_resubmitted"] += 1
+            except Exception as ex:
+                job.error = structured_error(
+                    KeyError(
+                        f"session {job.session_id} did not survive the "
+                        f"daemon restart ({type(ex).__name__}); the job "
+                        "cannot be resumed"
+                    )
+                )
+                job.finish(ERROR)
+                self._scheduler.adopt(job)
+                self._journal.finish_job(jid)
+                self._recovery["jobs_failed_over"] += 1
+
+    def stop(self, drain: bool = False) -> None:
+        """Stop serving. ``drain=False`` (default) keeps PR 6 semantics:
+        HTTP down first, scheduler cancelled, sessions closed, engine
+        context stopped. ``drain=True`` is the graceful path: the health
+        state flips to *draining* (new submissions answer 503 +
+        Retry-After while polling keeps working), in-flight jobs get
+        ``fugue.serve.drain_timeout`` seconds to finish, stragglers are
+        cancelled and abandoned, and the final state is journaled BEFORE
+        the engine context closes."""
+        if not self._started:
+            return
+        if drain:
+            self._health.start_drain(self._drain_timeout)
+            self._drain_result = self._scheduler.drain(self._drain_timeout)
+        self._started = False
+        self._supervisor.stop()
+        self._http.stop()
+        self._scheduler.stop()
+        if self._journal is not None:
+            # journaled daemon: keep durable state for the next start;
+            # write the final snapshot before the engine dies
+            self._sessions.shutdown()
+            self._journal.write()
+        else:
+            self._sessions.close_all()
+        self._engine.release()
+        self._health.transition(STOPPED)
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT → graceful drain (``stop(drain=True)`` on a
+        helper thread, so the signal handler returns immediately). Call
+        from the main thread of a dedicated serve process; in-process
+        embeddings (tests, benches) should call ``stop`` directly."""
+
+        def _drain_on_signal(signum: int, frame: Any) -> None:
+            threading.Thread(
+                target=self.stop, kwargs={"drain": True}, daemon=True,
+                name="fugue-serve-drain",
+            ).start()
+
+        signal.signal(signal.SIGTERM, _drain_on_signal)
+        signal.signal(signal.SIGINT, _drain_on_signal)
+
+    def _hard_kill(self) -> None:
+        """Chaos/test hook: the closest an in-process harness gets to
+        ``kill -9`` mid-flight. No drain, no final journal write (the
+        journal is incrementally crash-durable by construction), workers
+        killed via sentinels, catalog copies dropped (device state dies
+        with the process), engine context closed."""
         if not self._started:
             return
         self._started = False
+        # scheduler FIRST: its first act is dropping the finish
+        # observers, so a job completing while the rest of the teardown
+        # runs can no longer clean its journal entry — a real kill -9
+        # would not have run those callbacks either
+        self._scheduler.kill()
+        self._supervisor.stop()
         self._http.stop()
-        self._scheduler.stop()
-        self._sessions.close_all()
-        self._engine.stop_context()
+        self._sessions.shutdown()  # drops catalog copies, keeps journal
+        self._engine.release()
+        self._health.transition(STOPPED)
 
     def __enter__(self) -> "ServeDaemon":
         return self.start()
@@ -147,11 +354,75 @@ class ServeDaemon:
 
     # ---- operations (HTTP routes call these; tests/benches may too) ------
     def create_session(self, ttl: Optional[float] = None) -> ServeSession:
+        if not self._health.healthy:
+            self._count_reject("draining")
+            raise BackpressureError(
+                f"daemon is {self._health.state}; not accepting sessions",
+                retry_after=max(1.0, self._health.drain_remaining()),
+            )
         return self._sessions.create(ttl=ttl)
 
     def close_session(self, session_id: str) -> Dict[str, Any]:
         dropped = self._sessions.close(session_id)
         return {"closed": session_id, "dropped_tables": dropped}
+
+    def memory_pressure(self) -> float:
+        """Device-tier fill fraction of the governed budget (0.0 when
+        ungoverned) — the admission controller's memory signal, read
+        from the PR 4 ledger snapshot."""
+        mem = getattr(self._engine, "memory_stats", None)
+        if not isinstance(mem, dict) or not mem.get("enabled"):
+            return 0.0
+        budget = mem.get("budget_bytes") or 0
+        if budget <= 0:
+            return 0.0
+        return float((mem.get("tiers") or {}).get("device", 0)) / budget
+
+    def _count_reject(self, kind: str) -> None:
+        with self._stats_lock:
+            self._reject_totals[kind] = self._reject_totals.get(kind, 0) + 1
+
+    def _admit(self, session_id: str) -> None:
+        """Admission control for one submission; raises an
+        :class:`AdmissionError` subtype (503/429 + Retry-After) when the
+        daemon must shed load instead of queueing it."""
+        if not self._health.healthy:
+            self._count_reject("draining")
+            raise BackpressureError(
+                f"daemon is {self._health.state}; not accepting submissions",
+                retry_after=max(1.0, self._health.drain_remaining()),
+            )
+        if self._max_queue > 0 and self._scheduler.backlog() >= self._max_queue:
+            self._count_reject("queue_full")
+            raise BackpressureError(
+                f"job queue is full ({self._max_queue} queued)",
+                retry_after=1.0,
+            )
+        if self._memory_reject > 0:
+            pressure = self.memory_pressure()
+            if pressure >= self._memory_reject:
+                self._count_reject("memory_pressure")
+                raise BackpressureError(
+                    f"device memory pressure {pressure:.2f} is over the "
+                    f"admission threshold {self._memory_reject:.2f}",
+                    retry_after=2.0,
+                )
+        if (
+            self._session_max_jobs > 0
+            and self._scheduler.active_count(session_id)
+            >= self._session_max_jobs
+        ):
+            self._count_reject("session_cap")
+            raise SessionBusyError(
+                f"session {session_id} already has "
+                f"{self._session_max_jobs} jobs queued/running",
+                retry_after=1.0,
+            )
+        try:
+            self._supervisor.admit_session(session_id)
+        except AdmissionError:
+            self._count_reject("breaker_open")
+            raise
 
     def submit(
         self,
@@ -164,6 +435,7 @@ class ServeDaemon:
         limit: int = 10_000,
     ) -> ServeJob:
         self._sessions.get(session_id)  # 404 early + touches the session
+        self._admit(session_id)
         job = ServeJob(
             session_id,
             sql,
@@ -172,7 +444,18 @@ class ServeDaemon:
             collect=collect,
             limit=limit,
         )
-        self._scheduler.submit(job)
+        if not wait and self._journal is not None:
+            # journal BEFORE the queue: a crash between accept and
+            # dispatch still resumes the job on restart
+            self._journal.record_job(job)
+        try:
+            self._scheduler.submit(job)
+        except Exception:
+            if not wait and self._journal is not None:
+                self._journal.finish_job(job.job_id)
+            # _admit may have claimed a half-open probe slot: release it
+            self._supervisor.note_cancelled(session_id, None)
+            raise
         if wait:
             # bounded: a wedged job must not pin the caller (an HTTP
             # handler thread) forever — on expiry the live snapshot goes
@@ -197,27 +480,71 @@ class ServeDaemon:
             engine_stats["fallbacks"] = fallbacks
         with self._stats_lock:
             fault_totals = dict(self._fault_totals)
-        return {
+            reject_totals = dict(self._reject_totals)
+        fault_totals["integrity_rejected"] += (
+            self._sessions.integrity_rejected()
+        )
+        counts = self._scheduler.counts()
+        health = self._health.describe()
+        if self._health.state != "healthy":
+            health["jobs_in_flight"] = counts["queued"] + counts["running"]
+            if self._drain_result is not None:
+                health["drain_result"] = dict(self._drain_result)
+        out: Dict[str, Any] = {
             "uptime_seconds": (
                 round(time.time() - self._started_at, 3)
                 if self._started_at is not None
                 else 0.0
             ),
+            "health": health,
             "engine": engine_stats,
             "sessions": {
                 "count": self._sessions.count(),
                 "active": self._sessions.describe(),
             },
-            "jobs": self._scheduler.counts(),
+            "jobs": counts,
             "fault_stats": fault_totals,
+            "backpressure": {
+                "queue_depth": self._scheduler.backlog(),
+                "max_queue": self._max_queue,
+                "memory_pressure": round(self.memory_pressure(), 4),
+                "rejections": reject_totals,
+            },
+            "supervisor": {
+                "breakers": self._supervisor.breaker_stats(),
+                "wedged_jobs_cancelled": self._supervisor.wedged_jobs,
+                "heartbeat_timeout": self._supervisor.heartbeat_timeout,
+            },
         }
+        if self._journal is not None:
+            out["durable"] = self._journal.describe()
+            out["recovery"] = dict(self._recovery)
+        return out
 
     # ---- job execution (scheduler worker threads) ------------------------
     def _execute_job(self, job: ServeJob) -> Dict[str, Any]:
+        job.beat()
         session = self._sessions.get(job.session_id)
         dag = FugueSQLWorkflow()
         sources = session.table_frames()
-        dag._sql(job.sql, {}, **sources)
+        try:
+            dag._sql(job.sql, {}, **sources)
+        except Exception:
+            # the query never compiled, so there is no DAG uuid to key
+            # the breaker on — fall back to a deterministic text hash so
+            # repeat-submitting a compile-poison query still quarantines
+            from fugue_tpu.utils.hash import to_uuid
+
+            job.fingerprint = to_uuid(
+                "serve.compile", sorted(sources), job.sql
+            )
+            self._supervisor.admit_query(job.fingerprint)
+            raise
+        # the DAG's deterministic uuid (built from task uuids) is the
+        # breaker's query fingerprint: same query over the same session
+        # tables -> same key, across submissions and daemon restarts
+        job.fingerprint = dag.__uuid__()
+        self._supervisor.admit_query(job.fingerprint)
         has_result = dag.last_df is not None
         if has_result:
             dag.last_df.yield_dataframe_as(_RESULT_YIELD)
@@ -235,6 +562,7 @@ class ServeDaemon:
         )
         with scope:
             wres = dag.run(self._engine, cancel_token=job.token)
+            job.beat()
             self._note_fault_stats(wres.fault_stats)
             payload: Dict[str, Any] = {
                 "yields": sorted(
@@ -246,6 +574,7 @@ class ServeDaemon:
             df = wres[_RESULT_YIELD]
             if job.save_as is not None:
                 session.save_table(job.save_as, df)
+                job.beat()
                 payload["saved_as"] = job.save_as
             if job.collect:
                 from fugue_tpu.workflow.fault import engine_dispatch_guard
@@ -255,6 +584,7 @@ class ServeDaemon:
                 # token makes the wait cancellable
                 with engine_dispatch_guard(self._engine, job.token):
                     local = df.head(job.limit + 1)
+                job.beat()
                 rows = local.as_array(type_safe=True)
                 truncated = len(rows) > job.limit
                 payload["result"] = {
@@ -266,6 +596,29 @@ class ServeDaemon:
                 }
         session.touch()
         return payload
+
+    def _job_finished(self, job: ServeJob) -> None:
+        """Scheduler ``on_finish`` observer: job-journal cleanup and
+        breaker accounting (cancellations are neutral; a breaker's own
+        rejection never counts as a fresh failure)."""
+        if self._journal is not None:
+            self._journal.finish_job(job.job_id)
+        if job.status == CANCELLED:
+            # verdict-free for the breakers — but the job may have held
+            # a half-open probe slot, which must go back
+            self._supervisor.note_cancelled(job.session_id, job.fingerprint)
+            return
+        err_type = (job.error or {}).get("error")
+        if err_type in _BREAKER_ERRORS:
+            # a breaker's own rejection is verdict-free — but the
+            # submit-time session admission may still hold a half-open
+            # probe slot, which must go back (the query-fingerprint
+            # breaker refused, so it claimed nothing)
+            self._supervisor.note_cancelled(job.session_id, None)
+            return
+        self._supervisor.note_result(
+            job.session_id, job.fingerprint, failed=job.status == ERROR
+        )
 
     def _note_fault_stats(self, stats: Dict[str, Any]) -> None:
         with self._stats_lock:
@@ -282,28 +635,46 @@ class ServeDaemon:
     # ---- HTTP routing ----------------------------------------------------
     def handle_api(
         self, method: str, path: str, payload: Dict[str, Any]
-    ) -> Tuple[int, Dict[str, Any]]:
-        """Route one API request; returns (status, JSON-safe response).
-        Never raises: handler failures become structured error payloads
-        (KeyError -> 404, bad input -> 400, the rest -> 500)."""
+    ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
+        """Route one API request; returns (status, JSON-safe response,
+        extra headers). Never raises: handler failures become structured
+        error payloads (KeyError -> 404, admission/backpressure -> the
+        error's own status + Retry-After header, bad input -> 400, the
+        rest -> 500)."""
         try:
-            return self._route(method, path, payload)
+            fault_point("serve.http", f"{method} {path}")
+            out = self._route(method, path, payload)
+            if len(out) == 2:
+                status, resp = out  # type: ignore[misc]
+                return status, resp, {}
+            return out  # type: ignore[return-value]
         except KeyError as ex:
-            return 404, {"error": structured_error(ex)}
+            return 404, {"error": structured_error(ex)}, {}
+        except AdmissionError as ex:
+            return (
+                ex.status,
+                {
+                    "error": structured_error(ex),
+                    "retry_after": ex.retry_after,
+                },
+                {"Retry-After": str(max(1, int(round(ex.retry_after or 1))))},
+            )
         except (ValueError, TypeError) as ex:
-            return 400, {"error": structured_error(ex)}
+            return 400, {"error": structured_error(ex)}, {}
         except Exception as ex:  # pragma: no cover - defensive
-            return 500, {"error": structured_error(ex)}
+            return 500, {"error": structured_error(ex)}, {}
 
     def _route(
         self, method: str, path: str, payload: Dict[str, Any]
-    ) -> Tuple[int, Dict[str, Any]]:
+    ) -> Any:
         parts = [p for p in path.split("?", 1)[0].split("/") if p]
         if not parts or parts[0] != "v1":
             raise KeyError(f"unknown path {path}")
         route = parts[1:]
         if route == ["health"] and method == "GET":
-            return 200, {"ok": True}
+            ok = self._health.healthy
+            body = {"ok": ok, "state": self._health.state}
+            return (200 if ok else 503), body
         if route == ["status"] and method == "GET":
             return 200, self.status()
         if route == ["sessions"]:
@@ -350,6 +721,17 @@ class ServeDaemon:
         mode = str(payload.get("mode", "sync")).lower()
         if mode not in ("sync", "async"):
             raise ValueError(f"mode must be sync|async, got {mode!r}")
+        degraded = False
+        if (
+            mode == "sync"
+            and self._sync_degrade_depth > 0
+            and self._scheduler.backlog() >= self._sync_degrade_depth
+        ):
+            # under load a sync submit would park an HTTP worker behind
+            # a deep queue: degrade to async and hand back the job id
+            mode = "async"
+            degraded = True
+            self._count_reject("sync_degraded")
         job = self.submit(
             sid,
             sql,
@@ -360,5 +742,8 @@ class ServeDaemon:
             limit=int(payload.get("limit", 10_000)),
         )
         if mode == "async":
-            return 202, job.snapshot(include_result=False)
+            snap = job.snapshot(include_result=False)
+            if degraded:
+                snap["degraded_to_async"] = True
+            return 202, snap
         return 200, job.snapshot()

@@ -68,16 +68,22 @@ class _ServeAPIHandler(HardenedRequestHandler):
         except ValueError as ex:
             self.send_error_payload(400, ex)
             return
-        status, resp = self.rpc_server.daemon.handle_api(
+        status, resp, headers = self.rpc_server.daemon.handle_api(
             method, self.path, payload
         )
-        self._send_json(status, resp)
+        self._send_json(status, resp, headers)
 
-    def _send_json(self, status: int, resp: Any) -> None:
+    def _send_json(
+        self, status: int, resp: Any, headers: Any = None
+    ) -> None:
         data = dumps(resp)
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for name, value in (headers or {}).items():
+            # extra response headers from the router — Retry-After on
+            # the backpressure/drain rejections
+            self.send_header(name, str(value))
         self.end_headers()
         self.wfile.write(data)
 

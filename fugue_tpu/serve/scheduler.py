@@ -17,8 +17,20 @@ mechanism:
   boundary.
 
 Concurrency is bounded by ``fugue.serve.max_concurrent`` worker threads
-pulling from one FIFO queue; completed jobs stay queryable until the
-retention cap evicts the oldest finished ones.
+pulling from one FIFO queue. Resilience plumbing on top (ISSUE 7):
+
+- :meth:`backlog` / :meth:`active_count` feed the daemon's admission
+  control (queue-depth backpressure, per-session caps);
+- :meth:`drain` stops intake, lets in-flight jobs finish until a
+  deadline, then cancels and abandons the rest;
+- finished jobs keep their **status** until the record cap evicts them,
+  but their result **payload** is dropped by TTL
+  (``fugue.serve.job_ttl``) — a long-lived daemon must not pin hundreds
+  of MB of collected rows for jobs nobody will poll again;
+- worker pickup passes the chaos site ``serve.dispatch``; an injected
+  dispatch fault lands on the job as a structured error;
+- jobs carry heartbeats (:meth:`ServeJob.beat`) the engine supervisor
+  watches to cancel wedged runs.
 """
 
 import queue
@@ -28,6 +40,7 @@ import uuid
 from typing import Any, Callable, Dict, List, Optional
 
 from fugue_tpu.exceptions import TaskCancelledError
+from fugue_tpu.testing.faults import fault_point
 from fugue_tpu.workflow.fault import CancelToken
 from fugue_tpu.workflow.runner import DAGRunner, TaskNode
 
@@ -37,17 +50,16 @@ DONE = "done"
 ERROR = "error"
 CANCELLED = "cancelled"
 
-# finished jobs kept for polling before the oldest are evicted
+# finished job RECORDS (status/error/timings) kept for polling before
+# the oldest are evicted; payloads go earlier, by TTL
 _RETAIN_FINISHED = 1000
-# ... of which only the newest keep their FULL result payload (collected
-# rows can run to limit x row_width bytes per job — a long-lived daemon
-# must not pin hundreds of MB of host memory for jobs nobody will poll
-# again); older finished jobs keep status/error/timings only
-_RETAIN_RESULTS = 64
 
 
 class ServeJob:
-    """One submission: its request, lifecycle state, and outcome."""
+    """One submission: its request, lifecycle state, and outcome.
+    ``job_id`` is normally minted fresh; daemon restart recovery passes
+    the journaled id so clients polling across the restart still
+    resolve their job."""
 
     def __init__(
         self,
@@ -57,8 +69,9 @@ class ServeJob:
         timeout: float = 0.0,
         collect: bool = True,
         limit: int = 10_000,
+        job_id: Optional[str] = None,
     ):
-        self.job_id = "job-" + uuid.uuid4().hex[:12]
+        self.job_id = job_id or ("job-" + uuid.uuid4().hex[:12])
         self.session_id = session_id
         self.sql = sql
         self.save_as = save_as
@@ -66,6 +79,12 @@ class ServeJob:
         self.collect = bool(collect)
         self.limit = int(limit)
         self.token = CancelToken()
+        # every cooperative cancellation check the inner workflow makes
+        # (task launch, retry attempts, dispatch-guard acquisition) is a
+        # liveness proof: heartbeats ride on the polls, so a long multi-
+        # task query keeps beating between device dispatches and the
+        # watchdog only sees a stale beat when ONE dispatch truly wedges
+        self.token.on_poll = self.beat
         self.status = QUEUED
         self.result: Optional[Dict[str, Any]] = None
         self.error: Optional[Dict[str, str]] = None
@@ -73,15 +92,56 @@ class ServeJob:
         self.started_at: Optional[float] = None
         self.finished_at: Optional[float] = None
         self.done_event = threading.Event()
+        self._finish_lock = threading.Lock()
+        # deterministic workflow uuid of the compiled DAG, set by the
+        # executor once the DAG exists — the breaker's query fingerprint
+        self.fingerprint: Optional[str] = None
+        # True when restart recovery resubmitted this job from the journal
+        self.recovered = False
+        self._heartbeat: Optional[float] = None  # monotonic
 
     @property
     def finished(self) -> bool:
         return self.status in (DONE, ERROR, CANCELLED)
 
+    def beat(self) -> None:
+        """Record liveness; the executor calls this at milestones and
+        the supervisor cancels running jobs whose beat goes stale."""
+        self._heartbeat = time.monotonic()
+
+    @property
+    def heartbeat_age(self) -> Optional[float]:
+        """Seconds since the last beat (None before the first)."""
+        if self._heartbeat is None:
+            return None
+        return time.monotonic() - self._heartbeat
+
     def finish(self, status: str) -> None:
         self.status = status
         self.finished_at = time.time()
         self.done_event.set()
+
+    def try_finish(self, status: str) -> bool:
+        """Finish exactly once: False when another path (the watchdog's
+        abandon vs the worker's own completion) already finished it."""
+        with self._finish_lock:
+            if self.finished:
+                return False
+            self.finish(status)
+            return True
+
+    def try_start(self) -> bool:
+        """Atomically claim execution at worker pickup: False when the
+        job was cancelled or already terminalized. Under the finish lock
+        so a drain/watchdog ``abandon`` racing the pickup can never be
+        overwritten back to RUNNING (a resurrected finished job would
+        double-fire the finish observers)."""
+        with self._finish_lock:
+            if self.finished or self.token.cancelled:
+                return False
+            self.status = RUNNING
+            self.started_at = time.time()
+            return True
 
     def snapshot(self, include_result: bool = True) -> Dict[str, Any]:
         out: Dict[str, Any] = {
@@ -90,6 +150,8 @@ class ServeJob:
             "status": self.status,
             "submitted_at": self.submitted_at,
         }
+        if self.recovered:
+            out["recovered"] = True
         if self.started_at is not None and self.finished_at is not None:
             out["seconds"] = round(self.finished_at - self.started_at, 6)
         if self.error is not None:
@@ -104,17 +166,29 @@ class ServeJob:
 
 class JobScheduler:
     """Bounded-concurrency executor: ``execute(job)`` produces the job's
-    result payload; failures become structured errors on the job."""
+    result payload; failures become structured errors on the job.
+    ``on_finish`` (optional) fires after every job reaches a terminal
+    state — the daemon uses it for breaker accounting and job-journal
+    cleanup."""
 
-    def __init__(self, execute: Callable[[ServeJob], Any], max_concurrent: int):
+    def __init__(
+        self,
+        execute: Callable[[ServeJob], Any],
+        max_concurrent: int,
+        job_ttl: float = 0.0,
+        on_finish: Optional[Callable[[ServeJob], None]] = None,
+    ):
         self._execute = execute
         self._max_concurrent = max(1, int(max_concurrent))
+        self._job_ttl = max(0.0, float(job_ttl))
+        self._on_finish = on_finish
         self._queue: "queue.Queue[Optional[ServeJob]]" = queue.Queue()
         self._jobs: Dict[str, ServeJob] = {}
         self._order: List[str] = []  # submission order, for retention
         self._lock = threading.RLock()
         self._workers: List[threading.Thread] = []
         self._started = False
+        self._draining = False
 
     @property
     def max_concurrent(self) -> int:
@@ -125,6 +199,7 @@ class JobScheduler:
             if self._started:
                 return
             self._started = True
+            self._draining = False
             self._workers = [
                 threading.Thread(
                     target=self._work, daemon=True,
@@ -139,24 +214,68 @@ class JobScheduler:
         """Cancel queued jobs and stop the workers. Running jobs get
         their token set; their worker threads are daemons, so a wedged
         query cannot block shutdown."""
+        self._shutdown(cancel=True)
+
+    def kill(self) -> None:
+        """Hard-kill approximation for chaos tests: stop the workers via
+        their sentinels and cancel running tokens (the closest an
+        in-process harness gets to threads vanishing mid-flight), with
+        no drain, no waiting, no journaling — and no finish observers:
+        a killed process never runs its callbacks, so the job journal
+        keeps the interrupted entries a restart must resume."""
+        self._on_finish = None
+        self._shutdown(cancel=True, join=0.5)
+
+    def _shutdown(self, cancel: bool, join: float = 5.0) -> None:
         with self._lock:
             if not self._started:
                 return
             self._started = False
             jobs = list(self._jobs.values())
-        for job in jobs:
-            if not job.finished:
-                job.token.cancel()
+        if cancel:
+            for job in jobs:
+                if not job.finished:
+                    job.token.cancel()
         for _ in self._workers:
             self._queue.put(None)
         for w in self._workers:
-            w.join(timeout=5)
+            w.join(timeout=join)
         self._workers = []
+
+    # ---- drain -----------------------------------------------------------
+    def drain(self, timeout: float) -> Dict[str, int]:
+        """Graceful drain: stop accepting, give queued+running jobs up
+        to ``timeout`` seconds to finish, then cancel and abandon the
+        rest. Returns ``{"completed": n, "abandoned": m}`` counted over
+        the jobs that were in flight when the drain began."""
+        with self._lock:
+            self._draining = True
+            inflight = [j for j in self._jobs.values() if not j.finished]
+        deadline = time.monotonic() + max(0.0, timeout)
+        for job in inflight:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            job.done_event.wait(timeout=remaining)
+        # deadline passed: stragglers are abandoned — terminal CANCELLED
+        # immediately, so the final journal snapshot and any pollers see
+        # a settled state, not a phantom running job
+        abandoned = sum(
+            1 for job in inflight if not job.finished and self.abandon(job)
+        )
+        return {
+            "completed": len(inflight) - abandoned,
+            "abandoned": abandoned,
+        }
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
 
     def submit(self, job: ServeJob) -> ServeJob:
         with self._lock:
-            if not self._started:
-                raise ValueError("scheduler is not running")
+            if not self._started or self._draining:
+                raise ValueError("scheduler is not accepting jobs")
             self._jobs[job.job_id] = job
             self._order.append(job.job_id)
             self._evict_locked()
@@ -165,6 +284,30 @@ class JobScheduler:
             # in the queue behind the shutdown sentinels un-cancelled
             # (which would leave a sync waiter blocked forever)
             self._queue.put(job)
+        return job
+
+    def abandon(self, job: ServeJob) -> bool:
+        """Cancel + immediately terminalize a job the daemon has given
+        up on (drain deadline, stale heartbeat): the record flips to
+        CANCELLED right away so pollers unblock, while the worker thread
+        — possibly still wedged inside the dispatch — can no longer
+        overwrite the outcome (``try_finish``). Returns False when the
+        job won the race and finished on its own."""
+        job.token.cancel()
+        if job.try_finish(CANCELLED):
+            self._notify_finish(job)
+            return True
+        return False
+
+    def adopt(self, job: ServeJob) -> ServeJob:
+        """Register a job record WITHOUT queueing it — restart recovery
+        uses this for journaled jobs whose session did not survive, so a
+        client polling the old job id gets the structured failover error
+        instead of a 404."""
+        with self._lock:
+            self._jobs[job.job_id] = job
+            self._order.append(job.job_id)
+            self._evict_locked()
         return job
 
     def get(self, job_id: str) -> ServeJob:
@@ -191,6 +334,26 @@ class JobScheduler:
             out[j.status] = out.get(j.status, 0) + 1
         return out
 
+    def backlog(self) -> int:
+        """Queued (not yet running) jobs — the admission controller's
+        queue-depth signal."""
+        with self._lock:
+            return sum(1 for j in self._jobs.values() if j.status == QUEUED)
+
+    def active_count(self, session_id: str) -> int:
+        """Queued+running jobs of one session (per-session cap)."""
+        with self._lock:
+            return sum(
+                1
+                for j in self._jobs.values()
+                if j.session_id == session_id and not j.finished
+            )
+
+    def running_jobs(self) -> List[ServeJob]:
+        with self._lock:
+            return [j for j in self._jobs.values() if j.status == RUNNING]
+
+    # ---- retention -------------------------------------------------------
     def _evict_locked(self) -> None:
         while len(self._order) > _RETAIN_FINISHED:
             for i, jid in enumerate(self._order):
@@ -200,10 +363,28 @@ class JobScheduler:
                     break
             else:
                 return  # everything retained is still live
-        # payload stripping beyond the fresh window (see _RETAIN_RESULTS)
-        finished = [j for j in self._order if self._jobs[j].finished]
-        for jid in finished[:-_RETAIN_RESULTS]:
-            self._jobs[jid].result = None
+
+    def gc_payloads(self, now: Optional[float] = None) -> int:
+        """TTL eviction of finished-job payloads (``fugue.serve.job_ttl``):
+        a job finished more than the TTL ago keeps its status/error/
+        timings but drops the collected-rows payload. 0 = keep payloads
+        until the record cap evicts the whole job. Returns how many
+        payloads were dropped."""
+        if self._job_ttl <= 0:
+            return 0
+        cutoff = (now if now is not None else time.time()) - self._job_ttl
+        dropped = 0
+        with self._lock:
+            for job in self._jobs.values():
+                if (
+                    job.finished
+                    and job.result is not None
+                    and job.finished_at is not None
+                    and job.finished_at < cutoff
+                ):
+                    job.result = None
+                    dropped += 1
+        return dropped
 
     # ---- worker loop -----------------------------------------------------
     def _work(self) -> None:
@@ -211,14 +392,14 @@ class JobScheduler:
             job = self._queue.get()
             if job is None:
                 return
-            if job.token.cancelled:
-                job.finish(CANCELLED)
+            if not job.try_start():
+                if job.try_finish(CANCELLED):
+                    self._notify_finish(job)
                 continue
-            job.status = RUNNING
-            job.started_at = time.time()
+            job.beat()
             node = TaskNode(
                 job.job_id,
-                lambda deps, j=job: self._execute(j),
+                lambda deps, j=job: self._dispatch(j),
                 [],
                 name=f"serve:{job.job_id}",
                 timeout=job.timeout,
@@ -231,11 +412,34 @@ class JobScheduler:
                     [node], cancel_token=job.token
                 )
                 job.result = res.get(job.job_id)
-                job.finish(DONE)
+                if not job.try_finish(DONE):
+                    # lost the race to an abandon (drain deadline, stale
+                    # heartbeat): the outcome stays CANCELLED
+                    job.result = None
+                    continue
             except TaskCancelledError:
-                job.finish(CANCELLED)
+                if not job.try_finish(CANCELLED):
+                    continue
             except Exception as ex:
                 from fugue_tpu.rpc.http import structured_error
 
+                if job.finished:  # abandoned mid-flight: outcome settled
+                    continue
                 job.error = structured_error(ex)
-                job.finish(ERROR)
+                if not job.try_finish(ERROR):
+                    continue
+            self._notify_finish(job)
+
+    def _dispatch(self, job: ServeJob) -> Any:
+        # chaos site: an injected dispatch fault surfaces on the job as
+        # a structured error, never as a dead worker thread
+        fault_point("serve.dispatch", job.job_id)
+        return self._execute(job)
+
+    def _notify_finish(self, job: ServeJob) -> None:
+        if self._on_finish is None:
+            return
+        try:
+            self._on_finish(job)
+        except Exception:  # pragma: no cover - observer must not kill worker
+            pass

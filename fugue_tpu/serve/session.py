@@ -10,6 +10,16 @@ per-tenant budget accounting and fair spill ordering see exactly the
 bytes this session pins. Closing the session drops every table from the
 catalog; the ledger reconciles to zero through the frames' weakref
 finalizers the moment the last reference dies.
+
+**Durability** (ISSUE 7): with a :class:`~fugue_tpu.serve.state.ServeStateJournal`
+attached, ``save_table`` also writes the frame as a parquet artifact
+under the state path and journals its sha256 fingerprint. A session
+restored after a daemon restart starts with *durable records* instead of
+catalog entries; the first access to a table re-verifies the fingerprint
+(:func:`~fugue_tpu.workflow.manifest.artifact_fingerprint`) and lazily
+reloads the artifact into the catalog — corrupt artifacts are removed
+and the table forgotten (counted in ``integrity_rejected``), the same
+rejection manifest resume applies to checkpoints.
 """
 
 import threading
@@ -18,8 +28,10 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 from fugue_tpu.dataframe import DataFrame
+from fugue_tpu.testing.faults import fault_point
 from fugue_tpu.utils.assertion import assert_or_throw
 from fugue_tpu.workflow.fault import engine_dispatch_guard
+from fugue_tpu.workflow.manifest import artifact_fingerprint
 
 _NAMESPACE = "__serve__"
 
@@ -27,19 +39,59 @@ _NAMESPACE = "__serve__"
 class ServeSession:
     """One client's hot state against the shared persistent engine."""
 
-    def __init__(self, engine: Any, ttl: float = 0.0):
-        self.session_id = "s-" + uuid.uuid4().hex[:12]
+    def __init__(
+        self,
+        engine: Any,
+        ttl: float = 0.0,
+        journal: Any = None,
+        session_id: Optional[str] = None,
+        created_at: Optional[float] = None,
+    ):
+        self.session_id = session_id or ("s-" + uuid.uuid4().hex[:12])
         self._engine = engine
+        self._journal = journal
         self.ttl = max(0.0, float(ttl))
-        self.created_at = time.time()
+        self.created_at = created_at if created_at is not None else time.time()
         self._last_used = time.monotonic()
         self._tables: Dict[str, str] = {}  # name -> qualified catalog name
+        # tables known only from the journal after a restart:
+        # name -> {"artifact", "size", "sha256"}; loaded lazily
+        self._durable: Dict[str, Dict[str, Any]] = {}
+        self.integrity_rejected = 0
+        self.restored = False
         self._lock = threading.RLock()
         self._closed = False
+
+    @classmethod
+    def restore(
+        cls,
+        engine: Any,
+        journal: Any,
+        session_id: str,
+        record: Dict[str, Any],
+    ) -> "ServeSession":
+        """Rehydrate a journaled session: same id/ttl/created_at, table
+        records kept durable-only until first access reloads them."""
+        s = cls(
+            engine,
+            ttl=float(record.get("ttl", 0.0) or 0.0),
+            journal=journal,
+            session_id=session_id,
+            created_at=record.get("created_at"),
+        )
+        s._durable = {
+            name: dict(rec)
+            for name, rec in (record.get("tables") or {}).items()
+            if rec.get("artifact")
+        }
+        s.restored = True
+        return s
 
     # ---- lifecycle -------------------------------------------------------
     def touch(self) -> None:
         self._last_used = time.monotonic()
+        if self._journal is not None:
+            self._journal.touch_session(self.session_id)
 
     @property
     def idle_seconds(self) -> float:
@@ -53,30 +105,49 @@ class ServeSession:
     def closed(self) -> bool:
         return self._closed
 
-    def close(self) -> List[str]:
+    def close(self, forget: bool = True) -> List[str]:
         """Drop every session table from the catalog; returns the dropped
-        names. Idempotent."""
+        names. Idempotent. ``forget=True`` (user close / TTL expiry) also
+        removes the journal records and durable artifacts; daemon
+        shutdown passes ``forget=False`` so the journaled state survives
+        for the next daemon to rehydrate."""
         with self._lock:
             if self._closed:
                 return []
             self._closed = True
-            dropped = list(self._tables)
+            dropped = sorted(set(self._tables) | set(self._durable))
             sql = self._engine.sql_engine
             for name, qualified in self._tables.items():
                 try:
                     sql.drop_table(qualified)
                 except Exception:  # pragma: no cover - best-effort cleanup
                     pass
+            if forget and self._journal is not None:
+                for name in dropped:
+                    self._remove_artifact(name)
+                self._journal.forget_session(self.session_id)
             self._tables.clear()
+            self._durable.clear()
             return dropped
+
+    def _remove_artifact(self, name: str) -> None:
+        if self._journal is None:
+            return
+        uri = self._journal.table_artifact_uri(self.session_id, name)
+        try:
+            if self._engine.fs.exists(uri):
+                self._engine.fs.rm(uri, recursive=True)
+        except Exception:  # pragma: no cover - best-effort cleanup
+            pass
 
     # ---- table catalog (namespaced) --------------------------------------
     def qualified(self, name: str) -> str:
         return f"{_NAMESPACE}.{self.session_id}.{name}"
 
     def save_table(self, name: str, df: DataFrame) -> str:
-        """Persist ``df`` as a hot session table and claim its bytes for
-        this session's tenant account in the memory governor."""
+        """Persist ``df`` as a hot session table, claim its bytes for
+        this session's tenant account in the memory governor, and (with
+        a journal) write the durable parquet artifact + fingerprint."""
         assert_or_throw(
             name.isidentifier(),
             ValueError(f"invalid table name {name!r}"),
@@ -91,10 +162,36 @@ class ServeSession:
             # jobs sharing the engine (see task_execution_lock)
             with engine_dispatch_guard(self._engine, None):
                 sql.save_table(df, q, mode="overwrite")
-            self._claim_tenant(sql.load_table(q))
+            loaded = sql.load_table(q)
+            self._claim_tenant(loaded)
             self._tables[name] = q
+            self._durable.pop(name, None)  # catalog copy is now the truth
+            self._journal_table(name, loaded)
         self.touch()
         return q
+
+    def _journal_table(self, name: str, df: DataFrame) -> None:
+        """Write the durable artifact + fingerprint record (no-op for an
+        ephemeral daemon). Artifact write failures degrade durability,
+        never the request — the catalog save already succeeded."""
+        if self._journal is None:
+            return
+        uri = self._journal.table_artifact_uri(self.session_id, name)
+        try:
+            with engine_dispatch_guard(self._engine, None):
+                self._engine.save_df(df, uri, format_hint="parquet")
+            size, sha256 = artifact_fingerprint(self._engine.fs, uri)
+        except Exception as ex:
+            self._engine.log.warning(
+                "fugue_tpu serve: durable artifact for table %s.%s failed "
+                "(%s: %s); table is hot but will not survive a restart",
+                self.session_id, name, type(ex).__name__, ex,
+            )
+            return
+        self._journal.record_table(
+            self.session_id, name,
+            {"artifact": uri, "size": size, "sha256": sha256},
+        )
 
     def _claim_tenant(self, loaded: DataFrame) -> None:
         gov = getattr(self._engine, "memory_governor", None)
@@ -102,43 +199,108 @@ class ServeSession:
         if gov is not None and blocks is not None:
             gov.assign_tenant(blocks, self.session_id)
 
+    def _ensure_loaded(self, name: str) -> Optional[str]:
+        """Resolve a durable-only table into the catalog (lazy restart
+        reload). Caller holds the lock. Returns the qualified name, or
+        None when the record was integrity-rejected and dropped."""
+        if name in self._tables:
+            return self._tables[name]
+        rec = self._durable.get(name)
+        if rec is None:
+            return None
+        uri = rec["artifact"]
+        fs = self._engine.fs
+        try:
+            ok = fs.exists(uri)
+            if ok and rec.get("sha256"):
+                size, digest = artifact_fingerprint(fs, uri)
+                ok = digest == rec["sha256"] and (
+                    rec.get("size") is None or size == rec["size"]
+                )
+        except Exception:
+            ok = False
+        if not ok:
+            # same policy as manifest resume: a corrupt artifact is
+            # removed and never served — the table is forgotten rather
+            # than silently yielding garbage rows
+            self.integrity_rejected += 1
+            self._engine.log.warning(
+                "fugue_tpu serve: table %s.%s artifact %s failed the "
+                "integrity check on restart reload; dropping the record",
+                self.session_id, name, uri,
+            )
+            self._durable.pop(name, None)
+            try:
+                if fs.exists(uri):
+                    fs.rm(uri, recursive=True)
+            except Exception:  # pragma: no cover - best effort
+                pass
+            if self._journal is not None:
+                self._journal.forget_table(self.session_id, name)
+            return None
+        q = self.qualified(name)
+        sql = self._engine.sql_engine
+        with engine_dispatch_guard(self._engine, None):
+            df = self._engine.load_df(uri, format_hint="parquet")
+            sql.save_table(df, q, mode="overwrite")
+        self._claim_tenant(sql.load_table(q))
+        self._tables[name] = q
+        self._durable.pop(name, None)
+        return q
+
     def drop_table(self, name: str) -> None:
         with self._lock:
             q = self._tables.pop(name, None)
+            self._durable.pop(name, None)
+            self._remove_artifact(name)
+        if self._journal is not None:
+            self._journal.forget_table(self.session_id, name)
         if q is not None:
             self._engine.sql_engine.drop_table(q)
 
     def table_names(self) -> List[str]:
         with self._lock:
-            return sorted(self._tables)
+            return sorted(set(self._tables) | set(self._durable))
 
     def table_frames(self) -> Dict[str, DataFrame]:
         """The live session tables as engine dataframes — fed into
         FugueSQL compilation as named sources, so a query just says
-        ``SELECT ... FROM mytable``."""
+        ``SELECT ... FROM mytable``. Durable-only records (restart)
+        reload lazily here, on the session's first query."""
         with self._lock:
+            for name in list(self._durable):
+                self._ensure_loaded(name)
             items = list(self._tables.items())
         sql = self._engine.sql_engine
         return {name: sql.load_table(q) for name, q in items}
 
     def describe(self) -> Dict[str, Any]:
-        return {
+        out = {
             "session_id": self.session_id,
             "created_at": self.created_at,
             "idle_seconds": round(self.idle_seconds, 3),
             "ttl": self.ttl,
             "tables": self.table_names(),
         }
+        with self._lock:
+            if self.restored:
+                out["restored"] = True
+                out["tables_pending_reload"] = sorted(self._durable)
+        return out
 
 
 class SessionManager:
     """Session registry with lazy TTL expiry: every lookup sweeps the
     expired (closing them drops their tables, so an abandoned session
-    cannot pin device memory forever)."""
+    cannot pin device memory forever). With a journal attached, creates
+    and closes are journaled, and :meth:`restore` rehydrates a prior
+    daemon's registry."""
 
-    def __init__(self, engine: Any, default_ttl: float = 0.0):
+    def __init__(self, engine: Any, default_ttl: float = 0.0,
+                 journal: Any = None):
         self._engine = engine
         self._default_ttl = max(0.0, float(default_ttl))
+        self._journal = journal
         self._sessions: Dict[str, ServeSession] = {}
         self._lock = threading.RLock()
 
@@ -146,11 +308,39 @@ class SessionManager:
         session = ServeSession(
             self._engine,
             ttl=self._default_ttl if ttl is None else float(ttl),
+            journal=self._journal,
         )
         with self._lock:
             self._sessions[session.session_id] = session
+        if self._journal is not None:
+            self._journal.record_session(session)
         self.sweep()
         return session
+
+    def restore(self, journaled: Dict[str, Dict[str, Any]]) -> int:
+        """Rehydrate journaled sessions after a restart, skipping the
+        ones whose TTL expired while the daemon was down (their journal
+        records and artifacts are cleaned up). Returns the restored
+        count."""
+        restored = 0
+        now = time.time()
+        for sid, rec in sorted(journaled.items()):
+            ttl = float(rec.get("ttl", 0.0) or 0.0)
+            last_used = float(rec.get("last_used") or rec.get("created_at") or now)
+            if ttl > 0 and now - last_used > ttl:
+                # expired while down: clean up like a normal expiry
+                dead = ServeSession.restore(
+                    self._engine, self._journal, sid, rec
+                )
+                dead.close(forget=True)
+                continue
+            session = ServeSession.restore(
+                self._engine, self._journal, sid, rec
+            )
+            with self._lock:
+                self._sessions[sid] = session
+            restored += 1
+        return restored
 
     def get(self, session_id: str) -> ServeSession:
         """Raises ``KeyError`` for unknown AND expired ids (an expired
@@ -168,30 +358,62 @@ class SessionManager:
             session = self._sessions.pop(session_id, None)
         if session is None:
             raise KeyError(f"unknown or expired session {session_id}")
-        return session.close()
+        return session.close(forget=True)
 
     def close_all(self) -> None:
+        """User-facing teardown: closes AND forgets every session."""
         with self._lock:
             sessions = list(self._sessions.values())
             self._sessions.clear()
         for s in sessions:
-            s.close()
+            s.close(forget=True)
+
+    def shutdown(self) -> None:
+        """Daemon shutdown: drop the catalog copies (the engine is
+        dying) but KEEP the journal records and artifacts — the next
+        daemon on this state path rehydrates them."""
+        with self._lock:
+            sessions = list(self._sessions.values())
+            self._sessions.clear()
+        for s in sessions:
+            s.close(forget=False)
 
     def sweep(self) -> int:
-        """Close every expired session; returns how many were closed."""
+        """Close every expired session; returns how many were closed.
+        Chaos site ``serve.sweep`` fires per expired session — an
+        injected fault leaves that session for the next sweep instead
+        of wedging the caller."""
         with self._lock:
             expired = [
                 (sid, s) for sid, s in self._sessions.items() if s.expired
             ]
             for sid, _ in expired:
                 del self._sessions[sid]
-        for _, s in expired:
-            s.close()
-        return len(expired)
+        closed = 0
+        for sid, s in expired:
+            try:
+                fault_point("serve.sweep", sid)
+                s.close(forget=True)
+                closed += 1
+            except Exception as ex:
+                # put it back: the tables are still live, so the session
+                # must stay discoverable until a sweep succeeds
+                with self._lock:
+                    self._sessions.setdefault(sid, s)
+                self._engine.log.warning(
+                    "fugue_tpu serve: sweep of expired session %s failed "
+                    "(%s: %s); retrying next sweep",
+                    sid, type(ex).__name__, ex,
+                )
+        return closed
 
     def count(self) -> int:
         with self._lock:
             return len(self._sessions)
+
+    def integrity_rejected(self) -> int:
+        with self._lock:
+            return sum(s.integrity_rejected for s in self._sessions.values())
 
     def describe(self) -> List[Dict[str, Any]]:
         with self._lock:

@@ -1,0 +1,212 @@
+"""Durable daemon state: the crash journal behind serving resilience.
+
+With ``fugue.serve.state_path`` set, the daemon journals three things
+through ``engine.fs`` into ``<state_path>/serve_state.json``, atomically
+rewritten on every mutation (the same atomic-write primitive as the run
+manifest — :func:`fugue_tpu.workflow.manifest.atomic_json_write` — so a
+hard kill leaves the previous snapshot or the new one, never a torn
+file):
+
+- the **session registry**: id, ttl, creation time, last-use time;
+- each session's **saved-table catalog**: every ``save_table`` also
+  writes the frame as a parquet artifact under
+  ``<state_path>/tables/<session>/<name>.parquet`` and records its byte
+  size + sha256 (:func:`~fugue_tpu.workflow.manifest.artifact_fingerprint`);
+  a restarted daemon reloads a hot table LAZILY on first access, after
+  re-verifying the fingerprint — an integrity-rejected artifact is
+  removed and the table forgotten, exactly how manifest resume rejects
+  corrupted checkpoints;
+- the **async job journal**: queued/running async submissions with their
+  full request, so a restarted daemon resubmits them under their
+  original job ids (re-running a FugueSQL job is idempotent — saves are
+  overwrite-mode — so failover never duplicates rows).
+
+Journal writes are best-effort: a failing write (chaos site
+``serve.journal``) degrades durability, never availability — the error
+is logged and counted, and serving continues.
+"""
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from fugue_tpu.testing.faults import fault_point
+from fugue_tpu.workflow.manifest import atomic_json_write, read_json
+
+_STATE_FILE = "serve_state.json"
+
+
+class ServeStateJournal:
+    """The daemon's durable state file. All mutators rewrite the whole
+    (small) JSON snapshot under one lock; readers get plain dicts."""
+
+    def __init__(self, engine: Any, base_uri: str):
+        self._engine = engine
+        self._base = str(base_uri).rstrip("/")
+        self._lock = threading.RLock()
+        self._sessions: Dict[str, Dict[str, Any]] = {}
+        self._jobs: Dict[str, Dict[str, Any]] = {}
+        self.write_failures = 0
+        # touch_session marks the snapshot dirty WITHOUT writing; the
+        # supervisor tick flushes at a bounded cadence so a read-only
+        # workload's last_used still reaches disk (else its sessions
+        # would look idle-since-creation to a restarted daemon and be
+        # expired, artifacts and all)
+        self._dirty = False
+        self._last_write = 0.0  # monotonic
+
+    @property
+    def uri(self) -> str:
+        return self._engine.fs.join(self._base, _STATE_FILE)
+
+    def table_artifact_uri(self, session_id: str, name: str) -> str:
+        fs = self._engine.fs
+        return fs.join(self._base, "tables", session_id, f"{name}.parquet")
+
+    # ---- load / persist --------------------------------------------------
+    def load(self) -> Dict[str, Any]:
+        """Read a prior daemon's journal (empty dicts when none). The
+        snapshot becomes this journal's live state so the first mutation
+        after a restart does not drop recovered-but-untouched entries."""
+        data = read_json(
+            self._engine.fs, self.uri,
+            log=self._engine.log, what="serve state journal",
+        ) or {}
+        with self._lock:
+            self._sessions = dict(data.get("sessions") or {})
+            self._jobs = dict(data.get("jobs") or {})
+            return {
+                "sessions": dict(self._sessions),
+                "jobs": dict(self._jobs),
+            }
+
+    def write(self) -> None:
+        """Atomically persist the current snapshot (chaos site
+        ``serve.journal``). Best-effort: failures degrade durability,
+        never availability."""
+        with self._lock:
+            payload = {
+                "saved_at": time.time(),
+                "sessions": self._sessions,
+                "jobs": self._jobs,
+            }
+            self._dirty = False
+            self._last_write = time.monotonic()
+            try:
+                fault_point("serve.journal", self.uri)
+                atomic_json_write(self._engine.fs, self.uri, payload)
+            except Exception as ex:
+                self.write_failures += 1
+                self._engine.log.warning(
+                    "fugue_tpu serve: journal write to %s failed (%s: %s); "
+                    "durability degraded, serving continues",
+                    self.uri, type(ex).__name__, ex,
+                )
+
+    # ---- session registry ------------------------------------------------
+    def record_session(self, session: Any) -> None:
+        with self._lock:
+            rec = self._sessions.setdefault(
+                session.session_id,
+                {"tables": {}},
+            )
+            rec.update(
+                {
+                    "ttl": session.ttl,
+                    "created_at": session.created_at,
+                    "last_used": time.time(),
+                }
+            )
+        self.write()
+
+    def touch_session(self, session_id: str) -> None:
+        """Refresh a session's journaled last-use WITHOUT a write — the
+        journal must not rewrite on every query. The timestamp rides
+        along with the next mutation's snapshot, or with the supervisor
+        tick's bounded-cadence :meth:`maybe_flush`."""
+        with self._lock:
+            rec = self._sessions.get(session_id)
+            if rec is not None:
+                rec["last_used"] = time.time()
+                self._dirty = True
+
+    def maybe_flush(self, min_interval: float = 5.0) -> None:
+        """Write the snapshot iff touches are pending and the last write
+        is older than ``min_interval`` — bounds last_used staleness on a
+        read-only workload to ~min_interval without journal churn."""
+        with self._lock:
+            if (
+                not self._dirty
+                or time.monotonic() - self._last_write < min_interval
+            ):
+                return
+        self.write()
+
+    def forget_session(self, session_id: str) -> None:
+        with self._lock:
+            existed = self._sessions.pop(session_id, None) is not None
+        if existed:
+            self.write()
+
+    def record_table(
+        self, session_id: str, name: str, record: Dict[str, Any]
+    ) -> None:
+        with self._lock:
+            rec = self._sessions.get(session_id)
+            if rec is None:  # pragma: no cover - session raced away
+                return
+            rec.setdefault("tables", {})[name] = record
+            rec["last_used"] = time.time()
+        self.write()
+
+    def forget_table(self, session_id: str, name: str) -> None:
+        with self._lock:
+            rec = self._sessions.get(session_id)
+            existed = (
+                rec is not None
+                and rec.get("tables", {}).pop(name, None) is not None
+            )
+        if existed:
+            self.write()
+
+    # ---- async job journal -----------------------------------------------
+    def record_job(self, job: Any) -> None:
+        with self._lock:
+            self._jobs[job.job_id] = {
+                "session_id": job.session_id,
+                "sql": job.sql,
+                "save_as": job.save_as,
+                "timeout": job.timeout,
+                "collect": job.collect,
+                "limit": job.limit,
+                "submitted_at": job.submitted_at,
+            }
+        self.write()
+
+    def finish_job(self, job_id: str) -> None:
+        """A finished job leaves the journal — only interrupted
+        queued/running jobs are resume candidates."""
+        with self._lock:
+            existed = self._jobs.pop(job_id, None) is not None
+        if existed:
+            self.write()
+
+    # ---- observability ---------------------------------------------------
+    def describe(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "uri": self.uri,
+                "sessions": len(self._sessions),
+                "pending_jobs": len(self._jobs),
+                "write_failures": self.write_failures,
+            }
+
+
+def make_journal(engine: Any, state_path: str) -> Optional[ServeStateJournal]:
+    """The daemon's journal when ``fugue.serve.state_path`` is set; None
+    keeps the daemon ephemeral (PR 6 behavior)."""
+    base = str(state_path or "").strip()
+    if base == "":
+        return None
+    engine.fs.makedirs(base, exist_ok=True)
+    return ServeStateJournal(engine, base)

@@ -1,0 +1,399 @@
+"""Engine supervision: health state machine, admission errors, circuit
+breakers and the heartbeat watchdog.
+
+**Health state machine.** The daemon is ``healthy`` → ``draining`` →
+``stopped`` (one-way). ``draining`` (SIGTERM / ``stop(drain=True)``)
+keeps the HTTP plane up so clients can poll in-flight jobs, but every
+new submission answers 503 + ``Retry-After``; when the drain deadline
+expires, still-running jobs are cancelled and abandoned, the state is
+journaled, and the engine context closes.
+
+**Circuit breakers.** Consecutive job failures trip a breaker per
+session AND per query fingerprint (the FugueSQL DAG's deterministic
+workflow uuid — built from task uuids, so the same query text over the
+same session tables maps to the same key across submissions and across
+restarts). An OPEN breaker answers immediately with a structured error
+instead of burning engine time on a poison query; after the cooldown it
+HALF-OPENs for exactly one probe — success closes it, failure re-opens
+the cooldown window.
+
+**Heartbeat watchdog.** Running jobs beat at execution milestones AND
+on every cooperative cancellation check the inner workflow makes (the
+job's CancelToken ``on_poll`` hook) — so a long multi-task query keeps
+beating between device dispatches, and the timeout bounds the longest
+SINGLE wedged dispatch, not total query duration. The supervisor tick
+abandons any running job whose heartbeat is older than
+``fugue.serve.heartbeat_timeout`` (belt over the runner's per-job
+wall-clock timeout braces — a wedged XLA dispatch stops blocking
+pollers even when the job was submitted without a timeout). The
+tick also sweeps expired sessions (chaos site ``serve.sweep``) and runs
+the job-payload TTL GC.
+"""
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+HEALTHY = "healthy"
+DRAINING = "draining"
+STOPPED = "stopped"
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+# registry bound: a daemon serving millions of distinct queries must not
+# keep one breaker object per fingerprint forever (closed failure-free
+# breakers are stateless and rebuildable on demand)
+_MAX_BREAKERS = 4096
+
+
+class AdmissionError(Exception):
+    """A submission the daemon refuses to accept right now. Carries the
+    HTTP status and a Retry-After hint; the HTTP layer turns it into a
+    structured payload + ``Retry-After`` header, and the fault
+    classifier treats any error carrying ``retry_after`` as TRANSIENT
+    (so client-side retry layers back off and try again)."""
+
+    def __init__(self, message: str, status: int = 503,
+                 retry_after: float = 1.0):
+        super().__init__(message)
+        self.status = int(status)
+        self.retry_after = max(0.0, float(retry_after))
+
+
+class BackpressureError(AdmissionError):
+    """Overload rejection (queue depth / memory pressure / drain)."""
+
+
+class SessionBusyError(AdmissionError):
+    """Per-session concurrent-job cap hit (HTTP 429)."""
+
+    def __init__(self, message: str, retry_after: float = 1.0):
+        super().__init__(message, status=429, retry_after=retry_after)
+
+
+class CircuitOpenError(AdmissionError):
+    """A tripped breaker is refusing this session/query (HTTP 503 with
+    the remaining cooldown as Retry-After)."""
+
+
+class PoisonQueryError(AdmissionError):
+    """A query fingerprint quarantined by its breaker: the same DAG
+    failed ``threshold`` consecutive times, so the job settles with this
+    structured error instead of executing again. Raised at execution
+    start (the fingerprint needs the compiled DAG), so it reaches the
+    client as the JOB's error payload — not as an HTTP rejection."""
+
+    def __init__(self, message: str, retry_after: float = 0.0):
+        super().__init__(message, status=422, retry_after=retry_after)
+
+
+class CircuitBreaker:
+    """One consecutive-failure breaker. Caller holds no lock — the
+    breaker locks itself (submissions and job completions race)."""
+
+    def __init__(self, key: str, threshold: int, cooldown: float):
+        self.key = key
+        self.threshold = max(1, int(threshold))
+        self.cooldown = max(0.0, float(cooldown))
+        self.state = CLOSED
+        self.failures = 0          # consecutive
+        self.trips = 0
+        self.opened_at = 0.0
+        self._probing = False      # one probe in flight while HALF_OPEN
+        self._lock = threading.Lock()
+
+    def allow(self) -> None:
+        """Raise when the breaker refuses this attempt; admit (and claim
+        the half-open probe slot) otherwise."""
+        with self._lock:
+            if self.state == CLOSED:
+                return
+            elapsed = time.monotonic() - self.opened_at
+            if self.state == OPEN and elapsed >= self.cooldown:
+                self.state = HALF_OPEN
+                self._probing = False
+            if self.state == HALF_OPEN and not self._probing:
+                self._probing = True  # exactly one probe through
+                return
+            remaining = max(0.0, self.cooldown - elapsed)
+            raise CircuitOpenError(
+                f"circuit breaker {self.key} is {self.state} after "
+                f"{self.failures} consecutive failures; retry in "
+                f"{remaining:.1f}s",
+                retry_after=remaining if remaining > 0 else self.cooldown,
+            )
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.state = CLOSED
+            self.failures = 0
+            self._probing = False
+
+    def release_probe(self) -> None:
+        """A claimed half-open probe slot whose attempt produced NO
+        verdict (the probe job was cancelled, or its submission failed
+        after admission) goes back: neither success nor failure, but the
+        next attempt must be allowed to probe — otherwise the breaker
+        stays half-open-and-busy forever."""
+        with self._lock:
+            if self.state == HALF_OPEN:
+                self._probing = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self.failures += 1
+            if self.state == HALF_OPEN or self.failures >= self.threshold:
+                if self.state != OPEN:
+                    self.trips += 1
+                self.state = OPEN
+                self.opened_at = time.monotonic()
+                self._probing = False
+
+    def describe(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "key": self.key,
+                "state": self.state,
+                "consecutive_failures": self.failures,
+                "trips": self.trips,
+            }
+
+
+class HealthState:
+    """The daemon's one-way lifecycle state with drain bookkeeping."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.state = HEALTHY
+        self.since = time.time()
+        self.drain_deadline: Optional[float] = None  # monotonic
+
+    def transition(self, state: str) -> None:
+        with self._lock:
+            self.state = state
+            self.since = time.time()
+
+    def start_drain(self, timeout: float) -> float:
+        with self._lock:
+            self.state = DRAINING
+            self.since = time.time()
+            self.drain_deadline = time.monotonic() + max(0.0, timeout)
+            return self.drain_deadline
+
+    @property
+    def healthy(self) -> bool:
+        return self.state == HEALTHY
+
+    def drain_remaining(self) -> float:
+        with self._lock:
+            if self.drain_deadline is None:
+                return 0.0
+            return max(0.0, self.drain_deadline - time.monotonic())
+
+    def describe(self) -> Dict[str, Any]:
+        with self._lock:
+            out: Dict[str, Any] = {"state": self.state, "since": self.since}
+            if self.state == DRAINING and self.drain_deadline is not None:
+                out["drain_remaining_seconds"] = round(
+                    max(0.0, self.drain_deadline - time.monotonic()), 3
+                )
+            return out
+
+
+class EngineSupervisor:
+    """Breaker registry + heartbeat watchdog thread. ``tick_hooks`` are
+    extra periodic maintenance callables (session sweep, job GC) the
+    daemon registers; each runs isolated — one failing hook never stops
+    the watchdog."""
+
+    def __init__(
+        self,
+        threshold: int,
+        cooldown: float,
+        heartbeat_timeout: float = 0.0,
+        log: Any = None,
+    ):
+        self.threshold = max(0, int(threshold))
+        self.cooldown = max(0.0, float(cooldown))
+        self.heartbeat_timeout = max(0.0, float(heartbeat_timeout))
+        self._log = log
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._lock = threading.Lock()
+        self.wedged_jobs = 0
+        self._abandon: Optional[Callable[[Any], bool]] = None
+        self._running_jobs: Callable[[], List[Any]] = list
+        self.tick_hooks: List[Callable[[], None]] = []
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ---- breakers --------------------------------------------------------
+    def _breaker(self, key: str) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(key)
+            if br is None:
+                if len(self._breakers) >= _MAX_BREAKERS:
+                    self._evict_locked()
+                br = self._breakers[key] = CircuitBreaker(
+                    key, self.threshold, self.cooldown
+                )
+            return br
+
+    def _evict_locked(self) -> None:
+        """Bound the registry on a long-lived daemon: drop the oldest
+        CLOSED, failure-free breakers (insertion order) — they carry no
+        state worth keeping. Tripped/half-open/failing breakers are
+        never evicted."""
+        for key in list(self._breakers):
+            br = self._breakers[key]
+            if br.state == CLOSED and br.failures == 0:
+                del self._breakers[key]
+                if len(self._breakers) < _MAX_BREAKERS // 2:
+                    return
+
+    def admit_session(self, session_id: str) -> None:
+        # lookup-only: a breaker that was never tripped by a failure is
+        # trivially closed, and the admission hot path must not allocate
+        # registry entries per request
+        if self.threshold <= 0:
+            return
+        with self._lock:
+            br = self._breakers.get(f"session:{session_id}")
+        if br is not None:
+            br.allow()
+
+    def admit_query(self, fingerprint: str) -> None:
+        """Raises :class:`PoisonQueryError` for a quarantined query
+        fingerprint (structured) — checked at execution start, right
+        after the DAG (and so its deterministic uuid) exists."""
+        if self.threshold <= 0:
+            return
+        with self._lock:
+            br = self._breakers.get(f"query:{fingerprint}")
+        if br is None:
+            return
+        try:
+            br.allow()
+        except CircuitOpenError as ex:
+            raise PoisonQueryError(
+                f"query {fingerprint[:12]} is quarantined after "
+                f"{br.failures} consecutive failures; half-open probe in "
+                f"{ex.retry_after:.1f}s",
+                retry_after=ex.retry_after,
+            ) from None
+
+    def note_result(
+        self, session_id: str, fingerprint: Optional[str], failed: bool
+    ) -> None:
+        if self.threshold <= 0:
+            return
+        for key in self._keys(session_id, fingerprint):
+            if failed:
+                self._breaker(key).record_failure()
+            else:
+                # successes only touch EXISTING breakers: allocating one
+                # per distinct healthy query fingerprint would grow the
+                # registry without bound on a long-lived daemon
+                with self._lock:
+                    br = self._breakers.get(key)
+                if br is not None:
+                    br.record_success()
+
+    def note_cancelled(
+        self, session_id: str, fingerprint: Optional[str]
+    ) -> None:
+        """A cancelled job is verdict-free for its breakers — but it may
+        have been holding a half-open probe slot, which must go back so
+        the quarantine can still be probed out of."""
+        if self.threshold <= 0:
+            return
+        for key in self._keys(session_id, fingerprint):
+            with self._lock:
+                br = self._breakers.get(key)
+            if br is not None:
+                br.release_probe()
+
+    def _keys(
+        self, session_id: str, fingerprint: Optional[str]
+    ) -> List[str]:
+        keys = [f"session:{session_id}"]
+        if fingerprint:
+            keys.append(f"query:{fingerprint}")
+        return keys
+
+    def breaker_stats(self) -> Dict[str, Any]:
+        with self._lock:
+            breakers = list(self._breakers.values())
+        tripped = [b.describe() for b in breakers if b.state != CLOSED]
+        return {
+            "enabled": self.threshold > 0,
+            "threshold": self.threshold,
+            "cooldown": self.cooldown,
+            "total": len(breakers),
+            "open": tripped,
+            "trips": sum(b.trips for b in breakers),
+        }
+
+    # ---- heartbeat watchdog ----------------------------------------------
+    def start(
+        self,
+        running_jobs: Callable[[], List[Any]],
+        abandon: Optional[Callable[[Any], bool]] = None,
+    ) -> None:
+        """Start the watchdog thread; ``running_jobs`` snapshots the
+        scheduler's RUNNING jobs and ``abandon`` (the scheduler's
+        ``abandon``) terminalizes a wedged one — pollers unblock
+        immediately instead of waiting out the stuck dispatch. Without
+        it the watchdog only cancels the job's token."""
+        if self._thread is not None:
+            return
+        self._running_jobs = running_jobs
+        self._abandon = abandon
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._watch, daemon=True, name="fugue-serve-supervisor"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=5)
+
+    def _interval(self) -> float:
+        if self.heartbeat_timeout > 0:
+            return max(0.05, min(0.25, self.heartbeat_timeout / 4.0))
+        return 0.25
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self._interval()):
+            self.tick()
+
+    def tick(self) -> None:
+        """One maintenance pass (also callable directly from tests)."""
+        if self.heartbeat_timeout > 0:
+            for job in self._running_jobs():
+                age = job.heartbeat_age
+                if age is not None and age > self.heartbeat_timeout:
+                    self.wedged_jobs += 1
+                    if self._log is not None:
+                        self._log.warning(
+                            "fugue_tpu serve: job %s heartbeat is %.2fs "
+                            "old (> %.2fs); cancelling as wedged",
+                            job.job_id, age, self.heartbeat_timeout,
+                        )
+                    if self._abandon is not None:
+                        self._abandon(job)
+                    else:
+                        job.token.cancel()
+        for hook in list(self.tick_hooks):
+            try:
+                hook()
+            except Exception as ex:  # one bad hook never stops the watchdog
+                if self._log is not None:
+                    self._log.warning(
+                        "fugue_tpu serve: supervisor hook failed: %s: %s",
+                        type(ex).__name__, ex,
+                    )

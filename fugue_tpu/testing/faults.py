@@ -6,6 +6,23 @@ consult the active :class:`FaultPlan` and raise the planned error when a
 site/key/invocation matches. No plan active (the normal case) is a
 single ``None`` check.
 
+**Serve-plane chaos sites** (ISSUE 7) extend the harness into the
+long-lived daemon, where the interesting failures are *partial* — the
+daemon must degrade, never die:
+
+- ``serve.journal`` (key = journal URI): the durable state write in
+  ``serve/state.py`` — an injected failure degrades durability (counted
+  in ``write_failures``) while serving continues;
+- ``serve.sweep`` (key = session id): TTL-expiry close in
+  ``SessionManager.sweep`` — a failed sweep leaves the session for the
+  next pass instead of wedging the caller;
+- ``serve.dispatch`` (key = job id): worker pickup in the job scheduler
+  — the fault lands on the job as a structured error, never as a dead
+  worker thread;
+- ``serve.http`` (key = ``"METHOD /path"``): request routing in the
+  daemon — the fault answers as a structured 500 and the connection
+  plane survives.
+
 The ``device.alloc`` site fires in the memory governor's pre-allocation
 gate (jax_backend/memory.py) with the placement TIER as its key, right
 before a frame's device arrays are staged. A spec matching ``"device"``
@@ -51,6 +68,21 @@ from contextlib import contextmanager
 from typing import Any, Callable, Dict, Iterator, List, Optional, Union
 
 _ErrorLike = Union[BaseException, Callable[[], BaseException], type]
+
+# the fault-point vocabulary embedded in production code, for plan
+# authors and the chaos tests' self-checks (a typo'd site in a spec
+# would otherwise silently never fire)
+KNOWN_SITES = (
+    "fs.open",
+    "fs.write",
+    "task",
+    "rpc",
+    "device.alloc",
+    "serve.journal",
+    "serve.sweep",
+    "serve.dispatch",
+    "serve.http",
+)
 
 
 class _InjectedXlaRuntimeError(Exception):

@@ -73,6 +73,11 @@ def classify_error(ex: BaseException, retry_on: Tuple[type, ...] = ()) -> str:
     """
     if isinstance(ex, retry_on):
         return TRANSIENT
+    # an error carrying a server backoff hint IS the server saying
+    # "transient, come back later" — the serving daemon's 503/429
+    # backpressure answers (ServeAPIError, AdmissionError) land here
+    if getattr(ex, "retry_after", None) is not None:
+        return TRANSIENT
     name = type(ex).__name__
     text = str(ex)
     if isinstance(ex, MemoryError):
@@ -191,24 +196,42 @@ class RetryPolicy:
 class CancelToken:
     """Cooperative cancellation: the runner sets it when a sibling fails
     or times out; cancellation points (task launch, backoff sleeps, user
-    extensions via ``TaskContext``) observe it and abort early."""
+    extensions via ``TaskContext``) observe it and abort early.
+
+    ``on_poll`` (optional) fires on every cancellation check: each poll
+    proves the holder is alive between device dispatches, so liveness
+    watchers (the serving daemon's heartbeat supervisor) ride on the
+    checks the fault layer already makes at task boundaries instead of
+    instrumenting every execution path."""
 
     def __init__(self) -> None:
         self._event = threading.Event()
+        self.on_poll: Optional[Callable[[], None]] = None
 
     def cancel(self) -> None:
         self._event.set()
 
+    def _polled(self) -> None:
+        cb = self.on_poll
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # pragma: no cover - observer must not break
+                pass
+
     @property
     def cancelled(self) -> bool:
+        self._polled()
         return self._event.is_set()
 
     def raise_if_cancelled(self) -> None:
+        self._polled()
         if self._event.is_set():
             raise TaskCancelledError("cancelled by a failing sibling task")
 
     def wait(self, seconds: float) -> bool:
         """Sleep up to ``seconds``; True if cancelled meanwhile."""
+        self._polled()
         return self._event.wait(seconds)
 
 

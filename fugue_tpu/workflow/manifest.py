@@ -44,6 +44,36 @@ from fugue_tpu.constants import (
 _FINGERPRINT_CHUNK = 4 * 1024 * 1024
 
 
+def atomic_json_write(fs: Any, uri: str, payload: Dict[str, Any]) -> None:
+    """Atomically rewrite ``uri`` with ``payload`` as indented JSON —
+    the crash-durability primitive shared by the run manifest and the
+    serving daemon's state journal (serve/state.py): a hard kill leaves
+    either the previous snapshot or the new one, never a torn file."""
+    data = json.dumps(payload, indent=1).encode("utf-8")
+    fs.write_file_atomic(uri, lambda fp: fp.write(data))
+
+
+def read_json(
+    fs: Any, uri: str, log: Any = None, what: str = "state file"
+) -> Optional[Dict[str, Any]]:
+    """Best-effort JSON read: None when the file is missing or
+    unreadable (recovery consumers treat that as 'no prior state').
+    Missing is silent; an EXISTING-but-unreadable file warns through
+    ``log`` — an operator debugging a from-scratch restart needs the
+    signal that prior state was there and got rejected."""
+    try:
+        if not fs.exists(uri):
+            return None
+        data = json.loads(fs.read_bytes(uri).decode("utf-8"))
+        if isinstance(data, dict):
+            return data
+    except Exception:
+        pass
+    if log is not None:
+        log.warning("fugue_tpu: %s %s unreadable; ignoring", what, uri)
+    return None
+
+
 def artifact_fingerprint(fs: Any, uri: str) -> Tuple[int, str]:
     """(total bytes, sha256 hexdigest) of a checkpoint artifact — a
     single file, or a part-file directory hashed as sorted
@@ -121,16 +151,11 @@ class RunManifest:
     def load(self) -> None:
         """Read a prior (killed/failed) run's manifest; its completed set
         becomes this run's resume candidates."""
-        fs = self._engine.fs
         uri = self.uri
-        try:
-            if not fs.exists(uri):
-                return
-            data = json.loads(fs.read_bytes(uri).decode("utf-8"))
-        except Exception:  # unreadable manifest: resume is best-effort
-            self._engine.log.warning(
-                "fugue_tpu resume: manifest %s unreadable; ignoring", uri
-            )
+        data = read_json(
+            self._engine.fs, uri, log=self._engine.log, what="resume manifest"
+        )
+        if data is None:  # missing or unreadable: resume is best-effort
             return
         if data.get("workflow") != self._wf_uuid:  # pragma: no cover
             return
@@ -210,13 +235,11 @@ class RunManifest:
             # an older snapshot LAST and drop a finished task from the
             # manifest a resume will trust
             self._completed[task.__uuid__()] = rec
-            payload = json.dumps(
-                {"workflow": self._wf_uuid, "completed": self._completed},
-                indent=1,
-            ).encode("utf-8")
             try:
-                self._engine.fs.write_file_atomic(
-                    self.uri, lambda fp: fp.write(payload)
+                atomic_json_write(
+                    self._engine.fs,
+                    self.uri,
+                    {"workflow": self._wf_uuid, "completed": self._completed},
                 )
             except Exception:  # pragma: no cover - manifest is best-effort
                 self._engine.log.warning(

@@ -306,6 +306,40 @@ def test_fwf402_retry_wraps_append_save_and_use():
     )
 
 
+def test_fwf403_daemon_target_without_resume():
+    # a durable serve state path marks the run as daemon-targeted: with
+    # resume off, a failed-over async job re-executes every task
+    dag = FugueWorkflow()
+    dag.df([[0]], "a:int").deterministic_checkpoint()
+    diags = _analyze(dag, conf={"fugue.serve.state_path": "/tmp/serve"})
+    d = _assert_diag(diags, "FWF403", Severity.WARN, needs_callsite=False)
+    assert "fugue.workflow.resume" in d.message
+    # string conf values are legitimate: "false" must still warn
+    assert any(
+        x.code == "FWF403"
+        for x in _analyze(
+            dag,
+            conf={
+                "fugue.serve.state_path": "/tmp/serve",
+                "fugue.workflow.resume": "false",
+            },
+        )
+    )
+    # resume on -> the failover is cheap: silent
+    assert not any(
+        x.code == "FWF403"
+        for x in _analyze(
+            dag,
+            conf={
+                "fugue.serve.state_path": "/tmp/serve",
+                "fugue.workflow.resume": True,
+            },
+        )
+    )
+    # no state path -> not daemon-targeted: silent
+    assert not any(x.code == "FWF403" for x in _analyze(dag))
+
+
 def test_analyze_with_live_engine_reads_engine_conf():
     # engine-dependent rules must read the LIVE engine's conf, not the
     # global defaults: an engine built with a row bucket has already
@@ -360,7 +394,7 @@ def test_every_rule_has_corpus_coverage():
     covered = {
         "FWF101", "FWF102", "FWF103", "FWF104", "FWF105", "FWF106",
         "FWF201", "FWF202", "FWF301", "FWF302", "FWF303", "FWF401",
-        "FWF402",
+        "FWF402", "FWF403",
     }
     assert {r.code for r in all_rules()} == covered
 

@@ -1,0 +1,275 @@
+"""Serve-plane chaos harness (ISSUE 7): the seeded kill-restart recovery
+test (4-tenant workload, hard kill mid-flight, restart rehydrates
+sessions/tables/jobs with no duplicated side effects) plus one
+deterministic injection test per serve chaos site (``serve.journal``,
+``serve.sweep``, ``serve.dispatch``, ``serve.http``). Tier-1 compatible;
+select with ``-m chaos``."""
+
+import logging
+import random
+import threading
+import time
+
+import pytest
+
+from fugue_tpu.constants import (
+    FUGUE_CONF_SERVE_BREAKER_THRESHOLD,
+    FUGUE_CONF_SERVE_DRAIN_TIMEOUT,
+    FUGUE_CONF_SERVE_MAX_CONCURRENT,
+    FUGUE_CONF_SERVE_STATE_PATH,
+)
+from fugue_tpu.serve import ServeAPIError, ServeClient, ServeDaemon
+from fugue_tpu.serve.session import SessionManager
+from fugue_tpu.testing.faults import (
+    KNOWN_SITES,
+    FaultPlan,
+    FaultSpec,
+    inject_faults,
+)
+
+pytestmark = [pytest.mark.serve, pytest.mark.chaos]
+
+_SEED = 20260803
+_AGG = "SELECT k, SUM(v) AS s FROM t GROUP BY k"
+_NO_BREAKER = {FUGUE_CONF_SERVE_BREAKER_THRESHOLD: 0}
+
+
+class _Gate:
+    """Deterministically block scheduler execution until released — the
+    chaos harness's way of freezing jobs mid-flight so the kill point is
+    exact, not racy."""
+
+    def __init__(self, daemon):
+        self._real = daemon.scheduler._execute
+        self.started = threading.Event()
+        self.release = threading.Event()
+        daemon.scheduler._execute = self
+        self._daemon = daemon
+
+    def __call__(self, job):
+        self.started.set()
+        self.release.wait(timeout=60)
+        return self._real(job)
+
+    def restore(self):
+        self.release.set()
+        self._daemon.scheduler._execute = self._real
+
+
+def _tenant_rows(i: int):
+    """Seeded per-tenant data: distinct values so a cross-tenant mixup
+    or a duplicated re-execution is visible in the aggregates."""
+    rng = random.Random(_SEED + i)
+    return [(k, rng.randrange(1, 1000)) for k in (0, 0, 1, 1, 2)]
+
+def _tenant_create(i: int) -> str:
+    cells = ",".join(f"[{k},{v}]" for k, v in _tenant_rows(i))
+    return f"CREATE [{cells}] SCHEMA k:long,v:long"
+
+def _tenant_expected(i: int):
+    sums = {}
+    for k, v in _tenant_rows(i):
+        sums[k] = sums.get(k, 0) + v
+    return sorted([k, s] for k, s in sums.items())
+
+
+# ---------------------------------------------------------------------------
+# the kill-restart acceptance test
+# ---------------------------------------------------------------------------
+def test_seeded_kill_restart_recovers_4_tenant_workload(tmp_path):
+    conf = dict(_NO_BREAKER)
+    conf[FUGUE_CONF_SERVE_STATE_PATH] = str(tmp_path / "state")
+    conf[FUGUE_CONF_SERVE_MAX_CONCURRENT] = 2
+    d1 = ServeDaemon(conf).start()
+    host, port = d1.address
+
+    # 4 tenants save seeded hot tables; a 5th short-TTL tenant will
+    # expire while the daemon is down (its interrupted job must FAIL
+    # OVER with a structured error, not resume)
+    tenants = []
+    for i in range(4):
+        c = ServeClient(host, port)
+        sid = c.create_session()
+        c.sql(sid, _tenant_create(i), save_as="t", collect=False)
+        tenants.append((c, sid))
+    c5 = ServeClient(host, port)
+    sid5 = c5.create_session(ttl=0.25)
+    c5.sql(sid5, _tenant_create(99), save_as="t", collect=False)
+
+    # freeze execution, then put one async agg per tenant mid-flight:
+    # with 2 workers, 2 jobs are RUNNING (gated) and the rest QUEUED
+    gate = _Gate(d1)
+    jids = {}
+    for i, (c, sid) in enumerate(tenants):
+        jids[i] = c.submit_async(sid, _AGG, save_as="agg")
+    jid5 = c5.submit_async(sid5, _AGG)
+    assert gate.started.wait(timeout=30)
+    assert d1.journal.describe()["pending_jobs"] == 5
+
+    # hard kill: no drain, no final journal write — the journal is
+    # incrementally crash-durable by construction
+    d1._hard_kill()
+    gate.restore()  # let the orphaned worker threads die harmlessly
+    time.sleep(0.3)  # TTL 0.25 of tenant 5 lapses while "down"
+
+    d2 = ServeDaemon(conf).start()
+    try:
+        c2 = ServeClient(*d2.address)
+        st = c2.status()
+        # every unexpired session rehydrated; every interrupted job
+        # resubmitted under its original id; the dead tenant's job
+        # failed over instead
+        assert st["recovery"] == {
+            "sessions": 4,
+            "jobs_resubmitted": 4,
+            "jobs_failed_over": 1,
+        }
+        for i, (_, sid) in enumerate(tenants):
+            snap = c2.wait(jids[i])
+            assert snap["status"] == "done", snap.get("error")
+            assert snap["recovered"] is True
+            # exact aggregate parity: nothing lost, nothing duplicated
+            assert sorted(snap["result"]["rows"]) == _tenant_expected(i)
+            # the integrity-verified hot table came back under the SAME
+            # session id, and the job's save_as side effect landed once
+            desc = c2.session(sid)
+            assert "t" in desc["tables"] and "agg" in desc["tables"]
+            saved = c2.sql(sid, "SELECT k, s FROM agg")
+            assert sorted(saved["result"]["rows"]) == _tenant_expected(i)
+        # the expired tenant: structured failover, no resurrection
+        snap5 = c2.job(jid5)
+        assert snap5["status"] == "error"
+        assert "did not survive" in snap5["error"]["message"]
+        with pytest.raises(ServeAPIError):
+            c2.session(sid5)
+        # all recovered jobs reached terminal states: journal drained
+        assert d2.journal.describe()["pending_jobs"] == 0
+    finally:
+        d2.stop()
+
+
+def test_drain_journals_state_before_engine_close(tmp_path):
+    conf = dict(_NO_BREAKER)
+    conf[FUGUE_CONF_SERVE_STATE_PATH] = str(tmp_path / "state")
+    conf[FUGUE_CONF_SERVE_DRAIN_TIMEOUT] = 10.0
+    d1 = ServeDaemon(conf).start()
+    c1 = ServeClient(*d1.address)
+    sid = c1.create_session()
+    c1.sql(sid, _tenant_create(0), save_as="t", collect=False)
+    d1.stop(drain=True)
+    assert d1.health_state == "stopped"
+    # the journal file exists and carries the session + table records
+    # written BEFORE the engine context closed
+    journal_file = tmp_path / "state" / "serve_state.json"
+    assert journal_file.exists()
+    text = journal_file.read_text()
+    assert sid in text and '"t"' in text
+    # and a restart proves the snapshot is complete
+    d2 = ServeDaemon(conf).start()
+    try:
+        c2 = ServeClient(*d2.address)
+        assert sorted(c2.sql(sid, _AGG)["result"]["rows"]) == (
+            _tenant_expected(0)
+        )
+    finally:
+        d2.stop()
+
+
+# ---------------------------------------------------------------------------
+# per-site injection: the daemon degrades, never dies
+# ---------------------------------------------------------------------------
+def test_journal_fault_degrades_durability_not_availability(tmp_path):
+    conf = dict(_NO_BREAKER)
+    conf[FUGUE_CONF_SERVE_STATE_PATH] = str(tmp_path / "state")
+    with ServeDaemon(conf) as daemon:
+        client = ServeClient(*daemon.address, retries=0)
+        plan = FaultPlan(
+            FaultSpec("serve.journal", times=1, error=OSError("disk gone")),
+            seed=_SEED,
+        )
+        with inject_faults(plan):
+            sid = client.create_session()  # journal write fails inside
+            assert plan.total("injected") == 1
+            # ... but the request succeeded and serving continues
+            st = client.status()
+            assert st["durable"]["write_failures"] == 1
+            snap = client.sql(sid, _tenant_create(1), save_as="t",
+                              collect=False)
+            assert snap["status"] == "done"
+        # the table save re-journaled the full snapshot: durable again
+        assert (tmp_path / "state" / "serve_state.json").exists()
+
+
+def test_sweep_fault_leaves_session_for_next_sweep():
+    class _StubSQL:
+        def drop_table(self, q):
+            pass
+
+    class _StubEngine:
+        sql_engine = _StubSQL()
+        log = logging.getLogger("test_chaos.sweep")
+
+    mgr = SessionManager(_StubEngine())
+    s = mgr.create(ttl=0.01)
+    time.sleep(0.05)
+    plan = FaultPlan(
+        FaultSpec("serve.sweep", match=s.session_id, times=1,
+                  error=OSError("catalog io")),
+        seed=_SEED,
+    )
+    with inject_faults(plan):
+        # first sweep hits the fault: the session is PUT BACK (its
+        # tables are still live, it must stay discoverable)
+        assert mgr.sweep() == 0
+        assert plan.total("injected") == 1
+        assert mgr.count() == 1
+        assert not s.closed
+        # next sweep succeeds
+        assert mgr.sweep() == 1
+        assert mgr.count() == 0
+        assert s.closed
+
+
+def test_dispatch_fault_lands_on_job_worker_survives():
+    conf = dict(_NO_BREAKER)
+    conf[FUGUE_CONF_SERVE_MAX_CONCURRENT] = 1
+    with ServeDaemon(conf) as daemon:
+        client = ServeClient(*daemon.address, retries=0)
+        sid = client.create_session()
+        plan = FaultPlan(
+            FaultSpec("serve.dispatch", times=1, error=OSError("chaos")),
+            seed=_SEED,
+        )
+        with inject_faults(plan):
+            snap = client.sql(sid, _tenant_create(2))
+            # the injected fault became a structured job error, not a
+            # dead worker thread...
+            assert snap["status"] == "error"
+            assert snap["error"]["error"] == "OSError"
+            assert plan.total("injected") == 1
+            # ...and the SAME worker serves the next job fine
+            assert client.sql(sid, _tenant_create(2))["status"] == "done"
+
+
+def test_http_fault_answers_structured_500_plane_survives():
+    with ServeDaemon(dict(_NO_BREAKER)) as daemon:
+        client = ServeClient(*daemon.address, retries=0)
+        plan = FaultPlan(
+            FaultSpec("serve.http", match="GET /v1/status", times=1,
+                      error=RuntimeError("router chaos")),
+            seed=_SEED,
+        )
+        with inject_faults(plan):
+            with pytest.raises(ServeAPIError) as ex:
+                client.status()
+            assert ex.value.status == 500
+            assert ex.value.error["error"] == "RuntimeError"
+            # the connection plane survived: same client, next request
+            assert client.status()["health"]["state"] == "healthy"
+            assert client.health() is True
+
+
+def test_serve_sites_are_in_the_known_vocabulary():
+    for site in ("serve.journal", "serve.sweep", "serve.dispatch",
+                 "serve.http"):
+        assert site in KNOWN_SITES

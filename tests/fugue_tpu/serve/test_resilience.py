@@ -1,0 +1,563 @@
+"""Serving-layer resilience (ISSUE 7): durable daemon state + restart
+rehydration, graceful drain semantics, backpressure/admission control,
+circuit breakers (trip + half-open recovery), heartbeat supervision, job
+payload TTL GC, client transient retry, and the FWF403 analyzer rule.
+Tier-1 compatible; select with ``-m serve``."""
+
+import threading
+import time
+
+import pytest
+
+from fugue_tpu.analysis.analyzer import Analyzer
+from fugue_tpu.analysis.conf_pass import DaemonResumeOffRule
+from fugue_tpu.constants import (
+    FUGUE_CONF_SERVE_BREAKER_COOLDOWN,
+    FUGUE_CONF_SERVE_BREAKER_THRESHOLD,
+    FUGUE_CONF_SERVE_DRAIN_TIMEOUT,
+    FUGUE_CONF_SERVE_HEARTBEAT_TIMEOUT,
+    FUGUE_CONF_SERVE_JOB_TTL,
+    FUGUE_CONF_SERVE_MAX_CONCURRENT,
+    FUGUE_CONF_SERVE_MAX_QUEUE,
+    FUGUE_CONF_SERVE_MEMORY_REJECT,
+    FUGUE_CONF_SERVE_SESSION_MAX_JOBS,
+    FUGUE_CONF_SERVE_STATE_PATH,
+    FUGUE_CONF_SERVE_SYNC_DEGRADE_DEPTH,
+)
+from fugue_tpu.serve import ServeAPIError, ServeClient, ServeDaemon
+from fugue_tpu.serve.supervisor import CircuitBreaker, CircuitOpenError
+from fugue_tpu.sql_frontend.workflow_sql import fugue_sql_flow
+
+pytestmark = pytest.mark.serve
+
+_CREATE = "CREATE [[0,1],[0,2],[1,3],[1,4]] SCHEMA k:long,v:long"
+_AGG = "SELECT k, SUM(v) AS s FROM t GROUP BY k"
+
+# breakers off by default in these fixtures so unrelated failures never
+# interfere; breaker tests opt in explicitly
+_NO_BREAKER = {FUGUE_CONF_SERVE_BREAKER_THRESHOLD: 0}
+
+
+class _Gate:
+    """Deterministically block scheduler execution until released."""
+
+    def __init__(self, daemon):
+        self._real = daemon.scheduler._execute
+        self.started = threading.Event()
+        self.release = threading.Event()
+        daemon.scheduler._execute = self
+        self._daemon = daemon
+
+    def __call__(self, job):
+        self.started.set()
+        self.release.wait(timeout=60)
+        return self._real(job)
+
+    def restore(self):
+        self.release.set()
+        self._daemon.scheduler._execute = self._real
+
+
+# ---------------------------------------------------------------------------
+# graceful drain
+# ---------------------------------------------------------------------------
+def test_drain_completes_inflight_and_rejects_new_with_503():
+    conf = dict(_NO_BREAKER)
+    conf[FUGUE_CONF_SERVE_MAX_CONCURRENT] = 1
+    conf[FUGUE_CONF_SERVE_DRAIN_TIMEOUT] = 30.0
+    daemon = ServeDaemon(conf).start()
+    client = ServeClient(*daemon.address, retries=0)
+    sid = client.create_session()
+    gate = _Gate(daemon)
+    try:
+        jid = client.submit_async(sid, _CREATE)
+        assert gate.started.wait(timeout=30)
+        drainer = threading.Thread(
+            target=daemon.stop, kwargs={"drain": True}
+        )
+        drainer.start()
+        # draining: status still served, health flips, new submits 503
+        deadline = time.monotonic() + 10
+        while daemon.health_state != "draining":
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        with pytest.raises(ServeAPIError) as ex:
+            client.sql(sid, _CREATE)
+        assert ex.value.status == 503
+        assert ex.value.retry_after is not None  # Retry-After header
+        assert ex.value.error["error"] == "BackpressureError"
+        with pytest.raises(ServeAPIError) as ex:
+            client.create_session()
+        assert ex.value.status == 503
+        # /v1/health answers 503 while draining (LB vocabulary)
+        with pytest.raises(ServeAPIError) as ex:
+            client.health()
+        assert ex.value.status == 503
+        # the in-flight job is allowed to finish...
+        gate.release.set()
+        drainer.join(timeout=30)
+        assert not drainer.is_alive()
+        # ...and did: drained, not abandoned
+        assert daemon._drain_result == {"completed": 1, "abandoned": 0}
+        assert daemon.scheduler.get(jid).status == "done"
+        assert daemon.health_state == "stopped"
+    finally:
+        gate.restore()
+        daemon.stop()
+
+
+def test_drain_deadline_abandons_wedged_job():
+    conf = dict(_NO_BREAKER)
+    conf[FUGUE_CONF_SERVE_MAX_CONCURRENT] = 1
+    conf[FUGUE_CONF_SERVE_DRAIN_TIMEOUT] = 0.4
+    daemon = ServeDaemon(conf).start()
+    client = ServeClient(*daemon.address, retries=0)
+    sid = client.create_session()
+    gate = _Gate(daemon)  # never released until cleanup: a wedged job
+    try:
+        jid = client.submit_async(sid, _CREATE)
+        assert gate.started.wait(timeout=30)
+        t0 = time.monotonic()
+        daemon.stop(drain=True)
+        # the deadline bounded the drain (0.4s + 1s cancel grace)
+        assert time.monotonic() - t0 < 10
+        assert daemon._drain_result["abandoned"] == 1
+        job = daemon.scheduler.get(jid)
+        assert job.token.cancelled  # the straggler was cancelled
+    finally:
+        gate.restore()
+        daemon.stop()
+
+
+def test_daemon_engine_never_ambient_even_after_cross_thread_stop():
+    # the daemon RETAINS its engine instead of entering it as a context:
+    # as_context's token stack is per-thread, so a stop(drain=True) from
+    # a drain thread / signal handler used to leave the STARTING
+    # thread's ambient context engine pointing at the stopped daemon
+    # engine — and every later engineless dag.run() on that thread would
+    # silently use (and mutate the conf of) the dead engine
+    from fugue_tpu.execution.factory import try_get_context_engine
+
+    daemon = ServeDaemon(dict(_NO_BREAKER)).start()
+    try:
+        assert try_get_context_engine() is not daemon.engine
+        stopper = threading.Thread(
+            target=daemon.stop, kwargs={"drain": True}
+        )
+        stopper.start()
+        stopper.join(timeout=30)
+        assert not stopper.is_alive()
+        assert daemon.health_state == "stopped"
+        assert try_get_context_engine() is not daemon.engine
+    finally:
+        daemon.stop()
+
+
+# ---------------------------------------------------------------------------
+# backpressure & admission
+# ---------------------------------------------------------------------------
+def test_queue_full_rejects_503_with_retry_after():
+    conf = dict(_NO_BREAKER)
+    conf[FUGUE_CONF_SERVE_MAX_CONCURRENT] = 1
+    conf[FUGUE_CONF_SERVE_MAX_QUEUE] = 1
+    with ServeDaemon(conf) as daemon:
+        client = ServeClient(*daemon.address, retries=0)
+        sid = client.create_session()
+        gate = _Gate(daemon)
+        try:
+            client.submit_async(sid, _CREATE)  # running (gated)
+            assert gate.started.wait(timeout=30)
+            client.submit_async(sid, _CREATE)  # queued: backlog = 1
+            with pytest.raises(ServeAPIError) as ex:
+                client.submit_async(sid, _CREATE)
+            assert ex.value.status == 503
+            assert ex.value.retry_after is not None
+            st = client.status()
+            assert st["backpressure"]["rejections"]["queue_full"] == 1
+            assert st["backpressure"]["queue_depth"] == 1
+        finally:
+            gate.restore()
+
+
+def test_session_cap_rejects_429_other_sessions_unaffected():
+    conf = dict(_NO_BREAKER)
+    conf[FUGUE_CONF_SERVE_MAX_CONCURRENT] = 1
+    conf[FUGUE_CONF_SERVE_SESSION_MAX_JOBS] = 1
+    with ServeDaemon(conf) as daemon:
+        client = ServeClient(*daemon.address, retries=0)
+        sid = client.create_session()
+        other = client.create_session()
+        gate = _Gate(daemon)
+        try:
+            client.submit_async(sid, _CREATE)
+            assert gate.started.wait(timeout=30)
+            with pytest.raises(ServeAPIError) as ex:
+                client.submit_async(sid, _CREATE)
+            assert ex.value.status == 429
+            assert ex.value.error["error"] == "SessionBusyError"
+            # the cap is per session: another tenant still gets through
+            client.submit_async(other, _CREATE)
+            st = client.status()
+            assert st["backpressure"]["rejections"]["session_cap"] == 1
+        finally:
+            gate.restore()
+
+
+def test_memory_pressure_rejects_503():
+    conf = dict(_NO_BREAKER)
+    conf[FUGUE_CONF_SERVE_MEMORY_REJECT] = 0.8
+    with ServeDaemon(conf) as daemon:
+        client = ServeClient(*daemon.address, retries=0)
+        sid = client.create_session()
+        client.sql(sid, _CREATE, save_as="t", collect=False)
+        daemon.memory_pressure = lambda: 0.95  # ledger says: over the line
+        with pytest.raises(ServeAPIError) as ex:
+            client.sql(sid, _AGG)
+        assert ex.value.status == 503
+        assert "pressure" in ex.value.error["message"]
+        daemon.memory_pressure = lambda: 0.2  # pressure relieved
+        assert client.sql(sid, _AGG)["status"] == "done"
+
+
+def test_sync_degrades_to_async_under_load():
+    conf = dict(_NO_BREAKER)
+    conf[FUGUE_CONF_SERVE_MAX_CONCURRENT] = 1
+    conf[FUGUE_CONF_SERVE_SYNC_DEGRADE_DEPTH] = 1
+    with ServeDaemon(conf) as daemon:
+        client = ServeClient(*daemon.address, retries=0)
+        sid = client.create_session()
+        gate = _Gate(daemon)
+        try:
+            client.submit_async(sid, _CREATE)  # running (gated)
+            assert gate.started.wait(timeout=30)
+            client.submit_async(sid, _CREATE)  # queued: depth = 1
+            # a raw sync submit now answers 202 + job id instead of
+            # parking the HTTP worker behind the queue
+            status, snap, _ = daemon.handle_api(
+                "POST", f"/v1/sessions/{sid}/sql", {"sql": _CREATE}
+            )
+            assert status == 202
+            assert snap["degraded_to_async"] is True
+            gate.release.set()
+            # the client helper keeps sync semantics by polling
+            assert client.wait(snap["job_id"])["status"] == "done"
+            st = client.status()
+            assert st["backpressure"]["rejections"]["sync_degraded"] == 1
+        finally:
+            gate.restore()
+
+
+# ---------------------------------------------------------------------------
+# circuit breakers
+# ---------------------------------------------------------------------------
+def test_session_breaker_trips_and_half_open_recovers():
+    conf = {
+        FUGUE_CONF_SERVE_BREAKER_THRESHOLD: 2,
+        FUGUE_CONF_SERVE_BREAKER_COOLDOWN: 0.3,
+    }
+    with ServeDaemon(conf) as daemon:
+        client = ServeClient(*daemon.address, retries=0)
+        sid = client.create_session()
+        bad = "SELECT x FROM missing_table"
+        for _ in range(2):
+            assert client.sql(sid, bad)["status"] == "error"
+        # tripped: the next submit is refused without touching the engine
+        with pytest.raises(ServeAPIError) as ex:
+            client.sql(sid, _CREATE)
+        assert ex.value.status == 503
+        assert ex.value.error["error"] == "CircuitOpenError"
+        assert ex.value.retry_after is not None
+        st = client.status()
+        assert st["supervisor"]["breakers"]["trips"] >= 1
+        assert any(
+            b["key"] == f"session:{sid}" and b["state"] == "open"
+            for b in st["supervisor"]["breakers"]["open"]
+        )
+        # cooldown elapses -> half-open admits ONE probe; its success
+        # closes the SESSION breaker and the session serves normally
+        # again — the poison query's own fingerprint breaker stays
+        # quarantined (nothing probed it)
+        time.sleep(0.35)
+        assert client.sql(sid, _CREATE)["status"] == "done"
+        assert client.sql(sid, _CREATE)["status"] == "done"
+        st = client.status()
+        open_keys = [b["key"] for b in st["supervisor"]["breakers"]["open"]]
+        assert f"session:{sid}" not in open_keys
+        assert any(k.startswith("query:") for k in open_keys)
+
+
+def test_cancelled_probe_releases_half_open_slot():
+    from fugue_tpu.serve.supervisor import EngineSupervisor
+
+    sup = EngineSupervisor(threshold=1, cooldown=0.05)
+    sup.note_result("s1", "q1", failed=True)  # trips both breakers
+    time.sleep(0.07)
+    sup.admit_session("s1")  # half-open: probe slot claimed
+    # probe job cancelled -> verdict-free, but the slot must go back
+    with pytest.raises(CircuitOpenError):
+        sup.admit_session("s1")  # slot busy
+    sup.note_cancelled("s1", "q1")
+    sup.admit_session("s1")  # re-probe allowed, not wedged forever
+    sup.note_result("s1", "q1", failed=False)
+    sup.admit_session("s1")  # closed again
+
+
+def test_breaker_registry_does_not_grow_on_successes():
+    from fugue_tpu.serve.supervisor import EngineSupervisor
+
+    sup = EngineSupervisor(threshold=3, cooldown=1.0)
+    for i in range(100):
+        sup.admit_session(f"s{i}")  # lookup-only on the hot path
+        sup.note_result(f"s{i}", f"fp{i}", failed=False)
+    assert sup.breaker_stats()["total"] == 0  # successes allocate nothing
+    sup.note_result("s0", "fp0", failed=True)  # failures do
+    assert sup.breaker_stats()["total"] == 2
+
+
+def test_token_polls_count_as_heartbeats():
+    from fugue_tpu.serve.scheduler import ServeJob
+
+    job = ServeJob("s", "SELECT 1")
+    assert job.heartbeat_age is None
+    # a cooperative cancellation check from inside the run IS liveness:
+    # long multi-task queries beat between dispatches via the token
+    job.token.raise_if_cancelled()
+    assert job.heartbeat_age is not None and job.heartbeat_age < 1.0
+
+
+def test_half_open_failure_reopens():
+    br = CircuitBreaker("session:x", threshold=1, cooldown=0.1)
+    br.record_failure()
+    with pytest.raises(CircuitOpenError):
+        br.allow()
+    time.sleep(0.12)
+    br.allow()  # the half-open probe slot
+    with pytest.raises(CircuitOpenError):
+        br.allow()  # second concurrent probe is refused
+    br.record_failure()  # probe failed: re-open, fresh cooldown
+    assert br.state == "open"
+    assert br.trips == 2
+    with pytest.raises(CircuitOpenError):
+        br.allow()
+
+
+def test_poison_query_quarantined_with_structured_error():
+    conf = {
+        FUGUE_CONF_SERVE_BREAKER_THRESHOLD: 2,
+        FUGUE_CONF_SERVE_BREAKER_COOLDOWN: 30.0,
+    }
+    with ServeDaemon(conf) as daemon:
+        client = ServeClient(*daemon.address, retries=0)
+        sid = client.create_session()
+        bad = "SELECT x FROM missing_table"
+        # interleave successes so the SESSION breaker never trips while
+        # the QUERY fingerprint accumulates consecutive failures
+        assert client.sql(sid, bad)["status"] == "error"
+        assert client.sql(sid, _CREATE)["status"] == "done"
+        assert client.sql(sid, bad)["status"] == "error"
+        assert client.sql(sid, _CREATE)["status"] == "done"
+        # quarantined: the job answers the breaker's structured error
+        # immediately instead of re-executing the poison query
+        snap = client.sql(sid, bad)
+        assert snap["status"] == "error"
+        assert snap["error"]["error"] == "PoisonQueryError"
+        assert "quarantined" in snap["error"]["message"]
+        # other queries in the same session are unaffected
+        assert client.sql(sid, _CREATE)["status"] == "done"
+
+
+# ---------------------------------------------------------------------------
+# heartbeat supervision
+# ---------------------------------------------------------------------------
+def test_supervisor_cancels_wedged_job_by_heartbeat():
+    conf = dict(_NO_BREAKER)
+    conf[FUGUE_CONF_SERVE_MAX_CONCURRENT] = 1
+    conf[FUGUE_CONF_SERVE_HEARTBEAT_TIMEOUT] = 0.3
+    with ServeDaemon(conf) as daemon:
+        client = ServeClient(*daemon.address, retries=0)
+        sid = client.create_session()
+        gate = _Gate(daemon)  # blocks WITHOUT beating: a wedged dispatch
+        try:
+            jid = client.submit_async(sid, _CREATE)
+            assert gate.started.wait(timeout=30)
+            snap = client.wait(jid)
+            assert snap["status"] == "cancelled"
+            assert daemon.supervisor.wedged_jobs >= 1
+            st = client.status()
+            assert st["supervisor"]["wedged_jobs_cancelled"] >= 1
+        finally:
+            gate.restore()
+
+
+# ---------------------------------------------------------------------------
+# job payload TTL GC
+# ---------------------------------------------------------------------------
+def test_job_payload_ttl_evicts_result_keeps_status():
+    conf = dict(_NO_BREAKER)
+    conf[FUGUE_CONF_SERVE_JOB_TTL] = 0.5
+    with ServeDaemon(conf) as daemon:
+        client = ServeClient(*daemon.address, retries=0)
+        sid = client.create_session()
+        client.sql(sid, _CREATE, save_as="t", collect=False)
+        snap = client.sql(sid, _AGG)
+        jid = snap["job_id"]
+        assert "result" in client.job(jid)
+        time.sleep(0.7)
+        # the supervisor tick runs the GC in the background; the manual
+        # call just guarantees at least one pass after the TTL elapsed
+        daemon.scheduler.gc_payloads()
+        after = client.job(jid)
+        assert after["status"] == "done"  # status survives
+        assert "result" not in after  # payload evicted
+        assert "seconds" in after  # timings survive
+
+
+# ---------------------------------------------------------------------------
+# durable state: restart rehydration
+# ---------------------------------------------------------------------------
+def test_restart_rehydrates_sessions_and_hot_tables(tmp_path):
+    conf = dict(_NO_BREAKER)
+    conf[FUGUE_CONF_SERVE_STATE_PATH] = str(tmp_path / "state")
+    d1 = ServeDaemon(conf).start()
+    c1 = ServeClient(*d1.address)
+    sid = c1.create_session()
+    c1.sql(sid, _CREATE, save_as="t", collect=False)
+    expected = sorted(c1.sql(sid, _AGG)["result"]["rows"])
+    d1.stop()  # graceful stop KEEPS the journal + artifacts
+
+    d2 = ServeDaemon(conf).start()
+    try:
+        c2 = ServeClient(*d2.address)
+        st = c2.status()
+        assert st["recovery"]["sessions"] == 1
+        desc = c2.session(sid)  # the SAME session id survives
+        assert desc["restored"] is True
+        assert desc["tables"] == ["t"]
+        assert desc["tables_pending_reload"] == ["t"]  # lazy until used
+        # first query reloads the integrity-verified artifact
+        assert sorted(c2.sql(sid, _AGG)["result"]["rows"]) == expected
+        assert c2.session(sid)["tables_pending_reload"] == []
+        c2.close_session(sid)
+    finally:
+        d2.stop()
+    # user close FORGOT the session: a third daemon starts empty
+    d3 = ServeDaemon(conf).start()
+    try:
+        assert d3.sessions.count() == 0
+    finally:
+        d3.stop()
+
+
+def test_corrupt_artifact_is_integrity_rejected_on_reload(tmp_path):
+    conf = dict(_NO_BREAKER)
+    conf[FUGUE_CONF_SERVE_STATE_PATH] = str(tmp_path / "state")
+    d1 = ServeDaemon(conf).start()
+    c1 = ServeClient(*d1.address)
+    sid = c1.create_session()
+    c1.sql(sid, _CREATE, save_as="t", collect=False)
+    d1.stop()
+    # bit-rot the artifact while the daemon is down
+    artifact = tmp_path / "state" / "tables" / sid / "t.parquet"
+    assert artifact.exists()
+    artifact.write_bytes(artifact.read_bytes()[:-7] + b"garbage")
+
+    d2 = ServeDaemon(conf).start()
+    try:
+        c2 = ServeClient(*d2.address)
+        # the reload rejects the artifact: the table is forgotten, the
+        # query fails structurally (unknown table), nothing serves garbage
+        snap = c2.sql(sid, _AGG)
+        assert snap["status"] == "error"
+        assert d2.sessions.get(sid).integrity_rejected == 1
+        assert c2.session(sid)["tables"] == []
+        assert not artifact.exists()  # removed like manifest resume does
+        st = c2.status()
+        assert st["fault_stats"]["integrity_rejected"] >= 1
+    finally:
+        d2.stop()
+
+
+def test_read_only_touches_reach_the_journal_via_flush(tmp_path):
+    import json as _json
+
+    conf = dict(_NO_BREAKER)
+    conf[FUGUE_CONF_SERVE_STATE_PATH] = str(tmp_path / "state")
+    with ServeDaemon(conf) as daemon:
+        client = ServeClient(*daemon.address, retries=0)
+        sid = client.create_session(ttl=3600)
+        client.sql(sid, _CREATE, save_as="t", collect=False)
+        journal_file = tmp_path / "state" / "serve_state.json"
+        before = _json.loads(journal_file.read_text())
+        t0 = before["sessions"][sid]["last_used"]
+        time.sleep(0.05)
+        client.sql(sid, _AGG)  # read-only: touches, no journal mutation
+        daemon.journal.maybe_flush(min_interval=0.0)
+        after = _json.loads(journal_file.read_text())
+        # the touch reached disk, so a restart sees the session ACTIVE
+        # (not idle-since-creation) and will not wrongly expire it
+        assert after["sessions"][sid]["last_used"] > t0
+
+
+# ---------------------------------------------------------------------------
+# client retry
+# ---------------------------------------------------------------------------
+def test_client_retries_transient_503_honoring_retry_after():
+    import http.server
+    import json as _json
+    import threading as _threading
+
+    hits = []
+
+    class _Flaky(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            hits.append(time.monotonic())
+            if len(hits) == 1:
+                body = b'{"error": {"error": "BackpressureError"}}'
+                self.send_response(503)
+                self.send_header("Retry-After", "0.2")
+            else:
+                body = _json.dumps({"ok": True}).encode()
+                self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    server = http.server.HTTPServer(("127.0.0.1", 0), _Flaky)
+    thread = _threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        client = ServeClient("127.0.0.1", server.server_address[1], retries=2)
+        assert client.health() is True
+        assert len(hits) == 2  # one 503, one success
+        assert hits[1] - hits[0] >= 0.2  # honored the server's hint
+        # retries=0 fails fast with the structured error
+        strict = ServeClient(
+            "127.0.0.1", server.server_address[1], retries=0
+        )
+        hits.clear()
+        with pytest.raises(ServeAPIError) as ex:
+            strict.health()
+        assert ex.value.status == 503
+        assert ex.value.retry_after == pytest.approx(0.2)
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# FWF403: daemon-targeted workflow without resume
+# ---------------------------------------------------------------------------
+def test_fwf403_warns_on_durable_daemon_without_resume():
+    dag = fugue_sql_flow(_CREATE)
+    conf = {FUGUE_CONF_SERVE_STATE_PATH: "/tmp/serve-state"}
+    diags = Analyzer([DaemonResumeOffRule]).analyze(dag, conf=conf)
+    assert [d.code for d in diags] == ["FWF403"]
+    assert "fugue.workflow.resume" in diags[0].message
+    # resume on -> clean
+    conf["fugue.workflow.resume"] = True
+    assert Analyzer([DaemonResumeOffRule]).analyze(dag, conf=conf) == []
+    # no state path -> not daemon-targeted -> clean
+    assert Analyzer([DaemonResumeOffRule]).analyze(dag, conf={}) == []
