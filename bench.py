@@ -312,6 +312,77 @@ def _governance_overhead(
     }
 
 
+def _observability_overhead(
+    pdf: Any, jax_udf: Callable, n_rows: int
+) -> Dict[str, Any]:
+    """Observability overhead block (ISSUE 8): the SAME workflow
+    pipeline (transform + partitioned aggregate through
+    ``FugueWorkflow.run``, which is where the span instrumentation
+    lives) on an obs-ON engine (tracing enabled, per-run Chrome-trace
+    export to ``memory://``) vs an obs-OFF engine. The obs-on run must
+    stay within 1.05x of obs-off — a regression here means span/metric
+    instrumentation leaked onto the hot path."""
+    from fugue_tpu.column import col
+    from fugue_tpu.column import functions as ff
+    from fugue_tpu.execution import make_execution_engine
+    from fugue_tpu.workflow.workflow import FugueWorkflow
+
+    rows = min(int(n_rows), 2_000_000)  # per-iteration ingest: bound it
+    sub = pdf.iloc[:rows]
+
+    def run_on(eng: Any) -> float:
+        def once() -> None:
+            dag = FugueWorkflow()
+            df = dag.df(sub)
+            out = df.transform(jax_udf, schema="k:int,v2:float")
+            agg = out.partition_by("k").aggregate(
+                s=ff.sum(col("v2")), m=ff.avg(col("v2")),
+                c=ff.count(col("v2")),
+            )
+            agg.yield_dataframe_as("res", as_local=True)
+            dag.run(eng)["res"].as_array()
+
+        return _timed(once, warm=3)
+
+    obs_off = make_execution_engine("jax")
+    obs_on = make_execution_engine(
+        "jax",
+        {
+            "fugue.obs.enabled": True,
+            "fugue.obs.trace_path": "memory://bench_obs_traces",
+        },
+    )
+    obs_off_secs = run_on(obs_off)
+    obs_on_secs = run_on(obs_on)
+    ratio = obs_on_secs / max(obs_off_secs, 1e-9)
+    within_noise = ratio <= 1.05
+    if not within_noise:
+        import sys
+
+        print(
+            f"WARNING: obs-on run {ratio:.2f}x the obs-off run "
+            "(> 1.05 band) — observability overhead regressed",
+            file=sys.stderr,
+        )
+    snap = obs_on.metrics.snapshot()
+    exported = sum(
+        s["value"]
+        for s in (
+            snap.get("fugue_obs_traces_exported_total", {}).get("samples")
+            or []
+        )
+    )
+    return {
+        "rows": rows,
+        "obs_on_secs": round(obs_on_secs, 4),
+        "obs_off_secs": round(obs_off_secs, 4),
+        "overhead_ratio": round(ratio, 3),
+        "within_noise": within_noise,
+        "traces_exported": int(exported),
+        "compile_cache": obs_on.compile_cache_stats,
+    }
+
+
 def _bench_headline() -> Dict[str, Any]:
     import jax
     import jax.numpy as jnp
@@ -417,6 +488,12 @@ def _bench_headline() -> Dict[str, Any]:
         n_native,
     )
 
+    observability_block = _observability_overhead(
+        pd.DataFrame({"k": keys[:n_native], "v": values[:n_native]}),
+        jax_udf,
+        n_native,
+    )
+
     return {
         "metric": "transform_groupby_rows_per_sec",
         "value": round(jax_rps, 1),
@@ -438,6 +515,7 @@ def _bench_headline() -> Dict[str, Any]:
             "roofline": roofline,
             "strategy_counts": dict(engine.strategy_counts),
             "memory": memory_block,
+            "observability": observability_block,
             "devices": len(jax.devices()),
             "platform": jax.devices()[0].platform,
             "notes": (

@@ -15,6 +15,8 @@ from fugue_tpu.analysis.diagnostics import (
     register_rule,
 )
 from fugue_tpu.constants import (
+    FUGUE_CONF_OBS_ENABLED,
+    FUGUE_CONF_OBS_TRACE_PATH,
     FUGUE_CONF_SERVE_STATE_PATH,
     FUGUE_CONF_WORKFLOW_RESUME,
     declared_conf_keys,
@@ -96,4 +98,37 @@ class DaemonResumeOffRule(Rule):
                 "job re-executes every task instead of resuming at its "
                 "checkpoint frontier — set fugue.workflow.resume=true (and "
                 "a fugue.workflow.checkpoint.path) for cheap failover",
+            )
+
+
+@register_rule
+class ObsTracePathWithoutObsRule(Rule):
+    code = "FWF404"
+    severity = Severity.WARN
+    description = (
+        "fugue.obs.trace_path is set but fugue.obs.enabled is off: "
+        "no trace file will ever be written"
+    )
+
+    def check(self, ctx: Any) -> Iterable[Diagnostic]:
+        trace_path = str(
+            ctx.conf.get(FUGUE_CONF_OBS_TRACE_PATH, "") or ""
+        ).strip()
+        if trace_path == "":
+            return
+        try:
+            # _convert, not bool(): conf values legitimately arrive as
+            # strings, and bool("false") is True
+            enabled = _convert(
+                ctx.conf.get(FUGUE_CONF_OBS_ENABLED, False), bool
+            )
+        except Exception:
+            enabled = False
+        if not enabled:
+            yield self.diag(
+                f"fugue.obs.trace_path is set to '{trace_path}' but "
+                "fugue.obs.enabled is off: no trace is ever opened, so "
+                "no trace file will be written there — set "
+                "fugue.obs.enabled=true to get per-run Chrome-trace "
+                "JSON (or drop the trace_path)",
             )

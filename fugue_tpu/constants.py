@@ -68,6 +68,10 @@ FUGUE_CONF_SERVE_BREAKER_COOLDOWN = "fugue.serve.breaker.cooldown"
 FUGUE_CONF_SERVE_HEARTBEAT_TIMEOUT = "fugue.serve.heartbeat_timeout"
 FUGUE_CONF_SERVE_JOB_TTL = "fugue.serve.job_ttl"
 FUGUE_CONF_SERVE_CLIENT_RETRIES = "fugue.serve.client.retries"
+FUGUE_CONF_OBS_ENABLED = "fugue.obs.enabled"
+FUGUE_CONF_OBS_TRACE_PATH = "fugue.obs.trace_path"
+FUGUE_CONF_OBS_SLOW_QUERY_MS = "fugue.obs.slow_query_ms"
+FUGUE_CONF_OBS_SAMPLE_RATE = "fugue.obs.sample_rate"
 
 FUGUE_COMPILE_TIME_CONFIGS = {
     FUGUE_CONF_WORKFLOW_EXCEPTION_HIDE,
@@ -468,6 +472,43 @@ def _declare_defaults() -> None:
         2,
         "ServeClient retries on transient transport failures and "
         "503/429 backpressure answers (honors server Retry-After)",
+        in_defaults=False,
+    )
+    # unified observability plane (fugue_tpu/obs): request-scoped span
+    # tracing + metrics registry + Perfetto/Prometheus export. With
+    # enabled=False every instrumentation site is an allocation-free
+    # no-op (the hot-path contract the zero-overhead test enforces).
+    # Module-owned like the serve keys: read via typed_conf_get, not
+    # seeded into the global defaults.
+    r(
+        FUGUE_CONF_OBS_ENABLED,
+        bool,
+        False,
+        "request-scoped span tracing: off = every instrumentation site "
+        "is an allocation-free no-op",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_OBS_TRACE_PATH,
+        str,
+        "",
+        "dir/URI (via engine.fs) for per-trace Chrome-trace JSON files "
+        "loadable in Perfetto ('' = no trace files)",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_OBS_SLOW_QUERY_MS,
+        float,
+        0.0,
+        "jobs/runs slower than this log one structured record with "
+        "their span breakdown (0 = off)",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_OBS_SAMPLE_RATE,
+        float,
+        1.0,
+        "fraction of eligible requests/runs that open a trace",
         in_defaults=False,
     )
 

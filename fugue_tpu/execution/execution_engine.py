@@ -168,6 +168,7 @@ class ExecutionEngine(FugueEngineBase):
         self._map_engine: Optional[MapEngine] = None
         self._sql_engine: Optional[SQLEngine] = None
         self._fs: Optional[Any] = None
+        self._metrics: Optional[Any] = None
         self._in_context_count = 0
         self._is_global = False
         # ContextVar tokens must be reset by the thread that created them,
@@ -334,6 +335,21 @@ class ExecutionEngine(FugueEngineBase):
         from fugue_tpu.fs import make_default_registry
 
         return make_default_registry()
+
+    # ---- observability ---------------------------------------------------
+    @property
+    def metrics(self) -> Any:
+        """The engine's :class:`~fugue_tpu.obs.metrics.MetricsRegistry`
+        — the ONE registry every counter surface of this engine (and of
+        a serving daemon built on it) registers into. Per-engine by
+        design: two engines in one process never share counters. Lazily
+        created; always available regardless of ``fugue.obs.enabled``
+        (the back-compat dict accessors read through it)."""
+        if self._metrics is None:
+            from fugue_tpu.obs.metrics import MetricsRegistry
+
+            self._metrics = MetricsRegistry()
+        return self._metrics
 
     # ---- fault tolerance -------------------------------------------------
     @property

@@ -72,6 +72,7 @@ stays silent after the degrade override re-places onto the host tier.
 
 import re
 import threading
+import time
 import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -90,6 +91,7 @@ from fugue_tpu.jax_backend.blocks import (
     device_nbytes,
     row_sharding,
 )
+from fugue_tpu.obs.trace import begin_span, current_span
 from fugue_tpu.testing.faults import fault_point
 
 # CPU-backend default when the platform reports no memory stats: tests
@@ -244,18 +246,53 @@ class AllocationGate:
     frames (``JaxDataFrame._mem_gate``) so lazy ingest pays admission at
     materialization time, when the ledger state is current."""
 
-    __slots__ = ("_gov", "tier", "est")
+    __slots__ = ("_gov", "tier", "est", "_t0", "_obs_parent")
 
     def __init__(self, gov: "MemoryGovernor", tier: str, est: int):
         self._gov = gov
         self.tier = tier
         self.est = est
+        self._t0: Any = None
+        self._obs_parent: Any = None
 
     def before(self) -> None:
+        # the before→after window IS the host→device (or host-tier)
+        # staging of one frame. The span is NOT opened here: gates are
+        # shared across derived frames and stay armed after a raised
+        # alloc failure (see jax_backend/dataframe.py), so an open span
+        # with no guaranteed after() would leak and pin its trace
+        # incomplete. Instead the window's start and the ambient span
+        # are stamped, and after() emits one BACKDATED engine.transfer
+        # span — begin/clobber/abandon all degrade to "no span".
+        self._obs_parent = current_span()
+        if self._obs_parent is not None:
+            self._t0 = time.time_ns()
         self._gov.pre_alloc(self.tier, self.est)
 
     def after(self, blocks: JaxBlocks) -> None:
-        self._gov.register(blocks, self.tier)
+        nbytes = self._gov.register(blocks, self.tier)
+        # the ledger's real footprint everywhere — the counter and the
+        # span must agree with each other and with the spill phase
+        measured = (
+            int(nbytes) if nbytes is not None else int(device_nbytes(blocks))
+        )
+        self._gov.note_transfer("ingest", self.tier, measured)
+        parent, self._obs_parent = self._obs_parent, None
+        t0, self._t0 = self._t0, None
+        if parent is not None:
+            span = parent.trace.start_span(
+                "engine.transfer",
+                parent,
+                {
+                    "phase": "ingest",
+                    "tier": self.tier,
+                    "est_bytes": int(self.est),
+                    "bytes": measured,
+                },
+            )
+            if t0 is not None:
+                span.start_ns = t0
+            span.finish()
 
 
 class MemoryGovernor:
@@ -283,6 +320,9 @@ class MemoryGovernor:
         self._tenant_local = threading.local()
         self._tier_bytes: Dict[str, int] = {"device": 0, "host": 0}
         self._tier_peak: Dict[str, int] = {"device": 0, "host": 0}
+        # cached metric children for the transfer accounting, one per
+        # (phase, tier) — see note_transfer
+        self._transfer_children: Dict[Tuple[str, str], Any] = {}
         self.counters: Dict[str, int] = {
             "admissions_device": 0,
             "admissions_host": 0,
@@ -386,6 +426,22 @@ class MemoryGovernor:
     def gate(self, tier: str, est: int) -> AllocationGate:
         return AllocationGate(self, tier, max(0, int(est)))
 
+    def note_transfer(self, phase: str, tier: str, nbytes: int) -> None:
+        """Account one host↔device transfer window on the engine's
+        metrics registry (``fugue_engine_transfer_bytes_total``). The
+        child is resolved lazily once per (phase, tier) and cached —
+        the hot-path cost is one lock + add."""
+        key = (phase, tier)
+        child = self._transfer_children.get(key)
+        if child is None:
+            child = self._transfer_children[key] = self._engine.metrics.counter(
+                "fugue_engine_transfer_bytes_total",
+                "bytes moved through ingest staging and spill windows "
+                "per phase and destination tier",
+                ["phase", "tier"],
+            ).labels(phase=phase, tier=tier)
+        child.inc(max(0, int(nbytes)))
+
     def admit(self, est: int, default_tier: str) -> str:
         """The admission decision for a new frame of estimated footprint
         ``est`` whose placement policy chose ``default_tier``: a
@@ -433,12 +489,13 @@ class MemoryGovernor:
     # ---- ledger ----------------------------------------------------------
     def register(
         self, blocks: JaxBlocks, tier: str, persisted: bool = False
-    ) -> None:
+    ) -> Optional[int]:
         """Enter a frame's blocks into the ledger with their REAL device
         footprint. Idempotent: re-registering refreshes recency, the
-        persisted flag, and the byte count."""
+        persisted flag, and the byte count. Returns the measured bytes
+        (None when governance is off — nothing was measured)."""
         if not self.enabled:
-            return
+            return None
         nbytes = device_nbytes(blocks)
         key = id(blocks)
         with self._lock:
@@ -452,7 +509,7 @@ class MemoryGovernor:
                     )
                     existing.nbytes = nbytes
                     self._bump_peak(existing.tier)
-                return
+                return nbytes
             entry = _LedgerEntry(
                 weakref.ref(blocks), tier, nbytes, self._next_seq(),
                 persisted, tenant=self.current_tenant(),
@@ -461,6 +518,7 @@ class MemoryGovernor:
             self._tier_bytes[tier] += nbytes
             self._bump_peak(tier)
         weakref.finalize(blocks, self._release, key, entry)
+        return nbytes
 
     def _bump_peak(self, tier: str) -> None:
         if self._tier_bytes[tier] > self._tier_peak[tier]:
@@ -544,16 +602,30 @@ class MemoryGovernor:
             if v is None:
                 break
             blocks = v.ref()
-            if (
-                blocks is None  # finalizer will reclaim
-                or host_mesh is None
-                or not move_blocks_to_mesh(blocks, host_mesh)
-            ):
+            if blocks is None or host_mesh is None:  # finalizer reclaims
+                skipped.add(id(v))
+                continue
+            # the span wraps the ACTUAL device→host move: a multi-GB
+            # spill's wall clock must land on the transfer phase in the
+            # slow-query breakdown, not on whatever span encloses the
+            # allocation that triggered it
+            sp = begin_span("engine.transfer", phase="spill", tier="host")
+            moved = False
+            try:
+                moved = move_blocks_to_mesh(blocks, host_mesh)
+            finally:
+                # a raising device_put must not leak the span open (a
+                # leaked span pins the whole trace un-exportable)
+                if sp:
+                    sp.set_attr(bytes=int(v.nbytes), moved=moved)
+                    sp.finish()
+            if not moved:
                 skipped.add(id(v))
                 continue
             self._move_entry_locked(v, "host")
             self.counters["spills"] += 1
             self.counters["spilled_bytes"] += v.nbytes
+            self.note_transfer("spill", "host", v.nbytes)
             self._count(
                 "mem_spill",
                 f"{v.nbytes}B to host tier"

@@ -1,0 +1,172 @@
+"""The unified observability plane (ISSUE 8).
+
+Three coordinated pieces, all conf-gated and free when off:
+
+- **span tracer** (:mod:`fugue_tpu.obs.trace`): request-scoped spans
+  with ``trace_id``/``span_id``/parent links in a thread-local context
+  that propagates HTTP request → serve job → workflow run → task
+  attempt → engine compile/execute/transfer. Instrumentation sites are
+  allocation-free no-ops without an active trace.
+- **metrics registry** (:mod:`fugue_tpu.obs.metrics`): counters /
+  gauges / histograms with label sets, one per engine
+  (``engine.metrics``). The pre-existing ad-hoc dicts
+  (``engine.fallbacks``, serve backpressure counters, ``RunStats``,
+  breaker states) are views over families registered here; the serving
+  daemon renders the registry as Prometheus text at ``GET /v1/metrics``
+  and ``registry.snapshot()`` serves embedded use.
+- **exporters** (:mod:`fugue_tpu.obs.export`): per-run Chrome-trace
+  JSON (Perfetto-loadable) written through ``engine.fs`` under
+  ``fugue.obs.trace_path``, plus the structured slow-query log
+  (``fugue.obs.slow_query_ms``).
+
+Conf keys (registry-declared in :mod:`fugue_tpu.constants`):
+
+- ``fugue.obs.enabled`` (bool, default False): master switch. Off, no
+  trace is ever opened and every span site is a shared no-op singleton.
+- ``fugue.obs.trace_path`` (str, ""): dir/URI for per-trace Chrome
+  trace JSON files ("" = traces stay in memory for their owner only).
+- ``fugue.obs.slow_query_ms`` (float, 0): jobs/runs slower than this
+  log one structured record with their span breakdown (0 = off).
+- ``fugue.obs.sample_rate`` (float, 1.0): fraction of eligible
+  requests/runs that open a trace.
+"""
+
+import random
+from typing import Any, Optional, Tuple
+
+from fugue_tpu.obs.export import (  # noqa: F401
+    chrome_trace_events,
+    export_trace,
+    maybe_log_slow_query,
+    span_breakdown,
+)
+from fugue_tpu.obs.metrics import (  # noqa: F401
+    MetricsRegistry,
+    parse_prometheus_text,
+)
+from fugue_tpu.obs.trace import (  # noqa: F401
+    NULL_CM,
+    NULL_SPAN,
+    Span,
+    Trace,
+    activate,
+    begin_span,
+    current_span,
+    start_span,
+    suppress_tracing,
+    tracing_suppressed,
+)
+
+__all__ = [
+    "MetricsRegistry",
+    "ObsOptions",
+    "Span",
+    "Trace",
+    "activate",
+    "begin_span",
+    "chrome_trace_events",
+    "current_span",
+    "export_trace",
+    "finalize_trace",
+    "maybe_log_slow_query",
+    "obs_options",
+    "open_trace",
+    "parse_prometheus_text",
+    "span_breakdown",
+    "start_span",
+]
+
+
+class ObsOptions:
+    """Parsed ``fugue.obs.*`` conf, resolved once per owner."""
+
+    __slots__ = ("enabled", "trace_path", "slow_query_ms", "sample_rate")
+
+    def __init__(
+        self,
+        enabled: bool = False,
+        trace_path: str = "",
+        slow_query_ms: float = 0.0,
+        sample_rate: float = 1.0,
+    ):
+        self.enabled = bool(enabled)
+        self.trace_path = str(trace_path or "").strip()
+        self.slow_query_ms = max(0.0, float(slow_query_ms))
+        self.sample_rate = min(1.0, max(0.0, float(sample_rate)))
+
+
+def obs_options(conf: Any) -> ObsOptions:
+    """Read the declared ``fugue.obs.*`` keys from a conf mapping."""
+    from fugue_tpu.constants import (
+        FUGUE_CONF_OBS_ENABLED,
+        FUGUE_CONF_OBS_SAMPLE_RATE,
+        FUGUE_CONF_OBS_SLOW_QUERY_MS,
+        FUGUE_CONF_OBS_TRACE_PATH,
+        typed_conf_get,
+    )
+
+    return ObsOptions(
+        enabled=typed_conf_get(conf, FUGUE_CONF_OBS_ENABLED),
+        trace_path=typed_conf_get(conf, FUGUE_CONF_OBS_TRACE_PATH),
+        slow_query_ms=typed_conf_get(conf, FUGUE_CONF_OBS_SLOW_QUERY_MS),
+        sample_rate=typed_conf_get(conf, FUGUE_CONF_OBS_SAMPLE_RATE),
+    )
+
+
+def open_trace(
+    opts: ObsOptions,
+    name: str,
+    trace_id: Optional[str] = None,
+    **attrs: Any,
+) -> Tuple[Optional[Trace], Optional[Span]]:
+    """Open a new trace with one root span when observability is on and
+    the request wins the sampling draw; ``(None, None)`` otherwise. The
+    caller owns finalization (:func:`finalize_trace`)."""
+    if not opts.enabled:
+        return None, None
+    if opts.sample_rate < 1.0 and random.random() >= opts.sample_rate:
+        return None, None
+    trace = Trace(trace_id)
+    return trace, trace.root(name, **attrs)
+
+
+def finalize_trace(
+    trace: Optional[Trace],
+    opts: ObsOptions,
+    fs: Any = None,
+    log: Any = None,
+    registry: Any = None,
+    finish_root: bool = True,
+    **slow_detail: Any,
+) -> Optional[str]:
+    """Finish an OWNED trace: end the root span (idempotent; pass
+    ``finish_root=False`` from co-owners that must not cut a root still
+    serving elsewhere — the daemon's job-finish path), export the Chrome
+    trace JSON when ``fugue.obs.trace_path`` is set, and emit the
+    slow-query record when the root crossed ``fugue.obs.slow_query_ms``.
+    Safe to call from racing threads — only the call that observes the
+    trace complete and claims it exports. Returns the trace file URI
+    when one was written."""
+    if trace is None:
+        return None
+    root = trace.root_span
+    if finish_root and root is not None and root.end_ns is None:
+        root.finish()
+    if not trace.complete or not trace.mark_exported():
+        return None
+    if finish_root and root is not None:
+        # the slow-query record rides root ownership: co-owner callers
+        # (finish_root=False) time and report their own unit instead
+        maybe_log_slow_query(
+            trace,
+            root.duration_ms,
+            opts.slow_query_ms,
+            log=log,
+            registry=registry,
+            **slow_detail,
+        )
+    if opts.trace_path and fs is not None:
+        return export_trace(
+            trace, fs, opts.trace_path, log=log, registry=registry
+        )
+    return None

@@ -1,0 +1,157 @@
+"""Trace exporters: per-run Chrome-trace-event JSON (loads directly in
+Perfetto / ``chrome://tracing``) and the structured slow-query log.
+
+Export is strictly best-effort: a failing trace-file write (chaos site
+``obs.trace``) is counted on the registry and logged — it degrades
+observability, never the job that produced the trace.
+"""
+
+import json
+from typing import Any, Dict, List, Optional
+
+from fugue_tpu.obs.trace import Trace
+from fugue_tpu.testing.faults import fault_point
+
+# registry family names shared by the exporters and their tests
+TRACE_EXPORT_FAILURES = "fugue_obs_trace_export_failures_total"
+TRACES_EXPORTED = "fugue_obs_traces_exported_total"
+SLOW_QUERIES = "fugue_obs_slow_queries_total"
+
+
+def chrome_trace_events(trace: Trace) -> Dict[str, Any]:
+    """The trace as a Chrome trace-event JSON object: one complete
+    (``"ph": "X"``) event per span, on its executing thread's lane, with
+    the span/parent/trace ids in ``args`` so the tree survives tools
+    that only render time-nesting."""
+    import os
+
+    pid = os.getpid()
+    events: List[Dict[str, Any]] = []
+    with trace._lock:
+        spans = list(trace.spans)
+    # an unfinished span (crashed run) renders up to the latest end seen
+    latest = max(
+        (s.end_ns for s in spans if s.end_ns is not None),
+        default=None,
+    )
+    for s in spans:
+        end = s.end_ns if s.end_ns is not None else (latest or s.start_ns)
+        args: Dict[str, Any] = {
+            "trace_id": trace.trace_id,
+            "span_id": s.span_id,
+        }
+        if s.parent_id is not None:
+            args["parent_id"] = s.parent_id
+        args.update(s.attrs)
+        events.append(
+            {
+                "name": s.name,
+                "cat": "fugue_tpu",
+                "ph": "X",
+                "ts": s.start_ns / 1000.0,  # microseconds
+                "dur": max(0.0, (end - s.start_ns) / 1000.0),
+                "pid": pid,
+                "tid": s.thread_id,
+                "args": args,
+            }
+        )
+    return {"displayTimeUnit": "ms", "traceEvents": events}
+
+
+def span_breakdown(trace: Trace) -> Dict[str, Any]:
+    """Per-span-name time rollup of one trace — the slow-query log's
+    payload: how much wall clock each phase (queue/compile/execute/
+    transfer/...) consumed, with counts."""
+    phases: Dict[str, Dict[str, float]] = {}
+    with trace._lock:
+        spans = list(trace.spans)
+    for s in spans:
+        slot = phases.setdefault(s.name, {"ms": 0.0, "count": 0})
+        slot["ms"] = round(slot["ms"] + s.duration_ms, 3)
+        slot["count"] += 1
+    root = trace.root_span
+    return {
+        "trace_id": trace.trace_id,
+        "total_ms": round(root.duration_ms, 3) if root is not None else 0.0,
+        "spans": len(spans),
+        "phases": phases,
+    }
+
+
+def export_trace(
+    trace: Trace,
+    fs: Any,
+    base_uri: str,
+    log: Any = None,
+    registry: Any = None,
+) -> Optional[str]:
+    """Write the trace as ``<base_uri>/trace-<trace_id>.json`` through
+    the engine's virtual filesystem (atomic, like the run manifest).
+    Returns the URI, or None when the write failed — counted on
+    ``fugue_obs_trace_export_failures_total`` and logged, never raised."""
+    base = str(base_uri).rstrip("/")
+    uri = fs.join(base, f"trace-{trace.trace_id}.json")
+    try:
+        fault_point("obs.trace", uri)
+        fs.makedirs(base, exist_ok=True)
+        # compact separators, no indent: a big run's trace carries
+        # thousands of spans, and the export cost is the one obs cost
+        # paid per run even when nobody reads the file — keep it minimal
+        # (same atomic-write primitive as the run manifest)
+        data = json.dumps(
+            chrome_trace_events(trace), separators=(",", ":")
+        ).encode("utf-8")
+        fs.write_file_atomic(uri, lambda fp: fp.write(data))
+    except Exception as ex:
+        if registry is not None:
+            registry.counter(
+                TRACE_EXPORT_FAILURES,
+                "trace-file writes that failed (observability degraded, "
+                "the traced job was not affected)",
+            ).labels().inc()
+        if log is not None:
+            log.warning(
+                "fugue_tpu obs: trace export to %s failed (%s: %s); "
+                "observability degraded, the job is unaffected",
+                uri,
+                type(ex).__name__,
+                ex,
+            )
+        return None
+    if registry is not None:
+        registry.counter(
+            TRACES_EXPORTED, "trace files written to fugue.obs.trace_path"
+        ).labels().inc()
+    return uri
+
+
+def maybe_log_slow_query(
+    trace: Optional[Trace],
+    duration_ms: float,
+    slow_query_ms: float,
+    log: Any = None,
+    registry: Any = None,
+    **detail: Any,
+) -> Optional[Dict[str, Any]]:
+    """Emit one structured slow-query record when ``duration_ms``
+    crosses the configured threshold: a single JSON log line carrying
+    the span breakdown (phases of the offending job) plus caller detail
+    (job id, session, sql hash). Returns the record (tests introspect
+    it); None when under threshold or the threshold is off."""
+    if slow_query_ms <= 0 or duration_ms <= slow_query_ms:
+        return None
+    record: Dict[str, Any] = {
+        "slow_query_ms": slow_query_ms,
+        "duration_ms": round(duration_ms, 3),
+        **detail,
+    }
+    if trace is not None:
+        record["breakdown"] = span_breakdown(trace)
+    if registry is not None:
+        registry.counter(
+            SLOW_QUERIES,
+            "jobs/runs whose wall clock crossed fugue.obs.slow_query_ms",
+        ).labels().inc()
+    if log is not None:
+        log.warning("fugue_tpu obs slow query: %s", json.dumps(record))
+    return record

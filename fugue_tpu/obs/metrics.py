@@ -1,0 +1,406 @@
+"""The ONE metrics registry: counters, gauges and histograms with label
+sets, Prometheus text exposition, and a plain-dict ``snapshot()`` for
+embedded use.
+
+Before this module every subsystem counted into its own ad-hoc dict
+(``engine.fallbacks``, serve ``backpressure.rejections``, ``RunStats``,
+breaker describes). Those dicts remain the *public read shapes* — their
+owners now keep them as thin views over metric families registered here,
+so one Prometheus scrape covers everything and the back-compat accessors
+stay byte-identical.
+
+Design notes:
+
+- A :class:`MetricsRegistry` is an ordinary object, not a process
+  global: each engine owns one (``engine.metrics``), so two engines in
+  one process (tests, benches) never share counters. The serving daemon
+  exposes its engine's registry at ``GET /v1/metrics``.
+- Families are created idempotently (``registry.counter(name, ...)``
+  returns the existing family on repeat) so independent modules can
+  attach to the same family without import-order coupling.
+- Children (one per label-value tuple) are cached on the family;
+  callers on hot paths should pre-resolve children once
+  (``family.labels(op="x")``) and call ``inc()`` on the child — the
+  cost is then one lock + add, the same as the dict increments these
+  replace.
+- ``collectors`` are callables run right before ``snapshot()`` /
+  ``render()``: pull-model metrics (breaker states, queue depth,
+  memory pressure, uptime) are computed at scrape time instead of being
+  pushed on every mutation.
+"""
+
+import math
+import threading
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+COUNTER = "counter"
+GAUGE = "gauge"
+HISTOGRAM = "histogram"
+
+# latency-oriented default buckets (seconds), Prometheus-style
+DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+class _Child:
+    """One (family, label-values) time series."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Counter(_Child):
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self._value += n
+
+
+class Gauge(_Child):
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value -= n
+
+
+class Histogram:
+    """Cumulative-bucket histogram child (Prometheus semantics: the
+    rendered ``le`` buckets are cumulative, ``+Inf`` == ``_count``)."""
+
+    __slots__ = ("_lock", "buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets: Tuple[float, ...]):
+        self._lock = threading.Lock()
+        self.buckets = buckets
+        self.counts = [0] * len(buckets)  # per-bucket (non-cumulative)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self.sum += v
+            self.count += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self.counts[i] += 1
+                    break
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            cum: List[int] = []
+            acc = 0
+            for c in self.counts:
+                acc += c
+                cum.append(acc)
+            return {
+                "buckets": dict(zip(self.buckets, cum)),
+                "sum": self.sum,
+                "count": self.count,
+            }
+
+
+class MetricFamily:
+    """A named metric with a fixed label-name tuple and one child per
+    label-value combination."""
+
+    def __init__(
+        self,
+        name: str,
+        kind: str,
+        help: str,
+        labelnames: Tuple[str, ...],
+        buckets: Tuple[float, ...] = DEFAULT_BUCKETS,
+    ):
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self.labelnames = labelnames
+        self._buckets = buckets
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], Any] = {}
+
+    def _make_child(self) -> Any:
+        if self.kind == COUNTER:
+            return Counter()
+        if self.kind == GAUGE:
+            return Gauge()
+        return Histogram(self._buckets)
+
+    def labels(self, **kv: Any) -> Any:
+        """The child for one label-value set (created on first use).
+        With no labels declared, ``labels()`` is the single child."""
+        if set(kv) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name} expects labels {self.labelnames}, got "
+                f"{tuple(kv)}"
+            )
+        key = tuple(str(kv[n]) for n in self.labelnames)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = self._make_child()
+            return child
+
+    def clear(self) -> None:
+        """Drop every child — the reset idiom of the ad-hoc dicts this
+        registry replaced (``engine.reset_fallbacks``)."""
+        with self._lock:
+            self._children.clear()
+
+    def children(self) -> List[Tuple[Tuple[str, ...], Any]]:
+        with self._lock:
+            return list(self._children.items())
+
+    def as_dict(self) -> Dict[Any, float]:
+        """Back-compat view: single-label families map label value ->
+        value; label-free families map ``""`` -> value; multi-label
+        families map the label tuple -> value."""
+        out: Dict[Any, float] = {}
+        for key, child in self.children():
+            if isinstance(child, Histogram):
+                continue
+            if len(self.labelnames) == 1:
+                out[key[0]] = child.value
+            elif len(self.labelnames) == 0:
+                out[""] = child.value
+            else:
+                out[key] = child.value
+        return out
+
+    def as_int_dict(self) -> Dict[Any, int]:
+        return {k: int(v) for k, v in self.as_dict().items()}
+
+
+def _escape(value: str) -> str:
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace("\n", "\\n")
+        .replace('"', '\\"')
+    )
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+def _labels_text(names: Tuple[str, ...], values: Tuple[str, ...],
+                 extra: Optional[Tuple[str, str]] = None) -> str:
+    pairs = [f'{n}="{_escape(v)}"' for n, v in zip(names, values)]
+    if extra is not None:
+        pairs.append(f'{extra[0]}="{_escape(extra[1])}"')
+    if not pairs:
+        return ""
+    return "{" + ",".join(pairs) + "}"
+
+
+class MetricsRegistry:
+    """Create/lookup metric families, snapshot them, render them as
+    Prometheus text exposition (format version 0.0.4)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._families: Dict[str, MetricFamily] = {}
+        self._collectors: List[Callable[[], None]] = []
+
+    # ---- family constructors (idempotent) --------------------------------
+    def _family(
+        self,
+        name: str,
+        kind: str,
+        help: str,
+        labelnames: Iterable[str],
+        buckets: Tuple[float, ...] = DEFAULT_BUCKETS,
+    ) -> MetricFamily:
+        names = tuple(labelnames)
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if fam.kind != kind or fam.labelnames != names:
+                    raise ValueError(
+                        f"metric {name} already registered as {fam.kind}"
+                        f"{fam.labelnames}, not {kind}{names}"
+                    )
+                return fam
+            fam = self._families[name] = MetricFamily(
+                name, kind, help, names, buckets
+            )
+            return fam
+
+    def counter(
+        self, name: str, help: str, labelnames: Iterable[str] = ()
+    ) -> MetricFamily:
+        return self._family(name, COUNTER, help, labelnames)
+
+    def gauge(
+        self, name: str, help: str, labelnames: Iterable[str] = ()
+    ) -> MetricFamily:
+        return self._family(name, GAUGE, help, labelnames)
+
+    def histogram(
+        self,
+        name: str,
+        help: str,
+        labelnames: Iterable[str] = (),
+        buckets: Tuple[float, ...] = DEFAULT_BUCKETS,
+    ) -> MetricFamily:
+        return self._family(name, HISTOGRAM, help, labelnames, buckets)
+
+    def get(self, name: str) -> Optional[MetricFamily]:
+        with self._lock:
+            return self._families.get(name)
+
+    # ---- scrape-time collectors ------------------------------------------
+    def add_collector(self, fn: Callable[[], None]) -> None:
+        """Register a callable run before every snapshot/render — the
+        place to SET pull-model gauges (queue depth, breaker states)."""
+        with self._lock:
+            self._collectors.append(fn)
+
+    def remove_collector(self, fn: Callable[[], None]) -> None:
+        """Deregister a collector (idempotent). Owners with a lifecycle
+        shorter than the registry's — a serving daemon on a caller-owned
+        engine — must remove their collectors on stop, or every later
+        scrape would keep reading the stopped owner's stale gauges."""
+        with self._lock:
+            try:
+                self._collectors.remove(fn)
+            except ValueError:
+                pass
+
+    def _collect(self) -> None:
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:  # a bad collector must not break a scrape
+                pass
+
+    # ---- export ----------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """Plain-dict snapshot for embedded use (no HTTP scrape)."""
+        self._collect()
+        with self._lock:
+            families = list(self._families.values())
+        out: Dict[str, Any] = {}
+        for fam in families:
+            samples: List[Dict[str, Any]] = []
+            for key, child in fam.children():
+                labels = dict(zip(fam.labelnames, key))
+                if isinstance(child, Histogram):
+                    samples.append({"labels": labels, **child.snapshot()})
+                else:
+                    samples.append({"labels": labels, "value": child.value})
+            out[fam.name] = {
+                "type": fam.kind,
+                "help": fam.help,
+                "samples": samples,
+            }
+        return out
+
+    def render(self) -> str:
+        """Prometheus text exposition. Families with no children still
+        emit their HELP/TYPE header so scrapers learn the full schema."""
+        self._collect()
+        with self._lock:
+            families = list(self._families.values())
+        lines: List[str] = []
+        for fam in families:
+            lines.append(f"# HELP {fam.name} {_escape(fam.help)}")
+            lines.append(f"# TYPE {fam.name} {fam.kind}")
+            for key, child in sorted(fam.children(), key=lambda kv: kv[0]):
+                if isinstance(child, Histogram):
+                    snap = child.snapshot()
+                    for le, cum in snap["buckets"].items():
+                        lines.append(
+                            f"{fam.name}_bucket"
+                            f"{_labels_text(fam.labelnames, key, ('le', _fmt(le)))}"
+                            f" {cum}"
+                        )
+                    lines.append(
+                        f"{fam.name}_bucket"
+                        f"{_labels_text(fam.labelnames, key, ('le', '+Inf'))}"
+                        f" {snap['count']}"
+                    )
+                    lines.append(
+                        f"{fam.name}_sum{_labels_text(fam.labelnames, key)}"
+                        f" {_fmt(snap['sum'])}"
+                    )
+                    lines.append(
+                        f"{fam.name}_count{_labels_text(fam.labelnames, key)}"
+                        f" {snap['count']}"
+                    )
+                else:
+                    lines.append(
+                        f"{fam.name}{_labels_text(fam.labelnames, key)}"
+                        f" {_fmt(child.value)}"
+                    )
+        return "\n".join(lines) + "\n"
+
+
+def parse_prometheus_text(text: str) -> Dict[str, Dict[Tuple[Tuple[str, str], ...], float]]:
+    """Minimal exposition-format parser for round-trip tests and scrape
+    consumers: ``{metric_name: {((label, value), ...): sample_value}}``.
+    Handles the subset :meth:`MetricsRegistry.render` emits (escaped
+    label values, ``+Inf``, histogram ``_bucket``/``_sum``/``_count``)."""
+    out: Dict[str, Dict[Tuple[Tuple[str, str], ...], float]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        if "{" in line:
+            name, rest = line.split("{", 1)
+            labels_raw, value_raw = rest.rsplit("}", 1)
+            labels: List[Tuple[str, str]] = []
+            i = 0
+            while i < len(labels_raw):
+                eq = labels_raw.index("=", i)
+                lname = labels_raw[i:eq]
+                assert labels_raw[eq + 1] == '"'
+                j = eq + 2
+                buf: List[str] = []
+                while labels_raw[j] != '"':
+                    if labels_raw[j] == "\\":
+                        nxt = labels_raw[j + 1]
+                        buf.append(
+                            {"n": "\n", "\\": "\\", '"': '"'}.get(nxt, nxt)
+                        )
+                        j += 2
+                    else:
+                        buf.append(labels_raw[j])
+                        j += 1
+                labels.append((lname, "".join(buf)))
+                i = j + 1
+                if i < len(labels_raw) and labels_raw[i] == ",":
+                    i += 1
+        else:
+            name, value_raw = line.rsplit(None, 1)
+            labels = []
+            value_raw = " " + value_raw
+        value_str = value_raw.strip()
+        value = math.inf if value_str == "+Inf" else float(value_str)
+        out.setdefault(name, {})[tuple(labels)] = value
+    return out

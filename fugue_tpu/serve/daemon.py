@@ -52,13 +52,25 @@ HTTP API (all JSON; errors are structured payloads, never tracebacks)::
     GET    /v1/jobs/<jid>                   poll an async submission
     POST   /v1/jobs/<jid>/cancel
     GET    /v1/status                       health, memory_stats, breakers,
-                                            backpressure, recovery, jobs
+                                            backpressure, recovery, jobs,
+                                            uptime_secs, version,
+                                            compile_cache
     GET    /v1/health                       200 healthy / 503 draining
+    GET    /v1/metrics                      Prometheus text exposition
+
+Observability plane (ISSUE 8): every route accepts/echoes
+``X-Request-Id`` (generated when absent/unsafe) and, with
+``fugue.obs.enabled``, runs under a request trace whose spans follow the
+job through the workflow into engine compile/execute/transfer — exported
+as Perfetto-loadable Chrome-trace JSON under ``fugue.obs.trace_path``;
+jobs over ``fugue.obs.slow_query_ms`` log a structured span breakdown.
 """
 
+import re
 import signal
 import threading
 import time
+import uuid
 from contextlib import nullcontext
 from typing import Any, Dict, Optional, Tuple
 
@@ -81,6 +93,16 @@ from fugue_tpu.constants import (
     typed_conf_get,
 )
 from fugue_tpu.execution.factory import make_execution_engine
+from fugue_tpu.obs import (
+    activate,
+    current_span,
+    finalize_trace,
+    maybe_log_slow_query,
+    obs_options,
+    open_trace,
+    start_span,
+    suppress_tracing,
+)
 from fugue_tpu.rpc.http import structured_error
 from fugue_tpu.serve.http import ServeHTTPServer
 from fugue_tpu.serve.scheduler import (
@@ -108,6 +130,41 @@ _RESULT_YIELD = "serve_result"
 # breaker accounting must not count a breaker's own rejections as fresh
 # failures (that would extend a quarantine every time someone probes it)
 _BREAKER_ERRORS = ("PoisonQueryError", "CircuitOpenError")
+
+# X-Request-Id hygiene: the inbound header becomes a trace id (and so a
+# trace FILENAME under fugue.obs.trace_path) — restrict it to a safe
+# charset and length; anything else is replaced by a generated id
+_REQUEST_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
+
+_REJECT_KINDS = (
+    "draining",
+    "queue_full",
+    "memory_pressure",
+    "session_cap",
+    "breaker_open",
+    "sync_degraded",
+)
+_FAULT_KINDS = (
+    "runs",
+    "retries",
+    "recoveries",
+    "degradations",
+    "integrity_rejected",
+    "resumed",
+)
+
+
+def clean_request_id(raw: Optional[str]) -> Optional[str]:
+    """The inbound ``X-Request-Id`` if it is safe to echo/journal/use as
+    a trace id; None (→ generate one) otherwise."""
+    if raw is None:
+        return None
+    rid = str(raw).strip()
+    return rid if _REQUEST_ID_RE.match(rid) else None
+
+
+def new_request_id() -> str:
+    return "req-" + uuid.uuid4().hex[:16]
 
 
 class ServeDaemon:
@@ -164,29 +221,56 @@ class ServeDaemon:
         )
         self._started = False
         self._started_at: Optional[float] = None
-        self._stats_lock = threading.Lock()
-        self._fault_totals: Dict[str, int] = {
-            "runs": 0,
-            "retries": 0,
-            "recoveries": 0,
-            "degradations": 0,
-            "integrity_rejected": 0,
-            "resumed": 0,
-        }
-        self._reject_totals: Dict[str, int] = {
-            "draining": 0,
-            "queue_full": 0,
-            "memory_pressure": 0,
-            "session_cap": 0,
-            "breaker_open": 0,
-            "sync_degraded": 0,
-        }
         self._recovery: Dict[str, int] = {
             "sessions": 0,
             "jobs_resubmitted": 0,
             "jobs_failed_over": 0,
         }
         self._drain_result: Optional[Dict[str, int]] = None
+        # ---- observability plane (ISSUE 8) -------------------------------
+        # the daemon's counters live on the ENGINE's metrics registry
+        # (one registry per daemon by construction), rendered at
+        # GET /v1/metrics; the status() payload keeps its historical
+        # dict shapes as views over the families. Children are
+        # pre-touched so scrapes see the full label schema at zero.
+        self._obs = obs_options(econf)
+        metrics = self._engine.metrics
+        self._m_reject = metrics.counter(
+            "fugue_serve_rejections_total",
+            "submissions shed by admission control, by reason",
+            ["kind"],
+        )
+        for kind in _REJECT_KINDS:
+            self._m_reject.labels(kind=kind)
+        self._m_fault = metrics.counter(
+            "fugue_serve_fault_events_total",
+            "workflow fault-tolerance events aggregated over served jobs",
+            ["kind"],
+        )
+        for kind in _FAULT_KINDS:
+            self._m_fault.labels(kind=kind)
+        self._m_requests = metrics.counter(
+            "fugue_serve_requests_total",
+            "HTTP API requests by route family and status",
+            ["route", "status"],
+        )
+        self._m_request_secs = metrics.histogram(
+            "fugue_serve_request_seconds",
+            "HTTP API request latency by route family",
+            ["route"],
+        )
+        self._m_job_secs = metrics.histogram(
+            "fugue_serve_job_seconds",
+            "job execution wall clock (start to terminal) by outcome",
+            ["status"],
+        )
+        # registry counters are process-monotonic (Prometheus
+        # semantics), but status()'s dict shapes are DAEMON-scoped like
+        # the dicts they replaced: baseline a caller-owned engine's
+        # prior counts so a fresh daemon starts its payload at zero
+        self._reject_base = self._m_reject.as_int_dict()
+        self._fault_base = self._m_fault.as_int_dict()
+        metrics.add_collector(self._collect_serve_gauges)
 
     # ---- lifecycle -------------------------------------------------------
     @property
@@ -264,6 +348,7 @@ class ServeDaemon:
                 collect=bool(rec.get("collect", True)),
                 limit=int(rec.get("limit", 10_000)),
                 job_id=jid,
+                request_id=rec.get("request_id"),
             )
             job.recovered = True
             try:
@@ -298,6 +383,9 @@ class ServeDaemon:
             self._health.start_drain(self._drain_timeout)
             self._drain_result = self._scheduler.drain(self._drain_timeout)
         self._started = False
+        # a stopped daemon must not keep publishing gauges through a
+        # caller-owned engine's registry (stale values, leaked refs)
+        self._engine.metrics.remove_collector(self._collect_serve_gauges)
         self._supervisor.stop()
         self._http.stop()
         self._scheduler.stop()
@@ -335,6 +423,7 @@ class ServeDaemon:
         if not self._started:
             return
         self._started = False
+        self._engine.metrics.remove_collector(self._collect_serve_gauges)
         # scheduler FIRST: its first act is dropping the finish
         # observers, so a job completing while the rest of the teardown
         # runs can no longer clean its journal entry — a real kill -9
@@ -379,8 +468,41 @@ class ServeDaemon:
         return float((mem.get("tiers") or {}).get("device", 0)) / budget
 
     def _count_reject(self, kind: str) -> None:
-        with self._stats_lock:
-            self._reject_totals[kind] = self._reject_totals.get(kind, 0) + 1
+        self._m_reject.labels(kind=kind).inc()
+
+    def _collect_serve_gauges(self) -> None:
+        """Scrape-time collector: pull-model serve gauges (breaker
+        states as labeled gauges, queue depth, memory pressure, uptime,
+        live sessions) computed when the registry is read."""
+        metrics = self._engine.metrics
+        g = metrics.gauge(
+            "fugue_serve_breaker_states",
+            "circuit breakers currently in each state",
+            ["state"],
+        )
+        for state, n in self._supervisor.breaker_state_counts().items():
+            g.labels(state=state).set(n)
+        metrics.gauge(
+            "fugue_serve_breaker_trips",
+            "total breaker trips since daemon start",
+        ).labels().set(self._supervisor.breaker_stats()["trips"])
+        metrics.gauge(
+            "fugue_serve_queue_depth", "queued (not yet running) jobs"
+        ).labels().set(self._scheduler.backlog())
+        metrics.gauge(
+            "fugue_serve_memory_pressure",
+            "device-tier fill fraction of the governed memory budget",
+        ).labels().set(self.memory_pressure())
+        metrics.gauge(
+            "fugue_serve_sessions", "live serve sessions"
+        ).labels().set(self._sessions.count())
+        metrics.gauge(
+            "fugue_serve_uptime_seconds", "seconds since daemon start"
+        ).labels().set(
+            time.time() - self._started_at
+            if self._started_at is not None
+            else 0.0
+        )
 
     def _admit(self, session_id: str) -> None:
         """Admission control for one submission; raises an
@@ -433,6 +555,7 @@ class ServeDaemon:
         timeout: float = 0.0,
         collect: bool = True,
         limit: int = 10_000,
+        request_id: Optional[str] = None,
     ) -> ServeJob:
         self._sessions.get(session_id)  # 404 early + touches the session
         self._admit(session_id)
@@ -443,7 +566,19 @@ class ServeDaemon:
             timeout=timeout,
             collect=collect,
             limit=limit,
+            request_id=request_id,
         )
+        # under an active request trace the job gets its serve.job span
+        # NOW: queue wait is inside it, so traces attribute time spent
+        # queued behind the scheduler separately from execution
+        cur = current_span()
+        if cur is not None:
+            job.obs_trace = cur.trace
+            job.obs_span = cur.trace.start_span(
+                "serve.job",
+                cur,
+                {"job_id": job.job_id, "session_id": session_id},
+            )
         if not wait and self._journal is not None:
             # journal BEFORE the queue: a crash between accept and
             # dispatch still resumes the job on restart
@@ -455,6 +590,9 @@ class ServeDaemon:
                 self._journal.finish_job(job.job_id)
             # _admit may have claimed a half-open probe slot: release it
             self._supervisor.note_cancelled(session_id, None)
+            if job.obs_span is not None:
+                job.obs_span.set_attr(status="rejected")
+                job.obs_span.finish()
             raise
         if wait:
             # bounded: a wedged job must not pin the caller (an HTTP
@@ -478,9 +616,16 @@ class ServeDaemon:
         fallbacks = getattr(self._engine, "fallbacks", None)
         if isinstance(fallbacks, dict):
             engine_stats["fallbacks"] = fallbacks
-        with self._stats_lock:
-            fault_totals = dict(self._fault_totals)
-            reject_totals = dict(self._reject_totals)
+        # historical dict shapes, now views over the metric families
+        # (minus the pre-daemon baseline on caller-owned engines)
+        fault_totals = {
+            k: v - self._fault_base.get(k, 0)
+            for k, v in self._m_fault.as_int_dict().items()
+        }
+        reject_totals = {
+            k: v - self._reject_base.get(k, 0)
+            for k, v in self._m_reject.as_int_dict().items()
+        }
         fault_totals["integrity_rejected"] += (
             self._sessions.integrity_rejected()
         )
@@ -490,11 +635,22 @@ class ServeDaemon:
             health["jobs_in_flight"] = counts["queued"] + counts["running"]
             if self._drain_result is not None:
                 health["drain_result"] = dict(self._drain_result)
+        uptime = (
+            round(time.time() - self._started_at, 3)
+            if self._started_at is not None
+            else 0.0
+        )
+        from fugue_tpu import __version__
+
+        compile_cache = getattr(self._engine, "compile_cache_stats", None)
         out: Dict[str, Any] = {
-            "uptime_seconds": (
-                round(time.time() - self._started_at, 3)
-                if self._started_at is not None
-                else 0.0
+            "uptime_seconds": uptime,
+            "uptime_secs": uptime,
+            "version": __version__,
+            "compile_cache": (
+                dict(compile_cache)
+                if isinstance(compile_cache, dict)
+                else {"hits": 0, "misses": 0}
             ),
             "health": health,
             "engine": engine_stats,
@@ -523,6 +679,20 @@ class ServeDaemon:
 
     # ---- job execution (scheduler worker threads) ------------------------
     def _execute_job(self, job: ServeJob) -> Dict[str, Any]:
+        # re-attach the submitting request's trace on THIS worker
+        # thread: everything below (workflow.run → tasks → attempts →
+        # engine compile/execute/transfer) lands under the job's span.
+        # A job whose request LOST the sampling draw runs suppressed, so
+        # the workflow layer does not re-draw and export an
+        # uncorrelated trace of its own.
+        if self._obs.enabled and job.obs_span is None:
+            with suppress_tracing():
+                return self._execute_job_impl(job)
+        with activate(job.obs_span):
+            with start_span("serve.execute"):
+                return self._execute_job_impl(job)
+
+    def _execute_job_impl(self, job: ServeJob) -> Dict[str, Any]:
         job.beat()
         session = self._sessions.get(job.session_id)
         dag = FugueSQLWorkflow()
@@ -598,11 +768,14 @@ class ServeDaemon:
         return payload
 
     def _job_finished(self, job: ServeJob) -> None:
-        """Scheduler ``on_finish`` observer: job-journal cleanup and
-        breaker accounting (cancellations are neutral; a breaker's own
-        rejection never counts as a fresh failure)."""
+        """Scheduler ``on_finish`` observer: job-journal cleanup,
+        observability settlement (span end, latency histogram,
+        slow-query log, trace export) and breaker accounting
+        (cancellations are neutral; a breaker's own rejection never
+        counts as a fresh failure)."""
         if self._journal is not None:
             self._journal.finish_job(job.job_id)
+        self._obs_job_finished(job)
         if job.status == CANCELLED:
             # verdict-free for the breakers — but the job may have held
             # a half-open probe slot, which must go back
@@ -621,29 +794,134 @@ class ServeDaemon:
         )
 
     def _note_fault_stats(self, stats: Dict[str, Any]) -> None:
-        with self._stats_lock:
-            self._fault_totals["runs"] += 1
-            for key in (
-                "retries", "recoveries", "degradations",
-                "integrity_rejected",
-            ):
-                self._fault_totals[key] += sum(
-                    (stats.get(key) or {}).values()
+        self._m_fault.labels(kind="runs").inc()
+        for key in (
+            "retries", "recoveries", "degradations",
+            "integrity_rejected",
+        ):
+            n = sum((stats.get(key) or {}).values())
+            if n:
+                self._m_fault.labels(kind=key).inc(n)
+        resumed = len(stats.get("resumed") or [])
+        if resumed:
+            self._m_fault.labels(kind="resumed").inc(resumed)
+
+    def _obs_job_finished(self, job: ServeJob) -> None:
+        """Settle one finished job's observability: latency histogram,
+        span end + trace export, slow-query record. Best-effort — never
+        raises into the scheduler's finish path."""
+        try:
+            duration = None
+            if job.started_at is not None and job.finished_at is not None:
+                duration = job.finished_at - job.started_at
+                self._m_job_secs.labels(status=job.status).observe(duration)
+            if job.obs_span is not None:
+                job.obs_span.set_attr(status=job.status)
+                job.obs_span.finish()
+            if duration is not None:
+                maybe_log_slow_query(
+                    job.obs_trace,
+                    duration * 1000.0,
+                    self._obs.slow_query_ms,
+                    log=self._engine.log,
+                    registry=self._engine.metrics,
+                    job_id=job.job_id,
+                    session_id=job.session_id,
+                    request_id=job.request_id,
+                    status=job.status,
                 )
-            self._fault_totals["resumed"] += len(stats.get("resumed") or [])
+            if job.obs_trace is not None:
+                # export when this job was the LAST open piece of its
+                # request trace (async submissions; sync ones usually
+                # export at HTTP response time)
+                finalize_trace(
+                    job.obs_trace,
+                    self._obs,
+                    fs=self._engine.fs,
+                    log=self._engine.log,
+                    registry=self._engine.metrics,
+                    finish_root=False,
+                )
+        except Exception:  # pragma: no cover - observability best-effort
+            pass
 
     # ---- HTTP routing ----------------------------------------------------
+    def render_metrics(self) -> str:
+        """The engine registry as Prometheus text exposition — the body
+        of ``GET /v1/metrics``."""
+        return self._engine.metrics.render()
+
+    @staticmethod
+    def _route_family(path: str) -> str:
+        """Bounded-cardinality route label for request metrics: the
+        first path segment under /v1 (health/status/metrics/sessions/
+        jobs), never raw ids."""
+        parts = [p for p in path.split("?", 1)[0].split("/") if p]
+        if len(parts) >= 2 and parts[0] == "v1":
+            return parts[1]
+        return "unknown"
+
     def handle_api(
-        self, method: str, path: str, payload: Dict[str, Any]
+        self,
+        method: str,
+        path: str,
+        payload: Dict[str, Any],
+        request_id: Optional[str] = None,
     ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
         """Route one API request; returns (status, JSON-safe response,
         extra headers). Never raises: handler failures become structured
         error payloads (KeyError -> 404, admission/backpressure -> the
         error's own status + Retry-After header, bad input -> 400, the
-        rest -> 500)."""
+        rest -> 500). Every response carries ``X-Request-Id`` — the
+        (sanitized) inbound header or a generated id — and, with
+        ``fugue.obs.enabled``, the request runs under a trace root whose
+        id IS the correlation id."""
+        rid = clean_request_id(request_id) or new_request_id()
+        trace, root = open_trace(
+            self._obs,
+            "http.request",
+            trace_id=rid,
+            request_id=rid,
+            method=method,
+        )
+        t0 = time.monotonic()
+        status = 500
+        try:
+            with activate(root):
+                status, resp, headers = self._handle(
+                    method, path, payload, rid
+                )
+        finally:
+            elapsed = time.monotonic() - t0
+            if root is not None:
+                root.set_attr(status=status)
+                root.finish()
+            route = self._route_family(path)
+            self._m_requests.labels(route=route, status=str(status)).inc()
+            self._m_request_secs.labels(route=route).observe(elapsed)
+            if trace is not None:
+                finalize_trace(
+                    trace,
+                    self._obs,
+                    fs=self._engine.fs,
+                    log=self._engine.log,
+                    registry=self._engine.metrics,
+                    finish_root=False,
+                )
+        out_headers = dict(headers)
+        out_headers["X-Request-Id"] = rid
+        return status, resp, out_headers
+
+    def _handle(
+        self,
+        method: str,
+        path: str,
+        payload: Dict[str, Any],
+        request_id: Optional[str] = None,
+    ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
         try:
             fault_point("serve.http", f"{method} {path}")
-            out = self._route(method, path, payload)
+            out = self._route(method, path, payload, request_id)
             if len(out) == 2:
                 status, resp = out  # type: ignore[misc]
                 return status, resp, {}
@@ -665,7 +943,11 @@ class ServeDaemon:
             return 500, {"error": structured_error(ex)}, {}
 
     def _route(
-        self, method: str, path: str, payload: Dict[str, Any]
+        self,
+        method: str,
+        path: str,
+        payload: Dict[str, Any],
+        request_id: Optional[str] = None,
     ) -> Any:
         parts = [p for p in path.split("?", 1)[0].split("/") if p]
         if not parts or parts[0] != "v1":
@@ -700,7 +982,7 @@ class ServeDaemon:
             ):
                 return 200, self.close_session(sid)
             if rest == ["sql"] and method == "POST":
-                return self._route_sql(sid, payload)
+                return self._route_sql(sid, payload, request_id)
         if len(route) >= 2 and route[0] == "jobs":
             jid = route[1]
             rest = route[2:]
@@ -713,7 +995,10 @@ class ServeDaemon:
         raise KeyError(f"unknown route {method} {path}")
 
     def _route_sql(
-        self, sid: str, payload: Dict[str, Any]
+        self,
+        sid: str,
+        payload: Dict[str, Any],
+        request_id: Optional[str] = None,
     ) -> Tuple[int, Dict[str, Any]]:
         sql = payload.get("sql")
         if not isinstance(sql, str) or not sql.strip():
@@ -740,6 +1025,7 @@ class ServeDaemon:
             timeout=float(payload.get("timeout", 0.0)),
             collect=bool(payload.get("collect", True)),
             limit=int(payload.get("limit", 10_000)),
+            request_id=request_id,
         )
         if mode == "async":
             snap = job.snapshot(include_result=False)

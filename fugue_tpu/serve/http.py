@@ -49,16 +49,24 @@ class _ServeAPIHandler(HardenedRequestHandler):
     rpc_server: "ServeHTTPServer"
 
     def do_GET(self) -> None:  # noqa: N802 (stdlib naming)
+        self._begin_request()
         self._route("GET", b"")
 
     def do_DELETE(self) -> None:  # noqa: N802
+        self._begin_request()
         self._route("DELETE", b"")
 
     def do_POST(self) -> None:  # noqa: N802
+        # correlation id FIRST: even a 400/413 body rejection (written
+        # inside read_body, before routing) must echo X-Request-Id
+        self._begin_request()
         body = self.read_body()  # 413 already sent when over the cap
         if body is None:
             return
         self._route("POST", body)
+
+    def _begin_request(self) -> None:
+        self._request_id = self.headers.get("X-Request-Id")
 
     def _route(self, method: str, body: bytes) -> None:
         try:
@@ -68,24 +76,56 @@ class _ServeAPIHandler(HardenedRequestHandler):
         except ValueError as ex:
             self.send_error_payload(400, ex)
             return
-        status, resp, headers = self.rpc_server.daemon.handle_api(
-            method, self.path, payload
+        daemon = self.rpc_server.daemon
+        if method == "GET" and self.path.split("?", 1)[0] == "/v1/metrics":
+            # Prometheus scrape: text exposition, not the JSON plane
+            try:
+                text = daemon.render_metrics()
+            except Exception as ex:  # pragma: no cover - defensive
+                self.send_error_payload(500, ex)
+                return
+            self._send_bytes(
+                200,
+                text.encode("utf-8"),
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
+            return
+        status, resp, headers = daemon.handle_api(
+            method, self.path, payload, request_id=self._request_id
         )
         self._send_json(status, resp, headers)
+
+    def _send_bytes(
+        self,
+        status: int,
+        data: bytes,
+        content_type: str,
+        headers: Any = None,
+    ) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        merged = dict(headers or {})
+        if "X-Request-Id" not in merged:
+            # the router's echo when it ran; the raw inbound (or a
+            # generated one) for failures answered before routing
+            from fugue_tpu.serve.daemon import clean_request_id, new_request_id
+
+            merged["X-Request-Id"] = (
+                clean_request_id(getattr(self, "_request_id", None))
+                or new_request_id()
+            )
+        for name, value in merged.items():
+            # extra response headers from the router — Retry-After on
+            # the backpressure/drain rejections, X-Request-Id everywhere
+            self.send_header(name, str(value))
+        self.end_headers()
+        self.wfile.write(data)
 
     def _send_json(
         self, status: int, resp: Any, headers: Any = None
     ) -> None:
-        data = dumps(resp)
-        self.send_response(status)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(data)))
-        for name, value in (headers or {}).items():
-            # extra response headers from the router — Retry-After on
-            # the backpressure/drain rejections
-            self.send_header(name, str(value))
-        self.end_headers()
-        self.wfile.write(data)
+        self._send_bytes(status, dumps(resp), "application/json", headers)
 
     def send_error_payload(self, status: int, ex: BaseException) -> None:
         self._send_json(status, {"error": structured_error(ex)})

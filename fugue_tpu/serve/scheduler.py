@@ -70,6 +70,7 @@ class ServeJob:
         collect: bool = True,
         limit: int = 10_000,
         job_id: Optional[str] = None,
+        request_id: Optional[str] = None,
     ):
         self.job_id = job_id or ("job-" + uuid.uuid4().hex[:12])
         self.session_id = session_id
@@ -78,6 +79,15 @@ class ServeJob:
         self.timeout = max(0.0, float(timeout))
         self.collect = bool(collect)
         self.limit = int(limit)
+        # correlation id of the HTTP request that submitted this job
+        # (X-Request-Id, generated when absent); journaled with async
+        # jobs so a restarted daemon's resubmissions keep their ids
+        self.request_id = request_id
+        # observability carry: the submitting request's trace and this
+        # job's serve.job span (None with obs off) — the worker thread
+        # re-attaches them so the job's spans land in the request tree
+        self.obs_trace: Any = None
+        self.obs_span: Any = None
         self.token = CancelToken()
         # every cooperative cancellation check the inner workflow makes
         # (task launch, retry attempts, dispatch-guard acquisition) is a
@@ -150,6 +160,8 @@ class ServeJob:
             "status": self.status,
             "submitted_at": self.submitted_at,
         }
+        if self.request_id is not None:
+            out["request_id"] = self.request_id
         if self.recovered:
             out["recovered"] = True
         if self.started_at is not None and self.finished_at is not None:

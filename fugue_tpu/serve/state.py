@@ -180,6 +180,10 @@ class ServeStateJournal:
                 "collect": job.collect,
                 "limit": job.limit,
                 "submitted_at": job.submitted_at,
+                # correlation id survives the restart: a resubmitted
+                # job's logs/spans still tie back to the original
+                # X-Request-Id the client holds
+                "request_id": job.request_id,
             }
         self.write()
 

@@ -322,6 +322,17 @@ class EngineSupervisor:
             keys.append(f"query:{fingerprint}")
         return keys
 
+    def breaker_state_counts(self) -> Dict[str, int]:
+        """How many breakers sit in each state right now — the labeled
+        gauge (``fugue_serve_breaker_states{state=...}``) the daemon's
+        scrape-time collector publishes."""
+        with self._lock:
+            breakers = list(self._breakers.values())
+        out = {CLOSED: 0, OPEN: 0, HALF_OPEN: 0}
+        for b in breakers:
+            out[b.state] = out.get(b.state, 0) + 1
+        return out
+
     def breaker_stats(self) -> Dict[str, Any]:
         with self._lock:
             breakers = list(self._breakers.values())

@@ -82,6 +82,7 @@ KNOWN_SITES = (
     "serve.sweep",
     "serve.dispatch",
     "serve.http",
+    "obs.trace",
 )
 
 

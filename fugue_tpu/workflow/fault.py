@@ -34,6 +34,7 @@ from fugue_tpu.exceptions import (
     FugueWorkflowError,
     TaskCancelledError,
 )
+from fugue_tpu.obs.trace import start_span
 from fugue_tpu.testing.faults import active_plan
 
 TRANSIENT = "transient"
@@ -240,9 +241,15 @@ class RunStats:
     result: retries/recoveries/degradations per task plus the tasks the
     run manifest marked resumable (completed by a prior run with a
     durable artifact still present at check time — the actual load is
-    served by the task's checkpoint short-circuit)."""
+    served by the task's checkpoint short-circuit).
 
-    def __init__(self) -> None:
+    With a ``registry`` (the run engine's metrics registry) every event
+    is ALSO mirrored — unlabeled by task, to bound cardinality — onto
+    ``fugue_workflow_fault_events_total{event=...}``, so a long-lived
+    process's Prometheus scrape aggregates what the per-run dicts show
+    one run at a time. The dict read shapes are unchanged."""
+
+    def __init__(self, registry: Any = None) -> None:
         self._lock = threading.Lock()
         self.retries: dict = {}
         self.recoveries: dict = {}
@@ -254,26 +261,40 @@ class RunStats:
         # snapshot of the jax engine's memory-governance ledger at run
         # end (empty for ungoverned engines)
         self.memory: dict = {}
+        self._m_events = (
+            None
+            if registry is None
+            else registry.counter(
+                "fugue_workflow_fault_events_total",
+                "workflow fault-tolerance events across runs "
+                "(per-run per-task detail lives on RunStats)",
+                ["event"],
+            )
+        )
 
-    def _bump(self, d: dict, key: str) -> None:
+    def _bump(self, d: dict, key: str, event: str) -> None:
         with self._lock:
             d[key] = d.get(key, 0) + 1
+        if self._m_events is not None:
+            self._m_events.labels(event=event).inc()
 
     def note_retry(self, name: str) -> None:
-        self._bump(self.retries, name)
+        self._bump(self.retries, name, "retry")
 
     def note_recovery(self, name: str) -> None:
-        self._bump(self.recoveries, name)
+        self._bump(self.recoveries, name, "recovery")
 
     def note_degradation(self, name: str) -> None:
-        self._bump(self.degradations, name)
+        self._bump(self.degradations, name, "degradation")
 
     def note_integrity_rejected(self, name: str) -> None:
-        self._bump(self.integrity_rejected, name)
+        self._bump(self.integrity_rejected, name, "integrity_rejected")
 
     def note_resumed(self, name: str) -> None:
         with self._lock:
             self.resumed.append(name)
+        if self._m_events is not None:
+            self._m_events.labels(event="resumed").inc()
 
     def set_memory(self, snapshot: dict) -> None:
         with self._lock:
@@ -347,8 +368,13 @@ def execute_with_policy(
         if token is not None:
             token.raise_if_cancelled()
         try:
-            with engine_dispatch_guard(engine, token):
-                result = fn()
+            # the attempt span covers dispatch-guard queueing AND the
+            # attempt body, so a trace shows time queued behind a shared
+            # engine separately from the engine's own compile/execute/
+            # transfer child spans
+            with start_span("task.attempt", attempt=attempt):
+                with engine_dispatch_guard(engine, token):
+                    result = fn()
             if attempt > 1:
                 plan = active_plan()
                 if plan is not None:
@@ -426,8 +452,9 @@ def _try_degrade(
             cause,
         )
     try:
-        with ctx, engine_dispatch_guard(engine, token):
-            result = fn()
+        with start_span("task.attempt", tier="host", degraded=True):
+            with ctx, engine_dispatch_guard(engine, token):
+                result = fn()
     except TaskCancelledError:
         raise
     except Exception as degraded_ex:

@@ -47,6 +47,7 @@ from fugue_tpu.exceptions import (
     TaskTimeoutError,
     WorkflowRuntimeError,
 )
+from fugue_tpu.obs.trace import activate, current_span
 from fugue_tpu.utils.assertion import assert_or_throw
 from fugue_tpu.workflow.fault import CancelToken
 
@@ -242,24 +243,30 @@ class DAGRunner:
         already records their dependency."""
         f: Future = Future()
 
+        # tracing context crosses the thread boundary explicitly: the
+        # caller's span (captured at run()) is re-attached inside the
+        # worker so task/attempt/engine spans land in the right tree
+        ambient = current_span()
+
         def work() -> None:
             if not f.set_running_or_notify_cancel():  # pragma: no cover
                 return
-            try:
-                # first cancellation point: a task launched just before a
-                # sibling failed aborts here instead of doing work the
-                # run will discard
-                token.raise_if_cancelled()
-                node.started_at = time.monotonic()
-                result = node.func(deps)
-            except BaseException as ex:
-                f.set_exception(ex)
-                return
-            # stop the wall clock BEFORE the completion callback: a slow
-            # manifest write (remote fs) must not expire a task whose
-            # work already succeeded
-            node.started_at = None
-            self._notify(on_complete, node)
+            with activate(ambient):
+                try:
+                    # first cancellation point: a task launched just
+                    # before a sibling failed aborts here instead of
+                    # doing work the run will discard
+                    token.raise_if_cancelled()
+                    node.started_at = time.monotonic()
+                    result = node.func(deps)
+                except BaseException as ex:
+                    f.set_exception(ex)
+                    return
+                # stop the wall clock BEFORE the completion callback: a
+                # slow manifest write (remote fs) must not expire a task
+                # whose work already succeeded
+                node.started_at = None
+                self._notify(on_complete, node)
             f.set_result(result)
 
         threading.Thread(

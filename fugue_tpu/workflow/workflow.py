@@ -4,6 +4,7 @@ fugue/workflow/workflow.py:88-2302 re-built on our own runner/tasks).
 ``FugueWorkflow()`` collects operations as deterministic tasks;
 ``run(engine)`` executes them (nothing is compiled before that)."""
 
+from contextlib import nullcontext
 from typing import Any, Callable, Dict, Iterable, List, Optional, Union
 from uuid import uuid4
 
@@ -49,6 +50,15 @@ from fugue_tpu.extensions.builtins import (
     Show,
     Take,
     Zip,
+)
+from fugue_tpu.obs import (
+    activate,
+    current_span,
+    finalize_trace,
+    obs_options,
+    open_trace,
+    start_span,
+    tracing_suppressed,
 )
 from fugue_tpu.rpc import make_rpc_server, to_rpc_handler
 from fugue_tpu.schema import Schema
@@ -842,12 +852,53 @@ class FugueWorkflow:
         abort signal), so never reuse a token across runs — a re-run
         with a fired token cancels immediately."""
         e = make_execution_engine(engine, conf)
+        # observability: under an AMBIENT trace (a serving daemon's job)
+        # this run is one child span; embedded with fugue.obs.enabled it
+        # OWNS a per-run trace — exported to fugue.obs.trace_path and
+        # slow-query-checked at the end
+        opts = obs_options(e.conf)
+        owned_trace = None
+        if not opts.enabled or tracing_suppressed():
+            # suppressed: a serving daemon's job whose request lost the
+            # sampling draw — re-drawing here would export uncorrelated
+            # traces at ~double the configured rate
+            run_scope: Any = nullcontext()
+        elif current_span() is not None:
+            run_scope = start_span("workflow.run", tasks=len(self._tasks))
+        else:
+            owned_trace, obs_root = open_trace(
+                opts,
+                "workflow.run",
+                workflow=self.__uuid__()[:12],
+                tasks=len(self._tasks),
+            )
+            run_scope = activate(obs_root)
+        try:
+            with run_scope:
+                return self._run_inner(e, conf, cancel_token)
+        finally:
+            finalize_trace(
+                owned_trace,
+                opts,
+                fs=e.fs,
+                log=e.log,
+                registry=e.metrics,
+                what="workflow.run",
+                workflow=self.__uuid__()[:12],
+            )
+
+    def _run_inner(
+        self,
+        e: Any,
+        conf: Any = None,
+        cancel_token: Any = None,
+    ) -> "FugueWorkflowResult":
         self._pre_run_analysis(e, run_conf=conf)
         execution_id = str(uuid4())
         rpc_server = make_rpc_server(e.conf)
         checkpoint_path = CheckpointPath(e)
         token = cancel_token if cancel_token is not None else CancelToken()
-        stats = RunStats()
+        stats = RunStats(registry=e.metrics)
         ctx = TaskContext(e, rpc_server, checkpoint_path, cancel_token=token)
         base_policy = RetryPolicy.from_conf(e.conf)
         # checkpoint-backed resume: None unless fugue.workflow.resume is on
@@ -951,28 +1002,35 @@ class FugueWorkflow:
             return task.execute(ctx, inputs)
 
         def run_task(inputs: List[Any]) -> Any:
-            try:
-                # manifest resume is OBSERVED here but served by the
-                # task's own checkpoint short-circuit inside execute():
-                # validations still fire and there is only one load path
-                if manifest is not None and manifest.can_resume(
-                    task, ctx, stats=stats
-                ):
-                    stats.note_resumed(task.name)
-                # each attempt inside holds the engine's dispatch guard
-                # (task_execution_lock): shared-engine device programs
-                # serialize per attempt, host phases overlap
-                return execute_with_policy(
-                    lambda: attempt(inputs),
-                    policy,
-                    engine=ctx.engine,
-                    token=token,
-                    task_name=task.name,
-                    stats=stats,
-                    log=ctx.engine.log,
-                )
-            except Exception as ex:
-                self._reraise_with_callsite(task, ex)
+            # one span per TaskNode execution (the runner worker thread
+            # inherits the run's context via DAGRunner._spawn); attempt
+            # spans nest under it from execute_with_policy
+            with start_span(
+                "task", task=task.name, type=task.task_type
+            ):
+                try:
+                    # manifest resume is OBSERVED here but served by the
+                    # task's own checkpoint short-circuit inside
+                    # execute(): validations still fire and there is
+                    # only one load path
+                    if manifest is not None and manifest.can_resume(
+                        task, ctx, stats=stats
+                    ):
+                        stats.note_resumed(task.name)
+                    # each attempt inside holds the engine's dispatch
+                    # guard (task_execution_lock): shared-engine device
+                    # programs serialize per attempt, host phases overlap
+                    return execute_with_policy(
+                        lambda: attempt(inputs),
+                        policy,
+                        engine=ctx.engine,
+                        token=token,
+                        task_name=task.name,
+                        stats=stats,
+                        log=ctx.engine.log,
+                    )
+                except Exception as ex:
+                    self._reraise_with_callsite(task, ex)
 
         return run_task
 

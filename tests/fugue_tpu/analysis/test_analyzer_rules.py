@@ -340,6 +340,40 @@ def test_fwf403_daemon_target_without_resume():
     assert not any(x.code == "FWF403" for x in _analyze(dag))
 
 
+def test_fwf404_trace_path_without_obs_enabled():
+    # a trace_path with obs off silently never writes a trace file —
+    # the classic "why is my Perfetto dir empty" misconfiguration
+    dag = FugueWorkflow()
+    dag.df([[0]], "a:int").persist()
+    diags = _analyze(dag, conf={"fugue.obs.trace_path": "/tmp/traces"})
+    d = _assert_diag(diags, "FWF404", Severity.WARN, needs_callsite=False)
+    assert "fugue.obs.enabled" in d.message
+    # string conf values are legitimate: "false" must still warn
+    assert any(
+        x.code == "FWF404"
+        for x in _analyze(
+            dag,
+            conf={
+                "fugue.obs.trace_path": "/tmp/traces",
+                "fugue.obs.enabled": "false",
+            },
+        )
+    )
+    # enabled -> the path is live: silent
+    assert not any(
+        x.code == "FWF404"
+        for x in _analyze(
+            dag,
+            conf={
+                "fugue.obs.trace_path": "/tmp/traces",
+                "fugue.obs.enabled": True,
+            },
+        )
+    )
+    # no trace path -> nothing to warn about
+    assert not any(x.code == "FWF404" for x in _analyze(dag))
+
+
 def test_analyze_with_live_engine_reads_engine_conf():
     # engine-dependent rules must read the LIVE engine's conf, not the
     # global defaults: an engine built with a row bucket has already
@@ -394,7 +428,7 @@ def test_every_rule_has_corpus_coverage():
     covered = {
         "FWF101", "FWF102", "FWF103", "FWF104", "FWF105", "FWF106",
         "FWF201", "FWF202", "FWF301", "FWF302", "FWF303", "FWF401",
-        "FWF402", "FWF403",
+        "FWF402", "FWF403", "FWF404",
     }
     assert {r.code for r in all_rules()} == covered
 

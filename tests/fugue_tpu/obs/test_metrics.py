@@ -1,0 +1,156 @@
+"""The metrics registry: counter/gauge/histogram semantics, labels,
+back-compat dict views, scrape-time collectors, and the Prometheus text
+exposition round trip. Tier-1 compatible; select with ``-m obs``."""
+
+import math
+import threading
+
+import pytest
+
+from fugue_tpu.obs.metrics import (
+    MetricsRegistry,
+    parse_prometheus_text,
+)
+
+pytestmark = pytest.mark.obs
+
+
+def test_counter_semantics_and_labels():
+    r = MetricsRegistry()
+    c = r.counter("x_total", "an x", ["op"])
+    c.labels(op="a").inc()
+    c.labels(op="a").inc(2)
+    c.labels(op="b").inc()
+    assert c.as_int_dict() == {"a": 3, "b": 1}
+    with pytest.raises(ValueError):
+        c.labels(op="a").inc(-1)  # counters only go up
+    with pytest.raises(ValueError):
+        c.labels(wrong="a")  # label names are fixed
+    c.clear()
+    assert c.as_int_dict() == {}
+
+
+def test_family_registration_is_idempotent_but_kind_checked():
+    r = MetricsRegistry()
+    a = r.counter("same_total", "help", ["k"])
+    assert r.counter("same_total", "other help", ["k"]) is a
+    with pytest.raises(ValueError):
+        r.gauge("same_total", "as a gauge")
+    with pytest.raises(ValueError):
+        r.counter("same_total", "other labels", ["different"])
+
+
+def test_gauge_and_unlabeled_child():
+    r = MetricsRegistry()
+    g = r.gauge("depth", "queue depth")
+    g.labels().set(7)
+    g.labels().inc(3)
+    g.labels().dec(1)
+    assert g.as_dict() == {"": 9.0}
+
+
+def test_histogram_buckets_are_cumulative_in_render():
+    r = MetricsRegistry()
+    h = r.histogram("lat_seconds", "latency", ["route"], buckets=(0.1, 1.0))
+    child = h.labels(route="sql")
+    for v in (0.05, 0.5, 0.5, 5.0):
+        child.observe(v)
+    snap = child.snapshot()
+    assert snap["count"] == 4
+    assert snap["buckets"] == {0.1: 1, 1.0: 3}  # cumulative
+    assert snap["sum"] == pytest.approx(6.05)
+    text = r.render()
+    parsed = parse_prometheus_text(text)
+    b = parsed["lat_seconds_bucket"]
+    assert b[(("route", "sql"), ("le", "0.1"))] == 1
+    assert b[(("route", "sql"), ("le", "1"))] == 3
+    assert b[(("route", "sql"), ("le", "+Inf"))] == 4
+    assert parsed["lat_seconds_count"][(("route", "sql"),)] == 4
+
+
+def test_prometheus_round_trip_with_escaping():
+    r = MetricsRegistry()
+    c = r.counter("esc_total", 'help with "quotes"\nand newline', ["msg"])
+    c.labels(msg='say "hi"\\now\n').inc(5)
+    parsed = parse_prometheus_text(r.render())
+    assert parsed["esc_total"][(("msg", 'say "hi"\\now\n'),)] == 5
+
+
+def test_empty_family_still_renders_schema():
+    r = MetricsRegistry()
+    r.counter("declared_total", "declared but never incremented", ["op"])
+    text = r.render()
+    assert "# HELP declared_total" in text
+    assert "# TYPE declared_total counter" in text
+
+
+def test_collectors_run_at_scrape_time_and_never_break_it():
+    r = MetricsRegistry()
+    g = r.gauge("live", "set by collector")
+    calls = []
+
+    def ok():
+        calls.append(1)
+        g.labels().set(len(calls))
+
+    def broken():
+        raise RuntimeError("boom")
+
+    r.add_collector(ok)
+    r.add_collector(broken)
+    snap = r.snapshot()
+    assert snap["live"]["samples"][0]["value"] == 1
+    parsed = parse_prometheus_text(r.render())
+    assert parsed["live"][()] == 2  # collector ran again
+
+
+def test_remove_collector_is_idempotent():
+    r = MetricsRegistry()
+    g = r.gauge("v", "v")
+    calls = []
+
+    def coll():
+        calls.append(1)
+        g.labels().set(1)
+
+    r.add_collector(coll)
+    r.snapshot()
+    assert calls == [1]
+    r.remove_collector(coll)
+    r.remove_collector(coll)  # idempotent
+    r.snapshot()
+    assert calls == [1]  # no longer invoked
+
+
+def test_snapshot_shape():
+    r = MetricsRegistry()
+    r.counter("c_total", "c", ["k"]).labels(k="x").inc()
+    snap = r.snapshot()
+    assert snap["c_total"]["type"] == "counter"
+    assert snap["c_total"]["samples"] == [
+        {"labels": {"k": "x"}, "value": 1.0}
+    ]
+
+
+def test_concurrent_increments_are_not_lost():
+    r = MetricsRegistry()
+    child = r.counter("n_total", "n").labels()
+
+    def work():
+        for _ in range(1000):
+            child.inc()
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert child.value == 8000
+
+
+def test_parse_handles_inf_and_unlabeled():
+    text = "# TYPE x gauge\nx 4\ny_bucket{le=\"+Inf\"} 2\n"
+    parsed = parse_prometheus_text(text)
+    assert parsed["x"][()] == 4
+    assert parsed["y_bucket"][(("le", "+Inf"),)] == 2
+    assert not math.isinf(parsed["x"][()])

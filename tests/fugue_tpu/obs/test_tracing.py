@@ -1,0 +1,393 @@
+"""The span tracer: tree shape, cross-thread propagation, the
+retry+OOM-degrade span tree matching ``RunStats``, Chrome-trace export,
+the slow-query log, and chaos-degraded trace writes. Tier-1 compatible;
+select with ``-m obs``."""
+
+import json
+import threading
+
+import jax
+import pandas as pd
+import pytest
+
+from fugue_tpu.constants import (
+    FUGUE_CONF_WORKFLOW_RETRY_BACKOFF,
+    FUGUE_CONF_WORKFLOW_RETRY_JITTER,
+    FUGUE_CONF_WORKFLOW_RETRY_MAX_ATTEMPTS,
+)
+from fugue_tpu.obs import span_breakdown
+from fugue_tpu.obs.trace import (
+    Trace,
+    activate,
+    begin_span,
+    current_span,
+    start_span,
+)
+from fugue_tpu.testing.faults import FaultPlan, FaultSpec, inject_faults
+from fugue_tpu.workflow import FugueWorkflow
+
+pytestmark = pytest.mark.obs
+
+_FAST_RETRY = {
+    FUGUE_CONF_WORKFLOW_RETRY_MAX_ATTEMPTS: 3,
+    FUGUE_CONF_WORKFLOW_RETRY_BACKOFF: 0.01,
+    FUGUE_CONF_WORKFLOW_RETRY_JITTER: 0.0,
+}
+
+def _obs(path: str) -> dict:
+    """Obs conf with a per-test trace dir (memory:// is process-global,
+    and trace filenames are random hex — tests must not share dirs)."""
+    return {
+        "fugue.obs.enabled": True,
+        "fugue.obs.trace_path": f"memory://{path}",
+    }
+
+
+class FakeXlaRuntimeError(Exception):
+    pass
+
+
+FakeXlaRuntimeError.__name__ = "XlaRuntimeError"
+
+
+def _read_trace(engine, base):
+    files = sorted(engine.fs.listdir(base))
+    assert len(files) >= 1
+    uri = engine.fs.join(base, files[-1])
+    return json.loads(engine.fs.read_bytes(uri).decode("utf-8"))
+
+
+def _tree(events):
+    """(by_id, chain(event) -> root-first span-name path)."""
+    by_id = {e["args"]["span_id"]: e for e in events}
+
+    def chain(e):
+        out = [e["name"]]
+        while "parent_id" in e["args"]:
+            e = by_id[e["args"]["parent_id"]]
+            out.append(e["name"])
+        return list(reversed(out))
+
+    return by_id, chain
+
+
+# ---------------------------------------------------------------------------
+# tracer core
+# ---------------------------------------------------------------------------
+def test_span_nesting_and_parent_links():
+    t = Trace("t1")
+    root = t.root("root")
+    with activate(root):
+        with start_span("a") as a:
+            assert current_span() is a
+            with start_span("b", k=1) as b:
+                assert b.parent_id == a.span_id
+        assert current_span() is root
+    root.finish()
+    assert t.complete
+    assert [s.name for s in t.spans] == ["root", "a", "b"]
+    assert t.spans[1].parent_id == root.span_id
+
+
+def test_span_error_attr_on_raise():
+    t = Trace()
+    root = t.root("root")
+    with activate(root):
+        with pytest.raises(ValueError):
+            with start_span("bad"):
+                raise ValueError("boom")
+    assert t.find("bad")[0].attrs["error"] == "ValueError"
+    assert t.find("bad")[0].end_ns is not None
+
+
+def test_cross_thread_activate():
+    t = Trace()
+    root = t.root("root")
+    seen = []
+
+    def worker():
+        with activate(root):
+            with start_span("child") as c:
+                seen.append(c.thread_id)
+
+    th = threading.Thread(target=worker)
+    th.start()
+    th.join()
+    assert t.find("child")[0].parent_id == root.span_id
+    assert seen[0] != threading.get_ident()
+    assert current_span() is None  # caller thread untouched
+
+
+def test_begin_span_is_manual_and_not_pushed():
+    t = Trace()
+    root = t.root("root")
+    with activate(root):
+        m = begin_span("manual", bytes=10)
+        assert current_span() is root  # not pushed
+        m.finish()
+    assert t.find("manual")[0].attrs == {"bytes": 10}
+
+
+# ---------------------------------------------------------------------------
+# the acceptance tree: retry + OOM-degrade run, spans match RunStats
+# ---------------------------------------------------------------------------
+def test_retry_span_tree_matches_run_stats():
+    from fugue_tpu.execution import make_execution_engine
+
+    e = make_execution_engine("native", {**_FAST_RETRY, **_obs("obs_tr_retry")})
+    plan = FaultPlan(
+        FaultSpec(
+            "task", "CreateData*", times=2,
+            error=lambda: OSError("EIO: injected hiccup"),
+        )
+    )
+    dag = FugueWorkflow()
+    dag.df(pd.DataFrame({"x": [1, 2, 3]})).yield_dataframe_as(
+        "out", as_local=True
+    )
+    with inject_faults(plan):
+        res = dag.run(e)
+    retries = sum(res.fault_stats["retries"].values())
+    assert retries == 2
+    data = _read_trace(e, "memory://obs_tr_retry")
+    events = data["traceEvents"]
+    by_id, chain = _tree(events)
+    tasks = [ev for ev in events if ev["name"] == "task"]
+    attempts = [ev for ev in events if ev["name"] == "task.attempt"]
+    assert len(tasks) == 1
+    # attempt spans == RunStats retries + the succeeding attempt
+    assert len(attempts) == retries + 1
+    assert [a["args"]["attempt"] for a in attempts] == [1, 2, 3]
+    # the failed attempts carry the injected error class
+    assert [a["args"].get("error") for a in attempts] == [
+        "OSError", "OSError", None,
+    ]
+    for a in attempts:
+        assert chain(a) == ["workflow.run", "task", "task.attempt"]
+
+
+def test_oom_degrade_span_tree_matches_run_stats():
+    from fugue_tpu.jax_backend.blocks import make_mesh
+    from fugue_tpu.jax_backend.execution_engine import JaxExecutionEngine
+
+    e = JaxExecutionEngine({**_FAST_RETRY, **_obs("obs_tr_oom")})
+    try:
+        # a DISTINCT host mesh on a CPU-only box so degrade is real
+        e._host_mesh = make_mesh(jax.devices("cpu")[:4])
+        assert e.supports_host_degrade
+        plan = FaultPlan(
+            FaultSpec(
+                "task", "CreateData*", times=1,
+                error=lambda: FakeXlaRuntimeError(
+                    "RESOURCE_EXHAUSTED: failed to allocate 9.99G"
+                ),
+            )
+        )
+        dag = FugueWorkflow()
+        dag.df(pd.DataFrame({"x": [1, 2, 3]})).yield_dataframe_as(
+            "out", as_local=True
+        )
+        with inject_faults(plan):
+            res = dag.run(e)
+        assert sum(res.fault_stats["degradations"].values()) == 1
+        events = _read_trace(e, "memory://obs_tr_oom")["traceEvents"]
+        attempts = [ev for ev in events if ev["name"] == "task.attempt"]
+        # one device attempt (failed with the injected OOM) + one
+        # host-tier degraded attempt, no retry consumed
+        assert len(attempts) == 2
+        device, degraded = attempts
+        assert device["args"]["error"] == "XlaRuntimeError"
+        assert degraded["args"].get("tier") == "host"
+        assert degraded["args"].get("degraded") is True
+        assert sum(res.fault_stats["retries"].values()) == 0
+        # the fault-events mirror landed on the engine registry too
+        fam = e.metrics.get("fugue_workflow_fault_events_total")
+        assert fam.as_int_dict()["degradation"] == 1
+    finally:
+        e.stop()
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+def test_chrome_trace_events_are_perfetto_shaped():
+    from fugue_tpu.execution import make_execution_engine
+
+    e = make_execution_engine("native", _obs("obs_tr_chrome"))
+    dag = FugueWorkflow()
+    dag.df(pd.DataFrame({"x": [1]})).yield_dataframe_as("o", as_local=True)
+    dag.run(e)
+    data = _read_trace(e, "memory://obs_tr_chrome")
+    assert data["displayTimeUnit"] == "ms"
+    for ev in data["traceEvents"]:
+        assert ev["ph"] == "X"
+        assert ev["cat"] == "fugue_tpu"
+        assert ev["dur"] >= 0
+        assert "trace_id" in ev["args"] and "span_id" in ev["args"]
+    roots = [ev for ev in data["traceEvents"] if "parent_id" not in ev["args"]]
+    assert len(roots) == 1 and roots[0]["name"] == "workflow.run"
+
+
+def test_slow_query_log_records_span_breakdown(caplog):
+    import logging
+
+    from fugue_tpu.execution import make_execution_engine
+
+    e = make_execution_engine(
+        "native",
+        {
+            "fugue.obs.enabled": True,
+            "fugue.obs.slow_query_ms": 0.000001,  # everything is slow
+        },
+    )
+    dag = FugueWorkflow()
+    dag.df(pd.DataFrame({"x": [1]})).yield_dataframe_as("o", as_local=True)
+    with caplog.at_level(logging.WARNING):
+        dag.run(e)
+    recs = [
+        r for r in caplog.records if "slow query" in r.getMessage()
+    ]
+    assert len(recs) == 1
+    payload = json.loads(recs[0].getMessage().split("slow query: ", 1)[1])
+    assert payload["duration_ms"] > 0
+    assert "task" in payload["breakdown"]["phases"]
+    assert payload["breakdown"]["spans"] >= 2
+    fam = e.metrics.get("fugue_obs_slow_queries_total")
+    assert fam.as_int_dict()[""] == 1
+
+
+def test_span_breakdown_rollup():
+    t = Trace("b")
+    root = t.root("root")
+    with activate(root):
+        with start_span("phase"):
+            pass
+        with start_span("phase"):
+            pass
+    root.finish()
+    b = span_breakdown(t)
+    assert b["phases"]["phase"]["count"] == 2
+    assert b["spans"] == 3
+
+
+def test_failing_trace_write_degrades_without_failing_the_run(caplog):
+    import logging
+
+    from fugue_tpu.execution import make_execution_engine
+
+    e = make_execution_engine("native", _obs("obs_tr_chaos"))
+    plan = FaultPlan(
+        FaultSpec(
+            "obs.trace", "*", times=1,
+            error=lambda: OSError("injected trace-write failure"),
+        )
+    )
+    dag = FugueWorkflow()
+    dag.df(pd.DataFrame({"x": [7]})).yield_dataframe_as("o", as_local=True)
+    with inject_faults(plan), caplog.at_level(logging.WARNING):
+        res = dag.run(e)  # the run itself must succeed
+    assert res["o"].as_array() == [[7]]
+    assert plan.total("injected") == 1
+    fam = e.metrics.get("fugue_obs_trace_export_failures_total")
+    assert fam.as_int_dict()[""] == 1
+    assert any(
+        "trace export" in r.getMessage() for r in caplog.records
+    )
+    # and no trace file landed in this test's dir
+    assert not e.fs.exists("memory://obs_tr_chaos") or (
+        e.fs.listdir("memory://obs_tr_chaos") == []
+    )
+
+
+def test_alloc_failure_mid_gate_does_not_pin_the_trace_open():
+    # the memory gate's before() runs without a matching after() when
+    # the allocation raises (the device.alloc chaos site) and the fault
+    # layer degrades the attempt to the host tier — the trace must still
+    # COMPLETE and export (a leaked transfer span would pin it open,
+    # losing the trace of exactly the interesting OOM request)
+    from fugue_tpu.jax_backend.blocks import make_mesh
+    from fugue_tpu.jax_backend.execution_engine import JaxExecutionEngine
+    from fugue_tpu.testing.faults import resource_exhausted
+
+    e = JaxExecutionEngine(
+        {
+            **_FAST_RETRY,
+            **_obs("obs_tr_gate"),
+            "fugue.jax.placement": "device",
+        }
+    )
+    try:
+        e._host_mesh = make_mesh(jax.devices("cpu")[:4])
+        assert e.supports_host_degrade
+        plan = FaultPlan(
+            FaultSpec(
+                "device.alloc", "device", times=1,
+                error=lambda: resource_exhausted(10_000),
+            )
+        )
+        dag = FugueWorkflow()
+        df = dag.df(pd.DataFrame({"x": [1, 2, 3]}))
+        df.persist()  # device op: materializes through the gate
+        df.yield_dataframe_as("out", as_local=True)
+        with inject_faults(plan):
+            res = dag.run(e)
+        assert res["out"].as_array() == [[1], [2], [3]]
+        assert plan.total("injected") == 1
+        # the trace completed and exported despite the interrupted gate
+        data = _read_trace(e, "memory://obs_tr_gate")
+        names = [ev["name"] for ev in data["traceEvents"]]
+        assert "task.attempt" in names
+        # the degraded (host-tier) re-run's transfer window IS spanned
+        transfers = [
+            ev for ev in data["traceEvents"]
+            if ev["name"] == "engine.transfer"
+        ]
+        assert any(t["args"]["bytes"] > 0 for t in transfers)
+    finally:
+        e.stop()
+
+
+def test_recompile_on_new_shape_is_labeled_compile():
+    # with row_bucket=0 every distinct shape recompiles: the SECOND
+    # dispatch of the same logical program must still be labeled
+    # engine.compile (and counted as a miss), not mislabeled a hit
+    from fugue_tpu.jax_backend.execution_engine import JaxExecutionEngine
+
+    e = JaxExecutionEngine(_obs("obs_tr_recompile"))
+    try:
+        import jax.numpy as jnp
+
+        fn = e._jit_cached("probe", lambda x: x + 1)
+        t = Trace("probe")
+        root = t.root("root")
+        with activate(root):
+            fn(jnp.arange(4))   # new shape: compile
+            fn(jnp.arange(4))   # cached: execute
+            fn(jnp.arange(9))   # NEW shape: compile again
+        root.finish()
+        names = [s.name for s in t.spans]
+        assert names == [
+            "root", "engine.compile", "engine.execute", "engine.compile",
+        ]
+        assert e.compile_cache_stats == {"hits": 1, "misses": 2}
+    finally:
+        e.stop()
+
+
+def test_sample_rate_zero_opens_no_trace():
+    from fugue_tpu.execution import make_execution_engine
+
+    e = make_execution_engine(
+        "native",
+        {
+            "fugue.obs.enabled": True,
+            "fugue.obs.trace_path": "memory://obs_sampled_out",
+            "fugue.obs.sample_rate": 0.0,
+        },
+    )
+    dag = FugueWorkflow()
+    dag.df(pd.DataFrame({"x": [1]})).yield_dataframe_as("o", as_local=True)
+    dag.run(e)
+    assert not e.fs.exists("memory://obs_sampled_out") or (
+        e.fs.listdir("memory://obs_sampled_out") == []
+    )

@@ -1,0 +1,336 @@
+"""Serve-plane observability (ISSUE 8): X-Request-Id accept/generate/
+echo on every response (success AND failure), the request id in job
+snapshots and the async job journal across restarts, the Prometheus
+``/v1/metrics`` endpoint, the new ``/v1/status`` fields, and the serve-
+path span tree. Tier-1 compatible; select with ``-m serve`` or
+``-m obs``."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from fugue_tpu.constants import (
+    FUGUE_CONF_SERVE_BREAKER_THRESHOLD,
+    FUGUE_CONF_SERVE_STATE_PATH,
+)
+from fugue_tpu.obs import parse_prometheus_text
+from fugue_tpu.serve import ServeDaemon
+from fugue_tpu.serve.daemon import clean_request_id, new_request_id
+
+pytestmark = [pytest.mark.serve, pytest.mark.obs]
+
+_CREATE = "CREATE [[0,1],[0,2],[1,3],[1,4]] SCHEMA k:long,v:long"
+_QUERY = (
+    "t = CREATE [[0,1],[0,2],[1,3],[1,4]] SCHEMA k:long,v:long\n"
+    "SELECT k, SUM(v) AS s FROM t GROUP BY k"
+)
+_NO_BREAKER = {FUGUE_CONF_SERVE_BREAKER_THRESHOLD: 0}
+
+
+def _request(base, path, payload=None, method=None, headers=None):
+    """(status, headers, parsed JSON body) via raw urllib, so response
+    headers are observable (ServeClient hides them)."""
+    data = None if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        base + path,
+        data=data,
+        method=method or ("POST" if data is not None else "GET"),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, dict(resp.headers), json.loads(resp.read())
+    except urllib.error.HTTPError as ex:
+        body = ex.read()
+        return ex.code, dict(ex.headers), (
+            json.loads(body) if body else {}
+        )
+
+
+def test_request_id_sanitizer():
+    assert clean_request_id("abc-123.X_z") == "abc-123.X_z"
+    assert clean_request_id("  spaced  ") == "spaced"
+    assert clean_request_id(None) is None
+    assert clean_request_id("") is None
+    assert clean_request_id("../../etc/passwd") is None
+    assert clean_request_id("x" * 65) is None
+    assert clean_request_id("has space") is None
+    assert new_request_id().startswith("req-")
+
+
+def test_request_id_echoed_on_every_response():
+    with ServeDaemon(dict(_NO_BREAKER)) as daemon:
+        base = "http://%s:%d" % daemon.address
+        # provided -> echoed verbatim
+        st, hdr, body = _request(
+            base, "/v1/sessions", {}, headers={"X-Request-Id": "cli-42"}
+        )
+        assert st == 200 and hdr["X-Request-Id"] == "cli-42"
+        sid = body["session_id"]
+        # absent -> generated
+        st, hdr, _ = _request(base, "/v1/status")
+        assert st == 200 and hdr["X-Request-Id"].startswith("req-")
+        # unsafe -> replaced, never echoed raw
+        st, hdr, _ = _request(
+            base, "/v1/status", headers={"X-Request-Id": "../evil path"}
+        )
+        assert st == 200 and hdr["X-Request-Id"].startswith("req-")
+        # 404 still echoes
+        st, hdr, _ = _request(
+            base, "/v1/jobs/nope", headers={"X-Request-Id": "miss-1"}
+        )
+        assert st == 404 and hdr["X-Request-Id"] == "miss-1"
+        # 400 (malformed JSON body) is answered BEFORE routing — echoed
+        req = urllib.request.Request(
+            base + "/v1/sessions",
+            data=b"{not json",
+            method="POST",
+            headers={"X-Request-Id": "bad-body-7"},
+        )
+        try:
+            urllib.request.urlopen(req)
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as ex:
+            assert ex.code == 400
+            assert ex.headers["X-Request-Id"] == "bad-body-7"
+        # the id rides the job snapshot too
+        st, hdr, snap = _request(
+            base,
+            f"/v1/sessions/{sid}/sql",
+            {"sql": _CREATE, "mode": "sync"},
+            headers={"X-Request-Id": "job-rid-9"},
+        )
+        assert st == 200 and snap["request_id"] == "job-rid-9"
+        assert hdr["X-Request-Id"] == "job-rid-9"
+
+
+def test_rejection_responses_echo_request_id_with_retry_after():
+    with ServeDaemon(dict(_NO_BREAKER)) as daemon:
+        base = "http://%s:%d" % daemon.address
+        daemon._health.start_drain(5.0)  # draining: submissions get 503
+        st, hdr, body = _request(
+            base, "/v1/sessions", {}, headers={"X-Request-Id": "rej-1"}
+        )
+        assert st == 503
+        assert hdr["X-Request-Id"] == "rej-1"
+        assert "Retry-After" in hdr
+        assert body["error"]["error"] == "BackpressureError"
+
+
+def test_journal_keeps_request_id_across_restart(tmp_path):
+    conf = dict(_NO_BREAKER)
+    conf[FUGUE_CONF_SERVE_STATE_PATH] = str(tmp_path / "state")
+    daemon = ServeDaemon(conf).start()
+    try:
+        sid = daemon.sessions.create().session_id
+        # journal BEFORE dispatch: freeze the scheduler pickup by
+        # swapping the executor, then submit async
+        import threading
+
+        release = threading.Event()
+        real = daemon.scheduler._execute
+        daemon.scheduler._execute = lambda job: (
+            release.wait(timeout=60),
+            real(job),
+        )[1]
+        job = daemon.submit(
+            sid, _CREATE, wait=False, request_id="persist-me-1"
+        )
+        # the journal entry carries the correlation id
+        data = json.loads(
+            (tmp_path / "state" / "serve_state.json").read_text()
+        )
+        assert data["jobs"][job.job_id]["request_id"] == "persist-me-1"
+        daemon._hard_kill()
+    finally:
+        release.set()
+        daemon.stop()
+    # a restarted daemon resubmits the job under the same ids
+    daemon2 = ServeDaemon(conf).start()
+    try:
+        snap = daemon2.scheduler.get(job.job_id)
+        assert snap.request_id == "persist-me-1"
+        snap.done_event.wait(timeout=60)
+        assert daemon2.scheduler.get(job.job_id).snapshot()[
+            "request_id"
+        ] == "persist-me-1"
+    finally:
+        daemon2.stop()
+
+
+def test_metrics_endpoint_prometheus_exposition():
+    with ServeDaemon(dict(_NO_BREAKER)) as daemon:
+        base = "http://%s:%d" % daemon.address
+        _, _, body = _request(base, "/v1/sessions", {})
+        sid = body["session_id"]
+        st, _, snap = _request(
+            base, f"/v1/sessions/{sid}/sql", {"sql": _QUERY, "mode": "sync"}
+        )
+        assert snap["status"] == "done"
+        req = urllib.request.Request(base + "/v1/metrics")
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            assert "X-Request-Id" in resp.headers
+            text = resp.read().decode("utf-8")
+        parsed = parse_prometheus_text(text)
+        # the acceptance families: fallback, memory, backpressure,
+        # breaker, latency histogram
+        assert "# TYPE fugue_engine_fallbacks_total counter" in text
+        mem = parsed["fugue_engine_memory_bytes"]
+        assert (("tier", "device"),) in mem and (("tier", "host"),) in mem
+        rej = parsed["fugue_serve_rejections_total"]
+        assert rej[(("kind", "queue_full"),)] == 0  # pre-touched schema
+        states = parsed["fugue_serve_breaker_states"]
+        assert (("state", "closed"),) in states
+        lat = parsed["fugue_serve_request_seconds_count"]
+        assert lat[(("route", "sessions"),)] >= 2
+        assert parsed["fugue_serve_requests_total"][
+            (("route", "sessions"), ("status", "200"))
+        ] >= 2
+        jobs = parsed["fugue_serve_job_seconds_count"]
+        assert jobs[(("status", "done"),)] == 1
+        # compile-cache counters flowed from the engine
+        assert "fugue_engine_compile_cache_total" in parsed or (
+            "# TYPE fugue_engine_compile_cache_total counter" in text
+        )
+        # registry snapshot() serves the embedded path with same data
+        snap2 = daemon.engine.metrics.snapshot()
+        assert snap2["fugue_serve_job_seconds"]["samples"][0]["count"] == 1
+
+
+def test_status_gains_uptime_version_and_compile_cache():
+    import fugue_tpu
+
+    with ServeDaemon(dict(_NO_BREAKER)) as daemon:
+        st = daemon.status()
+        assert st["uptime_secs"] >= 0
+        assert st["uptime_secs"] == st["uptime_seconds"]
+        assert st["version"] == fugue_tpu.__version__
+        assert set(st["compile_cache"]) == {"hits", "misses"}
+        # the historical shapes survived the registry migration
+        assert set(st["backpressure"]["rejections"]) == {
+            "draining", "queue_full", "memory_pressure", "session_cap",
+            "breaker_open", "sync_degraded",
+        }
+        assert set(st["fault_stats"]) == {
+            "runs", "retries", "recoveries", "degradations",
+            "integrity_rejected", "resumed",
+        }
+
+
+def test_sampled_out_request_suppresses_workflow_owned_traces():
+    # a job whose request lost the sampling draw must NOT fall through
+    # to workflow.run's embedded trace owner — that would export
+    # uncorrelated traces at ~double the configured rate
+    conf = dict(_NO_BREAKER)
+    conf.update(
+        {
+            "fugue.obs.enabled": True,
+            "fugue.obs.trace_path": "memory://obs_serve_sampled",
+            "fugue.obs.sample_rate": 0.0,  # every request loses
+        }
+    )
+    with ServeDaemon(conf) as daemon:
+        base = "http://%s:%d" % daemon.address
+        _, _, body = _request(base, "/v1/sessions", {})
+        sid = body["session_id"]
+        st, _, snap = _request(
+            base, f"/v1/sessions/{sid}/sql", {"sql": _CREATE, "mode": "sync"}
+        )
+        assert st == 200 and snap["status"] == "done"
+        fs = daemon.engine.fs
+        assert not fs.exists("memory://obs_serve_sampled") or (
+            fs.listdir("memory://obs_serve_sampled") == []
+        )
+
+
+def test_second_daemon_on_same_engine_starts_status_at_zero():
+    from fugue_tpu.execution import make_execution_engine
+
+    engine = make_execution_engine("jax", dict(_NO_BREAKER))
+    engine.retain()  # keep alive across daemon lifecycles
+    try:
+        with ServeDaemon(engine=engine) as d1:
+            d1._count_reject("queue_full")
+            d1._count_reject("queue_full")
+            assert d1.status()["backpressure"]["rejections"][
+                "queue_full"
+            ] == 2
+        # registry counters are process-monotonic...
+        fam = engine.metrics.get("fugue_serve_rejections_total")
+        assert fam.as_int_dict()["queue_full"] == 2
+        # ...but a fresh daemon's status() payload is daemon-scoped,
+        # like the dicts the registry replaced
+        with ServeDaemon(engine=engine) as d2:
+            rej = d2.status()["backpressure"]["rejections"]
+            assert rej["queue_full"] == 0
+            d2._count_reject("draining")
+            assert d2.status()["backpressure"]["rejections"][
+                "draining"
+            ] == 1
+    finally:
+        engine.release()
+
+
+def test_serve_trace_tree_links_request_to_engine_spans():
+    conf = dict(_NO_BREAKER)
+    conf.update(
+        {
+            "fugue.obs.enabled": True,
+            "fugue.obs.trace_path": "memory://obs_serve_tree",
+            "fugue.jax.placement": "device",
+        }
+    )
+    with ServeDaemon(conf) as daemon:
+        base = "http://%s:%d" % daemon.address
+        _, _, body = _request(base, "/v1/sessions", {})
+        sid = body["session_id"]
+        st, hdr, snap = _request(
+            base,
+            f"/v1/sessions/{sid}/sql",
+            {"sql": _QUERY, "mode": "sync"},
+            headers={"X-Request-Id": "trace-me-1"},
+        )
+        assert st == 200 and snap["status"] == "done"
+        fs = daemon.engine.fs
+        uri = fs.join("memory://obs_serve_tree", "trace-trace-me-1.json")
+        data = json.loads(fs.read_bytes(uri).decode("utf-8"))
+        events = data["traceEvents"]
+        by_id = {e["args"]["span_id"]: e for e in events}
+
+        def chain(e):
+            out = [e["name"]]
+            while "parent_id" in e["args"]:
+                e = by_id[e["args"]["parent_id"]]
+                out.append(e["name"])
+            return list(reversed(out))
+
+        names = {e["name"] for e in events}
+        # HTTP request -> job -> task attempts -> engine children
+        assert {
+            "http.request", "serve.job", "serve.execute", "workflow.run",
+            "task", "task.attempt",
+        } <= names
+        assert "engine.compile" in names or "engine.execute" in names
+        assert "engine.transfer" in names
+        attempt = next(e for e in events if e["name"] == "task.attempt")
+        assert chain(attempt) == [
+            "http.request", "serve.job", "serve.execute", "workflow.run",
+            "task", "task.attempt",
+        ]
+        eng = next(
+            e for e in events
+            if e["name"] in ("engine.compile", "engine.execute")
+        )
+        assert chain(eng)[:4] == [
+            "http.request", "serve.job", "serve.execute", "workflow.run",
+        ]
+        transfer = next(e for e in events if e["name"] == "engine.transfer")
+        assert transfer["args"]["bytes"] > 0
+        # the root is the request, stamped with the correlation id
+        root = next(e for e in events if "parent_id" not in e["args"])
+        assert root["name"] == "http.request"
+        assert root["args"]["request_id"] == "trace-me-1"
