@@ -123,6 +123,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         "on any error-level diagnostic (pre-merge gate)",
     )
     p.add_argument(
+        "--optimize",
+        action="store_true",
+        help="with --self-test: additionally rewrite every corpus "
+        "workflow with the DAG optimizer's full rule set and assert the "
+        "optimized plans still pass schema propagation (exit nonzero on "
+        "any rewrite that breaks it)",
+    )
+    p.add_argument(
         "--conf",
         action="append",
         default=[],
@@ -155,7 +163,32 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"{len(results)} workflows analyzed",
             file=sys.stdout,
         )
+        if args.optimize:
+            from fugue_tpu.analysis.selftest import (
+                optimize_check_failed,
+                run_optimize_check,
+            )
+
+            opt_results = run_optimize_check()
+            for name, applied, diags in opt_results:
+                _print_diags(
+                    f"{name} [optimized: {applied} rewrites]",
+                    [d for d in diags if d.severity >= floor],
+                    sys.stdout,
+                )
+            opt_failed = optimize_check_failed(opt_results)
+            total_applied = sum(a for _, a, _ in opt_results)
+            print(
+                f"optimize-check {'FAILED' if opt_failed else 'passed'}: "
+                f"{len(opt_results)} workflows rewritten "
+                f"({total_applied} rewrites applied)",
+                file=sys.stdout,
+            )
+            failed = failed or opt_failed
         return 1 if failed else 0
+    if args.optimize:
+        print("--optimize requires --self-test", file=sys.stderr)
+        return 2
 
     if not args.target:
         p.print_usage(sys.stderr)

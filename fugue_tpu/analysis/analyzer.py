@@ -14,6 +14,7 @@ from typing import Any, Dict, List, Optional, Sequence, Set, Type
 
 import fugue_tpu.analysis.conf_pass  # noqa: F401  (register rules)
 import fugue_tpu.analysis.cost_pass  # noqa: F401  (register rules)
+import fugue_tpu.analysis.optimize_pass  # noqa: F401  (register rules)
 from fugue_tpu.analysis.diagnostics import (
     GENERIC,
     JAX,
@@ -82,10 +83,14 @@ class Analyzer:
         conf: Any = None,
         engine: Any = None,
         scopes: Optional[Set[str]] = None,
+        exclude_lint_only: bool = False,
     ) -> List[Diagnostic]:
         """Analyze a built (unexecuted) workflow. ``scopes`` defaults to
         engine-appropriate: with a non-jax engine only generic rules run;
-        with no engine at all (lint mode) every scope runs."""
+        with no engine at all (lint mode) every scope runs.
+        ``exclude_lint_only`` skips rules marked ``lint_only`` — the
+        pre-run gate sets it (those rules duplicate work ``run()`` is
+        about to do anyway)."""
         if scopes is None:
             if engine is None:
                 scopes = {GENERIC, JAX}
@@ -98,6 +103,8 @@ class Analyzer:
         out: List[Diagnostic] = []
         for rule_cls in self._rules if self._rules is not None else all_rules():
             if rule_cls.scope not in scopes:
+                continue
+            if exclude_lint_only and rule_cls.lint_only:
                 continue
             try:
                 out.extend(rule_cls().check(ctx))

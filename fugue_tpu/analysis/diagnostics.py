@@ -95,6 +95,12 @@ class Rule:
     severity: Severity = Severity.WARN
     scope: str = GENERIC
     description: str = ""
+    # lint_only rules run in analyze()/lint/CLI but are EXCLUDED from
+    # the pre-run fugue.analysis gate — e.g. FWF501's optimizer dry-run,
+    # which run() is about to perform for real anyway (running it in
+    # the gate would double the per-run planning cost for no findings
+    # the log doesn't already get from the optimizer itself)
+    lint_only: bool = False
 
     def check(self, ctx: Any) -> Iterable[Diagnostic]:  # pragma: no cover
         raise NotImplementedError

@@ -1,0 +1,64 @@
+"""Optimizer-report rule: surface the DAG optimizer's decisions without
+executing anything.
+
+FWF501 dry-runs the rewrite phase (:mod:`fugue_tpu.optimize`) over the
+analyzed task graph — the optimizer clones internally, so the user's
+workflow is untouched — and reports one info-level diagnostic per
+applied/declined rewrite with the offending task's name and user
+callsite. ``lint_sql()`` and the CLI therefore show what the optimizer
+WOULD do to a query before it ever runs."""
+
+from typing import Any, Iterable
+
+from fugue_tpu.analysis.diagnostics import (
+    JAX,
+    Diagnostic,
+    Rule,
+    Severity,
+    register_rule,
+)
+
+
+@register_rule
+class OptimizerRewriteReportRule(Rule):
+    code = "FWF501"
+    severity = Severity.INFO
+    scope = JAX  # the rewrite phase is jax-gated (fugue.optimize=auto)
+    # excluded from the pre-run fugue.analysis gate: run() performs the
+    # rewrite for real right after and logs the applied notes itself —
+    # dry-running here too would double every run's planning cost
+    lint_only = True
+    description = (
+        "reports each rewrite the DAG optimizer would apply or decline "
+        "(dry run: projection/filter pushdown, fusion, CSE)"
+    )
+
+    def check(self, ctx: Any) -> Iterable[Diagnostic]:
+        from fugue_tpu.constants import FUGUE_CONF_OPTIMIZE
+        from fugue_tpu.optimize import optimize_enabled, optimize_tasks
+        from fugue_tpu.optimize.rewrite import OFF_VALUES
+
+        mode = str(ctx.conf.get(FUGUE_CONF_OPTIMIZE, "auto")).strip().lower()
+        if mode in OFF_VALUES:
+            return
+        try:
+            optimize_enabled(ctx.conf, ctx.engine)
+        except ValueError as ex:
+            # the same conf makes run() raise before executing anything:
+            # the lint surface must flag it, not cheerfully report
+            # rewrites for a run that will crash
+            yield self.diag(str(ex), severity=Severity.ERROR)
+            return
+        # engine-agnostic lint mode (engine=None) still dry-runs under
+        # "auto": the jax scope selection already narrows when a real
+        # non-jax engine is known
+        plan = optimize_tasks(ctx.tasks, conf=ctx.conf, engine=ctx.engine)
+        for note in plan.notes:
+            yield Diagnostic(
+                code=self.code,
+                severity=self.severity,
+                message=note.describe(),
+                task_name=note.task_name,
+                callsite=note.callsite,
+                rule=type(self).__name__,
+            )

@@ -92,12 +92,31 @@ def _wf_deep_chain(n: int = 50) -> Any:
     return dag
 
 
+def _wf_join_filter_narrow() -> Any:
+    """Join + filter + narrow select (ISSUE 10): the optimizer's bread
+    and butter — filter pushdown below the rename, chain fusion, and a
+    projection requirement that narrows both join sides."""
+    from fugue_tpu.column.expressions import col
+    from fugue_tpu.workflow.workflow import FugueWorkflow
+
+    dag = FugueWorkflow()
+    left = dag.df(
+        [[i, float(i), f"u{i}"] for i in range(8)], "k:int,v:double,name:str"
+    )
+    right = dag.df([[i, i * 10] for i in range(8)], "k:int,w:long")
+    joined = left.inner_join(right, on=["k"])
+    out = joined.rename({"w": "weight"}).filter(col("weight") > 20)
+    out.select("k", "weight").yield_dataframe_as("res")
+    return dag
+
+
 WORKFLOW_BUILDERS: Dict[str, Callable[[], Any]] = {
     "transform": _wf_transform,
     "relational": _wf_relational,
     "sql_and_schema_ops": _wf_sql_and_schema_ops,
     "checkpoint_yield": _wf_checkpoint_yield,
     "deep_chain_50": _wf_deep_chain,
+    "join_filter_narrow": _wf_join_filter_narrow,
 }
 
 
@@ -116,4 +135,48 @@ def run_self_test() -> List[Tuple[str, List[Diagnostic]]]:
 def self_test_failed(results: List[Tuple[str, List[Diagnostic]]]) -> bool:
     return any(
         d.severity is Severity.ERROR for _, diags in results for d in diags
+    )
+
+
+class _OptimizedView:
+    """Adapter handing an optimized task list to the Analyzer (which
+    reads ``.tasks``) without building a workflow around it."""
+
+    def __init__(self, tasks: Any):
+        self.tasks = tasks
+
+
+def run_optimize_check() -> List[Tuple[str, int, List[Diagnostic]]]:
+    """``--optimize`` gate: rewrite every corpus workflow with the full
+    rule set forced ON, then re-analyze the OPTIMIZED plan at full
+    scope. Returns (name, applied_rewrites, diagnostics) triples; any
+    error-level diagnostic means a rewrite broke schema propagation (or
+    another invariant a clean plan must satisfy) — the CLI exits
+    nonzero."""
+    from fugue_tpu.constants import FUGUE_CONF_OPTIMIZE
+    from fugue_tpu.optimize import optimize_tasks
+
+    out: List[Tuple[str, int, List[Diagnostic]]] = []
+    analyzer = Analyzer()
+    for name, build in WORKFLOW_BUILDERS.items():
+        dag = build()
+        conf = dict(dag._conf)
+        conf[FUGUE_CONF_OPTIMIZE] = "on"
+        plan = optimize_tasks(dag.tasks, conf=conf)
+        # exclude_lint_only: FWF501 would dry-run the optimizer AGAIN
+        # over the already-optimized plan (second-order rewrite noise)
+        diags = analyzer.analyze(
+            _OptimizedView(plan.tasks), conf=conf, exclude_lint_only=True
+        )
+        out.append((name, len(plan.applied), diags))
+    return out
+
+
+def optimize_check_failed(
+    results: List[Tuple[str, int, List[Diagnostic]]]
+) -> bool:
+    return any(
+        d.severity is Severity.ERROR
+        for _, _, diags in results
+        for d in diags
     )

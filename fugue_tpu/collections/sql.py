@@ -83,6 +83,21 @@ class StructuredRawSQL:
     def dialect(self) -> Optional[str]:
         return self._dialect
 
+    def __uuid__(self) -> str:
+        """Deterministic identity from the statement parts + dialect.
+        Without this, a task holding a raw SQL statement hashed by the
+        OBJECT's repr (memory address), so two compilations of the same
+        query produced different task uuids — breaking the serving
+        daemon's query fingerprint (breaker + result cache) and
+        deterministic checkpoints over raw-SQL tasks."""
+        from fugue_tpu.utils.hash import to_uuid
+
+        return to_uuid(
+            "StructuredRawSQL",
+            [[bool(d), str(t)] for d, t in self._statements],
+            self._dialect,
+        )
+
     def construct(
         self,
         name_map: Any = None,

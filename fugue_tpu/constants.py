@@ -68,6 +68,18 @@ FUGUE_CONF_SERVE_BREAKER_COOLDOWN = "fugue.serve.breaker.cooldown"
 FUGUE_CONF_SERVE_HEARTBEAT_TIMEOUT = "fugue.serve.heartbeat_timeout"
 FUGUE_CONF_SERVE_JOB_TTL = "fugue.serve.job_ttl"
 FUGUE_CONF_SERVE_CLIENT_RETRIES = "fugue.serve.client.retries"
+FUGUE_CONF_OPTIMIZE = "fugue.optimize"
+FUGUE_CONF_OPTIMIZE_CSE = "fugue.optimize.cse"
+FUGUE_CONF_OPTIMIZE_FILTER = "fugue.optimize.filter_pushdown"
+FUGUE_CONF_OPTIMIZE_FUSION = "fugue.optimize.fusion"
+FUGUE_CONF_OPTIMIZE_PROJECTION = "fugue.optimize.projection_pushdown"
+FUGUE_CONF_OPTIMIZE_RESULT_CACHE = "fugue.optimize.result_cache"
+FUGUE_CONF_OPTIMIZE_CACHE_MAX_ENTRIES = "fugue.optimize.cache.max_entries"
+FUGUE_CONF_OPTIMIZE_CACHE_MAX_PROGRAMS = "fugue.optimize.cache.max_programs"
+FUGUE_CONF_OPTIMIZE_CACHE_MAX_RESULT_BYTES = (
+    "fugue.optimize.cache.max_result_bytes"
+)
+FUGUE_CONF_SERVE_RESULT_CACHE = "fugue.serve.result_cache"
 FUGUE_CONF_OBS_ENABLED = "fugue.obs.enabled"
 FUGUE_CONF_OBS_TRACE_PATH = "fugue.obs.trace_path"
 FUGUE_CONF_OBS_SLOW_QUERY_MS = "fugue.obs.slow_query_ms"
@@ -472,6 +484,78 @@ def _declare_defaults() -> None:
         2,
         "ServeClient retries on transient transport failures and "
         "503/429 backpressure answers (honors server Retry-After)",
+        in_defaults=False,
+    )
+    # cost-based DAG optimizer (fugue_tpu/optimize): the rewrite phase
+    # running between schema propagation and execution. "auto" (default)
+    # enables it for jax engines only; per-rule keys disable individual
+    # rewrites. Rewrites NEVER change task uuids (clones pin them), so
+    # deterministic checkpoints and manifest resume are unaffected.
+    r(
+        FUGUE_CONF_OPTIMIZE,
+        str,
+        "auto",
+        "DAG rewrite phase: off | on | auto (jax engines only)",
+    )
+    r(FUGUE_CONF_OPTIMIZE_CSE, bool, True, "common-subplan elimination rule")
+    r(
+        FUGUE_CONF_OPTIMIZE_FILTER,
+        bool,
+        True,
+        "filter pushdown past select/rename + parquet row-group pruning",
+    )
+    r(
+        FUGUE_CONF_OPTIMIZE_FUSION,
+        bool,
+        True,
+        "select/rename/filter chain fusion into one compiled program",
+    )
+    r(
+        FUGUE_CONF_OPTIMIZE_PROJECTION,
+        bool,
+        True,
+        "projection pushdown into the parquet load's narrow-load planner",
+    )
+    # process-wide plan & result cache (fugue_tpu/optimize/cache.py):
+    # compiled jit program handles are ALWAYS shared across same-conf
+    # engine instances; result_cache additionally serves
+    # deterministically-checkpointed task artifacts from memory while
+    # the artifact exists (opt-in: the artifact already gives cross-run
+    # reuse, the memory tier is for hot repeated pipelines)
+    r(
+        FUGUE_CONF_OPTIMIZE_RESULT_CACHE,
+        bool,
+        False,
+        "in-memory reuse of deterministically-checkpointed task results",
+    )
+    r(
+        FUGUE_CONF_OPTIMIZE_CACHE_MAX_PROGRAMS,
+        int,
+        512,
+        "LRU bound on process-wide cached compiled program handles",
+    )
+    r(
+        FUGUE_CONF_OPTIMIZE_CACHE_MAX_ENTRIES,
+        int,
+        256,
+        "LRU bound on process-wide cached result entries",
+    )
+    r(
+        FUGUE_CONF_OPTIMIZE_CACHE_MAX_RESULT_BYTES,
+        int,
+        256 * 1024 * 1024,
+        "byte bound on cached results (governed engines additionally "
+        "clamp to a fraction of the HBM ledger budget)",
+    )
+    # serving daemon's cross-request query result cache: a resubmitted
+    # identical pure query (same session, same table-catalog epoch, same
+    # DAG uuid) answers from the cached payload with zero execution —
+    # the "millions of users running similar queries" fast path
+    r(
+        FUGUE_CONF_SERVE_RESULT_CACHE,
+        bool,
+        True,
+        "serving daemon cross-request result cache for pure queries",
         in_defaults=False,
     )
     # unified observability plane (fugue_tpu/obs): request-scoped span

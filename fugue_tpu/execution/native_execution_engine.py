@@ -476,6 +476,10 @@ class NativeExecutionEngine(ExecutionEngine):
         columns: Any = None,
         **kwargs: Any,
     ) -> LocalBoundedDataFrame:
+        # optimizer-attached row-group pruning is a jax-ingest hint; the
+        # native path ignores it (the downstream filter re-applies the
+        # predicate, so dropping the hint is always correct)
+        kwargs.pop("pruning", None)
         return _io.load_df(path, format_hint, columns, fs=self.fs, **kwargs)
 
     def save_df(

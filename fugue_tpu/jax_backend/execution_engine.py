@@ -620,6 +620,28 @@ class JaxExecutionEngine(ExecutionEngine):
         )
         self._compile_hits = _m_compile.labels(result="hit")
         self._compile_misses = _m_compile.labels(result="miss")
+        # process-wide plan cache (ISSUE 10): compiled program handles
+        # are shared across engine instances under a signature folding
+        # platform + mesh devices + fugue.jax.* conf, so a fresh engine
+        # running a repeated query skips XLA compilation entirely.
+        # These counters are EXACT lookup results (hit = a handle was
+        # reused, miss = a new program was jitted), unlike the
+        # per-dispatch compile_cache heuristic.
+        from fugue_tpu.optimize.cache import (
+            engine_plan_signature,
+            get_plan_cache,
+        )
+
+        _m_plan = self.metrics.counter(
+            "fugue_engine_plan_cache_total",
+            "process-wide plan-cache program-handle lookups by result",
+            ["result"],
+        )
+        self._plan_hits = _m_plan.labels(result="hit")
+        self._plan_misses = _m_plan.labels(result="miss")
+        self._plan_cache = get_plan_cache()
+        self._plan_cache.configure(self.conf)
+        self._plan_sig = engine_plan_signature(self)
         self.metrics.add_collector(self._collect_memory_gauges)
         # segment-reduction strategy observability, mirroring fallbacks:
         # strategy name -> times an aggregate program ran on it ("generic"
@@ -681,6 +703,18 @@ class JaxExecutionEngine(ExecutionEngine):
         return {
             "hits": int(self._compile_hits.value),
             "misses": int(self._compile_misses.value),
+        }
+
+    @property
+    def plan_cache_stats(self) -> Dict[str, int]:
+        """EXACT program-handle lookup counts against the process-wide
+        plan cache (hit = compiled handle reused — from this engine or a
+        previous same-signature one; miss = a new program was jitted).
+        ``/v1/status`` reports these as ``compile_cache`` instead of the
+        per-dispatch jax-cache-growth heuristic above."""
+        return {
+            "hits": int(self._plan_hits.value),
+            "misses": int(self._plan_misses.value),
         }
 
     def _collect_memory_gauges(self) -> None:
@@ -1671,12 +1705,17 @@ class JaxExecutionEngine(ExecutionEngine):
     ) -> DataFrame:
         from fugue_tpu.constants import FUGUE_CONF_JAX_IO_BATCH_ROWS
 
+        # optimizer-attached row-group pruning triples (ADVISORY: the
+        # downstream filter re-applies the predicate, so ignoring them
+        # on the eager path is always correct)
+        pruning = kwargs.pop("pruning", None)
         batch_rows = int(self.conf.get(FUGUE_CONF_JAX_IO_BATCH_ROWS, 0))
         if batch_rows > 0:
             from fugue_tpu.jax_backend import ingest
 
             res = ingest.try_stream_load(
-                self, path, format_hint, columns, batch_rows, **kwargs
+                self, path, format_hint, columns, batch_rows,
+                pruning=pruning, **kwargs
             )
             if res is not None:
                 return res
@@ -1905,22 +1944,37 @@ class JaxExecutionEngine(ExecutionEngine):
         if cache is None:
             cache = {}
             self._jit_cache = cache
-        if key not in cache:
+        local = cache.get(key)
+        if local is not None:
+            # engine-local reuse is a plan-cache hit too: the compiled
+            # handle is shared either way (one counter, two tiers)
+            self._plan_hits.inc()
+            return local
+        # process-wide handle reuse: a same-signature engine already
+        # jitted this logical program → its per-shape executables
+        # come along for free (zero XLA compile on this engine)
+        global_key = (self._plan_sig, key)
+        jitted = self._plan_cache.get_program(global_key)
+        if jitted is None:
             jitted = jax.jit(fn)
-            name = str(key[0]) if isinstance(key, tuple) and key else str(key)
+            self._plan_cache.put_program(global_key, jitted)
+            self._plan_misses.inc()
+        else:
+            self._plan_hits.inc()
+        name = str(key[0]) if isinstance(key, tuple) and key else str(key)
 
-            def _wrapped(
-                *args: Any, _j: Any = jitted, _f: Callable = fn, _k: Any = key,
-                _n: str = name,
-            ) -> Any:
-                if self._program_log_armed:
-                    self._program_log[_k] = (
-                        _f, jax.tree_util.tree_map(_as_aval, args)
-                    )
-                return self._traced_dispatch(_j, _n, args)
+        def _wrapped(
+            *args: Any, _j: Any = jitted, _f: Callable = fn, _k: Any = key,
+            _n: str = name,
+        ) -> Any:
+            if self._program_log_armed:
+                self._program_log[_k] = (
+                    _f, jax.tree_util.tree_map(_as_aval, args)
+                )
+            return self._traced_dispatch(_j, _n, args)
 
-            cache[key] = _wrapped
-        return cache[key]
+        cache[key] = _wrapped
+        return _wrapped
 
     def _traced_dispatch(self, jitted: Any, name: str, args: Any) -> Any:
         """One jitted-program dispatch under the compile/execute span

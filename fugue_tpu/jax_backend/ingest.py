@@ -42,16 +42,65 @@ import pyarrow.parquet as pq
 from fugue_tpu.jax_backend import blocks as B
 from fugue_tpu.schema import Schema
 
+def _row_groups_surviving(
+    md: Any, pruning: List[Any]
+) -> Optional[List[int]]:
+    """Row groups a conjunctive ``[col, op, literal]`` predicate cannot
+    refute via the group's column statistics. Pruning with any SUBSET of
+    a conjunction is sound (a refuted conjunct falsifies every row of
+    the group; null rows fail comparisons anyway), and statistics-less
+    columns simply keep the group. None = statistics unreadable, keep
+    everything."""
+    try:
+        keep: List[int] = []
+        for g in range(md.num_row_groups):
+            rg = md.row_group(g)
+            stats: Dict[str, Any] = {}
+            for j in range(rg.num_columns):
+                cmeta = rg.column(j)
+                st = cmeta.statistics
+                if st is not None and st.has_min_max:
+                    stats[cmeta.path_in_schema] = st
+            alive = True
+            for name, op, val in pruning:
+                st = stats.get(name)
+                if st is None or not isinstance(val, (int, float)):
+                    continue
+                mn, mx = st.min, st.max
+                if not isinstance(mn, (int, float)) or not isinstance(
+                    mx, (int, float)
+                ):
+                    continue
+                if (
+                    (op == ">" and not mx > val)
+                    or (op == ">=" and not mx >= val)
+                    or (op == "<" and not mn < val)
+                    or (op == "<=" and not mn <= val)
+                    or (op == "==" and not (mn <= val <= mx))
+                ):
+                    alive = False
+                    break
+            if alive:
+                keep.append(g)
+        return keep
+    except Exception:  # pragma: no cover - stats drift: keep everything
+        return None
+
+
 def try_stream_load(
     engine: Any,
     path: Any,
     format_hint: Optional[str],
     columns: Any,
     batch_rows: int,
+    pruning: Optional[List[Any]] = None,
     **kwargs: Any,
 ) -> Optional[Any]:
     """Build a lazily-streaming JaxDataFrame for a parquet load, or None
-    when the input needs the eager path."""
+    when the input needs the eager path. ``pruning`` (optimizer-attached
+    conjunctive ``[col, op, literal]`` triples) skips row groups whose
+    parquet statistics refute the predicate — advisory: the downstream
+    filter re-applies the full condition."""
     from fugue_tpu.utils.io import infer_format
 
     if jax.process_count() > 1 or batch_rows <= 0 or len(kwargs) > 0:
@@ -81,18 +130,16 @@ def try_stream_load(
                 return None  # eager path owns the error message
             files.append(p)
 
-    # metadata pass: row count + arrow schema, no data pages touched
+    # metadata pass: row count + arrow schema (+ row-group pruning),
+    # no data pages touched
     total_rows = 0
     est_bytes = 0
     arrow_schema: Optional[pa.Schema] = None
+    group_meta: List[Any] = []  # (file, [rows/group], [bytes/group], keep)
     for f in files:
         with fs.open_input_stream(f) as fp:
             pf = pq.ParquetFile(fp)
             md = pf.metadata
-            total_rows += md.num_rows
-            est_bytes += sum(
-                md.row_group(i).total_byte_size for i in range(md.num_row_groups)
-            )
             if arrow_schema is None:
                 arrow_schema = pf.schema_arrow
             elif pf.schema_arrow != arrow_schema:
@@ -100,6 +147,27 @@ def try_stream_load(
                 # dtype drift): the eager dataset read owns null
                 # promotion/unification semantics
                 return None
+            g_rows = [md.row_group(i).num_rows for i in range(md.num_row_groups)]
+            g_bytes = [
+                md.row_group(i).total_byte_size
+                for i in range(md.num_row_groups)
+            ]
+            keep = _row_groups_surviving(md, pruning) if pruning else None
+            group_meta.append((f, g_rows, g_bytes, keep))
+    row_groups: Optional[Dict[str, List[int]]] = None
+    if pruning and all(k is not None for _, _, _, k in group_meta):
+        pruned_rows = sum(
+            sum(rows[g] for g in keep) for _, rows, _, keep in group_meta
+        )
+        if pruned_rows > 0:
+            # an all-groups-refuted load would need empty-frame device
+            # shapes the streamed path doesn't model: fall back to the
+            # unpruned stream (the filter drops every row anyway)
+            row_groups = {f: list(keep) for f, _, _, keep in group_meta}
+    for f, g_rows, g_bytes, _ in group_meta:
+        sel = row_groups[f] if row_groups is not None else range(len(g_rows))
+        total_rows += sum(g_rows[g] for g in sel)
+        est_bytes += sum(g_bytes[g] for g in sel)
     assert arrow_schema is not None
     base_schema = arrow_schema
     # provisional placement only (admit=False): the binding admission
@@ -140,6 +208,7 @@ def try_stream_load(
                 nrows,
                 batch_rows,
                 sel,
+                row_groups,
             )
             gate.after(loaded)
             return loaded
@@ -147,8 +216,18 @@ def try_stream_load(
         def load_table() -> pa.Table:
             tables = []
             for f in files:
+                groups = None if row_groups is None else row_groups[f]
+                if groups is not None and len(groups) == 0:
+                    continue  # every row group refuted: nothing to read
                 with fs.open_input_stream(f) as fp:
-                    tables.append(pq.read_table(fp, columns=sel))
+                    if groups is None:
+                        tables.append(pq.read_table(fp, columns=sel))
+                    else:
+                        tables.append(
+                            pq.ParquetFile(fp).read_row_groups(
+                                groups, columns=sel
+                            )
+                        )
             return tables[0] if len(tables) == 1 else pa.concat_tables(tables)
 
         def load_head(n: int) -> pa.Table:
@@ -159,11 +238,15 @@ def try_stream_load(
             for f in files:
                 if remaining <= 0:
                     break
+                groups = None if row_groups is None else row_groups[f]
+                if groups is not None and len(groups) == 0:
+                    continue
                 with fs.open_input_stream(f) as fp:
                     pf = pq.ParquetFile(fp)
                     for b in pf.iter_batches(
                         batch_size=max(min(batch_rows, max(n, 1)), 1),
                         columns=sel,
+                        row_groups=groups,
                     ):
                         batches.append(b.slice(0, remaining))
                         remaining -= min(b.num_rows, remaining)
@@ -214,6 +297,7 @@ def _stream_to_blocks(
     nrows: int,
     batch_rows: int,
     columns: Any,
+    row_groups: Optional[Dict[str, List[int]]] = None,
 ) -> B.JaxBlocks:
     B.ensure_x64()
     ndev = int(mesh.devices.size)
@@ -249,9 +333,14 @@ def _stream_to_blocks(
 
     offset = 0
     for fname in files:
+        groups = None if row_groups is None else row_groups.get(fname)
+        if groups is not None and len(groups) == 0:
+            continue  # every row group statistically refuted
         with fs.open_input_stream(fname) as fp:
             pf = pq.ParquetFile(fp)
-            for batch in pf.iter_batches(batch_size=batch_rows, columns=cols):
+            for batch in pf.iter_batches(
+                batch_size=batch_rows, columns=cols, row_groups=groups
+            ):
                 n = batch.num_rows
                 if n == 0:
                     continue

@@ -1,0 +1,35 @@
+"""Cost-based DAG optimizer (ISSUE 10): the rewrite phase that runs
+between ``schema_pass.propagate`` and execution, plus the process-wide
+plan & result cache shared across workflow runs and serving-daemon
+sessions.
+
+- :mod:`fugue_tpu.optimize.rewrite` — rule-driven task-graph rewrites
+  (projection pushdown, filter pushdown + parquet row-group pruning,
+  select/rename/filter chain fusion, common-subplan elimination) over a
+  CLONED task list whose uuids are pinned to the original tasks, so
+  rewrites never change the task identities deterministic checkpoints
+  and manifest resume key on.
+- :mod:`fugue_tpu.optimize.cache` — the process-wide
+  :class:`~fugue_tpu.optimize.cache.PlanCache`: compiled jit program
+  handles keyed by (engine signature, program key) shared across engine
+  instances, plus result entries (deterministically-checkpointed task
+  artifacts, serving-daemon query payloads) with LRU eviction bounded
+  by entry count and bytes.
+"""
+
+from fugue_tpu.optimize.cache import PlanCache, get_plan_cache
+from fugue_tpu.optimize.rewrite import (
+    OptimizedPlan,
+    RewriteNote,
+    optimize_enabled,
+    optimize_tasks,
+)
+
+__all__ = [
+    "OptimizedPlan",
+    "PlanCache",
+    "RewriteNote",
+    "get_plan_cache",
+    "optimize_enabled",
+    "optimize_tasks",
+]

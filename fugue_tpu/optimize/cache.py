@@ -1,0 +1,307 @@
+"""The process-wide plan & result cache (ISSUE 10).
+
+One :class:`PlanCache` instance outlives every engine in the process:
+
+- **program handles** — ``JaxExecutionEngine._jit_cached`` keys every
+  logical program by structure; the plan cache stores the underlying
+  ``jax.jit`` handle under (engine signature, program key) so a FRESH
+  engine (a new ``run()``, a restarted bench loop) reuses the already
+  compiled executables instead of paying XLA compilation again. The
+  engine signature folds the platform, the mesh's device ids and every
+  ``fugue.jax.*`` conf value, so engines with different kernel-selection
+  conf never share a slot.
+- **result entries** — deterministically-checkpointed task artifacts
+  (the loaded dataframe is served from memory while the artifact still
+  exists, skipping the parquet decode) and serving-daemon query payloads
+  (keyed by session id + catalog epoch + the DAG's deterministic uuid).
+
+Eviction is LRU, bounded by entry count and by total result bytes; for
+governed engines (PR 4 HBM ledger) the byte bound additionally clamps to
+a fraction of the device-memory budget so cached device frames can never
+crowd out live working sets. Hit/miss counters surface on the PR 8
+metrics registry (``fugue_engine_plan_cache_total``,
+``fugue_serve_result_cache_total``) and in ``/v1/status``.
+"""
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, Optional
+
+_DEFAULT_MAX_PROGRAMS = 512
+_DEFAULT_MAX_ENTRIES = 256
+_DEFAULT_MAX_RESULT_BYTES = 256 * 1024 * 1024
+# governed engines: cached results may pin at most this fraction of the
+# device-memory budget (the PR 4 ledger's admission bound)
+_GOVERNED_RESULT_FRACTION = 0.25
+
+
+class PlanCache:
+    """Thread-safe LRU cache of compiled program handles and result
+    entries, shared process-wide (see :func:`get_plan_cache`)."""
+
+    def __init__(
+        self,
+        max_programs: int = _DEFAULT_MAX_PROGRAMS,
+        max_entries: int = _DEFAULT_MAX_ENTRIES,
+        max_result_bytes: int = _DEFAULT_MAX_RESULT_BYTES,
+    ):
+        self._lock = threading.RLock()
+        self._max_programs = max_programs
+        self._max_entries = max_entries
+        self._max_result_bytes = max_result_bytes
+        self._programs: "OrderedDict[Any, Any]" = OrderedDict()
+        # key -> (value, nbytes, tag)
+        self._results: "OrderedDict[Any, Any]" = OrderedDict()
+        self._result_bytes = 0
+        self.program_hits = 0
+        self.program_misses = 0
+        self.result_hits = 0
+        self.result_misses = 0
+        self.evictions = 0
+
+    def configure(self, conf: Any) -> None:
+        """Adopt the cache bounds from a conf mapping (engines call this
+        at construction; the tightest explicit setting wins last)."""
+        from fugue_tpu.constants import (
+            FUGUE_CONF_OPTIMIZE_CACHE_MAX_ENTRIES,
+            FUGUE_CONF_OPTIMIZE_CACHE_MAX_PROGRAMS,
+            FUGUE_CONF_OPTIMIZE_CACHE_MAX_RESULT_BYTES,
+            typed_conf_get,
+        )
+
+        with self._lock:
+            self._max_programs = int(
+                typed_conf_get(conf, FUGUE_CONF_OPTIMIZE_CACHE_MAX_PROGRAMS)
+            )
+            self._max_entries = int(
+                typed_conf_get(conf, FUGUE_CONF_OPTIMIZE_CACHE_MAX_ENTRIES)
+            )
+            self._max_result_bytes = int(
+                typed_conf_get(conf, FUGUE_CONF_OPTIMIZE_CACHE_MAX_RESULT_BYTES)
+            )
+
+    # ---- program handles -------------------------------------------------
+    def get_program(self, key: Any) -> Optional[Any]:
+        with self._lock:
+            handle = self._programs.get(key)
+            if handle is None:
+                self.program_misses += 1
+                return None
+            self._programs.move_to_end(key)
+            self.program_hits += 1
+            return handle
+
+    def put_program(self, key: Any, handle: Any) -> None:
+        with self._lock:
+            self._programs[key] = handle
+            self._programs.move_to_end(key)
+            while len(self._programs) > max(1, self._max_programs):
+                self._programs.popitem(last=False)
+                self.evictions += 1
+
+    # ---- result entries --------------------------------------------------
+    def get_result(self, key: Any) -> Optional[Any]:
+        with self._lock:
+            entry = self._results.get(key)
+            if entry is None:
+                self.result_misses += 1
+                return None
+            self._results.move_to_end(key)
+            self.result_hits += 1
+            return entry[0]
+
+    def put_result(
+        self,
+        key: Any,
+        value: Any,
+        nbytes: int,
+        tag: Optional[str] = None,
+        byte_cap: Optional[int] = None,
+    ) -> bool:
+        """Insert a result entry, evicting LRU entries past the entry
+        and byte bounds. An entry alone larger than the byte cap is
+        refused (never cached) rather than evicting everything else."""
+        nbytes = max(0, int(nbytes))
+        cap = self._max_result_bytes if byte_cap is None else min(
+            self._max_result_bytes, int(byte_cap)
+        )
+        if nbytes > cap > 0:
+            return False
+        with self._lock:
+            old = self._results.pop(key, None)
+            if old is not None:
+                self._result_bytes -= old[1]
+            self._results[key] = (value, nbytes, tag)
+            self._result_bytes += nbytes
+            while self._results and (
+                len(self._results) > max(1, self._max_entries)
+                or (cap > 0 and self._result_bytes > cap)
+            ):
+                _, (_, evicted_bytes, _) = self._results.popitem(last=False)
+                self._result_bytes -= evicted_bytes
+                self.evictions += 1
+            return True
+
+    def drop_result(self, key: Any) -> None:
+        with self._lock:
+            entry = self._results.pop(key, None)
+            if entry is not None:
+                self._result_bytes -= entry[1]
+
+    def invalidate_tag(self, tag: str) -> int:
+        """Drop every result entry carrying ``tag`` (a serving session
+        closing drops its payload entries); returns the dropped count."""
+        with self._lock:
+            dead = [k for k, (_, _, t) in self._results.items() if t == tag]
+            for k in dead:
+                _, nbytes, _ = self._results.pop(k)
+                self._result_bytes -= nbytes
+            return len(dead)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._programs.clear()
+            self._results.clear()
+            self._result_bytes = 0
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "programs": len(self._programs),
+                "program_hits": self.program_hits,
+                "program_misses": self.program_misses,
+                "results": len(self._results),
+                "result_bytes": self._result_bytes,
+                "result_hits": self.result_hits,
+                "result_misses": self.result_misses,
+                "evictions": self.evictions,
+            }
+
+
+_PLAN_CACHE = PlanCache()
+
+
+def get_plan_cache() -> PlanCache:
+    """The process-wide plan cache singleton."""
+    return _PLAN_CACHE
+
+
+# ---- engine signature -------------------------------------------------------
+def engine_plan_signature(engine: Any) -> str:
+    """Deterministic signature under which an engine's compiled programs
+    may be shared process-wide: platform + mesh device ids + every
+    ``fugue.jax.*`` conf value (kernel-selection conf changes programs,
+    so differing conf must never share a slot)."""
+    from fugue_tpu.utils.hash import to_uuid
+
+    try:
+        devices = tuple(
+            str(d) for d in getattr(engine.mesh, "devices").flat
+        )
+    except Exception:  # pragma: no cover - defensive
+        devices = ()
+    conf_items = sorted(
+        (k, str(v))
+        for k, v in dict(engine.conf).items()
+        if isinstance(k, str) and k.startswith("fugue.jax.")
+    )
+    return to_uuid(type(engine).__name__, devices, conf_items)
+
+
+# ---- deterministic-checkpoint task results ----------------------------------
+def _estimate_frame_bytes(df: Any) -> int:
+    try:
+        blocks = getattr(df, "native", None)
+        if blocks is not None:
+            from fugue_tpu.jax_backend.blocks import device_nbytes
+
+            return int(device_nbytes(blocks))
+    except Exception:  # pragma: no cover - estimator best-effort
+        pass
+    try:
+        n = int(df.count())
+        return max(1, n) * max(1, len(df.schema)) * 16
+    except Exception:  # pragma: no cover
+        return 1 << 20
+
+
+def _governed_byte_cap(engine: Any) -> Optional[int]:
+    mem = getattr(engine, "memory_stats", None)
+    if not isinstance(mem, dict) or not mem.get("enabled"):
+        return None
+    budget = int(mem.get("budget_bytes") or 0)
+    if budget <= 0:
+        return None
+    return int(budget * _GOVERNED_RESULT_FRACTION)
+
+
+def task_result_cache_enabled(engine: Any) -> bool:
+    """The ``fugue.optimize.result_cache`` gate for in-memory reuse of
+    deterministically-checkpointed task artifacts (default off: the
+    artifact itself already provides cross-run reuse; the memory tier is
+    an opt-in for hot repeated pipelines)."""
+    from fugue_tpu.constants import (
+        FUGUE_CONF_OPTIMIZE_RESULT_CACHE,
+        typed_conf_get,
+    )
+
+    try:
+        return bool(
+            typed_conf_get(engine.conf, FUGUE_CONF_OPTIMIZE_RESULT_CACHE)
+        )
+    except Exception:  # pragma: no cover - conf-less engine stub
+        return False
+
+
+def _task_result_key(task: Any, ctx: Any, uri: str) -> Any:
+    # fold the engine's plan signature (platform + mesh devices +
+    # fugue.jax.* conf) like the program cache does: a cached frame's
+    # blocks are sharded on a specific mesh, and serving them to a
+    # different-mesh/conf engine would hand it misplaced device state
+    engine = ctx.engine
+    sig = getattr(engine, "_plan_sig", None) or type(engine).__name__
+    return ("task", sig, task.__uuid__(), uri)
+
+
+def get_task_result(task: Any, ctx: Any) -> Optional[Any]:
+    """In-memory hit for a deterministically-checkpointed task: serves
+    the previously loaded dataframe while the artifact still exists
+    (existence is re-verified so a cleaned checkpoint dir invalidates
+    the memory entry exactly like it invalidates the artifact)."""
+    cp = task.checkpoint
+    if not getattr(cp, "deterministic", False):
+        return None
+    uri = cp.artifact_uri(ctx.checkpoint_path)
+    if uri is None:
+        return None
+    cache = get_plan_cache()
+    key = _task_result_key(task, ctx, uri)
+    df = cache.get_result(key)
+    if df is None:
+        return None
+    try:
+        exists = ctx.checkpoint_path.file_exists(uri)
+    except Exception:  # pragma: no cover - fs hiccup: treat as gone
+        exists = False
+    if not exists:
+        cache.drop_result(key)
+        return None
+    yielded = getattr(cp, "yielded", None)
+    if yielded is not None:
+        yielded.set_value(uri)
+    return df
+
+
+def put_task_result(task: Any, ctx: Any, df: Any) -> None:
+    cp = task.checkpoint
+    if not getattr(cp, "deterministic", False):
+        return
+    uri = cp.artifact_uri(ctx.checkpoint_path)
+    if uri is None:
+        return
+    get_plan_cache().put_result(
+        _task_result_key(task, ctx, uri),
+        df,
+        _estimate_frame_bytes(df),
+        byte_cap=_governed_byte_cap(ctx.engine),
+    )

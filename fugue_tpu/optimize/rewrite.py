@@ -1,0 +1,1203 @@
+"""Rule-driven rewrites of a built (unexecuted) workflow task graph.
+
+The optimizer runs inside ``FugueWorkflow.run`` after the static
+analysis gate and before the DAG runner, gated by conf ``fugue.optimize``
+(``auto`` — the default — enables it for jax engines only). It never
+mutates the user's workflow: the task list is CLONED and every clone's
+uuid is pinned to its source task BEFORE any rewrite, so deterministic
+checkpoints, manifest resume and the plan cache keep seeing the exact
+identities the unoptimized DAG would produce.
+
+Rules, in application order:
+
+- **common-subplan elimination** (``fugue.optimize.cse``) — the
+  deterministic task uuids already identify structurally identical
+  subtrees; duplicates whose whole upstream cone is deterministic
+  execute once and fan out.
+- **filter pushdown** (``fugue.optimize.filter_pushdown``) — a filter
+  sinks below select/rename/drop projections (with expression column
+  remapping), and a predicate that lands directly on a parquet load
+  attaches conjunctive ``(col, op, literal)`` pruning triples the
+  streamed ingest checks against parquet row-group statistics (pruning
+  is advisory: the filter still runs, so partial/ignored pruning is
+  always correct).
+- **chain fusion** (``fugue.optimize.fusion``) — maximal
+  select/rename/filter/drop chains collapse into ONE select (projection
+  + combined ``where``) so the engine dispatches one compiled program
+  instead of N.
+- **projection pushdown** (``fugue.optimize.projection_pushdown``) —
+  each task's downstream-required column set is threaded backward
+  through filter/select/rename/join/aggregate edges into the parquet
+  load's ``columns`` spec, so the streamed ingest's narrow-load planner
+  (and the eager reader) never decode or stage columns no consumer
+  needs. Columns are only dropped when EVERY path to an externally
+  observable point (output task, yield, deterministic checkpoint,
+  opaque extension) provably ignores them.
+"""
+
+import copy
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from fugue_tpu.analysis.schema_pass import SchemaInfo, expr_columns, propagate
+from fugue_tpu.collections.partition import parse_presort_exp
+from fugue_tpu.column.expressions import (
+    ColumnExpr,
+    _BinaryOpExpr,
+    _FuncExpr,
+    _LitColumnExpr,
+    _NamedColumnExpr,
+    _UnaryOpExpr,
+    col,
+)
+from fugue_tpu.column.sql import SelectColumns
+from fugue_tpu.extensions import builtins as _b
+from fugue_tpu.utils.hash import to_uuid
+from fugue_tpu.utils.params import ParamDict
+from fugue_tpu.workflow.checkpoint import WeakCheckpoint
+from fugue_tpu.workflow.tasks import CreateTask, FugueTask, OutputTask, ProcessTask
+
+# rule slugs (stable: conf keys, FWF501 messages and tests key on them)
+RULE_CSE = "cse"
+RULE_FILTER_PUSHDOWN = "filter_pushdown"
+RULE_FUSION = "fusion"
+RULE_PROJECTION = "projection_pushdown"
+
+# builtins whose output is a pure function of their spec + inputs: safe
+# to deduplicate (CSE) and to serve from a result cache. User
+# transformers/processors/creators and writers are deliberately absent —
+# uuid equality is SPEC equality, not value determinism, for user code.
+_PURE_EXTENSIONS = (
+    _b.CreateData,
+    _b.Load,
+    _b.RunJoin,
+    _b.RunSetOperation,
+    _b.Distinct,
+    _b.Dropna,
+    _b.Fillna,
+    _b.RunSQLSelect,
+    _b.Select,
+    _b.Filter,
+    _b.Assign,
+    _b.Aggregate,
+    _b.Rename,
+    _b.AlterColumns,
+    _b.DropColumns,
+    _b.SelectColumnsP,
+    _b.Take,
+)
+
+
+class RewriteNote:
+    """One applied or declined rewrite, with the offending task's name
+    and user callsite (the same attribution diagnostics carry)."""
+
+    __slots__ = ("rule", "applied", "message", "task_name", "callsite")
+
+    def __init__(self, rule: str, applied: bool, message: str, task: Any = None):
+        self.rule = rule
+        self.applied = applied
+        self.message = message
+        self.task_name = getattr(task, "name", "") if task is not None else ""
+        self.callsite = list(getattr(task, "callsite", None) or [])
+
+    def describe(self) -> str:
+        verb = "applied" if self.applied else "declined"
+        head = f"{self.rule} {verb}"
+        if self.task_name:
+            head += f" [task {self.task_name}]"
+        return f"{head}: {self.message}"
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"RewriteNote({self.describe()})"
+
+
+class OptimizedPlan:
+    """The rewrite phase's output: the (possibly rewritten) task list in
+    dependency order plus the notes of every rule decision."""
+
+    __slots__ = ("tasks", "notes")
+
+    def __init__(self, tasks: List[FugueTask], notes: List[RewriteNote]):
+        self.tasks = tasks
+        self.notes = notes
+
+    @property
+    def applied(self) -> List[RewriteNote]:
+        return [n for n in self.notes if n.applied]
+
+
+# the one vocabulary of disabling fugue.optimize values (FWF501 and the
+# run() gate must never drift apart on what counts as "off")
+OFF_VALUES = ("off", "false", "0", "none", "")
+
+
+def optimize_enabled(conf: Any, engine: Any = None) -> bool:
+    """The ``fugue.optimize`` gate: ``auto`` (default) enables the
+    rewrite phase for jax engines only; ``on`` forces it for any engine,
+    ``off`` disables it. Unknown values raise — a gate the user asked
+    for must not silently degrade."""
+    from fugue_tpu.analysis.analyzer import _is_jax_engine
+    from fugue_tpu.constants import FUGUE_CONF_OPTIMIZE, conf_default
+
+    raw = str(
+        (conf or {}).get(FUGUE_CONF_OPTIMIZE, conf_default(FUGUE_CONF_OPTIMIZE))
+        if conf is not None
+        else conf_default(FUGUE_CONF_OPTIMIZE)
+    ).strip().lower()
+    if raw in OFF_VALUES:
+        return False
+    if raw in ("on", "true", "1"):
+        return True
+    if raw == "auto":
+        return _is_jax_engine(engine)
+    raise ValueError(
+        f"invalid {FUGUE_CONF_OPTIMIZE} mode {raw!r}: expected off | on | auto"
+    )
+
+
+def _rule_enabled(conf: Any, rule: str) -> bool:
+    from fugue_tpu.constants import typed_conf_get
+
+    return bool(typed_conf_get(conf or {}, f"fugue.optimize.{rule}"))
+
+
+def _value_hashable(obj: Any, depth: int = 0) -> bool:
+    """Whether a raw CreateData payload hashes by VALUE through
+    ``to_uuid`` (plain scalars and nested lists/tuples of them). Frame
+    objects (and numpy arrays) hash by schema/truncated repr only, so
+    two different datasets can share a uuid — never value-stable."""
+    if obj is None or isinstance(obj, (str, int, float, bool, bytes)):
+        return True
+    if depth > 6:
+        return False
+    if isinstance(obj, (list, tuple)):
+        return all(_value_hashable(x, depth + 1) for x in obj)
+    return False
+
+
+def is_pure_task(task: FugueTask, frame_inputs_stable: bool = False) -> bool:
+    """True when the task's output is a pure, VALUE-deterministic
+    function of its uuid + inputs (``Sample`` counts only when seeded).
+    ``CreateData`` wrapping a dataframe object is excluded — dataframes
+    hash by schema repr, so uuid equality does not imply equal data —
+    unless the caller vouches for frame stability
+    (``frame_inputs_stable``: the serving daemon's session tables only
+    change through ``save_table``, which bumps the cache epoch in the
+    key)."""
+    ext = task.extension
+    if ext is _b.Sample:
+        return task.params.get("seed", None) is not None
+    if ext is _b.CreateData:
+        data = task.params.get("data", None)
+        if _value_hashable(data):
+            return True
+        if not frame_inputs_stable:
+            return False
+        from fugue_tpu.dataframe import DataFrame
+
+        return isinstance(data, DataFrame)
+    return any(ext is p for p in _PURE_EXTENSIONS)
+
+
+def tasks_are_pure(
+    tasks: List[FugueTask], frame_inputs_stable: bool = False
+) -> bool:
+    """True when EVERY task in the list is a pure builtin and none is an
+    output task — the eligibility check the serving daemon's
+    cross-request result cache uses (a cached payload must not skip side
+    effects). ``Load`` is rejected here even though CSE treats it as
+    pure WITHIN one run: a cross-request cache keyed by task uuid would
+    keep serving stale rows after the external file changes on disk
+    (file content is not epoch-tracked the way session tables are)."""
+    return all(
+        is_pure_task(t, frame_inputs_stable)
+        and not isinstance(t, OutputTask)
+        and t.extension is not _b.Load
+        for t in tasks
+    )
+
+
+def _observable(task: FugueTask) -> bool:
+    """Whether the task's FULL output is externally observable: yields,
+    durable (deterministic) checkpoint artifacts, or a broadcast handle.
+    Rewrites must never change what an observable point sees."""
+    if task.yields or task.broadcast_result:
+        return True
+    cp = task.checkpoint
+    return not cp.is_null and not isinstance(cp, WeakCheckpoint)
+
+
+def _rewirable(task: FugueTask) -> bool:
+    """An intermediate node a rewrite may restructure: not observable,
+    no checkpoint of any kind, no partition hints riding on it."""
+    return (
+        not _observable(task)
+        and task.checkpoint.is_null
+        and not task.partition_spec.partition_by
+        and len(task.partition_spec.presort) == 0
+    )
+
+
+# ---- clone machinery --------------------------------------------------------
+def _clone_tasks(tasks: List[FugueTask]) -> List[FugueTask]:
+    """Shallow-clone the task graph with every clone's uuid PINNED to
+    its source task's uuid (computed from the pristine spec) so no later
+    param/input edit can change the identities checkpoints key on."""
+    mapping: Dict[int, FugueTask] = {}
+    out: List[FugueTask] = []
+    for t in tasks:
+        c = copy.copy(t)
+        c._uuid = t.__uuid__()  # pin BEFORE any rewrite edits the spec
+        c.params = ParamDict(dict(t.params))
+        c.inputs = [mapping[id(i)] for i in t.inputs]
+        mapping[id(t)] = c
+        out.append(c)
+    return out
+
+
+def _synthetic(
+    template_cls: type,
+    extension: Any,
+    params: Dict[str, Any],
+    inputs: List[FugueTask],
+    uuid: str,
+    like: Optional[FugueTask] = None,
+) -> FugueTask:
+    """Build a rewrite-created task with an explicit (deterministic)
+    uuid. ``like`` transfers the observable surface of the task the new
+    node REPLACES: checkpoint, yields, broadcast, fault policy, callsite
+    and partition spec — and its uuid wins, because the replacement
+    produces the exact frame the replaced task would have."""
+    task = template_cls(extension, params=params, input_tasks=inputs)
+    task._uuid = uuid
+    if like is not None:
+        task._uuid = like.__uuid__()
+        task.checkpoint = like.checkpoint
+        task.yields = like.yields
+        task.yield_as_local = like.yield_as_local
+        task.broadcast_result = like.broadcast_result
+        task.fault_override = like.fault_override
+        task.callsite = like.callsite
+        task.partition_spec = like.partition_spec
+    return task
+
+
+def _consumers(tasks: List[FugueTask]) -> Dict[int, List[FugueTask]]:
+    out: Dict[int, List[FugueTask]] = {id(t): [] for t in tasks}
+    for t in tasks:
+        for i in t.inputs:
+            out.setdefault(id(i), []).append(t)
+    return out
+
+
+def _rewire(tasks: List[FugueTask], old: FugueTask, new: FugueTask) -> None:
+    for t in tasks:
+        if any(i is old for i in t.inputs):
+            t.inputs = [new if i is old else i for i in t.inputs]
+
+
+# ---- expression helpers -----------------------------------------------------
+def _conjuncts(expr: Any) -> Iterator[ColumnExpr]:
+    """Top-level AND conjuncts of a condition tree."""
+    if (
+        isinstance(expr, _BinaryOpExpr)
+        and expr.op == "&"
+        and expr.as_type is None
+        and expr.as_name == ""
+    ):
+        yield from _conjuncts(expr.left)
+        yield from _conjuncts(expr.right)
+    elif isinstance(expr, ColumnExpr):
+        yield expr
+
+
+def _and_all(conds: List[ColumnExpr]) -> ColumnExpr:
+    out = conds[0]
+    for c in conds[1:]:
+        out = out & c
+    return out
+
+
+def rename_expr_columns(
+    expr: Any, name_map: Dict[str, str]
+) -> Optional[ColumnExpr]:
+    """Rebuild an expression tree with every named column reference
+    renamed through ``name_map`` (identity for unmapped names). Returns
+    None when the tree holds nodes that can't be safely rebuilt
+    (wildcards, unknown classes) — callers decline the rewrite then."""
+    if not isinstance(expr, ColumnExpr):
+        return None
+    out: Optional[ColumnExpr]
+    if isinstance(expr, _NamedColumnExpr):
+        if expr.wildcard:
+            return None
+        out = col(name_map.get(expr.name, expr.name))
+    elif isinstance(expr, _LitColumnExpr):
+        return expr
+    elif isinstance(expr, _UnaryOpExpr):
+        c = rename_expr_columns(expr.col, name_map)
+        if c is None:
+            return None
+        out = _UnaryOpExpr(expr.op, c)
+    elif isinstance(expr, _BinaryOpExpr):
+        left = rename_expr_columns(expr.left, name_map)
+        right = rename_expr_columns(expr.right, name_map)
+        if left is None or right is None:
+            return None
+        out = _BinaryOpExpr(expr.op, left, right)
+    elif isinstance(expr, _FuncExpr):
+        args = [rename_expr_columns(a, name_map) for a in expr.args]
+        if any(a is None for a in args):
+            return None
+        out = _FuncExpr(
+            expr.func,
+            *args,
+            arg_distinct=expr.arg_distinct,
+            is_aggregation=expr.is_aggregation,
+        )
+    else:
+        return None
+    out._as_name = expr.as_name
+    out._as_type = expr.as_type
+    return out
+
+
+def extract_pruning_triples(cond: Any) -> List[List[Any]]:
+    """Conjunctive ``[col, op, literal]`` comparisons usable for parquet
+    row-group pruning: pruning with ANY subset of a conjunction is
+    sound, so non-comparison conjuncts are simply skipped."""
+    triples: List[List[Any]] = []
+    _FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "==": "=="}
+    for c in _conjuncts(cond):
+        if not isinstance(c, _BinaryOpExpr) or c.as_type is not None:
+            continue
+        if c.op not in ("<", "<=", ">", ">=", "=="):
+            continue
+        left, right = c.left, c.right
+
+        def _named(e: Any) -> Optional[str]:
+            if (
+                isinstance(e, _NamedColumnExpr)
+                and not e.wildcard
+                and e.as_type is None
+            ):
+                return e.name
+            return None
+
+        def _num(e: Any) -> Optional[Any]:
+            if isinstance(e, _LitColumnExpr) and isinstance(
+                e.value, (int, float)
+            ) and not isinstance(e.value, bool):
+                return e.value
+            return None
+
+        name, value = _named(left), _num(right)
+        if name is not None and value is not None:
+            triples.append([name, c.op, value])
+            continue
+        name, value = _named(right), _num(left)
+        if name is not None and value is not None:
+            triples.append([name, _FLIP[c.op], value])
+    return triples
+
+
+# ---- rule: common-subplan elimination ---------------------------------------
+def _cse(
+    tasks: List[FugueTask], notes: List[RewriteNote]
+) -> List[FugueTask]:
+    kept_by_uuid: Dict[str, FugueTask] = {}
+    replacement: Dict[int, FugueTask] = {}
+    deterministic: Dict[int, bool] = {}
+    out: List[FugueTask] = []
+    for t in tasks:
+        if replacement:
+            t.inputs = [replacement.get(id(i), i) for i in t.inputs]
+        det = is_pure_task(t) and all(
+            deterministic.get(id(i), False) for i in t.inputs
+        )
+        deterministic[id(t)] = det
+        if det and not isinstance(t, OutputTask):
+            key = t.__uuid__()
+            kept = kept_by_uuid.get(key)
+            if (
+                kept is not None
+                and t.checkpoint.is_null
+                and not t.yields
+                and not t.broadcast_result
+            ):
+                replacement[id(t)] = kept
+                notes.append(
+                    RewriteNote(
+                        RULE_CSE,
+                        True,
+                        f"duplicate subplan folded into task {kept.name} "
+                        f"(identical uuid {key[:8]})",
+                        t,
+                    )
+                )
+                continue
+            kept_by_uuid.setdefault(key, t)
+        out.append(t)
+    return out
+
+
+# ---- rule: filter pushdown + row-group pruning ------------------------------
+def _pure_projection_map(task: FugueTask) -> Optional[Dict[str, str]]:
+    """output name -> input name for projections a filter can cross:
+    Rename, DropColumns, SelectColumnsP and simple Selects whose
+    entries are plain (un-cast) named columns. None = not crossable."""
+    ext = task.extension
+    p = task.params
+    if ext is _b.Rename:
+        columns = p.get("columns", None) or {}
+        return {v: k for k, v in columns.items()}
+    if ext is _b.DropColumns or ext is _b.SelectColumnsP:
+        return {}  # names pass through unchanged
+    if ext is _b.Select:
+        cols = p.get("columns", None)
+        if (
+            cols is None
+            or p.get("having", None) is not None
+            or cols.is_distinct
+            or cols.has_agg
+        ):
+            return None
+        out: Dict[str, str] = {}
+        for c in cols.all_cols:
+            if (
+                not isinstance(c, _NamedColumnExpr)
+                or c.wildcard
+                or c.as_type is not None
+                or c.output_name == ""
+            ):
+                return None
+            out[c.output_name] = c.name
+        return out
+    return None
+
+
+def _filter_refs_survive(task: FugueTask, cond_cols: List[str]) -> bool:
+    """After the swap the projection's REFERENCED inputs must still
+    exist; for DropColumns the filter may not reference dropped
+    columns, for SelectColumnsP the condition columns must be selected
+    ones, and for Rename a condition column that is a rename KEY (an
+    old name that is not also someone's new name) does not exist in the
+    filter's input — the unoptimized run errors there, and the rewrite
+    must not silently legitimize it."""
+    ext = task.extension
+    p = task.params
+    if ext is _b.SelectColumnsP:
+        names = set(p.get("columns", None) or [])
+        return all(c in names for c in cond_cols)
+    if ext is _b.DropColumns:
+        dropped = set(p.get("columns", None) or [])
+        return not any(c in dropped for c in cond_cols)
+    if ext is _b.Rename:
+        columns = p.get("columns", None) or {}
+        shadowed = set(columns.keys()) - set(columns.values())
+        return not any(c in shadowed for c in cond_cols)
+    return True
+
+
+def _filter_pushdown(
+    tasks: List[FugueTask], conf: Any, notes: List[RewriteNote]
+) -> List[FugueTask]:
+    changed = True
+    guard = 0
+    noted: set = set()
+    while changed and guard < len(tasks) + 8:
+        changed = False
+        guard += 1
+        consumers = _consumers(tasks)
+        for t in list(tasks):
+            if t.extension is not _b.Filter or len(t.inputs) != 1:
+                continue
+            proj = t.inputs[0]
+            if (
+                proj not in tasks
+                or len(proj.inputs) != 1
+                or len(consumers.get(id(proj), [])) != 1
+                or not _rewirable(proj)
+                or not isinstance(proj, ProcessTask)
+            ):
+                continue
+            name_map = _pure_projection_map(proj)
+            if name_map is None:
+                if (
+                    proj.extension is _b.Select
+                    and (id(t), id(proj)) not in noted
+                ):
+                    noted.add((id(t), id(proj)))
+                    notes.append(
+                        RewriteNote(
+                            RULE_FILTER_PUSHDOWN,
+                            False,
+                            "select has computed/distinct/aggregate "
+                            "columns; filter cannot cross the projection",
+                            t,
+                        )
+                    )
+                continue
+            cond = t.params.get("condition", None)
+            cond_cols = list(dict.fromkeys(expr_columns(cond)))
+            if proj.extension is _b.Select and not all(
+                c in name_map for c in cond_cols
+            ):
+                if (id(t), id(proj)) not in noted:
+                    noted.add((id(t), id(proj)))
+                    notes.append(
+                        RewriteNote(
+                            RULE_FILTER_PUSHDOWN,
+                            False,
+                            "filter references a computed select column; "
+                            "cannot cross the projection",
+                            t,
+                        )
+                    )
+                continue
+            if not _filter_refs_survive(proj, cond_cols):
+                continue
+            remapped = rename_expr_columns(cond, name_map)
+            if remapped is None:
+                if (id(t), id(proj)) not in noted:
+                    noted.add((id(t), id(proj)))
+                    notes.append(
+                        RewriteNote(
+                            RULE_FILTER_PUSHDOWN,
+                            False,
+                            "filter condition could not be rebuilt for "
+                            "the projection's input columns",
+                            t,
+                        )
+                    )
+                continue
+            inner = _synthetic(
+                ProcessTask,
+                _b.Filter,
+                dict(condition=remapped),
+                [proj.inputs[0]],
+                to_uuid("opt.filter_pushdown", proj.__uuid__(), t.__uuid__()),
+            )
+            inner.callsite = t.callsite
+            outer = _synthetic(
+                ProcessTask,
+                proj.extension,
+                dict(proj.params),
+                [inner],
+                "",
+                like=t,
+            )
+            # the outer projection replaces the FILTER's identity (same
+            # output frame); keep the projection's own param spec
+            outer.input_names = proj.input_names
+            idx_proj = next(i for i, x in enumerate(tasks) if x is proj)
+            idx_t = next(i for i, x in enumerate(tasks) if x is t)
+            tasks[idx_proj] = inner
+            tasks[idx_t] = outer
+            _rewire(tasks, t, outer)
+            notes.append(
+                RewriteNote(
+                    RULE_FILTER_PUSHDOWN,
+                    True,
+                    f"filter pushed below {proj.name} "
+                    f"(condition columns remapped: {cond_cols})",
+                    t,
+                )
+            )
+            changed = True
+            break
+    _attach_rowgroup_pruning(tasks, notes)
+    return tasks
+
+
+def _is_parquet_load(task: FugueTask) -> bool:
+    if not (isinstance(task, CreateTask) and task.extension is _b.Load):
+        return False
+    from fugue_tpu.utils.io import infer_format
+
+    path = task.params.get("path", None)
+    if isinstance(path, (list, tuple)):
+        path = path[0] if path else None
+    if not isinstance(path, str):
+        return False
+    fmt = task.params.get("fmt", "") or None
+    try:
+        return infer_format(path, fmt) == "parquet"
+    except Exception:
+        return False
+
+
+def _attach_rowgroup_pruning(
+    tasks: List[FugueTask], notes: List[RewriteNote]
+) -> None:
+    consumers = _consumers(tasks)
+    for t in tasks:
+        if not _is_parquet_load(t) or _observable(t):
+            continue
+        cons = consumers.get(id(t), [])
+        if len(cons) != 1:
+            continue
+        c = cons[0]
+        if c.extension is _b.Filter:
+            cond = c.params.get("condition", None)
+        elif c.extension is _b.Select:
+            cond = c.params.get("where", None)
+        else:
+            continue
+        if cond is None:
+            continue
+        kwargs = dict(t.params.get("params", None) or {})
+        if "pruning" in kwargs:
+            continue
+        triples = extract_pruning_triples(cond)
+        if not triples:
+            notes.append(
+                RewriteNote(
+                    RULE_FILTER_PUSHDOWN,
+                    False,
+                    "predicate over the parquet load has no conjunctive "
+                    "column-vs-literal comparison usable for row-group "
+                    "pruning",
+                    c,
+                )
+            )
+            continue
+        kwargs["pruning"] = triples
+        t.params["params"] = kwargs
+        notes.append(
+            RewriteNote(
+                RULE_FILTER_PUSHDOWN,
+                True,
+                f"row-group pruning triples {triples} attached to the "
+                "parquet load (advisory: the filter still runs)",
+                c,
+            )
+        )
+
+
+# ---- rule: select/rename/filter chain fusion --------------------------------
+class _ChainState:
+    """Composed effect of a fusible chain in CHAIN-INPUT terms:
+    ``outputs`` is the ordered projection (None = not yet explicit)
+    where each entry is (output name, expression over the chain input);
+    ``conds`` are the accumulated filter conditions. While ``outputs``
+    is None the column SET is the (possibly unknown) chain input's, with
+    ``fwd`` tracking composed renames (head name -> current name) so
+    rename chains over schema-less inputs still fuse once an explicit
+    projection terminates them."""
+
+    def __init__(self) -> None:
+        self.outputs: Optional[List[Tuple[str, ColumnExpr]]] = None
+        self.fwd: Dict[str, str] = {}
+        self.conds: List[ColumnExpr] = []
+
+    def name_map(self) -> Optional[Dict[str, str]]:
+        """current output name -> chain-input name, defined only while
+        every current output is a plain un-cast named column (None =
+        some are not; pure-rename state returns the inverse rename)."""
+        if self.outputs is None:
+            return {cur: head for head, cur in self.fwd.items()}
+        out: Dict[str, str] = {}
+        for name, e in self.outputs:
+            if (
+                not isinstance(e, _NamedColumnExpr)
+                or e.wildcard
+                or e.as_type is not None
+            ):
+                return None
+            out[name] = e.name
+        return out
+
+
+_FUSIBLE = (
+    _b.Filter,
+    _b.Rename,
+    _b.DropColumns,
+    _b.SelectColumnsP,
+    _b.Select,
+)
+
+
+def _compose_op(
+    state: _ChainState, task: FugueTask, head_info: SchemaInfo
+) -> bool:
+    """Fold one chain op into the state; False = not composable (the
+    chain is cut before this op)."""
+    ext = task.extension
+    p = task.params
+    if ext is _b.Filter:
+        nm = state.name_map()
+        if nm is None:
+            return False
+        raw = p.get("condition", None)
+        cond_cols = list(dict.fromkeys(expr_columns(raw)))
+        if state.outputs is not None:
+            # explicit projection: the filter's input has EXACTLY the
+            # output names — an unknown reference errors unoptimized
+            if any(c not in nm for c in cond_cols):
+                return False
+        else:
+            # pure-rename state: a reference to a renamed-AWAY head
+            # name does not exist post-rename; composing it would
+            # silently legitimize an invalid plan
+            shadowed = {
+                head for head, cur in state.fwd.items() if head != cur
+            } - set(state.fwd.values())
+            if any(c in shadowed for c in cond_cols):
+                return False
+        cond = rename_expr_columns(raw, nm)
+        if cond is None:
+            return False
+        state.conds.append(cond)
+        return True
+    if state.outputs is None and ext in (_b.Rename, _b.DropColumns):
+        # materialize the implicit identity projection when the chain
+        # input's columns are statically known (validations stay exact)
+        if head_info.columns is not None and not state.fwd:
+            state.outputs = [(n, col(n)) for n in head_info.columns]
+    if ext is _b.Rename:
+        columns = p.get("columns", None) or {}
+        if state.outputs is None:
+            # schema-less: compose the rename maps; an explicit
+            # projection later resolves names through the composition
+            fwd = dict(state.fwd)
+            produced = set(fwd.values())
+            for head, cur in list(fwd.items()):
+                fwd[head] = columns.get(cur, cur)
+            for old, new in columns.items():
+                if old not in produced:
+                    fwd[old] = new
+            if len(set(fwd.values())) != len(fwd):
+                return False  # rename collision: keep the runtime error
+            state.fwd = fwd
+            return True
+        current = [n for n, _ in state.outputs]
+        if any(k not in current for k in columns):
+            return False  # runtime would reject: keep the error
+        renamed = [(columns.get(n, n), e) for n, e in state.outputs]
+        if len({n for n, _ in renamed}) != len(renamed):
+            return False
+        state.outputs = renamed
+        return True
+    if ext is _b.DropColumns:
+        if state.outputs is None:
+            # schema-less drop can't validate its column list and a
+            # later projection referencing a dropped column would be
+            # silently legitimized: not composable
+            return False
+        names = [c for c in p.get("columns", None) or [] if isinstance(c, str)]
+        current = {n for n, _ in state.outputs}
+        if not p.get("if_exists", False) and any(n not in current for n in names):
+            return False
+        kept = [(n, e) for n, e in state.outputs if n not in names]
+        if not kept:
+            return False
+        state.outputs = kept
+        return True
+    if ext is _b.SelectColumnsP:
+        names = p.get("columns", None) or []
+        if not all(isinstance(n, str) for n in names) or not names:
+            return False
+        if state.outputs is None:
+            nm = state.name_map() or {}
+            state.outputs = [(n, col(nm.get(n, n))) for n in names]
+            return True
+        by_name = dict(state.outputs)
+        if any(n not in by_name for n in names):
+            return False
+        state.outputs = [(n, by_name[n]) for n in names]
+        return True
+    if ext is _b.Select:
+        cols = p.get("columns", None)
+        if (
+            cols is None
+            or p.get("having", None) is not None
+            or cols.is_distinct
+            or cols.has_agg
+        ):
+            return False
+        nm = state.name_map()
+        if nm is None:
+            return False
+        where = p.get("where", None)
+        if where is not None:
+            cond = rename_expr_columns(where, nm)
+            if cond is None:
+                return False
+            state.conds.append(cond)
+        new_out: List[Tuple[str, ColumnExpr]] = []
+        for c in cols.all_cols:
+            if isinstance(c, _NamedColumnExpr) and c.wildcard:
+                return False
+            name = c.output_name
+            if name == "":
+                return False
+            rebuilt = rename_expr_columns(c, nm)
+            if rebuilt is None:
+                return False
+            new_out.append((name, rebuilt))
+        if len({n for n, _ in new_out}) != len(new_out):
+            return False
+        state.outputs = new_out
+        return True
+    return False
+
+
+def _fuse_chains(
+    tasks: List[FugueTask], conf: Any, notes: List[RewriteNote]
+) -> List[FugueTask]:
+    infos, _ = propagate(tasks)
+    consumers = _consumers(tasks)
+    in_chain: set = set()
+
+    def _fusible_link(t: FugueTask) -> bool:
+        return (
+            isinstance(t, ProcessTask)
+            and len(t.inputs) == 1
+            and any(t.extension is f for f in _FUSIBLE)
+            and id(t) not in in_chain
+        )
+
+    for start in list(tasks):
+        if not _fusible_link(start):
+            continue
+        # `start` must be the FIRST link: its input is not itself a
+        # fusible intermediate (else the chain starts further up)
+        inp = start.inputs[0]
+        if (
+            _fusible_link(inp)
+            and len(consumers.get(id(inp), [])) == 1
+            and _rewirable(inp)
+        ):
+            continue
+        chain = [start]
+        while True:
+            last = chain[-1]
+            outs = consumers.get(id(last), [])
+            if (
+                len(outs) == 1
+                and _fusible_link(outs[0])
+                and _rewirable(last)
+            ):
+                chain.append(outs[0])
+            else:
+                break
+        if len(chain) < 2:
+            continue
+        head_info = infos.get(id(start.inputs[0]), SchemaInfo(reason="unknown"))
+        state = _ChainState()
+        composed: List[FugueTask] = []
+        for link in chain:
+            trial = _ChainState()
+            trial.outputs = None if state.outputs is None else list(state.outputs)
+            trial.fwd = dict(state.fwd)
+            trial.conds = list(state.conds)
+            if not _compose_op(trial, link, head_info):
+                break
+            state = trial
+            composed.append(link)
+        while composed and state.outputs is None and state.fwd:
+            # a pure-rename tail without an explicit projection can't
+            # build a single Select over an unknown schema: re-compose
+            # the longest prefix that CAN build
+            composed = composed[:-1]
+            state = _ChainState()
+            for link in composed:
+                _compose_op(state, link, head_info)
+        if len(composed) < 2:
+            if len(chain) >= 2:
+                notes.append(
+                    RewriteNote(
+                        RULE_FUSION,
+                        False,
+                        f"chain of {len(chain)} select/rename/filter tasks "
+                        "not fusible (computed columns, wildcards or an "
+                        "unknown input schema)",
+                        chain[0],
+                    )
+                )
+            continue
+        last = composed[-1]
+        head_input = composed[0].inputs[0]
+        if state.outputs is None:
+            fused = _synthetic(
+                ProcessTask,
+                _b.Filter,
+                dict(condition=_and_all(state.conds)),
+                [head_input],
+                "",
+                like=last,
+            )
+        else:
+            entries = [
+                e if e.output_name == name else e.alias(name)
+                for name, e in state.outputs
+            ]
+            fused = _synthetic(
+                ProcessTask,
+                _b.Select,
+                dict(
+                    columns=SelectColumns(*entries),
+                    where=_and_all(state.conds) if state.conds else None,
+                    having=None,
+                ),
+                [head_input],
+                "",
+                like=last,
+            )
+        idx_last = next(i for i, x in enumerate(tasks) if x is last)
+        tasks[idx_last] = fused
+        for link in composed[:-1]:
+            tasks.remove(link)
+        _rewire(tasks, last, fused)
+        for link in composed:
+            in_chain.add(id(link))
+        in_chain.add(id(fused))
+        consumers = _consumers(tasks)
+        notes.append(
+            RewriteNote(
+                RULE_FUSION,
+                True,
+                f"{len(composed)} chained select/rename/filter tasks fused "
+                "into one compiled program",
+                last,
+            )
+        )
+    return tasks
+
+
+# ---- rule: projection pushdown ----------------------------------------------
+_ALL = None  # sentinel: the full output is required
+
+
+def _ordered(names: Any) -> Dict[str, None]:
+    return dict.fromkeys(n for n in names if isinstance(n, str))
+
+
+def _merge_req(
+    req: Dict[int, Any], task: FugueTask, add: Any
+) -> None:
+    if id(task) not in req:
+        req[id(task)] = dict() if add is not _ALL else _ALL
+    if add is _ALL:
+        req[id(task)] = _ALL
+        return
+    if req[id(task)] is _ALL:
+        return
+    req[id(task)].update(add)
+
+
+def _input_requirements(
+    t: FugueTask, out_req: Any, infos: Dict[int, SchemaInfo]
+) -> List[Any]:
+    """Per-input required-column sets given the task's own required
+    output (``_ALL`` = everything). Anything not provably narrowable
+    answers ``_ALL`` — the sweep is safe by construction."""
+    ext = t.extension
+    p = t.params
+    n = len(t.inputs)
+    if n == 0:
+        return []
+    if isinstance(t, OutputTask) or not is_pure_task(t):
+        return [_ALL] * n
+    if ext is _b.Filter:
+        cond_refs = _ordered(expr_columns(p.get("condition", None)))
+        if out_req is _ALL:
+            return [_ALL]
+        return [{**out_req, **cond_refs}]
+    if ext is _b.Select:
+        cols = p.get("columns", None)
+        entries = getattr(cols, "all_cols", None) or []
+        refs: Dict[str, None] = {}
+        for c in entries:
+            if isinstance(c, _NamedColumnExpr) and c.wildcard:
+                return [_ALL]
+            refs.update(_ordered(expr_columns(c)))
+        refs.update(_ordered(expr_columns(p.get("where", None))))
+        return [refs]
+    if ext is _b.Rename:
+        columns = p.get("columns", None) or {}
+        if out_req is _ALL:
+            return [_ALL]
+        inv = {v: k for k, v in columns.items()}
+        req = _ordered(inv.get(c, c) for c in out_req)
+        req.update(_ordered(columns.keys()))
+        return [req]
+    if ext is _b.AlterColumns:
+        if out_req is _ALL:
+            return [_ALL]
+        from fugue_tpu.schema import Schema
+
+        try:
+            altered = Schema(p.get("columns", "")).names
+        except Exception:
+            return [_ALL]
+        return [{**out_req, **_ordered(altered)}]
+    if ext is _b.DropColumns:
+        names = _ordered(p.get("columns", None) or [])
+        if out_req is _ALL:
+            return [_ALL]
+        if p.get("if_exists", False):
+            return [dict(out_req)]
+        return [{**out_req, **names}]
+    if ext is _b.SelectColumnsP:
+        names = p.get("columns", None) or []
+        if not all(isinstance(c, str) for c in names):
+            return [_ALL]
+        return [_ordered(names)]
+    if ext is _b.Assign:
+        cols = p.get("columns", None) or []
+        if out_req is _ALL:
+            return [_ALL]
+        assigned = {getattr(c, "output_name", "") for c in cols}
+        req = _ordered(c for c in out_req if c not in assigned)
+        for c in cols:
+            req.update(_ordered(expr_columns(c)))
+        return [req]
+    if ext is _b.Aggregate:
+        req = _ordered(t.partition_spec.partition_by)
+        for c in p.get("columns", None) or []:
+            req.update(_ordered(expr_columns(c)))
+        return [req]
+    if ext is _b.Take:
+        if out_req is _ALL:
+            return [_ALL]
+        req = dict(out_req)
+        req.update(_ordered(t.partition_spec.partition_by))
+        req.update(_ordered(t.partition_spec.presort.keys()))
+        try:
+            req.update(_ordered(parse_presort_exp(p.get("presort", "")).keys()))
+        except Exception:
+            return [_ALL]
+        return [req]
+    if ext is _b.Dropna:
+        subset = p.get("subset", None)
+        if subset and out_req is not _ALL:
+            return [{**out_req, **_ordered(subset)}]
+        return [_ALL]
+    if ext is _b.Fillna:
+        if out_req is _ALL:
+            return [_ALL]
+        req = dict(out_req)
+        subset = p.get("subset", None)
+        if subset:
+            req.update(_ordered(subset))
+        value = p.get("value", None)
+        if isinstance(value, dict):
+            req.update(_ordered(value.keys()))
+        return [req]
+    if ext is _b.Sample:
+        return [out_req if out_req is _ALL else dict(out_req)]
+    if ext is _b.RunJoin:
+        how = str(p.get("how", "")).lower()
+        on = [c for c in p.get("on", None) or [] if isinstance(c, str)]
+        if out_req is _ALL:
+            return [_ALL] * n
+        sides = [infos.get(id(i), SchemaInfo(reason="unknown")) for i in t.inputs]
+        if any(s.columns is None for s in sides):
+            return [_ALL] * n
+        if how in ("semi", "anti", "left_semi", "left_anti") and n == 2:
+            first = {**out_req, **_ordered(on)}
+            return [first, _ordered(on)]
+        # a duplicate non-key column is a runtime error the optimizer
+        # must not silently fix by narrowing it away
+        seen: Dict[str, int] = {}
+        for i, s in enumerate(sides):
+            for name in s.columns or []:
+                if name in seen and name not in on:
+                    return [_ALL] * n
+                seen.setdefault(name, i)
+        out: List[Any] = []
+        for s in sides:
+            cols = set(s.columns or [])
+            req = _ordered([c for c in out_req if c in cols] + on)
+            out.append(req)
+        return out
+    # Distinct / set ops compare WHOLE rows; everything else is opaque
+    return [_ALL] * n
+
+
+def _required_columns(
+    tasks: List[FugueTask], infos: Dict[int, SchemaInfo]
+) -> Dict[int, Any]:
+    consumers = _consumers(tasks)
+    req: Dict[int, Any] = {}
+    for t in reversed(tasks):
+        out_req = req.get(id(t), _ALL if not consumers.get(id(t)) else dict())
+        if _observable(t):
+            out_req = _ALL
+        req.setdefault(id(t), out_req)
+        if req[id(t)] is not _ALL and out_req is _ALL:
+            req[id(t)] = _ALL
+        out_req = req[id(t)]
+        for inp, r in zip(t.inputs, _input_requirements(t, out_req, infos)):
+            _merge_req(req, inp, r)
+    return req
+
+
+def _projection_pushdown(
+    tasks: List[FugueTask], conf: Any, notes: List[RewriteNote]
+) -> List[FugueTask]:
+    infos, _ = propagate(tasks)
+    req = _required_columns(tasks, infos)
+    for t in tasks:
+        if not _is_parquet_load(t):
+            continue
+        r = req.get(id(t), _ALL)
+        if r is _ALL or len(r) == 0:
+            continue
+        current = t.params.get("columns", None)
+        if isinstance(current, str):
+            notes.append(
+                RewriteNote(
+                    RULE_PROJECTION,
+                    False,
+                    "load declares a schema-expression column spec; narrow "
+                    "load not applicable",
+                    t,
+                )
+            )
+            continue
+        if current is None:
+            narrowed = list(r)
+        else:
+            cur = [c for c in current if isinstance(c, str)]
+            if any(c not in cur for c in r):
+                # a consumer references a column outside the declared
+                # load list: the unoptimized run errors there — keep it
+                continue
+            narrowed = [c for c in cur if c in r]
+            if narrowed == cur:
+                continue
+        t.params["columns"] = narrowed
+        notes.append(
+            RewriteNote(
+                RULE_PROJECTION,
+                True,
+                f"parquet load narrowed to {narrowed} (downstream "
+                "consumers require no other column)",
+                t,
+            )
+        )
+    return tasks
+
+
+# ---- the pipeline -----------------------------------------------------------
+def optimize_tasks(
+    tasks: List[FugueTask], conf: Any = None, engine: Any = None
+) -> OptimizedPlan:
+    """Clone the task graph (uuids pinned) and run the enabled rewrite
+    rules over it. The input tasks are never mutated, so the same
+    workflow object can be optimized repeatedly (or linted dry-run by
+    FWF501) without drift."""
+    notes: List[RewriteNote] = []
+    out = _clone_tasks(tasks)
+    if _rule_enabled(conf, RULE_CSE):
+        out = _cse(out, notes)
+    if _rule_enabled(conf, RULE_FILTER_PUSHDOWN):
+        out = _filter_pushdown(out, conf, notes)
+    if _rule_enabled(conf, RULE_FUSION):
+        out = _fuse_chains(out, conf, notes)
+    if _rule_enabled(conf, RULE_PROJECTION):
+        out = _projection_pushdown(out, conf, notes)
+    return OptimizedPlan(out, notes)

@@ -75,6 +75,7 @@ from contextlib import nullcontext
 from typing import Any, Dict, Optional, Tuple
 
 from fugue_tpu.constants import (
+    FUGUE_CONF_SERVE_RESULT_CACHE,
     FUGUE_CONF_SERVE_BREAKER_COOLDOWN,
     FUGUE_CONF_SERVE_BREAKER_THRESHOLD,
     FUGUE_CONF_SERVE_DRAIN_TIMEOUT,
@@ -264,6 +265,23 @@ class ServeDaemon:
             "job execution wall clock (start to terminal) by outcome",
             ["status"],
         )
+        # cross-request result cache (ISSUE 10): a resubmitted PURE
+        # query (same session, same table-catalog epoch, same DAG uuid)
+        # answers from the process-wide plan cache with zero execution —
+        # no Python planning, no device dispatch, no recompile
+        from fugue_tpu.optimize import get_plan_cache
+
+        self._plan_cache = get_plan_cache()
+        self._result_cache_on = bool(
+            typed_conf_get(econf, FUGUE_CONF_SERVE_RESULT_CACHE)
+        )
+        self._m_result_cache = metrics.counter(
+            "fugue_serve_result_cache_total",
+            "cross-request query result cache lookups by result",
+            ["result"],
+        )
+        for kind in ("hit", "miss"):
+            self._m_result_cache.labels(result=kind)
         # registry counters are process-monotonic (Prometheus
         # semantics), but status()'s dict shapes are DAEMON-scoped like
         # the dicts they replaced: baseline a caller-owned engine's
@@ -642,7 +660,18 @@ class ServeDaemon:
         )
         from fugue_tpu import __version__
 
-        compile_cache = getattr(self._engine, "compile_cache_stats", None)
+        # ISSUE 10: compile_cache reads the plan cache's EXACT
+        # program-handle lookup counters (hit = a compiled handle was
+        # reused) instead of the per-dispatch jax-cache-growth heuristic
+        compile_cache = getattr(
+            self._engine,
+            "plan_cache_stats",
+            getattr(self._engine, "compile_cache_stats", None),
+        )
+        plan_cache = dict(self._plan_cache.stats())
+        plan_cache["serve_result"] = {
+            str(k): v for k, v in self._m_result_cache.as_int_dict().items()
+        }
         out: Dict[str, Any] = {
             "uptime_seconds": uptime,
             "uptime_secs": uptime,
@@ -652,6 +681,7 @@ class ServeDaemon:
                 if isinstance(compile_cache, dict)
                 else {"hits": 0, "misses": 0}
             ),
+            "plan_cache": plan_cache,
             "health": health,
             "engine": engine_stats,
             "sessions": {
@@ -696,6 +726,11 @@ class ServeDaemon:
         job.beat()
         session = self._sessions.get(job.session_id)
         dag = FugueSQLWorkflow()
+        # snapshot the epoch BEFORE the table frames: a concurrent
+        # save_table between the snapshot and the key build must make
+        # this job's payload land under the OLD epoch (never served
+        # again), not under the new one with pre-save data
+        cache_epoch = session.cache_epoch
         sources = session.table_frames()
         try:
             dag._sql(job.sql, {}, **sources)
@@ -716,6 +751,42 @@ class ServeDaemon:
         job.fingerprint = dag.__uuid__()
         self._supervisor.admit_query(job.fingerprint)
         has_result = dag.last_df is not None
+        # cross-request result cache: only PURE queries (deterministic
+        # builtins, no output tasks, no user yields, no save_as) are
+        # eligible — a cached payload must never skip a side effect.
+        # The key folds the session id and its catalog epoch so another
+        # session's same-shaped tables or a post-save resubmission can
+        # never be served the wrong payload.
+        cache_key = None
+        if (
+            self._result_cache_on
+            and has_result
+            and job.save_as is None
+            and job.collect
+            and len(dag.yields) == 0
+        ):
+            from fugue_tpu.optimize.rewrite import tasks_are_pure
+
+            # session table frames only change via save_table, which
+            # bumps cache_epoch in this key: frame inputs are stable
+            if tasks_are_pure(dag.tasks, frame_inputs_stable=True):
+                cache_key = (
+                    "serve",
+                    job.session_id,
+                    cache_epoch,
+                    job.fingerprint,
+                    job.limit,
+                )
+        if cache_key is not None:
+            cached = self._plan_cache.get_result(cache_key)
+            if cached is not None:
+                self._m_result_cache.labels(result="hit").inc()
+                session.touch()
+                payload = dict(cached)
+                if "result" in payload:
+                    payload["result"] = dict(payload["result"])
+                return payload
+            self._m_result_cache.labels(result="miss").inc()
         if has_result:
             dag.last_df.yield_dataframe_as(_RESULT_YIELD)
         gov = getattr(self._engine, "memory_governor", None)
@@ -764,6 +835,17 @@ class ServeDaemon:
                     "row_count": min(len(rows), job.limit),
                     "truncated": truncated,
                 }
+        if cache_key is not None:
+            result = payload.get("result") or {}
+            nbytes = 64 + 16 * len(result.get("rows") or []) * max(
+                1, len(result.get("columns") or [])
+            )
+            stored = dict(payload)
+            if "result" in stored:
+                stored["result"] = dict(stored["result"])
+            self._plan_cache.put_result(
+                cache_key, stored, nbytes, tag=job.session_id
+            )
         session.touch()
         return payload
 

@@ -54,6 +54,10 @@ class ServeSession:
         self.created_at = created_at if created_at is not None else time.time()
         self._last_used = time.monotonic()
         self._tables: Dict[str, str] = {}  # name -> qualified catalog name
+        # bumped on every catalog mutation (save/drop): the daemon's
+        # cross-request result cache keys on it, so a resubmitted query
+        # after a table update can never serve the stale payload
+        self.cache_epoch = 0
         # tables known only from the journal after a restart:
         # name -> {"artifact", "size", "sha256"}; loaded lazily
         self._durable: Dict[str, Dict[str, Any]] = {}
@@ -85,6 +89,17 @@ class ServeSession:
             if rec.get("artifact")
         }
         s.restored = True
+        # the restored session's cache_epoch restarts at 0 while the
+        # PROCESS-wide plan cache may still hold this session id's
+        # pre-restart payload entries (in-process kill-restart): drop
+        # them, or a post-restart save could realign the epoch and
+        # serve a stale payload
+        try:
+            from fugue_tpu.optimize import get_plan_cache
+
+            get_plan_cache().invalidate_tag(session_id)
+        except Exception:  # pragma: no cover - best-effort hygiene
+            pass
         return s
 
     # ---- lifecycle -------------------------------------------------------
@@ -128,6 +143,13 @@ class ServeSession:
                 self._journal.forget_session(self.session_id)
             self._tables.clear()
             self._durable.clear()
+            # a closing session's cached query payloads die with it
+            try:
+                from fugue_tpu.optimize import get_plan_cache
+
+                get_plan_cache().invalidate_tag(self.session_id)
+            except Exception:  # pragma: no cover - best-effort cleanup
+                pass
             return dropped
 
     def _remove_artifact(self, name: str) -> None:
@@ -166,6 +188,7 @@ class ServeSession:
             self._claim_tenant(loaded)
             self._tables[name] = q
             self._durable.pop(name, None)  # catalog copy is now the truth
+            self.cache_epoch += 1
             self._journal_table(name, loaded)
         self.touch()
         return q
@@ -252,6 +275,7 @@ class ServeSession:
         with self._lock:
             q = self._tables.pop(name, None)
             self._durable.pop(name, None)
+            self.cache_epoch += 1
             self._remove_artifact(name)
         if self._journal is not None:
             self._journal.forget_table(self.session_id, name)

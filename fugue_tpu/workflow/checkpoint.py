@@ -27,6 +27,13 @@ class Checkpoint:
     def is_null(self) -> bool:
         return True
 
+    @property
+    def deterministic(self) -> bool:
+        """True when the checkpoint's artifact is keyed by the task uuid
+        (re-running an identical DAG reuses it) — the eligibility bit
+        the optimizer's result cache and observability checks read."""
+        return False
+
     def run(self, df: DataFrame, path: "CheckpointPath") -> DataFrame:
         return df
 
@@ -92,6 +99,10 @@ class StrongCheckpoint(Checkpoint):
     @property
     def is_null(self) -> bool:
         return False
+
+    @property
+    def deterministic(self) -> bool:
+        return self._deterministic
 
     def _file_path(self, path: "CheckpointPath") -> str:
         from fugue_tpu.utils.hash import to_uuid
@@ -164,6 +175,10 @@ class TableCheckpoint(Checkpoint):
     @property
     def is_null(self) -> bool:
         return False
+
+    @property
+    def deterministic(self) -> bool:
+        return self._deterministic
 
     def _table_name(self, path: "CheckpointPath") -> str:
         from fugue_tpu.utils.hash import to_uuid

@@ -119,12 +119,32 @@ class FugueTask:
         raise NotImplementedError  # pragma: no cover
 
     # ---- shared result handling -----------------------------------------
+    def _result_cache(self, ctx: "TaskContext") -> Any:
+        """The optimizer's in-memory result tier over deterministic
+        checkpoints (``fugue.optimize.result_cache``, opt-in), or None."""
+        from fugue_tpu.optimize import cache as _plan_cache
+
+        if not _plan_cache.task_result_cache_enabled(ctx.engine):
+            return None
+        return _plan_cache
+
     def _try_skip(self, ctx: "TaskContext") -> Optional[DataFrame]:
         """Deterministic-checkpoint short circuit: reuse the artifact and
-        skip compute when an identical DAG already produced it."""
+        skip compute when an identical DAG already produced it. With
+        ``fugue.optimize.result_cache`` on, a process-wide memory tier
+        sits in front of the artifact: the previously loaded dataframe
+        is served (artifact existence re-verified) without paying the
+        parquet decode again."""
+        cache = self._result_cache(ctx)
+        if cache is not None:
+            hit = cache.get_task_result(self, ctx)
+            if hit is not None:
+                return self._finalize(ctx, hit, run_checkpoint=False)
         cached = self.checkpoint.try_load(ctx.checkpoint_path)
         if cached is None:
             return None
+        if cache is not None:
+            cache.put_task_result(self, ctx, cached)
         return self._finalize(ctx, cached, run_checkpoint=False)
 
     def _finalize(
@@ -132,6 +152,9 @@ class FugueTask:
     ) -> DataFrame:
         if run_checkpoint:
             df = self.checkpoint.run(df, ctx.checkpoint_path)
+            cache = self._result_cache(ctx)
+            if cache is not None:
+                cache.put_task_result(self, ctx, df)
         if self.broadcast_result:
             df = ctx.engine.broadcast(df)
         for y in self.yields:

@@ -749,7 +749,12 @@ class FugueWorkflow:
         )
 
     # ---- static analysis -------------------------------------------------
-    def analyze(self, conf: Any = None, engine: Any = None) -> List[Any]:
+    def analyze(
+        self,
+        conf: Any = None,
+        engine: Any = None,
+        exclude_lint_only: bool = False,
+    ) -> List[Any]:
         """Statically analyze the built (unexecuted) DAG and return the
         list of :class:`~fugue_tpu.analysis.Diagnostic` findings, most
         severe first — stable-coded rules over schemas, partition specs,
@@ -773,7 +778,10 @@ class FugueWorkflow:
         if engine_conf is not None:
             merged.update(ParamDict(engine_conf))
         merged.update(ParamDict(conf))
-        return Analyzer().analyze(self, conf=merged, engine=engine)
+        return Analyzer().analyze(
+            self, conf=merged, engine=engine,
+            exclude_lint_only=exclude_lint_only,
+        )
 
     def _pre_run_analysis(self, e: Any, run_conf: Any = None) -> None:
         """The ``fugue.analysis`` gate at the top of ``run()``: ``off``
@@ -813,7 +821,11 @@ class FugueWorkflow:
         from fugue_tpu.exceptions import WorkflowAnalysisError
 
         try:
-            diags = self.analyze(conf=e.conf, engine=e)
+            # lint_only rules (FWF501's optimizer dry-run) are skipped:
+            # run() performs the rewrite for real right after this gate
+            diags = self.analyze(
+                conf=e.conf, engine=e, exclude_lint_only=True
+            )
         except WorkflowAnalysisError:  # pragma: no cover - defensive
             raise
         except Exception as ex:  # analyzer bug: log VISIBLY (the user asked
@@ -887,6 +899,49 @@ class FugueWorkflow:
                 workflow=self.__uuid__()[:12],
             )
 
+    def _optimized_tasks(self, e: Any) -> List[FugueTask]:
+        """The task list execution runs: the optimizer's rewrite phase
+        (``fugue.optimize``; ``auto`` = jax engines only) over a CLONED
+        graph whose uuids are pinned to the original tasks — rewrites
+        never change the identities deterministic checkpoints and
+        manifest resume key on. The phase is sandboxed: an optimizer
+        crash logs a warning and the pristine DAG runs instead."""
+        from fugue_tpu.constants import declared_conf_keys
+        from fugue_tpu.optimize import optimize_enabled, optimize_tasks
+
+        # same precedence as the fugue.analysis gate: an engine conf
+        # value that still equals the registered default is "not set",
+        # so an explicit workflow compile-conf value (fugue.optimize and
+        # its per-rule keys) wins over the inherited default
+        declared = declared_conf_keys()
+        conf = ParamDict(e.conf)
+        for k, v in self._conf.items():
+            if not isinstance(k, str) or not k.startswith("fugue.optimize"):
+                continue
+            info = declared.get(k)
+            if info is not None and str(conf.get(k, info.default)) == str(
+                info.default
+            ):
+                conf[k] = v
+        # an invalid fugue.optimize mode must raise (the user asked for
+        # a gate that doesn't exist), so it is checked OUTSIDE the
+        # sandbox below
+        if not optimize_enabled(conf, e):
+            return list(self._tasks)
+        try:
+            plan = optimize_tasks(self._tasks, conf=conf, engine=e)
+            for note in plan.applied:
+                e.log.info("fugue_tpu optimize: %s", note.describe())
+            return plan.tasks
+        except Exception as ex:
+            e.log.warning(
+                "fugue_tpu optimize crashed and was skipped (the DAG "
+                "runs unoptimized): %s: %s",
+                type(ex).__name__,
+                ex,
+            )
+            return list(self._tasks)
+
     def _run_inner(
         self,
         e: Any,
@@ -894,6 +949,7 @@ class FugueWorkflow:
         cancel_token: Any = None,
     ) -> "FugueWorkflowResult":
         self._pre_run_analysis(e, run_conf=conf)
+        run_tasks = self._optimized_tasks(e)
         execution_id = str(uuid4())
         rpc_server = make_rpc_server(e.conf)
         checkpoint_path = CheckpointPath(e)
@@ -911,7 +967,7 @@ class FugueWorkflow:
             e.as_context()
             in_ctx = True
             checkpoint_path.init_temp_path(execution_id)
-            index_of = {id(t): i for i, t in enumerate(self._tasks)}
+            index_of = {id(t): i for i, t in enumerate(run_tasks)}
             nodes = [
                 TaskNode(
                     t.__uuid__() + f"_{i}",
@@ -926,13 +982,13 @@ class FugueWorkflow:
                     callsite=t.callsite,
                     timeout=self._task_policy(t, base_policy).timeout,
                 )
-                for i, t in enumerate(self._tasks)
+                for i, t in enumerate(run_tasks)
             ]
             on_complete = None
             if manifest is not None:
                 by_node_id = {
                     t.__uuid__() + f"_{i}": t
-                    for i, t in enumerate(self._tasks)
+                    for i, t in enumerate(run_tasks)
                 }
                 on_complete = lambda node: manifest.mark_complete(  # noqa: E731
                     by_node_id[node.task_id]

@@ -422,13 +422,38 @@ def test_crashing_rule_is_skipped_with_a_visible_warning(caplog):
     )
 
 
+def test_fwf501_optimizer_rewrite_report():
+    # a fusible filter+select chain: the dry-run reports the applied
+    # rewrite with the offending task's name and user callsite, without
+    # executing or mutating anything
+    dag = FugueWorkflow()
+    df = dag.df([[1, 2.0], [5, 3.0]], "a:int,b:double")
+    df.filter(col("a") > 1).select("a").yield_dataframe_as("out")
+    before = [t.name for t in dag.tasks]
+    diags = _analyze(dag, codes={"FWF501"})
+    d = _assert_diag(diags, "FWF501", Severity.INFO)
+    assert "fusion applied" in d.message
+    assert [t.name for t in dag.tasks] == before  # dry run: no mutation
+    # fugue.optimize=off silences the report (the user disabled the
+    # phase, so there is nothing the optimizer "would do")
+    assert not any(
+        x.code == "FWF501"
+        for x in _analyze(dag, conf={"fugue.optimize": "off"})
+    )
+    # an invalid mode is flagged at ERROR — run() raises the identical
+    # ValueError, so lint must not cheerfully report rewrites instead
+    bad = _analyze(dag, conf={"fugue.optimize": "onn"}, codes={"FWF501"})
+    assert bad and bad[0].severity is Severity.ERROR
+    assert "invalid" in bad[0].message
+
+
 def test_every_rule_has_corpus_coverage():
     """The corpus above must track the registry: a newly registered rule
     without a fixture here fails this meta-check."""
     covered = {
         "FWF101", "FWF102", "FWF103", "FWF104", "FWF105", "FWF106",
         "FWF201", "FWF202", "FWF301", "FWF302", "FWF303", "FWF401",
-        "FWF402", "FWF403", "FWF404",
+        "FWF402", "FWF403", "FWF404", "FWF501",
     }
     assert {r.code for r in all_rules()} == covered
 

@@ -1,0 +1,351 @@
+"""Rewrite-rule unit tests: each optimizer rule gets minimal workflows
+asserting the rewrite it applies, the safety checks that make it
+decline, and the two structural invariants every rewrite must keep —
+the user's workflow object is never mutated, and task uuids carrying
+checkpoints never change."""
+
+import os
+import tempfile
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fugue_tpu.column.expressions import col
+from fugue_tpu.extensions import builtins as _b
+from fugue_tpu.optimize import optimize_tasks
+from fugue_tpu.optimize.rewrite import (
+    RULE_CSE,
+    RULE_FILTER_PUSHDOWN,
+    RULE_FUSION,
+    RULE_PROJECTION,
+    extract_pruning_triples,
+    rename_expr_columns,
+)
+from fugue_tpu.workflow.workflow import FugueWorkflow
+
+pytestmark = pytest.mark.optimize
+
+
+@pytest.fixture(scope="module")
+def parquet_file():
+    tmp = tempfile.mkdtemp(prefix="fugue_opt_")
+    path = os.path.join(tmp, "src.parquet")
+    pd.DataFrame(
+        {
+            "a": np.arange(100, dtype=np.int64),
+            "b": np.arange(100, dtype=np.float64),
+            "c": np.random.default_rng(0).random(100),
+            "d": np.arange(100, dtype=np.int64)[::-1],
+        }
+    ).to_parquet(path, row_group_size=10)
+    return path
+
+
+def _notes(plan, rule, applied=True):
+    return [n for n in plan.notes if n.rule == rule and n.applied is applied]
+
+
+def _load_task(plan):
+    return next(t for t in plan.tasks if t.extension is _b.Load)
+
+
+# ---- projection pushdown ----------------------------------------------------
+def test_projection_pushdown_narrows_load(parquet_file):
+    dag = FugueWorkflow()
+    dag.load(parquet_file).select("a", "c").yield_dataframe_as("out")
+    plan = optimize_tasks(dag.tasks, conf=dag._conf)
+    assert _notes(plan, RULE_PROJECTION)
+    assert _load_task(plan).params["columns"] == ["a", "c"]
+
+
+def test_projection_pushdown_threads_filter_and_rename(parquet_file):
+    dag = FugueWorkflow()
+    df = dag.load(parquet_file).filter(col("d") > 10).rename({"b": "bb"})
+    df.select("a", "bb").yield_dataframe_as("out")
+    plan = optimize_tasks(dag.tasks, conf=dag._conf)
+    cols = _load_task(plan).params["columns"]
+    # the filter's column must survive the narrow load
+    assert set(cols) == {"a", "b", "d"}
+
+
+def test_projection_pushdown_blocked_by_observable_intermediate(parquet_file):
+    dag = FugueWorkflow()
+    df = dag.load(parquet_file)
+    df.yield_dataframe_as("full")  # full output observable
+    df.select("a").yield_dataframe_as("narrow")
+    plan = optimize_tasks(dag.tasks, conf=dag._conf)
+    assert _load_task(plan).params["columns"] is None
+
+
+def test_projection_pushdown_blocked_by_opaque_consumer(parquet_file):
+    def tf(df: pd.DataFrame) -> pd.DataFrame:
+        return df
+
+    dag = FugueWorkflow()
+    df = dag.load(parquet_file)
+    df.transform(tf, schema="*").yield_dataframe_as("out")
+    plan = optimize_tasks(dag.tasks, conf=dag._conf)
+    assert _load_task(plan).params["columns"] is None
+
+
+def test_projection_pushdown_narrows_declared_list_preserving_order(
+    parquet_file,
+):
+    dag = FugueWorkflow()
+    df = dag.load(parquet_file, columns=["d", "b", "a"])
+    df.select("a", "d").yield_dataframe_as("out")
+    plan = optimize_tasks(dag.tasks, conf=dag._conf)
+    assert _load_task(plan).params["columns"] == ["d", "a"]
+
+
+def test_projection_rule_disable_key(parquet_file):
+    dag = FugueWorkflow()
+    dag.load(parquet_file).select("a").yield_dataframe_as("out")
+    conf = dict(dag._conf)
+    conf["fugue.optimize.projection_pushdown"] = False
+    plan = optimize_tasks(dag.tasks, conf=conf)
+    assert not _notes(plan, RULE_PROJECTION)
+    assert _load_task(plan).params["columns"] is None
+
+
+# ---- filter pushdown --------------------------------------------------------
+def test_filter_pushes_below_rename_with_remap():
+    dag = FugueWorkflow()
+    df = dag.df([[1, 2.0], [5, 3.0]], "a:int,b:double")
+    df.rename({"a": "aa"}).filter(col("aa") > 2).yield_dataframe_as("out")
+    plan = optimize_tasks(dag.tasks, conf=dag._conf)
+    applied = _notes(plan, RULE_FILTER_PUSHDOWN)
+    assert applied and "Rename" in applied[0].message
+
+
+def test_filter_on_renamed_away_column_stays_an_error():
+    # df.rename({a: aa}).filter(col(a) > 0) errors unoptimized (no
+    # column 'a' post-rename); the rewrite must NOT legitimize it by
+    # pushing the filter below the rename where 'a' still exists
+    from fugue_tpu.execution import make_execution_engine
+
+    def build():
+        dag = FugueWorkflow()
+        df = dag.df([[1, 2.0], [5, 3.0]], "a:int,b:double")
+        df.rename({"a": "aa"}).filter(col("a") > 2).yield_dataframe_as(
+            "out", as_local=True
+        )
+        return dag
+
+    conf = {"fugue.analysis": "off"}
+    with pytest.raises(Exception):
+        build().run(make_execution_engine("jax", {**conf, "fugue.optimize": "off"}))
+    with pytest.raises(Exception):
+        build().run(make_execution_engine("jax", {**conf, "fugue.optimize": "on"}))
+    # and the fusion path: rename then filter then select must also
+    # keep the error (not compose the invalid reference away)
+    def build2():
+        dag = FugueWorkflow()
+        df = dag.df([[1, 2.0]], "a:int,b:double")
+        df.rename({"a": "aa"}).filter(col("a") > 0).select(
+            "aa"
+        ).yield_dataframe_as("out", as_local=True)
+        return dag
+
+    with pytest.raises(Exception):
+        build2().run(make_execution_engine("jax", {**conf, "fugue.optimize": "on"}))
+
+
+def test_filter_not_pushed_past_computed_select():
+    dag = FugueWorkflow()
+    df = dag.df([[1, 2.0]], "a:int,b:double")
+    sel = df.select((col("a") + col("b")).cast(float).alias("s"))
+    sel.filter(col("s") > 1).yield_dataframe_as("out")
+    plan = optimize_tasks(dag.tasks, conf=dag._conf)
+    declined = _notes(plan, RULE_FILTER_PUSHDOWN, applied=False)
+    assert declined and "computed" in declined[0].message
+
+
+def test_pruning_triples_attach_to_parquet_load(parquet_file):
+    dag = FugueWorkflow()
+    df = dag.load(parquet_file).filter((col("a") > 50) & (col("c") < 2.0))
+    df.select("a", "b").yield_dataframe_as("out")
+    plan = optimize_tasks(dag.tasks, conf=dag._conf)
+    kwargs = _load_task(plan).params["params"]
+    assert kwargs["pruning"] == [["a", ">", 50], ["c", "<", 2.0]]
+
+
+def test_pruning_extraction_shapes():
+    assert extract_pruning_triples((col("x") >= 3) & (col("y") == 1.5)) == [
+        ["x", ">=", 3],
+        ["y", "==", 1.5],
+    ]
+    # flipped literal-first comparisons, OR trees, string literals
+    from fugue_tpu.column.expressions import lit
+
+    assert extract_pruning_triples(lit(3) > col("x")) == [["x", "<", 3]]
+    assert extract_pruning_triples((col("x") > 3) | (col("y") > 4)) == []
+    assert extract_pruning_triples(col("s") == lit("z")) == []
+
+
+def test_no_pruning_when_load_has_second_consumer(parquet_file):
+    dag = FugueWorkflow()
+    df = dag.load(parquet_file)
+    df.filter(col("a") > 50).yield_dataframe_as("f")
+    df.select("b").yield_dataframe_as("s")
+    plan = optimize_tasks(dag.tasks, conf=dag._conf)
+    assert "pruning" not in (_load_task(plan).params["params"] or {})
+
+
+# ---- fusion -----------------------------------------------------------------
+def test_chain_fuses_to_single_select_keeping_last_uuid():
+    dag = FugueWorkflow()
+    df = dag.df([[i, float(i), str(i)] for i in range(10)], "a:int,b:double,c:str")
+    out = df.filter(col("a") > 1).rename({"b": "bb"}).select("a", "bb")
+    out.yield_dataframe_as("out")
+    last_uuid = out.task.__uuid__()
+    plan = optimize_tasks(dag.tasks, conf=dag._conf)
+    assert _notes(plan, RULE_FUSION)
+    fused = [t for t in plan.tasks if t.extension is _b.Select]
+    assert len(fused) == 1 and fused[0].__uuid__() == last_uuid
+    # the fused node carries the original task's yields
+    assert fused[0].yields
+
+
+def test_fusion_respects_checkpoint_boundary():
+    dag = FugueWorkflow()
+    df = dag.df([[i, float(i)] for i in range(10)], "a:int,b:double")
+    mid = df.filter(col("a") > 1)
+    mid.persist()  # weak checkpoint on the intermediate: not rewirable
+    mid.select("a").yield_dataframe_as("out")
+    plan = optimize_tasks(dag.tasks, conf=dag._conf)
+    assert not _notes(plan, RULE_FUSION)
+
+
+def test_fusion_disable_key():
+    dag = FugueWorkflow()
+    df = dag.df([[1, 2.0]], "a:int,b:double")
+    df.filter(col("a") > 0).select("a").yield_dataframe_as("out")
+    conf = dict(dag._conf)
+    conf["fugue.optimize.fusion"] = False
+    plan = optimize_tasks(dag.tasks, conf=conf)
+    assert not _notes(plan, RULE_FUSION)
+
+
+# ---- common-subplan elimination ---------------------------------------------
+def test_cse_folds_duplicate_pure_subtrees():
+    dag = FugueWorkflow()
+    a = dag.df([[1], [2]], "a:int").filter(col("a") > 0)
+    b = dag.df([[1], [2]], "a:int").filter(col("a") > 0)
+    a.union(b, distinct=False).yield_dataframe_as("out")
+    plan = optimize_tasks(dag.tasks, conf=dag._conf)
+    assert len(_notes(plan, RULE_CSE)) == 2
+    assert len(plan.tasks) == len(dag.tasks) - 2
+
+
+def test_cse_skips_impure_subtrees():
+    def make(df: pd.DataFrame) -> pd.DataFrame:
+        return df
+
+    dag = FugueWorkflow()
+    a = dag.df([[1]], "a:int").transform(make, schema="*")
+    b = dag.df([[1]], "a:int").transform(make, schema="*")
+    a.union(b, distinct=False).yield_dataframe_as("out")
+    plan = optimize_tasks(dag.tasks, conf=dag._conf)
+    # the duplicate CreateData below the transforms folds; the
+    # transforms and everything above them must not
+    names = [t.name for t in plan.tasks]
+    assert sum("RunTransformer" in n for n in names) == 2
+
+
+def test_cse_keeps_duplicate_with_checkpoint():
+    dag = FugueWorkflow()
+    a = dag.df([[1]], "a:int").filter(col("a") > 0)
+    b = dag.df([[1]], "a:int").filter(col("a") > 0)
+    b.weak_checkpoint()
+    a.union(b, distinct=False).yield_dataframe_as("out")
+    plan = optimize_tasks(dag.tasks, conf=dag._conf)
+    # the CreateData dup folds; the checkpointed filter must survive
+    assert len([t for t in plan.tasks if t.extension is _b.Filter]) == 2
+
+
+# ---- structural invariants --------------------------------------------------
+def test_original_workflow_never_mutated(parquet_file):
+    dag = FugueWorkflow()
+    df = dag.load(parquet_file).filter(col("a") > 50)
+    df.select("a", "b").yield_dataframe_as("out")
+    before = [(t.name, dict(t.params)) for t in dag.tasks]
+    optimize_tasks(dag.tasks, conf=dag._conf)
+    after = [(t.name, dict(t.params)) for t in dag.tasks]
+    assert before == after
+    load = next(t for t in dag.tasks if t.extension is _b.Load)
+    assert load.params["columns"] is None
+
+
+def test_rewrites_never_change_checkpointed_uuids(parquet_file):
+    dag = FugueWorkflow()
+    df = dag.load(parquet_file).filter(col("a") > 50).select("a")
+    df.deterministic_checkpoint()
+    df.yield_dataframe_as("out")
+    original = {t.__uuid__() for t in dag.tasks}
+    plan = optimize_tasks(dag.tasks, conf=dag._conf)
+    for t in plan.tasks:
+        if not t.checkpoint.is_null:
+            assert t.__uuid__() in original
+
+
+def test_compile_conf_disables_optimizer(parquet_file):
+    # an explicit workflow compile-conf value wins over the engine
+    # conf's inherited "auto" default (same precedence as fugue.analysis)
+    from fugue_tpu.execution import make_execution_engine
+
+    dag = FugueWorkflow({"fugue.optimize": "off"})
+    dag.load(parquet_file).select("a").yield_dataframe_as("out")
+    engine = make_execution_engine("jax")
+    run_tasks = dag._optimized_tasks(engine)
+    assert all(a is b for a, b in zip(run_tasks, dag.tasks))
+    # and without the compile-conf override the same engine optimizes
+    dag2 = FugueWorkflow()
+    dag2.load(parquet_file).select("a").yield_dataframe_as("out")
+    run_tasks2 = dag2._optimized_tasks(engine)
+    assert not all(a is b for a, b in zip(run_tasks2, dag2.tasks))
+
+
+def test_tasks_are_pure_rejects_load_and_outputs(parquet_file):
+    # Load is CSE-pure within one run, but a CROSS-REQUEST result cache
+    # must not assume external file immutability
+    from fugue_tpu.optimize.rewrite import tasks_are_pure
+
+    dag = FugueWorkflow()
+    dag.load(parquet_file).select("a")
+    assert not tasks_are_pure(dag.tasks)
+    dag2 = FugueWorkflow()
+    dag2.df([[1]], "a:int").select("a")
+    assert tasks_are_pure(dag2.tasks)
+    dag2.df([[1]], "a:int").show()
+    assert not tasks_are_pure(dag2.tasks)  # output task = side effect
+
+
+def test_fwf501_excluded_from_run_gate():
+    from fugue_tpu.analysis import Analyzer
+
+    dag = FugueWorkflow()
+    dag.df([[1, 2.0]], "a:int,b:double").filter(col("a") > 0).select(
+        "a"
+    ).yield_dataframe_as("out")
+    full = Analyzer().analyze(dag, conf=dict(dag._conf))
+    gated = Analyzer().analyze(
+        dag, conf=dict(dag._conf), exclude_lint_only=True
+    )
+    assert any(d.code == "FWF501" for d in full)
+    assert not any(d.code == "FWF501" for d in gated)
+
+
+def test_rename_expr_columns_rebuilds_tree():
+    e = (col("a") + col("b")).alias("s") > 3
+    out = rename_expr_columns(e, {"a": "x"})
+    cols = set()
+
+    def walk(x):
+        from fugue_tpu.analysis.schema_pass import expr_columns
+
+        cols.update(expr_columns(x))
+
+    walk(out)
+    assert cols == {"x", "b"}

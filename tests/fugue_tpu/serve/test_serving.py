@@ -159,7 +159,14 @@ def test_concurrent_sessions_parity_with_serial():
     n_sessions, n_queries = 4, 3
     frames = {i: _pdf(seed=10 + i) for i in range(n_sessions)}
     with ServeDaemon(
-        {FUGUE_CONF_SERVE_MAX_CONCURRENT: n_sessions}
+        {
+            FUGUE_CONF_SERVE_MAX_CONCURRENT: n_sessions,
+            # this test PROVES concurrent execution against one shared
+            # engine via exact run counts; the ISSUE 10 cross-request
+            # result cache would (correctly) answer the repeated
+            # identical queries without running them, so it is off here
+            "fugue.serve.result_cache": False,
+        }
     ) as daemon:
         host, port = daemon.address
         results: dict = {}
