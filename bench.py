@@ -23,10 +23,12 @@ from typing import Any, Callable, Dict, Tuple
 
 _SMALL = os.environ.get("BENCH_SMALL", "") in ("1", "true")
 
-# persistent XLA compilation cache (fugue.jax.compile.cache): a fresh
-# process reuses compiled executables, collapsing the ~40s cold compile to
-# seconds on the second run — see detail.jax_cold_secs for THIS process's
-# cold number (cache-hit when a previous bench populated the cache)
+# persistent executable cache (fugue.optimize.cache.dir; this env var is
+# its deprecated-alias spelling): a fresh process deserializes the
+# AOT-compiled executables instead of paying XLA again — see
+# detail.jax_cold_secs for THIS process's cold number (cache-hit when a
+# previous bench populated the cache) and config 7_cold_start for the
+# controlled fresh-process on/off comparison
 os.environ.setdefault(
     "FUGUE_JAX_COMPILE_CACHE",
     os.path.join(tempfile.gettempdir(), "fugue_jax_compile_cache"),
@@ -1208,24 +1210,30 @@ def _serving_warm_resubmission(rows: int, agg_sql: str) -> Dict[str, Any]:
 def _serving_restart_recovery(
     tenants: int, rows: int, agg_sql: str
 ) -> Dict[str, Any]:
-    """Restart-recovery scenario (ISSUE 7): a DURABLE daemon holding one
-    hot table per tenant is hard-killed mid-serving, then restarted on
-    the same state path. Reports time-to-healthy (journal load + session
-    rehydration, i.e. restart ``start()`` wall), the recovered session /
-    hot-table counts, and the lazy integrity-verified reload time of the
-    first post-restart query per tenant."""
+    """Restart-recovery scenario (ISSUE 7 + 11): a DURABLE daemon holding
+    one hot table per tenant — now also backed by the persistent
+    executable cache — is hard-killed mid-serving, then restarted on the
+    same state path. Reports time-to-ready (journal load + session
+    rehydration + executable pre-warm), the recovered session/hot-table
+    counts, and ``time_to_first_query`` SPLIT into journal-reload /
+    cache-load / compile / dispatch phases (the compile phase must read
+    ~0 when the pre-warm did its job)."""
     import tempfile
 
     import numpy as np
     import pandas as pd
 
+    from fugue_tpu.optimize import flush_persists, get_plan_cache
     from fugue_tpu.serve import ServeClient, ServeDaemon
 
     out: Dict[str, Any] = {"tenants": tenants, "rows_per_table": rows}
     with tempfile.TemporaryDirectory() as state_dir:
         conf = {
             "fugue.serve.max_concurrent": tenants,
-            "fugue.serve.state_path": state_dir,
+            "fugue.serve.state_path": os.path.join(state_dir, "state"),
+            # ISSUE 11: the executable disk tier + daemon pre-warm make
+            # the restart's first query compile-free
+            "fugue.optimize.cache.dir": os.path.join(state_dir, "xc"),
         }
         d1 = ServeDaemon(conf).start()
         host, port = d1.address
@@ -1242,11 +1250,20 @@ def _serving_restart_recovery(
             )
             d1.sessions.get(sid).save_table("t", d1.engine.to_df(pdf))
             sids.append(sid)
+        for sid in sids:
+            ServeClient(host, port, timeout=600).sql(sid, agg_sql)
+        flush_persists()  # executables durable before the "kill -9"
         d1._hard_kill()  # no drain, no final journal write
+        # the plan cache is process-wide: clearing it makes the restart
+        # below equivalent to a fresh process (disk is the only carry)
+        get_plan_cache().clear()
 
         t0 = time.perf_counter()
         d2 = ServeDaemon(conf).start()
         out["time_to_healthy_secs"] = round(time.perf_counter() - t0, 4)
+        while not d2.ready and time.perf_counter() - t0 < 120:
+            time.sleep(0.01)
+        out["time_to_ready_secs"] = round(time.perf_counter() - t0, 4)
         try:
             c2 = ServeClient(host, d2.address[1], timeout=600)
             st = c2.status()
@@ -1255,8 +1272,12 @@ def _serving_restart_recovery(
             # verified artifact into the device catalog
             t1 = time.perf_counter()
             ok = 0
+            first_query_secs = None
             for sid in sids:
+                q0 = time.perf_counter()
                 snap = c2.sql(sid, agg_sql)
+                if first_query_secs is None:
+                    first_query_secs = round(time.perf_counter() - q0, 4)
                 if snap["status"] == "done" and "t" in c2.session(sid)[
                     "tables"
                 ]:
@@ -1265,9 +1286,123 @@ def _serving_restart_recovery(
                 time.perf_counter() - t1, 4
             )
             out["recovered_hot_tables"] = ok
+            # ISSUE 11 phase split: journal-reload / cache-load from
+            # startup, compile / dispatch from the first executed query
+            cold = c2.status().get("cold_start", {})
+            phases = dict(cold.get("phases", {}))
+            fq = cold.get("first_query", {})
+            out["time_to_first_query"] = {
+                "total_secs": first_query_secs,
+                "journal_reload_secs": phases.get("journal_reload_secs"),
+                "cache_load_secs": phases.get("cache_load_secs"),
+                "prewarmed_executables": phases.get(
+                    "prewarmed_executables"
+                ),
+                "compile_secs": fq.get("compile_secs"),
+                "dispatch_secs": fq.get("dispatch_secs"),
+                "disk_load_secs": fq.get("disk_load_secs"),
+                "xla_compiles": fq.get("xla_compiles"),
+            }
         finally:
             d2.stop()
     return out
+
+
+_COLD_START_SCRIPT = r"""
+import json, os, sys, time
+t_start = time.perf_counter()
+import numpy as np
+from fugue_tpu.column import col
+from fugue_tpu.column import functions as ff
+from fugue_tpu.execution import make_execution_engine
+from fugue_tpu.execution.api import aggregate
+from fugue_tpu.optimize import flush_persists
+t_import = time.perf_counter()
+
+src, out_path, cache_dir, batch_rows = sys.argv[1:5]
+conf = {"fugue.jax.io.batch_rows": int(batch_rows)}
+if cache_dir:
+    conf["fugue.optimize.cache.dir"] = cache_dir
+t0 = time.perf_counter()
+e = make_execution_engine("jax", conf)
+df = e.load_df(src, format_hint="parquet")
+agg = aggregate(
+    e.filter(df, col("k") < 96), partition_by="k",
+    s=ff.sum(col("v")), c=ff.count(col("v")),
+    engine=e, as_fugue=True,
+)
+e.save_df(agg, out_path, format_hint="parquet")
+t1 = time.perf_counter()
+flush_persists()
+print(json.dumps({
+    "import_secs": round(t_import - t_start, 4),
+    "pipeline_secs": round(t1 - t0, 4),
+    "process_secs": round(time.perf_counter() - t_start, 4),
+    "compile_cache": e.compile_cache_stats,
+    "exec_cache": e.exec_cache_stats,
+}))
+"""
+
+
+def _config7_cold_start() -> Dict[str, Any]:
+    """Cold-start scenario (ISSUE 11): the SAME pipeline end-to-end in
+    FRESH OS processes — executable cache off, cache on with an empty
+    dir (pays compile + persists), and cache on warm (the acceptance
+    row: pipeline wall <1 s on this container with 0 XLA compiles,
+    counter-verified). ``import_secs`` is reported separately: the
+    interpreter + jax import cost is shared by every python process and
+    not something the cache can (or should) hide."""
+    import subprocess
+    import sys as _sys
+
+    import numpy as np
+    import pandas as pd
+
+    n = _scale(2_000_000)
+    rng = np.random.default_rng(17)
+    tmp = tempfile.mkdtemp(prefix="fugue_cold_")
+    src = os.path.join(tmp, "src.parquet")
+    pd.DataFrame(
+        {
+            "k": rng.integers(0, 128, n).astype(np.int32),
+            "v": rng.random(n).astype(np.float32),
+        }
+    ).to_parquet(src)
+    cache_dir = os.path.join(tmp, "xc")
+    batch_rows = str(max(n // 16, 65_536))
+
+    def run(tag: str, cache: str) -> Dict[str, Any]:
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        # the bench process exports the legacy alias env var for the
+        # headline's own cold/warm split: the controlled comparison here
+        # must not let it leak into the cache-off variant
+        env.pop("FUGUE_JAX_COMPILE_CACHE", None)
+        out = subprocess.run(
+            [
+                _sys.executable, "-c", _COLD_START_SCRIPT,
+                src, os.path.join(tmp, f"out_{tag}.parquet"),
+                cache, batch_rows,
+            ],
+            capture_output=True, text=True, timeout=900, env=env,
+        )
+        if out.returncode != 0:  # surfaced in the artifact, not fatal
+            return {"error": out.stderr[-1500:]}
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    res: Dict[str, Any] = {"rows": n}
+    res["cache_off"] = run("off", "")
+    res["cache_on_cold"] = run("cold", cache_dir)  # compiles + persists
+    res["cache_on_warm"] = run("warm", cache_dir)  # the fresh-process hit
+    warm = res["cache_on_warm"]
+    off = res["cache_off"]
+    if "pipeline_secs" in warm and "pipeline_secs" in off:
+        res["warm_vs_off_speedup"] = round(
+            off["pipeline_secs"] / max(warm["pipeline_secs"], 1e-9), 2
+        )
+        res["warm_xla_compiles"] = warm["compile_cache"]["misses"]
+        res["warm_under_1s"] = warm["pipeline_secs"] < 1.0
+    return res
 
 
 def _bench() -> Dict[str, Any]:
@@ -1280,6 +1415,7 @@ def _bench() -> Dict[str, Any]:
         "4_cotransform": _config4_cotransform(),
         "5_e2e_parquet": _config5_e2e_parquet(),
         "6_serving_daemon": _config6_serving_daemon(),
+        "7_cold_start": _config7_cold_start(),
     }
     headline["detail"]["configs"] = configs
     return headline

@@ -102,6 +102,40 @@ class DaemonResumeOffRule(Rule):
 
 
 @register_rule
+class DaemonColdStartCacheRule(Rule):
+    code = "FWF502"
+    severity = Severity.WARN
+    description = (
+        "serve-targeted conf without a persistent executable cache dir: "
+        "every daemon restart re-pays full XLA compilation before the "
+        "first query"
+    )
+
+    def check(self, ctx: Any) -> Iterable[Diagnostic]:
+        state_path = str(
+            ctx.conf.get(FUGUE_CONF_SERVE_STATE_PATH, "") or ""
+        ).strip()
+        if state_path == "":
+            return
+        # the SAME resolution run() and the engine use (new key, then
+        # the deprecated fugue.jax.compile.cache alias + env var), so
+        # the gate and the engine can never disagree about whether the
+        # disk tier is on
+        from fugue_tpu.optimize.exec_cache import resolve_cache_dir
+
+        if resolve_cache_dir(ctx.conf) != "":
+            return
+        yield self.diag(
+            "the daemon journals sessions and jobs for restart recovery "
+            "(fugue.serve.state_path is set), but no persistent "
+            "executable cache dir is configured: a restarted daemon "
+            "re-pays the full XLA compile of every hot query before its "
+            "first answer — set fugue.optimize.cache.dir so restarts "
+            "pre-warm from disk and time_to_first_query stays IO-bound",
+        )
+
+
+@register_rule
 class ObsTracePathWithoutObsRule(Rule):
     code = "FWF404"
     severity = Severity.WARN

@@ -41,6 +41,7 @@ FUGUE_CONF_JAX_PLACEMENT = "fugue.jax.placement"
 FUGUE_CONF_JAX_MIN_DEVICE_BYTES = "fugue.jax.placement.min_device_bytes"
 FUGUE_CONF_JAX_COMPILE_CACHE = "fugue.jax.compile.cache"
 FUGUE_CONF_JAX_IO_BATCH_ROWS = "fugue.jax.io.batch_rows"
+FUGUE_CONF_JAX_IO_PIPELINE = "fugue.jax.io.pipeline"
 FUGUE_CONF_JAX_GROUPBY_MATMUL = "fugue.jax.groupby.matmul"
 FUGUE_CONF_JAX_GROUPBY_STRATEGY = "fugue.jax.groupby.strategy"
 FUGUE_CONF_JAX_GROUPBY_AUTOTUNE = "fugue.jax.groupby.autotune"
@@ -68,6 +69,7 @@ FUGUE_CONF_SERVE_BREAKER_COOLDOWN = "fugue.serve.breaker.cooldown"
 FUGUE_CONF_SERVE_HEARTBEAT_TIMEOUT = "fugue.serve.heartbeat_timeout"
 FUGUE_CONF_SERVE_JOB_TTL = "fugue.serve.job_ttl"
 FUGUE_CONF_SERVE_CLIENT_RETRIES = "fugue.serve.client.retries"
+FUGUE_CONF_SERVE_PREWARM = "fugue.serve.prewarm"
 FUGUE_CONF_OPTIMIZE = "fugue.optimize"
 FUGUE_CONF_OPTIMIZE_CSE = "fugue.optimize.cse"
 FUGUE_CONF_OPTIMIZE_FILTER = "fugue.optimize.filter_pushdown"
@@ -79,6 +81,7 @@ FUGUE_CONF_OPTIMIZE_CACHE_MAX_PROGRAMS = "fugue.optimize.cache.max_programs"
 FUGUE_CONF_OPTIMIZE_CACHE_MAX_RESULT_BYTES = (
     "fugue.optimize.cache.max_result_bytes"
 )
+FUGUE_CONF_OPTIMIZE_CACHE_DIR = "fugue.optimize.cache.dir"
 FUGUE_CONF_SERVE_RESULT_CACHE = "fugue.serve.result_cache"
 FUGUE_CONF_OBS_ENABLED = "fugue.obs.enabled"
 FUGUE_CONF_OBS_TRACE_PATH = "fugue.obs.trace_path"
@@ -223,13 +226,38 @@ def _declare_defaults() -> None:
         256 * 1024 * 1024,
         "auto-placement threshold: smaller frames stay on the host tier",
     )
-    r(FUGUE_CONF_JAX_COMPILE_CACHE, str, "", "persistent XLA compilation cache dir")
+    # DEPRECATED alias of fugue.optimize.cache.dir (the persistent
+    # executable cache that replaced jax's own compilation cache here).
+    # Precedence: fugue.optimize.cache.dir wins when both are set; a
+    # value arriving only through this key (or the FUGUE_JAX_COMPILE_CACHE
+    # env var) still enables the SAME disk tier, with a deprecation note
+    # logged — two divergent caches never run side by side.
+    r(
+        FUGUE_CONF_JAX_COMPILE_CACHE,
+        str,
+        "",
+        "DEPRECATED alias of fugue.optimize.cache.dir (persistent "
+        "executable cache dir); the new key wins when both are set",
+    )
     # streamed parquet ingest/save: 0 = eager (whole-table). > 0 pipelines
     # arrow record-batch decode with per-shard device_put staging on load
     # (each mesh shard ships as soon as its rows are decoded, while the
     # next batches decode) and bounds parquet row groups on save. The
     # ingest stays LAZY: host-only chains never pay a device round trip.
     r(FUGUE_CONF_JAX_IO_BATCH_ROWS, int, 0, "streamed parquet ingest batch rows (0 = eager)")
+    # end-to-end IO pipelining over the streamed paths (requires
+    # batch_rows > 0): on load, the first batches kick a background warm
+    # of the persistent-executable cache so the first dispatch after
+    # assembly is execute-only; on save, row-group encode/write of chunk
+    # k overlaps the device->host fetch of chunk k+1. Results and row
+    # order are identical to the unpipelined stream (parity-tested).
+    r(
+        FUGUE_CONF_JAX_IO_PIPELINE,
+        bool,
+        True,
+        "overlap streamed-IO decode/staging with executable warm (load) "
+        "and row-group writes with result fetch (save)",
+    )
     # group-by reduction algorithm (legacy knob, kept for back-compat):
     # "always"/"never" pin the strategy below to matmul/scatter; "auto"
     # defers to fugue.jax.groupby.strategy.
@@ -486,6 +514,20 @@ def _declare_defaults() -> None:
         "503/429 backpressure answers (honors server Retry-After)",
         in_defaults=False,
     )
+    # daemon pre-warm (cold-start recovery): with a persistent
+    # executable cache dir configured, a starting daemon loads the
+    # cached executables matching its engine signature in the
+    # background and /v1/health answers 503 state="warming" until the
+    # warm finishes — so an LB routes the first query only when its
+    # dispatch is compile-free (time_to_first_query becomes IO-bound)
+    r(
+        FUGUE_CONF_SERVE_PREWARM,
+        bool,
+        True,
+        "pre-load persistent-cached executables at daemon start before "
+        "/v1/health reports ready",
+        in_defaults=False,
+    )
     # cost-based DAG optimizer (fugue_tpu/optimize): the rewrite phase
     # running between schema propagation and execution. "auto" (default)
     # enables it for jax engines only; per-rule keys disable individual
@@ -546,6 +588,23 @@ def _declare_defaults() -> None:
         256 * 1024 * 1024,
         "byte bound on cached results (governed engines additionally "
         "clamp to a fraction of the HBM ledger budget)",
+    )
+    # the plan cache's DISK tier (fugue_tpu/optimize/exec_cache.py):
+    # compiled executables are AOT-serialized through engine.fs under
+    # this dir/URI, keyed by the plan signature (platform + mesh devices
+    # + fugue.jax.* conf) plus the program key, fn source hash and
+    # argument avals — so a FRESH PROCESS skips XLA compilation
+    # entirely, and URI-capable storage lets fleet replicas share one
+    # cache. Entries are version-stamped (jax/jaxlib/format rev); stale
+    # or corrupt entries evict to a recompile, never an error. Takes
+    # precedence over the deprecated fugue.jax.compile.cache alias.
+    r(
+        FUGUE_CONF_OPTIMIZE_CACHE_DIR,
+        str,
+        "",
+        "dir/URI (via engine.fs) of the persistent compiled-executable "
+        "cache ('' = disk tier off; overrides the deprecated "
+        "fugue.jax.compile.cache alias)",
     )
     # serving daemon's cross-request query result cache: a resubmitted
     # identical pure query (same session, same table-catalog epoch, same

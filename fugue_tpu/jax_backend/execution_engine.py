@@ -25,6 +25,7 @@ fugue_spark/execution_engine.py:336) — but TPU-first in design:
 """
 
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import jax
@@ -583,13 +584,13 @@ class JaxExecutionEngine(ExecutionEngine):
 
     Config keys: ``fugue.jax.default.partitions`` (logical split count for
     host-fallback maps; default = mesh size), ``fugue.jax.placement``,
-    ``fugue.jax.placement.min_device_bytes``, ``fugue.jax.compile.cache``
-    (persistent XLA compilation cache dir)."""
+    ``fugue.jax.placement.min_device_bytes``, ``fugue.optimize.cache.dir``
+    (persistent compiled-executable cache; the deprecated
+    ``fugue.jax.compile.cache`` key aliases it)."""
 
     def __init__(self, conf: Any = None, mesh: Any = None):
         super().__init__(conf)
         ensure_x64()
-        _maybe_enable_compile_cache(self.conf, self.log)
         self._mesh = mesh if mesh is not None else make_mesh()
         self._mesh_pinned = mesh is not None
         self._host_mesh = self._mesh if mesh is not None else _host_mesh_like(
@@ -631,17 +632,53 @@ class JaxExecutionEngine(ExecutionEngine):
             engine_plan_signature,
             get_plan_cache,
         )
+        from fugue_tpu.optimize.exec_cache import (
+            ExecutableDiskCache,
+            resolve_cache_dir,
+        )
 
         _m_plan = self.metrics.counter(
             "fugue_engine_plan_cache_total",
-            "process-wide plan-cache program-handle lookups by result",
-            ["result"],
+            "process-wide plan-cache lookups by tier and result "
+            "(memory = shared jit handles, disk = persisted executables)",
+            ["tier", "result"],
         )
-        self._plan_hits = _m_plan.labels(result="hit")
-        self._plan_misses = _m_plan.labels(result="miss")
+        self._plan_hits = _m_plan.labels(tier="memory", result="hit")
+        self._plan_misses = _m_plan.labels(tier="memory", result="miss")
+        self._disk_hits = _m_plan.labels(tier="disk", result="hit")
+        self._disk_misses = _m_plan.labels(tier="disk", result="miss")
+        self._disk_evicts = _m_plan.labels(tier="disk", result="evict")
+        self._disk_corrupt = _m_plan.labels(tier="disk", result="corrupt")
         self._plan_cache = get_plan_cache()
         self._plan_cache.configure(self.conf)
         self._plan_sig = engine_plan_signature(self)
+        # DISK tier under the plan cache (ISSUE 11): AOT-serialized
+        # executables under fugue.optimize.cache.dir (or its deprecated
+        # fugue.jax.compile.cache alias) — a fresh PROCESS running a
+        # cached program skips XLA entirely. Disabled (empty dir) = the
+        # dispatch hot path never touches any of this.
+        self._exec_cache = ExecutableDiskCache(
+            self, resolve_cache_dir(self.conf, self.log)
+        )
+        self._exec_enabled = self._exec_cache.enabled
+        self._m_deserialize = self.metrics.histogram(
+            "fugue_engine_exec_cache_deserialize_seconds",
+            "disk-tier executable deserialize latency",
+        )
+        _m_persist = self.metrics.counter(
+            "fugue_engine_exec_cache_persist_total",
+            "disk-tier executable persist outcomes",
+            ["result"],
+        )
+        self._persist_ok = _m_persist.labels(result="ok")
+        self._persist_err = _m_persist.labels(result="error")
+        # compile/execute/disk-load wall clock split of every jitted
+        # dispatch since construction — the daemon's time_to_first_query
+        # phase report reads deltas of this
+        self._dispatch_secs_lock = threading.Lock()
+        self._dispatch_secs = {
+            "compile": 0.0, "execute": 0.0, "disk_load": 0.0,
+        }
         self.metrics.add_collector(self._collect_memory_gauges)
         # segment-reduction strategy observability, mirroring fallbacks:
         # strategy name -> times an aggregate program ran on it ("generic"
@@ -716,6 +753,36 @@ class JaxExecutionEngine(ExecutionEngine):
             "hits": int(self._plan_hits.value),
             "misses": int(self._plan_misses.value),
         }
+
+    @property
+    def exec_cache_stats(self) -> Dict[str, Any]:
+        """The DISK tier's counters: per-shape executable loads by
+        result (hit/miss/evict/corrupt) plus persist outcomes. All
+        zeros when no cache dir is configured."""
+        return {
+            "enabled": self._exec_enabled,
+            "dir": self._exec_cache.base_uri,
+            "hits": int(self._disk_hits.value),
+            "misses": int(self._disk_misses.value),
+            "evictions": int(self._disk_evicts.value),
+            "corrupt": int(self._disk_corrupt.value),
+            "persisted": int(self._persist_ok.value),
+            "persist_failures": int(self._persist_err.value),
+        }
+
+    @property
+    def dispatch_time_stats(self) -> Dict[str, float]:
+        """Wall-clock split of every jitted dispatch since construction:
+        ``compile`` (dispatches that paid an XLA compile), ``execute``
+        (compile-free dispatches) and ``disk_load`` (executable
+        deserialize time) — the cold-start phase accounting the serving
+        daemon's ``time_to_first_query`` report reads."""
+        with self._dispatch_secs_lock:
+            return dict(self._dispatch_secs)
+
+    def _add_dispatch_secs(self, kind: str, secs: float) -> None:
+        with self._dispatch_secs_lock:
+            self._dispatch_secs[kind] += secs
 
     def _collect_memory_gauges(self) -> None:
         """Scrape-time collector: the PR 4 memory ledger's live/peak
@@ -1739,11 +1806,24 @@ class JaxExecutionEngine(ExecutionEngine):
 
         jdf: JaxDataFrame = self.to_df(df)  # type: ignore
         batch_rows = int(self.conf.get(FUGUE_CONF_JAX_IO_BATCH_ROWS, 0))
+        partition_cols = _io.spec_partition_cols(partition_spec, force_single)
         if batch_rows > 0:
+            # pipelined save (fugue.jax.io.pipeline): row-group writes of
+            # chunk k overlap the device->host fetch of chunk k+1, so the
+            # parquet encode rides the tail of compute instead of waiting
+            # for the full readback; falls through to the eager path for
+            # targets/frames it does not cover
+            from fugue_tpu.jax_backend import ingest
+
+            if ingest.try_pipelined_save(
+                self, jdf, path, format_hint, mode, partition_cols,
+                batch_rows, dict(kwargs),
+            ):
+                return
             kwargs.setdefault("batch_rows", batch_rows)
         _io.save_df(
             jdf.as_local_bounded(), path, format_hint, mode,
-            partition_cols=_io.spec_partition_cols(partition_spec, force_single),
+            partition_cols=partition_cols,
             fs=self.fs, **kwargs,
         )
 
@@ -1971,18 +2051,204 @@ class JaxExecutionEngine(ExecutionEngine):
                 self._program_log[_k] = (
                     _f, jax.tree_util.tree_map(_as_aval, args)
                 )
+            if self._exec_enabled:
+                return self._dispatch_with_disk_tier(_j, _f, _k, _n, args)
             return self._traced_dispatch(_j, _n, args)
 
         cache[key] = _wrapped
         return _wrapped
 
-    def _traced_dispatch(self, jitted: Any, name: str, args: Any) -> Any:
+    def _dispatch_with_disk_tier(
+        self, jitted: Any, fn: Callable, key: Any, name: str, args: Any
+    ) -> Any:
+        """Dispatch with the persistent-executable tier in front of the
+        jit path: a shape this process never compiled first probes the
+        disk cache (deserialize ≪ compile); a shape the jit path already
+        compiled skips the probe forever. A deserialized executable that
+        rejects the live inputs (layout/sharding drift) falls back to
+        the jit path — the tier can lose time, never correctness."""
+        from fugue_tpu.optimize.exec_cache import (
+            args_signature,
+            fn_source_hash,
+        )
+
+        sig = args_signature(args)
+        if sig is None:
+            # a leaf the signature scheme does not model (host object,
+            # uncommitted np array): the disk tier skips this program
+            return self._traced_dispatch(jitted, name, args)
+        # the key folds the cache BASE URI (the probe/compiled/persist
+        # bookkeeping describes one disk's state — two same-signature
+        # engines pointed at different dirs must not starve each other)
+        # and the FN SOURCE HASH (a code change under the same logical
+        # key must never hit a warm-loaded stale executable)
+        exec_key = (
+            self._exec_cache.base_uri,
+            (self._plan_sig, key),
+            fn_source_hash(fn),
+            sig.token,
+        )
+        want_persist = False
+        compiled = self._plan_cache.get_executable(exec_key)
+        if compiled is None and not self._plan_cache.was_compiled(exec_key):
+            compiled = self._load_executable(key, fn, sig, exec_key)
+            # the disk has no (valid) entry for this shape: persist one
+            # after the jit dispatch below — even when the jit handle
+            # already owns the executable (compiled by an earlier
+            # same-signature engine), the disk must still learn it, or a
+            # warm in-memory tier would starve the cross-process tier
+            want_persist = compiled is None
+        if compiled is not None:
+            try:
+                t0 = time.perf_counter()
+                with start_span("engine.dispatch", program=name) as sp:
+                    out = compiled(*args)
+                    if sp:
+                        sp.name = "engine.execute"
+                # an AOT dispatch is compile-free by construction: it
+                # counts as a hit on the per-dispatch compile surface
+                self._compile_hits.inc()
+                self._add_dispatch_secs(
+                    "execute", time.perf_counter() - t0
+                )
+                return out
+            except Exception as ex:
+                # ANY failure of a deserialized executable — python-level
+                # aval/sharding mismatch (ValueError/TypeError) or an
+                # XLA runtime rejection the token scheme cannot model —
+                # drops the entry and falls back to the jit path, whose
+                # fresh persist below OVERWRITES the disk entry: a bad
+                # cached executable may lose time, never correctness,
+                # and can never poison a query across restarts
+                self._plan_cache.drop_executable(exec_key)
+                want_persist = True
+                self.log.info(
+                    "fugue_tpu exec-cache: cached executable for %s "
+                    "rejected live inputs (%s: %s); recompiling",
+                    name, type(ex).__name__, ex,
+                )
+        return self._traced_dispatch(
+            jitted, name, args,
+            persist=(key, fn, sig, exec_key) if want_persist else None,
+        )
+
+    def _load_executable(
+        self, key: Any, fn: Callable, sig: Any, exec_key: Any
+    ) -> Optional[Any]:
+        """One disk-tier probe: deserialize the entry for (program key,
+        fn hash, avals) if present and version-valid; counts
+        hit/miss/evict/corrupt under ``tier="disk"``."""
+        dc = self._exec_cache
+        eid = dc.entry_id(self._plan_sig, key, fn, sig.token)
+        if eid is None:
+            self._plan_cache.mark_compiled(exec_key)  # never probe again
+            return None
+        t0 = time.perf_counter()
+        status, compiled, _meta = dc.load(dc.entry_uri(self._plan_sig, eid))
+        elapsed = time.perf_counter() - t0
+        if status == "hit":
+            self._disk_hits.inc()
+            self._m_deserialize.labels().observe(elapsed)
+            self._add_dispatch_secs("disk_load", elapsed)
+            self._plan_cache.put_executable(exec_key, compiled)
+            return compiled
+        # disjoint result labels (matching the warm-scan path): an
+        # absent entry is a miss; a version-stale or unreadable one
+        # counts ONLY as evict/corrupt — either way the caller compiles
+        if status == "evict":
+            self._disk_evicts.inc()
+        elif status == "corrupt":
+            self._disk_corrupt.inc()
+        else:
+            self._disk_misses.inc()
+        return None
+
+    def try_begin_warm(self) -> Optional[Callable[[], int]]:
+        """SYNCHRONOUSLY claim the once-per-(cache dir, plan signature)
+        executable warm and hand back the work to run (on any thread);
+        None when the disk tier is off or another caller already owns
+        the claim. Callers who must not lose the claim to a concurrent
+        warm trigger (the daemon's readiness gate vs a streamed
+        ingest's first-batch hook) claim here first, then run/spawn."""
+        if not self._exec_enabled:
+            return None
+        if not self._plan_cache.claim_warm(
+            (self._exec_cache.base_uri, self._plan_sig)
+        ):
+            return None
+        return self._warm_executables_now
+
+    def warm_executables(self, background: bool = False) -> Any:
+        """Load every disk-tier entry matching this engine's plan
+        signature into the in-memory executable store, so upcoming
+        dispatches are compile-free AND deserialize-free. Runs at most
+        once per (cache dir, plan signature) per process (the claim
+        lives on the plan cache, taken on THIS thread). Returns the
+        number of executables loaded — or, with ``background=True``,
+        the started thread (None when there is nothing to do)."""
+        work = self.try_begin_warm()
+        if work is None:
+            return None if background else 0
+        if background:
+            from fugue_tpu.optimize.exec_cache import spawn_warm_thread
+
+            return spawn_warm_thread(work)
+        return work()
+
+    def _warm_executables_now(self) -> int:
+        dc = self._exec_cache
+        loaded = 0
+        try:
+            for uri in dc.scan(self._plan_sig):
+                t0 = time.perf_counter()
+                status, compiled, meta = dc.load(uri)
+                if status == "hit" and meta is not None:
+                    self._disk_hits.inc()
+                    elapsed = time.perf_counter() - t0
+                    self._m_deserialize.labels().observe(elapsed)
+                    self._add_dispatch_secs("disk_load", elapsed)
+                    self._plan_cache.put_executable(
+                        (
+                            dc.base_uri,
+                            (meta["plan_sig"], meta["key"]),
+                            # entries without a recorded fn hash can
+                            # never match a live dispatch key: stale
+                            # formats warm-load inert, never wrong
+                            meta.get("fn_hash", ""),
+                            meta["aval_token"],
+                        ),
+                        compiled,
+                    )
+                    loaded += 1
+                elif status == "evict":
+                    self._disk_evicts.inc()
+                elif status == "corrupt":
+                    self._disk_corrupt.inc()
+        except Exception as ex:  # pragma: no cover - warm is best-effort
+            self.log.warning(
+                "fugue_tpu exec-cache: warm scan failed (%s: %s)",
+                type(ex).__name__, ex,
+            )
+        if loaded:
+            self.log.info(
+                "fugue_tpu exec-cache: pre-warmed %d executables from %s",
+                loaded, dc.base_uri,
+            )
+        return loaded
+
+    def _traced_dispatch(
+        self, jitted: Any, name: str, args: Any, persist: Any = None
+    ) -> Any:
         """One jitted-program dispatch under the compile/execute span
         split. Whether THIS dispatch compiled is read from jax's own
         per-shape cache (``_cache_size`` growth), so shape-driven
         recompiles (row_bucket=0) and post-failure retries are labeled
         ``engine.compile`` too — the slow-query breakdown must pin
-        multi-second compile time on the compile phase, not execute."""
+        multi-second compile time on the compile phase, not execute.
+
+        ``persist`` (set by the disk-tier dispatch path) is the
+        ``(key, fn, sig, exec_key)`` needed to background-persist the
+        executable this dispatch is about to compile."""
         sizer = getattr(jitted, "_cache_size", None)
         before = -1
         if sizer is not None:
@@ -1990,6 +2256,7 @@ class JaxExecutionEngine(ExecutionEngine):
                 before = sizer()
             except Exception:  # pragma: no cover - jax version drift
                 sizer = None
+        t0 = time.perf_counter()
         with start_span("engine.dispatch", program=name) as sp:
             out = jitted(*args)
             compiled = False
@@ -2006,7 +2273,27 @@ class JaxExecutionEngine(ExecutionEngine):
                 # spans are plain records: the name settles once the
                 # dispatch revealed whether it compiled
                 sp.name = "engine.compile" if compiled else "engine.execute"
+        self._add_dispatch_secs(
+            "compile" if compiled else "execute", time.perf_counter() - t0
+        )
+        if persist is not None:
+            key, fn, sig, exec_key = persist
+            # whichever way this dispatch went, the jit handle now owns
+            # the shape in-process: later dispatches skip the disk probe
+            self._plan_cache.mark_compiled(exec_key)
+            # persist even when THIS dispatch did not compile — the
+            # handle may carry an executable compiled before the disk
+            # tier was watching (earlier same-signature engine), and the
+            # probe above established the disk does not have it yet;
+            # lower().compile() hits jax's in-memory caches either way
+            self._exec_cache.schedule_persist(
+                jitted, self._plan_sig, key, fn, sig, name,
+                on_done=self._note_persist,
+            )
         return out
+
+    def _note_persist(self, ok: bool) -> None:
+        (self._persist_ok if ok else self._persist_err).inc()
 
     def _map_program(
         self,
@@ -2899,38 +3186,6 @@ def _host_mesh_like(mesh: Any) -> Any:
     ):
         return mesh
     return make_mesh(list(cpu_devs))
-
-
-_COMPILE_CACHE_SET = False
-
-
-def _maybe_enable_compile_cache(conf: Any, log: Any) -> None:
-    """Point XLA's persistent compilation cache at ``fugue.jax.compile.cache``
-    (conf or env FUGUE_JAX_COMPILE_CACHE) so a fresh process reuses compiled
-    executables instead of paying the ~40s cold compile again (BENCH cold/warm
-    split). Process-global and set-once: jax reads it at first compile."""
-    global _COMPILE_CACHE_SET
-    if _COMPILE_CACHE_SET:
-        return
-    import os
-
-    from fugue_tpu.constants import FUGUE_CONF_JAX_COMPILE_CACHE
-
-    path = conf.get(FUGUE_CONF_JAX_COMPILE_CACHE, "") or os.environ.get(
-        "FUGUE_JAX_COMPILE_CACHE", ""
-    )
-    if not path:
-        return
-    try:
-        os.makedirs(path, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", str(path))
-        # cache every executable regardless of its compile time
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-        _COMPILE_CACHE_SET = True
-        log.info("fugue_tpu: persistent compilation cache at %s", path)
-    except Exception as e:  # pragma: no cover - best effort
-        log.warning("fugue_tpu: compilation cache setup failed: %s", e)
 
 
 def blocks_with_columns(

@@ -607,14 +607,23 @@ def dicts_of(blocks: JaxBlocks) -> Dict[str, np.ndarray]:
 def dict_fingerprint(blocks: JaxBlocks) -> Tuple[Any, ...]:
     """A stable key component for jit caches of programs that bake
     string-dictionary lookup tables in as constants: same expression +
-    same fingerprint => identical program."""
+    same fingerprint => identical program. Hashed with a DETERMINISTIC
+    digest (not builtin ``hash``, which is salted per process) so the
+    persistent executable cache recognizes the same dictionary across
+    process restarts."""
+    import hashlib
+
     out = []
     for name in sorted(blocks.columns):
         col = blocks.columns[name]
         if col.on_device and col.is_string:
             fp = getattr(col, "_dict_fp", None)
             if fp is None:
-                fp = hash("\x00".join(str(x) for x in col.dictionary))
+                digest = hashlib.blake2b(
+                    "\x00".join(str(x) for x in col.dictionary).encode(),
+                    digest_size=8,
+                ).digest()
+                fp = int.from_bytes(digest, "big")
                 col._dict_fp = fp  # type: ignore[attr-defined]
             out.append((name, len(col.dictionary), fp))
     return tuple(out)

@@ -209,6 +209,7 @@ def try_stream_load(
                 batch_rows,
                 sel,
                 row_groups,
+                first_batch_hook=_first_batch_hook(engine),
             )
             gate.after(loaded)
             return loaded
@@ -261,6 +262,29 @@ def try_stream_load(
     return plan(list(columns) if columns is not None else None)
 
 
+def _first_batch_hook(engine: Any) -> Optional[Callable[[], None]]:
+    """Pipelined first-batch dispatch (``fugue.jax.io.pipeline``): the
+    moment the FIRST record batches are decoded, kick a background warm
+    of the persistent-executable cache for this engine's plan signature
+    — deserializing the consumer's compiled program overlaps the decode
+    and staging of the remaining batches, so the first dispatch after
+    assembly is execute-only instead of compile/load-bound. A no-op
+    when no cache dir is configured or the warm already ran."""
+    from fugue_tpu.constants import (
+        FUGUE_CONF_JAX_IO_PIPELINE,
+        typed_conf_get,
+    )
+
+    try:
+        if not typed_conf_get(engine.conf, FUGUE_CONF_JAX_IO_PIPELINE):
+            return None
+        if not getattr(engine, "_exec_enabled", False):
+            return None
+    except Exception:  # pragma: no cover - conf-less engine stub
+        return None
+    return lambda: engine.warm_executables(background=True)
+
+
 class _ShardStager:
     """Per-column staging buffer that ships each mesh shard to its device
     the moment decode fills it (device_put is async — the transfer
@@ -298,6 +322,7 @@ def _stream_to_blocks(
     batch_rows: int,
     columns: Any,
     row_groups: Optional[Dict[str, List[int]]] = None,
+    first_batch_hook: Optional[Callable[[], None]] = None,
 ) -> B.JaxBlocks:
     B.ensure_x64()
     ndev = int(mesh.devices.size)
@@ -360,6 +385,14 @@ def _stream_to_blocks(
                     st.fill_to(end)
                 for st in mask_stagers.values():
                     st.fill_to(end)
+                if first_batch_hook is not None:
+                    # leading batches are decoded/staged: overlap the
+                    # executable warm with the remaining stream
+                    hook, first_batch_hook = first_batch_hook, None
+                    try:
+                        hook()
+                    except Exception:  # pragma: no cover - warm is
+                        pass  # best-effort, never an ingest error
 
     out_cols: Dict[str, B.JaxColumn] = {}
     for f in schema.fields:
@@ -400,6 +433,120 @@ def _stream_to_blocks(
             tp, data, mask, stats=stats.get(f.name), unique=unique
         )
     return B.JaxBlocks(nrows, out_cols, mesh)
+
+
+def _chunk_view(blocks: B.JaxBlocks, lo: int, hi: int) -> B.JaxBlocks:
+    """A zero-copy row-range view of prefix-layout blocks: device
+    columns slice lazily on device (the fetch worker materializes them),
+    host columns slice their arrow storage. Decode semantics are then
+    EXACTLY ``blocks.to_arrow`` on the view — the pipelined save cannot
+    diverge from the one-shot conversion."""
+    cols: Dict[str, B.JaxColumn] = {}
+    for name, col in blocks.columns.items():
+        if col.on_device:
+            cols[name] = B.JaxColumn(
+                col.pa_type,
+                col.data[lo:hi],
+                None if col.mask is None else col.mask[lo:hi],
+                col.dictionary,
+                stats=col.stats,
+            )
+        else:
+            cols[name] = B.JaxColumn(col.pa_type, col.data.slice(lo, hi - lo))
+    return B.JaxBlocks(hi - lo, cols, blocks.mesh)
+
+
+def try_pipelined_save(
+    engine: Any,
+    jdf: Any,
+    path: str,
+    format_hint: Optional[str],
+    mode: str,
+    partition_cols: Any,
+    batch_rows: int,
+    kwargs: Dict[str, Any],
+) -> bool:
+    """Overlap row-group writes with the tail of compute: the result
+    frame is fetched to host CHUNK BY CHUNK on a prefetch worker (device
+    slice + transfer of chunk k+1 runs while chunk k parquet-encodes and
+    writes), so the save's host encode no longer waits for the full
+    device readback. Returns False when the target/frame needs one of
+    the general paths (non-parquet, dir targets, append concat, masked
+    layout, pending/lazy frames) — the caller then uses the eager save.
+    Row content and order are identical by construction (parity-tested):
+    each chunk decodes through the same ``blocks.to_arrow``."""
+    from fugue_tpu.constants import (
+        FUGUE_CONF_JAX_IO_PIPELINE,
+        typed_conf_get,
+    )
+    from fugue_tpu.utils.io import infer_format
+
+    if batch_rows <= 0 or partition_cols:
+        return False
+    try:
+        if not typed_conf_get(engine.conf, FUGUE_CONF_JAX_IO_PIPELINE):
+            return False
+    except Exception:  # pragma: no cover - conf-less engine stub
+        return False
+    try:
+        if infer_format(path, format_hint or None) != "parquet":
+            return False
+    except NotImplementedError:
+        return False
+    if mode not in ("overwrite", "error"):
+        return False  # append reads + concats the old artifact: host path
+    if jdf._blocks is None:
+        return False  # pending/lazy frame: no device tail to overlap
+    blocks = jdf._blocks
+    if blocks.row_valid is not None or not blocks.nrows_known:
+        return False  # masked layout compacts in to_arrow: one-shot path
+    nrows = blocks.nrows
+    if nrows <= 0:
+        return False
+    fs = engine.fs
+    if fs.exists(path):
+        if mode == "error":
+            raise FileExistsError(path)
+        if fs.isdir(path):
+            return False  # dir targets need the pre-delete semantics
+    schema = jdf.schema
+    # same contract as utils/io.save_df: batch_rows is OUR streaming
+    # knob, never a pyarrow writer kwarg (here the chunking already
+    # bounds row groups at batch_rows)
+    kwargs = {k: v for k, v in kwargs.items() if k != "batch_rows"}
+    spans = [
+        (lo, min(lo + batch_rows, nrows))
+        for lo in range(0, nrows, batch_rows)
+    ]
+    from concurrent.futures import ThreadPoolExecutor
+
+    def fetch(span: Tuple[int, int]) -> pa.Table:
+        lo, hi = span
+        return B.to_arrow(_chunk_view(blocks, lo, hi), schema)
+
+    with ThreadPoolExecutor(
+        1, thread_name_prefix="fugue-save-fetch"
+    ) as pool:
+
+        def write_all(fp: Any) -> None:
+            writer = None
+            try:
+                fut = pool.submit(fetch, spans[0])
+                for i in range(len(spans)):
+                    table = fut.result()
+                    if i + 1 < len(spans):
+                        fut = pool.submit(fetch, spans[i + 1])
+                    if writer is None:
+                        writer = pq.ParquetWriter(
+                            fp, table.schema, **kwargs
+                        )
+                    writer.write_table(table)
+            finally:
+                if writer is not None:
+                    writer.close()
+
+        fs.write_file_atomic(path, write_all)
+    return True
 
 
 def _assemble(stager: _ShardStager, shape: Tuple[int, ...], sharding: Any) -> Any:

@@ -15,9 +15,19 @@ sessions.
   instances, plus result entries (deterministically-checkpointed task
   artifacts, serving-daemon query payloads) with LRU eviction bounded
   by entry count and bytes.
+- :mod:`fugue_tpu.optimize.exec_cache` — the plan cache's DISK tier
+  (ISSUE 11): AOT-serialized compiled executables persisted through
+  ``engine.fs`` under ``fugue.optimize.cache.dir``, keyed by the plan
+  signature + program key + fn source hash + argument avals, so a
+  FRESH PROCESS skips XLA compilation entirely.
 """
 
 from fugue_tpu.optimize.cache import PlanCache, get_plan_cache
+from fugue_tpu.optimize.exec_cache import (
+    ExecutableDiskCache,
+    flush_persists,
+    resolve_cache_dir,
+)
 from fugue_tpu.optimize.rewrite import (
     OptimizedPlan,
     RewriteNote,
@@ -26,10 +36,13 @@ from fugue_tpu.optimize.rewrite import (
 )
 
 __all__ = [
+    "ExecutableDiskCache",
     "OptimizedPlan",
     "PlanCache",
     "RewriteNote",
+    "flush_persists",
     "get_plan_cache",
     "optimize_enabled",
     "optimize_tasks",
+    "resolve_cache_dir",
 ]

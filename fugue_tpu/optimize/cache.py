@@ -21,6 +21,15 @@ a fraction of the device-memory budget so cached device frames can never
 crowd out live working sets. Hit/miss counters surface on the PR 8
 metrics registry (``fugue_engine_plan_cache_total``,
 ``fugue_serve_result_cache_total``) and in ``/v1/status``.
+
+Since ISSUE 11 the cache also fronts a DISK tier
+(:mod:`fugue_tpu.optimize.exec_cache`): per-shape AOT-compiled
+executables loaded from ``fugue.optimize.cache.dir`` live in
+:meth:`PlanCache.get_executable`/``put_executable`` (LRU under the same
+program bound), ``mark_compiled`` records shapes the jit path owns so
+the disk is probed at most once per shape, and ``claim_warm`` makes the
+per-plan-signature bulk warm (daemon pre-warm, streamed-ingest
+first-batch warm) run once per process.
 """
 
 import threading
@@ -50,6 +59,16 @@ class PlanCache:
         self._max_entries = max_entries
         self._max_result_bytes = max_result_bytes
         self._programs: "OrderedDict[Any, Any]" = OrderedDict()
+        # (global program key, aval token) -> AOT-compiled executable
+        # loaded from the DISK tier (exec_cache.py); dispatches for these
+        # shapes run the deserialized executable and never touch XLA
+        self._executables: "OrderedDict[Any, Any]" = OrderedDict()
+        # shapes this process compiled via the jit path (LRU-bounded):
+        # no point probing the disk again — the jit handle owns them
+        self._compiled_shapes: "OrderedDict[Any, None]" = OrderedDict()
+        # plan signatures a full disk warm already ran for (daemon
+        # pre-warm / streamed-ingest first-batch warm fire once each)
+        self._warmed_sigs: set = set()
         # key -> (value, nbytes, tag)
         self._results: "OrderedDict[Any, Any]" = OrderedDict()
         self._result_bytes = 0
@@ -98,6 +117,48 @@ class PlanCache:
             while len(self._programs) > max(1, self._max_programs):
                 self._programs.popitem(last=False)
                 self.evictions += 1
+
+    # ---- AOT executables (disk-tier shapes) ------------------------------
+    def get_executable(self, key: Any) -> Optional[Any]:
+        with self._lock:
+            c = self._executables.get(key)
+            if c is not None:
+                self._executables.move_to_end(key)
+            return c
+
+    def put_executable(self, key: Any, compiled: Any) -> None:
+        with self._lock:
+            self._executables[key] = compiled
+            self._executables.move_to_end(key)
+            while len(self._executables) > max(1, self._max_programs):
+                self._executables.popitem(last=False)
+                self.evictions += 1
+
+    def drop_executable(self, key: Any) -> None:
+        with self._lock:
+            self._executables.pop(key, None)
+
+    def mark_compiled(self, key: Any) -> None:
+        """This process jit-compiled the shape: later dispatches skip
+        the disk probe (the jit handle's own cache serves them)."""
+        with self._lock:
+            self._compiled_shapes[key] = None
+            self._compiled_shapes.move_to_end(key)
+            while len(self._compiled_shapes) > max(4, 4 * self._max_programs):
+                self._compiled_shapes.popitem(last=False)
+
+    def was_compiled(self, key: Any) -> bool:
+        with self._lock:
+            return key in self._compiled_shapes
+
+    def claim_warm(self, claim_key: Any) -> bool:
+        """True exactly once per (cache dir, plan signature) — the
+        caller owning the claim runs the full disk warm for it."""
+        with self._lock:
+            if claim_key in self._warmed_sigs:
+                return False
+            self._warmed_sigs.add(claim_key)
+            return True
 
     # ---- result entries --------------------------------------------------
     def get_result(self, key: Any) -> Optional[Any]:
@@ -161,6 +222,9 @@ class PlanCache:
     def clear(self) -> None:
         with self._lock:
             self._programs.clear()
+            self._executables.clear()
+            self._compiled_shapes.clear()
+            self._warmed_sigs.clear()
             self._results.clear()
             self._result_bytes = 0
 
@@ -168,6 +232,7 @@ class PlanCache:
         with self._lock:
             return {
                 "programs": len(self._programs),
+                "executables": len(self._executables),
                 "program_hits": self.program_hits,
                 "program_misses": self.program_misses,
                 "results": len(self._results),
@@ -192,6 +257,7 @@ def engine_plan_signature(engine: Any) -> str:
     may be shared process-wide: platform + mesh device ids + every
     ``fugue.jax.*`` conf value (kernel-selection conf changes programs,
     so differing conf must never share a slot)."""
+    from fugue_tpu.constants import FUGUE_CONF_JAX_COMPILE_CACHE
     from fugue_tpu.utils.hash import to_uuid
 
     try:
@@ -203,7 +269,12 @@ def engine_plan_signature(engine: Any) -> str:
     conf_items = sorted(
         (k, str(v))
         for k, v in dict(engine.conf).items()
-        if isinstance(k, str) and k.startswith("fugue.jax.")
+        if isinstance(k, str)
+        and k.startswith("fugue.jax.")
+        # the deprecated disk-cache ALIAS names where executables are
+        # stored, not what they compute: folding it would split one
+        # shared cache into disjoint per-spelling namespaces
+        and k != FUGUE_CONF_JAX_COMPILE_CACHE
     )
     return to_uuid(type(engine).__name__, devices, conf_items)
 

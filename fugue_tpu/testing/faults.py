@@ -83,6 +83,7 @@ KNOWN_SITES = (
     "serve.dispatch",
     "serve.http",
     "obs.trace",
+    "cache.persist",
 )
 
 

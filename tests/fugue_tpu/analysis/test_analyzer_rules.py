@@ -374,6 +374,43 @@ def test_fwf404_trace_path_without_obs_enabled():
     assert not any(x.code == "FWF404" for x in _analyze(dag))
 
 
+def test_fwf502_serve_target_without_executable_cache(monkeypatch):
+    # a serve-targeted conf (durable state path) without a persistent
+    # executable cache dir: every daemon restart re-pays full XLA
+    # compilation before the first query — the cold-start hazard.
+    # The legacy env alias would silence the rule: isolate it
+    monkeypatch.delenv("FUGUE_JAX_COMPILE_CACHE", raising=False)
+    dag = FugueWorkflow()
+    dag.df([[0]], "a:int").persist()
+    diags = _analyze(dag, conf={"fugue.serve.state_path": "/tmp/serve"})
+    d = _assert_diag(diags, "FWF502", Severity.WARN, needs_callsite=False)
+    assert "fugue.optimize.cache.dir" in d.message
+    # the new key silences it
+    assert not any(
+        x.code == "FWF502"
+        for x in _analyze(
+            dag,
+            conf={
+                "fugue.serve.state_path": "/tmp/serve",
+                "fugue.optimize.cache.dir": "/tmp/xcache",
+            },
+        )
+    )
+    # the DEPRECATED alias counts too (it feeds the same disk tier)
+    assert not any(
+        x.code == "FWF502"
+        for x in _analyze(
+            dag,
+            conf={
+                "fugue.serve.state_path": "/tmp/serve",
+                "fugue.jax.compile.cache": "/tmp/xcache",
+            },
+        )
+    )
+    # no state path -> not serve-targeted: silent
+    assert not any(x.code == "FWF502" for x in _analyze(dag))
+
+
 def test_analyze_with_live_engine_reads_engine_conf():
     # engine-dependent rules must read the LIVE engine's conf, not the
     # global defaults: an engine built with a row bucket has already
@@ -453,7 +490,7 @@ def test_every_rule_has_corpus_coverage():
     covered = {
         "FWF101", "FWF102", "FWF103", "FWF104", "FWF105", "FWF106",
         "FWF201", "FWF202", "FWF301", "FWF302", "FWF303", "FWF401",
-        "FWF402", "FWF403", "FWF404", "FWF501",
+        "FWF402", "FWF403", "FWF404", "FWF501", "FWF502",
     }
     assert {r.code for r in all_rules()} == covered
 

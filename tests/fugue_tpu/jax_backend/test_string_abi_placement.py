@@ -249,11 +249,36 @@ def test_groupby_matmul_conf_paths_agree():
     assert e._count_reduce_strategy(blocks, 16) == "scatter"
 
 
-def test_compile_cache_conf():
-    from fugue_tpu.constants import FUGUE_CONF_JAX_COMPILE_CACHE
-    from fugue_tpu.jax_backend import execution_engine as ee
+def test_compile_cache_conf(monkeypatch):
+    # the legacy key is a deprecation-logged ALIAS of the new disk tier
+    # (fugue.optimize.cache.dir): it enables the SAME persistent
+    # executable cache, and the new key wins when both are set — two
+    # divergent caches never run side by side
+    monkeypatch.delenv("FUGUE_JAX_COMPILE_CACHE", raising=False)
+    from fugue_tpu.constants import (
+        FUGUE_CONF_JAX_COMPILE_CACHE,
+        FUGUE_CONF_OPTIMIZE_CACHE_DIR,
+    )
 
     path = tempfile.mkdtemp(prefix="fugue_jax_cache_")
-    JaxExecutionEngine({FUGUE_CONF_JAX_COMPILE_CACHE: path})
-    assert ee._COMPILE_CACHE_SET
-    assert jax.config.jax_compilation_cache_dir == path
+    e = JaxExecutionEngine({FUGUE_CONF_JAX_COMPILE_CACHE: path})
+    assert e._exec_enabled
+    assert e.exec_cache_stats["dir"] == path
+    # precedence: the new key overrides the alias
+    new_path = tempfile.mkdtemp(prefix="fugue_jax_cache_new_")
+    e2 = JaxExecutionEngine(
+        {
+            FUGUE_CONF_JAX_COMPILE_CACHE: path,
+            FUGUE_CONF_OPTIMIZE_CACHE_DIR: new_path,
+        }
+    )
+    assert e2.exec_cache_stats["dir"] == new_path
+    # neither key -> disk tier off
+    e3 = JaxExecutionEngine()
+    assert not e3._exec_enabled
+    # the alias names WHERE executables are stored, not what they
+    # compute: it must not split the plan signature (replicas spelling
+    # the cache dir differently still share one namespace)
+    from fugue_tpu.optimize.cache import engine_plan_signature
+
+    assert engine_plan_signature(e) == engine_plan_signature(e3)

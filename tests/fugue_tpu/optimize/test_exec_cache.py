@@ -1,0 +1,309 @@
+"""Disk tier of the plan cache (ISSUE 11): persistent AOT-serialized
+executables — fresh-process reuse with zero XLA compiles, version-stamp
+and corrupt-entry eviction, persist fault tolerance, and key-encoding
+eligibility."""
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+from fugue_tpu.column.expressions import col
+from fugue_tpu.execution import make_execution_engine
+from fugue_tpu.optimize import flush_persists, get_plan_cache
+from fugue_tpu.optimize.exec_cache import (
+    FORMAT_REV,
+    _MAGIC,
+    args_signature,
+    canonical_key_token,
+    resolve_cache_dir,
+)
+from fugue_tpu.workflow.workflow import FugueWorkflow
+
+pytestmark = pytest.mark.optimize
+
+
+@pytest.fixture(autouse=True)
+def _isolate_plan_cache():
+    """The plan cache is process-wide BY DESIGN (in-memory executables
+    survive engine churn); tests of the disk tier need each scenario to
+    start cold or nothing ever touches the disk twice."""
+    get_plan_cache().clear()
+    yield
+    get_plan_cache().clear()
+
+
+def _run_pipeline(engine):
+    dag = FugueWorkflow()
+    df = dag.df(
+        [[i, float(i), "ab"[i % 2]] for i in range(64)],
+        "a:int,b:double,s:str",
+    )
+    df.filter(col("a") > 5).yield_dataframe_as("o", as_local=True)
+    return dag.run(engine)["o"].as_array()
+
+
+def _fresh_engine(cache_dir, extra=None):
+    conf = {"fugue.optimize.cache.dir": cache_dir}
+    conf.update(extra or {})
+    return make_execution_engine("jax", conf)
+
+
+# ---- key encoding -----------------------------------------------------------
+def test_canonical_key_token_stable_primitives():
+    k = ("filter", "uuid-1", 64, (("s", 3, 123456),))
+    assert canonical_key_token(k) == canonical_key_token(
+        ("filter", "uuid-1", 64, (("s", 3, 123456),))
+    )
+    assert canonical_key_token(np.dtype("int64")) == "dt:<i8"
+    # frozensets are order-independent
+    assert canonical_key_token(frozenset({1, 2})) == canonical_key_token(
+        frozenset({2, 1})
+    )
+    # anything unstable (objects, lambdas) disqualifies the whole key
+    assert canonical_key_token(("x", object())) is None
+    assert canonical_key_token({"not": "hashable-scheme"}) is None
+
+
+def test_args_signature_models_supported_leaves_only():
+    import jax.numpy as jnp
+
+    sig = args_signature(({"a": jnp.arange(4)}, None, np.int32(4)))
+    assert sig is not None
+    # tree structure (incl. the None) is folded into the token
+    sig2 = args_signature(({"a": jnp.arange(4)}, jnp.ones(4, bool), np.int32(4)))
+    assert sig2 is not None and sig2.token != sig.token
+    # a host object leaf disqualifies the dispatch for the disk tier
+    assert args_signature((object(),)) is None
+
+
+def test_resolve_cache_dir_precedence(caplog, monkeypatch):
+    import logging
+
+    monkeypatch.delenv("FUGUE_JAX_COMPILE_CACHE", raising=False)
+    new = {"fugue.optimize.cache.dir": "/tmp/new", "fugue.jax.compile.cache": "/tmp/old"}
+    assert resolve_cache_dir(new) == "/tmp/new"
+    import fugue_tpu.optimize.exec_cache as xc
+
+    xc._DEPRECATION_LOGGED = False
+    with caplog.at_level(logging.WARNING, logger="fugue_tpu.optimize.exec_cache"):
+        assert resolve_cache_dir({"fugue.jax.compile.cache": "/tmp/old"}) == "/tmp/old"
+    assert any("deprecated" in r.message for r in caplog.records)
+    assert resolve_cache_dir({}) == ""
+
+
+# ---- fresh-process reuse (in-process simulation) ----------------------------
+def test_cleared_plan_cache_reloads_executables_from_disk():
+    """Clearing the process-wide plan cache simulates a fresh process:
+    the second engine must answer from the DISK tier with zero XLA
+    compiles and identical results."""
+    with tempfile.TemporaryDirectory(prefix="fgxc_") as d:
+        e1 = _fresh_engine(d)
+        r1 = _run_pipeline(e1)
+        flush_persists()
+        assert e1.exec_cache_stats["persisted"] >= 1
+        assert e1.exec_cache_stats["persist_failures"] == 0
+        files = [f for f in os.listdir(d) if f.endswith(".jxc")]
+        assert len(files) >= 1
+
+        get_plan_cache().clear()
+        e2 = _fresh_engine(d)
+        r2 = _run_pipeline(e2)
+        assert r2 == r1
+        st = e2.exec_cache_stats
+        assert st["hits"] >= 1 and st["corrupt"] == 0
+        # counter-verified: no _jit_cached program paid an XLA compile
+        assert e2.compile_cache_stats["misses"] == 0
+        assert e2.dispatch_time_stats["disk_load"] > 0
+
+
+def test_warm_executables_bulk_load():
+    with tempfile.TemporaryDirectory(prefix="fgxc_warm_") as d:
+        e1 = _fresh_engine(d)
+        _run_pipeline(e1)
+        flush_persists()
+        get_plan_cache().clear()
+        e2 = _fresh_engine(d)
+        n = e2.warm_executables()
+        assert n >= 1
+        assert e2.exec_cache_stats["hits"] == n
+        # the claim is once-per-signature: a second warm is a no-op
+        assert e2.warm_executables() == 0
+        r = _run_pipeline(e2)
+        assert e2.compile_cache_stats["misses"] == 0
+        assert len(r) > 0
+
+
+def test_warm_loaded_entry_of_changed_source_is_never_hit(monkeypatch):
+    """Entries persisted by OLD program source must not serve a process
+    running new source: the exec key folds the fn hash on both the warm
+    and dispatch paths, so warm-scanned stale entries load inert and
+    the engine recompiles (simulated by patching fn_source_hash, the
+    in-test stand-in for an upgraded program body)."""
+    import fugue_tpu.optimize.exec_cache as xc
+
+    with tempfile.TemporaryDirectory(prefix="fgxc_stale_") as d:
+        e1 = _fresh_engine(d)
+        r1 = _run_pipeline(e1)
+        flush_persists()
+        assert e1.exec_cache_stats["persisted"] >= 1
+
+        get_plan_cache().clear()
+        monkeypatch.setattr(
+            xc, "fn_source_hash", lambda fn: "upgraded-source"
+        )
+        e2 = _fresh_engine(d)
+        # the warm scan still loads the old entries (their files are
+        # version-valid) — but under their RECORDED fn hash, which no
+        # live dispatch key can match
+        assert e2.warm_executables() >= 1
+        r2 = _run_pipeline(e2)
+        assert r2 == r1
+        # the stale warm entries were never dispatched: the engine paid
+        # its own compiles instead of running old code
+        assert e2.compile_cache_stats["misses"] >= 1
+
+
+# ---- invalidation -----------------------------------------------------------
+def _entry_paths(d):
+    return [os.path.join(d, f) for f in os.listdir(d) if f.endswith(".jxc")]
+
+
+def test_version_mismatch_evicts_to_recompile():
+    with tempfile.TemporaryDirectory(prefix="fgxc_ver_") as d:
+        e1 = _fresh_engine(d)
+        r1 = _run_pipeline(e1)
+        flush_persists()
+        # rewrite every entry's header as if an older jax had written it
+        for p in _entry_paths(d):
+            with open(p, "rb") as fp:
+                blob = fp.read()
+            entry = pickle.loads(blob[len(_MAGIC):])
+            entry["meta"]["jax"] = "0.0.1"
+            with open(p, "wb") as fp:
+                fp.write(_MAGIC + pickle.dumps(entry))
+        n_before = len(_entry_paths(d))
+        get_plan_cache().clear()
+        e2 = _fresh_engine(d)
+        r2 = _run_pipeline(e2)
+        assert r2 == r1  # recompiled, not broken
+        st = e2.exec_cache_stats
+        assert st["evictions"] >= 1 and st["hits"] == 0
+        # evicted files are REMOVED so the fresh persist replaces them
+        flush_persists()
+        assert len(_entry_paths(d)) <= n_before
+
+
+def test_truncated_entry_counts_corrupt_and_recompiles():
+    with tempfile.TemporaryDirectory(prefix="fgxc_trunc_") as d:
+        e1 = _fresh_engine(d)
+        r1 = _run_pipeline(e1)
+        flush_persists()
+        for p in _entry_paths(d):
+            with open(p, "rb") as fp:
+                blob = fp.read()
+            with open(p, "wb") as fp:
+                fp.write(blob[: max(8, len(blob) // 3)])  # torn write
+        get_plan_cache().clear()
+        e2 = _fresh_engine(d)
+        r2 = _run_pipeline(e2)
+        assert r2 == r1
+        st = e2.exec_cache_stats
+        assert st["corrupt"] >= 1 and st["hits"] == 0
+
+
+def test_format_rev_is_stamped():
+    with tempfile.TemporaryDirectory(prefix="fgxc_rev_") as d:
+        e1 = _fresh_engine(d)
+        _run_pipeline(e1)
+        flush_persists()
+        paths = _entry_paths(d)
+        assert paths
+        with open(paths[0], "rb") as fp:
+            blob = fp.read()
+        assert blob.startswith(_MAGIC)
+        meta = pickle.loads(blob[len(_MAGIC):])["meta"]
+        import jax
+        import jaxlib
+
+        assert meta["rev"] == FORMAT_REV
+        assert meta["jax"] == jax.__version__
+        assert meta["jaxlib"] == jaxlib.__version__
+
+
+# ---- persist fault tolerance ------------------------------------------------
+@pytest.mark.faults
+def test_persist_failure_is_counted_never_fatal():
+    from fugue_tpu.testing.faults import FaultPlan, FaultSpec, inject_faults
+
+    with tempfile.TemporaryDirectory(prefix="fgxc_fault_") as d:
+        e = _fresh_engine(d)
+        plan = FaultPlan(
+            FaultSpec(
+                "cache.persist", "*", times=100,
+                error=lambda: OSError("injected disk-full"),
+            )
+        )
+        with inject_faults(plan):
+            r = _run_pipeline(e)  # the run itself must be unaffected
+            flush_persists()
+        assert len(r) > 0
+        assert plan.total("injected") >= 1
+        st = e.exec_cache_stats
+        assert st["persist_failures"] >= 1 and st["persisted"] == 0
+        assert _entry_paths(d) == []
+
+
+# ---- the real thing: two OS processes ---------------------------------------
+_SUBPROC_SCRIPT = r"""
+import json, sys
+from fugue_tpu.column.expressions import col
+from fugue_tpu.execution import make_execution_engine
+from fugue_tpu.optimize import flush_persists
+from fugue_tpu.workflow.workflow import FugueWorkflow
+
+cache_dir = sys.argv[1]
+engine = make_execution_engine("jax", {"fugue.optimize.cache.dir": cache_dir})
+dag = FugueWorkflow()
+df = dag.df([[i, float(i), "ab"[i % 2]] for i in range(64)], "a:int,b:double,s:str")
+df.filter(col("a") > 5).yield_dataframe_as("o", as_local=True)
+rows = dag.run(engine)["o"].as_array()
+flush_persists()
+print(json.dumps({
+    "rows": rows,
+    "compile": engine.compile_cache_stats,
+    "exec": engine.exec_cache_stats,
+}))
+"""
+
+
+def test_cross_process_reuse_zero_xla_compiles(tmp_path):
+    """The acceptance shape: the SAME pipeline in two fresh OS
+    processes sharing one cache dir — the second performs 0 XLA
+    compiles (counter-verified) and returns identical rows."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+    )
+    cache_dir = str(tmp_path / "xc")
+
+    def run_once():
+        out = subprocess.run(
+            [sys.executable, "-c", _SUBPROC_SCRIPT, cache_dir],
+            capture_output=True, text=True, timeout=300, env=env,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    first = run_once()
+    assert first["exec"]["persisted"] >= 1
+    second = run_once()
+    assert second["rows"] == first["rows"]
+    assert second["compile"]["misses"] == 0, second
+    assert second["exec"]["hits"] >= 1

@@ -1,0 +1,168 @@
+"""Daemon cold-start pre-warm (ISSUE 11): /v1/health ready-gating while
+cached executables load, and the compile-free first query after a
+journaled restart with a persistent executable cache."""
+
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fugue_tpu.optimize import flush_persists, get_plan_cache
+
+pytestmark = pytest.mark.serve
+
+_AGG = "SELECT k, SUM(v) AS s FROM t GROUP BY k"
+
+
+@pytest.fixture(autouse=True)
+def _isolate_plan_cache():
+    get_plan_cache().clear()
+    yield
+    get_plan_cache().clear()
+
+
+def _pdf(rows=4000):
+    rng = np.random.default_rng(7)
+    return pd.DataFrame(
+        {
+            "k": rng.integers(0, 32, rows).astype(np.int64),
+            "v": rng.random(rows),
+        }
+    )
+
+
+def test_health_reports_warming_until_prewarm_done(tmp_path, monkeypatch):
+    from fugue_tpu.serve import ServeClient, ServeDaemon
+
+    release = threading.Event()
+    started = threading.Event()
+    real = ServeDaemon._prewarm
+
+    def gated(self, work):
+        started.set()
+        assert release.wait(timeout=30)
+        return real(self, work)
+
+    monkeypatch.setattr(ServeDaemon, "_prewarm", gated)
+    conf = {
+        "fugue.serve.state_path": str(tmp_path / "state"),
+        "fugue.optimize.cache.dir": str(tmp_path / "xc"),
+    }
+    daemon = ServeDaemon(conf).start()
+    try:
+        assert started.wait(timeout=30)
+        host, port = daemon.address
+        c = ServeClient(host, port, timeout=60, retries=0)
+        # not ready while the warm runs — an LB keeps routing elsewhere
+        assert not daemon.ready
+        import urllib.error
+        import urllib.request
+
+        try:
+            urllib.request.urlopen(f"http://{host}:{port}/v1/health")
+            raise AssertionError("expected 503 while warming")
+        except urllib.error.HTTPError as ex:
+            assert ex.code == 503
+            import json
+
+            assert json.loads(ex.read())["state"] == "warming"
+        # submissions are still ACCEPTED during the warm (gating is
+        # about LB routing, not availability)
+        sid = c.create_session()
+        assert sid
+        release.set()
+        deadline = time.monotonic() + 30
+        while not daemon.ready:
+            assert time.monotonic() < deadline, "warm never finished"
+            time.sleep(0.02)
+        assert c.health() is True
+        st = daemon.status()
+        assert "cache_load_secs" in st["cold_start"]["phases"]
+    finally:
+        release.set()
+        daemon.stop()
+
+
+def test_restart_prewarm_makes_first_query_compile_free(tmp_path):
+    from fugue_tpu.serve import ServeClient, ServeDaemon
+
+    conf = {
+        "fugue.serve.state_path": str(tmp_path / "state"),
+        "fugue.optimize.cache.dir": str(tmp_path / "xc"),
+        "fugue.serve.max_concurrent": 2,
+    }
+    pdf = _pdf()
+    d1 = ServeDaemon(conf).start()
+    host, port = d1.address
+    c1 = ServeClient(host, port, timeout=600)
+    sid = c1.create_session()
+    d1.sessions.get(sid).save_table("t", d1.engine.to_df(pdf))
+    r1 = c1.sql(sid, _AGG)
+    assert r1["status"] == "done"
+    flush_persists()  # entries must be durable before the "kill"
+    assert d1.engine.exec_cache_stats["persisted"] >= 1
+    d1._hard_kill()
+
+    get_plan_cache().clear()  # fresh-process simulation
+    d2 = ServeDaemon(conf).start()
+    try:
+        deadline = time.monotonic() + 60
+        while not d2.ready:
+            assert time.monotonic() < deadline, "prewarm never finished"
+            time.sleep(0.02)
+        st = d2.status()
+        phases = st["cold_start"]["phases"]
+        assert phases.get("prewarmed_executables", 0) >= 1
+        assert "journal_reload_secs" in phases
+        # the daemon claimed the warm SYNCHRONOUSLY at start: no later
+        # trigger (e.g. a streamed ingest's first-batch hook) can own it
+        assert d2.engine.warm_executables() == 0
+        c2 = ServeClient(host, d2.address[1], timeout=600)
+        r2 = c2.sql(sid, _AGG)
+        assert r2["status"] == "done"
+        assert sorted(map(tuple, r2["result"]["rows"])) == sorted(
+            map(tuple, r1["result"]["rows"])
+        )
+        fq = d2.status()["cold_start"]["first_query"]
+        # the acceptance shape: restart pre-warm makes time_to_first_query
+        # compile-free — the split pins the cost on IO/dispatch, not XLA
+        assert fq["xla_compiles"] == 0
+        assert fq["compile_secs"] == 0.0
+        assert fq["total_secs"] > 0
+    finally:
+        d2.stop()
+
+
+def test_prewarm_disabled_or_cacheless_is_ready_immediately(
+    tmp_path, monkeypatch
+):
+    from fugue_tpu.serve import ServeDaemon
+
+    # the legacy env alias would enable a cache dir: isolate it
+    monkeypatch.delenv("FUGUE_JAX_COMPILE_CACHE", raising=False)
+    # no executable cache dir: nothing to warm, ready at start
+    d = ServeDaemon(
+        {"fugue.serve.state_path": str(tmp_path / "s1")}
+    ).start()
+    try:
+        assert d.ready
+        assert "cache_load_secs" not in d.status().get(
+            "cold_start", {}
+        ).get("phases", {})
+    finally:
+        d.stop()
+    # cache dir but prewarm off: ready immediately, per-key disk loads
+    # still serve dispatches lazily
+    d2 = ServeDaemon(
+        {
+            "fugue.serve.state_path": str(tmp_path / "s2"),
+            "fugue.optimize.cache.dir": str(tmp_path / "xc"),
+            "fugue.serve.prewarm": False,
+        }
+    ).start()
+    try:
+        assert d2.ready
+    finally:
+        d2.stop()
