@@ -1,5 +1,6 @@
 """``python -m fugue_tpu.analysis`` — lint a FugueSQL file or a workflow
-module WITHOUT executing it.
+module WITHOUT executing it, or (``--lint-source``) lint the fugue_tpu
+SOURCE TREE itself with the FLN concurrency/invariant rules.
 
 Targets:
 
@@ -9,8 +10,13 @@ Targets:
 - a workflow module: ``pkg.mod`` or ``pkg.mod:attr`` where the attribute
   (or, unqualified, the first match in the module) is a FugueWorkflow
   instance or a zero-arg callable returning one;
+- ``--lint-source [dir]``: run the ``FLN###`` source linter
+  (:mod:`fugue_tpu.analysis.codelint`) over a package tree (default:
+  the installed fugue_tpu package), applying the justification-required
+  baseline (``--baseline``, default: the packaged baseline.json);
 - ``--self-test``: analyze the built-in representative workflow corpus
-  (pre-merge gate: exits nonzero on any error-level diagnostic).
+  AND source-lint the installed tree — the one-command pre-merge gate
+  covering both planes (exits nonzero on any error-level diagnostic).
 
 Exit codes: 0 clean (or only sub-error findings), 1 error-level
 diagnostics, 2 the target could not be built.
@@ -105,6 +111,35 @@ def _print_diags(title: str, diags: List[Diagnostic], out: Any) -> None:
                 print("  " + line, file=out)
 
 
+def _run_source_lint(
+    root: Optional[str], baseline_path: Optional[str], floor: Severity, out: Any
+) -> int:
+    """Source-lint a tree with the baseline applied; prints findings and
+    returns the number of error-level diagnostics."""
+    from fugue_tpu.analysis.codelint import (
+        apply_baseline,
+        lint_tree,
+        load_baseline,
+        stale_diags,
+    )
+
+    entries, problems = load_baseline(baseline_path)
+    diags = lint_tree(root)
+    kept, suppressed, stale = apply_baseline(diags, entries)
+    final = problems + kept + stale_diags(stale, baseline_path)
+    for d in final:
+        if d.severity >= floor:
+            print(d.describe(), file=out)
+    errors = sum(1 for d in final if d.severity is Severity.ERROR)
+    print(
+        f"source lint: {errors} error(s), "
+        f"{sum(1 for d in final if d.severity is Severity.WARN)} warning(s), "
+        f"{len(suppressed)} baselined exception(s)",
+        file=out,
+    )
+    return errors
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m fugue_tpu.analysis",
@@ -131,6 +166,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         "any rewrite that breaks it)",
     )
     p.add_argument(
+        "--lint-source",
+        action="store_true",
+        help="run the FLN source linter over a package tree (optional "
+        "target: directory; default: the installed fugue_tpu package)",
+    )
+    p.add_argument(
+        "--baseline",
+        default=None,
+        metavar="PATH",
+        help="with --lint-source: the justification-required baseline "
+        "file (default: the packaged codelint/baseline.json)",
+    )
+    p.add_argument(
         "--conf",
         action="append",
         default=[],
@@ -150,6 +198,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     except ValueError as ex:
         print(str(ex), file=sys.stderr)
         return 2
+
+    if args.lint_source:
+        if args.self_test:
+            print("--lint-source and --self-test are exclusive "
+                  "(--self-test already includes the source lint)",
+                  file=sys.stderr)
+            return 2
+        root = args.target
+        if root is not None and not os.path.isdir(root):
+            print(f"--lint-source target {root!r} is not a directory",
+                  file=sys.stderr)
+            return 2
+        errors = _run_source_lint(root, args.baseline, floor, sys.stdout)
+        return 1 if errors else 0
 
     if args.self_test:
         from fugue_tpu.analysis.selftest import run_self_test, self_test_failed
@@ -185,6 +247,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                 file=sys.stdout,
             )
             failed = failed or opt_failed
+        # both planes, one command: the workflow-corpus gate above plus
+        # the FLN source lint of the installed tree
+        src_errors = _run_source_lint(None, args.baseline, floor, sys.stdout)
+        failed = failed or src_errors > 0
         return 1 if failed else 0
     if args.optimize:
         print("--optimize requires --self-test", file=sys.stderr)

@@ -1,0 +1,71 @@
+"""Source-level concurrency/invariant linter — the second static-
+analysis plane. Where :mod:`fugue_tpu.analysis` lints USER workflow
+DAGs (``FWF###``), this package lints the CODEBASE ITSELF (``FLN###``):
+the concurrency invariants that previously lived only in changelog
+prose, machine-checked on every PR.
+
+Rules (each an error unless baselined with a justification):
+
+- **FLN101** lock-order inversion/cycle over the statically-extracted
+  lock-acquisition graph (canonical hierarchy: ``lockspec.py``)
+- **FLN102** ``threading.Thread`` without a join-on-stop path or
+  ``spawn_warm_thread``-style bounded atexit registration
+- **FLN103** thread-local/ContextVar set without a paired restore
+  (finally / ``__enter__``+``__exit__`` / token reset)
+- **FLN104** blocking IO/sleep/network call while holding a registered
+  lock
+- **FLN105** raw ``open()``/``os.remove`` on engine/serve paths that
+  must route through ``engine.fs``
+- **FLN106** string-literal ``fugue.*`` conf key missing from the
+  ``constants.py`` registry (source-side complement of FWF201)
+- **FLN107** ``fault_point`` site missing from ``KNOWN_SITES`` / metric
+  name outside ``METRIC_NAME_PREFIXES``
+
+Front doors: ``python -m fugue_tpu.analysis --lint-source`` (exit-code
+contract matching the workflow linter), :func:`lint_tree` /
+:func:`lint_text` for embedding, and the tier-1 ``codelint`` test
+module that lints the live tree — the gate enforces itself.
+
+The runtime half of this plane is the opt-in lock-order sanitizer in
+:mod:`fugue_tpu.testing.locktrace`.
+"""
+
+from fugue_tpu.analysis.codelint.baseline import (
+    BaselineEntry,
+    DEFAULT_BASELINE,
+    apply_baseline,
+    load_baseline,
+    stale_diags,
+)
+from fugue_tpu.analysis.codelint.engine import (
+    LintContext,
+    ModuleInfo,
+    lint_text,
+    lint_tree,
+    load_tree,
+    package_root,
+)
+from fugue_tpu.analysis.codelint.model import (
+    SourceDiagnostic,
+    SourceRule,
+    all_source_rules,
+    register_source_rule,
+)
+
+__all__ = [
+    "BaselineEntry",
+    "DEFAULT_BASELINE",
+    "LintContext",
+    "ModuleInfo",
+    "SourceDiagnostic",
+    "SourceRule",
+    "all_source_rules",
+    "apply_baseline",
+    "lint_text",
+    "lint_tree",
+    "load_tree",
+    "load_baseline",
+    "package_root",
+    "register_source_rule",
+    "stale_diags",
+]

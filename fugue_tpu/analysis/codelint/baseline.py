@@ -1,0 +1,128 @@
+"""Justification-required baseline for accepted FLN exceptions.
+
+The gate must be zero-error on the shipped tree, but some findings are
+*intentional* (a fire-and-forget drain thread started from a signal
+handler cannot be joined by design). Those live in ``baseline.json``
+next to this module: every entry names the rule code, the file, the
+enclosing qualname, and a NON-EMPTY one-line justification — an entry
+without a justification is itself an error, and an entry that matches
+nothing is reported stale (warn) so the baseline can only shrink.
+
+Format::
+
+    {"entries": [
+      {"code": "FLN102",
+       "file": "fugue_tpu/workflow/runner.py",
+       "context": "DAGRunner._spawn",
+       "justification": "why this exception is sound"}
+    ]}
+"""
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from fugue_tpu.analysis.codelint.model import SourceDiagnostic
+from fugue_tpu.analysis.diagnostics import Severity
+
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.json"
+)
+
+
+class BaselineEntry:
+    __slots__ = ("code", "file", "context", "justification", "used")
+
+    def __init__(self, code: str, file: str, context: str, justification: str):
+        self.code = code
+        self.file = file
+        self.context = context
+        self.justification = justification
+        self.used = 0
+
+    def matches(self, d: SourceDiagnostic) -> bool:
+        return (
+            d.code == self.code
+            and (d.path == self.file or d.path.endswith("/" + self.file))
+            and (self.context == "" or self.context in (d.qualname or ""))
+        )
+
+
+def load_baseline(
+    path: Optional[str] = None,
+) -> Tuple[List[BaselineEntry], List[SourceDiagnostic]]:
+    """Entries plus any problems with the baseline ITSELF (unreadable
+    file, entry without justification) as error diagnostics."""
+    path = path or DEFAULT_BASELINE
+    problems: List[SourceDiagnostic] = []
+    if not os.path.isfile(path):
+        return [], problems
+    try:
+        with open(path, "r") as fp:
+            payload = json.load(fp)
+    except (OSError, ValueError) as ex:
+        return [], [
+            SourceDiagnostic(
+                "FLN002",
+                Severity.ERROR,
+                f"unreadable baseline: {type(ex).__name__}: {ex}",
+                path=path,
+                rule="baseline",
+            )
+        ]
+    entries: List[BaselineEntry] = []
+    for i, raw in enumerate(payload.get("entries", [])):
+        entry = BaselineEntry(
+            str(raw.get("code", "")),
+            str(raw.get("file", "")),
+            str(raw.get("context", "")),
+            str(raw.get("justification", "")).strip(),
+        )
+        if entry.justification == "":
+            problems.append(
+                SourceDiagnostic(
+                    "FLN002",
+                    Severity.ERROR,
+                    f"baseline entry #{i} ({entry.code} {entry.file}) has "
+                    "no justification: accepted exceptions must say WHY",
+                    path=path,
+                    line=0,
+                    rule="baseline",
+                )
+            )
+            continue
+        entries.append(entry)
+    return entries, problems
+
+
+def apply_baseline(
+    diags: List[SourceDiagnostic], entries: List[BaselineEntry]
+) -> Tuple[List[SourceDiagnostic], List[SourceDiagnostic], List[BaselineEntry]]:
+    """(kept, suppressed, stale_entries): each diagnostic is suppressed
+    by the first matching entry; entries that matched nothing are stale."""
+    kept: List[SourceDiagnostic] = []
+    suppressed: List[SourceDiagnostic] = []
+    for d in diags:
+        hit = next((e for e in entries if e.matches(d)), None)
+        if hit is not None:
+            hit.used += 1
+            suppressed.append(d)
+        else:
+            kept.append(d)
+    stale = [e for e in entries if e.used == 0]
+    return kept, suppressed, stale
+
+
+def stale_diags(stale: List[BaselineEntry], path: Optional[str] = None) -> List[SourceDiagnostic]:
+    return [
+        SourceDiagnostic(
+            "FLN003",
+            Severity.WARN,
+            f"stale baseline entry: {e.code} {e.file} [{e.context}] no "
+            "longer matches any finding — the exception was fixed, prune "
+            "the entry",
+            path=path or DEFAULT_BASELINE,
+            rule="baseline",
+        )
+        for e in stale
+    ]

@@ -1,0 +1,329 @@
+"""The source-lint driver: parses a file set once, builds the shared
+:class:`LintContext` (per-module ASTs, qualname attribution, lock
+tables, function call/acquisition summaries) and runs every registered
+``FLN###`` rule over it.
+
+The analyses are deliberately *lexical and intra-module where they must
+approximate*: FLN101's nesting edges come from ``with``-block
+containment plus a same-module call-graph closure (a ``with self._lock``
+block that calls a method acquiring another lock contributes an edge),
+never from cross-module data flow — honest static scope, zero false
+"proofs". The runtime sanitizer (:mod:`fugue_tpu.testing.locktrace`)
+covers the interleavings the static view cannot.
+"""
+
+import ast
+import os
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
+
+from fugue_tpu.analysis.codelint.model import (
+    SourceDiagnostic,
+    all_source_rules,
+)
+from fugue_tpu.analysis.diagnostics import Severity
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "Lock", "RLock"}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return dotted_name(node.func)
+
+
+def _literal(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class FunctionSummary:
+    """What one function does, for the interprocedural closure: locks it
+    acquires anywhere (name -> first site line), and the same-module
+    callees it invokes (``self.m()`` -> method, ``f()`` -> module fn)."""
+
+    def __init__(self, qualname: str, node: ast.AST):
+        self.qualname = qualname
+        self.node = node
+        self.acquires: Dict[str, int] = {}
+        self.calls: List[Tuple[str, int]] = []  # (callee key, line)
+        # closure of `acquires` over same-module calls, filled by the
+        # module fixpoint: lock name -> (line, via) of the witness site
+        self.reachable: Dict[str, Tuple[int, str]] = {}
+
+
+class ModuleInfo:
+    """One parsed file plus the per-node attribution the rules share."""
+
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel  # package-relative display path (posix slashes)
+        self.source = source
+        self.tree = ast.parse(source)
+        # id(node) -> enclosing qualname ("Class.method" / "fn" / "")
+        self.qualnames: Dict[int, str] = {}
+        # id(Constant) of module/class/function docstrings
+        self.docstrings: Set[int] = set()
+        # lock tables ------------------------------------------------------
+        # module-level lock names: var name -> canonical lock name
+        self.module_locks: Dict[str, str] = {}
+        # (class, attr) -> canonical; attr -> [canonical, ...] fallback
+        self.class_locks: Dict[Tuple[str, str], str] = {}
+        self.attr_locks: Dict[str, List[str]] = {}
+        # thread-locals / ContextVars --------------------------------------
+        self.module_tls: Set[str] = set()  # module-level names
+        self.attr_tls: Set[str] = set()  # self.<attr> names
+        self.module_cvars: Set[str] = set()
+        # function summaries: qualname -> FunctionSummary
+        self.functions: Dict[str, FunctionSummary] = {}
+        self._annotate()
+        self._collect_locks()
+
+    # ---- attribution -----------------------------------------------------
+    def _annotate(self) -> None:
+        def mark_docstring(node: ast.AST) -> None:
+            body = getattr(node, "body", None)
+            if (
+                body
+                and isinstance(body[0], ast.Expr)
+                and isinstance(body[0].value, ast.Constant)
+                and isinstance(body[0].value.value, str)
+            ):
+                self.docstrings.add(id(body[0].value))
+
+        mark_docstring(self.tree)
+
+        def walk(node: ast.AST, stack: List[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.ClassDef,) + _FUNC_NODES):
+                    mark_docstring(child)
+                    self.qualnames[id(child)] = ".".join(stack)
+                    walk(child, stack + [child.name])
+                else:
+                    self.qualnames[id(child)] = ".".join(stack)
+                    walk(child, stack)
+
+        walk(self.tree, [])
+
+    def qualname(self, node: ast.AST) -> str:
+        return self.qualnames.get(id(node), "")
+
+    def enclosing_class(self, node: ast.AST) -> str:
+        q = self.qualname(node)
+        return q.split(".", 1)[0] if q else ""
+
+    # ---- lock / TLS / ContextVar discovery -------------------------------
+    def _lock_ctor(self, value: ast.AST) -> Optional[Tuple[str, bool]]:
+        """(canonical_or_None_marker, is_tracked) when ``value`` builds a
+        lock: ``tracked_lock("name", ...)`` -> (name, True); a bare
+        ``threading.Lock()/RLock()`` -> (None, False)."""
+        if not isinstance(value, ast.Call):
+            return None
+        name = call_name(value)
+        if name in ("tracked_lock", "locktrace.tracked_lock") or (
+            name is not None and name.endswith(".tracked_lock")
+        ):
+            lit = _literal(value.args[0]) if value.args else None
+            return (lit, True)
+        if name in _LOCK_CTORS:
+            return (None, False)
+        return None
+
+    def _collect_locks(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            target = node.targets[0]
+            ctor = self._lock_ctor(node.value)
+            vname = call_name(node.value) if isinstance(node.value, ast.Call) else None
+            is_tls = vname in ("threading.local", "local")
+            is_cvar = vname in ("ContextVar", "contextvars.ContextVar")
+            if ctor is None and not is_tls and not is_cvar:
+                continue
+            if isinstance(target, ast.Name):
+                if is_tls:
+                    self.module_tls.add(target.id)
+                elif is_cvar:
+                    self.module_cvars.add(target.id)
+                else:
+                    canonical = ctor[0] or f"{self.rel}:{target.id}"
+                    self.module_locks[target.id] = canonical
+            elif isinstance(target, ast.Attribute) and isinstance(
+                target.value, ast.Name
+            ) and target.value.id == "self":
+                cls = self.enclosing_class(node)
+                if is_tls:
+                    self.attr_tls.add(target.attr)
+                elif is_cvar:
+                    pass  # instance ContextVars: out of static scope
+                else:
+                    canonical = ctor[0] or f"{self.rel}:{cls}.{target.attr}"
+                    self.class_locks[(cls, target.attr)] = canonical
+                    self.attr_locks.setdefault(target.attr, []).append(canonical)
+
+    def resolve_lock(self, expr: ast.AST, at: ast.AST) -> Optional[str]:
+        """The canonical lock name of an expression, or None when it is
+        not (known to be) a lock. ``self.X`` resolves through the
+        enclosing class; ``obj.X`` falls back to the attr name when it
+        is unambiguous module-wide."""
+        if isinstance(expr, ast.Name):
+            return self.module_locks.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+                cls = self.enclosing_class(at)
+                hit = self.class_locks.get((cls, expr.attr))
+                if hit is not None:
+                    return hit
+            candidates = self.attr_locks.get(expr.attr, [])
+            if len(candidates) == 1:
+                return candidates[0]
+        return None
+
+
+class LintContext:
+    """Everything a source rule may consult."""
+
+    def __init__(self, modules: List[ModuleInfo]):
+        self.modules = modules
+        for m in modules:
+            _summarize_functions(m)
+            _close_acquires(m)
+
+    def functions(self) -> Iterable[Tuple[ModuleInfo, FunctionSummary]]:
+        for m in self.modules:
+            for fs in m.functions.values():
+                yield m, fs
+
+
+# ---- function summaries -----------------------------------------------------
+def _summarize_functions(mod: ModuleInfo) -> None:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, _FUNC_NODES):
+            continue
+        enclosing = mod.qualname(node)
+        qual = f"{enclosing}.{node.name}" if enclosing else node.name
+        fs = FunctionSummary(qual, node)
+        cls = enclosing.split(".", 1)[0] if enclosing else ""
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.With):
+                for item in sub.items:
+                    lock = mod.resolve_lock(item.context_expr, sub)
+                    if lock is not None:
+                        fs.acquires.setdefault(lock, sub.lineno)
+            elif isinstance(sub, ast.Call):
+                name = call_name(sub)
+                if name is None:
+                    continue
+                if name.endswith(".acquire"):
+                    lock = mod.resolve_lock(sub.func.value, sub)  # type: ignore[attr-defined]
+                    if lock is not None:
+                        fs.acquires.setdefault(lock, sub.lineno)
+                elif name.startswith("self.") and name.count(".") == 1:
+                    meth = name.split(".", 1)[1]
+                    fs.calls.append((f"{cls}.{meth}" if cls else meth, sub.lineno))
+                elif "." not in name:
+                    fs.calls.append((name, sub.lineno))
+        mod.functions[qual] = fs
+
+
+def _close_acquires(mod: ModuleInfo) -> None:
+    """Fixpoint: a function 'reaches' every lock it acquires directly
+    plus everything its same-module callees reach."""
+    for fs in mod.functions.values():
+        fs.reachable = {
+            lock: (line, fs.qualname) for lock, line in fs.acquires.items()
+        }
+    changed = True
+    while changed:
+        changed = False
+        for fs in mod.functions.values():
+            for callee, line in fs.calls:
+                target = mod.functions.get(callee)
+                if target is None:
+                    continue
+                for lock, (_, via) in target.reachable.items():
+                    if lock not in fs.reachable:
+                        # witness: the CALL site inside fs, noting the
+                        # callee that ultimately takes the lock
+                        fs.reachable[lock] = (line, via)
+                        changed = True
+
+
+# ---- tree loading -----------------------------------------------------------
+def package_root() -> str:
+    """The installed ``fugue_tpu`` package directory (the default lint
+    target: the tree gates itself)."""
+    import fugue_tpu
+
+    return os.path.dirname(os.path.abspath(fugue_tpu.__file__))
+
+
+def _iter_py_files(root: str) -> Iterable[str]:
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames if d not in ("__pycache__",)
+        )
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def load_tree(root: Optional[str] = None) -> Tuple[List[ModuleInfo], List[SourceDiagnostic]]:
+    """Parse every ``.py`` under ``root`` (default: the fugue_tpu
+    package). Unparseable files become error diagnostics, never a
+    crashed lint."""
+    root = root or package_root()
+    base = os.path.dirname(os.path.abspath(root))
+    modules: List[ModuleInfo] = []
+    problems: List[SourceDiagnostic] = []
+    for path in _iter_py_files(root):
+        rel = os.path.relpath(path, base).replace(os.sep, "/")
+        try:
+            with open(path, "r") as fp:
+                source = fp.read()
+            modules.append(ModuleInfo(path, rel, source))
+        except (OSError, SyntaxError, ValueError) as ex:
+            problems.append(
+                SourceDiagnostic(
+                    "FLN001",
+                    Severity.ERROR,
+                    f"could not parse: {type(ex).__name__}: {ex}",
+                    path=rel,
+                    rule="parse",
+                )
+            )
+    return modules, problems
+
+
+def lint_modules(modules: List[ModuleInfo]) -> List[SourceDiagnostic]:
+    import fugue_tpu.analysis.codelint.rules_locks  # noqa: F401
+    import fugue_tpu.analysis.codelint.rules_threads  # noqa: F401
+    import fugue_tpu.analysis.codelint.rules_vocab  # noqa: F401
+
+    ctx = LintContext(modules)
+    out: List[SourceDiagnostic] = []
+    for rule_cls in all_source_rules():
+        out.extend(rule_cls().check(ctx))
+    out.sort(key=lambda d: (-int(d.severity), d.path, d.line))
+    return out
+
+
+def lint_tree(root: Optional[str] = None) -> List[SourceDiagnostic]:
+    modules, problems = load_tree(root)
+    return problems + lint_modules(modules)
+
+
+def lint_text(source: str, rel: str = "fugue_tpu/fixture.py") -> List[SourceDiagnostic]:
+    """Lint one in-memory module (the fixture-corpus entry point)."""
+    return lint_modules([ModuleInfo(rel, rel, source)])

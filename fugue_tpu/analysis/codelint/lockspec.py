@@ -1,0 +1,80 @@
+"""The concurrency-invariant registries the FLN rules enforce — ONE
+place declaring what CHANGES.md used to carry as prose.
+
+- :data:`CANONICAL_LOCK_ORDER`: the repo-wide lock hierarchy, outermost
+  first. A lock may only be acquired while holding locks that appear
+  EARLIER in this tuple; FLN101 flags any statically-observed nesting
+  that runs backwards, and any cycle among observed nestings (listed or
+  not). Names are the ``tracked_lock`` names
+  (:mod:`fugue_tpu.testing.locktrace`), so the static registry, the
+  runtime sanitizer's reports and the source agree on vocabulary;
+  locks created with a bare ``threading.Lock()`` get a synthesized
+  ``<file>:<Class>.<attr>`` name and participate in cycle detection
+  only.
+- :data:`ENGINE_FS_PATHS`: package-relative prefixes of the engine/serve
+  code that must route ALL file IO through ``engine.fs`` (the fault
+  sites, URI support and chaos injection live there) — FLN105's scope.
+- :data:`BLOCKING_CALLS`: dotted-name prefixes of calls that block on
+  IO/sleep/network; FLN104 rejects them inside a held lock.
+"""
+
+# Outermost -> innermost. The serve plane sits above the engine plane:
+# an HTTP/scheduler path may reach INTO the engine (dispatch under a
+# session or scheduler lock) but engine internals must never call back
+# up into serve locks. Leaf bookkeeping locks (metrics, faults, stats)
+# come last: they are acquired everywhere and may never hold anything.
+CANONICAL_LOCK_ORDER = (
+    # serve plane (outermost: owns requests and jobs)
+    "serve.daemon.ServeDaemon._first_query_lock",
+    "serve.scheduler.JobScheduler._lock",
+    "serve.session.SessionManager._lock",
+    "serve.session.ServeSession._lock",
+    "serve.scheduler.ServeJob._finish_lock",
+    "serve.supervisor.EngineSupervisor._lock",
+    "serve.supervisor.CircuitBreaker._lock",
+    "serve.supervisor.HealthState._lock",
+    "serve.state.ServeStateJournal._lock",
+    # engine plane
+    "execution.engine._GLOBAL_LOCK",
+    "execution.engine.ExecutionEngine._ctx_lock",
+    "execution.engine.ExecutionEngine._stop_lock",
+    "jax.engine.JaxExecutionEngine._dispatch_lock",
+    "jax.memory.MemoryGovernor._lock",
+    "optimize.cache.PlanCache._lock",
+    "optimize.exec_cache._WORKER_LOCK",
+    "optimize.exec_cache._WARM_LOCK",
+    "optimize.exec_cache._FN_HASH_LOCK",
+    # leaf bookkeeping (held for O(1) mutations only; never nest)
+    "jax.engine.JaxExecutionEngine._dispatch_secs_lock",
+    "workflow.manifest.RunManifest._lock",
+    "workflow.fault.RunStats._lock",
+    "testing.faults._ACTIVE_LOCK",
+    "testing.faults.FaultPlan._lock",
+    "obs.trace.Trace._lock",
+    "obs.metrics.MetricsRegistry._lock",
+    "obs.metrics.MetricFamily._lock",
+)
+
+LOCK_RANK = {name: i for i, name in enumerate(CANONICAL_LOCK_ORDER)}
+
+# package-relative path prefixes whose file IO must go through engine.fs
+ENGINE_FS_PATHS = (
+    "fugue_tpu/serve/",
+    "fugue_tpu/jax_backend/",
+    "fugue_tpu/optimize/",
+    "fugue_tpu/obs/",
+    "fugue_tpu/workflow/",
+)
+
+# dotted-call prefixes that block (IO, sleep, network, subprocess):
+# forbidden while holding any registered lock (FLN104)
+BLOCKING_CALLS = (
+    "time.sleep",
+    "open",
+    "urllib.",
+    "requests.",
+    "socket.",
+    "subprocess.",
+    "os.system",
+    "http.client.",
+)

@@ -1,0 +1,103 @@
+"""Diagnostic/rule model of the SOURCE linter — the second static-
+analysis plane (codes ``FLN###``), mirroring the workflow analyzer's
+``Rule``/``Diagnostic`` registry idiom (:mod:`fugue_tpu.analysis.
+diagnostics`) but attributed to ``file:line`` instead of task/callsite:
+the subject here is the codebase itself, not a user DAG."""
+
+from typing import Any, Dict, Iterable, List, Optional, Type
+
+from fugue_tpu.analysis.diagnostics import Severity
+
+
+class SourceDiagnostic:
+    """One source-lint finding: stable rule code, severity, message, and
+    the offending ``file:line`` plus the enclosing ``Class.method``
+    qualname (the baseline's match key)."""
+
+    __slots__ = ("code", "severity", "message", "path", "line", "qualname", "rule")
+
+    def __init__(
+        self,
+        code: str,
+        severity: Severity,
+        message: str,
+        path: str = "",
+        line: int = 0,
+        qualname: str = "",
+        rule: str = "",
+    ):
+        self.code = code
+        self.severity = Severity.parse(severity)
+        self.message = message
+        self.path = path
+        self.line = int(line)
+        self.qualname = qualname
+        self.rule = rule
+
+    def describe(self) -> str:
+        where = f"{self.path}:{self.line}"
+        ctx = f" [in {self.qualname}]" if self.qualname else ""
+        return f"{self.code} {self.severity} {where}{ctx}: {self.message}"
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dict(
+            code=self.code,
+            severity=str(self.severity),
+            message=self.message,
+            path=self.path,
+            line=self.line,
+            qualname=self.qualname,
+            rule=self.rule,
+        )
+
+    def __str__(self) -> str:
+        return self.describe()
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"SourceDiagnostic({self.code}, {self.path}:{self.line})"
+
+
+class SourceRule:
+    """One source-level check with a stable ``FLN###`` code. Rules are
+    side-effect free; ``check`` runs over the whole :class:`LintContext`
+    (not per file) so cross-module analyses — the FLN101 lock graph —
+    see every acquisition site at once."""
+
+    code: str = "FLN000"
+    severity: Severity = Severity.ERROR
+    description: str = ""
+
+    def check(self, ctx: Any) -> Iterable[SourceDiagnostic]:  # pragma: no cover
+        raise NotImplementedError
+
+    def diag(
+        self,
+        message: str,
+        path: str = "",
+        line: int = 0,
+        qualname: str = "",
+        severity: Optional[Severity] = None,
+    ) -> SourceDiagnostic:
+        return SourceDiagnostic(
+            code=self.code,
+            severity=self.severity if severity is None else severity,
+            message=message,
+            path=path,
+            line=line,
+            qualname=qualname,
+            rule=type(self).__name__,
+        )
+
+
+_SOURCE_RULES: Dict[str, Type[SourceRule]] = {}
+
+
+def register_source_rule(cls: Type[SourceRule]) -> Type[SourceRule]:
+    """Class decorator: register by stable code (re-registering a code
+    replaces the rule, same contract as the workflow registry)."""
+    _SOURCE_RULES[cls.code] = cls
+    return cls
+
+
+def all_source_rules() -> List[Type[SourceRule]]:
+    return [_SOURCE_RULES[k] for k in sorted(_SOURCE_RULES)]

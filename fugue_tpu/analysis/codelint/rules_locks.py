@@ -1,0 +1,209 @@
+"""Lock-discipline rules.
+
+FLN101 builds the statically-observable lock-acquisition graph: an edge
+``A -> B`` whenever a ``with A``/`A.acquire()`` region lexically
+contains an acquisition of ``B``, or calls (same module) a function
+whose acquisition closure reaches ``B``. It then rejects (a) any edge
+that runs BACKWARDS through the canonical hierarchy declared in
+:mod:`fugue_tpu.analysis.codelint.lockspec` and (b) any cycle among
+observed edges — the static complement of the runtime sanitizer's
+per-acquisition inversion check.
+
+FLN104 rejects blocking calls (sleep, file IO, network, subprocess)
+lexically inside a held registered lock: a slow syscall under an engine
+lock stalls every thread behind it (the serving daemon's workers, the
+memory governor's admission path).
+"""
+
+import ast
+from typing import Any, Dict, Iterable, List, Tuple
+
+from fugue_tpu.analysis.codelint.engine import call_name
+from fugue_tpu.analysis.codelint.lockspec import (
+    BLOCKING_CALLS,
+    LOCK_RANK,
+)
+from fugue_tpu.analysis.codelint.model import (
+    SourceDiagnostic,
+    SourceRule,
+    register_source_rule,
+)
+
+
+def _inner_acquisitions(mod: Any, fs: Any, with_node: ast.With) -> List[Tuple[str, int, str]]:
+    """Locks acquired inside ``with_node``'s body: (lock, line, via)."""
+    out: List[Tuple[str, int, str]] = []
+    for stmt in with_node.body:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.With):
+                for item in sub.items:
+                    lock = mod.resolve_lock(item.context_expr, sub)
+                    if lock is not None:
+                        out.append((lock, sub.lineno, fs.qualname))
+            elif isinstance(sub, ast.Call):
+                name = call_name(sub)
+                if name is None:
+                    continue
+                if name.endswith(".acquire"):
+                    lock = mod.resolve_lock(sub.func.value, sub)
+                    if lock is not None:
+                        out.append((lock, sub.lineno, fs.qualname))
+                    continue
+                callee = None
+                if name.startswith("self.") and name.count(".") == 1:
+                    cls = fs.qualname.split(".", 1)[0]
+                    callee = f"{cls}.{name.split('.', 1)[1]}"
+                elif "." not in name:
+                    callee = name
+                target = mod.functions.get(callee) if callee else None
+                if target is not None:
+                    for lock, (_, via) in target.reachable.items():
+                        out.append((lock, sub.lineno, via))
+    return out
+
+
+class _Edge:
+    __slots__ = ("outer", "inner", "path", "line", "qualname", "via")
+
+    def __init__(self, outer, inner, path, line, qualname, via):
+        self.outer = outer
+        self.inner = inner
+        self.path = path
+        self.line = line
+        self.qualname = qualname
+        self.via = via
+
+
+def collect_edges(ctx: Any) -> List[_Edge]:
+    edges: List[_Edge] = []
+    for mod, fs in ctx.functions():
+        for sub in ast.walk(fs.node):
+            if not isinstance(sub, ast.With):
+                continue
+            outers = [
+                mod.resolve_lock(item.context_expr, sub) for item in sub.items
+            ]
+            # `with A, B:` acquires item-order left to right: each earlier
+            # item is an outer of every later one
+            resolved = [o for o in outers if o is not None]
+            for i, outer in enumerate(resolved):
+                for inner in resolved[i + 1:]:
+                    if inner != outer:
+                        edges.append(
+                            _Edge(
+                                outer, inner, mod.rel, sub.lineno,
+                                fs.qualname, fs.qualname,
+                            )
+                        )
+            for outer in resolved:
+                for inner, line, via in _inner_acquisitions(mod, fs, sub):
+                    if inner != outer:  # reentrant nesting is legal
+                        edges.append(
+                            _Edge(outer, inner, mod.rel, line, fs.qualname, via)
+                        )
+    return edges
+
+
+@register_source_rule
+class LockOrderRule(SourceRule):
+    code = "FLN101"
+    description = (
+        "lock acquired against the canonical hierarchy, or a cycle in "
+        "the statically-observed lock-acquisition graph"
+    )
+
+    def check(self, ctx: Any) -> Iterable[SourceDiagnostic]:
+        edges = collect_edges(ctx)
+        # (a) canonical-order inversions
+        for e in edges:
+            ro, ri = LOCK_RANK.get(e.outer), LOCK_RANK.get(e.inner)
+            if ro is not None and ri is not None and ro > ri:
+                hint = f" (reached via {e.via})" if e.via != e.qualname else ""
+                yield self.diag(
+                    f"'{e.inner}' acquired while holding '{e.outer}', "
+                    "inverting the canonical lock order declared in "
+                    f"analysis/codelint/lockspec.py{hint}",
+                    path=e.path,
+                    line=e.line,
+                    qualname=e.qualname,
+                )
+        # (b) cycles among observed edges (listed in the hierarchy or not)
+        adjacency: Dict[str, Dict[str, _Edge]] = {}
+        for e in edges:
+            adjacency.setdefault(e.outer, {}).setdefault(e.inner, e)
+        reported = set()
+        for start in sorted(adjacency):
+            path: List[str] = []
+            onpath = set()
+            seen = set()
+
+            def dfs(node: str) -> Iterable[SourceDiagnostic]:
+                path.append(node)
+                onpath.add(node)
+                seen.add(node)
+                for nxt, e in sorted(adjacency.get(node, {}).items()):
+                    if nxt in onpath:
+                        cycle = tuple(path[path.index(nxt):] + [nxt])
+                        key = frozenset(cycle)
+                        if key not in reported:
+                            reported.add(key)
+                            yield self.diag(
+                                "lock-acquisition cycle: "
+                                + " -> ".join(cycle)
+                                + " — two threads entering it from "
+                                "different locks can deadlock",
+                                path=e.path,
+                                line=e.line,
+                                qualname=e.qualname,
+                            )
+                    elif nxt not in seen:
+                        yield from dfs(nxt)
+                path.pop()
+                onpath.discard(node)
+
+            yield from dfs(start)
+
+
+@register_source_rule
+class BlockingUnderLockRule(SourceRule):
+    code = "FLN104"
+    description = (
+        "blocking IO/sleep/network call while holding a registered lock"
+    )
+
+    def check(self, ctx: Any) -> Iterable[SourceDiagnostic]:
+        for mod, fs in ctx.functions():
+            for sub in ast.walk(fs.node):
+                if not isinstance(sub, ast.With):
+                    continue
+                held = [
+                    lock
+                    for item in sub.items
+                    if (lock := mod.resolve_lock(item.context_expr, sub))
+                ]
+                if not held:
+                    continue
+                for stmt in sub.body:
+                    for call in ast.walk(stmt):
+                        if not isinstance(call, ast.Call):
+                            continue
+                        name = call_name(call)
+                        if name is None:
+                            continue
+                        for pat in BLOCKING_CALLS:
+                            hit = (
+                                name.startswith(pat)
+                                if pat.endswith(".")
+                                else name == pat
+                            )
+                            if hit:
+                                yield self.diag(
+                                    f"blocking call '{name}' while "
+                                    f"holding '{held[0]}' — every thread "
+                                    "queued on that lock stalls behind "
+                                    "this IO/sleep",
+                                    path=mod.rel,
+                                    line=call.lineno,
+                                    qualname=fs.qualname,
+                                )
+                                break

@@ -1,0 +1,317 @@
+"""Thread-lifecycle and context-propagation rules.
+
+FLN102 — every ``threading.Thread(...)`` must be joinable: bound to a
+name/attribute that some code in the module ``.join()``s (directly, or
+as the loop variable of a sweep over the bound collection), or it is a
+fire-and-forget thread that can abort interpreter teardown (the PR 10
+warm-thread lesson: a daemon thread frozen mid-XLA-deserialize at exit
+kills the process from C++). Intentional fire-and-forget threads get a
+justified baseline entry, not silence.
+
+FLN103 — a thread-local slot or ContextVar set without a paired
+restore leaks request state onto pooled worker threads (the PR 7
+cross-thread ``as_context`` bug class). A set is paired when it sits in
+a ``finally``/``__exit__`` restore path, when its enclosing function
+restores the same slot in a ``finally``, when its ``__enter__`` has a
+matching ``__exit__`` assignment, or — ContextVars — when the token is
+captured and the module ``reset()``s it. Initializing a fresh per-thread
+container (``tls.stack = []``) is state creation, not a scoped override,
+and is allowed.
+"""
+
+import ast
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
+
+from fugue_tpu.analysis.codelint.engine import call_name
+from fugue_tpu.analysis.codelint.model import (
+    SourceDiagnostic,
+    SourceRule,
+    register_source_rule,
+)
+
+_THREAD_CTORS = ("threading.Thread", "Thread")
+
+
+def _norm(token: str) -> str:
+    return token.lstrip("_")
+
+
+def _join_tokens(mod: Any) -> Set[str]:
+    """Names/attrs the module joins: bases of ``X.join()`` calls plus
+    the iterables of ``for v in X: ... v.join()`` sweeps."""
+    tokens: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "join"
+        ):
+            base = node.func.value
+            if isinstance(base, ast.Name):
+                tokens.add(_norm(base.id))
+            elif isinstance(base, ast.Attribute):
+                tokens.add(_norm(base.attr))
+        elif isinstance(node, ast.For) and isinstance(node.target, ast.Name):
+            var = node.target.id
+            joins_var = any(
+                isinstance(c, ast.Call)
+                and isinstance(c.func, ast.Attribute)
+                and c.func.attr == "join"
+                and isinstance(c.func.value, ast.Name)
+                and c.func.value.id == var
+                for stmt in node.body
+                for c in ast.walk(stmt)
+            )
+            if not joins_var:
+                continue
+            it = node.iter
+            # unwrap list(X) / sorted(X) / reversed(X)
+            if isinstance(it, ast.Call) and it.args:
+                it = it.args[0]
+            if isinstance(it, ast.Name):
+                tokens.add(_norm(it.id))
+            elif isinstance(it, ast.Attribute):
+                tokens.add(_norm(it.attr))
+    return tokens
+
+
+def _thread_bindings(mod: Any) -> Dict[int, str]:
+    """id(Thread Call node) -> the token it is bound to (assignment
+    target, including threads built inside comprehensions/list
+    literals of that assignment)."""
+    bound: Dict[int, str] = {}
+    for node in ast.walk(mod.tree):
+        targets: List[ast.AST] = []
+        value: Optional[ast.AST] = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        if value is None:
+            continue
+        token = None
+        for t in targets:
+            if isinstance(t, ast.Name):
+                token = _norm(t.id)
+            elif isinstance(t, ast.Attribute):
+                token = _norm(t.attr)
+        if token is None:
+            continue
+        for sub in ast.walk(value):
+            if isinstance(sub, ast.Call) and call_name(sub) in _THREAD_CTORS:
+                bound[id(sub)] = token
+    return bound
+
+
+@register_source_rule
+class ThreadJoinRule(SourceRule):
+    code = "FLN102"
+    description = (
+        "threading.Thread spawned without a join path (join-on-stop or "
+        "spawn_warm_thread-style atexit registration)"
+    )
+
+    def check(self, ctx: Any) -> Iterable[SourceDiagnostic]:
+        for mod in ctx.modules:
+            joins = _join_tokens(mod)
+            bound = _thread_bindings(mod)
+            for node in ast.walk(mod.tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and call_name(node) in _THREAD_CTORS
+                ):
+                    continue
+                token = bound.get(id(node))
+                if token is not None and token in joins:
+                    continue
+                detail = (
+                    f"bound to '{token}' which is never joined"
+                    if token is not None
+                    else "never bound, so it can never be joined"
+                )
+                yield self.diag(
+                    f"threading.Thread {detail}: an unjoined thread can "
+                    "abort interpreter teardown mid-flight — join it on "
+                    "stop, register a bounded atexit join "
+                    "(spawn_warm_thread), or add a justified baseline "
+                    "entry",
+                    path=mod.rel,
+                    line=node.lineno,
+                    qualname=mod.qualname(node),
+                )
+
+
+class _TlsWrite:
+    __slots__ = ("mod", "node", "token", "attr", "qualname", "fn")
+
+    def __init__(self, mod, node, token, attr, qualname, fn):
+        self.mod = mod
+        self.node = node
+        self.token = token  # the thread-local object's name/attr
+        self.attr = attr  # the slot written
+        self.qualname = qualname
+        self.fn = fn  # enclosing function node (or None at module level)
+
+
+def _tls_base_token(mod: Any, expr: ast.AST) -> Optional[str]:
+    """Token of a known thread-local object, or None."""
+    if isinstance(expr, ast.Name) and expr.id in mod.module_tls:
+        return expr.id
+    if isinstance(expr, ast.Attribute) and expr.attr in mod.attr_tls:
+        return expr.attr
+    return None
+
+
+def _finally_nodes(root: ast.AST) -> Set[int]:
+    out: Set[int] = set()
+    for node in ast.walk(root):
+        if isinstance(node, ast.Try):
+            for stmt in node.finalbody:
+                for sub in ast.walk(stmt):
+                    out.add(id(sub))
+                out.add(id(stmt))
+    return out
+
+
+def _is_container_init(value: ast.AST) -> bool:
+    return isinstance(value, (ast.List, ast.Dict, ast.Set, ast.Tuple))
+
+
+def _collect_tls_writes(mod: Any) -> List[_TlsWrite]:
+    writes: List[_TlsWrite] = []
+    fn_of: Dict[int, ast.AST] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(node):
+                fn_of.setdefault(id(sub), node)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for target in node.targets:
+            if not isinstance(target, ast.Attribute):
+                continue
+            token = _tls_base_token(mod, target.value)
+            if token is None:
+                continue
+            writes.append(
+                _TlsWrite(
+                    mod,
+                    node,
+                    token,
+                    target.attr,
+                    mod.qualname(node),
+                    fn_of.get(id(node)),
+                )
+            )
+    return writes
+
+
+@register_source_rule
+class ContextRestoreRule(SourceRule):
+    code = "FLN103"
+    description = (
+        "thread-local/ContextVar set without a paired restore on every "
+        "exit path"
+    )
+
+    def check(self, ctx: Any) -> Iterable[SourceDiagnostic]:
+        for mod in ctx.modules:
+            yield from self._check_contextvars(mod)
+            yield from self._check_thread_locals(mod)
+
+    # ---- ContextVars -----------------------------------------------------
+    def _check_contextvars(self, mod: Any) -> Iterable[SourceDiagnostic]:
+        if not mod.module_cvars:
+            return
+        resets: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                if name is not None and name.endswith(".reset"):
+                    base = name.rsplit(".", 1)[0]
+                    resets.add(base)
+        # sets whose token is DISCARDED (statement-level call) can never
+        # be reset; capture their ids so the second pass skips them
+        discarded: Set[int] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+                name = call_name(node.value)
+                if name is not None and name.endswith(".set"):
+                    base = name.rsplit(".", 1)[0]
+                    if base in mod.module_cvars:
+                        discarded.add(id(node.value))
+                        yield self.diag(
+                            f"ContextVar '{base}'.set() token discarded: "
+                            "without the token the var can never be "
+                            "reset, leaking context onto reused threads",
+                            path=mod.rel,
+                            line=node.lineno,
+                            qualname=mod.qualname(node),
+                        )
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or id(node) in discarded:
+                continue
+            name = call_name(node)
+            if name is None or not name.endswith(".set"):
+                continue
+            base = name.rsplit(".", 1)[0]
+            if base in mod.module_cvars and base not in resets:
+                yield self.diag(
+                    f"ContextVar '{base}' is set but never reset in "
+                    "this module: captured tokens must flow into a "
+                    f"'{base}.reset(token)' on every exit path",
+                    path=mod.rel,
+                    line=node.lineno,
+                    qualname=mod.qualname(node),
+                )
+
+    # ---- thread-locals ---------------------------------------------------
+    def _check_thread_locals(self, mod: Any) -> Iterable[SourceDiagnostic]:
+        writes = _collect_tls_writes(mod)
+        if not writes:
+            return
+        in_finally = _finally_nodes(mod.tree)
+        # (class, token, attr) -> method names that write the slot
+        by_class: Dict[Tuple[str, str, str], Set[str]] = {}
+        for w in writes:
+            parts = w.qualname.split(".")
+            if len(parts) >= 2:
+                by_class.setdefault(
+                    (parts[0], w.token, w.attr), set()
+                ).add(parts[-1])
+        for w in writes:
+            if _is_container_init(w.node.value):
+                continue  # fresh per-thread state, not a scoped override
+            if id(w.node) in in_finally:
+                continue  # this IS the restore
+            method = w.qualname.split(".")[-1] if w.qualname else ""
+            if method == "__exit__":
+                continue  # CM restore path
+            # enclosing function restores the slot in a finally?
+            if w.fn is not None:
+                fn_finally = _finally_nodes(w.fn)
+                restored = any(
+                    id(o.node) in fn_finally
+                    for o in writes
+                    if o.fn is w.fn
+                    and o.token == w.token
+                    and o.attr == w.attr
+                    and o.node is not w.node
+                )
+                if restored:
+                    continue
+            # __enter__ paired with an __exit__ writing the same slot?
+            parts = w.qualname.split(".")
+            if method == "__enter__" and len(parts) >= 2:
+                methods = by_class.get((parts[0], w.token, w.attr), set())
+                if "__exit__" in methods:
+                    continue
+            yield self.diag(
+                f"thread-local '{w.token}.{w.attr}' set without a paired "
+                "restore: no finally-restore in this function, not an "
+                "__enter__/__exit__ pair — the override leaks onto the "
+                "next job this pooled thread runs",
+                path=mod.rel,
+                line=w.node.lineno,
+                qualname=w.qualname,
+            )

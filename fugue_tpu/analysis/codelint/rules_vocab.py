@@ -1,0 +1,171 @@
+"""Registry-vocabulary rules: the source-side complements of the
+runtime registries.
+
+FLN105 — engine/serve-path file IO must route through ``engine.fs``:
+the fs layer owns fault sites (``fs.open``/``fs.write``), URI schemes
+and atomic-write semantics; a raw ``open()``/``os.remove`` there
+bypasses chaos injection and breaks object-store deployments.
+
+FLN106 — every string-literal ``fugue.*`` conf key must be declared in
+the :mod:`fugue_tpu.constants` registry (the source-side complement of
+the runtime FWF201 rule: an undeclared key is silently ignored by every
+engine getter AND unlintable for users).
+
+FLN107 — ``fault_point(site, ...)`` literals must come from
+``testing/faults.py KNOWN_SITES`` (a typo'd site never fires, so the
+chaos test silently stops testing anything), and literal metric names
+must fall under ``obs/metrics.py METRIC_NAME_PREFIXES`` (one dashboard
+namespace, no silent forks).
+"""
+
+import ast
+import re
+from typing import Any, Iterable
+
+from fugue_tpu.analysis.codelint.engine import call_name
+from fugue_tpu.analysis.codelint.lockspec import ENGINE_FS_PATHS
+from fugue_tpu.analysis.codelint.model import (
+    SourceDiagnostic,
+    SourceRule,
+    register_source_rule,
+)
+
+_RAW_IO_CALLS = {
+    "open": "engine.fs.open_read/open_write",
+    "os.remove": "engine.fs.remove",
+    "os.unlink": "engine.fs.remove",
+    "os.rmdir": "engine.fs.remove",
+    "shutil.rmtree": "engine.fs.remove",
+}
+
+_CONF_KEY_RE = re.compile(r"fugue(\.[a-z0-9_]+)+")
+_METRIC_METHODS = {"counter", "gauge", "histogram"}
+
+
+@register_source_rule
+class RawIoOnEnginePathRule(SourceRule):
+    code = "FLN105"
+    description = (
+        "raw open()/os.remove on an engine/serve path that must route "
+        "through engine.fs"
+    )
+
+    def check(self, ctx: Any) -> Iterable[SourceDiagnostic]:
+        for mod in ctx.modules:
+            if not mod.rel.startswith(ENGINE_FS_PATHS):
+                continue
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                replacement = _RAW_IO_CALLS.get(name or "")
+                if replacement is None:
+                    continue
+                yield self.diag(
+                    f"raw '{name}(...)' on an engine/serve path: route "
+                    f"through {replacement} so fault injection "
+                    "(fs.open/fs.write sites), URI schemes and atomic "
+                    "writes apply",
+                    path=mod.rel,
+                    line=node.lineno,
+                    qualname=mod.qualname(node),
+                )
+
+
+@register_source_rule
+class UndeclaredConfKeyLiteralRule(SourceRule):
+    code = "FLN106"
+    description = (
+        "string-literal fugue.* conf key absent from the constants.py "
+        "registry (source-side complement of runtime FWF201)"
+    )
+
+    def check(self, ctx: Any) -> Iterable[SourceDiagnostic]:
+        from fugue_tpu.constants import declared_conf_keys
+
+        declared = declared_conf_keys()
+        for mod in ctx.modules:
+            for node in ast.walk(mod.tree):
+                if (
+                    not isinstance(node, ast.Constant)
+                    or not isinstance(node.value, str)
+                    or id(node) in mod.docstrings
+                ):
+                    continue
+                value = node.value
+                if not _CONF_KEY_RE.fullmatch(value):
+                    continue
+                if value in declared:
+                    continue
+                yield self.diag(
+                    f"conf-key literal '{value}' is not declared in the "
+                    "constants.py registry: undeclared fugue.* keys are "
+                    "silently ignored by every engine getter and "
+                    "invisible to the conf linter — register_conf_key it "
+                    "(or rename to the declared key)",
+                    path=mod.rel,
+                    line=node.lineno,
+                    qualname=mod.qualname(node),
+                )
+
+
+@register_source_rule
+class VocabularyRule(SourceRule):
+    code = "FLN107"
+    description = (
+        "fault_point site missing from KNOWN_SITES, or metric name "
+        "outside the registered METRIC_NAME_PREFIXES"
+    )
+
+    def check(self, ctx: Any) -> Iterable[SourceDiagnostic]:
+        from fugue_tpu.obs.metrics import METRIC_NAME_PREFIXES
+        from fugue_tpu.testing.faults import KNOWN_SITES
+
+        for mod in ctx.modules:
+            defines_vocab = mod.rel.endswith("testing/faults.py")
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if name is None:
+                    continue
+                if (
+                    not defines_vocab
+                    and (name == "fault_point" or name.endswith(".fault_point"))
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    site = node.args[0].value
+                    if site not in KNOWN_SITES:
+                        yield self.diag(
+                            f"fault site '{site}' is not in testing/"
+                            "faults.py KNOWN_SITES: a plan spec naming "
+                            "it would silently never fire — add it to "
+                            "the vocabulary",
+                            path=mod.rel,
+                            line=node.lineno,
+                            qualname=mod.qualname(node),
+                        )
+                    continue
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METRIC_METHODS
+                    and len(node.args) >= 2
+                    # our registry signature: (name_literal, help_literal)
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and isinstance(node.args[1], ast.Constant)
+                    and isinstance(node.args[1].value, str)
+                ):
+                    metric = node.args[0].value
+                    if not metric.startswith(METRIC_NAME_PREFIXES):
+                        yield self.diag(
+                            f"metric name '{metric}' falls outside the "
+                            "registered METRIC_NAME_PREFIXES (obs/"
+                            "metrics.py): new subsystems extend the "
+                            "prefix tuple in the same PR",
+                            path=mod.rel,
+                            line=node.lineno,
+                            qualname=mod.qualname(node),
+                        )

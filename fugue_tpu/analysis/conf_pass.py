@@ -17,6 +17,7 @@ from fugue_tpu.analysis.diagnostics import (
 from fugue_tpu.constants import (
     FUGUE_CONF_OBS_ENABLED,
     FUGUE_CONF_OBS_TRACE_PATH,
+    FUGUE_CONF_SERVE_MAX_CONCURRENT,
     FUGUE_CONF_SERVE_STATE_PATH,
     FUGUE_CONF_WORKFLOW_RESUME,
     declared_conf_keys,
@@ -133,6 +134,41 @@ class DaemonColdStartCacheRule(Rule):
             "first answer — set fugue.optimize.cache.dir so restarts "
             "pre-warm from disk and time_to_first_query stays IO-bound",
         )
+
+
+@register_rule
+class ServeConcurrencyDispatchLockRule(Rule):
+    code = "FWF503"
+    severity = Severity.WARN
+    description = (
+        "serve-targeted conf with concurrent submissions but an engine "
+        "whose task_execution_lock is None: concurrent device dispatch "
+        "of collective programs can deadlock (XLA CPU rendezvous)"
+    )
+
+    def check(self, ctx: Any) -> Iterable[Diagnostic]:
+        # only a conf that EXPLICITLY carries the serve concurrency key
+        # is serve-targeted; and with no live engine the lock is unknowable
+        if FUGUE_CONF_SERVE_MAX_CONCURRENT not in ctx.conf or ctx.engine is None:
+            return
+        try:
+            max_concurrent = _convert(
+                ctx.conf[FUGUE_CONF_SERVE_MAX_CONCURRENT], int
+            )
+        except Exception:
+            return  # FWF202 already rejects the unconvertible value
+        if max_concurrent <= 1:
+            return
+        if getattr(ctx.engine, "task_execution_lock", None) is None:
+            yield self.diag(
+                f"fugue.serve.max_concurrent={max_concurrent} but the "
+                "target engine's task_execution_lock is None: two "
+                "concurrently dispatched programs with collectives can "
+                "starve each other's rendezvous participants and "
+                "deadlock (the PR 6 shared-engine hazard) — serve "
+                "through an engine that serializes task execution, or "
+                "set fugue.serve.max_concurrent=1",
+            )
 
 
 @register_rule

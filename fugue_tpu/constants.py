@@ -83,6 +83,7 @@ FUGUE_CONF_OPTIMIZE_CACHE_MAX_RESULT_BYTES = (
 )
 FUGUE_CONF_OPTIMIZE_CACHE_DIR = "fugue.optimize.cache.dir"
 FUGUE_CONF_SERVE_RESULT_CACHE = "fugue.serve.result_cache"
+FUGUE_CONF_DEBUG_LOCK_SANITIZER = "fugue.debug.lock_sanitizer"
 FUGUE_CONF_OBS_ENABLED = "fugue.obs.enabled"
 FUGUE_CONF_OBS_TRACE_PATH = "fugue.obs.trace_path"
 FUGUE_CONF_OBS_SLOW_QUERY_MS = "fugue.obs.slow_query_ms"
@@ -652,6 +653,20 @@ def _declare_defaults() -> None:
         float,
         1.0,
         "fraction of eligible requests/runs that open a trace",
+        in_defaults=False,
+    )
+    # runtime lock-order sanitizer (testing/locktrace.py): debug-only.
+    # Off (the default), every tracked_lock() call returns a plain
+    # threading lock — no wrapper, zero overhead. On, locks created
+    # afterwards are name-registered and every acquisition is checked
+    # for ordering inversions/potential deadlock cycles. Consumed by
+    # the serving daemon at start and by tests; module-owned, not seeded.
+    r(
+        FUGUE_CONF_DEBUG_LOCK_SANITIZER,
+        bool,
+        False,
+        "debug lock-order sanitizer: wrap locks created after arming and "
+        "report acquisition-order inversions (off = zero overhead)",
         in_defaults=False,
     )
 

@@ -12,7 +12,7 @@ import logging
 from abc import ABC, abstractmethod
 from contextlib import contextmanager
 from contextvars import ContextVar
-from threading import RLock, local
+from threading import local
 from typing import TYPE_CHECKING, Any, Callable, Dict, Iterator, List, Optional, Union
 from uuid import uuid4
 
@@ -25,6 +25,7 @@ from fugue_tpu.collections.yielded import PhysicalYielded, Yielded
 from fugue_tpu.column.expressions import ColumnExpr
 from fugue_tpu.column.sql import SelectColumns
 from fugue_tpu.constants import FUGUE_GLOBAL_CONF
+from fugue_tpu.testing.locktrace import tracked_lock
 from fugue_tpu.dataframe import (
     ArrayDataFrame,
     DataFrame,
@@ -47,7 +48,7 @@ _ZIP_HOW_META = "serialized_how"
 _CONTEXT_ENGINE: ContextVar[Optional["ExecutionEngine"]] = ContextVar(
     "fugue_tpu_engine", default=None
 )
-_GLOBAL_LOCK = RLock()
+_GLOBAL_LOCK = tracked_lock("execution.engine._GLOBAL_LOCK", reentrant=True)
 _GLOBAL_ENGINE: List[Optional["ExecutionEngine"]] = [None]
 
 
@@ -176,8 +177,12 @@ class ExecutionEngine(FugueEngineBase):
         # (the serving daemon) runs many workflows concurrently, each
         # entering/leaving the context on its own worker thread
         self._ctx_local = local()
-        self._ctx_lock = RLock()
-        self._stop_lock = RLock()
+        self._ctx_lock = tracked_lock(
+            "execution.engine.ExecutionEngine._ctx_lock", reentrant=True
+        )
+        self._stop_lock = tracked_lock(
+            "execution.engine.ExecutionEngine._stop_lock", reentrant=True
+        )
         self._stopped = False
 
     # ---- lifecycle & context (reference :363-447) -----------------------

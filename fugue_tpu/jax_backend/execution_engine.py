@@ -50,6 +50,7 @@ from fugue_tpu.dataframe import (
     LocalDataFrame,
 )
 from fugue_tpu.obs.trace import start_span
+from fugue_tpu.testing.locktrace import tracked_lock
 from fugue_tpu.execution.execution_engine import (
     ExecutionEngine,
     MapEngine,
@@ -675,7 +676,9 @@ class JaxExecutionEngine(ExecutionEngine):
         # compile/execute/disk-load wall clock split of every jitted
         # dispatch since construction — the daemon's time_to_first_query
         # phase report reads deltas of this
-        self._dispatch_secs_lock = threading.Lock()
+        self._dispatch_secs_lock = tracked_lock(
+            "jax.engine.JaxExecutionEngine._dispatch_secs_lock"
+        )
         self._dispatch_secs = {
             "compile": 0.0, "execute": 0.0, "disk_load": 0.0,
         }
@@ -707,7 +710,9 @@ class JaxExecutionEngine(ExecutionEngine):
         # concurrently dispatched programs with collectives can starve
         # each other's participants and deadlock. Reentrant, so a serial
         # in-thread workflow nests freely.
-        self._dispatch_lock = threading.RLock()
+        self._dispatch_lock = tracked_lock(
+            "jax.engine.JaxExecutionEngine._dispatch_lock", reentrant=True
+        )
 
     @property
     def fallbacks(self) -> Dict[str, int]:

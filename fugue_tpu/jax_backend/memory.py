@@ -93,6 +93,7 @@ from fugue_tpu.jax_backend.blocks import (
 )
 from fugue_tpu.obs.trace import begin_span, current_span
 from fugue_tpu.testing.faults import fault_point
+from fugue_tpu.testing.locktrace import tracked_lock
 
 # CPU-backend default when the platform reports no memory stats: tests
 # configure budget_fraction against a deterministic synthetic capacity
@@ -307,7 +308,9 @@ class MemoryGovernor:
 
     def __init__(self, engine: Any):
         self._engine = engine
-        self._lock = threading.RLock()
+        self._lock = tracked_lock(
+            "jax.memory.MemoryGovernor._lock", reentrant=True
+        )
         self._entries: Dict[int, _LedgerEntry] = {}
         self._seq = 0
         self._resolved = False

@@ -33,6 +33,20 @@ import math
 import threading
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
+from fugue_tpu.testing.locktrace import tracked_lock
+
+# the metric-NAME vocabulary: every family registered with a literal
+# name must fall under one of these component prefixes. The source
+# linter's FLN107 enforces it statically (a free-form name would fork
+# the dashboard namespace silently); new subsystems extend the tuple in
+# the same PR that introduces their metrics.
+METRIC_NAME_PREFIXES = (
+    "fugue_engine_",
+    "fugue_serve_",
+    "fugue_obs_",
+    "fugue_workflow_",
+)
+
 COUNTER = "counter"
 GAUGE = "gauge"
 HISTOGRAM = "histogram"
@@ -134,7 +148,7 @@ class MetricFamily:
         self.help = help
         self.labelnames = labelnames
         self._buckets = buckets
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("obs.metrics.MetricFamily._lock")
         self._children: Dict[Tuple[str, ...], Any] = {}
 
     def _make_child(self) -> Any:
@@ -221,7 +235,7 @@ class MetricsRegistry:
     Prometheus text exposition (format version 0.0.4)."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("obs.metrics.MetricsRegistry._lock")
         self._families: Dict[str, MetricFamily] = {}
         self._collectors: List[Callable[[], None]] = []
 

@@ -30,6 +30,8 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional
 
+from fugue_tpu.testing.locktrace import tracked_lock
+
 _TLS = threading.local()
 
 
@@ -144,7 +146,7 @@ class Trace:
         self.trace_id = trace_id or uuid.uuid4().hex[:16]
         self.spans: List[Span] = []
         self.root_span: Optional[Span] = None
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("obs.trace.Trace._lock")
         self._ids = itertools.count(1)
         self._open = 0
         self._exported = False

@@ -32,9 +32,10 @@ per-plan-signature bulk warm (daemon pre-warm, streamed-ingest
 first-batch warm) run once per process.
 """
 
-import threading
 from collections import OrderedDict
 from typing import Any, Dict, Optional
+
+from fugue_tpu.testing.locktrace import tracked_lock
 
 _DEFAULT_MAX_PROGRAMS = 512
 _DEFAULT_MAX_ENTRIES = 256
@@ -54,7 +55,9 @@ class PlanCache:
         max_entries: int = _DEFAULT_MAX_ENTRIES,
         max_result_bytes: int = _DEFAULT_MAX_RESULT_BYTES,
     ):
-        self._lock = threading.RLock()
+        self._lock = tracked_lock(
+            "optimize.cache.PlanCache._lock", reentrant=True
+        )
         self._max_programs = max_programs
         self._max_entries = max_entries
         self._max_result_bytes = max_result_bytes

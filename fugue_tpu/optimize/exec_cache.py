@@ -48,6 +48,8 @@ from typing import Any, Callable, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
+from fugue_tpu.testing.locktrace import tracked_lock
+
 # bump when the on-disk layout or the keying scheme changes: old entries
 # then evict to a recompile instead of deserializing garbage
 FORMAT_REV = 1
@@ -124,7 +126,7 @@ def canonical_key_token(obj: Any) -> Optional[str]:
 
 
 _FN_HASHES: "Any" = None
-_FN_HASH_LOCK = threading.Lock()
+_FN_HASH_LOCK = tracked_lock("optimize.exec_cache._FN_HASH_LOCK")
 
 
 def fn_source_hash(fn: Callable) -> str:
@@ -249,7 +251,7 @@ def args_signature(args: Tuple[Any, ...]) -> Optional[ArgsSignature]:
 
 # ---- background warm threads ------------------------------------------------
 _WARM_THREADS: List[threading.Thread] = []
-_WARM_LOCK = threading.Lock()
+_WARM_LOCK = tracked_lock("optimize.exec_cache._WARM_LOCK")
 
 
 def _join_warm_threads() -> None:
@@ -279,7 +281,7 @@ def spawn_warm_thread(target: Callable[[], Any]) -> threading.Thread:
 
 
 # ---- background persist worker ----------------------------------------------
-_WORKER_LOCK = threading.Lock()
+_WORKER_LOCK = tracked_lock("optimize.exec_cache._WORKER_LOCK")
 _WORKER: Optional[ThreadPoolExecutor] = None
 _PENDING: List[Any] = []
 

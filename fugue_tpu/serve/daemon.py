@@ -125,6 +125,12 @@ from fugue_tpu.serve.supervisor import (
 )
 from fugue_tpu.sql_frontend.workflow_sql import FugueSQLWorkflow
 from fugue_tpu.testing.faults import fault_point
+from fugue_tpu.testing.locktrace import (
+    active_sanitizer,
+    disable_lock_sanitizer,
+    maybe_enable_from_conf,
+    tracked_lock,
+)
 from fugue_tpu.utils.params import ParamDict
 
 _RESULT_YIELD = "serve_result"
@@ -174,6 +180,15 @@ class ServeDaemon:
     manager; ``start()`` binds the HTTP API and returns the daemon."""
 
     def __init__(self, conf: Any = None, engine: Any = "jax"):
+        # debug lock-order sanitizer: must arm BEFORE the engine/
+        # scheduler/session locks below are constructed so they wrap.
+        # Remember whether THIS daemon armed it — stop() disarms then,
+        # so a later same-process daemon without the flag gets plain
+        # locks again instead of reporting into a dead scope
+        self._owns_sanitizer = (
+            active_sanitizer() is None
+            and maybe_enable_from_conf(ParamDict(conf)) is not None
+        )
         self._engine = make_execution_engine(engine, ParamDict(conf))
         econf = self._engine.conf
         self._journal = make_journal(
@@ -243,7 +258,9 @@ class ServeDaemon:
         self._prewarm_thread: Optional[threading.Thread] = None
         self._restart_phases: Dict[str, Any] = {}
         self._first_query: Optional[Dict[str, Any]] = None
-        self._first_query_lock = threading.Lock()
+        self._first_query_lock = tracked_lock(
+            "serve.daemon.ServeDaemon._first_query_lock"
+        )
         # ---- observability plane (ISSUE 8) -------------------------------
         # the daemon's counters live on the ENGINE's metrics registry
         # (one registry per daemon by construction), rendered at
@@ -499,6 +516,9 @@ class ServeDaemon:
             self._sessions.close_all()
         self._engine.release()
         self._health.transition(STOPPED)
+        if self._owns_sanitizer:
+            disable_lock_sanitizer()
+            self._owns_sanitizer = False
 
     def _join_prewarm(self) -> None:
         """A stopping daemon must not leave the warm thread touching a
@@ -543,6 +563,11 @@ class ServeDaemon:
         self._sessions.shutdown()  # drops catalog copies, keeps journal
         self._engine.release()
         self._health.transition(STOPPED)
+        # even the kill path disarms an owned sanitizer: a restarted
+        # in-process daemon must not report into this dead scope
+        if self._owns_sanitizer:
+            disable_lock_sanitizer()
+            self._owns_sanitizer = False
 
     def __enter__(self) -> "ServeDaemon":
         return self.start()

@@ -41,6 +41,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from fugue_tpu.exceptions import TaskCancelledError
 from fugue_tpu.testing.faults import fault_point
+from fugue_tpu.testing.locktrace import tracked_lock
 from fugue_tpu.workflow.fault import CancelToken
 from fugue_tpu.workflow.runner import DAGRunner, TaskNode
 
@@ -102,7 +103,7 @@ class ServeJob:
         self.started_at: Optional[float] = None
         self.finished_at: Optional[float] = None
         self.done_event = threading.Event()
-        self._finish_lock = threading.Lock()
+        self._finish_lock = tracked_lock("serve.scheduler.ServeJob._finish_lock")
         # deterministic workflow uuid of the compiled DAG, set by the
         # executor once the DAG exists — the breaker's query fingerprint
         self.fingerprint: Optional[str] = None
@@ -197,7 +198,9 @@ class JobScheduler:
         self._queue: "queue.Queue[Optional[ServeJob]]" = queue.Queue()
         self._jobs: Dict[str, ServeJob] = {}
         self._order: List[str] = []  # submission order, for retention
-        self._lock = threading.RLock()
+        self._lock = tracked_lock(
+            "serve.scheduler.JobScheduler._lock", reentrant=True
+        )
         self._workers: List[threading.Thread] = []
         self._started = False
         self._draining = False

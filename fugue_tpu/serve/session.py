@@ -22,13 +22,13 @@ and the table forgotten (counted in ``integrity_rejected``), the same
 rejection manifest resume applies to checkpoints.
 """
 
-import threading
 import time
 import uuid
 from typing import Any, Dict, List, Optional
 
 from fugue_tpu.dataframe import DataFrame
 from fugue_tpu.testing.faults import fault_point
+from fugue_tpu.testing.locktrace import tracked_lock
 from fugue_tpu.utils.assertion import assert_or_throw
 from fugue_tpu.workflow.fault import engine_dispatch_guard
 from fugue_tpu.workflow.manifest import artifact_fingerprint
@@ -63,7 +63,9 @@ class ServeSession:
         self._durable: Dict[str, Dict[str, Any]] = {}
         self.integrity_rejected = 0
         self.restored = False
-        self._lock = threading.RLock()
+        self._lock = tracked_lock(
+            "serve.session.ServeSession._lock", reentrant=True
+        )
         self._closed = False
 
     @classmethod
@@ -326,7 +328,9 @@ class SessionManager:
         self._default_ttl = max(0.0, float(default_ttl))
         self._journal = journal
         self._sessions: Dict[str, ServeSession] = {}
-        self._lock = threading.RLock()
+        self._lock = tracked_lock(
+            "serve.session.SessionManager._lock", reentrant=True
+        )
 
     def create(self, ttl: Optional[float] = None) -> ServeSession:
         session = ServeSession(

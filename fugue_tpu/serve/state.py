@@ -26,11 +26,11 @@ Journal writes are best-effort: a failing write (chaos site
 is logged and counted, and serving continues.
 """
 
-import threading
 import time
 from typing import Any, Dict, Optional
 
 from fugue_tpu.testing.faults import fault_point
+from fugue_tpu.testing.locktrace import tracked_lock
 from fugue_tpu.workflow.manifest import atomic_json_write, read_json
 
 _STATE_FILE = "serve_state.json"
@@ -43,7 +43,9 @@ class ServeStateJournal:
     def __init__(self, engine: Any, base_uri: str):
         self._engine = engine
         self._base = str(base_uri).rstrip("/")
-        self._lock = threading.RLock()
+        self._lock = tracked_lock(
+            "serve.state.ServeStateJournal._lock", reentrant=True
+        )
         self._sessions: Dict[str, Dict[str, Any]] = {}
         self._jobs: Dict[str, Dict[str, Any]] = {}
         self.write_failures = 0

@@ -34,6 +34,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from fugue_tpu.testing.locktrace import tracked_lock
+
 HEALTHY = "healthy"
 DRAINING = "draining"
 STOPPED = "stopped"
@@ -102,7 +104,7 @@ class CircuitBreaker:
         self.trips = 0
         self.opened_at = 0.0
         self._probing = False      # one probe in flight while HALF_OPEN
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("serve.supervisor.CircuitBreaker._lock")
 
     def allow(self) -> None:
         """Raise when the breaker refuses this attempt; admit (and claim
@@ -165,7 +167,7 @@ class HealthState:
     """The daemon's one-way lifecycle state with drain bookkeeping."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("serve.supervisor.HealthState._lock")
         self.state = HEALTHY
         self.since = time.time()
         self.drain_deadline: Optional[float] = None  # monotonic
@@ -220,7 +222,7 @@ class EngineSupervisor:
         self.heartbeat_timeout = max(0.0, float(heartbeat_timeout))
         self._log = log
         self._breakers: Dict[str, CircuitBreaker] = {}
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("serve.supervisor.EngineSupervisor._lock")
         self.wedged_jobs = 0
         self._abandon: Optional[Callable[[Any], bool]] = None
         self._running_jobs: Callable[[], List[Any]] = list

@@ -63,8 +63,9 @@ Usage::
 
 import fnmatch
 import random
-import threading
 from contextlib import contextmanager
+
+from fugue_tpu.testing.locktrace import tracked_lock
 from typing import Any, Callable, Dict, Iterator, List, Optional, Union
 
 _ErrorLike = Union[BaseException, Callable[[], BaseException], type]
@@ -163,7 +164,7 @@ class FaultPlan:
         self.specs: List[FaultSpec] = list(specs)
         self.seed = seed
         self._rng = random.Random(seed)
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("testing.faults.FaultPlan._lock")
         self.counters: Dict[str, Dict[str, int]] = {}
 
     def add(self, spec: FaultSpec) -> "FaultPlan":
@@ -224,7 +225,7 @@ class FaultPlan:
 
 
 _ACTIVE: Optional[FaultPlan] = None
-_ACTIVE_LOCK = threading.Lock()
+_ACTIVE_LOCK = tracked_lock("testing.faults._ACTIVE_LOCK")
 
 
 def active_plan() -> Optional[FaultPlan]:

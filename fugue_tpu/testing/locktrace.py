@@ -1,0 +1,339 @@
+"""Runtime lock-order sanitizer (the dynamic half of the concurrency
+correctness plane; the static half is :mod:`fugue_tpu.analysis.codelint`).
+
+Production modules create their locks through :func:`tracked_lock`, giving
+every lock a stable dotted name (``"serve.scheduler.JobScheduler._lock"``)
+— the SAME vocabulary the source linter's FLN101 lock registry uses. With
+the sanitizer disabled (the default, and the only mode production ever
+runs), ``tracked_lock`` returns a plain ``threading.Lock``/``RLock``
+directly: **no wrapper object, no indirection, zero steady-state
+overhead** — the disabled-mode identity the test suite asserts.
+
+Enabled (conf ``fugue.debug.lock_sanitizer``, or :func:`lock_sanitizer`
+in tests), every lock created inside the scope is wrapped. At each
+acquisition the sanitizer:
+
+- tracks this thread's **held set** (names + the acquisition stack);
+- records a directed edge ``outer -> inner`` for every lock already held
+  (reentrant re-acquisition of the same lock records nothing — RLock
+  nesting is legal by construction);
+- reports an **ordering inversion** the moment an edge's reverse was
+  ever observed (by any thread), carrying BOTH acquisition stacks — the
+  site that established ``A -> B`` and the site now attempting
+  ``B -> A``;
+- reports **potential deadlock cycles** of length > 2 by walking the
+  accumulated edge graph at insertion time.
+
+Detection happens BEFORE the underlying acquire blocks, so a schedule
+that would actually deadlock still produces its report. Violations are
+recorded (and logged) rather than raised by default: the serve stress
+and chaos suites run entire scenarios under the sanitizer and assert
+``violations == []`` at the end.
+"""
+
+import logging
+import threading
+import traceback
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from fugue_tpu.constants import FUGUE_CONF_DEBUG_LOCK_SANITIZER, typed_conf_get
+
+_LOG = logging.getLogger("fugue_tpu.locktrace")
+
+_ACTIVE: Optional["LockSanitizer"] = None
+_ACTIVE_GUARD = threading.Lock()
+
+
+class LockOrderViolation:
+    """One detected hazard: an inversion (2-cycle) or a longer potential
+    deadlock cycle. Carries the acquisition stacks of BOTH sides so the
+    report names the two code sites whose nesting disagrees."""
+
+    def __init__(
+        self,
+        kind: str,
+        cycle: Tuple[str, ...],
+        thread_name: str,
+        stack: List[str],
+        other_thread_name: str,
+        other_stack: List[str],
+    ):
+        self.kind = kind  # "inversion" | "cycle"
+        self.cycle = cycle  # lock names, acquisition order of the new edge
+        self.thread_name = thread_name
+        self.stack = stack
+        self.other_thread_name = other_thread_name
+        self.other_stack = other_stack
+
+    def describe(self) -> str:
+        chain = " -> ".join(self.cycle)
+        lines = [
+            f"lock-order {self.kind}: {chain}",
+            f"  this acquisition [{self.thread_name}]:",
+            *("    " + s for s in self.stack),
+            f"  conflicting order established at [{self.other_thread_name}]:",
+            *("    " + s for s in self.other_stack),
+        ]
+        return "\n".join(lines)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"LockOrderViolation({self.kind}, {' -> '.join(self.cycle)})"
+
+
+_THIS_FILE = __file__
+
+
+def _site_stack(limit: int = 8) -> List[str]:
+    """The acquiring frames, innermost last, with this module's own
+    frames stripped (the report should point at the caller's site)."""
+    out: List[str] = []
+    for fs in traceback.extract_stack()[:-1]:
+        if fs.filename == _THIS_FILE:
+            continue
+        out.append(f"{fs.filename}:{fs.lineno} in {fs.name}")
+    return out[-limit:]
+
+
+class _Edge:
+    """First observation of ``outer -> inner``: who, and from where."""
+
+    __slots__ = ("thread_name", "stack")
+
+    def __init__(self, thread_name: str, stack: List[str]):
+        self.thread_name = thread_name
+        self.stack = stack
+
+
+class LockSanitizer:
+    """Per-scope collector: the held-set bookkeeping, the accumulated
+    lock-order graph, and the violations found."""
+
+    def __init__(self) -> None:
+        self._guard = threading.Lock()
+        self._tls = threading.local()
+        # (outer, inner) -> first-observation record
+        self._edges: Dict[Tuple[str, str], _Edge] = {}
+        self.violations: List[LockOrderViolation] = []
+        self.names: List[str] = []  # registration order, for reports
+
+    # ---- registration ----------------------------------------------------
+    def register(self, name: str) -> None:
+        with self._guard:
+            if name not in self.names:
+                self.names.append(name)
+
+    # ---- held-set bookkeeping (per thread) -------------------------------
+    def _held(self) -> List[Tuple[str, int]]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def note_acquire(self, name: str, lock_id: int) -> None:
+        """Called BEFORE the underlying acquire blocks: record edges from
+        every currently-held lock and check them against the graph.
+        Held entries key by (name, INSTANCE): only re-acquiring the SAME
+        instance is RLock reentrancy — two per-instance locks sharing a
+        class-level name (every ServeSession's ``_lock``) are peers, and
+        nesting them records the self-edge ``name -> name``, which the
+        cycle check reports immediately (peer-lock ABBA needs an ordered
+        tiebreak, not silence)."""
+        held = self._held()
+        if any(hid == lock_id for _, hid in held):
+            # reentrant re-acquisition (RLock nesting): legal, no edges
+            held.append((name, lock_id))
+            return
+        if held:
+            stack = _site_stack()
+            tname = threading.current_thread().name
+            for outer in dict.fromkeys(n for n, _ in held):
+                self._check_edge(outer, name, tname, stack)
+        held.append((name, lock_id))
+
+    def note_release(self, name: str, lock_id: int) -> None:
+        held = self._held()
+        # remove the LAST occurrence: reentrant releases unwind inner-first
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][1] == lock_id:
+                del held[i]
+                return
+
+    def note_acquire_failed(self, name: str, lock_id: int) -> None:
+        """A non-blocking/timed acquire that returned False: undo the
+        held-set push (the edges stay — the *attempted* order is real)."""
+        self.note_release(name, lock_id)
+
+    # ---- graph -----------------------------------------------------------
+    def _check_edge(
+        self, outer: str, inner: str, tname: str, stack: List[str]
+    ) -> None:
+        with self._guard:
+            key = (outer, inner)
+            if key in self._edges:
+                return  # identical-order re-acquisition: never flagged
+            rev = self._edges.get((inner, outer))
+            if rev is not None:
+                self.violations.append(
+                    LockOrderViolation(
+                        "inversion",
+                        (outer, inner, outer),
+                        tname,
+                        stack,
+                        rev.thread_name,
+                        rev.stack,
+                    )
+                )
+            else:
+                cycle = self._find_path(inner, outer)
+                if cycle is not None:
+                    # len-1 path = the degenerate self-edge (two peer
+                    # instances sharing one name nested in one thread)
+                    nxt = cycle[1] if len(cycle) > 1 else cycle[0]
+                    first_hop = self._edges.get((inner, nxt))
+                    self.violations.append(
+                        LockOrderViolation(
+                            "cycle",
+                            tuple(cycle) + (inner,),
+                            tname,
+                            stack,
+                            first_hop.thread_name if first_hop else "?",
+                            first_hop.stack if first_hop else [],
+                        )
+                    )
+            self._edges[key] = _Edge(tname, stack)
+        if self.violations and self.violations[-1].stack is stack:
+            _LOG.warning(
+                "fugue_tpu lock sanitizer: %s", self.violations[-1].describe()
+            )
+
+    def _find_path(self, src: str, dst: str) -> Optional[List[str]]:
+        """DFS over recorded edges: a path src ~> dst means adding
+        dst -> src would close a cycle. Caller holds ``_guard``."""
+        adjacency: Dict[str, List[str]] = {}
+        for a, b in self._edges:
+            adjacency.setdefault(a, []).append(b)
+        seen = {src}
+        path = [src]
+
+        def dfs(node: str) -> Optional[List[str]]:
+            if node == dst:
+                return list(path)
+            for nxt in adjacency.get(node, ()):
+                if nxt in seen:
+                    continue
+                seen.add(nxt)
+                path.append(nxt)
+                hit = dfs(nxt)
+                if hit is not None:
+                    return hit
+                path.pop()
+            return None
+
+        return dfs(src)
+
+    def report(self) -> str:
+        with self._guard:
+            violations = list(self.violations)
+        if not violations:
+            return "lock sanitizer: no ordering violations"
+        return "\n".join(v.describe() for v in violations)
+
+
+class _SanitizedLock:
+    """The wrapper a :func:`tracked_lock` call returns while a sanitizer
+    is active. Mirrors the ``threading.Lock``/``RLock`` surface the
+    codebase uses (``with``, ``acquire``/``release``)."""
+
+    __slots__ = ("_lock", "_san", "name", "reentrant")
+
+    def __init__(self, san: LockSanitizer, name: str, reentrant: bool):
+        self._lock: Any = (
+            threading.RLock() if reentrant else threading.Lock()
+        )
+        self._san = san
+        self.name = name
+        self.reentrant = reentrant
+        san.register(name)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        self._san.note_acquire(self.name, id(self))
+        ok = self._lock.acquire(blocking, timeout)
+        if not ok:
+            self._san.note_acquire_failed(self.name, id(self))
+        return ok
+
+    def release(self) -> None:
+        self._lock.release()
+        self._san.note_release(self.name, id(self))
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *args: Any) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        locked = getattr(self._lock, "locked", None)
+        return bool(locked()) if callable(locked) else False
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"_SanitizedLock({self.name!r}, reentrant={self.reentrant})"
+
+
+def tracked_lock(name: str, reentrant: bool = False) -> Any:
+    """The ONE lock constructor of the concurrency plane: production
+    modules call this instead of ``threading.Lock()``/``RLock()`` so
+    every lock carries the stable dotted name the FLN101 lock registry
+    and the sanitizer's reports share. Disabled (the default) this IS
+    ``threading.Lock()``/``RLock()`` — no wrapper, nothing retained."""
+    san = _ACTIVE
+    if san is None:
+        return threading.RLock() if reentrant else threading.Lock()
+    return _SanitizedLock(san, name, reentrant)
+
+
+def active_sanitizer() -> Optional[LockSanitizer]:
+    return _ACTIVE
+
+
+def enable_lock_sanitizer() -> LockSanitizer:
+    """Arm a process-wide sanitizer (idempotent: an already-armed one is
+    returned). Locks created while armed are wrapped; pre-existing plain
+    locks stay plain — arm BEFORE constructing the engine/daemon under
+    test."""
+    global _ACTIVE
+    with _ACTIVE_GUARD:
+        if _ACTIVE is None:
+            _ACTIVE = LockSanitizer()
+        return _ACTIVE
+
+
+def disable_lock_sanitizer() -> None:
+    global _ACTIVE
+    with _ACTIVE_GUARD:
+        _ACTIVE = None
+
+
+@contextmanager
+def lock_sanitizer() -> Iterator[LockSanitizer]:
+    """Test scope: arm the sanitizer for the block, disarm after. The
+    yielded sanitizer keeps its graph/violations readable after exit."""
+    san = enable_lock_sanitizer()
+    try:
+        yield san
+    finally:
+        disable_lock_sanitizer()
+
+
+def maybe_enable_from_conf(conf: Any) -> Optional[LockSanitizer]:
+    """Conf-driven arming (``fugue.debug.lock_sanitizer``): long-lived
+    owners (the serving daemon) call this before constructing their
+    locks. Off (the default) touches nothing and returns None."""
+    try:
+        enabled = typed_conf_get(conf, FUGUE_CONF_DEBUG_LOCK_SANITIZER)
+    except Exception:
+        enabled = False
+    if not enabled:
+        return None
+    return enable_lock_sanitizer()

@@ -36,6 +36,7 @@ from fugue_tpu.exceptions import (
 )
 from fugue_tpu.obs.trace import start_span
 from fugue_tpu.testing.faults import active_plan
+from fugue_tpu.testing.locktrace import tracked_lock
 
 TRANSIENT = "transient"
 OOM = "oom"
@@ -250,7 +251,7 @@ class RunStats:
     one run at a time. The dict read shapes are unchanged."""
 
     def __init__(self, registry: Any = None) -> None:
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("workflow.fault.RunStats._lock")
         self.retries: dict = {}
         self.recoveries: dict = {}
         self.degradations: dict = {}

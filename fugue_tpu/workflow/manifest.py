@@ -31,7 +31,6 @@ recomputes instead of loading garbage, and the rejection is counted in
 
 import hashlib
 import json
-import threading
 from typing import Any, Dict, Optional, Tuple
 
 from fugue_tpu.constants import (
@@ -39,6 +38,7 @@ from fugue_tpu.constants import (
     FUGUE_CONF_WORKFLOW_RESUME,
     typed_conf_get,
 )
+from fugue_tpu.testing.locktrace import tracked_lock
 
 
 _FINGERPRINT_CHUNK = 4 * 1024 * 1024
@@ -115,7 +115,7 @@ class RunManifest:
         self._engine = engine
         self._ckpt = checkpoint_path
         self._wf_uuid = workflow_uuid
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("workflow.manifest.RunManifest._lock")
         self._completed: Dict[str, Dict[str, Any]] = {}
         self._resumable: Dict[str, Dict[str, Any]] = {}
 

@@ -484,13 +484,65 @@ def test_fwf501_optimizer_rewrite_report():
     assert "invalid" in bad[0].message
 
 
+def test_fwf503_serve_concurrency_without_dispatch_lock():
+    # the statically-detectable precondition of the PR 6 XLA dispatch
+    # deadlock: concurrent serve submissions against an engine that
+    # does not serialize task execution
+    from fugue_tpu.execution.native_execution_engine import (
+        NativeExecutionEngine,
+    )
+    from fugue_tpu.jax_backend.execution_engine import JaxExecutionEngine
+
+    dag = FugueWorkflow()
+    dag.df([[0]], "a:int").persist()
+    native = NativeExecutionEngine()
+    assert native.task_execution_lock is None  # the hazard's premise
+    diags = [
+        d
+        for d in Analyzer().analyze(
+            dag, conf={"fugue.serve.max_concurrent": 4}, engine=native
+        )
+        if d.code == "FWF503"
+    ]
+    d = _assert_diag(diags, "FWF503", Severity.WARN, needs_callsite=False)
+    assert "task_execution_lock" in d.message
+    # max_concurrent=1 serializes at the scheduler: silent
+    assert not any(
+        d.code == "FWF503"
+        for d in Analyzer().analyze(
+            dag, conf={"fugue.serve.max_concurrent": 1}, engine=native
+        )
+    )
+    # a conf not naming the serve key is not serve-targeted: silent
+    assert not any(
+        d.code == "FWF503"
+        for d in Analyzer().analyze(dag, conf={}, engine=native)
+    )
+    # the jax engine carries a real dispatch lock: silent
+    jax_engine = JaxExecutionEngine()
+    assert jax_engine.task_execution_lock is not None
+    assert not any(
+        d.code == "FWF503"
+        for d in Analyzer().analyze(
+            dag, conf={"fugue.serve.max_concurrent": 4}, engine=jax_engine
+        )
+    )
+    # engine unknown (pure lint mode): the lock is unknowable, stay silent
+    assert not any(
+        d.code == "FWF503"
+        for d in Analyzer().analyze(
+            dag, conf={"fugue.serve.max_concurrent": 4}
+        )
+    )
+
+
 def test_every_rule_has_corpus_coverage():
     """The corpus above must track the registry: a newly registered rule
     without a fixture here fails this meta-check."""
     covered = {
         "FWF101", "FWF102", "FWF103", "FWF104", "FWF105", "FWF106",
         "FWF201", "FWF202", "FWF301", "FWF302", "FWF303", "FWF401",
-        "FWF402", "FWF403", "FWF404", "FWF501", "FWF502",
+        "FWF402", "FWF403", "FWF404", "FWF501", "FWF502", "FWF503",
     }
     assert {r.code for r in all_rules()} == covered
 

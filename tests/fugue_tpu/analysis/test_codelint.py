@@ -1,0 +1,445 @@
+"""Source-linter (FLN) gate + rule corpus.
+
+The live-tree test IS the self-enforcing gate: the shipped fugue_tpu
+package must lint to zero unbaselined FLN errors, every baseline entry
+must carry a justification AND still match a real finding (no rot).
+The fixture corpus then triggers every FLN rule with its expected
+code/severity/file:line, the same contract the FWF corpus enforces."""
+
+import pytest
+
+from fugue_tpu.analysis import Severity
+from fugue_tpu.analysis.codelint import (
+    all_source_rules,
+    apply_baseline,
+    lint_text,
+    lint_tree,
+    load_baseline,
+)
+
+pytestmark = [pytest.mark.analysis, pytest.mark.codelint]
+
+
+def _codes(diags):
+    return [d.code for d in diags]
+
+
+def _find(diags, code):
+    hits = [d for d in diags if d.code == code]
+    assert hits, f"no {code} in {_codes(diags)}"
+    return hits
+
+
+# ---------------------------------------------------------------------------
+# the self-enforcing gate
+# ---------------------------------------------------------------------------
+def test_live_tree_lints_clean_with_justified_baseline():
+    entries, problems = load_baseline()
+    assert problems == [], [str(p) for p in problems]
+    assert all(e.justification for e in entries)
+    diags = lint_tree()
+    kept, suppressed, stale = apply_baseline(diags, entries)
+    errors = [d for d in kept if d.severity is Severity.ERROR]
+    assert errors == [], "unbaselined FLN errors:\n" + "\n".join(
+        d.describe() for d in errors
+    )
+    # the baseline can only shrink: every entry still matches a finding
+    assert stale == [], [f"{e.code} {e.file}" for e in stale]
+    # and it is not a blanket waiver: each entry suppresses something real
+    assert len(suppressed) >= len(entries)
+
+
+def test_rule_registry_metadata():
+    rules = all_source_rules()
+    codes = {r.code for r in rules}
+    assert codes == {
+        "FLN101", "FLN102", "FLN103", "FLN104", "FLN105", "FLN106", "FLN107",
+    }
+    for r in rules:
+        assert r.code.startswith("FLN") and len(r.code) == 6
+        assert r.description != ""
+
+
+# ---------------------------------------------------------------------------
+# FLN101 — lock order
+# ---------------------------------------------------------------------------
+_LOCKS_FIXTURE = '''
+from fugue_tpu.testing.locktrace import tracked_lock
+
+class S:
+    def __init__(self):
+        self._sched = tracked_lock("serve.scheduler.JobScheduler._lock", reentrant=True)
+        self._sess = tracked_lock("serve.session.SessionManager._lock", reentrant=True)
+
+    def forward(self):
+        with self._sched:
+            with self._sess:
+                pass
+
+    def inverted(self):
+        with self._sess:
+            with self._sched:
+                pass
+'''
+
+
+def test_fln101_canonical_inversion_with_site():
+    diags = lint_text(_LOCKS_FIXTURE, rel="fugue_tpu/serve/fx.py")
+    hits = [
+        d
+        for d in _find(diags, "FLN101")
+        if "inverting the canonical lock order" in d.message
+    ]
+    d = hits[0]
+    assert d.severity is Severity.ERROR
+    assert d.path == "fugue_tpu/serve/fx.py"
+    assert d.line == 16  # the inner `with self._sched:` in inverted()
+    assert d.qualname == "S.inverted"
+    # the forward nesting alone is clean
+    clean = _LOCKS_FIXTURE.replace(
+        "    def inverted(self):\n"
+        "        with self._sess:\n"
+        "            with self._sched:\n"
+        "                pass\n",
+        "",
+    )
+    assert not [
+        d for d in lint_text(clean, rel="fugue_tpu/serve/fx.py")
+        if d.code == "FLN101"
+    ]
+
+
+def test_fln101_cycle_among_unregistered_locks():
+    src = (
+        "import threading\n"
+        "_A = threading.Lock()\n"
+        "_B = threading.Lock()\n"
+        "_C = threading.Lock()\n"
+        "def f():\n"
+        "    with _A:\n"
+        "        with _B: pass\n"
+        "def g():\n"
+        "    with _B:\n"
+        "        with _C: pass\n"
+        "def h():\n"
+        "    with _C:\n"
+        "        with _A: pass\n"
+    )
+    diags = _find(lint_text(src), "FLN101")
+    assert any("cycle" in d.message for d in diags)
+
+
+def test_fln101_interprocedural_edge_via_called_method():
+    src = (
+        'from fugue_tpu.testing.locktrace import tracked_lock\n'
+        "class S:\n"
+        "    def __init__(self):\n"
+        '        self._a = tracked_lock("serve.scheduler.JobScheduler._lock")\n'
+        '        self._b = tracked_lock("serve.session.SessionManager._lock")\n'
+        "    def helper(self):\n"
+        "        with self._a: pass\n"
+        "    def caller(self):\n"
+        "        with self._b:\n"
+        "            self.helper()\n"
+    )
+    diags = _find(lint_text(src), "FLN101")
+    assert any("via S.helper" in d.message for d in diags)
+
+
+# ---------------------------------------------------------------------------
+# FLN102 — thread join discipline
+# ---------------------------------------------------------------------------
+def test_fln102_unbound_thread_flagged_with_line():
+    src = (
+        "import threading\n"
+        "def fire():\n"
+        "    threading.Thread(target=print, daemon=True).start()\n"
+    )
+    d = _find(lint_text(src), "FLN102")[0]
+    assert d.severity is Severity.ERROR and d.line == 3
+    assert d.qualname == "fire"
+
+
+def test_fln102_bound_but_never_joined_flagged():
+    src = (
+        "import threading\n"
+        "class S:\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=print, daemon=True)\n"
+        "        self._t.start()\n"
+    )
+    assert _find(lint_text(src), "FLN102")
+
+
+def test_fln102_join_on_stop_passes():
+    src = (
+        "import threading\n"
+        "class S:\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=print, daemon=True)\n"
+        "        self._t.start()\n"
+        "    def stop(self):\n"
+        "        t = self._t\n"
+        "        t.join(timeout=5)\n"
+    )
+    assert not [d for d in lint_text(src) if d.code == "FLN102"]
+
+
+def test_fln102_worker_pool_loop_join_passes():
+    src = (
+        "import threading\n"
+        "class S:\n"
+        "    def start(self):\n"
+        "        self._workers = [\n"
+        "            threading.Thread(target=print) for _ in range(4)\n"
+        "        ]\n"
+        "    def stop(self):\n"
+        "        for w in self._workers:\n"
+        "            w.join(timeout=5)\n"
+    )
+    assert not [d for d in lint_text(src) if d.code == "FLN102"]
+
+
+# ---------------------------------------------------------------------------
+# FLN103 — thread-local / ContextVar restore discipline
+# ---------------------------------------------------------------------------
+def test_fln103_discarded_contextvar_token():
+    src = (
+        "from contextvars import ContextVar\n"
+        "_CV = ContextVar('cv', default=None)\n"
+        "def enter(v):\n"
+        "    _CV.set(v)\n"
+    )
+    d = _find(lint_text(src), "FLN103")[0]
+    assert "token discarded" in d.message and d.line == 4
+
+
+def test_fln103_captured_token_without_reset():
+    src = (
+        "from contextvars import ContextVar\n"
+        "_CV = ContextVar('cv', default=None)\n"
+        "def enter(v):\n"
+        "    return _CV.set(v)\n"
+    )
+    d = _find(lint_text(src), "FLN103")[0]
+    assert "never reset" in d.message
+
+
+def test_fln103_token_stack_with_reset_passes():
+    src = (
+        "from contextvars import ContextVar\n"
+        "_CV = ContextVar('cv', default=None)\n"
+        "_stack = []\n"
+        "def enter(v):\n"
+        "    _stack.append(_CV.set(v))\n"
+        "def leave():\n"
+        "    _CV.reset(_stack.pop())\n"
+    )
+    assert not [d for d in lint_text(src) if d.code == "FLN103"]
+
+
+def test_fln103_thread_local_set_without_restore():
+    src = (
+        "import threading\n"
+        "_TLS = threading.local()\n"
+        "def set_mode(m):\n"
+        "    _TLS.mode = m\n"
+    )
+    d = _find(lint_text(src), "FLN103")[0]
+    assert "_TLS.mode" in d.message and d.line == 4
+
+
+def test_fln103_finally_restore_passes():
+    src = (
+        "import threading\n"
+        "_TLS = threading.local()\n"
+        "def scoped(m):\n"
+        "    prev = getattr(_TLS, 'mode', None)\n"
+        "    _TLS.mode = m\n"
+        "    try:\n"
+        "        yield\n"
+        "    finally:\n"
+        "        _TLS.mode = prev\n"
+    )
+    assert not [d for d in lint_text(src) if d.code == "FLN103"]
+
+
+def test_fln103_enter_exit_pair_passes_and_container_init_allowed():
+    src = (
+        "import threading\n"
+        "_TLS = threading.local()\n"
+        "class CM:\n"
+        "    def __enter__(self):\n"
+        "        _TLS.span = self\n"
+        "    def __exit__(self, *a):\n"
+        "        _TLS.span = None\n"
+        "def init_stack():\n"
+        "    _TLS.stack = []\n"
+    )
+    assert not [d for d in lint_text(src) if d.code == "FLN103"]
+
+
+# ---------------------------------------------------------------------------
+# FLN104 — blocking call under a lock
+# ---------------------------------------------------------------------------
+def test_fln104_sleep_under_lock():
+    src = (
+        "import threading, time\n"
+        "_L = threading.Lock()\n"
+        "def slow():\n"
+        "    with _L:\n"
+        "        time.sleep(0.5)\n"
+        "def fine():\n"
+        "    with _L:\n"
+        "        pass\n"
+        "    time.sleep(0.5)\n"
+    )
+    hits = _find(lint_text(src), "FLN104")
+    assert len(hits) == 1 and hits[0].line == 5
+    assert "time.sleep" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# FLN105 — raw IO on engine/serve paths
+# ---------------------------------------------------------------------------
+def test_fln105_raw_open_on_serve_path_only():
+    src = (
+        "import os\n"
+        "def read(p):\n"
+        "    with open(p) as fp:\n"
+        "        return fp.read()\n"
+        "def drop(p):\n"
+        "    os.remove(p)\n"
+    )
+    diags = _find(lint_text(src, rel="fugue_tpu/serve/fx.py"), "FLN105")
+    assert {d.line for d in diags} == {3, 6}
+    assert all(d.severity is Severity.ERROR for d in diags)
+    # the fs layer itself (and other non-engine paths) may use raw IO
+    assert not [
+        d
+        for d in lint_text(src, rel="fugue_tpu/fs/local.py")
+        if d.code == "FLN105"
+    ]
+
+
+# ---------------------------------------------------------------------------
+# FLN106 — undeclared conf-key literals
+# ---------------------------------------------------------------------------
+def test_fln106_undeclared_conf_key_literal():
+    src = 'KEY = "fugue.serve.max_concurent"\n'  # typo'd literal
+    d = _find(lint_text(src), "FLN106")[0]
+    assert "fugue.serve.max_concurent" in d.message and d.line == 1
+    # declared keys and docstrings stay silent
+    ok = (
+        '"""mentions fugue.made.up.key in prose"""\n'
+        'KEY = "fugue.serve.max_concurrent"\n'
+    )
+    assert not [d for d in lint_text(ok) if d.code == "FLN106"]
+
+
+# ---------------------------------------------------------------------------
+# FLN107 — fault-site / metric-name vocabulary
+# ---------------------------------------------------------------------------
+def test_fln107_unknown_fault_site():
+    src = (
+        "from fugue_tpu.testing.faults import fault_point\n"
+        "def f(k):\n"
+        "    fault_point('serve.nonexistent', k)\n"
+        "    fault_point('serve.sweep', k)\n"
+    )
+    hits = _find(lint_text(src), "FLN107")
+    assert len(hits) == 1 and hits[0].line == 3
+    assert "serve.nonexistent" in hits[0].message
+
+
+def test_fln107_metric_name_outside_prefixes():
+    src = (
+        "def attach(metrics):\n"
+        "    metrics.counter('my_metric_total', 'help text')\n"
+        "    metrics.counter('fugue_serve_ok_total', 'help text')\n"
+    )
+    hits = _find(lint_text(src), "FLN107")
+    assert len(hits) == 1 and hits[0].line == 2
+    assert "my_metric_total" in hits[0].message
+
+
+def test_known_sites_cover_every_embedded_fault_point():
+    # the completeness direction: every fault_point(...) literal in the
+    # tree (incl. serve.sweep at serve/session.py) is in KNOWN_SITES —
+    # enforced by FLN107 linting clean over the live tree
+    from fugue_tpu.testing.faults import KNOWN_SITES
+
+    assert "serve.sweep" in KNOWN_SITES
+    diags = [d for d in lint_tree() if d.code == "FLN107"]
+    assert diags == [], [d.describe() for d in diags]
+
+
+# ---------------------------------------------------------------------------
+# CLI exit-code contract
+# ---------------------------------------------------------------------------
+def test_cli_lint_source_exit_codes(tmp_path, capsys):
+    from fugue_tpu.analysis.__main__ import main
+
+    # 0: the shipped tree with the packaged baseline
+    assert main(["--lint-source"]) == 0
+    out = capsys.readouterr().out
+    assert "source lint: 0 error(s)" in out and "baselined exception" in out
+
+    # 1: a tree with a violation and no baseline
+    bad = tmp_path / "pkg"
+    bad.mkdir()
+    (bad / "mod.py").write_text(
+        "import threading\n"
+        "threading.Thread(target=print).start()\n"
+    )
+    empty = tmp_path / "empty_baseline.json"
+    empty.write_text('{"entries": []}')
+    assert main(["--lint-source", str(bad), "--baseline", str(empty)]) == 1
+    assert "FLN102" in capsys.readouterr().out
+
+    # 1: a matching baseline entry WITHOUT a justification is an error
+    unjustified = tmp_path / "unjustified.json"
+    unjustified.write_text(
+        '{"entries": [{"code": "FLN102", "file": "pkg/mod.py",'
+        ' "context": "", "justification": ""}]}'
+    )
+    assert (
+        main(["--lint-source", str(bad), "--baseline", str(unjustified)]) == 1
+    )
+    assert "no justification" in capsys.readouterr().out
+
+    # 0: the same entry WITH a justification suppresses the finding
+    justified = tmp_path / "justified.json"
+    justified.write_text(
+        '{"entries": [{"code": "FLN102", "file": "pkg/mod.py",'
+        ' "context": "", "justification": "fixture thread"}]}'
+    )
+    assert (
+        main(["--lint-source", str(bad), "--baseline", str(justified)]) == 0
+    )
+
+    # 2: not a directory
+    assert main(["--lint-source", str(tmp_path / "missing")]) == 2
+
+
+def test_fln101_multi_item_with_statement_records_edges():
+    # `with A, B:` acquires left-to-right: the item-order edge must be
+    # checked against the canonical hierarchy even with an empty body
+    src = (
+        'from fugue_tpu.testing.locktrace import tracked_lock\n'
+        "class S:\n"
+        "    def __init__(self):\n"
+        '        self._a = tracked_lock("serve.scheduler.JobScheduler._lock")\n'
+        '        self._b = tracked_lock("serve.session.SessionManager._lock")\n'
+        "    def inverted(self):\n"
+        "        with self._b, self._a:\n"
+        "            pass\n"
+    )
+    diags = _find(lint_text(src), "FLN101")
+    assert any(
+        "inverting the canonical lock order" in d.message and d.line == 7
+        for d in diags
+    )
+    # forward item order is clean
+    ok = src.replace("self._b, self._a", "self._a, self._b")
+    assert not [d for d in lint_text(ok) if d.code == "FLN101"]

@@ -273,3 +273,31 @@ def test_serve_sites_are_in_the_known_vocabulary():
     for site in ("serve.journal", "serve.sweep", "serve.dispatch",
                  "serve.http"):
         assert site in KNOWN_SITES
+
+
+def test_chaos_run_under_lock_sanitizer_reports_no_inversions():
+    # ISSUE 12: the chaos path (injected dispatch fault + recovery on
+    # the same worker) runs with every daemon-created lock wrapped by
+    # the runtime lock-order sanitizer — the fault-handling branches
+    # must hold the same lock discipline as the happy path
+    from fugue_tpu.testing.locktrace import lock_sanitizer
+
+    conf = dict(_NO_BREAKER)
+    conf[FUGUE_CONF_SERVE_MAX_CONCURRENT] = 2
+    with lock_sanitizer() as san:
+        with ServeDaemon(conf) as daemon:
+            client = ServeClient(*daemon.address, retries=0)
+            sid = client.create_session()
+            plan = FaultPlan(
+                FaultSpec("serve.dispatch", times=1, error=OSError("chaos")),
+                seed=_SEED,
+            )
+            with inject_faults(plan):
+                snap = client.sql(sid, _tenant_create(7))
+                assert snap["status"] == "error"
+                assert plan.total("injected") == 1
+            # recovery path after the fault, same daemon
+            ok = client.sql(sid, _tenant_create(7), save_as="t")
+            assert ok["status"] == "done"
+            assert client.sql(sid, _AGG)["result"]["rows"]
+        assert san.violations == [], san.report()

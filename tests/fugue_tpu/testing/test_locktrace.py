@@ -1,0 +1,170 @@
+"""Runtime lock-order sanitizer unit contract: deterministic inversion
+detection with both stack sites, RLock reentrancy and identical-order
+acquisition unflagged, and — the hot-path guarantee — disabled mode
+returning PLAIN threading locks (no wrapper, zero overhead)."""
+
+import threading
+
+import pytest
+
+from fugue_tpu.testing.locktrace import (
+    _SanitizedLock,
+    active_sanitizer,
+    disable_lock_sanitizer,
+    lock_sanitizer,
+    maybe_enable_from_conf,
+    tracked_lock,
+)
+
+pytestmark = pytest.mark.codelint
+
+THIS_FILE = __file__
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_sanitizer():
+    yield
+    disable_lock_sanitizer()
+
+
+def _run_seq(*fns):
+    """Run each fn on its own thread, SEQUENTIALLY: the sanitizer's
+    graph persists across threads, so detection is deterministic
+    without a real interleaving (or a real deadlock)."""
+    for fn in fns:
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join(timeout=30)
+        assert not t.is_alive()
+
+
+def test_disabled_mode_returns_plain_locks_identity():
+    assert active_sanitizer() is None
+    lk = tracked_lock("x")
+    rl = tracked_lock("y", reentrant=True)
+    assert type(lk) is type(threading.Lock())
+    assert type(rl) is type(threading.RLock())
+    assert not isinstance(lk, _SanitizedLock)
+
+
+def test_two_thread_inversion_detected_with_both_stacks():
+    with lock_sanitizer() as san:
+        a = tracked_lock("test.A")
+        b = tracked_lock("test.B", reentrant=True)
+        assert isinstance(a, _SanitizedLock)
+
+        def forward():
+            with a:
+                with b:
+                    pass
+
+        def backward():
+            with b:
+                with a:
+                    pass
+
+        _run_seq(forward, backward)
+        assert len(san.violations) == 1
+        v = san.violations[0]
+        assert v.kind == "inversion"
+        assert set(v.cycle) == {"test.A", "test.B"}
+        # BOTH acquisition sites point into this test
+        assert any(THIS_FILE in line for line in v.stack)
+        assert any(THIS_FILE in line for line in v.other_stack)
+        report = san.report()
+        assert "inversion" in report and "conflicting order" in report
+    assert active_sanitizer() is None
+
+
+def test_identical_order_and_rlock_reentrancy_not_flagged():
+    with lock_sanitizer() as san:
+        a = tracked_lock("test.A")
+        b = tracked_lock("test.B", reentrant=True)
+
+        def nested_same_order():
+            with a:
+                with b:
+                    with b:  # RLock reentrancy
+                        pass
+
+        _run_seq(nested_same_order, nested_same_order)
+        assert san.violations == []
+
+
+def test_three_lock_cycle_detected():
+    with lock_sanitizer() as san:
+        a = tracked_lock("test.A")
+        b = tracked_lock("test.B")
+        c = tracked_lock("test.C")
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        def bc():
+            with b:
+                with c:
+                    pass
+
+        def ca():
+            with c:
+                with a:
+                    pass
+
+        _run_seq(ab, bc, ca)
+        assert len(san.violations) == 1
+        assert san.violations[0].kind == "cycle"
+        assert set(san.violations[0].cycle) == {"test.A", "test.B", "test.C"}
+
+
+def test_acquire_release_api_and_failed_acquire_bookkeeping():
+    with lock_sanitizer() as san:
+        a = tracked_lock("test.A")
+        assert a.acquire()
+        assert a.locked()
+        # non-blocking second acquire from ANOTHER thread fails cleanly
+        result = {}
+
+        def try_acquire():
+            result["ok"] = a.acquire(blocking=False)
+
+        _run_seq(try_acquire)
+        assert result["ok"] is False
+        a.release()
+        assert not a.locked()
+        assert san.violations == []
+
+
+def test_maybe_enable_from_conf():
+    from fugue_tpu.constants import FUGUE_CONF_DEBUG_LOCK_SANITIZER
+
+    assert maybe_enable_from_conf({}) is None
+    assert active_sanitizer() is None
+    san = maybe_enable_from_conf({FUGUE_CONF_DEBUG_LOCK_SANITIZER: True})
+    assert san is not None and active_sanitizer() is san
+    # string conf values coerce through the typed getter
+    disable_lock_sanitizer()
+    assert maybe_enable_from_conf(
+        {FUGUE_CONF_DEBUG_LOCK_SANITIZER: "false"}
+    ) is None
+
+
+def test_same_name_different_instance_nesting_is_not_reentrancy():
+    # per-instance locks share a class-level name (every ServeSession's
+    # _lock): nesting TWO instances is peer-lock ABBA territory, not
+    # RLock reentrancy — the held-set keys by instance, so the
+    # self-edge is recorded and reported
+    with lock_sanitizer() as san:
+        s1 = tracked_lock("test.Session._lock", reentrant=True)
+        s2 = tracked_lock("test.Session._lock", reentrant=True)
+
+        def cross():
+            with s1:
+                with s2:
+                    pass
+
+        _run_seq(cross)
+        assert len(san.violations) == 1
+        assert san.violations[0].kind == "cycle"
+        assert set(san.violations[0].cycle) == {"test.Session._lock"}
