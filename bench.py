@@ -1405,6 +1405,159 @@ def _config7_cold_start() -> Dict[str, Any]:
     return res
 
 
+def _config8_serving_fleet() -> Dict[str, Any]:
+    """Fleet serving scenario (ISSUE 13): aggregate qps + p99 through
+    the front-tier router at replicas=1 and replicas=2 (each replica
+    owns its own engine; both caches off so the numbers measure serving
+    EXECUTION, comparable with config 6), plus a rolling restart of the
+    2-replica fleet under a continuous client loop — reporting
+    failed_calls (the zero-drop contract) and migration_secs (the
+    journal-adoption handoff cost)."""
+    import tempfile
+    import threading as _threading
+
+    import numpy as np
+    import pandas as pd
+
+    from fugue_tpu.serve import ServeClient, ServeFleet
+
+    clients = 4
+    queries_per_client = 6
+    rows = _scale(200_000)
+    agg_sql = "SELECT k, SUM(v) AS s, COUNT(*) AS c FROM t GROUP BY k"
+    out: Dict[str, Any] = {
+        "clients": clients,
+        "queries_per_client": queries_per_client,
+        "rows_per_table": rows,
+    }
+
+    def _fleet_conf(tmp: str) -> Dict[str, Any]:
+        return {
+            "fugue.serve.state_path": tmp + "/state",
+            "fugue.serve.max_concurrent": clients,
+            "fugue.serve.breaker.threshold": 0,
+            # execution, not cache reads: both result tiers off
+            "fugue.serve.result_cache": False,
+            "fugue.serve.fleet.result_cache_dir": "",
+            "fugue.serve.fleet.health_interval": 0.1,
+            "fugue.serve.drain_timeout": 30.0,
+        }
+
+    def _setup_tenants(fleet: Any) -> list:
+        rng = np.random.default_rng(13)
+        handles = []
+        for _ in range(clients):
+            c = ServeClient([fleet.address], retries=10, timeout=600)
+            sid = c.create_session()
+            pdf = pd.DataFrame(
+                {
+                    "k": rng.integers(0, 64, rows).astype(np.int64),
+                    "v": rng.random(rows),
+                }
+            )
+            # hot-table setup + program warmup, UNMEASURED (config 6
+            # idiom): saved once via the owning replica's engine, then
+            # queried repeatedly through the router
+            rid = fleet.router.affinity()[sid]
+            daemon = fleet.replica(rid)
+            daemon.sessions.get(sid).save_table(
+                "t", daemon.engine.to_df(pdf)
+            )
+            c.sql(sid, agg_sql)  # warm the compiled programs
+            handles.append((c, sid))
+        return handles
+
+    def _qps_block(n_replicas: int) -> Dict[str, Any]:
+        tmp = tempfile.mkdtemp(prefix="fugue_fleet_bench_")
+        res: Dict[str, Any] = {"replicas": n_replicas}
+        latencies: list = []
+        errors: list = []
+        lat_lock = _threading.Lock()
+        with ServeFleet(_fleet_conf(tmp), replicas=n_replicas) as fleet:
+            handles = _setup_tenants(fleet)
+
+            def one_client(c: Any, sid: str) -> None:
+                try:
+                    mine = []
+                    for _ in range(queries_per_client):
+                        t0 = time.perf_counter()
+                        r = c.sql(sid, agg_sql)
+                        mine.append((time.perf_counter() - t0) * 1000.0)
+                        if r["status"] != "done":
+                            errors.append(r.get("error"))
+                    with lat_lock:
+                        latencies.extend(mine)
+                except Exception as ex:  # pragma: no cover - in json
+                    errors.append(repr(ex))
+
+            threads = [
+                _threading.Thread(target=one_client, args=h)
+                for h in handles
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            res["sessions_per_replica"] = fleet.router.describe()[
+                "sessions_per_replica"
+            ]
+        total = clients * queries_per_client
+        res["errors"] = errors
+        res["queries"] = total
+        res["wall_secs"] = round(wall, 4)
+        res["queries_per_sec"] = (
+            round(total / wall, 2) if wall > 0 else 0.0
+        )
+        if latencies:
+            res["p50_ms"] = round(float(np.percentile(latencies, 50)), 2)
+            res["p99_ms"] = round(float(np.percentile(latencies, 99)), 2)
+        return res
+
+    def _rolling_restart_block() -> Dict[str, Any]:
+        tmp = tempfile.mkdtemp(prefix="fugue_fleet_bench_rr_")
+        res: Dict[str, Any] = {"replicas": 2}
+        stop = _threading.Event()
+        failed: list = []
+        completed: list = []
+        with ServeFleet(_fleet_conf(tmp), replicas=2) as fleet:
+            handles = _setup_tenants(fleet)
+
+            def loop(c: Any, sid: str) -> None:
+                while not stop.is_set():
+                    try:
+                        r = c.sql(sid, agg_sql)
+                        (completed if r["status"] == "done" else failed
+                         ).append(sid)
+                    except Exception as ex:  # pragma: no cover
+                        failed.append(repr(ex))
+                    time.sleep(0.01)
+
+            threads = [
+                _threading.Thread(target=loop, args=h) for h in handles
+            ]
+            for t in threads:
+                t.start()
+            time.sleep(0.5)  # continuous load established
+            stats = fleet.rolling_restart()
+            time.sleep(0.5)  # ...and keeps flowing on the fresh fleet
+            stop.set()
+            for t in threads:
+                t.join(timeout=60)
+        res["failed_calls"] = len(failed)
+        res["completed_calls"] = len(completed)
+        res["migrated_sessions"] = stats["migrated_sessions"]
+        res["migration_secs"] = stats["migration_secs"]
+        res["restart_secs"] = stats["secs"]
+        return res
+
+    out["replicas_1"] = _qps_block(1)
+    out["replicas_2"] = _qps_block(2)
+    out["rolling_restart"] = _rolling_restart_block()
+    return out
+
+
 def _bench() -> Dict[str, Any]:
     headline = _bench_headline()
     configs = {
@@ -1416,6 +1569,7 @@ def _bench() -> Dict[str, Any]:
         "5_e2e_parquet": _config5_e2e_parquet(),
         "6_serving_daemon": _config6_serving_daemon(),
         "7_cold_start": _config7_cold_start(),
+        "8_serving_fleet": _config8_serving_fleet(),
     }
     headline["detail"]["configs"] = configs
     return headline

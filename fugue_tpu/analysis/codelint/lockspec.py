@@ -24,7 +24,12 @@ place declaring what CHANGES.md used to carry as prose.
 # up into serve locks. Leaf bookkeeping locks (metrics, faults, stats)
 # come last: they are acquired everywhere and may never hold anything.
 CANONICAL_LOCK_ORDER = (
-    # serve plane (outermost: owns requests and jobs)
+    # fleet plane (outermost: the router owns replicas and affinity;
+    # it reaches replicas over HTTP only, never into their locks —
+    # failover serializes above the routing map)
+    "serve.fleet.FleetRouter._failover_lock",
+    "serve.fleet.FleetRouter._lock",
+    # serve plane (owns requests and jobs)
     "serve.daemon.ServeDaemon._first_query_lock",
     "serve.scheduler.JobScheduler._lock",
     "serve.session.SessionManager._lock",
@@ -34,6 +39,9 @@ CANONICAL_LOCK_ORDER = (
     "serve.supervisor.CircuitBreaker._lock",
     "serve.supervisor.HealthState._lock",
     "serve.state.ServeStateJournal._lock",
+    # the ONE lock journal IO may run under (see baseline.json FLN104):
+    # state locks snapshot above it, nothing is acquired below it
+    "serve.state.SnapshotWriter._lock",
     # engine plane
     "execution.engine._GLOBAL_LOCK",
     "execution.engine.ExecutionEngine._ctx_lock",
@@ -67,7 +75,13 @@ ENGINE_FS_PATHS = (
 )
 
 # dotted-call prefixes that block (IO, sleep, network, subprocess):
-# forbidden while holding any registered lock (FLN104)
+# forbidden while holding any registered lock (FLN104). The engine-fs
+# JSON/IO helpers (workflow/manifest.py) are listed by bare name: they
+# stream through shared/remote filesystems, so calling one under a
+# request-path lock stalls every thread queued on it behind a slow
+# mount — exactly the journal-write shape ISSUE 13 removed from
+# ServeStateJournal (snapshot under the state lock, write through the
+# dedicated SnapshotWriter outside it).
 BLOCKING_CALLS = (
     "time.sleep",
     "open",
@@ -77,4 +91,7 @@ BLOCKING_CALLS = (
     "subprocess.",
     "os.system",
     "http.client.",
+    "atomic_json_write",
+    "read_json",
+    "artifact_fingerprint",
 )

@@ -17,6 +17,7 @@ from fugue_tpu.analysis.diagnostics import (
 from fugue_tpu.constants import (
     FUGUE_CONF_OBS_ENABLED,
     FUGUE_CONF_OBS_TRACE_PATH,
+    FUGUE_CONF_SERVE_FLEET_REPLICAS,
     FUGUE_CONF_SERVE_MAX_CONCURRENT,
     FUGUE_CONF_SERVE_STATE_PATH,
     FUGUE_CONF_WORKFLOW_RESUME,
@@ -168,6 +169,52 @@ class ServeConcurrencyDispatchLockRule(Rule):
                 "deadlock (the PR 6 shared-engine hazard) — serve "
                 "through an engine that serializes task execution, or "
                 "set fugue.serve.max_concurrent=1",
+            )
+
+
+@register_rule
+class FleetSharedStateRule(Rule):
+    code = "FWF504"
+    severity = Severity.WARN
+    description = (
+        "fleet conf with replicas > 1 but no shared serve state path "
+        "or no shared executable cache dir: failover and cross-replica "
+        "warm starts silently degrade"
+    )
+
+    def check(self, ctx: Any) -> Iterable[Diagnostic]:
+        if FUGUE_CONF_SERVE_FLEET_REPLICAS not in ctx.conf:
+            return
+        raw = ctx.conf[FUGUE_CONF_SERVE_FLEET_REPLICAS]
+        try:
+            replicas = _convert(raw, int)
+        except Exception:
+            return  # FWF202 already rejects the unconvertible value
+        if replicas <= 1:
+            return
+        state_path = str(
+            ctx.conf.get(FUGUE_CONF_SERVE_STATE_PATH, "") or ""
+        ).strip()
+        if state_path == "":
+            yield self.diag(
+                f"fugue.serve.fleet.replicas={replicas} but no shared "
+                "fugue.serve.state_path: the per-replica journals under "
+                "it are what a survivor adopts on replica death or a "
+                "rolling-restart drain — without one, failover has "
+                "nothing to migrate and every session dies with its "
+                "replica",
+            )
+        # the SAME resolution run() and the engine use (new key, then
+        # the deprecated alias), so this gate and FWF502 cannot drift
+        from fugue_tpu.optimize.exec_cache import resolve_cache_dir
+
+        if resolve_cache_dir(ctx.conf) == "":
+            yield self.diag(
+                f"fugue.serve.fleet.replicas={replicas} but no shared "
+                "fugue.optimize.cache.dir: every replica (and every "
+                "rolling-restart fresh daemon) re-pays full XLA "
+                "compilation instead of warm-starting from the fleet's "
+                "shared executable cache",
             )
 
 

@@ -70,6 +70,12 @@ FUGUE_CONF_SERVE_HEARTBEAT_TIMEOUT = "fugue.serve.heartbeat_timeout"
 FUGUE_CONF_SERVE_JOB_TTL = "fugue.serve.job_ttl"
 FUGUE_CONF_SERVE_CLIENT_RETRIES = "fugue.serve.client.retries"
 FUGUE_CONF_SERVE_PREWARM = "fugue.serve.prewarm"
+FUGUE_CONF_SERVE_FLEET_REPLICAS = "fugue.serve.fleet.replicas"
+FUGUE_CONF_SERVE_FLEET_HOST = "fugue.serve.fleet.host"
+FUGUE_CONF_SERVE_FLEET_PORT = "fugue.serve.fleet.port"
+FUGUE_CONF_SERVE_FLEET_HEALTH_INTERVAL = "fugue.serve.fleet.health_interval"
+FUGUE_CONF_SERVE_FLEET_DEATH_THRESHOLD = "fugue.serve.fleet.death_threshold"
+FUGUE_CONF_SERVE_FLEET_RESULT_CACHE_DIR = "fugue.serve.fleet.result_cache_dir"
 FUGUE_CONF_OPTIMIZE = "fugue.optimize"
 FUGUE_CONF_OPTIMIZE_CSE = "fugue.optimize.cse"
 FUGUE_CONF_OPTIMIZE_FILTER = "fugue.optimize.filter_pushdown"
@@ -527,6 +533,61 @@ def _declare_defaults() -> None:
         True,
         "pre-load persistent-cached executables at daemon start before "
         "/v1/health reports ready",
+        in_defaults=False,
+    )
+    # serving fleet (fugue_tpu/serve/fleet.py): a front-tier router
+    # spreading sessions across N daemon replicas with journal-based
+    # migration — on replica death (or a planned drain for a rolling
+    # restart) a survivor adopts the dead replica's journal, so sessions
+    # and fingerprint-verified hot tables move without losing committed
+    # saves. Replicas must share fugue.serve.state_path (and ideally the
+    # fugue.optimize.cache.dir executable cache) — FWF504 warns when a
+    # multi-replica conf lacks either.
+    r(
+        FUGUE_CONF_SERVE_FLEET_REPLICAS,
+        int,
+        0,
+        "daemon replicas a ServeFleet runs behind the router (0/1 = "
+        "single-daemon serving, no fleet)",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_SERVE_FLEET_HOST,
+        str,
+        "127.0.0.1",
+        "bind host of the fleet router's HTTP front tier",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_SERVE_FLEET_PORT,
+        int,
+        0,
+        "fleet router HTTP port (0 = ephemeral)",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_SERVE_FLEET_HEALTH_INTERVAL,
+        float,
+        1.0,
+        "seconds between the router's /v1/health polls of each replica",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_SERVE_FLEET_DEATH_THRESHOLD,
+        int,
+        3,
+        "consecutive health-poll/forward transport failures before the "
+        "router declares a replica dead and fails its sessions over",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_SERVE_FLEET_RESULT_CACHE_DIR,
+        str,
+        "",
+        "dir/URI (via engine.fs) of the fleet's cross-replica result "
+        "cache for pure queries, keyed by DAG fingerprint + table "
+        "artifact sha256s ('' = off; ServeFleet defaults it under the "
+        "shared state path)",
         in_defaults=False,
     )
     # cost-based DAG optimizer (fugue_tpu/optimize): the rewrite phase

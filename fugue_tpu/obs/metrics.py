@@ -43,6 +43,7 @@ from fugue_tpu.testing.locktrace import tracked_lock
 METRIC_NAME_PREFIXES = (
     "fugue_engine_",
     "fugue_serve_",
+    "fugue_fleet_",
     "fugue_obs_",
     "fugue_workflow_",
 )

@@ -27,8 +27,13 @@ Quick start::
         client.close_session(sid)
 """
 
-from fugue_tpu.serve.client import ServeAPIError, ServeClient
+from fugue_tpu.serve.client import (
+    ServeAPIError,
+    ServeClient,
+    ServeJobTimeoutError,
+)
 from fugue_tpu.serve.daemon import ServeDaemon
+from fugue_tpu.serve.fleet import FleetRouter, ServeFleet
 from fugue_tpu.serve.scheduler import JobScheduler, ServeJob
 from fugue_tpu.serve.session import ServeSession, SessionManager
 from fugue_tpu.serve.state import ServeStateJournal
@@ -48,10 +53,13 @@ __all__ = [
     "CircuitBreaker",
     "CircuitOpenError",
     "EngineSupervisor",
+    "FleetRouter",
     "PoisonQueryError",
     "ServeAPIError",
     "ServeClient",
     "ServeDaemon",
+    "ServeFleet",
+    "ServeJobTimeoutError",
     "ServeStateJournal",
     "SessionBusyError",
     "JobScheduler",

@@ -10,11 +10,24 @@ the workflow fault classifier's triage through
 the server's ``Retry-After`` header over the local backoff schedule.
 Deterministic failures (404s, structured job errors, 400s) fail fast.
 The budget comes from ``fugue.serve.client.retries`` (the registered
-default; per-client override via the ``retries`` argument). Retries are
-at-least-once: a connection that dies after the request was sent may
-replay a submission — the daemon's saves are overwrite-mode idempotent,
-but set ``retries=0`` for flows where a duplicate submit is worse than
-a failed call.
+default; per-client override via the ``retries`` argument).
+
+**Multi-endpoint failover** (ISSUE 13): the client accepts a LIST of
+``(host, port)`` endpoints — a fleet's replicas, or its router plus a
+fallback — and ROTATES to the next endpoint instead of re-hammering one
+when an attempt dies on the transport (connection refused/reset: the
+endpoint is gone or restarting) or answers 503 (draining replica,
+backpressure — another replica may have headroom). 429 stays on the
+same endpoint: a per-session cap follows the session wherever it lives.
+The rotation spends the SAME bounded retry budget and still honors
+``Retry-After``; a single-endpoint client behaves exactly as before.
+
+Retries are **at-least-once**: a connection that dies after the request
+was sent may replay a submission — and a failed-over submit may land on
+a replica that adopts the job the first replica already journaled. The
+daemon's saves are overwrite-mode idempotent and job ids are stable
+across failover, so duplicates converge; set ``retries=0`` for flows
+where a duplicate submit is worse than a failed call.
 """
 
 import json
@@ -22,14 +35,20 @@ import random
 import time
 import urllib.error
 import urllib.request
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
-from fugue_tpu.constants import FUGUE_CONF_SERVE_CLIENT_RETRIES, conf_default
+from fugue_tpu.constants import (
+    FUGUE_CONF_SERVE_CLIENT_RETRIES,
+    FUGUE_CONF_SERVE_SYNC_WAIT,
+    conf_default,
+)
 from fugue_tpu.rpc.http import (
     _is_transient_transport_error,
     backoff_delay,
     parse_retry_after,
 )
+
+_TERMINAL = ("done", "error", "cancelled")
 
 
 class ServeAPIError(RuntimeError):
@@ -53,15 +72,55 @@ class ServeAPIError(RuntimeError):
         )
 
 
-class ServeClient:
+class ServeJobTimeoutError(TimeoutError):
+    """:meth:`ServeClient.wait` gave up on a job that did not reach a
+    terminal state within its deadline. Structured: carries the job id,
+    the deadline, and the job's last observed snapshot (still
+    queued/running), so a caller can keep polling, cancel, or alert —
+    instead of hanging forever on a lost job id."""
+
     def __init__(
         self,
-        host: str,
-        port: int,
+        job_id: str,
+        deadline: float,
+        last_snapshot: Optional[Dict[str, Any]] = None,
+    ):
+        self.job_id = job_id
+        self.deadline = deadline
+        self.last_snapshot = dict(last_snapshot or {})
+        status = self.last_snapshot.get("status", "unknown")
+        super().__init__(
+            f"job {job_id} did not finish within {deadline:.1f}s "
+            f"(last status: {status})"
+        )
+
+
+EndpointArg = Union[str, Sequence[Tuple[str, int]]]
+
+
+class ServeClient:
+    """``ServeClient(host, port)`` talks to one daemon (or a fleet
+    router); ``ServeClient([(h1, p1), (h2, p2)])`` failovers across
+    endpoints (see module docstring for the rotation + at-least-once
+    semantics)."""
+
+    def __init__(
+        self,
+        host: EndpointArg,
+        port: Optional[int] = None,
         timeout: float = 120.0,
         retries: Optional[int] = None,
     ):
-        self._base = f"http://{host}:{port}"
+        if isinstance(host, (list, tuple)) and port is None:
+            endpoints = [(str(h), int(p)) for h, p in host]
+            if not endpoints:
+                raise ValueError("endpoint list must not be empty")
+        else:
+            if port is None:
+                raise ValueError("port is required with a single host")
+            endpoints = [(str(host), int(port))]
+        self._endpoints: List[Tuple[str, int]] = endpoints
+        self._current = 0
         self._timeout = timeout
         self._retries = max(
             0,
@@ -72,6 +131,17 @@ class ServeClient:
             ),
         )
 
+    @property
+    def endpoints(self) -> List[Tuple[str, int]]:
+        return list(self._endpoints)
+
+    @property
+    def current_endpoint(self) -> Tuple[str, int]:
+        return self._endpoints[self._current]
+
+    def _rotate(self) -> None:
+        self._current = (self._current + 1) % len(self._endpoints)
+
     def _call(
         self,
         method: str,
@@ -80,18 +150,39 @@ class ServeClient:
     ) -> Dict[str, Any]:
         rng = random.Random()
         attempt = 0
+        start = self._current
         while True:
             attempt += 1
             try:
                 return self._call_once(method, path, payload)
             except Exception as ex:
+                status = ex.status if isinstance(ex, ServeAPIError) else None
                 transient = (
-                    ex.status in (503, 429)
-                    if isinstance(ex, ServeAPIError)
+                    status in (503, 429)
+                    if status is not None
                     else _is_transient_transport_error(ex)
                 )
-                if attempt > self._retries or not transient:
+                # a 404 AFTER a rotation is usually the WRONG REPLICA
+                # (the session lives elsewhere), not a verdict: keep
+                # rotating through the budget instead of fail-fasting
+                # on — and then sticking to — a replica that never
+                # owned the session
+                wrong_replica = status == 404 and self._current != start
+                if attempt > self._retries or not (
+                    transient or wrong_replica
+                ):
+                    if self._current != start and status == 404:
+                        # never WEDGE on a foreign replica: later calls
+                        # should start from the session's last-good one
+                        self._current = start
                     raise
+                # failover rotation: a transport death, a 503 (drain,
+                # backpressure) or a wrong-replica 404 sends the next
+                # attempt to the next endpoint; 429 (per-session cap)
+                # retries in place — the session's jobs live on one
+                # replica regardless
+                if len(self._endpoints) > 1 and status != 429:
+                    self._rotate()
                 # retry_after is already parse_retry_after-capped
                 time.sleep(
                     backoff_delay(
@@ -110,8 +201,9 @@ class ServeClient:
             if payload is not None
             else None
         )
+        host, port = self._endpoints[self._current]
         req = urllib.request.Request(
-            self._base + path, data=data, method=method,
+            f"http://{host}:{port}" + path, data=data, method=method,
             headers={"Content-Type": "application/json"},
         )
         try:
@@ -199,12 +291,33 @@ class ServeClient:
     def cancel(self, job_id: str) -> Dict[str, Any]:
         return self._call("POST", f"/v1/jobs/{job_id}/cancel", {})
 
-    def wait(self, job_id: str, poll: float = 0.05) -> Dict[str, Any]:
-        """Poll an async job until it finishes; returns the snapshot."""
+    def wait(
+        self,
+        job_id: str,
+        poll: float = 0.05,
+        deadline: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """Poll an async job until it finishes; returns the snapshot.
+
+        ``deadline`` bounds the total wait in seconds — on expiry a
+        structured :class:`ServeJobTimeoutError` (job id + last
+        snapshot) is raised, so a lost job id can never hang the caller.
+        None takes the registered ``fugue.serve.sync_wait`` default (the
+        same budget the daemon gives a sync submit); <= 0 waits
+        forever (the old behavior, explicit opt-in only)."""
+        limit = float(
+            conf_default(FUGUE_CONF_SERVE_SYNC_WAIT)
+            if deadline is None
+            else deadline
+        )
+        start = time.monotonic()
+        snap: Dict[str, Any] = {}
         while True:
             snap = self.job(job_id)
-            if snap["status"] in ("done", "error", "cancelled"):
+            if snap["status"] in _TERMINAL:
                 return snap
+            if limit > 0 and time.monotonic() - start >= limit:
+                raise ServeJobTimeoutError(job_id, limit, snap)
             time.sleep(poll)
 
     # ---- daemon ----------------------------------------------------------

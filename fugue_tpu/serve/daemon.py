@@ -75,6 +75,7 @@ from contextlib import nullcontext
 from typing import Any, Dict, Optional, Tuple
 
 from fugue_tpu.constants import (
+    FUGUE_CONF_SERVE_FLEET_RESULT_CACHE_DIR,
     FUGUE_CONF_SERVE_PREWARM,
     FUGUE_CONF_SERVE_RESULT_CACHE,
     FUGUE_CONF_SERVE_BREAKER_COOLDOWN,
@@ -114,7 +115,7 @@ from fugue_tpu.serve.scheduler import (
     ServeJob,
 )
 from fugue_tpu.serve.session import ServeSession, SessionManager
-from fugue_tpu.serve.state import make_journal
+from fugue_tpu.serve.state import ServeStateJournal, make_journal
 from fugue_tpu.serve.supervisor import (
     AdmissionError,
     BackpressureError,
@@ -308,12 +309,34 @@ class ServeDaemon:
         self._result_cache_on = bool(
             typed_conf_get(econf, FUGUE_CONF_SERVE_RESULT_CACHE)
         )
+        # fleet tier (ISSUE 13): an fs-backed result cache shared by
+        # every replica, keyed by the DAG fingerprint + the session
+        # tables' artifact sha256s — content-addressed, so a migrated
+        # (or merely content-identical) session warm-starts on ANY
+        # replica without re-executing
+        self._fleet_result_dir = str(
+            typed_conf_get(econf, FUGUE_CONF_SERVE_FLEET_RESULT_CACHE_DIR)
+            or ""
+        ).strip()
+        if self._fleet_result_dir:
+            try:
+                self._engine.fs.makedirs(
+                    self._fleet_result_dir, exist_ok=True
+                )
+            except Exception:
+                self._engine.log.warning(
+                    "fugue_tpu serve: fleet result-cache dir %s is not "
+                    "writable; cross-replica result cache disabled",
+                    self._fleet_result_dir,
+                )
+                self._fleet_result_dir = ""
         self._m_result_cache = metrics.counter(
             "fugue_serve_result_cache_total",
             "cross-request query result cache lookups by result",
             ["result"],
         )
-        for kind in ("hit", "miss"):
+        for kind in ("hit", "miss", "fs_hit", "fs_miss", "fs_store",
+                     "fs_error"):
             self._m_result_cache.labels(result=kind)
         # registry counters are process-monotonic (Prometheus
         # semantics), but status()'s dict shapes are DAEMON-scoped like
@@ -456,7 +479,24 @@ class ServeDaemon:
         self._recovery["sessions"] = self._sessions.restore(
             data.get("sessions") or {}
         )
-        for jid, rec in sorted((data.get("jobs") or {}).items()):
+        resubmitted, failed_over = self._resubmit_journaled_jobs(
+            data.get("jobs") or {}, import_into_journal=False
+        )
+        self._recovery["jobs_resubmitted"] += resubmitted
+        self._recovery["jobs_failed_over"] += failed_over
+
+    def _resubmit_journaled_jobs(
+        self, jobs: Dict[str, Dict[str, Any]], import_into_journal: bool
+    ) -> Tuple[int, int]:
+        """Resubmit interrupted journaled jobs under their ORIGINAL ids
+        (idempotent: saves are overwrite-mode); jobs whose session did
+        not survive fail over with a structured error a poller can read.
+        ``import_into_journal`` (the fleet-adoption path) records each
+        resubmitted job into THIS daemon's journal first — restart
+        recovery skips that, its jobs are already journaled here.
+        Returns (resubmitted, failed_over)."""
+        resubmitted = failed_over = 0
+        for jid, rec in sorted(jobs.items()):
             job = ServeJob(
                 rec.get("session_id", ""),
                 rec.get("sql", ""),
@@ -470,8 +510,28 @@ class ServeDaemon:
             job.recovered = True
             try:
                 self._sessions.get(job.session_id)
+                if import_into_journal:
+                    self._journal.record_job(job)
                 self._scheduler.submit(job)
-                self._recovery["jobs_resubmitted"] += 1
+                resubmitted += 1
+            except AdmissionError as ex:
+                # this daemon started draining mid-loop. Do NOT
+                # terminalize the job ("session did not survive" would
+                # be a lie) and do NOT abort the pass — the sessions
+                # are already adopted here, so aborting would let the
+                # router re-adopt the same source elsewhere and
+                # double-own them. DEFER instead: the job record is
+                # (or stays) in THIS journal, and the failover that
+                # follows this daemon's drain migrates it onward with
+                # the sessions it belongs to.
+                if import_into_journal:
+                    self._journal.record_job(job)
+                self._engine.log.warning(
+                    "fugue_tpu serve: job %s deferred during "
+                    "recovery/adoption (%s); it rides the next "
+                    "failover of this daemon's journal",
+                    jid, ex,
+                )
             except Exception as ex:
                 job.error = structured_error(
                     KeyError(
@@ -483,7 +543,62 @@ class ServeDaemon:
                 job.finish(ERROR)
                 self._scheduler.adopt(job)
                 self._journal.finish_job(jid)
-                self._recovery["jobs_failed_over"] += 1
+                failed_over += 1
+        return resubmitted, failed_over
+
+    def adopt_state(self, state_path: str) -> Dict[str, Any]:
+        """Fleet failover/handoff hook (``POST /v1/admin/adopt``): adopt
+        a dead or drained replica's journaled state. Its unexpired
+        sessions rehydrate HERE under their original ids (hot tables
+        reload lazily from the shared-fs artifacts after fingerprint
+        verification — the adoption analog of restart recovery), its
+        interrupted async jobs resubmit under their original job ids,
+        and the source journal is atomically emptied so a restarted
+        origin replica cannot double-own the moved sessions."""
+        if self._journal is None:
+            raise ValueError(
+                "this daemon has no state journal "
+                "(fugue.serve.state_path); it cannot adopt replica state"
+            )
+        if not self._health.healthy:
+            raise BackpressureError(
+                f"daemon is {self._health.state}; not adopting sessions",
+                retry_after=1.0,
+            )
+        base = str(state_path or "").strip()
+        if base == "" or base.rstrip("/") == self._journal.base_uri:
+            raise ValueError(f"invalid adoption source {state_path!r}")
+        fs = self._engine.fs
+        data = ServeStateJournal.read_state(fs, base, log=self._engine.log)
+        adopted, expired = self._sessions.adopt(data["sessions"])
+        resubmitted, failed_over = self._resubmit_journaled_jobs(
+            data["jobs"], import_into_journal=True
+        )
+        source_cleared = True
+        try:
+            ServeStateJournal.clear_state(fs, base)
+        except Exception as ex:
+            source_cleared = False
+            # the adoption stands; a not-cleared source is logged loudly
+            # because a restarted origin replica would double-own
+            self._engine.log.warning(
+                "fugue_tpu serve: adopted state from %s but could not "
+                "clear the source journal (%s: %s) — do not restart the "
+                "origin replica against it",
+                base, type(ex).__name__, ex,
+            )
+        self._recovery["jobs_resubmitted"] += resubmitted
+        self._recovery["jobs_failed_over"] += failed_over
+        return {
+            "sessions": adopted,
+            "expired_sessions": expired,
+            "jobs_resubmitted": resubmitted,
+            "jobs_failed_over": failed_over,
+            # False = the origin journal still holds the moved state:
+            # the operator/fleet must clear it before restarting the
+            # origin replica, or it double-owns the sessions
+            "source_cleared": source_cleared,
+        }
 
     def stop(self, drain: bool = False) -> None:
         """Stop serving. ``drain=False`` (default) keeps PR 6 semantics:
@@ -577,12 +692,7 @@ class ServeDaemon:
 
     # ---- operations (HTTP routes call these; tests/benches may too) ------
     def create_session(self, ttl: Optional[float] = None) -> ServeSession:
-        if not self._health.healthy:
-            self._count_reject("draining")
-            raise BackpressureError(
-                f"daemon is {self._health.state}; not accepting sessions",
-                retry_after=max(1.0, self._health.drain_remaining()),
-            )
+        self._reject_if_unhealthy()
         return self._sessions.create(ttl=ttl)
 
     def close_session(self, session_id: str) -> Dict[str, Any]:
@@ -638,16 +748,24 @@ class ServeDaemon:
             else 0.0
         )
 
-    def _admit(self, session_id: str) -> None:
-        """Admission control for one submission; raises an
-        :class:`AdmissionError` subtype (503/429 + Retry-After) when the
-        daemon must shed load instead of queueing it."""
+    def _reject_if_unhealthy(self) -> None:
+        """503 + Retry-After while draining/stopping. Checked BEFORE the
+        session lookup too: a stopping daemon tears sessions down while
+        the health state is still draining, and a racing submission must
+        see the retryable rejection, never a fail-fast 404."""
         if not self._health.healthy:
             self._count_reject("draining")
             raise BackpressureError(
                 f"daemon is {self._health.state}; not accepting submissions",
                 retry_after=max(1.0, self._health.drain_remaining()),
             )
+
+    def _admit(self, session_id: str) -> None:
+        """Admission control for one submission; raises an
+        :class:`AdmissionError` subtype (503/429 + Retry-After) when the
+        daemon must shed load instead of queueing it. The caller has
+        already passed :meth:`_reject_if_unhealthy` (before its session
+        lookup), so this starts at the load signals."""
         if self._max_queue > 0 and self._scheduler.backlog() >= self._max_queue:
             self._count_reject("queue_full")
             raise BackpressureError(
@@ -691,6 +809,7 @@ class ServeDaemon:
         limit: int = 10_000,
         request_id: Optional[str] = None,
     ) -> ServeJob:
+        self._reject_if_unhealthy()
         self._sessions.get(session_id)  # 404 early + touches the session
         self._admit(session_id)
         job = ServeJob(
@@ -896,6 +1015,12 @@ class ServeDaemon:
         # this job's payload land under the OLD epoch (never served
         # again), not under the new one with pre-save data
         cache_epoch = session.cache_epoch
+        # content keys snapshot with the epoch: a save racing this job
+        # leaves the payload under the PRE-save keys (equivalent to the
+        # job having run just before the save), never the new ones
+        pre_content_keys = (
+            session.table_content_keys() if self._fleet_result_dir else None
+        )
         sources = session.table_frames()
         try:
             dag._sql(job.sql, {}, **sources)
@@ -923,8 +1048,9 @@ class ServeDaemon:
         # session's same-shaped tables or a post-save resubmission can
         # never be served the wrong payload.
         cache_key = None
+        fleet_cache_uri = None
         if (
-            self._result_cache_on
+            (self._result_cache_on or self._fleet_result_dir)
             and has_result
             and job.save_as is None
             and job.collect
@@ -935,13 +1061,32 @@ class ServeDaemon:
             # session table frames only change via save_table, which
             # bumps cache_epoch in this key: frame inputs are stable
             if tasks_are_pure(dag.tasks, frame_inputs_stable=True):
-                cache_key = (
-                    "serve",
-                    job.session_id,
-                    cache_epoch,
-                    job.fingerprint,
-                    job.limit,
-                )
+                if self._result_cache_on:
+                    cache_key = (
+                        "serve",
+                        job.session_id,
+                        cache_epoch,
+                        job.fingerprint,
+                        job.limit,
+                    )
+                # fleet tier: content-addressed (DAG fingerprint + the
+                # tables' artifact sha256s), so the key is valid on ANY
+                # replica and for ANY session with identical content —
+                # the cross-replica warm-start path. Sessions with an
+                # unverifiable table (no durable artifact) are ineligible
+                if self._fleet_result_dir and pre_content_keys is not None:
+                    from fugue_tpu.utils.hash import to_uuid
+
+                    fleet_cache_uri = self._engine.fs.join(
+                        self._fleet_result_dir,
+                        to_uuid(
+                            "serve.fleet.result",
+                            job.fingerprint,
+                            str(job.limit),
+                            pre_content_keys,
+                        )
+                        + ".json",
+                    )
         if cache_key is not None:
             cached = self._plan_cache.get_result(cache_key)
             if cached is not None:
@@ -952,6 +1097,17 @@ class ServeDaemon:
                     payload["result"] = dict(payload["result"])
                 return payload
             self._m_result_cache.labels(result="miss").inc()
+        if fleet_cache_uri is not None:
+            from fugue_tpu.workflow.manifest import read_json
+
+            entry = read_json(self._engine.fs, fleet_cache_uri)
+            if isinstance(entry, dict) and isinstance(
+                entry.get("payload"), dict
+            ):
+                self._m_result_cache.labels(result="fs_hit").inc()
+                session.touch()
+                return dict(entry["payload"])
+            self._m_result_cache.labels(result="fs_miss").inc()
         if has_result:
             dag.last_df.yield_dataframe_as(_RESULT_YIELD)
         gov = getattr(self._engine, "memory_governor", None)
@@ -1011,8 +1167,48 @@ class ServeDaemon:
             self._plan_cache.put_result(
                 cache_key, stored, nbytes, tag=job.session_id
             )
+        if fleet_cache_uri is not None:
+            self._store_fleet_result(
+                session, pre_content_keys, fleet_cache_uri, payload
+            )
         session.touch()
         return payload
+
+    def _store_fleet_result(
+        self,
+        session: ServeSession,
+        pre_content_keys: Any,
+        uri: str,
+        payload: Dict[str, Any],
+    ) -> None:
+        """Best-effort store into the fleet's shared fs result cache —
+        only when the session's table content is STILL what the key was
+        computed from (a save racing the run must not publish new data
+        under the old content keys). Failures count, never raise."""
+        try:
+            if session.table_content_keys() != pre_content_keys:
+                return
+            from fugue_tpu.serve.http import dumps
+            from fugue_tpu.workflow.manifest import atomic_json_write
+
+            # json-roundtrip through the serve encoder: result cells may
+            # be numpy/temporal scalars the plain encoder rejects, and
+            # an fs entry must read back exactly like an HTTP payload
+            import json as _json
+
+            normalized = _json.loads(dumps(payload).decode("utf-8"))
+            atomic_json_write(
+                self._engine.fs, uri,
+                {"saved_at": time.time(), "payload": normalized},
+            )
+            self._m_result_cache.labels(result="fs_store").inc()
+        except Exception as ex:
+            self._m_result_cache.labels(result="fs_error").inc()
+            self._engine.log.warning(
+                "fugue_tpu serve: fleet result-cache store to %s failed "
+                "(%s: %s); serving continues",
+                uri, type(ex).__name__, ex,
+            )
 
     def _job_finished(self, job: ServeJob) -> None:
         """Scheduler ``on_finish`` observer: job-journal cleanup,
@@ -1239,6 +1435,13 @@ class ServeDaemon:
                 return 200, self.close_session(sid)
             if rest == ["sql"] and method == "POST":
                 return self._route_sql(sid, payload, request_id)
+        if route == ["admin", "adopt"] and method == "POST":
+            state_path = payload.get("state_path")
+            if not isinstance(state_path, str) or not state_path.strip():
+                raise ValueError(
+                    "payload must carry the source replica's 'state_path'"
+                )
+            return 200, {"adopted": self.adopt_state(state_path)}
         if len(route) >= 2 and route[0] == "jobs":
             jid = route[1]
             rest = route[2:]

@@ -1,0 +1,1012 @@
+"""Serving fleet (ISSUE 13): a front-tier router spreading sessions
+across N daemon replicas, with journal-based failover and rolling
+restart — the horizontal-scale composition of the already-hardened
+single-daemon pieces (ROADMAP open item 3, the Spark/Ray-Serve fleet
+role of PAPER.md §2.7/§2.10-2.11).
+
+Topology::
+
+    clients ──► FleetRouter (HTTP, HardenedRequestHandler stack)
+                   │ session affinity: sid → replica, journaled to
+                   │ <fugue.serve.state_path>/router_state.json
+                   ├──► ServeDaemon replica r0   state: <state>/replicas/r0
+                   └──► ServeDaemon replica r1   state: <state>/replicas/r1
+    shared fs:  <state>/replicas/<rid>/  (journals + table artifacts)
+                <state>/results/         (cross-replica result cache)
+                fugue.optimize.cache.dir (shared compiled executables)
+
+**Affinity & routing.** ``POST /v1/sessions`` lands on the healthy
+replica with the fewest affined sessions (round-robin tiebreak); every
+session- and job-scoped request then follows the affinity map. The map
+is journaled through the same atomic-snapshot machinery as the daemon
+journal (:class:`~fugue_tpu.serve.state.SnapshotWriter`), so a restarted
+router resumes routing existing sessions without guessing.
+
+**Health-driven replica state.** A background poller walks each
+replica's ``/v1/health``: ``healthy`` / ``warming`` (prewarm in
+progress) / ``draining`` / ``dead``. Transport failures — from the
+poller OR from per-request forwards (fault site ``serve.route``) —
+count against ``fugue.serve.fleet.death_threshold``; crossing it marks
+the replica dead and queues failover.
+
+**Journal-based migration.** Failover (replica death) and planned
+drain (rolling restart) are the SAME move: a surviving replica adopts
+the lost replica's journal via ``POST /v1/admin/adopt``
+(:meth:`~fugue_tpu.serve.daemon.ServeDaemon.adopt_state`) — sessions
+rehydrate under their original ids, hot tables reload lazily from the
+fingerprint-verified shared-fs artifacts, interrupted async jobs
+resubmit under their original job ids, and the source journal is
+emptied so the origin replica cannot double-own them. The router then
+re-points the affinity map. During the handoff window requests for the
+moving sessions answer 503 + ``Retry-After``; the
+:class:`~fugue_tpu.serve.client.ServeClient` retry/failover budget
+absorbs them, which is what makes a rolling restart under live load
+complete with zero failed client calls.
+
+**Observability.** ``GET /v1/metrics`` on the router emits the
+router's own families plus every live replica's exposition with a
+``replica="<rid>"`` label injected; ``GET /v1/status`` aggregates the
+fleet view (states, affinity counts, failovers) over the per-replica
+status payloads.
+
+:class:`ServeFleet` is the in-process composition used by tests and the
+bench: it owns the N replica daemons + the router, derives the
+per-replica state subdirectories from the shared
+``fugue.serve.state_path``, and drives
+:meth:`~ServeFleet.rolling_restart` (drain → migrate → fresh daemon →
+wait healthy, one replica at a time).
+"""
+
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+from fugue_tpu.constants import (
+    FUGUE_CONF_OPTIMIZE_CACHE_DIR,
+    FUGUE_CONF_SERVE_FLEET_DEATH_THRESHOLD,
+    FUGUE_CONF_SERVE_FLEET_HEALTH_INTERVAL,
+    FUGUE_CONF_SERVE_FLEET_HOST,
+    FUGUE_CONF_SERVE_FLEET_PORT,
+    FUGUE_CONF_SERVE_FLEET_REPLICAS,
+    FUGUE_CONF_SERVE_FLEET_RESULT_CACHE_DIR,
+    FUGUE_CONF_SERVE_PORT,
+    FUGUE_CONF_SERVE_STATE_PATH,
+    typed_conf_get,
+)
+from fugue_tpu.fs import make_default_registry
+from fugue_tpu.obs import MetricsRegistry
+from fugue_tpu.rpc.http import structured_error
+from fugue_tpu.serve.http import ServeHTTPServer, dumps
+from fugue_tpu.serve.state import SnapshotWriter
+from fugue_tpu.serve.supervisor import BackpressureError
+from fugue_tpu.testing.faults import fault_point
+from fugue_tpu.testing.locktrace import tracked_lock
+from fugue_tpu.utils.params import ParamDict
+from fugue_tpu.workflow.manifest import read_json
+
+_ROUTER_STATE_FILE = "router_state.json"
+_MAX_TRACKED_JOBS = 4096
+
+HEALTHY = "healthy"
+WARMING = "warming"
+DRAINING = "draining"
+DEAD = "dead"
+
+# one Prometheus sample line: name[{labels}] value [timestamp]
+_METRIC_LINE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(.+)$"
+)
+
+
+def relabel_exposition(text: str, replica: str) -> List[str]:
+    """Inject ``replica="<rid>"`` into every sample of a Prometheus
+    text exposition (comment lines pass through; the caller dedupes
+    HELP/TYPE across replicas)."""
+    out: List[str] = []
+    for line in text.splitlines():
+        if not line.strip() or line.startswith("#"):
+            out.append(line)
+            continue
+        m = _METRIC_LINE_RE.match(line)
+        if m is None:  # pragma: no cover - malformed exposition line
+            out.append(line)
+            continue
+        name, _, inner, value = m.groups()
+        labels = f'replica="{replica}"'
+        if inner:
+            labels = f"{labels},{inner}"
+        out.append(f"{name}{{{labels}}} {value}")
+    return out
+
+
+class _Replica:
+    """The router's view of one daemon replica."""
+
+    def __init__(self, rid: str, host: str, port: int,
+                 state_path: str = ""):
+        self.rid = rid
+        self.host = host
+        self.port = int(port)
+        # the replica's OWN journal dir on the shared fs — what a
+        # survivor adopts when this replica dies or drains away
+        self.state_path = state_path
+        self.state = WARMING
+        self.fails = 0
+        self.last_seen = 0.0
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "replica": self.rid,
+            "address": f"{self.host}:{self.port}",
+            "state": self.state,
+            "consecutive_failures": self.fails,
+            "state_path": self.state_path,
+        }
+
+
+class FleetRouter:
+    """The fleet's HTTP front tier. Duck-types the daemon contract the
+    hardened serve handler expects (``handle_api`` + ``render_metrics``)
+    so it runs on the exact same HTTP stack."""
+
+    def __init__(self, conf: Any = None):
+        conf = ParamDict(conf)
+        self._fs = make_default_registry()
+        self._base = str(
+            typed_conf_get(conf, FUGUE_CONF_SERVE_STATE_PATH) or ""
+        ).strip()
+        self._health_interval = max(
+            0.02,
+            float(typed_conf_get(conf, FUGUE_CONF_SERVE_FLEET_HEALTH_INTERVAL)),
+        )
+        self._death_threshold = max(
+            1, int(typed_conf_get(conf, FUGUE_CONF_SERVE_FLEET_DEATH_THRESHOLD))
+        )
+        # failover serializes ABOVE the routing lock: adoption talks to
+        # a replica over HTTP and must never run under _lock
+        self._failover_lock = tracked_lock(
+            "serve.fleet.FleetRouter._failover_lock", reentrant=True
+        )
+        self._lock = tracked_lock(
+            "serve.fleet.FleetRouter._lock", reentrant=True
+        )
+        self._replicas: Dict[str, _Replica] = {}
+        self._affinity: Dict[str, str] = {}   # session id -> replica id
+        self._jobs: Dict[str, str] = {}       # job id -> session id
+        self._pending_failover: List[str] = []
+        self._rr = 0
+        self._dirty = False
+        self._writer: Optional[SnapshotWriter] = None
+        if self._base:
+            self._fs.makedirs(self._base, exist_ok=True)
+            self._writer = SnapshotWriter(self._fs, self.state_uri)
+        http_conf = ParamDict(conf)
+        http_conf["fugue.rpc.http_server.host"] = typed_conf_get(
+            conf, FUGUE_CONF_SERVE_FLEET_HOST
+        )
+        http_conf["fugue.rpc.http_server.port"] = typed_conf_get(
+            conf, FUGUE_CONF_SERVE_FLEET_PORT
+        )
+        self._http = ServeHTTPServer(self, http_conf)
+        self._started = False
+        self._stop_event = threading.Event()
+        self._health_thread: Optional[threading.Thread] = None
+        self._metrics = MetricsRegistry()
+        self._m_requests = self._metrics.counter(
+            "fugue_fleet_requests_total",
+            "router HTTP requests by route family and status",
+            ["route", "status"],
+        )
+        self._m_forward_fail = self._metrics.counter(
+            "fugue_fleet_forward_failures_total",
+            "transport failures forwarding to a replica",
+            ["replica"],
+        )
+        self._m_failover = self._metrics.counter(
+            "fugue_fleet_failovers_total",
+            "journal adoptions moving sessions off a replica, by mode",
+            ["mode"],
+        )
+        for mode in ("death", "planned"):
+            self._m_failover.labels(mode=mode)
+        self._metrics.add_collector(self._collect_gauges)
+
+    # ---- lifecycle -------------------------------------------------------
+    @property
+    def state_uri(self) -> str:
+        return self._fs.join(self._base, _ROUTER_STATE_FILE)
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._http.address
+
+    def attach(
+        self, rid: str, host: str, port: int, state_path: str = ""
+    ) -> None:
+        """Register (or re-register after a restart: fresh address,
+        reset failure count, back to warming) one replica."""
+        with self._lock:
+            self._replicas[rid] = _Replica(rid, host, port, state_path)
+            if rid in self._pending_failover:
+                self._pending_failover.remove(rid)
+
+    def start(self) -> "FleetRouter":
+        if self._started:
+            return self
+        if self._writer is not None:
+            data = read_json(self._fs, self.state_uri) or {}
+            with self._lock:
+                self._affinity = dict(data.get("affinity") or {})
+                self._jobs = dict(data.get("jobs") or {})
+        self.check_health()
+        self._stop_event.clear()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True, name="fugue-fleet-health"
+        )
+        self._health_thread.start()
+        self._http.start()
+        self._started = True
+        return self
+
+    def stop(self) -> None:
+        if not self._started:
+            return
+        self._started = False
+        self._stop_event.set()
+        health_thread, self._health_thread = self._health_thread, None
+        if health_thread is not None:
+            health_thread.join(timeout=5.0)
+        self._http.stop()
+        self._journal()
+
+    def __enter__(self) -> "FleetRouter":
+        return self.start()
+
+    def __exit__(self, *args: Any) -> None:
+        self.stop()
+
+    # ---- affinity journal ------------------------------------------------
+    def _journal(self) -> None:
+        """Persist the affinity + job maps (snapshot under the routing
+        lock, ordered write outside it — same discipline as the daemon
+        journal). No-op without a state path: the router still works,
+        it just cannot resume its map after ITS OWN restart."""
+        if self._writer is None:
+            return
+        with self._lock:
+            payload = {
+                "saved_at": time.time(),
+                "affinity": dict(self._affinity),
+                "jobs": dict(self._jobs),
+            }
+            self._dirty = False
+            ticket = self._writer.ticket()
+        self._writer.write(ticket, payload)
+
+    def _maybe_journal(self) -> None:
+        if self._writer is None:
+            return
+        with self._lock:
+            if not self._dirty:
+                return
+        self._journal()
+
+    # ---- replica health --------------------------------------------------
+    def replica_state(self, rid: str) -> str:
+        with self._lock:
+            return self._replicas[rid].state
+
+    def replicas(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [r.describe() for r in self._replicas.values()]
+
+    def affinity(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._affinity)
+
+    def begin_drain(self, rid: str) -> None:
+        """Planned-migration entry (rolling restart): stop routing NEW
+        sessions at ``rid`` now; existing-session traffic keeps
+        forwarding (the draining daemon itself answers 503 +
+        Retry-After for submissions, which the client absorbs)."""
+        with self._lock:
+            replica = self._replicas.get(rid)
+            if replica is not None and replica.state != DEAD:
+                replica.state = DRAINING
+
+    def _health_loop(self) -> None:
+        while not self._stop_event.wait(self._health_interval):
+            try:
+                self.check_health()
+                self._run_pending_failovers()
+                self._maybe_journal()
+            except Exception:  # pragma: no cover - poller must survive
+                pass
+
+    def check_health(self) -> Dict[str, str]:
+        """One synchronous poll pass over every replica (the background
+        loop's body; tests and the fleet's restart wait call it directly
+        for determinism). Returns {rid: state}."""
+        with self._lock:
+            replicas = list(self._replicas.values())
+        out: Dict[str, str] = {}
+        for replica in replicas:
+            out[replica.rid] = self._probe(replica)
+        return out
+
+    def _probe(self, replica: _Replica) -> str:
+        url = f"http://{replica.host}:{replica.port}/v1/health"
+        timeout = max(2.0, self._health_interval * 2)
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as resp:
+                body = resp.read()
+            state = HEALTHY
+        except urllib.error.HTTPError as ex:
+            # an HTTP answer (503 warming/draining) is a LIVE replica
+            body = ex.read()
+            state = DRAINING
+        except Exception:
+            return self._note_replica_failure(replica.rid)
+        try:
+            reported = str(json.loads(body.decode("utf-8")).get("state", ""))
+            if reported in (HEALTHY, WARMING, DRAINING):
+                state = reported
+        except Exception:  # pragma: no cover - non-JSON health body
+            pass
+        with self._lock:
+            replica.fails = 0
+            replica.last_seen = time.time()
+            # a router-side planned drain is sticky until reattach: the
+            # daemon still answers "healthy" while the fleet is about to
+            # stop it, and new sessions must not land there
+            if not (replica.state == DRAINING and state == HEALTHY):
+                if replica.state == DEAD:
+                    # the corpse answered: transient poll failures, not
+                    # a death — CANCEL the queued failover, or the next
+                    # tick would adopt a LIVE replica's journal and
+                    # double-own its sessions
+                    if replica.rid in self._pending_failover:
+                        self._pending_failover.remove(replica.rid)
+                replica.state = state
+        return replica.state
+
+    def _note_replica_failure(self, rid: str) -> str:
+        """Count one transport failure against the replica; crossing
+        ``fugue.serve.fleet.death_threshold`` marks it dead and queues
+        its sessions for adoption by a survivor."""
+        self._m_forward_fail.labels(replica=rid).inc()
+        with self._lock:
+            replica = self._replicas.get(rid)
+            if replica is None:  # pragma: no cover - detached mid-flight
+                return DEAD
+            replica.fails += 1
+            if replica.fails < self._death_threshold or replica.state == DEAD:
+                return replica.state
+            replica.state = DEAD
+            if rid not in self._pending_failover:
+                self._pending_failover.append(rid)
+        return DEAD
+
+    def _run_pending_failovers(self) -> None:
+        with self._lock:
+            pending = list(self._pending_failover)
+        for rid in pending:
+            self.failover(rid)
+
+    # ---- failover / migration --------------------------------------------
+    def _pick_replica(
+        self, exclude: Tuple[str, ...] = ()
+    ) -> Optional[str]:
+        """The healthy replica owning the fewest sessions (round-robin
+        tiebreak); warming replicas only when no healthy one exists
+        (they accept submissions, just not compile-free yet)."""
+        with self._lock:
+            counts: Dict[str, int] = {
+                rid: 0 for rid in self._replicas if rid not in exclude
+            }
+            for sid, rid in self._affinity.items():
+                if rid in counts:
+                    counts[rid] += 1
+            for accept in ((HEALTHY,), (HEALTHY, WARMING)):
+                ranked = sorted(
+                    (
+                        (counts[rid], i, rid)
+                        for i, rid in enumerate(self._replicas)
+                        if rid not in exclude
+                        and self._replicas[rid].state in accept
+                    ),
+                )
+                if ranked:
+                    self._rr += 1
+                    best = [r for r in ranked if r[0] == ranked[0][0]]
+                    return best[self._rr % len(best)][2]
+            return None
+
+    def failover(self, rid: str, mode: Optional[str] = None) -> Optional[List[str]]:
+        """Move ``rid``'s sessions to a survivor by journal adoption.
+        Returns the adopted session ids once the adoption RAN ([] when
+        the journal held nothing unexpired), or None when it could not
+        run yet (no survivor, adopt call failed, or a death-queued
+        replica turned out to be alive) — a death-triggered failover
+        stays queued and retries on the next health tick."""
+        with self._failover_lock:
+            with self._lock:
+                replica = self._replicas.get(rid)
+                state_path = replica.state_path if replica is not None else ""
+                sids = [
+                    s for s, r in self._affinity.items() if r == rid
+                ]
+                mode = mode or (
+                    "planned"
+                    if replica is not None and replica.state == DRAINING
+                    else "death"
+                )
+                if (
+                    mode == "death"
+                    and replica is not None
+                    and replica.state not in (DEAD, DRAINING)
+                ):
+                    # revived between queueing and now: adopting a LIVE
+                    # replica's journal would double-own its sessions
+                    if rid in self._pending_failover:
+                        self._pending_failover.remove(rid)
+                    return None
+            if not state_path:
+                # nothing adoptable (ephemeral replica): drop the map
+                # entries so requests 404 instead of routing at a corpse
+                with self._lock:
+                    for sid in sids:
+                        self._affinity.pop(sid, None)
+                    if rid in self._pending_failover:
+                        self._pending_failover.remove(rid)
+                    self._dirty = True
+                return []
+            survivor = self._pick_replica(exclude=(rid,))
+            if survivor is None:
+                return None  # stays pending; retried on the next tick
+            # bounded: this runs on the health-loop thread under the
+            # failover lock — a hung adoption must not freeze death
+            # detection fleet-wide for the forward default's 600s
+            status, body, _ = self._forward(
+                survivor, "POST", "/v1/admin/adopt",
+                {"state_path": state_path}, timeout=60.0,
+            )
+            if status != 200:
+                return None  # stays pending; retried on the next tick
+            adopted = list((body.get("adopted") or {}).get("sessions") or [])
+            with self._lock:
+                for sid in adopted:
+                    self._affinity[sid] = survivor
+                for sid in sids:
+                    if sid not in adopted:
+                        self._affinity.pop(sid, None)  # expired while moving
+                if rid in self._pending_failover:
+                    self._pending_failover.remove(rid)
+            self._m_failover.labels(mode=mode).inc()
+            self._journal()
+            return adopted
+
+    # ---- forwarding ------------------------------------------------------
+    def _forward(
+        self,
+        rid: str,
+        method: str,
+        path: str,
+        payload: Optional[Dict[str, Any]] = None,
+        request_id: Optional[str] = None,
+        timeout: float = 600.0,
+    ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
+        """Forward one request to a replica; transport failures count
+        toward its death threshold and answer 503 + Retry-After (the
+        client's retry budget bridges the failover window)."""
+        fault_point("serve.route", f"{rid} {method} {path}")
+        with self._lock:
+            replica = self._replicas.get(rid)
+            if replica is None or replica.state == DEAD:
+                return self._unavailable(rid)
+            host, port = replica.address
+        headers = {"Content-Type": "application/json"}
+        if request_id is not None:
+            headers["X-Request-Id"] = request_id
+        req = urllib.request.Request(
+            f"http://{host}:{port}" + path,
+            data=dumps(payload) if payload is not None else None,
+            method=method,
+            headers=headers,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                body = json.loads(resp.read().decode("utf-8"))
+                out_headers = {
+                    k: v
+                    for k, v in resp.headers.items()
+                    if k.lower() == "retry-after"
+                }
+                return resp.status, body, out_headers
+        except urllib.error.HTTPError as ex:
+            try:
+                body = json.loads(ex.read().decode("utf-8"))
+            except Exception:
+                body = {"error": {"error": "HTTPError", "message": str(ex)}}
+            out_headers = {
+                k: v
+                for k, v in (ex.headers or {}).items()
+                if k.lower() == "retry-after"
+            }
+            return ex.code, body, out_headers
+        except Exception:
+            self._note_replica_failure(rid)
+            return self._unavailable(rid)
+
+    def _unavailable(
+        self, rid: str
+    ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
+        err = BackpressureError(
+            f"replica {rid} is unavailable; its sessions are being "
+            "failed over — retry shortly",
+            retry_after=1.0,
+        )
+        return (
+            503,
+            {"error": structured_error(err), "retry_after": 1.0},
+            {"Retry-After": "1"},
+        )
+
+    # ---- bookkeeping on forwarded answers --------------------------------
+    def _note_session(self, sid: str, rid: str) -> None:
+        with self._lock:
+            self._affinity[sid] = rid
+        self._journal()
+
+    def _drop_session(self, sid: str) -> None:
+        with self._lock:
+            self._affinity.pop(sid, None)
+        self._journal()
+
+    def _note_job(self, jid: str, sid: str, durable: bool) -> None:
+        """Track job → session so /v1/jobs routes through the affinity
+        map (and keeps routing correctly AFTER a migration moves the
+        session). Async submissions journal immediately — a restarted
+        router must resolve a poller's job id; sync ones ride along
+        with the next write."""
+        with self._lock:
+            self._jobs[jid] = sid
+            while len(self._jobs) > _MAX_TRACKED_JOBS:
+                self._jobs.pop(next(iter(self._jobs)))
+            self._dirty = True
+        if durable:
+            self._journal()
+
+    # ---- the daemon-contract surface (HTTP handler calls these) ----------
+    def render_metrics(self) -> str:
+        """Router families + every live replica's exposition with a
+        ``replica`` label injected; HELP/TYPE comments dedupe across
+        replicas (first writer wins)."""
+        lines: List[str] = []
+        seen_comments: set = set()
+        for line in self._metrics.render().splitlines():
+            lines.append(line)
+            if line.startswith("#"):
+                seen_comments.add(line)
+        with self._lock:
+            replicas = [
+                (r.rid, r.address) for r in self._replicas.values()
+                if r.state != DEAD
+            ]
+        for rid, (host, port) in replicas:
+            try:
+                with urllib.request.urlopen(
+                    f"http://{host}:{port}/v1/metrics", timeout=5.0
+                ) as resp:
+                    text = resp.read().decode("utf-8")
+            except Exception:
+                continue  # scrape-time: a missing replica just drops out
+            for line in relabel_exposition(text, rid):
+                if line.startswith("#"):
+                    if line in seen_comments:
+                        continue
+                    seen_comments.add(line)
+                lines.append(line)
+        return "\n".join(lines) + "\n"
+
+    def _collect_gauges(self) -> None:
+        g = self._metrics.gauge(
+            "fugue_fleet_replicas", "replicas per router health state",
+            ["state"],
+        )
+        with self._lock:
+            states = [r.state for r in self._replicas.values()]
+            sessions = len(self._affinity)
+        for state in (HEALTHY, WARMING, DRAINING, DEAD):
+            g.labels(state=state).set(states.count(state))
+        self._metrics.gauge(
+            "fugue_fleet_sessions", "sessions tracked in the affinity map"
+        ).labels().set(sessions)
+
+    def handle_api(
+        self,
+        method: str,
+        path: str,
+        payload: Dict[str, Any],
+        request_id: Optional[str] = None,
+    ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
+        """Route one front-tier request (same contract as the daemon's
+        ``handle_api``: never raises, structured errors, X-Request-Id on
+        every response)."""
+        from fugue_tpu.serve.daemon import clean_request_id, new_request_id
+
+        req_id = clean_request_id(request_id) or new_request_id()
+        try:
+            status, resp, headers = self._handle(
+                method, path, payload, req_id
+            )
+        except KeyError as ex:
+            status, resp, headers = 404, {"error": structured_error(ex)}, {}
+        except (ValueError, TypeError) as ex:
+            status, resp, headers = 400, {"error": structured_error(ex)}, {}
+        except Exception as ex:  # defensive: the router must answer
+            status, resp, headers = 500, {"error": structured_error(ex)}, {}
+        route = path.split("?", 1)[0].split("/")
+        family = route[2] if len(route) > 2 and route[1] == "v1" else "unknown"
+        self._m_requests.labels(route=family, status=str(status)).inc()
+        out_headers = dict(headers)
+        out_headers["X-Request-Id"] = req_id
+        return status, resp, out_headers
+
+    def _handle(
+        self,
+        method: str,
+        path: str,
+        payload: Dict[str, Any],
+        request_id: str,
+    ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
+        parts = [p for p in path.split("?", 1)[0].split("/") if p]
+        if not parts or parts[0] != "v1":
+            raise KeyError(f"unknown path {path}")
+        route = parts[1:]
+        if route == ["health"] and method == "GET":
+            with self._lock:
+                states = {
+                    rid: r.state for rid, r in self._replicas.items()
+                }
+            ok = any(s == HEALTHY for s in states.values())
+            return (
+                (200 if ok else 503),
+                {"ok": ok, "state": HEALTHY if ok else "degraded",
+                 "replicas": states},
+                {},
+            )
+        if route == ["status"] and method == "GET":
+            return 200, self.status(), {}
+        if route == ["fleet"] and method == "GET":
+            return 200, self.describe(), {}
+        if route == ["sessions"] and method == "POST":
+            return self._route_create_session(payload, request_id)
+        if route == ["sessions"] and method == "GET":
+            return 200, {"sessions": self._gather_sessions(request_id)}, {}
+        if len(route) >= 2 and route[0] == "sessions":
+            sid = route[1]
+            with self._lock:
+                owner = self._affinity.get(sid)
+            if owner is None:
+                raise KeyError(f"unknown or expired session {sid}")
+            status, body, headers = self._forward(
+                owner, method, path, payload if method == "POST" else None,
+                request_id=request_id,
+            )
+            rest = route[2:]
+            if status == 200 and (
+                (not rest and method == "DELETE")
+                or (rest == ["close"] and method == "POST")
+            ):
+                self._drop_session(sid)
+            if rest == ["sql"] and status in (200, 202):
+                jid = body.get("job_id")
+                if isinstance(jid, str):
+                    self._note_job(jid, sid, durable=status == 202)
+            return status, body, headers
+        if len(route) >= 2 and route[0] == "jobs":
+            jid = route[1]
+            with self._lock:
+                sid = self._jobs.get(jid)
+                owner = self._affinity.get(sid) if sid is not None else None
+            if owner is None:
+                raise KeyError(f"unknown job {jid}")
+            return self._forward(
+                owner, method, path,
+                payload if method == "POST" else None,
+                request_id=request_id,
+            )
+        raise KeyError(f"unknown route {method} {path}")
+
+    def _route_create_session(
+        self, payload: Dict[str, Any], request_id: str
+    ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
+        rid = self._pick_replica()
+        if rid is None:
+            err = BackpressureError(
+                "no healthy replica available for a new session",
+                retry_after=1.0,
+            )
+            return (
+                503,
+                {"error": structured_error(err), "retry_after": 1.0},
+                {"Retry-After": "1"},
+            )
+        status, body, headers = self._forward(
+            rid, "POST", "/v1/sessions", payload, request_id=request_id
+        )
+        if status == 200 and isinstance(body.get("session_id"), str):
+            self._note_session(body["session_id"], rid)
+            body = dict(body)
+            body["replica"] = rid
+        return status, body, headers
+
+    def _gather_sessions(self, request_id: str) -> List[Dict[str, Any]]:
+        with self._lock:
+            live = [
+                r.rid for r in self._replicas.values() if r.state != DEAD
+            ]
+        out: List[Dict[str, Any]] = []
+        for rid in live:
+            status, body, _ = self._forward(
+                rid, "GET", "/v1/sessions", request_id=request_id,
+                timeout=10.0,
+            )
+            if status == 200:
+                for rec in body.get("sessions") or []:
+                    rec = dict(rec)
+                    rec["replica"] = rid
+                    out.append(rec)
+        return out
+
+    # ---- aggregate views -------------------------------------------------
+    def describe(self) -> Dict[str, Any]:
+        with self._lock:
+            counts: Dict[str, int] = {}
+            for rid in self._affinity.values():
+                counts[rid] = counts.get(rid, 0) + 1
+            return {
+                "replicas": [r.describe() for r in self._replicas.values()],
+                "sessions": len(self._affinity),
+                "sessions_per_replica": counts,
+                "tracked_jobs": len(self._jobs),
+                "pending_failovers": list(self._pending_failover),
+                "state_uri": self.state_uri if self._base else "",
+            }
+
+    def status(self) -> Dict[str, Any]:
+        """Fleet-wide ``/v1/status``: the router's topology block plus
+        each live replica's own status payload."""
+        out: Dict[str, Any] = {"fleet": self.describe(), "replicas": {}}
+        with self._lock:
+            live = [
+                r.rid for r in self._replicas.values() if r.state != DEAD
+            ]
+        for rid in live:
+            status, body, _ = self._forward(
+                rid, "GET", "/v1/status", timeout=30.0
+            )
+            out["replicas"][rid] = (
+                body if status == 200 else {"unreachable": True}
+            )
+        return out
+
+
+class ServeFleet:
+    """An in-process serving fleet: N :class:`ServeDaemon` replicas —
+    each with its own engine and a per-replica journal under the shared
+    ``fugue.serve.state_path`` — behind one :class:`FleetRouter`.
+
+    The replicas share the persistent executable cache
+    (``fugue.optimize.cache.dir``, when set) and the cross-replica
+    result cache (``fugue.serve.fleet.result_cache_dir``, defaulted to
+    ``<state_path>/results``), so a migrated session warm-starts on its
+    new replica. :meth:`rolling_restart` is the planned-migration chaos
+    scenario: drain → adopt → fresh daemon → wait healthy, one replica
+    at a time, with live traffic riding the client retry budget."""
+
+    def __init__(
+        self,
+        conf: Any = None,
+        replicas: Optional[int] = None,
+        engine: Any = "jax",
+    ):
+        self._conf = ParamDict(conf)
+        n = int(
+            replicas
+            if replicas is not None
+            else typed_conf_get(self._conf, FUGUE_CONF_SERVE_FLEET_REPLICAS)
+        )
+        if n < 1:
+            raise ValueError(
+                "a fleet needs replicas >= 1 (set the replicas argument "
+                f"or {FUGUE_CONF_SERVE_FLEET_REPLICAS})"
+            )
+        base = str(
+            typed_conf_get(self._conf, FUGUE_CONF_SERVE_STATE_PATH) or ""
+        ).strip()
+        if base == "":
+            raise ValueError(
+                "a fleet requires a shared fugue.serve.state_path: the "
+                "per-replica journals under it are what failover adopts"
+            )
+        self._engine_spec = engine
+        self._base = base.rstrip("/")
+        fs = make_default_registry()
+        if FUGUE_CONF_SERVE_FLEET_RESULT_CACHE_DIR in self._conf:
+            # explicit conf wins — including an explicit '' = OFF (the
+            # bench uses that to measure execution, not cache reads)
+            result_dir = str(
+                self._conf[FUGUE_CONF_SERVE_FLEET_RESULT_CACHE_DIR] or ""
+            ).strip()
+        else:
+            result_dir = fs.join(self._base, "results")
+        self._replica_ids = [f"r{i}" for i in range(n)]
+        self._replica_confs: Dict[str, ParamDict] = {}
+        for rid in self._replica_ids:
+            rconf = ParamDict(self._conf)
+            rconf[FUGUE_CONF_SERVE_STATE_PATH] = self.replica_state_path(rid)
+            rconf[FUGUE_CONF_SERVE_FLEET_RESULT_CACHE_DIR] = result_dir
+            rconf[FUGUE_CONF_SERVE_PORT] = 0  # ephemeral: never collide
+            self._replica_confs[rid] = rconf
+        self._daemons: Dict[str, Any] = {}
+        self._router = FleetRouter(self._conf)
+        self._started = False
+
+    # ---- lifecycle -------------------------------------------------------
+    def replica_state_path(self, rid: str) -> str:
+        fs = make_default_registry()
+        return fs.join(self._base, "replicas", rid)
+
+    @property
+    def router(self) -> FleetRouter:
+        return self._router
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        """(host, port) of the ROUTER's HTTP front tier."""
+        return self._router.address
+
+    @property
+    def replica_ids(self) -> List[str]:
+        return list(self._replica_ids)
+
+    def replica(self, rid: str) -> Any:
+        return self._daemons[rid]
+
+    def shares_exec_cache(self) -> bool:
+        return (
+            str(
+                typed_conf_get(self._conf, FUGUE_CONF_OPTIMIZE_CACHE_DIR)
+                or ""
+            ).strip()
+            != ""
+        )
+
+    def start(self) -> "ServeFleet":
+        if self._started:
+            return self
+        from fugue_tpu.serve.daemon import ServeDaemon
+
+        for rid in self._replica_ids:
+            daemon = ServeDaemon(
+                self._replica_confs[rid], self._engine_spec
+            ).start()
+            self._daemons[rid] = daemon
+            host, port = daemon.address
+            self._router.attach(
+                rid, host, port, state_path=self.replica_state_path(rid)
+            )
+        self._router.start()
+        self._started = True
+        return self
+
+    def stop(self, drain: bool = False) -> None:
+        if not self._started:
+            return
+        self._started = False
+        self._router.stop()
+        for daemon in self._daemons.values():
+            try:
+                daemon.stop(drain=drain)
+            except Exception:  # pragma: no cover - best-effort teardown
+                pass
+
+    def __enter__(self) -> "ServeFleet":
+        return self.start()
+
+    def __exit__(self, *args: Any) -> None:
+        self.stop()
+
+    # ---- chaos / rolling restart -----------------------------------------
+    def kill_replica(self, rid: str) -> None:
+        """Chaos hook: the in-process stand-in for ``kill -9`` on one
+        replica (no drain, no final journal write). The router's health
+        loop detects the corpse and fails its sessions over."""
+        self._daemons[rid]._hard_kill()
+
+    def restart_replica(
+        self, rid: str, timeout: float = 120.0
+    ) -> Dict[str, Any]:
+        """One rolling-restart step: planned migration then a fresh
+        daemon. Drain the replica (its final journal snapshot lands
+        BEFORE the engine closes), adopt its journal into a survivor,
+        start a fresh daemon on the same slot, and wait until the
+        router sees it healthy again."""
+        t0 = time.monotonic()
+        self._router.begin_drain(rid)
+        self._daemons[rid].stop(drain=True)
+        migrated = self._router.failover(rid, mode="planned")
+        t_migrated = time.monotonic()
+        if migrated is not None:
+            # the adoption ran, so the origin journal MUST be empty
+            # before a fresh daemon starts on it — adopt_state clears
+            # it, but a shared-fs hiccup there only logs on the
+            # survivor. Verify here and refuse to double-own: a fresh
+            # daemon rehydrating just-migrated sessions would later
+            # delete the shared artifacts the survivor depends on.
+            from fugue_tpu.serve.state import ServeStateJournal
+
+            fs = make_default_registry()
+            state_path = self.replica_state_path(rid)
+            leftover = ServeStateJournal.read_state(fs, state_path)
+            if leftover["sessions"] or leftover["jobs"]:
+                ServeStateJournal.clear_state(fs, state_path)
+        from fugue_tpu.serve.daemon import ServeDaemon
+
+        fresh = ServeDaemon(
+            self._replica_confs[rid], self._engine_spec
+        ).start()
+        self._daemons[rid] = fresh
+        host, port = fresh.address
+        self._router.attach(
+            rid, host, port, state_path=self.replica_state_path(rid)
+        )
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._router.check_health().get(rid) == HEALTHY:
+                break
+            time.sleep(0.05)
+        else:  # pragma: no cover - replica failed to come back
+            raise TimeoutError(
+                f"replica {rid} did not report healthy within {timeout}s "
+                "after its rolling restart"
+            )
+        return {
+            "replica": rid,
+            "migrated_sessions": len(migrated or []),
+            # None = no survivor was available: the fresh daemon
+            # recovered its own journal instead (single-daemon path)
+            "migration_ran": migrated is not None,
+            "migration_secs": round(t_migrated - t0, 4),
+            "secs": round(time.monotonic() - t0, 4),
+        }
+
+    def rolling_restart(self, timeout: float = 120.0) -> Dict[str, Any]:
+        """Restart every replica in sequence under live load — the
+        fleet's headline chaos scenario. Sessions migrate off each
+        replica before it stops and spread back as later restarts
+        migrate onto the fresh daemons; client calls ride their retry
+        budget through each handoff window."""
+        t0 = time.monotonic()
+        steps = [
+            self.restart_replica(rid, timeout=timeout)
+            for rid in self._replica_ids
+        ]
+        return {
+            "replicas": steps,
+            "migrated_sessions": sum(s["migrated_sessions"] for s in steps),
+            "migration_secs": round(
+                sum(s["migration_secs"] for s in steps), 4
+            ),
+            "secs": round(time.monotonic() - t0, 4),
+        }

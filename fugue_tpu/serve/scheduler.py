@@ -290,7 +290,17 @@ class JobScheduler:
     def submit(self, job: ServeJob) -> ServeJob:
         with self._lock:
             if not self._started or self._draining:
-                raise ValueError("scheduler is not accepting jobs")
+                # a 503 BackpressureError, not a 400: a submission can
+                # legitimately race the start of a drain past the
+                # daemon's health check, and the client's retry budget
+                # must carry it to the next attempt (or, in a fleet,
+                # to the replica adopting this one's sessions)
+                from fugue_tpu.serve.supervisor import BackpressureError
+
+                raise BackpressureError(
+                    "scheduler is draining/stopped; not accepting jobs",
+                    retry_after=1.0,
+                )
             self._jobs[job.job_id] = job
             self._order.append(job.job_id)
             self._evict_locked()

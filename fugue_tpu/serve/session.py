@@ -24,7 +24,7 @@ rejection manifest resume applies to checkpoints.
 
 import time
 import uuid
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from fugue_tpu.dataframe import DataFrame
 from fugue_tpu.testing.faults import fault_point
@@ -61,6 +61,12 @@ class ServeSession:
         # tables known only from the journal after a restart:
         # name -> {"artifact", "size", "sha256"}; loaded lazily
         self._durable: Dict[str, Dict[str, Any]] = {}
+        # durable records of CATALOG-live tables (set at save/reload):
+        # the artifact URI is authoritative here — an ADOPTED session's
+        # artifacts live under the ORIGIN replica's state dir, not where
+        # this daemon's journal would derive them — and the sha256s are
+        # the content keys of the fleet's cross-replica result cache
+        self._artifacts: Dict[str, Dict[str, Any]] = {}
         self.integrity_rejected = 0
         self.restored = False
         self._lock = tracked_lock(
@@ -145,6 +151,7 @@ class ServeSession:
                 self._journal.forget_session(self.session_id)
             self._tables.clear()
             self._durable.clear()
+            self._artifacts.clear()
             # a closing session's cached query payloads die with it
             try:
                 from fugue_tpu.optimize import get_plan_cache
@@ -157,7 +164,10 @@ class ServeSession:
     def _remove_artifact(self, name: str) -> None:
         if self._journal is None:
             return
-        uri = self._journal.table_artifact_uri(self.session_id, name)
+        rec = self._artifacts.get(name) or self._durable.get(name) or {}
+        uri = rec.get("artifact") or self._journal.table_artifact_uri(
+            self.session_id, name
+        )
         try:
             if self._engine.fs.exists(uri):
                 self._engine.fs.rm(uri, recursive=True)
@@ -189,7 +199,16 @@ class ServeSession:
             loaded = sql.load_table(q)
             self._claim_tenant(loaded)
             self._tables[name] = q
-            self._durable.pop(name, None)  # catalog copy is now the truth
+            # catalog copy is now the truth; an overwritten durable-only
+            # record (adopted, never queried) becomes the PRIOR artifact
+            # so _journal_table can clean the origin replica's file up
+            durable_prior = self._durable.pop(name, None)
+            if (
+                name not in self._artifacts
+                and durable_prior
+                and durable_prior.get("artifact")
+            ):
+                self._artifacts[name] = dict(durable_prior)
             self.cache_epoch += 1
             self._journal_table(name, loaded)
         self.touch()
@@ -202,6 +221,7 @@ class ServeSession:
         if self._journal is None:
             return
         uri = self._journal.table_artifact_uri(self.session_id, name)
+        prior = (self._artifacts.get(name) or {}).get("artifact")
         try:
             with engine_dispatch_guard(self._engine, None):
                 self._engine.save_df(df, uri, format_hint="parquet")
@@ -213,10 +233,19 @@ class ServeSession:
                 self.session_id, name, type(ex).__name__, ex,
             )
             return
-        self._journal.record_table(
-            self.session_id, name,
-            {"artifact": uri, "size": size, "sha256": sha256},
-        )
+        rec = {"artifact": uri, "size": size, "sha256": sha256}
+        self._artifacts[name] = dict(rec)
+        self._journal.record_table(self.session_id, name, rec)
+        if prior and prior != uri:
+            # an ADOPTED session's prior artifact lives under the ORIGIN
+            # replica's state dir: the re-save above wrote this journal's
+            # own path, so the origin file would leak on the shared fs
+            # forever once the record stops pointing at it
+            try:
+                if self._engine.fs.exists(prior):
+                    self._engine.fs.rm(prior, recursive=True)
+            except Exception:  # pragma: no cover - best-effort cleanup
+                pass
 
     def _claim_tenant(self, loaded: DataFrame) -> None:
         gov = getattr(self._engine, "memory_governor", None)
@@ -270,15 +299,17 @@ class ServeSession:
             sql.save_table(df, q, mode="overwrite")
         self._claim_tenant(sql.load_table(q))
         self._tables[name] = q
+        self._artifacts[name] = dict(rec)
         self._durable.pop(name, None)
         return q
 
     def drop_table(self, name: str) -> None:
         with self._lock:
             q = self._tables.pop(name, None)
-            self._durable.pop(name, None)
             self.cache_epoch += 1
             self._remove_artifact(name)
+            self._durable.pop(name, None)
+            self._artifacts.pop(name, None)
         if self._journal is not None:
             self._journal.forget_table(self.session_id, name)
         if q is not None:
@@ -287,6 +318,24 @@ class ServeSession:
     def table_names(self) -> List[str]:
         with self._lock:
             return sorted(set(self._tables) | set(self._durable))
+
+    def table_content_keys(self) -> Optional[List[List[str]]]:
+        """Sorted ``[name, sha256]`` pairs over every session table — the
+        content-addressed part of the fleet's cross-replica result-cache
+        key (same artifacts => same key on ANY replica, and the sha
+        changes the moment a save changes the table). None when any
+        table has no verified durable record (artifact write failed, or
+        an ephemeral daemon): a content-keyed cache must not guess."""
+        with self._lock:
+            names = set(self._tables) | set(self._durable)
+            out: List[List[str]] = []
+            for name in sorted(names):
+                rec = self._artifacts.get(name) or self._durable.get(name)
+                sha = (rec or {}).get("sha256")
+                if not sha:
+                    return None
+                out.append([name, str(sha)])
+            return out
 
     def table_frames(self) -> Dict[str, DataFrame]:
         """The live session tables as engine dataframes — fed into
@@ -369,6 +418,46 @@ class SessionManager:
                 self._sessions[sid] = session
             restored += 1
         return restored
+
+    def adopt(
+        self, journaled: Dict[str, Dict[str, Any]]
+    ) -> Tuple[List[str], int]:
+        """Fleet failover: rehydrate ANOTHER replica's journaled
+        sessions into this manager, importing each adopted record into
+        OUR journal so the sessions survive this daemon's own restarts
+        too. Sessions whose TTL lapsed are cleaned up exactly like
+        :meth:`restore`'s expiry path; ids already live here are left
+        untouched (the local session is the current owner). Returns
+        (adopted session ids, expired count)."""
+        adopted: List[str] = []
+        expired = 0
+        now = time.time()
+        for sid, rec in sorted(journaled.items()):
+            with self._lock:
+                exists = sid in self._sessions
+            if exists:
+                self._engine.log.warning(
+                    "fugue_tpu serve: adoption skipped session %s — a "
+                    "live local session already owns the id", sid,
+                )
+                continue
+            ttl = float(rec.get("ttl", 0.0) or 0.0)
+            last_used = float(
+                rec.get("last_used") or rec.get("created_at") or now
+            )
+            session = ServeSession.restore(
+                self._engine, self._journal, sid, rec
+            )
+            if ttl > 0 and now - last_used > ttl:
+                session.close(forget=True)
+                expired += 1
+                continue
+            with self._lock:
+                self._sessions[sid] = session
+            if self._journal is not None:
+                self._journal.import_session(sid, rec)
+            adopted.append(sid)
+        return adopted, expired
 
     def get(self, session_id: str) -> ServeSession:
         """Raises ``KeyError`` for unknown AND expired ids (an expired

@@ -24,8 +24,23 @@ file):
 Journal writes are best-effort: a failing write (chaos site
 ``serve.journal``) degrades durability, never availability — the error
 is logged and counted, and serving continues.
+
+**Write path (ISSUE 13)**: the state lock is held only to SNAPSHOT the
+payload; the filesystem write itself runs outside it through a
+:class:`SnapshotWriter` — a dedicated writer mutex that serializes
+writes and drops superseded snapshots (sequence-numbered tickets), so a
+slow or hung shared-fs write can no longer block every
+``touch_session``/``record_*`` on the serving hot path behind it.
+
+The journal is also the **handoff unit** of fleet failover
+(:mod:`fugue_tpu.serve.fleet`): a surviving replica adopts a dead
+replica's sessions/jobs by reading its journal (:meth:`read_state`),
+importing the records into its own (:meth:`import_session` +
+``record_job``), and clearing the source (:meth:`clear_state`) so a
+later restart of the origin replica cannot double-own the sessions.
 """
 
+import copy
 import time
 from typing import Any, Dict, Optional
 
@@ -36,9 +51,63 @@ from fugue_tpu.workflow.manifest import atomic_json_write, read_json
 _STATE_FILE = "serve_state.json"
 
 
+class SnapshotWriter:
+    """Ordered best-effort snapshot writes OUTSIDE the state lock.
+
+    Contract: the caller allocates a :meth:`ticket` while holding ITS
+    OWN state lock together with the snapshot (so ticket order equals
+    snapshot order), then calls :meth:`write` holding NO state lock.
+    The writer mutex serializes the filesystem writes; a snapshot whose
+    ticket is older than the last landed one is simply dropped — its
+    state is a strict subset of what is already on disk, so skipping it
+    preserves write ordering without ever writing stale state."""
+
+    def __init__(self, fs: Any, uri: str, log: Any = None):
+        self._fs = fs
+        self._uri = uri
+        self._log = log
+        # the ONLY lock in the serve plane a filesystem write may run
+        # under — nothing else is ever acquired while holding it, and
+        # no request-path lock waits on it (see baseline.json FLN104)
+        self._lock = tracked_lock("serve.state.SnapshotWriter._lock")
+        self._next = 1      # mutated under the CALLER's state lock only
+        self._written = 0   # mutated under self._lock only
+        self.failures = 0
+
+    def ticket(self) -> int:
+        """Allocate the next snapshot sequence number. MUST be called
+        under the caller's state lock, in the same critical section
+        that takes the snapshot."""
+        t = self._next
+        self._next += 1
+        return t
+
+    def write(self, ticket: int, payload: Dict[str, Any]) -> None:
+        """Atomically persist ``payload`` unless a newer ticket already
+        landed (chaos site ``serve.journal``). Best-effort: failures
+        degrade durability, never availability."""
+        with self._lock:
+            if ticket <= self._written:
+                return  # superseded: a newer snapshot is already durable
+            try:
+                fault_point("serve.journal", self._uri)
+                atomic_json_write(self._fs, self._uri, payload)
+                self._written = ticket
+            except Exception as ex:
+                self.failures += 1
+                if self._log is not None:
+                    self._log.warning(
+                        "fugue_tpu serve: journal write to %s failed "
+                        "(%s: %s); durability degraded, serving continues",
+                        self._uri, type(ex).__name__, ex,
+                    )
+
+
 class ServeStateJournal:
-    """The daemon's durable state file. All mutators rewrite the whole
-    (small) JSON snapshot under one lock; readers get plain dicts."""
+    """The daemon's durable state file. Mutators update the in-memory
+    snapshot under one lock, then hand a deep-copied payload to the
+    :class:`SnapshotWriter` — the filesystem write never runs under the
+    state lock; readers get plain dicts."""
 
     def __init__(self, engine: Any, base_uri: str):
         self._engine = engine
@@ -48,7 +117,9 @@ class ServeStateJournal:
         )
         self._sessions: Dict[str, Dict[str, Any]] = {}
         self._jobs: Dict[str, Dict[str, Any]] = {}
-        self.write_failures = 0
+        self._writer = SnapshotWriter(
+            engine.fs, self.uri, log=engine.log
+        )
         # touch_session marks the snapshot dirty WITHOUT writing; the
         # supervisor tick flushes at a bounded cadence so a read-only
         # workload's last_used still reaches disk (else its sessions
@@ -60,6 +131,16 @@ class ServeStateJournal:
     @property
     def uri(self) -> str:
         return self._engine.fs.join(self._base, _STATE_FILE)
+
+    @property
+    def base_uri(self) -> str:
+        """The journal's state dir — what a fleet router hands to a
+        surviving replica's adopt hook on failover."""
+        return self._base
+
+    @property
+    def write_failures(self) -> int:
+        return self._writer.failures
 
     def table_artifact_uri(self, session_id: str, name: str) -> str:
         fs = self._engine.fs
@@ -83,27 +164,56 @@ class ServeStateJournal:
             }
 
     def write(self) -> None:
-        """Atomically persist the current snapshot (chaos site
-        ``serve.journal``). Best-effort: failures degrade durability,
-        never availability."""
+        """Persist the current snapshot. The state lock covers only the
+        deep-copy + ticket; the write itself runs through the ordered
+        :class:`SnapshotWriter` so a hung shared-fs write cannot stall
+        the serving hot path behind this lock."""
         with self._lock:
             payload = {
                 "saved_at": time.time(),
-                "sessions": self._sessions,
-                "jobs": self._jobs,
+                "sessions": copy.deepcopy(self._sessions),
+                "jobs": copy.deepcopy(self._jobs),
             }
             self._dirty = False
             self._last_write = time.monotonic()
-            try:
-                fault_point("serve.journal", self.uri)
-                atomic_json_write(self._engine.fs, self.uri, payload)
-            except Exception as ex:
-                self.write_failures += 1
-                self._engine.log.warning(
-                    "fugue_tpu serve: journal write to %s failed (%s: %s); "
-                    "durability degraded, serving continues",
-                    self.uri, type(ex).__name__, ex,
-                )
+            ticket = self._writer.ticket()
+        self._writer.write(ticket, payload)
+
+    # ---- fleet adoption (static: reads a FOREIGN replica's journal) ------
+    @staticmethod
+    def read_state(fs: Any, base_uri: str, log: Any = None) -> Dict[str, Any]:
+        """A replica's journal snapshot as plain dicts (empty when
+        missing/unreadable) — what the adopt hook consumes."""
+        base = str(base_uri).rstrip("/")
+        data = read_json(
+            fs, fs.join(base, _STATE_FILE),
+            log=log, what="adopted serve journal",
+        ) or {}
+        return {
+            "sessions": dict(data.get("sessions") or {}),
+            "jobs": dict(data.get("jobs") or {}),
+        }
+
+    @staticmethod
+    def clear_state(fs: Any, base_uri: str) -> None:
+        """Atomically empty a replica's journal after its sessions were
+        adopted elsewhere: a restarted origin replica rehydrates nothing
+        instead of double-owning migrated sessions."""
+        base = str(base_uri).rstrip("/")
+        atomic_json_write(
+            fs,
+            fs.join(base, _STATE_FILE),
+            {"saved_at": time.time(), "sessions": {}, "jobs": {}},
+        )
+
+    def import_session(self, session_id: str, record: Dict[str, Any]) -> None:
+        """Adopt a foreign journal's full session record (ttl, times AND
+        table catalog) into this journal — fleet failover's bookkeeping
+        move; the artifact URIs inside the record stay where the origin
+        replica wrote them (shared fs)."""
+        with self._lock:
+            self._sessions[session_id] = copy.deepcopy(record)
+        self.write()
 
     # ---- session registry ------------------------------------------------
     def record_session(self, session: Any) -> None:

@@ -22,6 +22,9 @@ daemon must degrade, never die:
 - ``serve.http`` (key = ``"METHOD /path"``): request routing in the
   daemon — the fault answers as a structured 500 and the connection
   plane survives.
+- ``serve.route`` (key = ``"<replica> METHOD /path"``): the fleet
+  router's forward to a replica (serve/fleet.py) — the fault answers as
+  a structured error from the ROUTER while the replicas stay untouched.
 
 The ``device.alloc`` site fires in the memory governor's pre-allocation
 gate (jax_backend/memory.py) with the placement TIER as its key, right
@@ -83,6 +86,7 @@ KNOWN_SITES = (
     "serve.sweep",
     "serve.dispatch",
     "serve.http",
+    "serve.route",
     "obs.trace",
     "cache.persist",
 )

@@ -536,6 +536,59 @@ def test_fwf503_serve_concurrency_without_dispatch_lock():
     )
 
 
+def test_fwf504_fleet_without_shared_state_or_cache(monkeypatch):
+    # a fleet conf (replicas > 1) must share the serve state path (the
+    # journals failover adopts) AND the executable cache dir (what a
+    # migrated session / fresh rolling-restart daemon warm-starts from):
+    # missing either silently degrades resilience, so each gap warns
+    monkeypatch.delenv("FUGUE_JAX_COMPILE_CACHE", raising=False)
+    dag = FugueWorkflow()
+    dag.df([[0]], "a:int").persist()
+    diags = [
+        d
+        for d in _analyze(dag, conf={"fugue.serve.fleet.replicas": 2})
+        if d.code == "FWF504"
+    ]
+    assert len(diags) == 2
+    _assert_diag(diags, "FWF504", Severity.WARN, needs_callsite=False)
+    messages = " | ".join(d.message for d in diags)
+    assert "fugue.serve.state_path" in messages
+    assert "fugue.optimize.cache.dir" in messages
+    # both shared -> silent
+    assert not any(
+        x.code == "FWF504"
+        for x in _analyze(
+            dag,
+            conf={
+                "fugue.serve.fleet.replicas": 2,
+                "fugue.serve.state_path": "/tmp/fleet",
+                "fugue.optimize.cache.dir": "/tmp/xcache",
+            },
+        )
+    )
+    # one shared -> exactly the other gap warns
+    only_state = [
+        d
+        for d in _analyze(
+            dag,
+            conf={
+                "fugue.serve.fleet.replicas": 2,
+                "fugue.serve.state_path": "/tmp/fleet",
+            },
+        )
+        if d.code == "FWF504"
+    ]
+    assert len(only_state) == 1
+    assert "fugue.optimize.cache.dir" in only_state[0].message
+    # a single replica is not a fleet: silent
+    assert not any(
+        x.code == "FWF504"
+        for x in _analyze(dag, conf={"fugue.serve.fleet.replicas": 1})
+    )
+    # no fleet key at all: silent
+    assert not any(x.code == "FWF504" for x in _analyze(dag))
+
+
 def test_every_rule_has_corpus_coverage():
     """The corpus above must track the registry: a newly registered rule
     without a fixture here fails this meta-check."""
@@ -543,6 +596,7 @@ def test_every_rule_has_corpus_coverage():
         "FWF101", "FWF102", "FWF103", "FWF104", "FWF105", "FWF106",
         "FWF201", "FWF202", "FWF301", "FWF302", "FWF303", "FWF401",
         "FWF402", "FWF403", "FWF404", "FWF501", "FWF502", "FWF503",
+        "FWF504",
     }
     assert {r.code for r in all_rules()} == covered
 

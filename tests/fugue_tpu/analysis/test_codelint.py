@@ -299,6 +299,57 @@ def test_fln104_sleep_under_lock():
     assert "time.sleep" in hits[0].message
 
 
+# the EXACT shape ISSUE 13 removed from ServeStateJournal.write(): the
+# journal held its state lock across the shared-fs write, so a slow or
+# hung mount stalled every touch_session/record_* on the serving hot
+# path behind it. The fixture proves the extended FLN104 (engine-fs IO
+# helpers as blocking calls) catches the old code forever.
+_JOURNAL_IO_FIXTURE = '''
+from fugue_tpu.testing.locktrace import tracked_lock
+from fugue_tpu.workflow.manifest import artifact_fingerprint, atomic_json_write
+
+class Journal:
+    def __init__(self):
+        self._lock = tracked_lock("serve.state.ServeStateJournal._lock", reentrant=True)
+
+    def write(self, fs, uri, payload):
+        with self._lock:
+            atomic_json_write(fs, uri, payload)
+
+    def fingerprint_under_lock(self, fs, uri):
+        with self._lock:
+            return artifact_fingerprint(fs, uri)
+
+    def snapshot_then_write(self, fs, uri, payload):
+        with self._lock:
+            snapshot = dict(payload)
+        atomic_json_write(fs, uri, snapshot)
+'''
+
+
+def test_fln104_fires_on_journal_io_under_state_lock():
+    diags = lint_text(
+        _JOURNAL_IO_FIXTURE, rel="fugue_tpu/serve/fx_state.py"
+    )
+    hits = _find(diags, "FLN104")
+    by_call = {d.message.split("'")[1]: d for d in hits}
+    # the old write(): the fs write under the held journal lock
+    d = by_call["atomic_json_write"]
+    assert d.severity is Severity.ERROR
+    assert d.qualname == "Journal.write"
+    assert "serve.state.ServeStateJournal._lock" in d.message
+    # fingerprinting (reads the whole artifact) is just as blocking
+    assert by_call["artifact_fingerprint"].qualname == (
+        "Journal.fingerprint_under_lock"
+    )
+    # the FIXED shape — snapshot under the lock, write outside — is
+    # clean: exactly the two bad call sites fire
+    assert len(hits) == 2
+    assert not any(
+        d.qualname == "Journal.snapshot_then_write" for d in hits
+    )
+
+
 # ---------------------------------------------------------------------------
 # FLN105 — raw IO on engine/serve paths
 # ---------------------------------------------------------------------------

@@ -1,0 +1,123 @@
+"""ServeClient multi-endpoint failover + bounded wait (ISSUE 13
+satellites): rotation to the next replica on connection-refused and on
+503-draining, the retained Retry-After-honoring retry budget, and the
+``wait`` deadline raising a structured timeout instead of hanging on a
+lost job id. Tier-1 compatible; select with ``-m serve``."""
+
+import socket
+import threading
+
+import pytest
+
+from fugue_tpu.constants import FUGUE_CONF_SERVE_BREAKER_THRESHOLD
+from fugue_tpu.serve import (
+    ServeAPIError,
+    ServeClient,
+    ServeDaemon,
+    ServeJobTimeoutError,
+)
+
+pytestmark = [pytest.mark.serve]
+
+_NO_BREAKER = {FUGUE_CONF_SERVE_BREAKER_THRESHOLD: 0}
+_CREATE = "CREATE [[0,1],[1,2]] SCHEMA k:long,v:long"
+
+
+def _dead_port() -> int:
+    """A port nothing listens on (bound then released)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _Gate:
+    """Freeze the daemon's job execution (never-finishing jobs)."""
+
+    def __init__(self, daemon):
+        self._real = daemon.scheduler._execute
+        self.release = threading.Event()
+        daemon.scheduler._execute = self
+        self._daemon = daemon
+
+    def __call__(self, job):
+        self.release.wait(timeout=60)
+        return self._real(job)
+
+    def restore(self):
+        self.release.set()
+        self._daemon.scheduler._execute = self._real
+
+
+def test_client_rotates_to_live_endpoint_on_connection_refused():
+    with ServeDaemon(dict(_NO_BREAKER)) as daemon:
+        host, port = daemon.address
+        # first endpoint refuses connections; the retry budget rotates
+        # to the live one instead of re-hammering the corpse
+        client = ServeClient(
+            [("127.0.0.1", _dead_port()), (host, port)], retries=2
+        )
+        sid = client.create_session()
+        assert client.current_endpoint == (host, port)
+        # follow-up calls stay on the rotated-to endpoint: no flapping
+        assert client.sql(sid, _CREATE)["status"] == "done"
+        assert client.current_endpoint == (host, port)
+
+
+def test_client_rotates_off_draining_replica_on_503():
+    with ServeDaemon(dict(_NO_BREAKER)) as d1, ServeDaemon(
+        dict(_NO_BREAKER)
+    ) as d2:
+        # d1 answers 503 + Retry-After (draining); the client's next
+        # attempt must land on d2, not burn the budget on d1
+        d1._health.start_drain(300.0)
+        client = ServeClient([d1.address, d2.address], retries=2)
+        sid = client.create_session()
+        assert client.current_endpoint == d2.address
+        # d2 really owns it
+        assert d2.sessions.get(sid).session_id == sid
+
+
+def test_single_endpoint_client_fails_fast_without_rotation():
+    with ServeDaemon(dict(_NO_BREAKER)) as daemon:
+        daemon._health.start_drain(300.0)
+        client = ServeClient(*daemon.address, retries=0)
+        with pytest.raises(ServeAPIError) as ex:
+            client.create_session()
+        assert ex.value.status == 503
+        assert ex.value.retry_after is not None
+
+
+def test_wait_deadline_raises_structured_timeout():
+    with ServeDaemon(dict(_NO_BREAKER)) as daemon:
+        client = ServeClient(*daemon.address)
+        sid = client.create_session()
+        gate = _Gate(daemon)
+        try:
+            jid = client.submit_async(sid, _CREATE)
+            # the job never finishes while gated: the deadline bounds
+            # the poll loop with a STRUCTURED error a caller can read
+            with pytest.raises(ServeJobTimeoutError) as ex:
+                client.wait(jid, poll=0.02, deadline=0.3)
+            err = ex.value
+            assert err.job_id == jid
+            assert err.deadline == 0.3
+            assert err.last_snapshot["status"] in ("queued", "running")
+            assert jid in str(err)
+            assert isinstance(err, TimeoutError)
+        finally:
+            gate.restore()
+        # released: the same wait (registered default deadline) settles
+        assert client.wait(jid)["status"] == "done"
+
+
+def test_wait_default_deadline_comes_from_registered_conf():
+    from fugue_tpu.constants import (
+        FUGUE_CONF_SERVE_SYNC_WAIT,
+        conf_default,
+    )
+
+    # the default budget is the daemon's own sync-submit budget — a
+    # lost job id can hang a caller at most that long
+    assert conf_default(FUGUE_CONF_SERVE_SYNC_WAIT) == 600.0

@@ -1,0 +1,503 @@
+"""Serving-fleet chaos gate (ISSUE 13): router affinity, hard-kill
+failover with journal adoption under live load, rolling restart with a
+continuous client loop and zero failed calls, the ``serve.route`` fault
+site, router-journal restarts, and the cross-replica fs result cache.
+Tier-1 compatible; select with ``-m fleet``."""
+
+import random
+import socket
+import threading
+import time
+
+import pytest
+
+from fugue_tpu.constants import (
+    FUGUE_CONF_SERVE_BREAKER_THRESHOLD,
+    FUGUE_CONF_SERVE_DRAIN_TIMEOUT,
+    FUGUE_CONF_SERVE_FLEET_DEATH_THRESHOLD,
+    FUGUE_CONF_SERVE_FLEET_HEALTH_INTERVAL,
+    FUGUE_CONF_SERVE_FLEET_RESULT_CACHE_DIR,
+    FUGUE_CONF_SERVE_MAX_CONCURRENT,
+    FUGUE_CONF_SERVE_RESULT_CACHE,
+    FUGUE_CONF_SERVE_STATE_PATH,
+)
+from fugue_tpu.serve import (
+    FleetRouter,
+    ServeAPIError,
+    ServeClient,
+    ServeFleet,
+)
+from fugue_tpu.testing.faults import (
+    KNOWN_SITES,
+    FaultPlan,
+    FaultSpec,
+    inject_faults,
+)
+
+pytestmark = [pytest.mark.serve, pytest.mark.chaos, pytest.mark.fleet]
+
+_SEED = 20260804
+_AGG = "SELECT k, SUM(v) AS s FROM t GROUP BY k"
+
+
+def _fleet_conf(tmp_path, **extra):
+    conf = {
+        FUGUE_CONF_SERVE_BREAKER_THRESHOLD: 0,
+        FUGUE_CONF_SERVE_STATE_PATH: str(tmp_path / "state"),
+        FUGUE_CONF_SERVE_FLEET_HEALTH_INTERVAL: 0.05,
+        FUGUE_CONF_SERVE_FLEET_DEATH_THRESHOLD: 1,
+        FUGUE_CONF_SERVE_MAX_CONCURRENT: 2,
+    }
+    conf.update(extra)
+    return conf
+
+
+def _tenant_rows(i: int):
+    rng = random.Random(_SEED + i)
+    return [(k, rng.randrange(1, 1000)) for k in (0, 0, 1, 1, 2)]
+
+
+def _tenant_create(i: int) -> str:
+    cells = ",".join(f"[{k},{v}]" for k, v in _tenant_rows(i))
+    return f"CREATE [{cells}] SCHEMA k:long,v:long"
+
+
+def _tenant_expected(i: int):
+    sums = {}
+    for k, v in _tenant_rows(i):
+        sums[k] = sums.get(k, 0) + v
+    return sorted([k, s] for k, s in sums.items())
+
+
+class _Gate:
+    """Freeze one replica's job execution so the kill point is exact."""
+
+    def __init__(self, daemon):
+        self._real = daemon.scheduler._execute
+        self.started = threading.Event()
+        self.release = threading.Event()
+        daemon.scheduler._execute = self
+        self._daemon = daemon
+
+    def __call__(self, job):
+        self.started.set()
+        self.release.wait(timeout=60)
+        return self._real(job)
+
+    def restore(self):
+        self.release.set()
+        self._daemon.scheduler._execute = self._real
+
+
+def _wait_until(cond, timeout=30.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# routing & affinity
+# ---------------------------------------------------------------------------
+def test_router_spreads_sessions_and_routes_by_affinity(tmp_path):
+    with ServeFleet(_fleet_conf(tmp_path), replicas=2) as fleet:
+        client = ServeClient(*fleet.address)
+        sids = [client.create_session() for _ in range(4)]
+        aff = fleet.router.affinity()
+        # least-loaded spread: 4 sessions over 2 replicas = 2 + 2
+        assert sorted(aff[s] for s in sids).count("r0") == 2
+        # every session's traffic lands on ITS replica: the saved hot
+        # table is visible on follow-up requests through the router
+        for i, sid in enumerate(sids):
+            r = client.sql(sid, _tenant_create(i), save_as="t",
+                           collect=False)
+            assert r["status"] == "done", r.get("error")
+            assert sorted(
+                client.sql(sid, _AGG)["result"]["rows"]
+            ) == _tenant_expected(i)
+            assert "t" in client.session(sid)["tables"]
+        # the replica actually owning the session is the affinity one
+        for sid in sids:
+            daemon = fleet.replica(aff[sid])
+            assert daemon.sessions.get(sid).session_id == sid
+        # fleet-wide aggregates answer through the router
+        status = client.status()
+        assert set(status["replicas"]) == {"r0", "r1"}
+        assert status["fleet"]["sessions"] == 4
+        # unknown session -> 404 from the router itself
+        with pytest.raises(ServeAPIError) as ex:
+            ServeClient(*fleet.address, retries=0).session("s-nope")
+        assert ex.value.status == 404
+
+
+def test_fleet_metrics_aggregate_with_replica_labels(tmp_path):
+    import urllib.request
+
+    with ServeFleet(_fleet_conf(tmp_path), replicas=2) as fleet:
+        client = ServeClient(*fleet.address)
+        sid = client.create_session()
+        client.sql(sid, _tenant_create(0), save_as="t", collect=False)
+        host, port = fleet.address
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/v1/metrics", timeout=10
+        ) as resp:
+            text = resp.read().decode("utf-8")
+        # router families plus BOTH replicas' expositions, relabeled
+        assert "fugue_fleet_requests_total" in text
+        assert 'replica="r0"' in text and 'replica="r1"' in text
+        # a daemon family carries the injected label
+        assert 'fugue_serve_sessions{replica="' in text
+
+
+# ---------------------------------------------------------------------------
+# the hard-kill acceptance gate
+# ---------------------------------------------------------------------------
+def test_hard_kill_failover_adopts_sessions_under_live_load(tmp_path):
+    fleet = ServeFleet(_fleet_conf(tmp_path), replicas=2).start()
+    try:
+        setup = ServeClient(*fleet.address)
+        # 4 tenants save seeded hot tables through the router (the
+        # committed saves the kill must not lose)
+        sids = []
+        for i in range(4):
+            sid = setup.create_session()
+            r = setup.sql(sid, _tenant_create(i), save_as="t",
+                          collect=False)
+            assert r["status"] == "done", r.get("error")
+            sids.append(sid)
+        aff = fleet.router.affinity()
+        victim = aff[sids[0]]
+        survivor = [r for r in fleet.replica_ids if r != victim][0]
+        victims = [sid for sid in sids if aff[sid] == victim]
+        assert len(victims) == 2  # the spread put 2 tenants on each
+
+        # freeze the victim and put one async agg per victim tenant
+        # mid-flight (queued/running when the replica dies)
+        gate = _Gate(fleet.replica(victim))
+        jids = {
+            sid: setup.submit_async(sid, _AGG, save_as="agg")
+            for sid in victims
+        }
+        assert gate.started.wait(timeout=30)
+        assert (
+            fleet.replica(victim).journal.describe()["pending_jobs"]
+            == len(victims)
+        )
+
+        # hard kill mid-flight; the router's health loop declares the
+        # replica dead and a survivor adopts its journal
+        fleet.kill_replica(victim)
+        gate.release.set()  # orphaned workers die harmlessly
+        assert _wait_until(
+            lambda: all(
+                r == survivor for r in fleet.router.affinity().values()
+            )
+        ), fleet.router.describe()
+
+        # live load rides the client retry budget through the window
+        client = ServeClient([fleet.address], retries=10)
+        for sid, jid in jids.items():
+            # the interrupted job finished on the SURVIVOR under its
+            # ORIGINAL id, with exact aggregate parity
+            snap = client.wait(jid, deadline=60)
+            assert snap["status"] == "done", snap.get("error")
+            assert snap["recovered"] is True
+        for i, sid in enumerate(sids):
+            # zero lost committed saves: every pre-kill table answers
+            # with the exact seeded aggregate, wherever it lives now
+            assert sorted(
+                client.sql(sid, _AGG)["result"]["rows"]
+            ) == _tenant_expected(i), sid
+        for sid in victims:
+            # the async save_as side effect landed exactly once
+            desc = client.session(sid)
+            assert "t" in desc["tables"] and "agg" in desc["tables"]
+        # the adopted tables passed fingerprint verification (corrupt
+        # artifacts would be counted + dropped)
+        sstat = fleet.replica(survivor).status()
+        assert sstat["fault_stats"]["integrity_rejected"] == 0
+        assert sstat["recovery"]["jobs_resubmitted"] == len(victims)
+        # the dead replica's journal was emptied: a restarted origin
+        # cannot double-own the moved sessions
+        from fugue_tpu.serve.state import ServeStateJournal
+
+        leftover = ServeStateJournal.read_state(
+            fleet.replica(survivor).engine.fs,
+            fleet.replica_state_path(victim),
+        )
+        assert leftover == {"sessions": {}, "jobs": {}}
+    finally:
+        fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# rolling restart under a continuous client loop
+# ---------------------------------------------------------------------------
+def test_rolling_restart_under_continuous_load_zero_failed_calls(tmp_path):
+    conf = _fleet_conf(
+        tmp_path,
+        **{
+            FUGUE_CONF_SERVE_FLEET_DEATH_THRESHOLD: 2,
+            FUGUE_CONF_SERVE_DRAIN_TIMEOUT: 15.0,
+        },
+    )
+    fleet = ServeFleet(conf, replicas=2).start()
+    stop = threading.Event()
+    failed, completed = [], []
+    try:
+        setup = ServeClient(*fleet.address)
+        sids = []
+        for i in range(4):
+            sid = setup.create_session()
+            setup.sql(sid, _tenant_create(i), save_as="t", collect=False)
+            sids.append(sid)
+        expected = {
+            sid: _tenant_expected(i) for i, sid in enumerate(sids)
+        }
+
+        def loop(sid):
+            client = ServeClient([fleet.address], retries=10, timeout=60)
+            while not stop.is_set():
+                try:
+                    snap = client.sql(sid, _AGG)
+                    if snap["status"] != "done" or sorted(
+                        snap["result"]["rows"]
+                    ) != expected[sid]:
+                        failed.append((sid, snap))
+                    else:
+                        completed.append(sid)
+                except Exception as ex:
+                    failed.append((sid, repr(ex)))
+                time.sleep(0.01)
+
+        threads = [
+            threading.Thread(target=loop, args=(sid,)) for sid in sids
+        ]
+        for t in threads:
+            t.start()
+        assert _wait_until(lambda: len(completed) >= 4)
+        stats = fleet.rolling_restart()
+        # keep traffic flowing after the last handoff before stopping
+        count_after = len(completed)
+        assert _wait_until(lambda: len(completed) >= count_after + 4)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert failed == [], failed[:3]
+        # every replica restarted; every session migrated at least once
+        assert [s["replica"] for s in stats["replicas"]] == ["r0", "r1"]
+        assert stats["migrated_sessions"] >= 4
+        # fresh daemons own the traffic now: both replicas healthy and
+        # the affinity map covers all sessions
+        states = fleet.router.check_health()
+        assert set(states.values()) == {"healthy"}
+        assert set(fleet.router.affinity()) == set(sids)
+    finally:
+        stop.set()
+        fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# serve.route fault site
+# ---------------------------------------------------------------------------
+def test_route_fault_site_registered_and_structured():
+    assert "serve.route" in KNOWN_SITES
+
+
+def test_route_fault_answers_structured_error_plane_survives(tmp_path):
+    with ServeFleet(_fleet_conf(tmp_path), replicas=2) as fleet:
+        client = ServeClient(*fleet.address, retries=0)
+        sid = client.create_session()
+        plan = FaultPlan(
+            FaultSpec(
+                "serve.route", match="* GET /v1/sessions/*", times=1,
+                error=RuntimeError("route chaos"),
+            ),
+            seed=_SEED,
+        )
+        with inject_faults(plan):
+            with pytest.raises(ServeAPIError) as ex:
+                client.session(sid)
+            assert ex.value.status == 500
+            assert ex.value.error["error"] == "RuntimeError"
+            assert plan.total("injected") == 1
+            # the fault surfaced at the ROUTER; the replica is intact
+            # and the very next forward succeeds
+            assert client.session(sid)["session_id"] == sid
+        # no replica was marked failed by the injected (router-side)
+        # fault: both still routable
+        states = {r["replica"]: r["state"] for r in fleet.router.replicas()}
+        assert set(states.values()) == {"healthy"}
+
+
+# ---------------------------------------------------------------------------
+# router restart: the affinity map is journaled
+# ---------------------------------------------------------------------------
+def test_router_restart_restores_affinity_from_journal(tmp_path):
+    conf = _fleet_conf(tmp_path)
+    fleet = ServeFleet(conf, replicas=2).start()
+    try:
+        client = ServeClient(*fleet.address)
+        sid = client.create_session()
+        client.sql(sid, _tenant_create(0), save_as="t", collect=False)
+        owner = fleet.router.affinity()[sid]
+        fleet.router.stop()
+        # a FRESH router on the same conf: the journaled affinity map
+        # resumes routing the existing session without guessing
+        router2 = FleetRouter(conf)
+        for rid in fleet.replica_ids:
+            daemon = fleet.replica(rid)
+            router2.attach(
+                rid, *daemon.address,
+                state_path=fleet.replica_state_path(rid),
+            )
+        router2.start()
+        try:
+            assert router2.affinity()[sid] == owner
+            c2 = ServeClient(*router2.address)
+            assert sorted(
+                c2.sql(sid, _AGG)["result"]["rows"]
+            ) == _tenant_expected(0)
+        finally:
+            router2.stop()
+    finally:
+        fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# cross-replica fs result cache
+# ---------------------------------------------------------------------------
+def test_fleet_result_cache_warm_starts_across_replicas(tmp_path):
+    # isolate the fs tier: the in-memory serve result cache is OFF, so
+    # every hit below is the shared-fs content-addressed cache
+    conf = _fleet_conf(
+        tmp_path,
+        **{
+            FUGUE_CONF_SERVE_RESULT_CACHE: False,
+            FUGUE_CONF_SERVE_FLEET_RESULT_CACHE_DIR: str(
+                tmp_path / "state" / "results"
+            ),
+        },
+    )
+    fleet = ServeFleet(conf, replicas=2).start()
+    try:
+        client = ServeClient([fleet.address], retries=10)
+        sid = client.create_session()
+        client.sql(sid, _tenant_create(1), save_as="t", collect=False)
+        owner = fleet.router.affinity()[sid]
+        expected = _tenant_expected(1)
+
+        def cache_counts(rid):
+            counts = fleet.replica(rid)._m_result_cache.as_int_dict()
+            return {str(k): int(v) for k, v in counts.items()}
+
+        # first run executes and STORES the content-addressed entry
+        assert sorted(client.sql(sid, _AGG)["result"]["rows"]) == expected
+        assert cache_counts(owner).get("fs_store", 0) >= 1, (
+            cache_counts(owner)
+        )
+        # resubmission on the same replica answers from the fs tier
+        assert sorted(client.sql(sid, _AGG)["result"]["rows"]) == expected
+        assert cache_counts(owner).get("fs_hit", 0) >= 1
+        # migrate the session (planned failover path), then resubmit:
+        # the NEW replica answers from the shared fs cache — the
+        # cross-replica warm start, zero execution of the moved query
+        survivor = [r for r in fleet.replica_ids if r != owner][0]
+        fleet.restart_replica(owner)
+        assert fleet.router.affinity()[sid] == survivor
+        assert sorted(client.sql(sid, _AGG)["result"]["rows"]) == expected
+        assert cache_counts(survivor).get("fs_hit", 0) >= 1, (
+            cache_counts(survivor)
+        )
+    finally:
+        fleet.stop()
+
+
+def test_resave_after_migration_cleans_origin_artifact(tmp_path):
+    import pathlib
+
+    fleet = ServeFleet(_fleet_conf(tmp_path), replicas=2).start()
+    try:
+        client = ServeClient([fleet.address], retries=10)
+        sid = client.create_session()
+        client.sql(sid, _tenant_create(3), save_as="t", collect=False)
+        owner = fleet.router.affinity()[sid]
+        origin_artifact = (
+            pathlib.Path(fleet.replica_state_path(owner))
+            / "tables" / sid / "t.parquet"
+        )
+        assert origin_artifact.exists()
+        fleet.restart_replica(owner)  # planned migration to the peer
+        survivor = fleet.router.affinity()[sid]
+        assert survivor != owner
+        # overwrite the ADOPTED, never-queried table (durable-only
+        # record) directly: the new artifact lands under the SURVIVOR's
+        # journal and the origin file is removed, not leaked forever
+        daemon = fleet.replica(survivor)
+        import pandas as pd
+
+        daemon.sessions.get(sid).save_table(
+            "t", daemon.engine.to_df(pd.DataFrame({"k": [0], "v": [7]}))
+        )
+        new_artifact = (
+            pathlib.Path(fleet.replica_state_path(survivor))
+            / "tables" / sid / "t.parquet"
+        )
+        assert new_artifact.exists()
+        assert not origin_artifact.exists()
+        assert sorted(
+            client.sql(sid, _AGG)["result"]["rows"]
+        ) == [[0, 7]]
+    finally:
+        fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# no-survivor orphan window: requests answer 503, not corruption
+# ---------------------------------------------------------------------------
+def test_single_replica_death_answers_503_until_replacement(tmp_path):
+    conf = _fleet_conf(tmp_path)
+    fleet = ServeFleet(conf, replicas=1).start()
+    try:
+        client = ServeClient(*fleet.address, retries=0)
+        sid = client.create_session()
+        client.sql(sid, _tenant_create(2), save_as="t", collect=False)
+        fleet.kill_replica("r0")
+        assert _wait_until(
+            lambda: fleet.router.replica_state("r0") == "dead"
+        )
+        # no survivor: the session stays mapped (failover pending) and
+        # requests shed with 503 + Retry-After instead of wedging
+        with pytest.raises(ServeAPIError) as ex:
+            client.sql(sid, _AGG)
+        assert ex.value.status == 503
+        assert ex.value.retry_after is not None
+        # a replacement replica arrives on a FRESH slot; the pending
+        # failover adopts the dead replica's journal into it on the
+        # next health tick
+        from fugue_tpu.serve.daemon import ServeDaemon
+        from fugue_tpu.utils.params import ParamDict
+
+        rconf = ParamDict(fleet._replica_confs["r0"])
+        rconf[FUGUE_CONF_SERVE_STATE_PATH] = str(
+            tmp_path / "state" / "replicas" / "r1"
+        )
+        replacement = ServeDaemon(rconf, "jax").start()
+        try:
+            fleet.router.attach(
+                "r1", *replacement.address,
+                state_path=rconf[FUGUE_CONF_SERVE_STATE_PATH],
+            )
+            assert _wait_until(
+                lambda: fleet.router.affinity().get(sid) == "r1"
+            ), fleet.router.describe()
+            retry_client = ServeClient([fleet.address], retries=10)
+            assert sorted(
+                retry_client.sql(sid, _AGG)["result"]["rows"]
+            ) == _tenant_expected(2)
+        finally:
+            replacement.stop()
+    finally:
+        fleet.stop()
