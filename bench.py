@@ -385,6 +385,72 @@ def _observability_overhead(
     }
 
 
+def _profiler_overhead(
+    pdf: Any, jax_udf: Callable, n_rows: int
+) -> Dict[str, Any]:
+    """Profiler overhead block (ISSUE 14): the SAME workflow pipeline as
+    ``detail.observability`` with the per-task profiler ON
+    (``fugue.obs.profile`` + ``fugue.obs.enabled``) vs everything OFF.
+    The profiled run must stay within 1.05x — the profiler's per-task
+    row counts, byte estimates and counter sampling live at task
+    granularity, not per row, so the bar is the same as obs alone."""
+    from fugue_tpu.column import col
+    from fugue_tpu.column import functions as ff
+    from fugue_tpu.execution import make_execution_engine
+    from fugue_tpu.workflow.workflow import FugueWorkflow
+
+    rows = min(int(n_rows), 2_000_000)  # per-iteration ingest: bound it
+    sub = pdf.iloc[:rows]
+    last_profile: Dict[str, Any] = {}
+
+    def run_on(eng: Any, capture: bool = False) -> float:
+        def once() -> None:
+            dag = FugueWorkflow()
+            df = dag.df(sub)
+            out = df.transform(jax_udf, schema="k:int,v2:float")
+            agg = out.partition_by("k").aggregate(
+                s=ff.sum(col("v2")), m=ff.avg(col("v2")),
+                c=ff.count(col("v2")),
+            )
+            agg.yield_dataframe_as("res", as_local=True)
+            res = dag.run(eng)
+            res["res"].as_array()
+            if capture:
+                prof = res.profile()
+                if prof is not None:
+                    last_profile["tasks"] = len(prof.records)
+                    last_profile["top"] = prof.top_tasks(1)
+
+        return _timed(once, warm=3)
+
+    prof_off = make_execution_engine("jax")
+    prof_on = make_execution_engine(
+        "jax",
+        {"fugue.obs.enabled": True, "fugue.obs.profile": True},
+    )
+    off_secs = run_on(prof_off)
+    on_secs = run_on(prof_on, capture=True)
+    ratio = on_secs / max(off_secs, 1e-9)
+    within_noise = ratio <= 1.05
+    if not within_noise:
+        import sys
+
+        print(
+            f"WARNING: profiler-on run {ratio:.2f}x the profiler-off run "
+            "(> 1.05 band) — per-task profiler overhead regressed",
+            file=sys.stderr,
+        )
+    return {
+        "rows": rows,
+        "profile_on_secs": round(on_secs, 4),
+        "profile_off_secs": round(off_secs, 4),
+        "overhead_ratio": round(ratio, 3),
+        "within_noise": within_noise,
+        "tasks_profiled": last_profile.get("tasks", 0),
+        "top_task": (last_profile.get("top") or [{}])[0],
+    }
+
+
 def _optimizer_pipeline_bench(n: int, warm: int = 3) -> Dict[str, Any]:
     """ISSUE 10: narrow-consumer e2e parquet pipeline, optimizer on vs
     off. The WIDE file (8 columns) feeds load -> filter -> select(k, v)
@@ -564,6 +630,12 @@ def _bench_headline() -> Dict[str, Any]:
         n_native,
     )
 
+    profiler_block = _profiler_overhead(
+        pd.DataFrame({"k": keys[:n_native], "v": values[:n_native]}),
+        jax_udf,
+        n_native,
+    )
+
     optimizer_block = _optimizer_pipeline_bench(_scale(2_000_000))
 
     return {
@@ -588,6 +660,7 @@ def _bench_headline() -> Dict[str, Any]:
             "strategy_counts": dict(engine.strategy_counts),
             "memory": memory_block,
             "observability": observability_block,
+            "profiler": profiler_block,
             "optimizer": optimizer_block,
             "devices": len(jax.devices()),
             "platform": jax.devices()[0].platform,

@@ -81,7 +81,12 @@ from fugue_tpu.workflow import (
     WorkflowDataFrame,
     module,
 )
-from fugue_tpu.workflow.api import out_transform, raw_sql, transform
-from fugue_tpu.sql_frontend.api import fugue_sql, fugue_sql_flow, lint_sql  # noqa: E402
+from fugue_tpu.workflow.api import explain, out_transform, raw_sql, transform
+from fugue_tpu.sql_frontend.api import (  # noqa: E402
+    explain_sql,
+    fugue_sql,
+    fugue_sql_flow,
+    lint_sql,
+)
 
 import fugue_tpu.registry  # noqa: F401  (registers builtin engines)

@@ -247,6 +247,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                 file=sys.stdout,
             )
             failed = failed or opt_failed
+        # EXPLAIN leg (ISSUE 14): every corpus workflow plus a
+        # representative FugueSQL script must render a clean plan
+        # report (text + JSON) — a crashing EXPLAIN is a failed gate
+        try:
+            from fugue_tpu.analysis.selftest import run_explain_check
+
+            explained = run_explain_check()
+            print(
+                f"explain-check passed: {len(explained)} plans rendered",
+                file=sys.stdout,
+            )
+        except Exception as ex:
+            print(
+                f"explain-check FAILED: {type(ex).__name__}: {ex}",
+                file=sys.stdout,
+            )
+            failed = True
         # both planes, one command: the workflow-corpus gate above plus
         # the FLN source lint of the installed tree
         src_errors = _run_source_lint(None, args.baseline, floor, sys.stdout)

@@ -16,6 +16,8 @@ from fugue_tpu.analysis.diagnostics import (
 )
 from fugue_tpu.constants import (
     FUGUE_CONF_OBS_ENABLED,
+    FUGUE_CONF_OBS_PROFILE,
+    FUGUE_CONF_OBS_SLOW_QUERY_MS,
     FUGUE_CONF_OBS_TRACE_PATH,
     FUGUE_CONF_SERVE_FLEET_REPLICAS,
     FUGUE_CONF_SERVE_MAX_CONCURRENT,
@@ -215,6 +217,56 @@ class FleetSharedStateRule(Rule):
                 "rolling-restart fresh daemon) re-pays full XLA "
                 "compilation instead of warm-starting from the fleet's "
                 "shared executable cache",
+            )
+
+
+@register_rule
+class ObsDependentConfWithoutObsRule(Rule):
+    code = "FWF505"
+    severity = Severity.WARN
+    description = (
+        "fugue.obs.slow_query_ms or fugue.obs.profile is set but "
+        "fugue.obs.enabled is off: the conf is silently inert"
+    )
+
+    def check(self, ctx: Any) -> Iterable[Diagnostic]:
+        try:
+            # _convert, not bool(): conf values legitimately arrive as
+            # strings, and bool("false") is True (FWF404's idiom)
+            enabled = _convert(
+                ctx.conf.get(FUGUE_CONF_OBS_ENABLED, False), bool
+            )
+        except Exception:
+            enabled = False
+        if enabled:
+            return
+        try:
+            slow_ms = float(
+                ctx.conf.get(FUGUE_CONF_OBS_SLOW_QUERY_MS, 0.0) or 0.0
+            )
+        except Exception:
+            slow_ms = 0.0
+        if slow_ms > 0:
+            yield self.diag(
+                f"fugue.obs.slow_query_ms={slow_ms:g} but fugue.obs.enabled "
+                "is off: embedded runs never open a trace, so no slow-query "
+                "record (or span breakdown) is ever produced — set "
+                "fugue.obs.enabled=true (or drop the threshold)",
+            )
+        try:
+            profile = _convert(
+                ctx.conf.get(FUGUE_CONF_OBS_PROFILE, False), bool
+            )
+        except Exception:
+            profile = False
+        if profile:
+            yield self.diag(
+                "fugue.obs.profile is on but fugue.obs.enabled is off: the "
+                "profiler's conf gate needs the span tracer for the "
+                "compile/execute/transfer split, so runs are NOT profiled "
+                "and FugueWorkflowResult.profile() stays None — set "
+                "fugue.obs.enabled=true (the serving 'profile' submission "
+                "flag forces profiling per request instead)",
             )
 
 

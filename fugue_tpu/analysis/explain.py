@@ -1,0 +1,356 @@
+"""EXPLAIN: the static plan report over a built-but-unexecuted DAG.
+
+One call renders what ``run()`` would actually execute, without running
+anything:
+
+- the **optimizer-rewritten task tree** — the same clone-and-pin rewrite
+  phase ``run()`` performs (FWF501's dry-run machinery), so the tree
+  shows the fused/pruned/narrowed plan with every applied and declined
+  rewrite note attached to its task;
+- **propagated schemas** from the analyzer's shared ``schema_pass``
+  sweep (full schema, names-only, or unknown-with-reason);
+- **estimated rows and device bytes** — statically-known create sizes
+  through the FWF303 estimator (the PR 4 dtype-widening admission
+  estimate), propagated through row-preserving edges.
+
+The report renders as a text tree (``to_text``) and as JSON
+(``to_dict``). EXPLAIN ANALYZE is the same tree with a
+:class:`~fugue_tpu.obs.profile.RunProfile` merged in
+(:meth:`ExplainReport.attach_profile`): each node gains the observed
+rows in/out, device bytes, wall/compile/execute/transfer split, queue
+wait and cache events of the run, attributed by the pinned task uuids —
+rewrites never change identities, so the static and runtime halves key
+on the same ids by construction.
+"""
+
+from typing import Any, Dict, List, Optional
+
+from fugue_tpu.analysis.schema_pass import SchemaInfo, propagate
+from fugue_tpu.extensions import builtins as _b
+from fugue_tpu.workflow.tasks import FugueTask
+
+# extensions that preserve their input's row count exactly — enough to
+# thread statically-known create sizes through projection-ish chains
+_ROW_PRESERVING = (
+    _b.Rename,
+    _b.AlterColumns,
+    _b.DropColumns,
+    _b.SelectColumnsP,
+    _b.Assign,
+    _b.Fillna,
+)
+
+
+def _ext_name(task: FugueTask) -> str:
+    ext = task.extension
+    if isinstance(ext, type):
+        return ext.__name__
+    if callable(ext) and hasattr(ext, "__name__"):
+        return ext.__name__
+    return type(ext).__name__
+
+
+def _schema_text(info: SchemaInfo) -> str:
+    if info.schema is not None:
+        return str(info.schema)
+    if info.columns is not None:
+        return "columns[" + ",".join(info.columns) + "]"
+    return f"unknown({info.reason})" if info.reason else "unknown"
+
+
+def _fmt_bytes(n: Optional[int]) -> str:
+    if n is None:
+        return "?"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024.0
+    return f"{n}B"  # pragma: no cover - unreachable
+
+
+class ExplainNode:
+    """One task in the (optimizer-rewritten) plan tree."""
+
+    __slots__ = (
+        "task",
+        "uuid",
+        "name",
+        "task_type",
+        "extension",
+        "callsite",
+        "schema_text",
+        "est_rows",
+        "est_device_bytes",
+        "rewrites",
+        "inputs",
+        "profile",
+    )
+
+    def __init__(self, task: FugueTask, info: SchemaInfo):
+        self.task = task
+        self.uuid = task.__uuid__()
+        self.name = task.name
+        self.task_type = task.task_type
+        self.extension = _ext_name(task)
+        self.callsite = list(task.callsite or [])
+        self.schema_text = _schema_text(info)
+        self.est_rows: Optional[int] = None
+        self.est_device_bytes: Optional[int] = None
+        self.rewrites: List[str] = []
+        self.inputs: List[str] = [t.__uuid__() for t in task.inputs]
+        self.profile: Optional[Dict[str, Any]] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "uuid": self.uuid,
+            "name": self.name,
+            "type": self.task_type,
+            "extension": self.extension,
+            "callsite": list(self.callsite),
+            "schema": self.schema_text,
+            "est_rows": self.est_rows,
+            "est_device_bytes": self.est_device_bytes,
+            "inputs": list(self.inputs),
+        }
+        if self.rewrites:
+            out["rewrites"] = list(self.rewrites)
+        if self.profile is not None:
+            out["profile"] = dict(self.profile)
+        return out
+
+
+class ExplainReport:
+    """The plan report: nodes in dependency order + rewrite notes."""
+
+    def __init__(
+        self,
+        nodes: List[ExplainNode],
+        notes: List[Any],
+        optimized: bool,
+    ):
+        self.nodes = nodes
+        self.notes = list(notes)
+        self.optimized = optimized
+        self._by_uuid = {n.uuid: n for n in nodes}
+        self.analyzed = False  # flips when a RunProfile is merged in
+
+    def node(self, uuid: str) -> Optional[ExplainNode]:
+        return self._by_uuid.get(uuid)
+
+    @property
+    def applied_rewrites(self) -> List[str]:
+        return [n.describe() for n in self.notes if n.applied]
+
+    def attach_profile(self, run_profile: Any) -> "ExplainReport":
+        """Merge a run's per-task observations (EXPLAIN ANALYZE). Keyed
+        by task uuid — the pinned-uuid rewrite invariant is what makes
+        the static and runtime trees line up."""
+        self.analyzed = True
+        for node in self.nodes:
+            rec = run_profile.task(node.uuid)
+            if rec is not None:
+                node.profile = rec.as_dict()
+        return self
+
+    # ---- rendering -------------------------------------------------------
+    def _node_line(self, node: ExplainNode) -> str:
+        head = f"{node.name} [{node.task_type}]"
+        parts = [f"schema={node.schema_text}"]
+        if node.est_rows is not None:
+            parts.append(f"est_rows={node.est_rows}")
+        if node.est_device_bytes is not None:
+            parts.append(
+                f"est_device_bytes={_fmt_bytes(node.est_device_bytes)}"
+            )
+        p = node.profile
+        if p is not None:
+            obs = [
+                f"rows_in={p.get('rows_in')}",
+                f"rows_out={p.get('rows_out')}",
+                f"bytes={_fmt_bytes(p.get('device_bytes'))}",
+                f"wall={p.get('wall_ms')}ms",
+            ]
+            phases = p.get("phases") or {}
+            for k in ("compile_ms", "execute_ms", "transfer_ms"):
+                if k in phases:
+                    obs.append(f"{k.split('_')[0]}={phases[k]}ms")
+            if p.get("queue_wait_ms"):
+                obs.append(f"queued={p['queue_wait_ms']}ms")
+            cache = p.get("cache") or {}
+            if cache:
+                obs.append(f"cache={cache}")
+            parts.append("actual(" + " ".join(obs) + ")")
+        return head + " " + " ".join(parts)
+
+    def to_text(self) -> str:
+        """The plan as an indented tree rooted at the sink tasks (tasks
+        no other task consumes). A node with several consumers renders
+        its subtree once; later references are ``(ref)`` lines."""
+        consumed = {u for n in self.nodes for u in n.inputs}
+        sinks = [n for n in self.nodes if n.uuid not in consumed]
+        title = "EXPLAIN ANALYZE" if self.analyzed else "EXPLAIN"
+        lines: List[str] = [
+            f"{title} ({'optimized' if self.optimized else 'unoptimized'} "
+            f"plan, {len(self.nodes)} tasks)"
+        ]
+        rendered: set = set()
+        # explicit stack, not recursion: a deep linear DAG the runner
+        # executes fine must EXPLAIN fine too (no RecursionError)
+        stack = [(sink, 0) for sink in reversed(sinks)]
+        while stack:
+            node, depth = stack.pop()
+            pad = "  " * depth
+            if node.uuid in rendered:
+                lines.append(f"{pad}(ref) {node.name}")
+                continue
+            rendered.add(node.uuid)
+            lines.append(pad + self._node_line(node))
+            for note in node.rewrites:
+                lines.append(f"{pad}  * {note}")
+            if node.callsite:
+                lines.append(f"{pad}  @ {node.callsite[0].strip()}")
+            for dep in reversed(node.inputs):
+                child = self._by_uuid.get(dep)
+                if child is not None:
+                    stack.append((child, depth + 1))
+        unattached = [
+            n.describe()
+            for n in self.notes
+            if not getattr(n, "task_name", "")
+        ]
+        if unattached:
+            lines.append("rewrites:")
+            lines.extend(f"  * {d}" for d in unattached)
+        return "\n".join(lines)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "optimized": self.optimized,
+            "analyzed": self.analyzed,
+            "tasks": [n.to_dict() for n in self.nodes],
+            "rewrites": {
+                "applied": [n.describe() for n in self.notes if n.applied],
+                "declined": [
+                    n.describe() for n in self.notes if not n.applied
+                ],
+            },
+        }
+
+
+def _estimate_rows(tasks: List[FugueTask]) -> Dict[str, Optional[int]]:
+    """Statically-known row counts: create sizes (the FWF303 estimator's
+    sources) threaded through row-preserving edges."""
+    import pandas as pd
+
+    from fugue_tpu.dataframe import DataFrame
+
+    rows: Dict[str, Optional[int]] = {}
+    for t in tasks:
+        est: Optional[int] = None
+        if t.task_type == "create" and t.extension is _b.CreateData:
+            data = t.params.get("data", None)
+            if isinstance(data, pd.DataFrame):
+                est = len(data)
+            elif isinstance(data, DataFrame):
+                try:
+                    if data.is_bounded and data.is_local:
+                        est = data.count()
+                except Exception:
+                    est = None
+            elif isinstance(data, (list, tuple)):
+                est = len(data)
+        elif t.extension in _ROW_PRESERVING and len(t.inputs) == 1:
+            est = rows.get(t.inputs[0].__uuid__())
+        rows[t.__uuid__()] = est
+    return rows
+
+
+def explain_tasks(
+    tasks: List[FugueTask], conf: Any = None, engine: Any = None
+) -> ExplainReport:
+    """Build the EXPLAIN report for a task list: dry-run the optimizer
+    under the same gate semantics as ``run()`` (clone-and-pin — the
+    caller's tasks are untouched), propagate schemas, estimate sizes.
+    An invalid ``fugue.optimize`` mode raises the same ValueError the
+    run would."""
+    from fugue_tpu.constants import FUGUE_CONF_OPTIMIZE
+    from fugue_tpu.optimize import optimize_enabled, optimize_tasks
+    from fugue_tpu.optimize.rewrite import OFF_VALUES
+
+    notes: List[Any] = []
+    plan_tasks = list(tasks)
+    optimized = False
+    # FWF501's gate semantics: "auto" with no known engine still
+    # dry-runs (lint mode must show the jax plan), an explicit off stays
+    # off, and an invalid mode raises exactly like run() would
+    mode = str(
+        (conf or {}).get(FUGUE_CONF_OPTIMIZE, "auto")
+    ).strip().lower()
+    if mode not in OFF_VALUES:
+        optimize_enabled(conf, engine)  # raises on an invalid mode
+        plan = optimize_tasks(tasks, conf=conf, engine=engine)
+        plan_tasks = plan.tasks
+        notes = plan.notes
+        optimized = True
+    infos, _issues = propagate(plan_tasks)
+    from fugue_tpu.analysis.schema_pass import UNKNOWN
+
+    nodes = [
+        ExplainNode(t, infos.get(id(t), UNKNOWN)) for t in plan_tasks
+    ]
+    report = ExplainReport(nodes, notes, optimized)
+    # attach rewrite notes to the task they describe (by display name —
+    # the attribution RewriteNote already carries)
+    by_name: Dict[str, ExplainNode] = {}
+    for n in nodes:
+        by_name.setdefault(n.name, n)
+    for note in notes:
+        target = by_name.get(getattr(note, "task_name", ""))
+        if target is not None:
+            target.rewrites.append(note.describe())
+    # size estimates: rows through row-preserving edges, bytes via the
+    # admission estimator over the propagated full schemas
+    est_rows = _estimate_rows(plan_tasks)
+    for n in nodes:
+        n.est_rows = est_rows.get(n.uuid)
+        info = infos.get(id(n.task))
+        if (
+            n.est_rows is not None
+            and info is not None
+            and info.schema is not None
+        ):
+            try:
+                from fugue_tpu.jax_backend.memory import (
+                    estimate_schema_device_bytes,
+                )
+
+                n.est_device_bytes = int(
+                    estimate_schema_device_bytes(info.schema, n.est_rows)
+                )
+            except Exception:
+                n.est_device_bytes = None
+    return report
+
+
+def explain_workflow(
+    workflow: Any, conf: Any = None, engine: Any = None
+) -> ExplainReport:
+    """EXPLAIN a built workflow (see :meth:`FugueWorkflow.explain`)."""
+    from fugue_tpu.utils.params import ParamDict
+
+    merged = ParamDict(getattr(workflow, "_conf", None))
+    engine_conf = getattr(engine, "conf", None)
+    if engine_conf is not None:
+        merged.update(ParamDict(engine_conf))
+    # re-apply the workflow's fugue.optimize* precedence AFTER the
+    # engine merge: an engine value still equal to the registered
+    # default must not shadow an explicit compile-conf setting, or
+    # EXPLAIN would describe a plan run() never executes
+    overlay = getattr(workflow, "_overlay_optimize_conf", None)
+    if overlay is not None:
+        merged = overlay(merged)
+    merged.update(ParamDict(conf))
+    tasks = getattr(workflow, "tasks", None)
+    if tasks is None:
+        tasks = list(getattr(workflow, "_tasks", []))
+    return explain_tasks(tasks, conf=merged, engine=engine)

@@ -180,3 +180,37 @@ def optimize_check_failed(
         for _, _, diags in results
         for d in diags
     )
+
+
+# a representative FugueSQL multi-statement script for the explain_sql
+# leg of the gate — same shapes the serve plane compiles per request
+_EXPLAIN_SQL = """
+a = CREATE [[0, 1.0], [1, 2.0], [0, 3.0]] SCHEMA k:int,v:double
+b = CREATE [[0, 'x'], [1, 'y']] SCHEMA k:int,name:str
+SELECT a.k, name, v FROM a INNER JOIN b ON a.k = b.k WHERE v > 1.0
+YIELD DATAFRAME AS res
+"""
+
+
+def run_explain_check() -> List[Tuple[str, str]]:
+    """EXPLAIN gate: render every corpus workflow's plan report (text +
+    JSON) plus an ``explain_sql`` pass over a representative FugueSQL
+    script. Any exception propagates — a crashing EXPLAIN is a broken
+    pre-merge gate, exactly like a crashing rule corpus. Returns
+    (name, rendered text) pairs for the CLI to summarize."""
+    import json
+
+    out: List[Tuple[str, str]] = []
+    for name, build in WORKFLOW_BUILDERS.items():
+        dag = build()
+        report = dag.explain(conf=dag._conf)
+        text = report.to_text()
+        json.dumps(report.to_dict())  # JSON form must serialize clean
+        assert text.startswith("EXPLAIN"), text[:60]
+        out.append((name, text))
+    from fugue_tpu.sql_frontend.workflow_sql import explain_sql
+
+    report = explain_sql(_EXPLAIN_SQL)
+    json.dumps(report.to_dict())
+    out.append(("explain_sql", report.to_text()))
+    return out

@@ -67,10 +67,15 @@ from fugue_tpu.execution.api import (
 )
 
 # workflow-level entry points
-from fugue_tpu.workflow.api import out_transform, raw_sql, transform
+from fugue_tpu.workflow.api import explain, out_transform, raw_sql, transform
 
 # sql entry points
-from fugue_tpu.sql_frontend.api import fugue_sql, fugue_sql_flow, lint_sql
+from fugue_tpu.sql_frontend.api import (
+    explain_sql,
+    fugue_sql,
+    fugue_sql_flow,
+    lint_sql,
+)
 
 # column algebra re-exports (fa.col, fa.lit usable in select/filter)
 from fugue_tpu.column import all_cols, col, lit, null

@@ -94,6 +94,9 @@ FUGUE_CONF_OBS_ENABLED = "fugue.obs.enabled"
 FUGUE_CONF_OBS_TRACE_PATH = "fugue.obs.trace_path"
 FUGUE_CONF_OBS_SLOW_QUERY_MS = "fugue.obs.slow_query_ms"
 FUGUE_CONF_OBS_SAMPLE_RATE = "fugue.obs.sample_rate"
+FUGUE_CONF_OBS_PROFILE = "fugue.obs.profile"
+FUGUE_CONF_STATS_PATH = "fugue.stats.path"
+FUGUE_CONF_STATS_HISTORY = "fugue.stats.history"
 
 FUGUE_COMPILE_TIME_CONFIGS = {
     FUGUE_CONF_WORKFLOW_EXCEPTION_HIDE,
@@ -714,6 +717,41 @@ def _declare_defaults() -> None:
         float,
         1.0,
         "fraction of eligible requests/runs that open a trace",
+        in_defaults=False,
+    )
+    # per-task profiler (ISSUE 14): rows in/out, device bytes, compile/
+    # execute/transfer split, queue wait, retries and cache events per
+    # DAG task, surfaced as FugueWorkflowResult.profile() (EXPLAIN
+    # ANALYZE). Needs fugue.obs.enabled for the span-derived phase
+    # split — FWF505 warns about the silently inert combination.
+    r(
+        FUGUE_CONF_OBS_PROFILE,
+        bool,
+        False,
+        "per-task runtime profiler (EXPLAIN ANALYZE); inert unless "
+        "fugue.obs.enabled is also on",
+        in_defaults=False,
+    )
+    # persisted runtime-statistics store (fugue_tpu/obs/stats_store.py):
+    # profiled runs append per-task-uuid observed rows/bytes/timings
+    # into a bounded ring per query fingerprint under this dir/URI via
+    # engine.fs — the statistics the phase-2 cost model / adaptive
+    # re-planning (ROADMAP item 1) will read. The serving daemon
+    # defaults it to <fugue.serve.state_path>/stats.
+    r(
+        FUGUE_CONF_STATS_PATH,
+        str,
+        "",
+        "dir/URI (via engine.fs) of the persisted runtime-statistics "
+        "store ('' = off; serving defaults to <state_path>/stats)",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_STATS_HISTORY,
+        int,
+        32,
+        "observations kept per query fingerprint in the runtime-"
+        "statistics store (bounded ring)",
         in_defaults=False,
     )
     # runtime lock-order sanitizer (testing/locktrace.py): debug-only.

@@ -44,6 +44,16 @@ from fugue_tpu.obs.metrics import (  # noqa: F401
     MetricsRegistry,
     parse_prometheus_text,
 )
+from fugue_tpu.obs.profile import (  # noqa: F401
+    Profiler,
+    RunProfile,
+    TaskProfile,
+    current_task_profile,
+    force_profiling,
+    note_cache_event,
+    profiling_forced,
+    profiling_requested,
+)
 from fugue_tpu.obs.trace import (  # noqa: F401
     NULL_CM,
     NULL_SPAN,
@@ -60,18 +70,26 @@ from fugue_tpu.obs.trace import (  # noqa: F401
 __all__ = [
     "MetricsRegistry",
     "ObsOptions",
+    "Profiler",
+    "RunProfile",
     "Span",
+    "TaskProfile",
     "Trace",
     "activate",
     "begin_span",
     "chrome_trace_events",
     "current_span",
+    "current_task_profile",
     "export_trace",
     "finalize_trace",
+    "force_profiling",
     "maybe_log_slow_query",
+    "note_cache_event",
     "obs_options",
     "open_trace",
     "parse_prometheus_text",
+    "profiling_forced",
+    "profiling_requested",
     "span_breakdown",
     "start_span",
 ]
@@ -137,6 +155,7 @@ def finalize_trace(
     log: Any = None,
     registry: Any = None,
     finish_root: bool = True,
+    profile: Any = None,
     **slow_detail: Any,
 ) -> Optional[str]:
     """Finish an OWNED trace: end the root span (idempotent; pass
@@ -163,6 +182,7 @@ def finalize_trace(
             opts.slow_query_ms,
             log=log,
             registry=registry,
+            profile=profile,
             **slow_detail,
         )
     if opts.trace_path and fs is not None:

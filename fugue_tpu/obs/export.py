@@ -131,13 +131,17 @@ def maybe_log_slow_query(
     slow_query_ms: float,
     log: Any = None,
     registry: Any = None,
+    profile: Any = None,
     **detail: Any,
 ) -> Optional[Dict[str, Any]]:
     """Emit one structured slow-query record when ``duration_ms``
     crosses the configured threshold: a single JSON log line carrying
     the span breakdown (phases of the offending job) plus caller detail
-    (job id, session, sql hash). Returns the record (tests introspect
-    it); None when under threshold or the threshold is off."""
+    (job id, session, sql hash). With a run profile available, the
+    record also names the top-3 most expensive TASKS (name, user
+    callsite, phase split) — the "which line of my workflow is slow"
+    answer the per-phase rollup can't give. Returns the record (tests
+    introspect it); None when under threshold or the threshold is off."""
     if slow_query_ms <= 0 or duration_ms <= slow_query_ms:
         return None
     record: Dict[str, Any] = {
@@ -147,6 +151,11 @@ def maybe_log_slow_query(
     }
     if trace is not None:
         record["breakdown"] = span_breakdown(trace)
+    if profile is not None:
+        try:
+            record["top_tasks"] = profile.top_tasks(3)
+        except Exception:  # pragma: no cover - enrichment is best-effort
+            pass
     if registry is not None:
         registry.counter(
             SLOW_QUERIES,

@@ -45,6 +45,7 @@ METRIC_NAME_PREFIXES = (
     "fugue_serve_",
     "fugue_fleet_",
     "fugue_obs_",
+    "fugue_stats_",
     "fugue_workflow_",
 )
 

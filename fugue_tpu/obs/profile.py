@@ -1,0 +1,495 @@
+"""Per-task execution profiler (EXPLAIN ANALYZE's runtime half).
+
+The profiler rides the machinery PR 8 already put in place — the span
+tracer and the workflow runner's task hooks — and attributes runtime
+cost back to individual DAG tasks:
+
+- **rows in/out** of every task (metadata-cheap ``count()`` on bounded
+  frames; opaque/iterable frames record ``None`` rather than consume);
+- **device bytes** of each task's output — the REAL ledger bytes
+  (:func:`fugue_tpu.jax_backend.blocks.device_nbytes`) for materialized
+  jax frames, the PR 4 dtype-widening estimator otherwise;
+- the **wall / compile / execute / transfer split** from the engine
+  spans nested under the task's span (``engine.compile`` /
+  ``engine.execute`` / ``engine.transfer``), plus attempt counts from
+  the ``task.attempt`` spans;
+- **queue wait vs execution**: how long the task sat READY (every
+  dependency finished) before its worker actually started it;
+- **retries / degradations / fallbacks / cache events** — retry and
+  host-degrade counts from the run's :class:`RunStats`, engine
+  plan/exec-cache and fallback counter deltas sampled around the task,
+  and exact checkpoint / result-cache hits noted by the task layer
+  through the thread-local task scope.
+
+The off contract matches the tracer's: ``fugue.obs.profile`` off means
+``FugueWorkflow.run`` never constructs a profiler, the task wrapper
+takes the pre-existing code path (one ``is None`` check), and the task
+layer's cache-event hook is a single thread-local read returning None —
+no wrapper objects, no allocation (the bench's ``detail.profiler`` block
+holds the on/off ratio at ≤ ~1.05, same bar as ``detail.observability``).
+
+Phase attribution needs spans, so the profiler only activates through
+conf when ``fugue.obs.enabled`` is also on (FWF505 warns about the
+silently inert combination, mirroring FWF404); a per-request
+:func:`force_profiling` scope (the serving daemon's ``profile`` flag)
+activates it regardless and simply records empty phases when no trace
+is live.
+"""
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+# span names that make up a task's phase split
+_PHASE_SPANS = ("engine.compile", "engine.execute", "engine.transfer")
+
+_TLS = threading.local()
+
+
+def current_task_profile() -> Optional["TaskProfile"]:
+    """The record of the task executing on THIS thread, or None when
+    profiling is off (the allocation-free fast path: one thread-local
+    read)."""
+    return getattr(_TLS, "task", None)
+
+
+def note_cache_event(tier: str, result: str) -> None:
+    """Attribute one cache event (``tier`` in checkpoint/result/...,
+    ``result`` in hit/miss/store) to the task executing on this thread.
+    A no-op single thread-local read when profiling is off."""
+    rec = getattr(_TLS, "task", None)
+    if rec is not None:
+        rec.note_cache(tier, result)
+
+
+class _TaskScope:
+    """Attaches one task's record as this thread's current profile
+    target for the duration of the task body (paired set/restore — the
+    FLN103 contract); the deep layers' :func:`note_cache_event` reads
+    it through the thread-local."""
+
+    __slots__ = ("_rec", "_prev")
+
+    def __init__(self, rec: "TaskProfile"):
+        self._rec = rec
+        self._prev: Optional["TaskProfile"] = None
+
+    def __enter__(self) -> "TaskProfile":
+        self._prev = getattr(_TLS, "task", None)
+        _TLS.task = self._rec
+        return self._rec
+
+    def __exit__(self, *args: Any) -> bool:
+        # restore (not clear): an extension that runs a nested profiled
+        # workflow on this thread hands attribution back to the OUTER
+        # task when the inner one finishes
+        _TLS.task = self._prev
+        return False
+
+
+def task_scope(rec: "TaskProfile") -> _TaskScope:
+    return _TaskScope(rec)
+
+
+class _ForceCM:
+    """Thread-scoped per-request profiling override (the serving
+    daemon's ``profile: true`` submission flag)."""
+
+    __slots__ = ("_prev",)
+
+    def __enter__(self) -> None:
+        self._prev = getattr(_TLS, "force", False)
+        _TLS.force = True
+        return None
+
+    def __exit__(self, *args: Any) -> bool:
+        _TLS.force = self._prev
+        return False
+
+
+def force_profiling() -> Any:
+    """Scope in which ``FugueWorkflow.run`` profiles regardless of conf
+    (phases stay empty when no trace is live)."""
+    return _ForceCM()
+
+
+def profiling_forced() -> bool:
+    return getattr(_TLS, "force", False)
+
+
+def profiling_requested(conf: Any) -> bool:
+    """The conf gate: ``fugue.obs.profile`` AND ``fugue.obs.enabled``
+    (without the tracer the phase split has no source — FWF505 flags the
+    inert combination)."""
+    from fugue_tpu.constants import (
+        FUGUE_CONF_OBS_ENABLED,
+        FUGUE_CONF_OBS_PROFILE,
+        typed_conf_get,
+    )
+
+    return bool(typed_conf_get(conf, FUGUE_CONF_OBS_PROFILE)) and bool(
+        typed_conf_get(conf, FUGUE_CONF_OBS_ENABLED)
+    )
+
+
+def _safe_count(df: Any) -> Optional[int]:
+    """Row count when it is metadata-cheap and safe: bounded DataFrames
+    only (iterable frames raise instead of consuming; anything else
+    records None — the profiler must never change execution)."""
+    try:
+        if df is None or not getattr(df, "is_bounded", False):
+            return None
+        return int(df.count())
+    except Exception:
+        return None
+
+
+def _device_bytes(df: Any) -> Optional[int]:
+    """Output device footprint: REAL ledger bytes for a materialized
+    jax frame, the PR 4 widening estimate from (schema, rows) otherwise,
+    None when rows are unknowable."""
+    try:
+        blocks = getattr(df, "_blocks", None)
+        if blocks is not None and hasattr(blocks, "columns"):
+            from fugue_tpu.jax_backend.blocks import device_nbytes
+
+            return int(device_nbytes(blocks))
+        rows = _safe_count(df)
+        if rows is None:
+            return None
+        schema = getattr(df, "schema", None)
+        if schema is None:
+            return None
+        from fugue_tpu.jax_backend.memory import estimate_schema_device_bytes
+
+        return int(estimate_schema_device_bytes(schema, rows))
+    except Exception:
+        return None
+
+
+class TaskProfile:
+    """One task's runtime observation (built only while profiling)."""
+
+    __slots__ = (
+        "uuid",
+        "name",
+        "task_type",
+        "callsite",
+        "dep_uuids",
+        "rows_in",
+        "rows_out",
+        "device_bytes",
+        "started_at",
+        "ended_at",
+        "queue_wait_ms",
+        "phases",
+        "attempts",
+        "retries",
+        "degradations",
+        "cache",
+        "counters",
+        "error",
+        "span",
+    )
+
+    def __init__(self, task: Any, span: Any = None):
+        self.uuid = task.__uuid__()
+        self.name = task.name
+        self.task_type = task.task_type
+        self.callsite = list(task.callsite or [])
+        self.dep_uuids = [t.__uuid__() for t in task.inputs]
+        self.rows_in: List[Optional[int]] = []
+        self.rows_out: Optional[int] = None
+        self.device_bytes: Optional[int] = None
+        self.started_at = time.monotonic()
+        self.ended_at: Optional[float] = None
+        self.queue_wait_ms = 0.0
+        self.phases: Dict[str, float] = {}
+        self.attempts = 1
+        self.retries = 0
+        self.degradations = 0
+        self.cache: Dict[str, Dict[str, int]] = {}
+        self.counters: Dict[str, Dict[str, int]] = {}
+        self.error: Optional[str] = None
+        # the task's real Span (or None): phase attribution walks its
+        # subtree once at finalize
+        self.span = span if getattr(span, "span_id", None) is not None else None
+
+    @property
+    def wall_ms(self) -> float:
+        end = self.ended_at if self.ended_at is not None else time.monotonic()
+        return (end - self.started_at) * 1000.0
+
+    def note_cache(self, tier: str, result: str) -> None:
+        slot = self.cache.setdefault(tier, {})
+        slot[result] = slot.get(result, 0) + 1
+
+    def as_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "uuid": self.uuid,
+            "name": self.name,
+            "type": self.task_type,
+            "callsite": list(self.callsite),
+            "rows_in": list(self.rows_in),
+            "rows_out": self.rows_out,
+            "device_bytes": self.device_bytes,
+            "wall_ms": round(self.wall_ms, 3),
+            "queue_wait_ms": round(self.queue_wait_ms, 3),
+            "phases": {k: round(v, 3) for k, v in self.phases.items()},
+            "attempts": self.attempts,
+            "retries": self.retries,
+            "degradations": self.degradations,
+            "cache": {k: dict(v) for k, v in self.cache.items()},
+        }
+        if self.counters:
+            out["counters"] = {k: dict(v) for k, v in self.counters.items()}
+        if self.error is not None:
+            out["error"] = self.error
+        return out
+
+
+# engine counter surfaces sampled around each task (delta attribution);
+# each maps a profile key to the engine property carrying the dict
+_COUNTER_SURFACES = (
+    ("plan_cache", "plan_cache_stats"),
+    ("compile_cache", "compile_cache_stats"),
+    ("fallbacks", "fallbacks"),
+)
+
+
+class RunProfile:
+    """One run's profile: per-task records in execution order plus the
+    merged EXPLAIN tree (set by the workflow when available)."""
+
+    def __init__(self, workflow_uuid: str, concurrency: int = 1):
+        self.workflow_uuid = workflow_uuid
+        self.concurrency = int(concurrency)
+        self.records: Dict[str, TaskProfile] = {}
+        self.order: List[str] = []
+        self.started_at = time.monotonic()
+        self.total_ms = 0.0
+        self.report: Any = None  # ExplainReport, attached by the workflow
+        self._lock = threading.Lock()
+
+    # counter-delta attribution is exact only when tasks run serially
+    # (the default inner concurrency); concurrent tasks overlap on the
+    # shared engine counters, so the profile says so instead of lying
+    @property
+    def exact_attribution(self) -> bool:
+        return self.concurrency <= 1
+
+    def add(self, rec: TaskProfile) -> None:
+        # task uuids are CONTENT hashes: two spec-identical tasks (CSE
+        # off, or user duplicates) legitimately share one. Store every
+        # instance under a unique key (uuid, then uuid#2, uuid#3 …) so
+        # no observation is lost; uuid lookups resolve to the first
+        # instance — the same dedup the explain tree applies.
+        with self._lock:
+            key = rec.uuid
+            n = 2
+            while key in self.records:
+                key = f"{rec.uuid}#{n}"
+                n += 1
+            self.records[key] = rec
+            self.order.append(key)
+
+    def task(self, uuid: str) -> Optional[TaskProfile]:
+        return self.records.get(uuid)
+
+    def by_name(self, name: str) -> Optional[TaskProfile]:
+        for rec in self.records.values():
+            if rec.name == name:
+                return rec
+        return None
+
+    def finalize(
+        self, trace: Any = None, stats: Any = None
+    ) -> "RunProfile":
+        """Settle the run: total wall, queue waits from dependency end
+        times, phase splits from one walk of the trace's span forest,
+        retry/degrade counts from :class:`RunStats`."""
+        self.total_ms = (time.monotonic() - self.started_at) * 1000.0
+        # queue wait: time between READY (all deps ended; run start for
+        # roots) and the worker actually starting the task
+        for rec in self.records.values():
+            ready = self.started_at
+            for dep in rec.dep_uuids:
+                d = self.records.get(dep)
+                if d is not None and d.ended_at is not None:
+                    ready = max(ready, d.ended_at)
+            rec.queue_wait_ms = max(0.0, (rec.started_at - ready) * 1000.0)
+        if stats is not None:
+            retries = getattr(stats, "retries", None) or {}
+            degrades = getattr(stats, "degradations", None) or {}
+            for rec in self.records.values():
+                rec.retries = int(retries.get(rec.name, 0))
+                rec.degradations = int(degrades.get(rec.name, 0))
+        if trace is not None:
+            self._attach_spans(trace)
+        return self
+
+    def _attach_spans(self, trace: Any) -> None:
+        """One pass over the trace: group spans under each task's span
+        subtree and roll their durations up into the phase split."""
+        try:
+            with trace._lock:
+                spans = list(trace.spans)
+        except Exception:
+            return
+        children: Dict[int, List[Any]] = {}
+        for s in spans:
+            if s.parent_id is not None:
+                children.setdefault(s.parent_id, []).append(s)
+        for rec in self.records.values():
+            if rec.span is None:
+                continue
+            attempts = 0
+            stack = list(children.get(rec.span.span_id, []))
+            while stack:
+                s = stack.pop()
+                stack.extend(children.get(s.span_id, []))
+                if s.name in _PHASE_SPANS:
+                    key = s.name.split(".", 1)[1] + "_ms"
+                    rec.phases[key] = rec.phases.get(key, 0.0) + s.duration_ms
+                elif s.name == "task.attempt":
+                    attempts += 1
+            if attempts > 0:
+                rec.attempts = attempts
+
+    def top_tasks(self, n: int = 3) -> List[Dict[str, Any]]:
+        """The run's ``n`` most expensive tasks by wall clock — what the
+        slow-query log carries beyond the per-phase span breakdown."""
+        ranked = sorted(
+            self.records.values(), key=lambda r: r.wall_ms, reverse=True
+        )
+        out: List[Dict[str, Any]] = []
+        for rec in ranked[: max(0, n)]:
+            out.append(
+                {
+                    "name": rec.name,
+                    "callsite": rec.callsite[0] if rec.callsite else "",
+                    "wall_ms": round(rec.wall_ms, 3),
+                    "phases": {k: round(v, 3) for k, v in rec.phases.items()},
+                }
+            )
+        return out
+
+    def observation(self) -> Dict[str, Any]:
+        """The statistics-store payload: per-task-uuid observed rows /
+        bytes / timings for this run of this query fingerprint."""
+        return {
+            "workflow": self.workflow_uuid,
+            "total_ms": round(self.total_ms, 3),
+            "tasks": {
+                uuid: {
+                    "name": rec.name,
+                    "rows_in": list(rec.rows_in),
+                    "rows_out": rec.rows_out,
+                    "device_bytes": rec.device_bytes,
+                    "wall_ms": round(rec.wall_ms, 3),
+                    "phases": {
+                        k: round(v, 3) for k, v in rec.phases.items()
+                    },
+                }
+                for uuid, rec in self.records.items()
+            },
+        }
+
+    def as_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "workflow": self.workflow_uuid,
+            "concurrency": self.concurrency,
+            "exact_attribution": self.exact_attribution,
+            "total_ms": round(self.total_ms, 3),
+            "tasks": [self.records[u].as_dict() for u in self.order],
+        }
+        if self.report is not None:
+            out["plan"] = self.report.to_dict()
+        return out
+
+    def to_text(self) -> str:
+        """EXPLAIN ANALYZE rendering: the plan tree annotated with this
+        run's per-task observations (falls back to a flat listing when
+        no plan report is attached)."""
+        if self.report is not None:
+            self.report.attach_profile(self)
+            return self.report.to_text()
+        lines = [f"RunProfile {self.workflow_uuid[:12]} "
+                 f"total={self.total_ms:.1f}ms"]
+        for uuid in self.order:
+            rec = self.records[uuid]
+            lines.append(
+                f"  {rec.name}: rows={rec.rows_out} "
+                f"wall={rec.wall_ms:.1f}ms phases={rec.phases}"
+            )
+        return "\n".join(lines)
+
+
+class Profiler:
+    """The per-run collector ``FugueWorkflow.run`` owns while profiling
+    is active. ``begin``/``finish`` bracket each task on its worker
+    thread; the thread-local task scope is what lets deep layers
+    (checkpoint short-circuits, result caches) attribute events without
+    plumbing."""
+
+    def __init__(self, workflow_uuid: str, engine: Any, concurrency: int = 1):
+        self._engine = engine
+        self.profile = RunProfile(workflow_uuid, concurrency=concurrency)
+
+    def _sample(self) -> Dict[str, Dict[str, int]]:
+        out: Dict[str, Dict[str, int]] = {}
+        for key, attr in _COUNTER_SURFACES:
+            try:
+                val = getattr(self._engine, attr, None)
+                if isinstance(val, dict):
+                    out[key] = {
+                        k: int(v)
+                        for k, v in val.items()
+                        if isinstance(v, (int, float))
+                    }
+            except Exception:
+                pass
+        return out
+
+    def begin(self, task: Any, span: Any = None) -> TaskProfile:
+        """The task's record; the caller enters :func:`task_scope` with
+        it so the thread-local attach/detach stays a paired scope."""
+        rec = TaskProfile(task, span=span)
+        rec.counters = self._sample()  # baselines; finish() turns to deltas
+        return rec
+
+    def finish(
+        self,
+        rec: TaskProfile,
+        inputs: Any = None,
+        result: Any = None,
+        error: Any = None,
+    ) -> TaskProfile:
+        rec.ended_at = time.monotonic()
+        if error is not None:
+            rec.error = type(error).__name__
+        after = self._sample()
+        deltas: Dict[str, Dict[str, int]] = {}
+        for key, base in rec.counters.items():
+            cur = after.get(key, {})
+            d = {
+                k: cur.get(k, 0) - v
+                for k, v in base.items()
+                if cur.get(k, 0) - v != 0
+            }
+            for k, v in cur.items():
+                if k not in base and v != 0:
+                    d[k] = v
+            if d:
+                deltas[key] = d
+        rec.counters = deltas
+        if inputs is not None:
+            rec.rows_in = [_safe_count(i) for i in inputs]
+        if result is not None:
+            rec.rows_out = _safe_count(result)
+            rec.device_bytes = _device_bytes(result)
+        self.profile.add(rec)
+        return rec
+
+    def finalize(self, trace: Any = None, stats: Any = None) -> RunProfile:
+        return self.profile.finalize(trace=trace, stats=stats)

@@ -1,0 +1,341 @@
+"""The persisted runtime-statistics store: per query-fingerprint rolling
+history of per-task-uuid observed rows / bytes / timings.
+
+This is the durable half of the profiler — the statistics plane ROADMAP
+item 1 (cost model + adaptive re-planning) will read. Layout, all
+through ``engine.fs`` (URI-capable: local dirs, ``memory://``, object
+stores):
+
+    <base>/<fingerprint>.json
+        {"fingerprint": ..., "observations": [obs, ...]}   # bounded ring
+
+where ``fingerprint`` is the deterministic workflow uuid (the same key
+the serve circuit breakers and result caches use — stable across
+processes and replicas) and each observation is
+:meth:`~fugue_tpu.obs.profile.RunProfile.observation`: per-task-uuid
+rows in/out, device bytes, wall/phase timings.
+
+Write discipline matches the serve journal (FLN104-clean): the in-memory
+ring mutates under the store lock, the filesystem write runs OUTSIDE it
+through a per-fingerprint :class:`~fugue_tpu.serve.state.SnapshotWriter`
+(ordered tickets, superseded snapshots dropped, failures counted and
+logged — durability degrades, the run that produced the profile never
+fails). The store survives daemon restarts by construction (it IS
+files), and :meth:`adopt` merges a dead replica's fingerprint files into
+the survivor's store during fleet failover.
+
+Conf (registry-declared):
+
+- ``fugue.stats.path`` — dir/URI of the store; the serving daemon
+  defaults it to ``<fugue.serve.state_path>/stats``. '' = off.
+- ``fugue.stats.history`` — ring length per fingerprint (default 32).
+"""
+
+import copy
+import time
+from typing import Any, Dict, List, Optional
+
+from fugue_tpu.testing.locktrace import tracked_lock
+from fugue_tpu.workflow.manifest import read_json
+
+# bound on in-memory cached rings/writers; the files themselves are the
+# durable store, the cache only avoids re-reading hot fingerprints
+_MAX_CACHED = 256
+
+STATS_WRITES = "fugue_stats_store_writes_total"
+
+
+class RuntimeStatsStore:
+    """Rolling per-fingerprint observation rings on the fs layer."""
+
+    def __init__(
+        self,
+        fs: Any,
+        base_uri: str,
+        history: int = 32,
+        log: Any = None,
+        registry: Any = None,
+    ):
+        self._fs = fs
+        self._base = str(base_uri).rstrip("/")
+        self._history = max(1, int(history))
+        self._log = log
+        self._lock = tracked_lock("obs.stats_store.RuntimeStatsStore._lock")
+        self._rings: Dict[str, List[Dict[str, Any]]] = {}
+        self._writers: Dict[str, Any] = {}
+        self._m_writes = (
+            None
+            if registry is None
+            else registry.counter(
+                STATS_WRITES,
+                "runtime-statistics store snapshot writes by result",
+                ["result"],
+            )
+        )
+        try:
+            fs.makedirs(self._base, exist_ok=True)
+        except Exception:  # pragma: no cover - store is best-effort
+            pass
+
+    @property
+    def base_uri(self) -> str:
+        return self._base
+
+    def rebind(
+        self,
+        fs: Any,
+        history: int,
+        log: Any = None,
+        registry: Any = None,
+    ) -> None:
+        """Re-point a process-cached store at a NEW owner (a restarted
+        daemon's engine): fresh fs/log, the CURRENT conf's ring length,
+        and the live engine's metrics registry — a stopped engine's
+        registry must not keep receiving this store's counters."""
+        m_writes = (
+            None
+            if registry is None
+            else registry.counter(
+                STATS_WRITES,
+                "runtime-statistics store snapshot writes by result",
+                ["result"],
+            )
+        )
+        with self._lock:
+            self._fs = fs
+            self._history = max(1, int(history))
+            self._log = log
+            self._m_writes = m_writes
+
+    def uri(self, fingerprint: str) -> str:
+        return self._fs.join(self._base, f"{fingerprint}.json")
+
+    # ---- ring access -----------------------------------------------------
+    def _load_ring(self, fingerprint: str) -> List[Dict[str, Any]]:
+        """The in-memory ring for one fingerprint, loading the file on a
+        cache miss. The fs read runs OUTSIDE the store lock."""
+        with self._lock:
+            ring = self._rings.get(fingerprint)
+        if ring is not None:
+            return ring
+        data = (
+            read_json(
+                self._fs, self.uri(fingerprint),
+                log=self._log, what="runtime stats",
+            )
+            or {}
+        )
+        loaded = [
+            o for o in (data.get("observations") or []) if isinstance(o, dict)
+        ][-self._history:]
+        with self._lock:
+            # double-checked install: a racing loader's ring wins
+            ring = self._rings.setdefault(fingerprint, loaded)
+            self._evict_locked()
+        return ring
+
+    def _writer(self, fingerprint: str) -> Any:
+        from fugue_tpu.serve.state import SnapshotWriter
+
+        with self._lock:
+            w = self._writers.get(fingerprint)
+            if w is None:
+                w = self._writers[fingerprint] = SnapshotWriter(
+                    self._fs, self.uri(fingerprint), log=self._log
+                )
+            return w
+
+    def _evict_locked(self) -> None:
+        # rings only: they reload from disk on the next touch. Writers
+        # are NEVER evicted — the superseded-ticket ordering guarantee
+        # only holds within one SnapshotWriter instance per URI, and a
+        # writer is just a mutex + two ints, bounded by the distinct
+        # fingerprints this process ever recorded.
+        while len(self._rings) > _MAX_CACHED:
+            self._rings.pop(next(iter(self._rings)))
+
+    # ---- public API ------------------------------------------------------
+    def record(self, fingerprint: str, observation: Dict[str, Any]) -> bool:
+        """Append one observation to the fingerprint's ring and persist
+        the snapshot. Best-effort: returns False (counted + logged) when
+        the write failed; never raises into the profiled run."""
+        fingerprint = str(fingerprint)
+        try:
+            ring = self._load_ring(fingerprint)
+            writer = self._writer(fingerprint)
+            obs = dict(observation)
+            obs.setdefault("recorded_at", time.time())
+            with self._lock:
+                ring.append(obs)
+                del ring[: max(0, len(ring) - self._history)]
+                payload = {
+                    "fingerprint": fingerprint,
+                    "history": self._history,
+                    "observations": copy.deepcopy(ring),
+                }
+                ticket = writer.ticket()
+            before = writer.failures
+            writer.write(ticket, payload)
+            ok = writer.failures == before
+        except Exception as ex:
+            ok = False
+            if self._log is not None:
+                self._log.warning(
+                    "fugue_tpu stats store: recording fingerprint %s "
+                    "failed (%s: %s); statistics degraded, the run is "
+                    "unaffected",
+                    fingerprint[:12], type(ex).__name__, ex,
+                )
+        if self._m_writes is not None:
+            self._m_writes.labels(result="ok" if ok else "error").inc()
+        return ok
+
+    def history(self, fingerprint: str) -> List[Dict[str, Any]]:
+        """The fingerprint's observation ring, oldest first (empty when
+        never recorded)."""
+        ring = self._load_ring(str(fingerprint))
+        with self._lock:
+            return copy.deepcopy(ring)
+
+    def latest(self, fingerprint: str) -> Optional[Dict[str, Any]]:
+        hist = self.history(fingerprint)
+        return hist[-1] if hist else None
+
+    def observed_rows(self, fingerprint: str) -> Dict[str, Optional[int]]:
+        """Per-task-uuid ``rows_out`` of the LATEST observation — the
+        replay surface the cost model (and EXPLAIN's ``observed`` block)
+        reads."""
+        obs = self.latest(fingerprint)
+        if obs is None:
+            return {}
+        return {
+            uuid: rec.get("rows_out")
+            for uuid, rec in (obs.get("tasks") or {}).items()
+        }
+
+    def fingerprints(self) -> List[str]:
+        """Every fingerprint with a persisted ring (scans the store
+        dir — startup/diagnostic use, not the hot path)."""
+        out: List[str] = []
+        try:
+            for uri in self._fs.glob(self._fs.join(self._base, "*.json")):
+                name = uri.rsplit("/", 1)[-1]
+                if name.endswith(".json"):
+                    out.append(name[: -len(".json")])
+        except Exception:  # pragma: no cover - scan is best-effort
+            pass
+        return sorted(out)
+
+    def describe(self) -> Dict[str, Any]:
+        with self._lock:
+            cached = len(self._rings)
+        return {
+            "uri": self._base,
+            "history": self._history,
+            "cached_fingerprints": cached,
+        }
+
+    # ---- fleet adoption --------------------------------------------------
+    def adopt(self, source_base: str) -> int:
+        """Merge a dead/drained replica's store into this one (fleet
+        failover rides along with the journal adoption): each source
+        fingerprint's observations append into the survivor's ring,
+        oldest first, bounded as usual. Returns fingerprints merged."""
+        source = str(source_base or "").rstrip("/")
+        if source == "" or source == self._base:
+            return 0
+        merged = 0
+        try:
+            uris = list(self._fs.glob(self._fs.join(source, "*.json")))
+        except Exception:
+            return 0
+        for uri in uris:
+            name = uri.rsplit("/", 1)[-1]
+            if not name.endswith(".json"):
+                continue
+            fingerprint = name[: -len(".json")]
+            data = (
+                read_json(self._fs, uri, log=self._log, what="adopted stats")
+                or {}
+            )
+            observations = [
+                o
+                for o in (data.get("observations") or [])
+                if isinstance(o, dict)
+            ]
+            if not observations:
+                continue
+            ring = self._load_ring(fingerprint)
+            writer = self._writer(fingerprint)
+            with self._lock:
+                seen = {
+                    o.get("recorded_at") for o in ring
+                }
+                fresh = [
+                    o
+                    for o in observations
+                    if o.get("recorded_at") not in seen
+                ]
+                # source observations are OLDER context: they go in
+                # front so the survivor's own runs stay the latest
+                ring[:0] = fresh
+                del ring[: max(0, len(ring) - self._history)]
+                payload = {
+                    "fingerprint": fingerprint,
+                    "history": self._history,
+                    "observations": copy.deepcopy(ring),
+                }
+                ticket = writer.ticket()
+            writer.write(ticket, payload)
+            merged += 1
+        return merged
+
+
+def make_stats_store(
+    engine: Any, path: str, history: int = 32
+) -> Optional[RuntimeStatsStore]:
+    """A store on the engine's fs when ``path`` is non-empty; None keeps
+    statistics off (PR-8-and-earlier behavior)."""
+    base = str(path or "").strip()
+    if base == "":
+        return None
+    return RuntimeStatsStore(
+        engine.fs,
+        base,
+        history=history,
+        log=engine.log,
+        registry=getattr(engine, "metrics", None),
+    )
+
+
+_STORES: Dict[str, RuntimeStatsStore] = {}
+_STORES_LOCK = tracked_lock("obs.stats_store._STORES_LOCK")
+
+
+def get_stats_store(
+    engine: Any, path: str, history: int = 32
+) -> RuntimeStatsStore:
+    """Process-wide store cache keyed by base URI: every profiled run
+    against the same store path shares one ring cache and one ordered
+    writer per fingerprint, so concurrent same-fingerprint runs in one
+    process append instead of clobbering each other's snapshots. A
+    cache hit REBINDS the store to the calling engine (fs, log,
+    metrics registry, current ring length) — a restarted daemon's
+    counters must land on its live engine, not its predecessor's."""
+    base = str(path).rstrip("/")
+    with _STORES_LOCK:
+        store = _STORES.get(base)
+    if store is None:
+        built = make_stats_store(engine, base, history=history)
+        assert built is not None  # caller checked path non-empty
+        with _STORES_LOCK:
+            store = _STORES.setdefault(base, built)
+        if store is built:
+            return store
+    store.rebind(
+        engine.fs,
+        history,
+        log=engine.log,
+        registry=getattr(engine, "metrics", None),
+    )
+    return store

@@ -48,8 +48,13 @@ HTTP API (all JSON; errors are structured payloads, never tracebacks)::
     POST   /v1/sessions/<sid>/sql           {"sql": ..., "save_as"?: name,
                                              "mode"?: "sync"|"async",
                                              "timeout"?: s, "collect"?: bool,
-                                             "limit"?: rows}
+                                             "limit"?: rows,
+                                             "profile"?: bool (EXPLAIN
+                                             ANALYZE via /profile),
+                                             "explain"?: bool (static plan
+                                             report, nothing executes)}
     GET    /v1/jobs/<jid>                   poll an async submission
+    GET    /v1/jobs/<jid>/profile           per-task runtime profile
     POST   /v1/jobs/<jid>/cancel
     GET    /v1/status                       health, memory_stats, breakers,
                                             backpressure, recovery, jobs,
@@ -93,6 +98,8 @@ from fugue_tpu.constants import (
     FUGUE_CONF_SERVE_STATE_PATH,
     FUGUE_CONF_SERVE_SYNC_DEGRADE_DEPTH,
     FUGUE_CONF_SERVE_SYNC_WAIT,
+    FUGUE_CONF_STATS_HISTORY,
+    FUGUE_CONF_STATS_PATH,
     typed_conf_get,
 )
 from fugue_tpu.execution.factory import make_execution_engine
@@ -100,6 +107,7 @@ from fugue_tpu.obs import (
     activate,
     current_span,
     finalize_trace,
+    force_profiling,
     maybe_log_slow_query,
     obs_options,
     open_trace,
@@ -195,6 +203,32 @@ class ServeDaemon:
         self._journal = make_journal(
             self._engine, typed_conf_get(econf, FUGUE_CONF_SERVE_STATE_PATH)
         )
+        # runtime-statistics store (ISSUE 14): a journaled daemon
+        # defaults fugue.stats.path to <state_path>/stats, so profiled
+        # jobs persist per-task observations next to the journal (the
+        # engine conf carries the key — the workflow layer's profiler
+        # writes through the same shared store instance)
+        if (
+            self._journal is not None
+            and not str(
+                typed_conf_get(econf, FUGUE_CONF_STATS_PATH) or ""
+            ).strip()
+        ):
+            econf[FUGUE_CONF_STATS_PATH] = self._engine.fs.join(
+                self._journal.base_uri, "stats"
+            )
+        self._stats_store: Any = None
+        stats_path = str(
+            typed_conf_get(econf, FUGUE_CONF_STATS_PATH) or ""
+        ).strip()
+        if stats_path:
+            from fugue_tpu.obs.stats_store import get_stats_store
+
+            self._stats_store = get_stats_store(
+                self._engine,
+                stats_path,
+                history=typed_conf_get(econf, FUGUE_CONF_STATS_HISTORY),
+            )
         self._health = HealthState()
         self._supervisor = EngineSupervisor(
             typed_conf_get(econf, FUGUE_CONF_SERVE_BREAKER_THRESHOLD),
@@ -506,6 +540,7 @@ class ServeDaemon:
                 limit=int(rec.get("limit", 10_000)),
                 job_id=jid,
                 request_id=rec.get("request_id"),
+                profile=bool(rec.get("profile", False)),
             )
             job.recovered = True
             try:
@@ -589,9 +624,21 @@ class ServeDaemon:
             )
         self._recovery["jobs_resubmitted"] += resubmitted
         self._recovery["jobs_failed_over"] += failed_over
+        adopted_stats = 0
+        if self._stats_store is not None:
+            # the origin's runtime statistics ride along with its
+            # sessions: merge its <state>/stats rings into ours so the
+            # adopted queries keep their observed-rows history
+            try:
+                adopted_stats = self._stats_store.adopt(
+                    fs.join(base, "stats")
+                )
+            except Exception:  # pragma: no cover - stats are best-effort
+                pass
         return {
             "sessions": adopted,
             "expired_sessions": expired,
+            "stats_fingerprints": adopted_stats,
             "jobs_resubmitted": resubmitted,
             "jobs_failed_over": failed_over,
             # False = the origin journal still holds the moved state:
@@ -808,6 +855,7 @@ class ServeDaemon:
         collect: bool = True,
         limit: int = 10_000,
         request_id: Optional[str] = None,
+        profile: bool = False,
     ) -> ServeJob:
         self._reject_if_unhealthy()
         self._sessions.get(session_id)  # 404 early + touches the session
@@ -820,6 +868,7 @@ class ServeDaemon:
             collect=collect,
             limit=limit,
             request_id=request_id,
+            profile=profile,
         )
         # under an active request trace the job gets its serve.job span
         # NOW: queue wait is inside it, so traces attribute time spent
@@ -940,6 +989,8 @@ class ServeDaemon:
         if self._journal is not None:
             out["durable"] = self._journal.describe()
             out["recovery"] = dict(self._recovery)
+        if self._stats_store is not None:
+            out["stats_store"] = self._stats_store.describe()
         if self._restart_phases or self._first_query:
             # time_to_first_query phase split (ISSUE 11): journal-reload
             # and cache-load from startup, compile/dispatch from the
@@ -1055,6 +1106,9 @@ class ServeDaemon:
             and job.save_as is None
             and job.collect
             and len(dag.yields) == 0
+            # a profile-requested job must actually EXECUTE (EXPLAIN
+            # ANALYZE measures a run, a cached payload has no profile)
+            and not job.profile_requested
         ):
             from fugue_tpu.optimize.rewrite import tasks_are_pure
 
@@ -1122,9 +1176,18 @@ class ServeDaemon:
             if gov is not None
             else nullcontext()
         )
+        profile_scope = (
+            force_profiling() if job.profile_requested else nullcontext()
+        )
         with scope:
-            wres = dag.run(self._engine, cancel_token=job.token)
+            with profile_scope:
+                wres = dag.run(self._engine, cancel_token=job.token)
             job.beat()
+            # per-task runtime profile (EXPLAIN ANALYZE): present when
+            # the job requested it or daemon conf profiles every run —
+            # served at GET /v1/jobs/<id>/profile; the workflow layer
+            # already persisted the observation into the stats store
+            job.profile = wres.profile()
             self._note_fault_stats(wres.fault_stats)
             payload: Dict[str, Any] = {
                 "yields": sorted(
@@ -1268,6 +1331,9 @@ class ServeDaemon:
                     self._obs.slow_query_ms,
                     log=self._engine.log,
                     registry=self._engine.metrics,
+                    # profiled jobs name their top-3 most expensive
+                    # tasks (name, callsite, phase split) in the record
+                    profile=job.profile,
                     job_id=job.job_id,
                     session_id=job.session_id,
                     request_id=job.request_id,
@@ -1447,11 +1513,67 @@ class ServeDaemon:
             rest = route[2:]
             if not rest and method == "GET":
                 return 200, self._scheduler.get(jid).snapshot()
+            if rest == ["profile"] and method == "GET":
+                return 200, self.job_profile(jid)
             if rest == ["cancel"] and method == "POST":
                 return 200, self._scheduler.cancel(jid).snapshot(
                     include_result=False
                 )
         raise KeyError(f"unknown route {method} {path}")
+
+    def job_profile(self, job_id: str) -> Dict[str, Any]:
+        """``GET /v1/jobs/<id>/profile``: the job's per-task runtime
+        profile (EXPLAIN ANALYZE). 404 while the job is still running
+        or when it was not profiled (submit with ``"profile": true`` or
+        set ``fugue.obs.profile`` on the daemon)."""
+        job = self._scheduler.get(job_id)
+        if job.profile is None:
+            raise KeyError(
+                f"job {job_id} has no profile (status={job.status}; "
+                "submit with 'profile': true, or set fugue.obs.profile "
+                "with fugue.obs.enabled on the daemon)"
+            )
+        return {
+            "job_id": job.job_id,
+            "session_id": job.session_id,
+            "status": job.status,
+            "profile": job.profile.as_dict(),
+            "text": job.profile.to_text(),
+        }
+
+    def explain_sql(self, session_id: str, sql: str) -> Dict[str, Any]:
+        """The submission-time ``explain`` flag: compile the FugueSQL
+        against the session's hot tables and return the static plan
+        report WITHOUT executing anything (classic EXPLAIN). When the
+        runtime-statistics store holds history for this query's
+        fingerprint, the last observed per-task row counts ride along —
+        the replay surface that survives restarts and adoption."""
+        session = self._sessions.get(session_id)
+        dag = FugueSQLWorkflow()
+        dag._sql(sql, {}, **session.table_frames())
+        report = dag.explain(engine=self._engine)
+        fingerprint = dag.__uuid__()
+        out: Dict[str, Any] = {
+            "session_id": session_id,
+            "fingerprint": fingerprint,
+            "explain": {
+                "text": report.to_text(),
+                "plan": report.to_dict(),
+            },
+        }
+        if self._stats_store is not None:
+            latest = self._stats_store.latest(fingerprint)
+            if latest is not None:
+                out["observed"] = {
+                    "recorded_at": latest.get("recorded_at"),
+                    "total_ms": latest.get("total_ms"),
+                    "rows": self._stats_store.observed_rows(fingerprint),
+                    "observations": len(
+                        self._stats_store.history(fingerprint)
+                    ),
+                }
+        session.touch()
+        return out
 
     def _route_sql(
         self,
@@ -1462,6 +1584,11 @@ class ServeDaemon:
         sql = payload.get("sql")
         if not isinstance(sql, str) or not sql.strip():
             raise ValueError("payload must carry a non-empty 'sql' string")
+        if bool(payload.get("explain", False)):
+            # EXPLAIN: compile + report, never execute (health-gated
+            # like a submission — a draining daemon sheds it)
+            self._reject_if_unhealthy()
+            return 200, self.explain_sql(sid, sql)
         mode = str(payload.get("mode", "sync")).lower()
         if mode not in ("sync", "async"):
             raise ValueError(f"mode must be sync|async, got {mode!r}")
@@ -1485,6 +1612,7 @@ class ServeDaemon:
             collect=bool(payload.get("collect", True)),
             limit=int(payload.get("limit", 10_000)),
             request_id=request_id,
+            profile=bool(payload.get("profile", False)),
         )
         if mode == "async":
             snap = job.snapshot(include_result=False)

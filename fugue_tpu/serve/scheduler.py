@@ -72,6 +72,7 @@ class ServeJob:
         limit: int = 10_000,
         job_id: Optional[str] = None,
         request_id: Optional[str] = None,
+        profile: bool = False,
     ):
         self.job_id = job_id or ("job-" + uuid.uuid4().hex[:12])
         self.session_id = session_id
@@ -80,6 +81,12 @@ class ServeJob:
         self.timeout = max(0.0, float(timeout))
         self.collect = bool(collect)
         self.limit = int(limit)
+        # per-request profiling (ISSUE 14): the executor forces the
+        # workflow profiler for this job regardless of daemon conf; the
+        # RunProfile lands on ``self.profile`` for GET /v1/jobs/<id>/
+        # profile (conf-level fugue.obs.profile fills it too)
+        self.profile_requested = bool(profile)
+        self.profile: Any = None
         # correlation id of the HTTP request that submitted this job
         # (X-Request-Id, generated when absent); journaled with async
         # jobs so a restarted daemon's resubmissions keep their ids

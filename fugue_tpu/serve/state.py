@@ -296,6 +296,9 @@ class ServeStateJournal:
                 # job's logs/spans still tie back to the original
                 # X-Request-Id the client holds
                 "request_id": job.request_id,
+                # a profiled submission stays profiled when a restart
+                # or adoption resubmits it
+                "profile": bool(getattr(job, "profile_requested", False)),
             }
         self.write()
 

@@ -7,6 +7,7 @@ resolution)."""
 
 from fugue_tpu.sql_frontend.workflow_sql import (  # noqa: F401
     FugueSQLWorkflow,
+    explain_sql,
     fill_sql_template,
     fugue_sql,
     fugue_sql_flow,
@@ -17,6 +18,7 @@ __all__ = [
     "fugue_sql",
     "fugue_sql_flow",
     "FugueSQLWorkflow",
+    "explain_sql",
     "fill_sql_template",
     "lint_sql",
 ]

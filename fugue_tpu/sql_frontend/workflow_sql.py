@@ -12,7 +12,7 @@ from fugue_tpu.workflow.workflow import FugueWorkflow, WorkflowDataFrame
 
 __all__ = [
     "FugueSQLWorkflow", "fugue_sql", "fugue_sql_flow", "fill_sql_template",
-    "lint_sql",
+    "explain_sql", "lint_sql",
 ]
 
 
@@ -147,6 +147,26 @@ def lint_sql(query: str, *args: Any, conf: Any = None, **kwargs: Any) -> Any:
     dag = FugueSQLWorkflow(conf)
     dag._sql(query, _caller_vars(2), *args, **kwargs)
     return dag.analyze(conf=conf)
+
+
+def explain_sql(
+    query: str,
+    *args: Any,
+    conf: Any = None,
+    engine: Any = None,
+    **kwargs: Any,
+) -> Any:
+    """EXPLAIN a FugueSQL script WITHOUT executing it: compile the DAG
+    (same path as :func:`fugue_sql_flow`, so caller-local dataframes
+    resolve as usual) and return the
+    :class:`~fugue_tpu.analysis.explain.ExplainReport` — the
+    optimizer-rewritten task tree with applied rewrites, propagated
+    schemas and estimated device bytes (``.to_text()`` /
+    ``.to_dict()``). Pair with ``fugue.obs.profile`` and
+    ``FugueWorkflowResult.profile()`` for EXPLAIN ANALYZE."""
+    dag = FugueSQLWorkflow(conf)
+    dag._sql(query, _caller_vars(2), *args, **kwargs)
+    return dag.explain(conf=conf, engine=engine)
 
 
 def fugue_sql(

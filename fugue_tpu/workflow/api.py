@@ -100,3 +100,24 @@ def raw_sql(
     if as_fugue or any(isinstance(x, DataFrame) for x in dfs.values()):
         return result
     return result.native if result.is_local else get_native_as_df(result)
+
+
+def explain(
+    df: Any = None, conf: Any = None, engine: Any = None
+) -> Any:
+    """EXPLAIN without executing: the static plan report
+    (:class:`~fugue_tpu.analysis.explain.ExplainReport`) for a built
+    :class:`FugueWorkflow`, a :class:`WorkflowDataFrame` (its whole
+    workflow), or any raw dataframe (a one-task plan). Renders the
+    optimizer-rewritten task tree with applied rewrites, propagated
+    schemas and estimated device bytes via ``.to_text()`` /
+    ``.to_dict()``; run with ``fugue.obs.profile`` and read
+    ``FugueWorkflowResult.profile()`` for EXPLAIN ANALYZE."""
+    if isinstance(df, FugueWorkflow):
+        return df.explain(conf=conf, engine=engine)
+    if isinstance(df, WorkflowDataFrame):
+        return df.workflow.explain(conf=conf, engine=engine)
+    dag = FugueWorkflow(conf)
+    if df is not None:
+        dag.create_data(df)
+    return dag.explain(conf=conf, engine=engine)

@@ -17,6 +17,7 @@ from fugue_tpu.extensions.convert import (
     _to_processor,
 )
 from fugue_tpu.extensions.interfaces import Creator, Outputter, Processor
+from fugue_tpu.obs.profile import note_cache_event
 from fugue_tpu.schema import Schema
 from fugue_tpu.utils.assertion import assert_or_throw
 from fugue_tpu.utils.hash import to_uuid
@@ -139,10 +140,13 @@ class FugueTask:
         if cache is not None:
             hit = cache.get_task_result(self, ctx)
             if hit is not None:
+                # profiler attribution (thread-local; no-op when off)
+                note_cache_event("result", "hit")
                 return self._finalize(ctx, hit, run_checkpoint=False)
         cached = self.checkpoint.try_load(ctx.checkpoint_path)
         if cached is None:
             return None
+        note_cache_event("checkpoint", "hit")
         if cache is not None:
             cache.put_task_result(self, ctx, cached)
         return self._finalize(ctx, cached, run_checkpoint=False)

@@ -60,6 +60,13 @@ from fugue_tpu.obs import (
     start_span,
     tracing_suppressed,
 )
+from fugue_tpu.obs.profile import (
+    Profiler,
+    profiling_forced,
+    profiling_requested,
+    task_scope,
+)
+from fugue_tpu.obs.trace import NULL_CM
 from fugue_tpu.rpc import make_rpc_server, to_rpc_handler
 from fugue_tpu.schema import Schema
 from fugue_tpu.utils.assertion import assert_or_throw
@@ -523,6 +530,9 @@ class FugueWorkflow:
         self._conf.update(ParamDict(compile_conf))
         self._computed = False
         self._last_df: Optional[WorkflowDataFrame] = None
+        # the most recent profiled run's RunProfile (None otherwise) —
+        # run()'s finalize reads it for the slow-query top-tasks block
+        self._last_run_profile: Any = None
 
     @property
     def yields(self) -> Dict[str, Yielded]:
@@ -783,6 +793,20 @@ class FugueWorkflow:
             exclude_lint_only=exclude_lint_only,
         )
 
+    def explain(self, conf: Any = None, engine: Any = None) -> Any:
+        """EXPLAIN: the static plan report for this DAG — the
+        optimizer-rewritten task tree (clone-and-pin dry run; this
+        workflow is untouched) with applied rewrites, propagated
+        schemas and estimated device bytes, as a text tree
+        (``.to_text()``) and JSON (``.to_dict()``). Nothing executes.
+        ``engine`` accepts a live instance or the same name/spec
+        ``run()`` accepts."""
+        from fugue_tpu.analysis.explain import explain_workflow
+
+        if engine is not None and not hasattr(engine, "conf"):
+            engine = make_execution_engine(engine, conf)
+        return explain_workflow(self, conf=conf, engine=engine)
+
     def _pre_run_analysis(self, e: Any, run_conf: Any = None) -> None:
         """The ``fugue.analysis`` gate at the top of ``run()``: ``off``
         skips, ``warn`` (default) logs findings and proceeds, ``error``
@@ -895,26 +919,23 @@ class FugueWorkflow:
                 fs=e.fs,
                 log=e.log,
                 registry=e.metrics,
+                profile=self._last_run_profile,
                 what="workflow.run",
                 workflow=self.__uuid__()[:12],
             )
 
-    def _optimized_tasks(self, e: Any) -> List[FugueTask]:
-        """The task list execution runs: the optimizer's rewrite phase
-        (``fugue.optimize``; ``auto`` = jax engines only) over a CLONED
-        graph whose uuids are pinned to the original tasks — rewrites
-        never change the identities deterministic checkpoints and
-        manifest resume key on. The phase is sandboxed: an optimizer
-        crash logs a warning and the pristine DAG runs instead."""
+    def _overlay_optimize_conf(self, base_conf: Any) -> ParamDict:
+        """The ``fugue.optimize*`` precedence shared by run()'s rewrite
+        phase, ``explain()`` and the EXPLAIN ANALYZE tree (they must
+        all describe the SAME plan): a base/engine conf value that
+        still equals the registered default is "not set", so an
+        explicit workflow compile-conf value (``fugue.optimize`` and
+        its per-rule keys) wins over the inherited default — the same
+        dance as the ``fugue.analysis`` gate."""
         from fugue_tpu.constants import declared_conf_keys
-        from fugue_tpu.optimize import optimize_enabled, optimize_tasks
 
-        # same precedence as the fugue.analysis gate: an engine conf
-        # value that still equals the registered default is "not set",
-        # so an explicit workflow compile-conf value (fugue.optimize and
-        # its per-rule keys) wins over the inherited default
         declared = declared_conf_keys()
-        conf = ParamDict(e.conf)
+        conf = ParamDict(base_conf)
         for k, v in self._conf.items():
             if not isinstance(k, str) or not k.startswith("fugue.optimize"):
                 continue
@@ -923,6 +944,18 @@ class FugueWorkflow:
                 info.default
             ):
                 conf[k] = v
+        return conf
+
+    def _optimized_tasks(self, e: Any) -> List[FugueTask]:
+        """The task list execution runs: the optimizer's rewrite phase
+        (``fugue.optimize``; ``auto`` = jax engines only) over a CLONED
+        graph whose uuids are pinned to the original tasks — rewrites
+        never change the identities deterministic checkpoints and
+        manifest resume key on. The phase is sandboxed: an optimizer
+        crash logs a warning and the pristine DAG runs instead."""
+        from fugue_tpu.optimize import optimize_enabled, optimize_tasks
+
+        conf = self._overlay_optimize_conf(e.conf)
         # an invalid fugue.optimize mode must raise (the user asked for
         # a gate that doesn't exist), so it is checked OUTSIDE the
         # sandbox below
@@ -957,6 +990,17 @@ class FugueWorkflow:
         stats = RunStats(registry=e.metrics)
         ctx = TaskContext(e, rpc_server, checkpoint_path, cancel_token=token)
         base_policy = RetryPolicy.from_conf(e.conf)
+        concurrency = e.conf.get(FUGUE_CONF_WORKFLOW_CONCURRENCY, 1)
+        # per-task profiler (EXPLAIN ANALYZE): only constructed when
+        # fugue.obs.profile is requested (conf gate needs fugue.obs.
+        # enabled for the span-derived phase split; the serving daemon's
+        # per-request flag forces it) — off means the task wrapper takes
+        # the pre-existing path and nothing here allocates
+        profiler = None
+        if profiling_forced() or profiling_requested(e.conf):
+            profiler = Profiler(
+                self.__uuid__(), e, concurrency=int(concurrency)
+            )
         # checkpoint-backed resume: None unless fugue.workflow.resume is on
         # AND a durable checkpoint dir exists to hold the run manifest
         manifest = RunManifest.from_conf(e, checkpoint_path, self.__uuid__())
@@ -972,7 +1016,8 @@ class FugueWorkflow:
                 TaskNode(
                     t.__uuid__() + f"_{i}",
                     self._make_task_func(
-                        t, ctx, base_policy, token, manifest, stats
+                        t, ctx, base_policy, token, manifest, stats,
+                        profiler=profiler,
                     ),
                     [
                         inp.__uuid__() + f"_{index_of[id(inp)]}"
@@ -993,7 +1038,6 @@ class FugueWorkflow:
                 on_complete = lambda node: manifest.mark_complete(  # noqa: E731
                     by_node_id[node.task_id]
                 )
-            concurrency = e.conf.get(FUGUE_CONF_WORKFLOW_CONCURRENCY, 1)
             try:
                 DAGRunner(concurrency).run(
                     nodes, on_complete=on_complete, cancel_token=token
@@ -1031,7 +1075,65 @@ class FugueWorkflow:
             checkpoint_path.remove_temp_path()
             if started_rpc:
                 rpc_server.stop()
-        return FugueWorkflowResult(self._yields, stats=stats)
+        run_profile = None
+        if profiler is not None:
+            run_profile = self._settle_profile(e, profiler, stats)
+        return FugueWorkflowResult(
+            self._yields, stats=stats, profile=run_profile
+        )
+
+    def _settle_profile(self, e: Any, profiler: Any, stats: Any) -> Any:
+        """Finalize a profiled run: merge the span-derived phase split,
+        attach the EXPLAIN tree (same deterministic rewrite dry run the
+        plan executed, so uuids line up), persist the observation into
+        the runtime-statistics store when ``fugue.stats.path`` is set,
+        and stash the profile for the slow-query enrichment in
+        ``run()``'s finalize. Every step is best-effort — profiling
+        must never fail the run it measured."""
+        cur = current_span()
+        run_profile = profiler.finalize(
+            trace=cur.trace if cur is not None else None, stats=stats
+        )
+        try:
+            from fugue_tpu.analysis.explain import explain_tasks
+
+            # the SAME conf overlay _optimized_tasks used: the attached
+            # tree must describe the plan this run actually executed
+            run_profile.report = explain_tasks(
+                self._tasks,
+                conf=self._overlay_optimize_conf(e.conf),
+                engine=e,
+            )
+        except Exception as ex:  # plan report is additive
+            e.log.warning(
+                "fugue_tpu profile: EXPLAIN tree build failed (%s: %s); "
+                "the runtime profile stands alone",
+                type(ex).__name__, ex,
+            )
+        try:
+            from fugue_tpu.constants import (
+                FUGUE_CONF_STATS_HISTORY,
+                FUGUE_CONF_STATS_PATH,
+                typed_conf_get,
+            )
+
+            stats_path = typed_conf_get(e.conf, FUGUE_CONF_STATS_PATH)
+            if str(stats_path or "").strip():
+                from fugue_tpu.obs.stats_store import get_stats_store
+
+                get_stats_store(
+                    e,
+                    stats_path,
+                    history=typed_conf_get(e.conf, FUGUE_CONF_STATS_HISTORY),
+                ).record(self.__uuid__(), run_profile.observation())
+        except Exception as ex:  # pragma: no cover - store is best-effort
+            e.log.warning(
+                "fugue_tpu profile: statistics-store record failed "
+                "(%s: %s); the run is unaffected",
+                type(ex).__name__, ex,
+            )
+        self._last_run_profile = run_profile
+        return run_profile
 
     def _task_policy(self, task: FugueTask, base: RetryPolicy) -> RetryPolicy:
         if not task.fault_override:
@@ -1046,6 +1148,7 @@ class FugueWorkflow:
         token: CancelToken,
         manifest: Optional[RunManifest],
         stats: RunStats,
+        profiler: Any = None,
     ) -> Callable:
         policy = self._task_policy(task, base_policy)
 
@@ -1060,33 +1163,46 @@ class FugueWorkflow:
         def run_task(inputs: List[Any]) -> Any:
             # one span per TaskNode execution (the runner worker thread
             # inherits the run's context via DAGRunner._spawn); attempt
-            # spans nest under it from execute_with_policy
+            # spans nest under it from execute_with_policy. With the
+            # profiler off (None), this is the pre-existing path plus
+            # one is-None check — nothing is allocated.
             with start_span(
                 "task", task=task.name, type=task.task_type
-            ):
-                try:
-                    # manifest resume is OBSERVED here but served by the
-                    # task's own checkpoint short-circuit inside
-                    # execute(): validations still fire and there is
-                    # only one load path
-                    if manifest is not None and manifest.can_resume(
-                        task, ctx, stats=stats
-                    ):
-                        stats.note_resumed(task.name)
-                    # each attempt inside holds the engine's dispatch
-                    # guard (task_execution_lock): shared-engine device
-                    # programs serialize per attempt, host phases overlap
-                    return execute_with_policy(
-                        lambda: attempt(inputs),
-                        policy,
-                        engine=ctx.engine,
-                        token=token,
-                        task_name=task.name,
-                        stats=stats,
-                        log=ctx.engine.log,
-                    )
-                except Exception as ex:
-                    self._reraise_with_callsite(task, ex)
+            ) as sp:
+                rec = None if profiler is None else profiler.begin(task, sp)
+                # NULL_CM when off: the shared no-op, nothing allocated
+                with NULL_CM if rec is None else task_scope(rec):
+                    return _execute(inputs, rec)
+
+        def _execute(inputs: List[Any], rec: Any) -> Any:
+            try:
+                # manifest resume is OBSERVED here but served by the
+                # task's own checkpoint short-circuit inside
+                # execute(): validations still fire and there is
+                # only one load path
+                if manifest is not None and manifest.can_resume(
+                    task, ctx, stats=stats
+                ):
+                    stats.note_resumed(task.name)
+                # each attempt inside holds the engine's dispatch
+                # guard (task_execution_lock): shared-engine device
+                # programs serialize per attempt, host phases overlap
+                result = execute_with_policy(
+                    lambda: attempt(inputs),
+                    policy,
+                    engine=ctx.engine,
+                    token=token,
+                    task_name=task.name,
+                    stats=stats,
+                    log=ctx.engine.log,
+                )
+            except Exception as ex:
+                if rec is not None:
+                    profiler.finish(rec, inputs, None, error=ex)
+                self._reraise_with_callsite(task, ex)
+            if rec is not None:
+                profiler.finish(rec, inputs, result)
+            return result
 
         return run_task
 
@@ -1122,11 +1238,18 @@ class FugueWorkflow:
 class FugueWorkflowResult:
     """Run result: access yielded dataframes (reference workflow.py:1609)
     plus the run's fault-tolerance stats (retries/recoveries/degradations
-    per task and manifest-resumed tasks)."""
+    per task and manifest-resumed tasks) and — for profiled runs — the
+    per-task runtime profile (EXPLAIN ANALYZE)."""
 
-    def __init__(self, yields: Dict[str, Yielded], stats: Any = None):
+    def __init__(
+        self,
+        yields: Dict[str, Yielded],
+        stats: Any = None,
+        profile: Any = None,
+    ):
         self._yields = yields
         self._stats = stats
+        self._profile = profile
 
     @property
     def yields(self) -> Dict[str, Yielded]:
@@ -1135,6 +1258,15 @@ class FugueWorkflowResult:
     @property
     def fault_stats(self) -> Dict[str, Any]:
         return self._stats.as_dict() if self._stats is not None else {}
+
+    def profile(self) -> Any:
+        """The run's :class:`~fugue_tpu.obs.profile.RunProfile` — per
+        task rows in/out, device bytes, wall/compile/execute/transfer
+        split, queue wait, retries and cache events, with the EXPLAIN
+        plan tree attached (``.to_text()`` renders EXPLAIN ANALYZE).
+        None unless the run was profiled (``fugue.obs.profile`` with
+        ``fugue.obs.enabled``, or the serve ``profile`` flag)."""
+        return self._profile
 
     def __getitem__(self, name: str) -> Any:
         y = self._yields[name]

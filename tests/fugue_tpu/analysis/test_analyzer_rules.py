@@ -374,6 +374,45 @@ def test_fwf404_trace_path_without_obs_enabled():
     assert not any(x.code == "FWF404" for x in _analyze(dag))
 
 
+def test_fwf505_profiler_conf_without_obs_enabled():
+    # slow_query_ms / profile with obs off are silently inert — the
+    # FWF404 misconfiguration shape, on the ISSUE 14 keys
+    dag = FugueWorkflow()
+    dag.df([[0]], "a:int").persist()
+    diags = _analyze(
+        dag,
+        conf={"fugue.obs.slow_query_ms": 250, "fugue.obs.profile": True},
+        codes={"FWF505"},
+    )
+    assert len(diags) == 2  # one per inert key
+    d = _assert_diag(diags, "FWF505", Severity.WARN, needs_callsite=False)
+    assert "fugue.obs.enabled" in d.message
+    msgs = " | ".join(x.message for x in diags)
+    assert "slow_query_ms" in msgs and "fugue.obs.profile" in msgs
+    # string conf values are legitimate: "false" must still warn
+    assert any(
+        x.code == "FWF505"
+        for x in _analyze(
+            dag,
+            conf={"fugue.obs.profile": True, "fugue.obs.enabled": "false"},
+        )
+    )
+    # enabled -> both keys are live: silent
+    assert not any(
+        x.code == "FWF505"
+        for x in _analyze(
+            dag,
+            conf={
+                "fugue.obs.slow_query_ms": 250,
+                "fugue.obs.profile": True,
+                "fugue.obs.enabled": True,
+            },
+        )
+    )
+    # neither key set -> nothing to warn about
+    assert not any(x.code == "FWF505" for x in _analyze(dag))
+
+
 def test_fwf502_serve_target_without_executable_cache(monkeypatch):
     # a serve-targeted conf (durable state path) without a persistent
     # executable cache dir: every daemon restart re-pays full XLA
@@ -596,7 +635,7 @@ def test_every_rule_has_corpus_coverage():
         "FWF101", "FWF102", "FWF103", "FWF104", "FWF105", "FWF106",
         "FWF201", "FWF202", "FWF301", "FWF302", "FWF303", "FWF401",
         "FWF402", "FWF403", "FWF404", "FWF501", "FWF502", "FWF503",
-        "FWF504",
+        "FWF504", "FWF505",
     }
     assert {r.code for r in all_rules()} == covered
 

@@ -1,0 +1,370 @@
+"""Per-task profiler / EXPLAIN / EXPLAIN ANALYZE correctness (ISSUE 14).
+
+Covers the acceptance criteria: a seeded multi-join SQL pipeline whose
+per-task rows in/out, device bytes and compile/execute/transfer split
+attribute to the CORRECT task names and user callsites; exact cache-hit
+attribution; the off-mode identity contract (no profiler objects
+allocated); the statistics store's record/replay ring; and the EXPLAIN
+report surfaces (workflow.explain, explain_sql, fa.explain).
+Tier-1 compatible; select with ``-m profile``.
+"""
+
+import tempfile
+
+import pytest
+
+import fugue_tpu.api as fa
+from fugue_tpu.column.expressions import col
+from fugue_tpu.execution import make_execution_engine
+from fugue_tpu.obs import profile as profile_mod
+from fugue_tpu.obs.export import maybe_log_slow_query
+from fugue_tpu.obs.profile import current_task_profile, force_profiling
+from fugue_tpu.obs.stats_store import RuntimeStatsStore, get_stats_store
+from fugue_tpu.sql_frontend.workflow_sql import explain_sql
+from fugue_tpu.workflow.workflow import FugueWorkflow
+
+pytestmark = [pytest.mark.obs, pytest.mark.profile]
+
+THIS_FILE = __file__
+
+_PROFILE_CONF = {"fugue.obs.enabled": True, "fugue.obs.profile": True}
+
+
+def _multi_join_dag():
+    """The acceptance pipeline: two joins + filter + SQL groupby."""
+    dag = FugueWorkflow()
+    facts = dag.df(
+        [[i % 4, i, float(i)] for i in range(16)], "k:int,i:int,v:double"
+    )
+    dims = dag.df([[i, f"d{i}"] for i in range(4)], "k:int,name:str")
+    weights = dag.df([[i, i * 10] for i in range(4)], "k:int,w:long")
+    joined = facts.inner_join(dims, on=["k"]).inner_join(weights, on=["k"])
+    narrowed = joined.filter(col("w") >= 10).select("k", "v", "w")
+    agg = dag.select(
+        "SELECT k, SUM(v) AS s, COUNT(*) AS c FROM", narrowed, "GROUP BY k"
+    )
+    agg.yield_dataframe_as("res", as_local=True)
+    return dag
+
+
+def test_explain_analyze_multi_join_pipeline():
+    dag = _multi_join_dag()
+    res = dag.run("jax", conf=_PROFILE_CONF)
+    prof = res.profile()
+    assert prof is not None
+    assert prof.exact_attribution  # serial inner runner -> exact deltas
+    by_name = {rec.name: rec for rec in prof.records.values()}
+
+    creates = [r for r in by_name.values() if r.task_type == "create"]
+    assert sorted(r.rows_out for r in creates) == [4, 4, 16]
+    joins = [r for r in by_name.values() if r.name.startswith("RunJoin")]
+    assert len(joins) == 2
+    # 16 facts x 4 matching dims (1:1 on k) -> 16 rows out of each join
+    for j in joins:
+        assert j.rows_out == 16
+        assert 16 in j.rows_in
+    sql = [r for r in by_name.values() if r.name.startswith("RunSQLSelect")]
+    # filter w >= 10 drops k=0 (w=0): 3 surviving groups
+    assert len(sql) == 1 and sql[0].rows_out == 3
+    # every task: correct USER callsite (this test file, not framework)
+    for rec in by_name.values():
+        assert rec.callsite, rec.name
+        assert any(THIS_FILE in line for line in rec.callsite), rec.name
+    # device bytes recorded for materialized outputs
+    assert all(
+        r.device_bytes is not None and r.device_bytes > 0 for r in creates
+    )
+    # the phase split came from the engine spans under each task's span:
+    # somewhere in the run there was real compile and transfer work
+    all_phases = [p for r in by_name.values() for p in r.phases]
+    assert "compile_ms" in all_phases or "execute_ms" in all_phases
+    assert prof.total_ms > 0
+    # EXPLAIN ANALYZE rendering merges the plan tree with the runtime
+    text = prof.to_text()
+    assert text.startswith("EXPLAIN ANALYZE")
+    assert "actual(" in text and "rows_out=4" in text
+    # JSON form carries the plan + per-task observations
+    d = prof.as_dict()
+    assert "plan" in d and len(d["tasks"]) == len(prof.records)
+
+
+def test_profiler_off_mode_identity(monkeypatch):
+    """Off = the pre-existing path: result.profile() is None, NO
+    profiler or record objects are ever constructed, and the
+    thread-local task scope stays empty inside extensions."""
+    import pandas as pd
+
+    seen = []
+
+    def observer(df: pd.DataFrame) -> pd.DataFrame:
+        seen.append(current_task_profile())
+        return df.assign(b=1.0)
+
+    def boom(*a, **k):  # any construction = off-mode contract broken
+        raise AssertionError("profiler object allocated with profiling off")
+
+    monkeypatch.setattr(profile_mod, "Profiler", boom)
+    monkeypatch.setattr(profile_mod, "TaskProfile", boom)
+    import fugue_tpu.workflow.workflow as wf_mod
+
+    monkeypatch.setattr(wf_mod, "Profiler", boom)
+
+    dag = FugueWorkflow()
+    df = dag.df([[0], [1]], "a:int")
+    df.transform(observer, schema="*,b:double").yield_dataframe_as("r")
+    res = dag.run("jax")
+    assert res.profile() is None
+    assert seen == [None]
+    # obs on but profile off is still the off path
+    dag2 = FugueWorkflow()
+    dag2.df([[0]], "a:int").yield_dataframe_as("r")
+    assert dag2.run("jax", conf={"fugue.obs.enabled": True}).profile() is None
+
+
+def test_profile_conf_inert_without_obs_enabled():
+    # the FWF505 combination: conf-level profile with obs off is inert
+    dag = FugueWorkflow()
+    dag.df([[0]], "a:int").yield_dataframe_as("r")
+    assert dag.run("jax", conf={"fugue.obs.profile": True}).profile() is None
+
+
+def test_force_profiling_without_obs():
+    """The serve per-request flag: forced profiling works with obs off —
+    rows/bytes/wall recorded, phases empty (no trace to derive from)."""
+    dag = FugueWorkflow()
+    dag.df([[0], [1], [2]], "a:int").yield_dataframe_as("r")
+    with force_profiling():
+        res = dag.run("jax")
+    prof = res.profile()
+    assert prof is not None
+    rec = next(iter(prof.records.values()))
+    assert rec.rows_out == 3
+    assert rec.phases == {}
+
+
+def test_result_cache_hit_attribution():
+    """Exact cache attribution: second identical run on a fresh engine
+    with the in-memory result tier serves the checkpoint artifact (or
+    its memory tier) and the profiler records the hit on the right
+    task."""
+    tmp = tempfile.mkdtemp()
+    conf = {
+        **_PROFILE_CONF,
+        "fugue.workflow.checkpoint.path": tmp,
+        "fugue.optimize.result_cache": True,
+    }
+
+    def build():
+        dag = FugueWorkflow()
+        df = dag.df([[i, float(i)] for i in range(8)], "a:int,b:double")
+        df.select("a").deterministic_checkpoint().yield_dataframe_as("r")
+        return dag
+
+    engine = make_execution_engine("jax", conf)
+    first = build().run(engine).profile()
+    sel0 = [r for r in first.records.values() if "Select" in r.name][0]
+    assert sel0.cache.get("checkpoint") is None  # first run computes
+    second = build().run(engine).profile()
+    sel = [r for r in second.records.values() if "Select" in r.name][0]
+    hits = sel.cache
+    assert (
+        hits.get("checkpoint", {}).get("hit", 0)
+        + hits.get("result", {}).get("hit", 0)
+        >= 1
+    ), hits
+    # other tasks did not get the event mis-attributed
+    for rec in second.records.values():
+        if "Select" not in rec.name:
+            assert "checkpoint" not in rec.cache and "result" not in rec.cache
+
+
+def test_queue_wait_and_retry_attribution():
+    from fugue_tpu.testing.faults import FaultPlan, FaultSpec, inject_faults
+
+    dag = FugueWorkflow()
+    df = dag.df([[0]], "a:int")
+    df.select("a").yield_dataframe_as("r")
+    sel_name = dag.tasks[-1].name
+    plan = FaultPlan(
+        FaultSpec("task", sel_name, times=1, error=ConnectionResetError),
+        seed=7,
+    )
+    with inject_faults(plan):
+        res = dag.run(
+            "jax",
+            conf={**_PROFILE_CONF, "fugue.workflow.retry.max_attempts": 3,
+                  "fugue.workflow.retry.backoff": 0.01},
+        )
+    prof = res.profile()
+    rec = prof.by_name(sel_name)
+    assert rec is not None and rec.retries == 1
+    assert rec.attempts == 2  # one failed + one recovered attempt span
+    assert rec.queue_wait_ms >= 0.0
+
+
+def test_slow_query_log_top_tasks():
+    dag = _multi_join_dag()
+    prof = dag.run("jax", conf=_PROFILE_CONF).profile()
+    record = maybe_log_slow_query(
+        None, duration_ms=1000.0, slow_query_ms=1.0, profile=prof
+    )
+    assert record is not None
+    top = record["top_tasks"]
+    assert 1 <= len(top) <= 3
+    names = {rec.name for rec in prof.records.values()}
+    assert top[0]["name"] in names
+    assert "wall_ms" in top[0] and "phases" in top[0]
+    # top-1 really is the most expensive task
+    walls = sorted((r.wall_ms for r in prof.records.values()), reverse=True)
+    assert abs(top[0]["wall_ms"] - round(walls[0], 3)) < 1e-6
+
+
+# ---- EXPLAIN (static) ------------------------------------------------------
+def test_explain_workflow_report():
+    dag = _multi_join_dag()
+    report = dag.explain()
+    text = report.to_text()
+    assert text.startswith("EXPLAIN (optimized plan")
+    assert "RunJoin" in text and "CreateData" in text
+    assert "est_rows=16" in text and "est_device_bytes=" in text
+    d = report.to_dict()
+    assert d["optimized"] and not d["analyzed"]
+    assert len(d["tasks"]) == len(dag.explain().nodes)
+    # schemas propagated onto the nodes
+    creates = [t for t in d["tasks"] if t["type"] == "create"]
+    assert any("k:int" in t["schema"] for t in creates)
+    # callsites attached
+    assert any(
+        THIS_FILE in line for t in d["tasks"] for line in t["callsite"]
+    )
+
+
+def test_explain_rewrites_attached_and_off_mode():
+    dag = FugueWorkflow()
+    df = dag.df([[i, float(i), i * 2] for i in range(8)], "k:int,v:double,w:long")
+    df.rename({"w": "weight"}).filter(col("weight") > 4).select(
+        "k", "weight"
+    ).yield_dataframe_as("r")
+    report = dag.explain(conf={"fugue.optimize": "on"})
+    assert report.optimized and len(report.applied_rewrites) >= 1
+    assert any(n.rewrites for n in report.nodes)
+    off = dag.explain(conf={"fugue.optimize": "off"})
+    assert not off.optimized and off.to_text().startswith(
+        "EXPLAIN (unoptimized plan"
+    )
+    # an invalid mode raises exactly like run() would
+    with pytest.raises(ValueError):
+        dag.explain(conf={"fugue.optimize": "bogus"})
+
+
+def test_explain_sql_and_fa_explain():
+    report = explain_sql(
+        "a = CREATE [[0, 1.0], [1, 2.0]] SCHEMA k:int,v:double\n"
+        "SELECT k, SUM(v) AS s FROM a GROUP BY k\n"
+        "YIELD DATAFRAME AS res"
+    )
+    assert "RunSQLSelect" in report.to_text()
+    # fa.explain over a workflow / a workflow df / raw data
+    dag = _multi_join_dag()
+    assert fa.explain(dag).to_dict()["tasks"]
+    assert fa.explain(dag.last_df).to_dict()["tasks"]
+    # raw data wraps into a one-task plan via create_data
+    one = fa.explain([[0], [1]])
+    assert len(one.to_dict()["tasks"]) == 1
+
+
+# ---- statistics store ------------------------------------------------------
+def test_stats_store_record_replay_and_ring_bound():
+    tmp = tempfile.mkdtemp()
+    conf = {**_PROFILE_CONF, "fugue.stats.path": tmp,
+            "fugue.stats.history": 3}
+
+    def build():
+        dag = FugueWorkflow()
+        df = dag.df([[i] for i in range(5)], "a:int")
+        df.select("a").yield_dataframe_as("r")
+        return dag
+
+    engine = make_execution_engine("jax", conf)
+    fp = build().__uuid__()
+    for _ in range(5):
+        build().run(engine)
+    # a FRESH store (fresh engine) replays from disk — restart shape
+    store = RuntimeStatsStore(make_execution_engine("jax").fs, tmp, history=3)
+    hist = store.history(fp)
+    assert len(hist) == 3  # ring bounded at fugue.stats.history
+    rows = store.observed_rows(fp)
+    assert set(rows.values()) == {5}
+    assert store.fingerprints() == [fp]
+    assert store.latest(fp)["total_ms"] >= 0
+
+
+def test_stats_store_adopt_merges_rings():
+    src = tempfile.mkdtemp()
+    dst = tempfile.mkdtemp()
+    fs = make_execution_engine("native").fs
+    a = RuntimeStatsStore(fs, src)
+    b = RuntimeStatsStore(fs, dst)
+    a.record("fp1", {"tasks": {"u1": {"rows_out": 7}}})
+    b.record("fp2", {"tasks": {"u2": {"rows_out": 9}}})
+    merged = b.adopt(src)
+    assert merged == 1
+    assert b.observed_rows("fp1") == {"u1": 7}
+    assert b.observed_rows("fp2") == {"u2": 9}
+    # idempotent: re-adopting dedupes by recorded_at
+    before = len(b.history("fp1"))
+    b.adopt(src)
+    assert len(b.history("fp1")) == before
+
+
+def test_get_stats_store_shared_by_base_uri():
+    tmp = tempfile.mkdtemp()
+    e = make_execution_engine("native")
+    s1 = get_stats_store(e, tmp)
+    s2 = get_stats_store(e, tmp + "/")
+    assert s1 is s2
+
+
+def test_analyze_tree_honors_compile_conf_optimize_off():
+    """Review fix: the EXPLAIN ANALYZE tree must describe the plan the
+    run actually executed — a compile-conf fugue.optimize=off governs
+    the attached tree even on an engine whose conf carries the 'auto'
+    default, and every executed task gets its actual(...) block."""
+    dag = FugueWorkflow({"fugue.optimize": "off"})
+    df = dag.df(
+        [[i, float(i), i * 2] for i in range(8)], "k:int,v:double,w:long"
+    )
+    df.rename({"w": "weight"}).filter(col("weight") > 4).select(
+        "k", "weight"
+    ).yield_dataframe_as("r")
+    engine = make_execution_engine("jax", _PROFILE_CONF)
+    prof = dag.run(engine).profile()
+    text = prof.to_text()
+    assert "EXPLAIN ANALYZE (unoptimized plan" in text
+    assert text.count("actual(") == len(prof.records)
+    assert not dag.explain(engine=engine).optimized
+
+
+def test_duplicate_task_uuids_keep_both_records():
+    """Review fix: two spec-identical tasks share a content-hash uuid;
+    both observations must survive (uuid, then uuid#2 storage keys)."""
+    dag = FugueWorkflow({"fugue.optimize": "off"})
+    dag.df([[0, 1.0]], "k:int,v:double").yield_dataframe_as("ra")
+    dag.df([[0, 1.0]], "k:int,v:double").yield_dataframe_as("rb")
+    prof = dag.run("jax", conf=_PROFILE_CONF).profile()
+    assert len(prof.records) == 2 == len(prof.order)
+    assert len({id(r) for r in prof.records.values()}) == 2
+    assert len(prof.as_dict()["tasks"]) == 2
+
+
+def test_deep_chain_explains_without_recursion_limit():
+    """Review fix: EXPLAIN renders a deep linear DAG with an explicit
+    stack — no RecursionError where run() executes fine."""
+    dag = FugueWorkflow()
+    df = dag.df([[0, 0.0]], "a:int,b:double")
+    from fugue_tpu.column.expressions import col as _col
+
+    for _ in range(1500):
+        df = df.assign(b=_col("b") + 1.0)
+    text = dag.explain(conf={"fugue.optimize": "off"}).to_text()
+    assert text.count("Assign") >= 1500

@@ -201,6 +201,66 @@ def test_metrics_endpoint_prometheus_exposition():
         assert snap2["fugue_serve_job_seconds"]["samples"][0]["count"] == 1
 
 
+def test_metrics_content_type_and_exposition_round_trip():
+    """ISSUE 14 satellite: the scrape endpoint answers the EXACT
+    Prometheus text-format content type, and the full exposition
+    round-trips through the parser — every family name falls under a
+    registered prefix, histogram ``le`` buckets are ascending with
+    monotonically non-decreasing cumulative counts, and every parsed
+    sample value is finite-or-+Inf-labeled, never garbage."""
+    import math
+
+    from fugue_tpu.obs.metrics import METRIC_NAME_PREFIXES
+
+    with ServeDaemon(dict(_NO_BREAKER)) as daemon:
+        base = "http://%s:%d" % daemon.address
+        _, _, body = _request(base, "/v1/sessions", {})
+        sid = body["session_id"]
+        st, _, snap = _request(
+            base, f"/v1/sessions/{sid}/sql", {"sql": _QUERY, "mode": "sync"}
+        )
+        assert snap["status"] == "done"
+        with urllib.request.urlopen(base + "/v1/metrics") as resp:
+            assert (
+                resp.headers["Content-Type"]
+                == "text/plain; version=0.0.4; charset=utf-8"
+            )
+            text = resp.read().decode("utf-8")
+        parsed = parse_prometheus_text(text)
+        assert parsed  # something was scraped
+        histogram_bases = set()
+        for name in parsed:
+            stem = name
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name.endswith(suffix):
+                    stem = name[: -len(suffix)]
+                    if suffix == "_bucket":
+                        histogram_bases.add(stem)
+                    break
+            assert any(
+                stem.startswith(p) for p in METRIC_NAME_PREFIXES
+            ), f"family {name} outside the registered prefixes"
+        assert histogram_bases  # latency histograms were emitted
+        for stem in histogram_bases:
+            # group bucket samples by their non-le label set
+            series = {}
+            for labels, value in parsed[stem + "_bucket"].items():
+                le = dict(labels)["le"]
+                rest = tuple(kv for kv in labels if kv[0] != "le")
+                series.setdefault(rest, []).append((le, value))
+            for rest, buckets in series.items():
+                les = [
+                    math.inf if le == "+Inf" else float(le)
+                    for le, _ in buckets
+                ]
+                # render order IS ascending le order, +Inf last
+                assert les == sorted(les), (stem, rest, les)
+                counts = [v for _, v in buckets]
+                assert counts == sorted(counts), (stem, rest, counts)
+                # +Inf bucket equals the family _count sample
+                assert counts[-1] == parsed[stem + "_count"][rest]
+
+
 def test_status_gains_uptime_version_and_compile_cache():
     import fugue_tpu
 

@@ -1,0 +1,191 @@
+"""Serving-plane profiler surface (ISSUE 14): the ``profile`` submission
+flag + ``GET /v1/jobs/<id>/profile``, the ``explain`` flag (static plan
+report, nothing executes), the runtime-statistics store replaying
+observed rows across a daemon restart, and profile/explain retrieval
+through the fleet router across a planned failover/adoption.
+Tier-1 compatible; select with ``-m serve`` or ``-m profile``."""
+
+import json
+import tempfile
+import urllib.error
+import urllib.request
+
+import pytest
+
+from fugue_tpu.serve import ServeDaemon
+from fugue_tpu.serve.fleet import ServeFleet
+
+pytestmark = [pytest.mark.serve, pytest.mark.profile]
+
+_SAVE_TABLE = "CREATE [[0,1],[0,2],[1,3],[1,4],[2,5]] SCHEMA k:long,v:long"
+_GROUPBY = "SELECT k, SUM(v) AS s, COUNT(*) AS c FROM t GROUP BY k"
+
+
+def _request(base, path, payload=None, method=None):
+    data = None if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        base + path,
+        data=data,
+        method=method or ("POST" if data is not None else "GET"),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as ex:
+        body = ex.read()
+        return ex.code, (json.loads(body) if body else {})
+
+
+def _daemon_conf(tmp):
+    return {
+        "fugue.serve.state_path": tmp,
+        "fugue.serve.breaker.threshold": 0,
+        "fugue.workflow.resume": False,
+    }
+
+
+def test_job_profile_flag_and_retrieval_route():
+    tmp = tempfile.mkdtemp(prefix="fugue_profile_serve_")
+    with ServeDaemon(_daemon_conf(tmp)) as daemon:
+        base = "http://%s:%d" % daemon.address
+        _, body = _request(base, "/v1/sessions", {})
+        sid = body["session_id"]
+        _, snap = _request(
+            base, f"/v1/sessions/{sid}/sql",
+            {"sql": _SAVE_TABLE, "save_as": "t"},
+        )
+        assert snap["status"] == "done"
+        # unprofiled job -> /profile is a structured 404
+        st, err = _request(base, f"/v1/jobs/{snap['job_id']}/profile")
+        assert st == 404 and "profile" in err["error"]["message"]
+        # profiled job
+        st, snap = _request(
+            base, f"/v1/sessions/{sid}/sql",
+            {"sql": _GROUPBY, "profile": True},
+        )
+        assert st == 200 and snap["status"] == "done"
+        st, prof = _request(base, f"/v1/jobs/{snap['job_id']}/profile")
+        assert st == 200
+        assert prof["job_id"] == snap["job_id"]
+        tasks = prof["profile"]["tasks"]
+        sql_tasks = [t for t in tasks if t["name"].startswith("RunSQLSelect")]
+        assert sql_tasks and sql_tasks[0]["rows_out"] == 3  # 3 groups
+        assert prof["text"].startswith("EXPLAIN")
+
+
+def test_explain_flag_and_observed_rows_replay_across_restart():
+    tmp = tempfile.mkdtemp(prefix="fugue_profile_replay_")
+    conf = _daemon_conf(tmp)
+    daemon = ServeDaemon(conf).start()
+    try:
+        base = "http://%s:%d" % daemon.address
+        _, body = _request(base, "/v1/sessions", {})
+        sid = body["session_id"]
+        _request(
+            base, f"/v1/sessions/{sid}/sql",
+            {"sql": _SAVE_TABLE, "save_as": "t"},
+        )
+        # EXPLAIN: compiles, renders, never executes — no job is created
+        st, rep = _request(
+            base, f"/v1/sessions/{sid}/sql",
+            {"sql": _GROUPBY, "explain": True},
+        )
+        assert st == 200
+        assert "job_id" not in rep
+        assert rep["explain"]["text"].startswith("EXPLAIN")
+        assert "observed" not in rep  # nothing profiled yet
+        fingerprint = rep["fingerprint"]
+        # run it profiled: the stats store records the observation
+        _, snap = _request(
+            base, f"/v1/sessions/{sid}/sql",
+            {"sql": _GROUPBY, "profile": True},
+        )
+        assert snap["status"] == "done"
+        st, rep = _request(
+            base, f"/v1/sessions/{sid}/sql",
+            {"sql": _GROUPBY, "explain": True},
+        )
+        assert rep["fingerprint"] == fingerprint  # stable across calls
+        assert rep["observed"]["observations"] == 1
+        assert 3 in rep["observed"]["rows"].values()
+    finally:
+        daemon._hard_kill()
+    # a RESTARTED daemon replays the same fingerprint's observed rows.
+    # Drop the process-wide store cache first: an in-process restart
+    # must prove the DISK ring, not the previous daemon's memory
+    from fugue_tpu.obs import stats_store as _ss
+
+    with _ss._STORES_LOCK:
+        _ss._STORES.clear()
+    daemon2 = ServeDaemon(conf).start()
+    try:
+        base = "http://%s:%d" % daemon2.address
+        st, rep = _request(
+            base, f"/v1/sessions/{sid}/sql",
+            {"sql": _GROUPBY, "explain": True},
+        )
+        assert st == 200 and rep["fingerprint"] == fingerprint
+        assert rep["observed"]["observations"] == 1
+        assert 3 in rep["observed"]["rows"].values()
+        assert daemon2.status()["stats_store"]["uri"].endswith("stats")
+    finally:
+        daemon2.stop()
+
+
+@pytest.mark.fleet
+def test_fleet_forwards_profile_and_adopts_stats():
+    """The router forwards the explain flag and /profile by session
+    affinity, and a planned migration (rolling-restart step) carries
+    the origin replica's statistics rings to the adopter — the adopted
+    session's EXPLAIN still replays its observed rows."""
+    tmp = tempfile.mkdtemp(prefix="fugue_fleet_profile_")
+    conf = {
+        "fugue.serve.state_path": tmp,
+        "fugue.serve.breaker.threshold": 0,
+        "fugue.serve.fleet.result_cache_dir": "",
+    }
+    with ServeFleet(conf, replicas=2) as fleet:
+        base = "http://%s:%d" % fleet.address
+        _, body = _request(base, "/v1/sessions", {})
+        sid, owner = body["session_id"], body["replica"]
+        _, snap = _request(
+            base, f"/v1/sessions/{sid}/sql",
+            {"sql": _SAVE_TABLE, "save_as": "t"},
+        )
+        assert snap["status"] == "done"
+        # profiled job THROUGH the router; profile retrieval forwards
+        # to the owning replica by job -> session affinity
+        _, snap = _request(
+            base, f"/v1/sessions/{sid}/sql",
+            {"sql": _GROUPBY, "profile": True},
+        )
+        assert snap["status"] == "done"
+        st, prof = _request(base, f"/v1/jobs/{snap['job_id']}/profile")
+        assert st == 200 and prof["profile"]["tasks"]
+        # the fleet /v1/metrics scrape keeps the exposition content type
+        with urllib.request.urlopen(base + "/v1/metrics") as resp:
+            assert (
+                resp.headers["Content-Type"]
+                == "text/plain; version=0.0.4; charset=utf-8"
+            )
+            assert "fugue_fleet_replicas" in resp.read().decode("utf-8")
+        # planned migration: the owner drains, the survivor adopts its
+        # journal AND its statistics rings
+        step = fleet.restart_replica(owner)
+        assert step["migration_ran"]
+        st, rep = _request(
+            base, f"/v1/sessions/{sid}/sql",
+            {"sql": _GROUPBY, "explain": True},
+        )
+        assert st == 200
+        assert rep["observed"]["observations"] >= 1
+        assert 3 in rep["observed"]["rows"].values()
+        # and a fresh profiled run works on the adopting replica
+        _, snap = _request(
+            base, f"/v1/sessions/{sid}/sql",
+            {"sql": _GROUPBY, "profile": True},
+        )
+        assert snap["status"] == "done"
+        st, prof = _request(base, f"/v1/jobs/{snap['job_id']}/profile")
+        assert st == 200 and prof["profile"]["tasks"]
