@@ -31,8 +31,14 @@ CANONICAL_LOCK_ORDER = (
     "serve.fleet.FleetRouter._lock",
     # serve plane (owns requests and jobs)
     "serve.daemon.ServeDaemon._first_query_lock",
+    "serve.daemon.ServeDaemon._views_lock",
     "serve.scheduler.JobScheduler._lock",
     "serve.session.SessionManager._lock",
+    # stream plane: the standing-pipeline step claim sits ABOVE the
+    # session lock (a view refresh calls session.save_table) but the
+    # claim flag's critical sections are O(1) — fold/IO never run under
+    # it (steps coalesce through the busy flag instead)
+    "stream.pipeline.StandingPipeline._lock",
     "serve.session.ServeSession._lock",
     "serve.scheduler.ServeJob._finish_lock",
     "serve.supervisor.EngineSupervisor._lock",
@@ -71,6 +77,7 @@ ENGINE_FS_PATHS = (
     "fugue_tpu/jax_backend/",
     "fugue_tpu/optimize/",
     "fugue_tpu/obs/",
+    "fugue_tpu/stream/",
     "fugue_tpu/workflow/",
 )
 

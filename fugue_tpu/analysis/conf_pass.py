@@ -22,6 +22,7 @@ from fugue_tpu.constants import (
     FUGUE_CONF_SERVE_FLEET_REPLICAS,
     FUGUE_CONF_SERVE_MAX_CONCURRENT,
     FUGUE_CONF_SERVE_STATE_PATH,
+    FUGUE_CONF_STREAM_SOURCE,
     FUGUE_CONF_WORKFLOW_RESUME,
     declared_conf_keys,
 )
@@ -267,6 +268,56 @@ class ObsDependentConfWithoutObsRule(Rule):
                 "and FugueWorkflowResult.profile() stays None — set "
                 "fugue.obs.enabled=true (the serving 'profile' submission "
                 "flag forces profiling per request instead)",
+            )
+
+
+@register_rule
+class StreamConfRule(Rule):
+    code = "FWF506"
+    severity = Severity.WARN
+    description = (
+        "fugue.stream.* keys set without a streaming source (inert), or "
+        "a standing pipeline without fugue.workflow.resume (a restart "
+        "refolds every consumed file from scratch)"
+    )
+
+    def check(self, ctx: Any) -> Iterable[Diagnostic]:
+        stream_keys = sorted(
+            k for k in ctx.conf.keys() if k.startswith("fugue.stream.")
+        )
+        if not stream_keys:
+            return
+        source = str(
+            ctx.conf.get(FUGUE_CONF_STREAM_SOURCE, "") or ""
+        ).strip()
+        if source == "":
+            for key in stream_keys:
+                if key == FUGUE_CONF_STREAM_SOURCE:
+                    continue
+                yield self.diag(
+                    f"'{key}' is set but {FUGUE_CONF_STREAM_SOURCE} is "
+                    "empty: no standing pipeline tails anything, so the "
+                    "key is silently inert — set the source dir/URI (or "
+                    "drop the fugue.stream.* keys)",
+                )
+            return
+        try:
+            # _convert, not bool(): conf values legitimately arrive as
+            # strings, and bool("false") is True (FWF404's idiom)
+            resume = _convert(
+                ctx.conf.get(FUGUE_CONF_WORKFLOW_RESUME, False), bool
+            )
+        except Exception:
+            resume = False
+        if not resume:
+            yield self.diag(
+                f"{FUGUE_CONF_STREAM_SOURCE} configures a standing "
+                "pipeline but fugue.workflow.resume is off: the pipeline "
+                "keeps no durable progress manifest, so a killed driver "
+                "restarts from scratch and refolds every consumed file "
+                "(double-counted aggregates if the view was already "
+                "published) — set fugue.workflow.resume=true for "
+                "exactly-once restart",
             )
 
 

@@ -110,6 +110,37 @@ def _wf_join_filter_narrow() -> Any:
     return dag
 
 
+def _wf_streaming() -> Any:
+    """The standing-pipeline shape (ISSUE 15): the groupby aggregation a
+    micro-batch driver re-runs incrementally, compiled with the
+    ``fugue.stream.*`` conf a continuous deployment carries (source +
+    resume + checkpoint path, so FWF506 and FWF403-style resume rules
+    stay silent). The analyzer and EXPLAIN legs must both render it
+    clean — the serve plane builds exactly this per registered view."""
+    from fugue_tpu.column import functions as f
+    from fugue_tpu.column.expressions import col
+    from fugue_tpu.workflow.workflow import FugueWorkflow
+
+    dag = FugueWorkflow(
+        {
+            "fugue.stream.source": "memory://selftest/stream_in",
+            "fugue.stream.interval": 0.5,
+            "fugue.stream.watermark.delay": 5.0,
+            "fugue.workflow.resume": True,
+            "fugue.workflow.checkpoint.path": "memory://selftest/ckpt",
+        }
+    )
+    events = dag.df(
+        [[0, 1.0, 3], [1, 2.0, 7], [0, 3.0, 12]], "k:int,v:double,ts:long"
+    )
+    events.partition_by("k").aggregate(
+        s=f.sum(col("v")),
+        c=f.count(col("v")),
+        hi=f.max(col("v")),
+    ).yield_dataframe_as("view")
+    return dag
+
+
 WORKFLOW_BUILDERS: Dict[str, Callable[[], Any]] = {
     "transform": _wf_transform,
     "relational": _wf_relational,
@@ -117,6 +148,7 @@ WORKFLOW_BUILDERS: Dict[str, Callable[[], Any]] = {
     "checkpoint_yield": _wf_checkpoint_yield,
     "deep_chain_50": _wf_deep_chain,
     "join_filter_narrow": _wf_join_filter_narrow,
+    "streaming_pipeline": _wf_streaming,
 }
 
 

@@ -97,6 +97,12 @@ FUGUE_CONF_OBS_SAMPLE_RATE = "fugue.obs.sample_rate"
 FUGUE_CONF_OBS_PROFILE = "fugue.obs.profile"
 FUGUE_CONF_STATS_PATH = "fugue.stats.path"
 FUGUE_CONF_STATS_HISTORY = "fugue.stats.history"
+FUGUE_CONF_STREAM_SOURCE = "fugue.stream.source"
+FUGUE_CONF_STREAM_PATTERN = "fugue.stream.pattern"
+FUGUE_CONF_STREAM_INTERVAL = "fugue.stream.interval"
+FUGUE_CONF_STREAM_WATERMARK_DELAY = "fugue.stream.watermark.delay"
+FUGUE_CONF_STREAM_MAX_FILES = "fugue.stream.max_files_per_batch"
+FUGUE_CONF_STREAM_BATCH_ROWS = "fugue.stream.batch_rows"
 
 FUGUE_COMPILE_TIME_CONFIGS = {
     FUGUE_CONF_WORKFLOW_EXCEPTION_HIDE,
@@ -752,6 +758,61 @@ def _declare_defaults() -> None:
         32,
         "observations kept per query fingerprint in the runtime-"
         "statistics store (bounded ring)",
+        in_defaults=False,
+    )
+    # continuous execution (fugue_tpu/stream): a standing pipeline tails
+    # new parquet files under fugue.stream.source through the fs layer
+    # (mtime-then-name discovery order), folds each micro-batch into
+    # device-resident accumulators carried ACROSS batches, and commits
+    # an exactly-once progress manifest (consumed files + accumulator
+    # snapshot) per batch. Module-owned (read via typed_conf_get, not
+    # seeded); FWF506 warns about inert fugue.stream.* keys (no source)
+    # and a standing pipeline without fugue.workflow.resume (no durable
+    # progress manifest -> a restart refolds from scratch).
+    r(
+        FUGUE_CONF_STREAM_SOURCE,
+        str,
+        "",
+        "dir/URI (via engine.fs) a standing pipeline tails for arriving "
+        "parquet files ('' = no streaming source)",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_STREAM_PATTERN,
+        str,
+        "*.parquet",
+        "basename glob the tail source matches new files against",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_STREAM_INTERVAL,
+        float,
+        1.0,
+        "seconds between a standing pipeline's discovery polls "
+        "(0 = manual stepping only, no ticker thread)",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_STREAM_WATERMARK_DELAY,
+        float,
+        0.0,
+        "event-time lateness allowance: watermark = max event time seen "
+        "- delay; a window emits only once the watermark passes its end",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_STREAM_MAX_FILES,
+        int,
+        0,
+        "cap on files folded per micro-batch (0 = all newly discovered)",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_STREAM_BATCH_ROWS,
+        int,
+        0,
+        "rows per host chunk when folding one parquet file "
+        "(0 = pyarrow's record-batch default)",
         in_defaults=False,
     )
     # runtime lock-order sanitizer (testing/locktrace.py): debug-only.

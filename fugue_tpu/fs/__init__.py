@@ -1,6 +1,7 @@
 """URI-addressed virtual filesystem layer (see base.py for the design)."""
 
 from fugue_tpu.fs.base import (
+    FileInfo,
     FileSystemRegistry,
     VirtualFileSystem,
     is_uri,
@@ -14,6 +15,7 @@ from fugue_tpu.fs.base import (
 from fugue_tpu.fs.memory import reset_memory_fs
 
 __all__ = [
+    "FileInfo",
     "FileSystemRegistry",
     "VirtualFileSystem",
     "is_uri",

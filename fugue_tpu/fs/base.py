@@ -23,15 +23,37 @@ Design rules:
   concurrent reader never sees a torn file).
 """
 
+import fnmatch
 import posixpath
 import re
 from abc import ABC, abstractmethod
-from typing import Any, BinaryIO, Callable, Dict, List, Optional, Tuple
+from typing import (
+    Any,
+    BinaryIO,
+    Callable,
+    Dict,
+    List,
+    NamedTuple,
+    Optional,
+    Tuple,
+)
 
 from fugue_tpu.testing.faults import fault_point
 from fugue_tpu.utils.assertion import assert_or_throw
 
 _URI_RE = re.compile(r"^([A-Za-z][A-Za-z0-9+.-]*)://(.*)$")
+
+
+class FileInfo(NamedTuple):
+    """One filesystem entry's metadata. ``mtime`` is seconds since the
+    epoch and is guaranteed PRESENT on every backend (memory:// stamps
+    commit time; object stores map their last-modified) — the streaming
+    tail source's discovery order depends on it."""
+
+    path: str
+    size: int
+    mtime: float
+    isdir: bool
 
 
 def split_uri(uri: str) -> Tuple[str, str]:
@@ -111,6 +133,26 @@ class VirtualFileSystem(ABC):
     def file_size(self, path: str) -> int:
         raise NotImplementedError  # pragma: no cover
 
+    def info(self, path: str) -> FileInfo:
+        """Metadata of one entry, ``mtime`` included — every BUILTIN
+        backend produces a real modification time (the streaming tail
+        source's mtime-then-name discovery order depends on it). This
+        default (not abstract: out-of-tree backends written before it
+        existed must keep instantiating) derives size/isdir from the
+        required primitives and reports ``mtime=0.0`` — a backend used
+        as a streaming source SHOULD override with real timestamps
+        (with 0.0 everywhere, discovery degrades to pure name order).
+        Raises ``FileNotFoundError`` for missing paths."""
+        if not self.exists(path):
+            raise FileNotFoundError(path)
+        isdir = self.isdir(path)
+        return FileInfo(
+            path=path,
+            size=0 if isdir else int(self.file_size(path)),
+            mtime=0.0,
+            isdir=isdir,
+        )
+
     # ---- mutation --------------------------------------------------------
     @abstractmethod
     def makedirs(self, path: str, exist_ok: bool = True) -> None:
@@ -157,13 +199,44 @@ class VirtualFileSystem(ABC):
             fp.write(data)
         self.rm(src)
 
+    def list_chronological(
+        self, path: str, pattern: str = "*"
+    ) -> List[FileInfo]:
+        """Direct-child FILES of a directory in deterministic
+        (mtime, name) order — the streaming tail source's discovery
+        order: arrival order where mtimes differ, name order where a
+        burst of files lands within one timestamp granule. Dot/
+        underscore-prefixed names are skipped (atomic-write temps and
+        marker files, the same convention every part-file reader
+        applies); directories are skipped. A MISSING dir is an empty
+        list (a tail source may start before its first file arrives);
+        any other listing failure (auth, network) PROPAGATES — an
+        unreachable source must look broken, not merely idle."""
+        try:
+            names = self.listdir(path)
+        except FileNotFoundError:
+            return []
+        out: List[FileInfo] = []
+        for name in names:
+            if name.startswith(".") or name.startswith("_"):
+                continue
+            if not fnmatch.fnmatchcase(name, pattern):
+                continue
+            child = f"{path.rstrip('/')}/{name}" if path else name
+            try:
+                inf = self.info(child)
+            except FileNotFoundError:  # raced away between list and stat
+                continue
+            if inf.isdir:
+                continue
+            out.append(inf)
+        return sorted(out, key=lambda i: (i.mtime, i.path))
+
     def glob(self, pattern: str) -> List[str]:
         """Expand ``*``/``?``/``[...]`` PER PATH SEGMENT (``*`` never
         crosses ``/`` — standard glob semantics, matching the native
         local and fsspec backends), sorted. Default walks listdir —
         backends with native globbing override."""
-        import fnmatch
-
         if not any(c in pattern for c in "*?["):
             return [pattern] if self.exists(pattern) else []
         cur = ["/"] if pattern.startswith("/") else [""]
@@ -268,6 +341,29 @@ class FileSystemRegistry:
     def file_size(self, uri: str) -> int:
         fs, path = self.resolve(uri)
         return fs.file_size(path)
+
+    def info(self, uri: str) -> FileInfo:
+        """Entry metadata with the FULL URI restored into ``path`` so a
+        consumer can hand it straight back to any registry method."""
+        scheme, path = split_uri(uri)
+        fs, _ = self.resolve(uri)
+        inf = fs.info(path)
+        prefix = f"{scheme}://" if _URI_RE.match(uri) else ""
+        return inf._replace(path=prefix + inf.path)
+
+    def list_chronological(
+        self, uri: str, pattern: str = "*"
+    ) -> List[FileInfo]:
+        """Direct-child files of a directory URI in deterministic
+        (mtime, name) order (see the backend method); paths come back
+        as full URIs."""
+        scheme, path = split_uri(uri)
+        fs, _ = self.resolve(uri)
+        prefix = f"{scheme}://" if _URI_RE.match(uri) else ""
+        return [
+            i._replace(path=prefix + i.path)
+            for i in fs.list_chronological(path, pattern)
+        ]
 
     def makedirs(self, uri: str, exist_ok: bool = True) -> None:
         fs, path = self.resolve(uri)

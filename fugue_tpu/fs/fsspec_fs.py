@@ -8,7 +8,29 @@ raise the registry's NotImplementedError instead of an import error."""
 
 from typing import Any, BinaryIO, List
 
-from fugue_tpu.fs.base import VirtualFileSystem, register_filesystem
+from fugue_tpu.fs.base import FileInfo, VirtualFileSystem, register_filesystem
+
+
+def _mtime_of(detail: Any) -> float:
+    """Normalize fsspec's per-backend modified-time vocabulary (mtime /
+    LastModified / last_modified / created as float, datetime or ISO
+    string) into epoch seconds; 0.0 when the backend reports none."""
+    for key in ("mtime", "LastModified", "last_modified", "created"):
+        v = (detail or {}).get(key)
+        if v is None:
+            continue
+        if isinstance(v, (int, float)):
+            return float(v)
+        ts = getattr(v, "timestamp", None)
+        if callable(ts):
+            return float(ts())
+        try:
+            from datetime import datetime
+
+            return datetime.fromisoformat(str(v)).timestamp()
+        except Exception:
+            continue
+    return 0.0
 
 
 class FsspecFileSystem(VirtualFileSystem):
@@ -54,6 +76,22 @@ class FsspecFileSystem(VirtualFileSystem):
 
     def file_size(self, path: str) -> int:
         return int(self._fs.size(self._q(path)))
+
+    def info(self, path: str) -> FileInfo:
+        p = self._q(path)
+        try:
+            detail = self._fs.info(p)
+        except FileNotFoundError:
+            raise
+        except Exception as ex:  # pragma: no cover - backend-specific
+            raise FileNotFoundError(f"{self.scheme}://{p}: {ex}")
+        isdir = str(detail.get("type", "file")) == "directory"
+        return FileInfo(
+            path=path,
+            size=0 if isdir else int(detail.get("size") or 0),
+            mtime=_mtime_of(detail),
+            isdir=isdir,
+        )
 
     def makedirs(self, path: str, exist_ok: bool = True) -> None:
         self._fs.makedirs(self._q(path), exist_ok=exist_ok)

@@ -5,7 +5,7 @@ import os
 import shutil
 from typing import BinaryIO, List
 
-from fugue_tpu.fs.base import VirtualFileSystem, register_filesystem
+from fugue_tpu.fs.base import FileInfo, VirtualFileSystem, register_filesystem
 
 
 class LocalFileSystem(VirtualFileSystem):
@@ -30,6 +30,16 @@ class LocalFileSystem(VirtualFileSystem):
 
     def file_size(self, path: str) -> int:
         return os.path.getsize(path)
+
+    def info(self, path: str) -> FileInfo:
+        st = os.stat(path)
+        isdir = os.path.isdir(path)
+        return FileInfo(
+            path=path,
+            size=0 if isdir else int(st.st_size),
+            mtime=float(st.st_mtime),
+            isdir=isdir,
+        )
 
     def makedirs(self, path: str, exist_ok: bool = True) -> None:
         os.makedirs(path, exist_ok=exist_ok)

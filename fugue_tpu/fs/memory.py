@@ -9,14 +9,19 @@ driver process, exactly like a real remote store would."""
 
 import io
 import posixpath
+import time
 from threading import RLock
 from typing import BinaryIO, Callable, Dict, List
 
-from fugue_tpu.fs.base import VirtualFileSystem, register_filesystem
+from fugue_tpu.fs.base import FileInfo, VirtualFileSystem, register_filesystem
 
 _LOCK = RLock()
 _FILES: Dict[str, bytes] = {}
 _DIRS: set = set()
+# commit-time timestamps: files stamp at (every) commit, dirs at
+# creation. Strictly non-decreasing so a same-granule burst still
+# resolves deterministically through the (mtime, name) listing order.
+_MTIMES: Dict[str, float] = {}
 
 
 def reset_memory_fs() -> None:
@@ -24,6 +29,7 @@ def reset_memory_fs() -> None:
     with _LOCK:
         _FILES.clear()
         _DIRS.clear()
+        _MTIMES.clear()
 
 
 def _norm(path: str) -> str:
@@ -77,7 +83,10 @@ class MemoryFileSystem(VirtualFileSystem):
         def commit(data: bytes) -> None:
             with _LOCK:
                 _FILES[p] = data
-                _DIRS.update(_parents(p))
+                _MTIMES[p] = time.time()
+                for d in _parents(p):
+                    _DIRS.add(d)
+                    _MTIMES.setdefault(d, _MTIMES[p])
 
         return _WriteBuffer(commit)
 
@@ -110,20 +119,39 @@ class MemoryFileSystem(VirtualFileSystem):
                 raise FileNotFoundError(f"memory://{p}")
             return len(_FILES[p])
 
+    def info(self, path: str) -> FileInfo:
+        p = _norm(path)
+        with _LOCK:
+            if p in _FILES:
+                return FileInfo(
+                    path=p,
+                    size=len(_FILES[p]),
+                    mtime=_MTIMES.get(p, 0.0),
+                    isdir=False,
+                )
+            if p == "" or p in _DIRS:
+                return FileInfo(
+                    path=p, size=0, mtime=_MTIMES.get(p, 0.0), isdir=True
+                )
+            raise FileNotFoundError(f"memory://{p}")
+
     def makedirs(self, path: str, exist_ok: bool = True) -> None:
         p = _norm(path)
         with _LOCK:
             if not exist_ok and p in _DIRS:
                 raise FileExistsError(f"memory://{p}")
             if p != "":
-                _DIRS.add(p)
-                _DIRS.update(_parents(p))
+                now = time.time()
+                for d in [p] + _parents(p):
+                    _DIRS.add(d)
+                    _MTIMES.setdefault(d, now)
 
     def rm(self, path: str, recursive: bool = False) -> None:
         p = _norm(path)
         with _LOCK:
             if p in _FILES:
                 del _FILES[p]
+                _MTIMES.pop(p, None)
                 return
             if p in _DIRS:
                 prefix = p + "/"
@@ -133,26 +161,39 @@ class MemoryFileSystem(VirtualFileSystem):
                     raise OSError(f"memory://{p} is not empty")
                 for k in children:
                     del _FILES[k]
+                    _MTIMES.pop(k, None)
                 for k in subdirs:
                     _DIRS.discard(k)
+                    _MTIMES.pop(k, None)
                 _DIRS.discard(p)
+                _MTIMES.pop(p, None)
 
     def rename(self, src: str, dst: str) -> None:
         s, d = _norm(src), _norm(dst)
         with _LOCK:
             if s in _FILES:
                 _FILES[d] = _FILES.pop(s)
+                # rename preserves the source's commit time (os.replace
+                # semantics): an atomic temp+rename write carries the
+                # moment the bytes were committed, not the rename
+                _MTIMES[d] = _MTIMES.pop(s, time.time())
                 _DIRS.update(_parents(d))
                 return
             if s in _DIRS:
                 prefix = s + "/"
                 for k in [k for k in _FILES if k.startswith(prefix)]:
-                    _FILES[d + "/" + k[len(prefix):]] = _FILES.pop(k)
+                    moved = d + "/" + k[len(prefix):]
+                    _FILES[moved] = _FILES.pop(k)
+                    _MTIMES[moved] = _MTIMES.pop(k, time.time())
                 for k in [k for k in _DIRS if k.startswith(prefix)]:
                     _DIRS.discard(k)
                     _DIRS.add(d + "/" + k[len(prefix):])
+                    _MTIMES[d + "/" + k[len(prefix):]] = _MTIMES.pop(
+                        k, time.time()
+                    )
                 _DIRS.discard(s)
                 _DIRS.add(d)
+                _MTIMES[d] = _MTIMES.pop(s, time.time())
                 _DIRS.update(_parents(d))
                 return
             raise FileNotFoundError(f"memory://{s}")

@@ -46,6 +46,7 @@ METRIC_NAME_PREFIXES = (
     "fugue_fleet_",
     "fugue_obs_",
     "fugue_stats_",
+    "fugue_stream_",
     "fugue_workflow_",
 )
 

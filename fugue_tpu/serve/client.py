@@ -320,6 +320,49 @@ class ServeClient:
                 raise ServeJobTimeoutError(job_id, limit, snap)
             time.sleep(poll)
 
+    # ---- standing pipelines / materialized views -------------------------
+    def register_pipeline(
+        self, session_id: str, spec: Dict[str, Any], step: bool = True
+    ) -> Dict[str, Any]:
+        """Register a standing pipeline maintaining ``spec["name"]`` as
+        this session's materialized view (see README "Continuous
+        pipelines" for the spec shape)."""
+        payload = dict(spec)
+        payload["step"] = step
+        return self._call(
+            "POST", f"/v1/sessions/{session_id}/pipelines", payload
+        )
+
+    def pipelines(self, session_id: str) -> List[Dict[str, Any]]:
+        return self._call(
+            "GET", f"/v1/sessions/{session_id}/pipelines"
+        )["pipelines"]
+
+    def pipeline(self, session_id: str, name: str) -> Dict[str, Any]:
+        return self._call(
+            "GET", f"/v1/sessions/{session_id}/pipelines/{name}"
+        )
+
+    def step_pipeline(
+        self, session_id: str, name: str, force_refresh: bool = False
+    ) -> Dict[str, Any]:
+        """Run one micro-batch now; ``{"skipped": "busy"}`` when a
+        concurrent (ticker or manual) step already runs."""
+        return self._call(
+            "POST",
+            f"/v1/sessions/{session_id}/pipelines/{name}/step",
+            {"force_refresh": force_refresh},
+        )
+
+    def remove_pipeline(
+        self, session_id: str, name: str, drop_table: bool = False
+    ) -> Dict[str, Any]:
+        return self._call(
+            "DELETE",
+            f"/v1/sessions/{session_id}/pipelines/{name}",
+            {"drop_table": drop_table},
+        )
+
     # ---- daemon ----------------------------------------------------------
     def status(self) -> Dict[str, Any]:
         return self._call("GET", "/v1/status")

@@ -77,7 +77,7 @@ import threading
 import time
 import uuid
 from contextlib import nullcontext
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from fugue_tpu.constants import (
     FUGUE_CONF_SERVE_FLEET_RESULT_CACHE_DIR,
@@ -275,6 +275,7 @@ class ServeDaemon:
         self._started_at: Optional[float] = None
         self._recovery: Dict[str, int] = {
             "sessions": 0,
+            "pipelines": 0,
             "jobs_resubmitted": 0,
             "jobs_failed_over": 0,
         }
@@ -295,6 +296,16 @@ class ServeDaemon:
         self._first_query: Optional[Dict[str, Any]] = None
         self._first_query_lock = tracked_lock(
             "serve.daemon.ServeDaemon._first_query_lock"
+        )
+        # ---- standing pipelines / materialized views (ISSUE 15) ----------
+        # (session_id, name) -> MaterializedView. Registration journals
+        # the SPEC into the session record; restart/adoption rebuilds
+        # the objects and each pipeline's progress manifest restores
+        # its exactly-once state. The lock only guards the dict —
+        # stepping/refreshing never runs under it.
+        self._views: Dict[Tuple[str, str], Any] = {}
+        self._views_lock = tracked_lock(
+            "serve.daemon.ServeDaemon._views_lock"
         )
         # ---- observability plane (ISSUE 8) -------------------------------
         # the daemon's counters live on the ENGINE's metrics registry
@@ -436,6 +447,7 @@ class ServeDaemon:
         self._supervisor.tick_hooks = [
             self._sessions.sweep,
             self._scheduler.gc_payloads,
+            self._sweep_views,
         ]
         if self._journal is not None:
             self._supervisor.tick_hooks.append(self._journal.maybe_flush)
@@ -511,6 +523,11 @@ class ServeDaemon:
         read."""
         data = self._journal.load()
         self._recovery["sessions"] = self._sessions.restore(
+            data.get("sessions") or {}
+        )
+        # standing pipelines rebuild from their journaled specs; each
+        # progress manifest restores the last committed micro-batch
+        self._recovery["pipelines"] = self._restore_views(
             data.get("sessions") or {}
         )
         resubmitted, failed_over = self._resubmit_journaled_jobs(
@@ -606,6 +623,13 @@ class ServeDaemon:
         fs = self._engine.fs
         data = ServeStateJournal.read_state(fs, base, log=self._engine.log)
         adopted, expired = self._sessions.adopt(data["sessions"])
+        # the adopted sessions' standing pipelines move with them: the
+        # specs rode along in the imported records, and the progress
+        # manifests (origin state dir, shared fs) resume exactly-once
+        adopted_pipelines = self._restore_views(
+            data["sessions"], only=set(adopted)
+        )
+        self._recovery["pipelines"] += adopted_pipelines
         resubmitted, failed_over = self._resubmit_journaled_jobs(
             data["jobs"], import_into_journal=True
         )
@@ -638,6 +662,7 @@ class ServeDaemon:
         return {
             "sessions": adopted,
             "expired_sessions": expired,
+            "pipelines": adopted_pipelines,
             "stats_fingerprints": adopted_stats,
             "jobs_resubmitted": resubmitted,
             "jobs_failed_over": failed_over,
@@ -666,6 +691,7 @@ class ServeDaemon:
         # a stopped daemon must not keep publishing gauges through a
         # caller-owned engine's registry (stale values, leaked refs)
         self._engine.metrics.remove_collector(self._collect_serve_gauges)
+        self._stop_views()  # tickers off; progress manifests survive
         self._supervisor.stop()
         self._http.stop()
         self._scheduler.stop()
@@ -715,6 +741,7 @@ class ServeDaemon:
         self._started = False
         self._join_prewarm()
         self._engine.metrics.remove_collector(self._collect_serve_gauges)
+        self._stop_views()
         # scheduler FIRST: its first act is dropping the finish
         # observers, so a job completing while the rest of the teardown
         # runs can no longer clean its journal entry — a real kill -9
@@ -743,8 +770,216 @@ class ServeDaemon:
         return self._sessions.create(ttl=ttl)
 
     def close_session(self, session_id: str) -> Dict[str, Any]:
+        self._drop_session_views(session_id)
         dropped = self._sessions.close(session_id)
         return {"closed": session_id, "dropped_tables": dropped}
+
+    # ---- standing pipelines / materialized views (ISSUE 15) --------------
+    def register_pipeline(
+        self, session_id: str, payload: Dict[str, Any], step: bool = True
+    ) -> Dict[str, Any]:
+        """Register a standing pipeline maintaining ``payload["name"]``
+        as this session's continuously-refreshed materialized view. The
+        spec is journaled into the session record (restart + adoption
+        rebuild it); the progress manifest defaults under the durable
+        state path so a rebuilt pipeline resumes exactly-once. An
+        initial ``step`` folds any already-arrived files so the view is
+        queryable immediately."""
+        from fugue_tpu.stream.pipeline import PipelineSpec
+        from fugue_tpu.stream.view import MaterializedView, view_progress_uri
+
+        self._reject_if_unhealthy()
+        session = self._sessions.get(session_id)
+        spec = PipelineSpec.from_dict(payload)
+        if spec.progress is None and self._journal is not None:
+            spec.progress = view_progress_uri(
+                self._engine.fs,
+                self._journal.base_uri,
+                session_id,
+                spec.name,
+            )
+        key = (session_id, spec.name)
+        with self._views_lock:
+            if key in self._views:
+                raise ValueError(
+                    f"pipeline {spec.name!r} is already registered on "
+                    f"session {session_id}"
+                )
+        view = MaterializedView(self._engine, session, spec)
+        with self._views_lock:
+            if key in self._views:  # lost a registration race
+                view.stop()
+                raise ValueError(
+                    f"pipeline {spec.name!r} is already registered on "
+                    f"session {session_id}"
+                )
+            self._views[key] = view
+        if self._journal is not None:
+            self._journal.record_pipeline(
+                session_id, spec.name, spec.to_dict()
+            )
+        out: Dict[str, Any] = {
+            "session_id": session_id,
+            "name": spec.name,
+            "progress": spec.progress,
+            "interval": spec.interval,
+        }
+        # ticker FIRST: the registration stands even when the initial
+        # step fails (bad first file, NULL keys) — the error rides the
+        # response, the pipeline stays registered and keeps ticking
+        # (the step rolled back, so a fixed source folds cleanly later)
+        view.start()
+        if step:
+            try:
+                out["report"] = view.step()
+            except Exception as ex:
+                self._engine.log.warning(
+                    "fugue_tpu serve: initial step of pipeline %s.%s "
+                    "failed (%s: %s); registration stands",
+                    session_id, spec.name, type(ex).__name__, ex,
+                )
+                out["report"] = {
+                    "pipeline": spec.name,
+                    "error": f"{type(ex).__name__}: {ex}",
+                }
+        return out
+
+    def _get_view(self, session_id: str, name: str) -> Any:
+        self._sessions.get(session_id)  # 404 + touch
+        with self._views_lock:
+            view = self._views.get((session_id, name))
+        if view is None:
+            raise KeyError(
+                f"no pipeline {name!r} registered on session {session_id}"
+            )
+        return view
+
+    def list_pipelines(self, session_id: str) -> List[Dict[str, Any]]:
+        self._sessions.get(session_id)
+        with self._views_lock:
+            views = [
+                v for (sid, _), v in sorted(self._views.items())
+                if sid == session_id
+            ]
+        return [v.describe() for v in views]
+
+    def describe_pipeline(
+        self, session_id: str, name: str
+    ) -> Dict[str, Any]:
+        return self._get_view(session_id, name).describe()
+
+    def step_pipeline(
+        self, session_id: str, name: str, force_refresh: bool = False
+    ) -> Dict[str, Any]:
+        """Run one micro-batch of a registered pipeline NOW (the manual
+        complement of the interval ticker; concurrent steps coalesce)."""
+        self._reject_if_unhealthy()
+        return self._get_view(session_id, name).step(
+            force_refresh=force_refresh
+        )
+
+    def remove_pipeline(
+        self, session_id: str, name: str, drop_table: bool = False
+    ) -> Dict[str, Any]:
+        view = self._get_view(session_id, name)
+        with self._views_lock:
+            self._views.pop((session_id, name), None)
+        view.remove(drop_table=drop_table)
+        if self._journal is not None:
+            self._journal.forget_pipeline(session_id, name)
+        return {
+            "removed": name,
+            "session_id": session_id,
+            "dropped_table": drop_table,
+        }
+
+    def _restore_views(
+        self, journaled: Dict[str, Dict[str, Any]], only: Any = None
+    ) -> int:
+        """Rebuild pipeline objects from journaled session records (the
+        restart/adoption path). Each pipeline's progress manifest
+        restores its accumulator state; a batch whose commit landed but
+        whose refresh never confirmed re-emits on its first step. Never
+        raises — a broken spec loses one view, not the daemon."""
+        from fugue_tpu.stream.pipeline import PipelineSpec
+        from fugue_tpu.stream.view import MaterializedView
+
+        restored = 0
+        for sid, rec in sorted(journaled.items()):
+            if only is not None and sid not in only:
+                continue
+            session = self._sessions.peek(sid)
+            if session is None:
+                continue
+            for name, spec_dict in sorted(
+                (rec.get("pipelines") or {}).items()
+            ):
+                key = (sid, name)
+                with self._views_lock:
+                    if key in self._views:
+                        continue
+                try:
+                    view = MaterializedView(
+                        self._engine, session,
+                        PipelineSpec.from_dict(spec_dict),
+                    )
+                except Exception as ex:
+                    self._engine.log.warning(
+                        "fugue_tpu serve: could not restore pipeline "
+                        "%s.%s (%s: %s); its journal record is kept",
+                        sid, name, type(ex).__name__, ex,
+                    )
+                    continue
+                with self._views_lock:
+                    self._views[key] = view
+                view.start()
+                restored += 1
+        return restored
+
+    def _drop_session_views(self, session_id: str) -> None:
+        """A closing session takes its views down with it (tickers
+        stopped, progress manifests cleared; the journal records die
+        with the session record)."""
+        with self._views_lock:
+            keys = [k for k in self._views if k[0] == session_id]
+            views = [self._views.pop(k) for k in keys]
+        for v in views:
+            try:
+                v.remove(drop_table=False)
+            except Exception:  # pragma: no cover - best-effort cleanup
+                pass
+
+    def _sweep_views(self) -> None:
+        """Supervisor tick hook: a view whose session expired (TTL
+        sweep) must stop ticking — peek, never get, so the sweep itself
+        cannot keep an abandoned session alive."""
+        with self._views_lock:
+            items = list(self._views.items())
+        for (sid, name), view in items:
+            if self._sessions.peek(sid) is not None:
+                continue
+            with self._views_lock:
+                self._views.pop((sid, name), None)
+            try:
+                # remove, not stop: the expired session's journal record
+                # (pipeline specs included) is gone, so an orphaned
+                # progress manifest would sit on shared fs forever
+                view.remove(drop_table=False)
+            except Exception:  # pragma: no cover - best-effort cleanup
+                pass
+
+    def _stop_views(self) -> None:
+        """Daemon shutdown: stop tickers, KEEP progress manifests and
+        journal records — the next daemon on this state path rebuilds
+        and resumes the pipelines."""
+        with self._views_lock:
+            views = list(self._views.values())
+            self._views.clear()
+        for v in views:
+            try:
+                v.stop()
+            except Exception:  # pragma: no cover - best-effort cleanup
+                pass
 
     def memory_pressure(self) -> float:
         """Device-tier fill fraction of the governed budget (0.0 when
@@ -1501,6 +1736,33 @@ class ServeDaemon:
                 return 200, self.close_session(sid)
             if rest == ["sql"] and method == "POST":
                 return self._route_sql(sid, payload, request_id)
+            if rest and rest[0] == "pipelines":
+                prest = rest[1:]
+                if not prest and method == "POST":
+                    return 200, self.register_pipeline(
+                        sid, payload,
+                        step=bool(payload.get("step", True)),
+                    )
+                if not prest and method == "GET":
+                    return 200, {"pipelines": self.list_pipelines(sid)}
+                if len(prest) == 1 and method == "GET":
+                    return 200, self.describe_pipeline(sid, prest[0])
+                if len(prest) == 1 and method == "DELETE":
+                    return 200, self.remove_pipeline(
+                        sid, prest[0],
+                        drop_table=bool(payload.get("drop_table", False)),
+                    )
+                if (
+                    len(prest) == 2
+                    and prest[1] == "step"
+                    and method == "POST"
+                ):
+                    return 200, self.step_pipeline(
+                        sid, prest[0],
+                        force_refresh=bool(
+                            payload.get("force_refresh", False)
+                        ),
+                    )
         if route == ["admin", "adopt"] and method == "POST":
             state_path = payload.get("state_path")
             if not isinstance(state_path, str) or not state_path.strip():

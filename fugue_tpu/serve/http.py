@@ -53,8 +53,13 @@ class _ServeAPIHandler(HardenedRequestHandler):
         self._route("GET", b"")
 
     def do_DELETE(self) -> None:  # noqa: N802
+        # DELETE may carry a small JSON body (pipeline removal options);
+        # absent Content-Length reads as empty, exactly like before
         self._begin_request()
-        self._route("DELETE", b"")
+        body = self.read_body()
+        if body is None:
+            return
+        self._route("DELETE", body)
 
     def do_POST(self) -> None:  # noqa: N802
         # correlation id FIRST: even a 400/413 body rejection (written

@@ -470,6 +470,14 @@ class SessionManager:
         session.touch()
         return session
 
+    def peek(self, session_id: str) -> Optional[ServeSession]:
+        """The live session WITHOUT touching it (no TTL refresh, no
+        sweep) — how the daemon's view sweep checks liveness without
+        keeping an abandoned session alive forever."""
+        with self._lock:
+            session = self._sessions.get(session_id)
+        return None if session is None or session.expired else session
+
     def close(self, session_id: str) -> List[str]:
         with self._lock:
             session = self._sessions.pop(session_id, None)

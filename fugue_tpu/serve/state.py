@@ -281,6 +281,32 @@ class ServeStateJournal:
         if existed:
             self.write()
 
+    # ---- standing pipelines (materialized views) -------------------------
+    def record_pipeline(
+        self, session_id: str, name: str, spec: Dict[str, Any]
+    ) -> None:
+        """Journal a standing pipeline's SPEC under its session record:
+        a restarted (or adopting) daemon rebuilds the pipeline object
+        from the spec, and the progress manifest the spec points at
+        restores its exactly-once state."""
+        with self._lock:
+            rec = self._sessions.get(session_id)
+            if rec is None:  # pragma: no cover - session raced away
+                return
+            rec.setdefault("pipelines", {})[name] = copy.deepcopy(spec)
+            rec["last_used"] = time.time()
+        self.write()
+
+    def forget_pipeline(self, session_id: str, name: str) -> None:
+        with self._lock:
+            rec = self._sessions.get(session_id)
+            existed = (
+                rec is not None
+                and rec.get("pipelines", {}).pop(name, None) is not None
+            )
+        if existed:
+            self.write()
+
     # ---- async job journal -----------------------------------------------
     def record_job(self, job: Any) -> None:
         with self._lock:

@@ -1,0 +1,34 @@
+"""Continuous execution: standing pipelines over arriving data.
+
+The subsystem composing the repo's batch parts into Spark-Structured-
+Streaming's micro-batch role (ROADMAP open item 4):
+
+- :mod:`fugue_tpu.stream.source` — a tail source discovering new
+  parquet files/URIs through the fs layer in deterministic
+  (mtime, name) order, with a consumed-file ledger;
+- :mod:`fugue_tpu.stream.progress` — the exactly-once progress
+  manifest: consumed-file set + accumulator-state checkpoint,
+  atomically rewritten per committed micro-batch;
+- :mod:`fugue_tpu.stream.pipeline` — the micro-batch driver: groupby/
+  window accumulator state carried ACROSS micro-batches on device
+  (:class:`~fugue_tpu.jax_backend.streaming.StreamingAggregator`),
+  watermark-based emission for event-time windows;
+- :mod:`fugue_tpu.stream.view` — the serving loop closure: a standing
+  pipeline maintaining a serve session table as a continuously-
+  refreshed materialized view (each refresh bumps the catalog epoch so
+  the serve result caches self-invalidate).
+"""
+
+from fugue_tpu.stream.pipeline import PipelineSpec, StandingPipeline
+from fugue_tpu.stream.progress import StreamProgress
+from fugue_tpu.stream.source import ParquetTailSource, read_parquet_chunks
+from fugue_tpu.stream.view import MaterializedView
+
+__all__ = [
+    "MaterializedView",
+    "ParquetTailSource",
+    "PipelineSpec",
+    "StandingPipeline",
+    "StreamProgress",
+    "read_parquet_chunks",
+]

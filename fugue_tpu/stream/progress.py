@@ -1,0 +1,163 @@
+"""Exactly-once progress manifest for a standing pipeline.
+
+Per committed micro-batch the driver atomically rewrites ONE JSON file
+(:func:`fugue_tpu.workflow.manifest.atomic_json_write` — the same crash-
+durability primitive as the run manifest and the serve journal)::
+
+    {"pipeline": <id>, "batches": n, "rows": n,
+     "consumed": {path: {"size": ..., "mtime": ...}},
+     "watermark": <max event time seen - delay, or null>,
+     "state": <StreamingAggregator.snapshot()>, "refreshed": bool}
+
+The commit point IS the exactly-once boundary:
+
+- killed MID-FOLD (before commit): the manifest still holds the
+  pre-batch accumulator snapshot and the pre-batch consumed set — the
+  restart restores that state and re-discovers the un-consumed files,
+  so the interrupted fold re-runs from exactly where it started.
+  Nothing the torn fold pushed onto the device survives the process,
+  so nothing is double-counted.
+- killed BETWEEN commit and view refresh: the state is committed with
+  ``refreshed=false``; the restart re-emits the view from the restored
+  snapshot without re-folding anything.
+
+Concurrency contract: a StreamProgress instance is only touched by the
+pipeline's CLAIMED step (the driver serializes steps through a busy
+flag, not by holding a lock across this IO), so no lock lives here.
+"""
+
+from typing import Any, Dict, List, Optional
+
+from fugue_tpu.fs.base import FileInfo
+from fugue_tpu.testing.faults import fault_point
+from fugue_tpu.workflow.manifest import atomic_json_write, read_json
+
+
+class StreamProgress:
+    """The consumed-file ledger + state checkpoint of one pipeline.
+    ``uri=None`` keeps progress in memory only (an EPHEMERAL pipeline:
+    a restart refolds from scratch — FWF506's warning subject)."""
+
+    def __init__(
+        self, fs: Any, uri: Optional[str], pipeline_id: str, log: Any = None
+    ):
+        self._fs = fs
+        self.uri = uri
+        self.pipeline_id = pipeline_id
+        self._log = log
+        self.consumed: Dict[str, Dict[str, Any]] = {}
+        self.batches = 0
+        self.rows = 0
+        self.watermark: Optional[float] = None
+        self.state: Optional[Dict[str, Any]] = None
+        self.refreshed = True
+        self.restored = False
+
+    @property
+    def durable(self) -> bool:
+        return self.uri is not None
+
+    def load(self) -> bool:
+        """Read a prior run's manifest; True when prior state existed
+        (the pipeline restarts from its last committed micro-batch)."""
+        if self.uri is None:
+            return False
+        data = read_json(
+            self._fs, self.uri, log=self._log, what="stream progress manifest"
+        )
+        if data is None or data.get("pipeline") != self.pipeline_id:
+            return False
+        self.consumed = dict(data.get("consumed") or {})
+        self.batches = int(data.get("batches", 0))
+        self.rows = int(data.get("rows", 0))
+        self.watermark = data.get("watermark")
+        self.state = data.get("state")
+        self.refreshed = bool(data.get("refreshed", True))
+        self.restored = True
+        return True
+
+    def _payload(self) -> Dict[str, Any]:
+        return {
+            "pipeline": self.pipeline_id,
+            "batches": self.batches,
+            "rows": self.rows,
+            "consumed": self.consumed,
+            "watermark": self.watermark,
+            "state": self.state,
+            "refreshed": self.refreshed,
+        }
+
+    def commit(
+        self,
+        entries: List[FileInfo],
+        state: Optional[Dict[str, Any]],
+        watermark: Optional[float],
+        rows: int,
+    ) -> None:
+        """Commit one folded micro-batch: consumed set + state snapshot
+        land in ONE atomic write (chaos site ``stream.commit``), with
+        ``refreshed=False`` until the view refresh confirms. A failing
+        durable commit RAISES and applies NOTHING in memory either —
+        the fold result must not be observable (via the view or this
+        object) without its exactly-once record, or a restart (or a
+        retried step) would double-count the batch."""
+        staged = dict(self.consumed)
+        for e in entries:
+            staged[e.path] = {"size": e.size, "mtime": e.mtime}
+        payload = {
+            "pipeline": self.pipeline_id,
+            "batches": self.batches + 1,
+            "rows": self.rows + rows,
+            "consumed": staged,
+            "watermark": watermark,
+            "state": state,
+            "refreshed": False,
+        }
+        if self.uri is not None:
+            fault_point("stream.commit", self.uri)
+            atomic_json_write(self._fs, self.uri, payload)
+        # durable record landed (or the pipeline is ephemeral): the
+        # in-memory view now matches it exactly
+        self.consumed = staged
+        self.batches += 1
+        self.rows += rows
+        self.state = state
+        self.watermark = watermark
+        self.refreshed = False
+
+    def mark_refreshed(self) -> None:
+        """The view refresh landed: record it so a restart does not
+        re-emit an already-published snapshot. Best-effort — a failed
+        write only means one redundant (idempotent) refresh later."""
+        self.refreshed = True
+        if self.uri is None:
+            return
+        try:
+            atomic_json_write(self._fs, self.uri, self._payload())
+        except Exception:  # pragma: no cover - degraded durability only
+            if self._log is not None:
+                self._log.warning(
+                    "fugue_tpu stream: refresh marker write to %s failed; "
+                    "the next restart re-emits the view once",
+                    self.uri,
+                )
+
+    def clear(self) -> None:
+        """Remove the manifest (pipeline removal). Idempotent."""
+        if self.uri is None:
+            return
+        try:
+            self._fs.rm(self.uri)
+        except Exception:  # pragma: no cover - best effort
+            pass
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "uri": self.uri,
+            "batches": self.batches,
+            "rows": self.rows,
+            "files_consumed": len(self.consumed),
+            "watermark": self.watermark,
+            "refreshed": self.refreshed,
+            "restored": self.restored,
+        }

@@ -1,0 +1,93 @@
+"""Materialized views: the serving loop closed over a standing pipeline.
+
+A :class:`MaterializedView` binds a :class:`StandingPipeline`'s refresh
+to ``ServeSession.save_table`` — each emission
+
+- swaps the device-resident session table under the engine's
+  ``task_execution_lock`` (save_table's dispatch guard),
+- bumps the session's ``cache_epoch``, so the in-process serve result
+  cache (keyed on the epoch) and the fleet's content-addressed fs cache
+  (keyed on the artifact sha256s) can NEVER serve a pre-refresh payload,
+- journals the durable parquet artifact + fingerprint, so the view
+  survives a daemon restart (lazy integrity-verified reload) and fleet
+  adoption, exactly like a user-saved hot table.
+
+The daemon records the pipeline SPEC in the session's journal record;
+a restarted or adopting daemon rebuilds the view from the spec, the
+progress manifest restores the accumulator state, and a commit whose
+refresh never confirmed re-emits once.
+"""
+
+from typing import Any, Dict, Optional
+
+from fugue_tpu.stream.pipeline import PipelineSpec, StandingPipeline
+
+
+class MaterializedView:
+    """One pipeline-maintained session table."""
+
+    def __init__(self, engine: Any, session: Any, spec: PipelineSpec):
+        self._session = session
+        self.spec = spec
+        self.pipeline = StandingPipeline(engine, spec, on_refresh=self._swap)
+
+    @property
+    def session_id(self) -> str:
+        return self._session.session_id
+
+    @property
+    def name(self) -> str:
+        return self.spec.name
+
+    def _swap(self, df: Any) -> None:
+        # save_table IS the swap: dispatch-guarded catalog overwrite,
+        # cache_epoch bump, durable artifact + journal record
+        self._session.save_table(self.spec.name, df)
+
+    def step(self, force_refresh: bool = False) -> Dict[str, Any]:
+        report = self.pipeline.step(force_refresh=force_refresh)
+        report["session_id"] = self.session_id
+        report["view"] = self.spec.name
+        return report
+
+    def refresh(self) -> bool:
+        return self.pipeline.refresh()
+
+    def start(self) -> "MaterializedView":
+        self.pipeline.start()
+        return self
+
+    def stop(self) -> None:
+        self.pipeline.stop()
+
+    def remove(self, drop_table: bool = False) -> None:
+        """Unregister: stop the ticker and clear the progress manifest;
+        ``drop_table`` additionally drops the maintained session table
+        (default keeps it — the view's last snapshot stays queryable)."""
+        self.pipeline.stop()
+        self.pipeline.progress.clear()
+        if drop_table:
+            try:
+                self._session.drop_table(self.spec.name)
+            except Exception:  # pragma: no cover - best-effort cleanup
+                pass
+
+    def describe(self) -> Dict[str, Any]:
+        out = self.pipeline.describe()
+        out["session_id"] = self.session_id
+        out["view"] = self.spec.name
+        out["cache_epoch"] = self._session.cache_epoch
+        return out
+
+
+def view_progress_uri(
+    fs: Any, state_path: Optional[str], session_id: str, name: str
+) -> Optional[str]:
+    """Where a serve-registered pipeline keeps its progress manifest:
+    under the daemon's durable state path, namespaced per session —
+    shared-fs-reachable, so fleet adoption resumes the SAME manifest.
+    None for an ephemeral daemon (progress dies with the process)."""
+    base = str(state_path or "").strip()
+    if base == "":
+        return None
+    return fs.join(base, "pipelines", session_id, f"{name}.json")

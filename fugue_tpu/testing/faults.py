@@ -89,6 +89,7 @@ KNOWN_SITES = (
     "serve.route",
     "obs.trace",
     "cache.persist",
+    "stream.commit",
 )
 
 

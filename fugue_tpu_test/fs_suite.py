@@ -45,6 +45,29 @@ class FileSystemIOTests:
         def _p(self, base: str, name: str) -> str:
             return self.engine.fs.join(base, name)
 
+        # ---- metadata contract (ISSUE 15: streaming tail source) --------
+        def test_info_and_chronological_listing(self, base_uri):
+            """Any backend claiming the fs contract must answer
+            ``info()`` (size + an mtime the tail source can order by)
+            and ``list_chronological`` (files only, dot/underscore
+            temps skipped, missing dir = empty)."""
+            fs = self.engine.fs
+            assert fs.list_chronological(self._p(base_uri, "nope")) == []
+            with fs.open_output_stream(self._p(base_uri, "one.bin")) as fp:
+                fp.write(b"12345")
+            with fs.open_output_stream(self._p(base_uri, ".tmp")) as fp:
+                fp.write(b"x")
+            inf = fs.info(self._p(base_uri, "one.bin"))
+            assert inf.size == 5 and not inf.isdir
+            assert inf.mtime >= 0.0  # builtin backends stamp real time
+            assert fs.info(base_uri).isdir
+            with pytest.raises(FileNotFoundError):
+                fs.info(self._p(base_uri, "ghost.bin"))
+            listed = fs.list_chronological(base_uri)
+            assert [i.path for i in listed] == [
+                self._p(base_uri, "one.bin")
+            ]
+
         # ---- engine-level save/load matrix ------------------------------
         def test_save_load_parquet(self, base_uri):
             e = self.engine

@@ -628,6 +628,59 @@ def test_fwf504_fleet_without_shared_state_or_cache(monkeypatch):
     assert not any(x.code == "FWF504" for x in _analyze(dag))
 
 
+def test_fwf506_stream_conf_rules():
+    # streaming conf keys on a workflow with NO streaming source are
+    # silently inert; a standing pipeline (source set) without resume
+    # loses exactly-once restart — both halves of the ISSUE 15 rule
+    dag = FugueWorkflow()
+    dag.df([[0]], "a:int").persist()
+    # inert keys: no source
+    diags = _analyze(
+        dag,
+        conf={
+            "fugue.stream.interval": 0.5,
+            "fugue.stream.watermark.delay": 5.0,
+        },
+        codes={"FWF506"},
+    )
+    assert len(diags) == 2  # one per inert key
+    d = _assert_diag(diags, "FWF506", Severity.WARN, needs_callsite=False)
+    assert "fugue.stream.source" in d.message
+    # source set, resume off -> the standing-pipeline half warns
+    diags = _analyze(
+        dag,
+        conf={"fugue.stream.source": "/tmp/in"},
+        codes={"FWF506"},
+    )
+    assert len(diags) == 1
+    assert "fugue.workflow.resume" in diags[0].message
+    # string conf values are legitimate: "false" must still warn
+    assert any(
+        x.code == "FWF506"
+        for x in _analyze(
+            dag,
+            conf={
+                "fugue.stream.source": "/tmp/in",
+                "fugue.workflow.resume": "false",
+            },
+        )
+    )
+    # source + resume -> a well-configured standing pipeline: silent
+    assert not any(
+        x.code == "FWF506"
+        for x in _analyze(
+            dag,
+            conf={
+                "fugue.stream.source": "/tmp/in",
+                "fugue.stream.interval": 0.5,
+                "fugue.workflow.resume": True,
+            },
+        )
+    )
+    # no stream keys at all: silent
+    assert not any(x.code == "FWF506" for x in _analyze(dag))
+
+
 def test_every_rule_has_corpus_coverage():
     """The corpus above must track the registry: a newly registered rule
     without a fixture here fails this meta-check."""
@@ -635,7 +688,7 @@ def test_every_rule_has_corpus_coverage():
         "FWF101", "FWF102", "FWF103", "FWF104", "FWF105", "FWF106",
         "FWF201", "FWF202", "FWF301", "FWF302", "FWF303", "FWF401",
         "FWF402", "FWF403", "FWF404", "FWF501", "FWF502", "FWF503",
-        "FWF504", "FWF505",
+        "FWF504", "FWF505", "FWF506",
     }
     assert {r.code for r in all_rules()} == covered
 

@@ -1,11 +1,15 @@
 """Unit tests for the virtual filesystem layer: URI helpers, memory
-backend semantics, glob, save modes, atomic overwrite."""
+backend semantics, glob, save modes, atomic overwrite, and the
+info()/mtime contract every backend must honor (ISSUE 15: the
+streaming tail source's discovery order)."""
 
 import os
+import time
 
 import pytest
 
 from fugue_tpu.fs import (
+    FileInfo,
     FileSystemRegistry,
     join_uri,
     make_default_registry,
@@ -246,3 +250,98 @@ def test_engine_fs_contract():
     assert e.fs.exists("memory://") is True or isinstance(
         e.fs, FileSystemRegistry
     )
+
+
+# ---------------------------------------------------------------------------
+# info() / mtime contract (ISSUE 15: the streaming tail source's order)
+# ---------------------------------------------------------------------------
+def test_info_local(tmp_path):
+    fs = make_default_registry()
+    p = str(tmp_path / "a.bin")
+    with fs.open_output_stream(p) as fp:
+        fp.write(b"abc")
+    inf = fs.info(p)
+    assert isinstance(inf, FileInfo)
+    assert inf.size == 3 and not inf.isdir
+    assert abs(inf.mtime - time.time()) < 60
+    d = fs.info(str(tmp_path))
+    assert d.isdir and d.mtime > 0
+    with pytest.raises(FileNotFoundError):
+        fs.info(str(tmp_path / "nope.bin"))
+
+
+def test_info_memory():
+    fs = make_default_registry()
+    base = "memory://unit/info"
+    with fs.open_output_stream(f"{base}/x.bin") as fp:
+        fp.write(b"12345")
+    inf = fs.info(f"{base}/x.bin")
+    assert inf.size == 5 and not inf.isdir
+    assert abs(inf.mtime - time.time()) < 60  # memory:// HAS an mtime now
+    assert inf.path == f"{base}/x.bin"  # registry restores the full URI
+    assert fs.info(base).isdir
+    with pytest.raises(FileNotFoundError):
+        fs.info(f"{base}/ghost.bin")
+
+
+def test_info_memory_atomic_write_stamps_commit_time():
+    # atomic temp+rename must carry the COMMIT time (os.replace
+    # semantics), not zero — the tail source orders by it
+    fs = make_default_registry()
+    uri = "memory://unit/info_atomic/y.bin"
+    t0 = time.time()
+    fs.write_file_atomic(uri, lambda fp: fp.write(b"z"))
+    inf = fs.info(uri)
+    assert inf.mtime >= t0 - 1
+
+
+def test_info_fsspec(tmp_path):
+    # the fsspec adapter (here: its local backend through a file:// URI
+    # routed via FsspecFileSystem directly) honors the same contract
+    fsspec = pytest.importorskip("fsspec")  # noqa: F841
+    from fugue_tpu.fs.fsspec_fs import FsspecFileSystem
+
+    backend = FsspecFileSystem("file")
+    p = str(tmp_path / "z.bin")
+    with open(p, "wb") as fp:
+        fp.write(b"zz")
+    inf = backend.info(p)
+    assert inf.size == 2 and not inf.isdir and inf.mtime > 0
+    assert backend.info(str(tmp_path)).isdir
+
+
+def test_list_chronological_mtime_then_name(tmp_path):
+    fs = make_default_registry()
+    # land files OUT of name order with increasing mtimes
+    for i, name in enumerate(["c.parquet", "a.parquet", "b.parquet"]):
+        p = str(tmp_path / name)
+        with fs.open_output_stream(p) as fp:
+            fp.write(b".")
+        os.utime(p, (1_000_000 + i, 1_000_000 + i))
+    got = [
+        os.path.basename(i.path)
+        for i in fs.list_chronological(str(tmp_path), "*.parquet")
+    ]
+    assert got == ["c.parquet", "a.parquet", "b.parquet"]
+    # equal mtimes tie-break by name (deterministic listing)
+    for name in ["c.parquet", "a.parquet", "b.parquet"]:
+        os.utime(str(tmp_path / name), (2_000_000, 2_000_000))
+    got = [
+        os.path.basename(i.path)
+        for i in fs.list_chronological(str(tmp_path), "*.parquet")
+    ]
+    assert got == ["a.parquet", "b.parquet", "c.parquet"]
+
+
+def test_list_chronological_skips_temps_dirs_and_missing():
+    fs = make_default_registry()
+    base = "memory://unit/chron"
+    for name in ("one.parquet", ".tmp-x", "_marker", "other.csv"):
+        with fs.open_output_stream(f"{base}/{name}") as fp:
+            fp.write(b".")
+    fs.makedirs(f"{base}/subdir")
+    got = fs.list_chronological(base, "*.parquet")
+    assert [i.path for i in got] == [f"{base}/one.parquet"]
+    # a missing directory is an EMPTY listing, not an error (a tail
+    # source may start before its first file arrives)
+    assert fs.list_chronological("memory://unit/chron_missing") == []

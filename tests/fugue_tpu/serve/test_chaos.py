@@ -121,6 +121,7 @@ def test_seeded_kill_restart_recovers_4_tenant_workload(tmp_path):
         # failed over instead
         assert st["recovery"] == {
             "sessions": 4,
+            "pipelines": 0,
             "jobs_resubmitted": 4,
             "jobs_failed_over": 1,
         }

@@ -1,0 +1,233 @@
+"""Materialized views over standing pipelines in the serving daemon
+(ISSUE 15): the HTTP pipeline API, result-cache invalidation on view
+refresh, restart survival (journal rehydration + exactly-once resume)
+and fleet adoption."""
+
+import os
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from fugue_tpu.constants import (
+    FUGUE_CONF_SERVE_BREAKER_THRESHOLD,
+    FUGUE_CONF_SERVE_STATE_PATH,
+)
+from fugue_tpu.serve import ServeAPIError, ServeClient, ServeDaemon
+
+pytestmark = [pytest.mark.serve, pytest.mark.stream]
+
+_Q = "SELECT k, s, c FROM sess ORDER BY k LIMIT 100"
+
+
+def _land(src: str, name: str, pdf: pd.DataFrame) -> None:
+    os.makedirs(src, exist_ok=True)
+    tmp = os.path.join(src, f".{name}.tmp")
+    pq.write_table(pa.Table.from_pandas(pdf, preserve_index=False), tmp)
+    os.replace(tmp, os.path.join(src, name))
+
+
+def _pdf(seed: int, rows: int = 300):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame(
+        {"k": rng.integers(0, 8, rows).astype(np.int64),
+         "v": rng.random(rows)}
+    )
+
+
+def _oracle(frames):
+    return (
+        pd.concat(frames).groupby("k")["v"]
+        .agg(["sum", "count"]).reset_index()
+    )
+
+
+def _assert_rows(rows, frames):
+    got = pd.DataFrame(rows, columns=["k", "s", "c"])
+    exp = _oracle(frames)
+    assert (got["k"].to_numpy() == exp["k"].to_numpy()).all()
+    assert np.allclose(got["s"].to_numpy(), exp["sum"].to_numpy())
+    assert (got["c"].to_numpy() == exp["count"].to_numpy()).all()
+
+
+def _spec(src):
+    return {
+        "name": "sess",
+        "source": src,
+        "keys": ["k"],
+        "aggs": [["s", "sum", "v"], ["c", "count", "v"]],
+    }
+
+
+def test_view_refresh_invalidates_cached_result(tmp_path):
+    src = str(tmp_path / "in")
+    conf = {FUGUE_CONF_SERVE_STATE_PATH: str(tmp_path / "state")}
+    with ServeDaemon(conf) as d:
+        c = ServeClient(*d.address)
+        sid = c.create_session()
+        frames = [_pdf(0)]
+        _land(src, "f0.parquet", frames[0])
+        out = c.register_pipeline(sid, _spec(src))
+        assert out["report"]["files"] == 1
+        assert out["report"]["refreshed"] is True
+        # the view is immediately queryable
+        r1 = c.sql(sid, _Q)
+        _assert_rows(r1["result"]["rows"], frames)
+        # identical resubmission answers from the result cache
+        r2 = c.sql(sid, _Q)
+        assert r2["result"]["rows"] == r1["result"]["rows"]
+        hits = d.status()["plan_cache"]["serve_result"]["hit"]
+        assert hits >= 1
+        # new file + step -> save_table bumps cache_epoch -> the STALE
+        # payload is never served again (the acceptance criterion)
+        frames.append(_pdf(1))
+        _land(src, "f1.parquet", frames[1])
+        rep = c.step_pipeline(sid, "sess")
+        assert rep["files"] == 1 and rep["refreshed"] is True
+        r3 = c.sql(sid, _Q)
+        assert r3["result"]["rows"] != r1["result"]["rows"]
+        _assert_rows(r3["result"]["rows"], frames)
+        # pipeline introspection over HTTP
+        lst = c.pipelines(sid)
+        assert [p["name"] for p in lst] == ["sess"]
+        one = c.pipeline(sid, "sess")
+        assert one["aggregator"]["rows"] == 600
+        assert one["progress"]["batches"] == 2
+
+
+def test_view_survives_daemon_restart_and_steps_exactly_once(tmp_path):
+    src = str(tmp_path / "in")
+    conf = {
+        FUGUE_CONF_SERVE_STATE_PATH: str(tmp_path / "state"),
+        FUGUE_CONF_SERVE_BREAKER_THRESHOLD: 0,
+    }
+    d1 = ServeDaemon(conf).start()
+    c1 = ServeClient(*d1.address)
+    sid = c1.create_session()
+    frames = [_pdf(0)]
+    _land(src, "f0.parquet", frames[0])
+    c1.register_pipeline(sid, _spec(src))
+    d1._hard_kill()
+
+    d2 = ServeDaemon(conf).start()
+    try:
+        c2 = ServeClient(*d2.address)
+        st = c2.status()
+        assert st["recovery"]["sessions"] == 1
+        assert st["recovery"]["pipelines"] == 1
+        # the view table itself rehydrates from the journaled artifact
+        r = c2.sql(sid, _Q)
+        _assert_rows(r["result"]["rows"], frames)
+        # stepping continues from the progress manifest: the consumed
+        # file does NOT refold (exactly-once), the new one does
+        frames.append(_pdf(1))
+        _land(src, "f1.parquet", frames[1])
+        rep = c2.step_pipeline(sid, "sess")
+        assert rep["files"] == 1 and rep["batches"] == 2
+        r2 = c2.sql(sid, _Q)
+        _assert_rows(r2["result"]["rows"], frames)
+    finally:
+        d2.stop()
+
+
+def test_view_moves_with_fleet_adoption(tmp_path):
+    src = str(tmp_path / "in")
+    state_a = str(tmp_path / "state_a")
+    state_b = str(tmp_path / "state_b")
+    d1 = ServeDaemon({FUGUE_CONF_SERVE_STATE_PATH: state_a}).start()
+    c1 = ServeClient(*d1.address)
+    sid = c1.create_session()
+    frames = [_pdf(0)]
+    _land(src, "f0.parquet", frames[0])
+    c1.register_pipeline(sid, _spec(src))
+    d1._hard_kill()  # replica death
+
+    d2 = ServeDaemon({FUGUE_CONF_SERVE_STATE_PATH: state_b}).start()
+    try:
+        adopted = d2.adopt_state(state_a)
+        assert adopted["sessions"] == [sid]
+        assert adopted["pipelines"] == 1
+        c2 = ServeClient(*d2.address)
+        r = c2.sql(sid, _Q)
+        _assert_rows(r["result"]["rows"], frames)
+        # the adopted pipeline keeps consuming — its progress manifest
+        # (origin state dir, shared fs) resumes exactly-once
+        frames.append(_pdf(1))
+        _land(src, "f1.parquet", frames[1])
+        rep = c2.step_pipeline(sid, "sess")
+        assert rep["files"] == 1 and rep["batches"] == 2
+        r2 = c2.sql(sid, _Q)
+        _assert_rows(r2["result"]["rows"], frames)
+    finally:
+        d2.stop()
+
+
+def test_pipeline_lifecycle_and_errors(tmp_path):
+    src = str(tmp_path / "in")
+    conf = {FUGUE_CONF_SERVE_STATE_PATH: str(tmp_path / "state")}
+    with ServeDaemon(conf) as d:
+        c = ServeClient(*d.address)
+        sid = c.create_session()
+        _land(src, "f0.parquet", _pdf(0))
+        c.register_pipeline(sid, _spec(src))
+        # duplicate registration is a 400
+        with pytest.raises(ServeAPIError) as ex:
+            c.register_pipeline(sid, _spec(src))
+        assert ex.value.status == 400
+        # a malformed spec (missing name/source) is a 400, never a 404
+        with pytest.raises(ServeAPIError) as ex:
+            c.register_pipeline(sid, {"keys": ["k"]})
+        assert ex.value.status == 400
+        # unknown pipeline is a 404
+        with pytest.raises(ServeAPIError) as ex:
+            c.step_pipeline(sid, "ghost")
+        assert ex.value.status == 404
+        # removal keeps the last view snapshot queryable by default
+        prog_uri = c.pipeline(sid, "sess")["progress"]["uri"]
+        assert d.engine.fs.exists(prog_uri)
+        c.remove_pipeline(sid, "sess")
+        assert c.pipelines(sid) == []
+        assert not d.engine.fs.exists(prog_uri)  # manifest cleared
+        r = c.sql(sid, _Q)
+        assert len(r["result"]["rows"]) > 0  # table still there
+        # a failing INITIAL step does not poison the registration: the
+        # error rides the response, the pipeline stays registered and a
+        # later step (fixed source) folds cleanly
+        bad_src = str(tmp_path / "bad")
+        os.makedirs(bad_src)
+        with open(os.path.join(bad_src, "junk.parquet"), "wb") as fp:
+            fp.write(b"not parquet")
+        out = c.register_pipeline(sid, dict(_spec(bad_src), name="degr"))
+        assert "error" in out["report"]
+        assert "degr" in [p["name"] for p in c.pipelines(sid)]
+        # closing the session takes a registered view down with it
+        c.register_pipeline(sid, dict(_spec(src), name="other"))
+        c.close_session(sid)
+        with d._views_lock:
+            assert d._views == {}
+
+
+def test_ticker_runs_under_daemon(tmp_path):
+    src = str(tmp_path / "in")
+    conf = {FUGUE_CONF_SERVE_STATE_PATH: str(tmp_path / "state")}
+    with ServeDaemon(conf) as d:
+        c = ServeClient(*d.address)
+        sid = c.create_session()
+        spec = dict(_spec(src), interval=0.05)
+        c.register_pipeline(sid, spec, step=False)
+        frames = [_pdf(0)]
+        _land(src, "f0.parquet", frames[0])
+        deadline = time.monotonic() + 10
+        rows = None
+        while time.monotonic() < deadline:
+            snap = c.pipeline(sid, "sess")
+            if snap["progress"]["batches"] >= 1:
+                rows = c.sql(sid, _Q)["result"]["rows"]
+                break
+            time.sleep(0.05)
+        assert rows is not None, "ticker never folded the landed file"
+        _assert_rows(rows, frames)
+    # daemon exit joined the ticker (no lingering thread errors)

@@ -1,0 +1,430 @@
+"""Standing-pipeline driver: micro-batch folding with device state
+carried across batches, exactly-once restart from the progress manifest
+(including a hard kill between fold and commit), watermark-gated
+event-time windows, and parity with the equivalent one-shot batch run
+over the same file union — the acceptance contract of ISSUE 15."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from fugue_tpu.jax_backend import JaxExecutionEngine
+from fugue_tpu.stream import PipelineSpec, StandingPipeline
+from fugue_tpu.testing.faults import FaultPlan, FaultSpec, inject_faults
+
+pytestmark = pytest.mark.stream
+
+
+def make_engine() -> JaxExecutionEngine:
+    return JaxExecutionEngine(dict(test=True))
+
+
+def _land(src: str, name: str, pdf: pd.DataFrame) -> None:
+    os.makedirs(src, exist_ok=True)
+    tmp = os.path.join(src, f".{name}.tmp")
+    pq.write_table(pa.Table.from_pandas(pdf, preserve_index=False), tmp)
+    os.replace(tmp, os.path.join(src, name))
+
+
+def _sessions_pdf(seed: int, rows: int = 400, nkeys: int = 12):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame(
+        {"k": rng.integers(0, nkeys, rows).astype(np.int64),
+         "v": rng.random(rows)}
+    )
+
+
+def _batch_oracle(frames) -> pd.DataFrame:
+    return (
+        pd.concat(frames).groupby("k")["v"]
+        .agg(["sum", "count"]).reset_index()
+    )
+
+
+def _assert_parity(view: pd.DataFrame, oracle: pd.DataFrame) -> None:
+    got = view.sort_values("k").reset_index(drop=True)
+    assert np.allclose(got["s"].to_numpy(), oracle["sum"].to_numpy())
+    assert (got["c"].to_numpy() == oracle["count"].to_numpy()).all()
+    assert (got["k"].to_numpy() == oracle["k"].to_numpy()).all()
+
+
+def _spec(tmp_path, **kw) -> PipelineSpec:
+    defaults = dict(
+        name="sess",
+        source=str(tmp_path / "in"),
+        keys=["k"],
+        aggs=[("s", "sum", "v"), ("c", "count", "v")],
+        progress=str(tmp_path / "progress.json"),
+    )
+    defaults.update(kw)
+    return PipelineSpec(**defaults)
+
+
+def test_pipeline_parity_and_zero_recompiles_across_batches(tmp_path):
+    e = make_engine()
+    emitted = []
+    p = StandingPipeline(
+        e, _spec(tmp_path),
+        on_refresh=lambda df: emitted.append(df.as_pandas()),
+    )
+    frames = []
+    for i in range(4):  # >= 3 micro-batches, state carried on device
+        frames.append(_sessions_pdf(i))
+        _land(str(tmp_path / "in"), f"f{i}.parquet", frames[-1])
+        rep = p.step()
+        assert rep["files"] == 1 and rep["rows"] == 400
+        assert rep["refreshed"] is True
+        _assert_parity(emitted[-1], _batch_oracle(frames))
+    st = p.stats()["aggregator"]
+    # the acceptance counter: ONE trace total — zero recompiles after
+    # the first micro-batch (padded key space + shared row bucket hold)
+    assert st["traces"] == 1, st
+    assert st["chunks"] == 4 and st["rows"] == 1600
+    # idle tick: no files, no fold, no emission
+    rep = p.step()
+    assert rep["files"] == 0 and rep["refreshed"] is False
+    # several files in one poll -> ONE micro-batch, one commit
+    frames.append(_sessions_pdf(10))
+    frames.append(_sessions_pdf(11))
+    _land(str(tmp_path / "in"), "g0.parquet", frames[-2])
+    _land(str(tmp_path / "in"), "g1.parquet", frames[-1])
+    rep = p.step()
+    assert rep["files"] == 2 and rep["rows"] == 800
+    _assert_parity(emitted[-1], _batch_oracle(frames))
+    assert p.progress.batches == 5
+
+
+def test_pipeline_restart_resumes_without_refold(tmp_path):
+    e = make_engine()
+    spec = _spec(tmp_path)
+    emitted = []
+    p = StandingPipeline(
+        e, spec, on_refresh=lambda df: emitted.append(df.as_pandas())
+    )
+    frames = [_sessions_pdf(0)]
+    _land(str(tmp_path / "in"), "f0.parquet", frames[0])
+    p.step()
+    # "process death": a NEW pipeline object over the same spec —
+    # the progress manifest restores consumed set + accumulator state
+    p2 = StandingPipeline(
+        e, spec, on_refresh=lambda df: emitted.append(df.as_pandas())
+    )
+    assert p2.progress.restored
+    rep = p2.step()
+    assert rep["files"] == 0  # nothing refolds: f0 is in the ledger
+    frames.append(_sessions_pdf(1))
+    _land(str(tmp_path / "in"), "f1.parquet", frames[1])
+    rep = p2.step()
+    assert rep["files"] == 1 and rep["batches"] == 2
+    _assert_parity(emitted[-1], _batch_oracle(frames))
+
+
+def test_hard_kill_before_commit_is_exactly_once(tmp_path):
+    # THE chaos contract: a driver killed mid-micro-batch (fold done,
+    # commit never landed) restarts from the previous committed state,
+    # re-discovers the file and refolds it — aggregate parity with the
+    # one-shot batch run, no double count, no loss.
+    e = make_engine()
+    spec = _spec(tmp_path)
+    emitted = []
+    p = StandingPipeline(
+        e, spec, on_refresh=lambda df: emitted.append(df.as_pandas())
+    )
+    frames = [_sessions_pdf(0)]
+    _land(str(tmp_path / "in"), "f0.parquet", frames[0])
+    p.step()  # batch 1 committed
+    # batch 2 dies AT the commit point (after the device fold)
+    frames.append(_sessions_pdf(1))
+    _land(str(tmp_path / "in"), "f1.parquet", frames[1])
+    plan = FaultPlan(
+        FaultSpec("stream.commit", match="*", times=1,
+                  error=OSError("kill -9 between fold and commit"))
+    )
+    with inject_faults(plan):
+        with pytest.raises(OSError):
+            p.step()
+    assert plan.total("injected") == 1
+    # the manifest still holds batch 1 only
+    assert p.progress.batches == 1
+    # restart: fresh object, restored state; f1 refolds exactly once
+    p3 = StandingPipeline(
+        e, spec, on_refresh=lambda df: emitted.append(df.as_pandas())
+    )
+    rep = p3.step()
+    assert rep["files"] == 1 and rep["batches"] == 2
+    _assert_parity(emitted[-1], _batch_oracle(frames))
+    # and the emitted view equals the engine's own one-shot batch
+    # aggregate over the full file union (the FugueWorkflow oracle)
+    from fugue_tpu.collections.partition import PartitionSpec
+    from fugue_tpu.column import col
+    from fugue_tpu.column import functions as ff
+
+    full = e.to_df(
+        pd.concat(frames, ignore_index=True), "k:long,v:double"
+    )
+    oracle = e.aggregate(
+        full, PartitionSpec(by=["k"]),
+        [ff.sum(col("v")).alias("s"), ff.count(col("v")).alias("c")],
+    ).as_pandas().sort_values("k").reset_index(drop=True)
+    got = emitted[-1].sort_values("k").reset_index(drop=True)
+    assert np.allclose(got["s"], oracle["s"])
+    assert (got["c"].to_numpy() == oracle["c"].to_numpy()).all()
+
+
+def test_failed_step_rolls_back_device_state_no_double_count(tmp_path):
+    # an IN-PROCESS retry after a failed step (commit died) must not
+    # double-count the rows the aborted fold already pushed on device:
+    # the pipeline rolls back to the last committed snapshot and the
+    # retry refolds cleanly — same-object twin of the restart path
+    e = make_engine()
+    spec = _spec(tmp_path)
+    emitted = []
+    p = StandingPipeline(
+        e, spec, on_refresh=lambda df: emitted.append(df.as_pandas())
+    )
+    frames = [_sessions_pdf(0)]
+    _land(str(tmp_path / "in"), "f0.parquet", frames[0])
+    p.step()
+    frames.append(_sessions_pdf(1))
+    _land(str(tmp_path / "in"), "f1.parquet", frames[1])
+    plan = FaultPlan(
+        FaultSpec("stream.commit", match="*", times=1, error=OSError)
+    )
+    with inject_faults(plan):
+        with pytest.raises(OSError):
+            p.step()
+    # retry on the SAME pipeline object (what the ticker does)
+    rep = p.step()
+    assert rep["files"] == 1 and rep["batches"] == 2
+    _assert_parity(emitted[-1], _batch_oracle(frames))
+    # ephemeral pipelines (no manifest) roll back the same way
+    spec_e = _spec(tmp_path, name="eph", progress=None,
+                   source=str(tmp_path / "in2"))
+    emitted2 = []
+    p2 = StandingPipeline(
+        e, spec_e, on_refresh=lambda df: emitted2.append(df.as_pandas())
+    )
+    f = [_sessions_pdf(5)]
+    _land(str(tmp_path / "in2"), "a.parquet", f[0])
+    p2.step()
+    f.append(_sessions_pdf(6))
+    _land(str(tmp_path / "in2"), "b.parquet", f[1])
+    # fold dies mid-batch: second file is unreadable garbage
+    bad = str(tmp_path / "in2" / "c.parquet")
+    with open(bad, "wb") as fp:
+        fp.write(b"not parquet at all")
+    with pytest.raises(Exception):
+        p2.step()
+    os.remove(bad)
+    rep = p2.step()
+    assert rep["files"] == 1
+    _assert_parity(emitted2[-1], _batch_oracle(f))
+
+
+def test_kill_between_commit_and_refresh_reemits_once(tmp_path):
+    e = make_engine()
+    spec = _spec(tmp_path)
+    emitted = []
+    boom = [False]
+
+    def swap(df):
+        if boom[0]:
+            raise RuntimeError("killed during view swap")
+        emitted.append(df.as_pandas())
+
+    p = StandingPipeline(e, spec, on_refresh=swap)
+    frames = [_sessions_pdf(0)]
+    _land(str(tmp_path / "in"), "f0.parquet", frames[0])
+    boom[0] = True
+    with pytest.raises(RuntimeError):
+        p.step()
+    # committed but never published
+    assert p.progress.batches == 1 and not p.progress.refreshed
+    p2 = StandingPipeline(e, spec, on_refresh=swap)
+    boom[0] = False
+    rep = p2.step()  # no new files, but the pending refresh re-emits
+    assert rep["files"] == 0 and rep["refreshed"] is True
+    _assert_parity(emitted[-1], _batch_oracle(frames))
+
+
+def test_windowed_pipeline_watermark_emission(tmp_path):
+    e = make_engine()
+    emitted = []
+    spec = _spec(
+        tmp_path,
+        name="win",
+        window={"column": "ts", "size": 10, "delay": 5},
+        progress=str(tmp_path / "wprog.json"),
+    )
+    p = StandingPipeline(
+        e, spec, on_refresh=lambda df: emitted.append(df.as_pandas())
+    )
+    rng = np.random.default_rng(2)
+
+    def events(seed, tmax, rows=200):
+        r = np.random.default_rng(seed)
+        return pd.DataFrame(
+            {"k": r.integers(0, 3, rows).astype(np.int64),
+             "v": r.random(rows),
+             "ts": r.integers(0, tmax, rows).astype(np.int64)}
+        )
+
+    f0 = events(0, 35)
+    _land(str(tmp_path / "in"), "e0.parquet", f0)
+    rep = p.step()
+    # watermark = max ts - 5; only windows ENTIRELY below it emit
+    wm = p.watermark
+    assert wm == float(f0["ts"].max() - 5)
+    view = emitted[-1]
+    assert set(view.columns) == {"window_start", "k", "s", "c"}
+    assert ((view["window_start"] + 10) <= wm).all()
+    # oracle over closed windows only
+    o = f0.copy()
+    o["window_start"] = (o["ts"] // 10) * 10
+    o = o[o["window_start"] + 10 <= wm]
+    exp = (
+        o.groupby(["window_start", "k"])["v"].agg(["sum", "count"])
+        .reset_index()
+    )
+    got = view.sort_values(["window_start", "k"]).reset_index(drop=True)
+    assert np.allclose(got["s"], exp["sum"])
+    assert (got["c"].to_numpy() == exp["count"].to_numpy()).all()
+    # a later file advances the watermark and emits MORE windows; late
+    # rows within the allowance still land in their original windows
+    f1 = events(1, 60)
+    _land(str(tmp_path / "in"), "e1.parquet", f1)
+    p.step()
+    wm2 = p.watermark
+    assert wm2 > wm
+    both = pd.concat([f0, f1])
+    both["window_start"] = (both["ts"] // 10) * 10
+    closed = both[both["window_start"] + 10 <= wm2]
+    exp2 = (
+        closed.groupby(["window_start", "k"])["v"].agg(["sum", "count"])
+        .reset_index()
+    )
+    got2 = (
+        emitted[-1].sort_values(["window_start", "k"])
+        .reset_index(drop=True)
+    )
+    assert np.allclose(got2["s"], exp2["sum"])
+    assert (got2["c"].to_numpy() == exp2["count"].to_numpy()).all()
+    # null event-time rows drop (counted), they poison no window
+    f2 = events(3, 40).astype({"ts": "float64"})
+    f2.loc[f2.index[:7], "ts"] = np.nan
+    _land(str(tmp_path / "in"), "e2.parquet", f2)
+    p.step()
+    assert p.stats()["dropped_null_event_rows"] == 7
+
+
+def test_window_retention_bounds_state(tmp_path):
+    # a STANDING windowed pipeline must not grow window-id state with
+    # wall time: retention evicts windows behind the horizon, and the
+    # view covers only the retained range afterwards
+    e = make_engine()
+    emitted = []
+    spec = _spec(
+        tmp_path,
+        name="ret",
+        window={"column": "ts", "size": 10, "delay": 0, "retention": 3},
+        progress=str(tmp_path / "rprog.json"),
+    )
+    p = StandingPipeline(
+        e, spec, on_refresh=lambda df: emitted.append(df.as_pandas())
+    )
+    for i, base_ts in enumerate([0, 200, 400]):
+        pdf = pd.DataFrame(
+            {"k": np.zeros(50, dtype=np.int64),
+             "v": np.ones(50),
+             "ts": (base_ts + np.arange(50) % 40).astype(np.int64)}
+        )
+        _land(str(tmp_path / "in"), f"r{i}.parquet", pdf)
+        p.step()
+    bounds = p.stats()["aggregator"] and p._agg.key_bounds
+    lo, hi = bounds[0]
+    # watermark ~ 439; cutoff id = 43 - 3 = 40: old epochs evicted
+    assert lo >= 40, bounds
+    view = emitted[-1]
+    assert (view["window_start"] >= lo * 10).all()
+    # restart restores the EVICTED (bounded) state
+    p2 = StandingPipeline(e, spec)
+    assert p2._agg.key_bounds[0][0] == lo
+
+
+def test_discover_propagates_non_missing_errors(tmp_path):
+    # a misconfigured/unreachable source must look BROKEN, not idle
+    from fugue_tpu.fs import make_default_registry
+    from fugue_tpu.stream.source import ParquetTailSource
+
+    fs = make_default_registry()
+    # missing dir: empty (tail may start before the first file)
+    assert ParquetTailSource(fs, str(tmp_path / "nope")).discover({}) == []
+    # a FILE where the source dir should be: NotADirectoryError-class
+    p = str(tmp_path / "afile")
+    with open(p, "wb") as fp:
+        fp.write(b"x")
+    with pytest.raises(Exception):
+        ParquetTailSource(fs, p).discover({})
+
+
+def test_ticker_thread_steps_and_stops(tmp_path):
+    e = make_engine()
+    emitted = []
+    spec = _spec(tmp_path, interval=0.05)
+    p = StandingPipeline(
+        e, spec, on_refresh=lambda df: emitted.append(df.as_pandas())
+    )
+    frames = [_sessions_pdf(0)]
+    _land(str(tmp_path / "in"), "f0.parquet", frames[0])
+    import time as _time
+
+    p.start()
+    try:
+        deadline = _time.monotonic() + 10
+        while not emitted and _time.monotonic() < deadline:
+            _time.sleep(0.02)
+        assert emitted, "ticker never folded the landed file"
+    finally:
+        p.stop()
+    assert p._thread is None  # joined
+    _assert_parity(emitted[-1], _batch_oracle(frames))
+
+
+def test_spec_roundtrip_and_from_conf(tmp_path):
+    spec = _spec(
+        tmp_path, window={"column": "ts", "size": 10}, interval=2.5
+    )
+    again = PipelineSpec.from_dict(spec.to_dict())
+    assert again.to_dict() == spec.to_dict()
+    assert again.uuid == spec.uuid
+    # conf-driven construction: fugue.stream.* keys + resume-derived
+    # progress manifest under the checkpoint path
+    conf = {
+        "fugue.stream.source": str(tmp_path / "in"),
+        "fugue.stream.interval": 3.0,
+        "fugue.stream.watermark.delay": 7.0,
+        "fugue.workflow.resume": True,
+        "fugue.workflow.checkpoint.path": str(tmp_path / "ckpt"),
+    }
+    s = PipelineSpec.from_conf(
+        conf, "fromconf", ["k"], [("s", "sum", "v")],
+        window={"column": "ts", "size": 10},
+    )
+    assert s.source == str(tmp_path / "in")
+    assert s.interval == 3.0
+    assert s.window["delay"] == 7.0
+    assert s.progress and "stream_progress_fromconf.json" in s.progress
+    # resume off -> EPHEMERAL (no progress manifest): FWF506's subject
+    s2 = PipelineSpec.from_conf(
+        dict(conf, **{"fugue.workflow.resume": False}),
+        "fromconf", ["k"], [("s", "sum", "v")],
+    )
+    assert s2.progress is None
+    with pytest.raises(ValueError):
+        PipelineSpec("bad name!", str(tmp_path), ["k"], [("s", "sum", "v")])
+    with pytest.raises(ValueError):
+        PipelineSpec("p", str(tmp_path), [], [("s", "sum", "v")])

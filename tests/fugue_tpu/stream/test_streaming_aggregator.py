@@ -1,0 +1,244 @@
+"""Cross-micro-batch device state: the StreamingAggregator contract the
+standing pipeline builds on — parity with the one-shot batch result,
+zero recompiles once the padded key space and row bucket hold, stable
+pytree under nulls, exact snapshot/restore."""
+
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fugue_tpu.jax_backend import JaxExecutionEngine
+from fugue_tpu.jax_backend.streaming import (
+    StreamingAggregator,
+    StreamUnsupported,
+)
+from fugue_tpu.schema import Schema
+
+pytestmark = pytest.mark.stream
+
+
+def make_engine() -> JaxExecutionEngine:
+    return JaxExecutionEngine(dict(test=True))
+
+
+def test_state_carried_across_batches_matches_batch_run():
+    e = make_engine()
+    agg = StreamingAggregator(
+        e, Schema("k:long,v:double"), ["k"],
+        [("s", "sum", "v"), ("m", "avg", "v"), ("c", "count", "v"),
+         ("lo", "min", "v"), ("hi", "max", "v")],
+        pad_spans=True,
+    )
+    rng = np.random.default_rng(11)
+    batches = []
+    for _ in range(4):
+        pdf = pd.DataFrame(
+            {"k": rng.integers(0, 16, 400).astype(np.int64),
+             "v": rng.random(400)}
+        )
+        batches.append(pdf)
+        agg.fold(pdf)
+    got = (
+        agg.finalize().as_pandas().sort_values("k").reset_index(drop=True)
+    )
+    exp = (
+        pd.concat(batches).groupby("k")["v"]
+        .agg(["sum", "mean", "count", "min", "max"]).reset_index()
+    )
+    assert np.allclose(got["s"], exp["sum"])
+    assert np.allclose(got["m"], exp["mean"])
+    assert (got["c"].to_numpy() == exp["count"].to_numpy()).all()
+    assert np.allclose(got["lo"], exp["min"])
+    assert np.allclose(got["hi"], exp["max"])
+
+
+def test_zero_recompiles_after_first_batch_with_padding():
+    # the ISSUE 15 counter contract: key-DICTIONARY growth within the
+    # padded pow2 span + ragged chunk sizes within one row bucket must
+    # re-trace NOTHING after the first fold
+    e = make_engine()
+    agg = StreamingAggregator(
+        e, Schema("k:long,v:double"), ["k"], [("s", "sum", "v")],
+        pad_spans=True,
+    )
+    rng = np.random.default_rng(5)
+    for i, rows in enumerate([300, 280, 410, 333, 502]):
+        nkeys = 10 + 2 * i  # 10 -> 18 keys: grows INSIDE the pad of 16?
+        # spans pad to pow2 anchored at lo: 10 keys pad to 16; cap the
+        # key draw at 16 so growth stays inside the padded space
+        pdf = pd.DataFrame(
+            {"k": rng.integers(0, min(nkeys, 16), rows).astype(np.int64),
+             "v": rng.random(rows)}
+        )
+        agg.fold(pdf)
+    st = agg.stats()
+    assert st["traces"] == 1, st
+    assert st["rebases"] == 0, st
+    # growth BEYOND the padded span rebases exactly once and re-traces
+    agg.fold(
+        pd.DataFrame({"k": np.arange(20, dtype=np.int64),
+                      "v": np.ones(20)})
+    )
+    st = agg.stats()
+    assert st["rebases"] == 1 and st["traces"] == 2, st
+
+
+def test_empty_batch_is_a_noop_and_all_null_batch_reuses_program():
+    e = make_engine()
+    agg = StreamingAggregator(
+        e, Schema("k:long,v:double"), ["k"],
+        [("s", "sum", "v"), ("lo", "min", "v")],
+    )
+    rng = np.random.default_rng(1)
+    base = pd.DataFrame(
+        {"k": rng.integers(0, 4, 300).astype(np.int64),
+         "v": rng.random(300)}
+    )
+    agg.fold(base)
+    t = agg.stats()["traces"]
+    # empty micro-batch: no rows, no device call, no state change
+    empty = pd.DataFrame(
+        {"k": pd.Series(dtype=np.int64), "v": pd.Series(dtype=float)}
+    )
+    assert agg.fold(empty) == 0
+    snap_before = json.dumps(agg.snapshot(), sort_keys=True)
+    assert agg.fold(empty) == 0
+    assert json.dumps(agg.snapshot(), sort_keys=True) == snap_before
+    # an ALL-NULL payload batch (same row bucket) folds through the
+    # SAME compiled program — the always-mask pytree keeps the
+    # structure shape-stable — and adds nothing to the sums
+    nulls = pd.DataFrame(
+        {"k": np.full(300, 2, dtype=np.int64),
+         "v": np.full(300, np.nan)}
+    )
+    agg.fold(nulls)
+    assert agg.stats()["traces"] == t
+    got = agg.finalize().as_pandas().sort_values("k").reset_index(drop=True)
+    exp = base.groupby("k")["v"].agg(["sum", "min"]).reset_index()
+    assert np.allclose(got["s"], exp["sum"])
+    assert np.allclose(got["lo"], exp["min"])
+    # a group fed ONLY nulls aggregates to NULL
+    only_null = pd.DataFrame(
+        {"k": np.full(300, 9, dtype=np.int64), "v": np.full(300, np.nan)}
+    )
+    agg.fold(only_null)
+    rows = {
+        int(r[0]): r[1:] for r in agg.finalize().as_array()
+    }
+    assert rows[9] == [None, None], rows
+
+
+def test_int_column_with_nulls_stays_exact():
+    # pandas promotes an int column with nulls to float: the fold must
+    # mask the nulls and route the remaining values back through int64
+    e = make_engine()
+    agg = StreamingAggregator(
+        e, Schema("k:long,v:long"), ["k"], [("s", "sum", "v")]
+    )
+    big = (1 << 55) + 3
+    agg.fold(
+        pd.DataFrame(
+            {"k": [0, 0], "v": np.array([big, big + 1], dtype=np.int64)}
+        )
+    )
+    agg.fold(pd.DataFrame({"k": [0, 0], "v": [2.0, float("nan")]}))
+    # big + (big+1) + 2, bit-exact: a float64 round trip would land on
+    # a multiple of 8 here
+    assert agg.finalize().as_array() == [[0, 2 * big + 3]]
+
+
+def test_snapshot_roundtrip_and_unsupported():
+    e = make_engine()
+    agg = StreamingAggregator(
+        e, Schema("k:long,v:double"), ["k"],
+        [("s", "sum", "v"), ("c", "count", "v")],
+    )
+    rng = np.random.default_rng(3)
+    for _ in range(3):
+        agg.fold(
+            pd.DataFrame(
+                {"k": rng.integers(0, 8, 100).astype(np.int64),
+                 "v": rng.random(100)}
+            )
+        )
+    # snapshot is pure JSON and restores to an IDENTICAL result
+    snap = json.loads(json.dumps(agg.snapshot()))
+    agg2 = StreamingAggregator.from_snapshot(e, snap)
+    a = agg.finalize().as_pandas().sort_values("k").reset_index(drop=True)
+    b = agg2.finalize().as_pandas().sort_values("k").reset_index(drop=True)
+    pd.testing.assert_frame_equal(a, b)
+    # ... and the restored aggregator keeps folding
+    agg2.fold(
+        pd.DataFrame({"k": np.zeros(10, dtype=np.int64),
+                      "v": np.ones(10)})
+    )
+    assert agg2.rows_folded == agg.rows_folded + 10
+    # NULL group keys are a data-contract violation for streaming
+    with pytest.raises(StreamUnsupported):
+        agg.fold(pd.DataFrame({"k": [1.0, None], "v": [1.0, 2.0]}))
+    # an empty aggregator finalizes to None (nothing to emit)
+    fresh = StreamingAggregator(
+        e, Schema("k:long,v:double"), ["k"], [("s", "sum", "v")]
+    )
+    assert fresh.finalize() is None and fresh.empty
+
+
+def test_evict_leading_below_bounds_state():
+    # window retention: dropping the leading key's oldest slots is a
+    # contiguous slice (most-significant radix), results untouched for
+    # the retained range
+    e = make_engine()
+    agg = StreamingAggregator(
+        e, Schema("w:long,k:long,v:double"), ["w", "k"],
+        [("s", "sum", "v")],
+    )
+    agg.fold(
+        pd.DataFrame(
+            {"w": [0, 1, 2, 3], "k": [0, 1, 0, 1],
+             "v": [1.0, 2.0, 3.0, 4.0]}
+        )
+    )
+    before = agg.finalize().as_array()
+    evicted = agg.evict_leading_below(2)
+    assert evicted > 0
+    assert agg.key_bounds[0] == (2, 3)
+    rows = sorted(map(tuple, agg.finalize().as_array()))
+    assert rows == [(2, 0, 3.0), (3, 1, 4.0)], rows
+    assert len(before) == 4
+    # evicting everything resets to empty; folding restarts cleanly
+    assert agg.evict_leading_below(100) > 0
+    assert agg.empty
+    agg.fold(pd.DataFrame({"w": [7], "k": [0], "v": [9.0]}))
+    assert agg.finalize().as_array() == [[7, 0, 9.0]]
+    # no-op below the current lo
+    assert agg.evict_leading_below(0) == 0
+
+
+def test_finalize_key_filter_and_transform():
+    import pyarrow as pa
+
+    e = make_engine()
+    agg = StreamingAggregator(
+        e, Schema("w:long,k:long,v:double"), ["w", "k"],
+        [("s", "sum", "v")],
+    )
+    agg.fold(
+        pd.DataFrame(
+            {"w": [0, 0, 1, 1, 2], "k": [0, 1, 0, 1, 0],
+             "v": [1.0, 2.0, 3.0, 4.0, 5.0]}
+        )
+    )
+    df = agg.finalize(
+        key_filter=lambda keys: keys["w"] < 2,  # watermark-style gate
+        key_transform={
+            "w": (lambda ids: (ids * 10).astype(np.int64), pa.int64())
+        },
+    )
+    rows = sorted(map(tuple, df.as_array()))
+    assert rows == [
+        (0, 0, 1.0), (0, 1, 2.0), (10, 0, 3.0), (10, 1, 4.0),
+    ], rows
+    # filter that keeps nothing -> None, not an empty frame
+    assert agg.finalize(key_filter=lambda keys: keys["w"] > 99) is None
