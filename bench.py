@@ -1478,6 +1478,188 @@ def _config7_cold_start() -> Dict[str, Any]:
     return res
 
 
+_SCALING_SCRIPT = r"""
+import json, sys, time
+n_dev, rows, jrows = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+import numpy as np
+import pandas as pd
+import jax
+from fugue_tpu.column import col
+from fugue_tpu.column import functions as ff
+from fugue_tpu.collections.partition import PartitionSpec
+from fugue_tpu.jax_backend import JaxExecutionEngine
+
+assert len(jax.devices()) == n_dev, (len(jax.devices()), n_dev)
+# shuffle pinned ON: this config measures the sharded relational path
+# itself (auto would decline the small BENCH_SMALL shapes)
+e = JaxExecutionEngine({"fugue.jax.shuffle": "on"})
+
+def gb_frame(seed):
+    # every frame carries EXACTLY the full 512-key domain (permuted):
+    # num_segments is a static of the compiled program, so a randomly
+    # missing key would read as a spurious recompile on the warm run
+    r = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "k": r.permutation(np.arange(rows, dtype=np.int64) % 512),
+        "v": r.random(rows),
+    })
+
+aggs = [
+    ff.sum(col("v")).alias("s"),
+    ff.count(col("v")).alias("c"),
+    ff.min(col("v")).alias("mn"),
+]
+spec = PartitionSpec(by=["k"])
+# distinct pre-ingested frames per run: identical shapes share compiled
+# programs, distinct data defeats any result memoization
+gb = [e.to_df(gb_frame(s)) for s in (1, 2, 3)]
+e.aggregate(gb[0], spec, aggs).as_array()  # compile + warm
+m0 = e.compile_cache_stats["misses"]
+best = float("inf")
+for _ in range(3):  # best-of-6 damps the 1-core container's jitter
+    for d in gb[1:]:
+        t0 = time.perf_counter()
+        e.aggregate(d, spec, aggs).as_array()
+        best = min(best, time.perf_counter() - t0)
+gb_rps = rows / best
+gb_zero = e.compile_cache_stats["misses"] == m0
+del gb  # release the group-by frames' device buffers before the join
+
+jdom = max(jrows // 4, 64)
+
+def j_frame(seed, n):
+    # full key domain on both sides, same determinism rationale. The
+    # domain keeps multiplicity low (right side: exactly 2 rows/key,
+    # output ~2x left) so the timing measures the relational path, not
+    # a many-to-many row explosion; 2 rows/key also keeps the right
+    # side off the unique-right fast path so the sharded count program
+    # actually runs
+    r = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "k": r.permutation(np.arange(n, dtype=np.int64) % jdom),
+        "v": r.random(n),
+    })
+
+right = e.to_df(j_frame(9, jrows // 2).rename(columns={"v": "w"}))
+lefts = [e.to_df(j_frame(s, jrows)) for s in (4, 5, 6)]
+e.join(lefts[0], right, how="inner", on=["k"]).count()  # compile + warm
+m1 = e.compile_cache_stats["misses"]
+jbest = float("inf")
+for _ in range(3):
+    for d in lefts[1:]:
+        t0 = time.perf_counter()
+        e.join(d, right, how="inner", on=["k"]).count()
+        jbest = min(jbest, time.perf_counter() - t0)
+j_rps = jrows / jbest
+j_zero = e.compile_cache_stats["misses"] == m1
+print(json.dumps({
+    "devices": n_dev,
+    "groupby_rows_per_sec": round(gb_rps),
+    "join_rows_per_sec": round(j_rps),
+    "zero_recompile_warm": bool(gb_zero and j_zero),
+    "shuffle_counts": e.shuffle_counts if n_dev > 1 else {},
+}))
+"""
+
+
+def _config10_scaling() -> Dict[str, Any]:
+    """Multi-device scaling curve (ISSUE 16): the SAME shuffle-on
+    group-by and join workloads in fresh processes at devices=1/2/4/8
+    (CPU via ``--xla_force_host_platform_device_count``), reporting
+    rows/sec per point and ``parallel_efficiency`` per workload:
+    ``(rps_n / rps_1) / min(n, cpu_cores)``. The min(n, cores)
+    normalizer makes the number honest on this container: forced host
+    devices beyond the physical core count cannot add real parallelism,
+    so a point at n > cores measures shuffle OVERHEAD (efficiency ~1.0
+    = the sharded path costs nothing extra), while n <= cores measures
+    true scale-out. ``zero_recompile_warm`` asserts the one-trace
+    invariant held at every device count."""
+    import subprocess
+    import sys as _sys
+
+    rows = _scale(1_000_000)
+    jrows = _scale(400_000)
+    cores = os.cpu_count() or 1
+
+    def run(n_dev: int) -> Dict[str, Any]:
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        flags = [
+            f
+            for f in env.get("XLA_FLAGS", "").split()
+            if not f.startswith("--xla_force_host_platform_device_count")
+        ]
+        flags.append(f"--xla_force_host_platform_device_count={n_dev}")
+        env["XLA_FLAGS"] = " ".join(flags)
+        out = subprocess.run(
+            [
+                _sys.executable, "-c", _SCALING_SCRIPT,
+                str(n_dev), str(rows), str(jrows),
+            ],
+            capture_output=True, text=True, timeout=900, env=env,
+        )
+        if out.returncode != 0:  # surfaced in the artifact, not fatal
+            return {"devices": n_dev, "error": out.stderr[-1500:]}
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    # TWO interleaved sweeps, merged per point by best rows/sec: the
+    # points are measured in separate subprocesses minutes apart, and on
+    # a small shared box the machine-state epochs between them swing
+    # single measurements by tens of percent — a second decorrelated
+    # pass damps exactly the noise that best-of-N inside one process
+    # cannot see
+    merged: Dict[int, Dict[str, Any]] = {}
+    for _sweep in range(2):
+        for n in (1, 2, 4, 8):
+            p = run(n)
+            prev = merged.get(n)
+            if prev is None or "error" in prev:
+                merged[n] = p
+            elif "error" not in p:
+                for k in ("groupby_rows_per_sec", "join_rows_per_sec"):
+                    prev[k] = max(prev[k], p[k])
+                prev["zero_recompile_warm"] = (
+                    prev["zero_recompile_warm"] and p["zero_recompile_warm"]
+                )
+    points = [merged[n] for n in (1, 2, 4, 8)]
+    res: Dict[str, Any] = {
+        "rows": rows,
+        "join_rows": jrows,
+        "cpu_cores": cores,
+        "points": points,
+        "efficiency_normalizer": "min(devices, cpu_cores)",
+    }
+    base = points[0]
+    eff: Dict[str, Dict[str, float]] = {}
+    if "error" not in base:
+        for p in points[1:]:
+            if "error" in p:
+                continue
+            n = p["devices"]
+            denom = float(min(n, cores))
+            eff[str(n)] = {
+                "groupby": round(
+                    p["groupby_rows_per_sec"]
+                    / max(base["groupby_rows_per_sec"], 1)
+                    / denom,
+                    3,
+                ),
+                "join": round(
+                    p["join_rows_per_sec"]
+                    / max(base["join_rows_per_sec"], 1)
+                    / denom,
+                    3,
+                ),
+            }
+    res["parallel_efficiency"] = eff
+    res["zero_recompile_warm"] = all(
+        p.get("zero_recompile_warm", False)
+        for p in points
+        if "error" not in p
+    )
+    return res
+
+
 def _config8_serving_fleet() -> Dict[str, Any]:
     """Fleet serving scenario (ISSUE 13): aggregate qps + p99 through
     the front-tier router at replicas=1 and replicas=2 (each replica
@@ -1753,8 +1935,16 @@ def _bench() -> Dict[str, Any]:
         "7_cold_start": _config7_cold_start(),
         "8_serving_fleet": _config8_serving_fleet(),
         "9_continuous": _config9_continuous(),
+        "10_scaling": _config10_scaling(),
     }
     headline["detail"]["configs"] = configs
+    # the scaling curve's summary rides the headline contract: devices
+    # is already in detail (the headline engine's mesh), the measured
+    # multi-device efficiency joins it here
+    scaling = configs["10_scaling"]
+    headline["detail"]["parallel_efficiency"] = scaling.get(
+        "parallel_efficiency", {}
+    )
     return headline
 
 

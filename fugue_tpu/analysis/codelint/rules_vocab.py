@@ -16,11 +16,20 @@ FLN107 — ``fault_point(site, ...)`` literals must come from
 chaos test silently stops testing anything), and literal metric names
 must fall under ``obs/metrics.py METRIC_NAME_PREFIXES`` (one dashboard
 namespace, no silent forks).
+
+FLN108 — no eager default-device placement on engine paths
+(``fugue_tpu/jax_backend/``): a single-argument ``jax.device_put``
+pins data to the process default device — which belongs to a DIFFERENT
+replica's slice when engines carve up the pod via ``fugue.jax.devices``
+— and a module-level ``jnp.array/zeros/...`` allocates on that device
+at import time, before any mesh exists. Placement must name its
+sharding (``device_put(x, sharding)``) or happen inside traced/mesh-
+scoped code.
 """
 
 import ast
 import re
-from typing import Any, Iterable
+from typing import Any, Iterable, List
 
 from fugue_tpu.analysis.codelint.engine import call_name
 from fugue_tpu.analysis.codelint.lockspec import ENGINE_FS_PATHS
@@ -40,6 +49,14 @@ _RAW_IO_CALLS = {
 
 _CONF_KEY_RE = re.compile(r"fugue(\.[a-z0-9_]+)+")
 _METRIC_METHODS = {"counter", "gauge", "histogram"}
+
+#: paths where device placement must stay mesh-scoped (FLN108)
+_DEVICE_PLACEMENT_PATHS = ("fugue_tpu/jax_backend/",)
+_EAGER_ARRAY_CTORS = {
+    "array", "asarray", "zeros", "ones", "full", "empty", "eye",
+    "arange", "linspace",
+}
+_JNP_PREFIXES = ("jnp.", "jax.numpy.")
 
 
 @register_source_rule
@@ -169,3 +186,74 @@ class VocabularyRule(SourceRule):
                             line=node.lineno,
                             qualname=mod.qualname(node),
                         )
+
+
+def _import_time_nodes(tree: ast.Module) -> Iterable[ast.AST]:
+    """AST nodes whose code runs at IMPORT time: module and class bodies,
+    plus decorator expressions and argument defaults of function
+    definitions — but not function/lambda bodies."""
+    stack: List[ast.AST] = list(tree.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(node.decorator_list)
+            stack.extend(d for d in node.args.defaults)
+            stack.extend(d for d in node.args.kw_defaults if d is not None)
+            continue
+        if isinstance(node, ast.Lambda):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@register_source_rule
+class EagerDevicePlacementRule(SourceRule):
+    code = "FLN108"
+    description = (
+        "eager default-device placement on an engine path: single-arg "
+        "jax.device_put, or module-level jnp array construction"
+    )
+
+    def check(self, ctx: Any) -> Iterable[SourceDiagnostic]:
+        for mod in ctx.modules:
+            if not mod.rel.startswith(_DEVICE_PLACEMENT_PATHS):
+                continue
+            import_time = {id(n) for n in _import_time_nodes(mod.tree)}
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if name is None:
+                    continue
+                if name in ("jax.device_put", "device_put"):
+                    placed = len(node.args) >= 2 or any(
+                        kw.arg == "device" for kw in node.keywords
+                    )
+                    if not placed:
+                        yield self.diag(
+                            "single-argument jax.device_put on an engine "
+                            "path commits data to the process default "
+                            "device — the WRONG device once engines "
+                            "carve the pod into per-replica slices "
+                            "(fugue.jax.devices): pass the owning "
+                            "mesh's sharding (device_put(x, sharding))",
+                            path=mod.rel,
+                            line=node.lineno,
+                            qualname=mod.qualname(node),
+                        )
+                    continue
+                if (
+                    id(node) in import_time
+                    and name.startswith(_JNP_PREFIXES)
+                    and name.rsplit(".", 1)[-1] in _EAGER_ARRAY_CTORS
+                ):
+                    yield self.diag(
+                        f"module-level '{name}(...)' allocates on the "
+                        "default device at import time, before any mesh "
+                        "or device slice exists: build device arrays "
+                        "inside jitted/mesh-scoped code (host-side "
+                        "np.* constants are fine)",
+                        path=mod.rel,
+                        line=node.lineno,
+                        qualname=mod.qualname(node),
+                    )

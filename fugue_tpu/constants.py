@@ -45,6 +45,9 @@ FUGUE_CONF_JAX_IO_PIPELINE = "fugue.jax.io.pipeline"
 FUGUE_CONF_JAX_GROUPBY_MATMUL = "fugue.jax.groupby.matmul"
 FUGUE_CONF_JAX_GROUPBY_STRATEGY = "fugue.jax.groupby.strategy"
 FUGUE_CONF_JAX_GROUPBY_AUTOTUNE = "fugue.jax.groupby.autotune"
+FUGUE_CONF_JAX_SHUFFLE = "fugue.jax.shuffle"
+FUGUE_CONF_JAX_SHUFFLE_OVERLAP = "fugue.jax.shuffle.overlap"
+FUGUE_CONF_JAX_DEVICES = "fugue.jax.devices"
 FUGUE_CONF_JAX_MEMORY_BUDGET_BYTES = "fugue.jax.memory.budget_bytes"
 FUGUE_CONF_JAX_MEMORY_BUDGET_FRACTION = "fugue.jax.memory.budget_fraction"
 FUGUE_CONF_JAX_MEMORY_HIGH_WATERMARK = "fugue.jax.memory.high_watermark"
@@ -76,6 +79,7 @@ FUGUE_CONF_SERVE_FLEET_PORT = "fugue.serve.fleet.port"
 FUGUE_CONF_SERVE_FLEET_HEALTH_INTERVAL = "fugue.serve.fleet.health_interval"
 FUGUE_CONF_SERVE_FLEET_DEATH_THRESHOLD = "fugue.serve.fleet.death_threshold"
 FUGUE_CONF_SERVE_FLEET_RESULT_CACHE_DIR = "fugue.serve.fleet.result_cache_dir"
+FUGUE_CONF_SERVE_FLEET_DEVICE_SLICES = "fugue.serve.fleet.device_slices"
 FUGUE_CONF_OPTIMIZE = "fugue.optimize"
 FUGUE_CONF_OPTIMIZE_CSE = "fugue.optimize.cse"
 FUGUE_CONF_OPTIMIZE_FILTER = "fugue.optimize.filter_pushdown"
@@ -293,6 +297,21 @@ def _declare_defaults() -> None:
     # autotune policy: "auto" probes on accelerator meshes for large
     # frames only; True/False force it on/off. Mixed-type by design.
     r(FUGUE_CONF_JAX_GROUPBY_AUTOTUNE, object, "auto", "one-shot strategy autotune: auto | bool")
+    # all-to-all shuffle repartition (jax_backend/shuffle.py): co-locate
+    # matching keys per device shard before segment reductions (group-by,
+    # join match counts). "auto" shuffles only on multi-device meshes for
+    # frames large enough to amortize the padded receive; "on"/"off" pin
+    # it. Single-device meshes never shuffle regardless.
+    r(FUGUE_CONF_JAX_SHUFFLE, str, "auto", "key-shuffle repartition: auto | on | off")
+    # collective/compute overlap: double-buffer the next key-range's
+    # all-to-all behind the current range's local reduction. "auto"
+    # enables it on accelerator meshes only (CPU collectives are
+    # synchronous, so the split is pure overhead there).
+    r(FUGUE_CONF_JAX_SHUFFLE_OVERLAP, str, "auto", "shuffle/compute overlap: auto | on | off")
+    # device slice for the engine's mesh: a comma-separated list of
+    # indices into jax.devices() (e.g. "0,1"). Empty = all devices. How
+    # a serve fleet gives each replica its own slice of the pod.
+    r(FUGUE_CONF_JAX_DEVICES, str, "", "engine device slice: comma-separated jax.devices() indices")
     # device-memory governance (jax_backend/memory.py): budget_bytes > 0
     # (or budget_fraction > 0 of the detected per-device memory) turns on
     # the HBM byte ledger + admission controller. An ingest/persist that
@@ -597,6 +616,19 @@ def _declare_defaults() -> None:
         "cache for pure queries, keyed by DAG fingerprint + table "
         "artifact sha256s ('' = off; ServeFleet defaults it under the "
         "shared state path)",
+        in_defaults=False,
+    )
+    # per-replica device slices: when on and the pod has at least one
+    # device per replica, the fleet partitions jax.devices() evenly and
+    # sets each replica's fugue.jax.devices so every engine owns its own
+    # sub-mesh (capacity model: qps x devices) instead of all replicas
+    # sharing one global mesh.
+    r(
+        FUGUE_CONF_SERVE_FLEET_DEVICE_SLICES,
+        bool,
+        False,
+        "give each fleet replica its own slice of jax.devices() via "
+        "fugue.jax.devices (needs >= 1 device per replica)",
         in_defaults=False,
     )
     # cost-based DAG optimizer (fugue_tpu/optimize): the rewrite phase

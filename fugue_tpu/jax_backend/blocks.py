@@ -33,6 +33,7 @@ do the rest).
 """
 
 import logging
+import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -150,7 +151,19 @@ def row_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("p"))
 
 
-_JIT_ROW_SHARDED_CACHE: Dict[Any, Any] = {}
+# Compiled row-sharded programs are cached ON their mesh object (one
+# dict per mesh) instead of in a module-global keyed by the mesh: each
+# cached program's out_sharding holds a strong reference back to its
+# mesh, so a global would root every mesh it ever saw — dead meshes
+# (fleet replica churn, per-test engines) would leak their compiled
+# programs forever, and no finalizer could fire to stop it. Attached to
+# the mesh, cache + programs + mesh form one cycle the ordinary GC
+# reclaims the moment the last outside reference drops. The weak
+# registry below only observes which meshes currently carry a cache
+# (tests assert it stays weak and that no module global here strongly
+# roots a mesh or its programs).
+_JIT_ROW_SHARDED_ATTR = "_fugue_jit_row_sharded_cache"
+_JIT_ROW_SHARDED_MESHES: Any = weakref.WeakSet()
 
 
 def jit_row_sharded(mesh: Mesh, key: Any, fn: Any) -> Any:
@@ -161,11 +174,15 @@ def jit_row_sharded(mesh: Mesh, key: Any, fn: Any) -> Any:
     process-spanning sharding is a cross-host reshard jax refuses on CPU
     meshes. Callers must pass HOST scalars (np.int32, not jnp) so inputs
     never carry a single-device commitment either."""
-    k = (mesh, key)
-    prog = _JIT_ROW_SHARDED_CACHE.get(k)
+    per_mesh = getattr(mesh, _JIT_ROW_SHARDED_ATTR, None)
+    if per_mesh is None:
+        per_mesh = {}
+        setattr(mesh, _JIT_ROW_SHARDED_ATTR, per_mesh)
+        _JIT_ROW_SHARDED_MESHES.add(mesh)
+    prog = per_mesh.get(key)
     if prog is None:
         prog = jax.jit(fn, out_shardings=row_sharding(mesh))
-        _JIT_ROW_SHARDED_CACHE[k] = prog
+        per_mesh[key] = prog
     return prog
 
 

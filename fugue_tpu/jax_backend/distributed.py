@@ -60,7 +60,9 @@ def init_distributed(conf: Any = None) -> bool:
 
 
 def make_device_callback(
-    client: Callable[..., Any], result_shape: Optional[Any] = None
+    client: Callable[..., Any],
+    result_shape: Optional[Any] = None,
+    mesh: Optional[Any] = None,
 ) -> Callable[..., Any]:
     """Wrap an RPC client (or any host callable) for use INSIDE jitted
     code via ``jax.experimental.io_callback``.
@@ -75,6 +77,14 @@ def make_device_callback(
             ...
             notify(jnp.sum(arrs["_row_valid"]))
             return {...}
+
+    Pass the OWNING mesh when the caller's program runs on a device
+    slice: the pin must land on a device that program actually uses —
+    ``jax.devices()[0]`` belongs to a different replica's slice when
+    engines carve up the pod via ``fugue.jax.devices``, and a cross
+    slice pin both breaks the partitioner's placement and ships the
+    callback operands over a link the program otherwise never touches.
+    Without a mesh the process default device is kept for back-compat.
     """
     from jax.experimental import io_callback
 
@@ -89,7 +99,10 @@ def make_device_callback(
     # under SPMD the callback is pinned to one device: the partitioner
     # rejects replicated side-effecting custom-calls, and a single
     # invocation per logical call is the semantic the RPC channel wants
-    pin = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    pin_dev = (
+        mesh.devices.flat[0] if mesh is not None else jax.devices()[0]
+    )
+    pin = jax.sharding.SingleDeviceSharding(pin_dev)
 
     if result_shape is None:
         # io_callback requires a result; use a dummy int32 scalar

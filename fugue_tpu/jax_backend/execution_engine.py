@@ -592,7 +592,14 @@ class JaxExecutionEngine(ExecutionEngine):
     def __init__(self, conf: Any = None, mesh: Any = None):
         super().__init__(conf)
         ensure_x64()
-        self._mesh = mesh if mesh is not None else make_mesh()
+        # fugue.jax.devices carves the engine's mesh out of a slice of
+        # the pod (how each fleet replica owns its own devices); an
+        # explicitly passed mesh always wins
+        self._mesh = (
+            mesh
+            if mesh is not None
+            else make_mesh(_devices_from_conf(self.conf))
+        )
         self._mesh_pinned = mesh is not None
         self._host_mesh = self._mesh if mesh is not None else _host_mesh_like(
             self._mesh
@@ -688,6 +695,30 @@ class JaxExecutionEngine(ExecutionEngine):
         # = the unpacked per-agg path). Benches report this per config so
         # the crossover selector's choices are visible, not guessed.
         self._strategy_counts: Dict[str, int] = {}
+        # shuffle-repartition observability (the fugue_shuffle_ family):
+        # per-op program runs split by overlap mode, transported-byte
+        # estimates, and dispatch wall clock. EXPLAIN ANALYZE surfaces
+        # deltas of the shuffle_counts view over these.
+        self._m_shuffle_ops = self.metrics.counter(
+            "fugue_shuffle_ops_total",
+            "all-to-all shuffle-repartitioned programs per op, split by "
+            "whether the collective/compute overlap split was traced",
+            ["op", "overlap"],
+        )
+        self._m_shuffle_bytes = self.metrics.counter(
+            "fugue_shuffle_bytes_total",
+            "estimated bytes moved through padded all-to-all exchanges "
+            "per op (static shape estimate, counts the full padded "
+            "send buffers)",
+            ["op"],
+        )
+        self._m_shuffle_secs = self.metrics.counter(
+            "fugue_shuffle_seconds_total",
+            "dispatch wall clock of shuffle-repartitioned programs per "
+            "op (async dispatch time; the collective itself overlaps "
+            "downstream compute)",
+            ["op"],
+        )
         # (fn, arg avals) of jitted programs as they run, for AOT
         # cost_analysis (see program_cost_analysis). Recording is DISARMED
         # until reset_program_log() so the per-dispatch aval capture never
@@ -862,6 +893,36 @@ class JaxExecutionEngine(ExecutionEngine):
 
     def _count_strategy(self, name: str) -> None:
         self._strategy_counts[name] = self._strategy_counts.get(name, 0) + 1
+
+    @property
+    def shuffle_counts(self) -> Dict[str, int]:
+        """Shuffle-repartition counters since construction, flattened for
+        the profiler's counter surface: per-op program runs (``aggregate``,
+        ``join``), ``<op>_overlap`` runs that traced the double-buffered
+        split, ``<op>_bytes`` transported-byte estimates, and ``<op>_ms``
+        cumulative dispatch wall clock."""
+        out: Dict[str, int] = {}
+        for (op, overlap), v in self._m_shuffle_ops.as_int_dict().items():
+            out[op] = out.get(op, 0) + v
+            if overlap == "1":
+                out[f"{op}_overlap"] = out.get(f"{op}_overlap", 0) + v
+        for op, v in self._m_shuffle_bytes.as_int_dict().items():
+            if v:
+                out[f"{op}_bytes"] = v
+        for op, secs in self._m_shuffle_secs.as_dict().items():
+            ms = int(secs * 1000.0)
+            if ms:
+                out[f"{op}_ms"] = ms
+        return out
+
+    def _count_shuffle(
+        self, op: str, nbytes: int, secs: float, overlap: bool
+    ) -> None:
+        self._m_shuffle_ops.labels(
+            op=op, overlap="1" if overlap else "0"
+        ).inc()
+        self._m_shuffle_bytes.labels(op=op).inc(max(0, int(nbytes)))
+        self._m_shuffle_secs.labels(op=op).inc(max(0.0, float(secs)))
 
     def reset_program_log(self) -> None:
         """Arm program recording and forget prior signatures (scopes
@@ -2509,6 +2570,31 @@ class JaxExecutionEngine(ExecutionEngine):
         # through the strategy layer per tier — min/max/median etc. stay
         # scatter-native inside _segment_agg_impl
         seg_strategy = self._count_reduce_strategy(blocks, num_segments)
+        # devices-aware column of the strategy decision: on multi-device
+        # meshes, repartition rows by key (all-to-all) so each device
+        # reduces only its own segments instead of every device reducing
+        # the full segment space redundantly
+        from fugue_tpu.jax_backend import segtune as _segtune
+        from fugue_tpu.jax_backend import shuffle as _shuffle
+
+        use_shuffle = _segtune.choose_shuffle(
+            self._shuffle_mode(), blocks.mesh, pad_n, num_segments
+        )
+        # combinable plan sets ride the map-side combine (partial
+        # aggregation + reduce-scatter-layout all-to-all): O(S * ndev)
+        # traffic. Only non-combinable aggregates (median, variance)
+        # need the O(rows * ndev) row shuffle
+        use_preagg = use_shuffle and _shuffle.preagg_ok(
+            [f for _, f, _, _ in typed_plans]
+        )
+        use_overlap = (
+            use_shuffle
+            and not use_preagg
+            and _segtune.choose_overlap(
+                self._shuffle_overlap_mode(), blocks.mesh, num_segments
+            )
+        )
+        mesh = blocks.mesh
 
         # ONE fused program: every agg + key gather + padding, single dispatch
         def _agg_program(
@@ -2531,6 +2617,7 @@ class JaxExecutionEngine(ExecutionEngine):
                 outs[f"k:{k}"] = _pad_to(kd, out_pad)
                 if km is not None:
                     outs[f"km:{k}"] = _pad_to(km[first_idx_], out_pad)
+            plan_inputs = []
             for name, func, arg, tp in typed_plans:
                 if func == "count" and arg is None:
                     values: Any = jnp.ones((pad_n,), dtype=jnp.int32)
@@ -2542,10 +2629,51 @@ class JaxExecutionEngine(ExecutionEngine):
                 mask = _apply_distinct_mask(
                     dsegs_, dfirsts_, name, pad_n, mask
                 )
-                v, m = groupby._segment_agg_impl(
-                    func, values, mask, seg_, num_segments, valid_,
+                plan_inputs.append((name, func, tp, values, mask))
+            if use_preagg:
+                # map-side combine: per-device partials, one tiny
+                # all-to-all of (ndev, S_local) partial tables
+                pairs = _shuffle.preagg_segment_aggs(
+                    mesh,
+                    [f for _, f, _, _, _ in plan_inputs],
+                    seg_,
+                    valid_,
+                    [
+                        None if f == "count" else v
+                        for _, f, _, v, _ in plan_inputs
+                    ],
+                    [m for _, _, _, _, m in plan_inputs],
+                    num_segments,
                     strategy=seg_strategy,
                 )
+            elif use_shuffle:
+                # ONE all-to-all co-locates every plan's rows by key;
+                # count transports only its mask (values are unused by
+                # the count kernel — but the mask MUST travel, it folds
+                # into the effective row count)
+                pairs = _shuffle.shuffled_segment_aggs(
+                    mesh,
+                    [f for _, f, _, _, _ in plan_inputs],
+                    seg_,
+                    valid_,
+                    [
+                        None if f == "count" else v
+                        for _, f, _, v, _ in plan_inputs
+                    ],
+                    [m for _, _, _, _, m in plan_inputs],
+                    num_segments,
+                    strategy=seg_strategy,
+                    overlap=use_overlap,
+                )
+            else:
+                pairs = [
+                    groupby._segment_agg_impl(
+                        f, v, m, seg_, num_segments, valid_,
+                        strategy=seg_strategy,
+                    )
+                    for _, f, _, v, m in plan_inputs
+                ]
+            for (name, func, tp, _, _), (v, m) in zip(plan_inputs, pairs):
                 outs[f"a:{name}"] = _pad_to(_cast_agg_result(v, tp), out_pad)
                 if m is not None:
                     outs[f"am:{name}"] = _pad_to(m, out_pad)
@@ -2559,16 +2687,26 @@ class JaxExecutionEngine(ExecutionEngine):
             tuple((n, f, None if a is None else a.__uuid__(), str(t))
                   for n, f, a, t in typed_plans),
             tuple(keys), num_segments, out_pad, pad_n, seg_strategy,
+            ("shuf", use_shuffle, use_preagg, use_overlap, ndev),
             tuple(sorted(distinct_args.items())),
             expr_eval.dict_fingerprint(blocks),
         )
         self._count_strategy("generic")
+        if use_shuffle:
+            # per-strategy shuffle visibility: which exchange plan ran
+            # (map-side combine vs row shuffle) and which reduction
+            # kernel the local pass used
+            self._count_strategy(
+                "shuffle_preagg" if use_preagg
+                else f"shuffle_{seg_strategy}"
+            )
         key_data = {k: blocks.columns[k].data for k in keys}
         key_masks = {
             k: blocks.columns[k].mask
             for k in keys
             if blocks.columns[k].mask is not None
         }
+        t0 = time.perf_counter() if use_shuffle else 0.0
         outs = self._jit_cached(prog_key, _agg_program)(
             expr_eval.blocks_to_masked(blocks),
             key_data,
@@ -2581,6 +2719,27 @@ class JaxExecutionEngine(ExecutionEngine):
             blocks.row_valid,
             _nrows_arg(blocks),
         )
+        if use_shuffle:
+            if use_preagg:
+                # per-segment partial widths: count ships an i32 count,
+                # everything else an 8B value + a marker/count column
+                widths = sum(
+                    4 if f == "count" else 9 for _, f, _, _ in typed_plans
+                )
+                nbytes = _shuffle.estimate_preagg_bytes(
+                    num_segments, ndev, widths
+                )
+            else:
+                widths = sum(
+                    (0 if f == "count" else 8) + 1
+                    for _, f, _, _ in typed_plans
+                )
+                nbytes = _shuffle.estimate_shuffle_bytes(
+                    pad_n, ndev, widths
+                )
+            self._count_shuffle(
+                "aggregate", nbytes, time.perf_counter() - t0, use_overlap
+            )
         out_cols: Dict[str, JaxColumn] = {}
         schema_fields = [jdf.schema[k] for k in keys]
         for k in keys:
@@ -2719,6 +2878,39 @@ class JaxExecutionEngine(ExecutionEngine):
         if mode == "auto" and legacy != "auto":
             mode = "matmul" if legacy == "always" else "scatter"
         return mode
+
+    def _shuffle_mode(self) -> str:
+        """``fugue.jax.shuffle`` normalized to auto/on/off — whether
+        segment reductions repartition rows by key over the mesh first."""
+        from fugue_tpu.constants import FUGUE_CONF_JAX_SHUFFLE
+        from fugue_tpu.jax_backend import segtune
+
+        return segtune.shuffle_mode(
+            self.conf.get(FUGUE_CONF_JAX_SHUFFLE, "auto"),
+            FUGUE_CONF_JAX_SHUFFLE,
+        )
+
+    def _shuffle_overlap_mode(self) -> str:
+        """``fugue.jax.shuffle.overlap`` normalized to auto/on/off —
+        whether shuffled reductions double-buffer the next key-range's
+        all-to-all behind the current range's local reduction."""
+        from fugue_tpu.constants import FUGUE_CONF_JAX_SHUFFLE_OVERLAP
+        from fugue_tpu.jax_backend import segtune
+
+        return segtune.shuffle_mode(
+            self.conf.get(FUGUE_CONF_JAX_SHUFFLE_OVERLAP, "auto"),
+            FUGUE_CONF_JAX_SHUFFLE_OVERLAP,
+        )
+
+    def _join_shuffle(self, mesh: Any, rows: int, num_segments: int) -> bool:
+        """Shuffle decision for relational.py's join count reductions —
+        same strategy column as aggregates, exposed so expand_join does
+        not reach into conf itself."""
+        from fugue_tpu.jax_backend import segtune
+
+        return segtune.choose_shuffle(
+            self._shuffle_mode(), mesh, rows, num_segments
+        )
 
     def _groupby_strategy(
         self,
@@ -3175,6 +3367,42 @@ class JaxExecutionEngine(ExecutionEngine):
             ),
             schema,
         )
+
+
+def _devices_from_conf(conf: Any) -> Optional[List[Any]]:
+    """Parse ``fugue.jax.devices`` — a comma-separated list of indices
+    into ``jax.devices()`` — into the device slice the engine's mesh
+    should cover. Empty/unset means all devices. Out-of-range or
+    non-integer indices raise: a replica silently grabbing the whole pod
+    because of a typo'd slice would defeat the isolation the knob
+    exists for."""
+    from fugue_tpu.constants import FUGUE_CONF_JAX_DEVICES
+
+    raw = str(conf.get(FUGUE_CONF_JAX_DEVICES, "") or "").strip()
+    if raw == "":
+        return None
+    devs = jax.devices()
+    out: List[Any] = []
+    for part in raw.split(","):
+        part = part.strip()
+        if part == "":
+            continue
+        try:
+            idx = int(part)
+        except ValueError:
+            raise ValueError(
+                f"{FUGUE_CONF_JAX_DEVICES}={raw!r}: {part!r} is not an "
+                "integer device index"
+            )
+        if not (0 <= idx < len(devs)):
+            raise ValueError(
+                f"{FUGUE_CONF_JAX_DEVICES}={raw!r}: index {idx} is out of "
+                f"range for {len(devs)} visible devices"
+            )
+        out.append(devs[idx])
+    if len(out) == 0:
+        return None
+    return out
 
 
 def _host_mesh_like(mesh: Any) -> Any:

@@ -24,6 +24,15 @@ object-store spilling):
   IN PLACE, so every live reference follows) until usage falls to the
   low watermark, then admits. Only persisted frames spill: transient
   intermediates die with their task and return budget via weakref.
+- **Per-device pools**: the device-tier ledger additionally splits every
+  frame's bytes evenly over the devices its arrays span. Admission and
+  watermark decisions look at the MINIMUM free pool (equivalently, the
+  fullest device scaled to mesh-total bytes): HBM is a per-chip
+  resource, and one saturated device OOMs the whole mesh-spanning
+  allocation no matter how empty its siblings are. While every frame
+  spans the full engine mesh the pools stay balanced and the decisions
+  reduce byte-identically to the global ledger arithmetic;
+  ``snapshot()["device_pools"]`` exposes the split.
 - **OOM feedback**: a real ``RESOURCE_EXHAUSTED`` that still slips
   through (engine under-estimate, foreign allocations in the same
   process) feeds the measured allocation size back into the ledger —
@@ -220,7 +229,9 @@ def parse_oom_bytes(text: str) -> int:
 
 
 class _LedgerEntry:
-    __slots__ = ("ref", "tier", "nbytes", "seq", "spillable", "tenant")
+    __slots__ = (
+        "ref", "tier", "nbytes", "seq", "spillable", "tenant", "devices",
+    )
 
     def __init__(
         self,
@@ -230,6 +241,7 @@ class _LedgerEntry:
         seq: int,
         spillable: bool,
         tenant: Optional[str] = None,
+        devices: Tuple[int, ...] = (),
     ):
         self.ref = ref
         self.tier = tier
@@ -237,6 +249,10 @@ class _LedgerEntry:
         self.seq = seq
         self.spillable = spillable
         self.tenant = tenant
+        # device ids the frame's row-sharded arrays span: its bytes are
+        # charged evenly across these per-device pools while on the
+        # device tier
+        self.devices = devices
 
 
 class AllocationGate:
@@ -323,6 +339,12 @@ class MemoryGovernor:
         self._tenant_local = threading.local()
         self._tier_bytes: Dict[str, int] = {"device": 0, "host": 0}
         self._tier_peak: Dict[str, int] = {"device": 0, "host": 0}
+        # per-device pools (device tier only): device id -> charged bytes.
+        # A row-sharded frame's footprint splits evenly over the devices
+        # it spans; governance decisions look at the FULLEST pool (i.e.
+        # the minimum free pool), which reduces exactly to the global
+        # ledger arithmetic while every frame spans the whole mesh.
+        self._device_bytes: Dict[int, float] = {}
         # cached metric children for the transfer accounting, one per
         # (phase, tier) — see note_transfer
         self._transfer_children: Dict[Tuple[str, str], Any] = {}
@@ -375,6 +397,46 @@ class MemoryGovernor:
     def _next_seq(self) -> int:
         self._seq += 1
         return self._seq
+
+    # ---- per-device pools ------------------------------------------------
+    def _engine_pool_ids(self) -> Tuple[int, ...]:
+        """Device ids of the engine's own mesh — the pools admission and
+        watermark decisions range over (a frame parked on some other
+        mesh's devices still charges ITS devices' pools, but cannot
+        relieve pressure here)."""
+        mesh = getattr(self._engine, "mesh", None)
+        if mesh is None:
+            return ()
+        return tuple(int(d.id) for d in mesh.devices.flat)
+
+    def _frame_device_ids(self, blocks: JaxBlocks) -> Tuple[int, ...]:
+        return tuple(int(d.id) for d in blocks.mesh.devices.flat)
+
+    def _charge_pools_locked(
+        self, entry: _LedgerEntry, nbytes: int
+    ) -> None:
+        """Add (or, negative, remove) one entry's even per-device split."""
+        if not entry.devices:
+            return
+        share = nbytes / len(entry.devices)
+        for d in entry.devices:
+            self._device_bytes[d] = self._device_bytes.get(d, 0.0) + share
+
+    def _effective_device_usage_locked(self) -> float:
+        """Device-tier usage as governance sees it: the fullest pool
+        scaled back to mesh-total bytes — i.e. the budget headroom is the
+        MINIMUM free pool, so one saturated device gates admission even
+        while its siblings sit empty. While every frame spans the whole
+        engine mesh the pools are balanced and this returns the exact
+        integer global ledger sum (byte-identical legacy decisions)."""
+        ids = self._engine_pool_ids()
+        if not ids:
+            return float(self._tier_bytes["device"])
+        pools = [self._device_bytes.get(d, 0.0) for d in ids]
+        hi, lo = max(pools), min(pools)
+        if hi - lo <= 0.5:  # balanced (float split noise only)
+            return float(self._tier_bytes["device"])
+        return hi * len(ids)
 
     # ---- tenants ---------------------------------------------------------
     def tenant_scope(self, tenant: Optional[str]) -> Any:
@@ -449,7 +511,11 @@ class MemoryGovernor:
         """The admission decision for a new frame of estimated footprint
         ``est`` whose placement policy chose ``default_tier``: a
         newcomer that alone exceeds the whole budget goes to the host
-        tier directly instead of ever letting XLA throw."""
+        tier directly instead of ever letting XLA throw. (A new frame
+        row-shards evenly over the engine mesh, so its per-device share
+        vs the per-device pool budget is exactly this comparison scaled
+        by the device count; usage-dependent pressure is pre_alloc's
+        job, evaluated against the minimum free pool.)"""
         if default_tier != "device" or not self.enabled:
             return default_tier
         with self._lock:
@@ -473,17 +539,21 @@ class MemoryGovernor:
             return
         with self._lock:
             high = self._high * self._budget
-            if self._tier_bytes["device"] + est <= high:
+            # the minimum free pool gates admission: usage is the fullest
+            # device's pool scaled to mesh-total bytes (== the global sum
+            # while every frame spans the whole mesh)
+            used = self._effective_device_usage_locked()
+            if used + est <= high:
                 return
             self.counters["pressure_events"] += 1
             self._count(
                 "mem_pressure",
-                f"{self._tier_bytes['device'] + est}B > "
+                f"{int(used + est)}B > "
                 f"high watermark {int(high)}B",
             )
             target = max(self._low * self._budget - est, 0.0)
             self._spill_down_to_locked(target)
-            if self._tier_bytes["device"] + est > self._budget:
+            if self._effective_device_usage_locked() + est > self._budget:
                 # nothing left to spill: the allocation proceeds anyway
                 # (the reactive OOM path still backstops it) but the
                 # overcommit is on the record
@@ -510,15 +580,22 @@ class MemoryGovernor:
                     self._tier_bytes[existing.tier] += (
                         nbytes - existing.nbytes
                     )
+                    if existing.tier == "device":
+                        self._charge_pools_locked(
+                            existing, nbytes - existing.nbytes
+                        )
                     existing.nbytes = nbytes
                     self._bump_peak(existing.tier)
                 return nbytes
             entry = _LedgerEntry(
                 weakref.ref(blocks), tier, nbytes, self._next_seq(),
                 persisted, tenant=self.current_tenant(),
+                devices=self._frame_device_ids(blocks),
             )
             self._entries[key] = entry
             self._tier_bytes[tier] += nbytes
+            if tier == "device":
+                self._charge_pools_locked(entry, nbytes)
             self._bump_peak(tier)
         weakref.finalize(blocks, self._release, key, entry)
         return nbytes
@@ -533,6 +610,8 @@ class MemoryGovernor:
             if self._entries.get(key) is entry:
                 del self._entries[key]
                 self._tier_bytes[entry.tier] -= entry.nbytes
+                if entry.tier == "device":
+                    self._charge_pools_locked(entry, -entry.nbytes)
 
     def touch(self, blocks: Optional[JaxBlocks]) -> None:
         """LRU recency update for a frame flowing through an engine op."""
@@ -600,7 +679,7 @@ class MemoryGovernor:
         plain global LRU otherwise. Caller holds the lock."""
         host_mesh = getattr(self._engine, "host_mesh", None)
         skipped: set = set()
-        while self._tier_bytes["device"] > target_bytes:
+        while self._effective_device_usage_locked() > target_bytes:
             v = self._pick_victim_locked(skipped)
             if v is None:
                 break
@@ -686,9 +765,13 @@ class MemoryGovernor:
     def _move_entry_locked(self, entry: _LedgerEntry, tier: str) -> None:
         if entry.tier == tier:
             return
+        if entry.tier == "device":
+            self._charge_pools_locked(entry, -entry.nbytes)
         self._tier_bytes[entry.tier] -= entry.nbytes
         self._tier_bytes[tier] += entry.nbytes
         entry.tier = tier
+        if tier == "device":
+            self._charge_pools_locked(entry, entry.nbytes)
         self._bump_peak(tier)
 
     # ---- OOM feedback ----------------------------------------------------
@@ -720,12 +803,19 @@ class MemoryGovernor:
                     continue
                 slot = tenants.setdefault(e.tenant, {"device": 0, "host": 0})
                 slot[e.tier] += e.nbytes
+            ids = self._engine_pool_ids()
             return {
                 "enabled": self._budget > 0,
                 "budget_bytes": self._budget,
+                "per_device_budget_bytes": (
+                    self._budget // len(ids) if ids else self._budget
+                ),
                 "high_watermark": self._high,
                 "low_watermark": self._low,
                 "tiers": dict(self._tier_bytes),
+                "device_pools": {
+                    int(d): int(self._device_bytes.get(d, 0.0)) for d in ids
+                },
                 "peak": dict(self._tier_peak),
                 "counters": dict(self.counters),
                 "live_frames": sum(

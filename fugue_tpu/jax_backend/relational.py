@@ -22,6 +22,7 @@ data). Null JOIN keys never match (SQL): rows with any null key get the
 out-of-range sentinel segment, so every occupancy/count test skips them.
 """
 
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -29,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 import pyarrow as pa
 
-from fugue_tpu.jax_backend import groupby
+from fugue_tpu.jax_backend import groupby, shuffle
 from fugue_tpu.jax_backend.blocks import (
     JaxBlocks,
     JaxColumn,
@@ -431,8 +432,13 @@ def expand_join(
         )
 
     # per-side match counts share the group-by strategy layer (matmul on
-    # accelerator tiers below the segment cap, scatter otherwise)
+    # accelerator tiers below the segment cap, scatter otherwise); on
+    # multi-device meshes the shuffle column of the strategy decision
+    # runs them as a map-side combine: each device counts its own rows
+    # and one reduce-scatter-layout all-to-all of partial counts gives
+    # every device its own segment range
     strat = engine._count_reduce_strategy(b1, S)
+    shuf = not is_cross and engine._join_shuffle(mesh, max(p1, p2), S)
 
     def _count_prog(
         seg1_: Any,
@@ -446,24 +452,40 @@ def expand_join(
         valid1 = groupby.materialize_validity(rv1, p1, n1)
         match2 = v2 if n2m is None else (v2 & ~n2m)
         seg2s = jnp.where(match2, seg2_, S)
-        c2 = groupby.segment_count(match2, seg2s, S, strat)
+        # right-side metadata (per-segment counts, exclusive starts,
+        # grouped order: stable, non-rows last). Multi-device shuffle:
+        # GSPMD replicates a global argsort onto every device; the fused
+        # local-sort + one-all-gather construction yields the identical
+        # enumeration with only local sorts and ONE partial-counts
+        # exchange feeding counts, starts and order alike
+        if shuf:
+            c2, cstart2, order2 = shuffle.sharded_grouped_order(
+                mesh, seg2s, S
+            )
+        else:
+            c2 = groupby.segment_count(match2, seg2s, S, strat)
+            cstart2 = shuffle.sharded_cumsum(mesh, c2) - c2
+            order2, _ = shuffle.grouped_sort(seg2s, S, p2)
         matchable1 = valid1 if n1m is None else (valid1 & ~n1m)
         m = jnp.where(matchable1, c2[jnp.clip(seg1_, 0, S - 1)], 0)
         reps = jnp.where(
             valid1, jnp.maximum(m, 1) if outer_left else m, 0
         )
         total = jnp.sum(reps)
-        start = jnp.cumsum(reps) - reps
-        # right side grouped by segment: stable order, non-rows last
-        order2 = jnp.argsort(seg2s, stable=True).astype(jnp.int32)
-        cstart2 = jnp.cumsum(c2) - c2
+        # sharded-axis prefix sum rides the two-level scan: GSPMD's own
+        # cumsum partitioning serializes across devices (see
+        # shuffle.sharded_cumsum)
+        start = shuffle.sharded_cumsum(mesh, reps) - reps
         if how != "fullouter":
             # the right-unmatched tail exists only for full outer — an
             # O(p1) segment_sum the other join types shouldn't pay
             zero = jnp.zeros((), jnp.int32)
             return m, start, order2, cstart2, total, zero, order2
-        c1 = groupby.segment_count(
-            matchable1, jnp.where(matchable1, seg1_, S), S, strat
+        seg1s = jnp.where(matchable1, seg1_, S)
+        c1 = (
+            shuffle.preagg_segment_count(mesh, matchable1, seg1s, S, strat)
+            if shuf
+            else groupby.segment_count(matchable1, seg1s, S, strat)
         )
         un2 = v2 & (
             ~match2 | (c1[jnp.clip(seg2_, 0, S - 1)] == 0)
@@ -472,8 +494,9 @@ def expand_join(
         order_un2 = jnp.argsort(~un2, stable=True).astype(jnp.int32)
         return m, start, order2, cstart2, total, r_total, order_un2
 
+    t0 = time.perf_counter() if shuf else 0.0
     m, start, order2, cstart2, total, r_total, order_un2 = engine._jit_cached(
-        ("join_count", how, S, p1, p2, tuple(keys), strat), _count_prog
+        ("join_count", how, S, p1, p2, tuple(keys), strat, shuf), _count_prog
     )(
         seg1,
         seg2,
@@ -483,6 +506,14 @@ def expand_join(
         null1,
         null2,
     )
+    if shuf:
+        # join counts are combinable: they ride the map-side-combine
+        # exchange (i32 partial counts), not the row shuffle
+        ndev_ = int(mesh.devices.size)
+        nbytes = shuffle.estimate_preagg_bytes(S, ndev_, 4)
+        if how == "fullouter":
+            nbytes *= 2
+        engine._count_shuffle("join", nbytes, time.perf_counter() - t0, False)
     # THE one host sync of the join: output cardinality
     M = int(total)
     R = int(r_total) if how == "fullouter" else 0
@@ -525,14 +556,19 @@ def expand_join(
         seg1_: Any,
     ) -> Tuple[Dict[str, Any], Dict[str, Any], Dict[str, Any], Dict[str, Any], Any]:
         t = jnp.arange(out_pad, dtype=jnp.int32)
-        # rows with zero matches scatter onto the NEXT row's start (same
-        # offset), so the duplicate marks accumulate and cumsum skips
-        # them — "drop" discards starts beyond the output (tail rows
-        # with zero matches)
-        marks = jnp.zeros((out_pad,), jnp.int32).at[start_].add(
-            1, mode="drop"
-        )
-        i = jnp.cumsum(marks) - 1
+        if int(mesh.devices.size) > 1:
+            # the scatter+scan's GSPMD partitioning all-reduces full
+            # output copies; per-shard binary search is collective-free
+            i = shuffle.sharded_expand_rows(mesh, start_, out_pad)
+        else:
+            # rows with zero matches scatter onto the NEXT row's start
+            # (same offset), so the duplicate marks accumulate and
+            # cumsum skips them — "drop" discards starts beyond the
+            # output (tail rows with zero matches)
+            marks = jnp.zeros((out_pad,), jnp.int32).at[start_].add(
+                1, mode="drop"
+            )
+            i = jnp.cumsum(marks) - 1
         i = jnp.clip(i, 0, p1 - 1)
         j_local = t - start_[i]
         matched = j_local < m_[i]
@@ -807,6 +843,95 @@ def _null_device_dtype(tp: pa.DataType) -> Any:
 
 
 @_mesh_scoped(0)
+def repartition_by_key(
+    engine: Any, blocks: JaxBlocks, keys: List[str]
+) -> Optional[JaxBlocks]:
+    """Explicit shuffle repartition: materialize a copy of ``blocks``
+    where every valid row lives on device ``segment(keys) % ndev``, via
+    ONE padded all-to-all (shuffle.shuffle_rows). Joins, group-bys and
+    distincts on the same keys then reduce purely device-locally —
+    matching keys are co-located per shard.
+
+    Row count, column dtypes, dictionaries and stats are preserved; only
+    placement and padded length change (the receive is padded to
+    ``ndev * padded_nrows``). Returns None when there is nothing to
+    co-locate (single-device mesh) or the frame is not fully on device —
+    callers fall back to the unshuffled frame."""
+    mesh = blocks.mesh
+    ndev = int(mesh.devices.size)
+    if ndev <= 1 or not blocks.all_on_device:
+        return None
+    for k in keys:
+        if k not in blocks.columns:
+            return None
+    fr = groupby.factorize_keys(blocks, keys)
+    pad_n = blocks.padded_nrows
+    names = sorted(blocks.columns)
+    mask_names = tuple(
+        n for n in names if blocks.columns[n].mask is not None
+    )
+
+    def _prog(
+        seg_: Any,
+        row_valid: Optional[Any],
+        nrows_s: Any,
+        datas_: Dict[str, Any],
+        masks_: Dict[str, Any],
+    ) -> Dict[str, Any]:
+        valid_ = groupby.materialize_validity(row_valid, pad_n, nrows_s)
+        arrays: Dict[str, Any] = {}
+        for n in names:
+            arrays[f"d:{n}"] = datas_[n]
+        for n in mask_names:
+            arrays[f"m:{n}"] = masks_[n]
+        _, marker, out = shuffle.shuffle_rows(mesh, seg_, valid_, arrays)
+        out["_valid"] = marker
+        return out
+
+    dtypes = tuple(str(blocks.columns[n].data.dtype) for n in names)
+    t0 = time.perf_counter()
+    outs = engine._jit_cached(
+        ("repartition", tuple(names), mask_names, dtypes, tuple(keys),
+         pad_n, ndev),
+        _prog,
+    )(
+        fr.seg,
+        blocks.row_valid,
+        _nrows_arg(blocks),
+        {n: blocks.columns[n].data for n in names},
+        {n: blocks.columns[n].mask for n in mask_names},
+    )
+    widths = sum(
+        blocks.columns[n].data.dtype.itemsize for n in names
+    ) + len(mask_names)
+    engine._count_shuffle(
+        "repartition",
+        shuffle.estimate_shuffle_bytes(pad_n, ndev, widths),
+        time.perf_counter() - t0,
+        False,
+    )
+    sharding = row_sharding(mesh)
+    out_cols: Dict[str, JaxColumn] = {}
+    for n in names:
+        src = blocks.columns[n]
+        out_cols[n] = JaxColumn(
+            src.pa_type,
+            jax.device_put(outs[f"d:{n}"], sharding),
+            jax.device_put(outs[f"m:{n}"], sharding)
+            if n in mask_names
+            else None,
+            src.dictionary,
+            src.stats,
+        )
+    return JaxBlocks(
+        blocks._nrows,
+        out_cols,
+        mesh,
+        row_valid=jax.device_put(outs["_valid"], sharding),
+        nrows_dev=blocks._nrows_dev,
+    )
+
+
 def union_all_blocks(b1: JaxBlocks, b2: JaxBlocks) -> JaxBlocks:
     """Concatenate two frames along the row axis. Padding rows of each side
     remain invalid under the combined mask — no compaction, no sync. All
@@ -948,7 +1073,7 @@ def intersect_subtract(
         segv1 = jnp.where(valid1, seg1, S)
         order = jnp.argsort(segv1, stable=True)
         c1 = groupby.segment_count(valid1, segv1, S + 1, strat)[:S]
-        starts = jnp.cumsum(c1) - c1
+        starts = shuffle.sharded_cumsum(b1.mesh, c1) - c1
         sseg = segv1[order]
         ordinal_sorted = pos - starts[jnp.clip(sseg, 0, S - 1)]
         ordinal = jnp.zeros((p1,), dtype=jnp.int32).at[order].set(

@@ -48,6 +48,9 @@ _PROBE_MAX_ROWS = 1 << 20
 _PROBE_MIN_ROWS = 1 << 14
 # below this many rows a probe sweep costs more than the op it tunes
 _AUTOTUNE_MIN_ROWS = 1 << 22
+# below this many padded rows the all-to-all shuffle's ndev-fold padded
+# receive costs more than the cross-device combine it removes
+_SHUFFLE_MIN_ROWS = 1 << 15
 
 
 def clear_cache() -> None:
@@ -99,6 +102,60 @@ def autotune_enabled(
             "bool or auto/true/false string"
         )
     return platform != "cpu" and rows >= _AUTOTUNE_MIN_ROWS
+
+
+def shuffle_mode(conf_value: Any, conf_key: str) -> str:
+    """Normalize a shuffle conf value to ``auto`` / ``on`` / ``off``.
+    Shared by ``fugue.jax.shuffle`` and ``fugue.jax.shuffle.overlap``;
+    a misspelled opt-out must not silently keep shuffling."""
+    v = conf_value
+    if isinstance(v, bool):
+        return "on" if v else "off"
+    if v is None:
+        return "auto"
+    s = str(v).strip().lower()
+    if s in ("true", "1", "always", "on"):
+        return "on"
+    if s in ("false", "0", "never", "off"):
+        return "off"
+    if s == "auto":
+        return "auto"
+    raise ValueError(
+        f"{conf_key}={conf_value!r} is not one of auto/on/off"
+    )
+
+
+def choose_shuffle(
+    mode: str, mesh: Any, rows: int, num_segments: int
+) -> bool:
+    """The devices-aware strategy column: should this segment reduction
+    repartition rows by key (all-to-all shuffle, shuffle.py) so each
+    device reduces only its own segments?
+
+    Single-device meshes never shuffle (there is nothing to co-locate).
+    ``on`` forces it on any multi-device mesh; ``auto`` additionally
+    requires the frame to be large enough to amortize the padded
+    receive and enough segments that every device owns some."""
+    ndev = int(mesh.devices.size)
+    if mode == "off" or ndev <= 1 or num_segments < 1:
+        return False
+    if mode == "on":
+        return True
+    return rows >= _SHUFFLE_MIN_ROWS and num_segments >= 2 * ndev
+
+
+def choose_overlap(mode: str, mesh: Any, num_segments: int) -> bool:
+    """Collective/compute overlap: double-buffer the next key-range's
+    all-to-all behind the current range's local reduction. Worth it
+    only where collectives are asynchronous (accelerator meshes — CPU
+    runs them inline, so the second pass is pure overhead) and when the
+    segment space splits into two non-trivial ranges."""
+    ndev = int(mesh.devices.size)
+    if mode == "off" or ndev <= 1 or num_segments < 2 * ndev:
+        return False
+    if mode == "on":
+        return True
+    return mesh.devices.flat[0].platform != "cpu"
 
 
 def choose_strategy(
@@ -216,7 +273,10 @@ def _measure(
 __all__ = [
     "STRATEGIES",
     "autotune_enabled",
+    "choose_overlap",
+    "choose_shuffle",
     "choose_strategy",
     "clear_cache",
     "heuristic_strategy",
+    "shuffle_mode",
 ]

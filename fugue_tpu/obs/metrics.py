@@ -48,6 +48,7 @@ METRIC_NAME_PREFIXES = (
     "fugue_stats_",
     "fugue_stream_",
     "fugue_workflow_",
+    "fugue_shuffle_",
 )
 
 COUNTER = "counter"

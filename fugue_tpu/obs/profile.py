@@ -254,6 +254,7 @@ _COUNTER_SURFACES = (
     ("plan_cache", "plan_cache_stats"),
     ("compile_cache", "compile_cache_stats"),
     ("fallbacks", "fallbacks"),
+    ("shuffle", "shuffle_counts"),
 )
 
 

@@ -66,8 +66,10 @@ import urllib.request
 from typing import Any, Dict, List, Optional, Tuple
 
 from fugue_tpu.constants import (
+    FUGUE_CONF_JAX_DEVICES,
     FUGUE_CONF_OPTIMIZE_CACHE_DIR,
     FUGUE_CONF_SERVE_FLEET_DEATH_THRESHOLD,
+    FUGUE_CONF_SERVE_FLEET_DEVICE_SLICES,
     FUGUE_CONF_SERVE_FLEET_HEALTH_INTERVAL,
     FUGUE_CONF_SERVE_FLEET_HOST,
     FUGUE_CONF_SERVE_FLEET_PORT,
@@ -851,16 +853,49 @@ class ServeFleet:
         else:
             result_dir = fs.join(self._base, "results")
         self._replica_ids = [f"r{i}" for i in range(n)]
+        device_slices = self._device_slices(n)
         self._replica_confs: Dict[str, ParamDict] = {}
-        for rid in self._replica_ids:
+        for i, rid in enumerate(self._replica_ids):
             rconf = ParamDict(self._conf)
             rconf[FUGUE_CONF_SERVE_STATE_PATH] = self.replica_state_path(rid)
             rconf[FUGUE_CONF_SERVE_FLEET_RESULT_CACHE_DIR] = result_dir
             rconf[FUGUE_CONF_SERVE_PORT] = 0  # ephemeral: never collide
+            if device_slices is not None:
+                rconf[FUGUE_CONF_JAX_DEVICES] = device_slices[i]
             self._replica_confs[rid] = rconf
         self._daemons: Dict[str, Any] = {}
         self._router = FleetRouter(self._conf)
         self._started = False
+
+    def _device_slices(self, n: int) -> Optional[List[str]]:
+        """With ``fugue.serve.fleet.device_slices`` on, carve
+        ``jax.devices()`` into ``n`` contiguous per-replica slices (each
+        replica's engine then builds its mesh over its own devices via
+        ``fugue.jax.devices`` — HBM and collectives fully isolated
+        between replicas). Requires at least one device per replica;
+        raises otherwise, since silently sharing devices would defeat
+        the isolation the knob asks for. Leftover devices (ndev not
+        divisible by n) go to the last replica."""
+        if not bool(
+            self._conf.get(FUGUE_CONF_SERVE_FLEET_DEVICE_SLICES, False)
+        ):
+            return None
+        import jax
+
+        ndev = len(jax.devices())
+        if ndev < n:
+            raise ValueError(
+                f"{FUGUE_CONF_SERVE_FLEET_DEVICE_SLICES}: {n} replicas "
+                f"need at least one device each, but only {ndev} "
+                "devices are visible"
+            )
+        per = ndev // n
+        out: List[str] = []
+        for i in range(n):
+            lo = i * per
+            hi = (i + 1) * per if i < n - 1 else ndev
+            out.append(",".join(str(d) for d in range(lo, hi)))
+        return out
 
     # ---- lifecycle -------------------------------------------------------
     def replica_state_path(self, rid: str) -> str:

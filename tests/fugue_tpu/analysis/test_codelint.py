@@ -54,6 +54,7 @@ def test_rule_registry_metadata():
     codes = {r.code for r in rules}
     assert codes == {
         "FLN101", "FLN102", "FLN103", "FLN104", "FLN105", "FLN106", "FLN107",
+        "FLN108",
     }
     for r in rules:
         assert r.code.startswith("FLN") and len(r.code) == 6
@@ -422,6 +423,50 @@ def test_known_sites_cover_every_embedded_fault_point():
 
     assert "serve.sweep" in KNOWN_SITES
     diags = [d for d in lint_tree() if d.code == "FLN107"]
+    assert diags == [], [d.describe() for d in diags]
+
+
+# ---------------------------------------------------------------------------
+# FLN108 — eager default-device placement on engine paths
+# ---------------------------------------------------------------------------
+_FLN108_FIXTURE = (
+    "import jax\n"
+    "import jax.numpy as jnp\n"
+    "import numpy as np\n"
+    "_BAD = jnp.arange(16)\n"              # line 4: import-time device alloc
+    "_OK = np.arange(16)\n"                # host-side constant is fine
+    "def put(x, sharding):\n"
+    "    a = jax.device_put(x)\n"          # line 7: no placement operand
+    "    b = jax.device_put(x, sharding)\n"
+    "    c = jnp.zeros((4,))\n"            # inside a function: fine
+    "    return a, b, c\n"
+    "class K:\n"
+    "    TABLE = jnp.zeros((2, 2))\n"      # line 12: class body runs at import
+)
+
+
+def test_fln108_eager_placement_on_engine_path():
+    hits = _find(
+        lint_text(_FLN108_FIXTURE, rel="fugue_tpu/jax_backend/fx.py"),
+        "FLN108",
+    )
+    assert {d.line for d in hits} == {4, 7, 12}
+    assert all(d.severity is Severity.ERROR for d in hits)
+    put_hit = [d for d in hits if d.line == 7][0]
+    assert "device_put" in put_hit.message
+    assert put_hit.qualname == "put"
+
+
+def test_fln108_scoped_to_jax_backend_and_live_tree_clean():
+    # other subsystems may build host/device arrays freely
+    assert not [
+        d
+        for d in lint_text(_FLN108_FIXTURE, rel="fugue_tpu/serve/fx.py")
+        if d.code == "FLN108"
+    ]
+    # and the shipped engine carries no eager placement (the rule's
+    # completeness direction, same contract as FLN107's)
+    diags = [d for d in lint_tree() if d.code == "FLN108"]
     assert diags == [], [d.describe() for d in diags]
 
 
