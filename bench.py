@@ -1922,6 +1922,119 @@ def _config9_continuous() -> Dict[str, Any]:
     return out
 
 
+def _config11_lake() -> Dict[str, Any]:
+    """Versioned table storage (ISSUE 17): optimistic-CAS commit
+    throughput under k concurrent writers (with the conflict-retry rate
+    the jittered backoff produces), the manifest-stats file-prune ratio
+    of a selective scan vs the footer-only baseline (every file opened),
+    and the scan speedup compaction buys on a many-small-files table."""
+    import tempfile
+    import threading
+
+    import numpy as np
+    import pandas as pd
+    import pyarrow as _pa
+
+    from fugue_tpu.lake import LakeTable
+
+    tmp = tempfile.mkdtemp(prefix="fugue_lake_bench_")
+    conf = {"fugue.lake.commit.backoff": 0.002,
+            "fugue.lake.commit.retries": 200}
+    out: Dict[str, Any] = {}
+
+    # -- commit throughput under k racing writers --------------------------
+    k_writers, per_writer = 4, 8
+    rows_per_commit = _scale(20_000)
+    rng = np.random.default_rng(17)
+
+    def batch(w: int, b: int) -> _pa.Table:
+        return _pa.Table.from_pandas(
+            pd.DataFrame(
+                {
+                    "w": np.full(rows_per_commit, w, dtype=np.int64),
+                    "t": np.arange(rows_per_commit, dtype=np.int64)
+                    + b * rows_per_commit,
+                    "v": rng.random(rows_per_commit),
+                }
+            ),
+            preserve_index=False,
+        )
+
+    tables = [LakeTable(tmp + "/commits", conf=conf)
+              for _ in range(k_writers)]
+
+    def writer(i: int) -> None:
+        for b in range(per_writer):
+            tables[i].append(batch(i, b))
+
+    threads = [
+        threading.Thread(target=writer, args=(i,)) for i in range(k_writers)
+    ]
+    t0 = time.perf_counter()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    commit_secs = time.perf_counter() - t0
+    commits = sum(t.counters["commits"] for t in tables)
+    conflicts = sum(t.counters["conflicts"] for t in tables)
+    head = LakeTable(tmp + "/commits")
+    assert head.current_version() == k_writers * per_writer
+    assert head.read_manifest(head.current_version()).rows == (
+        k_writers * per_writer * rows_per_commit
+    )
+    out["commit"] = {
+        "writers": k_writers,
+        "commits": commits,
+        "commits_per_sec": round(commits / commit_secs, 2),
+        "conflict_retries": conflicts,
+        "conflict_retry_rate": round(conflicts / commits, 3),
+    }
+
+    # -- manifest-stats file pruning vs footer-only ------------------------
+    # files are range-partitioned on t by construction (each commit owns
+    # a distinct t window), so a selective window predicate can prune
+    # whole files from the manifest without touching a parquet footer
+    lo = (per_writer - 1) * rows_per_commit  # only the LAST window
+    triples = [["t", ">=", lo]]
+    probe = LakeTable(tmp + "/commits")
+    probe.scan(pruning=triples)  # ONE scan: per-scan prune counters
+    scan_t = _timed(lambda: head.scan(pruning=triples), warm=1)
+    footer = LakeTable(tmp + "/commits")
+    full_t = _timed(lambda: footer.scan(), warm=1)
+    total_files = len(head.read_manifest(head.current_version()).files)
+    out["pruning"] = {
+        "files_total": total_files,
+        "files_pruned": probe.counters["files_pruned"],
+        "prune_ratio": round(
+            probe.counters["files_pruned"] / total_files, 3
+        ),
+        "pruned_scan_secs": round(scan_t, 4),
+        "footer_only_scan_secs": round(full_t, 4),
+        "speedup": round(full_t / scan_t, 2) if scan_t > 0 else 0.0,
+    }
+
+    # -- compaction scan speedup -------------------------------------------
+    frag = LakeTable(tmp + "/frag", conf=conf)
+    small_files, small_rows = 64, _scale(10_000) // 8
+    for i in range(small_files):
+        frag.append(
+            _pa.table({"k": np.full(small_rows, i, dtype=np.int64),
+                       "v": rng.random(small_rows)})
+        )
+    before = _timed(lambda: LakeTable(tmp + "/frag").scan(), warm=1)
+    m = frag.compact(target_rows=small_files * small_rows)
+    after = _timed(lambda: LakeTable(tmp + "/frag").scan(), warm=1)
+    out["compaction"] = {
+        "files_before": small_files,
+        "files_after": len(m.files),
+        "scan_secs_before": round(before, 4),
+        "scan_secs_after": round(after, 4),
+        "speedup": round(before / after, 2) if after > 0 else 0.0,
+    }
+    return out
+
+
 def _bench() -> Dict[str, Any]:
     headline = _bench_headline()
     configs = {
@@ -1936,6 +2049,7 @@ def _bench() -> Dict[str, Any]:
         "8_serving_fleet": _config8_serving_fleet(),
         "9_continuous": _config9_continuous(),
         "10_scaling": _config10_scaling(),
+        "11_lake": _config11_lake(),
     }
     headline["detail"]["configs"] = configs
     # the scaling curve's summary rides the headline contract: devices

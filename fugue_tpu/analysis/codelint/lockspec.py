@@ -60,6 +60,11 @@ CANONICAL_LOCK_ORDER = (
     "optimize.exec_cache._FN_HASH_LOCK",
     # leaf bookkeeping (held for O(1) mutations only; never nest)
     "jax.engine.JaxExecutionEngine._dispatch_secs_lock",
+    # lake-table bookkeeping: guards the cached head/manifest memo only.
+    # Commit/scan IO NEVER runs under it (snapshot-then-write, the same
+    # discipline FLN104 enforces for the journal helpers) — writers on
+    # different PROCESSES serialize through the manifest CAS, not locks
+    "lake.table.LakeTable._lock",
     "workflow.manifest.RunManifest._lock",
     "workflow.fault.RunStats._lock",
     "testing.faults._ACTIVE_LOCK",
@@ -74,6 +79,7 @@ LOCK_RANK = {name: i for i, name in enumerate(CANONICAL_LOCK_ORDER)}
 # package-relative path prefixes whose file IO must go through engine.fs
 ENGINE_FS_PATHS = (
     "fugue_tpu/serve/",
+    "fugue_tpu/lake/",
     "fugue_tpu/jax_backend/",
     "fugue_tpu/optimize/",
     "fugue_tpu/obs/",

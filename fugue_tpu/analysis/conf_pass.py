@@ -15,6 +15,7 @@ from fugue_tpu.analysis.diagnostics import (
     register_rule,
 )
 from fugue_tpu.constants import (
+    FUGUE_CONF_LAKE_SERVE_PATH,
     FUGUE_CONF_OBS_ENABLED,
     FUGUE_CONF_OBS_PROFILE,
     FUGUE_CONF_OBS_SLOW_QUERY_MS,
@@ -318,6 +319,68 @@ class StreamConfRule(Rule):
                 "(double-counted aggregates if the view was already "
                 "published) — set fugue.workflow.resume=true for "
                 "exactly-once restart",
+            )
+
+
+@register_rule
+class LakeConfRule(Rule):
+    code = "FWF507"
+    severity = Severity.WARN
+    description = (
+        "fugue.lake.* keys set but nothing reads or writes a lake:// "
+        "table (inert), or AS OF time travel against a non-lake path"
+    )
+
+    def check(self, ctx: Any) -> Iterable[Diagnostic]:
+        from fugue_tpu.extensions import builtins as _b
+        from fugue_tpu.lake.format import is_lake_uri
+
+        def _task_path(t: Any) -> Any:
+            p = t.params.get("path", None)
+            if isinstance(p, (list, tuple)):
+                p = p[0] if p else None
+            return p if isinstance(p, str) else None
+
+        touches_lake = False
+        for t in ctx.tasks:
+            if t.extension not in (_b.Load, _b.Save):
+                continue
+            path = _task_path(t)
+            if path is not None and is_lake_uri(path):
+                touches_lake = True
+            if t.extension is _b.Load:
+                params = dict(t.params.get("params", None) or {})
+                pinned = [
+                    k for k in ("version", "timestamp") if k in params
+                ]
+                if pinned and path is not None and not is_lake_uri(path):
+                    yield self.diag(
+                        f"AS OF ({'/'.join(pinned)}) on load of "
+                        f"'{path}': time travel only applies to lake:// "
+                        "tables — a plain file path has no snapshot "
+                        "history, so this load fails at run time "
+                        "(prefix the path with lake:// or drop AS OF)",
+                        t,
+                    )
+        lake_keys = sorted(
+            k for k in ctx.conf.keys() if k.startswith("fugue.lake.")
+        )
+        if not lake_keys:
+            return
+        # fugue.lake.serve.path anchors lake usage by itself: it turns
+        # on the serve sessions' lake-backed durable tables, which no
+        # workflow task would reveal
+        serve_path = str(
+            ctx.conf.get(FUGUE_CONF_LAKE_SERVE_PATH, "") or ""
+        ).strip()
+        if serve_path != "" or touches_lake:
+            return
+        for key in lake_keys:
+            yield self.diag(
+                f"'{key}' is set but no task loads or saves a lake:// "
+                "table and fugue.lake.serve.path is empty: the key is "
+                "silently inert — point a LOAD/SAVE at a lake:// URI "
+                "(or drop the fugue.lake.* keys)",
             )
 
 

@@ -141,6 +141,49 @@ def _wf_streaming() -> Any:
     return dag
 
 
+def _wf_lake() -> Any:
+    """The versioned-table shape (ISSUE 17): a lake:// read with AS OF
+    time travel feeding a filter the optimizer turns into pruning
+    triples, plus a transactional append back into another lake table —
+    compiled under the ``fugue.lake.*`` conf a serving deployment
+    carries (the serve path anchors the keys, so FWF507 stays silent).
+    Analyzer and EXPLAIN legs must both render it clean. The builder
+    also seeds the memory-fs table up to version 3 (idempotent), so the
+    workflow is RUNNABLE — the optimizer parity gate executes every
+    corpus entry, and the AS OF pin stays stable across the appends
+    each run commits on top."""
+    import pyarrow as pa
+
+    from fugue_tpu.column.expressions import col
+    from fugue_tpu.lake import LakeTable
+    from fugue_tpu.workflow.workflow import FugueWorkflow
+
+    seed = LakeTable("memory://selftest/lake/events")
+    while seed.current_version() < 3:
+        i = seed.current_version()
+        seed.append(
+            pa.table(
+                {
+                    "k": pa.array([i, i + 1], pa.int32()),
+                    "v": pa.array([float(i), i + 1.5], pa.float64()),
+                }
+            )
+        )
+
+    dag = FugueWorkflow(
+        {
+            "fugue.lake.commit.retries": 8,
+            "fugue.lake.commit.backoff": 0.02,
+            "fugue.lake.serve.path": "memory://selftest/lake",
+        }
+    )
+    events = dag.load("lake://memory://selftest/lake/events", version=3)
+    events.filter(col("v") > 1.0).yield_dataframe_as("asof_view")
+    fresh = dag.df([[0, 1.0], [1, 2.0]], "k:int,v:double")
+    fresh.save("lake://memory://selftest/lake/events", mode="append")
+    return dag
+
+
 WORKFLOW_BUILDERS: Dict[str, Callable[[], Any]] = {
     "transform": _wf_transform,
     "relational": _wf_relational,
@@ -149,6 +192,7 @@ WORKFLOW_BUILDERS: Dict[str, Callable[[], Any]] = {
     "deep_chain_50": _wf_deep_chain,
     "join_filter_narrow": _wf_join_filter_narrow,
     "streaming_pipeline": _wf_streaming,
+    "lake_versioned": _wf_lake,
 }
 
 

@@ -107,6 +107,10 @@ FUGUE_CONF_STREAM_INTERVAL = "fugue.stream.interval"
 FUGUE_CONF_STREAM_WATERMARK_DELAY = "fugue.stream.watermark.delay"
 FUGUE_CONF_STREAM_MAX_FILES = "fugue.stream.max_files_per_batch"
 FUGUE_CONF_STREAM_BATCH_ROWS = "fugue.stream.batch_rows"
+FUGUE_CONF_LAKE_COMMIT_RETRIES = "fugue.lake.commit.retries"
+FUGUE_CONF_LAKE_COMMIT_BACKOFF = "fugue.lake.commit.backoff"
+FUGUE_CONF_LAKE_COMPACT_TARGET_ROWS = "fugue.lake.compact.target_rows"
+FUGUE_CONF_LAKE_SERVE_PATH = "fugue.lake.serve.path"
 
 FUGUE_COMPILE_TIME_CONFIGS = {
     FUGUE_CONF_WORKFLOW_EXCEPTION_HIDE,
@@ -845,6 +849,45 @@ def _declare_defaults() -> None:
         0,
         "rows per host chunk when folding one parquet file "
         "(0 = pyarrow's record-batch default)",
+        in_defaults=False,
+    )
+    # versioned table storage (fugue_tpu/lake): snapshot-isolated tables
+    # of immutable parquet data files + a _meta/ manifest log, committed
+    # through an optimistic CAS on the next manifest slot. Module-owned
+    # (read via typed_conf_get, not seeded); FWF507 warns about inert
+    # fugue.lake.* keys and AS OF reads against non-lake paths.
+    r(
+        FUGUE_CONF_LAKE_COMMIT_RETRIES,
+        int,
+        10,
+        "optimistic-commit attempts before a LakeCommitConflict "
+        "propagates (each retry rebases on the new table head)",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_LAKE_COMMIT_BACKOFF,
+        float,
+        0.05,
+        "base seconds of linear backoff between lake commit retries "
+        "(attempt k sleeps ~k*backoff with jitter)",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_LAKE_COMPACT_TARGET_ROWS,
+        int,
+        1_000_000,
+        "rows per rewritten data file when compaction coalesces "
+        "streamed micro-batch files into larger ones",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_LAKE_SERVE_PATH,
+        str,
+        "",
+        "base dir/URI for lake-backed serve tables: session save_table "
+        "commits each materialized view as a shared versioned table "
+        "under <path>/<name> any replica can query ('' = per-session "
+        "parquet artifacts, the pre-lake behavior)",
         in_defaults=False,
     )
     # runtime lock-order sanitizer (testing/locktrace.py): debug-only.

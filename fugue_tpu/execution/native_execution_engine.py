@@ -478,8 +478,16 @@ class NativeExecutionEngine(ExecutionEngine):
     ) -> LocalBoundedDataFrame:
         # optimizer-attached row-group pruning is a jax-ingest hint; the
         # native path ignores it (the downstream filter re-applies the
-        # predicate, so dropping the hint is always correct)
-        kwargs.pop("pruning", None)
+        # predicate, so dropping the hint is always correct) — EXCEPT on
+        # lake:// paths, where the triples prune WHOLE FILES from
+        # manifest stats before any footer is read, which is free on any
+        # engine
+        pruning = kwargs.pop("pruning", None)
+        first = path if isinstance(path, str) else path[0]
+        from fugue_tpu.lake.format import is_lake_uri
+
+        if pruning and is_lake_uri(first):
+            kwargs["pruning"] = pruning
         return _io.load_df(path, format_hint, columns, fs=self.fs, **kwargs)
 
     def save_df(

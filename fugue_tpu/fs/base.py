@@ -199,6 +199,27 @@ class VirtualFileSystem(ABC):
             fp.write(data)
         self.rm(src)
 
+    def write_file_if_absent(
+        self, path: str, writer: Callable[[BinaryIO], None]
+    ) -> None:
+        """Fail-if-exists single-file write: the optimistic compare-and-
+        swap primitive versioned-table commits are built on. Exactly one
+        of N concurrent callers targeting the same path succeeds; every
+        loser gets ``FileExistsError`` and NO bytes of the loser's
+        payload are ever visible. Like :meth:`write_file_atomic`, a
+        reader never observes a torn file.
+
+        This default stages through a hidden temp then performs an
+        exists-check + rename — atomic only as far as the backend's
+        primitives allow. Backends with a native all-or-nothing
+        "create exclusive" (local ``os.link``, memory's single-lock
+        commit, object-store ``If-None-Match``) MUST override; the
+        conformance suite (``fugue_tpu_test/fs_suite.py``) races
+        concurrent writers against the contract."""
+        if self.exists(path):
+            raise FileExistsError(path)
+        self.write_file_atomic(path, writer)
+
     def list_chronological(
         self, path: str, pattern: str = "*"
     ) -> List[FileInfo]:
@@ -325,6 +346,16 @@ class FileSystemRegistry:
         fault_point("fs.write", uri)
         fs, path = self.resolve(uri)
         fs.write_file_atomic(path, writer)
+
+    def write_file_if_absent(
+        self, uri: str, writer: Callable[[BinaryIO], None]
+    ) -> None:
+        """Fail-if-exists write (the CAS primitive — see the backend
+        method). Raises ``FileExistsError`` when the target already
+        exists; exactly one of N concurrent writers wins."""
+        fault_point("fs.write", uri)
+        fs, path = self.resolve(uri)
+        fs.write_file_if_absent(path, writer)
 
     def exists(self, uri: str) -> bool:
         fs, path = self.resolve(uri)

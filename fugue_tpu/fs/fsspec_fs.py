@@ -6,7 +6,7 @@ Registered as the ``"*"`` fallback so explicit builtin schemes
 when absent the fallback registration is skipped and unknown schemes
 raise the registry's NotImplementedError instead of an import error."""
 
-from typing import Any, BinaryIO, List
+from typing import Any, BinaryIO, Callable, List
 
 from fugue_tpu.fs.base import FileInfo, VirtualFileSystem, register_filesystem
 
@@ -107,6 +107,29 @@ class FsspecFileSystem(VirtualFileSystem):
 
     def glob(self, pattern: str) -> List[str]:
         return sorted(str(p) for p in self._fs.glob(self._q(pattern)))
+
+    def write_file_if_absent(
+        self, path: str, writer: Callable[[BinaryIO], None]
+    ) -> None:
+        # ADVISORY on generic object stores: fsspec exposes no portable
+        # exclusive-create, so this is exists-check + exclusive local
+        # semantics where the backend honors mode "xb", else check +
+        # atomic write. Stores with conditional puts (GCS
+        # if-generation-match, S3 If-None-Match) should get a dedicated
+        # backend for contended multi-writer commit paths; single-writer
+        # and low-contention uses are safe here.
+        p = self._q(path)
+        if self._fs.exists(p):
+            raise FileExistsError(f"{self.scheme}://{p}")
+        try:
+            fp = self._fs.open(p, "xb")
+        except (NotImplementedError, ValueError):
+            self.write_file_atomic(path, writer)
+            return
+        except FileExistsError:
+            raise FileExistsError(f"{self.scheme}://{p}")
+        with fp:
+            writer(fp)
 
     def pyarrow_native(self) -> Any:
         """Object stores skip the python FileSystemHandler shim: pyarrow

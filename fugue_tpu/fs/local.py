@@ -3,7 +3,8 @@
 import glob as _glob
 import os
 import shutil
-from typing import BinaryIO, List
+from typing import BinaryIO, Callable, List
+from uuid import uuid4
 
 from fugue_tpu.fs.base import FileInfo, VirtualFileSystem, register_filesystem
 
@@ -57,6 +58,38 @@ class LocalFileSystem(VirtualFileSystem):
 
     def rename(self, src: str, dst: str) -> None:
         os.replace(src, dst)
+
+    def write_file_if_absent(
+        self, path: str, writer: Callable[[BinaryIO], None]
+    ) -> None:
+        # stage the full payload into a hidden temp, then publish with
+        # os.link: link(2) is atomic AND fails with EEXIST when the
+        # target exists, so of N racing writers exactly one wins and a
+        # reader only ever sees a complete winner. The 'xb' fallback
+        # covers filesystems without hard links (FAT, some network
+        # mounts) — there the create is exclusive but the bytes stream
+        # in after it, which is still safe for dot/underscore-skipping
+        # readers and single-read-after-commit consumers.
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        base = os.path.basename(path)
+        tmp = os.path.join(parent, f".{base}.cas-{uuid4().hex[:8]}")
+        try:
+            with open(tmp, "wb") as fp:
+                writer(fp)
+            try:
+                os.link(tmp, path)
+            except OSError as ex:
+                if isinstance(ex, FileExistsError):
+                    raise
+                # hard links unsupported: exclusive-create fallback
+                with open(path, "xb") as out, open(tmp, "rb") as src:
+                    shutil.copyfileobj(src, out)
+        finally:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
 
     def glob(self, pattern: str) -> List[str]:
         return sorted(_glob.glob(pattern))

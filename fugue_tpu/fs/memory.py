@@ -198,6 +198,33 @@ class MemoryFileSystem(VirtualFileSystem):
                 return
             raise FileNotFoundError(f"memory://{s}")
 
+    def write_file_if_absent(
+        self, path: str, writer: Callable[[BinaryIO], None]
+    ) -> None:
+        # the whole check-absent-then-publish runs as ONE critical
+        # section under the store lock at commit time, so two racing
+        # writers serialize: the loser's fully-buffered payload is
+        # discarded and FileExistsError raised — a true CAS
+        p = _norm(path)
+
+        def commit(data: bytes) -> None:
+            with _LOCK:
+                if p in _FILES:
+                    raise FileExistsError(f"memory://{p}")
+                _FILES[p] = data
+                _MTIMES[p] = time.time()
+                for d in _parents(p):
+                    _DIRS.add(d)
+                    _MTIMES.setdefault(d, _MTIMES[p])
+
+        fp = _WriteBuffer(commit)
+        try:
+            writer(fp)
+        except BaseException:
+            fp.abort()
+            raise
+        fp.close()
+
     def write_file_atomic(self, path: str, writer: Callable[[BinaryIO], None]) -> None:
         # the commit-on-close buffer IS the atomic swap; no temp object.
         # A failing writer ABORTS the buffer — partial bytes must never

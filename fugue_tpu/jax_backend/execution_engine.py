@@ -49,6 +49,7 @@ from fugue_tpu.dataframe import (
     DataFrame,
     LocalDataFrame,
 )
+from fugue_tpu.lake import format as _lake_io
 from fugue_tpu.obs.trace import start_span
 from fugue_tpu.testing.locktrace import tracked_lock
 from fugue_tpu.execution.execution_engine import (
@@ -1842,6 +1843,19 @@ class JaxExecutionEngine(ExecutionEngine):
         # downstream filter re-applies the predicate, so ignoring them
         # on the eager path is always correct)
         pruning = kwargs.pop("pruning", None)
+        first = path if isinstance(path, str) else path[0]
+        if _lake_io.is_lake_uri(first):
+            # lake reads resolve a SNAPSHOT (version/timestamp) and prune
+            # whole files from manifest stats — forward the triples; the
+            # row-group streaming path doesn't apply to manifest-driven
+            # multi-file reads
+            from fugue_tpu.utils import io as _io
+
+            local = _io.load_df(
+                path, format_hint, columns, fs=self.fs,
+                pruning=pruning, **kwargs
+            )
+            return self.to_df(local)
         batch_rows = int(self.conf.get(FUGUE_CONF_JAX_IO_BATCH_ROWS, 0))
         if batch_rows > 0:
             from fugue_tpu.jax_backend import ingest
@@ -1873,6 +1887,14 @@ class JaxExecutionEngine(ExecutionEngine):
         jdf: JaxDataFrame = self.to_df(df)  # type: ignore
         batch_rows = int(self.conf.get(FUGUE_CONF_JAX_IO_BATCH_ROWS, 0))
         partition_cols = _io.spec_partition_cols(partition_spec, force_single)
+        if _lake_io.is_lake_uri(path):
+            # lake saves are transactional manifest commits, not file
+            # replacement — the pipelined row-group writer doesn't apply
+            _io.save_df(
+                jdf.as_local_bounded(), path, format_hint, mode,
+                partition_cols=partition_cols, fs=self.fs, **kwargs,
+            )
+            return
         if batch_rows > 0:
             # pipelined save (fugue.jax.io.pipeline): row-group writes of
             # chunk k overlap the device->host fetch of chunk k+1, so the

@@ -1,0 +1,30 @@
+"""Transactional versioned table storage — the lakehouse catalog.
+
+``lake://<path>[?version=N|timestamp=T]`` URIs address snapshot-isolated
+tables built from immutable parquet data files plus a write-once
+manifest log; commits go through the fs layer's fail-if-exists CAS so
+any number of fleet replicas and standing pipelines write safely.
+See format.py (layout) and table.py (commit protocol).
+"""
+
+from fugue_tpu.lake.format import (
+    LakeCommitConflict,
+    LakeCompactionConflict,
+    LakeError,
+    Manifest,
+    format_lake_uri,
+    is_lake_uri,
+    parse_lake_uri,
+)
+from fugue_tpu.lake.table import LakeTable
+
+__all__ = [
+    "LakeCommitConflict",
+    "LakeCompactionConflict",
+    "LakeError",
+    "LakeTable",
+    "Manifest",
+    "format_lake_uri",
+    "is_lake_uri",
+    "parse_lake_uri",
+]

@@ -1,0 +1,432 @@
+"""The lake table format: URIs, manifests, per-column statistics.
+
+A lake table is a directory on any ``engine.fs`` backend::
+
+    <root>/data/part-<uuid>-<seq>.parquet   immutable data files
+    <root>/_meta/manifest-<version>.json    the commit log (one per snapshot)
+    <root>/_meta/_head.json                 head-version HINT (best effort)
+
+Each ``manifest-<V>.json`` is a complete snapshot description: the
+current field list (stable integer field ids — the rename/widen anchor)
+and every live data file with per-column stats (min/max, null count,
+distinct estimate) plus row/byte counts. The MANIFEST CHAIN is the
+truth: writing ``manifest-(V+1).json`` through the fs layer's
+fail-if-exists CAS *is* the commit point, so of N racing writers exactly
+one owns version V+1 and the losers re-read the new head and retry.
+``_head.json`` is only a probe hint — it may lag, never lead.
+
+Snapshots are immutable by construction (data files are never rewritten
+in place, manifests are write-once), which is what makes ``AS OF``
+reads deterministic and result-cacheable.
+
+URI scheme: ``lake://<underlying-path-or-URI>[?version=N|timestamp=T]``
+— e.g. ``lake:///warehouse/events``, ``lake://memory://tables/t1?version=3``.
+The prefix is stripped before any fs call; the remainder is the table
+root on whatever backend it names.
+"""
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+import pyarrow as pa
+
+from fugue_tpu.schema import parse_type, type_to_expr
+from fugue_tpu.utils.assertion import assert_or_throw
+
+LAKE_URI_PREFIX = "lake://"
+
+#: manifest file name pattern (zero-padded so name order == version order)
+MANIFEST_FMT = "manifest-%010d.json"
+HEAD_FILE = "_head.json"
+META_DIR = "_meta"
+DATA_DIR = "data"
+
+
+class LakeError(Exception):
+    """Base class for lake-format errors."""
+
+
+class LakeCommitConflict(LakeError):
+    """An optimistic commit lost the CAS on its manifest slot more times
+    than the retry budget allows. Classified TRANSIENT by the workflow
+    fault classifier (the fix is re-read head + retry, not a traceback)."""
+
+
+class LakeCompactionConflict(LakeError):
+    """A concurrent overwrite/compaction removed files this compaction
+    meant to rewrite; the plan is stale and must be rebuilt from the
+    new head."""
+
+
+def is_lake_uri(path: Any) -> bool:
+    return isinstance(path, str) and path.startswith(LAKE_URI_PREFIX)
+
+
+def parse_lake_uri(uri: str) -> Tuple[str, Dict[str, Any]]:
+    """``"lake://memory://t/x?version=3"`` ->
+    ``("memory://t/x", {"version": 3})``. Recognized query keys:
+    ``version`` (int) and ``timestamp`` (float epoch seconds)."""
+    assert_or_throw(is_lake_uri(uri), ValueError(f"not a lake URI: {uri!r}"))
+    rest = uri[len(LAKE_URI_PREFIX):]
+    params: Dict[str, Any] = {}
+    if "?" in rest:
+        rest, qs = rest.split("?", 1)
+        for part in qs.split("&"):
+            if not part:
+                continue
+            key, _, value = part.partition("=")
+            if key == "version":
+                params["version"] = int(value)
+            elif key == "timestamp":
+                params["timestamp"] = float(value)
+            else:
+                raise ValueError(
+                    f"unknown lake URI query key {key!r} in {uri!r} "
+                    "(expected version=N or timestamp=T)"
+                )
+    assert_or_throw(
+        rest.strip() != "", ValueError(f"empty table path in {uri!r}")
+    )
+    return rest, params
+
+
+def format_lake_uri(table_uri: str, version: Optional[int] = None) -> str:
+    """The canonical pinned form: ``lake://<root>?version=N``."""
+    base = f"{LAKE_URI_PREFIX}{table_uri}"
+    return base if version is None else f"{base}?version={int(version)}"
+
+
+# ---- fields & schema evolution ---------------------------------------------
+
+class LakeField:
+    """One table column: a STABLE integer id plus the current name and
+    type. Renames change ``name`` under the same id; widenings change
+    ``type``; data files map ids to the name/type they were written
+    with, so old snapshots resolve old files forever."""
+
+    def __init__(self, field_id: int, name: str, type_expr: str):
+        self.id = int(field_id)
+        self.name = str(name)
+        self.type_expr = str(type_expr)
+
+    @property
+    def pa_type(self) -> pa.DataType:
+        return parse_type(self.type_expr)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"id": self.id, "name": self.name, "type": self.type_expr}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "LakeField":
+        return cls(d["id"], d["name"], d["type"])
+
+
+# widening lattice: a type may evolve to any type RIGHT of it in its row
+# (int widening, float widening, int -> double). Everything else is a
+# conflict the append must surface, not silently coerce.
+_WIDEN_CHAINS = (
+    ["byte", "short", "int", "long"],
+    ["float", "double"],
+)
+
+
+def widens_to(old_expr: str, new_expr: str) -> bool:
+    """True when ``old`` may evolve to ``new`` without data loss."""
+    if old_expr == new_expr:
+        return True
+    for chain in _WIDEN_CHAINS:
+        if old_expr in chain and new_expr in chain:
+            return chain.index(old_expr) < chain.index(new_expr)
+    # integer -> double is allowed (pandas/arrow aggregate convention)
+    if old_expr in _WIDEN_CHAINS[0] and new_expr == "double":
+        return True
+    return False
+
+
+def merge_fields(
+    current: List[LakeField], incoming: pa.Schema
+) -> List[LakeField]:
+    """Schema-evolve ``current`` against an appended batch's schema:
+    same-name columns must match or widen (widening updates the field
+    type in place), unseen columns get fresh ids appended, and columns
+    the batch omits stay (null-filled at read). Raises on a
+    non-widenable type change."""
+    by_name = {f.name: f for f in current}
+    next_id = max((f.id for f in current), default=0) + 1
+    out = [LakeField(f.id, f.name, f.type_expr) for f in current]
+    for field in incoming:
+        expr = type_to_expr(field.type)
+        cur = by_name.get(field.name)
+        if cur is None:
+            out.append(LakeField(next_id, field.name, expr))
+            next_id += 1
+            continue
+        tgt = next(f for f in out if f.id == cur.id)
+        if widens_to(cur.type_expr, expr):
+            tgt.type_expr = expr  # widen in place
+        elif not widens_to(expr, cur.type_expr):
+            raise LakeError(
+                f"column {field.name!r} cannot evolve from "
+                f"{cur.type_expr} to {expr}: only int/float widening is "
+                "a schema evolution; anything else needs an explicit "
+                "overwrite"
+            )
+        # narrower incoming data is fine: it casts up to the current
+        # type at read time
+    return out
+
+
+def overwrite_fields(
+    current: List[LakeField], incoming: pa.Schema
+) -> List[LakeField]:
+    """Field list after an OVERWRITE: only the incoming columns survive,
+    but same-name columns KEEP their ids (so rename history and old
+    snapshots still resolve), and any type change is allowed — replacing
+    the contents is the explicit escape hatch ``merge_fields`` points
+    non-widenable changes at."""
+    by_name = {f.name: f for f in current}
+    next_id = max((f.id for f in current), default=0) + 1
+    out: List[LakeField] = []
+    for field in incoming:
+        expr = type_to_expr(field.type)
+        cur = by_name.get(field.name)
+        if cur is None:
+            out.append(LakeField(next_id, field.name, expr))
+            next_id += 1
+        else:
+            out.append(LakeField(cur.id, field.name, expr))
+    return out
+
+
+# ---- per-column statistics -------------------------------------------------
+
+def _json_scalar(v: Any) -> Any:
+    """Stats values must survive JSON round-trips; anything exotic
+    (timestamps, decimals, binary) is dropped rather than corrupted."""
+    if isinstance(v, bool) or v is None:
+        return v
+    if isinstance(v, (int, str)):
+        return v
+    if isinstance(v, float):
+        return v if v == v and v not in (float("inf"), float("-inf")) else None
+    return None
+
+
+def column_stats(table: pa.Table) -> Dict[str, Dict[str, Any]]:
+    """min/max, null count and a distinct estimate per column of one
+    data file's content. The distinct estimate comes from the same
+    dictionary-style uniqueness pass streamed ingest builds (arrow's
+    ``count_distinct``) — the catalog statistic the cost-based
+    optimizer prunes files and sizes joins with."""
+    import pyarrow.compute as pc
+
+    out: Dict[str, Dict[str, Any]] = {}
+    for i, field in enumerate(table.schema):
+        col = table.column(i)
+        stats: Dict[str, Any] = {
+            "nulls": int(col.null_count),
+            "min": None,
+            "max": None,
+            "distinct": None,
+        }
+        try:
+            mm = pc.min_max(col)
+            stats["min"] = _json_scalar(mm["min"].as_py())
+            stats["max"] = _json_scalar(mm["max"].as_py())
+        except pa.ArrowNotImplementedError:
+            pass
+        try:
+            stats["distinct"] = int(
+                pc.count_distinct(col, mode="only_valid").as_py()
+            )
+        except pa.ArrowNotImplementedError:
+            pass
+        out[field.name] = stats
+    return out
+
+
+_PRUNE_OPS = {">", ">=", "<", "<=", "==", "="}
+
+
+def stats_exclude_file(
+    stats: Optional[Dict[str, Any]], op: str, literal: Any
+) -> bool:
+    """True when a file's column stats PROVE no row satisfies
+    ``col <op> literal`` — the whole-file analog of row-group pruning,
+    answered from the manifest without opening a footer. Conservative:
+    missing/partial stats never exclude. NULL rows never satisfy a
+    comparison, so they don't block exclusion."""
+    if not stats or op not in _PRUNE_OPS:
+        return False
+    lo, hi = stats.get("min"), stats.get("max")
+    if not isinstance(lo, (int, float)) or isinstance(lo, bool):
+        return False
+    if not isinstance(hi, (int, float)) or isinstance(hi, bool):
+        return False
+    if not isinstance(literal, (int, float)) or isinstance(literal, bool):
+        return False
+    if op == ">":
+        return hi <= literal
+    if op == ">=":
+        return hi < literal
+    if op == "<":
+        return lo >= literal
+    if op == "<=":
+        return lo > literal
+    return literal < lo or literal > hi  # == / =
+
+
+# ---- data files & manifests ------------------------------------------------
+
+class DataFileEntry:
+    """One immutable parquet file of a snapshot. ``columns`` maps the
+    table's FIELD ID (as a string — JSON keys) to the column's
+    name/type AS WRITTEN in this file plus its stats; read resolution
+    renames/casts/null-fills from this mapping to the snapshot schema."""
+
+    def __init__(
+        self,
+        path: str,
+        rows: int,
+        nbytes: int,
+        columns: Dict[str, Dict[str, Any]],
+    ):
+        self.path = str(path)  # RELATIVE to the table root
+        self.rows = int(rows)
+        self.nbytes = int(nbytes)
+        self.columns = columns
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "path": self.path,
+            "rows": self.rows,
+            "bytes": self.nbytes,
+            "columns": self.columns,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "DataFileEntry":
+        return cls(
+            d["path"], d["rows"], d["bytes"], dict(d.get("columns") or {})
+        )
+
+    @classmethod
+    def from_pending(
+        cls, pending: Dict[str, Any], fields: List[LakeField]
+    ) -> "DataFileEntry":
+        """Bind a name-keyed pending file (see
+        :meth:`pending_file`) to field IDS under ``fields`` — done PER
+        COMMIT ATTEMPT, not at write time, because a rebase against a
+        concurrent commit can change which id a new column lands on."""
+        by_name = {f.name: f for f in fields}
+        columns: Dict[str, Dict[str, Any]] = {}
+        for name, meta in pending["by_name"].items():
+            columns[str(by_name[name].id)] = {"name": name, **meta}
+        return cls(pending["path"], pending["rows"], pending["bytes"], columns)
+
+
+def pending_file(path: str, nbytes: int, table: pa.Table) -> Dict[str, Any]:
+    """A written-but-uncommitted data file, stats keyed by COLUMN NAME
+    (field-id binding happens at commit time — see
+    :meth:`DataFileEntry.from_pending`)."""
+    stats = column_stats(table)
+    return {
+        "path": str(path),
+        "rows": int(table.num_rows),
+        "bytes": int(nbytes),
+        "by_name": {
+            f.name: {"type": type_to_expr(f.type), **stats[f.name]}
+            for f in table.schema
+        },
+    }
+
+
+class Manifest:
+    """One committed snapshot: the version, its full field list and its
+    full live-file list (self-contained — no log replay needed), plus
+    the optional idempotence token of the writer that produced it."""
+
+    def __init__(
+        self,
+        version: int,
+        parent: int,
+        timestamp: float,
+        operation: str,
+        fields: List[LakeField],
+        files: List[DataFileEntry],
+        writer: Optional[Dict[str, Any]] = None,
+    ):
+        self.version = int(version)
+        self.parent = int(parent)
+        self.timestamp = float(timestamp)
+        self.operation = str(operation)
+        self.fields = fields
+        self.files = files
+        self.writer = writer
+        #: sha256 of the serialized payload (filled at commit/read time)
+        self.sha256: Optional[str] = None
+
+    @property
+    def rows(self) -> int:
+        return sum(f.rows for f in self.files)
+
+    @property
+    def schema(self) -> pa.Schema:
+        return pa.schema(
+            [pa.field(f.name, f.pa_type) for f in self.fields]
+        )
+
+    def field_by_name(self, name: str) -> Optional[LakeField]:
+        for f in self.fields:
+            if f.name == name:
+                return f
+        return None
+
+    def to_payload(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "format": "fugue-lake/1",
+            "version": self.version,
+            "parent": self.parent,
+            "timestamp": self.timestamp,
+            "operation": self.operation,
+            "fields": [f.to_dict() for f in self.fields],
+            "files": [f.to_dict() for f in self.files],
+        }
+        if self.writer is not None:
+            out["writer"] = dict(self.writer)
+        return out
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(
+            self.to_payload(), sort_keys=True, separators=(",", ":")
+        ).encode("utf-8")
+
+    @classmethod
+    def from_payload(cls, d: Dict[str, Any]) -> "Manifest":
+        assert_or_throw(
+            str(d.get("format", "")).startswith("fugue-lake/"),
+            LakeError(f"not a lake manifest: format={d.get('format')!r}"),
+        )
+        m = cls(
+            d["version"],
+            d.get("parent", 0),
+            d.get("timestamp", 0.0),
+            d.get("operation", "append"),
+            [LakeField.from_dict(f) for f in (d.get("fields") or [])],
+            [DataFileEntry.from_dict(f) for f in (d.get("files") or [])],
+            writer=d.get("writer"),
+        )
+        return m
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "version": self.version,
+            "operation": self.operation,
+            "timestamp": self.timestamp,
+            "files": len(self.files),
+            "rows": self.rows,
+            "bytes": sum(f.nbytes for f in self.files),
+            "schema": ",".join(
+                f"{f.name}:{f.type_expr}" for f in self.fields
+            ),
+        }

@@ -1,0 +1,662 @@
+"""Snapshot-isolated versioned tables over ``engine.fs``.
+
+:class:`LakeTable` is the transactional surface of the lake format
+(see format.py for the on-disk layout). The commit protocol is
+two-phase optimistic concurrency:
+
+1. WRITE PHASE (no coordination): data files go to ``data/`` under
+   attempt-agnostic unique names through ``write_file_atomic``. An
+   uncommitted file is invisible — no manifest references it — so a
+   crash here costs garbage bytes, never correctness.
+2. COMMIT PHASE (the CAS loop): read the head, build
+   ``manifest-(V+1).json`` against it, and publish through the fs
+   layer's ``write_file_if_absent``. Exactly one of N racing writers
+   owns slot V+1; losers re-read the new head, REBASE and retry with
+   jittered linear backoff. Appends rebase trivially (their files are
+   disjoint by construction, field ids re-bind against the new head's
+   schema); compaction rebases only if its rewrite set survived intact,
+   else it aborts with :class:`LakeCompactionConflict` and replans.
+
+The retry budget exhausting raises :class:`LakeCommitConflict`, which
+the workflow fault classifier treats as TRANSIENT — a task-level retry
+re-reads the head and usually wins.
+
+Exactly-once for streaming: a writer may tag commits with
+``writer_id``/``writer_batch``. Before each attempt the recent manifest
+chain is scanned for that id at >= that batch; a hit means the batch
+already committed (the writer crashed between its lake commit and its
+own progress record) and the existing manifest is returned instead of
+appending twice — the same dedupe contract Delta's ``txn`` action
+gives streaming sinks.
+
+``fault_point("lake.commit"/"lake.compact", table_uri)`` sit exactly at
+the commit decision points so the chaos harness can kill or fail a
+writer at its most vulnerable instant; the manifest CAS makes every
+outcome either "old snapshot" or "new snapshot", never torn.
+"""
+
+import hashlib
+import io
+import json
+import random
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import pyarrow as pa
+
+from fugue_tpu.constants import (
+    FUGUE_CONF_LAKE_COMMIT_BACKOFF,
+    FUGUE_CONF_LAKE_COMMIT_RETRIES,
+    FUGUE_CONF_LAKE_COMPACT_TARGET_ROWS,
+    typed_conf_get,
+)
+from fugue_tpu.fs import FileSystemRegistry, uri_basename
+from fugue_tpu.lake.format import (
+    DATA_DIR,
+    HEAD_FILE,
+    MANIFEST_FMT,
+    META_DIR,
+    _PRUNE_OPS,
+    DataFileEntry,
+    LakeCommitConflict,
+    LakeCompactionConflict,
+    LakeError,
+    LakeField,
+    Manifest,
+    merge_fields,
+    overwrite_fields,
+    pending_file,
+    stats_exclude_file,
+)
+from fugue_tpu.testing.faults import fault_point
+from fugue_tpu.testing.locktrace import tracked_lock
+from fugue_tpu.utils.assertion import assert_or_throw
+
+#: how far back the writer-dedupe scan walks the manifest chain before
+#: giving up (bounds commit cost on long histories; a streaming writer
+#: that lost 200 commits of ground is not "recently crashed")
+_DEDUPE_SCAN_LIMIT = 200
+
+#: manifest memo cap — manifests are immutable so the cache is safe;
+#: the cap only bounds memory on very long time-travel walks
+_MANIFEST_CACHE_CAP = 128
+
+
+def _uuid_token() -> str:
+    from uuid import uuid4
+
+    return uuid4().hex[:12]
+
+
+class LakeTable:
+    """One versioned table rooted at ``table_uri`` (scheme-less path or
+    any registered fs URI — NOT the ``lake://`` wrapper; parse that with
+    :func:`fugue_tpu.lake.parse_lake_uri` first).
+
+    Thread/process safety: ``_lock`` guards only the in-memory manifest
+    memo (O(1) get/put). All correctness across threads, processes and
+    fleet replicas comes from the manifest CAS — two LakeTable instances
+    on two machines are exactly as safe as one.
+    """
+
+    def __init__(
+        self,
+        table_uri: str,
+        fs: Optional[FileSystemRegistry] = None,
+        conf: Optional[Dict[str, Any]] = None,
+        metrics: Optional[Any] = None,
+    ):
+        from fugue_tpu.utils.io import default_fs
+
+        self._uri = table_uri.rstrip("/")
+        self._fs = fs if fs is not None else default_fs()
+        conf = conf or {}
+        self._retries = typed_conf_get(conf, FUGUE_CONF_LAKE_COMMIT_RETRIES)
+        self._backoff = typed_conf_get(conf, FUGUE_CONF_LAKE_COMMIT_BACKOFF)
+        self._compact_target = typed_conf_get(
+            conf, FUGUE_CONF_LAKE_COMPACT_TARGET_ROWS
+        )
+        self._lock = tracked_lock("lake.table.LakeTable._lock")
+        self._manifest_memo: Dict[int, Manifest] = {}
+        #: plain counters for benches/tests (metrics registry optional)
+        self.counters: Dict[str, int] = {
+            "commits": 0,
+            "conflicts": 0,
+            "dedupe_hits": 0,
+            "files_scanned": 0,
+            "files_pruned": 0,
+        }
+        self._metrics = metrics
+        if metrics is not None:
+            self._m_commits = metrics.counter(
+                "fugue_lake_commits_total",
+                "committed lake snapshots by operation",
+                ["operation"],
+            )
+            self._m_conflicts = metrics.counter(
+                "fugue_lake_commit_conflicts_total",
+                "lost manifest CAS races (each one retried)",
+            )
+            self._m_pruned = metrics.counter(
+                "fugue_lake_files_pruned_total",
+                "data files skipped via manifest stats before any footer read",
+            )
+            self._m_scanned = metrics.counter(
+                "fugue_lake_files_scanned_total",
+                "data files actually opened by lake scans",
+            )
+
+    # ---- paths -----------------------------------------------------------
+
+    @property
+    def uri(self) -> str:
+        return self._uri
+
+    def _meta_uri(self, name: str) -> str:
+        return self._fs.join(self._uri, META_DIR, name)
+
+    def _manifest_uri(self, version: int) -> str:
+        return self._meta_uri(MANIFEST_FMT % version)
+
+    # ---- head discovery --------------------------------------------------
+
+    def current_version(self) -> int:
+        """The latest committed version (0 = table does not exist).
+        Reads the ``_head.json`` hint, falls back to a ``_meta`` listing
+        when the hint is missing/stale, then probes FORWARD — the hint
+        may lag the truth (best-effort write) but the probe always lands
+        on the real head."""
+        from fugue_tpu.workflow.manifest import read_json
+
+        hint = read_json(self._fs, self._meta_uri(HEAD_FILE)) or {}
+        try:
+            v = int(hint.get("version", 0) or 0)
+        except (TypeError, ValueError):
+            v = 0
+        if v > 0 and not self._fs.exists(self._manifest_uri(v)):
+            v = 0  # stale or corrupt hint: rebuild from the listing
+        if v == 0:
+            v = self._max_listed_version()
+        while self._fs.exists(self._manifest_uri(v + 1)):
+            v += 1
+        return v
+
+    def _max_listed_version(self) -> int:
+        meta = self._fs.join(self._uri, META_DIR)
+        if not self._fs.exists(meta):
+            return 0
+        best = 0
+        for name in self._fs.listdir(meta):
+            base = uri_basename(name)
+            if base.startswith("manifest-") and base.endswith(".json"):
+                try:
+                    best = max(best, int(base[len("manifest-"):-len(".json")]))
+                except ValueError:
+                    continue
+        return best
+
+    def exists(self) -> bool:
+        return self.current_version() > 0
+
+    # ---- manifest reads --------------------------------------------------
+
+    def read_manifest(self, version: int) -> Manifest:
+        with self._lock:
+            hit = self._manifest_memo.get(version)
+        if hit is not None:
+            return hit
+        raw = self._fs.read_bytes(self._manifest_uri(version))
+        m = Manifest.from_payload(json.loads(raw.decode("utf-8")))
+        m.sha256 = hashlib.sha256(raw).hexdigest()
+        assert_or_throw(
+            m.version == version,
+            LakeError(
+                f"manifest {version} of {self._uri} claims version "
+                f"{m.version}"
+            ),
+        )
+        with self._lock:
+            if len(self._manifest_memo) >= _MANIFEST_CACHE_CAP:
+                self._manifest_memo.pop(min(self._manifest_memo))
+            self._manifest_memo[version] = m
+        return m
+
+    def snapshot(
+        self,
+        version: Optional[int] = None,
+        timestamp: Optional[float] = None,
+    ) -> Manifest:
+        """Resolve an ``AS OF`` target to a concrete manifest: a pinned
+        version, the newest snapshot committed at-or-before a timestamp,
+        or (neither given) the current head."""
+        assert_or_throw(
+            version is None or timestamp is None,
+            ValueError("give AS OF a version OR a timestamp, not both"),
+        )
+        head = self.current_version()
+        assert_or_throw(
+            head > 0, FileNotFoundError(f"lake table not found: {self._uri}")
+        )
+        if version is not None:
+            assert_or_throw(
+                0 < int(version) <= head,
+                LakeError(
+                    f"version {version} of {self._uri} does not exist "
+                    f"(head is {head})"
+                ),
+            )
+            return self.read_manifest(int(version))
+        if timestamp is None:
+            return self.read_manifest(head)
+        v = head
+        while v > 0:
+            m = self.read_manifest(v)
+            if m.timestamp <= float(timestamp):
+                return m
+            v = m.parent
+        raise LakeError(
+            f"no snapshot of {self._uri} at or before timestamp {timestamp}"
+        )
+
+    def _head_or_none(self) -> Optional[Manifest]:
+        v = self.current_version()
+        return self.read_manifest(v) if v > 0 else None
+
+    def history(self, limit: int = 50) -> List[Dict[str, Any]]:
+        """Newest-first snapshot descriptions (version, operation,
+        rows/files/bytes, schema) — the audit view."""
+        out: List[Dict[str, Any]] = []
+        v = self.current_version()
+        while v > 0 and len(out) < limit:
+            m = self.read_manifest(v)
+            out.append(m.describe())
+            v = m.parent
+        return out
+
+    # ---- write phase -----------------------------------------------------
+
+    def _write_data_file(self, table: pa.Table, seq: int, token: str
+                         ) -> Dict[str, Any]:
+        import pyarrow.parquet as pq
+
+        rel = f"{DATA_DIR}/part-{token}-{seq:03d}.parquet"
+        sink = io.BytesIO()
+        pq.write_table(table, sink)
+        data = sink.getvalue()
+        self._fs.write_file_atomic(
+            self._fs.join(self._uri, rel), lambda fp: fp.write(data)
+        )
+        return pending_file(rel, len(data), table)
+
+    def _write_tables(self, tables: Sequence[pa.Table]) -> List[Dict[str, Any]]:
+        token = _uuid_token()
+        return [
+            self._write_data_file(t, i, token)
+            for i, t in enumerate(tables)
+            if t.num_rows > 0
+        ]
+
+    # ---- commit phase ----------------------------------------------------
+
+    def _commit(
+        self,
+        build: Any,
+        writer_id: Optional[str] = None,
+        writer_batch: Optional[int] = None,
+        writer_meta: Optional[Dict[str, Any]] = None,
+    ) -> Manifest:
+        """The CAS loop. ``build(base, version)`` makes the candidate
+        manifest for one attempt (called fresh per attempt so rebases
+        see the latest head); publishing it via fail-if-exists IS the
+        commit. Returns the committed (or deduped) manifest."""
+        attempts = max(1, int(self._retries) + 1)
+        for attempt in range(attempts):
+            base = self._head_or_none()
+            if writer_id is not None and writer_batch is not None:
+                dup = self._find_writer_commit(base, writer_id, writer_batch)
+                if dup is not None:
+                    self.counters["dedupe_hits"] += 1
+                    return dup
+            version = (base.version if base is not None else 0) + 1
+            manifest = build(base, version)
+            if writer_id is not None and writer_batch is not None:
+                manifest.writer = {
+                    **(writer_meta or {}),
+                    "id": str(writer_id),
+                    "batch": int(writer_batch),
+                }
+            raw = manifest.to_bytes()
+            # the chaos harness's kill/fail window: an injected fault or
+            # hard kill HERE must leave the table at the previous
+            # snapshot with the retry converging — the parity tests'
+            # whole point
+            fault_point("lake.commit", self._uri)
+            try:
+                self._fs.write_file_if_absent(
+                    self._manifest_uri(version), lambda fp: fp.write(raw)
+                )
+            except FileExistsError:
+                self.counters["conflicts"] += 1
+                if self._metrics is not None:
+                    self._m_conflicts.labels().inc()
+                if attempt + 1 < attempts:
+                    # jittered linear backoff so k racing writers fan
+                    # out instead of re-colliding in lockstep
+                    time.sleep(
+                        self._backoff
+                        * (attempt + 1)
+                        * random.uniform(0.5, 1.5)
+                    )
+                continue
+            manifest.sha256 = hashlib.sha256(raw).hexdigest()
+            self.counters["commits"] += 1
+            if self._metrics is not None:
+                self._m_commits.labels(operation=manifest.operation).inc()
+            with self._lock:
+                if len(self._manifest_memo) >= _MANIFEST_CACHE_CAP:
+                    self._manifest_memo.pop(min(self._manifest_memo))
+                self._manifest_memo[version] = manifest
+            self._write_head_hint(version)
+            return manifest
+        raise LakeCommitConflict(
+            f"lost the manifest CAS on {self._uri} {attempts} times "
+            f"(head kept moving); classified transient — a task-level "
+            f"retry re-reads the head and rebases"
+        )
+
+    def _write_head_hint(self, version: int) -> None:
+        """Best effort: a failure here only slows the next reader's
+        forward probe, never changes what the head IS."""
+        try:
+            data = json.dumps({"version": int(version)}).encode("utf-8")
+            self._fs.write_file_atomic(
+                self._meta_uri(HEAD_FILE), lambda fp: fp.write(data)
+            )
+        except Exception:  # noqa: BLE001  (hint only; CAS is the truth)
+            pass
+
+    def _find_writer_commit(
+        self, head: Optional[Manifest], writer_id: str, writer_batch: int
+    ) -> Optional[Manifest]:
+        v = head.version if head is not None else 0
+        scanned = 0
+        while v > 0 and scanned < _DEDUPE_SCAN_LIMIT:
+            m = self.read_manifest(v) if v != getattr(head, "version", -1) \
+                else head
+            w = m.writer or {}
+            if w.get("id") == writer_id:
+                try:
+                    if int(w.get("batch", -1)) >= int(writer_batch):
+                        return m
+                except (TypeError, ValueError):
+                    pass
+            v = m.parent
+            scanned += 1
+        return None
+
+    # ---- public write operations ----------------------------------------
+
+    def append(
+        self,
+        table: pa.Table,
+        writer_id: Optional[str] = None,
+        writer_batch: Optional[int] = None,
+        writer_meta: Optional[Dict[str, Any]] = None,
+    ) -> Manifest:
+        """Append rows as new files. Concurrent appends auto-merge: the
+        files are disjoint by construction, so a rebase just re-binds
+        field ids against the new head and stacks on top.
+        ``writer_id``/``writer_batch`` make the append IDEMPOTENT (see
+        the module docstring); ``writer_meta`` rides along in the
+        writer token (e.g. a streaming sink's source-file list, the
+        recovery anchor for a crash between lake append and progress
+        commit)."""
+        pendings = self._write_tables([table])
+
+        def build(base: Optional[Manifest], version: int) -> Manifest:
+            base_fields = base.fields if base is not None else []
+            fields = merge_fields(base_fields, table.schema)
+            entries = [DataFileEntry.from_pending(p, fields) for p in pendings]
+            files = (list(base.files) if base is not None else []) + entries
+            return Manifest(
+                version,
+                base.version if base is not None else 0,
+                time.time(),
+                "append" if base is not None else "create",
+                fields,
+                files,
+            )
+
+        return self._commit(build, writer_id, writer_batch, writer_meta)
+
+    def find_writer_commit(
+        self, writer_id: str, writer_batch: int
+    ) -> Optional[Manifest]:
+        """The committed manifest of an idempotent writer's batch (>=
+        the given number), or None — how a restarted streaming sink
+        discovers a DANGLING append (lake commit landed, the writer's
+        own progress record did not)."""
+        return self._find_writer_commit(
+            self._head_or_none(), writer_id, int(writer_batch)
+        )
+
+    def overwrite(self, table: pa.Table) -> Manifest:
+        """Replace the table's contents (and, if needed, its schema —
+        the escape hatch for non-widenable changes). On conflict the
+        overwrite LOSES and retries against the new head: last
+        overwrite wins, appends racing it land either before (replaced)
+        or after (kept) — a linear history either way."""
+        pendings = self._write_tables([table])
+
+        def build(base: Optional[Manifest], version: int) -> Manifest:
+            base_fields = base.fields if base is not None else []
+            fields = overwrite_fields(base_fields, table.schema)
+            entries = [DataFileEntry.from_pending(p, fields) for p in pendings]
+            return Manifest(
+                version,
+                base.version if base is not None else 0,
+                time.time(),
+                "overwrite" if base is not None else "create",
+                fields,
+                entries,
+            )
+
+        return self._commit(build)
+
+    def rename_column(self, old: str, new: str) -> Manifest:
+        """Metadata-only rename under the stable field id: no data file
+        moves, old snapshots keep the old name, old FILES resolve under
+        the new name forever."""
+
+        def build(base: Optional[Manifest], version: int) -> Manifest:
+            assert_or_throw(
+                base is not None,
+                FileNotFoundError(f"lake table not found: {self._uri}"),
+            )
+            assert_or_throw(
+                base.field_by_name(old) is not None,
+                LakeError(f"no column {old!r} in {self._uri}"),
+            )
+            assert_or_throw(
+                base.field_by_name(new) is None,
+                LakeError(f"column {new!r} already exists in {self._uri}"),
+            )
+            fields = [
+                LakeField(f.id, new if f.name == old else f.name, f.type_expr)
+                for f in base.fields
+            ]
+            return Manifest(
+                version, base.version, time.time(), "evolve",
+                fields, list(base.files),
+            )
+
+        return self._commit(build)
+
+    def compact(self, target_rows: Optional[int] = None) -> Optional[Manifest]:
+        """Rewrite small files into ~``target_rows`` files and commit
+        the swap as a NORMAL snapshot — time travel to pre-compaction
+        versions still reads the original files (nothing is deleted).
+        Concurrent appends rebase cleanly (their files are kept);
+        a concurrent overwrite invalidates the rewrite set and raises
+        :class:`LakeCompactionConflict` (re-plan from the new head).
+        Returns None when there is nothing to merge."""
+        base = self._head_or_none()
+        if base is None or len(base.files) <= 1:
+            return None
+        target = int(target_rows or self._compact_target)
+        fault_point("lake.compact", self._uri)
+        merged = self._read_snapshot(base, None, None)
+        chunks: List[pa.Table] = []
+        if merged.num_rows == 0:
+            chunks = []
+        else:
+            for start in range(0, merged.num_rows, target):
+                chunks.append(merged.slice(start, target))
+        pendings = self._write_tables(chunks)
+        rewritten = {f.path for f in base.files}
+
+        def build(head: Optional[Manifest], version: int) -> Manifest:
+            assert_or_throw(
+                head is not None,
+                LakeCompactionConflict(f"{self._uri} disappeared mid-compact"),
+            )
+            live = {f.path for f in head.files}
+            if not rewritten <= live:
+                raise LakeCompactionConflict(
+                    f"compaction of {self._uri} planned at v{base.version} "
+                    f"but a concurrent overwrite removed some of its input "
+                    f"files; re-plan from v{head.version}"
+                )
+            entries = [
+                DataFileEntry.from_pending(p, head.fields) for p in pendings
+            ]
+            kept = [f for f in head.files if f.path not in rewritten]
+            return Manifest(
+                version, head.version, time.time(), "compact",
+                head.fields, entries + kept,
+            )
+
+        return self._commit(build)
+
+    # ---- reads -----------------------------------------------------------
+
+    def scan(
+        self,
+        columns: Optional[Sequence[str]] = None,
+        version: Optional[int] = None,
+        timestamp: Optional[float] = None,
+        pruning: Optional[Sequence[Sequence[Any]]] = None,
+    ) -> pa.Table:
+        """Read a snapshot as one arrow table, resolving schema
+        evolution (renames by field id, null-fill for pre-addition
+        files, upcast for widened types) and pruning WHOLE FILES from
+        manifest stats before any parquet footer is touched.
+        ``pruning`` is the optimizer's conjunctive ``[col, op, literal]``
+        triples — the same shape row-group pruning consumes; surviving
+        rows are NOT filtered here, the engine's filter still runs."""
+        m = self.snapshot(version=version, timestamp=timestamp)
+        return self._read_snapshot(m, columns, pruning)
+
+    def _read_snapshot(
+        self,
+        m: Manifest,
+        columns: Optional[Sequence[str]],
+        pruning: Optional[Sequence[Sequence[Any]]],
+    ) -> pa.Table:
+        if columns:
+            sel: List[LakeField] = []
+            for name in columns:
+                f = m.field_by_name(name)
+                assert_or_throw(
+                    f is not None,
+                    LakeError(
+                        f"no column {name!r} in {self._uri} "
+                        f"v{m.version}"
+                    ),
+                )
+                sel.append(f)  # type: ignore[arg-type]
+        else:
+            sel = list(m.fields)
+        out_schema = pa.schema([pa.field(f.name, f.pa_type) for f in sel])
+        parts: List[pa.Table] = []
+        for entry in m.files:
+            if pruning and self._file_excluded(entry, m, pruning):
+                self.counters["files_pruned"] += 1
+                if self._metrics is not None:
+                    self._m_pruned.labels().inc()
+                continue
+            self.counters["files_scanned"] += 1
+            if self._metrics is not None:
+                self._m_scanned.labels().inc()
+            parts.append(self._read_file(entry, sel, out_schema))
+        if not parts:
+            return out_schema.empty_table()
+        return pa.concat_tables(parts)
+
+    def _file_excluded(
+        self,
+        entry: DataFileEntry,
+        m: Manifest,
+        triples: Sequence[Sequence[Any]],
+    ) -> bool:
+        for triple in triples:
+            if len(triple) != 3:
+                continue
+            col, op, lit = triple
+            f = m.field_by_name(str(col))
+            if f is None:
+                continue
+            st = entry.columns.get(str(f.id))
+            if st is None:
+                # the file predates this column: every row is NULL and
+                # NULL never satisfies a comparison -> whole file out
+                if op in _PRUNE_OPS:
+                    return True
+                continue
+            if stats_exclude_file(st, str(op), lit):
+                return True
+        return False
+
+    def _read_file(
+        self,
+        entry: DataFileEntry,
+        sel: List[LakeField],
+        out_schema: pa.Schema,
+    ) -> pa.Table:
+        import pyarrow.parquet as pq
+
+        # which selected fields exist in THIS file, under which name
+        in_file: Dict[int, str] = {}
+        for f in sel:
+            meta = entry.columns.get(str(f.id))
+            if meta is not None:
+                in_file[f.id] = meta["name"]
+        if in_file:
+            raw = self._fs.read_bytes(self._fs.join(self._uri, entry.path))
+            t = pq.read_table(
+                pa.BufferReader(raw), columns=list(in_file.values())
+            )
+            nrows = t.num_rows
+        else:
+            t = None
+            nrows = entry.rows
+        arrays: List[Any] = []
+        for f in sel:
+            name = in_file.get(f.id)
+            if name is None or t is None:
+                arrays.append(pa.nulls(nrows, f.pa_type))
+                continue
+            col = t.column(name)
+            if col.type != f.pa_type:
+                col = col.cast(f.pa_type)
+            arrays.append(col)
+        return pa.Table.from_arrays(arrays, schema=out_schema)
+
+    # ---- maintenance -----------------------------------------------------
+
+    def describe(self) -> Dict[str, Any]:
+        head = self.current_version()
+        out: Dict[str, Any] = {"uri": self._uri, "version": head}
+        if head > 0:
+            out.update(self.read_manifest(head).describe())
+        return out

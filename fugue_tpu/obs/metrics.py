@@ -49,6 +49,7 @@ METRIC_NAME_PREFIXES = (
     "fugue_stream_",
     "fugue_workflow_",
     "fugue_shuffle_",
+    "fugue_lake_",
 )
 
 COUNTER = "counter"

@@ -199,6 +199,33 @@ def is_pure_task(task: FugueTask, frame_inputs_stable: bool = False) -> bool:
     return any(ext is p for p in _PURE_EXTENSIONS)
 
 
+def _is_pinned_lake_load(task: FugueTask) -> bool:
+    """A ``lake://`` load pinned to an explicit VERSION reads a
+    write-once manifest: the snapshot can never change under the same
+    key, so it is safe for a cross-request result cache. Timestamp pins
+    stay uncacheable — their resolution depends on commit-clock
+    monotonicity the format does not promise."""
+    if task.extension is not _b.Load:
+        return False
+    path = task.params.get("path", None)
+    if isinstance(path, (list, tuple)):
+        path = path[0] if path else None
+    if not isinstance(path, str):
+        return False
+    from fugue_tpu.lake.format import is_lake_uri, parse_lake_uri
+
+    if not is_lake_uri(path):
+        return False
+    try:
+        _, pin = parse_lake_uri(path)
+    except Exception:
+        return False
+    params = dict(task.params.get("params", None) or {})
+    if "timestamp" in params or "timestamp" in pin:
+        return False
+    return "version" in params or "version" in pin
+
+
 def tasks_are_pure(
     tasks: List[FugueTask], frame_inputs_stable: bool = False
 ) -> bool:
@@ -208,11 +235,13 @@ def tasks_are_pure(
     effects). ``Load`` is rejected here even though CSE treats it as
     pure WITHIN one run: a cross-request cache keyed by task uuid would
     keep serving stale rows after the external file changes on disk
-    (file content is not epoch-tracked the way session tables are)."""
+    (file content is not epoch-tracked the way session tables are). The
+    one exception is a version-pinned ``lake://`` load (``AS OF <v>``):
+    the pinned snapshot is immutable by construction."""
     return all(
         is_pure_task(t, frame_inputs_stable)
         and not isinstance(t, OutputTask)
-        and t.extension is not _b.Load
+        and (t.extension is not _b.Load or _is_pinned_lake_load(t))
         for t in tasks
     )
 
@@ -620,6 +649,12 @@ def _is_parquet_load(task: FugueTask) -> bool:
         path = path[0] if path else None
     if not isinstance(path, str):
         return False
+    from fugue_tpu.lake.format import is_lake_uri
+
+    if is_lake_uri(path):
+        # lake tables are parquet underneath, and the pruning triples
+        # additionally skip WHOLE FILES from manifest stats
+        return True
     fmt = task.params.get("fmt", "") or None
     try:
         return infer_format(path, fmt) == "parquet"

@@ -27,6 +27,7 @@ import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 from fugue_tpu.dataframe import DataFrame
+from fugue_tpu.lake.format import format_lake_uri, is_lake_uri, parse_lake_uri
 from fugue_tpu.testing.faults import fault_point
 from fugue_tpu.testing.locktrace import tracked_lock
 from fugue_tpu.utils.assertion import assert_or_throw
@@ -168,6 +169,11 @@ class ServeSession:
         uri = rec.get("artifact") or self._journal.table_artifact_uri(
             self.session_id, name
         )
+        if is_lake_uri(uri):
+            # lake-backed tables are SHARED versioned tables: a session
+            # closing forgets its pinned-snapshot record, never the data
+            # (other replicas/pipelines may hold other versions live)
+            return
         try:
             if self._engine.fs.exists(uri):
                 self._engine.fs.rm(uri, recursive=True)
@@ -220,6 +226,10 @@ class ServeSession:
         never the request — the catalog save already succeeded."""
         if self._journal is None:
             return
+        lake_base = self._lake_serve_base()
+        if lake_base:
+            self._journal_table_lake(name, df, lake_base)
+            return
         uri = self._journal.table_artifact_uri(self.session_id, name)
         prior = (self._artifacts.get(name) or {}).get("artifact")
         try:
@@ -247,6 +257,58 @@ class ServeSession:
             except Exception:  # pragma: no cover - best-effort cleanup
                 pass
 
+    def _lake_serve_base(self) -> str:
+        """``fugue.lake.serve.path``: when set, durable session tables
+        commit to SHARED versioned lake tables under this base instead
+        of per-session parquet artifacts — a materialized view saved on
+        one replica becomes a snapshot any replica (or any offline
+        reader) loads by pinned version."""
+        from fugue_tpu.constants import (
+            FUGUE_CONF_LAKE_SERVE_PATH,
+            typed_conf_get,
+        )
+
+        try:
+            conf = getattr(self._engine, "conf", None) or {}
+            return str(typed_conf_get(conf, FUGUE_CONF_LAKE_SERVE_PATH) or "")
+        except Exception:  # pragma: no cover - conf shape surprises
+            return ""
+
+    def _journal_table_lake(
+        self, name: str, df: DataFrame, lake_base: str
+    ) -> None:
+        """Lake-backed durability: overwrite-commit the frame into
+        ``<base>/<name>`` and journal a record pinned to the COMMITTED
+        VERSION — ``{"artifact": "lake://...?version=V", "sha256":
+        <manifest sha>}``. The sha doubles as the fleet result cache's
+        content key, and the pin means a restart reloads exactly what
+        was saved even if the shared table has moved on since."""
+        from fugue_tpu.lake import LakeTable
+
+        table_uri = self._engine.fs.join(lake_base, name)
+        try:
+            with engine_dispatch_guard(self._engine, None):
+                local = df.as_local_bounded().as_arrow(type_safe=True)
+            lt = LakeTable(
+                table_uri, fs=self._engine.fs,
+                conf=getattr(self._engine, "conf", None) or {},
+            )
+            manifest = lt.overwrite(local)
+        except Exception as ex:
+            self._engine.log.warning(
+                "fugue_tpu serve: lake commit for table %s.%s failed "
+                "(%s: %s); table is hot but will not survive a restart",
+                self.session_id, name, type(ex).__name__, ex,
+            )
+            return
+        rec = {
+            "artifact": format_lake_uri(table_uri, manifest.version),
+            "size": sum(f.nbytes for f in manifest.files),
+            "sha256": manifest.sha256,
+        }
+        self._artifacts[name] = dict(rec)
+        self._journal.record_table(self.session_id, name, rec)
+
     def _claim_tenant(self, loaded: DataFrame) -> None:
         gov = getattr(self._engine, "memory_governor", None)
         blocks = getattr(loaded, "native", None)
@@ -264,15 +326,43 @@ class ServeSession:
             return None
         uri = rec["artifact"]
         fs = self._engine.fs
-        try:
-            ok = fs.exists(uri)
-            if ok and rec.get("sha256"):
-                size, digest = artifact_fingerprint(fs, uri)
-                ok = digest == rec["sha256"] and (
-                    rec.get("size") is None or size == rec["size"]
+        if is_lake_uri(uri):
+            # pinned lake snapshot: the integrity check is the MANIFEST
+            # sha (manifests are write-once, so a matching sha proves the
+            # whole snapshot: every data file is content-addressed by it)
+            try:
+                from fugue_tpu.lake import LakeTable
+
+                table_uri, pin = parse_lake_uri(uri)
+                m = LakeTable(table_uri, fs=fs).read_manifest(
+                    int(pin["version"])
                 )
-        except Exception:
-            ok = False
+                ok = not rec.get("sha256") or m.sha256 == rec["sha256"]
+            except Exception:
+                ok = False
+            if not ok:
+                # forget the record but NEVER remove shared lake data
+                self.integrity_rejected += 1
+                self._engine.log.warning(
+                    "fugue_tpu serve: table %s.%s lake snapshot %s failed "
+                    "the integrity check on restart reload; dropping the "
+                    "record",
+                    self.session_id, name, uri,
+                )
+                self._durable.pop(name, None)
+                if self._journal is not None:
+                    self._journal.forget_table(self.session_id, name)
+                return None
+        else:
+            try:
+                ok = fs.exists(uri)
+                if ok and rec.get("sha256"):
+                    size, digest = artifact_fingerprint(fs, uri)
+                    ok = digest == rec["sha256"] and (
+                        rec.get("size") is None or size == rec["size"]
+                    )
+            except Exception:
+                ok = False
         if not ok:
             # same policy as manifest resume: a corrupt artifact is
             # removed and never served — the table is forgotten rather

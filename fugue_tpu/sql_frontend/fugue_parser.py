@@ -210,10 +210,47 @@ class FugueSQLCompiler:
             fmt = cur.advance().value.lower()
         path = self._path(cur)
         params = self._opt_paren_params(cur) or {}
+        if cur.accept_kw("AS"):
+            cur.expect_kw("OF")
+            params.update(self._as_of_target(cur))
         columns: Any = None
         if cur.accept_kw("COLUMNS"):
             columns = self._schema_or_cols(cur)
         return self.workflow.load(path, fmt=fmt, columns=columns, **params)
+
+    def _as_of_target(self, cur: Cursor) -> Dict[str, Any]:
+        """``LOAD "lake://..." AS OF <target>`` — time travel against a
+        versioned lake table. A bare integer pins a snapshot VERSION; a
+        float or a quoted ISO datetime pins a TIMESTAMP (resolved to the
+        newest snapshot committed at or before it). Both land in the
+        load params, so ``AS OF`` against a non-lake path is statically
+        flaggable (FWF507) and fails at run time."""
+        v = self._json_value(cur)
+        if isinstance(v, bool):
+            raise FugueSQLSyntaxError("AS OF expects a version or timestamp")
+        if isinstance(v, int):
+            return {"version": v}
+        if isinstance(v, float):
+            return {"timestamp": v}
+        if isinstance(v, str):
+            try:
+                return {"version": int(v)}
+            except ValueError:
+                pass
+            try:
+                return {"timestamp": float(v)}
+            except ValueError:
+                pass
+            from datetime import datetime
+
+            try:
+                return {"timestamp": datetime.fromisoformat(v).timestamp()}
+            except ValueError:
+                raise FugueSQLSyntaxError(
+                    f"invalid AS OF target {v!r} (expected a version "
+                    "number, an epoch timestamp or an ISO datetime)"
+                )
+        raise FugueSQLSyntaxError("AS OF expects a version or timestamp")
 
     # ---- extension statements -------------------------------------------
 

@@ -80,6 +80,7 @@ class PipelineSpec:
         max_files_per_batch: int = 0,
         batch_rows: int = 0,
         progress: Optional[str] = None,
+        sink: Optional[str] = None,
     ):
         assert_or_throw(
             str(name).isidentifier(), ValueError(f"invalid pipeline name {name!r}")
@@ -119,6 +120,19 @@ class PipelineSpec:
         self.max_files_per_batch = int(max_files_per_batch)
         self.batch_rows = int(batch_rows)
         self.progress = progress
+        # optional lake sink: every micro-batch's RAW rows also append
+        # to this versioned table (exactly-once via the writer token +
+        # progress manifest — see StandingPipeline._append_sink)
+        if sink is not None:
+            from fugue_tpu.lake.format import is_lake_uri
+
+            assert_or_throw(
+                is_lake_uri(str(sink)),
+                ValueError(
+                    f"pipeline sink must be a lake:// URI, got {sink!r}"
+                ),
+            )
+        self.sink = None if sink is None else str(sink)
 
     @property
     def uuid(self) -> str:
@@ -146,6 +160,7 @@ class PipelineSpec:
             "max_files_per_batch": self.max_files_per_batch,
             "batch_rows": self.batch_rows,
             "progress": self.progress,
+            "sink": self.sink,
         }
 
     @classmethod
@@ -163,6 +178,7 @@ class PipelineSpec:
             max_files_per_batch=int(d.get("max_files_per_batch", 0) or 0),
             batch_rows=int(d.get("batch_rows", 0) or 0),
             progress=d.get("progress"),
+            sink=d.get("sink"),
         )
 
     @classmethod
@@ -352,6 +368,8 @@ class StandingPipeline:
         entries = self._source.discover(
             self._progress.consumed, self.spec.max_files_per_batch
         )
+        if self.spec.sink is not None and entries:
+            entries = self._restrict_to_dangling_sink_batch(entries)
         report: Dict[str, Any] = {
             "pipeline": self.spec.name,
             "files": len(entries),
@@ -370,11 +388,16 @@ class StandingPipeline:
             "stream.batch", pipeline=self.spec.name, files=len(entries)
         ):
             rows = 0
+            sink_chunks: List[pd.DataFrame] = []
             try:
                 for e in entries:
                     for chunk in read_parquet_chunks(
                         self._engine.fs, e.path, self.spec.batch_rows
                     ):
+                        if self.spec.sink is not None and len(chunk) > 0:
+                            # RAW rows (pre-windowing: the sink is the
+                            # faithful event log, not the aggregate)
+                            sink_chunks.append(chunk)
                         chunk = self._prepare(chunk)
                         if len(chunk) == 0:
                             continue
@@ -395,11 +418,19 @@ class StandingPipeline:
                 # publishes anything. Ephemeral pipelines keep the
                 # snapshot in memory too — it is the rollback point a
                 # failed LATER step restores.
+                # lake sink append FIRST, then the progress commit that
+                # references its committed version: a kill between the
+                # two leaves a DANGLING lake batch whose writer token
+                # carries this batch's file list — the restart restricts
+                # re-discovery to exactly those files, refolds them, and
+                # the idempotent append dedupes instead of doubling
+                lake_version = self._append_sink(sink_chunks, entries)
                 self._progress.commit(
                     entries,
                     self._agg.snapshot() if self._agg is not None else None,
                     self.watermark,
                     rows,
+                    lake_version=lake_version,
                 )
             except BaseException:
                 # a step that dies AFTER folding began (unreadable
@@ -426,6 +457,68 @@ class StandingPipeline:
                     ).observe(max(0.0, now - e.mtime))
         report["secs"] = round(time.monotonic() - t0, 4)
         return report
+
+    def _sink_table(self) -> Any:
+        from fugue_tpu.lake import LakeTable, parse_lake_uri
+
+        table_uri, _ = parse_lake_uri(self.spec.sink)
+        return LakeTable(
+            table_uri, fs=self._engine.fs,
+            conf=getattr(self._engine, "conf", None) or {},
+        )
+
+    def _restrict_to_dangling_sink_batch(self, entries: List[Any]) -> List[Any]:
+        """Crash recovery for the lake sink: if the NEXT batch number
+        already committed to the lake (we died between the lake append
+        and the progress commit), replay exactly the file set that
+        append covered — new arrivals wait one tick. The refolded batch
+        then dedupes against the existing lake commit and the progress
+        record converges. Only meaningful with durable progress (an
+        ephemeral pipeline restarts at batch 0 and must not dedupe
+        against a prior life's numbering)."""
+        if not self._progress.durable:
+            return entries
+        try:
+            dangling = self._sink_table().find_writer_commit(
+                self.spec.uuid, self._progress.batches + 1
+            )
+        except Exception:  # pragma: no cover - sink unreachable: fold on
+            return entries
+        if dangling is None:
+            return entries
+        files = set((dangling.writer or {}).get("files") or [])
+        if not files:
+            return entries
+        replay = [e for e in entries if e.path in files]
+        return replay if replay else entries
+
+    def _append_sink(
+        self, chunks: List[pd.DataFrame], entries: List[Any]
+    ) -> Optional[int]:
+        """Append the batch's raw rows to the lake sink; returns the
+        committed version (referenced by the progress manifest). The
+        writer token (pipeline uuid + batch number + file list) makes
+        the append idempotent under crash-replay."""
+        if self.spec.sink is None or not chunks:
+            return None
+        table = pa.concat_tables(
+            [
+                pa.Table.from_pandas(c, preserve_index=False)
+                for c in chunks
+            ],
+            promote_options="default",
+        )
+        lt = self._sink_table()
+        if self._progress.durable:
+            manifest = lt.append(
+                table,
+                writer_id=self.spec.uuid,
+                writer_batch=self._progress.batches + 1,
+                writer_meta={"files": sorted(e.path for e in entries)},
+            )
+        else:
+            manifest = lt.append(table)
+        return manifest.version
 
     def _evict_expired_windows(self) -> None:
         """Drop window slots older than ``retention`` closed windows

@@ -52,6 +52,11 @@ class StreamProgress:
         self.state: Optional[Dict[str, Any]] = None
         self.refreshed = True
         self.restored = False
+        # version of the lake-sink snapshot the last committed batch
+        # appended (None: no sink, or nothing appended yet) — the
+        # exactly-once cross-reference between this manifest and the
+        # versioned table
+        self.lake_version: Optional[int] = None
 
     @property
     def durable(self) -> bool:
@@ -73,6 +78,8 @@ class StreamProgress:
         self.watermark = data.get("watermark")
         self.state = data.get("state")
         self.refreshed = bool(data.get("refreshed", True))
+        lv = data.get("lake_version")
+        self.lake_version = None if lv is None else int(lv)
         self.restored = True
         return True
 
@@ -85,6 +92,7 @@ class StreamProgress:
             "watermark": self.watermark,
             "state": self.state,
             "refreshed": self.refreshed,
+            "lake_version": self.lake_version,
         }
 
     def commit(
@@ -93,6 +101,7 @@ class StreamProgress:
         state: Optional[Dict[str, Any]],
         watermark: Optional[float],
         rows: int,
+        lake_version: Optional[int] = None,
     ) -> None:
         """Commit one folded micro-batch: consumed set + state snapshot
         land in ONE atomic write (chaos site ``stream.commit``), with
@@ -112,6 +121,11 @@ class StreamProgress:
             "watermark": watermark,
             "state": state,
             "refreshed": False,
+            "lake_version": (
+                lake_version
+                if lake_version is not None
+                else self.lake_version
+            ),
         }
         if self.uri is not None:
             fault_point("stream.commit", self.uri)
@@ -124,6 +138,8 @@ class StreamProgress:
         self.state = state
         self.watermark = watermark
         self.refreshed = False
+        if lake_version is not None:
+            self.lake_version = int(lake_version)
 
     def mark_refreshed(self) -> None:
         """The view refresh landed: record it so a restart does not
@@ -160,4 +176,5 @@ class StreamProgress:
             "watermark": self.watermark,
             "refreshed": self.refreshed,
             "restored": self.restored,
+            "lake_version": self.lake_version,
         }

@@ -90,6 +90,8 @@ KNOWN_SITES = (
     "obs.trace",
     "cache.persist",
     "stream.commit",
+    "lake.commit",
+    "lake.compact",
 )
 
 

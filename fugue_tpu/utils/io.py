@@ -20,6 +20,7 @@ import pyarrow.parquet as pq
 
 from fugue_tpu.dataframe import ArrowDataFrame, DataFrame, LocalBoundedDataFrame
 from fugue_tpu.fs import FileSystemRegistry, make_default_registry
+from fugue_tpu.lake.format import is_lake_uri
 from fugue_tpu.schema import Schema
 from fugue_tpu.utils.assertion import assert_or_throw
 
@@ -81,6 +82,8 @@ def load_df(
 ) -> LocalBoundedDataFrame:
     fs = fs or default_fs()
     paths = [path] if isinstance(path, str) else list(path)
+    if is_lake_uri(paths[0]):
+        return _load_lake(paths, columns, fs, kwargs)
     fmt = infer_format(paths[0], format_hint)
     tables = []
     for p in paths:
@@ -112,6 +115,81 @@ def load_df(
         table = cast_table(table.select(schema.names), schema)
         return ArrowDataFrame(table, schema)
     return ArrowDataFrame(table)
+
+
+def _load_lake(
+    paths: List[str], columns: Any, fs: FileSystemRegistry,
+    kwargs: Dict[str, Any],
+) -> LocalBoundedDataFrame:
+    """``lake://`` load: resolve the snapshot (URI query and/or
+    version/timestamp kwargs — the SQL ``AS OF`` lands here), let the
+    lake layer do schema-evolution resolution and manifest-stats file
+    pruning, and come back as a normal arrow frame."""
+    from fugue_tpu.lake import LakeTable, parse_lake_uri
+
+    assert_or_throw(
+        len(paths) == 1,
+        NotImplementedError("multiple lake:// paths in one load"),
+    )
+    table_uri, params = parse_lake_uri(paths[0])
+    version = kwargs.pop("version", params.get("version"))
+    timestamp = kwargs.pop("timestamp", params.get("timestamp"))
+    pruning = kwargs.pop("pruning", None)
+    assert_or_throw(
+        len(kwargs) == 0,
+        NotImplementedError(f"lake load got unknown options {sorted(kwargs)}"),
+    )
+    cols = columns if isinstance(columns, list) else None
+    if isinstance(columns, str):
+        cols = Schema(columns).names
+    table = LakeTable(table_uri, fs=fs).scan(
+        columns=cols,
+        version=None if version is None else int(version),
+        timestamp=None if timestamp is None else float(timestamp),
+        pruning=pruning,
+    )
+    if isinstance(columns, str):  # schema expression: select + cast
+        schema = Schema(columns)
+        from fugue_tpu.dataframe.arrow_utils import cast_table
+
+        return ArrowDataFrame(cast_table(table, schema), schema)
+    return ArrowDataFrame(table)
+
+
+def _save_lake(
+    df: DataFrame, path: str, mode: str, fs: FileSystemRegistry,
+    kwargs: Dict[str, Any],
+) -> None:
+    """``lake://`` save: a transactional commit instead of file
+    replacement — overwrite/append map to the table operations,
+    ``error`` refuses only when the table already exists."""
+    from fugue_tpu.lake import LakeTable, parse_lake_uri
+
+    table_uri, params = parse_lake_uri(path)
+    assert_or_throw(
+        len(params) == 0,
+        ValueError(f"can't write to a pinned lake snapshot: {path}"),
+    )
+    writer_id = kwargs.pop("writer_id", None)
+    writer_batch = kwargs.pop("writer_batch", None)
+    kwargs.pop("batch_rows", None)  # row-group knob: no-op for lake
+    assert_or_throw(
+        len(kwargs) == 0,
+        NotImplementedError(f"lake save got unknown options {sorted(kwargs)}"),
+    )
+    table = df.as_local_bounded().as_arrow(type_safe=True)
+    lt = LakeTable(table_uri, fs=fs)
+    if mode == "error":
+        assert_or_throw(not lt.exists(), FileExistsError(path))
+        lt.append(table)
+    elif mode == "append":
+        lt.append(
+            table,
+            writer_id=writer_id,
+            writer_batch=None if writer_batch is None else int(writer_batch),
+        )
+    else:
+        lt.overwrite(table)
 
 
 def _load_single(
@@ -187,14 +265,21 @@ def save_df(
     **kwargs: Any,
 ) -> None:
     fs = fs or default_fs()
-    fmt = infer_format(path, format_hint)
-    # row-group streaming knob (fugue.jax.io.batch_rows): bounded-memory
-    # buffered writes — not a pyarrow kwarg, never forward it
-    batch_rows = int(kwargs.pop("batch_rows", 0) or 0)
     assert_or_throw(
         mode in ("overwrite", "append", "error"),
         NotImplementedError(f"invalid mode {mode}"),
     )
+    if is_lake_uri(path):
+        assert_or_throw(
+            not partition_cols,
+            NotImplementedError("partitioned save into a lake table"),
+        )
+        _save_lake(df, path, mode, fs, kwargs)
+        return
+    fmt = infer_format(path, format_hint)
+    # row-group streaming knob (fugue.jax.io.batch_rows): bounded-memory
+    # buffered writes — not a pyarrow kwarg, never forward it
+    batch_rows = int(kwargs.pop("batch_rows", 0) or 0)
     if fs.exists(path):
         if mode == "error":
             raise FileExistsError(path)

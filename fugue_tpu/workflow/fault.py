@@ -55,6 +55,11 @@ _TRANSIENT_NAMES = (
     "IncompleteRead",
     "RetriableError",
     "TransientError",
+    # a lost optimistic lake commit (fugue_tpu/lake): the conflict is
+    # resolved by re-reading the new head and retrying — the textbook
+    # transient — and it must NOT fall into the FileExistsError->FATAL
+    # branch its underlying CAS loses with
+    "LakeCommitConflict",
 )
 # status tokens in error text that mark a transient RPC/XLA transport
 # failure (grpc/absl status vocabulary)

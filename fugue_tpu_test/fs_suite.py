@@ -68,6 +68,66 @@ class FileSystemIOTests:
                 self._p(base_uri, "one.bin")
             ]
 
+        # ---- fail-if-exists CAS primitive (ISSUE 17: lake commits) ------
+        def test_write_file_if_absent_contract(self, base_uri):
+            """Any backend claiming the fs contract must provide the
+            fail-if-exists write: first writer wins and publishes a
+            COMPLETE payload, every later writer gets FileExistsError
+            and changes nothing — the head-pointer CAS of versioned-
+            table commits depends on exactly these semantics."""
+            fs = self.engine.fs
+            target = self._p(base_uri, "manifest-1.json")
+            fs.write_file_if_absent(target, lambda fp: fp.write(b"winner"))
+            assert fs.read_bytes(target) == b"winner"
+            with pytest.raises(FileExistsError):
+                fs.write_file_if_absent(
+                    target, lambda fp: fp.write(b"loser")
+                )
+            assert fs.read_bytes(target) == b"winner"
+            # a failing writer publishes nothing: the slot stays free
+            boom = self._p(base_uri, "manifest-2.json")
+            with pytest.raises(RuntimeError):
+                fs.write_file_if_absent(
+                    boom, lambda fp: (_ for _ in ()).throw(RuntimeError())
+                )
+            assert not fs.exists(boom)
+            fs.write_file_if_absent(boom, lambda fp: fp.write(b"retry"))
+            assert fs.read_bytes(boom) == b"retry"
+
+        def test_write_file_if_absent_single_winner_race(self, base_uri):
+            """N concurrent writers to one path: exactly one wins, the
+            file holds exactly the winner's payload, and no temp debris
+            is left behind to poison part-file listings."""
+            import threading
+
+            fs = self.engine.fs
+            target = self._p(base_uri, "head.json")
+            outcomes: list = []
+
+            def attempt(i: int) -> None:
+                payload = f"writer-{i}".encode()
+                try:
+                    fs.write_file_if_absent(
+                        target, lambda fp: fp.write(payload)
+                    )
+                    outcomes.append(("won", i))
+                except FileExistsError:
+                    outcomes.append(("lost", i))
+
+            threads = [
+                threading.Thread(target=attempt, args=(i,)) for i in range(8)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            winners = [i for kind, i in outcomes if kind == "won"]
+            assert len(winners) == 1, outcomes
+            assert fs.read_bytes(target) == f"writer-{winners[0]}".encode()
+            # dot-prefixed CAS temps must not survive
+            listed = [i.path for i in fs.list_chronological(base_uri)]
+            assert listed == [target]
+
         # ---- engine-level save/load matrix ------------------------------
         def test_save_load_parquet(self, base_uri):
             e = self.engine

@@ -681,6 +681,59 @@ def test_fwf506_stream_conf_rules():
     assert not any(x.code == "FWF506" for x in _analyze(dag))
 
 
+def test_fwf507_lake_conf_rules():
+    # both halves of the lake rule: fugue.lake.* keys with no lake://
+    # task anywhere are silently inert; AS OF (version/timestamp) on a
+    # plain file path has no snapshot history and fails at run time
+    dag = FugueWorkflow()
+    dag.df([[0]], "a:int").persist()
+    # inert keys: nothing lake-flavored in the workflow or conf
+    diags = _analyze(
+        dag,
+        conf={
+            "fugue.lake.commit.retries": 3,
+            "fugue.lake.compact.target_rows": 1000,
+        },
+        codes={"FWF507"},
+    )
+    assert len(diags) == 2  # one per inert key
+    d = _assert_diag(diags, "FWF507", Severity.WARN, needs_callsite=False)
+    assert "lake://" in d.message
+    # fugue.lake.serve.path anchors lake usage by itself (the serve
+    # sessions' durable-table mode has no workflow-visible task)
+    assert not any(
+        x.code == "FWF507"
+        for x in _analyze(
+            dag,
+            conf={
+                "fugue.lake.commit.retries": 3,
+                "fugue.lake.serve.path": "memory://serve/lake",
+            },
+        )
+    )
+    # a lake:// load anchors the keys too
+    dag2 = FugueWorkflow()
+    dag2.load("lake://memory://t/x").persist()
+    assert not any(
+        x.code == "FWF507"
+        for x in _analyze(dag2, conf={"fugue.lake.commit.retries": 3})
+    )
+    # AS OF against a non-lake path: statically flagged
+    dag3 = FugueWorkflow()
+    dag3.load("/tmp/plain.parquet", version=3).persist()
+    d = _assert_diag(
+        _analyze(dag3, codes={"FWF507"}), "FWF507", Severity.WARN,
+        task_prefix="Load",
+    )
+    assert "AS OF" in d.message and "/tmp/plain.parquet" in d.message
+    # AS OF against a lake path: silent
+    dag4 = FugueWorkflow()
+    dag4.load("lake://memory://t/x", version=3).persist()
+    assert not any(x.code == "FWF507" for x in _analyze(dag4))
+    # no lake keys, no AS OF: silent
+    assert not any(x.code == "FWF507" for x in _analyze(dag))
+
+
 def test_every_rule_has_corpus_coverage():
     """The corpus above must track the registry: a newly registered rule
     without a fixture here fails this meta-check."""
@@ -688,7 +741,7 @@ def test_every_rule_has_corpus_coverage():
         "FWF101", "FWF102", "FWF103", "FWF104", "FWF105", "FWF106",
         "FWF201", "FWF202", "FWF301", "FWF302", "FWF303", "FWF401",
         "FWF402", "FWF403", "FWF404", "FWF501", "FWF502", "FWF503",
-        "FWF504", "FWF505", "FWF506",
+        "FWF504", "FWF505", "FWF506", "FWF507",
     }
     assert {r.code for r in all_rules()} == covered
 

@@ -345,3 +345,49 @@ def test_list_chronological_skips_temps_dirs_and_missing():
     # a missing directory is an EMPTY listing, not an error (a tail
     # source may start before its first file arrives)
     assert fs.list_chronological("memory://unit/chron_missing") == []
+
+
+def test_local_write_file_if_absent_atomic_cas(tmp_path):
+    """Local CAS: os.link publishes all-or-nothing; a second writer
+    loses with FileExistsError, temps never survive, and the winner's
+    bytes are untouched."""
+    fs = LocalFileSystem()
+    target = str(tmp_path / "manifest-1.json")
+    fs.write_file_if_absent(target, lambda fp: fp.write(b"v1"))
+    assert open(target, "rb").read() == b"v1"
+    with pytest.raises(FileExistsError):
+        fs.write_file_if_absent(target, lambda fp: fp.write(b"v2"))
+    assert open(target, "rb").read() == b"v1"
+    # a crashing writer leaves neither target nor temp debris
+    bad = str(tmp_path / "manifest-2.json")
+    with pytest.raises(RuntimeError):
+        fs.write_file_if_absent(
+            bad, lambda fp: (_ for _ in ()).throw(RuntimeError("boom"))
+        )
+    assert not os.path.exists(bad)
+    assert [n for n in os.listdir(str(tmp_path)) if n.startswith(".")] == []
+    # parents are created like open_output_stream does
+    nested = str(tmp_path / "a" / "b" / "head.json")
+    fs.write_file_if_absent(nested, lambda fp: fp.write(b"n"))
+    assert open(nested, "rb").read() == b"n"
+
+
+def test_registry_write_file_if_absent_routes_and_faults():
+    """Registry-level CAS: full-URI routing plus the fs.write fault
+    site (chaos plans cover CAS commits exactly like atomic writes)."""
+    from fugue_tpu.testing.faults import FaultPlan, FaultSpec, inject_faults
+
+    fs = make_default_registry()
+    uri = "memory://unit/cas/reg.json"
+    plan = FaultPlan(
+        FaultSpec(site="fs.write", match="*cas/reg.json", times=1,
+                  error=OSError("injected"))
+    )
+    with inject_faults(plan):
+        with pytest.raises(OSError):
+            fs.write_file_if_absent(uri, lambda fp: fp.write(b"x"))
+        assert not fs.exists(uri)
+        fs.write_file_if_absent(uri, lambda fp: fp.write(b"x"))
+    assert fs.read_bytes(uri) == b"x"
+    with pytest.raises(FileExistsError):
+        fs.write_file_if_absent(uri, lambda fp: fp.write(b"y"))

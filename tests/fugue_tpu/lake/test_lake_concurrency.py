@@ -1,0 +1,312 @@
+"""Lake commit protocol under contention and chaos (ISSUE 17): the
+two-writer conflict matrix (append/append auto-merge, overwrite/append
+retry), rebase-safe field-id binding, compaction racing writers, the
+kill-at-commit parity contract mirroring ``stream.commit``, and k=4
+concurrent writers (fleet replicas + a standing pipeline + an engine
+save path) converging to a linear history with zero lost updates."""
+
+import threading
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import fugue_tpu.lake.table as lake_table_mod
+from fugue_tpu.lake import LakeCompactionConflict, LakeTable
+from fugue_tpu.testing.faults import FaultPlan, FaultSpec, inject_faults
+
+pytestmark = [pytest.mark.lake, pytest.mark.faults]
+
+_CONF = {"fugue.lake.commit.backoff": 0.002, "fugue.lake.commit.retries": 60}
+
+
+def _t(**cols) -> pa.Table:
+    return pa.table(cols)
+
+
+def _race_once(monkeypatch, racer) -> None:
+    """Run ``racer()`` inside the FIRST ``lake.commit`` fault window —
+    i.e. after the victim built its candidate manifest but before its
+    CAS write — the deterministic two-writer interleaving. The racer's
+    own commit re-enters the wrapper with the budget spent, so it
+    publishes cleanly."""
+    real = lake_table_mod.fault_point
+    fired = []
+
+    def wrapper(site, detail=None):
+        if site == "lake.commit" and not fired:
+            fired.append(True)
+            racer()
+        return real(site, detail)
+
+    monkeypatch.setattr(lake_table_mod, "fault_point", wrapper)
+
+
+def test_append_append_conflict_auto_merges(tmp_path, monkeypatch):
+    uri = str(tmp_path / "tbl")
+    lt1 = LakeTable(uri, conf=_CONF)
+    lt2 = LakeTable(uri, conf=_CONF)
+    lt1.append(_t(k=[0], v=[0.0]))
+    _race_once(monkeypatch, lambda: lt2.append(_t(k=[2], v=[2.0])))
+    m = lt1.append(_t(k=[1], v=[1.0]))
+    # lt1 lost slot 2 to lt2, rebased, and landed as 3 — nothing lost
+    assert lt1.counters["conflicts"] == 1
+    assert m.version == 3 and m.parent == 2
+    assert sorted(LakeTable(uri).scan().to_pydict()["k"]) == [0, 1, 2]
+    hist = LakeTable(uri).history()
+    assert [(h["version"]) for h in hist] == [3, 2, 1]
+
+
+def test_overwrite_loses_to_concurrent_append_and_retries(
+    tmp_path, monkeypatch
+):
+    uri = str(tmp_path / "tbl")
+    lt1 = LakeTable(uri, conf=_CONF)
+    lt2 = LakeTable(uri, conf=_CONF)
+    lt1.append(_t(k=[0], v=[0.0]))
+    _race_once(monkeypatch, lambda: lt2.append(_t(k=[5], v=[5.0])))
+    m = lt1.overwrite(_t(k=[9], v=[9.0]))
+    # the overwrite retried on top of the interleaved append: last
+    # overwrite wins the final state, the append is in HISTORY not lost
+    assert m.version == 3 and lt1.counters["conflicts"] == 1
+    assert LakeTable(uri).scan().to_pydict()["k"] == [9]
+    assert sorted(LakeTable(uri).scan(version=2).to_pydict()["k"]) == [0, 5]
+
+
+def test_rebase_rebinds_new_column_field_ids(tmp_path, monkeypatch):
+    # two writers add DIFFERENT new columns at the same base version:
+    # the loser's rebase must give its column a FRESH id, not the one
+    # the winner just claimed
+    uri = str(tmp_path / "tbl")
+    lt1 = LakeTable(uri, conf=_CONF)
+    lt2 = LakeTable(uri, conf=_CONF)
+    lt1.append(_t(k=[0]))
+    _race_once(monkeypatch, lambda: lt2.append(_t(k=[1], xcol=[1.5])))
+    lt1.append(_t(k=[2], ycol=[2.5]))
+    head = LakeTable(uri).read_manifest(3)
+    ids = {f.name: f.id for f in head.fields}
+    assert len(set(ids.values())) == 3, ids
+    out = LakeTable(uri).scan()
+    rows = {
+        k: (x, y)
+        for k, x, y in zip(
+            out.column("k").to_pylist(),
+            out.column("xcol").to_pylist(),
+            out.column("ycol").to_pylist(),
+        )
+    }
+    assert rows == {0: (None, None), 1: (1.5, None), 2: (None, 2.5)}
+
+
+def test_compaction_keeps_concurrently_appended_files(tmp_path, monkeypatch):
+    uri = str(tmp_path / "tbl")
+    lt1 = LakeTable(uri, conf=_CONF)
+    lt2 = LakeTable(uri, conf=_CONF)
+    for i in range(4):
+        lt1.append(_t(k=[i]))
+    _race_once(monkeypatch, lambda: lt2.append(_t(k=[99])))
+    m = lt1.compact(target_rows=1_000)
+    # the rewrite landed on a rebased head and KEPT the racer's file
+    assert m is not None and len(m.files) == 2
+    assert sorted(LakeTable(uri).scan().to_pydict()["k"]) == [0, 1, 2, 3, 99]
+
+
+def test_compaction_aborts_when_overwrite_removes_its_inputs(
+    tmp_path, monkeypatch
+):
+    uri = str(tmp_path / "tbl")
+    lt1 = LakeTable(uri, conf=_CONF)
+    lt2 = LakeTable(uri, conf=_CONF)
+    for i in range(3):
+        lt1.append(_t(k=[i]))
+    _race_once(monkeypatch, lambda: lt2.overwrite(_t(k=[7])))
+    with pytest.raises(LakeCompactionConflict):
+        lt1.compact(target_rows=1_000)
+    # the overwrite's state is untouched by the aborted compaction
+    assert LakeTable(uri).scan().to_pydict()["k"] == [7]
+
+
+def test_retry_budget_exhaustion_raises_commit_conflict(
+    tmp_path, monkeypatch
+):
+    from fugue_tpu.lake import LakeCommitConflict
+
+    uri = str(tmp_path / "tbl")
+    lt1 = LakeTable(
+        uri,
+        conf={"fugue.lake.commit.backoff": 0.0, "fugue.lake.commit.retries": 2},
+    )
+    lt2 = LakeTable(uri, conf=_CONF)
+    lt1.append(_t(k=[0]))
+    counter = [0]
+    busy = [False]  # the racer's own commit must not re-trigger itself
+    real = lake_table_mod.fault_point
+
+    def always_lose(site, detail=None):
+        if site == "lake.commit" and not busy[0] and counter[0] < 3:
+            counter[0] += 1
+            busy[0] = True
+            try:
+                lt2.append(_t(k=[100 + counter[0]]))
+            finally:
+                busy[0] = False
+        return real(site, detail)
+
+    monkeypatch.setattr(lake_table_mod, "fault_point", always_lose)
+    with pytest.raises(LakeCommitConflict, match="3 times"):
+        lt1.append(_t(k=[1]))
+    # every slot it lost was a REAL commit: the head kept moving
+    assert LakeTable(uri).current_version() == 4
+
+
+def test_kill_at_commit_parity_with_serial_schedule(tmp_path):
+    # THE chaos contract, mirroring stream.commit: a writer hard-killed
+    # at the commit point leaves the table readable at the previous
+    # snapshot (no torn state), and the retry converges to exactly what
+    # a serial schedule produces.
+    uri = str(tmp_path / "tbl")
+    lt = LakeTable(uri, conf=_CONF)
+    lt.append(_t(k=[0, 1], v=[0.0, 1.0]))
+    plan = FaultPlan(
+        FaultSpec("lake.commit", match="*", times=1,
+                  error=OSError("kill -9 at the manifest CAS"))
+    )
+    with inject_faults(plan):
+        with pytest.raises(OSError):
+            lt.append(_t(k=[2, 3], v=[2.0, 3.0]))
+    assert plan.total("injected") == 1
+    # previous snapshot fully readable; the torn attempt left only
+    # unreferenced data bytes, no manifest
+    fresh = LakeTable(uri)
+    assert fresh.current_version() == 1
+    assert fresh.scan().to_pydict()["k"] == [0, 1]
+    # retry converges — exact parity vs the serial schedule
+    lt.append(_t(k=[2, 3], v=[2.0, 3.0]))
+    assert LakeTable(uri).scan().to_pydict()["k"] == [0, 1, 2, 3]
+    assert LakeTable(uri).current_version() == 2
+
+
+def test_kill_at_compaction_leaves_table_unchanged(tmp_path):
+    uri = str(tmp_path / "tbl")
+    lt = LakeTable(uri, conf=_CONF)
+    for i in range(3):
+        lt.append(_t(k=[i]))
+    plan = FaultPlan(
+        FaultSpec("lake.compact", match="*", times=1, error=OSError)
+    )
+    with inject_faults(plan):
+        with pytest.raises(OSError):
+            lt.compact(target_rows=1_000)
+    fresh = LakeTable(uri)
+    assert fresh.current_version() == 3
+    assert sorted(fresh.scan().to_pydict()["k"]) == [0, 1, 2]
+    m = lt.compact(target_rows=1_000)
+    assert m is not None and m.version == 4
+
+
+def _land(src, name, pdf):
+    src.mkdir(parents=True, exist_ok=True)
+    tmp = src / f".{name}.tmp"
+    pq.write_table(pa.Table.from_pandas(pdf, preserve_index=False), tmp)
+    tmp.replace(src / name)
+
+
+@pytest.mark.stream
+def test_four_concurrent_writers_linear_history_zero_lost_updates(tmp_path):
+    # k=4 writers on ONE table: two fleet-replica appenders (raw
+    # LakeTable), one engine save_df("lake://...", mode="append") — the
+    # serve-session write path — and one standing pipeline appending
+    # micro-batches through its exactly-once sink. The outcome must be
+    # indistinguishable from a serial schedule: a linear version chain
+    # and the exact multiset union of every writer's rows.
+    from fugue_tpu.jax_backend import JaxExecutionEngine
+    from fugue_tpu.stream import PipelineSpec, StandingPipeline
+
+    uri = str(tmp_path / "tbl")
+    lake_uri = f"lake://{uri}"
+    batches = 3
+    frames = {}  # writer -> list of DataFrames appended
+
+    def replica(wid: int):
+        lt = LakeTable(uri, conf=_CONF)
+        for b in range(batches):
+            pdf = pd.DataFrame(
+                {"w": np.full(50, wid, dtype=np.int64),
+                 "v": np.arange(50, dtype=np.float64) + b}
+            )
+            frames.setdefault(wid, []).append(pdf)
+            lt.append(pa.Table.from_pandas(pdf, preserve_index=False))
+
+    engine = JaxExecutionEngine(dict(test=True, **_CONF))
+
+    def serve_writer():
+        # the path session.save_df takes for a lake artifact
+        from fugue_tpu.utils import io as _io
+
+        for b in range(batches):
+            pdf = pd.DataFrame(
+                {"w": np.full(50, 3, dtype=np.int64),
+                 "v": np.arange(50, dtype=np.float64) + 10 * b}
+            )
+            frames.setdefault(3, []).append(pdf)
+            _io.save_df(
+                engine.to_df(pdf, "w:long,v:double"), lake_uri,
+                mode="append", fs=engine.fs,
+            )
+
+    spec = PipelineSpec(
+        name="sink",
+        source=str(tmp_path / "in"),
+        keys=["w"],
+        aggs=[("s", "sum", "v")],
+        progress=str(tmp_path / "progress.json"),
+        sink=lake_uri,
+    )
+    pipe_engine = JaxExecutionEngine(dict(test=True, **_CONF))
+    pipe = StandingPipeline(pipe_engine, spec)
+
+    def pipeline_writer():
+        for b in range(batches):
+            pdf = pd.DataFrame(
+                {"w": np.full(50, 4, dtype=np.int64),
+                 "v": np.arange(50, dtype=np.float64) + 100 * b}
+            )
+            frames.setdefault(4, []).append(pdf)
+            _land(tmp_path / "in", f"f{b}.parquet", pdf)
+            rep = pipe.step()
+            assert rep["files"] == 1, rep
+
+    threads = [
+        threading.Thread(target=replica, args=(1,)),
+        threading.Thread(target=replica, args=(2,)),
+        threading.Thread(target=serve_writer),
+        threading.Thread(target=pipeline_writer),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive(), "writer deadlocked"
+
+    lt = LakeTable(uri)
+    head = lt.current_version()
+    assert head == 4 * batches  # every append owns exactly one version
+    # linear history: an unbroken parent chain back to the create
+    v, hops = head, 0
+    while v > 0:
+        m = lt.read_manifest(v)
+        assert m.parent == v - 1
+        v, hops = m.parent, hops + 1
+    assert hops == head
+    # zero lost updates: the table equals the serial-schedule union
+    got = (
+        lt.scan().to_pandas().sort_values(["w", "v"]).reset_index(drop=True)
+    )
+    exp = (
+        pd.concat([f for fl in frames.values() for f in fl])
+        .sort_values(["w", "v"]).reset_index(drop=True)
+    )
+    pd.testing.assert_frame_equal(got, exp)
+    assert lt.scan().num_rows == 4 * batches * 50

@@ -1,0 +1,365 @@
+"""Lake integration seams (ISSUE 17): engine load/save of ``lake://``
+URIs, FugueSQL ``AS OF`` time travel, optimizer pruning-triple
+attachment flowing into manifest-stats file pruning, the serve
+session's lake-backed durable-table mode (restart reload + the
+version-pinned result-cache contract), and the standing pipeline's
+exactly-once lake sink under kill-at-commit chaos."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from fugue_tpu.lake import LakeTable
+from fugue_tpu.testing.faults import FaultPlan, FaultSpec, inject_faults
+
+pytestmark = pytest.mark.lake
+
+
+def _seed(tmp_path, rows=(("a", 1.0), ("b", 2.0))) -> str:
+    uri = str(tmp_path / "events")
+    lt = LakeTable(uri)
+    lt.append(pa.table({"k": [r[0] for r in rows],
+                        "v": [r[1] for r in rows]}))
+    return uri
+
+
+def test_engine_save_load_lake_roundtrip_and_as_of(tmp_path):
+    from fugue_tpu.jax_backend import JaxExecutionEngine
+
+    e = JaxExecutionEngine(dict(test=True))
+    uri = f"lake://{tmp_path}/t1"
+    df1 = e.to_df([[1, "x"], [2, "y"]], "a:long,s:str")
+    e.save_df(df1, uri)
+    e.save_df(e.to_df([[3, "z"]], "a:long,s:str"), uri, mode="append")
+    assert e.load_df(uri).as_pandas()["a"].tolist() == [1, 2, 3]
+    # AS OF via kwarg and via URI pin read the same snapshot
+    assert e.load_df(uri, version=1).as_pandas()["a"].tolist() == [1, 2]
+    assert (
+        e.load_df(f"{uri}?version=1").as_pandas()["a"].tolist() == [1, 2]
+    )
+    # column projection flows through the manifest schema
+    assert e.load_df(uri, columns=["s"]).schema.names == ["s"]
+    # mode="error" refuses an existing table, transactionally
+    with pytest.raises(Exception):
+        e.save_df(df1, uri, mode="error")
+    # writes to a PINNED snapshot are refused
+    with pytest.raises(Exception):
+        e.save_df(df1, f"{uri}?version=1")
+
+
+@pytest.mark.optimize
+def test_optimizer_attaches_pruning_and_scan_skips_files(tmp_path):
+    from fugue_tpu.column.expressions import col
+    from fugue_tpu.execution import make_execution_engine
+    from fugue_tpu.extensions import builtins as _b
+    from fugue_tpu.optimize import optimize_tasks
+    from fugue_tpu.workflow.workflow import FugueWorkflow
+
+    uri = str(tmp_path / "t")
+    lt = LakeTable(uri)
+    lt.append(pa.table({"k": [0, 1], "v": [0.0, 1.0]}))
+    lt.append(pa.table({"k": [10, 11], "v": [10.0, 11.0]}))
+
+    dag = FugueWorkflow()
+    df = dag.load(f"lake://{uri}").filter(col("k") >= 10)
+    df.yield_dataframe_as("out")
+    plan = optimize_tasks(dag.tasks, conf={"fugue.optimize": "on"})
+    load = next(t for t in plan.tasks if t.extension is _b.Load)
+    assert (load.params["params"] or {})["pruning"] == [["k", ">=", 10]]
+    # end-to-end: the run returns the filtered rows (file pruning is a
+    # superset-safe pre-filter; the engine filter still applies)
+    dag2 = FugueWorkflow({"fugue.optimize": "on"})
+    dag2.load(f"lake://{uri}").filter(col("k") >= 10).yield_dataframe_as(
+        "out"
+    )
+    dag2.run(make_execution_engine("jax", {"test": True}))
+    out = dag2.yields["out"].result.as_pandas()
+    assert sorted(out["k"].tolist()) == [10, 11]
+
+
+def test_sql_as_of_time_travel_and_append(tmp_path):
+    from fugue_tpu.sql_frontend.api import fugue_sql
+
+    uri = _seed(tmp_path)
+    LakeTable(uri).append(pa.table({"k": ["c"], "v": [3.0]}))
+    head = fugue_sql(f'LOAD "lake://{uri}"', as_fugue=True).as_pandas()
+    assert head["k"].tolist() == ["a", "b", "c"]
+    v1 = fugue_sql(f'LOAD "lake://{uri}" AS OF 1', as_fugue=True).as_pandas()
+    assert v1["k"].tolist() == ["a", "b"]
+    # AS OF accepts a float epoch timestamp too
+    ts = LakeTable(uri).read_manifest(1).timestamp
+    byts = fugue_sql(
+        f'LOAD "lake://{uri}" AS OF {ts!r}', as_fugue=True
+    ).as_pandas()
+    assert byts["k"].tolist() == ["a", "b"]
+    # SAVE APPEND commits a new snapshot transactionally
+    fugue_sql(
+        f"""
+        a = CREATE [["d", 4.0]] SCHEMA k:str,v:double
+        SAVE a APPEND "lake://{uri}"
+        SELECT * FROM a
+        """
+    )
+    assert LakeTable(uri).current_version() == 3
+    assert LakeTable(uri).scan().num_rows == 4
+
+
+@pytest.mark.optimize
+def test_version_pinned_lake_load_is_result_cache_pure(tmp_path):
+    from fugue_tpu.optimize.rewrite import tasks_are_pure
+    from fugue_tpu.workflow.workflow import FugueWorkflow
+
+    uri = _seed(tmp_path)
+    pinned = FugueWorkflow()
+    pinned.load(f"lake://{uri}", version=1).select("k")
+    assert tasks_are_pure(pinned.tasks, frame_inputs_stable=True)
+    uri_pin = FugueWorkflow()
+    uri_pin.load(f"lake://{uri}?version=1").select("k")
+    assert tasks_are_pure(uri_pin.tasks, frame_inputs_stable=True)
+    # unpinned head reads and timestamp pins stay UNCACHEABLE
+    unpinned = FugueWorkflow()
+    unpinned.load(f"lake://{uri}").select("k")
+    assert not tasks_are_pure(unpinned.tasks, frame_inputs_stable=True)
+    by_ts = FugueWorkflow()
+    by_ts.load(f"lake://{uri}", timestamp=1e12).select("k")
+    assert not tasks_are_pure(by_ts.tasks, frame_inputs_stable=True)
+
+
+# ---------------------------------------------------------------------------
+# serve session: lake-backed durable tables
+# ---------------------------------------------------------------------------
+@pytest.mark.serve
+def test_serve_lake_mode_saves_versioned_tables_and_reloads(tmp_path):
+    from fugue_tpu.serve import ServeClient, ServeDaemon
+
+    lake_base = str(tmp_path / "warehouse")
+    conf = {
+        "fugue.serve.state_path": str(tmp_path / "state"),
+        "fugue.serve.breaker.threshold": 0,
+        "fugue.lake.serve.path": lake_base,
+    }
+    pdf = pd.DataFrame({"k": [0, 1, 0], "v": [1.0, 2.0, 3.0]})
+    agg = "SELECT k, SUM(v) AS s FROM t GROUP BY k"
+    d1 = ServeDaemon(conf).start()
+    c1 = ServeClient(*d1.address, timeout=600)
+    sid = c1.create_session()
+    d1.sessions.get(sid).save_table("t", d1.engine.to_df(pdf))
+    expected = sorted(c1.sql(sid, agg)["result"]["rows"])
+    # the durable artifact is a PINNED shared versioned table
+    rec = d1.sessions.get(sid)._artifacts["t"]
+    assert rec["artifact"] == f"lake://{lake_base}/t?version=1"
+    lt = LakeTable(f"{lake_base}/t")
+    assert rec["sha256"] == lt.read_manifest(1).sha256
+    assert lt.scan().num_rows == 3
+    # re-saving commits version 2 of the SAME shared table
+    d1.sessions.get(sid).save_table(
+        "t", d1.engine.to_df(pdf.assign(v=pdf["v"] * 2))
+    )
+    assert (
+        d1.sessions.get(sid)._artifacts["t"]["artifact"]
+        == f"lake://{lake_base}/t?version=2"
+    )
+    d1.stop()  # graceful stop keeps journal + lake data
+
+    d2 = ServeDaemon(conf).start()
+    try:
+        c2 = ServeClient(*d2.address, timeout=600)
+        desc = c2.session(sid)
+        assert desc["restored"] is True and desc["tables"] == ["t"]
+        rows = sorted(c2.sql(sid, agg)["result"]["rows"])
+        assert rows == sorted(
+            [[k, s * 2] for k, s in expected], key=lambda r: r[0]
+        )
+        # closing the session never deletes the SHARED lake table
+        c2.close_session(sid)
+        assert LakeTable(f"{lake_base}/t").current_version() == 2
+    finally:
+        d2.stop()
+
+
+@pytest.mark.serve
+def test_serve_repeated_as_of_query_served_from_result_cache(tmp_path):
+    from fugue_tpu.serve import ServeClient, ServeDaemon
+
+    uri = str(tmp_path / "events")
+    lt = LakeTable(uri)
+    rng = np.random.default_rng(3)
+    lt.append(
+        pa.table(
+            {
+                "k": pa.array(rng.integers(0, 8, 2000), pa.int64()),
+                "v": pa.array(rng.random(2000), pa.float64()),
+            }
+        )
+    )
+    pinned = (
+        f'data = LOAD "lake://{uri}" AS OF 1\n'
+        "SELECT k, SUM(v) AS s FROM data GROUP BY k"
+    )
+    with ServeDaemon({"fugue.serve.max_concurrent": 2}) as daemon:
+        c = ServeClient(*daemon.address, timeout=600)
+        sid = c.create_session()
+        r1 = c.sql(sid, pinned)
+        assert r1["status"] == "done"
+        st = daemon.status()
+        hits0 = st["plan_cache"]["serve_result"].get("hit", 0)
+        misses0 = st["compile_cache"]["misses"]
+        # the acceptance contract: the REPEATED AS OF query is served
+        # from the result cache — a hit, zero new compiles
+        r2 = c.sql(sid, pinned)
+        assert r2["status"] == "done"
+        st = daemon.status()
+        assert st["plan_cache"]["serve_result"].get("hit", 0) > hits0
+        assert st["compile_cache"]["misses"] == misses0
+        assert sorted(r2["result"]["rows"]) == sorted(r1["result"]["rows"])
+        # the UNPINNED head query must NOT be result-cached: the table
+        # can move underneath it
+        unpinned = (
+            f'data = LOAD "lake://{uri}"\n'
+            "SELECT k, SUM(v) AS s FROM data GROUP BY k"
+        )
+        c.sql(sid, unpinned)
+        hits1 = daemon.status()["plan_cache"]["serve_result"].get("hit", 0)
+        c.sql(sid, unpinned)
+        assert (
+            daemon.status()["plan_cache"]["serve_result"].get("hit", 0)
+            == hits1
+        )
+        c.close_session(sid)
+
+
+# ---------------------------------------------------------------------------
+# standing pipeline: exactly-once lake sink
+# ---------------------------------------------------------------------------
+def _land(src, name, pdf):
+    src.mkdir(parents=True, exist_ok=True)
+    tmp = src / f".{name}.tmp"
+    pq.write_table(pa.Table.from_pandas(pdf, preserve_index=False), tmp)
+    tmp.replace(src / name)
+
+
+def _pipe(tmp_path, engine, **kw):
+    from fugue_tpu.stream import PipelineSpec, StandingPipeline
+
+    spec = PipelineSpec(
+        name="sess",
+        source=str(tmp_path / "in"),
+        keys=["k"],
+        aggs=[("s", "sum", "v")],
+        progress=str(tmp_path / "progress.json"),
+        sink=f"lake://{tmp_path}/sink",
+        **kw,
+    )
+    return StandingPipeline(engine, spec), spec
+
+
+def _wave(seed, rows=200):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame(
+        {"k": rng.integers(0, 8, rows).astype(np.int64),
+         "v": rng.random(rows)}
+    )
+
+
+@pytest.mark.stream
+@pytest.mark.faults
+def test_pipeline_lake_sink_appends_and_survives_kill_at_lake_commit(
+    tmp_path,
+):
+    from fugue_tpu.jax_backend import JaxExecutionEngine
+
+    e = JaxExecutionEngine(dict(test=True))
+    p, spec = _pipe(tmp_path, e)
+    frames = [_wave(0)]
+    _land(tmp_path / "in", "f0.parquet", frames[0])
+    rep = p.step()
+    assert rep["rows"] == 200
+    lt = LakeTable(str(tmp_path / "sink"))
+    assert lt.current_version() == 1
+    assert p.progress.lake_version == 1
+    # batch 2 dies AT the lake commit (before the progress commit)
+    frames.append(_wave(1))
+    _land(tmp_path / "in", "f1.parquet", frames[1])
+    plan = FaultPlan(
+        FaultSpec("lake.commit", match="*", times=1,
+                  error=OSError("kill -9 at the sink commit"))
+    )
+    with inject_faults(plan):
+        with pytest.raises(OSError):
+            p.step()
+    assert plan.total("injected") == 1
+    # nothing moved: sink at v1, progress at batch 1
+    assert LakeTable(str(tmp_path / "sink")).current_version() == 1
+    assert p.progress.batches == 1
+    # restart converges exactly once
+    from fugue_tpu.stream import StandingPipeline
+
+    p2 = StandingPipeline(e, spec)
+    rep = p2.step()
+    assert rep["files"] == 1 and rep["batches"] == 2
+    assert p2.progress.lake_version == 2
+    got = (
+        LakeTable(str(tmp_path / "sink")).scan().to_pandas()
+        .sort_values(["k", "v"]).reset_index(drop=True)
+    )
+    exp = (
+        pd.concat(frames).sort_values(["k", "v"]).reset_index(drop=True)
+    )
+    pd.testing.assert_frame_equal(got, exp)
+
+
+@pytest.mark.stream
+@pytest.mark.faults
+def test_pipeline_dangling_lake_append_dedupes_on_restart(tmp_path):
+    # the OTHER side of the window: the lake append LANDED but the
+    # progress commit died. A new file arrives before the restart. The
+    # restarted pipeline must replay exactly the dangling batch's file
+    # set, dedupe against the existing lake commit (no duplicate rows),
+    # and pick the new file up on the NEXT tick.
+    from fugue_tpu.jax_backend import JaxExecutionEngine
+    from fugue_tpu.stream import StandingPipeline
+
+    e = JaxExecutionEngine(dict(test=True))
+    p, spec = _pipe(tmp_path, e)
+    frames = [_wave(0)]
+    _land(tmp_path / "in", "f0.parquet", frames[0])
+    p.step()
+    frames.append(_wave(1))
+    _land(tmp_path / "in", "f1.parquet", frames[1])
+    plan = FaultPlan(
+        FaultSpec("stream.commit", match="*", times=1,
+                  error=OSError("kill -9 between sink append and commit"))
+    )
+    with inject_faults(plan):
+        with pytest.raises(OSError):
+            p.step()
+    sink = LakeTable(str(tmp_path / "sink"))
+    assert sink.current_version() == 2  # the DANGLING append
+    assert p.progress.batches == 1
+    frames.append(_wave(2))
+    _land(tmp_path / "in", "f2.parquet", frames[2])  # arrives pre-restart
+    emitted = []
+    p2 = StandingPipeline(
+        e, spec, on_refresh=lambda df: emitted.append(df.as_pandas())
+    )
+    rep = p2.step()
+    # the replay covered ONLY the dangling file; the lake append deduped
+    assert rep["files"] == 1 and rep["batches"] == 2
+    assert LakeTable(str(tmp_path / "sink")).current_version() == 2
+    assert p2.progress.lake_version == 2
+    rep = p2.step()  # the new arrival folds on the next tick
+    assert rep["files"] == 1 and rep["batches"] == 3
+    got = (
+        LakeTable(str(tmp_path / "sink")).scan().to_pandas()
+        .sort_values(["k", "v"]).reset_index(drop=True)
+    )
+    exp = pd.concat(frames).sort_values(["k", "v"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, exp)
+    # and the view itself has exactly-once parity
+    view = emitted[-1].sort_values("k").reset_index(drop=True)
+    oracle = (
+        pd.concat(frames).groupby("k")["v"].sum().reset_index(name="s")
+    )
+    assert np.allclose(view["s"].to_numpy(), oracle["s"].to_numpy())

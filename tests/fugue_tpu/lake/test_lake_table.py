@@ -1,0 +1,242 @@
+"""Lake table core semantics (ISSUE 17): manifest-CAS commits, time
+travel, schema evolution under stable field ids, compaction that
+preserves history byte-for-byte, manifest-stats file pruning, and the
+writer-token idempotence streaming sinks rely on."""
+
+import json
+
+import pyarrow as pa
+import pytest
+
+from fugue_tpu.lake import (
+    LakeError,
+    LakeTable,
+    format_lake_uri,
+    is_lake_uri,
+    parse_lake_uri,
+)
+from fugue_tpu.lake.format import stats_exclude_file
+
+pytestmark = pytest.mark.lake
+
+
+def _t(**cols) -> pa.Table:
+    return pa.table(cols)
+
+
+def _lt(tmp_path, **conf) -> LakeTable:
+    base = {"fugue.lake.commit.backoff": 0.005}
+    base.update(conf)
+    return LakeTable(str(tmp_path / "tbl"), conf=base)
+
+
+def test_lake_uri_parse_and_format():
+    assert is_lake_uri("lake:///w/events") and not is_lake_uri("/w/events")
+    assert parse_lake_uri("lake:///w/events") == ("/w/events", {})
+    assert parse_lake_uri("lake://memory://t/x?version=3") == (
+        "memory://t/x", {"version": 3}
+    )
+    assert parse_lake_uri("lake:///w/e?timestamp=17.5") == (
+        "/w/e", {"timestamp": 17.5}
+    )
+    assert format_lake_uri("/w/events", 7) == "lake:///w/events?version=7"
+    with pytest.raises(ValueError):
+        parse_lake_uri("lake:///w/e?mode=fast")
+    with pytest.raises(ValueError):
+        parse_lake_uri("lake://")
+
+
+def test_create_append_history_and_time_travel(tmp_path):
+    lt = _lt(tmp_path)
+    assert not lt.exists() and lt.current_version() == 0
+    m1 = lt.append(_t(k=[1, 2], v=[1.0, 2.0]))
+    assert m1.version == 1 and m1.operation == "create"
+    m2 = lt.append(_t(k=[3], v=[3.0]))
+    assert m2.version == 2 and m2.parent == 1 and m2.operation == "append"
+    # head read sees everything; AS OF version pins the old snapshot
+    assert lt.scan().num_rows == 3
+    assert lt.scan(version=1).to_pydict()["k"] == [1, 2]
+    # AS OF timestamp resolves to the newest snapshot at-or-before
+    assert lt.snapshot(timestamp=m1.timestamp).version == 1
+    assert lt.snapshot(timestamp=m2.timestamp + 10).version == 2
+    with pytest.raises(LakeError):
+        lt.snapshot(timestamp=m1.timestamp - 10)
+    with pytest.raises(LakeError):
+        lt.snapshot(version=9)
+    hist = lt.history()
+    assert [h["version"] for h in hist] == [2, 1]
+    assert hist[0]["rows"] == 3 and hist[1]["rows"] == 2
+
+
+def test_head_hint_stale_or_corrupt_never_wrong(tmp_path):
+    lt = _lt(tmp_path)
+    lt.append(_t(a=[1]))
+    lt.append(_t(a=[2]))
+    meta = tmp_path / "tbl" / "_meta"
+    # a LAGGING hint probes forward to the real head
+    (meta / "_head.json").write_text(json.dumps({"version": 1}))
+    assert LakeTable(str(tmp_path / "tbl")).current_version() == 2
+    # a corrupt hint falls back to the listing
+    (meta / "_head.json").write_text("not json at all")
+    assert LakeTable(str(tmp_path / "tbl")).current_version() == 2
+    # a LEADING hint (pointing past the truth) is rejected as stale
+    (meta / "_head.json").write_text(json.dumps({"version": 99}))
+    assert LakeTable(str(tmp_path / "tbl")).current_version() == 2
+
+
+def test_schema_evolution_add_column_and_widen(tmp_path):
+    lt = _lt(tmp_path)
+    lt.append(_t(k=pa.array([1, 2], pa.int32()), v=[1.0, 2.0]))
+    # add a column + widen k int->long in one append
+    lt.append(
+        pa.table(
+            {
+                "k": pa.array([3], pa.int64()),
+                "v": [3.0],
+                "tag": ["new"],
+            }
+        )
+    )
+    head = lt.scan()
+    assert head.schema.field("k").type == pa.int64()
+    assert head.column("tag").to_pylist() == [None, None, "new"]
+    # the old snapshot still reads with its OWN schema: no tag, int32 k
+    old = lt.scan(version=1)
+    assert old.schema.names == ["k", "v"]
+    assert old.schema.field("k").type == pa.int32()
+    # a non-widenable change is refused (overwrite is the escape hatch)
+    with pytest.raises(LakeError, match="cannot evolve"):
+        lt.append(_t(k=["oops"], v=[1.0]))
+    # NARROWER incoming data upcasts at read instead of erroring
+    lt.append(_t(k=pa.array([9], pa.int32()), v=[9.0]))
+    assert lt.scan().schema.field("k").type == pa.int64()
+
+
+def test_rename_resolves_old_files_forever(tmp_path):
+    lt = _lt(tmp_path)
+    lt.append(_t(k=[1], v=[10.0]))
+    m = lt.rename_column("v", "value")
+    assert m.operation == "evolve"
+    # metadata only: no data file was rewritten
+    assert [f.path for f in m.files] == [
+        f.path for f in lt.read_manifest(1).files
+    ]
+    assert lt.scan().to_pydict() == {"k": [1], "value": [10.0]}
+    # the pre-rename snapshot keeps the old name
+    assert lt.scan(version=1).schema.names == ["k", "v"]
+    lt.append(_t(k=[2], value=[20.0]))
+    assert lt.scan().to_pydict()["value"] == [10.0, 20.0]
+    with pytest.raises(LakeError):
+        lt.rename_column("nope", "x")
+    with pytest.raises(LakeError):
+        lt.rename_column("k", "value")
+
+
+def test_overwrite_replaces_and_history_stays_navigable(tmp_path):
+    lt = _lt(tmp_path)
+    lt.append(_t(k=[1, 2], v=[1.0, 2.0]))
+    m = lt.overwrite(_t(k=["a"], n=[5]))  # type change: allowed here
+    assert m.version == 2 and m.operation == "overwrite"
+    assert lt.scan().to_pydict() == {"k": ["a"], "n": [5]}
+    # time travel across the overwrite still reads the original data
+    assert lt.scan(version=1).to_pydict() == {"k": [1, 2], "v": [1.0, 2.0]}
+
+
+def test_compaction_identity_and_time_travel_byte_stability(tmp_path):
+    lt = _lt(tmp_path)
+    for i in range(6):
+        lt.append(_t(k=[i, i], v=[float(i), float(i) + 0.5]))
+    pre_head = lt.scan()
+    pre_v2 = lt.scan(version=2)
+    raw_v2 = (
+        tmp_path / "tbl" / "_meta" / ("manifest-%010d.json" % 2)
+    ).read_bytes()
+    m = lt.compact(target_rows=1_000)
+    assert m is not None and m.operation == "compact"
+    assert len(m.files) == 1  # 6 small files merged into one
+    lt2 = LakeTable(str(tmp_path / "tbl"))  # no memo: read from disk
+    # the head's CONTENT is unchanged by compaction (row order included:
+    # compaction rewrites the concatenated snapshot in order)
+    assert lt2.scan().equals(pre_head)
+    # AS OF a pre-compaction version is BYTE-identical: same manifest
+    # bytes on disk, same arrow table out
+    assert (
+        tmp_path / "tbl" / "_meta" / ("manifest-%010d.json" % 2)
+    ).read_bytes() == raw_v2
+    assert lt2.scan(version=2).equals(pre_v2)
+    # nothing to merge -> no new snapshot
+    assert lt2.compact() is None
+
+
+def test_manifest_stats_prune_whole_files(tmp_path):
+    lt = _lt(tmp_path)
+    lt.append(_t(k=[0, 1], v=[0.0, 1.0]))
+    lt.append(_t(k=[10, 11], v=[10.0, 11.0]))
+    lt.append(_t(k=[20, 21], v=[20.0, 21.0]))
+    out = lt.scan(pruning=[["k", ">=", 10], ["k", "<", 20]])
+    assert out.to_pydict()["k"] == [10, 11]
+    assert lt.counters["files_pruned"] == 2
+    assert lt.counters["files_scanned"] == 1
+    # a file that PREDATES a column is all-NULL there: any comparison
+    # on that column excludes it without touching bytes
+    lt.append(_t(k=[30], v=[30.0], score=[0.9]))
+    out = lt.scan(pruning=[["score", ">", 0.5]])
+    assert out.to_pydict()["k"] == [30]
+    # conservative: unknown column / op / non-numeric literal never prune
+    assert lt.scan(pruning=[["nope", ">", 1]]).num_rows == 7
+    assert lt.scan(pruning=[["k", "!=", 1]]).num_rows == 7
+
+
+def test_stats_exclude_file_is_conservative():
+    st = {"min": 5, "max": 10, "nulls": 1}
+    assert stats_exclude_file(st, ">", 10)
+    assert stats_exclude_file(st, ">=", 11)
+    assert stats_exclude_file(st, "<", 5)
+    assert stats_exclude_file(st, "<=", 4)
+    assert stats_exclude_file(st, "==", 42)
+    assert not stats_exclude_file(st, ">", 9.5)
+    assert not stats_exclude_file(st, "==", 7)
+    # missing stats, unknown ops, exotic literals: never exclude
+    assert not stats_exclude_file(None, ">", 1)
+    assert not stats_exclude_file({"min": None, "max": 3}, ">", 1)
+    assert not stats_exclude_file(st, "!=", 1)
+    assert not stats_exclude_file(st, ">", "ten")
+    assert not stats_exclude_file(st, ">", True)
+
+
+def test_writer_token_makes_appends_idempotent(tmp_path):
+    lt = _lt(tmp_path)
+    m1 = lt.append(_t(a=[1]), writer_id="pipe-7", writer_batch=1)
+    assert (m1.writer or {}).get("batch") == 1
+    # replaying the SAME batch returns the existing commit, appends nothing
+    m1b = lt.append(_t(a=[1]), writer_id="pipe-7", writer_batch=1)
+    assert m1b.version == m1.version
+    assert lt.counters["dedupe_hits"] == 1
+    assert lt.current_version() == 1 and lt.scan().num_rows == 1
+    # a NEWER batch from the same writer commits normally
+    m2 = lt.append(_t(a=[2]), writer_id="pipe-7", writer_batch=2)
+    assert m2.version == 2 and lt.scan().num_rows == 2
+    # recovery probe: find the dangling commit by (writer, batch)
+    found = lt.find_writer_commit("pipe-7", 2)
+    assert found is not None and found.version == 2
+    assert lt.find_writer_commit("pipe-7", 3) is None
+    assert lt.find_writer_commit("other", 1) is None
+
+
+def test_column_projection_and_empty_results(tmp_path):
+    lt = _lt(tmp_path)
+    lt.append(_t(k=[1, 2], v=[1.0, 2.0], name=["a", "b"]))
+    out = lt.scan(columns=["name", "k"])
+    assert out.schema.names == ["name", "k"]
+    with pytest.raises(LakeError, match="no column"):
+        lt.scan(columns=["ghost"])
+    # everything pruned away still yields a typed empty table
+    out = lt.scan(pruning=[["k", ">", 100]])
+    assert out.num_rows == 0 and out.schema.names == ["k", "v", "name"]
+
+
+def test_commit_conflict_is_classified_transient():
+    from fugue_tpu.lake import LakeCommitConflict
+    from fugue_tpu.workflow.fault import TRANSIENT, classify_error
+
+    assert classify_error(LakeCommitConflict("lost the CAS")) == TRANSIENT
