@@ -1137,6 +1137,9 @@ def _config6_serving_daemon() -> Dict[str, Any]:
         "clients": clients,
         "queries_per_client": queries_per_client,
         "rows_per_table": rows,
+        # this block measures the default FIFO queue; config 12 runs the
+        # predictive scheduler, so the headline rows stay comparable
+        "scheduler": "fifo",
     }
     import threading as _threading
 
@@ -1684,6 +1687,9 @@ def _config8_serving_fleet() -> Dict[str, Any]:
         "clients": clients,
         "queries_per_client": queries_per_client,
         "rows_per_table": rows,
+        # this block measures the default FIFO queue; config 12 runs the
+        # predictive scheduler, so the fleet rows stay comparable
+        "scheduler": "fifo",
     }
 
     def _fleet_conf(tmp: str) -> Dict[str, Any]:
@@ -2035,6 +2041,401 @@ def _config11_lake() -> Dict[str, Any]:
     return out
 
 
+def _config12_overload() -> Dict[str, Any]:
+    """Overload survival (ISSUE 18): a heavy-tailed query mix (90%
+    cheap / 10% heavy, a priority submission every 10th) offered through
+    a diurnal arrival ramp at 1x and then 2x worker count, against the
+    PREDICTIVE scheduler. The 2x phase runs with an admission wait
+    budget derived from the 1x calibration (3x its p99 — the acceptance
+    bound itself), so overload SHEDS low-priority arrivals with a
+    drain-sized Retry-After instead of letting accepted latency grow
+    without bound. Reports p50/p99 of ACCEPTED work at both rates, the
+    shed vs lost split (accepted work must NEVER be lost: lost == 0 at
+    both rates), the continuous plane riding through the storm
+    (standing-pipeline folds and lake CAS commits, all landed), and an
+    autoscale up->down cycle with a HARD KILL at the ``serve.scale``
+    fault site (zero session loss)."""
+    import math
+    import os as _os
+    import tempfile
+    import threading as _threading
+
+    import numpy as np
+    import pandas as pd
+    import pyarrow as _pa
+    import pyarrow.parquet as _pq
+
+    from fugue_tpu.lake import LakeTable
+    from fugue_tpu.serve import (
+        ServeAPIError,
+        ServeClient,
+        ServeDaemon,
+        ServeFleet,
+    )
+    from fugue_tpu.testing.faults import FaultPlan, FaultSpec, inject_faults
+
+    sessions = 4
+    queries_per_worker = 12
+    rows = _scale(200_000)
+    cheap_sql = "SELECT k, SUM(v) AS s FROM t GROUP BY k"
+    heavy_sql = (
+        "SELECT k, SUM(v) AS s, COUNT(*) AS c, MAX(v) AS hi, "
+        "MIN(v) AS lo, AVG(v) AS av FROM t GROUP BY k"
+    )
+    out: Dict[str, Any] = {
+        "scheduler": "predictive",
+        "sessions": sessions,
+        "queries_per_worker": queries_per_worker,
+        "rows_per_table": rows,
+        "mix": {"heavy_fraction": 0.1, "priority_every": 10},
+    }
+
+    def _daemon_conf(tmp: str, max_wait: float) -> Dict[str, Any]:
+        return {
+            "fugue.serve.scheduler": "predictive",
+            "fugue.serve.state_path": tmp + "/state",
+            "fugue.serve.max_concurrent": sessions,
+            "fugue.serve.breaker.threshold": 0,
+            # execution, not cache reads (config 6 idiom): a result hit
+            # would collapse the repeated mix into no load at all
+            "fugue.serve.result_cache": False,
+            "fugue.serve.admission.max_predicted_wait": max_wait,
+        }
+
+    def _offered_phase(
+        workers_per_session: int, max_wait: float
+    ) -> Dict[str, Any]:
+        tmp = tempfile.mkdtemp(prefix="fugue_overload_bench_")
+        latencies: list = []
+        shed: list = []
+        lost: list = []
+        errors: list = []
+        lock = _threading.Lock()
+        with ServeDaemon(_daemon_conf(tmp, max_wait)) as daemon:
+            host, port = daemon.address
+            rng = np.random.default_rng(18)
+            handles = []
+            for _ in range(sessions):
+                # shed must SURFACE (503 + Retry-After), not vanish into
+                # the client's transparent retry loop: retries=0
+                c = ServeClient(host, port, retries=0, timeout=600)
+                sid = c.create_session()
+                pdf = pd.DataFrame(
+                    {
+                        "k": rng.integers(0, 64, rows).astype(np.int64),
+                        "v": rng.random(rows),
+                    }
+                )
+                daemon.sessions.get(sid).save_table(
+                    "t", daemon.engine.to_df(pdf)
+                )
+                # warm BOTH tails' programs and seed the cost history
+                c.sql(sid, cheap_sql)
+                c.sql(sid, heavy_sql)
+                handles.append((c, sid))
+
+            def worker(c: Any, sid: str, seed: int) -> None:
+                wrng = np.random.default_rng(seed)
+                mine = []
+                for i in range(queries_per_worker):
+                    # diurnal ramp: quiet -> peak (no gap) -> quiet
+                    time.sleep(
+                        0.04
+                        * (1 + math.cos(2 * math.pi * i / queries_per_worker))
+                        / 2
+                    )
+                    sql = (
+                        heavy_sql if wrng.random() < 0.1 else cheap_sql
+                    )
+                    prio = 100 if i % 10 == 0 else 0
+                    t0 = time.perf_counter()
+                    try:
+                        jid = c.submit_async(
+                            sid, sql, priority=prio, collect=False
+                        )
+                    except ServeAPIError as ex:
+                        if ex.status == 503:
+                            with lock:
+                                shed.append(sid)
+                            continue
+                        with lock:
+                            errors.append(repr(ex))
+                        continue
+                    # accepted work is COMMITTED: it must complete
+                    try:
+                        r = c.wait(jid)
+                        mine.append((time.perf_counter() - t0) * 1000.0)
+                        if r["status"] != "done":
+                            with lock:
+                                lost.append(r.get("error"))
+                    except Exception as ex:  # pragma: no cover - in json
+                        with lock:
+                            lost.append(repr(ex))
+                with lock:
+                    latencies.extend(mine)
+
+            threads = [
+                _threading.Thread(target=worker, args=(c, sid, 100 + j))
+                for j, (c, sid) in enumerate(handles)
+                for _ in range(workers_per_session)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            rej = daemon.status()["backpressure"]["rejections"]
+        offered = sessions * workers_per_session * queries_per_worker
+        res: Dict[str, Any] = {
+            "workers": sessions * workers_per_session,
+            "offered": offered,
+            "accepted": len(latencies),
+            "shed": len(shed),
+            "shed_counted_by_daemon": rej.get("shed", 0),
+            "lost": len(lost),
+            "errors": errors,
+            "wall_secs": round(wall, 4),
+            "wait_budget_secs": max_wait,
+        }
+        if latencies:
+            res["p50_ms"] = round(float(np.percentile(latencies, 50)), 2)
+            res["p99_ms"] = round(float(np.percentile(latencies, 99)), 2)
+        return res
+
+    # 1x calibration: one worker per session, an effectively-unbounded
+    # wait budget — nothing sheds, p99 is the baseline
+    rate_1x = _offered_phase(1, 600.0)
+    out["rate_1x"] = rate_1x
+    p99_1x_secs = rate_1x.get("p99_ms", 1000.0) / 1000.0
+    # 2x overload: double the workers, and bound accepted wait at 3x the
+    # calibrated p99 (the acceptance bound) so excess arrivals shed
+    budget = max(0.1, round(3.0 * p99_1x_secs, 3))
+    rate_2x = _offered_phase(2, budget)
+    out["rate_2x"] = rate_2x
+    if "p99_ms" in rate_1x and "p99_ms" in rate_2x:
+        ratio = rate_2x["p99_ms"] / max(rate_1x["p99_ms"], 1e-9)
+        out["p99_ratio_2x_over_1x"] = round(ratio, 2)
+        out["accepted_p99_within_3x"] = bool(ratio <= 3.0)
+    out["zero_accepted_lost"] = (
+        rate_1x["lost"] == 0 and rate_2x["lost"] == 0
+    )
+
+    # -- the continuous plane through the storm ----------------------------
+    # a standing pipeline folding waves and a lake table taking CAS
+    # commits while a 2x burst saturates the SAME process: overload may
+    # shed interactive arrivals, but committed continuous work lands
+    def _continuous_block() -> Dict[str, Any]:
+        tmp = tempfile.mkdtemp(prefix="fugue_overload_cont_")
+        src = _os.path.join(tmp, "in")
+        _os.makedirs(src)
+        rng = np.random.default_rng(19)
+        waves = 6
+        rows_per_wave = _scale(20_000)
+
+        def land(i: int) -> None:
+            pdf = pd.DataFrame(
+                {
+                    "k": rng.integers(0, 8, rows_per_wave).astype(np.int64),
+                    "v": rng.random(rows_per_wave),
+                }
+            )
+            t = _os.path.join(src, f".w{i}.tmp")
+            _pq.write_table(
+                _pa.Table.from_pandas(pdf, preserve_index=False), t
+            )
+            _os.replace(t, _os.path.join(src, f"w{i}.parquet"))
+
+        lake = LakeTable(tmp + "/lake", conf={
+            "fugue.lake.commit.backoff": 0.002,
+            "fugue.lake.commit.retries": 200,
+        })
+        commits_tried = 0
+        with ServeDaemon(_daemon_conf(tmp, 0.5)) as daemon:
+            host, port = daemon.address
+            c = ServeClient(host, port, retries=0, timeout=600)
+            sid = c.create_session()
+            pdf = pd.DataFrame(
+                {
+                    "k": rng.integers(0, 64, rows).astype(np.int64),
+                    "v": rng.random(rows),
+                }
+            )
+            daemon.sessions.get(sid).save_table(
+                "t", daemon.engine.to_df(pdf)
+            )
+            c.sql(sid, cheap_sql)
+            land(0)
+            c.register_pipeline(
+                sid,
+                {
+                    "name": "sess",
+                    "source": src,
+                    "keys": ["k"],
+                    "aggs": [["s", "sum", "v"], ["c", "count", "v"]],
+                    "batch_rows": rows_per_wave,
+                },
+            )
+            shed_local: list = []
+            stop = _threading.Event()
+
+            def storm() -> None:
+                while not stop.is_set():
+                    try:
+                        jid = c.submit_async(sid, cheap_sql, collect=False)
+                        c.wait(jid)
+                    except ServeAPIError as ex:
+                        if ex.status != 503:
+                            raise
+                        shed_local.append(1)
+                        time.sleep(0.01)
+
+            stormers = [
+                _threading.Thread(target=storm) for _ in range(sessions)
+            ]
+            for t in stormers:
+                t.start()
+            fold_errors: list = []
+            try:
+                for i in range(1, waves):
+                    land(i)
+                    rep = c.step_pipeline(sid, "sess")
+                    if not (rep["files"] == 1 and rep["refreshed"]):
+                        fold_errors.append(rep)
+                    commits_tried += 1
+                    lake.append(
+                        _pa.table(
+                            {
+                                "w": np.full(1000, i, dtype=np.int64),
+                                "v": rng.random(1000),
+                            }
+                        )
+                    )
+            finally:
+                stop.set()
+                for t in stormers:
+                    t.join(timeout=60)
+            snap = c.pipeline(sid, "sess")
+        folds = snap["progress"]["batches"]
+        return {
+            "waves_landed": waves,
+            "pipeline_folds": folds,
+            "folds_lost": waves - folds,
+            "fold_errors": fold_errors,
+            "lake_commits": lake.counters["commits"],
+            "commits_lost": commits_tried - lake.current_version(),
+            "interactive_shed_during_storm": len(shed_local),
+        }
+
+    out["continuous_through_storm"] = _continuous_block()
+
+    # -- autoscale cycle with a hard kill at serve.scale -------------------
+    def _autoscale_block() -> Dict[str, Any]:
+        tmp = tempfile.mkdtemp(prefix="fugue_overload_scale_")
+        conf = {
+            "fugue.serve.state_path": tmp + "/state",
+            "fugue.serve.max_concurrent": 1,
+            "fugue.serve.breaker.threshold": 0,
+            "fugue.serve.result_cache": False,
+            "fugue.serve.fleet.health_interval": 0.05,
+            "fugue.serve.fleet.death_threshold": 1,
+            # the controller thread is parked (interval=60): the bench
+            # drives tick() deterministically, like the chaos tests
+            "fugue.serve.autoscale.max_replicas": 2,
+            "fugue.serve.autoscale.interval": 60.0,
+            "fugue.serve.autoscale.scale_up_queue": 1,
+            "fugue.serve.autoscale.sustain_ticks": 1,
+            "fugue.serve.autoscale.idle_ticks": 1,
+            "fugue.serve.autoscale.cooldown": 0.0,
+        }
+        res: Dict[str, Any] = {}
+        with ServeFleet(conf, replicas=1) as fleet:
+            scaler = fleet.autoscaler
+            c = ServeClient([fleet.address], retries=10, timeout=600)
+            sid0 = c.create_session()
+            c.sql(
+                sid0,
+                "CREATE [[0,1],[0,2],[1,3]] SCHEMA k:long,v:long",
+                save_as="t",
+                collect=False,
+            )
+            agg = "SELECT k, SUM(v) AS s FROM t GROUP BY k"
+            c.sql(sid0, agg)
+            # pressure: async bursts until a tick catches the queue deep
+            # enough to add hardware
+            t0 = time.perf_counter()
+            jids: list = []
+            decision = ""
+            for _ in range(40):
+                jids.extend(
+                    c.submit_async(sid0, agg, collect=False)
+                    for _ in range(8)
+                )
+                decision = scaler.tick()
+                if decision.startswith("scale_up"):
+                    break
+            res["scaled_up"] = decision.startswith("scale_up")
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if fleet.router.check_health().get("r1") == "healthy":
+                    break
+                time.sleep(0.05)
+            res["scale_up_secs"] = round(time.perf_counter() - t0, 4)
+            for jid in jids:
+                c.wait(jid)
+            # a fresh session lands on the new replica, then a HARD KILL
+            # mid-scale-down degrades to an ordinary death failover
+            sid1 = c.create_session()
+            c.sql(
+                sid1,
+                "CREATE [[0,1],[0,2],[1,3]] SCHEMA k:long,v:long",
+                save_as="t",
+                collect=False,
+            )
+            victim_rid = fleet.router.affinity()[sid1]
+            res["victim_replica"] = victim_rid
+            plan = FaultPlan(
+                FaultSpec(
+                    "serve.scale", f"down {victim_rid}", times=1,
+                    error=lambda: OSError("injected kill mid-scale-down"),
+                ),
+                seed=12,
+            )
+            t0 = time.perf_counter()
+            try:
+                with inject_faults(plan):
+                    fleet.retire_replica(victim_rid)
+                res["hard_kill_injected"] = False
+            except OSError:
+                res["hard_kill_injected"] = True
+            survivor = next(
+                r for r in fleet.replica_ids if r != victim_rid
+            )
+            deadline = time.monotonic() + 30
+            adopted = False
+            while time.monotonic() < deadline:
+                if fleet.router.affinity().get(sid1) == survivor:
+                    adopted = True
+                    break
+                time.sleep(0.05)
+            res["adoption_secs"] = round(time.perf_counter() - t0, 4)
+            r = c.sql(sid1, agg)
+            res["sessions_lost"] = 0 if (
+                adopted
+                and r["status"] == "done"
+                and sorted(r["result"]["rows"]) == [[0, 3], [1, 3]]
+            ) else 1
+            # the retry of the retire completes the cycle cleanly
+            fleet.retire_replica(victim_rid)
+            res["replicas_after_cycle"] = len(fleet.replica_ids)
+            d = scaler.describe()
+            res["scale_ups"] = d["scale_ups"]
+        return res
+
+    out["autoscale_cycle"] = _autoscale_block()
+    return out
+
+
 def _bench() -> Dict[str, Any]:
     headline = _bench_headline()
     configs = {
@@ -2050,6 +2451,7 @@ def _bench() -> Dict[str, Any]:
         "9_continuous": _config9_continuous(),
         "10_scaling": _config10_scaling(),
         "11_lake": _config11_lake(),
+        "12_overload": _config12_overload(),
     }
     headline["detail"]["configs"] = configs
     # the scaling curve's summary rides the headline contract: devices

@@ -264,6 +264,35 @@ def main(argv: Optional[List[str]] = None) -> int:
                 file=sys.stdout,
             )
             failed = True
+        # admission leg (ISSUE 18): the predictive scheduler's
+        # admit/shed/defer decisions replayed against a canned stats
+        # fixture — two replays must agree and match the pinned contract
+        try:
+            from fugue_tpu.analysis.selftest import (
+                _ADMISSION_EXPECTED,
+                admission_check_failed,
+                run_admission_check,
+            )
+
+            decisions = run_admission_check()
+            adm_failed = admission_check_failed(decisions)
+            if adm_failed:
+                for got, want in zip(decisions, _ADMISSION_EXPECTED):
+                    if got != want:
+                        print(f"  {got!r} != expected {want!r}",
+                              file=sys.stdout)
+            print(
+                f"admission-check {'FAILED' if adm_failed else 'passed'}: "
+                f"{len(decisions)} decisions replayed",
+                file=sys.stdout,
+            )
+            failed = failed or adm_failed
+        except Exception as ex:
+            print(
+                f"admission-check FAILED: {type(ex).__name__}: {ex}",
+                file=sys.stdout,
+            )
+            failed = True
         # both planes, one command: the workflow-corpus gate above plus
         # the FLN source lint of the installed tree
         src_errors = _run_source_lint(None, args.baseline, floor, sys.stdout)

@@ -24,15 +24,25 @@ place declaring what CHANGES.md used to carry as prose.
 # up into serve locks. Leaf bookkeeping locks (metrics, faults, stats)
 # come last: they are acquired everywhere and may never hold anything.
 CANONICAL_LOCK_ORDER = (
-    # fleet plane (outermost: the router owns replicas and affinity;
-    # it reaches replicas over HTTP only, never into their locks —
+    # fleet plane (outermost: the fleet's replica-set mutations hold
+    # their lock across router failover and replica HTTP forwards; the
+    # autoscaler's own lock guards decision counters only and is never
+    # held across an action. The router owns replicas and affinity and
+    # reaches replicas over HTTP only, never into their locks —
     # failover serializes above the routing map)
+    "serve.fleet.ServeFleet._lock",
+    "serve.autoscale.FleetAutoscaler._lock",
     "serve.fleet.FleetRouter._failover_lock",
     "serve.fleet.FleetRouter._lock",
     # serve plane (owns requests and jobs)
     "serve.daemon.ServeDaemon._first_query_lock",
     "serve.daemon.ServeDaemon._views_lock",
     "serve.scheduler.JobScheduler._lock",
+    # predictive-admission bookkeeping: the scheduler updates these
+    # under its own lock (submit/pick hooks), so they rank below it;
+    # O(1) arithmetic only, nothing is acquired under them
+    "serve.admission.QueryCostModel._lock",
+    "serve.admission.PredictiveAdmission._lock",
     "serve.session.SessionManager._lock",
     # stream plane: the standing-pipeline step claim sits ABOVE the
     # session lock (a view refresh calls session.save_table) but the

@@ -20,6 +20,7 @@ from fugue_tpu.constants import (
     FUGUE_CONF_OBS_PROFILE,
     FUGUE_CONF_OBS_SLOW_QUERY_MS,
     FUGUE_CONF_OBS_TRACE_PATH,
+    FUGUE_CONF_SERVE_AUTOSCALE_MAX_REPLICAS,
     FUGUE_CONF_SERVE_FLEET_REPLICAS,
     FUGUE_CONF_SERVE_MAX_CONCURRENT,
     FUGUE_CONF_SERVE_STATE_PATH,
@@ -381,6 +382,66 @@ class LakeConfRule(Rule):
                 "table and fugue.lake.serve.path is empty: the key is "
                 "silently inert — point a LOAD/SAVE at a lake:// URI "
                 "(or drop the fugue.lake.* keys)",
+            )
+
+
+@register_rule
+class AutoscaleConfRule(Rule):
+    code = "FWF508"
+    severity = Severity.WARN
+    description = (
+        "fugue.serve.autoscale.* keys set without an elastic fleet "
+        "(inert), or autoscaling without a shared serve state path "
+        "(scale-down drains have no journal for the survivor to adopt)"
+    )
+
+    def check(self, ctx: Any) -> Iterable[Diagnostic]:
+        autoscale_keys = sorted(
+            k for k in ctx.conf.keys()
+            if k.startswith("fugue.serve.autoscale.")
+        )
+        if not autoscale_keys:
+            return
+        try:
+            max_replicas = _convert(
+                ctx.conf.get(FUGUE_CONF_SERVE_AUTOSCALE_MAX_REPLICAS, 0), int
+            )
+        except Exception:
+            return  # FWF202 already rejects the unconvertible value
+        if max_replicas <= 0:
+            # the master switch is off (or absent): every other
+            # autoscale key is silently inert
+            for key in autoscale_keys:
+                if key == FUGUE_CONF_SERVE_AUTOSCALE_MAX_REPLICAS:
+                    continue
+                yield self.diag(
+                    f"'{key}' is set but "
+                    f"{FUGUE_CONF_SERVE_AUTOSCALE_MAX_REPLICAS} is unset "
+                    "(or <= 0): no autoscaler is ever constructed, so the "
+                    "key is silently inert — set a positive max_replicas "
+                    "(or drop the fugue.serve.autoscale.* keys)",
+                )
+            return
+        if FUGUE_CONF_SERVE_FLEET_REPLICAS not in ctx.conf:
+            yield self.diag(
+                f"{FUGUE_CONF_SERVE_AUTOSCALE_MAX_REPLICAS}="
+                f"{max_replicas} but {FUGUE_CONF_SERVE_FLEET_REPLICAS} "
+                "is absent: the autoscaler only runs inside a ServeFleet, "
+                "and this conf never constructs one — an embedded daemon "
+                "ignores every fugue.serve.autoscale.* key (set "
+                "fugue.serve.fleet.replicas, or drop the autoscale keys)",
+            )
+        state_path = str(
+            ctx.conf.get(FUGUE_CONF_SERVE_STATE_PATH, "") or ""
+        ).strip()
+        if state_path == "":
+            yield self.diag(
+                f"{FUGUE_CONF_SERVE_AUTOSCALE_MAX_REPLICAS}="
+                f"{max_replicas} but no shared fugue.serve.state_path: "
+                "scale-down drains a replica's sessions to its journal "
+                "for a survivor to adopt — without one there is nothing "
+                "to adopt, so every autoscale retire loses the sessions "
+                "it drains",
             )
 
 

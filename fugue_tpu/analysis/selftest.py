@@ -290,3 +290,116 @@ def run_explain_check() -> List[Tuple[str, str]]:
     json.dumps(report.to_dict())
     out.append(("explain_sql", report.to_text()))
     return out
+
+
+# ---------------------------------------------------------------------------
+# admission leg (ISSUE 18): the predictive scheduler's admit/shed/defer
+# decisions replayed against a canned stats fixture
+# ---------------------------------------------------------------------------
+class _CannedStats:
+    """The stats-store surface the cost model reads, with fixed history."""
+
+    def __init__(self, history: Dict[str, List[Dict[str, Any]]]):
+        self._h = history
+
+    def history(self, fp: str) -> List[Dict[str, Any]]:
+        return list(self._h.get(fp, []))
+
+
+def _canned_obs(total_ms: float, device_bytes: int) -> Dict[str, Any]:
+    return {
+        "workflow": "selftest",
+        "total_ms": total_ms,
+        "tasks": {"t1": {"device_bytes": device_bytes}},
+    }
+
+
+# one long ETL query with real history, one cheap dashboard query with
+# real history, and an unknown ad-hoc shape that falls to the defaults
+_ADMISSION_FIXTURE: Dict[str, List[Dict[str, Any]]] = {
+    "fp-etl": [_canned_obs(6000.0, 700)],
+    "fp-dash": [_canned_obs(100.0, 100)],
+}
+
+# (label, fingerprint, priority) — replayed in order against ONE slot,
+# a 1000-byte ledger at 0.8 memory fraction, and a 2s wait budget
+_ADMISSION_SEQUENCE: List[Tuple[str, str, int]] = [
+    ("etl-backfill", "fp-etl", 0),
+    ("dashboard", "fp-dash", 0),
+    ("dashboard-priority", "fp-dash", 5),
+    ("adhoc", "fp-unknown", 0),
+    ("adhoc-priority", "fp-unknown", 9),
+]
+
+# the pinned contract: admit from observed history, shed below the
+# overload priority floor, priority punches through the shed gate, the
+# default estimate sheds too, and a too-big default DEFERS on memory
+# even at high priority — any drift in the cost model or the admission
+# arithmetic moves one of these strings
+_ADMISSION_EXPECTED: List[Tuple[str, str]] = [
+    ("etl-backfill", "admit wall_ms=6000 device_bytes=700"),
+    ("dashboard", "shed"),
+    ("dashboard-priority", "admit wall_ms=100 device_bytes=100"),
+    ("adhoc", "shed"),
+    ("adhoc-priority", "defer"),
+]
+
+
+def _replay_admission() -> List[Tuple[str, str]]:
+    from fugue_tpu.serve.admission import make_admission
+
+    adm = make_admission(
+        _CannedStats(_ADMISSION_FIXTURE),
+        max_concurrent=1,
+        memory_fraction=0.8,
+        default_ms=250.0,
+        default_bytes=600,
+        budget_bytes_fn=lambda: 1000,
+    )
+    max_wait = 2.0
+    running: List[str] = []
+    decisions: List[Tuple[str, str]] = []
+    for label, fp, priority in _ADMISSION_SEQUENCE:
+        est = adm.model.estimate_fingerprint(fp)
+        # the daemon's shed rule: predicted drain over the wait budget
+        # sets the overload ratio, and the ratio IS the priority floor
+        ratio = adm.predicted_drain_secs() / max_wait
+        if ratio > 1.0 and priority < int(ratio):
+            decisions.append((label, "shed"))
+            continue
+        if not adm.fits_memory(est, anything_running=bool(running)):
+            decisions.append((label, "defer"))
+            continue
+        adm.job_queued(label, est)
+        if not running:  # one slot: first admitted job runs, rest queue
+            adm.job_started(label)
+            running.append(label)
+        decisions.append(
+            (
+                label,
+                f"admit wall_ms={est.wall_ms:g} "
+                f"device_bytes={est.device_bytes}",
+            )
+        )
+    return decisions
+
+
+def run_admission_check() -> List[Tuple[str, str]]:
+    """``--self-test`` admission leg: replay the canned submission
+    sequence through a real PredictiveAdmission TWICE — the two replays
+    must agree exactly (determinism), and the decisions must match the
+    pinned contract (no silent drift in cost estimation, the shed
+    priority floor, or memory deferral). Returns the decision pairs for
+    the CLI to count."""
+    first = _replay_admission()
+    second = _replay_admission()
+    if first != second:
+        raise AssertionError(
+            "admission replay is not deterministic: "
+            f"{first!r} != {second!r}"
+        )
+    return first
+
+
+def admission_check_failed(results: List[Tuple[str, str]]) -> bool:
+    return results != _ADMISSION_EXPECTED

@@ -80,6 +80,23 @@ FUGUE_CONF_SERVE_FLEET_HEALTH_INTERVAL = "fugue.serve.fleet.health_interval"
 FUGUE_CONF_SERVE_FLEET_DEATH_THRESHOLD = "fugue.serve.fleet.death_threshold"
 FUGUE_CONF_SERVE_FLEET_RESULT_CACHE_DIR = "fugue.serve.fleet.result_cache_dir"
 FUGUE_CONF_SERVE_FLEET_DEVICE_SLICES = "fugue.serve.fleet.device_slices"
+FUGUE_CONF_SERVE_SCHEDULER = "fugue.serve.scheduler"
+FUGUE_CONF_SERVE_ADMISSION_MEMORY_FRACTION = (
+    "fugue.serve.admission.memory_fraction"
+)
+FUGUE_CONF_SERVE_ADMISSION_MAX_WAIT = "fugue.serve.admission.max_predicted_wait"
+FUGUE_CONF_SERVE_ADMISSION_DEFAULT_MS = "fugue.serve.admission.default_cost_ms"
+FUGUE_CONF_SERVE_ADMISSION_DEFAULT_BYTES = (
+    "fugue.serve.admission.default_cost_bytes"
+)
+FUGUE_CONF_SERVE_AUTOSCALE_MAX_REPLICAS = "fugue.serve.autoscale.max_replicas"
+FUGUE_CONF_SERVE_AUTOSCALE_MIN_REPLICAS = "fugue.serve.autoscale.min_replicas"
+FUGUE_CONF_SERVE_AUTOSCALE_INTERVAL = "fugue.serve.autoscale.interval"
+FUGUE_CONF_SERVE_AUTOSCALE_UP_QUEUE = "fugue.serve.autoscale.scale_up_queue"
+FUGUE_CONF_SERVE_AUTOSCALE_UP_P99_MS = "fugue.serve.autoscale.scale_up_p99_ms"
+FUGUE_CONF_SERVE_AUTOSCALE_SUSTAIN_TICKS = "fugue.serve.autoscale.sustain_ticks"
+FUGUE_CONF_SERVE_AUTOSCALE_IDLE_TICKS = "fugue.serve.autoscale.idle_ticks"
+FUGUE_CONF_SERVE_AUTOSCALE_COOLDOWN = "fugue.serve.autoscale.cooldown"
 FUGUE_CONF_OPTIMIZE = "fugue.optimize"
 FUGUE_CONF_OPTIMIZE_CSE = "fugue.optimize.cse"
 FUGUE_CONF_OPTIMIZE_FILTER = "fugue.optimize.filter_pushdown"
@@ -633,6 +650,122 @@ def _declare_defaults() -> None:
         False,
         "give each fleet replica its own slice of jax.devices() via "
         "fugue.jax.devices (needs >= 1 device per replica)",
+        in_defaults=False,
+    )
+    # overload-survival plane (ISSUE 18): the predictive scheduler
+    # replaces FIFO job pickup with shortest-predicted-job-first inside
+    # per-tenant fairness, costs each query from its fingerprint's
+    # stats-store history (fugue.stats.path), and admits-or-queues on
+    # PREDICTED device bytes against the governed memory budget instead
+    # of rejecting on observed fill. fugue.serve.admission.* tune the
+    # predictions; fugue.serve.autoscale.* drive the fleet autoscaler
+    # (scale up on sustained queue/latency pressure, drain-then-retire
+    # on idle via the same journal-adoption move as a rolling restart).
+    r(
+        FUGUE_CONF_SERVE_SCHEDULER,
+        str,
+        "fifo",
+        "job scheduling policy: fifo | predictive (stats-store cost "
+        "model, shortest-job-first within per-tenant fairness, "
+        "priority/deadline submission fields)",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_SERVE_ADMISSION_MEMORY_FRACTION,
+        float,
+        0.8,
+        "fraction of the governed device-memory budget the predictive "
+        "scheduler plans into: a queued job whose predicted bytes would "
+        "push the in-flight prediction over it waits for headroom "
+        "instead of starting (0 = predicted-memory gating off)",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_SERVE_ADMISSION_MAX_WAIT,
+        float,
+        0.0,
+        "seconds of predicted queue drain beyond which new submissions "
+        "are shed in priority order with 503 + Retry-After sized from "
+        "the predicted drain (0 = predictive shedding off)",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_SERVE_ADMISSION_DEFAULT_MS,
+        float,
+        250.0,
+        "assumed wall milliseconds for a query fingerprint with no "
+        "stats-store history",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_SERVE_ADMISSION_DEFAULT_BYTES,
+        int,
+        32 * 1024 * 1024,
+        "assumed peak device bytes for a query fingerprint with no "
+        "stats-store history",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_SERVE_AUTOSCALE_MAX_REPLICAS,
+        int,
+        0,
+        "replica ceiling the fleet autoscaler may grow to (0 = "
+        "autoscaler off; must exceed fugue.serve.fleet.replicas to "
+        "ever scale up)",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_SERVE_AUTOSCALE_MIN_REPLICAS,
+        int,
+        1,
+        "replica floor scale-down must never drain below",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_SERVE_AUTOSCALE_INTERVAL,
+        float,
+        2.0,
+        "seconds between autoscaler pressure samples",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_SERVE_AUTOSCALE_UP_QUEUE,
+        int,
+        4,
+        "mean queued jobs per replica that counts one sample as "
+        "pressured",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_SERVE_AUTOSCALE_UP_P99_MS,
+        float,
+        0.0,
+        "fleet p99 job milliseconds that counts one sample as "
+        "pressured (0 = queue-depth signal only)",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_SERVE_AUTOSCALE_SUSTAIN_TICKS,
+        int,
+        3,
+        "consecutive pressured samples before a scale-up (one spike "
+        "must not spawn a replica)",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_SERVE_AUTOSCALE_IDLE_TICKS,
+        int,
+        10,
+        "consecutive idle samples (no queue, no running jobs) before "
+        "drain-then-retire of the newest surplus replica",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_SERVE_AUTOSCALE_COOLDOWN,
+        float,
+        10.0,
+        "seconds after any scale action during which the autoscaler "
+        "only observes",
         in_defaults=False,
     )
     # cost-based DAG optimizer (fugue_tpu/optimize): the rewrite phase

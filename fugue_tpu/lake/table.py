@@ -125,6 +125,8 @@ class LakeTable:
             "dedupe_hits": 0,
             "files_scanned": 0,
             "files_pruned": 0,
+            "files_vacuumed": 0,
+            "vacuum_kept_grace": 0,
         }
         self._metrics = metrics
         if metrics is not None:
@@ -653,6 +655,58 @@ class LakeTable:
         return pa.Table.from_arrays(arrays, schema=out_schema)
 
     # ---- maintenance -----------------------------------------------------
+
+    def vacuum(self, grace_secs: float = 3600.0) -> Dict[str, Any]:
+        """Delete orphaned data files: parquet parts present in the
+        ``data/`` listing but referenced by NO committed manifest of ANY
+        version (compaction keeps old files referenced — time travel to
+        pre-compaction versions must still read them, so the live set is
+        the union over the WHOLE manifest chain, never just the head).
+
+        Orphans are how crash-interrupted writers leave their mark: data
+        files land before the manifest CAS, so a writer killed between
+        the two (chaos site ``lake.commit``) leaves parts no manifest
+        ever adopted. ``grace_secs`` protects the mirror-image race — a
+        writer that has landed its parts but not yet WON its CAS looks
+        identical to a corpse — by skipping anything younger than the
+        grace window (mtime); concurrent in-flight commits are always
+        younger than any sane grace.
+
+        Safe to re-run and safe to crash mid-sweep: every delete is of a
+        file no manifest references, so the worst outcome of a partial
+        sweep is leftover orphans for the next vacuum. Returns/counts
+        ``files_vacuumed`` and ``vacuum_kept_grace``."""
+        head = self.current_version()
+        out = {"removed": 0, "kept_grace": 0, "live_files": 0, "bytes": 0}
+        if head == 0:
+            return out
+        live = set()
+        for v in range(1, head + 1):
+            live.update(f.path for f in self.read_manifest(v).files)
+        out["live_files"] = len(live)
+        data_dir = self._fs.join(self._uri, DATA_DIR)
+        if not self._fs.exists(data_dir):
+            return out
+        now = time.time()
+        for name in self._fs.listdir(data_dir):
+            base = uri_basename(name)
+            if f"{DATA_DIR}/{base}" in live:
+                continue
+            path = self._fs.join(data_dir, base)
+            try:
+                info = self._fs.info(path)
+            except Exception:
+                continue  # raced another sweep: already gone
+            if now - float(info.mtime or 0.0) < grace_secs:
+                # possibly a live writer between data land and CAS win
+                self.counters["vacuum_kept_grace"] += 1
+                out["kept_grace"] += 1
+                continue
+            self._fs.rm(path)
+            self.counters["files_vacuumed"] += 1
+            out["removed"] += 1
+            out["bytes"] += int(info.size or 0)
+        return out
 
     def describe(self) -> Dict[str, Any]:
         head = self.current_version()

@@ -44,6 +44,7 @@ METRIC_NAME_PREFIXES = (
     "fugue_engine_",
     "fugue_serve_",
     "fugue_fleet_",
+    "fugue_autoscale_",
     "fugue_obs_",
     "fugue_stats_",
     "fugue_stream_",

@@ -92,14 +92,23 @@ def backoff_delay(
     attempt: int, rng: Any, server_hint: Optional[float] = None
 ) -> float:
     """Bounded-exponential retry delay shared by the RPC client and
-    :class:`fugue_tpu.serve.client.ServeClient`: 50ms doubling with 10%
-    jitter, capped at 2s, then floored at the server's (already capped)
-    ``Retry-After`` hint — one backoff policy, not two drifting copies."""
-    delay = min(
-        0.05 * (2 ** (attempt - 1)) * (1.0 + rng.random() * 0.1), 2.0
-    )
+    :class:`fugue_tpu.serve.client.ServeClient`: 50ms doubling with full
+    jitter, capped at 2s — one backoff policy, not two drifting copies.
+
+    A server's (already capped) ``Retry-After`` hint is a FLOOR, with
+    the jittered exponential added ON TOP of it. The old policy
+    (``max(delay, hint)``) made the hint an exact release time: when a
+    fleet-wide overload 503s every client with the same predicted drain
+    hint, they all slept the identical interval and stampeded back in
+    one synchronized wave, re-triggering the very overload they were
+    told to wait out. Full jitter (rng.random() scales the whole
+    exponential term, not a 10% trim) spreads the herd across the
+    backoff window while the hint still guarantees nobody returns
+    before the server asked."""
+    base = min(0.05 * (2 ** (attempt - 1)), 2.0)
+    delay = base * rng.random()
     if server_hint is not None:
-        delay = max(delay, server_hint)
+        delay += max(0.0, server_hint)
     return delay
 
 

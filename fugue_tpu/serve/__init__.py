@@ -27,6 +27,12 @@ Quick start::
         client.close_session(sid)
 """
 
+from fugue_tpu.serve.admission import (
+    CostEstimate,
+    PredictiveAdmission,
+    QueryCostModel,
+)
+from fugue_tpu.serve.autoscale import FleetAutoscaler
 from fugue_tpu.serve.client import (
     ServeAPIError,
     ServeClient,
@@ -52,8 +58,12 @@ __all__ = [
     "BackpressureError",
     "CircuitBreaker",
     "CircuitOpenError",
+    "CostEstimate",
     "EngineSupervisor",
+    "FleetAutoscaler",
     "FleetRouter",
+    "PredictiveAdmission",
+    "QueryCostModel",
     "PoisonQueryError",
     "ServeAPIError",
     "ServeClient",

@@ -1,0 +1,277 @@
+"""Predictive admission (ISSUE 18): the cost model behind the
+overload-survival plane.
+
+The serving daemon already *observes* everything this module needs: the
+runtime-statistics store (PR 14) keeps a ring of
+:meth:`~fugue_tpu.obs.profile.RunProfile.observation` payloads per query
+fingerprint — total wall milliseconds plus per-task device bytes — and
+the memory governor (PR 4) publishes the device-byte budget. What was
+missing is the *forward* direction: before a job runs, predict what it
+will cost, and let the scheduler and admission controller plan against
+the prediction instead of reacting to the damage.
+
+:class:`QueryCostModel` turns a fingerprint's history into a
+:class:`CostEstimate` (mean wall ms, max observed peak device bytes;
+registered defaults for never-seen queries). Because a FugueSQL
+submission's DAG fingerprint only exists *after* compilation in the
+worker, the model also keeps a bounded SQL-text → fingerprint map fed
+back by the execution path (:meth:`note_fingerprint`): the first run of
+a query is costed at the defaults, every repeat is costed from its own
+history — exactly the population (hot, repeated queries) where
+prediction pays.
+
+:class:`PredictiveAdmission` owns the live planning state on top of the
+model:
+
+- **in-flight predicted bytes** — the sum of running jobs' predicted
+  peaks, maintained by the scheduler's start/finish hooks; a queued
+  job whose prediction would overflow
+  ``fugue.serve.admission.memory_fraction`` of the governed budget
+  waits for headroom instead of starting (and instead of the daemon
+  rejecting it on *observed* fill);
+- **predicted drain seconds** — backlog cost over worker slots, the
+  quantity the daemon sheds on (503 + ``Retry-After`` sized from it)
+  and the number a 503's ``Retry-After`` header carries, so clients
+  back off for as long as the queue is actually predicted to take.
+
+Everything here is advisory arithmetic under one small lock
+(``serve.admission.PredictiveAdmission._lock`` in the canonical order,
+just above the scheduler's): no filesystem access, no blocking calls —
+the stats store reads its snapshots from memory and refreshes from disk
+on its own cadence.
+"""
+
+from typing import Any, Dict, NamedTuple, Optional
+
+from fugue_tpu.testing.locktrace import tracked_lock
+from fugue_tpu.utils.hash import to_uuid
+
+# the sql-key → fingerprint feedback map is bounded: serving vocabulary
+# is finite (hot queries repeat), and an unbounded map would leak under
+# adversarial one-shot SQL
+_MAX_SQL_KEYS = 4096
+
+
+def sql_cost_key(sql: str) -> str:
+    """The submit-time identity of a query's *text* — what the cost
+    model can know before compilation produces the DAG fingerprint.
+    Whitespace-normalized so formatting differences share history."""
+    return to_uuid("serve.admission", " ".join(str(sql).split()))
+
+
+class CostEstimate(NamedTuple):
+    """One job's predicted cost. ``observed`` distinguishes a real
+    stats-store-backed estimate from the registered defaults."""
+
+    wall_ms: float
+    device_bytes: int
+    observed: bool
+
+
+class QueryCostModel:
+    """Fingerprint → :class:`CostEstimate` from stats-store history.
+
+    Stateless beyond the bounded sql-key map; safe to share between the
+    daemon's admission path and the scheduler's pick loop."""
+
+    def __init__(
+        self,
+        stats_store: Any = None,
+        default_ms: float = 250.0,
+        default_bytes: int = 32 * 1024 * 1024,
+    ):
+        self._stats = stats_store
+        self.default_ms = max(1.0, float(default_ms))
+        self.default_bytes = max(1, int(default_bytes))
+        self._lock = tracked_lock("serve.admission.QueryCostModel._lock")
+        self._sql_to_fp: Dict[str, str] = {}
+
+    # ---- fingerprint feedback -------------------------------------------
+    def note_fingerprint(self, sql_key: str, fingerprint: str) -> None:
+        """Execution-path feedback: this SQL text compiled to this DAG
+        fingerprint — the *next* submission of the same text is costed
+        from the fingerprint's history."""
+        if not sql_key or not fingerprint:
+            return
+        with self._lock:
+            if (
+                len(self._sql_to_fp) >= _MAX_SQL_KEYS
+                and sql_key not in self._sql_to_fp
+            ):
+                # drop the oldest mapping (insertion order): the hot
+                # vocabulary re-learns in one execution
+                self._sql_to_fp.pop(next(iter(self._sql_to_fp)))
+            self._sql_to_fp[sql_key] = fingerprint
+
+    def resolve(self, sql_key: str) -> Optional[str]:
+        with self._lock:
+            return self._sql_to_fp.get(sql_key)
+
+    # ---- estimates -------------------------------------------------------
+    def estimate_fingerprint(self, fingerprint: str) -> CostEstimate:
+        """Mean observed wall over the ring (a robust central tendency
+        for repeated queries), max observed peak device bytes (memory
+        planning must cover the worst observed case, not the average)."""
+        if self._stats is None or not fingerprint:
+            return CostEstimate(self.default_ms, self.default_bytes, False)
+        try:
+            history = self._stats.history(fingerprint)
+        except Exception:
+            history = []
+        if not history:
+            return CostEstimate(self.default_ms, self.default_bytes, False)
+        walls = []
+        peak = 0
+        for obs in history:
+            try:
+                walls.append(float(obs.get("total_ms") or 0.0))
+                nbytes = sum(
+                    int(t.get("device_bytes") or 0)
+                    for t in (obs.get("tasks") or {}).values()
+                )
+                peak = max(peak, nbytes)
+            except Exception:
+                continue
+        wall = sum(walls) / len(walls) if walls else self.default_ms
+        return CostEstimate(
+            max(1.0, wall), peak if peak > 0 else self.default_bytes, True
+        )
+
+    def estimate_sql(self, sql: str) -> CostEstimate:
+        """Submit-time estimate: through the feedback map when this text
+        has compiled before, defaults otherwise."""
+        fp = self.resolve(sql_cost_key(sql))
+        if fp is None:
+            return CostEstimate(self.default_ms, self.default_bytes, False)
+        return self.estimate_fingerprint(fp)
+
+
+class PredictiveAdmission:
+    """Live planning state: in-flight predicted bytes + backlog cost.
+
+    The scheduler calls :meth:`job_started` / :meth:`job_finished` and
+    :meth:`job_queued` / :meth:`job_dequeued`; the daemon reads
+    :meth:`predicted_drain_secs` and :meth:`fits_memory`."""
+
+    def __init__(
+        self,
+        model: QueryCostModel,
+        max_concurrent: int = 1,
+        memory_fraction: float = 0.8,
+        budget_bytes_fn: Any = None,
+    ):
+        self.model = model
+        self._slots = max(1, int(max_concurrent))
+        self._memory_fraction = max(0.0, float(memory_fraction))
+        # () -> governed device budget bytes (0 = ungoverned)
+        self._budget_bytes_fn = budget_bytes_fn or (lambda: 0)
+        self._lock = tracked_lock(
+            "serve.admission.PredictiveAdmission._lock"
+        )
+        self._running_bytes = 0
+        self._running_ms = 0.0
+        self._queued_ms = 0.0
+        self._running: Dict[str, CostEstimate] = {}
+        self._queued: Dict[str, CostEstimate] = {}
+
+    # ---- scheduler hooks -------------------------------------------------
+    def job_queued(self, job_id: str, est: CostEstimate) -> None:
+        with self._lock:
+            if job_id in self._queued:
+                return
+            self._queued[job_id] = est
+            self._queued_ms += est.wall_ms
+
+    def job_dequeued(self, job_id: str) -> None:
+        """The job left the queue WITHOUT starting (cancel, deadline
+        expiry, shutdown)."""
+        with self._lock:
+            est = self._queued.pop(job_id, None)
+            if est is not None:
+                self._queued_ms = max(0.0, self._queued_ms - est.wall_ms)
+
+    def job_started(self, job_id: str) -> None:
+        with self._lock:
+            est = self._queued.pop(job_id, None)
+            if est is None:
+                return
+            self._queued_ms = max(0.0, self._queued_ms - est.wall_ms)
+            self._running[job_id] = est
+            self._running_bytes += est.device_bytes
+            self._running_ms += est.wall_ms
+
+    def job_finished(self, job_id: str) -> None:
+        with self._lock:
+            est = self._running.pop(job_id, None)
+            if est is None:
+                return
+            self._running_bytes = max(
+                0, self._running_bytes - est.device_bytes
+            )
+            self._running_ms = max(0.0, self._running_ms - est.wall_ms)
+
+    # ---- planning reads --------------------------------------------------
+    def inflight_bytes(self) -> int:
+        with self._lock:
+            return self._running_bytes
+
+    def fits_memory(self, est: CostEstimate, anything_running: bool) -> bool:
+        """Would starting a job with this prediction keep the in-flight
+        predicted bytes inside the planned fraction of the governed
+        budget? Ungoverned engines (budget 0) always fit; an idle
+        scheduler always admits ONE job regardless (livelock escape — a
+        prediction larger than the whole budget must still run, and the
+        governor's spill tiers absorb the miss)."""
+        if self._memory_fraction <= 0.0:
+            return True
+        budget = int(self._budget_bytes_fn() or 0)
+        if budget <= 0:
+            return True
+        if not anything_running:
+            return True
+        with self._lock:
+            inflight = self._running_bytes
+        return inflight + est.device_bytes <= budget * self._memory_fraction
+
+    def predicted_drain_secs(self) -> float:
+        """Predicted seconds until the current backlog (queued + the
+        remainder of running, assumed half-done on average) drains
+        through the worker slots."""
+        with self._lock:
+            total_ms = self._queued_ms + self._running_ms / 2.0
+        return (total_ms / 1000.0) / self._slots
+
+    def describe(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "running_jobs": len(self._running),
+                "queued_jobs": len(self._queued),
+                "inflight_predicted_bytes": self._running_bytes,
+                "queued_predicted_ms": round(self._queued_ms, 3),
+                "predicted_drain_secs": round(
+                    (self._queued_ms + self._running_ms / 2.0)
+                    / 1000.0
+                    / self._slots,
+                    4,
+                ),
+            }
+
+
+def make_admission(
+    stats_store: Any,
+    max_concurrent: int,
+    memory_fraction: float,
+    default_ms: float,
+    default_bytes: int,
+    budget_bytes_fn: Any = None,
+) -> PredictiveAdmission:
+    """The daemon's constructor hook (kept tiny so the self-test's
+    admission leg and the daemon build identical objects)."""
+    return PredictiveAdmission(
+        QueryCostModel(
+            stats_store, default_ms=default_ms, default_bytes=default_bytes
+        ),
+        max_concurrent=max_concurrent,
+        memory_fraction=memory_fraction,
+        budget_bytes_fn=budget_bytes_fn,
+    )

@@ -1,0 +1,322 @@
+"""Fleet autoscaling (ISSUE 18): sustained-pressure scale-up, idle
+drain-then-retire scale-down, on top of the PR 13 fleet.
+
+:class:`FleetAutoscaler` is a small control loop over an in-process
+:class:`~fugue_tpu.serve.fleet.ServeFleet`. Every
+``fugue.serve.autoscale.interval`` seconds it samples each replica's
+scheduler (queue depth, running jobs) and — when
+``fugue.serve.autoscale.scale_up_p99_ms`` is set — the p99 of the
+``fugue_serve_job_seconds`` histogram *delta* since the previous tick,
+then decides:
+
+- **scale up** when the mean backlog per replica has been at or above
+  ``scale_up_queue`` (or the tick-window p99 above ``scale_up_p99_ms``)
+  for ``sustain_ticks`` consecutive samples and the fleet is below
+  ``max_replicas``. Sustained pressure, not a spike: a one-tick burst
+  that the queue absorbs is exactly what the queue is for.
+- **scale down** when the whole fleet has been completely idle (zero
+  queued, zero running) for ``idle_ticks`` consecutive samples and the
+  fleet is above ``min_replicas``. The retired replica is the
+  newest-added one, via :meth:`~fugue_tpu.serve.fleet.ServeFleet.
+  retire_replica` — drain → planned journal adoption → verify-empty →
+  detach, i.e. the SAME provably-loss-free move as a rolling restart,
+  which is why a hard kill at chaos site ``serve.scale`` mid-retire
+  degrades to an ordinary death failover instead of losing sessions.
+
+Each action starts a ``cooldown`` window during which no further action
+fires, so a scale-up's effect on the backlog is observed before the
+next decision (classic anti-flap hysteresis).
+
+The loop never raises: a failed action (e.g. a transient
+no-survivor-available retire) is counted on
+``fugue_autoscale_errors_total`` and retried on a later tick. Decisions
+are also exposed synchronously via :meth:`tick` so tests and the bench
+drive the controller deterministically without the wall-clock thread.
+"""
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from fugue_tpu.constants import (
+    FUGUE_CONF_SERVE_AUTOSCALE_COOLDOWN,
+    FUGUE_CONF_SERVE_AUTOSCALE_IDLE_TICKS,
+    FUGUE_CONF_SERVE_AUTOSCALE_INTERVAL,
+    FUGUE_CONF_SERVE_AUTOSCALE_MAX_REPLICAS,
+    FUGUE_CONF_SERVE_AUTOSCALE_MIN_REPLICAS,
+    FUGUE_CONF_SERVE_AUTOSCALE_SUSTAIN_TICKS,
+    FUGUE_CONF_SERVE_AUTOSCALE_UP_P99_MS,
+    FUGUE_CONF_SERVE_AUTOSCALE_UP_QUEUE,
+    typed_conf_get,
+)
+from fugue_tpu.obs import MetricsRegistry
+from fugue_tpu.testing.locktrace import tracked_lock
+from fugue_tpu.utils.params import ParamDict
+
+_JOB_HISTOGRAM = "fugue_serve_job_seconds"
+
+
+class FleetAutoscaler:
+    """Pressure-driven replica-count controller for a ServeFleet."""
+
+    def __init__(self, fleet: Any, conf: Any = None):
+        conf = ParamDict(conf)
+        self._fleet = fleet
+        self.max_replicas = max(
+            1, int(typed_conf_get(conf, FUGUE_CONF_SERVE_AUTOSCALE_MAX_REPLICAS))
+        )
+        self.min_replicas = max(
+            1, int(typed_conf_get(conf, FUGUE_CONF_SERVE_AUTOSCALE_MIN_REPLICAS))
+        )
+        self.interval = max(
+            0.02, float(typed_conf_get(conf, FUGUE_CONF_SERVE_AUTOSCALE_INTERVAL))
+        )
+        self.up_queue = max(
+            1, int(typed_conf_get(conf, FUGUE_CONF_SERVE_AUTOSCALE_UP_QUEUE))
+        )
+        # 0 = the p99 signal is OFF (queue pressure alone decides)
+        self.up_p99_ms = max(
+            0.0, float(typed_conf_get(conf, FUGUE_CONF_SERVE_AUTOSCALE_UP_P99_MS))
+        )
+        self.sustain_ticks = max(
+            1, int(typed_conf_get(conf, FUGUE_CONF_SERVE_AUTOSCALE_SUSTAIN_TICKS))
+        )
+        self.idle_ticks = max(
+            1, int(typed_conf_get(conf, FUGUE_CONF_SERVE_AUTOSCALE_IDLE_TICKS))
+        )
+        self.cooldown = max(
+            0.0, float(typed_conf_get(conf, FUGUE_CONF_SERVE_AUTOSCALE_COOLDOWN))
+        )
+        self._lock = tracked_lock("serve.autoscale.FleetAutoscaler._lock")
+        self._pressure_ticks = 0
+        self._idle_ticks = 0
+        self._last_action_at = 0.0
+        self._last_decision = "idle"
+        # per-replica (count, sum-of-bucket-counts) snapshot of the job
+        # histogram, so each tick's p99 covers only THAT tick's jobs
+        self._hist_base: Dict[str, List[int]] = {}
+        self._stop_event = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._metrics = MetricsRegistry()
+        self._m_ups = self._metrics.counter(
+            "fugue_autoscale_scale_ups_total", "replicas added by the autoscaler"
+        )
+        self._m_downs = self._metrics.counter(
+            "fugue_autoscale_scale_downs_total",
+            "replicas drained and retired by the autoscaler",
+        )
+        self._m_errors = self._metrics.counter(
+            "fugue_autoscale_errors_total",
+            "autoscale actions that failed and will retry",
+        )
+        self._m_ticks = self._metrics.counter(
+            "fugue_autoscale_ticks_total", "control-loop samples taken"
+        )
+        self._metrics.add_collector(self._collect_gauges)
+
+    def _collect_gauges(self) -> None:
+        self._metrics.gauge(
+            "fugue_autoscale_replicas", "current fleet replica count"
+        ).labels().set(len(self._fleet.replica_ids))
+        with self._lock:
+            pressure, idle = self._pressure_ticks, self._idle_ticks
+        self._metrics.gauge(
+            "fugue_autoscale_pressure_ticks",
+            "consecutive ticks at or above the scale-up threshold",
+        ).labels().set(pressure)
+        self._metrics.gauge(
+            "fugue_autoscale_idle_ticks",
+            "consecutive ticks with a completely idle fleet",
+        ).labels().set(idle)
+
+    # ---- lifecycle -------------------------------------------------------
+    def start(self) -> "FleetAutoscaler":
+        if self._thread is not None:
+            return self
+        self._stop_event.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="fugue-fleet-autoscale"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop_event.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=10.0)
+
+    def _loop(self) -> None:
+        while not self._stop_event.wait(self.interval):
+            try:
+                self.tick()
+            except Exception:  # pragma: no cover - loop must survive
+                self._m_errors.labels().inc()
+
+    # ---- sampling --------------------------------------------------------
+    def _sample(self) -> Dict[str, Any]:
+        """One pass over the live replicas' schedulers (in-process: the
+        autoscaler runs next to the fleet, not over HTTP)."""
+        queued = running = 0
+        p99_ms = 0.0
+        rids = self._fleet.replica_ids
+        for rid in rids:
+            try:
+                daemon = self._fleet.replica(rid)
+                counts = daemon.scheduler.counts()
+            except Exception:
+                continue  # replica mid-restart/retire: skip this tick
+            queued += int(counts.get("queued") or 0)
+            running += int(counts.get("running") or 0)
+            if self.up_p99_ms > 0.0:
+                p99_ms = max(p99_ms, self._replica_p99_ms(rid, daemon))
+        return {
+            "replicas": len(rids),
+            "queued": queued,
+            "running": running,
+            "backlog_per_replica": queued / max(1, len(rids)),
+            "p99_ms": round(p99_ms, 3),
+        }
+
+    def _replica_p99_ms(self, rid: str, daemon: Any) -> float:
+        """p99 upper-bound estimate over the jobs THIS replica finished
+        since the previous tick: the cumulative ``fugue_serve_job_seconds``
+        buckets are snapshotted per tick and the delta's 99th-percentile
+        bucket boundary is the estimate (Prometheus-style histogram
+        quantile, but windowed tick-to-tick instead of scrape-to-scrape)."""
+        try:
+            family = daemon._engine.metrics.get(_JOB_HISTOGRAM)
+        except Exception:
+            return 0.0
+        if family is None:
+            return 0.0
+        buckets: Optional[Any] = None
+        counts: Optional[List[int]] = None
+        for _, child in family.children():
+            if buckets is None:
+                buckets = child.buckets
+                counts = [0] * len(child.buckets)
+            with child._lock:
+                for i, c in enumerate(child.counts):
+                    counts[i] += c
+        if buckets is None or counts is None:
+            return 0.0
+        base = self._hist_base.get(rid, [0] * len(counts))
+        delta = [max(0, c - b) for c, b in zip(counts, base)]
+        self._hist_base[rid] = counts
+        total = sum(delta)
+        if total == 0:
+            return 0.0
+        rank = total * 0.99
+        seen = 0
+        for i, c in enumerate(delta):
+            seen += c
+            if seen >= rank:
+                b = buckets[i]
+                return (b if b != float("inf") else buckets[-2] * 2) * 1000.0
+        return buckets[-2] * 2 * 1000.0  # pragma: no cover
+
+    # ---- control ---------------------------------------------------------
+    def tick(self) -> str:
+        """One sample + decision + (maybe) action. Returns the decision:
+        ``scale_up``/``scale_down``/``pressure``/``idle``/``steady``/
+        ``cooldown``/``error``."""
+        self._m_ticks.labels().inc()
+        sample = self._sample()
+        with self._lock:
+            hot = sample["backlog_per_replica"] >= self.up_queue or (
+                self.up_p99_ms > 0.0 and sample["p99_ms"] >= self.up_p99_ms
+            )
+            cold = sample["queued"] == 0 and sample["running"] == 0
+            self._pressure_ticks = self._pressure_ticks + 1 if hot else 0
+            self._idle_ticks = self._idle_ticks + 1 if cold else 0
+            n = sample["replicas"]
+            in_cooldown = (
+                self._last_action_at > 0.0
+                and time.monotonic() - self._last_action_at < self.cooldown
+            )
+            want_up = (
+                self._pressure_ticks >= self.sustain_ticks
+                and n < self.max_replicas
+            )
+            want_down = (
+                self._idle_ticks >= self.idle_ticks and n > self.min_replicas
+            )
+        if (want_up or want_down) and in_cooldown:
+            self._last_decision = "cooldown"
+            return self._last_decision
+        if want_up:
+            self._last_decision = self._scale_up()
+        elif want_down:
+            self._last_decision = self._scale_down()
+        elif hot:
+            self._last_decision = "pressure"
+        elif cold:
+            self._last_decision = "idle"
+        else:
+            self._last_decision = "steady"
+        return self._last_decision
+
+    def _scale_up(self) -> str:
+        try:
+            rid = self._fleet.add_replica()
+        except Exception:
+            self._m_errors.labels().inc()
+            return "error"
+        self._m_ups.labels().inc()
+        with self._lock:
+            self._pressure_ticks = 0
+            self._last_action_at = time.monotonic()
+        return f"scale_up {rid}"
+
+    def _scale_down(self) -> str:
+        # retire the NEWEST replica: boot-time slots (r0..rN-1 from
+        # fugue.serve.fleet.replicas) are the floor the operator asked
+        # for; autoscaled additions go first
+        rids = self._fleet.replica_ids
+        if len(rids) <= 1:  # pragma: no cover - guarded by want_down
+            return "steady"
+        try:
+            self._fleet.retire_replica(rids[-1])
+        except Exception:
+            self._m_errors.labels().inc()
+            return "error"
+        self._m_downs.labels().inc()
+        with self._lock:
+            self._idle_ticks = 0
+            self._last_action_at = time.monotonic()
+        return f"scale_down {rids[-1]}"
+
+    # ---- observability ---------------------------------------------------
+    def render_metrics(self) -> str:
+        return self._metrics.render()
+
+    def describe(self) -> Dict[str, Any]:
+        with self._lock:
+            out = {
+                "max_replicas": self.max_replicas,
+                "min_replicas": self.min_replicas,
+                "interval": self.interval,
+                "scale_up_queue": self.up_queue,
+                "scale_up_p99_ms": self.up_p99_ms,
+                "sustain_ticks": self.sustain_ticks,
+                "idle_ticks": self.idle_ticks,
+                "cooldown": self.cooldown,
+                "pressure_ticks": self._pressure_ticks,
+                "idle_ticks_now": self._idle_ticks,
+                "last_decision": self._last_decision,
+            }
+        out["replicas"] = len(self._fleet.replica_ids)
+        counters = self._metrics.get("fugue_autoscale_scale_ups_total")
+        out["scale_ups"] = (
+            int(sum(v for _, v in counters.as_dict().items()))
+            if counters is not None
+            else 0
+        )
+        counters = self._metrics.get("fugue_autoscale_scale_downs_total")
+        out["scale_downs"] = (
+            int(sum(v for _, v in counters.as_dict().items()))
+            if counters is not None
+            else 0
+        )
+        return out

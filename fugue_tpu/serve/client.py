@@ -240,13 +240,21 @@ class ServeClient:
         timeout: float = 0.0,
         collect: bool = True,
         limit: int = 10_000,
+        priority: int = 0,
+        deadline: float = 0.0,
     ) -> Dict[str, Any]:
         """Synchronous submit: returns the finished job snapshot (its
         ``result`` carries columns/rows when the script ends in a
         dataframe and ``collect`` is on). Under deep queues the daemon
         may degrade the submit to async (202 + ``degraded_to_async``):
         this helper then polls the job to completion, so callers keep
-        sync semantics either way."""
+        sync semantics either way.
+
+        ``priority`` (higher runs first under the predictive scheduler,
+        and high-priority work survives overload shedding longest) and
+        ``deadline`` (relative seconds; a job still queued past it
+        settles as a structured DeadlineExceededError instead of
+        running) are ISSUE 18 admission fields."""
         payload: Dict[str, Any] = {
             "sql": sql,
             "mode": "sync",
@@ -254,6 +262,10 @@ class ServeClient:
             "collect": collect,
             "limit": limit,
         }
+        if priority:
+            payload["priority"] = int(priority)
+        if deadline > 0:
+            payload["deadline"] = float(deadline)
         if save_as is not None:
             payload["save_as"] = save_as
         snap = self._call(
@@ -271,6 +283,8 @@ class ServeClient:
         timeout: float = 0.0,
         collect: bool = True,
         limit: int = 10_000,
+        priority: int = 0,
+        deadline: float = 0.0,
     ) -> str:
         payload: Dict[str, Any] = {
             "sql": sql,
@@ -279,6 +293,10 @@ class ServeClient:
             "collect": collect,
             "limit": limit,
         }
+        if priority:
+            payload["priority"] = int(priority)
+        if deadline > 0:
+            payload["deadline"] = float(deadline)
         if save_as is not None:
             payload["save_as"] = save_as
         return self._call(

@@ -80,9 +80,14 @@ from contextlib import nullcontext
 from typing import Any, Dict, List, Optional, Tuple
 
 from fugue_tpu.constants import (
+    FUGUE_CONF_SERVE_ADMISSION_DEFAULT_BYTES,
+    FUGUE_CONF_SERVE_ADMISSION_DEFAULT_MS,
+    FUGUE_CONF_SERVE_ADMISSION_MAX_WAIT,
+    FUGUE_CONF_SERVE_ADMISSION_MEMORY_FRACTION,
     FUGUE_CONF_SERVE_FLEET_RESULT_CACHE_DIR,
     FUGUE_CONF_SERVE_PREWARM,
     FUGUE_CONF_SERVE_RESULT_CACHE,
+    FUGUE_CONF_SERVE_SCHEDULER,
     FUGUE_CONF_SERVE_BREAKER_COOLDOWN,
     FUGUE_CONF_SERVE_BREAKER_THRESHOLD,
     FUGUE_CONF_SERVE_DRAIN_TIMEOUT,
@@ -160,6 +165,7 @@ _REJECT_KINDS = (
     "session_cap",
     "breaker_open",
     "sync_degraded",
+    "shed",
 )
 _FAULT_KINDS = (
     "runs",
@@ -243,11 +249,44 @@ class ServeDaemon:
             default_ttl=typed_conf_get(econf, FUGUE_CONF_SERVE_SESSION_TTL),
             journal=self._journal,
         )
+        # predictive overload plane (ISSUE 18): under
+        # fugue.serve.scheduler=predictive the scheduler plans against
+        # stats-store cost predictions — shortest-job-first inside
+        # per-tenant fairness, priority/deadline submission fields, and
+        # a PREDICTED-memory admission gate replacing the observed-fill
+        # rejection. fifo (default) keeps PR 6 behavior bit-for-bit.
+        self._scheduler_policy = str(
+            typed_conf_get(econf, FUGUE_CONF_SERVE_SCHEDULER) or "fifo"
+        ).lower()
+        self._admission: Any = None
+        if self._scheduler_policy == "predictive":
+            from fugue_tpu.serve.admission import make_admission
+
+            self._admission = make_admission(
+                self._stats_store,
+                typed_conf_get(econf, FUGUE_CONF_SERVE_MAX_CONCURRENT),
+                typed_conf_get(
+                    econf, FUGUE_CONF_SERVE_ADMISSION_MEMORY_FRACTION
+                ),
+                typed_conf_get(econf, FUGUE_CONF_SERVE_ADMISSION_DEFAULT_MS),
+                typed_conf_get(
+                    econf, FUGUE_CONF_SERVE_ADMISSION_DEFAULT_BYTES
+                ),
+                budget_bytes_fn=self._memory_budget_bytes,
+            )
+        self._admission_max_wait = max(
+            0.0,
+            float(
+                typed_conf_get(econf, FUGUE_CONF_SERVE_ADMISSION_MAX_WAIT)
+            ),
+        )
         self._scheduler = JobScheduler(
             self._execute_job,
             typed_conf_get(econf, FUGUE_CONF_SERVE_MAX_CONCURRENT),
             job_ttl=typed_conf_get(econf, FUGUE_CONF_SERVE_JOB_TTL),
             on_finish=self._job_finished,
+            policy=self._scheduler_policy,
+            admission=self._admission,
         )
         http_conf = ParamDict(econf)
         http_conf["fugue.rpc.http_server.host"] = typed_conf_get(
@@ -558,8 +597,12 @@ class ServeDaemon:
                 job_id=jid,
                 request_id=rec.get("request_id"),
                 profile=bool(rec.get("profile", False)),
+                priority=int(rec.get("priority", 0) or 0),
+                deadline=float(rec.get("deadline", 0.0) or 0.0),
             )
             job.recovered = True
+            if self._admission is not None:
+                job.cost = self._admission.model.estimate_sql(job.sql)
             try:
                 self._sessions.get(job.session_id)
                 if import_into_journal:
@@ -981,6 +1024,15 @@ class ServeDaemon:
             except Exception:  # pragma: no cover - best-effort cleanup
                 pass
 
+    def _memory_budget_bytes(self) -> int:
+        """Governed device-byte budget (0 = ungoverned) — what the
+        predictive admission gate plans its in-flight predictions
+        against."""
+        mem = getattr(self._engine, "memory_stats", None)
+        if not isinstance(mem, dict) or not mem.get("enabled"):
+            return 0
+        return int(mem.get("budget_bytes") or 0)
+
     def memory_pressure(self) -> float:
         """Device-tier fill fraction of the governed budget (0.0 when
         ungoverned) — the admission controller's memory signal, read
@@ -1022,6 +1074,16 @@ class ServeDaemon:
         metrics.gauge(
             "fugue_serve_sessions", "live serve sessions"
         ).labels().set(self._sessions.count())
+        if self._admission is not None:
+            metrics.gauge(
+                "fugue_serve_predicted_drain_seconds",
+                "predicted seconds until the job backlog drains "
+                "(predictive scheduler)",
+            ).labels().set(self._scheduler.predicted_drain_secs())
+            metrics.gauge(
+                "fugue_serve_predicted_inflight_bytes",
+                "sum of running jobs' predicted peak device bytes",
+            ).labels().set(self._admission.inflight_bytes())
         metrics.gauge(
             "fugue_serve_uptime_seconds", "seconds since daemon start"
         ).labels().set(
@@ -1042,7 +1104,7 @@ class ServeDaemon:
                 retry_after=max(1.0, self._health.drain_remaining()),
             )
 
-    def _admit(self, session_id: str) -> None:
+    def _admit(self, session_id: str, priority: int = 0) -> None:
         """Admission control for one submission; raises an
         :class:`AdmissionError` subtype (503/429 + Retry-After) when the
         daemon must shed load instead of queueing it. The caller has
@@ -1054,7 +1116,33 @@ class ServeDaemon:
                 f"job queue is full ({self._max_queue} queued)",
                 retry_after=1.0,
             )
-        if self._memory_reject > 0:
+        if self._admission is not None and self._admission_max_wait > 0:
+            # predictive shedding (ISSUE 18): when the backlog's
+            # PREDICTED drain exceeds the configured wait, shed in
+            # priority order — the overload ratio sets the priority
+            # floor a submission must clear, so cheap excess load drops
+            # first while important work keeps landing; Retry-After is
+            # the predicted drain itself, so backed-off clients return
+            # when the queue is actually expected to have room. Never
+            # touches accepted (queued/running) work: shedding happens
+            # strictly at the door.
+            drain = self._scheduler.predicted_drain_secs()
+            ratio = drain / self._admission_max_wait
+            if ratio > 1.0 and int(priority) < int(ratio):
+                self._count_reject("shed")
+                raise BackpressureError(
+                    f"predicted queue drain {drain:.2f}s exceeds the "
+                    f"admission wait budget {self._admission_max_wait:.2f}s "
+                    f"(overload x{ratio:.1f}); submissions below priority "
+                    f"{int(ratio)} are shed",
+                    retry_after=max(1.0, drain),
+                )
+        if self._memory_reject > 0 and self._admission is None:
+            # reactive observed-fill rejection (PR 6). Under the
+            # predictive policy this check is OFF by design: jobs are
+            # admitted and QUEUED, and the scheduler's predicted-memory
+            # gate holds them until the in-flight prediction has room —
+            # admit-or-queue on prediction, not reject on observation.
             pressure = self.memory_pressure()
             if pressure >= self._memory_reject:
                 self._count_reject("memory_pressure")
@@ -1091,10 +1179,12 @@ class ServeDaemon:
         limit: int = 10_000,
         request_id: Optional[str] = None,
         profile: bool = False,
+        priority: int = 0,
+        deadline: float = 0.0,
     ) -> ServeJob:
         self._reject_if_unhealthy()
         self._sessions.get(session_id)  # 404 early + touches the session
-        self._admit(session_id)
+        self._admit(session_id, priority=priority)
         job = ServeJob(
             session_id,
             sql,
@@ -1104,7 +1194,14 @@ class ServeDaemon:
             limit=limit,
             request_id=request_id,
             profile=profile,
+            priority=priority,
+            deadline=deadline,
         )
+        if self._admission is not None:
+            # submit-time cost: stats-store-backed for repeat queries
+            # (the execution path feeds the sql→fingerprint map),
+            # registered defaults for first-timers
+            job.cost = self._admission.model.estimate_sql(sql)
         # under an active request trace the job gets its serve.job span
         # NOW: queue wait is inside it, so traces attribute time spent
         # queued behind the scheduler separately from execution
@@ -1214,6 +1311,7 @@ class ServeDaemon:
                 "max_queue": self._max_queue,
                 "memory_pressure": round(self.memory_pressure(), 4),
                 "rejections": reject_totals,
+                "scheduler": self._scheduler_policy,
             },
             "supervisor": {
                 "breakers": self._supervisor.breaker_stats(),
@@ -1221,6 +1319,10 @@ class ServeDaemon:
                 "heartbeat_timeout": self._supervisor.heartbeat_timeout,
             },
         }
+        if self._admission is not None:
+            admission = self._admission.describe()
+            admission["max_predicted_wait"] = self._admission_max_wait
+            out["admission"] = admission
         if self._journal is not None:
             out["durable"] = self._journal.describe()
             out["recovery"] = dict(self._recovery)
@@ -1325,6 +1427,15 @@ class ServeDaemon:
         # breaker's query fingerprint: same query over the same session
         # tables -> same key, across submissions and daemon restarts
         job.fingerprint = dag.__uuid__()
+        if self._admission is not None:
+            # cost-model feedback: the NEXT submission of this SQL text
+            # resolves to this fingerprint's stats-store history at
+            # admission time, before any compilation
+            from fugue_tpu.serve.admission import sql_cost_key
+
+            self._admission.model.note_fingerprint(
+                sql_cost_key(job.sql), job.fingerprint
+            )
         self._supervisor.admit_query(job.fingerprint)
         has_result = dag.last_df is not None
         # cross-request result cache: only PURE queries (deterministic
@@ -1865,6 +1976,14 @@ class ServeDaemon:
             mode = "async"
             degraded = True
             self._count_reject("sync_degraded")
+        # scheduling fields (ISSUE 18): "priority" (int, higher wins;
+        # default 0) and "deadline" (relative seconds budget — the job
+        # must START within it or it settles with a structured error;
+        # 0/absent = none). Converted here to the absolute epoch the
+        # scheduler compares against.
+        priority = int(payload.get("priority", 0))
+        deadline_secs = float(payload.get("deadline", 0.0) or 0.0)
+        deadline = time.time() + deadline_secs if deadline_secs > 0 else 0.0
         job = self.submit(
             sid,
             sql,
@@ -1875,6 +1994,8 @@ class ServeDaemon:
             limit=int(payload.get("limit", 10_000)),
             request_id=request_id,
             profile=bool(payload.get("profile", False)),
+            priority=priority,
+            deadline=deadline,
         )
         if mode == "async":
             snap = job.snapshot(include_result=False)

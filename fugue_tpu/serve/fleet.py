@@ -68,6 +68,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from fugue_tpu.constants import (
     FUGUE_CONF_JAX_DEVICES,
     FUGUE_CONF_OPTIMIZE_CACHE_DIR,
+    FUGUE_CONF_SERVE_AUTOSCALE_MAX_REPLICAS,
     FUGUE_CONF_SERVE_FLEET_DEATH_THRESHOLD,
     FUGUE_CONF_SERVE_FLEET_DEVICE_SLICES,
     FUGUE_CONF_SERVE_FLEET_HEALTH_INTERVAL,
@@ -239,6 +240,23 @@ class FleetRouter:
             self._replicas[rid] = _Replica(rid, host, port, state_path)
             if rid in self._pending_failover:
                 self._pending_failover.remove(rid)
+
+    def detach(self, rid: str) -> None:
+        """Forget one replica entirely (scale-down). The caller is
+        responsible for having migrated its sessions first (failover /
+        adoption); any affinity entries still pointing at ``rid`` are
+        dropped so requests 404 instead of routing at a gone replica."""
+        with self._lock:
+            self._replicas.pop(rid, None)
+            if rid in self._pending_failover:
+                self._pending_failover.remove(rid)
+            stranded = [
+                sid for sid, r in self._affinity.items() if r == rid
+            ]
+            for sid in stranded:
+                self._affinity.pop(sid, None)
+            self._dirty = True
+        self._journal()
 
     def start(self) -> "FleetRouter":
         if self._started:
@@ -847,25 +865,63 @@ class ServeFleet:
         if FUGUE_CONF_SERVE_FLEET_RESULT_CACHE_DIR in self._conf:
             # explicit conf wins — including an explicit '' = OFF (the
             # bench uses that to measure execution, not cache reads)
-            result_dir = str(
+            self._result_dir = str(
                 self._conf[FUGUE_CONF_SERVE_FLEET_RESULT_CACHE_DIR] or ""
             ).strip()
         else:
-            result_dir = fs.join(self._base, "results")
+            self._result_dir = fs.join(self._base, "results")
         self._replica_ids = [f"r{i}" for i in range(n)]
         device_slices = self._device_slices(n)
+        self._sliced = device_slices is not None
         self._replica_confs: Dict[str, ParamDict] = {}
         for i, rid in enumerate(self._replica_ids):
-            rconf = ParamDict(self._conf)
-            rconf[FUGUE_CONF_SERVE_STATE_PATH] = self.replica_state_path(rid)
-            rconf[FUGUE_CONF_SERVE_FLEET_RESULT_CACHE_DIR] = result_dir
-            rconf[FUGUE_CONF_SERVE_PORT] = 0  # ephemeral: never collide
-            if device_slices is not None:
-                rconf[FUGUE_CONF_JAX_DEVICES] = device_slices[i]
-            self._replica_confs[rid] = rconf
+            self._replica_confs[rid] = self._make_replica_conf(
+                rid, device_slices[i] if device_slices is not None else None
+            )
         self._daemons: Dict[str, Any] = {}
         self._router = FleetRouter(self._conf)
+        # serializes replica-set mutation (add/retire/restart) against
+        # the autoscaler thread — OUTERMOST in the canonical order: the
+        # guarded operations call into the router (failover/attach) and
+        # through it into replica HTTP forwards
+        self._lock = tracked_lock(
+            "serve.fleet.ServeFleet._lock", reentrant=True
+        )
+        self._autoscaler: Any = None
+        if (
+            int(
+                typed_conf_get(
+                    self._conf, FUGUE_CONF_SERVE_AUTOSCALE_MAX_REPLICAS
+                )
+            )
+            > 0
+        ):
+            from fugue_tpu.serve.autoscale import FleetAutoscaler
+
+            self._autoscaler = FleetAutoscaler(self, self._conf)
         self._started = False
+
+    def _make_replica_conf(
+        self, rid: str, device_slice: Optional[str] = None
+    ) -> ParamDict:
+        """One replica's derived conf: its own journal subdirectory and
+        an ephemeral port, the shared result-cache dir, optionally a
+        pinned device slice. The ``fugue.serve.autoscale.*`` keys stay
+        at the FLEET level — the controller lives on the ServeFleet, and
+        an embedded daemon carrying them would trip FWF508's inert-conf
+        gate."""
+        rconf = ParamDict(self._conf)
+        for key in [
+            k for k in rconf.keys()
+            if k.startswith("fugue.serve.autoscale.")
+        ]:
+            del rconf[key]
+        rconf[FUGUE_CONF_SERVE_STATE_PATH] = self.replica_state_path(rid)
+        rconf[FUGUE_CONF_SERVE_FLEET_RESULT_CACHE_DIR] = self._result_dir
+        rconf[FUGUE_CONF_SERVE_PORT] = 0  # ephemeral: never collide
+        if device_slice is not None:
+            rconf[FUGUE_CONF_JAX_DEVICES] = device_slice
+        return rconf
 
     def _device_slices(self, n: int) -> Optional[List[str]]:
         """With ``fugue.serve.fleet.device_slices`` on, carve
@@ -913,7 +969,14 @@ class ServeFleet:
 
     @property
     def replica_ids(self) -> List[str]:
-        return list(self._replica_ids)
+        with self._lock:
+            return list(self._replica_ids)
+
+    @property
+    def autoscaler(self) -> Any:
+        """The fleet's :class:`~fugue_tpu.serve.autoscale.FleetAutoscaler`
+        when ``fugue.serve.autoscale.max_replicas`` > 0, else None."""
+        return self._autoscaler
 
     def replica(self, rid: str) -> Any:
         return self._daemons[rid]
@@ -943,12 +1006,16 @@ class ServeFleet:
             )
         self._router.start()
         self._started = True
+        if self._autoscaler is not None:
+            self._autoscaler.start()
         return self
 
     def stop(self, drain: bool = False) -> None:
         if not self._started:
             return
         self._started = False
+        if self._autoscaler is not None:
+            self._autoscaler.stop()
         self._router.stop()
         for daemon in self._daemons.values():
             try:
@@ -977,35 +1044,24 @@ class ServeFleet:
         BEFORE the engine closes), adopt its journal into a survivor,
         start a fresh daemon on the same slot, and wait until the
         router sees it healthy again."""
-        t0 = time.monotonic()
-        self._router.begin_drain(rid)
-        self._daemons[rid].stop(drain=True)
-        migrated = self._router.failover(rid, mode="planned")
-        t_migrated = time.monotonic()
-        if migrated is not None:
-            # the adoption ran, so the origin journal MUST be empty
-            # before a fresh daemon starts on it — adopt_state clears
-            # it, but a shared-fs hiccup there only logs on the
-            # survivor. Verify here and refuse to double-own: a fresh
-            # daemon rehydrating just-migrated sessions would later
-            # delete the shared artifacts the survivor depends on.
-            from fugue_tpu.serve.state import ServeStateJournal
+        with self._lock:
+            t0 = time.monotonic()
+            self._router.begin_drain(rid)
+            self._daemons[rid].stop(drain=True)
+            migrated = self._router.failover(rid, mode="planned")
+            t_migrated = time.monotonic()
+            if migrated is not None:
+                self._ensure_origin_journal_clear(rid)
+            from fugue_tpu.serve.daemon import ServeDaemon
 
-            fs = make_default_registry()
-            state_path = self.replica_state_path(rid)
-            leftover = ServeStateJournal.read_state(fs, state_path)
-            if leftover["sessions"] or leftover["jobs"]:
-                ServeStateJournal.clear_state(fs, state_path)
-        from fugue_tpu.serve.daemon import ServeDaemon
-
-        fresh = ServeDaemon(
-            self._replica_confs[rid], self._engine_spec
-        ).start()
-        self._daemons[rid] = fresh
-        host, port = fresh.address
-        self._router.attach(
-            rid, host, port, state_path=self.replica_state_path(rid)
-        )
+            fresh = ServeDaemon(
+                self._replica_confs[rid], self._engine_spec
+            ).start()
+            self._daemons[rid] = fresh
+            host, port = fresh.address
+            self._router.attach(
+                rid, host, port, state_path=self.replica_state_path(rid)
+            )
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             if self._router.check_health().get(rid) == HEALTHY:
@@ -1025,6 +1081,108 @@ class ServeFleet:
             "migration_secs": round(t_migrated - t0, 4),
             "secs": round(time.monotonic() - t0, 4),
         }
+
+    def _ensure_origin_journal_clear(self, rid: str) -> None:
+        """After an adoption RAN, the origin journal MUST be empty
+        before the slot is reused (fresh daemon) or forgotten (retire)
+        — adopt_state clears it, but a shared-fs hiccup there only logs
+        on the survivor. Verify here and refuse to double-own: a daemon
+        rehydrating just-migrated sessions would later delete the
+        shared artifacts the survivor depends on."""
+        from fugue_tpu.serve.state import ServeStateJournal
+
+        fs = make_default_registry()
+        state_path = self.replica_state_path(rid)
+        leftover = ServeStateJournal.read_state(fs, state_path)
+        if leftover["sessions"] or leftover["jobs"]:
+            ServeStateJournal.clear_state(fs, state_path)
+
+    # ---- elastic scale (ISSUE 18) ----------------------------------------
+    def add_replica(self, timeout: float = 120.0) -> str:
+        """Scale up by one replica: mint the next free ``r<i>`` slot,
+        start a fresh daemon on it, attach it to the router, and wait
+        until it reports healthy. Returns the new replica id.
+
+        Refused under ``fugue.serve.fleet.device_slices``: the static
+        device carve-up is computed for the boot-time replica count and
+        cannot be re-partitioned under live engines."""
+        if self._sliced:
+            raise ValueError(
+                f"{FUGUE_CONF_SERVE_FLEET_DEVICE_SLICES}: cannot scale "
+                "out a device-sliced fleet — the per-replica slices are "
+                "fixed at boot"
+            )
+        with self._lock:
+            i = 0
+            while f"r{i}" in self._replica_confs:
+                i += 1
+            rid = f"r{i}"
+            fault_point("serve.scale", f"up {rid}")
+            rconf = self._make_replica_conf(rid)
+            from fugue_tpu.serve.daemon import ServeDaemon
+
+            daemon = ServeDaemon(rconf, self._engine_spec).start()
+            self._replica_confs[rid] = rconf
+            self._daemons[rid] = daemon
+            self._replica_ids.append(rid)
+            host, port = daemon.address
+            self._router.attach(
+                rid, host, port, state_path=self.replica_state_path(rid)
+            )
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._router.check_health().get(rid) == HEALTHY:
+                break
+            time.sleep(0.05)
+        else:  # pragma: no cover - replica failed to come up
+            raise TimeoutError(
+                f"replica {rid} did not report healthy within {timeout}s "
+                "after scale-up"
+            )
+        return rid
+
+    def retire_replica(self, rid: str) -> Dict[str, Any]:
+        """Scale down by one replica with the SAME provably-loss-free
+        move as a rolling restart: drain (final journal snapshot lands
+        before the engine closes) → planned journal adoption into a
+        survivor → verify the origin journal is empty → detach.
+
+        A hard kill anywhere in this window (chaos site ``serve.scale``)
+        cannot lose sessions: the drained journal is already on the
+        shared fs, so the router's death failover adopts it instead —
+        the planned and unplanned paths converge on the same journal."""
+        with self._lock:
+            if rid not in self._daemons:
+                raise KeyError(f"unknown replica {rid!r}")
+            if len(self._replica_ids) <= 1:
+                raise ValueError(
+                    "cannot retire the last replica: a fleet needs a "
+                    "survivor to adopt the retiring journal"
+                )
+            t0 = time.monotonic()
+            self._router.begin_drain(rid)
+            self._daemons[rid].stop(drain=True)
+            fault_point("serve.scale", f"down {rid}")
+            migrated = self._router.failover(rid, mode="planned")
+            if migrated is None:
+                # no survivor could adopt RIGHT NOW (transient): leave
+                # the replica attached — its daemon is stopped, so the
+                # health loop's death failover finishes the migration
+                # from the same drained journal on a later tick
+                raise RuntimeError(
+                    f"retiring {rid}: no survivor adopted its journal; "
+                    "replica left attached for death failover"
+                )
+            self._ensure_origin_journal_clear(rid)
+            self._router.detach(rid)
+            self._daemons.pop(rid, None)
+            self._replica_ids.remove(rid)
+            self._replica_confs.pop(rid, None)
+            return {
+                "replica": rid,
+                "migrated_sessions": len(migrated),
+                "secs": round(time.monotonic() - t0, 4),
+            }
 
     def rolling_restart(self, timeout: float = 120.0) -> Dict[str, Any]:
         """Restart every replica in sequence under live load — the

@@ -17,7 +17,22 @@ mechanism:
   boundary.
 
 Concurrency is bounded by ``fugue.serve.max_concurrent`` worker threads
-pulling from one FIFO queue. Resilience plumbing on top (ISSUE 7):
+pulling from one pending set. **Pickup order is a policy**
+(``fugue.serve.scheduler``, ISSUE 18):
+
+- ``fifo`` (default): strict submission order — PR 6 behavior;
+- ``predictive``: shortest-*predicted*-job-first within per-tenant
+  fairness. Each job carries a :class:`~fugue_tpu.serve.admission.
+  CostEstimate` from its query fingerprint's stats-store history;
+  pickup prefers higher ``priority``, then tenants with fewer running
+  jobs, then the smallest predicted wall, then the nearest ``deadline``.
+  A job whose ``deadline`` lapses while queued settles with a
+  structured error instead of executing; a job whose predicted device
+  bytes would overflow the planned fraction of the governed memory
+  budget waits for headroom instead of starting (livelock-free: an
+  idle scheduler always admits one job).
+
+Resilience plumbing on top (ISSUE 7):
 
 - :meth:`backlog` / :meth:`active_count` feed the daemon's admission
   control (queue-depth backpressure, per-session caps);
@@ -55,6 +70,10 @@ CANCELLED = "cancelled"
 # the oldest are evicted; payloads go earlier, by TTL
 _RETAIN_FINISHED = 1000
 
+# seconds a worker whose every pending job is memory-deferred waits
+# before re-checking predicted headroom (a finishing job frees it)
+_DEFER_POLL = 0.02
+
 
 class ServeJob:
     """One submission: its request, lifecycle state, and outcome.
@@ -73,6 +92,8 @@ class ServeJob:
         job_id: Optional[str] = None,
         request_id: Optional[str] = None,
         profile: bool = False,
+        priority: int = 0,
+        deadline: float = 0.0,
     ):
         self.job_id = job_id or ("job-" + uuid.uuid4().hex[:12])
         self.session_id = session_id
@@ -81,6 +102,17 @@ class ServeJob:
         self.timeout = max(0.0, float(timeout))
         self.collect = bool(collect)
         self.limit = int(limit)
+        # scheduling fields (ISSUE 18): higher priority runs first and
+        # survives load shedding longer; deadline is the ABSOLUTE epoch
+        # second after which a still-queued job is settled with a
+        # structured error instead of executing (0 = none) — the HTTP
+        # layer converts the submission's relative seconds budget
+        self.priority = int(priority)
+        self.deadline = max(0.0, float(deadline))
+        # predicted cost (a fugue_tpu.serve.admission.CostEstimate) the
+        # daemon attaches at submit under the predictive policy; None
+        # under fifo
+        self.cost: Any = None
         # per-request profiling (ISSUE 14): the executor forces the
         # workflow profiler for this job regardless of daemon conf; the
         # RunProfile lands on ``self.profile`` for GET /v1/jobs/<id>/
@@ -117,6 +149,7 @@ class ServeJob:
         # True when restart recovery resubmitted this job from the journal
         self.recovered = False
         self._heartbeat: Optional[float] = None  # monotonic
+        self._seq = 0  # submission sequence, assigned by the scheduler
 
     @property
     def finished(self) -> bool:
@@ -168,6 +201,10 @@ class ServeJob:
             "status": self.status,
             "submitted_at": self.submitted_at,
         }
+        if self.priority != 0:
+            out["priority"] = self.priority
+        if self.deadline > 0:
+            out["deadline"] = self.deadline
         if self.request_id is not None:
             out["request_id"] = self.request_id
         if self.recovered:
@@ -189,7 +226,9 @@ class JobScheduler:
     result payload; failures become structured errors on the job.
     ``on_finish`` (optional) fires after every job reaches a terminal
     state — the daemon uses it for breaker accounting and job-journal
-    cleanup."""
+    cleanup. ``policy`` selects pickup order (``fifo`` | ``predictive``);
+    ``admission`` (a :class:`~fugue_tpu.serve.admission.
+    PredictiveAdmission`) carries the predictive policy's cost ledger."""
 
     def __init__(
         self,
@@ -197,14 +236,28 @@ class JobScheduler:
         max_concurrent: int,
         job_ttl: float = 0.0,
         on_finish: Optional[Callable[[ServeJob], None]] = None,
+        policy: str = "fifo",
+        admission: Any = None,
     ):
         self._execute = execute
         self._max_concurrent = max(1, int(max_concurrent))
         self._job_ttl = max(0.0, float(job_ttl))
         self._on_finish = on_finish
-        self._queue: "queue.Queue[Optional[ServeJob]]" = queue.Queue()
+        self._policy = str(policy or "fifo").lower()
+        if self._policy not in ("fifo", "predictive"):
+            raise ValueError(
+                f"fugue.serve.scheduler must be fifo|predictive, "
+                f"got {self._policy!r}"
+            )
+        self._admission = admission
+        # wake-up channel only: one token per submitted job, None as the
+        # shutdown sentinel. The jobs themselves wait in _pending, where
+        # the policy (not arrival order) decides pickup.
+        self._queue: "queue.Queue[Optional[bool]]" = queue.Queue()
+        self._pending: List[ServeJob] = []
         self._jobs: Dict[str, ServeJob] = {}
         self._order: List[str] = []  # submission order, for retention
+        self._seq = 0
         self._lock = tracked_lock(
             "serve.scheduler.JobScheduler._lock", reentrant=True
         )
@@ -215,6 +268,14 @@ class JobScheduler:
     @property
     def max_concurrent(self) -> int:
         return self._max_concurrent
+
+    @property
+    def policy(self) -> str:
+        return self._policy
+
+    @property
+    def admission(self) -> Any:
+        return self._admission
 
     def start(self) -> None:
         with self._lock:
@@ -308,14 +369,19 @@ class JobScheduler:
                     "scheduler is draining/stopped; not accepting jobs",
                     retry_after=1.0,
                 )
+            self._seq += 1
+            job._seq = self._seq
             self._jobs[job.job_id] = job
             self._order.append(job.job_id)
+            self._pending.append(job)
+            if self._admission is not None and job.cost is not None:
+                self._admission.job_queued(job.job_id, job.cost)
             self._evict_locked()
             # enqueue UNDER the lock: stop() flips _started and snapshots
             # the job table under the same lock, so a job can never land
             # in the queue behind the shutdown sentinels un-cancelled
             # (which would leave a sync waiter blocked forever)
-            self._queue.put(job)
+            self._queue.put(True)
         return job
 
     def abandon(self, job: ServeJob) -> bool:
@@ -327,6 +393,7 @@ class JobScheduler:
         job won the race and finished on its own."""
         job.token.cancel()
         if job.try_finish(CANCELLED):
+            self._settle_cost(job)
             self._notify_finish(job)
             return True
         return False
@@ -385,6 +452,14 @@ class JobScheduler:
         with self._lock:
             return [j for j in self._jobs.values() if j.status == RUNNING]
 
+    def predicted_drain_secs(self) -> float:
+        """Predicted seconds until the backlog drains (0.0 under fifo /
+        without an admission ledger) — what the daemon's shed decision
+        and its 503 ``Retry-After`` are sized from."""
+        if self._admission is None:
+            return 0.0
+        return self._admission.predicted_drain_secs()
+
     # ---- retention -------------------------------------------------------
     def _evict_locked(self) -> None:
         while len(self._order) > _RETAIN_FINISHED:
@@ -418,16 +493,117 @@ class JobScheduler:
                     dropped += 1
         return dropped
 
+    # ---- pickup policy ---------------------------------------------------
+    def _pick_locked(self) -> Any:
+        """Choose the next job from the pending set (MUST hold _lock).
+        Returns ``(job, settled)`` where ``settled`` lists jobs removed
+        from pending that must be terminalized OUTSIDE the lock
+        (deadline expiries); ``job`` is None when nothing is eligible —
+        either pending is empty (token raced a cancel/expiry sweep) or
+        every candidate is memory-deferred (the worker polls for
+        headroom)."""
+        now = time.time()
+        settled: List[ServeJob] = []
+        candidates: List[ServeJob] = []
+        for job in self._pending:
+            if job.deadline > 0 and now >= job.deadline and (
+                not job.token.cancelled
+            ):
+                settled.append(job)
+            else:
+                candidates.append(job)
+        if settled:
+            self._pending = list(candidates)
+        if not candidates:
+            return None, settled
+        if self._policy == "fifo" or self._admission is None:
+            job = candidates[0]
+            self._pending.remove(job)
+            return job, settled
+        # predictive: priority first, then tenants with fewer RUNNING
+        # jobs (fairness), then shortest predicted wall, then nearest
+        # deadline, then submission order (stable tie-break)
+        running_by_tenant: Dict[str, int] = {}
+        anything_running = False
+        for j in self._jobs.values():
+            if j.status == RUNNING:
+                anything_running = True
+                running_by_tenant[j.session_id] = (
+                    running_by_tenant.get(j.session_id, 0) + 1
+                )
+
+        def _key(j: ServeJob) -> Any:
+            est = j.cost
+            wall = est.wall_ms if est is not None else 0.0
+            return (
+                -j.priority,
+                running_by_tenant.get(j.session_id, 0),
+                wall,
+                j.deadline if j.deadline > 0 else float("inf"),
+                j._seq,
+            )
+
+        for j in sorted(candidates, key=_key):
+            est = j.cost
+            if est is None or self._admission.fits_memory(
+                est, anything_running
+            ):
+                self._pending.remove(j)
+                return j, settled
+        return None, settled  # all memory-deferred: wait for headroom
+
+    def _settle_cost(self, job: ServeJob) -> None:
+        """Drop the job from the admission ledger wherever it sits."""
+        if self._admission is None:
+            return
+        self._admission.job_dequeued(job.job_id)
+        self._admission.job_finished(job.job_id)
+
+    def _expire(self, job: ServeJob) -> None:
+        """A queued job whose deadline lapsed: structured error, never
+        executed — the submitter asked for an answer by a time that has
+        passed, and running it anyway would burn capacity the live
+        queue needs."""
+        job.error = {
+            "error": "DeadlineExceededError",
+            "message": (
+                f"job {job.job_id} missed its deadline while queued "
+                f"(deadline={job.deadline:.3f}, now={time.time():.3f})"
+            ),
+        }
+        if job.try_finish(ERROR):
+            self._settle_cost(job)
+            self._notify_finish(job)
+
     # ---- worker loop -----------------------------------------------------
     def _work(self) -> None:
         while True:
-            job = self._queue.get()
-            if job is None:
+            token = self._queue.get()
+            if token is None:
                 return
+            job: Optional[ServeJob] = None
+            while True:
+                with self._lock:
+                    job, settled = self._pick_locked()
+                    pending = len(self._pending)
+                    started = self._started
+                for s in settled:
+                    self._expire(s)
+                if job is not None or pending == 0 or not started:
+                    break
+                # every candidate is memory-deferred: poll for the
+                # headroom a finishing job frees (bounded, shutdown-
+                # aware — the sentinel ends the worker either way)
+                time.sleep(_DEFER_POLL)
+            if job is None:
+                continue
             if not job.try_start():
+                self._settle_cost(job)
                 if job.try_finish(CANCELLED):
                     self._notify_finish(job)
                 continue
+            if self._admission is not None and job.cost is not None:
+                self._admission.job_started(job.job_id)
             job.beat()
             node = TaskNode(
                 job.job_id,
@@ -448,18 +624,23 @@ class JobScheduler:
                     # lost the race to an abandon (drain deadline, stale
                     # heartbeat): the outcome stays CANCELLED
                     job.result = None
+                    self._settle_cost(job)
                     continue
             except TaskCancelledError:
                 if not job.try_finish(CANCELLED):
+                    self._settle_cost(job)
                     continue
             except Exception as ex:
                 from fugue_tpu.rpc.http import structured_error
 
                 if job.finished:  # abandoned mid-flight: outcome settled
+                    self._settle_cost(job)
                     continue
                 job.error = structured_error(ex)
                 if not job.try_finish(ERROR):
+                    self._settle_cost(job)
                     continue
+            self._settle_cost(job)
             self._notify_finish(job)
 
     def _dispatch(self, job: ServeJob) -> Any:

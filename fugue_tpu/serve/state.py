@@ -325,6 +325,12 @@ class ServeStateJournal:
                 # a profiled submission stays profiled when a restart
                 # or adoption resubmits it
                 "profile": bool(getattr(job, "profile_requested", False)),
+                # scheduling fields (ISSUE 18): a resubmitted job keeps
+                # its priority and its ABSOLUTE deadline — a deadline
+                # that lapsed while the daemon was down settles as a
+                # structured deadline error, not a silent re-run
+                "priority": int(getattr(job, "priority", 0) or 0),
+                "deadline": float(getattr(job, "deadline", 0.0) or 0.0),
             }
         self.write()
 

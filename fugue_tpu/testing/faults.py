@@ -87,6 +87,7 @@ KNOWN_SITES = (
     "serve.dispatch",
     "serve.http",
     "serve.route",
+    "serve.scale",
     "obs.trace",
     "cache.persist",
     "stream.commit",

@@ -133,3 +133,4 @@ def test_cli_subprocess_self_test_gate():
     )
     assert res.returncode == 0, res.stdout + res.stderr
     assert "self-test passed" in res.stdout
+    assert "admission-check passed: 5 decisions replayed" in res.stdout

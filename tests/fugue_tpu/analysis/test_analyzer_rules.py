@@ -734,6 +734,64 @@ def test_fwf507_lake_conf_rules():
     assert not any(x.code == "FWF507" for x in _analyze(dag))
 
 
+def test_fwf508_autoscale_conf_rules():
+    # both halves of the autoscale rule: fugue.serve.autoscale.* keys
+    # without the max_replicas master switch (or without a fleet) are
+    # silently inert; an elastic fleet without a shared state path
+    # loses every session a scale-down drains
+    dag = FugueWorkflow()
+    dag.df([[0]], "a:int").persist()
+    # tuning keys without the master switch: one diag per inert key
+    diags = _analyze(
+        dag,
+        conf={
+            "fugue.serve.autoscale.sustain_ticks": 5,
+            "fugue.serve.autoscale.cooldown": 30.0,
+        },
+        codes={"FWF508"},
+    )
+    assert len(diags) == 2
+    d = _assert_diag(diags, "FWF508", Severity.WARN, needs_callsite=False)
+    assert "fugue.serve.autoscale.max_replicas" in d.message
+    # switch present but <= 0: the tuning keys are still inert
+    assert any(
+        x.code == "FWF508"
+        for x in _analyze(
+            dag,
+            conf={
+                "fugue.serve.autoscale.max_replicas": 0,
+                "fugue.serve.autoscale.cooldown": 30.0,
+            },
+        )
+    )
+    # switch on but no fleet key: an embedded daemon never autoscales,
+    # and no state path: drains would have nothing to adopt — both warn
+    diags = _analyze(
+        dag,
+        conf={"fugue.serve.autoscale.max_replicas": 4},
+        codes={"FWF508"},
+    )
+    assert len(diags) == 2
+    messages = " | ".join(x.message for x in diags)
+    assert "fugue.serve.fleet.replicas" in messages
+    assert "fugue.serve.state_path" in messages
+    # fleet + shared state path -> a well-configured elastic fleet
+    assert not any(
+        x.code == "FWF508"
+        for x in _analyze(
+            dag,
+            conf={
+                "fugue.serve.autoscale.max_replicas": 4,
+                "fugue.serve.autoscale.sustain_ticks": 5,
+                "fugue.serve.fleet.replicas": 1,
+                "fugue.serve.state_path": "/tmp/fleet",
+            },
+        )
+    )
+    # no autoscale keys at all: silent
+    assert not any(x.code == "FWF508" for x in _analyze(dag))
+
+
 def test_every_rule_has_corpus_coverage():
     """The corpus above must track the registry: a newly registered rule
     without a fixture here fails this meta-check."""
@@ -741,7 +799,7 @@ def test_every_rule_has_corpus_coverage():
         "FWF101", "FWF102", "FWF103", "FWF104", "FWF105", "FWF106",
         "FWF201", "FWF202", "FWF301", "FWF302", "FWF303", "FWF401",
         "FWF402", "FWF403", "FWF404", "FWF501", "FWF502", "FWF503",
-        "FWF504", "FWF505", "FWF506", "FWF507",
+        "FWF504", "FWF505", "FWF506", "FWF507", "FWF508",
     }
     assert {r.code for r in all_rules()} == covered
 

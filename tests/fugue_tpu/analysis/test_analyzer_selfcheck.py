@@ -41,6 +41,22 @@ def test_builtin_selftest_corpus_clean():
     ]
 
 
+def test_admission_check_replays_the_pinned_decisions():
+    # the ISSUE 18 self-test leg: a real PredictiveAdmission replayed
+    # against the canned stats fixture must be deterministic AND land
+    # exactly on the pinned admit/shed/defer contract
+    from fugue_tpu.analysis.selftest import (
+        admission_check_failed,
+        run_admission_check,
+    )
+
+    decisions = run_admission_check()
+    assert not admission_check_failed(decisions), decisions
+    verdicts = [v.split()[0] for _, v in decisions]
+    # every branch of the admission plane is exercised by the fixture
+    assert verdicts == ["admit", "shed", "admit", "shed", "defer"]
+
+
 # schema: *,s:double
 def _with_s(df: pd.DataFrame) -> pd.DataFrame:
     return df.assign(s=df["b"] * 2.0)

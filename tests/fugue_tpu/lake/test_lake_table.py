@@ -240,3 +240,85 @@ def test_commit_conflict_is_classified_transient():
     from fugue_tpu.workflow.fault import TRANSIENT, classify_error
 
     assert classify_error(LakeCommitConflict("lost the CAS")) == TRANSIENT
+
+
+# ---------------------------------------------------------------------------
+# vacuum (ISSUE 18): orphan sweep with a crash-grace window
+# ---------------------------------------------------------------------------
+def _orphan_via_killed_commit(lt, table):
+    """Crash a writer between data land and manifest CAS (the chaos
+    site ``lake.commit``), leaving orphan parquet parts."""
+    from fugue_tpu.testing.faults import FaultPlan, FaultSpec, inject_faults
+
+    plan = FaultPlan(
+        FaultSpec(
+            "lake.commit", "*", times=1,
+            error=lambda: OSError("injected kill before manifest CAS"),
+        ),
+        seed=11,
+    )
+    with inject_faults(plan):
+        with pytest.raises(OSError):
+            lt.append(table)
+    assert plan.total("injected") == 1
+
+
+def _data_files(tmp_path):
+    return sorted((tmp_path / "tbl" / "data").iterdir())
+
+
+def test_vacuum_sweeps_orphans_keeps_history_and_grace(tmp_path):
+    lt = _lt(tmp_path)
+    lt.append(_t(k=[1, 2], v=[1.0, 2.0]))
+    lt.append(_t(k=[3, 4], v=[3.0, 4.0]))
+    # compaction rewrites the head but OLD manifests still reference the
+    # originals — vacuum must treat the whole chain as live
+    assert lt.compact(target_rows=10) is not None
+    live_before = len(_data_files(tmp_path))
+    _orphan_via_killed_commit(lt, _t(k=[9], v=[9.0]))
+    assert len(_data_files(tmp_path)) == live_before + 1
+    # fresh orphan is inside the grace window: kept, counted
+    rep = lt.vacuum(grace_secs=3600.0)
+    assert rep["removed"] == 0 and rep["kept_grace"] == 1
+    assert lt.counters["vacuum_kept_grace"] == 1
+    # grace elapsed (grace 0): the orphan goes, live files stay
+    rep = lt.vacuum(grace_secs=0.0)
+    assert rep["removed"] == 1 and rep["bytes"] > 0
+    assert lt.counters["files_vacuumed"] == 1
+    assert len(_data_files(tmp_path)) == live_before
+    # every snapshot still reads byte-identically after the sweep
+    assert sorted(lt.scan(version=1).to_pydict()["k"]) == [1, 2]
+    assert sorted(lt.scan(version=2).to_pydict()["k"]) == [1, 2, 3, 4]
+    assert sorted(lt.scan().to_pydict()["k"]) == [1, 2, 3, 4]
+    # idempotent: nothing left to sweep
+    assert lt.vacuum(grace_secs=0.0)["removed"] == 0
+
+
+def test_vacuum_crash_mid_sweep_retries_clean(tmp_path):
+    lt = _lt(tmp_path)
+    lt.append(_t(k=[1], v=[1.0]))
+    _orphan_via_killed_commit(lt, _t(k=[8], v=[8.0]))
+    _orphan_via_killed_commit(lt, _t(k=[9], v=[9.0]))
+    # kill the sweep after its first delete
+    real_rm = lt._fs.rm
+    calls = {"n": 0}
+
+    def dying_rm(path, recursive=False):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise OSError("injected kill mid-vacuum")
+        return real_rm(path, recursive=recursive)
+
+    lt._fs.rm = dying_rm
+    try:
+        with pytest.raises(OSError):
+            lt.vacuum(grace_secs=0.0)
+    finally:
+        lt._fs.rm = real_rm
+    # a partial sweep only leaves orphans behind — reads are unharmed
+    assert lt.scan().to_pydict()["k"] == [1]
+    # the NEXT vacuum finishes the job
+    rep = lt.vacuum(grace_secs=0.0)
+    assert rep["removed"] == 1
+    assert lt.counters["files_vacuumed"] == 2
+    assert lt.vacuum(grace_secs=0.0)["removed"] == 0

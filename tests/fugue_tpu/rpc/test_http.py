@@ -223,3 +223,44 @@ def test_stop_server_warns_on_wedged_thread(caplog):
     # the wedged handle is kept so a later stop can observe/retry it,
     # and calling again stays safe
     server.stop_server()
+
+
+# ---------------------------------------------------------------------------
+# retry backoff: full jitter on top of the server's Retry-After (ISSUE 18)
+# ---------------------------------------------------------------------------
+def test_backoff_full_jitter_spreads_a_synchronized_herd():
+    import random
+
+    from fugue_tpu.rpc.http import backoff_delay
+
+    # N clients all 503'd in the same instant with the SAME Retry-After
+    # hint (a fleet-wide overload shed does exactly this). Their next
+    # attempts must NOT land at one synchronized release time.
+    hint = 1.0
+    delays = [
+        backoff_delay(3, random.Random(seed), server_hint=hint)
+        for seed in range(32)
+    ]
+    # the hint is a floor — nobody comes back before the server asked —
+    # and the jittered exponential is bounded above by its 2s cap
+    assert all(hint <= d <= hint + 2.0 for d in delays)
+    # full jitter: the herd spreads over the window instead of stacking
+    # on one instant (the old policy returned EXACTLY the hint for all)
+    assert len({round(d, 6) for d in delays}) > 24
+    assert max(delays) - min(delays) > 0.02
+
+
+def test_backoff_without_hint_stays_bounded_exponential():
+    import random
+
+    from fugue_tpu.rpc.http import backoff_delay
+
+    rng = random.Random(7)
+    for attempt in range(1, 10):
+        d = backoff_delay(attempt, rng)
+        assert 0.0 <= d <= 2.0
+    # the exponential base still grows with the attempt number: a high
+    # attempt can reach delays a first attempt never can
+    first = [backoff_delay(1, random.Random(s)) for s in range(64)]
+    late = [backoff_delay(8, random.Random(s)) for s in range(64)]
+    assert max(first) <= 0.05 and max(late) > 0.5

@@ -1,0 +1,301 @@
+"""Predictive admission & scheduling (ISSUE 18): cost estimates from
+stats-store history, shortest-job-first under per-tenant fairness,
+priority ordering, queued-deadline expiry, predicted-memory deferral
+arithmetic, and overload shedding in priority order with a
+predicted-drain Retry-After. Tier-1 compatible; select with
+``-m serve``."""
+
+import threading
+import time
+
+import pytest
+
+from fugue_tpu.constants import (
+    FUGUE_CONF_SERVE_ADMISSION_DEFAULT_MS,
+    FUGUE_CONF_SERVE_ADMISSION_MAX_WAIT,
+    FUGUE_CONF_SERVE_MAX_CONCURRENT,
+    FUGUE_CONF_SERVE_SCHEDULER,
+    FUGUE_CONF_SERVE_STATE_PATH,
+)
+from fugue_tpu.serve import (
+    BackpressureError,
+    CostEstimate,
+    PredictiveAdmission,
+    QueryCostModel,
+    ServeClient,
+    ServeDaemon,
+)
+from fugue_tpu.serve.admission import make_admission, sql_cost_key
+
+pytestmark = pytest.mark.serve
+
+_CREATE = "CREATE [[0,1],[0,2],[1,3]] SCHEMA k:long,v:long"
+_CHEAP = "SELECT k, SUM(v) AS s FROM t GROUP BY k"
+_HEAVY = "SELECT k, SUM(v) AS s, COUNT(*) AS c FROM t GROUP BY k"
+
+
+class _StubStats:
+    def __init__(self, history=None):
+        self._h = history or {}
+
+    def history(self, fp):
+        return list(self._h.get(fp, []))
+
+
+def _obs(total_ms, device_bytes=0):
+    tasks = (
+        {"t1": {"device_bytes": device_bytes}} if device_bytes else {}
+    )
+    return {"workflow": "w", "total_ms": total_ms, "tasks": tasks}
+
+
+# ---------------------------------------------------------------------------
+# the cost model
+# ---------------------------------------------------------------------------
+def test_cost_model_defaults_history_and_feedback():
+    store = _StubStats(
+        {"fp-a": [_obs(10.0, 100), _obs(30.0, 300), _obs(20.0, 200)]}
+    )
+    model = QueryCostModel(store, default_ms=250.0, default_bytes=1024)
+    # never-seen fingerprint: registered defaults, marked unobserved
+    est = model.estimate_fingerprint("fp-ghost")
+    assert est == CostEstimate(250.0, 1024, False)
+    # observed: MEAN wall (central tendency), MAX bytes (worst case)
+    est = model.estimate_fingerprint("fp-a")
+    assert est.wall_ms == pytest.approx(20.0)
+    assert est.device_bytes == 300 and est.observed
+    # submit-time estimates go through the sql-text feedback map; the
+    # key is whitespace-normalized so formatting shares history
+    assert model.estimate_sql("SELECT 1").observed is False
+    model.note_fingerprint(sql_cost_key("SELECT  1"), "fp-a")
+    assert model.estimate_sql("SELECT 1").wall_ms == pytest.approx(20.0)
+    assert sql_cost_key("SELECT\n1  ") == sql_cost_key("SELECT 1")
+
+
+def test_cost_model_sql_map_is_bounded():
+    from fugue_tpu.serve import admission as adm
+
+    model = QueryCostModel(None)
+    cap = adm._MAX_SQL_KEYS
+    for i in range(cap + 10):
+        model.note_fingerprint(f"key-{i}", f"fp-{i}")
+    # oldest entries evicted, newest retained
+    assert model.resolve("key-0") is None
+    assert model.resolve(f"key-{cap + 9}") == f"fp-{cap + 9}"
+
+
+# ---------------------------------------------------------------------------
+# predictive planning arithmetic
+# ---------------------------------------------------------------------------
+def test_admission_inflight_drain_and_memory_planning():
+    budget = {"bytes": 1000}
+    adm = PredictiveAdmission(
+        QueryCostModel(None),
+        max_concurrent=2,
+        memory_fraction=0.8,
+        budget_bytes_fn=lambda: budget["bytes"],
+    )
+    big = CostEstimate(1000.0, 700, True)
+    small = CostEstimate(200.0, 100, True)
+    adm.job_queued("j1", big)
+    adm.job_queued("j2", small)
+    # drain = queued work over slots (nothing running yet)
+    assert adm.predicted_drain_secs() == pytest.approx(1.2 / 2)
+    adm.job_started("j1")
+    assert adm.inflight_bytes() == 700
+    # 700 + 700 > 800 budgeted bytes: a second big job defers...
+    assert not adm.fits_memory(big, anything_running=True)
+    # ...but a small one backfills (700 + 100 <= 800)
+    assert adm.fits_memory(small, anything_running=True)
+    # idle scheduler always admits one (livelock escape), and an
+    # ungoverned engine (budget 0) never defers
+    assert adm.fits_memory(big, anything_running=False)
+    budget["bytes"] = 0
+    assert adm.fits_memory(big, anything_running=True)
+    budget["bytes"] = 1000
+    # running work counts at HALF toward drain (assumed half done)
+    assert adm.predicted_drain_secs() == pytest.approx(
+        (200.0 + 1000.0 / 2.0) / 1000.0 / 2
+    )
+    adm.job_finished("j1")
+    adm.job_dequeued("j2")
+    assert adm.inflight_bytes() == 0
+    assert adm.predicted_drain_secs() == 0.0
+    d = adm.describe()
+    assert d["running_jobs"] == 0 and d["queued_jobs"] == 0
+
+
+def test_make_admission_matches_daemon_construction():
+    adm = make_admission(None, 4, 0.5, 100.0, 2048)
+    assert adm.model.default_ms == 100.0
+    assert adm.model.default_bytes == 2048
+    assert adm._slots == 4 and adm._memory_fraction == 0.5
+
+
+# ---------------------------------------------------------------------------
+# the predictive scheduler in a live daemon
+# ---------------------------------------------------------------------------
+class _Recorder:
+    """Gate + order recorder over the scheduler's execute hook."""
+
+    def __init__(self, daemon):
+        self._real = daemon.scheduler._execute
+        self.release = threading.Event()
+        self.order = []
+        self._first = threading.Event()
+        daemon.scheduler._execute = self
+        self._daemon = daemon
+
+    def __call__(self, job):
+        self.order.append(job.sql)
+        self._first.set()
+        if len(self.order) == 1:
+            self.release.wait(timeout=60)
+        return self._real(job)
+
+    def wait_first(self):
+        assert self._first.wait(timeout=30)
+
+    def restore(self):
+        self.release.set()
+        self._daemon.scheduler._execute = self._real
+
+
+def _predictive_conf(tmp_path, **extra):
+    conf = {
+        FUGUE_CONF_SERVE_SCHEDULER: "predictive",
+        FUGUE_CONF_SERVE_MAX_CONCURRENT: 1,
+        FUGUE_CONF_SERVE_STATE_PATH: str(tmp_path / "state"),
+    }
+    conf.update(extra)
+    return conf
+
+
+def test_priority_then_shortest_job_first_from_history(tmp_path):
+    with ServeDaemon(_predictive_conf(tmp_path)) as daemon:
+        assert daemon.status()["backpressure"]["scheduler"] == "predictive"
+        client = ServeClient(*daemon.address)
+        sid = client.create_session()
+        client.sql(sid, _CREATE, save_as="t", collect=False)
+        # teach the cost model: HEAVY is slow, CHEAP is fast
+        model = daemon._admission.model
+        model.note_fingerprint(sql_cost_key(_CHEAP), "fp-cheap")
+        model.note_fingerprint(sql_cost_key(_HEAVY), "fp-heavy")
+        daemon._stats_store.record("fp-cheap", _obs(5.0))
+        daemon._stats_store.record("fp-heavy", _obs(5000.0))
+        rec = _Recorder(daemon)
+        try:
+            blocker = client.submit_async(sid, "SELECT COUNT(*) AS c FROM t")
+            rec.wait_first()  # the queue now reorders behind this one
+            j_heavy = client.submit_async(sid, _HEAVY)
+            j_cheap = client.submit_async(sid, _CHEAP)
+            j_prio = client.submit_async(
+                sid, "SELECT MAX(v) AS m FROM t", priority=5
+            )
+            rec.release.set()
+            for jid in (blocker, j_heavy, j_cheap, j_prio):
+                snap = client.wait(jid)
+                assert snap["status"] == "done", snap.get("error")
+        finally:
+            rec.restore()
+        # priority beats cost; then predicted-shortest runs before the
+        # heavy one despite arriving AFTER it (SJF, not FIFO)
+        assert rec.order[1] == "SELECT MAX(v) AS m FROM t"
+        assert rec.order[2] == _CHEAP and rec.order[3] == _HEAVY
+        # job snapshots carry the admission fields
+        assert client.job(j_prio)["priority"] == 5
+
+
+def test_queued_deadline_settles_as_structured_error(tmp_path):
+    with ServeDaemon(_predictive_conf(tmp_path)) as daemon:
+        client = ServeClient(*daemon.address)
+        sid = client.create_session()
+        client.sql(sid, _CREATE, save_as="t", collect=False)
+        rec = _Recorder(daemon)
+        try:
+            blocker = client.submit_async(sid, "SELECT COUNT(*) AS c FROM t")
+            rec.wait_first()
+            doomed = client.submit_async(sid, _CHEAP, deadline=0.05)
+            time.sleep(0.2)  # the deadline lapses while still queued
+            rec.release.set()
+            client.wait(blocker)
+            snap = client.wait(doomed)
+        finally:
+            rec.restore()
+        assert snap["status"] == "error"
+        assert snap["error"]["error"] == "DeadlineExceededError"
+        assert "deadline" in snap["error"]["message"]
+        # the doomed job never reached the engine
+        assert _CHEAP not in rec.order
+
+
+def test_overload_sheds_in_priority_order_with_drain_retry_after(tmp_path):
+    conf = _predictive_conf(
+        tmp_path,
+        **{
+            # every unknown query predicts 10s of work; even ONE queued
+            # job overflows a 0.1s wait budget ~100x
+            FUGUE_CONF_SERVE_ADMISSION_DEFAULT_MS: 10_000.0,
+            FUGUE_CONF_SERVE_ADMISSION_MAX_WAIT: 0.1,
+        },
+    )
+    with ServeDaemon(conf) as daemon:
+        client = ServeClient(*daemon.address)
+        sid = client.create_session()
+        client.sql(sid, _CREATE, save_as="t", collect=False)
+        rec = _Recorder(daemon)
+        try:
+            blocker = client.submit_async(sid, "SELECT COUNT(*) AS c FROM t")
+            rec.wait_first()
+            # the running blocker alone predicts a drain far beyond the
+            # 0.1s wait budget: low-priority work is shed with a
+            # drain-sized Retry-After
+            with pytest.raises(BackpressureError) as ex:
+                daemon.submit(sid, _HEAVY, wait=False)
+            assert ex.value.status == 503
+            assert ex.value.retry_after >= 1.0
+            assert "shed" in str(ex.value) or "overload" in str(ex.value)
+            # high-priority submissions still get through the shed gate,
+            # and once admitted they are COMMITTED: never dropped
+            j3 = daemon.submit(sid, _HEAVY, wait=False, priority=10_000)
+            rec.release.set()
+            for jid in (blocker, j3.job_id):
+                snap = client.wait(jid)
+                assert snap["status"] == "done", snap.get("error")
+        finally:
+            rec.restore()
+        rej = daemon.status()["backpressure"]["rejections"]
+        assert rej.get("shed", 0) >= 1
+        adm = daemon.status()["admission"]
+        assert adm["max_predicted_wait"] == pytest.approx(0.1)
+        assert "fugue_serve_predicted_drain_seconds" in daemon.render_metrics()
+
+
+def test_fifo_stays_the_default(tmp_path):
+    with ServeDaemon({FUGUE_CONF_SERVE_MAX_CONCURRENT: 1}) as daemon:
+        st = daemon.status()
+        assert st["backpressure"]["scheduler"] == "fifo"
+        assert "admission" not in st
+        assert daemon._admission is None
+    with pytest.raises(ValueError, match="scheduler"):
+        ServeDaemon({FUGUE_CONF_SERVE_SCHEDULER: "quantum"})
+
+
+def test_recovered_jobs_keep_priority_and_deadline(tmp_path):
+    conf = _predictive_conf(tmp_path)
+    d1 = ServeDaemon(conf).start()
+    client = ServeClient(*d1.address)
+    sid = client.create_session()
+    client.sql(sid, _CREATE, save_as="t", collect=False)
+    rec = _Recorder(d1)
+    jid = client.submit_async(sid, _CHEAP, priority=7)
+    d1._hard_kill()
+    rec.release.set()
+    d2 = ServeDaemon(conf).start()
+    try:
+        c2 = ServeClient(*d2.address)
+        snap = c2.wait(jid)
+        assert snap["status"] == "done", snap.get("error")
+        assert snap["priority"] == 7 and snap.get("recovered")
+    finally:
+        d2.stop()

@@ -1,0 +1,251 @@
+"""Fleet autoscaling chaos gate (ISSUE 18): sustained-pressure
+scale-up, idle drain-then-retire scale-down with journal-verified zero
+session loss, the ``serve.scale`` fault site (hard kill mid-scale-down
+degrades to an ordinary death failover), and controller hysteresis.
+Tier-1 compatible; select with ``-m fleet``."""
+
+import threading
+import time
+
+import pytest
+
+from fugue_tpu.constants import (
+    FUGUE_CONF_SERVE_AUTOSCALE_COOLDOWN,
+    FUGUE_CONF_SERVE_AUTOSCALE_IDLE_TICKS,
+    FUGUE_CONF_SERVE_AUTOSCALE_INTERVAL,
+    FUGUE_CONF_SERVE_AUTOSCALE_MAX_REPLICAS,
+    FUGUE_CONF_SERVE_AUTOSCALE_SUSTAIN_TICKS,
+    FUGUE_CONF_SERVE_AUTOSCALE_UP_QUEUE,
+    FUGUE_CONF_SERVE_BREAKER_THRESHOLD,
+    FUGUE_CONF_SERVE_FLEET_DEATH_THRESHOLD,
+    FUGUE_CONF_SERVE_FLEET_HEALTH_INTERVAL,
+    FUGUE_CONF_SERVE_MAX_CONCURRENT,
+    FUGUE_CONF_SERVE_STATE_PATH,
+)
+from fugue_tpu.serve import ServeClient, ServeFleet
+from fugue_tpu.testing.faults import (
+    KNOWN_SITES,
+    FaultPlan,
+    FaultSpec,
+    inject_faults,
+)
+
+pytestmark = [pytest.mark.serve, pytest.mark.chaos, pytest.mark.fleet]
+
+_CREATE = "CREATE [[0,1],[0,2],[1,3]] SCHEMA k:long,v:long"
+_AGG = "SELECT k, SUM(v) AS s FROM t GROUP BY k"
+_EXPECTED = [[0, 3], [1, 3]]
+
+
+def _conf(tmp_path, **extra):
+    conf = {
+        FUGUE_CONF_SERVE_BREAKER_THRESHOLD: 0,
+        FUGUE_CONF_SERVE_STATE_PATH: str(tmp_path / "state"),
+        FUGUE_CONF_SERVE_FLEET_HEALTH_INTERVAL: 0.05,
+        FUGUE_CONF_SERVE_FLEET_DEATH_THRESHOLD: 1,
+        FUGUE_CONF_SERVE_MAX_CONCURRENT: 2,
+    }
+    conf.update(extra)
+    return conf
+
+
+def _autoscale_conf(tmp_path, **extra):
+    # the background thread is effectively parked (interval=60) so the
+    # tests drive tick() deterministically
+    return _conf(
+        tmp_path,
+        **{
+            FUGUE_CONF_SERVE_AUTOSCALE_MAX_REPLICAS: 2,
+            FUGUE_CONF_SERVE_AUTOSCALE_INTERVAL: 60.0,
+            FUGUE_CONF_SERVE_AUTOSCALE_UP_QUEUE: 2,
+            FUGUE_CONF_SERVE_AUTOSCALE_SUSTAIN_TICKS: 2,
+            FUGUE_CONF_SERVE_AUTOSCALE_IDLE_TICKS: 2,
+            FUGUE_CONF_SERVE_AUTOSCALE_COOLDOWN: 0.0,
+            **extra,
+        },
+    )
+
+
+class _Gate:
+    """Freeze one replica's job execution so queue depth is exact."""
+
+    def __init__(self, daemon):
+        self._real = daemon.scheduler._execute
+        self.release = threading.Event()
+        daemon.scheduler._execute = self
+        self._daemon = daemon
+
+    def __call__(self, job):
+        self.release.wait(timeout=60)
+        return self._real(job)
+
+    def restore(self):
+        self.release.set()
+        self._daemon.scheduler._execute = self._real
+
+
+def _wait_until(cond, timeout=30.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_serve_scale_is_a_registered_fault_site():
+    assert "serve.scale" in KNOWN_SITES
+
+
+def test_autoscaler_wiring_follows_conf(tmp_path):
+    fleet = ServeFleet(_conf(tmp_path), replicas=1)
+    assert fleet.autoscaler is None  # max_replicas unset: off
+    fleet2 = ServeFleet(_autoscale_conf(tmp_path / "b"), replicas=1)
+    scaler = fleet2.autoscaler
+    assert scaler is not None
+    d = scaler.describe()
+    assert d["max_replicas"] == 2 and d["min_replicas"] == 1
+    assert d["sustain_ticks"] == 2 and d["scale_up_queue"] == 2
+    assert d["last_decision"] == "idle" and d["scale_ups"] == 0
+
+
+def test_scale_up_on_sustained_pressure_then_idle_retire(tmp_path):
+    with ServeFleet(_autoscale_conf(tmp_path), replicas=1) as fleet:
+        scaler = fleet.autoscaler
+        client = ServeClient(*fleet.address)
+        sid0 = client.create_session()
+        r = client.sql(sid0, _CREATE, save_as="t", collect=False)
+        assert r["status"] == "done", r.get("error")
+
+        gate = _Gate(fleet.replica("r0"))
+        try:
+            jids = [
+                client.submit_async(sid0, _AGG, collect=False)
+                for _ in range(4)
+            ]
+            # one hot tick is NOT enough (sustain_ticks=2): a burst the
+            # queue can absorb must not add hardware
+            assert scaler.tick() == "pressure"
+            assert fleet.replica_ids == ["r0"]
+            out = scaler.tick()
+            assert out == "scale_up r1", out
+        finally:
+            gate.restore()
+        assert fleet.replica_ids == ["r0", "r1"]
+        assert _wait_until(
+            lambda: fleet.router.check_health().get("r1") == "healthy"
+        )
+        for jid in jids:
+            snap = client.wait(jid)
+            assert snap["status"] == "done", snap.get("error")
+
+        # a NEW session lands on the fresh (least-loaded) replica and
+        # serves queries there
+        sid1 = client.create_session()
+        assert fleet.router.affinity()[sid1] == "r1"
+        r = client.sql(sid1, _CREATE, save_as="t", collect=False)
+        assert r["status"] == "done", r.get("error")
+        assert sorted(client.sql(sid1, _AGG)["result"]["rows"]) == _EXPECTED
+
+        # fleet-wide idle for idle_ticks: the NEWEST replica drains and
+        # retires — and its session moves by journal adoption, not loss
+        assert scaler.tick() == "idle"
+        out = scaler.tick()
+        assert out == "scale_down r1", out
+        assert fleet.replica_ids == ["r0"]
+        assert fleet.router.affinity()[sid1] == "r0"
+        assert sorted(client.sql(sid1, _AGG)["result"]["rows"]) == _EXPECTED
+        assert "t" in client.session(sid1)["tables"]
+        d = scaler.describe()
+        assert d["scale_ups"] == 1 and d["scale_downs"] == 1
+        # the autoscaler's own families render under the registered
+        # fugue_autoscale_ prefix
+        text = scaler.render_metrics()
+        assert "fugue_autoscale_scale_ups_total 1" in text
+        assert "fugue_autoscale_replicas 1" in text
+
+
+def test_scale_up_failure_counts_error_and_retries(tmp_path):
+    with ServeFleet(_autoscale_conf(tmp_path), replicas=1) as fleet:
+        scaler = fleet.autoscaler
+        client = ServeClient(*fleet.address)
+        sid = client.create_session()
+        client.sql(sid, _CREATE, save_as="t", collect=False)
+        gate = _Gate(fleet.replica("r0"))
+        try:
+            jids = [
+                client.submit_async(sid, _AGG, collect=False)
+                for _ in range(4)
+            ]
+            assert scaler.tick() == "pressure"
+            plan = FaultPlan(
+                FaultSpec(
+                    "serve.scale", "up *", times=1,
+                    error=lambda: OSError("injected scale-up crash"),
+                ),
+                seed=3,
+            )
+            with inject_faults(plan):
+                assert scaler.tick() == "error"
+            assert plan.total("injected") == 1
+            # nothing half-added, and the pressure streak SURVIVES the
+            # failure: the next clean tick retries immediately
+            assert fleet.replica_ids == ["r0"]
+            assert scaler.tick() == "scale_up r1"
+            assert fleet.replica_ids == ["r0", "r1"]
+        finally:
+            gate.restore()
+        for jid in jids:
+            client.wait(jid)
+
+
+def test_hard_kill_at_serve_scale_degrades_to_death_failover(tmp_path):
+    """A crash mid-scale-down (after the drain, before the planned
+    adoption) must lose nothing: the drained journal is already on the
+    shared fs, so the router's death failover adopts it — the planned
+    and unplanned paths converge on the same journal."""
+    with ServeFleet(_conf(tmp_path), replicas=2) as fleet:
+        client = ServeClient(*fleet.address)
+        sids = [client.create_session() for _ in range(2)]
+        for sid in sids:
+            r = client.sql(sid, _CREATE, save_as="t", collect=False)
+            assert r["status"] == "done", r.get("error")
+        aff = fleet.router.affinity()
+        victim_sid = next(s for s in sids if aff[s] == "r1")
+
+        plan = FaultPlan(
+            FaultSpec(
+                "serve.scale", "down r1", times=1,
+                error=lambda: OSError("injected kill mid-scale-down"),
+            ),
+            seed=5,
+        )
+        with inject_faults(plan):
+            with pytest.raises(OSError):
+                fleet.retire_replica("r1")
+        assert plan.total("injected") == 1
+        # the replica is still attached (retire never finished) with a
+        # stopped daemon: the health loop declares it dead and adopts
+        assert "r1" in fleet.replica_ids
+        assert _wait_until(
+            lambda: fleet.router.affinity().get(victim_sid) == "r0"
+        ), "death failover did not adopt the half-retired replica"
+        # zero session loss: the migrated session answers with its data
+        assert (
+            sorted(client.sql(victim_sid, _AGG)["result"]["rows"])
+            == _EXPECTED
+        )
+        assert "t" in client.session(victim_sid)["tables"]
+        # a RETRY of the retire now completes (journal already empty)
+        rep = fleet.retire_replica("r1")
+        assert rep["migrated_sessions"] == 0
+        assert fleet.replica_ids == ["r0"]
+        assert all(r["replica"] != "r1" for r in fleet.router.replicas())
+
+
+def test_retire_replica_refuses_to_strand_the_last_survivor(tmp_path):
+    with ServeFleet(_conf(tmp_path), replicas=1) as fleet:
+        with pytest.raises(ValueError, match="survivor"):
+            fleet.retire_replica("r0")
+        with pytest.raises(KeyError):
+            fleet.retire_replica("r9")

@@ -501,3 +501,106 @@ def test_single_replica_death_answers_503_until_replacement(tmp_path):
             replacement.stop()
     finally:
         fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# scale-down racing continuous work (ISSUE 18): a draining replica
+# hosting a StandingPipeline view hands the pipeline to the adopter
+# mid-window with exactly-once fold parity
+# ---------------------------------------------------------------------------
+def test_scale_down_hands_standing_pipeline_to_adopter_exactly_once(
+    tmp_path,
+):
+    import os
+
+    import numpy as np
+    import pandas as pd
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    src = str(tmp_path / "in")
+
+    def _land(name, pdf):
+        os.makedirs(src, exist_ok=True)
+        tmp = os.path.join(src, f".{name}.tmp")
+        pq.write_table(
+            pa.Table.from_pandas(pdf, preserve_index=False), tmp
+        )
+        os.replace(tmp, os.path.join(src, name))
+
+    def _pdf(seed, rows=200):
+        rng = np.random.default_rng(seed)
+        return pd.DataFrame(
+            {"k": rng.integers(0, 6, rows).astype(np.int64),
+             "v": rng.random(rows)}
+        )
+
+    frames = [_pdf(0)]
+    _land("f0.parquet", frames[0])
+    with ServeFleet(_fleet_conf(tmp_path), replicas=2) as fleet:
+        client = ServeClient(*fleet.address)
+        sids = [client.create_session() for _ in range(2)]
+        aff = fleet.router.affinity()
+        sid = next(s for s in sids if aff[s] == "r1")  # pipeline on r1
+        out = client.register_pipeline(
+            sid,
+            {
+                "name": "sess",
+                "source": src,
+                "keys": ["k"],
+                "aggs": [["s", "sum", "v"], ["c", "count", "v"]],
+            },
+        )
+        assert out["report"]["files"] == 1
+
+        # a feeder keeps landing files and stepping THROUGH the retire
+        # window — its calls ride the client retry budget across the
+        # drain 503s and the adoption handoff
+        stop = threading.Event()
+        feeder_errors = []
+
+        def _feed():
+            feeder = ServeClient(*fleet.address)
+            i = 1
+            while not stop.is_set() and i <= 3:
+                frames.append(_pdf(i))
+                _land(f"f{i}.parquet", frames[-1])
+                try:
+                    feeder.step_pipeline(sid, "sess")
+                except Exception as ex:  # pragma: no cover - must not
+                    feeder_errors.append(ex)
+                    return
+                i += 1
+                time.sleep(0.02)
+
+        feeder = threading.Thread(target=_feed)
+        feeder.start()
+        try:
+            rep = fleet.retire_replica("r1")
+        finally:
+            stop.set()
+            feeder.join(timeout=30)
+        assert not feeder_errors, feeder_errors
+        assert rep["migrated_sessions"] >= 1
+        assert fleet.router.affinity()[sid] == "r0"
+        assert fleet.replica_ids == ["r0"]
+
+        # one final file + step on the ADOPTER, then parity: every file
+        # folded exactly once — any lost or double-folded batch breaks
+        # the sums/counts against the pandas oracle
+        frames.append(_pdf(9))
+        _land("f9.parquet", frames[-1])
+        client.step_pipeline(sid, "sess")
+        snap = client.pipeline(sid, "sess")
+        assert snap["progress"]["batches"] == len(frames)
+        rows = client.sql(
+            sid, "SELECT k, s, c FROM sess ORDER BY k LIMIT 100"
+        )["result"]["rows"]
+        got = pd.DataFrame(rows, columns=["k", "s", "c"])
+        exp = (
+            pd.concat(frames).groupby("k")["v"]
+            .agg(["sum", "count"]).reset_index()
+        )
+        assert (got["k"].to_numpy() == exp["k"].to_numpy()).all()
+        assert np.allclose(got["s"].to_numpy(), exp["sum"].to_numpy())
+        assert (got["c"].to_numpy() == exp["count"].to_numpy()).all()

@@ -273,7 +273,7 @@ def test_status_gains_uptime_version_and_compile_cache():
         # the historical shapes survived the registry migration
         assert set(st["backpressure"]["rejections"]) == {
             "draining", "queue_full", "memory_pressure", "session_cap",
-            "breaker_open", "sync_degraded",
+            "breaker_open", "sync_degraded", "shed",
         }
         assert set(st["fault_stats"]) == {
             "runs", "retries", "recoveries", "degradations",
