@@ -293,6 +293,35 @@ def main(argv: Optional[List[str]] = None) -> int:
                 file=sys.stdout,
             )
             failed = True
+        # device-recovery leg (ISSUE 19): the fault executor's
+        # degrade-recover-retry decisions replayed against a scripted
+        # engine — two replays must agree and match the pinned contract
+        try:
+            from fugue_tpu.analysis.selftest import (
+                _RECOVERY_EXPECTED,
+                recovery_check_failed,
+                run_recovery_check,
+            )
+
+            rec = run_recovery_check()
+            rec_failed = recovery_check_failed(rec)
+            if rec_failed:
+                for got, want in zip(rec, _RECOVERY_EXPECTED):
+                    if got != want:
+                        print(f"  {got!r} != expected {want!r}",
+                              file=sys.stdout)
+            print(
+                f"recovery-check {'FAILED' if rec_failed else 'passed'}: "
+                f"{len(rec)} decisions replayed",
+                file=sys.stdout,
+            )
+            failed = failed or rec_failed
+        except Exception as ex:
+            print(
+                f"recovery-check FAILED: {type(ex).__name__}: {ex}",
+                file=sys.stdout,
+            )
+            failed = True
         # both planes, one command: the workflow-corpus gate above plus
         # the FLN source lint of the installed tree
         src_errors = _run_source_lint(None, args.baseline, floor, sys.stdout)

@@ -15,6 +15,8 @@ from fugue_tpu.analysis.diagnostics import (
     register_rule,
 )
 from fugue_tpu.constants import (
+    FUGUE_CONF_JAX_DEVICES,
+    FUGUE_CONF_JAX_RECOVERY_ENABLED,
     FUGUE_CONF_LAKE_SERVE_PATH,
     FUGUE_CONF_OBS_ENABLED,
     FUGUE_CONF_OBS_PROFILE,
@@ -443,6 +445,92 @@ class AutoscaleConfRule(Rule):
                 "to adopt, so every autoscale retire loses the sessions "
                 "it drains",
             )
+
+
+@register_rule
+class DeviceRecoveryConfRule(Rule):
+    code = "FWF509"
+    severity = Severity.WARN
+    description = (
+        "fugue.jax.recovery.* keys with a single-device mesh (recovery "
+        "is inert: losing the only device leaves no survivors), or "
+        "recovery enabled without a resumable checkpoint/lake lineage "
+        "path (mid-flight frames fail their query on device loss)"
+    )
+
+    def check(self, ctx: Any) -> Iterable[Diagnostic]:
+        recovery_keys = sorted(
+            k for k in ctx.conf.keys()
+            if k.startswith("fugue.jax.recovery.")
+        )
+        if not recovery_keys:
+            return
+        # single-device pin: degraded-mesh rebuild needs at least one
+        # SURVIVOR, so a one-device mesh can never recover from a loss
+        devices = str(ctx.conf.get(FUGUE_CONF_JAX_DEVICES, "") or "").strip()
+        pinned = [p for p in devices.split(",") if p.strip() != ""]
+        if len(pinned) == 1:
+            for key in recovery_keys:
+                yield self.diag(
+                    f"'{key}' is set but {FUGUE_CONF_JAX_DEVICES}="
+                    f"'{devices}' pins the mesh to a single device: "
+                    "degraded-mesh recovery rebuilds onto the SURVIVORS "
+                    "of a loss, and a one-device mesh has none — the key "
+                    "is silently inert (widen the device slice or drop "
+                    "the fugue.jax.recovery.* keys)",
+                )
+            return
+        try:
+            # _convert, not bool(): conf values legitimately arrive as
+            # strings, and bool("false") is True
+            enabled = _convert(
+                ctx.conf.get(FUGUE_CONF_JAX_RECOVERY_ENABLED, True), bool
+            )
+        except Exception:
+            enabled = True
+        if not enabled:
+            return
+        try:
+            resume = _convert(
+                ctx.conf.get(FUGUE_CONF_WORKFLOW_RESUME, False), bool
+            )
+        except Exception:
+            resume = False
+        if resume:
+            return
+        # a PINNED lake load is deterministic lineage: recovery can
+        # re-read the exact snapshot onto the degraded mesh
+        from fugue_tpu.extensions import builtins as _b
+        from fugue_tpu.lake.format import is_lake_uri, parse_lake_uri
+
+        for t in ctx.tasks:
+            if t.extension is not _b.Load:
+                continue
+            p = t.params.get("path", None)
+            if isinstance(p, (list, tuple)):
+                p = p[0] if p else None
+            if not isinstance(p, str) or not is_lake_uri(p):
+                continue
+            params = dict(t.params.get("params", None) or {})
+            try:
+                _, uri_params = parse_lake_uri(p)
+            except Exception:
+                uri_params = {}
+            if (
+                "version" in params or "timestamp" in params
+                or "version" in uri_params or "timestamp" in uri_params
+            ):
+                return  # pinned lake lineage: rematerializable
+        yield self.diag(
+            f"{FUGUE_CONF_JAX_RECOVERY_ENABLED} is on but the workflow "
+            "has no resumable lineage path: no checkpointing "
+            "(fugue.workflow.resume is off) and no pinned lake:// AS OF "
+            "load — on device loss, frames whose shards cannot be "
+            "evacuated have nothing durable to re-materialize from, so "
+            "their owning query fails with DeviceLostError instead of "
+            "recovering — set fugue.workflow.resume=true (with a "
+            "checkpoint path) or pin lake reads to a version/timestamp",
+        )
 
 
 @register_rule

@@ -403,3 +403,123 @@ def run_admission_check() -> List[Tuple[str, str]]:
 
 def admission_check_failed(results: List[Tuple[str, str]]) -> bool:
     return results != _ADMISSION_EXPECTED
+
+
+# ---------------------------------------------------------------------------
+# device-recovery leg (ISSUE 19): the fault executor's degrade-recover-
+# retry decisions replayed against a scripted engine and injected
+# device-loss errors
+# ---------------------------------------------------------------------------
+class _ReplayEngine:
+    """The recovery surface ``execute_with_policy`` drives, with a
+    scripted mesh: each successful recovery drops the named device from
+    the survivor set; recovery refuses when disabled or when the loss
+    would leave no survivors — exactly the real engine's contract."""
+
+    def __init__(self, ndev: int):
+        self.devices = list(range(ndev))
+        self.enabled = True
+        self.recoveries = 0
+
+    def recover_from_device_loss(self, ex: Exception) -> bool:
+        from fugue_tpu.jax_backend.distributed import parse_lost_devices
+
+        if not self.enabled:
+            return False
+        lost = [d for d in parse_lost_devices(str(ex)) if d in self.devices]
+        if not lost or len(lost) >= len(self.devices):
+            return False
+        self.devices = [d for d in self.devices if d not in lost]
+        self.recoveries += 1
+        return True
+
+
+# (task, scripted per-attempt errors — None = the attempt succeeds).
+# Builders, not instances: each replay must inject FRESH errors.
+def _recovery_script() -> List[Tuple[str, List[Any]]]:
+    from fugue_tpu.testing.faults import collective_hang, device_lost
+
+    return [
+        # a mid-shuffle device loss: recover 4 -> 3 and retry clean
+        ("shuffle-groupby", [device_lost(2), None]),
+        # a hung collective is TRANSIENT, not a loss: plain retry, the
+        # mesh must NOT shrink
+        ("join-allreduce", [collective_hang(1), None]),
+        # a second loss on the already-degraded mesh: recover 3 -> 2
+        ("agg-rescan", [device_lost(0), None]),
+        # recovery disabled mid-sequence: the same error is now FATAL
+        ("post-disable", [device_lost(1), None]),
+    ]
+
+
+# the pinned contract: classification, recovery, mesh shrinkage and
+# retry accounting for the scripted sequence — any drift in the fault
+# classifier's DEVICE_LOST triage, the executor's recover-then-retry
+# branch, or the recovery bookkeeping moves one of these strings
+_RECOVERY_EXPECTED: List[Tuple[str, str]] = [
+    ("shuffle-groupby", "recovered survivors=[0,1,3] attempts=2"),
+    ("join-allreduce", "retried survivors=[0,1,3] attempts=2"),
+    ("agg-rescan", "recovered survivors=[1,3] attempts=2"),
+    ("post-disable", "fatal XlaRuntimeError survivors=[1,3] attempts=1"),
+]
+
+
+def _replay_recovery() -> List[Tuple[str, str]]:
+    from fugue_tpu.workflow.fault import RetryPolicy, execute_with_policy
+
+    engine = _ReplayEngine(4)
+    policy = RetryPolicy(max_attempts=3, backoff=0.0, jitter=0.0)
+    decisions: List[Tuple[str, str]] = []
+    for task, errors in _recovery_script():
+        if task == "post-disable":
+            engine.enabled = False
+        attempts = [0]
+        before = engine.recoveries
+
+        def _attempt() -> str:
+            err = errors[attempts[0]]
+            attempts[0] += 1
+            if err is not None:
+                raise err
+            return "ok"
+
+        survivors = "[%s]" % ",".join(str(d) for d in engine.devices)
+        try:
+            execute_with_policy(
+                _attempt, policy, engine=engine, task_name=task
+            )
+            survivors = "[%s]" % ",".join(str(d) for d in engine.devices)
+            verb = "recovered" if engine.recoveries > before else "retried"
+            decisions.append(
+                (task, f"{verb} survivors={survivors} attempts={attempts[0]}")
+            )
+        except Exception as ex:
+            survivors = "[%s]" % ",".join(str(d) for d in engine.devices)
+            decisions.append(
+                (
+                    task,
+                    f"fatal {type(ex).__name__} survivors={survivors} "
+                    f"attempts={attempts[0]}",
+                )
+            )
+    return decisions
+
+
+def run_recovery_check() -> List[Tuple[str, str]]:
+    """``--self-test`` device-recovery leg: replay the scripted
+    degrade-recover-retry sequence through the REAL fault classifier and
+    ``execute_with_policy`` TWICE — the replays must agree exactly
+    (determinism), and the decisions must match the pinned contract.
+    Returns the decision pairs for the CLI to count."""
+    first = _replay_recovery()
+    second = _replay_recovery()
+    if first != second:
+        raise AssertionError(
+            "device-recovery replay is not deterministic: "
+            f"{first!r} != {second!r}"
+        )
+    return first
+
+
+def recovery_check_failed(results: List[Tuple[str, str]]) -> bool:
+    return results != _RECOVERY_EXPECTED

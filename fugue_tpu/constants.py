@@ -52,6 +52,8 @@ FUGUE_CONF_JAX_MEMORY_BUDGET_BYTES = "fugue.jax.memory.budget_bytes"
 FUGUE_CONF_JAX_MEMORY_BUDGET_FRACTION = "fugue.jax.memory.budget_fraction"
 FUGUE_CONF_JAX_MEMORY_HIGH_WATERMARK = "fugue.jax.memory.high_watermark"
 FUGUE_CONF_JAX_MEMORY_LOW_WATERMARK = "fugue.jax.memory.low_watermark"
+FUGUE_CONF_JAX_RECOVERY_ENABLED = "fugue.jax.recovery.enabled"
+FUGUE_CONF_JAX_RECOVERY_MAX_LOSSES = "fugue.jax.recovery.max_losses"
 FUGUE_CONF_RPC_HTTP_RETRIES = "fugue.rpc.http_server.retries"
 FUGUE_CONF_RPC_HTTP_MAX_BODY = "fugue.rpc.http_server.max_body_bytes"
 FUGUE_CONF_RPC_HTTP_READ_TIMEOUT = "fugue.rpc.http_server.read_timeout"
@@ -128,6 +130,7 @@ FUGUE_CONF_LAKE_COMMIT_RETRIES = "fugue.lake.commit.retries"
 FUGUE_CONF_LAKE_COMMIT_BACKOFF = "fugue.lake.commit.backoff"
 FUGUE_CONF_LAKE_COMPACT_TARGET_ROWS = "fugue.lake.compact.target_rows"
 FUGUE_CONF_LAKE_SERVE_PATH = "fugue.lake.serve.path"
+FUGUE_CONF_LAKE_VERIFY = "fugue.lake.verify"
 
 FUGUE_COMPILE_TIME_CONFIGS = {
     FUGUE_CONF_WORKFLOW_EXCEPTION_HIDE,
@@ -349,6 +352,30 @@ def _declare_defaults() -> None:
     )
     r(FUGUE_CONF_JAX_MEMORY_HIGH_WATERMARK, float, 0.9, "admission spill trigger fraction")
     r(FUGUE_CONF_JAX_MEMORY_LOW_WATERMARK, float, 0.75, "spill-down target fraction")
+    # device-fault resilience (engine.recover_from_device_loss): on a
+    # DEVICE_LOST-classified XLA error the engine rebuilds its mesh from
+    # the surviving devices, evacuates/re-reads live frames, and retries
+    # the task under the normal backoff budget. Frames without
+    # recoverable lineage fail their owning query with DeviceLostError —
+    # never the process. Needs >1 device to have survivors (FWF509 warns
+    # when fugue.jax.devices pins a single device). Read with a local
+    # default-on fallback by the engine rather than seeded into every
+    # conf (in_defaults=False), so FWF509 only fires on EXPLICIT keys.
+    r(
+        FUGUE_CONF_JAX_RECOVERY_ENABLED,
+        bool,
+        True,
+        "degraded-mesh rebuild + block evacuation on device loss",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_JAX_RECOVERY_MAX_LOSSES,
+        int,
+        0,
+        "cumulative device losses an engine absorbs before failing fast "
+        "(0 = unlimited; each loss shrinks the mesh by the dead devices)",
+        in_defaults=False,
+    )
     # consumed with local fallbacks by their owning modules (multi-process
     # init in jax_backend/distributed.py, HTTP RPC in rpc/http.py) rather
     # than through the global defaults table — declared here so the
@@ -1021,6 +1048,16 @@ def _declare_defaults() -> None:
         "commits each materialized view as a shared versioned table "
         "under <path>/<name> any replica can query ('' = per-session "
         "parquet artifacts, the pre-lake behavior)",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_LAKE_VERIFY,
+        bool,
+        False,
+        "verify each data file's manifest-recorded sha256 on scan; a "
+        "mismatch fails the read with LakeIntegrityError and counts "
+        "fugue_lake_integrity_rejected (files committed before the "
+        "checksum field skip verification)",
         in_defaults=False,
     )
     # runtime lock-order sanitizer (testing/locktrace.py): debug-only.

@@ -155,6 +155,20 @@ class TaskCancelledError(FugueWorkflowRuntimeError):
     timed out; it never ran (or aborted at a cancellation point)."""
 
 
+class DeviceLostError(FugueWorkflowRuntimeError):
+    """A device in the engine's mesh died and the data this query needs
+    could not be recovered onto the survivors: no lazy ingest plan, no
+    checkpoint artifact, no pinned ``lake://`` version to rebuild from.
+    The error fails the OWNING query only — the engine keeps serving on
+    the degraded mesh and the process never dies. ``lost_devices`` holds
+    the dead device ids; ``frames`` the unrecoverable frame keys."""
+
+    def __init__(self, message: str, lost_devices=(), frames=()):
+        super().__init__(message)
+        self.lost_devices = tuple(lost_devices)
+        self.frames = tuple(frames)
+
+
 class FugueSQLError(FugueWorkflowCompileError):
     """FugueSQL-related compile error."""
 

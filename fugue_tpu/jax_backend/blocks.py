@@ -243,6 +243,14 @@ class JaxBlocks:
         self.row_valid = row_valid
         # per-frame cache of key factorizations (see groupby.factorize_keys)
         self.factorize_cache: Dict[Any, Any] = {}
+        # device-loss bookkeeping: ``lost`` marks a frame whose shards
+        # died with a device and could not be rebuilt (touching it fails
+        # the owning query with DeviceLostError); ``lineage`` optionally
+        # holds a zero-arg loader returning a fresh arrow table — the
+        # recoverable provenance (lazy ingest plan, checkpoint artifact,
+        # pinned lake:// version) recovery re-materializes from
+        self.lost = False
+        self.lineage: Optional[Any] = None
 
     @property
     def nrows(self) -> int:
@@ -525,6 +533,52 @@ def to_arrow(blocks: JaxBlocks, schema: Schema) -> pa.Table:
                 )
             )
     return pa.Table.from_arrays(arrays, schema=schema.pa_schema)
+
+
+def blocks_schema(blocks: JaxBlocks) -> Schema:
+    """A frame's schema as derived from its own columns (arrow types are
+    authoritative on every JaxColumn). Used when no external Schema is
+    at hand — e.g. device-loss evacuation of an anonymous frame."""
+    return Schema(
+        pa.schema(
+            [pa.field(n, c.pa_type) for n, c in blocks.columns.items()]
+        )
+    )
+
+
+def evacuate_blocks(
+    blocks: JaxBlocks, mesh: Mesh, schema: Optional[Schema] = None
+) -> None:
+    """Rebuild a frame's storage onto ``mesh`` IN PLACE via an arrow
+    round trip, preserving logical content exactly (row membership
+    compacts, strings re-encode). In place because callers across the
+    engine (catalog tables, session views, in-flight queries) hold
+    references to THIS JaxBlocks object — recovery must heal them all,
+    not just ones it can find.
+
+    An arrow round trip rather than a device-to-device resharding:
+    the old padding (a multiple of the dead mesh's size) is generally
+    not divisible by the survivor count, and the source sharding spans
+    a device that no longer answers — the host is the only safe relay.
+    Raises if the dead device's shards are already unreadable; the
+    caller then falls back to the frame's lineage."""
+    sch = schema if schema is not None else blocks_schema(blocks)
+    table = to_arrow(blocks, sch)
+    fresh = from_arrow(table, sch, mesh)
+    replace_blocks(blocks, fresh)
+
+
+def replace_blocks(blocks: JaxBlocks, fresh: JaxBlocks) -> None:
+    """Swap ``blocks``'s storage for ``fresh``'s in place (same logical
+    frame, new arrays/mesh). Derived caches reset; the ``lost`` flag
+    clears — the frame is healthy again."""
+    blocks.columns = fresh.columns
+    blocks.mesh = fresh.mesh
+    blocks.row_valid = fresh.row_valid
+    blocks._nrows = fresh._nrows
+    blocks._nrows_dev = fresh._nrows_dev
+    blocks.factorize_cache.clear()
+    blocks.lost = False
 
 
 def gather_indices(blocks: JaxBlocks, idx: Any, schema: Schema) -> JaxBlocks:

@@ -52,6 +52,11 @@ class JaxDataFrame(DataFrame):
         # by the engine on governed pending frames; consumed at blocks
         # materialization
         self._mem_gate: Optional[Any] = None
+        # () -> pa.Table reload plan set by engine.load_df on
+        # storage-backed frames; becomes blocks.lineage at
+        # materialization so device-loss recovery can re-read the
+        # artifact (see engine.recover_from_device_loss)
+        self._lineage_loader: Optional[Any] = None
 
     @staticmethod
     def from_table(table: pa.Table, mesh: Any, schema: Optional[Schema] = None) -> "JaxDataFrame":
@@ -62,6 +67,7 @@ class JaxDataFrame(DataFrame):
         res._pending = (table, mesh)
         res._lazy = None
         res._mem_gate = None
+        res._lineage_loader = None
         return res
 
     @staticmethod
@@ -92,6 +98,7 @@ class JaxDataFrame(DataFrame):
             load_blocks, load_table, mesh, nrows, load_head, narrow
         )
         res._mem_gate = None
+        res._lineage_loader = None
         return res
 
     @property
@@ -116,11 +123,19 @@ class JaxDataFrame(DataFrame):
             if gate is not None:
                 gate.before()
             if self._lazy is not None:
+                # the host decode plan doubles as device-loss recovery
+                # lineage: a dead device's shards can be re-read from
+                # storage onto the degraded mesh
+                loader = self._lazy.load_table
                 self._blocks = self._lazy.load_blocks()
+                self._blocks.lineage = loader
                 self._lazy = None  # device copy is authoritative now
             else:
                 table, mesh = self._pending  # type: ignore[misc]
                 self._blocks = from_arrow(table, self.schema, mesh)
+                self._blocks.lineage = getattr(
+                    self, "_lineage_loader", None
+                )
                 self._pending = None  # device copy is authoritative now
             if gate is not None:
                 gate.after(self._blocks)
@@ -226,8 +241,10 @@ class JaxDataFrame(DataFrame):
             )
             # the derived pending frame materializes under the same
             # admission ticket (sharing it is safe: the gate is
-            # stateless and registers whatever blocks it is handed)
+            # stateless and registers whatever blocks it is handed) and
+            # inherits the reload plan (recovery re-selects the subset)
             res._mem_gate = self._mem_gate
+            res._lineage_loader = self._lineage_loader
             return res
         blocks = JaxBlocks(
             self._blocks._nrows,

@@ -18,13 +18,20 @@ equivalents here:
   replicated side-effecting calls; one invocation per logical call is
   also the semantic an RPC notification wants).
 
+- :func:`parse_lost_devices` / :func:`surviving_devices` /
+  :func:`probe_devices` — the degraded-mesh recovery primitives: parse
+  the dead device ids out of an XLA DATA_LOSS error, or probe every
+  mesh device with a tiny transfer when the error names none, and hand
+  the execution engine the surviving device list to rebuild from.
+
 Conf keys:
 
 - ``fugue.jax.dist.coordinator`` — ``host:port`` of process 0
 - ``fugue.jax.dist.num_processes`` / ``fugue.jax.dist.process_id``
 """
 
-from typing import Any, Callable, Optional
+import re
+from typing import Any, Callable, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -36,6 +43,47 @@ CONF_NUM_PROCESSES = "fugue.jax.dist.num_processes"
 CONF_PROCESS_ID = "fugue.jax.dist.process_id"
 
 _STATE = {"initialized": False}
+
+# the id spellings XLA device errors use: "device 2", "device: 2",
+# "TPU_3", "participant 1" (collective timeouts name ranks)
+_LOST_DEVICE_RE = re.compile(
+    r"(?:device[:\s]+|TPU_|participant[:\s]+)(\d+)", re.IGNORECASE
+)
+
+
+def parse_lost_devices(text: str) -> List[int]:
+    """Dead device ids named by an XLA device-loss error message, in
+    first-mention order, deduplicated. Empty when the error names none
+    (the caller falls back to :func:`probe_devices`)."""
+    seen: List[int] = []
+    for m in _LOST_DEVICE_RE.finditer(str(text)):
+        i = int(m.group(1))
+        if i not in seen:
+            seen.append(i)
+    return seen
+
+
+def surviving_devices(mesh: Any, lost_ids: Any) -> List[Any]:
+    """The mesh's devices minus the lost ids, in mesh order. Ids match
+    on ``device.id`` — the stable process-wide index ``fugue.jax.devices``
+    also speaks."""
+    lost = set(int(i) for i in lost_ids)
+    return [d for d in mesh.devices.flat if int(d.id) not in lost]
+
+
+def probe_devices(mesh: Any) -> List[Any]:
+    """Probe every device in the mesh with a tiny round-trip transfer;
+    return the ones that still answer. The fallback identification path
+    when a device-loss error does not name the corpse."""
+    ok: List[Any] = []
+    for d in mesh.devices.flat:
+        try:
+            arr = jax.device_put(jnp.zeros((1,), jnp.int32), d)
+            jax.block_until_ready(arr)
+            ok.append(d)
+        except Exception:
+            continue
+    return ok
 
 
 def init_distributed(conf: Any = None) -> bool:

@@ -26,6 +26,7 @@ fugue_spark/execution_engine.py:336) — but TPU-first in design:
 
 import threading
 import time
+import weakref
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import jax
@@ -49,6 +50,7 @@ from fugue_tpu.dataframe import (
     DataFrame,
     LocalDataFrame,
 )
+from fugue_tpu.exceptions import DeviceLostError
 from fugue_tpu.lake import format as _lake_io
 from fugue_tpu.obs.trace import start_span
 from fugue_tpu.testing.locktrace import tracked_lock
@@ -66,7 +68,9 @@ from fugue_tpu.jax_backend import expr_eval, groupby, relational
 from fugue_tpu.jax_backend.blocks import (
     JaxBlocks,
     JaxColumn,
+    blocks_schema,
     ensure_x64,
+    evacuate_blocks,
     from_arrow,
     gather_indices,
     make_mesh,
@@ -736,6 +740,14 @@ class JaxExecutionEngine(ExecutionEngine):
         from fugue_tpu.jax_backend.memory import MemoryGovernor
 
         self._memory = MemoryGovernor(self)
+        # device-fault recovery state (recover_from_device_loss): live
+        # frame registry for the evacuation sweep (weak — the registry
+        # must never pin a frame's device memory), the devices retired
+        # so far (device OBJECTS: numeric ids collide across backends),
+        # and how many degrade-rebuild cycles ran
+        self._live_frames: Any = weakref.WeakSet()
+        self._lost_devices: set = set()
+        self._device_recoveries = 0
         # task-granular dispatch serialization for SHARED-engine use (the
         # serving daemon): XLA's CPU backend runs cross-device collectives
         # through a per-execution rendezvous on a shared thread pool — two
@@ -996,6 +1008,199 @@ class JaxExecutionEngine(ExecutionEngine):
 
         return _ctx()
 
+    # ---- device-fault recovery -------------------------------------------
+    @property
+    def lost_devices(self) -> Tuple[int, ...]:
+        """Ids of the devices this engine has retired after hardware
+        faults (empty on a healthy engine)."""
+        return tuple(sorted(int(d.id) for d in self._lost_devices))
+
+    @property
+    def surviving_device_count(self) -> int:
+        """Devices in the CURRENT mesh — after a degraded-mesh rebuild
+        this is the survivor count the serve plane's ``degraded`` health
+        state reports."""
+        return int(self._mesh.devices.size)
+
+    @property
+    def is_degraded(self) -> bool:
+        """True once any device has been lost and the engine rebuilt
+        onto the survivors."""
+        return len(self._lost_devices) > 0
+
+    @property
+    def device_recoveries(self) -> int:
+        """Completed degrade-rebuild cycles (the `device_lost_recovery`
+        counter's underlying engine state)."""
+        return self._device_recoveries
+
+    def recover_from_device_loss(self, ex: BaseException) -> bool:
+        """Rebuild the engine onto the surviving devices after ``ex``
+        (a DEVICE_LOST-classified XLA error; see workflow/fault.py).
+
+        The dead devices are parsed out of the error text, or probed
+        when the error names none. Then, under the dispatch lock: the
+        memory governor retires the dead pools and marks stranded ledger
+        entries lost, a fresh mesh is built from the survivors, the plan
+        signature is recomputed (a 4-device program must never serve the
+        3-device mesh), and every live frame is swept — evacuated via an
+        arrow round trip when its shards are still readable, re-read
+        from lineage (lazy load plan / checkpoint artifact / pinned
+        lake version) when not, or marked lost so only its OWNING query
+        fails (at the ``to_df`` touch point) while the process and every
+        other session survive.
+
+        Returns True when a rebuild happened — the retry executor then
+        counts ``device_lost_recovery`` and re-runs the task under the
+        normal backoff budget. False (recovery disabled, pinned mesh,
+        no identifiable corpse, no survivors, or ``max_losses``
+        exhausted) fails the task with the original error."""
+        from fugue_tpu.constants import (
+            FUGUE_CONF_JAX_RECOVERY_ENABLED,
+            FUGUE_CONF_JAX_RECOVERY_MAX_LOSSES,
+        )
+        from fugue_tpu.jax_backend.distributed import (
+            parse_lost_devices,
+            probe_devices,
+        )
+
+        if not self.conf.get(FUGUE_CONF_JAX_RECOVERY_ENABLED, True):
+            return False
+        if self._mesh_pinned:
+            # an explicitly passed mesh: the caller owns device topology
+            return False
+        mesh = self._mesh
+        by_id = {int(d.id): d for d in mesh.devices.flat}
+        named = [i for i in parse_lost_devices(str(ex)) if i in by_id]
+        if named:
+            lost = [by_id[i] for i in named]
+        else:
+            alive = set(probe_devices(mesh))
+            lost = [d for d in mesh.devices.flat if d not in alive]
+        if len(lost) == 0 or len(lost) >= len(by_id):
+            return False
+        max_losses = int(
+            self.conf.get(FUGUE_CONF_JAX_RECOVERY_MAX_LOSSES, 0)
+        )
+        if max_losses > 0 and len(self._lost_devices) + len(lost) > max_losses:
+            return False
+        with self._dispatch_lock:
+            survivors = [d for d in mesh.devices.flat if d not in lost]
+            single_tier = self._host_mesh is mesh
+            new_mesh = make_mesh(survivors)
+            self._lost_devices.update(lost)
+            self._memory.retire_devices([int(d.id) for d in lost])
+            self._mesh = new_mesh
+            if single_tier:
+                self._host_mesh = new_mesh
+            # plan/exec cache signatures fold the mesh devices
+            from fugue_tpu.optimize.cache import engine_plan_signature
+
+            self._plan_sig = engine_plan_signature(self)
+            self._device_recoveries += 1
+            outcomes = {"evacuated": 0, "rematerialized": 0, "lost": 0}
+            for blocks in list(self._live_frames):
+                res = self._recover_blocks(blocks)
+                if res in outcomes:
+                    outcomes[res] += 1
+            self._count_memory_event(
+                "device_lost_recovery",
+                f"lost {sorted(int(d.id) for d in lost)} -> "
+                f"{len(survivors)} survivors; "
+                f"{outcomes['evacuated']} evacuated, "
+                f"{outcomes['rematerialized']} rematerialized, "
+                f"{outcomes['lost']} unrecoverable",
+            )
+        return True
+
+    def _mesh_is_stale(self, mesh: Any) -> bool:
+        if not self._lost_devices:
+            return False
+        return any(d in self._lost_devices for d in mesh.devices.flat)
+
+    def _recover_blocks(self, blocks: Optional[JaxBlocks]) -> str:
+        """One frame's recovery: ``"ok"`` (untouched by the loss),
+        ``"evacuated"`` (arrow round trip onto the degraded mesh, same
+        JaxBlocks identity so every holder heals), ``"rematerialized"``
+        (re-read from lineage), or ``"lost"``."""
+        from fugue_tpu.testing.faults import fault_point
+
+        if blocks is None:
+            return "ok"
+        if not blocks.lost and not self._mesh_is_stale(blocks.mesh):
+            return "ok"
+        if not blocks.lost:
+            try:
+                # chaos hook: a plan here simulates shards that died
+                # WITH the device, forcing the lineage/lost path
+                fault_point("device.lost", "evacuate")
+                evacuate_blocks(blocks, self._mesh)
+                self._memory.register(blocks, "device")
+                return "evacuated"
+            except Exception as e:
+                self.log.warning("block evacuation failed: %s", e)
+        loader = blocks.lineage
+        if loader is not None:
+            try:
+                from fugue_tpu.jax_backend.blocks import replace_blocks
+
+                table = loader()
+                fresh = from_arrow(
+                    table.select(list(blocks.columns.keys())),
+                    blocks_schema(blocks),
+                    self._mesh,
+                )
+                replace_blocks(blocks, fresh)
+                self._memory.register(blocks, "device")
+                return "rematerialized"
+            except Exception as e:
+                self.log.warning(
+                    "lineage rematerialization failed: %s", e
+                )
+        blocks.lost = True
+        return "lost"
+
+    def _track_frame(self, df: JaxDataFrame) -> None:
+        """Recovery touch point for every frame entering an engine op:
+        remember live blocks for the evacuation sweep, re-point
+        pending/lazy placement stranded on a retired mesh, heal
+        materialized frames on the spot, and fail unrecoverable ones
+        with :class:`DeviceLostError` — the owning query dies; the
+        process (and every other session) survives."""
+        blocks = df._blocks
+        if blocks is None:
+            if self._lost_devices:
+                if df._pending is not None and self._mesh_is_stale(
+                    df._pending[1]
+                ):
+                    df._pending = (df._pending[0], self._mesh)
+                if df._lazy is not None and self._mesh_is_stale(
+                    df._lazy.mesh
+                ):
+                    df._lazy = df._lazy._replace(mesh=self._mesh)
+            return
+        if blocks.lost or self._mesh_is_stale(blocks.mesh):
+            if self._recover_blocks(blocks) == "lost":
+                raise DeviceLostError(
+                    f"frame [{df.schema}] lost its device shards "
+                    f"(devices {self.lost_devices}) and has no "
+                    "recoverable lineage (lazy load plan, checkpoint "
+                    "artifact, or pinned lake version)",
+                    lost_devices=self.lost_devices,
+                    frames=(str(df.schema),),
+                )
+        self._live_frames.add(blocks)
+
+    def _attach_load_lineage(
+        self, df: DataFrame, loader: Callable[[], pa.Table]
+    ) -> None:
+        """Storage-backed frames carry their reload plan as recovery
+        lineage: if a device dies holding their shards, the rebuild
+        re-reads the artifact onto the degraded mesh instead of failing
+        the query (see :meth:`recover_from_device_loss`)."""
+        if isinstance(df, JaxDataFrame):
+            df._lineage_loader = loader
+
     def _ingest_mesh(self, nbytes: int) -> Any:
         """Placement policy: which mesh a newly ingested frame lands on."""
         return self._place(nbytes)[0]
@@ -1088,6 +1293,10 @@ class JaxExecutionEngine(ExecutionEngine):
             assert_or_throw(
                 schema is None, ValueError("schema must be None for JaxDataFrame")
             )
+            # device-fault touch point: register live blocks for the
+            # recovery sweep, heal frames stranded on a retired device,
+            # and fail unrecoverable ones with DeviceLostError
+            self._track_frame(df)
             # LRU recency for the governor's spill ordering: a frame
             # flowing through an engine op is in active use
             self._memory.touch(df._blocks)
@@ -1853,9 +2062,30 @@ class JaxExecutionEngine(ExecutionEngine):
 
             local = _io.load_df(
                 path, format_hint, columns, fs=self.fs,
-                pruning=pruning, **kwargs
+                pruning=pruning, conf=self.conf, **kwargs
             )
-            return self.to_df(local)
+            res = self.to_df(local)
+            from fugue_tpu.lake import parse_lake_uri
+
+            _, params = parse_lake_uri(first)
+            pinned = (
+                kwargs.get("version") is not None
+                or kwargs.get("timestamp") is not None
+                or "version" in params
+                or "timestamp" in params
+            )
+            if pinned:
+                # a PINNED snapshot is deterministic lineage: device-loss
+                # recovery can re-read the exact same data (an unpinned
+                # read would re-resolve to a possibly newer version)
+                self._attach_load_lineage(
+                    res,
+                    lambda: _io.load_df(
+                        path, format_hint, columns, fs=self.fs,
+                        pruning=pruning, conf=self.conf, **kwargs
+                    ).as_arrow(),
+                )
+            return res
         batch_rows = int(self.conf.get(FUGUE_CONF_JAX_IO_BATCH_ROWS, 0))
         if batch_rows > 0:
             from fugue_tpu.jax_backend import ingest
@@ -1869,7 +2099,15 @@ class JaxExecutionEngine(ExecutionEngine):
         from fugue_tpu.utils import io as _io
 
         local = _io.load_df(path, format_hint, columns, fs=self.fs, **kwargs)
-        return self.to_df(local)
+        res = self.to_df(local)
+        # the stored artifact (data file or checkpoint) IS the lineage
+        self._attach_load_lineage(
+            res,
+            lambda: _io.load_df(
+                path, format_hint, columns, fs=self.fs, **kwargs
+            ).as_arrow(),
+        )
+        return res
 
     def save_df(
         self,

@@ -356,6 +356,8 @@ class MemoryGovernor:
             "spilled_bytes": 0,
             "oom_feedback": 0,
             "overcommit": 0,
+            "devices_retired": 0,
+            "frames_marked_lost": 0,
         }
 
     # ---- configuration ---------------------------------------------------
@@ -576,15 +578,23 @@ class MemoryGovernor:
             if existing is not None and existing.ref() is blocks:
                 existing.seq = self._next_seq()
                 existing.spillable = existing.spillable or persisted
-                if existing.nbytes != nbytes:
+                new_devices = self._frame_device_ids(blocks)
+                if (
+                    existing.nbytes != nbytes
+                    or existing.devices != new_devices
+                ):
+                    # uncharge the old per-device split BEFORE the
+                    # devices tuple changes: a frame rebuilt onto a
+                    # degraded mesh must stop charging the dead pools
+                    if existing.tier == "device":
+                        self._charge_pools_locked(existing, -existing.nbytes)
                     self._tier_bytes[existing.tier] += (
                         nbytes - existing.nbytes
                     )
-                    if existing.tier == "device":
-                        self._charge_pools_locked(
-                            existing, nbytes - existing.nbytes
-                        )
                     existing.nbytes = nbytes
+                    existing.devices = new_devices
+                    if existing.tier == "device":
+                        self._charge_pools_locked(existing, nbytes)
                     self._bump_peak(existing.tier)
                 return nbytes
             entry = _LedgerEntry(
@@ -773,6 +783,48 @@ class MemoryGovernor:
         if tier == "device":
             self._charge_pools_locked(entry, entry.nbytes)
         self._bump_peak(tier)
+
+    # ---- device loss -----------------------------------------------------
+    def retire_devices(self, lost_ids: Any) -> Dict[str, Any]:
+        """A device (or several) died: drop its pool from the ledger and
+        mark every device-tier entry spanning it LOST — the frame's
+        bytes return to the budget now (its arrays are unreadable, and
+        recovery re-registers whatever it rebuilds with the survivors'
+        split). Frames still reachable get ``blocks.lost = True`` so a
+        later touch fails the owning query instead of dereferencing a
+        dead shard. Runs even ungoverned: the lost flag is load-bearing
+        for correctness, not just accounting."""
+        lost = set(int(i) for i in lost_ids)
+        out: Dict[str, Any] = {
+            "entries_lost": 0, "bytes_lost": 0, "pools_retired": [],
+        }
+        with self._lock:
+            for e in self._entries.values():
+                if e.tier != "device" or not e.devices:
+                    continue
+                if not lost.intersection(e.devices):
+                    continue
+                self._charge_pools_locked(e, -e.nbytes)
+                self._tier_bytes["device"] -= e.nbytes
+                out["entries_lost"] += 1
+                out["bytes_lost"] += e.nbytes
+                e.nbytes = 0
+                e.devices = ()
+                blocks = e.ref()
+                if blocks is not None:
+                    blocks.lost = True
+                    self.counters["frames_marked_lost"] += 1
+            for d in sorted(lost):
+                if d in self._device_bytes:
+                    del self._device_bytes[d]
+                    out["pools_retired"].append(d)
+            self.counters["devices_retired"] += len(lost)
+            self._count(
+                "mem_device_retired",
+                f"devices {sorted(lost)}: {out['entries_lost']} ledger "
+                f"entries ({out['bytes_lost']}B) marked lost",
+            )
+        return out
 
     # ---- OOM feedback ----------------------------------------------------
     def note_oom(self, ex: BaseException) -> None:

@@ -11,6 +11,7 @@ from fugue_tpu.lake.format import (
     LakeCommitConflict,
     LakeCompactionConflict,
     LakeError,
+    LakeIntegrityError,
     Manifest,
     format_lake_uri,
     is_lake_uri,
@@ -22,6 +23,7 @@ __all__ = [
     "LakeCommitConflict",
     "LakeCompactionConflict",
     "LakeError",
+    "LakeIntegrityError",
     "LakeTable",
     "Manifest",
     "format_lake_uri",

@@ -58,6 +58,13 @@ class LakeCompactionConflict(LakeError):
     new head."""
 
 
+class LakeIntegrityError(LakeError):
+    """A data file's bytes no longer match the sha256 its manifest
+    recorded at commit time (bit rot, truncation or tampering). Raised
+    on scan only when ``fugue.lake.verify`` is enabled; the read fails —
+    silently returning corrupt rows is never an option."""
+
+
 def is_lake_uri(path: Any) -> bool:
     return isinstance(path, str) and path.startswith(LAKE_URI_PREFIX)
 
@@ -290,24 +297,32 @@ class DataFileEntry:
         rows: int,
         nbytes: int,
         columns: Dict[str, Dict[str, Any]],
+        sha256: Optional[str] = None,
     ):
         self.path = str(path)  # RELATIVE to the table root
         self.rows = int(rows)
         self.nbytes = int(nbytes)
         self.columns = columns
+        # content digest recorded at commit; files committed before the
+        # field exists carry None and skip scan-time verification
+        self.sha256 = str(sha256) if sha256 else None
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        out: Dict[str, Any] = {
             "path": self.path,
             "rows": self.rows,
             "bytes": self.nbytes,
             "columns": self.columns,
         }
+        if self.sha256 is not None:
+            out["sha256"] = self.sha256
+        return out
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "DataFileEntry":
         return cls(
-            d["path"], d["rows"], d["bytes"], dict(d.get("columns") or {})
+            d["path"], d["rows"], d["bytes"], dict(d.get("columns") or {}),
+            sha256=d.get("sha256"),
         )
 
     @classmethod
@@ -322,15 +337,20 @@ class DataFileEntry:
         columns: Dict[str, Dict[str, Any]] = {}
         for name, meta in pending["by_name"].items():
             columns[str(by_name[name].id)] = {"name": name, **meta}
-        return cls(pending["path"], pending["rows"], pending["bytes"], columns)
+        return cls(
+            pending["path"], pending["rows"], pending["bytes"], columns,
+            sha256=pending.get("sha256"),
+        )
 
 
-def pending_file(path: str, nbytes: int, table: pa.Table) -> Dict[str, Any]:
+def pending_file(
+    path: str, nbytes: int, table: pa.Table, sha256: Optional[str] = None
+) -> Dict[str, Any]:
     """A written-but-uncommitted data file, stats keyed by COLUMN NAME
     (field-id binding happens at commit time — see
     :meth:`DataFileEntry.from_pending`)."""
     stats = column_stats(table)
-    return {
+    out = {
         "path": str(path),
         "rows": int(table.num_rows),
         "bytes": int(nbytes),
@@ -339,6 +359,9 @@ def pending_file(path: str, nbytes: int, table: pa.Table) -> Dict[str, Any]:
             for f in table.schema
         },
     }
+    if sha256:
+        out["sha256"] = str(sha256)
+    return out
 
 
 class Manifest:

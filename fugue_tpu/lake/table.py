@@ -48,6 +48,7 @@ from fugue_tpu.constants import (
     FUGUE_CONF_LAKE_COMMIT_BACKOFF,
     FUGUE_CONF_LAKE_COMMIT_RETRIES,
     FUGUE_CONF_LAKE_COMPACT_TARGET_ROWS,
+    FUGUE_CONF_LAKE_VERIFY,
     typed_conf_get,
 )
 from fugue_tpu.fs import FileSystemRegistry, uri_basename
@@ -62,6 +63,7 @@ from fugue_tpu.lake.format import (
     LakeCompactionConflict,
     LakeError,
     LakeField,
+    LakeIntegrityError,
     Manifest,
     merge_fields,
     overwrite_fields,
@@ -116,6 +118,7 @@ class LakeTable:
         self._compact_target = typed_conf_get(
             conf, FUGUE_CONF_LAKE_COMPACT_TARGET_ROWS
         )
+        self._verify = bool(typed_conf_get(conf, FUGUE_CONF_LAKE_VERIFY))
         self._lock = tracked_lock("lake.table.LakeTable._lock")
         self._manifest_memo: Dict[int, Manifest] = {}
         #: plain counters for benches/tests (metrics registry optional)
@@ -127,6 +130,7 @@ class LakeTable:
             "files_pruned": 0,
             "files_vacuumed": 0,
             "vacuum_kept_grace": 0,
+            "integrity_rejected": 0,
         }
         self._metrics = metrics
         if metrics is not None:
@@ -146,6 +150,11 @@ class LakeTable:
             self._m_scanned = metrics.counter(
                 "fugue_lake_files_scanned_total",
                 "data files actually opened by lake scans",
+            )
+            self._m_integrity = metrics.counter(
+                "fugue_lake_integrity_rejected",
+                "scans failed because a data file's bytes no longer "
+                "match its manifest-recorded sha256",
             )
 
     # ---- paths -----------------------------------------------------------
@@ -288,7 +297,10 @@ class LakeTable:
         self._fs.write_file_atomic(
             self._fs.join(self._uri, rel), lambda fp: fp.write(data)
         )
-        return pending_file(rel, len(data), table)
+        return pending_file(
+            rel, len(data), table,
+            sha256=hashlib.sha256(data).hexdigest(),
+        )
 
     def _write_tables(self, tables: Sequence[pa.Table]) -> List[Dict[str, Any]]:
         token = _uuid_token()
@@ -635,6 +647,19 @@ class LakeTable:
                 in_file[f.id] = meta["name"]
         if in_file:
             raw = self._fs.read_bytes(self._fs.join(self._uri, entry.path))
+            if self._verify and entry.sha256:
+                digest = hashlib.sha256(raw).hexdigest()
+                if digest != entry.sha256:
+                    self.counters["integrity_rejected"] += 1
+                    if self._metrics is not None:
+                        self._m_integrity.labels().inc()
+                    raise LakeIntegrityError(
+                        f"data file {entry.path} of {self._uri} failed "
+                        f"integrity verification: manifest recorded "
+                        f"sha256 {entry.sha256} but the stored bytes "
+                        f"hash to {digest} ({len(raw)} bytes read, "
+                        f"{entry.nbytes} committed)"
+                    )
             t = pq.read_table(
                 pa.BufferReader(raw), columns=list(in_file.values())
             )

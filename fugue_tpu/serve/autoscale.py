@@ -23,6 +23,16 @@ then decides:
   which is why a hard kill at chaos site ``serve.scale`` mid-retire
   degrades to an ordinary death failover instead of losing sessions.
 
+- **replace degraded** (ISSUE 19): a replica whose engine lost a device
+  (``daemon._engine.is_degraded`` — the ``degraded`` /v1/health state)
+  counts as sustained pressure immediately. The controller first spawns
+  a healthy replacement (when the healthy count is below the floor and
+  the fleet below ``max_replicas``), then drain-retires the degraded
+  replica through the same loss-free retire as a rolling restart — its
+  sessions adopt onto the healthy survivors, zero session loss.
+  Ordinary idle scale-down also prefers a degraded replica over the
+  newest-added one.
+
 Each action starts a ``cooldown`` window during which no further action
 fires, so a scale-up's effect on the backlog is observed before the
 next decision (classic anti-flap hysteresis).
@@ -159,6 +169,7 @@ class FleetAutoscaler:
         autoscaler runs next to the fleet, not over HTTP)."""
         queued = running = 0
         p99_ms = 0.0
+        degraded: List[str] = []
         rids = self._fleet.replica_ids
         for rid in rids:
             try:
@@ -168,6 +179,8 @@ class FleetAutoscaler:
                 continue  # replica mid-restart/retire: skip this tick
             queued += int(counts.get("queued") or 0)
             running += int(counts.get("running") or 0)
+            if getattr(daemon._engine, "is_degraded", False):
+                degraded.append(rid)
             if self.up_p99_ms > 0.0:
                 p99_ms = max(p99_ms, self._replica_p99_ms(rid, daemon))
         return {
@@ -176,6 +189,7 @@ class FleetAutoscaler:
             "running": running,
             "backlog_per_replica": queued / max(1, len(rids)),
             "p99_ms": round(p99_ms, 3),
+            "degraded": degraded,
         }
 
     def _replica_p99_ms(self, rid: str, daemon: Any) -> float:
@@ -223,9 +237,17 @@ class FleetAutoscaler:
         ``cooldown``/``error``."""
         self._m_ticks.labels().inc()
         sample = self._sample()
+        degraded = sample.get("degraded") or []
         with self._lock:
-            hot = sample["backlog_per_replica"] >= self.up_queue or (
-                self.up_p99_ms > 0.0 and sample["p99_ms"] >= self.up_p99_ms
+            # a degraded replica (lost device, reduced mesh) IS
+            # sustained pressure: its capacity won't come back on its own
+            hot = (
+                sample["backlog_per_replica"] >= self.up_queue
+                or (
+                    self.up_p99_ms > 0.0
+                    and sample["p99_ms"] >= self.up_p99_ms
+                )
+                or len(degraded) > 0
             )
             cold = sample["queued"] == 0 and sample["running"] == 0
             self._pressure_ticks = self._pressure_ticks + 1 if hot else 0
@@ -242,6 +264,25 @@ class FleetAutoscaler:
             want_down = (
                 self._idle_ticks >= self.idle_ticks and n > self.min_replicas
             )
+        if degraded:
+            # replace-then-retire: first make sure enough HEALTHY
+            # replicas exist to cover the floor, then drain-retire the
+            # degraded one (loss-free: its sessions adopt onto the
+            # survivors). The cooldown window paces the two steps.
+            if in_cooldown:
+                self._last_decision = "cooldown"
+                return self._last_decision
+            healthy = n - len(degraded)
+            if healthy < self.min_replicas and n < self.max_replicas:
+                self._last_decision = self._scale_up()
+                return self._last_decision
+            if healthy >= self.min_replicas:
+                self._last_decision = self._retire_degraded(degraded[0])
+                return self._last_decision
+            # floor uncoverable (at max_replicas): keep the degraded
+            # capacity rather than shrink below the operator's floor
+            self._last_decision = "pressure"
+            return self._last_decision
         if (want_up or want_down) and in_cooldown:
             self._last_decision = "cooldown"
             return self._last_decision
@@ -269,15 +310,40 @@ class FleetAutoscaler:
             self._last_action_at = time.monotonic()
         return f"scale_up {rid}"
 
+    def _retire_degraded(self, rid: str) -> str:
+        """Drain-then-retire a replica whose engine lost a device: its
+        sessions adopt onto the healthy survivors (the same loss-free
+        move as a rolling restart); the preceding scale-up restored the
+        fleet's capacity."""
+        try:
+            self._fleet.retire_replica(rid)
+        except Exception:
+            self._m_errors.labels().inc()
+            return "error"
+        self._m_downs.labels().inc()
+        with self._lock:
+            self._last_action_at = time.monotonic()
+        return f"retire_degraded {rid}"
+
     def _scale_down(self) -> str:
         # retire the NEWEST replica: boot-time slots (r0..rN-1 from
         # fugue.serve.fleet.replicas) are the floor the operator asked
-        # for; autoscaled additions go first
+        # for; autoscaled additions go first. A DEGRADED replica jumps
+        # the queue — shrinking should shed the reduced-mesh capacity.
         rids = self._fleet.replica_ids
         if len(rids) <= 1:  # pragma: no cover - guarded by want_down
             return "steady"
+        target = rids[-1]
+        for rid in rids:
+            try:
+                daemon = self._fleet.replica(rid)
+            except Exception:
+                continue
+            if getattr(daemon._engine, "is_degraded", False):
+                target = rid
+                break
         try:
-            self._fleet.retire_replica(rids[-1])
+            self._fleet.retire_replica(target)
         except Exception:
             self._m_errors.labels().inc()
             return "error"
@@ -285,7 +351,7 @@ class FleetAutoscaler:
         with self._lock:
             self._idle_ticks = 0
             self._last_action_at = time.monotonic()
-        return f"scale_down {rids[-1]}"
+        return f"scale_down {target}"
 
     # ---- observability ---------------------------------------------------
     def render_metrics(self) -> str:

@@ -664,6 +664,23 @@ class ServeDaemon:
         if base == "" or base.rstrip("/") == self._journal.base_uri:
             raise ValueError(f"invalid adoption source {state_path!r}")
         fs = self._engine.fs
+        # CAS fence (write_file_if_absent): exactly ONE of N racing
+        # adopters proceeds past this line per journal; the losers get
+        # AdoptionFencedError and back off without reading any state.
+        # The fence clears with the journal in clear_state; on a raised
+        # adoption it is released so a later failover can retry.
+        ServeStateJournal.acquire_adoption_fence(
+            fs, base, owner=self._journal.base_uri
+        )
+        try:
+            return self._adopt_state_fenced(base, fs)
+        except BaseException:
+            ServeStateJournal.clear_adoption_fence(fs, base)
+            raise
+
+    def _adopt_state_fenced(
+        self, base: str, fs: Any
+    ) -> Dict[str, Any]:
         data = ServeStateJournal.read_state(fs, base, log=self._engine.log)
         adopted, expired = self._sessions.adopt(data["sessions"])
         # the adopted sessions' standing pipelines move with them: the
@@ -1340,6 +1357,14 @@ class ServeDaemon:
             }
         if getattr(self._engine, "_exec_enabled", False):
             out["exec_cache"] = self._engine.exec_cache_stats
+        if getattr(self._engine, "is_degraded", False):
+            out["device_recovery"] = {
+                "lost_devices": list(self._engine.lost_devices),
+                "surviving_devices": int(
+                    self._engine.surviving_device_count
+                ),
+                "recoveries": int(self._engine.device_recoveries),
+            }
         return out
 
     # ---- job execution (scheduler worker threads) ------------------------
@@ -1820,6 +1845,20 @@ class ServeDaemon:
                 else self._health.state
             )
             body = {"ok": ok, "state": state}
+            if (
+                ok
+                and state == "healthy"
+                and getattr(self._engine, "is_degraded", False)
+            ):
+                # a device died and the engine rebuilt onto the
+                # survivors: still serving (200) but advertising reduced
+                # capacity, so the fleet autoscaler treats this replica
+                # as sustained pressure (spawn healthy, drain-retire us)
+                body["state"] = "degraded"
+                body["surviving_devices"] = int(
+                    self._engine.surviving_device_count
+                )
+                body["lost_devices"] = list(self._engine.lost_devices)
             return (200 if ok else 503), body
         if route == ["status"] and method == "GET":
             return 200, self.status()

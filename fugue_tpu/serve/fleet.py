@@ -220,6 +220,11 @@ class FleetRouter:
         )
         for mode in ("death", "planned"):
             self._m_failover.labels(mode=mode)
+        self._m_fenced = self._metrics.counter(
+            "fugue_fleet_adoptions_fenced_total",
+            "adoption attempts that lost the journal's CAS fence race "
+            "to another adopter and backed off",
+        )
         self._metrics.add_collector(self._collect_gauges)
 
     # ---- lifecycle -------------------------------------------------------
@@ -500,6 +505,13 @@ class FleetRouter:
                 {"state_path": state_path}, timeout=60.0,
             )
             if status != 200:
+                err = body.get("error") or {}
+                if "AdoptionFenced" in str(err.get("error", "")):
+                    # another adopter holds this journal's fence — the
+                    # race is settled. Stay pending: once the winner
+                    # clears the journal (fence falls with it), the
+                    # retry adopts an empty state and settles the map.
+                    self._m_fenced.labels().inc()
                 return None  # stays pending; retried on the next tick
             adopted = list((body.get("adopted") or {}).get("sessions") or [])
             with self._lock:

@@ -41,14 +41,32 @@ later restart of the origin replica cannot double-own the sessions.
 """
 
 import copy
+import json
 import time
 from typing import Any, Dict, Optional
+from uuid import uuid4
 
 from fugue_tpu.testing.faults import fault_point
 from fugue_tpu.testing.locktrace import tracked_lock
 from fugue_tpu.workflow.manifest import atomic_json_write, read_json
 
 _STATE_FILE = "serve_state.json"
+_FENCE_FILE = "_adopt_fence.json"
+
+
+class AdoptionFencedError(RuntimeError):
+    """Another adopter already holds this journal's fence: backing off.
+    Carries the winning token so the loser can log WHO won; the race is
+    settled — retrying after the winner clears the journal adopts an
+    empty state, never a double-owned session."""
+
+    def __init__(self, base_uri: str, holder: Dict[str, Any]):
+        super().__init__(
+            f"journal {base_uri} is being adopted by "
+            f"{holder.get('owner', '<unknown>')!r}"
+        )
+        self.base_uri = base_uri
+        self.holder = dict(holder)
 
 
 class SnapshotWriter:
@@ -198,13 +216,77 @@ class ServeStateJournal:
     def clear_state(fs: Any, base_uri: str) -> None:
         """Atomically empty a replica's journal after its sessions were
         adopted elsewhere: a restarted origin replica rehydrates nothing
-        instead of double-owning migrated sessions."""
+        instead of double-owning migrated sessions. The adoption fence
+        falls with the journal, so a REBORN journal at this path is
+        adoptable again."""
         base = str(base_uri).rstrip("/")
         atomic_json_write(
             fs,
             fs.join(base, _STATE_FILE),
             {"saved_at": time.time(), "sessions": {}, "jobs": {}},
         )
+        ServeStateJournal.clear_adoption_fence(fs, base)
+
+    # ---- adoption fence (CAS) --------------------------------------------
+    @staticmethod
+    def acquire_adoption_fence(
+        fs: Any, base_uri: str, owner: str, stale_after: float = 30.0
+    ) -> Dict[str, Any]:
+        """Claim the EXCLUSIVE right to adopt this journal via a
+        fail-if-exists fence-token write (``write_file_if_absent`` — the
+        same CAS primitive as lake manifest commits). Exactly one of N
+        racing adopters wins; every loser raises
+        :class:`AdoptionFencedError` carrying the winner's token and
+        backs off WITHOUT reading the journal, so two survivors racing
+        to adopt a dead replica can never double-own its sessions.
+
+        A fence older than ``stale_after`` seconds is assumed abandoned
+        (its holder was hard-killed mid-adoption) and is broken with one
+        re-acquisition attempt — adoption is idempotent per session id,
+        so re-running a half-landed adoption converges rather than
+        duplicating. The fence clears together with the journal
+        (:meth:`clear_state`)."""
+        base = str(base_uri).rstrip("/")
+        uri = fs.join(base, _FENCE_FILE)
+        token = {
+            "owner": str(owner),
+            "claimed_at": time.time(),
+            "nonce": uuid4().hex,
+        }
+        payload = json.dumps(token).encode("utf-8")
+        for attempt in (0, 1):
+            try:
+                fs.write_file_if_absent(uri, lambda fp: fp.write(payload))
+                return token
+            except FileExistsError:
+                holder: Dict[str, Any] = {}
+                try:
+                    holder = json.loads(fs.read_bytes(uri))
+                except Exception:
+                    pass
+                age = time.time() - float(holder.get("claimed_at", 0.0))
+                if attempt == 0 and age > max(0.0, stale_after):
+                    # abandoned fence: its writer died mid-adoption.
+                    # Break it and race for the slot ONCE — the CAS on
+                    # the re-acquire still picks exactly one winner.
+                    try:
+                        fs.rm(uri)
+                    except FileNotFoundError:  # pragma: no cover - raced
+                        pass
+                    continue
+                raise AdoptionFencedError(base, holder)
+        raise AdoptionFencedError(base, {})  # pragma: no cover
+
+    @staticmethod
+    def clear_adoption_fence(fs: Any, base_uri: str) -> None:
+        """Drop the fence token (no-op when absent)."""
+        uri = fs.join(str(base_uri).rstrip("/"), _FENCE_FILE)
+        try:
+            fs.rm(uri)
+        except FileNotFoundError:
+            pass
+        except Exception:  # pragma: no cover - best-effort cleanup
+            pass
 
     def import_session(self, session_id: str, record: Dict[str, Any]) -> None:
         """Adopt a foreign journal's full session record (ttl, times AND

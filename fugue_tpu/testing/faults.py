@@ -93,6 +93,7 @@ KNOWN_SITES = (
     "stream.commit",
     "lake.commit",
     "lake.compact",
+    "device.lost",
 )
 
 
@@ -114,6 +115,30 @@ def resource_exhausted(nbytes: int = 0) -> BaseException:
     return _InjectedXlaRuntimeError(
         "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
         f"{int(nbytes)} bytes."
+    )
+
+
+def device_lost(device_id: int = 0) -> BaseException:
+    """An injectable device-loss error for ``device.lost`` fault specs:
+    the DATA_LOSS shape a dead accelerator produces mid-collective. The
+    classifier triages it DEVICE_LOST (real runtime-error types only)
+    and the engine's degraded-mesh recovery parses the dead device id
+    out of the text."""
+    return _InjectedXlaRuntimeError(
+        f"DATA_LOSS: device lost: device {int(device_id)} is in an "
+        "error state and its core halted (hardware fault)"
+    )
+
+
+def collective_hang(device_id: int = 0) -> BaseException:
+    """The hung-collective member of the ``device.lost`` chaos family: a
+    DEADLINE_EXCEEDED shape (a peer stopped answering the all-reduce but
+    the runtime can't yet prove it dead). Classifies TRANSIENT — the
+    retry either succeeds (the peer was slow, not dead) or the runtime
+    escalates to the DATA_LOSS shape above on a later attempt."""
+    return _InjectedXlaRuntimeError(
+        "DEADLINE_EXCEEDED: collective all-reduce timed out waiting for "
+        f"participant {int(device_id)} (possible hung peer)"
     )
 
 
@@ -189,6 +214,7 @@ class FaultPlan:
                 "retries": 0,
                 "recoveries": 0,
                 "degradations": 0,
+                "device_recoveries": 0,
             },
         )
         slot[counter] += n
@@ -226,6 +252,10 @@ class FaultPlan:
     def note_degradation(self, site: str, key: str) -> None:
         with self._lock:
             self._bump(f"{site}:{key}", "degradations")
+
+    def note_device_recovery(self, site: str, key: str) -> None:
+        with self._lock:
+            self._bump(f"{site}:{key}", "device_recoveries")
 
     def total(self, counter: str) -> int:
         with self._lock:

@@ -135,6 +135,7 @@ def _load_lake(
     version = kwargs.pop("version", params.get("version"))
     timestamp = kwargs.pop("timestamp", params.get("timestamp"))
     pruning = kwargs.pop("pruning", None)
+    conf = kwargs.pop("conf", None)
     assert_or_throw(
         len(kwargs) == 0,
         NotImplementedError(f"lake load got unknown options {sorted(kwargs)}"),
@@ -142,7 +143,7 @@ def _load_lake(
     cols = columns if isinstance(columns, list) else None
     if isinstance(columns, str):
         cols = Schema(columns).names
-    table = LakeTable(table_uri, fs=fs).scan(
+    table = LakeTable(table_uri, fs=fs, conf=conf).scan(
         columns=cols,
         version=None if version is None else int(version),
         timestamp=None if timestamp is None else float(timestamp),

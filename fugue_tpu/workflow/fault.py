@@ -41,6 +41,7 @@ from fugue_tpu.testing.locktrace import tracked_lock
 TRANSIENT = "transient"
 OOM = "oom"
 FATAL = "fatal"
+DEVICE_LOST = "device_lost"
 
 # exception class NAMES treated as transient: transport/storage errors
 # raised by backends we don't import (fsspec, gcsfs, requests, grpc) —
@@ -65,6 +66,32 @@ _TRANSIENT_NAMES = (
 # failure (grpc/absl status vocabulary)
 _TRANSIENT_TOKENS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED")
 _OOM_TOKENS = ("RESOURCE_EXHAUSTED", "out of memory", "Out of memory")
+# status tokens marking a DEAD device (vs a slow/unreachable one): the
+# runtime proved data on that device is gone, so a blind retry on the
+# same mesh replays the same failure — the engine must rebuild a
+# degraded mesh first (DEVICE_LOST triage)
+_DEVICE_LOST_TOKENS = (
+    "DATA_LOSS",
+    "device lost",
+    "DEVICE_LOST",
+    "is in an error state",
+)
+
+
+def _is_status_typed(ex: BaseException) -> bool:
+    """Only transport/runtime error TYPES may speak the absl status
+    vocabulary: a plain RuntimeError("... ABORTED ...") from user code is
+    deterministic and must not replay side effects. The same discipline
+    covers grpc transports and jaxlib's XlaRuntimeError (device errors
+    surface there with status-prefixed text)."""
+    name = type(ex).__name__
+    mod = type(ex).__module__
+    return (
+        name.endswith(("RpcError", "StatusError"))
+        or name == "XlaRuntimeError"
+        or "grpc" in mod
+        or "jaxlib" in mod
+    )
 
 
 def classify_error(ex: BaseException, retry_on: Tuple[type, ...] = ()) -> str:
@@ -74,6 +101,9 @@ def classify_error(ex: BaseException, retry_on: Tuple[type, ...] = ()) -> str:
       eligible for host-tier degradation, then retry.
     - ``transient``: fs/IO errors and RPC transport errors — retry with
       backoff.
+    - ``device_lost``: an XLA DATA_LOSS / device-dead error — a blind
+      retry replays the failure; the executor must first rebuild a
+      degraded mesh (``engine.recover_from_device_loss``), then retry.
     - ``fatal``: everything else — deterministic failures (schema &
       validation errors, user code bugs) re-raise immediately; retrying
       them only hides the first, best traceback.
@@ -92,6 +122,11 @@ def classify_error(ex: BaseException, retry_on: Tuple[type, ...] = ()) -> str:
     if name == "XlaRuntimeError" or "jaxlib" in type(ex).__module__:
         if any(t in text for t in _OOM_TOKENS):
             return OOM
+    # DEVICE_LOST outranks the transient tokens: a DATA_LOSS message can
+    # also mention the aborted collective, and the dead-device verdict
+    # must win or the retry loop spins against a broken mesh
+    if any(t in text for t in _DEVICE_LOST_TOKENS) and _is_status_typed(ex):
+        return DEVICE_LOST
     # framework errors are deliberate: never retry (validation, schema,
     # compile problems are deterministic by construction)
     if isinstance(ex, (FugueError, FugueWorkflowError)):
@@ -116,18 +151,11 @@ def classify_error(ex: BaseException, retry_on: Tuple[type, ...] = ()) -> str:
         return TRANSIENT
     if name in _TRANSIENT_NAMES:
         return TRANSIENT
-    if any(t in text for t in _TRANSIENT_TOKENS):
-        # only trust status tokens on actual transport/status error types
-        # (grpc, jaxlib) — a plain RuntimeError("... ABORTED ...") from
-        # user code is deterministic and must NOT replay side effects
-        mod = type(ex).__module__
-        if (
-            name.endswith(("RpcError", "StatusError"))
-            or name == "XlaRuntimeError"
-            or "grpc" in mod
-            or "jaxlib" in mod
-        ):
-            return TRANSIENT
+    if any(t in text for t in _TRANSIENT_TOKENS) and _is_status_typed(ex):
+        # a transient status (UNAVAILABLE / DEADLINE_EXCEEDED / ABORTED)
+        # on a real transport or XLA runtime type: a slow or unreachable
+        # peer, e.g. a hung collective — retry with backoff
+        return TRANSIENT
     return FATAL
 
 
@@ -260,6 +288,8 @@ class RunStats:
         self.retries: dict = {}
         self.recoveries: dict = {}
         self.degradations: dict = {}
+        # degraded-mesh rebuilds after a lost device (per task)
+        self.device_recoveries: dict = {}
         self.resumed: list = []
         # manifest artifacts that failed size/sha256 verification on
         # resume and were recomputed instead of loaded
@@ -293,6 +323,9 @@ class RunStats:
     def note_degradation(self, name: str) -> None:
         self._bump(self.degradations, name, "degradation")
 
+    def note_device_recovery(self, name: str) -> None:
+        self._bump(self.device_recoveries, name, "device_lost_recovery")
+
     def note_integrity_rejected(self, name: str) -> None:
         self._bump(self.integrity_rejected, name, "integrity_rejected")
 
@@ -312,6 +345,7 @@ class RunStats:
                 "retries": dict(self.retries),
                 "recoveries": dict(self.recoveries),
                 "degradations": dict(self.degradations),
+                "device_recoveries": dict(self.device_recoveries),
                 "resumed": list(self.resumed),
                 "integrity_rejected": dict(self.integrity_rejected),
                 "memory": dict(self.memory),
@@ -410,6 +444,42 @@ def execute_with_policy(
                     return degraded[0]
                 # degradation unsupported or failed: treat as transient
                 cls = TRANSIENT
+            elif cls == DEVICE_LOST:
+                # rebuild a degraded mesh from the survivors and re-place
+                # recoverable frames BEFORE retrying: the retry then runs
+                # on healthy hardware and consumes an ordinary attempt
+                # under the existing backoff budget. Unrecoverable = the
+                # engine can't rebuild (no survivors, recovery disabled,
+                # no recovery hook) -> fatal, the owning query fails with
+                # the original device error — never the process.
+                recoverer = getattr(engine, "recover_from_device_loss", None)
+                recovered = False
+                if recoverer is not None:
+                    try:
+                        recovered = bool(recoverer(ex))
+                    except Exception as rex:
+                        if log is not None:
+                            log.warning(
+                                "fugue_tpu degraded-mesh recovery for task "
+                                "%s failed with %s: %s (original device "
+                                "error: %s)",
+                                task_name, type(rex).__name__, rex, ex,
+                            )
+                if recovered:
+                    plan = active_plan()
+                    if plan is not None:
+                        plan.note_device_recovery("task", task_name)
+                    if stats is not None:
+                        stats.note_device_recovery(task_name)
+                    if log is not None:
+                        log.warning(
+                            "fugue_tpu task %s lost a device (%s); mesh "
+                            "rebuilt on survivors, retrying",
+                            task_name, ex,
+                        )
+                    cls = TRANSIENT
+                else:
+                    cls = FATAL
             if cls == FATAL or attempt >= policy.max_attempts:
                 raise
             plan = active_plan()

@@ -792,6 +792,74 @@ def test_fwf508_autoscale_conf_rules():
     assert not any(x.code == "FWF508" for x in _analyze(dag))
 
 
+def test_fwf509_device_recovery_conf_rules():
+    # both halves of the device-recovery rule: recovery keys with the
+    # mesh pinned to a single device are silently inert (no survivors
+    # to rebuild onto); recovery enabled without checkpointing or a
+    # pinned lake load has nothing durable to re-materialize from
+    dag = FugueWorkflow()
+    dag.df([[0]], "a:int").persist()
+    # single-device pin: every recovery key flagged inert
+    diags = _analyze(
+        dag,
+        conf={
+            "fugue.jax.recovery.enabled": True,
+            "fugue.jax.recovery.max_losses": 2,
+            "fugue.jax.devices": "3",
+        },
+        codes={"FWF509"},
+    )
+    assert len(diags) == 2
+    d = _assert_diag(diags, "FWF509", Severity.WARN, needs_callsite=False)
+    assert "single device" in d.message
+    # multi-device slice, recovery on, no resume, no pinned lake load:
+    # the no-durable-lineage half fires once
+    diags = _analyze(
+        dag,
+        conf={
+            "fugue.jax.recovery.enabled": True,
+            "fugue.jax.devices": "0,1,2,3",
+        },
+        codes={"FWF509"},
+    )
+    assert len(diags) == 1
+    assert "DeviceLostError" in diags[0].message
+    # resume on: recovered frames re-read their checkpoint — silent
+    assert not any(
+        x.code == "FWF509"
+        for x in _analyze(
+            dag,
+            conf={
+                "fugue.jax.recovery.enabled": True,
+                "fugue.workflow.resume": True,
+            },
+        )
+    )
+    # a PINNED lake load anchors durable lineage — silent
+    dag2 = FugueWorkflow()
+    dag2.load("lake://memory://t/x", version=3).persist()
+    assert not any(
+        x.code == "FWF509"
+        for x in _analyze(dag2, conf={"fugue.jax.recovery.enabled": True})
+    )
+    # an UNPINNED lake load is not deterministic lineage — still warns
+    dag3 = FugueWorkflow()
+    dag3.load("lake://memory://t/x").persist()
+    assert any(
+        x.code == "FWF509"
+        for x in _analyze(dag3, conf={"fugue.jax.recovery.enabled": True})
+    )
+    # recovery explicitly off: the lineage half is moot — silent
+    assert not any(
+        x.code == "FWF509"
+        for x in _analyze(
+            dag, conf={"fugue.jax.recovery.enabled": "false"}
+        )
+    )
+    # no recovery keys at all: silent
+    assert not any(x.code == "FWF509" for x in _analyze(dag))
+
+
 def test_every_rule_has_corpus_coverage():
     """The corpus above must track the registry: a newly registered rule
     without a fixture here fails this meta-check."""
@@ -799,7 +867,7 @@ def test_every_rule_has_corpus_coverage():
         "FWF101", "FWF102", "FWF103", "FWF104", "FWF105", "FWF106",
         "FWF201", "FWF202", "FWF301", "FWF302", "FWF303", "FWF401",
         "FWF402", "FWF403", "FWF404", "FWF501", "FWF502", "FWF503",
-        "FWF504", "FWF505", "FWF506", "FWF507", "FWF508",
+        "FWF504", "FWF505", "FWF506", "FWF507", "FWF508", "FWF509",
     }
     assert {r.code for r in all_rules()} == covered
 

@@ -1,0 +1,337 @@
+"""Fleet-aware device-fault failover (ISSUE 19): the ``degraded``
+/v1/health state of a replica whose engine lost a device, the
+autoscaler's replace-then-retire move with zero session loss, and the
+journal adoption fence (CAS) that keeps two racing adopters from
+double-owning a dead replica's sessions — including the hard-kill chaos
+case where a zombie fence blocks failover until it goes stale.
+Tier-1 compatible; select with ``-m fleet``."""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from fugue_tpu.constants import (
+    FUGUE_CONF_SERVE_AUTOSCALE_COOLDOWN,
+    FUGUE_CONF_SERVE_AUTOSCALE_IDLE_TICKS,
+    FUGUE_CONF_SERVE_AUTOSCALE_INTERVAL,
+    FUGUE_CONF_SERVE_AUTOSCALE_MAX_REPLICAS,
+    FUGUE_CONF_SERVE_AUTOSCALE_SUSTAIN_TICKS,
+    FUGUE_CONF_SERVE_AUTOSCALE_UP_QUEUE,
+    FUGUE_CONF_SERVE_BREAKER_THRESHOLD,
+    FUGUE_CONF_SERVE_FLEET_DEATH_THRESHOLD,
+    FUGUE_CONF_SERVE_FLEET_HEALTH_INTERVAL,
+    FUGUE_CONF_SERVE_MAX_CONCURRENT,
+    FUGUE_CONF_SERVE_STATE_PATH,
+)
+from fugue_tpu.fs import make_default_registry
+from fugue_tpu.serve import ServeClient, ServeDaemon, ServeFleet
+from fugue_tpu.serve.state import AdoptionFencedError, ServeStateJournal
+from fugue_tpu.testing.faults import device_lost
+
+pytestmark = [pytest.mark.serve, pytest.mark.chaos, pytest.mark.fleet]
+
+_CREATE = "CREATE [[0,1],[0,2],[1,3]] SCHEMA k:long,v:long"
+_AGG = "SELECT k, SUM(v) AS s FROM t GROUP BY k"
+_EXPECTED = [[0, 3], [1, 3]]
+
+_FENCE_FILE = "_adopt_fence.json"
+
+
+def _conf(tmp_path, **extra):
+    conf = {
+        FUGUE_CONF_SERVE_BREAKER_THRESHOLD: 0,
+        FUGUE_CONF_SERVE_STATE_PATH: str(tmp_path / "state"),
+        FUGUE_CONF_SERVE_FLEET_HEALTH_INTERVAL: 0.05,
+        FUGUE_CONF_SERVE_FLEET_DEATH_THRESHOLD: 1,
+        FUGUE_CONF_SERVE_MAX_CONCURRENT: 2,
+    }
+    conf.update(extra)
+    return conf
+
+
+def _autoscale_conf(tmp_path, **extra):
+    # interval=60 parks the background thread; tests drive tick()
+    return _conf(
+        tmp_path,
+        **{
+            FUGUE_CONF_SERVE_AUTOSCALE_MAX_REPLICAS: 2,
+            FUGUE_CONF_SERVE_AUTOSCALE_INTERVAL: 60.0,
+            FUGUE_CONF_SERVE_AUTOSCALE_UP_QUEUE: 2,
+            FUGUE_CONF_SERVE_AUTOSCALE_SUSTAIN_TICKS: 2,
+            FUGUE_CONF_SERVE_AUTOSCALE_IDLE_TICKS: 2,
+            FUGUE_CONF_SERVE_AUTOSCALE_COOLDOWN: 0.0,
+            **extra,
+        },
+    )
+
+
+def _wait_until(cond, timeout=30.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _health_body(host, port):
+    with urllib.request.urlopen(
+        f"http://{host}:{port}/v1/health", timeout=10
+    ) as resp:
+        return resp.status, json.loads(resp.read().decode("utf-8"))
+
+
+def _fenced_total(router) -> int:
+    fam = router._metrics.get("fugue_fleet_adoptions_fenced_total")
+    if fam is None:
+        return 0
+    return int(sum(v for _, v in fam.as_dict().items()))
+
+
+# ---------------------------------------------------------------------------
+# /v1/health: a degraded engine advertises reduced capacity, still 200
+# ---------------------------------------------------------------------------
+def test_health_and_status_report_degraded_engine(tmp_path):
+    daemon = ServeDaemon(
+        {FUGUE_CONF_SERVE_STATE_PATH: str(tmp_path / "state")}
+    ).start()
+    try:
+        host, port = daemon.address
+        status, body = _health_body(host, port)
+        assert status == 200 and body["state"] == "healthy"
+        assert "surviving_devices" not in body
+
+        before = daemon._engine.surviving_device_count
+        assert daemon._engine.recover_from_device_loss(device_lost(1))
+
+        # still answering 200 — an LB keeps the replica in rotation —
+        # but the state advertises the reduced mesh with the numbers an
+        # operator needs to size the replacement
+        status, body = _health_body(host, port)
+        assert status == 200, body
+        assert body["state"] == "degraded"
+        assert body["lost_devices"] == [1]
+        assert body["surviving_devices"] == before - 1
+
+        rec = daemon.status()["device_recovery"]
+        assert rec["lost_devices"] == [1]
+        assert rec["surviving_devices"] == before - 1
+
+        # ... and the degraded daemon still serves queries end to end
+        client = ServeClient(host, port)
+        sid = client.create_session()
+        r = client.sql(sid, _CREATE, save_as="t", collect=False)
+        assert r["status"] == "done", r.get("error")
+        assert sorted(client.sql(sid, _AGG)["result"]["rows"]) == _EXPECTED
+    finally:
+        daemon.stop()
+
+
+# ---------------------------------------------------------------------------
+# autoscaler: replace-then-retire a degraded replica, zero session loss
+# ---------------------------------------------------------------------------
+def test_autoscaler_replaces_degraded_replica_without_session_loss(tmp_path):
+    with ServeFleet(_autoscale_conf(tmp_path), replicas=1) as fleet:
+        scaler = fleet.autoscaler
+        client = ServeClient(*fleet.address)
+        sid = client.create_session()
+        r = client.sql(sid, _CREATE, save_as="t", collect=False)
+        assert r["status"] == "done", r.get("error")
+        assert fleet.router.affinity()[sid] == "r0"
+
+        # the device dies: the engine rebuilds onto the survivors and
+        # the replica starts advertising "degraded"
+        assert fleet.replica("r0")._engine.recover_from_device_loss(
+            device_lost(2)
+        )
+        host, port = fleet.replica("r0").address
+        assert _health_body(host, port)[1]["state"] == "degraded"
+
+        # degraded capacity is sustained pressure IMMEDIATELY (no
+        # sustain_ticks wait): the healthy count (0) is below the floor,
+        # so the first tick spawns the replacement
+        out = scaler.tick()
+        assert out == "scale_up r1", out
+        assert fleet.replica_ids == ["r0", "r1"]
+        assert _wait_until(
+            lambda: fleet.router.check_health().get("r1") == "healthy"
+        )
+
+        # with the floor covered by healthy hardware, the next tick
+        # drain-retires the degraded replica; its session moves by the
+        # SAME planned journal adoption as a rolling restart
+        out = scaler.tick()
+        assert out == "retire_degraded r0", out
+        assert fleet.replica_ids == ["r1"]
+        assert fleet.router.affinity()[sid] == "r1"
+
+        # zero session loss: the migrated session answers with its
+        # committed table on the healthy replacement
+        assert sorted(client.sql(sid, _AGG)["result"]["rows"]) == _EXPECTED
+        assert "t" in client.session(sid)["tables"]
+        d = scaler.describe()
+        assert d["scale_ups"] == 1 and d["scale_downs"] == 1
+
+
+def test_degraded_replica_retired_when_floor_already_covered(tmp_path):
+    with ServeFleet(_autoscale_conf(tmp_path), replicas=2) as fleet:
+        scaler = fleet.autoscaler
+        # degrade the OLDEST replica: plain newest-first retirement
+        # would shed r1 and keep the reduced mesh serving forever
+        assert fleet.replica("r0")._engine.recover_from_device_loss(
+            device_lost(3)
+        )
+        # the degraded branch fires before idle bookkeeping: with the
+        # floor (1) already covered by healthy r1, the degraded replica
+        # is retired straight away
+        out = scaler.tick()
+        assert out == "retire_degraded r0", out
+        assert fleet.replica_ids == ["r1"]
+
+
+# ---------------------------------------------------------------------------
+# adoption fence: exactly one winner per journal
+# ---------------------------------------------------------------------------
+def test_adoption_fence_admits_exactly_one_winner(tmp_path):
+    fs = make_default_registry()
+    base = str(tmp_path / "journal")
+    os.makedirs(base)
+
+    token = ServeStateJournal.acquire_adoption_fence(fs, base, owner="r0")
+    assert token["owner"] == "r0" and token["nonce"]
+
+    # the loser backs off WITHOUT reading state, told who won
+    with pytest.raises(AdoptionFencedError) as ex:
+        ServeStateJournal.acquire_adoption_fence(fs, base, owner="r1")
+    assert ex.value.base_uri == base
+    assert ex.value.holder["owner"] == "r0"
+
+    # the fence falls with the journal: a cleared state is adoptable
+    ServeStateJournal.clear_state(fs, base)
+    token = ServeStateJournal.acquire_adoption_fence(fs, base, owner="r1")
+    assert token["owner"] == "r1"
+    ServeStateJournal.clear_adoption_fence(fs, base)
+    # clearing twice is a harmless no-op
+    ServeStateJournal.clear_adoption_fence(fs, base)
+
+
+def test_stale_fence_is_broken_and_reclaimed(tmp_path):
+    fs = make_default_registry()
+    base = str(tmp_path / "journal")
+    os.makedirs(base)
+
+    # a fence whose writer was hard-killed mid-adoption: old claimed_at
+    with open(os.path.join(base, _FENCE_FILE), "w") as fp:
+        json.dump(
+            {"owner": "dead-adopter", "claimed_at": time.time() - 3600,
+             "nonce": "zz"},
+            fp,
+        )
+    # within stale_after the corpse still holds the slot
+    with pytest.raises(AdoptionFencedError):
+        ServeStateJournal.acquire_adoption_fence(
+            fs, base, owner="r2", stale_after=7200.0
+        )
+    # past stale_after it is broken with ONE re-acquire attempt —
+    # adoption is idempotent per session id, so re-running the dead
+    # owner's half-landed adoption converges instead of duplicating
+    token = ServeStateJournal.acquire_adoption_fence(
+        fs, base, owner="r2", stale_after=30.0
+    )
+    assert token["owner"] == "r2"
+
+
+def test_daemon_adoption_respects_a_foreign_fence(tmp_path):
+    origin = ServeDaemon(
+        {FUGUE_CONF_SERVE_STATE_PATH: str(tmp_path / "a")}
+    ).start()
+    try:
+        host, port = origin.address
+        client = ServeClient(host, port)
+        sid = client.create_session()
+        r = client.sql(sid, _CREATE, save_as="t", collect=False)
+        assert r["status"] == "done", r.get("error")
+        origin_base = origin.journal.base_uri
+    finally:
+        origin.stop()
+
+    adopter = ServeDaemon(
+        {FUGUE_CONF_SERVE_STATE_PATH: str(tmp_path / "b")}
+    ).start()
+    try:
+        fs = adopter._engine.fs
+        ServeStateJournal.acquire_adoption_fence(
+            fs, origin_base, owner="someone-else"
+        )
+        with pytest.raises(AdoptionFencedError):
+            adopter.adopt_state(origin_base)
+        assert adopter.sessions.peek(sid) is None
+
+        # the winner finished and cleared; the retry adopts for real
+        ServeStateJournal.clear_adoption_fence(fs, origin_base)
+        adopted = adopter.adopt_state(origin_base)
+        assert sid in adopted["sessions"]
+        # ... and releases ITS fence with the source journal, so the
+        # path is adoptable again (an empty adoption this time)
+        adopted = adopter.adopt_state(origin_base)
+        assert adopted["sessions"] == []
+    finally:
+        adopter.stop()
+
+
+# ---------------------------------------------------------------------------
+# hard-kill chaos: a zombie fence blocks death failover until stale
+# ---------------------------------------------------------------------------
+def test_hard_kill_failover_backs_off_fence_then_converges(tmp_path):
+    """A replica dies while a hard-killed third party's fence sits on
+    its journal: every failover attempt loses the CAS race and backs
+    off (counted on ``fugue_fleet_adoptions_fenced_total``), nothing is
+    double-owned, and once the fence goes stale the retry breaks it and
+    adopts — the session answers on the survivor with its data."""
+    with ServeFleet(_conf(tmp_path), replicas=2) as fleet:
+        client = ServeClient(*fleet.address)
+        sids = [client.create_session() for _ in range(2)]
+        for sid in sids:
+            r = client.sql(sid, _CREATE, save_as="t", collect=False)
+            assert r["status"] == "done", r.get("error")
+        aff = fleet.router.affinity()
+        victim_sid = next(s for s in sids if aff[s] == "r1")
+
+        # a zombie adopter's FRESH fence on r1's journal
+        fence_path = os.path.join(
+            fleet.replica_state_path("r1"), _FENCE_FILE
+        )
+        with open(fence_path, "w") as fp:
+            json.dump(
+                {"owner": "zombie-adopter", "claimed_at": time.time(),
+                 "nonce": "zz"},
+                fp,
+            )
+
+        fleet.kill_replica("r1")
+        # the health loop declares r1 dead and tries to adopt, but the
+        # fence wins the CAS every time: the failover stays PENDING
+        assert _wait_until(lambda: _fenced_total(fleet.router) >= 1)
+        assert fleet.router.affinity().get(victim_sid) == "r1"
+
+        # the zombie never comes back: age the fence past stale_after
+        # and the next retry breaks it and adopts
+        with open(fence_path, "w") as fp:
+            json.dump(
+                {"owner": "zombie-adopter",
+                 "claimed_at": time.time() - 3600, "nonce": "zz"},
+                fp,
+            )
+        assert _wait_until(
+            lambda: fleet.router.affinity().get(victim_sid) == "r0"
+        ), fleet.router.describe()
+
+        # zero session loss through the fenced window
+        assert (
+            sorted(client.sql(victim_sid, _AGG)["result"]["rows"])
+            == _EXPECTED
+        )
+        assert "t" in client.session(victim_sid)["tables"]

@@ -1,0 +1,189 @@
+"""Device-loss triage and the executor's degrade-recover-retry branch
+(ISSUE 19), against fake engines — the real-mesh recovery path runs in
+``tests/fugue_tpu/jax_backend/test_device_recovery.py`` under a forced
+multi-device subprocess. Tier-1 compatible; also selectable via
+``-m faults``."""
+
+from typing import Any, List
+
+import pytest
+
+from fugue_tpu.testing.faults import collective_hang, device_lost
+from fugue_tpu.workflow.fault import (
+    DEVICE_LOST,
+    FATAL,
+    OOM,
+    TRANSIENT,
+    RetryPolicy,
+    RunStats,
+    classify_error,
+    execute_with_policy,
+)
+
+pytestmark = pytest.mark.faults
+
+
+class FakeXlaRuntimeError(Exception):
+    pass
+
+
+FakeXlaRuntimeError.__name__ = "XlaRuntimeError"
+
+
+class FakeRpcError(Exception):
+    pass
+
+
+FakeRpcError.__name__ = "GrpcRpcError"
+
+
+# ---------------------------------------------------------------------------
+# classifier: DEVICE_LOST triage and the status-token discipline
+# ---------------------------------------------------------------------------
+# (exception, expected class) — the full status-token discipline on XLA
+# runtime errors in one table: dead-device tokens only count on real
+# transport/runtime error TYPES, and DEVICE_LOST outranks the transient
+# status vocabulary when both appear in one message
+_TRIAGE_TABLE = [
+    # dead-device status text on an XLA runtime type
+    (FakeXlaRuntimeError("DATA_LOSS: replica gone"), DEVICE_LOST),
+    (FakeXlaRuntimeError("device lost: core halted"), DEVICE_LOST),
+    (FakeXlaRuntimeError("DEVICE_LOST while executing"), DEVICE_LOST),
+    (FakeXlaRuntimeError("device 3 is in an error state"), DEVICE_LOST),
+    # ... and on grpc-style status types
+    (FakeRpcError("DATA_LOSS: stream broken"), DEVICE_LOST),
+    # the SAME text on plain user exception types is deterministic: a
+    # RuntimeError mentioning DATA_LOSS must not trigger mesh rebuilds
+    (RuntimeError("DATA_LOSS: my own message"), FATAL),
+    (ValueError("device lost in translation"), FATAL),
+    # DEVICE_LOST outranks transient tokens in a combined message — a
+    # blind retry against the broken mesh would replay the failure
+    (
+        FakeXlaRuntimeError("DATA_LOSS: collective ABORTED on device 2"),
+        DEVICE_LOST,
+    ),
+    # a hung collective with NO dead-device evidence stays transient
+    (
+        FakeXlaRuntimeError("DEADLINE_EXCEEDED: all-reduce timed out"),
+        TRANSIENT,
+    ),
+    # OOM triage still wins its own lane on XLA types
+    (FakeXlaRuntimeError("RESOURCE_EXHAUSTED: 2.1G"), OOM),
+    # the chaos family's injected errors classify like the real thing
+    (device_lost(2), DEVICE_LOST),
+    (collective_hang(1), TRANSIENT),
+]
+
+
+@pytest.mark.parametrize(
+    "ex,expected", _TRIAGE_TABLE, ids=[f"{type(e).__name__}-{c}" for e, c in _TRIAGE_TABLE]
+)
+def test_device_lost_triage_table(ex: Exception, expected: str):
+    assert classify_error(ex) == expected
+
+
+def test_injected_device_lost_parses_back_to_its_device():
+    from fugue_tpu.jax_backend.distributed import parse_lost_devices
+
+    assert parse_lost_devices(str(device_lost(3))) == [3]
+    # the chaos site is registered so plans can target it
+    from fugue_tpu.testing.faults import KNOWN_SITES
+
+    assert "device.lost" in KNOWN_SITES
+
+
+# ---------------------------------------------------------------------------
+# executor: the DEVICE_LOST branch of execute_with_policy
+# ---------------------------------------------------------------------------
+class _RecoveringEngine:
+    def __init__(self, outcomes: List[Any]):
+        self.outcomes = list(outcomes)
+        self.calls: List[str] = []
+
+    def recover_from_device_loss(self, ex: Exception) -> bool:
+        self.calls.append(str(ex))
+        out = self.outcomes.pop(0)
+        if isinstance(out, Exception):
+            raise out
+        return out
+
+
+_POLICY = RetryPolicy(max_attempts=3, backoff=0.0, jitter=0.0)
+
+
+def test_recovered_loss_consumes_one_ordinary_attempt():
+    engine = _RecoveringEngine([True])
+    stats = RunStats()
+    attempts = []
+
+    def work():
+        attempts.append(1)
+        if len(attempts) == 1:
+            raise device_lost(2)
+        return "ok"
+
+    out = execute_with_policy(
+        work, _POLICY, engine=engine, task_name="t", stats=stats
+    )
+    assert out == "ok"
+    assert len(attempts) == 2
+    assert len(engine.calls) == 1
+    assert stats.device_recoveries == {"t": 1}
+    # the post-recovery retry is an ordinary attempt under the budget
+    assert stats.retries == {"t": 1}
+
+
+def test_unrecoverable_loss_fails_fast_with_original_error():
+    engine = _RecoveringEngine([False])
+    attempts = []
+
+    def work():
+        attempts.append(1)
+        raise device_lost(0)
+
+    with pytest.raises(Exception, match="DATA_LOSS"):
+        execute_with_policy(work, _POLICY, engine=engine, task_name="t")
+    assert len(attempts) == 1  # no blind retry against a broken mesh
+
+
+def test_recovery_hook_raising_is_contained_as_fatal():
+    engine = _RecoveringEngine([RuntimeError("rebuild blew up")])
+
+    def work():
+        raise device_lost(1)
+
+    # the ORIGINAL device error surfaces, not the recovery failure
+    with pytest.raises(Exception, match="device lost"):
+        execute_with_policy(work, _POLICY, engine=engine, task_name="t")
+
+
+def test_device_loss_without_engine_hook_is_fatal():
+    attempts = []
+
+    def work():
+        attempts.append(1)
+        raise device_lost(1)
+
+    with pytest.raises(Exception, match="device lost"):
+        execute_with_policy(work, _POLICY, engine=object(), task_name="t")
+    assert len(attempts) == 1
+
+
+def test_repeated_losses_retry_under_the_same_budget():
+    # two consecutive losses, two successful recoveries, then success —
+    # all inside the 3-attempt budget
+    engine = _RecoveringEngine([True, True])
+    attempts = []
+
+    def work():
+        attempts.append(1)
+        if len(attempts) <= 2:
+            raise device_lost(len(attempts))
+        return "ok"
+
+    assert (
+        execute_with_policy(work, _POLICY, engine=engine, task_name="t")
+        == "ok"
+    )
+    assert len(attempts) == 3
+    assert len(engine.calls) == 2
