@@ -14,9 +14,14 @@ Targets:
   (:mod:`fugue_tpu.analysis.codelint`) over a package tree (default:
   the installed fugue_tpu package), applying the justification-required
   baseline (``--baseline``, default: the packaged baseline.json);
+- ``--lint-jit [dir]``: run the ``FJX###`` jit-hazard linter
+  (:mod:`fugue_tpu.analysis.jitlint`) over a package tree — static
+  recompile/host-sync/dtype/donation/side-effect analysis of every jit
+  boundary, with its own justification-required baseline;
 - ``--self-test``: analyze the built-in representative workflow corpus
-  AND source-lint the installed tree — the one-command pre-merge gate
-  covering both planes (exits nonzero on any error-level diagnostic).
+  AND source-lint AND jit-lint the installed tree — the one-command
+  pre-merge gate covering all three planes (exits nonzero on any
+  error-level diagnostic).
 
 Exit codes: 0 clean (or only sub-error findings), 1 error-level
 diagnostics, 2 the target could not be built.
@@ -140,6 +145,35 @@ def _run_source_lint(
     return errors
 
 
+def _run_jit_lint(
+    root: Optional[str], baseline_path: Optional[str], floor: Severity, out: Any
+) -> int:
+    """Jit-lint a tree with the FJX baseline applied; prints findings and
+    returns the number of error-level diagnostics."""
+    from fugue_tpu.analysis.jitlint import lint_tree_jit
+    from fugue_tpu.analysis.jitlint.baseline import (
+        apply_baseline,
+        load_jit_baseline,
+        stale_jit_diags,
+    )
+
+    entries, problems = load_jit_baseline(baseline_path)
+    diags = lint_tree_jit(root)
+    kept, suppressed, stale = apply_baseline(diags, entries)
+    final = problems + kept + stale_jit_diags(stale, baseline_path)
+    for d in final:
+        if d.severity >= floor:
+            print(d.describe(), file=out)
+    errors = sum(1 for d in final if d.severity is Severity.ERROR)
+    print(
+        f"jit lint: {errors} error(s), "
+        f"{sum(1 for d in final if d.severity is Severity.WARN)} warning(s), "
+        f"{len(suppressed)} baselined exception(s)",
+        file=out,
+    )
+    return errors
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m fugue_tpu.analysis",
@@ -172,11 +206,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         "target: directory; default: the installed fugue_tpu package)",
     )
     p.add_argument(
+        "--lint-jit",
+        action="store_true",
+        help="run the FJX jit-hazard linter over a package tree (optional "
+        "target: directory; default: the installed fugue_tpu package)",
+    )
+    p.add_argument(
         "--baseline",
         default=None,
         metavar="PATH",
-        help="with --lint-source: the justification-required baseline "
-        "file (default: the packaged codelint/baseline.json)",
+        help="with --lint-source/--lint-jit: the justification-required "
+        "baseline file (default: the packaged baseline.json of that plane)",
     )
     p.add_argument(
         "--conf",
@@ -199,18 +239,27 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(str(ex), file=sys.stderr)
         return 2
 
-    if args.lint_source:
+    if args.lint_source or args.lint_jit:
+        flag = "--lint-source" if args.lint_source else "--lint-jit"
+        if args.lint_source and args.lint_jit:
+            print("--lint-source and --lint-jit are exclusive (run them "
+                  "separately, or --self-test for every plane)",
+                  file=sys.stderr)
+            return 2
         if args.self_test:
-            print("--lint-source and --self-test are exclusive "
-                  "(--self-test already includes the source lint)",
+            print(f"{flag} and --self-test are exclusive "
+                  f"(--self-test already includes the lint planes)",
                   file=sys.stderr)
             return 2
         root = args.target
         if root is not None and not os.path.isdir(root):
-            print(f"--lint-source target {root!r} is not a directory",
+            print(f"{flag} target {root!r} is not a directory",
                   file=sys.stderr)
             return 2
-        errors = _run_source_lint(root, args.baseline, floor, sys.stdout)
+        if args.lint_source:
+            errors = _run_source_lint(root, args.baseline, floor, sys.stdout)
+        else:
+            errors = _run_jit_lint(root, args.baseline, floor, sys.stdout)
         return 1 if errors else 0
 
     if args.self_test:
@@ -322,10 +371,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                 file=sys.stdout,
             )
             failed = True
-        # both planes, one command: the workflow-corpus gate above plus
-        # the FLN source lint of the installed tree
+        # every plane, one command: the workflow-corpus gate above plus
+        # the FLN source lint and the FJX jit-hazard lint of the
+        # installed tree (each against its own packaged baseline)
         src_errors = _run_source_lint(None, args.baseline, floor, sys.stdout)
         failed = failed or src_errors > 0
+        jit_errors = _run_jit_lint(None, None, floor, sys.stdout)
+        failed = failed or jit_errors > 0
         return 1 if failed else 0
     if args.optimize:
         print("--optimize requires --self-test", file=sys.stderr)

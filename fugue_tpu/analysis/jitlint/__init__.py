@@ -1,0 +1,91 @@
+"""The JIT-HAZARD linter — the third static-analysis plane (``FJX###``).
+
+Where the workflow analyzer (``FWF``) reads user DAGs and the source
+linter (``FLN``) reads the codebase's concurrency/vocabulary discipline,
+this plane reads every **jit boundary**: the callables jax will trace
+(direct ``jax.jit``/``shard_map`` calls, ``blocks.jit_row_sharded``,
+``engine._jit_cached`` call sites, plus their same-module call-graph
+closure) and runs an intra-procedural two-taint dataflow over them for
+the recompile/host-sync/dtype/donation/side-effect hazards that bench
+gates like ``zero_recompile_warm`` only catch after the fact.
+
+Static scope is honest: same-module resolution, no cross-module data
+flow, attribute access breaks taint. The runtime twin —
+:mod:`fugue_tpu.testing.retrace` — counts the retraces that actually
+happen; a hazard should trip both planes (see the seeded two-plane test
+in ``tests/fugue_tpu/jax_backend/test_retrace_sentinel.py``).
+
+Front door::
+
+    python -m fugue_tpu.analysis --lint-jit [dir]
+
+Exit codes follow the established contract: 0 clean (warnings allowed),
+1 error findings, 2 the lint itself could not run.
+"""
+
+from typing import List, Optional
+
+from fugue_tpu.analysis.codelint.engine import (
+    ModuleInfo,
+    load_tree,
+)
+from fugue_tpu.analysis.codelint.model import SourceDiagnostic
+from fugue_tpu.analysis.diagnostics import Severity
+from fugue_tpu.analysis.jitlint.boundaries import (
+    BUCKET_SANITIZERS,
+    JitBinding,
+    JitContext,
+    JitFrame,
+    JitRegion,
+)
+from fugue_tpu.analysis.jitlint.model import (
+    JitRule,
+    all_jit_rules,
+    register_jit_rule,
+    registered_jit_codes,
+)
+
+__all__ = [
+    "JitRule",
+    "JitContext",
+    "JitRegion",
+    "JitFrame",
+    "JitBinding",
+    "BUCKET_SANITIZERS",
+    "register_jit_rule",
+    "all_jit_rules",
+    "registered_jit_codes",
+    "lint_modules_jit",
+    "lint_tree_jit",
+    "lint_text_jit",
+]
+
+
+def lint_modules_jit(modules: List[ModuleInfo]) -> List[SourceDiagnostic]:
+    import fugue_tpu.analysis.jitlint.rules_jit  # noqa: F401
+
+    ctx = JitContext(modules)
+    out: List[SourceDiagnostic] = []
+    for rule_cls in all_jit_rules():
+        out.extend(rule_cls().check(ctx))
+    out.sort(key=lambda d: (-int(d.severity), d.path, d.line))
+    return out
+
+
+def lint_tree_jit(root: Optional[str] = None) -> List[SourceDiagnostic]:
+    """Lint every ``.py`` under ``root`` (default: the installed
+    fugue_tpu package). Parse failures surface as FJX001 errors, never a
+    crashed lint."""
+    modules, problems = load_tree(root)
+    remapped = [
+        SourceDiagnostic(
+            "FJX001", p.severity, p.message, path=p.path, line=p.line, rule="parse"
+        )
+        for p in problems
+    ]
+    return remapped + lint_modules_jit(modules)
+
+
+def lint_text_jit(source: str, rel: str = "fugue_tpu/fixture.py") -> List[SourceDiagnostic]:
+    """Lint one in-memory module (the fixture-corpus entry point)."""
+    return lint_modules_jit([ModuleInfo(rel, rel, source)])

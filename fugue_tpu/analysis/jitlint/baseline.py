@@ -1,0 +1,124 @@
+"""Justification-required baseline for accepted FJX exceptions.
+
+Same contract as the FLN baseline (:mod:`fugue_tpu.analysis.codelint.
+baseline`), same entry shape — ``code``/``file``/``context``/
+``justification`` — but its own file and its own meta-codes so the two
+planes gate independently:
+
+* **FJX002** — the baseline itself is broken (unreadable JSON, entry
+  without a justification). Error.
+* **FJX003** — stale entry: matched nothing, the hazard was fixed,
+  prune it. Warn (the baseline can only shrink).
+* **FJX004** — entry names an FJX code no registered rule owns: the
+  rule was renamed or removed and the entry is dead weight that would
+  otherwise suppress nothing forever. Error.
+"""
+
+import json
+import os
+from typing import List, Optional, Tuple
+
+from fugue_tpu.analysis.codelint.baseline import BaselineEntry, apply_baseline
+from fugue_tpu.analysis.codelint.model import SourceDiagnostic
+from fugue_tpu.analysis.diagnostics import Severity
+from fugue_tpu.analysis.jitlint.model import registered_jit_codes
+
+__all__ = [
+    "BaselineEntry",
+    "apply_baseline",
+    "DEFAULT_BASELINE",
+    "load_jit_baseline",
+    "stale_jit_diags",
+    "completeness_diags",
+]
+
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.json"
+)
+
+
+def load_jit_baseline(
+    path: Optional[str] = None,
+) -> Tuple[List[BaselineEntry], List[SourceDiagnostic]]:
+    """Entries plus any problems with the baseline ITSELF as error
+    diagnostics (unreadable file -> FJX002, missing justification ->
+    FJX002, unregistered rule code -> FJX004)."""
+    path = path or DEFAULT_BASELINE
+    problems: List[SourceDiagnostic] = []
+    if not os.path.isfile(path):
+        return [], problems
+    try:
+        with open(path, "r") as fp:
+            payload = json.load(fp)
+    except (OSError, ValueError) as ex:
+        return [], [
+            SourceDiagnostic(
+                "FJX002",
+                Severity.ERROR,
+                f"unreadable jit baseline: {type(ex).__name__}: {ex}",
+                path=path,
+                rule="baseline",
+            )
+        ]
+    import fugue_tpu.analysis.jitlint.rules_jit  # noqa: F401  (registers FJX rules)
+
+    known = set(registered_jit_codes())
+    entries: List[BaselineEntry] = []
+    for i, raw in enumerate(payload.get("entries", [])):
+        entry = BaselineEntry(
+            str(raw.get("code", "")),
+            str(raw.get("file", "")),
+            str(raw.get("context", "")),
+            str(raw.get("justification", "")).strip(),
+        )
+        if entry.justification == "":
+            problems.append(
+                SourceDiagnostic(
+                    "FJX002",
+                    Severity.ERROR,
+                    f"jit baseline entry #{i} ({entry.code} {entry.file}) "
+                    "has no justification: accepted exceptions must say WHY",
+                    path=path,
+                    rule="baseline",
+                )
+            )
+            continue
+        if entry.code not in known:
+            problems.append(
+                SourceDiagnostic(
+                    "FJX004",
+                    Severity.ERROR,
+                    f"jit baseline entry #{i} names '{entry.code}' which no "
+                    "registered FJX rule owns — the rule was renamed or "
+                    "removed; update or prune the entry",
+                    path=path,
+                    rule="baseline",
+                )
+            )
+            continue
+        entries.append(entry)
+    return entries, problems
+
+
+def stale_jit_diags(
+    stale: List[BaselineEntry], path: Optional[str] = None
+) -> List[SourceDiagnostic]:
+    return [
+        SourceDiagnostic(
+            "FJX003",
+            Severity.WARN,
+            f"stale jit baseline entry: {e.code} {e.file} [{e.context}] no "
+            "longer matches any finding — the hazard was fixed, prune the "
+            "entry",
+            path=path or DEFAULT_BASELINE,
+            rule="baseline",
+        )
+        for e in stale
+    ]
+
+
+def completeness_diags(path: Optional[str] = None) -> List[SourceDiagnostic]:
+    """Standalone FJX004 sweep for the self-test: every code in the
+    shipped baseline must be a registered rule."""
+    _, problems = load_jit_baseline(path)
+    return [p for p in problems if p.code == "FJX004"]

@@ -1,0 +1,728 @@
+"""Jit-boundary discovery and the two-taint dataflow the FJX rules run
+on.
+
+A *region* is one callable that jax will trace: the target of a direct
+``jax.jit``/``shard_map`` call, of the engine's ``_jit_cached`` wrapper,
+of ``blocks.jit_row_sharded``, or a ``@jax.jit``/``@partial(jax.jit,
+...)``-decorated function. Each region expands into *frames*: the root
+function plus every same-module function it calls (taint propagates
+through the call arguments), so a hazard buried one helper deep is still
+attributed to the jit boundary that traces it.
+
+Two taints flow through each frame, and they mean different failures:
+
+* **traced** — the value is (derived from) a traced parameter. In a
+  shape position it is a trace-time crash (ConcretizationTypeError);
+  fed to ``float()``/``if`` it is a host sync.
+* **host** — the value varies per call but is folded into program
+  identity: a ``static_argnums`` parameter, a ``partial``-bound value,
+  or an enclosing function's parameter captured by closure. In a shape
+  position it recompiles per distinct value unless laundered through a
+  pow2 bucket.
+
+Laundering is modeled: a call to a bucket helper (``padded_len``,
+``pad_spans``, ``row_bucket``, ...) clears both taints, attribute access
+(``x.shape``) breaks taint (shapes are static at trace time), and
+assignment replaces a variable's taint. The walk is flow-sensitive in
+statement order with a second pass for loop-carried values.
+"""
+
+import ast
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
+
+from fugue_tpu.analysis.codelint.engine import (
+    LintContext,
+    ModuleInfo,
+    call_name,
+    dotted_name,
+)
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+#: call last-components that launder a host/traced value into a bounded
+#: bucket (clears both taints): the pow2 discipline the engine uses so
+#: per-length values collapse onto O(log n) programs.
+BUCKET_SANITIZERS = {
+    "padded_len",
+    "pad_spans",
+    "row_bucket",
+    "bucket_len",
+    "_bucket",
+    "_bucket_len",
+    "next_pow2",
+    "pow2",
+    "pow2_bucket",
+}
+
+#: builtins whose result is static at trace time regardless of operands.
+_CLEAN_CALLS = {"isinstance", "hasattr", "callable", "type", "len", "getattr"}
+
+
+# ---------------------------------------------------------------------------
+# regions / frames
+# ---------------------------------------------------------------------------
+class JitFrame:
+    """One function body analyzed under a jit boundary, with its
+    parameter classification and (after :meth:`run`) a per-expression
+    taint map the rules query."""
+
+    def __init__(
+        self,
+        region: "JitRegion",
+        mod: ModuleInfo,
+        node: ast.AST,
+        traced: Set[str],
+        host: Set[str],
+        depth: int = 0,
+    ):
+        self.region = region
+        self.mod = mod
+        self.node = node  # FunctionDef / AsyncFunctionDef / Lambda
+        self.traced_params = set(traced)
+        self.host_params = set(host)
+        self.depth = depth
+        # id(expr) -> (traced, host) at evaluation time
+        self.taint_at: Dict[int, Tuple[bool, bool]] = {}
+        # every name bound inside the frame (params, assigns, for/with
+        # targets, imports): a mutation of anything NOT here is a
+        # closed-over side effect (FJX205)
+        self.bound: Set[str] = set()
+        # names bound in ANCESTOR frames of the same region: mutating
+        # those is trace-local accumulation (the payload-dedup slot
+        # pattern), not an escaping side effect
+        self.inherited_bound: Set[str] = set()
+        self._ran = False
+
+    @property
+    def qualname(self) -> str:
+        name = getattr(self.node, "name", "<lambda>")
+        enclosing = self.mod.qualname(self.node)
+        return f"{enclosing}.{name}" if enclosing else name
+
+    def body(self) -> List[ast.stmt]:
+        body = getattr(self.node, "body", None)
+        if isinstance(body, list):
+            return body
+        # Lambda: wrap the expression as a statement-like list
+        return [ast.Expr(value=self.node.body)]  # type: ignore[attr-defined]
+
+    def run(self) -> None:
+        if self._ran:
+            return
+        self._ran = True
+        _TaintWalker(self).run()
+
+    def expr_taint(self, node: ast.AST) -> Tuple[bool, bool]:
+        return self.taint_at.get(id(node), (False, False))
+
+    def is_traced(self, node: ast.AST) -> bool:
+        return self.expr_taint(node)[0]
+
+    def is_host(self, node: ast.AST) -> bool:
+        return self.expr_taint(node)[1]
+
+
+class JitRegion:
+    """One discovered jit boundary and the frames it traces."""
+
+    def __init__(self, mod: ModuleInfo, kind: str, line: int, qualname: str):
+        self.mod = mod
+        self.kind = kind  # jax.jit / shard_map / _jit_cached / ...
+        self.line = line
+        self.qualname = qualname  # enclosing qualname of the boundary
+        self.frames: List[JitFrame] = []
+
+
+class JitBinding:
+    """One ``name = jax.jit(...)``-style binding, for the FJX204 donation
+    check: ``target`` is the dotted name the jitted callable is bound
+    to, call sites are classified later against the whole module."""
+
+    def __init__(
+        self,
+        mod: ModuleInfo,
+        line: int,
+        qualname: str,
+        target: str,
+        donated: bool,
+        kind: str,
+    ):
+        self.mod = mod
+        self.line = line
+        self.qualname = qualname
+        self.target = target
+        self.donated = donated
+        self.kind = kind
+        # (line, is_self_overwrite) per call site of `target(...)`
+        self.call_sites: List[Tuple[int, bool]] = []
+
+
+class JitContext:
+    """Everything an FJX rule may consult: the module set, the function
+    summaries (reused from the source-lint plane), every discovered jit
+    region with taint-annotated frames, and every jitted binding."""
+
+    def __init__(self, modules: List[ModuleInfo]):
+        self.modules = modules
+        self.lint = LintContext(modules)  # populates mod.functions
+        self.regions: List[JitRegion] = []
+        self.bindings: List[JitBinding] = []
+        for mod in modules:
+            _discover_module(self, mod)
+        for frame in self.iter_frames():
+            frame.run()
+        for b in self.bindings:
+            _classify_call_sites(b)
+
+    def iter_frames(self) -> Iterable[JitFrame]:
+        for region in self.regions:
+            for frame in region.frames:
+                yield frame
+
+
+# ---------------------------------------------------------------------------
+# discovery
+# ---------------------------------------------------------------------------
+def _const_int_set(node: Optional[ast.AST]) -> Set[int]:
+    """static_argnums / donate_argnums literals -> set of ints."""
+    out: Set[int] = set()
+    if node is None:
+        return out
+    items = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+    for it in items:
+        if isinstance(it, ast.Constant) and isinstance(it.value, int):
+            out.add(it.value)
+    return out
+
+
+def _const_str_set(node: Optional[ast.AST]) -> Set[str]:
+    out: Set[str] = set()
+    if node is None:
+        return out
+    items = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+    for it in items:
+        if isinstance(it, ast.Constant) and isinstance(it.value, str):
+            out.add(it.value)
+    return out
+
+
+def _names_in(expr: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _is_jit_name(name: Optional[str]) -> bool:
+    return name in ("jit", "jax.jit")
+
+
+def _is_partial(name: Optional[str]) -> bool:
+    return name in ("partial", "functools.partial")
+
+
+class _BoundarySpec:
+    """What one jit-construction call pins down before fn resolution."""
+
+    def __init__(self, kind: str, fn: Optional[ast.AST]):
+        self.kind = kind
+        self.fn = fn
+        self.static_nums: Set[int] = set()
+        self.static_names: Set[str] = set()
+        self.donated = False
+        # extra host-tainted params bound by functools.partial
+        self.partial_pos = 0
+        self.partial_kw: Set[str] = set()
+        # names folded into the program KEY (_jit_cached / jit_row_sharded):
+        # a host capture that is part of program identity is deliberate
+        # per-value specialization, not an accidental recompile — laundered
+        self.key_names: Set[str] = set()
+
+
+def _parse_jit_kwargs(spec: _BoundarySpec, call: ast.Call) -> None:
+    spec.static_nums |= _const_int_set(_kw(call, "static_argnums"))
+    spec.static_names |= _const_str_set(_kw(call, "static_argnames"))
+    if _kw(call, "donate_argnums") is not None or _kw(call, "donate_argnames") is not None:
+        spec.donated = True
+
+
+def _boundary_from_call(call: ast.Call) -> Optional[_BoundarySpec]:
+    name = call_name(call)
+    if name is None:
+        return None
+    last = name.rsplit(".", 1)[-1]
+    spec: Optional[_BoundarySpec] = None
+    if _is_jit_name(name) and call.args:
+        spec = _BoundarySpec("jax.jit", call.args[0])
+        _parse_jit_kwargs(spec, call)
+    elif last == "shard_map" and call.args:
+        spec = _BoundarySpec("shard_map", call.args[0])
+    elif last == "jit_row_sharded" and len(call.args) >= 3:
+        spec = _BoundarySpec("jit_row_sharded", call.args[2])
+        spec.key_names = _names_in(call.args[1])
+    elif last == "_jit_cached" and len(call.args) >= 2:
+        spec = _BoundarySpec("_jit_cached", call.args[1])
+        spec.key_names = _names_in(call.args[0])
+        spec.static_nums |= _const_int_set(_kw(call, "static_argnums"))
+        if len(call.args) >= 3:
+            spec.static_nums |= _const_int_set(call.args[2])
+    if spec is None:
+        return None
+    # unwrap functools.partial: positionally-bound params and kwarg-bound
+    # params are host values folded into the traced program
+    fn = spec.fn
+    if isinstance(fn, ast.Call) and _is_partial(call_name(fn)) and fn.args:
+        spec.partial_pos = len(fn.args) - 1
+        spec.partial_kw = {kw.arg for kw in fn.keywords if kw.arg}
+        spec.fn = fn.args[0]
+    return spec
+
+
+def _boundary_from_decorator(fn_def: ast.AST) -> Optional[_BoundarySpec]:
+    for dec in getattr(fn_def, "decorator_list", []):
+        if _is_jit_name(dotted_name(dec)):
+            return _BoundarySpec("jax.jit", None)
+        if isinstance(dec, ast.Call):
+            dname = call_name(dec)
+            if _is_jit_name(dname):
+                spec = _BoundarySpec("jax.jit", None)
+                _parse_jit_kwargs(spec, dec)
+                return spec
+            if _is_partial(dname) and dec.args and _is_jit_name(dotted_name(dec.args[0])):
+                spec = _BoundarySpec("jax.jit", None)
+                _parse_jit_kwargs(spec, dec)
+                return spec
+    return None
+
+
+def _resolve_fn(mod: ModuleInfo, at: ast.AST, expr: ast.AST) -> Optional[ast.AST]:
+    """The FunctionDef/Lambda a jit-target expression names, resolved in
+    this module (Lambda inline; ``f`` via progressively-stripped
+    enclosing qualnames; ``self.m`` via the enclosing class)."""
+    if isinstance(expr, ast.Lambda):
+        return expr
+    name = dotted_name(expr)
+    if name is None:
+        return None
+    enclosing = mod.qualname(at)
+    candidates: List[str] = []
+    if name.startswith("self.") and name.count(".") == 1:
+        cls = enclosing.split(".", 1)[0] if enclosing else ""
+        if cls:
+            candidates.append(f"{cls}.{name.split('.', 1)[1]}")
+    elif "." not in name:
+        parts = enclosing.split(".") if enclosing else []
+        for i in range(len(parts), -1, -1):
+            prefix = ".".join(parts[:i])
+            candidates.append(f"{prefix}.{name}" if prefix else name)
+    for cand in candidates:
+        fs = mod.functions.get(cand)
+        if fs is not None:
+            return fs.node
+    return None
+
+
+def _param_names(node: ast.AST) -> List[str]:
+    a = node.args  # type: ignore[attr-defined]
+    names = [p.arg for p in getattr(a, "posonlyargs", [])] + [p.arg for p in a.args]
+    if names and names[0] == "self":
+        names = names[1:]
+    names += [p.arg for p in a.kwonlyargs]
+    return names
+
+
+def _free_names(node: ast.AST) -> Set[str]:
+    """Names the function reads but never binds — closure captures."""
+    bound: Set[str] = set(_param_names(node)) | {"self"}
+    loads: Set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            if isinstance(sub.ctx, ast.Load):
+                loads.add(sub.id)
+            else:
+                bound.add(sub.id)
+        elif isinstance(sub, _FUNC_NODES) and sub is not node:
+            bound.add(sub.name)
+            bound.update(_param_names(sub))
+        elif isinstance(sub, (ast.Import, ast.ImportFrom)):
+            for alias in sub.names:
+                bound.add(alias.asname or alias.name.split(".", 1)[0])
+        elif isinstance(sub, ast.arg):
+            bound.add(sub.arg)
+    return loads - bound
+
+
+def _host_captures(mod: ModuleInfo, fn_node: ast.AST) -> Set[str]:
+    """Free variables of the jitted fn that are parameters of its
+    ENCLOSING function: values that vary per outer call but are baked
+    into the trace — the classic per-call-recompile closure capture."""
+    enclosing_qual = mod.qualname(fn_node)
+    if not enclosing_qual:
+        return set()
+    fs = mod.functions.get(enclosing_qual)
+    if fs is None:
+        return set()
+    outer_params = set(_param_names(fs.node))
+    return _free_names(fn_node) & outer_params
+
+
+def _discover_module(ctx: JitContext, mod: ModuleInfo) -> None:
+    seen_fn_ids: Set[int] = set()
+    for node in ast.walk(mod.tree):
+        spec: Optional[_BoundarySpec] = None
+        fn_node: Optional[ast.AST] = None
+        line = getattr(node, "lineno", 0)
+        if isinstance(node, ast.Call):
+            spec = _boundary_from_call(node)
+            if spec is None:
+                continue
+            if spec.fn is not None:
+                fn_node = _resolve_fn(mod, node, spec.fn)
+            _record_binding(ctx, mod, node, spec)
+        elif isinstance(node, _FUNC_NODES):
+            spec = _boundary_from_decorator(node)
+            if spec is None:
+                continue
+            fn_node = node
+        else:
+            continue
+        region = JitRegion(mod, spec.kind, line, mod.qualname(node))
+        ctx.regions.append(region)
+        if fn_node is None or id(fn_node) in seen_fn_ids:
+            continue
+        seen_fn_ids.add(id(fn_node))
+        params = _param_names(fn_node)
+        host: Set[str] = set()
+        for i in sorted(spec.static_nums):
+            if 0 <= i < len(params):
+                host.add(params[i])
+        host |= spec.static_names & set(params)
+        for i in range(min(spec.partial_pos, len(params))):
+            host.add(params[i])
+        host |= spec.partial_kw & set(params)
+        traced = set(params) - host
+        host |= _host_captures(mod, fn_node) - spec.key_names
+        root = JitFrame(region, mod, fn_node, traced, host, depth=0)
+        region.frames.append(root)
+        _expand_closure(region, root)
+
+
+def _record_binding(ctx: JitContext, mod: ModuleInfo, call: ast.Call, spec: _BoundarySpec) -> None:
+    """When the jit construction is the RHS of a simple assignment,
+    remember the binding for the FJX204 donation check."""
+    # find the Assign that owns this call: cheap parent scan limited to
+    # single-target assigns whose value is exactly this call
+    for node in ast.walk(mod.tree):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and node.value is call
+        ):
+            target = dotted_name(node.targets[0])
+            if target:
+                ctx.bindings.append(
+                    JitBinding(
+                        mod,
+                        node.lineno,
+                        mod.qualname(node),
+                        target,
+                        spec.donated,
+                        spec.kind,
+                    )
+                )
+            return
+
+
+def _classify_call_sites(b: JitBinding) -> None:
+    for node in ast.walk(b.mod.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        calls: List[ast.Call] = []
+        if isinstance(value, ast.Call) and dotted_name(value.func) == b.target:
+            calls.append(value)
+        for call in calls:
+            overwrite = False
+            if len(node.targets) == 1 and call.args:
+                tgt = dotted_name(node.targets[0])
+                first = dotted_name(call.args[0])
+                overwrite = tgt is not None and tgt == first
+            b.call_sites.append((node.lineno, overwrite))
+    # bare-expression / nested call sites: count as non-overwrite so the
+    # rule stays conservative (donation only suggested when EVERY site
+    # overwrites the argument with the return)
+    for node in ast.walk(b.mod.tree):
+        if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            if dotted_name(node.value.func) == b.target:
+                b.call_sites.append((node.lineno, False))
+
+
+def _expand_closure(region: JitRegion, root: JitFrame) -> None:
+    """Same-module call-graph closure: a helper called from inside the
+    boundary is traced too, with taint mapped through the call
+    arguments."""
+    mod = region.mod
+    worklist = [root]
+    visited: Set[Tuple[str, frozenset, frozenset]] = set()
+    while worklist:
+        frame = worklist.pop()
+        if frame.depth >= 5 or len(region.frames) > 64:
+            continue
+        frame.run()
+        for sub in ast.walk(frame.node):
+            if not isinstance(sub, ast.Call):
+                continue
+            callee_node = _resolve_fn(mod, sub, sub.func)
+            if callee_node is None or isinstance(callee_node, ast.Lambda):
+                continue
+            params = _param_names(callee_node)
+            traced: Set[str] = set()
+            host: Set[str] = set()
+            for i, arg in enumerate(sub.args):
+                if i >= len(params):
+                    break
+                t, h = frame.expr_taint(arg)
+                if t:
+                    traced.add(params[i])
+                if h:
+                    host.add(params[i])
+            for kw in sub.keywords:
+                if kw.arg and kw.arg in params:
+                    t, h = frame.expr_taint(kw.value)
+                    if t:
+                        traced.add(kw.arg)
+                    if h:
+                        host.add(kw.arg)
+            qual = mod.qualname(callee_node)
+            name = getattr(callee_node, "name", "")
+            key = (f"{qual}.{name}", frozenset(traced), frozenset(host))
+            if key in visited:
+                continue
+            visited.add(key)
+            child = JitFrame(region, mod, callee_node, traced, host, frame.depth + 1)
+            child.inherited_bound = frame.bound | frame.inherited_bound
+            region.frames.append(child)
+            worklist.append(child)
+
+
+# ---------------------------------------------------------------------------
+# the taint walker
+# ---------------------------------------------------------------------------
+class _TaintWalker:
+    """Flow-sensitive two-taint evaluator over one frame's body. Records
+    the taint of every expression AT its evaluation point so rules can
+    stay purely structural. Runs the body twice so loop-carried
+    assignments reach their uses."""
+
+    def __init__(self, frame: JitFrame):
+        self.frame = frame
+        self.traced: Set[str] = set(frame.traced_params)
+        self.host: Set[str] = set(frame.host_params)
+        frame.bound.update(_param_names(frame.node))
+
+    def run(self) -> None:
+        body = self.frame.body()
+        for _pass in range(2):
+            for stmt in body:
+                self.exec_stmt(stmt)
+
+    # ---- expressions -----------------------------------------------------
+    def eval(self, node: Optional[ast.AST]) -> Tuple[bool, bool]:
+        if node is None:
+            return (False, False)
+        t = self._eval(node)
+        self.frame.taint_at[id(node)] = t
+        return t
+
+    def _eval(self, node: ast.AST) -> Tuple[bool, bool]:
+        if isinstance(node, ast.Constant):
+            return (False, False)
+        if isinstance(node, ast.Name):
+            return (node.id in self.traced, node.id in self.host)
+        if isinstance(node, ast.Attribute):
+            # x.shape / x.dtype are static at trace time: breaks taint
+            self.eval(node.value)
+            return (False, False)
+        if isinstance(node, ast.Subscript):
+            self.eval(node.slice)
+            if isinstance(node.slice, ast.Slice):
+                for part in (node.slice.lower, node.slice.upper, node.slice.step):
+                    if part is not None:
+                        self.eval(part)
+            return self.eval(node.value)
+        if isinstance(node, ast.Call):
+            self.eval(node.func)
+            arg_t = False
+            arg_h = False
+            for arg in node.args:
+                t, h = self.eval(arg)
+                arg_t, arg_h = arg_t or t, arg_h or h
+            for kw in node.keywords:
+                t, h = self.eval(kw.value)
+                arg_t, arg_h = arg_t or t, arg_h or h
+            name = call_name(node)
+            last = name.rsplit(".", 1)[-1] if name else ""
+            if last in BUCKET_SANITIZERS or last in _CLEAN_CALLS:
+                return (False, False)
+            return (arg_t, arg_h)
+        if isinstance(node, ast.Compare):
+            out = self.eval(node.left)
+            for cmp in node.comparators:
+                t, h = self.eval(cmp)
+                out = (out[0] or t, out[1] or h)
+            if all(
+                isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                for op in node.ops
+            ):
+                # identity checks are static, and membership tests in
+                # engine code are dict-key checks over static python
+                # strings even when the VALUES are traced arrays
+                return (False, False)
+            return out
+        if isinstance(node, (ast.BinOp,)):
+            lt, lh = self.eval(node.left)
+            rt, rh = self.eval(node.right)
+            return (lt or rt, lh or rh)
+        if isinstance(node, ast.UnaryOp):
+            return self.eval(node.operand)
+        if isinstance(node, ast.BoolOp):
+            out = (False, False)
+            for v in node.values:
+                t, h = self.eval(v)
+                out = (out[0] or t, out[1] or h)
+            return out
+        if isinstance(node, ast.IfExp):
+            tt, th = self.eval(node.test)
+            bt, bh = self.eval(node.body)
+            ot, oh = self.eval(node.orelse)
+            return (tt or bt or ot, th or bh or oh)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            out = (False, False)
+            for el in node.elts:
+                t, h = self.eval(el)
+                out = (out[0] or t, out[1] or h)
+            return out
+        if isinstance(node, ast.Dict):
+            out = (False, False)
+            for el in list(node.keys) + list(node.values):
+                if el is None:
+                    continue
+                t, h = self.eval(el)
+                out = (out[0] or t, out[1] or h)
+            return out
+        if isinstance(node, ast.Starred):
+            return self.eval(node.value)
+        if isinstance(node, ast.NamedExpr):
+            t = self.eval(node.value)
+            self._assign(node.target, t)
+            return t
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)):
+            for gen in node.generators:
+                it = self.eval(gen.iter)
+                self._assign(gen.target, it)
+                for cond in gen.ifs:
+                    self.eval(cond)
+            if isinstance(node, ast.DictComp):
+                kt = self.eval(node.key)
+                vt = self.eval(node.value)
+                return (kt[0] or vt[0], kt[1] or vt[1])
+            return self.eval(node.elt)
+        if isinstance(node, (ast.JoinedStr, ast.FormattedValue)):
+            for sub in ast.iter_child_nodes(node):
+                if isinstance(sub, ast.expr):
+                    self.eval(sub)
+            return (False, False)
+        if isinstance(node, ast.Lambda):
+            return (False, False)
+        # fallback: OR over child expressions
+        out = (False, False)
+        for sub in ast.iter_child_nodes(node):
+            if isinstance(sub, ast.expr):
+                t, h = self.eval(sub)
+                out = (out[0] or t, out[1] or h)
+        return out
+
+    # ---- statements ------------------------------------------------------
+    def _assign(self, target: ast.AST, taint: Tuple[bool, bool]) -> None:
+        if isinstance(target, ast.Name):
+            self.frame.bound.add(target.id)
+            (self.traced.add if taint[0] else self.traced.discard)(target.id)
+            (self.host.add if taint[1] else self.host.discard)(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._assign(el, taint)
+        elif isinstance(target, ast.Starred):
+            self._assign(target.value, taint)
+        elif isinstance(target, (ast.Attribute, ast.Subscript)):
+            self.eval(target.value)
+
+    def exec_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.Assign):
+            t = self.eval(stmt.value)
+            for tgt in stmt.targets:
+                self._assign(tgt, t)
+        elif isinstance(stmt, ast.AugAssign):
+            vt = self.eval(stmt.value)
+            ct = self.eval(stmt.target)
+            self._assign(stmt.target, (vt[0] or ct[0], vt[1] or ct[1]))
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._assign(stmt.target, self.eval(stmt.value))
+        elif isinstance(stmt, ast.For):
+            it = self.eval(stmt.iter)
+            self._assign(stmt.target, it)
+            for s in stmt.body + stmt.orelse:
+                self.exec_stmt(s)
+        elif isinstance(stmt, (ast.While, ast.If)):
+            self.eval(stmt.test)
+            for s in stmt.body + stmt.orelse:
+                self.exec_stmt(s)
+        elif isinstance(stmt, ast.With):
+            for item in stmt.items:
+                t = self.eval(item.context_expr)
+                if item.optional_vars is not None:
+                    self._assign(item.optional_vars, t)
+            for s in stmt.body:
+                self.exec_stmt(s)
+        elif isinstance(stmt, (ast.Return, ast.Expr)):
+            self.eval(stmt.value)
+        elif isinstance(stmt, ast.Try):
+            for s in stmt.body:
+                self.exec_stmt(s)
+            for handler in stmt.handlers:
+                if handler.name:
+                    self.frame.bound.add(handler.name)
+                for s in handler.body:
+                    self.exec_stmt(s)
+            for s in stmt.orelse + stmt.finalbody:
+                self.exec_stmt(s)
+        elif isinstance(stmt, _FUNC_NODES):
+            # a nested def is still traced when called: walk its body
+            # with the params unbound (they shadow)
+            self.frame.bound.add(stmt.name)
+            inner = set(_param_names(stmt))
+            saved = (set(self.traced), set(self.host))
+            self.traced -= inner
+            self.host -= inner
+            self.frame.bound.update(inner)
+            for s in stmt.body:
+                self.exec_stmt(s)
+            self.traced, self.host = saved
+        elif isinstance(stmt, (ast.Import, ast.ImportFrom)):
+            for alias in stmt.names:
+                self.frame.bound.add(alias.asname or alias.name.split(".", 1)[0])
+        elif isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    self.traced.discard(tgt.id)
+                    self.host.discard(tgt.id)
+        elif isinstance(stmt, (ast.Raise,)):
+            if stmt.exc is not None:
+                self.eval(stmt.exc)
+        elif isinstance(stmt, ast.Assert):
+            self.eval(stmt.test)
+        # Pass/Break/Continue/Global/Nonlocal/ClassDef: nothing to flow

@@ -1,0 +1,63 @@
+"""Rule model of the JIT-HAZARD linter — the third static-analysis
+plane (codes ``FJX###``). Findings reuse the source linter's
+:class:`~fugue_tpu.analysis.codelint.model.SourceDiagnostic` (same
+``file:line`` + qualname attribution, same baseline match key); the rule
+registry is separate so the FJX sweep and the FLN sweep stay independent
+front doors with independent baselines."""
+
+from typing import Dict, List, Optional, Type
+
+from fugue_tpu.analysis.codelint.model import SourceDiagnostic
+from fugue_tpu.analysis.diagnostics import Severity
+
+
+class JitRule:
+    """One jit-hazard check with a stable ``FJX###`` code. Rules are
+    side-effect free; ``check`` runs over the whole :class:`JitContext`
+    (module set + discovered jit regions + taint), not per file."""
+
+    code: str = "FJX000"
+    severity: Severity = Severity.ERROR
+    description: str = ""
+
+    def check(self, ctx):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def diag(
+        self,
+        message: str,
+        path: str = "",
+        line: int = 0,
+        qualname: str = "",
+        severity: Optional[Severity] = None,
+    ) -> SourceDiagnostic:
+        return SourceDiagnostic(
+            code=self.code,
+            severity=self.severity if severity is None else severity,
+            message=message,
+            path=path,
+            line=line,
+            qualname=qualname,
+            rule=type(self).__name__,
+        )
+
+
+_JIT_RULES: Dict[str, Type[JitRule]] = {}
+
+
+def register_jit_rule(cls: Type[JitRule]) -> Type[JitRule]:
+    """Class decorator: register by stable code (re-registering a code
+    replaces the rule, same contract as the FLN/FWF registries)."""
+    _JIT_RULES[cls.code] = cls
+    return cls
+
+
+def all_jit_rules() -> List[Type[JitRule]]:
+    return [_JIT_RULES[k] for k in sorted(_JIT_RULES)]
+
+
+def registered_jit_codes() -> List[str]:
+    """Stable rule codes, for the baseline completeness check: a
+    baseline entry naming an unregistered FJX code is rot (the rule was
+    renamed/removed) and must be reported, never silently ignored."""
+    return sorted(_JIT_RULES)

@@ -1,0 +1,491 @@
+"""The FJX jit-hazard rules.
+
+Every rule runs over the :class:`~fugue_tpu.analysis.jitlint.boundaries.
+JitContext` — discovered jit regions with taint-annotated frames — and
+emits :class:`SourceDiagnostic` findings. The division of labor with the
+runtime retrace sentinel (:mod:`fugue_tpu.testing.retrace`): these rules
+see hazards *lexically* before any dispatch happens; the sentinel counts
+the retraces that actually occur. Same hazard, two planes.
+
+Codes:
+
+* **FJX201** shape-from-value: a traced value in a shape position is a
+  trace-time crash; a host-varying value there recompiles per distinct
+  value unless laundered through a pow2 bucket.
+* **FJX202** host sync inside jit: ``float()``/``int()``/``bool()``/
+  ``.item()``/``np.asarray`` on a traced value, or python control flow
+  branching on one.
+* **FJX203** dtype promotion: literal ``jnp.array`` without an explicit
+  dtype, and float python literals in arithmetic with traced operands.
+* **FJX204** donation miss: a jitted updater whose return overwrites its
+  own argument at every call site should donate that argument.
+* **FJX205** in-jit side effects: ``print``/``fault_point``/mutation of
+  closed-over state executes at trace time only and is silently absent
+  from the compiled program.
+"""
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from fugue_tpu.analysis.codelint.engine import call_name, dotted_name
+from fugue_tpu.analysis.codelint.model import SourceDiagnostic
+from fugue_tpu.analysis.diagnostics import Severity
+from fugue_tpu.analysis.jitlint.boundaries import JitContext, JitFrame
+from fugue_tpu.analysis.jitlint.model import JitRule, register_jit_rule
+
+#: module-alias prefixes of the array namespaces (distinguishes
+#: ``jnp.reshape(x, shape)`` from the method form ``x.reshape(*shape)``)
+_ARRAY_NAMESPACES = {"jnp", "np", "numpy", "jax.numpy", "lax", "jax.lax", "jax"}
+
+#: host-numpy prefixes: materializing a traced value through these is a
+#: device->host sync (FJX202)
+_HOST_NP = {"np", "numpy", "onp"}
+
+#: fn-last-component -> positional shape-arg indices ("all" = every arg)
+_SHAPE_POSITIONS: Dict[str, object] = {
+    "zeros": (0,),
+    "ones": (0,),
+    "empty": (0,),
+    "full": (0,),
+    "arange": "all",
+    "eye": (0, 1),
+    "resize": (1,),
+    "broadcast_to": (1,),
+    "tile": (1,),
+    "linspace": (2,),
+}
+
+#: kwargs that are shape positions wherever they appear on these calls
+_SHAPE_KWARGS = {"shape", "total_repeat_length", "size", "fill_value_shape"}
+
+_MUTATORS = {
+    "append",
+    "extend",
+    "insert",
+    "remove",
+    "pop",
+    "clear",
+    "update",
+    "add",
+    "setdefault",
+    "popitem",
+    "discard",
+}
+
+
+def _ns_of(name: str) -> Optional[str]:
+    """``jnp.zeros`` -> ``jnp``; bare ``zeros`` -> None."""
+    return name.rsplit(".", 1)[0] if "." in name else None
+
+
+def _shape_exprs(call: ast.Call, name: str) -> List[ast.AST]:
+    """The argument expressions of ``call`` that land in shape
+    positions, or [] when the call doesn't build/reshape arrays."""
+    last = name.rsplit(".", 1)[-1]
+    ns = _ns_of(name)
+    out: List[ast.AST] = []
+    if last == "reshape":
+        if ns in _ARRAY_NAMESPACES:
+            if len(call.args) >= 2:
+                out.append(call.args[1])
+        else:  # method form: every positional arg is a dim
+            out.extend(call.args)
+    elif last == "dynamic_slice":
+        out.extend(call.args[2:])
+    elif last in _SHAPE_POSITIONS:
+        spec = _SHAPE_POSITIONS[last]
+        if spec == "all":
+            out.extend(call.args)
+        else:
+            for i in spec:  # type: ignore[union-attr]
+                if i < len(call.args):
+                    out.append(call.args[i])
+    for kw in call.keywords:
+        if kw.arg in _SHAPE_KWARGS:
+            out.append(kw.value)
+    return out
+
+
+def _frame_calls(frame: JitFrame) -> Iterable[ast.Call]:
+    for node in ast.walk(frame.node):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def _dedup(diags: Iterable[SourceDiagnostic]) -> List[SourceDiagnostic]:
+    seen: Set[Tuple[str, str, int, str]] = set()
+    out: List[SourceDiagnostic] = []
+    for d in diags:
+        key = (d.code, d.path, d.line, d.message[:60])
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(d)
+    return out
+
+
+@register_jit_rule
+class ShapeFromValue(JitRule):
+    code = "FJX201"
+    severity = Severity.ERROR
+    description = (
+        "traced or host-varying value flows into a shape position inside "
+        "a jit boundary (trace-time crash / per-value recompile)"
+    )
+
+    def check(self, ctx: JitContext) -> List[SourceDiagnostic]:
+        out: List[SourceDiagnostic] = []
+        for frame in ctx.iter_frames():
+            mod = frame.mod
+            for call in _frame_calls(frame):
+                name = call_name(call)
+                if name is None:
+                    continue
+                for expr in _shape_exprs(call, name):
+                    traced, host = frame.expr_taint(expr)
+                    if traced:
+                        out.append(
+                            self.diag(
+                                f"traced value in shape position of {name}(): "
+                                "shapes must be concrete at trace time — this "
+                                "raises ConcretizationTypeError; hoist the "
+                                "shape computation out of the jit or make the "
+                                "driving argument static",
+                                path=mod.rel,
+                                line=expr.lineno,
+                                qualname=mod.qualname(call),
+                            )
+                        )
+                    elif host:
+                        out.append(
+                            self.diag(
+                                f"host-varying value in shape position of "
+                                f"{name}(): every distinct value recompiles "
+                                "the program — launder it through a pow2 "
+                                "bucket helper (padded_len/pad_spans/"
+                                "row_bucket) so lengths collapse onto "
+                                "O(log n) programs",
+                                path=mod.rel,
+                                line=expr.lineno,
+                                qualname=mod.qualname(call),
+                            )
+                        )
+            # slice bounds are shape positions too: x[:n] with traced n
+            # fails concretization, host-varying n retraces
+            for node in ast.walk(frame.node):
+                if not isinstance(node, ast.Subscript) or not isinstance(
+                    node.slice, ast.Slice
+                ):
+                    continue
+                for part in (node.slice.lower, node.slice.upper, node.slice.step):
+                    if part is None:
+                        continue
+                    traced, host = frame.expr_taint(part)
+                    if traced:
+                        out.append(
+                            self.diag(
+                                "traced value as a slice bound: static slices "
+                                "need concrete bounds — use "
+                                "lax.dynamic_slice with a bucketed size or a "
+                                "mask instead",
+                                path=mod.rel,
+                                line=part.lineno,
+                                qualname=mod.qualname(node),
+                            )
+                        )
+                    elif host:
+                        out.append(
+                            self.diag(
+                                "host-varying slice bound inside jit: every "
+                                "distinct bound recompiles — bucket it "
+                                "(padded_len/row_bucket) or slice outside "
+                                "the boundary",
+                                path=mod.rel,
+                                line=part.lineno,
+                                qualname=mod.qualname(node),
+                            )
+                        )
+        return _dedup(out)
+
+
+@register_jit_rule
+class HostSyncInJit(JitRule):
+    code = "FJX202"
+    severity = Severity.ERROR
+    description = (
+        "device->host sync inside a jit boundary (float()/int()/.item()/"
+        "np.asarray on a traced value, or python branching on one)"
+    )
+
+    def check(self, ctx: JitContext) -> List[SourceDiagnostic]:
+        out: List[SourceDiagnostic] = []
+        for frame in ctx.iter_frames():
+            mod = frame.mod
+            for call in _frame_calls(frame):
+                name = call_name(call)
+                if name is None:
+                    continue
+                last = name.rsplit(".", 1)[-1]
+                ns = _ns_of(name)
+                if (
+                    name in ("float", "int", "bool")
+                    and call.args
+                    and any(frame.is_traced(a) for a in call.args)
+                ):
+                    out.append(
+                        self.diag(
+                            f"{name}() on a traced value inside jit forces a "
+                            "device sync at trace time (and fails under "
+                            "abstract tracing) — keep it as a jnp scalar or "
+                            "compute it outside the boundary",
+                            path=mod.rel,
+                            line=call.lineno,
+                            qualname=mod.qualname(call),
+                        )
+                    )
+                elif last in ("item", "tolist") and isinstance(
+                    call.func, ast.Attribute
+                ):
+                    if frame.is_traced(call.func.value):
+                        out.append(
+                            self.diag(
+                                f".{last}() on a traced value inside jit is a "
+                                "host materialization — it cannot execute "
+                                "under tracing; return the array and read it "
+                                "outside the boundary",
+                                path=mod.rel,
+                                line=call.lineno,
+                                qualname=mod.qualname(call),
+                            )
+                        )
+                elif (
+                    ns in _HOST_NP
+                    and last in ("asarray", "array")
+                    and any(frame.is_traced(a) for a in call.args)
+                ):
+                    out.append(
+                        self.diag(
+                            f"{name}() on a traced value inside jit pulls the "
+                            "array to host numpy — use jnp and keep the value "
+                            "on device",
+                            path=mod.rel,
+                            line=call.lineno,
+                            qualname=mod.qualname(call),
+                        )
+                    )
+            for node in ast.walk(frame.node):
+                if isinstance(node, (ast.If, ast.While)) and frame.is_traced(
+                    node.test
+                ):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    out.append(
+                        self.diag(
+                            f"python `{kind}` on a traced value inside jit: "
+                            "abstract tracers have no truth value — use "
+                            "jnp.where / lax.cond / lax.while_loop",
+                            path=mod.rel,
+                            line=node.lineno,
+                            qualname=mod.qualname(node),
+                        )
+                    )
+                elif isinstance(node, ast.Assert) and frame.is_traced(node.test):
+                    out.append(
+                        self.diag(
+                            "assert on a traced value inside jit branches on "
+                            "a tracer — use checkify or move the check "
+                            "outside the boundary",
+                            path=mod.rel,
+                            line=node.lineno,
+                            qualname=mod.qualname(node),
+                        )
+                    )
+        return _dedup(out)
+
+
+def _literal_float(node: ast.AST) -> bool:
+    """True when the expression is (a nest of) python literals containing
+    at least one float — the implicit-dtype hazard case."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, float)
+    if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+        return all(_literal_only(el) for el in node.elts) and any(
+            _literal_float(el) for el in node.elts
+        )
+    if isinstance(node, ast.UnaryOp):
+        return _literal_float(node.operand)
+    return False
+
+
+def _literal_only(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+        return all(_literal_only(el) for el in node.elts)
+    if isinstance(node, ast.UnaryOp):
+        return _literal_only(node.operand)
+    return False
+
+
+@register_jit_rule
+class DtypePromotion(JitRule):
+    code = "FJX203"
+    severity = Severity.ERROR
+    description = (
+        "dtype-promotion hazard inside a jit boundary (literal jnp.array "
+        "without dtype; float literal arithmetic with traced operands)"
+    )
+
+    def check(self, ctx: JitContext) -> List[SourceDiagnostic]:
+        out: List[SourceDiagnostic] = []
+        for frame in ctx.iter_frames():
+            mod = frame.mod
+            for call in _frame_calls(frame):
+                name = call_name(call)
+                if name is None:
+                    continue
+                last = name.rsplit(".", 1)[-1]
+                ns = _ns_of(name)
+                if (
+                    ns in ("jnp", "jax.numpy")
+                    and last in ("array", "asarray")
+                    and call.args
+                    and _literal_float(call.args[0])
+                    and not any(kw.arg == "dtype" for kw in call.keywords)
+                ):
+                    out.append(
+                        self.diag(
+                            f"{name}() over float literals without an "
+                            "explicit dtype inside jit: the result is "
+                            "weakly-typed and its width follows the x64 "
+                            "flag — pass dtype= so programs hash identically "
+                            "across configurations",
+                            path=mod.rel,
+                            line=call.lineno,
+                            qualname=mod.qualname(call),
+                        )
+                    )
+            for node in ast.walk(frame.node):
+                if not isinstance(node, ast.BinOp):
+                    continue
+                for lit, other in (
+                    (node.left, node.right),
+                    (node.right, node.left),
+                ):
+                    if (
+                        isinstance(lit, ast.Constant)
+                        and isinstance(lit.value, float)
+                        and frame.is_traced(other)
+                    ):
+                        out.append(
+                            self.diag(
+                                "float python literal in arithmetic with a "
+                                "traced operand: integer operands promote to "
+                                "weak float — pin the dtype (jnp.float32("
+                                "...)) if the promotion is intended",
+                                path=mod.rel,
+                                line=node.lineno,
+                                qualname=mod.qualname(node),
+                                severity=Severity.WARN,
+                            )
+                        )
+                        break
+        return _dedup(out)
+
+
+@register_jit_rule
+class DonationMiss(JitRule):
+    code = "FJX204"
+    severity = Severity.ERROR
+    description = (
+        "jitted updater overwritten by its own return at every call site "
+        "without donate_argnums (double-buffers the state)"
+    )
+
+    def check(self, ctx: JitContext) -> List[SourceDiagnostic]:
+        out: List[SourceDiagnostic] = []
+        for b in ctx.bindings:
+            if b.kind != "jax.jit" or b.donated:
+                continue
+            if not b.call_sites:
+                continue
+            if all(overwrite for _, overwrite in b.call_sites):
+                sites = ", ".join(str(line) for line, _ in b.call_sites[:4])
+                out.append(
+                    self.diag(
+                        f"jitted updater '{b.target}' is overwritten by its "
+                        f"own return at every call site (line {sites}): pass "
+                        "donate_argnums=0 so XLA reuses the input buffer "
+                        "instead of double-buffering the state",
+                        path=b.mod.rel,
+                        line=b.line,
+                        qualname=b.qualname,
+                    )
+                )
+        return _dedup(out)
+
+
+@register_jit_rule
+class InJitSideEffects(JitRule):
+    code = "FJX205"
+    severity = Severity.ERROR
+    description = (
+        "side effect inside a jit boundary (print/fault_point/mutation of "
+        "closed-over state) executes at trace time only"
+    )
+
+    def check(self, ctx: JitContext) -> List[SourceDiagnostic]:
+        out: List[SourceDiagnostic] = []
+        for frame in ctx.iter_frames():
+            mod = frame.mod
+            for call in _frame_calls(frame):
+                name = call_name(call)
+                if name is None:
+                    continue
+                last = name.rsplit(".", 1)[-1]
+                if name in ("print", "breakpoint"):
+                    out.append(
+                        self.diag(
+                            f"{name}() inside jit runs at trace time only — "
+                            "silent on every cached dispatch; use "
+                            "jax.debug.print for traced values",
+                            path=mod.rel,
+                            line=call.lineno,
+                            qualname=mod.qualname(call),
+                        )
+                    )
+                elif last == "fault_point":
+                    out.append(
+                        self.diag(
+                            "fault_point() inside a traced program fires at "
+                            "trace time only and is absent from the compiled "
+                            "executable — hoist the hook to the dispatch "
+                            "site",
+                            path=mod.rel,
+                            line=call.lineno,
+                            qualname=mod.qualname(call),
+                        )
+                    )
+                elif (
+                    last in _MUTATORS
+                    and isinstance(call.func, ast.Attribute)
+                ):
+                    base = call.func.value
+                    while isinstance(base, ast.Attribute):
+                        base = base.value
+                    if (
+                        isinstance(base, ast.Name)
+                        and base.id not in frame.bound
+                        and base.id not in frame.inherited_bound
+                    ):
+                        out.append(
+                            self.diag(
+                                f"mutation of closed-over '{base.id}' inside "
+                                "jit happens once at trace time and never "
+                                "again on cached dispatches — return the new "
+                                "value instead",
+                                path=mod.rel,
+                                line=call.lineno,
+                                qualname=mod.qualname(call),
+                            )
+                        )
+        return _dedup(out)

@@ -113,6 +113,11 @@ FUGUE_CONF_OPTIMIZE_CACHE_MAX_RESULT_BYTES = (
 FUGUE_CONF_OPTIMIZE_CACHE_DIR = "fugue.optimize.cache.dir"
 FUGUE_CONF_SERVE_RESULT_CACHE = "fugue.serve.result_cache"
 FUGUE_CONF_DEBUG_LOCK_SANITIZER = "fugue.debug.lock_sanitizer"
+FUGUE_CONF_DEBUG_RETRACE_SENTINEL = "fugue.debug.retrace_sentinel"
+FUGUE_CONF_DEBUG_RETRACE_SENTINEL_MAX_TRACES = (
+    "fugue.debug.retrace_sentinel.max_traces"
+)
+FUGUE_CONF_DEBUG_RETRACE_SENTINEL_RAISE = "fugue.debug.retrace_sentinel.raise"
 FUGUE_CONF_OBS_ENABLED = "fugue.obs.enabled"
 FUGUE_CONF_OBS_TRACE_PATH = "fugue.obs.trace_path"
 FUGUE_CONF_OBS_SLOW_QUERY_MS = "fugue.obs.slow_query_ms"
@@ -1072,6 +1077,39 @@ def _declare_defaults() -> None:
         False,
         "debug lock-order sanitizer: wrap locks created after arming and "
         "report acquisition-order inversions (off = zero overhead)",
+        in_defaults=False,
+    )
+    # runtime retrace sentinel (testing/retrace.py): debug-only twin of
+    # the static FJX jit-hazard lint plane (analysis/jitlint). Off (the
+    # default), every dispatch pays one module-global read. On, each
+    # ACTUAL XLA trace of an engine program is counted per program key;
+    # exceeding the budget logs (or raises) a report carrying the Python
+    # callsite and the differing argument aval. Consumed by the serving
+    # daemon at start and by tests; module-owned, not seeded.
+    r(
+        FUGUE_CONF_DEBUG_RETRACE_SENTINEL,
+        bool,
+        False,
+        "debug retrace sentinel: count XLA traces per jitted program key "
+        "and report programs exceeding the trace budget with callsite + "
+        "differing aval (off = zero overhead)",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_DEBUG_RETRACE_SENTINEL_MAX_TRACES,
+        int,
+        4,
+        "trace budget per jitted program key before the retrace sentinel "
+        "reports a violation (only read when the sentinel is armed)",
+        in_defaults=False,
+    )
+    r(
+        FUGUE_CONF_DEBUG_RETRACE_SENTINEL_RAISE,
+        bool,
+        False,
+        "raise RetraceBudgetExceeded on a retrace-sentinel violation "
+        "instead of logging it (CI benches die at the first unstable "
+        "program)",
         in_defaults=False,
     )
 

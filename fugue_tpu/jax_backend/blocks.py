@@ -44,6 +44,7 @@ import pyarrow as pa
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from fugue_tpu.schema import Schema
+from fugue_tpu.testing.retrace import active_retrace_sentinel
 from fugue_tpu.utils.assertion import assert_or_throw
 
 _EPOCH = np.datetime64(0, "us")
@@ -183,7 +184,42 @@ def jit_row_sharded(mesh: Mesh, key: Any, fn: Any) -> Any:
     if prog is None:
         prog = jax.jit(fn, out_shardings=row_sharding(mesh))
         per_mesh[key] = prog
-    return prog
+    san = active_retrace_sentinel()
+    if san is None:
+        return prog
+    return _sentineled_dispatch(san, key, prog)
+
+
+def _sentineled_dispatch(san: Any, key: Any, prog: Any) -> Any:
+    """Retrace-sentinel shim over one row-sharded program: a dispatch
+    that grew jax's per-shape cache was an actual XLA trace, counted
+    against the program key's budget. Only ever constructed while the
+    debug sentinel is armed — the disarmed path returns the raw jitted
+    handle untouched."""
+    name = "row_sharded:" + (
+        str(key[0]) if isinstance(key, tuple) and key else str(key)
+    )
+
+    def _watched(*args: Any, **kwargs: Any) -> Any:
+        sizer = getattr(prog, "_cache_size", None)
+        before = -1
+        if sizer is not None:
+            try:
+                before = sizer()
+            except Exception:  # pragma: no cover - jax version drift
+                sizer = None
+        out = prog(*args, **kwargs)
+        if sizer is not None:
+            try:
+                traced = sizer() > before
+            except Exception:  # pragma: no cover
+                traced = False
+            if traced:
+                ev = san.note_trace(name, key, args)
+                san.raise_if_armed(ev)
+        return out
+
+    return _watched
 
 
 def on_mesh(mesh: Mesh) -> Any:

@@ -54,6 +54,7 @@ from fugue_tpu.exceptions import DeviceLostError
 from fugue_tpu.lake import format as _lake_io
 from fugue_tpu.obs.trace import start_span
 from fugue_tpu.testing.locktrace import tracked_lock
+from fugue_tpu.testing.retrace import active_retrace_sentinel
 from fugue_tpu.execution.execution_engine import (
     ExecutionEngine,
     MapEngine,
@@ -685,6 +686,15 @@ class JaxExecutionEngine(ExecutionEngine):
         )
         self._persist_ok = _m_persist.labels(result="ok")
         self._persist_err = _m_persist.labels(result="error")
+        # retrace-sentinel violations per program (the runtime twin of
+        # the FJX jit-hazard lint plane): only ever incremented while
+        # the debug sentinel is armed — a standing zero in production
+        self._m_retrace = self.metrics.counter(
+            "fugue_engine_retrace_sentinel_total",
+            "jitted programs that exceeded the armed retrace sentinel's "
+            "trace budget (fugue.debug.retrace_sentinel.max_traces)",
+            ["program"],
+        )
         # compile/execute/disk-load wall clock split of every jitted
         # dispatch since construction — the daemon's time_to_first_query
         # phase report reads deltas of this
@@ -2337,11 +2347,20 @@ class JaxExecutionEngine(ExecutionEngine):
             )
         return jres
 
-    def _jit_cached(self, key: Any, fn: Callable) -> Callable:
+    def _jit_cached(
+        self, key: Any, fn: Callable, static_argnums: Any = None
+    ) -> Callable:
         """Per-engine jit cache: logical programs (aggregate plans, map fns,
         filters) are keyed by structure so repeated queries reuse the
         compiled executable. Keys never include row counts — those enter
         programs as traced scalars/masks.
+
+        ``static_argnums`` passes through to ``jax.jit``; a static-arg
+        program bypasses the disk tier (the exec-cache signature scheme is
+        value-independent for host scalars, and an AOT executable is
+        compiled for ONE static value — serving another would be wrong).
+        Every distinct static value is a fresh trace, which the retrace
+        sentinel counts against the program's budget like any other.
 
         Each call records (fn, arg avals) in the program log so
         ``program_cost_analysis`` can AOT-lower the exact program later and
@@ -2362,24 +2381,29 @@ class JaxExecutionEngine(ExecutionEngine):
         global_key = (self._plan_sig, key)
         jitted = self._plan_cache.get_program(global_key)
         if jitted is None:
-            jitted = jax.jit(fn)
+            jitted = (
+                jax.jit(fn)
+                if static_argnums is None
+                else jax.jit(fn, static_argnums=static_argnums)
+            )
             self._plan_cache.put_program(global_key, jitted)
             self._plan_misses.inc()
         else:
             self._plan_hits.inc()
         name = str(key[0]) if isinstance(key, tuple) and key else str(key)
+        disk_ok = self._exec_enabled and static_argnums is None
 
         def _wrapped(
             *args: Any, _j: Any = jitted, _f: Callable = fn, _k: Any = key,
-            _n: str = name,
+            _n: str = name, _disk: bool = disk_ok,
         ) -> Any:
             if self._program_log_armed:
                 self._program_log[_k] = (
                     _f, jax.tree_util.tree_map(_as_aval, args)
                 )
-            if self._exec_enabled:
+            if _disk:
                 return self._dispatch_with_disk_tier(_j, _f, _k, _n, args)
-            return self._traced_dispatch(_j, _n, args)
+            return self._traced_dispatch(_j, _n, args, key=_k)
 
         cache[key] = _wrapped
         return _wrapped
@@ -2402,7 +2426,7 @@ class JaxExecutionEngine(ExecutionEngine):
         if sig is None:
             # a leaf the signature scheme does not model (host object,
             # uncommitted np array): the disk tier skips this program
-            return self._traced_dispatch(jitted, name, args)
+            return self._traced_dispatch(jitted, name, args, key=key)
         # the key folds the cache BASE URI (the probe/compiled/persist
         # bookkeeping describes one disk's state — two same-signature
         # engines pointed at different dirs must not starve each other)
@@ -2456,6 +2480,7 @@ class JaxExecutionEngine(ExecutionEngine):
         return self._traced_dispatch(
             jitted, name, args,
             persist=(key, fn, sig, exec_key) if want_persist else None,
+            key=key,
         )
 
     def _load_executable(
@@ -2563,7 +2588,8 @@ class JaxExecutionEngine(ExecutionEngine):
         return loaded
 
     def _traced_dispatch(
-        self, jitted: Any, name: str, args: Any, persist: Any = None
+        self, jitted: Any, name: str, args: Any, persist: Any = None,
+        key: Any = None,
     ) -> Any:
         """One jitted-program dispatch under the compile/execute span
         split. Whether THIS dispatch compiled is read from jax's own
@@ -2574,7 +2600,11 @@ class JaxExecutionEngine(ExecutionEngine):
 
         ``persist`` (set by the disk-tier dispatch path) is the
         ``(key, fn, sig, exec_key)`` needed to background-persist the
-        executable this dispatch is about to compile."""
+        executable this dispatch is about to compile.
+
+        ``key`` is the logical program key for the retrace sentinel's
+        per-program trace accounting (None for unkeyed dispatches —
+        counted under the program name alone)."""
         sizer = getattr(jitted, "_cache_size", None)
         before = -1
         if sizer is not None:
@@ -2593,6 +2623,16 @@ class JaxExecutionEngine(ExecutionEngine):
                     pass
             if compiled:
                 self._compile_misses.inc()
+                # retrace sentinel (debug twin of the FJX lint plane):
+                # every ACTUAL trace is counted per program key; past the
+                # budget the sentinel reports callsite + differing aval.
+                # Off (the default) this is one module-global read.
+                san = active_retrace_sentinel()
+                if san is not None:
+                    ev = san.note_trace(name, key, args)
+                    if ev is not None:
+                        self._m_retrace.labels(program=name).inc()
+                        san.raise_if_armed(ev)
             else:
                 self._compile_hits.inc()
             if sp:

@@ -145,6 +145,13 @@ from fugue_tpu.testing.locktrace import (
     maybe_enable_from_conf,
     tracked_lock,
 )
+from fugue_tpu.testing.retrace import (
+    active_retrace_sentinel,
+    disable_retrace_sentinel,
+)
+from fugue_tpu.testing.retrace import (
+    maybe_enable_from_conf as retrace_enable_from_conf,
+)
 from fugue_tpu.utils.params import ParamDict
 
 _RESULT_YIELD = "serve_result"
@@ -203,6 +210,15 @@ class ServeDaemon:
         self._owns_sanitizer = (
             active_sanitizer() is None
             and maybe_enable_from_conf(ParamDict(conf)) is not None
+        )
+        # debug retrace sentinel: same arming parity — conf-armed BEFORE
+        # the engine exists so the very first dispatch is watched, and
+        # owned arming is disarmed on stop()/_hard_kill() so a later
+        # same-process daemon without the flag runs unwatched instead of
+        # reporting into this daemon's dead scope
+        self._owns_retrace_sentinel = (
+            active_retrace_sentinel() is None
+            and retrace_enable_from_conf(ParamDict(conf)) is not None
         )
         self._engine = make_execution_engine(engine, ParamDict(conf))
         econf = self._engine.conf
@@ -767,6 +783,9 @@ class ServeDaemon:
         if self._owns_sanitizer:
             disable_lock_sanitizer()
             self._owns_sanitizer = False
+        if self._owns_retrace_sentinel:
+            disable_retrace_sentinel()
+            self._owns_retrace_sentinel = False
 
     def _join_prewarm(self) -> None:
         """A stopping daemon must not leave the warm thread touching a
@@ -812,11 +831,14 @@ class ServeDaemon:
         self._sessions.shutdown()  # drops catalog copies, keeps journal
         self._engine.release()
         self._health.transition(STOPPED)
-        # even the kill path disarms an owned sanitizer: a restarted
-        # in-process daemon must not report into this dead scope
+        # even the kill path disarms an owned sanitizer/sentinel: a
+        # restarted in-process daemon must not report into this dead scope
         if self._owns_sanitizer:
             disable_lock_sanitizer()
             self._owns_sanitizer = False
+        if self._owns_retrace_sentinel:
+            disable_retrace_sentinel()
+            self._owns_retrace_sentinel = False
 
     def __enter__(self) -> "ServeDaemon":
         return self.start()

@@ -1,0 +1,304 @@
+"""Runtime retrace sentinel (the dynamic half of the jit-hazard
+correctness plane; the static half is :mod:`fugue_tpu.analysis.jitlint`).
+
+The engine's central perf contract — "one XLA trace per logical program,
+zero recompiles on the warm path" — is asserted by bench gates
+(``zero_recompile_warm``) and streaming counters, but those only say *how
+many* compiles happened, never *which program* retraced or *why*. The
+sentinel closes that gap: armed (conf ``fugue.debug.retrace_sentinel``,
+or :func:`retrace_sentinel` in tests), every jitted dispatch that XLA
+actually re-traced — detected the same way the engine's compile counters
+are, via per-shape cache growth — records a per-program-key trace count
+plus the argument-aval signature of that trace. When one program key
+exceeds ``fugue.debug.retrace_sentinel.max_traces`` the sentinel emits a
+:class:`RetraceViolation` carrying:
+
+- the **Python callsite** of the offending dispatch (engine frames
+  stripped, like the lock sanitizer's reports);
+- the **differing aval**: the first argument leaf whose shape/dtype (or
+  host-scalar value — a Python int folded into a trace) changed between
+  the previous trace and this one — the concrete retrace generator the
+  static FJX201/FJX202 rules hunt for at lint time.
+
+Violations are recorded and logged by default; conf
+``fugue.debug.retrace_sentinel.raise`` upgrades them to
+:class:`RetraceBudgetExceeded` so a CI bench dies at the first unstable
+program instead of three PRs later. The engine exports violation counts
+as ``fugue_engine_retrace_sentinel_total{program=...}``.
+
+Disabled (the default, and the only mode production runs), the per-
+dispatch cost is one module-global read on an already-compiled path:
+nothing is wrapped, nothing retained — the same zero-overhead-off
+contract as :mod:`fugue_tpu.testing.locktrace`.
+"""
+
+import logging
+import threading
+import traceback
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from fugue_tpu.constants import (
+    FUGUE_CONF_DEBUG_RETRACE_SENTINEL,
+    FUGUE_CONF_DEBUG_RETRACE_SENTINEL_MAX_TRACES,
+    FUGUE_CONF_DEBUG_RETRACE_SENTINEL_RAISE,
+    typed_conf_get,
+)
+
+_LOG = logging.getLogger("fugue_tpu.retrace")
+
+_ACTIVE: Optional["RetraceSentinel"] = None
+_ACTIVE_GUARD = threading.Lock()
+
+#: frames from these files are the dispatch plumbing, not the caller
+_PLUMBING_SUFFIXES = (
+    "/testing/retrace.py",
+    "/jax_backend/execution_engine.py",
+    "/jax_backend/blocks.py",
+)
+
+
+class RetraceBudgetExceeded(RuntimeError):
+    """Raised (conf ``fugue.debug.retrace_sentinel.raise``) when a jitted
+    program exceeds its trace budget; the message IS the full report."""
+
+
+def _callsite(limit: int = 8) -> List[str]:
+    """The dispatching frames, innermost last, with the sentinel's and
+    the engine dispatch plumbing's own frames stripped — the report must
+    point at the *user* code whose inputs are shape-unstable."""
+    out: List[str] = []
+    for fs in traceback.extract_stack()[:-1]:
+        if fs.filename.replace("\\", "/").endswith(_PLUMBING_SUFFIXES):
+            continue
+        out.append(f"{fs.filename}:{fs.lineno} in {fs.name}")
+    return out[-limit:]
+
+
+def _leaf_sig(x: Any) -> str:
+    """One argument leaf's trace-identity: shape/dtype for arrays, the
+    concrete value for host scalars (a changing Python int IS a new
+    trace — jax hashes it into the program when static, and even traced
+    weak scalars betray a host-side fold when their dtype flips)."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        dims = ",".join(str(d) for d in shape)
+        return f"{dtype}[{dims}]"
+    if isinstance(x, (bool, int, float, complex, str, bytes, type(None))):
+        r = repr(x)
+        return f"py:{type(x).__name__}:{r[:40]}"
+    return f"obj:{type(x).__name__}"
+
+
+def args_signature(args: Any) -> Tuple[str, ...]:
+    """Flattened per-leaf aval signature of one dispatch's arguments."""
+    try:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(args)
+    except Exception:  # pragma: no cover - jax always present in-repo
+        leaves = list(args)
+    return tuple(_leaf_sig(leaf) for leaf in leaves)
+
+
+def diff_signatures(
+    prev: Tuple[str, ...], new: Tuple[str, ...]
+) -> List[str]:
+    """Human-readable per-leaf differences between two trace signatures
+    (the 'differing aval' of the report)."""
+    out: List[str] = []
+    if len(prev) != len(new):
+        out.append(f"arg count: {len(prev)} -> {len(new)} leaves")
+    for i, (p, n) in enumerate(zip(prev, new)):
+        if p != n:
+            out.append(f"arg leaf {i}: {p} -> {n}")
+    return out
+
+
+class RetraceViolation:
+    """One program key that exceeded its trace budget: the count, the
+    dispatching Python callsite, and the aval diff vs the prior trace."""
+
+    def __init__(
+        self,
+        program: str,
+        key: Any,
+        traces: int,
+        max_traces: int,
+        callsite: List[str],
+        diff: List[str],
+    ):
+        self.program = program
+        self.key = key
+        self.traces = traces
+        self.max_traces = max_traces
+        self.callsite = callsite
+        self.diff = diff
+
+    def describe(self) -> str:
+        lines = [
+            f"retrace sentinel: program '{self.program}' traced "
+            f"{self.traces} times (budget: {self.max_traces}) — a warm "
+            "path must reuse ONE trace; an unstable shape/dtype or a "
+            "host value folded into the program is forcing recompiles",
+            "  differing aval vs previous trace:",
+            *(
+                ("    " + d for d in self.diff)
+                if self.diff
+                else ("    (first recorded trace for this key)",)
+            ),
+            "  dispatched from:",
+            *("    " + s for s in self.callsite),
+        ]
+        return "\n".join(lines)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"RetraceViolation({self.program!r}, traces={self.traces})"
+
+
+class _ProgramRecord:
+    __slots__ = ("traces", "signature")
+
+    def __init__(self) -> None:
+        self.traces = 0
+        self.signature: Optional[Tuple[str, ...]] = None
+
+
+class RetraceSentinel:
+    """Per-scope collector: per-program-key trace counts, last-trace aval
+    signatures, and the violations found. ``note_trace`` is called by the
+    engine/blocks dispatch paths only when a dispatch ACTUALLY compiled
+    (per-shape cache growth), so counts are XLA's own, not a guess."""
+
+    def __init__(
+        self, max_traces: int = 4, raise_on_violation: bool = False
+    ) -> None:
+        self._guard = threading.Lock()
+        self.max_traces = int(max_traces)
+        self.raise_on_violation = bool(raise_on_violation)
+        self._programs: Dict[Any, _ProgramRecord] = {}
+        self.violations: List[RetraceViolation] = []
+
+    # ---- recording -------------------------------------------------------
+    def note_trace(
+        self, program: str, key: Any, args: Any
+    ) -> Optional[RetraceViolation]:
+        """Record one fresh trace of ``(program, key)``; returns the
+        violation when this trace exceeded the budget (already recorded
+        and logged — the caller decides metrics and raising via
+        :meth:`raise_if_armed`). Never raises itself."""
+        sig = args_signature(args)
+        try:
+            record_key: Any = (program, key)
+            hash(record_key)
+        except TypeError:  # unhashable program key: fall back to name
+            record_key = (program, None)
+        with self._guard:
+            rec = self._programs.get(record_key)
+            if rec is None:
+                rec = self._programs[record_key] = _ProgramRecord()
+            rec.traces += 1
+            prev, rec.signature = rec.signature, sig
+            if rec.traces <= self.max_traces:
+                return None
+            violation = RetraceViolation(
+                program=program,
+                key=key,
+                traces=rec.traces,
+                max_traces=self.max_traces,
+                callsite=_callsite(),
+                diff=diff_signatures(prev, sig) if prev is not None else [],
+            )
+            self.violations.append(violation)
+        _LOG.warning("fugue_tpu %s", violation.describe())
+        return violation
+
+    def raise_if_armed(self, violation: Optional[RetraceViolation]) -> None:
+        if violation is not None and self.raise_on_violation:
+            raise RetraceBudgetExceeded(violation.describe())
+
+    # ---- introspection ---------------------------------------------------
+    def trace_counts(self) -> Dict[str, int]:
+        """Per-program total trace counts (keys collapsed to the program
+        name — the report/metrics vocabulary)."""
+        with self._guard:
+            out: Dict[str, int] = {}
+            for (program, _), rec in self._programs.items():
+                out[program] = out.get(program, 0) + rec.traces
+            return out
+
+    def report(self) -> str:
+        with self._guard:
+            violations = list(self.violations)
+        if not violations:
+            return "retrace sentinel: no trace-budget violations"
+        return "\n".join(v.describe() for v in violations)
+
+
+def active_retrace_sentinel() -> Optional[RetraceSentinel]:
+    return _ACTIVE
+
+
+def enable_retrace_sentinel(
+    max_traces: int = 4, raise_on_violation: bool = False
+) -> RetraceSentinel:
+    """Arm a process-wide sentinel (idempotent: an already-armed one is
+    returned unchanged — first armer wins, mirroring the lock
+    sanitizer). Arm BEFORE the dispatches under test run."""
+    global _ACTIVE
+    with _ACTIVE_GUARD:
+        if _ACTIVE is None:
+            _ACTIVE = RetraceSentinel(
+                max_traces=max_traces, raise_on_violation=raise_on_violation
+            )
+        return _ACTIVE
+
+
+def disable_retrace_sentinel() -> None:
+    global _ACTIVE
+    with _ACTIVE_GUARD:
+        _ACTIVE = None
+
+
+@contextmanager
+def retrace_sentinel(
+    max_traces: int = 4, raise_on_violation: bool = False
+) -> Iterator[RetraceSentinel]:
+    """Test scope: arm for the block, disarm after. The yielded sentinel
+    keeps its counts/violations readable after exit."""
+    san = enable_retrace_sentinel(
+        max_traces=max_traces, raise_on_violation=raise_on_violation
+    )
+    try:
+        yield san
+    finally:
+        disable_retrace_sentinel()
+
+
+def maybe_enable_from_conf(conf: Any) -> Optional[RetraceSentinel]:
+    """Conf-driven arming (``fugue.debug.retrace_sentinel``): long-lived
+    owners (the serving daemon) call this before constructing their
+    engine so the first dispatch is already watched. Off (the default)
+    touches nothing and returns None."""
+    try:
+        enabled = typed_conf_get(conf, FUGUE_CONF_DEBUG_RETRACE_SENTINEL)
+    except Exception:
+        enabled = False
+    if not enabled:
+        return None
+    try:
+        max_traces = typed_conf_get(
+            conf, FUGUE_CONF_DEBUG_RETRACE_SENTINEL_MAX_TRACES
+        )
+    except Exception:
+        max_traces = 4
+    try:
+        raise_on = typed_conf_get(
+            conf, FUGUE_CONF_DEBUG_RETRACE_SENTINEL_RAISE
+        )
+    except Exception:
+        raise_on = False
+    return enable_retrace_sentinel(
+        max_traces=int(max_traces), raise_on_violation=bool(raise_on)
+    )

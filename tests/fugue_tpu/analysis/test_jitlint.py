@@ -1,0 +1,417 @@
+"""Jit-hazard linter (FJX) gate + rule corpus.
+
+Mirrors the FLN suite's contract: the live-tree test IS the
+self-enforcing gate (the shipped fugue_tpu package must jit-lint to zero
+unbaselined FJX errors, every baseline entry justified AND still
+matching), then a fixture corpus triggers every FJX rule with its
+expected code/severity/file:line/qualname — including the negatives the
+taint model promises: pow2-bucket laundering, program-key laundering,
+identity/membership tests, trace-local accumulation."""
+
+import pytest
+
+from fugue_tpu.analysis import Severity
+from fugue_tpu.analysis.jitlint import (
+    all_jit_rules,
+    lint_text_jit,
+    lint_tree_jit,
+    registered_jit_codes,
+)
+from fugue_tpu.analysis.jitlint.baseline import (
+    apply_baseline,
+    load_jit_baseline,
+    stale_jit_diags,
+)
+
+pytestmark = [pytest.mark.analysis, pytest.mark.jitlint]
+
+
+def _codes(diags):
+    return [d.code for d in diags]
+
+
+def _find(diags, code):
+    hits = [d for d in diags if d.code == code]
+    assert hits, f"no {code} in {_codes(diags)}"
+    return hits
+
+
+def _line_of(src, needle):
+    for i, line in enumerate(src.splitlines(), 1):
+        if needle in line:
+            return i
+    raise AssertionError(f"{needle!r} not in fixture")
+
+
+# ---------------------------------------------------------------------------
+# the self-enforcing gate
+# ---------------------------------------------------------------------------
+def test_live_tree_jit_lints_clean_with_justified_baseline():
+    entries, problems = load_jit_baseline()
+    assert problems == [], [str(p) for p in problems]
+    assert all(e.justification for e in entries)
+    diags = lint_tree_jit()
+    kept, suppressed, stale = apply_baseline(diags, entries)
+    errors = [d for d in kept if d.severity is Severity.ERROR]
+    assert errors == [], "unbaselined FJX errors:\n" + "\n".join(
+        d.describe() for d in errors
+    )
+    # the baseline can only shrink: every entry still matches a finding
+    assert stale == [], [f"{e.code} {e.file}" for e in stale]
+    # and it is not a blanket waiver: each entry suppresses something real
+    assert len(suppressed) >= len(entries)
+
+
+def test_rule_registry_metadata():
+    rules = all_jit_rules()
+    assert {r.code for r in rules} == {
+        "FJX201", "FJX202", "FJX203", "FJX204", "FJX205",
+    }
+    assert registered_jit_codes() == [
+        "FJX201", "FJX202", "FJX203", "FJX204", "FJX205",
+    ]
+    for r in rules:
+        assert r.code.startswith("FJX") and len(r.code) == 6
+        assert r.description
+        assert r.severity is Severity.ERROR
+
+
+# ---------------------------------------------------------------------------
+# FJX201: shape-from-value
+# ---------------------------------------------------------------------------
+def test_fjx201_traced_shape_is_a_trace_time_crash():
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def outer():\n"
+        "    def _prog(x, n):\n"
+        "        return jnp.zeros((n,)) + x\n"
+        "    return jax.jit(_prog)\n"
+    )
+    d = _find(lint_text_jit(src), "FJX201")[0]
+    assert d.severity is Severity.ERROR
+    assert d.line == _line_of(src, "jnp.zeros")
+    assert d.qualname == "outer._prog"
+    assert "traced value in shape position" in d.message
+
+
+def test_fjx201_static_argnum_shape_recompiles_per_value():
+    # the acceptance fixture's static hazard: a static_argnums parameter
+    # driving a shape — each distinct value is a fresh program (the
+    # runtime twin counts the same retraces in
+    # test_retrace_sentinel.py::test_two_planes_catch_the_same_hazard)
+    src = (
+        "import jax.numpy as jnp\n"
+        "def outer(engine):\n"
+        "    def _prog(x, n):\n"
+        "        return jnp.resize(x, (n,))\n"
+        "    return engine._jit_cached(('p', 1), _prog, static_argnums=(1,))\n"
+    )
+    d = _find(lint_text_jit(src), "FJX201")[0]
+    assert d.line == _line_of(src, "jnp.resize")
+    assert d.qualname == "outer._prog"
+    assert "recompiles" in d.message and "pow2" in d.message
+
+
+def test_fjx201_closure_capture_of_outer_param():
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def outer(rows):\n"
+        "    def _make(x):\n"
+        "        return jnp.zeros((rows,)) + x\n"
+        "    return jax.jit(_make)\n"
+    )
+    d = _find(lint_text_jit(src), "FJX201")[0]
+    assert d.line == _line_of(src, "jnp.zeros")
+    assert d.qualname == "outer._make"
+
+
+def test_fjx201_traced_slice_bound():
+    src = (
+        "import jax\n"
+        "def outer():\n"
+        "    def _prog(x, n):\n"
+        "        return x[:n]\n"
+        "    return jax.jit(_prog)\n"
+    )
+    d = _find(lint_text_jit(src), "FJX201")[0]
+    assert d.line == _line_of(src, "x[:n]")
+    assert "slice bound" in d.message
+
+
+def test_fjx201_bucket_laundering_clears_the_taint():
+    src = (
+        "import jax.numpy as jnp\n"
+        "from fugue_tpu.jax_backend.blocks import padded_len\n"
+        "def outer(engine):\n"
+        "    def _prog(x, n):\n"
+        "        n = padded_len(n)\n"
+        "        return jnp.resize(x, (n,))\n"
+        "    return engine._jit_cached(('p', 1), _prog, static_argnums=(1,))\n"
+    )
+    assert lint_text_jit(src) == []
+
+
+def test_fjx201_program_key_launders_the_capture():
+    # a capture folded into the _jit_cached key is deliberate per-value
+    # specialization (the engine's padded-size idiom), not a hazard
+    src = (
+        "import jax.numpy as jnp\n"
+        "def outer(engine, p):\n"
+        "    def _prog(x):\n"
+        "        return jnp.zeros((p,)) + x\n"
+        "    return engine._jit_cached(('prog', p), _prog)\n"
+    )
+    assert lint_text_jit(src) == []
+
+
+def test_fjx201_static_shape_attributes_stay_clean():
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def outer():\n"
+        "    def _prog(x):\n"
+        "        return jnp.zeros((x.shape[0],), x.dtype) + x[: x.shape[0]]\n"
+        "    return jax.jit(_prog)\n"
+    )
+    assert lint_text_jit(src) == []
+
+
+# ---------------------------------------------------------------------------
+# FJX202: host sync inside jit
+# ---------------------------------------------------------------------------
+def test_fjx202_sync_forms_with_static_negatives():
+    src = (
+        "import jax\n"
+        "def outer():\n"
+        "    def _prog(x, flags):\n"
+        "        if flags is None:\n"        # static: identity
+        "            return x\n"
+        "        if 'a' in flags:\n"         # static: membership
+        "            return x\n"
+        "        if x > 0:\n"                # tracer branch
+        "            return float(x)\n"      # float sync
+        "        return x.item()\n"          # item sync
+        "    return jax.jit(_prog)\n"
+    )
+    diags = _find(lint_text_jit(src), "FJX202")
+    lines = sorted(d.line for d in diags)
+    assert lines == [
+        _line_of(src, "if x > 0"),
+        _line_of(src, "float(x)"),
+        _line_of(src, "x.item()"),
+    ]
+    for d in diags:
+        assert d.severity is Severity.ERROR
+        assert d.qualname == "outer._prog"
+
+
+def test_fjx202_host_numpy_materialization():
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "def outer():\n"
+        "    def _prog(x):\n"
+        "        return np.asarray(x).sum()\n"
+        "    return jax.jit(_prog)\n"
+    )
+    d = _find(lint_text_jit(src), "FJX202")[0]
+    assert d.line == _line_of(src, "np.asarray")
+    assert "host numpy" in d.message
+
+
+# ---------------------------------------------------------------------------
+# FJX203: dtype promotion
+# ---------------------------------------------------------------------------
+def test_fjx203_literal_array_without_dtype_and_float_literal_binop():
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def outer():\n"
+        "    def _prog(x):\n"
+        "        lit = jnp.array([1.5, 2.5])\n"
+        "        ok = jnp.array([1.5], dtype=jnp.float32)\n"
+        "        return x * 0.5 + lit.sum() + ok.sum()\n"
+        "    return jax.jit(_prog)\n"
+    )
+    diags = _find(lint_text_jit(src), "FJX203")
+    errors = [d for d in diags if d.severity is Severity.ERROR]
+    warns = [d for d in diags if d.severity is Severity.WARN]
+    assert [d.line for d in errors] == [_line_of(src, "jnp.array([1.5, 2.5])")]
+    assert [d.line for d in warns] == [_line_of(src, "x * 0.5")]
+    assert all(d.qualname == "outer._prog" for d in diags)
+
+
+# ---------------------------------------------------------------------------
+# FJX204: donation miss
+# ---------------------------------------------------------------------------
+def test_fjx204_self_overwriting_updater_without_donation():
+    src = (
+        "import jax\n"
+        "class Agg:\n"
+        "    def __init__(self, fn):\n"
+        "        self._update = jax.jit(fn)\n"
+        "        self._good = jax.jit(fn, donate_argnums=0)\n"
+        "        self._peeked = jax.jit(fn)\n"
+        "    def step(self, x):\n"
+        "        self.state = self._update(self.state, x)\n"
+        "        self.state = self._good(self.state, x)\n"
+        "    def peek(self, x):\n"
+        "        y = self._peeked(self.state, x)\n"
+        "        return y\n"
+    )
+    diags = _find(lint_text_jit(src), "FJX204")
+    # only _update fires: _good donates, _peeked has a non-overwriting
+    # call site (its return is NOT the state being replaced)
+    assert [d.line for d in diags] == [_line_of(src, "self._update = ")]
+    assert diags[0].qualname == "Agg.__init__"
+    assert "donate_argnums" in diags[0].message
+
+
+# ---------------------------------------------------------------------------
+# FJX205: in-jit side effects
+# ---------------------------------------------------------------------------
+def test_fjx205_print_fault_point_and_closure_mutation():
+    src = (
+        "import jax\n"
+        "from fugue_tpu.testing.faults import fault_point\n"
+        "def outer(log):\n"
+        "    def _prog(x):\n"
+        "        print('tracing')\n"
+        "        fault_point('inside.jit')\n"
+        "        log.append(1)\n"
+        "        acc = []\n"
+        "        acc.append(x)\n"          # local: trace-time unroll, fine
+        "        return x\n"
+        "    return jax.jit(_prog)\n"
+    )
+    diags = _find(lint_text_jit(src), "FJX205")
+    assert sorted(d.line for d in diags) == [
+        _line_of(src, "print("),
+        _line_of(src, "fault_point("),
+        _line_of(src, "log.append"),
+    ]
+    assert all(d.qualname == "outer._prog" for d in diags)
+
+
+def test_fjx205_ancestor_frame_accumulator_is_trace_local():
+    # the payload-dedup slot pattern: a helper mutating a list bound in
+    # its ANCESTOR frame of the same jit region accumulates during the
+    # trace — not an escaping side effect
+    src = (
+        "import jax\n"
+        "def outer():\n"
+        "    def _prog(x):\n"
+        "        slots = []\n"
+        "        def _slot(v):\n"
+        "            slots.append(v)\n"
+        "            return len(slots)\n"
+        "        _slot(x)\n"
+        "        return x\n"
+        "    return jax.jit(_prog)\n"
+    )
+    assert lint_text_jit(src) == []
+
+
+# ---------------------------------------------------------------------------
+# baseline meta-codes
+# ---------------------------------------------------------------------------
+def test_fjx002_unjustified_entry_is_an_error(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text(
+        '{"entries": [{"code": "FJX201", "file": "x.py",'
+        ' "context": "", "justification": ""}]}'
+    )
+    entries, problems = load_jit_baseline(str(p))
+    assert entries == []
+    assert _codes(problems) == ["FJX002"]
+    assert "no justification" in problems[0].message
+
+
+def test_fjx003_stale_entry_warns(tmp_path):
+    entries, problems = load_jit_baseline()
+    assert problems == []
+    diags = lint_tree_jit()
+    _, _, stale = apply_baseline(diags, entries)
+    assert stale == []  # shipped baseline has no rot
+    # a fabricated never-matching entry reports FJX003 at WARN
+    p = tmp_path / "b.json"
+    p.write_text(
+        '{"entries": [{"code": "FJX201", "file": "no/such.py",'
+        ' "context": "", "justification": "obsolete"}]}'
+    )
+    fresh, _ = load_jit_baseline(str(p))
+    _, _, stale = apply_baseline([], fresh)
+    warns = stale_jit_diags(stale, str(p))
+    assert _codes(warns) == ["FJX003"]
+    assert warns[0].severity is Severity.WARN
+
+
+def test_fjx004_unregistered_code_in_baseline(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text(
+        '{"entries": [{"code": "FJX999", "file": "x.py",'
+        ' "context": "", "justification": "typo"}]}'
+    )
+    entries, problems = load_jit_baseline(str(p))
+    assert entries == []
+    assert _codes(problems) == ["FJX004"]
+    assert problems[0].severity is Severity.ERROR
+
+
+def test_fjx001_parse_failure_is_a_diagnostic_not_a_crash(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "broken.py").write_text("def oops(:\n")
+    diags = lint_tree_jit(str(pkg))
+    assert _codes(diags) == ["FJX001"]
+
+
+# ---------------------------------------------------------------------------
+# CLI exit-code contract
+# ---------------------------------------------------------------------------
+def test_cli_lint_jit_exit_codes(tmp_path, capsys):
+    from fugue_tpu.analysis.__main__ import main
+
+    # 0: the shipped tree with the packaged baseline
+    assert main(["--lint-jit"]) == 0
+    out = capsys.readouterr().out
+    assert "jit lint: 0 error(s)" in out and "baselined exception" in out
+
+    # 1: a tree with a hazard and no baseline
+    bad = tmp_path / "pkg"
+    bad.mkdir()
+    (bad / "mod.py").write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def make(rows):\n"
+        "    def _prog(x):\n"
+        "        return jnp.zeros((rows,)) + x\n"
+        "    return jax.jit(_prog)\n"
+    )
+    empty = tmp_path / "empty.json"
+    empty.write_text('{"entries": []}')
+    assert main(["--lint-jit", str(bad), "--baseline", str(empty)]) == 1
+    assert "FJX201" in capsys.readouterr().out
+
+    # 1: a matching entry WITHOUT a justification is itself an error
+    unjustified = tmp_path / "unjustified.json"
+    unjustified.write_text(
+        '{"entries": [{"code": "FJX201", "file": "pkg/mod.py",'
+        ' "context": "", "justification": ""}]}'
+    )
+    assert main(["--lint-jit", str(bad), "--baseline", str(unjustified)]) == 1
+    assert "no justification" in capsys.readouterr().out
+
+    # 0: the same entry WITH a justification suppresses the finding
+    justified = tmp_path / "justified.json"
+    justified.write_text(
+        '{"entries": [{"code": "FJX201", "file": "pkg/mod.py",'
+        ' "context": "make._prog", "justification": "fixture"}]}'
+    )
+    assert main(["--lint-jit", str(bad), "--baseline", str(justified)]) == 0
+
+    # 2: not a directory / exclusive flags
+    assert main(["--lint-jit", str(tmp_path / "missing")]) == 2
+    assert main(["--lint-jit", "--lint-source"]) == 2

@@ -1,0 +1,234 @@
+"""Runtime retrace sentinel: the dynamic twin of the FJX jit-hazard
+lint plane.
+
+The centerpiece is the two-plane test: ONE shape-unstable program —
+a static_argnums parameter driving a shape — is flagged FJX201 by the
+static pass AND trips the armed sentinel at runtime with the offending
+callsite (this file) and the differing aval. Same hazard, both planes.
+
+Plus the sentinel contract: XLA-cache-growth-based counting (not a
+guess), per-program-key budgets, log-vs-raise modes, the
+``fugue_engine_retrace_sentinel_total`` metric, the ``jit_row_sharded``
+dispatch shim, zero-overhead-off, and the serving daemon's conf-driven
+arming parity (armed before the first dispatch, disarmed on stop and on
+hard kill — mirroring the lock sanitizer)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from fugue_tpu.constants import (
+    FUGUE_CONF_DEBUG_RETRACE_SENTINEL,
+    FUGUE_CONF_DEBUG_RETRACE_SENTINEL_MAX_TRACES,
+    FUGUE_CONF_DEBUG_RETRACE_SENTINEL_RAISE,
+)
+from fugue_tpu.testing.retrace import (
+    RetraceBudgetExceeded,
+    active_retrace_sentinel,
+    args_signature,
+    diff_signatures,
+    disable_retrace_sentinel,
+    enable_retrace_sentinel,
+    maybe_enable_from_conf,
+    retrace_sentinel,
+)
+
+pytestmark = [pytest.mark.jitlint]
+
+
+@pytest.fixture
+def engine():
+    from fugue_tpu.jax_backend.execution_engine import JaxExecutionEngine
+
+    e = JaxExecutionEngine(dict(test=True))
+    yield e
+    disable_retrace_sentinel()
+
+
+# the shared two-plane fixture: n is static and drives the output shape,
+# so every distinct n is a fresh XLA program under the SAME engine key
+def _unstable_prog(x, n):
+    return jnp.resize(x, (n,)) + x.sum()
+
+
+def _dispatch_unstable(engine, n, key=("two_plane", "resize")):
+    # NOTE: the engine's plan cache shares jitted handles process-wide by
+    # (plan_sig, key), so each test dispatches under its own key — a
+    # shape another test already compiled would not re-trace here.
+    fn = engine._jit_cached(key, _unstable_prog, (1,))
+    return fn(jnp.arange(4, dtype=jnp.float32), n)
+
+
+def test_two_planes_catch_the_same_hazard(engine):
+    # --- static plane: the same program shape is an FJX201 host-leg
+    # finding (static shape param without bucket laundering)
+    from fugue_tpu.analysis.jitlint import lint_text_jit
+
+    src = (
+        "import jax.numpy as jnp\n"
+        "def build(engine):\n"
+        "    def _unstable_prog(x, n):\n"
+        "        return jnp.resize(x, (n,)) + x.sum()\n"
+        "    return engine._jit_cached(\n"
+        "        ('two_plane', 'resize'), _unstable_prog, (1,))\n"
+    )
+    static = [d for d in lint_text_jit(src) if d.code == "FJX201"]
+    assert static, "static plane must flag the shape-from-static hazard"
+    assert "recompiles" in static[0].message
+
+    # --- runtime plane: the armed sentinel counts each distinct n as a
+    # fresh trace of the SAME program key and reports past the budget
+    with retrace_sentinel(max_traces=2) as san:
+        for n in (3, 5, 7, 9):
+            out = _dispatch_unstable(engine, n)
+            assert out.shape == (n,)
+        assert san.trace_counts()["two_plane"] == 4
+        assert len(san.violations) == 2  # traces 3 and 4 exceed budget 2
+        v = san.violations[0]
+        assert v.traces == 3 and v.max_traces == 2
+        # the report points at THIS file's dispatch, not engine plumbing
+        assert any("test_retrace_sentinel.py" in s for s in v.callsite)
+        assert all("execution_engine.py" not in s for s in v.callsite)
+        # the differing aval is the static scalar that forced the trace
+        assert any("py:int" in d for d in v.diff), v.diff
+        assert "traced 3 times" in v.describe()
+
+    # --- and the engine exported the violations as a labeled counter
+    assert engine._m_retrace.labels(program="two_plane").value == 2.0
+
+
+def test_stable_program_never_trips(engine):
+    with retrace_sentinel(max_traces=2) as san:
+        fn = engine._jit_cached(("stable", "sum"), lambda x: x.sum())
+        for _ in range(6):
+            fn(jnp.arange(8, dtype=jnp.float32))  # one shape, one trace
+        assert san.violations == []
+        assert sum(san.trace_counts().values()) <= 1
+
+
+def test_raise_mode_dies_at_the_first_violation(engine):
+    # a test-local fn: jax's trace cache is keyed on the underlying
+    # function object, so reusing _unstable_prog here would hit the
+    # traces the two-plane test already compiled and never re-trace
+    def _prog(x, n):
+        return jnp.resize(x, (n,)) + x.sum()
+
+    with retrace_sentinel(max_traces=1, raise_on_violation=True):
+        fn = engine._jit_cached(("raise_mode", "resize"), _prog, (1,))
+        fn(jnp.arange(4, dtype=jnp.float32), 3)
+        with pytest.raises(RetraceBudgetExceeded) as ei:
+            fn(jnp.arange(4, dtype=jnp.float32), 5)
+        assert "budget: 1" in str(ei.value)
+
+
+def test_jit_row_sharded_dispatch_is_watched():
+    import jax
+
+    from fugue_tpu.jax_backend import blocks as B
+
+    mesh = B.make_mesh(list(jax.devices())[:1])
+    with retrace_sentinel(max_traces=1) as san:
+        # same program key, two input shapes: the second dispatch grows
+        # jax's per-shape cache -> counted as a retrace of this key
+        for n in (4, 8):
+            prog = B.jit_row_sharded(mesh, ("rt_test", 1), lambda x: x + 1)
+            prog(np.arange(n, dtype=np.int32))
+        assert len(san.violations) == 1
+        assert san.violations[0].program == "row_sharded:rt_test"
+        assert any("int32[4] -> int32[8]" in d for d in san.violations[0].diff)
+    # disarmed: the cached handle dispatches unwatched again
+    assert active_retrace_sentinel() is None
+    prog = B.jit_row_sharded(mesh, ("rt_test", 1), lambda x: x + 1)
+    assert prog(np.arange(16, dtype=np.int32)).shape == (16,)
+
+
+def test_zero_overhead_off(engine):
+    assert active_retrace_sentinel() is None
+    fn = engine._jit_cached(("off", "id"), lambda x: x * 2)
+    for n in (3, 5, 7):
+        fn(jnp.arange(n, dtype=jnp.float32))  # retraces, nobody watching
+    assert active_retrace_sentinel() is None
+
+
+def test_signature_and_diff_vocabulary():
+    sig = args_signature((jnp.zeros((2, 3), jnp.float32), 7, None))
+    assert sig[0] == "float32[2,3]"
+    assert sig[1] == "py:int:7"
+    assert diff_signatures(sig, sig) == []
+    other = args_signature((jnp.zeros((2, 4), jnp.float32), 7, None))
+    d = diff_signatures(sig, other)
+    assert d == ["arg leaf 0: float32[2,3] -> float32[2,4]"]
+
+
+def test_first_armer_wins_and_conf_arming():
+    try:
+        a = enable_retrace_sentinel(max_traces=9)
+        b = enable_retrace_sentinel(max_traces=2)
+        assert a is b and b.max_traces == 9
+    finally:
+        disable_retrace_sentinel()
+    # conf off: nothing armed
+    assert maybe_enable_from_conf({}) is None
+    assert active_retrace_sentinel() is None
+    # conf on: armed with the declared keys' types
+    try:
+        san = maybe_enable_from_conf(
+            {
+                FUGUE_CONF_DEBUG_RETRACE_SENTINEL: "true",
+                FUGUE_CONF_DEBUG_RETRACE_SENTINEL_MAX_TRACES: "3",
+                FUGUE_CONF_DEBUG_RETRACE_SENTINEL_RAISE: "true",
+            }
+        )
+        assert san is active_retrace_sentinel()
+        assert san.max_traces == 3 and san.raise_on_violation
+    finally:
+        disable_retrace_sentinel()
+
+
+@pytest.mark.serve
+def test_daemon_arms_and_disarms_the_sentinel():
+    from fugue_tpu.serve import ServeDaemon
+
+    assert active_retrace_sentinel() is None
+    d = ServeDaemon(
+        {FUGUE_CONF_DEBUG_RETRACE_SENTINEL: True,
+         FUGUE_CONF_DEBUG_RETRACE_SENTINEL_MAX_TRACES: 2}
+    ).start()
+    try:
+        san = active_retrace_sentinel()
+        assert san is not None and san.max_traces == 2
+        assert d._owns_retrace_sentinel
+    finally:
+        d.stop()
+    # stop() disarms an OWNED sentinel: a later daemon without the conf
+    # flag must not report into this dead scope
+    assert active_retrace_sentinel() is None
+
+
+@pytest.mark.serve
+def test_daemon_does_not_steal_a_preexisting_sentinel():
+    from fugue_tpu.serve import ServeDaemon
+
+    pre = enable_retrace_sentinel(max_traces=7)
+    try:
+        d = ServeDaemon({FUGUE_CONF_DEBUG_RETRACE_SENTINEL: True}).start()
+        try:
+            assert not d._owns_retrace_sentinel
+            assert active_retrace_sentinel() is pre
+        finally:
+            d.stop()
+        # the outer owner's scope survives the daemon's lifetime
+        assert active_retrace_sentinel() is pre
+    finally:
+        disable_retrace_sentinel()
+
+
+@pytest.mark.serve
+def test_hard_kill_disarms_an_owned_sentinel():
+    from fugue_tpu.serve import ServeDaemon
+
+    d = ServeDaemon({FUGUE_CONF_DEBUG_RETRACE_SENTINEL: True}).start()
+    assert d._owns_retrace_sentinel
+    d._hard_kill()
+    assert active_retrace_sentinel() is None
